@@ -1,0 +1,16 @@
+"""pinot_tpu — a TPU-native realtime distributed OLAP datastore.
+
+A from-scratch framework with the capabilities of Apache Pinot (incubating):
+columnar immutable segments with dictionary / forward / inverted-bitmap /
+bloom / star-tree indexes, a PQL-style query language compiled to per-segment
+execution plans, scatter-gather distributed execution with broker-side reduce,
+batch + streaming ingestion, and a controller plane for segment assignment.
+
+Unlike the Java reference (see SURVEY.md), the per-segment execution engine is
+built TPU-first: filters are vectorized mask kernels over HBM-resident
+dictionary-encoded columns, aggregations are masked reductions, group-by is a
+mixed-radix scatter-add, and multi-segment combine rides `shard_map`/`psum`
+over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,191 @@
+"""Compiled query representation: the broker request model.
+
+Parity: the Thrift types in pinot-common/src/thrift/request.thrift
+(BrokerRequest, FilterQuery/FilterQueryMap, AggregationInfo, GroupBy,
+Selection, SelectionSort, HavingFilterQuery) plus
+org.apache.pinot.common.utils.request.FilterQueryTree. We use plain
+dataclass trees instead of flattened thrift id-maps — the semantics
+(operators, nesting, value lists) are identical.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional
+
+
+class FilterOperator(enum.Enum):
+    AND = "AND"
+    OR = "OR"
+    EQUALITY = "EQUALITY"
+    NOT = "NOT"                 # not-equals
+    IN = "IN"
+    NOT_IN = "NOT_IN"
+    RANGE = "RANGE"
+    REGEXP_LIKE = "REGEXP_LIKE"
+    IS_NULL = "IS_NULL"
+    IS_NOT_NULL = "IS_NOT_NULL"
+
+
+@dataclasses.dataclass
+class FilterQueryTree:
+    """A node in the filter tree.
+
+    Leaf nodes carry (column, operator, values); AND/OR nodes carry children.
+    RANGE values use Pinot's interval string syntax, e.g. ``["(10\t\t20)"]``
+    is 10 < col < 20, ``["[10\t\t*)"]`` is col >= 10 (values joined by the
+    RANGE delimiter). We keep a structured form instead: values =
+    [lower, upper] with inclusive flags.
+    """
+    operator: FilterOperator
+    column: Optional[str] = None
+    values: List[str] = dataclasses.field(default_factory=list)
+    children: List["FilterQueryTree"] = dataclasses.field(default_factory=list)
+    # RANGE only:
+    lower: Optional[str] = None          # None = unbounded (*)
+    upper: Optional[str] = None
+    lower_inclusive: bool = True
+    upper_inclusive: bool = True
+
+    def is_leaf(self) -> bool:
+        return not self.children
+
+    def __repr__(self) -> str:  # compact, for plan/debug output
+        if self.operator in (FilterOperator.AND, FilterOperator.OR):
+            return f"{self.operator.value}({', '.join(map(repr, self.children))})"
+        if self.operator == FilterOperator.RANGE:
+            lb = "[" if self.lower_inclusive else "("
+            ub = "]" if self.upper_inclusive else ")"
+            return (f"RANGE({self.column} in {lb}{self.lower or '*'},"
+                    f"{self.upper or '*'}{ub})")
+        return f"{self.operator.value}({self.column}, {self.values})"
+
+
+@dataclasses.dataclass
+class AggregationInfo:
+    """One aggregation call, e.g. SUM(metric).
+
+    Parity: request.thrift AggregationInfo {aggregationType, aggregationParams}.
+    """
+    function_name: str                    # upper-case, e.g. "SUM", "PERCENTILE95"
+    column: str                           # "*" for COUNT(*)
+    # parsed expression for transform args (round 1: plain column only)
+
+    @property
+    def call(self) -> str:
+        return f"{self.function_name.lower()}({self.column})"
+
+
+@dataclasses.dataclass
+class SelectionSort:
+    column: str
+    ascending: bool = True
+
+
+@dataclasses.dataclass
+class GroupBy:
+    columns: List[str]
+    top_n: int = 10
+
+
+@dataclasses.dataclass
+class Selection:
+    columns: List[str]
+    order_by: List[SelectionSort] = dataclasses.field(default_factory=list)
+    offset: int = 0
+    size: int = 10
+
+
+@dataclasses.dataclass
+class HavingNode:
+    """HAVING clause tree: comparison over aggregation results, or AND/OR."""
+    operator: FilterOperator              # EQUALITY/NOT/RANGE/IN/... or AND/OR
+    agg: Optional[AggregationInfo] = None
+    values: List[str] = dataclasses.field(default_factory=list)
+    children: List["HavingNode"] = dataclasses.field(default_factory=list)
+    lower: Optional[str] = None
+    upper: Optional[str] = None
+    lower_inclusive: bool = True
+    upper_inclusive: bool = True
+
+
+@dataclasses.dataclass
+class QueryOptions:
+    trace: bool = False
+    timeout_ms: Optional[int] = None
+    debug_options: dict = dataclasses.field(default_factory=dict)
+    options: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class BrokerRequest:
+    """The compiled query, handed from broker to servers.
+
+    Exactly one of (aggregations, selection) is populated: aggregation queries
+    may also carry group_by; selection queries carry columns + order by.
+    """
+    table_name: str
+    filter: Optional[FilterQueryTree] = None
+    aggregations: List[AggregationInfo] = dataclasses.field(default_factory=list)
+    group_by: Optional[GroupBy] = None
+    selection: Optional[Selection] = None
+    having: Optional[HavingNode] = None
+    query_options: QueryOptions = dataclasses.field(default_factory=QueryOptions)
+    limit: int = 10
+
+    @property
+    def is_aggregation(self) -> bool:
+        return bool(self.aggregations)
+
+    @property
+    def is_group_by(self) -> bool:
+        return self.group_by is not None
+
+    @property
+    def is_selection(self) -> bool:
+        return self.selection is not None
+
+    def filter_columns(self) -> List[str]:
+        cols: List[str] = []
+
+        def walk(node: Optional[FilterQueryTree]):
+            if node is None:
+                return
+            if node.is_leaf():
+                if node.column:
+                    cols.append(node.column)
+            else:
+                for c in node.children:
+                    walk(c)
+
+        walk(self.filter)
+        return cols
+
+    def referenced_columns(self) -> List[str]:
+        """All physical columns the query touches (for pruning/validation)."""
+        cols = set(self.filter_columns())
+        for a in self.aggregations:
+            if a.column != "*":
+                cols.add(a.column)
+        if self.group_by:
+            cols.update(self.group_by.columns)
+        if self.selection:
+            for c in self.selection.columns:
+                if c != "*":
+                    cols.add(c)
+            cols.update(s.column for s in self.selection.order_by)
+        return sorted(cols)
+
+
+@dataclasses.dataclass
+class InstanceRequest:
+    """Broker→server RPC payload.
+
+    Parity: request.thrift InstanceRequest {requestId, query, searchSegments,
+    enableTrace, brokerId}.
+    """
+    request_id: int
+    query: BrokerRequest
+    search_segments: List[str] = dataclasses.field(default_factory=list)
+    enable_trace: bool = False
+    broker_id: str = ""

@@ -1,0 +1,387 @@
+"""PQL recursive-descent parser → BrokerRequest.
+
+Parity: org.apache.pinot.pql.parsers.Pql2Compiler.compileToBrokerRequest
+(pinot-common/.../pql/parsers/Pql2Compiler.java:63-102) and the PQL2.g4
+grammar: SELECT output list (columns or aggregation calls), FROM, WHERE
+predicate tree (comparison / BETWEEN / IN / NOT IN / REGEXP_LIKE / IS NULL
+with AND/OR nesting), GROUP BY, HAVING, ORDER BY, TOP, LIMIT.
+
+Comparison predicates compile to the same FilterOperator encoding the
+reference uses (Pql2AstNode → FilterQueryTree): ``=`` → EQUALITY, ``<>/!=`` →
+NOT, ``< <= > >=`` → one-sided RANGE, BETWEEN → two-sided inclusive RANGE.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
+                                      FilterOperator, FilterQueryTree, GroupBy,
+                                      HavingNode, QueryOptions, Selection,
+                                      SelectionSort)
+from pinot_tpu.pql.lexer import PqlSyntaxError, TokType, Token, tokenize
+
+# Aggregation function names the engine recognizes (PERCENTILE variants are
+# matched by prefix, e.g. PERCENTILE95 / PERCENTILETDIGEST99).
+AGG_PREFIXES = (
+    "COUNT", "SUM", "MIN", "MAX", "AVG", "MINMAXRANGE", "DISTINCTCOUNTHLL",
+    "DISTINCTCOUNTRAWHLL", "DISTINCTCOUNT", "FASTHLL", "PERCENTILEEST",
+    "PERCENTILETDIGEST", "PERCENTILE",
+)
+_MV_SUFFIX = "MV"
+
+
+def is_aggregation_function(name: str) -> bool:
+    up = name.upper()
+    if up.endswith(_MV_SUFFIX):
+        up = up[: -len(_MV_SUFFIX)]
+    for p in sorted(AGG_PREFIXES, key=len, reverse=True):
+        if up.startswith(p):
+            rest = up[len(p):]
+            return rest == "" or rest.isdigit()
+    return False
+
+
+class Pql2Compiler:
+    """compile(pql) -> BrokerRequest."""
+
+    def compile(self, pql: str) -> BrokerRequest:
+        return _Parser(tokenize(pql), pql).parse_query()
+
+
+def compile_pql(pql: str) -> BrokerRequest:
+    return Pql2Compiler().compile(pql)
+
+
+class _Parser:
+    def __init__(self, toks: List[Token], text: str):
+        self.toks = toks
+        self.text = text
+        self.i = 0
+
+    # -- token plumbing ----------------------------------------------------
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *words: str) -> bool:
+        t = self.peek()
+        if t.type == TokType.KEYWORD and t.upper == words[0]:
+            # multi-word keyword like GROUP BY
+            for k, w in enumerate(words):
+                tk = self.toks[self.i + k]
+                if not (tk.type == TokType.KEYWORD and tk.upper == w):
+                    return False
+            self.i += len(words)
+            return True
+        return False
+
+    def expect_kw(self, *words: str):
+        if not self.accept_kw(*words):
+            raise PqlSyntaxError(
+                f"expected {' '.join(words)} at {self.peek().pos} "
+                f"(got {self.peek().value!r})")
+
+    def expect(self, ttype: TokType) -> Token:
+        t = self.next()
+        if t.type != ttype:
+            raise PqlSyntaxError(f"expected {ttype.value} at {t.pos}, "
+                                 f"got {t.value!r}")
+        return t
+
+    # -- grammar -----------------------------------------------------------
+    def parse_query(self) -> BrokerRequest:
+        self.expect_kw("SELECT")
+        select_items = self.parse_select_list()
+        self.expect_kw("FROM")
+        table = self.expect(TokType.IDENT).value
+
+        filt = None
+        if self.accept_kw("WHERE"):
+            filt = self.parse_predicate()
+
+        group_by_cols: List[str] = []
+        if self.accept_kw("GROUP", "BY"):
+            group_by_cols = self.parse_ident_list()
+
+        having = None
+        if self.accept_kw("HAVING"):
+            having = self.parse_having()
+
+        order_by: List[SelectionSort] = []
+        if self.accept_kw("ORDER", "BY"):
+            order_by = self.parse_order_list()
+
+        top_n = None
+        if self.accept_kw("TOP"):
+            top_n = int(self.expect(TokType.INT).value)
+
+        offset, size = 0, None
+        if self.accept_kw("LIMIT"):
+            first = int(self.expect(TokType.INT).value)
+            if self.peek().type == TokType.COMMA:
+                self.next()
+                offset, size = first, int(self.expect(TokType.INT).value)
+            elif self.accept_kw("OFFSET"):
+                size, offset = first, int(self.expect(TokType.INT).value)
+            else:
+                size = first
+
+        options = QueryOptions()
+        if self.accept_kw("OPTION"):
+            self.expect(TokType.LPAREN)
+            while True:
+                key = self.next().value
+                self.expect(TokType.OP)  # '='
+                val = self.next().value
+                options.options[key] = val
+                if self.peek().type == TokType.COMMA:
+                    self.next()
+                    continue
+                break
+            self.expect(TokType.RPAREN)
+
+        if self.peek().type != TokType.EOF:
+            raise PqlSyntaxError(
+                f"trailing input at {self.peek().pos}: {self.peek().value!r}")
+
+        # -- assemble ------------------------------------------------------
+        aggs = [it for it in select_items if isinstance(it, AggregationInfo)]
+        cols = [it for it in select_items if isinstance(it, str)]
+        if aggs and cols:
+            raise PqlSyntaxError(
+                "cannot mix aggregations and plain columns in SELECT "
+                "(use GROUP BY for grouped output)")
+
+        req = BrokerRequest(table_name=table, filter=filt,
+                            query_options=options)
+        if aggs:
+            req.aggregations = aggs
+            if group_by_cols:
+                req.group_by = GroupBy(columns=group_by_cols,
+                                       top_n=top_n or size or 10)
+            req.having = having
+            req.limit = top_n or size or 10
+        else:
+            if group_by_cols:
+                raise PqlSyntaxError("GROUP BY requires aggregations")
+            req.selection = Selection(columns=cols or ["*"],
+                                      order_by=order_by, offset=offset,
+                                      size=size if size is not None else 10)
+            req.limit = size if size is not None else 10
+        return req
+
+    def parse_select_list(self):
+        items = []
+        if self.peek().type == TokType.STAR:
+            self.next()
+            return ["*"]
+        while True:
+            items.append(self.parse_select_item())
+            if self.peek().type == TokType.COMMA:
+                self.next()
+                continue
+            return items
+
+    def parse_select_item(self):
+        t = self.peek()
+        if t.type == TokType.IDENT and \
+                self.toks[self.i + 1].type == TokType.LPAREN and \
+                is_aggregation_function(t.value):
+            return self.parse_agg_call()
+        if t.type == TokType.IDENT:
+            return self.next().value
+        raise PqlSyntaxError(f"bad select item at {t.pos}: {t.value!r}")
+
+    def parse_agg_call(self) -> AggregationInfo:
+        name = self.next().upper
+        self.expect(TokType.LPAREN)
+        if self.peek().type == TokType.STAR:
+            self.next()
+            col = "*"
+        else:
+            col = self.expect(TokType.IDENT).value
+        self.expect(TokType.RPAREN)
+        return AggregationInfo(function_name=name, column=col)
+
+    def parse_ident_list(self) -> List[str]:
+        out = [self.expect(TokType.IDENT).value]
+        while self.peek().type == TokType.COMMA:
+            self.next()
+            out.append(self.expect(TokType.IDENT).value)
+        return out
+
+    def parse_order_list(self) -> List[SelectionSort]:
+        out = []
+        while True:
+            col = self.expect(TokType.IDENT).value
+            asc = True
+            if self.accept_kw("ASC"):
+                asc = True
+            elif self.accept_kw("DESC"):
+                asc = False
+            out.append(SelectionSort(column=col, ascending=asc))
+            if self.peek().type == TokType.COMMA:
+                self.next()
+                continue
+            return out
+
+    # -- WHERE predicates --------------------------------------------------
+    def parse_predicate(self) -> FilterQueryTree:
+        return self.parse_or()
+
+    def parse_or(self) -> FilterQueryTree:
+        left = self.parse_and()
+        children = [left]
+        while self.accept_kw("OR"):
+            children.append(self.parse_and())
+        if len(children) == 1:
+            return left
+        return FilterQueryTree(FilterOperator.OR, children=children)
+
+    def parse_and(self) -> FilterQueryTree:
+        left = self.parse_unary()
+        children = [left]
+        while self.accept_kw("AND"):
+            children.append(self.parse_unary())
+        if len(children) == 1:
+            return left
+        return FilterQueryTree(FilterOperator.AND, children=children)
+
+    def parse_unary(self) -> FilterQueryTree:
+        if self.peek().type == TokType.LPAREN:
+            self.next()
+            node = self.parse_or()
+            self.expect(TokType.RPAREN)
+            return node
+        # REGEXP_LIKE(col, 'pattern')
+        t = self.peek()
+        if t.type == TokType.IDENT and t.upper == "REGEXP_LIKE" and \
+                self.toks[self.i + 1].type == TokType.LPAREN:
+            self.next(); self.next()
+            col = self.expect(TokType.IDENT).value
+            self.expect(TokType.COMMA)
+            pat = self.expect(TokType.STRING).value
+            self.expect(TokType.RPAREN)
+            return FilterQueryTree(FilterOperator.REGEXP_LIKE, column=col,
+                                   values=[pat])
+        return self.parse_comparison()
+
+    def parse_literal(self) -> str:
+        t = self.next()
+        if t.type in (TokType.STRING, TokType.INT, TokType.FLOAT,
+                      TokType.IDENT):
+            return t.value
+        raise PqlSyntaxError(f"expected literal at {t.pos}, got {t.value!r}")
+
+    def parse_comparison(self) -> FilterQueryTree:
+        col = self.expect(TokType.IDENT).value
+        t = self.peek()
+        if t.type == TokType.OP:
+            op = self.next().value
+            val = self.parse_literal()
+            return _comparison_to_tree(col, op, val)
+        negate = self.accept_kw("NOT")
+        if self.accept_kw("BETWEEN"):
+            lo = self.parse_literal()
+            self.expect_kw("AND")
+            hi = self.parse_literal()
+            node = FilterQueryTree(FilterOperator.RANGE, column=col,
+                                   lower=lo, upper=hi,
+                                   lower_inclusive=True, upper_inclusive=True)
+            if negate:
+                raise PqlSyntaxError("NOT BETWEEN is not supported")
+            return node
+        if self.accept_kw("IN"):
+            self.expect(TokType.LPAREN)
+            vals = [self.parse_literal()]
+            while self.peek().type == TokType.COMMA:
+                self.next()
+                vals.append(self.parse_literal())
+            self.expect(TokType.RPAREN)
+            return FilterQueryTree(
+                FilterOperator.NOT_IN if negate else FilterOperator.IN,
+                column=col, values=vals)
+        if self.accept_kw("IS"):
+            is_not = self.accept_kw("NOT")
+            self.expect_kw("NULL")
+            return FilterQueryTree(
+                FilterOperator.IS_NOT_NULL if is_not else FilterOperator.IS_NULL,
+                column=col)
+        raise PqlSyntaxError(f"bad predicate near {t.pos}: {t.value!r}")
+
+    # -- HAVING ------------------------------------------------------------
+    def parse_having(self) -> HavingNode:
+        return self.parse_having_or()
+
+    def parse_having_or(self) -> HavingNode:
+        children = [self.parse_having_and()]
+        while self.accept_kw("OR"):
+            children.append(self.parse_having_and())
+        if len(children) == 1:
+            return children[0]
+        return HavingNode(FilterOperator.OR, children=children)
+
+    def parse_having_and(self) -> HavingNode:
+        children = [self.parse_having_unary()]
+        while self.accept_kw("AND"):
+            children.append(self.parse_having_unary())
+        if len(children) == 1:
+            return children[0]
+        return HavingNode(FilterOperator.AND, children=children)
+
+    def parse_having_unary(self) -> HavingNode:
+        if self.peek().type == TokType.LPAREN:
+            self.next()
+            node = self.parse_having_or()
+            self.expect(TokType.RPAREN)
+            return node
+        agg = self.parse_agg_call()
+        t = self.peek()
+        if t.type == TokType.OP:
+            op = self.next().value
+            val = self.parse_literal()
+            tree = _comparison_to_tree("_", op, val)
+            return HavingNode(tree.operator, agg=agg, values=tree.values,
+                              lower=tree.lower, upper=tree.upper,
+                              lower_inclusive=tree.lower_inclusive,
+                              upper_inclusive=tree.upper_inclusive)
+        if self.accept_kw("BETWEEN"):
+            lo = self.parse_literal()
+            self.expect_kw("AND")
+            hi = self.parse_literal()
+            return HavingNode(FilterOperator.RANGE, agg=agg, lower=lo,
+                              upper=hi)
+        if self.accept_kw("IN"):
+            self.expect(TokType.LPAREN)
+            vals = [self.parse_literal()]
+            while self.peek().type == TokType.COMMA:
+                self.next()
+                vals.append(self.parse_literal())
+            self.expect(TokType.RPAREN)
+            return HavingNode(FilterOperator.IN, agg=agg, values=vals)
+        raise PqlSyntaxError(f"bad HAVING predicate at {t.pos}")
+
+
+def _comparison_to_tree(col: str, op: str, val: str) -> FilterQueryTree:
+    if op == "=":
+        return FilterQueryTree(FilterOperator.EQUALITY, column=col,
+                               values=[val])
+    if op in ("<>", "!="):
+        return FilterQueryTree(FilterOperator.NOT, column=col, values=[val])
+    if op == "<":
+        return FilterQueryTree(FilterOperator.RANGE, column=col, upper=val,
+                               upper_inclusive=False)
+    if op == "<=":
+        return FilterQueryTree(FilterOperator.RANGE, column=col, upper=val,
+                               upper_inclusive=True)
+    if op == ">":
+        return FilterQueryTree(FilterOperator.RANGE, column=col, lower=val,
+                               lower_inclusive=False)
+    if op == ">=":
+        return FilterQueryTree(FilterOperator.RANGE, column=col, lower=val,
+                               lower_inclusive=True)
+    raise PqlSyntaxError(f"unknown comparison operator {op!r}")
