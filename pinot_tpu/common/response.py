@@ -1,0 +1,97 @@
+"""Broker response model (the JSON the client receives).
+
+Parity: pinot-common/.../response/broker/BrokerResponseNative.java — PQL
+response shape: aggregationResults (plain or groupByResult), selectionResults,
+exceptions, and the execution-stats fields
+(ServerQueryExecutorV1Impl.java:190-197 metadata propagated through
+BrokerReduceService).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class AggregationResult:
+    function: str
+    value: Optional[object] = None
+    # group-by variant:
+    group_by_columns: Optional[List[str]] = None
+    group_by_result: Optional[List[dict]] = None   # [{"group": [...], "value": v}]
+
+    def to_json(self) -> dict:
+        if self.group_by_result is not None:
+            return {"function": self.function,
+                    "groupByColumns": self.group_by_columns,
+                    "groupByResult": self.group_by_result}
+        return {"function": self.function, "value": _fmt(self.value)}
+
+
+@dataclasses.dataclass
+class SelectionResults:
+    columns: List[str]
+    results: List[list]
+
+    def to_json(self) -> dict:
+        return {"columns": self.columns, "results": self.results}
+
+
+@dataclasses.dataclass
+class BrokerResponse:
+    aggregation_results: Optional[List[AggregationResult]] = None
+    selection_results: Optional[SelectionResults] = None
+    exceptions: List[dict] = dataclasses.field(default_factory=list)
+    num_docs_scanned: int = 0
+    num_entries_scanned_in_filter: int = 0
+    num_entries_scanned_post_filter: int = 0
+    num_segments_processed: int = 0
+    num_segments_matched: int = 0
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
+    num_groups_limit_reached: bool = False
+    total_docs: int = 0
+    time_used_ms: float = 0.0
+
+    def to_json(self) -> dict:
+        d = {
+            "exceptions": self.exceptions,
+            "numDocsScanned": self.num_docs_scanned,
+            "numEntriesScannedInFilter": self.num_entries_scanned_in_filter,
+            "numEntriesScannedPostFilter":
+                self.num_entries_scanned_post_filter,
+            "numSegmentsProcessed": self.num_segments_processed,
+            "numSegmentsMatched": self.num_segments_matched,
+            "numServersQueried": self.num_servers_queried,
+            "numServersResponded": self.num_servers_responded,
+            "numGroupsLimitReached": self.num_groups_limit_reached,
+            "totalDocs": self.total_docs,
+            "timeUsedMs": round(self.time_used_ms, 3),
+        }
+        if self.aggregation_results is not None:
+            d["aggregationResults"] = [a.to_json()
+                                       for a in self.aggregation_results]
+        if self.selection_results is not None:
+            d["selectionResults"] = self.selection_results.to_json()
+        return d
+
+    def to_json_str(self) -> str:
+        return json.dumps(self.to_json())
+
+
+def _fmt(v):
+    """Format final aggregation values as strings (the reference renders
+    numbers as strings in the JSON response); floats keep full precision."""
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return str(v).lower()
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        return str(int(v)) if v == int(v) and abs(v) < 1e15 else str(v)
+    return str(v)

@@ -1,0 +1,315 @@
+"""Per-segment query kernels: filter masks, aggregations, group-by, selection.
+
+This is the TPU replacement for the reference's operator tree
+(pinot-core/.../core/operator/ — SURVEY.md §2.2 "primary TPU kernel surface").
+Where the Java engine pulls 10k-doc blocks through virtual-call iterators
+(DocIdSetOperator → ProjectionOperator → AggregationOperator), we compile the
+whole per-segment plan into ONE jitted function over padded, HBM-resident
+dictId lanes:
+
+- Filter tree → vectorized boolean mask expression. Predicates are resolved
+  host-side into the dictId domain (sorted dictionaries make ranges contiguous
+  id intervals), so EQ/RANGE/IN become integer compares on int32 lanes and
+  arbitrary dictionary predicates (REGEXP_LIKE, big IN lists) become a
+  member-vector gather. Replaces BitmapBasedFilterOperator /
+  ScanBasedFilterOperator / SortedInvertedIndexBasedFilterOperator and the
+  And/OrDocIdIterator hot loops with pure VPU work.
+- Aggregations → masked reductions. SUM/AVG/DISTINCTCOUNT go through a dictId
+  histogram (int32 scatter-add) so the device only ever computes exact integer
+  counts; the final f64 dot with dictionary values happens host-side. MIN/MAX
+  reduce dictIds directly (dictionaries are sorted ⇒ id order == value order).
+  Replaces AggregationOperator / DictionaryBasedAggregationOperator.
+- Group-by → mixed-radix dictId keys (same math as
+  DictionaryBasedGroupKeyGenerator.java:204 `groupId = groupId*card + dictId`)
+  + scatter-add into a static pow2-padded group table. Replaces
+  DefaultGroupByExecutor.
+- Selection → jnp.nonzero(size=k) for limit queries, lax.top_k over packed
+  order keys for ORDER BY. Replaces SelectionOperator's PriorityQueue.
+
+Kernel specs are hashable tuples of static structure (shapes pow2-bucketed);
+predicate constants are dynamic operands — so one compiled executable serves
+every query with the same shape, the plan-cache requirement called out in
+SURVEY.md §7 "hard parts".
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+def pow2_bucket(n: int, floor: int = 8) -> int:
+    """Round up to a power of two (shape bucketing for jit-cache reuse)."""
+    n = max(n, floor)
+    return 1 << int(np.ceil(np.log2(n)))
+
+
+def sum_dtype():
+    """Accumulator dtype for value sums: f64 under x64 (CPU tests), else f32."""
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Filter spec evaluation
+#
+# spec grammar (hashable tuples):
+#   ("and", (child, ...)) | ("or", (child, ...))
+#   ("match_all",) | ("empty",)
+#   ("pred", kind, col, source, extra)
+#     kind ∈ {eq_id, neq_id, in_ids, notin_ids, range_ids, member,
+#             eq_raw, neq_raw, in_raw, notin_raw, range_raw}
+#     source ∈ {sv, mv, raw}
+#     extra: kind-specific static data (bucketed value count, inclusivity)
+# params: flat tuple of jnp arrays consumed in depth-first pred order.
+# ---------------------------------------------------------------------------
+
+
+def _eval_pred(kind: str, source: str, extra, lane, params: List):
+    """lane: int32 [P] (sv ids), int32 [P, W] (mv ids), or raw values [P]."""
+    if kind == "eq_id" or kind == "eq_raw":
+        v = params.pop(0)
+        m = lane == v
+    elif kind == "neq_id" or kind == "neq_raw":
+        v = params.pop(0)
+        m = lane != v
+    elif kind == "in_ids" or kind == "in_raw":
+        vals = params.pop(0)  # [k]
+        m = (lane[..., None] == vals).any(-1)
+    elif kind == "notin_ids" or kind == "notin_raw":
+        vals = params.pop(0)
+        m = ~((lane[..., None] == vals).any(-1))
+    elif kind == "range_ids":
+        lo, hi = params.pop(0), params.pop(0)  # half-open id interval
+        m = (lane >= lo) & (lane < hi)
+    elif kind == "range_raw":
+        lo, hi = params.pop(0), params.pop(0)
+        lo_inc, hi_inc = extra
+        ml = (lane >= lo) if lo_inc else (lane > lo)
+        mh = (lane <= hi) if hi_inc else (lane < hi)
+        m = ml & mh
+    elif kind == "member":
+        member = params.pop(0)  # bool [card_pad]
+        m = member[jnp.clip(lane, 0, member.shape[0] - 1)]
+    else:
+        raise ValueError(f"unknown predicate kind {kind}")
+    if source == "mv":
+        # Pinot MV semantics: doc matches if ANY entry matches; padding
+        # entries carry id == cardinality which only member-vectors could
+        # accidentally hit — member vectors are padded False there.
+        m = m.any(-1)
+    return m
+
+
+def _eval_filter(spec, cols: Dict[str, jnp.ndarray], params: List, valid):
+    op = spec[0]
+    if op == "match_all":
+        return valid
+    if op == "empty":
+        return jnp.zeros_like(valid)
+    if op in ("and", "or"):
+        masks = [_eval_filter(c, cols, params, valid) for c in spec[1]]
+        out = masks[0]
+        for m in masks[1:]:
+            out = (out & m) if op == "and" else (out | m)
+        return out
+    if op == "pred":
+        _, kind, col, source, extra = spec
+        key = {"sv": f"{col}.ids", "mv": f"{col}.mv", "raw": f"{col}.raw"}[source]
+        return _eval_pred(kind, source, extra, cols[key], params)
+    raise ValueError(f"unknown filter node {op}")
+
+
+# ---------------------------------------------------------------------------
+# Aggregation spec evaluation (no group-by)
+#
+# agg spec: (fname, col, source, extra)
+#   fname ∈ {count, sum, min, max, avg, minmaxrange, distinctcount,
+#            sumhist, percentile}
+# Emitted outputs are "device partials" — host code (query/aggregation)
+# finishes them exactly (histogram ⋅ dictionary in f64, id → value decode).
+# ---------------------------------------------------------------------------
+
+
+def _histogram(cols, col: str, card_pad: int, mask):
+    ids = cols[f"{col}.ids"]
+    return jnp.zeros(card_pad, jnp.int32).at[ids].add(mask.astype(jnp.int32))
+
+
+def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
+    outs = {}
+    hists: Dict[Tuple[str, int], jnp.ndarray] = {}
+    for i, spec in enumerate(agg_specs):
+        fname, col, source, extra = spec
+        if fname == "count":
+            outs[f"agg{i}"] = mask.sum(dtype=jnp.int32)
+        elif fname in ("sum", "avg", "distinctcount", "percentile") and \
+                source == "sv":
+            card_pad = extra
+            hk = (col, card_pad)
+            if hk not in hists:
+                hists[hk] = _histogram(cols, col, card_pad, mask)
+            # sum/avg: host does the f64 histogram·dictionary dot;
+            # percentile: host walks the value-count CDF; distinctcount:
+            # host needs the value set anyway for cross-segment merge
+            outs[f"agg{i}"] = hists[hk]
+        elif source == "mv":
+            card_pad, card = extra
+            ids = cols[f"{col}.mv"]
+            entry_mask = mask[:, None] & (ids < card)  # drop padding entries
+            if fname in ("sum", "avg", "percentile", "distinctcount",
+                         "countmv"):
+                hk = (col, card_pad, "mv")
+                if hk not in hists:
+                    hists[hk] = jnp.zeros(card_pad, jnp.int32).at[
+                        ids.reshape(-1)].add(
+                            entry_mask.reshape(-1).astype(jnp.int32))
+                if fname == "countmv":
+                    outs[f"agg{i}"] = hists[hk][:card].sum(dtype=jnp.int32)
+                else:
+                    outs[f"agg{i}"] = hists[hk]
+            elif fname in ("min", "max", "minmaxrange"):
+                if fname in ("min", "minmaxrange"):
+                    outs[f"agg{i}.min"] = jnp.where(entry_mask, ids,
+                                                    card_pad).min()
+                if fname in ("max", "minmaxrange"):
+                    outs[f"agg{i}.max"] = jnp.where(entry_mask, ids, -1).max()
+            else:
+                raise ValueError(f"unsupported MV aggregation {fname}")
+        elif fname in ("min", "max", "minmaxrange") and source == "sv":
+            card_pad = extra
+            ids = cols[f"{col}.ids"]
+            if fname in ("min", "minmaxrange"):
+                outs[f"agg{i}.min"] = jnp.where(mask, ids, card_pad).min()
+            if fname in ("max", "minmaxrange"):
+                outs[f"agg{i}.max"] = jnp.where(mask, ids, -1).max()
+        elif fname in ("sum", "avg", "min", "max", "minmaxrange") and \
+                source == "raw":
+            vals = cols[f"{col}.raw"]
+            acc = sum_dtype()
+            if fname in ("sum", "avg"):
+                outs[f"agg{i}"] = jnp.where(mask, vals, 0).sum(dtype=acc)
+                if fname == "avg":
+                    outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
+            if fname in ("min", "minmaxrange"):
+                outs[f"agg{i}.min"] = jnp.where(mask, vals,
+                                                jnp.inf).min()
+            if fname in ("max", "minmaxrange"):
+                outs[f"agg{i}.max"] = jnp.where(mask, vals,
+                                                -jnp.inf).max()
+        else:
+            raise ValueError(f"unsupported aggregation spec {spec}")
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Group-by
+#
+# group spec: (cols=(c1,...), strides=(s1,...), g_pad, aggs=(agg specs))
+# Keys are mixed-radix over dictIds; table arrays are pow2-padded.
+# ---------------------------------------------------------------------------
+
+
+def _group_outputs(group_spec, cols, mask, num_docs):
+    gcols, strides, g_pad, agg_specs = group_spec
+    key = None
+    for c, s in zip(gcols, strides):
+        term = cols[f"{c}.ids"].astype(jnp.int32) * np.int32(s)
+        key = term if key is None else key + term
+    key = jnp.clip(key, 0, g_pad - 1)
+    outs = {
+        "group.count": jnp.zeros(g_pad, jnp.int32).at[key].add(
+            mask.astype(jnp.int32))
+    }
+    for i, spec in enumerate(agg_specs):
+        fname, col, source, extra = spec
+        if fname == "count":
+            continue  # shares group.count
+        if source == "sv":
+            vals = cols[f"{col}.vals"][cols[f"{col}.ids"]]
+        else:
+            vals = cols[f"{col}.raw"]
+        acc = sum_dtype()
+        if fname in ("sum", "avg"):
+            contrib = jnp.where(mask, vals.astype(acc), 0)
+            outs[f"gagg{i}.sum"] = jnp.zeros(g_pad, acc).at[key].add(contrib)
+        if fname in ("min", "minmaxrange"):
+            v = jnp.where(mask, vals.astype(acc), jnp.inf)
+            outs[f"gagg{i}.min"] = jnp.full(g_pad, jnp.inf, acc).at[key].min(v)
+        if fname in ("max", "minmaxrange"):
+            v = jnp.where(mask, vals.astype(acc), -jnp.inf)
+            outs[f"gagg{i}.max"] = jnp.full(g_pad, -jnp.inf, acc).at[key].max(v)
+        if fname not in ("sum", "avg", "min", "max", "minmaxrange"):
+            raise ValueError(f"unsupported group-by aggregation {fname}")
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Selection
+#
+# select spec: (kind, k, order=((col, asc, card_pad, source), ...),
+#               gather_cols=((col, source), ...))
+#   kind ∈ {"limit", "order"}
+# ---------------------------------------------------------------------------
+
+
+def _selection_outputs(select_spec, cols, mask):
+    kind, k, order, gather_cols = select_spec
+    if kind == "limit":
+        docids = jnp.nonzero(mask, size=k, fill_value=-1)[0]
+    else:
+        # pack order columns into one int32 key (plan maker guarantees the
+        # radix product fits in 31 bits, else it falls back to host sort)
+        key = jnp.zeros(mask.shape[0], jnp.int32)
+        for col, asc, card_pad, source in order:
+            ids = cols[f"{col}.ids"]
+            term = ids if asc else (np.int32(card_pad - 1) - ids)
+            key = key * np.int32(card_pad) + term
+        key = jnp.where(mask, key, INT32_MAX)
+        neg_vals, docids = jax.lax.top_k(-key, k)
+        docids = jnp.where(neg_vals == -INT32_MAX, -1, docids)
+    out = {"sel.docids": docids.astype(jnp.int32),
+           "sel.count": mask.sum(dtype=jnp.int32)}
+    safe = jnp.maximum(docids, 0)
+    for col, source in gather_cols:
+        lane = {"sv": f"{col}.ids", "raw": f"{col}.raw",
+                "mv": f"{col}.mv"}[source]
+        out[f"sel.{col}"] = cols[lane][safe]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Kernel assembly + jit cache
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1024)
+def get_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
+                       select_spec):
+    """Compile (once per static signature) the whole per-segment plan."""
+
+    def kernel(cols: Dict[str, jnp.ndarray], params: Tuple, num_docs):
+        valid = jnp.arange(padded, dtype=jnp.int32) < num_docs
+        plist = list(params)
+        mask = _eval_filter(filter_spec, cols, plist, valid) & valid
+        outs = {"stats.num_docs_matched": mask.sum(dtype=jnp.int32)}
+        if group_spec is not None:
+            outs.update(_group_outputs(group_spec, cols, mask, num_docs))
+        elif agg_specs:
+            outs.update(_agg_outputs(agg_specs, cols, mask, num_docs))
+        if select_spec is not None:
+            outs.update(_selection_outputs(select_spec, cols, mask))
+        return outs
+
+    return jax.jit(kernel)
+
+
+def run_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
+                       select_spec, cols, params, num_docs):
+    fn = get_segment_kernel(padded, filter_spec, tuple(agg_specs or ()),
+                            group_spec, select_spec)
+    return fn(cols, tuple(params), jnp.int32(num_docs))

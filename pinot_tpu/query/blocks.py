@@ -1,0 +1,64 @@
+"""Result blocks and execution statistics.
+
+Parity: pinot-core/.../operator/blocks/IntermediateResultsBlock.java and
+core/operator/ExecutionStatistics.java — the per-segment (and per-server,
+after combine) result container carried up to the broker reduce.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ExecutionStats:
+    num_docs_scanned: int = 0
+    num_entries_scanned_in_filter: int = 0
+    num_entries_scanned_post_filter: int = 0
+    num_segments_processed: int = 0
+    num_segments_matched: int = 0
+    num_segments_pruned: int = 0
+    total_docs: int = 0
+    num_groups_limit_reached: bool = False
+    time_used_ms: float = 0.0
+
+    def merge(self, other: "ExecutionStats") -> None:
+        self.num_docs_scanned += other.num_docs_scanned
+        self.num_entries_scanned_in_filter += other.num_entries_scanned_in_filter
+        self.num_entries_scanned_post_filter += \
+            other.num_entries_scanned_post_filter
+        self.num_segments_processed += other.num_segments_processed
+        self.num_segments_matched += other.num_segments_matched
+        self.num_segments_pruned += other.num_segments_pruned
+        self.total_docs += other.total_docs
+        self.num_groups_limit_reached |= other.num_groups_limit_reached
+
+    def to_metadata(self) -> Dict[str, str]:
+        return {
+            "numDocsScanned": str(self.num_docs_scanned),
+            "numEntriesScannedInFilter": str(self.num_entries_scanned_in_filter),
+            "numEntriesScannedPostFilter":
+                str(self.num_entries_scanned_post_filter),
+            "numSegmentsProcessed": str(self.num_segments_processed),
+            "numSegmentsMatched": str(self.num_segments_matched),
+            "totalDocs": str(self.total_docs),
+            "numGroupsLimitReached": str(self.num_groups_limit_reached).lower(),
+        }
+
+
+@dataclasses.dataclass
+class IntermediateResultsBlock:
+    """Intermediate (mergeable) results of one segment / one server.
+
+    Exactly one of agg_intermediates / group_map / selection_rows is the
+    payload, mirroring the reference's block contents.
+    """
+    # aggregation-only: one intermediate object per aggregation function
+    agg_intermediates: Optional[List[object]] = None
+    # group-by: group key values tuple → list of intermediates
+    group_map: Optional[Dict[Tuple, List[object]]] = None
+    # selection: row tuples (decoded values) + total matched count
+    selection_rows: Optional[List[tuple]] = None
+    selection_columns: Optional[List[str]] = None
+    stats: ExecutionStats = dataclasses.field(default_factory=ExecutionStats)
+    exceptions: List[str] = dataclasses.field(default_factory=list)

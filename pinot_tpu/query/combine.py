@@ -1,0 +1,144 @@
+"""Combine per-segment result blocks into one per-server block.
+
+Parity: pinot-core/.../operator/CombineOperator.java (selection/agg merge via
+CombineService) and CombineGroupByOperator.java:107-156 (concurrent group map
+merge) + AggregationGroupByTrimmingService.java:44 (trim to
+max(5·topN, 5000) when the merged map passes 4× that size).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from pinot_tpu.common.request import BrokerRequest, SelectionSort
+from pinot_tpu.query.aggregation import AggregationFunction, make_functions
+from pinot_tpu.query.blocks import IntermediateResultsBlock
+
+
+def trim_size_for(top_n: int) -> int:
+    return max(5 * top_n, 5000)
+
+
+def combine_blocks(request: BrokerRequest,
+                   blocks: List[IntermediateResultsBlock]
+                   ) -> IntermediateResultsBlock:
+    if not blocks:
+        return IntermediateResultsBlock()
+    out = blocks[0]
+    functions = make_functions(request.aggregations) \
+        if request.is_aggregation else []
+    for blk in blocks[1:]:
+        _merge_into(request, functions, out, blk)
+        out.stats.merge(blk.stats)
+        out.exceptions.extend(blk.exceptions)
+    if request.is_group_by and out.group_map is not None:
+        t = trim_size_for(request.group_by.top_n)
+        if len(out.group_map) > 4 * t:
+            out.group_map = trim_group_map(out.group_map, functions, t)
+    if request.is_selection and out.selection_rows is not None:
+        _trim_selection(request, out)
+    return out
+
+
+def _merge_into(request: BrokerRequest,
+                functions: List[AggregationFunction],
+                a: IntermediateResultsBlock,
+                b: IntermediateResultsBlock) -> None:
+    if request.is_group_by:
+        if a.group_map is None:
+            a.group_map = b.group_map or {}
+        elif b.group_map:
+            for key, inters in b.group_map.items():
+                mine = a.group_map.get(key)
+                if mine is None:
+                    a.group_map[key] = inters
+                else:
+                    a.group_map[key] = [f.merge(x, y) for f, x, y in
+                                        zip(functions, mine, inters)]
+    elif request.is_aggregation:
+        if a.agg_intermediates is None:
+            a.agg_intermediates = b.agg_intermediates
+        elif b.agg_intermediates is not None:
+            a.agg_intermediates = [
+                f.merge(x, y) for f, x, y in
+                zip(functions, a.agg_intermediates, b.agg_intermediates)]
+    if request.is_selection:
+        if a.selection_rows is None:
+            a.selection_rows = b.selection_rows
+            a.selection_columns = b.selection_columns
+        elif b.selection_rows:
+            a.selection_rows = merge_selection_rows(
+                request, a.selection_columns, a.selection_rows,
+                b.selection_rows)
+
+
+def merge_selection_rows(request: BrokerRequest, columns: List[str],
+                         rows_a: List[tuple], rows_b: List[tuple]
+                         ) -> List[tuple]:
+    sel = request.selection
+    limit = sel.offset + sel.size
+    merged = list(rows_a) + list(rows_b)
+    if sel.order_by:
+        merged.sort(key=_order_key(sel.order_by, columns))
+    return merged[:limit]
+
+
+def _order_key(order_by: List[SelectionSort], columns: List[str]):
+    idx = {c: i for i, c in enumerate(columns)}
+
+    def key(row: tuple):
+        parts = []
+        for ob in order_by:
+            v = row[idx[ob.column]]
+            parts.append(_Rev(v) if not ob.ascending else v)
+        return tuple(parts)
+
+    return key
+
+
+class _Rev:
+    """Reverse-order wrapper for mixed-type sort keys."""
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return other.v == self.v
+
+
+def trim_group_map(group_map: Dict[Tuple, List],
+                   functions: List[AggregationFunction],
+                   trim_size: int) -> Dict[Tuple, List]:
+    """Keep the union of per-function top-`trim_size` groups (value desc).
+
+    Parity: AggregationGroupByTrimmingService sorts per function and keeps
+    the heads, so a group surviving under ANY function survives the trim.
+    """
+    keep = set()
+    keys = list(group_map.keys())
+    for fi, f in enumerate(functions):
+        scored = sorted(
+            keys, key=lambda k: _sortable(f.extract_final(group_map[k][fi])),
+            reverse=True)
+        keep.update(scored[:trim_size])
+    return {k: group_map[k] for k in keep}
+
+
+def _sortable(v):
+    if isinstance(v, (int, float)):
+        return v
+    return float("-inf")
+
+
+def _trim_selection(request: BrokerRequest,
+                    out: IntermediateResultsBlock) -> None:
+    sel = request.selection
+    limit = sel.offset + sel.size
+    rows = out.selection_rows
+    if sel.order_by:
+        rows = sorted(rows, key=_order_key(sel.order_by,
+                                           out.selection_columns))
+    out.selection_rows = rows[:limit]

@@ -1,0 +1,65 @@
+"""Server-side query executor.
+
+Parity: pinot-core/.../query/executor/ServerQueryExecutorV1Impl.java:100-267 —
+acquire segments → prune → plan → execute per segment → combine → result
+block with execution stats. Device-unsupported query shapes fall back to the
+host (numpy) executor per segment, the way the reference falls back from
+index-based to scan-based operators.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.query.blocks import IntermediateResultsBlock
+from pinot_tpu.query.combine import combine_blocks
+from pinot_tpu.query import host_exec
+from pinot_tpu.query.plan import (GroupsLimitExceeded, InstancePlanMaker,
+                                  UnsupportedOnDevice)
+from pinot_tpu.query.pruner import SegmentPrunerService
+from pinot_tpu.segment.loader import ImmutableSegment
+
+
+class ServerQueryExecutor:
+    def __init__(self, plan_maker: Optional[InstancePlanMaker] = None,
+                 pruner: Optional[SegmentPrunerService] = None,
+                 use_device: bool = True):
+        self.plan_maker = plan_maker or InstancePlanMaker()
+        self.pruner = pruner or SegmentPrunerService()
+        self.use_device = use_device
+
+    def execute(self, request: BrokerRequest,
+                segments: List[ImmutableSegment]) -> IntermediateResultsBlock:
+        t0 = time.perf_counter()
+        selected = self.pruner.prune(segments, request)
+        num_pruned = len(segments) - len(selected)
+
+        blocks: List[IntermediateResultsBlock] = []
+        for seg in selected:
+            blocks.append(self._execute_segment(seg, request))
+
+        if not blocks:
+            blk = IntermediateResultsBlock()
+            if request.is_group_by:
+                blk.group_map = {}
+            elif request.is_aggregation:
+                blk.agg_intermediates = None
+            if request.is_selection:
+                blk.selection_rows = []
+                blk.selection_columns = list(request.selection.columns)
+        else:
+            blk = combine_blocks(request, blocks)
+        blk.stats.num_segments_pruned = num_pruned
+        blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
+        return blk
+
+    def _execute_segment(self, segment: ImmutableSegment,
+                         request: BrokerRequest) -> IntermediateResultsBlock:
+        if self.use_device:
+            try:
+                plan = self.plan_maker.make_segment_plan(segment, request)
+                return plan.execute()
+            except (GroupsLimitExceeded, UnsupportedOnDevice):
+                pass
+        return host_exec.execute_host(segment, request)

@@ -1,0 +1,346 @@
+"""Host (numpy) query executor — the fallback + CPU baseline path.
+
+Covers query shapes the device kernels don't (group cardinality over the
+groups limit, order-by keys too wide to pack, percentile over raw columns)
+and doubles as the CPU reference implementation the benchmarks compare
+against. Produces IntermediateResultsBlock objects merge-compatible with the
+device path.
+
+Parity note: this is the moral equivalent of the reference's scan-based
+operators (ScanBasedFilterOperator + DefaultAggregationExecutor /
+DefaultGroupByExecutor / SelectionOperator) executed columnar-vectorized.
+"""
+from __future__ import annotations
+
+import re as _re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
+                                      FilterQueryTree)
+from pinot_tpu.query.aggregation import AggregationFunction, make_functions
+from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
+from pinot_tpu.segment.loader import DataSource, ImmutableSegment
+
+
+def execute_host(segment: ImmutableSegment, request: BrokerRequest
+                 ) -> IntermediateResultsBlock:
+    mask = _eval_filter(request.filter, segment)
+    blk = IntermediateResultsBlock()
+    matched = int(mask.sum())
+
+    if request.is_group_by:
+        _group_by(segment, request, mask, blk)
+    elif request.is_aggregation:
+        blk.agg_intermediates = [
+            _aggregate(segment, f, mask) for f in make_functions(
+                request.aggregations)]
+    if request.is_selection:
+        _selection(segment, request, mask, blk)
+
+    blk.stats = ExecutionStats(
+        num_docs_scanned=matched,
+        num_entries_scanned_in_filter=(
+            _count_leaves(request.filter) * segment.num_docs),
+        num_segments_processed=1,
+        num_segments_matched=1 if matched else 0,
+        total_docs=segment.num_docs)
+    return blk
+
+
+def _count_leaves(tree: Optional[FilterQueryTree]) -> int:
+    if tree is None:
+        return 0
+    if tree.is_leaf():
+        return 1
+    return sum(_count_leaves(c) for c in tree.children)
+
+
+# ---------------------------------------------------------------------------
+# Filter evaluation (vectorized numpy over decoded / id lanes)
+# ---------------------------------------------------------------------------
+
+
+def _eval_filter(tree: Optional[FilterQueryTree], segment: ImmutableSegment
+                 ) -> np.ndarray:
+    n = segment.num_docs
+    if tree is None:
+        return np.ones(n, dtype=bool)
+    if tree.operator in (FilterOperator.AND, FilterOperator.OR):
+        masks = [_eval_filter(c, segment) for c in tree.children]
+        out = masks[0]
+        for m in masks[1:]:
+            out = (out & m) if tree.operator == FilterOperator.AND else \
+                (out | m)
+        return out
+    return _eval_leaf(tree, segment)
+
+
+def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
+    ds = segment.data_source(tree.column)
+    cm = ds.metadata
+    n = segment.num_docs
+    op = tree.operator
+
+    if op == FilterOperator.IS_NULL:
+        return np.zeros(n, dtype=bool)
+    if op == FilterOperator.IS_NOT_NULL:
+        return np.ones(n, dtype=bool)
+
+    if not cm.has_dictionary:
+        vals = ds.raw_values
+        cv = _coercer(cm.data_type.np_dtype)
+        if op == FilterOperator.EQUALITY:
+            return vals == cv(tree.values[0])
+        if op == FilterOperator.NOT:
+            return vals != cv(tree.values[0])
+        if op == FilterOperator.IN:
+            return np.isin(vals, [cv(v) for v in tree.values])
+        if op == FilterOperator.NOT_IN:
+            return ~np.isin(vals, [cv(v) for v in tree.values])
+        if op == FilterOperator.RANGE:
+            m = np.ones(n, dtype=bool)
+            if tree.lower is not None:
+                lo = cv(tree.lower)
+                m &= (vals >= lo) if tree.lower_inclusive else (vals > lo)
+            if tree.upper is not None:
+                hi = cv(tree.upper)
+                m &= (vals <= hi) if tree.upper_inclusive else (vals < hi)
+            return m
+        raise ValueError(f"unsupported raw filter {op}")
+
+    # dictionary-encoded: resolve to id-domain predicate, then test lanes
+    dictionary = ds.dictionary
+    card = dictionary.cardinality
+    member = np.zeros(card + 1, dtype=bool)  # slot card = MV padding
+    if op == FilterOperator.EQUALITY:
+        i = dictionary.index_of(tree.values[0])
+        if i >= 0:
+            member[i] = True
+    elif op == FilterOperator.NOT:
+        member[:card] = True
+        i = dictionary.index_of(tree.values[0])
+        if i >= 0:
+            member[i] = False
+    elif op == FilterOperator.IN:
+        for v in tree.values:
+            i = dictionary.index_of(v)
+            if i >= 0:
+                member[i] = True
+    elif op == FilterOperator.NOT_IN:
+        member[:card] = True
+        for v in tree.values:
+            i = dictionary.index_of(v)
+            if i >= 0:
+                member[i] = False
+    elif op == FilterOperator.RANGE:
+        lo, hi = dictionary.range_to_id_interval(
+            tree.lower, tree.upper, tree.lower_inclusive,
+            tree.upper_inclusive)
+        member[lo:hi] = True
+    elif op == FilterOperator.REGEXP_LIKE:
+        pat = _re.compile(tree.values[0])
+        for i in range(card):
+            if pat.search(str(dictionary.get(i))):
+                member[i] = True
+    else:
+        raise ValueError(f"unsupported filter {op}")
+
+    if cm.single_value:
+        return member[ds.dict_ids]
+    return member[ds.mv_dict_ids].any(axis=1)
+
+
+def _coercer(dtype: np.dtype):
+    if dtype.kind == "f":
+        return lambda v: dtype.type(float(v))
+    return lambda v: dtype.type(int(str(v)))
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+# ---------------------------------------------------------------------------
+
+
+def _masked_values(segment: ImmutableSegment, col: str, mask: np.ndarray
+                   ) -> np.ndarray:
+    ds = segment.data_source(col)
+    cm = ds.metadata
+    if not cm.has_dictionary:
+        return ds.raw_values[mask]
+    if cm.single_value:
+        return ds.dictionary.values[ds.dict_ids[mask]]
+    ids = ds.mv_dict_ids[mask]
+    flat = ids[ids < cm.cardinality]
+    return ds.dictionary.values[flat]
+
+
+def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
+               mask: np.ndarray):
+    base = f.info.base
+    if base == "COUNT" and not f.info.is_mv:
+        return int(mask.sum())
+    vals = _masked_values(segment, f.column, mask)
+    if base == "COUNT":  # COUNTMV: entries
+        return int(len(vals))
+    if len(vals) == 0:
+        return None
+    if base == "SUM":
+        return float(np.sum(np.asarray(vals, dtype=np.float64)))
+    if base == "MIN":
+        return float(vals.min())
+    if base == "MAX":
+        return float(vals.max())
+    if base == "AVG":
+        return (float(np.sum(np.asarray(vals, dtype=np.float64))), len(vals))
+    if base == "MINMAXRANGE":
+        return (float(vals.min()), float(vals.max()))
+    if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+        return set(_plain(v) for v in np.unique(vals))
+    if base in ("PERCENTILE", "PERCENTILEEST", "PERCENTILETDIGEST"):
+        uniq, counts = np.unique(vals, return_counts=True)
+        return {_plain(u): int(c) for u, c in zip(uniq, counts)}
+    raise ValueError(base)
+
+
+# ---------------------------------------------------------------------------
+# Group-by
+# ---------------------------------------------------------------------------
+
+
+def _group_by(segment: ImmutableSegment, request: BrokerRequest,
+              mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
+    gcols = request.group_by.columns
+    id_lanes = []
+    dicts = []
+    for c in gcols:
+        ds = segment.data_source(c)
+        if not (ds.metadata.has_dictionary and ds.metadata.single_value):
+            raise ValueError(f"host group-by needs SV dictionary column {c}")
+        id_lanes.append(ds.dict_ids[mask].astype(np.int64))
+        dicts.append(ds.dictionary)
+    key = np.zeros(int(mask.sum()), dtype=np.int64)
+    for lane, d in zip(id_lanes, dicts):
+        key = key * d.cardinality + lane
+    uniq_keys, inverse = np.unique(key, return_inverse=True)
+    g = len(uniq_keys)
+
+    # decode group values
+    value_cols = []
+    rem = uniq_keys.copy()
+    for d in reversed(dicts):
+        value_cols.append(d.decode(rem % d.cardinality))
+        rem //= d.cardinality
+    value_cols.reverse()
+    group_keys = [tuple(_plain(vc[i]) for vc in value_cols) for i in range(g)]
+
+    functions = make_functions(request.aggregations)
+    per_fn: List[List] = []
+    for f in functions:
+        base = f.info.base
+        if base == "COUNT":
+            counts = np.zeros(g, dtype=np.int64)
+            np.add.at(counts, inverse, 1)
+            per_fn.append([int(c) for c in counts])
+            continue
+        ds = segment.data_source(f.column)
+        cm = ds.metadata
+        if cm.has_dictionary and cm.single_value:
+            vals = ds.dictionary.values[ds.dict_ids[mask]].astype(np.float64)
+        elif not cm.has_dictionary:
+            vals = ds.raw_values[mask].astype(np.float64)
+        else:
+            raise ValueError("host group-by over MV metric unsupported")
+        if base in ("SUM", "AVG"):
+            sums = np.zeros(g)
+            np.add.at(sums, inverse, vals)
+            if base == "SUM":
+                per_fn.append([float(s) for s in sums])
+            else:
+                counts = np.zeros(g, dtype=np.int64)
+                np.add.at(counts, inverse, 1)
+                per_fn.append([(float(s), int(c))
+                               for s, c in zip(sums, counts)])
+        elif base in ("MIN", "MAX", "MINMAXRANGE"):
+            mins = np.full(g, np.inf)
+            maxs = np.full(g, -np.inf)
+            np.minimum.at(mins, inverse, vals)
+            np.maximum.at(maxs, inverse, vals)
+            if base == "MIN":
+                per_fn.append([float(v) for v in mins])
+            elif base == "MAX":
+                per_fn.append([float(v) for v in maxs])
+            else:
+                per_fn.append([(float(a), float(b))
+                               for a, b in zip(mins, maxs)])
+        else:
+            # set/map intermediates per group (distinctcount, percentile)
+            items: List = [None] * g
+            for gi in range(g):
+                sel = vals[inverse == gi]
+                if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+                    items[gi] = set(_plain(v) for v in np.unique(sel))
+                else:
+                    u, c = np.unique(sel, return_counts=True)
+                    items[gi] = {_plain(x): int(y) for x, y in zip(u, c)}
+            per_fn.append(items)
+
+    blk.group_map = {
+        group_keys[i]: [per_fn[fi][i] for fi in range(len(functions))]
+        for i in range(g)}
+
+
+# ---------------------------------------------------------------------------
+# Selection
+# ---------------------------------------------------------------------------
+
+
+def _selection(segment: ImmutableSegment, request: BrokerRequest,
+               mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
+    from pinot_tpu.query.plan import selection_columns
+    sel = request.selection
+    cols = selection_columns(segment, request)
+    docids = np.nonzero(mask)[0]
+    if sel.order_by:
+        sort_keys = []
+        for ob in reversed(sel.order_by):  # lexsort: last key is primary
+            ds = segment.data_source(ob.column)
+            cm = ds.metadata
+            if cm.has_dictionary and cm.single_value:
+                k = ds.dict_ids[docids].astype(np.int64)
+            elif not cm.has_dictionary:
+                k = ds.raw_values[docids]
+            else:
+                raise ValueError("order-by on MV column")
+            sort_keys.append(-k if not ob.ascending else k)
+        order = np.lexsort(sort_keys)
+        docids = docids[order]
+    docids = docids[: sel.offset + sel.size]
+
+    rows = []
+    decoded = {}
+    for c in cols:
+        ds = segment.data_source(c)
+        cm = ds.metadata
+        if not cm.has_dictionary:
+            decoded[c] = ds.raw_values[docids]
+        elif cm.single_value:
+            decoded[c] = ds.dictionary.values[ds.dict_ids[docids]]
+        else:
+            card = cm.cardinality
+            decoded[c] = [
+                [_plain(ds.dictionary.get(i)) for i in row if i < card]
+                for row in ds.mv_dict_ids[docids]]
+    for r in range(len(docids)):
+        rows.append(tuple(_plain(decoded[c][r]) for c in cols))
+    blk.selection_rows = rows
+    blk.selection_columns = cols
+
+
+def _plain(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v

@@ -1,0 +1,520 @@
+"""Per-segment plan maker + execution.
+
+Parity: pinot-core/.../core/plan/maker/InstancePlanMakerImplV2.java — chooses
+the per-segment execution strategy:
+  - metadata-based COUNT with no filter (InstancePlanMakerImplV2.java:148)
+  - dictionary-based MIN/MAX/MINMAXRANGE with no filter (:179-211)
+  - inverted-index count fast path (BitmapBasedFilterOperator + count)
+  - otherwise: one fused device kernel (filter+project+aggregate/group/select)
+and FilterPlanNode.java:51 — converts the FilterQueryTree into a physical
+filter, resolving each predicate against the column's dictionary host-side so
+the device sees only integer compares / member-vector gathers.
+
+The reference's `num.groups.limit` (100k, InstancePlanMakerImplV2.java:58)
+becomes the static group-table bound; queries over it fall back to the host
+executor (query/host_exec.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re as _re
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
+                                      FilterQueryTree)
+from pinot_tpu.ops import kernels
+from pinot_tpu.query.aggregation import AggregationFunction, make_functions
+from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
+from pinot_tpu.segment.loader import DataSource, ImmutableSegment
+
+DEFAULT_NUM_GROUPS_LIMIT = 100_000     # parity: num.groups.limit
+IN_LIST_MEMBER_THRESHOLD = 16          # small IN → broadcast compare, else
+                                       # member-vector gather
+MAX_SELECTION_K = 1 << 16
+
+
+class GroupsLimitExceeded(Exception):
+    pass
+
+
+class UnsupportedOnDevice(Exception):
+    """Raised when a query shape needs the host fallback executor."""
+
+
+# ---------------------------------------------------------------------------
+# Filter resolution: FilterQueryTree → (kernel spec, params)
+# ---------------------------------------------------------------------------
+
+MATCH_ALL = ("match_all",)
+EMPTY = ("empty",)
+
+
+def resolve_filter(tree: Optional[FilterQueryTree], segment: ImmutableSegment
+                   ) -> Tuple[tuple, List]:
+    if tree is None:
+        return MATCH_ALL, []
+    params: List = []
+    spec = _resolve(tree, segment, params)
+    return spec, params
+
+
+def _resolve(node: FilterQueryTree, segment: ImmutableSegment, params: List
+             ) -> tuple:
+    if node.operator in (FilterOperator.AND, FilterOperator.OR):
+        is_and = node.operator == FilterOperator.AND
+        children = []
+        for c in node.children:
+            sub_params: List = []
+            spec = _resolve(c, segment, sub_params)
+            if spec == EMPTY:
+                if is_and:
+                    return EMPTY
+                continue
+            if spec == MATCH_ALL:
+                if not is_and:
+                    return MATCH_ALL
+                continue
+            children.append((spec, sub_params))
+        if not children:
+            return MATCH_ALL if is_and else EMPTY
+        if len(children) == 1:
+            params.extend(children[0][1])
+            return children[0][0]
+        for _, p in children:
+            params.extend(p)
+        return ("and" if is_and else "or",
+                tuple(spec for spec, _ in children))
+    return _resolve_leaf(node, segment, params)
+
+
+def _resolve_leaf(node: FilterQueryTree, segment: ImmutableSegment,
+                  params: List) -> tuple:
+    ds = segment.data_source(node.column)
+    cm = ds.metadata
+    op = node.operator
+
+    if not cm.has_dictionary:
+        return _resolve_raw_leaf(node, ds, params)
+
+    source = "sv" if cm.single_value else "mv"
+    dictionary = ds.dictionary
+    card = dictionary.cardinality
+    card_pad = kernels.pow2_bucket(card + 1)
+
+    if op == FilterOperator.EQUALITY:
+        i = dictionary.index_of(node.values[0])
+        if i < 0:
+            return EMPTY
+        params.append(np.int32(i))
+        return ("pred", "eq_id", node.column, source, None)
+
+    if op == FilterOperator.NOT:
+        i = dictionary.index_of(node.values[0])
+        if i < 0:
+            return MATCH_ALL
+        if source == "mv":
+            # see NOT_IN: member vector keeps padding entries non-matching
+            member = np.zeros(card_pad, dtype=bool)
+            member[:card] = True
+            member[i] = False
+            params.append(member)
+            return ("pred", "member", node.column, source, card_pad)
+        params.append(np.int32(i))
+        return ("pred", "neq_id", node.column, source, None)
+
+    if op in (FilterOperator.IN, FilterOperator.NOT_IN):
+        ids = [dictionary.index_of(v) for v in node.values]
+        ids = sorted({i for i in ids if i >= 0})
+        negate = op == FilterOperator.NOT_IN
+        if not ids:
+            return MATCH_ALL if negate else EMPTY
+        if negate and source == "mv":
+            # negated MV predicates must go through a member vector: the
+            # padded-id compare form would let padding entries (id == card)
+            # satisfy the negation and match every doc
+            member = np.zeros(card_pad, dtype=bool)
+            member[:card] = True
+            member[ids] = False
+            params.append(member)
+            return ("pred", "member", node.column, source, card_pad)
+        if len(ids) <= IN_LIST_MEMBER_THRESHOLD:
+            k = kernels.pow2_bucket(len(ids), floor=1)
+            arr = np.full(k, -1, dtype=np.int32)
+            arr[: len(ids)] = ids
+            params.append(arr)
+            return ("pred", "notin_ids" if negate else "in_ids",
+                    node.column, source, k)
+        member = np.zeros(card_pad, dtype=bool)
+        member[ids] = True
+        if negate:
+            member = ~member
+            member[card:] = False   # padding ids never match
+        params.append(member)
+        return ("pred", "member", node.column, source, card_pad)
+
+    if op == FilterOperator.RANGE:
+        lo, hi = dictionary.range_to_id_interval(
+            node.lower, node.upper, node.lower_inclusive,
+            node.upper_inclusive)
+        if lo >= hi:
+            return EMPTY
+        if lo == 0 and hi >= card and source == "sv":
+            return MATCH_ALL
+        params.append(np.int32(lo))
+        params.append(np.int32(hi))
+        return ("pred", "range_ids", node.column, source, None)
+
+    if op == FilterOperator.REGEXP_LIKE:
+        # evaluate over the (small) dictionary host-side → member vector.
+        # Parity: RegexpLikePredicateEvaluatorFactory uses Matcher.find()
+        # semantics, i.e. pattern found anywhere in the value.
+        pattern = _re.compile(node.values[0])
+        member = np.zeros(card_pad, dtype=bool)
+        for i in range(card):
+            if pattern.search(str(dictionary.get(i))):
+                member[i] = True
+        if not member.any():
+            return EMPTY
+        params.append(member)
+        return ("pred", "member", node.column, source, card_pad)
+
+    if op == FilterOperator.IS_NULL:
+        return EMPTY      # no null vector yet: nothing is null
+    if op == FilterOperator.IS_NOT_NULL:
+        return MATCH_ALL
+
+    raise UnsupportedOnDevice(f"filter operator {op}")
+
+
+def _resolve_raw_leaf(node: FilterQueryTree, ds: DataSource, params: List
+                      ) -> tuple:
+    dt = ds.metadata.data_type.np_dtype
+    op = node.operator
+    col = node.column
+
+    def cv(v):
+        return dt.type(float(v)) if dt.kind == "f" else dt.type(int(str(v)))
+
+    if op == FilterOperator.EQUALITY:
+        params.append(cv(node.values[0]))
+        return ("pred", "eq_raw", col, "raw", None)
+    if op == FilterOperator.NOT:
+        params.append(cv(node.values[0]))
+        return ("pred", "neq_raw", col, "raw", None)
+    if op in (FilterOperator.IN, FilterOperator.NOT_IN):
+        vals = sorted({cv(v) for v in node.values})
+        k = kernels.pow2_bucket(len(vals), floor=1)
+        arr = np.full(k, vals[0], dtype=dt)
+        arr[: len(vals)] = vals
+        params.append(arr)
+        return ("pred", "notin_raw" if op == FilterOperator.NOT_IN
+                else "in_raw", col, "raw", k)
+    if op == FilterOperator.RANGE:
+        info = np.iinfo(dt) if dt.kind in "iu" else np.finfo(dt)
+        lo = cv(node.lower) if node.lower is not None else dt.type(info.min)
+        hi = cv(node.upper) if node.upper is not None else dt.type(info.max)
+        lo_inc = node.lower_inclusive if node.lower is not None else True
+        hi_inc = node.upper_inclusive if node.upper is not None else True
+        params.append(lo)
+        params.append(hi)
+        return ("pred", "range_raw", col, "raw", (lo_inc, hi_inc))
+    raise UnsupportedOnDevice(f"raw-column filter operator {op}")
+
+
+# ---------------------------------------------------------------------------
+# Plan construction
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SegmentPlan:
+    segment: ImmutableSegment
+    request: BrokerRequest
+    # device kernel inputs (None when fast_path_result is set)
+    filter_spec: Optional[tuple] = None
+    params: Optional[List] = None
+    agg_specs: Tuple = ()
+    group_spec: Optional[tuple] = None
+    select_spec: Optional[tuple] = None
+    needed_cols: Tuple[Tuple[str, str], ...] = ()   # (column, lane-kind)
+    functions: List[AggregationFunction] = dataclasses.field(
+        default_factory=list)
+    group_strides: Tuple[int, ...] = ()
+    fast_path_result: Optional[IntermediateResultsBlock] = None
+
+    def execute(self) -> IntermediateResultsBlock:
+        from pinot_tpu.query import execution
+        return execution.execute_segment_plan(self)
+
+
+class InstancePlanMaker:
+    """Builds a SegmentPlan per segment for a BrokerRequest.
+
+    Parity: InstancePlanMakerImplV2.makeInnerSegmentPlan
+    (InstancePlanMakerImplV2.java:97).
+    """
+
+    def __init__(self, num_groups_limit: int = DEFAULT_NUM_GROUPS_LIMIT):
+        self.num_groups_limit = num_groups_limit
+
+    def make_segment_plan(self, segment: ImmutableSegment,
+                          request: BrokerRequest) -> SegmentPlan:
+        plan = SegmentPlan(segment=segment, request=request)
+        if request.is_aggregation:
+            plan.functions = make_functions(request.aggregations)
+
+        # fast path: no filter, metadata/dictionary-answerable aggregations
+        if request.is_aggregation and not request.is_group_by and \
+                request.filter is None and \
+                self._try_metadata_fast_path(plan, segment, request):
+            return plan
+
+        filter_spec, params = resolve_filter(request.filter, segment)
+
+        if filter_spec == EMPTY:
+            plan.fast_path_result = _empty_block(plan, segment)
+            return plan
+
+        # fast path: COUNT(*) on a pure match-all filter
+        if filter_spec == MATCH_ALL and request.is_aggregation and \
+                not request.is_group_by and \
+                all(f.info.base == "COUNT" and not f.info.is_mv
+                    for f in plan.functions):
+            blk = IntermediateResultsBlock(
+                agg_intermediates=[segment.num_docs for _ in plan.functions])
+            _fill_stats(blk, segment, segment.num_docs, 0, 0)
+            plan.fast_path_result = blk
+            return plan
+
+        # fast path: COUNT(*) + single EQ/IN leaf answered by inverted index
+        if request.is_aggregation and not request.is_group_by and \
+                all(f.info.base == "COUNT" and not f.info.is_mv
+                    for f in plan.functions):
+            cnt = self._try_inverted_count(segment, filter_spec, params)
+            if cnt is not None:
+                blk = IntermediateResultsBlock(
+                    agg_intermediates=[cnt for _ in plan.functions])
+                _fill_stats(blk, segment, cnt, 0, 0)
+                plan.fast_path_result = blk
+                return plan
+
+        plan.filter_spec = filter_spec
+        plan.params = params
+
+        needed: Dict[Tuple[str, str], None] = {}
+        _collect_filter_cols(filter_spec, needed)
+
+        if request.is_group_by:
+            self._plan_group_by(plan, segment, request, needed)
+        elif request.is_aggregation:
+            plan.agg_specs = tuple(
+                _agg_device_spec(f, segment, needed) for f in plan.functions)
+        if request.is_selection:
+            self._plan_selection(plan, segment, request, needed)
+
+        plan.needed_cols = tuple(needed.keys())
+        return plan
+
+    # -- helpers -----------------------------------------------------------
+    def _try_metadata_fast_path(self, plan: SegmentPlan,
+                                segment: ImmutableSegment,
+                                request: BrokerRequest) -> bool:
+        inters: List = []
+        for f in plan.functions:
+            base = f.info.base
+            if base == "COUNT" and not f.info.is_mv:
+                inters.append(segment.num_docs)
+                continue
+            if base in ("MIN", "MAX", "MINMAXRANGE") and \
+                    segment.has_column(f.column):
+                cm = segment.data_source(f.column).metadata
+                if cm.has_dictionary and cm.single_value and \
+                        cm.data_type.is_numeric:
+                    mn, mx = float(cm.min_value), float(cm.max_value)
+                    inters.append(mn if base == "MIN" else
+                                  mx if base == "MAX" else (mn, mx))
+                    continue
+            return False
+        blk = IntermediateResultsBlock(agg_intermediates=inters)
+        _fill_stats(blk, segment, segment.num_docs, 0, 0)
+        plan.fast_path_result = blk
+        return True
+
+    def _try_inverted_count(self, segment: ImmutableSegment, spec: tuple,
+                            params: List) -> Optional[int]:
+        if spec[0] != "pred":
+            return None
+        _, kind, col, source, extra = spec
+        if source != "sv":
+            return None
+        ds = segment.data_source(col)
+        if ds.inverted_index is not None:
+            if kind == "eq_id":
+                return ds.inverted_index.count(int(params[0]))
+            if kind == "in_ids":
+                ids = [int(i) for i in np.asarray(params[0]) if i >= 0]
+                return sum(ds.inverted_index.count(i) for i in ids)
+            if kind == "range_ids":
+                return ds.inverted_index.count_range(int(params[0]),
+                                                     int(params[1]))
+        if ds.sorted_ranges is not None:
+            r = ds.sorted_ranges
+            if kind == "eq_id":
+                s, e = r[int(params[0])]
+                return int(e - s)
+            if kind == "range_ids":
+                lo, hi = int(params[0]), int(params[1])
+                return int(r[lo:hi, 1].sum() - r[lo:hi, 0].sum())
+        return None
+
+    def _plan_group_by(self, plan: SegmentPlan, segment: ImmutableSegment,
+                       request: BrokerRequest, needed: Dict) -> None:
+        gcols = request.group_by.columns
+        cards = []
+        for c in gcols:
+            ds = segment.data_source(c)
+            if not ds.metadata.has_dictionary or not ds.metadata.single_value:
+                raise UnsupportedOnDevice(
+                    f"group-by on non-dictionary/MV column {c}")
+            cards.append(ds.metadata.cardinality)
+            needed[(c, "ids")] = None
+        g = int(np.prod(cards, dtype=np.int64))
+        if g > self.num_groups_limit:
+            raise GroupsLimitExceeded(
+                f"{g} potential groups > limit {self.num_groups_limit}")
+        strides = []
+        acc = 1
+        for c in reversed(cards):
+            strides.append(acc)
+            acc *= c
+        strides = tuple(reversed(strides))
+        g_pad = kernels.pow2_bucket(g)
+        agg_specs = tuple(
+            _agg_device_spec(f, segment, needed, for_group=True)
+            for f in plan.functions)
+        plan.group_spec = (tuple(gcols), strides, g_pad, agg_specs)
+        plan.group_strides = strides
+
+    def _plan_selection(self, plan: SegmentPlan, segment: ImmutableSegment,
+                        request: BrokerRequest, needed: Dict) -> None:
+        sel = request.selection
+        cols = selection_columns(segment, request)
+        gather = []
+        for c in cols:
+            ds = segment.data_source(c)
+            if not ds.metadata.has_dictionary:
+                gather.append((c, "raw"))
+                needed[(c, "raw")] = None
+            elif ds.metadata.single_value:
+                gather.append((c, "sv"))
+                needed[(c, "ids")] = None
+            else:
+                gather.append((c, "mv"))
+                needed[(c, "mv")] = None
+        k = sel.offset + sel.size
+        if k > MAX_SELECTION_K:
+            raise UnsupportedOnDevice(f"selection k={k} too large")
+        k = min(kernels.pow2_bucket(k, floor=1), segment.padded_docs)
+        if not sel.order_by:
+            plan.select_spec = ("limit", k, (), tuple(gather))
+            return
+        order = []
+        packed_bits = 0
+        for ob in sel.order_by:
+            ds = segment.data_source(ob.column)
+            cm = ds.metadata
+            if not (cm.has_dictionary and cm.single_value):
+                raise UnsupportedOnDevice(
+                    f"order-by on non-dictionary/MV column {ob.column}")
+            card_pad = cm.cardinality + 1
+            packed_bits += int(np.ceil(np.log2(max(card_pad, 2))))
+            order.append((ob.column, ob.ascending, card_pad, "sv"))
+            needed[(ob.column, "ids")] = None
+        if packed_bits > 30:
+            raise UnsupportedOnDevice("order-by key exceeds 31-bit packing")
+        plan.select_spec = ("order", k, tuple(order), tuple(gather))
+
+
+def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
+                     needed: Dict, for_group: bool = False) -> tuple:
+    base = f.info.base
+    if base == "COUNT" and not f.info.is_mv:
+        return ("count", "*", "none", None)
+    col = f.column
+    ds = segment.data_source(col)
+    cm = ds.metadata
+    fname = {
+        "COUNT": "countmv" if f.info.is_mv else "count",
+        "SUM": "sum", "MIN": "min", "MAX": "max", "AVG": "avg",
+        "MINMAXRANGE": "minmaxrange",
+        "DISTINCTCOUNT": "distinctcount",
+        "DISTINCTCOUNTHLL": "distinctcount", "FASTHLL": "distinctcount",
+        "PERCENTILE": "percentile", "PERCENTILEEST": "percentile",
+        "PERCENTILETDIGEST": "percentile",
+    }[base]
+    if not cm.has_dictionary:
+        if fname in ("percentile", "distinctcount"):
+            # raw columns have no dictId histogram: percentile can't merge
+            # exactly across segments and distinctcount needs the value set —
+            # both take the host fallback path
+            raise UnsupportedOnDevice(f"{fname} over no-dictionary column")
+        needed[(col, "raw")] = None
+        return (fname, col, "raw", None)
+    card_pad = kernels.pow2_bucket(cm.cardinality + 1)
+    if cm.single_value:
+        needed[(col, "ids")] = None
+        if for_group and fname in ("sum", "avg", "min", "max", "minmaxrange"):
+            needed[(col, "vals")] = None
+        return (fname, col, "sv", card_pad)
+    needed[(col, "mv")] = None
+    if for_group:
+        raise UnsupportedOnDevice("group-by over MV metric")
+    return (fname, col, "mv", (card_pad, cm.cardinality))
+
+
+def _collect_filter_cols(spec: tuple, needed: Dict) -> None:
+    if spec[0] in ("and", "or"):
+        for c in spec[1]:
+            _collect_filter_cols(c, needed)
+    elif spec[0] == "pred":
+        _, kind, col, source, _ = spec
+        needed[(col, {"sv": "ids", "mv": "mv", "raw": "raw"}[source])] = None
+
+
+def selection_columns(segment: ImmutableSegment, request: BrokerRequest
+                      ) -> List[str]:
+    """Expand SELECT * to the segment's physical columns."""
+    cols = request.selection.columns
+    if cols == ["*"]:
+        return [c for c in segment.column_names if not c.startswith("$")]
+    return list(cols)
+
+
+def _empty_block(plan: SegmentPlan, segment: ImmutableSegment
+                 ) -> IntermediateResultsBlock:
+    blk = IntermediateResultsBlock()
+    if plan.request.is_group_by:
+        blk.group_map = {}
+    elif plan.request.is_aggregation:
+        blk.agg_intermediates = [None for _ in plan.functions]
+    if plan.request.is_selection:
+        blk.selection_rows = []
+        blk.selection_columns = selection_columns(segment, plan.request)
+    _fill_stats(blk, segment, 0, 0, 0)
+    return blk
+
+
+def _fill_stats(blk: IntermediateResultsBlock, segment: ImmutableSegment,
+                docs_scanned: int, entries_filter: int, entries_post: int
+                ) -> None:
+    blk.stats = ExecutionStats(
+        num_docs_scanned=docs_scanned,
+        num_entries_scanned_in_filter=entries_filter,
+        num_entries_scanned_post_filter=entries_post,
+        num_segments_processed=1,
+        num_segments_matched=1 if docs_scanned else 0,
+        total_docs=segment.num_docs)

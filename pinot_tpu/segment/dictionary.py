@@ -1,0 +1,143 @@
+"""Sorted dictionaries: value <-> dictId encoding.
+
+Parity: pinot-core/.../segment/creator/impl/SegmentDictionaryCreator.java and
+the ImmutableDictionaryReader family (core/segment/index/readers/) — sorted
+unique values, id = rank. Because values are sorted, range predicates resolve
+to contiguous dictId intervals, which is what makes the TPU filter kernels
+pure vectorized integer compares (SURVEY.md §7 "guiding translation").
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.segment import format as fmt
+
+
+class Dictionary:
+    """Immutable sorted dictionary for one column."""
+
+    def __init__(self, data_type: DataType, values: np.ndarray):
+        self.data_type = data_type
+        self.values = values  # sorted unique; numeric ndarray or object array
+
+    # -- core api ----------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.values)
+
+    def get(self, dict_id: int):
+        return self.values[dict_id]
+
+    def index_of(self, value) -> int:
+        """Exact lookup; -1 if absent (reference: Dictionary.indexOf)."""
+        v = self._coerce(value)
+        i = int(np.searchsorted(self.values, v))
+        if i < len(self.values) and self.values[i] == v:
+            return i
+        return -1
+
+    def index_of_many(self, values: Sequence) -> np.ndarray:
+        return np.array([self.index_of(v) for v in values], dtype=np.int32)
+
+    def encode(self, column: np.ndarray) -> np.ndarray:
+        """Vectorized value→dictId for a full column (build path)."""
+        if self.data_type.is_numeric:
+            ids = np.searchsorted(self.values, column)
+        else:
+            ids = np.searchsorted(self.values, column)
+        return ids.astype(np.int32)
+
+    def decode(self, dict_ids: np.ndarray) -> np.ndarray:
+        return self.values[dict_ids]
+
+    def range_to_id_interval(self, lower, upper, lower_inclusive: bool,
+                             upper_inclusive: bool) -> Tuple[int, int]:
+        """Map a value range to a half-open dictId interval [lo, hi).
+
+        This is the host-side predicate resolution step: a RANGE predicate on
+        a dictionary-encoded column becomes ``lo <= dictId < hi`` on device.
+        """
+        if lower is None:
+            lo = 0
+        else:
+            lv = self._coerce(lower)
+            side = "left" if lower_inclusive else "right"
+            lo = int(np.searchsorted(self.values, lv, side=side))
+        if upper is None:
+            hi = len(self.values)
+        else:
+            uv = self._coerce(upper)
+            side = "right" if upper_inclusive else "left"
+            hi = int(np.searchsorted(self.values, uv, side=side))
+        return lo, max(lo, hi)
+
+    @property
+    def min_value(self):
+        return self.values[0] if len(self.values) else None
+
+    @property
+    def max_value(self):
+        return self.values[-1] if len(self.values) else None
+
+    def _coerce(self, value):
+        if self.data_type.is_numeric:
+            # keep exact int when possible (int64 > 2^53 loses precision as
+            # float); fall back to float so fractional bounds on int columns
+            # (e.g. RANGE x > 2.5) still order correctly under searchsorted
+            try:
+                return int(str(value))
+            except ValueError:
+                return float(value)
+        if self.data_type == DataType.BYTES:
+            return value if isinstance(value, bytes) else bytes.fromhex(str(value))
+        return str(value)
+
+    # -- build + serde -----------------------------------------------------
+    @classmethod
+    def build(cls, data_type: DataType, column: np.ndarray) -> "Dictionary":
+        uniq = np.unique(column)
+        return cls(data_type, uniq)
+
+    def save(self, seg_dir: str, col: str) -> None:
+        if self.data_type.is_numeric:
+            np.save(os.path.join(seg_dir, fmt.DICT_NUMERIC.format(col=col)),
+                    self.values)
+        else:
+            encoded = [_to_bytes(v, self.data_type) for v in self.values]
+            offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+            np.cumsum([len(b) for b in encoded], out=offsets[1:])
+            with open(os.path.join(seg_dir, fmt.DICT_BYTES.format(col=col)),
+                      "wb") as f:
+                f.write(b"".join(encoded))
+            np.save(os.path.join(seg_dir, fmt.DICT_OFFSETS.format(col=col)),
+                    offsets)
+
+    @classmethod
+    def load(cls, seg_dir: str, col: str, data_type: DataType) -> "Dictionary":
+        if data_type.is_numeric:
+            values = np.load(os.path.join(seg_dir,
+                                          fmt.DICT_NUMERIC.format(col=col)))
+            return cls(data_type, values)
+        offsets = np.load(os.path.join(seg_dir, fmt.DICT_OFFSETS.format(col=col)))
+        with open(os.path.join(seg_dir, fmt.DICT_BYTES.format(col=col)),
+                  "rb") as f:
+            blob = f.read()
+        vals: List = []
+        for i in range(len(offsets) - 1):
+            raw = blob[offsets[i]:offsets[i + 1]]
+            vals.append(raw if data_type == DataType.BYTES
+                        else raw.decode("utf-8"))
+        return cls(data_type, np.array(vals, dtype=object))
+
+
+def _to_bytes(v, data_type: DataType) -> bytes:
+    if data_type == DataType.BYTES:
+        return v if isinstance(v, bytes) else bytes(v)
+    return str(v).encode("utf-8")

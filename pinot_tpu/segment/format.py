@@ -1,0 +1,38 @@
+"""Segment v1 on-disk format constants.
+
+Parity: pinot-core/.../segment/creator/impl/V1Constants.java — file-per-index
+layout. We keep the same logical content (dictionary, forward index, inverted
+index, bloom, metadata) with numpy-native containers:
+
+    <segment_dir>/
+      metadata.json              segment + per-column metadata
+      creation.meta.json         build info
+      <col>.dict.npy             numeric dictionary (sorted values)
+      <col>.dict.bytes / .offsets.npy   string/bytes dictionary
+      <col>.sv.fwd.npy           bit-packed dictId forward index (uint32 words)
+      <col>.sv.sorted.fwd.npy    sorted column: [cardinality, 2] doc-id ranges
+      <col>.mv.fwd.npy / <col>.mv.offsets.npy   multi-value forward index
+      <col>.sv.raw.fwd.npy       raw (no-dictionary) values
+      <col>.inv.docids.npy / <col>.inv.offsets.npy  CSR inverted index
+      <col>.bloom.npy            bloom filter bit array
+"""
+
+METADATA_FILE = "metadata.json"
+CREATION_META_FILE = "creation.meta.json"
+
+DICT_NUMERIC = "{col}.dict.npy"
+DICT_BYTES = "{col}.dict.bytes"
+DICT_OFFSETS = "{col}.dict.offsets.npy"
+
+SV_FWD = "{col}.sv.fwd.npy"
+SV_SORTED_FWD = "{col}.sv.sorted.fwd.npy"
+SV_RAW_FWD = "{col}.sv.raw.fwd.npy"
+MV_FWD = "{col}.mv.fwd.npy"
+MV_OFFSETS = "{col}.mv.offsets.npy"
+
+INV_DOCIDS = "{col}.inv.docids.npy"
+INV_OFFSETS = "{col}.inv.offsets.npy"
+
+BLOOM = "{col}.bloom.npy"
+
+SEGMENT_VERSION = "v1"

@@ -1,0 +1,146 @@
+"""Forward indexes: bit-packed dictIds, sorted ranges, raw values, multi-value.
+
+Parity: pinot-core/.../io/reader/impl/v1/{FixedBitSingleValueReader,
+FixedBitMultiValueReader,FixedByteChunkSingleValueReader}.java and the
+creator-side fwd index writers (core/segment/creator/impl/fwd/). On disk we
+bit-pack dictIds into uint32 words exactly like the fixed-bit format; in HBM
+the loader keeps unpacked int32 lanes (TPU-native width) — the pack exists
+for storage parity + compactness, the device layout is chosen for the VPU.
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from pinot_tpu.segment import format as fmt
+
+
+def bits_required(cardinality: int) -> int:
+    if cardinality <= 1:
+        return 1
+    return int(np.ceil(np.log2(cardinality))) or 1
+
+
+# -- fixed-bit packing (vectorized) ---------------------------------------
+
+def pack_bits(ids: np.ndarray, num_bits: int) -> np.ndarray:
+    """Pack int32 ids (< 2**num_bits) into a dense little-endian bitstream
+    stored as uint32 words."""
+    n = len(ids)
+    total_bits = n * num_bits
+    n_words = (total_bits + 31) // 32
+    out = np.zeros(n_words, dtype=np.uint64)  # u64 scratch to allow carries
+    vals = ids.astype(np.uint64)
+    bit_pos = np.arange(n, dtype=np.int64) * num_bits
+    word_idx = bit_pos // 32
+    shift = (bit_pos % 32).astype(np.uint64)
+    lo = (vals << shift) & 0xFFFFFFFFFFFFFFFF
+    # contributions to word i and possibly word i+1
+    np.add.at(out, word_idx, lo & 0xFFFFFFFF)
+    hi = lo >> np.uint64(32)
+    spill = hi != 0
+    if spill.any():
+        np.add.at(out, word_idx[spill] + 1, hi[spill])
+    return out.astype(np.uint32)
+
+
+def unpack_bits(words: np.ndarray, num_bits: int, n: int) -> np.ndarray:
+    """Inverse of pack_bits → int32[n]."""
+    w = words.astype(np.uint64)
+    bit_pos = np.arange(n, dtype=np.int64) * num_bits
+    word_idx = bit_pos // 32
+    shift = (bit_pos % 32).astype(np.uint64)
+    lo = w[word_idx] >> shift
+    need_hi = (bit_pos % 32) + num_bits > 32
+    hi = np.zeros(n, dtype=np.uint64)
+    if need_hi.any():
+        hi[need_hi] = w[word_idx[need_hi] + 1] << (np.uint64(32) -
+                                                   shift[need_hi])
+    mask = np.uint64((1 << num_bits) - 1)
+    return ((lo | hi) & mask).astype(np.int32)
+
+
+# -- single-value dict-encoded --------------------------------------------
+
+class SVForwardIndexWriter:
+    @staticmethod
+    def write(seg_dir: str, col: str, ids: np.ndarray, cardinality: int) -> int:
+        nb = bits_required(cardinality)
+        words = pack_bits(ids.astype(np.int32), nb)
+        np.save(os.path.join(seg_dir, fmt.SV_FWD.format(col=col)), words)
+        return nb
+
+
+def read_sv_fwd(seg_dir: str, col: str, num_bits: int, num_docs: int
+                ) -> np.ndarray:
+    words = np.load(os.path.join(seg_dir, fmt.SV_FWD.format(col=col)),
+                    mmap_mode="r")
+    return unpack_bits(np.asarray(words), num_bits, num_docs)
+
+
+# -- sorted column ---------------------------------------------------------
+
+def write_sorted_fwd(seg_dir: str, col: str, ids: np.ndarray,
+                     cardinality: int) -> None:
+    """Sorted column forward index = per-dictId [start, end) doc ranges.
+
+    Parity: SortedIndexReaderImpl / SingleValueSortedForwardIndexCreator.
+    """
+    starts = np.searchsorted(ids, np.arange(cardinality), side="left")
+    ends = np.searchsorted(ids, np.arange(cardinality), side="right")
+    ranges = np.stack([starts, ends], axis=1).astype(np.int32)
+    np.save(os.path.join(seg_dir, fmt.SV_SORTED_FWD.format(col=col)), ranges)
+
+
+def read_sorted_fwd(seg_dir: str, col: str) -> np.ndarray:
+    return np.asarray(np.load(os.path.join(seg_dir,
+                                           fmt.SV_SORTED_FWD.format(col=col))))
+
+
+# -- raw (no-dictionary) ---------------------------------------------------
+
+def write_raw_fwd(seg_dir: str, col: str, values: np.ndarray) -> None:
+    np.save(os.path.join(seg_dir, fmt.SV_RAW_FWD.format(col=col)), values)
+
+
+def read_raw_fwd(seg_dir: str, col: str) -> np.ndarray:
+    return np.asarray(np.load(os.path.join(seg_dir,
+                                           fmt.SV_RAW_FWD.format(col=col))))
+
+
+# -- multi-value -----------------------------------------------------------
+
+def write_mv_fwd(seg_dir: str, col: str, flat_ids: np.ndarray,
+                 offsets: np.ndarray) -> None:
+    """MV fwd index as CSR: flat dictIds + int64 row offsets."""
+    np.save(os.path.join(seg_dir, fmt.MV_FWD.format(col=col)),
+            flat_ids.astype(np.int32))
+    np.save(os.path.join(seg_dir, fmt.MV_OFFSETS.format(col=col)),
+            offsets.astype(np.int64))
+
+
+def read_mv_fwd(seg_dir: str, col: str) -> Tuple[np.ndarray, np.ndarray]:
+    flat = np.asarray(np.load(os.path.join(seg_dir, fmt.MV_FWD.format(col=col))))
+    offs = np.asarray(np.load(os.path.join(seg_dir,
+                                           fmt.MV_OFFSETS.format(col=col))))
+    return flat, offs
+
+
+def mv_to_padded(flat_ids: np.ndarray, offsets: np.ndarray,
+                 fill_value: int) -> np.ndarray:
+    """CSR → dense [num_docs, max_entries] padded matrix for device kernels.
+
+    The fill value is the column cardinality (an invalid dictId) so predicate
+    kernels can mask padding with ``id < cardinality``.
+    """
+    counts = np.diff(offsets)
+    num_docs = len(counts)
+    width = int(counts.max()) if num_docs and counts.size else 1
+    width = max(width, 1)
+    out = np.full((num_docs, width), fill_value, dtype=np.int32)
+    rows = np.repeat(np.arange(num_docs), counts)
+    cols = np.arange(len(flat_ids)) - np.repeat(offsets[:-1], counts)
+    out[rows, cols] = flat_ids
+    return out

@@ -1,0 +1,195 @@
+"""Immutable segment loader: segment dir → host arrays → HBM device arrays.
+
+Parity: pinot-core/.../indexsegment/immutable/{ImmutableSegmentImpl,
+ImmutableSegmentLoader}.java + core/common/DataSource.java. Where the
+reference mmaps per-index files into PinotDataBuffer (off-heap memory,
+core/segment/memory/PinotDataBuffer.java:54), the TPU build's "native memory"
+is HBM: each column's dictId lanes and numeric dictionary are pushed to device
+once at load, padded to a lane-friendly block multiple so every query kernel
+sees static shapes (SURVEY.md §7 — padded power-of-two blocks instead of mmap).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.segment import format as fmt
+from pinot_tpu.segment.bloom import BloomFilter
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.fwd import (mv_to_padded, read_mv_fwd, read_raw_fwd,
+                                   read_sorted_fwd, read_sv_fwd)
+from pinot_tpu.segment.inverted import InvertedIndexReader
+from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
+
+# Padding block: multiple of the f32 VPU tile (8 x 128 lanes).
+PAD_BLOCK = 1024
+
+
+def padded_size(n: int, block: int = PAD_BLOCK) -> int:
+    return max(block, ((n + block - 1) // block) * block)
+
+
+class DataSource:
+    """Column access for operators.
+
+    Parity: core/common/DataSource.java + BlockValSet — exposes dictId forward
+    index, dictionary, optional inverted/bloom index and column metadata.
+    """
+
+    def __init__(self, metadata: ColumnMetadata, segment: "ImmutableSegment"):
+        self.metadata = metadata
+        self._segment = segment
+        self.dictionary: Optional[Dictionary] = None
+        # host arrays
+        self.dict_ids: Optional[np.ndarray] = None        # int32 [num_docs]
+        self.raw_values: Optional[np.ndarray] = None      # no-dict columns
+        self.mv_dict_ids: Optional[np.ndarray] = None     # int32 [docs, width]
+        self.sorted_ranges: Optional[np.ndarray] = None   # [card, 2]
+        self.inverted_index: Optional[InvertedIndexReader] = None
+        self.bloom_filter: Optional[BloomFilter] = None
+        # device arrays (lazy)
+        self._dev: Dict[str, object] = {}
+
+    # -- device access -----------------------------------------------------
+    def device_dict_ids(self):
+        """Padded int32 dictIds on device; padding = cardinality (invalid)."""
+        return self._device("dict_ids", self._pad_ids(self.dict_ids))
+
+    def device_mv_dict_ids(self):
+        pad = self.metadata.cardinality
+        arr = self.mv_dict_ids
+        p = padded_size(arr.shape[0])
+        out = np.full((p, arr.shape[1]), pad, dtype=np.int32)
+        out[: arr.shape[0]] = arr
+        return self._device("mv_dict_ids", out)
+
+    def device_dict_values(self):
+        """Numeric dictionary values on device (f64/i64 host width preserved
+        when x64 is on; jax downcasts otherwise). Padded to the same pow2
+        bucket the kernels use for cardinality so compiled executables are
+        shared across segments with similar dictionaries; padding slots
+        repeat the last value (kernels mask them out)."""
+        from pinot_tpu.ops.kernels import pow2_bucket
+        vals = self.dictionary.values
+        if len(vals) == 0:
+            vals = np.zeros(1, self.metadata.data_type.np_dtype)
+        card_pad = pow2_bucket(len(vals) + 1)
+        padded = np.concatenate(
+            [vals, np.full(card_pad - len(vals), vals[-1], vals.dtype)])
+        return self._device("dict_values", padded)
+
+    def device_raw_values(self):
+        arr = self.raw_values
+        p = padded_size(len(arr))
+        out = np.zeros(p, dtype=arr.dtype)
+        out[: len(arr)] = arr
+        return self._device("raw_values", out)
+
+    def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
+        p = padded_size(len(ids))
+        out = np.full(p, self.metadata.cardinality, dtype=np.int32)
+        out[: len(ids)] = ids
+        return out
+
+    def _device(self, key: str, host_array: np.ndarray):
+        if key not in self._dev:
+            import jax.numpy as jnp
+            self._dev[key] = jnp.asarray(host_array)
+        return self._dev[key]
+
+
+class ImmutableSegment:
+    """A loaded, queryable immutable segment.
+
+    Parity: core/indexsegment/immutable/ImmutableSegmentImpl.java.
+    """
+
+    def __init__(self, metadata: SegmentMetadata,
+                 data_sources: Dict[str, DataSource]):
+        self.metadata = metadata
+        self._data_sources = data_sources
+
+    @property
+    def segment_name(self) -> str:
+        return self.metadata.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self.metadata.total_docs
+
+    @property
+    def padded_docs(self) -> int:
+        return padded_size(self.metadata.total_docs)
+
+    @property
+    def column_names(self):
+        return list(self._data_sources.keys())
+
+    def data_source(self, column: str) -> DataSource:
+        try:
+            return self._data_sources[column]
+        except KeyError:
+            raise KeyError(f"column '{column}' not in segment "
+                           f"'{self.segment_name}'")
+
+    def has_column(self, column: str) -> bool:
+        return column in self._data_sources
+
+    def warm_device(self, columns=None) -> None:
+        """Eagerly push forward indexes + dictionaries to HBM."""
+        for name in (columns or self.column_names):
+            ds = self.data_source(name)
+            if ds.dict_ids is not None:
+                ds.device_dict_ids()
+                if ds.metadata.data_type.is_numeric:
+                    ds.device_dict_values()
+            elif ds.raw_values is not None:
+                ds.device_raw_values()
+            elif ds.mv_dict_ids is not None:
+                ds.device_mv_dict_ids()
+
+    def destroy(self) -> None:
+        for ds in self._data_sources.values():
+            ds._dev.clear()
+
+
+class ImmutableSegmentLoader:
+    """load(segment_dir) → ImmutableSegment.
+
+    Parity: ImmutableSegmentLoader.load (core/indexsegment/immutable/
+    ImmutableSegmentLoader.java:50-81): read metadata, build a
+    ColumnIndexContainer per column, wire DataSources.
+    """
+
+    @staticmethod
+    def load(seg_dir: str) -> ImmutableSegment:
+        meta = SegmentMetadata.load(seg_dir)
+        sources: Dict[str, DataSource] = {}
+        for name, cm in meta.columns.items():
+            ds = DataSource(cm, None)
+            if not cm.has_dictionary:
+                ds.raw_values = read_raw_fwd(seg_dir, name)
+            else:
+                ds.dictionary = Dictionary.load(seg_dir, name, cm.data_type)
+                if cm.single_value:
+                    ds.dict_ids = read_sv_fwd(seg_dir, name,
+                                              cm.bits_per_element,
+                                              meta.total_docs)
+                    if cm.sorted:
+                        ds.sorted_ranges = read_sorted_fwd(seg_dir, name)
+                else:
+                    flat, offs = read_mv_fwd(seg_dir, name)
+                    ds.mv_dict_ids = mv_to_padded(flat, offs, cm.cardinality)
+                if cm.has_inverted_index:
+                    ds.inverted_index = InvertedIndexReader.load(
+                        seg_dir, name, meta.total_docs)
+                if cm.has_bloom_filter:
+                    ds.bloom_filter = BloomFilter.load(seg_dir, name)
+            sources[name] = ds
+        seg = ImmutableSegment(meta, sources)
+        for ds in sources.values():
+            ds._segment = seg
+        return seg

@@ -1,0 +1,70 @@
+"""Shared test fixtures: a deterministic multi-type table + segment builder.
+
+Mirrors the reference's Avro-fixture approach
+(pinot-core/src/test/.../queries/*QueriesTest building real segments from
+fixtures) with a seeded random table generator.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.schema import (Schema, TimeUnit, dimension, metric,
+                                     time_field)
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+TEAMS = ["ANA", "BAL", "BOS", "CHA", "CLE", "DET", "HOU", "KCA", "LAA",
+         "MIN", "NYA", "OAK", "SEA", "TBA", "TEX", "TOR"]
+LEAGUES = ["AL", "NL"]
+POSITIONS = ["P", "C", "1B", "2B", "3B", "SS", "LF", "CF", "RF", "DH"]
+
+
+def make_schema() -> Schema:
+    return Schema("baseballStats", [
+        dimension("teamID", DataType.STRING),
+        dimension("league", DataType.STRING),
+        dimension("playerName", DataType.STRING),
+        dimension("position", DataType.STRING, single_value=False),
+        metric("runs", DataType.INT),
+        metric("hits", DataType.LONG),
+        metric("average", DataType.DOUBLE),
+        metric("salary", DataType.FLOAT),
+        time_field("yearID", DataType.INT, TimeUnit.DAYS),
+    ])
+
+
+def make_table_config(**kw) -> TableConfig:
+    idx = IndexingConfig(
+        inverted_index_columns=kw.pop("inverted", ["teamID", "league"]),
+        bloom_filter_columns=kw.pop("bloom", ["teamID"]),
+        no_dictionary_columns=kw.pop("no_dict", ["salary"]))
+    return TableConfig("baseballStats", indexing_config=idx, **kw)
+
+
+def make_columns(n: int, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "teamID": np.array(rng.choice(TEAMS, n), dtype=object),
+        "league": np.array(rng.choice(LEAGUES, n), dtype=object),
+        "playerName": np.array(
+            [f"player_{i % 997:03d}" for i in rng.integers(0, 997, n)],
+            dtype=object),
+        "position": [list(rng.choice(POSITIONS, rng.integers(1, 4),
+                                     replace=False)) for _ in range(n)],
+        "runs": rng.integers(0, 150, n).astype(np.int32),
+        "hits": rng.integers(0, 250, n).astype(np.int64),
+        "average": np.round(rng.random(n), 3),
+        "salary": (rng.random(n).astype(np.float32) * 1e6).round(2),
+        "yearID": rng.integers(1990, 2020, n).astype(np.int32),
+    }
+
+
+def build_segment(tmpdir: str, n: int = 10_000, seed: int = 0,
+                  name: str | None = None):
+    cols = make_columns(n, seed)
+    creator = SegmentCreator(make_schema(), make_table_config(),
+                             segment_name=name)
+    creator.build(cols, tmpdir)
+    return ImmutableSegmentLoader.load(tmpdir), cols

@@ -1,0 +1,91 @@
+"""Independent pure-numpy oracle for golden query checks.
+
+Deliberately written against the RAW column arrays (never the segment /
+engine code paths) so engine bugs can't cancel out — the same role H2 plays
+in the reference's integration tests
+(ClusterIntegrationTestUtils.setUpH2TableWithAvro).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+import numpy as np
+
+
+def mask_eq(col, v):
+    return np.asarray([x == v for x in col]) if isinstance(col[0], list) \
+        else (np.asarray(col) == v)
+
+
+class Oracle:
+    """cols: dict of raw numpy arrays / list-of-lists (MV)."""
+
+    def __init__(self, cols: Dict[str, np.ndarray]):
+        self.cols = cols
+        self.n = len(next(iter(cols.values())))
+
+    def mask(self, fn) -> np.ndarray:
+        """fn: row-dict → bool, evaluated row-at-a-time (slow but simple)."""
+        out = np.zeros(self.n, dtype=bool)
+        keys = list(self.cols.keys())
+        for i in range(self.n):
+            row = {k: self.cols[k][i] for k in keys}
+            out[i] = bool(fn(row))
+        return out
+
+    # -- aggregations ------------------------------------------------------
+    def count(self, m):
+        return int(m.sum())
+
+    def vals(self, col, m):
+        v = self.cols[col]
+        if isinstance(v, list):  # MV
+            return np.array([x for i in np.nonzero(m)[0] for x in v[i]])
+        return np.asarray(v)[m]
+
+    def sum(self, col, m):
+        return float(np.sum(self.vals(col, m).astype(np.float64)))
+
+    def min(self, col, m):
+        v = self.vals(col, m)
+        return float(v.min()) if len(v) else float("inf")
+
+    def max(self, col, m):
+        v = self.vals(col, m)
+        return float(v.max()) if len(v) else float("-inf")
+
+    def avg(self, col, m):
+        v = self.vals(col, m).astype(np.float64)
+        return float(v.mean()) if len(v) else float("-inf")
+
+    def minmaxrange(self, col, m):
+        v = self.vals(col, m)
+        return float(v.max() - v.min()) if len(v) else float("-inf")
+
+    def distinctcount(self, col, m):
+        return int(len(np.unique(self.vals(col, m))))
+
+    def percentile(self, col, m, q):
+        v = np.sort(self.vals(col, m).astype(np.float64))
+        if len(v) == 0:
+            return float("-inf")
+        return float(v[min((len(v) * q) // 100, len(v) - 1)])
+
+    # -- group by ----------------------------------------------------------
+    def group_by(self, gcols: List[str], m, agg):
+        """agg: (name, col) → dict[group_tuple → final value]."""
+        groups: Dict[tuple, np.ndarray] = {}
+        idx = np.nonzero(m)[0]
+        key_arrays = [self.cols[c] for c in gcols]
+        by_key: Dict[tuple, list] = {}
+        for i in idx:
+            key = tuple(k[i] for k in key_arrays)
+            by_key.setdefault(key, []).append(i)
+        out = {}
+        name, col = agg
+        for key, rows in by_key.items():
+            rm = np.zeros(self.n, dtype=bool)
+            rm[rows] = True
+            out[key] = getattr(self, name)(col, rm) if col else self.count(rm)
+        return out

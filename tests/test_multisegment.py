@@ -1,0 +1,120 @@
+"""Multi-segment combine + pruning tests.
+
+Mirrors the reference's inter-segment tier (CombineOperator /
+CombineGroupByOperator merge + SegmentPrunerService).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, make_columns
+from oracle import Oracle
+
+from pinot_tpu.engine import QueryEngine
+
+
+@pytest.fixture(scope="module")
+def multi():
+    segs, all_cols = [], []
+    base = tempfile.mkdtemp()
+    for i in range(4):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        seg, cols = build_segment(d, n=2500, seed=100 + i, name=f"s{i}")
+        segs.append(seg)
+        all_cols.append(cols)
+    merged = {k: (np.concatenate([c[k] for c in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((c[k] for c in all_cols), []))
+              for k in all_cols[0]}
+    return (QueryEngine(segs), QueryEngine(segs, use_device=False),
+            Oracle(merged))
+
+
+def test_multiseg_count_sum(multi):
+    dev, host, oracle = multi
+    m = oracle.mask(lambda r: r["yearID"] >= 2000)
+    for e in (dev, host):
+        resp = e.query("SELECT COUNT(*), SUM(runs) FROM baseballStats "
+                       "WHERE yearID >= 2000")
+        assert resp.aggregation_results[0].value == str(oracle.count(m))
+        assert float(resp.aggregation_results[1].value) == pytest.approx(
+            oracle.sum("runs", m))
+        assert resp.num_segments_processed == 4
+        assert resp.total_docs == 10000
+
+
+def test_multiseg_distinctcount_merges_sets(multi):
+    dev, host, oracle = multi
+    m = oracle.mask(lambda r: True)
+    for e in (dev, host):
+        resp = e.query("SELECT DISTINCTCOUNT(playerName) FROM baseballStats")
+        assert int(resp.aggregation_results[0].value) == \
+            oracle.distinctcount("playerName", m)
+
+
+def test_multiseg_percentile_exact(multi):
+    dev, host, oracle = multi
+    m = oracle.mask(lambda r: r["league"] == "NL")
+    for e in (dev, host):
+        resp = e.query("SELECT PERCENTILE90(hits) FROM baseballStats "
+                       "WHERE league = 'NL'")
+        assert float(resp.aggregation_results[0].value) == \
+            oracle.percentile("hits", m, 90)
+
+
+def test_multiseg_group_by(multi):
+    dev, host, oracle = multi
+    m = oracle.mask(lambda r: True)
+    expected = oracle.group_by(["league"], m, ("max", "hits"))
+    for e in (dev, host):
+        resp = e.query("SELECT MAX(hits) FROM baseballStats GROUP BY league")
+        got = {tuple(g["group"]): float(g["value"])
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == {k: v for k, v in expected.items()}
+
+
+def test_multiseg_selection_order_by(multi):
+    dev, host, oracle = multi
+    m = oracle.mask(lambda r: r["teamID"] == "BOS")
+    top = np.sort(oracle.vals("runs", m))[::-1][:8]
+    for e in (dev, host):
+        resp = e.query("SELECT runs FROM baseballStats WHERE teamID = 'BOS' "
+                       "ORDER BY runs DESC LIMIT 8")
+        got = [int(r[0]) for r in resp.selection_results.results]
+        assert got == [int(x) for x in top]
+
+
+def test_pruning_by_time_range(multi):
+    dev, host, oracle = multi
+    # build two segments with disjoint year ranges and check pruning stats
+    base = tempfile.mkdtemp()
+    segs = []
+    for i, years in enumerate([(1990, 1995), (2010, 2015)]):
+        cols = make_columns(500, seed=i)
+        cols["yearID"] = np.random.default_rng(i).integers(
+            years[0], years[1], 500).astype(np.int32)
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        from fixtures import make_schema, make_table_config
+        from pinot_tpu.segment.creator import SegmentCreator
+        from pinot_tpu.segment.loader import ImmutableSegmentLoader
+        SegmentCreator(make_schema(), make_table_config(),
+                       segment_name=f"p{i}").build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+    e = QueryEngine(segs)
+    resp = e.query(
+        "SELECT COUNT(*) FROM baseballStats WHERE yearID >= 2012")
+    assert resp.num_segments_processed == 1  # one segment pruned
+    rng = np.random.default_rng(1)
+    expect = int((rng.integers(2010, 2015, 500) >= 2012).sum())
+    assert resp.aggregation_results[0].value == str(expect)
+
+
+def test_bloom_pruning_on_absent_value(multi):
+    dev, host, oracle = multi
+    resp = dev.query(
+        "SELECT COUNT(*) FROM baseballStats WHERE teamID = 'XYZ'")
+    assert resp.aggregation_results[0].value == "0"

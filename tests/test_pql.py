@@ -1,0 +1,73 @@
+"""PQL compiler unit tests (reference tier:
+pinot-common/src/test/.../pql/parsers/Pql2CompilerTest).
+"""
+import pytest
+
+from pinot_tpu.common.request import FilterOperator
+from pinot_tpu.pql.lexer import PqlSyntaxError
+from pinot_tpu.pql.optimizer import BrokerRequestOptimizer
+from pinot_tpu.pql.parser import compile_pql
+
+
+def test_aggregation_query_shape():
+    q = compile_pql("SELECT SUM(a), COUNT(*) FROM t WHERE x = 3 "
+                    "GROUP BY g1, g2 TOP 42")
+    assert q.table_name == "t"
+    assert [a.function_name for a in q.aggregations] == ["SUM", "COUNT"]
+    assert q.group_by.columns == ["g1", "g2"]
+    assert q.group_by.top_n == 42
+    assert q.filter.operator == FilterOperator.EQUALITY
+
+
+def test_selection_query_shape():
+    q = compile_pql("SELECT a, b FROM t ORDER BY a DESC, b LIMIT 5, 20")
+    s = q.selection
+    assert s.columns == ["a", "b"]
+    assert s.offset == 5 and s.size == 20
+    assert [(o.column, o.ascending) for o in s.order_by] == \
+        [("a", False), ("b", True)]
+
+
+def test_comparison_operators_map_to_ranges():
+    for op, lower, upper, li, ui in [
+            (">", "5", None, False, True), (">=", "5", None, True, True),
+            ("<", None, "5", True, False), ("<=", None, "5", True, True)]:
+        q = compile_pql(f"SELECT COUNT(*) FROM t WHERE x {op} 5")
+        f = q.filter
+        assert f.operator == FilterOperator.RANGE
+        assert f.lower == lower and f.upper == upper
+        assert f.lower_inclusive == li and f.upper_inclusive == ui
+
+
+def test_optimizer_or_eq_to_in_and_flatten():
+    q = compile_pql("SELECT COUNT(*) FROM t WHERE (a = 1 OR a = 2 OR a = 3) "
+                    "AND (b = 'x' AND c > 0)")
+    q = BrokerRequestOptimizer().optimize(q)
+    assert q.filter.operator == FilterOperator.AND
+    kinds = sorted(c.operator.value for c in q.filter.children)
+    assert kinds == ["EQUALITY", "IN", "RANGE"]
+
+
+def test_optimizer_range_merge():
+    q = compile_pql("SELECT COUNT(*) FROM t WHERE x > 2 AND x <= 10")
+    q = BrokerRequestOptimizer().optimize(q)
+    f = q.filter
+    assert f.operator == FilterOperator.RANGE
+    assert f.lower == "2" and not f.lower_inclusive
+    assert f.upper == "10" and f.upper_inclusive
+
+
+def test_having_tree():
+    q = compile_pql("SELECT SUM(a) FROM t GROUP BY g HAVING SUM(a) > 10 "
+                    "AND SUM(a) <= 20")
+    h = q.having
+    assert h.operator == FilterOperator.AND
+    assert len(h.children) == 2
+    assert h.children[0].agg.function_name == "SUM"
+
+
+def test_syntax_errors():
+    for bad in ["SELECT", "SELECT a FROM", "SELECT a FROM t WHERE",
+                "SELECT a, SUM(b) FROM t"]:
+        with pytest.raises(PqlSyntaxError):
+            compile_pql(bad)

@@ -1,0 +1,109 @@
+"""Regression tests for review findings: MV negated predicates, raw
+DISTINCTCOUNT fallback, empty-filter + SELECT * merge, COUNTMV fast paths,
+bloom pruning literal normalization."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.schema import Schema, dimension, metric
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+
+def _build(tmp, schema, cols, tc=None, name=None):
+    SegmentCreator(schema, tc, segment_name=name).build(cols, tmp)
+    return ImmutableSegmentLoader.load(tmp)
+
+
+@pytest.fixture(scope="module")
+def mv_seg():
+    tmp = tempfile.mkdtemp()
+    schema = Schema("t", [dimension("tags", DataType.STRING,
+                                    single_value=False),
+                          metric("v", DataType.INT)])
+    cols = {
+        # doc0 has ONLY 'x' and fewer entries than the padded width
+        "tags": [["x"], ["x", "y"], ["y", "z", "w"], ["z"]],
+        "v": np.array([1, 2, 3, 4], np.int32),
+    }
+    return _build(tmp, schema, cols), cols
+
+
+def test_mv_neq_excludes_padding(mv_seg):
+    seg, cols = mv_seg
+    for use_device in (True, False):
+        e = QueryEngine([seg], use_device=use_device)
+        # doc0's only value is 'x' → must NOT match tags <> 'x'
+        r = e.query("SELECT COUNT(*) FROM t WHERE tags <> 'x'")
+        assert r.aggregation_results[0].value == "3", use_device
+        r = e.query("SELECT COUNT(*) FROM t WHERE tags NOT IN ('x', 'y')")
+        assert r.aggregation_results[0].value == "2", use_device
+
+
+def test_countmv_counts_entries_not_docs(mv_seg):
+    seg, cols = mv_seg
+    total_entries = sum(len(x) for x in cols["tags"])
+    for use_device in (True, False):
+        e = QueryEngine([seg], use_device=use_device)
+        r = e.query("SELECT COUNTMV(tags) FROM t")  # no filter → fast path?
+        assert r.aggregation_results[0].value == str(total_entries), use_device
+        r = e.query("SELECT COUNTMV(tags) FROM t WHERE v > 1")
+        assert r.aggregation_results[0].value == str(
+            sum(len(x) for x, v in zip(cols["tags"], cols["v"]) if v > 1))
+
+
+def test_distinctcount_on_raw_column_falls_back():
+    tmp = tempfile.mkdtemp()
+    schema = Schema("t", [metric("m", DataType.FLOAT),
+                          dimension("d", DataType.INT)])
+    tc = TableConfig("t", indexing_config=IndexingConfig(
+        no_dictionary_columns=["m"]))
+    cols = {"m": np.array([1.5, 2.5, 1.5, 3.5], np.float32),
+            "d": np.array([1, 1, 2, 2], np.int32)}
+    seg = _build(tmp, schema, cols, tc)
+    e = QueryEngine([seg])
+    r = e.query("SELECT DISTINCTCOUNT(m) FROM t")
+    assert r.aggregation_results[0].value == "3"
+    r = e.query("SELECT PERCENTILE50(m) FROM t WHERE d = 1")
+    assert float(r.aggregation_results[0].value) == 2.5
+
+
+def test_select_star_order_by_with_empty_segment_merge():
+    schema = Schema("t", [dimension("k", DataType.STRING),
+                          metric("v", DataType.INT)])
+    segs = []
+    base = tempfile.mkdtemp()
+    for i, ks in enumerate([["a", "b"], ["c", "d"]]):
+        d = os.path.join(base, f"s{i}")
+        os.makedirs(d)
+        cols = {"k": np.array(ks, dtype=object),
+                "v": np.array([i * 10 + 1, i * 10 + 2], np.int32)}
+        segs.append(_build(d, schema, cols, name=f"s{i}"))
+    for use_device in (True, False):
+        e = QueryEngine(segs, use_device=use_device)
+        # 'c' exists only in segment 2; segment 1 resolves EMPTY
+        r = e.query("SELECT * FROM t WHERE k = 'c' ORDER BY v LIMIT 10")
+        assert r.selection_results.columns == ["k", "v"], use_device
+        assert r.selection_results.results == [["c", 11]], use_device
+
+
+def test_bloom_pruner_numeric_literal_normalization():
+    tmp = tempfile.mkdtemp()
+    schema = Schema("t", [metric("price", DataType.FLOAT),
+                          dimension("d", DataType.INT)])
+    tc = TableConfig("t", indexing_config=IndexingConfig(
+        bloom_filter_columns=["price"]))
+    cols = {"price": np.array([5.0, 7.5, 9.0], np.float32),
+            "d": np.array([1, 2, 3], np.int32)}
+    seg = _build(tmp, schema, cols, tc)
+    e = QueryEngine([seg])
+    # '5' must not be bloom-pruned just because it hashes differently
+    # than '5.0'
+    r = e.query("SELECT COUNT(*) FROM t WHERE price = 5")
+    assert r.aggregation_results[0].value == "1"
+    assert r.num_segments_processed == 1
