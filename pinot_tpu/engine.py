@@ -21,9 +21,17 @@ from pinot_tpu.segment.loader import ImmutableSegment, ImmutableSegmentLoader
 
 class QueryEngine:
     def __init__(self, segments: Sequence[ImmutableSegment],
-                 use_device: bool = True):
+                 use_device: bool = True, mesh=None):
+        """`mesh`: optional jax.sharding.Mesh — when given, multi-segment
+        queries run the sharded executor (segment DP with ICI combine,
+        parallel/sharded.py) and fall back to sequential per-segment
+        execution when segments aren't homogeneous enough."""
         self.segments = list(segments)
         self.executor = ServerQueryExecutor(use_device=use_device)
+        self.sharded = None
+        if mesh is not None:
+            from pinot_tpu.parallel.sharded import ShardedQueryExecutor
+            self.sharded = ShardedQueryExecutor(mesh=mesh)
         self.optimizer = BrokerRequestOptimizer()
         self.reducer = BrokerReduceService()
 
@@ -35,7 +43,18 @@ class QueryEngine:
     def query(self, pql: str) -> BrokerResponse:
         t0 = time.perf_counter()
         request = self.optimizer.optimize(compile_pql(pql))
-        block = self.executor.execute(request, self.segments)
+        block = self._execute(request)
         resp = self.reducer.reduce(request, [block])
         resp.time_used_ms = (time.perf_counter() - t0) * 1e3
         return resp
+
+    def _execute(self, request):
+        if self.sharded is not None and len(self.segments) > 1:
+            from pinot_tpu.parallel.sharded import NotShardable
+            from pinot_tpu.query.plan import (GroupsLimitExceeded,
+                                              UnsupportedOnDevice)
+            try:
+                return self.sharded.execute(request, self.segments)
+            except (NotShardable, GroupsLimitExceeded, UnsupportedOnDevice):
+                pass
+        return self.executor.execute(request, self.segments)

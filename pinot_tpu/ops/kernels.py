@@ -288,9 +288,9 @@ def _selection_outputs(select_spec, cols, mask):
 
 
 @functools.lru_cache(maxsize=1024)
-def get_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
-                       select_spec):
-    """Compile (once per static signature) the whole per-segment plan."""
+def build_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
+                         select_spec):
+    """Unjitted whole-plan kernel closure (vmap/shard_map composable)."""
 
     def kernel(cols: Dict[str, jnp.ndarray], params: Tuple, num_docs):
         valid = jnp.arange(padded, dtype=jnp.int32) < num_docs
@@ -305,7 +305,15 @@ def get_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
             outs.update(_selection_outputs(select_spec, cols, mask))
         return outs
 
-    return jax.jit(kernel)
+    return kernel
+
+
+@functools.lru_cache(maxsize=1024)
+def get_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
+                       select_spec):
+    """Compile (once per static signature) the whole per-segment plan."""
+    return jax.jit(build_segment_kernel(padded, filter_spec, agg_specs,
+                                        group_spec, select_spec))
 
 
 def run_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,

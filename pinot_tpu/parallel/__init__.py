@@ -1,0 +1,5 @@
+from pinot_tpu.parallel.sharded import (NotShardable, ShardedQueryExecutor,
+                                        StackedSegments, make_mesh)
+
+__all__ = ["NotShardable", "ShardedQueryExecutor", "StackedSegments",
+           "make_mesh"]

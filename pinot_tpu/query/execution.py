@@ -26,10 +26,10 @@ def _count_filter_leaves(spec) -> int:
     return 1
 
 
-def gather_operands(plan) -> Dict[str, object]:
+def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
     cols: Dict[str, object] = {}
-    for col, kind in plan.needed_cols:
-        ds = plan.segment.data_source(col)
+    for col, kind in needed_cols:
+        ds = segment.data_source(col)
         if kind == "ids":
             cols[f"{col}.ids"] = ds.device_dict_ids()
         elif kind == "vals":
@@ -39,6 +39,10 @@ def gather_operands(plan) -> Dict[str, object]:
         elif kind == "mv":
             cols[f"{col}.mv"] = ds.device_mv_dict_ids()
     return cols
+
+
+def gather_operands(plan) -> Dict[str, object]:
+    return gather_operands_for(plan.segment, plan.needed_cols)
 
 
 def execute_segment_plan(plan) -> IntermediateResultsBlock:

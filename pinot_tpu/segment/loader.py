@@ -56,15 +56,10 @@ class DataSource:
     # -- device access -----------------------------------------------------
     def device_dict_ids(self):
         """Padded int32 dictIds on device; padding = cardinality (invalid)."""
-        return self._device("dict_ids", self._pad_ids(self.dict_ids))
+        return self._device("dict_ids", self.host_operand("ids"))
 
     def device_mv_dict_ids(self):
-        pad = self.metadata.cardinality
-        arr = self.mv_dict_ids
-        p = padded_size(arr.shape[0])
-        out = np.full((p, arr.shape[1]), pad, dtype=np.int32)
-        out[: arr.shape[0]] = arr
-        return self._device("mv_dict_ids", out)
+        return self._device("mv_dict_ids", self.host_operand("mv"))
 
     def device_dict_values(self):
         """Numeric dictionary values on device (f64/i64 host width preserved
@@ -72,21 +67,39 @@ class DataSource:
         bucket the kernels use for cardinality so compiled executables are
         shared across segments with similar dictionaries; padding slots
         repeat the last value (kernels mask them out)."""
-        from pinot_tpu.ops.kernels import pow2_bucket
-        vals = self.dictionary.values
-        if len(vals) == 0:
-            vals = np.zeros(1, self.metadata.data_type.np_dtype)
-        card_pad = pow2_bucket(len(vals) + 1)
-        padded = np.concatenate(
-            [vals, np.full(card_pad - len(vals), vals[-1], vals.dtype)])
-        return self._device("dict_values", padded)
+        return self._device("dict_values", self.host_operand("vals"))
 
     def device_raw_values(self):
-        arr = self.raw_values
-        p = padded_size(len(arr))
-        out = np.zeros(p, dtype=arr.dtype)
-        out[: len(arr)] = arr
-        return self._device("raw_values", out)
+        return self._device("raw_values", self.host_operand("raw"))
+
+    def host_operand(self, kind: str) -> np.ndarray:
+        """Padded host array for a lane kind ('ids'|'vals'|'raw'|'mv') —
+        identical layout to the device arrays; used by the sharded executor
+        to stack homogeneous segments onto a leading mesh axis."""
+        if kind == "ids":
+            return self._pad_ids(self.dict_ids)
+        if kind == "vals":
+            from pinot_tpu.ops.kernels import pow2_bucket
+            vals = self.dictionary.values
+            if len(vals) == 0:
+                vals = np.zeros(1, self.metadata.data_type.np_dtype)
+            card_pad = pow2_bucket(len(vals) + 1)
+            return np.concatenate(
+                [vals, np.full(card_pad - len(vals), vals[-1], vals.dtype)])
+        if kind == "raw":
+            arr = self.raw_values
+            p = padded_size(len(arr))
+            out = np.zeros(p, dtype=arr.dtype)
+            out[: len(arr)] = arr
+            return out
+        if kind == "mv":
+            arr = self.mv_dict_ids
+            p = padded_size(arr.shape[0])
+            out = np.full((p, arr.shape[1]), self.metadata.cardinality,
+                          dtype=np.int32)
+            out[: arr.shape[0]] = arr
+            return out
+        raise ValueError(kind)
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
         p = padded_size(len(ids))

@@ -68,3 +68,57 @@ def build_segment(tmpdir: str, n: int = 10_000, seed: int = 0,
                              segment_name=name)
     creator.build(cols, tmpdir)
     return ImmutableSegmentLoader.load(tmpdir), cols
+
+
+def make_shared_columns(n: int, seed: int = 0) -> dict:
+    """Columns whose first rows enumerate each value pool, so every segment
+    built from them has IDENTICAL dictionaries — the shared-dictionary
+    layout the mesh-sharded executor combines in the dictId domain."""
+    assert n >= 1024, "need n >= 1024 to cover the value pools"
+    rng = np.random.default_rng(seed)
+
+    def pick(pool, dtype=None):
+        k = len(pool)
+        idx = np.concatenate([np.arange(k), rng.integers(0, k, n - k)])
+        arr = np.asarray(pool)[idx]
+        return arr.astype(dtype) if dtype is not None else \
+            np.array(arr, dtype=object)
+
+    players = [f"player_{i:03d}" for i in range(997)]
+    avg_grid = np.round(np.arange(256) / 256.0, 4)
+    positions = [[POSITIONS[i % len(POSITIONS)]] if i < len(POSITIONS)
+                 else list(rng.choice(POSITIONS, rng.integers(1, 4),
+                                      replace=False))
+                 for i in range(n)]
+    return {
+        "teamID": pick(TEAMS),
+        "league": pick(LEAGUES),
+        "playerName": pick(players),
+        "position": positions,
+        "runs": pick(np.arange(150), np.int32),
+        "hits": pick(np.arange(250), np.int64),
+        "average": pick(avg_grid, np.float64),
+        "salary": (rng.random(n).astype(np.float32) * 1e6).round(2),
+        "yearID": pick(np.arange(1990, 2020), np.int32),
+    }
+
+
+def build_shared_segments(base: str, n_segs: int = 8, n: int = 2048,
+                          seed: int = 0):
+    """n_segs segments with identical dictionaries + concatenated raw cols."""
+    import os
+    segs, all_cols = [], []
+    for i in range(n_segs):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d, exist_ok=True)
+        cols = make_shared_columns(n, seed + i)
+        creator = SegmentCreator(make_schema(), make_table_config(),
+                                 segment_name=f"shared_{i}")
+        creator.build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        all_cols.append(cols)
+    merged = {k: (np.concatenate([c[k] for c in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((c[k] for c in all_cols), []))
+              for k in all_cols[0]}
+    return segs, merged

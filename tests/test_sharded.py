@@ -1,0 +1,251 @@
+"""Mesh-sharded multi-segment execution tests (8 virtual devices).
+
+Mirrors the reference's CombineOperator/CombineGroupByOperator correctness
+expectations: sharded execution must return exactly the same answers as the
+sequential per-segment path / the numpy oracle.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, build_shared_segments
+from oracle import Oracle
+
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.parallel import (NotShardable, ShardedQueryExecutor,
+                                make_mesh)
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.reduce import BrokerReduceService
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, n_segs=8, n=2048)
+    mesh = make_mesh()
+    return segs, Oracle(merged), mesh
+
+
+def _reduce(request, block):
+    return BrokerReduceService().reduce(request, [block])
+
+
+def _run(sharded, segs, pql):
+    request = compile_pql(pql)
+    return _reduce(request, sharded.execute(request, segs))
+
+
+def test_mesh_has_8_devices(cluster):
+    _, _, mesh = cluster
+    assert mesh.devices.size == 8
+
+
+def test_sharded_count_sum_avg(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: r["yearID"] >= 2000)
+    resp = _run(sharded, segs,
+                "SELECT COUNT(*), SUM(runs), AVG(hits) FROM baseballStats "
+                "WHERE yearID >= 2000")
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert float(resp.aggregation_results[1].value) == pytest.approx(
+        oracle.sum("runs", m))
+    assert float(resp.aggregation_results[2].value) == pytest.approx(
+        oracle.avg("hits", m), rel=1e-9)
+    assert resp.num_segments_processed == 8
+
+
+def test_sharded_min_max_range(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: r["teamID"] == "BOS")
+    resp = _run(sharded, segs,
+                "SELECT MIN(runs), MAX(runs), MINMAXRANGE(hits) "
+                "FROM baseballStats WHERE teamID = 'BOS'")
+    assert float(resp.aggregation_results[0].value) == oracle.min("runs", m)
+    assert float(resp.aggregation_results[1].value) == oracle.max("runs", m)
+    assert float(resp.aggregation_results[2].value) == \
+        oracle.minmaxrange("hits", m)
+
+
+def test_sharded_raw_column_aggs(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: r["league"] == "NL")
+    resp = _run(sharded, segs,
+                "SELECT SUM(salary), MIN(salary), MAX(salary) "
+                "FROM baseballStats WHERE league = 'NL'")
+    assert float(resp.aggregation_results[0].value) == pytest.approx(
+        oracle.sum("salary", m), rel=1e-6)
+    assert float(resp.aggregation_results[1].value) == pytest.approx(
+        oracle.min("salary", m))
+    assert float(resp.aggregation_results[2].value) == pytest.approx(
+        oracle.max("salary", m))
+
+
+def test_sharded_distinctcount_percentile(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: r["yearID"] < 2005)
+    resp = _run(sharded, segs,
+                "SELECT DISTINCTCOUNT(playerName), PERCENTILE90(runs) "
+                "FROM baseballStats WHERE yearID < 2005")
+    assert int(resp.aggregation_results[0].value) == \
+        oracle.distinctcount("playerName", m)
+    assert float(resp.aggregation_results[1].value) == pytest.approx(
+        oracle.percentile("runs", m, 90))
+
+
+def test_sharded_group_by(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: r["runs"] > 50)
+    expected = oracle.group_by(["teamID", "league"], m,
+                               ("sum", "hits"))
+    resp = _run(sharded, segs,
+                "SELECT SUM(hits) FROM baseballStats WHERE runs > 50 "
+                "GROUP BY teamID, league TOP 1000")
+    got = {tuple(g["group"]): float(g["value"])
+           for g in resp.aggregation_results[0].group_by_result}
+    assert got == {k: pytest.approx(v) for k, v in expected.items()}
+
+
+def test_sharded_group_by_min_max_avg(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: True)
+    for agg, okind in [("MIN(runs)", ("min", "runs")),
+                       ("MAX(runs)", ("max", "runs")),
+                       ("AVG(runs)", ("avg", "runs")),
+                       ("COUNT(*)", ("count", None))]:
+        expected = oracle.group_by(["league"], m, okind)
+        resp = _run(sharded, segs,
+                    f"SELECT {agg} FROM baseballStats GROUP BY league")
+        got = {tuple(g["group"]): float(g["value"])
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == {k: pytest.approx(v) for k, v in expected.items()}, agg
+
+
+def test_sharded_mv_aggregation(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    m = oracle.mask(lambda r: "P" in r["position"])
+    resp = _run(sharded, segs,
+                "SELECT COUNT(*) FROM baseballStats WHERE position = 'P'")
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+
+
+def test_sharded_selection_limit_and_order(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    resp = _run(sharded, segs,
+                "SELECT teamID, runs FROM baseballStats "
+                "WHERE league = 'AL' ORDER BY runs DESC LIMIT 20")
+    assert len(resp.selection_results.results) == 20
+    got_runs = [int(r[1]) for r in resp.selection_results.results]
+    m = oracle.mask(lambda r: r["league"] == "AL")
+    expected = sorted(oracle.vals("runs", m), reverse=True)[:20]
+    assert got_runs == [int(v) for v in expected]
+
+
+def test_sharded_matches_sequential_engine(cluster):
+    segs, oracle, mesh = cluster
+    dev = QueryEngine(segs)
+    sharded_engine = QueryEngine(segs, mesh=mesh)
+    for pql in [
+        "SELECT COUNT(*) FROM baseballStats WHERE teamID IN ('BOS','NYA')",
+        "SELECT SUM(runs), MAX(hits) FROM baseballStats WHERE runs "
+        "BETWEEN 10 AND 90",
+        "SELECT AVG(average) FROM baseballStats GROUP BY teamID TOP 100",
+    ]:
+        a = dev.query(pql).to_json()
+        b = sharded_engine.query(pql).to_json()
+        for key in ("aggregationResults", "selectionResults"):
+            assert a.get(key) == b.get(key), pql
+
+
+def test_heterogeneous_dictionaries_not_shardable():
+    base = tempfile.mkdtemp()
+    segs = []
+    for i in range(2):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        seg, _ = build_segment(d, n=1000, seed=i, name=f"h{i}")
+        segs.append(seg)
+    sharded = ShardedQueryExecutor(mesh=make_mesh())
+    # playerName: 997-value pool sampled 1000x per segment → the two
+    # segments' dictionaries are necessarily different subsets
+    request = compile_pql(
+        "SELECT DISTINCTCOUNT(playerName) FROM baseballStats")
+    with pytest.raises(NotShardable):
+        sharded.execute(request, segs)
+
+
+def test_folded_predicate_on_heterogeneous_dicts_falls_back():
+    """A predicate that constant-folds differently per segment dictionary
+    (e.g. NOT over a value present in only one segment) must not be executed
+    with segment-0's plan across all segments."""
+    base = tempfile.mkdtemp()
+    segs, all_cols = [], []
+    for i in range(2):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        seg, cols = build_segment(d, n=1000, seed=i, name=f"fold{i}")
+        segs.append(seg)
+        all_cols.append(cols)
+    # find a player present in segment 1 but absent from segment 0
+    s0 = set(all_cols[0]["playerName"])
+    s1 = set(all_cols[1]["playerName"])
+    only1 = sorted(s1 - s0)[0]
+    names = np.concatenate([c["playerName"] for c in all_cols])
+    runs = np.concatenate([c["runs"] for c in all_cols])
+    expected = float(runs[names != only1].sum())
+
+    sharded = ShardedQueryExecutor(mesh=make_mesh())
+    request = compile_pql(
+        f"SELECT SUM(runs) FROM baseballStats WHERE playerName <> '{only1}'")
+    with pytest.raises(NotShardable):
+        sharded.execute(request, segs)
+
+    engine = QueryEngine(segs, mesh=make_mesh())
+    resp = engine.query(
+        f"SELECT SUM(runs) FROM baseballStats WHERE playerName <> '{only1}'")
+    assert float(resp.aggregation_results[0].value) == pytest.approx(expected)
+
+
+def test_sharded_num_segments_matched():
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, n_segs=4, n=1024, seed=77)
+    sharded = ShardedQueryExecutor(mesh=make_mesh())
+    # match-nothing-ish filter: runs == 149 appears in every segment's
+    # first-1024 enumeration? runs pool is 150 wide and n=1024 covers it,
+    # so instead compare against the per-segment oracle count
+    request = compile_pql(
+        "SELECT COUNT(*) FROM baseballStats WHERE runs = 142 AND "
+        "yearID = 1999")
+    blk = sharded.execute(request, segs)
+    per_seg = []
+    for i in range(4):
+        lo, hi = i * 1024, (i + 1) * 1024
+        m = (merged["runs"][lo:hi] == 142) & (merged["yearID"][lo:hi] == 1999)
+        per_seg.append(int(m.sum()))
+    assert blk.stats.num_segments_matched == sum(1 for c in per_seg if c)
+    assert blk.stats.num_docs_scanned == sum(per_seg)
+
+
+def test_engine_falls_back_when_not_shardable():
+    base = tempfile.mkdtemp()
+    segs, all_cols = [], []
+    for i in range(2):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        seg, cols = build_segment(d, n=1000, seed=i, name=f"f{i}")
+        segs.append(seg)
+        all_cols.append(cols)
+    merged_runs = np.concatenate([c["runs"] for c in all_cols])
+    engine = QueryEngine(segs, mesh=make_mesh())
+    resp = engine.query("SELECT SUM(runs) FROM baseballStats")
+    assert float(resp.aggregation_results[0].value) == pytest.approx(
+        float(merged_runs.sum()))
