@@ -125,18 +125,186 @@ def _eval_filter(spec, cols: Dict[str, jnp.ndarray], params: List, valid):
 
 
 # ---------------------------------------------------------------------------
+# TPU reduction strategy
+#
+# Scatter/gather run at ~150M rows/s on TPU (serialized updates) while tree
+# reductions and MXU matmuls run at memory/matmul bandwidth (20-200x faster,
+# measured on v5e). So the hot aggregation paths NEVER scatter or gather:
+#
+# - SUM/AVG over integer dictionary columns reads precomputed bit-sliced
+#   "part lanes" (int8 [n_parts, P], 7 bits of the offset value per lane,
+#   built once at segment load) and does masked tree reductions per part.
+#   Per 8192-block a part sum is <= 127*8192 < 2^20, so int32 block partials
+#   are exact; the final f64/int64 combine (<< 7k shifts + min_value*count)
+#   happens host-side. Exact at any scale without f64 on device.
+# - GROUP-BY SUM/AVG one-hot-encodes the mixed-radix group key per block and
+#   matmuls [B, G]^T @ [B, n_parts] on the MXU with f32 accumulation (block
+#   sums < 2^24 => exact), accumulating int32 across blocks.
+# - Histograms (DISTINCTCOUNT/PERCENTILE) are one-hot matmuls too.
+# - MIN/MAX reduce dictIds directly (sorted dict => id order == value order);
+#   group-by min/max uses blocked masked min over a [B, G] compare tile.
+# Scatter remains only as the fallback for huge group tables / cardinalities.
+# ---------------------------------------------------------------------------
+
+BLOCK = 8192                 # row block: must divide padded segment length
+CHUNK_BLOCKS = 256           # int32 two-stage partial width (2^20*256 < 2^31)
+DENSE_G_LIMIT = 32768        # one-hot matmul group-table cap
+DENSE_ROWS_LIMIT = 1 << 24   # carry-accum int32 bound (127 * 2^24 < 2^31)
+DENSE_CARD_LIMIT = 32768     # one-hot matmul histogram cap
+
+
+def _tile_rows(g: int) -> int:
+    """Block size for [B, G] one-hot tiles: keep B*G <= 2^24, B | BLOCK."""
+    b = 1 << max(9, min(13, int(np.log2(max((1 << 24) // max(g, 1), 1)))))
+    return min(b, BLOCK)
+
+
+def _chunked_int_sum(x):
+    """[T, ...] int32 block partials -> [T1, ...] int32, exact.
+
+    Each input partial is < 2^20; summing 256 at a time stays < 2^28. The
+    final (host-side) combine over T1 uses int64.
+    """
+    t = x.shape[0]
+    t1 = -(-t // CHUNK_BLOCKS)
+    x = jnp.pad(x, ((0, t1 * CHUNK_BLOCKS - t),) + ((0, 0),) * (x.ndim - 1))
+    return x.reshape((t1, CHUNK_BLOCKS) + x.shape[1:]).sum(
+        axis=1, dtype=jnp.int32)
+
+
+def _part_sums(parts, mask):
+    """Masked exact sums of int8 part lanes.
+
+    parts: int8 [n_parts, P]; returns int32 [T1, n_parts] chunk partials.
+    """
+    n_parts, p = parts.shape
+    contrib = jnp.where(mask[None, :], parts.astype(jnp.int32), 0)
+    blocks = contrib.reshape(n_parts, p // BLOCK, BLOCK).sum(
+        axis=2, dtype=jnp.int32)                      # [n_parts, T] < 2^20
+    return _chunked_int_sum(jnp.swapaxes(blocks, 0, 1))
+
+
+def _chunked_float_sum(vals, mask):
+    """Masked float sum -> [T1] block-chunk partials (f64 under x64)."""
+    acc = sum_dtype()
+    contrib = jnp.where(mask, vals.astype(acc), 0)
+    blocks = contrib.reshape(-1, BLOCK).sum(axis=1, dtype=acc)
+    t = blocks.shape[0]
+    t1 = -(-t // CHUNK_BLOCKS)
+    blocks = jnp.pad(blocks, (0, t1 * CHUNK_BLOCKS - t))
+    return blocks.reshape(t1, CHUNK_BLOCKS).sum(axis=1, dtype=acc)
+
+
+def _mxu_histogram(ids, mask, card_pad: int):
+    """One-hot matmul histogram: int32 [card_pad], exact.
+
+    Replaces the scatter-add histogram (~40x faster on v5e at 8k bins).
+    """
+    b = _tile_rows(card_pad)
+    ids_b = ids.reshape(-1, b)
+    mask_b = mask.astype(jnp.bfloat16).reshape(-1, b)
+
+    def body(carry, tb):
+        i, m = tb
+        onehot = jax.nn.one_hot(i, card_pad, dtype=jnp.bfloat16)   # [b, card]
+        h = jnp.matmul(m[None, :], onehot,
+                       preferred_element_type=jnp.float32)[0]      # <= b
+        return carry + h.astype(jnp.int32), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros(card_pad, jnp.int32),
+                          (ids_b, mask_b))
+    return out
+
+
+def _dense_group_count(key, mask, g_pad: int):
+    """Per-group match counts — a histogram over group keys."""
+    return _mxu_histogram(key, mask, g_pad)
+
+
+def _dense_group_part_sums(parts, key, mask, g_pad: int):
+    """Exact per-group sums of int8 part lanes via MXU: int32 [n_parts, g].
+
+    Carry-accumulated int32; planner guarantees padded <= DENSE_ROWS_LIMIT
+    so 127 * rows < 2^31.
+    """
+    n_parts = parts.shape[0]
+    b = _tile_rows(g_pad)
+    contrib = jnp.where(mask[None, :], parts.astype(jnp.bfloat16), 0)
+    key_b = key.reshape(-1, b)
+    cb = jnp.moveaxis(contrib.reshape(n_parts, -1, b), 1, 0)  # [T, n_parts, b]
+
+    def body(carry, tb):
+        k, c = tb
+        onehot = jax.nn.one_hot(k, g_pad, dtype=jnp.bfloat16)       # [b, g]
+        s = jnp.matmul(c, onehot, preferred_element_type=jnp.float32)
+        return carry + s.astype(jnp.int32), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros((n_parts, g_pad), jnp.int32),
+                          (key_b, cb))
+    return out
+
+
+def _dense_group_float_sums(vals, key, mask, g_pad: int):
+    """Per-group float sums via MXU (f32 carry; f64 under x64): [g_pad]."""
+    acc = sum_dtype()
+    mm_dtype = acc if acc == jnp.float64 else jnp.float32
+    b = _tile_rows(g_pad)
+    contrib = jnp.where(mask, vals.astype(mm_dtype), 0)
+    key_b = key.reshape(-1, b)
+    cb = contrib.reshape(-1, b)
+
+    def body(carry, tb):
+        k, c = tb
+        onehot = jax.nn.one_hot(k, g_pad, dtype=mm_dtype)
+        s = jnp.matmul(c[None, :], onehot,
+                       preferred_element_type=mm_dtype)[0]
+        return carry + s, None
+
+    out, _ = jax.lax.scan(body, jnp.zeros(g_pad, mm_dtype), (key_b, cb))
+    return out
+
+
+def _dense_group_extreme(ids_or_vals, key, mask, g_pad: int, sentinel,
+                         is_min: bool):
+    """Blocked masked min/max per group over a [b, G] compare tile."""
+    b = _tile_rows(g_pad)
+    v_b = ids_or_vals.reshape(-1, b)
+    key_b = key.reshape(-1, b)
+    mask_b = mask.reshape(-1, b)
+    groups = jnp.arange(g_pad, dtype=jnp.int32)
+    init = jnp.full(g_pad, sentinel, ids_or_vals.dtype)
+
+    def body(carry, tb):
+        k, v, m = tb
+        hit = (k[:, None] == groups[None, :]) & m[:, None]
+        tile = jnp.where(hit, v[:, None], sentinel)
+        ext = tile.min(axis=0) if is_min else tile.max(axis=0)
+        return (jnp.minimum(carry, ext) if is_min
+                else jnp.maximum(carry, ext)), None
+
+    out, _ = jax.lax.scan(body, init, (key_b, v_b, mask_b))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Aggregation spec evaluation (no group-by)
 #
 # agg spec: (fname, col, source, extra)
 #   fname ∈ {count, sum, min, max, avg, minmaxrange, distinctcount,
 #            sumhist, percentile}
-# Emitted outputs are "device partials" — host code (query/aggregation)
-# finishes them exactly (histogram ⋅ dictionary in f64, id → value decode).
+# extra encodes the planner-chosen strategy (see plan._agg_device_spec):
+#   sv: ("parts", n_parts) | ("vlane",) | ("hist", card_pad)
+#       | ("ids", card_pad)
+# Emitted outputs are "device partials" — host code (query/execution)
+# finishes them exactly (int64 shift-combine, f64 histogram ⋅ dictionary
+# dot, id → value decode).
 # ---------------------------------------------------------------------------
 
 
 def _histogram(cols, col: str, card_pad: int, mask):
     ids = cols[f"{col}.ids"]
+    if card_pad <= DENSE_CARD_LIMIT:
+        return _mxu_histogram(ids, mask, card_pad)
     return jnp.zeros(card_pad, jnp.int32).at[ids].add(mask.astype(jnp.int32))
 
 
@@ -147,13 +315,23 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
         fname, col, source, extra = spec
         if fname == "count":
             outs[f"agg{i}"] = mask.sum(dtype=jnp.int32)
+        elif fname in ("sum", "avg") and source == "sv" and \
+                isinstance(extra, tuple) and extra[0] == "parts":
+            # exact integer sum: bit-sliced part lanes, tree reductions
+            outs[f"agg{i}.parts"] = _part_sums(cols[f"{col}.parts"], mask)
+            outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
+        elif fname in ("sum", "avg") and source == "sv" and \
+                isinstance(extra, tuple) and extra[0] == "vlane":
+            # float dictionary values: decoded value lane, chunked f32/f64
+            outs[f"agg{i}.vsum"] = _chunked_float_sum(cols[f"{col}.vlane"],
+                                                      mask)
+            outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
         elif fname in ("sum", "avg", "distinctcount", "percentile") and \
                 source == "sv":
-            card_pad = extra
+            card_pad = extra[1] if isinstance(extra, tuple) else extra
             hk = (col, card_pad)
             if hk not in hists:
                 hists[hk] = _histogram(cols, col, card_pad, mask)
-            # sum/avg: host does the f64 histogram·dictionary dot;
             # percentile: host walks the value-count CDF; distinctcount:
             # host needs the value set anyway for cross-segment merge
             outs[f"agg{i}"] = hists[hk]
@@ -181,7 +359,7 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
             else:
                 raise ValueError(f"unsupported MV aggregation {fname}")
         elif fname in ("min", "max", "minmaxrange") and source == "sv":
-            card_pad = extra
+            card_pad = extra[1] if isinstance(extra, tuple) else extra
             ids = cols[f"{col}.ids"]
             if fname in ("min", "minmaxrange"):
                 outs[f"agg{i}.min"] = jnp.where(mask, ids, card_pad).min()
@@ -190,11 +368,9 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
         elif fname in ("sum", "avg", "min", "max", "minmaxrange") and \
                 source == "raw":
             vals = cols[f"{col}.raw"]
-            acc = sum_dtype()
             if fname in ("sum", "avg"):
-                outs[f"agg{i}"] = jnp.where(mask, vals, 0).sum(dtype=acc)
-                if fname == "avg":
-                    outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
+                outs[f"agg{i}.vsum"] = _chunked_float_sum(vals, mask)
+                outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
             if fname in ("min", "minmaxrange"):
                 outs[f"agg{i}.min"] = jnp.where(mask, vals,
                                                 jnp.inf).min()
@@ -221,28 +397,66 @@ def _group_outputs(group_spec, cols, mask, num_docs):
         term = cols[f"{c}.ids"].astype(jnp.int32) * np.int32(s)
         key = term if key is None else key + term
     key = jnp.clip(key, 0, g_pad - 1)
-    outs = {
-        "group.count": jnp.zeros(g_pad, jnp.int32).at[key].add(
-            mask.astype(jnp.int32))
-    }
+    dense = g_pad <= DENSE_G_LIMIT and mask.shape[0] <= DENSE_ROWS_LIMIT
+    if dense:
+        outs = {"group.count": _dense_group_count(key, mask, g_pad)}
+    else:
+        outs = {"group.count": jnp.zeros(g_pad, jnp.int32).at[key].add(
+            mask.astype(jnp.int32))}
+    acc = sum_dtype()
     for i, spec in enumerate(agg_specs):
         fname, col, source, extra = spec
         if fname == "count":
             continue  # shares group.count
-        if source == "sv":
-            vals = cols[f"{col}.vals"][cols[f"{col}.ids"]]
-        else:
-            vals = cols[f"{col}.raw"]
-        acc = sum_dtype()
+        strategy = extra[0] if isinstance(extra, tuple) else "vals"
         if fname in ("sum", "avg"):
-            contrib = jnp.where(mask, vals.astype(acc), 0)
-            outs[f"gagg{i}.sum"] = jnp.zeros(g_pad, acc).at[key].add(contrib)
-        if fname in ("min", "minmaxrange"):
-            v = jnp.where(mask, vals.astype(acc), jnp.inf)
-            outs[f"gagg{i}.min"] = jnp.full(g_pad, jnp.inf, acc).at[key].min(v)
-        if fname in ("max", "minmaxrange"):
-            v = jnp.where(mask, vals.astype(acc), -jnp.inf)
-            outs[f"gagg{i}.max"] = jnp.full(g_pad, -jnp.inf, acc).at[key].max(v)
+            if strategy == "psums":
+                # exact: one-hot MXU matmul over int8 part lanes
+                outs[f"gagg{i}.psums"] = _dense_group_part_sums(
+                    cols[f"{col}.parts"], key, mask, g_pad)
+            elif strategy == "csums":
+                lane = cols[f"{col}.vlane" if source == "sv"
+                            else f"{col}.raw"]
+                outs[f"gagg{i}.csums"] = _dense_group_float_sums(
+                    lane, key, mask, g_pad)
+            else:  # scatter fallback (huge group tables)
+                if source == "sv":
+                    vals = cols[f"{col}.vals"][cols[f"{col}.ids"]]
+                else:
+                    vals = cols[f"{col}.raw"]
+                contrib = jnp.where(mask, vals.astype(acc), 0)
+                outs[f"gagg{i}.sum"] = jnp.zeros(g_pad, acc).at[key].add(
+                    contrib)
+        if fname in ("min", "max", "minmaxrange"):
+            if source == "sv":
+                card_pad = extra[1]
+                ids = cols[f"{col}.ids"]
+                if fname in ("min", "minmaxrange"):
+                    outs[f"gagg{i}.min"] = (
+                        _dense_group_extreme(ids, key, mask, g_pad,
+                                             np.int32(card_pad), True)
+                        if dense else jnp.full(g_pad, card_pad, jnp.int32)
+                        .at[key].min(jnp.where(mask, ids, card_pad)))
+                if fname in ("max", "minmaxrange"):
+                    outs[f"gagg{i}.max"] = (
+                        _dense_group_extreme(ids, key, mask, g_pad,
+                                             np.int32(-1), False)
+                        if dense else jnp.full(g_pad, -1, jnp.int32)
+                        .at[key].max(jnp.where(mask, ids, -1)))
+            else:
+                vals = cols[f"{col}.raw"].astype(acc)
+                if fname in ("min", "minmaxrange"):
+                    outs[f"gagg{i}.min"] = (
+                        _dense_group_extreme(vals, key, mask, g_pad,
+                                             acc(np.inf), True)
+                        if dense else jnp.full(g_pad, jnp.inf, acc)
+                        .at[key].min(jnp.where(mask, vals, jnp.inf)))
+                if fname in ("max", "minmaxrange"):
+                    outs[f"gagg{i}.max"] = (
+                        _dense_group_extreme(vals, key, mask, g_pad,
+                                             acc(-np.inf), False)
+                        if dense else jnp.full(g_pad, -jnp.inf, acc)
+                        .at[key].max(jnp.where(mask, vals, -jnp.inf)))
         if fname not in ("sum", "avg", "min", "max", "minmaxrange"):
             raise ValueError(f"unsupported group-by aggregation {fname}")
     return outs

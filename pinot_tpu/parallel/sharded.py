@@ -60,11 +60,13 @@ def make_mesh(devices: Optional[Sequence] = None,
 def _combine_kind(key: str) -> str:
     if key.startswith("sel."):
         return "stack"          # per-segment; host merges selection rows
+    if key.endswith((".parts", ".vsum", ".psums", ".csums")):
+        return "stack"          # chunk partials: host combines in int64/f64
     if key.endswith(".min"):
         return "min"
     if key.endswith(".max"):
         return "max"
-    return "sum"                # counts, histograms, group tables, sums
+    return "sum"                # counts, histograms, group tables
 
 
 @functools.lru_cache(maxsize=256)
@@ -171,7 +173,7 @@ class StackedSegments:
         key = (col, kind)
         if key in self._lanes:
             return self._lanes[key]
-        if kind in ("ids", "mv", "vals"):
+        if kind in ("ids", "mv", "vals", "parts", "vlane"):
             self._check_shared_dictionary(col)
         arrs = [s.data_source(col).host_operand(kind) for s in self.segments]
         if kind == "vals":
@@ -203,7 +205,8 @@ class StackedSegments:
         cols = {}
         for col, kind in needed_cols:
             cols[{"ids": f"{col}.ids", "vals": f"{col}.vals",
-                  "raw": f"{col}.raw", "mv": f"{col}.mv"}[kind]] = \
+                  "raw": f"{col}.raw", "mv": f"{col}.mv",
+                  "parts": f"{col}.parts", "vlane": f"{col}.vlane"}[kind]] = \
                 self.lane(col, kind)
         return cols
 

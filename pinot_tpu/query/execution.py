@@ -38,6 +38,10 @@ def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
             cols[f"{col}.raw"] = ds.device_raw_values()
         elif kind == "mv":
             cols[f"{col}.mv"] = ds.device_mv_dict_ids()
+        elif kind == "parts":
+            cols[f"{col}.parts"] = ds.device_part_lanes()
+        elif kind == "vlane":
+            cols[f"{col}.vlane"] = ds.device_value_lane()
     return cols
 
 
@@ -89,8 +93,22 @@ def _finish_aggregation(plan, outs, blk) -> None:
     for i, (f, spec) in enumerate(zip(plan.functions, plan.agg_specs)):
         fname, col, source, extra = spec
         base = f.info.base
+        strategy = extra[0] if isinstance(extra, tuple) else None
         if fname in ("count", "countmv"):
             inters.append(int(outs[f"agg{i}"]))
+        elif source == "sv" and fname in ("sum", "avg") and \
+                strategy in ("parts", "vlane"):
+            cnt = int(outs[f"agg{i}.count"])
+            if strategy == "parts":
+                arr = np.asarray(outs[f"agg{i}.parts"])
+                arr = arr.reshape(-1, arr.shape[-1]).astype(np.int64).sum(0)
+                _, min_v = plan.segment.data_source(col).int_part_info()
+                total = sum(int(arr[k]) << (7 * k) for k in range(len(arr)))
+                s = float(total + min_v * cnt)
+            else:
+                s = float(np.asarray(outs[f"agg{i}.vsum"],
+                                     dtype=np.float64).sum())
+            inters.append(s if fname == "sum" else (s, cnt))
         elif source in ("sv", "mv") and fname in (
                 "sum", "avg", "percentile", "distinctcount"):
             dict_vals = plan.segment.data_source(col).dictionary.values
@@ -106,9 +124,11 @@ def _finish_aggregation(plan, outs, blk) -> None:
                 None if mx is None else int(mx), dict_vals))
         elif source == "raw":
             if fname == "sum":
-                inters.append(float(outs[f"agg{i}"]))
+                inters.append(float(np.asarray(outs[f"agg{i}.vsum"],
+                                               dtype=np.float64).sum()))
             elif fname == "avg":
-                inters.append((float(outs[f"agg{i}"]),
+                inters.append((float(np.asarray(outs[f"agg{i}.vsum"],
+                                                dtype=np.float64).sum()),
                                int(outs[f"agg{i}.count"])))
             elif fname in ("min", "max", "minmaxrange"):
                 mn = outs.get(f"agg{i}.min")
@@ -143,28 +163,68 @@ def _finish_group_by(plan, outs, blk) -> None:
         id_cols.append((keys // stride) % card)
     value_cols = [d.decode(ids) for d, ids in zip(dicts, id_cols)]
 
+    def _sum_array(i, spec):
+        """Exact f64 per-group sums from the device partials."""
+        fname, col, source, extra = spec
+        strategy = extra[0] if isinstance(extra, tuple) else None
+        if strategy == "psums":
+            arr = np.asarray(outs[f"gagg{i}.psums"])
+            if arr.ndim == 3:                  # sharded: [S, n_parts, G]
+                arr = arr.astype(np.int64).sum(0)
+            arr = arr.astype(np.int64)
+            _, min_v = plan.segment.data_source(col).int_part_info()
+            shifts = np.left_shift(np.int64(1),
+                                   7 * np.arange(arr.shape[0],
+                                                 dtype=np.int64))
+            totals = (arr * shifts[:, None]).sum(0)
+            totals = totals + np.int64(min_v) * counts.astype(np.int64)
+            return totals[nz].astype(np.float64)
+        if strategy == "csums":
+            arr = np.asarray(outs[f"gagg{i}.csums"], dtype=np.float64)
+            if arr.ndim == 2:                  # sharded: [S, G]
+                arr = arr.sum(0)
+            return arr[nz]
+        return np.asarray(outs[f"gagg{i}.sum"])[nz]
+
+    def _extreme_array(i, spec, which):
+        """Per-group min/max as float values (inf sentinels when empty)."""
+        fname, col, source, extra = spec
+        arr = np.asarray(outs[f"gagg{i}.{which}"])[nz]
+        if source == "sv" and isinstance(extra, tuple) and extra[0] == "ids":
+            _, card_pad = extra
+            vals = plan.segment.data_source(col).dictionary.values
+            card = len(vals)
+            if which == "min":
+                valid = arr < card
+                sentinel = np.inf
+            else:
+                valid = arr >= 0
+                sentinel = -np.inf
+            out = np.full(len(arr), sentinel)
+            safe = np.clip(arr, 0, card - 1)
+            out[valid] = np.asarray(vals, dtype=np.float64)[safe][valid]
+            return out
+        return arr
+
     per_agg_arrays = []
     for i, spec in enumerate(agg_specs):
-        fname, col, source, extra = spec
+        fname = spec[0]
         if fname == "count":
             per_agg_arrays.append(("count", counts[nz], None))
-        elif fname in ("sum",):
-            per_agg_arrays.append(("sum",
-                                   np.asarray(outs[f"gagg{i}.sum"])[nz], None))
+        elif fname == "sum":
+            per_agg_arrays.append(("sum", _sum_array(i, spec), None))
         elif fname == "avg":
-            per_agg_arrays.append(("avg",
-                                   np.asarray(outs[f"gagg{i}.sum"])[nz],
-                                   counts[nz]))
+            per_agg_arrays.append(("avg", _sum_array(i, spec), counts[nz]))
         elif fname == "min":
-            per_agg_arrays.append(("min",
-                                   np.asarray(outs[f"gagg{i}.min"])[nz], None))
+            per_agg_arrays.append(("min", _extreme_array(i, spec, "min"),
+                                   None))
         elif fname == "max":
-            per_agg_arrays.append(("max",
-                                   np.asarray(outs[f"gagg{i}.max"])[nz], None))
+            per_agg_arrays.append(("max", _extreme_array(i, spec, "max"),
+                                   None))
         elif fname == "minmaxrange":
             per_agg_arrays.append(("minmaxrange",
-                                   np.asarray(outs[f"gagg{i}.min"])[nz],
-                                   np.asarray(outs[f"gagg{i}.max"])[nz]))
+                                   _extreme_array(i, spec, "min"),
+                                   _extreme_array(i, spec, "max")))
         else:
             raise ValueError(fname)
 

@@ -394,7 +394,7 @@ class InstancePlanMaker:
         strides = tuple(reversed(strides))
         g_pad = kernels.pow2_bucket(g)
         agg_specs = tuple(
-            _agg_device_spec(f, segment, needed, for_group=True)
+            _agg_device_spec(f, segment, needed, for_group=True, g_pad=g_pad)
             for f in plan.functions)
         plan.group_spec = (tuple(gcols), strides, g_pad, agg_specs)
         plan.group_strides = strides
@@ -440,7 +440,8 @@ class InstancePlanMaker:
 
 
 def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
-                     needed: Dict, for_group: bool = False) -> tuple:
+                     needed: Dict, for_group: bool = False,
+                     g_pad: int = 0) -> tuple:
     base = f.info.base
     if base == "COUNT" and not f.info.is_mv:
         return ("count", "*", "none", None)
@@ -463,13 +464,52 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
             # both take the host fallback path
             raise UnsupportedOnDevice(f"{fname} over no-dictionary column")
         needed[(col, "raw")] = None
+        if for_group and fname in ("sum", "avg") and \
+                segment.padded_docs <= kernels.DENSE_ROWS_LIMIT and \
+                g_pad <= kernels.DENSE_G_LIMIT:
+            return (fname, col, "raw", ("csums",))
         return (fname, col, "raw", None)
     card_pad = kernels.pow2_bucket(cm.cardinality + 1)
     if cm.single_value:
+        # Strategy selection (see kernels.py "TPU reduction strategy"):
+        # integer dict SUM/AVG reads bit-sliced part lanes (exact, no
+        # scatter/gather); float dict SUM/AVG reads a decoded value lane;
+        # DISTINCTCOUNT/PERCENTILE take the histogram (one-hot matmul);
+        # MIN/MAX reduce dictIds. Group-by uses the dense one-hot MXU paths
+        # when the group table and segment size allow, else scatter.
+        is_int_dict = cm.data_type.np_dtype.kind in "iu"
+        dense_ok = segment.padded_docs <= kernels.DENSE_ROWS_LIMIT and \
+            g_pad <= kernels.DENSE_G_LIMIT
+        if for_group:
+            if fname in ("sum", "avg"):
+                if dense_ok and is_int_dict:
+                    needed[(col, "parts")] = None
+                    return (fname, col, "sv", ("psums", card_pad))
+                if dense_ok:
+                    needed[(col, "vlane")] = None
+                    return (fname, col, "sv", ("csums", card_pad))
+                needed[(col, "ids")] = None
+                needed[(col, "vals")] = None
+                return (fname, col, "sv", ("vals", card_pad))
+            needed[(col, "ids")] = None
+            return (fname, col, "sv", ("ids", card_pad))
+        if fname in ("sum", "avg"):
+            if is_int_dict:
+                needed[(col, "parts")] = None
+                return (fname, col, "sv", ("parts", card_pad))
+            # float dictionaries: the MXU histogram + host f64 dot stays
+            # EXACT on device-f32 TPUs; the f32 value-lane sum is only for
+            # cardinalities past the one-hot matmul cap
+            if card_pad <= kernels.DENSE_CARD_LIMIT:
+                needed[(col, "ids")] = None
+                return (fname, col, "sv", ("hist", card_pad))
+            needed[(col, "vlane")] = None
+            return (fname, col, "sv", ("vlane", card_pad))
+        if fname in ("distinctcount", "percentile"):
+            needed[(col, "ids")] = None
+            return (fname, col, "sv", ("hist", card_pad))
         needed[(col, "ids")] = None
-        if for_group and fname in ("sum", "avg", "min", "max", "minmaxrange"):
-            needed[(col, "vals")] = None
-        return (fname, col, "sv", card_pad)
+        return (fname, col, "sv", ("ids", card_pad))
     needed[(col, "mv")] = None
     if for_group:
         raise UnsupportedOnDevice("group-by over MV metric")

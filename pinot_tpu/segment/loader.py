@@ -24,8 +24,9 @@ from pinot_tpu.segment.fwd import (mv_to_padded, read_mv_fwd, read_raw_fwd,
 from pinot_tpu.segment.inverted import InvertedIndexReader
 from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
 
-# Padding block: multiple of the f32 VPU tile (8 x 128 lanes).
-PAD_BLOCK = 1024
+# Padding block == the kernel row-block so blocked reductions/matmuls tile
+# evenly; 8192 = 8 x (8 x 128) VPU tiles.
+from pinot_tpu.ops.kernels import BLOCK as PAD_BLOCK  # noqa: E402
 
 
 def padded_size(n: int, block: int = PAD_BLOCK) -> int:
@@ -52,6 +53,7 @@ class DataSource:
         self.bloom_filter: Optional[BloomFilter] = None
         # device arrays (lazy)
         self._dev: Dict[str, object] = {}
+        self._part_info: Optional[tuple] = None
 
     # -- device access -----------------------------------------------------
     def device_dict_ids(self):
@@ -71,6 +73,29 @@ class DataSource:
 
     def device_raw_values(self):
         return self._device("raw_values", self.host_operand("raw"))
+
+    def device_part_lanes(self):
+        """Bit-sliced int8 part lanes [n_parts, P] for exact integer sums
+        (see kernels.py 'TPU reduction strategy')."""
+        return self._device("part_lanes", self.host_operand("parts"))
+
+    def device_value_lane(self):
+        """Decoded dictionary-value lane [P] for float sums."""
+        return self._device("value_lane", self.host_operand("vlane"))
+
+    def int_part_info(self) -> tuple:
+        """(n_parts, min_value) for the bit-sliced integer sum encoding.
+
+        Values are offset by min_value (so lanes are non-negative) and split
+        into 7-bit slices: value = min_value + sum_k part_k << (7k).
+        """
+        if self._part_info is None:
+            vals = np.asarray(self.dictionary.values, dtype=np.int64)
+            min_v = int(vals[0]) if len(vals) else 0
+            max_off = (int(vals[-1]) - min_v) if len(vals) else 0
+            n_parts = -(-max(1, max_off.bit_length()) // 7)
+            self._part_info = (n_parts, min_v)
+        return self._part_info
 
     def host_operand(self, kind: str) -> np.ndarray:
         """Padded host array for a lane kind ('ids'|'vals'|'raw'|'mv') —
@@ -99,6 +124,20 @@ class DataSource:
                           dtype=np.int32)
             out[: arr.shape[0]] = arr
             return out
+        if kind == "parts":
+            n_parts, min_v = self.int_part_info()
+            vals = np.asarray(self.dictionary.values, dtype=np.int64)
+            off = vals - min_v
+            table = np.stack([(off >> (7 * k)) & 0x7F
+                              for k in range(n_parts)]).astype(np.int8)
+            # id == cardinality (row padding) -> all-zero parts
+            table = np.concatenate(
+                [table, np.zeros((n_parts, 1), np.int8)], axis=1)
+            return table[:, self.host_operand("ids")]
+        if kind == "vlane":
+            vals = np.asarray(self.dictionary.values, dtype=np.float64)
+            vals = np.concatenate([vals, [0.0]])
+            return vals[self.host_operand("ids")]
         raise ValueError(kind)
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:

@@ -1,0 +1,241 @@
+"""Synthetic data generators: in-memory segments for benchmarks & dryruns.
+
+Parity: the reference's data-generation tooling
+(pinot-tools/.../tools/data/DataGenerator.java and the SSB/TPC-H style
+pinot-druid-benchmark harness, SURVEY.md §6). Builds ImmutableSegment objects
+directly from arrays — no file round-trip — so 100M-row benchmark tables
+materialize in seconds. All segments of a table share one global dictionary
+per column (the layout the mesh-sharded executor combines in the dictId
+domain).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.loader import DataSource, ImmutableSegment
+from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
+
+
+def _bits_for(card: int) -> int:
+    return max(1, int(np.ceil(np.log2(max(card, 2)))))
+
+
+def make_segment_from_arrays(
+        name: str, table: str,
+        dict_cols: Dict[str, Tuple[DataType, np.ndarray, np.ndarray]],
+        raw_cols: Optional[Dict[str, Tuple[DataType, np.ndarray]]] = None,
+        ) -> ImmutableSegment:
+    """Build a queryable in-memory segment.
+
+    dict_cols: col → (data_type, sorted_unique_values, dict_ids[int32])
+    raw_cols:  col → (data_type, values)  (no-dictionary columns)
+    """
+    raw_cols = raw_cols or {}
+    num_docs = None
+    columns: Dict[str, ColumnMetadata] = {}
+    sources: Dict[str, DataSource] = {}
+
+    for col, (dt, values, ids) in dict_cols.items():
+        ids = np.ascontiguousarray(ids, dtype=np.int32)
+        if num_docs is None:
+            num_docs = len(ids)
+        assert len(ids) == num_docs, f"column {col} length mismatch"
+        card = len(values)
+        cm = ColumnMetadata(
+            name=col, data_type=dt, cardinality=card,
+            bits_per_element=_bits_for(card), single_value=True,
+            sorted=bool(np.all(ids[1:] >= ids[:-1])) if len(ids) else True,
+            has_dictionary=True,
+            min_value=values[0] if card else None,
+            max_value=values[-1] if card else None,
+            total_number_of_entries=num_docs)
+        ds = DataSource(cm, None)
+        ds.dictionary = Dictionary(dt, values)
+        ds.dict_ids = ids
+        columns[col] = cm
+        sources[col] = ds
+
+    for col, (dt, vals) in raw_cols.items():
+        vals = np.ascontiguousarray(vals)
+        if num_docs is None:
+            num_docs = len(vals)
+        assert len(vals) == num_docs, f"column {col} length mismatch"
+        cm = ColumnMetadata(
+            name=col, data_type=dt, cardinality=num_docs,
+            bits_per_element=vals.dtype.itemsize * 8, single_value=True,
+            sorted=False, has_dictionary=False,
+            min_value=vals.min() if num_docs else None,
+            max_value=vals.max() if num_docs else None,
+            total_number_of_entries=num_docs)
+        ds = DataSource(cm, None)
+        ds.raw_values = vals
+        columns[col] = cm
+        sources[col] = ds
+
+    meta = SegmentMetadata(segment_name=name, table_name=table,
+                           total_docs=int(num_docs), columns=columns)
+    seg = ImmutableSegment(meta, sources)
+    for ds in sources.values():
+        ds._segment = seg
+    return seg
+
+
+# ---------------------------------------------------------------------------
+# SSB-style star-schema table (denormalized lineorder, the shape the
+# pinot-druid benchmark queries — contrib/pinot-druid-benchmark)
+# ---------------------------------------------------------------------------
+
+SSB_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+SSB_NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "CHINA", "EGYPT",
+               "ETHIOPIA", "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN",
+               "IRAQ", "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE",
+               "PERU", "ROMANIA", "RUSSIA", "SAUDI ARABIA", "UNITED KINGDOM",
+               "UNITED STATES", "VIETNAM"]
+
+
+SSB_TYPES = {
+    "lo_quantity": DataType.INT, "lo_discount": DataType.INT,
+    "lo_revenue": DataType.LONG, "lo_supplycost": DataType.DOUBLE,
+    "d_year": DataType.INT, "d_yearmonthnum": DataType.INT,
+    "c_region": DataType.STRING, "s_nation": DataType.STRING,
+    "p_brand": DataType.STRING,
+}
+SSB_RAW_COLS = {"lo_supplycost"}
+
+
+def ssb_pools(seed: int = 0) -> Dict[str, np.ndarray]:
+    """Sorted global value pools (== the shared dictionaries)."""
+    rng = np.random.default_rng(seed + 10_007)
+    revenue = np.unique((rng.integers(100, 10_000, 8192) * 100)
+                        .astype(np.int64))
+    ymn = np.array(sorted(y * 100 + m for y in range(1992, 1999)
+                          for m in range(1, 13)), dtype=np.int64)
+    return {
+        "lo_quantity": np.arange(1, 51, dtype=np.int64),
+        "lo_discount": np.arange(0, 11, dtype=np.int64),
+        "lo_revenue": revenue,
+        "d_year": np.arange(1992, 1999, dtype=np.int64),
+        "d_yearmonthnum": ymn,
+        "c_region": np.array(sorted(SSB_REGIONS), dtype=object),
+        "s_nation": np.array(sorted(SSB_NATIONS), dtype=object),
+        "p_brand": np.array([f"MFGR#{i:04d}" for i in range(1000)],
+                            dtype=object),
+    }
+
+
+class SsbTable:
+    """Generated table: segments + id-level host arrays for oracle math.
+
+    Oracle checks run on the int32 id arrays (decode via `pools`) so 100M-row
+    tables never materialize 100M python-object string columns host-side.
+    """
+
+    def __init__(self, segments, pools, ids, supplycost):
+        self.segments = segments
+        self.pools = pools            # col → sorted values (the dictionary)
+        self.ids = ids                # col → int32 [total_rows]
+        self.supplycost = supplycost  # raw float64 [total_rows]
+
+    def id_of(self, col: str, value) -> int:
+        i = int(np.searchsorted(self.pools[col], value))
+        assert self.pools[col][i] == value
+        return i
+
+    def decoded(self, col: str) -> np.ndarray:
+        if col == "lo_supplycost":
+            return self.supplycost
+        return self.pools[col][self.ids[col]]
+
+
+def make_ssb_device_stack(total_rows: int, num_segments: int, mesh,
+                          seed: int = 0):
+    """Device-generated stacked SSB lanes for large-scale benchmarking.
+
+    Host->device bandwidth can be the bottleneck for huge synthetic tables
+    (notably through the test harness's TPU relay), so the column lanes are
+    synthesized directly in HBM with jax PRNG — same pools/cardinalities/
+    distributions as make_ssb_segments, different values. Returns
+    (lanes, num_docs_sharded, plan_table) where `lanes` maps
+    "col.ids"/"col.parts"/"col.raw" to [S, P] device arrays sharded over the
+    mesh's `seg` axis, and `plan_table` is a tiny host SsbTable with the
+    same dictionaries for building plans/params.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pinot_tpu.parallel.sharded import SEG_AXIS
+    from pinot_tpu.segment.loader import padded_size
+
+    pools = ssb_pools(seed)
+    per = total_rows // num_segments
+    padded = padded_size(per)
+    shard = NamedSharding(mesh, P(SEG_AXIS))
+    n_dev = mesh.devices.size
+    s_total = -(-num_segments // n_dev) * n_dev
+
+    key = jax.random.PRNGKey(seed)
+    lanes = {}
+    for c, pool in pools.items():
+        key, sub = jax.random.split(key)
+        arr = jax.random.randint(sub, (s_total, padded), 0, len(pool),
+                                 dtype=jnp.int32)
+        lanes[f"{c}.ids"] = jax.device_put(arr, shard)
+
+    # bit-sliced part lanes for the integer SUM metric (lo_revenue)
+    plan_table = make_ssb_segments(max(BLOCK_ROWS, 2 * padded_size(1)),
+                                   1, seed=seed)
+    ds = plan_table.segments[0].data_source("lo_revenue")
+    n_parts, _ = ds.int_part_info()
+    vals = np.asarray(ds.dictionary.values, dtype=np.int64)
+    off = vals - int(vals[0])
+    table = np.stack([(off >> (7 * k)) & 0x7F
+                      for k in range(n_parts)]).astype(np.int8)
+    table_dev = jnp.asarray(table)
+    rev_ids = lanes["lo_revenue.ids"]
+    parts = jax.jit(
+        lambda ids: jnp.moveaxis(table_dev[:, ids], 1, 0),
+        out_shardings=shard)(rev_ids)
+    lanes["lo_revenue.parts"] = parts
+
+    key, sub = jax.random.split(key)
+    raw = jax.random.uniform(sub, (s_total, padded), jnp.float32) * 1e5
+    lanes["lo_supplycost.raw"] = jax.device_put(raw, shard)
+
+    num_docs = np.zeros(s_total, np.int32)
+    num_docs[:num_segments] = per
+    num_docs_dev = jax.device_put(num_docs, shard)
+    return lanes, num_docs_dev, plan_table, padded
+
+
+BLOCK_ROWS = 16384
+
+
+def make_ssb_segments(total_rows: int, num_segments: int, seed: int = 0
+                      ) -> SsbTable:
+    """num_segments equal slices of an SSB table with GLOBAL dictionaries.
+
+    DictIds are generated directly against pre-sorted pools (no
+    unique/searchsorted pass over the full table — 100M rows materialize in
+    seconds).
+    """
+    rng = np.random.default_rng(seed)
+    pools = ssb_pools(seed)
+    ids = {c: rng.integers(0, len(p), total_rows).astype(np.int32)
+           for c, p in pools.items()}
+    supplycost = (rng.random(total_rows) * 1e5).round(2)
+
+    per = total_rows // num_segments
+    segments = []
+    for i in range(num_segments):
+        lo, hi = i * per, (i + 1) * per if i < num_segments - 1 else total_rows
+        dict_part = {c: (SSB_TYPES[c], pools[c], ids[c][lo:hi])
+                     for c in pools}
+        raw_part = {"lo_supplycost": (DataType.DOUBLE, supplycost[lo:hi])}
+        segments.append(make_segment_from_arrays(
+            f"ssb_{i}", "lineorder", dict_part, raw_part))
+    return SsbTable(segments, pools, ids, supplycost)
