@@ -1,0 +1,15 @@
+from pinot_tpu.broker.quota import HitCounter, QueryQuotaManager
+from pinot_tpu.broker.request_handler import (BrokerRequestHandler,
+                                              InProcessTransport,
+                                              QueryRouter, TcpTransport)
+from pinot_tpu.broker.routing import (BalancedRandomRoutingTableBuilder,
+                                      ReplicaGroupRoutingTableBuilder,
+                                      RoutingManager)
+from pinot_tpu.broker.time_boundary import (TimeBoundaryService,
+                                            attach_time_boundary)
+
+__all__ = ["HitCounter", "QueryQuotaManager", "BrokerRequestHandler",
+           "InProcessTransport", "QueryRouter", "TcpTransport",
+           "BalancedRandomRoutingTableBuilder",
+           "ReplicaGroupRoutingTableBuilder", "RoutingManager",
+           "TimeBoundaryService", "attach_time_boundary"]

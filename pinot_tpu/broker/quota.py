@@ -1,0 +1,65 @@
+"""Per-table QPS quota with a sliding hit counter.
+
+Parity: pinot-broker/.../queryquota/HelixExternalViewBasedQueryQuotaManager
++ HitCounter — per-table max QPS enforced over a rolling window, hits
+bucketed per 100ms.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+BUCKETS = 10
+BUCKET_MS = 100
+
+
+class HitCounter:
+    def __init__(self):
+        self._times = [0] * BUCKETS
+        self._counts = [0] * BUCKETS
+        self._lock = threading.Lock()
+
+    def hit(self, now_ms: Optional[int] = None) -> None:
+        now_ms = int(time.time() * 1e3) if now_ms is None else now_ms
+        idx = (now_ms // BUCKET_MS) % BUCKETS
+        with self._lock:
+            stamp = now_ms // BUCKET_MS
+            if self._times[idx] != stamp:
+                self._times[idx] = stamp
+                self._counts[idx] = 0
+            self._counts[idx] += 1
+
+    def hits_in_window(self, now_ms: Optional[int] = None) -> int:
+        now_ms = int(time.time() * 1e3) if now_ms is None else now_ms
+        lo = now_ms // BUCKET_MS - BUCKETS + 1
+        with self._lock:
+            return sum(c for t, c in zip(self._times, self._counts)
+                       if t >= lo)
+
+
+class QueryQuotaManager:
+    def __init__(self):
+        self._quotas: Dict[str, float] = {}
+        self._counters: Dict[str, HitCounter] = {}
+        self._lock = threading.Lock()
+
+    def set_qps_quota(self, table: str, max_qps: Optional[float]) -> None:
+        with self._lock:
+            if max_qps is None:
+                self._quotas.pop(table, None)
+                self._counters.pop(table, None)
+            else:
+                self._quotas[table] = max_qps
+                self._counters.setdefault(table, HitCounter())
+
+    def acquire(self, table: str) -> bool:
+        """Record a hit; False when the table is over quota."""
+        with self._lock:
+            quota = self._quotas.get(table)
+            counter = self._counters.get(table)
+        if quota is None or counter is None:
+            return True
+        counter.hit()
+        window_s = BUCKETS * BUCKET_MS / 1e3
+        return counter.hits_in_window() <= quota * window_s

@@ -1,0 +1,118 @@
+"""Broker routing: external view → pre-computed routing tables.
+
+Parity: pinot-broker/.../routing/ — HelixExternalViewBasedRouting.java:70
+(rebuild on external-view change) + builder/BaseRoutingTableBuilder
+(N pre-computed routing tables, random pick per query) +
+BalancedRandomRoutingTableBuilder.java:36 and the partition-aware variants
+(PartitionAwareOfflineRoutingTableBuilder.java:69 — replica-group style
+server selection per query instead of per segment).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+from pinot_tpu.common.cluster_state import CONSUMING, ONLINE, TableView
+
+RoutingTable = Dict[str, List[str]]          # server -> segments
+
+
+class RoutingError(Exception):
+    pass
+
+
+class RoutingTableBuilder:
+    def build(self, view: TableView, rng: random.Random
+              ) -> List[RoutingTable]:
+        raise NotImplementedError
+
+
+class BalancedRandomRoutingTableBuilder(RoutingTableBuilder):
+    """Per segment, pick a random live replica; balance by least-loaded
+    among a random sample. N tables are pre-computed; queries pick one."""
+
+    def __init__(self, num_tables: int = 10):
+        self.num_tables = num_tables
+
+    def build(self, view: TableView, rng: random.Random
+              ) -> List[RoutingTable]:
+        tables: List[RoutingTable] = []
+        for _ in range(self.num_tables):
+            rt: RoutingTable = {}
+            for segment in view.segments():
+                servers = view.servers_for(segment, states=(ONLINE,
+                                                            CONSUMING))
+                if not servers:
+                    continue         # no live replica: skip segment
+                candidates = rng.sample(servers, min(2, len(servers)))
+                best = min(candidates, key=lambda s: len(rt.get(s, [])))
+                rt.setdefault(best, []).append(segment)
+            tables.append(rt)
+        return tables
+
+
+class ReplicaGroupRoutingTableBuilder(RoutingTableBuilder):
+    """Confine each routing table to one 'replica group': every segment is
+    served by the same replica index where possible (reference's
+    partition-aware/replica-group builders reduce fan-out variance)."""
+
+    def __init__(self, num_tables: int = 10):
+        self.num_tables = num_tables
+
+    def build(self, view: TableView, rng: random.Random
+              ) -> List[RoutingTable]:
+        max_replicas = max((len(view.servers_for(s))
+                            for s in view.segments()), default=1)
+        tables: List[RoutingTable] = []
+        for i in range(self.num_tables):
+            replica = i % max(max_replicas, 1)
+            rt: RoutingTable = {}
+            for segment in view.segments():
+                servers = view.servers_for(segment)
+                if not servers:
+                    continue
+                server = servers[replica % len(servers)]
+                rt.setdefault(server, []).append(segment)
+            tables.append(rt)
+        return tables
+
+
+class RoutingManager:
+    """Holds current routing tables per physical table; rebuilds on
+    external-view changes (parity: processExternalViewChange :418)."""
+
+    def __init__(self, builder: Optional[RoutingTableBuilder] = None,
+                 seed: int = 0):
+        self.builder = builder or BalancedRandomRoutingTableBuilder()
+        self._tables: Dict[str, List[RoutingTable]] = {}
+        self._views: Dict[str, TableView] = {}
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def update_view(self, view: TableView) -> None:
+        tables = self.builder.build(view, self._rng)
+        with self._lock:
+            self._views[view.table_name] = view.copy()
+            self._tables[view.table_name] = tables
+
+    def remove_table(self, table_name: str) -> None:
+        with self._lock:
+            self._tables.pop(table_name, None)
+            self._views.pop(table_name, None)
+
+    def has_table(self, table_name: str) -> bool:
+        with self._lock:
+            return bool(self._tables.get(table_name))
+
+    def route(self, table_name: str) -> RoutingTable:
+        with self._lock:
+            tables = self._tables.get(table_name)
+            if not tables:
+                raise RoutingError(f"no routing table for {table_name}")
+            return self._rng.choice(tables)
+
+    def view(self, table_name: str) -> Optional[TableView]:
+        with self._lock:
+            v = self._views.get(table_name)
+            return v.copy() if v is not None else None

@@ -1,0 +1,75 @@
+"""Hybrid-table time boundary: split queries between offline and realtime.
+
+Parity: pinot-broker/.../routing/HelixExternalViewBasedTimeBoundaryService
+.java:95-132 — boundary = max end time across offline segments minus one
+time-unit day (minus one hour for HOURLY-push tables); the offline
+sub-query gets ``time <= boundary`` and the realtime one ``time > boundary``
+(attach at BaseBrokerRequestHandler.java:430).
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Dict, Optional
+
+from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
+                                      FilterQueryTree)
+
+_UNIT_MS = {
+    "MILLISECONDS": 1, "SECONDS": 1000, "MINUTES": 60_000,
+    "HOURS": 3_600_000, "DAYS": 86_400_000,
+}
+
+
+class TimeBoundaryInfo:
+    def __init__(self, column: str, value: int):
+        self.column = column
+        self.value = value
+
+
+class TimeBoundaryService:
+    def __init__(self):
+        self._boundaries: Dict[str, TimeBoundaryInfo] = {}
+        self._lock = threading.Lock()
+
+    def update_from_segments(self, offline_table: str, time_column: str,
+                             time_unit: str, segment_end_times,
+                             hourly_push: bool = False) -> None:
+        ends = [e for e in segment_end_times if e is not None]
+        if not ends:
+            return
+        max_end = max(int(e) for e in ends)
+        unit_ms = _UNIT_MS.get((time_unit or "DAYS").upper(), 86_400_000)
+        delta = (3_600_000 if hourly_push else 86_400_000) // unit_ms
+        boundary = max_end - max(delta, 1)
+        with self._lock:
+            self._boundaries[offline_table] = TimeBoundaryInfo(time_column,
+                                                               boundary)
+
+    def get(self, offline_table: str) -> Optional[TimeBoundaryInfo]:
+        with self._lock:
+            return self._boundaries.get(offline_table)
+
+    def remove(self, offline_table: str) -> None:
+        with self._lock:
+            self._boundaries.pop(offline_table, None)
+
+
+def attach_time_boundary(request: BrokerRequest, info: TimeBoundaryInfo,
+                         offline: bool) -> BrokerRequest:
+    """Copy the request with the boundary filter AND'ed in."""
+    out = copy.deepcopy(request)
+    if offline:
+        bound = FilterQueryTree(
+            operator=FilterOperator.RANGE, column=info.column,
+            lower=None, upper=str(info.value), upper_inclusive=True)
+    else:
+        bound = FilterQueryTree(
+            operator=FilterOperator.RANGE, column=info.column,
+            lower=str(info.value), lower_inclusive=False, upper=None)
+    if out.filter is None:
+        out.filter = bound
+    else:
+        out.filter = FilterQueryTree(operator=FilterOperator.AND,
+                                     children=[out.filter, bound])
+    return out

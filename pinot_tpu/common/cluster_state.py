@@ -1,0 +1,55 @@
+"""Cluster state model: ideal state + external view.
+
+Parity: Helix's IdealState / ExternalView records as used by Pinot
+(docs/architecture.rst:35-120 — table = resource, segment = partition,
+server instances mapped to states ONLINE/OFFLINE/CONSUMING/ERROR). The
+controller writes ideal states; servers converge and report; brokers build
+routing tables from external views. Here both are plain mappings published
+through a PropertyStore (controller plane) or handed directly to the broker
+in embedded setups.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+ONLINE = "ONLINE"
+OFFLINE = "OFFLINE"
+CONSUMING = "CONSUMING"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class TableView:
+    """segment -> instance -> state, for one physical table."""
+    table_name: str
+    segment_states: Dict[str, Dict[str, str]] = dataclasses.field(
+        default_factory=dict)
+
+    def segments(self) -> List[str]:
+        return list(self.segment_states.keys())
+
+    def servers_for(self, segment: str, states=(ONLINE, CONSUMING)
+                    ) -> List[str]:
+        return sorted(inst for inst, st in
+                      self.segment_states.get(segment, {}).items()
+                      if st in states)
+
+    def all_servers(self) -> List[str]:
+        out = set()
+        for m in self.segment_states.values():
+            out.update(m.keys())
+        return sorted(out)
+
+    def copy(self) -> "TableView":
+        return TableView(self.table_name,
+                         {s: dict(m) for s, m in
+                          self.segment_states.items()})
+
+    def to_json(self) -> dict:
+        return {"table": self.table_name, "segments": self.segment_states}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TableView":
+        return cls(d["table"], {s: dict(m)
+                                for s, m in d.get("segments", {}).items()})

@@ -1,0 +1,144 @@
+"""DataTable: the server→broker result wire format.
+
+Parity: pinot-common/.../utils/DataTable.java + DataTableImplV2.java:40-263 —
+version, metadata map, exceptions, schema (column names/types), row payload —
+rebuilt as a tagged binary format on top of the typed object serde
+(common/serde.py) instead of the reference's fixed+variable byte regions.
+
+Three logical layouts mirror IntermediateResultsBlock's payloads:
+- aggregation-only: one row, one object cell per aggregation function
+- group-by: one row per group, key columns + intermediate object columns
+- selection: one row per selected doc
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, List
+
+from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.serde import obj_from_bytes, obj_to_bytes
+from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
+
+_U32 = struct.Struct(">I")
+VERSION = 1
+
+KIND_EMPTY = 0
+KIND_AGGREGATION = 1
+KIND_GROUP_BY = 2
+KIND_SELECTION = 3
+
+
+@dataclasses.dataclass
+class DataTable:
+    kind: int = KIND_EMPTY
+    columns: List[str] = dataclasses.field(default_factory=list)
+    rows: List[tuple] = dataclasses.field(default_factory=list)
+    num_group_cols: int = 0
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+    exceptions: List[str] = dataclasses.field(default_factory=list)
+
+    # -- wire format -------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        out += _U32.pack(VERSION)
+        out += bytes([self.kind])
+        out += _U32.pack(self.num_group_cols)
+        _w_obj(out, self.metadata)
+        _w_obj(out, list(self.exceptions))
+        _w_obj(out, list(self.columns))
+        out += _U32.pack(len(self.rows))
+        for row in self.rows:
+            _w_obj(out, tuple(row))
+        return bytes(out)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "DataTable":
+        off = 0
+        version = _U32.unpack_from(b, off)[0]
+        off += 4
+        if version != VERSION:
+            raise ValueError(f"unsupported DataTable version {version}")
+        kind = b[off]
+        off += 1
+        num_group_cols = _U32.unpack_from(b, off)[0]
+        off += 4
+        metadata, off = _r_obj(b, off)
+        exceptions, off = _r_obj(b, off)
+        columns, off = _r_obj(b, off)
+        n_rows = _U32.unpack_from(b, off)[0]
+        off += 4
+        rows = []
+        for _ in range(n_rows):
+            row, off = _r_obj(b, off)
+            rows.append(row)
+        return cls(kind=kind, columns=list(columns), rows=rows,
+                   num_group_cols=num_group_cols,
+                   metadata=dict(metadata), exceptions=list(exceptions))
+
+    # -- block conversion --------------------------------------------------
+    @classmethod
+    def from_block(cls, request: BrokerRequest,
+                   block: IntermediateResultsBlock) -> "DataTable":
+        dt = cls(metadata=block.stats.to_metadata(),
+                 exceptions=list(block.exceptions))
+        dt.metadata["timeUsedMs"] = f"{block.stats.time_used_ms:.3f}"
+        # numpy-scalar normalization happens inside serde._write_obj, so
+        # rows can carry intermediates as-is
+        if block.group_map is not None:
+            dt.kind = KIND_GROUP_BY
+            gcols = request.group_by.columns if request.group_by else []
+            dt.num_group_cols = len(gcols)
+            dt.columns = list(gcols) + [a.call for a in request.aggregations]
+            dt.rows = [tuple(key) + tuple(inters)
+                       for key, inters in block.group_map.items()]
+        elif block.agg_intermediates is not None:
+            dt.kind = KIND_AGGREGATION
+            dt.columns = [a.call for a in request.aggregations]
+            dt.rows = [tuple(block.agg_intermediates)]
+        elif block.selection_rows is not None:
+            dt.kind = KIND_SELECTION
+            dt.columns = list(block.selection_columns or [])
+            dt.rows = [tuple(row) for row in block.selection_rows]
+        return dt
+
+    def to_block(self) -> IntermediateResultsBlock:
+        blk = IntermediateResultsBlock(exceptions=list(self.exceptions))
+        blk.stats = _stats_from_metadata(self.metadata)
+        if self.kind == KIND_GROUP_BY:
+            g = self.num_group_cols
+            blk.group_map = {tuple(row[:g]): list(row[g:])
+                             for row in self.rows}
+        elif self.kind == KIND_AGGREGATION:
+            blk.agg_intermediates = list(self.rows[0]) if self.rows else None
+        elif self.kind == KIND_SELECTION:
+            blk.selection_rows = [tuple(r) for r in self.rows]
+            blk.selection_columns = list(self.columns)
+        return blk
+
+
+def _stats_from_metadata(md: Dict[str, str]) -> ExecutionStats:
+    def gi(k):
+        return int(md.get(k, "0"))
+
+    return ExecutionStats(
+        num_docs_scanned=gi("numDocsScanned"),
+        num_entries_scanned_in_filter=gi("numEntriesScannedInFilter"),
+        num_entries_scanned_post_filter=gi("numEntriesScannedPostFilter"),
+        num_segments_processed=gi("numSegmentsProcessed"),
+        num_segments_matched=gi("numSegmentsMatched"),
+        total_docs=gi("totalDocs"),
+        num_groups_limit_reached=md.get("numGroupsLimitReached") == "true",
+        time_used_ms=float(md.get("timeUsedMs", "0")))
+
+
+def _w_obj(out: bytearray, v) -> None:
+    b = obj_to_bytes(v)
+    out += _U32.pack(len(b))
+    out += b
+
+
+def _r_obj(b: bytes, off: int):
+    n = _U32.unpack_from(b, off)[0]
+    off += 4
+    return obj_from_bytes(b[off:off + n]), off + n

@@ -186,6 +186,8 @@ class InstanceRequest:
     """
     request_id: int
     query: BrokerRequest
-    search_segments: List[str] = dataclasses.field(default_factory=list)
+    # None = all hosted segments (embedded/test convenience);
+    # [] = explicitly zero segments; list = exactly those segments
+    search_segments: Optional[List[str]] = None
     enable_trace: bool = False
     broker_id: str = ""

@@ -1,0 +1,272 @@
+"""Wire serde: request JSON tree + typed binary object serde.
+
+Parity: pinot-common's Thrift request serialization (request.thrift via
+TCompactProtocol, ScheduledRequestHandler.java:63) and the typed object
+serde registry (core/common/ObjectSerDeUtils.java:55-83 — AvgPair,
+MinMaxRangePair, HLL, percentile maps...). We use JSON for the request tree
+(control-plane friendly, schema evolvable) and a compact tagged binary
+format for result objects (sets/maps/pairs cross the server→broker wire in
+DataTable cells).
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, List, Optional
+
+from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
+                                      FilterOperator, FilterQueryTree,
+                                      GroupBy, HavingNode, InstanceRequest,
+                                      QueryOptions, Selection, SelectionSort)
+
+# ---------------------------------------------------------------------------
+# Request JSON
+# ---------------------------------------------------------------------------
+
+
+def filter_to_json(n: Optional[FilterQueryTree]) -> Optional[dict]:
+    if n is None:
+        return None
+    return {
+        "op": n.operator.value, "col": n.column, "vals": n.values,
+        "children": [filter_to_json(c) for c in n.children],
+        "lo": n.lower, "hi": n.upper,
+        "loInc": n.lower_inclusive, "hiInc": n.upper_inclusive,
+    }
+
+
+def filter_from_json(d: Optional[dict]) -> Optional[FilterQueryTree]:
+    if d is None:
+        return None
+    return FilterQueryTree(
+        operator=FilterOperator(d["op"]), column=d.get("col"),
+        values=d.get("vals") or [],
+        children=[filter_from_json(c) for c in d.get("children") or []],
+        lower=d.get("lo"), upper=d.get("hi"),
+        lower_inclusive=d.get("loInc", True),
+        upper_inclusive=d.get("hiInc", True))
+
+
+def _having_to_json(n: Optional[HavingNode]) -> Optional[dict]:
+    if n is None:
+        return None
+    return {
+        "op": n.operator.value,
+        "agg": None if n.agg is None else
+        {"fn": n.agg.function_name, "col": n.agg.column},
+        "vals": n.values,
+        "children": [_having_to_json(c) for c in n.children],
+        "lo": n.lower, "hi": n.upper,
+        "loInc": n.lower_inclusive, "hiInc": n.upper_inclusive,
+    }
+
+
+def _having_from_json(d: Optional[dict]) -> Optional[HavingNode]:
+    if d is None:
+        return None
+    agg = d.get("agg")
+    return HavingNode(
+        operator=FilterOperator(d["op"]),
+        agg=None if agg is None else AggregationInfo(agg["fn"], agg["col"]),
+        values=d.get("vals") or [],
+        children=[_having_from_json(c) for c in d.get("children") or []],
+        lower=d.get("lo"), upper=d.get("hi"),
+        lower_inclusive=d.get("loInc", True),
+        upper_inclusive=d.get("hiInc", True))
+
+
+def request_to_json(r: BrokerRequest) -> dict:
+    return {
+        "table": r.table_name,
+        "filter": filter_to_json(r.filter),
+        "aggregations": [{"fn": a.function_name, "col": a.column}
+                         for a in r.aggregations],
+        "groupBy": None if r.group_by is None else
+        {"columns": r.group_by.columns, "topN": r.group_by.top_n},
+        "selection": None if r.selection is None else {
+            "columns": r.selection.columns,
+            "orderBy": [{"col": s.column, "asc": s.ascending}
+                        for s in r.selection.order_by],
+            "offset": r.selection.offset, "size": r.selection.size},
+        "having": _having_to_json(r.having),
+        "options": {"trace": r.query_options.trace,
+                    "timeoutMs": r.query_options.timeout_ms,
+                    "debug": r.query_options.debug_options,
+                    "options": r.query_options.options},
+        "limit": r.limit,
+    }
+
+
+def request_from_json(d: dict) -> BrokerRequest:
+    sel = d.get("selection")
+    gb = d.get("groupBy")
+    opts = d.get("options") or {}
+    return BrokerRequest(
+        table_name=d["table"],
+        filter=filter_from_json(d.get("filter")),
+        aggregations=[AggregationInfo(a["fn"], a["col"])
+                      for a in d.get("aggregations") or []],
+        group_by=None if gb is None else GroupBy(gb["columns"], gb["topN"]),
+        selection=None if sel is None else Selection(
+            columns=sel["columns"],
+            order_by=[SelectionSort(s["col"], s["asc"])
+                      for s in sel.get("orderBy") or []],
+            offset=sel.get("offset", 0), size=sel.get("size", 10)),
+        having=_having_from_json(d.get("having")),
+        query_options=QueryOptions(
+            trace=opts.get("trace", False),
+            timeout_ms=opts.get("timeoutMs"),
+            debug_options=opts.get("debug") or {},
+            options=opts.get("options") or {}),
+        limit=d.get("limit", 10))
+
+
+def instance_request_to_bytes(r: InstanceRequest) -> bytes:
+    return json.dumps({
+        "requestId": r.request_id,
+        "query": request_to_json(r.query),
+        "searchSegments": r.search_segments,
+        "enableTrace": r.enable_trace,
+        "brokerId": r.broker_id,
+    }).encode("utf-8")
+
+
+def instance_request_from_bytes(b: bytes) -> InstanceRequest:
+    d = json.loads(b.decode("utf-8"))
+    return InstanceRequest(
+        request_id=d["requestId"],
+        query=request_from_json(d["query"]),
+        search_segments=d.get("searchSegments"),
+        enable_trace=d.get("enableTrace", False),
+        broker_id=d.get("brokerId", ""))
+
+
+# ---------------------------------------------------------------------------
+# Typed binary object serde (DataTable cells / aggregation intermediates)
+#
+# Tags: N null, i int64, I bigint(str), d float64, s str, b bytes,
+#       t tuple, l list, S set, D dict (sorted by key bytes for determinism)
+# ---------------------------------------------------------------------------
+
+_I64 = struct.Struct(">q")
+_F64 = struct.Struct(">d")
+_U32 = struct.Struct(">I")
+
+
+def obj_to_bytes(v: Any) -> bytes:
+    out = bytearray()
+    _write_obj(out, v)
+    return bytes(out)
+
+
+def obj_from_bytes(b: bytes) -> Any:
+    v, off = _read_obj(b, 0)
+    return v
+
+
+def _write_obj(out: bytearray, v: Any) -> None:
+    import numpy as np
+    if isinstance(v, np.generic):
+        v = v.item()
+    if v is None:
+        out += b"N"
+    elif isinstance(v, bool):
+        out += b"i"
+        out += _I64.pack(int(v))
+    elif isinstance(v, int):
+        if -(2**63) <= v < 2**63:
+            out += b"i"
+            out += _I64.pack(v)
+        else:
+            s = str(v).encode()
+            out += b"I"
+            out += _U32.pack(len(s))
+            out += s
+    elif isinstance(v, float):
+        out += b"d"
+        out += _F64.pack(v)
+    elif isinstance(v, str):
+        s = v.encode("utf-8")
+        out += b"s"
+        out += _U32.pack(len(s))
+        out += s
+    elif isinstance(v, bytes):
+        out += b"b"
+        out += _U32.pack(len(v))
+        out += v
+    elif isinstance(v, tuple):
+        out += b"t"
+        out += _U32.pack(len(v))
+        for x in v:
+            _write_obj(out, x)
+    elif isinstance(v, list):
+        out += b"l"
+        out += _U32.pack(len(v))
+        for x in v:
+            _write_obj(out, x)
+    elif isinstance(v, (set, frozenset)):
+        items = [obj_to_bytes(x) for x in v]
+        items.sort()
+        out += b"S"
+        out += _U32.pack(len(items))
+        for ib in items:
+            out += ib
+    elif isinstance(v, dict):
+        items = sorted((obj_to_bytes(k), obj_to_bytes(x))
+                       for k, x in v.items())
+        out += b"D"
+        out += _U32.pack(len(items))
+        for kb, vb in items:
+            out += kb
+            out += vb
+    else:
+        raise TypeError(f"unserializable object type {type(v)}")
+
+
+def _read_obj(b: bytes, off: int):
+    tag = b[off:off + 1]
+    off += 1
+    if tag == b"N":
+        return None, off
+    if tag == b"i":
+        return _I64.unpack_from(b, off)[0], off + 8
+    if tag == b"I":
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        return int(b[off:off + n].decode()), off + n
+    if tag == b"d":
+        return _F64.unpack_from(b, off)[0], off + 8
+    if tag == b"s":
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        return b[off:off + n].decode("utf-8"), off + n
+    if tag == b"b":
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        return bytes(b[off:off + n]), off + n
+    if tag in (b"t", b"l"):
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        items: List[Any] = []
+        for _ in range(n):
+            v, off = _read_obj(b, off)
+            items.append(v)
+        return (tuple(items) if tag == b"t" else items), off
+    if tag == b"S":
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        out = set()
+        for _ in range(n):
+            v, off = _read_obj(b, off)
+            out.add(v)
+        return out, off
+    if tag == b"D":
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = _read_obj(b, off)
+            v, off = _read_obj(b, off)
+            d[k] = v
+        return d, off
+    raise ValueError(f"bad object tag {tag!r} at {off - 1}")

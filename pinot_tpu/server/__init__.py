@@ -1,0 +1,11 @@
+from pinot_tpu.server.data_manager import (InstanceDataManager,
+                                           SegmentDataManager,
+                                           TableDataManager)
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.server.query_executor import InstanceQueryExecutor
+from pinot_tpu.server.scheduler import (FCFSQueryScheduler,
+                                        TokenBucketScheduler, make_scheduler)
+
+__all__ = ["InstanceDataManager", "SegmentDataManager", "TableDataManager",
+           "ServerInstance", "InstanceQueryExecutor", "FCFSQueryScheduler",
+           "TokenBucketScheduler", "make_scheduler"]

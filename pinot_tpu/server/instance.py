@@ -1,0 +1,71 @@
+"""Server process wiring: data manager + scheduler + executor + transport.
+
+Parity: pinot-server — ServerInstance/ServerBuilder (ServerInstance.java:43:
+InstanceDataManager + QueryExecutor + QueryScheduler + NettyServer) and
+ScheduledRequestHandler.java:40-66 (bytes → deserialize → schedule →
+execute → DataTable bytes).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.serde import instance_request_from_bytes
+from pinot_tpu.server.data_manager import InstanceDataManager
+from pinot_tpu.server.query_executor import InstanceQueryExecutor
+from pinot_tpu.server.scheduler import QueryScheduler, make_scheduler
+from pinot_tpu.transport.tcp import EventLoopThread, QueryServer
+
+
+class ServerInstance:
+    """One query server: hosts segments, answers InstanceRequests."""
+
+    def __init__(self, instance_id: str = "server_0",
+                 scheduler: str = "fcfs", num_workers: int = 4,
+                 mesh=None, use_device: bool = True):
+        self.instance_id = instance_id
+        self.data_manager = InstanceDataManager()
+        self.scheduler: QueryScheduler = make_scheduler(scheduler,
+                                                        num_workers)
+        self.executor = InstanceQueryExecutor(self.data_manager, mesh=mesh,
+                                              use_device=use_device)
+        self._loop: Optional[EventLoopThread] = None
+        self._server: Optional[QueryServer] = None
+        self.port: Optional[int] = None
+
+    # -- in-process path (used by tests and the embedded broker) -----------
+    def handle_request_bytes(self, payload: bytes) -> bytes:
+        try:
+            request = instance_request_from_bytes(payload)
+        except Exception as e:  # noqa: BLE001 — malformed wire payload
+            dt = DataTable()
+            dt.exceptions.append(f"RequestDeserializationError: {e}")
+            return dt.to_bytes()
+        future = self.scheduler.submit(
+            request.query.table_name,
+            lambda: self.executor.execute(request))
+        try:
+            return future.result().to_bytes()
+        except Exception as e:  # noqa: BLE001 — query execution error
+            dt = DataTable()
+            dt.metadata["requestId"] = str(request.request_id)
+            dt.exceptions.append(f"QueryExecutionError: {e}")
+            return dt.to_bytes()
+
+    # -- network service ---------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start the TCP query service; returns the bound port."""
+        self._loop = EventLoopThread()
+        self._server = QueryServer(host, port, self.handle_request_bytes)
+        self._loop.run(self._server.start())
+        self.port = self._server.port
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None and self._loop is not None:
+            self._loop.run(self._server.stop())
+        if self._loop is not None:
+            self._loop.stop()
+            self._loop = None
+        self.scheduler.shutdown()
+        self.data_manager.shutdown()

@@ -1,0 +1,75 @@
+"""Server query executor: acquire → prune → execute → DataTable.
+
+Parity: pinot-core/.../query/executor/ServerQueryExecutorV1Impl.java:100-267
+— refcounted segment acquisition, pruning, per-segment execution (device
+kernels, with the mesh-sharded combine when segments are homogeneous),
+timeout accounting, execution-stats metadata on the DataTable.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.query.blocks import IntermediateResultsBlock
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.server.data_manager import InstanceDataManager
+
+
+class InstanceQueryExecutor:
+    """Executes InstanceRequests against this server's tables."""
+
+    def __init__(self, data_manager: InstanceDataManager,
+                 mesh=None, use_device: bool = True,
+                 default_timeout_ms: float = 15_000.0):
+        self.data_manager = data_manager
+        self.executor = ServerQueryExecutor(use_device=use_device)
+        self.sharded = None
+        if mesh is not None:
+            from pinot_tpu.parallel.sharded import ShardedQueryExecutor
+            self.sharded = ShardedQueryExecutor(mesh=mesh)
+        self.default_timeout_ms = default_timeout_ms
+
+    def execute(self, request: InstanceRequest) -> DataTable:
+        t_start = time.perf_counter()
+        query = request.query
+        timeout_ms = query.query_options.timeout_ms or self.default_timeout_ms
+        tdm = self.data_manager.table(query.table_name)
+        if tdm is None:
+            dt = DataTable()
+            dt.exceptions.append(
+                f"TableDoesNotExistError: {query.table_name}")
+            return dt
+
+        acquired, missing = tdm.acquire_segments(request.search_segments)
+        try:
+            segments = [s.segment for s in acquired]
+            block = self._execute_segments(query, segments)
+            if missing:
+                block.exceptions.append(
+                    f"SegmentMissingError: {sorted(missing)}")
+            elapsed_ms = (time.perf_counter() - t_start) * 1e3
+            if elapsed_ms > timeout_ms:
+                block.exceptions.append(
+                    f"QueryTimeoutError: {elapsed_ms:.0f}ms > "
+                    f"{timeout_ms:.0f}ms")
+            block.stats.time_used_ms = elapsed_ms
+            dt = DataTable.from_block(query, block)
+            dt.metadata["requestId"] = str(request.request_id)
+            return dt
+        finally:
+            for sdm in acquired:
+                tdm.release_segment(sdm)
+
+    def _execute_segments(self, query, segments: List
+                          ) -> IntermediateResultsBlock:
+        if self.sharded is not None and len(segments) > 1:
+            from pinot_tpu.parallel.sharded import NotShardable
+            from pinot_tpu.query.plan import (GroupsLimitExceeded,
+                                              UnsupportedOnDevice)
+            try:
+                return self.sharded.execute(query, segments)
+            except (NotShardable, GroupsLimitExceeded, UnsupportedOnDevice):
+                pass
+        return self.executor.execute(query, segments)

@@ -1,0 +1,103 @@
+"""Query schedulers: FCFS and token-bucket priority.
+
+Parity: pinot-core/.../core/query/scheduler/ — QuerySchedulerFactory
+(algorithms "fcfs" | "tokenbucket", QuerySchedulerFactory.java:40-68),
+PriorityScheduler + TokenSchedulerGroup (token bucket ≈ CPU-ms accounting
+with linear decay, TokenSchedulerGroup.java:31-56), bounded per-group
+concurrency. Execution happens on a thread pool; the device serializes
+kernels anyway, so scheduling decides ORDER and fairness, exactly the
+role it plays in the reference.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, Optional
+
+
+class QueryScheduler:
+    """submit(group, fn) -> Future; subclasses order execution."""
+
+    def __init__(self, num_workers: int = 4):
+        self._pool = ThreadPoolExecutor(max_workers=num_workers)
+        self.num_workers = num_workers
+
+    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class FCFSQueryScheduler(QueryScheduler):
+    """First-come-first-served (the reference default)."""
+
+    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+        return self._pool.submit(fn)
+
+
+class TokenBucketScheduler(QueryScheduler):
+    """Priority scheduling by per-group token accounting.
+
+    Each group (table) accrues tokens linearly over time and spends
+    wall-clock-ms tokens when its queries run; the pending query from the
+    group with the most tokens runs first. Mirrors TokenSchedulerGroup's
+    `tokens = tokens*decay + lifetime_ms*num_workers - used_ms`.
+    """
+
+    TOKEN_LIFETIME_MS = 100.0
+
+    def __init__(self, num_workers: int = 4):
+        super().__init__(num_workers)
+        self._groups: Dict[str, float] = {}
+        self._last_refresh: Dict[str, float] = {}
+        self._queue: list = []            # (-tokens, seq, group, fn, future)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _refresh_tokens(self, group: str) -> float:
+        now = time.monotonic()
+        last = self._last_refresh.get(group, now)
+        tokens = self._groups.get(group, 0.0)
+        tokens = tokens * 0.5 + (now - last) * 1e3 * self.num_workers
+        tokens = min(tokens, self.TOKEN_LIFETIME_MS * self.num_workers * 2)
+        self._groups[group] = tokens
+        self._last_refresh[group] = now
+        return tokens
+
+    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+        future: Future = Future()
+        with self._lock:
+            tokens = self._refresh_tokens(group)
+            heapq.heappush(self._queue,
+                           (-tokens, self._seq, group, fn, future))
+            self._seq += 1
+        self._pool.submit(self._drain)
+        return future
+
+    def _drain(self) -> None:
+        with self._lock:
+            if not self._queue:
+                return
+            _, _, group, fn, future = heapq.heappop(self._queue)
+        if not future.set_running_or_notify_cancel():
+            return
+        t0 = time.monotonic()
+        try:
+            future.set_result(fn())
+        except BaseException as e:  # noqa: BLE001 — future carries it
+            future.set_exception(e)
+        finally:
+            used_ms = (time.monotonic() - t0) * 1e3
+            with self._lock:
+                self._groups[group] = self._groups.get(group, 0.0) - used_ms
+
+
+def make_scheduler(algorithm: str = "fcfs", num_workers: int = 4
+                   ) -> QueryScheduler:
+    """Parity: QuerySchedulerFactory.create (falls back to FCFS)."""
+    if algorithm == "tokenbucket":
+        return TokenBucketScheduler(num_workers)
+    return FCFSQueryScheduler(num_workers)

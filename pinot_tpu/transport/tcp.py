@@ -1,0 +1,142 @@
+"""Data-plane transport: length-framed TCP between broker and servers.
+
+Parity: the reference's Netty data plane — core/transport/ServerChannels.java
+(one channel per server, LengthFieldBasedFrameDecoder framing) and
+pinot-transport NettyServer — rebuilt on asyncio. Frames are
+[4-byte big-endian length][payload]; requests carry a serialized
+InstanceRequest, responses carry DataTable bytes (request correlation via
+the requestId metadata entry, as in the reference).
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(4)
+    n = _LEN.unpack(header)[0]
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return await reader.readexactly(n)
+
+
+def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)) + payload)
+
+
+class QueryServer:
+    """Accepts framed requests, hands payloads to a handler, writes replies.
+
+    handler: bytes -> bytes, called on the event loop's default executor so
+    device work never blocks the accept loop (parity: Netty worker threads
+    handing off to the QueryScheduler).
+    """
+
+    def __init__(self, host: str, port: int,
+                 handler: Callable[[bytes], bytes]):
+        self.host = host
+        self.port = port
+        self.handler = handler
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._connections: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # force-close persistent client connections so wait_closed()
+            # doesn't wait for brokers that keep their channels open
+            for writer in list(self._connections):
+                writer.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
+        self._connections.add(writer)
+        try:
+            while True:
+                payload = await read_frame(reader)
+                reply = await loop.run_in_executor(None, self.handler,
+                                                   payload)
+                write_frame(writer, reply)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                ConnectionAbortedError):
+            pass
+        finally:
+            self._connections.discard(writer)
+            writer.close()
+
+
+class ServerConnection:
+    """One persistent framed connection to a server (broker side).
+
+    Concurrent senders are serialized per connection; responses come back
+    in order (the server processes frames sequentially per connection),
+    mirroring the single-channel-per-server model of ServerChannels.
+    """
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def _ensure(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+
+    async def request(self, payload: bytes,
+                      timeout: Optional[float] = None) -> bytes:
+        async with self._lock:
+            await self._ensure()
+            try:
+                write_frame(self._writer, payload)
+                await self._writer.drain()
+                return await asyncio.wait_for(read_frame(self._reader),
+                                              timeout)
+            except BaseException:
+                # a timeout/cancel mid-frame desynchronizes the stream (a
+                # late response would be read as the NEXT query's reply) —
+                # drop the connection so the next request reconnects clean
+                self._writer.close()
+                self._writer = None
+                self._reader = None
+                raise
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread (for sync call sites)."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self.loop.run_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)

@@ -1,0 +1,244 @@
+"""Broker-plane tests: routing, scatter-gather, partial responses, quota,
+hybrid time-boundary split — over an embedded multi-server cluster.
+
+Mirrors the reference's routing-builder unit tests and the ClusterTest
+pattern (multi-node in one process, real serde on the wire).
+"""
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment
+from oracle import Oracle
+
+from pinot_tpu.broker import (BalancedRandomRoutingTableBuilder,
+                              BrokerRequestHandler, InProcessTransport,
+                              ReplicaGroupRoutingTableBuilder,
+                              RoutingManager, TcpTransport,
+                              TimeBoundaryService)
+from pinot_tpu.common.cluster_state import ONLINE, TableView
+from pinot_tpu.server import ServerInstance
+
+import random
+
+
+def _view(table, segment_servers):
+    return TableView(table, {seg: {srv: ONLINE for srv in servers}
+                             for seg, servers in segment_servers.items()})
+
+
+# -- routing builders -------------------------------------------------------
+
+def test_balanced_random_builder_covers_all_segments():
+    view = _view("t_OFFLINE", {
+        f"seg_{i}": [f"s{i % 3}", f"s{(i + 1) % 3}"] for i in range(12)})
+    tables = BalancedRandomRoutingTableBuilder(num_tables=5).build(
+        view, random.Random(0))
+    assert len(tables) == 5
+    for rt in tables:
+        routed = sorted(s for segs in rt.values() for s in segs)
+        assert routed == sorted(view.segments())
+        # balance: with 12 segments over 3 servers, no server > 8
+        assert max(len(v) for v in rt.values()) <= 8
+
+
+def test_balanced_random_builder_skips_dead_replicas():
+    view = TableView("t_OFFLINE", {
+        "seg_live": {"s0": ONLINE, "s1": "OFFLINE"},
+        "seg_dead": {"s1": "ERROR"},
+    })
+    tables = BalancedRandomRoutingTableBuilder(num_tables=3).build(
+        view, random.Random(0))
+    for rt in tables:
+        assert rt.get("s0") == ["seg_live"]
+        assert "s1" not in rt
+
+
+def test_replica_group_builder_single_server_per_table():
+    view = _view("t_OFFLINE",
+                 {f"seg_{i}": ["s0", "s1"] for i in range(6)})
+    tables = ReplicaGroupRoutingTableBuilder(num_tables=4).build(
+        view, random.Random(0))
+    for rt in tables:
+        assert len(rt) == 1           # one replica group serves everything
+        assert sorted(list(rt.values())[0]) == sorted(view.segments())
+
+
+# -- embedded cluster -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    base = tempfile.mkdtemp()
+    servers = {f"server_{i}": ServerInstance(f"server_{i}")
+               for i in range(2)}
+    all_cols = []
+    view = TableView("baseballStats_OFFLINE", {})
+    for i in range(4):
+        seg, cols = build_segment(f"{base}/seg{i}", n=1500, seed=80 + i,
+                                  name=f"bb_{i}")
+        all_cols.append(cols)
+        target = f"server_{i % 2}"
+        servers[target].data_manager.table(
+            "baseballStats_OFFLINE", create=True).add_segment(seg)
+        view.segment_states[f"bb_{i}"] = {target: ONLINE}
+    merged = {k: (np.concatenate([c[k] for c in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((c[k] for c in all_cols), []))
+              for k in all_cols[0]}
+    routing = RoutingManager()
+    routing.update_view(view)
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers))
+    yield handler, Oracle(merged), servers
+    for s in servers.values():
+        s.stop()
+
+
+def test_broker_aggregation_across_servers(cluster):
+    handler, oracle, _ = cluster
+    m = oracle.mask(lambda r: r["league"] == "NL")
+    resp = handler.handle("SELECT COUNT(*), AVG(runs) FROM baseballStats "
+                          "WHERE league = 'NL'")
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert float(resp.aggregation_results[1].value) == pytest.approx(
+        oracle.avg("runs", m))
+    assert resp.num_servers_queried == 2
+    assert resp.num_servers_responded == 2
+    assert resp.num_segments_processed == 4
+    assert resp.total_docs == 6000
+
+
+def test_broker_group_by_reduce(cluster):
+    handler, oracle, _ = cluster
+    m = oracle.mask(lambda r: True)
+    expected = oracle.group_by(["teamID"], m, ("sum", "hits"))
+    resp = handler.handle(
+        "SELECT SUM(hits) FROM baseballStats GROUP BY teamID TOP 1000")
+    got = {tuple(g["group"]): float(g["value"])
+           for g in resp.aggregation_results[0].group_by_result}
+    assert got == {(k[0],): pytest.approx(v) for k, v in expected.items()}
+
+
+def test_broker_selection_order_by(cluster):
+    handler, oracle, _ = cluster
+    resp = handler.handle("SELECT runs FROM baseballStats "
+                          "ORDER BY runs DESC LIMIT 10")
+    got = [int(r[0]) for r in resp.selection_results.results]
+    m = oracle.mask(lambda r: True)
+    assert got == [int(v) for v in
+                   sorted(oracle.vals("runs", m), reverse=True)[:10]]
+
+
+def test_broker_unknown_table(cluster):
+    handler, _, _ = cluster
+    resp = handler.handle("SELECT COUNT(*) FROM nothere")
+    assert resp.exceptions
+    assert "TableDoesNotExistError" in resp.exceptions[0]["message"]
+
+
+def test_broker_bad_pql(cluster):
+    handler, _, _ = cluster
+    resp = handler.handle("SELEKT nope")
+    assert resp.exceptions
+    assert "PQLParsingError" in resp.exceptions[0]["message"]
+
+
+def test_broker_quota(cluster):
+    handler, _, _ = cluster
+    handler.quota.set_qps_quota("baseballStats", 3)
+    try:
+        results = [handler.handle("SELECT COUNT(*) FROM baseballStats")
+                   for _ in range(10)]
+        over = [r for r in results if r.exceptions and
+                "QuotaExceededError" in r.exceptions[0]["message"]]
+        assert over, "quota never tripped at 10 rapid queries vs 3 qps"
+    finally:
+        handler.quota.set_qps_quota("baseballStats", None)
+
+
+def test_broker_partial_response(cluster):
+    handler, oracle, servers = cluster
+
+    class Flaky(InProcessTransport):
+        async def query(self, server, payload, timeout):
+            if server == "server_1":
+                raise ConnectionError("boom")
+            return await super().query(server, payload, timeout)
+
+    flaky_handler = BrokerRequestHandler(handler.routing, Flaky(servers))
+    resp = flaky_handler.handle("SELECT COUNT(*) FROM baseballStats")
+    assert resp.num_servers_queried == 2
+    assert resp.num_servers_responded == 1
+    # partial result: only server_0's 2 segments
+    assert resp.num_segments_processed == 2
+
+
+def test_broker_over_tcp(cluster):
+    handler, oracle, servers = cluster
+    endpoints = {}
+    for name, inst in servers.items():
+        port = inst.start(port=0)
+        endpoints[name] = ("127.0.0.1", port)
+    tcp_handler = BrokerRequestHandler(handler.routing,
+                                       TcpTransport(endpoints))
+    try:
+        m = oracle.mask(lambda r: r["teamID"] == "BOS")
+        resp = tcp_handler.handle(
+            "SELECT SUM(runs) FROM baseballStats WHERE teamID = 'BOS'")
+        assert float(resp.aggregation_results[0].value) == pytest.approx(
+            oracle.sum("runs", m))
+        assert resp.num_servers_responded == 2
+    finally:
+        tcp_handler.close()
+
+
+# -- hybrid time boundary ---------------------------------------------------
+
+def test_hybrid_time_boundary_split():
+    base = tempfile.mkdtemp()
+    server = ServerInstance("hybrid_server")
+    # offline segment: years < 2010; "realtime" segment: years >= 2005
+    # (overlap on purpose: the boundary must dedupe)
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    cols_all = make_columns(4000, seed=7)
+    off_mask = cols_all["yearID"] < 2010
+    rt_mask = cols_all["yearID"] >= 2005
+
+    def subset(mask, name, table, seg_dir):
+        sub = {k: (np.asarray(v)[mask] if isinstance(v, np.ndarray)
+                   else [x for x, m in zip(v, mask) if m])
+               for k, v in cols_all.items()}
+        sub["position"] = [list(p) for p in sub["position"]]
+        creator = SegmentCreator(make_schema(), make_table_config(),
+                                 segment_name=name)
+        creator.build(sub, seg_dir)
+        seg = ImmutableSegmentLoader.load(seg_dir)
+        server.data_manager.table(table, create=True).add_segment(seg)
+        return seg
+
+    off_seg = subset(off_mask, "off_0", "baseballStats_OFFLINE",
+                     f"{base}/off")
+    subset(rt_mask, "rt_0", "baseballStats_REALTIME", f"{base}/rt")
+
+    routing = RoutingManager()
+    routing.update_view(_view("baseballStats_OFFLINE",
+                              {"off_0": ["hybrid_server"]}))
+    routing.update_view(_view("baseballStats_REALTIME",
+                              {"rt_0": ["hybrid_server"]}))
+    tb = TimeBoundaryService()
+    tb.update_from_segments("baseballStats_OFFLINE", "yearID", "DAYS",
+                            [off_seg.metadata.end_time])
+    handler = BrokerRequestHandler(routing, InProcessTransport(
+        {"hybrid_server": server}))
+    handler.time_boundary = tb
+
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    # boundary = max offline end time (2009) - 1: offline <= 2008, rt > 2008
+    y = cols_all["yearID"]
+    expected = int((off_mask & (y <= 2008)).sum() +
+                   (rt_mask & (y > 2008)).sum())
+    assert resp.aggregation_results[0].value == str(expected)
+    server.stop()
