@@ -1,0 +1,56 @@
+"""Broker-side cluster spectator: external views → routing + time boundary.
+
+Parity: HelixBrokerStarter's spectator role —
+HelixExternalViewBasedRouting.processExternalViewChange (:418) rebuilds
+routing tables, and HelixExternalViewBasedTimeBoundaryService recomputes
+hybrid boundaries from offline segment metadata.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from pinot_tpu.broker.routing import RoutingManager
+from pinot_tpu.broker.time_boundary import TimeBoundaryService
+from pinot_tpu.common.cluster_state import TableView
+from pinot_tpu.common.table_name import raw_table, table_type
+from pinot_tpu.controller.manager import ResourceManager
+from pinot_tpu.controller.state_machine import ClusterCoordinator
+
+
+class BrokerClusterWatcher:
+    def __init__(self, coordinator: ClusterCoordinator,
+                 manager: ResourceManager,
+                 routing: Optional[RoutingManager] = None,
+                 time_boundary: Optional[TimeBoundaryService] = None):
+        self.coordinator = coordinator
+        self.manager = manager
+        self.routing = routing or RoutingManager()
+        self.time_boundary = time_boundary or TimeBoundaryService()
+        coordinator.watch_external_views(self._on_view)
+        for table in coordinator.tables():
+            self._on_view(coordinator.external_view(table))
+
+    def _on_view(self, view: TableView) -> None:
+        if not view.segment_states:
+            self.routing.remove_table(view.table_name)
+            return
+        self.routing.update_view(view)
+        if table_type(view.table_name) == "OFFLINE":
+            self._update_time_boundary(view.table_name)
+
+    def _update_time_boundary(self, offline_table: str) -> None:
+        schema = self.manager.get_schema(raw_table(offline_table))
+        if schema is None:
+            return
+        tc = schema.time_column
+        if tc is None:
+            return
+        ends, unit = [], None
+        for seg in self.manager.segment_names(offline_table):
+            meta = self.manager.segment_metadata(offline_table, seg) or {}
+            if meta.get("endTime") is not None:
+                ends.append(meta["endTime"])
+                unit = meta.get("timeUnit") or unit
+        if ends:
+            self.time_boundary.update_from_segments(
+                offline_table, tc.name, unit or "DAYS", ends)

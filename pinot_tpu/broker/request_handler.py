@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -77,7 +78,11 @@ class TcpTransport(ServerTransport):
             host, port = self.endpoints[server]
             conn = ServerConnection(host, port)
             self._conns[server] = conn
-        return await conn.request(payload, timeout)
+        # the deadline covers connect + per-connection queueing + write +
+        # read: a black-holed server (dropped SYNs) or a slow in-flight
+        # query ahead of us must still surface as a timely partial response
+        return await asyncio.wait_for(conn.request(payload, timeout),
+                                      timeout)
 
     async def close(self) -> None:
         for conn in self._conns.values():
@@ -137,12 +142,15 @@ class BrokerRequestHandler:
         self.default_timeout_s = default_timeout_s
         self._request_ids = itertools.count(1)
         self._loop: Optional[EventLoopThread] = None
+        self._loop_lock = threading.Lock()
 
     # -- sync facade -------------------------------------------------------
     def handle(self, pql: str) -> BrokerResponse:
-        if self._loop is None:
-            self._loop = EventLoopThread()
-        return self._loop.run(self.handle_async(pql))
+        with self._loop_lock:
+            if self._loop is None:
+                self._loop = EventLoopThread()
+            loop = self._loop
+        return loop.run(self.handle_async(pql))
 
     def close(self) -> None:
         if self._loop is not None:

@@ -14,11 +14,7 @@ from typing import Dict, Optional
 
 from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
                                       FilterQueryTree)
-
-_UNIT_MS = {
-    "MILLISECONDS": 1, "SECONDS": 1000, "MINUTES": 60_000,
-    "HOURS": 3_600_000, "DAYS": 86_400_000,
-}
+from pinot_tpu.common.timeutils import unit_ms
 
 
 class TimeBoundaryInfo:
@@ -39,8 +35,8 @@ class TimeBoundaryService:
         if not ends:
             return
         max_end = max(int(e) for e in ends)
-        unit_ms = _UNIT_MS.get((time_unit or "DAYS").upper(), 86_400_000)
-        delta = (3_600_000 if hourly_push else 86_400_000) // unit_ms
+        u = unit_ms(time_unit)
+        delta = (3_600_000 if hourly_push else 86_400_000) // u
         boundary = max_end - max(delta, 1)
         with self._lock:
             self._boundaries[offline_table] = TimeBoundaryInfo(time_column,

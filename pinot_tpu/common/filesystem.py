@@ -1,0 +1,86 @@
+"""PinotFS: the deep-store filesystem abstraction.
+
+Parity: pinot-common/.../filesystem/PinotFS.java (copy/move/delete/mkdir/
+exists/listFiles + factory by URI scheme) with LocalPinotFS as the default
+implementation. Segment directories are the durable artifacts; servers
+fetch them from the deep store on ONLINE transitions.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List, Type
+
+
+class PinotFS:
+    def mkdir(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def move(self, src: str, dst: str) -> bool:
+        raise NotImplementedError
+
+    def copy(self, src: str, dst: str) -> bool:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def list_files(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def is_directory(self, path: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalPinotFS(PinotFS):
+    def mkdir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> bool:
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+            return True
+        if os.path.exists(path):
+            os.remove(path)
+            return True
+        return False
+
+    def move(self, src: str, dst: str) -> bool:
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        shutil.move(src, dst)
+        return True
+
+    def copy(self, src: str, dst: str) -> bool:
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            shutil.copy2(src, dst)
+        return True
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def list_files(self, path: str) -> List[str]:
+        return sorted(os.path.join(path, f) for f in os.listdir(path))
+
+    def is_directory(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+
+_REGISTRY: Dict[str, Type[PinotFS]] = {"file": LocalPinotFS}
+
+
+def register_fs(scheme: str, cls: Type[PinotFS]) -> None:
+    _REGISTRY[scheme] = cls
+
+
+def get_fs(uri: str = "file://") -> PinotFS:
+    scheme = uri.split("://", 1)[0] if "://" in uri else "file"
+    try:
+        return _REGISTRY[scheme]()
+    except KeyError:
+        raise ValueError(f"no PinotFS registered for scheme '{scheme}'")

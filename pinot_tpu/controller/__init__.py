@@ -1,0 +1,18 @@
+from pinot_tpu.controller.assignment import (BalancedNumSegmentAssignment,
+                                             RandomSegmentAssignment,
+                                             ReplicaGroupSegmentAssignment,
+                                             make_assignment)
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.controller.manager import ResourceManager
+from pinot_tpu.controller.periodic import (PeriodicTaskScheduler,
+                                           RetentionManager,
+                                           SegmentStatusChecker)
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.controller.state_machine import (ClusterCoordinator,
+                                                StateModel)
+
+__all__ = ["BalancedNumSegmentAssignment", "RandomSegmentAssignment",
+           "ReplicaGroupSegmentAssignment", "make_assignment", "Controller",
+           "ResourceManager", "PeriodicTaskScheduler", "RetentionManager",
+           "SegmentStatusChecker", "PropertyStore", "ClusterCoordinator",
+           "StateModel"]

@@ -1,0 +1,72 @@
+"""Segment assignment strategies.
+
+Parity: pinot-controller/.../helix/core/sharding/ SegmentAssignmentStrategy
+SPI — balanced-num-segments (least loaded instances first), random, and
+replica-group assignment (ReplicaGroupSegmentAssignmentStrategy).
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+
+class SegmentAssignmentStrategy:
+    def assign(self, segment: str, instances: List[str], replicas: int,
+               current: Dict[str, Dict[str, str]]) -> List[str]:
+        """→ the instances that should host `segment`."""
+        raise NotImplementedError
+
+
+class BalancedNumSegmentAssignment(SegmentAssignmentStrategy):
+    """Pick the `replicas` least-loaded instances (segment count)."""
+
+    def assign(self, segment: str, instances: List[str], replicas: int,
+               current: Dict[str, Dict[str, str]]) -> List[str]:
+        if not instances:
+            raise ValueError("no live server instances to assign to")
+        load = {inst: 0 for inst in instances}
+        for seg, m in current.items():
+            for inst in m:
+                if inst in load:
+                    load[inst] += 1
+        ordered = sorted(instances, key=lambda i: (load[i], i))
+        return ordered[: min(replicas, len(ordered))]
+
+
+class RandomSegmentAssignment(SegmentAssignmentStrategy):
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+
+    def assign(self, segment: str, instances: List[str], replicas: int,
+               current: Dict[str, Dict[str, str]]) -> List[str]:
+        if not instances:
+            raise ValueError("no live server instances to assign to")
+        k = min(replicas, len(instances))
+        return sorted(self._rng.sample(instances, k))
+
+
+class ReplicaGroupSegmentAssignment(SegmentAssignmentStrategy):
+    """Partition instances into `replicas` groups; each group hosts every
+    segment once, spread within the group by least-load."""
+
+    def assign(self, segment: str, instances: List[str], replicas: int,
+               current: Dict[str, Dict[str, str]]) -> List[str]:
+        if not instances:
+            raise ValueError("no live server instances to assign to")
+        instances = sorted(instances)
+        replicas = min(replicas, len(instances))
+        groups = [instances[i::replicas] for i in range(replicas)]
+        load = {inst: 0 for inst in instances}
+        for seg, m in current.items():
+            for inst in m:
+                if inst in load:
+                    load[inst] += 1
+        return sorted(min(g, key=lambda i: (load[i], i)) for g in groups)
+
+
+def make_assignment(name: str = "balanced") -> SegmentAssignmentStrategy:
+    return {
+        "balanced": BalancedNumSegmentAssignment,
+        "random": RandomSegmentAssignment,
+        "replicagroup": ReplicaGroupSegmentAssignment,
+    }[name]()

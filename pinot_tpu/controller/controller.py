@@ -1,0 +1,33 @@
+"""Controller process wiring.
+
+Parity: pinot-controller/.../ControllerStarter.java:77-444 — connects the
+cluster coordinator, resource manager and periodic tasks. (The reference
+additionally hosts the Helix controller and a Jersey REST API; the REST
+admin surface here lives in pinot_tpu/tools and the coordinator is
+in-process.)
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pinot_tpu.controller.manager import ResourceManager
+from pinot_tpu.controller.periodic import (PeriodicTask,
+                                           PeriodicTaskScheduler)
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.controller.state_machine import ClusterCoordinator
+
+
+class Controller:
+    def __init__(self, deep_store_dir: str,
+                 store: Optional[PropertyStore] = None,
+                 periodic_tasks: Optional[List[PeriodicTask]] = None):
+        self.store = store or PropertyStore()
+        self.coordinator = ClusterCoordinator(self.store)
+        self.manager = ResourceManager(self.coordinator, deep_store_dir)
+        self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks)
+
+    def start(self) -> None:
+        self.periodic.start()
+
+    def stop(self) -> None:
+        self.periodic.stop()

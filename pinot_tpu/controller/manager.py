@@ -1,0 +1,175 @@
+"""Cluster resource manager: tables, segments, assignment, deep store.
+
+Parity: pinot-controller/.../helix/core/PinotHelixResourceManager.java (the
+cluster-ops god object): create/update tables, addNewSegment
+(:1579-1604 — segment metadata write + ideal-state update via the
+assignment strategy), delete segments, rebalance entry; segment upload
+keeps the artifact in the deep store (PinotFS) for servers to fetch.
+
+Store layout (beyond state_machine.py's):
+  /CONFIGS/TABLE/<table>       table config JSON
+  /CONFIGS/SCHEMA/<name>       schema JSON
+  /SEGMENTS/<table>/<segment>  segment metadata (download path, time range)
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from pinot_tpu.common.cluster_state import ONLINE
+from pinot_tpu.common.filesystem import LocalPinotFS, PinotFS
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.assignment import (SegmentAssignmentStrategy,
+                                             make_assignment)
+from pinot_tpu.controller.state_machine import (ClusterCoordinator, DROPPED)
+from pinot_tpu.segment.metadata import SegmentMetadata
+
+TABLE_CONFIGS = "/CONFIGS/TABLE"
+SCHEMAS = "/CONFIGS/SCHEMA"
+SEGMENTS = "/SEGMENTS"
+
+
+class ResourceManager:
+    def __init__(self, coordinator: ClusterCoordinator, deep_store_dir: str,
+                 fs: Optional[PinotFS] = None):
+        self.coordinator = coordinator
+        self.store = coordinator.store
+        self.deep_store_dir = deep_store_dir
+        self.fs = fs or LocalPinotFS()
+        self.fs.mkdir(deep_store_dir)
+        self._assignments: Dict[str, SegmentAssignmentStrategy] = {}
+
+    # -- schemas & tables --------------------------------------------------
+    def add_schema(self, schema: Schema) -> None:
+        self.store.set(f"{SCHEMAS}/{schema.schema_name}", schema.to_json())
+
+    def get_schema(self, name: str) -> Optional[Schema]:
+        rec = self.store.get(f"{SCHEMAS}/{name}")
+        return Schema.from_json(rec) if rec else None
+
+    def add_table(self, config: TableConfig,
+                  assignment: str = "balanced") -> str:
+        table = config.table_name_with_type
+        self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
+        self._assignments[table] = make_assignment(assignment)
+        self.coordinator.set_ideal_state(table,
+                                         self.coordinator.ideal_state(table))
+        return table
+
+    def get_table_config(self, table: str) -> Optional[TableConfig]:
+        rec = self.store.get(f"{TABLE_CONFIGS}/{table}")
+        return TableConfig.from_json(rec) if rec else None
+
+    def table_names(self) -> List[str]:
+        return self.store.children(TABLE_CONFIGS)
+
+    def delete_table(self, table: str) -> None:
+        self.coordinator.drop_table(table)
+        self.store.remove(f"{TABLE_CONFIGS}/{table}")
+        for seg in self.segment_names(table):
+            self.store.remove(f"{SEGMENTS}/{table}/{seg}")
+        self.fs.delete(os.path.join(self.deep_store_dir, table))
+
+    # -- segments ----------------------------------------------------------
+    def add_segment(self, table: str, segment_dir: str,
+                    metadata: Optional[SegmentMetadata] = None) -> str:
+        """Upload a built segment: deep-store copy + metadata + assignment.
+
+        Parity: PinotSegmentUploadRestletResource → ZKOperator →
+        addNewSegment.
+        """
+        config = self.get_table_config(table)
+        if config is None:
+            raise ValueError(f"table {table} does not exist")
+        meta = metadata or SegmentMetadata.load(segment_dir)
+        name = meta.segment_name
+        dest = os.path.join(self.deep_store_dir, table, name)
+        if os.path.abspath(segment_dir) != os.path.abspath(dest):
+            self.fs.delete(dest)
+            self.fs.copy(segment_dir, dest)
+        self.store.set(f"{SEGMENTS}/{table}/{name}", {
+            "segmentName": name,
+            "downloadPath": dest,
+            "startTime": meta.start_time,
+            "endTime": meta.end_time,
+            "timeUnit": meta.time_unit,
+            "totalDocs": meta.total_docs,
+            "pushTimeMs": int(time.time() * 1e3),
+            "crc": meta.crc,
+        })
+        replicas = config.segments_config.replication
+        strategy = self._assignments.setdefault(
+            table, make_assignment("balanced"))
+        servers = self.coordinator.live_instances()
+        current = self.coordinator.ideal_state(table)
+        if name in current:
+            # refresh of an existing segment: keep its assignment, bounce
+            # it through OFFLINE so servers reload the new artifact
+            # (parity: the segment refresh message ZKOperator sends)
+            assigned = sorted(current[name])
+
+            def offline(segments):
+                segments[name] = {inst: "OFFLINE" for inst in assigned}
+                return segments
+
+            self.coordinator.update_ideal_state(table, offline)
+        else:
+            assigned = strategy.assign(name, servers, replicas, current)
+
+        def add(segments):
+            segments[name] = {inst: ONLINE for inst in assigned}
+            return segments
+
+        self.coordinator.update_ideal_state(table, add)
+        return name
+
+    def segment_names(self, table: str) -> List[str]:
+        return self.store.children(f"{SEGMENTS}/{table}")
+
+    def segment_metadata(self, table: str, segment: str) -> Optional[dict]:
+        return self.store.get(f"{SEGMENTS}/{table}/{segment}")
+
+    def delete_segment(self, table: str, segment: str) -> None:
+        """Parity: SegmentDeletionManager — drop from ideal state, remove
+        metadata, delete the deep-store artifact."""
+
+        def drop(segments):
+            if segment in segments:
+                segments[segment] = {inst: DROPPED
+                                     for inst in segments[segment]}
+            return segments
+
+        self.coordinator.update_ideal_state(table, drop)
+
+        def purge(segments):
+            segments.pop(segment, None)
+            return segments
+
+        self.coordinator.update_ideal_state(table, purge)
+        self.store.remove(f"{SEGMENTS}/{table}/{segment}")
+        self.fs.delete(os.path.join(self.deep_store_dir, table, segment))
+
+    # -- rebalance ---------------------------------------------------------
+    def rebalance_table(self, table: str, dry_run: bool = False) -> Dict:
+        """Recompute the whole assignment against live instances.
+
+        Parity: TableRebalancer/DefaultRebalanceSegmentStrategy — target
+        state computed fresh; applied in one ideal-state write (servers
+        converge; queries keep working through refcounted swap).
+        """
+        config = self.get_table_config(table)
+        if config is None:
+            raise ValueError(f"table {table} does not exist")
+        replicas = config.segments_config.replication
+        strategy = self._assignments.setdefault(
+            table, make_assignment("balanced"))
+        servers = self.coordinator.live_instances()
+        target: Dict[str, Dict[str, str]] = {}
+        for seg in self.segment_names(table):
+            assigned = strategy.assign(seg, servers, replicas, target)
+            target[seg] = {inst: ONLINE for inst in assigned}
+        if not dry_run:
+            self.coordinator.set_ideal_state(table, target)
+        return target

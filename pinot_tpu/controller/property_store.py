@@ -1,0 +1,83 @@
+"""PropertyStore: hierarchical JSON records with watches.
+
+Parity: the ZooKeeper property store as Pinot uses it through Helix
+(ZKMetadataProvider paths: /CONFIGS/TABLE, /SEGMENTS/<table>/<segment>,
+ideal states, external views). In-process, thread-safe, watch callbacks on
+path prefixes — the single source of truth for cluster state, exactly the
+role ZK plays; a networked implementation can replace it behind the same
+interface.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional
+
+Watcher = Callable[[str, Optional[dict]], None]
+
+
+class PropertyStore:
+    def __init__(self):
+        self._data: Dict[str, dict] = {}
+        self._watchers: List[tuple] = []        # (prefix, callback)
+        self._lock = threading.RLock()
+
+    # -- records -----------------------------------------------------------
+    def set(self, path: str, record: dict) -> None:
+        with self._lock:
+            self._data[path] = json.loads(json.dumps(record))
+            watchers = [cb for p, cb in self._watchers
+                        if path.startswith(p)]
+        for cb in watchers:
+            cb(path, record)
+
+    def get(self, path: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._data.get(path)
+            return json.loads(json.dumps(rec)) if rec is not None else None
+
+    def update(self, path: str, fn: Callable[[Optional[dict]], dict]
+               ) -> dict:
+        """Atomic read-modify-write (single-writer ideal-state updates)."""
+        with self._lock:
+            rec = fn(self.get(path))
+            self._data[path] = json.loads(json.dumps(rec))
+            watchers = [cb for p, cb in self._watchers
+                        if path.startswith(p)]
+        for cb in watchers:
+            cb(path, rec)
+        return rec
+
+    def remove(self, path: str) -> bool:
+        with self._lock:
+            existed = self._data.pop(path, None) is not None
+            watchers = [cb for p, cb in self._watchers
+                        if path.startswith(p)] if existed else []
+        for cb in watchers:
+            cb(path, None)
+        return existed
+
+    def children(self, prefix: str) -> List[str]:
+        """Paths directly under prefix (like ZK getChildren)."""
+        if not prefix.endswith("/"):
+            prefix += "/"
+        with self._lock:
+            out = set()
+            for p in self._data:
+                if p.startswith(prefix):
+                    out.add(p[len(prefix):].split("/", 1)[0])
+            return sorted(out)
+
+    def list_paths(self, prefix: str) -> List[str]:
+        with self._lock:
+            return sorted(p for p in self._data if p.startswith(prefix))
+
+    # -- watches -----------------------------------------------------------
+    def watch(self, prefix: str, callback: Watcher) -> None:
+        with self._lock:
+            self._watchers.append((prefix, callback))
+
+    def unwatch(self, callback: Watcher) -> None:
+        with self._lock:
+            self._watchers = [(p, cb) for p, cb in self._watchers
+                              if cb is not callback]

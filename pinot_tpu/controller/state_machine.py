@@ -1,0 +1,201 @@
+"""Cluster state-machine engine: ideal state → transitions → external view.
+
+Parity: the Helix core loop as Pinot uses it (docs/architecture.rst:35-120):
+the controller writes IdealStates (table = resource, segment = partition);
+participants (servers) receive state transitions
+(SegmentOnlineOfflineStateModelFactory.java:81-156 —
+OFFLINE→ONLINE loads a segment, ONLINE→OFFLINE unloads, →DROPPED deletes,
+OFFLINE→CONSUMING starts a realtime consumer); current states compose into
+ExternalViews that spectators (brokers) watch for routing.
+
+Store layout:
+  /IDEALSTATES/<table>              {"segments": {seg: {instance: state}}}
+  /CURRENTSTATES/<instance>/<table> {"segments": {seg: state}}
+  /EXTERNALVIEW/<table>             {"segments": {seg: {instance: state}}}
+  /LIVEINSTANCES/<instance>         {"tags": [...]}
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.common.cluster_state import (CONSUMING, ERROR, OFFLINE,
+                                            ONLINE, TableView)
+from pinot_tpu.controller.property_store import PropertyStore
+
+log = logging.getLogger(__name__)
+
+DROPPED = "DROPPED"
+
+IDEAL = "/IDEALSTATES"
+CURRENT = "/CURRENTSTATES"
+VIEW = "/EXTERNALVIEW"
+LIVE = "/LIVEINSTANCES"
+
+
+class StateModel:
+    """Participant-side transition handlers (segment lifecycle).
+
+    Parity: SegmentOnlineOfflineStateModelFactory's state model.
+    """
+
+    def on_become_online(self, table: str, segment: str) -> None:
+        pass
+
+    def on_become_consuming(self, table: str, segment: str) -> None:
+        pass
+
+    def on_become_offline(self, table: str, segment: str) -> None:
+        pass
+
+    def on_become_dropped(self, table: str, segment: str) -> None:
+        pass
+
+
+class ClusterCoordinator:
+    """Drives participants toward ideal state; composes external views."""
+
+    def __init__(self, store: Optional[PropertyStore] = None):
+        self.store = store or PropertyStore()
+        self._participants: Dict[str, StateModel] = {}
+        self._lock = threading.RLock()
+
+    # -- membership --------------------------------------------------------
+    def register_participant(self, instance_id: str, model: StateModel,
+                             tags: Optional[List[str]] = None) -> None:
+        with self._lock:
+            self._participants[instance_id] = model
+            self.store.set(f"{LIVE}/{instance_id}",
+                           {"tags": list(tags or ["DefaultTenant"])})
+        self._reconcile_all()
+
+    def deregister_participant(self, instance_id: str) -> None:
+        """Instance death (ephemeral node loss): drop from views.
+
+        Current-state records die with the instance (they described a
+        process that no longer exists) — otherwise a restarted instance
+        under the same id would be believed to still host its segments and
+        never receive load transitions."""
+        with self._lock:
+            self._participants.pop(instance_id, None)
+            self.store.remove(f"{LIVE}/{instance_id}")
+            for path in self.store.list_paths(f"{CURRENT}/{instance_id}/"):
+                self.store.remove(path)
+        for table in self.tables():
+            self._recompute_view(table)
+
+    def live_instances(self, tag: Optional[str] = None) -> List[str]:
+        out = []
+        for inst in self.store.children(LIVE):
+            rec = self.store.get(f"{LIVE}/{inst}") or {}
+            if tag is None or tag in rec.get("tags", []):
+                out.append(inst)
+        return sorted(out)
+
+    # -- ideal state -------------------------------------------------------
+    def set_ideal_state(self, table: str,
+                        segments: Dict[str, Dict[str, str]]) -> None:
+        self.store.set(f"{IDEAL}/{table}", {"segments": segments})
+        self._reconcile(table)
+
+    def update_ideal_state(self, table: str, fn) -> Dict:
+        rec = self.store.update(
+            f"{IDEAL}/{table}",
+            lambda old: {"segments": fn(dict((old or {}).get("segments",
+                                                            {})))})
+        self._reconcile(table)
+        return rec["segments"]
+
+    def ideal_state(self, table: str) -> Dict[str, Dict[str, str]]:
+        rec = self.store.get(f"{IDEAL}/{table}") or {}
+        return rec.get("segments", {})
+
+    def drop_table(self, table: str) -> None:
+        self.update_ideal_state(
+            table, lambda segs: {s: {i: DROPPED for i in m}
+                                 for s, m in segs.items()})
+        self.store.remove(f"{IDEAL}/{table}")
+        self.store.remove(f"{VIEW}/{table}")
+        for inst in self.store.children(CURRENT):
+            self.store.remove(f"{CURRENT}/{inst}/{table}")
+
+    def tables(self) -> List[str]:
+        return self.store.children(IDEAL)
+
+    # -- views -------------------------------------------------------------
+    def external_view(self, table: str) -> TableView:
+        rec = self.store.get(f"{VIEW}/{table}") or {}
+        return TableView(table, rec.get("segments", {}))
+
+    def watch_external_views(self, callback: Callable[[TableView], None]
+                             ) -> None:
+        def on_change(path: str, rec: Optional[dict]) -> None:
+            table = path[len(VIEW) + 1:]
+            callback(TableView(table, (rec or {}).get("segments", {})))
+
+        self.store.watch(VIEW + "/", on_change)
+
+    # -- reconciliation ----------------------------------------------------
+    def _reconcile_all(self) -> None:
+        for table in self.tables():
+            self._reconcile(table)
+
+    def _reconcile(self, table: str) -> None:
+        with self._lock:
+            ideal = self.ideal_state(table)
+            for inst, model in list(self._participants.items()):
+                self._reconcile_instance(table, inst, model, ideal)
+            self._recompute_view(table)
+
+    def _reconcile_instance(self, table: str, inst: str, model: StateModel,
+                            ideal: Dict[str, Dict[str, str]]) -> None:
+        path = f"{CURRENT}/{inst}/{table}"
+        current = (self.store.get(path) or {}).get("segments", {})
+        wanted = {seg: states[inst] for seg, states in ideal.items()
+                  if inst in states}
+        changed = False
+        for seg, target in wanted.items():
+            state = current.get(seg, OFFLINE)
+            if state == target:
+                continue
+            try:
+                if target == ONLINE:
+                    model.on_become_online(table, seg)
+                elif target == CONSUMING:
+                    model.on_become_consuming(table, seg)
+                elif target == OFFLINE:
+                    model.on_become_offline(table, seg)
+                elif target == DROPPED:
+                    if state in (ONLINE, CONSUMING):
+                        model.on_become_offline(table, seg)
+                    model.on_become_dropped(table, seg)
+                current[seg] = target
+            except Exception:  # noqa: BLE001 — transition failure => ERROR
+                log.exception("transition %s -> %s failed for %s/%s on %s",
+                              state, target, table, seg, inst)
+                current[seg] = ERROR
+            changed = True
+        # segments no longer assigned to this instance: offline + drop
+        for seg in [s for s in current if s not in wanted]:
+            if current[seg] in (ONLINE, CONSUMING):
+                try:
+                    model.on_become_offline(table, seg)
+                    model.on_become_dropped(table, seg)
+                except Exception:  # noqa: BLE001
+                    log.exception("unassign failed for %s/%s", table, seg)
+            del current[seg]
+            changed = True
+        if changed:
+            self.store.set(path, {"segments": current})
+
+    def _recompute_view(self, table: str) -> None:
+        live = set(self._participants)
+        view: Dict[str, Dict[str, str]] = {}
+        for inst in live:
+            current = (self.store.get(f"{CURRENT}/{inst}/{table}") or {}
+                       ).get("segments", {})
+            for seg, state in current.items():
+                if state != DROPPED:
+                    view.setdefault(seg, {})[inst] = state
+        self.store.set(f"{VIEW}/{table}", {"segments": view})

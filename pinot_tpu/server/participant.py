@@ -1,0 +1,36 @@
+"""Server-side cluster participant: state transitions → segment lifecycle.
+
+Parity: pinot-server/.../starter/helix/SegmentOnlineOfflineStateModelFactory
+.java:81-156 (OFFLINE→ONLINE downloads + loads, ONLINE→OFFLINE unloads,
+→DROPPED deletes local data) + SegmentFetcherAndLoader (deep-store fetch →
+ImmutableSegmentLoader).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from pinot_tpu.controller.manager import ResourceManager
+from pinot_tpu.controller.state_machine import StateModel
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.server.instance import ServerInstance
+
+
+class ServerParticipant(StateModel):
+    def __init__(self, server: ServerInstance, manager: ResourceManager):
+        self.server = server
+        self.manager = manager
+
+    def on_become_online(self, table: str, segment: str) -> None:
+        meta = self.manager.segment_metadata(table, segment)
+        if meta is None:
+            raise ValueError(f"no metadata for {table}/{segment}")
+        seg = ImmutableSegmentLoader.load(meta["downloadPath"])
+        self.server.data_manager.table(table, create=True).add_segment(seg)
+
+    def on_become_offline(self, table: str, segment: str) -> None:
+        tdm = self.server.data_manager.table(table)
+        if tdm is not None:
+            tdm.remove_segment(segment)
+
+    def on_become_dropped(self, table: str, segment: str) -> None:
+        pass  # local artifact cleanup is a no-op: segments load from deep store

@@ -1,0 +1,244 @@
+"""Controller-plane + full-cluster integration tests.
+
+Mirrors the reference's OfflineClusterIntegrationTest /
+MultiNodesOfflineClusterIntegrationTest: a real embedded cluster
+(controller + servers + broker) where segments become queryable through
+the ideal-state → transition → external-view → routing pipeline, plus
+unit tiers for the property store, assignment strategies, retention and
+rebalance.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, make_schema, make_table_config
+from oracle import Oracle
+
+from pinot_tpu.common.cluster_state import ONLINE
+from pinot_tpu.controller import (BalancedNumSegmentAssignment,
+                                  ClusterCoordinator, PropertyStore,
+                                  ReplicaGroupSegmentAssignment,
+                                  RetentionManager, SegmentStatusChecker)
+from pinot_tpu.controller.state_machine import DROPPED, StateModel
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+# -- property store ---------------------------------------------------------
+
+def test_property_store_watch_and_children():
+    store = PropertyStore()
+    events = []
+    store.watch("/EXTERNALVIEW/", lambda p, r: events.append((p, r)))
+    store.set("/EXTERNALVIEW/t1", {"a": 1})
+    store.set("/CONFIGS/TABLE/t1", {"b": 2})      # not watched
+    store.remove("/EXTERNALVIEW/t1")
+    assert events == [("/EXTERNALVIEW/t1", {"a": 1}),
+                      ("/EXTERNALVIEW/t1", None)]
+    store.set("/SEGMENTS/t1/s1", {})
+    store.set("/SEGMENTS/t1/s2", {})
+    assert store.children("/SEGMENTS/t1") == ["s1", "s2"]
+
+
+def test_property_store_update_atomic():
+    store = PropertyStore()
+    store.set("/x", {"n": 1})
+    rec = store.update("/x", lambda old: {"n": (old or {}).get("n", 0) + 1})
+    assert rec == {"n": 2}
+    assert store.get("/x") == {"n": 2}
+
+
+# -- assignment -------------------------------------------------------------
+
+def test_balanced_assignment_spreads_load():
+    strat = BalancedNumSegmentAssignment()
+    current = {}
+    for i in range(9):
+        assigned = strat.assign(f"s{i}", ["a", "b", "c"], 1, current)
+        current[f"s{i}"] = {inst: ONLINE for inst in assigned}
+    counts = {}
+    for m in current.values():
+        for inst in m:
+            counts[inst] = counts.get(inst, 0) + 1
+    assert counts == {"a": 3, "b": 3, "c": 3}
+
+
+def test_replica_group_assignment():
+    strat = ReplicaGroupSegmentAssignment()
+    current = {}
+    for i in range(4):
+        assigned = strat.assign(f"s{i}", ["a", "b", "c", "d"], 2, current)
+        current[f"s{i}"] = {inst: ONLINE for inst in assigned}
+        assert len(assigned) == 2
+        # one from each replica group {a,c} and {b,d}
+        assert len({x in ("a", "c") for x in assigned}) == 2
+
+
+# -- state machine ----------------------------------------------------------
+
+class RecordingModel(StateModel):
+    def __init__(self):
+        self.events = []
+
+    def on_become_online(self, table, segment):
+        self.events.append(("online", table, segment))
+
+    def on_become_offline(self, table, segment):
+        self.events.append(("offline", table, segment))
+
+    def on_become_dropped(self, table, segment):
+        self.events.append(("dropped", table, segment))
+
+
+def test_state_machine_transitions_and_view():
+    coord = ClusterCoordinator()
+    m1, m2 = RecordingModel(), RecordingModel()
+    coord.register_participant("i1", m1)
+    coord.register_participant("i2", m2)
+    coord.set_ideal_state("t", {"s1": {"i1": ONLINE, "i2": ONLINE},
+                                "s2": {"i1": ONLINE}})
+    assert ("online", "t", "s1") in m1.events
+    assert ("online", "t", "s2") in m1.events
+    assert m2.events == [("online", "t", "s1")]
+    view = coord.external_view("t")
+    assert view.servers_for("s1") == ["i1", "i2"]
+    assert view.servers_for("s2") == ["i1"]
+
+    # drop s2
+    coord.set_ideal_state("t", {"s1": {"i1": ONLINE, "i2": ONLINE},
+                                "s2": {"i1": DROPPED}})
+    assert ("offline", "t", "s2") in m1.events
+    assert ("dropped", "t", "s2") in m1.events
+    assert coord.external_view("t").servers_for("s2") == []
+
+    # instance death: view excludes it immediately
+    coord.deregister_participant("i2")
+    assert coord.external_view("t").servers_for("s1") == ["i1"]
+
+
+def test_state_machine_failed_transition_marks_error():
+    class Failing(StateModel):
+        def on_become_online(self, table, segment):
+            raise RuntimeError("disk full")
+
+    coord = ClusterCoordinator()
+    coord.register_participant("bad", Failing())
+    coord.set_ideal_state("t", {"s1": {"bad": ONLINE}})
+    view = coord.external_view("t")
+    assert view.segment_states["s1"]["bad"] == "ERROR"
+    assert view.servers_for("s1") == []       # ERROR is not routable
+
+
+# -- full cluster -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    work = tempfile.mkdtemp()
+    c = EmbeddedCluster(work, num_servers=2)
+    c.add_schema(make_schema())
+    c.add_table(make_table_config())
+    segs_dir = os.path.join(work, "build")
+    all_cols = []
+    for i in range(4):
+        _, cols = build_segment(f"{segs_dir}/{i}", n=1500, seed=200 + i,
+                                name=f"cl_{i}")
+        c.upload_segment("baseballStats_OFFLINE", f"{segs_dir}/{i}")
+        all_cols.append(cols)
+    merged = {k: (np.concatenate([col[k] for col in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((col[k] for col in all_cols), []))
+              for k in all_cols[0]}
+    yield c, Oracle(merged)
+    c.stop()
+
+
+def test_cluster_upload_to_queryable(cluster):
+    c, oracle = cluster
+    m = oracle.mask(lambda r: r["yearID"] > 2000)
+    resp = c.query("SELECT COUNT(*) FROM baseballStats WHERE yearID > 2000")
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert resp.num_servers_queried == 2
+    assert resp.total_docs == 6000
+
+
+def test_cluster_assignment_balanced(cluster):
+    c, _ = cluster
+    ideal = c.controller.coordinator.ideal_state("baseballStats_OFFLINE")
+    counts = {}
+    for seg, m in ideal.items():
+        for inst in m:
+            counts[inst] = counts.get(inst, 0) + 1
+    assert counts == {"Server_0": 2, "Server_1": 2}
+
+
+def test_cluster_segment_replace_same_name(cluster):
+    c, oracle = cluster
+    # re-upload cl_0 with different content; count must change accordingly
+    work = tempfile.mkdtemp()
+    _, cols = build_segment(f"{work}/new", n=700, seed=999, name="cl_0")
+    c.upload_segment("baseballStats_OFFLINE", f"{work}/new")
+    resp = c.query("SELECT COUNT(*) FROM baseballStats")
+    assert resp.aggregation_results[0].value == str(4500 + 700)
+    # restore for other tests
+    base = tempfile.mkdtemp()
+    _, cols0 = build_segment(f"{base}/orig", n=1500, seed=200, name="cl_0")
+    c.upload_segment("baseballStats_OFFLINE", f"{base}/orig")
+
+
+def test_cluster_status_checker(cluster):
+    c, _ = cluster
+    checker = SegmentStatusChecker()
+    checker.run(c.controller.manager)
+    report = checker.last_report["baseballStats_OFFLINE"]
+    assert report["segments"] == 4
+    assert report["missing"] == []
+
+
+def test_cluster_server_death_and_rebalance(cluster):
+    c, oracle = cluster
+    m = oracle.mask(lambda r: True)
+    # kill Server_1: external view loses its segments, queries go partial
+    c.controller.coordinator.deregister_participant("Server_1")
+    resp = c.query("SELECT COUNT(*) FROM baseballStats")
+    assert resp.num_servers_queried == 1
+    assert int(resp.aggregation_results[0].value) < oracle.count(m)
+
+    # rebalance onto the survivor: full results again
+    c.controller.manager.rebalance_table("baseballStats_OFFLINE")
+    resp = c.query("SELECT COUNT(*) FROM baseballStats")
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+
+    # revive Server_1 and rebalance back
+    from pinot_tpu.server.participant import ServerParticipant
+    c.controller.coordinator.register_participant(
+        "Server_1", ServerParticipant(c.servers["Server_1"],
+                                      c.controller.manager))
+    c.controller.manager.rebalance_table("baseballStats_OFFLINE")
+    resp = c.query("SELECT COUNT(*) FROM baseballStats")
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert resp.num_servers_queried == 2
+
+
+def test_retention_deletes_expired_segments():
+    work = tempfile.mkdtemp()
+    c = EmbeddedCluster(work, num_servers=1)
+    c.add_schema(make_schema())
+    cfg = make_table_config()
+    cfg.segments_config.retention_time_unit = "DAYS"
+    cfg.segments_config.retention_time_value = 365 * 5
+    c.add_table(cfg)
+    _, cols = build_segment(f"{work}/seg", n=800, seed=5, name="ret_0")
+    c.upload_segment("baseballStats_OFFLINE", f"{work}/seg")
+    assert c.query("SELECT COUNT(*) FROM baseballStats"
+                   ).aggregation_results[0].value == "800"
+
+    # yearID is the DAYS time column with values ~1990-2019: far past
+    # any 5-year retention from "now"
+    ret = RetentionManager()
+    ret.run(c.controller.manager)
+    assert c.controller.manager.segment_names(
+        "baseballStats_OFFLINE") == []
+    resp = c.query("SELECT COUNT(*) FROM baseballStats")
+    assert resp.exceptions or resp.aggregation_results[0].value == "0"
+    c.stop()
