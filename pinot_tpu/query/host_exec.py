@@ -135,10 +135,26 @@ def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
             if i >= 0:
                 member[i] = False
     elif op == FilterOperator.RANGE:
-        lo, hi = dictionary.range_to_id_interval(
-            tree.lower, tree.upper, tree.lower_inclusive,
-            tree.upper_inclusive)
-        member[lo:hi] = True
+        if getattr(dictionary, "is_sorted", True):
+            lo, hi = dictionary.range_to_id_interval(
+                tree.lower, tree.upper, tree.lower_inclusive,
+                tree.upper_inclusive)
+            member[lo:hi] = True
+        else:
+            # mutable (arrival-order) dictionary: compare every value
+            vals = dictionary.values
+            m = np.ones(card, dtype=bool)
+            if cm.data_type.is_numeric:
+                cv = _coercer(cm.data_type.np_dtype)
+            else:
+                cv = str
+            if tree.lower is not None:
+                lo_v = cv(tree.lower)
+                m &= (vals >= lo_v) if tree.lower_inclusive else (vals > lo_v)
+            if tree.upper is not None:
+                hi_v = cv(tree.upper)
+                m &= (vals <= hi_v) if tree.upper_inclusive else (vals < hi_v)
+            member[:card] = m
     elif op == FilterOperator.REGEXP_LIKE:
         pat = _re.compile(tree.values[0])
         for i in range(card):

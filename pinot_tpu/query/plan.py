@@ -263,6 +263,11 @@ class InstancePlanMaker:
 
     def make_segment_plan(self, segment: ImmutableSegment,
                           request: BrokerRequest) -> SegmentPlan:
+        if getattr(segment, "is_mutable", False):
+            # consuming segments have arrival-order (unsorted) dictionaries,
+            # which breaks the sorted-id-interval device predicates — they
+            # take the host executor until committed
+            raise UnsupportedOnDevice("mutable segment")
         plan = SegmentPlan(segment=segment, request=request)
         if request.is_aggregation:
             plan.functions = make_functions(request.aggregations)

@@ -1,0 +1,289 @@
+"""Mutable (consuming) segment: append rows, query concurrently.
+
+Parity: pinot-core/.../indexsegment/mutable/MutableSegmentImpl.java:64-198 —
+per-column mutable dictionary (ARRIVAL order: ids must stay stable as values
+arrive, so unlike immutable segments the dictionary is unsorted) + growable
+fixed-width forward indexes; queries snapshot (num_docs, lanes[:n]) without
+blocking the writer. Queries against mutable segments run on the host
+executor (unsorted dictionaries break the device kernels' sorted-id-interval
+assumption); on commit RealtimeSegmentConverter re-sorts everything into a
+standard immutable segment (RealtimeSegmentConverter.java:85-129).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.schema import FieldSpec, Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
+
+
+class MutableDictionary:
+    """Arrival-order dictionary: id = insertion rank (stable)."""
+
+    is_sorted = False
+
+    def __init__(self, data_type: DataType):
+        self.data_type = data_type
+        self._values: List = []
+        self._index: Dict = {}
+        self._np_cache: Optional[np.ndarray] = None
+
+    @property
+    def cardinality(self) -> int:
+        return len(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    @property
+    def values(self) -> np.ndarray:
+        if self._np_cache is None or len(self._np_cache) != len(self._values):
+            dtype = self.data_type.np_dtype if self.data_type.is_numeric \
+                else object
+            self._np_cache = np.array(self._values, dtype=dtype)
+        return self._np_cache
+
+    def index_of(self, value) -> int:
+        v = self._coerce(value)
+        return self._index.get(v, -1)
+
+    def index_of_or_add(self, value) -> int:
+        v = self._coerce(value)
+        i = self._index.get(v)
+        if i is None:
+            i = len(self._values)
+            self._values.append(v)
+            self._index[v] = i
+        return i
+
+    def get(self, dict_id: int):
+        return self._values[dict_id]
+
+    def decode(self, dict_ids: np.ndarray) -> np.ndarray:
+        return self.values[dict_ids]
+
+    def _coerce(self, value):
+        if self.data_type.is_numeric:
+            try:
+                return int(str(value)) if \
+                    self.data_type.np_dtype.kind in "iu" else float(value)
+            except ValueError:
+                return float(value)
+        if self.data_type == DataType.BYTES:
+            return value if isinstance(value, bytes) \
+                else bytes.fromhex(str(value))
+        return str(value)
+
+    @property
+    def min_value(self):
+        return min(self._values) if self._values else None
+
+    @property
+    def max_value(self):
+        return max(self._values) if self._values else None
+
+
+class _GrowableArray:
+    """Append-only numpy array with capacity doubling; reads of [:n] are
+    stable because growth copies into a NEW buffer (readers keep slicing a
+    consistent snapshot)."""
+
+    def __init__(self, dtype, capacity: int = 4096):
+        self._arr = np.zeros(capacity, dtype=dtype)
+        self.n = 0
+
+    def append(self, v) -> None:
+        if self.n == len(self._arr):
+            bigger = np.zeros(len(self._arr) * 2, dtype=self._arr.dtype)
+            bigger[: self.n] = self._arr
+            self._arr = bigger
+        self._arr[self.n] = v
+        self.n += 1
+
+    def snapshot(self, n: int) -> np.ndarray:
+        return self._arr[:n]
+
+
+class _MutableDataSource:
+    """DataSource-compatible column view over mutable storage."""
+
+    def __init__(self, field: FieldSpec, has_dictionary: bool):
+        self.field = field
+        self.has_dictionary = has_dictionary
+        self.dictionary = MutableDictionary(field.data_type) \
+            if has_dictionary else None
+        self.inverted_index = None
+        self.bloom_filter = None
+        self.sorted_ranges = None
+        if field.single_value:
+            dtype = np.int32 if has_dictionary else field.data_type.np_dtype
+            self._sv = _GrowableArray(dtype)
+            self._mv: Optional[List[List[int]]] = None
+        else:
+            self._sv = None
+            self._mv = []
+        self._snapshot_n = 0
+        self._mv_cache: Optional[np.ndarray] = None
+
+    # -- write path --------------------------------------------------------
+    def add(self, value) -> None:
+        f = self.field
+        if f.single_value:
+            v = f.convert(value)
+            if self.has_dictionary:
+                self._sv.append(self.dictionary.index_of_or_add(v))
+            else:
+                self._sv.append(v)
+        else:
+            vs = value if isinstance(value, (list, tuple)) else (
+                [] if value is None else [value])
+            converted = [f.convert(x) for x in vs] or [f.default_null_value]
+            self._mv.append([self.dictionary.index_of_or_add(x)
+                             for x in converted])
+
+    # -- read path (snapshot at n docs) ------------------------------------
+    def bind(self, n: int) -> "_MutableDataSource":
+        self._snapshot_n = n
+        return self
+
+    @property
+    def metadata(self) -> ColumnMetadata:
+        card = self.dictionary.cardinality if self.has_dictionary else \
+            self._snapshot_n
+        return ColumnMetadata(
+            name=self.field.name, data_type=self.field.data_type,
+            cardinality=card,
+            bits_per_element=max(1, int(np.ceil(np.log2(max(card, 2))))),
+            single_value=self.field.single_value, sorted=False,
+            has_dictionary=self.has_dictionary,
+            min_value=self.dictionary.min_value if self.has_dictionary
+            else None,
+            max_value=self.dictionary.max_value if self.has_dictionary
+            else None,
+            total_number_of_entries=self._snapshot_n)
+
+    @property
+    def dict_ids(self) -> Optional[np.ndarray]:
+        if self._sv is None or not self.has_dictionary:
+            return None
+        return self._sv.snapshot(self._snapshot_n)
+
+    @property
+    def raw_values(self) -> Optional[np.ndarray]:
+        if self._sv is None or self.has_dictionary:
+            return None
+        return self._sv.snapshot(self._snapshot_n)
+
+    @property
+    def mv_dict_ids(self) -> Optional[np.ndarray]:
+        if self._mv is None:
+            return None
+        n = self._snapshot_n
+        if self._mv_cache is not None and len(self._mv_cache) == n:
+            return self._mv_cache
+        card = self.dictionary.cardinality
+        rows = self._mv[:n]
+        width = max((len(r) for r in rows), default=1)
+        out = np.full((n, width), card, dtype=np.int32)
+        for i, r in enumerate(rows):
+            out[i, : len(r)] = r
+        self._mv_cache = out
+        return out
+
+    def raw_column(self, n: int) -> List:
+        """Decoded values for the segment converter."""
+        if self._mv is not None:
+            return [[self.dictionary.get(i) for i in r]
+                    for r in self._mv[:n]]
+        arr = self._sv.snapshot(n)
+        if self.has_dictionary:
+            return list(self.dictionary.decode(arr))
+        return list(arr)
+
+
+class MutableSegmentImpl:
+    """The consuming segment: single writer, many reader snapshots."""
+
+    is_mutable = True
+
+    def __init__(self, schema: Schema, table_config: TableConfig,
+                 segment_name: str):
+        self.schema = schema
+        self.table_config = table_config
+        self.segment_name = segment_name
+        no_dict = set(table_config.indexing_config.no_dictionary_columns)
+        self._sources = {
+            f.name: _MutableDataSource(f, f.name not in no_dict)
+            for f in schema.fields}
+        self._num_docs = 0
+        self._lock = threading.Lock()
+        self._start_time: Optional[int] = None
+        self._end_time: Optional[int] = None
+        self.creation_time_ms = int(time.time() * 1e3)
+
+    # -- write -------------------------------------------------------------
+    def index_row(self, row: dict) -> bool:
+        tc = self.schema.time_column
+        with self._lock:
+            for name, ds in self._sources.items():
+                ds.add(row.get(name))
+            if tc is not None:
+                try:
+                    t = int(row.get(tc.name))
+                    self._start_time = t if self._start_time is None \
+                        else min(self._start_time, t)
+                    self._end_time = t if self._end_time is None \
+                        else max(self._end_time, t)
+                except (TypeError, ValueError):
+                    pass
+            self._num_docs += 1
+        return True
+
+    # -- query interface (ImmutableSegment-compatible) ---------------------
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    @property
+    def padded_docs(self) -> int:
+        from pinot_tpu.segment.loader import padded_size
+        return padded_size(max(self._num_docs, 1))
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._sources.keys())
+
+    def has_column(self, column: str) -> bool:
+        return column in self._sources
+
+    def data_source(self, column: str) -> _MutableDataSource:
+        ds = self._sources[column]
+        return ds.bind(self._num_docs)
+
+    @property
+    def metadata(self) -> SegmentMetadata:
+        tc = self.schema.time_column
+        return SegmentMetadata(
+            segment_name=self.segment_name,
+            table_name=self.table_config.table_name,
+            total_docs=self._num_docs,
+            columns={name: ds.bind(self._num_docs).metadata
+                     for name, ds in self._sources.items()},
+            time_column=tc.name if tc else None,
+            time_unit=tc.time_unit.name if tc else None,
+            start_time=self._start_time, end_time=self._end_time,
+            creation_time_ms=self.creation_time_ms)
+
+    def columnar_snapshot(self) -> Dict[str, List]:
+        """Decoded columns for RealtimeSegmentConverter → SegmentCreator."""
+        n = self._num_docs
+        return {name: ds.raw_column(n) for name, ds in self._sources.items()}
+
+    def destroy(self) -> None:
+        self._sources.clear()
