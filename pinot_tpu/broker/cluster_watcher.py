@@ -11,7 +11,7 @@ from typing import Optional
 
 from pinot_tpu.broker.routing import RoutingManager
 from pinot_tpu.broker.time_boundary import TimeBoundaryService
-from pinot_tpu.common.cluster_state import TableView
+from pinot_tpu.common.cluster_state import ONLINE, TableView
 from pinot_tpu.common.table_name import raw_table, table_type
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.state_machine import ClusterCoordinator
@@ -36,20 +36,33 @@ class BrokerClusterWatcher:
             return
         self.routing.update_view(view)
         if table_type(view.table_name) == "OFFLINE":
-            self._update_time_boundary(view.table_name)
+            self._update_time_boundary(view)
 
-    def _update_time_boundary(self, offline_table: str) -> None:
+    def _update_time_boundary(self, view: TableView) -> None:
+        offline_table = view.table_name
         schema = self.manager.get_schema(raw_table(offline_table))
         if schema is None:
             return
         tc = schema.time_column
         if tc is None:
             return
+        # Only segments actually served (at least one ONLINE replica in the
+        # external view — matching what RoutingManager will route to) may
+        # advance the boundary, and non-positive end times are skipped —
+        # parity: HelixExternalViewBasedTimeBoundaryService filters to the EV
+        # and ignores endTime <= 0. With an async coordinator the property
+        # store can hold segments no server serves yet; advancing past them
+        # would silently drop rows from hybrid results.
+        served = {seg for seg, states in view.segment_states.items()
+                  if ONLINE in states.values()}
         ends, unit = [], None
         for seg in self.manager.segment_names(offline_table):
+            if seg not in served:
+                continue
             meta = self.manager.segment_metadata(offline_table, seg) or {}
-            if meta.get("endTime") is not None:
-                ends.append(meta["endTime"])
+            end = meta.get("endTime")
+            if end is not None and end > 0:
+                ends.append(end)
                 unit = meta.get("timeUnit") or unit
         if ends:
             self.time_boundary.update_from_segments(

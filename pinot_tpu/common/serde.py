@@ -144,7 +144,7 @@ def instance_request_from_bytes(b: bytes) -> InstanceRequest:
 # ---------------------------------------------------------------------------
 # Typed binary object serde (DataTable cells / aggregation intermediates)
 #
-# Tags: N null, i int64, I bigint(str), d float64, s str, b bytes,
+# Tags: N null, B bool, i int64, I bigint(str), d float64, s str, b bytes,
 #       t tuple, l list, S set, D dict (sorted by key bytes for determinism)
 # ---------------------------------------------------------------------------
 
@@ -171,8 +171,8 @@ def _write_obj(out: bytearray, v: Any) -> None:
     if v is None:
         out += b"N"
     elif isinstance(v, bool):
-        out += b"i"
-        out += _I64.pack(int(v))
+        out += b"B"
+        out += b"\x01" if v else b"\x00"
     elif isinstance(v, int):
         if -(2**63) <= v < 2**63:
             out += b"i"
@@ -228,6 +228,8 @@ def _read_obj(b: bytes, off: int):
     off += 1
     if tag == b"N":
         return None, off
+    if tag == b"B":
+        return b[off] != 0, off + 1
     if tag == b"i":
         return _I64.unpack_from(b, off)[0], off + 8
     if tag == b"I":

@@ -23,7 +23,9 @@ One jitted shard_map executable serves every query with the same static spec
 """
 from __future__ import annotations
 
+import collections
 import functools
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -226,19 +228,57 @@ class ShardedQueryExecutor:
     """
 
     def __init__(self, mesh: Optional[Mesh] = None,
-                 plan_maker: Optional[InstancePlanMaker] = None):
+                 plan_maker: Optional[InstancePlanMaker] = None,
+                 max_stacks: int = 4):
         self.mesh = mesh or make_mesh()
         self.plan_maker = plan_maker or InstancePlanMaker()
-        self._stacks: Dict[Tuple[str, ...], StackedSegments] = {}
+        # Bounded LRU keyed on the canonical (sorted) name tuple: with
+        # randomized routing each server sees many orderings/subsets of the
+        # same segment set; sorting collapses orderings to one stack and the
+        # LRU bound caps HBM duplication across subsets. A hit additionally
+        # requires segment object identity so a refreshed segment (same
+        # name, new object) rebuilds instead of serving stale lanes.
+        self.max_stacks = max_stacks
+        self._stacks: "collections.OrderedDict[Tuple[str, ...], StackedSegments]" = \
+            collections.OrderedDict()
+        # Queries run on scheduler worker threads while evict_segment fires
+        # from segment-transition threads; the lock guards the OrderedDict
+        # and the generation counter closes the build/evict race (a stack
+        # built concurrently with an eviction is served but never cached).
+        self._lock = threading.Lock()
+        self._evict_gen = 0
 
     def stack_for(self, segments: Sequence[ImmutableSegment]
                   ) -> StackedSegments:
-        key = tuple(s.segment_name for s in segments)
-        st = self._stacks.get(key)
-        if st is None or st.segments != list(segments):
-            st = StackedSegments(segments, self.mesh)
-            self._stacks[key] = st
+        ordered = sorted(segments, key=lambda s: s.segment_name)
+        key = tuple(s.segment_name for s in ordered)
+        with self._lock:
+            st = self._stacks.get(key)
+            if st is not None and len(st.segments) == len(ordered) and \
+                    all(a is b for a, b in zip(st.segments, ordered)):
+                self._stacks.move_to_end(key)
+                return st
+            gen = self._evict_gen
+        st = StackedSegments(ordered, self.mesh)
+        with self._lock:
+            if self._evict_gen == gen:
+                self._stacks[key] = st
+                self._stacks.move_to_end(key)
+                while len(self._stacks) > self.max_stacks:
+                    self._stacks.popitem(last=False)
         return st
+
+    def evict_segment(self, segment_name: str) -> None:
+        """Drop every cached stack containing `segment_name`.
+
+        Wired as a segment-removal listener by the server data manager so a
+        refreshed/deleted segment's HBM lanes are released promptly instead
+        of lingering until LRU pressure.
+        """
+        with self._lock:
+            self._evict_gen += 1
+            for key in [k for k in self._stacks if segment_name in k]:
+                del self._stacks[key]
 
     def execute(self, request: BrokerRequest,
                 segments: Sequence[ImmutableSegment]

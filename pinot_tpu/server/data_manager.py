@@ -61,6 +61,19 @@ class TableDataManager:
         self.table_name = table_name
         self._segments: Dict[str, SegmentDataManager] = {}
         self._lock = threading.Lock()
+        self._removal_listeners: List = []
+
+    def add_removal_listener(self, fn) -> None:
+        """fn(segment_name) fires when a segment is replaced or removed —
+        lets caches (e.g. the sharded stack cache) evict promptly."""
+        self._removal_listeners.append(fn)
+
+    def _notify_removed(self, name: str) -> None:
+        for fn in self._removal_listeners:
+            try:
+                fn(name)
+            except Exception:  # noqa: BLE001 — a listener bug must not
+                pass           # abort the transition or leak the segment
 
     def add_segment(self, segment: ImmutableSegment) -> None:
         sdm = SegmentDataManager(segment)
@@ -68,6 +81,7 @@ class TableDataManager:
             old = self._segments.get(sdm.name)
             self._segments[sdm.name] = sdm
         if old is not None:
+            self._notify_removed(sdm.name)
             self._release(old)
 
     def add_segment_from_dir(self, seg_dir: str) -> None:
@@ -77,6 +91,7 @@ class TableDataManager:
         with self._lock:
             old = self._segments.pop(name, None)
         if old is not None:
+            self._notify_removed(name)
             self._release(old)
 
     def segment_names(self) -> List[str]:
@@ -122,6 +137,15 @@ class InstanceDataManager:
     def __init__(self):
         self._tables: Dict[str, TableDataManager] = {}
         self._lock = threading.Lock()
+        self._removal_listeners: List = []
+
+    def add_removal_listener(self, fn) -> None:
+        """Attach fn(segment_name) to every current and future table."""
+        with self._lock:
+            self._removal_listeners.append(fn)
+            tables = list(self._tables.values())
+        for tdm in tables:
+            tdm.add_removal_listener(fn)
 
     def table(self, table_name: str, create: bool = False
               ) -> Optional[TableDataManager]:
@@ -129,6 +153,8 @@ class InstanceDataManager:
             tdm = self._tables.get(table_name)
             if tdm is None and create:
                 tdm = TableDataManager(table_name)
+                for fn in self._removal_listeners:
+                    tdm.add_removal_listener(fn)
                 self._tables[table_name] = tdm
             return tdm
 

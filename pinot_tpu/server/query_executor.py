@@ -29,6 +29,7 @@ class InstanceQueryExecutor:
         if mesh is not None:
             from pinot_tpu.parallel.sharded import ShardedQueryExecutor
             self.sharded = ShardedQueryExecutor(mesh=mesh)
+            data_manager.add_removal_listener(self.sharded.evict_segment)
         self.default_timeout_ms = default_timeout_ms
 
     def execute(self, request: InstanceRequest) -> DataTable:

@@ -242,3 +242,89 @@ def test_hybrid_time_boundary_split():
                    (rt_mask & (y > 2008)).sum())
     assert resp.aggregation_results[0].value == str(expected)
     server.stop()
+
+
+def test_time_boundary_only_from_served_segments():
+    """The boundary must come from EV-present segments with endTime > 0 —
+    a property-store segment no server serves yet must not advance it."""
+    from fixtures import make_schema
+
+    from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+    from pinot_tpu.common.cluster_state import TableView
+
+    schema = make_schema()
+
+    class FakeCoord:
+        def watch_external_views(self, fn):
+            pass
+
+        def tables(self):
+            return []
+
+    class FakeManager:
+        meta = {
+            "seg_served": {"endTime": 100, "timeUnit": "DAYS"},
+            "seg_unserved": {"endTime": 200, "timeUnit": "DAYS"},
+            "seg_bad_end": {"endTime": -1, "timeUnit": "DAYS"},
+        }
+
+        def get_schema(self, name):
+            return schema
+
+        def segment_names(self, table):
+            return list(self.meta)
+
+        def segment_metadata(self, table, seg):
+            return self.meta[seg]
+
+    w = BrokerClusterWatcher(FakeCoord(), FakeManager())
+    view = TableView("baseballStats_OFFLINE", {
+        "seg_served": {"i1": "ONLINE"},
+        "seg_bad_end": {"i1": "ONLINE"},
+    })
+    w._update_time_boundary(view)
+    info = w.time_boundary.get("baseballStats_OFFLINE")
+    assert info is not None and info.column == "yearID"
+    assert info.value == 100 - 1  # max served end − one unit; 200 excluded
+
+
+def test_time_boundary_ignores_offline_replicas():
+    """A segment whose replicas are all OFFLINE in the EV is not routable,
+    so it must not advance the boundary either."""
+    from fixtures import make_schema
+
+    from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+    from pinot_tpu.common.cluster_state import TableView
+
+    schema = make_schema()
+
+    class FakeCoord:
+        def watch_external_views(self, fn):
+            pass
+
+        def tables(self):
+            return []
+
+    class FakeManager:
+        meta = {
+            "seg_on": {"endTime": 50, "timeUnit": "DAYS"},
+            "seg_off": {"endTime": 500, "timeUnit": "DAYS"},
+        }
+
+        def get_schema(self, name):
+            return schema
+
+        def segment_names(self, table):
+            return list(self.meta)
+
+        def segment_metadata(self, table, seg):
+            return self.meta[seg]
+
+    w = BrokerClusterWatcher(FakeCoord(), FakeManager())
+    view = TableView("baseballStats_OFFLINE", {
+        "seg_on": {"i1": "ONLINE"},
+        "seg_off": {"i1": "OFFLINE", "i2": "ERROR"},
+    })
+    w._update_time_boundary(view)
+    info = w.time_boundary.get("baseballStats_OFFLINE")
+    assert info.value == 50 - 1

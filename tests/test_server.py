@@ -37,9 +37,17 @@ def test_object_serde_roundtrip():
         (1, 2.5, "x"), [1, [2, [3]]], {1, 2, 3}, {"a", "b"},
         {"k": 1, "j": (2.0, 3)}, {(1, 2): {3, 4}},
         (None, set(), {}, []),
+        True, False, (True, 1, False, 0), {"flag": True},
     ]
     for v in cases:
         assert obj_from_bytes(obj_to_bytes(v)) == v, v
+    # booleans must keep their type across the wire (distinct tag), not
+    # collapse to 1/0 like the round-1 int encoding did
+    for v in (True, False):
+        rt = obj_from_bytes(obj_to_bytes(v))
+        assert isinstance(rt, bool) and rt is v
+    rt = obj_from_bytes(obj_to_bytes((True, 1)))
+    assert isinstance(rt[0], bool) and not isinstance(rt[1], bool)
 
 
 def test_request_json_roundtrip():

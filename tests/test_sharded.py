@@ -265,3 +265,56 @@ def test_engine_falls_back_when_not_shardable():
     resp = engine.query("SELECT SUM(runs) FROM baseballStats")
     assert float(resp.aggregation_results[0].value) == pytest.approx(
         float(merged_runs.sum()))
+
+
+def test_stack_cache_canonical_key_lru_and_evict(cluster):
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh, max_stacks=2)
+    pql = "SELECT SUM(runs) FROM baseballStats WHERE yearID >= 1980"
+    # different orderings of the same segment set share one stack
+    _run(sharded, segs, pql)
+    _run(sharded, list(reversed(segs)), pql)
+    assert len(sharded._stacks) == 1
+    # distinct subsets get distinct stacks, bounded by max_stacks (LRU)
+    _run(sharded, segs[:4] + segs[4:], pql)  # same set again → still 1
+    st_full = next(iter(sharded._stacks.values()))
+    request = compile_pql(pql)
+    sharded.execute(request, segs[:4])
+    sharded.execute(request, segs[4:])
+    assert len(sharded._stacks) == 2  # full-set stack evicted by LRU
+    assert st_full not in sharded._stacks.values()
+    # explicit eviction drops every stack containing the segment
+    sharded.evict_segment(segs[0].segment_name)
+    assert all(segs[0].segment_name not in k for k in sharded._stacks)
+
+
+def test_stack_rebuilds_on_segment_refresh(cluster):
+    import copy
+    segs, oracle, mesh = cluster
+    sharded = ShardedQueryExecutor(mesh=mesh)
+    pql = "SELECT SUM(runs) FROM baseballStats WHERE yearID >= 1980"
+    _run(sharded, segs, pql)
+    st0 = next(iter(sharded._stacks.values()))
+    # same names, one replaced object (refresh) → rebuild, not stale hit
+    refreshed = list(segs)
+    refreshed[3] = copy.copy(segs[3])
+    _run(sharded, refreshed, pql)
+    st1 = next(iter(sharded._stacks.values()))
+    assert st1 is not st0
+
+
+def test_data_manager_removal_listener_evicts_stack():
+    from pinot_tpu.server import ServerInstance
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, n_segs=4, n=1024, seed=5)
+    server = ServerInstance(mesh=make_mesh())
+    tdm = server.data_manager.table("baseballStats_OFFLINE", create=True)
+    for s in segs:
+        tdm.add_segment(s)
+    request = compile_pql(
+        "SELECT SUM(runs) FROM baseballStats WHERE yearID >= 1980")
+    server.executor.sharded.execute(request, segs)
+    assert len(server.executor.sharded._stacks) == 1
+    tdm.remove_segment(segs[0].segment_name)
+    assert len(server.executor.sharded._stacks) == 0
+    server.data_manager.shutdown()
