@@ -1,0 +1,37 @@
+"""Segment completion protocol: consuming-server ↔ controller messages.
+
+Parity: pinot-common/.../protocols/SegmentCompletionProtocol.java:50-117 —
+message types segmentConsumed / segmentCommitStart / segmentCommitEnd and
+response statuses HOLD / CATCHUP / DISCARD / KEEP / COMMIT /
+COMMIT_SUCCESS / COMMIT_CONTINUE / FAILED. Servers report their stream
+offset when a consuming segment hits its end criteria; the controller's
+completion FSM elects a committer and steers every replica.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# response statuses (SegmentCompletionProtocol.ControllerResponseStatus)
+HOLD = "HOLD"                       # keep the built rows, re-poll soon
+CATCHUP = "CATCHUP"                 # consume up to `offset`, then re-poll
+DISCARD = "DISCARD"                 # drop local rows; committed copy will
+#                                     arrive via the ONLINE transition
+KEEP = "KEEP"                       # local rows match the committed end
+COMMIT = "COMMIT"                   # you are the committer: build + upload
+COMMIT_SUCCESS = "COMMIT_SUCCESS"
+COMMIT_CONTINUE = "COMMIT_CONTINUE"
+FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class CompletionResponse:
+    status: str
+    offset: Optional[int] = None    # CATCHUP target / committed end offset
+
+    def to_json(self) -> dict:
+        return {"status": self.status, "offset": self.offset}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CompletionResponse":
+        return cls(d["status"], d.get("offset"))

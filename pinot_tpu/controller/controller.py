@@ -12,8 +12,10 @@ from typing import List, Optional
 
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.periodic import (PeriodicTask,
-                                           PeriodicTaskScheduler)
+                                           PeriodicTaskScheduler,
+                                           RealtimeSegmentValidationManager)
 from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.controller.realtime_manager import RealtimeSegmentManager
 from pinot_tpu.controller.state_machine import ClusterCoordinator
 
 
@@ -24,7 +26,13 @@ class Controller:
         self.store = store or PropertyStore()
         self.coordinator = ClusterCoordinator(self.store)
         self.manager = ResourceManager(self.coordinator, deep_store_dir)
+        self.realtime = RealtimeSegmentManager(self.manager)
         self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks)
+        if periodic_tasks is None:
+            # scheduler owns the defaults; the controller only appends the
+            # realtime validation task (it needs the realtime manager)
+            self.periodic.tasks.append(
+                RealtimeSegmentValidationManager(self.realtime))
 
     def start(self) -> None:
         self.periodic.start()

@@ -88,6 +88,21 @@ class SegmentStatusChecker(PeriodicTask):
         self.last_report = report
 
 
+class RealtimeSegmentValidationManager(PeriodicTask):
+    """Repairs realtime consumption: every stream partition must have a
+    live consuming segment (parity: RealtimeSegmentValidationManager →
+    PinotLLCRealtimeSegmentManager.ensureAllPartitionsConsuming:891)."""
+
+    name = "RealtimeSegmentValidationManager"
+    interval_s = 60.0
+
+    def __init__(self, realtime_manager):
+        self.realtime_manager = realtime_manager
+
+    def run(self, manager: ResourceManager) -> None:
+        self.realtime_manager.ensure_all_partitions_consuming()
+
+
 class PeriodicTaskScheduler:
     def __init__(self, manager: ResourceManager,
                  tasks: Optional[List[PeriodicTask]] = None):

@@ -1,0 +1,352 @@
+"""Controller realtime plane: LLC segment lifecycle + completion FSM.
+
+Parity: pinot-controller/.../helix/core/realtime/ —
+PinotLLCRealtimeSegmentManager (setupNewTable :198 creates per-partition
+IN_PROGRESS segment metadata + CONSUMING ideal state; commitSegmentMetadata
+:389-462 flips IN_PROGRESS→DONE, creates the next sequence, steps the ideal
+state old CONSUMING→ONLINE / new →CONSUMING; ensureAllPartitionsConsuming
+:891-1133 repairs dead partitions) and SegmentCompletionManager.java:55-475
+(per-segment FSM: HOLDING → committer election by max offset →
+COMMITTER_NOTIFIED → COMMITTING → COMMITTED; losers HOLD/CATCHUP/DISCARD).
+
+The FSM rebuilds from the property store on restart (SURVEY §5.4): segment
+status/offsets are durable, the in-memory FSM is only an election cache.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from pinot_tpu.common import completion as proto
+from pinot_tpu.common.cluster_state import CONSUMING, OFFLINE, ONLINE
+from pinot_tpu.common.completion import CompletionResponse
+from pinot_tpu.common.table_config import TableConfig, TableType
+from pinot_tpu.controller.assignment import make_assignment
+from pinot_tpu.controller.manager import SEGMENTS, ResourceManager
+from pinot_tpu.realtime.registry import resolve_stream_config
+from pinot_tpu.realtime.segment_name import LLCSegmentName
+from pinot_tpu.segment.metadata import SegmentMetadata
+
+log = logging.getLogger(__name__)
+
+IN_PROGRESS = "IN_PROGRESS"
+DONE = "DONE"
+
+
+class _CompletionFSM:
+    """Election state for one committing segment."""
+
+    def __init__(self, replicas: List[str]):
+        self.replicas = list(replicas)
+        self.offsets: Dict[str, int] = {}
+        self.report_order: List[str] = []
+        self.first_report_ms: Optional[float] = None
+        self.winner: Optional[str] = None
+        self.target: Optional[int] = None
+
+
+class RealtimeSegmentManager:
+    def __init__(self, manager: ResourceManager,
+                 election_wait_ms: float = 2_000.0):
+        self.manager = manager
+        self.coordinator = manager.coordinator
+        self.store = manager.store
+        self.election_wait_ms = election_wait_ms
+        self._fsm: Dict[str, _CompletionFSM] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Table setup + repair
+    # ------------------------------------------------------------------
+
+    def setup_table(self, config: TableConfig,
+                    assignment: str = "balanced") -> str:
+        """Create the realtime table and its partition-0 consuming segments.
+
+        Parity: PinotLLCRealtimeSegmentManager.setupNewTable:198.
+        """
+        assert config.table_type == TableType.REALTIME
+        table = self.manager.add_table(config, assignment=assignment)
+        self.ensure_all_partitions_consuming(table)
+        return table
+
+    def ensure_all_partitions_consuming(self,
+                                        table: Optional[str] = None) -> None:
+        """Create/repair consuming segments so every stream partition has a
+        live CONSUMING replica set.
+
+        Parity: ensureAllPartitionsConsuming:891-1133 — also the
+        consuming-partition repair path after server death.
+        """
+        tables = [table] if table else [
+            t for t in self.manager.table_names() if t.endswith("_REALTIME")]
+        for t in tables:
+            config = self.manager.get_table_config(t)
+            if config is None or \
+                    not config.indexing_config.stream_configs:
+                continue
+            try:
+                stream = resolve_stream_config(config)
+            except KeyError as e:
+                log.warning("table %s: unresolvable stream config (%s)", t, e)
+                continue
+            meta_provider = stream.consumer_factory.create_metadata_provider(
+                stream)
+            n_parts = meta_provider.partition_count()
+            for p in range(n_parts):
+                self._ensure_partition_consuming(t, config, stream,
+                                                 meta_provider, p)
+
+    def _latest_segment(self, table: str, partition: int
+                        ) -> Optional[LLCSegmentName]:
+        latest = None
+        for name in self.manager.segment_names(table):
+            if not LLCSegmentName.is_llc(name):
+                continue
+            llc = LLCSegmentName.parse(name)
+            if llc.partition != partition:
+                continue
+            if latest is None or llc.sequence > latest.sequence:
+                latest = llc
+        return latest
+
+    def _ensure_partition_consuming(self, table, config, stream,
+                                    meta_provider, partition: int) -> None:
+        raw = config.table_name
+        latest = self._latest_segment(table, partition)
+        if latest is None:
+            start = meta_provider.fetch_offset(partition,
+                                               stream.offset_criteria)
+            self._create_consuming_segment(
+                table, config, LLCSegmentName(raw, partition, 0), start)
+            return
+        meta = self.manager.segment_metadata(table, latest.name) or {}
+        if meta.get("status") == DONE:
+            # last segment committed but no successor (e.g. controller died
+            # mid-commit): flip its replicas to the committed copy and
+            # create the next sequence from its end offset
+            ideal = self.coordinator.ideal_state(table)
+            stale = sorted(ideal.get(latest.name, {}))
+            if stale and set(ideal[latest.name].values()) != {ONLINE}:
+
+                def flip(segments):
+                    segments[latest.name] = {i: ONLINE for i in stale}
+                    return segments
+
+                self.coordinator.update_ideal_state(table, flip)
+            self._create_consuming_segment(table, config, latest.next(),
+                                           int(meta["endOffset"]))
+            return
+        # IN_PROGRESS: make sure a live, non-errored replica is consuming
+        ideal = self.coordinator.ideal_state(table)
+        live = set(self.coordinator.live_instances())
+        assigned = set(ideal.get(latest.name, {}))
+        stopped = set(meta.get("stoppedInstances", []))
+        if (assigned & live) - stopped:
+            return
+        servers = self.coordinator.live_instances()
+        if not servers:
+            return
+        replicas = config.segments_config.replication
+        strategy = self.manager._assignments.setdefault(
+            table, make_assignment("balanced"))
+        if assigned:
+            # bounce through OFFLINE so a reassignment landing on the same
+            # instance still fires a fresh OFFLINE→CONSUMING transition
+            # (the state machine skips same-state targets)
+            def offline(segments):
+                segments[latest.name] = {i: OFFLINE for i in
+                                         sorted(assigned)}
+                return segments
+
+            self.coordinator.update_ideal_state(table, offline)
+        chosen = strategy.assign(latest.name, servers, replicas,
+                                 self.coordinator.ideal_state(table))
+        log.info("repair: reassigning consuming %s/%s -> %s", table,
+                 latest.name, chosen)
+        with self._lock:
+            self._fsm.pop(latest.name, None)   # stale election state
+        if stopped:
+            self.store.update(
+                f"{SEGMENTS}/{table}/{latest.name}",
+                lambda old: {k: v for k, v in (old or {}).items()
+                             if k != "stoppedInstances"})
+
+        def reassign(segments):
+            segments[latest.name] = {inst: CONSUMING for inst in chosen}
+            return segments
+
+        self.coordinator.update_ideal_state(table, reassign)
+
+    def _create_consuming_segment(self, table: str, config: TableConfig,
+                                  llc: LLCSegmentName,
+                                  start_offset: int) -> None:
+        self.store.set(f"{SEGMENTS}/{table}/{llc.name}", {
+            "segmentName": llc.name,
+            "partition": llc.partition,
+            "sequence": llc.sequence,
+            "status": IN_PROGRESS,
+            "startOffset": int(start_offset),
+            "creationTimeMs": int(time.time() * 1e3),
+        })
+        servers = self.coordinator.live_instances()
+        replicas = config.segments_config.replication
+        strategy = self.manager._assignments.setdefault(
+            table, make_assignment("balanced"))
+        ideal = self.coordinator.ideal_state(table)
+        chosen = strategy.assign(llc.name, servers, replicas, ideal) \
+            if servers else []
+
+        def add(segments):
+            segments[llc.name] = {inst: CONSUMING for inst in chosen}
+            return segments
+
+        self.coordinator.update_ideal_state(table, add)
+
+    # ------------------------------------------------------------------
+    # Completion protocol (controller side)
+    # ------------------------------------------------------------------
+
+    def segment_consumed(self, table: str, segment: str, instance: str,
+                         offset: int) -> CompletionResponse:
+        """A replica reached its end criteria at `offset`.
+
+        Parity: SegmentCompletionManager FSM :321-475 — first reports HOLD
+        until every replica reported (or the election window passed), then
+        the max-offset replica gets COMMIT, laggards get CATCHUP, and
+        late reporters on a committed segment get KEEP/DISCARD.
+        """
+        meta = self.manager.segment_metadata(table, segment) or {}
+        if meta.get("status") == DONE:
+            end = int(meta.get("endOffset", -1))
+            if offset == end:
+                return CompletionResponse(proto.KEEP, end)
+            return CompletionResponse(proto.DISCARD, end)
+        with self._lock:
+            fsm = self._fsm.get(segment)
+            if fsm is None:
+                replicas = sorted(
+                    self.coordinator.ideal_state(table).get(segment, {}))
+                fsm = self._fsm[segment] = _CompletionFSM(replicas or
+                                                          [instance])
+            if instance not in fsm.offsets:
+                fsm.report_order.append(instance)
+            fsm.offsets[instance] = int(offset)
+            now = time.monotonic() * 1e3
+            if fsm.first_report_ms is None:
+                fsm.first_report_ms = now
+            if fsm.winner is None:
+                all_reported = set(fsm.replicas) <= set(fsm.offsets)
+                window_passed = (now - fsm.first_report_ms
+                                 ) >= self.election_wait_ms
+                if all_reported or window_passed:
+                    best = max(fsm.offsets.values())
+                    fsm.winner = next(i for i in fsm.report_order
+                                      if fsm.offsets[i] == best)
+                    fsm.target = best
+            if fsm.winner is None:
+                return CompletionResponse(proto.HOLD)
+            if instance == fsm.winner:
+                if offset < fsm.target:
+                    return CompletionResponse(proto.CATCHUP, fsm.target)
+                return CompletionResponse(proto.COMMIT, fsm.target)
+            # losers catch up to the winner's offset (so their rows match
+            # the committed end — parity with the reference's CATCHUP),
+            # then hold until the winner commits → KEEP/DISCARD above
+            if offset < fsm.target:
+                return CompletionResponse(proto.CATCHUP, fsm.target)
+            return CompletionResponse(proto.HOLD)
+
+    def stopped_consuming(self, table: str, segment: str, instance: str,
+                          reason: str = "") -> None:
+        """A replica's consumer died (build/commit failure, fatal stream
+        error). Recorded durably so the validation task can repair the
+        partition even though the server process itself is still live.
+
+        Parity: SegmentCompletionProtocol.stoppedConsuming +
+        RealtimeSegmentValidationManager picking it up.
+        """
+        log.warning("stoppedConsuming %s/%s on %s: %s", table, segment,
+                    instance, reason)
+
+        def mark(old):
+            rec = dict(old or {})
+            stopped = set(rec.get("stoppedInstances", []))
+            stopped.add(instance)
+            rec["stoppedInstances"] = sorted(stopped)
+            return rec
+
+        self.store.update(f"{SEGMENTS}/{table}/{segment}", mark)
+
+    def commit_start(self, table: str, segment: str, instance: str,
+                     offset: int) -> CompletionResponse:
+        with self._lock:
+            fsm = self._fsm.get(segment)
+            if fsm is None or fsm.winner != instance or \
+                    offset != fsm.target:
+                return CompletionResponse(proto.FAILED)
+        return CompletionResponse(proto.COMMIT_CONTINUE, offset)
+
+    def commit_end(self, table: str, segment: str, instance: str,
+                   offset: int, segment_dir: str) -> CompletionResponse:
+        """Winner uploaded its built segment: persist + step the cluster.
+
+        Parity: commitSegmentMetadata:389-462 (split-commit end): deep-store
+        the artifact, IN_PROGRESS→DONE with endOffset, create next sequence
+        IN_PROGRESS, ideal state old→ONLINE / new→CONSUMING.
+        """
+        with self._lock:
+            fsm = self._fsm.get(segment)
+            if fsm is None or fsm.winner != instance or \
+                    offset != fsm.target:
+                return CompletionResponse(proto.FAILED)
+        config = self.manager.get_table_config(table)
+        if config is None:
+            return CompletionResponse(proto.FAILED)
+        built = SegmentMetadata.load(segment_dir)
+        dest = os.path.join(self.manager.deep_store_dir, table, segment)
+        if os.path.abspath(segment_dir) != os.path.abspath(dest):
+            self.manager.fs.delete(dest)
+            self.manager.fs.copy(segment_dir, dest)
+
+        def finish(old: Optional[dict]) -> dict:
+            rec = dict(old or {})
+            rec.update({
+                "status": DONE,
+                "endOffset": int(offset),
+                "downloadPath": dest,
+                "startTime": built.start_time,
+                "endTime": built.end_time,
+                "timeUnit": built.time_unit,
+                "totalDocs": built.total_docs,
+                "pushTimeMs": int(time.time() * 1e3),
+                "crc": built.crc,
+            })
+            return rec
+
+        self.store.update(f"{SEGMENTS}/{table}/{segment}", finish)
+        llc = LLCSegmentName.parse(segment)
+        nxt = llc.next()
+        self.store.set(f"{SEGMENTS}/{table}/{nxt.name}", {
+            "segmentName": nxt.name,
+            "partition": nxt.partition,
+            "sequence": nxt.sequence,
+            "status": IN_PROGRESS,
+            "startOffset": int(offset),
+            "creationTimeMs": int(time.time() * 1e3),
+        })
+        ideal = self.coordinator.ideal_state(table)
+        committed_replicas = sorted(ideal.get(segment, {})) or [instance]
+
+        def step(segments):
+            segments[segment] = {i: ONLINE for i in committed_replicas}
+            segments[nxt.name] = {i: CONSUMING for i in committed_replicas}
+            return segments
+
+        with self._lock:
+            self._fsm.pop(segment, None)
+        self.coordinator.update_ideal_state(table, step)
+        return CompletionResponse(proto.COMMIT_SUCCESS, offset)

@@ -138,6 +138,8 @@ class StackedSegments:
         n_dev = mesh.devices.size
         if not self.segments:
             raise NotShardable("no segments")
+        if any(getattr(s, "is_mutable", False) for s in self.segments):
+            raise NotShardable("mutable (consuming) segment in set")
         pads = {s.padded_docs for s in self.segments}
         if len(pads) != 1:
             raise NotShardable(f"padded doc counts differ: {sorted(pads)}")

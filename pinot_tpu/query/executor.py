@@ -37,6 +37,12 @@ class ServerQueryExecutor:
 
         blocks: List[IntermediateResultsBlock] = []
         for seg in selected:
+            if getattr(seg, "is_mutable", False) and \
+                    hasattr(seg, "snapshot_view"):
+                # consuming segment: freeze (num_docs, cardinalities) so
+                # the filter mask and every column lane agree while the
+                # consumer thread keeps appending
+                seg = seg.snapshot_view()
             blocks.append(self._execute_segment(seg, request))
 
         if not blocks:

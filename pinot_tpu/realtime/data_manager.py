@@ -1,0 +1,284 @@
+"""Server-side realtime ingestion: consuming segments + commit lifecycle.
+
+Parity: pinot-core/.../data/manager/realtime/ —
+LLRealtimeSegmentDataManager.java:85-590 (per-partition consumer state
+machine: consumeLoop indexes decoded rows into the mutable segment; on end
+criteria → segmentConsumed protocol; COMMIT → build immutable segment +
+split commit; CATCHUP → consume to the winner's offset; DISCARD/KEEP →
+stop and wait for the committed copy) and
+RealtimeTableDataManager.java:61 (consuming + completed segments of one
+realtime table on one server).
+
+The mutable segment is registered in the server's TableDataManager the
+moment consumption starts, so queries see in-flight rows (host execution
+path — arrival-order dictionaries don't meet the device kernels' sorted-id
+preconditions); the committed immutable segment atomically replaces it via
+the regular refcounted swap.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Dict, Optional
+
+from pinot_tpu.common import completion as proto
+from pinot_tpu.common.table_name import raw_table
+from pinot_tpu.realtime import converter
+from pinot_tpu.realtime.mutable_segment import MutableSegmentImpl
+from pinot_tpu.realtime.registry import resolve_stream_config
+from pinot_tpu.realtime.segment_name import LLCSegmentName
+from pinot_tpu.realtime.stream import StreamConfig
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+log = logging.getLogger(__name__)
+
+# consumer states (parity: LLRealtimeSegmentDataManager.State)
+CONSUMING_STATE = "CONSUMING"
+CATCHING_UP = "CATCHING_UP"
+HOLDING = "HOLDING"
+COMMITTING = "COMMITTING"
+COMMITTED = "COMMITTED"
+DISCARDED = "DISCARDED"
+ERROR_STATE = "ERROR"
+
+_POLL_S = 0.02
+
+
+class RealtimeSegmentDataManager:
+    """One consuming segment: consumer thread + mutable segment."""
+
+    def __init__(self, llc: LLCSegmentName, table: str, schema,
+                 table_config, stream_config: StreamConfig,
+                 start_offset: int, completion, instance_id: str,
+                 table_data_manager, work_dir: str):
+        self.llc = llc
+        self.table = table
+        self.stream_config = stream_config
+        self.completion = completion
+        self.instance_id = instance_id
+        self.tdm = table_data_manager
+        self.work_dir = work_dir
+        self.offset = int(start_offset)
+        self.state = CONSUMING_STATE
+        self.mutable = MutableSegmentImpl(schema, table_config, llc.name)
+        self.consumer = stream_config.consumer_factory \
+            .create_partition_consumer(stream_config, llc.partition)
+        self.decoder = stream_config.decoder
+        self._catchup_target: Optional[int] = None
+        self._deadline = time.monotonic() + \
+            stream_config.flush_threshold_time_ms / 1e3
+        self._stop = threading.Event()
+        # queryable from the first row (refcounted like any segment)
+        self.tdm.add_segment(self.mutable)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"consumer-{llc.name}")
+        self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10)
+        try:
+            self.consumer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- consume loop ------------------------------------------------------
+
+    def _end_criteria_reached(self) -> bool:
+        if self._catchup_target is not None:
+            return self.offset >= self._catchup_target
+        return (self.mutable.num_docs >=
+                self.stream_config.flush_threshold_rows or
+                time.monotonic() >= self._deadline)
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if self._end_criteria_reached():
+                    if not self._report_consumed():
+                        return
+                    continue
+                self._consume_batch()
+        except Exception as e:  # noqa: BLE001 — keep the server alive
+            log.exception("consumer %s died", self.llc.name)
+            self._enter_error(f"consumer loop died: {e}")
+
+    def _consume_batch(self) -> None:
+        try:
+            batch = self.consumer.fetch_messages(
+                self.offset, self._catchup_target,
+                self.stream_config.fetch_timeout_ms)
+        except Exception:  # noqa: BLE001 — flaky stream: back off, retry
+            log.warning("fetch failed for %s at offset %d; retrying",
+                        self.llc.name, self.offset, exc_info=True)
+            self._stop.wait(_POLL_S)
+            return
+        if not batch.messages:
+            self._stop.wait(_POLL_S)
+            return
+        for msg in batch.messages:
+            if msg.offset < self.offset:
+                continue
+            row = self.decoder.decode(msg.value)
+            if row is None:
+                log.debug("dropping undecodable message at offset %d",
+                          msg.offset)
+                continue
+            self.mutable.index_row(row)
+        self.offset = max(self.offset, batch.next_offset)
+
+    # -- completion protocol (server side) ---------------------------------
+
+    def _report_consumed(self) -> bool:
+        """segmentConsumed → steer by response. Returns False to exit."""
+        self._catchup_target = None
+        self.state = HOLDING
+        resp = self.completion.segment_consumed(
+            self.table, self.llc.name, self.instance_id, self.offset)
+        if resp.status == proto.HOLD:
+            self._stop.wait(_POLL_S)
+            return True
+        if resp.status == proto.CATCHUP:
+            self.state = CATCHING_UP
+            self._catchup_target = int(resp.offset)
+            return True
+        if resp.status == proto.COMMIT:
+            self._commit()
+            return False
+        if resp.status in (proto.KEEP, proto.DISCARD):
+            # another replica committed; the ONLINE transition will swap in
+            # the committed copy (losers always take the download path)
+            self.state = DISCARDED
+            return False
+        log.warning("unexpected completion status %s for %s", resp.status,
+                    self.llc.name)
+        self._enter_error(f"unexpected completion status {resp.status}")
+        return False
+
+    def _enter_error(self, reason: str) -> None:
+        """Report stoppedConsuming so the controller's validation task can
+        repair the partition despite this server process staying live."""
+        self.state = ERROR_STATE
+        try:
+            self.completion.stopped_consuming(
+                self.table, self.llc.name, self.instance_id, reason)
+        except Exception:  # noqa: BLE001 — best effort
+            log.exception("stopped_consuming report failed for %s",
+                          self.llc.name)
+
+    def _commit(self) -> None:
+        self.state = COMMITTING
+        resp = self.completion.commit_start(self.table, self.llc.name,
+                                            self.instance_id, self.offset)
+        if resp.status != proto.COMMIT_CONTINUE:
+            log.warning("commit_start rejected for %s: %s", self.llc.name,
+                        resp.status)
+            self._enter_error(f"commit_start rejected: {resp.status}")
+            return
+        out_dir = os.path.join(self.work_dir, self.llc.name)
+        try:
+            shutil.rmtree(out_dir, ignore_errors=True)
+            converter.convert(self.mutable, out_dir, self.llc.name)
+        except Exception as e:  # noqa: BLE001 — build failure (disk etc.)
+            log.exception("segment build failed for %s", self.llc.name)
+            self._enter_error(f"segment build failed: {e}")
+            return
+        resp = self.completion.commit_end(self.table, self.llc.name,
+                                          self.instance_id, self.offset,
+                                          out_dir)
+        if resp.status != proto.COMMIT_SUCCESS:
+            log.warning("commit_end failed for %s: %s", self.llc.name,
+                        resp.status)
+            self._enter_error(f"commit_end failed: {resp.status}")
+            return
+        self.state = COMMITTED
+
+
+class RealtimeTableDataManager:
+    """All consuming segments of this server, across realtime tables.
+
+    Parity: RealtimeTableDataManager.java:61 — holds the consuming segment
+    managers; completed (immutable) segments live in the regular
+    TableDataManager maps alongside offline segments.
+    """
+
+    def __init__(self, server, resource_manager, completion,
+                 work_dir: str):
+        self.server = server
+        self.manager = resource_manager
+        self.completion = completion
+        self.work_dir = work_dir
+        self._consuming: Dict[str, RealtimeSegmentDataManager] = {}
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def consuming_state(self, segment: str) -> Optional[str]:
+        with self._lock:
+            rdm = self._consuming.get(segment)
+            return rdm.state if rdm else None
+
+    def start_consuming(self, table: str, segment: str) -> None:
+        """OFFLINE→CONSUMING: start the partition consumer.
+
+        Resumes from the durable startOffset in segment metadata — the
+        checkpoint/resume story (SURVEY §5.4): consumption always restarts
+        from the last committed segment boundary.
+        """
+        meta = self.manager.segment_metadata(table, segment)
+        if meta is None:
+            raise ValueError(f"no metadata for {table}/{segment}")
+        config = self.manager.get_table_config(table)
+        schema = self.manager.get_schema(raw_table(table))
+        if config is None or schema is None:
+            raise ValueError(f"missing config/schema for {table}")
+        stream_config = resolve_stream_config(config)
+        llc = LLCSegmentName.parse(segment)
+        tdm = self.server.data_manager.table(table, create=True)
+        # construct (which starts the consumer thread) under the lock so a
+        # concurrent shutdown() can never miss a just-started consumer
+        with self._lock:
+            if self._closed or segment in self._consuming:
+                return
+            self._consuming[segment] = RealtimeSegmentDataManager(
+                llc, table, schema, config, stream_config,
+                int(meta["startOffset"]), self.completion,
+                self.server.instance_id, tdm,
+                os.path.join(self.work_dir, table))
+
+    def on_segment_online(self, table: str, segment: str) -> None:
+        """CONSUMING→ONLINE (or OFFLINE→ONLINE for a committed LLC
+        segment): stop any local consumer and swap in the committed copy
+        from the deep store."""
+        with self._lock:
+            rdm = self._consuming.pop(segment, None)
+        if rdm is not None:
+            rdm.stop()
+        meta = self.manager.segment_metadata(table, segment)
+        if meta is None or not meta.get("downloadPath"):
+            raise ValueError(f"no committed artifact for {table}/{segment}")
+        seg = ImmutableSegmentLoader.load(meta["downloadPath"])
+        self.server.data_manager.table(table, create=True).add_segment(seg)
+
+    def on_segment_offline(self, table: str, segment: str) -> None:
+        with self._lock:
+            rdm = self._consuming.pop(segment, None)
+        if rdm is not None:
+            rdm.stop()
+        tdm = self.server.data_manager.table(table)
+        if tdm is not None:
+            tdm.remove_segment(segment)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            rdms = list(self._consuming.values())
+            self._consuming.clear()
+        for rdm in rdms:
+            rdm.stop()

@@ -207,6 +207,157 @@ class _MutableDataSource:
         return list(arr)
 
 
+class _SnapshotDictionary:
+    """Dictionary view pinned at a cardinality: values added after the
+    snapshot are invisible (index_of returns -1 for them)."""
+
+    is_sorted = False
+
+    def __init__(self, inner: MutableDictionary, cardinality: int):
+        self._inner = inner
+        self.cardinality = cardinality
+        self.data_type = inner.data_type
+
+    def __len__(self) -> int:
+        return self.cardinality
+
+    @property
+    def values(self) -> np.ndarray:
+        return self._inner.values[: self.cardinality]
+
+    def index_of(self, value) -> int:
+        i = self._inner.index_of(value)
+        return i if i < self.cardinality else -1
+
+    def get(self, dict_id: int):
+        return self._inner.get(dict_id)
+
+    def decode(self, dict_ids: np.ndarray) -> np.ndarray:
+        return self.values[dict_ids]
+
+    @property
+    def min_value(self):
+        vals = self._inner._values[: self.cardinality]
+        return min(vals) if vals else None
+
+    @property
+    def max_value(self):
+        vals = self._inner._values[: self.cardinality]
+        return max(vals) if vals else None
+
+
+class _SnapshotSource:
+    """Point-in-time column view: doc count AND dictionary cardinality are
+    pinned at snapshot creation, so every access within one query sees the
+    same rows (the writer keeps appending concurrently)."""
+
+    def __init__(self, ds: _MutableDataSource, n: int):
+        self._ds = ds
+        self._n = n
+        self.field = ds.field
+        self.has_dictionary = ds.has_dictionary
+        self.dictionary = _SnapshotDictionary(
+            ds.dictionary, ds.dictionary.cardinality) \
+            if ds.has_dictionary else None
+        self.inverted_index = None
+        self.bloom_filter = None
+        self.sorted_ranges = None
+        self._mv_cache: Optional[np.ndarray] = None
+
+    @property
+    def metadata(self) -> ColumnMetadata:
+        card = self.dictionary.cardinality if self.has_dictionary \
+            else self._n
+        return ColumnMetadata(
+            name=self.field.name, data_type=self.field.data_type,
+            cardinality=card,
+            bits_per_element=max(1, int(np.ceil(np.log2(max(card, 2))))),
+            single_value=self.field.single_value, sorted=False,
+            has_dictionary=self.has_dictionary,
+            min_value=self.dictionary.min_value if self.has_dictionary
+            else None,
+            max_value=self.dictionary.max_value if self.has_dictionary
+            else None,
+            total_number_of_entries=self._n)
+
+    @property
+    def dict_ids(self) -> Optional[np.ndarray]:
+        if self._ds._sv is None or not self.has_dictionary:
+            return None
+        return self._ds._sv.snapshot(self._n)
+
+    @property
+    def raw_values(self) -> Optional[np.ndarray]:
+        if self._ds._sv is None or self.has_dictionary:
+            return None
+        return self._ds._sv.snapshot(self._n)
+
+    @property
+    def mv_dict_ids(self) -> Optional[np.ndarray]:
+        if self._ds._mv is None:
+            return None
+        if self._mv_cache is None:
+            card = self.dictionary.cardinality
+            rows = self._ds._mv[: self._n]
+            width = max((len(r) for r in rows), default=1)
+            out = np.full((self._n, width), card, dtype=np.int32)
+            for i, r in enumerate(rows):
+                out[i, : len(r)] = r
+            self._mv_cache = out
+        return self._mv_cache
+
+
+class MutableSegmentView:
+    """Frozen (num_docs, cardinalities) view of a consuming segment — what
+    one query executes against. Parity: the reference snapshots the doc
+    count once per query (MutableSegmentImpl readers index up to a captured
+    numDocsIndexed); here the whole column view is pinned."""
+
+    is_mutable = True
+
+    def __init__(self, impl: "MutableSegmentImpl"):
+        self._impl = impl
+        self.segment_name = impl.segment_name
+        self.schema = impl.schema
+        self.num_docs = impl._num_docs
+        self._sources: Dict[str, _SnapshotSource] = {}
+
+    @property
+    def padded_docs(self) -> int:
+        from pinot_tpu.segment.loader import padded_size
+        return padded_size(max(self.num_docs, 1))
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._impl._sources.keys())
+
+    def has_column(self, column: str) -> bool:
+        return column in self._impl._sources
+
+    def data_source(self, column: str) -> _SnapshotSource:
+        src = self._sources.get(column)
+        if src is None:
+            src = _SnapshotSource(self._impl._sources[column],
+                                  self.num_docs)
+            self._sources[column] = src
+        return src
+
+    @property
+    def metadata(self) -> SegmentMetadata:
+        tc = self.schema.time_column
+        return SegmentMetadata(
+            segment_name=self.segment_name,
+            table_name=self._impl.table_config.table_name,
+            total_docs=self.num_docs,
+            columns={name: self.data_source(name).metadata
+                     for name in self.column_names},
+            time_column=tc.name if tc else None,
+            time_unit=tc.time_unit.name if tc else None,
+            start_time=self._impl._start_time,
+            end_time=self._impl._end_time,
+            creation_time_ms=self._impl.creation_time_ms)
+
+
 class MutableSegmentImpl:
     """The consuming segment: single writer, many reader snapshots."""
 
@@ -246,6 +397,10 @@ class MutableSegmentImpl:
         return True
 
     # -- query interface (ImmutableSegment-compatible) ---------------------
+    def snapshot_view(self) -> MutableSegmentView:
+        """Consistent point-in-time view for one query."""
+        return MutableSegmentView(self)
+
     @property
     def num_docs(self) -> int:
         return self._num_docs

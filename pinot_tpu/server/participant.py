@@ -1,12 +1,15 @@
 """Server-side cluster participant: state transitions → segment lifecycle.
 
 Parity: pinot-server/.../starter/helix/SegmentOnlineOfflineStateModelFactory
-.java:81-156 (OFFLINE→ONLINE downloads + loads, ONLINE→OFFLINE unloads,
-→DROPPED deletes local data) + SegmentFetcherAndLoader (deep-store fetch →
-ImmutableSegmentLoader).
+.java:81-156 (OFFLINE→ONLINE downloads + loads, OFFLINE→CONSUMING starts
+the LLC consumer, CONSUMING→ONLINE swaps in the committed copy,
+ONLINE→OFFLINE unloads, →DROPPED deletes local data) +
+SegmentFetcherAndLoader (deep-store fetch → ImmutableSegmentLoader).
 """
 from __future__ import annotations
 
+import os
+import tempfile
 from typing import Optional
 
 from pinot_tpu.controller.manager import ResourceManager
@@ -16,11 +19,39 @@ from pinot_tpu.server.instance import ServerInstance
 
 
 class ServerParticipant(StateModel):
-    def __init__(self, server: ServerInstance, manager: ResourceManager):
+    def __init__(self, server: ServerInstance, manager: ResourceManager,
+                 completion=None, work_dir: Optional[str] = None):
+        """`completion`: the controller's RealtimeSegmentManager (or an
+        HTTP client speaking the same protocol) — required for realtime
+        tables; `work_dir`: where committed segments are built."""
         self.server = server
         self.manager = manager
+        self.completion = completion
+        self.work_dir = work_dir
+        self._realtime = None
+
+    @property
+    def realtime(self):
+        if self._realtime is None:
+            if self.completion is None:
+                raise RuntimeError(
+                    "realtime transition but no completion client wired")
+            from pinot_tpu.realtime.data_manager import \
+                RealtimeTableDataManager
+            work = self.work_dir or os.path.join(
+                tempfile.gettempdir(),
+                f"pinot_tpu_rt_{self.server.instance_id}")
+            self._realtime = RealtimeTableDataManager(
+                self.server, self.manager, self.completion, work)
+        return self._realtime
+
+    def on_become_consuming(self, table: str, segment: str) -> None:
+        self.realtime.start_consuming(table, segment)
 
     def on_become_online(self, table: str, segment: str) -> None:
+        if table.endswith("_REALTIME"):
+            self.realtime.on_segment_online(table, segment)
+            return
         meta = self.manager.segment_metadata(table, segment)
         if meta is None:
             raise ValueError(f"no metadata for {table}/{segment}")
@@ -28,9 +59,16 @@ class ServerParticipant(StateModel):
         self.server.data_manager.table(table, create=True).add_segment(seg)
 
     def on_become_offline(self, table: str, segment: str) -> None:
+        if self._realtime is not None and table.endswith("_REALTIME"):
+            self._realtime.on_segment_offline(table, segment)
+            return
         tdm = self.server.data_manager.table(table)
         if tdm is not None:
             tdm.remove_segment(segment)
 
     def on_become_dropped(self, table: str, segment: str) -> None:
         pass  # local artifact cleanup is a no-op: segments load from deep store
+
+    def shutdown(self) -> None:
+        if self._realtime is not None:
+            self._realtime.shutdown()

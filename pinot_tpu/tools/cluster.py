@@ -31,12 +31,18 @@ class EmbeddedCluster:
         self.work_dir = work_dir
         self.controller = Controller(os.path.join(work_dir, "deepstore"))
         self.servers: Dict[str, ServerInstance] = {}
+        self.participants: Dict[str, ServerParticipant] = {}
         for i in range(num_servers):
             name = f"Server_{i}"
             server = ServerInstance(name, scheduler=scheduler, mesh=mesh)
             self.servers[name] = server
-            self.controller.coordinator.register_participant(
-                name, ServerParticipant(server, self.controller.manager))
+            participant = ServerParticipant(
+                server, self.controller.manager,
+                completion=self.controller.realtime,
+                work_dir=os.path.join(work_dir, "server_work", name))
+            self.participants[name] = participant
+            self.controller.coordinator.register_participant(name,
+                                                             participant)
         self.watcher = BrokerClusterWatcher(self.controller.coordinator,
                                             self.controller.manager)
         if tcp:
@@ -54,6 +60,9 @@ class EmbeddedCluster:
         self.controller.manager.add_schema(schema)
 
     def add_table(self, config: TableConfig, **kw) -> str:
+        from pinot_tpu.common.table_config import TableType
+        if config.table_type == TableType.REALTIME:
+            return self.controller.realtime.setup_table(config, **kw)
         return self.controller.manager.add_table(config, **kw)
 
     def upload_segment(self, table: str, segment_dir: str) -> str:
@@ -65,5 +74,7 @@ class EmbeddedCluster:
     def stop(self) -> None:
         self.controller.stop()
         self.broker.close()
+        for participant in self.participants.values():
+            participant.shutdown()
         for server in self.servers.values():
             server.stop()
