@@ -162,17 +162,23 @@ class BrokerRequest:
         return cols
 
     def referenced_columns(self) -> List[str]:
-        """All physical columns the query touches (for pruning/validation)."""
-        cols = set(self.filter_columns())
+        """All physical columns the query touches (for pruning/validation).
+
+        Transform expressions are expanded to their source columns."""
+        from pinot_tpu.common.expression import referenced_columns as expand
+        cols = set()
+        for c in self.filter_columns():
+            cols.update(expand(c))
         for a in self.aggregations:
             if a.column != "*":
-                cols.add(a.column)
+                cols.update(expand(a.column))
         if self.group_by:
-            cols.update(self.group_by.columns)
+            for c in self.group_by.columns:
+                cols.update(expand(c))
         if self.selection:
             for c in self.selection.columns:
                 if c != "*":
-                    cols.add(c)
+                    cols.update(expand(c))
             cols.update(s.column for s in self.selection.order_by)
         return sorted(cols)
 

@@ -326,8 +326,8 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
             outs[f"agg{i}.vsum"] = _chunked_float_sum(cols[f"{col}.vlane"],
                                                       mask)
             outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
-        elif fname in ("sum", "avg", "distinctcount", "percentile") and \
-                source == "sv":
+        elif fname in ("sum", "avg", "distinctcount", "percentile",
+                       "hist") and source == "sv":
             card_pad = extra[1] if isinstance(extra, tuple) else extra
             hk = (col, card_pad)
             if hk not in hists:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree, GroupBy,
                                       HavingNode, QueryOptions, Selection,
@@ -203,15 +204,50 @@ class _Parser:
             self.next()
             col = "*"
         else:
-            col = self.expect(TokType.IDENT).value
+            col = self.parse_column_or_expression()
         self.expect(TokType.RPAREN)
         return AggregationInfo(function_name=name, column=col)
 
+    def parse_column_or_expression(self) -> str:
+        """Plain column, or a transform call like time_convert(col,'D','H')
+        — returned as a canonical expression string (parity:
+        TransformExpressionTree's standardized column name)."""
+        t = self.expect(TokType.IDENT)
+        if self.peek().type != TokType.LPAREN or \
+                not expr_mod.is_transform_function(t.value):
+            return t.value
+        return expr_mod.to_string(self._parse_expr_call(t.value))
+
+    def _parse_expr_call(self, fname: str):
+        self.expect(TokType.LPAREN)
+        args = []
+        if self.peek().type != TokType.RPAREN:
+            args.append(self._parse_expr_arg())
+            while self.peek().type == TokType.COMMA:
+                self.next()
+                args.append(self._parse_expr_arg())
+        self.expect(TokType.RPAREN)
+        return expr_mod.Call(fname.lower(), tuple(args))
+
+    def _parse_expr_arg(self):
+        t = self.next()
+        if t.type == TokType.STRING:
+            return expr_mod.Lit(t.value, is_string=True)
+        if t.type in (TokType.INT, TokType.FLOAT):
+            return expr_mod.Lit(t.value)
+        if t.type == TokType.IDENT:
+            if self.peek().type == TokType.LPAREN and \
+                    expr_mod.is_transform_function(t.value):
+                return self._parse_expr_call(t.value)
+            return expr_mod.Col(t.value)
+        raise PqlSyntaxError(
+            f"bad expression argument at {t.pos}: {t.value!r}")
+
     def parse_ident_list(self) -> List[str]:
-        out = [self.expect(TokType.IDENT).value]
+        out = [self.parse_column_or_expression()]
         while self.peek().type == TokType.COMMA:
             self.next()
-            out.append(self.expect(TokType.IDENT).value)
+            out.append(self.parse_column_or_expression())
         return out
 
     def parse_order_list(self) -> List[SelectionSort]:
@@ -278,7 +314,7 @@ class _Parser:
         raise PqlSyntaxError(f"expected literal at {t.pos}, got {t.value!r}")
 
     def parse_comparison(self) -> FilterQueryTree:
-        col = self.expect(TokType.IDENT).value
+        col = self.parse_column_or_expression()
         t = self.peek()
         if t.type == TokType.OP:
             op = self.next().value

@@ -81,7 +81,26 @@ class AggregationFunction:
             return set(_plain(dict_values[i]) for i in nz)
         if base in ("PERCENTILE", "PERCENTILEEST", "PERCENTILETDIGEST"):
             nz = np.nonzero(h)[0]
-            return {_plain(dict_values[i]): int(h[i]) for i in nz}
+            out: Dict = {}
+            for i in nz:
+                # accumulate: transformed dictionaries can map several ids
+                # to one value (non-injective transforms)
+                k = _plain(dict_values[i])
+                out[k] = out.get(k, 0) + int(h[i])
+            return out
+        if base in ("MIN", "MAX", "MINMAXRANGE"):
+            # expression path: transformed values are not id-ordered, so
+            # extremes come from the histogram's support
+            nz = np.nonzero(h)[0]
+            if len(nz) == 0:
+                return None if base != "MINMAXRANGE" else (None, None)
+            present = np.asarray(dict_values, dtype=np.float64)[nz]
+            mn, mx = float(present.min()), float(present.max())
+            if base == "MIN":
+                return mn
+            if base == "MAX":
+                return mx
+            return (mn, mx)
         raise ValueError(f"{self.name} cannot be built from a histogram")
 
     def from_minmax_ids(self, min_id: Optional[int], max_id: Optional[int],

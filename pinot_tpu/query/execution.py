@@ -109,6 +109,14 @@ def _finish_aggregation(plan, outs, blk) -> None:
                 s = float(np.asarray(outs[f"agg{i}.vsum"],
                                      dtype=np.float64).sum())
             inters.append(s if fname == "sum" else (s, cnt))
+        elif fname == "hist":
+            # expression aggregation: transform the dictionary value table
+            # (O(cardinality)) and finish from the device histogram
+            from pinot_tpu.common import expression as expr_mod
+            src_vals = np.asarray(
+                plan.segment.data_source(col).dictionary.values)
+            tv = np.asarray(expr_mod.evaluate(f.column, lambda _: src_vals))
+            inters.append(f.from_histogram(np.asarray(outs[f"agg{i}"]), tv))
         elif source in ("sv", "mv") and fname in (
                 "sum", "avg", "percentile", "distinctcount"):
             dict_vals = plan.segment.data_source(col).dictionary.values
@@ -156,12 +164,16 @@ def _finish_group_by(plan, outs, blk) -> None:
     cards = [d.cardinality for d in dicts]
 
     group_map: Dict[Tuple, List] = {}
-    # decode all non-empty group keys vectorized
+    # decode all non-empty group keys vectorized; expression group keys
+    # decode through their transformed value table (collisions — distinct
+    # source ids mapping to one transformed value — merge below)
     keys = nz
     id_cols = []
     for stride, card in zip(strides, cards):
         id_cols.append((keys // stride) % card)
-    value_cols = [d.decode(ids) for d, ids in zip(dicts, id_cols)]
+    vtables = plan.group_value_tables or (None,) * len(gcols)
+    value_cols = [tv[ids] if tv is not None else d.decode(ids)
+                  for d, ids, tv in zip(dicts, id_cols, vtables)]
 
     def _sum_array(i, spec):
         """Exact f64 per-group sums from the device partials."""
@@ -248,6 +260,12 @@ def _finish_group_by(plan, outs, blk) -> None:
                 mn, mx = float(a[row]), float(b[row])
                 inters.append((None if not np.isfinite(mn) else mn,
                                None if not np.isfinite(mx) else mx))
+        old = group_map.get(key)
+        if old is not None:
+            # expression group keys can collide (non-injective transform):
+            # merge with the same semantics as cross-segment combine
+            inters = [f.merge(o, v) for f, o, v in
+                      zip(plan.functions, old, inters)]
         group_map[key] = inters
     blk.group_map = group_map
 

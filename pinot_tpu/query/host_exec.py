@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
                                       FilterQueryTree)
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
@@ -77,7 +78,48 @@ def _eval_filter(tree: Optional[FilterQueryTree], segment: ImmutableSegment
     return _eval_leaf(tree, segment)
 
 
+def _expr_rows(text: str, segment: ImmutableSegment) -> np.ndarray:
+    """Row-domain expression evaluation (host fallback / mutable path).
+
+    Memoized per segment object (immutable segments are immutable; mutable
+    segments are queried through per-query snapshot views, so the cache is
+    naturally query-scoped there)."""
+    cache = getattr(segment, "_expr_cache", None)
+    if cache is None:
+        try:
+            cache = segment._expr_cache = {}
+        except AttributeError:      # __slots__ or frozen object
+            cache = None
+    if cache is not None and text in cache:
+        return cache[text]
+
+    def resolve(c: str) -> np.ndarray:
+        ds = segment.data_source(c)
+        cm = ds.metadata
+        if not cm.single_value:
+            raise ValueError(f"MV column {c} in expression")
+        if cm.has_dictionary:
+            return np.asarray(ds.dictionary.values)[ds.dict_ids]
+        return ds.raw_values
+
+    out = np.asarray(expr_mod.evaluate(text, resolve))
+    if cache is not None:
+        if len(cache) > 32:
+            cache.clear()
+        cache[text] = out
+    return out
+
+
+def _eval_expr_leaf(tree: FilterQueryTree, segment: ImmutableSegment
+                    ) -> np.ndarray:
+    from pinot_tpu.query.plan import _pred_over_values
+    vals = _expr_rows(tree.column, segment).astype(np.float64)
+    return _pred_over_values(tree, vals)
+
+
 def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
+    if expr_mod.is_expression(tree.column):
+        return _eval_expr_leaf(tree, segment)
     ds = segment.data_source(tree.column)
     cm = ds.metadata
     n = segment.num_docs
@@ -181,6 +223,8 @@ def _coercer(dtype: np.dtype):
 
 def _masked_values(segment: ImmutableSegment, col: str, mask: np.ndarray
                    ) -> np.ndarray:
+    if expr_mod.is_expression(col):
+        return _expr_rows(col, segment)[mask]
     ds = segment.data_source(col)
     cm = ds.metadata
     if not cm.has_dictionary:
@@ -225,29 +269,44 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
 # ---------------------------------------------------------------------------
 
 
+def _group_value_lane(segment: ImmutableSegment, c: str, mask: np.ndarray
+                      ) -> np.ndarray:
+    """Masked row values for one group-by key (column or expression)."""
+    if expr_mod.is_expression(c):
+        return _expr_rows(c, segment)[mask]
+    ds = segment.data_source(c)
+    cm = ds.metadata
+    if cm.has_dictionary and cm.single_value:
+        return np.asarray(ds.dictionary.values)[ds.dict_ids[mask]]
+    if not cm.has_dictionary:
+        return ds.raw_values[mask]
+    raise ValueError(f"host group-by needs SV column {c}")
+
+
 def _group_by(segment: ImmutableSegment, request: BrokerRequest,
               mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
     gcols = request.group_by.columns
-    id_lanes = []
-    dicts = []
+    # per-key-column unique coding (value domain, so plain columns,
+    # no-dictionary columns and transform expressions all group uniformly)
+    codes: List[np.ndarray] = []
+    uniq_vals: List[np.ndarray] = []
     for c in gcols:
-        ds = segment.data_source(c)
-        if not (ds.metadata.has_dictionary and ds.metadata.single_value):
-            raise ValueError(f"host group-by needs SV dictionary column {c}")
-        id_lanes.append(ds.dict_ids[mask].astype(np.int64))
-        dicts.append(ds.dictionary)
+        lane = _group_value_lane(segment, c, mask)
+        u, inv = np.unique(lane, return_inverse=True)
+        uniq_vals.append(u)
+        codes.append(inv.astype(np.int64))
     key = np.zeros(int(mask.sum()), dtype=np.int64)
-    for lane, d in zip(id_lanes, dicts):
-        key = key * d.cardinality + lane
+    for u, inv in zip(uniq_vals, codes):
+        key = key * max(len(u), 1) + inv
     uniq_keys, inverse = np.unique(key, return_inverse=True)
     g = len(uniq_keys)
 
     # decode group values
     value_cols = []
     rem = uniq_keys.copy()
-    for d in reversed(dicts):
-        value_cols.append(d.decode(rem % d.cardinality))
-        rem //= d.cardinality
+    for u in reversed(uniq_vals):
+        value_cols.append(u[rem % max(len(u), 1)])
+        rem //= max(len(u), 1)
     value_cols.reverse()
     group_keys = [tuple(_plain(vc[i]) for vc in value_cols) for i in range(g)]
 
@@ -260,14 +319,11 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
             np.add.at(counts, inverse, 1)
             per_fn.append([int(c) for c in counts])
             continue
-        ds = segment.data_source(f.column)
-        cm = ds.metadata
-        if cm.has_dictionary and cm.single_value:
-            vals = ds.dictionary.values[ds.dict_ids[mask]].astype(np.float64)
-        elif not cm.has_dictionary:
-            vals = ds.raw_values[mask].astype(np.float64)
-        else:
-            raise ValueError("host group-by over MV metric unsupported")
+        if not expr_mod.is_expression(f.column):
+            cm = segment.data_source(f.column).metadata
+            if cm.has_dictionary and not cm.single_value:
+                raise ValueError("host group-by over MV metric unsupported")
+        vals = _group_value_lane(segment, f.column, mask).astype(np.float64)
         if base in ("SUM", "AVG"):
             sums = np.zeros(g)
             np.add.at(sums, inverse, vals)

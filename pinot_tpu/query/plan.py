@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.datatype import DataType
 from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
                                       FilterQueryTree)
@@ -91,8 +92,75 @@ def _resolve(node: FilterQueryTree, segment: ImmutableSegment, params: List
     return _resolve_leaf(node, segment, params)
 
 
+def _pred_over_values(node: FilterQueryTree, tv: np.ndarray) -> np.ndarray:
+    """Apply a numeric predicate to an array of (transformed) values."""
+    op = node.operator
+    if op == FilterOperator.IS_NULL:
+        return np.zeros(len(tv), dtype=bool)   # transforms never yield null
+    if op == FilterOperator.IS_NOT_NULL:
+        return np.ones(len(tv), dtype=bool)
+    if op == FilterOperator.REGEXP_LIKE:
+        pat = _re.compile(node.values[0])
+        return np.array([bool(pat.search(str(v))) for v in tv])
+    if op == FilterOperator.EQUALITY:
+        return tv == float(node.values[0])
+    if op == FilterOperator.NOT:
+        return tv != float(node.values[0])
+    if op == FilterOperator.IN:
+        return np.isin(tv, [float(v) for v in node.values])
+    if op == FilterOperator.NOT_IN:
+        return ~np.isin(tv, [float(v) for v in node.values])
+    if op == FilterOperator.RANGE:
+        m = np.ones(len(tv), dtype=bool)
+        if node.lower is not None:
+            lo = float(node.lower)
+            m &= (tv >= lo) if node.lower_inclusive else (tv > lo)
+        if node.upper is not None:
+            hi = float(node.upper)
+            m &= (tv <= hi) if node.upper_inclusive else (tv < hi)
+        return m
+    raise UnsupportedOnDevice(f"expression filter operator {op}")
+
+
+def _resolve_expr_leaf(node: FilterQueryTree, segment: ImmutableSegment,
+                       params: List) -> tuple:
+    """Expression filter → member vector over the transformed dictionary.
+
+    TPU-first: the transform is evaluated once over the (cardinality-sized)
+    dictionary value table host-side; the doc-scale work stays the plain
+    member-gather kernel — the device never sees the expression. Parity:
+    ExpressionFilterOperator.java:59 evaluates the transform per projected
+    block instead (O(docs) work; here it is O(cardinality)).
+    """
+    expr = expr_mod.parse_expression(node.column)
+    srcs = expr_mod.columns_of(expr)
+    if len(srcs) != 1:
+        raise UnsupportedOnDevice("multi-column expression filter")
+    src = srcs[0]
+    ds = segment.data_source(src)
+    cm = ds.metadata
+    if not (cm.has_dictionary and cm.single_value):
+        raise UnsupportedOnDevice(
+            f"expression over non-dictionary/MV column {src}")
+    vals = np.asarray(ds.dictionary.values)
+    tv = np.asarray(expr_mod.evaluate(expr, lambda c: vals),
+                    dtype=np.float64)
+    card = cm.cardinality
+    card_pad = kernels.pow2_bucket(card + 1)
+    member = np.zeros(card_pad, dtype=bool)
+    member[:card] = _pred_over_values(node, tv)
+    if not member.any():
+        return EMPTY
+    if member[:card].all():
+        return MATCH_ALL
+    params.append(member)
+    return ("pred", "member", src, "sv", card_pad)
+
+
 def _resolve_leaf(node: FilterQueryTree, segment: ImmutableSegment,
                   params: List) -> tuple:
+    if expr_mod.is_expression(node.column):
+        return _resolve_expr_leaf(node, segment, params)
     ds = segment.data_source(node.column)
     cm = ds.metadata
     op = node.operator
@@ -244,6 +312,9 @@ class SegmentPlan:
     functions: List[AggregationFunction] = dataclasses.field(
         default_factory=list)
     group_strides: Tuple[int, ...] = ()
+    # per group column: None (decode via dictionary) or a transformed value
+    # table aligned with the source column's dictIds (expression group-by)
+    group_value_tables: Tuple = ()
     fast_path_result: Optional[IntermediateResultsBlock] = None
 
     def execute(self) -> IntermediateResultsBlock:
@@ -378,15 +449,42 @@ class InstancePlanMaker:
 
     def _plan_group_by(self, plan: SegmentPlan, segment: ImmutableSegment,
                        request: BrokerRequest, needed: Dict) -> None:
-        gcols = request.group_by.columns
+        gcols = []
+        value_tables = []
         cards = []
-        for c in gcols:
+        for c in request.group_by.columns:
+            if expr_mod.is_expression(c):
+                # expression group key: group in the SOURCE column's id
+                # domain on device; decode through the transformed value
+                # table host-side (collapsing collisions there) — the
+                # kernel is identical to a plain group-by
+                expr = expr_mod.parse_expression(c)
+                srcs = expr_mod.columns_of(expr)
+                if len(srcs) != 1:
+                    raise UnsupportedOnDevice(
+                        "multi-column expression group key")
+                src = srcs[0]
+                ds = segment.data_source(src)
+                if not ds.metadata.has_dictionary or \
+                        not ds.metadata.single_value:
+                    raise UnsupportedOnDevice(
+                        f"expression group key over non-dict/MV column {src}")
+                vals = np.asarray(ds.dictionary.values)
+                tv = np.asarray(expr_mod.evaluate(expr, lambda _: vals))
+                gcols.append(src)
+                value_tables.append(tv)
+                cards.append(ds.metadata.cardinality)
+                needed[(src, "ids")] = None
+                continue
             ds = segment.data_source(c)
             if not ds.metadata.has_dictionary or not ds.metadata.single_value:
                 raise UnsupportedOnDevice(
                     f"group-by on non-dictionary/MV column {c}")
+            gcols.append(c)
+            value_tables.append(None)
             cards.append(ds.metadata.cardinality)
             needed[(c, "ids")] = None
+        plan.group_value_tables = tuple(value_tables)
         g = int(np.prod(cards, dtype=np.int64))
         if g > self.num_groups_limit:
             raise GroupsLimitExceeded(
@@ -451,6 +549,29 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
     if base == "COUNT" and not f.info.is_mv:
         return ("count", "*", "none", None)
     col = f.column
+    if expr_mod.is_expression(col):
+        # expression aggregation argument: the device produces a plain
+        # dictId histogram over the SOURCE column; the host finisher
+        # evaluates the transform over the dictionary value table and
+        # computes SUM/AVG/MIN/MAX/PERCENTILE/DISTINCTCOUNT from
+        # (histogram, transformed values) — exact, O(cardinality) transform
+        # work, zero doc-scale expression evaluation
+        if f.info.is_mv:
+            raise UnsupportedOnDevice("MV expression aggregation")
+        if for_group:
+            raise UnsupportedOnDevice(
+                "expression metric inside group-by (host path)")
+        srcs = expr_mod.columns_of(col)
+        if len(srcs) != 1:
+            raise UnsupportedOnDevice("multi-column expression aggregation")
+        src = srcs[0]
+        cm = segment.data_source(src).metadata
+        if not (cm.has_dictionary and cm.single_value):
+            raise UnsupportedOnDevice(
+                f"expression over non-dictionary/MV column {src}")
+        card_pad = kernels.pow2_bucket(cm.cardinality + 1)
+        needed[(src, "ids")] = None
+        return ("hist", src, "sv", ("hist", card_pad))
     ds = segment.data_source(col)
     cm = ds.metadata
     fname = {

@@ -70,6 +70,9 @@ class ColumnValueSegmentPruner:
             return all(self._prune_node(segment, c) for c in node.children)
         if node.operator not in (FilterOperator.EQUALITY, FilterOperator.RANGE):
             return False
+        from pinot_tpu.common.expression import is_expression
+        if is_expression(node.column):
+            return False    # no min/max metadata for transformed values
         ds = segment.data_source(node.column)
         cm = ds.metadata
         if cm.min_value is None or cm.max_value is None or \

@@ -1,0 +1,209 @@
+"""Transform functions + expression filters — golden tests vs numpy.
+
+Parity: TransformFunctionFactory (add/sub/mult/div, time_convert,
+datetime_convert), ExpressionFilterOperator, transform-in-group-by
+(TransformOperator.java:41). Device path: expressions evaluate over
+dictionary value tables host-side while doc-scale work stays id-domain
+kernels; host fallback evaluates row-domain.
+"""
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, build_shared_segments
+
+from pinot_tpu.common import expression as ex
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.parallel import make_mesh
+from pinot_tpu.pql.parser import compile_pql
+
+
+# -- expression unit tests ---------------------------------------------------
+
+def test_parse_and_canonicalize():
+    e = ex.parse_expression("time_convert(yearID,'DAYS','HOURS')")
+    assert ex.to_string(e) == "time_convert(yearID,'DAYS','HOURS')"
+    assert ex.columns_of(e) == ["yearID"]
+    e2 = ex.parse_expression("div(add(runs, hits), 2)")
+    assert ex.to_string(e2) == "div(add(runs,hits),2)"
+    assert ex.columns_of(e2) == ["runs", "hits"]
+    with pytest.raises(ex.ExpressionError):
+        ex.parse_expression("nosuchfn(a)")
+
+
+def test_evaluate_arithmetic_and_time():
+    a = np.array([1, 2, 3], dtype=np.int64)
+    b = np.array([10, 20, 30], dtype=np.int64)
+    cols = {"a": a, "b": b}
+    r = ex.evaluate("add(a,b)", cols.__getitem__)
+    assert list(r) == [11, 22, 33]
+    r = ex.evaluate("div(mult(a,b),2)", cols.__getitem__)
+    assert list(r) == [5.0, 20.0, 45.0]
+    r = ex.evaluate("time_convert(a,'DAYS','HOURS')", cols.__getitem__)
+    assert list(r) == [24, 48, 72]
+    # datetime_convert: days → weekly buckets expressed in days
+    d = np.array([0, 3, 7, 13, 14], dtype=np.int64)
+    r = ex.evaluate(
+        "datetime_convert(d,'1:DAYS:EPOCH','1:DAYS:EPOCH','7:DAYS')",
+        {"d": d}.__getitem__)
+    assert list(r) == [0, 0, 7, 7, 14]
+
+
+def test_parser_expressions_in_positions():
+    req = compile_pql(
+        "SELECT SUM(add(runs,hits)) FROM t "
+        "WHERE time_convert(yearID,'DAYS','HOURS') > 100 "
+        "GROUP BY div(yearID,10)")
+    assert req.aggregations[0].column == "add(runs,hits)"
+    assert req.filter.column == "time_convert(yearID,'DAYS','HOURS')"
+    assert req.group_by.columns == ["div(yearID,10)"]
+    assert set(req.referenced_columns()) == {"runs", "hits", "yearID"}
+
+
+# -- engine golden tests -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def seg():
+    d = tempfile.mkdtemp()
+    segment, cols = build_segment(d, n=4000, seed=13)
+    return segment, cols
+
+
+def _engines(segment):
+    return [QueryEngine([segment], use_device=True),
+            QueryEngine([segment], use_device=False)]
+
+
+def test_expression_aggregation_single_column(seg):
+    segment, cols = seg
+    years = cols["yearID"].astype(np.int64)
+    m = cols["teamID"] == "BOS"
+    exp = float((years[m] * 24).sum())
+    for eng in _engines(segment):
+        resp = eng.query(
+            "SELECT SUM(time_convert(yearID,'DAYS','HOURS')) "
+            "FROM baseballStats WHERE teamID = 'BOS'")
+        assert float(resp.aggregation_results[0].value) == exp
+
+
+def test_expression_aggregation_multi_column_host(seg):
+    segment, cols = seg
+    exp = float((cols["runs"].astype(np.float64) +
+                 cols["hits"].astype(np.float64)).sum())
+    for eng in _engines(segment):
+        resp = eng.query("SELECT SUM(add(runs,hits)) FROM baseballStats")
+        assert float(resp.aggregation_results[0].value) == \
+            pytest.approx(exp)
+
+
+def test_expression_min_max_avg(seg):
+    segment, cols = seg
+    vals = cols["runs"].astype(np.float64) * 2 + 1
+    for eng in _engines(segment):
+        resp = eng.query(
+            "SELECT MIN(add(mult(runs,2),1)), MAX(add(mult(runs,2),1)), "
+            "AVG(add(mult(runs,2),1)) FROM baseballStats")
+        assert float(resp.aggregation_results[0].value) == vals.min()
+        assert float(resp.aggregation_results[1].value) == vals.max()
+        assert float(resp.aggregation_results[2].value) == \
+            pytest.approx(vals.mean())
+
+
+def test_expression_filter(seg):
+    segment, cols = seg
+    hours = cols["yearID"].astype(np.int64) * 24
+    m = (hours >= 2000 * 24) & (hours < 2010 * 24)
+    exp = float(cols["runs"][m].sum())
+    for eng in _engines(segment):
+        resp = eng.query(
+            "SELECT SUM(runs) FROM baseballStats "
+            "WHERE time_convert(yearID,'DAYS','HOURS') >= 48000 AND "
+            "time_convert(yearID,'DAYS','HOURS') < 48240")
+        assert float(resp.aggregation_results[0].value) == exp
+
+
+def test_time_bucketed_group_by(seg):
+    """The canonical OLAP shape: GROUP BY a non-injective time bucket —
+    collisions across source dict ids must merge exactly."""
+    segment, cols = seg
+    years = cols["yearID"].astype(np.int64)
+    buckets = years - (years % 5)            # 5-year buckets via datetime
+    runs = cols["runs"].astype(np.float64)
+    expected = {}
+    for b in np.unique(buckets):
+        expected[int(b)] = float(runs[buckets == b].sum())
+    pql = ("SELECT SUM(runs) FROM baseballStats GROUP BY "
+           "datetime_convert(yearID,'1:DAYS:EPOCH','1:DAYS:EPOCH',"
+           "'5:DAYS') TOP 50")
+    for eng in _engines(segment):
+        resp = eng.query(pql)
+        got = {int(g["group"][0]): float(g["value"])
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == expected
+
+
+def test_expression_group_by_sharded():
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, n_segs=8, n=2048, seed=17)
+    eng = QueryEngine(segs, mesh=make_mesh())
+    years = merged["yearID"].astype(np.int64)
+    buckets = years - (years % 10)
+    runs = merged["runs"].astype(np.float64)
+    expected = {int(b): float(runs[buckets == b].sum())
+                for b in np.unique(buckets)}
+    resp = eng.query(
+        "SELECT SUM(runs) FROM baseballStats GROUP BY "
+        "datetime_convert(yearID,'1:DAYS:EPOCH','1:DAYS:EPOCH','10:DAYS') "
+        "TOP 50")
+    got = {int(g["group"][0]): float(g["value"])
+           for g in resp.aggregation_results[0].group_by_result}
+    assert got == expected
+
+
+def test_expression_distinctcount_percentile(seg):
+    segment, cols = seg
+    doubled = cols["runs"].astype(np.int64) * 2
+    exp_distinct = len(np.unique(doubled))
+    for eng in _engines(segment):
+        resp = eng.query(
+            "SELECT DISTINCTCOUNT(mult(runs,2)), "
+            "PERCENTILE50(mult(runs,2)) FROM baseballStats")
+        assert int(resp.aggregation_results[0].value) == exp_distinct
+        v = sorted(doubled)
+        exp_p50 = float(v[(len(v) * 50) // 100])
+        assert float(resp.aggregation_results[1].value) == exp_p50
+
+
+def test_percentile_over_noninjective_transform(seg):
+    """Colliding transformed values must ACCUMULATE counts (a histogram
+    overwrite here silently drops most of the distribution)."""
+    segment, cols = seg
+    years = cols["yearID"].astype(np.int64)
+    buckets = np.sort(years - (years % 5))
+    exp_p50 = float(buckets[(len(buckets) * 50) // 100])
+    for eng in _engines(segment):
+        resp = eng.query(
+            "SELECT PERCENTILE50(datetime_convert(yearID,'1:DAYS:EPOCH',"
+            "'1:DAYS:EPOCH','5:DAYS')) FROM baseballStats")
+        assert float(resp.aggregation_results[0].value) == exp_p50
+
+
+def test_expression_is_not_null(seg):
+    segment, cols = seg
+    for eng in _engines(segment):
+        resp = eng.query(
+            "SELECT COUNT(*) FROM baseballStats "
+            "WHERE time_convert(yearID,'DAYS','HOURS') IS NOT NULL")
+        assert int(resp.aggregation_results[0].value) == len(cols["yearID"])
+        resp = eng.query(
+            "SELECT COUNT(*) FROM baseballStats "
+            "WHERE time_convert(yearID,'DAYS','HOURS') IS NULL")
+        assert int(resp.aggregation_results[0].value) == 0
+
+
+def test_time_convert_truncates_toward_zero():
+    v = np.array([-25, -24, -1, 0, 1, 24, 25], dtype=np.int64)
+    r = ex.evaluate("time_convert(v,'HOURS','DAYS')", {"v": v}.__getitem__)
+    # Java TimeUnit.convert truncates toward zero: -25h -> -1d, -1h -> 0d
+    assert list(r) == [-1, -1, 0, 0, 0, 1, 1]
