@@ -1,0 +1,21 @@
+from pinot_tpu.ingestion.record_reader import (CSVRecordReader,
+                                               GenericRowRecordReader,
+                                               JSONRecordReader,
+                                               RecordReader,
+                                               SegmentRecordReader,
+                                               make_record_reader)
+from pinot_tpu.ingestion.transformer import (CompoundTransformer,
+                                             DataTypeTransformer,
+                                             ExpressionTransformer,
+                                             NullValueTransformer,
+                                             RecordTransformer,
+                                             SanitationTransformer,
+                                             TimeTransformer)
+
+__all__ = [
+    "RecordReader", "CSVRecordReader", "JSONRecordReader",
+    "GenericRowRecordReader", "SegmentRecordReader", "make_record_reader",
+    "RecordTransformer", "CompoundTransformer", "ExpressionTransformer",
+    "TimeTransformer", "DataTypeTransformer", "NullValueTransformer",
+    "SanitationTransformer",
+]

@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 from pinot_tpu.common import completion as proto
 from pinot_tpu.common.table_name import raw_table
+from pinot_tpu.ingestion import CompoundTransformer
 from pinot_tpu.realtime import converter
 from pinot_tpu.realtime.mutable_segment import MutableSegmentImpl
 from pinot_tpu.realtime.registry import resolve_stream_config
@@ -67,6 +68,7 @@ class RealtimeSegmentDataManager:
         self.consumer = stream_config.consumer_factory \
             .create_partition_consumer(stream_config, llc.partition)
         self.decoder = stream_config.decoder
+        self.transformer = CompoundTransformer(schema)
         self._catchup_target: Optional[int] = None
         self._deadline = time.monotonic() + \
             stream_config.flush_threshold_time_ms / 1e3
@@ -127,9 +129,14 @@ class RealtimeSegmentDataManager:
             if msg.offset < self.offset:
                 continue
             row = self.decoder.decode(msg.value)
+            if row is not None:
+                try:
+                    row = self.transformer.transform(row)
+                except Exception:  # noqa: BLE001 — poison record: drop,
+                    row = None     # never kill the partition consumer
             if row is None:
-                log.debug("dropping undecodable message at offset %d",
-                          msg.offset)
+                log.debug("dropping undecodable/untransformable message "
+                          "at offset %d", msg.offset)
                 continue
             self.mutable.index_row(row)
         self.offset = max(self.offset, batch.next_offset)

@@ -1,0 +1,56 @@
+"""Batch ingestion: build one segment per input file, push to controller.
+
+Parity: pinot-hadoop — SegmentCreationJob (one mapper per input file runs
+the segment build) + SegmentTarPushJob (POST artifacts to the controller).
+MapReduce becomes a thread pool; the "push" is the resource manager's
+segment upload (or any callable for remote push).
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence
+
+from pinot_tpu.common.schema import Schema, TimeUnit
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.tools.create_segment import create_segment_from_file
+
+
+def batch_build_segments(
+        input_paths: Sequence[str], fmt: str, schema: Schema,
+        out_base: str, table_config: Optional[TableConfig] = None,
+        segment_name_prefix: Optional[str] = None,
+        expressions: Optional[Dict[str, str]] = None,
+        incoming_time_unit: Optional[TimeUnit] = None,
+        max_workers: int = 4) -> List[str]:
+    """Build one segment per input file (parallel); returns segment dirs."""
+    prefix = segment_name_prefix or schema.schema_name
+
+    def build(i_path):
+        i, path = i_path
+        seg_dir = os.path.join(out_base, f"{prefix}_{i}")
+        create_segment_from_file(
+            path, fmt, schema, seg_dir, table_config,
+            segment_name=f"{prefix}_{i}", expressions=expressions,
+            incoming_time_unit=incoming_time_unit)
+        return seg_dir
+
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        return list(pool.map(build, enumerate(input_paths)))
+
+
+def push_segments(segment_dirs: Sequence[str],
+                  push: Callable[[str], str]) -> List[str]:
+    """Push built segments (parity: SegmentTarPushJob). `push(seg_dir)` is
+    typically `lambda d: manager.add_segment(table, d)` or an HTTP upload."""
+    return [push(d) for d in segment_dirs]
+
+
+def batch_ingest(input_paths: Sequence[str], fmt: str, schema: Schema,
+                 out_base: str, table: str, manager,
+                 table_config: Optional[TableConfig] = None,
+                 **kw) -> List[str]:
+    """Build + push in one call against a ResourceManager."""
+    dirs = batch_build_segments(input_paths, fmt, schema, out_base,
+                                table_config, **kw)
+    return push_segments(dirs, lambda d: manager.add_segment(table, d))

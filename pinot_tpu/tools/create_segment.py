@@ -1,0 +1,37 @@
+"""CreateSegment tool: input file → immutable segment directory.
+
+Parity: pinot-tools CreateSegmentCommand + the Hadoop
+SegmentCreationJob mapper body (read file → transform records →
+SegmentIndexCreationDriverImpl.build). The batch multi-file variant
+(one segment per input file + controller push) lives in
+tools/batch_ingest.py.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from pinot_tpu.common.schema import Schema, TimeUnit
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.ingestion import CompoundTransformer, make_record_reader
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.metadata import SegmentMetadata
+
+
+def create_segment_from_file(
+        input_path: str, fmt: str, schema: Schema, out_dir: str,
+        table_config: Optional[TableConfig] = None,
+        segment_name: Optional[str] = None,
+        expressions: Optional[Dict[str, str]] = None,
+        incoming_time_unit: Optional[TimeUnit] = None,
+        **reader_kw) -> SegmentMetadata:
+    """Read `input_path` (csv/json), run the record-transformer chain,
+    build one immutable segment into `out_dir`."""
+    transformer = CompoundTransformer(schema, expressions,
+                                      incoming_time_unit)
+    reader = make_record_reader(input_path, fmt, schema, **reader_kw)
+    with reader:
+        rows = (r for r in (transformer.transform(dict(raw))
+                            for raw in reader) if r is not None)
+        creator = SegmentCreator(schema, table_config,
+                                 segment_name=segment_name)
+        return creator.build(rows, out_dir)

@@ -1,0 +1,248 @@
+"""Ingestion readers + record transformer chain tests.
+
+Parity: core/data/readers/ (CSV/JSON/GenericRow/PinotSegment readers) and
+core/data/recordtransformer/ (CompoundTransformer ordering: expression →
+time → data-type → null → sanitation). End state: a segment built from a
+CSV file answers queries identically to the same rows built in-memory.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import make_schema, make_table_config
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.schema import (Schema, TimeUnit, dimension, metric,
+                                     time_field)
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.ingestion import (CompoundTransformer, CSVRecordReader,
+                                 DataTypeTransformer, GenericRowRecordReader,
+                                 JSONRecordReader, NullValueTransformer,
+                                 SanitationTransformer, SegmentRecordReader)
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.tools.create_segment import create_segment_from_file
+
+ROWS = [
+    {"teamID": "BOS", "league": "AL", "playerName": "ted",
+     "position": ["LF", "RF"], "runs": 10, "hits": 20, "average": 0.34,
+     "salary": 100.5, "yearID": 1999},
+    {"teamID": "NYA", "league": "AL", "playerName": "babe",
+     "position": ["P"], "runs": 15, "hits": 25, "average": 0.39,
+     "salary": 200.25, "yearID": 2001},
+    {"teamID": "BOS", "league": "AL", "playerName": "carl",
+     "position": ["CF"], "runs": 5, "hits": 8, "average": 0.28,
+     "salary": 50.0, "yearID": 2005},
+]
+
+
+def _write_csv(path):
+    with open(path, "w") as fh:
+        fh.write("teamID,league,playerName,position,runs,hits,average,"
+                 "salary,yearID\n")
+        for r in ROWS:
+            fh.write(",".join([
+                r["teamID"], r["league"], r["playerName"],
+                ";".join(r["position"]), str(r["runs"]), str(r["hits"]),
+                str(r["average"]), str(r["salary"]), str(r["yearID"]),
+            ]) + "\n")
+
+
+def _check_segment_queries(seg_dir):
+    eng = QueryEngine.from_dirs([seg_dir])
+    resp = eng.query("SELECT COUNT(*), SUM(runs) FROM baseballStats")
+    assert int(resp.aggregation_results[0].value) == 3
+    assert float(resp.aggregation_results[1].value) == 30.0
+    resp = eng.query("SELECT SUM(hits) FROM baseballStats "
+                     "WHERE teamID = 'BOS'")
+    assert float(resp.aggregation_results[0].value) == 28.0
+    resp = eng.query("SELECT COUNT(*) FROM baseballStats "
+                     "WHERE position = 'RF'")
+    assert int(resp.aggregation_results[0].value) == 1
+
+
+def test_csv_reader_to_segment_to_query():
+    base = tempfile.mkdtemp()
+    csv_path = os.path.join(base, "in.csv")
+    _write_csv(csv_path)
+    seg_dir = os.path.join(base, "seg")
+    meta = create_segment_from_file(csv_path, "csv", make_schema(), seg_dir,
+                                    make_table_config(),
+                                    segment_name="csv_seg_0")
+    assert meta.total_docs == 3
+    assert meta.start_time == 1999 and meta.end_time == 2005
+    _check_segment_queries(seg_dir)
+
+
+def test_json_reader_to_segment_to_query():
+    base = tempfile.mkdtemp()
+    json_path = os.path.join(base, "in.json")
+    with open(json_path, "w") as fh:
+        for r in ROWS:
+            fh.write(json.dumps(r) + "\n")
+    seg_dir = os.path.join(base, "seg")
+    create_segment_from_file(json_path, "json", make_schema(), seg_dir,
+                             make_table_config())
+    _check_segment_queries(seg_dir)
+
+
+def test_json_array_format():
+    base = tempfile.mkdtemp()
+    json_path = os.path.join(base, "arr.json")
+    with open(json_path, "w") as fh:
+        json.dump(ROWS, fh)
+    rows = list(JSONRecordReader(json_path))
+    assert len(rows) == 3 and rows[1]["playerName"] == "babe"
+
+
+def test_csv_reader_mv_and_nulls():
+    base = tempfile.mkdtemp()
+    p = os.path.join(base, "x.csv")
+    with open(p, "w") as fh:
+        fh.write("teamID,position,runs\nBOS,LF;RF,5\nNYA,,\n")
+    rows = list(CSVRecordReader(p, make_schema()))
+    assert rows[0]["position"] == ["LF", "RF"]
+    assert rows[1]["position"] is None and rows[1]["runs"] is None
+
+
+def test_transformer_chain():
+    schema = make_schema()
+    t = CompoundTransformer(schema)
+    # strings coerced, MV normalized, nulls filled, NULs stripped
+    row = t.transform({"teamID": "B\x00OS", "league": "AL",
+                       "playerName": "x" * 600, "position": "LF",
+                       "runs": "7", "hits": 3.0, "average": "0.5",
+                       "yearID": "1998"})
+    assert row["teamID"] == "BOS"
+    assert len(row["playerName"]) == 512
+    assert row["position"] == ["LF"]
+    assert row["runs"] == 7 and isinstance(row["runs"], int)
+    assert row["salary"] == 0.0          # missing metric → default fill
+    assert row["yearID"] == 1998
+
+
+def test_expression_transformer_derives_column():
+    schema = Schema("t", [dimension("a", DataType.INT),
+                          metric("b", DataType.LONG),
+                          time_field("days", DataType.INT, TimeUnit.DAYS)])
+    t = CompoundTransformer(schema,
+                            expressions={"days": "time_convert(hours,"
+                                                 "'HOURS','DAYS')"})
+    row = t.transform({"a": 1, "b": 2, "hours": 48})
+    assert row["days"] == 2
+
+
+def test_time_transformer_incoming_unit():
+    schema = make_schema()        # yearID in DAYS
+    t = CompoundTransformer(schema, incoming_time_unit=TimeUnit.HOURS)
+    row = t.transform({"teamID": "BOS", "yearID": 48})   # 48h → 2 days
+    assert row["yearID"] == 2
+
+
+def test_segment_record_reader_roundtrip():
+    base = tempfile.mkdtemp()
+    csv_path = os.path.join(base, "in.csv")
+    _write_csv(csv_path)
+    seg_dir = os.path.join(base, "seg")
+    create_segment_from_file(csv_path, "csv", make_schema(), seg_dir,
+                             make_table_config())
+    seg = ImmutableSegmentLoader.load(seg_dir)
+    rows = list(SegmentRecordReader(seg))
+    assert len(rows) == 3
+    by_player = {r["playerName"]: r for r in rows}
+    assert by_player["ted"]["runs"] == 10
+    assert sorted(by_player["ted"]["position"]) == ["LF", "RF"]
+    # rebuild a segment from the re-read rows: same answers
+    seg2_dir = os.path.join(base, "seg2")
+    from pinot_tpu.segment.creator import SegmentCreator
+    SegmentCreator(make_schema(), make_table_config(),
+                   segment_name="rebuilt").build(
+        GenericRowRecordReader(rows), seg2_dir)
+    _check_segment_queries(seg2_dir)
+
+
+def test_batch_ingest_to_cluster():
+    """Parity: SegmentCreationJob + SegmentTarPushJob — one segment per
+    input file, pushed to the controller, queryable via the cluster."""
+    from pinot_tpu.tools.batch_ingest import batch_ingest
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    base = tempfile.mkdtemp()
+    paths = []
+    for i in range(3):
+        p = os.path.join(base, f"in_{i}.csv")
+        _write_csv(p)
+        paths.append(p)
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"), num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        names = batch_ingest(paths, "csv", make_schema(),
+                             os.path.join(base, "segs"),
+                             "baseballStats_OFFLINE",
+                             cluster.controller.manager,
+                             make_table_config())
+        assert len(names) == 3
+        resp = cluster.query("SELECT COUNT(*), SUM(runs) FROM baseballStats")
+        assert int(resp.aggregation_results[0].value) == 9
+        assert float(resp.aggregation_results[1].value) == 90.0
+    finally:
+        cluster.stop()
+
+
+def test_poison_record_does_not_kill_realtime_consumer():
+    """A record that decodes but fails type coercion must be dropped, not
+    kill the partition consumer."""
+    import time as _time
+
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig, TableConfig,
+                                               TableType)
+
+    base = tempfile.mkdtemp()
+    stream = MemoryStream("poison", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_poison", MemoryStreamConsumerFactory(stream, batch_size=8))
+    cluster = EmbeddedCluster(base, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(TableConfig(
+            "baseballStats", table_type=TableType.REALTIME,
+            indexing_config=IndexingConfig(stream_configs={
+                "stream.factory.name": "mem_poison",
+                "stream.topic.name": "poison"}),
+            segments_config=SegmentsConfig(replication=1)))
+        good = dict(ROWS[0])
+        bad = dict(ROWS[1])
+        bad["runs"] = "not_a_number"
+        stream.publish(good, partition=0)
+        stream.publish(bad, partition=0)       # poison: dropped, not fatal
+        stream.publish(dict(ROWS[2]), partition=0)
+        deadline = _time.monotonic() + 10
+        cnt = -1
+        while _time.monotonic() < deadline:
+            resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+            if not resp.exceptions:
+                cnt = int(resp.aggregation_results[0].value)
+                if cnt == 2:
+                    break
+            _time.sleep(0.05)
+        assert cnt == 2
+        rt = cluster.participants["Server_0"].realtime
+        assert rt.consuming_state("baseballStats__0__0") == "CONSUMING"
+    finally:
+        cluster.stop()
+
+
+def test_expression_transformer_scalar_literals():
+    schema = Schema("t", [dimension("region", DataType.STRING),
+                          metric("b", DataType.LONG)])
+    from pinot_tpu.ingestion import ExpressionTransformer
+    t = ExpressionTransformer({"region": "'west'"})
+    assert t.transform({"b": 1})["region"] == "west"
