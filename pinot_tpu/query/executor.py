@@ -62,6 +62,12 @@ class ServerQueryExecutor:
 
     def _execute_segment(self, segment: ImmutableSegment,
                          request: BrokerRequest) -> IntermediateResultsBlock:
+        if request.is_aggregation and not request.is_selection and \
+                getattr(segment, "star_trees", None):
+            from pinot_tpu.startree.executor import try_star_tree_execute
+            blk = try_star_tree_execute(segment, request)
+            if blk is not None:
+                return blk
         if self.use_device:
             try:
                 plan = self.plan_maker.make_segment_plan(segment, request)

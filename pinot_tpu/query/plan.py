@@ -349,6 +349,18 @@ class InstancePlanMaker:
                 self._try_metadata_fast_path(plan, segment, request):
             return plan
 
+        # star-tree: a covering pre-aggregated cube answers the query in
+        # O(groups) host work (core/startree/ parity; startree/executor.py).
+        # This hook serves the sharded path (which plans directly); the
+        # sequential path already checked in ServerQueryExecutor.
+        if request.is_aggregation and not request.is_selection and \
+                getattr(segment, "star_trees", None):
+            from pinot_tpu.startree.executor import try_star_tree_execute
+            blk = try_star_tree_execute(segment, request)
+            if blk is not None:
+                plan.fast_path_result = blk
+                return plan
+
         filter_spec, params = resolve_filter(request.filter, segment)
 
         if filter_spec == EMPTY:

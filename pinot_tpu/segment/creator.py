@@ -64,6 +64,11 @@ class SegmentCreator:
             columns = self._columnarize(records)
 
         os.makedirs(out_dir, exist_ok=True)
+        # a rebuild into the same dir must not serve a previous build's
+        # pre-aggregations against the new rows
+        import glob as _glob
+        for stale in _glob.glob(os.path.join(out_dir, "startree.*")):
+            os.remove(stale)
         idx_cfg = self.table_config.indexing_config
         num_docs = None
         col_meta: Dict[str, ColumnMetadata] = {}
@@ -196,6 +201,9 @@ class SegmentCreator:
         with open(os.path.join(out_dir, fmt.CREATION_META_FILE), "w") as f:
             json.dump({"creator": "pinot_tpu", "version": fmt.SEGMENT_VERSION},
                       f)
+        if idx_cfg.star_tree_configs:
+            from pinot_tpu.startree.cube import build_and_save_star_trees
+            build_and_save_star_trees(out_dir, self.table_config)
         return meta
 
 

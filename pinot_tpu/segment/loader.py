@@ -163,6 +163,7 @@ class ImmutableSegment:
                  data_sources: Dict[str, DataSource]):
         self.metadata = metadata
         self._data_sources = data_sources
+        self.star_trees = []     # pre-aggregated cubes (startree/cube.py)
 
     @property
     def segment_name(self) -> str:
@@ -244,4 +245,6 @@ class ImmutableSegmentLoader:
         seg = ImmutableSegment(meta, sources)
         for ds in sources.values():
             ds._segment = seg
+        from pinot_tpu.startree.cube import load_star_trees
+        seg.star_trees = load_star_trees(seg_dir)
         return seg

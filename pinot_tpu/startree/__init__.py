@@ -1,0 +1,6 @@
+from pinot_tpu.startree.cube import (StarTreeConfig, StarTreeCube,
+                                     build_star_trees, load_star_trees)
+from pinot_tpu.startree.executor import try_star_tree_execute
+
+__all__ = ["StarTreeConfig", "StarTreeCube", "build_star_trees",
+           "load_star_trees", "try_star_tree_execute"]

@@ -1,0 +1,213 @@
+"""Star-tree query execution: route eligible queries to a cube.
+
+Parity: core/startree/ query side — StarTreeFilterOperator +
+StarTreeAggregationExecutor/StarTreeGroupByExecutor and the plan nodes
+that swap in when a query's dimensions/metrics are covered
+(StarTreeV2's eligibility rules). Here the cube is a columnar grouped
+table, so execution is: evaluate the filter over the cube's dictId lanes
+(reusing the host filter evaluator through a segment-shaped facade),
+then weighted aggregation over the surviving groups.
+
+Cubes are small by construction (bounded at build), so this runs
+host-side numpy — O(groups) instead of the device's O(docs); doc-scale
+work never happens at all, which is the entire point of the structure.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.common import expression as expr_mod
+from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.query.aggregation import make_functions
+from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
+
+_COVERED_BASES = {"COUNT", "SUM", "AVG", "MIN", "MAX", "MINMAXRANGE"}
+
+
+class _CubeDataSource:
+    """Segment-DataSource-shaped view of one cube dimension lane."""
+
+    def __init__(self, parent_ds, ids: np.ndarray):
+        self.metadata = parent_ds.metadata
+        self.dictionary = parent_ds.dictionary
+        self.dict_ids = ids
+        self.raw_values = None
+        self.mv_dict_ids = None
+        self.inverted_index = None
+        self.bloom_filter = None
+        self.sorted_ranges = None
+
+
+class _CubeView:
+    """Segment-shaped facade so host filter evaluation runs unchanged."""
+
+    def __init__(self, segment, cube):
+        self._segment = segment
+        self._cube = cube
+        self.num_docs = cube.n_groups
+        self.segment_name = segment.segment_name
+
+    def has_column(self, col: str) -> bool:
+        return col in self._cube.dim_ids
+
+    def data_source(self, col: str) -> _CubeDataSource:
+        return _CubeDataSource(self._segment.data_source(col),
+                               self._cube.dim_ids[col])
+
+
+def _eligible_cube(segment, request: BrokerRequest, functions):
+    """Pick the first cube covering the query, or None.
+
+    Coverage: filter + group columns ⊆ dimensions (expressions allowed in
+    filters when their source columns are dimensions); aggregations are
+    COUNT(*) or covered-base functions over cube metrics.
+    """
+    cubes = getattr(segment, "star_trees", None)
+    if not cubes or not request.is_aggregation or request.is_selection:
+        return None
+    if request.query_options.options.get("useStarTree") == "false":
+        return None
+    needed_dims = set()
+    for c in request.filter_columns():
+        needed_dims.update(expr_mod.referenced_columns(c))
+    group_cols = list(request.group_by.columns) if request.group_by else []
+    for c in group_cols:
+        if expr_mod.is_expression(c):
+            return None                       # group keys must be plain dims
+        needed_dims.add(c)
+    needed_metrics = set()
+    for f in functions:
+        if f.info.is_mv:
+            return None
+        if f.info.base == "COUNT":
+            continue
+        if f.info.base not in _COVERED_BASES:
+            return None
+        if expr_mod.is_expression(f.column):
+            return None
+        needed_metrics.add(f.column)
+    for cube in cubes:
+        if needed_dims <= set(cube.dimensions) and \
+                needed_metrics <= set(cube.metrics):
+            return cube
+    return None
+
+
+def try_star_tree_execute(segment, request: BrokerRequest
+                          ) -> Optional[IntermediateResultsBlock]:
+    """Execute over a covering cube; None when not eligible."""
+    if not getattr(segment, "star_trees", None):
+        return None
+    functions = make_functions(request.aggregations)
+    cube = _eligible_cube(segment, request, functions)
+    if cube is None:
+        return None
+    from pinot_tpu.query import host_exec
+    view = _CubeView(segment, cube)
+    try:
+        mask = host_exec._eval_filter(request.filter, view)
+    except Exception:  # noqa: BLE001 — unresolvable predicate: fall back
+        return None
+
+    blk = IntermediateResultsBlock()
+    counts = cube.counts
+    matched_docs = int(counts[mask].sum())
+    if request.is_group_by:
+        _cube_group_by(segment, cube, request, functions, mask, blk)
+    else:
+        blk.agg_intermediates = [
+            _cube_aggregate(cube, f, mask) for f in functions]
+    blk.stats = ExecutionStats(
+        num_docs_scanned=int(mask.sum()),         # groups, not raw docs —
+        # parity: star-tree queries report aggregated doc counts
+        num_entries_scanned_in_filter=cube.n_groups,
+        num_segments_processed=1,
+        num_segments_matched=1 if matched_docs else 0,
+        total_docs=segment.num_docs)
+    return blk
+
+
+def _cube_aggregate(cube, f, mask: np.ndarray):
+    base = f.info.base
+    cnt = int(cube.counts[mask].sum())
+    if base == "COUNT":
+        return cnt
+    if cnt == 0:
+        return None
+    stats = cube.metric_stats[f.column]
+    if base == "SUM":
+        return float(stats["sum"][mask].sum())
+    if base == "AVG":
+        return (float(stats["sum"][mask].sum()), cnt)
+    if base == "MIN":
+        return float(stats["min"][mask].min())
+    if base == "MAX":
+        return float(stats["max"][mask].max())
+    if base == "MINMAXRANGE":
+        return (float(stats["min"][mask].min()),
+                float(stats["max"][mask].max()))
+    raise ValueError(base)
+
+
+def _cube_group_by(segment, cube, request, functions, mask: np.ndarray,
+                   blk: IntermediateResultsBlock) -> None:
+    gcols = request.group_by.columns
+    sel = np.nonzero(mask)[0]
+    lanes = [cube.dim_ids[c][sel].astype(np.int64) for c in gcols]
+    cards = [segment.data_source(c).metadata.cardinality for c in gcols]
+    key = np.zeros(len(sel), dtype=np.int64)
+    for lane, card in zip(lanes, cards):
+        key = key * card + lane
+    uniq, inverse = np.unique(key, return_inverse=True)
+    g = len(uniq)
+
+    value_cols = []
+    rem = uniq.copy()
+    for c, card in zip(reversed(gcols), reversed(cards)):
+        d = segment.data_source(c).dictionary
+        value_cols.append(d.decode(rem % card))
+        rem //= card
+    value_cols.reverse()
+
+    counts = np.zeros(g, dtype=np.int64)
+    np.add.at(counts, inverse, cube.counts[sel])
+    per_fn: List[List] = []
+    for f in functions:
+        base = f.info.base
+        if base == "COUNT":
+            per_fn.append([int(c) for c in counts])
+            continue
+        stats = cube.metric_stats[f.column]
+        if base in ("SUM", "AVG"):
+            sums = np.zeros(g)
+            np.add.at(sums, inverse, stats["sum"][sel])
+            if base == "SUM":
+                per_fn.append([float(s) for s in sums])
+            else:
+                per_fn.append([(float(s), int(c))
+                               for s, c in zip(sums, counts)])
+        else:
+            mins = np.full(g, np.inf)
+            maxs = np.full(g, -np.inf)
+            np.minimum.at(mins, inverse, stats["min"][sel])
+            np.maximum.at(maxs, inverse, stats["max"][sel])
+            if base == "MIN":
+                per_fn.append([float(v) for v in mins])
+            elif base == "MAX":
+                per_fn.append([float(v) for v in maxs])
+            else:
+                per_fn.append([(float(a), float(b))
+                               for a, b in zip(mins, maxs)])
+
+    blk.group_map = {
+        tuple(_plain(vc[i]) for vc in value_cols):
+            [per_fn[fi][i] for fi in range(len(functions))]
+        for i in range(g)}
+
+
+def _plain(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

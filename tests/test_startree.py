@@ -1,0 +1,210 @@
+"""Star-tree (pre-aggregated cube) tests.
+
+Mirrors StarTreeClusterIntegrationTest: every eligible query must return
+EXACTLY the same answer with and without the star-tree path, and the
+star-tree path must scan orders of magnitude fewer rows.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import make_columns, make_schema, make_table_config
+
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+ST_CONFIG = {
+    "dimensionsSplitOrder": ["teamID", "league", "yearID"],
+    "functionColumnPairs": ["SUM__runs", "SUM__hits", "MAX__average"],
+    "maxSize": 1 << 20,
+}
+
+QUERIES = [
+    "SELECT COUNT(*) FROM baseballStats",
+    "SELECT SUM(runs), COUNT(*) FROM baseballStats WHERE teamID = 'BOS'",
+    "SELECT SUM(runs) FROM baseballStats WHERE yearID >= 2000 AND "
+    "league = 'AL'",
+    "SELECT MIN(average), MAX(average), AVG(hits) FROM baseballStats "
+    "WHERE teamID IN ('BOS', 'NYA', 'SEA')",
+    "SELECT MINMAXRANGE(runs) FROM baseballStats WHERE yearID <> 1995",
+    "SELECT SUM(runs) FROM baseballStats GROUP BY teamID TOP 100",
+    "SELECT SUM(hits), COUNT(*) FROM baseballStats "
+    "WHERE league = 'NL' GROUP BY teamID, yearID TOP 1000",
+    "SELECT AVG(runs) FROM baseballStats GROUP BY league "
+    "HAVING AVG(runs) > 0 TOP 10",
+    # expression filter whose source column is a cube dimension
+    "SELECT SUM(runs) FROM baseballStats "
+    "WHERE time_convert(yearID,'DAYS','HOURS') >= 48000",
+]
+
+
+@pytest.fixture(scope="module")
+def segments():
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.star_tree_configs = [ST_CONFIG]
+    d_st = os.path.join(base, "with_st")
+    d_plain = os.path.join(base, "plain")
+    cols = make_columns(20_000, seed=23)
+    SegmentCreator(make_schema(), cfg, "st_seg").build(dict(cols), d_st)
+    SegmentCreator(make_schema(), make_table_config(),
+                   "plain_seg").build(dict(cols), d_plain)
+    return (ImmutableSegmentLoader.load(d_st),
+            ImmutableSegmentLoader.load(d_plain), cols)
+
+
+def _result_key(resp):
+    out = []
+    if resp.aggregation_results is None:
+        return sorted(map(tuple, resp.selection_results.results))
+    for a in resp.aggregation_results:
+        if a.group_by_result is not None:
+            out.append(sorted((tuple(g["group"]), g["value"])
+                              for g in a.group_by_result))
+        else:
+            out.append(a.value)
+    return out
+
+
+def test_cubes_built_and_loaded(segments):
+    seg_st, seg_plain, _ = segments
+    assert len(seg_st.star_trees) == 1
+    cube = seg_st.star_trees[0]
+    assert cube.dimensions == ["teamID", "league", "yearID"]
+    assert set(cube.metrics) == {"runs", "hits", "average"}
+    assert 0 < cube.n_groups < seg_st.num_docs
+    assert int(cube.counts.sum()) == seg_st.num_docs
+    assert seg_plain.star_trees == []
+
+
+def test_star_tree_same_answers_as_plain_path(segments):
+    """The StarTreeClusterIntegrationTest contract."""
+    seg_st, seg_plain, _ = segments
+    eng_st = QueryEngine([seg_st])
+    eng_plain = QueryEngine([seg_plain])
+    for q in QUERIES:
+        r_st = _result_key(eng_st.query(q))
+        r_plain = _result_key(eng_plain.query(q))
+        assert r_st == r_plain, q
+
+
+def test_star_tree_disable_option(segments):
+    seg_st, _, _ = segments
+    eng = QueryEngine([seg_st])
+    q = "SELECT SUM(runs) FROM baseballStats WHERE teamID = 'BOS'"
+    on = eng.query(q)
+    off = eng.query(q + " OPTION(useStarTree=false)")
+    assert on.aggregation_results[0].value == \
+        off.aggregation_results[0].value
+    # the cube path scans groups, not docs
+    assert on.num_docs_scanned < off.num_docs_scanned
+
+
+def test_star_tree_ineligible_falls_back(segments):
+    seg_st, seg_plain, cols = segments
+    eng_st = QueryEngine([seg_st])
+    eng_plain = QueryEngine([seg_plain])
+    # uncovered metric (salary), uncovered dim (playerName), percentile,
+    # selection — all must silently take the normal path
+    for q in [
+        "SELECT SUM(salary) FROM baseballStats WHERE teamID = 'BOS'",
+        "SELECT SUM(runs) FROM baseballStats WHERE playerName = "
+        "'player_001'",
+        "SELECT PERCENTILE50(runs) FROM baseballStats",
+        "SELECT DISTINCTCOUNT(runs) FROM baseballStats "
+        "WHERE teamID = 'BOS'",
+        "SELECT teamID, runs FROM baseballStats LIMIT 5",
+    ]:
+        r_st = _result_key(eng_st.query(q))
+        r_plain = _result_key(eng_plain.query(q))
+        assert r_st == r_plain, q
+
+
+def test_star_tree_group_by_vs_numpy(segments):
+    seg_st, _, cols = segments
+    eng = QueryEngine([seg_st])
+    resp = eng.query("SELECT SUM(runs) FROM baseballStats "
+                     "WHERE league = 'AL' GROUP BY teamID TOP 100")
+    m = cols["league"] == "AL"
+    runs = cols["runs"].astype(np.float64)
+    expected = {}
+    for t in np.unique(cols["teamID"][m]):
+        expected[str(t)] = float(runs[m & (cols["teamID"] == t)].sum())
+    got = {g["group"][0]: float(g["value"])
+           for g in resp.aggregation_results[0].group_by_result}
+    assert got == expected
+
+
+def test_star_tree_through_cluster_upload():
+    """Cube files travel with the segment through deep store + download."""
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.star_tree_configs = [ST_CONFIG]
+    seg_dir = os.path.join(base, "seg")
+    cols = make_columns(5000, seed=29)
+    SegmentCreator(make_schema(), cfg, "st_up").build(cols, seg_dir)
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"), num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(cfg)
+        cluster.upload_segment("baseballStats_OFFLINE", seg_dir)
+        server = cluster.servers["Server_0"]
+        tdm = server.data_manager.table("baseballStats_OFFLINE")
+        acquired, _ = tdm.acquire_segments(["st_up"])
+        try:
+            assert len(acquired[0].segment.star_trees) == 1
+        finally:
+            for sdm in acquired:
+                tdm.release_segment(sdm)
+        resp = cluster.query("SELECT SUM(runs) FROM baseballStats "
+                             "WHERE teamID = 'BOS'")
+        exp = float(cols["runs"][cols["teamID"] == "BOS"].sum())
+        assert float(resp.aggregation_results[0].value) == exp
+    finally:
+        cluster.stop()
+
+
+def test_rebuild_removes_stale_cubes():
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.star_tree_configs = [ST_CONFIG]
+    d = os.path.join(base, "seg")
+    cols1 = make_columns(2000, seed=31)
+    SegmentCreator(make_schema(), cfg, "reb").build(cols1, d)
+    assert len(ImmutableSegmentLoader.load(d).star_trees) == 1
+    # rebuild same dir WITHOUT star-tree config: stale cubes must vanish
+    cols2 = make_columns(2000, seed=32)
+    SegmentCreator(make_schema(), make_table_config(), "reb").build(cols2, d)
+    seg = ImmutableSegmentLoader.load(d)
+    assert seg.star_trees == []
+    eng = QueryEngine([seg])
+    resp = eng.query("SELECT SUM(runs) FROM baseballStats "
+                     "WHERE teamID = 'BOS'")
+    exp = float(cols2["runs"][cols2["teamID"] == "BOS"].sum())
+    assert float(resp.aggregation_results[0].value) == exp
+
+
+def test_broken_cube_files_do_not_brick_segment():
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.star_tree_configs = [ST_CONFIG]
+    d = os.path.join(base, "seg")
+    SegmentCreator(make_schema(), cfg, "brk").build(
+        make_columns(2000, seed=33), d)
+    os.remove(os.path.join(d, "startree.0.npz"))    # crash-torn save
+    seg = ImmutableSegmentLoader.load(d)            # must not raise
+    assert seg.star_trees == []
+
+
+def test_max_leaf_records_does_not_disable_cube():
+    from pinot_tpu.startree.cube import StarTreeConfig
+    c = StarTreeConfig.from_json({
+        "dimensionsSplitOrder": ["teamID"],
+        "functionColumnPairs": ["SUM__runs"],
+        "maxLeafRecords": 10000})
+    assert c.max_groups > 10000     # Pinot's split threshold is not a cap
