@@ -18,6 +18,7 @@ from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree,
                                       GroupBy, HavingNode, InstanceRequest,
                                       QueryOptions, Selection, SelectionSort)
+from pinot_tpu.common.sketches import HyperLogLog, TDigest
 
 # ---------------------------------------------------------------------------
 # Request JSON
@@ -145,7 +146,9 @@ def instance_request_from_bytes(b: bytes) -> InstanceRequest:
 # Typed binary object serde (DataTable cells / aggregation intermediates)
 #
 # Tags: N null, B bool, i int64, I bigint(str), d float64, s str, b bytes,
-#       t tuple, l list, S set, D dict (sorted by key bytes for determinism)
+#       t tuple, l list, S set, D dict (sorted by key bytes for determinism),
+#       H HyperLogLog, T TDigest (sketch custom objects —
+#       ObjectSerDeUtils.ObjectType HyperLogLog/TDigest parity)
 # ---------------------------------------------------------------------------
 
 _I64 = struct.Struct(">q")
@@ -219,6 +222,16 @@ def _write_obj(out: bytearray, v: Any) -> None:
         for kb, vb in items:
             out += kb
             out += vb
+    elif isinstance(v, HyperLogLog):
+        payload = v.to_bytes()
+        out += b"H"
+        out += _U32.pack(len(payload))
+        out += payload
+    elif isinstance(v, TDigest):
+        payload = v.to_bytes()
+        out += b"T"
+        out += _U32.pack(len(payload))
+        out += payload
     else:
         raise TypeError(f"unserializable object type {type(v)}")
 
@@ -271,4 +284,9 @@ def _read_obj(b: bytes, off: int):
             v, off = _read_obj(b, off)
             d[k] = v
         return d, off
+    if tag in (b"H", b"T"):
+        n = _U32.unpack_from(b, off)[0]
+        off += 4
+        cls = HyperLogLog if tag == b"H" else TDigest
+        return cls.from_bytes(b[off:off + n]), off + n
     raise ValueError(f"bad object tag {tag!r} at {off - 1}")

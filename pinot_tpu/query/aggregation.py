@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.common.sketches import HyperLogLog, TDigest
+
 _PERCENTILE_RE = re.compile(
     r"^(PERCENTILE|PERCENTILEEST|PERCENTILETDIGEST)(\d+)(MV)?$")
 
@@ -76,10 +78,15 @@ class AggregationFunction:
         if base == "AVG":
             s = float(np.dot(h, np.asarray(dict_values, dtype=np.float64)))
             return (s, int(h.sum()))
-        if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+        if base == "DISTINCTCOUNT":
             nz = np.nonzero(h)[0]
             return set(_plain(dict_values[i]) for i in nz)
-        if base in ("PERCENTILE", "PERCENTILEEST", "PERCENTILETDIGEST"):
+        if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+            # sketch intermediate: mergeable across segments/servers with
+            # non-shared dictionaries (ObjectSerDeUtils HyperLogLog parity)
+            nz = np.nonzero(h)[0]
+            return HyperLogLog.from_values(np.asarray(dict_values)[nz])
+        if base == "PERCENTILE":
             nz = np.nonzero(h)[0]
             out: Dict = {}
             for i in nz:
@@ -88,6 +95,11 @@ class AggregationFunction:
                 k = _plain(dict_values[i])
                 out[k] = out.get(k, 0) + int(h[i])
             return out
+        if base in ("PERCENTILEEST", "PERCENTILETDIGEST"):
+            nz = np.nonzero(h)[0]
+            return TDigest.from_values(
+                np.asarray(dict_values, dtype=np.float64)[nz],
+                weights=h[nz])
         if base in ("MIN", "MAX", "MINMAXRANGE"):
             # expression path: transformed values are not id-ordered, so
             # extremes come from the histogram's support
@@ -142,13 +154,17 @@ class AggregationFunction:
             mx = a[1] if b[1] is None else (b[1] if a[1] is None
                                             else max(a[1], b[1]))
             return (mn, mx)
-        if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+        if base == "DISTINCTCOUNT":
             return a | b
-        if base in ("PERCENTILE", "PERCENTILEEST", "PERCENTILETDIGEST"):
+        if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+            return a.merge(b)
+        if base == "PERCENTILE":
             out = dict(a)
             for k, v in b.items():
                 out[k] = out.get(k, 0) + v
             return out
+        if base in ("PERCENTILEEST", "PERCENTILETDIGEST"):
+            return a.merge(b)
         raise ValueError(base)
 
     # -- final result ------------------------------------------------------
@@ -174,10 +190,16 @@ class AggregationFunction:
             if mn is None or mx is None:
                 return float("-inf")
             return mx - mn
-        if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+        if base == "DISTINCTCOUNT":
             return len(intermediate)
-        if base in ("PERCENTILE", "PERCENTILEEST", "PERCENTILETDIGEST"):
+        if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+            return int(round(intermediate.cardinality()))
+        if base == "PERCENTILE":
             return self._percentile_from_counts(intermediate)
+        if base in ("PERCENTILEEST", "PERCENTILETDIGEST"):
+            if intermediate.total_weight == 0:
+                return float("-inf")
+            return intermediate.quantile(self.info.percentile / 100.0)
         raise ValueError(base)
 
     def empty_result(self):

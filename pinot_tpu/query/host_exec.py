@@ -20,6 +20,7 @@ import numpy as np
 from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
                                       FilterQueryTree)
+from pinot_tpu.common.sketches import HyperLogLog, TDigest
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
 from pinot_tpu.segment.loader import DataSource, ImmutableSegment
@@ -256,11 +257,17 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
         return (float(np.sum(np.asarray(vals, dtype=np.float64))), len(vals))
     if base == "MINMAXRANGE":
         return (float(vals.min()), float(vals.max()))
-    if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+    if base == "DISTINCTCOUNT":
         return set(_plain(v) for v in np.unique(vals))
-    if base in ("PERCENTILE", "PERCENTILEEST", "PERCENTILETDIGEST"):
+    if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+        return HyperLogLog.from_values(np.unique(vals))
+    if base == "PERCENTILE":
         uniq, counts = np.unique(vals, return_counts=True)
         return {_plain(u): int(c) for u, c in zip(uniq, counts)}
+    if base in ("PERCENTILEEST", "PERCENTILETDIGEST"):
+        uniq, counts = np.unique(np.asarray(vals, dtype=np.float64),
+                                 return_counts=True)
+        return TDigest.from_values(uniq, weights=counts)
     raise ValueError(base)
 
 
@@ -323,7 +330,9 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
             cm = segment.data_source(f.column).metadata
             if cm.has_dictionary and not cm.single_value:
                 raise ValueError("host group-by over MV metric unsupported")
-        vals = _group_value_lane(segment, f.column, mask).astype(np.float64)
+        vals = _group_value_lane(segment, f.column, mask)
+        if base not in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+            vals = vals.astype(np.float64)   # distinct bases keep strings
         if base in ("SUM", "AVG"):
             sums = np.zeros(g)
             np.add.at(sums, inverse, vals)
@@ -347,15 +356,20 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
                 per_fn.append([(float(a), float(b))
                                for a, b in zip(mins, maxs)])
         else:
-            # set/map intermediates per group (distinctcount, percentile)
+            # set/map/sketch intermediates per group
             items: List = [None] * g
             for gi in range(g):
                 sel = vals[inverse == gi]
-                if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+                if base == "DISTINCTCOUNT":
                     items[gi] = set(_plain(v) for v in np.unique(sel))
-                else:
+                elif base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+                    items[gi] = HyperLogLog.from_values(np.unique(sel))
+                elif base == "PERCENTILE":
                     u, c = np.unique(sel, return_counts=True)
                     items[gi] = {_plain(x): int(y) for x, y in zip(u, c)}
+                else:
+                    u, c = np.unique(sel, return_counts=True)
+                    items[gi] = TDigest.from_values(u, weights=c)
             per_fn.append(items)
 
     blk.group_map = {

@@ -619,6 +619,11 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
         dense_ok = segment.padded_docs <= kernels.DENSE_ROWS_LIMIT and \
             g_pad <= kernels.DENSE_G_LIMIT
         if for_group:
+            if fname in ("distinctcount", "percentile"):
+                # the group kernel has no per-group histogram path; these
+                # take the host executor (set/sketch intermediates)
+                raise UnsupportedOnDevice(
+                    f"group-by with {fname} aggregation")
             if fname in ("sum", "avg"):
                 if dense_ok and is_int_dict:
                     needed[(col, "parts")] = None

@@ -1,0 +1,180 @@
+"""Mergeable sketch tests: HLL + t-digest.
+
+Parity: ObjectSerDeUtils HyperLogLog/TDigest custom objects — the key
+property is mergeability across segments/servers with NON-shared
+dictionaries (exact per-dictionary histograms lose that).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, make_schema, make_table_config
+
+from pinot_tpu.common.serde import obj_from_bytes, obj_to_bytes
+from pinot_tpu.common.sketches import HyperLogLog, TDigest
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+
+# -- unit: HLL ---------------------------------------------------------------
+
+def test_hll_estimate_accuracy():
+    rng = np.random.default_rng(1)
+    for true_n in (10, 100, 5_000, 100_000):
+        vals = rng.integers(0, 2**60, true_n)
+        uniq = len(np.unique(vals))
+        est = HyperLogLog.from_values(vals).cardinality()
+        assert abs(est - uniq) / uniq < 0.06, (true_n, est, uniq)
+
+
+def test_hll_merge_equals_union():
+    a_vals = np.arange(0, 60_000)
+    b_vals = np.arange(40_000, 100_000)      # overlapping ranges
+    a = HyperLogLog.from_values(a_vals)
+    b = HyperLogLog.from_values(b_vals)
+    merged = a.merge(b)
+    union = HyperLogLog.from_values(np.arange(0, 100_000))
+    assert np.array_equal(merged.registers, union.registers)
+    assert abs(merged.cardinality() - 100_000) / 100_000 < 0.05
+
+
+def test_hll_string_values_and_serde():
+    vals = np.array([f"user_{i}" for i in range(10_000)], dtype=object)
+    h = HyperLogLog.from_values(vals)
+    assert abs(h.cardinality() - 10_000) / 10_000 < 0.06
+    rt = obj_from_bytes(obj_to_bytes(h))
+    assert rt == h and rt.cardinality() == h.cardinality()
+
+
+# -- unit: t-digest ----------------------------------------------------------
+
+def test_tdigest_quantiles():
+    rng = np.random.default_rng(2)
+    vals = rng.normal(100, 15, 200_000)
+    td = TDigest.from_values(vals)
+    assert len(td.means) < 500               # actually compressed
+    for q in (0.01, 0.25, 0.5, 0.75, 0.95, 0.99):
+        exact = np.quantile(vals, q)
+        est = td.quantile(q)
+        spread = np.quantile(vals, 0.99) - np.quantile(vals, 0.01)
+        assert abs(est - exact) / spread < 0.02, (q, est, exact)
+
+
+def test_tdigest_merge_matches_whole():
+    rng = np.random.default_rng(3)
+    a_vals = rng.exponential(10, 50_000)
+    b_vals = rng.exponential(30, 50_000)
+    merged = TDigest.from_values(a_vals).merge(TDigest.from_values(b_vals))
+    allv = np.concatenate([a_vals, b_vals])
+    for q in (0.1, 0.5, 0.9):
+        exact = np.quantile(allv, q)
+        assert abs(merged.quantile(q) - exact) / max(exact, 1) < 0.05
+    rt = obj_from_bytes(obj_to_bytes(merged))
+    assert rt == merged
+
+
+# -- engine: cross-segment merge with non-shared dictionaries ---------------
+
+@pytest.fixture(scope="module")
+def hetero_segments():
+    """Two segments whose playerName/runs dictionaries DO NOT overlap —
+    the case where exact dictId histograms cannot merge and real sketch
+    objects must."""
+    base = tempfile.mkdtemp()
+    segs, all_names, all_runs = [], [], []
+    for i in range(2):
+        n = 4000
+        rng = np.random.default_rng(100 + i)
+        names = np.array([f"seg{i}_player_{j % 1500}" for j in
+                          rng.integers(0, 1500, n)], dtype=object)
+        runs = rng.integers(i * 1000, i * 1000 + 800, n).astype(np.int32)
+        cols = {
+            "teamID": np.array(rng.choice(["BOS", "NYA"], n), dtype=object),
+            "league": np.array(["AL"] * n, dtype=object),
+            "playerName": names,
+            "position": [["P"]] * n,
+            "runs": runs,
+            "hits": rng.integers(0, 250, n).astype(np.int64),
+            "average": np.round(rng.random(n), 3),
+            "salary": (rng.random(n).astype(np.float32) * 1e6).round(2),
+            "yearID": rng.integers(1990, 2020, n).astype(np.int32),
+        }
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        SegmentCreator(make_schema(), make_table_config(),
+                       f"hetero_{i}").build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        all_names.append(names)
+        all_runs.append(runs)
+    return segs, np.concatenate(all_names), np.concatenate(all_runs)
+
+
+def test_hll_cross_segment_merge(hetero_segments):
+    segs, names, runs = hetero_segments
+    eng = QueryEngine(segs)
+    true_distinct = len(np.unique(names))
+    resp = eng.query("SELECT DISTINCTCOUNTHLL(playerName) "
+                     "FROM baseballStats")
+    est = int(resp.aggregation_results[0].value)
+    assert abs(est - true_distinct) / true_distinct < 0.06
+    # FASTHLL aliases the same sketch
+    resp = eng.query("SELECT FASTHLL(playerName) FROM baseballStats")
+    assert abs(int(resp.aggregation_results[0].value) -
+               true_distinct) / true_distinct < 0.06
+
+
+def test_tdigest_cross_segment_merge(hetero_segments):
+    segs, names, runs = hetero_segments
+    eng = QueryEngine(segs)
+    resp = eng.query("SELECT PERCENTILETDIGEST50(runs), "
+                     "PERCENTILEEST90(runs) FROM baseballStats")
+    exact50 = np.quantile(runs, 0.5)
+    exact90 = np.quantile(runs, 0.9)
+    spread = runs.max() - runs.min()
+    assert abs(float(resp.aggregation_results[0].value) - exact50) / \
+        spread < 0.02
+    assert abs(float(resp.aggregation_results[1].value) - exact90) / \
+        spread < 0.02
+
+
+def test_hll_group_by_and_wire(hetero_segments):
+    """Sketches cross the server→broker wire inside DataTables."""
+    segs, names, runs = hetero_segments
+    from pinot_tpu.server import ServerInstance
+    from pinot_tpu.broker.request_handler import (BrokerRequestHandler,
+                                                  InProcessTransport)
+    from pinot_tpu.broker.routing import RoutingManager
+    from pinot_tpu.common.cluster_state import TableView
+
+    servers = {}
+    for i, seg in enumerate(segs):
+        s = ServerInstance(f"s{i}")
+        s.data_manager.table("baseballStats_OFFLINE",
+                             create=True).add_segment(seg)
+        servers[f"s{i}"] = s
+    routing = RoutingManager()
+    routing.update_view(TableView("baseballStats_OFFLINE", {
+        seg.segment_name: {f"s{i}": "ONLINE"}
+        for i, seg in enumerate(segs)}))
+    broker = BrokerRequestHandler(routing, InProcessTransport(servers))
+    try:
+        resp = broker.handle("SELECT DISTINCTCOUNTHLL(playerName) "
+                             "FROM baseballStats GROUP BY teamID TOP 10")
+        got = {g["group"][0]: int(g["value"])
+               for g in resp.aggregation_results[0].group_by_result}
+        assert set(got) == {"BOS", "NYA"}
+        # exact distinct through the same wire as the oracle
+        resp2 = broker.handle("SELECT DISTINCTCOUNT(playerName) "
+                              "FROM baseballStats GROUP BY teamID TOP 10")
+        exact = {g["group"][0]: int(g["value"])
+                 for g in resp2.aggregation_results[0].group_by_result}
+        for team, est in got.items():
+            assert abs(est - exact[team]) / exact[team] < 0.06, \
+                (team, est, exact[team])
+    finally:
+        broker.close()
+        for s in servers.values():
+            s.stop()
