@@ -1,0 +1,73 @@
+"""Broker access control SPI.
+
+Parity: pinot-broker/.../api/AccessControl.java + AccessControlFactory
+(BaseBrokerRequestHandler.java:159 calls hasAccess(requesterIdentity,
+brokerRequest) before routing; the default factory returns an allow-all
+implementation). Identity here is whatever the transport layer attaches —
+the HTTP API passes a RequesterIdentity with the client address and any
+auth token; in-process callers pass None.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.table_name import raw_table
+
+
+@dataclasses.dataclass
+class RequesterIdentity:
+    client_address: str = ""
+    token: Optional[str] = None
+
+
+class AccessControl:
+    """SPI: decide whether `identity` may run `request`."""
+
+    def has_access(self, identity: Optional[RequesterIdentity],
+                   request: BrokerRequest) -> bool:
+        raise NotImplementedError
+
+
+class AllowAllAccessControl(AccessControl):
+    """The reference's default: everything is allowed."""
+
+    def has_access(self, identity, request) -> bool:
+        return True
+
+
+class TableAclAccessControl(AccessControl):
+    """Static per-table token ACL: a table not in the map is open; a table
+    in the map requires one of its listed tokens."""
+
+    def __init__(self, table_tokens: Dict[str, list]):
+        self.table_tokens = {raw_table(k): set(v)
+                             for k, v in table_tokens.items()}
+
+    def has_access(self, identity, request) -> bool:
+        allowed = self.table_tokens.get(raw_table(request.table_name))
+        if allowed is None:
+            return True
+        return identity is not None and identity.token in allowed
+
+
+class AccessControlFactory:
+    """Parity: AccessControlFactory.create (class-name keyed registry)."""
+
+    _registry: Dict[str, Callable[..., AccessControl]] = {
+        "allowall": AllowAllAccessControl,
+        "tableacl": TableAclAccessControl,
+    }
+
+    @classmethod
+    def register(cls, name: str,
+                 ctor: Callable[..., AccessControl]) -> None:
+        cls._registry[name.lower()] = ctor
+
+    @classmethod
+    def create(cls, name: str = "allowall", **kwargs) -> AccessControl:
+        ctor = cls._registry.get(name.lower())
+        if ctor is None:
+            raise ValueError(f"unknown access control: {name}")
+        return ctor(**kwargs)

@@ -17,9 +17,12 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
+                                      MetricsRegistry)
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.common.serde import instance_request_to_bytes
+from pinot_tpu.common.trace import make_trace
 from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table)
 from pinot_tpu.broker.quota import QueryQuotaManager
@@ -100,26 +103,32 @@ class QueryRouter:
     async def submit(self, request_id: int,
                      routes: List[Tuple[BrokerRequest, Dict[str,
                                                             List[str]]]],
-                     timeout: float) -> Tuple[List[DataTable], int, int]:
+                     timeout: float, enable_trace: bool = False
+                     ) -> Tuple[List[DataTable], int, int]:
         """routes: [(per-table request, {server: segments})] —
         returns (tables, num_queried, num_responded)."""
         calls = []
+        servers: List[str] = []
         for sub_request, routing in routes:
             for server, segments in routing.items():
                 payload = instance_request_to_bytes(InstanceRequest(
                     request_id=request_id, query=sub_request,
-                    search_segments=segments, broker_id=self.broker_id))
+                    search_segments=segments, broker_id=self.broker_id,
+                    enable_trace=enable_trace))
                 calls.append(self.transport.query(server, payload, timeout))
+                servers.append(server)
         results = await asyncio.gather(*calls, return_exceptions=True)
         tables: List[DataTable] = []
         responded = 0
-        for r in results:
+        for server, r in zip(servers, results):
             if isinstance(r, BaseException):
                 continue
             try:
-                tables.append(DataTable.from_bytes(r))
+                dt = DataTable.from_bytes(r)
             except Exception:  # noqa: BLE001 — corrupt response payload
                 continue       # counts as a non-responding server
+            dt.metadata.setdefault("serverName", server)
+            tables.append(dt)
             responded += 1
         return tables, len(calls), responded
 
@@ -132,25 +141,32 @@ class BrokerRequestHandler:
                  time_boundary: Optional[TimeBoundaryService] = None,
                  quota: Optional[QueryQuotaManager] = None,
                  broker_id: str = "broker_0",
-                 default_timeout_s: float = 15.0):
+                 default_timeout_s: float = 15.0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 access_control=None):
         self.routing = routing
         self.router = QueryRouter(transport, broker_id)
         self.time_boundary = time_boundary or TimeBoundaryService()
         self.quota = quota or QueryQuotaManager()
         self.optimizer = BrokerRequestOptimizer()
         self.reducer = BrokerReduceService()
+        self.metrics = metrics or MetricsRegistry("broker")
+        if access_control is None:
+            from pinot_tpu.broker.access_control import AllowAllAccessControl
+            access_control = AllowAllAccessControl()
+        self.access_control = access_control
         self.default_timeout_s = default_timeout_s
         self._request_ids = itertools.count(1)
         self._loop: Optional[EventLoopThread] = None
         self._loop_lock = threading.Lock()
 
     # -- sync facade -------------------------------------------------------
-    def handle(self, pql: str) -> BrokerResponse:
+    def handle(self, pql: str, identity=None) -> BrokerResponse:
         with self._loop_lock:
             if self._loop is None:
                 self._loop = EventLoopThread()
             loop = self._loop
-        return loop.run(self.handle_async(pql))
+        return loop.run(self.handle_async(pql, identity))
 
     def close(self) -> None:
         if self._loop is not None:
@@ -158,33 +174,80 @@ class BrokerRequestHandler:
             self._loop.stop()
             self._loop = None
 
-    async def handle_async(self, pql: str) -> BrokerResponse:
+    async def handle_async(self, pql: str, identity=None) -> BrokerResponse:
         t0 = time.perf_counter()
+        self.metrics.meter(BrokerMeter.QUERIES).mark()
+        t = time.perf_counter()
         try:
             request = compile_pql(pql)
         except Exception as e:  # noqa: BLE001 — compile errors → response
+            self.metrics.meter(
+                BrokerMeter.REQUEST_COMPILATION_EXCEPTIONS).mark()
             return _error_response(150, f"PQLParsingError: {e}")
+        compile_ms = (time.perf_counter() - t) * 1e3
+        self.metrics.timer(BrokerQueryPhase.REQUEST_COMPILATION).update(
+            compile_ms)
+        trace = make_trace(request.query_options.trace)
+        trace.record(BrokerQueryPhase.REQUEST_COMPILATION, compile_ms)
+
+        if not self.access_control.has_access(identity, request):
+            self.metrics.meter(
+                BrokerMeter.REQUEST_DROPPED_DUE_TO_ACCESS_ERROR).mark()
+            return _error_response(180, "AccessDeniedError: permission "
+                                   f"denied for table {request.table_name}")
 
         raw = raw_table(request.table_name)
         if not self.quota.acquire(raw):
+            self.metrics.meter(BrokerMeter.QUERY_QUOTA_EXCEEDED).mark()
             return _error_response(429, f"QuotaExceededError: table {raw} "
                                    "exceeded its QPS quota")
 
-        routes, error = self._resolve_routes(request, raw)
+        with self.metrics.timer(BrokerQueryPhase.QUERY_ROUTING).time(), \
+                trace.span(BrokerQueryPhase.QUERY_ROUTING):
+            routes, error = self._resolve_routes(request, raw)
         if error is not None:
+            self.metrics.meter(
+                BrokerMeter.RESOURCE_MISSING_EXCEPTIONS).mark()
             return error
 
         timeout_s = (request.query_options.timeout_ms or
                      self.default_timeout_s * 1e3) / 1e3
-        tables, queried, responded = await self.router.submit(
-            next(self._request_ids), routes, timeout_s)
-        blocks = [dt.to_block() for dt in tables]
-        resp = self.reducer.reduce(request, blocks) if blocks else \
-            _error_response(427, "ServerNotRespondedError: no server "
-                            "responded in time")
+        with self.metrics.timer(BrokerQueryPhase.SCATTER_GATHER).time(), \
+                trace.span(BrokerQueryPhase.SCATTER_GATHER):
+            tables, queried, responded = await self.router.submit(
+                next(self._request_ids), routes, timeout_s,
+                enable_trace=request.query_options.trace)
+        if responded < queried:
+            self.metrics.meter(
+                BrokerMeter.BROKER_RESPONSES_WITH_PARTIAL_SERVERS).mark()
+        with self.metrics.timer(BrokerQueryPhase.REDUCE).time(), \
+                trace.span(BrokerQueryPhase.REDUCE):
+            blocks = [dt.to_block() for dt in tables]
+            resp = self.reducer.reduce(request, blocks) if blocks else \
+                _error_response(427, "ServerNotRespondedError: no server "
+                                "responded in time")
         resp.num_servers_queried = queried
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.timer(BrokerQueryPhase.QUERY_TOTAL).update(
+            resp.time_used_ms)
+        self.metrics.meter(BrokerMeter.DOCUMENTS_SCANNED).mark(
+            resp.num_docs_scanned)
+        if request.query_options.trace:
+            from pinot_tpu.common.trace import Trace
+            resp.trace_info = {"broker": trace.to_list()}
+            for dt in tables:
+                server_trace = dt.metadata.get("traceInfo")
+                if not server_trace:
+                    continue
+                try:
+                    spans = Trace.from_json_str(server_trace).to_list()
+                except Exception:  # noqa: BLE001 — skewed/corrupt metadata
+                    continue       # a bad trace must not fail the query
+                name = dt.metadata.get("serverName", "server")
+                # hybrid tables: one server answers both the OFFLINE and
+                # REALTIME sub-requests — merge, don't overwrite
+                resp.trace_info.setdefault(name, []).extend(spans)
         return resp
 
     def _resolve_routes(self, request: BrokerRequest, raw: str):

@@ -1,0 +1,200 @@
+"""Metrics registry: meters, gauges, and phase timers.
+
+Parity: pinot-common/.../metrics/AbstractMetrics.java (typed
+addMeteredTableValue / setValueOfTableGauge / addPhaseTiming over a yammer
+MetricsRegistry) and the per-component subclasses BrokerMetrics /
+ServerMetrics / ControllerMetrics with their Meter/Gauge/Timer enums
+(BrokerMeter.java, BrokerQueryPhase.java, ServerMeter.java,
+ServerQueryPhase.java). We keep one thread-safe registry per component;
+metric names are plain strings (optionally suffixed with a table name the
+way the reference's table-level metrics are), and timers keep a bounded
+reservoir for percentiles instead of an exponentially-decaying sample.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+class Meter:
+    """Monotonic event counter with a lifetime rate."""
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def rate(self) -> float:
+        """Events per second since the meter was created."""
+        dt = time.monotonic() - self._t0
+        return self._count / dt if dt > 0 else 0.0
+
+
+class Gauge:
+    """Last-value (or callable-backed) instantaneous metric."""
+
+    def __init__(self) -> None:
+        self._value: float = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        self._fn = None
+        self._value = float(value)
+
+    def set_callable(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        return self._value
+
+
+class Timer:
+    """Duration metric: count, total, mean, and reservoir percentiles."""
+
+    RESERVOIR = 1024
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._total_ms = 0.0
+        self._samples: deque = deque(maxlen=self.RESERVOIR)
+        self._lock = threading.Lock()
+
+    def update(self, ms: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total_ms += ms
+            self._samples.append(ms)
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.update((time.perf_counter() - t0) * 1e3)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total_ms(self) -> float:
+        return self._total_ms
+
+    @property
+    def mean_ms(self) -> float:
+        return self._total_ms / self._count if self._count else 0.0
+
+    def percentile_ms(self, p: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            return float(np.percentile(np.asarray(self._samples), p))
+
+
+class MetricsRegistry:
+    """One component's metric namespace (broker / server / controller)."""
+
+    def __init__(self, component: str = ""):
+        self.component = component
+        self._meters: Dict[str, Meter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._lock = threading.Lock()
+
+    def meter(self, name: str, table: Optional[str] = None) -> Meter:
+        return self._get(self._meters, Meter, name, table)
+
+    def gauge(self, name: str, table: Optional[str] = None) -> Gauge:
+        return self._get(self._gauges, Gauge, name, table)
+
+    def timer(self, name: str, table: Optional[str] = None) -> Timer:
+        return self._get(self._timers, Timer, name, table)
+
+    def _get(self, store, cls, name: str, table: Optional[str]):
+        key = f"{table}.{name}" if table else name
+        with self._lock:
+            m = store.get(key)
+            if m is None:
+                m = store[key] = cls()
+            return m
+
+    def snapshot(self) -> dict:
+        """Flat JSON-able view of every registered metric."""
+        with self._lock:
+            meters = dict(self._meters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+        out: Dict[str, object] = {}
+        for k, m in meters.items():
+            out[f"meter.{k}.count"] = m.count
+        for k, g in gauges.items():
+            out[f"gauge.{k}"] = g.value
+        for k, t in timers.items():
+            out[f"timer.{k}.count"] = t.count
+            out[f"timer.{k}.totalMs"] = round(t.total_ms, 3)
+            out[f"timer.{k}.meanMs"] = round(t.mean_ms, 3)
+        return out
+
+
+# -- metric name constants (parity: the reference's metric enums) ------------
+
+class BrokerMeter:
+    QUERIES = "queries"
+    REQUEST_COMPILATION_EXCEPTIONS = "requestCompilationExceptions"
+    RESOURCE_MISSING_EXCEPTIONS = "resourceMissingExceptions"
+    QUERY_QUOTA_EXCEEDED = "queryQuotaExceeded"
+    NO_SERVER_FOUND_EXCEPTIONS = "noServerFoundExceptions"
+    REQUEST_DROPPED_DUE_TO_ACCESS_ERROR = "requestDroppedDueToAccessError"
+    BROKER_RESPONSES_WITH_PARTIAL_SERVERS = "brokerResponsesWithPartialServers"
+    DOCUMENTS_SCANNED = "documentsScanned"
+
+
+class BrokerQueryPhase:
+    REQUEST_COMPILATION = "requestCompilation"
+    AUTHORIZATION = "authorization"
+    QUERY_ROUTING = "queryRouting"
+    SCATTER_GATHER = "scatterGather"
+    REDUCE = "reduce"
+    QUERY_TOTAL = "queryTotal"
+
+
+class ServerMeter:
+    QUERIES = "queries"
+    QUERY_EXECUTION_EXCEPTIONS = "queryExecutionExceptions"
+    DELETED_SEGMENT_COUNT = "deletedSegmentCount"
+    REALTIME_ROWS_CONSUMED = "realtimeRowsConsumed"
+
+
+class ServerQueryPhase:
+    REQUEST_DESERIALIZATION = "requestDeserialization"
+    SCHEDULER_WAIT = "schedulerWait"
+    SEGMENT_PRUNING = "segmentPruning"
+    SEGMENT_EXECUTION = "segmentExecution"
+    SHARDED_EXECUTION = "shardedExecute"
+    BUILD_QUERY_PLAN = "buildQueryPlan"
+    QUERY_PLAN_EXECUTION = "queryPlanExecution"
+    QUERY_PROCESSING = "queryProcessing"
+    RESPONSE_SERIALIZATION = "responseSerialization"
+
+
+class ServerGauge:
+    DOCUMENT_COUNT = "documentCount"
+    SEGMENT_COUNT = "segmentCount"
+    LLC_PARTITION_CONSUMING = "llcPartitionConsuming"

@@ -54,6 +54,8 @@ class BrokerResponse:
     num_groups_limit_reached: bool = False
     total_docs: int = 0
     time_used_ms: float = 0.0
+    # trace=true responses: {"broker": [...spans], "<server>": [...spans]}
+    trace_info: Optional[Dict[str, list]] = None
 
     def to_json(self) -> dict:
         d = {
@@ -75,6 +77,8 @@ class BrokerResponse:
                                        for a in self.aggregation_results]
         if self.selection_results is not None:
             d["selectionResults"] = self.selection_results.to_json()
+        if self.trace_info is not None:
+            d["traceInfo"] = self.trace_info
         return d
 
     def to_json_str(self) -> str:

@@ -139,6 +139,10 @@ class _Parser:
                 self.expect(TokType.OP)  # '='
                 val = self.next().value
                 options.options[key] = val
+                if key == "timeoutMs":
+                    options.timeout_ms = int(val)
+                elif key == "trace":
+                    options.trace = str(val).lower() in ("true", "1")
                 if self.peek().type == TokType.COMMA:
                     self.next()
                     continue

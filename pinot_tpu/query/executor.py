@@ -12,6 +12,7 @@ import time
 from typing import List, Optional
 
 from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.trace import Trace, make_trace
 from pinot_tpu.query.blocks import IntermediateResultsBlock
 from pinot_tpu.query.combine import combine_blocks
 from pinot_tpu.query import host_exec
@@ -30,20 +31,25 @@ class ServerQueryExecutor:
         self.use_device = use_device
 
     def execute(self, request: BrokerRequest,
-                segments: List[ImmutableSegment]) -> IntermediateResultsBlock:
+                segments: List[ImmutableSegment],
+                trace: Optional[Trace] = None) -> IntermediateResultsBlock:
+        from pinot_tpu.common.metrics import ServerQueryPhase
+        trace = trace if trace is not None else make_trace(False)
         t0 = time.perf_counter()
-        selected = self.pruner.prune(segments, request)
+        with trace.span(ServerQueryPhase.SEGMENT_PRUNING):
+            selected = self.pruner.prune(segments, request)
         num_pruned = len(segments) - len(selected)
 
         blocks: List[IntermediateResultsBlock] = []
-        for seg in selected:
-            if getattr(seg, "is_mutable", False) and \
-                    hasattr(seg, "snapshot_view"):
-                # consuming segment: freeze (num_docs, cardinalities) so
-                # the filter mask and every column lane agree while the
-                # consumer thread keeps appending
-                seg = seg.snapshot_view()
-            blocks.append(self._execute_segment(seg, request))
+        with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
+            for seg in selected:
+                if getattr(seg, "is_mutable", False) and \
+                        hasattr(seg, "snapshot_view"):
+                    # consuming segment: freeze (num_docs, cardinalities) so
+                    # the filter mask and every column lane agree while the
+                    # consumer thread keeps appending
+                    seg = seg.snapshot_view()
+                blocks.append(self._execute_segment(seg, request))
 
         if not blocks:
             blk = IntermediateResultsBlock()

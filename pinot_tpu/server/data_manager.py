@@ -162,6 +162,11 @@ class InstanceDataManager:
         with self._lock:
             return list(self._tables.keys())
 
+    def num_segments(self) -> int:
+        with self._lock:
+            tables = list(self._tables.values())
+        return sum(len(t.segment_names()) for t in tables)
+
     def shutdown(self) -> None:
         with self._lock:
             tables = list(self._tables.values())

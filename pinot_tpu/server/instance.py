@@ -7,9 +7,12 @@ execute → DataTable bytes).
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.metrics import (MetricsRegistry, ServerGauge,
+                                      ServerMeter, ServerQueryPhase)
 from pinot_tpu.common.serde import instance_request_from_bytes
 from pinot_tpu.server.data_manager import InstanceDataManager
 from pinot_tpu.server.query_executor import InstanceQueryExecutor
@@ -24,29 +27,43 @@ class ServerInstance:
                  scheduler: str = "fcfs", num_workers: int = 4,
                  mesh=None, use_device: bool = True):
         self.instance_id = instance_id
+        self.metrics = MetricsRegistry("server")
         self.data_manager = InstanceDataManager()
         self.scheduler: QueryScheduler = make_scheduler(scheduler,
                                                         num_workers)
         self.executor = InstanceQueryExecutor(self.data_manager, mesh=mesh,
-                                              use_device=use_device)
+                                              use_device=use_device,
+                                              metrics=self.metrics)
+        self.metrics.gauge(ServerGauge.SEGMENT_COUNT).set_callable(
+            self.data_manager.num_segments)
         self._loop: Optional[EventLoopThread] = None
         self._server: Optional[QueryServer] = None
         self.port: Optional[int] = None
 
     # -- in-process path (used by tests and the embedded broker) -----------
     def handle_request_bytes(self, payload: bytes) -> bytes:
+        with self.metrics.timer(
+                ServerQueryPhase.REQUEST_DESERIALIZATION).time():
+            try:
+                request = instance_request_from_bytes(payload)
+            except Exception as e:  # noqa: BLE001 — malformed wire payload
+                dt = DataTable()
+                dt.exceptions.append(f"RequestDeserializationError: {e}")
+                return dt.to_bytes()
+        t_submit = time.perf_counter()
+
+        def run():
+            wait_ms = (time.perf_counter() - t_submit) * 1e3
+            return self.executor.execute(request, scheduler_wait_ms=wait_ms)
+
+        future = self.scheduler.submit(request.query.table_name, run)
         try:
-            request = instance_request_from_bytes(payload)
-        except Exception as e:  # noqa: BLE001 — malformed wire payload
-            dt = DataTable()
-            dt.exceptions.append(f"RequestDeserializationError: {e}")
-            return dt.to_bytes()
-        future = self.scheduler.submit(
-            request.query.table_name,
-            lambda: self.executor.execute(request))
-        try:
-            return future.result().to_bytes()
-        except Exception as e:  # noqa: BLE001 — query execution error
+            dt = future.result()
+            with self.metrics.timer(
+                    ServerQueryPhase.RESPONSE_SERIALIZATION).time():
+                return dt.to_bytes()
+        except Exception as e:  # noqa: BLE001 — execution or serde error
+            self.metrics.meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS).mark()
             dt = DataTable()
             dt.metadata["requestId"] = str(request.request_id)
             dt.exceptions.append(f"QueryExecutionError: {e}")

@@ -11,7 +11,10 @@ import time
 from typing import List, Optional
 
 from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.metrics import (MetricsRegistry, ServerMeter,
+                                      ServerQueryPhase)
 from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.trace import Trace, make_trace
 from pinot_tpu.query.blocks import IntermediateResultsBlock
 from pinot_tpu.query.executor import ServerQueryExecutor
 from pinot_tpu.server.data_manager import InstanceDataManager
@@ -22,7 +25,8 @@ class InstanceQueryExecutor:
 
     def __init__(self, data_manager: InstanceDataManager,
                  mesh=None, use_device: bool = True,
-                 default_timeout_ms: float = 15_000.0):
+                 default_timeout_ms: float = 15_000.0,
+                 metrics: Optional[MetricsRegistry] = None):
         self.data_manager = data_manager
         self.executor = ServerQueryExecutor(use_device=use_device)
         self.sharded = None
@@ -31,9 +35,16 @@ class InstanceQueryExecutor:
             self.sharded = ShardedQueryExecutor(mesh=mesh)
             data_manager.add_removal_listener(self.sharded.evict_segment)
         self.default_timeout_ms = default_timeout_ms
+        self.metrics = metrics or MetricsRegistry("server")
 
-    def execute(self, request: InstanceRequest) -> DataTable:
+    def execute(self, request: InstanceRequest,
+                scheduler_wait_ms: float = 0.0) -> DataTable:
         t_start = time.perf_counter()
+        self.metrics.meter(ServerMeter.QUERIES).mark()
+        self.metrics.timer(ServerQueryPhase.SCHEDULER_WAIT).update(
+            scheduler_wait_ms)
+        trace = make_trace(request.enable_trace)
+        trace.record(ServerQueryPhase.SCHEDULER_WAIT, scheduler_wait_ms)
         query = request.query
         timeout_ms = query.query_options.timeout_ms or self.default_timeout_ms
         tdm = self.data_manager.table(query.table_name)
@@ -46,7 +57,7 @@ class InstanceQueryExecutor:
         acquired, missing = tdm.acquire_segments(request.search_segments)
         try:
             segments = [s.segment for s in acquired]
-            block = self._execute_segments(query, segments)
+            block = self._execute_segments(query, segments, trace)
             if missing:
                 block.exceptions.append(
                     f"SegmentMissingError: {sorted(missing)}")
@@ -56,21 +67,27 @@ class InstanceQueryExecutor:
                     f"QueryTimeoutError: {elapsed_ms:.0f}ms > "
                     f"{timeout_ms:.0f}ms")
             block.stats.time_used_ms = elapsed_ms
+            self.metrics.timer(ServerQueryPhase.QUERY_PROCESSING).update(
+                elapsed_ms)
+            trace.record(ServerQueryPhase.QUERY_PROCESSING, elapsed_ms)
             dt = DataTable.from_block(query, block)
             dt.metadata["requestId"] = str(request.request_id)
+            if request.enable_trace:
+                dt.metadata["traceInfo"] = trace.to_json_str()
             return dt
         finally:
             for sdm in acquired:
                 tdm.release_segment(sdm)
 
-    def _execute_segments(self, query, segments: List
-                          ) -> IntermediateResultsBlock:
+    def _execute_segments(self, query, segments: List,
+                          trace: Trace) -> IntermediateResultsBlock:
         if self.sharded is not None and len(segments) > 1:
             from pinot_tpu.parallel.sharded import NotShardable
             from pinot_tpu.query.plan import (GroupsLimitExceeded,
                                               UnsupportedOnDevice)
             try:
-                return self.sharded.execute(query, segments)
+                with trace.span(ServerQueryPhase.SHARDED_EXECUTION):
+                    return self.sharded.execute(query, segments)
             except (NotShardable, GroupsLimitExceeded, UnsupportedOnDevice):
                 pass
-        return self.executor.execute(query, segments)
+        return self.executor.execute(query, segments, trace=trace)
