@@ -1,0 +1,75 @@
+"""Broker HTTP API: the /query endpoint clients talk to.
+
+Parity: pinot-broker/.../api/resources/PinotClientRequest.java:67 (GET
+/query?pql=...) and :95 (POST /query {"pql": ...}), plus the broker admin
+app's /health and a /metrics view of the registry. Auth tokens arrive as
+`Authorization: Bearer <token>` and become the RequesterIdentity the
+access-control SPI sees.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from pinot_tpu.broker.access_control import RequesterIdentity
+from pinot_tpu.broker.request_handler import BrokerRequestHandler
+from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
+
+
+class BrokerApiServer(ApiServer):
+    """HTTP front door for one BrokerRequestHandler."""
+
+    def __init__(self, handler: BrokerRequestHandler):
+        super().__init__()
+        self.handler = handler
+        self.router.add("GET", "/query", self._get_query)
+        self.router.add("POST", "/query", self._post_query)
+        self.router.add("GET", "/health", self._health)
+        self.router.add("GET", "/metrics", self._metrics)
+
+    @staticmethod
+    def _identity(request: HttpRequest) -> RequesterIdentity:
+        auth = request.headers.get("authorization", "")
+        token = auth.split(None, 1)[1] if auth.lower().startswith(
+            "bearer ") else None
+        return RequesterIdentity(client_address=request.client, token=token)
+
+    async def _run_query(self, pql: str,
+                         identity: RequesterIdentity) -> HttpResponse:
+        # the broker handler owns its own event loop (per-server TCP
+        # connections live there); hop through its sync facade off-thread
+        loop = asyncio.get_running_loop()
+        resp = await loop.run_in_executor(
+            None, lambda: self.handler.handle(pql, identity))
+        return HttpResponse.of_json(resp.to_json())
+
+    async def _get_query(self, request: HttpRequest) -> HttpResponse:
+        pql = request.query.get("pql") or request.query.get("sql")
+        if not pql:
+            return HttpResponse.error(400, "missing ?pql= parameter")
+        return await self._run_query(pql, self._identity(request))
+
+    async def _post_query(self, request: HttpRequest) -> HttpResponse:
+        try:
+            body = request.json() or {}
+        except ValueError:
+            return HttpResponse.error(400, "invalid JSON body")
+        pql = body.get("pql") or body.get("sql")
+        if not pql:
+            return HttpResponse.error(400, 'missing "pql" in body')
+        if body.get("trace"):
+            # parity: the client's trace flag rides the request JSON; an
+            # explicit trace key inside an existing OPTION clause wins
+            # (the parser applies keys in order)
+            import re
+            if "option(" in pql.lower():
+                pql = re.sub(r"(?i)option\s*\(", "OPTION(trace=true, ",
+                             pql, count=1)
+            else:
+                pql = f"{pql} OPTION(trace=true)"
+        return await self._run_query(pql, self._identity(request))
+
+    async def _health(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse(200, b"OK", content_type="text/plain")
+
+    async def _metrics(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json(self.handler.metrics.snapshot())

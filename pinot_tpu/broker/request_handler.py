@@ -22,7 +22,7 @@ from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.common.serde import instance_request_to_bytes
-from pinot_tpu.common.trace import make_trace
+from pinot_tpu.common.trace import Trace, make_trace
 from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table)
 from pinot_tpu.broker.quota import QueryQuotaManager
@@ -190,7 +190,10 @@ class BrokerRequestHandler:
         trace = make_trace(request.query_options.trace)
         trace.record(BrokerQueryPhase.REQUEST_COMPILATION, compile_ms)
 
-        if not self.access_control.has_access(identity, request):
+        with self.metrics.timer(BrokerQueryPhase.AUTHORIZATION).time(), \
+                trace.span(BrokerQueryPhase.AUTHORIZATION):
+            allowed = self.access_control.has_access(identity, request)
+        if not allowed:
             self.metrics.meter(
                 BrokerMeter.REQUEST_DROPPED_DUE_TO_ACCESS_ERROR).mark()
             return _error_response(180, "AccessDeniedError: permission "
@@ -234,7 +237,6 @@ class BrokerRequestHandler:
         self.metrics.meter(BrokerMeter.DOCUMENTS_SCANNED).mark(
             resp.num_docs_scanned)
         if request.query_options.trace:
-            from pinot_tpu.common.trace import Trace
             resp.trace_info = {"broker": trace.to_list()}
             for dt in tables:
                 server_trace = dt.metadata.get("traceInfo")

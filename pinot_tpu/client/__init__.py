@@ -1,0 +1,8 @@
+"""Python client API (parity: pinot-api / org.apache.pinot.client)."""
+from pinot_tpu.client.connection import (Connection, ControllerClient,
+                                         PinotClientError, ResultSet,
+                                         ResultSetGroup,
+                                         SimpleBrokerSelector, connect)
+
+__all__ = ["Connection", "ControllerClient", "PinotClientError",
+           "ResultSet", "ResultSetGroup", "SimpleBrokerSelector", "connect"]

@@ -1,0 +1,275 @@
+"""Python client: broker connection + result sets + controller admin.
+
+Parity: pinot-api (org.apache.pinot.client) — Connection.java (execute via
+a BrokerSelector over the broker list), ResultSetGroup.java,
+AggregationResultSet / GroupByResultSet / SelectionResultSet, and
+PinotClientException. The admin half mirrors what the reference's
+quickstarts drive against the controller REST API (schema/table create,
+segment upload).
+"""
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import random
+import urllib.parse
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class PinotClientError(Exception):
+    pass
+
+
+class ResultSet:
+    """One result table: aggregation value, group-by rows, or selection."""
+
+    def __init__(self, column_names: List[str], rows: List[list],
+                 group_key_columns: Optional[List[str]] = None,
+                 group_keys: Optional[List[list]] = None):
+        self._columns = column_names
+        self._rows = rows
+        self._group_key_columns = group_key_columns or []
+        self._group_keys = group_keys or []
+
+    @property
+    def row_count(self) -> int:
+        return len(self._rows)
+
+    @property
+    def column_count(self) -> int:
+        return len(self._columns)
+
+    def column_name(self, i: int) -> str:
+        return self._columns[i]
+
+    def get(self, row: int, col: int = 0):
+        return self._rows[row][col]
+
+    @property
+    def group_key_columns(self) -> List[str]:
+        return list(self._group_key_columns)
+
+    def group_key(self, row: int) -> list:
+        return self._group_keys[row]
+
+    def rows(self) -> List[list]:
+        return [list(r) for r in self._rows]
+
+
+class ResultSetGroup:
+    """All result tables of one query + the response stats."""
+
+    def __init__(self, response: dict):
+        self.response = response
+        self.exceptions = response.get("exceptions", [])
+        self._sets: List[ResultSet] = []
+        for agg in response.get("aggregationResults", []):
+            if "groupByResult" in agg:
+                self._sets.append(ResultSet(
+                    column_names=[agg["function"]],
+                    rows=[[g["value"]] for g in agg["groupByResult"]],
+                    group_key_columns=agg.get("groupByColumns", []),
+                    group_keys=[g["group"] for g in agg["groupByResult"]]))
+            else:
+                self._sets.append(ResultSet(
+                    column_names=[agg["function"]],
+                    rows=[[agg["value"]]]))
+        sel = response.get("selectionResults")
+        if sel is not None:
+            self._sets.append(ResultSet(column_names=sel["columns"],
+                                        rows=sel["results"]))
+
+    @property
+    def result_set_count(self) -> int:
+        return len(self._sets)
+
+    def result_set(self, i: int = 0) -> ResultSet:
+        return self._sets[i]
+
+    @property
+    def num_docs_scanned(self) -> int:
+        return self.response.get("numDocsScanned", 0)
+
+    @property
+    def time_used_ms(self) -> float:
+        return self.response.get("timeUsedMs", 0.0)
+
+    @property
+    def trace_info(self) -> Optional[dict]:
+        return self.response.get("traceInfo")
+
+
+class _HttpEndpoint:
+    """One host:port with persistent keep-alive connections."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def request(self, method: str, path: str, body: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None
+                ) -> Tuple[int, bytes]:
+        headers = dict(headers or {})
+        for attempt in (0, 1):       # one retry on a stale kept-alive conn
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout)
+            try:
+                self._conn.request(method, path, body=body, headers=headers)
+                resp = self._conn.getresponse()
+                return resp.status, resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise PinotClientError("unreachable")  # pragma: no cover
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+
+class SimpleBrokerSelector:
+    """Round-robin over the broker list (parity: SimpleBrokerSelector)."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]]):
+        if not endpoints:
+            raise PinotClientError("empty broker list")
+        shuffled = list(endpoints)
+        random.shuffle(shuffled)
+        self._endpoints = [_HttpEndpoint(h, p) for h, p in shuffled]
+        self._cycle = itertools.cycle(range(len(self._endpoints)))
+
+    def select(self) -> _HttpEndpoint:
+        return self._endpoints[next(self._cycle)]
+
+    def close(self) -> None:
+        for e in self._endpoints:
+            e.close()
+
+
+class Connection:
+    """Queries one Pinot cluster through its broker(s)."""
+
+    def __init__(self, selector: SimpleBrokerSelector,
+                 token: Optional[str] = None):
+        self._selector = selector
+        self._token = token
+
+    def execute(self, pql: str, trace: bool = False) -> ResultSetGroup:
+        body = json.dumps({"pql": pql, "trace": trace}).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        endpoint = self._selector.select()
+        try:
+            status, payload = endpoint.request("POST", "/query", body,
+                                               headers)
+        except (ConnectionError, OSError) as e:
+            raise PinotClientError(f"broker unreachable: {e}") from e
+        if status != 200:
+            raise PinotClientError(f"broker returned HTTP {status}: "
+                                   f"{payload[:200]!r}")
+        group = ResultSetGroup(json.loads(payload))
+        for exc in group.exceptions:
+            msg = exc.get("message", "")
+            if "AccessDenied" in msg:
+                raise PinotClientError(msg)
+        return group
+
+    def close(self) -> None:
+        self._selector.close()
+
+
+def connect(brokers, token: Optional[str] = None) -> Connection:
+    """connect("host:port") / connect([("h", p), ...]) → Connection."""
+    if isinstance(brokers, str):
+        brokers = [brokers]
+    endpoints = []
+    for b in brokers:
+        if isinstance(b, str):
+            host, _, port = b.partition(":")
+            endpoints.append((host, int(port)))
+        else:
+            endpoints.append(tuple(b))
+    return Connection(SimpleBrokerSelector(endpoints), token=token)
+
+
+class ControllerClient:
+    """Admin client for the controller REST API."""
+
+    def __init__(self, host: str, port: int):
+        self._endpoint = _HttpEndpoint(host, port)
+
+    def _json(self, method: str, path: str,
+              body: Optional[bytes] = None) -> dict:
+        status, payload = self._endpoint.request(
+            method, path, body,
+            {"Content-Type": "application/json"} if body else None)
+        data = json.loads(payload) if payload else {}
+        if status >= 400:
+            raise PinotClientError(
+                f"HTTP {status}: {data.get('error', payload[:200])}")
+        return data
+
+    def add_schema(self, schema_json: dict) -> dict:
+        return self._json("POST", "/schemas",
+                          json.dumps(schema_json).encode())
+
+    def get_schema(self, name: str) -> dict:
+        return self._json("GET", f"/schemas/{urllib.parse.quote(name)}")
+
+    def add_table(self, config_json: dict) -> dict:
+        return self._json("POST", "/tables",
+                          json.dumps(config_json).encode())
+
+    def list_tables(self) -> List[str]:
+        return self._json("GET", "/tables")["tables"]
+
+    def get_table(self, name: str) -> dict:
+        return self._json("GET", f"/tables/{urllib.parse.quote(name)}")
+
+    def delete_table(self, name: str) -> dict:
+        return self._json("DELETE", f"/tables/{urllib.parse.quote(name)}")
+
+    def external_view(self, table: str) -> dict:
+        return self._json(
+            "GET", f"/tables/{urllib.parse.quote(table)}/externalview")
+
+    def rebalance(self, table: str, dry_run: bool = False) -> dict:
+        return self._json(
+            "POST", f"/tables/{urllib.parse.quote(table)}/rebalance"
+            f"?dryRun={'true' if dry_run else 'false'}")
+
+    def list_segments(self, table: str) -> List[str]:
+        return self._json(
+            "GET", f"/tables/{urllib.parse.quote(table)}/segments")
+
+    def upload_segment_dir(self, table: str, segment_dir: str) -> dict:
+        from pinot_tpu.controller.http_api import pack_segment_dir
+        data = pack_segment_dir(segment_dir)
+        status, payload = self._endpoint.request(
+            "POST", f"/segments/{urllib.parse.quote(table)}", data,
+            {"Content-Type": "application/gzip"})
+        out = json.loads(payload) if payload else {}
+        if status >= 400:
+            raise PinotClientError(
+                f"HTTP {status}: {out.get('error', payload[:200])}")
+        return out
+
+    def delete_segment(self, table: str, segment: str) -> dict:
+        return self._json(
+            "DELETE", f"/segments/{urllib.parse.quote(table)}/"
+            f"{urllib.parse.quote(segment)}")
+
+    def segment_metadata(self, table: str, segment: str) -> dict:
+        return self._json(
+            "GET", f"/segments/{urllib.parse.quote(table)}/"
+            f"{urllib.parse.quote(segment)}/metadata")
+
+    def close(self) -> None:
+        self._endpoint.close()

@@ -1,0 +1,166 @@
+"""Controller admin REST API.
+
+Parity: pinot-controller/.../api/resources/ — the admin surface a Pinot
+operator drives: PinotSchemaRestletResource (schema CRUD),
+PinotTableRestletResource (table CRUD + rebalance),
+PinotSegmentUploadRestletResource (segment upload as a packed artifact),
+PinotSegmentRestletResource (list/delete segments), TableViews.java
+(idealstate / externalview). Segment upload bodies are gzipped tars of the
+segment directory — the same "push a built artifact" contract as the
+reference's SegmentCompletionUtils tar.gz push.
+"""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import tempfile
+
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig, TableType
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
+
+
+def pack_segment_dir(segment_dir: str) -> bytes:
+    """Segment directory → tar.gz bytes (the upload artifact format)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for entry in sorted(os.listdir(segment_dir)):
+            tar.add(os.path.join(segment_dir, entry), arcname=entry)
+    return buf.getvalue()
+
+
+def unpack_segment_tar(data: bytes, dest_dir: str) -> None:
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            # flat segment artifacts only: refuse path traversal
+            name = os.path.normpath(member.name)
+            if name.startswith("..") or os.path.isabs(name) or \
+                    not (member.isfile() or member.isdir()):
+                raise ValueError(f"unsafe tar member: {member.name}")
+        try:
+            tar.extractall(dest_dir, filter="data")
+        except TypeError:            # Python < 3.12: no filter kwarg
+            tar.extractall(dest_dir)
+
+
+class ControllerApiServer(ApiServer):
+    """HTTP admin surface for one Controller."""
+
+    def __init__(self, controller: Controller):
+        super().__init__()
+        self.controller = controller
+        self.manager = controller.manager
+        router = self.router
+        router.add("GET", "/health", self._health)
+        router.add("GET", "/schemas", self._list_schemas)
+        router.add("POST", "/schemas", self._add_schema)
+        router.add("GET", "/schemas/{name}", self._get_schema)
+        router.add("GET", "/tables", self._list_tables)
+        router.add("POST", "/tables", self._add_table)
+        router.add("GET", "/tables/{name}", self._get_table)
+        router.add("DELETE", "/tables/{name}", self._delete_table)
+        router.add("GET", "/tables/{name}/idealstate", self._ideal_state)
+        router.add("GET", "/tables/{name}/externalview",
+                   self._external_view)
+        router.add("POST", "/tables/{name}/rebalance", self._rebalance)
+        router.add("GET", "/tables/{name}/segments", self._list_segments)
+        router.add("POST", "/segments/{table}", self._upload_segment)
+        router.add("GET", "/segments/{table}/{segment}/metadata",
+                   self._segment_metadata)
+        router.add("DELETE", "/segments/{table}/{segment}",
+                   self._delete_segment)
+
+    # -- handlers ----------------------------------------------------------
+    async def _health(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse(200, b"OK", content_type="text/plain")
+
+    async def _list_schemas(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json(
+            self.manager.store.children("/CONFIGS/SCHEMA"))
+
+    async def _add_schema(self, request: HttpRequest) -> HttpResponse:
+        schema = Schema.from_json(request.json())
+        self.manager.add_schema(schema)
+        return HttpResponse.of_json({"status": f"{schema.schema_name} "
+                                     "successfully added"})
+
+    async def _get_schema(self, request: HttpRequest) -> HttpResponse:
+        schema = self.manager.get_schema(request.path_params["name"])
+        if schema is None:
+            return HttpResponse.error(404, "schema not found")
+        return HttpResponse.of_json(schema.to_json())
+
+    async def _list_tables(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json({"tables": self.manager.table_names()})
+
+    async def _add_table(self, request: HttpRequest) -> HttpResponse:
+        config = TableConfig.from_json(request.json())
+        if config.table_type == TableType.REALTIME:
+            table = self.controller.realtime.setup_table(config)
+        else:
+            table = self.manager.add_table(config)
+        return HttpResponse.of_json({"status": f"{table} successfully "
+                                     "added"})
+
+    async def _get_table(self, request: HttpRequest) -> HttpResponse:
+        config = self.manager.get_table_config(
+            request.path_params["name"])
+        if config is None:
+            return HttpResponse.error(404, "table not found")
+        return HttpResponse.of_json(config.to_json())
+
+    async def _delete_table(self, request: HttpRequest) -> HttpResponse:
+        table = request.path_params["name"]
+        if self.manager.get_table_config(table) is None:
+            return HttpResponse.error(404, "table not found")
+        self.manager.delete_table(table)
+        return HttpResponse.of_json({"status": f"{table} deleted"})
+
+    async def _ideal_state(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json(self.controller.coordinator.ideal_state(
+            request.path_params["name"]))
+
+    async def _external_view(self, request: HttpRequest) -> HttpResponse:
+        view = self.controller.coordinator.external_view(
+            request.path_params["name"])
+        return HttpResponse.of_json(view.segment_states)
+
+    async def _rebalance(self, request: HttpRequest) -> HttpResponse:
+        dry = request.query.get("dryRun", "false").lower() == "true"
+        target = self.manager.rebalance_table(
+            request.path_params["name"], dry_run=dry)
+        return HttpResponse.of_json({"dryRun": dry, "targetState": target})
+
+    async def _list_segments(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json(self.manager.segment_names(
+            request.path_params["name"]))
+
+    async def _upload_segment(self, request: HttpRequest) -> HttpResponse:
+        table = request.path_params["table"]
+        if self.manager.get_table_config(table) is None:
+            return HttpResponse.error(404, f"table {table} not found")
+        if not request.body:
+            return HttpResponse.error(400, "empty segment payload")
+        with tempfile.TemporaryDirectory() as tmp:
+            seg_dir = os.path.join(tmp, "segment")
+            os.makedirs(seg_dir)
+            unpack_segment_tar(request.body, seg_dir)
+            name = self.manager.add_segment(table, seg_dir)
+        return HttpResponse.of_json({"status": f"segment {name} uploaded"})
+
+    async def _segment_metadata(self, request: HttpRequest) -> HttpResponse:
+        meta = self.manager.segment_metadata(
+            request.path_params["table"], request.path_params["segment"])
+        if meta is None:
+            return HttpResponse.error(404, "segment not found")
+        return HttpResponse.of_json(meta)
+
+    async def _delete_segment(self, request: HttpRequest) -> HttpResponse:
+        table = request.path_params["table"]
+        segment = request.path_params["segment"]
+        if self.manager.segment_metadata(table, segment) is None:
+            return HttpResponse.error(404, "segment not found")
+        self.manager.delete_segment(table, segment)
+        return HttpResponse.of_json({"status": f"{segment} deleted"})

@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
+from pinot_tpu.common.metrics import ServerQueryPhase
 from pinot_tpu.common.request import BrokerRequest
 from pinot_tpu.common.trace import Trace, make_trace
 from pinot_tpu.query.blocks import IntermediateResultsBlock
@@ -33,7 +34,6 @@ class ServerQueryExecutor:
     def execute(self, request: BrokerRequest,
                 segments: List[ImmutableSegment],
                 trace: Optional[Trace] = None) -> IntermediateResultsBlock:
-        from pinot_tpu.common.metrics import ServerQueryPhase
         trace = trace if trace is not None else make_trace(False)
         t0 = time.perf_counter()
         with trace.span(ServerQueryPhase.SEGMENT_PRUNING):

@@ -27,7 +27,8 @@ class EmbeddedCluster:
     """controller + num_servers query servers + one broker."""
 
     def __init__(self, work_dir: str, num_servers: int = 2,
-                 tcp: bool = False, mesh=None, scheduler: str = "fcfs"):
+                 tcp: bool = False, mesh=None, scheduler: str = "fcfs",
+                 http: bool = False):
         self.work_dir = work_dir
         self.controller = Controller(os.path.join(work_dir, "deepstore"))
         self.servers: Dict[str, ServerInstance] = {}
@@ -54,6 +55,17 @@ class EmbeddedCluster:
         self.broker = BrokerRequestHandler(
             self.watcher.routing, transport,
             time_boundary=self.watcher.time_boundary)
+        self.broker_api = None
+        self.controller_api = None
+        self.broker_port: Optional[int] = None
+        self.controller_port: Optional[int] = None
+        if http:
+            from pinot_tpu.broker.http_api import BrokerApiServer
+            from pinot_tpu.controller.http_api import ControllerApiServer
+            self.broker_api = BrokerApiServer(self.broker)
+            self.broker_port = self.broker_api.start()
+            self.controller_api = ControllerApiServer(self.controller)
+            self.controller_port = self.controller_api.start()
 
     # -- admin facade (parity: controller REST) ----------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -72,6 +84,10 @@ class EmbeddedCluster:
         return self.broker.handle(pql)
 
     def stop(self) -> None:
+        if self.broker_api is not None:
+            self.broker_api.stop()
+        if self.controller_api is not None:
+            self.controller_api.stop()
         self.controller.stop()
         self.broker.close()
         for participant in self.participants.values():

@@ -1,0 +1,179 @@
+"""HTTP surfaces + Python client tests.
+
+Mirrors the reference's ClusterIntegrationTestUtils flow driven entirely
+over REST: schema POST, table POST, segment upload (tar.gz artifact),
+broker /query GET+POST, table views, segment delete — with the Python
+client (parity: pinot-api Connection/ResultSetGroup) as the caller.
+"""
+import json
+import os
+import tempfile
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, make_schema, make_table_config
+from oracle import Oracle
+
+from pinot_tpu.client import (ControllerClient, PinotClientError, connect)
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+@pytest.fixture(scope="module")
+def http_cluster():
+    work = tempfile.mkdtemp()
+    c = EmbeddedCluster(work, num_servers=2, http=True)
+    ctl = ControllerClient("127.0.0.1", c.controller_port)
+    ctl.add_schema(make_schema().to_json())
+    ctl.add_table(make_table_config().to_json())
+    all_cols = []
+    for i in range(3):
+        seg_dir = os.path.join(work, "build", str(i))
+        _, cols = build_segment(seg_dir, n=1200, seed=500 + i,
+                                name=f"ht_{i}")
+        ctl.upload_segment_dir("baseballStats_OFFLINE", seg_dir)
+        all_cols.append(cols)
+    merged = {k: (np.concatenate([col[k] for col in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((col[k] for col in all_cols), []))
+              for k in all_cols[0]}
+    conn = connect(f"127.0.0.1:{c.broker_port}")
+    yield c, ctl, conn, Oracle(merged)
+    conn.close()
+    ctl.close()
+    c.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read()
+
+
+def test_rest_schema_and_table_crud(http_cluster):
+    c, ctl, _, _ = http_cluster
+    assert ctl.get_schema("baseballStats")["schemaName"] == "baseballStats"
+    assert "baseballStats_OFFLINE" in ctl.list_tables()
+    cfg = ctl.get_table("baseballStats_OFFLINE")
+    assert cfg["tableName"].startswith("baseballStats")
+    with pytest.raises(PinotClientError, match="404"):
+        ctl.get_schema("nope")
+    with pytest.raises(PinotClientError, match="404"):
+        ctl.get_table("nope_OFFLINE")
+
+
+def test_rest_upload_makes_segments_queryable(http_cluster):
+    c, ctl, conn, oracle = http_cluster
+    assert sorted(ctl.list_segments("baseballStats_OFFLINE")) == \
+        ["ht_0", "ht_1", "ht_2"]
+    ev = ctl.external_view("baseballStats_OFFLINE")
+    assert set(ev) == {"ht_0", "ht_1", "ht_2"}
+    rg = conn.execute("SELECT COUNT(*) FROM baseballStats")
+    assert rg.result_set(0).get(0, 0) == "3600"
+    assert rg.num_docs_scanned == 3600
+
+
+def test_client_aggregation_matches_oracle(http_cluster):
+    _, _, conn, oracle = http_cluster
+    m = oracle.mask(lambda r: r["league"] == "NL")
+    rg = conn.execute("SELECT COUNT(*), SUM(hits) FROM baseballStats "
+                      "WHERE league = 'NL'")
+    assert rg.result_set_count == 2
+    assert rg.result_set(0).get(0, 0) == str(oracle.count(m))
+    assert float(rg.result_set(1).get(0, 0)) == float(
+        np.sum(oracle.vals("hits", m)))
+
+
+def test_client_group_by_result_set(http_cluster):
+    _, _, conn, oracle = http_cluster
+    expected = oracle.group_by(["league"], oracle.mask(lambda r: True),
+                               ("count", None))
+    rg = conn.execute("SELECT COUNT(*) FROM baseballStats GROUP BY league")
+    rs = rg.result_set(0)
+    assert rs.group_key_columns == ["league"]
+    got = {tuple(rs.group_key(i)): float(rs.get(i, 0))
+           for i in range(rs.row_count)}
+    assert got == {k: float(v) for k, v in expected.items()}
+
+
+def test_client_selection_rows(http_cluster):
+    _, _, conn, oracle = http_cluster
+    rg = conn.execute("SELECT runs FROM baseballStats "
+                      "ORDER BY runs DESC LIMIT 5")
+    rs = rg.result_set(0)
+    assert rs.column_name(0) == "runs"
+    top = sorted(oracle.vals("runs", oracle.mask(lambda r: True)),
+                 reverse=True)[:5]
+    assert [int(rs.get(i, 0)) for i in range(5)] == [int(v) for v in top]
+
+
+def test_client_trace_flag(http_cluster):
+    _, _, conn, _ = http_cluster
+    rg = conn.execute("SELECT COUNT(*) FROM baseballStats", trace=True)
+    assert rg.trace_info is not None
+    assert "broker" in rg.trace_info
+
+
+def test_get_query_endpoint(http_cluster):
+    c, _, _, _ = http_cluster
+    q = urllib.parse.quote("SELECT MAX(runs) FROM baseballStats")
+    status, payload = _get(c.broker_port, f"/query?pql={q}")
+    assert status == 200
+    data = json.loads(payload)
+    assert data["aggregationResults"][0]["function"] == "max(runs)"
+
+
+def test_broker_http_error_paths(http_cluster):
+    c, _, _, _ = http_cluster
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(c.broker_port, "/query")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(c.broker_port, "/nothere")
+    assert e.value.code == 404
+    status, payload = _get(c.broker_port, "/health")
+    assert (status, payload) == (200, b"OK")
+    status, payload = _get(c.broker_port, "/metrics")
+    assert json.loads(payload)["meter.queries.count"] >= 1
+
+
+def test_controller_views_and_segment_metadata(http_cluster):
+    c, ctl, _, _ = http_cluster
+    status, payload = _get(c.controller_port,
+                           "/tables/baseballStats_OFFLINE/idealstate")
+    ideal = json.loads(payload)
+    assert set(ideal) == {"ht_0", "ht_1", "ht_2"}
+    meta = ctl.segment_metadata("baseballStats_OFFLINE", "ht_0")
+    assert meta["segmentName"] == "ht_0"
+    assert meta["totalDocs"] == 1200
+    reb = ctl.rebalance("baseballStats_OFFLINE", dry_run=True)
+    assert reb["dryRun"] is True
+    assert set(reb["targetState"]) == {"ht_0", "ht_1", "ht_2"}
+
+
+def test_rest_delete_segment_and_requery(http_cluster):
+    c, ctl, conn, _ = http_cluster
+    work = tempfile.mkdtemp()
+    seg_dir = os.path.join(work, "extra")
+    build_segment(seg_dir, n=300, seed=999, name="ht_extra")
+    ctl.upload_segment_dir("baseballStats_OFFLINE", seg_dir)
+    import time
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rg = conn.execute("SELECT COUNT(*) FROM baseballStats")
+        if rg.result_set(0).get(0, 0) == "3900":
+            break
+        time.sleep(0.05)
+    assert rg.result_set(0).get(0, 0) == "3900"
+    ctl.delete_segment("baseballStats_OFFLINE", "ht_extra")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rg = conn.execute("SELECT COUNT(*) FROM baseballStats")
+        if rg.result_set(0).get(0, 0) == "3600":
+            break
+        time.sleep(0.05)
+    assert rg.result_set(0).get(0, 0) == "3600"
+    with pytest.raises(PinotClientError, match="404"):
+        ctl.segment_metadata("baseballStats_OFFLINE", "ht_extra")
