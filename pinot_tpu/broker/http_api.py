@@ -28,18 +28,19 @@ class BrokerApiServer(ApiServer):
 
     @staticmethod
     def _identity(request: HttpRequest) -> RequesterIdentity:
-        auth = request.headers.get("authorization", "")
-        token = auth.split(None, 1)[1] if auth.lower().startswith(
-            "bearer ") else None
-        return RequesterIdentity(client_address=request.client, token=token)
+        parts = request.headers.get("authorization", "").split(None, 1)
+        token = parts[1].strip() if len(parts) == 2 and \
+            parts[0].lower() == "bearer" else None
+        return RequesterIdentity(client_address=request.client,
+                                 token=token or None)
 
-    async def _run_query(self, pql: str,
-                         identity: RequesterIdentity) -> HttpResponse:
+    async def _run_query(self, pql: str, identity: RequesterIdentity,
+                         force_trace: bool = False) -> HttpResponse:
         # the broker handler owns its own event loop (per-server TCP
         # connections live there); hop through its sync facade off-thread
         loop = asyncio.get_running_loop()
         resp = await loop.run_in_executor(
-            None, lambda: self.handler.handle(pql, identity))
+            None, lambda: self.handler.handle(pql, identity, force_trace))
         return HttpResponse.of_json(resp.to_json())
 
     async def _get_query(self, request: HttpRequest) -> HttpResponse:
@@ -56,17 +57,8 @@ class BrokerApiServer(ApiServer):
         pql = body.get("pql") or body.get("sql")
         if not pql:
             return HttpResponse.error(400, 'missing "pql" in body')
-        if body.get("trace"):
-            # parity: the client's trace flag rides the request JSON; an
-            # explicit trace key inside an existing OPTION clause wins
-            # (the parser applies keys in order)
-            import re
-            if "option(" in pql.lower():
-                pql = re.sub(r"(?i)option\s*\(", "OPTION(trace=true, ",
-                             pql, count=1)
-            else:
-                pql = f"{pql} OPTION(trace=true)"
-        return await self._run_query(pql, self._identity(request))
+        return await self._run_query(pql, self._identity(request),
+                                     force_trace=bool(body.get("trace")))
 
     async def _health(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse(200, b"OK", content_type="text/plain")

@@ -161,12 +161,13 @@ class BrokerRequestHandler:
         self._loop_lock = threading.Lock()
 
     # -- sync facade -------------------------------------------------------
-    def handle(self, pql: str, identity=None) -> BrokerResponse:
+    def handle(self, pql: str, identity=None,
+               force_trace: bool = False) -> BrokerResponse:
         with self._loop_lock:
             if self._loop is None:
                 self._loop = EventLoopThread()
             loop = self._loop
-        return loop.run(self.handle_async(pql, identity))
+        return loop.run(self.handle_async(pql, identity, force_trace))
 
     def close(self) -> None:
         if self._loop is not None:
@@ -174,7 +175,8 @@ class BrokerRequestHandler:
             self._loop.stop()
             self._loop = None
 
-    async def handle_async(self, pql: str, identity=None) -> BrokerResponse:
+    async def handle_async(self, pql: str, identity=None,
+                           force_trace: bool = False) -> BrokerResponse:
         t0 = time.perf_counter()
         self.metrics.meter(BrokerMeter.QUERIES).mark()
         t = time.perf_counter()
@@ -184,6 +186,10 @@ class BrokerRequestHandler:
             self.metrics.meter(
                 BrokerMeter.REQUEST_COMPILATION_EXCEPTIONS).mark()
             return _error_response(150, f"PQLParsingError: {e}")
+        if force_trace and "trace" not in request.query_options.options:
+            # the HTTP client's JSON trace flag; an explicit OPTION(trace=…)
+            # in the query wins
+            request.query_options.trace = True
         compile_ms = (time.perf_counter() - t) * 1e3
         self.metrics.timer(BrokerQueryPhase.REQUEST_COMPILATION).update(
             compile_ms)

@@ -108,10 +108,15 @@ class _HttpEndpoint:
         self._conn: Optional[http.client.HTTPConnection] = None
 
     def request(self, method: str, path: str, body: Optional[bytes] = None,
-                headers: Optional[Dict[str, str]] = None
-                ) -> Tuple[int, bytes]:
+                headers: Optional[Dict[str, str]] = None,
+                idempotent: Optional[bool] = None) -> Tuple[int, bytes]:
+        """One retry on a stale kept-alive connection — but only for
+        requests that are safe to re-send (the server may already have
+        processed a POST whose response was lost)."""
         headers = dict(headers or {})
-        for attempt in (0, 1):       # one retry on a stale kept-alive conn
+        if idempotent is None:
+            idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
+        for attempt in (0, 1):
             if self._conn is None:
                 self._conn = http.client.HTTPConnection(
                     self.host, self.port, timeout=self.timeout)
@@ -121,7 +126,7 @@ class _HttpEndpoint:
                 return resp.status, resp.read()
             except (http.client.HTTPException, ConnectionError, OSError):
                 self.close()
-                if attempt:
+                if attempt or not idempotent:
                     raise
         raise PinotClientError("unreachable")  # pragma: no cover
 
@@ -167,9 +172,10 @@ class Connection:
             headers["Authorization"] = f"Bearer {self._token}"
         endpoint = self._selector.select()
         try:
+            # queries are read-only: safe to retry on a stale connection
             status, payload = endpoint.request("POST", "/query", body,
-                                               headers)
-        except (ConnectionError, OSError) as e:
+                                               headers, idempotent=True)
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
             raise PinotClientError(f"broker unreachable: {e}") from e
         if status != 200:
             raise PinotClientError(f"broker returned HTTP {status}: "
@@ -205,11 +211,13 @@ class ControllerClient:
     def __init__(self, host: str, port: int):
         self._endpoint = _HttpEndpoint(host, port)
 
-    def _json(self, method: str, path: str,
-              body: Optional[bytes] = None) -> dict:
+    def _json(self, method: str, path: str, body: Optional[bytes] = None,
+              content_type: str = "application/json",
+              idempotent: Optional[bool] = None) -> dict:
         status, payload = self._endpoint.request(
             method, path, body,
-            {"Content-Type": "application/json"} if body else None)
+            {"Content-Type": content_type} if body else None,
+            idempotent=idempotent)
         data = json.loads(payload) if payload else {}
         if status >= 400:
             raise PinotClientError(
@@ -217,8 +225,9 @@ class ControllerClient:
         return data
 
     def add_schema(self, schema_json: dict) -> dict:
+        # schema/table adds are store upserts: retry-safe
         return self._json("POST", "/schemas",
-                          json.dumps(schema_json).encode())
+                          json.dumps(schema_json).encode(), idempotent=True)
 
     def get_schema(self, name: str) -> dict:
         return self._json("GET", f"/schemas/{urllib.parse.quote(name)}")
@@ -252,14 +261,9 @@ class ControllerClient:
     def upload_segment_dir(self, table: str, segment_dir: str) -> dict:
         from pinot_tpu.controller.http_api import pack_segment_dir
         data = pack_segment_dir(segment_dir)
-        status, payload = self._endpoint.request(
+        return self._json(
             "POST", f"/segments/{urllib.parse.quote(table)}", data,
-            {"Content-Type": "application/gzip"})
-        out = json.loads(payload) if payload else {}
-        if status >= 400:
-            raise PinotClientError(
-                f"HTTP {status}: {out.get('error', payload[:200])}")
-        return out
+            content_type="application/gzip", idempotent=False)
 
     def delete_segment(self, table: str, segment: str) -> dict:
         return self._json(

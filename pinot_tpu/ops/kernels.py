@@ -393,8 +393,16 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
 def _group_outputs(group_spec, cols, mask, num_docs):
     gcols, strides, g_pad, agg_specs = group_spec
     key = None
-    for c, s in zip(gcols, strides):
-        term = cols[f"{c}.ids"].astype(jnp.int32) * np.int32(s)
+    for (c, gkind, off, _card), s in zip(gcols, strides):
+        if gkind == "rawoff":
+            # no-dictionary integer group key: bin by (value - min), the
+            # on-the-fly analogue of a dictId (metadata min/max bound the
+            # range; the planner verified it fits the group table)
+            lane = cols[f"{c}.raw"]
+            ids = (lane - lane.dtype.type(off)).astype(jnp.int32)
+        else:
+            ids = cols[f"{c}.ids"].astype(jnp.int32)
+        term = ids * np.int32(s)
         key = term if key is None else key + term
     key = jnp.clip(key, 0, g_pad - 1)
     dense = g_pad <= DENSE_G_LIMIT and mask.shape[0] <= DENSE_ROWS_LIMIT
@@ -467,17 +475,48 @@ def _group_outputs(group_spec, cols, mask, num_docs):
 #
 # select spec: (kind, k, order=((col, asc, card_pad, source), ...),
 #               gather_cols=((col, source), ...))
-#   kind ∈ {"limit", "order"}
+#   kind ∈ {"limit",     # no order: first-k matched docids
+#           "order",     # all-dict keys packed into one int32 → top_k
+#           "ordertk",   # single raw int32/f32 key → monotone-map + top_k
+#           "ordermk"}   # general multi-key → lax.sort (no packing limit)
 # ---------------------------------------------------------------------------
+
+
+def _monotone_int32_keys(lane, asc: bool) -> list:
+    """Numeric lane → 1-2 int32 lanes whose lexicographic order equals the
+    value order, exactly (IEEE-754 bit tricks; int64/f64 split hi/lo).
+    Descending order is per-lane bitwise NOT (x ↦ -x-1 reverses int32 order
+    and distributes over the hi/lo concatenation)."""
+    dt = lane.dtype
+    if dt in (jnp.int8, jnp.int16, jnp.int32):
+        keys = [lane.astype(jnp.int32)]
+    elif dt == jnp.float32:
+        b = jax.lax.bitcast_convert_type(lane, jnp.int32)
+        keys = [b ^ ((b >> 31) & jnp.int32(0x7FFFFFFF))]
+    elif dt == jnp.int64:
+        hi = (lane >> 32).astype(jnp.int32)
+        lo = ((lane & jnp.int64(0xFFFFFFFF)) -
+              jnp.int64(0x80000000)).astype(jnp.int32)
+        keys = [hi, lo]
+    elif dt == jnp.float64:
+        b = jax.lax.bitcast_convert_type(lane, jnp.int64)
+        m = b ^ ((b >> 63) & jnp.int64(0x7FFFFFFFFFFFFFFF))
+        hi = (m >> 32).astype(jnp.int32)
+        lo = ((m & jnp.int64(0xFFFFFFFF)) -
+              jnp.int64(0x80000000)).astype(jnp.int32)
+        keys = [hi, lo]
+    else:
+        raise ValueError(f"unsupported order-by lane dtype {dt}")
+    return keys if asc else [~k for k in keys]
 
 
 def _selection_outputs(select_spec, cols, mask):
     kind, k, order, gather_cols = select_spec
     if kind == "limit":
         docids = jnp.nonzero(mask, size=k, fill_value=-1)[0]
-    else:
-        # pack order columns into one int32 key (plan maker guarantees the
-        # radix product fits in 31 bits, else it falls back to host sort)
+    elif kind == "order":
+        # pack dict order columns into one int32 key (planner guarantees
+        # the radix product fits in 31 bits, else it emits "ordermk")
         key = jnp.zeros(mask.shape[0], jnp.int32)
         for col, asc, card_pad, source in order:
             ids = cols[f"{col}.ids"]
@@ -486,6 +525,33 @@ def _selection_outputs(select_spec, cols, mask):
         key = jnp.where(mask, key, INT32_MAX)
         neg_vals, docids = jax.lax.top_k(-key, k)
         docids = jnp.where(neg_vals == -INT32_MAX, -1, docids)
+    elif kind == "ordertk":
+        # single raw int32/f32 order column: monotone int32 key + top_k
+        (col, asc, _card_pad, _source), = order
+        key = _monotone_int32_keys(cols[f"{col}.raw"], asc)[0]
+        # reserve INT32_MAX for the masked-row sentinel so no valid row can
+        # tie it and get dropped (cost: values whose keys are INT32_MAX and
+        # INT32_MAX-1 — int 2^31-1 vs 2^31-2, or two NaN bit patterns —
+        # become order-tied with each other)
+        key = jnp.minimum(key, INT32_MAX - 1)
+        # top_k is descending; ~key descending == key ascending
+        scored = jnp.where(mask, ~key, -INT32_MAX - 1)
+        _, docids = jax.lax.top_k(scored, k)
+        n_valid = mask.sum(dtype=jnp.int32)
+        docids = jnp.where(jnp.arange(k, dtype=jnp.int32) < n_valid,
+                           docids, -1)
+    else:  # ordermk: general multi-key device sort
+        keys = []
+        for col, asc, card_pad, source in order:
+            if source == "sv":
+                ids = cols[f"{col}.ids"].astype(jnp.int32)
+                keys.append(ids if asc else ~ids)
+            else:
+                keys.extend(_monotone_int32_keys(cols[f"{col}.raw"], asc))
+        flag = jnp.where(mask, jnp.int32(0), jnp.int32(1))
+        iota = jnp.arange(mask.shape[0], dtype=jnp.int32)
+        res = jax.lax.sort((flag, *keys, iota), num_keys=1 + len(keys))
+        docids = jnp.where(res[0][:k] == 0, res[-1][:k], -1)
     out = {"sel.docids": docids.astype(jnp.int32),
            "sel.count": mask.sum(dtype=jnp.int32)}
     safe = jnp.maximum(docids, 0)

@@ -160,20 +160,27 @@ def _finish_group_by(plan, outs, blk) -> None:
     gcols, strides, g_pad, agg_specs = plan.group_spec
     counts = np.asarray(outs["group.count"])
     nz = np.nonzero(counts)[0]
-    dicts = [plan.segment.data_source(c).dictionary for c in gcols]
-    cards = [d.cardinality for d in dicts]
+    cards = [entry[3] for entry in gcols]
 
     group_map: Dict[Tuple, List] = {}
     # decode all non-empty group keys vectorized; expression group keys
     # decode through their transformed value table (collisions — distinct
-    # source ids mapping to one transformed value — merge below)
+    # source ids mapping to one transformed value — merge below);
+    # raw-binned keys decode as (binId + min_value)
     keys = nz
     id_cols = []
     for stride, card in zip(strides, cards):
         id_cols.append((keys // stride) % card)
     vtables = plan.group_value_tables or (None,) * len(gcols)
-    value_cols = [tv[ids] if tv is not None else d.decode(ids)
-                  for d, ids, tv in zip(dicts, id_cols, vtables)]
+    value_cols = []
+    for (c, gkind, off, _card), ids, tv in zip(gcols, id_cols, vtables):
+        if tv is not None:
+            value_cols.append(tv[ids])
+        elif gkind == "rawoff":
+            value_cols.append(ids.astype(np.int64) + off)
+        else:
+            value_cols.append(
+                plan.segment.data_source(c).dictionary.decode(ids))
 
     def _sum_array(i, spec):
         """Exact f64 per-group sums from the device partials."""

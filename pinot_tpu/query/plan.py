@@ -483,19 +483,35 @@ class InstancePlanMaker:
                         f"expression group key over non-dict/MV column {src}")
                 vals = np.asarray(ds.dictionary.values)
                 tv = np.asarray(expr_mod.evaluate(expr, lambda _: vals))
-                gcols.append(src)
+                gcols.append((src, "ids", 0, ds.metadata.cardinality))
                 value_tables.append(tv)
                 cards.append(ds.metadata.cardinality)
                 needed[(src, "ids")] = None
                 continue
             ds = segment.data_source(c)
-            if not ds.metadata.has_dictionary or not ds.metadata.single_value:
-                raise UnsupportedOnDevice(
-                    f"group-by on non-dictionary/MV column {c}")
-            gcols.append(c)
-            value_tables.append(None)
-            cards.append(ds.metadata.cardinality)
-            needed[(c, "ids")] = None
+            cm = ds.metadata
+            if cm.has_dictionary and cm.single_value:
+                gcols.append((c, "ids", 0, cm.cardinality))
+                value_tables.append(None)
+                cards.append(cm.cardinality)
+                needed[(c, "ids")] = None
+                continue
+            if not cm.has_dictionary and cm.single_value and \
+                    cm.data_type.np_dtype.kind in "iu" and \
+                    cm.min_value is not None and \
+                    -2**31 <= int(cm.min_value) and int(cm.max_value) < 2**31:
+                # no-dictionary integer group key: bin by (value - min) —
+                # metadata min/max bound the id range (int32-safe: device
+                # lanes are int32 when x64 is off); the groups-limit check
+                # below rejects ranges too wide for the group table
+                span = int(cm.max_value) - int(cm.min_value) + 1
+                gcols.append((c, "rawoff", int(cm.min_value), span))
+                value_tables.append(None)
+                cards.append(span)
+                needed[(c, "raw")] = None
+                continue
+            raise UnsupportedOnDevice(
+                f"group-by on non-dictionary/MV column {c}")
         plan.group_value_tables = tuple(value_tables)
         g = int(np.prod(cards, dtype=np.int64))
         if g > self.num_groups_limit:
@@ -539,19 +555,40 @@ class InstancePlanMaker:
             return
         order = []
         packed_bits = 0
+        all_dict = True
+        single_lane_raw = False
         for ob in sel.order_by:
             ds = segment.data_source(ob.column)
             cm = ds.metadata
-            if not (cm.has_dictionary and cm.single_value):
-                raise UnsupportedOnDevice(
-                    f"order-by on non-dictionary/MV column {ob.column}")
-            card_pad = cm.cardinality + 1
-            packed_bits += int(np.ceil(np.log2(max(card_pad, 2))))
-            order.append((ob.column, ob.ascending, card_pad, "sv"))
-            needed[(ob.column, "ids")] = None
-        if packed_bits > 30:
-            raise UnsupportedOnDevice("order-by key exceeds 31-bit packing")
-        plan.select_spec = ("order", k, tuple(order), tuple(gather))
+            if cm.has_dictionary and cm.single_value:
+                # sorted dictionary ⇒ id order == value order: dictIds are
+                # exact order keys for ANY dict column (incl. float/string)
+                card_pad = cm.cardinality + 1
+                packed_bits += int(np.ceil(np.log2(max(card_pad, 2))))
+                order.append((ob.column, ob.ascending, card_pad, "sv"))
+                needed[(ob.column, "ids")] = None
+                continue
+            if not cm.has_dictionary and cm.single_value and \
+                    cm.data_type.is_numeric:
+                all_dict = False
+                # the device lane keeps int32/f32 width; wider types only
+                # exist device-side under x64 (CPU) where hi/lo keys apply
+                single_lane_raw = cm.data_type.np_dtype.itemsize <= 4
+                order.append((ob.column, ob.ascending, 0, "raw"))
+                needed[(ob.column, "raw")] = None
+                continue
+            raise UnsupportedOnDevice(
+                f"order-by on MV/non-numeric-raw column {ob.column}")
+        if all_dict and packed_bits <= 30:
+            # fast path: one packed int32 key + top_k
+            plan.select_spec = ("order", k, tuple(order), tuple(gather))
+        elif len(order) == 1 and single_lane_raw:
+            # fast path: single raw int32/f32 key, monotone map + top_k
+            plan.select_spec = ("ordertk", k, tuple(order), tuple(gather))
+        else:
+            # general path: per-column int32 key lanes, full device sort —
+            # covers >31-bit dict packings, raw columns, and mixes
+            plan.select_spec = ("ordermk", k, tuple(order), tuple(gather))
 
 
 def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,

@@ -1,0 +1,214 @@
+"""Device-coverage tests for former host-fallback cliffs.
+
+Round-1 verdict called out three UnsupportedOnDevice cliffs (plan.py):
+ORDER BY on raw/float columns, order keys past 31-bit packing, and
+group-by over no-dictionary columns. These tests pin the new device paths
+(monotone-int32 top_k, multi-key lax.sort, raw-value binning) against the
+numpy oracle AND against the host executor.
+"""
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import make_columns, make_schema, make_table_config
+from oracle import Oracle
+
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.plan import InstancePlanMaker, UnsupportedOnDevice
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+N = 6000
+
+
+@pytest.fixture(scope="module")
+def setup():
+    tmp = tempfile.mkdtemp()
+    cols = make_columns(N, seed=42)
+    # runs raw (no-dictionary int32), salary raw (no-dictionary float32)
+    cfg = make_table_config(no_dict=["salary", "runs"])
+    SegmentCreator(make_schema(), cfg, segment_name="cov_0").build(cols, tmp)
+    segment = ImmutableSegmentLoader.load(tmp)
+    engine = QueryEngine([segment])
+    host = QueryEngine([segment], use_device=False)
+    return segment, engine, host, Oracle(cols)
+
+
+def _plan(segment, pql):
+    return InstancePlanMaker().make_segment_plan(segment, compile_pql(pql))
+
+
+def _sel_rows(resp):
+    return resp.selection_results.results
+
+
+# -- ORDER BY over raw columns ----------------------------------------------
+
+def test_order_by_raw_float_plans_topk(setup):
+    segment, _, _, _ = setup
+    plan = _plan(segment, "SELECT salary FROM baseballStats "
+                 "ORDER BY salary DESC LIMIT 10")
+    assert plan.select_spec[0] == "ordertk"
+
+
+def test_order_by_raw_float_matches_oracle(setup):
+    _, engine, host, oracle = setup
+    for e in (engine, host):
+        resp = e.query("SELECT salary FROM baseballStats "
+                       "ORDER BY salary DESC LIMIT 10")
+        got = [float(r[0]) for r in _sel_rows(resp)]
+        exp = sorted(oracle.vals("salary", oracle.mask(lambda r: True)),
+                     reverse=True)[:10]
+        assert got == pytest.approx([float(v) for v in exp])
+
+
+def test_order_by_raw_int_asc_with_filter(setup):
+    segment, engine, host, oracle = setup
+    plan = _plan(segment, "SELECT runs FROM baseballStats "
+                 "ORDER BY runs LIMIT 15")
+    assert plan.select_spec[0] == "ordertk"
+    m = oracle.mask(lambda r: r["league"] == "NL")
+    exp = sorted(oracle.vals("runs", m))[:15]
+    for e in (engine, host):
+        resp = e.query("SELECT runs FROM baseballStats WHERE league = 'NL' "
+                       "ORDER BY runs LIMIT 15")
+        got = [int(r[0]) for r in _sel_rows(resp)]
+        assert got == [int(v) for v in exp]
+
+
+def test_order_by_mixed_dict_and_raw_uses_sort(setup):
+    segment, engine, host, oracle = setup
+    plan = _plan(segment, "SELECT teamID, salary FROM baseballStats "
+                 "ORDER BY teamID, salary DESC LIMIT 25")
+    assert plan.select_spec[0] == "ordermk"
+    m = oracle.mask(lambda r: True)
+    pairs = sorted(zip(oracle.vals("teamID", m), oracle.vals("salary", m)),
+                   key=lambda p: (p[0], -float(p[1])))[:25]
+    for e in (engine, host):
+        resp = e.query("SELECT teamID, salary FROM baseballStats "
+                       "ORDER BY teamID, salary DESC LIMIT 25")
+        rows = _sel_rows(resp)
+        assert [r[0] for r in rows] == [p[0] for p in pairs]
+        assert [float(r[1]) for r in rows] == pytest.approx(
+            [float(p[1]) for p in pairs])
+
+
+def test_order_by_wide_dict_key_uses_sort(setup):
+    segment, engine, host, oracle = setup
+    pql = ("SELECT playerName, average, hits, yearID FROM baseballStats "
+           "ORDER BY playerName, average DESC, hits, yearID LIMIT 20")
+    plan = _plan(segment, pql)
+    # 997 * 1001 * 251 * 31 distinct values ≈ 2^37 — beyond int32 packing
+    assert plan.select_spec[0] == "ordermk"
+    m = oracle.mask(lambda r: True)
+    quads = sorted(zip(oracle.vals("playerName", m),
+                       oracle.vals("average", m),
+                       oracle.vals("hits", m),
+                       oracle.vals("yearID", m)),
+                   key=lambda q: (q[0], -q[1], q[2], q[3]))[:20]
+    for e in (engine, host):
+        resp = e.query(pql)
+        rows = _sel_rows(resp)
+        assert [r[0] for r in rows] == [q[0] for q in quads]
+        assert [float(r[1]) for r in rows] == pytest.approx(
+            [float(q[1]) for q in quads])
+        assert [int(r[2]) for r in rows] == [int(q[2]) for q in quads]
+        assert [int(r[3]) for r in rows] == [int(q[3]) for q in quads]
+
+
+# -- GROUP BY over no-dictionary columns ------------------------------------
+
+def test_group_by_raw_int_plans_on_device(setup):
+    segment, _, _, _ = setup
+    plan = _plan(segment, "SELECT COUNT(*) FROM baseballStats "
+                 "GROUP BY runs TOP 1000")
+    assert plan.group_spec is not None
+    (col, kind, off, card), = plan.group_spec[0]
+    assert (col, kind) == ("runs", "rawoff")
+    assert card >= 1
+
+
+def test_group_by_raw_int_matches_oracle(setup):
+    _, engine, host, oracle = setup
+    m = oracle.mask(lambda r: True)
+    exp_cnt = oracle.group_by(["runs"], m, ("count", None))
+    exp_sum = oracle.group_by(["runs"], m, ("sum", "hits"))
+    for e in (engine, host):
+        resp = e.query("SELECT COUNT(*), SUM(hits) FROM baseballStats "
+                       "GROUP BY runs TOP 1000")
+        got_cnt = {g["group"][0]: float(g["value"]) for g in
+                   resp.aggregation_results[0].group_by_result}
+        got_sum = {g["group"][0]: float(g["value"]) for g in
+                   resp.aggregation_results[1].group_by_result}
+        assert got_cnt == {int(k[0]): float(v) for k, v in exp_cnt.items()}
+        assert got_sum == {int(k[0]): pytest.approx(float(v))
+                           for k, v in exp_sum.items()}
+
+
+def test_group_by_raw_int_with_dict_dim(setup):
+    _, engine, host, oracle = setup
+    m = oracle.mask(lambda r: r["yearID"] >= 2005)
+    exp = oracle.group_by(["league", "runs"], m, ("count", None))
+    pql = ("SELECT COUNT(*) FROM baseballStats WHERE yearID >= 2005 "
+           "GROUP BY league, runs TOP 2000")
+    for e in (engine, host):
+        resp = e.query(pql)
+        got = {(g["group"][0], int(g["group"][1])): float(g["value"])
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == {(k[0], int(k[1])): float(v) for k, v in exp.items()}
+
+
+def test_group_by_raw_float_still_falls_back(setup):
+    segment, engine, _, oracle = setup
+    with pytest.raises(UnsupportedOnDevice):
+        _plan(segment, "SELECT COUNT(*) FROM baseballStats "
+              "GROUP BY salary TOP 10000")
+    # the engine still answers via the host executor
+    resp = engine.query("SELECT COUNT(*) FROM baseballStats "
+                        "GROUP BY salary TOP 20000")
+    total = sum(float(g["value"]) for g in
+                resp.aggregation_results[0].group_by_result)
+    assert total == N
+
+
+# -- sharded (mesh) execution of the new paths ------------------------------
+
+@pytest.fixture(scope="module")
+def sharded_setup():
+    import os
+    from fixtures import build_shared_segments
+    from pinot_tpu.parallel import make_mesh
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, n_segs=8, n=2048, seed=9)
+    engine = QueryEngine(segs, mesh=make_mesh())
+    seq = QueryEngine(segs)
+    return engine, seq, Oracle(merged)
+
+
+def test_sharded_order_by_raw_float(sharded_setup):
+    engine, seq, oracle = sharded_setup
+    pql = ("SELECT salary FROM baseballStats ORDER BY salary DESC LIMIT 12")
+    exp = sorted(oracle.vals("salary", oracle.mask(lambda r: True)),
+                 reverse=True)[:12]
+    for e in (engine, seq):
+        got = [float(r[0]) for r in _sel_rows(e.query(pql))]
+        assert got == pytest.approx([float(v) for v in exp])
+
+
+def test_sharded_wide_key_order_by(sharded_setup):
+    engine, seq, oracle = sharded_setup
+    pql = ("SELECT playerName, average, hits, yearID FROM baseballStats "
+           "ORDER BY playerName, average DESC, hits, yearID LIMIT 15")
+    m = oracle.mask(lambda r: True)
+    quads = sorted(zip(oracle.vals("playerName", m),
+                       oracle.vals("average", m),
+                       oracle.vals("hits", m),
+                       oracle.vals("yearID", m)),
+                   key=lambda q: (q[0], -q[1], q[2], q[3]))[:15]
+    for e in (engine, seq):
+        rows = _sel_rows(e.query(pql))
+        assert [r[0] for r in rows] == [q[0] for q in quads]
+        assert [float(r[1]) for r in rows] == pytest.approx(
+            [float(q[1]) for q in quads])
