@@ -1,34 +1,45 @@
-"""Benchmark: SSB-style aggregation queries, TPU engine vs CPU columnar scan.
+"""Benchmark: SSB Q1.1–Q4.3 (13 queries), TPU engine vs CPU columnar scan.
 
-Mirrors BASELINE.md configs 1-4 (+ the 8-segment combine of config 5): range
-COUNT, filtered SUM/MIN/MAX, range+IN conjunction, 2-dim GROUP BY.
+Matches BASELINE.md's north star ("≥8× p50 latency vs CPU on SSB Q1.1–Q4.3,
+identical result rows") and the reference's contrib/pinot-druid-benchmark
+harness shape (flattened star schema, PQL aggregations — PQL 0.2.0 has no
+expression aggregations, so Q1.x sums lo_revenue and Q4.x returns
+SUM(lo_revenue), SUM(lo_supplycost) as separate aggregations, the standard
+Pinot adaptation).
 
 Two stages:
-1. CORRECTNESS GATE — a small table goes through the FULL engine path
-   (host-built segments -> HBM upload -> plan -> fused sharded kernel ->
-   host finish -> broker reduce) and every query's result rows must equal
-   the numpy oracle's.
-2. THROUGHPUT — the BASELINE-sized table (default 100M rows, 8 segments).
-   Column lanes are synthesized directly in HBM (the test harness reaches
-   the TPU through a ~3MB/s relay, so uploading a 2.5GB table is the
-   harness's bottleneck, not the engine's). Device timing is PIPELINED:
-   N back-to-back kernel dispatches with one final sync — steady-state of
-   a loaded server — so the relay's ~100ms per-sync round trip amortizes
-   away. The CPU baseline does the same id-domain columnar work with
-   vectorized numpy on an identically-distributed table.
+1. STORAGE PATH (the headline): PINOT_TPU_BENCH_STORE_ROWS rows (default
+   16M, 8 segments) go through the framework's OWN path end-to-end — rows →
+   SegmentCreator (dictionary build, bit-packed fwd) → disk →
+   ImmutableSegmentLoader → HBM upload of the loaded lanes. Every query's
+   result is checked against the numpy oracle, then timed: device timing is
+   PIPELINED (N back-to-back dispatches, one final sync — steady state of a
+   loaded server; the test harness reaches the TPU through a ~3MB/s,
+   ~100ms-RTT relay, so per-sync cost amortizes away) plus the measured
+   host finish (group decode / reduce). CPU baseline: vectorized numpy over
+   id-domain columns of the same table.
+2. LARGE SYNTH (secondary, PINOT_TPU_BENCH_ROWS rows, default 100M): same
+   13 queries at reference benchmark scale. Column lanes are synthesized
+   directly in HBM (relay-bottleneck workaround: uploading ~6GB through
+   the 3MB/s harness relay is infeasible — the storage path itself is
+   exercised and timed in stage 1). CPU baseline runs on an
+   identically-distributed host table at the same row count.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": p50 speedup vs CPU, "unit": "x",
-   "vs_baseline": value / 8.0}   (BASELINE north star: >= 8x p50 vs CPU)
+  {"metric": "ssb13_storage_path_p50_speedup_vs_cpu", "value": p50 speedup
+   over the 13 queries through the framework's own load path, "unit": "x",
+   "vs_baseline": value / 8.0, ...per-query and large-synth detail...}
 
-Env knobs: PINOT_TPU_BENCH_ROWS (default 100_000_000),
-PINOT_TPU_BENCH_SEGMENTS (8), PINOT_TPU_BENCH_REPS (5).
+Env knobs: PINOT_TPU_BENCH_STORE_ROWS (16_000_000),
+PINOT_TPU_BENCH_ROWS (100_000_000), PINOT_TPU_BENCH_SEGMENTS (8),
+PINOT_TPU_BENCH_REPS (5), PINOT_TPU_BENCH_SKIP_BIG (0).
 """
 from __future__ import annotations
 
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -42,182 +53,516 @@ def median(xs):
     return float(np.median(np.asarray(xs)))
 
 
-PQLS = {
-    "q1_range_count":
-        "SELECT COUNT(*) FROM lineorder WHERE d_year > 1994",
-    "q2_eq_sum_min_max":
-        "SELECT SUM(lo_revenue), MIN(lo_revenue), MAX(lo_revenue) "
-        "FROM lineorder WHERE c_region = 'ASIA'",
-    "q3_range_in_conj":
-        "SELECT COUNT(*) FROM lineorder WHERE d_year BETWEEN 1993 AND "
-        "1996 AND s_nation IN ('CHINA', 'INDIA', 'JAPAN') AND "
-        "lo_discount <= 5",
-    "q4_group_by_2d":
-        "SELECT SUM(lo_revenue) FROM lineorder WHERE lo_quantity < 25 "
-        "GROUP BY d_year, c_region TOP 1000",
+# ---------------------------------------------------------------------------
+# The 13 SSB queries, flattened-lineorder PQL
+# ---------------------------------------------------------------------------
+
+SSB_PQLS = {
+    "q1.1": "SELECT SUM(lo_revenue) FROM lineorder WHERE d_year = 1993 AND "
+            "lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25",
+    "q1.2": "SELECT SUM(lo_revenue) FROM lineorder WHERE d_yearmonthnum = "
+            "199401 AND lo_discount BETWEEN 4 AND 6 AND lo_quantity "
+            "BETWEEN 26 AND 35",
+    "q1.3": "SELECT SUM(lo_revenue) FROM lineorder WHERE d_weeknuminyear = "
+            "6 AND d_year = 1994 AND lo_discount BETWEEN 5 AND 7 AND "
+            "lo_quantity BETWEEN 26 AND 35",
+    "q2.1": "SELECT SUM(lo_revenue) FROM lineorder WHERE p_category = "
+            "'MFGR#12' AND s_region = 'AMERICA' GROUP BY d_year, p_brand1 "
+            "TOP 10000",
+    "q2.2": "SELECT SUM(lo_revenue) FROM lineorder WHERE p_brand1 BETWEEN "
+            "'MFGR#2221' AND 'MFGR#2228' AND s_region = 'ASIA' GROUP BY "
+            "d_year, p_brand1 TOP 10000",
+    "q2.3": "SELECT SUM(lo_revenue) FROM lineorder WHERE p_brand1 = "
+            "'MFGR#2221' AND s_region = 'EUROPE' GROUP BY d_year, p_brand1 "
+            "TOP 10000",
+    "q3.1": "SELECT SUM(lo_revenue) FROM lineorder WHERE c_region = 'ASIA' "
+            "AND s_region = 'ASIA' AND d_year BETWEEN 1992 AND 1997 GROUP "
+            "BY c_nation, s_nation, d_year TOP 10000",
+    # c_city × s_city × d_year spans 437k potential groups — past the
+    # default numGroupsLimit; the per-query option (reference parity)
+    # routes these to the scatter group path instead of the host
+    "q3.2": "SELECT SUM(lo_revenue) FROM lineorder WHERE c_nation = "
+            "'UNITED STATES' AND s_nation = 'UNITED STATES' AND d_year "
+            "BETWEEN 1992 AND 1997 GROUP BY c_city, s_city, d_year "
+            "TOP 10000 OPTION(numGroupsLimit=4194304)",
+    "q3.3": "SELECT SUM(lo_revenue) FROM lineorder WHERE c_city IN "
+            "('UNITED KI1', 'UNITED KI5') AND s_city IN ('UNITED KI1', "
+            "'UNITED KI5') AND d_year BETWEEN 1992 AND 1997 GROUP BY "
+            "c_city, s_city, d_year TOP 10000 "
+            "OPTION(numGroupsLimit=4194304)",
+    "q3.4": "SELECT SUM(lo_revenue) FROM lineorder WHERE c_city IN "
+            "('UNITED KI1', 'UNITED KI5') AND s_city IN ('UNITED KI1', "
+            "'UNITED KI5') AND d_yearmonth = 'Dec1997' GROUP BY c_city, "
+            "s_city, d_year TOP 10000 OPTION(numGroupsLimit=4194304)",
+    "q4.1": "SELECT SUM(lo_revenue), SUM(lo_supplycost) FROM lineorder "
+            "WHERE c_region = 'AMERICA' AND s_region = 'AMERICA' AND "
+            "p_mfgr IN ('MFGR#1', 'MFGR#2') GROUP BY d_year, c_nation "
+            "TOP 10000",
+    "q4.2": "SELECT SUM(lo_revenue), SUM(lo_supplycost) FROM lineorder "
+            "WHERE c_region = 'AMERICA' AND s_region = 'AMERICA' AND "
+            "d_year IN (1997, 1998) AND p_mfgr IN ('MFGR#1', 'MFGR#2') "
+            "GROUP BY d_year, s_nation, p_category TOP 10000",
+    "q4.3": "SELECT SUM(lo_revenue), SUM(lo_supplycost) FROM lineorder "
+            "WHERE c_region = 'AMERICA' AND s_nation = 'UNITED STATES' "
+            "AND d_year IN (1997, 1998) AND p_category = 'MFGR#14' GROUP "
+            "BY d_year, s_city, p_brand1 TOP 10000 "
+            "OPTION(numGroupsLimit=4194304)",
 }
 
 
-def make_cpu_queries(pools, ids):
-    """The same queries as vectorized numpy id-domain columnar scans."""
-    rev_vals = pools["lo_revenue"].astype(np.float64)
-    y94 = int(np.searchsorted(pools["d_year"], 1994, side="right"))
-    y93 = int(np.searchsorted(pools["d_year"], 1993))
-    y96 = int(np.searchsorted(pools["d_year"], 1996, side="right"))
-    d5 = int(np.searchsorted(pools["lo_discount"], 5, side="right"))
-    q25 = int(np.searchsorted(pools["lo_quantity"], 25))
+# ---------------------------------------------------------------------------
+# CPU baseline + oracle: vectorized numpy over id-domain columns
+# ---------------------------------------------------------------------------
 
-    def idq(col, value):
+
+def make_cpu_queries(pools, ids, supplycost):
+    """name → fn; scalar queries return float, group queries return
+    {(decoded key strings...): (sum_revenue[, sum_supplycost])}."""
+    rev_vals = pools["lo_revenue"].astype(np.float64)
+
+    def vid(col, value):
         i = int(np.searchsorted(pools[col], value))
-        assert pools[col][i] == value
+        assert str(pools[col][i]) == str(value), (col, value)
         return i
 
-    asia = idq("c_region", "ASIA")
-    nations = np.array([idq("s_nation", n)
-                        for n in ("CHINA", "INDIA", "JAPAN")], np.int32)
+    def vids(col, values):
+        return np.array([vid(col, v) for v in values], np.int32)
 
-    def q1():
-        return int((ids["d_year"] >= y94).sum())
+    def rng_ids(col, lo, hi):
+        """[lo, hi] inclusive value range → [lo_id, hi_id) id interval."""
+        a = int(np.searchsorted(pools[col], lo, side="left"))
+        b = int(np.searchsorted(pools[col], hi, side="right"))
+        return a, b
 
-    def q2():
-        m = ids["c_region"] == asia
-        h = np.bincount(ids["lo_revenue"][m], minlength=len(rev_vals))
-        nz = np.nonzero(h)[0]
-        return (float(h @ rev_vals), float(rev_vals[nz[0]]),
-                float(rev_vals[nz[-1]]))
+    def revenue_sum(mask):
+        h = np.bincount(ids["lo_revenue"][mask],
+                        minlength=len(rev_vals))
+        return float(h @ rev_vals)
 
-    def q3():
-        m = (ids["d_year"] >= y93) & (ids["d_year"] < y96) & \
-            np.isin(ids["s_nation"], nations) & (ids["lo_discount"] < d5)
-        return int(m.sum())
+    def group(mask, gcols, with_cost):
+        key = np.zeros(int(mask.sum()), np.int64)
+        cards = []
+        for c in gcols:
+            card = len(pools[c])
+            key = key * card + ids[c][mask]
+            cards.append(card)
+        n_groups = int(np.prod([len(pools[c]) for c in gcols]))
+        rev = np.bincount(key, weights=rev_vals[ids["lo_revenue"][mask]],
+                          minlength=n_groups)
+        cost = np.bincount(key, weights=supplycost[mask],
+                           minlength=n_groups) if with_cost else None
+        nz = np.nonzero(np.bincount(key, minlength=n_groups))[0]
+        out = {}
+        for gi in nz:
+            rem, parts = int(gi), []
+            for c in reversed(gcols):
+                card = len(pools[c])
+                parts.append(str(pools[c][rem % card]))
+                rem //= card
+            k = tuple(reversed(parts))
+            out[k] = (float(rev[gi]),) + (
+                (float(cost[gi]),) if with_cost else ())
+        return out
 
-    def q4():
-        m = ids["lo_quantity"] < q25
-        key = ids["d_year"][m].astype(np.int64) * len(pools["c_region"]) + \
-            ids["c_region"][m]
-        n_groups = len(pools["d_year"]) * len(pools["c_region"])
-        sums = np.zeros(n_groups)
-        np.add.at(sums, key, rev_vals[ids["lo_revenue"][m]])
-        return sums
+    y = ids["d_year"]
+    disc = ids["lo_discount"]
+    qty = ids["lo_quantity"]
 
-    return {"q1_range_count": q1, "q2_eq_sum_min_max": q2,
-            "q3_range_in_conj": q3, "q4_group_by_2d": q4}
+    # Scalar dictionary lookups (value → id bound) are precomputed — that
+    # is O(log card) planner work. The ROW-SCALE filter evaluation happens
+    # inside each timed closure, like it does on the device side.
+    d1, d3 = rng_ids("lo_discount", 1, 3)
+    d4, d6 = rng_ids("lo_discount", 4, 6)
+    d5, d7 = rng_ids("lo_discount", 5, 7)
+    q25 = vid("lo_quantity", 25)
+    q26, q35 = rng_ids("lo_quantity", 26, 35)
+    y93 = vid("d_year", 1993)
+    y94 = vid("d_year", 1994)
+    y92, y97 = rng_ids("d_year", 1992, 1997)
+    ym9401 = vid("d_yearmonthnum", 199401)
+    wk6 = vid("d_weeknuminyear", 6)
+    b21, b28 = rng_ids("p_brand1", "MFGR#2221", "MFGR#2228")
+    us = vid("c_nation", "UNITED STATES")
+    ki = vids("c_city", ["UNITED KI1", "UNITED KI5"])
+    mf12 = vids("p_mfgr", ["MFGR#1", "MFGR#2"])
+    y9798 = vids("d_year", [1997, 1998])
+
+    mask_fns = {
+        "q1.1": lambda: (y == y93) & (disc >= d1) & (disc < d3) &
+                        (qty < q25),
+        "q1.2": lambda: (ids["d_yearmonthnum"] == ym9401) &
+                        (disc >= d4) & (disc < d6) &
+                        (qty >= q26) & (qty < q35),
+        "q1.3": lambda: (ids["d_weeknuminyear"] == wk6) & (y == y94) &
+                        (disc >= d5) & (disc < d7) &
+                        (qty >= q26) & (qty < q35),
+        "q2.1": lambda: (ids["p_category"] == vid("p_category",
+                                                  "MFGR#12")) &
+                        (ids["s_region"] == vid("s_region", "AMERICA")),
+        "q2.2": lambda: (ids["p_brand1"] >= b21) &
+                        (ids["p_brand1"] < b28) &
+                        (ids["s_region"] == vid("s_region", "ASIA")),
+        "q2.3": lambda: (ids["p_brand1"] == vid("p_brand1",
+                                                "MFGR#2221")) &
+                        (ids["s_region"] == vid("s_region", "EUROPE")),
+        "q3.1": lambda: (ids["c_region"] == vid("c_region", "ASIA")) &
+                        (ids["s_region"] == vid("s_region", "ASIA")) &
+                        (y >= y92) & (y < y97),
+        "q3.2": lambda: (ids["c_nation"] == us) &
+                        (ids["s_nation"] == us) & (y >= y92) & (y < y97),
+        "q3.3": lambda: np.isin(ids["c_city"], ki) &
+                        np.isin(ids["s_city"], ki) &
+                        (y >= y92) & (y < y97),
+        "q3.4": lambda: np.isin(ids["c_city"], ki) &
+                        np.isin(ids["s_city"], ki) &
+                        (ids["d_yearmonth"] == vid("d_yearmonth",
+                                                   "Dec1997")),
+        "q4.1": lambda: (ids["c_region"] == vid("c_region", "AMERICA")) &
+                        (ids["s_region"] == vid("s_region", "AMERICA")) &
+                        np.isin(ids["p_mfgr"], mf12),
+        "q4.2": lambda: (ids["c_region"] == vid("c_region", "AMERICA")) &
+                        (ids["s_region"] == vid("s_region", "AMERICA")) &
+                        np.isin(ids["p_mfgr"], mf12) & np.isin(y, y9798),
+        "q4.3": lambda: (ids["c_region"] == vid("c_region", "AMERICA")) &
+                        (ids["s_nation"] == us) & np.isin(y, y9798) &
+                        (ids["p_category"] == vid("p_category",
+                                                  "MFGR#14")),
+    }
+
+    fns = {}
+    for q in ("q1.1", "q1.2", "q1.3"):
+        fns[q] = (lambda mf: (lambda: revenue_sum(mf())))(mask_fns[q])
+    for q, gcols in (("q2.1", ["d_year", "p_brand1"]),
+                     ("q2.2", ["d_year", "p_brand1"]),
+                     ("q2.3", ["d_year", "p_brand1"]),
+                     ("q3.1", ["c_nation", "s_nation", "d_year"]),
+                     ("q3.2", ["c_city", "s_city", "d_year"]),
+                     ("q3.3", ["c_city", "s_city", "d_year"]),
+                     ("q3.4", ["c_city", "s_city", "d_year"])):
+        fns[q] = (lambda mf, gc: (lambda: group(mf(), gc, False)))(
+            mask_fns[q], gcols)
+    for q, gcols in (("q4.1", ["d_year", "c_nation"]),
+                     ("q4.2", ["d_year", "s_nation", "p_category"]),
+                     ("q4.3", ["d_year", "s_city", "p_brand1"])):
+        fns[q] = (lambda mf, gc: (lambda: group(mf(), gc, True)))(
+            mask_fns[q], gcols)
+    return fns
 
 
-def correctness_gate(engine, pools, cpu) -> None:
-    """Engine answers (full path) must equal numpy on the same table."""
-    resp = engine.query(PQLS["q1_range_count"])
-    assert resp.aggregation_results[0].value == str(cpu["q1_range_count"]()),\
-        "q1 mismatch"
-    resp = engine.query(PQLS["q2_eq_sum_min_max"])
-    s, mn, mx = cpu["q2_eq_sum_min_max"]()
-    assert abs(float(resp.aggregation_results[0].value) - s) <= 1e-6 * s, \
-        "q2 sum mismatch"
-    assert float(resp.aggregation_results[1].value) == mn, "q2 min mismatch"
-    assert float(resp.aggregation_results[2].value) == mx, "q2 max mismatch"
-    resp = engine.query(PQLS["q3_range_in_conj"])
-    assert resp.aggregation_results[0].value == str(cpu["q3_range_in_conj"]()
-                                                    ), "q3 mismatch"
-    resp = engine.query(PQLS["q4_group_by_2d"])
-    sums = cpu["q4_group_by_2d"]()
-    got = {tuple(str(x) for x in g["group"]): float(g["value"])
-           for g in resp.aggregation_results[0].group_by_result}
-    for gi, v in enumerate(sums):
-        if v == 0:
+def canon_response(name: str, resp):
+    """BrokerResponse → the CPU functions' canonical result shape."""
+    if name.startswith("q1"):
+        v = resp.aggregation_results[0].value
+        return 0.0 if v == "null" else float(v)
+    n_aggs = len(resp.aggregation_results)
+    out = {}
+    for ai in range(n_aggs):
+        for g in resp.aggregation_results[ai].group_by_result:
+            k = tuple(str(x) for x in g["group"])
+            out.setdefault(k, [0.0] * n_aggs)[ai] = float(g["value"])
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def check(name: str, got, exp) -> None:
+    if name.startswith("q1"):
+        assert abs(got - exp) <= max(1e-6 * abs(exp), 1e-6), \
+            f"{name}: {got} != {exp}"
+        return
+    assert set(got) == set(exp), \
+        f"{name}: group keys differ ({len(got)} vs {len(exp)}); " \
+        f"e.g. {list(set(exp) - set(got))[:3]} missing"
+    for k, ev in exp.items():
+        gv = got[k]
+        # dense group paths (psums) are exact; past DENSE_G_LIMIT the
+        # scatter path accumulates in device f32 (~1e-5 rel at this scale),
+        # as does the supplycost carry — tolerance covers both
+        assert abs(gv[0] - ev[0]) <= max(1e-4 * abs(ev[0]), 1e-6), \
+            f"{name} {k}: revenue {gv[0]} != {ev[0]}"
+        if len(ev) > 1:
+            assert abs(gv[1] - ev[1]) <= max(2e-4 * abs(ev[1]), 1e-3), \
+                f"{name} {k}: supplycost {gv[1]} != {ev[1]}"
+
+
+# ---------------------------------------------------------------------------
+
+
+def time_cpu(fn, reps: int):
+    ts = []
+    for _ in range(max(3, reps)):
+        t = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t)
+    return median(ts)
+
+
+def measure_rtt(sample) -> float:
+    """Harness relay round-trip (dispatch + sync of a trivial program)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x.reshape(-1)[0])
+    jax.device_get(fn(sample))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(fn(sample))
+        ts.append(time.perf_counter() - t0)
+    return median(ts)
+
+
+def bench_queries(mesh, stack, cpu, reps, rows, stage: str):
+    """Device timing: N kernel executions inside ONE dispatch (lax.scan over
+    a runtime-zero perturbation so XLA cannot hoist the body), minus the
+    measured relay round-trip, plus the measured host finish. This is the
+    steady-state per-query cost; per-dispatch timing through the harness
+    relay (~80ms sync RTT, ~5ms per queued dispatch) measures the relay,
+    not the engine."""
+    import jax
+    import jax.numpy as jnp
+
+    from pinot_tpu.parallel.sharded import get_sharded_kernel
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.pql.optimizer import BrokerRequestOptimizer
+    from pinot_tpu.query import execution
+    from pinot_tpu.query.blocks import IntermediateResultsBlock
+    from pinot_tpu.query.plan import (InstancePlanMaker,
+                                      run_with_group_escalation,
+                                      set_group_kmax)
+
+    plan_maker = InstancePlanMaker()
+    optimizer = BrokerRequestOptimizer()
+    n_exec = 16
+    per_query = {}
+    speedups = []
+    rtt = None
+    for name, pql in SSB_PQLS.items():
+        request = optimizer.optimize(compile_pql(pql))
+        plan = plan_maker.make_segment_plan(stack.segments[0], request)
+        if plan.fast_path_result is not None:
+            # star-tree cube (or metadata) answer: O(groups) host work —
+            # time the full sequential executor over every segment
+            from pinot_tpu.query.executor import ServerQueryExecutor
+            ex = ServerQueryExecutor()
+            samples = []
+            for _ in range(max(3, reps)):
+                t0 = time.perf_counter()
+                ex.execute(request, stack.segments)
+                samples.append(time.perf_counter() - t0)
+            d50 = median(samples)
+            d99 = float(np.percentile(samples, 99))
+            c = time_cpu(cpu[name], reps)
+            speedups.append(c / d50)
+            per_query[name] = {
+                "device_p50_ms": round(d50 * 1e3, 3),
+                "device_p99_ms": round(d99 * 1e3, 3),
+                "cpu_p50_ms": round(c * 1e3, 3),
+                "speedup": round(c / d50, 2),
+                "rows_per_s_per_chip": round(rows / d50),
+                "path": "star-tree",
+            }
+            log(f"bench[{stage}] {name}: star-tree p50 {d50 * 1e3:.3f}ms, "
+                f"cpu {c * 1e3:.2f}ms, speedup {c / d50:.1f}x")
             continue
-        yi, ri = divmod(gi, len(pools["c_region"]))
-        key = (str(pools["d_year"][yi]), str(pools["c_region"][ri]))
-        assert abs(got[key] - v) <= 1e-9 * abs(v), f"q4 mismatch at {key}"
+        cols = stack.gather(plan.needed_cols)
+        nd = stack.device_num_docs()
+        if rtt is None:
+            rtt = measure_rtt(nd)
+            log(f"bench[{stage}] relay RTT {rtt * 1e3:.1f}ms "
+                f"(subtracted from scan-of-{n_exec} totals)")
+        lane_keys = tuple(sorted(cols.keys()))
+        group_spec = plan.group_spec
+        if group_spec is not None:
+            # the plan may come from a small template segment; size the
+            # compaction to the lanes actually executed
+            group_spec = set_group_kmax(group_spec, stack.padded_docs)
+
+        def run(spec):
+            nonlocal group_spec, fn
+            group_spec = spec
+            fn = get_sharded_kernel(mesh, stack.padded_docs,
+                                    plan.filter_spec,
+                                    tuple(plan.agg_specs or ()), spec,
+                                    plan.select_spec, lane_keys)
+            return jax.device_get(fn(cols, tuple(plan.params), nd))
+
+        fn = None
+        outs_h, group_spec = run_with_group_escalation(
+            run, group_spec, stack.padded_docs)
+
+        # host finish (group decode / reduce): median of 3 (first call pays
+        # one-time numpy/cache effects)
+        finish_ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            blk = IntermediateResultsBlock()
+            if plan.group_spec is not None:
+                execution._finish_group_by(plan, outs_h, blk)
+            else:
+                execution._finish_aggregation(plan, outs_h, blk)
+            finish_ts.append(time.perf_counter() - t0)
+        finish_s = median(finish_ts)
+
+        params = tuple(plan.params)
+        zs = jnp.zeros(n_exec, jnp.int32)
+
+        @jax.jit
+        def timed(cols, params, nd, zs, fn=fn):
+            def body(c, z):
+                o = fn(cols, params, nd + z)   # z == 0, but only at runtime
+                s = jnp.float32(0)
+                for v in o.values():
+                    s = s + v.astype(jnp.float32).sum()
+                return c + s, None
+            out, _ = jax.lax.scan(body, jnp.float32(0), zs)
+            return out
+
+        jax.device_get(timed(cols, params, nd, zs))    # compile
+        samples = []
+        for _ in range(max(3, reps)):
+            t0 = time.perf_counter()
+            jax.device_get(timed(cols, params, nd, zs))
+            total = time.perf_counter() - t0
+            samples.append(max(total - rtt, 1e-5) / n_exec + finish_s)
+        d50, d99 = median(samples), float(np.percentile(samples, 99))
+        c = time_cpu(cpu[name], reps)
+        speedups.append(c / d50)
+        per_query[name] = {
+            "device_p50_ms": round(d50 * 1e3, 3),
+            "device_p99_ms": round(d99 * 1e3, 3),
+            "cpu_p50_ms": round(c * 1e3, 3),
+            "speedup": round(c / d50, 2),
+            "rows_per_s_per_chip": round(rows / d50),
+        }
+        log(f"bench[{stage}] {name}: device p50 {d50 * 1e3:.3f}ms "
+            f"(finish {finish_s * 1e3:.2f}ms), cpu {c * 1e3:.2f}ms, "
+            f"speedup {c / d50:.1f}x, {rows / d50 / 1e9:.2f}B rows/s/chip")
+    return per_query, speedups
 
 
 def main() -> None:
-    rows = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 100_000_000))
+    store_rows = int(os.environ.get("PINOT_TPU_BENCH_STORE_ROWS",
+                                    16_000_000))
+    big_rows = int(os.environ.get("PINOT_TPU_BENCH_ROWS", 100_000_000))
     n_segs = int(os.environ.get("PINOT_TPU_BENCH_SEGMENTS", 8))
     reps = int(os.environ.get("PINOT_TPU_BENCH_REPS", 5))
+    skip_big = os.environ.get("PINOT_TPU_BENCH_SKIP_BIG", "0") == "1"
 
     import jax
 
     from pinot_tpu.engine import QueryEngine
     from pinot_tpu.parallel import make_mesh
-    from pinot_tpu.parallel.sharded import get_sharded_kernel
-    from pinot_tpu.pql.parser import compile_pql
-    from pinot_tpu.tools.datagen import (make_ssb_device_stack,
-                                         make_ssb_segments, ssb_pools)
-    from pinot_tpu.query.plan import InstancePlanMaker
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.tools.datagen import (build_ssb_segment_dirs,
+                                         make_ssb_ids, ssb_pools)
 
     mesh = make_mesh()
-    log(f"bench: {rows} rows, {n_segs} segments, devices={jax.devices()}")
+    log(f"bench: devices={jax.devices()}")
 
-    # 1. correctness gate (small, full path incl. HBM upload)
-    gate_rows = min(rows, 2_000_000)
-    gate = make_ssb_segments(gate_rows, n_segs, seed=3)
-    engine = QueryEngine(gate.segments, mesh=mesh)
-    gate_cpu = make_cpu_queries(gate.pools, gate.ids)
-    correctness_gate(engine, gate.pools, gate_cpu)
-    log(f"bench: correctness gate passed at {gate_rows} rows "
-        "(device == numpy, full engine path)")
-
-    # 2. throughput at full size
-    t0 = time.perf_counter()
-    lanes, num_docs_dev, plan_table, padded = make_ssb_device_stack(
-        rows, n_segs, mesh, seed=3)
-    jax.block_until_ready(list(lanes.values()))
-    log(f"bench: device lanes synthesized in {time.perf_counter() - t0:.1f}s"
-        f" (padded {padded}/segment)")
-
+    # ---- stage 1: the framework's own storage path -----------------------
     pools = ssb_pools(3)
     t0 = time.perf_counter()
-    rng = np.random.default_rng(3)
-    host_ids = {c: rng.integers(0, len(p), rows).astype(np.int32)
-                for c, p in pools.items() if c in
-                ("d_year", "c_region", "s_nation", "lo_discount",
-                 "lo_quantity", "lo_revenue")}
-    log(f"bench: host baseline table in {time.perf_counter() - t0:.1f}s")
-    cpu = make_cpu_queries(pools, host_ids)
-
-    plan_maker = InstancePlanMaker()
-    plan_seg = plan_table.segments[0]
-    pipeline_n = max(4 * reps, 20)
-    speedups = []
-    for name, pql in PQLS.items():
-        request = compile_pql(pql)
-        plan = plan_maker.make_segment_plan(plan_seg, request)
-        cols = {}
-        for col, kind in plan.needed_cols:
-            key = {"ids": f"{col}.ids", "parts": f"{col}.parts",
-                   "raw": f"{col}.raw", "vlane": f"{col}.vlane",
-                   "vals": f"{col}.vals"}[kind]
-            cols[key] = lanes[key]
-        fn = get_sharded_kernel(mesh, padded, plan.filter_spec,
-                                tuple(plan.agg_specs or ()), plan.group_spec,
-                                plan.select_spec, tuple(sorted(cols.keys())))
-        args = (cols, tuple(plan.params), num_docs_dev)
-        jax.device_get(fn(*args))              # compile + 1 RTT
+    star_tree = os.environ.get("PINOT_TPU_BENCH_STARTREE", "1") == "1"
+    with tempfile.TemporaryDirectory() as base:
+        dirs, ids, supplycost = build_ssb_segment_dirs(
+            base, store_rows, n_segs, seed=3, log=log, star_tree=star_tree)
+        if star_tree:
+            log("bench: segments built WITH star-tree cubes (the "
+                "reference benchmark's star-tree segment variant); "
+                "PINOT_TPU_BENCH_STARTREE=0 disables")
+        log(f"bench: {store_rows} rows built via SegmentCreator in "
+            f"{time.perf_counter() - t0:.1f}s")
         t0 = time.perf_counter()
-        outs = None
-        for _ in range(pipeline_n):
-            outs = fn(*args)
-        jax.device_get(outs["stats.num_docs_matched"])
-        d = (time.perf_counter() - t0) / pipeline_n
+        segments = [ImmutableSegmentLoader.load(d) for d in dirs]
+        log(f"bench: loaded via ImmutableSegmentLoader in "
+            f"{time.perf_counter() - t0:.1f}s")
 
-        cpu_times = []
-        for _ in range(max(3, reps // 2)):
-            t = time.perf_counter()
-            cpu[name]()
-            cpu_times.append(time.perf_counter() - t)
-        c = median(cpu_times)
-        speedups.append(c / d)
-        log(f"bench: {name}: device {d * 1e3:.2f}ms/query (pipelined x"
-            f"{pipeline_n}), cpu p50 {c * 1e3:.2f}ms, speedup {c / d:.2f}x, "
-            f"{rows / d / 1e9:.1f}B rows/s")
+        cpu = make_cpu_queries(pools, ids, supplycost)
+        engine = QueryEngine(segments, mesh=mesh)
+        t0 = time.perf_counter()
+        for name, pql in SSB_PQLS.items():
+            check(name, canon_response(name, engine.query(pql)),
+                  cpu[name]())
+        log(f"bench: all 13 SSB queries match the numpy oracle through the "
+            f"full engine path ({time.perf_counter() - t0:.1f}s incl. HBM "
+            "upload of loaded lanes)")
 
-    p50 = median(speedups)
-    print(json.dumps({
-        "metric": "ssb_p50_query_speedup_vs_cpu_numpy",
+        # reuse the engine's already-uploaded stack — a fresh
+        # StackedSegments would push every lane through the relay again
+        store_pq, store_speedups = bench_queries(
+            mesh, engine.sharded.stack_for(segments), cpu, reps,
+            store_rows, "storage")
+        # release stage-1 HBM before the 100M-row synth stage
+        del engine
+        for s in segments:
+            s.destroy()
+        del segments, cpu
+        import gc
+        gc.collect()
+
+    p50 = median(store_speedups)
+    result = {
+        "metric": "ssb13_storage_path_p50_speedup_vs_cpu",
         "value": round(p50, 3),
         "unit": "x",
         "vs_baseline": round(p50 / 8.0, 4),
-    }))
+        "storage_rows": store_rows,
+        "min_query_speedup": round(min(store_speedups), 2),
+        "per_query": store_pq,
+    }
+
+    # ---- stage 2: reference-scale synth table ----------------------------
+    if not skip_big:
+        from pinot_tpu.tools.datagen import make_ssb_device_stack
+
+        t0 = time.perf_counter()
+        lanes, num_docs_dev, plan_table, padded = make_ssb_device_stack(
+            big_rows, n_segs, mesh, seed=3)
+        jax.block_until_ready(list(lanes.values()))
+        log(f"bench[big]: {big_rows} rows synthesized in HBM in "
+            f"{time.perf_counter() - t0:.1f}s (upload workaround: the "
+            "~3MB/s harness relay cannot carry the table; the storage "
+            "path is exercised and timed in stage 1)")
+        t0 = time.perf_counter()
+        # same seed as the device stack: big_ids index the same value
+        # pools make_cpu_queries receives (a different seed would build a
+        # different-sized lo_revenue pool and misalign the id domain)
+        big_ids, big_cost = make_ssb_ids(big_rows, seed=3)
+        log(f"bench[big]: host baseline table in "
+            f"{time.perf_counter() - t0:.1f}s")
+        big_cpu = make_cpu_queries(pools, big_ids, big_cost)
+
+        # lane-override stack: plans build against the small plan_table
+        # segment (same dictionaries); lanes are the HBM-synthesized ones
+        class _SynthStack:
+            padded_docs = padded
+            segments = plan_table.segments
+
+            def gather(self, needed_cols):
+                import jax.numpy as jnp
+                out = {}
+                for col, kind in needed_cols:
+                    key = f"{col}.{kind}"
+                    if key not in lanes and kind == "vals":
+                        # replicated dictionary value table (tiny)
+                        lanes[key] = jnp.asarray(
+                            plan_table.segments[0].data_source(col)
+                            .host_operand("vals"))
+                    out[key] = lanes[key]
+                return out
+
+            def device_num_docs(self):
+                return num_docs_dev
+
+        big_pq, big_speedups = bench_queries(
+            mesh, _SynthStack(), big_cpu, reps, big_rows, "big")
+        result["big_synth"] = {
+            "rows": big_rows,
+            "p50_speedup": round(median(big_speedups), 3),
+            "min_query_speedup": round(min(big_speedups), 2),
+            "per_query": big_pq,
+        }
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -99,6 +99,18 @@ def get_sharded_kernel(mesh: Mesh, padded: int, filter_spec, agg_specs,
             per_seg, SEG_AXIS).reshape(-1)
         for k, v in outs.items():
             kind = _combine_kind(k)
+            if k.endswith(".cpsums"):
+                # compacted int part sums: a straight int32 psum could
+                # overflow past ~16.9M matched rows in one group, so split
+                # each segment's table into 16-bit halves (each half's
+                # cross-segment sum stays far inside int32) and let the
+                # host recombine in int64
+                flat = v.reshape((-1,) + v.shape[-2:])  # [S(*chunks), P, G]
+                lo = (flat & 0xFFFF).sum(axis=0)
+                hi = ((flat >> 16) & 0xFFFF).sum(axis=0)
+                combined[f"{k}.lo"] = jax.lax.psum(lo, SEG_AXIS)
+                combined[f"{k}.hi"] = jax.lax.psum(hi, SEG_AXIS)
+                continue
             if kind == "sum":
                 combined[k] = jax.lax.psum(v.sum(axis=0), SEG_AXIS)
             elif kind == "min":
@@ -206,13 +218,9 @@ class StackedSegments:
         return out
 
     def gather(self, needed_cols) -> Dict[str, object]:
-        cols = {}
-        for col, kind in needed_cols:
-            cols[{"ids": f"{col}.ids", "vals": f"{col}.vals",
-                  "raw": f"{col}.raw", "mv": f"{col}.mv",
-                  "parts": f"{col}.parts", "vlane": f"{col}.vlane"}[kind]] = \
-                self.lane(col, kind)
-        return cols
+        # lane keys are "<col>.<kind>" — the same names the kernels read
+        return {f"{col}.{kind}": self.lane(col, kind)
+                for col, kind in needed_cols}
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +306,22 @@ class ShardedQueryExecutor:
             if seg0.has_column(col) and \
                     seg0.data_source(col).metadata.has_dictionary:
                 stack._check_shared_dictionary(col)
+        if request.is_group_by:
+            # raw group keys bin by segment 0's min/max — every segment
+            # must share that range or rows would clip into wrong bins
+            for col in request.group_by.columns:
+                if not seg0.has_column(col):
+                    continue
+                cm0 = seg0.data_source(col).metadata
+                if cm0.has_dictionary:
+                    continue
+                for s in stack.segments[1:]:
+                    cm = s.data_source(col).metadata
+                    if (cm.min_value, cm.max_value) != (cm0.min_value,
+                                                        cm0.max_value):
+                        raise NotShardable(
+                            f"raw group column '{col}' min/max differ "
+                            "across segments")
         plan = self.plan_maker.make_segment_plan(seg0, request)
         if plan.fast_path_result is not None:
             # metadata fast paths are per-segment host work; take the
@@ -305,12 +329,19 @@ class ShardedQueryExecutor:
             raise NotShardable("fast-path plan; no device work to shard")
 
         cols = stack.gather(plan.needed_cols)
-        fn = get_sharded_kernel(self.mesh, stack.padded_docs,
-                                plan.filter_spec, tuple(plan.agg_specs or ()),
-                                plan.group_spec, plan.select_spec,
-                                tuple(sorted(cols.keys())))
-        outs = jax.device_get(fn(cols, tuple(plan.params),
-                                 stack.device_num_docs()))
+        lane_keys = tuple(sorted(cols.keys()))
+
+        def run(group_spec):
+            fn = get_sharded_kernel(
+                self.mesh, stack.padded_docs, plan.filter_spec,
+                tuple(plan.agg_specs or ()), group_spec, plan.select_spec,
+                lane_keys)
+            return jax.device_get(fn(cols, tuple(plan.params),
+                                     stack.device_num_docs()))
+
+        from pinot_tpu.query.plan import run_with_group_escalation
+        outs, _ = run_with_group_escalation(run, plan.group_spec,
+                                            stack.padded_docs)
 
         blk = IntermediateResultsBlock()
         matched = int(outs["stats.num_docs_matched"])

@@ -56,11 +56,16 @@ def execute_segment_plan(plan) -> IntermediateResultsBlock:
     segment = plan.segment
     t0 = time.perf_counter()
     cols = gather_operands(plan)
-    outs = kernels.run_segment_kernel(
-        segment.padded_docs, plan.filter_spec, plan.agg_specs,
-        plan.group_spec, plan.select_spec, cols, plan.params,
-        segment.num_docs)
-    outs = jax.device_get(outs)
+    from pinot_tpu.query.plan import run_with_group_escalation
+
+    def run(group_spec):
+        return jax.device_get(kernels.run_segment_kernel(
+            segment.padded_docs, plan.filter_spec, plan.agg_specs,
+            group_spec, plan.select_spec, cols, plan.params,
+            segment.num_docs))
+
+    outs, _ = run_with_group_escalation(run, plan.group_spec,
+                                        segment.padded_docs)
 
     blk = IntermediateResultsBlock()
     matched = int(outs["stats.num_docs_matched"])
@@ -157,7 +162,7 @@ def _finish_aggregation(plan, outs, blk) -> None:
 
 
 def _finish_group_by(plan, outs, blk) -> None:
-    gcols, strides, g_pad, agg_specs = plan.group_spec
+    gcols, strides, g_pad, agg_specs, kmax = plan.group_spec
     counts = np.asarray(outs["group.count"])
     nz = np.nonzero(counts)[0]
     cards = [entry[3] for entry in gcols]
@@ -186,24 +191,40 @@ def _finish_group_by(plan, outs, blk) -> None:
         """Exact f64 per-group sums from the device partials."""
         fname, col, source, extra = spec
         strategy = extra[0] if isinstance(extra, tuple) else None
-        if strategy == "psums":
-            arr = np.asarray(outs[f"gagg{i}.psums"])
-            if arr.ndim == 3:                  # sharded: [S, n_parts, G]
+        # all arithmetic below runs on the non-empty groups only — the
+        # full [G] tables can be millions of slots with a handful occupied
+        if strategy == "psums" and f"gagg{i}.cpsums.lo" in outs:
+            # sharded compacted path: 16-bit halves psum'd across segments,
+            # recombined exactly here in int64
+            lo = np.asarray(outs[f"gagg{i}.cpsums.lo"])[:, nz]
+            hi = np.asarray(outs[f"gagg{i}.cpsums.hi"])[:, nz]
+            arr = (hi.astype(np.int64) << 16) + lo.astype(np.int64)
+        elif strategy == "psums" and f"gagg{i}.cpsums" in outs:
+            # compacted path: scatter-combined int32 [n_parts, G], or
+            # [n_chunks, n_parts, G] when kmax exceeded the per-scatter
+            # int32 bound — recombine chunks exactly in int64 here
+            a = np.asarray(outs[f"gagg{i}.cpsums"]).astype(np.int64)
+            if a.ndim == 3:
+                a = a.sum(axis=0)
+            arr = a[:, nz]
+        elif strategy == "psums":
+            arr = np.asarray(outs[f"gagg{i}.psums"])[..., nz]
+            if arr.ndim == 3:                  # sharded: [S, n_parts, nz]
                 arr = arr.astype(np.int64).sum(0)
             arr = arr.astype(np.int64)
-            _, min_v = plan.segment.data_source(col).int_part_info()
-            shifts = np.left_shift(np.int64(1),
-                                   7 * np.arange(arr.shape[0],
-                                                 dtype=np.int64))
-            totals = (arr * shifts[:, None]).sum(0)
-            totals = totals + np.int64(min_v) * counts.astype(np.int64)
-            return totals[nz].astype(np.float64)
-        if strategy == "csums":
-            arr = np.asarray(outs[f"gagg{i}.csums"], dtype=np.float64)
-            if arr.ndim == 2:                  # sharded: [S, G]
-                arr = arr.sum(0)
-            return arr[nz]
-        return np.asarray(outs[f"gagg{i}.sum"])[nz]
+        elif strategy == "csums" and f"gagg{i}.csums" in outs:
+            arr = np.asarray(outs[f"gagg{i}.csums"])[..., nz]
+            if arr.ndim == 2:                  # sharded: [S, nz] — combine
+                arr = arr.sum(0, dtype=np.float64)   # in f64 on host
+            return arr.astype(np.float64)
+        else:
+            return np.asarray(outs[f"gagg{i}.sum"])[nz].astype(np.float64)
+        _, min_v = plan.segment.data_source(col).int_part_info()
+        shifts = np.left_shift(np.int64(1),
+                               7 * np.arange(arr.shape[0], dtype=np.int64))
+        totals = (arr * shifts[:, None]).sum(0)
+        totals = totals + np.int64(min_v) * counts[nz].astype(np.int64)
+        return totals.astype(np.float64)
 
     def _extreme_array(i, spec, which):
         """Per-group min/max as float values (inf sentinels when empty)."""

@@ -40,6 +40,17 @@ class ServerQueryExecutor:
             selected = self.pruner.prune(segments, request)
         num_pruned = len(segments) - len(selected)
 
+        if request.is_aggregation and not request.is_selection and \
+                len(selected) > 1 and \
+                all(getattr(s, "star_trees", None) for s in selected):
+            from pinot_tpu.startree.executor import \
+                try_star_tree_execute_multi
+            blk = try_star_tree_execute_multi(selected, request)
+            if blk is not None:
+                blk.stats.num_segments_pruned = num_pruned
+                blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
+                return blk
+
         blocks: List[IntermediateResultsBlock] = []
         with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
             for seg in selected:

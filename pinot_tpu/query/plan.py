@@ -329,8 +329,10 @@ class InstancePlanMaker:
     (InstancePlanMakerImplV2.java:97).
     """
 
-    def __init__(self, num_groups_limit: int = DEFAULT_NUM_GROUPS_LIMIT):
+    def __init__(self, num_groups_limit: int = DEFAULT_NUM_GROUPS_LIMIT,
+                 allow_group_compaction: bool = True):
         self.num_groups_limit = num_groups_limit
+        self.allow_group_compaction = allow_group_compaction
 
     def make_segment_plan(self, segment: ImmutableSegment,
                           request: BrokerRequest) -> SegmentPlan:
@@ -514,9 +516,15 @@ class InstancePlanMaker:
                 f"group-by on non-dictionary/MV column {c}")
         plan.group_value_tables = tuple(value_tables)
         g = int(np.prod(cards, dtype=np.int64))
-        if g > self.num_groups_limit:
+        # per-query override (parity: the reference's numGroupsLimit query
+        # option, InstancePlanMakerImplV2.java:58 + QueryOptionKey)
+        limit = self.num_groups_limit
+        opt = request.query_options.options.get("numGroupsLimit")
+        if opt is not None:
+            limit = int(opt)
+        if g > limit:
             raise GroupsLimitExceeded(
-                f"{g} potential groups > limit {self.num_groups_limit}")
+                f"{g} potential groups > limit {limit}")
         strides = []
         acc = 1
         for c in reversed(cards):
@@ -524,10 +532,17 @@ class InstancePlanMaker:
             acc *= c
         strides = tuple(reversed(strides))
         g_pad = kernels.pow2_bucket(g)
+        # sort-compaction for filtered group-bys (see kernels.py): start at
+        # ~1.5% of the segment; the executor escalates via the overflow flag
+        kmax = 0
+        if self.allow_group_compaction and plan.filter_spec is not None \
+                and plan.filter_spec != MATCH_ALL:
+            kmax = initial_group_kmax(segment.padded_docs)
         agg_specs = tuple(
-            _agg_device_spec(f, segment, needed, for_group=True, g_pad=g_pad)
+            _agg_device_spec(f, segment, needed, for_group=True, g_pad=g_pad,
+                             compact=bool(kmax))
             for f in plan.functions)
-        plan.group_spec = (tuple(gcols), strides, g_pad, agg_specs)
+        plan.group_spec = (tuple(gcols), strides, g_pad, agg_specs, kmax)
         plan.group_strides = strides
 
     def _plan_selection(self, plan: SegmentPlan, segment: ImmutableSegment,
@@ -591,9 +606,43 @@ class InstancePlanMaker:
             plan.select_spec = ("ordermk", k, tuple(order), tuple(gather))
 
 
+def initial_group_kmax(padded: int) -> int:
+    return min(kernels.pow2_bucket(max(padded // 64, 1024)), padded)
+
+
+def set_group_kmax(group_spec: tuple, padded: int) -> tuple:
+    """Re-derive kmax for a different run-time padded size (a plan built
+    against a small template segment but executed over bigger lanes)."""
+    gcols, strides, g_pad, agg_specs, kmax = group_spec
+    if not kmax:
+        return group_spec
+    return (gcols, strides, g_pad, agg_specs, initial_group_kmax(padded))
+
+
+def escalate_group_kmax(group_spec: tuple, padded: int):
+    """Next rung of the compaction ladder; None when already at full size."""
+    gcols, strides, g_pad, agg_specs, kmax = group_spec
+    if not kmax or kmax >= padded:
+        return None
+    nk = min(kernels.pow2_bucket(kmax * 4), padded)
+    return (gcols, strides, g_pad, agg_specs, nk)
+
+
+def run_with_group_escalation(run, group_spec, padded: int):
+    """run(group_spec) → host outs; re-runs up the kmax ladder while the
+    compacted group kernel reports overflow. Returns (outs, final_spec)."""
+    outs = run(group_spec)
+    while group_spec is not None and \
+            int(np.asarray(outs.get("group.overflow", 0))) > 0:
+        group_spec = escalate_group_kmax(group_spec, padded)
+        assert group_spec is not None, "overflow at full kmax is impossible"
+        outs = run(group_spec)
+    return outs, group_spec
+
+
 def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
                      needed: Dict, for_group: bool = False,
-                     g_pad: int = 0) -> tuple:
+                     g_pad: int = 0, compact: bool = False) -> tuple:
     base = f.info.base
     if base == "COUNT" and not f.info.is_mv:
         return ("count", "*", "none", None)
@@ -640,8 +689,8 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
             raise UnsupportedOnDevice(f"{fname} over no-dictionary column")
         needed[(col, "raw")] = None
         if for_group and fname in ("sum", "avg") and \
-                segment.padded_docs <= kernels.DENSE_ROWS_LIMIT and \
-                g_pad <= kernels.DENSE_G_LIMIT:
+                (compact or (segment.padded_docs <= kernels.DENSE_ROWS_LIMIT
+                             and g_pad <= kernels.DENSE_G_LIMIT)):
             return (fname, col, "raw", ("csums",))
         return (fname, col, "raw", None)
     card_pad = kernels.pow2_bucket(cm.cardinality + 1)
@@ -662,10 +711,10 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
                 raise UnsupportedOnDevice(
                     f"group-by with {fname} aggregation")
             if fname in ("sum", "avg"):
-                if dense_ok and is_int_dict:
+                if (dense_ok or compact) and is_int_dict:
                     needed[(col, "parts")] = None
                     return (fname, col, "sv", ("psums", card_pad))
-                if dense_ok:
+                if dense_ok or compact:
                     needed[(col, "vlane")] = None
                     return (fname, col, "sv", ("csums", card_pad))
                 needed[(col, "ids")] = None

@@ -33,10 +33,16 @@ class SegmentCreator:
     """Builds one immutable segment from records."""
 
     def __init__(self, schema: Schema, table_config: Optional[TableConfig] = None,
-                 segment_name: Optional[str] = None):
+                 segment_name: Optional[str] = None,
+                 fixed_dictionaries: Optional[Dict[str, np.ndarray]] = None):
         self.schema = schema
         self.table_config = table_config or TableConfig(schema.schema_name)
         self.segment_name = segment_name
+        # column → full value domain: build the dictionary over the whole
+        # domain instead of this segment's slice, so segments of one table
+        # share dictionaries (enables the stacked/sharded device path even
+        # when a small slice misses rare values)
+        self.fixed_dictionaries = fixed_dictionaries or {}
 
     # -- input normalization ----------------------------------------------
     def _columnarize(self, rows: Iterable[dict]) -> Dict[str, list]:
@@ -109,7 +115,12 @@ class SegmentCreator:
 
             # -- stats pass + dictionary -----------------------------------
             if field.single_value:
-                dictionary = Dictionary.build(field.data_type, arr)
+                if name in self.fixed_dictionaries:
+                    dictionary = Dictionary.build(
+                        field.data_type,
+                        np.asarray(self.fixed_dictionaries[name]))
+                else:
+                    dictionary = Dictionary.build(field.data_type, arr)
                 ids = dictionary.encode(arr)
                 is_sorted = bool(np.all(ids[:-1] <= ids[1:])) if n > 1 else True
                 total_entries = n

@@ -90,7 +90,10 @@ def _eligible_cube(segment, request: BrokerRequest, functions):
         needed_metrics.add(f.column)
     for cube in cubes:
         if needed_dims <= set(cube.dimensions) and \
-                needed_metrics <= set(cube.metrics):
+                needed_metrics <= set(cube.metrics) and \
+                cube.n_groups * 8 <= segment.num_docs:
+            # the cube must actually compress: scanning a cube nearly as
+            # tall as the segment costs more than the doc-scale kernel
             return cube
     return None
 
@@ -127,6 +130,124 @@ def try_star_tree_execute(segment, request: BrokerRequest
         num_segments_matched=1 if matched_docs else 0,
         total_docs=segment.num_docs)
     return blk
+
+
+def try_star_tree_execute_multi(segments, request: BrokerRequest
+                                ) -> Optional[IntermediateResultsBlock]:
+    """Vectorized cube execution across MANY segments at once.
+
+    The per-segment path emits one group_map dict per segment and merges
+    them entry-by-entry in Python — fine for two segments, dominant cost
+    for many. Here the matched cube rows (decoded group values, counts,
+    stat lanes) from every segment are concatenated and aggregated in one
+    numpy group-by pass. Parity: the combine step of
+    StarTreeAggregationExecutor outputs, done columnar.
+    """
+    if not request.is_aggregation or request.is_selection:
+        return None
+    functions = make_functions(request.aggregations)
+    pairs = []
+    for seg in segments:
+        cube = _eligible_cube(seg, request, functions)
+        if cube is None:
+            return None                   # all segments must be covered
+        pairs.append((seg, cube))
+
+    from pinot_tpu.query import host_exec
+    gcols = list(request.group_by.columns) if request.group_by else []
+    val_chunks: List[List[np.ndarray]] = [[] for _ in gcols]
+    cnt_chunks: List[np.ndarray] = []
+    stat_chunks: Dict[str, List[np.ndarray]] = {}
+    total_docs = 0
+    matched_groups = 0
+    scanned = 0
+    for seg, cube in pairs:
+        total_docs += seg.num_docs
+        scanned += cube.n_groups
+        view = _CubeView(seg, cube)
+        try:
+            mask = host_exec._eval_filter(request.filter, view)
+        except Exception:  # noqa: BLE001 — unresolvable predicate
+            return None
+        sel = np.nonzero(mask)[0]
+        matched_groups += len(sel)
+        cnt_chunks.append(cube.counts[sel])
+        for i, c in enumerate(gcols):
+            d = seg.data_source(c).dictionary
+            val_chunks[i].append(np.asarray(
+                d.decode(cube.dim_ids[c][sel])))
+        for f in functions:
+            if f.info.base == "COUNT":
+                continue
+            stats = cube.metric_stats[f.column]
+            for k in ("sum", "min", "max"):
+                stat_chunks.setdefault(f"{f.column}.{k}", []).append(
+                    stats[k][sel])
+
+    counts = np.concatenate(cnt_chunks) if cnt_chunks else \
+        np.zeros(0, np.int64)
+    stats_cat = {k: np.concatenate(v) for k, v in stat_chunks.items()}
+    blk = IntermediateResultsBlock()
+    if not gcols:
+        mask_all = np.ones(len(counts), dtype=bool)
+        flat_cube = StarTreeCubeLike(counts, stats_cat)
+        blk.agg_intermediates = [
+            _cube_aggregate(flat_cube, f, mask_all) for f in functions]
+    else:
+        _multi_group_by(gcols, val_chunks, counts, stats_cat, functions,
+                        blk)
+        from pinot_tpu.query.combine import trim_group_map, trim_size_for
+        t = trim_size_for(request.group_by.top_n)
+        if len(blk.group_map) > 4 * t:
+            # same memory/parity bound combine_blocks applies on the
+            # per-segment path (AggregationGroupByTrimmingService)
+            blk.group_map = trim_group_map(blk.group_map, functions, t)
+    blk.stats = ExecutionStats(
+        num_docs_scanned=matched_groups,
+        num_entries_scanned_in_filter=scanned,
+        num_segments_processed=len(segments),
+        num_segments_matched=len(segments) if matched_groups else 0,
+        total_docs=total_docs)
+    return blk
+
+
+class StarTreeCubeLike:
+    """Concatenated cross-segment cube rows, shaped like a cube for
+    _cube_aggregate."""
+
+    def __init__(self, counts: np.ndarray, stats_cat: Dict[str, np.ndarray]):
+        self.counts = counts
+        self.metric_stats: Dict[str, Dict[str, np.ndarray]] = {}
+        for k, arr in stats_cat.items():
+            col, stat = k.rsplit(".", 1)
+            self.metric_stats.setdefault(col, {})[stat] = arr
+
+
+def _multi_group_by(gcols, val_chunks, counts, stats_cat, functions,
+                    blk) -> None:
+    n = len(counts)
+    codes = []
+    uniq_vals = []
+    for chunks in val_chunks:
+        lane = np.concatenate(chunks) if chunks else np.zeros(0, object)
+        u, inv = np.unique(lane, return_inverse=True)
+        uniq_vals.append(u)
+        codes.append(inv.astype(np.int64))
+    key = np.zeros(n, dtype=np.int64)
+    for u, inv in zip(uniq_vals, codes):
+        key = key * max(len(u), 1) + inv
+    uniq_keys, inverse = np.unique(key, return_inverse=True)
+    g = len(uniq_keys)
+
+    value_cols = []
+    rem = uniq_keys.copy()
+    for u in reversed(uniq_vals):
+        value_cols.append(u[rem % max(len(u), 1)])
+        rem //= max(len(u), 1)
+    value_cols.reverse()
+
+    _fill_group_map(blk, functions, g, inverse, counts, value_cols,
+                    lambda f, k: stats_cat[f"{f.column}.{k}"])
 
 
 def _cube_aggregate(cube, f, mask: np.ndarray):
@@ -171,28 +292,40 @@ def _cube_group_by(segment, cube, request, functions, mask: np.ndarray,
         rem //= card
     value_cols.reverse()
 
-    counts = np.zeros(g, dtype=np.int64)
-    np.add.at(counts, inverse, cube.counts[sel])
+    _fill_group_map(blk, functions, g, inverse, cube.counts[sel],
+                    value_cols,
+                    lambda f, k: cube.metric_stats[f.column][k][sel])
+
+
+def _fill_group_map(blk: IntermediateResultsBlock, functions, g: int,
+                    inverse: np.ndarray, row_counts: np.ndarray,
+                    value_cols, stat_rows) -> None:
+    """Shared group-by finisher for the single-segment and multi-segment
+    cube paths: scatter matched cube rows into `g` group slots and emit
+    the engine's standard intermediate formats (AVG = (sum, count),
+    MINMAXRANGE = (min, max)). `stat_rows(f, kind)` yields the matched
+    rows' "sum"/"min"/"max" lane for function f."""
+    gcounts = np.zeros(g, dtype=np.int64)
+    np.add.at(gcounts, inverse, row_counts)
     per_fn: List[List] = []
     for f in functions:
         base = f.info.base
         if base == "COUNT":
-            per_fn.append([int(c) for c in counts])
+            per_fn.append([int(c) for c in gcounts])
             continue
-        stats = cube.metric_stats[f.column]
         if base in ("SUM", "AVG"):
             sums = np.zeros(g)
-            np.add.at(sums, inverse, stats["sum"][sel])
+            np.add.at(sums, inverse, stat_rows(f, "sum"))
             if base == "SUM":
                 per_fn.append([float(s) for s in sums])
             else:
                 per_fn.append([(float(s), int(c))
-                               for s, c in zip(sums, counts)])
+                               for s, c in zip(sums, gcounts)])
         else:
             mins = np.full(g, np.inf)
             maxs = np.full(g, -np.inf)
-            np.minimum.at(mins, inverse, stats["min"][sel])
-            np.maximum.at(maxs, inverse, stats["max"][sel])
+            np.minimum.at(mins, inverse, stat_rows(f, "min"))
+            np.maximum.at(maxs, inverse, stat_rows(f, "max"))
             if base == "MIN":
                 per_fn.append([float(v) for v in mins])
             elif base == "MAX":
@@ -200,7 +333,6 @@ def _cube_group_by(segment, cube, request, functions, mask: np.ndarray,
             else:
                 per_fn.append([(float(a), float(b))
                                for a, b in zip(mins, maxs)])
-
     blk.group_map = {
         tuple(_plain(vc[i]) for vc in value_cols):
             [per_fn[fi][i] for fi in range(len(functions))]

@@ -85,8 +85,9 @@ def make_segment_from_arrays(
 
 
 # ---------------------------------------------------------------------------
-# SSB-style star-schema table (denormalized lineorder, the shape the
-# pinot-druid benchmark queries — contrib/pinot-druid-benchmark)
+# SSB star-schema table, denormalized (flat lineorder) — the layout the
+# Star Schema Benchmark Q1.1–Q4.3 queries run against, and the shape the
+# reference's contrib/pinot-druid-benchmark flattens TPC-H into.
 # ---------------------------------------------------------------------------
 
 SSB_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
@@ -95,16 +96,45 @@ SSB_NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "CHINA", "EGYPT",
                "IRAQ", "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE",
                "PERU", "ROMANIA", "RUSSIA", "SAUDI ARABIA", "UNITED KINGDOM",
                "UNITED STATES", "VIETNAM"]
+# TPC-H nation → region (SSB inherits it)
+SSB_NATION_REGION = {
+    "ALGERIA": "AFRICA", "ETHIOPIA": "AFRICA", "KENYA": "AFRICA",
+    "MOROCCO": "AFRICA", "MOZAMBIQUE": "AFRICA",
+    "ARGENTINA": "AMERICA", "BRAZIL": "AMERICA", "CANADA": "AMERICA",
+    "PERU": "AMERICA", "UNITED STATES": "AMERICA",
+    "CHINA": "ASIA", "INDIA": "ASIA", "INDONESIA": "ASIA", "JAPAN": "ASIA",
+    "VIETNAM": "ASIA",
+    "FRANCE": "EUROPE", "GERMANY": "EUROPE", "ROMANIA": "EUROPE",
+    "RUSSIA": "EUROPE", "UNITED KINGDOM": "EUROPE",
+    "EGYPT": "MIDDLE EAST", "IRAN": "MIDDLE EAST", "IRAQ": "MIDDLE EAST",
+    "JORDAN": "MIDDLE EAST", "SAUDI ARABIA": "MIDDLE EAST",
+}
+_MONTHS = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug", "Sep",
+           "Oct", "Nov", "Dec"]
 
 
 SSB_TYPES = {
     "lo_quantity": DataType.INT, "lo_discount": DataType.INT,
     "lo_revenue": DataType.LONG, "lo_supplycost": DataType.DOUBLE,
     "d_year": DataType.INT, "d_yearmonthnum": DataType.INT,
-    "c_region": DataType.STRING, "s_nation": DataType.STRING,
-    "p_brand": DataType.STRING,
+    "d_yearmonth": DataType.STRING, "d_weeknuminyear": DataType.INT,
+    "c_region": DataType.STRING, "c_nation": DataType.STRING,
+    "c_city": DataType.STRING,
+    "s_region": DataType.STRING, "s_nation": DataType.STRING,
+    "s_city": DataType.STRING,
+    "p_mfgr": DataType.STRING, "p_category": DataType.STRING,
+    "p_brand1": DataType.STRING,
 }
 SSB_RAW_COLS = {"lo_supplycost"}
+
+
+def _city_pool() -> np.ndarray:
+    """250 cities: nation name truncated to 9 chars + digit (SSB layout,
+    e.g. 'UNITED KI1'). Nations sorted + fixed-width suffix ⇒ the pool is
+    lexicographically sorted and city_id == nation_id * 10 + digit."""
+    nations = sorted(SSB_NATIONS)
+    return np.array([n[:9] + str(d) for n in nations for d in range(10)],
+                    dtype=object)
 
 
 def ssb_pools(seed: int = 0) -> Dict[str, np.ndarray]:
@@ -114,17 +144,170 @@ def ssb_pools(seed: int = 0) -> Dict[str, np.ndarray]:
                         .astype(np.int64))
     ymn = np.array(sorted(y * 100 + m for y in range(1992, 1999)
                           for m in range(1, 13)), dtype=np.int64)
+    yearmonth = np.array(sorted(f"{_MONTHS[m]}{y}" for y in range(1992, 1999)
+                                for m in range(12)), dtype=object)
+    nations = np.array(sorted(SSB_NATIONS), dtype=object)
     return {
         "lo_quantity": np.arange(1, 51, dtype=np.int64),
         "lo_discount": np.arange(0, 11, dtype=np.int64),
         "lo_revenue": revenue,
         "d_year": np.arange(1992, 1999, dtype=np.int64),
         "d_yearmonthnum": ymn,
+        "d_yearmonth": yearmonth,
+        "d_weeknuminyear": np.arange(1, 54, dtype=np.int64),
         "c_region": np.array(sorted(SSB_REGIONS), dtype=object),
-        "s_nation": np.array(sorted(SSB_NATIONS), dtype=object),
-        "p_brand": np.array([f"MFGR#{i:04d}" for i in range(1000)],
-                            dtype=object),
+        "c_nation": nations,
+        "c_city": _city_pool(),
+        "s_region": np.array(sorted(SSB_REGIONS), dtype=object),
+        "s_nation": nations,
+        "s_city": _city_pool(),
+        "p_mfgr": np.array([f"MFGR#{m}" for m in range(1, 6)], dtype=object),
+        "p_category": np.array([f"MFGR#{m}{c}" for m in range(1, 6)
+                                for c in range(1, 6)], dtype=object),
+        "p_brand1": np.array([f"MFGR#{m}{c}{b:02d}" for m in range(1, 6)
+                              for c in range(1, 6)
+                              for b in range(1, 41)], dtype=object),
     }
+
+
+def ssb_derivation_tables(pools) -> Dict[str, np.ndarray]:
+    """Id-domain derivation maps for the correlated dimensions."""
+    nations = pools["c_nation"]
+    regions = list(pools["c_region"])
+    nation_region = np.array(
+        [regions.index(SSB_NATION_REGION[n]) for n in nations],
+        dtype=np.int32)
+    # ymn id (chronological) → d_yearmonth id (lexicographically sorted pool)
+    ym_sorted = list(pools["d_yearmonth"])
+    ymn_to_ym = np.array(
+        [ym_sorted.index(f"{_MONTHS[(int(v) % 100) - 1]}{int(v) // 100}")
+         for v in pools["d_yearmonthnum"]], dtype=np.int32)
+    return {"nation_region": nation_region, "ymn_to_ym": ymn_to_ym}
+
+
+def make_ssb_ids(total_rows: int, seed: int = 0
+                 ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Correlated id-domain SSB table: (ids per column, raw supplycost).
+
+    Base draws are uniform; city→nation→region, ymn→year/yearmonth and
+    brand→category→mfgr are derived exactly like the star schema's
+    functional dependencies."""
+    rng = np.random.default_rng(seed)
+    pools = ssb_pools(seed)
+    maps = ssb_derivation_tables(pools)
+    n = total_rows
+
+    def narrow(arr):
+        # minimal id dtype: keeps a 100M-row table host-resident
+        from pinot_tpu.segment.loader import min_id_dtype
+        m = int(arr.max()) if len(arr) else 0
+        return arr.astype(min_id_dtype(m))
+
+    ids: Dict[str, np.ndarray] = {}
+    ids["lo_quantity"] = narrow(rng.integers(0, 50, n))
+    ids["lo_discount"] = narrow(rng.integers(0, 11, n))
+    ids["lo_revenue"] = narrow(
+        rng.integers(0, len(pools["lo_revenue"]), n))
+    ymn = narrow(rng.integers(0, 84, n))
+    ids["d_yearmonthnum"] = ymn
+    ids["d_year"] = narrow(ymn // 12)
+    ids["d_yearmonth"] = narrow(maps["ymn_to_ym"][ymn])
+    ids["d_weeknuminyear"] = narrow(rng.integers(0, 53, n))
+    for side in ("c", "s"):
+        city = narrow(rng.integers(0, 250, n))
+        nation = narrow(city // 10)
+        ids[f"{side}_city"] = city
+        ids[f"{side}_nation"] = nation
+        ids[f"{side}_region"] = narrow(maps["nation_region"][nation])
+    brand = narrow(rng.integers(0, 1000, n))
+    ids["p_brand1"] = brand
+    ids["p_category"] = narrow(brand // 40)
+    ids["p_mfgr"] = narrow(brand // 200)
+    supplycost = (rng.random(n) * 1e5).round(2)
+    return ids, supplycost
+
+
+def ssb_schema():
+    """Schema for the flat lineorder table (creator/loader path)."""
+    from pinot_tpu.common.schema import (Schema, dimension, metric)
+    fields = []
+    for col, dt in SSB_TYPES.items():
+        if col.startswith("lo_"):
+            fields.append(metric(col, dt))
+        else:
+            fields.append(dimension(col, dt))
+    return Schema("lineorder", fields)
+
+
+# Star-tree cube configs for the SSB query classes (parity: the reference
+# benchmark's star-tree segment variant, contrib/pinot-druid-benchmark
+# config/; functional dependencies — city→nation→region, brand→category→
+# mfgr — keep the actual group counts far below the dimension product).
+SSB_STAR_TREE_CONFIGS = [
+    {"dimensionsSplitOrder": ["d_year", "p_brand1", "s_region",
+                              "p_category"],
+     "metrics": ["lo_revenue"]},                      # Q2.1-2.3
+    {"dimensionsSplitOrder": ["c_nation", "s_nation", "d_year",
+                              "c_region", "s_region"],
+     "metrics": ["lo_revenue"]},                      # Q3.1
+    {"dimensionsSplitOrder": ["c_city", "s_city", "c_nation", "s_nation",
+                              "d_year"],
+     "metrics": ["lo_revenue"]},                      # Q3.2/3.3
+    {"dimensionsSplitOrder": ["d_year", "c_nation", "c_region", "s_region",
+                              "p_mfgr"],
+     "metrics": ["lo_revenue", "lo_supplycost"]},     # Q4.1
+    {"dimensionsSplitOrder": ["d_year", "s_nation", "p_category",
+                              "c_region", "s_region", "p_mfgr"],
+     "metrics": ["lo_revenue", "lo_supplycost"]},     # Q4.2
+]
+
+
+def ssb_table_config(star_tree: bool = False):
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    return TableConfig("lineorder", indexing_config=IndexingConfig(
+        no_dictionary_columns=sorted(SSB_RAW_COLS),
+        star_tree_configs=list(SSB_STAR_TREE_CONFIGS) if star_tree
+        else []))
+
+
+def build_ssb_segment_dirs(base_dir: str, total_rows: int,
+                           num_segments: int, seed: int = 0,
+                           log=None, star_tree: bool = False
+                           ) -> Tuple[List[str], Dict, np.ndarray]:
+    """Full storage path: rows → SegmentCreator → segment dirs on disk.
+
+    Returns (segment_dirs, ids, supplycost) — ids feed the numpy oracle."""
+    import os
+
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    pools = ssb_pools(seed)
+    ids, supplycost = make_ssb_ids(total_rows, seed)
+    schema = ssb_schema()
+    config = ssb_table_config(star_tree=star_tree)
+    per = total_rows // num_segments
+    dirs = []
+    for i in range(num_segments):
+        lo = i * per
+        hi = (i + 1) * per if i < num_segments - 1 else total_rows
+        cols = {}
+        for c in SSB_TYPES:
+            if c in SSB_RAW_COLS:
+                cols[c] = supplycost[lo:hi]
+            else:
+                cols[c] = pools[c][ids[c][lo:hi]]
+        d = os.path.join(base_dir, f"ssb_{i}")
+        # full-domain dictionaries: a small slice can miss rare values,
+        # which would give segments differing dictionaries and knock out
+        # the stacked/sharded device path (NotShardable)
+        fixed = {c: pools[c] for c in SSB_TYPES if c not in SSB_RAW_COLS}
+        SegmentCreator(schema, config, segment_name=f"ssb_{i}",
+                       fixed_dictionaries=fixed).build(cols, d)
+        dirs.append(d)
+        if log:
+            log(f"datagen: built segment {i + 1}/{num_segments} "
+                f"({hi - lo} rows) via SegmentCreator")
+    return dirs, ids, supplycost
 
 
 class SsbTable:
@@ -172,6 +355,7 @@ def make_ssb_device_stack(total_rows: int, num_segments: int, mesh,
     from pinot_tpu.segment.loader import padded_size
 
     pools = ssb_pools(seed)
+    maps = ssb_derivation_tables(pools)
     per = total_rows // num_segments
     padded = padded_size(per)
     shard = NamedSharding(mesh, P(SEG_AXIS))
@@ -180,11 +364,47 @@ def make_ssb_device_stack(total_rows: int, num_segments: int, mesh,
 
     key = jax.random.PRNGKey(seed)
     lanes = {}
-    for c, pool in pools.items():
+
+    def lane_dtype(card):
+        # narrow id lanes, matching the loader's storage-path ladder
+        from pinot_tpu.segment.loader import min_id_dtype
+        return jnp.dtype(min_id_dtype(card))
+
+    def uniform(card):
+        nonlocal key
         key, sub = jax.random.split(key)
-        arr = jax.random.randint(sub, (s_total, padded), 0, len(pool),
+        arr = jax.random.randint(sub, (s_total, padded), 0, card,
                                  dtype=jnp.int32)
-        lanes[f"{c}.ids"] = jax.device_put(arr, shard)
+        return jax.device_put(arr.astype(lane_dtype(card)), shard)
+
+    # base uniforms
+    for c in ("lo_quantity", "lo_discount", "lo_revenue",
+              "d_weeknuminyear"):
+        lanes[f"{c}.ids"] = uniform(len(pools[c]))
+    ymn = uniform(84)
+    lanes["d_yearmonthnum.ids"] = ymn
+    # derived dimensions: the same functional dependencies as the host
+    # generator, applied with device gathers over tiny mapping tables
+    ym_map = jnp.asarray(maps["ymn_to_ym"].astype(np.int8))
+    region_map = jnp.asarray(maps["nation_region"].astype(np.int8))
+    derive = jax.jit(lambda f, x: f(x), static_argnums=0,
+                     out_shardings=shard)
+    lanes["d_year.ids"] = derive(lambda y: (y // 12).astype(jnp.int8), ymn)
+    lanes["d_yearmonth.ids"] = derive(lambda y: ym_map[y.astype(jnp.int32)],
+                                      ymn)
+    for side in ("c", "s"):
+        city = uniform(250)
+        lanes[f"{side}_city.ids"] = city
+        nation = derive(lambda x: (x // 10).astype(jnp.int8), city)
+        lanes[f"{side}_nation.ids"] = nation
+        lanes[f"{side}_region.ids"] = derive(
+            lambda x: region_map[x.astype(jnp.int32)], nation)
+    brand = uniform(1000)
+    lanes["p_brand1.ids"] = brand
+    lanes["p_category.ids"] = derive(lambda b: (b // 40).astype(jnp.int8),
+                                     brand)
+    lanes["p_mfgr.ids"] = derive(lambda b: (b // 200).astype(jnp.int8),
+                                 brand)
 
     # bit-sliced part lanes for the integer SUM metric (lo_revenue)
     plan_table = make_ssb_segments(max(BLOCK_ROWS, 2 * padded_size(1)),
@@ -221,13 +441,11 @@ def make_ssb_segments(total_rows: int, num_segments: int, seed: int = 0
 
     DictIds are generated directly against pre-sorted pools (no
     unique/searchsorted pass over the full table — 100M rows materialize in
-    seconds).
+    seconds). Same correlated distributions as the creator path
+    (build_ssb_segment_dirs), no file round-trip.
     """
-    rng = np.random.default_rng(seed)
     pools = ssb_pools(seed)
-    ids = {c: rng.integers(0, len(p), total_rows).astype(np.int32)
-           for c, p in pools.items()}
-    supplycost = (rng.random(total_rows) * 1e5).round(2)
+    ids, supplycost = make_ssb_ids(total_rows, seed)
 
     per = total_rows // num_segments
     segments = []

@@ -107,3 +107,36 @@ def test_bloom_pruner_numeric_literal_normalization():
     r = e.query("SELECT COUNT(*) FROM t WHERE price = 5")
     assert r.aggregation_results[0].value == "1"
     assert r.num_segments_processed == 1
+
+
+def test_compacted_group_by_chunked_psums(monkeypatch):
+    """kmax > DENSE_ROWS_LIMIT: the compacted psums scatter must chunk so
+    each int32 scatter covers <= DENSE_ROWS_LIMIT rows (no wraparound),
+    and the host must recombine the chunks exactly in int64."""
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.query import plan as plan_mod
+
+    monkeypatch.setattr(kernels, "DENSE_ROWS_LIMIT", 256)
+    # distinctive shape so the jit cache can't hand back a kernel traced
+    # with the real DENSE_ROWS_LIMIT
+    n = 3100
+    rng = np.random.default_rng(11)
+    tmp = tempfile.mkdtemp()
+    schema = Schema("t", [dimension("g", DataType.STRING),
+                          metric("v", DataType.INT)])
+    cols = {"g": np.array(["g%02d" % i for i in
+                           rng.integers(0, 7, n)], dtype=object),
+            "v": rng.integers(0, 100_000, n).astype(np.int32)}
+    seg = _build(tmp, schema, cols)
+    # kmax starts at 1024 (> 256) and escalates to padded on overflow;
+    # the filter matches nearly every row so escalation is exercised too
+    expected = {}
+    msk = cols["v"] >= 5
+    for g, v, m in zip(cols["g"], cols["v"], msk):
+        if m:
+            expected[g] = expected.get(g, 0) + int(v)
+    e = QueryEngine([seg], use_device=True)
+    r = e.query("SELECT SUM(v) FROM t WHERE v >= 5 GROUP BY g TOP 10")
+    got = {gr["group"][0]: float(gr["value"])
+           for gr in r.aggregation_results[0].group_by_result}
+    assert got == {k: float(v) for k, v in expected.items()}
