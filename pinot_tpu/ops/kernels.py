@@ -34,7 +34,7 @@ SURVEY.md §7 "hard parts".
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -147,16 +147,30 @@ def _eval_filter(spec, cols: Dict[str, jnp.ndarray], params: List, valid):
 # ---------------------------------------------------------------------------
 
 BLOCK = 8192                 # row block: must divide padded segment length
+CBLOCK = 2048                # MXU stream-compaction block (B=2048/r=16 won
+#                              the measured race against B=8192 variants)
 CHUNK_BLOCKS = 256           # int32 two-stage partial width (2^20*256 < 2^31)
 DENSE_G_LIMIT = 32768        # one-hot matmul group-table cap
 DENSE_ROWS_LIMIT = 1 << 24   # carry-accum int32 bound (127 * 2^24 < 2^31)
 DENSE_CARD_LIMIT = 32768     # one-hot matmul histogram cap
 
 
-def _tile_rows(g: int) -> int:
-    """Block size for [B, G] one-hot tiles: keep B*G <= 2^24, B | BLOCK."""
-    b = 1 << max(9, min(13, int(np.log2(max((1 << 24) // max(g, 1), 1)))))
-    return min(b, BLOCK)
+def _tile_rows(g: int, n: Optional[int] = None) -> int:
+    """Row-tile size for [B, G] one-hot tiles.
+
+    B*G <= 2^24 keeps a bf16 tile within ~32MB of VMEM; B is a multiple
+    of BLOCK up to 8*BLOCK when the table is narrow (fewer, fatter scan
+    steps — per-step loop overhead dominates small-G histograms
+    otherwise), constrained to divide n when given.
+    """
+    cap = max((1 << 24) // max(g, 1), 1 << 9)
+    b = 1 << max(9, min(16, int(np.log2(cap))))
+    b = min(b, 8 * BLOCK)
+    if n is not None:
+        while b > BLOCK and (n % b or b > n):
+            b //= 2
+        b = min(b, n)
+    return b
 
 
 def _chunked_int_sum(x):
@@ -200,7 +214,7 @@ def _mxu_histogram(ids, mask, card_pad: int):
 
     Replaces the scatter-add histogram (~40x faster on v5e at 8k bins).
     """
-    b = _tile_rows(card_pad)
+    b = _tile_rows(card_pad, ids.shape[0])
     ids_b = ids.reshape(-1, b)
     mask_b = mask.astype(jnp.bfloat16).reshape(-1, b)
 
@@ -228,7 +242,7 @@ def _dense_group_part_sums(parts, key, mask, g_pad: int):
     so 127 * rows < 2^31.
     """
     n_parts = parts.shape[0]
-    b = _tile_rows(g_pad)
+    b = _tile_rows(g_pad, key.shape[0])
     contrib = jnp.where(mask[None, :], parts.astype(jnp.bfloat16), 0)
     key_b = key.reshape(-1, b)
     cb = jnp.moveaxis(contrib.reshape(n_parts, -1, b), 1, 0)  # [T, n_parts, b]
@@ -248,7 +262,7 @@ def _dense_group_float_sums(vals, key, mask, g_pad: int):
     """Per-group float sums via MXU (f32 carry; f64 under x64): [g_pad]."""
     acc = sum_dtype()
     mm_dtype = acc if acc == jnp.float64 else jnp.float32
-    b = _tile_rows(g_pad)
+    b = _tile_rows(g_pad, key.shape[0])
     contrib = jnp.where(mask, vals.astype(mm_dtype), 0)
     key_b = key.reshape(-1, b)
     cb = contrib.reshape(-1, b)
@@ -267,7 +281,7 @@ def _dense_group_float_sums(vals, key, mask, g_pad: int):
 def _dense_group_extreme(ids_or_vals, key, mask, g_pad: int, sentinel,
                          is_min: bool):
     """Blocked masked min/max per group over a [b, G] compare tile."""
-    b = _tile_rows(g_pad)
+    b = _tile_rows(g_pad, key.shape[0])
     v_b = ids_or_vals.reshape(-1, b)
     key_b = key.reshape(-1, b)
     mask_b = mask.reshape(-1, b)
@@ -408,6 +422,12 @@ def _group_key(gcols, strides, g_pad, cols):
             # range; the planner verified it fits the group table)
             lane = cols[f"{c}.raw"]
             ids = (lane - lane.dtype.type(off)).astype(jnp.int32)
+        elif gkind == "idoff":
+            # adaptive dense remap (plan.drive_group_execution): the
+            # filter's phase-A histogram bounded this column's active
+            # dictIds to [off, off+span); re-base so the dense group
+            # table covers only the active subspace
+            ids = cols[f"{c}.ids"].astype(jnp.int32) - np.int32(off)
         else:
             ids = cols[f"{c}.ids"].astype(jnp.int32)
         term = ids * np.int32(s)
@@ -415,7 +435,123 @@ def _group_key(gcols, strides, g_pad, cols):
     return jnp.clip(key, 0, g_pad - 1)
 
 
-def _group_outputs_compacted(group_spec, cols, mask, num_docs):
+def _bytes_for(maxval: int) -> int:
+    """Byte planes needed to carry values in [0, maxval]."""
+    b = 1
+    while (1 << (8 * b)) <= maxval:
+        b += 1
+    return b
+
+
+def _block_compact(mask, int_lanes, f32_lanes, r: int):
+    """MXU stream compaction: matched rows of each 8192-row block move to
+    r per-block output slots via a fused one-hot matmul (no sorts, no
+    row-scale scatters/gathers — random HBM access is the slow primitive
+    on TPU, matmul is the fast one). Each (block, slot) output cell has
+    exactly ONE contributing row, so the f32 accumulation is exact.
+
+    int_lanes: list of [n] int32 lanes with values in [0, 255] (byte
+    planes — bf16-exact). f32_lanes: list of [n] float lanes, moved in
+    sum_dtype() (f64 under x64 for host parity, f32 on device).
+    Returns (ints [K, Pi], floats [K, Pf], valid [K], overflow) with
+    K = (n // CBLOCK) * r. Rows past r in an overflowing block are
+    dropped; `overflow` flags it and the executor escalates kmax.
+    """
+    n = mask.shape[0]
+    t = n // CBLOCK
+    mb = mask.reshape(t, CBLOCK)
+    pos = jnp.cumsum(mb.astype(jnp.int32), axis=1) - 1
+    cnt = mb.sum(axis=1, dtype=jnp.int32)
+    overflow = (cnt > r).any().astype(jnp.int32)
+    oh = (pos[:, :, None] == jnp.arange(r, dtype=jnp.int32)) & \
+        mb[:, :, None]                                    # [t, B, r]
+    ints = None
+    if int_lanes:
+        lb = jnp.stack([v.reshape(t, CBLOCK).astype(jnp.bfloat16)
+                        for v in int_lanes], axis=-1)
+        ints = jnp.einsum("tbr,tbl->trl", oh.astype(jnp.bfloat16), lb,
+                          preferred_element_type=jnp.float32
+                          ).reshape(t * r, len(int_lanes))
+    floats = None
+    if f32_lanes:
+        facc = sum_dtype()
+        lf = jnp.stack([v.reshape(t, CBLOCK).astype(facc)
+                        for v in f32_lanes], axis=-1)
+        floats = jnp.einsum("tbr,tbl->trl", oh.astype(facc), lf,
+                            preferred_element_type=facc
+                            ).reshape(t * r, len(f32_lanes))
+    valid = (jnp.arange(r, dtype=jnp.int32)[None, :] <
+             jnp.minimum(cnt, r)[:, None]).reshape(t * r)
+    return ints, floats, valid, overflow
+
+
+def _slot_sum_tables(gslot, t_slots: int, int_vals, f32_vals, count_mask):
+    """Per-group sums/counts via chunked one-hot matmuls.
+
+    gslot [K] in [0, t_slots] (t_slots = drop slot). Rows are processed
+    in <= 2^16 chunks so each chunk's f32 accumulation stays exact for
+    int values up to 255 (255 * 2^16 < 2^24); chunks combine in int32
+    (bound: 255 * K < 2^31 for K < 2^23 — callers route bigger K through
+    the DENSE_ROWS_LIMIT macro-chunking, and summed int lanes are 7-bit
+    metric parts in practice).
+    Returns (int_tables [Li, t_slots] int32, f32_tables [Lf, t_slots],
+    counts [t_slots] int32); any of the value args may be None.
+    """
+    k = gslot.shape[0]
+    ch = min(k, 1 << 16)
+    nch = -(-k // ch)
+    pad = nch * ch - k
+    gs = jnp.pad(gslot, (0, pad), constant_values=t_slots).reshape(nch, ch)
+    acc = sum_dtype()
+
+    iv = None if int_vals is None else jnp.pad(
+        int_vals, ((0, pad), (0, 0))).reshape(nch, ch, -1)
+    fv = None if f32_vals is None else jnp.pad(
+        f32_vals, ((0, pad), (0, 0))).reshape(nch, ch, -1)
+    cm = None if count_mask is None else jnp.pad(
+        count_mask, (0, pad)).reshape(nch, ch)
+
+    def body(carry, xs):
+        ci, cf, cc = carry
+        g = xs[0]
+        oh2 = g[:, None] == jnp.arange(t_slots + 1, dtype=jnp.int32)
+        j = 1
+        if iv is not None:
+            ci = ci + jnp.einsum(
+                "kg,kl->lg", oh2.astype(jnp.bfloat16),
+                xs[j].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(jnp.int32)
+            j += 1
+        if fv is not None:
+            cf = cf + jnp.einsum(
+                "kg,kl->lg", oh2.astype(acc), xs[j].astype(acc),
+                preferred_element_type=acc)
+            j += 1
+        if cm is not None:
+            cc = cc + jnp.einsum(
+                "kg,k->g", oh2.astype(jnp.bfloat16),
+                xs[j].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(jnp.int32)
+        return (ci, cf, cc), None
+
+    init = (
+        jnp.zeros((iv.shape[2] if iv is not None else 0, t_slots + 1),
+                  jnp.int32),
+        jnp.zeros((fv.shape[2] if fv is not None else 0, t_slots + 1), acc),
+        jnp.zeros(t_slots + 1, jnp.int32))
+    xs = (gs,) + tuple(x for x in (iv, fv, cm) if x is not None)
+    (ti, tf, tc), _ = jax.lax.scan(body, init, xs)
+    return (None if int_vals is None else ti[:, :t_slots],
+            None if f32_vals is None else tf[:, :t_slots],
+            None if count_mask is None else tc[:t_slots])
+
+
+def _group_outputs_compacted_sorted(group_spec, cols, mask, num_docs):
+    """Terminal fallback for barely-selective compacted group-bys
+    (r > 256): full-segment sort compaction + scatters into dense
+    [g_pad] tables. Slower than the MXU path but its memory/compute is
+    bounded at any escalation rung, where the one-hot einsums would
+    build O(rows * r) / O(cap * slots) intermediates."""
     gcols, strides, g_pad, agg_specs, kmax = group_spec
     key = _group_key(gcols, strides, g_pad, cols)
     n = mask.shape[0]
@@ -436,11 +572,9 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs):
         strategy = extra[0] if isinstance(extra, tuple) else "vals"
         if fname in ("sum", "avg"):
             if strategy == "psums":
-                # exact integer sums: int8 part lanes gathered at the
-                # compacted rows, int32 scatter per part. Each scatter
-                # covers <= DENSE_ROWS_LIMIT rows (127 * 2^24 < 2^31), so
-                # kmax beyond that is chunked into a leading axis the host
-                # recombines in int64.
+                # int8 part lanes gathered at the compacted rows, int32
+                # scatter per part; kmax past DENSE_ROWS_LIMIT is chunked
+                # into a leading axis the host recombines in int64
                 pv = cols[f"{col}.parts"][:, si_c].astype(jnp.int32)
                 pv = jnp.where(vm[None, :], pv, 0)
                 n_parts = pv.shape[0]
@@ -490,6 +624,183 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs):
                         jnp.where(vm, vv, -jnp.inf))[:g_pad]
         else:
             raise ValueError(f"unsupported group-by aggregation {fname}")
+    return outs
+
+
+def _group_outputs_compacted(group_spec, cols, mask, num_docs):
+    """Filtered group-by over MXU-compacted matched rows.
+
+    Every needed lane (mixed-radix key bytes, int8 metric parts, float
+    value lanes, dictIds for extrema) is block-compacted by _block_compact
+    in ONE fused one-hot matmul, then aggregated into group tables by a
+    second one-hot matmul (_slot_sum_tables). Measured ~500x faster than
+    the sort- or scatter-based alternatives at SSB shapes on v5e: the
+    only row-scale work is elementwise + matmul. Two table layouts:
+
+    - g_pad <= DENSE_G_LIMIT: dense [g_pad] tables addressed by key
+      (shared key space → device psum combine across segments).
+    - g_pad >  DENSE_G_LIMIT ("ranked"): sort the compacted keys (k-scale
+      only), rank-dedup, tables addressed by group RANK + a parallel
+      `group.rkeys` lane. Bounded by matched rows, not by the key
+      cross-product; host merges per-segment rank spaces by key (the
+      CombineGroupByOperator merge, done columnar in numpy).
+    """
+    gcols, strides, g_pad, agg_specs, kmax = group_spec
+    n = mask.shape[0]
+    t = n // CBLOCK
+    r = min(max(-(-kmax // t), 8), CBLOCK)
+    if r > 256:
+        # barely-selective escalation rung: the one-hot compaction would
+        # cost O(rows * r) — the bounded sort+scatter fallback wins there
+        return _group_outputs_compacted_sorted(group_spec, cols, mask,
+                                               num_docs)
+    key = _group_key(gcols, strides, g_pad, cols)
+
+    # lane registry: key byte planes + per-agg value planes
+    n_kb = _bytes_for(g_pad - 1)
+    int_lanes = [((key >> (8 * b)) & 0xFF) for b in range(n_kb)]
+    f32_lanes = []
+    int_slots: Dict[int, Tuple[int, int]] = {}   # agg i → (start, n_planes)
+    f32_slots: Dict[int, int] = {}
+    id_slots: Dict[int, Tuple[int, int]] = {}    # agg i → ids byte planes
+    for i, spec in enumerate(agg_specs):
+        fname, col, source, extra = spec
+        if fname == "count":
+            continue
+        strategy = extra[0] if isinstance(extra, tuple) else "vals"
+        if fname in ("sum", "avg"):
+            if strategy == "psums":
+                parts = cols[f"{col}.parts"]
+                int_slots[i] = (len(int_lanes), parts.shape[0])
+                for p in range(parts.shape[0]):
+                    int_lanes.append(parts[p].astype(jnp.int32))
+            else:
+                lane = cols[f"{col}.vlane" if source == "sv"
+                            else f"{col}.raw"]
+                f32_slots[i] = len(f32_lanes)
+                f32_lanes.append(lane.astype(jnp.float32))
+        elif fname in ("min", "max", "minmaxrange"):
+            if source == "sv":
+                card_pad = extra[1]
+                ids = cols[f"{col}.ids"].astype(jnp.int32)
+                nb = _bytes_for(card_pad - 1)
+                id_slots[i] = (len(int_lanes), nb)
+                for b in range(nb):
+                    int_lanes.append((ids >> (8 * b)) & 0xFF)
+            else:
+                f32_slots[i] = len(f32_lanes)
+                f32_lanes.append(cols[f"{col}.raw"].astype(jnp.float32))
+        else:
+            raise ValueError(f"unsupported group-by aggregation {fname}")
+
+    ci, cf, valid, overflow = _block_compact(mask, int_lanes, f32_lanes, r)
+    cap = t * r
+    outs = {"group.overflow": overflow}
+
+    def _reassemble(start, nb):
+        v = ci[:, start].astype(jnp.int32)
+        for b in range(1, nb):
+            v = v + (ci[:, start + b].astype(jnp.int32) << (8 * b))
+        return v
+
+    k_c = jnp.where(valid, _reassemble(0, n_kb), jnp.int32(g_pad))
+    acc = sum_dtype()
+
+    ranked = g_pad > DENSE_G_LIMIT
+    if ranked:
+        # sort only the compacted keys (cap-scale), rank-dedup
+        sk, order = jax.lax.sort((k_c, jnp.arange(cap, dtype=jnp.int32)),
+                                 num_keys=1)
+        vs = sk < g_pad
+        if ci is not None:
+            ci = ci[order]
+        if cf is not None:
+            cf = cf[order]
+        valid = vs
+        newg = vs & jnp.concatenate([vs[:1], sk[1:] != sk[:-1]])
+        gslot = jnp.where(vs, jnp.cumsum(newg.astype(jnp.int32)) - 1, cap)
+        t_slots = cap
+        outs["group.rkeys"] = jnp.full(
+            cap + 1, g_pad, jnp.int32).at[
+            jnp.where(newg, gslot, cap)].set(sk)[:cap]
+        sum_key, min_key, max_key, psums_key = ("rsum", "rmin", "rmax",
+                                                "rpsums")
+    else:
+        gslot = jnp.where(valid, k_c, g_pad)
+        t_slots = g_pad
+        sum_key, min_key, max_key, psums_key = ("sum", "min", "max",
+                                                "cpsums")
+
+    # the int value columns actually summed (metric parts)
+    part_cols = []
+    for i, (start, np_) in int_slots.items():
+        part_cols.extend(range(start, start + np_))
+    iv = ci[:, part_cols] if part_cols else None
+    if iv is not None:
+        iv = jnp.where(valid[:, None], iv, 0)
+    fvals = cf if f32_slots else None
+    if fvals is not None:
+        fvals = jnp.where(valid[:, None], fvals, 0)
+    if iv is not None and cap > DENSE_ROWS_LIMIT:
+        # int32 accumulation bound (127 * 2^24 < 2^31): emit per-macro-
+        # chunk tables; the host recombines chunks exactly in int64
+        n_mc = -(-cap // DENSE_ROWS_LIMIT)
+        ti = jnp.stack([
+            _slot_sum_tables(
+                gslot[c * DENSE_ROWS_LIMIT: (c + 1) * DENSE_ROWS_LIMIT],
+                t_slots,
+                iv[c * DENSE_ROWS_LIMIT: (c + 1) * DENSE_ROWS_LIMIT],
+                None, None)[0]
+            for c in range(n_mc)])                      # [C, L, t_slots]
+        _, tf, tc = _slot_sum_tables(gslot, t_slots, None, fvals,
+                                     valid.astype(jnp.float32))
+    else:
+        ti, tf, tc = _slot_sum_tables(gslot, t_slots, iv, fvals,
+                                      valid.astype(jnp.float32))
+    if ranked:
+        outs["group.rcount"] = tc
+    else:
+        outs["group.count"] = tc
+
+    # map table rows back to per-agg outputs
+    pci = 0
+    for i, spec in enumerate(agg_specs):
+        fname, col, source, extra = spec
+        if fname == "count":
+            continue
+        strategy = extra[0] if isinstance(extra, tuple) else "vals"
+        if fname in ("sum", "avg"):
+            if strategy == "psums":
+                _, np_ = int_slots[i]
+                outs[f"gagg{i}.{psums_key}"] = (
+                    ti[:, pci: pci + np_] if ti.ndim == 3
+                    else ti[pci: pci + np_])
+                pci += np_
+            else:
+                outs[f"gagg{i}.{sum_key}"] = tf[f32_slots[i]]
+        elif fname in ("min", "max", "minmaxrange"):
+            if source == "sv":
+                card_pad = extra[1]
+                start, nb = id_slots[i]
+                idv = _reassemble(start, nb)
+                if fname in ("min", "minmaxrange"):
+                    outs[f"gagg{i}.{min_key}"] = jnp.full(
+                        t_slots + 1, card_pad, jnp.int32).at[gslot].min(
+                        jnp.where(valid, idv, card_pad))[:t_slots]
+                if fname in ("max", "minmaxrange"):
+                    outs[f"gagg{i}.{max_key}"] = jnp.full(
+                        t_slots + 1, -1, jnp.int32).at[gslot].max(
+                        jnp.where(valid, idv, -1))[:t_slots]
+            else:
+                vv = cf[:, f32_slots[i]].astype(acc)
+                if fname in ("min", "minmaxrange"):
+                    outs[f"gagg{i}.{min_key}"] = jnp.full(
+                        t_slots + 1, jnp.inf, acc).at[gslot].min(
+                        jnp.where(valid, vv, jnp.inf))[:t_slots]
+                if fname in ("max", "minmaxrange"):
+                    outs[f"gagg{i}.{max_key}"] = jnp.full(
+                        t_slots + 1, -jnp.inf, acc).at[gslot].max(
+                        jnp.where(valid, vv, -jnp.inf))[:t_slots]
     return outs
 
 

@@ -64,6 +64,10 @@ def _combine_kind(key: str) -> str:
         return "stack"          # per-segment; host merges selection rows
     if key.endswith((".parts", ".vsum", ".psums", ".csums")):
         return "stack"          # chunk partials: host combines in int64/f64
+    if key.endswith((".rkeys", ".rcount", ".rpsums", ".rsum", ".rmin",
+                     ".rmax")):
+        return "stack"          # ranked group tables: per-segment rank
+        #                         spaces; host merges by group key
     if key.endswith(".min"):
         return "min"
     if key.endswith(".max"):
@@ -331,24 +335,31 @@ class ShardedQueryExecutor:
         cols = stack.gather(plan.needed_cols)
         lane_keys = tuple(sorted(cols.keys()))
 
-        def run(group_spec):
+        def run(agg_specs, group_spec):
             fn = get_sharded_kernel(
                 self.mesh, stack.padded_docs, plan.filter_spec,
-                tuple(plan.agg_specs or ()), group_spec, plan.select_spec,
+                tuple(agg_specs or ()), group_spec, plan.select_spec,
                 lane_keys)
             return jax.device_get(fn(cols, tuple(plan.params),
                                      stack.device_num_docs()))
 
-        from pinot_tpu.query.plan import run_with_group_escalation
-        outs, _ = run_with_group_escalation(run, plan.group_spec,
-                                            stack.padded_docs)
-
+        from pinot_tpu.query.plan import (drive_group_execution,
+                                          set_group_kmax)
         blk = IntermediateResultsBlock()
-        matched = int(outs["stats.num_docs_matched"])
         if plan.group_spec is not None:
-            execution._finish_group_by(plan, outs, blk)
-        elif plan.agg_specs:
-            execution._finish_aggregation(plan, outs, blk)
+            spec0 = set_group_kmax(plan.group_spec, stack.padded_docs)
+            outs, spec_used = drive_group_execution(
+                run, spec0, stack.padded_docs, int(stack.num_docs.sum()))
+            if spec_used is None:
+                blk.group_map = {}
+            else:
+                execution._finish_group_by(
+                    execution._with_group_spec(plan, spec_used), outs, blk)
+        else:
+            outs = run(plan.agg_specs, None)
+            if plan.agg_specs:
+                execution._finish_aggregation(plan, outs, blk)
+        matched = int(outs["stats.num_docs_matched"])
         if plan.select_spec is not None:
             self._finish_selection(request, plan, stack, outs, blk)
 

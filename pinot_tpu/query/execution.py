@@ -56,24 +56,28 @@ def execute_segment_plan(plan) -> IntermediateResultsBlock:
     segment = plan.segment
     t0 = time.perf_counter()
     cols = gather_operands(plan)
-    from pinot_tpu.query.plan import run_with_group_escalation
+    from pinot_tpu.query.plan import drive_group_execution
 
-    def run(group_spec):
+    def run(agg_specs, group_spec):
         return jax.device_get(kernels.run_segment_kernel(
-            segment.padded_docs, plan.filter_spec, plan.agg_specs,
+            segment.padded_docs, plan.filter_spec, agg_specs,
             group_spec, plan.select_spec, cols, plan.params,
             segment.num_docs))
 
-    outs, _ = run_with_group_escalation(run, plan.group_spec,
-                                        segment.padded_docs)
-
     blk = IntermediateResultsBlock()
-    matched = int(outs["stats.num_docs_matched"])
-
     if plan.group_spec is not None:
-        _finish_group_by(plan, outs, blk)
-    elif plan.agg_specs:
-        _finish_aggregation(plan, outs, blk)
+        outs, spec_used = drive_group_execution(run, plan.group_spec,
+                                                segment.padded_docs,
+                                                segment.num_docs)
+        if spec_used is None:
+            blk.group_map = {}
+        else:
+            _finish_group_by(_with_group_spec(plan, spec_used), outs, blk)
+    else:
+        outs = run(plan.agg_specs, None)
+        if plan.agg_specs:
+            _finish_aggregation(plan, outs, blk)
+    matched = int(outs["stats.num_docs_matched"])
     if plan.select_spec is not None:
         _finish_selection(plan, outs, blk, matched)
 
@@ -161,24 +165,34 @@ def _finish_aggregation(plan, outs, blk) -> None:
     blk.agg_intermediates = inters
 
 
-def _finish_group_by(plan, outs, blk) -> None:
-    gcols, strides, g_pad, agg_specs, kmax = plan.group_spec
-    counts = np.asarray(outs["group.count"])
-    nz = np.nonzero(counts)[0]
-    cards = [entry[3] for entry in gcols]
+def _with_group_spec(plan, spec_used):
+    """Plan view for finishing: plans are cached per query shape, so a
+    value-dependent (adaptive-remap) group spec must not mutate them."""
+    if spec_used is plan.group_spec:
+        return plan
+    import copy
+    p = copy.copy(plan)
+    p.group_spec = spec_used
+    return p
 
-    group_map: Dict[Tuple, List] = {}
-    # decode all non-empty group keys vectorized; expression group keys
-    # decode through their transformed value table (collisions — distinct
-    # source ids mapping to one transformed value — merge below);
-    # raw-binned keys decode as (binId + min_value)
-    keys = nz
+
+def _decode_group_values(plan, nz: np.ndarray) -> List[np.ndarray]:
+    """Mixed-radix decode of group keys `nz` into per-column value arrays.
+
+    Expression group keys decode through their transformed value table
+    (collisions — distinct source ids mapping to one transformed value —
+    merge in the assembly loop); raw-binned keys decode as (binId + min).
+    """
+    gcols, strides, _g_pad, _specs, _kmax = plan.group_spec
+    cards = [entry[3] for entry in gcols]
     id_cols = []
     for stride, card in zip(strides, cards):
-        id_cols.append((keys // stride) % card)
+        id_cols.append((nz // stride) % card)
     vtables = plan.group_value_tables or (None,) * len(gcols)
     value_cols = []
     for (c, gkind, off, _card), ids, tv in zip(gcols, id_cols, vtables):
+        if gkind == "idoff":
+            ids = ids + off              # re-base adaptive-remapped ids
         if tv is not None:
             value_cols.append(tv[ids])
         elif gkind == "rawoff":
@@ -186,6 +200,67 @@ def _finish_group_by(plan, outs, blk) -> None:
         else:
             value_cols.append(
                 plan.segment.data_source(c).dictionary.decode(ids))
+    return value_cols
+
+
+def _decode_extreme_ids(plan, spec, arr: np.ndarray, which: str
+                        ) -> np.ndarray:
+    """dictId-domain per-group extrema → float values (inf when empty)."""
+    _fname, col, source, extra = spec
+    if source == "sv" and isinstance(extra, tuple) and extra[0] == "ids":
+        vals = plan.segment.data_source(col).dictionary.values
+        card = len(vals)
+        if which == "min":
+            valid = arr < card
+            sentinel = np.inf
+        else:
+            valid = arr >= 0
+            sentinel = -np.inf
+        out = np.full(len(arr), sentinel)
+        safe = np.clip(arr, 0, card - 1)
+        out[valid] = np.asarray(vals, dtype=np.float64)[safe][valid]
+        return out
+    return arr
+
+
+def _assemble_group_map(plan, blk, value_cols, per_agg_arrays,
+                        n_groups: int) -> None:
+    group_map: Dict[Tuple, List] = {}
+    for row in range(n_groups):
+        key = tuple(_plain(vc[row]) for vc in value_cols)
+        inters: List = []
+        for kind, a, b in per_agg_arrays:
+            if kind == "count":
+                inters.append(int(a[row]))
+            elif kind == "sum":
+                inters.append(float(a[row]))
+            elif kind == "avg":
+                inters.append((float(a[row]), int(b[row])))
+            elif kind in ("min", "max"):
+                v = float(a[row])
+                inters.append(None if not np.isfinite(v) else v)
+            else:  # minmaxrange
+                mn, mx = float(a[row]), float(b[row])
+                inters.append((None if not np.isfinite(mn) else mn,
+                               None if not np.isfinite(mx) else mx))
+        old = group_map.get(key)
+        if old is not None:
+            # expression group keys can collide (non-injective transform):
+            # merge with the same semantics as cross-segment combine
+            inters = [f.merge(o, v) for f, o, v in
+                      zip(plan.functions, old, inters)]
+        group_map[key] = inters
+    blk.group_map = group_map
+
+
+def _finish_group_by(plan, outs, blk) -> None:
+    if "group.rkeys" in outs:
+        _finish_group_by_ranked(plan, outs, blk)
+        return
+    gcols, strides, g_pad, agg_specs, kmax = plan.group_spec
+    counts = np.asarray(outs["group.count"])
+    nz = np.nonzero(counts)[0]
+    value_cols = _decode_group_values(plan, nz)
 
     def _sum_array(i, spec):
         """Exact f64 per-group sums from the device partials."""
@@ -228,23 +303,8 @@ def _finish_group_by(plan, outs, blk) -> None:
 
     def _extreme_array(i, spec, which):
         """Per-group min/max as float values (inf sentinels when empty)."""
-        fname, col, source, extra = spec
         arr = np.asarray(outs[f"gagg{i}.{which}"])[nz]
-        if source == "sv" and isinstance(extra, tuple) and extra[0] == "ids":
-            _, card_pad = extra
-            vals = plan.segment.data_source(col).dictionary.values
-            card = len(vals)
-            if which == "min":
-                valid = arr < card
-                sentinel = np.inf
-            else:
-                valid = arr >= 0
-                sentinel = -np.inf
-            out = np.full(len(arr), sentinel)
-            safe = np.clip(arr, 0, card - 1)
-            out[valid] = np.asarray(vals, dtype=np.float64)[safe][valid]
-            return out
-        return arr
+        return _decode_extreme_ids(plan, spec, arr, which)
 
     per_agg_arrays = []
     for i, spec in enumerate(agg_specs):
@@ -268,34 +328,94 @@ def _finish_group_by(plan, outs, blk) -> None:
         else:
             raise ValueError(fname)
 
-    for row in range(len(nz)):
-        key = tuple(_plain(vc[row]) for vc in value_cols)
-        inters: List = []
-        for kind, a, b in per_agg_arrays:
-            if kind == "count":
-                inters.append(int(a[row]))
-            elif kind == "sum":
-                inters.append(float(a[row]))
-            elif kind == "avg":
-                inters.append((float(a[row]), int(b[row])))
-            elif kind == "min":
-                v = float(a[row])
-                inters.append(None if not np.isfinite(v) else v)
-            elif kind == "max":
-                v = float(a[row])
-                inters.append(None if not np.isfinite(v) else v)
-            else:  # minmaxrange
-                mn, mx = float(a[row]), float(b[row])
-                inters.append((None if not np.isfinite(mn) else mn,
-                               None if not np.isfinite(mx) else mx))
-        old = group_map.get(key)
-        if old is not None:
-            # expression group keys can collide (non-injective transform):
-            # merge with the same semantics as cross-segment combine
-            inters = [f.merge(o, v) for f, o, v in
-                      zip(plan.functions, old, inters)]
-        group_map[key] = inters
-    blk.group_map = group_map
+    _assemble_group_map(plan, blk, value_cols, per_agg_arrays, len(nz))
+
+
+def _finish_group_by_ranked(plan, outs, blk) -> None:
+    """Finish the ranked compacted group-by (kernels.py: wide-key layout).
+
+    Per-segment tables are addressed by group RANK with a parallel key
+    lane, so the cross-segment combine happens here: concatenate every
+    segment's valid (key, partial) entries and merge them columnar via
+    np.unique + np.add.at / minimum.at / maximum.at — the
+    CombineGroupByOperator merge without the g_pad-sized tables.
+    """
+    gcols, strides, g_pad, agg_specs, kmax = plan.group_spec
+    rkeys = np.asarray(outs["group.rkeys"])
+    rcount = np.asarray(outs["group.rcount"])
+    single = rkeys.ndim == 1
+    if single:                               # single segment → [S=1, K]
+        rkeys, rcount = rkeys[None], rcount[None]
+    valid = rkeys < g_pad                    # [S, K]
+    nz, inverse = np.unique(rkeys[valid], return_inverse=True)
+    counts_nz = np.zeros(len(nz), np.int64)
+    np.add.at(counts_nz, inverse, rcount[valid].astype(np.int64))
+    value_cols = _decode_group_values(plan, nz)
+
+    def _sum_array(i, spec):
+        fname, col, source, extra = spec
+        strategy = extra[0] if isinstance(extra, tuple) else None
+        if strategy == "psums":
+            a = np.asarray(outs[f"gagg{i}.rpsums"]).astype(np.int64)
+            if single:                       # [P, K] or [C, P, K] chunked
+                a = (a.sum(axis=0) if a.ndim == 3 else a)[None]
+            elif a.ndim == 4:                # [S, C, P, K] chunked
+                a = a.sum(axis=1)
+            vals = np.moveaxis(a, 1, 2)[valid]          # [M, P]
+            sums = np.zeros((len(nz), vals.shape[1]), np.int64)
+            np.add.at(sums, inverse, vals)
+            _, min_v = plan.segment.data_source(col).int_part_info()
+            shifts = np.left_shift(
+                np.int64(1), 7 * np.arange(sums.shape[1], dtype=np.int64))
+            totals = (sums * shifts[None, :]).sum(1)
+            return (totals + np.int64(min_v) * counts_nz).astype(np.float64)
+        a = np.asarray(outs[f"gagg{i}.rsum"], dtype=np.float64)
+        if a.ndim == 1:
+            a = a[None]
+        sums = np.zeros(len(nz), np.float64)
+        np.add.at(sums, inverse, a[valid])
+        return sums
+
+    def _extreme_array(i, spec, which):
+        a = np.asarray(outs[f"gagg{i}.r{which}"])
+        if a.ndim == 1:
+            a = a[None]
+        if a.dtype.kind in "iu":             # dictId domain
+            _fname, col, _source, extra = spec
+            sentinel = extra[1] if which == "min" else -1
+            out = np.full(len(nz), sentinel, np.int64)
+            red = np.minimum if which == "min" else np.maximum
+            red.at(out, inverse, a[valid].astype(np.int64))
+            return _decode_extreme_ids(plan, spec, out, which)
+        sentinel = np.inf if which == "min" else -np.inf
+        out = np.full(len(nz), sentinel, np.float64)
+        red = np.minimum if which == "min" else np.maximum
+        red.at(out, inverse, a[valid].astype(np.float64))
+        return out
+
+    per_agg_arrays = []
+    for i, spec in enumerate(agg_specs):
+        fname = spec[0]
+        if fname == "count":
+            per_agg_arrays.append(("count", counts_nz, None))
+        elif fname == "sum":
+            per_agg_arrays.append(("sum", _sum_array(i, spec), None))
+        elif fname == "avg":
+            per_agg_arrays.append(("avg", _sum_array(i, spec), counts_nz))
+        elif fname == "min":
+            per_agg_arrays.append(("min", _extreme_array(i, spec, "min"),
+                                   None))
+        elif fname == "max":
+            per_agg_arrays.append(("max", _extreme_array(i, spec, "max"),
+                                   None))
+        elif fname == "minmaxrange":
+            per_agg_arrays.append(("minmaxrange",
+                                   _extreme_array(i, spec, "min"),
+                                   _extreme_array(i, spec, "max")))
+        else:
+            raise ValueError(fname)
+
+    _assemble_group_map(plan, blk, value_cols, per_agg_arrays, len(nz))
 
 
 def _finish_selection(plan, outs, blk, matched: int) -> None:

@@ -525,12 +525,7 @@ class InstancePlanMaker:
         if g > limit:
             raise GroupsLimitExceeded(
                 f"{g} potential groups > limit {limit}")
-        strides = []
-        acc = 1
-        for c in reversed(cards):
-            strides.append(acc)
-            acc *= c
-        strides = tuple(reversed(strides))
+        strides = mixed_radix_strides(cards)
         g_pad = kernels.pow2_bucket(g)
         # sort-compaction for filtered group-bys (see kernels.py): start at
         # ~1.5% of the segment; the executor escalates via the overflow flag
@@ -606,8 +601,20 @@ class InstancePlanMaker:
             plan.select_spec = ("ordermk", k, tuple(order), tuple(gather))
 
 
+def mixed_radix_strides(cards) -> tuple:
+    """Strides for the mixed-radix group key (last column fastest)."""
+    strides = []
+    acc = 1
+    for c in reversed(list(cards)):
+        strides.append(acc)
+        acc *= c
+    return tuple(reversed(strides))
+
+
 def initial_group_kmax(padded: int) -> int:
-    return min(kernels.pow2_bucket(max(padded // 64, 1024)), padded)
+    # ~0.8% selectivity tolerance per 8192-row block (r=64) — the MXU
+    # block-compaction makes a rerun cheap, so start small and escalate
+    return min(kernels.pow2_bucket(max(padded // 128, 1024)), padded)
 
 
 def set_group_kmax(group_spec: tuple, padded: int) -> tuple:
@@ -638,6 +645,98 @@ def run_with_group_escalation(run, group_spec, padded: int):
         assert group_spec is not None, "overflow at full kmax is impossible"
         outs = run(group_spec)
     return outs, group_spec
+
+
+def adaptive_phase_a_specs(group_spec) -> Optional[tuple]:
+    """Scout agg specs (masked MIN+MAX of each group column's dictIds)
+    for the adaptive two-phase group-by, or None when the plan isn't
+    eligible (no filter to narrow the key space, or non-dictionary
+    keys). Min/max are streaming-rate tree reductions — the scout costs
+    about one filter evaluation."""
+    if group_spec is None or not group_spec[4]:
+        return None
+    specs = []
+    for (c, gkind, _off, card) in group_spec[0]:
+        if gkind != "ids":
+            return None
+        card_pad = kernels.pow2_bucket(card + 1)
+        specs.append(("min", c, "sv", ("ids", card_pad)))
+        specs.append(("max", c, "sv", ("ids", card_pad)))
+    return tuple(specs)
+
+
+def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
+                          total_docs: int):
+    """Derive the remapped group spec from the phase-A scout.
+
+    `bounds` = per-gcol (lo, hi) matched dictId ranges. The remapped key
+    space is the product of the spans — orders of magnitude below the
+    full cross-product when the filter correlates with the group columns
+    (the star-schema norm). The compaction capacity kmax is sized from
+    the scout's matched count: per-2048-row-block Poisson mean plus tail
+    headroom (the kernel's overflow flag still escalates on skew).
+    Returns (spec, empty).
+    """
+    gcols, _strides, _g_pad, agg_specs, _kmax = group_spec
+    offs, spans = [], []
+    for lo, hi in bounds:
+        if hi < lo:
+            return None, True
+        offs.append(lo)
+        spans.append(hi - lo + 1)
+    g = int(np.prod(spans, dtype=np.int64))
+    new_gcols = tuple((c[0], "idoff", off, span)
+                      for c, off, span in zip(gcols, offs, spans))
+    strides = mixed_radix_strides(spans)
+    g_pad = kernels.pow2_bucket(g)
+    # compaction capacity from measured selectivity
+    t = max(padded // kernels.CBLOCK, 1)
+    mu = matched * kernels.CBLOCK / max(total_docs, 1)
+    r = kernels.pow2_bucket(max(16, int(2 * mu + 8)))
+    if r >= kernels.CBLOCK // 4 and g_pad <= kernels.DENSE_G_LIMIT:
+        kmax = 0          # barely-selective filter: direct dense one-hot
+    else:
+        kmax = min(t * r, padded)
+    spec = (new_gcols, strides, g_pad, agg_specs, kmax)
+    return spec, False
+
+
+def drive_group_execution(run, group_spec, padded: int, total_docs: int):
+    """Execution policy for device group-bys.
+
+    `run(agg_specs, group_spec)` dispatches the kernel, returns host outs.
+    Filtered dictionary-keyed group-bys take the ADAPTIVE TWO-PHASE path:
+
+    - Phase A (scout): masked min/max of each group column's dictIds +
+      the matched count — one streaming-rate dispatch.
+    - Phase B: group tables over the REMAPPED key space (product of the
+      scout's active spans), with MXU block-compaction sized from the
+      measured selectivity. Small remapped spaces take the dense one-hot
+      layout (device psum combine); big ones the ranked layout.
+
+    No sorts, row-scale scatters or gathers anywhere on the hot path —
+    those are TPU's slow primitives. Non-eligible plans fall back to the
+    compacted kernel with the kmax escalation ladder.
+
+    Returns (outs, group_spec_used); group_spec_used=None means the
+    filter matched nothing (outs still carries the stats).
+    """
+    pa = adaptive_phase_a_specs(group_spec) \
+        if padded <= kernels.DENSE_ROWS_LIMIT else None
+    if pa is not None:
+        ha = run(pa, None)
+        bounds = [(int(ha[f"agg{2 * i}.min"]), int(ha[f"agg{2 * i + 1}.max"]))
+                  for i in range(len(pa) // 2)]
+        matched = int(ha["stats.num_docs_matched"])
+        spec2, empty = adaptive_phase_b_spec(group_spec, bounds, matched,
+                                             padded, total_docs)
+        if empty:
+            return ha, None
+        if spec2 is not None:
+            return run_with_group_escalation(lambda gs: run((), gs),
+                                             spec2, padded)
+    return run_with_group_escalation(lambda gs: run((), gs), group_spec,
+                                     padded)
 
 
 def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,

@@ -212,3 +212,133 @@ def test_sharded_wide_key_order_by(sharded_setup):
         assert [r[0] for r in rows] == [q[0] for q in quads]
         assert [float(r[1]) for r in rows] == pytest.approx(
             [float(q[1]) for q in quads])
+
+
+# -- ranked (wide-key) compacted group-by -----------------------------------
+
+@pytest.fixture(scope="module")
+def wide_group_setup():
+    """Group-key cross-product past DENSE_G_LIMIT: the kernel must take
+    the ranked layout (rank-addressed tables + key lane, host merge)."""
+    import os
+
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, dimension, metric
+    base = tempfile.mkdtemp()
+    rng = np.random.default_rng(5)
+    n = 4096
+    schema = Schema("w", [dimension("a", DataType.STRING),
+                          dimension("b", DataType.STRING),
+                          metric("v", DataType.INT),
+                          metric("f", DataType.FLOAT)])
+    avals = np.array([f"a{i:03d}" for i in range(300)], dtype=object)
+    bvals = np.array([f"b{i:03d}" for i in range(250)], dtype=object)
+    segs, datas = [], []
+    for s in range(4):
+        cols = {"a": avals[rng.integers(0, 300, n)],
+                "b": bvals[rng.integers(0, 250, n)],
+                "v": rng.integers(-50, 100000, n).astype(np.int32),
+                "f": rng.random(n).astype(np.float32)}
+        d = os.path.join(base, f"w{s}")
+        os.makedirs(d)
+        SegmentCreator(schema, None, segment_name=f"w{s}",
+                       fixed_dictionaries={"a": avals, "b": bvals}
+                       ).build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        datas.append(cols)
+    merged = {k: np.concatenate([c[k] for c in datas]) for k in datas[0]}
+    return segs, merged
+
+
+def test_wide_key_group_by_takes_ranked_path(wide_group_setup):
+    segs, _ = wide_group_setup
+    plan = _plan(segs[0], "SELECT SUM(v) FROM w WHERE v >= 0 "
+                          "GROUP BY a, b TOP 20000")
+    from pinot_tpu.ops.kernels import DENSE_G_LIMIT
+    assert plan.group_spec is not None
+    assert plan.group_spec[2] > DENSE_G_LIMIT   # g_pad → ranked layout
+    assert plan.group_spec[4] > 0               # compacted (kmax set)
+
+
+def test_wide_key_group_by_matches_oracle(wide_group_setup):
+    from pinot_tpu.parallel import make_mesh
+    segs, merged = wide_group_setup
+    pql = ("SELECT SUM(v), COUNT(*), MIN(v), MAX(v), AVG(f) FROM w "
+           "WHERE v >= 0 GROUP BY a, b TOP 20000")
+    m = merged["v"] >= 0
+    exp_sum, exp_cnt, exp_min, exp_max, exp_favg = {}, {}, {}, {}, {}
+    for a, b, v, f, ok in zip(merged["a"], merged["b"], merged["v"],
+                              merged["f"], m):
+        if not ok:
+            continue
+        k = (a, b)
+        exp_sum[k] = exp_sum.get(k, 0) + int(v)
+        exp_cnt[k] = exp_cnt.get(k, 0) + 1
+        exp_min[k] = min(exp_min.get(k, 1 << 40), int(v))
+        exp_max[k] = max(exp_max.get(k, -(1 << 40)), int(v))
+        exp_favg[k] = exp_favg.get(k, 0.0) + float(f)
+    for engine in (QueryEngine(segs),
+                   QueryEngine(segs, mesh=make_mesh()),
+                   QueryEngine(segs, use_device=False)):
+        resp = engine.query(pql)
+        aggs = resp.aggregation_results
+        got_sum = {tuple(g["group"]): float(g["value"])
+                   for g in aggs[0].group_by_result}
+        assert got_sum == {k: float(v) for k, v in exp_sum.items()}
+        got_cnt = {tuple(g["group"]): float(g["value"])
+                   for g in aggs[1].group_by_result}
+        assert got_cnt == {k: float(v) for k, v in exp_cnt.items()}
+        got_min = {tuple(g["group"]): float(g["value"])
+                   for g in aggs[2].group_by_result}
+        assert got_min == {k: float(v) for k, v in exp_min.items()}
+        got_max = {tuple(g["group"]): float(g["value"])
+                   for g in aggs[3].group_by_result}
+        assert got_max == {k: float(v) for k, v in exp_max.items()}
+        got_avg = {tuple(g["group"]): float(g["value"])
+                   for g in aggs[4].group_by_result}
+        for k, tot in exp_favg.items():
+            assert got_avg[k] == pytest.approx(tot / exp_cnt[k], rel=1e-5)
+
+
+def test_adaptive_dense_remap_group_by(wide_group_setup):
+    """Filter narrows the active key space: the executor's two-phase
+    adaptive path (phase-A histograms → remapped dense tables) must be
+    taken and agree with the host executor."""
+    from pinot_tpu.parallel import make_mesh
+    from pinot_tpu.query.plan import (adaptive_phase_a_specs,
+                                      adaptive_phase_b_spec)
+    segs, merged = wide_group_setup
+    plan = _plan(segs[0], "SELECT SUM(v), COUNT(*) FROM w "
+                          "WHERE a BETWEEN 'a100' AND 'a105' "
+                          "GROUP BY a, b TOP 20000")
+    pa = adaptive_phase_a_specs(plan.group_spec)
+    assert pa is not None and [s[1] for s in pa] == ["a", "a", "b", "b"]
+    assert {s[0] for s in pa} == {"min", "max"}
+    # simulated scout bounds: a in [100, 105], b full range; selective
+    spec2, empty = adaptive_phase_b_spec(
+        plan.group_spec, [(100, 105), (0, 249)], matched=50,
+        padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
+    assert not empty and spec2 is not None
+    assert spec2[0][0][1] == "idoff" and spec2[0][0][2] == 100
+    assert spec2[4] > 0                        # compacted (selective)
+
+    pql = ("SELECT SUM(v), COUNT(*) FROM w WHERE a BETWEEN 'a100' AND "
+           "'a105' GROUP BY a, b TOP 20000")
+    m = (merged["a"] >= "a100") & (merged["a"] <= "a105")
+    exp = {}
+    for a, b, v, ok in zip(merged["a"], merged["b"], merged["v"], m):
+        if ok:
+            k = (a, b)
+            e = exp.setdefault(k, [0, 0])
+            e[0] += int(v)
+            e[1] += 1
+    for engine in (QueryEngine(segs),
+                   QueryEngine(segs, mesh=make_mesh()),
+                   QueryEngine(segs, use_device=False)):
+        resp = engine.query(pql)
+        got_sum = {tuple(g["group"]): float(g["value"])
+                   for g in resp.aggregation_results[0].group_by_result}
+        got_cnt = {tuple(g["group"]): float(g["value"])
+                   for g in resp.aggregation_results[1].group_by_result}
+        assert got_sum == {k: float(v[0]) for k, v in exp.items()}
+        assert got_cnt == {k: float(v[1]) for k, v in exp.items()}
