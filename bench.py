@@ -555,6 +555,7 @@ def main() -> None:
         class _SynthStack:
             padded_docs = padded
             segments = plan_table.segments
+            num_docs = np.asarray(jax.device_get(num_docs_dev))
 
             def gather(self, needed_cols):
                 import jax.numpy as jnp

@@ -26,11 +26,13 @@ class BrokerClusterWatcher:
         self.manager = manager
         self.routing = routing or RoutingManager()
         self.time_boundary = time_boundary or TimeBoundaryService()
+        self.partition_pruner = PartitionZKMetadataPruner(manager)
         coordinator.watch_external_views(self._on_view)
         for table in coordinator.tables():
             self._on_view(coordinator.external_view(table))
 
     def _on_view(self, view: TableView) -> None:
+        self.partition_pruner.invalidate(view.table_name)
         if not view.segment_states:
             self.routing.remove_table(view.table_name)
             return
@@ -67,3 +69,93 @@ class BrokerClusterWatcher:
         if ends:
             self.time_boundary.update_from_segments(
                 offline_table, tc.name, unit or "DAYS", ends)
+
+
+class PartitionZKMetadataPruner:
+    """Broker-side partition pruning from segment ZK records.
+
+    Parity: pinot-broker/.../pruner/PartitionZKMetadataPruner — before
+    scatter, EQ predicates on partitioned columns eliminate segments
+    whose recorded partition-id sets cannot match, cutting server
+    fan-out (the functional outcome of the reference's partition-aware
+    routing builders). Partition metadata and schemas are cached per
+    table; BrokerClusterWatcher invalidates the cache on external-view
+    changes, keeping the query hot path free of property-store reads.
+    Any malformed metadata fails OPEN (segment kept, never dropped).
+    """
+
+    def __init__(self, manager: ResourceManager):
+        self.manager = manager
+        self._meta: dict = {}      # table → {segment: partitionMetadata}
+        self._schemas: dict = {}   # table → Schema | None
+
+    def invalidate(self, table: str) -> None:
+        self._meta.pop(table, None)
+        self._schemas.pop(table, None)
+
+    def _table_meta(self, table: str) -> dict:
+        cached = self._meta.get(table)
+        if cached is None:
+            cached = {}
+            for seg in self.manager.segment_names(table):
+                rec = self.manager.segment_metadata(table, seg) or {}
+                pm = rec.get("partitionMetadata") or {}
+                if pm:
+                    cached[seg] = pm
+            self._meta[table] = cached
+        return cached
+
+    def _schema(self, table: str):
+        if table not in self._schemas:
+            self._schemas[table] = self.manager.get_schema(
+                raw_table(table))
+        return self._schemas[table]
+
+    def prune(self, request, table: str, segments):
+        try:
+            meta = self._table_meta(table)
+            if not meta:
+                return list(segments)
+            schema = self._schema(table)
+            memo: dict = {}
+            kept = []
+            for seg in segments:
+                pm = meta.get(seg)
+                if pm and self._pruned(request.filter, pm, schema, memo):
+                    continue
+                kept.append(seg)
+            return kept
+        except Exception:  # noqa: BLE001 — pruning is an optimization:
+            return list(segments)      # fail open on any metadata issue
+
+    def _pruned(self, node, pm, schema, memo) -> bool:
+        from pinot_tpu.common.request import FilterOperator
+        if node is None:
+            return False
+        if node.operator == FilterOperator.AND:
+            return any(self._pruned(c, pm, schema, memo)
+                       for c in node.children)
+        if node.operator == FilterOperator.OR:
+            return all(self._pruned(c, pm, schema, memo)
+                       for c in node.children)
+        if node.operator != FilterOperator.EQUALITY:
+            return False
+        info = pm.get(node.column)
+        if not info or not info.get("partitions"):
+            return False
+        from pinot_tpu.common.partition import partition_of_value
+        key = (node.column, info["functionName"],
+               int(info["numPartitions"]), node.values[0])
+        p = memo.get(key)
+        if p is None:
+            dt = None
+            if schema is not None and schema.has_column(node.column):
+                dt = schema.field(node.column).data_type.np_dtype
+            try:
+                p = partition_of_value(info["functionName"],
+                                       int(info["numPartitions"]),
+                                       dt, node.values[0])
+            except Exception:  # noqa: BLE001 — unknown function: keep
+                p = -1
+            memo[key] = p
+        return p >= 0 and p not in set(info["partitions"])

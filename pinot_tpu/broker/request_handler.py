@@ -143,7 +143,11 @@ class BrokerRequestHandler:
                  broker_id: str = "broker_0",
                  default_timeout_s: float = 15.0,
                  metrics: Optional[MetricsRegistry] = None,
-                 access_control=None):
+                 access_control=None,
+                 segment_pruner=None):
+        # optional broker-side segment pruner (PartitionZKMetadataPruner):
+        # prune(request, table, segments) -> segments
+        self.segment_pruner = segment_pruner
         self.routing = routing
         self.router = QueryRouter(transport, broker_id)
         self.time_boundary = time_boundary or TimeBoundaryService()
@@ -258,6 +262,23 @@ class BrokerRequestHandler:
                 resp.trace_info.setdefault(name, []).extend(spans)
         return resp
 
+    def _pruned_route(self, sub_request: BrokerRequest, table: str
+                      ) -> Dict[str, List[str]]:
+        routing = self.routing.route(table)
+        if self.segment_pruner is None:
+            return routing
+        out = {}
+        for server, segments in routing.items():
+            kept = self.segment_pruner.prune(sub_request, table, segments)
+            if kept:
+                out[server] = kept
+        # all segments pruned: keep one server with an empty segment list
+        # so the response still carries the table's schema/zero counts
+        if not out and routing:
+            server = sorted(routing)[0]
+            out[server] = []
+        return out
+
     def _resolve_routes(self, request: BrokerRequest, raw: str):
         """Physical-table fan-out with hybrid time-boundary split."""
         off, rt = offline_table(raw), realtime_table(raw)
@@ -274,12 +295,12 @@ class BrokerRequestHandler:
                 sub = self.optimizer.optimize(_retable(request, off))
                 if boundary is not None:
                     sub = attach_time_boundary(sub, boundary, offline=True)
-                routes.append((sub, self.routing.route(off)))
+                routes.append((sub, self._pruned_route(sub, off)))
             if has_rt:
                 sub = self.optimizer.optimize(_retable(request, rt))
                 if boundary is not None:
                     sub = attach_time_boundary(sub, boundary, offline=False)
-                routes.append((sub, self.routing.route(rt)))
+                routes.append((sub, self._pruned_route(sub, rt)))
         except RoutingError as e:
             # table removed between has_table and route (external-view race)
             return None, _error_response(190, f"RoutingError: {e}")

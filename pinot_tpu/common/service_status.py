@@ -1,0 +1,111 @@
+"""Service readiness status (parity: pinot-common
+utils/ServiceStatus.java:44-109).
+
+An instance reports STARTING until its state has converged with the
+controller's ideal state — current-state match for participants
+(servers), external-view match for query-routing readiness. Health
+endpoints and rolling restarts gate on GOOD.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Callable, List, Tuple
+
+from pinot_tpu.common.cluster_state import ONLINE
+
+
+class Status(enum.Enum):
+    STARTING = "STARTING"
+    GOOD = "GOOD"
+    BAD = "BAD"
+
+
+class ServiceStatusCallback:
+    def get_status(self) -> Tuple[Status, str]:
+        raise NotImplementedError
+
+
+class IdealStateAndCurrentStateMatchCallback(ServiceStatusCallback):
+    """GOOD once this instance's current state matches every ideal-state
+    assignment it holds (parity:
+    IdealStateAndCurrentStateMatchServiceStatusCallback). Converged
+    tables are remembered so steady-state polls stay O(new tables)."""
+
+    def __init__(self, coordinator, instance: str):
+        self.coordinator = coordinator
+        self.instance = instance
+        self._converged: set = set()
+
+    def get_status(self) -> Tuple[Status, str]:
+        for table in self.coordinator.tables():
+            if table in self._converged:
+                continue
+            ideal = self.coordinator.ideal_state(table)
+            current = (self.coordinator.store.get(
+                f"/CURRENTSTATES/{self.instance}/{table}") or {}
+            ).get("segments", {})
+            for seg, replicas in ideal.items():
+                want = replicas.get(self.instance)
+                if want is None or want == "DROPPED":
+                    continue
+                have = current.get(seg)
+                if have != want:
+                    return (Status.STARTING,
+                            f"{table}/{seg}: current={have} ideal={want}")
+            self._converged.add(table)
+        return Status.GOOD, "current state matches ideal state"
+
+
+class IdealStateAndExternalViewMatchCallback(ServiceStatusCallback):
+    """GOOD once the external view serves every ONLINE ideal-state entry
+    (parity: IdealStateAndExternalViewMatchServiceStatusCallback)."""
+
+    def __init__(self, coordinator):
+        self.coordinator = coordinator
+        self._converged: set = set()
+
+    def get_status(self) -> Tuple[Status, str]:
+        for table in self.coordinator.tables():
+            if table in self._converged:
+                continue
+            ideal = self.coordinator.ideal_state(table)
+            view = self.coordinator.external_view(table).segment_states
+            for seg, replicas in ideal.items():
+                want_online = {i for i, s in replicas.items() if s == ONLINE}
+                have_online = {i for i, s in view.get(seg, {}).items()
+                               if s == ONLINE}
+                if not want_online <= have_online:
+                    missing = sorted(want_online - have_online)
+                    return (Status.STARTING,
+                            f"{table}/{seg}: not serving on {missing}")
+            self._converged.add(table)
+        return Status.GOOD, "external view matches ideal state"
+
+
+class MultipleCallbackServiceStatus(ServiceStatusCallback):
+    """First non-GOOD child wins (parity:
+    MultipleCallbackServiceStatusCalback)."""
+
+    def __init__(self, callbacks: List[ServiceStatusCallback]):
+        self.callbacks = list(callbacks)
+
+    def get_status(self) -> Tuple[Status, str]:
+        for cb in self.callbacks:
+            status, desc = cb.get_status()
+            if status != Status.GOOD:
+                return status, desc
+        return Status.GOOD, "all callbacks GOOD"
+
+
+_registry: dict = {}
+
+
+def set_service_status(instance: str, cb: ServiceStatusCallback) -> None:
+    _registry[instance] = cb
+
+
+def get_service_status(instance: str) -> Tuple[Status, str]:
+    cb = _registry.get(instance)
+    if cb is None:
+        return Status.STARTING, "no status callback registered"
+    return cb.get_status()

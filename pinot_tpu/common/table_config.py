@@ -29,6 +29,11 @@ class IndexingConfig:
     load_mode: str = "MMAP"  # MMAP | HEAP (host) — device copy is explicit
     stream_configs: Dict[str, str] = dataclasses.field(default_factory=dict)
     aggregate_metrics: bool = False
+    # column → {"functionName": ..., "numPartitions": N} (parity:
+    # SegmentPartitionConfig); the segment creator records each built
+    # segment's observed partition ids in its metadata
+    segment_partition_config: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -40,6 +45,8 @@ class IndexingConfig:
             "loadMode": self.load_mode,
             "streamConfigs": self.stream_configs,
             "aggregateMetrics": self.aggregate_metrics,
+            "segmentPartitionConfig": {
+                "columnPartitionMap": self.segment_partition_config},
         }
 
     @classmethod
@@ -54,6 +61,8 @@ class IndexingConfig:
             load_mode=d.get("loadMode", "MMAP"),
             stream_configs=d.get("streamConfigs") or {},
             aggregate_metrics=d.get("aggregateMetrics", False),
+            segment_partition_config=(d.get("segmentPartitionConfig") or {}
+                                      ).get("columnPartitionMap", {}),
         )
 
 
@@ -139,6 +148,10 @@ class TableConfig:
     tenant_config: TenantConfig = dataclasses.field(default_factory=TenantConfig)
     quota_config: Optional[QuotaConfig] = None
     custom_config: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # task type → config map for the minion plane (parity: TableTaskConfig,
+    # e.g. {"ConvertToRawIndexTask": {"columnsToConvert": "a,b"}})
+    task_configs: Dict[str, Dict[str, str]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def table_name_with_type(self) -> str:
@@ -153,6 +166,8 @@ class TableConfig:
             "tenants": self.tenant_config.to_json(),
             "metadata": {"customConfigs": self.custom_config},
         }
+        if self.task_configs:
+            d["task"] = {"taskTypeConfigsMap": self.task_configs}
         if self.quota_config:
             d["quota"] = self.quota_config.to_json()
         return d
@@ -176,6 +191,8 @@ class TableConfig:
             quota_config=(QuotaConfig.from_json(d["quota"]) if d.get("quota")
                           else None),
             custom_config=(d.get("metadata", {}) or {}).get("customConfigs", {}),
+            task_configs=(d.get("task", {}) or {}).get("taskTypeConfigsMap",
+                                                       {}),
         )
 
     @classmethod

@@ -89,6 +89,15 @@ class ResourceManager:
         if os.path.abspath(segment_dir) != os.path.abspath(dest):
             self.fs.delete(dest)
             self.fs.copy(segment_dir, dest)
+        # per-column partition metadata rides the segment ZK record so the
+        # broker can prune before scatter (parity: the partition info in
+        # SegmentZKMetadata consumed by PartitionZKMetadataPruner)
+        partition_meta = {
+            cname: {"functionName": cm.partition_function,
+                    "numPartitions": cm.num_partitions,
+                    "partitions": list(cm.partitions)}
+            for cname, cm in meta.columns.items()
+            if cm.partition_function and cm.partitions}
         self.store.set(f"{SEGMENTS}/{table}/{name}", {
             "segmentName": name,
             "downloadPath": dest,
@@ -98,6 +107,7 @@ class ResourceManager:
             "totalDocs": meta.total_docs,
             "pushTimeMs": int(time.time() * 1e3),
             "crc": meta.crc,
+            "partitionMetadata": partition_meta,
         })
         replicas = config.segments_config.replication
         strategy = self._assignments.setdefault(

@@ -1,0 +1,27 @@
+"""Minion plane: background segment-maintenance tasks.
+
+Parity: pinot-minion (worker + executor SPI) and
+pinot-controller helix/core/minion (task manager + generators),
+rebuilt on the cluster property store instead of the Helix Task
+Framework.
+"""
+from pinot_tpu.minion.executors import (CONVERT_TO_RAW_TASK,
+                                        MERGE_ROLLUP_TASK, PURGE_TASK,
+                                        MinionContext, PinotTaskExecutor,
+                                        TaskExecutorRegistry)
+from pinot_tpu.minion.task_manager import (ConvertToRawIndexTaskGenerator,
+                                           PinotTaskGenerator,
+                                           PinotTaskManager,
+                                           PurgeTaskGenerator)
+from pinot_tpu.minion.tasks import (COMPLETED, ERROR, GENERATED,
+                                    IN_PROGRESS, PinotTaskConfig, TaskQueue)
+from pinot_tpu.minion.worker import MinionWorker
+
+__all__ = [
+    "CONVERT_TO_RAW_TASK", "MERGE_ROLLUP_TASK", "PURGE_TASK",
+    "MinionContext", "PinotTaskExecutor", "TaskExecutorRegistry",
+    "ConvertToRawIndexTaskGenerator", "PinotTaskGenerator",
+    "PinotTaskManager", "PurgeTaskGenerator", "COMPLETED", "ERROR",
+    "GENERATED", "IN_PROGRESS", "PinotTaskConfig", "TaskQueue",
+    "MinionWorker",
+]

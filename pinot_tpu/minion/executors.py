@@ -1,0 +1,173 @@
+"""Minion task executors: segment conversion jobs.
+
+Parity: pinot-minion/.../executor/ (PinotTaskExecutor SPI,
+PurgeTaskExecutor, ConvertToRawIndexTaskExecutor) and the rollup merge in
+core/minion/rollup/MergeRollupSegmentConverter.java. Each executor takes
+a downloaded segment directory, produces a converted segment in a
+working directory, and the worker re-uploads it (refresh) through the
+controller.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.ingestion.record_reader import SegmentRecordReader
+from pinot_tpu.minion.tasks import (COLUMNS_TO_CONVERT_KEY,
+                                    MERGED_SEGMENTS_KEY, SEGMENT_NAME_KEY,
+                                    TABLE_NAME_KEY, PinotTaskConfig)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+PURGE_TASK = "PurgeTask"
+CONVERT_TO_RAW_TASK = "ConvertToRawIndexTask"
+MERGE_ROLLUP_TASK = "MergeRollupTask"
+
+
+class SegmentConversionResult:
+    def __init__(self, out_dir: str, segment_name: str,
+                 custom: Optional[Dict] = None):
+        self.out_dir = out_dir
+        self.segment_name = segment_name
+        self.custom = custom or {}
+
+
+class MinionContext:
+    """Per-process extension points (parity: MinionContext —
+    recordPurgerFactory / recordModifierFactory)."""
+
+    def __init__(self):
+        # table → row-predicate: True means PURGE the row
+        self.record_purger_factory: Dict[str, Callable[[dict], bool]] = {}
+        # table → row-transform (mutates/returns the row)
+        self.record_modifier_factory: Dict[str, Callable[[dict], dict]] = {}
+
+
+class PinotTaskExecutor:
+    """SPI (parity: PinotTaskExecutor.executeTask)."""
+
+    task_type: str = ""
+
+    def execute(self, task: PinotTaskConfig, schema: Schema,
+                table_config: TableConfig, input_dirs: List[str],
+                work_dir: str, context: MinionContext
+                ) -> SegmentConversionResult:
+        raise NotImplementedError
+
+
+class PurgeTaskExecutor(PinotTaskExecutor):
+    """Drop/modify rows by the table's registered purger/modifier and
+    rebuild the segment (parity: PurgeTaskExecutor + SegmentPurger)."""
+
+    task_type = PURGE_TASK
+
+    def execute(self, task, schema, table_config, input_dirs, work_dir,
+                context) -> SegmentConversionResult:
+        table = task.configs[TABLE_NAME_KEY].rsplit("_", 1)[0]
+        purger = context.record_purger_factory.get(table)
+        modifier = context.record_modifier_factory.get(table)
+        segment = ImmutableSegmentLoader.load(input_dirs[0])
+        rows, purged, modified = [], 0, 0
+        for row in SegmentRecordReader(segment):
+            if purger is not None and purger(row):
+                purged += 1
+                continue
+            if modifier is not None:
+                row = modifier(row) or row
+                modified += 1
+            rows.append(row)
+        out = os.path.join(work_dir, segment.segment_name)
+        SegmentCreator(schema, table_config,
+                       segment_name=segment.segment_name).build(rows, out)
+        return SegmentConversionResult(
+            out, segment.segment_name,
+            {"numRecordsPurged": purged, "numRecordsModified": modified})
+
+
+class ConvertToRawIndexTaskExecutor(PinotTaskExecutor):
+    """Rebuild with the given columns as raw (no-dictionary) forward
+    indexes (parity: ConvertToRawIndexTaskExecutor + RawIndexConverter)."""
+
+    task_type = CONVERT_TO_RAW_TASK
+
+    def execute(self, task, schema, table_config, input_dirs, work_dir,
+                context) -> SegmentConversionResult:
+        import copy
+        cols = [c for c in
+                task.configs.get(COLUMNS_TO_CONVERT_KEY, "").split(",") if c]
+        segment = ImmutableSegmentLoader.load(input_dirs[0])
+        cfg = copy.deepcopy(table_config)
+        no_dict = set(cfg.indexing_config.no_dictionary_columns) | set(cols)
+        cfg.indexing_config.no_dictionary_columns = sorted(no_dict)
+        rows = list(SegmentRecordReader(segment))
+        out = os.path.join(work_dir, segment.segment_name)
+        SegmentCreator(schema, cfg,
+                       segment_name=segment.segment_name).build(rows, out)
+        return SegmentConversionResult(out, segment.segment_name,
+                                       {"columnsConverted": cols})
+
+
+class MergeRollupTaskExecutor(PinotTaskExecutor):
+    """Concatenate N segments' rows, optionally rolling up metrics by the
+    dimension key (parity: MergeRollupSegmentConverter CONCATENATE /
+    ROLLUP modes)."""
+
+    task_type = MERGE_ROLLUP_TASK
+
+    def execute(self, task, schema, table_config, input_dirs, work_dir,
+                context) -> SegmentConversionResult:
+        rollup = task.configs.get("mergeType", "CONCATENATE") == "ROLLUP"
+        rows: List[dict] = []
+        for d in input_dirs:
+            rows.extend(SegmentRecordReader(ImmutableSegmentLoader.load(d)))
+        if rollup:
+            metric_names = {f.name for f in schema.fields
+                            if f.field_type.name == "METRIC"}
+            merged: Dict[tuple, dict] = {}
+            dims = [f.name for f in schema.fields
+                    if f.name not in metric_names]
+            for row in rows:
+                key = tuple(_freeze(row.get(d)) for d in dims)
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = dict(row)
+                else:
+                    for m in metric_names:   # SUM rollup (default agg)
+                        cur[m] = cur[m] + row[m]
+            rows = list(merged.values())
+        name = task.configs.get(
+            SEGMENT_NAME_KEY,
+            "merged_" + "_".join(os.path.basename(d) for d in input_dirs))
+        name = f"{name}_merged" if name in {
+            os.path.basename(d) for d in input_dirs} else name
+        out = os.path.join(work_dir, name)
+        SegmentCreator(schema, table_config, segment_name=name).build(
+            rows, out)
+        return SegmentConversionResult(out, name,
+                                       {"numSegmentsMerged": len(input_dirs),
+                                        "rollup": rollup})
+
+
+def _freeze(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
+class TaskExecutorRegistry:
+    """Parity: TaskExecutorFactoryRegistry."""
+
+    def __init__(self):
+        self._executors: Dict[str, PinotTaskExecutor] = {}
+        for ex in (PurgeTaskExecutor(), ConvertToRawIndexTaskExecutor(),
+                   MergeRollupTaskExecutor()):
+            self.register(ex)
+
+    def register(self, executor: PinotTaskExecutor) -> None:
+        self._executors[executor.task_type] = executor
+
+    def get(self, task_type: str) -> Optional[PinotTaskExecutor]:
+        return self._executors.get(task_type)
+
+    def task_types(self) -> List[str]:
+        return sorted(self._executors)

@@ -1,0 +1,88 @@
+"""Controller-side task generation (parity: PinotTaskManager +
+TaskGeneratorRegistry + ConvertToRawIndexTaskGenerator).
+
+A periodic task walks every table's `task_configs`; each registered
+generator emits PinotTaskConfigs for work not yet queued (dedup against
+open tasks per segment).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from pinot_tpu.minion.executors import (CONVERT_TO_RAW_TASK, MERGE_ROLLUP_TASK,
+                                        PURGE_TASK)
+from pinot_tpu.minion.tasks import (COLUMNS_TO_CONVERT_KEY, SEGMENT_NAME_KEY,
+                                    TABLE_NAME_KEY, PinotTaskConfig,
+                                    TaskQueue)
+
+
+class PinotTaskGenerator:
+    task_type: str = ""
+
+    def generate(self, table: str, table_config, manager,
+                 queue: TaskQueue) -> List[PinotTaskConfig]:
+        raise NotImplementedError
+
+
+class ConvertToRawIndexTaskGenerator(PinotTaskGenerator):
+    """One task per segment that still has dictionaries on the configured
+    columns (parity: ConvertToRawIndexTaskGenerator)."""
+
+    task_type = CONVERT_TO_RAW_TASK
+
+    def generate(self, table, table_config, manager, queue):
+        cfg = table_config.task_configs.get(self.task_type, {})
+        columns = cfg.get(COLUMNS_TO_CONVERT_KEY, "")
+        out = []
+        for seg in manager.segment_names(table):
+            if queue.tasks_for_segment(self.task_type, table, seg):
+                continue
+            meta = manager.segment_metadata(table, seg) or {}
+            if meta.get("customMap", {}).get(f"{self.task_type}.time"):
+                continue                      # already converted
+            out.append(PinotTaskConfig(self.task_type, {
+                TABLE_NAME_KEY: table, SEGMENT_NAME_KEY: seg,
+                COLUMNS_TO_CONVERT_KEY: columns}))
+        return out
+
+
+class PurgeTaskGenerator(PinotTaskGenerator):
+    task_type = PURGE_TASK
+
+    def generate(self, table, table_config, manager, queue):
+        out = []
+        for seg in manager.segment_names(table):
+            if queue.tasks_for_segment(self.task_type, table, seg):
+                continue
+            out.append(PinotTaskConfig(self.task_type, {
+                TABLE_NAME_KEY: table, SEGMENT_NAME_KEY: seg}))
+        return out
+
+
+class PinotTaskManager:
+    """Walks tables and schedules generator output onto the queue."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.queue = TaskQueue(manager.store)
+        self._generators: Dict[str, PinotTaskGenerator] = {}
+        for g in (ConvertToRawIndexTaskGenerator(), PurgeTaskGenerator()):
+            self.register(g)
+
+    def register(self, gen: PinotTaskGenerator) -> None:
+        self._generators[gen.task_type] = gen
+
+    def schedule_tasks(self) -> List[str]:
+        scheduled = []
+        for table in self.manager.table_names():
+            config = self.manager.get_table_config(table)
+            if config is None:
+                continue
+            for ttype in config.task_configs:
+                gen = self._generators.get(ttype)
+                if gen is None:
+                    continue
+                for task in gen.generate(table, config, self.manager,
+                                         self.queue):
+                    scheduled.append(self.queue.submit(task))
+        return scheduled

@@ -1,0 +1,131 @@
+"""Minion task model + property-store-backed task queue.
+
+Parity: the Helix Task Framework usage in
+pinot-controller/.../helix/core/minion/PinotHelixTaskResourceManager.java
+(task queues per task type, task states) and
+pinot-common PinotTaskConfig. The TPU build replaces the Helix task
+state machine with atomic claim/complete updates on the cluster
+property store — the same single-writer CAS discipline the ideal-state
+updates use.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from pinot_tpu.controller.property_store import PropertyStore
+
+TASKS_ROOT = "/TASKS"
+
+# task states (parity: TaskState in the Helix task framework)
+GENERATED = "GENERATED"
+IN_PROGRESS = "IN_PROGRESS"
+COMPLETED = "COMPLETED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class PinotTaskConfig:
+    """Parity: pinot-common PinotTaskConfig — task type + string configs."""
+    task_type: str
+    configs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    task_id: str = ""
+
+    def __post_init__(self):
+        if not self.task_id:
+            self.task_id = (f"Task_{self.task_type}_"
+                            f"{uuid.uuid4().hex[:12]}")
+
+    def to_json(self) -> dict:
+        return {"taskType": self.task_type, "taskId": self.task_id,
+                "configs": dict(self.configs)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PinotTaskConfig":
+        return cls(task_type=d["taskType"], configs=dict(d.get("configs", {})),
+                   task_id=d["taskId"])
+
+
+# common config keys (parity: core/common/MinionConstants.java)
+TABLE_NAME_KEY = "tableName"
+SEGMENT_NAME_KEY = "segmentName"
+DOWNLOAD_URL_KEY = "downloadURL"
+COLUMNS_TO_CONVERT_KEY = "columnsToConvert"
+MERGED_SEGMENTS_KEY = "segmentNames"          # comma-separated, merge tasks
+
+
+class TaskQueue:
+    """Task lifecycle on the property store.
+
+    /TASKS/<taskType>/<taskId> → {"config": ..., "state": ...,
+    "worker": ..., "info": ...}. Claiming is an atomic read-modify-write
+    so concurrent minions never double-run a task.
+    """
+
+    def __init__(self, store: PropertyStore):
+        self.store = store
+
+    def submit(self, task: PinotTaskConfig) -> str:
+        self.store.set(f"{TASKS_ROOT}/{task.task_type}/{task.task_id}", {
+            "config": task.to_json(), "state": GENERATED,
+            "submitTimeMs": int(time.time() * 1e3)})
+        return task.task_id
+
+    def claim(self, worker_id: str, task_types: List[str]
+              ) -> Optional[PinotTaskConfig]:
+        """Atomically move one GENERATED task to IN_PROGRESS."""
+        for ttype in task_types:
+            for task_id in self.store.children(f"{TASKS_ROOT}/{ttype}"):
+                path = f"{TASKS_ROOT}/{ttype}/{task_id}"
+                claimed = {}
+
+                def try_claim(rec):
+                    if rec and rec.get("state") == GENERATED:
+                        rec = dict(rec)
+                        rec["state"] = IN_PROGRESS
+                        rec["worker"] = worker_id
+                        claimed["config"] = rec["config"]
+                    return rec or {}
+
+                self.store.update(path, try_claim)
+                if claimed:
+                    return PinotTaskConfig.from_json(claimed["config"])
+        return None
+
+    def finish(self, task: PinotTaskConfig, state: str,
+               info: str = "") -> None:
+        path = f"{TASKS_ROOT}/{task.task_type}/{task.task_id}"
+
+        def done(rec):
+            rec = dict(rec or {})
+            rec["state"] = state
+            rec["info"] = info
+            rec["endTimeMs"] = int(time.time() * 1e3)
+            return rec
+
+        self.store.update(path, done)
+
+    def task_states(self, task_type: str) -> Dict[str, str]:
+        out = {}
+        for task_id in self.store.children(f"{TASKS_ROOT}/{task_type}"):
+            rec = self.store.get(f"{TASKS_ROOT}/{task_type}/{task_id}")
+            if rec:
+                out[task_id] = rec.get("state", "?")
+        return out
+
+    def tasks_for_segment(self, task_type: str, table: str,
+                          segment: str) -> List[str]:
+        """Open (non-terminal) tasks already covering a segment — used by
+        generators to avoid duplicate scheduling."""
+        out = []
+        for task_id in self.store.children(f"{TASKS_ROOT}/{task_type}"):
+            rec = self.store.get(f"{TASKS_ROOT}/{task_type}/{task_id}")
+            if not rec or rec.get("state") in (COMPLETED, ERROR):
+                continue
+            cfg = rec.get("config", {}).get("configs", {})
+            if cfg.get(TABLE_NAME_KEY) == table and \
+                    segment in cfg.get(SEGMENT_NAME_KEY, "").split(","):
+                out.append(task_id)
+        return out

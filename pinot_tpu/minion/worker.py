@@ -1,0 +1,112 @@
+"""Minion worker: claims tasks, converts segments, re-uploads.
+
+Parity: pinot-minion/.../MinionStarter.java + TaskFactory — a Helix
+participant that runs task-framework jobs. Here the worker polls the
+property-store task queue (atomic claim), downloads the segment from the
+deep store, runs the registered executor, uploads the converted segment
+through the controller manager (a refresh bounce re-loads it on
+servers), and marks the task COMPLETED/ERROR.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import traceback
+from typing import List, Optional
+
+from pinot_tpu.minion.executors import (MinionContext, TaskExecutorRegistry)
+from pinot_tpu.minion.tasks import (COMPLETED, ERROR, SEGMENT_NAME_KEY,
+                                    TABLE_NAME_KEY, PinotTaskConfig,
+                                    TaskQueue)
+
+
+class MinionWorker:
+    def __init__(self, manager, instance_id: str = "Minion_0",
+                 work_dir: Optional[str] = None,
+                 registry: Optional[TaskExecutorRegistry] = None,
+                 context: Optional[MinionContext] = None):
+        self.manager = manager                      # ControllerManager
+        self.instance_id = instance_id
+        self.queue = TaskQueue(manager.store)
+        self.registry = registry or TaskExecutorRegistry()
+        self.context = context or MinionContext()
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="minion_")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- single task ------------------------------------------------------
+
+    def run_one(self) -> Optional[str]:
+        """Claim and execute one task; returns its id or None when idle."""
+        task = self.queue.claim(self.instance_id,
+                                self.registry.task_types())
+        if task is None:
+            return None
+        try:
+            self._execute(task)
+            self.queue.finish(task, COMPLETED)
+        except Exception as e:  # noqa: BLE001 — task isolation boundary
+            self.queue.finish(task, ERROR,
+                              f"{type(e).__name__}: {e}\n"
+                              f"{traceback.format_exc(limit=5)}")
+        return task.task_id
+
+    def _execute(self, task: PinotTaskConfig) -> None:
+        table = task.configs[TABLE_NAME_KEY]
+        segments = [s for s in
+                    task.configs.get(SEGMENT_NAME_KEY, "").split(",") if s]
+        executor = self.registry.get(task.task_type)
+        if executor is None:
+            raise ValueError(f"no executor for task type {task.task_type}")
+        schema = self.manager.get_schema(table.rsplit("_", 1)[0]) or \
+            self.manager.get_schema(table)
+        config = self.manager.get_table_config(table)
+        if schema is None or config is None:
+            raise ValueError(f"missing schema/config for {table}")
+        # download from the deep store (local-FS copy here; the PinotFS
+        # SPI covers remote stores)
+        inputs = []
+        task_dir = os.path.join(self.work_dir, task.task_id)
+        os.makedirs(task_dir, exist_ok=True)
+        for seg in segments:
+            meta = self.manager.segment_metadata(table, seg)
+            if meta is None:
+                raise ValueError(f"segment {seg} not found in {table}")
+            local = os.path.join(task_dir, "in", seg)
+            os.makedirs(os.path.dirname(local), exist_ok=True)
+            self.manager.fs.copy(meta["downloadPath"], local)
+            inputs.append(local)
+        out_dir = os.path.join(task_dir, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        result = executor.execute(task, schema, config, inputs, out_dir,
+                                  self.context)
+        self.manager.add_segment(table, result.out_dir)
+        shutil.rmtree(task_dir, ignore_errors=True)
+
+    # -- background loop --------------------------------------------------
+
+    def start(self, poll_interval_s: float = 0.2) -> None:
+        def loop():
+            while not self._stop.is_set():
+                if self.run_one() is None:
+                    self._stop.wait(poll_interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=self.instance_id)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def drain(self) -> List[str]:
+        """Run queued tasks to completion (test/batch convenience)."""
+        done = []
+        while True:
+            tid = self.run_one()
+            if tid is None:
+                return done
+            done.append(tid)

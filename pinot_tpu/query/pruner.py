@@ -18,7 +18,7 @@ class SegmentPrunerService:
     def __init__(self, pruners: Optional[List] = None):
         self.pruners = pruners if pruners is not None else [
             ValidSegmentPruner(), DataSchemaSegmentPruner(),
-            ColumnValueSegmentPruner()]
+            ColumnValueSegmentPruner(), PartitionSegmentPruner()]
 
     def prune(self, segments: List[ImmutableSegment], request: BrokerRequest
               ) -> List[ImmutableSegment]:
@@ -104,3 +104,42 @@ class ColumnValueSegmentPruner:
             if hi < mn or (hi == mn and not node.upper_inclusive):
                 return True
         return False
+
+
+class PartitionSegmentPruner:
+    """Prune segments whose partition-id set cannot contain an EQ literal.
+
+    Parity: core/query/pruner/PartitionSegmentPruner — the segment's
+    column metadata records the partition function + ids present; an
+    equality predicate on a partitioned column maps the literal to its
+    partition and skips segments that never stored that partition.
+    """
+
+    def prune(self, segment: ImmutableSegment,
+              request: BrokerRequest) -> bool:
+        return self._prune_node(segment, request.filter)
+
+    def _prune_node(self, segment: ImmutableSegment,
+                    node: Optional[FilterQueryTree]) -> bool:
+        if node is None:
+            return False
+        if node.operator == FilterOperator.AND:
+            return any(self._prune_node(segment, c) for c in node.children)
+        if node.operator == FilterOperator.OR:
+            return all(self._prune_node(segment, c) for c in node.children)
+        if node.operator != FilterOperator.EQUALITY:
+            return False
+        from pinot_tpu.common.expression import is_expression
+        if is_expression(node.column) or not segment.has_column(node.column):
+            return False
+        cm = segment.data_source(node.column).metadata
+        if not cm.partition_function or not cm.partitions:
+            return False
+        from pinot_tpu.common.partition import partition_of_value
+        try:
+            p = partition_of_value(cm.partition_function,
+                                   cm.num_partitions,
+                                   cm.data_type.np_dtype, node.values[0])
+        except Exception:  # noqa: BLE001 — unknown function/bad metadata:
+            return False   # fail open (never wrongly drop a segment)
+        return p not in set(cm.partitions)

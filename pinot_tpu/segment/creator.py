@@ -190,6 +190,28 @@ class SegmentCreator:
 
         num_docs = num_docs or 0
 
+        # -- column partitions (parity: SegmentPartitionConfig → per-
+        # column partition metadata used by partition-aware pruning) ------
+        part_cfg = getattr(idx_cfg, "segment_partition_config", {}) or {}
+        for name, pc in part_cfg.items():
+            cm = col_meta.get(name)
+            if cm is None:
+                continue
+            from pinot_tpu.common.partition import (
+                coerce_partition_value, make_partition_function)
+            fn = make_partition_function(pc["functionName"],
+                                         int(pc["numPartitions"]))
+            src = columns[name] if cm.single_value else \
+                [v for row in columns[name] for v in row]
+            # coerce through the column dtype so build-time hashing
+            # agrees with the pruners' query-literal hashing
+            dt = cm.data_type.np_dtype
+            cm.partition_function = fn.name
+            cm.num_partitions = fn.num_partitions
+            cm.partitions = sorted(
+                {fn.get_partition(coerce_partition_value(dt, _plain(v)))
+                 for v in src})
+
         # -- time range ---------------------------------------------------
         tcol = self.schema.time_column
         start_t = end_t = None

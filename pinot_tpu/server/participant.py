@@ -29,6 +29,13 @@ class ServerParticipant(StateModel):
         self.completion = completion
         self.work_dir = work_dir
         self._realtime = None
+        # readiness: GOOD once current state converges with ideal state
+        # (parity: HelixServerStarter registering ServiceStatus callbacks)
+        from pinot_tpu.common.service_status import (
+            IdealStateAndCurrentStateMatchCallback, set_service_status)
+        set_service_status(server.instance_id,
+                           IdealStateAndCurrentStateMatchCallback(
+                               manager.coordinator, server.instance_id))
 
     @property
     def realtime(self):

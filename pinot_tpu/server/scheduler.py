@@ -100,4 +100,100 @@ def make_scheduler(algorithm: str = "fcfs", num_workers: int = 4
     """Parity: QuerySchedulerFactory.create (falls back to FCFS)."""
     if algorithm == "tokenbucket":
         return TokenBucketScheduler(num_workers)
+    if algorithm == "bounded_fcfs":
+        return BoundedFCFSScheduler(num_workers)
     return FCFSQueryScheduler(num_workers)
+
+
+class SchedulerOutOfCapacityError(Exception):
+    """Parity: OutOfCapacityException — bounded queue rejected the query."""
+
+
+class ResourceLimitPolicy:
+    """Per-group concurrency/queue bounds.
+
+    Parity: core/query/scheduler/resources/ResourceLimitPolicy — a group
+    (table) may use at most `table_threads_hard_limit` workers at once,
+    and at most `max_pending_per_group` queries may wait.
+    """
+
+    def __init__(self, num_workers: int,
+                 max_threads_per_group_pct: float = 0.5,
+                 max_pending_per_group: int = 64):
+        self.table_threads_hard_limit = max(
+            1, int(num_workers * max_threads_per_group_pct))
+        self.max_pending_per_group = max_pending_per_group
+
+
+class BoundedFCFSScheduler(QueryScheduler):
+    """Per-group FCFS with bounded per-group resources.
+
+    Parity: BoundedFCFSScheduler + PolicyBasedResourceManager — FCFS
+    order across groups (oldest pending first), but a group already at
+    its thread limit is skipped, and a group with a full pending queue
+    rejects new queries instead of growing without bound.
+    """
+
+    def __init__(self, num_workers: int = 4,
+                 policy: Optional[ResourceLimitPolicy] = None):
+        super().__init__(num_workers)
+        self.policy = policy or ResourceLimitPolicy(num_workers)
+        self._pending: Dict[str, list] = {}
+        self._running: Dict[str, int] = {}
+        self._order: list = []            # (seq, group) FCFS across groups
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+        future: Future = Future()
+        with self._lock:
+            q = self._pending.setdefault(group, [])
+            if len(q) >= self.policy.max_pending_per_group:
+                future.set_exception(SchedulerOutOfCapacityError(
+                    f"group {group}: {len(q)} pending >= "
+                    f"{self.policy.max_pending_per_group}"))
+                return future
+            q.append((fn, future))
+            heapq.heappush(self._order, (self._seq, group))
+            self._seq += 1
+        self._pool.submit(self._drain)
+        return future
+
+    def _next(self):
+        """Oldest pending entry whose group is under its thread limit."""
+        skipped = []
+        try:
+            while self._order:
+                seq, group = heapq.heappop(self._order)
+                if not self._pending.get(group):
+                    continue            # stale order entry
+                if self._running.get(group, 0) >= \
+                        self.policy.table_threads_hard_limit:
+                    skipped.append((seq, group))
+                    continue
+                fn, future = self._pending[group].pop(0)
+                self._running[group] = self._running.get(group, 0) + 1
+                return group, fn, future
+            return None
+        finally:
+            for item in skipped:
+                heapq.heappush(self._order, item)
+
+    def _drain(self) -> None:
+        with self._lock:
+            item = self._next()
+        if item is None:
+            return
+        group, fn, future = item
+        try:
+            if future.set_running_or_notify_cancel():
+                try:
+                    future.set_result(fn())
+                except BaseException as e:  # noqa: BLE001
+                    future.set_exception(e)
+        finally:
+            with self._lock:
+                self._running[group] -= 1
+                more = any(self._pending.values())
+            if more:
+                self._pool.submit(self._drain)

@@ -1,0 +1,255 @@
+"""pinot-tpu-admin: the admin command surface.
+
+Parity: pinot-tools PinotAdministrator (tools/admin/command/ — StartServer
+/AddTable/AddSchema/CreateSegment/UploadSegment/PostQuery/RebalanceTable/
+DeleteSegment/Quickstart...). Commands speak to the controller/broker
+REST APIs so they work against any running cluster; `quickstart` boots an
+embedded cluster in-process (parity: tools/Quickstart.java:125-144).
+
+Usage:
+    python -m pinot_tpu.tools.admin <command> [options]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Optional
+
+
+def _http(method: str, url: str, body: Optional[bytes] = None,
+          content_type: str = "application/json") -> dict:
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers={"Content-Type": content_type}
+                                 if body else {})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        data = resp.read()
+    try:
+        return json.loads(data)
+    except ValueError:
+        return {"raw": data.decode("utf-8", "replace")}
+
+
+def cmd_add_schema(args) -> int:
+    with open(args.schema_file) as f:
+        body = f.read().encode()
+    out = _http("POST", f"http://{args.controller}/schemas", body)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_add_table(args) -> int:
+    with open(args.table_config_file) as f:
+        body = f.read().encode()
+    out = _http("POST", f"http://{args.controller}/tables", body)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_create_segment(args) -> int:
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.tools.create_segment import create_segment_from_file
+    with open(args.schema_file) as f:
+        schema = Schema.from_json(json.load(f))
+    table_config = None
+    if args.table_config_file:
+        with open(args.table_config_file) as f:
+            table_config = TableConfig.from_json(json.load(f))
+    meta = create_segment_from_file(
+        args.input, args.format, schema, args.out_dir,
+        table_config=table_config, segment_name=args.segment_name)
+    print(json.dumps({"segmentName": meta.segment_name,
+                      "totalDocs": meta.total_docs}))
+    return 0
+
+
+def cmd_upload_segment(args) -> int:
+    from pinot_tpu.controller.http_api import pack_segment_dir
+    body = pack_segment_dir(args.segment_dir)
+    out = _http("POST",
+                f"http://{args.controller}/segments/{args.table}",
+                body, content_type="application/octet-stream")
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_post_query(args) -> int:
+    body = json.dumps({"pql": args.query}).encode()
+    out = _http("POST", f"http://{args.broker}/query", body)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_rebalance_table(args) -> int:
+    out = _http("POST",
+                f"http://{args.controller}/tables/{args.table}/rebalance"
+                f"?dryRun={'true' if args.dry_run else 'false'}")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_delete_segment(args) -> int:
+    out = _http("DELETE",
+                f"http://{args.controller}/segments/{args.table}/"
+                f"{args.segment}")
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_show_cluster(args) -> int:
+    tables = _http("GET", f"http://{args.controller}/tables")["tables"]
+    out = {}
+    for t in tables:
+        ev = _http("GET",
+                   f"http://{args.controller}/tables/{t}/externalview")
+        out[t] = ev
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_quickstart(args) -> int:
+    """Boot an embedded cluster with demo data and run sample queries.
+
+    Parity: tools/Quickstart.java (offline baseballStats quickstart).
+    """
+    import tempfile
+
+    sys.path.insert(0, "tests")  # reuse the demo fixture generators
+    import numpy as np
+
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, dimension, metric
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    work = args.dir or tempfile.mkdtemp(prefix="pinot_tpu_quickstart_")
+    schema = Schema("baseballStats", [
+        dimension("playerName", DataType.STRING),
+        dimension("teamID", DataType.STRING),
+        dimension("league", DataType.STRING),
+        metric("runs", DataType.INT),
+        metric("hits", DataType.LONG),
+        dimension("yearID", DataType.INT),
+    ])
+    config = TableConfig("baseballStats")
+    cluster = EmbeddedCluster(work, num_servers=2, tcp=True, http=True)
+    cluster.add_schema(schema)
+    cluster.add_table(config)
+    rng = np.random.default_rng(7)
+    n = args.rows
+    import os
+    for i in range(2):
+        cols = {
+            "playerName": np.array(
+                [f"player{j:04d}" for j in
+                 rng.integers(0, 500, n)], dtype=object),
+            "teamID": np.array([f"T{j:02d}" for j in
+                                rng.integers(0, 30, n)], dtype=object),
+            "league": np.array([("AL", "NL")[j] for j in
+                                rng.integers(0, 2, n)], dtype=object),
+            "runs": rng.integers(0, 150, n).astype(np.int32),
+            "hits": rng.integers(0, 250, n).astype(np.int64),
+            "yearID": rng.integers(1990, 2020, n).astype(np.int32),
+        }
+        d = os.path.join(work, f"quickstart_{i}")
+        SegmentCreator(schema, config,
+                       segment_name=f"quickstart_{i}").build(cols, d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+    print(f"Controller REST: http://127.0.0.1:{cluster.controller_port}")
+    print(f"Broker query:    http://127.0.0.1:{cluster.broker_port}/query")
+    for q in (
+            "SELECT COUNT(*) FROM baseballStats",
+            "SELECT SUM(runs) FROM baseballStats WHERE league = 'AL'",
+            "SELECT SUM(hits), COUNT(*) FROM baseballStats "
+            "GROUP BY teamID TOP 5"):
+        resp = cluster.query(q)
+        print(f"\n> {q}")
+        print(json.dumps(resp.to_json(), indent=2)[:800])
+    if args.exit_after:
+        cluster.stop()
+        return 0
+    print("\nquickstart cluster running — Ctrl-C to stop")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        cluster.stop()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pinot-tpu-admin",
+                                description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def ctrl(sp):
+        sp.add_argument("--controller", default="127.0.0.1:9000")
+
+    sp = sub.add_parser("AddSchema", help="upload a schema JSON")
+    ctrl(sp)
+    sp.add_argument("--schema-file", required=True)
+    sp.set_defaults(fn=cmd_add_schema)
+
+    sp = sub.add_parser("AddTable", help="create a table from config JSON")
+    ctrl(sp)
+    sp.add_argument("--table-config-file", required=True)
+    sp.set_defaults(fn=cmd_add_table)
+
+    sp = sub.add_parser("CreateSegment",
+                        help="build a segment from CSV/JSON input")
+    sp.add_argument("--input", required=True)
+    sp.add_argument("--format", default="csv", choices=["csv", "json"])
+    sp.add_argument("--schema-file", required=True)
+    sp.add_argument("--table-config-file")
+    sp.add_argument("--out-dir", required=True)
+    sp.add_argument("--segment-name")
+    sp.set_defaults(fn=cmd_create_segment)
+
+    sp = sub.add_parser("UploadSegment", help="push a segment dir")
+    ctrl(sp)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--segment-dir", required=True)
+    sp.set_defaults(fn=cmd_upload_segment)
+
+    sp = sub.add_parser("PostQuery", help="run a PQL query via broker")
+    sp.add_argument("--broker", default="127.0.0.1:8099")
+    sp.add_argument("--query", required=True)
+    sp.set_defaults(fn=cmd_post_query)
+
+    sp = sub.add_parser("RebalanceTable", help="rebalance segments")
+    ctrl(sp)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--dry-run", action="store_true")
+    sp.set_defaults(fn=cmd_rebalance_table)
+
+    sp = sub.add_parser("DeleteSegment", help="delete one segment")
+    ctrl(sp)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.set_defaults(fn=cmd_delete_segment)
+
+    sp = sub.add_parser("ShowCluster", help="tables + external views")
+    ctrl(sp)
+    sp.set_defaults(fn=cmd_show_cluster)
+
+    sp = sub.add_parser("Quickstart",
+                        help="embedded demo cluster with sample data")
+    sp.add_argument("--rows", type=int, default=10_000)
+    sp.add_argument("--dir")
+    sp.add_argument("--exit-after", action="store_true",
+                    help="stop the cluster after the sample queries")
+    sp.set_defaults(fn=cmd_quickstart)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

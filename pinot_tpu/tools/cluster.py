@@ -54,7 +54,8 @@ class EmbeddedCluster:
             transport = InProcessTransport(self.servers)
         self.broker = BrokerRequestHandler(
             self.watcher.routing, transport,
-            time_boundary=self.watcher.time_boundary)
+            time_boundary=self.watcher.time_boundary,
+            segment_pruner=self.watcher.partition_pruner)
         self.broker_api = None
         self.controller_api = None
         self.broker_port: Optional[int] = None

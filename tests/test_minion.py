@@ -1,0 +1,173 @@
+"""Minion plane tests.
+
+Mirrors the reference's PurgeTaskExecutorTest + the minion integration
+tests: executors convert real segments; the task queue claims
+atomically; the end-to-end path (generator → queue → worker → refresh
+upload → query) changes query results.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fixtures import make_schema, make_table_config, make_shared_columns
+
+from pinot_tpu.minion import (COMPLETED, CONVERT_TO_RAW_TASK, ERROR,
+                              GENERATED, PURGE_TASK, MinionWorker,
+                              PinotTaskConfig, PinotTaskManager, TaskQueue)
+from pinot_tpu.minion.executors import (MergeRollupTaskExecutor,
+                                        MinionContext, PurgeTaskExecutor)
+from pinot_tpu.minion.tasks import (MERGED_SEGMENTS_KEY, SEGMENT_NAME_KEY,
+                                    TABLE_NAME_KEY)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+def _build_segment(base, name="seg_0", n=1024, seed=0):
+    d = os.path.join(base, name)
+    cols = make_shared_columns(n, seed)
+    SegmentCreator(make_schema(), make_table_config(),
+                   segment_name=name).build(cols, d)
+    return d, cols
+
+
+# -- executors (unit) --------------------------------------------------------
+
+def test_purge_executor_drops_and_modifies_rows():
+    base = tempfile.mkdtemp()
+    d, cols = _build_segment(base)
+    ctx = MinionContext()
+    ctx.record_purger_factory["baseballStats"] = \
+        lambda row: row["league"] == "NL"
+    ctx.record_modifier_factory["baseballStats"] = \
+        lambda row: {**row, "runs": 0}
+    task = PinotTaskConfig(PURGE_TASK, {
+        TABLE_NAME_KEY: "baseballStats_OFFLINE", SEGMENT_NAME_KEY: "seg_0"})
+    out = tempfile.mkdtemp()
+    res = PurgeTaskExecutor().execute(task, make_schema(),
+                                      make_table_config(), [d], out, ctx)
+    seg = ImmutableSegmentLoader.load(res.out_dir)
+    n_nl = sum(1 for v in cols["league"] if v == "NL")
+    assert res.custom["numRecordsPurged"] == n_nl
+    assert seg.num_docs == len(cols["league"]) - n_nl
+    # modifier zeroed runs on every surviving row
+    assert seg.data_source("runs").metadata.max_value == 0
+
+
+def test_merge_rollup_executor_concat_and_rollup():
+    base = tempfile.mkdtemp()
+    d1, c1 = _build_segment(base, "m_0", seed=1)
+    d2, c2 = _build_segment(base, "m_1", seed=2)
+    out = tempfile.mkdtemp()
+    task = PinotTaskConfig("MergeRollupTask", {
+        TABLE_NAME_KEY: "baseballStats_OFFLINE",
+        SEGMENT_NAME_KEY: "merged_a", "mergeType": "CONCATENATE"})
+    res = MergeRollupTaskExecutor().execute(
+        task, make_schema(), make_table_config(), [d1, d2], out,
+        MinionContext())
+    seg = ImmutableSegmentLoader.load(res.out_dir)
+    assert seg.num_docs == len(c1["league"]) + len(c2["league"])
+    # rollup mode: same total SUM of a metric, fewer (grouped) rows
+    task2 = PinotTaskConfig("MergeRollupTask", {
+        TABLE_NAME_KEY: "baseballStats_OFFLINE",
+        SEGMENT_NAME_KEY: "merged_b", "mergeType": "ROLLUP"})
+    res2 = MergeRollupTaskExecutor().execute(
+        task2, make_schema(), make_table_config(), [d1, d2],
+        tempfile.mkdtemp(), MinionContext())
+    seg2 = ImmutableSegmentLoader.load(res2.out_dir)
+    assert seg2.num_docs <= seg.num_docs
+    from pinot_tpu.engine import QueryEngine
+    tot = QueryEngine([seg]).query("SELECT SUM(runs) FROM baseballStats")
+    tot2 = QueryEngine([seg2]).query("SELECT SUM(runs) FROM baseballStats")
+    assert tot.aggregation_results[0].value == tot2.aggregation_results[0].value
+
+
+# -- task queue --------------------------------------------------------------
+
+def test_task_queue_atomic_claim_and_states():
+    from pinot_tpu.controller.property_store import PropertyStore
+    store = PropertyStore()
+    q = TaskQueue(store)
+    t = PinotTaskConfig(PURGE_TASK, {TABLE_NAME_KEY: "t_OFFLINE",
+                                     SEGMENT_NAME_KEY: "s0"})
+    q.submit(t)
+    assert q.task_states(PURGE_TASK)[t.task_id] == GENERATED
+    got = q.claim("w1", [PURGE_TASK])
+    assert got is not None and got.task_id == t.task_id
+    # a second worker cannot claim the same task
+    assert q.claim("w2", [PURGE_TASK]) is None
+    q.finish(t, COMPLETED)
+    assert q.task_states(PURGE_TASK)[t.task_id] == COMPLETED
+    # dedup helper sees only open tasks
+    assert q.tasks_for_segment(PURGE_TASK, "t_OFFLINE", "s0") == []
+
+
+# -- end-to-end: generator → worker → refreshed segment ----------------------
+
+def test_minion_purge_end_to_end():
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"), num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cfg = make_table_config()
+        cfg.task_configs = {PURGE_TASK: {}}
+        cluster.add_table(cfg)
+        for i in range(2):
+            d, _ = _build_segment(base, f"mp_{i}", seed=i)
+            cluster.upload_segment("baseballStats_OFFLINE", d)
+        before = int(cluster.query(
+            "SELECT COUNT(*) FROM baseballStats WHERE league = 'NL'"
+        ).aggregation_results[0].value)
+        assert before > 0
+
+        tm = PinotTaskManager(cluster.controller.manager)
+        ids = tm.schedule_tasks()
+        assert len(ids) == 2
+        # scheduling again must not duplicate open tasks
+        assert tm.schedule_tasks() == []
+
+        ctx = MinionContext()
+        ctx.record_purger_factory["baseballStats"] = \
+            lambda row: row["league"] == "NL"
+        worker = MinionWorker(cluster.controller.manager,
+                              work_dir=os.path.join(base, "minion"),
+                              context=ctx)
+        done = worker.drain()
+        assert sorted(done) == sorted(ids)
+        states = worker.queue.task_states(PURGE_TASK)
+        assert all(s == COMPLETED for s in states.values()), states
+
+        after = int(cluster.query(
+            "SELECT COUNT(*) FROM baseballStats WHERE league = 'NL'"
+        ).aggregation_results[0].value)
+        assert after == 0
+        total = int(cluster.query(
+            "SELECT COUNT(*) FROM baseballStats"
+        ).aggregation_results[0].value)
+        assert total == 2048 - before
+    finally:
+        cluster.stop()
+
+
+def test_minion_error_isolation():
+    """A failing executor marks ERROR with the traceback, not a crash."""
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"), num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        q = TaskQueue(cluster.controller.manager.store)
+        t = PinotTaskConfig(PURGE_TASK, {
+            TABLE_NAME_KEY: "baseballStats_OFFLINE",
+            SEGMENT_NAME_KEY: "does_not_exist"})
+        q.submit(t)
+        worker = MinionWorker(cluster.controller.manager,
+                              work_dir=os.path.join(base, "minion"))
+        assert worker.drain() == [t.task_id]
+        rec = cluster.controller.manager.store.get(
+            f"/TASKS/{PURGE_TASK}/{t.task_id}")
+        assert rec["state"] == ERROR and "not found" in rec["info"]
+    finally:
+        cluster.stop()

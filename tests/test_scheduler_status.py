@@ -1,0 +1,123 @@
+"""Bounded-FCFS scheduler + ServiceStatus readiness tests.
+
+Parity: BoundedFCFSScheduler/ResourceLimitPolicy (per-group caps,
+OutOfCapacity rejection) and ServiceStatus.java convergence gating.
+"""
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from fixtures import make_schema, make_table_config, make_shared_columns
+
+from pinot_tpu.common.service_status import (Status, get_service_status)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.server.scheduler import (BoundedFCFSScheduler,
+                                        ResourceLimitPolicy,
+                                        SchedulerOutOfCapacityError,
+                                        make_scheduler)
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+def test_bounded_fcfs_limits_per_group_concurrency():
+    sched = BoundedFCFSScheduler(
+        num_workers=4, policy=ResourceLimitPolicy(4,
+                                                  max_threads_per_group_pct=0.25))
+    assert sched.policy.table_threads_hard_limit == 1
+    running = []
+    peak = []
+    gate = threading.Event()
+
+    def job(i):
+        def run():
+            running.append(i)
+            peak.append(len(running))
+            gate.wait(2)
+            running.remove(i)
+            return i
+        return run
+
+    futures = [sched.submit("t1", job(i)) for i in range(4)]
+    time.sleep(0.2)
+    # hard limit 1: only one t1 query may run at a time
+    assert max(peak) == 1
+    gate.set()
+    assert sorted(f.result(timeout=5) for f in futures) == [0, 1, 2, 3]
+    assert max(peak) == 1
+    sched.shutdown()
+
+
+def test_bounded_fcfs_rejects_over_capacity():
+    sched = BoundedFCFSScheduler(
+        num_workers=2, policy=ResourceLimitPolicy(
+            2, max_threads_per_group_pct=0.5, max_pending_per_group=2))
+    gate = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        gate.wait(2)
+        return True
+
+    first = sched.submit("t", blocker)
+    assert started.wait(2)
+    # first is RUNNING; queue bound 2 admits two more, rejects the rest
+    futures = [sched.submit("t", lambda: True) for _ in range(4)]
+    gate.set()
+    results = []
+    rejected = 0
+    for f in [first] + futures:
+        try:
+            results.append(f.result(timeout=5))
+        except SchedulerOutOfCapacityError:
+            rejected += 1
+    assert rejected == 2 and len(results) == 3
+    sched.shutdown()
+
+
+def test_make_scheduler_bounded_fcfs():
+    s = make_scheduler("bounded_fcfs", 2)
+    assert isinstance(s, BoundedFCFSScheduler)
+    assert s.submit("g", lambda: 7).result(timeout=5) == 7
+    s.shutdown()
+
+
+def test_service_status_converges_with_cluster():
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        d = os.path.join(base, "seg")
+        SegmentCreator(make_schema(), make_table_config(),
+                       segment_name="ss_0").build(
+            make_shared_columns(1024, 1), d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+        # the embedded coordinator applies transitions synchronously:
+        # every server must now report GOOD
+        for name in cluster.servers:
+            status, desc = get_service_status(name)
+            assert status == Status.GOOD, (name, desc)
+        # an unknown instance has no callback → STARTING
+        assert get_service_status("nope")[0] == Status.STARTING
+    finally:
+        cluster.stop()
+
+
+def test_service_status_detects_divergence():
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        coord = cluster.controller.coordinator
+        # fabricate an ideal-state entry the server never applied
+        coord.store.update(
+            "/IDEALSTATES/baseballStats_OFFLINE",
+            lambda old: {"segments": {"ghost_seg": {"Server_0": "ONLINE"}}})
+        status, desc = get_service_status("Server_0")
+        assert status == Status.STARTING and "ghost_seg" in desc
+    finally:
+        cluster.stop()
