@@ -100,6 +100,11 @@ class DataTable:
             dt.kind = KIND_SELECTION
             dt.columns = list(block.selection_columns or [])
             dt.rows = [tuple(row) for row in block.selection_rows]
+            if block.selection_display_cols is not None:
+                # trailing ORDER-BY-only columns: the broker needs the
+                # display split to trim after its cross-server merge
+                dt.metadata["selectionDisplayCols"] = str(
+                    block.selection_display_cols)
         return dt
 
     def to_block(self) -> IntermediateResultsBlock:
@@ -114,6 +119,9 @@ class DataTable:
         elif self.kind == KIND_SELECTION:
             blk.selection_rows = [tuple(r) for r in self.rows]
             blk.selection_columns = list(self.columns)
+            n = self.metadata.get("selectionDisplayCols")
+            if n is not None:
+                blk.selection_display_cols = int(n)
         return blk
 
 

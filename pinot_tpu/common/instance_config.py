@@ -1,0 +1,89 @@
+"""Layered per-process instance configuration.
+
+Parity: the commons-configuration properties layer — ServerConf,
+ControllerConf, broker Configuration, constants in CommonConstants
+(SURVEY.md §5.6a). Precedence: explicit overrides > environment
+(PINOT_TPU_<KEY with dots as __>) > properties file > defaults.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# defaults (parity: CommonConstants)
+DEFAULTS: Dict[str, str] = {
+    "pinot.server.query.executor.timeout": "15000",       # ms
+    "pinot.server.query.scheduler.algorithm": "fcfs",
+    "pinot.server.query.scheduler.workers": "4",
+    "pinot.server.netty.port": "8098",
+    "pinot.broker.timeout.ms": "15000",
+    "pinot.broker.client.queryPort": "8099",
+    "pinot.broker.routing.table.builder": "balanced",
+    "pinot.controller.port": "9000",
+    "pinot.controller.retention.frequencyInSeconds": "21600",
+    "controller.realtime.segment.commit.timeoutSeconds": "120",
+    "pinot.server.instance.dataDir": "",
+    "pinot.minion.workers": "1",
+}
+
+
+def _env_key(key: str) -> str:
+    return "PINOT_TPU_" + key.replace(".", "__").upper()
+
+
+class InstanceConfig:
+    """One process's configuration view."""
+
+    def __init__(self, overrides: Optional[Dict[str, str]] = None,
+                 properties_file: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self._file: Dict[str, str] = {}
+        if properties_file and os.path.exists(properties_file):
+            self._file = self._parse(properties_file)
+        self._overrides = dict(overrides or {})
+        self._env = os.environ if env is None else env
+
+    @staticmethod
+    def _parse(path: str) -> Dict[str, str]:
+        out = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith(("#", "!")):
+                    continue
+                if "=" in line:
+                    k, v = line.split("=", 1)
+                    out[k.strip()] = v.strip()
+        return out
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        if key in self._overrides:
+            return self._overrides[key]
+        ek = _env_key(key)
+        if ek in self._env:
+            return self._env[ek]
+        if key in self._file:
+            return self._file[key]
+        return DEFAULTS.get(key, default)
+
+    def get_int(self, key: str, default: Optional[int] = None
+                ) -> Optional[int]:
+        v = self.get(key, None)
+        return int(v) if v is not None and v != "" else default
+
+    def get_float(self, key: str, default: Optional[float] = None
+                  ) -> Optional[float]:
+        v = self.get(key, None)
+        return float(v) if v is not None and v != "" else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, None)
+        if v is None or v == "":
+            return default
+        return str(v).lower() in ("1", "true", "yes", "on")
+
+    def subset(self, prefix: str) -> Dict[str, str]:
+        """All resolved keys under a prefix (defaults + file + overrides)."""
+        keys = set(DEFAULTS) | set(self._file) | set(self._overrides)
+        return {k: self.get(k) for k in sorted(keys)
+                if k.startswith(prefix)}

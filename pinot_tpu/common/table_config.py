@@ -34,6 +34,9 @@ class IndexingConfig:
     # segment's observed partition ids in its metadata
     segment_partition_config: Dict[str, dict] = dataclasses.field(
         default_factory=dict)
+    # "v1" (file-per-index) | "v3" (single columns.psf container with
+    # per-member DEFLATE — parity: SegmentVersion + ChunkCompressor)
+    segment_version: str = "v1"
 
     def to_json(self) -> dict:
         return {
@@ -47,6 +50,7 @@ class IndexingConfig:
             "aggregateMetrics": self.aggregate_metrics,
             "segmentPartitionConfig": {
                 "columnPartitionMap": self.segment_partition_config},
+            "segmentFormatVersion": self.segment_version,
         }
 
     @classmethod
@@ -63,6 +67,7 @@ class IndexingConfig:
             aggregate_metrics=d.get("aggregateMetrics", False),
             segment_partition_config=(d.get("segmentPartitionConfig") or {}
                                       ).get("columnPartitionMap", {}),
+            segment_version=d.get("segmentFormatVersion", "v1"),
         )
 
 

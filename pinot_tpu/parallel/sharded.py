@@ -391,7 +391,8 @@ class ShardedQueryExecutor:
             sub = {k: v[i] for k, v in outs.items() if k.startswith("sel.")}
             seg_plan = SegmentPlan(
                 segment=seg, request=request,
-                select_spec=plan.select_spec, needed_cols=plan.needed_cols)
+                select_spec=plan.select_spec, needed_cols=plan.needed_cols,
+                select_display=plan.select_display)
             seg_blk = IntermediateResultsBlock()
             execution._finish_selection(seg_plan, sub, seg_blk,
                                         int(seg_matched[i]))
@@ -406,3 +407,4 @@ class ShardedQueryExecutor:
         sel = request.selection
         blk.selection_rows = rows_all[: sel.offset + sel.size]
         blk.selection_columns = columns
+        blk.selection_display_cols = plan.select_display

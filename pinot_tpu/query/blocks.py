@@ -60,5 +60,8 @@ class IntermediateResultsBlock:
     # selection: row tuples (decoded values) + total matched count
     selection_rows: Optional[List[tuple]] = None
     selection_columns: Optional[List[str]] = None
+    # rows may carry trailing ORDER-BY-only columns (needed to re-sort in
+    # cross-segment merges); the reducer trims to the first N display cols
+    selection_display_cols: Optional[int] = None
     stats: ExecutionStats = dataclasses.field(default_factory=ExecutionStats)
     exceptions: List[str] = dataclasses.field(default_factory=list)

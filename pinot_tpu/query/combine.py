@@ -65,6 +65,7 @@ def _merge_into(request: BrokerRequest,
         if a.selection_rows is None:
             a.selection_rows = b.selection_rows
             a.selection_columns = b.selection_columns
+            a.selection_display_cols = b.selection_display_cols
         elif b.selection_rows:
             a.selection_rows = merge_selection_rows(
                 request, a.selection_columns, a.selection_rows,

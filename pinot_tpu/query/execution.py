@@ -445,6 +445,7 @@ def _finish_selection(plan, outs, blk, matched: int) -> None:
         rows.append(tuple(_plain(cv[r]) for cv in col_values))
     blk.selection_rows = rows
     blk.selection_columns = columns
+    blk.selection_display_cols = plan.select_display
     blk.stats.num_docs_scanned = matched
 
 

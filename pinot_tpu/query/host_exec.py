@@ -387,6 +387,8 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
     from pinot_tpu.query.plan import selection_columns
     sel = request.selection
     cols = selection_columns(segment, request)
+    extras = [ob.column for ob in (sel.order_by or [])
+              if ob.column not in cols]
     docids = np.nonzero(mask)[0]
     if sel.order_by:
         sort_keys = []
@@ -406,6 +408,8 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
 
     rows = []
     decoded = {}
+    display_n = len(cols)
+    cols = cols + extras
     for c in cols:
         ds = segment.data_source(c)
         cm = ds.metadata
@@ -422,6 +426,7 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
         rows.append(tuple(_plain(decoded[c][r]) for c in cols))
     blk.selection_rows = rows
     blk.selection_columns = cols
+    blk.selection_display_cols = display_n
 
 
 def _plain(v):

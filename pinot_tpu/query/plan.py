@@ -315,6 +315,7 @@ class SegmentPlan:
     # per group column: None (decode via dictionary) or a transformed value
     # table aligned with the source column's dictIds (expression group-by)
     group_value_tables: Tuple = ()
+    select_display: Optional[int] = None   # display cols (rest: order-only)
     fast_path_result: Optional[IntermediateResultsBlock] = None
 
     def execute(self) -> IntermediateResultsBlock:
@@ -544,8 +545,14 @@ class InstancePlanMaker:
                         request: BrokerRequest, needed: Dict) -> None:
         sel = request.selection
         cols = selection_columns(segment, request)
+        plan.select_display = len(cols)
+        # ORDER BY columns outside the display list ride along at the end
+        # of each row so cross-segment merges can re-sort; the reducer
+        # trims them via selection_display_cols
+        extras = [ob.column for ob in (sel.order_by or [])
+                  if ob.column not in cols]
         gather = []
-        for c in cols:
+        for c in cols + extras:
             ds = segment.data_source(c)
             if not ds.metadata.has_dictionary:
                 gather.append((c, "raw"))

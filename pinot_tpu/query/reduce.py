@@ -52,8 +52,13 @@ class BrokerReduceService:
             sel = request.selection
             rows = merged.selection_rows or []
             rows = rows[sel.offset: sel.offset + sel.size]
+            columns = merged.selection_columns or sel.columns
+            n = merged.selection_display_cols
+            if n is not None and n < len(columns):
+                columns = columns[:n]
+                rows = [row[:n] for row in rows]
             resp.selection_results = SelectionResults(
-                columns=merged.selection_columns or sel.columns,
+                columns=columns,
                 results=[[_json_val(v) for v in row] for row in rows])
         return resp
 

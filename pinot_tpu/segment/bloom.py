@@ -61,8 +61,8 @@ class BloomFilter:
                 np.concatenate([header, self.bits]))
 
     @classmethod
-    def load(cls, seg_dir: str, col: str) -> "BloomFilter":
-        arr = np.asarray(np.load(os.path.join(seg_dir,
-                                              fmt.BLOOM.format(col=col))))
+    def load(cls, seg_dir, col: str) -> "BloomFilter":
+        arr = np.asarray(fmt.open_dir(seg_dir).load_array(
+            fmt.BLOOM.format(col=col)))
         num_bits, num_hashes = int(arr[0]), int(arr[1])
         return cls(num_bits, num_hashes, arr[2:].copy())

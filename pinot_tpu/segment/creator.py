@@ -237,6 +237,12 @@ class SegmentCreator:
         if idx_cfg.star_tree_configs:
             from pinot_tpu.startree.cube import build_and_save_star_trees
             build_and_save_star_trees(out_dir, self.table_config)
+        # v3 conversion runs LAST so star-tree cubes land inside the
+        # container with every other index member
+        if getattr(idx_cfg, "segment_version", "v1") == "v3":
+            from pinot_tpu.segment.store import SegmentFormatConverter
+            SegmentFormatConverter.v1_to_v3(out_dir)
+            meta.segment_version = "v3"
         return meta
 
 

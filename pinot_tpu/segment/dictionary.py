@@ -120,15 +120,13 @@ class Dictionary:
                     offsets)
 
     @classmethod
-    def load(cls, seg_dir: str, col: str, data_type: DataType) -> "Dictionary":
+    def load(cls, seg_dir, col: str, data_type: DataType) -> "Dictionary":
+        d = fmt.open_dir(seg_dir)
         if data_type.is_numeric:
-            values = np.load(os.path.join(seg_dir,
-                                          fmt.DICT_NUMERIC.format(col=col)))
+            values = d.load_array(fmt.DICT_NUMERIC.format(col=col))
             return cls(data_type, values)
-        offsets = np.load(os.path.join(seg_dir, fmt.DICT_OFFSETS.format(col=col)))
-        with open(os.path.join(seg_dir, fmt.DICT_BYTES.format(col=col)),
-                  "rb") as f:
-            blob = f.read()
+        offsets = d.load_array(fmt.DICT_OFFSETS.format(col=col))
+        blob = d.read_bytes(fmt.DICT_BYTES.format(col=col))
         vals: List = []
         for i in range(len(offsets) - 1):
             raw = blob[offsets[i]:offsets[i + 1]]

@@ -36,3 +36,73 @@ INV_OFFSETS = "{col}.inv.offsets.npy"
 BLOOM = "{col}.bloom.npy"
 
 SEGMENT_VERSION = "v1"
+
+# -- v3 single-file container -----------------------------------------------
+# Parity: SegmentVersion.java:21-24 + SingleFileIndexDirectory — every index
+# lives inside ONE columns.psf container. Here the container is a (optionally
+# DEFLATE-compressed) zip of the v1 members, which also supplies the chunk
+# compression role of ChunkCompressorFactory (PASS_THROUGH | compressed).
+COLUMNS_PSF = "columns.psf"
+SEGMENT_VERSION_V3 = "v3"
+
+
+class SegmentDir:
+    """Virtual segment directory over either layout.
+
+    v1: file-per-index in a real directory. v3: a single columns.psf zip
+    whose members are the v1 files (arrays as .npy, raw members as
+    bytes). Readers go through load_array/read_bytes/read_text/exists and
+    never know which layout is underneath (parity: SegmentDirectory).
+    """
+
+    def __init__(self, path: str):
+        import os
+        self.path = path
+        psf = os.path.join(path, COLUMNS_PSF)
+        self._zip = None
+        if os.path.exists(psf):
+            import zipfile
+            self._zip = zipfile.ZipFile(psf, "r")
+            self._names = set(self._zip.namelist())
+
+    def exists(self, name: str) -> bool:
+        import os
+        if self._zip is not None and name in self._names:
+            return True
+        return os.path.exists(os.path.join(self.path, name))
+
+    def load_array(self, name: str):
+        import io
+        import os
+
+        import numpy as np
+        if self._zip is not None and name in self._names:
+            with self._zip.open(name) as f:
+                return np.load(io.BytesIO(f.read()))
+        return np.load(os.path.join(self.path, name))
+
+    def read_bytes(self, name: str) -> bytes:
+        import os
+        if self._zip is not None and name in self._names:
+            return self._zip.read(name)
+        with open(os.path.join(self.path, name), "rb") as f:
+            return f.read()
+
+    def read_text(self, name: str) -> str:
+        return self.read_bytes(name).decode("utf-8")
+
+    def list(self, suffix: str = "", prefix: str = "") -> list:
+        """Member names across BOTH layouts (zip members union loose
+        files), filtered by prefix/suffix — layout knowledge stays here."""
+        import os
+        names = set(self._names) if self._zip is not None else set()
+        if os.path.isdir(self.path):
+            names.update(n for n in os.listdir(self.path)
+                         if not os.path.isdir(os.path.join(self.path, n)))
+        return sorted(n for n in names
+                      if n.startswith(prefix) and n.endswith(suffix))
+
+
+def open_dir(seg_dir) -> "SegmentDir":
+    """str → SegmentDir (idempotent for SegmentDir inputs)."""
+    return seg_dir if isinstance(seg_dir, SegmentDir) else SegmentDir(seg_dir)

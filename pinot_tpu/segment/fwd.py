@@ -73,10 +73,9 @@ class SVForwardIndexWriter:
         return nb
 
 
-def read_sv_fwd(seg_dir: str, col: str, num_bits: int, num_docs: int
+def read_sv_fwd(seg_dir, col: str, num_bits: int, num_docs: int
                 ) -> np.ndarray:
-    words = np.load(os.path.join(seg_dir, fmt.SV_FWD.format(col=col)),
-                    mmap_mode="r")
+    words = fmt.open_dir(seg_dir).load_array(fmt.SV_FWD.format(col=col))
     return unpack_bits(np.asarray(words), num_bits, num_docs)
 
 
@@ -94,9 +93,9 @@ def write_sorted_fwd(seg_dir: str, col: str, ids: np.ndarray,
     np.save(os.path.join(seg_dir, fmt.SV_SORTED_FWD.format(col=col)), ranges)
 
 
-def read_sorted_fwd(seg_dir: str, col: str) -> np.ndarray:
-    return np.asarray(np.load(os.path.join(seg_dir,
-                                           fmt.SV_SORTED_FWD.format(col=col))))
+def read_sorted_fwd(seg_dir, col: str) -> np.ndarray:
+    return np.asarray(fmt.open_dir(seg_dir).load_array(
+        fmt.SV_SORTED_FWD.format(col=col)))
 
 
 # -- raw (no-dictionary) ---------------------------------------------------
@@ -105,9 +104,9 @@ def write_raw_fwd(seg_dir: str, col: str, values: np.ndarray) -> None:
     np.save(os.path.join(seg_dir, fmt.SV_RAW_FWD.format(col=col)), values)
 
 
-def read_raw_fwd(seg_dir: str, col: str) -> np.ndarray:
-    return np.asarray(np.load(os.path.join(seg_dir,
-                                           fmt.SV_RAW_FWD.format(col=col))))
+def read_raw_fwd(seg_dir, col: str) -> np.ndarray:
+    return np.asarray(fmt.open_dir(seg_dir).load_array(
+        fmt.SV_RAW_FWD.format(col=col)))
 
 
 # -- multi-value -----------------------------------------------------------
@@ -121,10 +120,10 @@ def write_mv_fwd(seg_dir: str, col: str, flat_ids: np.ndarray,
             offsets.astype(np.int64))
 
 
-def read_mv_fwd(seg_dir: str, col: str) -> Tuple[np.ndarray, np.ndarray]:
-    flat = np.asarray(np.load(os.path.join(seg_dir, fmt.MV_FWD.format(col=col))))
-    offs = np.asarray(np.load(os.path.join(seg_dir,
-                                           fmt.MV_OFFSETS.format(col=col))))
+def read_mv_fwd(seg_dir, col: str) -> Tuple[np.ndarray, np.ndarray]:
+    d = fmt.open_dir(seg_dir)
+    flat = np.asarray(d.load_array(fmt.MV_FWD.format(col=col)))
+    offs = np.asarray(d.load_array(fmt.MV_OFFSETS.format(col=col)))
     return flat, offs
 
 

@@ -44,11 +44,10 @@ class InvertedIndexReader:
         self.num_docs = num_docs
 
     @classmethod
-    def load(cls, seg_dir: str, col: str, num_docs: int) -> "InvertedIndexReader":
-        docids = np.asarray(np.load(os.path.join(
-            seg_dir, fmt.INV_DOCIDS.format(col=col))))
-        offsets = np.asarray(np.load(os.path.join(
-            seg_dir, fmt.INV_OFFSETS.format(col=col))))
+    def load(cls, seg_dir, col: str, num_docs: int) -> "InvertedIndexReader":
+        d = fmt.open_dir(seg_dir)
+        docids = np.asarray(d.load_array(fmt.INV_DOCIDS.format(col=col)))
+        offsets = np.asarray(d.load_array(fmt.INV_OFFSETS.format(col=col)))
         return cls(docids, offsets, num_docs)
 
     def postings(self, dict_id: int) -> np.ndarray:

@@ -192,15 +192,51 @@ class ImmutableSegment:
     def column_names(self):
         return list(self._data_sources.keys())
 
+    #: parity: core/segment/virtualcolumn/VirtualColumnProviderFactory —
+    #: $docId / $segmentName / $hostName are synthesized on first access
+    VIRTUAL_COLUMNS = ("$docId", "$segmentName", "$hostName")
+
     def data_source(self, column: str) -> DataSource:
         try:
             return self._data_sources[column]
         except KeyError:
+            if column in self.VIRTUAL_COLUMNS:
+                ds = self._make_virtual(column)
+                self._data_sources[column] = ds
+                return ds
             raise KeyError(f"column '{column}' not in segment "
                            f"'{self.segment_name}'")
 
     def has_column(self, column: str) -> bool:
-        return column in self._data_sources
+        return column in self._data_sources or \
+            column in self.VIRTUAL_COLUMNS
+
+    def _make_virtual(self, column: str) -> DataSource:
+        from pinot_tpu.common.datatype import DataType
+        n = self.num_docs
+        if column == "$docId":
+            cm = ColumnMetadata(
+                name=column, data_type=DataType.INT, cardinality=n,
+                bits_per_element=32, has_dictionary=False,
+                min_value=0, max_value=max(n - 1, 0),
+                total_number_of_entries=n)
+            ds = DataSource(cm, self)
+            ds.raw_values = np.arange(n, dtype=np.int32)
+            return ds
+        if column == "$segmentName":
+            value = self.segment_name
+        else:
+            import socket
+            value = socket.gethostname()
+        cm = ColumnMetadata(
+            name=column, data_type=DataType.STRING, cardinality=1,
+            bits_per_element=1, sorted=True, has_dictionary=True,
+            min_value=value, max_value=value, total_number_of_entries=n)
+        ds = DataSource(cm, self)
+        ds.dictionary = Dictionary(DataType.STRING,
+                                   np.array([value], dtype=object))
+        ds.dict_ids = np.zeros(n, dtype=np.int32)
+        return ds
 
     def warm_device(self, columns=None) -> None:
         """Eagerly push forward indexes + dictionaries to HBM."""
@@ -230,6 +266,8 @@ class ImmutableSegmentLoader:
 
     @staticmethod
     def load(seg_dir: str) -> ImmutableSegment:
+        from pinot_tpu.segment import format as fmt
+        seg_dir = fmt.open_dir(seg_dir)      # v1 dir or v3 columns.psf
         meta = SegmentMetadata.load(seg_dir)
         sources: Dict[str, DataSource] = {}
         for name, cm in meta.columns.items():

@@ -95,9 +95,8 @@ class SegmentMetadata:
             json.dump(self.to_json(), f, indent=1, default=str)
 
     @classmethod
-    def load(cls, seg_dir: str) -> "SegmentMetadata":
-        with open(os.path.join(seg_dir, fmt.METADATA_FILE)) as f:
-            d = json.load(f)
+    def load(cls, seg_dir) -> "SegmentMetadata":
+        d = json.loads(fmt.open_dir(seg_dir).read_text(fmt.METADATA_FILE))
         return cls(
             segment_name=d["segmentName"],
             table_name=d["tableName"],

@@ -1,0 +1,66 @@
+"""Segment format conversion: v1 (file-per-index) ↔ v3 (single-file).
+
+Parity: core/segment/store/ (SegmentVersion.java:21-24,
+SingleFileIndexDirectory, SegmentV1V2ToV3FormatConverter). v3 packs
+every index member into ONE `columns.psf` container; `metadata.json`
+stays outside as in the reference (metadata.properties survives the
+conversion in place). DEFLATE per member doubles as the chunk
+compression layer (ChunkCompressorFactory PASS_THROUGH | compressed).
+"""
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+from pinot_tpu.segment import format as fmt
+
+
+class SegmentFormatConverter:
+    """Parity: SegmentFormatConverter SPI + the v1→v3 impl."""
+
+    @staticmethod
+    def v1_to_v3(seg_dir: str, compress: bool = True) -> str:
+        """Pack all index members into columns.psf (in place)."""
+        psf = os.path.join(seg_dir, fmt.COLUMNS_PSF)
+        if os.path.exists(psf):
+            return psf
+        members = [n for n in sorted(os.listdir(seg_dir))
+                   if n != fmt.METADATA_FILE and
+                   not os.path.isdir(os.path.join(seg_dir, n))]
+        comp = zipfile.ZIP_DEFLATED if compress else zipfile.ZIP_STORED
+        tmp = psf + ".tmp"
+        with zipfile.ZipFile(tmp, "w", compression=comp) as z:
+            for name in members:
+                z.write(os.path.join(seg_dir, name), arcname=name)
+        os.replace(tmp, psf)             # container is the commit marker
+        for name in members:
+            os.remove(os.path.join(seg_dir, name))
+        _set_version(seg_dir, fmt.SEGMENT_VERSION_V3)
+        return psf
+
+    @staticmethod
+    def v3_to_v1(seg_dir: str) -> None:
+        """Unpack columns.psf back into file-per-index members."""
+        psf = os.path.join(seg_dir, fmt.COLUMNS_PSF)
+        if not os.path.exists(psf):
+            return
+        with zipfile.ZipFile(psf, "r") as z:
+            for name in z.namelist():
+                if name.startswith("..") or os.path.isabs(name) or \
+                        "/" in name or "\\" in name:
+                    raise ValueError(f"suspicious member name {name!r}")
+                with z.open(name) as src, \
+                        open(os.path.join(seg_dir, name), "wb") as dst:
+                    dst.write(src.read())
+        os.remove(psf)
+        _set_version(seg_dir, fmt.SEGMENT_VERSION)
+
+
+def _set_version(seg_dir: str, version: str) -> None:
+    path = os.path.join(seg_dir, fmt.METADATA_FILE)
+    with open(path) as f:
+        meta = json.load(f)
+    meta["segmentVersion"] = version
+    with open(path, "w") as f:
+        json.dump(meta, f, indent=1, default=str)

@@ -96,12 +96,15 @@ class StarTreeCube:
             json.dump(self.config.to_json(), fh)
 
     @classmethod
-    def load(cls, seg_dir: str, idx: int) -> "StarTreeCube":
-        with open(os.path.join(seg_dir,
-                               STARTREE_META.format(idx=idx))) as fh:
-            config = StarTreeConfig.from_json(json.load(fh))
-        data = np.load(os.path.join(seg_dir,
-                                    STARTREE_DATA.format(idx=idx)))
+    def load(cls, seg_dir, idx: int) -> "StarTreeCube":
+        import io
+
+        from pinot_tpu.segment import format as fmt
+        d = fmt.open_dir(seg_dir)
+        config = StarTreeConfig.from_json(json.loads(
+            d.read_text(STARTREE_META.format(idx=idx))))
+        data = np.load(io.BytesIO(
+            d.read_bytes(STARTREE_DATA.format(idx=idx))))
         dim_ids = {d: data[f"dim.{d}"] for d in config.dimensions}
         metric_stats = {
             m: {k: data[f"met.{m}.{k}"] for k in ("sum", "min", "max")}
@@ -195,17 +198,18 @@ def build_and_save_star_trees(seg_dir: str, table_config) -> int:
     return len(cubes)
 
 
-def load_star_trees(seg_dir: str) -> List[StarTreeCube]:
+def load_star_trees(seg_dir) -> List[StarTreeCube]:
+    from pinot_tpu.segment import format as fmt
+    d = fmt.open_dir(seg_dir)
     cubes = []
-    for meta_path in sorted(glob.glob(
-            os.path.join(seg_dir, "startree.*.json"))):
-        idx = int(os.path.basename(meta_path).split(".")[1])
+    for meta_name in d.list(prefix="startree.", suffix=".json"):
+        idx = int(meta_name.split(".")[1])
         try:
-            cubes.append(StarTreeCube.load(seg_dir, idx))
+            cubes.append(StarTreeCube.load(d, idx))
         except Exception:  # noqa: BLE001 — an acceleration structure must
             # never brick the segment; skip the broken cube
             import logging
             logging.getLogger(__name__).warning(
                 "skipping unloadable star-tree cube %d in %s", idx,
-                seg_dir, exc_info=True)
+                d.path, exc_info=True)
     return cubes

@@ -242,3 +242,29 @@ def test_retention_deletes_expired_segments():
     resp = c.query("SELECT COUNT(*) FROM baseballStats")
     assert resp.exceptions or resp.aggregation_results[0].value == "0"
     c.stop()
+
+
+def test_order_by_unselected_column_over_tcp(tmp_path):
+    """The display-column split must survive the DataTable wire format:
+    ORDER BY on a non-selected column returns only the selected columns
+    after the broker's cross-server merge."""
+    from fixtures import make_shared_columns
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    cluster = EmbeddedCluster(str(tmp_path / "c"), num_servers=2, tcp=True)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        for i in range(2):
+            d = str(tmp_path / f"s{i}")
+            SegmentCreator(make_schema(), make_table_config(),
+                           segment_name=f"s{i}").build(
+                make_shared_columns(1024, i), d)
+            cluster.upload_segment("baseballStats_OFFLINE", d)
+        r = cluster.query("SELECT teamID FROM baseballStats "
+                          "ORDER BY yearID LIMIT 5")
+        assert r.selection_results.columns == ["teamID"]
+        assert len(r.selection_results.results) == 5
+        assert all(len(row) == 1 for row in r.selection_results.results)
+    finally:
+        cluster.stop()

@@ -140,3 +140,56 @@ def test_compacted_group_by_chunked_psums(monkeypatch):
     got = {gr["group"][0]: float(gr["value"])
            for gr in r.aggregation_results[0].group_by_result}
     assert got == {k: float(v) for k, v in expected.items()}
+
+
+def test_order_by_unselected_column_multi_segment():
+    """ORDER BY a column that is not selected: rows must merge across
+    segments in key order, and the response must show only the selected
+    columns (the gathered order keys are trimmed by the reducer)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fixtures import build_shared_segments
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, 3, n=1024, seed=4)
+    order = np.argsort(merged["yearID"], kind="stable")
+    exp = [merged["playerName"][i] for i in order[:7]]
+    exp_years = sorted(merged["yearID"])[:7]
+    for use_device in (True, False):
+        e = QueryEngine(segs, use_device=use_device)
+        r = e.query("SELECT playerName FROM baseballStats "
+                    "ORDER BY yearID LIMIT 7")
+        assert r.selection_results.columns == ["playerName"], use_device
+        rows = r.selection_results.results
+        assert len(rows) == 7
+        # the single-column rows must match the two-column query's names
+        rr = e.query("SELECT playerName, yearID FROM baseballStats "
+                     "ORDER BY yearID LIMIT 7")
+        assert [row[1] for row in rr.selection_results.results] == \
+            [int(y) for y in exp_years], use_device
+        assert [row[0] for row in rows] == \
+            [row[0] for row in rr.selection_results.results], use_device
+
+
+def test_virtual_columns():
+    """$docId / $segmentName / $hostName (parity:
+    core/segment/virtualcolumn/VirtualColumnProviderFactory)."""
+    import socket
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from fixtures import build_shared_segments
+    base = tempfile.mkdtemp()
+    segs, merged = build_shared_segments(base, 2, n=1024, seed=6)
+    for use_device in (True, False):
+        e = QueryEngine(segs, use_device=use_device)
+        r = e.query("SELECT COUNT(*) FROM baseballStats WHERE $docId < 100")
+        assert r.aggregation_results[0].value == str(2 * 100), use_device
+        r2 = e.query("SELECT COUNT(*) FROM baseballStats "
+                     "GROUP BY $segmentName TOP 10")
+        got = {g["group"][0]: g["value"]
+               for g in r2.aggregation_results[0].group_by_result}
+        assert got == {"shared_0": "1024", "shared_1": "1024"}, use_device
+        r3 = e.query("SELECT COUNT(*) FROM baseballStats "
+                     "GROUP BY $hostName TOP 5")
+        groups = r3.aggregation_results[0].group_by_result
+        assert len(groups) == 1
+        assert groups[0]["group"][0] == socket.gethostname()

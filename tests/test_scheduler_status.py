@@ -121,3 +121,28 @@ def test_service_status_detects_divergence():
         assert status == Status.STARTING and "ghost_seg" in desc
     finally:
         cluster.stop()
+
+
+def test_instance_config_layering(tmp_path):
+    from pinot_tpu.common.instance_config import InstanceConfig
+    props = tmp_path / "server.properties"
+    props.write_text("# comment\n"
+                     "pinot.server.query.scheduler.algorithm=tokenbucket\n"
+                     "custom.key = hello\n")
+    cfg = InstanceConfig(
+        overrides={"pinot.server.query.scheduler.workers": "8"},
+        properties_file=str(props),
+        env={"PINOT_TPU_PINOT__BROKER__TIMEOUT__MS": "9000"})
+    # default
+    assert cfg.get("pinot.broker.routing.table.builder") == "balanced"
+    # file beats default
+    assert cfg.get("pinot.server.query.scheduler.algorithm") == "tokenbucket"
+    # env beats file/default
+    assert cfg.get_int("pinot.broker.timeout.ms") == 9000
+    # override beats everything
+    assert cfg.get_int("pinot.server.query.scheduler.workers") == 8
+    assert cfg.get("custom.key") == "hello"
+    assert cfg.get("missing.key", "fallback") == "fallback"
+    assert cfg.get_bool("missing.flag", True) is True
+    sub = cfg.subset("pinot.server.query.")
+    assert sub["pinot.server.query.scheduler.workers"] == "8"

@@ -114,3 +114,95 @@ def test_sorted_column_detected(tmp_path):
     ds = seg.data_source("s")
     assert ds.sorted_ranges is not None
     np.testing.assert_array_equal(ds.sorted_ranges[3], [30, 40])
+
+
+def test_v3_single_file_format_roundtrip():
+    """v1 → v3 (single columns.psf) → load → identical query results;
+    v3 → v1 restores the file-per-index layout. Parity:
+    SegmentV1V2ToV3FormatConverter + SingleFileIndexDirectory."""
+    import shutil
+
+    from pinot_tpu.engine import QueryEngine
+    from pinot_tpu.segment import format as fmt
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.segment.store import SegmentFormatConverter
+    from fixtures import make_columns, make_schema, make_table_config
+
+    base = tempfile.mkdtemp()
+    v1_dir = os.path.join(base, "v1")
+    cols = make_columns(2048, seed=11)
+    cfg = make_table_config(inverted=["teamID"], bloom=["playerName"])
+    SegmentCreator(make_schema(), cfg, segment_name="fmt_0").build(
+        cols, v1_dir)
+    v3_dir = os.path.join(base, "v3")
+    shutil.copytree(v1_dir, v3_dir)
+    SegmentFormatConverter.v1_to_v3(v3_dir)
+    names = sorted(os.listdir(v3_dir))
+    assert fmt.COLUMNS_PSF in names
+    assert [n for n in names if n.endswith(".npy")] == []
+    seg1 = ImmutableSegmentLoader.load(v1_dir)
+    seg3 = ImmutableSegmentLoader.load(v3_dir)
+    assert seg3.metadata.segment_version == "v3"
+    pqls = ["SELECT COUNT(*), SUM(runs), MAX(hits) FROM baseballStats "
+            "WHERE league = 'NL'",
+            "SELECT SUM(runs) FROM baseballStats GROUP BY teamID TOP 50",
+            "SELECT playerName, runs FROM baseballStats "
+            "ORDER BY runs DESC LIMIT 5"]
+    for pql in pqls:
+        r1 = QueryEngine([seg1]).query(pql)
+        r3 = QueryEngine([seg3]).query(pql)
+        assert repr(r1.aggregation_results) == repr(r3.aggregation_results)
+        assert repr(r1.selection_results) == repr(r3.selection_results)
+    # compression: the container is smaller than the sum of v1 members
+    v1_size = sum(os.path.getsize(os.path.join(v1_dir, n))
+                  for n in os.listdir(v1_dir))
+    v3_size = sum(os.path.getsize(os.path.join(v3_dir, n))
+                  for n in os.listdir(v3_dir))
+    assert v3_size < v1_size
+    # back-conversion restores v1
+    SegmentFormatConverter.v3_to_v1(v3_dir)
+    assert not os.path.exists(os.path.join(v3_dir, fmt.COLUMNS_PSF))
+    seg_back = ImmutableSegmentLoader.load(v3_dir)
+    r = QueryEngine([seg_back]).query(pqls[0])
+    assert repr(r.aggregation_results) == \
+        repr(QueryEngine([seg1]).query(pqls[0]).aggregation_results)
+
+
+def test_creator_builds_v3_directly():
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.engine import QueryEngine
+    from pinot_tpu.segment import format as fmt
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.segment_version = "v3"
+    SegmentCreator(make_schema(), cfg, segment_name="fmt_v3").build(
+        make_columns(1024, seed=12), base)
+    assert os.path.exists(os.path.join(base, fmt.COLUMNS_PSF))
+    seg = ImmutableSegmentLoader.load(base)
+    r = QueryEngine([seg]).query("SELECT COUNT(*) FROM baseballStats")
+    assert r.aggregation_results[0].value == "1024"
+
+
+def test_v3_segment_keeps_star_trees():
+    """v3 conversion must pack star-tree cubes INTO the container (the
+    conversion runs after the cube build)."""
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.segment_version = "v3"
+    cfg.indexing_config.star_tree_configs = [{
+        "dimensionsSplitOrder": ["teamID", "league"],
+        "functionColumnPairs": ["SUM__runs", "COUNT__*"]}]
+    SegmentCreator(make_schema(), cfg, segment_name="fmt_st").build(
+        make_columns(2048, seed=13), base)
+    seg = ImmutableSegmentLoader.load(base)
+    assert seg.star_trees, "cubes must survive the v3 conversion"
+    # no loose star-tree files left outside the container
+    assert [n for n in os.listdir(base) if n.startswith("startree.")] == []
