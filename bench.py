@@ -310,7 +310,8 @@ def measure_rtt(sample) -> float:
     return median(ts)
 
 
-def bench_queries(mesh, stack, cpu, reps, rows, stage: str):
+def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
+                  budget_s: float = float("inf")):
     """Device timing: N kernel executions inside ONE dispatch (lax.scan over
     a runtime-zero perturbation so XLA cannot hoist the body), minus the
     measured relay round-trip, plus the measured host finish. This is the
@@ -329,6 +330,7 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str):
                                       drive_group_execution,
                                       set_group_kmax)
 
+    t_stage = time.monotonic()
     plan_maker = InstancePlanMaker()
     optimizer = BrokerRequestOptimizer()
     # 64 back-to-back executions per timed dispatch: the relay RTT
@@ -339,6 +341,13 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str):
     speedups = []
     rtt = None
     for name, pql in SSB_PQLS.items():
+        if time.monotonic() - t_stage > budget_s:
+            # compiles at this scale are minutes each; emit honest
+            # partial results rather than risk the whole run's budget
+            log(f"bench[{stage}] {name}: SKIPPED (stage over "
+                f"{budget_s:.0f}s time budget)")
+            per_query[name] = {"skipped": "stage time budget"}
+            continue
         request = optimizer.optimize(compile_pql(pql))
         plan = plan_maker.make_segment_plan(stack.segments[0], request)
         if plan.fast_path_result is not None:
@@ -573,12 +582,17 @@ def main() -> None:
             def device_num_docs(self):
                 return num_docs_dev
 
+        big_budget = float(os.environ.get(
+            "PINOT_TPU_BENCH_BIG_BUDGET_S", "2400"))
         big_pq, big_speedups = bench_queries(
-            mesh, _SynthStack(), big_cpu, reps, big_rows, "big")
+            mesh, _SynthStack(), big_cpu, reps, big_rows, "big",
+            budget_s=big_budget)
         result["big_synth"] = {
             "rows": big_rows,
-            "p50_speedup": round(median(big_speedups), 3),
-            "min_query_speedup": round(min(big_speedups), 2),
+            "p50_speedup": (round(median(big_speedups), 3)
+                            if big_speedups else None),
+            "min_query_speedup": (round(min(big_speedups), 2)
+                                  if big_speedups else None),
             "per_query": big_pq,
         }
 
