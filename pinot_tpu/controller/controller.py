@@ -14,6 +14,7 @@ from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.periodic import (PeriodicTask,
                                            PeriodicTaskScheduler,
                                            RealtimeSegmentValidationManager)
+from pinot_tpu.controller.leadership import ControllerLeadershipManager
 from pinot_tpu.controller.property_store import PropertyStore
 from pinot_tpu.controller.realtime_manager import RealtimeSegmentManager
 from pinot_tpu.controller.state_machine import ClusterCoordinator
@@ -22,12 +23,18 @@ from pinot_tpu.controller.state_machine import ClusterCoordinator
 class Controller:
     def __init__(self, deep_store_dir: str,
                  store: Optional[PropertyStore] = None,
-                 periodic_tasks: Optional[List[PeriodicTask]] = None):
+                 periodic_tasks: Optional[List[PeriodicTask]] = None,
+                 instance_id: str = "Controller_0"):
         self.store = store or PropertyStore()
         self.coordinator = ClusterCoordinator(self.store)
         self.manager = ResourceManager(self.coordinator, deep_store_dir)
         self.realtime = RealtimeSegmentManager(self.manager)
-        self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks)
+        # lead-controller gating for the periodic plane (parity:
+        # ControllerLeadershipManager + ControllerPeriodicTask)
+        self.leadership = ControllerLeadershipManager(self.store,
+                                                      instance_id)
+        self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks,
+                                              leadership=self.leadership)
         if periodic_tasks is None:
             # scheduler owns the defaults; the controller only appends the
             # realtime validation task (it needs the realtime manager)

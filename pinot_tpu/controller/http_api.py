@@ -53,6 +53,7 @@ class ControllerApiServer(ApiServer):
         self.controller = controller
         self.manager = controller.manager
         router = self.router
+        router.add("GET", "/", self._console)
         router.add("GET", "/health", self._health)
         router.add("GET", "/schemas", self._list_schemas)
         router.add("POST", "/schemas", self._add_schema)
@@ -73,6 +74,15 @@ class ControllerApiServer(ApiServer):
                    self._delete_segment)
 
     # -- handlers ----------------------------------------------------------
+    async def _console(self, request: HttpRequest) -> HttpResponse:
+        """Minimal query console (parity: the controller's web UI query
+        console). Pass ?broker=host:port to point it at a broker."""
+        import html as _html
+        broker = request.query.get("broker", "127.0.0.1:8099")
+        html = _CONSOLE_HTML.replace("__BROKER__", _html.escape(broker))
+        return HttpResponse(200, html.encode("utf-8"),
+                            content_type="text/html; charset=utf-8")
+
     async def _health(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse(200, b"OK", content_type="text/plain")
 
@@ -164,3 +174,76 @@ class ControllerApiServer(ApiServer):
             return HttpResponse.error(404, "segment not found")
         self.manager.delete_segment(table, segment)
         return HttpResponse.of_json({"status": f"{segment} deleted"})
+
+
+_CONSOLE_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>pinot_tpu query console</title>
+<style>
+ body { font-family: monospace; margin: 2rem; background: #101418;
+        color: #d8dee6; }
+ h1 { font-size: 1.1rem; }
+ textarea { width: 100%; height: 6rem; background: #181e24;
+            color: #d8dee6; border: 1px solid #2c343c; padding: .5rem;
+            font-family: inherit; }
+ input { background: #181e24; color: #d8dee6; border: 1px solid #2c343c;
+         padding: .3rem; width: 16rem; font-family: inherit; }
+ button { padding: .4rem 1rem; margin-top: .5rem; cursor: pointer; }
+ pre { background: #181e24; border: 1px solid #2c343c; padding: .7rem;
+       overflow: auto; max-height: 32rem; }
+ table { border-collapse: collapse; margin-top: .6rem; }
+ td, th { border: 1px solid #2c343c; padding: .25rem .6rem; }
+</style></head><body>
+<h1>pinot_tpu query console</h1>
+<div>broker <input id="broker" value="__BROKER__"></div>
+<textarea id="pql">SELECT COUNT(*) FROM baseballStats</textarea><br>
+<button onclick="run()">Run (Ctrl-Enter)</button>
+<div id="stats"></div><div id="table"></div><pre id="out"></pre>
+<script>
+async function run() {
+  const pql = document.getElementById('pql').value;
+  const broker = document.getElementById('broker').value;
+  const t0 = performance.now();
+  try {
+    const r = await fetch('http://' + broker + '/query', {
+      method: 'POST', headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({pql})});
+    const j = await r.json();
+    const ms = (performance.now() - t0).toFixed(1);
+    document.getElementById('stats').textContent =
+      ms + ' ms | docs scanned: ' + (j.numDocsScanned ?? '?') +
+      ' | segments: ' + (j.numSegmentsProcessed ?? '?');
+    render(j);
+    document.getElementById('out').textContent =
+      JSON.stringify(j, null, 2);
+  } catch (e) {
+    document.getElementById('out').textContent = 'error: ' + e;
+  }
+}
+function esc(v) {
+  return String(v).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+    .replace(/>/g, '&gt;').replace(/"/g, '&quot;');
+}
+function render(j) {
+  const el = document.getElementById('table');
+  el.innerHTML = '';
+  const mk = (rows, header) => {
+    const t = document.createElement('table');
+    t.innerHTML = '<tr>' + header.map(h => '<th>' + esc(h) + '</th>')
+      .join('') + '</tr>' + rows.map(r => '<tr>' +
+        r.map(c => '<td>' + esc(c) + '</td>').join('') + '</tr>').join('');
+    el.appendChild(t);
+  };
+  if (j.selectionResults)
+    mk(j.selectionResults.results, j.selectionResults.columns);
+  for (const a of (j.aggregationResults || [])) {
+    if (a.groupByResult)
+      mk(a.groupByResult.map(g => [...g.group, g.value]),
+         [...(a.groupByColumns || []), a.function]);
+    else if (a.function) mk([[a.value]], [a.function]);
+  }
+}
+document.getElementById('pql').addEventListener('keydown', e => {
+  if (e.ctrlKey && e.key === 'Enter') run();
+});
+</script></body></html>
+"""

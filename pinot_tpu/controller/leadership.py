@@ -1,0 +1,107 @@
+"""Controller leader election on the property store.
+
+Parity: controller/ControllerLeadershipManager.java — the reference
+elects a lead controller through Helix so periodic tasks (retention,
+validation, task generation) run exactly once across controllers. Here
+the election is a lease record at /CONTROLLER/LEADER claimed with the
+property store's atomic read-modify-write; the holder refreshes the
+lease, others take over when it expires.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+LEADER_PATH = "/CONTROLLER/LEADER"
+DEFAULT_LEASE_S = 10.0
+
+
+class ControllerLeadershipManager:
+    def __init__(self, store, instance_id: str,
+                 lease_s: float = DEFAULT_LEASE_S,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.instance_id = instance_id
+        self.lease_s = lease_s
+        self._clock = clock
+        self._listeners: List[Callable[[bool], None]] = []
+        self._was_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- election ----------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """Claim (or refresh) the lease; returns leadership state."""
+        now = self._clock()
+        cur = self.store.get(LEADER_PATH) or {}
+        if cur.get("instance") not in (None, self.instance_id) and \
+                cur.get("leaseUntil", 0) >= now:
+            # someone else holds an unexpired lease: no write, no
+            # spurious watcher churn from heartbeat polls
+            self._notify(False)
+            return False
+        out = {}
+
+        def claim(rec):
+            rec = dict(rec or {})
+            holder = rec.get("instance")
+            expired = rec.get("leaseUntil", 0) < now
+            if holder in (None, self.instance_id) or expired:
+                rec["instance"] = self.instance_id
+                rec["leaseUntil"] = now + self.lease_s
+            out["leader"] = rec.get("instance") == self.instance_id
+            return rec
+
+        self.store.update(LEADER_PATH, claim)
+        self._notify(out["leader"])
+        return out["leader"]
+
+    def is_leader(self) -> bool:
+        rec = self.store.get(LEADER_PATH) or {}
+        return rec.get("instance") == self.instance_id and \
+            rec.get("leaseUntil", 0) >= self._clock()
+
+    def resign(self) -> None:
+        def drop(rec):
+            rec = dict(rec or {})
+            if rec.get("instance") == self.instance_id:
+                rec["instance"] = None
+                rec["leaseUntil"] = 0
+            return rec
+
+        self.store.update(LEADER_PATH, drop)
+        self._notify(False)
+
+    # -- listeners (parity: onBecomeLeader/onBecomeNotLeader) --------------
+
+    def add_listener(self, fn: Callable[[bool], None]) -> None:
+        self._listeners.append(fn)
+
+    def _notify(self, leader: bool) -> None:
+        if leader != self._was_leader:
+            self._was_leader = leader
+            for fn in self._listeners:
+                fn(leader)
+
+    # -- background heartbeat ---------------------------------------------
+
+    def start(self, interval_s: Optional[float] = None) -> None:
+        interval = interval_s if interval_s is not None else \
+            self.lease_s / 3
+
+        def loop():
+            while not self._stop.is_set():
+                self.try_acquire()
+                self._stop.wait(interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"leader-{self.instance_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.resign()

@@ -105,14 +105,21 @@ class RealtimeSegmentValidationManager(PeriodicTask):
 
 class PeriodicTaskScheduler:
     def __init__(self, manager: ResourceManager,
-                 tasks: Optional[List[PeriodicTask]] = None):
+                 tasks: Optional[List[PeriodicTask]] = None,
+                 leadership=None):
         self.manager = manager
         self.tasks = tasks if tasks is not None else [
             RetentionManager(), SegmentStatusChecker()]
+        # parity: ControllerPeriodicTask lead-controller gating — with
+        # multiple controllers, only the lease holder runs the tasks
+        self.leadership = leadership
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
     def run_once(self) -> None:
+        if self.leadership is not None and \
+                not self.leadership.try_acquire():
+            return
         for task in self.tasks:
             try:
                 task.run(self.manager)
@@ -128,6 +135,9 @@ class PeriodicTaskScheduler:
 
     def _loop(self, task: PeriodicTask) -> None:
         while not self._stop.wait(task.interval_s):
+            if self.leadership is not None and \
+                    not self.leadership.try_acquire():
+                continue
             try:
                 task.run(self.manager)
             except Exception:  # noqa: BLE001

@@ -700,8 +700,12 @@ def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
     t = max(padded // kernels.CBLOCK, 1)
     mu = matched * kernels.CBLOCK / max(total_docs, 1)
     r = kernels.pow2_bucket(max(16, int(2 * mu + 8)))
-    if r >= kernels.CBLOCK // 4 and g_pad <= kernels.DENSE_G_LIMIT:
-        kmax = 0          # barely-selective filter: direct dense one-hot
+    if r >= 64 and g_pad <= kernels.DENSE_G_LIMIT:
+        # barely-selective filter: the compaction one-hot costs rows*r
+        # while the direct dense path's VMEM-tiled one-hot scan costs
+        # rows*g_pad with much better fusion — direct wins once r is a
+        # sizable fraction of the table width (measured on v5e)
+        kmax = 0
     else:
         kmax = min(t * r, padded)
     spec = (new_gcols, strides, g_pad, agg_specs, kmax)

@@ -171,6 +171,10 @@ class HttpServer:
                            body, client=client)
 
     async def _dispatch(self, request: HttpRequest) -> HttpResponse:
+        if request.method == "OPTIONS":
+            # CORS preflight: browser clients (e.g. the controller's
+            # query console) fetch the broker cross-origin
+            return HttpResponse(204, b"", content_type="text/plain")
         handler, params, path_exists = self.router.match(
             request.method, request.path)
         if handler is None:
@@ -189,6 +193,10 @@ class HttpServer:
         head = (f"HTTP/1.1 {response.status} {reason}\r\n"
                 f"Content-Type: {response.content_type}\r\n"
                 f"Content-Length: {len(response.body)}\r\n"
+                "Access-Control-Allow-Origin: *\r\n"
+                "Access-Control-Allow-Methods: "
+                "GET, POST, DELETE, OPTIONS\r\n"
+                "Access-Control-Allow-Headers: Content-Type\r\n"
                 f"Connection: {'keep-alive' if keep else 'close'}\r\n"
                 "\r\n")
         writer.write(head.encode("latin-1") + response.body)

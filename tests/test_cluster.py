@@ -268,3 +268,65 @@ def test_order_by_unselected_column_over_tcp(tmp_path):
         assert all(len(row) == 1 for row in r.selection_results.results)
     finally:
         cluster.stop()
+
+
+def test_controller_leader_election():
+    """Lease-based leader election (parity: ControllerLeadershipManager):
+    one leader at a time, takeover on resign and on lease expiry, and
+    periodic tasks gated on leadership."""
+    from pinot_tpu.controller.leadership import ControllerLeadershipManager
+    from pinot_tpu.controller.periodic import (PeriodicTask,
+                                               PeriodicTaskScheduler)
+
+    store = PropertyStore()
+    clock = [1000.0]
+    a = ControllerLeadershipManager(store, "ctrl_a", lease_s=10,
+                                    clock=lambda: clock[0])
+    b = ControllerLeadershipManager(store, "ctrl_b", lease_s=10,
+                                    clock=lambda: clock[0])
+    events = []
+    a.add_listener(lambda lead: events.append(("a", lead)))
+    assert a.try_acquire() is True
+    assert b.try_acquire() is False
+    assert a.is_leader() and not b.is_leader()
+    assert events == [("a", True)]
+    # resign → b takes over
+    a.resign()
+    assert events == [("a", True), ("a", False)]
+    assert b.try_acquire() is True and not a.is_leader()
+    # lease expiry → a can reclaim without b resigning
+    clock[0] += 11
+    assert not b.is_leader()
+    assert a.try_acquire() is True
+
+    # periodic tasks run only on the leader
+    ran = []
+
+    class Probe(PeriodicTask):
+        name = "probe"
+        interval_s = 1
+
+        def run(self, manager):
+            ran.append(1)
+
+    sched_b = PeriodicTaskScheduler(manager=None, tasks=[Probe()],
+                                    leadership=b)
+    sched_b.run_once()
+    assert ran == []                     # b is not the leader
+    sched_a = PeriodicTaskScheduler(manager=None, tasks=[Probe()],
+                                    leadership=a)
+    sched_a.run_once()
+    assert ran == [1]
+
+
+def test_query_console_served(tmp_path):
+    import urllib.request
+    cluster = EmbeddedCluster(str(tmp_path / "c"), num_servers=1,
+                              http=True)
+    try:
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{cluster.controller_port}/?broker=x:1",
+            timeout=10).read().decode()
+        assert "query console" in html and 'value="x:1"' in html
+    finally:
+        cluster.stop()

@@ -316,11 +316,16 @@ def test_adaptive_dense_remap_group_by(wide_group_setup):
     assert {s[0] for s in pa} == {"min", "max"}
     # simulated scout bounds: a in [100, 105], b full range; selective
     spec2, empty = adaptive_phase_b_spec(
-        plan.group_spec, [(100, 105), (0, 249)], matched=50,
+        plan.group_spec, [(100, 105), (0, 249)], matched=2,
         padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
     assert not empty and spec2 is not None
     assert spec2[0][0][1] == "idoff" and spec2[0][0][2] == 100
-    assert spec2[4] > 0                        # compacted (selective)
+    assert spec2[4] > 0                        # compacted (very selective)
+    # barely-selective: the cost model flips to the direct dense layout
+    dense_spec, _ = adaptive_phase_b_spec(
+        plan.group_spec, [(100, 105), (0, 249)], matched=2000,
+        padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
+    assert dense_spec[4] == 0
 
     pql = ("SELECT SUM(v), COUNT(*) FROM w WHERE a BETWEEN 'a100' AND "
            "'a105' GROUP BY a, b TOP 20000")
