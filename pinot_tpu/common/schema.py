@@ -62,11 +62,17 @@ class FieldSpec:
         return self.data_type.convert(value)
 
     def to_json(self) -> dict:
+        default = self.default_null_value
         d = {
             "name": self.name,
             "dataType": self.data_type.value,
             "singleValueField": self.single_value,
         }
+        if isinstance(default, bytes):
+            # hex-encode like ColumnMetadata.to_json does for bytes
+            d["defaultNullValueHex"] = default.hex()
+        else:
+            d["defaultNullValue"] = default
         if self.time_unit is not None:
             d["timeUnit"] = self.time_unit.name
             d["timeUnitSize"] = self.time_unit_size
@@ -145,13 +151,20 @@ class Schema:
     @classmethod
     def from_json(cls, d: dict) -> "Schema":
         fields: List[FieldSpec] = []
+        def _default(fs):
+            if "defaultNullValueHex" in fs:
+                return bytes.fromhex(fs["defaultNullValueHex"])
+            return fs.get("defaultNullValue")
+
         for fs in d.get("dimensionFieldSpecs", []) or []:
             fields.append(FieldSpec(fs["name"], DataType(fs["dataType"]),
                                     FieldType.DIMENSION,
-                                    fs.get("singleValueField", True)))
+                                    fs.get("singleValueField", True),
+                                    _default(fs)))
         for fs in d.get("metricFieldSpecs", []) or []:
             fields.append(FieldSpec(fs["name"], DataType(fs["dataType"]),
-                                    FieldType.METRIC))
+                                    FieldType.METRIC,
+                                    default_null_value=_default(fs)))
         tf = d.get("timeFieldSpec")
         if tf:
             g = tf.get("incomingGranularitySpec", tf)

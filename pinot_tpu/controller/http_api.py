@@ -72,6 +72,9 @@ class ControllerApiServer(ApiServer):
                    self._segment_metadata)
         router.add("DELETE", "/segments/{table}/{segment}",
                    self._delete_segment)
+        router.add("POST", "/segments/{table}/{segment}/reload",
+                   self._reload_segment)
+        router.add("POST", "/tables/{name}/reload", self._reload_table)
 
     # -- handlers ----------------------------------------------------------
     async def _console(self, request: HttpRequest) -> HttpResponse:
@@ -159,6 +162,21 @@ class ControllerApiServer(ApiServer):
             unpack_segment_tar(request.body, seg_dir)
             name = self.manager.add_segment(table, seg_dir)
         return HttpResponse.of_json({"status": f"segment {name} uploaded"})
+
+    async def _reload_segment(self, request: HttpRequest) -> HttpResponse:
+        try:
+            self.manager.reload_segment(request.path_params["table"],
+                                        request.path_params["segment"])
+        except ValueError as e:
+            return HttpResponse.error(404, str(e))
+        return HttpResponse.of_json({"status": "reload triggered"})
+
+    async def _reload_table(self, request: HttpRequest) -> HttpResponse:
+        try:
+            n = self.manager.reload_table(request.path_params["name"])
+        except ValueError as e:
+            return HttpResponse.error(404, str(e))
+        return HttpResponse.of_json({"status": f"{n} segments reloaded"})
 
     async def _segment_metadata(self, request: HttpRequest) -> HttpResponse:
         meta = self.manager.segment_metadata(

@@ -161,6 +161,47 @@ class ResourceManager:
         self.store.remove(f"{SEGMENTS}/{table}/{segment}")
         self.fs.delete(os.path.join(self.deep_store_dir, table, segment))
 
+    def reload_segment(self, table: str, segment: str) -> None:
+        """Rolling per-replica bounce through OFFLINE so holders re-run
+        the load path — applying schema evolution (default columns) and
+        new index configs to an already-served segment. One replica
+        reloads at a time, so replicated tables keep serving throughout
+        (a replication-1 segment is briefly unrouted — the reference's
+        in-place reload message has no gap, but also no Helix-visible
+        progress). Parity: the segment reload REST operation.
+        Each closure re-reads the LIVE instance map, so a concurrent
+        rebalance is never clobbered with a stale holder set."""
+        current = self.coordinator.ideal_state(table)
+        if segment not in current:
+            raise ValueError(f"segment {segment} not in {table}")
+        for inst in sorted(current[segment]):
+
+            def offline(segments, inst=inst):
+                entry = dict(segments.get(segment, {}))
+                if entry.get(inst) == ONLINE:
+                    entry[inst] = "OFFLINE"
+                    segments[segment] = entry
+                return segments
+
+            self.coordinator.update_ideal_state(table, offline)
+
+            def online(segments, inst=inst):
+                entry = dict(segments.get(segment, {}))
+                if entry.get(inst) == "OFFLINE":
+                    entry[inst] = ONLINE
+                    segments[segment] = entry
+                return segments
+
+            self.coordinator.update_ideal_state(table, online)
+
+    def reload_table(self, table: str) -> int:
+        segments = self.segment_names(table)
+        if self.get_table_config(table) is None:
+            raise ValueError(f"table {table} does not exist")
+        for seg in segments:
+            self.reload_segment(table, seg)
+        return len(segments)
+
     # -- rebalance ---------------------------------------------------------
     def rebalance_table(self, table: str, dry_run: bool = False) -> Dict:
         """Recompute the whole assignment against live instances.

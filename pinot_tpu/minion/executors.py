@@ -65,7 +65,8 @@ class PurgeTaskExecutor(PinotTaskExecutor):
 
     def execute(self, task, schema, table_config, input_dirs, work_dir,
                 context) -> SegmentConversionResult:
-        table = task.configs[TABLE_NAME_KEY].rsplit("_", 1)[0]
+        from pinot_tpu.common.table_name import raw_table
+        table = raw_table(task.configs[TABLE_NAME_KEY])
         purger = context.record_purger_factory.get(table)
         modifier = context.record_modifier_factory.get(table)
         segment = ImmutableSegmentLoader.load(input_dirs[0])

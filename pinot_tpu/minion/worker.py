@@ -60,7 +60,8 @@ class MinionWorker:
         executor = self.registry.get(task.task_type)
         if executor is None:
             raise ValueError(f"no executor for task type {task.task_type}")
-        schema = self.manager.get_schema(table.rsplit("_", 1)[0]) or \
+        from pinot_tpu.common.table_name import raw_table
+        schema = self.manager.get_schema(raw_table(table)) or \
             self.manager.get_schema(table)
         config = self.manager.get_table_config(table)
         if schema is None or config is None:

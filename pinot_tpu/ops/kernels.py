@@ -21,8 +21,11 @@ dictId lanes:
   Replaces AggregationOperator / DictionaryBasedAggregationOperator.
 - Group-by → mixed-radix dictId keys (same math as
   DictionaryBasedGroupKeyGenerator.java:204 `groupId = groupId*card + dictId`)
-  + scatter-add into a static pow2-padded group table. Replaces
-  DefaultGroupByExecutor.
+  aggregated WITHOUT row-scale sorts/scatters/gathers: MXU block stream-
+  compaction of matched rows + one-hot matmul group tables (dense layout
+  for small key spaces, rank-addressed for wide ones), driven by an
+  adaptive two-phase executor (plan.drive_group_execution). Replaces
+  DefaultGroupByExecutor + CombineGroupByOperator.
 - Selection → jnp.nonzero(size=k) for limit queries, lax.top_k over packed
   order keys for ORDER BY. Replaces SelectionOperator's PriorityQueue.
 

@@ -700,11 +700,11 @@ def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
     t = max(padded // kernels.CBLOCK, 1)
     mu = matched * kernels.CBLOCK / max(total_docs, 1)
     r = kernels.pow2_bucket(max(16, int(2 * mu + 8)))
-    if r >= 64 and g_pad <= kernels.DENSE_G_LIMIT:
-        # barely-selective filter: the compaction one-hot costs rows*r
-        # while the direct dense path's VMEM-tiled one-hot scan costs
-        # rows*g_pad with much better fusion — direct wins once r is a
-        # sizable fraction of the table width (measured on v5e)
+    if r > 128 and g_pad <= kernels.DENSE_G_LIMIT:
+        # barely-selective filter: the block-compaction einsum degrades
+        # past r=128 while the dense path's VMEM-tiled one-hot scan
+        # keeps a flat per-element rate — measured crossover on v5e
+        # (compact r<=128 beats dense g=512; compact r=256 loses)
         kmax = 0
     else:
         kmax = min(t * r, padded)

@@ -23,16 +23,25 @@ import numpy as np
 from pinot_tpu.segment import format as fmt
 
 
+def build_inverted_csr(entry_ids: np.ndarray, doc_of_entry: np.ndarray,
+                       cardinality: int):
+    """CSR postings from (dictId, docId) pairs — one pair per SV doc,
+    one per MV entry. Returns (docids int32, offsets int64)."""
+    order = np.argsort(entry_ids, kind="stable")
+    offsets = np.searchsorted(entry_ids[order],
+                              np.arange(cardinality + 1)).astype(np.int64)
+    return doc_of_entry[order].astype(np.int32), offsets
+
+
 class InvertedIndexWriter:
     @staticmethod
     def write(seg_dir: str, col: str, ids: np.ndarray, cardinality: int) -> None:
-        order = np.argsort(ids, kind="stable")  # doc ids grouped by dictId
-        sorted_ids = ids[order]
-        offsets = np.searchsorted(sorted_ids, np.arange(cardinality + 1))
+        docids, offsets = build_inverted_csr(
+            ids, np.arange(len(ids)), cardinality)
         np.save(os.path.join(seg_dir, fmt.INV_DOCIDS.format(col=col)),
-                order.astype(np.int32))
+                docids)
         np.save(os.path.join(seg_dir, fmt.INV_OFFSETS.format(col=col)),
-                offsets.astype(np.int64))
+                offsets)
 
 
 class InvertedIndexReader:

@@ -265,7 +265,14 @@ class ImmutableSegmentLoader:
     """
 
     @staticmethod
-    def load(seg_dir: str) -> ImmutableSegment:
+    def load(seg_dir: str, schema=None,
+             index_loading_config=None) -> ImmutableSegment:
+        """`schema`: when given, columns the schema defines but the
+        segment predates are synthesized as default-value columns
+        (schema evolution). `index_loading_config`: an IndexingConfig —
+        inverted indexes it lists are generated at load when missing.
+        Parity: core/segment/index/loader/SegmentPreProcessor.
+        """
         from pinot_tpu.segment import format as fmt
         seg_dir = fmt.open_dir(seg_dir)      # v1 dir or v3 columns.psf
         meta = SegmentMetadata.load(seg_dir)
@@ -291,9 +298,60 @@ class ImmutableSegmentLoader:
                 if cm.has_bloom_filter:
                     ds.bloom_filter = BloomFilter.load(seg_dir, name)
             sources[name] = ds
+        # -- SegmentPreProcessor parity ---------------------------------
+        if index_loading_config is not None:
+            from pinot_tpu.segment.inverted import build_inverted_csr
+            for name in index_loading_config.inverted_index_columns:
+                ds = sources.get(name)
+                if ds is None or ds.inverted_index is not None:
+                    continue
+                card = ds.metadata.cardinality
+                if ds.dict_ids is not None:
+                    docids, offsets = build_inverted_csr(
+                        ds.dict_ids, np.arange(len(ds.dict_ids)), card)
+                elif ds.mv_dict_ids is not None:
+                    mv = ds.mv_dict_ids
+                    flat = mv.reshape(-1)
+                    docs = np.repeat(np.arange(mv.shape[0]), mv.shape[1])
+                    keep = flat < card       # drop padding entries
+                    docids, offsets = build_inverted_csr(
+                        flat[keep], docs[keep], card)
+                else:
+                    continue                 # raw column: no dictIds
+                ds.inverted_index = InvertedIndexReader(
+                    docids, offsets, meta.total_docs)
+                ds.metadata.has_inverted_index = True
+        if schema is not None:
+            for field in schema.fields:
+                if field.name in sources:
+                    continue
+                # default column: the segment predates this schema field
+                sources[field.name] = _default_column(field,
+                                                      meta.total_docs)
         seg = ImmutableSegment(meta, sources)
         for ds in sources.values():
             ds._segment = seg
         from pinot_tpu.startree.cube import load_star_trees
         seg.star_trees = load_star_trees(seg_dir)
         return seg
+
+
+def _default_column(field, num_docs: int) -> DataSource:
+    """Constant default-value column (parity: DefaultColumnHandler +
+    virtual default column providers)."""
+    default = field.default_null_value
+    cm = ColumnMetadata(
+        name=field.name, data_type=field.data_type, cardinality=1,
+        bits_per_element=1, single_value=field.single_value, sorted=True,
+        has_dictionary=True, min_value=default, max_value=default,
+        total_number_of_entries=num_docs)
+    ds = DataSource(cm, None)
+    dtype = object if not field.data_type.is_numeric else \
+        field.data_type.np_dtype
+    ds.dictionary = Dictionary(field.data_type,
+                               np.array([default], dtype=dtype))
+    if field.single_value:
+        ds.dict_ids = np.zeros(num_docs, dtype=np.int32)
+    else:
+        ds.mv_dict_ids = np.zeros((num_docs, 1), dtype=np.int32)
+    return ds

@@ -62,7 +62,16 @@ class ServerParticipant(StateModel):
         meta = self.manager.segment_metadata(table, segment)
         if meta is None:
             raise ValueError(f"no metadata for {table}/{segment}")
-        seg = ImmutableSegmentLoader.load(meta["downloadPath"])
+        # SegmentPreProcessor parity: the current schema synthesizes
+        # default columns for pre-evolution segments, and configured
+        # inverted indexes are generated when the artifact lacks them
+        from pinot_tpu.common.table_name import raw_table
+        schema = self.manager.get_schema(raw_table(table))
+        config = self.manager.get_table_config(table)
+        seg = ImmutableSegmentLoader.load(
+            meta["downloadPath"], schema=schema,
+            index_loading_config=(config.indexing_config
+                                  if config else None))
         self.server.data_manager.table(table, create=True).add_segment(seg)
 
     def on_become_offline(self, table: str, segment: str) -> None:

@@ -330,3 +330,36 @@ def test_query_console_served(tmp_path):
         assert "query console" in html and 'value="x:1"' in html
     finally:
         cluster.stop()
+
+
+def test_schema_evolution_via_reload(tmp_path):
+    """Add a column to the schema, reload the segment: servers re-load
+    it with a synthesized default column (SegmentPreProcessor parity)."""
+    from fixtures import make_shared_columns
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import FieldSpec, FieldType, Schema
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    cluster = EmbeddedCluster(str(tmp_path / "c"), num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        d = str(tmp_path / "seg")
+        SegmentCreator(make_schema(), make_table_config(),
+                       segment_name="evo_0").build(
+            make_shared_columns(1024, 3), d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+        # before evolution the column doesn't exist
+        r = cluster.query("SELECT COUNT(*) FROM baseballStats "
+                          "WHERE country = 'USA'")
+        assert r.exceptions or r.num_segments_processed == 0
+        evolved = Schema("baseballStats", make_schema().fields + [
+            FieldSpec("country", DataType.STRING, FieldType.DIMENSION,
+                      default_null_value="USA")])
+        cluster.add_schema(evolved)
+        cluster.controller.manager.reload_table("baseballStats_OFFLINE")
+        r2 = cluster.query("SELECT COUNT(*) FROM baseballStats "
+                           "WHERE country = 'USA'")
+        assert int(r2.aggregation_results[0].value) == 1024
+    finally:
+        cluster.stop()

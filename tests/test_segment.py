@@ -206,3 +206,46 @@ def test_v3_segment_keeps_star_trees():
     assert seg.star_trees, "cubes must survive the v3 conversion"
     # no loose star-tree files left outside the container
     assert [n for n in os.listdir(base) if n.startswith("startree.")] == []
+
+
+def test_preprocessor_default_columns_and_inverted(tmp_path):
+    """Load-time preprocessing (parity: SegmentPreProcessor): schema
+    evolution synthesizes default columns; configured inverted indexes
+    are generated when the segment lacks them."""
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (FieldSpec, FieldType, Schema,
+                                         metric)
+    from pinot_tpu.engine import QueryEngine
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    d = str(tmp_path / "seg")
+    cfg = make_table_config(inverted=[])      # built WITHOUT inverted
+    SegmentCreator(make_schema(), cfg, segment_name="pp_0").build(
+        make_columns(1024, seed=17), d)
+
+    # evolved schema: adds a column the segment predates
+    evolved = Schema("baseballStats", make_schema().fields + [
+        FieldSpec("country", DataType.STRING, FieldType.DIMENSION,
+                  default_null_value="USA"),
+        metric("errors", DataType.INT),
+    ])
+    idx = make_table_config(inverted=["teamID"]).indexing_config
+    seg = ImmutableSegmentLoader.load(d, schema=evolved,
+                                      index_loading_config=idx)
+    assert seg.data_source("teamID").inverted_index is not None
+    assert seg.has_column("country") and seg.has_column("errors")
+    e = QueryEngine([seg])
+    r = e.query("SELECT COUNT(*) FROM baseballStats WHERE country = 'USA'")
+    assert r.aggregation_results[0].value == "1024"
+    r2 = e.query("SELECT SUM(errors) FROM baseballStats")
+    assert float(r2.aggregation_results[0].value) == 0.0
+    # the generated inverted index answers the count fast path correctly
+    import numpy as np
+    cols = make_columns(1024, seed=17)
+    team = cols["teamID"][0]
+    r3 = e.query(f"SELECT COUNT(*) FROM baseballStats "
+                 f"WHERE teamID = '{team}'")
+    exp = sum(1 for t in cols["teamID"] if t == team)
+    assert int(r3.aggregation_results[0].value) == exp
