@@ -392,13 +392,14 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
         # TWO dispatches per query: phase-A histograms + phase-B dense)
         fns = []
 
-        def run(agg_specs, spec):
+        def run(agg_specs, spec, extra_params=()):
             fn = get_sharded_kernel(mesh, stack.padded_docs,
                                     plan.filter_spec,
                                     tuple(agg_specs or ()), spec,
                                     plan.select_spec, lane_keys)
-            fns.append(fn)
-            return jax.device_get(fn(cols, tuple(plan.params), nd))
+            full = tuple(plan.params) + tuple(extra_params)
+            fns.append((fn, full))
+            return jax.device_get(fn(cols, full, nd))
 
         fin_plan = plan
         if group_spec is not None:
@@ -429,26 +430,29 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
             finish_ts.append(time.perf_counter() - t0)
         finish_s = median(finish_ts)
 
-        params = tuple(plan.params)
         zs = jnp.zeros(n_exec, jnp.int32)
+        only_fns = tuple(fn for fn, _ in fns)
+        all_fparams = tuple(fp for _, fp in fns)
 
         @jax.jit
-        def timed(cols, params, nd, zs, fns=tuple(fns)):
+        def timed(cols, nd, zs, all_fparams):
+            # params are jit ARGUMENTS (not constants) so the timed
+            # program is operand-driven exactly like production dispatch
             def body(c, z):
                 s = jnp.float32(0)
-                for fn in fns:             # every per-query dispatch
-                    o = fn(cols, params, nd + z)   # z == 0 at runtime only
+                for fn, fparams in zip(only_fns, all_fparams):
+                    o = fn(cols, fparams, nd + z)  # z == 0 at runtime only
                     for v in o.values():
                         s = s + v.astype(jnp.float32).sum()
                 return c + s, None
             out, _ = jax.lax.scan(body, jnp.float32(0), zs)
             return out
 
-        jax.device_get(timed(cols, params, nd, zs))    # compile
+        jax.device_get(timed(cols, nd, zs, all_fparams))    # compile
         samples = []
         for _ in range(max(3, reps)):
             t0 = time.perf_counter()
-            jax.device_get(timed(cols, params, nd, zs))
+            jax.device_get(timed(cols, nd, zs, all_fparams))
             total = time.perf_counter() - t0
             samples.append(max(total - rtt, 1e-5) / n_exec + finish_s)
         d50, d99 = median(samples), float(np.percentile(samples, 99))

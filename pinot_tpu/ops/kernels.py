@@ -416,7 +416,7 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
 # ---------------------------------------------------------------------------
 
 
-def _group_key(gcols, strides, g_pad, cols):
+def _group_key(gcols, strides, g_pad, cols, params=None):
     key = None
     for (c, gkind, off, _card), s in zip(gcols, strides):
         if gkind == "rawoff":
@@ -427,10 +427,13 @@ def _group_key(gcols, strides, g_pad, cols):
             ids = (lane - lane.dtype.type(off)).astype(jnp.int32)
         elif gkind == "idoff":
             # adaptive dense remap (plan.drive_group_execution): the
-            # filter's phase-A histogram bounded this column's active
-            # dictIds to [off, off+span); re-base so the dense group
-            # table covers only the active subspace
-            ids = cols[f"{c}.ids"].astype(jnp.int32) - np.int32(off)
+            # filter's phase-A scout bounded this column's active dictIds
+            # to [off, off+span); re-base so the group table covers only
+            # the active subspace. The offset is a RUNTIME operand (and
+            # spans are pow2-bucketed by the planner) so one compiled
+            # executable serves every literal of the same query template.
+            off_op = params.pop(0)
+            ids = cols[f"{c}.ids"].astype(jnp.int32) - off_op
         else:
             ids = cols[f"{c}.ids"].astype(jnp.int32)
         term = ids * np.int32(s)
@@ -549,14 +552,15 @@ def _slot_sum_tables(gslot, t_slots: int, int_vals, f32_vals, count_mask):
             None if count_mask is None else tc[:t_slots])
 
 
-def _group_outputs_compacted_sorted(group_spec, cols, mask, num_docs):
+def _group_outputs_compacted_sorted(group_spec, cols, mask, num_docs,
+                                    params=None):
     """Terminal fallback for barely-selective compacted group-bys
     (r > 256): full-segment sort compaction + scatters into dense
     [g_pad] tables. Slower than the MXU path but its memory/compute is
     bounded at any escalation rung, where the one-hot einsums would
     build O(rows * r) / O(cap * slots) intermediates."""
     gcols, strides, g_pad, agg_specs, kmax = group_spec
-    key = _group_key(gcols, strides, g_pad, cols)
+    key = _group_key(gcols, strides, g_pad, cols, params)
     n = mask.shape[0]
     mk = jnp.where(mask, key, jnp.int32(g_pad))      # invalid rows sort last
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -630,7 +634,8 @@ def _group_outputs_compacted_sorted(group_spec, cols, mask, num_docs):
     return outs
 
 
-def _group_outputs_compacted(group_spec, cols, mask, num_docs):
+def _group_outputs_compacted(group_spec, cols, mask, num_docs,
+                             params=None):
     """Filtered group-by over MXU-compacted matched rows.
 
     Every needed lane (mixed-radix key bytes, int8 metric parts, float
@@ -656,8 +661,8 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs):
         # barely-selective escalation rung: the one-hot compaction would
         # cost O(rows * r) — the bounded sort+scatter fallback wins there
         return _group_outputs_compacted_sorted(group_spec, cols, mask,
-                                               num_docs)
-    key = _group_key(gcols, strides, g_pad, cols)
+                                               num_docs, params)
+    key = _group_key(gcols, strides, g_pad, cols, params)
 
     # lane registry: key byte planes + per-agg value planes
     n_kb = _bytes_for(g_pad - 1)
@@ -807,11 +812,12 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs):
     return outs
 
 
-def _group_outputs(group_spec, cols, mask, num_docs):
+def _group_outputs(group_spec, cols, mask, num_docs, params=None):
     gcols, strides, g_pad, agg_specs, kmax = group_spec
     if kmax:
-        return _group_outputs_compacted(group_spec, cols, mask, num_docs)
-    key = _group_key(gcols, strides, g_pad, cols)
+        return _group_outputs_compacted(group_spec, cols, mask, num_docs,
+                                        params)
+    key = _group_key(gcols, strides, g_pad, cols, params)
     dense = g_pad <= DENSE_G_LIMIT and mask.shape[0] <= DENSE_ROWS_LIMIT
     if dense:
         outs = {"group.count": _dense_group_count(key, mask, g_pad)}
@@ -985,7 +991,8 @@ def build_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
         mask = _eval_filter(filter_spec, cols, plist, valid) & valid
         outs = {"stats.num_docs_matched": mask.sum(dtype=jnp.int32)}
         if group_spec is not None:
-            outs.update(_group_outputs(group_spec, cols, mask, num_docs))
+            outs.update(_group_outputs(group_spec, cols, mask, num_docs,
+                                       plist))
         elif agg_specs:
             outs.update(_agg_outputs(agg_specs, cols, mask, num_docs))
         if select_spec is not None:

@@ -335,13 +335,14 @@ class ShardedQueryExecutor:
         cols = stack.gather(plan.needed_cols)
         lane_keys = tuple(sorted(cols.keys()))
 
-        def run(agg_specs, group_spec):
+        def run(agg_specs, group_spec, extra_params=()):
             fn = get_sharded_kernel(
                 self.mesh, stack.padded_docs, plan.filter_spec,
                 tuple(agg_specs or ()), group_spec, plan.select_spec,
                 lane_keys)
-            return jax.device_get(fn(cols, tuple(plan.params),
-                                     stack.device_num_docs()))
+            return jax.device_get(fn(
+                cols, tuple(plan.params) + tuple(extra_params),
+                stack.device_num_docs()))
 
         from pinot_tpu.query.plan import (drive_group_execution,
                                           set_group_kmax)
@@ -356,7 +357,7 @@ class ShardedQueryExecutor:
                 execution._finish_group_by(
                     execution._with_group_spec(plan, spec_used), outs, blk)
         else:
-            outs = run(plan.agg_specs, None)
+            outs = run(plan.agg_specs, None, ())
             if plan.agg_specs:
                 execution._finish_aggregation(plan, outs, blk)
         matched = int(outs["stats.num_docs_matched"])

@@ -58,10 +58,11 @@ def execute_segment_plan(plan) -> IntermediateResultsBlock:
     cols = gather_operands(plan)
     from pinot_tpu.query.plan import drive_group_execution
 
-    def run(agg_specs, group_spec):
+    def run(agg_specs, group_spec, extra_params=()):
         return jax.device_get(kernels.run_segment_kernel(
             segment.padded_docs, plan.filter_spec, agg_specs,
-            group_spec, plan.select_spec, cols, plan.params,
+            group_spec, plan.select_spec, cols,
+            tuple(plan.params) + tuple(extra_params),
             segment.num_docs))
 
     blk = IntermediateResultsBlock()
@@ -74,7 +75,7 @@ def execute_segment_plan(plan) -> IntermediateResultsBlock:
         else:
             _finish_group_by(_with_group_spec(plan, spec_used), outs, blk)
     else:
-        outs = run(plan.agg_specs, None)
+        outs = run(plan.agg_specs, None, ())
         if plan.agg_specs:
             _finish_aggregation(plan, outs, blk)
     matched = int(outs["stats.num_docs_matched"])

@@ -677,23 +677,28 @@ def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
     """Derive the remapped group spec from the phase-A scout.
 
     `bounds` = per-gcol (lo, hi) matched dictId ranges. The remapped key
-    space is the product of the spans — orders of magnitude below the
-    full cross-product when the filter correlates with the group columns
-    (the star-schema norm). The compaction capacity kmax is sized from
-    the scout's matched count: per-2048-row-block Poisson mean plus tail
-    headroom (the kernel's overflow flag still escalates on skew).
-    Returns (spec, empty).
+    space is the product of the POW2-BUCKETED spans, and the offsets are
+    RUNTIME operands — so one compiled executable serves every literal
+    of the same query template (spans bucket to the same widths).
+    Returns (kernel_spec, finish_spec, extra_params, empty): the kernel
+    spec carries placeholder offsets (static, hashable jit key); the
+    finish spec carries the real offsets for host-side group decode.
+    The compaction capacity kmax is sized from the scout's matched count
+    (per-2048-row-block Poisson mean plus tail headroom; the kernel's
+    overflow flag still escalates on skew).
     """
     gcols, _strides, _g_pad, agg_specs, _kmax = group_spec
     offs, spans = [], []
     for lo, hi in bounds:
         if hi < lo:
-            return None, True
+            return None, None, (), True
         offs.append(lo)
-        spans.append(hi - lo + 1)
+        spans.append(kernels.pow2_bucket(hi - lo + 1, floor=1))
     g = int(np.prod(spans, dtype=np.int64))
-    new_gcols = tuple((c[0], "idoff", off, span)
-                      for c, off, span in zip(gcols, offs, spans))
+    kernel_gcols = tuple((c[0], "idoff", 0, span)
+                         for c, span in zip(gcols, spans))
+    finish_gcols = tuple((c[0], "idoff", off, span)
+                         for c, off, span in zip(gcols, offs, spans))
     strides = mixed_radix_strides(spans)
     g_pad = kernels.pow2_bucket(g)
     # compaction capacity from measured selectivity
@@ -708,15 +713,19 @@ def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
         kmax = 0
     else:
         kmax = min(t * r, padded)
-    spec = (new_gcols, strides, g_pad, agg_specs, kmax)
-    return spec, False
+    kernel_spec = (kernel_gcols, strides, g_pad, agg_specs, kmax)
+    finish_spec = (finish_gcols, strides, g_pad, agg_specs, kmax)
+    extra = tuple(np.int32(o) for o in offs)
+    return kernel_spec, finish_spec, extra, False
 
 
 def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     """Execution policy for device group-bys.
 
-    `run(agg_specs, group_spec)` dispatches the kernel, returns host outs.
-    Filtered dictionary-keyed group-bys take the ADAPTIVE TWO-PHASE path:
+    `run(agg_specs, group_spec, extra_params)` dispatches the kernel and
+    returns host outs (extra_params are appended after the filter
+    operands). Filtered dictionary-keyed group-bys take the ADAPTIVE
+    TWO-PHASE path:
 
     - Phase A (scout): masked min/max of each group column's dictIds +
       the matched count — one streaming-rate dispatch.
@@ -729,25 +738,28 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     those are TPU's slow primitives. Non-eligible plans fall back to the
     compacted kernel with the kmax escalation ladder.
 
-    Returns (outs, group_spec_used); group_spec_used=None means the
+    Returns (outs, group_spec_for_finish); None finish spec means the
     filter matched nothing (outs still carries the stats).
     """
     pa = adaptive_phase_a_specs(group_spec) \
         if padded <= kernels.DENSE_ROWS_LIMIT else None
     if pa is not None:
-        ha = run(pa, None)
+        ha = run(pa, None, ())
         bounds = [(int(ha[f"agg{2 * i}.min"]), int(ha[f"agg{2 * i + 1}.max"]))
                   for i in range(len(pa) // 2)]
         matched = int(ha["stats.num_docs_matched"])
-        spec2, empty = adaptive_phase_b_spec(group_spec, bounds, matched,
-                                             padded, total_docs)
+        kspec, fspec, extra, empty = adaptive_phase_b_spec(
+            group_spec, bounds, matched, padded, total_docs)
         if empty:
             return ha, None
-        if spec2 is not None:
-            return run_with_group_escalation(lambda gs: run((), gs),
-                                             spec2, padded)
-    return run_with_group_escalation(lambda gs: run((), gs), group_spec,
-                                     padded)
+        outs, final = run_with_group_escalation(
+            lambda gs: run((), gs, extra), kspec, padded)
+        if final is not kspec:            # ladder escalated kmax
+            fspec = fspec[:4] + (final[4],)
+        return outs, fspec
+    return run_with_group_escalation(lambda gs: run((), gs, ()),
+                                     group_spec, padded)
+
 
 
 def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,

@@ -315,14 +315,24 @@ def test_adaptive_dense_remap_group_by(wide_group_setup):
     assert pa is not None and [s[1] for s in pa] == ["a", "a", "b", "b"]
     assert {s[0] for s in pa} == {"min", "max"}
     # simulated scout bounds: a in [100, 105], b full range; selective
-    spec2, empty = adaptive_phase_b_spec(
+    kspec, fspec, extra, empty = adaptive_phase_b_spec(
         plan.group_spec, [(100, 105), (0, 249)], matched=2,
         padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
-    assert not empty and spec2 is not None
-    assert spec2[0][0][1] == "idoff" and spec2[0][0][2] == 100
-    assert spec2[4] > 0                        # compacted (very selective)
+    assert not empty and kspec is not None
+    # kernel spec: placeholder offset (literal-stable jit key), bucketed
+    # span; finish spec carries the real offset; offsets ride as params
+    assert kspec[0][0][1] == "idoff" and kspec[0][0][2] == 0
+    assert kspec[0][0][3] == 8                 # span 6 → pow2 bucket
+    assert fspec[0][0][2] == 100
+    assert tuple(int(x) for x in extra) == (100, 0)
+    assert kspec[4] > 0                        # compacted (very selective)
+    # same template, different literal → SAME kernel spec (no recompile)
+    kspec2, _, extra2, _ = adaptive_phase_b_spec(
+        plan.group_spec, [(200, 205), (0, 249)], matched=2,
+        padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
+    assert kspec2 == kspec and tuple(int(x) for x in extra2) == (200, 0)
     # barely-selective: the cost model flips to the direct dense layout
-    dense_spec, _ = adaptive_phase_b_spec(
+    dense_spec, _, _, _ = adaptive_phase_b_spec(
         plan.group_spec, [(100, 105), (0, 249)], matched=2000,
         padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
     assert dense_spec[4] == 0
