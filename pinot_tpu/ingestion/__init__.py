@@ -1,6 +1,8 @@
 from pinot_tpu.ingestion.record_reader import (CSVRecordReader,
                                                GenericRowRecordReader,
                                                JSONRecordReader,
+                                               ORCRecordReader,
+                                               ParquetRecordReader,
                                                RecordReader,
                                                SegmentRecordReader,
                                                make_record_reader)
@@ -14,6 +16,7 @@ from pinot_tpu.ingestion.transformer import (CompoundTransformer,
 
 __all__ = [
     "RecordReader", "CSVRecordReader", "JSONRecordReader",
+    "ParquetRecordReader", "ORCRecordReader",
     "GenericRowRecordReader", "SegmentRecordReader", "make_record_reader",
     "RecordTransformer", "CompoundTransformer", "ExpressionTransformer",
     "TimeTransformer", "DataTypeTransformer", "NullValueTransformer",

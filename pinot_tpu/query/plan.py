@@ -701,7 +701,11 @@ def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
                          for c, off, span in zip(gcols, offs, spans))
     strides = mixed_radix_strides(spans)
     g_pad = kernels.pow2_bucket(g)
-    # compaction capacity from measured selectivity
+    # compaction capacity from measured selectivity.  NOTE: r (and hence
+    # kmax) is pow2-bucketed from the phase-A matched count, so literal
+    # stability holds only within a selectivity bucket — literals of the
+    # same template whose match rates land in different pow2 buckets (or
+    # cross the dense-flip threshold below) still compile fresh variants.
     t = max(padded // kernels.CBLOCK, 1)
     mu = matched * kernels.CBLOCK / max(total_docs, 1)
     r = kernels.pow2_bucket(max(16, int(2 * mu + 8)))

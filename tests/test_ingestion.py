@@ -246,3 +246,46 @@ def test_expression_transformer_scalar_literals():
     from pinot_tpu.ingestion import ExpressionTransformer
     t = ExpressionTransformer({"region": "'west'"})
     assert t.transform({"b": 1})["region"] == "west"
+
+
+def _arrow_rows_table():
+    import pyarrow as pa
+    return pa.table({
+        "teamID": [r["teamID"] for r in ROWS],
+        "league": [r["league"] for r in ROWS],
+        "playerName": [r["playerName"] for r in ROWS],
+        "position": [r["position"] for r in ROWS],
+        "runs": [r["runs"] for r in ROWS],
+        "hits": [r["hits"] for r in ROWS],
+        "average": [r["average"] for r in ROWS],
+        "salary": [r["salary"] for r in ROWS],
+        "yearID": [r["yearID"] for r in ROWS],
+    })
+
+
+def test_parquet_reader_to_segment_to_query():
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+    base = tempfile.mkdtemp()
+    path = os.path.join(base, "in.parquet")
+    pq.write_table(_arrow_rows_table(), path)
+    seg_dir = os.path.join(base, "seg")
+    meta = create_segment_from_file(path, "parquet", make_schema(), seg_dir,
+                                    make_table_config(),
+                                    segment_name="pq_seg_0")
+    assert meta.total_docs == 3
+    _check_segment_queries(seg_dir)
+
+
+def test_orc_reader_to_segment_to_query():
+    pa = pytest.importorskip("pyarrow")
+    from pyarrow import orc as pa_orc
+    base = tempfile.mkdtemp()
+    path = os.path.join(base, "in.orc")
+    pa_orc.write_table(_arrow_rows_table(), path)
+    seg_dir = os.path.join(base, "seg")
+    meta = create_segment_from_file(path, "orc", make_schema(), seg_dir,
+                                    make_table_config(),
+                                    segment_name="orc_seg_0")
+    assert meta.total_docs == 3
+    _check_segment_queries(seg_dir)
