@@ -1,3 +1,4 @@
+from pinot_tpu.ingestion.avro import AvroRecordReader
 from pinot_tpu.ingestion.record_reader import (CSVRecordReader,
                                                GenericRowRecordReader,
                                                JSONRecordReader,
@@ -16,7 +17,7 @@ from pinot_tpu.ingestion.transformer import (CompoundTransformer,
 
 __all__ = [
     "RecordReader", "CSVRecordReader", "JSONRecordReader",
-    "ParquetRecordReader", "ORCRecordReader",
+    "AvroRecordReader", "ParquetRecordReader", "ORCRecordReader",
     "GenericRowRecordReader", "SegmentRecordReader", "make_record_reader",
     "RecordTransformer", "CompoundTransformer", "ExpressionTransformer",
     "TimeTransformer", "DataTypeTransformer", "NullValueTransformer",

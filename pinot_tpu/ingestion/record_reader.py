@@ -147,12 +147,13 @@ class _ArrowTableRecordReader(RecordReader):
 
     def __init__(self, table):
         self._table = table
+        self._pylist = None
 
     def _rows(self) -> Iterator[dict]:
-        names = self._table.column_names
-        cols = [self._table.column(n).to_pylist() for n in names]
-        for i in range(self._table.num_rows):
-            yield {n: c[i] for n, c in zip(names, cols)}
+        if self._pylist is None:  # convert once; readers are re-iterable
+            self._pylist = self._table.to_pylist()
+        for row in self._pylist:
+            yield dict(row)
 
 
 class ParquetRecordReader(_ArrowTableRecordReader):
@@ -197,5 +198,8 @@ def make_record_reader(path: str, fmt: str,
         return ParquetRecordReader(path)
     if fmt == "orc":
         return ORCRecordReader(path)
+    if fmt == "avro":
+        from pinot_tpu.ingestion.avro import AvroRecordReader
+        return AvroRecordReader(path)
     raise ValueError(
-        f"unsupported input format {fmt!r} (csv, json, parquet, orc)")
+        f"unsupported input format {fmt!r} (csv, json, avro, parquet, orc)")

@@ -60,7 +60,8 @@ class AggregationFunction:
         base = self.info.base
         if base not in ("COUNT", "SUM", "MIN", "MAX", "AVG", "MINMAXRANGE",
                         "DISTINCTCOUNT", "DISTINCTCOUNTHLL", "PERCENTILE",
-                        "PERCENTILEEST", "PERCENTILETDIGEST", "FASTHLL"):
+                        "PERCENTILEEST", "PERCENTILETDIGEST", "FASTHLL",
+                        "DISTINCTCOUNTRAWHLL"):
             raise ValueError(f"unsupported aggregation function {name}")
 
     @property
@@ -81,7 +82,7 @@ class AggregationFunction:
         if base == "DISTINCTCOUNT":
             nz = np.nonzero(h)[0]
             return set(_plain(dict_values[i]) for i in nz)
-        if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+        if base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):
             # sketch intermediate: mergeable across segments/servers with
             # non-shared dictionaries (ObjectSerDeUtils HyperLogLog parity)
             nz = np.nonzero(h)[0]
@@ -156,7 +157,7 @@ class AggregationFunction:
             return (mn, mx)
         if base == "DISTINCTCOUNT":
             return a | b
-        if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+        if base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):
             return a.merge(b)
         if base == "PERCENTILE":
             out = dict(a)
@@ -192,6 +193,10 @@ class AggregationFunction:
             return mx - mn
         if base == "DISTINCTCOUNT":
             return len(intermediate)
+        if base == "DISTINCTCOUNTRAWHLL":
+            # serialized-sketch result (DistinctCountRawHLL parity): the
+            # client merges/estimates; hex like SerializedHLL.toString()
+            return intermediate.to_bytes().hex()
         if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
             return int(round(intermediate.cardinality()))
         if base == "PERCENTILE":
@@ -202,10 +207,25 @@ class AggregationFunction:
             return intermediate.quantile(self.info.percentile / 100.0)
         raise ValueError(base)
 
+    def sortable_final(self, intermediate) -> float:
+        """Numeric ordering key for top-N / trim over group results.
+
+        DISTINCTCOUNTRAWHLL's final value is a hex string, but it must
+        order by the estimate (Pinot's SerializedHLL is Comparable by
+        cardinality); everything else orders by its numeric final.
+        """
+        if self.info.base == "DISTINCTCOUNTRAWHLL":
+            return 0.0 if intermediate is None \
+                else float(intermediate.cardinality())
+        v = self.extract_final(intermediate)
+        return v if isinstance(v, (int, float)) else float("-inf")
+
     def empty_result(self):
         base = self.info.base
         if base == "COUNT":
             return 0
+        if base == "DISTINCTCOUNTRAWHLL":
+            return HyperLogLog().to_bytes().hex()
         if base in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
             return 0
         if base == "MIN":

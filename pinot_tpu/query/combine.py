@@ -122,7 +122,7 @@ def trim_group_map(group_map: Dict[Tuple, List],
     keys = list(group_map.keys())
     for fi, f in enumerate(functions):
         scored = sorted(
-            keys, key=lambda k: _sortable(f.extract_final(group_map[k][fi])),
+            keys, key=lambda k: f.sortable_final(group_map[k][fi]),
             reverse=True)
         keep.update(scored[:trim_size])
     return {k: group_map[k] for k in keep}

@@ -259,7 +259,7 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
         return (float(vals.min()), float(vals.max()))
     if base == "DISTINCTCOUNT":
         return set(_plain(v) for v in np.unique(vals))
-    if base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+    if base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):
         return HyperLogLog.from_values(np.unique(vals))
     if base == "PERCENTILE":
         uniq, counts = np.unique(vals, return_counts=True)
@@ -331,7 +331,8 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
             if cm.has_dictionary and not cm.single_value:
                 raise ValueError("host group-by over MV metric unsupported")
         vals = _group_value_lane(segment, f.column, mask)
-        if base not in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL"):
+        if base not in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL",
+                        "DISTINCTCOUNTRAWHLL"):
             vals = vals.astype(np.float64)   # distinct bases keep strings
         if base in ("SUM", "AVG"):
             sums = np.zeros(g)
@@ -362,7 +363,7 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
                 sel = vals[inverse == gi]
                 if base == "DISTINCTCOUNT":
                     items[gi] = set(_plain(v) for v in np.unique(sel))
-                elif base in ("DISTINCTCOUNTHLL", "FASTHLL"):
+                elif base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):
                     items[gi] = HyperLogLog.from_values(np.unique(sel))
                 elif base == "PERCENTILE":
                     u, c = np.unique(sel, return_counts=True)

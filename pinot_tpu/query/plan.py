@@ -804,6 +804,7 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
         "MINMAXRANGE": "minmaxrange",
         "DISTINCTCOUNT": "distinctcount",
         "DISTINCTCOUNTHLL": "distinctcount", "FASTHLL": "distinctcount",
+        "DISTINCTCOUNTRAWHLL": "distinctcount",
         "PERCENTILE": "percentile", "PERCENTILEEST": "percentile",
         "PERCENTILETDIGEST": "percentile",
     }[base]

@@ -15,7 +15,7 @@ from pinot_tpu.common.response import (AggregationResult, BrokerResponse,
                                        SelectionResults)
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import IntermediateResultsBlock
-from pinot_tpu.query.combine import combine_blocks, _sortable
+from pinot_tpu.query.combine import combine_blocks
 
 
 class BrokerReduceService:
@@ -77,9 +77,10 @@ class BrokerReduceService:
         top_n = request.group_by.top_n
         results = []
         for fi, f in enumerate(functions):
-            ordered = sorted(finals.items(),
-                             key=lambda kv: _sortable(kv[1][fi]),
-                             reverse=True)[:top_n]
+            ordered = sorted(
+                finals.items(),
+                key=lambda kv: f.sortable_final(group_map[kv[0]][fi]),
+                reverse=True)[:top_n]
             results.append(AggregationResult(
                 function=f.result_name,
                 group_by_columns=list(request.group_by.columns),

@@ -178,3 +178,38 @@ def test_hll_group_by_and_wire(hetero_segments):
         broker.close()
         for s in servers.values():
             s.stop()
+
+
+def test_distinctcountrawhll_returns_serialized_sketch(hetero_segments):
+    segs, names, runs = hetero_segments
+    eng = QueryEngine(segs)
+    resp = eng.query("SELECT DISTINCTCOUNTRAWHLL(playerName) "
+                     "FROM baseballStats")
+    raw = resp.aggregation_results[0].value
+    # the result IS the sketch (DistinctCountRawHLL parity): hex-decode,
+    # estimate must match the DISTINCTCOUNTHLL path exactly
+    hll = HyperLogLog.from_bytes(bytes.fromhex(raw))
+    est = int(round(hll.cardinality()))
+    resp2 = eng.query("SELECT DISTINCTCOUNTHLL(playerName) "
+                      "FROM baseballStats")
+    assert est == int(resp2.aggregation_results[0].value)
+    true_distinct = len(np.unique(names))
+    assert abs(est - true_distinct) / true_distinct < 0.06
+
+
+def test_distinctcountrawhll_group_by_orders_by_estimate(hetero_segments):
+    segs, names, runs = hetero_segments
+    eng = QueryEngine(segs)
+    resp = eng.query("SELECT DISTINCTCOUNTRAWHLL(playerName) "
+                     "FROM baseballStats GROUP BY teamID TOP 2")
+    got = [(g["group"][0],
+            int(round(HyperLogLog.from_bytes(
+                bytes.fromhex(g["value"])).cardinality())))
+           for g in resp.aggregation_results[0].group_by_result]
+    resp2 = eng.query("SELECT DISTINCTCOUNTHLL(playerName) "
+                      "FROM baseballStats GROUP BY teamID TOP 1000")
+    ests = sorted(((g["group"][0], int(g["value"]))
+                   for g in resp2.aggregation_results[0].group_by_result),
+                  key=lambda kv: -kv[1])
+    # top-2 groups must be the highest-estimate groups, same estimates
+    assert got == ests[:2]
