@@ -48,6 +48,21 @@ class PropertyStore:
             cb(path, rec)
         return rec
 
+    def cas(self, path: str, expected: Optional[dict],
+            record: dict) -> bool:
+        """Compare-and-set: apply only if the current record equals
+        `expected` (None = path absent). The remote client's update()
+        builds its read-modify-write loop on this."""
+        with self._lock:
+            if self._data.get(path) != expected:
+                return False
+            self._data[path] = json.loads(json.dumps(record))
+            watchers = [cb for p, cb in self._watchers
+                        if path.startswith(p)]
+        for cb in watchers:
+            cb(path, record)
+        return True
+
     def remove(self, path: str) -> bool:
         with self._lock:
             existed = self._data.pop(path, None) is not None

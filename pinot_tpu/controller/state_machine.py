@@ -53,6 +53,102 @@ class StateModel:
         pass
 
 
+def apply_transitions(model: StateModel, table: str, inst: str,
+                      wanted: Dict[str, str],
+                      current: Dict[str, str]) -> bool:
+    """Drive `model` from `current` toward `wanted`; mutate `current`.
+
+    Shared by the in-process coordinator and the remote ParticipantAgent
+    (server/agent.py) — the transition semantics
+    (SegmentOnlineOfflineStateModelFactory parity, ERROR on failure,
+    offline+drop on unassignment) must be identical in both deployments.
+    Returns whether `current` changed.
+    """
+    changed = False
+    for seg, target in wanted.items():
+        state = current.get(seg, OFFLINE)
+        if state == target:
+            continue
+        try:
+            if target == ONLINE:
+                model.on_become_online(table, seg)
+            elif target == CONSUMING:
+                model.on_become_consuming(table, seg)
+            elif target == OFFLINE:
+                model.on_become_offline(table, seg)
+            elif target == DROPPED:
+                if state in (ONLINE, CONSUMING):
+                    model.on_become_offline(table, seg)
+                model.on_become_dropped(table, seg)
+            current[seg] = target
+        except Exception:  # noqa: BLE001 — transition failure => ERROR
+            log.exception("transition %s -> %s failed for %s/%s on %s",
+                          state, target, table, seg, inst)
+            current[seg] = ERROR
+        changed = True
+    # segments no longer assigned to this instance: offline + drop
+    for seg in [s for s in current if s not in wanted]:
+        if current[seg] in (ONLINE, CONSUMING):
+            try:
+                model.on_become_offline(table, seg)
+                model.on_become_dropped(table, seg)
+            except Exception:  # noqa: BLE001
+                log.exception("unassign failed for %s/%s", table, seg)
+        del current[seg]
+        changed = True
+    return changed
+
+
+def compose_view(store: PropertyStore, table: str) -> None:
+    """Recompute /EXTERNALVIEW/<table> from live instances' current states.
+
+    Writes only on change, so redundant composers (the in-process
+    coordinator and a ViewComposer over the same store) don't generate
+    watch noise.
+    """
+    view: Dict[str, Dict[str, str]] = {}
+    for inst in store.children(LIVE):
+        current = (store.get(f"{CURRENT}/{inst}/{table}") or {}
+                   ).get("segments", {})
+        for seg, state in current.items():
+            if state != DROPPED:
+                view.setdefault(seg, {})[inst] = state
+    new = {"segments": view}
+    if store.get(f"{VIEW}/{table}") != new:
+        store.set(f"{VIEW}/{table}", new)
+
+
+class ViewComposer:
+    """Controller-side external-view maintenance for remote participants.
+
+    Parity: the Helix controller recomputing ExternalViews from
+    CurrentStates + LiveInstances.  The in-process coordinator composes
+    views synchronously after driving its own participants; remote
+    participants (server/agent.py) write current states over the store,
+    and this composer reacts to those writes — including the ephemeral
+    current-state/live-instance removal when a server dies.
+    """
+
+    def __init__(self, store: PropertyStore):
+        self.store = store
+        self._watcher = self._on_change
+        store.watch(CURRENT + "/", self._watcher)
+        store.watch(LIVE + "/", self._watcher)
+
+    def _on_change(self, path: str, record: Optional[dict]) -> None:
+        if path.startswith(CURRENT + "/"):
+            parts = path[len(CURRENT) + 1:].split("/", 1)
+            if len(parts) == 2:
+                compose_view(self.store, parts[1])
+            return
+        # live-instance change: membership affects every table's view
+        for table in self.store.children(IDEAL):
+            compose_view(self.store, table)
+
+    def close(self) -> None:
+        self.store.unwatch(self._watcher)
+
+
 class ClusterCoordinator:
     """Drives participants toward ideal state; composes external views."""
 
@@ -154,48 +250,8 @@ class ClusterCoordinator:
         current = (self.store.get(path) or {}).get("segments", {})
         wanted = {seg: states[inst] for seg, states in ideal.items()
                   if inst in states}
-        changed = False
-        for seg, target in wanted.items():
-            state = current.get(seg, OFFLINE)
-            if state == target:
-                continue
-            try:
-                if target == ONLINE:
-                    model.on_become_online(table, seg)
-                elif target == CONSUMING:
-                    model.on_become_consuming(table, seg)
-                elif target == OFFLINE:
-                    model.on_become_offline(table, seg)
-                elif target == DROPPED:
-                    if state in (ONLINE, CONSUMING):
-                        model.on_become_offline(table, seg)
-                    model.on_become_dropped(table, seg)
-                current[seg] = target
-            except Exception:  # noqa: BLE001 — transition failure => ERROR
-                log.exception("transition %s -> %s failed for %s/%s on %s",
-                              state, target, table, seg, inst)
-                current[seg] = ERROR
-            changed = True
-        # segments no longer assigned to this instance: offline + drop
-        for seg in [s for s in current if s not in wanted]:
-            if current[seg] in (ONLINE, CONSUMING):
-                try:
-                    model.on_become_offline(table, seg)
-                    model.on_become_dropped(table, seg)
-                except Exception:  # noqa: BLE001
-                    log.exception("unassign failed for %s/%s", table, seg)
-            del current[seg]
-            changed = True
-        if changed:
+        if apply_transitions(model, table, inst, wanted, current):
             self.store.set(path, {"segments": current})
 
     def _recompute_view(self, table: str) -> None:
-        live = set(self._participants)
-        view: Dict[str, Dict[str, str]] = {}
-        for inst in live:
-            current = (self.store.get(f"{CURRENT}/{inst}/{table}") or {}
-                       ).get("segments", {})
-            for seg, state in current.items():
-                if state != DROPPED:
-                    view.setdefault(seg, {})[inst] = state
-        self.store.set(f"{VIEW}/{table}", {"segments": view})
+        compose_view(self.store, table)

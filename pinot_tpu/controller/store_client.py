@@ -1,0 +1,194 @@
+"""RemotePropertyStore: PropertyStore interface over the store server.
+
+Parity: the ZooKeeper *client* role — every non-controller process in the
+reference holds a ZK session for cluster state and watches.  This client
+speaks the store_server frame protocol and exposes exactly the
+PropertyStore interface, so ClusterCoordinator, ResourceManager,
+BrokerClusterWatcher, minions etc. run unchanged over a remote store.
+
+- update(fn) is a CAS retry loop (read → fn → compare-and-set), giving
+  the same atomic read-modify-write the in-process store's lock provides.
+- watch callbacks are dispatched on a single daemon thread in arrival
+  order (ZK's single watcher-thread ordering guarantee).
+- set(..., ephemeral=True) binds the path to this client's connection:
+  the server removes it when the connection dies (ZK ephemeral znodes).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.transport.tcp import read_frame, write_frame
+
+Watcher = Callable[[str, Optional[dict]], None]
+
+
+class StoreClosedError(ConnectionError):
+    pass
+
+
+class RemotePropertyStore:
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._loop = asyncio.new_event_loop()
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._watchers: List[tuple] = []        # (prefix, callback)
+        self._watch_lock = threading.Lock()
+        self._events: "queue.Queue" = queue.Queue()
+        self._closed = False
+
+        ready = threading.Event()
+        boot: Dict[str, Optional[BaseException]] = {"err": None}
+
+        def run() -> None:
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._reader, self._writer = self._loop.run_until_complete(
+                    asyncio.open_connection(host, port))
+            except BaseException as e:  # noqa: BLE001
+                boot["err"] = e
+                ready.set()
+                return
+            self._reader_task = self._loop.create_task(self._read_loop())
+            ready.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        ready.wait()
+        if boot["err"] is not None:
+            raise ConnectionError(
+                f"cannot reach property store at {host}:{port}: "
+                f"{boot['err']}")
+        self._dispatcher = threading.Thread(target=self._dispatch_events,
+                                            daemon=True)
+        self._dispatcher.start()
+
+    # -- wire --------------------------------------------------------------
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                msg = json.loads(frame)
+                if "event" in msg:
+                    self._events.put(msg["event"])
+                    continue
+                fut = self._pending.pop(msg.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(StoreClosedError("store disconnected"))
+            self._pending.clear()
+            self._events.put(None)
+
+    def _call(self, **req) -> dict:
+        if self._closed:
+            raise StoreClosedError("store client is closed")
+        with self._id_lock:
+            self._next_id += 1
+            req["id"] = self._next_id
+
+        async def send_and_wait() -> dict:
+            fut = self._loop.create_future()
+            self._pending[req["id"]] = fut
+            write_frame(self._writer, json.dumps(req).encode("utf-8"))
+            await self._writer.drain()
+            return await asyncio.wait_for(fut, self.timeout)
+
+        resp = asyncio.run_coroutine_threadsafe(
+            send_and_wait(), self._loop).result(self.timeout + 1)
+        if not resp.get("ok"):
+            raise RuntimeError(f"store op failed: {resp.get('error')}")
+        return resp
+
+    def _dispatch_events(self) -> None:
+        while True:
+            ev = self._events.get()
+            if ev is None:
+                return
+            path, record = ev["path"], ev["record"]
+            with self._watch_lock:
+                cbs = [cb for p, cb in self._watchers
+                       if path.startswith(p)]
+            for cb in cbs:
+                try:
+                    cb(path, record)
+                except Exception:  # noqa: BLE001 — watcher errors are theirs
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "watch callback failed for %s", path)
+
+    # -- PropertyStore interface ------------------------------------------
+    def set(self, path: str, record: dict, ephemeral: bool = False) -> None:
+        self._call(op="set", path=path, record=record, ephemeral=ephemeral)
+
+    def get(self, path: str) -> Optional[dict]:
+        return self._call(op="get", path=path)["record"]
+
+    def cas(self, path: str, expected: Optional[dict],
+            record: dict) -> bool:
+        return self._call(op="cas", path=path, expected=expected,
+                          record=record)["applied"]
+
+    def update(self, path: str, fn: Callable[[Optional[dict]], dict]
+               ) -> dict:
+        while True:
+            cur = self.get(path)
+            rec = fn(cur)
+            if self.cas(path, cur, rec):
+                return rec
+
+    def remove(self, path: str) -> bool:
+        return self._call(op="remove", path=path)["existed"]
+
+    def children(self, prefix: str) -> List[str]:
+        return self._call(op="children", prefix=prefix)["result"]
+
+    def list_paths(self, prefix: str) -> List[str]:
+        return self._call(op="list", prefix=prefix)["result"]
+
+    def watch(self, prefix: str, callback: Watcher) -> None:
+        with self._watch_lock:
+            self._watchers.append((prefix, callback))
+        self._call(op="watch", prefix=prefix)
+
+    def unwatch(self, callback: Watcher) -> None:
+        # server-side prefixes stay registered (another callback may share
+        # them); dropping the local route is what stops delivery
+        with self._watch_lock:
+            self._watchers = [(p, cb) for p, cb in self._watchers
+                              if cb is not callback]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+
+        async def shutdown() -> None:
+            self._reader_task.cancel()
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+            self._loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+            self._thread.join(timeout=5)
+        except RuntimeError:
+            pass
+        self._events.put(None)

@@ -1,0 +1,195 @@
+"""Networked property store: serve a PropertyStore over framed TCP.
+
+Parity: the ZooKeeper server role in the reference deployment — every
+process (controller, brokers, servers, minions) connects to one store
+for cluster state, watches push change notifications, and
+connection-scoped *ephemeral* paths vanish when their owner disconnects
+(ZK ephemeral znodes — the liveness mechanism behind Helix LIVEINSTANCES,
+docs/architecture.rst:35-120).
+
+Wire protocol: 4-byte-length JSON frames (same framing as the data plane,
+transport/tcp.py). Requests carry an `id` echoed in the response; watch
+events are pushed as id-less `{"event": {"path", "record"}}` frames.
+
+Ops: get, set, cas, remove, children, list, watch, unwatch, ping.
+`set` takes `"ephemeral": true` to bind the path's lifetime to the
+connection.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Optional, Set
+
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.transport.tcp import read_frame, write_frame
+
+log = logging.getLogger(__name__)
+
+
+class _Connection:
+    """One client: request handling + ordered event/response writer."""
+
+    def __init__(self, server: "PropertyStoreServer",
+                 reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.watched_prefixes: Set[str] = set()
+        self.ephemeral_paths: Set[str] = set()
+        self._store_watcher = None
+
+    # store watcher callbacks arrive on arbitrary threads
+    def on_store_event(self, path: str, record: Optional[dict]) -> None:
+        try:
+            self.server.loop.call_soon_threadsafe(
+                self.queue.put_nowait,
+                {"event": {"path": path, "record": record}})
+        except RuntimeError:
+            pass  # loop already shut down; connection is being reaped
+
+    async def run(self) -> None:
+        writer_task = asyncio.create_task(self._drain())
+        try:
+            while True:
+                try:
+                    frame = await read_frame(self.reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                req = None
+                try:
+                    req = json.loads(frame)
+                    resp = self._handle(req)
+                except Exception as e:  # noqa: BLE001 — report, keep serving
+                    resp = {"id": req.get("id") if isinstance(req, dict)
+                            else None, "ok": False, "error": str(e)}
+                await self.queue.put(resp)
+        finally:
+            writer_task.cancel()
+            self._cleanup()
+
+    async def _drain(self) -> None:
+        while True:
+            msg = await self.queue.get()
+            write_frame(self.writer, json.dumps(msg).encode("utf-8"))
+            await self.writer.drain()
+
+    def _cleanup(self) -> None:
+        store = self.server.store
+        if self._store_watcher is not None:
+            store.unwatch(self._store_watcher)
+        for path in sorted(self.ephemeral_paths):
+            store.remove(path)
+        self.server.connections.discard(self)
+        try:
+            self.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _handle(self, req: dict) -> dict:
+        store = self.server.store
+        op = req["op"]
+        rid = req.get("id")
+        ok = {"id": rid, "ok": True}
+        if op == "ping":
+            return ok
+        if op == "get":
+            return {**ok, "record": store.get(req["path"])}
+        if op == "set":
+            store.set(req["path"], req["record"])
+            if req.get("ephemeral"):
+                self.ephemeral_paths.add(req["path"])
+            return ok
+        if op == "cas":
+            applied = store.cas(req["path"], req.get("expected"),
+                                req["record"])
+            if applied and req.get("ephemeral"):
+                self.ephemeral_paths.add(req["path"])
+            return {**ok, "applied": applied}
+        if op == "remove":
+            existed = store.remove(req["path"])
+            self.ephemeral_paths.discard(req["path"])
+            return {**ok, "existed": existed}
+        if op == "children":
+            return {**ok, "result": store.children(req["prefix"])}
+        if op == "list":
+            return {**ok, "result": store.list_paths(req["prefix"])}
+        if op == "watch":
+            if self._store_watcher is None:
+                # one fan-in watcher per connection; client-side code
+                # routes events to per-prefix callbacks
+                def fanin(path: str, record: Optional[dict],
+                          conn=self) -> None:
+                    if any(path.startswith(p)
+                           for p in conn.watched_prefixes):
+                        conn.on_store_event(path, record)
+                self._store_watcher = fanin
+                store.watch("", fanin)
+            self.watched_prefixes.add(req["prefix"])
+            return ok
+        if op == "unwatch":
+            self.watched_prefixes.discard(req["prefix"])
+            return ok
+        raise ValueError(f"unknown op {op!r}")
+
+
+class PropertyStoreServer:
+    """Serve `store` on host:port from a daemon event-loop thread."""
+
+    def __init__(self, store: Optional[PropertyStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store if store is not None else PropertyStore()
+        self.host = host
+        self.port = port
+        self.connections: Set[_Connection] = set()
+        self.loop = asyncio.new_event_loop()
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def start(self) -> int:
+        started = threading.Event()
+        boot: dict = {"err": None}
+
+        def run() -> None:
+            asyncio.set_event_loop(self.loop)
+            try:
+                self._server = self.loop.run_until_complete(
+                    asyncio.start_server(self._serve, self.host, self.port))
+            except BaseException as e:  # noqa: BLE001 — surface bind errors
+                boot["err"] = e
+                started.set()
+                return
+            self.port = self._server.sockets[0].getsockname()[1]
+            started.set()
+            self.loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        started.wait()
+        if boot["err"] is not None:
+            raise OSError(
+                f"property store cannot bind {self.host}:{self.port}: "
+                f"{boot['err']}") from boot["err"]
+        return self.port
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        conn = _Connection(self, reader, writer)
+        self.connections.add(conn)
+        await conn.run()
+
+    def stop(self) -> None:
+        def shutdown() -> None:
+            if self._server is not None:
+                self._server.close()
+            for conn in list(self.connections):
+                conn._cleanup()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

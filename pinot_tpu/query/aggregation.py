@@ -207,17 +207,22 @@ class AggregationFunction:
             return intermediate.quantile(self.info.percentile / 100.0)
         raise ValueError(base)
 
-    def sortable_final(self, intermediate) -> float:
+    _UNSET = object()
+
+    def sortable_final(self, intermediate, final=_UNSET) -> float:
         """Numeric ordering key for top-N / trim over group results.
 
         DISTINCTCOUNTRAWHLL's final value is a hex string, but it must
         order by the estimate (Pinot's SerializedHLL is Comparable by
         cardinality); everything else orders by its numeric final.
+        Callers that already extracted the final pass it to avoid
+        recomputing (percentile extraction sorts per group).
         """
         if self.info.base == "DISTINCTCOUNTRAWHLL":
             return 0.0 if intermediate is None \
                 else float(intermediate.cardinality())
-        v = self.extract_final(intermediate)
+        v = self.extract_final(intermediate) if final is self._UNSET \
+            else final
         return v if isinstance(v, (int, float)) else float("-inf")
 
     def empty_result(self):

@@ -128,12 +128,6 @@ def trim_group_map(group_map: Dict[Tuple, List],
     return {k: group_map[k] for k in keep}
 
 
-def _sortable(v):
-    if isinstance(v, (int, float)):
-        return v
-    return float("-inf")
-
-
 def _trim_selection(request: BrokerRequest,
                     out: IntermediateResultsBlock) -> None:
     sel = request.selection

@@ -79,7 +79,8 @@ class BrokerReduceService:
         for fi, f in enumerate(functions):
             ordered = sorted(
                 finals.items(),
-                key=lambda kv: f.sortable_final(group_map[kv[0]][fi]),
+                key=lambda kv: f.sortable_final(group_map[kv[0]][fi],
+                                                final=kv[1][fi]),
                 reverse=True)[:top_n]
             results.append(AggregationResult(
                 function=f.result_name,

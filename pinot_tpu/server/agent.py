@@ -1,0 +1,91 @@
+"""ParticipantAgent: server-process side of the distributed state machine.
+
+Parity: the Helix participant embedded in HelixServerStarter — the server
+process announces itself as a live instance (ephemeral), watches ideal
+states, drives its own state model (segment load/unload/consume), and
+publishes current states for the controller's view composer
+(controller/state_machine.py ViewComposer).  With this agent + a
+RemotePropertyStore (controller/store_client.py), a server runs in its
+own process connected to the controller only through the store — the
+reference's ZK-mediated deployment shape.
+
+Current states and the live-instance record are written ephemeral where
+the store supports it, so a dying server's segments leave the external
+view with its session (ZK ephemeral-node semantics).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from pinot_tpu.controller.state_machine import (CURRENT, IDEAL, LIVE,
+                                                StateModel,
+                                                apply_transitions)
+
+
+class ParticipantAgent:
+    def __init__(self, store, instance_id: str, model: StateModel,
+                 tags: Optional[List[str]] = None,
+                 endpoint: Optional[tuple] = None):
+        """`endpoint`: (host, port) of this server's query service,
+        published in the live-instance record so brokers can build their
+        data-plane connections from the store (the reference encodes
+        host/port in the Helix instance name)."""
+        self.store = store
+        self.instance_id = instance_id
+        self.model = model
+        self.tags = list(tags or ["DefaultTenant"])
+        self.endpoint = endpoint
+        self._lock = threading.Lock()
+        self._watcher = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        rec = {"tags": self.tags}
+        if self.endpoint is not None:
+            rec["host"], rec["port"] = self.endpoint[0], self.endpoint[1]
+        self._set(f"{LIVE}/{self.instance_id}", rec)
+        self._watcher = self._on_ideal_change
+        self.store.watch(IDEAL + "/", self._watcher)
+        self.reconcile_all()
+
+    def stop(self) -> None:
+        """Graceful departure (beyond the ephemeral-cleanup safety net)."""
+        if self._watcher is not None:
+            self.store.unwatch(self._watcher)
+            self._watcher = None
+        self.store.remove(f"{LIVE}/{self.instance_id}")
+        for path in self.store.list_paths(
+                f"{CURRENT}/{self.instance_id}/"):
+            self.store.remove(path)
+
+    # -- reconciliation ----------------------------------------------------
+    def _on_ideal_change(self, path: str, record: Optional[dict]) -> None:
+        table = path[len(IDEAL) + 1:]
+        self.reconcile_table(table, (record or {}).get("segments", {}))
+
+    def reconcile_all(self) -> None:
+        for table in self.store.children(IDEAL):
+            rec = self.store.get(f"{IDEAL}/{table}") or {}
+            self.reconcile_table(table, rec.get("segments", {}))
+
+    def reconcile_table(self, table: str,
+                        ideal_segments: Dict[str, Dict[str, str]]) -> None:
+        with self._lock:
+            path = f"{CURRENT}/{self.instance_id}/{table}"
+            current = (self.store.get(path) or {}).get("segments", {})
+            wanted = {seg: states[self.instance_id]
+                      for seg, states in ideal_segments.items()
+                      if self.instance_id in states}
+            if apply_transitions(self.model, table, self.instance_id,
+                                 wanted, current):
+                if current:
+                    self._set(path, {"segments": current})
+                else:
+                    self.store.remove(path)
+
+    def _set(self, path: str, record: dict) -> None:
+        try:
+            self.store.set(path, record, ephemeral=True)
+        except TypeError:  # in-process store: no sessions, no ephemerals
+            self.store.set(path, record)

@@ -109,6 +109,56 @@ def cmd_show_cluster(args) -> int:
     return 0
 
 
+def _run_until_interrupt(stop) -> int:
+    import time
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop()
+    return 0
+
+
+def cmd_start_controller(args) -> int:
+    """Controller process: resource manager + store server (+ admin HTTP).
+
+    Parity: StartControllerCommand (the store server plays ZooKeeper)."""
+    from pinot_tpu.tools.distributed import DistributedController
+    ctrl = DistributedController(args.dir, store_port=args.store_port,
+                                 http=True, periodic=True)
+    print(json.dumps({"storePort": ctrl.store_port,
+                      "httpPort": ctrl.http_port,
+                      "deepStore": ctrl.deep_store_dir}), flush=True)
+    return _run_until_interrupt(ctrl.stop)
+
+
+def cmd_start_server(args) -> int:
+    """Server process joined to the cluster through the remote store.
+
+    Parity: StartServerCommand."""
+    from pinot_tpu.tools.distributed import DistributedServer
+    host, port = args.store.rsplit(":", 1)
+    srv = DistributedServer(args.instance_id, host, int(port),
+                            args.deep_store, work_dir=args.dir,
+                            port=args.port, scheduler=args.scheduler)
+    print(json.dumps({"instanceId": args.instance_id,
+                      "queryPort": srv.port}), flush=True)
+    return _run_until_interrupt(srv.stop)
+
+
+def cmd_start_broker(args) -> int:
+    """Broker process: spectator + HTTP /query endpoint.
+
+    Parity: StartBrokerCommand."""
+    from pinot_tpu.tools.distributed import DistributedBroker
+    host, port = args.store.rsplit(":", 1)
+    broker = DistributedBroker(host, int(port), args.deep_store, http=True)
+    print(json.dumps({"httpPort": broker.http_port}), flush=True)
+    return _run_until_interrupt(broker.stop)
+
+
 def cmd_quickstart(args) -> int:
     """Boot an embedded cluster with demo data and run sample queries.
 
@@ -202,7 +252,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("CreateSegment",
                         help="build a segment from CSV/JSON input")
     sp.add_argument("--input", required=True)
-    sp.add_argument("--format", default="csv", choices=["csv", "json"])
+    sp.add_argument("--format", default="csv",
+                    choices=["csv", "json", "avro", "parquet", "orc"])
     sp.add_argument("--schema-file", required=True)
     sp.add_argument("--table-config-file")
     sp.add_argument("--out-dir", required=True)
@@ -235,6 +286,33 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("ShowCluster", help="tables + external views")
     ctrl(sp)
     sp.set_defaults(fn=cmd_show_cluster)
+
+    sp = sub.add_parser("StartController",
+                        help="run a controller (+ store server + REST)")
+    sp.add_argument("--dir", required=True,
+                    help="work dir (deep store lives under it)")
+    sp.add_argument("--store-port", type=int, default=2181)
+    sp.set_defaults(fn=cmd_start_controller)
+
+    sp = sub.add_parser("StartServer",
+                        help="run a query server joined via the store")
+    sp.add_argument("--store", default="127.0.0.1:2181",
+                    help="controller's store host:port")
+    sp.add_argument("--deep-store", required=True,
+                    help="shared deep-store path")
+    sp.add_argument("--instance-id", default="Server_0")
+    sp.add_argument("--port", type=int, default=0,
+                    help="query service port (0 = ephemeral)")
+    sp.add_argument("--scheduler", default="fcfs",
+                    choices=["fcfs", "bounded_fcfs", "tokenbucket"])
+    sp.add_argument("--dir", help="realtime work dir")
+    sp.set_defaults(fn=cmd_start_server)
+
+    sp = sub.add_parser("StartBroker",
+                        help="run a broker with an HTTP /query endpoint")
+    sp.add_argument("--store", default="127.0.0.1:2181")
+    sp.add_argument("--deep-store", required=True)
+    sp.set_defaults(fn=cmd_start_broker)
 
     sp = sub.add_parser("Quickstart",
                         help="embedded demo cluster with sample data")

@@ -1,0 +1,145 @@
+"""Distributed deployment wiring: each plane in its own process.
+
+Parity: the reference's production shape — StartControllerCommand /
+StartServerCommand / StartBrokerCommand processes joined through
+ZooKeeper (tools/admin/command/).  Here the store server
+(controller/store_server.py) plays ZK: the controller hosts it; servers
+and brokers connect with RemotePropertyStore and coordinate through
+watches and ephemeral records only.  The deep store is a shared
+filesystem path (PinotFS), as in the reference's NFS/HDFS deployments.
+
+These classes are the process entrypoints; `tools/admin.py` exposes them
+as start-controller / start-server / start-broker commands, and the
+distributed integration tests drive them in-process over real TCP.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+from pinot_tpu.broker.request_handler import (BrokerRequestHandler,
+                                              TcpTransport)
+from pinot_tpu.common.response import BrokerResponse
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.controller.manager import ResourceManager
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.controller.state_machine import (LIVE, ClusterCoordinator,
+                                                ViewComposer)
+from pinot_tpu.controller.store_client import RemotePropertyStore
+from pinot_tpu.controller.store_server import PropertyStoreServer
+from pinot_tpu.server.agent import ParticipantAgent
+from pinot_tpu.server.instance import ServerInstance
+from pinot_tpu.server.participant import ServerParticipant
+
+
+class DistributedController:
+    """Controller process: resource manager + store server + view composer
+    (+ optional admin HTTP)."""
+
+    def __init__(self, work_dir: str, store_port: int = 0,
+                 http: bool = False, periodic: bool = False):
+        self.work_dir = work_dir
+        self.store = PropertyStore()
+        self.controller = Controller(os.path.join(work_dir, "deepstore"),
+                                     store=self.store)
+        self.composer = ViewComposer(self.store)
+        self.store_server = PropertyStoreServer(self.store, port=store_port)
+        self.store_port = self.store_server.start()
+        self.http_api = None
+        self.http_port: Optional[int] = None
+        if http:
+            from pinot_tpu.controller.http_api import ControllerApiServer
+            self.http_api = ControllerApiServer(self.controller)
+            self.http_port = self.http_api.start()
+        if periodic:
+            self.controller.start()
+
+    @property
+    def deep_store_dir(self) -> str:
+        return self.controller.manager.deep_store_dir
+
+    def stop(self) -> None:
+        if self.http_api is not None:
+            self.http_api.stop()
+        self.controller.stop()
+        self.composer.close()
+        self.store_server.stop()
+
+
+class DistributedServer:
+    """Server process: query service + participant agent over a remote
+    store."""
+
+    def __init__(self, instance_id: str, store_host: str, store_port: int,
+                 deep_store_dir: str, work_dir: Optional[str] = None,
+                 port: int = 0, scheduler: str = "fcfs", mesh=None,
+                 host: str = "127.0.0.1"):
+        self.store = RemotePropertyStore(store_host, store_port)
+        coordinator = ClusterCoordinator(self.store)
+        self.manager = ResourceManager(coordinator, deep_store_dir)
+        self.server = ServerInstance(instance_id, scheduler=scheduler,
+                                     mesh=mesh)
+        self.port = self.server.start(port=port)
+        self.participant = ServerParticipant(self.server, self.manager,
+                                             work_dir=work_dir)
+        self.agent = ParticipantAgent(self.store, instance_id,
+                                      self.participant,
+                                      endpoint=(host, self.port))
+        self.agent.start()
+
+    def stop(self) -> None:
+        """Graceful shutdown: deregister, then stop serving."""
+        self.agent.stop()
+        self.participant.shutdown()
+        self.server.stop()
+        self.store.close()
+
+    def kill(self) -> None:
+        """Crash simulation: the store session dies with the process —
+        ephemeral live-instance/current-state records must vanish without
+        any deregistration call (ZK session-expiry semantics)."""
+        self.store.close()
+        self.server.stop()
+
+
+class DistributedBroker:
+    """Broker process: spectator over a remote store + TCP data plane with
+    endpoints learned from live-instance records."""
+
+    def __init__(self, store_host: str, store_port: int,
+                 deep_store_dir: str, http: bool = False):
+        self.store = RemotePropertyStore(store_host, store_port)
+        coordinator = ClusterCoordinator(self.store)
+        manager = ResourceManager(coordinator, deep_store_dir)
+        self.transport = TcpTransport({})
+        self._live_watcher = self._on_live
+        self.store.watch(LIVE + "/", self._live_watcher)
+        for inst in self.store.children(LIVE):
+            self._on_live(f"{LIVE}/{inst}", self.store.get(f"{LIVE}/{inst}"))
+        self.watcher = BrokerClusterWatcher(coordinator, manager)
+        self.handler = BrokerRequestHandler(
+            self.watcher.routing, self.transport,
+            time_boundary=self.watcher.time_boundary,
+            segment_pruner=self.watcher.partition_pruner)
+        self.http_api = None
+        self.http_port: Optional[int] = None
+        if http:
+            from pinot_tpu.broker.http_api import BrokerApiServer
+            self.http_api = BrokerApiServer(self.handler)
+            self.http_port = self.http_api.start()
+
+    def _on_live(self, path: str, record: Optional[dict]) -> None:
+        inst = path[len(LIVE) + 1:]
+        if record is not None and "host" in record:
+            self.transport.set_endpoint(inst, record["host"],
+                                        record["port"])
+
+    def query(self, pql: str) -> BrokerResponse:
+        return self.handler.handle(pql)
+
+    def stop(self) -> None:
+        if self.http_api is not None:
+            self.http_api.stop()
+        self.handler.close()
+        self.store.close()

@@ -1,0 +1,243 @@
+"""Multi-process-shaped cluster: planes joined only through the store.
+
+Parity: the reference's production deployment — controller, servers and
+broker as separate processes around ZooKeeper.  Here each plane is wired
+exactly as its process entrypoint wires it (tools/distributed.py), and
+every interaction crosses real TCP: cluster state through the store
+server (watches, ephemerals), queries through the framed data plane.
+Covers the MultiNodesOfflineClusterIntegrationTest + instance-death
+recovery (ChaosMonkey pattern: a killed server's ephemeral session drops
+it from the external view and queries keep answering).
+"""
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment, make_columns, make_schema, \
+    make_table_config
+from oracle import Oracle
+
+from pinot_tpu.common.table_config import SegmentsConfig
+from pinot_tpu.tools.distributed import (DistributedBroker,
+                                         DistributedController,
+                                         DistributedServer)
+
+N = 4_000
+
+
+def _await(cond, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out: {msg}"
+        time.sleep(0.02)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    base = tempfile.mkdtemp()
+    ctrl = DistributedController(base)
+    servers = [
+        DistributedServer(f"Server_{i}", "127.0.0.1", ctrl.store_port,
+                          ctrl.deep_store_dir,
+                          work_dir=os.path.join(base, f"s{i}_work"))
+        for i in range(2)]
+    broker = DistributedBroker("127.0.0.1", ctrl.store_port,
+                               ctrl.deep_store_dir)
+    # data: 4 segments, replication 2 so both servers host every segment
+    cols_all = []
+    ctrl.controller.manager.add_schema(make_schema())
+    cfg = make_table_config(
+        segments_config=SegmentsConfig(replication=2))
+    ctrl.controller.manager.add_table(cfg)
+    for i in range(4):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        _, cols = build_segment(d, n=N, seed=100 + i, name=f"dseg_{i}")
+        cols_all.append(cols)
+        ctrl.controller.manager.add_segment("baseballStats_OFFLINE", d)
+    merged = {}
+    for k in cols_all[0]:
+        if isinstance(cols_all[0][k], list):
+            merged[k] = sum((c[k] for c in cols_all), [])
+        else:
+            merged[k] = np.concatenate([c[k] for c in cols_all])
+    yield ctrl, servers, broker, Oracle(merged)
+    broker.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 — killed servers can't deregister
+            pass
+    ctrl.stop()
+
+
+def test_segments_load_via_store_watches(cluster):
+    ctrl, servers, broker, oracle = cluster
+    # both server processes must converge to hosting all 4 segments
+    for s in servers:
+        _await(lambda: len(
+            s.server.data_manager.table("baseballStats_OFFLINE",
+                                        create=True).segment_names()) == 4,
+            msg=f"{s.agent.instance_id} segment load")
+    view = ctrl.controller.coordinator.external_view(
+        "baseballStats_OFFLINE")
+    assert len(view.segment_states) == 4
+    for states in view.segment_states.values():
+        assert set(states.values()) == {"ONLINE"}
+        assert len(states) == 2
+
+
+def test_query_through_remote_planes(cluster):
+    ctrl, servers, broker, oracle = cluster
+    _await(lambda: broker.watcher.routing.has_table(
+        "baseballStats_OFFLINE"), msg="routing table")
+    m = oracle.mask(lambda r: r["yearID"] > 2000)
+    resp = None
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        resp = broker.query(
+            "SELECT COUNT(*), SUM(runs) FROM baseballStats "
+            "WHERE yearID > 2000")
+        if not resp.exceptions and \
+                int(resp.aggregation_results[0].value) == oracle.count(m):
+            break
+        time.sleep(0.05)
+    assert int(resp.aggregation_results[0].value) == oracle.count(m)
+    assert float(resp.aggregation_results[1].value) == \
+        pytest.approx(oracle.sum("runs", m))
+    assert resp.num_servers_queried >= 1
+
+    g = broker.query("SELECT COUNT(*) FROM baseballStats "
+                     "GROUP BY league TOP 10")
+    got = {r["group"][0]: int(r["value"])
+           for r in g.aggregation_results[0].group_by_result}
+    exp = oracle.group_by(["league"], oracle.mask(lambda r: True),
+                          ("count", None))
+    assert got == {k[0]: v for k, v in exp.items()}
+
+
+def test_server_death_drops_ephemerals_and_queries_survive(cluster):
+    ctrl, servers, broker, oracle = cluster
+    _await(lambda: broker.watcher.routing.has_table(
+        "baseballStats_OFFLINE"), msg="routing table")
+    victim = servers[1]
+    victim.kill()          # no deregistration: session death only
+    store = ctrl.store
+    _await(lambda: store.get(
+        f"/LIVEINSTANCES/{victim.agent.instance_id}") is None,
+        msg="ephemeral live record reaped")
+    _await(lambda: all(
+        victim.agent.instance_id not in states
+        for states in ctrl.controller.coordinator.external_view(
+            "baseballStats_OFFLINE").segment_states.values()),
+        msg="external view drops dead instance")
+    # broker rerouted onto the survivor: full, correct answers
+    m = oracle.mask(lambda r: True)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        resp = broker.query("SELECT COUNT(*) FROM baseballStats")
+        if not resp.exceptions and \
+                int(resp.aggregation_results[0].value) == oracle.count(m):
+            break
+        time.sleep(0.05)
+    assert int(resp.aggregation_results[0].value) == oracle.count(m)
+
+
+def test_graceful_server_stop_deregisters(cluster):
+    ctrl, servers, broker, oracle = cluster
+    # runs last (module order): stop the remaining server gracefully
+    survivor = servers[0]
+    survivor.stop()
+    store = ctrl.store
+    assert store.get(f"/LIVEINSTANCES/{survivor.agent.instance_id}") is None
+    assert store.list_paths(
+        f"/CURRENTSTATES/{survivor.agent.instance_id}/") == []
+    _await(lambda: ctrl.controller.coordinator.external_view(
+        "baseballStats_OFFLINE").segment_states == {},
+        msg="view empties after last server departs")
+
+
+# ---------------------------------------------------------------------------
+# True multi-process deployment: admin CLI process entrypoints, every
+# interaction over TCP/HTTP (parity: StartController/Server/BrokerCommand)
+# ---------------------------------------------------------------------------
+
+def test_three_process_cluster_over_cli():
+    import json
+    import subprocess
+    import sys
+    import urllib.request
+
+    base = tempfile.mkdtemp()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    procs = []
+
+    def spawn(*cmd):
+        p = subprocess.Popen([sys.executable, "-m",
+                              "pinot_tpu.tools.admin", *cmd],
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             env=env, cwd="/root/repo", text=True)
+        procs.append(p)
+        line = p.stdout.readline().strip()
+        assert line, (p.stderr.read() if p.poll() is not None else "no boot line")
+        return json.loads(line)
+
+    def http(method, url, body=None, ctype="application/json"):
+        req = urllib.request.Request(
+            url, data=body, method=method,
+            headers={"Content-Type": ctype} if body else {})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        ctrl = spawn("StartController", "--dir", base, "--store-port", "0")
+        store = f"127.0.0.1:{ctrl['storePort']}"
+        deep = ctrl["deepStore"]
+        spawn("StartServer", "--store", store, "--deep-store", deep,
+              "--instance-id", "Server_A")
+        broker = spawn("StartBroker", "--store", store, "--deep-store",
+                       deep)
+
+        capi = f"http://127.0.0.1:{ctrl['httpPort']}"
+        http("POST", f"{capi}/schemas",
+             json.dumps(make_schema().to_json()).encode())
+        http("POST", f"{capi}/tables",
+             json.dumps(make_table_config().to_json()).encode())
+        seg_dir = os.path.join(base, "seg")
+        os.makedirs(seg_dir)
+        _, cols = build_segment(seg_dir, n=1_000, seed=3, name="cli_seg")
+        from pinot_tpu.controller.http_api import pack_segment_dir
+        http("POST", f"{capi}/segments/baseballStats_OFFLINE",
+             pack_segment_dir(seg_dir), ctype="application/octet-stream")
+
+        oracle = Oracle(cols)
+        m = oracle.mask(lambda r: r["yearID"] >= 2000)
+        bapi = f"http://127.0.0.1:{broker['httpPort']}"
+        deadline = time.monotonic() + 30
+        out = None
+        while time.monotonic() < deadline:
+            try:
+                out = http("POST", f"{bapi}/query", json.dumps(
+                    {"pql": "SELECT COUNT(*) FROM baseballStats "
+                            "WHERE yearID >= 2000"}).encode())
+                if not out.get("exceptions") and \
+                        out["aggregationResults"][0]["value"] == \
+                        str(oracle.count(m)):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert out is not None
+        assert out["aggregationResults"][0]["value"] == \
+            str(oracle.count(m)), out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

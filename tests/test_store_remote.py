@@ -1,0 +1,182 @@
+"""Networked property store tests: server, client, watches, ephemerals.
+
+Parity: the ZooKeeper role in the reference — remote cluster-state store
+with watch push and ephemeral-node liveness (docs/architecture.rst).
+"""
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.controller.store_client import (RemotePropertyStore,
+                                               StoreClosedError)
+from pinot_tpu.controller.store_server import PropertyStoreServer
+
+
+@pytest.fixture()
+def server():
+    srv = PropertyStoreServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _client(server, **kw):
+    return RemotePropertyStore("127.0.0.1", server.port, **kw)
+
+
+def test_basic_ops_roundtrip(server):
+    c = _client(server)
+    try:
+        assert c.get("/a") is None
+        c.set("/a/b", {"x": 1})
+        c.set("/a/c", {"y": [1, 2, {"z": "s"}]})
+        assert c.get("/a/b") == {"x": 1}
+        assert c.get("/a/c") == {"y": [1, 2, {"z": "s"}]}
+        assert c.children("/a") == ["b", "c"]
+        assert c.list_paths("/a") == ["/a/b", "/a/c"]
+        assert c.remove("/a/b") is True
+        assert c.remove("/a/b") is False
+        assert c.get("/a/b") is None
+    finally:
+        c.close()
+
+
+def test_update_cas_loop_under_contention(server):
+    n_threads, n_incr = 4, 25
+    clients = [_client(server) for _ in range(n_threads)]
+    try:
+        clients[0].set("/counter", {"n": 0})
+
+        def bump(c):
+            for _ in range(n_incr):
+                c.update("/counter", lambda rec: {"n": (rec or {"n": 0})["n"]
+                                                  + 1})
+
+        threads = [threading.Thread(target=bump, args=(c,))
+                   for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert clients[0].get("/counter") == {"n": n_threads * n_incr}
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_watch_push_across_clients(server):
+    a, b = _client(server), _client(server)
+    try:
+        events = []
+        got = threading.Event()
+
+        def cb(path, rec):
+            events.append((path, rec))
+            if len(events) >= 3:
+                got.set()
+
+        a.watch("/EXTERNALVIEW/", cb)
+        b.set("/EXTERNALVIEW/t1", {"segments": {"s0": {"i0": "ONLINE"}}})
+        b.set("/OTHER/t1", {"ignored": True})   # outside prefix: no event
+        b.set("/EXTERNALVIEW/t2", {"segments": {}})
+        b.remove("/EXTERNALVIEW/t1")
+        assert got.wait(5), events
+        assert events[0] == ("/EXTERNALVIEW/t1",
+                             {"segments": {"s0": {"i0": "ONLINE"}}})
+        assert events[1] == ("/EXTERNALVIEW/t2", {"segments": {}})
+        assert events[2] == ("/EXTERNALVIEW/t1", None)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ephemeral_paths_vanish_on_disconnect(server):
+    a, b = _client(server), _client(server)
+    try:
+        seen = []
+        gone = threading.Event()
+
+        def cb(path, rec):
+            seen.append((path, rec))
+            if rec is None:
+                gone.set()
+
+        b.watch("/LIVEINSTANCES/", cb)
+        a.set("/LIVEINSTANCES/Server_9", {"tags": ["T"]}, ephemeral=True)
+        a.set("/CONFIGS/stay", {"k": 1})          # persistent
+        deadline = time.monotonic() + 5
+        while b.get("/LIVEINSTANCES/Server_9") is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        a.close()                                  # session death
+        assert gone.wait(5), seen
+        assert b.get("/LIVEINSTANCES/Server_9") is None
+        assert b.get("/CONFIGS/stay") == {"k": 1}  # persists
+    finally:
+        b.close()
+
+
+def test_shared_store_with_inprocess_side(server):
+    """The controller holds the in-process store; remote clients see the
+    same tree (the deployment shape: store server runs in the controller)."""
+    local: PropertyStore = server.store
+    c = _client(server)
+    try:
+        local.set("/CONFIGS/TABLE/t", {"v": 1})
+        assert c.get("/CONFIGS/TABLE/t") == {"v": 1}
+        c.set("/CONFIGS/TABLE/u", {"v": 2})
+        assert local.get("/CONFIGS/TABLE/u") == {"v": 2}
+        # watches registered locally fire for remote writes
+        fired = threading.Event()
+        local.watch("/CONFIGS/", lambda p, r: fired.set())
+        c.set("/CONFIGS/TABLE/w", {"v": 3})
+        assert fired.wait(5)
+    finally:
+        c.close()
+
+
+def test_client_errors(server):
+    c = _client(server)
+    try:
+        with pytest.raises(ConnectionError):
+            RemotePropertyStore("127.0.0.1", 1)    # nothing listening
+    finally:
+        c.close()
+    with pytest.raises(StoreClosedError):
+        c.get("/x")                                # after close
+
+
+def test_local_cas_semantics():
+    s = PropertyStore()
+    assert s.cas("/p", None, {"v": 1}) is True
+    assert s.cas("/p", None, {"v": 2}) is False
+    assert s.cas("/p", {"v": 1}, {"v": 2}) is True
+    assert s.get("/p") == {"v": 2}
+
+
+def test_bind_conflict_raises_instead_of_hanging(server):
+    s2 = PropertyStoreServer(port=server.port)
+    with pytest.raises(OSError, match="cannot bind"):
+        s2.start()
+
+
+def test_malformed_frame_keeps_connection_alive(server):
+    import json
+    import socket
+    import struct
+
+    sock = socket.create_connection(("127.0.0.1", server.port))
+    try:
+        bad = b"not json"
+        sock.sendall(struct.pack(">I", len(bad)) + bad)
+        n = struct.unpack(">I", sock.recv(4))[0]
+        resp = json.loads(sock.recv(n))
+        assert resp["ok"] is False and resp["id"] is None
+        good = json.dumps({"id": 7, "op": "ping"}).encode()
+        sock.sendall(struct.pack(">I", len(good)) + good)
+        n = struct.unpack(">I", sock.recv(4))[0]
+        assert json.loads(sock.recv(n)) == {"id": 7, "ok": True}
+    finally:
+        sock.close()
