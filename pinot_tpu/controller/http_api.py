@@ -75,6 +75,15 @@ class ControllerApiServer(ApiServer):
         router.add("POST", "/segments/{table}/{segment}/reload",
                    self._reload_segment)
         router.add("POST", "/tables/{name}/reload", self._reload_table)
+        # LLC segment-completion protocol (parity:
+        # controller/api/resources/LLCSegmentCompletionHandlers.java —
+        # segmentConsumed / segmentStoppedConsuming / segmentCommitStart /
+        # segmentCommitEnd{WithMetadata})
+        router.add("POST", "/segmentConsumed", self._segment_consumed)
+        router.add("POST", "/segmentStoppedConsuming",
+                   self._stopped_consuming)
+        router.add("POST", "/segmentCommitStart", self._commit_start)
+        router.add("POST", "/segmentCommitEnd", self._commit_end)
 
     # -- handlers ----------------------------------------------------------
     async def _console(self, request: HttpRequest) -> HttpResponse:
@@ -177,6 +186,47 @@ class ControllerApiServer(ApiServer):
         except ValueError as e:
             return HttpResponse.error(404, str(e))
         return HttpResponse.of_json({"status": f"{n} segments reloaded"})
+
+    # -- LLC completion protocol ------------------------------------------
+    def _completion_params(self, request: HttpRequest):
+        q = request.query
+        return (q["table"], q["name"], q["instance"],
+                int(q.get("offset", "-1")))
+
+    async def _segment_consumed(self, request: HttpRequest) -> HttpResponse:
+        table, name, instance, offset = self._completion_params(request)
+        resp = self.controller.realtime.segment_consumed(
+            table, name, instance, offset)
+        return HttpResponse.of_json(resp.to_json())
+
+    async def _stopped_consuming(self, request: HttpRequest) -> HttpResponse:
+        table, name, instance, _ = self._completion_params(request)
+        self.controller.realtime.stopped_consuming(
+            table, name, instance, request.query.get("reason", ""))
+        return HttpResponse.of_json({"status": "PROCESSED"})
+
+    async def _commit_start(self, request: HttpRequest) -> HttpResponse:
+        table, name, instance, offset = self._completion_params(request)
+        resp = self.controller.realtime.commit_start(
+            table, name, instance, offset)
+        return HttpResponse.of_json(resp.to_json())
+
+    async def _commit_end(self, request: HttpRequest) -> HttpResponse:
+        """Split-commit end: the winner uploads its built segment as the
+        request body (tar.gz), the controller deep-stores it and steps
+        the cluster (commitSegmentMetadata parity)."""
+        table, name, instance, offset = self._completion_params(request)
+        if not request.body:
+            return HttpResponse.error(400, "empty segment payload")
+        with tempfile.TemporaryDirectory() as tmp:
+            seg_dir = os.path.join(tmp, "segment")
+            try:
+                unpack_segment_tar(request.body, seg_dir)
+            except Exception as e:  # noqa: BLE001 — bad upload payload
+                return HttpResponse.error(400, f"bad segment tar: {e}")
+            resp = self.controller.realtime.commit_end(
+                table, name, instance, offset, seg_dir)
+        return HttpResponse.of_json(resp.to_json())
 
     async def _segment_metadata(self, request: HttpRequest) -> HttpResponse:
         meta = self.manager.segment_metadata(

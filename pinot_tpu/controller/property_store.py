@@ -21,9 +21,15 @@ class PropertyStore:
         self._data: Dict[str, dict] = {}
         self._watchers: List[tuple] = []        # (prefix, callback)
         self._lock = threading.RLock()
+        # serializes external-view composition (state_machine.compose_view
+        # read-compute-write cycles from coordinator + ViewComposer threads)
+        self.compose_lock = threading.Lock()
 
     # -- records -----------------------------------------------------------
-    def set(self, path: str, record: dict) -> None:
+    def set(self, path: str, record: dict, ephemeral: bool = False) -> None:
+        """`ephemeral` is accepted for interface parity with
+        RemotePropertyStore; the in-process store has no sessions, so it
+        is ignored."""
         with self._lock:
             self._data[path] = json.loads(json.dumps(record))
             watchers = [cb for p, cb in self._watchers

@@ -104,18 +104,23 @@ def compose_view(store: PropertyStore, table: str) -> None:
 
     Writes only on change, so redundant composers (the in-process
     coordinator and a ViewComposer over the same store) don't generate
-    watch noise.
+    watch noise.  The read-compute-write cycle is serialized per store
+    (compose_lock): without it, a composer thread that read stale
+    current states could overwrite a newer view last and leave routing
+    wrong until the next current-state event.
     """
-    view: Dict[str, Dict[str, str]] = {}
-    for inst in store.children(LIVE):
-        current = (store.get(f"{CURRENT}/{inst}/{table}") or {}
-                   ).get("segments", {})
-        for seg, state in current.items():
-            if state != DROPPED:
-                view.setdefault(seg, {})[inst] = state
-    new = {"segments": view}
-    if store.get(f"{VIEW}/{table}") != new:
-        store.set(f"{VIEW}/{table}", new)
+    lock = getattr(store, "compose_lock", None) or threading.Lock()
+    with lock:
+        view: Dict[str, Dict[str, str]] = {}
+        for inst in store.children(LIVE):
+            current = (store.get(f"{CURRENT}/{inst}/{table}") or {}
+                       ).get("segments", {})
+            for seg, state in current.items():
+                if state != DROPPED:
+                    view.setdefault(seg, {})[inst] = state
+        new = {"segments": view}
+        if store.get(f"{VIEW}/{table}") != new:
+            store.set(f"{VIEW}/{table}", new)
 
 
 class ViewComposer:

@@ -1,0 +1,60 @@
+"""HTTP client for the LLC segment-completion protocol.
+
+Parity: the server side of SegmentCompletionProtocol — the reference's
+ServerSegmentCompletionProtocolHandler POSTs segmentConsumed /
+segmentStoppedConsuming / segmentCommitStart / segmentCommitEnd to the
+lead controller's REST API.  This client exposes the same four-method
+interface as the in-process RealtimeSegmentManager, so
+RealtimeTableDataManager works unchanged in a multi-process deployment
+(tools/distributed.py wires it when a controller HTTP address is given).
+"""
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+
+from pinot_tpu.common.completion import CompletionResponse
+
+
+class HttpSegmentCompletionClient:
+    def __init__(self, controller: str, timeout: float = 60.0):
+        """`controller`: host:port of the controller's HTTP API."""
+        self.base = f"http://{controller}"
+        self.timeout = timeout
+
+    def _post(self, path: str, params: dict, body: bytes = None) -> dict:
+        url = f"{self.base}{path}?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/octet-stream"}
+            if body else {})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def segment_consumed(self, table: str, segment: str, instance: str,
+                         offset: int) -> CompletionResponse:
+        return CompletionResponse.from_json(self._post(
+            "/segmentConsumed", {"table": table, "name": segment,
+                                 "instance": instance, "offset": offset}))
+
+    def stopped_consuming(self, table: str, segment: str, instance: str,
+                          reason: str = "") -> None:
+        self._post("/segmentStoppedConsuming",
+                   {"table": table, "name": segment, "instance": instance,
+                    "reason": reason})
+
+    def commit_start(self, table: str, segment: str, instance: str,
+                     offset: int) -> CompletionResponse:
+        return CompletionResponse.from_json(self._post(
+            "/segmentCommitStart", {"table": table, "name": segment,
+                                    "instance": instance,
+                                    "offset": offset}))
+
+    def commit_end(self, table: str, segment: str, instance: str,
+                   offset: int, segment_dir: str) -> CompletionResponse:
+        from pinot_tpu.controller.http_api import pack_segment_dir
+        return CompletionResponse.from_json(self._post(
+            "/segmentCommitEnd", {"table": table, "name": segment,
+                                  "instance": instance, "offset": offset},
+            body=pack_segment_dir(segment_dir)))

@@ -85,7 +85,6 @@ class ParticipantAgent:
                     self.store.remove(path)
 
     def _set(self, path: str, record: dict) -> None:
-        try:
-            self.store.set(path, record, ephemeral=True)
-        except TypeError:  # in-process store: no sessions, no ephemerals
-            self.store.set(path, record)
+        # both store implementations accept the flag; the in-process one
+        # (no sessions) ignores it
+        self.store.set(path, record, ephemeral=True)

@@ -142,7 +142,8 @@ def cmd_start_server(args) -> int:
     host, port = args.store.rsplit(":", 1)
     srv = DistributedServer(args.instance_id, host, int(port),
                             args.deep_store, work_dir=args.dir,
-                            port=args.port, scheduler=args.scheduler)
+                            port=args.port, scheduler=args.scheduler,
+                            controller_http=args.controller_http)
     print(json.dumps({"instanceId": args.instance_id,
                       "queryPort": srv.port}), flush=True)
     return _run_until_interrupt(srv.stop)
@@ -306,6 +307,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--scheduler", default="fcfs",
                     choices=["fcfs", "bounded_fcfs", "tokenbucket"])
     sp.add_argument("--dir", help="realtime work dir")
+    sp.add_argument("--controller-http",
+                    help="controller REST host:port (enables realtime "
+                         "tables: LLC completion over HTTP)")
     sp.set_defaults(fn=cmd_start_server)
 
     sp = sub.add_parser("StartBroker",

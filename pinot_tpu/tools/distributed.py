@@ -74,14 +74,25 @@ class DistributedServer:
     def __init__(self, instance_id: str, store_host: str, store_port: int,
                  deep_store_dir: str, work_dir: Optional[str] = None,
                  port: int = 0, scheduler: str = "fcfs", mesh=None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 controller_http: Optional[str] = None):
+        """`controller_http`: host:port of the controller REST API —
+        enables realtime tables (the LLC completion protocol goes over
+        HTTP, as the reference's ServerSegmentCompletionProtocolHandler
+        does)."""
         self.store = RemotePropertyStore(store_host, store_port)
         coordinator = ClusterCoordinator(self.store)
         self.manager = ResourceManager(coordinator, deep_store_dir)
         self.server = ServerInstance(instance_id, scheduler=scheduler,
                                      mesh=mesh)
         self.port = self.server.start(port=port)
+        completion = None
+        if controller_http is not None:
+            from pinot_tpu.realtime.http_completion import \
+                HttpSegmentCompletionClient
+            completion = HttpSegmentCompletionClient(controller_http)
         self.participant = ServerParticipant(self.server, self.manager,
+                                             completion=completion,
                                              work_dir=work_dir)
         self.agent = ParticipantAgent(self.store, instance_id,
                                       self.participant,
