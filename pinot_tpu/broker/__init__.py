@@ -3,6 +3,7 @@ from pinot_tpu.broker.request_handler import (BrokerRequestHandler,
                                               InProcessTransport,
                                               QueryRouter, TcpTransport)
 from pinot_tpu.broker.routing import (BalancedRandomRoutingTableBuilder,
+                                      LargeClusterRoutingTableBuilder,
                                       ReplicaGroupRoutingTableBuilder,
                                       RoutingManager)
 from pinot_tpu.broker.time_boundary import (TimeBoundaryService,
@@ -11,5 +12,6 @@ from pinot_tpu.broker.time_boundary import (TimeBoundaryService,
 __all__ = ["HitCounter", "QueryQuotaManager", "BrokerRequestHandler",
            "InProcessTransport", "QueryRouter", "TcpTransport",
            "BalancedRandomRoutingTableBuilder",
+           "LargeClusterRoutingTableBuilder",
            "ReplicaGroupRoutingTableBuilder", "RoutingManager",
            "TimeBoundaryService", "attach_time_boundary"]

@@ -78,6 +78,46 @@ class ReplicaGroupRoutingTableBuilder(RoutingTableBuilder):
         return tables
 
 
+class LargeClusterRoutingTableBuilder(RoutingTableBuilder):
+    """Cap each routing table to a bounded server subset.
+
+    Parity: LargeClusterRoutingTableBuilder.java — on clusters with many
+    servers, fanning every query out to all of them makes tail latency
+    the max over the fleet; instead each pre-computed table routes over a
+    random `target_num_servers` subset that still covers every segment
+    (servers hosting otherwise-uncovered segments are added back)."""
+
+    def __init__(self, target_num_servers: int = 20, num_tables: int = 10):
+        self.target = target_num_servers
+        self.num_tables = num_tables
+
+    def build(self, view: TableView, rng: random.Random
+              ) -> List[RoutingTable]:
+        all_servers = sorted({s for seg in view.segments()
+                              for s in view.servers_for(
+                                  seg, states=(ONLINE, CONSUMING))})
+        tables: List[RoutingTable] = []
+        for _ in range(self.num_tables):
+            subset = set(rng.sample(
+                all_servers, min(self.target, len(all_servers))))
+            rt: RoutingTable = {}
+            for segment in view.segments():
+                servers = view.servers_for(segment, states=(ONLINE,
+                                                            CONSUMING))
+                if not servers:
+                    continue
+                usable = [s for s in servers if s in subset]
+                if not usable:
+                    # coverage first: pull a replica back in
+                    pick = rng.choice(servers)
+                    subset.add(pick)
+                    usable = [pick]
+                best = min(usable, key=lambda s: len(rt.get(s, [])))
+                rt.setdefault(best, []).append(segment)
+            tables.append(rt)
+        return tables
+
+
 class RoutingManager:
     """Holds current routing tables per physical table; rebuilds on
     external-view changes (parity: processExternalViewChange :418)."""

@@ -60,6 +60,7 @@ class ControllerApiServer(ApiServer):
         router.add("GET", "/schemas/{name}", self._get_schema)
         router.add("GET", "/tables", self._list_tables)
         router.add("POST", "/tables", self._add_table)
+        router.add("PUT", "/tables/{name}", self._update_table)
         router.add("GET", "/tables/{name}", self._get_table)
         router.add("DELETE", "/tables/{name}", self._delete_table)
         router.add("GET", "/tables/{name}/idealstate", self._ideal_state)
@@ -125,6 +126,19 @@ class ControllerApiServer(ApiServer):
             table = self.manager.add_table(config)
         return HttpResponse.of_json({"status": f"{table} successfully "
                                      "added"})
+
+    async def _update_table(self, request: HttpRequest) -> HttpResponse:
+        config = TableConfig.from_json(request.json())
+        if config.table_name_with_type != request.path_params["name"]:
+            return HttpResponse.error(
+                400, f"table name mismatch: path addresses "
+                f"{request.path_params['name']!r} but body names "
+                f"{config.table_name_with_type!r}")
+        try:
+            table = self.manager.update_table_config(config)
+        except ValueError as e:
+            return HttpResponse.error(404, str(e))
+        return HttpResponse.of_json({"status": f"{table} updated"})
 
     async def _get_table(self, request: HttpRequest) -> HttpResponse:
         config = self.manager.get_table_config(

@@ -62,6 +62,16 @@ class ResourceManager:
         rec = self.store.get(f"{TABLE_CONFIGS}/{table}")
         return TableConfig.from_json(rec) if rec else None
 
+    def update_table_config(self, config: TableConfig) -> str:
+        """Overwrite a table's config (parity: updateTableConfig REST —
+        replication/indexing changes take effect on the next rebalance /
+        segment reload)."""
+        table = config.table_name_with_type
+        if self.store.get(f"{TABLE_CONFIGS}/{table}") is None:
+            raise ValueError(f"table {table} not found")
+        self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
+        return table
+
     def table_names(self) -> List[str]:
         return self.store.children(TABLE_CONFIGS)
 

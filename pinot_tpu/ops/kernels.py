@@ -189,16 +189,19 @@ def _chunked_int_sum(x):
         axis=1, dtype=jnp.int32)
 
 
-def _part_sums(parts, mask):
-    """Masked exact sums of int8 part lanes.
+def _part_sums(part_lanes, mask):
+    """Masked exact sums of 7-bit part lanes.
 
-    parts: int8 [n_parts, P]; returns int32 [T1, n_parts] chunk partials.
+    part_lanes: list of 1-D [P] int lanes; returns int32 [T1, n_parts]
+    chunk partials. Per-lane processing keeps every intermediate 1-D or
+    [T, BLOCK]-shaped (no small-extent tile axes).
     """
-    n_parts, p = parts.shape
-    contrib = jnp.where(mask[None, :], parts.astype(jnp.int32), 0)
-    blocks = contrib.reshape(n_parts, p // BLOCK, BLOCK).sum(
-        axis=2, dtype=jnp.int32)                      # [n_parts, T] < 2^20
-    return _chunked_int_sum(jnp.swapaxes(blocks, 0, 1))
+    per_lane = []
+    for lane in part_lanes:
+        contrib = jnp.where(mask, lane.astype(jnp.int32), 0)
+        per_lane.append(contrib.reshape(-1, BLOCK).sum(
+            axis=1, dtype=jnp.int32))                 # [T] < 2^20
+    return _chunked_int_sum(jnp.stack(per_lane, axis=-1))
 
 
 def _chunked_float_sum(vals, mask):
@@ -212,20 +215,65 @@ def _chunked_float_sum(vals, mask):
     return blocks.reshape(t1, CHUNK_BLOCKS).sum(axis=1, dtype=acc)
 
 
+import os as _os
+RADIX_G = int(_os.environ.get("PINOT_TPU_RADIX_G", "512"))
+#                  ^ above this, one-hots are factored hi x lo: VPU
+                   # compares per row drop from g to g/128 + 128, and the
+                   # wide accumulation happens on the MXU instead
+RADIX_LO = 128     # lane width: lo one-hot fills exactly one vreg lane dim
+
+
+def _radix_onehots(idx, g_pad: int, dtype):
+    """idx -> (oh_hi [k, g_pad/128], oh_lo [k, 128]) with
+    one_hot(idx, g_pad)[k, g] == oh_hi[k, g//128] * oh_lo[k, g%128].
+
+    The factored product is exact in any float dtype (entries are 0/1),
+    so S = hi^T @ (lo * v) accumulates the same sums as the direct
+    one-hot matmul at 1/40th the VPU comparison work for g ~ 8k.
+    """
+    g1 = g_pad // RADIX_LO
+    oh_hi = jax.nn.one_hot(idx // RADIX_LO, g1, dtype=dtype)
+    oh_lo = jax.nn.one_hot(idx % RADIX_LO, RADIX_LO, dtype=dtype)
+    return oh_hi, oh_lo
+
+
+def _radix_pad(g: int) -> int:
+    return -(-g // RADIX_LO) * RADIX_LO
+
+
+def _radix_group_sum(oh_hi, oh_lo, v, g: int, acc):
+    """hi^T @ (lo * v) -> [g] per-group sums of v, in `acc` dtype.
+
+    The factored one-hot accumulation (see _radix_onehots): exact
+    whenever v's values are exact in the one-hot dtype and the per-call
+    accumulation stays within `acc`'s integer range — each call site
+    carries its own bound. Counts are the v == mask special case
+    (sum m * hi * lo == (hi weighted by m)^T lo)."""
+    return jnp.matmul(oh_hi.T, oh_lo * v[:, None],
+                      preferred_element_type=acc).reshape(-1)[:g]
+
+
 def _mxu_histogram(ids, mask, card_pad: int):
     """One-hot matmul histogram: int32 [card_pad], exact.
 
-    Replaces the scatter-add histogram (~40x faster on v5e at 8k bins).
-    """
+    Replaces the scatter-add histogram (~40x faster on v5e at 8k bins);
+    past RADIX_G bins the one-hot is hi/lo-factored (counts are then a
+    plain [g1, 128] = hi^T @ lo matmul — the 2-D histogram)."""
     b = _tile_rows(card_pad, ids.shape[0])
     ids_b = ids.reshape(-1, b)
     mask_b = mask.astype(jnp.bfloat16).reshape(-1, b)
+    radix = card_pad > RADIX_G
+    gp = _radix_pad(card_pad)
 
     def body(carry, tb):
         i, m = tb
-        onehot = jax.nn.one_hot(i, card_pad, dtype=jnp.bfloat16)   # [b, card]
-        h = jnp.matmul(m[None, :], onehot,
-                       preferred_element_type=jnp.float32)[0]      # <= b
+        if radix:
+            oh_hi, oh_lo = _radix_onehots(i, gp, jnp.bfloat16)
+            h = _radix_group_sum(oh_hi, oh_lo, m, card_pad, jnp.float32)
+        else:
+            onehot = jax.nn.one_hot(i, card_pad, dtype=jnp.bfloat16)
+            h = jnp.matmul(m[None, :], onehot,
+                           preferred_element_type=jnp.float32)[0]  # <= b
         return carry + h.astype(jnp.int32), None
 
     out, _ = jax.lax.scan(body, jnp.zeros(card_pad, jnp.int32),
@@ -238,26 +286,40 @@ def _dense_group_count(key, mask, g_pad: int):
     return _mxu_histogram(key, mask, g_pad)
 
 
-def _dense_group_part_sums(parts, key, mask, g_pad: int):
-    """Exact per-group sums of int8 part lanes via MXU: int32 [n_parts, g].
+def _dense_group_part_sums(part_lanes, key, mask, g_pad: int):
+    """Exact per-group sums of 7-bit part lanes via MXU: int32 [n_parts, g].
 
-    Carry-accumulated int32; planner guarantees padded <= DENSE_ROWS_LIMIT
-    so 127 * rows < 2^31.
+    part_lanes: list of 1-D [P] lanes — per-lane [T, b] blocking avoids
+    any small-extent tile axis. Carry-accumulated int32; planner
+    guarantees padded <= DENSE_ROWS_LIMIT so 127 * rows < 2^31.
     """
-    n_parts = parts.shape[0]
+    n_parts = len(part_lanes)
     b = _tile_rows(g_pad, key.shape[0])
-    contrib = jnp.where(mask[None, :], parts.astype(jnp.bfloat16), 0)
     key_b = key.reshape(-1, b)
-    cb = jnp.moveaxis(contrib.reshape(n_parts, -1, b), 1, 0)  # [T, n_parts, b]
+    lanes_b = tuple(
+        jnp.where(mask, lane.astype(jnp.bfloat16), 0).reshape(-1, b)
+        for lane in part_lanes)
+    radix = g_pad > RADIX_G
+    gp = _radix_pad(g_pad)
 
     def body(carry, tb):
-        k, c = tb
-        onehot = jax.nn.one_hot(k, g_pad, dtype=jnp.bfloat16)       # [b, g]
-        s = jnp.matmul(c, onehot, preferred_element_type=jnp.float32)
+        k = tb[0]
+        cs = tb[1:]
+        if radix:
+            oh_hi, oh_lo = _radix_onehots(k, gp, jnp.bfloat16)
+            s = jnp.stack([
+                _radix_group_sum(oh_hi, oh_lo, c, g_pad, jnp.float32)
+                for c in cs])
+        else:
+            onehot = jax.nn.one_hot(k, g_pad, dtype=jnp.bfloat16)   # [b, g]
+            s = jnp.stack([
+                jnp.matmul(c[None, :], onehot,
+                           preferred_element_type=jnp.float32)[0]
+                for c in cs])
         return carry + s.astype(jnp.int32), None
 
     out, _ = jax.lax.scan(body, jnp.zeros((n_parts, g_pad), jnp.int32),
-                          (key_b, cb))
+                          (key_b,) + lanes_b)
     return out
 
 
@@ -269,12 +331,18 @@ def _dense_group_float_sums(vals, key, mask, g_pad: int):
     contrib = jnp.where(mask, vals.astype(mm_dtype), 0)
     key_b = key.reshape(-1, b)
     cb = contrib.reshape(-1, b)
+    radix = g_pad > RADIX_G
+    gp = _radix_pad(g_pad)
 
     def body(carry, tb):
         k, c = tb
-        onehot = jax.nn.one_hot(k, g_pad, dtype=mm_dtype)
-        s = jnp.matmul(c[None, :], onehot,
-                       preferred_element_type=mm_dtype)[0]
+        if radix:
+            oh_hi, oh_lo = _radix_onehots(k, gp, mm_dtype)
+            s = _radix_group_sum(oh_hi, oh_lo, c, g_pad, mm_dtype)
+        else:
+            onehot = jax.nn.one_hot(k, g_pad, dtype=mm_dtype)
+            s = jnp.matmul(c[None, :], onehot,
+                           preferred_element_type=mm_dtype)[0]
         return carry + s, None
 
     out, _ = jax.lax.scan(body, jnp.zeros(g_pad, mm_dtype), (key_b, cb))
@@ -335,7 +403,9 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
         elif fname in ("sum", "avg") and source == "sv" and \
                 isinstance(extra, tuple) and extra[0] == "parts":
             # exact integer sum: bit-sliced part lanes, tree reductions
-            outs[f"agg{i}.parts"] = _part_sums(cols[f"{col}.parts"], mask)
+            pl = cols[f"{col}.parts"]
+            outs[f"agg{i}.parts"] = _part_sums(
+                [pl[p] for p in range(pl.shape[0])], mask)
             outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
         elif fname in ("sum", "avg") and source == "sv" and \
                 isinstance(extra, tuple) and extra[0] == "vlane":
@@ -456,8 +526,8 @@ def _block_compact(mask, int_lanes, f32_lanes, r: int):
     on TPU, matmul is the fast one). Each (block, slot) output cell has
     exactly ONE contributing row, so the f32 accumulation is exact.
 
-    int_lanes: list of [n] int32 lanes with values in [0, 255] (byte
-    planes — bf16-exact). f32_lanes: list of [n] float lanes, moved in
+    int_lanes: list of [n] integer lanes with values in [0, 255] (byte
+    planes — bf16-exact; any int dtype, int16 avoids relayout cost). f32_lanes: list of [n] float lanes, moved in
     sum_dtype() (f64 under x64 for host parity, f32 on device).
     Returns (ints [K, Pi], floats [K, Pf], valid [K], overflow) with
     K = (n // CBLOCK) * r. Rows past r in an overflowing block are
@@ -517,11 +587,41 @@ def _slot_sum_tables(gslot, t_slots: int, int_vals, f32_vals, count_mask):
     cm = None if count_mask is None else jnp.pad(
         count_mask, (0, pad)).reshape(nch, ch)
 
+    radix = (t_slots + 1) > RADIX_G
+    gp = _radix_pad(t_slots + 1)
+
     def body(carry, xs):
         ci, cf, cc = carry
         g = xs[0]
-        oh2 = g[:, None] == jnp.arange(t_slots + 1, dtype=jnp.int32)
         j = 1
+        if radix:
+            # factored accumulation: per value lane, one [k, 128]
+            # elementwise product + one MXU matmul replaces the [k, g]
+            # one-hot build (the VPU cost that dominated group-by at
+            # g ~ 8k; see _radix_onehots)
+            oh_hi, oh_lo = _radix_onehots(g, gp, jnp.bfloat16)
+            if iv is not None:
+                v = xs[j].astype(jnp.bfloat16)
+                ci = ci + jnp.stack([
+                    _radix_group_sum(oh_hi, oh_lo, v[:, p], t_slots + 1,
+                                     jnp.float32)
+                    for p in range(v.shape[1])]).astype(jnp.int32)
+                j += 1
+            if fv is not None:
+                hi_a, lo_a = (oh_hi.astype(acc), oh_lo.astype(acc)) \
+                    if acc != jnp.bfloat16 else (oh_hi, oh_lo)
+                v = xs[j].astype(acc)
+                cf = cf + jnp.stack([
+                    _radix_group_sum(hi_a, lo_a, v[:, p], t_slots + 1, acc)
+                    for p in range(v.shape[1])])
+                j += 1
+            if cm is not None:
+                m = xs[j].astype(jnp.bfloat16)
+                cc = cc + _radix_group_sum(
+                    oh_hi, oh_lo, m, t_slots + 1,
+                    jnp.float32).astype(jnp.int32)
+            return (ci, cf, cc), None
+        oh2 = g[:, None] == jnp.arange(t_slots + 1, dtype=jnp.int32)
         if iv is not None:
             ci = ci + jnp.einsum(
                 "kg,kl->lg", oh2.astype(jnp.bfloat16),
@@ -579,7 +679,7 @@ def _group_outputs_compacted_sorted(group_spec, cols, mask, num_docs,
         strategy = extra[0] if isinstance(extra, tuple) else "vals"
         if fname in ("sum", "avg"):
             if strategy == "psums":
-                # int8 part lanes gathered at the compacted rows, int32
+                # part lanes gathered at the compacted rows, int32
                 # scatter per part; kmax past DENSE_ROWS_LIMIT is chunked
                 # into a leading axis the host recombines in int64
                 pv = cols[f"{col}.parts"][:, si_c].astype(jnp.int32)
@@ -678,10 +778,10 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs,
         strategy = extra[0] if isinstance(extra, tuple) else "vals"
         if fname in ("sum", "avg"):
             if strategy == "psums":
-                parts = cols[f"{col}.parts"]
-                int_slots[i] = (len(int_lanes), parts.shape[0])
-                for p in range(parts.shape[0]):
-                    int_lanes.append(parts[p].astype(jnp.int32))
+                pl = cols[f"{col}.parts"]
+                plist = [pl[p] for p in range(pl.shape[0])]
+                int_slots[i] = (len(int_lanes), len(plist))
+                int_lanes.extend(plist)   # 7-bit values: bf16-exact
             else:
                 lane = cols[f"{col}.vlane" if source == "sv"
                             else f"{col}.raw"]
@@ -834,7 +934,9 @@ def _group_outputs(group_spec, cols, mask, num_docs, params=None):
             if strategy == "psums":
                 # exact: one-hot MXU matmul over int8 part lanes
                 outs[f"gagg{i}.psums"] = _dense_group_part_sums(
-                    cols[f"{col}.parts"], key, mask, g_pad)
+                    [cols[f"{col}.parts"][p]
+                     for p in range(cols[f"{col}.parts"].shape[0])],
+                    key, mask, g_pad)
             elif strategy == "csums":
                 lane = cols[f"{col}.vlane" if source == "sv"
                             else f"{col}.raw"]

@@ -109,6 +109,66 @@ def cmd_show_cluster(args) -> int:
     return 0
 
 
+def cmd_change_num_replicas(args) -> int:
+    """Parity: ChangeNumReplicasCommand — update replication in the table
+    config, then rebalance to apply it."""
+    cfg = _http("GET", f"http://{args.controller}/tables/{args.table}")
+    cfg["segmentsConfig"]["replication"] = str(args.replicas)
+    _http("PUT", f"http://{args.controller}/tables/{args.table}",
+          json.dumps(cfg).encode())
+    out = _http("POST",
+                f"http://{args.controller}/tables/{args.table}/rebalance")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_verify_cluster_state(args) -> int:
+    """Parity: VerifyClusterStateCommand — every table's external view must
+    converge to its ideal state. Exit 0 iff converged."""
+    tables = _http("GET", f"http://{args.controller}/tables")["tables"]
+    bad = {}
+    for t in tables:
+        ideal = _http("GET",
+                      f"http://{args.controller}/tables/{t}/idealstate")
+        view = _http("GET",
+                     f"http://{args.controller}/tables/{t}/externalview")
+        if ideal != view:
+            bad[t] = {"idealstate": ideal, "externalview": view}
+    if bad:
+        print(json.dumps({"converged": False, "tables": bad}, indent=2))
+        return 1
+    print(json.dumps({"converged": True, "tables": len(tables)}))
+    return 0
+
+
+def cmd_segment_dump(args) -> int:
+    """Parity: SegmentDumpTool — print a segment's metadata and per-column
+    index summary from its on-disk artifact."""
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    seg = ImmutableSegmentLoader.load(args.segment_dir)
+    meta = seg.metadata
+    cols = {}
+    for name in seg.column_names:
+        cm = seg.data_source(name).metadata
+        cols[name] = {
+            "dataType": cm.data_type.name,
+            "cardinality": cm.cardinality,
+            "singleValue": cm.single_value,
+            "hasDictionary": cm.has_dictionary,
+            "sorted": cm.sorted,
+            "hasInvertedIndex": cm.has_inverted_index,
+            "hasBloomFilter": getattr(cm, "has_bloom_filter", False),
+        }
+    print(json.dumps({
+        "segmentName": meta.segment_name,
+        "totalDocs": meta.total_docs,
+        "timeRange": [meta.start_time, meta.end_time],
+        "crc": meta.crc,
+        "columns": cols,
+    }, indent=2))
+    return 0
+
+
 def _run_until_interrupt(stop) -> int:
     import time
     try:
@@ -287,6 +347,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("ShowCluster", help="tables + external views")
     ctrl(sp)
     sp.set_defaults(fn=cmd_show_cluster)
+
+    sp = sub.add_parser("ChangeNumReplicas",
+                        help="update replication + rebalance")
+    ctrl(sp)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--replicas", type=int, required=True)
+    sp.set_defaults(fn=cmd_change_num_replicas)
+
+    sp = sub.add_parser("VerifyClusterState",
+                        help="check external views converged to ideal")
+    ctrl(sp)
+    sp.set_defaults(fn=cmd_verify_cluster_state)
+
+    sp = sub.add_parser("SegmentDump",
+                        help="print a segment artifact's metadata")
+    sp.add_argument("--segment-dir", required=True)
+    sp.set_defaults(fn=cmd_segment_dump)
 
     sp = sub.add_parser("StartController",
                         help="run a controller (+ store server + REST)")

@@ -79,6 +79,20 @@ def test_admin_cli_end_to_end(http_cluster, capsys):
     assert rc == 0
     assert json.loads(out)["aggregationResults"][0]["value"] == "100"
 
+    rc, out = _run(["SegmentDump", "--segment-dir", out_dir], capsys)
+    assert rc == 0
+    dump = json.loads(out)
+    assert dump["segmentName"] == "cli_0" and dump["totalDocs"] == 100
+    assert dump["columns"]["teamID"]["hasDictionary"] is True
+
+    rc, out = _run(["VerifyClusterState", "--controller", ctrl], capsys)
+    assert rc == 0 and json.loads(out)["converged"] is True
+
+    rc, out = _run(["ChangeNumReplicas", "--controller", ctrl,
+                    "--table", "baseballStats_OFFLINE", "--replicas", "2"],
+                   capsys)
+    assert rc == 0
+
     rc, out = _run(["ShowCluster", "--controller", ctrl], capsys)
     assert rc == 0
     view = json.loads(out)

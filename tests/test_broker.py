@@ -13,6 +13,7 @@ from fixtures import build_segment
 from oracle import Oracle
 
 from pinot_tpu.broker import (BalancedRandomRoutingTableBuilder,
+                              LargeClusterRoutingTableBuilder,
                               BrokerRequestHandler, InProcessTransport,
                               ReplicaGroupRoutingTableBuilder,
                               RoutingManager, TcpTransport,
@@ -50,6 +51,34 @@ def test_balanced_random_builder_skips_dead_replicas():
     })
     tables = BalancedRandomRoutingTableBuilder(num_tables=3).build(
         view, random.Random(0))
+    for rt in tables:
+        assert rt.get("s0") == ["seg_live"]
+        assert "s1" not in rt
+
+
+def test_large_cluster_builder_caps_servers_but_covers():
+    # 10 servers, 120 segments, 4 replicas each: a 4-server subset can
+    # cover everything, so fan-out stays near the target
+    view = _view("t_OFFLINE", {
+        f"seg_{i}": [f"s{(i + k) % 10}" for k in range(4)]
+        for i in range(120)})
+    tables = LargeClusterRoutingTableBuilder(
+        target_num_servers=4, num_tables=6).build(view, random.Random(1))
+    assert len(tables) == 6
+    for rt in tables:
+        routed = sorted(s for segs in rt.values() for s in segs)
+        assert routed == sorted(view.segments())   # full coverage
+        # bounded fan-out: near the target, below the fleet size
+        assert len(rt) <= 7 < 10
+
+
+def test_large_cluster_builder_skips_dead_replicas():
+    view = TableView("t_OFFLINE", {
+        "seg_live": {"s0": ONLINE, "s1": "OFFLINE"},
+        "seg_dead": {"s1": "ERROR"},
+    })
+    tables = LargeClusterRoutingTableBuilder(
+        target_num_servers=1, num_tables=2).build(view, random.Random(0))
     for rt in tables:
         assert rt.get("s0") == ["seg_live"]
         assert "s1" not in rt

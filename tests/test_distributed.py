@@ -80,7 +80,7 @@ def test_segments_load_via_store_watches(cluster):
         _await(lambda: len(
             s.server.data_manager.table("baseballStats_OFFLINE",
                                         create=True).segment_names()) == 4,
-            msg=f"{s.agent.instance_id} segment load")
+            timeout=30, msg=f"{s.agent.instance_id} segment load")
     view = ctrl.controller.coordinator.external_view(
         "baseballStats_OFFLINE")
     assert len(view.segment_states) == 4

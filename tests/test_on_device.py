@@ -58,7 +58,7 @@ with tempfile.TemporaryDirectory() as td:
         if ok:
             exp[(t, lg)] = exp.get((t, lg), 0) + int(v)
     checks.append(got == {{k: float(v) for k, v in exp.items()}})
-    out["checks"] = checks
+    out["checks"] = [bool(c) for c in checks]
 print("DEVICE_RESULT " + json.dumps(out))
 """
 
