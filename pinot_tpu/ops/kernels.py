@@ -217,6 +217,12 @@ def _chunked_float_sum(vals, mask):
 
 import os as _os
 RADIX_G = int(_os.environ.get("PINOT_TPU_RADIX_G", "512"))
+# row-scale accumulations (full-segment dense tables / histograms) factor
+# above RADIX_G; the COMPACTED slot tables process ~100x fewer rows, so
+# the direct [K, g] one-hot stays cheap much longer and radix's per-row
+# lo-products only pay off for wide tables (measured: direct wins at 513
+# slots by 1.5x, radix wins at 8193 by 1.2x on v5e)
+SLOT_RADIX_G = int(_os.environ.get("PINOT_TPU_SLOT_RADIX_G", "8192"))
 #                  ^ above this, one-hots are factored hi x lo: VPU
                    # compares per row drop from g to g/128 + 128, and the
                    # wide accumulation happens on the MXU instead
@@ -527,8 +533,9 @@ def _block_compact(mask, int_lanes, f32_lanes, r: int):
     exactly ONE contributing row, so the f32 accumulation is exact.
 
     int_lanes: list of [n] integer lanes with values in [0, 255] (byte
-    planes — bf16-exact; any int dtype, int16 avoids relayout cost). f32_lanes: list of [n] float lanes, moved in
-    sum_dtype() (f64 under x64 for host parity, f32 on device).
+    planes — bf16-exact; any int dtype). f32_lanes: list of [n] float
+    lanes, moved in sum_dtype() (f64 under x64 for host parity, f32 on
+    device).
     Returns (ints [K, Pi], floats [K, Pf], valid [K], overflow) with
     K = (n // CBLOCK) * r. Rows past r in an overflowing block are
     dropped; `overflow` flags it and the executor escalates kmax.
@@ -587,7 +594,7 @@ def _slot_sum_tables(gslot, t_slots: int, int_vals, f32_vals, count_mask):
     cm = None if count_mask is None else jnp.pad(
         count_mask, (0, pad)).reshape(nch, ch)
 
-    radix = (t_slots + 1) > RADIX_G
+    radix = (t_slots + 1) > SLOT_RADIX_G
     gp = _radix_pad(t_slots + 1)
 
     def body(carry, xs):
@@ -608,8 +615,7 @@ def _slot_sum_tables(gslot, t_slots: int, int_vals, f32_vals, count_mask):
                     for p in range(v.shape[1])]).astype(jnp.int32)
                 j += 1
             if fv is not None:
-                hi_a, lo_a = (oh_hi.astype(acc), oh_lo.astype(acc)) \
-                    if acc != jnp.bfloat16 else (oh_hi, oh_lo)
+                hi_a, lo_a = oh_hi.astype(acc), oh_lo.astype(acc)
                 v = xs[j].astype(acc)
                 cf = cf + jnp.stack([
                     _radix_group_sum(hi_a, lo_a, v[:, p], t_slots + 1, acc)

@@ -157,7 +157,7 @@ def cmd_segment_dump(args) -> int:
             "hasDictionary": cm.has_dictionary,
             "sorted": cm.sorted,
             "hasInvertedIndex": cm.has_inverted_index,
-            "hasBloomFilter": getattr(cm, "has_bloom_filter", False),
+            "hasBloomFilter": cm.has_bloom_filter,
         }
     print(json.dumps({
         "segmentName": meta.segment_name,

@@ -230,6 +230,28 @@ def test_random_group_by_queries(setup):
                             v, rel=1e-3, abs=1e-6), (pql, label, key)
 
 
+def test_random_group_by_having_queries(setup):
+    engine, host_engine, oracle = setup
+    gen = Gen(random.Random(SEED + 3), oracle)
+    for qi in range(6):
+        where, m = gen.where()
+        dims = gen.rng.sample(["teamID", "league"], 1)
+        thresh = gen.rng.randint(5, 200)
+        op = gen.rng.choice([">", "<="])
+        pql = ("SELECT COUNT(*) FROM baseballStats" + where +
+               " GROUP BY " + dims[0] +
+               f" HAVING COUNT(*) {op} {thresh} TOP 2000")
+        counts = oracle.group_by(dims, m, ("count", None))
+        keep = {tuple(str(k) for k in key): v for key, v in counts.items()
+                if (v > thresh if op == ">" else v <= thresh)}
+        for e, label in [(engine, "device"), (host_engine, "host")]:
+            resp = e.query(pql)
+            assert not resp.exceptions, (pql, label, resp.exceptions)
+            got = {tuple(str(k) for k in g["group"]): int(float(g["value"]))
+                   for g in resp.aggregation_results[0].group_by_result}
+            assert got == keep, (pql, label)
+
+
 def test_random_selection_queries(setup):
     engine, host_engine, oracle = setup
     gen = Gen(random.Random(SEED + 2), oracle)
