@@ -36,9 +36,26 @@ class BrokerClusterWatcher:
         if not view.segment_states:
             self.routing.remove_table(view.table_name)
             return
+        self._apply_routing_config(view.table_name)
         self.routing.update_view(view)
         if table_type(view.table_name) == "OFFLINE":
             self._update_time_boundary(view)
+
+    def _apply_routing_config(self, table: str) -> None:
+        """Honor the table's routingTableBuilderName (parity:
+        HelixExternalViewBasedRouting reading RoutingConfig)."""
+        from pinot_tpu.broker.routing import make_routing_builder
+        config = self.manager.get_table_config(table)
+        if config is None:
+            return
+        rc = config.routing_config
+        builder = make_routing_builder(rc.builder_name, rc.options)
+        target = builder if builder is not None else self.routing.builder
+        # builder-kind comparison: re-applying the same kind would only
+        # churn (option-only changes take effect on broker restart)
+        if type(target) is not type(self.routing.table_builder(table)):
+            # the caller pushes the fresh view right after: no rebuild
+            self.routing.set_table_builder(table, builder, rebuild=False)
 
     def _update_time_boundary(self, view: TableView) -> None:
         offline_table = view.table_name

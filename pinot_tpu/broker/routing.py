@@ -118,6 +118,32 @@ class LargeClusterRoutingTableBuilder(RoutingTableBuilder):
         return tables
 
 
+def make_routing_builder(name: Optional[str],
+                         options: Optional[Dict[str, str]] = None
+                         ) -> Optional[RoutingTableBuilder]:
+    """Resolve a table config's routingTableBuilderName (parity:
+    RoutingTableBuilderFactory). None/unknown -> broker default."""
+    opts = options or {}
+    key = (name or "").lower().replace("routingtablebuilder", "")
+    if key in ("balanced", "balancedrandom", "defaultoffline",
+               "defaultrealtime"):
+        return BalancedRandomRoutingTableBuilder()
+    if key in ("replicagroup", "partitionawareoffline",
+               "partitionawarerealtime"):
+        return ReplicaGroupRoutingTableBuilder()
+    if key == "largecluster":
+        try:
+            target = int(opts.get("targetNumServers", "20"))
+        except ValueError:
+            # a malformed option must not break the view-watcher callback
+            # chain (nothing validates configs at upload time) — fall
+            # back to the default fan-out cap
+            target = 20
+        return LargeClusterRoutingTableBuilder(
+            target_num_servers=max(1, target))
+    return None
+
+
 class RoutingManager:
     """Holds current routing tables per physical table; rebuilds on
     external-view changes (parity: processExternalViewChange :418)."""
@@ -125,13 +151,34 @@ class RoutingManager:
     def __init__(self, builder: Optional[RoutingTableBuilder] = None,
                  seed: int = 0):
         self.builder = builder or BalancedRandomRoutingTableBuilder()
+        self._table_builders: Dict[str, RoutingTableBuilder] = {}
         self._tables: Dict[str, List[RoutingTable]] = {}
         self._views: Dict[str, TableView] = {}
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
+    def table_builder(self, table_name: str) -> RoutingTableBuilder:
+        with self._lock:
+            return self._table_builders.get(table_name, self.builder)
+
+    def set_table_builder(self, table_name: str,
+                          builder: Optional[RoutingTableBuilder],
+                          rebuild: bool = True) -> None:
+        """Per-table builder override (parity: per-table
+        routingTableBuilderName); rebuilds the held view unless the
+        caller is about to push one anyway."""
+        with self._lock:
+            if builder is None:
+                self._table_builders.pop(table_name, None)
+            else:
+                self._table_builders[table_name] = builder
+            view = self._views.get(table_name)
+        if rebuild and view is not None:
+            self.update_view(view)
+
     def update_view(self, view: TableView) -> None:
-        tables = self.builder.build(view, self._rng)
+        builder = self.table_builder(view.table_name)
+        tables = builder.build(view, self._rng)
         with self._lock:
             self._views[view.table_name] = view.copy()
             self._tables[view.table_name] = tables
@@ -140,6 +187,9 @@ class RoutingManager:
         with self._lock:
             self._tables.pop(table_name, None)
             self._views.pop(table_name, None)
+            # drop the builder override too: a recreated table must start
+            # from the broker default until its own config is applied
+            self._table_builders.pop(table_name, None)
 
     def has_table(self, table_name: str) -> bool:
         with self._lock:

@@ -145,6 +145,28 @@ class QuotaConfig:
 
 
 @dataclasses.dataclass
+class RoutingConfig:
+    """Broker routing-table builder selection (parity: RoutingConfig /
+    routingTableBuilderName in the reference's table config)."""
+    builder_name: Optional[str] = None   # balanced | replicagroup |
+    #                                      largecluster (None = broker default)
+    options: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        d = {}
+        if self.builder_name:
+            d["routingTableBuilderName"] = self.builder_name
+        if self.options:
+            d["routingTableBuilderOptions"] = dict(self.options)
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "RoutingConfig":
+        return cls(d.get("routingTableBuilderName"),
+                   dict(d.get("routingTableBuilderOptions", {})))
+
+
+@dataclasses.dataclass
 class TableConfig:
     table_name: str                      # raw name, without type suffix
     table_type: TableType = TableType.OFFLINE
@@ -152,6 +174,8 @@ class TableConfig:
     indexing_config: IndexingConfig = dataclasses.field(default_factory=IndexingConfig)
     tenant_config: TenantConfig = dataclasses.field(default_factory=TenantConfig)
     quota_config: Optional[QuotaConfig] = None
+    routing_config: RoutingConfig = dataclasses.field(
+        default_factory=RoutingConfig)
     custom_config: Dict[str, str] = dataclasses.field(default_factory=dict)
     # task type → config map for the minion plane (parity: TableTaskConfig,
     # e.g. {"ConvertToRawIndexTask": {"columnsToConvert": "a,b"}})
@@ -175,6 +199,9 @@ class TableConfig:
             d["task"] = {"taskTypeConfigsMap": self.task_configs}
         if self.quota_config:
             d["quota"] = self.quota_config.to_json()
+        routing = self.routing_config.to_json()
+        if routing:
+            d["routing"] = routing
         return d
 
     def to_json_str(self) -> str:
@@ -196,6 +223,8 @@ class TableConfig:
             quota_config=(QuotaConfig.from_json(d["quota"]) if d.get("quota")
                           else None),
             custom_config=(d.get("metadata", {}) or {}).get("customConfigs", {}),
+            routing_config=RoutingConfig.from_json(d.get("routing", {})
+                                                   or {}),
             task_configs=(d.get("task", {}) or {}).get("taskTypeConfigsMap",
                                                        {}),
         )

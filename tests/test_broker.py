@@ -357,3 +357,73 @@ def test_time_boundary_ignores_offline_replicas():
     w._update_time_boundary(view)
     info = w.time_boundary.get("baseballStats_OFFLINE")
     assert info.value == 50 - 1
+
+
+def test_routing_config_selects_builder_per_table():
+    from pinot_tpu.broker.routing import (RoutingManager,
+                                          make_routing_builder)
+    assert isinstance(make_routing_builder("largecluster",
+                                           {"targetNumServers": "3"}),
+                      LargeClusterRoutingTableBuilder)
+    assert isinstance(make_routing_builder("ReplicaGroup"),
+                      ReplicaGroupRoutingTableBuilder)
+    assert make_routing_builder(None) is None
+    assert make_routing_builder("bogus") is None
+
+    rm = RoutingManager()
+    view = _view("t_OFFLINE", {f"seg_{i}": ["s0", "s1"] for i in range(4)})
+    rm.update_view(view)
+    assert isinstance(rm.table_builder("t_OFFLINE"),
+                      BalancedRandomRoutingTableBuilder)
+    rm.set_table_builder("t_OFFLINE",
+                         ReplicaGroupRoutingTableBuilder(num_tables=3))
+    # override rebuilt the held view with the new builder
+    assert len(rm._tables["t_OFFLINE"]) == 3
+    assert isinstance(rm.table_builder("t_OFFLINE"),
+                      ReplicaGroupRoutingTableBuilder)
+
+
+def test_cluster_watcher_applies_table_routing_config(tmp_path):
+    import os
+    from fixtures import build_segment, make_schema, make_table_config
+    from pinot_tpu.broker.routing import ReplicaGroupRoutingTableBuilder
+    from pinot_tpu.common.table_config import RoutingConfig
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    cluster = EmbeddedCluster(str(tmp_path), num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cfg = make_table_config(
+            routing_config=RoutingConfig("replicagroup"))
+        cluster.add_table(cfg)
+        d = str(tmp_path / "seg")
+        os.makedirs(d)
+        build_segment(d, n=256, seed=4, name="rt_route")
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+        assert isinstance(
+            cluster.watcher.routing.table_builder("baseballStats_OFFLINE"),
+            ReplicaGroupRoutingTableBuilder)
+        resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+        assert resp.aggregation_results[0].value == "256"
+    finally:
+        cluster.stop()
+
+
+def test_make_routing_builder_tolerates_bad_options():
+    from pinot_tpu.broker.routing import make_routing_builder
+    b = make_routing_builder("largecluster", {"targetNumServers": "abc"})
+    assert isinstance(b, LargeClusterRoutingTableBuilder)
+    assert b.target == 20
+    b = make_routing_builder("largecluster", {"targetNumServers": "-3"})
+    assert b.target == 1
+
+
+def test_remove_table_clears_builder_override():
+    from pinot_tpu.broker.routing import RoutingManager
+    rm = RoutingManager()
+    view = _view("t_OFFLINE", {"seg_0": ["s0"]})
+    rm.update_view(view)
+    rm.set_table_builder("t_OFFLINE", ReplicaGroupRoutingTableBuilder())
+    rm.remove_table("t_OFFLINE")
+    assert isinstance(rm.table_builder("t_OFFLINE"),
+                      BalancedRandomRoutingTableBuilder)
