@@ -510,6 +510,18 @@ def _group_key(gcols, strides, g_pad, cols, params=None):
             # executable serves every literal of the same query template.
             off_op = params.pop(0)
             ids = cols[f"{c}.ids"].astype(jnp.int32) - off_op
+        elif gkind == "idrank":
+            # adaptive DENSIFYING remap: the scout's per-dim histogram
+            # found the PRESENT dictIds (scattered ids — e.g. the five
+            # Asian nations in a sorted nation dictionary — make
+            # offset spans 4-8x wider than the actual active set); the
+            # rank vector (runtime operand, [card_pad] int32) maps
+            # id -> rank-among-present, collapsing the key space to the
+            # bucketed present counts. Unmatched rows gather garbage
+            # ranks; their contributions are masked everywhere.
+            rank = params.pop(0)
+            lane = cols[f"{c}.ids"].astype(jnp.int32)
+            ids = rank[jnp.clip(lane, 0, rank.shape[0] - 1)]
         else:
             ids = cols[f"{c}.ids"].astype(jnp.int32)
         term = ids * np.int32(s)

@@ -194,6 +194,10 @@ def _decode_group_values(plan, nz: np.ndarray) -> List[np.ndarray]:
     for (c, gkind, off, _card), ids, tv in zip(gcols, id_cols, vtables):
         if gkind == "idoff":
             ids = ids + off              # re-base adaptive-remapped ids
+        elif gkind == "idrank":
+            # densifying remap: `off` carries the present-id array; only
+            # nonzero-count groups reach here, so every rank is in range
+            ids = np.asarray(off)[ids]
         if tv is not None:
             value_cols.append(tv[ids])
         elif gkind == "rawoff":

@@ -654,51 +654,90 @@ def run_with_group_escalation(run, group_spec, padded: int):
     return outs, group_spec
 
 
+RANK_HIST_CARD_LIMIT = 4096    # scout histograms (→ rank remap) only for
+#                                dims this small; wider dims scout min/max
+
+
 def adaptive_phase_a_specs(group_spec) -> Optional[tuple]:
-    """Scout agg specs (masked MIN+MAX of each group column's dictIds)
-    for the adaptive two-phase group-by, or None when the plan isn't
-    eligible (no filter to narrow the key space, or non-dictionary
-    keys). Min/max are streaming-rate tree reductions — the scout costs
-    about one filter evaluation."""
+    """Scout agg specs for the adaptive two-phase group-by, or None when
+    the plan isn't eligible (no filter to narrow the key space, or
+    non-dictionary keys).
+
+    Small-cardinality dims scout a matched-id HISTOGRAM (one one-hot
+    matmul — from it the host derives the exact PRESENT id set for the
+    densifying rank remap); wider dims scout masked MIN+MAX (streaming
+    tree reductions) for the offset remap. Returns (specs, dim_kinds)
+    with dim_kinds[i] in {"hist", "bounds"}."""
     if group_spec is None or not group_spec[4]:
         return None
-    specs = []
+    specs, dim_kinds = [], []
     for (c, gkind, _off, card) in group_spec[0]:
         if gkind != "ids":
             return None
         card_pad = kernels.pow2_bucket(card + 1)
-        specs.append(("min", c, "sv", ("ids", card_pad)))
-        specs.append(("max", c, "sv", ("ids", card_pad)))
-    return tuple(specs)
+        if card_pad <= RANK_HIST_CARD_LIMIT:
+            specs.append(("hist", c, "sv", ("hist", card_pad)))
+            dim_kinds.append("hist")
+        else:
+            specs.append(("min", c, "sv", ("ids", card_pad)))
+            specs.append(("max", c, "sv", ("ids", card_pad)))
+            dim_kinds.append("bounds")
+    return tuple(specs), tuple(dim_kinds)
 
 
-def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
+def adaptive_phase_b_spec(group_spec, scout, matched: int, padded: int,
                           total_docs: int):
     """Derive the remapped group spec from the phase-A scout.
 
-    `bounds` = per-gcol (lo, hi) matched dictId ranges. The remapped key
-    space is the product of the POW2-BUCKETED spans, and the offsets are
-    RUNTIME operands — so one compiled executable serves every literal
-    of the same query template (spans bucket to the same widths).
+    `scout` = per-gcol ("bounds", lo, hi) — matched dictId range for the
+    OFFSET remap — or ("present", ids) — exact matched id set for the
+    DENSIFYING RANK remap, used when its pow2 bucket is strictly smaller
+    than the span's (scattered actives, e.g. the five Asian nations in a
+    25-nation sorted dictionary, make spans 4-8x wider than the active
+    set; parity intent: DictionaryBasedGroupKeyGenerator's map-based
+    generators handle exactly this sparse-key regime).  Offsets and rank
+    vectors are RUNTIME operands — one compiled executable serves every
+    literal of the same query template (spans/present-counts bucket to
+    the same widths).
     Returns (kernel_spec, finish_spec, extra_params, empty): the kernel
-    spec carries placeholder offsets (static, hashable jit key); the
-    finish spec carries the real offsets for host-side group decode.
-    The compaction capacity kmax is sized from the scout's matched count
-    (per-2048-row-block Poisson mean plus tail headroom; the kernel's
-    overflow flag still escalates on skew).
+    spec carries placeholder remaps (static, hashable jit key); the
+    finish spec carries the real offsets / present-id arrays for
+    host-side group decode. The compaction capacity kmax is sized from
+    the scout's matched count (per-2048-row-block Poisson mean plus tail
+    headroom; the kernel's overflow flag still escalates on skew).
     """
     gcols, _strides, _g_pad, agg_specs, _kmax = group_spec
-    offs, spans = [], []
-    for lo, hi in bounds:
-        if hi < lo:
-            return None, None, (), True
-        offs.append(lo)
-        spans.append(kernels.pow2_bucket(hi - lo + 1, floor=1))
+    kernel_gcols, finish_gcols, spans, extra = [], [], [], []
+    for c, dim in zip(gcols, scout):
+        card_pad = kernels.pow2_bucket(c[3] + 1)
+        if dim[0] == "present":
+            present = dim[1]
+            if len(present) == 0:
+                return None, None, (), True
+            span = kernels.pow2_bucket(
+                int(present[-1]) - int(present[0]) + 1, floor=1)
+            n = kernels.pow2_bucket(len(present), floor=1)
+            if n < span:
+                rank = np.zeros(card_pad, np.int32)
+                rank[present] = np.arange(len(present), dtype=np.int32)
+                kernel_gcols.append((c[0], "idrank", 0, n))
+                finish_gcols.append((c[0], "idrank", present, n))
+                spans.append(n)
+                extra.append(rank)
+                continue
+            lo, hi = int(present[0]), int(present[-1])
+        else:
+            lo, hi = dim[1], dim[2]
+            if hi < lo:
+                return None, None, (), True
+            span = kernels.pow2_bucket(hi - lo + 1, floor=1)
+        kernel_gcols.append((c[0], "idoff", 0, span))
+        finish_gcols.append((c[0], "idoff", lo, span))
+        spans.append(span)
+        extra.append(np.int32(lo))
     g = int(np.prod(spans, dtype=np.int64))
-    kernel_gcols = tuple((c[0], "idoff", 0, span)
-                         for c, span in zip(gcols, spans))
-    finish_gcols = tuple((c[0], "idoff", off, span)
-                         for c, off, span in zip(gcols, offs, spans))
+    kernel_gcols = tuple(kernel_gcols)
+    finish_gcols = tuple(finish_gcols)
     strides = mixed_radix_strides(spans)
     g_pad = kernels.pow2_bucket(g)
     # compaction capacity from measured selectivity.  NOTE: r (and hence
@@ -719,8 +758,7 @@ def adaptive_phase_b_spec(group_spec, bounds, matched: int, padded: int,
         kmax = min(t * r, padded)
     kernel_spec = (kernel_gcols, strides, g_pad, agg_specs, kmax)
     finish_spec = (finish_gcols, strides, g_pad, agg_specs, kmax)
-    extra = tuple(np.int32(o) for o in offs)
-    return kernel_spec, finish_spec, extra, False
+    return kernel_spec, finish_spec, tuple(extra), False
 
 
 def drive_group_execution(run, group_spec, padded: int, total_docs: int):
@@ -731,16 +769,22 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     operands). Filtered dictionary-keyed group-bys take the ADAPTIVE
     TWO-PHASE path:
 
-    - Phase A (scout): masked min/max of each group column's dictIds +
-      the matched count — one streaming-rate dispatch.
+    - Phase A (scout): per group column, a matched-id histogram (one
+      MXU one-hot matmul) for small-cardinality dims or masked min/max
+      (streaming tree reductions) for wide ones, plus the matched count
+      — one dispatch.
     - Phase B: group tables over the REMAPPED key space (product of the
-      scout's active spans), with MXU block-compaction sized from the
-      measured selectivity. Small remapped spaces take the dense one-hot
-      layout (device psum combine); big ones the ranked layout.
+      scout's active spans — or of bucketed PRESENT counts where the
+      densifying rank remap wins), with MXU block-compaction sized from
+      the measured selectivity. Small remapped spaces take the dense
+      one-hot layout (device psum combine); big ones the ranked layout.
 
-    No sorts, row-scale scatters or gathers anywhere on the hot path —
-    those are TPU's slow primitives. Non-eligible plans fall back to the
-    compacted kernel with the kmax escalation ladder.
+    No sorts or row-scale scatters anywhere on the hot path — those are
+    TPU's slow primitives. The one row-scale gather is the idrank
+    remap's rank-vector lookup (kernels._group_key), paid only when the
+    scout proves it collapses the key space below the offset span.
+    Non-eligible plans fall back to the compacted kernel with the kmax
+    escalation ladder.
 
     Returns (outs, group_spec_for_finish); None finish spec means the
     filter matched nothing (outs still carries the stats).
@@ -748,12 +792,21 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     pa = adaptive_phase_a_specs(group_spec) \
         if padded <= kernels.DENSE_ROWS_LIMIT else None
     if pa is not None:
-        ha = run(pa, None, ())
-        bounds = [(int(ha[f"agg{2 * i}.min"]), int(ha[f"agg{2 * i + 1}.max"]))
-                  for i in range(len(pa) // 2)]
+        specs, dim_kinds = pa
+        ha = run(specs, None, ())
+        scout, si = [], 0
+        for c, kind in zip(group_spec[0], dim_kinds):
+            if kind == "hist":
+                hist = np.asarray(ha[f"agg{si}"])[: c[3]]
+                scout.append(("present", np.nonzero(hist)[0]))
+                si += 1
+            else:
+                scout.append(("bounds", int(ha[f"agg{si}.min"]),
+                              int(ha[f"agg{si + 1}.max"])))
+                si += 2
         matched = int(ha["stats.num_docs_matched"])
         kspec, fspec, extra, empty = adaptive_phase_b_spec(
-            group_spec, bounds, matched, padded, total_docs)
+            group_spec, scout, matched, padded, total_docs)
         if empty:
             return ha, None
         outs, final = run_with_group_escalation(

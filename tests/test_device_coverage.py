@@ -312,11 +312,18 @@ def test_adaptive_dense_remap_group_by(wide_group_setup):
                           "WHERE a BETWEEN 'a100' AND 'a105' "
                           "GROUP BY a, b TOP 20000")
     pa = adaptive_phase_a_specs(plan.group_spec)
-    assert pa is not None and [s[1] for s in pa] == ["a", "a", "b", "b"]
-    assert {s[0] for s in pa} == {"min", "max"}
-    # simulated scout bounds: a in [100, 105], b full range; selective
+    assert pa is not None
+    specs, dim_kinds = pa
+    # small-card dims scout histograms (exact present sets for the rank
+    # remap); this fixture's cards fit the histogram budget
+    assert [s[1] for s in specs] == ["a", "b"]
+    assert dim_kinds == ("hist", "hist")
+    # simulated scout: a's matched ids contiguous [100..105], b full
+    # range — contiguous actives keep the OFFSET remap
+    scout = [("present", np.arange(100, 106)),
+             ("present", np.arange(0, 250))]
     kspec, fspec, extra, empty = adaptive_phase_b_spec(
-        plan.group_spec, [(100, 105), (0, 249)], matched=2,
+        plan.group_spec, scout, matched=2,
         padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
     assert not empty and kspec is not None
     # kernel spec: placeholder offset (literal-stable jit key), bucketed
@@ -327,13 +334,32 @@ def test_adaptive_dense_remap_group_by(wide_group_setup):
     assert tuple(int(x) for x in extra) == (100, 0)
     assert kspec[4] > 0                        # compacted (very selective)
     # same template, different literal → SAME kernel spec (no recompile)
+    scout2 = [("present", np.arange(200, 206)),
+              ("present", np.arange(0, 250))]
     kspec2, _, extra2, _ = adaptive_phase_b_spec(
-        plan.group_spec, [(200, 205), (0, 249)], matched=2,
+        plan.group_spec, scout2, matched=2,
         padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
     assert kspec2 == kspec and tuple(int(x) for x in extra2) == (200, 0)
+    # SCATTERED actives: the densifying rank remap collapses the key
+    # space to the bucketed present count (8 << pow2-span 128) and ships
+    # the rank vector as a runtime operand
+    scat = np.array([3, 40, 77, 101, 130], dtype=np.int64)
+    kspec3, fspec3, extra3, _ = adaptive_phase_b_spec(
+        plan.group_spec, [("present", scat), ("present", np.arange(250))],
+        matched=2, padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
+    assert kspec3[0][0][1] == "idrank" and kspec3[0][0][3] == 8
+    assert np.array_equal(fspec3[0][0][2], scat)
+    rank = np.asarray(extra3[0])
+    assert rank[scat[2]] == 2 and rank[scat[-1]] == len(scat) - 1
+    # same-shape scattered literal → same kernel spec (rank is operand)
+    scat2 = scat + 7
+    kspec4, _, _, _ = adaptive_phase_b_spec(
+        plan.group_spec, [("present", scat2), ("present", np.arange(250))],
+        matched=2, padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
+    assert kspec4 == kspec3
     # barely-selective: the cost model flips to the direct dense layout
     dense_spec, _, _, _ = adaptive_phase_b_spec(
-        plan.group_spec, [(100, 105), (0, 249)], matched=2000,
+        plan.group_spec, scout, matched=2000,
         padded=segs[0].padded_docs, total_docs=segs[0].num_docs)
     assert dense_spec[4] == 0
 
@@ -357,3 +383,35 @@ def test_adaptive_dense_remap_group_by(wide_group_setup):
                    for g in resp.aggregation_results[1].group_by_result}
         assert got_sum == {k: float(v[0]) for k, v in exp.items()}
         assert got_cnt == {k: float(v[1]) for k, v in exp.items()}
+
+
+def test_rank_remap_scattered_actives_end_to_end(wide_group_setup):
+    """IN-filter selecting SCATTERED dict ids + group-by on the same
+    column: phase A's histogram finds the present set, the rank remap
+    collapses the key space, and results must match the host executor
+    (the q3.1-class regression: non-contiguous actives made offset spans
+    4-8x wider than the active set)."""
+    from pinot_tpu.parallel import make_mesh
+    segs, merged = wide_group_setup
+    picks = ["a003", "a091", "a155", "a202", "a249"]   # scattered ids
+    lst = ", ".join(f"'{p}'" for p in picks)
+    pql = (f"SELECT SUM(v), COUNT(*) FROM w WHERE a IN ({lst}) "
+           "GROUP BY a, b TOP 20000")
+    m = np.isin(merged["a"], picks)
+    exp = {}
+    for a, b, v, ok in zip(merged["a"], merged["b"], merged["v"], m):
+        if ok:
+            e = exp.setdefault((a, b), [0, 0])
+            e[0] += int(v)
+            e[1] += 1
+    for engine, label in ((QueryEngine(segs), "device"),
+                          (QueryEngine(segs, mesh=make_mesh()), "mesh"),
+                          (QueryEngine(segs, use_device=False), "host")):
+        resp = engine.query(pql)
+        assert not resp.exceptions, (label, resp.exceptions)
+        got_sum = {tuple(g["group"]): float(g["value"])
+                   for g in resp.aggregation_results[0].group_by_result}
+        got_cnt = {tuple(g["group"]): int(g["value"])
+                   for g in resp.aggregation_results[1].group_by_result}
+        assert got_sum == {k: float(v[0]) for k, v in exp.items()}, label
+        assert got_cnt == {k: v[1] for k, v in exp.items()}, label
