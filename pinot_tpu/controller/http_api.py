@@ -19,6 +19,8 @@ import tempfile
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.table_config import TableConfig, TableType
 from pinot_tpu.controller.controller import Controller
+from pinot_tpu.controller.manager import InvalidTableConfigError
+from pinot_tpu.controller.quota import StorageQuotaExceededError
 from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
 
 
@@ -120,10 +122,13 @@ class ControllerApiServer(ApiServer):
 
     async def _add_table(self, request: HttpRequest) -> HttpResponse:
         config = TableConfig.from_json(request.json())
-        if config.table_type == TableType.REALTIME:
-            table = self.controller.realtime.setup_table(config)
-        else:
-            table = self.manager.add_table(config)
+        try:
+            if config.table_type == TableType.REALTIME:
+                table = self.controller.realtime.setup_table(config)
+            else:
+                table = self.manager.add_table(config)
+        except InvalidTableConfigError as e:
+            return HttpResponse.error(400, str(e))
         return HttpResponse.of_json({"status": f"{table} successfully "
                                      "added"})
 
@@ -136,6 +141,8 @@ class ControllerApiServer(ApiServer):
                 f"{config.table_name_with_type!r}")
         try:
             table = self.manager.update_table_config(config)
+        except InvalidTableConfigError as e:
+            return HttpResponse.error(400, str(e))
         except ValueError as e:
             return HttpResponse.error(404, str(e))
         return HttpResponse.of_json({"status": f"{table} updated"})
@@ -183,7 +190,10 @@ class ControllerApiServer(ApiServer):
             seg_dir = os.path.join(tmp, "segment")
             os.makedirs(seg_dir)
             unpack_segment_tar(request.body, seg_dir)
-            name = self.manager.add_segment(table, seg_dir)
+            try:
+                name = self.manager.add_segment(table, seg_dir)
+            except StorageQuotaExceededError as e:
+                return HttpResponse.error(403, str(e))
         return HttpResponse.of_json({"status": f"segment {name} uploaded"})
 
     async def _reload_segment(self, request: HttpRequest) -> HttpResponse:

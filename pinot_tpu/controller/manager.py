@@ -23,12 +23,30 @@ from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.table_config import TableConfig
 from pinot_tpu.controller.assignment import (SegmentAssignmentStrategy,
                                              make_assignment)
+from pinot_tpu.controller.quota import (StorageQuotaChecker, dir_size_bytes,
+                                        parse_storage_size)
 from pinot_tpu.controller.state_machine import (ClusterCoordinator, DROPPED)
 from pinot_tpu.segment.metadata import SegmentMetadata
 
 TABLE_CONFIGS = "/CONFIGS/TABLE"
 SCHEMAS = "/CONFIGS/SCHEMA"
 SEGMENTS = "/SEGMENTS"
+
+
+class InvalidTableConfigError(ValueError):
+    """Malformed table config — REST maps this to 400, not 404/500."""
+
+
+def _validate_table_config(config: TableConfig) -> None:
+    """Reject malformed configs at create/update time, not first use
+    (parity: TableConfigUtils.validate — e.g. an unparseable
+    quota.storage must fail the config call, not every later upload)."""
+    quota = config.quota_config
+    if quota is not None and quota.storage:
+        try:
+            parse_storage_size(quota.storage)
+        except ValueError as e:
+            raise InvalidTableConfigError(str(e)) from None
 
 
 class ResourceManager:
@@ -40,6 +58,7 @@ class ResourceManager:
         self.fs = fs or LocalPinotFS()
         self.fs.mkdir(deep_store_dir)
         self._assignments: Dict[str, SegmentAssignmentStrategy] = {}
+        self._quota_checker = StorageQuotaChecker()
 
     # -- schemas & tables --------------------------------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -52,6 +71,7 @@ class ResourceManager:
     def add_table(self, config: TableConfig,
                   assignment: str = "balanced") -> str:
         table = config.table_name_with_type
+        _validate_table_config(config)
         self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
         self._assignments[table] = make_assignment(assignment)
         self.coordinator.set_ideal_state(table,
@@ -69,6 +89,7 @@ class ResourceManager:
         table = config.table_name_with_type
         if self.store.get(f"{TABLE_CONFIGS}/{table}") is None:
             raise ValueError(f"table {table} not found")
+        _validate_table_config(config)
         self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
         return table
 
@@ -95,6 +116,14 @@ class ResourceManager:
             raise ValueError(f"table {table} does not exist")
         meta = metadata or SegmentMetadata.load(segment_dir)
         name = meta.segment_name
+        # storage quota admission (parity: StorageQuotaChecker invoked
+        # from the upload resource before the segment is accepted)
+        size_bytes = dir_size_bytes(segment_dir)
+        if config.quota_config is not None and config.quota_config.storage:
+            existing = {seg: (self.segment_metadata(table, seg) or {}).get(
+                "sizeBytes") for seg in self.segment_names(table)}
+            self._quota_checker.check_segment_upload(
+                config, table, existing, name, size_bytes)
         dest = os.path.join(self.deep_store_dir, table, name)
         if os.path.abspath(segment_dir) != os.path.abspath(dest):
             self.fs.delete(dest)
@@ -117,6 +146,7 @@ class ResourceManager:
             "totalDocs": meta.total_docs,
             "pushTimeMs": int(time.time() * 1e3),
             "crc": meta.crc,
+            "sizeBytes": size_bytes,
             "partitionMetadata": partition_meta,
         })
         replicas = config.segments_config.replication

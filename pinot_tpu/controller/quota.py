@@ -1,0 +1,74 @@
+"""Table storage quota enforcement at segment upload.
+
+Parity: pinot-controller/.../validation/StorageQuotaChecker.java —
+invoked from the segment upload path (PinotSegmentUploadRestletResource
+→ ZKOperator): estimate the table's post-upload storage footprint and
+reject the upload when it would exceed the table config's
+``quota.storage``. The reference states the quota per replica and
+multiplies both sides by the replication factor; the factors cancel, so
+this checker compares the sum of single-copy segment artifact sizes
+against the parsed quota directly.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+from pinot_tpu.common.table_config import TableConfig
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([KMGTP]?)B?\s*$", re.I)
+_UNITS = {"": 1, "K": 1 << 10, "M": 1 << 20, "G": 1 << 30,
+          "T": 1 << 40, "P": 1 << 50}
+
+
+class StorageQuotaExceededError(ValueError):
+    """Raised when a segment upload would push a table past its quota."""
+
+
+def parse_storage_size(text: str) -> int:
+    """'100G' / '1.5M' / '2048' / '64KB' → bytes (binary units, matching
+    the reference's DataSize parsing)."""
+    m = _SIZE_RE.match(text)
+    if not m:
+        raise ValueError(f"bad storage size: {text!r}")
+    return int(float(m.group(1)) * _UNITS[m.group(2).upper()])
+
+
+def dir_size_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            total += os.path.getsize(os.path.join(root, f))
+    return total
+
+
+class StorageQuotaChecker:
+    """Pre-upload admission check against the table's storage quota."""
+
+    def check_segment_upload(self, config: TableConfig, table: str,
+                             existing_sizes: Dict[str, Optional[int]],
+                             segment_name: str, segment_bytes: int) -> None:
+        """Raise StorageQuotaExceededError if adding (or refreshing)
+        ``segment_name`` at ``segment_bytes`` would exceed the quota.
+
+        ``existing_sizes`` maps resident segment names to their recorded
+        artifact sizes; a refresh replaces the old artifact, so the
+        incumbent's size is excluded. Segments with unknown sizes (None,
+        e.g. records written before size tracking) are skipped — the
+        reference likewise proceeds on incomplete size reports rather
+        than failing closed.
+        """
+        quota = config.quota_config
+        if quota is None or not quota.storage:
+            return
+        allowed = parse_storage_size(quota.storage)
+        resident = sum(sz for name, sz in existing_sizes.items()
+                       if sz is not None and name != segment_name)
+        estimated = resident + segment_bytes
+        if estimated > allowed:
+            raise StorageQuotaExceededError(
+                f"storage quota exceeded for table {table}: estimated "
+                f"{estimated} bytes > quota {quota.storage} "
+                f"({allowed} bytes); segment {segment_name} is "
+                f"{segment_bytes} bytes on top of {resident} resident")

@@ -363,3 +363,58 @@ def test_schema_evolution_via_reload(tmp_path):
         assert int(r2.aggregation_results[0].value) == 1024
     finally:
         cluster.stop()
+
+
+# -- storage quota ----------------------------------------------------------
+
+def test_parse_storage_size():
+    from pinot_tpu.controller.quota import parse_storage_size
+    assert parse_storage_size("2048") == 2048
+    assert parse_storage_size("4K") == 4096
+    assert parse_storage_size("1.5M") == 1536 * 1024
+    assert parse_storage_size("100G") == 100 << 30
+    assert parse_storage_size("64KB") == 64 << 10
+    with pytest.raises(ValueError):
+        parse_storage_size("lots")
+
+
+def test_storage_quota_rejects_upload(tmp_path):
+    """Parity: StorageQuotaChecker — a table whose quota.storage fits one
+    segment accepts the first upload, rejects the second (HTTP path maps
+    it to 403), still allows a same-name refresh (the incumbent's size is
+    replaced, not added), and accepts again after the quota is raised."""
+    from pinot_tpu.common.table_config import QuotaConfig
+    from pinot_tpu.controller.quota import (StorageQuotaExceededError,
+                                            dir_size_bytes)
+
+    cluster = EmbeddedCluster(str(tmp_path / "c"), num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        d0 = str(tmp_path / "s0")
+        build_segment(d0, n=1200, seed=1, name="q_0")
+        size = dir_size_bytes(d0)
+        cluster.add_table(make_table_config(
+            quota_config=QuotaConfig(storage=str(size + size // 2))))
+        table = "baseballStats_OFFLINE"
+        cluster.upload_segment(table, d0)
+
+        d1 = str(tmp_path / "s1")
+        build_segment(d1, n=1200, seed=2, name="q_1")
+        with pytest.raises(StorageQuotaExceededError, match="quota"):
+            cluster.upload_segment(table, d1)
+        assert cluster.controller.manager.segment_names(table) == ["q_0"]
+
+        # refresh of the resident segment: replaced, not double-counted
+        d0b = str(tmp_path / "s0b")
+        build_segment(d0b, n=1200, seed=3, name="q_0")
+        cluster.upload_segment(table, d0b)
+
+        # raising the quota admits the second segment
+        cfg = cluster.controller.manager.get_table_config(table)
+        cfg.quota_config = QuotaConfig(storage="1G")
+        cluster.controller.manager.update_table_config(cfg)
+        cluster.upload_segment(table, d1)
+        meta = cluster.controller.manager.segment_metadata(table, "q_1")
+        assert meta["sizeBytes"] > 0
+    finally:
+        cluster.stop()

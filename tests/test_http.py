@@ -177,3 +177,26 @@ def test_rest_delete_segment_and_requery(http_cluster):
     assert rg.result_set(0).get(0, 0) == "3600"
     with pytest.raises(PinotClientError, match="404"):
         ctl.segment_metadata("baseballStats_OFFLINE", "ht_extra")
+
+
+def test_rest_upload_storage_quota_403(tmp_path):
+    """Over-quota upload returns HTTP 403 (StorageQuotaChecker parity);
+    a malformed quota string is a 400 at config time, not a 500 later."""
+    from pinot_tpu.common.table_config import QuotaConfig
+    c = EmbeddedCluster(str(tmp_path / "c"), num_servers=1, http=True)
+    ctl = ControllerClient("127.0.0.1", c.controller_port)
+    try:
+        ctl.add_schema(make_schema().to_json())
+        bad = make_table_config(quota_config=QuotaConfig(storage="lots"))
+        with pytest.raises(PinotClientError, match="400"):
+            ctl.add_table(bad.to_json())
+        cfg = make_table_config(quota_config=QuotaConfig(storage="1K"))
+        ctl.add_table(cfg.to_json())
+        seg_dir = str(tmp_path / "seg")
+        build_segment(seg_dir, n=1200, seed=9, name="quota_0")
+        with pytest.raises(PinotClientError, match="403"):
+            ctl.upload_segment_dir("baseballStats_OFFLINE", seg_dir)
+        assert ctl.list_segments("baseballStats_OFFLINE") == []
+    finally:
+        ctl.close()
+        c.stop()
