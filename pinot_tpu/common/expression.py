@@ -46,7 +46,7 @@ class Call:
 Expr = Union[Col, Lit, Call]
 
 TRANSFORM_FUNCTIONS = {"add", "sub", "mult", "div", "time_convert",
-                       "datetime_convert"}
+                       "datetime_convert", "valuein"}
 
 
 def is_transform_function(name: str) -> bool:
@@ -253,6 +253,14 @@ def evaluate(expr_or_text, resolve: Callable[[str], np.ndarray]
             ms = v * in_ms
             ms = _trunc_div(ms, gran_ms) * gran_ms
             return _trunc_div(ms, out_ms)
+        if e.func == "valuein":
+            # MV→MV transform (ValueInTransformFunction): produces a value
+            # SET per doc, not a scalar — group-by and MV aggregations
+            # handle it in the dictId domain (host_exec._mv_group_source);
+            # it has no scalar row-domain evaluation.
+            raise ExpressionError(
+                "valuein is a multi-value transform; it is only usable as "
+                "a group-by key or MV aggregation argument")
         raise ExpressionError(f"unknown transform function {e.func!r}")
 
     return ev(expr)

@@ -224,17 +224,17 @@ def _coercer(dtype: np.dtype):
 
 def _masked_values(segment: ImmutableSegment, col: str, mask: np.ndarray
                    ) -> np.ndarray:
+    src = _mv_group_source(segment, col)
+    if src is not None:                  # MV column or valuein(mvcol, ...)
+        vals, _counts = _mv_entries(src[0], src[1], np.nonzero(mask)[0])
+        return vals
     if expr_mod.is_expression(col):
         return _expr_rows(col, segment)[mask]
     ds = segment.data_source(col)
     cm = ds.metadata
     if not cm.has_dictionary:
         return ds.raw_values[mask]
-    if cm.single_value:
-        return ds.dictionary.values[ds.dict_ids[mask]]
-    ids = ds.mv_dict_ids[mask]
-    flat = ids[ids < cm.cardinality]
-    return ds.dictionary.values[flat]
+    return ds.dictionary.values[ds.dict_ids[mask]]
 
 
 def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
@@ -242,6 +242,9 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
     base = f.info.base
     if base == "COUNT" and not f.info.is_mv:
         return int(mask.sum())
+    if f.info.is_mv and _mv_group_source(segment, f.column) is None:
+        raise ValueError(
+            f"{base}MV needs a multi-value column, got {f.column}")
     vals = _masked_values(segment, f.column, mask)
     if base == "COUNT":  # COUNTMV: entries
         return int(len(vals))
@@ -276,33 +279,111 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
 # ---------------------------------------------------------------------------
 
 
-def _group_value_lane(segment: ImmutableSegment, c: str, mask: np.ndarray
-                      ) -> np.ndarray:
-    """Masked row values for one group-by key (column or expression)."""
+def _valuein_parts(c: str):
+    """(column, literal texts) if ``c`` is ``valuein(col, lit, ...)``,
+    else None."""
+    if not expr_mod.is_expression(c):
+        return None
+    expr = expr_mod.parse_expression(c)
+    if not (isinstance(expr, expr_mod.Call) and expr.func == "valuein"):
+        return None
+    if not expr.args or not isinstance(expr.args[0], expr_mod.Col):
+        raise ValueError("valuein needs a column as its first argument")
+    lits = []
+    for a in expr.args[1:]:
+        if not isinstance(a, expr_mod.Lit):
+            raise ValueError("valuein values must be literals")
+        lits.append(a.text)
+    return expr.args[0].name, tuple(lits)
+
+
+def _mv_group_source(segment: ImmutableSegment, c: str):
+    """(data source, allowed-dictId bool mask | None) when ``c`` is an MV
+    dictionary column or ``valuein(mvcol, ...)``; None for scalar keys.
+
+    Parity: DefaultGroupByExecutor.aggregateGroupByMV — MV keys
+    contribute one group entry per (doc, value); ValueInTransformFunction
+    restricts the value set (`core/operator/transform/transformer`)."""
+    vi = _valuein_parts(c)
+    name = vi[0] if vi else c
+    if expr_mod.is_expression(name):
+        return None
+    ds = segment.data_source(name)
+    cm = ds.metadata
+    if cm.single_value or not cm.has_dictionary:
+        if vi:
+            raise ValueError(
+                f"valuein needs a dictionary-encoded MV column, got {name}")
+        return None
+    allowed = None
+    if vi:
+        allowed = np.zeros(cm.cardinality, dtype=bool)
+        ids = ds.dictionary.index_of_many(vi[1])
+        allowed[ids[ids >= 0]] = True
+    return ds, allowed
+
+
+def _mv_entries(ds, allowed, row2doc: np.ndarray):
+    """Per-row MV entries for the given doc rows: (values, counts) where
+    counts[i] is row i's entry count and values holds the entries
+    row-major (padding slots — id == cardinality — and, for valuein,
+    disallowed values are dropped)."""
+    card = ds.metadata.cardinality
+    ids = ds.mv_dict_ids[row2doc]                 # [rows, width]
+    valid = ids < card
+    if allowed is not None:
+        valid &= allowed[np.clip(ids, 0, card - 1)]
+    counts = valid.sum(axis=1)
+    values = np.asarray(ds.dictionary.values)[ids[valid]]
+    return values, counts
+
+
+def _group_value_rows(segment: ImmutableSegment, c: str,
+                      row2doc: np.ndarray) -> np.ndarray:
+    """Row values for one scalar group-by key (column or expression) over
+    the expanded row space (row2doc maps rows back to doc ids)."""
     if expr_mod.is_expression(c):
-        return _expr_rows(c, segment)[mask]
+        return _expr_rows(c, segment)[row2doc]
     ds = segment.data_source(c)
     cm = ds.metadata
     if cm.has_dictionary and cm.single_value:
-        return np.asarray(ds.dictionary.values)[ds.dict_ids[mask]]
+        return np.asarray(ds.dictionary.values)[ds.dict_ids[row2doc]]
     if not cm.has_dictionary:
-        return ds.raw_values[mask]
+        return ds.raw_values[row2doc]
     raise ValueError(f"host group-by needs SV column {c}")
 
 
 def _group_by(segment: ImmutableSegment, request: BrokerRequest,
               mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
     gcols = request.group_by.columns
+    # MV keys expand the row space: one row per (doc, value) — and per
+    # value combination when several keys are MV (reference cross-product
+    # semantics, DefaultGroupByExecutor.aggregateGroupByMV). Scalar keys
+    # and aggregations then index rows through row2doc.
+    row2doc = np.nonzero(mask)[0]
+    mv_lanes: Dict[int, np.ndarray] = {}
+    for idx, c in enumerate(gcols):
+        src = _mv_group_source(segment, c)
+        if src is None:
+            continue
+        values, counts = _mv_entries(src[0], src[1], row2doc)
+        rep = np.repeat(np.arange(len(row2doc)), counts)
+        row2doc = row2doc[rep]
+        for k in mv_lanes:
+            mv_lanes[k] = mv_lanes[k][rep]
+        mv_lanes[idx] = values
     # per-key-column unique coding (value domain, so plain columns,
     # no-dictionary columns and transform expressions all group uniformly)
     codes: List[np.ndarray] = []
     uniq_vals: List[np.ndarray] = []
-    for c in gcols:
-        lane = _group_value_lane(segment, c, mask)
+    for idx, c in enumerate(gcols):
+        lane = mv_lanes.get(idx)
+        if lane is None:
+            lane = _group_value_rows(segment, c, row2doc)
         u, inv = np.unique(lane, return_inverse=True)
         uniq_vals.append(u)
         codes.append(inv.astype(np.int64))
-    key = np.zeros(int(mask.sum()), dtype=np.int64)
+    key = np.zeros(len(row2doc), dtype=np.int64)
     for u, inv in zip(uniq_vals, codes):
         key = key * max(len(u), 1) + inv
     uniq_keys, inverse = np.unique(key, return_inverse=True)
@@ -321,34 +402,50 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
     per_fn: List[List] = []
     for f in functions:
         base = f.info.base
-        if base == "COUNT":
+        if base == "COUNT" and (f.column == "*" or not f.info.is_mv):
             counts = np.zeros(g, dtype=np.int64)
             np.add.at(counts, inverse, 1)
             per_fn.append([int(c) for c in counts])
             continue
-        if not expr_mod.is_expression(f.column):
-            cm = segment.data_source(f.column).metadata
-            if cm.has_dictionary and not cm.single_value:
-                raise ValueError("host group-by over MV metric unsupported")
-        vals = _group_value_lane(segment, f.column, mask)
+        # MV aggregation argument (SUMMV/COUNTMV/... or valuein(...)):
+        # one contribution per (row, entry) — reference aggregateGroupByMV.
+        # Non-suffixed aggregations over MV columns keep the engine-wide
+        # entry-flattening semantics (the device kernels' source=="mv"
+        # path does the same); only *MV over a single-value column is
+        # rejected. COUNT stays row-count — COUNTMV is the entry count.
+        src = _mv_group_source(segment, f.column)
+        if src is None and f.info.is_mv:
+            raise ValueError(
+                f"{base}MV needs a multi-value column, got {f.column}")
+        if src is not None:
+            vals, ecounts = _mv_entries(src[0], src[1], row2doc)
+            inv_f = np.repeat(inverse, ecounts)
+        else:
+            vals = _group_value_rows(segment, f.column, row2doc)
+            inv_f = inverse
+        if base == "COUNT":              # COUNTMV: entries per group
+            counts = np.zeros(g, dtype=np.int64)
+            np.add.at(counts, inv_f, 1)
+            per_fn.append([int(c) for c in counts])
+            continue
         if base not in ("DISTINCTCOUNT", "DISTINCTCOUNTHLL", "FASTHLL",
                         "DISTINCTCOUNTRAWHLL"):
             vals = vals.astype(np.float64)   # distinct bases keep strings
         if base in ("SUM", "AVG"):
             sums = np.zeros(g)
-            np.add.at(sums, inverse, vals)
+            np.add.at(sums, inv_f, vals)
             if base == "SUM":
                 per_fn.append([float(s) for s in sums])
             else:
                 counts = np.zeros(g, dtype=np.int64)
-                np.add.at(counts, inverse, 1)
+                np.add.at(counts, inv_f, 1)
                 per_fn.append([(float(s), int(c))
                                for s, c in zip(sums, counts)])
         elif base in ("MIN", "MAX", "MINMAXRANGE"):
             mins = np.full(g, np.inf)
             maxs = np.full(g, -np.inf)
-            np.minimum.at(mins, inverse, vals)
-            np.maximum.at(maxs, inverse, vals)
+            np.minimum.at(mins, inv_f, vals)
+            np.maximum.at(maxs, inv_f, vals)
             if base == "MIN":
                 per_fn.append([float(v) for v in mins])
             elif base == "MAX":
@@ -360,7 +457,7 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
             # set/map/sketch intermediates per group
             items: List = [None] * g
             for gi in range(g):
-                sel = vals[inverse == gi]
+                sel = vals[inv_f == gi]
                 if base == "DISTINCTCOUNT":
                     items[gi] = set(_plain(v) for v in np.unique(sel))
                 elif base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):

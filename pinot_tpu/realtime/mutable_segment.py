@@ -53,6 +53,9 @@ class MutableDictionary:
         v = self._coerce(value)
         return self._index.get(v, -1)
 
+    def index_of_many(self, values) -> np.ndarray:
+        return np.array([self.index_of(v) for v in values], dtype=np.int32)
+
     def index_of_or_add(self, value) -> int:
         v = self._coerce(value)
         i = self._index.get(v)
@@ -228,6 +231,9 @@ class _SnapshotDictionary:
     def index_of(self, value) -> int:
         i = self._inner.index_of(value)
         return i if i < self.cardinality else -1
+
+    def index_of_many(self, values) -> np.ndarray:
+        return np.array([self.index_of(v) for v in values], dtype=np.int32)
 
     def get(self, dict_id: int):
         return self._inner.get(dict_id)

@@ -81,12 +81,15 @@ def test_segments_load_via_store_watches(cluster):
             s.server.data_manager.table("baseballStats_OFFLINE",
                                         create=True).segment_names()) == 4,
             timeout=30, msg=f"{s.agent.instance_id} segment load")
-    view = ctrl.controller.coordinator.external_view(
-        "baseballStats_OFFLINE")
-    assert len(view.segment_states) == 4
-    for states in view.segment_states.values():
-        assert set(states.values()) == {"ONLINE"}
-        assert len(states) == 2
+    # the external view converges asynchronously after the servers report
+    # their current states over the networked store — wait for it too
+    def _ev_converged():
+        view = ctrl.controller.coordinator.external_view(
+            "baseballStats_OFFLINE")
+        return len(view.segment_states) == 4 and all(
+            set(states.values()) == {"ONLINE"} and len(states) == 2
+            for states in view.segment_states.values())
+    _await(_ev_converged, timeout=30, msg="external view convergence")
 
 
 def test_query_through_remote_planes(cluster):

@@ -293,3 +293,126 @@ def test_empty_segment_level_results_merge(setup):
                        "WHERE yearID > 9999")
         assert agg_value(resp, 0) == "Infinity", label
         assert agg_value(resp, 1) == "-Infinity", label
+
+
+# ---------------------------------------------------------------------------
+# MV group-by + valuein (reference: DefaultGroupByExecutor.aggregateGroupByMV,
+# ValueInTransformFunction)
+# ---------------------------------------------------------------------------
+
+
+def _mv_group_oracle(cols, mask=None, gmv="position", sv=None, metric=None):
+    """COUNT (and optional SUM(metric)) per MV value (x optional SV key)."""
+    out = {}
+    for i, lst in enumerate(cols[gmv]):
+        if mask is not None and not mask[i]:
+            continue
+        for v in lst:
+            k = (v,) if sv is None else (v, cols[sv][i])
+            e = out.setdefault(k, [0, 0.0])
+            e[0] += 1
+            if metric is not None:
+                e[1] += float(cols[metric][i])
+    return out
+
+
+def test_mv_group_by_count(setup):
+    engines, oracle = both_engines(setup)
+    exp = _mv_group_oracle(oracle.cols)
+    for e, label in engines:
+        resp = e.query("SELECT COUNT(*) FROM baseballStats "
+                       "GROUP BY position TOP 1000")
+        got = {tuple(g["group"]): int(float(g["value"]))
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == {k: v[0] for k, v in exp.items()}, label
+
+
+def test_mv_group_by_with_sv_key_and_sum(setup):
+    engines, oracle = both_engines(setup)
+    m = oracle.mask(lambda r: r["yearID"] >= 1990)
+    exp = _mv_group_oracle(oracle.cols, mask=m, sv="league", metric="hits")
+    for e, label in engines:
+        resp = e.query("SELECT SUM(hits), COUNT(*) FROM baseballStats "
+                       "WHERE yearID >= 1990 GROUP BY position, league "
+                       "TOP 1000")
+        got_sum = {tuple(g["group"]): float(g["value"])
+                   for g in resp.aggregation_results[0].group_by_result}
+        got_cnt = {tuple(g["group"]): int(float(g["value"]))
+                   for g in resp.aggregation_results[1].group_by_result}
+        assert got_cnt == {k: v[0] for k, v in exp.items()}, label
+        assert got_sum == {k: v[1] for k, v in exp.items()}, label
+
+
+def test_valuein_group_key_and_countmv(setup):
+    engines, oracle = both_engines(setup)
+    full = _mv_group_oracle(oracle.cols)
+    keep = {("P",), ("C",), ("SS",)}
+    for e, label in engines:
+        resp = e.query("SELECT COUNT(*) FROM baseballStats "
+                       "GROUP BY valuein(position, 'P', 'C', 'SS') TOP 100")
+        got = {tuple(g["group"]): int(float(g["value"]))
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == {k: v[0] for k, v in full.items() if k in keep}, label
+        # non-grouped COUNTMV over the restricted value set
+        resp2 = e.query("SELECT COUNTMV(valuein(position, 'P', 'C', 'SS')) "
+                        "FROM baseballStats")
+        exp_entries = sum(v[0] for k, v in full.items() if k in keep)
+        assert int(float(agg_value(resp2))) == exp_entries, label
+
+
+def test_countmv_inside_group_by(setup):
+    engines, oracle = both_engines(setup)
+    # COUNTMV(position) grouped by league: entries per league
+    exp = {}
+    for i, lst in enumerate(oracle.cols["position"]):
+        k = (oracle.cols["league"][i],)
+        exp[k] = exp.get(k, 0) + len(lst)
+    for e, label in engines:
+        resp = e.query("SELECT COUNTMV(position) FROM baseballStats "
+                       "GROUP BY league TOP 100")
+        got = {tuple(g["group"]): int(float(g["value"]))
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == exp, label
+
+
+def test_mv_metric_sum_in_group_by(tmp_path):
+    """Numeric MV aggregation argument inside a group-by (SUMMV parity:
+    each (doc, entry) contributes to the doc's group)."""
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, dimension, metric
+    from pinot_tpu.common.schema import FieldSpec, FieldType
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    rng = np.random.default_rng(3)
+    n = 800
+    schema = Schema("mv", [dimension("k", DataType.STRING),
+                           FieldSpec("scores", DataType.INT,
+                                     FieldType.DIMENSION,
+                                     single_value=False),
+                           metric("v", DataType.INT)])
+    keys = np.array(["a", "b", "c"], dtype=object)
+    kcol = keys[rng.integers(0, 3, n)]
+    scores = [list(rng.integers(0, 50, rng.integers(1, 4)))
+              for _ in range(n)]
+    cols = {"k": kcol, "scores": scores,
+            "v": rng.integers(0, 100, n).astype(np.int32)}
+    d = str(tmp_path / "seg")
+    SegmentCreator(schema, None, segment_name="mv0").build(cols, d)
+    seg = ImmutableSegmentLoader.load(d)
+    exp = {}
+    for k, lst in zip(kcol, scores):
+        e = exp.setdefault((k,), [0.0, 0])
+        e[0] += float(sum(lst))
+        e[1] += len(lst)
+    for use_device in (True, False):
+        eng = QueryEngine([seg], use_device=use_device)
+        resp = eng.query("SELECT SUMMV(scores), COUNTMV(scores) FROM mv "
+                         "GROUP BY k TOP 10")
+        assert not resp.exceptions, resp.exceptions
+        got_sum = {tuple(g["group"]): float(g["value"])
+                   for g in resp.aggregation_results[0].group_by_result}
+        got_cnt = {tuple(g["group"]): int(float(g["value"]))
+                   for g in resp.aggregation_results[1].group_by_result}
+        assert got_sum == {k: v[0] for k, v in exp.items()}
+        assert got_cnt == {k: v[1] for k, v in exp.items()}
