@@ -348,7 +348,8 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                 f"{budget_s:.0f}s time budget)")
             per_query[name] = {"skipped": "stage time budget"}
             continue
-        for _attempt in (1, 2):
+        n_attempts = 3
+        for _attempt in range(1, n_attempts + 1):
             _sp0 = len(speedups)
             try:
                 request = optimizer.optimize(compile_pql(pql))
@@ -392,8 +393,9 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                     # compaction to the lanes actually executed
                     group_spec = set_group_kmax(group_spec, stack.padded_docs)
 
-                # the kernels each query rep must execute (adaptive group-bys run
-                # TWO dispatches per query: phase-A histograms + phase-B dense)
+                # the kernels each query rep must execute (adaptive
+                # group-bys run 2-3 dispatches: phase-A min/max scout,
+                # the conditional hist rung, the phase-B group kernel)
                 fns = []
 
                 def run(agg_specs, spec, extra_params=()):
@@ -402,7 +404,7 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                                             tuple(agg_specs or ()), spec,
                                             plan.select_spec, lane_keys)
                     full = tuple(plan.params) + tuple(extra_params)
-                    fns.append((fn, full))
+                    fns.append((fn, full, spec))
                     return jax.device_get(fn(cols, full, nd))
 
                 fin_plan = plan
@@ -411,11 +413,11 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                     outs_h, spec_used = drive_group_execution(
                         run, group_spec, stack.padded_docs,
                         int(stack.num_docs.sum()))
-                    adaptive = spec_used is not None and \
-                        any(g[1] == "idoff" for g in spec_used[0])
-                    # steady state = final ladder rung, plus phase A when adaptive
-                    fns = [fns[0], fns[-1]] if adaptive and len(fns) > 1 \
-                        else [fns[-1]]
+                    # steady state = every scout dispatch (spec None:
+                    # phase A min/max + the conditional hist rung) plus
+                    # the final escalation-ladder rung
+                    scouts = [f for f in fns[:-1] if f[2] is None]
+                    fns = scouts + [fns[-1]]
                     fin_plan = execution._with_group_spec(plan, spec_used)
                 else:
                     fns.clear()
@@ -435,8 +437,8 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                 finish_s = median(finish_ts)
 
                 zs = jnp.zeros(n_exec, jnp.int32)
-                only_fns = tuple(fn for fn, _ in fns)
-                all_fparams = tuple(fp for _, fp in fns)
+                only_fns = tuple(f[0] for f in fns)
+                all_fparams = tuple(f[1] for f in fns)
 
                 @jax.jit
                 def timed(cols, nd, zs, all_fparams):
@@ -474,13 +476,19 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                     f"speedup {c / d50:.1f}x, {rows / d50 / 1e9:.2f}B rows/s/chip")
                 break
             except Exception as e:  # noqa: BLE001 — crashed TPU
-                # worker / flaky remote-compile channel: retry the
-                # query once, then record an honest error
+                # worker / flaky remote-compile channel: retry, with a
+                # cool-down when the worker itself crashed (it restarts
+                # in the background; immediate retries hit the corpse)
                 del speedups[_sp0:]   # drop any partial sample
-                if _attempt == 1:
-                    log(f"bench[{stage}] {name}: attempt 1 failed "
-                        f"({type(e).__name__}: {str(e)[:120]}) — "
+                if _attempt < n_attempts:
+                    crashed = "UNAVAILABLE" in str(e) or \
+                        "crashed" in str(e)
+                    log(f"bench[{stage}] {name}: attempt {_attempt} "
+                        f"failed ({type(e).__name__}: {str(e)[:120]}) — "
+                        f"{'cooling down 45s then ' if crashed else ''}"
                         "retrying")
+                    if crashed:
+                        time.sleep(45)
                     continue
                 log(f"bench[{stage}] {name}: ERROR "
                     f"{type(e).__name__}: {str(e)[:200]}")

@@ -268,7 +268,7 @@ def _mxu_histogram(ids, mask, card_pad: int):
     b = _tile_rows(card_pad, ids.shape[0])
     ids_b = ids.reshape(-1, b)
     mask_b = mask.astype(jnp.bfloat16).reshape(-1, b)
-    radix = card_pad > RADIX_G
+    radix = card_pad >= RADIX_G
     gp = _radix_pad(card_pad)
 
     def body(carry, tb):
@@ -292,20 +292,28 @@ def _dense_group_count(key, mask, g_pad: int):
     return _mxu_histogram(key, mask, g_pad)
 
 
-def _dense_group_part_sums(part_lanes, key, mask, g_pad: int):
+def _dense_group_part_sums(part_lanes, key, mask, g_pad: int,
+                           with_count: bool = False):
     """Exact per-group sums of 7-bit part lanes via MXU: int32 [n_parts, g].
 
     part_lanes: list of 1-D [P] lanes — per-lane [T, b] blocking avoids
     any small-extent tile axis. Carry-accumulated int32; planner
     guarantees padded <= DENSE_ROWS_LIMIT so 127 * rows < 2^31.
+
+    with_count=True folds the group COUNT in as one more summed lane
+    (the mask itself), sharing the per-chunk one-hot build — at dense
+    SSB shapes the one-hots dominate, so count-plus-parts in one scan
+    runs ~2x faster than separate histogram + part-sum passes. Returns
+    (sums [n_parts, g], counts [g]) then; sums alone otherwise.
     """
     n_parts = len(part_lanes)
     b = _tile_rows(g_pad, key.shape[0])
     key_b = key.reshape(-1, b)
+    lanes = tuple(part_lanes) + ((mask,) if with_count else ())
     lanes_b = tuple(
         jnp.where(mask, lane.astype(jnp.bfloat16), 0).reshape(-1, b)
-        for lane in part_lanes)
-    radix = g_pad > RADIX_G
+        for lane in lanes)
+    radix = g_pad >= RADIX_G
     gp = _radix_pad(g_pad)
 
     def body(carry, tb):
@@ -324,8 +332,11 @@ def _dense_group_part_sums(part_lanes, key, mask, g_pad: int):
                 for c in cs])
         return carry + s.astype(jnp.int32), None
 
-    out, _ = jax.lax.scan(body, jnp.zeros((n_parts, g_pad), jnp.int32),
+    out, _ = jax.lax.scan(body,
+                          jnp.zeros((len(lanes), g_pad), jnp.int32),
                           (key_b,) + lanes_b)
+    if with_count:
+        return out[:n_parts], out[n_parts]
     return out
 
 
@@ -337,7 +348,7 @@ def _dense_group_float_sums(vals, key, mask, g_pad: int):
     contrib = jnp.where(mask, vals.astype(mm_dtype), 0)
     key_b = key.reshape(-1, b)
     cb = contrib.reshape(-1, b)
-    radix = g_pad > RADIX_G
+    radix = g_pad >= RADIX_G
     gp = _radix_pad(g_pad)
 
     def body(carry, tb):
@@ -517,11 +528,19 @@ def _group_key(gcols, strides, g_pad, cols, params=None):
             # offset spans 4-8x wider than the actual active set); the
             # rank vector (runtime operand, [card_pad] int32) maps
             # id -> rank-among-present, collapsing the key space to the
-            # bucketed present counts. Unmatched rows gather garbage
-            # ranks; their contributions are masked everywhere.
+            # bucketed present counts. Evaluated as a ONE-HOT MATMUL,
+            # never a row-scale gather (measured: rank[ids] gathers at
+            # ~90M rows/s on v5e — 1.1s/dim at 100M rows — vs ~15ms for
+            # the [rows, card_pad<=512] one-hot contraction; exact: the
+            # one-hot is 0/1 and ranks < 512 are exact in f32).
+            # Unmatched rows map to garbage ranks; their contributions
+            # are masked everywhere.
             rank = params.pop(0)
             lane = cols[f"{c}.ids"].astype(jnp.int32)
-            ids = rank[jnp.clip(lane, 0, rank.shape[0] - 1)]
+            oh = jax.nn.one_hot(lane, rank.shape[0], dtype=jnp.bfloat16)
+            ids = jnp.matmul(oh, rank.astype(jnp.float32)[:, None],
+                             preferred_element_type=jnp.float32
+                             )[:, 0].astype(jnp.int32)
         else:
             ids = cols[f"{c}.ids"].astype(jnp.int32)
         term = ids * np.int32(s)
@@ -937,11 +956,31 @@ def _group_outputs(group_spec, cols, mask, num_docs, params=None):
                                         params)
     key = _group_key(gcols, strides, g_pad, cols, params)
     dense = g_pad <= DENSE_G_LIMIT and mask.shape[0] <= DENSE_ROWS_LIMIT
-    if dense:
-        outs = {"group.count": _dense_group_count(key, mask, g_pad)}
+    # all part-sum aggregations + the group count share ONE fused scan
+    # (one-hot builds dominate at dense shapes; fusing halves the passes)
+    psums_specs = [(i, spec) for i, spec in enumerate(agg_specs)
+                   if spec[0] in ("sum", "avg") and
+                   isinstance(spec[3], tuple) and spec[3][0] == "psums"]
+    outs = {}
+    if dense and psums_specs:
+        lanes, slots, start = [], {}, 0
+        for i, spec in psums_specs:
+            pl = cols[f"{spec[1]}.parts"]
+            n_p = pl.shape[0]
+            lanes.extend(pl[p] for p in range(n_p))
+            slots[i] = (start, n_p)
+            start += n_p
+        sums, count = _dense_group_part_sums(lanes, key, mask, g_pad,
+                                             with_count=True)
+        outs["group.count"] = count
+        for i, _spec in psums_specs:
+            s0, n_p = slots[i]
+            outs[f"gagg{i}.psums"] = sums[s0:s0 + n_p]
+    elif dense:
+        outs["group.count"] = _dense_group_count(key, mask, g_pad)
     else:
-        outs = {"group.count": jnp.zeros(g_pad, jnp.int32).at[key].add(
-            mask.astype(jnp.int32))}
+        outs["group.count"] = jnp.zeros(g_pad, jnp.int32).at[key].add(
+            mask.astype(jnp.int32))
     acc = sum_dtype()
     for i, spec in enumerate(agg_specs):
         fname, col, source, extra = spec
@@ -950,11 +989,14 @@ def _group_outputs(group_spec, cols, mask, num_docs, params=None):
         strategy = extra[0] if isinstance(extra, tuple) else "vals"
         if fname in ("sum", "avg"):
             if strategy == "psums":
-                # exact: one-hot MXU matmul over int8 part lanes
-                outs[f"gagg{i}.psums"] = _dense_group_part_sums(
-                    [cols[f"{col}.parts"][p]
-                     for p in range(cols[f"{col}.parts"].shape[0])],
-                    key, mask, g_pad)
+                if not dense:
+                    # scatter fallback keyed per part lane
+                    outs[f"gagg{i}.psums"] = jnp.stack([
+                        jnp.zeros(g_pad, jnp.int32).at[key].add(
+                            jnp.where(mask, cols[f"{col}.parts"][p]
+                                      .astype(jnp.int32), 0))
+                        for p in range(cols[f"{col}.parts"].shape[0])])
+                # dense: already emitted by the fused pass above
             elif strategy == "csums":
                 lane = cols[f"{col}.vlane" if source == "sv"
                             else f"{col}.raw"]

@@ -17,6 +17,7 @@ executor (query/host_exec.py).
 from __future__ import annotations
 
 import dataclasses
+import os
 import re as _re
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -654,35 +655,86 @@ def run_with_group_escalation(run, group_spec, padded: int):
     return outs, group_spec
 
 
-RANK_HIST_CARD_LIMIT = 4096    # scout histograms (→ rank remap) only for
-#                                dims this small; wider dims scout min/max
+RANK_HIST_CARD_LIMIT = int(os.environ.get(
+    "PINOT_TPU_RANK_HIST_CARD", "512"))    # hist scout + rank remap only
+#                              when every group dim's card_pad fits this
+#                              budget: both the scout histogram and the
+#                              kernel's one-hot rank contraction are
+#                              O(rows * card_pad). 0 disables the rung.
 
 
 def adaptive_phase_a_specs(group_spec) -> Optional[tuple]:
-    """Scout agg specs for the adaptive two-phase group-by, or None when
-    the plan isn't eligible (no filter to narrow the key space, or
-    non-dictionary keys).
-
-    Small-cardinality dims scout a matched-id HISTOGRAM (one one-hot
-    matmul — from it the host derives the exact PRESENT id set for the
-    densifying rank remap); wider dims scout masked MIN+MAX (streaming
-    tree reductions) for the offset remap. Returns (specs, dim_kinds)
-    with dim_kinds[i] in {"hist", "bounds"}."""
+    """Scout agg specs (masked MIN+MAX of each group column's dictIds)
+    for the adaptive two-phase group-by, or None when the plan isn't
+    eligible (no filter to narrow the key space, or non-dictionary
+    keys). Min/max are streaming-rate tree reductions — the scout costs
+    about one filter evaluation. (The HISTOGRAM scout for the densifying
+    rank remap is a separate, conditional second rung —
+    adaptive_hist_specs — because a wide-card histogram at full row
+    scale costs ~5x the min/max scout; measured 229ms vs ~10ms for the
+    1024-bin p_brand1 hist at 100M rows on v5e.)"""
     if group_spec is None or not group_spec[4]:
         return None
-    specs, dim_kinds = [], []
+    specs = []
     for (c, gkind, _off, card) in group_spec[0]:
         if gkind != "ids":
             return None
         card_pad = kernels.pow2_bucket(card + 1)
-        if card_pad <= RANK_HIST_CARD_LIMIT:
-            specs.append(("hist", c, "sv", ("hist", card_pad)))
-            dim_kinds.append("hist")
-        else:
-            specs.append(("min", c, "sv", ("ids", card_pad)))
-            specs.append(("max", c, "sv", ("ids", card_pad)))
-            dim_kinds.append("bounds")
-    return tuple(specs), tuple(dim_kinds)
+        specs.append(("min", c, "sv", ("ids", card_pad)))
+        specs.append(("max", c, "sv", ("ids", card_pad)))
+    return tuple(specs)
+
+
+def adaptive_hist_specs(group_spec, bounds) -> Optional[tuple]:
+    """Conditional second scout rung: matched-id histograms, from which
+    the host derives each dim's exact PRESENT id set for the densifying
+    rank remap (parity intent: DictionaryBasedGroupKeyGenerator's
+    map-based generators serve exactly this sparse-key regime — e.g.
+    SSB q3.1's 'the 5 Asian nations in a 25-nation sorted dictionary').
+
+    The hist one-hots and the kernel's rank contraction are O(rows), so
+    this rung only dispatches when densifying can buy the one layout
+    change the offset spans can't: escaping the RANKED sort layout
+    (span space > DENSE_G_LIMIT). Within the dense regime shrinking g
+    does NOT pay — the dense kernel's cost is dominated by the per-row
+    [rows, 128] lo one-hot products, measured g-independent (394ms at
+    g=8192 vs 398ms at g=512, q3.1 shapes, 100M rows, v5e).
+    Every dim must fit the histogram budget. Returns hist agg specs or
+    None."""
+    if not RANK_HIST_CARD_LIMIT:
+        return None
+    spans = []
+    for (c, _gkind, _off, card), (lo, hi) in zip(group_spec[0], bounds):
+        card_pad = kernels.pow2_bucket(card + 1)
+        if card_pad > RANK_HIST_CARD_LIMIT:
+            return None
+        spans.append(kernels.pow2_bucket(max(hi - lo + 1, 1), floor=1))
+    g_span = int(np.prod(spans, dtype=np.int64))
+    if kernels.pow2_bucket(g_span) <= kernels.DENSE_G_LIMIT:
+        return None
+    return tuple(("hist", c, "sv",
+                  ("hist", kernels.pow2_bucket(card + 1)))
+                 for (c, _gkind, _off, card) in group_spec[0])
+
+
+def _adaptive_kmax(matched: int, padded: int, total_docs: int,
+                   g_pad: int) -> int:
+    """Compaction capacity from measured selectivity (per-2048-row-block
+    Poisson mean plus tail headroom). NOTE: r (and hence kmax) is
+    pow2-bucketed from the phase-A matched count, so literal stability
+    holds only within a selectivity bucket — literals of the same
+    template whose match rates land in different pow2 buckets (or cross
+    the dense-flip threshold) still compile fresh variants."""
+    t = max(padded // kernels.CBLOCK, 1)
+    mu = matched * kernels.CBLOCK / max(total_docs, 1)
+    r = kernels.pow2_bucket(max(16, int(2 * mu + 8)))
+    if r > 128 and g_pad <= kernels.DENSE_G_LIMIT:
+        # barely-selective filter: the block-compaction einsum degrades
+        # past r=128 while the dense path's VMEM-tiled one-hot scan
+        # keeps a flat per-element rate — measured crossover on v5e
+        # (compact r<=128 beats dense g=512; compact r=256 loses)
+        return 0
+    return min(t * r, padded)
 
 
 def adaptive_phase_b_spec(group_spec, scout, matched: int, padded: int,
@@ -707,9 +759,8 @@ def adaptive_phase_b_spec(group_spec, scout, matched: int, padded: int,
     headroom; the kernel's overflow flag still escalates on skew).
     """
     gcols, _strides, _g_pad, agg_specs, _kmax = group_spec
-    kernel_gcols, finish_gcols, spans, extra = [], [], [], []
+    dims = []                    # (span, n_rank | None, payload)
     for c, dim in zip(gcols, scout):
-        card_pad = kernels.pow2_bucket(c[3] + 1)
         if dim[0] == "present":
             present = dim[1]
             if len(present) == 0:
@@ -717,20 +768,37 @@ def adaptive_phase_b_spec(group_spec, scout, matched: int, padded: int,
             span = kernels.pow2_bucket(
                 int(present[-1]) - int(present[0]) + 1, floor=1)
             n = kernels.pow2_bucket(len(present), floor=1)
-            if n < span:
-                rank = np.zeros(card_pad, np.int32)
-                rank[present] = np.arange(len(present), dtype=np.int32)
-                kernel_gcols.append((c[0], "idrank", 0, n))
-                finish_gcols.append((c[0], "idrank", present, n))
-                spans.append(n)
-                extra.append(rank)
-                continue
-            lo, hi = int(present[0]), int(present[-1])
+            dims.append((span, n if n < span else None, present))
         else:
             lo, hi = dim[1], dim[2]
             if hi < lo:
                 return None, None, (), True
             span = kernels.pow2_bucket(hi - lo + 1, floor=1)
+            dims.append((span, None, (lo, hi)))
+    # The rank remap's one-hot contraction is O(rows); "present" scouts
+    # only exist when drive_group_execution judged the hist rung worth
+    # its cost (ranked-layout escape), so here any pow2 shrink of the
+    # key space takes the rank remap.
+    g_span = int(np.prod([d[0] for d in dims], dtype=np.int64))
+    g_rank = int(np.prod([d[1] if d[1] is not None else d[0]
+                          for d in dims], dtype=np.int64))
+    use_rank = kernels.pow2_bucket(g_rank) < kernels.pow2_bucket(g_span)
+    kernel_gcols, finish_gcols, spans, extra = [], [], [], []
+    for c, (span, n, payload) in zip(gcols, dims):
+        card_pad = kernels.pow2_bucket(c[3] + 1)
+        if use_rank and n is not None:
+            present = payload
+            rank = np.zeros(card_pad, np.int32)
+            rank[present] = np.arange(len(present), dtype=np.int32)
+            kernel_gcols.append((c[0], "idrank", 0, n))
+            finish_gcols.append((c[0], "idrank", present, n))
+            spans.append(n)
+            extra.append(rank)
+            continue
+        if isinstance(payload, tuple):
+            lo, hi = payload
+        else:                        # present set, contiguous enough
+            lo, hi = int(payload[0]), int(payload[-1])
         kernel_gcols.append((c[0], "idoff", 0, span))
         finish_gcols.append((c[0], "idoff", lo, span))
         spans.append(span)
@@ -745,17 +813,7 @@ def adaptive_phase_b_spec(group_spec, scout, matched: int, padded: int,
     # stability holds only within a selectivity bucket — literals of the
     # same template whose match rates land in different pow2 buckets (or
     # cross the dense-flip threshold below) still compile fresh variants.
-    t = max(padded // kernels.CBLOCK, 1)
-    mu = matched * kernels.CBLOCK / max(total_docs, 1)
-    r = kernels.pow2_bucket(max(16, int(2 * mu + 8)))
-    if r > 128 and g_pad <= kernels.DENSE_G_LIMIT:
-        # barely-selective filter: the block-compaction einsum degrades
-        # past r=128 while the dense path's VMEM-tiled one-hot scan
-        # keeps a flat per-element rate — measured crossover on v5e
-        # (compact r<=128 beats dense g=512; compact r=256 loses)
-        kmax = 0
-    else:
-        kmax = min(t * r, padded)
+    kmax = _adaptive_kmax(matched, padded, total_docs, g_pad)
     kernel_spec = (kernel_gcols, strides, g_pad, agg_specs, kmax)
     finish_spec = (finish_gcols, strides, g_pad, agg_specs, kmax)
     return kernel_spec, finish_spec, tuple(extra), False
@@ -767,22 +825,25 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     `run(agg_specs, group_spec, extra_params)` dispatches the kernel and
     returns host outs (extra_params are appended after the filter
     operands). Filtered dictionary-keyed group-bys take the ADAPTIVE
-    TWO-PHASE path:
+    path:
 
-    - Phase A (scout): per group column, a matched-id histogram (one
-      MXU one-hot matmul) for small-cardinality dims or masked min/max
-      (streaming tree reductions) for wide ones, plus the matched count
-      — one dispatch.
+    - Phase A (scout): masked min/max of each group column's dictIds +
+      the matched count — streaming tree reductions, about one filter
+      evaluation.
+    - Phase A2 (conditional hist rung, adaptive_hist_specs): matched-id
+      histograms → exact present sets for the densifying rank remap,
+      dispatched only when the span key space would need the ranked
+      sort layout (> DENSE_G_LIMIT).
     - Phase B: group tables over the REMAPPED key space (product of the
-      scout's active spans — or of bucketed PRESENT counts where the
-      densifying rank remap wins), with MXU block-compaction sized from
-      the measured selectivity. Small remapped spaces take the dense
-      one-hot layout (device psum combine); big ones the ranked layout.
+      scout's active spans — or bucketed PRESENT counts where the rank
+      remap applies), with MXU block-compaction sized from the measured
+      selectivity. Small remapped spaces take the dense one-hot layout
+      (device psum combine); big ones the ranked layout.
 
     No sorts or row-scale scatters anywhere on the hot path — those are
     TPU's slow primitives. The one row-scale gather is the idrank
     remap's rank-vector lookup (kernels._group_key), paid only when the
-    scout proves it collapses the key space below the offset span.
+    hist rung proves it collapses the key space below the offset span.
     Non-eligible plans fall back to the compacted kernel with the kmax
     escalation ladder.
 
@@ -792,19 +853,18 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     pa = adaptive_phase_a_specs(group_spec) \
         if padded <= kernels.DENSE_ROWS_LIMIT else None
     if pa is not None:
-        specs, dim_kinds = pa
-        ha = run(specs, None, ())
-        scout, si = [], 0
-        for c, kind in zip(group_spec[0], dim_kinds):
-            if kind == "hist":
-                hist = np.asarray(ha[f"agg{si}"])[: c[3]]
-                scout.append(("present", np.nonzero(hist)[0]))
-                si += 1
-            else:
-                scout.append(("bounds", int(ha[f"agg{si}.min"]),
-                              int(ha[f"agg{si + 1}.max"])))
-                si += 2
+        ha = run(pa, None, ())
+        bounds = [(int(ha[f"agg{2 * i}.min"]), int(ha[f"agg{2 * i + 1}.max"]))
+                  for i in range(len(pa) // 2)]
         matched = int(ha["stats.num_docs_matched"])
+        scout = [("bounds", lo, hi) for lo, hi in bounds]
+        if matched > 0:
+            ph = adaptive_hist_specs(group_spec, bounds)
+            if ph is not None:
+                hh = run(ph, None, ())
+                scout = [("present",
+                          np.nonzero(np.asarray(hh[f"agg{i}"])[: c[3]])[0])
+                         for i, c in enumerate(group_spec[0])]
         kspec, fspec, extra, empty = adaptive_phase_b_spec(
             group_spec, scout, matched, padded, total_docs)
         if empty:

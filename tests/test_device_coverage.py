@@ -305,19 +305,24 @@ def test_adaptive_dense_remap_group_by(wide_group_setup):
     adaptive path (phase-A histograms → remapped dense tables) must be
     taken and agree with the host executor."""
     from pinot_tpu.parallel import make_mesh
-    from pinot_tpu.query.plan import (adaptive_phase_a_specs,
+    from pinot_tpu.query.plan import (adaptive_hist_specs,
+                                      adaptive_phase_a_specs,
                                       adaptive_phase_b_spec)
     segs, merged = wide_group_setup
     plan = _plan(segs[0], "SELECT SUM(v), COUNT(*) FROM w "
                           "WHERE a BETWEEN 'a100' AND 'a105' "
                           "GROUP BY a, b TOP 20000")
     pa = adaptive_phase_a_specs(plan.group_spec)
-    assert pa is not None
-    specs, dim_kinds = pa
-    # small-card dims scout histograms (exact present sets for the rank
-    # remap); this fixture's cards fit the histogram budget
-    assert [s[1] for s in specs] == ["a", "b"]
-    assert dim_kinds == ("hist", "hist")
+    # phase A scouts min/max bounds per dim (streaming-rate)
+    assert pa is not None and [s[1] for s in pa] == ["a", "a", "b", "b"]
+    assert {s[0] for s in pa} == {"min", "max"}
+    # hist rung gating: a selective filter with a small span space skips
+    # the histograms (their one-hots are O(rows)); a span space needing
+    # the ranked layout dispatches them
+    assert adaptive_hist_specs(
+        plan.group_spec, [(100, 105), (0, 249)]) is None
+    ph = adaptive_hist_specs(plan.group_spec, [(0, 299), (0, 249)])
+    assert ph is not None and [s[0] for s in ph] == ["hist", "hist"]
     # simulated scout: a's matched ids contiguous [100..105], b full
     # range — contiguous actives keep the OFFSET remap
     scout = [("present", np.arange(100, 106)),

@@ -299,3 +299,48 @@ def test_random_selection_queries(setup):
                 ovals = np.sort(oracle.vals(ocol, m).astype(np.float64))
                 exp_top = ovals[::-1][:limit] if desc else ovals[:limit]
                 assert vals == [float(v) for v in exp_top], (pql, label)
+
+
+def test_random_mv_group_by_queries(setup):
+    """MV group keys and valuein under random filters — engine (device +
+    host) vs an inline expansion oracle (aggregateGroupByMV semantics)."""
+    engine, host_engine, oracle = setup
+    gen = Gen(random.Random(SEED + 7), oracle)
+    all_pos = sorted({v for lst in oracle.cols["position"] for v in lst})
+    for qi in range(8):
+        where, m = gen.where()
+        if gen.rng.random() < 0.5:
+            picks = gen.rng.sample(all_pos, gen.rng.randint(2, 5))
+            mvkey = "valuein(position, %s)" % \
+                ", ".join("'%s'" % p for p in picks)
+            allowed = set(picks)
+        else:
+            mvkey, allowed = "position", None
+        extra_sv = gen.rng.choice([None, "league"])
+        dims = [mvkey] + ([extra_sv] if extra_sv else [])
+        pql = ("SELECT COUNT(*), SUM(hits) FROM baseballStats" + where +
+               " GROUP BY " + ", ".join(dims) + " TOP 5000")
+        exp = {}
+        for i, lst in enumerate(oracle.cols["position"]):
+            if not m[i]:
+                continue
+            for v in lst:
+                if allowed is not None and v not in allowed:
+                    continue
+                key = (v,) + ((str(oracle.cols["league"][i]),)
+                              if extra_sv else ())
+                e2 = exp.setdefault(key, [0, 0.0])
+                e2[0] += 1
+                e2[1] += float(oracle.cols["hits"][i])
+        for e, label in [(engine, "device"), (host_engine, "host")]:
+            resp = e.query(pql)
+            assert not resp.exceptions, (pql, label, resp.exceptions)
+            got_cnt = {tuple(str(k) for k in g["group"]):
+                       int(float(g["value"]))
+                       for g in resp.aggregation_results[0].group_by_result}
+            got_sum = {tuple(str(k) for k in g["group"]): float(g["value"])
+                       for g in resp.aggregation_results[1].group_by_result}
+            assert got_cnt == {k: v[0] for k, v in exp.items()}, (pql, label)
+            for k, v in exp.items():
+                assert got_sum[k] == pytest.approx(v[1], rel=1e-9), \
+                    (pql, label, k)
