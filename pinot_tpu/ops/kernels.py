@@ -949,7 +949,68 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs,
     return outs
 
 
+def _expand_mv_group(group_spec, cols, mask):
+    """Row-space expansion for MV group keys: one row per (doc, entry)
+    cross-combination across all MV key columns (reference parity:
+    DefaultGroupByExecutor.aggregateGroupByMV — a doc contributes once
+    per value combination, and its metrics repeat per combination).
+
+    Returns (group_spec', cols', mask') with every "mvids" gcol
+    rewritten to a flattened "ids" lane over rows*W rows (W = product
+    of the MV columns' padded entry widths, static from lane shapes);
+    padding entries (id == cardinality) mask their rows out. Only
+    row-scale lanes the group machinery reads are expanded; dictionary
+    value tables pass through. W multiplies the row count, so this is
+    reserved for MV group-bys (never on the SSB hot path)."""
+    gcols, strides, g_pad, agg_specs, kmax = group_spec
+    n = mask.shape[0]
+    widths = {c: cols[f"{c}.mv"].shape[-1]
+              for (c, gkind, _o, _card) in gcols if gkind == "mvids"}
+    total_w = int(np.prod(list(widths.values()), dtype=np.int64))
+    # mixed-radix decomposition of the cross index over the mv widths
+    entry_idx, stride = {}, 1
+    for c, w in widths.items():
+        entry_idx[c] = (np.arange(total_w) // stride) % w
+        stride *= w
+
+    def rep1(lane):                       # [n] -> [n * total_w]
+        return jnp.broadcast_to(lane[:, None],
+                                (n, total_w)).reshape(-1)
+
+    cols2, mask2, gcols2 = {}, rep1(mask), []
+    for (c, gkind, off, card) in gcols:
+        if gkind == "mvids":
+            flat = cols[f"{c}.mv"][:, entry_idx[c]].reshape(-1)
+            cols2[f"{c}.ids"] = flat
+            mask2 = mask2 & (flat < card)
+            gcols2.append((c, "ids", off, card))
+        else:
+            gcols2.append((c, gkind, off, card))
+    for key, lane in cols.items():
+        if key in cols2:
+            continue
+        if key.endswith(".mv"):
+            w = lane.shape[-1]
+            cols2[key] = jnp.broadcast_to(
+                lane[:, None, :], (n, total_w, w)).reshape(-1, w)
+        elif key.endswith(".parts"):      # [n_parts, n]
+            cols2[key] = jnp.broadcast_to(
+                lane[:, :, None],
+                lane.shape + (total_w,)).reshape(lane.shape[0], -1)
+        elif key.endswith(".vals"):       # dictionary value table
+            cols2[key] = lane
+        else:                             # .ids / .raw / .vlane: [n]
+            cols2[key] = rep1(lane)
+    # compaction capacity scales with the expansion (the escalation
+    # ladder still covers skew/overflow)
+    kmax2 = min(kmax * total_w, n * total_w) if kmax else 0
+    spec2 = (tuple(gcols2), strides, g_pad, agg_specs, kmax2)
+    return spec2, cols2, mask2
+
+
 def _group_outputs(group_spec, cols, mask, num_docs, params=None):
+    if any(g[1] == "mvids" for g in group_spec[0]):
+        group_spec, cols, mask = _expand_mv_group(group_spec, cols, mask)
     gcols, strides, g_pad, agg_specs, kmax = group_spec
     if kmax:
         return _group_outputs_compacted(group_spec, cols, mask, num_docs,

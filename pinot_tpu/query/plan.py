@@ -500,6 +500,16 @@ class InstancePlanMaker:
                 cards.append(cm.cardinality)
                 needed[(c, "ids")] = None
                 continue
+            if cm.has_dictionary and not cm.single_value:
+                # MV group key: the kernel expands the row space to one
+                # row per (doc, entry) cross-combination before the
+                # group machinery (kernels._expand_mv_group — reference
+                # parity: DefaultGroupByExecutor.aggregateGroupByMV)
+                gcols.append((c, "mvids", 0, cm.cardinality))
+                value_tables.append(None)
+                cards.append(cm.cardinality)
+                needed[(c, "mv")] = None
+                continue
             if not cm.has_dictionary and cm.single_value and \
                     cm.data_type.np_dtype.kind in "iu" and \
                     cm.min_value is not None and \

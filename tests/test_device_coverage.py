@@ -420,3 +420,67 @@ def test_rank_remap_scattered_actives_end_to_end(wide_group_setup):
                    for g in resp.aggregation_results[1].group_by_result}
         assert got_sum == {k: float(v[0]) for k, v in exp.items()}, label
         assert got_cnt == {k: v[1] for k, v in exp.items()}, label
+
+
+def test_mv_group_by_takes_device_path(wide_group_setup):
+    """MV dictionary group keys plan as 'mvids' (kernel row expansion,
+    aggregateGroupByMV parity) — no host fallback, and the device,
+    mesh, and host paths agree."""
+    import os
+    import tempfile
+
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (FieldSpec, FieldType, Schema,
+                                         dimension, metric)
+    from pinot_tpu.parallel import make_mesh
+
+    base = tempfile.mkdtemp()
+    rng = np.random.default_rng(9)
+    n = 4096
+    schema = Schema("mvw", [dimension("k", DataType.STRING),
+                            FieldSpec("tags", DataType.STRING,
+                                      FieldType.DIMENSION,
+                                      single_value=False),
+                            metric("v", DataType.INT)])
+    kvals = np.array([f"k{i:02d}" for i in range(40)], dtype=object)
+    tvals = np.array([f"t{i:02d}" for i in range(12)], dtype=object)
+    segs, datas = [], []
+    for s in range(2):
+        cols = {"k": kvals[rng.integers(0, 40, n)],
+                "tags": [list(rng.choice(tvals, rng.integers(1, 4),
+                                         replace=False))
+                         for _ in range(n)],
+                "v": rng.integers(0, 1000, n).astype(np.int32)}
+        d = os.path.join(base, f"s{s}")
+        os.makedirs(d)
+        SegmentCreator(schema, None, segment_name=f"mvw{s}",
+                       fixed_dictionaries={"k": kvals, "tags": tvals}
+                       ).build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        datas.append(cols)
+
+    pql = ("SELECT COUNT(*), SUM(v) FROM mvw WHERE v >= 100 "
+           "GROUP BY tags, k TOP 5000")
+    plan = _plan(segs[0], pql)
+    assert plan.group_spec is not None
+    assert [g[1] for g in plan.group_spec[0]] == ["mvids", "ids"]
+
+    exp = {}
+    for cols in datas:
+        for lst, k, v in zip(cols["tags"], cols["k"], cols["v"]):
+            if v >= 100:
+                for t in lst:
+                    e = exp.setdefault((t, k), [0, 0])
+                    e[0] += 1
+                    e[1] += int(v)
+    for engine, label in ((QueryEngine(segs), "device"),
+                          (QueryEngine(segs, mesh=make_mesh()), "mesh"),
+                          (QueryEngine(segs, use_device=False), "host")):
+        resp = engine.query(pql)
+        assert not resp.exceptions, (label, resp.exceptions)
+        got_cnt = {tuple(g["group"]): int(float(g["value"]))
+                   for g in resp.aggregation_results[0].group_by_result}
+        got_sum = {tuple(g["group"]): float(g["value"])
+                   for g in resp.aggregation_results[1].group_by_result}
+        assert got_cnt == {k: v[0] for k, v in exp.items()}, label
+        assert got_sum == {k: float(v[1]) for k, v in exp.items()}, label
