@@ -60,6 +60,27 @@ class StreamMetadataProvider:
         raise NotImplementedError
 
 
+class StreamLevelConsumer:
+    """High-level (HLC) group consumer SPI (parity:
+    core/realtime/stream/StreamLevelConsumer used by
+    HLRealtimeSegmentDataManager.java:61): the stream, not the server,
+    owns partition assignment; the server just drains messages and
+    checkpoints a consumer-group position after each durable flush."""
+
+    def next_messages(self, max_count: int) -> List[StreamMessage]:
+        """Up to max_count payload messages across partitions; empty
+        list when nothing is available right now."""
+        raise NotImplementedError
+
+    def checkpoint(self) -> Dict[int, int]:
+        """Current per-partition positions covering every message this
+        consumer has returned (persist AFTER the rows are durable)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
 class StreamConsumerFactory:
     def create_partition_consumer(self, config: StreamConfig,
                                   partition: int) -> PartitionLevelConsumer:
@@ -67,6 +88,13 @@ class StreamConsumerFactory:
 
     def create_metadata_provider(self, config: StreamConfig
                                  ) -> StreamMetadataProvider:
+        raise NotImplementedError
+
+    def create_stream_consumer(self, config: StreamConfig,
+                               checkpoint: Optional[Dict[int, int]] = None
+                               ) -> StreamLevelConsumer:
+        """HLC entry: a group consumer resuming from `checkpoint`
+        (per-partition positions) or the config's offset criteria."""
         raise NotImplementedError
 
 
@@ -142,6 +170,49 @@ class MemoryStreamConsumerFactory(StreamConsumerFactory):
                                  ) -> StreamMetadataProvider:
         return _MemoryMetadataProvider(self.stream)
 
+    def create_stream_consumer(self, config: StreamConfig,
+                               checkpoint: Optional[Dict[int, int]] = None
+                               ) -> StreamLevelConsumer:
+        return _MemoryStreamLevelConsumer(self.stream, config, checkpoint,
+                                          self.batch_size)
+
+
+class _MemoryStreamLevelConsumer(StreamLevelConsumer):
+    """Round-robin group consumer over the in-memory log."""
+
+    def __init__(self, stream: MemoryStream, config: StreamConfig,
+                 checkpoint: Optional[Dict[int, int]], batch_size: int):
+        self.stream = stream
+        self.batch_size = batch_size
+        self._pos: Dict[int, int] = {}
+        for p in range(stream.num_partitions):
+            if checkpoint and p in checkpoint:
+                self._pos[p] = int(checkpoint[p])
+            elif config.offset_criteria == SMALLEST_OFFSET:
+                self._pos[p] = 0
+            else:
+                self._pos[p] = stream.latest_offset(p)
+        self._next_part = 0
+
+    def next_messages(self, max_count: int) -> List[StreamMessage]:
+        out: List[StreamMessage] = []
+        parts = self.stream.num_partitions
+        for _ in range(parts):
+            if len(out) >= max_count:
+                break
+            p = self._next_part
+            self._next_part = (self._next_part + 1) % parts
+            msgs = self.stream.read(p, self._pos[p],
+                                    min(self.batch_size,
+                                        max_count - len(out)))
+            if msgs:
+                self._pos[p] = msgs[-1].offset + 1
+                out.extend(msgs)
+        return out
+
+    def checkpoint(self) -> Dict[int, int]:
+        return dict(self._pos)
+
 
 class _MemoryPartitionConsumer(PartitionLevelConsumer):
     def __init__(self, stream: MemoryStream, partition: int,
@@ -205,3 +276,29 @@ class FlakyConsumerFactory(StreamConsumerFactory):
     def create_metadata_provider(self, config: StreamConfig
                                  ) -> StreamMetadataProvider:
         return self.inner.create_metadata_provider(config)
+
+    def create_stream_consumer(self, config: StreamConfig,
+                               checkpoint: Optional[Dict[int, int]] = None
+                               ) -> StreamLevelConsumer:
+        import random
+        inner = self.inner.create_stream_consumer(config, checkpoint)
+        rng = random.Random(self.seed)
+
+        class FlakyHL(StreamLevelConsumer):
+            def next_messages(self, max_count):
+                roll = rng.random()
+                if roll < 0.15:
+                    raise RuntimeError("flaky consumer exception")
+                msgs = inner.next_messages(max_count)
+                if roll < 0.3 and msgs:
+                    m = msgs[0]
+                    msgs[0] = StreamMessage(m.offset, b"\xff garbage")
+                return msgs
+
+            def checkpoint(self):
+                return inner.checkpoint()
+
+            def close(self):
+                inner.close()
+
+        return FlakyHL()
