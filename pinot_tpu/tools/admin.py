@@ -225,61 +225,89 @@ def cmd_quickstart(args) -> int:
 
     Parity: tools/Quickstart.java (offline baseballStats quickstart).
     """
+    import os
     import tempfile
 
-    sys.path.insert(0, "tests")  # reuse the demo fixture generators
-    import numpy as np
-
-    from pinot_tpu.common.datatype import DataType
-    from pinot_tpu.common.schema import Schema, dimension, metric
     from pinot_tpu.common.table_config import TableConfig
     from pinot_tpu.segment.creator import SegmentCreator
     from pinot_tpu.tools.cluster import EmbeddedCluster
 
     work = args.dir or tempfile.mkdtemp(prefix="pinot_tpu_quickstart_")
-    schema = Schema("baseballStats", [
+    schema = _demo_schema()
+    config = TableConfig("baseballStats")
+    cluster = EmbeddedCluster(work, num_servers=2, tcp=True, http=True)
+    cluster.add_schema(schema)
+    cluster.add_table(config)
+    for i in range(2):
+        rows = _demo_rows(args.rows, seed=7 + i, year_lo=1990,
+                          year_hi=2020)
+        d = os.path.join(work, f"quickstart_{i}")
+        SegmentCreator(schema, config,
+                       segment_name=f"quickstart_{i}").build(rows, d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+    print(f"Controller REST: http://127.0.0.1:{cluster.controller_port}")
+    print(f"Broker query:    http://127.0.0.1:{cluster.broker_port}/query")
+    _run_samples(cluster, (
+        "SELECT COUNT(*) FROM baseballStats",
+        "SELECT SUM(runs) FROM baseballStats WHERE league = 'AL'",
+        "SELECT SUM(hits), COUNT(*) FROM baseballStats "
+        "GROUP BY teamID TOP 5"))
+    return _hold_or_stop(cluster, args.exit_after)
+
+
+def _demo_schema():
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (Schema, TimeUnit, dimension,
+                                         metric, time_field)
+    return Schema("baseballStats", [
         dimension("playerName", DataType.STRING),
         dimension("teamID", DataType.STRING),
         dimension("league", DataType.STRING),
         metric("runs", DataType.INT),
         metric("hits", DataType.LONG),
-        dimension("yearID", DataType.INT),
+        # a real TIME field: segments record start/end times and the
+        # hybrid quickstart's broker computes a true time boundary
+        time_field("yearID", DataType.INT, TimeUnit.DAYS),
     ])
-    config = TableConfig("baseballStats")
-    cluster = EmbeddedCluster(work, num_servers=2, tcp=True, http=True)
-    cluster.add_schema(schema)
-    cluster.add_table(config)
-    rng = np.random.default_rng(7)
-    n = args.rows
-    import os
-    for i in range(2):
-        cols = {
-            "playerName": np.array(
-                [f"player{j:04d}" for j in
-                 rng.integers(0, 500, n)], dtype=object),
-            "teamID": np.array([f"T{j:02d}" for j in
-                                rng.integers(0, 30, n)], dtype=object),
-            "league": np.array([("AL", "NL")[j] for j in
-                                rng.integers(0, 2, n)], dtype=object),
-            "runs": rng.integers(0, 150, n).astype(np.int32),
-            "hits": rng.integers(0, 250, n).astype(np.int64),
-            "yearID": rng.integers(1990, 2020, n).astype(np.int32),
-        }
-        d = os.path.join(work, f"quickstart_{i}")
-        SegmentCreator(schema, config,
-                       segment_name=f"quickstart_{i}").build(cols, d)
-        cluster.upload_segment("baseballStats_OFFLINE", d)
-    print(f"Controller REST: http://127.0.0.1:{cluster.controller_port}")
-    print(f"Broker query:    http://127.0.0.1:{cluster.broker_port}/query")
-    for q in (
-            "SELECT COUNT(*) FROM baseballStats",
-            "SELECT SUM(runs) FROM baseballStats WHERE league = 'AL'",
-            "SELECT SUM(hits), COUNT(*) FROM baseballStats "
-            "GROUP BY teamID TOP 5"):
+
+
+def _demo_rows(n: int, seed: int, year_lo: int, year_hi: int):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [{
+        "playerName": f"player{int(j):04d}",
+        "teamID": f"T{int(t):02d}",
+        "league": ("AL", "NL")[int(lg)],
+        "runs": int(r), "hits": int(h), "yearID": int(y),
+    } for j, t, lg, r, h, y in zip(
+        rng.integers(0, 500, n), rng.integers(0, 30, n),
+        rng.integers(0, 2, n), rng.integers(0, 150, n),
+        rng.integers(0, 250, n), rng.integers(year_lo, year_hi, n))]
+
+
+def _wait_count(cluster, expect: int, timeout_s: float = 60.0) -> int:
+    import time
+    deadline = time.monotonic() + timeout_s
+    got = -1
+    while time.monotonic() < deadline:
+        resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+        if not resp.exceptions:
+            got = int(resp.aggregation_results[0].value)
+            if got >= expect:
+                break
+        time.sleep(0.1)
+    return got
+
+
+def _run_samples(cluster, queries) -> None:
+    for q in queries:
         resp = cluster.query(q)
         print(f"\n> {q}")
         print(json.dumps(resp.to_json(), indent=2)[:800])
-    if args.exit_after:
+
+
+def _hold_or_stop(cluster, exit_after: bool) -> int:
+    if exit_after:
         cluster.stop()
         return 0
     print("\nquickstart cluster running — Ctrl-C to stop")
@@ -290,6 +318,130 @@ def cmd_quickstart(args) -> int:
     except KeyboardInterrupt:
         cluster.stop()
     return 0
+
+
+def _realtime_table_config(factory_name: str, topic: str, flush_rows: int):
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig, TableConfig,
+                                               TableType)
+    idx = IndexingConfig(stream_configs={
+        "stream.factory.name": factory_name,
+        "stream.topic.name": topic,
+        "realtime.segment.flush.threshold.size": str(flush_rows),
+        "realtime.segment.flush.threshold.time.ms": "600000000",
+    })
+    return TableConfig("baseballStats", table_type=TableType.REALTIME,
+                       indexing_config=idx,
+                       segments_config=SegmentsConfig(
+                           replication=1, time_column_name="yearID"))
+
+
+def cmd_realtime_quickstart(args) -> int:
+    """Embedded cluster consuming a live in-process stream.
+
+    Parity: tools/RealtimeQuickStart.java (meetup-RSVP → Kafka demo) —
+    here rows stream through the in-memory log into LLC consumers and
+    are queryable mid-consumption, before any segment commits.
+    """
+    import tempfile
+
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    work = args.dir or tempfile.mkdtemp(prefix="pinot_tpu_rt_quickstart_")
+    stream = MemoryStream("events", num_partitions=2)
+    registry.register_stream_factory(
+        "quickstart_mem", MemoryStreamConsumerFactory(stream,
+                                                      batch_size=200))
+    cluster = EmbeddedCluster(work, num_servers=2, tcp=True, http=True)
+    cluster.add_schema(_demo_schema())
+    cluster.add_table(_realtime_table_config(
+        "quickstart_mem", "events", flush_rows=max(args.rows // 3, 100)))
+    for row in _demo_rows(args.rows, seed=11, year_lo=2015, year_hi=2026):
+        stream.publish(row)
+    got = _wait_count(cluster, args.rows)
+    if got < args.rows:
+        print(f"ERROR: consumed only {got}/{args.rows} rows before the "
+              "timeout", file=sys.stderr)
+        cluster.stop()
+        return 1
+    print(f"consumed {got}/{args.rows} rows "
+          f"(some segments already committed, the tail is CONSUMING)")
+    print(f"Controller REST: http://127.0.0.1:{cluster.controller_port}")
+    print(f"Broker query:    http://127.0.0.1:{cluster.broker_port}/query")
+    _run_samples(cluster, (
+        "SELECT COUNT(*) FROM baseballStats",
+        "SELECT SUM(runs) FROM baseballStats WHERE yearID >= 2020",
+        "SELECT COUNT(*) FROM baseballStats GROUP BY league TOP 5"))
+    return _hold_or_stop(cluster, args.exit_after)
+
+
+def cmd_hybrid_quickstart(args) -> int:
+    """Embedded HYBRID cluster: an offline table with historical segments
+    plus a realtime table consuming recent rows; the broker splits
+    queries at the time boundary and merges both sides.
+
+    Parity: tools/HybridQuickstart.java.
+    """
+    import os
+    import tempfile
+
+    from pinot_tpu.common.table_config import SegmentsConfig, TableConfig
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    work = args.dir or tempfile.mkdtemp(prefix="pinot_tpu_hy_quickstart_")
+    schema = _demo_schema()
+    cluster = EmbeddedCluster(work, num_servers=2, tcp=True, http=True)
+    cluster.add_schema(schema)
+    # offline side: historical years
+    cluster.add_table(TableConfig(
+        "baseballStats",
+        segments_config=SegmentsConfig(replication=1,
+                                       time_column_name="yearID")))
+    n_off = args.rows
+    rows_off = _demo_rows(n_off, seed=5, year_lo=1990, year_hi=2015)
+    d = os.path.join(work, "hybrid_offline_0")
+    SegmentCreator(schema, None, segment_name="hybrid_offline_0"
+                   ).build(rows_off, d)
+    cluster.upload_segment("baseballStats_OFFLINE", d)
+    # realtime side: recent years streaming in, OVERLAPPING the last
+    # offline year — the broker's time boundary (max offline end time
+    # minus one day) serves each row from exactly one side (offline
+    # <= boundary, realtime > boundary), so the overlap never double
+    # counts (HelixExternalViewBasedTimeBoundaryService parity)
+    stream = MemoryStream("events", num_partitions=2)
+    registry.register_stream_factory(
+        "quickstart_mem_hy", MemoryStreamConsumerFactory(stream,
+                                                         batch_size=200))
+    cluster.add_table(_realtime_table_config(
+        "quickstart_mem_hy", "events", flush_rows=10 ** 9))
+    n_rt = max(args.rows // 2, 100)
+    rows_rt = _demo_rows(n_rt, seed=6, year_lo=2013, year_hi=2026)
+    for row in rows_rt:
+        stream.publish(row)
+    boundary = max(r["yearID"] for r in rows_off) - 1
+    expected = sum(1 for r in rows_off if r["yearID"] <= boundary) + \
+        sum(1 for r in rows_rt if r["yearID"] > boundary)
+    got = _wait_count(cluster, expected)
+    if got != expected:
+        print(f"ERROR: hybrid table serving {got} rows, expected "
+              f"{expected} before the timeout", file=sys.stderr)
+        cluster.stop()
+        return 1
+    print(f"hybrid table serving {got} rows "
+          f"({n_off} offline + {n_rt} realtime, overlapping years "
+          f"deduplicated at the time boundary {boundary})")
+    _run_samples(cluster, (
+        "SELECT COUNT(*) FROM baseballStats",
+        "SELECT MIN(yearID), MAX(yearID) FROM baseballStats",
+        "SELECT SUM(hits) FROM baseballStats WHERE yearID >= 2010"))
+    return _hold_or_stop(cluster, args.exit_after)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -402,6 +554,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--exit-after", action="store_true",
                     help="stop the cluster after the sample queries")
     sp.set_defaults(fn=cmd_quickstart)
+
+    for name, fn, default_rows in (
+            ("RealtimeQuickstart", cmd_realtime_quickstart, 3000),
+            ("HybridQuickstart", cmd_hybrid_quickstart, 5000)):
+        sp = sub.add_parser(name, help=f"embedded {name.lower()} demo")
+        sp.add_argument("--rows", type=int, default=default_rows)
+        sp.add_argument("--dir")
+        sp.add_argument("--exit-after", action="store_true")
+        sp.set_defaults(fn=fn)
     return p
 
 

@@ -112,3 +112,27 @@ def test_admin_cli_end_to_end(http_cluster, capsys):
         assert resp["aggregationResults"][0]["value"] == "0"
     else:
         assert resp.get("exceptions"), resp
+
+
+def test_realtime_quickstart_command(capsys):
+    """RealtimeQuickStart parity: boots, consumes the demo stream, and
+    answers the sample queries."""
+    from pinot_tpu.tools.admin import main
+    rc = main(["RealtimeQuickstart", "--rows", "600", "--exit-after"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "consumed 600/600 rows" in out
+    # the three sample queries printed real responses
+    assert out.count("> SELECT") == 3
+    assert "aggregationResults" in out
+
+
+def test_hybrid_quickstart_command(capsys):
+    """HybridQuickstart parity: offline + realtime sides merge at the
+    time boundary, overlapping years deduplicated."""
+    from pinot_tpu.tools.admin import main
+    rc = main(["HybridQuickstart", "--rows", "400", "--exit-after"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "deduplicated at the time boundary" in out
+    assert out.count("> SELECT") == 3
