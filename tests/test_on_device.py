@@ -63,16 +63,90 @@ print("DEVICE_RESULT " + json.dumps(out))
 """
 
 
-def test_device_numerics_match_oracle():
+def _run_driver(driver_src: str) -> dict:
+    """Run a device driver in a subprocess with the cpu-forcing env
+    stripped; return the parsed DEVICE_RESULT payload."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     proc = subprocess.run([sys.executable, "-c",
-                           _DRIVER.format(repo=repo)],
+                           driver_src.format(repo=repo)],
                           capture_output=True, text=True, timeout=600,
                           env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = [ln for ln in proc.stdout.splitlines()
             if ln.startswith("DEVICE_RESULT ")][-1]
-    out = json.loads(line[len("DEVICE_RESULT "):])
+    return json.loads(line[len("DEVICE_RESULT "):])
+
+
+def test_device_numerics_match_oracle():
+    out = _run_driver(_DRIVER)
+    assert all(out["checks"]), out
+
+
+_DRIVER2 = r"""
+import json, sys, tempfile, os
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.schema import (FieldSpec, FieldType, Schema,
+                                     dimension, metric)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.engine import QueryEngine
+out = {{"platform": jax.devices()[0].platform}}
+with tempfile.TemporaryDirectory() as td:
+    rng = np.random.default_rng(31)
+    n = 8192
+    schema = Schema("t", [dimension("a", DataType.STRING),
+                          dimension("b", DataType.STRING),
+                          FieldSpec("tags", DataType.STRING,
+                                    FieldType.DIMENSION,
+                                    single_value=False),
+                          metric("v", DataType.INT)])
+    avals = np.array([f"a{{i:03d}}" for i in range(300)], dtype=object)
+    bvals = np.array([f"b{{i:03d}}" for i in range(250)], dtype=object)
+    tvals = np.array([f"t{{i:02d}}" for i in range(10)], dtype=object)
+    segs = []
+    for s in range(2):
+        cols = {{"a": avals[rng.integers(0, 300, n)],
+                "b": bvals[rng.integers(0, 250, n)],
+                "tags": [list(rng.choice(tvals, rng.integers(1, 4),
+                                         replace=False))
+                         for _ in range(n)],
+                "v": rng.integers(0, 10000, n).astype(np.int32)}}
+        d = os.path.join(td, f"s{{s}}"); os.makedirs(d)
+        SegmentCreator(schema, None, segment_name=f"s{{s}}",
+                       fixed_dictionaries={{"a": avals, "b": bvals,
+                                           "tags": tvals}}).build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+    dev = QueryEngine(segs)
+    host = QueryEngine(segs, use_device=False)
+    checks = []
+    # scattered-IN ranked-escape (hist scout + idrank one-hot remap)
+    q1 = ("SELECT SUM(v), COUNT(*) FROM t WHERE a IN "
+          "('a003','a091','a155','a202','a249') GROUP BY a, b TOP 20000")
+    # device MV group-by (in-kernel row expansion)
+    q2 = "SELECT COUNT(*), SUM(v) FROM t WHERE v >= 2000 GROUP BY tags TOP 100"
+    for pql in (q1, q2):
+        rd, rh = dev.query(pql), host.query(pql)
+        checks.append(not rd.exceptions and not rh.exceptions)
+        for i in range(2):
+            gd = {{tuple(g["group"]): float(g["value"])
+                  for g in rd.aggregation_results[i].group_by_result}}
+            gh = {{tuple(g["group"]): float(g["value"])
+                  for g in rh.aggregation_results[i].group_by_result}}
+            checks.append(gd == gh and len(gd) > 0)
+    out["checks"] = [bool(c) for c in checks]
+print("DEVICE_RESULT " + json.dumps(out))
+"""
+
+
+def test_device_adaptive_and_mv_group_paths():
+    """Real-chip agreement for the round-2 additions: the rank-remap
+    adaptive group-by (scattered IN over a wide key space) and the MV
+    group-key row expansion — TPU bf16/f32 numerics vs the host
+    executor."""
+    out = _run_driver(_DRIVER2)
     assert all(out["checks"]), out
