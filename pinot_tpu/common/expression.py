@@ -58,6 +58,27 @@ def is_expression(col: str) -> bool:
     return "(" in col
 
 
+def valuein_parts(expr_or_text):
+    """(column, literal texts) when the expression is
+    ``valuein(col, lit, ...)``; None when it isn't a valuein call.
+    Malformed valuein calls (non-column first argument, non-literal
+    values) raise ExpressionError — both executors share this so the
+    device path can never silently accept what the host rejects."""
+    expr = parse_expression(expr_or_text) \
+        if isinstance(expr_or_text, str) else expr_or_text
+    if not (isinstance(expr, Call) and expr.func == "valuein"):
+        return None
+    if not expr.args or not isinstance(expr.args[0], Col):
+        raise ExpressionError("valuein needs a column as its first "
+                              "argument")
+    lits = []
+    for a in expr.args[1:]:
+        if not isinstance(a, Lit):
+            raise ExpressionError("valuein values must be literals")
+        lits.append(a.text)
+    return expr.args[0].name, tuple(lits)
+
+
 # ---------------------------------------------------------------------------
 # Parsing (canonical text form: func(arg,arg,...), strings '-quoted)
 # ---------------------------------------------------------------------------

@@ -949,23 +949,27 @@ def _group_outputs_compacted(group_spec, cols, mask, num_docs,
     return outs
 
 
-def _expand_mv_group(group_spec, cols, mask):
+def _expand_mv_group(group_spec, cols, mask, params=None):
     """Row-space expansion for MV group keys: one row per (doc, entry)
     cross-combination across all MV key columns (reference parity:
     DefaultGroupByExecutor.aggregateGroupByMV — a doc contributes once
     per value combination, and its metrics repeat per combination).
 
-    Returns (group_spec', cols', mask') with every "mvids" gcol
+    Returns (group_spec', cols', mask') with every "mvids"/"mvin" gcol
     rewritten to a flattened "ids" lane over rows*W rows (W = product
     of the MV columns' padded entry widths, static from lane shapes);
-    padding entries (id == cardinality) mask their rows out. Only
-    row-scale lanes the group machinery reads are expanded; dictionary
-    value tables pass through. W multiplies the row count, so this is
-    reserved for MV group-bys (never on the SSB hot path)."""
+    padding entries (id == cardinality) mask their rows out, and "mvin"
+    dims (valuein group keys) additionally mask entries outside their
+    allowed-value member vector — a RUNTIME operand popped from
+    `params` in gcol order. Only row-scale lanes the group machinery
+    reads are expanded; dictionary value tables pass through. W
+    multiplies the row count, so this is reserved for MV group-bys
+    (never on the SSB hot path)."""
     gcols, strides, g_pad, agg_specs, kmax = group_spec
     n = mask.shape[0]
     widths = {c: cols[f"{c}.mv"].shape[-1]
-              for (c, gkind, _o, _card) in gcols if gkind == "mvids"}
+              for (c, gkind, _o, _card) in gcols
+              if gkind in ("mvids", "mvin")}
     total_w = int(np.prod(list(widths.values()), dtype=np.int64))
     # mixed-radix decomposition of the cross index over the mv widths
     entry_idx, stride = {}, 1
@@ -979,10 +983,14 @@ def _expand_mv_group(group_spec, cols, mask):
 
     cols2, mask2, gcols2 = {}, rep1(mask), []
     for (c, gkind, off, card) in gcols:
-        if gkind == "mvids":
+        if gkind in ("mvids", "mvin"):
             flat = cols[f"{c}.mv"][:, entry_idx[c]].reshape(-1)
             cols2[f"{c}.ids"] = flat
             mask2 = mask2 & (flat < card)
+            if gkind == "mvin":
+                member = params.pop(0)     # bool [card_pad], pad False
+                mask2 = mask2 & member[
+                    jnp.clip(flat, 0, member.shape[0] - 1)]
             gcols2.append((c, "ids", off, card))
         else:
             gcols2.append((c, gkind, off, card))
@@ -1009,8 +1017,9 @@ def _expand_mv_group(group_spec, cols, mask):
 
 
 def _group_outputs(group_spec, cols, mask, num_docs, params=None):
-    if any(g[1] == "mvids" for g in group_spec[0]):
-        group_spec, cols, mask = _expand_mv_group(group_spec, cols, mask)
+    if any(g[1] in ("mvids", "mvin") for g in group_spec[0]):
+        group_spec, cols, mask = _expand_mv_group(group_spec, cols, mask,
+                                                  params)
     gcols, strides, g_pad, agg_specs, kmax = group_spec
     if kmax:
         return _group_outputs_compacted(group_spec, cols, mask, num_docs,

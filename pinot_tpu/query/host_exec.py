@@ -281,20 +281,10 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
 
 def _valuein_parts(c: str):
     """(column, literal texts) if ``c`` is ``valuein(col, lit, ...)``,
-    else None."""
+    else None (shared validation: expression.valuein_parts)."""
     if not expr_mod.is_expression(c):
         return None
-    expr = expr_mod.parse_expression(c)
-    if not (isinstance(expr, expr_mod.Call) and expr.func == "valuein"):
-        return None
-    if not expr.args or not isinstance(expr.args[0], expr_mod.Col):
-        raise ValueError("valuein needs a column as its first argument")
-    lits = []
-    for a in expr.args[1:]:
-        if not isinstance(a, expr_mod.Lit):
-            raise ValueError("valuein values must be literals")
-        lits.append(a.text)
-    return expr.args[0].name, tuple(lits)
+    return expr_mod.valuein_parts(c)
 
 
 def _mv_group_source(segment: ImmutableSegment, c: str):

@@ -481,6 +481,28 @@ class InstancePlanMaker:
                         "multi-column expression group key")
                 src = srcs[0]
                 ds = segment.data_source(src)
+                vi = expr_mod.valuein_parts(expr)   # raises on malformed
+                if vi is not None:
+                    # valuein(mvcol, lits...): an MV group key restricted
+                    # to the allowed value set — the kernel's MV row
+                    # expansion masks disallowed entries via a member
+                    # vector riding as a RUNTIME operand (one executable
+                    # per template, any literal set)
+                    cm = ds.metadata
+                    if not cm.has_dictionary or cm.single_value:
+                        raise UnsupportedOnDevice(
+                            "valuein group key needs a dict MV column")
+                    lits = vi[1]
+                    card_pad = kernels.pow2_bucket(cm.cardinality + 1)
+                    member = np.zeros(card_pad, dtype=bool)
+                    ids = ds.dictionary.index_of_many(lits)
+                    member[ids[ids >= 0]] = True
+                    plan.params.append(member)
+                    gcols.append((src, "mvin", 0, cm.cardinality))
+                    value_tables.append(None)
+                    cards.append(cm.cardinality)
+                    needed[(src, "mv")] = None
+                    continue
                 if not ds.metadata.has_dictionary or \
                         not ds.metadata.single_value:
                     raise UnsupportedOnDevice(

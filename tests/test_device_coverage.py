@@ -484,3 +484,74 @@ def test_mv_group_by_takes_device_path(wide_group_setup):
                    for g in resp.aggregation_results[1].group_by_result}
         assert got_cnt == {k: v[0] for k, v in exp.items()}, label
         assert got_sum == {k: float(v[1]) for k, v in exp.items()}, label
+
+
+def test_valuein_group_key_takes_device_path(tmp_path):
+    """valuein(mvcol, ...) group keys plan as 'mvin' — the kernel's MV
+    row expansion masks disallowed entries via a runtime member vector;
+    device, mesh, and host paths agree, and a different literal set
+    reuses the same kernel spec."""
+    import os
+
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (FieldSpec, FieldType, Schema,
+                                         metric)
+    from pinot_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(13)
+    n = 4096
+    schema = Schema("vw", [FieldSpec("tags", DataType.STRING,
+                                     FieldType.DIMENSION,
+                                     single_value=False),
+                           metric("v", DataType.INT)])
+    tvals = np.array([f"t{i:02d}" for i in range(16)], dtype=object)
+    segs, datas = [], []
+    for s in range(2):
+        cols = {"tags": [list(rng.choice(tvals, rng.integers(1, 4),
+                                         replace=False))
+                         for _ in range(n)],
+                "v": rng.integers(0, 1000, n).astype(np.int32)}
+        d = str(tmp_path / f"s{s}")
+        os.makedirs(d)
+        SegmentCreator(schema, None, segment_name=f"vw{s}",
+                       fixed_dictionaries={"tags": tvals}).build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        datas.append(cols)
+
+    pql = ("SELECT COUNT(*), SUM(v) FROM vw WHERE v >= 100 "
+           "GROUP BY valuein(tags, 't03', 't07', 't12') TOP 100")
+    plan = _plan(segs[0], pql)
+    assert [g[1] for g in plan.group_spec[0]] == ["mvin"]
+    pql2 = pql.replace("'t03', 't07', 't12'", "'t01', 't15'")
+    # same template, different literals → identical kernel group spec
+    assert _plan(segs[0], pql2).group_spec == plan.group_spec
+
+    def oracle(allowed):
+        exp = {}
+        for cols in datas:
+            for lst, v in zip(cols["tags"], cols["v"]):
+                if v >= 100:
+                    for t in lst:
+                        if t in allowed:
+                            e = exp.setdefault((t,), [0, 0])
+                            e[0] += 1
+                            e[1] += int(v)
+        return exp
+
+    for engine, label in ((QueryEngine(segs), "device"),
+                          (QueryEngine(segs, mesh=make_mesh()), "mesh"),
+                          (QueryEngine(segs, use_device=False), "host")):
+        # BOTH literal sets execute (the second reuses the compiled
+        # executable with a different member-vector operand)
+        for q, allowed in ((pql, {"t03", "t07", "t12"}),
+                           (pql2, {"t01", "t15"})):
+            exp = oracle(allowed)
+            resp = engine.query(q)
+            assert not resp.exceptions, (label, resp.exceptions)
+            got_cnt = {tuple(g["group"]): int(float(g["value"]))
+                       for g in resp.aggregation_results[0].group_by_result}
+            got_sum = {tuple(g["group"]): float(g["value"])
+                       for g in resp.aggregation_results[1].group_by_result}
+            assert got_cnt == {k: v[0] for k, v in exp.items()}, (label, q)
+            assert got_sum == {k: float(v[1])
+                               for k, v in exp.items()}, (label, q)
