@@ -129,7 +129,10 @@ with tempfile.TemporaryDirectory() as td:
           "('a003','a091','a155','a202','a249') GROUP BY a, b TOP 20000")
     # device MV group-by (in-kernel row expansion)
     q2 = "SELECT COUNT(*), SUM(v) FROM t WHERE v >= 2000 GROUP BY tags TOP 100"
-    for pql in (q1, q2):
+    # device valuein group key (mvin member-vector operand)
+    q3 = ("SELECT COUNT(*), SUM(v) FROM t WHERE v >= 2000 "
+          "GROUP BY valuein(tags, 't02', 't05', 't08') TOP 100")
+    for pql in (q1, q2, q3):
         rd, rh = dev.query(pql), host.query(pql)
         checks.append(not rd.exceptions and not rh.exceptions)
         for i in range(2):
