@@ -693,6 +693,19 @@ RANK_HIST_CARD_LIMIT = int(os.environ.get(
 #                              budget: both the scout histogram and the
 #                              kernel's one-hot rank contraction are
 #                              O(rows * card_pad). 0 disables the rung.
+DENSE_RANK_HIST_CARD = int(os.environ.get(
+    "PINOT_TPU_DENSE_RANK_HIST_CARD", "128"))  # within the DENSE regime the
+#                              hist rung fires only when every dim's hist
+#                              is VPU-cheap (card_pad <= 128 takes the
+#                              fused compare+reduce histogram — ~10ms-class
+#                              at 100M rows, vs ~230ms for a 1024-bin
+#                              matmul histogram)
+DENSE_RANK_HIST_G = int(os.environ.get(
+    "PINOT_TPU_DENSE_RANK_HIST_G", "2048"))    # ...and the span key space
+#                              exceeds this: below it the lane-concat
+#                              dense kernel is already a single MXU pass
+#                              (passes = ceil(n_lanes * g/128 / 128)), so
+#                              shrinking g buys nothing
 
 
 def adaptive_phase_a_specs(group_spec) -> Optional[tuple]:
@@ -724,26 +737,35 @@ def adaptive_hist_specs(group_spec, bounds) -> Optional[tuple]:
     map-based generators serve exactly this sparse-key regime — e.g.
     SSB q3.1's 'the 5 Asian nations in a 25-nation sorted dictionary').
 
-    The hist one-hots and the kernel's rank contraction are O(rows), so
-    this rung only dispatches when densifying can buy the one layout
-    change the offset spans can't: escaping the RANKED sort layout
-    (span space > DENSE_G_LIMIT). Within the dense regime shrinking g
-    does NOT pay — the dense kernel's cost is dominated by the per-row
-    [rows, 128] lo one-hot products, measured g-independent (394ms at
-    g=8192 vs 398ms at g=512, q3.1 shapes, 100M rows, v5e).
-    Every dim must fit the histogram budget. Returns hist agg specs or
-    None."""
+    The rung dispatches in two regimes:
+    - RANKED ESCAPE (span space > DENSE_G_LIMIT, dims fit
+      RANK_HIST_CARD_LIMIT): densifying is the one layout change the
+      offset spans can't buy — escaping the ranked sort layout.
+    - DENSE SHRINK (span space > DENSE_RANK_HIST_G, every dim's
+      card_pad <= DENSE_RANK_HIST_CARD): the lane-concat int8 dense
+      kernel's cost scales with ceil(n_lanes * g/128 / 128) MXU
+      passes, so collapsing e.g. q3.1's 32*32*8 offset-span space to
+      the 8*8*8 present space (the 5 Asian nations scattered in a
+      25-nation sorted dictionary) drops 3 row-stream passes to 1;
+      the <=128-bin histograms are fused compare+reduce (~10ms-class
+      at 100M rows), well under the pass saved. (The round-2 per-lane
+      kernel was g-independent — 394ms at g=8192 vs 398ms at g=512 —
+      which is why this regime was previously gated off.)
+    Returns hist agg specs or None."""
     if not RANK_HIST_CARD_LIMIT:
         return None
-    spans = []
+    spans, cards = [], []
     for (c, _gkind, _off, card), (lo, hi) in zip(group_spec[0], bounds):
         card_pad = kernels.pow2_bucket(card + 1)
         if card_pad > RANK_HIST_CARD_LIMIT:
             return None
+        cards.append(card_pad)
         spans.append(kernels.pow2_bucket(max(hi - lo + 1, 1), floor=1))
     g_span = int(np.prod(spans, dtype=np.int64))
     if kernels.pow2_bucket(g_span) <= kernels.DENSE_G_LIMIT:
-        return None
+        if not DENSE_RANK_HIST_CARD or g_span <= DENSE_RANK_HIST_G or \
+                any(cp > DENSE_RANK_HIST_CARD for cp in cards):
+            return None
     return tuple(("hist", c, "sv",
                   ("hist", kernels.pow2_bucket(card + 1)))
                  for (c, _gkind, _off, card) in group_spec[0])

@@ -20,10 +20,12 @@ def _naive_hist(ids, mask, g):
     return out
 
 
-@pytest.mark.parametrize("g_pad", [256, 1024, 8192])
+@pytest.mark.parametrize("g_pad", [32, 128, 256, 1024, 8192])
 def test_mxu_histogram_matches_naive(g_pad):
+    """All three regimes: <=128 fused compare+reduce (the adaptive hist
+    scout's path), direct bf16 matmul, hi/lo-factored radix."""
     rng = np.random.default_rng(1)
-    n = 4096 * 4
+    n = kernels.BLOCK * 2
     ids = rng.integers(0, g_pad, n).astype(np.int32)
     mask = rng.random(n) < 0.3
     out = np.asarray(kernels._mxu_histogram(
@@ -31,20 +33,26 @@ def test_mxu_histogram_matches_naive(g_pad):
     np.testing.assert_array_equal(out, _naive_hist(ids, mask, g_pad))
 
 
-@pytest.mark.parametrize("g_pad", [256, 1024, 4096])
-def test_dense_group_part_sums_exact(g_pad):
+@pytest.mark.parametrize("g_pad,n_parts", [(256, 4), (1024, 4), (4096, 2),
+                                           (8192, 5), (32768, 4)])
+def test_dense_group_part_sums_exact(g_pad, n_parts):
+    """Covers the direct batched path (g < 512), the batched radix
+    concat (n_l*g1 <= 128), and the wide-table scan fallback
+    (g_pad=8192 with 6 lanes → n_l*g1 = 384; g_pad=32768 → 1280)."""
     rng = np.random.default_rng(2)
-    n, n_parts = 4096 * 4, 4
+    n = kernels.BLOCK * 2
     key = rng.integers(0, g_pad, n).astype(np.int32)
     mask = rng.random(n) < 0.5
     parts = rng.integers(0, 128, (n_parts, n)).astype(np.int8)  # max 127
-    out = np.asarray(kernels._dense_group_part_sums(
+    out, count = kernels._dense_group_part_sums(
         [jnp.asarray(parts[p]) for p in range(n_parts)],
-        jnp.asarray(key), jnp.asarray(mask), g_pad))
+        jnp.asarray(key), jnp.asarray(mask), g_pad, with_count=True)
     exp = np.zeros((n_parts, g_pad), dtype=np.int64)
     for p in range(n_parts):
         np.add.at(exp[p], key[mask], parts[p][mask].astype(np.int64))
-    np.testing.assert_array_equal(out, exp)
+    np.testing.assert_array_equal(np.asarray(out), exp)
+    np.testing.assert_array_equal(np.asarray(count),
+                                  _naive_hist(key, mask, g_pad))
 
 
 @pytest.mark.parametrize("g_pad", [256, 2048])
@@ -61,19 +69,25 @@ def test_dense_group_float_sums(g_pad):
     np.testing.assert_allclose(out, exp, rtol=1e-9)
 
 
-@pytest.mark.parametrize("t_slots", [300, 8192])
+@pytest.mark.parametrize("t_slots", [300, 8192, 16384])
 def test_slot_sum_tables_radix_and_direct(t_slots):
-    """Both sides of the RADIX_G threshold, with the drop slot, max byte
-    values, and a non-divisible row count."""
+    """Both sides of the SLOT_RADIX_G threshold, with the drop slot, max
+    7-bit plane values (the s8 contract: every int lane <= 127), and a
+    non-divisible row count."""
     rng = np.random.default_rng(4)
-    k = (1 << 16) + 777          # forces pad + a second chunk
+    k = (1 << 16) + 777          # forces pad + a non-divisible chunk
     gslot = rng.integers(0, t_slots + 1, k).astype(np.int32)  # incl. drop
-    int_vals = rng.integers(0, 256, (k, 3)).astype(np.int32)  # max 255
+    int_vals = rng.integers(0, 128, (k, 3)).astype(np.int32)  # max 127
     f32_vals = (rng.random((k, 2)) * 10).astype(np.float64)
     count_mask = rng.random(k) < 0.9
-    ti, tf, tc = kernels._slot_sum_tables(
-        jnp.asarray(gslot), t_slots, jnp.asarray(int_vals),
-        jnp.asarray(f32_vals), jnp.asarray(count_mask))
+    orig_chunk = kernels.SLOT_CHUNK
+    kernels.SLOT_CHUNK = 1 << 16          # cover the multi-chunk scan
+    try:
+        ti, tf, tc = kernels._slot_sum_tables(
+            jnp.asarray(gslot), t_slots, jnp.asarray(int_vals),
+            jnp.asarray(f32_vals), jnp.asarray(count_mask))
+    finally:
+        kernels.SLOT_CHUNK = orig_chunk
     keep = gslot < t_slots
     exp_i = np.zeros((3, t_slots), dtype=np.int64)
     for li in range(3):

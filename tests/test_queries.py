@@ -360,6 +360,32 @@ def test_valuein_group_key_and_countmv(setup):
         assert int(float(agg_value(resp2))) == exp_entries, label
 
 
+def test_duplicate_mv_column_group_keys(setup):
+    """GROUP BY col, valuein(col, ...) over the SAME MV column: each key
+    position is an independent axis of the entry cross-product (a doc
+    with positions [P, C] contributes (P,P), (P,C), (C,P), (C,C) before
+    the valuein restriction), matching the reference's sequential
+    per-key expansion (DefaultGroupByExecutor.aggregateGroupByMV).
+    Round-2 advisor finding: the device expansion used to key entry
+    indexes by column NAME, collapsing the two axes to the diagonal."""
+    engines, oracle = both_engines(setup)
+    keep = {"P", "C", "SS"}
+    exp = {}
+    for lst in oracle.cols["position"]:
+        for v1 in lst:
+            for v2 in lst:
+                if v2 in keep:
+                    exp[(v1, v2)] = exp.get((v1, v2), 0) + 1
+    for e, label in engines:
+        resp = e.query(
+            "SELECT COUNT(*) FROM baseballStats "
+            "GROUP BY position, valuein(position, 'P', 'C', 'SS') "
+            "TOP 1000")
+        got = {tuple(g["group"]): int(float(g["value"]))
+               for g in resp.aggregation_results[0].group_by_result}
+        assert got == exp, label
+
+
 def test_countmv_inside_group_by(setup):
     engines, oracle = both_engines(setup)
     # COUNTMV(position) grouped by league: entries per league
