@@ -6,17 +6,26 @@ CombineGroupByOperator.java:107-156: per-segment plans on an ExecutorService,
 merged into a shared ConcurrentHashMap) and the broker's scatter-gather
 (SURVEY.md §2.18 #1/#2) — rebuilt the TPU way:
 
-- Homogeneous segments (same schema, same padded doc count, shared
-  dictionaries) are stacked onto a leading `seg` axis and sharded over a
-  `jax.sharding.Mesh` with `shard_map`.
+- Homogeneous segments (same schema, same padded doc count) are stacked
+  onto a leading `seg` axis and sharded over a `jax.sharding.Mesh` with
+  `shard_map`.
 - Each device vmaps the single-segment kernel over its local shard, reduces
   locally, then combines across devices with XLA collectives over ICI:
   `psum` for counts/sums/histograms/group tables, `pmin`/`pmax` for id- or
   value-domain extrema, `all_gather` for selection lanes.
-- Cross-segment combine in the dictId domain is only sound when dictionaries
-  are shared; the stacker verifies that per column and raises `NotShardable`
-  otherwise so callers fall back to per-segment execution + host merge (the
-  same answer, just without ICI riding).
+- Cross-segment combine in the dictId domain is only sound in ONE shared id
+  space. Segments built independently (the normal storage path) have
+  per-segment dictionaries, so the stacker builds a UNION DICTIONARY per
+  column — the sorted merge of every segment's values — and remaps each
+  segment's id lanes into the union domain at stack time, before upload
+  (a monotonic id map: sortedness and range-filter semantics survive).
+  Queries then plan against a union view of segment 0 and combine on
+  device exactly as in the shared case. This is the value-domain merge of
+  the reference's CombineGroupByOperator
+  (core/operator/CombineGroupByOperator.java:107-156) moved to stack time:
+  pay the remap once per (segment-set, column), not per query.
+  `NotShardable` remains only for genuinely un-stackable sets (mutable
+  segments, differing padded sizes/shapes, raw-column range mismatches).
 
 One jitted shard_map executable serves every query with the same static spec
 (shapes pow2-bucketed), mirroring the single-segment plan cache.
@@ -140,6 +149,127 @@ def get_sharded_kernel(mesh: Mesh, padded: int, filter_spec, agg_specs,
 # ---------------------------------------------------------------------------
 
 
+class _UnionColumn:
+    """Union-dictionary remap artifacts for one column.
+
+    values = sorted merge of every segment's dictionary values;
+    remaps[s] maps segment s's local dictId (plus the local padding
+    sentinel, id == local cardinality) into the union id domain (pad →
+    union cardinality). The map is monotonic per segment, so range
+    predicates and sorted-layout guarantees survive the remap.
+    """
+
+    def __init__(self, col: str, srcs):
+        from pinot_tpu.segment.dictionary import Dictionary
+        from pinot_tpu.segment.loader import (int_part_info_for,
+                                              int_part_table,
+                                              pad_dict_values)
+        self.col = col
+        per_seg = [np.asarray(s.dictionary.values) for s in srcs]
+        union = np.unique(np.concatenate(per_seg))
+        self.values = union
+        self.cardinality = len(union)
+        self.remaps = []
+        for v in per_seg:
+            r = np.searchsorted(union, v).astype(np.int32)
+            self.remaps.append(
+                np.concatenate([r, np.int32([self.cardinality])]))
+        cm0 = srcs[0].metadata
+        import dataclasses
+        self.metadata = dataclasses.replace(
+            cm0, cardinality=self.cardinality,
+            min_value=union[0] if len(union) else cm0.min_value,
+            max_value=union[-1] if len(union) else cm0.max_value,
+            sorted=all(s.metadata.sorted for s in srcs),
+            has_inverted_index=False, has_bloom_filter=False)
+        self.dictionary = Dictionary(cm0.data_type, union)
+        # segment-independent artifacts, built ONCE per union column
+        self.padded_vals = pad_dict_values(union, cm0.data_type.np_dtype)
+        self.part_info = int_part_info_for(union) \
+            if cm0.data_type.np_dtype.kind in "iu" else None
+        self.part_table = (int_part_table(union, *self.part_info)
+                           if self.part_info is not None else None)
+        self.f64_vals = np.concatenate(
+            [np.asarray(union, dtype=np.float64), [0.0]]) \
+            if cm0.data_type.is_numeric else None
+
+
+class _UnionDataSource:
+    """Planning-time DataSource view in the union id domain.
+
+    Everything a plan needs — metadata, literal→id binding, part
+    encodings, decode tables — comes from the union dictionary; index
+    structures that only exist per segment (inverted, bloom, sorted
+    ranges) are absent so plans can't take per-segment fast paths."""
+
+    def __init__(self, union: _UnionColumn):
+        self.metadata = union.metadata
+        self.dictionary = union.dictionary
+        self.inverted_index = None
+        self.bloom_filter = None
+        self.sorted_ranges = None
+        self._union = union
+
+    def int_part_info(self) -> tuple:
+        return self._union.part_info
+
+    def host_operand(self, kind: str) -> np.ndarray:
+        if kind == "vals":
+            return self._union.padded_vals
+        raise ValueError(
+            f"union data source serves plans, not '{kind}' lanes")
+
+
+class _UnionViewSegment:
+    """Segment 0 with union-dictionary columns swapped in — the object
+    queries plan against (and decode group/selection results with) when
+    a stack spans per-segment dictionaries."""
+
+    def __init__(self, stack: "StackedSegments"):
+        self._stack = stack
+        self._base = stack.segments[0]
+        self._sources: Dict[str, object] = {}
+
+    @property
+    def metadata(self):
+        return self._base.metadata
+
+    @property
+    def segment_name(self) -> str:
+        return self._base.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self._base.num_docs
+
+    @property
+    def padded_docs(self) -> int:
+        return self._base.padded_docs
+
+    @property
+    def column_names(self):
+        return self._base.column_names
+
+    @property
+    def star_trees(self):
+        # star-tree cubes are per-segment id-domain artifacts; the
+        # sharded path never serves them (fast paths go sequential)
+        return []
+
+    def has_column(self, column: str) -> bool:
+        return self._base.has_column(column)
+
+    def data_source(self, column: str):
+        ds = self._sources.get(column)
+        if ds is None:
+            base = self._base.data_source(column)
+            union = self._stack.union_column(column) \
+                if base.dictionary is not None else None
+            ds = _UnionDataSource(union) if union is not None else base
+            self._sources[column] = ds
+        return ds
+
+
 class StackedSegments:
     """Host-stacks homogeneous segments and caches sharded device arrays.
 
@@ -167,20 +297,33 @@ class StackedSegments:
         self.num_docs[: self.n_real] = [s.num_docs for s in self.segments]
         self._dev_num_docs = None
         self._lanes: Dict[Tuple[str, str], object] = {}
-        self._dict_checked: Dict[str, bool] = {}
+        # col -> None (dictionaries shared) | _UnionColumn (remap needed)
+        self._union: Dict[str, Optional["_UnionColumn"]] = {}
+        self._plan_segment = None
 
-    def _check_shared_dictionary(self, col: str) -> None:
-        ok = self._dict_checked.get(col)
-        if ok is None:
-            d0 = self.segments[0].data_source(col).dictionary
-            ok = all(
-                np.array_equal(s.data_source(col).dictionary.values,
-                               d0.values)
-                for s in self.segments[1:])
-            self._dict_checked[col] = ok
-        if not ok:
-            raise NotShardable(f"column '{col}' dictionaries differ across "
-                               "segments (id-domain combine unsound)")
+    def union_column(self, col: str) -> Optional["_UnionColumn"]:
+        """None when every segment shares the column's dictionary; else
+        the union-dictionary remap artifacts (built once per column)."""
+        if col not in self._union:
+            srcs = [s.data_source(col) for s in self.segments]
+            d0 = srcs[0].dictionary
+            if d0 is None:
+                self._union[col] = None       # raw column: no id domain
+            elif all(np.array_equal(s.dictionary.values, d0.values)
+                     for s in srcs[1:]):
+                self._union[col] = None
+            else:
+                self._union[col] = _UnionColumn(col, srcs)
+        return self._union[col]
+
+    def plan_segment(self) -> ImmutableSegment:
+        """Segment view queries plan against: segment 0 with every
+        differing-dictionary column replaced by its union view, so
+        literal→id binding, part encodings and group decode tables all
+        live in the union id domain the stacked lanes use."""
+        if self._plan_segment is None:
+            self._plan_segment = _UnionViewSegment(self)
+        return self._plan_segment
 
     def device_num_docs(self):
         if self._dev_num_docs is None:
@@ -193,17 +336,24 @@ class StackedSegments:
         key = (col, kind)
         if key in self._lanes:
             return self._lanes[key]
-        if kind in ("ids", "mv", "vals", "parts", "vlane"):
-            self._check_shared_dictionary(col)
-        arrs = [s.data_source(col).host_operand(kind) for s in self.segments]
+        union = self.union_column(col) \
+            if kind in ("ids", "mv", "vals", "parts", "vlane") else None
+        if union is not None:
+            arrs = [self._union_operand(union, i, kind)
+                    for i in range(self.n_real)]
+            card = union.cardinality
+        else:
+            arrs = [s.data_source(col).host_operand(kind)
+                    for s in self.segments]
+            card = self.segments[0].data_source(col).metadata.cardinality
         if kind == "vals":
-            # dictionary values are identical; replicate instead of sharding
+            # dictionary values are identical (or the union table);
+            # replicate instead of sharding
             out = jax.device_put(arrs[0], NamedSharding(self.mesh, P()))
             self._lanes[key] = out
             return out
         if kind == "mv":
             w = max(a.shape[1] for a in arrs)
-            card = self.segments[0].data_source(col).metadata.cardinality
             arrs = [np.pad(a, ((0, 0), (0, w - a.shape[1])),
                            constant_values=card) for a in arrs]
         shapes = {a.shape for a in arrs}
@@ -213,13 +363,40 @@ class StackedSegments:
         if self.n_total > self.n_real:
             pad_val = stacked.flat[0] * 0
             if kind in ("ids", "mv"):
-                pad_val = self.segments[0].data_source(col).metadata.cardinality
+                pad_val = card
             filler = np.full((self.n_total - self.n_real,) + stacked.shape[1:],
                              pad_val, stacked.dtype)
             stacked = np.concatenate([stacked, filler])
         out = jax.device_put(stacked, NamedSharding(self.mesh, P(SEG_AXIS)))
         self._lanes[key] = out
         return out
+
+    def _union_operand(self, union: _UnionColumn, i: int,
+                       kind: str) -> np.ndarray:
+        """Segment i's lane remapped into the union id domain (built
+        host-side at stack time — the one-time cost that buys id-domain
+        device combine for independently built segments)."""
+        from pinot_tpu.segment.loader import min_id_dtype
+        ds = self.segments[i].data_source(union.col)
+        remap = union.remaps[i]
+        if kind == "vals":
+            return union.padded_vals
+        if kind == "ids":
+            local = ds.host_operand("ids")
+            return remap[local.astype(np.int64)].astype(
+                min_id_dtype(union.cardinality))
+        if kind == "mv":
+            local = ds.host_operand("mv")
+            return remap[local.astype(np.int64)].astype(np.int32)
+        if kind == "parts":
+            # 7-bit part planes in the UNION encoding (offsets from the
+            # union min) so every segment's parts add exactly
+            ids = remap[ds.host_operand("ids").astype(np.int64)]
+            return union.part_table[:, ids]
+        if kind == "vlane":
+            return union.f64_vals[
+                remap[ds.host_operand("ids").astype(np.int64)]]
+        raise ValueError(kind)
 
     def gather(self, needed_cols) -> Dict[str, object]:
         # lane keys are "<col>.<kind>" — the same names the kernels read
@@ -299,17 +476,27 @@ class ShardedQueryExecutor:
                 ) -> IntermediateResultsBlock:
         t0 = time.perf_counter()
         stack = self.stack_for(segments)
-        seg0 = stack.segments[0]
-        # Plan is built against segment 0 and reused for every segment, so
-        # EVERY dictionary-encoded column the request references must have a
-        # shared dictionary — not just the ones that survive constant
-        # folding (a predicate folded to MATCH_ALL/EMPTY against segment
-        # 0's dictionary never reaches needed_cols, but would fold
-        # differently on a segment with a different dictionary).
-        for col in request.referenced_columns():
-            if seg0.has_column(col) and \
-                    seg0.data_source(col).metadata.has_dictionary:
-                stack._check_shared_dictionary(col)
+        # Fast paths (star-tree cubes, metadata/dictionary answers) are
+        # per-segment host work in each segment's OWN id domain — probe
+        # them against segment 0 directly and let the sequential
+        # executor serve them (it re-plans per segment).
+        plan0 = self.plan_maker.make_segment_plan(stack.segments[0],
+                                                  request)
+        if plan0.fast_path_result is not None:
+            raise NotShardable("fast-path plan; no device work to shard")
+        # Plan against the union view: every dictionary-encoded column the
+        # request references resolves to the union id domain the stacked
+        # lanes use — including predicates that constant-fold to
+        # MATCH_ALL/EMPTY (folding against the union dictionary is valid
+        # for every segment, which folding against segment 0 alone was
+        # not). Fully shared-dictionary stacks reuse plan0 — the union
+        # view would produce the identical plan, so don't plan twice.
+        needs_union = any(
+            stack.union_column(col) is not None
+            for col in request.referenced_columns()
+            if stack.segments[0].has_column(col) and
+            stack.segments[0].data_source(col).dictionary is not None)
+        seg0 = stack.plan_segment() if needs_union else stack.segments[0]
         if request.is_group_by:
             # raw group keys bin by segment 0's min/max — every segment
             # must share that range or rows would clip into wrong bins
@@ -326,11 +513,8 @@ class ShardedQueryExecutor:
                         raise NotShardable(
                             f"raw group column '{col}' min/max differ "
                             "across segments")
-        plan = self.plan_maker.make_segment_plan(seg0, request)
-        if plan.fast_path_result is not None:
-            # metadata fast paths are per-segment host work; take the
-            # sequential path for those (they're O(1) per segment anyway)
-            raise NotShardable("fast-path plan; no device work to shard")
+        plan = plan0 if not needs_union else \
+            self.plan_maker.make_segment_plan(seg0, request)
 
         cols = stack.gather(plan.needed_cols)
         lane_keys = tuple(sorted(cols.keys()))
@@ -388,10 +572,11 @@ class ShardedQueryExecutor:
         rows_all: List[tuple] = []
         columns = None
         seg_matched = np.asarray(outs["stats.seg_matched"])
+        decode_seg = stack.plan_segment()   # union-domain decode tables
         for i, seg in enumerate(stack.segments):
             sub = {k: v[i] for k, v in outs.items() if k.startswith("sel.")}
             seg_plan = SegmentPlan(
-                segment=seg, request=request,
+                segment=decode_seg, request=request,
                 select_spec=plan.select_spec, needed_cols=plan.needed_cols,
                 select_display=plan.select_display)
             seg_blk = IntermediateResultsBlock()

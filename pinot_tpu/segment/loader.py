@@ -43,6 +43,38 @@ def min_id_dtype(max_value: int) -> np.dtype:
                     np.int16 if max_value <= 32767 else np.int32)
 
 
+def pad_dict_values(values: np.ndarray, np_dtype) -> np.ndarray:
+    """Dictionary value table padded to the kernels' pow2 cardinality
+    bucket; padding repeats the last value (kernels mask it out). The
+    single convention shared by per-segment and union-dictionary lanes."""
+    from pinot_tpu.ops.kernels import pow2_bucket
+    if len(values) == 0:
+        values = np.zeros(1, np_dtype)
+    card_pad = pow2_bucket(len(values) + 1)
+    return np.concatenate(
+        [values, np.full(card_pad - len(values), values[-1], values.dtype)])
+
+
+def int_part_info_for(values: np.ndarray) -> tuple:
+    """(n_parts, min_value) for the 7-bit bit-sliced integer sum encoding
+    of a sorted integer dictionary (value = min + sum_k part_k << 7k)."""
+    vals = np.asarray(values, dtype=np.int64)
+    min_v = int(vals[0]) if len(vals) else 0
+    max_off = (int(vals[-1]) - min_v) if len(vals) else 0
+    n_parts = -(-max(1, max_off.bit_length()) // 7)
+    return (n_parts, min_v)
+
+
+def int_part_table(values: np.ndarray, n_parts: int,
+                   min_v: int) -> np.ndarray:
+    """[n_parts, card + 1] int8 plane table (last column = all-zero pad
+    sentinel for id == cardinality row padding)."""
+    off = np.asarray(values, dtype=np.int64) - min_v
+    table = np.stack([(off >> (7 * k)) & 0x7F
+                      for k in range(n_parts)]).astype(np.int8)
+    return np.concatenate([table, np.zeros((n_parts, 1), np.int8)], axis=1)
+
+
 class DataSource:
     """Column access for operators.
 
@@ -100,11 +132,7 @@ class DataSource:
         into 7-bit slices: value = min_value + sum_k part_k << (7k).
         """
         if self._part_info is None:
-            vals = np.asarray(self.dictionary.values, dtype=np.int64)
-            min_v = int(vals[0]) if len(vals) else 0
-            max_off = (int(vals[-1]) - min_v) if len(vals) else 0
-            n_parts = -(-max(1, max_off.bit_length()) // 7)
-            self._part_info = (n_parts, min_v)
+            self._part_info = int_part_info_for(self.dictionary.values)
         return self._part_info
 
     def host_operand(self, kind: str) -> np.ndarray:
@@ -114,13 +142,8 @@ class DataSource:
         if kind == "ids":
             return self._pad_ids(self.dict_ids)
         if kind == "vals":
-            from pinot_tpu.ops.kernels import pow2_bucket
-            vals = self.dictionary.values
-            if len(vals) == 0:
-                vals = np.zeros(1, self.metadata.data_type.np_dtype)
-            card_pad = pow2_bucket(len(vals) + 1)
-            return np.concatenate(
-                [vals, np.full(card_pad - len(vals), vals[-1], vals.dtype)])
+            return pad_dict_values(self.dictionary.values,
+                                   self.metadata.data_type.np_dtype)
         if kind == "raw":
             arr = self.raw_values
             p = padded_size(len(arr))
@@ -136,13 +159,7 @@ class DataSource:
             return out
         if kind == "parts":
             n_parts, min_v = self.int_part_info()
-            vals = np.asarray(self.dictionary.values, dtype=np.int64)
-            off = vals - min_v
-            table = np.stack([(off >> (7 * k)) & 0x7F
-                              for k in range(n_parts)]).astype(np.int8)
-            # id == cardinality (row padding) -> all-zero parts
-            table = np.concatenate(
-                [table, np.zeros((n_parts, 1), np.int8)], axis=1)
+            table = int_part_table(self.dictionary.values, n_parts, min_v)
             return table[:, self.host_operand("ids")]
         if kind == "vlane":
             vals = np.asarray(self.dictionary.values, dtype=np.float64)

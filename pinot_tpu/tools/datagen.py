@@ -272,10 +272,16 @@ def ssb_table_config(star_tree: bool = False):
 
 def build_ssb_segment_dirs(base_dir: str, total_rows: int,
                            num_segments: int, seed: int = 0,
-                           log=None, star_tree: bool = False
+                           log=None, star_tree: bool = False,
+                           shared_dictionaries: bool = False
                            ) -> Tuple[List[str], Dict, np.ndarray]:
     """Full storage path: rows → SegmentCreator → segment dirs on disk.
 
+    Each segment builds its OWN dictionaries from its own rows — exactly
+    what the reference's per-segment SegmentDictionaryCreator produces —
+    and the sharded executor's stack-time union remap handles the
+    differing id domains. `shared_dictionaries=True` restores the old
+    engineered full-domain dictionaries (kept for A/B comparisons).
     Returns (segment_dirs, ids, supplycost) — ids feed the numpy oracle."""
     import os
 
@@ -287,6 +293,8 @@ def build_ssb_segment_dirs(base_dir: str, total_rows: int,
     config = ssb_table_config(star_tree=star_tree)
     per = total_rows // num_segments
     dirs = []
+    fixed = {c: pools[c] for c in SSB_TYPES if c not in SSB_RAW_COLS} \
+        if shared_dictionaries else None
     for i in range(num_segments):
         lo = i * per
         hi = (i + 1) * per if i < num_segments - 1 else total_rows
@@ -297,10 +305,6 @@ def build_ssb_segment_dirs(base_dir: str, total_rows: int,
             else:
                 cols[c] = pools[c][ids[c][lo:hi]]
         d = os.path.join(base_dir, f"ssb_{i}")
-        # full-domain dictionaries: a small slice can miss rare values,
-        # which would give segments differing dictionaries and knock out
-        # the stacked/sharded device path (NotShardable)
-        fixed = {c: pools[c] for c in SSB_TYPES if c not in SSB_RAW_COLS}
         SegmentCreator(schema, config, segment_name=f"ssb_{i}",
                        fixed_dictionaries=fixed).build(cols, d)
         dirs.append(d)

@@ -182,36 +182,73 @@ def test_sharded_matches_sequential_engine(cluster):
                     float(fa["value"]), rel=1e-12), pql
 
 
-def test_heterogeneous_dictionaries_not_shardable():
-    base = tempfile.mkdtemp()
-    segs = []
-    for i in range(2):
-        d = os.path.join(base, f"seg{i}")
-        os.makedirs(d)
-        seg, _ = build_segment(d, n=1000, seed=i, name=f"h{i}")
-        segs.append(seg)
-    sharded = ShardedQueryExecutor(mesh=make_mesh())
-    # playerName: 997-value pool sampled 1000x per segment → the two
-    # segments' dictionaries are necessarily different subsets
-    request = compile_pql(
-        "SELECT DISTINCTCOUNT(playerName) FROM baseballStats")
-    with pytest.raises(NotShardable):
-        sharded.execute(request, segs)
-
-
-def test_folded_predicate_on_heterogeneous_dicts_falls_back():
-    """A predicate that constant-folds differently per segment dictionary
-    (e.g. NOT over a value present in only one segment) must not be executed
-    with segment-0's plan across all segments."""
+@pytest.fixture(scope="module")
+def hetero():
+    """Independently built segments — per-segment dictionaries, the way
+    the real storage path always produces them (reference: every segment
+    gets its own SegmentDictionaryCreator output)."""
     base = tempfile.mkdtemp()
     segs, all_cols = [], []
-    for i in range(2):
+    for i in range(4):
         d = os.path.join(base, f"seg{i}")
         os.makedirs(d)
-        seg, cols = build_segment(d, n=1000, seed=i, name=f"fold{i}")
+        seg, cols = build_segment(d, n=1024, seed=i, name=f"h{i}")
         segs.append(seg)
         all_cols.append(cols)
-    # find a player present in segment 1 but absent from segment 0
+    merged = {k: np.concatenate([c[k] for c in all_cols])
+              for k in all_cols[0] if k != "position"}
+    merged["position"] = sum((list(c["position"]) for c in all_cols), [])
+    return segs, all_cols, Oracle(merged)
+
+
+def test_heterogeneous_dictionaries_union_sharded(hetero):
+    """Independently built segments (necessarily different dictionary
+    subsets per segment) run on the DEVICE combine path via the stack-time
+    union-dictionary remap — the value-domain merge of the reference's
+    CombineGroupByOperator moved to stack time."""
+    segs, _, oracle = hetero
+    sharded = ShardedQueryExecutor(mesh=make_mesh())
+    resp = _run(sharded, segs,
+                "SELECT DISTINCTCOUNT(playerName), SUM(runs) "
+                "FROM baseballStats")
+    m = oracle.mask(lambda r: True)
+    assert int(resp.aggregation_results[0].value) == \
+        oracle.distinctcount("playerName", m)
+    assert float(resp.aggregation_results[1].value) == pytest.approx(
+        oracle.sum("runs", m))
+
+
+def test_heterogeneous_group_by_union_sharded(hetero):
+    segs, _, oracle = hetero
+    sharded = ShardedQueryExecutor(mesh=make_mesh())
+    m = oracle.mask(lambda r: r["runs"] > 50)
+    expected = oracle.group_by(["teamID", "league"], m, ("sum", "hits"))
+    resp = _run(sharded, segs,
+                "SELECT SUM(hits) FROM baseballStats WHERE runs > 50 "
+                "GROUP BY teamID, league TOP 1000")
+    got = {tuple(g["group"]): float(g["value"])
+           for g in resp.aggregation_results[0].group_by_result}
+    assert got == {k: pytest.approx(v) for k, v in expected.items()}
+
+
+def test_heterogeneous_selection_order_union_sharded(hetero):
+    segs, _, oracle = hetero
+    sharded = ShardedQueryExecutor(mesh=make_mesh())
+    resp = _run(sharded, segs,
+                "SELECT playerName, runs FROM baseballStats "
+                "WHERE league = 'AL' ORDER BY runs DESC LIMIT 15")
+    m = oracle.mask(lambda r: r["league"] == "AL")
+    expected = sorted(oracle.vals("runs", m), reverse=True)[:15]
+    got = [int(r[1]) for r in resp.selection_results.results]
+    assert got == [int(v) for v in expected]
+
+
+def test_folded_predicate_on_heterogeneous_dicts(hetero):
+    """A predicate over a value present in only SOME segments'
+    dictionaries constant-folds against the UNION dictionary, which is
+    valid for every segment (folding against segment 0 alone was not —
+    that regime used to force a NotShardable fallback)."""
+    segs, all_cols, _ = hetero
     s0 = set(all_cols[0]["playerName"])
     s1 = set(all_cols[1]["playerName"])
     only1 = sorted(s1 - s0)[0]
@@ -220,14 +257,9 @@ def test_folded_predicate_on_heterogeneous_dicts_falls_back():
     expected = float(runs[names != only1].sum())
 
     sharded = ShardedQueryExecutor(mesh=make_mesh())
-    request = compile_pql(
-        f"SELECT SUM(runs) FROM baseballStats WHERE playerName <> '{only1}'")
-    with pytest.raises(NotShardable):
-        sharded.execute(request, segs)
-
-    engine = QueryEngine(segs, mesh=make_mesh())
-    resp = engine.query(
-        f"SELECT SUM(runs) FROM baseballStats WHERE playerName <> '{only1}'")
+    resp = _run(sharded, segs,
+                f"SELECT SUM(runs) FROM baseballStats "
+                f"WHERE playerName <> '{only1}'")
     assert float(resp.aggregation_results[0].value) == pytest.approx(expected)
 
 
