@@ -29,10 +29,31 @@ def unregister_stream_factory(name: str) -> None:
     _factories.pop(name, None)
 
 
-def get_stream_factory(name: str) -> StreamConsumerFactory:
-    if name not in _factories:
-        raise KeyError(f"no stream factory registered under {name!r}")
-    return _factories[name]
+def _tcp_provider(stream_configs: Dict[str, str]) -> StreamConsumerFactory:
+    """Built-in cross-process connector: the factory is constructed from
+    the table config alone (stream.tcp.host/port), so a REMOTE server
+    process needs no pre-registered in-process object — the property
+    that makes realtime work across OS processes (parity: the Kafka
+    connector's broker-list-in-config construction,
+    KafkaPartitionLevelConsumer.java)."""
+    from pinot_tpu.realtime.tcp_stream import TcpStreamConsumerFactory
+    return TcpStreamConsumerFactory(
+        stream_configs.get("stream.tcp.host", "127.0.0.1"),
+        int(stream_configs["stream.tcp.port"]))
+
+
+# factory PROVIDERS build a factory from the streamConfigs map itself;
+# instance registrations (register_stream_factory) take precedence
+_providers = {"tcp": _tcp_provider}
+
+
+def get_stream_factory(name: str, stream_configs: Optional[Dict[str, str]]
+                       = None) -> StreamConsumerFactory:
+    if name in _factories:
+        return _factories[name]
+    if name in _providers and stream_configs is not None:
+        return _providers[name](stream_configs)
+    raise KeyError(f"no stream factory registered under {name!r}")
 
 
 def register_decoder(name: str, decoder_cls: type) -> None:
@@ -53,7 +74,7 @@ def resolve_stream_config(table_config: TableConfig) -> StreamConfig:
       stream.fetch.timeout.ms
     """
     sc = table_config.indexing_config.stream_configs or {}
-    factory = get_stream_factory(sc["stream.factory.name"])
+    factory = get_stream_factory(sc["stream.factory.name"], sc)
     decoder_cls = _decoders[sc.get("stream.decoder.name", "json")]
     kw = {}
     if "realtime.segment.flush.threshold.size" in sc:

@@ -504,3 +504,64 @@ def test_hlc_flaky_consumer_keeps_ingesting(work_dir):
         assert store.get(f"/CONSUMERS/{RT_TABLE}/gf")["sequence"] >= 2
     finally:
         mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# TCP topic stream connector (cross-process SPI; parity: the Kafka 0.9
+# connector proves the reference's stream SPI out-of-process —
+# KafkaPartitionLevelConsumer / KafkaStreamLevelConsumer)
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_stream_connector_spi():
+    from pinot_tpu.realtime.stream import (JsonMessageDecoder, LARGEST_OFFSET,
+                                           StreamConfig)
+    from pinot_tpu.realtime.tcp_stream import (TcpStreamConsumerFactory,
+                                               TcpTopicClient, TcpTopicServer)
+
+    srv = TcpTopicServer()
+    port = srv.start()
+    try:
+        srv.create_topic("unit_t", 2)
+        pub = TcpTopicClient("127.0.0.1", port)
+        for i in range(25):
+            pub.publish_row("unit_t", {"i": i}, partition=i % 2)
+
+        factory = TcpStreamConsumerFactory("127.0.0.1", port, batch_size=4)
+        cfg = StreamConfig(topic="unit_t", consumer_factory=factory,
+                           decoder=JsonMessageDecoder())
+
+        meta = factory.create_metadata_provider(cfg)
+        assert meta.partition_count() == 2
+        assert meta.fetch_offset(0, LARGEST_OFFSET) == 13   # 0,2,...,24
+        assert meta.fetch_offset(0, "smallest") == 0
+
+        # LLC partition consumer: batched fetch honors start/end offsets
+        c0 = factory.create_partition_consumer(cfg, 0)
+        batch = c0.fetch_messages(0, None, 1000)
+        assert [m.offset for m in batch.messages] == [0, 1, 2, 3]
+        batch = c0.fetch_messages(batch.next_offset, 6, 1000)
+        assert [m.offset for m in batch.messages] == [4, 5]
+        rows = [cfg.decoder.decode(m.value) for m in batch.messages]
+        assert rows == [{"i": 8}, {"i": 10}]
+        c0.close()
+
+        # HLC group consumer: drains all partitions, checkpoint resumes
+        hl = factory.create_stream_consumer(cfg)
+        seen = []
+        while True:
+            msgs = hl.next_messages(7)
+            if not msgs:
+                break
+            seen.extend(cfg.decoder.decode(m.value)["i"] for m in msgs)
+        assert sorted(seen) == list(range(25))
+        ckpt = hl.checkpoint()
+        hl.close()
+        pub.publish_row("unit_t", {"i": 99}, partition=0)
+        hl2 = factory.create_stream_consumer(cfg, checkpoint=ckpt)
+        msgs = hl2.next_messages(10)
+        assert [cfg.decoder.decode(m.value)["i"] for m in msgs] == [99]
+        hl2.close()
+        pub.close()
+    finally:
+        srv.stop()
