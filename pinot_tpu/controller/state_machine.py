@@ -109,7 +109,14 @@ def compose_view(store: PropertyStore, table: str) -> None:
     current states could overwrite a newer view last and leave routing
     wrong until the next current-state event.
     """
-    lock = getattr(store, "compose_lock", None) or threading.Lock()
+    lock = getattr(store, "compose_lock", None)
+    if lock is None:
+        # every PropertyStore implementation must carry the lock; a
+        # silent per-call fallback lock would disable the serialization
+        # this docstring promises (round-2 advisor finding)
+        raise TypeError(
+            f"{type(store).__name__} has no compose_lock; view "
+            "composition requires per-store serialization")
     with lock:
         view: Dict[str, Dict[str, str]] = {}
         for inst in store.children(LIVE):

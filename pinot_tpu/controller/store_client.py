@@ -43,6 +43,11 @@ class RemotePropertyStore:
         self._watch_lock = threading.Lock()
         self._events: "queue.Queue" = queue.Queue()
         self._closed = False
+        # per-client serialization of compose_view's read-compute-write
+        # (state_machine.compose_view): without the attribute the
+        # composer used to fall back to a throwaway lock, silently
+        # disabling the serialization for remote-store coordinators
+        self.compose_lock = threading.Lock()
 
         ready = threading.Event()
         boot: Dict[str, Optional[BaseException]] = {"err": None}
@@ -104,9 +109,15 @@ class RemotePropertyStore:
         async def send_and_wait() -> dict:
             fut = self._loop.create_future()
             self._pending[req["id"]] = fut
-            write_frame(self._writer, json.dumps(req).encode("utf-8"))
-            await self._writer.drain()
-            return await asyncio.wait_for(fut, self.timeout)
+            try:
+                write_frame(self._writer, json.dumps(req).encode("utf-8"))
+                await self._writer.drain()
+                return await asyncio.wait_for(fut, self.timeout)
+            finally:
+                # timeout/cancel must not leak the entry: a hung server
+                # would otherwise grow _pending per retry, and a late
+                # response would resolve a future nobody awaits
+                self._pending.pop(req["id"], None)
 
         resp = asyncio.run_coroutine_threadsafe(
             send_and_wait(), self._loop).result(self.timeout + 1)
