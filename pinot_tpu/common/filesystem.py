@@ -71,7 +71,82 @@ class LocalPinotFS(PinotFS):
         return os.path.isdir(path)
 
 
-_REGISTRY: Dict[str, Type[PinotFS]] = {"file": LocalPinotFS}
+class HttpPinotFS(PinotFS):
+    """Read-only deep-store client over the controller's /deepstore
+    endpoints (parity: the reference's HTTP segment fetchers,
+    pinot-common/.../segment/fetcher/ — servers without a shared
+    filesystem download committed artifacts from the controller).
+
+    Paths look like ``http://host:port/deepstore/<rel-path>``; rel-path
+    is resolved by the controller strictly inside its deep-store root.
+    ``copy(src, dst_local)`` downloads — a segment DIRECTORY arrives as
+    the upload tar format and is unpacked at ``dst``. Mutations raise:
+    the deep store's writer is the controller.
+    """
+
+    TIMEOUT_S = 30.0
+
+    def _split(self, path: str):
+        marker = "/deepstore/"
+        i = path.find(marker)
+        if i < 0:
+            raise ValueError(f"not a deep-store URI: {path!r}")
+        return path[:i], path[i + len(marker):]
+
+    def _call(self, path: str, op: str) -> bytes:
+        import urllib.parse
+        import urllib.request
+        base, rel = self._split(path)
+        url = f"{base}/deepstore/{op}?path=" + urllib.parse.quote(rel)
+        with urllib.request.urlopen(url, timeout=self.TIMEOUT_S) as resp:
+            return resp.read()
+
+    def _stat(self, path: str) -> dict:
+        import json
+        return json.loads(self._call(path, "stat"))
+
+    def exists(self, path: str) -> bool:
+        return bool(self._stat(path)["exists"])
+
+    def is_directory(self, path: str) -> bool:
+        return bool(self._stat(path)["isDirectory"])
+
+    def list_files(self, path: str) -> List[str]:
+        import json
+        files = json.loads(self._call(path, "list"))["files"]
+        return [path.rstrip("/") + "/" + f for f in files]
+
+    def copy(self, src: str, dst: str) -> bool:
+        # stat BEFORE downloading: deciding dir-vs-file after the fact
+        # could disagree with the downloaded payload if the controller
+        # deletes/replaces the path in between (and it saves nothing —
+        # either order is two round-trips)
+        is_dir = self._stat(src)["isDirectory"]
+        data = self._call(src, "download")
+        if is_dir:
+            from pinot_tpu.controller.http_api import unpack_segment_tar
+            os.makedirs(dst, exist_ok=True)
+            unpack_segment_tar(data, dst)
+        else:
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            with open(dst, "wb") as f:
+                f.write(data)
+        return True
+
+    def mkdir(self, path: str) -> None:
+        raise PermissionError("HttpPinotFS is read-only (the deep "
+                              "store's writer is the controller)")
+
+    def delete(self, path: str) -> bool:
+        raise PermissionError("HttpPinotFS is read-only")
+
+    def move(self, src: str, dst: str) -> bool:
+        raise PermissionError("HttpPinotFS is read-only")
+
+
+_REGISTRY: Dict[str, Type[PinotFS]] = {"file": LocalPinotFS,
+                                       "http": HttpPinotFS,
+                                       "https": HttpPinotFS}
 
 
 def register_fs(scheme: str, cls: Type[PinotFS]) -> None:

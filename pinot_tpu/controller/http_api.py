@@ -87,6 +87,13 @@ class ControllerApiServer(ApiServer):
                    self._stopped_consuming)
         router.add("POST", "/segmentCommitStart", self._commit_start)
         router.add("POST", "/segmentCommitEnd", self._commit_end)
+        # deep-store access for servers without a shared filesystem
+        # (parity: common/segment/fetcher HTTP segment fetchers + the
+        # controller serving segment downloads): segment dirs travel as
+        # the same tar format the upload endpoint accepts
+        router.add("GET", "/deepstore/download", self._deepstore_download)
+        router.add("GET", "/deepstore/stat", self._deepstore_stat)
+        router.add("GET", "/deepstore/list", self._deepstore_list)
 
     # -- handlers ----------------------------------------------------------
     async def _console(self, request: HttpRequest) -> HttpResponse:
@@ -251,6 +258,46 @@ class ControllerApiServer(ApiServer):
             resp = self.controller.realtime.commit_end(
                 table, name, instance, offset, seg_dir)
         return HttpResponse.of_json(resp.to_json())
+
+    def _deepstore_path(self, request: HttpRequest):
+        """Resolve ?path= strictly INSIDE the deep-store root (path
+        traversal outside it is refused)."""
+        root = os.path.realpath(self.manager.deep_store_dir)
+        rel = request.query.get("path", "")
+        full = os.path.realpath(os.path.join(root, rel))
+        if full != root and not full.startswith(root + os.sep):
+            return None
+        return full
+
+    async def _deepstore_download(self, request: HttpRequest
+                                  ) -> HttpResponse:
+        full = self._deepstore_path(request)
+        if full is None:
+            return HttpResponse.error(403, "path outside deep store")
+        if os.path.isdir(full):
+            return HttpResponse(200, pack_segment_dir(full),
+                                content_type="application/octet-stream")
+        if os.path.isfile(full):
+            with open(full, "rb") as f:
+                return HttpResponse(200, f.read(),
+                                    content_type="application/octet-stream")
+        return HttpResponse.error(404, "not found")
+
+    async def _deepstore_stat(self, request: HttpRequest) -> HttpResponse:
+        full = self._deepstore_path(request)
+        if full is None:
+            return HttpResponse.error(403, "path outside deep store")
+        return HttpResponse.of_json({
+            "exists": os.path.exists(full),
+            "isDirectory": os.path.isdir(full)})
+
+    async def _deepstore_list(self, request: HttpRequest) -> HttpResponse:
+        full = self._deepstore_path(request)
+        if full is None:
+            return HttpResponse.error(403, "path outside deep store")
+        if not os.path.isdir(full):
+            return HttpResponse.error(404, "not a directory")
+        return HttpResponse.of_json({"files": sorted(os.listdir(full))})
 
     async def _segment_metadata(self, request: HttpRequest) -> HttpResponse:
         meta = self.manager.segment_metadata(

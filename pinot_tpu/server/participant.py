@@ -52,6 +52,23 @@ class ServerParticipant(StateModel):
                 self.server, self.manager, self.completion, work)
         return self._realtime
 
+    def _fetch_segment_dir(self, table: str, segment: str,
+                           download_path: str) -> str:
+        """SegmentFetcherAndLoader parity: a remote downloadPath (e.g.
+        http://controller/deepstore/...) is fetched through the PinotFS
+        registry into the server's local segment cache; local paths
+        load in place (the shared-filesystem deployment)."""
+        if "://" not in download_path or \
+                download_path.startswith("file://"):
+            return download_path.replace("file://", "", 1)
+        from pinot_tpu.common.filesystem import get_fs
+        work = self.work_dir or os.path.join(
+            tempfile.gettempdir(),
+            f"pinot_tpu_seg_{self.server.instance_id}")
+        local = os.path.join(work, "fetched", table, segment)
+        get_fs(download_path).copy(download_path, local)
+        return local
+
     def on_become_consuming(self, table: str, segment: str) -> None:
         self.realtime.start_consuming(table, segment)
 
@@ -69,7 +86,8 @@ class ServerParticipant(StateModel):
         schema = self.manager.get_schema(raw_table(table))
         config = self.manager.get_table_config(table)
         seg = ImmutableSegmentLoader.load(
-            meta["downloadPath"], schema=schema,
+            self._fetch_segment_dir(table, segment, meta["downloadPath"]),
+            schema=schema,
             index_loading_config=(config.indexing_config
                                   if config else None))
         self.server.data_manager.table(table, create=True).add_segment(seg)

@@ -82,6 +82,33 @@ def cmd_post_query(args) -> int:
     return 0
 
 
+def cmd_query_runner(args) -> int:
+    """Replay a query file against a broker at a latency/QPS report.
+
+    Parity: tools/perf/QueryRunner.java:43-90 — modes singleThread /
+    multiThreads / targetQPS / increasingQPS."""
+    from pinot_tpu.tools.perf import (QueryRunner, http_query_fn,
+                                      load_query_file)
+    runner = QueryRunner(http_query_fn(args.broker),
+                         load_query_file(args.query_file))
+    if args.mode == "singleThread":
+        reports = [runner.single_thread(num_times=args.num_times)]
+    elif args.mode == "multiThreads":
+        reports = [runner.multi_threads(num_threads=args.num_threads,
+                                        num_times=args.num_times)]
+    elif args.mode == "targetQPS":
+        reports = [runner.target_qps(args.qps, args.duration,
+                                     num_threads=args.num_threads)]
+    else:
+        reports = runner.increasing_qps(
+            args.qps, args.step_qps, args.steps, args.duration,
+            num_threads=args.num_threads)
+    for r in reports:
+        print(r)
+    print(json.dumps([r.to_json() for r in reports]))
+    return 0
+
+
 def cmd_rebalance_table(args) -> int:
     out = _http("POST",
                 f"http://{args.controller}/tables/{args.table}/rebalance"
@@ -483,6 +510,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--broker", default="127.0.0.1:8099")
     sp.add_argument("--query", required=True)
     sp.set_defaults(fn=cmd_post_query)
+
+    sp = sub.add_parser("QueryRunner",
+                        help="replay a query file; latency/QPS report")
+    sp.add_argument("--broker", default="127.0.0.1:8099")
+    sp.add_argument("--query-file", required=True)
+    sp.add_argument("--mode", default="singleThread",
+                    choices=["singleThread", "multiThreads", "targetQPS",
+                             "increasingQPS"])
+    sp.add_argument("--num-times", type=int, default=1)
+    sp.add_argument("--num-threads", type=int, default=8)
+    sp.add_argument("--qps", type=float, default=10.0)
+    sp.add_argument("--duration", type=float, default=10.0,
+                    help="seconds per (step-)run in the QPS modes")
+    sp.add_argument("--step-qps", type=float, default=10.0)
+    sp.add_argument("--steps", type=int, default=3)
+    sp.set_defaults(fn=cmd_query_runner)
 
     sp = sub.add_parser("RebalanceTable", help="rebalance segments")
     ctrl(sp)

@@ -1,19 +1,32 @@
 """Batch ingestion: build one segment per input file, push to controller.
 
-Parity: pinot-hadoop — SegmentCreationJob (one mapper per input file runs
-the segment build) + SegmentTarPushJob (POST artifacts to the controller).
-MapReduce becomes a thread pool; the "push" is the resource manager's
-segment upload (or any callable for remote push).
+Parity: pinot-hadoop — SegmentCreationJob (one MAPPER PROCESS per input
+file runs the segment build, hadoop/job/SegmentCreationJob.java) +
+SegmentTarPushJob (POST artifacts to the controller). The MapReduce
+mapper fleet becomes a process pool (true parallel builds — dictionary
+sort + bit-packing are CPU-bound Python/numpy); the "push" is the
+resource manager's segment upload (or any callable for remote push).
 """
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
 from pinot_tpu.common.schema import Schema, TimeUnit
 from pinot_tpu.common.table_config import TableConfig
 from pinot_tpu.tools.create_segment import create_segment_from_file
+
+
+def _build_one(args) -> str:
+    """One mapper: input file → segment dir (module-level so the
+    process pool can pickle it)."""
+    (path, fmt, schema, seg_dir, table_config, name, expressions,
+     incoming_time_unit) = args
+    create_segment_from_file(
+        path, fmt, schema, seg_dir, table_config, segment_name=name,
+        expressions=expressions, incoming_time_unit=incoming_time_unit)
+    return seg_dir
 
 
 def batch_build_segments(
@@ -22,21 +35,36 @@ def batch_build_segments(
         segment_name_prefix: Optional[str] = None,
         expressions: Optional[Dict[str, str]] = None,
         incoming_time_unit: Optional[TimeUnit] = None,
-        max_workers: int = 4) -> List[str]:
-    """Build one segment per input file (parallel); returns segment dirs."""
+        max_workers: int = 4, use_processes: bool = True) -> List[str]:
+    """Build one segment per input file in parallel; returns segment
+    dirs (input order). `use_processes=False` falls back to threads
+    (e.g. for non-picklable expression callables)."""
     prefix = segment_name_prefix or schema.schema_name
-
-    def build(i_path):
-        i, path = i_path
-        seg_dir = os.path.join(out_base, f"{prefix}_{i}")
-        create_segment_from_file(
-            path, fmt, schema, seg_dir, table_config,
-            segment_name=f"{prefix}_{i}", expressions=expressions,
-            incoming_time_unit=incoming_time_unit)
-        return seg_dir
-
-    with ThreadPoolExecutor(max_workers=max_workers) as pool:
-        return list(pool.map(build, enumerate(input_paths)))
+    jobs = [(path, fmt, schema, os.path.join(out_base, f"{prefix}_{i}"),
+             table_config, f"{prefix}_{i}", expressions,
+             incoming_time_unit)
+            for i, path in enumerate(input_paths)]
+    workers = min(max_workers, max(len(jobs), 1))
+    pool = None
+    if use_processes:
+        try:
+            # spawn, not fork: the caller may already have initialized
+            # the JAX/XLA runtime (any segment load does), and forking
+            # its runtime threads can deadlock the workers; jobs are
+            # picklable module-level tuples so spawn is safe
+            import multiprocessing
+            pool = ProcessPoolExecutor(
+                max_workers=workers,
+                mp_context=multiprocessing.get_context("spawn"))
+        except (OSError, ImportError):
+            # restricted environments without process support: degrade
+            # to threads rather than failing the job (worker errors
+            # still propagate from pool.map below)
+            pool = None
+    if pool is None:
+        pool = ThreadPoolExecutor(max_workers=workers)
+    with pool:
+        return list(pool.map(_build_one, jobs))
 
 
 def push_segments(segment_dirs: Sequence[str],

@@ -1,0 +1,210 @@
+"""Query replay perf driver.
+
+Parity: pinot-tools/.../tools/perf/QueryRunner.java:43-90 — replay a
+query file against a broker in four modes (singleThread, multiThreads,
+targetQPS, increasingQPS) and report latency percentiles/QPS. The
+driver measures SERVING throughput (broker + scatter-gather + engine),
+complementing bench.py's single-query latency headline.
+
+The target is any callable `query_fn(pql) -> response`; `http_query_fn`
+builds one for a broker's HTTP endpoint, and an in-process
+BrokerRequestHandler's `.handle` works directly (the embedded-cluster
+path the tests use).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PerfReport:
+    mode: str
+    num_queries: int
+    num_errors: int
+    duration_s: float
+    qps: float
+    latency_avg_ms: float
+    latency_p50_ms: float
+    latency_p90_ms: float
+    latency_p99_ms: float
+    latency_max_ms: float
+    # targetQPS modes: dispatch slots that fell behind schedule
+    missed_slots: int = 0
+    target_qps: Optional[float] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        t = f" target={self.target_qps:g}qps" if self.target_qps else ""
+        return (f"[{self.mode}{t}] {self.num_queries} queries "
+                f"({self.num_errors} errors) in {self.duration_s:.2f}s = "
+                f"{self.qps:.1f} QPS; latency ms avg {self.latency_avg_ms:.2f} "
+                f"p50 {self.latency_p50_ms:.2f} p90 {self.latency_p90_ms:.2f} "
+                f"p99 {self.latency_p99_ms:.2f} max {self.latency_max_ms:.2f}")
+
+
+def load_query_file(path: str) -> List[str]:
+    """One PQL per line; blank lines and #-comments skipped (the
+    reference's query-file format)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            q = line.strip()
+            if q and not q.startswith("#"):
+                out.append(q)
+    return out
+
+
+def http_query_fn(broker: str, timeout: float = 30.0
+                  ) -> Callable[[str], dict]:
+    """POST {"pql": ...} to http://<broker>/query (pinot-api transport)."""
+    import urllib.request
+
+    def fn(pql: str) -> dict:
+        req = urllib.request.Request(
+            f"http://{broker}/query",
+            data=json.dumps({"pql": pql}).encode("utf-8"),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    return fn
+
+
+class QueryRunner:
+    def __init__(self, query_fn: Callable[[str], object],
+                 queries: Sequence[str]):
+        if not queries:
+            raise ValueError("empty query list")
+        self.query_fn = query_fn
+        self.queries = list(queries)
+
+    # -- internals ---------------------------------------------------------
+    def _run_one(self, pql: str, lat_ms: List[float],
+                 errors: List[int], lock: threading.Lock) -> None:
+        t0 = time.perf_counter()
+        err = 0
+        try:
+            resp = self.query_fn(pql)
+            exc = getattr(resp, "exceptions", None)
+            if exc is None and isinstance(resp, dict):
+                exc = resp.get("exceptions")
+            if exc:
+                err = 1
+        except Exception:  # noqa: BLE001 — an error IS the measurement
+            err = 1
+        dt = (time.perf_counter() - t0) * 1e3
+        with lock:
+            lat_ms.append(dt)
+            errors[0] += err
+
+    def _report(self, mode: str, lat_ms: List[float], errors: int,
+                duration: float, missed: int = 0,
+                target_qps: Optional[float] = None) -> PerfReport:
+        a = np.asarray(lat_ms) if lat_ms else np.zeros(1)
+        return PerfReport(
+            mode=mode, num_queries=len(lat_ms), num_errors=errors,
+            duration_s=duration,
+            qps=len(lat_ms) / duration if duration > 0 else 0.0,
+            latency_avg_ms=float(a.mean()),
+            latency_p50_ms=float(np.percentile(a, 50)),
+            latency_p90_ms=float(np.percentile(a, 90)),
+            latency_p99_ms=float(np.percentile(a, 99)),
+            latency_max_ms=float(a.max()),
+            missed_slots=missed, target_qps=target_qps)
+
+    # -- modes (QueryRunner.java parity) -----------------------------------
+    def single_thread(self, num_times: int = 1) -> PerfReport:
+        """Replay the file num_times back-to-back on one thread."""
+        lat: List[float] = []
+        errors = [0]
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+        for _ in range(num_times):
+            for q in self.queries:
+                self._run_one(q, lat, errors, lock)
+        return self._report("singleThread", lat, errors[0],
+                            time.perf_counter() - t0)
+
+    def multi_threads(self, num_threads: int = 4,
+                      num_times: int = 1) -> PerfReport:
+        """num_threads workers drain the replay list concurrently."""
+        work = [q for _ in range(num_times) for q in self.queries]
+        idx = [0]
+        lat: List[float] = []
+        errors = [0]
+        lock = threading.Lock()
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    if idx[0] >= len(work):
+                        return
+                    q = work[idx[0]]
+                    idx[0] += 1
+                self._run_one(q, lat, errors, lock)
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=worker) for _ in range(num_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return self._report(f"multiThreads({num_threads})", lat, errors[0],
+                            time.perf_counter() - t0)
+
+    def target_qps(self, qps: float, duration_s: float,
+                   num_threads: int = 8) -> PerfReport:
+        """Dispatch on a fixed schedule; a pool of workers serves the
+        slots. Slots whose dispatch falls behind schedule are counted
+        (the reference logs the same backlog signal)."""
+        period = 1.0 / qps
+        lat: List[float] = []
+        errors = [0]
+        missed = [0]
+        lock = threading.Lock()
+        slot = [0]
+        t_start = time.perf_counter()
+        stop = t_start + duration_s
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    i = slot[0]
+                    slot[0] += 1
+                due = t_start + i * period
+                now = time.perf_counter()
+                if now >= stop:
+                    return
+                if due > now:
+                    time.sleep(due - now)
+                elif now - due > period:
+                    with lock:
+                        missed[0] += 1
+                self._run_one(self.queries[i % len(self.queries)],
+                              lat, errors, lock)
+
+        ts = [threading.Thread(target=worker) for _ in range(num_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return self._report("targetQPS", lat, errors[0],
+                            time.perf_counter() - t_start,
+                            missed=missed[0], target_qps=qps)
+
+    def increasing_qps(self, start_qps: float, step_qps: float,
+                       steps: int, step_duration_s: float,
+                       num_threads: int = 8) -> List[PerfReport]:
+        """targetQPS ladder (the reference's increasingQPS mode): one
+        report per rung so saturation shows as p99 blow-up/missed
+        slots."""
+        return [self.target_qps(start_qps + i * step_qps, step_duration_s,
+                                num_threads)
+                for i in range(steps)]

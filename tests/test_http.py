@@ -200,3 +200,71 @@ def test_rest_upload_storage_quota_403(tmp_path):
     finally:
         ctl.close()
         c.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP deep-store PinotFS (parity: pinot-common segment fetchers — servers
+# without a shared filesystem fetch committed artifacts over HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_http_pinot_fs_fetch_and_load(http_cluster):
+    c, ctl, conn, oracle = http_cluster
+    from pinot_tpu.common.filesystem import HttpPinotFS, get_fs
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    base = f"http://127.0.0.1:{c.controller_port}/deepstore"
+    seg_uri = f"{base}/baseballStats_OFFLINE/ht_0"
+    fs = get_fs(seg_uri)
+    assert isinstance(fs, HttpPinotFS)
+    assert fs.exists(seg_uri)
+    assert fs.is_directory(seg_uri)
+    assert not fs.exists(f"{base}/baseballStats_OFFLINE/nope")
+    files = fs.list_files(seg_uri)
+    assert any(f.endswith("metadata.json") for f in files), files
+
+    # download → local load → same row count as the uploaded artifact
+    dst = tempfile.mkdtemp() + "/fetched_seg"
+    assert fs.copy(seg_uri, dst)
+    seg = ImmutableSegmentLoader.load(dst)
+    assert seg.num_docs == 1200
+    # read-only: the controller owns deep-store mutations
+    with pytest.raises(PermissionError):
+        fs.delete(seg_uri)
+
+
+def test_http_deepstore_refuses_path_traversal(http_cluster):
+    c, _, _, _ = http_cluster
+    import urllib.error
+    for rel in ("../../etc/passwd", "..%2F..%2Fetc%2Fpasswd"):
+        try:
+            status, _ = _get(c.controller_port,
+                             f"/deepstore/download?path={rel}")
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status in (403, 404), rel
+
+
+def test_participant_fetches_http_download_path(http_cluster):
+    """OFFLINE→ONLINE with an http:// downloadPath goes through the
+    PinotFS fetch into the server's local cache (SegmentFetcherAndLoader
+    parity) and serves queries identically."""
+    c, ctl, conn, oracle = http_cluster
+    srv = next(iter(c.servers.values()))
+    from pinot_tpu.server.participant import ServerParticipant
+    base = f"http://127.0.0.1:{c.controller_port}/deepstore"
+    # craft a participant against the live manager and a remote path
+    p = ServerParticipant(srv, c.controller.manager,
+                          work_dir=tempfile.mkdtemp())
+    local = p._fetch_segment_dir(
+        "baseballStats_OFFLINE", "ht_1",
+        f"{base}/baseballStats_OFFLINE/ht_1")
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    seg = ImmutableSegmentLoader.load(local)
+    assert seg.num_docs == 1200
+    # a plain local path passes through untouched
+    meta = c.controller.manager.segment_metadata("baseballStats_OFFLINE",
+                                                 "ht_2")
+    assert p._fetch_segment_dir("baseballStats_OFFLINE", "ht_2",
+                                meta["downloadPath"]) == \
+        meta["downloadPath"]

@@ -192,6 +192,42 @@ def test_batch_ingest_to_cluster():
         cluster.stop()
 
 
+def test_parallel_batch_ingest_rest_push():
+    """Parity: SegmentCreationJob runs one MAPPER PROCESS per input file
+    in parallel and SegmentTarPushJob POSTs the artifacts — 4 input
+    files build concurrently on a process pool and push over the
+    controller's REST upload endpoint."""
+    from pinot_tpu.client import ControllerClient
+    from pinot_tpu.tools.batch_ingest import (batch_build_segments,
+                                              push_segments)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    base = tempfile.mkdtemp()
+    paths = []
+    for i in range(4):
+        p = os.path.join(base, f"in_{i}.csv")
+        _write_csv(p)
+        paths.append(p)
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"),
+                              num_servers=2, http=True)
+    ctl = ControllerClient("127.0.0.1", cluster.controller_port)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        dirs = batch_build_segments(
+            paths, "csv", make_schema(), os.path.join(base, "segs"),
+            make_table_config(), max_workers=4, use_processes=True)
+        assert len(dirs) == 4
+        push_segments(dirs, lambda d: ctl.upload_segment_dir(
+            "baseballStats_OFFLINE", d))
+        resp = cluster.query("SELECT COUNT(*), SUM(runs) FROM baseballStats")
+        assert int(resp.aggregation_results[0].value) == 12
+        assert float(resp.aggregation_results[1].value) == 120.0
+    finally:
+        ctl.close()
+        cluster.stop()
+
+
 def test_poison_record_does_not_kill_realtime_consumer():
     """A record that decodes but fails type coercion must be dropped, not
     kill the partition consumer."""
