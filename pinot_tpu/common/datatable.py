@@ -83,6 +83,8 @@ class DataTable:
         dt = cls(metadata=block.stats.to_metadata(),
                  exceptions=list(block.exceptions))
         dt.metadata["timeUsedMs"] = f"{block.stats.time_used_ms:.3f}"
+        if block.execution_path is not None:
+            dt.metadata["executionPath"] = block.execution_path
         # numpy-scalar normalization happens inside serde._write_obj, so
         # rows can carry intermediates as-is
         if block.group_map is not None:

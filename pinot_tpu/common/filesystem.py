@@ -124,7 +124,7 @@ class HttpPinotFS(PinotFS):
         is_dir = self._stat(src)["isDirectory"]
         data = self._call(src, "download")
         if is_dir:
-            from pinot_tpu.controller.http_api import unpack_segment_tar
+            from pinot_tpu.common.segment_tar import unpack_segment_tar
             os.makedirs(dst, exist_ok=True)
             unpack_segment_tar(data, dst)
         else:

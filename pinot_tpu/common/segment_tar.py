@@ -1,0 +1,34 @@
+"""Segment artifact packing: segment dir <-> tar.gz bytes.
+
+The wire format for segment artifacts everywhere they travel — the
+controller upload endpoint, the deep-store HTTP download, the LLC
+split-commit upload (parity: the reference's TarGzCompressionUtils,
+pinot-common/.../utils/TarGzCompressionUtils.java)."""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+
+def pack_segment_dir(segment_dir: str) -> bytes:
+    """Segment directory → tar.gz bytes (the upload artifact format)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for entry in sorted(os.listdir(segment_dir)):
+            tar.add(os.path.join(segment_dir, entry), arcname=entry)
+    return buf.getvalue()
+
+
+def unpack_segment_tar(data: bytes, dest_dir: str) -> None:
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            # flat segment artifacts only: refuse path traversal
+            name = os.path.normpath(member.name)
+            if name.startswith("..") or os.path.isabs(name) or \
+                    not (member.isfile() or member.isdir()):
+                raise ValueError(f"unsafe tar member: {member.name}")
+        try:
+            tar.extractall(dest_dir, filter="data")
+        except TypeError:            # Python < 3.12: no filter kwarg
+            tar.extractall(dest_dir)

@@ -24,27 +24,10 @@ from pinot_tpu.controller.quota import StorageQuotaExceededError
 from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
 
 
-def pack_segment_dir(segment_dir: str) -> bytes:
-    """Segment directory → tar.gz bytes (the upload artifact format)."""
-    buf = io.BytesIO()
-    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
-        for entry in sorted(os.listdir(segment_dir)):
-            tar.add(os.path.join(segment_dir, entry), arcname=entry)
-    return buf.getvalue()
-
-
-def unpack_segment_tar(data: bytes, dest_dir: str) -> None:
-    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
-        for member in tar.getmembers():
-            # flat segment artifacts only: refuse path traversal
-            name = os.path.normpath(member.name)
-            if name.startswith("..") or os.path.isabs(name) or \
-                    not (member.isfile() or member.isdir()):
-                raise ValueError(f"unsafe tar member: {member.name}")
-        try:
-            tar.extractall(dest_dir, filter="data")
-        except TypeError:            # Python < 3.12: no filter kwarg
-            tar.extractall(dest_dir)
+# canonical home is common/segment_tar.py; re-exported here because the
+# upload/download endpoints are where most callers first meet the format
+from pinot_tpu.common.segment_tar import (pack_segment_dir,   # noqa: F401
+                                          unpack_segment_tar)
 
 
 class ControllerApiServer(ApiServer):

@@ -65,3 +65,7 @@ class IntermediateResultsBlock:
     selection_display_cols: Optional[int] = None
     stats: ExecutionStats = dataclasses.field(default_factory=ExecutionStats)
     exceptions: List[str] = dataclasses.field(default_factory=list)
+    # which instance-level path served this block: "sharded" (mesh ICI
+    # combine) or "sequential" (per-segment + host merge); None when the
+    # block came from a layer that doesn't choose (e.g. per-segment)
+    execution_path: Optional[str] = None

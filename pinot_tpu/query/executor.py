@@ -52,8 +52,31 @@ class ServerQueryExecutor:
                 return blk
 
         blocks: List[IntermediateResultsBlock] = []
+        extra_parts = extra_matched = 0
         with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
             for seg in selected:
+                if getattr(seg, "is_mutable", False) and \
+                        hasattr(seg, "device_view"):
+                    # consuming segment: the periodic sorted snapshot
+                    # serves the frozen prefix on the DEVICE kernels and
+                    # the post-freeze tail host-side; the two parts
+                    # combine like any other pair of segments
+                    # (reference: consuming segments are first-class
+                    # engine targets, MutableSegmentImpl.java:64-198)
+                    frozen, tail = seg.device_view()
+                    fb = tb = None
+                    if frozen is not None:
+                        fb = self._execute_segment(frozen, request)
+                        blocks.append(fb)
+                    if tail.num_docs > 0 or frozen is None:
+                        tb = self._execute_segment(tail, request)
+                        blocks.append(tb)
+                    if fb is not None and tb is not None:
+                        extra_parts += 1
+                        if fb.stats.num_segments_matched and \
+                                tb.stats.num_segments_matched:
+                            extra_matched += 1
+                    continue
                 if getattr(seg, "is_mutable", False) and \
                         hasattr(seg, "snapshot_view"):
                     # consuming segment: freeze (num_docs, cardinalities) so
@@ -73,6 +96,11 @@ class ServerQueryExecutor:
                 blk.selection_columns = list(request.selection.columns)
         else:
             blk = combine_blocks(request, blocks)
+        if extra_parts:
+            # frozen+tail pairs are ONE logical consuming segment: both
+            # processed always, matched only when both halves matched
+            blk.stats.num_segments_processed -= extra_parts
+            blk.stats.num_segments_matched -= extra_matched
         blk.stats.num_segments_pruned = num_pruned
         blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
         return blk

@@ -4,10 +4,12 @@ Parity: pinot-core/.../indexsegment/mutable/MutableSegmentImpl.java:64-198 —
 per-column mutable dictionary (ARRIVAL order: ids must stay stable as values
 arrive, so unlike immutable segments the dictionary is unsorted) + growable
 fixed-width forward indexes; queries snapshot (num_docs, lanes[:n]) without
-blocking the writer. Queries against mutable segments run on the host
-executor (unsorted dictionaries break the device kernels' sorted-id-interval
-assumption); on commit RealtimeSegmentConverter re-sorts everything into a
-standard immutable segment (RealtimeSegmentConverter.java:85-129).
+blocking the writer. Device serving: a PERIODIC SORTED SNAPSHOT freezes the
+row prefix into a standard in-memory ImmutableSegment (sorted dictionaries,
+remapped id lanes) so the TPU kernels serve the bulk of a consuming segment,
+with only the post-freeze tail on the host executor (see device_view); on
+commit RealtimeSegmentConverter re-sorts everything into a standard
+immutable segment (RealtimeSegmentConverter.java:85-129).
 """
 from __future__ import annotations
 
@@ -255,11 +257,13 @@ class _SnapshotDictionary:
 class _SnapshotSource:
     """Point-in-time column view: doc count AND dictionary cardinality are
     pinned at snapshot creation, so every access within one query sees the
-    same rows (the writer keeps appending concurrently)."""
+    same rows (the writer keeps appending concurrently). `start` slices a
+    TAIL window [start, n) for the hybrid frozen+tail serving mode."""
 
-    def __init__(self, ds: _MutableDataSource, n: int):
+    def __init__(self, ds: _MutableDataSource, n: int, start: int = 0):
         self._ds = ds
         self._n = n
+        self._start = start
         self.field = ds.field
         self.has_dictionary = ds.has_dictionary
         self.dictionary = _SnapshotDictionary(
@@ -273,7 +277,7 @@ class _SnapshotSource:
     @property
     def metadata(self) -> ColumnMetadata:
         card = self.dictionary.cardinality if self.has_dictionary \
-            else self._n
+            else self._n - self._start
         return ColumnMetadata(
             name=self.field.name, data_type=self.field.data_type,
             cardinality=card,
@@ -284,19 +288,19 @@ class _SnapshotSource:
             else None,
             max_value=self.dictionary.max_value if self.has_dictionary
             else None,
-            total_number_of_entries=self._n)
+            total_number_of_entries=self._n - self._start)
 
     @property
     def dict_ids(self) -> Optional[np.ndarray]:
         if self._ds._sv is None or not self.has_dictionary:
             return None
-        return self._ds._sv.snapshot(self._n)
+        return self._ds._sv.snapshot(self._n)[self._start:]
 
     @property
     def raw_values(self) -> Optional[np.ndarray]:
         if self._ds._sv is None or self.has_dictionary:
             return None
-        return self._ds._sv.snapshot(self._n)
+        return self._ds._sv.snapshot(self._n)[self._start:]
 
     @property
     def mv_dict_ids(self) -> Optional[np.ndarray]:
@@ -304,9 +308,9 @@ class _SnapshotSource:
             return None
         if self._mv_cache is None:
             card = self.dictionary.cardinality
-            rows = self._ds._mv[: self._n]
+            rows = self._ds._mv[self._start: self._n]
             width = max((len(r) for r in rows), default=1)
-            out = np.full((self._n, width), card, dtype=np.int32)
+            out = np.full((len(rows), width), card, dtype=np.int32)
             for i, r in enumerate(rows):
                 out[i, : len(r)] = r
             self._mv_cache = out
@@ -317,15 +321,21 @@ class MutableSegmentView:
     """Frozen (num_docs, cardinalities) view of a consuming segment — what
     one query executes against. Parity: the reference snapshots the doc
     count once per query (MutableSegmentImpl readers index up to a captured
-    numDocsIndexed); here the whole column view is pinned."""
+    numDocsIndexed); here the whole column view is pinned.
+
+    `start` > 0 makes this a TAIL view (rows [start, num_docs)) — the
+    un-snapshotted remainder served host-side next to a frozen device
+    snapshot of rows [0, start)."""
 
     is_mutable = True
 
-    def __init__(self, impl: "MutableSegmentImpl"):
+    def __init__(self, impl: "MutableSegmentImpl", start: int = 0):
         self._impl = impl
-        self.segment_name = impl.segment_name
+        self.segment_name = impl.segment_name if start == 0 else \
+            f"{impl.segment_name}__tail"
         self.schema = impl.schema
-        self.num_docs = impl._num_docs
+        self.start = start
+        self.num_docs = impl._num_docs - start
         self._sources: Dict[str, _SnapshotSource] = {}
 
     @property
@@ -344,7 +354,8 @@ class MutableSegmentView:
         src = self._sources.get(column)
         if src is None:
             src = _SnapshotSource(self._impl._sources[column],
-                                  self.num_docs)
+                                  self.start + self.num_docs,
+                                  start=self.start)
             self._sources[column] = src
         return src
 
@@ -382,6 +393,8 @@ class MutableSegmentImpl:
         self._lock = threading.Lock()
         self._start_time: Optional[int] = None
         self._end_time: Optional[int] = None
+        self._frozen = None                  # sorted device snapshot
+        self._freeze_lock = threading.Lock()
         self.creation_time_ms = int(time.time() * 1e3)
 
     # -- write -------------------------------------------------------------
@@ -403,9 +416,126 @@ class MutableSegmentImpl:
         return True
 
     # -- query interface (ImmutableSegment-compatible) ---------------------
-    def snapshot_view(self) -> MutableSegmentView:
+    def snapshot_view(self, start: int = 0) -> MutableSegmentView:
         """Consistent point-in-time view for one query."""
-        return MutableSegmentView(self)
+        return MutableSegmentView(self, start=start)
+
+    # -- device path: periodic sorted snapshot -----------------------------
+    #
+    # The TPU-first answer to "consuming segments are first-class query
+    # targets" (reference: MutableSegmentImpl.java:64-198 serves queries
+    # on the same engine): arrival-order dictionaries break the device
+    # kernels' sorted-id preconditions, so a background-free PERIODIC
+    # SNAPSHOT re-sorts each dictionary, remaps the frozen row prefix
+    # into sorted-id space, and materializes a standard in-memory
+    # ImmutableSegment — every device kernel (and its jit cache) applies
+    # unchanged. Queries then run [frozen device part] + [host tail of
+    # rows indexed since the freeze] as two segments and merge through
+    # the ordinary combine path. Freeze points double (8192, 16384, ...)
+    # so the jit shape set stays logarithmic in segment size and the
+    # O(n + card log card) rebuild cost amortizes to O(1)/row.
+
+    FREEZE_MIN_ROWS = 8192
+
+    def device_view(self):
+        """(frozen ImmutableSegment | None, tail MutableSegmentView).
+
+        The tail view may be empty (num_docs == 0) when no rows arrived
+        since the freeze; callers skip executing it then. Rebuild+swap
+        is serialized by _freeze_lock (queries run on a worker pool);
+        superseded snapshots are NOT destroyed eagerly — an in-flight
+        query may still be executing against one, so their device
+        arrays are released by GC when the last reference drops."""
+        n = self._num_docs
+        snap = self._frozen
+        if n >= self.FREEZE_MIN_ROWS and \
+                (snap is None or n >= 2 * snap.num_docs):
+            with self._freeze_lock:
+                snap = self._frozen        # another query may have won
+                if snap is None or n >= 2 * snap.num_docs:
+                    snap = self._build_frozen(n)
+                    self._frozen = snap
+        if snap is None:
+            return None, self.snapshot_view()
+        return snap, self.snapshot_view(start=snap.num_docs)
+
+    def _build_frozen(self, n: int):
+        """Rows [0, n) as a sorted-dictionary in-memory ImmutableSegment."""
+        from pinot_tpu.segment.dictionary import Dictionary
+        from pinot_tpu.segment.loader import DataSource, ImmutableSegment
+
+        tc = self.schema.time_column
+        sources: Dict[str, DataSource] = {}
+        col_meta: Dict[str, ColumnMetadata] = {}
+        for name, ms in self._sources.items():
+            f = ms.field
+            if not ms.has_dictionary:
+                raw = np.array(ms._sv.snapshot(n), copy=True)
+                cm = ColumnMetadata(
+                    name=name, data_type=f.data_type, cardinality=n,
+                    bits_per_element=32, single_value=True,
+                    has_dictionary=False,
+                    min_value=raw.min() if n else None,
+                    max_value=raw.max() if n else None,
+                    total_number_of_entries=n)
+                ds = DataSource(cm, None)
+                ds.raw_values = raw
+                sources[name] = ds
+                col_meta[name] = cm
+                continue
+            # pin the cardinality, sort values, invert the permutation
+            card = ms.dictionary.cardinality
+            dtype = f.data_type.np_dtype if f.data_type.is_numeric \
+                else object
+            # list slice under the GIL: a consistent copy even while the
+            # consumer thread keeps appending new values
+            vals = np.array(ms.dictionary._values[:card], dtype=dtype)
+            order = np.argsort(vals, kind="stable")
+            sorted_vals = vals[order]
+            remap = np.empty(card + 1, np.int32)
+            remap[order] = np.arange(card, dtype=np.int32)
+            remap[card] = card          # MV padding sentinel
+            if f.single_value:
+                ids = remap[ms._sv.snapshot(n)]
+                mv = None
+                entries = n
+            else:
+                rows = ms._mv[:n]
+                width = max((len(r) for r in rows), default=1)
+                mv = np.full((n, width), card, dtype=np.int32)
+                for i, r in enumerate(rows):
+                    mv[i, : len(r)] = remap[r]
+                ids = None
+                entries = int(sum(len(r) for r in rows))
+            cm = ColumnMetadata(
+                name=name, data_type=f.data_type, cardinality=card,
+                bits_per_element=max(
+                    1, int(np.ceil(np.log2(max(card, 2))))),
+                single_value=f.single_value, sorted=False,
+                has_dictionary=True,
+                min_value=sorted_vals[0] if card else None,
+                max_value=sorted_vals[-1] if card else None,
+                max_number_of_multi_values=(0 if mv is None
+                                            else mv.shape[1]),
+                total_number_of_entries=entries)
+            ds = DataSource(cm, None)
+            ds.dictionary = Dictionary(f.data_type, sorted_vals)
+            ds.dict_ids = ids
+            ds.mv_dict_ids = mv
+            sources[name] = ds
+            col_meta[name] = cm
+        meta = SegmentMetadata(
+            segment_name=f"{self.segment_name}__frozen",
+            table_name=self.table_config.table_name,
+            total_docs=n, columns=col_meta,
+            time_column=tc.name if tc else None,
+            time_unit=tc.time_unit.name if tc else None,
+            start_time=self._start_time, end_time=self._end_time,
+            creation_time_ms=self.creation_time_ms)
+        seg = ImmutableSegment(meta, sources)
+        for ds in sources.values():
+            ds._segment = seg
+        return seg
 
     @property
     def num_docs(self) -> int:
@@ -447,4 +577,7 @@ class MutableSegmentImpl:
         return {name: ds.raw_column(n) for name, ds in self._sources.items()}
 
     def destroy(self) -> None:
+        if self._frozen is not None:
+            self._frozen.destroy()
+            self._frozen = None
         self._sources.clear()

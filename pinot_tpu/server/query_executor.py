@@ -87,7 +87,11 @@ class InstanceQueryExecutor:
                                               UnsupportedOnDevice)
             try:
                 with trace.span(ServerQueryPhase.SHARDED_EXECUTION):
-                    return self.sharded.execute(query, segments)
+                    blk = self.sharded.execute(query, segments)
+                blk.execution_path = "sharded"
+                return blk
             except (NotShardable, GroupsLimitExceeded, UnsupportedOnDevice):
                 pass
-        return self.executor.execute(query, segments, trace=trace)
+        blk = self.executor.execute(query, segments, trace=trace)
+        blk.execution_path = "sequential"
+        return blk

@@ -180,7 +180,10 @@ class QueryRunner:
                     slot[0] += 1
                 due = t_start + i * period
                 now = time.perf_counter()
-                if now >= stop:
+                # slots scheduled beyond the deadline never run —
+                # checking only `now` would let early workers sleep
+                # PAST the deadline and overrun the window
+                if now >= stop or due >= stop:
                     return
                 if due > now:
                     time.sleep(due - now)

@@ -58,9 +58,10 @@ def test_target_and_increasing_qps(perf_cluster):
     runner = QueryRunner(cluster.broker.handle, load_query_file(qfile))
     r = runner.target_qps(qps=50, duration_s=1.0, num_threads=4)
     assert r.mode == "targetQPS" and r.target_qps == 50
-    # scheduled dispatch: close to the target unless saturated
-    assert r.num_queries >= 10
-    assert r.duration_s >= 1.0
+    # scheduled dispatch: close to the target unless saturated; slots
+    # past the deadline never run, so the window can end slightly early
+    assert 10 <= r.num_queries <= 60
+    assert r.duration_s <= 1.5
     rungs = runner.increasing_qps(start_qps=20, step_qps=20, steps=2,
                                   step_duration_s=0.5, num_threads=4)
     assert len(rungs) == 2
