@@ -11,9 +11,7 @@ reference's SegmentCompletionUtils tar.gz push.
 """
 from __future__ import annotations
 
-import io
 import os
-import tarfile
 import tempfile
 
 from pinot_tpu.common.schema import Schema

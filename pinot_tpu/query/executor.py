@@ -55,7 +55,8 @@ class ServerQueryExecutor:
         extra_parts = extra_matched = 0
         with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
             for seg in selected:
-                if getattr(seg, "is_mutable", False) and \
+                if self.use_device and \
+                        getattr(seg, "is_mutable", False) and \
                         hasattr(seg, "device_view"):
                     # consuming segment: the periodic sorted snapshot
                     # serves the frozen prefix on the DEVICE kernels and

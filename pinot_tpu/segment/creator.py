@@ -119,9 +119,10 @@ class SegmentCreator:
                     dictionary = Dictionary.build(
                         field.data_type,
                         np.asarray(self.fixed_dictionaries[name]))
+                    ids = dictionary.encode(arr)
                 else:
-                    dictionary = Dictionary.build(field.data_type, arr)
-                ids = dictionary.encode(arr)
+                    dictionary, ids = Dictionary.build_encoded(
+                        field.data_type, arr)
                 is_sorted = bool(np.all(ids[:-1] <= ids[1:])) if n > 1 else True
                 total_entries = n
                 max_mv = 0
@@ -129,8 +130,8 @@ class SegmentCreator:
                 flat_vals = np.asarray(
                     [v for row in lists for v in row],
                     dtype=field.data_type.np_dtype)
-                dictionary = Dictionary.build(field.data_type, flat_vals)
-                flat_ids = dictionary.encode(flat_vals)
+                dictionary, flat_ids = Dictionary.build_encoded(
+                    field.data_type, flat_vals)
                 counts = np.array([len(row) for row in lists], dtype=np.int64)
                 offsets = np.zeros(n + 1, dtype=np.int64)
                 np.cumsum(counts, out=offsets[1:])

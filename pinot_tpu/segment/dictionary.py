@@ -48,10 +48,10 @@ class Dictionary:
 
     def encode(self, column: np.ndarray) -> np.ndarray:
         """Vectorized value→dictId for a full column (build path)."""
-        if self.data_type.is_numeric:
-            ids = np.searchsorted(self.values, column)
-        else:
-            ids = np.searchsorted(self.values, column)
+        if self.values.dtype.kind == "U" and \
+                np.asarray(column).dtype.kind != "U":
+            column = np.asarray(column, dtype=np.str_)
+        ids = np.searchsorted(self.values, column)
         return ids.astype(np.int32)
 
     def decode(self, dict_ids: np.ndarray) -> np.ndarray:
@@ -101,7 +101,25 @@ class Dictionary:
 
     # -- build + serde -----------------------------------------------------
     @classmethod
+    def build_encoded(cls, data_type: DataType, column: np.ndarray):
+        """(dictionary, encoded ids) in ONE unique pass: return_inverse
+        hands back the value→id mapping for free, skipping the separate
+        full-column searchsorted of build()+encode() (profiled ~15% of
+        the segment build)."""
+        if data_type == DataType.STRING and \
+                np.asarray(column).dtype.kind == "O":
+            column = np.asarray(column, dtype=np.str_)
+        uniq, inv = np.unique(column, return_inverse=True)
+        return cls(data_type, uniq), inv.astype(np.int32)
+
+    @classmethod
     def build(cls, data_type: DataType, column: np.ndarray) -> "Dictionary":
+        if data_type == DataType.STRING and \
+                np.asarray(column).dtype.kind == "O":
+            # fixed-width unicode sorts/searches at C speed; object-array
+            # sorts are python-compare bound (profiled: np.unique over
+            # object strings was ~60% of the whole segment build)
+            column = np.asarray(column, dtype=np.str_)
         uniq = np.unique(column)
         return cls(data_type, uniq)
 
