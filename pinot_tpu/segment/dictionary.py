@@ -100,26 +100,36 @@ class Dictionary:
         return str(value)
 
     # -- build + serde -----------------------------------------------------
+    # fixed-width unicode columns allocate rows * max_len * 4 bytes; one
+    # pathological long value would blow that up, so the C-speed cast
+    # only applies under this per-value width
+    _STR_FAST_MAX_LEN = 256
+
+    @classmethod
+    def _fast_str_cast(cls, data_type: DataType, column: np.ndarray):
+        if data_type != DataType.STRING or \
+                np.asarray(column).dtype.kind != "O":
+            return column
+        if len(column) and max(map(len, column)) > cls._STR_FAST_MAX_LEN:
+            return column                     # object path: no blowup
+        return np.asarray(column, dtype=np.str_)
+
     @classmethod
     def build_encoded(cls, data_type: DataType, column: np.ndarray):
         """(dictionary, encoded ids) in ONE unique pass: return_inverse
         hands back the value→id mapping for free, skipping the separate
         full-column searchsorted of build()+encode() (profiled ~15% of
         the segment build)."""
-        if data_type == DataType.STRING and \
-                np.asarray(column).dtype.kind == "O":
-            column = np.asarray(column, dtype=np.str_)
+        column = cls._fast_str_cast(data_type, column)
         uniq, inv = np.unique(column, return_inverse=True)
         return cls(data_type, uniq), inv.astype(np.int32)
 
     @classmethod
     def build(cls, data_type: DataType, column: np.ndarray) -> "Dictionary":
-        if data_type == DataType.STRING and \
-                np.asarray(column).dtype.kind == "O":
-            # fixed-width unicode sorts/searches at C speed; object-array
-            # sorts are python-compare bound (profiled: np.unique over
-            # object strings was ~60% of the whole segment build)
-            column = np.asarray(column, dtype=np.str_)
+        # fixed-width unicode sorts/searches at C speed; object-array
+        # sorts are python-compare bound (profiled: np.unique over
+        # object strings was ~60% of the whole segment build)
+        column = cls._fast_str_cast(data_type, column)
         uniq = np.unique(column)
         return cls(data_type, uniq)
 

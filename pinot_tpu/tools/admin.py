@@ -82,6 +82,98 @@ def cmd_post_query(args) -> int:
     return 0
 
 
+def cmd_startree_viewer(args) -> int:
+    """Parity: StarTreeIndexViewer — dump a segment's pre-aggregated
+    cubes: split order, group counts, per-metric stats, reduction vs
+    raw docs."""
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    seg = ImmutableSegmentLoader.load(args.segment_dir)
+    if not seg.star_trees:
+        print(json.dumps({"segmentName": seg.segment_name,
+                          "starTrees": []}))
+        return 0
+    out = []
+    for i, cube in enumerate(seg.star_trees):
+        dims = {}
+        for d in cube.dimensions:
+            import numpy as _np
+            dims[d] = {"activeValues": int(_np.unique(
+                cube.dim_ids[d]).size)}
+        out.append({
+            "index": i,
+            "dimensionsSplitOrder": cube.dimensions,
+            "metrics": cube.metrics,
+            "numGroups": cube.n_groups,
+            "rawDocs": seg.num_docs,
+            "reductionFactor": round(seg.num_docs /
+                                     max(cube.n_groups, 1), 2),
+            "dimensions": dims,
+            "statKinds": {m: sorted(st.keys())
+                          for m, st in cube.metric_stats.items()},
+        })
+    print(json.dumps({"segmentName": seg.segment_name,
+                      "totalDocs": seg.num_docs, "starTrees": out},
+                     indent=2))
+    return 0
+
+
+def cmd_realtime_provisioning(args) -> int:
+    """Parity: RealtimeProvisioningHelperCommand — estimate per-host
+    memory for consuming segments across (numHosts, hoursToFlush)
+    combinations, from a SAMPLE completed segment's measured bytes/row
+    and the table's ingestion rate."""
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    seg = ImmutableSegmentLoader.load(args.sample_segment)
+    n = max(seg.num_docs, 1)
+    # measured bytes/row of the columnar artifact (consuming segments
+    # hold roughly this in arrival-order form, plus dictionary overhead)
+    total = 0
+    for name in seg.column_names:
+        ds = seg.data_source(name)
+        for arr in (ds.dict_ids, ds.raw_values, ds.mv_dict_ids):
+            if arr is not None:
+                total += arr.nbytes
+        if ds.dictionary is not None and \
+                getattr(ds.dictionary.values, "nbytes", None):
+            total += ds.dictionary.values.nbytes
+    bytes_per_row = total / n * 1.3          # mutable-structure overhead
+    rows_per_hour = args.rows_per_hour
+    hosts_list = [int(x) for x in args.num_hosts.split(",")]
+    hours_list = [int(x) for x in args.num_hours.split(",")]
+    if any(h <= 0 for h in hosts_list) or any(h <= 0 for h in hours_list):
+        print(json.dumps({"error": "--num-hosts/--num-hours must be "
+                          "positive integers"}))
+        return 1
+    matrix = {}
+    for hosts in hosts_list:
+        per_host = {}
+        parts_per_host = -(-args.num_partitions * args.replication
+                           // hosts)
+        for hours in hours_list:
+            rows_per_seg = rows_per_hour * hours / max(
+                args.num_partitions, 1)
+            consuming_mb = parts_per_host * rows_per_seg * \
+                bytes_per_row / 1e6
+            retained_mb = parts_per_host * \
+                (args.retention_hours / max(hours, 1)) * \
+                rows_per_seg * bytes_per_row / 1e6
+            per_host[f"{hours}h"] = {
+                "consumingMB": round(consuming_mb, 1),
+                "retainedMB": round(retained_mb, 1),
+                "totalMB": round(consuming_mb + retained_mb, 1),
+            }
+        matrix[f"{hosts}hosts"] = per_host
+    print(json.dumps({
+        "sampleSegmentRows": seg.num_docs,
+        "bytesPerRow": round(bytes_per_row, 1),
+        "rowsPerHour": rows_per_hour,
+        "numPartitions": args.num_partitions,
+        "replication": args.replication,
+        "retentionHours": args.retention_hours,
+        "memoryPerHost": matrix}, indent=2))
+    return 0
+
+
 def cmd_query_runner(args) -> int:
     """Replay a query file against a broker at a latency/QPS report.
 
@@ -510,6 +602,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--broker", default="127.0.0.1:8099")
     sp.add_argument("--query", required=True)
     sp.set_defaults(fn=cmd_post_query)
+
+    sp = sub.add_parser("StarTreeIndexViewer",
+                        help="dump a segment's star-tree cubes")
+    sp.add_argument("--segment-dir", required=True)
+    sp.set_defaults(fn=cmd_startree_viewer)
+
+    sp = sub.add_parser("RealtimeProvisioningHelper",
+                        help="estimate consuming-memory per host")
+    sp.add_argument("--sample-segment", required=True,
+                    help="a completed segment dir to measure bytes/row")
+    sp.add_argument("--rows-per-hour", type=int, required=True)
+    sp.add_argument("--num-partitions", type=int, default=1)
+    sp.add_argument("--replication", type=int, default=1)
+    sp.add_argument("--retention-hours", type=int, default=72)
+    sp.add_argument("--num-hosts", default="2,4,6,8")
+    sp.add_argument("--num-hours", default="2,4,6,8,10,12")
+    sp.set_defaults(fn=cmd_realtime_provisioning)
 
     sp = sub.add_parser("QueryRunner",
                         help="replay a query file; latency/QPS report")

@@ -136,3 +136,50 @@ def test_hybrid_quickstart_command(capsys):
     assert rc == 0
     assert "deduplicated at the time boundary" in out
     assert out.count("> SELECT") == 3
+
+
+def test_startree_viewer_and_provisioning_helper(capsys):
+    """Parity: StarTreeIndexViewer + RealtimeProvisioningHelperCommand."""
+    import tempfile
+
+    from fixtures import make_columns
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    base = tempfile.mkdtemp()
+    cols = make_columns(3000, seed=9)
+    cfg = TableConfig("baseballStats", indexing_config=IndexingConfig(
+        no_dictionary_columns=["salary"],
+        star_tree_configs=[{"dimensionsSplitOrder": ["teamID", "league"],
+                            "metrics": ["runs", "hits"]}]))
+    seg_dir = os.path.join(base, "st_seg")
+    SegmentCreator(make_schema(), cfg, segment_name="st_0").build(
+        cols, seg_dir)
+
+    rc, out = _run(["StarTreeIndexViewer", "--segment-dir", seg_dir],
+                   capsys)
+    assert rc == 0
+    view = json.loads(out)
+    assert view["totalDocs"] == 3000
+    st = view["starTrees"][0]
+    assert st["dimensionsSplitOrder"] == ["teamID", "league"]
+    assert 0 < st["numGroups"] <= 3000
+    assert st["reductionFactor"] >= 1
+    assert "sum" in st["statKinds"]["runs"]
+
+    rc, out = _run(["RealtimeProvisioningHelper",
+                    "--sample-segment", seg_dir,
+                    "--rows-per-hour", "100000",
+                    "--num-partitions", "4", "--replication", "2",
+                    "--retention-hours", "24",
+                    "--num-hosts", "2,4", "--num-hours", "2,6"], capsys)
+    assert rc == 0
+    prov = json.loads(out)
+    assert prov["bytesPerRow"] > 0
+    m = prov["memoryPerHost"]
+    assert set(m) == {"2hosts", "4hosts"}
+    assert set(m["2hosts"]) == {"2h", "6h"}
+    # fewer hosts -> more partitions/host -> more memory per host
+    assert m["2hosts"]["2h"]["totalMB"] >= m["4hosts"]["2h"]["totalMB"]
+    # longer flush -> bigger consuming segments
+    assert m["2hosts"]["6h"]["consumingMB"] > m["2hosts"]["2h"]["consumingMB"]
