@@ -48,9 +48,12 @@ class Dictionary:
 
     def encode(self, column: np.ndarray) -> np.ndarray:
         """Vectorized value→dictId for a full column (build path)."""
-        if self.values.dtype.kind == "U" and \
-                np.asarray(column).dtype.kind != "U":
-            column = np.asarray(column, dtype=np.str_)
+        if self.values.dtype.kind == "U":
+            column = self._fast_str_cast(self.data_type, column)
+            if np.asarray(column).dtype.kind != "U":
+                # pathological long values: search in the object domain
+                return np.searchsorted(
+                    self.values.astype(object), column).astype(np.int32)
         ids = np.searchsorted(self.values, column)
         return ids.astype(np.int32)
 

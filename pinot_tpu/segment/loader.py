@@ -65,6 +65,32 @@ def int_part_info_for(values: np.ndarray) -> tuple:
     return (n_parts, min_v)
 
 
+def segment_host_bytes(seg) -> int:
+    """Host-side column footprint of a (loaded or mutable) segment —
+    the single accounting used by the server size/debug endpoints and
+    the RealtimeProvisioningHelper. Object string arrays report their
+    actual encoded payload, not 8-byte pointers."""
+    def _arr_bytes(arr) -> int:
+        if arr is None or not hasattr(arr, "nbytes"):
+            return 0
+        if getattr(arr, "dtype", None) is not None and \
+                arr.dtype.kind == "O":
+            return int(sum(len(str(v).encode("utf-8", "replace"))
+                           for v in arr.ravel()))
+        return int(arr.nbytes)
+
+    total = 0
+    for name in seg.column_names:
+        ds = seg.data_source(name)
+        for arr in (getattr(ds, "dict_ids", None),
+                    getattr(ds, "raw_values", None),
+                    getattr(ds, "mv_dict_ids", None)):
+            total += _arr_bytes(arr)
+        vals = getattr(getattr(ds, "dictionary", None), "values", None)
+        total += _arr_bytes(vals)
+    return total
+
+
 def int_part_table(values: np.ndarray, n_parts: int,
                    min_v: int) -> np.ndarray:
     """[n_parts, card + 1] int8 plane table (last column = all-zero pad

@@ -1,0 +1,120 @@
+"""Server admin/debug HTTP API.
+
+Parity: pinot-server/.../api/resources/ — TablesResource (table list +
+per-segment metadata), TableSizeResource (estimated bytes per segment),
+HealthCheckResource, and MmapDebugResource. The reference's "native
+memory" debug surface reports mmap/direct buffers; the TPU build's
+native memory is HBM, so /debug/memory reports the DEVICE-RESIDENT lane
+bytes per table/segment (what the reference's PinotDataBuffer global
+accounting becomes on this architecture) next to the host-side column
+footprint.
+"""
+from __future__ import annotations
+
+from pinot_tpu.common.service_status import get_service_status
+from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
+
+
+from pinot_tpu.segment.loader import segment_host_bytes as _host_bytes
+
+
+def _device_bytes(seg) -> int:
+    total = 0
+    for name in seg.column_names:
+        dev = getattr(seg.data_source(name), "_dev", None) or {}
+        total += sum(int(a.nbytes) for a in dev.values()
+                     if hasattr(a, "nbytes"))
+    return total
+
+
+class ServerApiServer(ApiServer):
+    """Admin/debug surface for one ServerInstance."""
+
+    def __init__(self, server):
+        super().__init__()
+        self.server = server
+        self.router.add("GET", "/health", self._health)
+        self.router.add("GET", "/tables", self._tables)
+        self.router.add("GET", "/tables/{table}/segments", self._segments)
+        self.router.add("GET", "/tables/{table}/size", self._size)
+        self.router.add("GET", "/debug/memory", self._memory)
+
+    async def _health(self, request: HttpRequest) -> HttpResponse:
+        from pinot_tpu.common.service_status import Status
+        status, desc = get_service_status(self.server.instance_id)
+        if status in (Status.GOOD, Status.STARTING) and \
+                "no status callback" in desc:
+            # standalone servers (no participant) have no callback; they
+            # are healthy iff they answer at all
+            return HttpResponse(200, b"OK", content_type="text/plain")
+        if status == Status.GOOD:
+            return HttpResponse(200, b"OK", content_type="text/plain")
+        return HttpResponse.error(503, f"{status.name}: {desc}")
+
+    async def _tables(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json(
+            {"tables": self.server.data_manager.table_names()})
+
+    async def _segments(self, request: HttpRequest) -> HttpResponse:
+        table = request.path_params["table"]
+        tdm = self.server.data_manager.table(table)
+        if tdm is None:
+            return HttpResponse.error(404, f"table {table} not found")
+        sdms, _ = tdm.acquire_segments()
+        try:
+            out = {}
+            for sdm in sdms:
+                seg = sdm.segment
+                meta = seg.metadata
+                out[seg.segment_name] = {
+                    "totalDocs": seg.num_docs,
+                    "columns": len(seg.column_names),
+                    "startTime": meta.start_time,
+                    "endTime": meta.end_time,
+                    "mutable": bool(getattr(seg, "is_mutable", False)),
+                }
+            return HttpResponse.of_json({"table": table, "segments": out})
+        finally:
+            for sdm in sdms:
+                tdm.release_segment(sdm)
+
+    async def _size(self, request: HttpRequest) -> HttpResponse:
+        table = request.path_params["table"]
+        tdm = self.server.data_manager.table(table)
+        if tdm is None:
+            return HttpResponse.error(404, f"table {table} not found")
+        sdms, _ = tdm.acquire_segments()
+        try:
+            segs = {sdm.segment.segment_name:
+                    {"hostBytes": _host_bytes(sdm.segment)}
+                    for sdm in sdms}
+            return HttpResponse.of_json({
+                "table": table,
+                "totalHostBytes": sum(v["hostBytes"]
+                                      for v in segs.values()),
+                "segments": segs})
+        finally:
+            for sdm in sdms:
+                tdm.release_segment(sdm)
+
+    async def _memory(self, request: HttpRequest) -> HttpResponse:
+        out = {}
+        dm = self.server.data_manager
+        for table in dm.table_names():
+            tdm = dm.table(table)
+            if tdm is None:
+                continue
+            sdms, _ = tdm.acquire_segments()
+            try:
+                out[table] = {
+                    sdm.segment.segment_name: {
+                        "hbmResidentBytes": _device_bytes(sdm.segment),
+                        "hostBytes": _host_bytes(sdm.segment),
+                    } for sdm in sdms}
+            finally:
+                for sdm in sdms:
+                    tdm.release_segment(sdm)
+        total_hbm = sum(s["hbmResidentBytes"]
+                        for t in out.values() for s in t.values())
+        return HttpResponse.of_json({"totalHbmResidentBytes": total_hbm,
+                                     "tables": out})

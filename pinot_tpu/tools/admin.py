@@ -94,11 +94,9 @@ def cmd_startree_viewer(args) -> int:
         return 0
     out = []
     for i, cube in enumerate(seg.star_trees):
-        dims = {}
-        for d in cube.dimensions:
-            import numpy as _np
-            dims[d] = {"activeValues": int(_np.unique(
-                cube.dim_ids[d]).size)}
+        import numpy as np
+        dims = {d: {"activeValues": int(np.unique(cube.dim_ids[d]).size)}
+                for d in cube.dimensions}
         out.append({
             "index": i,
             "dimensionsSplitOrder": cube.dimensions,
@@ -122,21 +120,13 @@ def cmd_realtime_provisioning(args) -> int:
     memory for consuming segments across (numHosts, hoursToFlush)
     combinations, from a SAMPLE completed segment's measured bytes/row
     and the table's ingestion rate."""
-    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.segment.loader import (ImmutableSegmentLoader,
+                                          segment_host_bytes)
     seg = ImmutableSegmentLoader.load(args.sample_segment)
     n = max(seg.num_docs, 1)
     # measured bytes/row of the columnar artifact (consuming segments
     # hold roughly this in arrival-order form, plus dictionary overhead)
-    total = 0
-    for name in seg.column_names:
-        ds = seg.data_source(name)
-        for arr in (ds.dict_ids, ds.raw_values, ds.mv_dict_ids):
-            if arr is not None:
-                total += arr.nbytes
-        if ds.dictionary is not None and \
-                getattr(ds.dictionary.values, "nbytes", None):
-            total += ds.dictionary.values.nbytes
-    bytes_per_row = total / n * 1.3          # mutable-structure overhead
+    bytes_per_row = segment_host_bytes(seg) / n * 1.3   # mutable overhead
     rows_per_hour = args.rows_per_hour
     hosts_list = [int(x) for x in args.num_hosts.split(",")]
     hours_list = [int(x) for x in args.num_hours.split(",")]
@@ -323,9 +313,19 @@ def cmd_start_server(args) -> int:
                             args.deep_store, work_dir=args.dir,
                             port=args.port, scheduler=args.scheduler,
                             controller_http=args.controller_http)
-    print(json.dumps({"instanceId": args.instance_id,
-                      "queryPort": srv.port}), flush=True)
-    return _run_until_interrupt(srv.stop)
+    boot = {"instanceId": args.instance_id, "queryPort": srv.port}
+    api = None
+    if args.admin_port is not None:
+        from pinot_tpu.server.http_api import ServerApiServer
+        api = ServerApiServer(srv.server)
+        boot["adminPort"] = api.start(port=args.admin_port)
+    print(json.dumps(boot), flush=True)
+
+    def shutdown():
+        if api is not None:
+            api.stop()
+        srv.stop()
+    return _run_until_interrupt(shutdown)
 
 
 def cmd_start_broker(args) -> int:
@@ -691,6 +691,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--controller-http",
                     help="controller REST host:port (enables realtime "
                          "tables: LLC completion over HTTP)")
+    sp.add_argument("--admin-port", type=int,
+                    help="start the admin/debug HTTP API on this port "
+                         "(0 = ephemeral; omitted = disabled)")
     sp.set_defaults(fn=cmd_start_server)
 
     sp = sub.add_parser("StartBroker",

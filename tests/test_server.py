@@ -306,3 +306,60 @@ def test_instance_executor_records_sharded_and_fallback_paths():
     assert dt2.metadata["executionPath"] == "sequential"
     assert blk2.agg_intermediates[0] == exp_cnt + 1
     assert blk2.agg_intermediates[1] == pytest.approx(exp_sum + 7)
+
+
+def test_server_admin_http_api():
+    """Parity: pinot-server api/resources — TablesResource,
+    TableSizeResource, HealthCheckResource, and the MmapDebugResource
+    analogue (/debug/memory reports HBM-resident lane bytes — the TPU
+    build's native-memory accounting)."""
+    import json as _json
+    import tempfile as _tf
+    import urllib.request
+
+    from pinot_tpu.engine import QueryEngine
+    from pinot_tpu.server.http_api import ServerApiServer
+    from pinot_tpu.server.instance import ServerInstance
+
+    base = _tf.mkdtemp()
+    seg, _cols = build_segment(f"{base}/adm", n=1024, seed=91,
+                               name="adm_seg")
+    srv = ServerInstance("adm_srv")
+    srv.data_manager.table("baseballStats", create=True).add_segment(seg)
+    api = ServerApiServer(srv)
+    port = api.start()
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            body = r.read()
+            return r.status, body
+
+    try:
+        st, body = get("/health")
+        assert st == 200 and body == b"OK"
+        st, body = get("/tables")
+        assert _json.loads(body)["tables"] == ["baseballStats"]
+        st, body = get("/tables/baseballStats/segments")
+        segs = _json.loads(body)["segments"]
+        assert segs["adm_seg"]["totalDocs"] == 1024
+        assert segs["adm_seg"]["mutable"] is False
+        st, body = get("/tables/baseballStats/size")
+        size = _json.loads(body)
+        assert size["totalHostBytes"] > 0
+        # nothing uploaded yet → zero HBM residency
+        st, body = get("/debug/memory")
+        mem = _json.loads(body)
+        assert mem["totalHbmResidentBytes"] == 0
+        # run a device query → lanes become HBM-resident
+        engine = QueryEngine([seg])
+        engine.query("SELECT SUM(runs) FROM baseballStats "
+                     "WHERE yearID >= 1990")
+        st, body = get("/debug/memory")
+        mem = _json.loads(body)
+        assert mem["totalHbmResidentBytes"] > 0
+        t = mem["tables"]["baseballStats"]["adm_seg"]
+        assert t["hbmResidentBytes"] > 0 and t["hostBytes"] > 0
+    finally:
+        api.stop()
+        srv.stop()
