@@ -43,7 +43,12 @@ def setup():
             cols[k] = np.concatenate([cols1[k], cols2[k]])
     engine = QueryEngine([seg1, seg2])
     host_engine = QueryEngine([seg1, seg2], use_device=False)
-    return engine, host_engine, Oracle(cols)
+    # mesh engine: the two segments have DIFFERENT per-segment
+    # dictionaries (independent seeds), so this sweeps the randomized
+    # suite over the union-dictionary sharded device combine
+    from pinot_tpu.parallel import make_mesh
+    mesh_engine = QueryEngine([seg1, seg2], mesh=make_mesh())
+    return engine, host_engine, mesh_engine, Oracle(cols)
 
 
 # ---------------------------------------------------------------------------
@@ -180,14 +185,15 @@ def _check_agg(resp, i, oracle, name, col, mode, m, pql, label):
 
 
 def test_random_aggregation_queries(setup):
-    engine, host_engine, oracle = setup
+    engine, host_engine, mesh_engine, oracle = setup
     gen = Gen(random.Random(SEED), oracle)
     for qi in range(N_AGG):
         where, m = gen.where()
         aggs = gen.aggs()
         pql = ("SELECT " + ", ".join(a[0] for a in aggs) +
                " FROM baseballStats" + where)
-        for e, label in [(engine, "device"), (host_engine, "host")]:
+        for e, label in [(engine, "device"), (host_engine, "host"),
+                 (mesh_engine, "mesh-union")]:
             resp = e.query(pql)
             assert not resp.exceptions, (pql, label, resp.exceptions)
             for i, (_, name, col, mode) in enumerate(aggs):
@@ -195,7 +201,7 @@ def test_random_aggregation_queries(setup):
 
 
 def test_random_group_by_queries(setup):
-    engine, host_engine, oracle = setup
+    engine, host_engine, mesh_engine, oracle = setup
     gen = Gen(random.Random(SEED + 1), oracle)
     dims_pool = ["teamID", "league", "yearID"]
     for qi in range(N_GROUP):
@@ -205,7 +211,8 @@ def test_random_group_by_queries(setup):
         pql = ("SELECT " + ", ".join(a[0] for a in aggs) +
                " FROM baseballStats" + where +
                " GROUP BY " + ", ".join(dims) + " TOP 2000")
-        for e, label in [(engine, "device"), (host_engine, "host")]:
+        for e, label in [(engine, "device"), (host_engine, "host"),
+                 (mesh_engine, "mesh-union")]:
             resp = e.query(pql)
             assert not resp.exceptions, (pql, label, resp.exceptions)
             for i, (_, name, col, mode) in enumerate(aggs):
@@ -231,7 +238,7 @@ def test_random_group_by_queries(setup):
 
 
 def test_random_group_by_having_queries(setup):
-    engine, host_engine, oracle = setup
+    engine, host_engine, mesh_engine, oracle = setup
     gen = Gen(random.Random(SEED + 3), oracle)
     for qi in range(6):
         where, m = gen.where()
@@ -244,7 +251,8 @@ def test_random_group_by_having_queries(setup):
         counts = oracle.group_by(dims, m, ("count", None))
         keep = {tuple(str(k) for k in key): v for key, v in counts.items()
                 if (v > thresh if op == ">" else v <= thresh)}
-        for e, label in [(engine, "device"), (host_engine, "host")]:
+        for e, label in [(engine, "device"), (host_engine, "host"),
+                 (mesh_engine, "mesh-union")]:
             resp = e.query(pql)
             assert not resp.exceptions, (pql, label, resp.exceptions)
             got = {tuple(str(k) for k in g["group"]): int(float(g["value"]))
@@ -253,7 +261,7 @@ def test_random_group_by_having_queries(setup):
 
 
 def test_random_selection_queries(setup):
-    engine, host_engine, oracle = setup
+    engine, host_engine, mesh_engine, oracle = setup
     gen = Gen(random.Random(SEED + 2), oracle)
     exact_cols = ["teamID", "runs", "hits", "yearID"]
     for qi in range(N_SEL):
@@ -278,7 +286,8 @@ def test_random_selection_queries(setup):
         for i in idx:
             key = tuple(str(oracle.cols[c][i]) for c in cols)
             rowset[key] = rowset.get(key, 0) + 1
-        for e, label in [(engine, "device"), (host_engine, "host")]:
+        for e, label in [(engine, "device"), (host_engine, "host"),
+                 (mesh_engine, "mesh-union")]:
             resp = e.query(pql)
             assert not resp.exceptions, (pql, label, resp.exceptions)
             rows = resp.selection_results.results
@@ -304,7 +313,7 @@ def test_random_selection_queries(setup):
 def test_random_mv_group_by_queries(setup):
     """MV group keys and valuein under random filters — engine (device +
     host) vs an inline expansion oracle (aggregateGroupByMV semantics)."""
-    engine, host_engine, oracle = setup
+    engine, host_engine, mesh_engine, oracle = setup
     gen = Gen(random.Random(SEED + 7), oracle)
     all_pos = sorted({v for lst in oracle.cols["position"] for v in lst})
     for qi in range(8):
@@ -332,7 +341,8 @@ def test_random_mv_group_by_queries(setup):
                 e2 = exp.setdefault(key, [0, 0.0])
                 e2[0] += 1
                 e2[1] += float(oracle.cols["hits"][i])
-        for e, label in [(engine, "device"), (host_engine, "host")]:
+        for e, label in [(engine, "device"), (host_engine, "host"),
+                 (mesh_engine, "mesh-union")]:
             resp = e.query(pql)
             assert not resp.exceptions, (pql, label, resp.exceptions)
             got_cnt = {tuple(str(k) for k in g["group"]):
