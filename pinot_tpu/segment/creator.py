@@ -79,6 +79,18 @@ class SegmentCreator:
         num_docs = None
         col_meta: Dict[str, ColumnMetadata] = {}
 
+        # columns the star-tree cubes need, kept in memory through the
+        # build so sealing never re-reads the segment from disk
+        st_configs = []
+        st_dim_lanes: Dict[str, tuple] = {}
+        st_metric_vals: Dict[str, np.ndarray] = {}
+        if idx_cfg.star_tree_configs:
+            from pinot_tpu.startree.cube import StarTreeConfig
+            st_configs = [StarTreeConfig.from_json(c) if isinstance(c, dict)
+                          else c for c in idx_cfg.star_tree_configs]
+        st_dims = {d for c in st_configs for d in c.dimensions}
+        st_metrics = {m for c in st_configs for m in c.metrics}
+
         for field in self.schema.fields:
             name = field.name
             if name not in columns:
@@ -102,6 +114,8 @@ class SegmentCreator:
             if no_dict and field.single_value:
                 # raw forward index, no dictionary
                 write_raw_fwd(out_dir, name, arr)
+                if name in st_metrics:
+                    st_metric_vals[name] = arr.astype(np.float64)
                 col_meta[name] = ColumnMetadata(
                     name=name, data_type=field.data_type,
                     cardinality=int(len(np.unique(arr))),
@@ -141,6 +155,12 @@ class SegmentCreator:
 
             dictionary.save(out_dir, name)
             card = dictionary.cardinality
+            if field.single_value:
+                if name in st_dims:
+                    st_dim_lanes[name] = (ids, card)
+                if name in st_metrics and field.data_type.is_numeric:
+                    st_metric_vals[name] = np.asarray(
+                        dictionary.values, dtype=np.float64)[ids]
 
             # -- forward index ---------------------------------------------
             if field.single_value:
@@ -235,9 +255,15 @@ class SegmentCreator:
         with open(os.path.join(out_dir, fmt.CREATION_META_FILE), "w") as f:
             json.dump({"creator": "pinot_tpu", "version": fmt.SEGMENT_VERSION},
                       f)
-        if idx_cfg.star_tree_configs:
-            from pinot_tpu.startree.cube import build_and_save_star_trees
-            build_and_save_star_trees(out_dir, self.table_config)
+        if st_configs:
+            from pinot_tpu.startree.cube import build_cube_from_arrays
+            n_cubes = 0
+            for config in st_configs:
+                cube = build_cube_from_arrays(config, st_dim_lanes,
+                                              st_metric_vals)
+                if cube is not None:
+                    cube.save(out_dir, n_cubes)
+                    n_cubes += 1
         # v3 conversion runs LAST so star-tree cubes land inside the
         # container with every other index member
         if getattr(idx_cfg, "segment_version", "v1") == "v3":

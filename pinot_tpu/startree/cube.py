@@ -132,8 +132,7 @@ def _build_cube(segment, config: StarTreeConfig
     n = segment.num_docs
     if n == 0 or not config.dimensions:
         return None
-    id_lanes = []
-    cards = []
+    dim_lanes: Dict[str, tuple] = {}
     for d in config.dimensions:
         if not segment.has_column(d):
             return None
@@ -141,14 +140,52 @@ def _build_cube(segment, config: StarTreeConfig
         cm = ds.metadata
         if not (cm.has_dictionary and cm.single_value):
             return None                     # MV/raw dims unsupported
-        id_lanes.append(ds.dict_ids.astype(np.int64))
-        cards.append(cm.cardinality)
+        dim_lanes[d] = (ds.dict_ids, cm.cardinality)
+    def _metric(ds):
+        # deferred: only decoded if the cube survives the group-count
+        # checks (a rejected cube must not cost O(n) per metric)
+        cm = ds.metadata
+        if cm.has_dictionary:
+            return lambda: np.asarray(ds.dictionary.values,
+                                      dtype=np.float64)[ds.dict_ids]
+        return lambda: ds.raw_values.astype(np.float64)
+
+    metric_vals: Dict[str, object] = {}
+    for m in config.metrics:
+        if not segment.has_column(m):
+            return None
+        ds = segment.data_source(m)
+        cm = ds.metadata
+        if not cm.single_value or not cm.data_type.is_numeric:
+            return None
+        metric_vals[m] = _metric(ds)
+    return build_cube_from_arrays(config, dim_lanes, metric_vals)
+
+
+def build_cube_from_arrays(config: StarTreeConfig,
+                           dim_lanes: Dict[str, tuple],
+                           metric_vals: Dict[str, np.ndarray]
+                           ) -> Optional[StarTreeCube]:
+    """Core cube pass over host arrays: dim_lanes maps dimension →
+    (dict_ids, cardinality), metric_vals maps metric → float64 values
+    (or a zero-arg callable producing them, resolved only once the cube
+    passes the group-count checks). Linear-time grouping (hash factorize
+    + bincount) instead of the O(n log n) unique sort; the creator calls
+    this directly on its in-memory ids so sealing a segment never
+    re-reads it from disk."""
+    if not config.dimensions or \
+            any(d not in dim_lanes for d in config.dimensions):
+        return None
+    cards = [dim_lanes[d][1] for d in config.dimensions]
     if np.prod([float(c) for c in cards]) >= 2**62:
         return None                         # packed key would overflow
+    n = len(dim_lanes[config.dimensions[0]][0])
+    if n == 0:
+        return None
     key = np.zeros(n, dtype=np.int64)
-    for lane, card in zip(id_lanes, cards):
-        key = key * card + lane
-    uniq, inverse = np.unique(key, return_inverse=True)
+    for d, card in zip(config.dimensions, cards):
+        key = key * card + dim_lanes[d][0]
+    uniq, inverse = _linear_unique(key)
     g = len(uniq)
     if g > config.max_groups:
         return None                         # cube would not pay off
@@ -158,44 +195,32 @@ def _build_cube(segment, config: StarTreeConfig
     for d, card in zip(reversed(config.dimensions), reversed(cards)):
         dim_ids[d] = (rem % card).astype(np.int32)
         rem //= card
-    counts = np.zeros(g, dtype=np.int64)
-    np.add.at(counts, inverse, 1)
+    counts = np.bincount(inverse, minlength=g).astype(np.int64)
 
     metric_stats: Dict[str, Dict[str, np.ndarray]] = {}
     for m in config.metrics:
-        if not segment.has_column(m):
+        if m not in metric_vals:
             return None
-        ds = segment.data_source(m)
-        cm = ds.metadata
-        if not cm.single_value or not cm.data_type.is_numeric:
-            return None
-        if cm.has_dictionary:
-            vals = np.asarray(ds.dictionary.values,
-                              dtype=np.float64)[ds.dict_ids]
-        else:
-            vals = ds.raw_values.astype(np.float64)
-        sums = np.zeros(g, dtype=np.float64)
+        vals = metric_vals[m]
+        if callable(vals):
+            vals = vals()
+        sums = np.bincount(inverse, weights=vals, minlength=g)
         mins = np.full(g, np.inf)
         maxs = np.full(g, -np.inf)
-        np.add.at(sums, inverse, vals)
         np.minimum.at(mins, inverse, vals)
         np.maximum.at(maxs, inverse, vals)
         metric_stats[m] = {"sum": sums, "min": mins, "max": maxs}
     return StarTreeCube(config, g, dim_ids, counts, metric_stats)
 
 
-def build_and_save_star_trees(seg_dir: str, table_config) -> int:
-    """Post-build hook: load the sealed segment, materialize + persist
-    cubes next to it. Returns the number of cubes written."""
-    if not (table_config and
-            table_config.indexing_config.star_tree_configs):
-        return 0
-    from pinot_tpu.segment.loader import ImmutableSegmentLoader
-    segment = ImmutableSegmentLoader.load(seg_dir)
-    cubes = build_star_trees(segment, table_config)
-    for i, cube in enumerate(cubes):
-        cube.save(seg_dir, i)
-    return len(cubes)
+def _linear_unique(key: np.ndarray):
+    """(sorted unique keys, inverse codes) — O(n) hash factorize with an
+    np.unique fallback (pandas missing)."""
+    from pinot_tpu.utils.factorize import sorted_factorize
+    fact = sorted_factorize(key)
+    if fact is None:
+        return np.unique(key, return_inverse=True)
+    return fact
 
 
 def load_star_trees(seg_dir) -> List[StarTreeCube]:

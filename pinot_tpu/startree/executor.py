@@ -158,6 +158,10 @@ def try_star_tree_execute_multi(segments, request: BrokerRequest
     val_chunks: List[List[np.ndarray]] = [[] for _ in gcols]
     cnt_chunks: List[np.ndarray] = []
     stat_chunks: Dict[str, List[np.ndarray]] = {}
+    # each column's stat lanes exactly once per segment — two functions
+    # over the same column (MIN(x), MAX(x)) must not double-append
+    stat_cols = sorted({f.column for f in functions
+                        if f.info.base != "COUNT"})
     total_docs = 0
     matched_groups = 0
     scanned = 0
@@ -176,12 +180,10 @@ def try_star_tree_execute_multi(segments, request: BrokerRequest
             d = seg.data_source(c).dictionary
             val_chunks[i].append(np.asarray(
                 d.decode(cube.dim_ids[c][sel])))
-        for f in functions:
-            if f.info.base == "COUNT":
-                continue
-            stats = cube.metric_stats[f.column]
+        for col in stat_cols:
+            stats = cube.metric_stats[col]
             for k in ("sum", "min", "max"):
-                stat_chunks.setdefault(f"{f.column}.{k}", []).append(
+                stat_chunks.setdefault(f"{col}.{k}", []).append(
                     stats[k][sel])
 
     counts = np.concatenate(cnt_chunks) if cnt_chunks else \

@@ -1,0 +1,30 @@
+"""Linear-time sorted factorize shared by the dictionary and cube builders.
+
+np.unique is an O(n log n) argsort; a hash factorize is O(n) plus a sort of
+the (tiny) unique set. pandas provides the hash table; without it the
+np.unique fallback keeps behavior identical.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def sorted_factorize(arr: np.ndarray
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(sorted unique values, inverse codes) for arr, or None when the
+    linear path can't run (pandas missing, or NaN-like values that
+    factorize maps to the -1 sentinel — callers fall back to np.unique)."""
+    try:
+        import pandas as pd
+    except ImportError:
+        return None
+    codes, uniq = pd.factorize(arr)
+    if len(codes) and codes.min() < 0:          # -1 = NaN sentinel
+        return None
+    uniq = np.asarray(uniq)
+    order = np.argsort(uniq, kind="stable")      # unique set: tiny vs n
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order), dtype=np.int64)
+    return uniq[order], rank[codes]

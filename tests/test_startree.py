@@ -208,3 +208,31 @@ def test_max_leaf_records_does_not_disable_cube():
         "functionColumnPairs": ["SUM__runs"],
         "maxLeafRecords": 10000})
     assert c.max_groups > 10000     # Pinot's split threshold is not a cap
+
+
+def test_multi_segment_repeated_column_aggs():
+    """Regression: MIN(x), MAX(x) (two functions, one column) over the
+    multi-segment cube path double-appended x's stat lanes, breaking the
+    counts/stats alignment (IndexError at 2 segments)."""
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.star_tree_configs = [ST_CONFIG]
+    segs, plain = [], []
+    for i in range(3):
+        cols = make_columns(5_000, seed=40 + i)
+        d_st = os.path.join(base, f"st{i}")
+        d_pl = os.path.join(base, f"pl{i}")
+        SegmentCreator(make_schema(), cfg, f"st{i}").build(dict(cols), d_st)
+        SegmentCreator(make_schema(), make_table_config(),
+                       f"pl{i}").build(dict(cols), d_pl)
+        segs.append(ImmutableSegmentLoader.load(d_st))
+        plain.append(ImmutableSegmentLoader.load(d_pl))
+    eng_st, eng_plain = QueryEngine(segs), QueryEngine(plain)
+    for q in ("SELECT COUNT(*), MIN(runs), MAX(runs) FROM baseballStats "
+              "WHERE teamID = 'BOS'",
+              "SELECT MIN(average), MAX(average), AVG(hits) "
+              "FROM baseballStats WHERE league = 'AL'",
+              "SELECT MINMAXRANGE(runs), MIN(runs) FROM baseballStats "
+              "GROUP BY league TOP 10"):
+        assert _result_key(eng_st.query(q)) == \
+            _result_key(eng_plain.query(q)), q
