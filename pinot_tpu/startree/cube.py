@@ -216,11 +216,8 @@ def build_cube_from_arrays(config: StarTreeConfig,
 def _linear_unique(key: np.ndarray):
     """(sorted unique keys, inverse codes) — O(n) hash factorize with an
     np.unique fallback (pandas missing)."""
-    from pinot_tpu.utils.factorize import sorted_factorize
-    fact = sorted_factorize(key)
-    if fact is None:
-        return np.unique(key, return_inverse=True)
-    return fact
+    from pinot_tpu.utils.factorize import sorted_factorize_or_unique
+    return sorted_factorize_or_unique(key)
 
 
 def load_star_trees(seg_dir) -> List[StarTreeCube]:

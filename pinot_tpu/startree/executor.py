@@ -4,26 +4,35 @@ Parity: core/startree/ query side — StarTreeFilterOperator +
 StarTreeAggregationExecutor/StarTreeGroupByExecutor and the plan nodes
 that swap in when a query's dimensions/metrics are covered
 (StarTreeV2's eligibility rules). Here the cube is a columnar grouped
-table, so execution is: evaluate the filter over the cube's dictId lanes
-(reusing the host filter evaluator through a segment-shaped facade),
+table, so execution is: evaluate the filter over the cube's dictId lanes,
 then weighted aggregation over the surviving groups.
 
-Cubes are small by construction (bounded at build), so this runs
-host-side numpy — O(groups) instead of the device's O(docs); doc-scale
-work never happens at all, which is the entire point of the structure.
+Cube rows are SORTED by the split order (lexicographic in the packed
+dictId key — the build's sorted factorize guarantees it), which is the
+flattened form of the reference's tree: a conjunctive filter whose
+leading split dimensions resolve to dictId intervals narrows to
+contiguous row blocks by binary search (OffHeapStarTreeNode child lookup
+≡ np.searchsorted on the sorted dim lane), and only the surviving block
+rows are scanned for the residual predicates. A covering cube therefore
+answers in O(log groups + matched rows) host time instead of O(groups).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from pinot_tpu.common import expression as expr_mod
-from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
+                                      FilterQueryTree)
 from pinot_tpu.query.aggregation import make_functions
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
 
 _COVERED_BASES = {"COUNT", "SUM", "AVG", "MIN", "MAX", "MINMAXRANGE"}
+
+# stop expanding prefix blocks past this fan-out: the residual scan over
+# a bounded union of blocks is cheaper than deep enumeration
+_PREFIX_BLOCK_LIMIT = 512
 
 
 class _CubeDataSource:
@@ -88,14 +97,189 @@ def _eligible_cube(segment, request: BrokerRequest, functions):
         if expr_mod.is_expression(f.column):
             return None
         needed_metrics.add(f.column)
+    best = None
+    best_score = None
+    leaves = _conjunctive_leaves(request.filter)
     for cube in cubes:
-        if needed_dims <= set(cube.dimensions) and \
-                needed_metrics <= set(cube.metrics) and \
-                cube.n_groups * 8 <= segment.num_docs:
-            # the cube must actually compress: scanning a cube nearly as
-            # tall as the segment costs more than the doc-scale kernel
-            return cube
+        if not (needed_dims <= set(cube.dimensions) and
+                needed_metrics <= set(cube.metrics)):
+            continue
+        score = _prefix_score(segment, cube, leaves)
+        if cube.n_groups * 8 > segment.num_docs and score == 0:
+            # without prefix narrowing the cube must actually compress:
+            # scanning a cube nearly as tall as the segment costs more
+            # than the doc-scale kernel
+            continue
+        key = (score, -cube.n_groups)
+        if best is None or key > best_score:
+            best, best_score = cube, key
+    return best
+
+
+def _prefix_score(segment, cube, leaves) -> int:
+    """How many leading split dims a conjunctive filter narrows — the
+    cube-choice metric (deeper prefix ⇒ smaller scanned blocks)."""
+    if not leaves:
+        return 0
+    by_col = {}
+    for lf in leaves:
+        by_col.setdefault(lf.column, []).append(lf)
+    score = 0
+    for dim in cube.dimensions:
+        ivs = None
+        for lf in by_col.get(dim, ()):
+            ivs = _leaf_id_intervals(lf, segment.data_source(dim))
+            if ivs is not None:
+                break
+        if ivs is None:
+            break
+        score += 1
+        if not all(b - a == 1 for a, b in ivs):
+            break                       # descent stops after an interval
+    return score
+
+
+def _conjunctive_leaves(tree: Optional[FilterQueryTree]
+                        ) -> Optional[List[FilterQueryTree]]:
+    """Flatten an AND-only filter tree into its leaves; None when the
+    tree contains OR (prefix narrowing needs a pure conjunction)."""
+    if tree is None:
+        return []
+    if tree.is_leaf():
+        return [tree]
+    if tree.operator != FilterOperator.AND:
+        return None
+    out: List[FilterQueryTree] = []
+    for c in tree.children:
+        sub = _conjunctive_leaves(c)
+        if sub is None:
+            return None
+        out.extend(sub)
+    return out
+
+
+def _leaf_id_intervals(leaf: FilterQueryTree, ds
+                       ) -> Optional[List[Tuple[int, int]]]:
+    """Sorted-dictionary dictId intervals [a, b) equivalent to the leaf,
+    or None when the leaf can't narrow a sorted cube lane (NOT/NOT_IN/
+    REGEXP, expression columns, unsorted mutable dictionaries)."""
+    if expr_mod.is_expression(leaf.column):
+        return None
+    d = ds.dictionary
+    if d is None or not getattr(d, "is_sorted", True):
+        return None
+    op = leaf.operator
+    if op == FilterOperator.EQUALITY:
+        i = d.index_of(leaf.values[0])
+        return [] if i < 0 else [(i, i + 1)]
+    if op == FilterOperator.IN:
+        ids = sorted({d.index_of(v) for v in leaf.values} - {-1})
+        return [(i, i + 1) for i in ids]
+    if op == FilterOperator.RANGE:
+        lo, hi = d.range_to_id_interval(
+            leaf.lower, leaf.upper, leaf.lower_inclusive,
+            leaf.upper_inclusive)
+        return [] if hi <= lo else [(lo, hi)]
     return None
+
+
+def _prefix_select(segment, cube, leaves: List[FilterQueryTree]
+                   ) -> Optional[Tuple[np.ndarray, int]]:
+    """(selected row indices, rows examined) via sorted-prefix descent,
+    or None when the leading split dimension is unconstrained (full scan
+    is then the only option). Parity: StarTreeFilterOperator's
+    depth-first child matching over OffHeapStarTreeNode, done as binary
+    searches on the sorted dim lanes."""
+    by_col: Dict[str, List[FilterQueryTree]] = {}
+    for lf in leaves:
+        by_col.setdefault(lf.column, []).append(lf)
+
+    blocks: List[Tuple[int, int]] = [(0, cube.n_groups)]
+    consumed: set = set()
+    narrowed = False
+    for dim in cube.dimensions:
+        ivs = None
+        src = None
+        for lf in by_col.get(dim, ()):
+            ivs = _leaf_id_intervals(lf, segment.data_source(dim))
+            if ivs is not None:
+                src = lf
+                break
+        if ivs is None:
+            break                       # unconstrained dim: stop descent
+        lane = cube.dim_ids[dim]
+        new_blocks: List[Tuple[int, int]] = []
+        if len(blocks) * max(len(ivs), 1) > _PREFIX_BLOCK_LIMIT:
+            break
+        dt = lane.dtype.type          # dim lanes are int32; ids fit
+        for lo, hi in blocks:
+            seg_lane = lane[lo:hi]
+            for a, b in ivs:
+                # dtype-matched scalars: a python-int key would make numpy
+                # promote (copy+cast) the whole lane per call (~120x)
+                s = lo + int(np.searchsorted(seg_lane, dt(a), side="left"))
+                e = lo + int(np.searchsorted(seg_lane, dt(b), side="left"))
+                if s < e:
+                    new_blocks.append((s, e))
+        blocks = new_blocks
+        consumed.add(id(src))
+        narrowed = True
+        if not blocks:
+            break
+        if not all(b - a == 1 for a, b in ivs):
+            # rows inside a multi-id block aren't sorted by deeper dims
+            break
+    if not narrowed:
+        return None
+
+    sel = (np.concatenate([np.arange(lo, hi, dtype=np.int64)
+                           for lo, hi in blocks])
+           if blocks else np.zeros(0, np.int64))
+    examined = int(sel.size)
+    residual = [lf for lf in leaves if id(lf) not in consumed]
+    if residual and sel.size:
+        from pinot_tpu.query import host_exec
+        view = _SlicedCubeView(segment, cube, sel)
+        m = np.ones(sel.size, dtype=bool)
+        for lf in residual:
+            m &= host_exec._eval_leaf(lf, view)
+        sel = sel[m]
+    return sel, examined
+
+
+class _SlicedCubeView:
+    """_CubeView restricted to a row subset (residual predicate eval)."""
+
+    def __init__(self, segment, cube, sel: np.ndarray):
+        self._segment = segment
+        self._cube = cube
+        self._sel = sel
+        self.num_docs = int(sel.size)
+        self.segment_name = segment.segment_name
+
+    def has_column(self, col: str) -> bool:
+        return col in self._cube.dim_ids
+
+    def data_source(self, col: str) -> _CubeDataSource:
+        return _CubeDataSource(self._segment.data_source(col),
+                               self._cube.dim_ids[col][self._sel])
+
+
+def _cube_select(segment, cube, tree: Optional[FilterQueryTree]
+                 ) -> Tuple[np.ndarray, int]:
+    """Selected cube row indices + rows-examined. Prefix descent when
+    the filter is conjunctive and constrains the leading split dims;
+    full member-gather scan otherwise. Raises for predicates the host
+    evaluator can't resolve (callers fall back to the non-cube path)."""
+    leaves = _conjunctive_leaves(tree)
+    if leaves is not None and tree is not None:
+        ps = _prefix_select(segment, cube, leaves)
+        if ps is not None:
+            return ps
+    from pinot_tpu.query import host_exec
+    view = _CubeView(segment, cube)
+    mask = host_exec._eval_filter(tree, view)
+    return np.nonzero(mask)[0], cube.n_groups
 
 
 def try_star_tree_execute(segment, request: BrokerRequest
@@ -107,25 +291,23 @@ def try_star_tree_execute(segment, request: BrokerRequest
     cube = _eligible_cube(segment, request, functions)
     if cube is None:
         return None
-    from pinot_tpu.query import host_exec
-    view = _CubeView(segment, cube)
     try:
-        mask = host_exec._eval_filter(request.filter, view)
+        sel, examined = _cube_select(segment, cube, request.filter)
     except Exception:  # noqa: BLE001 — unresolvable predicate: fall back
         return None
 
     blk = IntermediateResultsBlock()
     counts = cube.counts
-    matched_docs = int(counts[mask].sum())
+    matched_docs = int(counts[sel].sum())
     if request.is_group_by:
-        _cube_group_by(segment, cube, request, functions, mask, blk)
+        _cube_group_by(segment, cube, request, functions, sel, blk)
     else:
         blk.agg_intermediates = [
-            _cube_aggregate(cube, f, mask) for f in functions]
+            _cube_aggregate(cube, f, sel) for f in functions]
     blk.stats = ExecutionStats(
-        num_docs_scanned=int(mask.sum()),         # groups, not raw docs —
+        num_docs_scanned=int(sel.size),           # groups, not raw docs —
         # parity: star-tree queries report aggregated doc counts
-        num_entries_scanned_in_filter=cube.n_groups,
+        num_entries_scanned_in_filter=examined,
         num_segments_processed=1,
         num_segments_matched=1 if matched_docs else 0,
         total_docs=segment.num_docs)
@@ -153,7 +335,6 @@ def try_star_tree_execute_multi(segments, request: BrokerRequest
             return None                   # all segments must be covered
         pairs.append((seg, cube))
 
-    from pinot_tpu.query import host_exec
     gcols = list(request.group_by.columns) if request.group_by else []
     val_chunks: List[List[np.ndarray]] = [[] for _ in gcols]
     cnt_chunks: List[np.ndarray] = []
@@ -167,13 +348,11 @@ def try_star_tree_execute_multi(segments, request: BrokerRequest
     scanned = 0
     for seg, cube in pairs:
         total_docs += seg.num_docs
-        scanned += cube.n_groups
-        view = _CubeView(seg, cube)
         try:
-            mask = host_exec._eval_filter(request.filter, view)
+            sel, examined = _cube_select(seg, cube, request.filter)
         except Exception:  # noqa: BLE001 — unresolvable predicate
             return None
-        sel = np.nonzero(mask)[0]
+        scanned += examined
         matched_groups += len(sel)
         cnt_chunks.append(cube.counts[sel])
         for i, c in enumerate(gcols):
@@ -252,8 +431,11 @@ def _multi_group_by(gcols, val_chunks, counts, stats_cat, functions,
                     lambda f, k: stats_cat[f"{f.column}.{k}"])
 
 
-def _cube_aggregate(cube, f, mask: np.ndarray):
+def _cube_aggregate(cube, f, sel: np.ndarray):
+    """sel: selected row indices (or a boolean mask — fancy indexing
+    treats both identically here)."""
     base = f.info.base
+    mask = sel
     cnt = int(cube.counts[mask].sum())
     if base == "COUNT":
         return cnt
@@ -274,10 +456,9 @@ def _cube_aggregate(cube, f, mask: np.ndarray):
     raise ValueError(base)
 
 
-def _cube_group_by(segment, cube, request, functions, mask: np.ndarray,
+def _cube_group_by(segment, cube, request, functions, sel: np.ndarray,
                    blk: IntermediateResultsBlock) -> None:
     gcols = request.group_by.columns
-    sel = np.nonzero(mask)[0]
     lanes = [cube.dim_ids[c][sel].astype(np.int64) for c in gcols]
     cards = [segment.data_source(c).metadata.cardinality for c in gcols]
     key = np.zeros(len(sel), dtype=np.int64)
@@ -335,13 +516,10 @@ def _fill_group_map(blk: IntermediateResultsBlock, functions, g: int,
             else:
                 per_fn.append([(float(a), float(b))
                                for a, b in zip(mins, maxs)])
+    # tolist() converts np scalars to python at C speed — the per-element
+    # _plain/.item() genexpr was the profile's top fixed cost per query
+    col_lists = [np.asarray(vc).tolist() for vc in value_cols]
+    n_fn = len(functions)
     blk.group_map = {
-        tuple(_plain(vc[i]) for vc in value_cols):
-            [per_fn[fi][i] for fi in range(len(functions))]
-        for i in range(g)}
-
-
-def _plain(v):
-    if isinstance(v, np.generic):
-        return v.item()
-    return v
+        key: [per_fn[fi][i] for fi in range(n_fn)]
+        for i, key in enumerate(zip(*col_lists))}

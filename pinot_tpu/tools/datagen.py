@@ -243,21 +243,27 @@ def ssb_schema():
 # benchmark's star-tree segment variant, contrib/pinot-druid-benchmark
 # config/; functional dependencies — city→nation→region, brand→category→
 # mfgr — keep the actual group counts far below the dimension product).
+# Split orders put each query class's FILTER dims first: cube rows are
+# sorted by split order, so the executor's prefix descent narrows to
+# contiguous blocks by binary search (the classic split-order guidance —
+# most-filtered dimensions first).
 SSB_STAR_TREE_CONFIGS = [
-    {"dimensionsSplitOrder": ["d_year", "p_brand1", "s_region",
+    {"dimensionsSplitOrder": ["s_region", "p_brand1", "d_year",
                               "p_category"],
      "metrics": ["lo_revenue"]},                      # Q2.1-2.3
-    {"dimensionsSplitOrder": ["c_nation", "s_nation", "d_year",
-                              "c_region", "s_region"],
+    {"dimensionsSplitOrder": ["c_region", "s_region", "c_nation",
+                              "s_nation", "d_year"],
      "metrics": ["lo_revenue"]},                      # Q3.1
-    {"dimensionsSplitOrder": ["c_city", "s_city", "c_nation", "s_nation",
+    {"dimensionsSplitOrder": ["c_nation", "s_nation", "c_city", "s_city",
                               "d_year"],
-     "metrics": ["lo_revenue"]},                      # Q3.2/3.3
-    {"dimensionsSplitOrder": ["d_year", "c_nation", "c_region", "s_region",
-                              "p_mfgr"],
+     "metrics": ["lo_revenue"]},                      # Q3.2
+    {"dimensionsSplitOrder": ["c_city", "s_city", "d_year"],
+     "metrics": ["lo_revenue"]},                      # Q3.3
+    {"dimensionsSplitOrder": ["c_region", "s_region", "p_mfgr", "d_year",
+                              "c_nation"],
      "metrics": ["lo_revenue", "lo_supplycost"]},     # Q4.1
-    {"dimensionsSplitOrder": ["d_year", "s_nation", "p_category",
-                              "c_region", "s_region", "p_mfgr"],
+    {"dimensionsSplitOrder": ["c_region", "s_region", "p_mfgr", "d_year",
+                              "s_nation", "p_category"],
      "metrics": ["lo_revenue", "lo_supplycost"]},     # Q4.2
 ]
 

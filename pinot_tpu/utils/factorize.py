@@ -28,3 +28,14 @@ def sorted_factorize(arr: np.ndarray
     rank = np.empty(len(order), np.int64)
     rank[order] = np.arange(len(order), dtype=np.int64)
     return uniq[order], rank[codes]
+
+
+def sorted_factorize_or_unique(arr: np.ndarray
+                               ) -> Tuple[np.ndarray, np.ndarray]:
+    """sorted_factorize with the canonical np.unique fallback — callers
+    that don't need a custom fallback (e.g. a pre-cast step) use this so
+    the fallback semantics live in one place."""
+    fact = sorted_factorize(arr)
+    if fact is None:
+        return np.unique(arr, return_inverse=True)
+    return fact

@@ -236,3 +236,59 @@ def test_multi_segment_repeated_column_aggs():
               "GROUP BY league TOP 10"):
         assert _result_key(eng_st.query(q)) == \
             _result_key(eng_plain.query(q)), q
+
+
+def test_prefix_descent_narrows_and_matches():
+    """Sorted-prefix cube descent (binary-search blocks) must agree with
+    the plain path AND examine far fewer rows than the full cube."""
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.pql.optimizer import BrokerRequestOptimizer
+    from pinot_tpu.startree.executor import (_cube_select,
+                                             _eligible_cube)
+    from pinot_tpu.query.aggregation import make_functions
+
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    # filter dims first: teamID/league EQ/IN queries become prefix blocks
+    cfg.indexing_config.star_tree_configs = [{
+        "dimensionsSplitOrder": ["teamID", "league", "yearID"],
+        "functionColumnPairs": ["SUM__runs", "SUM__hits", "MAX__average"],
+    }]
+    cols = make_columns(30_000, seed=51)
+    d_st = os.path.join(base, "st")
+    d_pl = os.path.join(base, "pl")
+    SegmentCreator(make_schema(), cfg, "st").build(dict(cols), d_st)
+    SegmentCreator(make_schema(), make_table_config(),
+                   "pl").build(dict(cols), d_pl)
+    seg = ImmutableSegmentLoader.load(d_st)
+    seg_pl = ImmutableSegmentLoader.load(d_pl)
+    cube = seg.star_trees[0]
+
+    # cube rows must be sorted by split order (the descent's invariant)
+    key = np.zeros(cube.n_groups, np.int64)
+    for dim in cube.dimensions:
+        card = seg.data_source(dim).metadata.cardinality
+        key = key * card + cube.dim_ids[dim]
+    assert (np.diff(key) > 0).all()
+
+    eng_st, eng_pl = QueryEngine([seg]), QueryEngine([seg_pl])
+    prefix_qs = [
+        "SELECT SUM(runs) FROM baseballStats WHERE teamID = 'BOS'",
+        "SELECT SUM(runs), COUNT(*) FROM baseballStats WHERE teamID IN "
+        "('BOS', 'SEA') AND league = 'AL' GROUP BY yearID TOP 100",
+        "SELECT MAX(average) FROM baseballStats WHERE teamID = 'NYA' AND "
+        "league = 'NL' AND yearID >= 2000",
+        # RANGE on the first dim: one interval block, residual on yearID
+        "SELECT SUM(hits) FROM baseballStats WHERE teamID >= 'NYA' AND "
+        "yearID < 2005 GROUP BY league TOP 10",
+    ]
+    for q in prefix_qs:
+        assert _result_key(eng_st.query(q)) == _result_key(eng_pl.query(q)), q
+
+    # and the descent really narrows: examined rows << full cube
+    req = BrokerRequestOptimizer().optimize(compile_pql(prefix_qs[1]))
+    fns = make_functions(req.aggregations)
+    assert _eligible_cube(seg, req, fns) is cube
+    sel, examined = _cube_select(seg, cube, req.filter)
+    assert examined < cube.n_groups / 4
+    assert len(sel) <= examined
