@@ -41,6 +41,13 @@ class ControllerApiServer(ApiServer):
         router.add("GET", "/schemas", self._list_schemas)
         router.add("POST", "/schemas", self._add_schema)
         router.add("GET", "/schemas/{name}", self._get_schema)
+        # tenant CRUD (parity: PinotTenantRestletResource.java:80)
+        router.add("GET", "/tenants", self._list_tenants)
+        router.add("POST", "/tenants", self._create_tenant)
+        router.add("GET", "/tenants/{name}", self._tenant_instances)
+        router.add("DELETE", "/tenants/{name}", self._delete_tenant)
+        router.add("GET", "/instances", self._list_instances)
+        router.add("PUT", "/instances/{name}/tags", self._update_tags)
         router.add("GET", "/tables", self._list_tables)
         router.add("POST", "/tables", self._add_table)
         router.add("PUT", "/tables/{name}", self._update_table)
@@ -104,6 +111,76 @@ class ControllerApiServer(ApiServer):
         if schema is None:
             return HttpResponse.error(404, "schema not found")
         return HttpResponse.of_json(schema.to_json())
+
+    # -- tenants -----------------------------------------------------------
+    async def _list_tenants(self, request: HttpRequest) -> HttpResponse:
+        return HttpResponse.of_json(self.manager.tenants.tenants())
+
+    async def _create_tenant(self, request: HttpRequest) -> HttpResponse:
+        from pinot_tpu.controller.tenants import TenantError
+        body = request.json()
+        name = body.get("tenantName") or body.get("name")
+        role = (body.get("tenantRole") or body.get("role") or
+                "SERVER").upper()
+        instances = body.get("instances") or []
+        if not name:
+            return HttpResponse.error(400, "tenantName required")
+        try:
+            if role == "BROKER":
+                insts = self.manager.tenants.create_broker_tenant(
+                    name, instances)
+            else:
+                insts = self.manager.tenants.create_server_tenant(
+                    name, instances)
+        except TenantError as e:
+            return HttpResponse.error(400, str(e))
+        # broker membership may have changed for existing tables
+        for table in self.manager.table_names():
+            self.manager.refresh_broker_resource(table)
+        return HttpResponse.of_json(
+            {"status": f"tenant {name} ({role}) tagged on "
+             f"{len(insts)} instances"})
+
+    async def _tenant_instances(self, request: HttpRequest) -> HttpResponse:
+        name = request.path_params["name"]
+        role = request.query.get("type", "server").upper()
+        insts = self.manager.tenants.tenant_instances(name, role)
+        return HttpResponse.of_json(
+            {"tenantName": name, "type": role,
+             "ServerInstances" if role != "BROKER" else "BrokerInstances":
+                 insts})
+
+    async def _delete_tenant(self, request: HttpRequest) -> HttpResponse:
+        from pinot_tpu.controller.tenants import TenantError
+        name = request.path_params["name"]
+        role = request.query.get("type", "server").upper()
+        tables = [self.manager.get_table_config(t)
+                  for t in self.manager.table_names()]
+        try:
+            self.manager.tenants.delete_tenant(
+                name, role, [t for t in tables if t is not None])
+        except TenantError as e:
+            return HttpResponse.error(409, str(e))
+        return HttpResponse.of_json({"status": f"tenant {name} deleted"})
+
+    async def _list_instances(self, request: HttpRequest) -> HttpResponse:
+        tenants = self.manager.tenants
+        return HttpResponse.of_json(
+            {"instances": {i: tenants.instance_tags(i)
+                           for i in tenants.live_instances()}})
+
+    async def _update_tags(self, request: HttpRequest) -> HttpResponse:
+        from pinot_tpu.controller.tenants import TenantError
+        body = request.json()
+        try:
+            tags = self.manager.tenants.update_instance_tags(
+                request.path_params["name"], add=body.get("add", []),
+                remove=body.get("remove", []))
+        except TenantError as e:
+            return HttpResponse.error(404, str(e))
+        for table in self.manager.table_names():
+            self.manager.refresh_broker_resource(table)
+        return HttpResponse.of_json({"tags": tags})
 
     async def _list_tables(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json({"tables": self.manager.table_names()})

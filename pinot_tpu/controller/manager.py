@@ -26,6 +26,9 @@ from pinot_tpu.controller.assignment import (SegmentAssignmentStrategy,
 from pinot_tpu.controller.quota import (StorageQuotaChecker, dir_size_bytes,
                                         parse_storage_size)
 from pinot_tpu.controller.state_machine import (ClusterCoordinator, DROPPED)
+from pinot_tpu.controller.tenants import (BROKER_RESOURCE, DEFAULT_TENANT,
+                                          TenantManager, broker_tenant_tag,
+                                          server_tenant_tag)
 from pinot_tpu.segment.metadata import SegmentMetadata
 
 TABLE_CONFIGS = "/CONFIGS/TABLE"
@@ -59,6 +62,7 @@ class ResourceManager:
         self.fs.mkdir(deep_store_dir)
         self._assignments: Dict[str, SegmentAssignmentStrategy] = {}
         self._quota_checker = StorageQuotaChecker()
+        self.tenants = TenantManager(self.store)
 
     # -- schemas & tables --------------------------------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -72,11 +76,46 @@ class ResourceManager:
                   assignment: str = "balanced") -> str:
         table = config.table_name_with_type
         _validate_table_config(config)
+        tenant = config.tenant_config.server or DEFAULT_TENANT
+        if tenant != DEFAULT_TENANT and not self.server_instances_for(
+                config):
+            # parity: table creation fails when the named tenant has no
+            # tagged instances (DefaultTenant stays lenient so tables can
+            # be registered before servers in bootstrap flows)
+            raise InvalidTableConfigError(
+                f"server tenant {tenant} has no live tagged instances")
         self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
         self._assignments[table] = make_assignment(assignment)
         self.coordinator.set_ideal_state(table,
                                          self.coordinator.ideal_state(table))
+        self.refresh_broker_resource(table, config)
         return table
+
+    # -- tenants -----------------------------------------------------------
+    def server_instances_for(self, config: TableConfig) -> List[str]:
+        """Live server instances the table's segments may be assigned to
+        — scoped to its server tenant tag (parity: the tag-filtered
+        instance lists PinotHelixResourceManager feeds the assignment
+        strategies)."""
+        ttype = getattr(config.table_type, "name", str(config.table_type))
+        tag = server_tenant_tag(config.tenant_config.server, ttype)
+        return self.coordinator.live_instances(tag=tag)
+
+    def refresh_broker_resource(self, table: str,
+                                config: Optional[TableConfig] = None
+                                ) -> List[str]:
+        """Recompute /BROKERRESOURCE/<table>: the brokers serving the
+        table, by broker tenant tag (parity: the Helix brokerResource
+        ideal state; watched by DynamicBrokerSelector clients)."""
+        config = config or self.get_table_config(table)
+        if config is None:
+            return []
+        tag = broker_tenant_tag(config.tenant_config.broker)
+        brokers = self.coordinator.live_instances(tag=tag)
+        self.store.set(f"{BROKER_RESOURCE}/{table}",
+                       {"tenant": config.tenant_config.broker,
+                        "instances": brokers})
+        return brokers
 
     def get_table_config(self, table: str) -> Optional[TableConfig]:
         rec = self.store.get(f"{TABLE_CONFIGS}/{table}")
@@ -99,6 +138,7 @@ class ResourceManager:
     def delete_table(self, table: str) -> None:
         self.coordinator.drop_table(table)
         self.store.remove(f"{TABLE_CONFIGS}/{table}")
+        self.store.remove(f"{BROKER_RESOURCE}/{table}")
         for seg in self.segment_names(table):
             self.store.remove(f"{SEGMENTS}/{table}/{seg}")
         self.fs.delete(os.path.join(self.deep_store_dir, table))
@@ -152,7 +192,11 @@ class ResourceManager:
         replicas = config.segments_config.replication
         strategy = self._assignments.setdefault(
             table, make_assignment("balanced"))
-        servers = self.coordinator.live_instances()
+        servers = self.server_instances_for(config)
+        if not servers:
+            raise ValueError(
+                f"no live server instances for tenant "
+                f"{config.tenant_config.server} (table {table})")
         current = self.coordinator.ideal_state(table)
         if name in current:
             # refresh of an existing segment: keep its assignment, bounce
@@ -256,7 +300,7 @@ class ResourceManager:
         replicas = config.segments_config.replication
         strategy = self._assignments.setdefault(
             table, make_assignment("balanced"))
-        servers = self.coordinator.live_instances()
+        servers = self.server_instances_for(config)
         target: Dict[str, Dict[str, str]] = {}
         for seg in self.segment_names(table):
             assigned = strategy.assign(seg, servers, replicas, target)

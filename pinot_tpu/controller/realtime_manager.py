@@ -147,7 +147,7 @@ class RealtimeSegmentManager:
         stopped = set(meta.get("stoppedInstances", []))
         if (assigned & live) - stopped:
             return
-        servers = self.coordinator.live_instances()
+        servers = self.manager.server_instances_for(config)
         if not servers:
             return
         replicas = config.segments_config.replication
@@ -192,7 +192,7 @@ class RealtimeSegmentManager:
             "startOffset": int(start_offset),
             "creationTimeMs": int(time.time() * 1e3),
         })
-        servers = self.coordinator.live_instances()
+        servers = self.manager.server_instances_for(config)
         replicas = config.segments_config.replication
         strategy = self.manager._assignments.setdefault(
             table, make_assignment("balanced"))

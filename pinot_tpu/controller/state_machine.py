@@ -194,10 +194,11 @@ class ClusterCoordinator:
             self._recompute_view(table)
 
     def live_instances(self, tag: Optional[str] = None) -> List[str]:
+        from pinot_tpu.controller.tenants import has_tag
         out = []
         for inst in self.store.children(LIVE):
             rec = self.store.get(f"{LIVE}/{inst}") or {}
-            if tag is None or tag in rec.get("tags", []):
+            if tag is None or has_tag(rec.get("tags", []), tag):
                 out.append(inst)
         return sorted(out)
 

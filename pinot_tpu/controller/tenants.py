@@ -1,0 +1,169 @@
+"""Tenant management: tagged instances scope assignment and routing.
+
+Parity: PinotHelixResourceManager.java:701,883,931 (createBrokerTenant /
+createServerTenant / instance tag updates via TagNameUtils) and the REST
+CRUD surface of PinotTenantRestletResource.java:80. Tag scheme mirrors
+TagNameUtils:
+
+    <tenant>_OFFLINE / <tenant>_REALTIME   server roles
+    <tenant>_BROKER                        broker role
+
+A table's ``tenants.server`` selects which instances its segments may be
+assigned to (controller/manager.py consults :func:`server_tenant_tag`);
+``tenants.broker`` selects which brokers serve it (the
+``/BROKERRESOURCE/<table>`` record, watched by the client's dynamic
+broker selector). A bare legacy tag (e.g. ``"DefaultTenant"``) counts as
+every role of that tenant, so pre-tenant clusters keep working.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from pinot_tpu.controller.state_machine import LIVE
+
+DEFAULT_TENANT = "DefaultTenant"
+BROKER_RESOURCE = "/BROKERRESOURCE"
+
+_ROLE_SUFFIXES = ("_OFFLINE", "_REALTIME", "_BROKER")
+
+
+def server_tenant_tag(tenant: str, table_type: str = "OFFLINE") -> str:
+    role = "REALTIME" if str(table_type).upper() == "REALTIME" else \
+        "OFFLINE"
+    return f"{tenant or DEFAULT_TENANT}_{role}"
+
+
+def broker_tenant_tag(tenant: str) -> str:
+    return f"{tenant or DEFAULT_TENANT}_BROKER"
+
+
+def split_tag(tag: str):
+    """(tenant, role) — role None for a bare legacy tag."""
+    for suf in _ROLE_SUFFIXES:
+        if tag.endswith(suf):
+            return tag[:-len(suf)], suf[1:]
+    return tag, None
+
+
+def has_tag(tags: Iterable[str], wanted: str) -> bool:
+    """Exact tag match, or a bare legacy tag covering every role of its
+    tenant (pre-tenant instances tagged just "DefaultTenant")."""
+    tags = list(tags or ())
+    if wanted in tags:
+        return True
+    tenant, role = split_tag(wanted)
+    return role is not None and tenant in tags
+
+
+class TenantError(ValueError):
+    pass
+
+
+class TenantManager:
+    """Tenant CRUD over live-instance tag records."""
+
+    def __init__(self, store):
+        self.store = store
+
+    # -- tag plumbing ------------------------------------------------------
+    def instance_tags(self, instance: str) -> List[str]:
+        rec = self.store.get(f"{LIVE}/{instance}") or {}
+        return list(rec.get("tags", []))
+
+    def update_instance_tags(self, instance: str,
+                             add: Iterable[str] = (),
+                             remove: Iterable[str] = ()) -> List[str]:
+        path = f"{LIVE}/{instance}"
+        if self.store.get(path) is None:
+            raise TenantError(f"instance {instance} is not live")
+
+        def mut(rec):
+            rec = dict(rec or {})
+            tags = [t for t in rec.get("tags", []) if t not in set(remove)]
+            for t in add:
+                if t not in tags:
+                    tags.append(t)
+            rec["tags"] = tags
+            return rec
+
+        return self.store.update(path, mut)["tags"]
+
+    def live_instances(self) -> List[str]:
+        return sorted(self.store.children(LIVE))
+
+    def instances_with_tag(self, tag: str) -> List[str]:
+        return sorted(i for i in self.store.children(LIVE)
+                      if has_tag(self.instance_tags(i), tag))
+
+    # -- tenant CRUD (parity: PinotTenantRestletResource) ------------------
+    def create_server_tenant(self, name: str,
+                             instances: Iterable[str]) -> List[str]:
+        """Tag instances with both server roles of the tenant (the
+        reference splits offline/realtime counts; both-role tagging is
+        its common single-tenant-server deployment)."""
+        insts = list(instances)
+        if not insts:
+            raise TenantError("server tenant needs at least one instance")
+        for inst in insts:
+            self.update_instance_tags(
+                inst, add=[server_tenant_tag(name, "OFFLINE"),
+                           server_tenant_tag(name, "REALTIME")],
+                # tagging takes the instance out of the untagged pool
+                # (parity: the reference retags from the default tag)
+                remove=() if name == DEFAULT_TENANT else (DEFAULT_TENANT,))
+        return insts
+
+    def create_broker_tenant(self, name: str,
+                             instances: Iterable[str]) -> List[str]:
+        insts = list(instances)
+        if not insts:
+            raise TenantError("broker tenant needs at least one instance")
+        for inst in insts:
+            self.update_instance_tags(
+                inst, add=[broker_tenant_tag(name)],
+                remove=() if name == DEFAULT_TENANT else (DEFAULT_TENANT,))
+        return insts
+
+    def tenants(self) -> Dict[str, List[str]]:
+        """{"SERVER_TENANTS": [...], "BROKER_TENANTS": [...]}."""
+        servers, brokers = set(), set()
+        for inst in self.store.children(LIVE):
+            for tag in self.instance_tags(inst):
+                tenant, role = split_tag(tag)
+                if role == "BROKER":
+                    brokers.add(tenant)
+                elif role in ("OFFLINE", "REALTIME"):
+                    servers.add(tenant)
+                else:                      # bare legacy tag: all roles
+                    servers.add(tenant)
+                    brokers.add(tenant)
+        return {"SERVER_TENANTS": sorted(servers),
+                "BROKER_TENANTS": sorted(brokers)}
+
+    def tenant_instances(self, name: str, role: str = "SERVER"
+                         ) -> List[str]:
+        if role.upper() == "BROKER":
+            return self.instances_with_tag(broker_tenant_tag(name))
+        return sorted(set(
+            self.instances_with_tag(server_tenant_tag(name, "OFFLINE")) +
+            self.instances_with_tag(server_tenant_tag(name, "REALTIME"))))
+
+    def delete_tenant(self, name: str, role: str = "SERVER",
+                      tables: Optional[Iterable[str]] = None) -> None:
+        """Untag every instance; refused while a table still references
+        the tenant (parity: the reference 409s on tenants in use)."""
+        for table_cfg in tables or ():
+            tc = table_cfg.tenant_config
+            used = tc.broker if role.upper() == "BROKER" else tc.server
+            if used == name:
+                raise TenantError(
+                    f"tenant {name} is in use by "
+                    f"{table_cfg.table_name_with_type}")
+        if role.upper() == "BROKER":
+            remove = [broker_tenant_tag(name)]
+        else:
+            remove = [server_tenant_tag(name, "OFFLINE"),
+                      server_tenant_tag(name, "REALTIME")]
+        for inst in self.store.children(LIVE):
+            if any(t in self.instance_tags(inst) for t in remove):
+                self.update_instance_tags(inst, remove=remove)
