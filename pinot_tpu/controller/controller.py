@@ -46,3 +46,4 @@ class Controller:
 
     def stop(self) -> None:
         self.periodic.stop()
+        self.manager.close()

@@ -134,9 +134,8 @@ class ControllerApiServer(ApiServer):
                     name, instances)
         except TenantError as e:
             return HttpResponse.error(400, str(e))
-        # broker membership may have changed for existing tables
-        for table in self.manager.table_names():
-            self.manager.refresh_broker_resource(table)
+        # (broker-resource records refresh via the manager's
+        # live-instance watch — tag writes land on /LIVEINSTANCES)
         return HttpResponse.of_json(
             {"status": f"tenant {name} ({role}) tagged on "
              f"{len(insts)} instances"})
@@ -178,8 +177,6 @@ class ControllerApiServer(ApiServer):
                 remove=body.get("remove", []))
         except TenantError as e:
             return HttpResponse.error(404, str(e))
-        for table in self.manager.table_names():
-            self.manager.refresh_broker_resource(table)
         return HttpResponse.of_json({"tags": tags})
 
     async def _list_tables(self, request: HttpRequest) -> HttpResponse:

@@ -63,6 +63,16 @@ class ResourceManager:
         self._assignments: Dict[str, SegmentAssignmentStrategy] = {}
         self._quota_checker = StorageQuotaChecker()
         self.tenants = TenantManager(self.store)
+        # broker membership follows live-instance records (registration,
+        # death, tag changes) — watch them so /BROKERRESOURCE/<table>
+        # never goes stale for clients' dynamic broker selectors
+        from pinot_tpu.controller.state_machine import LIVE as _LIVE
+        self._live_watcher = lambda path, rec: \
+            self.refresh_all_broker_resources()
+        self.store.watch(_LIVE + "/", self._live_watcher)
+
+    def close(self) -> None:
+        self.store.unwatch(self._live_watcher)
 
     # -- schemas & tables --------------------------------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -112,10 +122,15 @@ class ResourceManager:
             return []
         tag = broker_tenant_tag(config.tenant_config.broker)
         brokers = self.coordinator.live_instances(tag=tag)
-        self.store.set(f"{BROKER_RESOURCE}/{table}",
-                       {"tenant": config.tenant_config.broker,
-                        "instances": brokers})
+        rec = {"tenant": config.tenant_config.broker,
+               "instances": brokers}
+        if self.store.get(f"{BROKER_RESOURCE}/{table}") != rec:
+            self.store.set(f"{BROKER_RESOURCE}/{table}", rec)
         return brokers
+
+    def refresh_all_broker_resources(self) -> None:
+        for table in self.table_names():
+            self.refresh_broker_resource(table)
 
     def get_table_config(self, table: str) -> Optional[TableConfig]:
         rec = self.store.get(f"{TABLE_CONFIGS}/{table}")
@@ -129,7 +144,13 @@ class ResourceManager:
         if self.store.get(f"{TABLE_CONFIGS}/{table}") is None:
             raise ValueError(f"table {table} not found")
         _validate_table_config(config)
+        tenant = config.tenant_config.server or DEFAULT_TENANT
+        if tenant != DEFAULT_TENANT and not self.server_instances_for(
+                config):
+            raise InvalidTableConfigError(
+                f"server tenant {tenant} has no live tagged instances")
         self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
+        self.refresh_broker_resource(table, config)
         return table
 
     def table_names(self) -> List[str]:

@@ -194,13 +194,8 @@ class ClusterCoordinator:
             self._recompute_view(table)
 
     def live_instances(self, tag: Optional[str] = None) -> List[str]:
-        from pinot_tpu.controller.tenants import has_tag
-        out = []
-        for inst in self.store.children(LIVE):
-            rec = self.store.get(f"{LIVE}/{inst}") or {}
-            if tag is None or has_tag(rec.get("tags", []), tag):
-                out.append(inst)
-        return sorted(out)
+        from pinot_tpu.controller.tenants import live_instances_with_tag
+        return live_instances_with_tag(self.store, tag)
 
     # -- ideal state -------------------------------------------------------
     def set_ideal_state(self, table: str,

@@ -59,6 +59,28 @@ class TenantError(ValueError):
     pass
 
 
+def live_instances_with_tag(store, tag: Optional[str]) -> List[str]:
+    """THE canonical tag-filtered live-instance scan — used by both the
+    coordinator's assignment path and the tenant REST views so tag
+    semantics can't diverge."""
+    out = []
+    for inst in store.children(LIVE):
+        rec = store.get(f"{LIVE}/{inst}") or {}
+        if tag is None or has_tag(rec.get("tags", []), tag):
+            out.append(inst)
+    return sorted(out)
+
+
+def _bare_tag_replacement(keep_role: str, tenant: str) -> List[str]:
+    """Tags that preserve a bare legacy tag's OTHER facets when one role
+    is being retagged/deleted: the bare form implied every role, so
+    stripping it must not silently drop the rest."""
+    if keep_role == "BROKER":
+        return [broker_tenant_tag(tenant)]
+    return [server_tenant_tag(tenant, "OFFLINE"),
+            server_tenant_tag(tenant, "REALTIME")]
+
+
 class TenantManager:
     """Tenant CRUD over live-instance tag records."""
 
@@ -92,8 +114,7 @@ class TenantManager:
         return sorted(self.store.children(LIVE))
 
     def instances_with_tag(self, tag: str) -> List[str]:
-        return sorted(i for i in self.store.children(LIVE)
-                      if has_tag(self.instance_tags(i), tag))
+        return live_instances_with_tag(self.store, tag)
 
     # -- tenant CRUD (parity: PinotTenantRestletResource) ------------------
     def create_server_tenant(self, name: str,
@@ -105,12 +126,16 @@ class TenantManager:
         if not insts:
             raise TenantError("server tenant needs at least one instance")
         for inst in insts:
-            self.update_instance_tags(
-                inst, add=[server_tenant_tag(name, "OFFLINE"),
-                           server_tenant_tag(name, "REALTIME")],
-                # tagging takes the instance out of the untagged pool
-                # (parity: the reference retags from the default tag)
-                remove=() if name == DEFAULT_TENANT else (DEFAULT_TENANT,))
+            add = [server_tenant_tag(name, "OFFLINE"),
+                   server_tenant_tag(name, "REALTIME")]
+            remove = ()
+            if name != DEFAULT_TENANT and \
+                    DEFAULT_TENANT in self.instance_tags(inst):
+                # retagging takes the instance out of the default SERVER
+                # pool; the bare tag's broker facet survives explicitly
+                add += _bare_tag_replacement("BROKER", DEFAULT_TENANT)
+                remove = (DEFAULT_TENANT,)
+            self.update_instance_tags(inst, add=add, remove=remove)
         return insts
 
     def create_broker_tenant(self, name: str,
@@ -119,9 +144,13 @@ class TenantManager:
         if not insts:
             raise TenantError("broker tenant needs at least one instance")
         for inst in insts:
-            self.update_instance_tags(
-                inst, add=[broker_tenant_tag(name)],
-                remove=() if name == DEFAULT_TENANT else (DEFAULT_TENANT,))
+            add = [broker_tenant_tag(name)]
+            remove = ()
+            if name != DEFAULT_TENANT and \
+                    DEFAULT_TENANT in self.instance_tags(inst):
+                add += _bare_tag_replacement("SERVER", DEFAULT_TENANT)
+                remove = (DEFAULT_TENANT,)
+            self.update_instance_tags(inst, add=add, remove=remove)
         return insts
 
     def tenants(self) -> Dict[str, List[str]]:
@@ -159,11 +188,19 @@ class TenantManager:
                 raise TenantError(
                     f"tenant {name} is in use by "
                     f"{table_cfg.table_name_with_type}")
-        if role.upper() == "BROKER":
-            remove = [broker_tenant_tag(name)]
-        else:
-            remove = [server_tenant_tag(name, "OFFLINE"),
-                      server_tenant_tag(name, "REALTIME")]
+        broker_role = role.upper() == "BROKER"
+        remove = [broker_tenant_tag(name)] if broker_role else \
+            [server_tenant_tag(name, "OFFLINE"),
+             server_tenant_tag(name, "REALTIME")]
         for inst in self.store.children(LIVE):
-            if any(t in self.instance_tags(inst) for t in remove):
-                self.update_instance_tags(inst, remove=remove)
+            tags = self.instance_tags(inst)
+            add: List[str] = []
+            rm = [t for t in remove if t in tags]
+            if name in tags:
+                # a bare legacy tag covers this role too: strip it while
+                # preserving its OTHER facets as explicit tags
+                rm.append(name)
+                add = _bare_tag_replacement(
+                    "SERVER" if broker_role else "BROKER", name)
+            if rm:
+                self.update_instance_tags(inst, add=add, remove=rm)
