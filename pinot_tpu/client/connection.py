@@ -149,7 +149,7 @@ class SimpleBrokerSelector:
         self._endpoints = [_HttpEndpoint(h, p) for h, p in shuffled]
         self._cycle = itertools.cycle(range(len(self._endpoints)))
 
-    def select(self) -> _HttpEndpoint:
+    def select(self, table: Optional[str] = None) -> _HttpEndpoint:
         return self._endpoints[next(self._cycle)]
 
     def close(self) -> None:
@@ -165,12 +165,16 @@ class Connection:
         self._selector = selector
         self._token = token
 
+    def prepare(self, pql: str) -> "PreparedStatement":
+        """`?`-placeholder statement (parity: Connection.prepareStatement)."""
+        return PreparedStatement(self, pql)
+
     def execute(self, pql: str, trace: bool = False) -> ResultSetGroup:
         body = json.dumps({"pql": pql, "trace": trace}).encode("utf-8")
         headers = {"Content-Type": "application/json"}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        endpoint = self._selector.select()
+        endpoint = self._selector.select(table_of(pql))
         try:
             # queries are read-only: safe to retry on a stale connection
             status, payload = endpoint.request("POST", "/query", body,
@@ -203,6 +207,15 @@ def connect(brokers, token: Optional[str] = None) -> Connection:
         else:
             endpoints.append(tuple(b))
     return Connection(SimpleBrokerSelector(endpoints), token=token)
+
+
+def connect_dynamic(store_host: str, store_port: int,
+                    token: Optional[str] = None) -> Connection:
+    """Connection that discovers brokers from the cluster's property
+    store and follows membership changes (parity: ConnectionFactory
+    .fromZookeeper → DynamicBrokerSelector)."""
+    return Connection(DynamicBrokerSelector(store_host, store_port),
+                      token=token)
 
 
 class ControllerClient:
@@ -277,3 +290,168 @@ class ControllerClient:
 
     def close(self) -> None:
         self._endpoint.close()
+
+
+# ---------------------------------------------------------------------------
+# Dynamic broker selection + prepared statements
+# ---------------------------------------------------------------------------
+
+import re as _re
+import threading as _threading
+
+_FROM_RE = _re.compile(r"\bFROM\s+([A-Za-z_][A-Za-z0-9_.]*)", _re.IGNORECASE)
+
+
+def table_of(pql: str) -> Optional[str]:
+    """Raw table name a query addresses (parity: the reference client's
+    query→table extraction feeding BrokerSelector.selectBroker)."""
+    m = _FROM_RE.search(pql)
+    return m.group(1) if m else None
+
+
+class DynamicBrokerSelector:
+    """Property-store-watching broker selector.
+
+    Parity: DynamicBrokerSelector.java:41 — the reference client watches
+    the ZK external view of the broker resource to learn, per table,
+    which brokers are live; here the same contract runs over the
+    cluster's property store (controller/store_client.py is the ZK
+    client analogue): live-instance records carry broker host:port +
+    tenant tags, /BROKERRESOURCE/<table> carries the table→broker
+    mapping, and both are watched, so broker restarts/kills never
+    require client reconfiguration.
+    """
+
+    LIVE = "/LIVEINSTANCES"
+    BROKER_RESOURCE = "/BROKERRESOURCE"
+
+    def __init__(self, store_host: str, store_port: int):
+        from pinot_tpu.controller.store_client import RemotePropertyStore
+        self._store = RemotePropertyStore(store_host, store_port)
+        self._lock = _threading.Lock()
+        self._brokers: Dict[str, Tuple[str, int]] = {}   # inst -> endpoint
+        self._tables: Dict[str, List[str]] = {}          # table -> insts
+        self._endpoints: Dict[Tuple[str, int], _HttpEndpoint] = {}
+        self._rng = random.Random()
+        self._watcher = self._on_change
+        self._store.watch(self.LIVE + "/", self._watcher)
+        self._store.watch(self.BROKER_RESOURCE + "/", self._watcher)
+        for inst in self._store.children(self.LIVE):
+            self._on_change(f"{self.LIVE}/{inst}",
+                            self._store.get(f"{self.LIVE}/{inst}"))
+        for table in self._store.children(self.BROKER_RESOURCE):
+            self._on_change(f"{self.BROKER_RESOURCE}/{table}",
+                            self._store.get(
+                                f"{self.BROKER_RESOURCE}/{table}"))
+
+    def _on_change(self, path: str, record: Optional[dict]) -> None:
+        with self._lock:
+            if path.startswith(self.LIVE + "/"):
+                inst = path[len(self.LIVE) + 1:]
+                # explicit _BROKER tags only: broker processes always
+                # self-register with the suffix; a server's bare legacy
+                # tag must not make its QUERY port look like a broker
+                is_broker = record is not None and any(
+                    t.endswith("_BROKER")
+                    for t in record.get("tags", []))
+                if record is None or "host" not in record or \
+                        not is_broker:
+                    gone = self._brokers.pop(inst, None)
+                    # evict the endpoint (and its keep-alive socket)
+                    # unless another live broker shares the address
+                    if gone is not None and gone not in \
+                            self._brokers.values():
+                        ep = self._endpoints.pop(gone, None)
+                        if ep is not None:
+                            ep.close()
+                else:
+                    self._brokers[inst] = (record["host"],
+                                           int(record["port"]))
+            else:
+                table = path[len(self.BROKER_RESOURCE) + 1:]
+                if record is None:
+                    self._tables.pop(table, None)
+                else:
+                    self._tables[table] = list(record.get("instances", []))
+
+    def _endpoint(self, addr: Tuple[str, int]) -> _HttpEndpoint:
+        ep = self._endpoints.get(addr)
+        if ep is None:
+            ep = self._endpoints[addr] = _HttpEndpoint(*addr)
+        return ep
+
+    def select(self, table: Optional[str] = None) -> _HttpEndpoint:
+        with self._lock:
+            candidates: List[Tuple[str, int]] = []
+            if table is not None:
+                insts: List[str] = []
+                for t in (table, f"{table}_OFFLINE", f"{table}_REALTIME"):
+                    insts.extend(self._tables.get(t, ()))
+                candidates = [self._brokers[i] for i in insts
+                              if i in self._brokers]
+            if not candidates:
+                candidates = list(self._brokers.values())
+            if not candidates:
+                raise PinotClientError("no live brokers in the cluster")
+            return self._endpoint(self._rng.choice(candidates))
+
+    def live_brokers(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            return dict(self._brokers)
+
+    def close(self) -> None:
+        for ep in self._endpoints.values():
+            ep.close()
+        self._store.close()
+
+
+class PreparedStatement:
+    """`?`-placeholder statement with value escaping.
+
+    Parity: PreparedStatement.java:27 — the reference fills placeholders
+    client-side with single-quote escaping before sending the final PQL.
+    """
+
+    def __init__(self, connection: "Connection", pql: str):
+        self._connection = connection
+        self._template = pql.split("?")
+        self._values: List[Optional[str]] = \
+            [None] * (len(self._template) - 1)
+
+    def _set(self, i: int, literal: str) -> "PreparedStatement":
+        if not 0 <= i < len(self._values):
+            raise PinotClientError(
+                f"placeholder index {i} out of range "
+                f"(statement has {len(self._values)})")
+        self._values[i] = literal
+        return self
+
+    def set_string(self, i: int, value: str) -> "PreparedStatement":
+        escaped = str(value).replace("'", "''")
+        return self._set(i, f"'{escaped}'")
+
+    def set_int(self, i: int, value: int) -> "PreparedStatement":
+        return self._set(i, str(int(value)))
+
+    def set_long(self, i: int, value: int) -> "PreparedStatement":
+        return self._set(i, str(int(value)))
+
+    def set_float(self, i: int, value: float) -> "PreparedStatement":
+        return self._set(i, repr(float(value)))
+
+    def set_double(self, i: int, value: float) -> "PreparedStatement":
+        return self._set(i, repr(float(value)))
+
+    def fill(self) -> str:
+        if any(v is None for v in self._values):
+            missing = [i for i, v in enumerate(self._values) if v is None]
+            raise PinotClientError(f"unset placeholders: {missing}")
+        out = []
+        for i, part in enumerate(self._template):
+            out.append(part)
+            if i < len(self._values):
+                out.append(self._values[i])
+        return "".join(out)
+
+    def execute(self, trace: bool = False) -> ResultSetGroup:
+        return self._connection.execute(self.fill(), trace=trace)

@@ -233,10 +233,19 @@ class ControllerApiServer(ApiServer):
         return HttpResponse.of_json(view.segment_states)
 
     async def _rebalance(self, request: HttpRequest) -> HttpResponse:
+        import asyncio as _asyncio
         dry = request.query.get("dryRun", "false").lower() == "true"
-        target = self.manager.rebalance_table(
-            request.path_params["name"], dry_run=dry)
-        return HttpResponse.of_json({"dryRun": dry, "targetState": target})
+        downtime = request.query.get("downtime",
+                                     "false").lower() == "true"
+        # the stepping path blocks on external-view convergence — run it
+        # off the event loop so uploads and realtime commit traffic keep
+        # flowing during a rebalance
+        target = await _asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.manager.rebalance_table(
+                request.path_params["name"], dry_run=dry,
+                downtime=downtime))
+        return HttpResponse.of_json({"dryRun": dry, "downtime": downtime,
+                                     "targetState": target})
 
     async def _list_segments(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json(self.manager.segment_names(

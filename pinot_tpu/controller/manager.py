@@ -17,7 +17,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
-from pinot_tpu.common.cluster_state import ONLINE
+from pinot_tpu.common.cluster_state import CONSUMING, ONLINE
 from pinot_tpu.common.filesystem import LocalPinotFS, PinotFS
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.table_config import TableConfig
@@ -308,12 +308,22 @@ class ResourceManager:
         return len(segments)
 
     # -- rebalance ---------------------------------------------------------
-    def rebalance_table(self, table: str, dry_run: bool = False) -> Dict:
-        """Recompute the whole assignment against live instances.
+    def rebalance_table(self, table: str, dry_run: bool = False,
+                        downtime: bool = False,
+                        min_available_replicas: int = 1,
+                        batch_size: int = 10,
+                        converge_timeout_s: float = 30.0) -> Dict:
+        """Recompute the whole assignment against live tenant instances
+        and walk the ideal state toward it WITHOUT dropping availability.
 
-        Parity: TableRebalancer/DefaultRebalanceSegmentStrategy — target
-        state computed fresh; applied in one ideal-state write (servers
-        converge; queries keep working through refcounted swap).
+        Parity: TableRebalancer.java:51,82-97,195-217 — no-downtime mode
+        steps the ideal state make-before-break: new replicas are added
+        (and awaited in the external view) before old ones are dropped,
+        keeping ≥min_available_replicas serving replicas per segment at
+        every intermediate state; `downtime=True` is the one-shot write
+        (faster, for maintenance windows); `batch_size` bounds how many
+        segments move per step (bounds the transient extra capacity the
+        make-before-break union costs).
         """
         config = self.get_table_config(table)
         if config is None:
@@ -322,10 +332,77 @@ class ResourceManager:
         strategy = self._assignments.setdefault(
             table, make_assignment("balanced"))
         servers = self.server_instances_for(config)
+        current = self.coordinator.ideal_state(table)
         target: Dict[str, Dict[str, str]] = {}
         for seg in self.segment_names(table):
+            cur = current.get(seg, {})
+            if CONSUMING in cur.values():
+                # in-progress LLC segments are pinned to their consumers
+                # (parity: TableRebalancer leaves CONSUMING partitions to
+                # the realtime repair path) — flipping them ONLINE would
+                # kill ingestion and fail the load ('no committed
+                # artifact')
+                target[seg] = dict(cur)
+                continue
             assigned = strategy.assign(seg, servers, replicas, target)
             target[seg] = {inst: ONLINE for inst in assigned}
-        if not dry_run:
+        if dry_run:
+            return target
+        if downtime:
             self.coordinator.set_ideal_state(table, target)
+            return target
+
+        moving = sorted(s for s in set(current) | set(target)
+                        if current.get(s) != target.get(s))
+        for i in range(0, len(moving), max(batch_size, 1)):
+            batch = moving[i:i + max(batch_size, 1)]
+            # step 1 (make): run old + new replicas side by side
+            def add_new(segments, batch=batch):
+                for seg in batch:
+                    merged = dict(segments.get(seg, {}))
+                    merged.update(target.get(seg, {}))
+                    segments[seg] = merged
+                return segments
+
+            self.coordinator.update_ideal_state(table, add_new)
+            self._await_converged(table, {s: target.get(s, {})
+                                          for s in batch},
+                                  min_available_replicas,
+                                  converge_timeout_s)
+
+            # step 2 (break): drop replicas not in the target
+            def drop_old(segments, batch=batch):
+                for seg in batch:
+                    tgt = target.get(seg)
+                    if tgt:
+                        segments[seg] = dict(tgt)
+                    else:
+                        segments.pop(seg, None)
+                return segments
+
+            self.coordinator.update_ideal_state(table, drop_old)
         return target
+
+    def _await_converged(self, table: str,
+                         wanted: Dict[str, Dict[str, str]],
+                         min_available: int, timeout_s: float) -> None:
+        """Block until every segment has ≥min_available of its wanted
+        replicas serving in the external view (parity: the
+        external-view convergence wait between TableRebalancer steps)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            view = self.coordinator.external_view(table).segment_states
+            # drop-only segments (empty wanted map) need no convergence
+            ok = all(
+                not wanted.get(seg) or
+                sum(1 for inst, st in wanted[seg].items()
+                    if view.get(seg, {}).get(inst) == st) >=
+                min(min_available, len(wanted[seg]))
+                for seg in wanted)
+            if ok:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rebalance: external view of {table} did not "
+                    f"converge within {timeout_s}s")
+            time.sleep(0.05)

@@ -46,13 +46,15 @@ def split_tag(tag: str):
 
 
 def has_tag(tags: Iterable[str], wanted: str) -> bool:
-    """Exact tag match, or a bare legacy tag covering every role of its
-    tenant (pre-tenant instances tagged just "DefaultTenant")."""
+    """Exact tag match, or a bare legacy tag covering the SERVER roles
+    of its tenant (pre-tenant server participants register just
+    "DefaultTenant"; brokers always self-register with _BROKER tags, so
+    a bare tag never makes a server look like a broker)."""
     tags = list(tags or ())
     if wanted in tags:
         return True
     tenant, role = split_tag(wanted)
-    return role is not None and tenant in tags
+    return role in ("OFFLINE", "REALTIME") and tenant in tags
 
 
 class TenantError(ValueError):
@@ -71,12 +73,10 @@ def live_instances_with_tag(store, tag: Optional[str]) -> List[str]:
     return sorted(out)
 
 
-def _bare_tag_replacement(keep_role: str, tenant: str) -> List[str]:
-    """Tags that preserve a bare legacy tag's OTHER facets when one role
-    is being retagged/deleted: the bare form implied every role, so
-    stripping it must not silently drop the rest."""
-    if keep_role == "BROKER":
-        return [broker_tenant_tag(tenant)]
+def _bare_server_tags(tenant: str) -> List[str]:
+    """The explicit form of a bare legacy tag's coverage (both server
+    roles) — used when an operation must strip the bare form but keep
+    part of what it implied."""
     return [server_tenant_tag(tenant, "OFFLINE"),
             server_tenant_tag(tenant, "REALTIME")]
 
@@ -126,16 +126,13 @@ class TenantManager:
         if not insts:
             raise TenantError("server tenant needs at least one instance")
         for inst in insts:
-            add = [server_tenant_tag(name, "OFFLINE"),
-                   server_tenant_tag(name, "REALTIME")]
-            remove = ()
-            if name != DEFAULT_TENANT and \
-                    DEFAULT_TENANT in self.instance_tags(inst):
-                # retagging takes the instance out of the default SERVER
-                # pool; the bare tag's broker facet survives explicitly
-                add += _bare_tag_replacement("BROKER", DEFAULT_TENANT)
-                remove = (DEFAULT_TENANT,)
-            self.update_instance_tags(inst, add=add, remove=remove)
+            # retagging takes the instance out of the default SERVER
+            # pool (parity: the reference retags from the default tag)
+            self.update_instance_tags(
+                inst, add=[server_tenant_tag(name, "OFFLINE"),
+                           server_tenant_tag(name, "REALTIME")],
+                remove=() if name == DEFAULT_TENANT
+                else (DEFAULT_TENANT,))
         return insts
 
     def create_broker_tenant(self, name: str,
@@ -148,7 +145,9 @@ class TenantManager:
             remove = ()
             if name != DEFAULT_TENANT and \
                     DEFAULT_TENANT in self.instance_tags(inst):
-                add += _bare_tag_replacement("SERVER", DEFAULT_TENANT)
+                # the bare tag covered the server roles: keep them
+                # explicit while leaving the default pool
+                add += _bare_server_tags(DEFAULT_TENANT)
                 remove = (DEFAULT_TENANT,)
             self.update_instance_tags(inst, add=add, remove=remove)
         return insts
@@ -161,11 +160,8 @@ class TenantManager:
                 tenant, role = split_tag(tag)
                 if role == "BROKER":
                     brokers.add(tenant)
-                elif role in ("OFFLINE", "REALTIME"):
+                else:              # suffixed server tag or bare legacy
                     servers.add(tenant)
-                else:                      # bare legacy tag: all roles
-                    servers.add(tenant)
-                    brokers.add(tenant)
         return {"SERVER_TENANTS": sorted(servers),
                 "BROKER_TENANTS": sorted(brokers)}
 
@@ -194,13 +190,8 @@ class TenantManager:
              server_tenant_tag(name, "REALTIME")]
         for inst in self.store.children(LIVE):
             tags = self.instance_tags(inst)
-            add: List[str] = []
             rm = [t for t in remove if t in tags]
-            if name in tags:
-                # a bare legacy tag covers this role too: strip it while
-                # preserving its OTHER facets as explicit tags
-                rm.append(name)
-                add = _bare_tag_replacement(
-                    "SERVER" if broker_role else "BROKER", name)
+            if not broker_role and name in tags:
+                rm.append(name)       # bare legacy tag = server roles
             if rm:
-                self.update_instance_tags(inst, add=add, remove=rm)
+                self.update_instance_tags(inst, remove=rm)

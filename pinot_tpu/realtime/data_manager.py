@@ -54,7 +54,7 @@ class RealtimeSegmentDataManager:
     def __init__(self, llc: LLCSegmentName, table: str, schema,
                  table_config, stream_config: StreamConfig,
                  start_offset: int, completion, instance_id: str,
-                 table_data_manager, work_dir: str):
+                 table_data_manager, work_dir: str, stats_history=None):
         self.llc = llc
         self.table = table
         self.stream_config = stream_config
@@ -64,7 +64,12 @@ class RealtimeSegmentDataManager:
         self.work_dir = work_dir
         self.offset = int(start_offset)
         self.state = CONSUMING_STATE
-        self.mutable = MutableSegmentImpl(schema, table_config, llc.name)
+        self.stats_history = stats_history
+        # allocation sizing from the table's completed-segment history
+        # (parity: RealtimeSegmentStatsHistory.java:49 feedback loop)
+        hint = stats_history.estimate(table) if stats_history else None
+        self.mutable = MutableSegmentImpl(schema, table_config, llc.name,
+                                          stats_hint=hint)
         self.consumer = stream_config.consumer_factory \
             .create_partition_consumer(stream_config, llc.partition)
         self.decoder = stream_config.decoder
@@ -197,6 +202,9 @@ class RealtimeSegmentDataManager:
             log.exception("segment build failed for %s", self.llc.name)
             self._enter_error(f"segment build failed: {e}")
             return
+        # record stats NOW: commit_end's CONSUMING→ONLINE swap destroys
+        # the mutable (releasing its buffers) before it returns
+        stats = self.mutable.collect_stats()
         resp = self.completion.commit_end(self.table, self.llc.name,
                                           self.instance_id, self.offset,
                                           out_dir)
@@ -206,6 +214,8 @@ class RealtimeSegmentDataManager:
             self._enter_error(f"commit_end failed: {resp.status}")
             return
         self.state = COMMITTED
+        if self.stats_history is not None:
+            self.stats_history.add_segment_stats(self.table, stats)
 
 
 class RealtimeTableDataManager:
@@ -222,6 +232,10 @@ class RealtimeTableDataManager:
         self.manager = resource_manager
         self.completion = completion
         self.work_dir = work_dir
+        from pinot_tpu.realtime.stats_history import \
+            RealtimeSegmentStatsHistory
+        self.stats_history = RealtimeSegmentStatsHistory(
+            os.path.join(work_dir, "stats_history.json"))
         self._consuming: Dict[str, RealtimeSegmentDataManager] = {}
         self._closed = False
         self._lock = threading.Lock()
@@ -257,7 +271,8 @@ class RealtimeTableDataManager:
                 llc, table, schema, config, stream_config,
                 int(meta["startOffset"]), self.completion,
                 self.server.instance_id, tdm,
-                os.path.join(self.work_dir, table))
+                os.path.join(self.work_dir, table),
+                stats_history=self.stats_history)
 
     def on_segment_online(self, table: str, segment: str) -> None:
         """CONSUMING→ONLINE (or OFFLINE→ONLINE for a committed LLC

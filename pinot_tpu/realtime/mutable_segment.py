@@ -118,7 +118,8 @@ class _GrowableArray:
 class _MutableDataSource:
     """DataSource-compatible column view over mutable storage."""
 
-    def __init__(self, field: FieldSpec, has_dictionary: bool):
+    def __init__(self, field: FieldSpec, has_dictionary: bool,
+                 initial_capacity: int = 4096):
         self.field = field
         self.has_dictionary = has_dictionary
         self.dictionary = MutableDictionary(field.data_type) \
@@ -128,7 +129,7 @@ class _MutableDataSource:
         self.sorted_ranges = None
         if field.single_value:
             dtype = np.int32 if has_dictionary else field.data_type.np_dtype
-            self._sv = _GrowableArray(dtype)
+            self._sv = _GrowableArray(dtype, capacity=initial_capacity)
             self._mv: Optional[List[List[int]]] = None
         else:
             self._sv = None
@@ -381,13 +382,25 @@ class MutableSegmentImpl:
     is_mutable = True
 
     def __init__(self, schema: Schema, table_config: TableConfig,
-                 segment_name: str):
+                 segment_name: str, stats_hint: Optional[dict] = None):
+        """stats_hint: RealtimeSegmentStatsHistory.estimate() output —
+        sizes initial row-buffer allocations so steady-state consumption
+        skips the growth-copy ladder (parity: the reference sizing
+        MutableSegmentImpl allocations from RealtimeSegmentStatsHistory).
+        """
         self.schema = schema
         self.table_config = table_config
         self.segment_name = segment_name
         no_dict = set(table_config.indexing_config.no_dictionary_columns)
+        est_rows = int((stats_hint or {}).get("rows", 0))
+        # next pow2 ≥ estimate, floor 4096, capped so a bad estimate
+        # can't allocate unbounded memory up front
+        cap = 4096
+        while cap < est_rows and cap < (1 << 24):
+            cap *= 2
         self._sources = {
-            f.name: _MutableDataSource(f, f.name not in no_dict)
+            f.name: _MutableDataSource(f, f.name not in no_dict,
+                                       initial_capacity=cap)
             for f in schema.fields}
         self._num_docs = 0
         self._lock = threading.Lock()
@@ -414,6 +427,22 @@ class MutableSegmentImpl:
                     pass
             self._num_docs += 1
         return True
+
+    def collect_stats(self) -> dict:
+        """Completed-segment stats for RealtimeSegmentStatsHistory
+        (parity: the stats the reference records at segment completion:
+        rows indexed, per-column cardinality, avg MV count)."""
+        with self._lock:
+            cols = {}
+            for name, ds in self._sources.items():
+                st = {"cardinality": int(ds.dictionary.cardinality)
+                      if ds.dictionary is not None else 0}
+                if ds._mv is not None and self._num_docs:
+                    st["avgMvCount"] = (sum(len(v) for v in ds._mv) /
+                                        self._num_docs)
+                cols[name] = st
+            return {"numRowsIndexed": int(self._num_docs),
+                    "columns": cols}
 
     # -- query interface (ImmutableSegment-compatible) ---------------------
     def snapshot_view(self, start: int = 0) -> MutableSegmentView:

@@ -116,10 +116,17 @@ class DistributedServer:
 
 class DistributedBroker:
     """Broker process: spectator over a remote store + TCP data plane with
-    endpoints learned from live-instance records."""
+    endpoints learned from live-instance records. Registers itself as an
+    ephemeral live instance carrying its broker tenant tag + HTTP
+    endpoint, so tenant-aware broker resources and dynamic client
+    selectors see it (parity: HelixBrokerStarter registering the broker
+    participant under its tenant tag)."""
 
     def __init__(self, store_host: str, store_port: int,
-                 deep_store_dir: str, http: bool = False):
+                 deep_store_dir: str, http: bool = False,
+                 instance_id: Optional[str] = None,
+                 broker_tenant: str = "DefaultTenant",
+                 host: str = "127.0.0.1"):
         self.store = RemotePropertyStore(store_host, store_port)
         coordinator = ClusterCoordinator(self.store)
         manager = ResourceManager(coordinator, deep_store_dir)
@@ -135,10 +142,23 @@ class DistributedBroker:
             segment_pruner=self.watcher.partition_pruner)
         self.http_api = None
         self.http_port: Optional[int] = None
+        self.instance_id = instance_id
+        self._registered = False
         if http:
             from pinot_tpu.broker.http_api import BrokerApiServer
             self.http_api = BrokerApiServer(self.handler)
             self.http_port = self.http_api.start()
+            from pinot_tpu.controller.tenants import broker_tenant_tag
+            if self.instance_id is None:
+                self.instance_id = f"Broker_{host}_{self.http_port}"
+            # ephemeral: dies with this process's store session, so a
+            # killed broker drops out of every selector automatically
+            self.store.set(
+                f"{LIVE}/{self.instance_id}",
+                {"tags": [broker_tenant_tag(broker_tenant)],
+                 "host": host, "port": self.http_port},
+                ephemeral=True)
+            self._registered = True
 
     def _on_live(self, path: str, record: Optional[dict]) -> None:
         inst = path[len(LIVE) + 1:]
@@ -150,7 +170,20 @@ class DistributedBroker:
         return self.handler.handle(pql)
 
     def stop(self) -> None:
+        if self._registered:          # only the record THIS broker wrote
+            try:
+                self.store.remove(f"{LIVE}/{self.instance_id}")
+            except Exception:  # noqa: BLE001 — session may be dead
+                pass
         if self.http_api is not None:
             self.http_api.stop()
         self.handler.close()
         self.store.close()
+
+    def kill(self) -> None:
+        """Crash simulation: the ephemeral live record must vanish with
+        the store session, with no deregistration call."""
+        self.store.close()
+        if self.http_api is not None:
+            self.http_api.stop()
+        self.handler.close()

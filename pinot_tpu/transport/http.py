@@ -97,6 +97,7 @@ class HttpServer:
         self.port = port
         self.router = router
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -106,13 +107,24 @@ class HttpServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # wait_closed() (3.12) waits for every open connection; an
+            # idle keep-alive client would park it forever — cancel the
+            # per-connection tasks so shutdown is prompt
+            for t in list(self._conn_tasks):
+                t.cancel()
+            try:
+                await self._server.wait_closed()
+            except asyncio.CancelledError:
+                pass
             self._server = None
 
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername")
         client = f"{peer[0]}:{peer[1]}" if peer else ""
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
         try:
             while True:
                 try:
@@ -133,7 +145,11 @@ class HttpServer:
         except (ConnectionError, asyncio.IncompleteReadError,
                 asyncio.LimitOverrunError, ValueError):
             pass       # malformed request / oversized header line
+        except asyncio.CancelledError:
+            pass       # server shutdown cancelled this connection
         finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
             writer.close()
             try:
                 await writer.wait_closed()

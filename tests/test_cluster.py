@@ -418,3 +418,122 @@ def test_storage_quota_rejects_upload(tmp_path):
         assert meta["sizeBytes"] > 0
     finally:
         cluster.stop()
+
+
+def test_no_downtime_rebalance_under_query_load(tmp_path):
+    """VERDICT done-condition: rebalance a 2-replica table while a query
+    loop runs — zero failed queries, and every intermediate ideal-state
+    write keeps >=1 previously-serving replica per segment
+    (TableRebalancer.java:82-97 make-before-break parity)."""
+    import threading
+
+    from fixtures import make_columns
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    c = EmbeddedCluster(str(tmp_path), num_servers=2)
+    try:
+        cfg = make_table_config()
+        cfg.segments_config.replication = 2
+        c.add_schema(make_schema())
+        c.add_table(cfg)
+        table = cfg.table_name_with_type
+        total = 0
+        for i in range(4):
+            d = os.path.join(str(tmp_path), f"seg{i}")
+            cols = make_columns(2000, seed=60 + i)
+            SegmentCreator(make_schema(), make_table_config(),
+                           segment_name=f"seg{i}").build(cols, d)
+            c.upload_segment(table, d)
+            total += 2000
+
+        # record every intermediate ideal-state write during rebalance
+        states = []
+        c.controller.coordinator.store.watch(
+            f"/IDEALSTATES/{table}",
+            lambda p, rec: states.append(
+                {s: dict(m) for s, m in (rec or {}).get("segments",
+                                                        {}).items()}))
+
+        # register two new servers mid-flight -> rebalance must move load
+        from pinot_tpu.server.instance import ServerInstance
+        from pinot_tpu.server.participant import ServerParticipant
+        for i in (2, 3):
+            name = f"Server_{i}"
+            srv = ServerInstance(name)
+            part = ServerParticipant(
+                srv, c.controller.manager,
+                completion=c.controller.realtime,
+                work_dir=os.path.join(str(tmp_path), "work", name))
+            c.servers[name] = srv
+            c.participants[name] = part
+            c.controller.coordinator.register_participant(name, part)
+            # (c.servers IS the InProcessTransport's dict — already wired)
+
+        failures = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    resp = c.query("SELECT COUNT(*) FROM baseballStats")
+                    if int(resp.aggregation_results[0].value) != total or \
+                            resp.num_servers_responded < \
+                            resp.num_servers_queried:
+                        failures.append(resp.to_json())
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            target = c.controller.manager.rebalance_table(
+                table, batch_size=1)
+        finally:
+            stop.set()
+            t.join()
+        assert not failures, failures[:3]
+
+        # rebalance actually moved something onto the new servers
+        placed = {i for m in target.values() for i in m}
+        assert placed & {"Server_2", "Server_3"}, target
+
+        # make-before-break invariant on every intermediate write
+        prev = None
+        for st in states:
+            if prev is not None:
+                for seg, insts in st.items():
+                    if seg in prev:
+                        kept = set(prev[seg]) & set(insts)
+                        assert kept, (seg, prev[seg], insts)
+            prev = st
+
+        # and the final state serves correct answers
+        resp = c.query("SELECT COUNT(*) FROM baseballStats")
+        assert int(resp.aggregation_results[0].value) == total
+    finally:
+        c.stop()
+
+
+def test_rebalance_downtime_flag_one_shot(tmp_path):
+    c = EmbeddedCluster(str(tmp_path), num_servers=3)
+    try:
+        cfg = make_table_config()
+        cfg.segments_config.replication = 1
+        c.add_schema(make_schema())
+        c.add_table(cfg)
+        table = cfg.table_name_with_type
+        from fixtures import make_columns
+        from pinot_tpu.segment.creator import SegmentCreator
+        d = os.path.join(str(tmp_path), "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "seg0").build(make_columns(1000, seed=70), d)
+        c.upload_segment(table, d)
+        writes = []
+        c.controller.coordinator.store.watch(
+            f"/IDEALSTATES/{table}", lambda p, rec: writes.append(1))
+        c.controller.manager.rebalance_table(table, downtime=True)
+        assert len(writes) == 1          # one-shot write, no stepping
+        resp = c.query("SELECT COUNT(*) FROM baseballStats")
+        assert int(resp.aggregation_results[0].value) == 1000
+    finally:
+        c.stop()

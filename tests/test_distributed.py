@@ -445,3 +445,93 @@ def test_crossprocess_realtime_tcp_stream_kill_restart():
                 p.kill()
         pub.close()
         topic_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Dynamic broker selection + prepared statements (client API completeness)
+# ---------------------------------------------------------------------------
+
+
+def test_dynamic_broker_selector_survives_broker_kill(tmp_path):
+    """VERDICT done-condition: the client keeps querying across a broker
+    kill/restart with no reconfiguration (DynamicBrokerSelector.java:41
+    parity over the property store)."""
+    from pinot_tpu.client.connection import (PinotClientError,
+                                             connect_dynamic)
+
+    base = str(tmp_path)
+    ctrl = DistributedController(base)
+    server = DistributedServer("Server_0", "127.0.0.1", ctrl.store_port,
+                               ctrl.deep_store_dir,
+                               work_dir=os.path.join(base, "s0_work"))
+    b1 = DistributedBroker("127.0.0.1", ctrl.store_port,
+                           ctrl.deep_store_dir, http=True,
+                           instance_id="Broker_1")
+    b2 = DistributedBroker("127.0.0.1", ctrl.store_port,
+                           ctrl.deep_store_dir, http=True,
+                           instance_id="Broker_2")
+    conn = None
+    try:
+        ctrl.controller.manager.add_schema(make_schema())
+        cfg = make_table_config()
+        ctrl.controller.manager.add_table(cfg)
+        d = os.path.join(base, "seg0")
+        os.makedirs(d)
+        _, cols = build_segment(d, n=2000, seed=7, name="dynseg")
+        ctrl.controller.manager.add_segment("baseballStats_OFFLINE", d)
+
+        conn = connect_dynamic("127.0.0.1", ctrl.store_port)
+        sel = conn._selector
+        _await(lambda: len(sel.live_brokers()) == 2, msg="2 brokers seen")
+        # /BROKERRESOURCE carries the table→broker mapping
+        assert set(ctrl.controller.manager.refresh_broker_resource(
+            "baseballStats_OFFLINE")) == {"Broker_1", "Broker_2"}
+
+        _await(lambda: b1.handler.routing.has_table(
+            "baseballStats_OFFLINE") and b2.handler.routing.has_table(
+            "baseballStats_OFFLINE"), msg="brokers routable")
+        rs = conn.execute("SELECT COUNT(*) FROM baseballStats")
+        assert int(rs.result_set(0).get(0, 0)) == 2000
+
+        # prepared statement with escaping through the same connection
+        ps = conn.prepare("SELECT COUNT(*) FROM baseballStats "
+                          "WHERE teamID = ?")
+        ps.set_string(0, "BOS")
+        exp = int(np.sum(np.asarray(cols["teamID"]) == "BOS"))
+        assert int(ps.execute().result_set(0).get(0, 0)) == exp
+        assert "''" in conn.prepare("SELECT COUNT(*) FROM x WHERE a = ?"
+                                    ).set_string(0, "O'Brien").fill()
+
+        # kill one broker (session death, no deregistration): the client
+        # must keep answering via the survivor with no reconfiguration
+        b1.kill()
+        _await(lambda: len(sel.live_brokers()) == 1, msg="kill observed")
+        for _ in range(8):
+            rs = conn.execute("SELECT COUNT(*) FROM baseballStats")
+            assert int(rs.result_set(0).get(0, 0)) == 2000
+
+        # a replacement broker joins: the client picks it up, again with
+        # no reconfiguration
+        b3 = DistributedBroker("127.0.0.1", ctrl.store_port,
+                               ctrl.deep_store_dir, http=True,
+                               instance_id="Broker_3")
+        try:
+            _await(lambda: len(sel.live_brokers()) == 2,
+                   msg="replacement seen")
+            assert "Broker_3" in sel.live_brokers()
+            _await(lambda: b3.handler.routing.has_table(
+                "baseballStats_OFFLINE"), msg="b3 routable")
+            for _ in range(8):
+                rs = conn.execute("SELECT COUNT(*) FROM baseballStats")
+                assert int(rs.result_set(0).get(0, 0)) == 2000
+        finally:
+            b3.stop()
+    finally:
+        if conn is not None:
+            conn.close()
+        b2.stop()
+        try:
+            server.stop()
+        except Exception:
+            pass
+        ctrl.stop()

@@ -645,3 +645,86 @@ def test_device_snapshot_frozen_tail_serving():
     resp = ask("SELECT COUNT(*) FROM baseballStats WHERE yearID >= 1990",
                18_000)
     assert int(resp.aggregation_results[0].value) == len(m)
+
+
+def test_stats_history_sizes_next_segment(work_dir):
+    """Parity: RealtimeSegmentStatsHistory.java:49 — completed-segment
+    stats persist per table and size the NEXT consuming segment's
+    initial allocations (no growth-copy ladder at steady state)."""
+    from pinot_tpu.realtime.stats_history import RealtimeSegmentStatsHistory
+
+    stream = MemoryStream("topic_sh", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_sh", MemoryStreamConsumerFactory(stream, batch_size=64))
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(rt_config("mem_sh", "topic_sh", flush_rows=500))
+        rows = make_rows(1200, seed=9)
+        for r in rows[:600]:
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: len(done_segments(cluster)) >= 1)
+        assert wait_until(lambda: count_star(cluster) == 600)
+
+        rtdm = cluster.participants["Server_0"].realtime
+        hist = rtdm.stats_history
+        assert wait_until(lambda: len(hist.entries(RT_TABLE)) >= 1)
+        entry = hist.entries(RT_TABLE)[0]
+        assert entry["numRowsIndexed"] >= 500
+        assert entry["columns"]["teamID"]["cardinality"] > 0
+        est = hist.estimate(RT_TABLE)
+        assert est["rows"] >= 500
+
+        # the history is DURABLE (json on disk, atomic replace)
+        reloaded = RealtimeSegmentStatsHistory(hist.path)
+        assert reloaded.entries(RT_TABLE) == hist.entries(RT_TABLE)
+
+        # the live consuming segment created AFTER the commit allocated
+        # from the estimate: initial capacity >= pow2 ceiling of est rows
+        def second_seg():
+            for seg, rdm in rtdm._consuming.items():
+                if LLCSegmentName.parse(seg).sequence >= 1:
+                    return rdm
+            return None
+        assert wait_until(lambda: second_seg() is not None)
+        rdm = second_seg()
+        src = rdm.mutable._sources["teamID"]
+        want = 4096
+        while want < est["rows"]:
+            want *= 2
+        assert len(src._sv._arr) >= want, (len(src._sv._arr), want)
+    finally:
+        cluster.stop()
+
+
+def test_rebalance_preserves_consuming_segments(work_dir):
+    """Regression: rebalancing a realtime table must pin in-progress LLC
+    segments to their consumers (flipping them ONLINE would kill
+    ingestion with 'no committed artifact')."""
+    stream = MemoryStream("topic_rb", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_rb", MemoryStreamConsumerFactory(stream, batch_size=64))
+    cluster = EmbeddedCluster(work_dir, num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(rt_config("mem_rb", "topic_rb",
+                                    flush_rows=100_000))
+        rows = make_rows(300, seed=4)
+        for r in rows:
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: count_star(cluster) == 300)
+
+        target = cluster.controller.manager.rebalance_table(RT_TABLE)
+        # the consuming segment kept its CONSUMING state + holders
+        ideal = cluster.controller.coordinator.ideal_state(RT_TABLE)
+        consuming = [s for s, m in ideal.items()
+                     if "CONSUMING" in m.values()]
+        assert consuming, ideal
+        assert target[consuming[0]] == ideal[consuming[0]]
+
+        # ingestion is still alive after the rebalance
+        for r in make_rows(100, seed=5):
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: count_star(cluster) == 400)
+    finally:
+        cluster.stop()

@@ -30,9 +30,11 @@ def test_tag_helpers():
     assert broker_tenant_tag("A") == "A_BROKER"
     assert has_tag(["A_OFFLINE"], "A_OFFLINE")
     assert not has_tag(["A_OFFLINE"], "A_REALTIME")
-    # bare legacy tag covers every role of its tenant
+    # bare legacy tag covers the server roles of its tenant (brokers
+    # always self-register with explicit _BROKER tags)
     assert has_tag(["DefaultTenant"], "DefaultTenant_OFFLINE")
-    assert has_tag(["DefaultTenant"], "DefaultTenant_BROKER")
+    assert has_tag(["DefaultTenant"], "DefaultTenant_REALTIME")
+    assert not has_tag(["DefaultTenant"], "DefaultTenant_BROKER")
     assert not has_tag(["DefaultTenant"], "Other_OFFLINE")
 
 
