@@ -74,6 +74,71 @@ def push_segments(segment_dirs: Sequence[str],
     return [push(d) for d in segment_dirs]
 
 
+def preprocess_inputs(
+        input_paths: Sequence[str], fmt: str, schema: Schema,
+        out_base: str, partition_column: str, num_partitions: int,
+        partition_function: str = "murmur",
+        sort_column: Optional[str] = None,
+        **reader_kw) -> List[str]:
+    """Partition/sort shuffle stage BEFORE segment build.
+
+    Parity: pinot-hadoop/.../job/SegmentPreprocessingJob.java:59 — the
+    optional MR job that routes rows to one output file per partition
+    (so every built segment holds exactly one partition id and the
+    broker's partition pruning eliminates whole segments) and sorts rows
+    within each partition (so the sorted column gets a sorted forward
+    index). Emits JSON-lines files readable by the batch build; the
+    table's segmentPartitionConfig must name the same function/count for
+    the recorded metadata to line up with query-time hashing.
+    """
+    import json as _json
+
+    from pinot_tpu.common.partition import (coerce_partition_value,
+                                            make_partition_function)
+    from pinot_tpu.ingestion.record_reader import make_record_reader
+
+    fn = make_partition_function(partition_function, num_partitions)
+    part_field = schema.field(partition_column) \
+        if schema.has_column(partition_column) else None
+    dt = part_field.data_type.np_dtype if part_field is not None else None
+    sort_field = schema.field(sort_column) \
+        if sort_column is not None and schema.has_column(sort_column) \
+        else None
+    # keyed by the RAW partition id the creator will record (the modulo
+    # function yields negative ids for negative values — those must stay
+    # their own partition-pure files, not alias bucket [-1])
+    buckets: Dict[int, List[dict]] = {p: [] for p in range(num_partitions)}
+    for path in input_paths:
+        reader = make_record_reader(path, fmt, schema, **reader_kw)
+        with reader:
+            for row in reader:
+                # hash exactly what the segment creator will record:
+                # nulls become the schema default, values are typed
+                # (raw reader strings would hash/sort differently and
+                # split a partition across files)
+                v = row.get(partition_column)
+                if part_field is not None:
+                    v = part_field.convert(v)
+                p = fn.get_partition(coerce_partition_value(dt, v)
+                                     if dt is not None else v)
+                buckets.setdefault(p, []).append(dict(row))
+    os.makedirs(out_base, exist_ok=True)
+    out_paths: List[str] = []
+    for p, rows in sorted(buckets.items()):
+        if sort_column is not None:
+            if sort_field is not None:
+                rows.sort(key=lambda r: sort_field.convert(
+                    r.get(sort_column)))
+            else:
+                rows.sort(key=lambda r: r.get(sort_column))
+        out = os.path.join(out_base, f"part_{p}.json")
+        with open(out, "w") as fh:
+            for r in rows:
+                fh.write(_json.dumps(r) + "\n")
+        out_paths.append(out)
+    return out_paths
+
+
 def batch_ingest(input_paths: Sequence[str], fmt: str, schema: Schema,
                  out_base: str, table: str, manager,
                  table_config: Optional[TableConfig] = None,
