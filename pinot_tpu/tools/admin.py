@@ -191,10 +191,45 @@ def cmd_query_runner(args) -> int:
     return 0
 
 
+def _print_http(method: str, url: str, body=None) -> int:
+    """Run a controller call, printing error BODIES (the 400/409
+    responses carry the reason, e.g. 'tenant X is in use by t') instead
+    of dying with a traceback."""
+    import urllib.error
+    try:
+        out = _http(method, url, body)
+    except urllib.error.HTTPError as e:
+        print(json.dumps({"status": e.code,
+                          "error": e.read().decode("utf-8", "replace")},
+                         indent=2))
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_add_tenant(args) -> int:
+    """Parity: AddTenantCommand → PinotTenantRestletResource POST."""
+    return _print_http(
+        "POST", f"http://{args.controller}/tenants",
+        json.dumps({"tenantName": args.name,
+                    "tenantRole": args.role.upper(),
+                    "instances": args.instances}).encode())
+
+
+def cmd_list_tenants(args) -> int:
+    return _print_http("GET", f"http://{args.controller}/tenants")
+
+
+def cmd_delete_tenant(args) -> int:
+    return _print_http("DELETE", f"http://{args.controller}/tenants/"
+                       f"{args.name}?type={args.role.lower()}")
+
+
 def cmd_rebalance_table(args) -> int:
     out = _http("POST",
                 f"http://{args.controller}/tables/{args.table}/rebalance"
-                f"?dryRun={'true' if args.dry_run else 'false'}")
+                f"?dryRun={'true' if args.dry_run else 'false'}"
+                f"&downtime={'true' if args.downtime else 'false'}")
     print(json.dumps(out, indent=2))
     return 0
 
@@ -636,10 +671,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--steps", type=int, default=3)
     sp.set_defaults(fn=cmd_query_runner)
 
+    sp = sub.add_parser("AddTenant",
+                        help="tag instances as a server/broker tenant")
+    ctrl(sp)
+    sp.add_argument("--name", required=True)
+    sp.add_argument("--role", default="SERVER",
+                    choices=["SERVER", "BROKER", "server", "broker"])
+    sp.add_argument("--instances", nargs="+", required=True)
+    sp.set_defaults(fn=cmd_add_tenant)
+
+    sp = sub.add_parser("ListTenants", help="list tenants")
+    ctrl(sp)
+    sp.set_defaults(fn=cmd_list_tenants)
+
+    sp = sub.add_parser("DeleteTenant", help="untag a tenant")
+    ctrl(sp)
+    sp.add_argument("--name", required=True)
+    sp.add_argument("--role", default="SERVER",
+                    choices=["SERVER", "BROKER", "server", "broker"])
+    sp.set_defaults(fn=cmd_delete_tenant)
+
     sp = sub.add_parser("RebalanceTable", help="rebalance segments")
     ctrl(sp)
     sp.add_argument("--table", required=True)
     sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--downtime", action="store_true",
+                    help="one-shot write instead of no-downtime stepping")
     sp.set_defaults(fn=cmd_rebalance_table)
 
     sp = sub.add_parser("DeleteSegment", help="delete one segment")

@@ -183,3 +183,22 @@ def test_startree_viewer_and_provisioning_helper(capsys):
     assert m["2hosts"]["2h"]["totalMB"] >= m["4hosts"]["2h"]["totalMB"]
     # longer flush -> bigger consuming segments
     assert m["2hosts"]["6h"]["consumingMB"] > m["2hosts"]["2h"]["consumingMB"]
+
+
+def test_tenant_cli_commands(http_cluster, capsys):
+    """Parity: AddTenantCommand / tenant listing over the controller
+    REST, driven through the admin CLI."""
+    cluster, base = http_cluster
+    ctrl = f"127.0.0.1:{cluster.controller_port}"
+
+    rc, out = _run(["AddTenant", "--controller", ctrl, "--name", "CliT",
+                    "--role", "SERVER", "--instances", "Server_0"],
+                   capsys)
+    assert rc == 0 and "CliT" in out
+    rc, out = _run(["ListTenants", "--controller", ctrl], capsys)
+    assert rc == 0 and "CliT" in out
+    rc, out = _run(["DeleteTenant", "--controller", ctrl,
+                    "--name", "CliT"], capsys)
+    assert rc == 0
+    rc, out = _run(["ListTenants", "--controller", ctrl], capsys)
+    assert rc == 0 and "CliT" not in out
