@@ -1,0 +1,85 @@
+"""Retry policies for transient remote-operation failures.
+
+Parity: pinot-common/.../utils/retry/ — RetryPolicies.fixedDelayRetryPolicy /
+exponentialBackoffRetryPolicy / randomDelayRetryPolicy and the
+RetryPolicy.attempt contract (run the operation up to N times, sleeping
+per policy between attempts, raising the last failure when exhausted).
+Used by the segment fetch path (SegmentFetcherAndLoader's download
+retries) and available to any remote client.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class RetryExhaustedError(Exception):
+    """All attempts failed; __cause__ carries the last failure."""
+
+
+class RetryPolicy:
+    """attempts total tries; delay_for(i) seconds after failed try i."""
+
+    def __init__(self, attempts: int):
+        if attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        self.attempts = attempts
+
+    def delay_for(self, attempt: int) -> float:
+        raise NotImplementedError
+
+    def attempt(self, op: Callable[[], T],
+                retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                sleep: Callable[[float], None] = time.sleep) -> T:
+        last: BaseException | None = None
+        for i in range(self.attempts):
+            try:
+                return op()
+            except retry_on as e:  # noqa: PERF203 — retry loop
+                last = e
+                if i + 1 < self.attempts:
+                    sleep(self.delay_for(i))
+        raise RetryExhaustedError(
+            f"operation failed after {self.attempts} attempts: "
+            f"{last!r}") from last
+
+
+class FixedDelayRetryPolicy(RetryPolicy):
+    def __init__(self, attempts: int, delay_s: float):
+        super().__init__(attempts)
+        self.delay_s = float(delay_s)
+
+    def delay_for(self, attempt: int) -> float:
+        return self.delay_s
+
+
+class ExponentialBackoffRetryPolicy(RetryPolicy):
+    """delay = initial * scale^attempt, uniformly jittered to [0.5, 1)x
+    (the reference randomizes within the window to avoid thundering
+    herds on a recovering endpoint)."""
+
+    def __init__(self, attempts: int, initial_delay_s: float,
+                 scale: float = 2.0, rng: random.Random | None = None):
+        super().__init__(attempts)
+        self.initial_delay_s = float(initial_delay_s)
+        self.scale = float(scale)
+        self._rng = rng or random.Random()
+
+    def delay_for(self, attempt: int) -> float:
+        window = self.initial_delay_s * (self.scale ** attempt)
+        return window * (0.5 + 0.5 * self._rng.random())
+
+
+class RandomDelayRetryPolicy(RetryPolicy):
+    def __init__(self, attempts: int, min_delay_s: float,
+                 max_delay_s: float, rng: random.Random | None = None):
+        super().__init__(attempts)
+        self.min_delay_s = float(min_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self._rng = rng or random.Random()
+
+    def delay_for(self, attempt: int) -> float:
+        return self._rng.uniform(self.min_delay_s, self.max_delay_s)

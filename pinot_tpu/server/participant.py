@@ -66,7 +66,16 @@ class ServerParticipant(StateModel):
             tempfile.gettempdir(),
             f"pinot_tpu_seg_{self.server.instance_id}")
         local = os.path.join(work, "fetched", table, segment)
-        get_fs(download_path).copy(download_path, local)
+        # transient deep-store failures (controller restarting, network
+        # blip) retry with backoff before the transition goes ERROR
+        # (parity: SegmentFetcherAndLoader's RetryPolicies-wrapped fetch)
+        from pinot_tpu.common.retry import ExponentialBackoffRetryPolicy
+        ExponentialBackoffRetryPolicy(attempts=3, initial_delay_s=0.2) \
+            .attempt(lambda: get_fs(download_path).copy(download_path,
+                                                        local),
+                     # transient classes only: a 404/permission/URI error
+                     # can't heal and must fail the transition fast
+                     retry_on=(ConnectionError, TimeoutError, OSError))
         return local
 
     def on_become_consuming(self, table: str, segment: str) -> None:

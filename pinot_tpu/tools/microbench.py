@@ -1,0 +1,175 @@
+"""Component microbenchmarks (the pinot-perf JMH analogue).
+
+Parity: pinot-perf/src/main/java/.../perf/ — BenchmarkOfflineIndexReader,
+RawIndexBenchmark, dictionary benchmarks, BenchmarkRealtimeConsumptionSpeed
+(SURVEY.md §6). Each benchmark times one storage/engine component in
+isolation and reports a JSON line {"bench", "value", "unit"}; `run_all`
+returns the records (and the CLI prints them). Sizes are parameters so CI
+smoke runs stay fast while full runs use realistic scales.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _rate(n: int, fn: Callable[[], None], reps: int = 3) -> float:
+    """ops (rows) per second, median of reps."""
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return n / float(np.median(ts))
+
+
+def bench_dictionary_encode(n: int = 1_000_000, card: int = 1000) -> dict:
+    """SegmentDictionaryCreator path: string column → sorted dict + ids."""
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.segment.dictionary import Dictionary
+    rng = np.random.default_rng(0)
+    pool = np.array([f"value_{i:06d}" for i in range(card)], dtype=object)
+    col = pool[rng.integers(0, card, n)]
+    rate = _rate(n, lambda: Dictionary.build_encoded(DataType.STRING, col))
+    return {"bench": "dictionary_encode_string", "value": round(rate),
+            "unit": "rows/s"}
+
+
+def bench_fwd_pack_unpack(n: int = 4_000_000, bits: int = 13) -> dict:
+    """FixedBitSingleValueReader/Writer path: pack + unpack round-trip."""
+    from pinot_tpu.segment.fwd import pack_bits, unpack_bits
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1 << bits, n).astype(np.int32)
+    rate = _rate(n, lambda: unpack_bits(pack_bits(ids, bits), bits, n))
+    return {"bench": "fwd_bitpack_roundtrip", "value": round(rate),
+            "unit": "rows/s"}
+
+
+def bench_inverted_lookup(n: int = 2_000_000, card: int = 500,
+                          lookups: int = 200) -> dict:
+    """BitmapInvertedIndexReader path: posting-list fetches."""
+    from pinot_tpu.segment.inverted import InvertedIndexWriter
+    import os
+    import tempfile
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, card, n).astype(np.int32)
+    with tempfile.TemporaryDirectory() as d:
+        InvertedIndexWriter.write(d, "c", ids, card)
+        from pinot_tpu.segment.inverted import InvertedIndexReader
+        inv = InvertedIndexReader.load(d, "c", n)
+        keys = rng.integers(0, card, lookups)
+        rate = _rate(lookups, lambda: [inv.postings(int(k))
+                                       for k in keys])
+    return {"bench": "inverted_posting_lookup", "value": round(rate),
+            "unit": "lookups/s"}
+
+
+def bench_segment_build(rows: int = 1_000_000) -> dict:
+    """SegmentIndexCreationDriverImpl path: full SSB segment build."""
+    import tempfile
+
+    from pinot_tpu.tools.datagen import build_ssb_segment_dirs
+    with tempfile.TemporaryDirectory() as d:
+        t0 = time.perf_counter()
+        build_ssb_segment_dirs(d, rows, 1, seed=1, star_tree=True)
+        dt = time.perf_counter() - t0
+    return {"bench": "segment_build_ssb", "value": round(rows / dt),
+            "unit": "rows/s"}
+
+
+def bench_realtime_consumption(rows: int = 50_000) -> dict:
+    """BenchmarkRealtimeConsumptionSpeed analogue: MutableSegmentImpl
+    index_row throughput."""
+    from pinot_tpu.common.schema import (Schema, dimension, metric)
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.realtime.mutable_segment import MutableSegmentImpl
+    schema = Schema("t", [dimension("d1", DataType.STRING),
+                          dimension("d2", DataType.INT),
+                          metric("m1", DataType.LONG)])
+    rng = np.random.default_rng(0)
+    rws = [{"d1": f"v{int(rng.integers(0, 100))}",
+            "d2": int(rng.integers(0, 1000)),
+            "m1": int(rng.integers(0, 10_000))} for _ in range(rows)]
+
+    def run():
+        seg = MutableSegmentImpl(schema, TableConfig("t"), "s")
+        for r in rws:
+            seg.index_row(r)
+    rate = _rate(rows, run)
+    return {"bench": "realtime_index_row", "value": round(rate),
+            "unit": "rows/s"}
+
+
+def bench_startree_prefix_descent(rows: int = 2_000_000) -> dict:
+    """StarTree query path: prefix-descent block narrowing vs cube size."""
+    import tempfile
+
+    from pinot_tpu.pql.optimizer import BrokerRequestOptimizer
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.tools.datagen import build_ssb_segment_dirs
+    with tempfile.TemporaryDirectory() as d:
+        dirs, _, _ = build_ssb_segment_dirs(d, rows, 1, seed=2,
+                                            star_tree=True)
+        seg = ImmutableSegmentLoader.load(dirs[0])
+        req = BrokerRequestOptimizer().optimize(compile_pql(
+            "SELECT SUM(lo_revenue) FROM lineorder WHERE c_nation = "
+            "'UNITED STATES' AND s_nation = 'UNITED STATES' GROUP BY "
+            "c_city, s_city, d_year TOP 10000 "
+            "OPTION(numGroupsLimit=4194304)"))
+        ex = ServerQueryExecutor()
+        ex.execute(req, [seg])
+        n_q = 20
+        rate = _rate(n_q, lambda: [ex.execute(req, [seg])
+                                   for _ in range(n_q)])
+    return {"bench": "startree_prefix_group_by", "value": round(rate, 1),
+            "unit": "queries/s"}
+
+
+BENCHES: Dict[str, Callable[..., dict]] = {
+    "dictionary_encode": bench_dictionary_encode,
+    "fwd_pack_unpack": bench_fwd_pack_unpack,
+    "inverted_lookup": bench_inverted_lookup,
+    "segment_build": bench_segment_build,
+    "realtime_consumption": bench_realtime_consumption,
+    "startree_prefix_descent": bench_startree_prefix_descent,
+}
+
+
+def _scaled_kwargs(fn: Callable[..., dict], scale: float) -> dict:
+    """Scale a bench's n/rows defaults (floor 1000) — ONE rule shared by
+    run_all and the CLI so recorded and CLI numbers stay comparable."""
+    import inspect
+    kw = {}
+    for pname, p in inspect.signature(fn).parameters.items():
+        if pname in ("n", "rows") and isinstance(p.default, int):
+            kw[pname] = max(1000, int(p.default * scale))
+    return kw
+
+
+def run_all(scale: float = 1.0) -> List[dict]:
+    """Run every microbenchmark; `scale` multiplies row counts (CI smoke
+    uses ~0.01)."""
+    return [fn(**_scaled_kwargs(fn, scale)) for fn in BENCHES.values()]
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description="component microbenchmarks")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--only", choices=sorted(BENCHES), default=None)
+    args = ap.parse_args(argv)
+    benches = {args.only: BENCHES[args.only]} if args.only else BENCHES
+    for fn in benches.values():
+        print(json.dumps(fn(**_scaled_kwargs(fn, args.scale))),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -77,3 +77,15 @@ def test_http_replay_and_error_counting(perf_cluster):
     bad = QueryRunner(fn, ["SELECT COUNT(*) FROM missing_table"])
     rb = bad.single_thread()
     assert rb.num_errors == 1
+
+
+def test_microbench_smoke():
+    """pinot-perf JMH-analogue harness runs end-to-end at smoke scale
+    and emits well-formed records."""
+    from pinot_tpu.tools.microbench import BENCHES, run_all
+
+    records = run_all(scale=0.005)
+    assert len(records) == len(BENCHES)
+    for r in records:
+        assert set(r) == {"bench", "value", "unit"}
+        assert r["value"] > 0

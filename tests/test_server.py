@@ -363,3 +363,90 @@ def test_server_admin_http_api():
     finally:
         api.stop()
         srv.stop()
+
+
+def test_retry_policies():
+    """Parity: common/utils/retry/ — fixed/exponential/random policies,
+    attempt() contract (N tries, policy-shaped sleeps, last failure
+    chained when exhausted)."""
+    import random as _random
+
+    from pinot_tpu.common.retry import (ExponentialBackoffRetryPolicy,
+                                        FixedDelayRetryPolicy,
+                                        RandomDelayRetryPolicy,
+                                        RetryExhaustedError)
+
+    calls = []
+    sleeps = []
+
+    def flaky_then_ok():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    p = FixedDelayRetryPolicy(attempts=5, delay_s=0.01)
+    assert p.attempt(flaky_then_ok, sleep=sleeps.append) == "ok"
+    assert len(calls) == 3 and sleeps == [0.01, 0.01]
+
+    def always_fails():
+        raise ValueError("nope")
+
+    with pytest.raises(RetryExhaustedError) as ei:
+        FixedDelayRetryPolicy(attempts=2, delay_s=0).attempt(
+            always_fails, sleep=lambda s: None)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+    # a non-retryable exception propagates immediately
+    n = []
+    with pytest.raises(KeyError):
+        FixedDelayRetryPolicy(attempts=3, delay_s=0).attempt(
+            lambda: (n.append(1), {}["x"])[1],
+            retry_on=(ConnectionError,), sleep=lambda s: None)
+    assert len(n) == 1
+
+    exp = ExponentialBackoffRetryPolicy(attempts=4, initial_delay_s=1.0,
+                                        scale=2.0,
+                                        rng=_random.Random(7))
+    d0, d1, d2 = exp.delay_for(0), exp.delay_for(1), exp.delay_for(2)
+    assert 0.5 <= d0 < 1.0 and 1.0 <= d1 < 2.0 and 2.0 <= d2 < 4.0
+
+    rnd = RandomDelayRetryPolicy(attempts=3, min_delay_s=0.2,
+                                 max_delay_s=0.4,
+                                 rng=_random.Random(3))
+    assert all(0.2 <= rnd.delay_for(i) <= 0.4 for i in range(5))
+
+
+def test_deep_store_fetch_retries_transient_failures(tmp_path):
+    """The participant's remote segment fetch survives transient
+    deep-store failures (SegmentFetcherAndLoader retry parity)."""
+    import os
+
+    from pinot_tpu.common import filesystem as fsmod
+    from pinot_tpu.server.participant import ServerParticipant
+
+    class FlakyFS(fsmod.PinotFS):
+        fails = 2                       # class-level: get_fs instantiates
+
+        def copy(self, src, dst):
+            if FlakyFS.fails > 0:
+                FlakyFS.fails -= 1
+                raise ConnectionError("deep store hiccup")
+            os.makedirs(dst, exist_ok=True)
+            with open(os.path.join(dst, "ok"), "w") as fh:
+                fh.write("1")
+
+    fsmod.register_fs("flaky", FlakyFS)
+    try:
+        part = ServerParticipant.__new__(ServerParticipant)
+        part.work_dir = str(tmp_path)
+
+        class _Srv:
+            instance_id = "s0"
+        part.server = _Srv()
+        local = part._fetch_segment_dir(
+            "t_OFFLINE", "seg0", "flaky://deep/t/seg0")
+        assert os.path.isfile(os.path.join(local, "ok"))
+        assert FlakyFS.fails == 0
+    finally:
+        fsmod._REGISTRY.pop("flaky", None)
