@@ -139,6 +139,8 @@ def _stats_from_metadata(md: Dict[str, str]) -> ExecutionStats:
         num_segments_matched=gi("numSegmentsMatched"),
         total_docs=gi("totalDocs"),
         num_groups_limit_reached=md.get("numGroupsLimitReached") == "true",
+        num_consuming_segments_processed=gi("numConsumingSegmentsProcessed"),
+        min_consuming_freshness_ms=gi("minConsumingFreshnessTimeMs"),
         time_used_ms=float(md.get("timeUsedMs", "0")))
 
 

@@ -51,6 +51,8 @@ class BrokerResponse:
     num_segments_matched: int = 0
     num_servers_queried: int = 0
     num_servers_responded: int = 0
+    num_consuming_segments_queried: int = 0
+    min_consuming_freshness_time_ms: int = 0
     num_groups_limit_reached: bool = False
     total_docs: int = 0
     time_used_ms: float = 0.0
@@ -72,6 +74,14 @@ class BrokerResponse:
             "totalDocs": self.total_docs,
             "timeUsedMs": round(self.time_used_ms, 3),
         }
+        if self.num_consuming_segments_queried:
+            # realtime queries only (parity: the reference emits the
+            # freshness pair only when consuming segments were queried;
+            # an unconditional 0 would read as epoch-stale data)
+            d["numConsumingSegmentsQueried"] = \
+                self.num_consuming_segments_queried
+            d["minConsumingFreshnessTimeMs"] = \
+                self.min_consuming_freshness_time_ms
         if self.aggregation_results is not None:
             d["aggregationResults"] = [a.to_json()
                                        for a in self.aggregation_results]

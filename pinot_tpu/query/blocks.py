@@ -21,6 +21,12 @@ class ExecutionStats:
     total_docs: int = 0
     num_groups_limit_reached: bool = False
     time_used_ms: float = 0.0
+    # realtime freshness (parity: ServerQueryExecutorV1Impl's
+    # minConsumingFreshnessTimeMs + numConsumingSegmentsProcessed);
+    # BrokerResponse.to_json emits the pair only when consuming
+    # segments were queried
+    num_consuming_segments_processed: int = 0
+    min_consuming_freshness_ms: int = 0
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -32,6 +38,14 @@ class ExecutionStats:
         self.num_segments_pruned += other.num_segments_pruned
         self.total_docs += other.total_docs
         self.num_groups_limit_reached |= other.num_groups_limit_reached
+        self.num_consuming_segments_processed += \
+            other.num_consuming_segments_processed
+        if other.min_consuming_freshness_ms:
+            self.min_consuming_freshness_ms = \
+                min(self.min_consuming_freshness_ms,
+                    other.min_consuming_freshness_ms) \
+                if self.min_consuming_freshness_ms else \
+                other.min_consuming_freshness_ms
 
     def to_metadata(self) -> Dict[str, str]:
         return {
@@ -43,6 +57,10 @@ class ExecutionStats:
             "numSegmentsMatched": str(self.num_segments_matched),
             "totalDocs": str(self.total_docs),
             "numGroupsLimitReached": str(self.num_groups_limit_reached).lower(),
+            "numConsumingSegmentsProcessed":
+                str(self.num_consuming_segments_processed),
+            "minConsumingFreshnessTimeMs":
+                str(self.min_consuming_freshness_ms),
         }
 
 

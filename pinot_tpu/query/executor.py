@@ -102,6 +102,14 @@ class ServerQueryExecutor:
             # processed always, matched only when both halves matched
             blk.stats.num_segments_processed -= extra_parts
             blk.stats.num_segments_matched -= extra_matched
+        # realtime freshness over the consuming segments this query saw
+        # (parity: ServerQueryExecutorV1Impl minConsumingFreshness)
+        consuming_ts = [int(s_.last_indexed_time_ms) for s_ in selected
+                        if getattr(s_, "is_mutable", False) and
+                        hasattr(s_, "last_indexed_time_ms")]
+        blk.stats.num_consuming_segments_processed = len(consuming_ts)
+        if consuming_ts:
+            blk.stats.min_consuming_freshness_ms = min(consuming_ts)
         blk.stats.num_segments_pruned = num_pruned
         blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
         return blk

@@ -35,6 +35,10 @@ class BrokerReduceService:
         resp.num_segments_matched = stats.num_segments_matched
         resp.num_groups_limit_reached = stats.num_groups_limit_reached
         resp.total_docs = stats.total_docs
+        resp.num_consuming_segments_queried = \
+            stats.num_consuming_segments_processed
+        resp.min_consuming_freshness_time_ms = \
+            stats.min_consuming_freshness_ms
         resp.num_servers_queried = num_servers_queried
         resp.num_servers_responded = num_servers_responded
         resp.exceptions = [{"message": e} for e in merged.exceptions]

@@ -409,6 +409,9 @@ class MutableSegmentImpl:
         self._frozen = None                  # sorted device snapshot
         self._freeze_lock = threading.Lock()
         self.creation_time_ms = int(time.time() * 1e3)
+        # freshness: when the most recent row was indexed (parity: the
+        # lastIndexedTimestamp feeding minConsumingFreshnessTimeMs)
+        self.last_indexed_time_ms = self.creation_time_ms
 
     # -- write -------------------------------------------------------------
     def index_row(self, row: dict) -> bool:
@@ -426,6 +429,7 @@ class MutableSegmentImpl:
                 except (TypeError, ValueError):
                     pass
             self._num_docs += 1
+            self.last_indexed_time_ms = int(time.time() * 1e3)
         return True
 
     def collect_stats(self) -> dict:

@@ -728,3 +728,28 @@ def test_rebalance_preserves_consuming_segments(work_dir):
         assert wait_until(lambda: count_star(cluster) == 400)
     finally:
         cluster.stop()
+
+
+def test_consuming_freshness_reported(work_dir):
+    """Parity: ServerQueryExecutorV1Impl's minConsumingFreshnessTimeMs /
+    numConsumingSegmentsQueried — realtime queries report how fresh the
+    consuming data is; offline-only queries report none."""
+    stream = MemoryStream("topic_fr", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_fr", MemoryStreamConsumerFactory(stream, batch_size=64))
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(rt_config("mem_fr", "topic_fr",
+                                    flush_rows=100_000))
+        t0 = int(time.time() * 1e3)
+        for r in make_rows(200, seed=6):
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: count_star(cluster) == 200)
+        resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+        j = resp.to_json()
+        assert j["numConsumingSegmentsQueried"] == 1, j
+        assert t0 <= j["minConsumingFreshnessTimeMs"] <= \
+            int(time.time() * 1e3) + 1000, j
+    finally:
+        cluster.stop()
