@@ -66,6 +66,10 @@ class ControllerApiServer(ApiServer):
         router.add("POST", "/segments/{table}/{segment}/reload",
                    self._reload_segment)
         router.add("POST", "/tables/{name}/reload", self._reload_table)
+        # minion task plane (parity: PinotTaskRestletResource —
+        # list task states per type, schedule generators)
+        router.add("GET", "/tasks/{taskType}/state", self._task_states)
+        router.add("POST", "/tasks/schedule", self._schedule_tasks)
         # LLC segment-completion protocol (parity:
         # controller/api/resources/LLCSegmentCompletionHandlers.java —
         # segmentConsumed / segmentStoppedConsuming / segmentCommitStart /
@@ -178,6 +182,34 @@ class ControllerApiServer(ApiServer):
         except TenantError as e:
             return HttpResponse.error(404, str(e))
         return HttpResponse.of_json({"tags": tags})
+
+    # -- minion tasks ------------------------------------------------------
+    async def _task_states(self, request: HttpRequest) -> HttpResponse:
+        from pinot_tpu.minion.tasks import TaskQueue
+        states = TaskQueue(self.manager.store).task_states(
+            request.path_params["taskType"])
+        return HttpResponse.of_json(states)
+
+    async def _schedule_tasks(self, request: HttpRequest) -> HttpResponse:
+        """Run the registered task generators over all tables (parity:
+        POST /tasks/schedule running PinotTaskManager.scheduleTasks).
+        Serialized through one shared manager + lock: the generators'
+        dedup check (tasks_for_segment) and submit are not atomic, so
+        concurrent schedules would double-submit per segment."""
+        import asyncio as _asyncio
+        if not hasattr(self, "_task_manager"):
+            import threading as _threading
+            from pinot_tpu.minion.task_manager import PinotTaskManager
+            self._task_manager = PinotTaskManager(self.manager)
+            self._task_schedule_lock = _threading.Lock()
+
+        def run():
+            with self._task_schedule_lock:
+                return self._task_manager.schedule_tasks()
+
+        submitted = await _asyncio.get_running_loop().run_in_executor(
+            None, run)
+        return HttpResponse.of_json({"submitted": submitted})
 
     async def _list_tables(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json({"tables": self.manager.table_names()})

@@ -15,7 +15,8 @@ from pinot_tpu.minion.task_manager import (ConvertToRawIndexTaskGenerator,
                                            PurgeTaskGenerator)
 from pinot_tpu.minion.tasks import (COMPLETED, ERROR, GENERATED,
                                     IN_PROGRESS, PinotTaskConfig, TaskQueue)
-from pinot_tpu.minion.worker import MinionWorker
+from pinot_tpu.minion.worker import (MinionEventObserver,
+                                     MinionWorker)
 
 __all__ = [
     "CONVERT_TO_RAW_TASK", "MERGE_ROLLUP_TASK", "PURGE_TASK",
@@ -23,5 +24,6 @@ __all__ = [
     "ConvertToRawIndexTaskGenerator", "PinotTaskGenerator",
     "PinotTaskManager", "PurgeTaskGenerator", "COMPLETED", "ERROR",
     "GENERATED", "IN_PROGRESS", "PinotTaskConfig", "TaskQueue",
+    "MinionEventObserver",
     "MinionWorker",
 ]

@@ -22,15 +22,35 @@ from pinot_tpu.minion.tasks import (COMPLETED, ERROR, SEGMENT_NAME_KEY,
                                     TaskQueue)
 
 
+class MinionEventObserver:
+    """Task lifecycle callbacks (parity: pinot-minion's
+    MinionEventObserver SPI + MinionEventObserverFactory — observers are
+    notified at task start / success / error, e.g. for metrics or
+    progress reporting). Default methods are no-ops so observers
+    override only what they need."""
+
+    def notify_task_start(self, task: PinotTaskConfig) -> None:
+        pass
+
+    def notify_task_success(self, task: PinotTaskConfig) -> None:
+        pass
+
+    def notify_task_error(self, task: PinotTaskConfig,
+                          error: BaseException) -> None:
+        pass
+
+
 class MinionWorker:
     def __init__(self, manager, instance_id: str = "Minion_0",
                  work_dir: Optional[str] = None,
                  registry: Optional[TaskExecutorRegistry] = None,
-                 context: Optional[MinionContext] = None):
+                 context: Optional[MinionContext] = None,
+                 observers: Optional[List[MinionEventObserver]] = None):
         self.manager = manager                      # ControllerManager
         self.instance_id = instance_id
         self.queue = TaskQueue(manager.store)
         self.registry = registry or TaskExecutorRegistry()
+        self.observers: List[MinionEventObserver] = list(observers or ())
         self.context = context or MinionContext()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="minion_")
         self._stop = threading.Event()
@@ -44,14 +64,24 @@ class MinionWorker:
                                 self.registry.task_types())
         if task is None:
             return None
+        self._notify(lambda o: o.notify_task_start(task))
         try:
             self._execute(task)
             self.queue.finish(task, COMPLETED)
+            self._notify(lambda o: o.notify_task_success(task))
         except Exception as e:  # noqa: BLE001 — task isolation boundary
             self.queue.finish(task, ERROR,
                               f"{type(e).__name__}: {e}\n"
                               f"{traceback.format_exc(limit=5)}")
+            self._notify(lambda o: o.notify_task_error(task, e))
         return task.task_id
+
+    def _notify(self, fn) -> None:
+        for obs in self.observers:
+            try:
+                fn(obs)
+            except Exception:  # noqa: BLE001 — observers never break tasks
+                pass
 
     def _execute(self, task: PinotTaskConfig) -> None:
         table = task.configs[TABLE_NAME_KEY]

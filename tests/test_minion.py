@@ -171,3 +171,105 @@ def test_minion_error_isolation():
         assert rec["state"] == ERROR and "not found" in rec["info"]
     finally:
         cluster.stop()
+
+
+def test_event_observers_notified():
+    """Parity: MinionEventObserver SPI — observers see task start and
+    success/error; a throwing observer never breaks the task."""
+    import tempfile
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.minion import (MinionEventObserver, MinionWorker,
+                                  PinotTaskConfig)
+    from pinot_tpu.minion.tasks import (SEGMENT_NAME_KEY,
+                                        TABLE_NAME_KEY, TaskQueue)
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    events = []
+
+    class Recorder(MinionEventObserver):
+        def notify_task_start(self, task):
+            events.append(("start", task.task_type))
+
+        def notify_task_success(self, task):
+            events.append(("success", task.task_type))
+
+        def notify_task_error(self, task, error):
+            events.append(("error", task.task_type, type(error).__name__))
+
+    class Thrower(MinionEventObserver):
+        def notify_task_start(self, task):
+            raise RuntimeError("observer bug")
+
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        d = os.path.join(base, "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "obs_seg").build(make_columns(1000, seed=2), d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+
+        mgr = cluster.controller.manager
+        worker = MinionWorker(mgr, observers=[Thrower(), Recorder()],
+                              work_dir=os.path.join(base, "mw"))
+        q = TaskQueue(mgr.store)
+        q.submit(PinotTaskConfig("PurgeTask", {
+            TABLE_NAME_KEY: "baseballStats_OFFLINE",
+            SEGMENT_NAME_KEY: "obs_seg",
+            "filterExpression": "runs > 1000000"}))
+        tid = worker.run_one()
+        assert tid is not None
+        assert ("start", "PurgeTask") in events
+        assert ("success", "PurgeTask") in events
+
+        # a failing task notifies error
+        q.submit(PinotTaskConfig("PurgeTask", {
+            TABLE_NAME_KEY: "no_such_table_OFFLINE",
+            SEGMENT_NAME_KEY: "nope"}))
+        worker.run_one()
+        assert any(e[0] == "error" for e in events), events
+    finally:
+        cluster.stop()
+
+
+def test_task_rest_endpoints():
+    """Parity: PinotTaskRestletResource — schedule + per-type states
+    over the controller REST API."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=1,
+                              http=True)
+    try:
+        cfg = make_table_config()
+        cfg.task_configs = {"PurgeTask": {"filterExpression":
+                                          "runs > 1000000"}}
+        cluster.add_schema(make_schema())
+        cluster.add_table(cfg)
+        d = os.path.join(base, "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "rest_seg").build(make_columns(500, seed=3), d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+
+        ctrl = f"http://127.0.0.1:{cluster.controller_port}"
+        req = urllib.request.Request(f"{ctrl}/tasks/schedule",
+                                     method="POST")
+        with urllib.request.urlopen(req) as r:
+            out = _json.loads(r.read())
+        assert out["submitted"], out
+        with urllib.request.urlopen(
+                f"{ctrl}/tasks/PurgeTask/state") as r:
+            states = _json.loads(r.read())
+        assert states and set(states.values()) <= {
+            "GENERATED", "IN_PROGRESS", "COMPLETED", "ERROR"}, states
+    finally:
+        cluster.stop()
