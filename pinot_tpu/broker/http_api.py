@@ -25,6 +25,12 @@ class BrokerApiServer(ApiServer):
         self.router.add("POST", "/query", self._post_query)
         self.router.add("GET", "/health", self._health)
         self.router.add("GET", "/metrics", self._metrics)
+        # operator debug views (parity: the broker debug resources —
+        # RoutingTables + TimeBoundary endpoints)
+        self.router.add("GET", "/debug/routingTable/{table}",
+                        self._debug_routing)
+        self.router.add("GET", "/debug/timeBoundary/{table}",
+                        self._debug_time_boundary)
 
     @staticmethod
     def _identity(request: HttpRequest) -> RequesterIdentity:
@@ -65,3 +71,43 @@ class BrokerApiServer(ApiServer):
 
     async def _metrics(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json(self.handler.metrics.snapshot())
+
+    async def _debug_routing(self, request: HttpRequest) -> HttpResponse:
+        """One sampled routing table per physical variant of the table
+        (parity: the broker's debug RoutingTables view)."""
+        from pinot_tpu.broker.routing import RoutingError
+        from pinot_tpu.common.table_name import (offline_table,
+                                                 realtime_table,
+                                                 table_type)
+        raw = request.path_params["table"]
+        names = [raw] if table_type(raw) != "NONE" else \
+            [offline_table(raw), realtime_table(raw)]
+        out = {}
+        for name in names:
+            try:
+                out[name] = self.handler.routing.route(name)
+            except RoutingError:
+                continue
+        if not out:
+            return HttpResponse.error(404, f"no routing for {raw}")
+        return HttpResponse.of_json(out)
+
+    async def _debug_time_boundary(self, request: HttpRequest
+                                   ) -> HttpResponse:
+        """The boundary the TimeBoundaryService holds for the table's
+        offline variant (parity: the TimeBoundary debug view).
+        "appliedToQueries" says whether the broker actually attaches it
+        — only hybrid tables (both variants routable) get the split."""
+        from pinot_tpu.common.table_name import (offline_table,
+                                                 raw_table,
+                                                 realtime_table)
+        raw = raw_table(request.path_params["table"])
+        tb = self.handler.time_boundary
+        info = tb.get(offline_table(raw)) if tb is not None else None
+        if info is None:
+            return HttpResponse.error(404, f"no time boundary for {raw}")
+        hybrid = self.handler.routing.has_table(offline_table(raw)) and \
+            self.handler.routing.has_table(realtime_table(raw))
+        return HttpResponse.of_json({
+            "timeColumn": info.column, "timeValue": str(info.value),
+            "appliedToQueries": hybrid})

@@ -268,3 +268,52 @@ def test_participant_fetches_http_download_path(http_cluster):
     assert p._fetch_segment_dir("baseballStats_OFFLINE", "ht_2",
                                 meta["downloadPath"]) == \
         meta["downloadPath"]
+
+
+def test_broker_debug_endpoints(tmp_path):
+    """Parity: the broker's debug resources — sampled routing table and
+    hybrid time boundary over HTTP."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    c = EmbeddedCluster(str(tmp_path), num_servers=2, http=True)
+    try:
+        c.add_schema(make_schema())
+        c.add_table(make_table_config())
+        d = str(tmp_path / "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "dbg_seg").build(make_columns(500, seed=5), d)
+        c.upload_segment("baseballStats_OFFLINE", d)
+        base = f"http://127.0.0.1:{c.broker_port}"
+        with urllib.request.urlopen(
+                f"{base}/debug/routingTable/baseballStats") as r:
+            rt = _json.loads(r.read())
+        assert "baseballStats_OFFLINE" in rt
+        assert any("dbg_seg" in segs
+                   for segs in rt["baseballStats_OFFLINE"].values()), rt
+        # the offline table has a time column → boundary is published
+        with urllib.request.urlopen(
+                f"{base}/debug/timeBoundary/baseballStats") as r:
+            tbv = _json.loads(r.read())
+        assert tbv["timeColumn"] == "yearID" and int(tbv["timeValue"])
+        # offline-only table: the boundary exists but is NOT attached
+        assert tbv["appliedToQueries"] is False
+        # a table with no boundary: 404
+        try:
+            urllib.request.urlopen(f"{base}/debug/timeBoundary/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # unknown table: routing view is 404
+        try:
+            urllib.request.urlopen(f"{base}/debug/routingTable/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        c.stop()
