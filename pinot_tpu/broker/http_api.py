@@ -12,6 +12,9 @@ import asyncio
 
 from pinot_tpu.broker.access_control import RequesterIdentity
 from pinot_tpu.broker.request_handler import BrokerRequestHandler
+from pinot_tpu.broker.routing import RoutingError
+from pinot_tpu.common.table_name import (offline_table, raw_table,
+                                         realtime_table, table_type)
 from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
 
 
@@ -72,14 +75,27 @@ class BrokerApiServer(ApiServer):
     async def _metrics(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json(self.handler.metrics.snapshot())
 
+    def _check_debug_access(self, request: HttpRequest, table: str):
+        """Debug views honor the same access-control SPI as /query —
+        routing assignments are table metadata the ACL governs. The SPI
+        takes a BrokerRequest; a minimal one carrying the table name is
+        what table-scoped ACLs key on."""
+        ac = getattr(self.handler, "access_control", None)
+        if ac is None:
+            return None
+        from pinot_tpu.common.request import BrokerRequest
+        probe = BrokerRequest(table_name=raw_table(table))
+        if not ac.has_access(self._identity(request), probe):
+            return HttpResponse.error(403, "access denied")
+        return None
+
     async def _debug_routing(self, request: HttpRequest) -> HttpResponse:
         """One sampled routing table per physical variant of the table
         (parity: the broker's debug RoutingTables view)."""
-        from pinot_tpu.broker.routing import RoutingError
-        from pinot_tpu.common.table_name import (offline_table,
-                                                 realtime_table,
-                                                 table_type)
         raw = request.path_params["table"]
+        denied = self._check_debug_access(request, raw)
+        if denied is not None:
+            return denied
         names = [raw] if table_type(raw) != "NONE" else \
             [offline_table(raw), realtime_table(raw)]
         out = {}
@@ -98,10 +114,10 @@ class BrokerApiServer(ApiServer):
         offline variant (parity: the TimeBoundary debug view).
         "appliedToQueries" says whether the broker actually attaches it
         — only hybrid tables (both variants routable) get the split."""
-        from pinot_tpu.common.table_name import (offline_table,
-                                                 raw_table,
-                                                 realtime_table)
         raw = raw_table(request.path_params["table"])
+        denied = self._check_debug_access(request, raw)
+        if denied is not None:
+            return denied
         tb = self.handler.time_boundary
         info = tb.get(offline_table(raw)) if tb is not None else None
         if info is None:

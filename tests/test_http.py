@@ -317,3 +317,39 @@ def test_broker_debug_endpoints(tmp_path):
             assert e.code == 404
     finally:
         c.stop()
+
+
+def test_broker_debug_endpoints_honor_acl(tmp_path):
+    """Debug views consult the same AccessControl SPI as /query."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.broker.access_control import TableAclAccessControl
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    c = EmbeddedCluster(str(tmp_path), num_servers=1, http=True)
+    try:
+        c.add_schema(make_schema())
+        c.add_table(make_table_config())
+        d = str(tmp_path / "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "acl_seg").build(make_columns(200, seed=7), d)
+        c.upload_segment("baseballStats_OFFLINE", d)
+        c.broker.access_control = TableAclAccessControl(
+            {"baseballStats": ["s3cret"]})
+        base = f"http://127.0.0.1:{c.broker_port}"
+        url = f"{base}/debug/routingTable/baseballStats"
+        try:
+            urllib.request.urlopen(url)
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        req = urllib.request.Request(
+            url, headers={"Authorization": "Bearer s3cret"})
+        with urllib.request.urlopen(req) as r:
+            assert "baseballStats_OFFLINE" in _json.loads(r.read())
+    finally:
+        c.stop()

@@ -354,3 +354,81 @@ def test_random_mv_group_by_queries(setup):
             for k, v in exp.items():
                 assert got_sum[k] == pytest.approx(v[1], rel=1e-9), \
                     (pql, label, k)
+
+
+def test_random_star_tree_agreement():
+    """Randomized sweep over star-tree-enabled segments: every generated
+    aggregation/group-by answer must be IDENTICAL with and without cubes
+    (StarTreeClusterIntegrationTest's property, randomized) — this
+    stresses the sorted-prefix descent with arbitrary conjunctions,
+    IN-fanouts, ranges, and OR fallbacks."""
+    import os
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    base = tempfile.mkdtemp()
+    st_cfg = make_table_config()
+    st_cfg.indexing_config.star_tree_configs = [
+        {"dimensionsSplitOrder": ["teamID", "league", "yearID"],
+         "functionColumnPairs": ["SUM__runs", "SUM__hits",
+                                 "MAX__average"]},
+        {"dimensionsSplitOrder": ["league", "yearID"],
+         "functionColumnPairs": ["SUM__runs"]},
+    ]
+    st_segs, pl_segs, all_cols = [], [], {}
+    for i in range(2):
+        cols = make_columns(2_000, seed=90 + i)
+        d_st = os.path.join(base, f"st{i}")
+        d_pl = os.path.join(base, f"pl{i}")
+        SegmentCreator(make_schema(), st_cfg, f"st{i}").build(dict(cols),
+                                                              d_st)
+        SegmentCreator(make_schema(), make_table_config(),
+                       f"pl{i}").build(dict(cols), d_pl)
+        st_segs.append(ImmutableSegmentLoader.load(d_st))
+        pl_segs.append(ImmutableSegmentLoader.load(d_pl))
+        for k, v in cols.items():
+            if isinstance(v, list):
+                all_cols.setdefault(k, []).extend(v)
+            else:
+                all_cols[k] = np.concatenate([all_cols[k], v]) \
+                    if k in all_cols else v
+    oracle = Oracle(all_cols)
+    eng_st = QueryEngine(st_segs, use_device=False)
+    eng_pl = QueryEngine(pl_segs, use_device=False)
+
+    gen = Gen(random.Random(SEED + 7), oracle)
+    covered_aggs = [a for a in Gen.AGGS
+                    if a[1] in ("count", "sum", "min", "max", "avg",
+                                "minmaxrange") and
+                    a[2] in (None, "runs", "hits", "average")]
+    def canon(resp):
+        out = []
+        for ar in resp.aggregation_results:
+            if ar.group_by_result is not None:
+                out.append(sorted(
+                    (tuple(str(x) for x in g["group"]),
+                     round(float(g["value"]), 6))
+                    for g in ar.group_by_result))
+            else:
+                v = ar.value
+                out.append(round(float(v), 6)
+                           if v not in (None, "null") else v)
+        return out
+
+    for qi in range(24):
+        where, _m = gen.where()
+        aggs = gen.rng.sample(covered_aggs, gen.rng.randint(1, 2))
+        if gen.rng.random() < 0.5:
+            dims = gen.rng.sample(["teamID", "league", "yearID"],
+                                  gen.rng.randint(1, 2))
+            pql = ("SELECT " + ", ".join(a[0] for a in aggs) +
+                   " FROM baseballStats" + where +
+                   " GROUP BY " + ", ".join(dims) + " TOP 5000")
+        else:
+            pql = ("SELECT " + ", ".join(a[0] for a in aggs) +
+                   " FROM baseballStats" + where)
+        r_st, r_pl = eng_st.query(pql), eng_pl.query(pql)
+        assert not r_st.exceptions and not r_pl.exceptions, pql
+        assert canon(r_st) == canon(r_pl), pql
