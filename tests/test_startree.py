@@ -292,3 +292,38 @@ def test_prefix_descent_narrows_and_matches():
     sel, examined = _cube_select(seg, cube, req.filter)
     assert examined < cube.n_groups / 4
     assert len(sel) <= examined
+
+
+def test_star_tree_in_v3_container():
+    """Cubes built at seal time must ride the v3 single-file container
+    (creator runs the v3 conversion LAST so startree members land in
+    columns.psf) and keep the prefix-descent path working after load."""
+    base = tempfile.mkdtemp()
+    cfg = make_table_config()
+    cfg.indexing_config.star_tree_configs = [ST_CONFIG]
+    cfg.indexing_config.segment_version = "v3"
+    d = os.path.join(base, "v3st")
+    cols = make_columns(8_000, seed=55)
+    SegmentCreator(make_schema(), cfg, "v3st").build(dict(cols), d)
+    # single-file layout: no loose startree files outside the container
+    names = sorted(os.listdir(d))
+    assert any(n.startswith("columns.psf") for n in names) or \
+        "columns.psf" in names, names
+    assert not [n for n in names if n.startswith("startree.") and
+                n.endswith(".npz")], names
+    seg = ImmutableSegmentLoader.load(d)
+    assert len(seg.star_trees) == 1
+    eng = QueryEngine([seg], use_device=False)
+    q = ("SELECT SUM(runs) FROM baseballStats WHERE teamID = 'BOS' "
+         "GROUP BY yearID TOP 100")
+    resp = eng.query(q)
+    exp = {}
+    mask = cols["teamID"] == "BOS"
+    for y, r in zip(np.asarray(cols["yearID"])[mask],
+                    np.asarray(cols["runs"])[mask]):
+        exp[str(int(y))] = exp.get(str(int(y)), 0.0) + float(r)
+    got = {str(g["group"][0]): float(g["value"])
+           for g in resp.aggregation_results[0].group_by_result}
+    assert got == exp
+    # the cube path engaged (scanned far fewer rows than the segment)
+    assert resp.num_entries_scanned_in_filter < seg.num_docs / 4
