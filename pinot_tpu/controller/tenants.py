@@ -13,7 +13,9 @@ assigned to (controller/manager.py consults :func:`server_tenant_tag`);
 ``tenants.broker`` selects which brokers serve it (the
 ``/BROKERRESOURCE/<table>`` record, watched by the client's dynamic
 broker selector). A bare legacy tag (e.g. ``"DefaultTenant"``) counts as
-every role of that tenant, so pre-tenant clusters keep working.
+the SERVER roles of that tenant (pre-tenant server participants register
+it; brokers always self-register with explicit ``_BROKER`` tags), so
+pre-tenant clusters keep working.
 """
 from __future__ import annotations
 
@@ -194,4 +196,13 @@ class TenantManager:
             if not broker_role and name in tags:
                 rm.append(name)       # bare legacy tag = server roles
             if rm:
-                self.update_instance_tags(inst, remove=rm)
+                # an instance left with no tags would be orphaned out of
+                # every pool — return it to the default pool OF ITS ROLE
+                # (parity: the reference retags untagged instances to the
+                # default; the bare tag means server roles only, so an
+                # ex-broker gets the explicit default broker tag)
+                add = []
+                if not (set(tags) - set(rm)):
+                    add = [broker_tenant_tag(DEFAULT_TENANT)] \
+                        if broker_role else [DEFAULT_TENANT]
+                self.update_instance_tags(inst, add=add, remove=rm)

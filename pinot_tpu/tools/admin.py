@@ -191,13 +191,14 @@ def cmd_query_runner(args) -> int:
     return 0
 
 
-def _print_http(method: str, url: str, body=None) -> int:
+def _print_http(method: str, url: str, body=None,
+                content_type: str = "application/json") -> int:
     """Run a controller call, printing error BODIES (the 400/409
     responses carry the reason, e.g. 'tenant X is in use by t') instead
     of dying with a traceback."""
     import urllib.error
     try:
-        out = _http(method, url, body)
+        out = _http(method, url, body, content_type=content_type)
     except urllib.error.HTTPError as e:
         print(json.dumps({"status": e.code,
                           "error": e.read().decode("utf-8", "replace")},
@@ -240,6 +241,46 @@ def cmd_delete_segment(args) -> int:
                 f"{args.segment}")
     print(json.dumps(out))
     return 0
+
+
+def cmd_delete_table(args) -> int:
+    """Parity: DeleteTableCommand → DELETE /tables/{name}."""
+    return _print_http("DELETE",
+                       f"http://{args.controller}/tables/{args.table}")
+
+
+def cmd_backfill_segment(args) -> int:
+    """Parity: the backfill tooling — download a served segment's
+    artifact from the deep store, optionally point at a replacement
+    directory, and re-push it (a refresh bounce reloads it on servers).
+    With no --segment-dir this re-pushes the deep-store copy as-is
+    (useful to heal a corrupted local replica)."""
+    import tempfile as _tempfile
+    import urllib.parse as _p
+    import urllib.request as _req
+
+    from pinot_tpu.common.segment_tar import (pack_segment_dir,
+                                              unpack_segment_tar)
+    import urllib.error as _err
+    seg_dir = args.segment_dir
+    if seg_dir is None:
+        url = (f"http://{args.controller}/deepstore/download?"
+               + _p.urlencode({"path": f"{args.table}/{args.segment}"}))
+        try:
+            with _req.urlopen(url, timeout=60) as r:
+                blob = r.read()
+        except _err.HTTPError as e:
+            print(json.dumps({"status": e.code,
+                              "error": e.read().decode("utf-8",
+                                                       "replace")},
+                             indent=2))
+            return 1
+        seg_dir = _tempfile.mkdtemp(prefix="backfill_")
+        unpack_segment_tar(blob, seg_dir)
+    return _print_http(
+        "POST", f"http://{args.controller}/segments/{args.table}",
+        pack_segment_dir(seg_dir),
+        content_type="application/octet-stream")
 
 
 def cmd_show_cluster(args) -> int:
@@ -698,6 +739,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--downtime", action="store_true",
                     help="one-shot write instead of no-downtime stepping")
     sp.set_defaults(fn=cmd_rebalance_table)
+
+    sp = sub.add_parser("DeleteTable", help="drop a table")
+    ctrl(sp)
+    sp.add_argument("--table", required=True)
+    sp.set_defaults(fn=cmd_delete_table)
+
+    sp = sub.add_parser("BackfillSegment",
+                        help="re-push a segment (from deep store or a "
+                             "local replacement dir)")
+    ctrl(sp)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.add_argument("--segment-dir", default=None)
+    sp.set_defaults(fn=cmd_backfill_segment)
 
     sp = sub.add_parser("DeleteSegment", help="delete one segment")
     ctrl(sp)

@@ -202,3 +202,47 @@ def test_tenant_cli_commands(http_cluster, capsys):
     assert rc == 0
     rc, out = _run(["ListTenants", "--controller", ctrl], capsys)
     assert rc == 0 and "CliT" not in out
+
+
+def test_delete_table_and_backfill_commands(http_cluster, capsys):
+    """Parity: DeleteTableCommand + backfill tooling (deep-store
+    download → re-push refresh)."""
+    cluster, base = http_cluster
+    ctrl = f"127.0.0.1:{cluster.controller_port}"
+
+    schema_file = os.path.join(base, "schema2.json")
+    with open(schema_file, "w") as f:
+        json.dump(make_schema().to_json(), f)
+    cfg = make_table_config()
+    cfg.table_name = "bfill"
+    table_file = os.path.join(base, "table2.json")
+    with open(table_file, "w") as f:
+        json.dump(cfg.to_json(), f)
+    _run(["AddSchema", "--controller", ctrl,
+          "--schema-file", schema_file], capsys)
+    rc, _ = _run(["AddTable", "--controller", ctrl,
+                  "--table-config-file", table_file], capsys)
+    assert rc == 0
+
+    from fixtures import make_columns
+    from pinot_tpu.segment.creator import SegmentCreator
+    d = os.path.join(base, "bf_seg")
+    SegmentCreator(make_schema(), make_table_config(),
+                   "bf_seg").build(make_columns(400, seed=9), d)
+    rc, _ = _run(["UploadSegment", "--controller", ctrl,
+                  "--table", "bfill_OFFLINE", "--segment-dir", d], capsys)
+    assert rc == 0
+
+    # backfill with no --segment-dir: pulls from deep store, re-pushes
+    rc, out = _run(["BackfillSegment", "--controller", ctrl,
+                    "--table", "bfill_OFFLINE", "--segment", "bf_seg"],
+                   capsys)
+    assert rc == 0 and "bf_seg" in out
+
+    rc, out = _run(["DeleteTable", "--controller", ctrl,
+                    "--table", "bfill_OFFLINE"], capsys)
+    assert rc == 0
+    import urllib.request as _req
+    with _req.urlopen(f"http://{ctrl}/tables") as r:
+        tables = json.loads(r.read())["tables"]
+    assert "bfill_OFFLINE" not in tables, tables

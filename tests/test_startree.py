@@ -307,8 +307,7 @@ def test_star_tree_in_v3_container():
     SegmentCreator(make_schema(), cfg, "v3st").build(dict(cols), d)
     # single-file layout: no loose startree files outside the container
     names = sorted(os.listdir(d))
-    assert any(n.startswith("columns.psf") for n in names) or \
-        "columns.psf" in names, names
+    assert any(n.startswith("columns.psf") for n in names), names
     assert not [n for n in names if n.startswith("startree.") and
                 n.endswith(".npz")], names
     seg = ImmutableSegmentLoader.load(d)
