@@ -265,6 +265,17 @@ SSB_STAR_TREE_CONFIGS = [
     {"dimensionsSplitOrder": ["c_region", "s_region", "p_mfgr", "d_year",
                               "s_nation", "p_category"],
      "metrics": ["lo_revenue", "lo_supplycost"]},     # Q4.2
+    # Q3.4/Q4.3: cubes whose row counts approach the segment's — useless
+    # for scans, but the exact-prefix descents (c_city IN / region+
+    # nation+category EQ) touch only tens of rows; maxSize raised past
+    # the default cap because the scan-payoff heuristic doesn't apply
+    {"dimensionsSplitOrder": ["c_city", "s_city", "d_yearmonth",
+                              "d_year"],
+     "metrics": ["lo_revenue"], "maxSize": 8_000_000},        # Q3.4
+    {"dimensionsSplitOrder": ["c_region", "s_nation", "p_category",
+                              "d_year", "s_city", "p_brand1"],
+     "metrics": ["lo_revenue", "lo_supplycost"],
+     "maxSize": 12_000_000},                                  # Q4.3
 ]
 
 
