@@ -202,9 +202,15 @@ class RealtimeSegmentDataManager:
             log.exception("segment build failed for %s", self.llc.name)
             self._enter_error(f"segment build failed: {e}")
             return
-        # record stats NOW: commit_end's CONSUMING→ONLINE swap destroys
-        # the mutable (releasing its buffers) before it returns
-        stats = self.mutable.collect_stats()
+        # record stats NOW, before commit_end: the controller creates
+        # the SUCCESSOR consuming segment synchronously inside the
+        # commit_end call chain, and its allocation hint must see this
+        # segment's stats (also: the CONSUMING→ONLINE swap destroys the
+        # mutable before commit_end returns). Advisory data — recording
+        # before a failed commit is harmless.
+        if self.stats_history is not None:
+            self.stats_history.add_segment_stats(
+                self.table, self.mutable.collect_stats())
         resp = self.completion.commit_end(self.table, self.llc.name,
                                           self.instance_id, self.offset,
                                           out_dir)
@@ -214,8 +220,6 @@ class RealtimeSegmentDataManager:
             self._enter_error(f"commit_end failed: {resp.status}")
             return
         self.state = COMMITTED
-        if self.stats_history is not None:
-            self.stats_history.add_segment_stats(self.table, stats)
 
 
 class RealtimeTableDataManager:

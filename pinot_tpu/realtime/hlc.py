@@ -46,7 +46,7 @@ class HLRealtimeSegmentDataManager:
                  stream_config: StreamConfig, group_id: str, store,
                  table_data_manager, instance_id: str, work_dir: str,
                  on_segment_flushed: Optional[Callable] = None,
-                 batch_rows: int = 1000):
+                 batch_rows: int = 1000, stats_history=None):
         self.table = table
         self.schema = schema
         self.table_config = table_config
@@ -58,6 +58,7 @@ class HLRealtimeSegmentDataManager:
         self.work_dir = work_dir
         self.on_segment_flushed = on_segment_flushed
         self.batch_rows = batch_rows
+        self.stats_history = stats_history
         self.transformer = CompoundTransformer(schema)
         self.segments_flushed = 0
 
@@ -102,8 +103,13 @@ class HLRealtimeSegmentDataManager:
                 f"{self.group_id}__{seq}")
 
     def _new_consuming_segment(self) -> MutableSegmentImpl:
+        # allocation sizing from prior flushes (RealtimeSegmentStatsHistory
+        # parity — same feedback loop as the LLC path)
+        hint = self.stats_history.estimate(self.table) \
+            if self.stats_history is not None else None
         mutable = MutableSegmentImpl(self.schema, self.table_config,
-                                     self._segment_name(self._seq))
+                                     self._segment_name(self._seq),
+                                     stats_hint=hint)
         # queryable from the first row (refcounted like any segment)
         self.tdm.add_segment(mutable)
         return mutable
@@ -157,6 +163,7 @@ class HLRealtimeSegmentDataManager:
         (same name → refcounted swap in the data manager), then persist
         the consumer checkpoint — durability before commit."""
         name = self.mutable.segment_name
+        stats = self.mutable.collect_stats()   # before the swap drops it
         out_dir = os.path.join(self.work_dir, name)
         # a crash between flush and checkpoint replays this sequence —
         # never build into a directory holding a previous torn attempt
@@ -181,6 +188,8 @@ class HLRealtimeSegmentDataManager:
             "updatedAtMs": int(time.time() * 1e3),
         })
         self.segments_flushed += 1
+        if self.stats_history is not None:
+            self.stats_history.add_segment_stats(self.table, stats)
         log.info("HLC flushed %s (%d docs), checkpoint persisted",
                  name, meta.total_docs)
         self.mutable = self._new_consuming_segment()

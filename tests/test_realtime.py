@@ -659,21 +659,23 @@ def test_stats_history_sizes_next_segment(work_dir):
     cluster = EmbeddedCluster(work_dir, num_servers=1)
     try:
         cluster.add_schema(make_schema())
-        cluster.add_table(rt_config("mem_sh", "topic_sh", flush_rows=500))
-        rows = make_rows(1200, seed=9)
-        for r in rows[:600]:
+        cluster.add_table(rt_config("mem_sh", "topic_sh",
+                                    flush_rows=6000))
+        rows = make_rows(7000, seed=9)
+        for r in rows:
             stream.publish(r, partition=0)
-        assert wait_until(lambda: len(done_segments(cluster)) >= 1)
-        assert wait_until(lambda: count_star(cluster) == 600)
+        assert wait_until(lambda: len(done_segments(cluster)) >= 1,
+                          timeout=30)
+        assert wait_until(lambda: count_star(cluster) == 7000)
 
         rtdm = cluster.participants["Server_0"].realtime
         hist = rtdm.stats_history
         assert wait_until(lambda: len(hist.entries(RT_TABLE)) >= 1)
         entry = hist.entries(RT_TABLE)[0]
-        assert entry["numRowsIndexed"] >= 500
+        assert entry["numRowsIndexed"] >= 6000
         assert entry["columns"]["teamID"]["cardinality"] > 0
         est = hist.estimate(RT_TABLE)
-        assert est["rows"] >= 500
+        assert est["rows"] > 4096       # above the allocation floor
 
         # the history is DURABLE (json on disk, atomic replace)
         reloaded = RealtimeSegmentStatsHistory(hist.path)
@@ -692,7 +694,8 @@ def test_stats_history_sizes_next_segment(work_dir):
         want = 4096
         while want < est["rows"]:
             want *= 2
-        assert len(src._sv._arr) >= want, (len(src._sv._arr), want)
+        assert len(src._sv._arr) >= want > 4096, \
+            (len(src._sv._arr), want)
     finally:
         cluster.stop()
 
@@ -753,3 +756,44 @@ def test_consuming_freshness_reported(work_dir):
             int(time.time() * 1e3) + 1000, j
     finally:
         cluster.stop()
+
+
+def test_hlc_stats_history_feedback(work_dir):
+    """The HLC path records flush stats and sizes the next consuming
+    segment from them (same RealtimeSegmentStatsHistory loop as LLC)."""
+    from pinot_tpu.realtime.hlc import HLRealtimeSegmentDataManager
+    from pinot_tpu.realtime.stats_history import RealtimeSegmentStatsHistory
+    from pinot_tpu.realtime.stream import StreamConfig
+    from pinot_tpu.controller.property_store import PropertyStore
+    from pinot_tpu.server.data_manager import TableDataManager
+
+    stream = MemoryStream("topic_hsh", num_partitions=1)
+    factory = MemoryStreamConsumerFactory(stream, batch_size=64)
+    registry.register_stream_factory("mem_hsh", factory)
+    # flush threshold ABOVE the 4096 allocation floor, so the hint
+    # provably raises the next segment's initial capacity
+    cfg = rt_config("mem_hsh", "topic_hsh", flush_rows=6000)
+    stream_config = registry.resolve_stream_config(cfg)
+    hist = RealtimeSegmentStatsHistory(os.path.join(work_dir, "sh.json"))
+    store = PropertyStore()
+    tdm = TableDataManager(RT_TABLE)
+    mgr = HLRealtimeSegmentDataManager(
+        RT_TABLE, make_schema(), cfg, stream_config, "g0", store,
+        tdm, "srv0", work_dir, stats_history=hist)
+    try:
+        for r in make_rows(7000, seed=21):
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: mgr.segments_flushed >= 1, timeout=30)
+        assert wait_until(lambda: len(hist.entries(RT_TABLE)) >= 1)
+        assert hist.entries(RT_TABLE)[0]["numRowsIndexed"] >= 6000
+        # the live consuming segment allocated from the estimate — the
+        # estimate exceeds the floor, so the assertion is non-vacuous
+        est = hist.estimate(RT_TABLE)
+        assert est["rows"] > 4096
+        want = 4096
+        while want < est["rows"]:
+            want *= 2
+        src = mgr.mutable._sources["teamID"]
+        assert len(src._sv._arr) >= want > 4096
+    finally:
+        mgr.stop()
