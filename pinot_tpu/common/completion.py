@@ -21,6 +21,7 @@ KEEP = "KEEP"                       # local rows match the committed end
 COMMIT = "COMMIT"                   # you are the committer: build + upload
 COMMIT_SUCCESS = "COMMIT_SUCCESS"
 COMMIT_CONTINUE = "COMMIT_CONTINUE"
+PROCESSED = "PROCESSED"             # extendBuildTime granted
 FAILED = "FAILED"
 
 

@@ -78,6 +78,8 @@ class ControllerApiServer(ApiServer):
         router.add("POST", "/segmentStoppedConsuming",
                    self._stopped_consuming)
         router.add("POST", "/segmentCommitStart", self._commit_start)
+        router.add("POST", "/segmentExtendBuildTime",
+                   self._extend_build_time)
         router.add("POST", "/segmentCommitEnd", self._commit_end)
         # deep-store access for servers without a shared filesystem
         # (parity: common/segment/fetcher HTTP segment fetchers + the
@@ -331,6 +333,14 @@ class ControllerApiServer(ApiServer):
         self.controller.realtime.stopped_consuming(
             table, name, instance, request.query.get("reason", ""))
         return HttpResponse.of_json({"status": "PROCESSED"})
+
+    async def _extend_build_time(self, request: HttpRequest
+                                 ) -> HttpResponse:
+        table, name, instance, _ = self._completion_params(request)
+        extra = float(request.query.get("extraTimeMs", "60000"))
+        resp = self.controller.realtime.extend_build_time(
+            table, name, instance, extra)
+        return HttpResponse.of_json(resp.to_json())
 
     async def _commit_start(self, request: HttpRequest) -> HttpResponse:
         table, name, instance, offset = self._completion_params(request)

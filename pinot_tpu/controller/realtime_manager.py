@@ -46,15 +46,22 @@ class _CompletionFSM:
         self.first_report_ms: Optional[float] = None
         self.winner: Optional[str] = None
         self.target: Optional[int] = None
+        # commit lease: the winner must finish (or extend) within this
+        # deadline or the next replica report re-elects (parity:
+        # SegmentCompletionManager's commit-time lease +
+        # SegmentBuildTimeLeaseExtender extensions)
+        self.lease_deadline_ms: Optional[float] = None
 
 
 class RealtimeSegmentManager:
     def __init__(self, manager: ResourceManager,
-                 election_wait_ms: float = 2_000.0):
+                 election_wait_ms: float = 2_000.0,
+                 commit_lease_ms: float = 60_000.0):
         self.manager = manager
         self.coordinator = manager.coordinator
         self.store = manager.store
         self.election_wait_ms = election_wait_ms
+        self.commit_lease_ms = commit_lease_ms
         self._fsm: Dict[str, _CompletionFSM] = {}
         self._lock = threading.Lock()
 
@@ -243,12 +250,23 @@ class RealtimeSegmentManager:
                 window_passed = (now - fsm.first_report_ms
                                  ) >= self.election_wait_ms
                 if all_reported or window_passed:
-                    best = max(fsm.offsets.values())
-                    fsm.winner = next(i for i in fsm.report_order
-                                      if fsm.offsets[i] == best)
-                    fsm.target = best
+                    self._elect(fsm, now)
             if fsm.winner is None:
                 return CompletionResponse(proto.HOLD)
+            # lease expiry: a winner that went silent past its commit
+            # lease forfeits; re-elect among CURRENT reporters so the
+            # partition doesn't stall until the periodic repair task
+            if fsm.winner != instance and \
+                    fsm.lease_deadline_ms is not None and \
+                    now > fsm.lease_deadline_ms:
+                # the silent winner forfeits: re-elect among the OTHER
+                # reporters (the reporting instance is already recorded)
+                expired = fsm.winner
+                if any(i != expired for i in fsm.offsets):
+                    log.warning("commit lease expired for %s/%s (winner "
+                                "%s); re-electing", table, segment,
+                                expired)
+                    self._elect(fsm, now, exclude=expired)
             if instance == fsm.winner:
                 if offset < fsm.target:
                     return CompletionResponse(proto.CATCHUP, fsm.target)
@@ -259,6 +277,36 @@ class RealtimeSegmentManager:
             if offset < fsm.target:
                 return CompletionResponse(proto.CATCHUP, fsm.target)
             return CompletionResponse(proto.HOLD)
+
+    def _elect(self, fsm: "_CompletionFSM", now: float,
+               exclude: Optional[str] = None) -> None:
+        """Pick the max-offset reporter (first in report order breaks
+        ties) and start its commit lease AT ELECTION — a winner that
+        dies before ever polling must still be time-bounded."""
+        candidates = {i: o for i, o in fsm.offsets.items()
+                      if i != exclude}
+        best = max(candidates.values())
+        fsm.winner = next(i for i in fsm.report_order
+                          if i != exclude and fsm.offsets[i] == best)
+        fsm.target = best
+        fsm.lease_deadline_ms = now + self.commit_lease_ms
+
+    def extend_build_time(self, table: str, segment: str, instance: str,
+                          extra_ms: float = 60_000.0
+                          ) -> CompletionResponse:
+        """The committing winner asks for more build time (parity:
+        SegmentCompletionProtocol.extendBuildTime, driven by the
+        server's SegmentBuildTimeLeaseExtender during long builds)."""
+        with self._lock:
+            fsm = self._fsm.get(segment)
+            if fsm is None or fsm.winner != instance:
+                return CompletionResponse(proto.FAILED)
+            now = time.monotonic() * 1e3
+            if fsm.lease_deadline_ms is not None and \
+                    now > fsm.lease_deadline_ms:
+                return CompletionResponse(proto.FAILED)   # already lost
+            fsm.lease_deadline_ms = now + float(extra_ms)
+            return CompletionResponse(proto.PROCESSED)
 
     def stopped_consuming(self, table: str, segment: str, instance: str,
                           reason: str = "") -> None:
@@ -311,6 +359,15 @@ class RealtimeSegmentManager:
         if os.path.abspath(segment_dir) != os.path.abspath(dest):
             self.manager.fs.delete(dest)
             self.manager.fs.copy(segment_dir, dest)
+
+        # re-verify AFTER the (possibly long) deep-store copy: a lease
+        # expiry during it may have re-elected another winner — two
+        # committers must never both step the cluster
+        with self._lock:
+            fsm = self._fsm.get(segment)
+            if fsm is None or fsm.winner != instance or \
+                    offset != fsm.target:
+                return CompletionResponse(proto.FAILED)
 
         def finish(old: Optional[dict]) -> dict:
             rec = dict(old or {})

@@ -65,6 +65,8 @@ class RealtimeSegmentDataManager:
         self.offset = int(start_offset)
         self.state = CONSUMING_STATE
         self.stats_history = stats_history
+        # how often the build-time lease extender pings the controller
+        self.lease_extend_interval_s = 10.0
         # allocation sizing from the table's completed-segment history
         # (parity: RealtimeSegmentStatsHistory.java:49 feedback loop)
         hint = stats_history.estimate(table) if stats_history else None
@@ -187,6 +189,34 @@ class RealtimeSegmentDataManager:
 
     def _commit(self) -> None:
         self.state = COMMITTING
+        # SegmentBuildTimeLeaseExtender parity: ping the controller for
+        # the WHOLE commit (build + upload) so a slow build or a long
+        # deep-store copy isn't mistaken for a dead winner
+        lease_stop = threading.Event()
+
+        def _extend_lease() -> None:
+            extend = getattr(self.completion, "extend_build_time", None)
+            if extend is None:
+                return
+            while not lease_stop.wait(self.lease_extend_interval_s):
+                try:
+                    extend(self.table, self.llc.name, self.instance_id)
+                except Exception:  # noqa: BLE001 — advisory; commit_end
+                    # is the authoritative outcome
+                    log.warning("extendBuildTime failed for %s",
+                                self.llc.name, exc_info=True)
+
+        lease_thread = threading.Thread(
+            target=_extend_lease, daemon=True,
+            name=f"lease-{self.llc.name}")
+        lease_thread.start()
+        try:
+            self._commit_inner()
+        finally:
+            lease_stop.set()
+            lease_thread.join(timeout=5)
+
+    def _commit_inner(self) -> None:
         resp = self.completion.commit_start(self.table, self.llc.name,
                                             self.instance_id, self.offset)
         if resp.status != proto.COMMIT_CONTINUE:

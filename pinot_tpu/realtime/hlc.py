@@ -163,7 +163,10 @@ class HLRealtimeSegmentDataManager:
         (same name → refcounted swap in the data manager), then persist
         the consumer checkpoint — durability before commit."""
         name = self.mutable.segment_name
-        stats = self.mutable.collect_stats()   # before the swap drops it
+        # before the swap drops the mutable's buffers; guarded — the
+        # O(docs) stat pass is wasted without a history to record into
+        stats = self.mutable.collect_stats() \
+            if self.stats_history is not None else None
         out_dir = os.path.join(self.work_dir, name)
         # a crash between flush and checkpoint replays this sequence —
         # never build into a directory holding a previous torn attempt

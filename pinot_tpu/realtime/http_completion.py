@@ -44,6 +44,14 @@ class HttpSegmentCompletionClient:
                    {"table": table, "name": segment, "instance": instance,
                     "reason": reason})
 
+    def extend_build_time(self, table: str, segment: str,
+                          instance: str, extra_ms: float = 60_000.0
+                          ) -> CompletionResponse:
+        return CompletionResponse.from_json(self._post(
+            "/segmentExtendBuildTime",
+            {"table": table, "name": segment, "instance": instance,
+             "extraTimeMs": str(extra_ms)}))
+
     def commit_start(self, table: str, segment: str, instance: str,
                      offset: int) -> CompletionResponse:
         return CompletionResponse.from_json(self._post(

@@ -797,3 +797,76 @@ def test_hlc_stats_history_feedback(work_dir):
         assert len(src._sv._arr) >= want > 4096
     finally:
         mgr.stop()
+
+
+def test_commit_lease_expiry_reelects_winner(work_dir):
+    """Parity: the commit-time lease — a winner that goes silent past
+    its lease forfeits, and the next reporter is re-elected so the
+    partition doesn't stall until periodic repair."""
+    from pinot_tpu.common import completion as proto
+    from pinot_tpu.controller.controller import Controller
+
+    ctrl = Controller(os.path.join(work_dir, "ds"))
+    rt = ctrl.realtime
+    rt.election_wait_ms = 0.0           # elect on first report
+    rt.commit_lease_ms = 30.0           # tiny lease for the test
+    # two live replicas
+    from pinot_tpu.controller.state_machine import StateModel
+    ctrl.coordinator.register_participant("s1", StateModel())
+    ctrl.coordinator.register_participant("s2", StateModel())
+    from pinot_tpu.controller.manager import SEGMENTS
+    seg = "baseballStats__0__0"
+    ctrl.coordinator.set_ideal_state(
+        RT_TABLE, {seg: {"s1": "CONSUMING", "s2": "CONSUMING"}})
+    rt.store.set(f"{SEGMENTS}/{RT_TABLE}/{seg}",
+                 {"segmentName": seg, "status": "IN_PROGRESS",
+                  "startOffset": 0})
+
+    r1 = rt.segment_consumed(RT_TABLE, seg, "s1", 100)
+    assert r1.status == proto.COMMIT            # s1 elected, lease starts
+    r2 = rt.segment_consumed(RT_TABLE, seg, "s2", 100)
+    assert r2.status == proto.HOLD
+    time.sleep(0.1)                              # lease expires
+    r2 = rt.segment_consumed(RT_TABLE, seg, "s2", 100)
+    assert r2.status == proto.COMMIT, r2.status  # re-elected
+    # the old winner's commit_start is now refused
+    assert rt.commit_start(RT_TABLE, seg, "s1", 100).status == proto.FAILED
+    assert rt.commit_start(RT_TABLE, seg, "s2",
+                           100).status == proto.COMMIT_CONTINUE
+
+
+def test_extend_build_time_keeps_lease(work_dir):
+    """SegmentBuildTimeLeaseExtender parity: extensions keep a slow
+    winner's lease alive, so no re-election happens."""
+    from pinot_tpu.common import completion as proto
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.controller.manager import SEGMENTS
+    from pinot_tpu.controller.state_machine import StateModel
+
+    ctrl = Controller(os.path.join(work_dir, "ds"))
+    rt = ctrl.realtime
+    rt.election_wait_ms = 0.0
+    rt.commit_lease_ms = 500.0          # wide margin: CI-load safe
+    ctrl.coordinator.register_participant("s1", StateModel())
+    ctrl.coordinator.register_participant("s2", StateModel())
+    seg = "baseballStats__0__0"
+    ctrl.coordinator.set_ideal_state(
+        RT_TABLE, {seg: {"s1": "CONSUMING", "s2": "CONSUMING"}})
+    rt.store.set(f"{SEGMENTS}/{RT_TABLE}/{seg}",
+                 {"segmentName": seg, "status": "IN_PROGRESS",
+                  "startOffset": 0})
+    assert rt.segment_consumed(RT_TABLE, seg, "s1",
+                               50).status == proto.COMMIT
+    # 6 x 150ms = 900ms elapsed, well past the ORIGINAL 500ms lease;
+    # each extension grants a fresh 500ms (350ms slack per step under
+    # CI load), so the winner stays elected throughout
+    for _ in range(6):
+        time.sleep(0.15)
+        assert rt.extend_build_time(RT_TABLE, seg, "s1",
+                                    extra_ms=500.0).status == \
+            proto.PROCESSED
+    assert rt.segment_consumed(RT_TABLE, seg, "s2",
+                               50).status == proto.HOLD
+    # a non-winner cannot extend
+    assert rt.extend_build_time(RT_TABLE, seg, "s2").status == \
+        proto.FAILED
