@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -230,6 +231,11 @@ class BrokerRequestHandler:
             tables, queried, responded = await self.router.submit(
                 next(self._request_ids), routes, timeout_s,
                 enable_trace=request.query_options.trace)
+            tables, rq, rr = await self._retry_missing_segments(
+                routes, tables, timeout_s,
+                enable_trace=request.query_options.trace)
+            queried += rq
+            responded += rr
         if responded < queried:
             self.metrics.meter(
                 BrokerMeter.BROKER_RESPONSES_WITH_PARTIAL_SERVERS).mark()
@@ -261,6 +267,77 @@ class BrokerRequestHandler:
                 # REALTIME sub-requests — merge, don't overwrite
                 resp.trace_info.setdefault(name, []).extend(spans)
         return resp
+
+    _MISSING_RE = re.compile(r"^SegmentMissingError: \[(.*)\]$")
+
+    async def _retry_missing_segments(self, routes, tables,
+                                      timeout_s: float,
+                                      enable_trace: bool = False):
+        """One re-dispatch of segments a server reported missing.
+
+        A routing table sampled just before a rebalance drop step / a
+        reload bounce can point at a server that has already unloaded
+        the segment (the server still answers for the rest and reports
+        SegmentMissingError). The make-before-break invariant means
+        another replica IS serving — re-resolve those segments against
+        the CURRENT external view and dispatch once more; segments with
+        no live replica keep their exception (an honest miss). Parity:
+        the reference broker re-resolving routing on external-view
+        change + tolerating partial responses.
+        """
+        import ast
+
+        if not any(dt.exceptions for dt in tables):
+            return tables, 0, 0        # hot path: nothing to inspect
+
+        seg_home: Dict[str, tuple] = {}
+        for sub, routing in routes:
+            for server, segs in routing.items():
+                for g in segs:
+                    seg_home[g] = (sub, server)
+
+        # grouped per sub-request: a retry route must pair each server's
+        # segment list with the SAME request those segments belong to
+        retry_groups: Dict[int, tuple] = {}
+        for dt in tables:
+            remaining_exc = []
+            for exc in dt.exceptions:
+                m = self._MISSING_RE.match(str(exc))
+                if m is None:
+                    remaining_exc.append(exc)
+                    continue
+                try:
+                    missing = list(ast.literal_eval(f"[{m.group(1)}]"))
+                except (ValueError, SyntaxError):
+                    remaining_exc.append(exc)
+                    continue
+                unresolved = []
+                for g in missing:
+                    sub, failed = seg_home.get(g, (None, None))
+                    view = self.routing.view(sub.table_name) \
+                        if sub is not None else None
+                    candidates = [srv for srv in
+                                  (view.servers_for(g, states=("ONLINE",
+                                                               "CONSUMING"))
+                                   if view is not None else [])
+                                  if srv != failed]
+                    if sub is None or not candidates:
+                        unresolved.append(g)
+                        continue
+                    grp = retry_groups.setdefault(id(sub), (sub, {}))
+                    grp[1].setdefault(candidates[0], []).append(g)
+                if unresolved:
+                    remaining_exc.append(
+                        f"SegmentMissingError: {sorted(unresolved)}")
+            dt.exceptions = remaining_exc
+        retry_routes = list(retry_groups.values())
+
+        if not retry_routes:
+            return tables, 0, 0
+        retry_tables, rq, rr = await self.router.submit(
+            next(self._request_ids), retry_routes, timeout_s,
+            enable_trace=enable_trace)
+        return tables + retry_tables, rq, rr
 
     def _pruned_route(self, sub_request: BrokerRequest, table: str
                       ) -> Dict[str, List[str]]:

@@ -148,12 +148,20 @@ class RoutingManager:
     """Holds current routing tables per physical table; rebuilds on
     external-view changes (parity: processExternalViewChange :418)."""
 
+    # how long a segment whose replicas are ALL transiently non-serving
+    # keeps routing to its last-known serving replica (covers the
+    # reload/rebalance bounce windows where the view briefly shows no
+    # ONLINE replica; a genuinely deleted segment leaves the view
+    # entirely and gets no grace)
+    UNSERVABLE_GRACE_S = 10.0
+
     def __init__(self, builder: Optional[RoutingTableBuilder] = None,
                  seed: int = 0):
         self.builder = builder or BalancedRandomRoutingTableBuilder()
         self._table_builders: Dict[str, RoutingTableBuilder] = {}
         self._tables: Dict[str, List[RoutingTable]] = {}
         self._views: Dict[str, TableView] = {}
+        self._last_serving: Dict[str, Dict[str, tuple]] = {}
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
@@ -177,9 +185,36 @@ class RoutingManager:
             self.update_view(view)
 
     def update_view(self, view: TableView) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        view = view.copy()
         builder = self.table_builder(view.table_name)
-        tables = builder.build(view, self._rng)
         with self._lock:
+            # grace bookkeeping under the same lock as the table swap:
+            # concurrent update_view calls for one table must not
+            # interleave last-serving writes with an older view's
+            last = self._last_serving.setdefault(view.table_name, {})
+            for seg in list(view.segment_states):
+                servers = view.servers_for(seg, states=(ONLINE,
+                                                        CONSUMING))
+                if servers:
+                    # remember ONE serving replica for the grace fallback
+                    last[seg] = (servers[0],
+                                 now + self.UNSERVABLE_GRACE_S)
+                else:
+                    held = last.get(seg)
+                    if held is not None and held[1] > now:
+                        # transient all-replicas-bouncing window: keep
+                        # the segment routable at its last server (a
+                        # wrong guess surfaces as SegmentMissingError
+                        # and goes through the broker's re-dispatch,
+                        # never silent row loss)
+                        view.segment_states[seg] = {held[0]: ONLINE}
+            for seg in [s for s in last
+                        if s not in view.segment_states]:
+                del last[seg]          # segment left the view: no grace
+            tables = builder.build(view, self._rng)
             self._views[view.table_name] = view.copy()
             self._tables[view.table_name] = tables
 
@@ -187,6 +222,7 @@ class RoutingManager:
         with self._lock:
             self._tables.pop(table_name, None)
             self._views.pop(table_name, None)
+            self._last_serving.pop(table_name, None)
             # drop the builder override too: a recreated table must start
             # from the broker default until its own config is applied
             self._table_builders.pop(table_name, None)

@@ -54,7 +54,14 @@ def _validate_table_config(config: TableConfig) -> None:
 
 class ResourceManager:
     def __init__(self, coordinator: ClusterCoordinator, deep_store_dir: str,
-                 fs: Optional[PinotFS] = None):
+                 fs: Optional[PinotFS] = None,
+                 maintain_broker_resource: bool = True):
+        """`maintain_broker_resource`: whether THIS manager owns the
+        /BROKERRESOURCE records (watching live instances and rewriting
+        on membership change). True for the controller process; server/
+        broker processes construct read-only managers and must pass
+        False — a single writer, like the reference's Helix controller
+        owning the broker resource ideal state."""
         self.coordinator = coordinator
         self.store = coordinator.store
         self.deep_store_dir = deep_store_dir
@@ -64,15 +71,19 @@ class ResourceManager:
         self._quota_checker = StorageQuotaChecker()
         self.tenants = TenantManager(self.store)
         # broker membership follows live-instance records (registration,
-        # death, tag changes) — watch them so /BROKERRESOURCE/<table>
-        # never goes stale for clients' dynamic broker selectors
-        from pinot_tpu.controller.state_machine import LIVE as _LIVE
-        self._live_watcher = lambda path, rec: \
-            self.refresh_all_broker_resources()
-        self.store.watch(_LIVE + "/", self._live_watcher)
+        # death, tag changes) — the OWNING manager watches them so
+        # /BROKERRESOURCE/<table> never goes stale for clients' dynamic
+        # broker selectors
+        self._live_watcher = None
+        if maintain_broker_resource:
+            from pinot_tpu.controller.state_machine import LIVE as _LIVE
+            self._live_watcher = lambda path, rec: \
+                self.refresh_all_broker_resources()
+            self.store.watch(_LIVE + "/", self._live_watcher)
 
     def close(self) -> None:
-        self.store.unwatch(self._live_watcher)
+        if self._live_watcher is not None:
+            self.store.unwatch(self._live_watcher)
 
     # -- schemas & tables --------------------------------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -266,16 +277,21 @@ class ResourceManager:
         self.store.remove(f"{SEGMENTS}/{table}/{segment}")
         self.fs.delete(os.path.join(self.deep_store_dir, table, segment))
 
-    def reload_segment(self, table: str, segment: str) -> None:
+    def reload_segment(self, table: str, segment: str,
+                       converge_timeout_s: float = 30.0) -> None:
         """Rolling per-replica bounce through OFFLINE so holders re-run
         the load path — applying schema evolution (default columns) and
         new index configs to an already-served segment. One replica
-        reloads at a time, so replicated tables keep serving throughout
-        (a replication-1 segment is briefly unrouted — the reference's
-        in-place reload message has no gap, but also no Helix-visible
-        progress). Parity: the segment reload REST operation.
-        Each closure re-reads the LIVE instance map, so a concurrent
-        rebalance is never clobbered with a stale holder set."""
+        reloads at a time, WAITING for the external view to show it
+        serving again before the next bounce — with remote participants
+        the ideal-state write returns before the server transitions, and
+        bouncing the next replica early would leave a window with zero
+        serving replicas (a replication-1 segment is briefly unrouted —
+        the reference's in-place reload message has no gap, but also no
+        Helix-visible progress). Parity: the segment reload REST
+        operation. Each closure re-reads the LIVE instance map, so a
+        concurrent rebalance is never clobbered with a stale holder
+        set."""
         current = self.coordinator.ideal_state(table)
         if segment not in current:
             raise ValueError(f"segment {segment} not in {table}")
@@ -289,6 +305,32 @@ class ResourceManager:
                 return segments
 
             self.coordinator.update_ideal_state(table, offline)
+            try:
+                if self.coordinator.ideal_state(table).get(
+                        segment, {}).get(inst) == "OFFLINE":
+                    # wait for the UNLOAD to be visible before flipping
+                    # back: a remote agent lags the store write, and the
+                    # stale ONLINE in the view would otherwise satisfy
+                    # the re-ONLINE wait spuriously — letting the next
+                    # replica bounce while this one is still going down
+                    # (observed as both-replicas-OFFLINE view windows)
+                    self._await_converged(table,
+                                          {segment: {inst: "OFFLINE"}},
+                                          1, converge_timeout_s)
+            except TimeoutError:
+                # dead/wedged replica: restore the ideal state to ONLINE
+                # so the instance isn't parked OFFLINE forever, then
+                # surface the failure
+
+                def restore(segments, inst=inst):
+                    entry = dict(segments.get(segment, {}))
+                    if entry.get(inst) == "OFFLINE":
+                        entry[inst] = ONLINE
+                        segments[segment] = entry
+                    return segments
+
+                self.coordinator.update_ideal_state(table, restore)
+                raise
 
             def online(segments, inst=inst):
                 entry = dict(segments.get(segment, {}))
@@ -298,6 +340,10 @@ class ResourceManager:
                 return segments
 
             self.coordinator.update_ideal_state(table, online)
+            if self.coordinator.ideal_state(table).get(
+                    segment, {}).get(inst) == ONLINE:
+                self._await_converged(table, {segment: {inst: ONLINE}},
+                                      1, converge_timeout_s)
 
     def reload_table(self, table: str) -> int:
         segments = self.segment_names(table)
@@ -365,10 +411,17 @@ class ResourceManager:
                 return segments
 
             self.coordinator.update_ideal_state(table, add_new)
-            self._await_converged(table, {s: target.get(s, {})
-                                          for s in batch},
-                                  min_available_replicas,
-                                  converge_timeout_s)
+            # wait for the NEWLY ADDED replicas specifically: counting
+            # already-serving old replicas would let the drop step run
+            # before the new copies finish loading, and a subsequent
+            # bounce of the old survivor would leave zero serving
+            # replicas (observed under rebalance+reload churn)
+            added = {s: {i: st for i, st in target.get(s, {}).items()
+                         if i not in current.get(s, {})}
+                     for s in batch}
+            self._await_converged(table, added, min_available_replicas,
+                                  converge_timeout_s,
+                                  require_all=True)
 
             # step 2 (break): drop replicas not in the target
             def drop_old(segments, batch=batch):
@@ -385,10 +438,12 @@ class ResourceManager:
 
     def _await_converged(self, table: str,
                          wanted: Dict[str, Dict[str, str]],
-                         min_available: int, timeout_s: float) -> None:
-        """Block until every segment has ≥min_available of its wanted
-        replicas serving in the external view (parity: the
-        external-view convergence wait between TableRebalancer steps)."""
+                         min_available: int, timeout_s: float,
+                         require_all: bool = False) -> None:
+        """Block until every segment has ≥min_available (or, with
+        require_all, every one) of its wanted replicas serving in the
+        external view (parity: the external-view convergence wait
+        between TableRebalancer steps)."""
         deadline = time.monotonic() + timeout_s
         while True:
             view = self.coordinator.external_view(table).segment_states
@@ -397,7 +452,8 @@ class ResourceManager:
                 not wanted.get(seg) or
                 sum(1 for inst, st in wanted[seg].items()
                     if view.get(seg, {}).get(inst) == st) >=
-                min(min_available, len(wanted[seg]))
+                (len(wanted[seg]) if require_all else
+                 min(min_available, len(wanted[seg])))
                 for seg in wanted)
             if ok:
                 return

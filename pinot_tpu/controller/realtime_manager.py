@@ -357,17 +357,26 @@ class RealtimeSegmentManager:
         built = SegmentMetadata.load(segment_dir)
         dest = os.path.join(self.manager.deep_store_dir, table, segment)
         if os.path.abspath(segment_dir) != os.path.abspath(dest):
+            # stage per-attempt, swap in only after the post-copy winner
+            # re-verify: a forfeited winner's still-running copy must
+            # never clobber the re-elected winner's committed artifact
+            stage = f"{dest}.staging.{instance}"
+            self.manager.fs.delete(stage)
+            self.manager.fs.copy(segment_dir, stage)
+            with self._lock:
+                fsm = self._fsm.get(segment)
+                if fsm is None or fsm.winner != instance or \
+                        offset != fsm.target:
+                    self.manager.fs.delete(stage)
+                    return CompletionResponse(proto.FAILED)
             self.manager.fs.delete(dest)
-            self.manager.fs.copy(segment_dir, dest)
-
-        # re-verify AFTER the (possibly long) deep-store copy: a lease
-        # expiry during it may have re-elected another winner — two
-        # committers must never both step the cluster
-        with self._lock:
-            fsm = self._fsm.get(segment)
-            if fsm is None or fsm.winner != instance or \
-                    offset != fsm.target:
-                return CompletionResponse(proto.FAILED)
+            os.rename(stage, dest)
+        else:
+            with self._lock:
+                fsm = self._fsm.get(segment)
+                if fsm is None or fsm.winner != instance or \
+                        offset != fsm.target:
+                    return CompletionResponse(proto.FAILED)
 
         def finish(old: Optional[dict]) -> dict:
             rec = dict(old or {})

@@ -82,7 +82,8 @@ class DistributedServer:
         does)."""
         self.store = RemotePropertyStore(store_host, store_port)
         coordinator = ClusterCoordinator(self.store)
-        self.manager = ResourceManager(coordinator, deep_store_dir)
+        self.manager = ResourceManager(coordinator, deep_store_dir,
+                                       maintain_broker_resource=False)
         self.server = ServerInstance(instance_id, scheduler=scheduler,
                                      mesh=mesh)
         self.port = self.server.start(port=port)
@@ -129,7 +130,8 @@ class DistributedBroker:
                  host: str = "127.0.0.1"):
         self.store = RemotePropertyStore(store_host, store_port)
         coordinator = ClusterCoordinator(self.store)
-        manager = ResourceManager(coordinator, deep_store_dir)
+        manager = ResourceManager(coordinator, deep_store_dir,
+                                  maintain_broker_resource=False)
         self.transport = TcpTransport({})
         self._live_watcher = self._on_live
         self.store.watch(LIVE + "/", self._live_watcher)

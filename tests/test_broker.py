@@ -427,3 +427,43 @@ def test_remove_table_clears_builder_override():
     rm.remove_table("t_OFFLINE")
     assert isinstance(rm.table_builder("t_OFFLINE"),
                       BalancedRandomRoutingTableBuilder)
+
+
+def test_broker_retries_missing_segments_on_stale_routing(tmp_path):
+    """A server that unloaded a segment (rebalance drop / reload bounce)
+    answers with SegmentMissingError; the broker re-dispatches those
+    segments to a live replica from the current view — queries stay
+    correct with zero surfaced errors as long as ANY replica serves."""
+    import os
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    c = EmbeddedCluster(str(tmp_path), num_servers=2)
+    try:
+        cfg = make_table_config()
+        cfg.segments_config.replication = 2
+        c.add_schema(make_schema())
+        c.add_table(cfg)
+        d = os.path.join(str(tmp_path), "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "stale_seg").build(make_columns(1000, seed=12), d)
+        c.upload_segment("baseballStats_OFFLINE", d)
+
+        # simulate routing staleness: one server silently drops the
+        # segment while the external view (and routing tables) still
+        # advertise it
+        tdm = c.servers["Server_0"].data_manager.table(
+            "baseballStats_OFFLINE")
+        tdm.remove_segment("stale_seg")
+
+        hit_errors = []
+        for _ in range(20):     # sampled routing hits both servers
+            resp = c.query("SELECT COUNT(*) FROM baseballStats")
+            if resp.exceptions:
+                hit_errors.append(resp.exceptions)
+            assert int(resp.aggregation_results[0].value) == 1000
+        assert not hit_errors, hit_errors[:2]
+    finally:
+        c.stop()

@@ -535,3 +535,71 @@ def test_dynamic_broker_selector_survives_broker_kill(tmp_path):
         except Exception:
             pass
         ctrl.stop()
+
+
+def test_rebalance_reload_churn_zero_failures(tmp_path):
+    """Across repeated stepping rebalances + rolling reloads over REAL
+    TCP processes, a continuous query load sees zero wrong answers and
+    zero surfaced errors. Exercises the full no-downtime stack: add-step
+    convergence on the NEWLY ADDED replicas, per-replica reload bounces
+    that wait for the unload to be OBSERVED before flipping back, the
+    broker's unservable-window routing grace, and the missing-segment
+    re-dispatch."""
+    import threading
+
+    base = str(tmp_path)
+    ctrl = DistributedController(base)
+    servers = {f"Server_{i}": DistributedServer(
+        f"Server_{i}", "127.0.0.1", ctrl.store_port, ctrl.deep_store_dir,
+        work_dir=os.path.join(base, f"s{i}")) for i in range(3)}
+    broker = DistributedBroker("127.0.0.1", ctrl.store_port,
+                               ctrl.deep_store_dir)
+    try:
+        mgr = ctrl.controller.manager
+        mgr.add_schema(make_schema())
+        cfg = make_table_config()
+        cfg.segments_config.replication = 2
+        mgr.add_table(cfg)
+        total = 0
+        for i in range(4):
+            d = os.path.join(base, f"chseg{i}")
+            os.makedirs(d)
+            build_segment(d, n=1000, seed=50 + i, name=f"chseg{i}")
+            mgr.add_segment("baseballStats_OFFLINE", d)
+            total += 1000
+
+        def settled():
+            r = broker.query("SELECT COUNT(*) FROM baseballStats")
+            return not r.exceptions and \
+                int(r.aggregation_results[0].value) == total
+        _await(settled, timeout=20, msg="bootstrap routed")
+
+        failures = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                r = broker.query("SELECT COUNT(*) FROM baseballStats")
+                if r.exceptions or \
+                        int(r.aggregation_results[0].value) != total:
+                    failures.append(r.to_json())
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            for _ in range(3):
+                mgr.rebalance_table("baseballStats_OFFLINE",
+                                    batch_size=1)
+                mgr.reload_table("baseballStats_OFFLINE")
+        finally:
+            stop.set()
+            t.join()
+        assert not failures, failures[:2]
+    finally:
+        broker.stop()
+        for s in servers.values():
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        ctrl.stop()
