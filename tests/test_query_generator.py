@@ -432,3 +432,34 @@ def test_random_star_tree_agreement():
         r_st, r_pl = eng_st.query(pql), eng_pl.query(pql)
         assert not r_st.exceptions and not r_pl.exceptions, pql
         assert canon(r_st) == canon(r_pl), pql
+
+
+def test_random_multi_column_order_by(setup):
+    """Randomized two-key ORDER BY (mixed ASC/DESC): returned rows must
+    be sorted by the composite key and the prefix must match the oracle
+    top-k exactly (SelectionOperatorService order-by comparator parity)."""
+    engine, host_engine, mesh_engine, oracle = setup
+    gen = Gen(random.Random(SEED + 9), oracle)
+    num_cols = ["runs", "hits", "yearID"]
+    for qi in range(8):
+        where, m = gen.where()
+        o1, o2 = gen.rng.sample(num_cols, 2)
+        d1 = gen.rng.random() < 0.5
+        d2 = gen.rng.random() < 0.5
+        limit = gen.rng.randint(5, 15)
+        pql = (f"SELECT {o1}, {o2} FROM baseballStats{where} "
+               f"ORDER BY {o1} {'DESC' if d1 else 'ASC'}, "
+               f"{o2} {'DESC' if d2 else 'ASC'} LIMIT {limit}")
+        idx = np.nonzero(m)[0]
+        keys = sorted(
+            ((float(oracle.cols[o1][i]), float(oracle.cols[o2][i]))
+             for i in idx),
+            key=lambda t: (-t[0] if d1 else t[0],
+                           -t[1] if d2 else t[1]))[:limit]
+        for e, label in [(engine, "device"), (host_engine, "host"),
+                         (mesh_engine, "mesh-union")]:
+            resp = e.query(pql)
+            assert not resp.exceptions, (pql, label, resp.exceptions)
+            got = [(float(r[0]), float(r[1]))
+                   for r in resp.selection_results.results]
+            assert got == keys, (pql, label, got[:3], keys[:3])

@@ -870,3 +870,58 @@ def test_extend_build_time_keeps_lease(work_dir):
     # a non-winner cannot extend
     assert rt.extend_build_time(RT_TABLE, seg, "s2").status == \
         proto.FAILED
+
+
+def test_completion_fsm_survives_controller_restart(work_dir):
+    """SURVEY §5.4(d): the completion FSM tolerates a controller restart
+    by rebuilding from durable metadata — in-flight elections simply
+    re-run when replicas re-report, and already-committed segments
+    answer KEEP/DISCARD from the store."""
+    from pinot_tpu.common import completion as proto
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.controller.manager import SEGMENTS
+    from pinot_tpu.controller.realtime_manager import RealtimeSegmentManager
+    from pinot_tpu.controller.state_machine import StateModel
+
+    ctrl = Controller(os.path.join(work_dir, "ds"))
+    rt = ctrl.realtime
+    rt.election_wait_ms = 0.0
+    ctrl.coordinator.register_participant("s1", StateModel())
+    ctrl.coordinator.register_participant("s2", StateModel())
+    seg = "baseballStats__0__0"
+    # table config present in the durable store (commit_end reads it)
+    rt.store.set(f"/CONFIGS/TABLE/{RT_TABLE}",
+                 rt_config("none_fsm", "t_fsm").to_json())
+    ctrl.coordinator.set_ideal_state(
+        RT_TABLE, {seg: {"s1": "CONSUMING", "s2": "CONSUMING"}})
+    rt.store.set(f"{SEGMENTS}/{RT_TABLE}/{seg}",
+                 {"segmentName": seg, "status": "IN_PROGRESS",
+                  "startOffset": 0})
+
+    # s1 elected mid-flight, then the controller "restarts": a NEW
+    # manager over the same durable store, empty in-memory FSM
+    assert rt.segment_consumed(RT_TABLE, seg, "s1",
+                               80).status == proto.COMMIT
+    rt2 = RealtimeSegmentManager(ctrl.manager)
+    rt2.election_wait_ms = 0.0
+
+    # replicas re-report to the fresh controller: election re-runs
+    r = rt2.segment_consumed(RT_TABLE, seg, "s2", 80)
+    assert r.status == proto.COMMIT          # s2 elected by the new FSM
+    assert rt2.commit_start(RT_TABLE, seg, "s2",
+                            80).status == proto.COMMIT_CONTINUE
+
+    # commit through the NEW manager using a real built segment
+    d = os.path.join(work_dir, "built")
+    SegmentCreator(make_schema(), make_table_config(),
+                   seg).build(make_columns(500, seed=15), d)
+    assert rt2.commit_end(RT_TABLE, seg, "s2", 80,
+                          d).status == proto.COMMIT_SUCCESS
+
+    # a third manager (another restart): committed segments answer from
+    # durable metadata with no in-memory state at all
+    rt3 = RealtimeSegmentManager(ctrl.manager)
+    assert rt3.segment_consumed(RT_TABLE, seg, "s1",
+                                80).status == proto.KEEP
+    assert rt3.segment_consumed(RT_TABLE, seg, "s1",
+                                70).status == proto.DISCARD
