@@ -190,3 +190,71 @@ def test_tenant_rest_api(tmp_path):
         assert "RestT" not in t["SERVER_TENANTS"]
     finally:
         c.stop()
+
+
+def test_realtime_table_on_named_tenant(tmp_path):
+    """Realtime consuming segments are assigned only to the table's
+    server-tenant instances (the REALTIME role tag), and ingestion +
+    queries work end-to-end on the isolated tenant."""
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig,
+                                               TableConfig, TableType)
+
+    stream = MemoryStream("topic_tnt", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_tnt", MemoryStreamConsumerFactory(stream, batch_size=64))
+    c = EmbeddedCluster(str(tmp_path), num_servers=3)
+    try:
+        mgr = c.controller.manager
+        mgr.tenants.create_server_tenant("RtTenant",
+                                         ["Server_1", "Server_2"])
+        c.add_schema(make_schema())
+        idx = IndexingConfig(
+            no_dictionary_columns=["salary"],
+            stream_configs={
+                "stream.factory.name": "mem_tnt",
+                "stream.topic.name": "topic_tnt",
+                "realtime.segment.flush.threshold.size": "100000",
+                "realtime.segment.flush.threshold.time.ms": "600000000",
+            })
+        cfg = TableConfig(
+            "baseballStats", table_type=TableType.REALTIME,
+            indexing_config=idx,
+            segments_config=SegmentsConfig(replication=1,
+                                           time_column_name="yearID"))
+        cfg.tenant_config = TenantConfig(server="RtTenant")
+        c.add_table(cfg)
+
+        rows = []
+        import numpy as np
+        cols = make_columns(300, seed=44)
+        for i in range(300):
+            rows.append({k: ([str(x) for x in cols[k][i]]
+                             if isinstance(cols[k], list)
+                             else (cols[k][i].item()
+                                   if hasattr(cols[k][i], "item")
+                                   else str(cols[k][i])))
+                         for k in cols})
+        for r in rows:
+            stream.publish(r, partition=0)
+
+        import time as _t
+        deadline = _t.monotonic() + 20
+        def count():
+            resp = c.query("SELECT COUNT(*) FROM baseballStats")
+            return -1 if resp.exceptions else \
+                int(resp.aggregation_results[0].value)
+        while _t.monotonic() < deadline and count() != 300:
+            _t.sleep(0.05)
+        assert count() == 300
+
+        # the consuming segment landed only on tenant instances
+        ideal = c.controller.coordinator.ideal_state(
+            "baseballStats_REALTIME")
+        insts = {i for m in ideal.values() for i in m}
+        assert insts and insts <= {"Server_1", "Server_2"}, ideal
+    finally:
+        c.stop()
