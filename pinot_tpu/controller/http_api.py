@@ -53,6 +53,12 @@ class ControllerApiServer(ApiServer):
         router.add("PUT", "/tables/{name}", self._update_table)
         router.add("GET", "/tables/{name}", self._get_table)
         router.add("DELETE", "/tables/{name}", self._delete_table)
+        router.add("GET", "/tables/{name}/size", self._table_size)
+        router.add("GET", "/tables/{name}/schema", self._table_schema)
+        # query passthrough (parity: PqlQueryResource — the controller
+        # proxies ad-hoc queries to a live broker)
+        router.add("POST", "/pql", self._pql_passthrough)
+        router.add("GET", "/pql", self._pql_passthrough)
         router.add("GET", "/tables/{name}/idealstate", self._ideal_state)
         router.add("GET", "/tables/{name}/externalview",
                    self._external_view)
@@ -212,6 +218,89 @@ class ControllerApiServer(ApiServer):
         submitted = await _asyncio.get_running_loop().run_in_executor(
             None, run)
         return HttpResponse.of_json({"submitted": submitted})
+
+    async def _table_size(self, request: HttpRequest) -> HttpResponse:
+        """Aggregate + per-segment reported sizes from the durable
+        segment records (parity: the controller TableSize API feeding
+        quota/ops tooling)."""
+        table = request.path_params["name"]
+        if self.manager.get_table_config(table) is None:
+            return HttpResponse.error(404, f"table {table} not found")
+        segs = {}
+        total = 0
+        for seg in self.manager.segment_names(table):
+            rec = self.manager.segment_metadata(table, seg) or {}
+            size = int(rec.get("sizeBytes") or 0)
+            segs[seg] = size
+            total += size
+        return HttpResponse.of_json(
+            {"tableName": table, "reportedSizeInBytes": total,
+             "segments": segs})
+
+    async def _table_schema(self, request: HttpRequest) -> HttpResponse:
+        """The schema backing a table (parity: GET /tables/{t}/schema)."""
+        from pinot_tpu.common.table_name import raw_table
+        table = request.path_params["name"]
+        schema = self.manager.get_schema(raw_table(table))
+        if schema is None:
+            return HttpResponse.error(404,
+                                      f"no schema for table {table}")
+        return HttpResponse.of_json(schema.to_json())
+
+    async def _pql_passthrough(self, request: HttpRequest) -> HttpResponse:
+        """Proxy a query to a live broker (parity: PqlQueryResource).
+
+        Broker discovery: any live instance with a _BROKER tag carrying
+        an HTTP endpoint (the same records the dynamic client selector
+        uses)."""
+        import asyncio as _asyncio
+        import json as _json
+        import urllib.request as _req
+
+        fwd_body = {}
+        if request.method == "GET":
+            pql = request.query.get("pql") or request.query.get("sql")
+            if request.query.get("trace", "").lower() == "true":
+                fwd_body["trace"] = True
+        else:
+            try:
+                fwd_body = dict(request.json() or {})
+            except ValueError:
+                return HttpResponse.error(400, "invalid JSON body")
+            pql = fwd_body.get("pql") or fwd_body.get("sql")
+        if not pql:
+            return HttpResponse.error(400, "missing pql")
+        fwd_body["pql"] = pql
+        from pinot_tpu.controller.state_machine import LIVE
+        import random as _random
+        brokers = []
+        for inst in self.manager.store.children(LIVE):
+            rec = self.manager.store.get(f"{LIVE}/{inst}") or {}
+            if "host" in rec and any(t.endswith("_BROKER")
+                                     for t in rec.get("tags", [])):
+                brokers.append((rec["host"], int(rec["port"])))
+        if not brokers:
+            return HttpResponse.error(
+                503, "no live broker registered in the cluster")
+        broker = _random.choice(brokers)   # spread proxied load
+
+        headers = {"Content-Type": "application/json"}
+        auth = request.headers.get("authorization")
+        if auth:
+            # forward the caller's identity so the broker's ACL sees it
+            headers["Authorization"] = auth
+
+        def forward():
+            req = _req.Request(
+                f"http://{broker[0]}:{broker[1]}/query",
+                data=_json.dumps(fwd_body).encode(), headers=headers)
+            with _req.urlopen(req, timeout=60) as r:
+                return r.read()
+
+        payload = await _asyncio.get_running_loop().run_in_executor(
+            None, forward)
+        return HttpResponse(200, payload,
+                            content_type="application/json")
 
     async def _list_tables(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json({"tables": self.manager.table_names()})

@@ -353,3 +353,57 @@ def test_broker_debug_endpoints_honor_acl(tmp_path):
             assert "baseballStats_OFFLINE" in _json.loads(r.read())
     finally:
         c.stop()
+
+
+def test_controller_size_schema_and_pql_passthrough(tmp_path):
+    """Parity: TableSize aggregate, GET /tables/{t}/schema, and the
+    PqlQueryResource-style query passthrough to a live broker."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from fixtures import make_columns, make_schema, make_table_config
+    from pinot_tpu.controller.state_machine import LIVE
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    c = EmbeddedCluster(str(tmp_path), num_servers=1, http=True)
+    try:
+        c.add_schema(make_schema())
+        c.add_table(make_table_config())
+        d = str(tmp_path / "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "sz_seg").build(make_columns(800, seed=13), d)
+        c.upload_segment("baseballStats_OFFLINE", d)
+        base = f"http://127.0.0.1:{c.controller_port}"
+
+        with urllib.request.urlopen(
+                f"{base}/tables/baseballStats_OFFLINE/size") as r:
+            sz = _json.loads(r.read())
+        assert sz["reportedSizeInBytes"] > 0
+        assert sz["segments"]["sz_seg"] > 0
+
+        with urllib.request.urlopen(
+                f"{base}/tables/baseballStats_OFFLINE/schema") as r:
+            sch = _json.loads(r.read())
+        assert sch["schemaName"] == "baseballStats"
+
+        # no broker registered yet: passthrough reports 503
+        try:
+            urllib.request.urlopen(
+                f"{base}/pql?pql=SELECT+COUNT(*)+FROM+baseballStats")
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+
+        # register the embedded broker's HTTP endpoint as a live broker
+        c.controller.manager.store.set(
+            f"{LIVE}/Broker_embedded",
+            {"tags": ["DefaultTenant_BROKER"], "host": "127.0.0.1",
+             "port": c.broker_port})
+        with urllib.request.urlopen(
+                f"{base}/pql?pql=SELECT+COUNT(*)+FROM+baseballStats") as r:
+            out = _json.loads(r.read())
+        assert out["aggregationResults"][0]["value"] == "800", out
+    finally:
+        c.stop()
