@@ -12,12 +12,13 @@ from __future__ import annotations
 
 import asyncio
 import itertools
-import re
+import json
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
+                                        SEGMENT_MISSING_EXC_PREFIX)
 from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
                                       MetricsRegistry)
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
@@ -268,8 +269,6 @@ class BrokerRequestHandler:
                 resp.trace_info.setdefault(name, []).extend(spans)
         return resp
 
-    _MISSING_RE = re.compile(r"^SegmentMissingError: \[(.*)\]$")
-
     async def _retry_missing_segments(self, routes, tables,
                                       timeout_s: float,
                                       enable_trace: bool = False):
@@ -285,9 +284,7 @@ class BrokerRequestHandler:
         the reference broker re-resolving routing on external-view
         change + tolerating partial responses.
         """
-        import ast
-
-        if not any(dt.exceptions for dt in tables):
+        if not any(MISSING_SEGMENTS_KEY in dt.metadata for dt in tables):
             return tables, 0, 0        # hot path: nothing to inspect
 
         seg_home: Dict[str, tuple] = {}
@@ -300,36 +297,42 @@ class BrokerRequestHandler:
         # segment list with the SAME request those segments belong to
         retry_groups: Dict[int, tuple] = {}
         for dt in tables:
-            remaining_exc = []
-            for exc in dt.exceptions:
-                m = self._MISSING_RE.match(str(exc))
-                if m is None:
-                    remaining_exc.append(exc)
+            raw = dt.metadata.pop(MISSING_SEGMENTS_KEY, None)
+            if raw is None:
+                continue
+            try:
+                missing = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(missing, list):
+                continue        # skewed-version server: ignore, keep exc
+            unresolved = []
+            views: Dict[str, object] = {}
+            for g in missing:
+                sub, failed = seg_home.get(g, (None, None))
+                view = None
+                if sub is not None:
+                    if sub.table_name not in views:
+                        views[sub.table_name] = \
+                            self.routing.view(sub.table_name)
+                    view = views[sub.table_name]
+                candidates = [srv for srv in
+                              (view.servers_for(g, states=("ONLINE",
+                                                           "CONSUMING"))
+                               if view is not None else [])
+                              if srv != failed]
+                if sub is None or not candidates:
+                    unresolved.append(g)
                     continue
-                try:
-                    missing = list(ast.literal_eval(f"[{m.group(1)}]"))
-                except (ValueError, SyntaxError):
-                    remaining_exc.append(exc)
-                    continue
-                unresolved = []
-                for g in missing:
-                    sub, failed = seg_home.get(g, (None, None))
-                    view = self.routing.view(sub.table_name) \
-                        if sub is not None else None
-                    candidates = [srv for srv in
-                                  (view.servers_for(g, states=("ONLINE",
-                                                               "CONSUMING"))
-                                   if view is not None else [])
-                                  if srv != failed]
-                    if sub is None or not candidates:
-                        unresolved.append(g)
-                        continue
-                    grp = retry_groups.setdefault(id(sub), (sub, {}))
-                    grp[1].setdefault(candidates[0], []).append(g)
-                if unresolved:
-                    remaining_exc.append(
-                        f"SegmentMissingError: {sorted(unresolved)}")
-            dt.exceptions = remaining_exc
+                grp = retry_groups.setdefault(id(sub), (sub, {}))
+                grp[1].setdefault(candidates[0], []).append(g)
+            # the re-dispatch owns these segments now: drop the server's
+            # human-facing exception and re-state only the honest misses
+            dt.exceptions = [e for e in dt.exceptions if not
+                             str(e).startswith(SEGMENT_MISSING_EXC_PREFIX)]
+            if unresolved:
+                dt.exceptions.append(
+                    f"{SEGMENT_MISSING_EXC_PREFIX} {sorted(unresolved)}")
         retry_routes = list(retry_groups.values())
 
         if not retry_routes:

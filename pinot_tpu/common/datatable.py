@@ -28,6 +28,14 @@ KIND_AGGREGATION = 1
 KIND_GROUP_BY = 2
 KIND_SELECTION = 3
 
+# Structured metadata key carrying the JSON list of segments a server was
+# asked for but does not host; the broker keys its one-shot re-dispatch off
+# this (not off parsing exception strings, which can drift independently).
+MISSING_SEGMENTS_KEY = "missingSegments"
+# Human-facing exception prefix for the same condition — shared so the
+# server format and the broker's partial-response surface stay in sync.
+SEGMENT_MISSING_EXC_PREFIX = "SegmentMissingError:"
+
 
 @dataclasses.dataclass
 class DataTable:

@@ -369,8 +369,12 @@ class RealtimeSegmentManager:
                         offset != fsm.target:
                     self.manager.fs.delete(stage)
                     return CompletionResponse(proto.FAILED)
-            self.manager.fs.delete(dest)
-            os.rename(stage, dest)
+                # swap while still holding the lock: a lease-expiry
+                # re-election between re-verify and rename could otherwise
+                # let this (now forfeited) winner clobber the re-elected
+                # winner's artifact; both ops are fast local-fs calls
+                self.manager.fs.delete(dest)
+                self.manager.fs.move(stage, dest)
         else:
             with self._lock:
                 fsm = self._fsm.get(segment)

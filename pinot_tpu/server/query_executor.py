@@ -7,10 +7,12 @@ timeout accounting, execution-stats metadata on the DataTable.
 """
 from __future__ import annotations
 
+import json
 import time
 from typing import List, Optional
 
-from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
+                                        SEGMENT_MISSING_EXC_PREFIX)
 from pinot_tpu.common.metrics import (MetricsRegistry, ServerMeter,
                                       ServerQueryPhase)
 from pinot_tpu.common.request import InstanceRequest
@@ -60,7 +62,7 @@ class InstanceQueryExecutor:
             block = self._execute_segments(query, segments, trace)
             if missing:
                 block.exceptions.append(
-                    f"SegmentMissingError: {sorted(missing)}")
+                    f"{SEGMENT_MISSING_EXC_PREFIX} {sorted(missing)}")
             elapsed_ms = (time.perf_counter() - t_start) * 1e3
             if elapsed_ms > timeout_ms:
                 block.exceptions.append(
@@ -72,6 +74,9 @@ class InstanceQueryExecutor:
             trace.record(ServerQueryPhase.QUERY_PROCESSING, elapsed_ms)
             dt = DataTable.from_block(query, block)
             dt.metadata["requestId"] = str(request.request_id)
+            if missing:
+                dt.metadata[MISSING_SEGMENTS_KEY] = json.dumps(
+                    sorted(missing))
             if request.enable_trace:
                 dt.metadata["traceInfo"] = trace.to_json_str()
             return dt

@@ -104,39 +104,49 @@ def _eligible_cube(segment, request: BrokerRequest, functions):
         if not (needed_dims <= set(cube.dimensions) and
                 needed_metrics <= set(cube.metrics)):
             continue
-        score = _prefix_score(segment, cube, leaves)
-        if cube.n_groups * 8 > segment.num_docs and score == 0:
-            # without prefix narrowing the cube must actually compress:
-            # scanning a cube nearly as tall as the segment costs more
-            # than the doc-scale kernel
+        score, frac = _prefix_narrowing(segment, cube, leaves)
+        if cube.n_groups * frac * 8 > segment.num_docs:
+            # a cube nearly as tall as the segment must be narrowed to a
+            # genuinely small block before it beats the doc-scale kernel:
+            # a prefix "hit" from one wide RANGE on the leading dim (e.g.
+            # dim >= 'A') would otherwise degrade to a near-full host scan
             continue
-        key = (score, -cube.n_groups)
+        key = (score, -cube.n_groups * frac)
         if best is None or key > best_score:
             best, best_score = cube, key
     return best
 
 
-def _prefix_score(segment, cube, leaves) -> int:
-    """How many leading split dims a conjunctive filter narrows — the
-    cube-choice metric (deeper prefix ⇒ smaller scanned blocks)."""
+def _prefix_narrowing(segment, cube, leaves) -> Tuple[int, float]:
+    """(depth, est fraction): how many leading split dims a conjunctive
+    filter narrows, and the estimated fraction of cube rows left after the
+    descent (product of per-dim dictId coverage under a uniform-ids
+    assumption). Depth ranks cube choice; the fraction gates eligibility so
+    a wide RANGE on the leading dim doesn't count as real narrowing."""
     if not leaves:
-        return 0
+        return 0, 1.0
     by_col = {}
     for lf in leaves:
         by_col.setdefault(lf.column, []).append(lf)
     score = 0
+    frac = 1.0
     for dim in cube.dimensions:
         ivs = None
+        ds = segment.data_source(dim)
         for lf in by_col.get(dim, ()):
-            ivs = _leaf_id_intervals(lf, segment.data_source(dim))
+            ivs = _leaf_id_intervals(lf, ds)
             if ivs is not None:
                 break
         if ivs is None:
             break
         score += 1
+        card = max(1, len(ds.dictionary)) if ds.dictionary is not None \
+            else 1
+        covered = sum(b - a for a, b in ivs)
+        frac *= min(1.0, covered / card)
         if not all(b - a == 1 for a, b in ivs):
             break                       # descent stops after an interval
-    return score
+    return score, frac
 
 
 def _conjunctive_leaves(tree: Optional[FilterQueryTree]
