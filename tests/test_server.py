@@ -134,11 +134,8 @@ def test_tokenbucket_prefers_higher_token_group():
     # pin balances: "hog" deeply in debt, "idle" fresh — then queue both
     # while the single worker is occupied so the drain order is decided
     # purely by token priority
-    with sched._lock:
-        sched._groups["hog"] = -1e6
-        sched._last_refresh["hog"] = time.monotonic()
-        sched._groups["idle"] = 0.0
-        sched._last_refresh["idle"] = time.monotonic()
+    sched.queue.group("hog").available_tokens = -1e6
+    sched.queue.group("idle").available_tokens = 100.0
     order = []
     f_hog = sched.submit("hog", lambda: order.append("hog"))
     f_idle = sched.submit("idle", lambda: order.append("idle"))
