@@ -103,8 +103,13 @@ class ResultSetGroup:
 class _HttpEndpoint:
     """One host:port with persistent keep-alive connections."""
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 tls_config=None):
         self.host, self.port, self.timeout = host, port, timeout
+        # TlsConfig → https with the configured CA/verification
+        # (parity: the reference client's ClientSSLContextGenerator)
+        self._ssl_ctx = tls_config.client_context() \
+            if tls_config is not None else None
         self._conn: Optional[http.client.HTTPConnection] = None
 
     def request(self, method: str, path: str, body: Optional[bytes] = None,
@@ -118,8 +123,13 @@ class _HttpEndpoint:
             idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
         for attempt in (0, 1):
             if self._conn is None:
-                self._conn = http.client.HTTPConnection(
-                    self.host, self.port, timeout=self.timeout)
+                if self._ssl_ctx is not None:
+                    self._conn = http.client.HTTPSConnection(
+                        self.host, self.port, timeout=self.timeout,
+                        context=self._ssl_ctx)
+                else:
+                    self._conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=self.timeout)
             try:
                 self._conn.request(method, path, body=body, headers=headers)
                 resp = self._conn.getresponse()
@@ -141,12 +151,14 @@ class _HttpEndpoint:
 class SimpleBrokerSelector:
     """Round-robin over the broker list (parity: SimpleBrokerSelector)."""
 
-    def __init__(self, endpoints: Sequence[Tuple[str, int]]):
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 tls_config=None):
         if not endpoints:
             raise PinotClientError("empty broker list")
         shuffled = list(endpoints)
         random.shuffle(shuffled)
-        self._endpoints = [_HttpEndpoint(h, p) for h, p in shuffled]
+        self._endpoints = [_HttpEndpoint(h, p, tls_config=tls_config)
+                           for h, p in shuffled]
         self._cycle = itertools.cycle(range(len(self._endpoints)))
 
     def select(self, table: Optional[str] = None) -> _HttpEndpoint:
@@ -195,8 +207,10 @@ class Connection:
         self._selector.close()
 
 
-def connect(brokers, token: Optional[str] = None) -> Connection:
-    """connect("host:port") / connect([("h", p), ...]) → Connection."""
+def connect(brokers, token: Optional[str] = None,
+            tls_config=None) -> Connection:
+    """connect("host:port") / connect([("h", p), ...]) → Connection.
+    `tls_config`: a common.tls.TlsConfig — the brokers serve https."""
     if isinstance(brokers, str):
         brokers = [brokers]
     endpoints = []
@@ -206,23 +220,27 @@ def connect(brokers, token: Optional[str] = None) -> Connection:
             endpoints.append((host, int(port)))
         else:
             endpoints.append(tuple(b))
-    return Connection(SimpleBrokerSelector(endpoints), token=token)
+    return Connection(SimpleBrokerSelector(endpoints,
+                                           tls_config=tls_config),
+                      token=token)
 
 
 def connect_dynamic(store_host: str, store_port: int,
-                    token: Optional[str] = None) -> Connection:
+                    token: Optional[str] = None,
+                    tls_config=None) -> Connection:
     """Connection that discovers brokers from the cluster's property
     store and follows membership changes (parity: ConnectionFactory
     .fromZookeeper → DynamicBrokerSelector)."""
-    return Connection(DynamicBrokerSelector(store_host, store_port),
+    return Connection(DynamicBrokerSelector(store_host, store_port,
+                                            tls_config=tls_config),
                       token=token)
 
 
 class ControllerClient:
     """Admin client for the controller REST API."""
 
-    def __init__(self, host: str, port: int):
-        self._endpoint = _HttpEndpoint(host, port)
+    def __init__(self, host: str, port: int, tls_config=None):
+        self._endpoint = _HttpEndpoint(host, port, tls_config=tls_config)
 
     def _json(self, method: str, path: str, body: Optional[bytes] = None,
               content_type: str = "application/json",
@@ -325,8 +343,10 @@ class DynamicBrokerSelector:
     LIVE = "/LIVEINSTANCES"
     BROKER_RESOURCE = "/BROKERRESOURCE"
 
-    def __init__(self, store_host: str, store_port: int):
+    def __init__(self, store_host: str, store_port: int,
+                 tls_config=None):
         from pinot_tpu.controller.store_client import RemotePropertyStore
+        self._tls_config = tls_config
         self._store = RemotePropertyStore(store_host, store_port)
         self._lock = _threading.Lock()
         self._brokers: Dict[str, Tuple[str, int]] = {}   # inst -> endpoint
@@ -377,7 +397,8 @@ class DynamicBrokerSelector:
     def _endpoint(self, addr: Tuple[str, int]) -> _HttpEndpoint:
         ep = self._endpoints.get(addr)
         if ep is None:
-            ep = self._endpoints[addr] = _HttpEndpoint(*addr)
+            ep = self._endpoints[addr] = _HttpEndpoint(
+                *addr, tls_config=self._tls_config)
         return ep
 
     def select(self, table: Optional[str] = None) -> _HttpEndpoint:

@@ -86,6 +86,12 @@ class HttpPinotFS(PinotFS):
 
     TIMEOUT_S = 30.0
 
+    def __init__(self, tls_config=None):
+        # parity: HttpsSegmentFetcher — an https deep store fetches with a
+        # client SSLContext from the configured CA / verification flag
+        self._ssl_ctx = tls_config.client_context() \
+            if tls_config is not None else None
+
     def _split(self, path: str):
         marker = "/deepstore/"
         i = path.find(marker)
@@ -98,7 +104,9 @@ class HttpPinotFS(PinotFS):
         import urllib.request
         base, rel = self._split(path)
         url = f"{base}/deepstore/{op}?path=" + urllib.parse.quote(rel)
-        with urllib.request.urlopen(url, timeout=self.TIMEOUT_S) as resp:
+        ctx = self._ssl_ctx if url.startswith("https:") else None
+        with urllib.request.urlopen(url, timeout=self.TIMEOUT_S,
+                                    context=ctx) as resp:
             return resp.read()
 
     def _stat(self, path: str) -> dict:

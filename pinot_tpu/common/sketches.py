@@ -123,6 +123,20 @@ class HyperLogLog:
             bool(np.array_equal(self.registers, other.registers))
 
 
+def union_serialized_hlls(hex_values) -> Optional["HyperLogLog"]:
+    """Union hex-serialized HLLs (the derived-HLL-column FASTHLL path:
+    each dictionary value of a derived column is one sketch). Returns
+    None when no sketches matched — a default-log2m empty sketch would
+    trip the log2m-mismatch assert when merged with a real segment's
+    sketch at a different configured log2m; AggregationFunction.merge
+    treats None as the identity."""
+    out: Optional[HyperLogLog] = None
+    for v in hex_values:
+        h = HyperLogLog.from_bytes(bytes.fromhex(str(v)))
+        out = h if out is None else out.merge(h)
+    return out
+
+
 class TDigest:
     """Merging t-digest (k1 arcsine scale) over (mean, weight) centroids."""
 

@@ -37,6 +37,10 @@ class IndexingConfig:
     # "v1" (file-per-index) | "v3" (single columns.psf container with
     # per-member DEFLATE — parity: SegmentVersion + ChunkCompressor)
     segment_version: str = "v1"
+    # parity: startree/hll HllConfig — {"columnsToDerive": [...],
+    # "log2m": N, "suffix": "_hll"}: the creator adds a derived column of
+    # per-row serialized HLLs per origin, targeted by the FASTHLL rewrite
+    hll_config: Optional[dict] = None
 
     def to_json(self) -> dict:
         return {
@@ -51,6 +55,7 @@ class IndexingConfig:
             "segmentPartitionConfig": {
                 "columnPartitionMap": self.segment_partition_config},
             "segmentFormatVersion": self.segment_version,
+            "hllConfig": self.hll_config,
         }
 
     @classmethod
@@ -68,6 +73,7 @@ class IndexingConfig:
             segment_partition_config=(d.get("segmentPartitionConfig") or {}
                                       ).get("columnPartitionMap", {}),
             segment_version=d.get("segmentFormatVersion", "v1"),
+            hll_config=d.get("hllConfig"),
         )
 
 

@@ -1,0 +1,94 @@
+"""TLS configuration for the HTTP planes.
+
+Parity: pinot-common/.../segment/fetcher/HttpsSegmentFetcher.java +
+ClientSSLContextGenerator — the reference configures a client SSLContext
+from PEM material (server CA cert, optional client cert/key for mTLS) and
+an `enable-server-verification` flag; the controller/server side terminates
+TLS at the embedded HTTP layer. Here both directions are driven by one
+TlsConfig mapped onto the stdlib `ssl` module, and the asyncio HTTP server
+(transport/http.py) passes the server context straight into
+asyncio.start_server(ssl=...).
+"""
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class TlsConfig:
+    """PEM file paths (None = feature off for that direction).
+
+    server_cert/server_key: the listening side's certificate chain + key.
+    ca_cert: trust anchor for verifying the PEER (client side: the server
+    CA — HttpsSegmentFetcher's `server.ca-cert`; server side: client CA
+    for mTLS).
+    client_cert/client_key: client certificate for mTLS.
+    verify_server: HttpsSegmentFetcher's enable-server-verification — when
+    False the client skips chain + hostname checks (the reference logs a
+    warning and disables verification; same trade here).
+    """
+    server_cert: Optional[str] = None
+    server_key: Optional[str] = None
+    ca_cert: Optional[str] = None
+    client_cert: Optional[str] = None
+    client_key: Optional[str] = None
+    verify_server: bool = True
+    require_client_cert: bool = False
+
+    # -- context builders --------------------------------------------------
+    def server_context(self) -> Optional[ssl.SSLContext]:
+        if not (self.server_cert and self.server_key):
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.server_cert, self.server_key)
+        if self.require_client_cert:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            if self.ca_cert:
+                ctx.load_verify_locations(self.ca_cert)
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        ctx = ssl.create_default_context()
+        if not self.verify_server:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_cert:
+            ctx.load_verify_locations(self.ca_cert)
+        if self.client_cert and self.client_key:
+            ctx.load_cert_chain(self.client_cert, self.client_key)
+        return ctx
+
+    def to_json(self) -> dict:
+        return {"serverCert": self.server_cert, "serverKey": self.server_key,
+                "caCert": self.ca_cert, "clientCert": self.client_cert,
+                "clientKey": self.client_key,
+                "verifyServer": self.verify_server,
+                "requireClientCert": self.require_client_cert}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TlsConfig":
+        return cls(server_cert=d.get("serverCert"),
+                   server_key=d.get("serverKey"),
+                   ca_cert=d.get("caCert"),
+                   client_cert=d.get("clientCert"),
+                   client_key=d.get("clientKey"),
+                   verify_server=d.get("verifyServer", True),
+                   require_client_cert=d.get("requireClientCert", False))
+
+
+def generate_self_signed(dir_path: str, cn: str = "localhost"
+                         ) -> TlsConfig:
+    """Self-signed cert/key pair via the openssl CLI (test/dev helper —
+    production deployments bring their own PEMs)."""
+    import os
+    import subprocess
+    cert = os.path.join(dir_path, "server.crt")
+    key = os.path.join(dir_path, "server.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "2", "-subj", f"/CN={cn}",
+         "-addext", f"subjectAltName=DNS:{cn},IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return TlsConfig(server_cert=cert, server_key=key, ca_cert=cert)

@@ -201,5 +201,16 @@ def make_record_reader(path: str, fmt: str,
     if fmt == "avro":
         from pinot_tpu.ingestion.avro import AvroRecordReader
         return AvroRecordReader(path)
+    if fmt == "thrift":
+        from pinot_tpu.ingestion.thrift import (ThriftRecordReader,
+                                                ThriftRecordReaderConfig)
+        cfg = kw.pop("config", None)
+        if cfg is None:
+            fields = kw.pop("fields", None)
+            if fields is None:
+                raise ValueError("thrift reader needs config= or fields=")
+            cfg = ThriftRecordReaderConfig(fields)
+        return ThriftRecordReader(path, cfg, schema)
     raise ValueError(
-        f"unsupported input format {fmt!r} (csv, json, avro, parquet, orc)")
+        f"unsupported input format {fmt!r} "
+        "(csv, json, avro, parquet, orc, thrift)")

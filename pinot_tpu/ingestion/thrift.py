@@ -1,0 +1,198 @@
+"""Dependency-free Thrift TBinaryProtocol record reader (+ writer for
+round-trip tests).
+
+Parity: pinot-core/.../core/data/readers/ThriftRecordReader.java — the
+reference deserializes a file of back-to-back TBinaryProtocol-serialized
+structs using a generated Thrift class and maps field NAMES to field IDS
+by probing `tObject.fieldForId(index)` for index = 1, 2, ... There is no
+Thrift runtime (or code generator) in this environment, so the TPU build
+decodes the binary protocol directly — the wire format is a simple tagged
+field list — and takes the name→id mapping from the reader config
+(ThriftRecordReaderConfig.java's `thriftClass` becomes an explicit
+field-name list / map, ids defaulting to 1-based order exactly like the
+reference's probing loop).
+
+Wire format (struct, non-strict binary protocol):
+    repeat:  [ttype: i8] [field-id: i16 BE] [value]
+    until    ttype == 0 (STOP)
+value encodings: BOOL 1B, BYTE i8, I16/I32/I64 BE, DOUBLE 8B BE,
+STRING [len: i32 BE][utf-8 bytes], STRUCT nested field list,
+LIST/SET [etype: i8][count: i32 BE][elements], MAP [kt][vt][count][pairs].
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional, Sequence, Union
+
+from pinot_tpu.ingestion.record_reader import RecordReader
+
+# TType constants (thrift/protocol/TType)
+STOP, VOID, BOOL, BYTE, DOUBLE = 0, 1, 2, 3, 4
+I16, I32, I64, STRING, STRUCT, MAP, SET, LIST = 6, 8, 10, 11, 12, 13, 14, 15
+
+
+class ThriftRecordReaderConfig:
+    """Field-id mapping for a Thrift struct.
+
+    `fields` is either an ordered name sequence (ids 1..N, matching the
+    reference's fieldForId(1..) probing) or an explicit {name: id} map.
+    """
+
+    def __init__(self, fields: Union[Sequence[str], Dict[str, int]]):
+        if isinstance(fields, dict):
+            self.field_ids = dict(fields)
+        else:
+            self.field_ids = {name: i + 1 for i, name in enumerate(fields)}
+
+
+class _BinaryProtocolReader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        b = self.buf[self.pos: self.pos + n]
+        if len(b) != n:
+            raise EOFError("truncated thrift data")
+        self.pos += n
+        return b
+
+    def read_value(self, ttype: int):
+        if ttype == BOOL:
+            return self._take(1)[0] != 0
+        if ttype == BYTE:
+            return struct.unpack(">b", self._take(1))[0]
+        if ttype == I16:
+            return struct.unpack(">h", self._take(2))[0]
+        if ttype == I32:
+            return struct.unpack(">i", self._take(4))[0]
+        if ttype == I64:
+            return struct.unpack(">q", self._take(8))[0]
+        if ttype == DOUBLE:
+            return struct.unpack(">d", self._take(8))[0]
+        if ttype == STRING:
+            n = struct.unpack(">i", self._take(4))[0]
+            raw = self._take(n)
+            try:
+                return raw.decode("utf-8")
+            except UnicodeDecodeError:
+                return raw                      # BINARY field
+        if ttype == STRUCT:
+            return self.read_struct()
+        if ttype in (LIST, SET):
+            etype = self._take(1)[0]
+            n = struct.unpack(">i", self._take(4))[0]
+            return [self.read_value(etype) for _ in range(n)]
+        if ttype == MAP:
+            kt, vt = self._take(1)[0], self._take(1)[0]
+            n = struct.unpack(">i", self._take(4))[0]
+            return {self.read_value(kt): self.read_value(vt)
+                    for _ in range(n)}
+        raise ValueError(f"unsupported thrift type {ttype}")
+
+    def read_struct(self) -> Dict[int, object]:
+        """field-id → decoded value (ids keep the wire numbering)."""
+        out: Dict[int, object] = {}
+        while True:
+            ttype = self._take(1)[0]
+            if ttype == STOP:
+                return out
+            fid = struct.unpack(">h", self._take(2))[0]
+            out[fid] = self.read_value(ttype)
+
+    @property
+    def exhausted(self) -> bool:
+        return self.pos >= len(self.buf)
+
+
+class ThriftRecordReader(RecordReader):
+    """Reads back-to-back TBinaryProtocol structs into row dicts.
+
+    Parity: ThriftRecordReader.java — next() deserializes one struct and
+    projects the configured fields by id; unknown wire fields are skipped
+    (decoded and dropped), absent fields yield None.
+    """
+
+    def __init__(self, path: str, config: ThriftRecordReaderConfig,
+                 schema=None):
+        self.path = path
+        self.config = config
+        self.schema = schema
+
+    def _rows(self) -> Iterator[dict]:
+        with open(self.path, "rb") as fh:
+            proto = _BinaryProtocolReader(fh.read())
+        names = self.config.field_ids
+        wanted = (set(names) if self.schema is None
+                  else {f.name for f in self.schema.fields} & set(names))
+        while not proto.exhausted:
+            rec = proto.read_struct()
+            yield {name: rec.get(names[name]) for name in wanted}
+
+
+# ---------------------------------------------------------------------------
+# Writer (tests / datagen): encode rows as TBinaryProtocol structs
+# ---------------------------------------------------------------------------
+
+
+def _ttype_of(v) -> int:
+    if isinstance(v, bool):
+        return BOOL
+    if isinstance(v, int):
+        return I64
+    if isinstance(v, float):
+        return DOUBLE
+    if isinstance(v, (str, bytes)):
+        return STRING
+    if isinstance(v, (list, tuple)):
+        return LIST
+    if isinstance(v, dict):
+        return MAP
+    raise TypeError(f"unsupported thrift value {type(v)}")
+
+
+def _encode_value(v, out: List[bytes]) -> None:
+    t = _ttype_of(v)
+    if t == BOOL:
+        out.append(b"\x01" if v else b"\x00")
+    elif t == I64:
+        out.append(struct.pack(">q", v))
+    elif t == DOUBLE:
+        out.append(struct.pack(">d", v))
+    elif t == STRING:
+        raw = v.encode("utf-8") if isinstance(v, str) else v
+        out.append(struct.pack(">i", len(raw)))
+        out.append(raw)
+    elif t == LIST:
+        etype = _ttype_of(v[0]) if v else STRING
+        out.append(struct.pack(">bi", etype, len(v)))
+        for e in v:
+            _encode_value(e, out)
+    elif t == MAP:
+        items = list(v.items())
+        kt = _ttype_of(items[0][0]) if items else STRING
+        vt = _ttype_of(items[0][1]) if items else STRING
+        out.append(struct.pack(">bbi", kt, vt, len(items)))
+        for k, val in items:
+            _encode_value(k, out)
+            _encode_value(val, out)
+
+
+def write_thrift_records(path: str, rows: Sequence[dict],
+                         field_ids: Optional[Dict[str, int]] = None) -> None:
+    """Serialize rows as back-to-back TBinaryProtocol structs (None
+    fields are omitted, like an unset optional thrift field)."""
+    if field_ids is None:
+        names = sorted({k for r in rows for k in r})
+        field_ids = {n: i + 1 for i, n in enumerate(names)}
+    out: List[bytes] = []
+    for row in rows:
+        for name, fid in field_ids.items():
+            v = row.get(name)
+            if v is None:
+                continue
+            out.append(struct.pack(">bh", _ttype_of(v), fid))
+            _encode_value(v, out)
+        out.append(b"\x00")                     # STOP
+    with open(path, "wb") as fh:
+        fh.write(b"".join(out))

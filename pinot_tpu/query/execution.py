@@ -129,9 +129,20 @@ def _finish_aggregation(plan, outs, blk) -> None:
             inters.append(f.from_histogram(np.asarray(outs[f"agg{i}"]), tv))
         elif source in ("sv", "mv") and fname in (
                 "sum", "avg", "percentile", "distinctcount"):
-            dict_vals = plan.segment.data_source(col).dictionary.values
-            inters.append(f.from_histogram(np.asarray(outs[f"agg{i}"]),
-                                           dict_vals))
+            ds = plan.segment.data_source(col)
+            dict_vals = ds.dictionary.values
+            if f.info.base == "FASTHLL" and \
+                    getattr(ds.metadata, "derived_metric_type",
+                            None) == "HLL":
+                # derived serialized-HLL column (BrokerRequestPreProcessor
+                # rewrite): union the sketches of present dictionary values
+                from pinot_tpu.common.sketches import union_serialized_hlls
+                hist = np.asarray(outs[f"agg{i}"])[: len(dict_vals)]
+                inters.append(union_serialized_hlls(
+                    np.asarray(dict_vals)[np.nonzero(hist)[0]]))
+            else:
+                inters.append(f.from_histogram(np.asarray(outs[f"agg{i}"]),
+                                               dict_vals))
         elif source in ("sv", "mv") and fname in ("min", "max", "minmaxrange"):
             dict_vals = plan.segment.data_source(col).dictionary.values
             card = len(dict_vals)

@@ -36,6 +36,8 @@ class ServerQueryExecutor:
                 trace: Optional[Trace] = None) -> IntermediateResultsBlock:
         trace = trace if trace is not None else make_trace(False)
         t0 = time.perf_counter()
+        from pinot_tpu.query.plan import preprocess_request
+        preprocess_request(segments, request)   # FASTHLL derived rewrite
         with trace.span(ServerQueryPhase.SEGMENT_PRUNING):
             selected = self.pruner.prune(segments, request)
         num_pruned = len(segments) - len(selected)

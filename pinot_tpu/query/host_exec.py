@@ -237,6 +237,16 @@ def _masked_values(segment: ImmutableSegment, col: str, mask: np.ndarray
     return ds.dictionary.values[ds.dict_ids[mask]]
 
 
+def _hll_derived(segment: ImmutableSegment, col: str) -> bool:
+    """True when `col` is a derived serialized-HLL column (its values are
+    hex sketches to union, not raw values to hash)."""
+    try:
+        cm = segment.data_source(col).metadata
+    except KeyError:
+        return False
+    return getattr(cm, "derived_metric_type", None) == "HLL"
+
+
 def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
                mask: np.ndarray):
     base = f.info.base
@@ -263,6 +273,9 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
     if base == "DISTINCTCOUNT":
         return set(_plain(v) for v in np.unique(vals))
     if base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):
+        if base == "FASTHLL" and _hll_derived(segment, f.column):
+            from pinot_tpu.common.sketches import union_serialized_hlls
+            return union_serialized_hlls(np.unique(vals))
         return HyperLogLog.from_values(np.unique(vals))
     if base == "PERCENTILE":
         uniq, counts = np.unique(vals, return_counts=True)
@@ -451,7 +464,12 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
                 if base == "DISTINCTCOUNT":
                     items[gi] = set(_plain(v) for v in np.unique(sel))
                 elif base in ("DISTINCTCOUNTHLL", "FASTHLL", "DISTINCTCOUNTRAWHLL"):
-                    items[gi] = HyperLogLog.from_values(np.unique(sel))
+                    if base == "FASTHLL" and _hll_derived(segment, f.column):
+                        from pinot_tpu.common.sketches import \
+                            union_serialized_hlls
+                        items[gi] = union_serialized_hlls(np.unique(sel))
+                    else:
+                        items[gi] = HyperLogLog.from_values(np.unique(sel))
                 elif base == "PERCENTILE":
                     u, c = np.unique(sel, return_counts=True)
                     items[gi] = {_plain(x): int(y) for x, y in zip(u, c)}

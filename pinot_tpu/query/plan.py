@@ -324,6 +324,33 @@ class SegmentPlan:
         return execution.execute_segment_plan(self)
 
 
+def preprocess_request(segments, request) -> None:
+    """Parity: core/plan/maker/BrokerRequestPreProcessor.preProcess —
+    rewrite FASTHLL(col) to the derived serialized-HLL column recorded in
+    segment metadata (consistency-checked across the segment set); applied
+    in place before planning, exactly like the reference."""
+    if not request.aggregations:
+        return
+    for agg in request.aggregations:
+        if agg.function_name.upper() != "FASTHLL":
+            continue
+        derived = None
+        first_name = None
+        for i, seg in enumerate(segments):
+            md = getattr(seg, "metadata", None)
+            d = md.get_derived_column(agg.column, "HLL") \
+                if hasattr(md, "get_derived_column") else None
+            if i == 0:
+                derived, first_name = d, getattr(seg, "segment_name", "?")
+            elif d != derived:
+                raise RuntimeError(
+                    "Found inconsistency HLL derived column name. In "
+                    f"segment {first_name}: {derived}; in segment "
+                    f"{getattr(seg, 'segment_name', '?')}: {d}")
+        if derived is not None:
+            agg.column = derived
+
+
 class InstancePlanMaker:
     """Builds a SegmentPlan per segment for a BrokerRequest.
 

@@ -36,6 +36,11 @@ class ColumnMetadata:
     num_partitions: int = 0
     partitions: List[int] = dataclasses.field(default_factory=list)
     default_null_value: Optional[object] = None
+    # derived-metric columns (parity: MetricFieldSpec.DerivedMetricType —
+    # e.g. an HLL column holding per-row serialized sketches of
+    # `derived_from`, targeted by the FASTHLL broker-request rewrite)
+    derived_metric_type: Optional[str] = None
+    derived_from: Optional[str] = None
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
@@ -73,6 +78,16 @@ class SegmentMetadata:
 
     def column(self, name: str) -> ColumnMetadata:
         return self.columns[name]
+
+    def get_derived_column(self, origin: str,
+                           metric_type: str = "HLL") -> Optional[str]:
+        """Derived-column lookup (parity: SegmentMetadataImpl
+        .getDerivedColumn — the FASTHLL rewrite's metadata source)."""
+        for cm in self.columns.values():
+            if cm.derived_from == origin and \
+                    cm.derived_metric_type == metric_type:
+                return cm.name
+        return None
 
     def to_json(self) -> dict:
         return {

@@ -92,16 +92,18 @@ class HttpServer:
 
     MAX_BODY = 512 * 1024 * 1024     # segments upload through this path
 
-    def __init__(self, host: str, port: int, router: HttpRouter):
+    def __init__(self, host: str, port: int, router: HttpRouter,
+                 ssl_context=None):
         self.host = host
         self.port = port
         self.router = router
+        self.ssl_context = ssl_context   # ssl.SSLContext → serve https
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._serve, self.host, self.port)
+            self._serve, self.host, self.port, ssl=self.ssl_context)
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -232,10 +234,16 @@ class ApiServer:
         self._loop = None
         self._server: Optional[HttpServer] = None
         self.port: Optional[int] = None
+        self.tls_config = None           # TlsConfig → serve https
 
-    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              tls_config=None) -> int:
+        if tls_config is not None:
+            self.tls_config = tls_config
+        ssl_ctx = self.tls_config.server_context() \
+            if self.tls_config is not None else None
         self._loop = self._loop_cls()
-        self._server = HttpServer(host, port, self.router)
+        self._server = HttpServer(host, port, self.router, ssl_ctx)
         self._loop.run(self._server.start())
         self.port = self._server.port
         return self.port

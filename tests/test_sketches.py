@@ -213,3 +213,90 @@ def test_distinctcountrawhll_group_by_orders_by_estimate(hetero_segments):
                   key=lambda kv: -kv[1])
     # top-2 groups must be the highest-estimate groups, same estimates
     assert got == ests[:2]
+
+
+# -- FASTHLL derived-column rewrite (BrokerRequestPreProcessor parity) ------
+
+@pytest.fixture(scope="module")
+def hll_derived_segments():
+    """Segments built with an HllConfig: playerName gets a derived
+    playerName_hll column of per-row serialized sketches."""
+    base = tempfile.mkdtemp()
+    segs, all_names = [], []
+    cfg = make_table_config()
+    cfg.indexing_config.hll_config = {
+        "columnsToDerive": ["playerName"], "log2m": 11, "suffix": "_hll"}
+    for i in range(2):
+        n = 3000
+        rng = np.random.default_rng(300 + i)
+        names = np.array([f"p{i}_{j % 900}" for j in
+                          rng.integers(0, 900, n)], dtype=object)
+        cols = {
+            "teamID": np.array(rng.choice(["BOS", "NYA"], n), dtype=object),
+            "league": np.array(["AL"] * n, dtype=object),
+            "playerName": names,
+            "position": [["P"]] * n,
+            "runs": rng.integers(0, 100, n).astype(np.int32),
+            "hits": rng.integers(0, 250, n).astype(np.int64),
+            "average": np.round(rng.random(n), 3),
+            "salary": (rng.random(n).astype(np.float32) * 1e6).round(2),
+            "yearID": rng.integers(1990, 2020, n).astype(np.int32),
+        }
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        SegmentCreator(make_schema(), cfg, f"hllder_{i}").build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        all_names.append(names)
+    return segs, np.concatenate(all_names)
+
+
+def test_hll_derived_column_built_and_recorded(hll_derived_segments):
+    segs, _names = hll_derived_segments
+    md = segs[0].metadata
+    assert md.get_derived_column("playerName", "HLL") == "playerName_hll"
+    cm = md.columns["playerName_hll"]
+    assert cm.derived_from == "playerName"
+    assert cm.derived_metric_type == "HLL"
+    # the derived column's values are valid serialized sketches
+    from pinot_tpu.common.sketches import HyperLogLog
+    v0 = segs[0].data_source("playerName_hll").dictionary.values[0]
+    h = HyperLogLog.from_bytes(bytes.fromhex(str(v0)))
+    assert h.log2m == 11 and 0.5 < h.cardinality() < 2.5
+
+
+def test_fasthll_rewrite_and_union(hll_derived_segments):
+    """FASTHLL(playerName) is rewritten to the derived column and answered
+    by UNIONING serialized sketches (estimate within HLL error of truth,
+    and identical to hashing the raw values at the same log2m)."""
+    segs, names = hll_derived_segments
+    true_distinct = len(np.unique(names))
+    eng = QueryEngine(segs)
+    resp = eng.query("SELECT FASTHLL(playerName) FROM baseballStats")
+    est = int(resp.aggregation_results[0].value)
+    assert abs(est - true_distinct) / true_distinct < 0.1
+    # the rewrite actually happened: the result column names the derived
+    # column (reference parity: the request is mutated in place)
+    assert "playerName_hll" in resp.aggregation_results[0].function
+
+
+def test_fasthll_rewrite_consistency_check():
+    """Segments disagreeing on the derived column raise (reference throws
+    on inconsistent HLL derived column names)."""
+    base = tempfile.mkdtemp()
+    cfg_with = make_table_config()
+    cfg_with.indexing_config.hll_config = {
+        "columnsToDerive": ["playerName"], "log2m": 10, "suffix": "_hll"}
+    cfg_without = make_table_config()
+    segs = []
+    for i, cfg in enumerate((cfg_with, cfg_without)):
+        d = os.path.join(base, f"seg{i}")
+        os.makedirs(d)
+        from fixtures import make_columns
+        SegmentCreator(make_schema(), cfg, f"inc_{i}").build(
+            make_columns(500, seed=i), d)
+        segs.append(ImmutableSegmentLoader.load(d))
+    from pinot_tpu.query.plan import preprocess_request
+    from pinot_tpu.pql.parser import compile_pql
+    req = compile_pql("SELECT FASTHLL(playerName) FROM baseballStats")
+    with pytest.raises(RuntimeError, match="inconsistency"):
+        preprocess_request(segs, req)
