@@ -49,7 +49,14 @@ class BrokerClusterWatcher:
         if config is None:
             return
         rc = config.routing_config
-        builder = make_routing_builder(rc.builder_name, rc.options)
+
+        def partition_lookup(segment: str, _t=table):
+            """Segment -> recorded partition-id union across partitioned
+            columns (the PartitionAware builder's grouping key)."""
+            return self.partition_pruner.segment_partitions(_t, segment)
+
+        builder = make_routing_builder(rc.builder_name, rc.options,
+                                       partition_lookup=partition_lookup)
         target = builder if builder is not None else self.routing.builder
         # builder-kind comparison: re-applying the same kind would only
         # churn (option-only changes take effect on broker restart)
@@ -127,6 +134,18 @@ class PartitionZKMetadataPruner:
             self._schemas[table] = self.manager.get_schema(
                 raw_table(table))
         return self._schemas[table]
+
+    def segment_partitions(self, table: str, segment: str):
+        """Recorded partition-id union across a segment's partitioned
+        columns, or None — the public lookup the partition-aware routing
+        builder groups by (same cache the pruner reads)."""
+        pm = self._table_meta(table).get(segment)
+        if not pm:
+            return None
+        ids = set()
+        for info in pm.values():
+            ids.update(info.get("partitions") or ())
+        return ids or None
 
     def prune(self, request, table: str, segments):
         try:

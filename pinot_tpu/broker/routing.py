@@ -78,6 +78,71 @@ class ReplicaGroupRoutingTableBuilder(RoutingTableBuilder):
         return tables
 
 
+class PartitionAwareRoutingTableBuilder(RoutingTableBuilder):
+    """True partition-aware routing (parity:
+    PartitionAwareOfflineRoutingTableBuilder.java:69).
+
+    Segments are grouped by their recorded partition-id set and each
+    group is assigned to the FEWEST live servers that can host it
+    (greedy max-coverage over replicas). With partition-pure segments
+    this lands every partition on one server per routing table, so after
+    the broker's partition pruner empties non-matching servers the
+    scatter contacts exactly the servers hosting matching partitions —
+    fan-out reduction at ROUTING time, not just segment elimination.
+    Unpartitioned segments fall back to least-loaded balancing.
+    `partition_lookup(segment) -> iterable of partition ids | None` is
+    wired by the broker's cluster watcher from segment ZK metadata.
+    """
+
+    def __init__(self, partition_lookup, num_tables: int = 10):
+        self.partition_lookup = partition_lookup
+        self.num_tables = num_tables
+
+    def build(self, view: TableView, rng: random.Random
+              ) -> List[RoutingTable]:
+        groups: Dict[tuple, List[str]] = {}
+        loose: List[str] = []
+        for s in view.segments():
+            try:
+                p = self.partition_lookup(s)
+            except Exception:  # noqa: BLE001 — metadata issues fail open
+                p = None
+            if p:
+                groups.setdefault(tuple(sorted(p)), []).append(s)
+            else:
+                loose.append(s)
+        tables: List[RoutingTable] = []
+        for _ in range(self.num_tables):
+            rt: RoutingTable = {}
+            for _pids, group in sorted(groups.items()):
+                remaining = set(group)
+                while remaining:
+                    cover: Dict[str, List[str]] = {}
+                    for s in remaining:
+                        for srv in view.servers_for(
+                                s, states=(ONLINE, CONSUMING)):
+                            cover.setdefault(srv, []).append(s)
+                    if not cover:
+                        break            # no live replica for the rest
+                    best_n = max(len(v) for v in cover.values())
+                    # random tie-break spreads partitions over replicas
+                    # across the N pre-computed tables
+                    best = rng.choice(sorted(
+                        srv for srv, v in cover.items()
+                        if len(v) == best_n))
+                    rt.setdefault(best, []).extend(sorted(cover[best]))
+                    remaining -= set(cover[best])
+            for s in loose:
+                servers = view.servers_for(s, states=(ONLINE, CONSUMING))
+                if not servers:
+                    continue
+                candidates = rng.sample(servers, min(2, len(servers)))
+                best = min(candidates, key=lambda x: len(rt.get(x, [])))
+                rt.setdefault(best, []).append(s)
+            tables.append(rt)
+        return tables
+
+
 class LargeClusterRoutingTableBuilder(RoutingTableBuilder):
     """Cap each routing table to a bounded server subset.
 
@@ -119,7 +184,8 @@ class LargeClusterRoutingTableBuilder(RoutingTableBuilder):
 
 
 def make_routing_builder(name: Optional[str],
-                         options: Optional[Dict[str, str]] = None
+                         options: Optional[Dict[str, str]] = None,
+                         partition_lookup=None
                          ) -> Optional[RoutingTableBuilder]:
     """Resolve a table config's routingTableBuilderName (parity:
     RoutingTableBuilderFactory). None/unknown -> broker default."""
@@ -128,6 +194,9 @@ def make_routing_builder(name: Optional[str],
     if key in ("balanced", "balancedrandom", "defaultoffline",
                "defaultrealtime"):
         return BalancedRandomRoutingTableBuilder()
+    if key in ("partitionawareoffline", "partitionawarerealtime") and \
+            partition_lookup is not None:
+        return PartitionAwareRoutingTableBuilder(partition_lookup)
     if key in ("replicagroup", "partitionawareoffline",
                "partitionawarerealtime"):
         return ReplicaGroupRoutingTableBuilder()

@@ -12,8 +12,12 @@ from typing import Dict, List
 
 class SegmentAssignmentStrategy:
     def assign(self, segment: str, instances: List[str], replicas: int,
-               current: Dict[str, Dict[str, str]]) -> List[str]:
-        """→ the instances that should host `segment`."""
+               current: Dict[str, Dict[str, str]],
+               partition_ids=None) -> List[str]:
+        """→ the instances that should host `segment`. `partition_ids`:
+        the segment's recorded partition-id set (None/empty when the
+        table is unpartitioned); only partition-aware strategies use it.
+        """
         raise NotImplementedError
 
 
@@ -21,7 +25,8 @@ class BalancedNumSegmentAssignment(SegmentAssignmentStrategy):
     """Pick the `replicas` least-loaded instances (segment count)."""
 
     def assign(self, segment: str, instances: List[str], replicas: int,
-               current: Dict[str, Dict[str, str]]) -> List[str]:
+               current: Dict[str, Dict[str, str]],
+               partition_ids=None) -> List[str]:
         if not instances:
             raise ValueError("no live server instances to assign to")
         load = {inst: 0 for inst in instances}
@@ -38,7 +43,8 @@ class RandomSegmentAssignment(SegmentAssignmentStrategy):
         self._rng = random.Random(seed)
 
     def assign(self, segment: str, instances: List[str], replicas: int,
-               current: Dict[str, Dict[str, str]]) -> List[str]:
+               current: Dict[str, Dict[str, str]],
+               partition_ids=None) -> List[str]:
         if not instances:
             raise ValueError("no live server instances to assign to")
         k = min(replicas, len(instances))
@@ -50,7 +56,8 @@ class ReplicaGroupSegmentAssignment(SegmentAssignmentStrategy):
     segment once, spread within the group by least-load."""
 
     def assign(self, segment: str, instances: List[str], replicas: int,
-               current: Dict[str, Dict[str, str]]) -> List[str]:
+               current: Dict[str, Dict[str, str]],
+               partition_ids=None) -> List[str]:
         if not instances:
             raise ValueError("no live server instances to assign to")
         instances = sorted(instances)
@@ -64,9 +71,38 @@ class ReplicaGroupSegmentAssignment(SegmentAssignmentStrategy):
         return sorted(min(g, key=lambda i: (load[i], i)) for g in groups)
 
 
+class PartitionAwareSegmentAssignment(SegmentAssignmentStrategy):
+    """Same-partition segments land on the same `replicas`-sized instance
+    subset (instance index = (partition + r) % n over the sorted live
+    list), so the broker's PartitionAwareRoutingTableBuilder can route a
+    partition-pruned query to exactly one server per partition.
+
+    Parity: ReplicaGroupSegmentAssignmentStrategy with partition-level
+    replica groups (ReplicaGroupStrategyConfig.partitionColumn) — the
+    assignment half of the reference's partition-aware routing.
+    Unpartitioned segments fall back to balanced assignment."""
+
+    def __init__(self):
+        self._fallback = BalancedNumSegmentAssignment()
+
+    def assign(self, segment: str, instances: List[str], replicas: int,
+               current: Dict[str, Dict[str, str]],
+               partition_ids=None) -> List[str]:
+        if not instances:
+            raise ValueError("no live server instances to assign to")
+        if not partition_ids:
+            return self._fallback.assign(segment, instances, replicas,
+                                         current)
+        inst = sorted(instances)
+        p = min(partition_ids)
+        k = min(replicas, len(inst))
+        return sorted(inst[(p + r) % len(inst)] for r in range(k))
+
+
 def make_assignment(name: str = "balanced") -> SegmentAssignmentStrategy:
     return {
         "balanced": BalancedNumSegmentAssignment,
         "random": RandomSegmentAssignment,
         "replicagroup": ReplicaGroupSegmentAssignment,
+        "partitionaware": PartitionAwareSegmentAssignment,
     }[name]()

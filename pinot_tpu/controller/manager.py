@@ -106,6 +106,11 @@ class ResourceManager:
             raise InvalidTableConfigError(
                 f"server tenant {tenant} has no live tagged instances")
         self.store.set(f"{TABLE_CONFIGS}/{table}", config.to_json())
+        builder = (config.routing_config.builder_name or "").lower()
+        if assignment == "balanced" and "partitionaware" in builder:
+            # partition-aware routing needs its assignment half: same-
+            # partition segments co-located so routing can isolate them
+            assignment = "partitionaware"
         self._assignments[table] = make_assignment(assignment)
         self.coordinator.set_ideal_state(table,
                                          self.coordinator.ideal_state(table))
@@ -242,7 +247,10 @@ class ResourceManager:
 
             self.coordinator.update_ideal_state(table, offline)
         else:
-            assigned = strategy.assign(name, servers, replicas, current)
+            pids = {p for info in partition_meta.values()
+                    for p in info["partitions"]}
+            assigned = strategy.assign(name, servers, replicas, current,
+                                       partition_ids=pids or None)
 
         def add(segments):
             segments[name] = {inst: ONLINE for inst in assigned}
@@ -390,7 +398,12 @@ class ResourceManager:
                 # artifact')
                 target[seg] = dict(cur)
                 continue
-            assigned = strategy.assign(seg, servers, replicas, target)
+            pm = (self.segment_metadata(table, seg) or {}).get(
+                "partitionMetadata") or {}
+            pids = {p for info in pm.values()
+                    for p in info.get("partitions") or ()}
+            assigned = strategy.assign(seg, servers, replicas, target,
+                                       partition_ids=pids or None)
             target[seg] = {inst: ONLINE for inst in assigned}
         if dry_run:
             return target

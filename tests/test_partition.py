@@ -145,3 +145,61 @@ def test_broker_partition_pruning_end_to_end():
                 assert r.num_segments_processed <= 1
     finally:
         cluster.stop()
+
+
+def test_partition_aware_routing_reduces_server_fanout():
+    """PartitionAwareOfflineRoutingTableBuilder parity: with multiple
+    segments PER PARTITION spread over several servers, the partition-
+    aware builder lands each partition's segments on few servers, so a
+    partition-pruned EQ query contacts exactly ONE server — while an
+    unfiltered query still fans out to all of them."""
+    base = tempfile.mkdtemp()
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=3)
+    try:
+        cluster.add_schema(make_schema())
+        cfg = _partitioned_table_config()
+        cfg.routing_config.builder_name = "PartitionAwareOffline"
+        cluster.add_table(cfg)
+        teams = ["BOS", "NYA", "DET", "SFN", "CLE", "CHc"]
+        by_part = {}
+        for t in teams:
+            by_part.setdefault(_team_partition(t), []).append(t)
+        assert len(by_part) >= 2
+        # TWO segments per partition: segment pruning alone would leave
+        # them wherever balanced routing spread them; the partition-aware
+        # builder must co-locate them
+        expected = {}
+        for i, (p, ts) in enumerate(sorted(by_part.items())):
+            for half in range(2):
+                n = 1024
+                cols = make_shared_columns(n, seed=10 * i + half)
+                cols["teamID"] = np.array(
+                    [ts[j % len(ts)] for j in range(n)], dtype=object)
+                d = os.path.join(base, f"part_{p}_{half}")
+                SegmentCreator(make_schema(), cfg,
+                               segment_name=f"part_{p}_{half}").build(
+                    cols, d)
+                cluster.upload_segment("baseballStats_OFFLINE", d)
+                for t in ts:
+                    expected[t] = expected.get(t, 0) + int(
+                        (cols["teamID"] == t).sum())
+        from pinot_tpu.broker.routing import \
+            PartitionAwareRoutingTableBuilder
+        assert isinstance(
+            cluster.broker.routing.table_builder("baseballStats_OFFLINE"),
+            PartitionAwareRoutingTableBuilder)
+        for p, ts in sorted(by_part.items()):
+            for t in ts:
+                r = cluster.query("SELECT COUNT(*) FROM baseballStats "
+                                  f"WHERE teamID = '{t}'")
+                assert int(r.aggregation_results[0].value) == expected[t]
+                assert r.num_segments_processed <= 2
+                # the routing-time win: one server holds the partition
+                assert r.num_servers_queried == 1, \
+                    f"team {t} (partition {p}) fanned out to " \
+                    f"{r.num_servers_queried} servers"
+        # full scan still covers every segment
+        r = cluster.query("SELECT COUNT(*) FROM baseballStats")
+        assert int(r.aggregation_results[0].value) == sum(expected.values())
+    finally:
+        cluster.stop()
