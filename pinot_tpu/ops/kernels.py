@@ -192,16 +192,23 @@ def _chunked_int_sum(x):
 def _part_sums(part_lanes, mask):
     """Masked exact sums of 7-bit part lanes.
 
-    part_lanes: list of 1-D [P] int lanes; returns int32 [T1, n_parts]
-    chunk partials. Per-lane processing keeps every intermediate 1-D or
-    [T, BLOCK]-shaped (no small-extent tile axes).
+    part_lanes: [n_parts, P] int8 array (or a list of [P] lanes, stacked
+    cheaply as inputs); returns int32 [T1, n_parts] chunk partials.
+
+    ONE reduce op over ONE elementwise producer — never a stack/concat
+    of per-lane sibling reduces. Measured (round 5, v5e, 100M rows):
+    XLA does not multi-output-fuse sibling reductions even into a
+    single concatenated output, so the per-lane form materialized the
+    int32 where() contribs at row scale — 3.4GB accessed vs 0.8GB, the
+    whole 4.9ms-vs-0.8ms q1.x gap. The [n_parts, T, BLOCK] reduce keeps
+    the mask + parts in one fused loop at HBM-bandwidth rate.
     """
-    per_lane = []
-    for lane in part_lanes:
-        contrib = jnp.where(mask, lane.astype(jnp.int32), 0)
-        per_lane.append(contrib.reshape(-1, BLOCK).sum(
-            axis=1, dtype=jnp.int32))                 # [T] < 2^20
-    return _chunked_int_sum(jnp.stack(per_lane, axis=-1))
+    if isinstance(part_lanes, (list, tuple)):
+        part_lanes = jnp.stack(part_lanes)            # input-side stack
+    contrib = jnp.where(mask[None, :], part_lanes, 0).astype(jnp.int32)
+    blocks = contrib.reshape(part_lanes.shape[0], -1, BLOCK).sum(
+        axis=-1, dtype=jnp.int32)                     # [n_parts, T] < 2^20
+    return _chunked_int_sum(blocks.T)
 
 
 def _chunked_float_sum(vals, mask):
@@ -495,20 +502,37 @@ def _histogram(cols, col: str, card_pad: int, mask):
     return jnp.zeros(card_pad, jnp.int32).at[ids].add(mask.astype(jnp.int32))
 
 
+def _is_parts_agg(spec) -> bool:
+    fname, _col, source, extra = spec
+    return fname in ("sum", "avg") and source == "sv" and \
+        isinstance(extra, tuple) and extra[0] == "parts"
+
+
 def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
     outs = {}
     hists: Dict[Tuple[str, int], jnp.ndarray] = {}
+    # ALL part-lane sums ride ONE reduce over ONE concatenated [L, P]
+    # operand (see _part_sums: sibling reduces don't fuse on this XLA —
+    # q4.x's two SUM columns would otherwise pay the materialized-contrib
+    # tax twice)
+    parts_aggs = [(i, spec) for i, spec in enumerate(agg_specs)
+                  if _is_parts_agg(spec)]
+    if parts_aggs:
+        arrs = [cols[f"{spec[1]}.parts"] for _i, spec in parts_aggs]
+        combined = arrs[0] if len(arrs) == 1 else jnp.concatenate(arrs, 0)
+        sums = _part_sums(combined, mask)              # [T1, L]
+        off = 0
+        for i, spec in parts_aggs:
+            n_p = cols[f"{spec[1]}.parts"].shape[0]
+            outs[f"agg{i}.parts"] = sums[:, off: off + n_p]
+            outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
+            off += n_p
     for i, spec in enumerate(agg_specs):
         fname, col, source, extra = spec
+        if _is_parts_agg(spec):
+            continue                     # emitted by the fused pass above
         if fname == "count":
             outs[f"agg{i}"] = mask.sum(dtype=jnp.int32)
-        elif fname in ("sum", "avg") and source == "sv" and \
-                isinstance(extra, tuple) and extra[0] == "parts":
-            # exact integer sum: bit-sliced part lanes, tree reductions
-            pl = cols[f"{col}.parts"]
-            outs[f"agg{i}.parts"] = _part_sums(
-                [pl[p] for p in range(pl.shape[0])], mask)
-            outs[f"agg{i}.count"] = mask.sum(dtype=jnp.int32)
         elif fname in ("sum", "avg") and source == "sv" and \
                 isinstance(extra, tuple) and extra[0] == "vlane":
             # float dictionary values: decoded value lane, chunked f32/f64
