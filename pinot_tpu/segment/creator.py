@@ -29,6 +29,43 @@ from pinot_tpu.segment.inverted import InvertedIndexWriter
 from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
 
 
+class DictionaryEncodedColumn:
+    """Columnar ingestion fast path: a column arriving as (candidate
+    value pool, per-row indices) — the Arrow/Parquet dictionary-encoded
+    layout (parity: the reference ingests dictionary-encoded Parquet
+    pages the same way). The built segment is byte-identical to one
+    built from the decoded values: the per-segment dictionary still
+    contains ONLY values present in this segment's rows, sorted, with
+    the same ids — but the build is O(n + pool) LUT work instead of
+    hashing n (possibly string) values."""
+
+    def __init__(self, values: np.ndarray, indices: np.ndarray):
+        self.values = np.asarray(values)
+        self.indices = np.asarray(indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def decode(self) -> np.ndarray:
+        return self.values[self.indices]
+
+    def build_dictionary(self, data_type):
+        """(per-segment Dictionary of present values, remapped ids)."""
+        pool = len(self.values)
+        presence = np.zeros(pool, bool)
+        presence[self.indices] = True
+        present = np.flatnonzero(presence)
+        vals = self.values[present]
+        if vals.dtype.kind != "O":
+            vals = vals.astype(data_type.np_dtype)   # field dtype, like
+            #                                          the decoded path
+        order = np.argsort(vals, kind="stable")      # pool-scale: tiny
+        lut = np.zeros(pool, np.int32)
+        lut[present[order]] = np.arange(len(present), dtype=np.int32)
+        dictionary = Dictionary(data_type, vals[order])
+        return dictionary, lut[self.indices]
+
+
 class SegmentCreator:
     """Builds one immutable segment from records."""
 
@@ -64,7 +101,9 @@ class SegmentCreator:
         """records: Iterable[dict] (row path) or Dict[str, np.ndarray]
         (columnar path)."""
         if isinstance(records, dict):
-            columns = {k: list(v) if not isinstance(v, np.ndarray) else v
+            columns = {k: v if isinstance(v, (np.ndarray,
+                                              DictionaryEncodedColumn))
+                       else list(v)
                        for k, v in records.items()}
         else:
             columns = self._columnarize(records)
@@ -91,12 +130,23 @@ class SegmentCreator:
         st_dims = {d for c in st_configs for d in c.dimensions}
         st_metrics = {m for c in st_configs for m in c.metrics}
 
+        # parity: startree/hll HllConfig — origin columns whose per-row
+        # serialized HLL becomes a derived column (FASTHLL rewrite target)
+        hll_cfg = getattr(idx_cfg, "hll_config", None) or {}
+        hll_derive = set(hll_cfg.get("columnsToDerive", []))
+        hll_sources: Dict[str, tuple] = {}
+
         for field in self.schema.fields:
             name = field.name
             if name not in columns:
                 raise ValueError(f"missing column {name}")
             raw = columns[name]
-            if field.single_value:
+            encoded = isinstance(raw, DictionaryEncodedColumn) and \
+                field.single_value
+            if encoded:
+                arr = None                 # decoded lazily if ever needed
+                n = len(raw)
+            elif field.single_value:
                 arr = np.asarray(raw, dtype=field.data_type.np_dtype)
                 n = len(arr)
             else:
@@ -113,6 +163,9 @@ class SegmentCreator:
                                  f"columns (got {name})")
             if no_dict and field.single_value:
                 # raw forward index, no dictionary
+                if encoded:
+                    arr = np.asarray(raw.decode(),
+                                     dtype=field.data_type.np_dtype)
                 write_raw_fwd(out_dir, name, arr)
                 if name in st_metrics:
                     st_metric_vals[name] = arr.astype(np.float64)
@@ -129,7 +182,11 @@ class SegmentCreator:
 
             # -- stats pass + dictionary -----------------------------------
             if field.single_value:
-                if name in self.fixed_dictionaries:
+                if encoded:
+                    # dictionary-encoded columnar input: LUT remap, no
+                    # value hashing (output identical to the decoded path)
+                    dictionary, ids = raw.build_dictionary(field.data_type)
+                elif name in self.fixed_dictionaries:
                     dictionary = Dictionary.build(
                         field.data_type,
                         np.asarray(self.fixed_dictionaries[name]))
@@ -156,6 +213,8 @@ class SegmentCreator:
             dictionary.save(out_dir, name)
             card = dictionary.cardinality
             if field.single_value:
+                if name in hll_derive:
+                    hll_sources[name] = (dictionary.values, ids)
                 if name in st_dims:
                     st_dim_lanes[name] = (ids, card)
                 if name in st_metrics and field.data_type.is_numeric:
@@ -211,6 +270,36 @@ class SegmentCreator:
 
         num_docs = num_docs or 0
 
+        # -- derived HLL columns (parity: SegmentGeneratorConfig HllConfig
+        # + MetricFieldSpec.DerivedMetricType.HLL) -----------------------
+        # One serialized sketch per ORIGIN DICTIONARY VALUE (cardinality-
+        # scale work), forwarded through the origin's dictIds — the
+        # derived column then answers FASTHLL by unioning the sketches of
+        # matched rows' distinct values.
+        for origin, (ovals, oids) in hll_sources.items():
+            from pinot_tpu.common.sketches import HyperLogLog
+            log2m = int(hll_cfg.get("log2m", 8))
+            dname = origin + hll_cfg.get("suffix", "_hll")
+            ser = np.array([HyperLogLog.from_values([v], log2m)
+                            .to_bytes().hex() for v in ovals], dtype=object)
+            dct, dval_ids = Dictionary.build_encoded(DataType.STRING, ser)
+            dids = dval_ids[oids]
+            dct.save(out_dir, dname)
+            SVForwardIndexWriter.write(out_dir, dname, dids,
+                                       dct.cardinality)
+            col_meta[dname] = ColumnMetadata(
+                name=dname, data_type=DataType.STRING,
+                cardinality=dct.cardinality,
+                bits_per_element=bits_required(dct.cardinality),
+                single_value=True,
+                sorted=bool(np.all(dids[:-1] <= dids[1:]))
+                if len(dids) > 1 else True,
+                has_dictionary=True,
+                min_value=_plain(dct.min_value),
+                max_value=_plain(dct.max_value),
+                total_number_of_entries=len(dids),
+                derived_metric_type="HLL", derived_from=origin)
+
         # -- column partitions (parity: SegmentPartitionConfig → per-
         # column partition metadata used by partition-aware pruning) ------
         part_cfg = getattr(idx_cfg, "segment_partition_config", {}) or {}
@@ -222,8 +311,11 @@ class SegmentCreator:
                 coerce_partition_value, make_partition_function)
             fn = make_partition_function(pc["functionName"],
                                          int(pc["numPartitions"]))
-            src = columns[name] if cm.single_value else \
-                [v for row in columns[name] for v in row]
+            col_in = columns[name]
+            if isinstance(col_in, DictionaryEncodedColumn):
+                col_in = col_in.decode()
+            src = col_in if cm.single_value else \
+                [v for row in col_in for v in row]
             # coerce through the column dtype so build-time hashing
             # agrees with the pruners' query-literal hashing
             dt = cm.data_type.np_dtype

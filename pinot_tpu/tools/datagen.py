@@ -315,12 +315,17 @@ def build_ssb_segment_dirs(base_dir: str, total_rows: int,
     for i in range(num_segments):
         lo = i * per
         hi = (i + 1) * per if i < num_segments - 1 else total_rows
+        from pinot_tpu.segment.creator import DictionaryEncodedColumn
         cols = {}
         for c in SSB_TYPES:
             if c in SSB_RAW_COLS:
                 cols[c] = supplycost[lo:hi]
             else:
-                cols[c] = pools[c][ids[c][lo:hi]]
+                # dictionary-encoded columnar input: the creator still
+                # builds a PER-SEGMENT dictionary of only this slice's
+                # present values (byte-identical segments to the decoded
+                # path) without hashing row-scale strings
+                cols[c] = DictionaryEncodedColumn(pools[c], ids[c][lo:hi])
         d = os.path.join(base_dir, f"ssb_{i}")
         SegmentCreator(schema, config, segment_name=f"ssb_{i}",
                        fixed_dictionaries=fixed).build(cols, d)

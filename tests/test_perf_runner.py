@@ -87,5 +87,5 @@ def test_microbench_smoke():
     records = run_all(scale=0.005)
     assert len(records) == len(BENCHES)
     for r in records:
-        assert set(r) == {"bench", "value", "unit"}
+        assert {"bench", "value", "unit"} <= set(r)
         assert r["value"] > 0
