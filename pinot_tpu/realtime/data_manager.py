@@ -91,12 +91,15 @@ class RealtimeSegmentDataManager:
 
     def stop(self) -> None:
         self._stop.set()
-        if threading.current_thread() is not self._thread:
-            self._thread.join(timeout=10)
+        # close BEFORE join: close() wakes a long-polling fetch (the
+        # stream SPI's blocking read), otherwise the join waits out the
+        # fetch timeout
         try:
             self.consumer.close()
         except Exception:  # noqa: BLE001
             pass
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10)
 
     # -- consume loop ------------------------------------------------------
 
@@ -132,6 +135,7 @@ class RealtimeSegmentDataManager:
         if not batch.messages:
             self._stop.wait(_POLL_S)
             return
+        rows = []
         for msg in batch.messages:
             if msg.offset < self.offset:
                 continue
@@ -145,7 +149,9 @@ class RealtimeSegmentDataManager:
                 log.debug("dropping undecodable/untransformable message "
                           "at offset %d", msg.offset)
                 continue
-            self.mutable.index_row(row)
+            rows.append(row)
+        # batch indexing: one column-at-a-time pass over the fetch batch
+        self.mutable.index_rows(rows)
         self.offset = max(self.offset, batch.next_offset)
 
     # -- completion protocol (server side) ---------------------------------

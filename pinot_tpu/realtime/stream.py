@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 SMALLEST_OFFSET = "smallest"
@@ -127,6 +128,7 @@ class MemoryStream:
         self._partitions: List[List[bytes]] = [[] for _ in
                                                range(num_partitions)]
         self._lock = threading.Lock()
+        self._data = threading.Condition(self._lock)
 
     @property
     def num_partitions(self) -> int:
@@ -143,15 +145,32 @@ class MemoryStream:
                 sizes = [len(p) for p in self._partitions]
                 partition = sizes.index(min(sizes))
             self._partitions[partition].append(payload)
+            self._data.notify_all()
 
     def latest_offset(self, partition: int) -> int:
         with self._lock:
             return len(self._partitions[partition])
 
-    def read(self, partition: int, start: int, max_count: int
-             ) -> List[StreamMessage]:
+    def wake(self) -> None:
+        """Wake long-poll readers (consumer close / shutdown path)."""
+        with self._lock:
+            self._data.notify_all()
+
+    def read(self, partition: int, start: int, max_count: int,
+             timeout_ms: int = 0, stop=None) -> List[StreamMessage]:
+        """Long-poll read (Kafka consumer.poll semantics): when nothing
+        is available past `start`, block up to timeout_ms for a publish —
+        freshness is then publish-driven, not poll-cadence-driven.
+        `stop`: zero-arg callable; a True return (after wake()) aborts
+        the wait so consumer close never blocks on the full timeout."""
+        deadline = time.monotonic() + timeout_ms / 1e3 if timeout_ms else 0
         with self._lock:
             log_part = self._partitions[partition]
+            while timeout_ms and len(log_part) <= start and \
+                    not (stop is not None and stop()):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._data.wait(remaining):
+                    break
             end = min(len(log_part), start + max_count)
             return [StreamMessage(i, log_part[i]) for i in range(start, end)]
 
@@ -220,14 +239,21 @@ class _MemoryPartitionConsumer(PartitionLevelConsumer):
         self.stream = stream
         self.partition = partition
         self.batch_size = batch_size
+        self._closed = False
 
     def fetch_messages(self, start_offset: int, end_offset: Optional[int],
                        timeout_ms: int) -> MessageBatch:
         limit = self.batch_size if end_offset is None else \
             min(self.batch_size, end_offset - start_offset)
-        msgs = self.stream.read(self.partition, start_offset, max(limit, 0))
+        msgs = self.stream.read(self.partition, start_offset,
+                                max(limit, 0), timeout_ms=timeout_ms,
+                                stop=lambda: self._closed)
         next_off = msgs[-1].offset + 1 if msgs else start_offset
         return MessageBatch(msgs, next_off)
+
+    def close(self) -> None:
+        self._closed = True
+        self.stream.wake()
 
 
 class _MemoryMetadataProvider(StreamMetadataProvider):
