@@ -475,6 +475,8 @@ class ShardedQueryExecutor:
                 segments: Sequence[ImmutableSegment]
                 ) -> IntermediateResultsBlock:
         t0 = time.perf_counter()
+        from pinot_tpu.query.plan import preprocess_request
+        preprocess_request(segments, request)   # FASTHLL derived rewrite
         stack = self.stack_for(segments)
         # Fast paths (star-tree cubes, metadata/dictionary answers) are
         # per-segment host work in each segment's OWN id domain — probe
