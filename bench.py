@@ -387,6 +387,8 @@ def check(name: str, got, exp) -> None:
 
 
 def time_cpu(fn, reps: int):
+    """(median_s, samples) — sample count recorded so the artifact shows
+    exactly how many baseline iterations backed each number."""
     ts = []
     for _ in range(max(3, reps)):
         t = time.perf_counter()
@@ -397,7 +399,7 @@ def time_cpu(fn, reps: int):
             # are stable run-to-run; extra reps only burn the driver's
             # wall budget (round-2 post-mortem: 5 reps x 6.6s for q3.4)
             break
-    return median(ts)
+    return median(ts), ts
 
 
 def measure_rtt(sample) -> float:
@@ -485,12 +487,18 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                         samples.append(time.perf_counter() - t0)
                     d50 = median(samples)
                     d99 = float(np.percentile(samples, 99))
-                    c = time_cpu(cpu[name], reps)
+                    c, cpu_ts = time_cpu(cpu[name], reps)
                     speedups.append(c / d50)
                     per_query[name] = {
                         "device_p50_ms": round(d50 * 1e3, 3),
                         "device_p99_ms": round(d99 * 1e3, 3),
+                        "device_min_ms": round(min(samples) * 1e3, 3),
+                        "device_max_ms": round(max(samples) * 1e3, 3),
+                        "n_device": len(samples),
                         "cpu_p50_ms": round(c * 1e3, 3),
+                        "cpu_min_ms": round(min(cpu_ts) * 1e3, 3),
+                        "cpu_max_ms": round(max(cpu_ts) * 1e3, 3),
+                        "n_cpu": len(cpu_ts),
                         "speedup": round(c / d50, 2),
                         "rows_per_s_per_chip": round(rows / d50),
                         "path": "star-tree",
@@ -581,12 +589,19 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
                     total = time.perf_counter() - t0
                     samples.append(max(total - rtt, 1e-5) / n_exec + finish_s)
                 d50, d99 = median(samples), float(np.percentile(samples, 99))
-                c = time_cpu(cpu[name], reps)
+                c, cpu_ts = time_cpu(cpu[name], reps)
                 speedups.append(c / d50)
                 per_query[name] = {
                     "device_p50_ms": round(d50 * 1e3, 3),
                     "device_p99_ms": round(d99 * 1e3, 3),
+                    "device_min_ms": round(min(samples) * 1e3, 3),
+                    "device_max_ms": round(max(samples) * 1e3, 3),
+                    # each device sample is a scan of n_exec executions
+                    "n_device": len(samples), "execs_per_sample": n_exec,
                     "cpu_p50_ms": round(c * 1e3, 3),
+                    "cpu_min_ms": round(min(cpu_ts) * 1e3, 3),
+                    "cpu_max_ms": round(max(cpu_ts) * 1e3, 3),
+                    "n_cpu": len(cpu_ts),
                     "speedup": round(c / d50, 2),
                     "rows_per_s_per_chip": round(rows / d50),
                 }

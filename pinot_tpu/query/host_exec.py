@@ -133,7 +133,7 @@ def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
 
     if not cm.has_dictionary:
         vals = ds.raw_values
-        cv = _coercer(cm.data_type.np_dtype)
+        cv = _coercer(cm.data_type)
         if op == FilterOperator.EQUALITY:
             return vals == cv(tree.values[0])
         if op == FilterOperator.NOT:
@@ -151,6 +151,12 @@ def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
                 hi = cv(tree.upper)
                 m &= (vals <= hi) if tree.upper_inclusive else (vals < hi)
             return m
+        if op == FilterOperator.REGEXP_LIKE:
+            import re
+            pattern = re.compile(str(tree.values[0]))
+            return np.fromiter(
+                (pattern.search(str(v)) is not None for v in vals),
+                dtype=bool, count=len(vals))
         raise ValueError(f"unsupported raw filter {op}")
 
     # dictionary-encoded: resolve to id-domain predicate, then test lanes
@@ -188,7 +194,7 @@ def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
             vals = dictionary.values
             m = np.ones(card, dtype=bool)
             if cm.data_type.is_numeric:
-                cv = _coercer(cm.data_type.np_dtype)
+                cv = _coercer(cm.data_type)
             else:
                 cv = str
             if tree.lower is not None:
@@ -211,10 +217,20 @@ def _eval_leaf(tree: FilterQueryTree, segment: ImmutableSegment) -> np.ndarray:
     return member[ds.mv_dict_ids].any(axis=1)
 
 
-def _coercer(dtype: np.dtype):
-    if dtype.kind == "f":
-        return lambda v: dtype.type(float(v))
-    return lambda v: dtype.type(int(str(v)))
+def _coercer(data_type):
+    """Predicate-literal coercion for a column's DataType (raw columns
+    compare in the value domain: hex literals become bytes for BYTES,
+    everything else numeric/str)."""
+    dt = data_type.np_dtype
+    if dt.kind == "f":
+        return lambda v: dt.type(float(v))
+    if dt.kind in "iu":
+        return lambda v: dt.type(int(str(v)))
+    from pinot_tpu.common.datatype import DataType as _DT
+    if data_type == _DT.BYTES:
+        return lambda v: v if isinstance(v, bytes) \
+            else bytes.fromhex(str(v))
+    return str          # chunked raw string columns compare as strings
 
 
 # ---------------------------------------------------------------------------
@@ -507,6 +523,9 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
                 k = ds.raw_values[docids]
             else:
                 raise ValueError("order-by on MV column")
+            if k.dtype.kind == "O":
+                # strings/bytes: rank-encode so DESC can negate
+                _u, k = np.unique(k, return_inverse=True)
             sort_keys.append(-k if not ob.ascending else k)
         order = np.lexsort(sort_keys)
         docids = docids[order]

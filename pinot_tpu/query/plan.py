@@ -262,6 +262,11 @@ def _resolve_leaf(node: FilterQueryTree, segment: ImmutableSegment,
 def _resolve_raw_leaf(node: FilterQueryTree, ds: DataSource, params: List
                       ) -> tuple:
     dt = ds.metadata.data_type.np_dtype
+    if dt.kind not in "iuf":
+        # chunked raw string/bytes columns have no device lane; the host
+        # executor evaluates their predicates on the decoded object array
+        raise UnsupportedOnDevice(
+            f"filter over non-numeric raw column {node.column}")
     op = node.operator
     col = node.column
 
@@ -615,6 +620,11 @@ class InstancePlanMaker:
         for c in cols + extras:
             ds = segment.data_source(c)
             if not ds.metadata.has_dictionary:
+                if ds.metadata.data_type.np_dtype.kind not in "iuf":
+                    # chunked raw string/bytes: object arrays have no
+                    # device lane — the whole selection goes host-side
+                    raise UnsupportedOnDevice(
+                        f"selection over non-numeric raw column {c}")
                 gather.append((c, "raw"))
                 needed[(c, "raw")] = None
             elif ds.metadata.single_value:

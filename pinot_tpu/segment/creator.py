@@ -159,8 +159,25 @@ class SegmentCreator:
 
             no_dict = name in idx_cfg.no_dictionary_columns
             if no_dict and not field.data_type.is_numeric:
-                raise ValueError("no-dictionary only supported for numeric "
-                                 f"columns (got {name})")
+                if not field.single_value:
+                    raise ValueError("no-dictionary MV columns are not "
+                                     f"supported (got {name})")
+                # var-byte chunked raw string/bytes column (parity:
+                # VarByteChunkSingleValueWriter + ChunkCompressorFactory)
+                from pinot_tpu.segment.rawchunks import write_raw_chunks
+                vals = raw.decode() if encoded else \
+                    np.asarray(raw, dtype=object)
+                write_raw_chunks(out_dir, name, list(vals))
+                uniq = set(vals)
+                col_meta[name] = ColumnMetadata(
+                    name=name, data_type=field.data_type,
+                    cardinality=len(uniq),
+                    bits_per_element=0, has_dictionary=False,
+                    min_value=_plain(min(uniq)) if uniq else None,
+                    max_value=_plain(max(uniq)) if uniq else None,
+                    total_number_of_entries=n,
+                    default_null_value=field.default_null_value)
+                continue
             if no_dict and field.single_value:
                 # raw forward index, no dictionary
                 if encoded:

@@ -82,8 +82,15 @@ def segment_host_bytes(seg) -> int:
     total = 0
     for name in seg.column_names:
         ds = seg.data_source(name)
-        for arr in (getattr(ds, "dict_ids", None),
-                    getattr(ds, "raw_values", None),
+        # chunked raw columns: account the resident COMPRESSED buffer
+        # without triggering the lazy full decode (the size endpoint
+        # must not materialize gigabyte object arrays)
+        chunks = getattr(ds, "raw_chunks", None)
+        raw = getattr(ds, "_raw_values", None) \
+            if chunks is not None else getattr(ds, "raw_values", None)
+        if chunks is not None and raw is None:
+            total += len(chunks._data)
+        for arr in (getattr(ds, "dict_ids", None), raw,
                     getattr(ds, "mv_dict_ids", None)):
             total += _arr_bytes(arr)
         vals = getattr(getattr(ds, "dictionary", None), "values", None)
@@ -108,13 +115,27 @@ class DataSource:
     index, dictionary, optional inverted/bloom index and column metadata.
     """
 
+    @property
+    def raw_values(self) -> Optional[np.ndarray]:
+        if self._raw_values is None and self.raw_chunks is not None:
+            self._raw_values = self.raw_chunks.decode_all()
+        return self._raw_values
+
+    @raw_values.setter
+    def raw_values(self, arr) -> None:
+        self._raw_values = arr
+
     def __init__(self, metadata: ColumnMetadata, segment: "ImmutableSegment"):
         self.metadata = metadata
         self._segment = segment
         self.dictionary: Optional[Dictionary] = None
         # host arrays
         self.dict_ids: Optional[np.ndarray] = None        # int32 [num_docs]
-        self.raw_values: Optional[np.ndarray] = None      # no-dict columns
+        self._raw_values: Optional[np.ndarray] = None     # no-dict columns
+        # chunked raw reader (VarByteChunk parity): set for string/bytes
+        # no-dictionary columns; point lookups decompress one chunk,
+        # raw_values materializes lazily for scan paths
+        self.raw_chunks = None
         self.mv_dict_ids: Optional[np.ndarray] = None     # int32 [docs, width]
         self.sorted_ranges: Optional[np.ndarray] = None   # [card, 2]
         self.inverted_index: Optional[InvertedIndexReader] = None
@@ -289,6 +310,8 @@ class ImmutableSegment:
                 ds.device_dict_ids()
                 if ds.metadata.data_type.is_numeric:
                     ds.device_dict_values()
+            elif ds.raw_chunks is not None:
+                pass      # no device lane for string/bytes raw columns
             elif ds.raw_values is not None:
                 ds.device_raw_values()
             elif ds.mv_dict_ids is not None:
@@ -323,7 +346,14 @@ class ImmutableSegmentLoader:
         for name, cm in meta.columns.items():
             ds = DataSource(cm, None)
             if not cm.has_dictionary:
-                ds.raw_values = read_raw_fwd(seg_dir, name)
+                from pinot_tpu.segment.rawchunks import (ChunkedRawReader,
+                                                         has_raw_chunks)
+                if has_raw_chunks(seg_dir, name):
+                    ds.raw_chunks = ChunkedRawReader.open(
+                        seg_dir, name,
+                        is_bytes=cm.data_type == DataType.BYTES)
+                else:
+                    ds.raw_values = read_raw_fwd(seg_dir, name)
             else:
                 ds.dictionary = Dictionary.load(seg_dir, name, cm.data_type)
                 if cm.single_value:

@@ -1,0 +1,136 @@
+"""Var-byte chunked raw (no-dictionary) column format with per-chunk
+compression and random access.
+
+Parity: pinot-core/.../io/writer/impl/v1/VarByteChunkSingleValueWriter.java
++ ChunkCompressorFactory.java:32 — the reference stores raw STRING/BYTES
+columns as fixed-doc-count chunks, each var-byte encoded and compressed,
+with a chunk offset index for random access (point lookups decompress one
+chunk, not the column). Codecs here: PASS_THROUGH and DEFLATE (zlib —
+snappy has no stdlib implementation in this image; DEFLATE fills the same
+role, recorded in the header so readers dispatch correctly).
+
+File layout (little-endian):
+    magic u32 | version u32 | codec u32 | num_docs u64 |
+    docs_per_chunk u32 | num_chunks u32 |
+    chunk_offsets u64[num_chunks + 1]      (relative to data start)
+    chunk data...
+Each decompressed chunk: value_offsets u32[n_in_chunk + 1] | payload bytes.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+MAGIC = 0x52435631          # "RCV1"
+PASS_THROUGH = 0
+DEFLATE = 1
+
+DEFAULT_DOCS_PER_CHUNK = 4096
+
+RAW_CHUNKS = "{col}.sv.rawchunks"
+
+
+def _encode_chunk(values: Sequence, codec: int) -> bytes:
+    payloads: List[bytes] = []
+    for v in values:
+        payloads.append(v if isinstance(v, bytes)
+                        else str(v).encode("utf-8"))
+    offsets = np.zeros(len(payloads) + 1, dtype=np.uint32)
+    np.cumsum([len(p) for p in payloads], out=offsets[1:])
+    raw = offsets.tobytes() + b"".join(payloads)
+    return zlib.compress(raw, 6) if codec == DEFLATE else raw
+
+
+def write_raw_chunks(seg_dir: str, col: str, values,
+                     codec: int = DEFLATE,
+                     docs_per_chunk: int = DEFAULT_DOCS_PER_CHUNK) -> str:
+    """values: sequence of str/bytes. Returns the file path."""
+    n = len(values)
+    chunks = [_encode_chunk(values[i: i + docs_per_chunk], codec)
+              for i in range(0, n, docs_per_chunk)] or \
+        [_encode_chunk([], codec)]
+    offsets = np.zeros(len(chunks) + 1, dtype=np.uint64)
+    np.cumsum([len(c) for c in chunks], out=offsets[1:])
+    path = os.path.join(seg_dir, RAW_CHUNKS.format(col=col))
+    with open(path, "wb") as fh:
+        fh.write(struct.pack("<IIIQII", MAGIC, 1, codec, n,
+                             docs_per_chunk, len(chunks)))
+        fh.write(offsets.tobytes())
+        for c in chunks:
+            fh.write(c)
+    return path
+
+
+class ChunkedRawReader:
+    """Random-access reader: value(doc) decompresses ONE chunk (small LRU
+    keeps the hot chunk); decode_all() materializes the object array for
+    scan paths."""
+
+    HEADER = struct.Struct("<IIIQII")
+
+    def __init__(self, data: bytes, is_bytes: bool = False):
+        magic, version, codec, n, dpc, n_chunks = self.HEADER.unpack_from(
+            data, 0)
+        if magic != MAGIC:
+            raise ValueError("not a rawchunks file")
+        self.codec = codec
+        self.num_docs = n
+        self.docs_per_chunk = dpc
+        self.is_bytes = is_bytes
+        off0 = self.HEADER.size
+        self._chunk_offsets = np.frombuffer(
+            data, dtype=np.uint64, count=n_chunks + 1, offset=off0)
+        self._data = data
+        self._data_start = off0 + (n_chunks + 1) * 8
+        self._cache: dict = {}      # chunk idx → (offsets u32, payload)
+
+    @classmethod
+    def open(cls, seg_dir, col: str, is_bytes: bool = False
+             ) -> "ChunkedRawReader":
+        from pinot_tpu.segment import format as fmt
+        return cls(fmt.open_dir(seg_dir).read_bytes(
+            RAW_CHUNKS.format(col=col)), is_bytes)
+
+    def _chunk(self, ci: int):
+        hit = self._cache.get(ci)
+        if hit is not None:
+            return hit
+        a = self._data_start + int(self._chunk_offsets[ci])
+        b = self._data_start + int(self._chunk_offsets[ci + 1])
+        raw = self._data[a:b]
+        if self.codec == DEFLATE:
+            raw = zlib.decompress(raw)
+        n_in = min(self.docs_per_chunk,
+                   self.num_docs - ci * self.docs_per_chunk)
+        offs = np.frombuffer(raw, dtype=np.uint32, count=n_in + 1)
+        payload = raw[(n_in + 1) * 4:]
+        if len(self._cache) > 4:
+            self._cache.clear()
+        self._cache[ci] = (offs, payload)
+        return offs, payload
+
+    def value(self, doc: int):
+        ci, j = divmod(doc, self.docs_per_chunk)
+        offs, payload = self._chunk(ci)
+        b = payload[offs[j]: offs[j + 1]]
+        return b if self.is_bytes else b.decode("utf-8")
+
+    def decode_all(self) -> np.ndarray:
+        out = np.empty(self.num_docs, dtype=object)
+        i = 0
+        for ci in range(len(self._chunk_offsets) - 1):
+            offs, payload = self._chunk(ci)
+            for j in range(len(offs) - 1):
+                b = payload[offs[j]: offs[j + 1]]
+                out[i] = b if self.is_bytes else b.decode("utf-8")
+                i += 1
+        return out
+
+
+def has_raw_chunks(seg_dir, col: str) -> bool:
+    from pinot_tpu.segment import format as fmt
+    return fmt.open_dir(seg_dir).exists(RAW_CHUNKS.format(col=col))

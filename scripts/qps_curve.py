@@ -1,0 +1,104 @@
+"""Throughput curve: SSB queries through a real controller + broker +
+2-server cluster (HTTP broker endpoint, TCP data plane), driven by the
+QueryRunner perf harness in increasingQPS mode.
+
+Parity: pinot-tools/.../perf/QueryRunner.java targetQPS/increasingQPS and
+contrib/pinot-druid-benchmark PinotThroughput — the reference's benchmark
+culture records p50/p99 vs offered QPS and the saturation knee, not just
+single-query latency. Writes QPS_r05.json at the repo root.
+
+Runs on the CPU backend (the serving plane under test is broker routing +
+scatter/gather + scheduler + reduce; bench.py covers the chip plane), on
+purpose at a row count small enough that per-query work doesn't mask the
+serving-path costs.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# HARD override: the serving-plane benchmark must not pay the test
+# harness's TPU relay RTT (~90ms/dispatch) per query — that measures the
+# relay, not the broker path. bench.py owns the chip-plane numbers.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+ROWS = int(os.environ.get("QPS_ROWS", 2_000_000))
+SEGMENTS = int(os.environ.get("QPS_SEGMENTS", 4))
+STEP_S = float(os.environ.get("QPS_STEP_S", 3.0))
+
+
+def main() -> None:
+    from bench import SSB_PQLS
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.tools.datagen import (build_ssb_segment_dirs,
+                                         ssb_schema, ssb_table_config)
+    from pinot_tpu.tools.perf import QueryRunner, http_query_fn
+
+    t0 = time.time()
+    base = tempfile.mkdtemp()
+    print(f"building {ROWS} rows / {SEGMENTS} segments...",
+          file=sys.stderr, flush=True)
+    dirs, _ids, _sc = build_ssb_segment_dirs(
+        os.path.join(base, "segs"), ROWS, SEGMENTS, seed=7, star_tree=True)
+
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"),
+                              num_servers=2, tcp=True, http=True)
+    try:
+        cluster.add_schema(ssb_schema())
+        cluster.add_table(ssb_table_config(star_tree=True))
+        for d in dirs:
+            cluster.upload_segment("lineorder_OFFLINE", d)
+
+        queries = list(SSB_PQLS.values())
+        fn = http_query_fn(f"127.0.0.1:{cluster.broker_port}")
+        runner = QueryRunner(fn, queries)
+
+        # warm every query's plan/kernel caches
+        warm = runner.single_thread(num_times=2)
+        print(f"warm: {warm}", file=sys.stderr, flush=True)
+
+        rungs = []
+        qps = 25.0
+        knee = None
+        while qps <= 800:
+            r = runner.target_qps(qps=qps, duration_s=STEP_S,
+                                  num_threads=16)
+            print(str(r), file=sys.stderr, flush=True)
+            rungs.append(r.to_json())
+            achieved = r.qps
+            if knee is None and (achieved < 0.9 * qps or
+                                 r.missed_slots > r.num_queries // 2):
+                knee = qps
+            qps *= 2
+        out = {
+            "artifact": "ssb13_throughput_curve",
+            "rows": ROWS, "segments": SEGMENTS,
+            "cluster": "controller + broker(http) + 2 servers over TCP",
+            "backend": "cpu (serving-plane benchmark; chip plane is "
+                       "bench.py)",
+            "mode": "increasingQPS (QueryRunner.java parity)",
+            "step_duration_s": STEP_S,
+            "warmup": warm.to_json(),
+            "rungs": rungs,
+            "saturation_knee_qps": knee,
+            "wall_s": round(time.time() - t0, 1),
+        }
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "QPS_r05.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"artifact": path,
+                          "saturation_knee_qps": knee,
+                          "max_achieved_qps": max(r["qps"]
+                                                  for r in rungs)}))
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    main()

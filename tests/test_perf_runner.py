@@ -89,3 +89,21 @@ def test_microbench_smoke():
     for r in records:
         assert {"bench", "value", "unit"} <= set(r)
         assert r["value"] > 0
+
+
+def test_qps_headroom_small_segments(perf_cluster):
+    """The serving plane (broker compile/route/scatter/reduce + engine)
+    sustains >100 QPS on small segments — the throughput-culture check
+    behind QPS_r05.json (QueryRunner.java targetQPS parity)."""
+    cluster, _ = perf_cluster
+    qs = ["SELECT COUNT(*) FROM baseballStats",
+          "SELECT SUM(runs) FROM baseballStats WHERE teamID = 'BOS'"]
+    runner = QueryRunner(cluster.broker.handle, qs)
+    runner.single_thread(num_times=2)        # warm the plan caches
+    r = runner.single_thread(num_times=25)
+    assert r.num_errors == 0
+    assert r.qps > 100, str(r)
+    # offered load at 100 QPS: no errors, latency stays sane
+    r2 = runner.target_qps(qps=100, duration_s=1.5, num_threads=8)
+    assert r2.num_errors == 0, str(r2)
+    assert r2.latency_p99_ms < 1000, str(r2)
