@@ -71,7 +71,7 @@ def make_mesh(devices: Optional[Sequence] = None,
 def _combine_kind(key: str) -> str:
     if key.startswith("sel."):
         return "stack"          # per-segment; host merges selection rows
-    if key.endswith((".parts", ".vsum", ".psums", ".csums")):
+    if key.endswith((".parts", ".partsT", ".vsum", ".psums", ".csums")):
         return "stack"          # chunk partials: host combines in int64/f64
     if key.endswith((".rkeys", ".rcount", ".rpsums", ".rsum", ".rmin",
                      ".rmax")):

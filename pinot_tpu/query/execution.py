@@ -110,11 +110,21 @@ def _finish_aggregation(plan, outs, blk) -> None:
                 strategy in ("parts", "vlane"):
             cnt = int(outs[f"agg{i}.count"])
             if strategy == "parts":
-                arr = np.asarray(outs[f"agg{i}.parts"])
-                arr = arr.reshape(-1, arr.shape[-1]).astype(np.int64).sum(0)
-                _, min_v = plan.segment.data_source(col).int_part_info()
-                total = sum(int(arr[k]) << (7 * k) for k in range(len(arr)))
-                s = float(total + min_v * cnt)
+                n_parts, min_v = \
+                    plan.segment.data_source(col).int_part_info()
+                if f"agg{i}.parts" in outs:
+                    # [..., n_parts] fully device-reduced sums
+                    arr = np.asarray(outs[f"agg{i}.parts"]).astype(
+                        np.int64).reshape(-1, n_parts).sum(axis=0)
+                else:
+                    # oversized-segment fallback: [..., n_parts, T]
+                    # block partials, exact int64 combine
+                    arr = np.asarray(outs[f"agg{i}.partsT"]).astype(
+                        np.int64)
+                    arr = arr.reshape(-1, n_parts, arr.shape[-1]).sum(
+                        axis=(0, 2))
+                s = float(sum(int(arr[k]) << (7 * k)
+                              for k in range(n_parts)) + min_v * cnt)
             else:
                 s = float(np.asarray(outs[f"agg{i}.vsum"],
                                      dtype=np.float64).sum())

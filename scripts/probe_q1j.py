@@ -1,0 +1,167 @@
+"""Probe 10: the one-reduce producer is fast ([3]+scalar outputs, 0.8ms)
+but the shipping kernel (chunked [T1,3] output + stats) still runs 5ms at
+1.11GB cost. Vary ONLY the output stage on an exact kernel replica:
+
+cur_chunkT   — _part_sums as shipped: reduce->[3,T], .T, pad, [T1,3]
+flat3        — reduce->[3,T] -> sum(-1) -> [3]
+chunk_noT    — chunked WITHOUT transpose: [3,T1] orientation
+no_stats     — cur_chunkT minus the stats output
+no_valid     — cur_chunkT minus the valid-iota AND
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+S = 8
+PER = 12_500_992
+BLOCK = 8192
+T = PER // BLOCK
+CHUNK = 256
+T1 = -(-T // CHUNK)
+N1, N2 = 32, 160
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def median(xs):
+    return float(np.median(np.asarray(xs)))
+
+
+def make_lanes(key):
+    ks = jax.random.split(key, 6)
+    return {
+        "d_year.ids": jax.random.randint(ks[0], (S, PER), 0, 7, jnp.int8),
+        "lo_discount.ids": jax.random.randint(ks[1], (S, PER), 0, 11,
+                                              jnp.int8),
+        "lo_quantity.ids": jax.random.randint(ks[2], (S, PER), 0, 50,
+                                              jnp.int8),
+        "lo_revenue.parts": jax.random.randint(ks[3], (S, 3, PER), 0, 128,
+                                               jnp.int8),
+    }
+
+
+def the_mask(cols, p, with_valid, num_docs):
+    y, dlo, dhi, qlo, qhi = p
+    m = ((cols["d_year.ids"] == y) &
+         ((cols["lo_discount.ids"] >= dlo) &
+          (cols["lo_discount.ids"] < dhi)) &
+         ((cols["lo_quantity.ids"] >= qlo) &
+          (cols["lo_quantity.ids"] < qhi)))
+    if with_valid:
+        m = m & (jnp.arange(PER, dtype=jnp.int32) < num_docs)
+    return m
+
+
+def blocks_of(cols, mask):
+    contrib = jnp.where(mask[None, :], cols["lo_revenue.parts"],
+                        0).astype(jnp.int32)
+    return contrib.reshape(3, T, BLOCK).sum(-1, dtype=jnp.int32)  # [3,T]
+
+
+def chunked_T(blocks):               # as shipped: [T1, 3]
+    x = blocks.T
+    pad = T1 * CHUNK - T
+    return jnp.pad(x, ((0, pad), (0, 0))).reshape(
+        T1, CHUNK, 3).sum(axis=1, dtype=jnp.int32)
+
+
+def chunked_noT(blocks):             # [3, T1]
+    pad = T1 * CHUNK - T
+    return jnp.pad(blocks, ((0, 0), (0, pad))).reshape(
+        3, T1, CHUNK).sum(axis=-1, dtype=jnp.int32)
+
+
+def k_cur(cols, p, nd):
+    mask = the_mask(cols, p, True, nd)
+    return {"stats": mask.sum(dtype=jnp.int32),
+            "parts": chunked_T(blocks_of(cols, mask)),
+            "count": mask.sum(dtype=jnp.int32)}
+
+
+def k_flat3(cols, p, nd):
+    mask = the_mask(cols, p, True, nd)
+    return {"stats": mask.sum(dtype=jnp.int32),
+            "parts": blocks_of(cols, mask).sum(-1),
+            "count": mask.sum(dtype=jnp.int32)}
+
+
+def k_chunk_noT(cols, p, nd):
+    mask = the_mask(cols, p, True, nd)
+    return {"stats": mask.sum(dtype=jnp.int32),
+            "parts": chunked_noT(blocks_of(cols, mask)),
+            "count": mask.sum(dtype=jnp.int32)}
+
+
+def k_no_stats(cols, p, nd):
+    mask = the_mask(cols, p, True, nd)
+    return {"parts": chunked_T(blocks_of(cols, mask))}
+
+
+def k_no_valid(cols, p, nd):
+    mask = the_mask(cols, p, False, nd)
+    return {"stats": mask.sum(dtype=jnp.int32),
+            "parts": chunked_T(blocks_of(cols, mask)),
+            "count": mask.sum(dtype=jnp.int32)}
+
+
+def slope_time(run, tag, zs1, zs2):
+    t0 = time.perf_counter()
+    jax.device_get(run(zs1)); jax.device_get(run(zs2))
+    log(f"{tag}: compiled in {time.perf_counter()-t0:.1f}s")
+    s = []
+    for _ in range(5):
+        t0 = time.perf_counter(); jax.device_get(run(zs1))
+        t1 = time.perf_counter(); jax.device_get(run(zs2))
+        t2 = time.perf_counter()
+        s.append(((t2 - t1) - (t1 - t0)) / (N2 - N1))
+    ms = median(s) * 1e3
+    log(f"{tag}: {ms:.3f} ms/exec ({S*PER/(median(s))/1e9:.0f}B rows/s)")
+    return ms
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    lanes = make_lanes(jax.random.PRNGKey(0))
+    jax.block_until_ready(list(lanes.values()))
+    zs1 = jnp.zeros(N1, jnp.int32)
+    zs2 = jnp.zeros(N2, jnp.int32)
+    nd = jax.device_put(np.full(S, PER - 7, np.int32))
+    results = {}
+
+    for tag, k in (("cur_chunkT", k_cur), ("flat3", k_flat3),
+                   ("chunk_noT", k_chunk_noT), ("no_stats", k_no_stats),
+                   ("no_valid", k_no_valid)):
+        vm = jax.vmap(lambda c, p, n, _k=k: _k(c, p, n),
+                      in_axes=({kk: 0 for kk in lanes}, None, 0))
+
+        @jax.jit
+        def timed(cols, nd, zs, _vm=vm):
+            def body(c, z):
+                p = (jnp.int32(1) + z, jnp.int32(1) + z, jnp.int32(4) + z,
+                     jnp.int32(0) + z, jnp.int32(24) + z)
+                o = _vm(cols, p, nd)
+                return c + sum(v.astype(jnp.float32).sum()
+                               for v in o.values()), None
+            return jax.lax.scan(body, jnp.float32(0), zs)[0]
+
+        try:
+            ca = timed.lower(lanes, nd, zs1).compile().cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            log(f"{tag}: cost bytes={ca.get('bytes accessed', 0)/1e9:.2f}GB")
+        except Exception as e:  # noqa: BLE001
+            log(f"{tag}: cost_analysis unavailable ({e})")
+        results[tag] = slope_time(
+            lambda zs, _t=timed: _t(lanes, nd, zs), tag, zs1, zs2)
+    print(results)
+
+
+if __name__ == "__main__":
+    main()
