@@ -37,6 +37,7 @@ class ControllerApiServer(ApiServer):
         self.manager = controller.manager
         router = self.router
         router.add("GET", "/", self._console)
+        router.add("GET", "/ui", self._cluster_ui)
         router.add("GET", "/health", self._health)
         router.add("GET", "/schemas", self._list_schemas)
         router.add("POST", "/schemas", self._add_schema)
@@ -103,6 +104,13 @@ class ControllerApiServer(ApiServer):
         broker = request.query.get("broker", "127.0.0.1:8099")
         html = _CONSOLE_HTML.replace("__BROKER__", _html.escape(broker))
         return HttpResponse(200, html.encode("utf-8"),
+                            content_type="text/html; charset=utf-8")
+
+    async def _cluster_ui(self, request: HttpRequest) -> HttpResponse:
+        """Cluster manager UI (parity: the controller web app's cluster
+        views — tables / instances / tenants / schemas / segments),
+        driven entirely by the same-origin REST endpoints."""
+        return HttpResponse(200, _CLUSTER_UI_HTML.encode("utf-8"),
                             content_type="text/html; charset=utf-8")
 
     async def _health(self, request: HttpRequest) -> HttpResponse:
@@ -579,5 +587,77 @@ function render(j) {
 document.getElementById('pql').addEventListener('keydown', e => {
   if (e.ctrlKey && e.key === 'Enter') run();
 });
+</script></body></html>
+"""
+
+
+_CLUSTER_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>pinot_tpu cluster manager</title>
+<style>
+ body { font-family: monospace; margin: 2rem; background: #101418;
+        color: #d8dee6; }
+ h1 { font-size: 1.1rem; } h2 { font-size: 1rem; margin-top: 1.4rem; }
+ a { color: #7aa2f7; } pre { background: #181e24; padding: .7rem;
+     border: 1px solid #2c343c; overflow: auto; max-height: 24rem; }
+ table { border-collapse: collapse; margin-top: .6rem; }
+ td, th { border: 1px solid #2c343c; padding: .25rem .6rem;
+          text-align: left; }
+ .tag { background: #1f2a38; border-radius: 3px; padding: 0 .4rem;
+        margin-right: .3rem; }
+ button { cursor: pointer; padding: .2rem .6rem; }
+</style></head><body>
+<h1>pinot_tpu cluster manager
+  <small>(<a href="/">query console</a>)</small></h1>
+<h2>instances</h2><div id="instances">loading...</div>
+<h2>tenants</h2><div id="tenants">loading...</div>
+<h2>schemas</h2><div id="schemas">loading...</div>
+<h2>tables</h2><div id="tables">loading...</div>
+<h2>detail</h2><pre id="detail">click a table / schema for detail</pre>
+<script>
+const J = async p => (await fetch(p)).json();
+const esc = v => String(v).replace(/&/g,'&amp;').replace(/</g,'&lt;');
+async function detail(path) {
+  document.getElementById('detail').textContent =
+    JSON.stringify(await J(path), null, 2);
+}
+async function load() {
+  const inst = await J('/instances');
+  document.getElementById('instances').innerHTML =
+    '<table><tr><th>instance</th><th>tags</th></tr>' +
+    inst.map(i => '<tr><td>' + esc(i.name ?? i) + '</td><td>' +
+      ((i.tags ?? []).map(t => '<span class="tag">' + esc(t) +
+      '</span>').join('')) + '</td></tr>').join('') + '</table>';
+  const tenants = await J('/tenants');
+  document.getElementById('tenants').innerHTML =
+    (tenants.length ? tenants : ['(default only)']).map(esc).join(', ');
+  const schemas = await J('/schemas');
+  document.getElementById('schemas').innerHTML = schemas.map(s =>
+    '<a href="#" onclick="detail(\'/schemas/' + esc(s) +
+    '\');return false">' + esc(s) + '</a>').join(', ') || '(none)';
+  const tables = await J('/tables');
+  const names = tables.tables ?? tables;
+  const rows = [];
+  for (const t of names) {
+    let size = '?', segs = '?';
+    try {
+      const sz = await J('/tables/' + t + '/size');
+      size = (sz.reportedSizeInBytes ?? sz.sizeBytes ?? 0);
+      const sg = await J('/tables/' + t + '/segments');
+      segs = (sg.segments ?? sg).length;
+    } catch (e) {}
+    rows.push('<tr><td><a href="#" onclick="detail(\'/tables/' + esc(t) +
+      '\');return false">' + esc(t) + '</a></td><td>' + segs +
+      '</td><td>' + size + '</td>' +
+      '<td><a href="#" onclick="detail(\'/tables/' + esc(t) +
+      '/externalview\');return false">view</a></td>' +
+      '<td><a href="#" onclick="detail(\'/tables/' + esc(t) +
+      '/idealstate\');return false">ideal</a></td></tr>');
+  }
+  document.getElementById('tables').innerHTML =
+    '<table><tr><th>table</th><th>segments</th><th>bytes</th>' +
+    '<th>external view</th><th>ideal state</th></tr>' +
+    rows.join('') + '</table>';
+}
+load();
 </script></body></html>
 """

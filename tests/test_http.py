@@ -407,3 +407,16 @@ def test_controller_size_schema_and_pql_passthrough(tmp_path):
         assert out["aggregationResults"][0]["value"] == "800", out
     finally:
         c.stop()
+
+
+def test_cluster_manager_ui_served(http_cluster):
+    """/ui serves the cluster-manager page (controller web app parity)
+    wired to the same-origin REST endpoints."""
+    import urllib.request
+    cluster, _ctl, _conn, _oracle = http_cluster
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{cluster.controller_port}/ui",
+            timeout=10) as r:
+        body = r.read().decode("utf-8")
+    assert "cluster manager" in body
+    assert "/instances" in body and "/tables" in body
