@@ -9,6 +9,18 @@ device path.
 Parity note: this is the moral equivalent of the reference's scan-based
 operators (ScanBasedFilterOperator + DefaultAggregationExecutor /
 DefaultGroupByExecutor / SelectionOperator) executed columnar-vectorized.
+
+DELIBERATE TWIN DECISION (round 5): this module and ops/kernels.py both
+implement the full operator semantics. The duplication is intentional,
+not accidental: (a) the host twin doubles as the INDEPENDENT oracle the
+randomized agreement sweeps (tests/test_query_generator.py) compare the
+device path against — sharing a predicate-resolution layer would make
+the two paths fail together; (b) the performance-critical layouts
+diverge fundamentally (dictId-interval compares on padded lanes vs
+member-vector gathers on exact arrays), so a shared abstraction would
+be an interface with two disjoint implementations anyway. The cost — a
+new scalar function must be added twice — is bounded by the agreement
+sweep, which fails loudly when one side is missing or diverges.
 """
 from __future__ import annotations
 
