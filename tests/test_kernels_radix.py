@@ -109,3 +109,27 @@ def test_radix_onehots_reconstruct():
     direct = np.asarray(jnp.squeeze(
         jnp.asarray(np.eye(1024, dtype=np.float32))[idx]))
     np.testing.assert_array_equal(full.reshape(len(idx), 1024), direct)
+
+
+def test_part_sums_oversized_fallback_exact():
+    """_part_sums splits on 127 * padded < 2^31: the fast path fully
+    reduces on device ([n_parts]); past ~16.9M padded rows the partsT
+    block-partial fallback keeps int32 exact. Both must match an int64
+    reference."""
+    import numpy as np
+    from pinot_tpu.ops.kernels import BLOCK, _part_sums
+
+    rng = np.random.default_rng(5)
+    for padded, expect_reduced in ((4 * BLOCK, True),
+                                   (2065 * BLOCK, False)):   # >16.9M
+        assert (127 * padded < 2**31) == expect_reduced
+        lanes = rng.integers(0, 128, (2, padded)).astype(np.int8)
+        mask = rng.random(padded) < 0.37
+        sums, reduced = _part_sums(jnp.asarray(lanes), jnp.asarray(mask))
+        assert reduced is expect_reduced
+        got = np.asarray(sums).astype(np.int64)
+        if not reduced:
+            assert got.shape == (2, padded // BLOCK)
+            got = got.sum(axis=1)
+        ref = (lanes.astype(np.int64) * mask[None, :]).sum(axis=1)
+        assert np.array_equal(got, ref)
