@@ -67,6 +67,7 @@ def _bind(cdll: ctypes.CDLL) -> None:
                               ctypes.c_uint32, ctypes.c_double,
                               ctypes.c_void_p)
     cdll.pack_bits_u32.argtypes = [vp, i64, ctypes.c_int, vp, i64]
+    cdll.unpack_bits_u32.argtypes = [vp, i64, ctypes.c_int, i64, vp]
     cdll.group_index_i64.restype = i64
     cdll.group_index_i64.argtypes = [vp, i64, vp, vp]
     cdll.group_counts_i64.argtypes = [vp, i64, i64, vp]
@@ -94,6 +95,17 @@ def pack_bits(ids: np.ndarray, num_bits: int) -> Optional[np.ndarray]:
     n_words = (n * num_bits + 31) // 32
     out = np.empty(n_words, np.uint32)
     L.pack_bits_u32(_ptr(ids), n, num_bits, _ptr(out), n_words)
+    return out
+
+
+def unpack_bits(words: np.ndarray, num_bits: int,
+                n: int) -> Optional[np.ndarray]:
+    L = lib()
+    if L is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    out = np.empty(n, np.int32)
+    L.unpack_bits_u32(_ptr(words), len(words), num_bits, n, _ptr(out))
     return out
 
 

@@ -39,6 +39,25 @@ void pack_bits_u32(const int32_t* ids, int64_t n, int nb, uint32_t* out,
     if (fill > 0 && w < n_words) out[w] = (uint32_t)acc;
 }
 
+// inverse of pack_bits_u32: dense little-endian bitstream -> ids
+void unpack_bits_u32(const uint32_t* words, int64_t n_words, int nb,
+                     int64_t n, int32_t* out) {
+    uint64_t acc = 0;
+    int fill = 0;
+    int64_t w = 0;
+    const uint32_t mask = (nb >= 32) ? 0xFFFFFFFFu
+                                     : ((1u << nb) - 1u);
+    for (int64_t i = 0; i < n; ++i) {
+        while (fill < nb && w < n_words) {
+            acc |= (uint64_t)words[w++] << fill;
+            fill += 32;
+        }
+        out[i] = (int32_t)(acc & mask);
+        acc >>= nb;
+        fill -= nb;
+    }
+}
+
 // ---------------------------------------------------------------------------
 // group_index_i64: row keys -> per-row group ranks (sorted-key order) +
 // sorted unique keys. Open-addressing hash (splitmix64 mix), then the

@@ -64,6 +64,10 @@ def pack_bits(ids: np.ndarray, num_bits: int) -> np.ndarray:
 
 def unpack_bits(words: np.ndarray, num_bits: int, n: int) -> np.ndarray:
     """Inverse of pack_bits → int32[n]."""
+    from pinot_tpu import native
+    out = native.unpack_bits(words, num_bits, n)
+    if out is not None:
+        return out
     byts = np.ascontiguousarray(words, dtype="<u4").view(np.uint8)
     flat = np.unpackbits(byts, bitorder="little", count=n * num_bits)
     padded = np.zeros((n, 32), np.uint8)
