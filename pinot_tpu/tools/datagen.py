@@ -250,7 +250,14 @@ def ssb_schema():
 SSB_STAR_TREE_CONFIGS = [
     {"dimensionsSplitOrder": ["s_region", "p_brand1", "d_year",
                               "p_category"],
-     "metrics": ["lo_revenue"]},                      # Q2.1-2.3
+     "metrics": ["lo_revenue"]},                      # Q2.2-2.3
+    # Q2.1's EQ pair (s_region, p_category) leads its own cube so the
+    # prefix descent lands on tens of rows instead of a region-block
+    # residual scan (the chooser ranks by prefix depth, so Q2.2/2.3
+    # keep the brand1-leading cube above)
+    {"dimensionsSplitOrder": ["s_region", "p_category", "p_brand1",
+                              "d_year"],
+     "metrics": ["lo_revenue"]},                      # Q2.1
     {"dimensionsSplitOrder": ["c_region", "s_region", "c_nation",
                               "s_nation", "d_year"],
      "metrics": ["lo_revenue"]},                      # Q3.1
