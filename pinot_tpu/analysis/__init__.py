@@ -1,0 +1,38 @@
+"""tpulint — JAX-aware static analysis for the pinot_tpu codebase.
+
+The performance-native components of this datastore (columnar scan,
+bitmap intersection, hash group-by, star-tree traversal) are XLA
+kernels, so the correctness-and-speed story hinges on JAX-specific
+hazards the reference Java codebase never had:
+
+- silent device→host transfers on the kernel path (``host-sync``)
+- retracing / recompilation storms from unhashable or mutable jit
+  inputs (``retrace``)
+- 64-bit literals silently downcast when x64 is disabled, and int32
+  doc-id arithmetic that can overflow (``dtype-drift``)
+- server/realtime class state mutated across threads without a held
+  lock (``concurrency``)
+- JAX symbols absent from the installed version or on a deprecation
+  denylist — the exact class of break that took out the seed's 33
+  shard_map tests (``api-compat``)
+
+Usage::
+
+    python -m pinot_tpu.analysis pinot_tpu/            # lint the tree
+    python -m pinot_tpu.analysis --write-baseline ...  # grandfather
+    # per-line:  <code>  # tpulint: disable=host-sync -- reason
+    # per-file:  # tpulint: disable-file=concurrency -- reason
+
+See docs/ANALYSIS.md for the rule catalogue and baseline workflow.
+"""
+from pinot_tpu.analysis.core import (AnalysisConfig, Finding, Rule,
+                                     all_rules, load_baseline,
+                                     write_baseline)
+from pinot_tpu.analysis.runner import (AnalysisResult, analyze_paths,
+                                       analyze_source, diff_baseline)
+
+__all__ = [
+    "AnalysisConfig", "AnalysisResult", "Finding", "Rule", "all_rules",
+    "analyze_paths", "analyze_source", "diff_baseline", "load_baseline",
+    "write_baseline",
+]

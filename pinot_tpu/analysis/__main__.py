@@ -1,0 +1,93 @@
+"""tpulint CLI: `python -m pinot_tpu.analysis [paths...]`.
+
+Exits nonzero on findings NOT covered by the committed baseline (or on
+stale baseline entries with --strict-baseline, which CI uses so the
+grandfather list only ever shrinks). Run from the repo root so finding
+keys match the baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from pinot_tpu.analysis import core, runner
+
+DEFAULT_BASELINE = "tpulint.baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.analysis",
+        description="JAX-aware static analysis for pinot_tpu")
+    ap.add_argument("paths", nargs="*", default=["pinot_tpu"],
+                    help="files/directories to lint (repo-relative)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline JSON (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from this run and exit 0")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="also fail on stale baseline entries (CI mode)")
+    ap.add_argument("--rule", action="append", dest="rules", default=None,
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(core.all_rules().items()):
+            print(f"{rid:12s} {rule.description}")
+        return 0
+
+    known = set(core.all_rules())
+    if args.rules and not set(args.rules) <= known:
+        bad = sorted(set(args.rules) - known)
+        print(f"tpulint: unknown rule id(s) {bad}; known: "
+              f"{sorted(known)}", file=sys.stderr)
+        return 2
+
+    result = runner.analyze_paths(
+        args.paths, rule_ids=set(args.rules) if args.rules else None)
+    for err in result.errors:
+        print(f"tpulint: error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        if result.errors:
+            print("tpulint: refusing to write a baseline from a run "
+                  "with analysis errors", file=sys.stderr)
+            return 1
+        core.write_baseline(args.baseline, result.findings)
+        print(f"tpulint: wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = core.load_baseline(args.baseline)
+    new, stale = runner.diff_baseline(result, baseline)
+
+    if args.show_suppressed:
+        for f in result.suppressed:
+            print(f"suppressed: {f.render()}")
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(f"tpulint: stale baseline entry (code fixed — regenerate "
+              f"with --write-baseline): {key}")
+
+    n_grandfathered = len(result.findings) - len(new)
+    by_rule = ", ".join(f"{r}={n}" for r, n in
+                        sorted(result.by_rule().items())) or "none"
+    print(f"tpulint: {len(result.findings)} finding(s) [{by_rule}], "
+          f"{len(new)} new, {n_grandfathered} grandfathered, "
+          f"{len(result.suppressed)} suppressed, {len(stale)} stale "
+          "baseline entr(ies)")
+    if new or result.errors or (stale and args.strict_baseline):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

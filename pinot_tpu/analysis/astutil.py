@@ -1,0 +1,120 @@
+"""Shared AST helpers: alias-aware dotted-name resolution, jit detection.
+
+Every rule works on resolved dotted paths (``jnp.sum`` → ``jax.numpy.sum``)
+so rules match semantics, not spelling. Resolution is purely syntactic —
+it follows ``import``/``from ... import`` aliases within one file, which
+is exactly the granularity an AST linter can promise.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+
+def collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name → fully dotted path, from this module's imports.
+
+    ``import jax.numpy as jnp`` → {"jnp": "jax.numpy"};
+    ``from jax import lax`` → {"lax": "jax.lax"};
+    ``import jax`` → {"jax": "jax"}; likewise for numpy and everything
+    else (resolution is generic; rules filter by root).
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                aliases[local] = a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted chain with its root rewritten through the import aliases."""
+    d = dotted(node)
+    if d is None:
+        return None
+    root, _, rest = d.partition(".")
+    base = aliases.get(root, root)
+    return f"{base}.{rest}" if rest else base
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name underlying an expression, looking through
+    attribute access, subscripts and method-call receivers
+    (``outs.get(...)`` → ``outs``; ``a[i].x`` → ``a``)."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+_JIT_PATHS = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+def is_jit_expr(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """True for ``jax.jit`` / aliased jit, bare or via functools.partial."""
+    if resolve(node, aliases) in _JIT_PATHS:
+        return True
+    if isinstance(node, ast.Call):
+        f = resolve(node.func, aliases)
+        if f in _JIT_PATHS:
+            return True
+        if f in ("functools.partial", "partial") and node.args and \
+                resolve(node.args[0], aliases) in _JIT_PATHS:
+            return True
+    return False
+
+
+def is_jitted(fn: ast.AST, aliases: Dict[str, str]) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    return any(is_jit_expr(d, aliases) for d in fn.decorator_list)
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_shallow(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function
+    definitions (their hazards are judged in their own scope)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None

@@ -1,0 +1,175 @@
+"""tpulint core: findings, rule registry, suppressions, baseline file.
+
+Design notes
+------------
+- A Finding's baseline identity (`key()`) deliberately excludes the line
+  number so unrelated edits above a grandfathered finding don't churn
+  the baseline; identity is (path, rule, message) with multiplicity.
+- Suppressions are trailing comments on the flagged line
+  (``# tpulint: disable=RULE[,RULE...][ -- reason]``) or file-level
+  (``# tpulint: disable-file=RULE``); ``all`` matches every rule.
+  The ``-- reason`` tail is required style for hand-written
+  suppressions (enforced by review, not by the tool).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, Iterable, Iterator, List, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str          # repo-relative, posix separators
+    line: int          # 1-based
+    rule: str
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity — line-number free (see module docstring)."""
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisConfig:
+    """Scoping knobs: which parts of the tree each rule family watches."""
+
+    # modules whose functions feed (or are) jitted kernels: a silent
+    # device→host pull here stalls the pipeline per dispatch
+    kernel_path_prefixes: Tuple[str, ...] = (
+        "pinot_tpu/query/", "pinot_tpu/parallel/", "pinot_tpu/startree/",
+        "pinot_tpu/ops/")
+    # modules whose classes are touched by scheduler workers, consumer
+    # threads and state-transition threads concurrently
+    concurrency_prefixes: Tuple[str, ...] = (
+        "pinot_tpu/server/", "pinot_tpu/realtime/", "pinot_tpu/segment/",
+        "pinot_tpu/parallel/")
+
+
+class Rule:
+    """One rule family. Subclasses set `id`/`description`, yield Findings."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, ctx) -> Iterator[Finding]:  # ctx: runner.FileContext
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    assert inst.id and inst.id not in _REGISTRY, inst.id
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    # importing the package registers every rule module
+    from pinot_tpu.analysis import rules as _rules  # noqa: F401
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable(?P<scope>-file)?="
+    r"(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line → suppressed rule ids, file-level rule ids). Lines 1-based."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",")}
+        if m.group("scope"):
+            per_file |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, per_file
+
+
+def is_suppressed(finding: Finding, per_line: Dict[int, Set[str]],
+                  per_file: Set[str]) -> bool:
+    line_rules = per_line.get(finding.line, set())
+    return ("all" in per_file or finding.rule in per_file or
+            "all" in line_rules or finding.rule in line_rules)
+
+
+# ---------------------------------------------------------------------------
+# Baseline file
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def count_keys(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return {str(k): int(v) for k, v in data["findings"].items()}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": ("grandfathered tpulint findings; regenerate with "
+                    "`python -m pinot_tpu.analysis pinot_tpu/ "
+                    "--write-baseline` from the repo root"),
+        "findings": dict(sorted(count_keys(findings).items())),
+    }
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1)
+        fh.write("\n")
+
+
+def split_by_baseline(findings: List[Finding], baseline: Dict[str, int]
+                      ) -> Tuple[List[Finding], List[str]]:
+    """(new findings, stale baseline keys).
+
+    Per key the first `baseline[key]` occurrences are grandfathered;
+    occurrences beyond that are NEW. Baseline keys with fewer fresh
+    occurrences than recorded are STALE (fixed code — prune them).
+    """
+    fresh = count_keys(findings)
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in sorted(findings):
+        n = seen.get(f.key(), 0)
+        seen[f.key()] = n + 1
+        if n >= baseline.get(f.key(), 0):
+            new.append(f)
+    stale = [k for k, v in sorted(baseline.items())
+             if fresh.get(k, 0) < v]
+    return new, stale

@@ -1,0 +1,6 @@
+"""Rule modules — importing this package registers every rule."""
+from pinot_tpu.analysis.rules import (api_compat, concurrency, dtype_drift,
+                                      host_sync, retrace)
+
+__all__ = ["api_compat", "concurrency", "dtype_drift", "host_sync",
+           "retrace"]

@@ -1,0 +1,123 @@
+"""concurrency: unguarded attribute mutation in threaded classes.
+
+Server/realtime classes are touched by scheduler worker threads,
+partition-consumer threads and state-transition threads at once. The
+rule: inside modules on the concurrency watchlist, any ``self.X = ...``
+(or ``self.X[k] = ...`` / ``self.X += ...``) OUTSIDE ``__init__`` must
+happen under a ``with self.<lock>:`` where ``<lock>`` is a
+``threading.Lock``/``RLock``/``Condition`` declared on the class.
+Classes that declare no lock at all get every non-init mutation
+flagged — either the class needs a lock or the single-writer argument
+belongs in a suppression reason next to the mutation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+_INIT_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+
+
+def _lock_attrs(cls: ast.ClassDef, aliases) -> Set[str]:
+    """self.X assigned anywhere in the class from a Lock/RLock/Condition."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                astutil.resolve(node.value.func, aliases) in _LOCK_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    locks.add(tgt.attr)
+    return locks
+
+
+def _self_attr_of_target(tgt: ast.AST) -> str:
+    """'X' when tgt writes self.X or self.X[...]; '' otherwise."""
+    if isinstance(tgt, ast.Subscript):
+        tgt = tgt.value
+    if isinstance(tgt, ast.Attribute) and \
+            isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+        return tgt.attr
+    return ""
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect unguarded self-mutations, tracking the with-lock stack."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.depth = 0           # nested `with self.<lock>:` depth
+        self.hits: List[ast.AST] = []   # (node, attr) pairs
+
+    def visit_With(self, node: ast.With) -> None:
+        held = any(
+            _self_attr_of_target(item.context_expr) in self.lock_attrs
+            for item in node.items)
+        if held:
+            self.depth += 1
+        self.generic_visit(node)
+        if held:
+            self.depth -= 1
+
+    def _record(self, node: ast.AST, targets) -> None:
+        if self.depth:
+            return
+        for tgt in targets:
+            attr = _self_attr_of_target(tgt)
+            if attr and attr not in self.lock_attrs:
+                self.hits.append((node, attr))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record(node, [node.target])
+        self.generic_visit(node)
+
+
+@register
+class ConcurrencyRule(Rule):
+    id = "concurrency"
+    description = ("attributes of server/realtime classes mutated "
+                   "outside __init__ without holding a lock declared "
+                   "on the class")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if not ctx.in_prefixes(ctx.config.concurrency_prefixes):
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls, ctx.aliases)
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name in _INIT_METHODS:
+                    continue
+                scan = _MethodScan(locks)
+                scan.visit(method)
+                for node, attr in scan.hits:
+                    if locks:
+                        msg = (f"`{cls.name}.{method.name}` mutates "
+                               f"self.{attr} without holding "
+                               f"{'/'.join(sorted(locks))}")
+                    else:
+                        msg = (f"`{cls.name}.{method.name}` mutates "
+                               f"self.{attr} but the class declares no "
+                               "lock — add one or justify the "
+                               "single-writer invariant in a "
+                               "suppression reason")
+                    yield ctx.finding(self.id, node, msg)

@@ -1,0 +1,101 @@
+"""dtype-drift: 64-bit literals on the JAX path, int32 overflow casts.
+
+On TPU, x64 is disabled: a ``dtype=jnp.float64``/``int64`` reaching a
+``jnp`` op is SILENTLY downcast to 32 bits — sums lose integer
+exactness past 2^24 (f32) and doc-id math wraps past 2^31. The flip
+side: narrowing a fresh arithmetic result straight to int32 (e.g. a
+doc-count × width product) overflows for the 100M-row segments this
+engine targets. Host-side numpy 64-bit math is exempt — that's where
+exact combines are SUPPOSED to happen.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+_WIDE = {"jax.numpy.float64", "jax.numpy.int64", "jax.numpy.uint64",
+         "numpy.float64", "numpy.int64", "numpy.uint64"}
+_WIDE_STR = {"float64", "int64", "uint64"}
+_NARROW_I32 = {"jax.numpy.int32", "numpy.int32"}
+
+
+def _dtype_is_wide(node: ast.AST, aliases) -> Optional[str]:
+    d = astutil.resolve(node, aliases)
+    if d in _WIDE:
+        return d
+    s = astutil.const_str(node)
+    if s in _WIDE_STR:
+        return s
+    return None
+
+
+def _contains_arith(node: ast.AST) -> bool:
+    """Growth-capable arithmetic over at least one non-constant operand
+    (a pure-literal expression like ``2**31 - 1`` can't overflow at
+    runtime — it's a compile-time constant)."""
+    has_op = any(isinstance(n, ast.BinOp) and
+                 isinstance(n.op, (ast.Mult, ast.Add, ast.Pow, ast.LShift))
+                 for n in ast.walk(node))
+    has_var = any(isinstance(n, (ast.Name, ast.Attribute, ast.Subscript,
+                                 ast.Call))
+                  for n in ast.walk(node))
+    return has_op and has_var
+
+
+@register
+class DtypeDriftRule(Rule):
+    id = "dtype-drift"
+    description = ("64-bit dtypes reaching jnp ops (silently downcast "
+                   "when x64 is off) and int32 casts of arithmetic "
+                   "results (doc-id overflow)")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = astutil.resolve(node.func, ctx.aliases)
+            # jnp.full(..., dtype=jnp.int64) and friends
+            if callee and callee.startswith("jax."):
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    wide = _dtype_is_wide(kw.value, ctx.aliases)
+                    if wide:
+                        yield ctx.finding(
+                            self.id, kw.value,
+                            f"dtype={wide} passed to {callee} — silently "
+                            "downcast to 32 bits when x64 is disabled "
+                            "(TPU default); keep 64-bit math host-side")
+            # jnp.int64(x) / jnp.float64(x) scalar constructors
+            if callee in ("jax.numpy.int64", "jax.numpy.uint64",
+                          "jax.numpy.float64"):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{callee.replace('jax.numpy.', 'jnp.')}(...) is a "
+                    "32-bit value when x64 is disabled — the wide width "
+                    "exists only on the CPU/x64 test path")
+            # (a * b).astype(np.int32): narrowing a fresh product
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "astype" and node.args:
+                target = astutil.resolve(node.args[0], ctx.aliases) or \
+                    astutil.const_str(node.args[0])
+                if target in _NARROW_I32 or target == "int32":
+                    if isinstance(node.func.value, ast.BinOp) and \
+                            _contains_arith(node.func.value):
+                        yield ctx.finding(
+                            self.id, node,
+                            "int32 cast applied directly to an arithmetic "
+                            "result — doc-id scale products overflow "
+                            "int32; combine in int64 first, narrow last")
+            # np.int32(a * b)
+            if callee in _NARROW_I32 and node.args and \
+                    isinstance(node.args[0], ast.BinOp) and \
+                    _contains_arith(node.args[0]):
+                yield ctx.finding(
+                    self.id, node,
+                    "int32() around an arithmetic expression — doc-id "
+                    "scale products overflow int32; compute in int64 "
+                    "and narrow after bounds-checking")

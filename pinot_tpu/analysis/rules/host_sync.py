@@ -1,0 +1,161 @@
+"""host-sync: silent device→host transfers on the kernel path.
+
+``.item()``, ``int()/float()`` over ``np.asarray(...)``, and
+``np.nonzero`` applied to a device array each force a blocking
+device→host copy — per call. Inside a jit trace they are worse:
+numpy on a tracer is a trace-time concretization error, or silently
+constant-folds. On the (non-jitted) kernel path the fix is batching:
+ONE explicit ``jax.device_get`` per dispatch, host math after.
+
+Host-evidence dataflow: a name assigned from ``jax.device_get(...)``
+or any ``numpy.*`` call is proven host-side and never flagged; a name
+assigned from a ``jax.*``/``jax.numpy.*`` call is device-tainted. The
+rule stays quiet on values it can't classify except for the explicit
+sync idioms (``.item()``, ``int(np.asarray(..))``, ``np.nonzero``)
+whose only purpose is pulling data to the host.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+_NP_SYNC = {"numpy.asarray", "numpy.array", "numpy.nonzero"}
+_NP_ASARRAY = {"numpy.asarray", "numpy.array"}
+
+
+def _classify_names(fn: ast.AST, aliases: Dict[str, str]
+                    ) -> (Set[str], Set[str]):
+    """(host-proven names, device-tainted names) for one function body."""
+    host: Set[str] = set()
+    device: Set[str] = set()
+    for node in astutil.walk_shallow(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        # hist = np.asarray(outs[...])[: n] — classify through slicing
+        while isinstance(val, ast.Subscript):
+            val = val.value
+        if not isinstance(val, ast.Call):
+            continue
+        callee = astutil.resolve(val.func, aliases)
+        if callee is None and isinstance(val.func, ast.Call):
+            # e.g. jax.vmap(f)(x): classify by the inner callee
+            callee = astutil.resolve(val.func.func, aliases)
+        if callee is None:
+            continue
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if callee == "jax.device_get" or callee.startswith("numpy."):
+                host.add(tgt.id)
+            elif callee == "jax.device_put":
+                device.add(tgt.id)
+            elif callee.split(".")[0] == "jax":
+                device.add(tgt.id)
+    return host - device, device
+
+
+def _np_call(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    d = astutil.resolve(call.func, aliases)
+    return d if d in _NP_SYNC else None
+
+
+@register
+class HostSyncRule(Rule):
+    id = "host-sync"
+    description = ("device→host sync (.item/int/float/np.asarray/"
+                   "np.nonzero on device values) on the kernel path or "
+                   "inside a jitted function")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        on_kernel_path = ctx.in_prefixes(ctx.config.kernel_path_prefixes)
+        for fn in astutil.iter_functions(ctx.tree):
+            jitted = astutil.is_jitted(fn, ctx.aliases)
+            if not (jitted or on_kernel_path):
+                continue
+            yield from self._check_fn(ctx, fn, jitted)
+
+    def _check_fn(self, ctx, fn, jitted: bool) -> Iterator[Finding]:
+        host, device = _classify_names(fn, ctx.aliases)
+
+        def is_host(node: ast.AST) -> bool:
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            if isinstance(node, ast.Call):
+                # a numpy call's RESULT is host by construction (any
+                # device→host sync it performs is flagged at ITS site)
+                callee = astutil.resolve(node.func, ctx.aliases)
+                if callee is not None and callee.startswith("numpy."):
+                    return True
+            r = astutil.root_name(node)
+            return r in host
+
+        def is_device(node: ast.AST) -> bool:
+            r = astutil.root_name(node)
+            return r in device
+
+        for node in astutil.walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.item()
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                if not is_host(node.func.value):
+                    yield ctx.finding(
+                        self.id, node,
+                        ".item() forces a blocking device→host transfer "
+                        "per element — batch with one jax.device_get per "
+                        "dispatch")
+                continue
+            callee = astutil.resolve(node.func, ctx.aliases)
+            # np.asarray / np.array / np.nonzero
+            if callee in _NP_SYNC:
+                arg = node.args[0] if node.args else None
+                if jitted:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{callee.replace('numpy.', 'np.')} inside a "
+                        "jitted function concretizes the tracer (host "
+                        "round-trip or trace error)")
+                elif arg is not None and is_device(arg):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{callee.replace('numpy.', 'np.')} on a device "
+                        "array syncs device→host — use an explicit "
+                        "batched jax.device_get")
+                elif callee == "numpy.nonzero" and arg is not None and \
+                        not is_host(arg):
+                    yield ctx.finding(
+                        self.id, node,
+                        "np.nonzero on a possibly-device value syncs "
+                        "device→host — device_get the operand first")
+                continue
+            # int(...) / float(...) / bool(...)
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in ("int", "float", "bool") and \
+                    len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant):
+                    continue
+                wrapped_np = (isinstance(arg, ast.Call) and
+                              astutil.resolve(arg.func, ctx.aliases)
+                              in _NP_ASARRAY)
+                if jitted:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{node.func.id}() on a traced value inside a "
+                        "jitted function forces concretization")
+                elif wrapped_np and arg.args and not is_host(arg.args[0]):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{node.func.id}(np.asarray(..)) pulls one scalar "
+                        "device→host per call — batch the transfers into "
+                        "one jax.device_get per combine")
+                elif is_device(arg):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{node.func.id}() on a device array blocks on a "
+                        "device→host transfer")

@@ -1,0 +1,127 @@
+"""retrace: jit inputs that defeat the compilation cache.
+
+jax.jit caches by (shapes, dtypes, static-arg VALUES). Unhashable
+Python arguments (lists/dicts/sets) raise at call time when marked
+static and retrace-per-call when not; mutable defaults and mutable
+module globals closed over by a jitted function bake trace-time state
+into the executable (silent staleness) or retrace on every identity
+change. ``jax.jit`` inside a loop builds a fresh cache per iteration —
+the classic recompilation storm.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = {"list", "dict", "set", "collections.defaultdict",
+                  "collections.OrderedDict", "collections.deque"}
+_UNHASHABLE_ANN = {"list", "dict", "set", "List", "Dict", "Set",
+                   "typing.List", "typing.Dict", "typing.Set"}
+
+
+def _is_mutable_value(node: ast.AST, aliases) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call):
+        return astutil.resolve(node.func, aliases) in _MUTABLE_CTORS
+    return False
+
+
+def _module_mutable_globals(tree: ast.Module, aliases) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and \
+                _is_mutable_value(stmt.value, aliases):
+            names.update(t.id for t in stmt.targets
+                         if isinstance(t, ast.Name))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name) and \
+                _is_mutable_value(stmt.value, aliases):
+            names.add(stmt.target.id)
+    return names
+
+
+@register
+class RetraceRule(Rule):
+    id = "retrace"
+    description = ("jitted functions taking unhashable/mutable Python "
+                   "args, closing over mutable state, or jax.jit built "
+                   "inside a loop")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        mutable_globals = _module_mutable_globals(ctx.tree, ctx.aliases)
+        for fn in astutil.iter_functions(ctx.tree):
+            if astutil.is_jitted(fn, ctx.aliases):
+                yield from self._check_jitted_fn(ctx, fn, mutable_globals)
+        yield from self._check_jit_in_loop(ctx)
+
+    def _check_jitted_fn(self, ctx, fn, mutable_globals: Set[str]
+                         ) -> Iterator[Finding]:
+        # (a) mutable defaults — unhashable as static, identity-keyed as
+        # traced: either way the cache can never hit
+        args = fn.args
+        all_defaults = list(args.defaults) + list(args.kw_defaults or [])
+        for d in all_defaults:
+            if d is not None and _is_mutable_value(d, ctx.aliases):
+                yield ctx.finding(
+                    self.id, d,
+                    f"jitted `{fn.name}` has a mutable default argument — "
+                    "unhashable under static_argnums, retraces otherwise")
+        # (b) parameters annotated as unhashable containers
+        for a in list(args.args) + list(args.kwonlyargs) + \
+                list(getattr(args, "posonlyargs", [])):
+            if a.annotation is None:
+                continue
+            ann = a.annotation
+            if isinstance(ann, ast.Subscript):
+                ann = ann.value
+            d = astutil.resolve(ann, ctx.aliases)
+            if d in _UNHASHABLE_ANN:
+                yield ctx.finding(
+                    self.id, a,
+                    f"jitted `{fn.name}` takes `{a.arg}: {d}` — "
+                    "unhashable Python container as a jit argument "
+                    "(pass a tuple, or restructure as a pytree leaf)")
+        # (c) closing over mutable module state / object attributes
+        reported: Set[str] = set()
+        local_names = {a.arg for a in list(args.args) +
+                       list(args.kwonlyargs) +
+                       list(getattr(args, "posonlyargs", []))}
+        for node in astutil.walk_shallow(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in mutable_globals and \
+                    node.id not in local_names and node.id not in reported:
+                reported.add(node.id)
+                yield ctx.finding(
+                    self.id, node,
+                    f"jitted `{fn.name}` closes over mutable module "
+                    f"global `{node.id}` — its trace-time contents are "
+                    "baked into the executable")
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and "self" not in reported:
+                reported.add("self")
+                yield ctx.finding(
+                    self.id, node,
+                    f"jitted `{fn.name}` reads `self.{node.attr}` — "
+                    "object state freezes at trace time and keys no "
+                    "cache entry (jit a pure function of its inputs)")
+
+    def _check_jit_in_loop(self, ctx) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.Call) and \
+                        astutil.is_jit_expr(node, ctx.aliases):
+                    yield ctx.finding(
+                        self.id, node,
+                        "jax.jit constructed inside a loop — every "
+                        "iteration builds a fresh cache (hoist the jit "
+                        "out of the loop)")

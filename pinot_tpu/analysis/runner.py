@@ -1,0 +1,112 @@
+"""tpulint runner: walk files, run rules, apply suppressions + baseline."""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis.core import (AnalysisConfig, Finding, all_rules,
+                                     is_suppressed, parse_suppressions,
+                                     split_by_baseline)
+
+
+class FileContext:
+    """Everything a rule needs about one file (parsed once, shared)."""
+
+    def __init__(self, path: str, source: str,
+                 config: Optional[AnalysisConfig] = None):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.config = config or AnalysisConfig()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = astutil.collect_aliases(self.tree)
+
+    def in_prefixes(self, prefixes: Sequence[str]) -> bool:
+        return any(self.path.startswith(p) or f"/{p}" in f"/{self.path}"
+                   for p in prefixes)
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(path=self.path, line=getattr(node, "lineno", 1),
+                       rule=rule, message=message)
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]                 # kept (not suppressed)
+    suppressed: List[Finding]
+    errors: List[str]                       # unparseable files etc.
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def analyze_source(source: str, path: str,
+                   config: Optional[AnalysisConfig] = None,
+                   rule_ids: Optional[Set[str]] = None) -> AnalysisResult:
+    """Analyze one file's source under a (possibly virtual) repo path."""
+    try:
+        ctx = FileContext(path, source, config)
+    except SyntaxError as e:
+        return AnalysisResult([], [], [f"{path}: syntax error: {e}"])
+    per_line, per_file = parse_suppressions(source)
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule_id, rule in sorted(all_rules().items()):
+        if rule_ids is not None and rule_id not in rule_ids:
+            continue
+        for f in rule.check(ctx):
+            (suppressed if is_suppressed(f, per_line, per_file)
+             else kept).append(f)
+    kept.sort()
+    suppressed.sort()
+    return AnalysisResult(kept, suppressed, [])
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def analyze_paths(paths: Sequence[str],
+                  config: Optional[AnalysisConfig] = None,
+                  rule_ids: Optional[Set[str]] = None) -> AnalysisResult:
+    """Analyze every .py file under `paths` (files or directories).
+
+    Paths should be given relative to the repo root so finding keys
+    match the committed baseline.
+    """
+    total = AnalysisResult([], [], [])
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            total.errors.append(f"{path}: {e}")
+            continue
+        res = analyze_source(source, os.path.relpath(path), config,
+                             rule_ids)
+        total.findings.extend(res.findings)
+        total.suppressed.extend(res.suppressed)
+        total.errors.extend(res.errors)
+    total.findings.sort()
+    total.suppressed.sort()
+    return total
+
+
+def diff_baseline(result: AnalysisResult, baseline: Dict[str, int]
+                  ) -> Tuple[List[Finding], List[str]]:
+    """(new findings, stale baseline keys) for a finished run."""
+    return split_by_baseline(result.findings, baseline)

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pinot_tpu import compat
+from pinot_tpu.analysis.runtime import debug_transfer_guard
 from pinot_tpu.common.request import BrokerRequest
 from pinot_tpu.query import combine as combine_mod
 from pinot_tpu.query import execution
@@ -137,10 +139,11 @@ def get_sharded_kernel(mesh: Mesh, padded: int, filter_spec, agg_specs,
 
     # check_vma=False: outputs are replicated by construction (psum/pmin/
     # pmax/all_gather), but the static varying-axis check can't prove it
-    # for the all_gather'd selection lanes.
-    fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(col_specs, P(), P(SEG_AXIS)),
-                       out_specs=P(), check_vma=False)
+    # for the all_gather'd selection lanes. compat.shard_map resolves the
+    # installed spelling (jax.shard_map vs jax.experimental.shard_map).
+    fn = compat.shard_map(local, mesh=mesh,
+                          in_specs=(col_specs, P(), P(SEG_AXIS)),
+                          out_specs=P(), check_vma=False)
     return jax.jit(fn)
 
 
@@ -474,6 +477,14 @@ class ShardedQueryExecutor:
     def execute(self, request: BrokerRequest,
                 segments: Sequence[ImmutableSegment]
                 ) -> IntermediateResultsBlock:
+        # debug complement to tpulint host-sync: implicit device→host
+        # pulls raise under PINOT_TPU_DEBUG_TRANSFERS=1
+        with debug_transfer_guard():
+            return self._execute(request, segments)
+
+    def _execute(self, request: BrokerRequest,
+                 segments: Sequence[ImmutableSegment]
+                 ) -> IntermediateResultsBlock:
         t0 = time.perf_counter()
         from pinot_tpu.query.plan import preprocess_request
         preprocess_request(segments, request)   # FASTHLL derived rewrite
@@ -522,13 +533,14 @@ class ShardedQueryExecutor:
         lane_keys = tuple(sorted(cols.keys()))
 
         def run(agg_specs, group_spec, extra_params=()):
+            # returns DEVICE outs; drivers batch the device→host pull
+            # into one explicit jax.device_get per dispatch
             fn = get_sharded_kernel(
                 self.mesh, stack.padded_docs, plan.filter_spec,
                 tuple(agg_specs or ()), group_spec, plan.select_spec,
                 lane_keys)
-            return jax.device_get(fn(
-                cols, tuple(plan.params) + tuple(extra_params),
-                stack.device_num_docs()))
+            return fn(cols, tuple(plan.params) + tuple(extra_params),
+                      stack.device_num_docs())
 
         from pinot_tpu.query.plan import (drive_group_execution,
                                           set_group_kmax)
@@ -543,7 +555,7 @@ class ShardedQueryExecutor:
                 execution._finish_group_by(
                     execution._with_group_spec(plan, spec_used), outs, blk)
         else:
-            outs = run(plan.agg_specs, None, ())
+            outs = jax.device_get(run(plan.agg_specs, None, ()))
             if plan.agg_specs:
                 execution._finish_aggregation(plan, outs, blk)
         matched = int(outs["stats.num_docs_matched"])

@@ -258,7 +258,7 @@ class AggregationFunction:
 
 def _plain(v):
     if isinstance(v, np.generic):
-        return v.item()
+        return v.item()  # tpulint: disable=host-sync -- np.generic scalar: isinstance-guarded, host value
     return v
 
 

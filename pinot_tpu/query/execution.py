@@ -13,6 +13,7 @@ from typing import Dict, List, Tuple
 import jax
 import numpy as np
 
+from pinot_tpu.analysis.runtime import debug_transfer_guard
 from pinot_tpu.ops import kernels
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
 from pinot_tpu.segment.loader import ImmutableSegment
@@ -52,18 +53,28 @@ def gather_operands(plan) -> Dict[str, object]:
 def execute_segment_plan(plan) -> IntermediateResultsBlock:
     if plan.fast_path_result is not None:
         return plan.fast_path_result
+    # PINOT_TPU_DEBUG_TRANSFERS=1 turns any implicit device→host pull in
+    # the dispatch/finish path below into an error at the offending call
+    # site (the explicit batched jax.device_get per dispatch still works)
+    with debug_transfer_guard():
+        return _execute_segment_plan(plan)
 
+
+def _execute_segment_plan(plan) -> IntermediateResultsBlock:
     segment = plan.segment
     t0 = time.perf_counter()
     cols = gather_operands(plan)
     from pinot_tpu.query.plan import drive_group_execution
 
     def run(agg_specs, group_spec, extra_params=()):
-        return jax.device_get(kernels.run_segment_kernel(
+        # returns DEVICE outs; each driver batches the device→host pull
+        # into one explicit jax.device_get per dispatch (tpulint
+        # host-sync: never per-scalar)
+        return kernels.run_segment_kernel(
             segment.padded_docs, plan.filter_spec, agg_specs,
             group_spec, plan.select_spec, cols,
             tuple(plan.params) + tuple(extra_params),
-            segment.num_docs))
+            segment.num_docs)
 
     blk = IntermediateResultsBlock()
     if plan.group_spec is not None:
@@ -75,7 +86,7 @@ def execute_segment_plan(plan) -> IntermediateResultsBlock:
         else:
             _finish_group_by(_with_group_spec(plan, spec_used), outs, blk)
     else:
-        outs = run(plan.agg_specs, None, ())
+        outs = jax.device_get(run(plan.agg_specs, None, ()))
         if plan.agg_specs:
             _finish_aggregation(plan, outs, blk)
     matched = int(outs["stats.num_docs_matched"])
@@ -477,5 +488,5 @@ def _finish_selection(plan, outs, blk, matched: int) -> None:
 
 def _plain(v):
     if isinstance(v, np.generic):
-        return v.item()
+        return v.item()  # tpulint: disable=host-sync -- np.generic scalar: isinstance-guarded, host value
     return v

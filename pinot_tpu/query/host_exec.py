@@ -22,6 +22,9 @@ be an interface with two disjoint implementations anyway. The cost — a
 new scalar function must be added twice — is bounded by the agreement
 sweep, which fails loudly when one side is missing or diverges.
 """
+# tpulint: disable-file=host-sync -- every value on this path is host
+# numpy by construction (the device kernels never run here), so the
+# kernel-path sync heuristics don't apply.
 from __future__ import annotations
 
 import re as _re
@@ -568,7 +571,7 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
 
 def _plain(v):
     if isinstance(v, np.generic):
-        return v.item()
+        return v.item()  # tpulint: disable=host-sync -- np.generic scalar: isinstance-guarded, host value
     if isinstance(v, np.ndarray):
         return v.tolist()
     return v

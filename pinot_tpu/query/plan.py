@@ -22,6 +22,7 @@ import re as _re
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from pinot_tpu.common import expression as expr_mod
@@ -713,14 +714,17 @@ def escalate_group_kmax(group_spec: tuple, padded: int):
 
 
 def run_with_group_escalation(run, group_spec, padded: int):
-    """run(group_spec) → host outs; re-runs up the kmax ladder while the
-    compacted group kernel reports overflow. Returns (outs, final_spec)."""
-    outs = run(group_spec)
-    while group_spec is not None and \
-            int(np.asarray(outs.get("group.overflow", 0))) > 0:
+    """run(group_spec) → device outs; re-runs up the kmax ladder while
+    the compacted group kernel reports overflow. Returns the HOST outs
+    and the final spec — all of a dispatch's outputs come over in ONE
+    explicit jax.device_get (per-scalar pulls like the old
+    `int(np.asarray(outs[...]))` overflow probe stall the pipeline once
+    per output; see docs/ANALYSIS.md host-sync)."""
+    outs = jax.device_get(run(group_spec))
+    while group_spec is not None and int(outs.get("group.overflow", 0)) > 0:
         group_spec = escalate_group_kmax(group_spec, padded)
         assert group_spec is not None, "overflow at full kmax is impossible"
-        outs = run(group_spec)
+        outs = jax.device_get(run(group_spec))
     return outs, group_spec
 
 
@@ -914,9 +918,10 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     """Execution policy for device group-bys.
 
     `run(agg_specs, group_spec, extra_params)` dispatches the kernel and
-    returns host outs (extra_params are appended after the filter
-    operands). Filtered dictionary-keyed group-bys take the ADAPTIVE
-    path:
+    returns DEVICE outs (extra_params are appended after the filter
+    operands); this driver pulls each dispatch's outputs host-side in
+    one explicit batched jax.device_get. Filtered dictionary-keyed
+    group-bys take the ADAPTIVE path:
 
     - Phase A (scout): masked min/max of each group column's dictIds +
       the matched count — streaming tree reductions, about one filter
@@ -944,7 +949,9 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     pa = adaptive_phase_a_specs(group_spec) \
         if padded <= kernels.DENSE_ROWS_LIMIT else None
     if pa is not None:
-        ha = run(pa, None, ())
+        # one batched device→host transfer per scout dispatch; the
+        # per-bound int() reads below are host numpy, not device pulls
+        ha = jax.device_get(run(pa, None, ()))
         bounds = [(int(ha[f"agg{2 * i}.min"]), int(ha[f"agg{2 * i + 1}.max"]))
                   for i in range(len(pa) // 2)]
         matched = int(ha["stats.num_docs_matched"])
@@ -952,7 +959,7 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
         if matched > 0:
             ph = adaptive_hist_specs(group_spec, bounds)
             if ph is not None:
-                hh = run(ph, None, ())
+                hh = jax.device_get(run(ph, None, ()))
                 scout = [("present",
                           np.nonzero(np.asarray(hh[f"agg{i}"])[: c[3]])[0])
                          for i, c in enumerate(group_spec[0])]

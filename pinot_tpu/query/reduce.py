@@ -141,7 +141,7 @@ def _final_str(v):
 def _json_val(v):
     import numpy as np
     if isinstance(v, np.generic):
-        return v.item()
+        return v.item()  # tpulint: disable=host-sync -- np.generic scalar (broker-side reduce is all-numpy)
     if isinstance(v, bytes):
         return v.hex()
     return v

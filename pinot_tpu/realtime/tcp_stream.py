@@ -100,17 +100,17 @@ class TcpTopicServer:
         def run() -> None:
             asyncio.set_event_loop(self.loop)
             try:
-                self._server = self.loop.run_until_complete(
+                self._server = self.loop.run_until_complete(  # tpulint: disable=concurrency -- boot handshake: started.wait() orders this write before any reader
                     asyncio.start_server(self._serve, self.host, self.port))
             except BaseException as e:  # noqa: BLE001 — surface bind errors
                 boot["err"] = e
                 started.set()
                 return
-            self.port = self._server.sockets[0].getsockname()[1]
+            self.port = self._server.sockets[0].getsockname()[1]  # tpulint: disable=concurrency -- boot handshake: started.wait() orders this write before any reader
             started.set()
             self.loop.run_forever()
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True)  # tpulint: disable=concurrency -- single lifecycle thread creates the worker before exposing the object
         self._thread.start()
         started.wait()
         if boot["err"] is not None:
@@ -195,14 +195,16 @@ class TcpTopicClient:
         self.port = port
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        # RLock: close() locks too, and call() invokes it with the lock
+        # already held on transport errors (tpulint concurrency)
+        self._lock = threading.RLock()
         self._next_id = 0
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
-            self._sock = s
+            self._sock = s  # tpulint: disable=concurrency -- sole caller call() holds self._lock
         return self._sock
 
     def call(self, **req) -> dict:
@@ -242,12 +244,13 @@ class TcpTopicClient:
                   payloads=[base64.b64encode(payload).decode("ascii")])
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
 
 
 class TcpStreamConsumerFactory(StreamConsumerFactory):

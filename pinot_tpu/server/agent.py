@@ -45,15 +45,18 @@ class ParticipantAgent:
         if self.endpoint is not None:
             rec["host"], rec["port"] = self.endpoint[0], self.endpoint[1]
         self._set(f"{LIVE}/{self.instance_id}", rec)
-        self._watcher = self._on_ideal_change
-        self.store.watch(IDEAL + "/", self._watcher)
+        with self._lock:
+            self._watcher = self._on_ideal_change
+            watcher = self._watcher
+        self.store.watch(IDEAL + "/", watcher)
         self.reconcile_all()
 
     def stop(self) -> None:
         """Graceful departure (beyond the ephemeral-cleanup safety net)."""
-        if self._watcher is not None:
-            self.store.unwatch(self._watcher)
-            self._watcher = None
+        with self._lock:
+            watcher, self._watcher = self._watcher, None
+        if watcher is not None:
+            self.store.unwatch(watcher)
         self.store.remove(f"{LIVE}/{self.instance_id}")
         for path in self.store.list_paths(
                 f"{CURRENT}/{self.instance_id}/"):

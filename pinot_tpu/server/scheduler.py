@@ -237,17 +237,25 @@ class MultiLevelPriorityQueue:
         self._seq = 0
 
     def group(self, name: str) -> TokenSchedulerGroup:
+        """Get-or-create a group (takes the lock; tpulint concurrency
+        found the scheduler thread calling the unlocked variant —
+        two threads racing the same name could each build and account
+        against their own TokenSchedulerGroup)."""
+        with self._lock:
+            return self._group_locked(name)
+
+    def _group_locked(self, name: str) -> TokenSchedulerGroup:
         g = self._groups.get(name)
         if g is None:
             g = TokenSchedulerGroup(name, self.num_workers,
                                     self.token_lifetime_ms, self._clock)
-            self._groups[name] = g
+            self._groups[name] = g  # tpulint: disable=concurrency -- every caller holds self._lock (enforced by the public group())
         return g
 
     def put(self, group_name: str, fn: Callable[[], object]
             ) -> SchedulerQueryContext:
         with self._lock:
-            g = self.group(group_name)
+            g = self._group_locked(group_name)
             if len(g.pending) >= self.policy.max_pending_per_group and \
                     g.total_reserved_threads() >= \
                     self.policy.table_threads_hard_limit:
@@ -444,7 +452,7 @@ class TokenBucketScheduler(QueryScheduler):
         return self.queue.stats()
 
     def shutdown(self) -> None:
-        self._running = False
+        self._running = False  # tpulint: disable=concurrency -- single irreversible flip of a GIL-atomic bool; readers poll it, no compound invariant
         self.queue.wake()
         for ctx in self.queue.drain():
             ctx.future.set_exception(RuntimeError("scheduler is shut down"))
@@ -508,7 +516,7 @@ class BoundedFCFSScheduler(QueryScheduler):
                     skipped.append((seq, group))
                     continue
                 fn, future = self._pending[group].pop(0)
-                self._running[group] = self._running.get(group, 0) + 1
+                self._running[group] = self._running.get(group, 0) + 1  # tpulint: disable=concurrency -- only caller is _drain, which holds self._lock
                 return group, fn, future
             return None
         finally:

@@ -289,7 +289,7 @@ def _cube_select(segment, cube, tree: Optional[FilterQueryTree]
     from pinot_tpu.query import host_exec
     view = _CubeView(segment, cube)
     mask = host_exec._eval_filter(tree, view)
-    return np.nonzero(mask)[0], cube.n_groups
+    return np.nonzero(mask)[0], cube.n_groups  # tpulint: disable=host-sync -- mask is host numpy (host_exec filter eval)
 
 
 def try_star_tree_execute(segment, request: BrokerRequest

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# CI gate: tier-1 tests, then tpulint against the committed baseline.
+# Either failing fails the build.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 pytest =="
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
+echo "== tpulint =="
+exec "$(dirname "$0")/lint.sh"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# tpulint over the tree (or explicit paths), gated on the committed
+# baseline. Run from anywhere; executes at the repo root so finding
+# keys match tpulint.baseline.json.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pinot_tpu.analysis --strict-baseline "${@:-pinot_tpu/}"
