@@ -1,3 +1,5 @@
+from pinot_tpu.broker.fault_tolerance import (CircuitBreaker,
+                                              FaultToleranceManager)
 from pinot_tpu.broker.quota import HitCounter, QueryQuotaManager
 from pinot_tpu.broker.request_handler import (BrokerRequestHandler,
                                               InProcessTransport,
@@ -9,7 +11,8 @@ from pinot_tpu.broker.routing import (BalancedRandomRoutingTableBuilder,
 from pinot_tpu.broker.time_boundary import (TimeBoundaryService,
                                             attach_time_boundary)
 
-__all__ = ["HitCounter", "QueryQuotaManager", "BrokerRequestHandler",
+__all__ = ["CircuitBreaker", "FaultToleranceManager",
+           "HitCounter", "QueryQuotaManager", "BrokerRequestHandler",
            "InProcessTransport", "QueryRouter", "TcpTransport",
            "BalancedRandomRoutingTableBuilder",
            "LargeClusterRoutingTableBuilder",

@@ -1,0 +1,253 @@
+"""Broker-side fault tolerance: per-server health, circuit breakers,
+and hedge timing.
+
+The scatter-gather design (broker → per-server InstanceRequest → gather
+→ reduce) is only as good as its worst replica. This module gives the
+QueryRouter the three signals "The Tail at Scale" (Dean & Barroso, CACM
+2013) prescribes for fan-out services:
+
+- a per-server **health score** (EWMA of request outcomes) used to rank
+  replacement replicas when a dispatch fails,
+- a per-server **circuit breaker** (closed → open on consecutive
+  failures → half-open probe after a recovery window) so a flapping
+  server sheds load instead of burning every query's budget, and
+- a per-server **hedge threshold** derived from the p95 of that
+  server's observed latency (common/metrics.py Timer reservoir): a
+  request still pending past the threshold gets a hedged duplicate on
+  another replica, and the first good answer wins.
+
+Everything is observable: health and breaker state export as
+table-suffixed gauges (``broker.gauge.<server>.serverHealth`` /
+``.breakerState``), failures and hedges as meters, per-server latency
+as a timer. The clock is injectable so breaker recovery is testable
+without wall-clock sleeps.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from pinot_tpu.common.metrics import (BrokerGauge, BrokerMeter, BrokerTimer,
+                                      MetricsRegistry)
+
+# breaker states, doubling as the exported gauge values
+BREAKER_CLOSED = 0
+BREAKER_HALF_OPEN = 1
+BREAKER_OPEN = 2
+
+_STATE_NAMES = {BREAKER_CLOSED: "CLOSED", BREAKER_HALF_OPEN: "HALF_OPEN",
+                BREAKER_OPEN: "OPEN"}
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing.
+
+    CLOSED: all requests pass. After `failure_threshold` consecutive
+    failures → OPEN: requests are refused for `recovery_s`. Then the
+    next allow() transitions to HALF_OPEN and admits exactly ONE probe;
+    the probe's outcome closes (success) or re-opens (failure) the
+    breaker. Thread-safe; the clock is injectable for deterministic
+    tests.
+    """
+
+    def __init__(self, failure_threshold: int = 5,
+                 recovery_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.recovery_s = float(recovery_s)
+        self._clock = clock
+        self.state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_started_at = 0.0
+        self._lock = threading.Lock()
+
+    def _probe_is_stale(self, now: float) -> bool:
+        """A probe whose dispatch was abandoned (cancelled hedge loser,
+        budget expired before the call) never reports an outcome; after
+        a recovery window it must not exclude the server forever."""
+        return now - self._probe_started_at >= self.recovery_s
+
+    def allow(self) -> bool:
+        """May a request be dispatched now? (consumes the half-open
+        probe slot when it grants one)"""
+        with self._lock:
+            if self.state == BREAKER_CLOSED:
+                return True
+            now = self._clock()
+            if self.state == BREAKER_OPEN:
+                if now - self._opened_at < self.recovery_s:
+                    return False
+                self.state = BREAKER_HALF_OPEN
+                self._probe_in_flight = True
+                self._probe_started_at = now
+                return True
+            # HALF_OPEN: one probe at a time (stale probes re-arm)
+            if self._probe_in_flight and not self._probe_is_stale(now):
+                return False
+            self._probe_in_flight = True
+            self._probe_started_at = now
+            return True
+
+    def available(self) -> bool:
+        """Non-consuming view of allow(): used for candidate ranking so
+        scanning replicas does not eat half-open probe slots."""
+        with self._lock:
+            if self.state == BREAKER_CLOSED:
+                return True
+            now = self._clock()
+            if self.state == BREAKER_OPEN:
+                return now - self._opened_at >= self.recovery_s
+            return not self._probe_in_flight or self._probe_is_stale(now)
+
+    def on_success(self) -> None:
+        with self._lock:
+            self.state = BREAKER_CLOSED
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+
+    def on_failure(self) -> None:
+        with self._lock:
+            now = self._clock()
+            if self.state == BREAKER_HALF_OPEN:
+                # failed probe: straight back to OPEN for another window
+                self.state = BREAKER_OPEN
+                self._opened_at = now
+                self._probe_in_flight = False
+                return
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.failure_threshold:
+                self.state = BREAKER_OPEN
+                self._opened_at = now
+
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state]
+
+
+class _ServerEntry:
+    """One server's breaker + health score (mutations are guarded by
+    the owning FaultToleranceManager's lock)."""
+
+    __slots__ = ("breaker", "health")
+
+    def __init__(self, breaker: CircuitBreaker):
+        self.breaker = breaker
+        self.health = 1.0
+
+
+class FaultToleranceManager:
+    """Per-server health scores, breakers, and hedge thresholds.
+
+    One instance per broker, shared by every in-flight query. All state
+    transitions are metric-backed so operators can watch a server flap
+    (`broker.serverErrors`), shed (`breakerState` gauge = 2), probe
+    (= 1) and recover (= 0) without log archaeology.
+    """
+
+    HEALTH_ALPHA = 0.3          # EWMA weight of the newest outcome
+    HEDGE_MIN_S = 1e-3          # floor so a hot server can't hedge-storm
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 breaker_failure_threshold: int = 5,
+                 breaker_recovery_s: float = 30.0,
+                 hedge_quantile: float = 95.0,
+                 hedge_factor: float = 3.0,
+                 min_hedge_samples: int = 8,
+                 default_hedge_delay_s: Optional[float] = None):
+        self.metrics = metrics or MetricsRegistry("broker")
+        self._clock = clock
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_recovery_s = breaker_recovery_s
+        self.hedge_quantile = hedge_quantile
+        self.hedge_factor = hedge_factor
+        self.min_hedge_samples = min_hedge_samples
+        # hedge delay before a server has enough latency samples for a
+        # p95 estimate; None disables hedging until samples accumulate
+        self.default_hedge_delay_s = default_hedge_delay_s
+        self._servers: Dict[str, _ServerEntry] = {}
+        self._lock = threading.Lock()
+
+    # -- registry ----------------------------------------------------------
+    def _entry(self, server: str) -> _ServerEntry:
+        with self._lock:
+            e = self._servers.get(server)
+            if e is None:
+                e = self._servers[server] = _ServerEntry(CircuitBreaker(
+                    self.breaker_failure_threshold,
+                    self.breaker_recovery_s, self._clock))
+                # callable-backed gauges: always-current observability
+                # with zero bookkeeping on the hot path
+                self.metrics.gauge(
+                    BrokerGauge.SERVER_HEALTH, table=server).set_callable(
+                        lambda e=e: e.health)
+                self.metrics.gauge(
+                    BrokerGauge.BREAKER_STATE, table=server).set_callable(
+                        lambda e=e: e.breaker.state)
+            return e
+
+    # -- dispatch gating ---------------------------------------------------
+    def allow_request(self, server: str) -> bool:
+        """Gate an actual dispatch (consumes half-open probe slots)."""
+        return self._entry(server).breaker.allow()
+
+    def available(self, server: str) -> bool:
+        """Non-consuming availability check for replica ranking."""
+        return self._entry(server).breaker.available()
+
+    # -- outcome accounting ------------------------------------------------
+    def on_success(self, server: str, latency_ms: float) -> None:
+        e = self._entry(server)
+        e.breaker.on_success()
+        with self._lock:
+            e.health = ((1 - self.HEALTH_ALPHA) * e.health +
+                        self.HEALTH_ALPHA * 1.0)
+        self.metrics.timer(BrokerTimer.SERVER_LATENCY,
+                           table=server).update(latency_ms)
+
+    def on_failure(self, server: str) -> None:
+        """Breaker/health accounting only — the serverErrors meter is
+        marked by the dispatcher (QueryRouter), which also runs when no
+        fault-tolerance manager is wired."""
+        e = self._entry(server)
+        e.breaker.on_failure()
+        with self._lock:
+            e.health = (1 - self.HEALTH_ALPHA) * e.health
+
+    def on_hedge(self, server: str) -> None:
+        """The server was slow enough to trigger a hedge: a soft health
+        penalty (half a failure), never a breaker transition."""
+        e = self._entry(server)
+        with self._lock:
+            e.health = (1 - self.HEALTH_ALPHA / 2) * e.health
+        self.metrics.meter(BrokerMeter.HEDGED_REQUESTS).mark()
+        self.metrics.meter(BrokerMeter.HEDGED_REQUESTS, table=server).mark()
+
+    # -- queries -----------------------------------------------------------
+    def health(self, server: str) -> float:
+        return self._entry(server).health
+
+    def breaker_state(self, server: str) -> int:
+        return self._entry(server).breaker.state
+
+    def hedge_delay_s(self, server: str) -> Optional[float]:
+        """How long to wait on `server` before dispatching a hedge, or
+        None when hedging is off for it (no latency history yet and no
+        default configured)."""
+        timer = self.metrics.timer(BrokerTimer.SERVER_LATENCY, table=server)
+        if timer.count >= self.min_hedge_samples:
+            p = timer.percentile_ms(self.hedge_quantile)
+            return max(self.HEDGE_MIN_S, p * self.hedge_factor / 1e3)
+        return self.default_hedge_delay_s
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-server health/breaker view for admin endpoints."""
+        with self._lock:
+            servers = dict(self._servers)
+        return {name: {"health": round(e.health, 4),
+                       "breakerState": e.breaker.state_name()}
+                for name, e in servers.items()}

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from pinot_tpu.common.cluster_state import CONSUMING, ONLINE
 from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
                                         SEGMENT_MISSING_EXC_PREFIX)
 from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
@@ -27,6 +28,7 @@ from pinot_tpu.common.serde import instance_request_to_bytes
 from pinot_tpu.common.trace import Trace, make_trace
 from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table)
+from pinot_tpu.broker.fault_tolerance import FaultToleranceManager
 from pinot_tpu.broker.quota import QueryQuotaManager
 from pinot_tpu.broker.routing import RoutingError, RoutingManager
 from pinot_tpu.broker.time_boundary import (TimeBoundaryService,
@@ -95,44 +97,252 @@ class TcpTransport(ServerTransport):
         self._conns.clear()
 
 
-class QueryRouter:
-    """Scatter one query to its servers, gather DataTables."""
+def _server_error(server: str, message: str) -> dict:
+    """One per-server failure record; `recovered` flips to True when a
+    replica re-dispatch later produced the data anyway."""
+    return {"server": server, "message": message, "recovered": False}
 
-    def __init__(self, transport: ServerTransport, broker_id: str):
+
+class QueryRouter:
+    """Budget-aware scatter engine: deadline propagation, breaker
+    gating, hedged replica retries, per-server failure accounting.
+
+    Each (sub-request, server, segments) dispatch unit runs through:
+    1. breaker gate — an OPEN server is skipped outright,
+    2. the primary call with the REMAINING deadline budget stamped into
+       the InstanceRequest (deadline propagation),
+    3. an optional hedge: if the primary is still pending past the
+       server's p95-derived hedge threshold, the same segments go to
+       another live replica and the first good answer wins,
+    4. failover: on error / corrupt frame / timeout, the unit's
+       segments are re-routed to other ONLINE/CONSUMING replicas from
+       the current view (ranked by health score) while budget remains.
+
+    Failures are never swallowed: every one is recorded (server +
+    reason + whether a replica recovered it) and metered.
+    """
+
+    # primary + up to two failover waves per segment
+    MAX_ATTEMPTS = 3
+
+    def __init__(self, transport: ServerTransport, broker_id: str,
+                 fault_tolerance: Optional[FaultToleranceManager] = None,
+                 routing: Optional[RoutingManager] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock=time.monotonic):
         self.transport = transport
         self.broker_id = broker_id
+        self.fault_tolerance = fault_tolerance
+        self.routing = routing
+        self.metrics = metrics or MetricsRegistry("broker")
+        self._clock = clock
 
     async def submit(self, request_id: int,
                      routes: List[Tuple[BrokerRequest, Dict[str,
                                                             List[str]]]],
-                     timeout: float, enable_trace: bool = False
-                     ) -> Tuple[List[DataTable], int, int]:
-        """routes: [(per-table request, {server: segments})] —
-        returns (tables, num_queried, num_responded)."""
-        calls = []
-        servers: List[str] = []
+                     timeout: float, enable_trace: bool = False,
+                     deadline: Optional[float] = None
+                     ) -> Tuple[List[DataTable], int, int, List[dict]]:
+        """routes: [(per-table request, {server: segments})] — returns
+        (tables, num_queried, num_responded, errors). `deadline` is an
+        absolute clock() instant shared by retries so re-dispatches
+        never extend user-visible latency past the requested timeout."""
+        if deadline is None:
+            deadline = self._clock() + timeout
+        units = []
         for sub_request, routing in routes:
             for server, segments in routing.items():
-                payload = instance_request_to_bytes(InstanceRequest(
-                    request_id=request_id, query=sub_request,
-                    search_segments=segments, broker_id=self.broker_id,
-                    enable_trace=enable_trace))
-                calls.append(self.transport.query(server, payload, timeout))
-                servers.append(server)
-        results = await asyncio.gather(*calls, return_exceptions=True)
+                units.append((sub_request, server, segments))
+        outcomes = await asyncio.gather(
+            *(self._query_unit(request_id, sub, server, segments,
+                               deadline, enable_trace)
+              for sub, server, segments in units))
         tables: List[DataTable] = []
+        errors: List[dict] = []
         responded = 0
-        for server, r in zip(servers, results):
-            if isinstance(r, BaseException):
-                continue
-            try:
-                dt = DataTable.from_bytes(r)
-            except Exception:  # noqa: BLE001 — corrupt response payload
-                continue       # counts as a non-responding server
-            dt.metadata.setdefault("serverName", server)
-            tables.append(dt)
-            responded += 1
-        return tables, len(calls), responded
+        for unit_tables, unit_errors in outcomes:
+            errors.extend(unit_errors)
+            if unit_tables:
+                tables.extend(unit_tables)
+                responded += 1
+        return tables, len(units), responded, errors
+
+    # -- one dispatch unit --------------------------------------------------
+    async def _query_unit(self, request_id: int, sub: BrokerRequest,
+                          server: str, segments: List[str],
+                          deadline: float, enable_trace: bool):
+        errors: List[dict] = []
+        tried = {server}
+        tables: List[DataTable] = []
+        # breaker gating happens inside _call_once (uniformly for the
+        # primary, hedges and failovers); an OPEN primary just records
+        # CircuitBreakerOpen there and falls through to failover
+        dt = await self._dispatch_hedged(request_id, sub, server,
+                                         segments, deadline,
+                                         enable_trace, errors, tried)
+        if dt is not None:
+            for e in errors:         # e.g. primary failed, hedge won
+                e["recovered"] = True
+            return [dt], errors
+        # failover: re-route this unit's segments to other live replicas
+        # (waves, because the replacement can fail too) within budget
+        remaining_segs = list(segments)
+        for _ in range(1, self.MAX_ATTEMPTS):
+            if not remaining_segs or self._clock() >= deadline:
+                break
+            groups = self._replica_groups(sub, remaining_segs, tried)
+            if not groups:
+                break
+            self.metrics.meter(BrokerMeter.SEGMENT_RETRIES).mark(
+                len(remaining_segs))
+            items = sorted(groups.items())
+            results = await asyncio.gather(
+                *(self._call_once(request_id, sub, srv, segs, deadline,
+                                  enable_trace, errors)
+                  for srv, segs in items))
+            next_remaining: List[str] = []
+            for (srv, segs), dt in zip(items, results):
+                tried.add(srv)
+                if dt is None:
+                    next_remaining.extend(segs)
+                else:
+                    tables.append(dt)
+            remaining_segs = next_remaining
+        if not remaining_segs and tables:
+            # every segment of the failed unit was recovered elsewhere:
+            # the response is complete, demote the failures to telemetry
+            for e in errors:
+                e["recovered"] = True
+        return tables, errors
+
+    async def _dispatch_hedged(self, request_id, sub, server, segments,
+                               deadline, enable_trace, errors, tried):
+        """Primary call with a latency hedge to one replica."""
+        ft = self.fault_tolerance
+        primary = asyncio.ensure_future(self._call_once(
+            request_id, sub, server, segments, deadline, enable_trace,
+            errors))
+        hedge_after = ft.hedge_delay_s(server) if ft is not None else None
+        if hedge_after is None:
+            return await primary
+        budget = deadline - self._clock()
+        done, _pending = await asyncio.wait(
+            {primary}, timeout=max(0.0, min(hedge_after, budget)))
+        if done:
+            return primary.result()
+        hedge_server = self._hedge_candidate(sub, segments, tried)
+        if hedge_server is None:
+            return await primary
+        tried.add(hedge_server)
+        ft.on_hedge(server)
+        hedge = asyncio.ensure_future(self._call_once(
+            request_id, sub, hedge_server, segments, deadline,
+            enable_trace, errors))
+        pending = {primary, hedge}
+        winner = None
+        while pending and winner is None:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                dt = t.result()
+                if dt is not None and winner is None:
+                    winner = dt
+        for t in pending:
+            t.cancel()       # loser keeps running server-side; drop it
+        return winner
+
+    async def _call_once(self, request_id, sub, server, segments,
+                         deadline, enable_trace, errors):
+        """One dispatch to one server; stamps the remaining budget,
+        classifies failures, feeds the health/breaker state."""
+        ft = self.fault_tolerance
+        if ft is not None and not ft.allow_request(server):
+            # the one place every dispatch kind passes through, so the
+            # breaker's single-probe half-open invariant holds for
+            # hedges and failover waves too, not just primaries
+            errors.append(_server_error(
+                server, f"CircuitBreakerOpen: {server} is shedding load"))
+            return None
+        budget = deadline - self._clock()
+        if budget <= 0:
+            errors.append(_server_error(
+                server, "DeadlineExceededError: no budget left to "
+                f"dispatch to {server}"))
+            return None
+        payload = instance_request_to_bytes(InstanceRequest(
+            request_id=request_id, query=sub, search_segments=segments,
+            broker_id=self.broker_id, enable_trace=enable_trace,
+            deadline_budget_ms=budget * 1e3))
+        t0 = self._clock()
+        try:
+            raw = await asyncio.wait_for(
+                self.transport.query(server, payload, budget), budget)
+            dt = DataTable.from_bytes(raw)
+        except asyncio.CancelledError:
+            raise                       # hedge loser / caller teardown
+        except Exception as e:  # noqa: BLE001 — classified, never silent
+            self.metrics.meter(BrokerMeter.SERVER_ERRORS).mark()
+            self.metrics.meter(BrokerMeter.SERVER_ERRORS,
+                               table=server).mark()
+            if ft is not None:
+                ft.on_failure(server)
+            kind = "ServerTimeoutError" if \
+                isinstance(e, asyncio.TimeoutError) else type(e).__name__
+            errors.append(_server_error(server, f"{kind}: {e}"))
+            return None
+        if ft is not None:
+            ft.on_success(server, (self._clock() - t0) * 1e3)
+        dt.metadata.setdefault("serverName", server)
+        return dt
+
+    # -- replica selection --------------------------------------------------
+    def _view_for(self, sub: BrokerRequest):
+        """Fetch the routing view ONCE per selection scan — view() deep-
+        copies the table under the routing lock, so per-segment fetches
+        would make failover O(segments × view size) in copies."""
+        return self.routing.view(sub.table_name) \
+            if self.routing is not None else None
+
+    def _live_replicas(self, view, segment: str, tried: set) -> List[str]:
+        if view is None:
+            return []
+        ft = self.fault_tolerance
+        out = [srv for srv in view.servers_for(segment,
+                                               states=(ONLINE, CONSUMING))
+               if srv not in tried and (ft is None or ft.available(srv))]
+        if ft is not None:
+            out.sort(key=lambda s: -ft.health(s))
+        return out
+
+    def _replica_groups(self, sub: BrokerRequest, segments: List[str],
+                        tried: set) -> Dict[str, List[str]]:
+        """Healthiest untried live replica per segment, grouped into
+        per-server dispatch lists."""
+        view = self._view_for(sub)
+        groups: Dict[str, List[str]] = {}
+        for segment in segments:
+            candidates = self._live_replicas(view, segment, tried)
+            if candidates:
+                groups.setdefault(candidates[0], []).append(segment)
+        return groups
+
+    def _hedge_candidate(self, sub: BrokerRequest, segments: List[str],
+                         tried: set) -> Optional[str]:
+        """A single untried replica serving EVERY segment of the unit
+        (a hedge duplicates the whole unit, it does not split it)."""
+        if not segments:
+            return None
+        view = self._view_for(sub)
+        common: Optional[set] = None
+        for segment in segments:
+            servers = set(self._live_replicas(view, segment, tried))
+            common = servers if common is None else common & servers
+            if not common:
+                return None
+        ft = self.fault_tolerance
+        if ft is not None:
+            return max(common, key=ft.health)
+        return sorted(common)[0]
 
 
 class BrokerRequestHandler:
@@ -146,17 +356,22 @@ class BrokerRequestHandler:
                  default_timeout_s: float = 15.0,
                  metrics: Optional[MetricsRegistry] = None,
                  access_control=None,
-                 segment_pruner=None):
+                 segment_pruner=None,
+                 fault_tolerance: Optional[FaultToleranceManager] = None):
         # optional broker-side segment pruner (PartitionZKMetadataPruner):
         # prune(request, table, segments) -> segments
         self.segment_pruner = segment_pruner
         self.routing = routing
-        self.router = QueryRouter(transport, broker_id)
+        self.metrics = metrics or MetricsRegistry("broker")
+        self.fault_tolerance = fault_tolerance or FaultToleranceManager(
+            metrics=self.metrics)
+        self.router = QueryRouter(transport, broker_id,
+                                  fault_tolerance=self.fault_tolerance,
+                                  routing=routing, metrics=self.metrics)
         self.time_boundary = time_boundary or TimeBoundaryService()
         self.quota = quota or QueryQuotaManager()
         self.optimizer = BrokerRequestOptimizer()
         self.reducer = BrokerReduceService()
-        self.metrics = metrics or MetricsRegistry("broker")
         if access_control is None:
             from pinot_tpu.broker.access_control import AllowAllAccessControl
             access_control = AllowAllAccessControl()
@@ -227,16 +442,23 @@ class BrokerRequestHandler:
 
         timeout_s = (request.query_options.timeout_ms or
                      self.default_timeout_s * 1e3) / 1e3
+        # ONE absolute deadline governs the scatter, every hedge and
+        # every retry: re-dispatches spend the remaining budget, they
+        # never extend user-visible latency past the requested timeout
+        deadline = time.monotonic() + timeout_s
         with self.metrics.timer(BrokerQueryPhase.SCATTER_GATHER).time(), \
                 trace.span(BrokerQueryPhase.SCATTER_GATHER):
-            tables, queried, responded = await self.router.submit(
+            tables, queried, responded, errors = await self.router.submit(
                 next(self._request_ids), routes, timeout_s,
-                enable_trace=request.query_options.trace)
-            tables, rq, rr = await self._retry_missing_segments(
-                routes, tables, timeout_s,
-                enable_trace=request.query_options.trace)
+                enable_trace=request.query_options.trace,
+                deadline=deadline)
+            tables, rq, rr, retry_errors = \
+                await self._retry_missing_segments(
+                    routes, tables, deadline,
+                    enable_trace=request.query_options.trace)
             queried += rq
             responded += rr
+            errors += retry_errors
         if responded < queried:
             self.metrics.meter(
                 BrokerMeter.BROKER_RESPONSES_WITH_PARTIAL_SERVERS).mark()
@@ -246,6 +468,18 @@ class BrokerRequestHandler:
             resp = self.reducer.reduce(request, blocks) if blocks else \
                 _error_response(427, "ServerNotRespondedError: no server "
                                 "responded in time")
+        # surface per-server failures a replica did NOT recover (the
+        # old code silently `continue`d over them); recovered ones are
+        # telemetry-only (meters/health), not client-facing noise
+        unrecovered = [e for e in errors if not e.get("recovered")]
+        for e in unrecovered:
+            resp.exceptions.append({
+                "errorCode": 425,
+                "message": f"ServerQueryError: server={e['server']}: "
+                           f"{e['message']}"})
+        resp.partial_response = bool(
+            responded < queried or unrecovered or
+            any(dt.exceptions for dt in tables))
         resp.num_servers_queried = queried
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.perf_counter() - t0) * 1e3
@@ -270,7 +504,7 @@ class BrokerRequestHandler:
         return resp
 
     async def _retry_missing_segments(self, routes, tables,
-                                      timeout_s: float,
+                                      deadline: float,
                                       enable_trace: bool = False):
         """One re-dispatch of segments a server reported missing.
 
@@ -285,7 +519,13 @@ class BrokerRequestHandler:
         change + tolerating partial responses.
         """
         if not any(MISSING_SEGMENTS_KEY in dt.metadata for dt in tables):
-            return tables, 0, 0        # hot path: nothing to inspect
+            return tables, 0, 0, []    # hot path: nothing to inspect
+        if time.monotonic() >= deadline:
+            # budget exhausted: keep the honest SegmentMissingError
+            # exceptions rather than re-dispatching past the timeout
+            # (the old code reused the FULL timeout here, so a retry
+            # after a slow first wave could double user latency)
+            return tables, 0, 0, []
 
         seg_home: Dict[str, tuple] = {}
         for sub, routing in routes:
@@ -336,11 +576,14 @@ class BrokerRequestHandler:
         retry_routes = list(retry_groups.values())
 
         if not retry_routes:
-            return tables, 0, 0
-        retry_tables, rq, rr = await self.router.submit(
-            next(self._request_ids), retry_routes, timeout_s,
-            enable_trace=enable_trace)
-        return tables + retry_tables, rq, rr
+            return tables, 0, 0, []
+        # the re-dispatch spends only the REMAINING budget (deadline is
+        # absolute); a slow first wave leaves a short, honest retry
+        remaining_s = max(deadline - time.monotonic(), 0.0)
+        retry_tables, rq, rr, errors = await self.router.submit(
+            next(self._request_ids), retry_routes, remaining_s,
+            enable_trace=enable_trace, deadline=deadline)
+        return tables + retry_tables, rq, rr, errors
 
     def _pruned_route(self, sub_request: BrokerRequest, table: str
                       ) -> Dict[str, List[str]]:

@@ -164,6 +164,22 @@ class BrokerMeter:
     REQUEST_DROPPED_DUE_TO_ACCESS_ERROR = "requestDroppedDueToAccessError"
     BROKER_RESPONSES_WITH_PARTIAL_SERVERS = "brokerResponsesWithPartialServers"
     DOCUMENTS_SCANNED = "documentsScanned"
+    # fault-tolerance layer (global and per-server via the table suffix)
+    SERVER_ERRORS = "serverErrors"
+    HEDGED_REQUESTS = "hedgedRequests"
+    SEGMENT_RETRIES = "segmentRetries"
+
+
+class BrokerGauge:
+    # per-server (table-suffixed) fault-tolerance observability
+    SERVER_HEALTH = "serverHealth"          # EWMA success score in [0, 1]
+    BREAKER_STATE = "breakerState"          # 0 closed / 1 half-open / 2 open
+
+
+class BrokerTimer:
+    # per-server (table-suffixed) request latency; drives the hedge
+    # threshold (p95-based) in broker/fault_tolerance.py
+    SERVER_LATENCY = "serverLatency"
 
 
 class BrokerQueryPhase:
@@ -180,6 +196,9 @@ class ServerMeter:
     QUERY_EXECUTION_EXCEPTIONS = "queryExecutionExceptions"
     DELETED_SEGMENT_COUNT = "deletedSegmentCount"
     REALTIME_ROWS_CONSUMED = "realtimeRowsConsumed"
+    # queries dropped (or truncated) because the broker-propagated
+    # deadline had already expired — work nobody would read
+    DEADLINE_EXPIRED_QUERIES = "deadlineExpiredQueries"
 
 
 class ServerQueryPhase:

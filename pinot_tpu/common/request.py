@@ -197,3 +197,8 @@ class InstanceRequest:
     search_segments: Optional[List[str]] = None
     enable_trace: bool = False
     broker_id: str = ""
+    # remaining query budget at dispatch time (deadline propagation):
+    # the server drops or truncates work once this much time has passed
+    # since the request arrived. None = no propagated deadline (the
+    # server falls back to its own default timeout).
+    deadline_budget_ms: Optional[float] = None

@@ -56,6 +56,12 @@ class BrokerResponse:
     num_groups_limit_reached: bool = False
     total_docs: int = 0
     time_used_ms: float = 0.0
+    # honest-degradation flag: True whenever the result may be missing
+    # data (a server never responded, a segment had no live replica, or
+    # execution was truncated by the deadline) — clients must be able to
+    # tell a partial answer from a full one without string-matching
+    # exception messages
+    partial_response: bool = False
     # trace=true responses: {"broker": [...spans], "<server>": [...spans]}
     trace_info: Optional[Dict[str, list]] = None
 
@@ -71,6 +77,7 @@ class BrokerResponse:
             "numServersQueried": self.num_servers_queried,
             "numServersResponded": self.num_servers_responded,
             "numGroupsLimitReached": self.num_groups_limit_reached,
+            "partialResponse": self.partial_response,
             "totalDocs": self.total_docs,
             "timeUsedMs": round(self.time_used_ms, 3),
         }

@@ -123,13 +123,18 @@ def request_from_json(d: dict) -> BrokerRequest:
 
 
 def instance_request_to_bytes(r: InstanceRequest) -> bytes:
-    return json.dumps({
+    d = {
         "requestId": r.request_id,
         "query": request_to_json(r.query),
         "searchSegments": r.search_segments,
         "enableTrace": r.enable_trace,
         "brokerId": r.broker_id,
-    }).encode("utf-8")
+    }
+    if r.deadline_budget_ms is not None:
+        # optional key: payloads from older brokers stay parseable and
+        # payloads to older servers are ignored, not rejected
+        d["deadlineBudgetMs"] = r.deadline_budget_ms
+    return json.dumps(d).encode("utf-8")
 
 
 def instance_request_from_bytes(b: bytes) -> InstanceRequest:
@@ -139,7 +144,8 @@ def instance_request_from_bytes(b: bytes) -> InstanceRequest:
         query=request_from_json(d["query"]),
         search_segments=d.get("searchSegments"),
         enable_trace=d.get("enableTrace", False),
-        broker_id=d.get("brokerId", ""))
+        broker_id=d.get("brokerId", ""),
+        deadline_budget_ms=d.get("deadlineBudgetMs"))
 
 
 # ---------------------------------------------------------------------------

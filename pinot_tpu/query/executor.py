@@ -33,7 +33,13 @@ class ServerQueryExecutor:
 
     def execute(self, request: BrokerRequest,
                 segments: List[ImmutableSegment],
-                trace: Optional[Trace] = None) -> IntermediateResultsBlock:
+                trace: Optional[Trace] = None,
+                deadline: Optional[float] = None
+                ) -> IntermediateResultsBlock:
+        """`deadline`: absolute time.monotonic() instant; the
+        per-segment loop stops (with an honest truncation exception)
+        once it passes — a deadline-expired query must not keep a
+        worker pinned computing rows its broker stopped listening for."""
         trace = trace if trace is not None else make_trace(False)
         t0 = time.perf_counter()
         from pinot_tpu.query.plan import preprocess_request
@@ -55,8 +61,13 @@ class ServerQueryExecutor:
 
         blocks: List[IntermediateResultsBlock] = []
         extra_parts = extra_matched = 0
+        truncated_at: Optional[int] = None
         with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
-            for seg in selected:
+            for seg_idx, seg in enumerate(selected):
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    truncated_at = seg_idx
+                    break
                 if self.use_device and \
                         getattr(seg, "is_mutable", False) and \
                         hasattr(seg, "device_view"):
@@ -99,6 +110,11 @@ class ServerQueryExecutor:
                 blk.selection_columns = list(request.selection.columns)
         else:
             blk = combine_blocks(request, blocks)
+        if truncated_at is not None:
+            blk.exceptions.append(
+                "DeadlineExceededError: segment execution truncated at "
+                f"{truncated_at}/{len(selected)} segments (budget "
+                "expired mid-query)")
         if extra_parts:
             # frozen+tail pairs are ONE logical consuming segment: both
             # processed always, matched only when both halves matched

@@ -7,6 +7,7 @@ execute → DataTable bytes).
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -39,6 +40,9 @@ class ServerInstance:
         self._loop: Optional[EventLoopThread] = None
         self._server: Optional[QueryServer] = None
         self.port: Optional[int] = None
+        # guards the start/stop lifecycle fields (_loop/_server/port):
+        # an admin-triggered stop can race a late start on another thread
+        self._lifecycle_lock = threading.Lock()
 
     # -- in-process path (used by tests and the embedded broker) -----------
     def handle_request_bytes(self, payload: bytes) -> bytes:
@@ -50,13 +54,23 @@ class ServerInstance:
                 dt = DataTable()
                 dt.exceptions.append(f"RequestDeserializationError: {e}")
                 return dt.to_bytes()
+        # broker deadline propagation: fix the budget to an absolute
+        # instant NOW (deserialization time), so queue wait counts
+        # against it and expired work is dropped, not computed
+        deadline = None
+        budget_s = None
+        if request.deadline_budget_ms is not None:
+            budget_s = request.deadline_budget_ms / 1e3
+            deadline = time.monotonic() + budget_s
         t_submit = time.perf_counter()
 
         def run():
             wait_ms = (time.perf_counter() - t_submit) * 1e3
-            return self.executor.execute(request, scheduler_wait_ms=wait_ms)
+            return self.executor.execute(request, scheduler_wait_ms=wait_ms,
+                                         deadline=deadline)
 
-        future = self.scheduler.submit(request.query.table_name, run)
+        future = self.scheduler.submit(request.query.table_name, run,
+                                       deadline_s=budget_s)
         try:
             dt = future.result()
             with self.metrics.timer(
@@ -72,17 +86,20 @@ class ServerInstance:
     # -- network service ---------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start the TCP query service; returns the bound port."""
-        self._loop = EventLoopThread()
-        self._server = QueryServer(host, port, self.handle_request_bytes)
-        self._loop.run(self._server.start())
-        self.port = self._server.port
-        return self.port
+        with self._lifecycle_lock:
+            self._loop = EventLoopThread()
+            self._server = QueryServer(host, port,
+                                       self.handle_request_bytes)
+            self._loop.run(self._server.start())
+            self.port = self._server.port
+            return self.port
 
     def stop(self) -> None:
-        if self._server is not None and self._loop is not None:
-            self._loop.run(self._server.stop())
-        if self._loop is not None:
-            self._loop.stop()
-            self._loop = None
+        with self._lifecycle_lock:
+            if self._server is not None and self._loop is not None:
+                self._loop.run(self._server.stop())
+            if self._loop is not None:
+                self._loop.stop()
+                self._loop = None
         self.scheduler.shutdown()
         self.data_manager.shutdown()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 from typing import Optional
 
 from pinot_tpu.controller.manager import ResourceManager
@@ -29,6 +30,10 @@ class ServerParticipant(StateModel):
         self.completion = completion
         self.work_dir = work_dir
         self._realtime = None
+        # CONSUMING and ONLINE transitions for different segments can
+        # arrive on different state-machine threads; the lazy realtime
+        # manager must be built exactly once
+        self._realtime_lock = threading.Lock()
         # readiness: GOOD once current state converges with ideal state
         # (parity: HelixServerStarter registering ServiceStatus callbacks)
         from pinot_tpu.common.service_status import (
@@ -39,18 +44,20 @@ class ServerParticipant(StateModel):
 
     @property
     def realtime(self):
-        if self._realtime is None:
-            if self.completion is None:
-                raise RuntimeError(
-                    "realtime transition but no completion client wired")
-            from pinot_tpu.realtime.data_manager import \
-                RealtimeTableDataManager
-            work = self.work_dir or os.path.join(
-                tempfile.gettempdir(),
-                f"pinot_tpu_rt_{self.server.instance_id}")
-            self._realtime = RealtimeTableDataManager(
-                self.server, self.manager, self.completion, work)
-        return self._realtime
+        with self._realtime_lock:
+            if self._realtime is None:
+                if self.completion is None:
+                    raise RuntimeError(
+                        "realtime transition but no completion client "
+                        "wired")
+                from pinot_tpu.realtime.data_manager import \
+                    RealtimeTableDataManager
+                work = self.work_dir or os.path.join(
+                    tempfile.gettempdir(),
+                    f"pinot_tpu_rt_{self.server.instance_id}")
+                self._realtime = RealtimeTableDataManager(
+                    self.server, self.manager, self.completion, work)
+            return self._realtime
 
     def _fetch_segment_dir(self, table: str, segment: str,
                            download_path: str) -> str:

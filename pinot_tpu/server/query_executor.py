@@ -40,15 +40,30 @@ class InstanceQueryExecutor:
         self.metrics = metrics or MetricsRegistry("server")
 
     def execute(self, request: InstanceRequest,
-                scheduler_wait_ms: float = 0.0) -> DataTable:
+                scheduler_wait_ms: float = 0.0,
+                deadline: Optional[float] = None) -> DataTable:
+        """`deadline`: absolute time.monotonic() instant from the
+        broker-propagated budget; expired work is dropped or truncated
+        instead of computing answers nobody will read."""
         t_start = time.perf_counter()
         self.metrics.meter(ServerMeter.QUERIES).mark()
         self.metrics.timer(ServerQueryPhase.SCHEDULER_WAIT).update(
             scheduler_wait_ms)
+        if deadline is not None and time.monotonic() >= deadline:
+            self.metrics.meter(ServerMeter.DEADLINE_EXPIRED_QUERIES).mark()
+            dt = DataTable()
+            dt.metadata["requestId"] = str(request.request_id)
+            dt.exceptions.append(
+                "DeadlineExceededError: query budget expired before "
+                "execution started; dropped without executing")
+            return dt
         trace = make_trace(request.enable_trace)
         trace.record(ServerQueryPhase.SCHEDULER_WAIT, scheduler_wait_ms)
         query = request.query
         timeout_ms = query.query_options.timeout_ms or self.default_timeout_ms
+        if request.deadline_budget_ms is not None:
+            # the broker's remaining budget caps the server-side timeout
+            timeout_ms = min(timeout_ms, request.deadline_budget_ms)
         tdm = self.data_manager.table(query.table_name)
         if tdm is None:
             dt = DataTable()
@@ -59,7 +74,8 @@ class InstanceQueryExecutor:
         acquired, missing = tdm.acquire_segments(request.search_segments)
         try:
             segments = [s.segment for s in acquired]
-            block = self._execute_segments(query, segments, trace)
+            block = self._execute_segments(query, segments, trace,
+                                           deadline=deadline)
             if missing:
                 block.exceptions.append(
                     f"{SEGMENT_MISSING_EXC_PREFIX} {sorted(missing)}")
@@ -84,8 +100,9 @@ class InstanceQueryExecutor:
             for sdm in acquired:
                 tdm.release_segment(sdm)
 
-    def _execute_segments(self, query, segments: List,
-                          trace: Trace) -> IntermediateResultsBlock:
+    def _execute_segments(self, query, segments: List, trace: Trace,
+                          deadline: Optional[float] = None
+                          ) -> IntermediateResultsBlock:
         if self.sharded is not None and len(segments) > 1:
             from pinot_tpu.parallel.sharded import NotShardable
             from pinot_tpu.query.plan import (GroupsLimitExceeded,
@@ -97,6 +114,7 @@ class InstanceQueryExecutor:
                 return blk
             except (NotShardable, GroupsLimitExceeded, UnsupportedOnDevice):
                 pass
-        blk = self.executor.execute(query, segments, trace=trace)
+        blk = self.executor.execute(query, segments, trace=trace,
+                                    deadline=deadline)
         blk.execution_path = "sequential"
         return blk

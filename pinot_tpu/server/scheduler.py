@@ -37,13 +37,20 @@ from typing import Callable, Dict, List, Optional
 
 
 class QueryScheduler:
-    """submit(group, fn) -> Future; subclasses order execution."""
+    """submit(group, fn) -> Future; subclasses order execution.
+
+    `deadline_s` is the query's remaining budget (broker deadline
+    propagation): schedulers that queue work drop entries whose budget
+    expired before a worker picked them up — computing an answer nobody
+    will read only steals tokens from live queries.
+    """
 
     def __init__(self, num_workers: int = 4):
         self._pool = ThreadPoolExecutor(max_workers=num_workers)
         self.num_workers = num_workers
 
-    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+    def submit(self, group: str, fn: Callable[[], object],
+               deadline_s: Optional[float] = None) -> Future:
         raise NotImplementedError
 
     def shutdown(self) -> None:
@@ -51,9 +58,11 @@ class QueryScheduler:
 
 
 class FCFSQueryScheduler(QueryScheduler):
-    """First-come-first-served (the reference default)."""
+    """First-come-first-served (the reference default); unqueued, so
+    deadline enforcement happens in the executor itself."""
 
-    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+    def submit(self, group: str, fn: Callable[[], object],
+               deadline_s: Optional[float] = None) -> Future:
         return self._pool.submit(fn)
 
 
@@ -202,15 +211,20 @@ class TokenSchedulerGroup:
 class SchedulerQueryContext:
     """One queued query (parity: SchedulerQueryContext.java)."""
 
-    __slots__ = ("group", "fn", "future", "arrival_ms", "seq")
+    __slots__ = ("group", "fn", "future", "arrival_ms", "seq",
+                 "deadline_ms")
 
     def __init__(self, group: str, fn: Callable[[], object], seq: int,
-                 arrival_ms: float):
+                 arrival_ms: float,
+                 deadline_ms: Optional[float] = None):
         self.group = group
         self.fn = fn
         self.future: Future = Future()
         self.arrival_ms = arrival_ms
         self.seq = seq
+        # absolute clock instant (ms) after which the query's broker
+        # stops listening; None = only the scheduler-wide deadline
+        self.deadline_ms = deadline_ms
 
 
 class MultiLevelPriorityQueue:
@@ -252,8 +266,8 @@ class MultiLevelPriorityQueue:
             self._groups[name] = g  # tpulint: disable=concurrency -- every caller holds self._lock (enforced by the public group())
         return g
 
-    def put(self, group_name: str, fn: Callable[[], object]
-            ) -> SchedulerQueryContext:
+    def put(self, group_name: str, fn: Callable[[], object],
+            deadline_s: Optional[float] = None) -> SchedulerQueryContext:
         with self._lock:
             g = self._group_locked(group_name)
             if len(g.pending) >= self.policy.max_pending_per_group and \
@@ -265,8 +279,10 @@ class MultiLevelPriorityQueue:
                     f"{self.policy.max_pending_per_group}, "
                     f"{g.total_reserved_threads()} reserved >= "
                     f"{self.policy.table_threads_hard_limit}")
-            ctx = SchedulerQueryContext(group_name, fn, self._seq,
-                                        self._clock() * 1e3)
+            now_ms = self._clock() * 1e3
+            ctx = SchedulerQueryContext(
+                group_name, fn, self._seq, now_ms,
+                None if deadline_s is None else now_ms + deadline_s * 1e3)
             self._seq += 1
             g.pending.append(ctx)
             self._not_empty.notify()
@@ -282,12 +298,24 @@ class MultiLevelPriorityQueue:
         return False
 
     def _trim_expired(self, g: TokenSchedulerGroup) -> None:
-        deadline = self._clock() * 1e3 - self.query_deadline_s * 1e3
-        while g.pending and g.pending[0].arrival_ms < deadline:
+        now_ms = self._clock() * 1e3
+        oldest_ok = now_ms - self.query_deadline_s * 1e3
+        # scheduler-wide deadline: FIFO order makes the front oldest
+        while g.pending and g.pending[0].arrival_ms < oldest_ok:
             ctx = g.pending.popleft()
             ctx.future.set_exception(SchedulerDeadlineError(
                 f"query for group {g.name} expired after "
                 f"{self.query_deadline_s}s in scheduler queue"))
+        # per-query propagated deadlines are NOT monotone in arrival
+        # order (budgets differ per query) — scan the whole waitlist
+        expired = [ctx for ctx in g.pending
+                   if ctx.deadline_ms is not None and
+                   ctx.deadline_ms <= now_ms]
+        for ctx in expired:
+            g.pending.remove(ctx)
+            ctx.future.set_exception(SchedulerDeadlineError(
+                f"query for group {g.name} missed its propagated "
+                "deadline in the scheduler queue"))
 
     def take_next(self, timeout: float = 0.02
                   ) -> Optional[SchedulerQueryContext]:
@@ -384,13 +412,14 @@ class TokenBucketScheduler(QueryScheduler):
                                         name="scheduler", daemon=True)
         self._thread.start()
 
-    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+    def submit(self, group: str, fn: Callable[[], object],
+               deadline_s: Optional[float] = None) -> Future:
         if not self._running:
             f: Future = Future()
             f.set_exception(RuntimeError("scheduler is shut down"))
             return f
         try:
-            ctx = self.queue.put(group, fn)
+            ctx = self.queue.put(group, fn, deadline_s=deadline_s)
         except SchedulerOutOfCapacityError as e:
             f = Future()
             f.set_exception(e)
@@ -488,7 +517,8 @@ class BoundedFCFSScheduler(QueryScheduler):
         self._seq = 0
         self._lock = threading.Lock()
 
-    def submit(self, group: str, fn: Callable[[], object]) -> Future:
+    def submit(self, group: str, fn: Callable[[], object],
+               deadline_s: Optional[float] = None) -> Future:
         future: Future = Future()
         with self._lock:
             q = self._pending.setdefault(group, [])

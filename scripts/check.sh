@@ -8,5 +8,11 @@ echo "== tier-1 pytest =="
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
+echo "== chaos (broker fault tolerance) =="
+# dedicated gate: the fault-injection suite must stay green and fast
+# even if a future tier-1 filter stops collecting it implicitly
+env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
+    -q -p no:cacheprovider
+
 echo "== tpulint =="
 exec "$(dirname "$0")/lint.sh"

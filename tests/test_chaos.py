@@ -1,0 +1,441 @@
+"""Chaos suite: the broker fault-tolerance layer under injected faults.
+
+Every scenario runs a 2-replica embedded cluster through a seeded
+`FaultInjectingTransport` and asserts the tail-at-scale contract: the
+query returns either the correct full result (a surviving replica
+recovered it) or an honestly-flagged partial response
+(`partialResponse`, `numServersResponded < numServersQueried`) — never
+a silent wrong answer, never a hang past the propagated deadline.
+
+Determinism: fixed routing tables, seeded fault RNG, injectable clocks
+for breaker/scheduler tests. No wall-clock sleeps — the one bounded
+real wait is the deadline test's sub-second timeout itself.
+"""
+import asyncio
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment
+from oracle import Oracle
+
+from pinot_tpu.broker import (BrokerRequestHandler, FaultToleranceManager,
+                              InProcessTransport, RoutingManager)
+from pinot_tpu.broker.fault_tolerance import (BREAKER_CLOSED,
+                                              BREAKER_HALF_OPEN,
+                                              BREAKER_OPEN)
+from pinot_tpu.broker.routing import RoutingTableBuilder
+from pinot_tpu.common.cluster_state import ONLINE, TableView
+from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
+                                        SEGMENT_MISSING_EXC_PREFIX)
+from pinot_tpu.common.faults import (CORRUPT, DROP, ERROR, HANG, LATENCY,
+                                     MISSING_SEGMENTS, FaultInjectingTransport,
+                                     FaultSpec, corrupt_bytes)
+from pinot_tpu.common.metrics import (BrokerGauge, BrokerMeter,
+                                      MetricsRegistry, ServerMeter)
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.serde import (instance_request_from_bytes,
+                                    instance_request_to_bytes)
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.server import ServerInstance
+from pinot_tpu.server.scheduler import (MultiLevelPriorityQueue,
+                                        ResourceLimitPolicy,
+                                        SchedulerDeadlineError)
+
+TABLE = "baseballStats_OFFLINE"
+
+
+class FixedRoutingBuilder(RoutingTableBuilder):
+    """One fixed routing table — removes sampling nondeterminism."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def build(self, view, rng):
+        return [{srv: list(segs) for srv, segs in self.table.items()}]
+
+
+@pytest.fixture(scope="module")
+def replicated_cluster():
+    """2 servers, 2 segments, replication 2 (every segment on BOTH)."""
+    base = tempfile.mkdtemp()
+    servers = {f"server_{i}": ServerInstance(f"server_{i}")
+               for i in range(2)}
+    all_cols = []
+    view = TableView(TABLE, {})
+    for i, name in enumerate(["seg_a", "seg_b"]):
+        seg, cols = build_segment(f"{base}/seg{i}", n=700, seed=40 + i,
+                                  name=name)
+        all_cols.append(cols)
+        for srv in servers.values():
+            srv.data_manager.table(TABLE, create=True).add_segment(seg)
+        view.segment_states[name] = {s: ONLINE for s in servers}
+    merged = {k: (np.concatenate([c[k] for c in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((c[k] for c in all_cols), []))
+              for k in all_cols[0]}
+    yield servers, view, Oracle(merged)
+    for s in servers.values():
+        s.stop()
+
+
+def _make_handler(servers, view, routing_table, *, seed=0,
+                  default_timeout_s=15.0, ft_kwargs=None):
+    routing = RoutingManager(builder=FixedRoutingBuilder(routing_table))
+    routing.update_view(view)
+    transport = FaultInjectingTransport(InProcessTransport(servers),
+                                        seed=seed)
+    metrics = MetricsRegistry("broker")
+    ft = FaultToleranceManager(metrics=metrics, **(ft_kwargs or {}))
+    handler = BrokerRequestHandler(routing, transport, metrics=metrics,
+                                   default_timeout_s=default_timeout_s,
+                                   fault_tolerance=ft)
+    return handler, transport
+
+
+SPLIT_ROUTE = {"server_0": ["seg_a"], "server_1": ["seg_b"]}
+
+
+def _assert_full(resp, oracle):
+    m = oracle.mask(lambda r: True)
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert resp.partial_response is False
+    assert resp.exceptions == []
+    assert resp.num_servers_responded == resp.num_servers_queried
+
+
+# -- fault class: server exception ------------------------------------------
+
+def test_chaos_server_exception_recovers_via_replica(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+    handler, transport = _make_handler(servers, view, SPLIT_ROUTE)
+    transport.inject("server_0", FaultSpec(ERROR, error=RuntimeError(
+        "injected executor crash")))
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    _assert_full(resp, oracle)
+    assert transport.injected_count("server_0", ERROR) >= 1
+    m = handler.metrics
+    assert m.meter(BrokerMeter.SERVER_ERRORS).count >= 1
+    assert m.meter(BrokerMeter.SERVER_ERRORS, table="server_0").count >= 1
+    # the failure dented server_0's health score
+    assert m.gauge(BrokerGauge.SERVER_HEALTH, table="server_0").value < 1.0
+
+
+# -- fault class: corrupt frame ---------------------------------------------
+
+def test_chaos_corrupt_frame_recovers_via_replica(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+    handler, transport = _make_handler(servers, view, SPLIT_ROUTE)
+    transport.inject("server_0", FaultSpec(CORRUPT))
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    _assert_full(resp, oracle)
+    assert transport.injected_count("server_0", CORRUPT) >= 1
+    assert handler.metrics.meter(BrokerMeter.SERVER_ERRORS).count >= 1
+
+
+def test_corrupt_bytes_is_rejected_by_datatable():
+    dt = DataTable()
+    with pytest.raises(Exception):
+        DataTable.from_bytes(corrupt_bytes(dt.to_bytes()))
+
+
+# -- fault class: dropped connection ----------------------------------------
+
+def test_chaos_dropped_connection_recovers_via_replica(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+    handler, transport = _make_handler(servers, view, SPLIT_ROUTE)
+    transport.inject("server_0", FaultSpec(DROP))
+    resp = handler.handle("SELECT SUM(runs) FROM baseballStats")
+    m = oracle.mask(lambda r: True)
+    assert float(resp.aggregation_results[0].value) == pytest.approx(
+        oracle.sum("runs", m))
+    assert resp.partial_response is False
+    assert resp.exceptions == []
+    assert transport.injected_count("server_0", DROP) >= 1
+
+
+# -- fault class: slow replica past the hedge threshold ---------------------
+
+def test_chaos_hung_replica_hedged_to_healthy_one(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+    # hedge immediately (threshold 0): the hung primary never answers,
+    # the hedge wins, the loser is cancelled — zero sleeps involved
+    handler, transport = _make_handler(
+        servers, view, SPLIT_ROUTE,
+        ft_kwargs={"default_hedge_delay_s": 0.0})
+    transport.inject("server_0", FaultSpec(HANG))
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    _assert_full(resp, oracle)
+    assert handler.metrics.meter(BrokerMeter.HEDGED_REQUESTS).count >= 1
+    assert handler.metrics.meter(
+        BrokerMeter.HEDGED_REQUESTS, table="server_0").count >= 1
+
+
+def test_hedge_threshold_tracks_p95_latency():
+    ft = FaultToleranceManager(metrics=MetricsRegistry("broker"),
+                               min_hedge_samples=4, hedge_factor=3.0)
+    assert ft.hedge_delay_s("s0") is None      # no samples, no default
+    for ms in (10.0, 10.0, 10.0, 100.0):
+        ft.on_success("s0", ms)
+    delay = ft.hedge_delay_s("s0")
+    # p95 of the reservoir lands between 10ms and 100ms; threshold = x3
+    assert 0.010 * 3 <= delay <= 0.100 * 3
+
+
+# -- fault class: missing segments (stale routing) --------------------------
+
+def test_chaos_missing_segments_redispatched(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+    handler, transport = _make_handler(servers, view, SPLIT_ROUTE)
+    transport.inject("server_0", FaultSpec(MISSING_SEGMENTS,
+                                           segments=("seg_a",)))
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    m = oracle.mask(lambda r: True)
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert resp.partial_response is False
+    assert resp.exceptions == []
+    assert transport.injected_count("server_0", MISSING_SEGMENTS) >= 1
+
+
+# -- honest partial response when no replica survives -----------------------
+
+def test_chaos_partial_response_flagged_when_no_replica(replicated_cluster):
+    servers, _view, oracle = replicated_cluster
+    # single-replica view: seg_a only on server_0, seg_b only on server_1
+    view = TableView(TABLE, {"seg_a": {"server_0": ONLINE},
+                             "seg_b": {"server_1": ONLINE}})
+    handler, transport = _make_handler(servers, view, SPLIT_ROUTE)
+    transport.inject("server_0", FaultSpec(DROP))
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    # honest partial: flagged, counted, and attributed to the server
+    assert resp.partial_response is True
+    assert resp.num_servers_responded == 1 < resp.num_servers_queried == 2
+    assert any("server_0" in e["message"] for e in resp.exceptions)
+    assert any("ConnectionError" in e["message"] for e in resp.exceptions)
+    assert handler.metrics.meter(BrokerMeter.SERVER_ERRORS).count >= 1
+    # the data that DID survive is correct (seg_b's rows only)
+    seg_b_rows = 700
+    assert resp.aggregation_results[0].value == str(seg_b_rows)
+
+
+def test_chaos_total_outage_within_deadline(replicated_cluster):
+    servers, _view, oracle = replicated_cluster
+    view = TableView(TABLE, {"seg_a": {"server_0": ONLINE},
+                             "seg_b": {"server_1": ONLINE}})
+    # both servers hang, no replicas: the propagated deadline is the
+    # only thing standing between the client and an infinite wait
+    handler, transport = _make_handler(servers, view, SPLIT_ROUTE,
+                                       default_timeout_s=0.15)
+    transport.inject("server_0", FaultSpec(HANG))
+    transport.inject("server_1", FaultSpec(HANG))
+    t0 = time.monotonic()
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0                      # bounded by the deadline
+    assert resp.partial_response is True
+    assert resp.num_servers_responded == 0
+    assert any("ServerNotRespondedError" in e["message"]
+               for e in resp.exceptions)
+    assert any("ServerTimeoutError" in e["message"]
+               for e in resp.exceptions)
+
+
+# -- circuit breaker --------------------------------------------------------
+
+def test_breaker_opens_probes_and_recovers_with_virtual_clock():
+    t = [0.0]
+    m = MetricsRegistry("broker")
+    ft = FaultToleranceManager(metrics=m, clock=lambda: t[0],
+                               breaker_failure_threshold=3,
+                               breaker_recovery_s=10.0)
+    assert ft.allow_request("s0")
+    for _ in range(3):
+        ft.on_failure("s0")
+    assert ft.breaker_state("s0") == BREAKER_OPEN
+    assert not ft.allow_request("s0")          # shedding
+    assert m.gauge(BrokerGauge.BREAKER_STATE, table="s0").value == \
+        BREAKER_OPEN
+    t[0] = 10.5                                # recovery window elapsed
+    assert ft.allow_request("s0")              # exactly one probe
+    assert ft.breaker_state("s0") == BREAKER_HALF_OPEN
+    assert m.gauge(BrokerGauge.BREAKER_STATE, table="s0").value == \
+        BREAKER_HALF_OPEN
+    assert not ft.allow_request("s0")          # second probe refused
+    ft.on_failure("s0")                        # probe failed → re-open
+    assert ft.breaker_state("s0") == BREAKER_OPEN
+    t[0] = 21.0
+    assert ft.allow_request("s0")
+    ft.on_success("s0", 4.0)                   # probe succeeded → close
+    assert ft.breaker_state("s0") == BREAKER_CLOSED
+    assert m.gauge(BrokerGauge.BREAKER_STATE, table="s0").value == \
+        BREAKER_CLOSED
+    assert 0.0 < m.gauge(BrokerGauge.SERVER_HEALTH,
+                         table="s0").value < 1.0
+
+
+def test_chaos_breaker_sheds_flapping_server(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+    handler, transport = _make_handler(
+        servers, view, SPLIT_ROUTE,
+        ft_kwargs={"breaker_failure_threshold": 1,
+                   "breaker_recovery_s": 3600.0})
+    transport.inject("server_0", FaultSpec(ERROR))
+    resp1 = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    _assert_full(resp1, oracle)               # failure recovered once...
+    assert handler.fault_tolerance.breaker_state("server_0") == \
+        BREAKER_OPEN                          # ...and the breaker opened
+    errors_after_first = transport.injected_count("server_0", ERROR)
+    resp2 = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    _assert_full(resp2, oracle)
+    # the open breaker shed the dispatch: server_0 never saw query 2
+    assert transport.injected_count("server_0", ERROR) == \
+        errors_after_first
+
+
+# -- deadline propagation ---------------------------------------------------
+
+def test_deadline_budget_stamped_on_the_wire(replicated_cluster):
+    servers, view, oracle = replicated_cluster
+
+    class Recording(InProcessTransport):
+        def __init__(self, inner_servers):
+            super().__init__(inner_servers)
+            self.requests = []
+
+        async def query(self, server, payload, timeout):
+            self.requests.append(instance_request_from_bytes(payload))
+            return await super().query(server, payload, timeout)
+
+    routing = RoutingManager(builder=FixedRoutingBuilder(SPLIT_ROUTE))
+    routing.update_view(view)
+    transport = Recording(servers)
+    handler = BrokerRequestHandler(routing, transport,
+                                   default_timeout_s=7.5)
+    resp = handler.handle("SELECT COUNT(*) FROM baseballStats")
+    m = oracle.mask(lambda r: True)
+    assert resp.aggregation_results[0].value == str(oracle.count(m))
+    assert transport.requests
+    for req in transport.requests:
+        assert req.deadline_budget_ms is not None
+        assert 0 < req.deadline_budget_ms <= 7.5 * 1e3
+
+
+def test_deadline_budget_survives_serde_roundtrip():
+    req = InstanceRequest(request_id=9,
+                          query=compile_pql("SELECT COUNT(*) FROM t"),
+                          search_segments=["s1"], broker_id="b0",
+                          deadline_budget_ms=1234.5)
+    got = instance_request_from_bytes(instance_request_to_bytes(req))
+    assert got.deadline_budget_ms == 1234.5
+    # absent key (old-broker payload) deserializes to None
+    legacy = InstanceRequest(request_id=9, query=req.query)
+    assert instance_request_from_bytes(
+        instance_request_to_bytes(legacy)).deadline_budget_ms is None
+
+
+def test_server_drops_expired_work_without_executing(replicated_cluster):
+    servers, _view, _oracle = replicated_cluster
+    server = servers["server_0"]
+    query = compile_pql("SELECT COUNT(*) FROM baseballStats")
+    query.table_name = TABLE
+    req = InstanceRequest(request_id=1, query=query, search_segments=None)
+    dropped_before = server.metrics.meter(
+        ServerMeter.DEADLINE_EXPIRED_QUERIES).count
+    dt = server.executor.execute(req, deadline=time.monotonic() - 1.0)
+    assert any("DeadlineExceededError" in e for e in dt.exceptions)
+    assert dt.rows == []                      # nothing was computed
+    assert server.metrics.meter(
+        ServerMeter.DEADLINE_EXPIRED_QUERIES).count == dropped_before + 1
+
+
+def test_scheduler_queue_trims_propagated_deadline():
+    t = [0.0]
+    q = MultiLevelPriorityQueue(ResourceLimitPolicy(4), 4,
+                                query_deadline_s=30.0,
+                                clock=lambda: t[0])
+    ctx = q.put("g", lambda: 1, deadline_s=1.0)
+    live = q.put("g", lambda: 2)              # no propagated deadline
+    t[0] = 2.0                                # virtual clock: no sleeps
+    got = q.take_next(timeout=0)
+    assert got is live                        # expired entry was trimmed
+    assert isinstance(ctx.future.exception(), SchedulerDeadlineError)
+
+
+def test_retry_missing_segments_respects_exhausted_budget(
+        replicated_cluster):
+    servers, view, _oracle = replicated_cluster
+    handler, _transport = _make_handler(servers, view, SPLIT_ROUTE)
+    dt = DataTable()
+    dt.metadata[MISSING_SEGMENTS_KEY] = '["seg_a"]'
+    dt.exceptions.append(f"{SEGMENT_MISSING_EXC_PREFIX} ['seg_a']")
+    routes = [(compile_pql("SELECT COUNT(*) FROM baseballStats"),
+               {"server_0": ["seg_a"]})]
+
+    async def run():
+        return await handler._retry_missing_segments(
+            routes, [dt], deadline=time.monotonic() - 1.0)
+
+    tables, rq, rr, errors = asyncio.run(run())
+    assert rq == rr == 0 and errors == []     # no re-dispatch past budget
+    # the honest miss stays visible instead of a late/over-budget retry
+    assert any(e.startswith(SEGMENT_MISSING_EXC_PREFIX)
+               for e in tables[0].exceptions)
+
+
+# -- fault injection harness itself -----------------------------------------
+
+def test_fault_injection_is_seed_deterministic():
+    class Dummy:
+        async def query(self, server, payload, timeout):
+            return DataTable().to_bytes()
+
+        async def close(self):
+            pass
+
+    def activations(seed):
+        transport = FaultInjectingTransport(Dummy(), seed=seed)
+        transport.inject("s0", FaultSpec(DROP, probability=0.5))
+
+        async def run():
+            hits = []
+            for _ in range(20):
+                try:
+                    await transport.query("s0", b"x", 1.0)
+                    hits.append(False)
+                except ConnectionError:
+                    hits.append(True)
+            return hits
+
+        return asyncio.run(run())
+
+    assert activations(7) == activations(7)
+    assert activations(7) != activations(8)   # seed actually matters
+
+
+def test_fault_spec_times_budget_and_latency_sleep_injection():
+    sleeps = []
+
+    async def fake_sleep(s):
+        sleeps.append(s)                      # virtual: records, no wait
+
+    class Dummy:
+        async def query(self, server, payload, timeout):
+            return DataTable().to_bytes()
+
+        async def close(self):
+            pass
+
+    transport = FaultInjectingTransport(Dummy(), sleep=fake_sleep)
+    transport.inject("s0", FaultSpec(LATENCY, latency_s=9.0, times=2))
+
+    async def run():
+        for _ in range(5):
+            await transport.query("s0", b"x", 1.0)
+
+    asyncio.run(run())
+    assert sleeps == [9.0, 9.0]               # armed twice, then spent
+    assert transport.injected_count("s0", LATENCY) == 2
+    with pytest.raises(ValueError):
+        FaultSpec("no_such_fault")

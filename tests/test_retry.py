@@ -1,0 +1,114 @@
+"""Dedicated tests for common/retry.py: jitter bounds, cause chaining,
+retry_on filtering, and zero-sleep injection (the module previously had
+no direct coverage)."""
+import random
+
+import pytest
+
+from pinot_tpu.common.retry import (ExponentialBackoffRetryPolicy,
+                                    FixedDelayRetryPolicy,
+                                    RandomDelayRetryPolicy,
+                                    RetryExhaustedError, RetryPolicy)
+
+
+def test_exponential_backoff_jitter_bounds():
+    policy = ExponentialBackoffRetryPolicy(attempts=5, initial_delay_s=0.1,
+                                           scale=2.0,
+                                           rng=random.Random(3))
+    for attempt in range(6):
+        window = 0.1 * (2.0 ** attempt)
+        for _ in range(50):
+            d = policy.delay_for(attempt)
+            # uniformly jittered to [0.5, 1.0) of the window
+            assert 0.5 * window <= d < window
+
+
+def test_exponential_backoff_seeded_rng_is_deterministic():
+    a = ExponentialBackoffRetryPolicy(3, 0.5, rng=random.Random(11))
+    b = ExponentialBackoffRetryPolicy(3, 0.5, rng=random.Random(11))
+    assert [a.delay_for(i) for i in range(5)] == \
+        [b.delay_for(i) for i in range(5)]
+
+
+def test_retry_exhausted_chains_last_failure_as_cause():
+    boom = ValueError("attempt-specific detail")
+
+    def op():
+        raise boom
+
+    policy = FixedDelayRetryPolicy(attempts=3, delay_s=0.0)
+    with pytest.raises(RetryExhaustedError) as exc_info:
+        policy.attempt(op, sleep=lambda s: None)
+    assert exc_info.value.__cause__ is boom
+    assert "3 attempts" in str(exc_info.value)
+
+
+def test_retry_on_filters_exception_classes():
+    calls = []
+
+    def op():
+        calls.append(1)
+        raise ValueError("not retryable here")
+
+    policy = FixedDelayRetryPolicy(attempts=4, delay_s=0.0)
+    # a non-matching exception propagates immediately, unwrapped
+    with pytest.raises(ValueError):
+        policy.attempt(op, retry_on=(KeyError,), sleep=lambda s: None)
+    assert len(calls) == 1
+
+    # a matching one is retried to exhaustion
+    calls.clear()
+    with pytest.raises(RetryExhaustedError):
+        policy.attempt(op, retry_on=(ValueError,), sleep=lambda s: None)
+    assert len(calls) == 4
+
+
+def test_zero_sleep_injection_records_policy_delays():
+    slept = []
+    attempts = []
+
+    def op():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    policy = FixedDelayRetryPolicy(attempts=5, delay_s=1.5)
+    assert policy.attempt(op, sleep=slept.append) == "ok"
+    # two failures → two sleeps, none real; no sleep after success
+    assert slept == [1.5, 1.5]
+    assert len(attempts) == 3
+
+
+def test_no_sleep_after_final_attempt():
+    slept = []
+    policy = FixedDelayRetryPolicy(attempts=2, delay_s=0.7)
+
+    def op():
+        raise OSError("always")
+
+    with pytest.raises(RetryExhaustedError):
+        policy.attempt(op, sleep=slept.append)
+    assert slept == [0.7]          # N attempts sleep only N-1 times
+
+
+def test_random_delay_policy_bounds():
+    policy = RandomDelayRetryPolicy(attempts=3, min_delay_s=0.2,
+                                    max_delay_s=0.9,
+                                    rng=random.Random(5))
+    for attempt in range(10):
+        assert 0.2 <= policy.delay_for(attempt) <= 0.9
+
+
+def test_policy_validates_attempts():
+    with pytest.raises(ValueError):
+        RetryPolicy(0)
+    with pytest.raises(ValueError):
+        ExponentialBackoffRetryPolicy(attempts=-1, initial_delay_s=0.1)
+
+
+def test_first_attempt_success_never_sleeps():
+    slept = []
+    policy = ExponentialBackoffRetryPolicy(attempts=4, initial_delay_s=9.0)
+    assert policy.attempt(lambda: 42, sleep=slept.append) == 42
+    assert slept == []
