@@ -132,11 +132,17 @@ class _ServerEntry:
     """One server's breaker + health score (mutations are guarded by
     the owning FaultToleranceManager's lock)."""
 
-    __slots__ = ("breaker", "health")
+    __slots__ = ("breaker", "health", "hedge_at_count", "hedge_delay_s")
 
     def __init__(self, breaker: CircuitBreaker):
         self.breaker = breaker
         self.health = 1.0
+        # memoized hedge threshold: (sample count it was computed at,
+        # value) — the p95 over a 1024-sample reservoir barely moves per
+        # sample, and recomputing the percentile on EVERY dispatch was a
+        # measurable slice of broker CPU at high QPS
+        self.hedge_at_count = -1
+        self.hedge_delay_s = None
 
 
 class FaultToleranceManager:
@@ -234,14 +240,26 @@ class FaultToleranceManager:
     def breaker_state(self, server: str) -> int:
         return self._entry(server).breaker.state
 
+    # recompute the hedge percentile at most once per this many new
+    # latency samples (a 1/16 reservoir turnover)
+    HEDGE_REFRESH_SAMPLES = 64
+
     def hedge_delay_s(self, server: str) -> Optional[float]:
         """How long to wait on `server` before dispatching a hedge, or
         None when hedging is off for it (no latency history yet and no
         default configured)."""
         timer = self.metrics.timer(BrokerTimer.SERVER_LATENCY, table=server)
-        if timer.count >= self.min_hedge_samples:
-            p = timer.percentile_ms(self.hedge_quantile)
-            return max(self.HEDGE_MIN_S, p * self.hedge_factor / 1e3)
+        count = timer.count
+        if count >= self.min_hedge_samples:
+            entry = self._entry(server)
+            if entry.hedge_at_count < 0 or \
+                    count - entry.hedge_at_count >= \
+                    self.HEDGE_REFRESH_SAMPLES:
+                p = timer.percentile_ms(self.hedge_quantile)
+                entry.hedge_delay_s = max(self.HEDGE_MIN_S,
+                                          p * self.hedge_factor / 1e3)
+                entry.hedge_at_count = count
+            return entry.hedge_delay_s
         return self.default_hedge_delay_s
 
     def snapshot(self) -> Dict[str, dict]:

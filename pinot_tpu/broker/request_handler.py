@@ -68,7 +68,10 @@ class InProcessTransport(ServerTransport):
 
 
 class TcpTransport(ServerTransport):
-    """One persistent framed TCP connection per server."""
+    """One persistent MULTIPLEXED framed TCP connection per server:
+    every concurrent query to a server shares its channel, correlated by
+    requestId (ServerChannels parity), so in-flight requests are bounded
+    by the server, not by a one-at-a-time connection lock."""
 
     def __init__(self, endpoints: Dict[str, Tuple[str, int]]):
         self.endpoints = dict(endpoints)
@@ -76,18 +79,28 @@ class TcpTransport(ServerTransport):
 
     def set_endpoint(self, server: str, host: str, port: int) -> None:
         self.endpoints[server] = (host, port)
-        self._conns.pop(server, None)
+        stale = self._conns.pop(server, None)
+        if stale is not None:
+            # fail the old channel's in-flight requests promptly (they
+            # were sent to the departed endpoint) instead of leaking a
+            # reader task on a dead socket until its peers time out.
+            # Callers are watcher threads, not the event loop — the
+            # connection schedules close() onto ITS OWN loop.
+            stale.close_threadsafe()
 
     async def query(self, server: str, payload: bytes,
                     timeout: float) -> bytes:
         conn = self._conns.get(server)
         if conn is None:
             host, port = self.endpoints[server]
-            conn = ServerConnection(host, port)
-            self._conns[server] = conn
-        # the deadline covers connect + per-connection queueing + write +
-        # read: a black-holed server (dropped SYNs) or a slow in-flight
-        # query ahead of us must still surface as a timely partial response
+            # concurrent first-queries race to create the channel;
+            # setdefault keeps exactly one so they truly share it
+            conn = self._conns.setdefault(server,
+                                          ServerConnection(host, port))
+        # the deadline covers connect + write + read: a black-holed
+        # server (dropped SYNs) or a slow reply must still surface as a
+        # timely partial response — and a timeout abandons only THIS
+        # request's future, never the shared channel
         return await asyncio.wait_for(conn.request(payload, timeout),
                                       timeout)
 
@@ -384,11 +397,23 @@ class BrokerRequestHandler:
     # -- sync facade -------------------------------------------------------
     def handle(self, pql: str, identity=None,
                force_trace: bool = False) -> BrokerResponse:
+        """The CPU stages (compile, ACL, route, reduce) run HERE, on the
+        caller's thread; only the scatter-gather await shares the event
+        loop. One loop thread carries every concurrent query's network
+        waits just fine — it cannot also carry every query's compile and
+        reduce without becoming the serving plane's bottleneck."""
         with self._loop_lock:
             if self._loop is None:
                 self._loop = EventLoopThread()
             loop = self._loop
-        return loop.run(self.handle_async(pql, identity, force_trace))
+        prepared = self._prepare(pql, identity, force_trace)
+        if isinstance(prepared, BrokerResponse):
+            return prepared
+        request, trace, routes, timeout_s, deadline, t0 = prepared
+        tables, queried, responded, errors = loop.run(
+            self._scatter(request, trace, routes, timeout_s, deadline))
+        return self._finish(request, trace, t0, tables, queried,
+                            responded, errors)
 
     def close(self) -> None:
         if self._loop is not None:
@@ -398,6 +423,19 @@ class BrokerRequestHandler:
 
     async def handle_async(self, pql: str, identity=None,
                            force_trace: bool = False) -> BrokerResponse:
+        prepared = self._prepare(pql, identity, force_trace)
+        if isinstance(prepared, BrokerResponse):
+            return prepared
+        request, trace, routes, timeout_s, deadline, t0 = prepared
+        tables, queried, responded, errors = await self._scatter(
+            request, trace, routes, timeout_s, deadline)
+        return self._finish(request, trace, t0, tables, queried,
+                            responded, errors)
+
+    # -- pipeline stages ---------------------------------------------------
+    def _prepare(self, pql: str, identity, force_trace: bool):
+        """Sync CPU stage: compile → ACL → quota → route. Returns a
+        BrokerResponse on early exit, else the scatter inputs."""
         t0 = time.perf_counter()
         self.metrics.meter(BrokerMeter.QUERIES).mark()
         t = time.perf_counter()
@@ -446,6 +484,12 @@ class BrokerRequestHandler:
         # every retry: re-dispatches spend the remaining budget, they
         # never extend user-visible latency past the requested timeout
         deadline = time.monotonic() + timeout_s
+        return request, trace, routes, timeout_s, deadline, t0
+
+    async def _scatter(self, request: BrokerRequest, trace: Trace, routes,
+                       timeout_s: float, deadline: float):
+        """Async network stage: dispatch + gather + missing-segment
+        retry. The only stage that runs on the shared event loop."""
         with self.metrics.timer(BrokerQueryPhase.SCATTER_GATHER).time(), \
                 trace.span(BrokerQueryPhase.SCATTER_GATHER):
             tables, queried, responded, errors = await self.router.submit(
@@ -459,6 +503,12 @@ class BrokerRequestHandler:
             queried += rq
             responded += rr
             errors += retry_errors
+        return tables, queried, responded, errors
+
+    def _finish(self, request: BrokerRequest, trace: Trace, t0: float,
+                tables: List[DataTable], queried: int, responded: int,
+                errors: List[dict]) -> BrokerResponse:
+        """Sync CPU stage: reduce + failure surfacing + trace merge."""
         if responded < queried:
             self.metrics.meter(
                 BrokerMeter.BROKER_RESPONSES_WITH_PARTIAL_SERVERS).mark()

@@ -1,9 +1,22 @@
 """DataTable: the server→broker result wire format.
 
 Parity: pinot-common/.../utils/DataTable.java + DataTableImplV2.java:40-263 —
-version, metadata map, exceptions, schema (column names/types), row payload —
-rebuilt as a tagged binary format on top of the typed object serde
-(common/serde.py) instead of the reference's fixed+variable byte regions.
+version, metadata map, exceptions, schema (column names/types), row payload.
+
+Two wire versions, negotiated by the leading version tag (decode handles
+both; encode defaults to the newest):
+
+- v1: per-row tagged object serde (one `_w_obj` per row tuple) — the
+  original format, kept decodable so payloads from version-skewed servers
+  still reduce.
+- v2: COLUMNAR — the row payload is split into per-column blocks, like
+  DataTableImplV2's fixed-size/variable-size regions. Homogeneous int64 /
+  float64 / string columns serialize as fixed-width numpy buffers (plus a
+  var-width utf-8 region for strings); anything else (pairs, sketches,
+  sets, mixed types) falls back to one tagged object list per column.
+  Group-by and selection payloads are dominated by exactly those
+  homogeneous columns, so the per-row tag/tuple churn of v1 disappears
+  from the serving hot path.
 
 Three logical layouts mirror IntermediateResultsBlock's payloads:
 - aggregation-only: one row, one object cell per aggregation function
@@ -16,17 +29,26 @@ import dataclasses
 import struct
 from typing import Dict, List
 
+import numpy as np
+
 from pinot_tpu.common.request import BrokerRequest
 from pinot_tpu.common.serde import obj_from_bytes, obj_to_bytes
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
 
 _U32 = struct.Struct(">I")
-VERSION = 1
+VERSION = 2
+_LEGACY_VERSION = 1
 
 KIND_EMPTY = 0
 KIND_AGGREGATION = 1
 KIND_GROUP_BY = 2
 KIND_SELECTION = 3
+
+# v2 column-block tags
+_COL_I64 = b"L"      # big-endian int64 fixed-width block
+_COL_F64 = b"F"      # big-endian float64 fixed-width block
+_COL_STR = b"S"      # u32 offsets (fixed region) + utf-8 blob (var region)
+_COL_OBJ = b"O"      # tagged object list fallback
 
 # Structured metadata key carrying the JSON list of segments a server was
 # asked for but does not host; the broker keys its one-shot re-dispatch off
@@ -47,17 +69,22 @@ class DataTable:
     exceptions: List[str] = dataclasses.field(default_factory=list)
 
     # -- wire format -------------------------------------------------------
-    def to_bytes(self) -> bytes:
+    def to_bytes(self, version: int = VERSION) -> bytes:
         out = bytearray()
-        out += _U32.pack(VERSION)
+        out += _U32.pack(version)
         out += bytes([self.kind])
         out += _U32.pack(self.num_group_cols)
         _w_obj(out, self.metadata)
         _w_obj(out, list(self.exceptions))
         _w_obj(out, list(self.columns))
-        out += _U32.pack(len(self.rows))
-        for row in self.rows:
-            _w_obj(out, tuple(row))
+        if version == _LEGACY_VERSION:
+            out += _U32.pack(len(self.rows))
+            for row in self.rows:
+                _w_obj(out, tuple(row))
+        elif version == VERSION:
+            _write_columnar(out, self.rows)
+        else:
+            raise ValueError(f"unsupported DataTable version {version}")
         return bytes(out)
 
     @classmethod
@@ -65,7 +92,7 @@ class DataTable:
         off = 0
         version = _U32.unpack_from(b, off)[0]
         off += 4
-        if version != VERSION:
+        if version not in (_LEGACY_VERSION, VERSION):
             raise ValueError(f"unsupported DataTable version {version}")
         kind = b[off]
         off += 1
@@ -74,12 +101,15 @@ class DataTable:
         metadata, off = _r_obj(b, off)
         exceptions, off = _r_obj(b, off)
         columns, off = _r_obj(b, off)
-        n_rows = _U32.unpack_from(b, off)[0]
-        off += 4
-        rows = []
-        for _ in range(n_rows):
-            row, off = _r_obj(b, off)
-            rows.append(row)
+        if version == _LEGACY_VERSION:
+            n_rows = _U32.unpack_from(b, off)[0]
+            off += 4
+            rows = []
+            for _ in range(n_rows):
+                row, off = _r_obj(b, off)
+                rows.append(row)
+        else:
+            rows, off = _read_columnar(b, off)
         return cls(kind=kind, columns=list(columns), rows=rows,
                    num_group_cols=num_group_cols,
                    metadata=dict(metadata), exceptions=list(exceptions))
@@ -93,14 +123,14 @@ class DataTable:
         dt.metadata["timeUsedMs"] = f"{block.stats.time_used_ms:.3f}"
         if block.execution_path is not None:
             dt.metadata["executionPath"] = block.execution_path
-        # numpy-scalar normalization happens inside serde._write_obj, so
-        # rows can carry intermediates as-is
+        # numpy-scalar normalization happens inside serde._write_obj (and
+        # the columnar writer), so rows can carry intermediates as-is
         if block.group_map is not None:
             dt.kind = KIND_GROUP_BY
             gcols = request.group_by.columns if request.group_by else []
             dt.num_group_cols = len(gcols)
             dt.columns = list(gcols) + [a.call for a in request.aggregations]
-            dt.rows = [tuple(key) + tuple(inters)
+            dt.rows = [key + tuple(inters)
                        for key, inters in block.group_map.items()]
         elif block.agg_intermediates is not None:
             dt.kind = KIND_AGGREGATION
@@ -109,7 +139,10 @@ class DataTable:
         elif block.selection_rows is not None:
             dt.kind = KIND_SELECTION
             dt.columns = list(block.selection_columns or [])
-            dt.rows = [tuple(row) for row in block.selection_rows]
+            # selection rows are already tuples on the execution path —
+            # re-tupling every row was pure churn at scale
+            dt.rows = [r if type(r) is tuple else tuple(r)
+                       for r in block.selection_rows]
             if block.selection_display_cols is not None:
                 # trailing ORDER-BY-only columns: the broker needs the
                 # display split to trim after its cross-server merge
@@ -122,12 +155,16 @@ class DataTable:
         blk.stats = _stats_from_metadata(self.metadata)
         if self.kind == KIND_GROUP_BY:
             g = self.num_group_cols
+            # rows are tuples on every decode path, so tuple() here is a
+            # no-op identity check, not a copy (it only materializes for
+            # hand-built list rows)
             blk.group_map = {tuple(row[:g]): list(row[g:])
                              for row in self.rows}
         elif self.kind == KIND_AGGREGATION:
             blk.agg_intermediates = list(self.rows[0]) if self.rows else None
         elif self.kind == KIND_SELECTION:
-            blk.selection_rows = [tuple(r) for r in self.rows]
+            blk.selection_rows = [r if type(r) is tuple else tuple(r)
+                                  for r in self.rows]
             blk.selection_columns = list(self.columns)
             n = self.metadata.get("selectionDisplayCols")
             if n is not None:
@@ -150,6 +187,98 @@ def _stats_from_metadata(md: Dict[str, str]) -> ExecutionStats:
         num_consuming_segments_processed=gi("numConsumingSegmentsProcessed"),
         min_consuming_freshness_ms=gi("minConsumingFreshnessTimeMs"),
         time_used_ms=float(md.get("timeUsedMs", "0")))
+
+
+# ---------------------------------------------------------------------------
+# v2 columnar payload
+# ---------------------------------------------------------------------------
+
+_I64_MIN, _I64_MAX = -(2 ** 63), 2 ** 63 - 1
+
+
+def _is_i64(v) -> bool:
+    if type(v) is int:                      # excludes bool
+        return _I64_MIN <= v <= _I64_MAX
+    return isinstance(v, np.integer)
+
+
+def _is_f64(v) -> bool:
+    return type(v) is float or isinstance(v, np.floating)
+
+
+def _write_columnar(out: bytearray, rows: List[tuple]) -> None:
+    n_rows = len(rows)
+    n_cols = len(rows[0]) if rows else 0
+    out += _U32.pack(n_rows)
+    out += _U32.pack(n_cols)
+    if not n_rows or not n_cols:
+        return
+    for col in zip(*rows):
+        _write_column(out, col)
+
+
+def _write_column(out: bytearray, col: tuple) -> None:
+    if all(_is_i64(v) for v in col):
+        out += _COL_I64
+        out += np.asarray(col, dtype=">i8").tobytes()
+    elif all(_is_f64(v) for v in col):
+        out += _COL_F64
+        out += np.asarray(col, dtype=">f8").tobytes()
+    elif all(type(v) is str for v in col):
+        encoded = [v.encode("utf-8") for v in col]
+        offsets = np.zeros(len(col) + 1, dtype=">u4")
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        out += _COL_STR
+        out += _U32.pack(len(blob))
+        out += offsets.tobytes()
+        out += blob
+    else:
+        # heterogeneous / complex cells (pairs, sketches, None, bool,
+        # bigint, bytes): one tagged object list for the whole column —
+        # still no per-ROW tuple headers
+        out += _COL_OBJ
+        _w_obj(out, list(col))
+
+
+def _read_columnar(b: bytes, off: int):
+    n_rows = _U32.unpack_from(b, off)[0]
+    off += 4
+    n_cols = _U32.unpack_from(b, off)[0]
+    off += 4
+    if not n_rows or not n_cols:
+        return [() for _ in range(n_rows)], off
+    cols = []
+    for _ in range(n_cols):
+        col, off = _read_column(b, off, n_rows)
+        cols.append(col)
+    return list(zip(*cols)), off
+
+
+def _read_column(b: bytes, off: int, n: int):
+    tag = b[off:off + 1]
+    off += 1
+    if tag == _COL_I64:
+        end = off + n * 8
+        return np.frombuffer(b, dtype=">i8", count=n,
+                             offset=off).tolist(), end
+    if tag == _COL_F64:
+        end = off + n * 8
+        return np.frombuffer(b, dtype=">f8", count=n,
+                             offset=off).tolist(), end
+    if tag == _COL_STR:
+        blob_len = _U32.unpack_from(b, off)[0]
+        off += 4
+        offsets = np.frombuffer(b, dtype=">u4", count=n + 1, offset=off)
+        off += (n + 1) * 4
+        blob = b[off:off + blob_len]
+        off += blob_len
+        return [blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+                for i in range(n)], off
+    if tag == _COL_OBJ:
+        col, off = _r_obj(b, off)
+        return col, off
+    raise ValueError(f"bad DataTable column tag {tag!r} at {off - 1}")
 
 
 def _w_obj(out: bytearray, v) -> None:

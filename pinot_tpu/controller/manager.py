@@ -247,8 +247,11 @@ class ResourceManager:
 
             self.coordinator.update_ideal_state(table, offline)
         else:
+            # externally built segments may omit per-column partition
+            # lists — tolerate like the rebalance path does, instead of
+            # failing the whole upload with a KeyError
             pids = {p for info in partition_meta.values()
-                    for p in info["partitions"]}
+                    for p in info.get("partitions") or ()}
             assigned = strategy.assign(name, servers, replicas, current,
                                        partition_ids=pids or None)
 

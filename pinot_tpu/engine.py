@@ -43,6 +43,12 @@ class QueryEngine:
     def query(self, pql: str) -> BrokerResponse:
         t0 = time.perf_counter()
         request = self.optimizer.optimize(compile_pql(pql))
+        from pinot_tpu.query.plan import preprocess_request
+        # FASTHLL derived rewrite, once, while the request is still
+        # private to this query — the executors preprocess defensively
+        # too (on copies), but the rewritten column name must be visible
+        # to the reduce for result naming (reference parity)
+        request = preprocess_request(self.segments, request)
         block = self._execute(request)
         resp = self.reducer.reduce(request, [block])
         resp.time_used_ms = (time.perf_counter() - t0) * 1e3

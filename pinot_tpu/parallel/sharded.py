@@ -487,7 +487,9 @@ class ShardedQueryExecutor:
                  ) -> IntermediateResultsBlock:
         t0 = time.perf_counter()
         from pinot_tpu.query.plan import preprocess_request
-        preprocess_request(segments, request)   # FASTHLL derived rewrite
+        # FASTHLL derived rewrite — on a copy; the shared request must
+        # not change under concurrently planning executors
+        request = preprocess_request(segments, request)
         stack = self.stack_for(segments)
         # Fast paths (star-tree cubes, metadata/dictionary answers) are
         # per-segment host work in each segment's OWN id domain — probe

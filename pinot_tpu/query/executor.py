@@ -5,11 +5,18 @@ acquire segments → prune → plan → execute per segment → combine → resu
 block with execution stats. Device-unsupported query shapes fall back to the
 host (numpy) executor per segment, the way the reference falls back from
 index-based to scan-based operators.
+
+Per-segment execution fans out on the scheduler's query-worker pool
+(CombineOperator parity: per-segment plans on an ExecutorService,
+CombineOperator.java:27). Device dispatches serialize on the chip anyway,
+so the workers overlap host-side planning/decoding/finishing with device
+work — the win the reference gets from planNodes.parallelStream().
 """
 from __future__ import annotations
 
+import concurrent.futures
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from pinot_tpu.common.metrics import ServerQueryPhase
 from pinot_tpu.common.request import BrokerRequest
@@ -26,10 +33,14 @@ from pinot_tpu.segment.loader import ImmutableSegment
 class ServerQueryExecutor:
     def __init__(self, plan_maker: Optional[InstancePlanMaker] = None,
                  pruner: Optional[SegmentPrunerService] = None,
-                 use_device: bool = True):
+                 use_device: bool = True,
+                 segment_executor: Optional[
+                     concurrent.futures.Executor] = None):
         self.plan_maker = plan_maker or InstancePlanMaker()
         self.pruner = pruner or SegmentPrunerService()
         self.use_device = use_device
+        # the scheduler's query-worker pool; None → sequential loop
+        self.segment_executor = segment_executor
 
     def execute(self, request: BrokerRequest,
                 segments: List[ImmutableSegment],
@@ -37,13 +48,15 @@ class ServerQueryExecutor:
                 deadline: Optional[float] = None
                 ) -> IntermediateResultsBlock:
         """`deadline`: absolute time.monotonic() instant; the
-        per-segment loop stops (with an honest truncation exception)
+        per-segment fan-out stops (with an honest truncation exception)
         once it passes — a deadline-expired query must not keep a
         worker pinned computing rows its broker stopped listening for."""
         trace = trace if trace is not None else make_trace(False)
         t0 = time.perf_counter()
         from pinot_tpu.query.plan import preprocess_request
-        preprocess_request(segments, request)   # FASTHLL derived rewrite
+        # FASTHLL derived rewrite — returns a copy when it rewrites, so
+        # the broker's shared request never changes under our feet
+        request = preprocess_request(segments, request)
         with trace.span(ServerQueryPhase.SEGMENT_PRUNING):
             selected = self.pruner.prune(segments, request)
         num_pruned = len(segments) - len(selected)
@@ -59,45 +72,14 @@ class ServerQueryExecutor:
                 blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
                 return blk
 
-        blocks: List[IntermediateResultsBlock] = []
-        extra_parts = extra_matched = 0
-        truncated_at: Optional[int] = None
         with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
-            for seg_idx, seg in enumerate(selected):
-                if deadline is not None and \
-                        time.monotonic() >= deadline:
-                    truncated_at = seg_idx
-                    break
-                if self.use_device and \
-                        getattr(seg, "is_mutable", False) and \
-                        hasattr(seg, "device_view"):
-                    # consuming segment: the periodic sorted snapshot
-                    # serves the frozen prefix on the DEVICE kernels and
-                    # the post-freeze tail host-side; the two parts
-                    # combine like any other pair of segments
-                    # (reference: consuming segments are first-class
-                    # engine targets, MutableSegmentImpl.java:64-198)
-                    frozen, tail = seg.device_view()
-                    fb = tb = None
-                    if frozen is not None:
-                        fb = self._execute_segment(frozen, request)
-                        blocks.append(fb)
-                    if tail.num_docs > 0 or frozen is None:
-                        tb = self._execute_segment(tail, request)
-                        blocks.append(tb)
-                    if fb is not None and tb is not None:
-                        extra_parts += 1
-                        if fb.stats.num_segments_matched and \
-                                tb.stats.num_segments_matched:
-                            extra_matched += 1
-                    continue
-                if getattr(seg, "is_mutable", False) and \
-                        hasattr(seg, "snapshot_view"):
-                    # consuming segment: freeze (num_docs, cardinalities) so
-                    # the filter mask and every column lane agree while the
-                    # consumer thread keeps appending
-                    seg = seg.snapshot_view()
-                blocks.append(self._execute_segment(seg, request))
+            if self.segment_executor is not None and len(selected) > 1:
+                blocks, extra_parts, extra_matched, executed = \
+                    self._run_parallel(selected, request, deadline)
+            else:
+                blocks, extra_parts, extra_matched, executed = \
+                    self._run_sequential(selected, request, deadline)
+        truncated = executed < len(selected)
 
         if not blocks:
             blk = IntermediateResultsBlock()
@@ -110,10 +92,10 @@ class ServerQueryExecutor:
                 blk.selection_columns = list(request.selection.columns)
         else:
             blk = combine_blocks(request, blocks)
-        if truncated_at is not None:
+        if truncated:
             blk.exceptions.append(
                 "DeadlineExceededError: segment execution truncated at "
-                f"{truncated_at}/{len(selected)} segments (budget "
+                f"{executed}/{len(selected)} segments (budget "
                 "expired mid-query)")
         if extra_parts:
             # frozen+tail pairs are ONE logical consuming segment: both
@@ -131,6 +113,112 @@ class ServerQueryExecutor:
         blk.stats.num_segments_pruned = num_pruned
         blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
         return blk
+
+    # -- per-segment work ---------------------------------------------------
+    def _segment_work(self, seg, request: BrokerRequest
+                      ) -> Tuple[List[IntermediateResultsBlock], int, int]:
+        """Execute ONE logical segment; returns (blocks, extra_parts,
+        extra_matched) — a consuming segment's frozen+tail pair yields
+        two blocks that stay paired for stats accounting."""
+        if self.use_device and getattr(seg, "is_mutable", False) and \
+                hasattr(seg, "device_view"):
+            # consuming segment: the periodic sorted snapshot serves the
+            # frozen prefix on the DEVICE kernels and the post-freeze
+            # tail host-side; the two parts combine like any other pair
+            # of segments (reference: consuming segments are first-class
+            # engine targets, MutableSegmentImpl.java:64-198)
+            frozen, tail = seg.device_view()
+            blocks: List[IntermediateResultsBlock] = []
+            fb = tb = None
+            if frozen is not None:
+                fb = self._execute_segment(frozen, request)
+                blocks.append(fb)
+            if tail.num_docs > 0 or frozen is None:
+                tb = self._execute_segment(tail, request)
+                blocks.append(tb)
+            if fb is not None and tb is not None:
+                matched = 1 if (fb.stats.num_segments_matched and
+                                tb.stats.num_segments_matched) else 0
+                return blocks, 1, matched
+            return blocks, 0, 0
+        if getattr(seg, "is_mutable", False) and \
+                hasattr(seg, "snapshot_view"):
+            # consuming segment: freeze (num_docs, cardinalities) so the
+            # filter mask and every column lane agree while the consumer
+            # thread keeps appending
+            seg = seg.snapshot_view()
+        return [self._execute_segment(seg, request)], 0, 0
+
+    def _run_sequential(self, selected, request: BrokerRequest,
+                        deadline: Optional[float]):
+        blocks: List[IntermediateResultsBlock] = []
+        extra_parts = extra_matched = 0
+        executed = 0
+        for seg in selected:
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            segment_blocks, parts, matched = self._segment_work(seg,
+                                                                request)
+            blocks.extend(segment_blocks)
+            extra_parts += parts
+            extra_matched += matched
+            executed += 1
+        return blocks, extra_parts, extra_matched, executed
+
+    def _run_parallel(self, selected, request: BrokerRequest,
+                      deadline: Optional[float]):
+        """CombineOperator parity: every segment plan runs as a task on
+        the scheduler's query-worker pool while this (runner) thread
+        gathers. Deadline truncation: tasks not yet started when the
+        budget expires return unexecuted (the pool's queue order makes
+        "stop submitting" and "reject on pick-up" equivalent), and the
+        gather abandons stragglers instead of waiting past the deadline.
+        """
+        def work(seg):
+            if deadline is not None and time.monotonic() >= deadline:
+                return None                 # budget gone before start
+            return self._segment_work(seg, request)
+
+        futures = [self.segment_executor.submit(work, seg)
+                   for seg in selected]
+        results: List[Optional[tuple]] = [None] * len(selected)
+        abandoned = False
+        for i, fut in enumerate(futures):
+            if abandoned:
+                fut.cancel()
+                continue
+            budget = None if deadline is None else \
+                deadline - time.monotonic()
+            try:
+                results[i] = fut.result(
+                    timeout=None if budget is None else max(budget, 0.0))
+            except concurrent.futures.TimeoutError:
+                # budget expired mid-gather: abandon this straggler and
+                # cancel everything not yet started; whatever already
+                # finished still counts (drain-what's-done semantics)
+                abandoned = True
+                fut.cancel()
+        if abandoned:
+            for i, fut in enumerate(futures):
+                if results[i] is None and fut.done() and \
+                        not fut.cancelled():
+                    try:
+                        results[i] = fut.result(timeout=0)
+                    except (concurrent.futures.TimeoutError,
+                            concurrent.futures.CancelledError):
+                        pass
+        blocks: List[IntermediateResultsBlock] = []
+        extra_parts = extra_matched = 0
+        executed = 0
+        for res in results:
+            if res is None:
+                continue
+            segment_blocks, parts, matched = res
+            blocks.extend(segment_blocks)
+            extra_parts += parts
+            extra_matched += matched
+            executed += 1
+        return blocks, extra_parts, extra_matched, executed
 
     def _execute_segment(self, segment: ImmutableSegment,
                          request: BrokerRequest) -> IntermediateResultsBlock:

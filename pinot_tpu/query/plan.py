@@ -16,6 +16,7 @@ executor (query/host_exec.py).
 """
 from __future__ import annotations
 
+import copy
 import dataclasses
 import os
 import re as _re
@@ -27,8 +28,8 @@ import numpy as np
 
 from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.datatype import DataType
-from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
-                                      FilterQueryTree)
+from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
+                                      FilterOperator, FilterQueryTree)
 from pinot_tpu.ops import kernels
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
@@ -330,14 +331,22 @@ class SegmentPlan:
         return execution.execute_segment_plan(self)
 
 
-def preprocess_request(segments, request) -> None:
+def preprocess_request(segments, request):
     """Parity: core/plan/maker/BrokerRequestPreProcessor.preProcess —
     rewrite FASTHLL(col) to the derived serialized-HLL column recorded in
-    segment metadata (consistency-checked across the segment set); applied
-    in place before planning, exactly like the reference."""
+    segment metadata (consistency-checked across the segment set).
+
+    Returns the request to plan against: the ORIGINAL when no rewrite
+    applies, otherwise a shallow COPY with fresh AggregationInfo entries.
+    The shared BrokerRequest is never mutated — with per-segment
+    execution parallel (and hybrid sub-requests sharing structure), an
+    in-place rewrite would be visible mid-plan to concurrently executing
+    in-process servers.
+    """
     if not request.aggregations:
-        return
-    for agg in request.aggregations:
+        return request
+    rewrites: Dict[int, str] = {}
+    for idx, agg in enumerate(request.aggregations):
         if agg.function_name.upper() != "FASTHLL":
             continue
         derived = None
@@ -354,7 +363,15 @@ def preprocess_request(segments, request) -> None:
                     f"segment {first_name}: {derived}; in segment "
                     f"{getattr(seg, 'segment_name', '?')}: {d}")
         if derived is not None:
-            agg.column = derived
+            rewrites[idx] = derived
+    if not rewrites:
+        return request
+    out = copy.copy(request)
+    out.aggregations = [
+        AggregationInfo(a.function_name, rewrites[i]) if i in rewrites
+        else a
+        for i, a in enumerate(request.aggregations)]
+    return out
 
 
 class InstancePlanMaker:

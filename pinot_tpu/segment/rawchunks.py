@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
 from typing import List, Optional, Sequence
 
@@ -87,6 +88,10 @@ class ChunkedRawReader:
         self._data = data
         self._data_start = off0 + (n_chunks + 1) * 8
         self._cache: dict = {}      # chunk idx → (offsets u32, payload)
+        # two queries can scan the same segment concurrently now that
+        # per-segment execution fans out on the worker pool — the LRU
+        # bookkeeping (pop + reinsert) must not race
+        self._cache_lock = threading.Lock()
 
     @classmethod
     def open(cls, seg_dir, col: str, is_bytes: bool = False
@@ -95,10 +100,16 @@ class ChunkedRawReader:
         return cls(fmt.open_dir(seg_dir).read_bytes(
             RAW_CHUNKS.format(col=col)), is_bytes)
 
+    MAX_CACHED_CHUNKS = 4
+
     def _chunk(self, ci: int):
-        hit = self._cache.get(ci)
-        if hit is not None:
-            return hit
+        with self._cache_lock:
+            hit = self._cache.get(ci)
+            if hit is not None:
+                # insertion order doubles as recency order: re-append
+                self._cache.pop(ci)
+                self._cache[ci] = hit
+                return hit
         a = self._data_start + int(self._chunk_offsets[ci])
         b = self._data_start + int(self._chunk_offsets[ci + 1])
         raw = self._data[a:b]
@@ -108,9 +119,13 @@ class ChunkedRawReader:
                    self.num_docs - ci * self.docs_per_chunk)
         offs = np.frombuffer(raw, dtype=np.uint32, count=n_in + 1)
         payload = raw[(n_in + 1) * 4:]
-        if len(self._cache) > 4:
-            self._cache.clear()
-        self._cache[ci] = (offs, payload)
+        with self._cache_lock:
+            while len(self._cache) >= self.MAX_CACHED_CHUNKS:
+                # evict ONE least-recently-used entry; clearing the whole
+                # cache made every decode_all over a >5-chunk column
+                # re-read (and re-inflate) all of its earlier chunks
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[ci] = (offs, payload)
         return offs, payload
 
     def value(self, doc: int):

@@ -7,13 +7,15 @@ execute → DataTable bytes).
 """
 from __future__ import annotations
 
+import asyncio
 import threading
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 from pinot_tpu.common.datatable import DataTable
 from pinot_tpu.common.metrics import (MetricsRegistry, ServerGauge,
                                       ServerMeter, ServerQueryPhase)
+from pinot_tpu.common.request import InstanceRequest
 from pinot_tpu.common.serde import instance_request_from_bytes
 from pinot_tpu.server.data_manager import InstanceDataManager
 from pinot_tpu.server.query_executor import InstanceQueryExecutor
@@ -32,9 +34,10 @@ class ServerInstance:
         self.data_manager = InstanceDataManager()
         self.scheduler: QueryScheduler = make_scheduler(scheduler,
                                                         num_workers)
-        self.executor = InstanceQueryExecutor(self.data_manager, mesh=mesh,
-                                              use_device=use_device,
-                                              metrics=self.metrics)
+        self.executor = InstanceQueryExecutor(
+            self.data_manager, mesh=mesh, use_device=use_device,
+            metrics=self.metrics,
+            segment_executor=self.scheduler.segment_pool)
         self.metrics.gauge(ServerGauge.SEGMENT_COUNT).set_callable(
             self.data_manager.num_segments)
         self._loop: Optional[EventLoopThread] = None
@@ -44,19 +47,27 @@ class ServerInstance:
         # an admin-triggered stop can race a late start on another thread
         self._lifecycle_lock = threading.Lock()
 
-    # -- in-process path (used by tests and the embedded broker) -----------
-    def handle_request_bytes(self, payload: bytes) -> bytes:
+    # -- request path ------------------------------------------------------
+    def _deserialize(self, payload: bytes
+                     ) -> Tuple[Optional[InstanceRequest], Optional[bytes]]:
+        """(request, None) on success, (None, error reply bytes) on a
+        malformed wire payload."""
         with self.metrics.timer(
                 ServerQueryPhase.REQUEST_DESERIALIZATION).time():
             try:
-                request = instance_request_from_bytes(payload)
+                return instance_request_from_bytes(payload), None
             except Exception as e:  # noqa: BLE001 — malformed wire payload
                 dt = DataTable()
                 dt.exceptions.append(f"RequestDeserializationError: {e}")
-                return dt.to_bytes()
-        # broker deadline propagation: fix the budget to an absolute
-        # instant NOW (deserialization time), so queue wait counts
-        # against it and expired work is dropped, not computed
+                return None, dt.to_bytes()
+
+    def _schedule(self, request: InstanceRequest):
+        """Submit to the scheduler; returns the result Future.
+
+        Broker deadline propagation: the budget is fixed to an absolute
+        instant NOW (deserialization time), so queue wait counts against
+        it and expired work is dropped, not computed.
+        """
         deadline = None
         budget_s = None
         if request.deadline_budget_ms is not None:
@@ -69,27 +80,63 @@ class ServerInstance:
             return self.executor.execute(request, scheduler_wait_ms=wait_ms,
                                          deadline=deadline)
 
-        future = self.scheduler.submit(request.query.table_name, run,
-                                       deadline_s=budget_s)
-        try:
-            dt = future.result()
-            with self.metrics.timer(
-                    ServerQueryPhase.RESPONSE_SERIALIZATION).time():
-                return dt.to_bytes()
-        except Exception as e:  # noqa: BLE001 — execution or serde error
-            self.metrics.meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS).mark()
-            dt = DataTable()
-            dt.metadata["requestId"] = str(request.request_id)
-            dt.exceptions.append(f"QueryExecutionError: {e}")
+        return self.scheduler.submit(request.query.table_name, run,
+                                     deadline_s=budget_s)
+
+    def _serialize(self, request: InstanceRequest, dt: DataTable) -> bytes:
+        with self.metrics.timer(
+                ServerQueryPhase.RESPONSE_SERIALIZATION).time():
             return dt.to_bytes()
+
+    def _error_reply(self, request: InstanceRequest, e: Exception) -> bytes:
+        self.metrics.meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS).mark()
+        dt = DataTable()
+        dt.metadata["requestId"] = str(request.request_id)
+        dt.exceptions.append(f"QueryExecutionError: {e}")
+        return dt.to_bytes()
+
+    # -- in-process path (used by tests and the embedded broker) -----------
+    def handle_request_bytes(self, payload: bytes) -> bytes:
+        request, err = self._deserialize(payload)
+        if err is not None:
+            return err
+        try:
+            dt = self._schedule(request).result()
+            return self._serialize(request, dt)
+        except Exception as e:  # noqa: BLE001 — execution or serde error
+            return self._error_reply(request, e)
+
+    # -- network path (one coroutine per in-flight frame) ------------------
+    async def handle_request_async(self, payload: bytes) -> bytes:
+        """The multiplexed QueryServer's handler: dispatches to the
+        scheduler and awaits the result WITHOUT pinning a thread per
+        in-flight request — only scheduler workers compute; serde runs
+        on the executor so the event loop keeps draining frames."""
+        loop = asyncio.get_running_loop()
+        request, err = self._deserialize(payload)
+        if err is not None:
+            return err
+        try:
+            dt = await asyncio.wrap_future(self._schedule(request))
+            if len(dt.rows) <= 128:
+                # small replies (aggregations, trimmed group-bys)
+                # serialize faster than an executor hop costs
+                return self._serialize(request, dt)
+            return await loop.run_in_executor(
+                None, self._serialize, request, dt)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — execution or serde error
+            return self._error_reply(request, e)
 
     # -- network service ---------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start the TCP query service; returns the bound port."""
         with self._lifecycle_lock:
             self._loop = EventLoopThread()
-            self._server = QueryServer(host, port,
-                                       self.handle_request_bytes)
+            self._server = QueryServer(
+                host, port, self.handle_request_bytes,
+                async_handler=self.handle_request_async)
             self._loop.run(self._server.start())
             self.port = self._server.port
             return self.port

@@ -28,9 +28,14 @@ class InstanceQueryExecutor:
     def __init__(self, data_manager: InstanceDataManager,
                  mesh=None, use_device: bool = True,
                  default_timeout_ms: float = 15_000.0,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 segment_executor=None):
         self.data_manager = data_manager
-        self.executor = ServerQueryExecutor(use_device=use_device)
+        # segment_executor: the scheduler's query-worker pool — per-
+        # segment plans fan out on it (CombineOperator parity); None
+        # keeps the sequential per-segment loop
+        self.executor = ServerQueryExecutor(
+            use_device=use_device, segment_executor=segment_executor)
         self.sharded = None
         if mesh is not None:
             from pinot_tpu.parallel.sharded import ShardedQueryExecutor
@@ -74,6 +79,12 @@ class InstanceQueryExecutor:
         acquired, missing = tdm.acquire_segments(request.search_segments)
         try:
             segments = [s.segment for s in acquired]
+            from pinot_tpu.query.plan import preprocess_request
+            # FASTHLL derived rewrite happens HERE, once, before the
+            # per-segment fan-out: this request instance is private to
+            # this server query (deserialized per dispatch), and the
+            # DataTable columns below must carry the rewritten names
+            query = preprocess_request(segments, query)
             block = self._execute_segments(query, segments, trace,
                                            deadline=deadline)
             if missing:

@@ -43,11 +43,24 @@ class QueryScheduler:
     propagation): schedulers that queue work drop entries whose budget
     expired before a worker picked them up — computing an answer nobody
     will read only steals tokens from live queries.
+
+    Two pools, reference parity: query RUNNERS (`_pool`, one thread per
+    admitted query — pqr threads) and query WORKERS (`segment_pool`,
+    the per-segment plan executor CombineOperator fans out on — pqw
+    threads). They must be distinct: a runner blocks on its segment
+    futures, so per-segment work scheduled back onto the runner pool
+    would deadlock once every runner waits on work none can start.
     """
 
-    def __init__(self, num_workers: int = 4):
-        self._pool = ThreadPoolExecutor(max_workers=num_workers)
+    def __init__(self, num_workers: int = 4,
+                 num_segment_workers: Optional[int] = None):
+        self._pool = ThreadPoolExecutor(max_workers=num_workers,
+                                        thread_name_prefix="query-runner")
         self.num_workers = num_workers
+        self.num_segment_workers = num_segment_workers or num_workers
+        self.segment_pool = ThreadPoolExecutor(
+            max_workers=self.num_segment_workers,
+            thread_name_prefix="query-worker")
 
     def submit(self, group: str, fn: Callable[[], object],
                deadline_s: Optional[float] = None) -> Future:
@@ -55,6 +68,7 @@ class QueryScheduler:
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
+        self.segment_pool.shutdown(wait=False)
 
 
 class FCFSQueryScheduler(QueryScheduler):

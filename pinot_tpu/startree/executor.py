@@ -18,6 +18,7 @@ answers in O(log groups + matched rows) host time instead of O(groups).
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -419,6 +420,24 @@ class StarTreeCubeLike:
 
 
 _UNION_LUT_CACHE: Dict = {}
+_UNION_LUT_LOCK = threading.Lock()
+
+
+def _segment_cache_identity(s, col: str):
+    """Stable identity for one (segment, column) cache axis.
+
+    id(s) is NOT stable: after a segment unload/reload the interpreter
+    can reuse the address for the replacement segment, silently serving
+    the OLD union LUT — wrong group-by values with no error. Name +
+    num_docs + crc + dictionary fingerprint (cardinality and boundary
+    values change whenever the value set changes) pin the entry to the
+    segment artifact's contents instead of its transient address."""
+    d = s.data_source(col).dictionary
+    n = len(d)
+    fingerprint = (n, str(d.values[0]), str(d.values[n - 1])) if n else (0,)
+    md = getattr(s, "metadata", None)
+    return (getattr(s, "segment_name", None), s.num_docs,
+            getattr(md, "crc", None), fingerprint)
 
 
 def _union_lut(segments, col: str):
@@ -427,8 +446,9 @@ def _union_lut(segments, col: str):
     Cached per (segment identity tuple, column): the union merge and its
     object-array compares run once per segment set, leaving only int
     gathers on the query hot path."""
-    key = (tuple(id(s) for s in segments), col)
-    hit = _UNION_LUT_CACHE.get(key)
+    key = (tuple(_segment_cache_identity(s, col) for s in segments), col)
+    with _UNION_LUT_LOCK:
+        hit = _UNION_LUT_CACHE.get(key)
     if hit is not None:
         return hit
     dicts = [np.asarray(s.data_source(col).dictionary.values)
@@ -436,9 +456,10 @@ def _union_lut(segments, col: str):
     union = np.unique(np.concatenate(dicts)) if dicts else \
         np.zeros(0, object)
     luts = [np.searchsorted(union, d).astype(np.int64) for d in dicts]
-    if len(_UNION_LUT_CACHE) > 256:
-        _UNION_LUT_CACHE.clear()
-    _UNION_LUT_CACHE[key] = (union, luts)
+    with _UNION_LUT_LOCK:
+        if len(_UNION_LUT_CACHE) > 256:
+            _UNION_LUT_CACHE.clear()
+        _UNION_LUT_CACHE[key] = (union, luts)
     return union, luts
 
 

@@ -64,16 +64,43 @@ def load_query_file(path: str) -> List[str]:
 
 def http_query_fn(broker: str, timeout: float = 30.0
                   ) -> Callable[[str], dict]:
-    """POST {"pql": ...} to http://<broker>/query (pinot-api transport)."""
-    import urllib.request
+    """POST {"pql": ...} to http://<broker>/query (pinot-api transport).
+
+    Keep-alive: each calling thread holds ONE persistent connection
+    (http.client, thread-local), the way real serving clients talk to a
+    broker — a fresh TCP handshake per query measures the OS, not the
+    serving plane. TCP_NODELAY is set, or Nagle + delayed-ACK turns the
+    two-write request (headers, then body) into 40ms stalls on a
+    persistent socket. Broken connections reconnect transparently."""
+    import http.client
+    import socket
+
+    host, _, port = broker.partition(":")
+    local = threading.local()
 
     def fn(pql: str) -> dict:
-        req = urllib.request.Request(
-            f"http://{broker}/query",
-            data=json.dumps({"pql": pql}).encode("utf-8"),
-            headers={"Content-Type": "application/json"}, method="POST")
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read())
+        body = json.dumps({"pql": pql})
+        conn = getattr(local, "conn", None)
+        for attempt in (0, 1):
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    host, int(port or 80), timeout=timeout)
+                conn.connect()
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                local.conn = conn
+            try:
+                conn.request("POST", "/query", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return json.loads(resp.read())
+            except (http.client.HTTPException, ConnectionError, OSError):
+                # stale keep-alive (broker restarted / idle-closed):
+                # retry ONCE on a fresh connection, then surface
+                conn.close()
+                local.conn = conn = None
+                if attempt:
+                    raise
     return fn
 
 

@@ -14,5 +14,10 @@ echo "== chaos (broker fault tolerance) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
     -q -p no:cacheprovider
 
+echo "== qps smoke (serving plane) =="
+# one short target-QPS rung over the real TCP mux: catches serving-plane
+# regressions (per-connection serialization, serde blow-ups) in seconds
+env JAX_PLATFORMS=cpu python scripts/qps_smoke.py
+
 echo "== tpulint =="
 exec "$(dirname "$0")/lint.sh"

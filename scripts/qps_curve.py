@@ -5,7 +5,20 @@ QueryRunner perf harness in increasingQPS mode.
 Parity: pinot-tools/.../perf/QueryRunner.java targetQPS/increasingQPS and
 contrib/pinot-druid-benchmark PinotThroughput — the reference's benchmark
 culture records p50/p99 vs offered QPS and the saturation knee, not just
-single-query latency. Writes QPS_r05.json at the repo root.
+single-query latency. Writes QPS_r06.json at the repo root (override the
+artifact name with QPS_ARTIFACT; QPS_r05.json is the pre-mux baseline).
+
+Two cluster shapes:
+
+- QPS_MULTIPROC=0 (default): the single-process EmbeddedCluster — on
+  small CPU hosts one interpreter beats four processes' XLA thread
+  pools fighting over the same cores, so this is the shape the
+  committed QPS_r*.json artifacts use (the JSON's "cluster" field
+  records which shape produced it).
+- QPS_MULTIPROC=1: controller, broker and each server run as their OWN
+  process via the admin CLI (StartController/StartServer/StartBroker
+  parity) — the reference's deployment shape; prefer it on real
+  multi-core hosts where per-plane interpreters actually parallelize.
 
 Runs on the CPU backend (the serving plane under test is broker routing +
 scatter/gather + scheduler + reduce; bench.py covers the chip plane), on
@@ -14,12 +27,14 @@ serving-path costs.
 """
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
+import urllib.request
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 # HARD override: the serving-plane benchmark must not pay the test
 # harness's TPU relay RTT (~90ms/dispatch) per query — that measures the
@@ -30,11 +45,94 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 ROWS = int(os.environ.get("QPS_ROWS", 2_000_000))
 SEGMENTS = int(os.environ.get("QPS_SEGMENTS", 4))
 STEP_S = float(os.environ.get("QPS_STEP_S", 3.0))
+# default: single process — on small CPU hosts the one-interpreter
+# embedded shape outperforms 4 processes × XLA thread pools fighting for
+# the same cores; set QPS_MULTIPROC=1 on real multi-core hosts for the
+# reference's one-process-per-plane deployment shape
+MULTIPROC = os.environ.get("QPS_MULTIPROC", "0") != "0"
+NUM_SERVERS = 2
+TABLE = "lineorder_OFFLINE"
+
+
+def _http(method, url, body=None, ctype="application/json", timeout=60):
+    req = urllib.request.Request(
+        url, data=body, method=method,
+        headers={"Content-Type": ctype} if body else {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class MultiprocCluster:
+    """controller + NUM_SERVERS servers + broker, one process each."""
+
+    def __init__(self, base: str, dirs, schema, table_config):
+        self._procs = []
+        env = dict(os.environ, PYTHONPATH=REPO)
+
+        def spawn(*cmd):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "pinot_tpu.tools.admin", *cmd],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                env=env, cwd=REPO, text=True)
+            self._procs.append(p)
+            line = p.stdout.readline().strip()
+            if not line:
+                raise RuntimeError(f"process {cmd[0]} died on boot")
+            return json.loads(line)
+
+        ctrl = spawn("StartController", "--dir", base, "--store-port", "0")
+        store = f"127.0.0.1:{ctrl['storePort']}"
+        deep = ctrl["deepStore"]
+        for i in range(NUM_SERVERS):
+            spawn("StartServer", "--store", store, "--deep-store", deep,
+                  "--instance-id", f"Server_{i}")
+        broker = spawn("StartBroker", "--store", store,
+                       "--deep-store", deep)
+        self.broker_port = broker["httpPort"]
+
+        capi = f"http://127.0.0.1:{ctrl['httpPort']}"
+        _http("POST", f"{capi}/schemas",
+              json.dumps(schema.to_json()).encode())
+        _http("POST", f"{capi}/tables",
+              json.dumps(table_config.to_json()).encode())
+        from pinot_tpu.controller.http_api import pack_segment_dir
+        for d in dirs:
+            _http("POST", f"{capi}/segments/{TABLE}", pack_segment_dir(d),
+                  ctype="application/octet-stream")
+
+    def await_ready(self, expected_rows: int, timeout_s: float = 60.0):
+        """Poll until the broker serves the FULL table (external view
+        converged on every server)."""
+        bapi = f"http://127.0.0.1:{self.broker_port}"
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                out = _http("POST", f"{bapi}/query", json.dumps(
+                    {"pql": "SELECT COUNT(*) FROM lineorder"}).encode(),
+                    timeout=10)
+                last = out
+                if not out.get("exceptions") and \
+                        out["aggregationResults"][0]["value"] == \
+                        str(expected_rows):
+                    return
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+            time.sleep(0.3)
+        raise RuntimeError(f"cluster not ready in {timeout_s}s: {last}")
+
+    def stop(self):
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def main() -> None:
     from bench import SSB_PQLS
-    from pinot_tpu.tools.cluster import EmbeddedCluster
     from pinot_tpu.tools.datagen import (build_ssb_segment_dirs,
                                          ssb_schema, ssb_table_config)
     from pinot_tpu.tools.perf import QueryRunner, http_query_fn
@@ -46,14 +144,37 @@ def main() -> None:
     dirs, _ids, _sc = build_ssb_segment_dirs(
         os.path.join(base, "segs"), ROWS, SEGMENTS, seed=7, star_tree=True)
 
-    cluster = EmbeddedCluster(os.path.join(base, "cluster"),
-                              num_servers=2, tcp=True, http=True)
-    try:
-        cluster.add_schema(ssb_schema())
-        cluster.add_table(ssb_table_config(star_tree=True))
-        for d in dirs:
-            cluster.upload_segment("lineorder_OFFLINE", d)
+    if MULTIPROC:
+        cluster = MultiprocCluster(os.path.join(base, "cluster"), dirs,
+                                   ssb_schema(),
+                                   ssb_table_config(star_tree=True))
+        shape = (f"controller + broker(http) + {NUM_SERVERS} servers "
+                 "over TCP, one process each")
+    else:
+        from pinot_tpu.tools.cluster import EmbeddedCluster
 
+        class _Embedded:
+            def __init__(self):
+                self.c = EmbeddedCluster(os.path.join(base, "cluster"),
+                                         num_servers=NUM_SERVERS,
+                                         tcp=True, http=True)
+                self.c.add_schema(ssb_schema())
+                self.c.add_table(ssb_table_config(star_tree=True))
+                for d in dirs:
+                    self.c.upload_segment(TABLE, d)
+                self.broker_port = self.c.broker_port
+
+            def await_ready(self, *_a, **_k):
+                pass
+
+            def stop(self):
+                self.c.stop()
+
+        cluster = _Embedded()
+        shape = (f"controller + broker(http) + {NUM_SERVERS} servers "
+                 "over TCP, single process")
+    try:
+        cluster.await_ready(ROWS)
         queries = list(SSB_PQLS.values())
         fn = http_query_fn(f"127.0.0.1:{cluster.broker_port}")
         runner = QueryRunner(fn, queries)
@@ -78,7 +199,7 @@ def main() -> None:
         out = {
             "artifact": "ssb13_throughput_curve",
             "rows": ROWS, "segments": SEGMENTS,
-            "cluster": "controller + broker(http) + 2 servers over TCP",
+            "cluster": shape,
             "backend": "cpu (serving-plane benchmark; chip plane is "
                        "bench.py)",
             "mode": "increasingQPS (QueryRunner.java parity)",
@@ -88,8 +209,8 @@ def main() -> None:
             "saturation_knee_qps": knee,
             "wall_s": round(time.time() - t0, 1),
         }
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "QPS_r05.json")
+        path = os.path.join(REPO,
+                            os.environ.get("QPS_ARTIFACT", "QPS_r06.json"))
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
         print(json.dumps({"artifact": path,

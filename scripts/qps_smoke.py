@@ -1,0 +1,69 @@
+"""QPS smoke rung for CI: the serving plane must sustain a modest
+target-QPS step over the real TCP data plane with zero errors.
+
+A regression canary, not a benchmark: it catches a reintroduced
+one-in-flight-per-connection bottleneck, a serde blow-up, or a
+scheduler deadlock in seconds. The honest throughput numbers come from
+scripts/qps_curve.py (QPS_r*.json artifacts); docs/PERFORMANCE.md
+explains how to read both.
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROWS = int(os.environ.get("QPS_SMOKE_ROWS", 4000))
+SEGMENTS = int(os.environ.get("QPS_SMOKE_SEGMENTS", 2))
+TARGET_QPS = float(os.environ.get("QPS_SMOKE_TARGET", 20.0))
+STEP_S = float(os.environ.get("QPS_SMOKE_STEP_S", 2.0))
+# generous floor: CI boxes are noisy; the pre-mux serving plane failed
+# this by an order of magnitude at equal per-query cost
+MIN_ACHIEVED_FRACTION = 0.5
+
+
+def main() -> int:
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.tools.datagen import (build_ssb_segment_dirs,
+                                         ssb_schema, ssb_table_config)
+    from pinot_tpu.tools.perf import QueryRunner
+
+    base = tempfile.mkdtemp()
+    dirs, _ids, _sc = build_ssb_segment_dirs(
+        os.path.join(base, "segs"), ROWS, SEGMENTS, seed=7)
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"),
+                              num_servers=2, tcp=True)
+    try:
+        cluster.add_schema(ssb_schema())
+        cluster.add_table(ssb_table_config())
+        for d in dirs:
+            cluster.upload_segment("lineorder_OFFLINE", d)
+        queries = ["SELECT COUNT(*) FROM lineorder",
+                   "SELECT SUM(lo_revenue) FROM lineorder "
+                   "WHERE lo_quantity < 25"]
+        runner = QueryRunner(cluster.query, queries)
+        runner.single_thread(num_times=2)      # warm plan/kernel caches
+        report = runner.target_qps(qps=TARGET_QPS, duration_s=STEP_S,
+                                   num_threads=8)
+        print(json.dumps(report.to_json(), indent=1))
+        ok = True
+        if report.num_errors:
+            print(f"FAIL: {report.num_errors} query errors", file=sys.stderr)
+            ok = False
+        if report.qps < MIN_ACHIEVED_FRACTION * TARGET_QPS:
+            print(f"FAIL: achieved {report.qps:.1f} QPS < "
+                  f"{MIN_ACHIEVED_FRACTION:.0%} of target {TARGET_QPS:g}",
+                  file=sys.stderr)
+            ok = False
+        print("qps smoke: " + ("OK" if ok else "FAILED"))
+        return 0 if ok else 1
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,453 @@
+"""Multiplexed data-plane tests.
+
+The serving-plane contract this file pins down (reference parity:
+ServerChannels.java requestId correlation + CombineOperator's parallel
+per-segment plans):
+
+- many requests share ONE broker→server connection and complete OUT OF
+  ORDER — a slow query never head-of-line-blocks a fast one,
+- a per-request timeout abandons only its own future; the connection and
+  every other in-flight request stay live (late replies are discarded by
+  correlation id, never misread as another query's reply),
+- ≥8 in-flight requests on one connection round-trip correctly, and the
+  fault-injection classes from common/faults.py still yield the
+  correct-or-flagged-partial contract over the real TCP mux,
+- the columnar (v2) DataTable wire format round-trips value-equal to the
+  row (v1) path, and old v1 payloads still decode.
+
+Determinism: ordering is driven by asyncio.Events, not sleeps.
+"""
+import asyncio
+import concurrent.futures
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from fixtures import build_segment
+from oracle import Oracle
+
+from pinot_tpu.broker import BrokerRequestHandler, RoutingManager
+from pinot_tpu.broker.request_handler import TcpTransport
+from pinot_tpu.broker.routing import RoutingTableBuilder
+from pinot_tpu.common.cluster_state import ONLINE, TableView
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.faults import (CORRUPT, DROP, LATENCY,
+                                     MISSING_SEGMENTS,
+                                     FaultInjectingTransport, FaultSpec)
+from pinot_tpu.query.blocks import IntermediateResultsBlock
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.server import ServerInstance
+from pinot_tpu.transport.tcp import QueryServer, ServerConnection
+
+TABLE = "baseballStats_OFFLINE"
+
+
+# ---------------------------------------------------------------------------
+# transport-level: one connection, many in-flight requests
+# ---------------------------------------------------------------------------
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_mux_out_of_order_completion_no_hol_blocking():
+    """A delayed query and a fast query issued on the SAME connection:
+    the fast one completes FIRST; the slow one finishes when released."""
+    async def main():
+        release = asyncio.Event()
+        started = asyncio.Event()
+
+        async def handler(payload: bytes) -> bytes:
+            if payload == b"slow":
+                started.set()
+                await release.wait()
+            return b"reply:" + payload
+
+        server = QueryServer("127.0.0.1", 0, handler=None,
+                             async_handler=handler)
+        await server.start()
+        conn = ServerConnection("127.0.0.1", server.port)
+        try:
+            slow = asyncio.ensure_future(conn.request(b"slow", timeout=30))
+            await started.wait()          # slow frame is being handled
+            fast = await conn.request(b"fast", timeout=30)
+            assert fast == b"reply:fast"
+            assert not slow.done()        # ...while slow is in flight
+            release.set()
+            assert await slow == b"reply:slow"
+        finally:
+            await conn.close()
+            await server.stop()
+
+    _run(main())
+
+
+def test_mux_timeout_cancels_only_its_own_request():
+    """A timed-out request abandons ONE future: the connection is not
+    torn down, other in-flight requests survive, and the late reply to
+    the dead request is discarded instead of desynchronizing the
+    stream."""
+    async def main():
+        release = asyncio.Event()
+
+        async def handler(payload: bytes) -> bytes:
+            if payload.startswith(b"wait"):
+                await release.wait()
+            return b"ok:" + payload
+
+        server = QueryServer("127.0.0.1", 0, handler=None,
+                             async_handler=handler)
+        await server.start()
+        conn = ServerConnection("127.0.0.1", server.port)
+        try:
+            doomed = asyncio.ensure_future(
+                conn.request(b"wait-doomed", timeout=0.2))
+            survivor = asyncio.ensure_future(
+                conn.request(b"wait-survivor", timeout=30))
+            with pytest.raises(asyncio.TimeoutError):
+                await doomed
+            writer_before = conn._writer
+            assert writer_before is not None       # connection kept
+            # a fresh request on the same (untouched) connection works
+            assert await conn.request(b"echo", timeout=30) == b"ok:echo"
+            assert conn._writer is writer_before   # no reconnect
+            # releasing produces the survivor's reply AND the doomed
+            # request's late reply — which must be dropped by corr id
+            release.set()
+            assert await survivor == b"ok:wait-survivor"
+            assert await conn.request(b"echo2", timeout=30) == b"ok:echo2"
+            assert conn._writer is writer_before
+            assert conn.num_pending == 0
+        finally:
+            await conn.close()
+            await server.stop()
+
+    _run(main())
+
+
+def test_mux_many_in_flight_round_trip():
+    """≥8 requests simultaneously in flight on ONE connection, each
+    correlated back to its own payload. The handler refuses to answer
+    until every request has ARRIVED, so completion proves true
+    multiplexing, not pipelined turn-taking."""
+    n = 12
+
+    async def main():
+        arrived = 0
+        barrier = asyncio.Event()
+
+        async def handler(payload: bytes) -> bytes:
+            nonlocal arrived
+            arrived += 1
+            if arrived >= n:
+                barrier.set()
+            await barrier.wait()
+            return b"echo:" + payload
+
+        server = QueryServer("127.0.0.1", 0, handler=None,
+                             async_handler=handler)
+        await server.start()
+        conn = ServerConnection("127.0.0.1", server.port)
+        try:
+            reqs = [asyncio.ensure_future(
+                conn.request(b"req-%d" % i, timeout=30)) for i in range(n)]
+            results = await asyncio.gather(*reqs)
+            assert results == [b"echo:req-%d" % i for i in range(n)]
+        finally:
+            await conn.close()
+            await server.stop()
+
+    _run(main())
+
+
+def test_mux_connection_loss_fails_all_pending():
+    """A transport-level failure (server gone mid-flight) fails every
+    pending request promptly so the broker can fail over — no hang."""
+    async def main():
+        gate = asyncio.Event()
+
+        async def handler(payload: bytes) -> bytes:
+            await gate.wait()
+            return payload
+
+        server = QueryServer("127.0.0.1", 0, handler=None,
+                             async_handler=handler)
+        await server.start()
+        conn = ServerConnection("127.0.0.1", server.port)
+        try:
+            reqs = [asyncio.ensure_future(conn.request(b"x%d" % i,
+                                                       timeout=30))
+                    for i in range(4)]
+            await asyncio.sleep(0)        # let the writes flush
+            while conn.num_pending < 4:
+                await asyncio.sleep(0.01)
+            await server.stop()           # hard-closes the channel
+            for r in reqs:
+                with pytest.raises((ConnectionError, OSError,
+                                    asyncio.IncompleteReadError)):
+                    await r
+            assert conn.num_pending == 0
+        finally:
+            await conn.close()
+            await server.stop()
+
+    _run(main())
+
+
+# ---------------------------------------------------------------------------
+# cluster-level: real TCP mux under fault injection
+# ---------------------------------------------------------------------------
+
+class _FixedRoutingBuilder(RoutingTableBuilder):
+    def __init__(self, table):
+        self.table = table
+
+    def build(self, view, rng):
+        return [{srv: list(segs) for srv, segs in self.table.items()}]
+
+
+@pytest.fixture(scope="module")
+def tcp_cluster():
+    """2 TCP servers, 2 segments, replication 2 (both segments on both
+    servers) — the QPS_r05 topology at test scale."""
+    base = tempfile.mkdtemp()
+    servers = {f"server_{i}": ServerInstance(f"server_{i}")
+               for i in range(2)}
+    view = TableView(TABLE, {})
+    all_cols = []
+    for i, name in enumerate(["seg_a", "seg_b"]):
+        seg, cols = build_segment(f"{base}/seg{i}", n=600, seed=70 + i,
+                                  name=name)
+        all_cols.append(cols)
+        for srv in servers.values():
+            srv.data_manager.table(TABLE, create=True).add_segment(seg)
+        view.segment_states[name] = {s: ONLINE for s in servers}
+    endpoints = {name: ("127.0.0.1", srv.start(port=0))
+                 for name, srv in servers.items()}
+    merged = {k: (np.concatenate([c[k] for c in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((c[k] for c in all_cols), []))
+              for k in all_cols[0]}
+    yield servers, endpoints, view, Oracle(merged)
+    for s in servers.values():
+        s.stop()
+
+
+def _tcp_handler(endpoints, view, routing_table, seed=0):
+    routing = RoutingManager(builder=_FixedRoutingBuilder(routing_table))
+    routing.update_view(view)
+    transport = FaultInjectingTransport(TcpTransport(endpoints), seed=seed)
+    handler = BrokerRequestHandler(routing, transport,
+                                   default_timeout_s=10.0)
+    return handler, transport
+
+
+def _correct_or_flagged(resp, oracle) -> bool:
+    full = resp.aggregation_results and \
+        resp.aggregation_results[0].value == \
+        str(oracle.count(oracle.mask(lambda r: True)))
+    flagged = resp.partial_response or bool(resp.exceptions)
+    return bool(full or flagged)
+
+
+def test_mux_tcp_concurrent_queries_under_fault_injection(tcp_cluster):
+    """≥8 concurrent queries through the real TCP mux while the fault
+    injector throws latency / drops / corrupt frames / missing segments:
+    every response is the correct full answer or an honestly flagged
+    partial — never a silent wrong answer, never a hang."""
+    servers, endpoints, view, oracle = tcp_cluster
+    handler, transport = _tcp_handler(
+        endpoints, view,
+        {"server_0": ["seg_a"], "server_1": ["seg_b"]}, seed=11)
+    transport.inject("server_0", FaultSpec(LATENCY, latency_s=0.02,
+                                           probability=0.5))
+    transport.inject("server_0", FaultSpec(DROP, times=2))
+    transport.inject("server_1", FaultSpec(CORRUPT, times=2))
+    transport.inject("server_1", FaultSpec(
+        MISSING_SEGMENTS, segments=("seg_b",), times=2))
+
+    n = 10
+    results = [None] * n
+
+    def one(i):
+        results[i] = handler.handle("SELECT COUNT(*) FROM baseballStats")
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        assert all(r is not None for r in results)
+        for resp in results:
+            assert _correct_or_flagged(resp, oracle), resp.to_json()
+        # the faults actually fired
+        assert transport.injected_count("server_0", DROP) == 2
+        assert transport.injected_count("server_1", CORRUPT) == 2
+    finally:
+        handler.close()
+
+
+def test_mux_tcp_shares_one_connection_per_server(tcp_cluster):
+    """Concurrent queries reuse the per-server channel (the mux point of
+    the whole exercise) instead of serializing on a connection lock."""
+    servers, endpoints, view, oracle = tcp_cluster
+    handler, transport = _tcp_handler(
+        endpoints, view,
+        {"server_0": ["seg_a", "seg_b"]}, seed=3)
+    try:
+        def one(_):
+            return handler.handle("SELECT COUNT(*) FROM baseballStats")
+
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            responses = list(pool.map(one, range(8)))
+        for resp in responses:
+            assert _correct_or_flagged(resp, oracle)
+        inner = transport.inner
+        assert len(inner._conns) == 1          # one channel, many queries
+    finally:
+        handler.close()
+
+
+# ---------------------------------------------------------------------------
+# parallel per-segment execution
+# ---------------------------------------------------------------------------
+
+def _build_engine_segments(n_segments=4, rows=400):
+    base = tempfile.mkdtemp()
+    segs, all_cols = [], []
+    for i in range(n_segments):
+        seg, cols = build_segment(f"{base}/s{i}", n=rows, seed=90 + i,
+                                  name=f"ps_{i}")
+        segs.append(seg)
+        all_cols.append(cols)
+    merged = {k: (np.concatenate([c[k] for c in all_cols])
+                  if isinstance(all_cols[0][k], np.ndarray)
+                  else sum((c[k] for c in all_cols), []))
+              for k in all_cols[0]}
+    return segs, Oracle(merged)
+
+
+def test_parallel_segment_execution_matches_sequential():
+    from pinot_tpu.query.executor import ServerQueryExecutor
+
+    segs, oracle = _build_engine_segments()
+    pool = concurrent.futures.ThreadPoolExecutor(4)
+    try:
+        seq = ServerQueryExecutor(use_device=False)
+        par = ServerQueryExecutor(use_device=False, segment_executor=pool)
+        for pql in (
+                "SELECT COUNT(*), SUM(runs) FROM baseballStats "
+                "WHERE yearID >= 2000",
+                "SELECT SUM(hits) FROM baseballStats GROUP BY teamID "
+                "TOP 500",
+                "SELECT playerName, runs FROM baseballStats ORDER BY "
+                "runs DESC LIMIT 13"):
+            request = compile_pql(pql)
+            b_seq = seq.execute(request, segs)
+            b_par = par.execute(request, segs)
+            assert b_par.exceptions == b_seq.exceptions == []
+            assert b_par.stats.num_segments_processed == \
+                b_seq.stats.num_segments_processed
+            if b_seq.group_map is not None:
+                assert b_par.group_map == b_seq.group_map
+            elif b_seq.agg_intermediates is not None:
+                assert b_par.agg_intermediates == b_seq.agg_intermediates
+            if b_seq.selection_rows is not None:
+                assert sorted(b_par.selection_rows) == \
+                    sorted(b_seq.selection_rows)
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_parallel_segment_execution_deadline_truncates():
+    import time as _time
+    from pinot_tpu.query.executor import ServerQueryExecutor
+
+    segs, _ = _build_engine_segments()
+    pool = concurrent.futures.ThreadPoolExecutor(4)
+    try:
+        par = ServerQueryExecutor(use_device=False, segment_executor=pool)
+        request = compile_pql("SELECT COUNT(*) FROM baseballStats")
+        blk = par.execute(request, segs,
+                          deadline=_time.monotonic() - 0.001)
+        assert any("DeadlineExceededError" in e for e in blk.exceptions)
+        assert blk.stats.num_segments_processed < len(segs)
+    finally:
+        pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# DataTable wire-format compatibility
+# ---------------------------------------------------------------------------
+
+def _sample_tables():
+    group_by = DataTable(
+        kind=2, columns=["d1", "d2", "sum(m)", "avg(m)", "fasthll(x)"],
+        num_group_cols=2,
+        rows=[("x", 1, 10.0, (10.0, 2), None),
+              ("y", 2, 5.5, (5.5, 1), True),
+              ("z", -3, float("inf"), (0.0, 0), 2 ** 90)],
+        metadata={"numDocsScanned": "3", "totalDocs": "10"},
+        exceptions=["boom"])
+    selection = DataTable(
+        kind=3, columns=["name", "year", "score"],
+        rows=[(f"p{i}", 1990 + i, i * 1.5) for i in range(64)],
+        metadata={"selectionDisplayCols": "2"})
+    aggregation = DataTable(
+        kind=1, columns=["count(*)"], rows=[(123,)],
+        metadata={"numDocsScanned": "123"})
+    empty = DataTable()
+    return [group_by, selection, aggregation, empty]
+
+
+def test_datatable_v1_payloads_still_decode():
+    """Old-version payloads (a version-skewed server mid-rollout) decode
+    bit-for-bit equal to what the v1 reader produced."""
+    for dt in _sample_tables():
+        legacy = dt.to_bytes(version=1)
+        rt = DataTable.from_bytes(legacy)
+        assert rt.rows == dt.rows
+        assert rt.columns == dt.columns
+        assert rt.metadata == dt.metadata
+        assert rt.exceptions == dt.exceptions
+        assert rt.num_group_cols == dt.num_group_cols
+
+
+def test_datatable_columnar_roundtrip_value_equal_to_row_path():
+    """The v2 columnar encoding decodes value-equal to the v1 row path
+    for every payload kind, including blocks rebuilt via to_block."""
+    for dt in _sample_tables():
+        via_v1 = DataTable.from_bytes(dt.to_bytes(version=1))
+        via_v2 = DataTable.from_bytes(dt.to_bytes())
+        assert via_v2.rows == via_v1.rows
+        assert via_v2.columns == via_v1.columns
+        assert via_v2.metadata == via_v1.metadata
+        assert via_v2.exceptions == via_v1.exceptions
+        b1, b2 = via_v1.to_block(), via_v2.to_block()
+        assert b1.group_map == b2.group_map
+        assert b1.agg_intermediates == b2.agg_intermediates
+        assert b1.selection_rows == b2.selection_rows
+
+
+def test_datatable_columnar_preserves_python_types():
+    dt = DataTable(kind=3, columns=["i", "f", "s", "o"],
+                   rows=[(np.int64(7), np.float64(2.5), "a", True),
+                         (8, 3.5, "b", False)])
+    rt = DataTable.from_bytes(dt.to_bytes())
+    assert rt.rows == [(7, 2.5, "a", True), (8, 3.5, "b", False)]
+    assert type(rt.rows[0][0]) is int
+    assert type(rt.rows[0][1]) is float
+    assert type(rt.rows[0][3]) is bool
+
+
+def test_datatable_from_block_to_block_roundtrip():
+    request = compile_pql(
+        "SELECT SUM(m) FROM t GROUP BY d1, d2 TOP 10")
+    blk = IntermediateResultsBlock()
+    blk.group_map = {("a", 1): [2.0], ("b", 2): [3.0]}
+    dt = DataTable.from_block(request, blk)
+    rt = DataTable.from_bytes(dt.to_bytes())
+    assert rt.to_block().group_map == blk.group_map
