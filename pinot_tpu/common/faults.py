@@ -40,6 +40,65 @@ from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
 from pinot_tpu.common.serde import (instance_request_from_bytes,
                                     instance_request_to_bytes)
 
+class InjectedCrash(RuntimeError):
+    """Raised at an armed crash point: simulates the process dying at
+    exactly this instruction. Crash-recovery tests arm a point, drive
+    the component until the crash fires, abandon the component (its
+    in-memory state is 'lost'), and restart a fresh one over the same
+    durable state — the WAL/snapshot/deep-store files written up to the
+    crash instant."""
+
+
+class CrashPoints:
+    """Seeded, deterministic crash-point registry.
+
+    Production code calls ``crash_points.hit("name")`` at instrumented
+    instructions (WAL append, commit metadata flip, artifact download).
+    Unarmed points are free; an armed point raises InjectedCrash on its
+    Nth hit (``skip`` earlier hits pass through), then disarms — a
+    restarted component runs past the same point cleanly, exactly like
+    a real crash-once scenario.
+    """
+
+    def __init__(self):
+        self._armed: Dict[str, int] = {}          # name -> remaining skips
+        self.fired: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def arm(self, name: str, skip: int = 0) -> None:
+        """Fire on the (skip+1)-th hit of `name`."""
+        with self._lock:
+            self._armed[name] = skip
+
+    def clear(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(name, None)
+
+    def consume(self, name: str) -> bool:
+        """True exactly when the armed point fires (and disarms it)."""
+        with self._lock:
+            skips = self._armed.get(name)
+            if skips is None:
+                return False
+            if skips > 0:
+                self._armed[name] = skips - 1
+                return False
+            del self._armed[name]
+            self.fired[name] = self.fired.get(name, 0) + 1
+            return True
+
+    def hit(self, name: str) -> None:
+        if self.consume(name):
+            raise InjectedCrash(name)
+
+
+#: process-wide registry — components hit it, tests arm/clear it
+crash_points = CrashPoints()
+
+
 LATENCY = "latency"
 HANG = "hang"
 DROP = "drop"

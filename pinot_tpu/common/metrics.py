@@ -199,6 +199,17 @@ class ServerMeter:
     # queries dropped (or truncated) because the broker-propagated
     # deadline had already expired — work nobody would read
     DEADLINE_EXPIRED_QUERIES = "deadlineExpiredQueries"
+    # segment integrity / cold-start recovery
+    SEGMENT_DOWNLOADS = "segmentDownloads"
+    SEGMENT_LOCAL_RELOADS = "segmentLocalReloads"
+    SEGMENT_CRC_MISMATCHES = "segmentCrcMismatches"
+
+
+class ControllerMeter:
+    # integrity scrubber (SegmentIntegrityChecker)
+    CORRUPT_SEGMENTS = "corruptSegmentArtifacts"
+    ORPHAN_ARTIFACTS_DELETED = "orphanArtifactsDeleted"
+    ERROR_REPLICAS_REPAIRED = "errorReplicasRepaired"
 
 
 class ServerQueryPhase:

@@ -6,6 +6,7 @@ from pinot_tpu.controller.controller import Controller
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.periodic import (PeriodicTaskScheduler,
                                            RetentionManager,
+                                           SegmentIntegrityChecker,
                                            SegmentStatusChecker)
 from pinot_tpu.controller.property_store import PropertyStore
 from pinot_tpu.controller.state_machine import (ClusterCoordinator,
@@ -14,5 +15,5 @@ from pinot_tpu.controller.state_machine import (ClusterCoordinator,
 __all__ = ["BalancedNumSegmentAssignment", "RandomSegmentAssignment",
            "ReplicaGroupSegmentAssignment", "make_assignment", "Controller",
            "ResourceManager", "PeriodicTaskScheduler", "RetentionManager",
-           "SegmentStatusChecker", "PropertyStore", "ClusterCoordinator",
-           "StateModel"]
+           "SegmentStatusChecker", "SegmentIntegrityChecker",
+           "PropertyStore", "ClusterCoordinator", "StateModel"]

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from pinot_tpu.common.metrics import MetricsRegistry
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.periodic import (PeriodicTask,
                                            PeriodicTaskScheduler,
@@ -24,17 +25,25 @@ class Controller:
     def __init__(self, deep_store_dir: str,
                  store: Optional[PropertyStore] = None,
                  periodic_tasks: Optional[List[PeriodicTask]] = None,
-                 instance_id: str = "Controller_0"):
-        self.store = store or PropertyStore()
+                 instance_id: str = "Controller_0",
+                 store_dir: Optional[str] = None):
+        """`store_dir`: when the controller constructs its own store,
+        persist cluster state (WAL + snapshots) under this directory so
+        a restarted controller recovers tables, ideal states, segment
+        records and the realtime FSM's durable inputs."""
+        self._owns_store = store is None
+        self.store = store or PropertyStore(data_dir=store_dir)
         self.coordinator = ClusterCoordinator(self.store)
         self.manager = ResourceManager(self.coordinator, deep_store_dir)
         self.realtime = RealtimeSegmentManager(self.manager)
+        self.metrics = MetricsRegistry("controller")
         # lead-controller gating for the periodic plane (parity:
         # ControllerLeadershipManager + ControllerPeriodicTask)
         self.leadership = ControllerLeadershipManager(self.store,
                                                       instance_id)
         self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks,
-                                              leadership=self.leadership)
+                                              leadership=self.leadership,
+                                              metrics=self.metrics)
         if periodic_tasks is None:
             # scheduler owns the defaults; the controller only appends the
             # realtime validation task (it needs the realtime manager)
@@ -47,3 +56,5 @@ class Controller:
     def stop(self) -> None:
         self.periodic.stop()
         self.manager.close()
+        if self._owns_store:
+            self.store.close()

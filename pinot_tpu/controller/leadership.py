@@ -33,30 +33,36 @@ class ControllerLeadershipManager:
     # -- election ----------------------------------------------------------
 
     def try_acquire(self) -> bool:
-        """Claim (or refresh) the lease; returns leadership state."""
-        now = self._clock()
-        cur = self.store.get(LEADER_PATH) or {}
-        if cur.get("instance") not in (None, self.instance_id) and \
-                cur.get("leaseUntil", 0) >= now:
-            # someone else holds an unexpired lease: no write, no
-            # spurious watcher churn from heartbeat polls
-            self._notify(False)
-            return False
-        out = {}
+        """Claim (or refresh) the lease; returns leadership state.
 
-        def claim(rec):
-            rec = dict(rec or {})
-            holder = rec.get("instance")
-            expired = rec.get("leaseUntil", 0) < now
-            if holder in (None, self.instance_id) or expired:
-                rec["instance"] = self.instance_id
-                rec["leaseUntil"] = now + self.lease_s
-            out["leader"] = rec.get("instance") == self.instance_id
-            return rec
-
-        self.store.update(LEADER_PATH, claim)
-        self._notify(out["leader"])
-        return out["leader"]
+        The expired-lease takeover is a single compare-and-set against
+        the exact record we read: two controllers racing the same
+        expired lease can both pass the read check, but only one CAS
+        applies — the loser observes the failure instead of blindly
+        overwriting the winner's claim (a remote store's update() loop
+        would have let both believe they won)."""
+        for _ in range(2):
+            now = self._clock()
+            cur = self.store.get(LEADER_PATH)
+            holder = (cur or {}).get("instance")
+            expired = (cur or {}).get("leaseUntil", 0) < now
+            if holder not in (None, self.instance_id) and not expired:
+                # someone else holds an unexpired lease: no write, no
+                # spurious watcher churn from heartbeat polls
+                self._notify(False)
+                return False
+            rec = dict(cur or {})
+            rec["instance"] = self.instance_id
+            rec["leaseUntil"] = now + self.lease_s
+            if self.store.cas(LEADER_PATH, cur, rec):
+                self._notify(True)
+                return True
+            # CAS lost: someone moved the record under us — one re-read
+            # settles whether the winner was us (our own refresh racing)
+            # or a peer
+        leader = self.is_leader()
+        self._notify(leader)
+        return leader
 
     def is_leader(self) -> bool:
         rec = self.store.get(LEADER_PATH) or {}

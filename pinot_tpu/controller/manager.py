@@ -13,6 +13,7 @@ Store layout (beyond state_machine.py's):
 """
 from __future__ import annotations
 
+import glob
 import os
 import time
 from typing import Dict, List, Optional
@@ -69,6 +70,13 @@ class ResourceManager:
         self.fs.mkdir(deep_store_dir)
         self._assignments: Dict[str, SegmentAssignmentStrategy] = {}
         self._quota_checker = StorageQuotaChecker()
+        # when set (e.g. "http://controller:9000"), segment records
+        # advertise downloadPath through the controller's /deepstore
+        # endpoints instead of the raw filesystem path — the deployment
+        # shape where servers have no shared filesystem and download
+        # committed artifacts over HTTP (parity: the reference's
+        # controller VIP download URLs in SegmentZKMetadata)
+        self.download_base: Optional[str] = None
         self.tenants = TenantManager(self.store)
         # broker membership follows live-instance records (registration,
         # death, tag changes) — the OWNING manager watches them so
@@ -193,6 +201,15 @@ class ResourceManager:
             raise ValueError(f"table {table} does not exist")
         meta = metadata or SegmentMetadata.load(segment_dir)
         name = meta.segment_name
+        # integrity admission: externally built artifacts without a crc
+        # are stamped now; stamped ones are verified before the deep
+        # store accepts them (parity: ZKOperator checking the upload crc)
+        from pinot_tpu.segment.integrity import stamp_crc, verify_segment
+        if isinstance(segment_dir, str) and os.path.isdir(segment_dir):
+            if meta.crc is None:
+                meta.crc = stamp_crc(segment_dir)
+            else:
+                verify_segment(segment_dir, meta.crc)
         # storage quota admission (parity: StorageQuotaChecker invoked
         # from the upload resource before the segment is accepted)
         size_bytes = dir_size_bytes(segment_dir)
@@ -205,6 +222,16 @@ class ResourceManager:
         if os.path.abspath(segment_dir) != os.path.abspath(dest):
             self.fs.delete(dest)
             self.fs.copy(segment_dir, dest)
+            if meta.crc is not None and isinstance(self.fs, LocalPinotFS):
+                # a torn deep-store copy must never become the durable
+                # artifact servers download
+                from pinot_tpu.segment.integrity import (
+                    SegmentIntegrityError, verify_segment as _verify)
+                try:
+                    _verify(dest, meta.crc)
+                except SegmentIntegrityError:
+                    self.fs.delete(dest)
+                    raise
         # per-column partition metadata rides the segment ZK record so the
         # broker can prune before scatter (parity: the partition info in
         # SegmentZKMetadata consumed by PartitionZKMetadataPruner)
@@ -216,7 +243,7 @@ class ResourceManager:
             if cm.partition_function and cm.partitions}
         self.store.set(f"{SEGMENTS}/{table}/{name}", {
             "segmentName": name,
-            "downloadPath": dest,
+            "downloadPath": self.advertised_download_path(table, name),
             "startTime": meta.start_time,
             "endTime": meta.end_time,
             "timeUnit": meta.time_unit,
@@ -262,6 +289,36 @@ class ResourceManager:
         self.coordinator.update_ideal_state(table, add)
         return name
 
+    def advertised_download_path(self, table: str, segment: str) -> str:
+        """The downloadPath servers should fetch: the controller's
+        /deepstore HTTP endpoint when `download_base` is set, the raw
+        deep-store path otherwise (shared-filesystem deployments)."""
+        if self.download_base:
+            return (f"{self.download_base.rstrip('/')}/deepstore/"
+                    f"{table}/{segment}")
+        return os.path.join(self.deep_store_dir, table, segment)
+
+    def canonical_artifact_path(self, table: str, segment: str) -> str:
+        """The artifact's location inside THIS controller's deep store
+        (what an advertised HTTP downloadPath resolves to)."""
+        return os.path.join(self.deep_store_dir, table, segment)
+
+    def resolve_download_path(self, path: str) -> str:
+        """Re-base an HTTP deep-store URL onto the endpoint the CURRENT
+        controller publishes (/CONTROLLER/DEEPSTORE_BASE): segment
+        records are durable, but a restarted controller may come back
+        on a different port — a stamped absolute URL would point at the
+        dead process forever. Shared by every artifact consumer
+        (server participant, minion workers)."""
+        if "://" not in path or "/deepstore/" not in path:
+            return path
+        rec = self.store.get("/CONTROLLER/DEEPSTORE_BASE") or {}
+        base = rec.get("base")
+        if not base:
+            return path
+        rel = path.split("/deepstore/", 1)[1]
+        return f"{base.rstrip('/')}/deepstore/{rel}"
+
     def segment_names(self, table: str) -> List[str]:
         return self.store.children(f"{SEGMENTS}/{table}")
 
@@ -270,7 +327,10 @@ class ResourceManager:
 
     def delete_segment(self, table: str, segment: str) -> None:
         """Parity: SegmentDeletionManager — drop from ideal state, remove
-        metadata, delete the deep-store artifact."""
+        metadata, delete the deep-store artifact (the recorded
+        downloadPath AND the canonical location, plus any stale
+        split-commit staging copies — retention must not leak bytes)."""
+        meta = self.segment_metadata(table, segment) or {}
 
         def drop(segments):
             if segment in segments:
@@ -286,7 +346,14 @@ class ResourceManager:
 
         self.coordinator.update_ideal_state(table, purge)
         self.store.remove(f"{SEGMENTS}/{table}/{segment}")
-        self.fs.delete(os.path.join(self.deep_store_dir, table, segment))
+        canonical = os.path.join(self.deep_store_dir, table, segment)
+        self.fs.delete(canonical)
+        download = meta.get("downloadPath")
+        if download and "://" not in download and \
+                os.path.abspath(download) != os.path.abspath(canonical):
+            self.fs.delete(download)
+        for stale in glob.glob(canonical + ".staging.*"):
+            self.fs.delete(stale)
 
     def reload_segment(self, table: str, segment: str,
                        converge_timeout_s: float = 30.0) -> None:

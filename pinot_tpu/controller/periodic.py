@@ -59,6 +59,188 @@ class RetentionManager(PeriodicTask):
                     manager.delete_segment(table, seg)
 
 
+class SegmentIntegrityChecker(PeriodicTask):
+    """Deep-store scrubber + replica repair.
+
+    Three sweeps per run (parity: the reference's periodic controller
+    validation tasks, extended with the CRC story of SURVEY §5.4 —
+    "segments themselves are the durable artifacts in deep store"):
+
+    1. **Artifact integrity**: every committed segment's deep-store
+       artifact is CRC-verified against the durable record; a corrupt
+       artifact is moved to ``<deep_store>/quarantine/`` (never served,
+       kept for forensics) and surfaced via metrics/report. Serving
+       replicas hold verified copies and keep serving; the quarantined
+       record is reported for operator re-upload.
+    2. **ERROR-replica repair**: replicas the external view shows in
+       ERROR while the ideal state wants them serving are bounced
+       through OFFLINE (→ re-download from the deep store); a replica
+       that keeps failing is re-assigned to a healthy live instance.
+    3. **Orphan sweep**: deep-store entries with no property-store
+       record (upload/commit crash leftovers, leaked retention deletes)
+       are removed — completing RetentionManager's storage story.
+    """
+
+    name = "SegmentIntegrityChecker"
+    interval_s = 1800.0
+    QUARANTINE_DIR = "quarantine"
+    #: OFFLINE→ONLINE bounces per replica before giving up and moving
+    #: the replica to a different healthy instance
+    MAX_BOUNCES = 2
+    #: an unrecorded deep-store entry younger than this is an in-flight
+    #: upload (copy lands before the record is written), not an orphan
+    ORPHAN_GRACE_S = 300.0
+
+    def __init__(self, metrics=None, now_fn=None):
+        self.metrics = metrics
+        self._now = now_fn or time.time
+        self.last_report: Dict[str, Dict] = {}
+        self._bounce_counts: Dict[tuple, int] = {}
+
+    def _mark(self, name: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.meter(name).mark(n)
+
+    def run(self, manager: ResourceManager) -> None:
+        import os
+
+        from pinot_tpu.common.metrics import ControllerMeter
+        from pinot_tpu.segment.integrity import (SegmentIntegrityError,
+                                                 quarantine_segment,
+                                                 verify_segment)
+        report: Dict[str, Dict] = {}
+        quarantine_root = os.path.join(manager.deep_store_dir,
+                                       self.QUARANTINE_DIR)
+        for table in manager.table_names():
+            entry = {"corrupt": [], "missingArtifact": [], "repaired": [],
+                     "reassigned": [], "orphansDeleted": []}
+            # segments no replica bounce can heal (artifact quarantined
+            # this run, or already gone from an earlier one): repair
+            # would churn the ideal state forever against nothing
+            unrepairable = set()
+            known = set()
+            for seg in manager.segment_names(table):
+                known.add(seg)
+                meta = manager.segment_metadata(table, seg) or {}
+                path, crc = meta.get("downloadPath"), meta.get("crc")
+                if path and "://" in path:
+                    # HTTP-advertised paths resolve inside OUR deep store
+                    path = manager.canonical_artifact_path(table, seg)
+                if not path:
+                    continue        # consuming: no artifact yet
+                if not os.path.isdir(path):
+                    unrepairable.add(seg)
+                    entry["missingArtifact"].append(seg)
+                    continue
+                try:
+                    verify_segment(path, crc)
+                except SegmentIntegrityError:
+                    quarantine_segment(path, quarantine_root)
+                    entry["corrupt"].append(seg)
+                    unrepairable.add(seg)
+                    self._mark(ControllerMeter.CORRUPT_SEGMENTS)
+                    log.error("integrity: quarantined corrupt deep-store "
+                              "artifact %s/%s", table, seg)
+            self._repair_error_replicas(manager, table, entry,
+                                        skip=unrepairable)
+            self._sweep_orphans(manager, table, known, entry)
+            if any(entry.values()):
+                report[table] = entry
+        self.last_report = report
+
+    # -- repair -------------------------------------------------------------
+    def _repair_error_replicas(self, manager: ResourceManager, table: str,
+                               entry: Dict, skip=()) -> None:
+        """`skip`: segments whose deep-store artifact was just
+        quarantined — bouncing/reassigning their replicas cannot heal
+        anything (every load would fail against the missing artifact)
+        and would only churn the ideal state."""
+        from pinot_tpu.common.cluster_state import ERROR, OFFLINE, ONLINE
+        from pinot_tpu.common.metrics import ControllerMeter
+        ideal = manager.coordinator.ideal_state(table)
+        view = manager.coordinator.external_view(table).segment_states
+        live = set(manager.coordinator.live_instances())
+        for seg, wanted in ideal.items():
+            if seg in skip:
+                continue
+            for inst, target in sorted(wanted.items()):
+                if target != ONLINE or \
+                        view.get(seg, {}).get(inst) != ERROR:
+                    continue
+                key = (table, seg, inst)
+                bounces = self._bounce_counts.get(key, 0)
+                healthy = sorted(live - set(wanted))
+                if bounces >= self.MAX_BOUNCES and healthy:
+                    # persistent failure on this instance: move the
+                    # replica to a healthy live server
+                    new_inst = healthy[0]
+
+                    def reassign(segments, seg=seg, inst=inst,
+                                 new_inst=new_inst):
+                        states = dict(segments.get(seg, {}))
+                        states.pop(inst, None)
+                        states[new_inst] = ONLINE
+                        segments[seg] = states
+                        return segments
+
+                    manager.coordinator.update_ideal_state(table, reassign)
+                    self._bounce_counts.pop(key, None)
+                    entry["reassigned"].append(f"{seg}:{inst}->{new_inst}")
+                    self._mark(ControllerMeter.ERROR_REPLICAS_REPAIRED)
+                    log.warning("integrity: reassigned %s/%s %s -> %s",
+                                table, seg, inst, new_inst)
+                    continue
+
+                # bounce through OFFLINE so the load path re-runs (a
+                # re-download repairs a quarantined/corrupt local copy)
+                def offline(segments, seg=seg, inst=inst):
+                    states = dict(segments.get(seg, {}))
+                    if states.get(inst) == ONLINE:
+                        states[inst] = OFFLINE
+                        segments[seg] = states
+                    return segments
+
+                def online(segments, seg=seg, inst=inst):
+                    states = dict(segments.get(seg, {}))
+                    if states.get(inst) == OFFLINE:
+                        states[inst] = ONLINE
+                        segments[seg] = states
+                    return segments
+
+                manager.coordinator.update_ideal_state(table, offline)
+                manager.coordinator.update_ideal_state(table, online)
+                self._bounce_counts[key] = bounces + 1
+                entry["repaired"].append(f"{seg}:{inst}")
+                self._mark(ControllerMeter.ERROR_REPLICAS_REPAIRED)
+
+    # -- orphan sweep -------------------------------------------------------
+    def _sweep_orphans(self, manager: ResourceManager, table: str,
+                       known: set, entry: Dict) -> None:
+        import os
+
+        from pinot_tpu.common.metrics import ControllerMeter
+        tdir = os.path.join(manager.deep_store_dir, table)
+        if not os.path.isdir(tdir):
+            return
+        for name in sorted(os.listdir(tdir)):
+            if name in known:
+                continue
+            if ".staging." in name:
+                continue        # in-flight split-commit staging copy
+            path = os.path.join(tdir, name)
+            try:
+                age = self._now() - os.path.getmtime(path)
+            except OSError:
+                continue        # vanished under us
+            if age < self.ORPHAN_GRACE_S:
+                continue        # in-flight upload: copy precedes record
+            manager.fs.delete(path)
+            entry["orphansDeleted"].append(name)
+            self._mark(ControllerMeter.ORPHAN_ARTIFACTS_DELETED)
+            log.info("integrity: deleted orphan deep-store artifact "
+                     "%s/%s", table, name)
+
+
 class SegmentStatusChecker(PeriodicTask):
     """Reports replica health per table (parity: SegmentStatusChecker /
     OfflineSegmentIntervalChecker metrics). Returns its findings so
@@ -106,10 +288,11 @@ class RealtimeSegmentValidationManager(PeriodicTask):
 class PeriodicTaskScheduler:
     def __init__(self, manager: ResourceManager,
                  tasks: Optional[List[PeriodicTask]] = None,
-                 leadership=None):
+                 leadership=None, metrics=None):
         self.manager = manager
         self.tasks = tasks if tasks is not None else [
-            RetentionManager(), SegmentStatusChecker()]
+            RetentionManager(), SegmentStatusChecker(),
+            SegmentIntegrityChecker(metrics=metrics)]
         # parity: ControllerPeriodicTask lead-controller gating — with
         # multiple controllers, only the lease holder runs the tasks
         self.leadership = leadership

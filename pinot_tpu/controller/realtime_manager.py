@@ -21,6 +21,7 @@ import time
 from typing import Dict, List, Optional
 
 from pinot_tpu.common import completion as proto
+from pinot_tpu.common.faults import crash_points
 from pinot_tpu.common.cluster_state import CONSUMING, OFFLINE, ONLINE
 from pinot_tpu.common.completion import CompletionResponse
 from pinot_tpu.common.table_config import TableConfig, TableType
@@ -363,6 +364,16 @@ class RealtimeSegmentManager:
             stage = f"{dest}.staging.{instance}"
             self.manager.fs.delete(stage)
             self.manager.fs.copy(segment_dir, stage)
+            if built.crc is not None:
+                # a torn deep-store copy must never become the committed
+                # artifact (verified before the swap, outside the lock)
+                from pinot_tpu.segment.integrity import (
+                    SegmentIntegrityError, verify_segment)
+                try:
+                    verify_segment(stage, built.crc)
+                except SegmentIntegrityError:
+                    self.manager.fs.delete(stage)
+                    return CompletionResponse(proto.FAILED)
             with self._lock:
                 fsm = self._fsm.get(segment)
                 if fsm is None or fsm.winner != instance or \
@@ -382,12 +393,18 @@ class RealtimeSegmentManager:
                         offset != fsm.target:
                     return CompletionResponse(proto.FAILED)
 
+        # seeded crash point: controller dies after the artifact landed in
+        # the deep store but BEFORE the metadata flips DONE — the segment
+        # stays IN_PROGRESS, replicas re-elect and re-commit after restart
+        crash_points.hit("controller.commit_pre_done")
+
         def finish(old: Optional[dict]) -> dict:
             rec = dict(old or {})
             rec.update({
                 "status": DONE,
                 "endOffset": int(offset),
-                "downloadPath": dest,
+                "downloadPath": self.manager.advertised_download_path(
+                    table, segment),
                 "startTime": built.start_time,
                 "endTime": built.end_time,
                 "timeUnit": built.time_unit,
@@ -398,6 +415,11 @@ class RealtimeSegmentManager:
             return rec
 
         self.store.update(f"{SEGMENTS}/{table}/{segment}", finish)
+        # seeded crash point: controller dies mid-commit — DONE recorded
+        # but no successor created and the ideal state not stepped; the
+        # validation task's DONE-without-successor repair must finish the
+        # job from the durable store after restart
+        crash_points.hit("controller.commit_pre_successor")
         llc = LLCSegmentName.parse(segment)
         nxt = llc.next()
         self.store.set(f"{SEGMENTS}/{table}/{nxt.name}", {

@@ -100,15 +100,27 @@ class _Connection:
         if op == "get":
             return {**ok, "record": store.get(req["path"])}
         if op == "set":
-            store.set(req["path"], req["record"])
+            # the ephemeral flag travels down to the local store so its
+            # durability journal skips session-scoped records; a durable
+            # write over a once-ephemeral path unbinds it from this
+            # session (latest write wins — session death must not remove
+            # a record that was made durable afterwards)
+            store.set(req["path"], req["record"],
+                      ephemeral=bool(req.get("ephemeral")))
             if req.get("ephemeral"):
                 self.ephemeral_paths.add(req["path"])
+            else:
+                self.ephemeral_paths.discard(req["path"])
             return ok
         if op == "cas":
             applied = store.cas(req["path"], req.get("expected"),
-                                req["record"])
-            if applied and req.get("ephemeral"):
-                self.ephemeral_paths.add(req["path"])
+                                req["record"],
+                                ephemeral=bool(req.get("ephemeral")))
+            if applied:
+                if req.get("ephemeral"):
+                    self.ephemeral_paths.add(req["path"])
+                else:
+                    self.ephemeral_paths.discard(req["path"])
             return {**ok, "applied": applied}
         if op == "remove":
             existed = store.remove(req["path"])
@@ -141,8 +153,12 @@ class PropertyStoreServer:
     """Serve `store` on host:port from a daemon event-loop thread."""
 
     def __init__(self, store: Optional[PropertyStore] = None,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.store = store if store is not None else PropertyStore()
+                 host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None):
+        """`data_dir`: when constructing the store internally, enable
+        WAL + snapshot durability under this directory."""
+        self.store = store if store is not None else \
+            PropertyStore(data_dir=data_dir)
         self.host = host
         self.port = port
         self.connections: Set[_Connection] = set()

@@ -107,7 +107,17 @@ class MinionWorker:
                 raise ValueError(f"segment {seg} not found in {table}")
             local = os.path.join(task_dir, "in", seg)
             os.makedirs(os.path.dirname(local), exist_ok=True)
-            self.manager.fs.copy(meta["downloadPath"], local)
+            # resolve by scheme: an HTTP-advertised downloadPath fetches
+            # through the deep-store client (re-based onto the current
+            # controller endpoint), local paths copy directly
+            from pinot_tpu.common.filesystem import get_fs
+            src = self.manager.resolve_download_path(meta["downloadPath"])
+            src_fs = get_fs(src) if "://" in src else self.manager.fs
+            src_fs.copy(src, local)
+            # minions verify inputs like servers do — a corrupt artifact
+            # must not be silently merged/purged into a new segment
+            from pinot_tpu.segment.integrity import verify_segment
+            verify_segment(local, meta.get("crc"))
             inputs.append(local)
         out_dir = os.path.join(task_dir, "out")
         os.makedirs(out_dir, exist_ok=True)

@@ -267,11 +267,17 @@ class RealtimeTableDataManager:
     """
 
     def __init__(self, server, resource_manager, completion,
-                 work_dir: str):
+                 work_dir: str, fetcher=None):
+        """`fetcher`: optional (table, segment, download_path,
+        expected_crc) -> local_dir callable — the participant's cached,
+        CRC-verifying deep-store fetch, so committed realtime segments
+        take the same download/verify/quarantine path offline segments
+        do (required when downloadPath is a remote URL)."""
         self.server = server
         self.manager = resource_manager
         self.completion = completion
         self.work_dir = work_dir
+        self.fetcher = fetcher
         from pinot_tpu.realtime.stats_history import \
             RealtimeSegmentStatsHistory
         self.stats_history = RealtimeSegmentStatsHistory(
@@ -295,6 +301,14 @@ class RealtimeTableDataManager:
         meta = self.manager.segment_metadata(table, segment)
         if meta is None:
             raise ValueError(f"no metadata for {table}/{segment}")
+        if meta.get("status") == "DONE" and meta.get("downloadPath"):
+            # committed while this server was away (e.g. a controller
+            # that crashed between commit and the ideal-state step, now
+            # repaired): never re-consume committed rows — serve the
+            # committed artifact; the validation task advances the
+            # ideal state and successor from the durable record
+            self.on_segment_online(table, segment)
+            return
         config = self.manager.get_table_config(table)
         schema = self.manager.get_schema(raw_table(table))
         if config is None or schema is None:
@@ -325,7 +339,16 @@ class RealtimeTableDataManager:
         meta = self.manager.segment_metadata(table, segment)
         if meta is None or not meta.get("downloadPath"):
             raise ValueError(f"no committed artifact for {table}/{segment}")
-        seg = ImmutableSegmentLoader.load(meta["downloadPath"])
+        path = meta["downloadPath"]
+        if self.fetcher is not None:
+            path = self.fetcher(table, segment, path, meta.get("crc"))
+        elif "://" not in path:
+            # committed copy is CRC-verified against the durable record
+            # before it replaces the consuming segment — a corrupt
+            # artifact fails the transition (ERROR) instead of serving
+            from pinot_tpu.segment.integrity import verify_segment
+            verify_segment(path, meta.get("crc"))
+        seg = ImmutableSegmentLoader.load(path)
         self.server.data_manager.table(table, create=True).add_segment(seg)
 
     def on_segment_offline(self, table: str, segment: str) -> None:

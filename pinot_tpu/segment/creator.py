@@ -379,6 +379,11 @@ class SegmentCreator:
             from pinot_tpu.segment.store import SegmentFormatConverter
             SegmentFormatConverter.v1_to_v3(out_dir)
             meta.segment_version = "v3"
+        # seal: stamp the artifact crc into metadata.json (parity:
+        # CrcUtils at the end of SegmentIndexCreationDriverImpl.build) —
+        # after the v3 conversion so the crc describes the final layout
+        from pinot_tpu.segment.integrity import stamp_crc
+        meta.crc = stamp_crc(out_dir)
         return meta
 
 

@@ -1,0 +1,113 @@
+"""End-to-end segment artifact integrity: CRC32 stamping + verification.
+
+Parity: the reference's segment CRC story — CrcUtils.computeCrc over the
+segment files at build time, the crc stamped into SegmentZKMetadata, and
+SegmentFetcherAndLoader verifying every downloaded artifact before it is
+served (a mismatch fails the transition and the artifact is discarded).
+Here the checksum covers every artifact file EXCEPT metadata.json — the
+crc is stamped into metadata.json itself, so the metadata file cannot be
+part of its own checksum (the reference excludes it the same way).
+
+The checksum is layout-honest: it folds in each member's file name, so a
+missing, renamed, or extra index file changes the crc even if the byte
+streams happen to collide. v1 (file-per-index) and v3 (columns.psf) are
+different artifacts and carry different crcs — the crc always describes
+the bytes that actually travel and land on disk.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import zlib
+from typing import Optional
+
+from pinot_tpu.segment import format as fmt
+
+log = logging.getLogger(__name__)
+
+_CHUNK = 1 << 20
+
+
+class SegmentIntegrityError(ValueError):
+    """A segment artifact's bytes do not match its recorded CRC."""
+
+
+def compute_crc(seg_dir: str) -> str:
+    """CRC32 over every file in the segment directory except
+    metadata.json, folding in file names (sorted) so structural changes
+    are detected. Returned as a decimal string (SegmentMetadata.crc)."""
+    crc = 0
+    for name in sorted(os.listdir(seg_dir)):
+        if name == fmt.METADATA_FILE:
+            continue
+        path = os.path.join(seg_dir, name)
+        if os.path.isdir(path):
+            continue           # segment artifacts are flat
+        crc = zlib.crc32(name.encode("utf-8"), crc)
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(_CHUNK)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+    return str(crc & 0xFFFFFFFF)
+
+
+def stamp_crc(seg_dir: str) -> str:
+    """Compute the artifact crc and stamp it into metadata.json in
+    place; returns the crc. Run at seal time (SegmentCreator.build) and
+    lazily for pre-integrity artifacts entering the deep store."""
+    crc = compute_crc(seg_dir)
+    meta_path = os.path.join(seg_dir, fmt.METADATA_FILE)
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["crc"] = crc
+    with open(meta_path, "w") as f:
+        json.dump(meta, f, indent=1, default=str)
+    return crc
+
+
+def recorded_crc(seg_dir: str) -> Optional[str]:
+    """The crc stamped in the artifact's own metadata.json (None when
+    the artifact predates integrity stamping or has no metadata)."""
+    meta_path = os.path.join(seg_dir, fmt.METADATA_FILE)
+    try:
+        with open(meta_path) as f:
+            return json.load(f).get("crc")
+    except (OSError, ValueError):
+        return None
+
+
+def verify_segment(seg_dir: str,
+                   expected_crc: Optional[str] = None) -> str:
+    """Verify the artifact against `expected_crc` (falling back to the
+    crc stamped in its metadata). Returns the actual crc; raises
+    SegmentIntegrityError on mismatch. Artifacts with no recorded crc
+    anywhere pass vacuously (pre-integrity segments stay loadable)."""
+    actual = compute_crc(seg_dir)
+    expected = expected_crc if expected_crc is not None \
+        else recorded_crc(seg_dir)
+    if expected is not None and str(expected) != actual:
+        raise SegmentIntegrityError(
+            f"segment artifact {seg_dir} crc mismatch: "
+            f"expected {expected}, computed {actual}")
+    return actual
+
+
+def quarantine_segment(seg_dir: str, quarantine_root: str) -> str:
+    """Move a corrupt artifact into `quarantine_root` (never deleted —
+    kept for forensics, out of every serving path). Returns the new
+    location. Collisions get a numeric suffix."""
+    os.makedirs(quarantine_root, exist_ok=True)
+    base = os.path.basename(os.path.normpath(seg_dir))
+    dest = os.path.join(quarantine_root, base)
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = os.path.join(quarantine_root, f"{base}.{n}")
+    shutil.move(seg_dir, dest)
+    log.warning("quarantined corrupt segment artifact %s -> %s",
+                seg_dir, dest)
+    return dest

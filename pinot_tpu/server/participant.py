@@ -13,6 +13,8 @@ import tempfile
 import threading
 from typing import Optional
 
+from pinot_tpu.common.faults import crash_points
+from pinot_tpu.common.metrics import MetricsRegistry, ServerMeter
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.state_machine import StateModel
 from pinot_tpu.segment.loader import ImmutableSegmentLoader
@@ -56,23 +58,63 @@ class ServerParticipant(StateModel):
                     tempfile.gettempdir(),
                     f"pinot_tpu_rt_{self.server.instance_id}")
                 self._realtime = RealtimeTableDataManager(
-                    self.server, self.manager, self.completion, work)
+                    self.server, self.manager, self.completion, work,
+                    fetcher=self._fetch_segment_dir)
             return self._realtime
 
+    def _work_root(self) -> str:
+        return self.work_dir or os.path.join(
+            tempfile.gettempdir(),
+            f"pinot_tpu_seg_{self.server.instance_id}")
+
+    def local_segment_dir(self, table: str, segment: str) -> str:
+        """This server's local artifact cache location for a segment —
+        the cold-start recovery source (survives process restarts)."""
+        return os.path.join(self._work_root(), "fetched", table, segment)
+
+    def quarantine_root(self) -> str:
+        return os.path.join(self._work_root(), "quarantine")
+
+
     def _fetch_segment_dir(self, table: str, segment: str,
-                           download_path: str) -> str:
+                           download_path: str,
+                           expected_crc=None) -> str:
         """SegmentFetcherAndLoader parity: a remote downloadPath (e.g.
         http://controller/deepstore/...) is fetched through the PinotFS
         registry into the server's local segment cache; local paths
-        load in place (the shared-filesystem deployment)."""
+        load in place (the shared-filesystem deployment).
+
+        Every artifact is CRC-verified against the cluster-state record
+        before it is served. A valid cached copy short-circuits the
+        download — a restarted server reloads its committed segments
+        from local disk (cold-start recovery); a corrupt copy is moved
+        to quarantine/ and re-fetched, and a corrupt DOWNLOAD is
+        quarantined and fails the transition (→ ERROR replica, repaired
+        by the controller's integrity scrubber).
+        """
+        from pinot_tpu.segment.integrity import (SegmentIntegrityError,
+                                                 quarantine_segment,
+                                                 verify_segment)
+        metrics = getattr(self.server, "metrics", None) or \
+            MetricsRegistry()
+        download_path = self.manager.resolve_download_path(download_path)
         if "://" not in download_path or \
                 download_path.startswith("file://"):
-            return download_path.replace("file://", "", 1)
+            local = download_path.replace("file://", "", 1)
+            # shared-filesystem deployment: verify in place; the deep
+            # store is the controller's to quarantine, not this server's
+            verify_segment(local, expected_crc)
+            return local
         from pinot_tpu.common.filesystem import get_fs
-        work = self.work_dir or os.path.join(
-            tempfile.gettempdir(),
-            f"pinot_tpu_seg_{self.server.instance_id}")
-        local = os.path.join(work, "fetched", table, segment)
+        local = self.local_segment_dir(table, segment)
+        if os.path.isdir(local):
+            try:
+                verify_segment(local, expected_crc)
+                metrics.meter(ServerMeter.SEGMENT_LOCAL_RELOADS).mark()
+                return local            # cold start: no re-download
+            except SegmentIntegrityError:
+                metrics.meter(ServerMeter.SEGMENT_CRC_MISMATCHES).mark()
+                quarantine_segment(local, self.quarantine_root())
         # transient deep-store failures (controller restarting, network
         # blip) retry with backoff before the transition goes ERROR
         # (parity: SegmentFetcherAndLoader's RetryPolicies-wrapped fetch)
@@ -83,7 +125,50 @@ class ServerParticipant(StateModel):
                      # transient classes only: a 404/permission/URI error
                      # can't heal and must fail the transition fast
                      retry_on=(ConnectionError, TimeoutError, OSError))
+        metrics.meter(ServerMeter.SEGMENT_DOWNLOADS).mark()
+        # seeded crash point: process dies after the download landed but
+        # before verification/registration — restart must re-validate
+        # the cached bytes before serving them
+        crash_points.hit("server.post_download")
+        try:
+            verify_segment(local, expected_crc)
+        except SegmentIntegrityError:
+            metrics.meter(ServerMeter.SEGMENT_CRC_MISMATCHES).mark()
+            quarantine_segment(local, self.quarantine_root())
+            raise
         return local
+
+    def scan_local_artifacts(self) -> dict:
+        """Cold-start scan: CRC-validate every cached artifact under the
+        work dir, quarantining corrupt ones BEFORE transitions replay —
+        a restarted server then re-enters its assignments serving only
+        verified local copies (valid ones reload with no deep-store
+        re-download). Returns {"valid": [...], "quarantined": [...]} of
+        (table, segment) pairs."""
+        from pinot_tpu.segment.integrity import (SegmentIntegrityError,
+                                                 quarantine_segment,
+                                                 verify_segment)
+        report = {"valid": [], "quarantined": []}
+        fetched = os.path.join(self._work_root(), "fetched")
+        if not os.path.isdir(fetched):
+            return report
+        for table in sorted(os.listdir(fetched)):
+            tdir = os.path.join(fetched, table)
+            if not os.path.isdir(tdir):
+                continue
+            for segment in sorted(os.listdir(tdir)):
+                seg_dir = os.path.join(tdir, segment)
+                if not os.path.isdir(seg_dir):
+                    continue
+                record = self.manager.segment_metadata(table, segment)
+                expected = (record or {}).get("crc")
+                try:
+                    verify_segment(seg_dir, expected)
+                    report["valid"].append((table, segment))
+                except SegmentIntegrityError:
+                    quarantine_segment(seg_dir, self.quarantine_root())
+                    report["quarantined"].append((table, segment))
+        return report
 
     def on_become_consuming(self, table: str, segment: str) -> None:
         self.realtime.start_consuming(table, segment)
@@ -102,7 +187,8 @@ class ServerParticipant(StateModel):
         schema = self.manager.get_schema(raw_table(table))
         config = self.manager.get_table_config(table)
         seg = ImmutableSegmentLoader.load(
-            self._fetch_segment_dir(table, segment, meta["downloadPath"]),
+            self._fetch_segment_dir(table, segment, meta["downloadPath"],
+                                    expected_crc=meta.get("crc")),
             schema=schema,
             index_loading_config=(config.indexing_config
                                   if config else None))
@@ -117,7 +203,12 @@ class ServerParticipant(StateModel):
             tdm.remove_segment(segment)
 
     def on_become_dropped(self, table: str, segment: str) -> None:
-        pass  # local artifact cleanup is a no-op: segments load from deep store
+        # a dropped segment's cached artifact must not survive to be
+        # reused by a future same-name upload (reloads bounce through
+        # OFFLINE, not DROPPED, so refresh reuse is unaffected)
+        import shutil
+        shutil.rmtree(self.local_segment_dir(table, segment),
+                      ignore_errors=True)
 
     def shutdown(self) -> None:
         if self._realtime is not None:

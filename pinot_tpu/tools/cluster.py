@@ -28,9 +28,13 @@ class EmbeddedCluster:
 
     def __init__(self, work_dir: str, num_servers: int = 2,
                  tcp: bool = False, mesh=None, scheduler: str = "fcfs",
-                 http: bool = False):
+                 http: bool = False, store_dir: str = None):
+        """`store_dir`: persist cluster state (property-store WAL +
+        snapshots) under this directory — a cluster rebuilt over the
+        same work_dir/store_dir recovers its tables and segments."""
         self.work_dir = work_dir
-        self.controller = Controller(os.path.join(work_dir, "deepstore"))
+        self.controller = Controller(os.path.join(work_dir, "deepstore"),
+                                     store_dir=store_dir)
         self.servers: Dict[str, ServerInstance] = {}
         self.participants: Dict[str, ServerParticipant] = {}
         for i in range(num_servers):

@@ -38,9 +38,18 @@ class DistributedController:
     (+ optional admin HTTP)."""
 
     def __init__(self, work_dir: str, store_port: int = 0,
-                 http: bool = False, periodic: bool = False):
+                 http: bool = False, periodic: bool = False,
+                 durable: bool = True, download_base: Optional[str] = None):
+        """`durable`: journal cluster state under <work_dir>/store (WAL
+        + snapshots) so a controller restarted over the same work_dir
+        recovers every table, ideal state and segment record.
+        `download_base="http"` (requires http=True): advertise segment
+        downloadPaths through the controller's /deepstore endpoints —
+        the no-shared-filesystem deployment where servers download and
+        cache artifacts locally."""
         self.work_dir = work_dir
-        self.store = PropertyStore()
+        self.store = PropertyStore(
+            data_dir=os.path.join(work_dir, "store") if durable else None)
         self.controller = Controller(os.path.join(work_dir, "deepstore"),
                                      store=self.store)
         self.composer = ViewComposer(self.store)
@@ -52,6 +61,16 @@ class DistributedController:
             from pinot_tpu.controller.http_api import ControllerApiServer
             self.http_api = ControllerApiServer(self.controller)
             self.http_port = self.http_api.start()
+            if download_base == "http":
+                # advertise downloadPath through /deepstore so servers
+                # without a shared filesystem fetch over HTTP; the
+                # CURRENT endpoint is also published so servers re-base
+                # durable records stamped by a previous controller
+                # incarnation (a restart may land on a new port)
+                base = f"http://127.0.0.1:{self.http_port}"
+                self.controller.manager.download_base = base
+                self.store.set("/CONTROLLER/DEEPSTORE_BASE",
+                               {"base": base})
         if periodic:
             self.controller.start()
 
@@ -65,6 +84,19 @@ class DistributedController:
         self.controller.stop()
         self.composer.close()
         self.store_server.stop()
+        self.store.close()
+
+    def kill(self) -> None:
+        """Crash simulation: sockets die, nothing is drained or
+        resigned — recovery must come from the store's WAL/snapshots
+        and the deep store alone."""
+        if self.http_api is not None:
+            self.http_api.stop()
+        self.store_server.stop()
+        # the WAL handle is NOT fsync'd/closed gracefully on a real
+        # crash either; close() only releases the fd so a successor
+        # process (same test) can reopen the files
+        self.store.close()
 
 
 class DistributedServer:
@@ -95,6 +127,10 @@ class DistributedServer:
         self.participant = ServerParticipant(self.server, self.manager,
                                              completion=completion,
                                              work_dir=work_dir)
+        # cold-start recovery: validate the local artifact cache before
+        # re-entering assigned transitions — verified segments reload
+        # from disk, corrupt ones are quarantined and re-downloaded
+        self.recovery_report = self.participant.scan_local_artifacts()
         self.agent = ParticipantAgent(self.store, instance_id,
                                       self.participant,
                                       endpoint=(host, self.port))

@@ -14,6 +14,15 @@ echo "== chaos (broker fault tolerance) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
     -q -p no:cacheprovider
 
+echo "== crash recovery (durability plane) =="
+# kill-and-restart gates: WAL/snapshot recovery, torn tails, seeded
+# crash points, cold-start reloads, integrity quarantine + repair ...
+env JAX_PLATFORMS=cpu python -m pytest tests/test_crash_recovery.py \
+    -q -p no:cacheprovider
+# ... plus a scripted kill-restart of the distributed quickstart that
+# must converge (zero re-downloads) within a bounded window
+env JAX_PLATFORMS=cpu python scripts/crash_restart_smoke.py
+
 echo "== qps smoke (serving plane) =="
 # one short target-QPS rung over the real TCP mux: catches serving-plane
 # regressions (per-connection serialization, serde blow-ups) in seconds

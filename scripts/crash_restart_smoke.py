@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Crash-restart convergence gate.
+
+Boots the distributed quickstart shape (controller with durable store +
+HTTP deep store, one server, one broker), loads demo segments, then
+KILLS the controller and the server (no graceful deregistration) and
+restarts both over the same directories. The restarted cluster must
+converge to serving the exact same row count within a bounded window,
+with the server reloading every segment from its CRC-verified local
+cache (zero deep-store re-downloads).
+
+Exit code 0 on convergence, 1 otherwise. Env knobs:
+  CRASH_SMOKE_ROWS     rows per segment (default 2000)
+  CRASH_SMOKE_WINDOW_S convergence window after restart (default 60)
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROWS = int(os.environ.get("CRASH_SMOKE_ROWS", "2000"))
+WINDOW_S = float(os.environ.get("CRASH_SMOKE_WINDOW_S", "60"))
+TABLE = "baseballStats_OFFLINE"
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — still converging
+            pass
+        time.sleep(0.1)
+    print(f"FAIL: timed out waiting for {what}", file=sys.stderr)
+    return False
+
+
+def count_star(broker):
+    resp = broker.query("SELECT COUNT(*) FROM baseballStats")
+    if resp.exceptions:
+        return -1
+    return int(resp.aggregation_results[0].value)
+
+
+def main() -> int:
+    from pinot_tpu.common.metrics import ServerMeter
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.tools.admin import _demo_rows, _demo_schema
+    from pinot_tpu.tools.distributed import (DistributedBroker,
+                                             DistributedController,
+                                             DistributedServer)
+
+    base = tempfile.mkdtemp(prefix="pinot_tpu_crash_smoke_")
+    t0 = time.monotonic()
+
+    def boot():
+        ctrl = DistributedController(base, http=True,
+                                     download_base="http")
+        srv = DistributedServer("Server_0", "127.0.0.1", ctrl.store_port,
+                                ctrl.deep_store_dir,
+                                work_dir=os.path.join(base, "s0_work"))
+        broker = DistributedBroker("127.0.0.1", ctrl.store_port,
+                                   ctrl.deep_store_dir)
+        return ctrl, srv, broker
+
+    ctrl, srv, broker = boot()
+    schema = _demo_schema()
+    ctrl.controller.manager.add_schema(schema)
+    ctrl.controller.manager.add_table(TableConfig("baseballStats"))
+    expected = 0
+    for i in range(2):
+        rows = _demo_rows(ROWS, seed=11 + i, year_lo=1990, year_hi=2020)
+        expected += len(rows)
+        d = os.path.join(base, f"smoke_seg_{i}")
+        SegmentCreator(schema, TableConfig("baseballStats"),
+                       segment_name=f"smoke_seg_{i}").build(rows, d)
+        ctrl.controller.manager.add_segment(TABLE, d)
+    if not wait_for(lambda: count_star(broker) == expected, 60,
+                    "initial convergence"):
+        return 1
+    print(f"loaded: {expected} rows served "
+          f"(t+{time.monotonic() - t0:.1f}s)")
+
+    # -- kill controller AND server: sessions drop, nothing deregisters --
+    broker.stop()
+    srv.kill()
+    ctrl.kill()
+    print("killed controller + server (no graceful shutdown)")
+
+    restart_t0 = time.monotonic()
+    ctrl2, srv2, broker2 = boot()
+    ok = wait_for(lambda: count_star(broker2) == expected, WINDOW_S,
+                  f"post-restart convergence to {expected} rows")
+    elapsed = time.monotonic() - restart_t0
+    downloads = srv2.server.metrics.meter(
+        ServerMeter.SEGMENT_DOWNLOADS).count
+    reloads = srv2.server.metrics.meter(
+        ServerMeter.SEGMENT_LOCAL_RELOADS).count
+    result = {
+        "converged": ok,
+        "convergenceSeconds": round(elapsed, 2),
+        "windowSeconds": WINDOW_S,
+        "rows": expected,
+        "segmentDownloadsAfterRestart": downloads,
+        "segmentLocalReloadsAfterRestart": reloads,
+    }
+    print(json.dumps(result, indent=2))
+    if ok and downloads != 0:
+        print("FAIL: restarted server re-downloaded instead of "
+              "reloading its verified local cache", file=sys.stderr)
+        ok = False
+    if ok and reloads != 2:
+        print(f"FAIL: expected 2 local reloads, saw {reloads}",
+              file=sys.stderr)
+        ok = False
+    broker2.stop()
+    srv2.stop()
+    ctrl2.stop()
+    shutil.rmtree(base, ignore_errors=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

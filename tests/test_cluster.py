@@ -49,6 +49,80 @@ def test_property_store_update_atomic():
     assert store.get("/x") == {"n": 2}
 
 
+def test_property_store_watchers_get_defensive_copies():
+    """Watchers receive a deep-copied snapshot — neither the caller
+    mutating its record afterwards nor a watcher mutating what it was
+    handed can corrupt the stored state (get() already copies)."""
+    store = PropertyStore()
+    received = []
+
+    import json as _json
+
+    def cb(path, rec):
+        received.append(_json.loads(_json.dumps(rec)))
+        if rec is not None:
+            rec["mutated-by-watcher"] = True
+
+    store.watch("/SEGMENTS/", cb)
+    record = {"crc": "1", "nested": {"a": [1, 2]}}
+    store.set("/SEGMENTS/t/s0", record)
+    record["nested"]["a"].append(99)          # caller mutates after set
+    assert received[0] == {"crc": "1", "nested": {"a": [1, 2]}}
+    assert store.get("/SEGMENTS/t/s0") == \
+        {"crc": "1", "nested": {"a": [1, 2]}}
+    store.update("/SEGMENTS/t/s0", lambda old: {"crc": "2"})
+    assert received[1] == {"crc": "2"}
+    assert store.get("/SEGMENTS/t/s0") == {"crc": "2"}
+    assert store.cas("/SEGMENTS/t/s0", {"crc": "2"}, {"crc": "3"})
+    assert received[2] == {"crc": "3"}
+    assert store.get("/SEGMENTS/t/s0") == {"crc": "3"}
+
+
+# -- leadership -------------------------------------------------------------
+
+def test_leadership_expired_lease_takeover_single_winner():
+    """Two controllers racing one expired lease: the takeover is a CAS
+    against the exact record each read, so the second claimant's write
+    must LOSE — it can never overwrite the winner and believe it won."""
+    import json as _json
+
+    from pinot_tpu.controller.leadership import (LEADER_PATH,
+                                                 ControllerLeadershipManager)
+    store = PropertyStore()
+    now = {"t": 100.0}
+    store.set(LEADER_PATH, {"instance": "dead", "leaseUntil": 50.0})
+    stale = store.get(LEADER_PATH)
+
+    class StaleFirstRead:
+        """Simulates the race: c2's first read happened BEFORE c1's
+        claim landed (both saw the same expired lease)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self._pending = True
+
+        def get(self, path):
+            if self._pending and path == LEADER_PATH:
+                self._pending = False
+                return _json.loads(_json.dumps(stale))
+            return self.inner.get(path)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    c1 = ControllerLeadershipManager(store, "c1", clock=lambda: now["t"])
+    c2 = ControllerLeadershipManager(StaleFirstRead(store), "c2",
+                                     clock=lambda: now["t"])
+    assert c1.try_acquire() is True
+    assert c2.try_acquire() is False
+    assert store.get(LEADER_PATH)["instance"] == "c1"
+    assert c1.is_leader() and not c2.is_leader()
+    # after c1's lease expires, c2 takes over cleanly
+    now["t"] = 200.0
+    assert c2.try_acquire() is True
+    assert store.get(LEADER_PATH)["instance"] == "c2"
+
+
 # -- assignment -------------------------------------------------------------
 
 def test_balanced_assignment_spreads_load():

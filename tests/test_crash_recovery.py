@@ -1,0 +1,630 @@
+"""Kill-and-restart suite: the cluster survives controller/server death.
+
+Three tiers, mirroring the durability planes:
+
+1. **Property-store durability** — WAL replay, snapshot compaction, torn
+   final record, ephemeral/session-state exclusion, seeded crash points
+   before and in the middle of a WAL append.
+2. **Whole-cluster restart** — an embedded cluster rebuilt over the same
+   store/deep-store directories recovers tables, ideal states, segment
+   records and the realtime completion FSM's durable inputs; a seeded
+   controller crash mid-commit (before DONE, and after DONE but before
+   the successor) loses no committed segment and double-consumes no
+   offsets.
+3. **Segment integrity** — a restarted server serves CRC-verified local
+   artifacts without re-downloading; a corrupt artifact is quarantined,
+   never served, surfaced in metrics, and repaired by the scrubber
+   (re-download bounce, then reassignment to a healthy replica).
+"""
+import os
+import tempfile
+import time
+
+import pytest
+
+from fixtures import build_segment, make_schema, make_table_config
+
+from pinot_tpu.common.cluster_state import ERROR, ONLINE
+from pinot_tpu.common.faults import InjectedCrash, crash_points
+from pinot_tpu.controller.periodic import SegmentIntegrityChecker
+from pinot_tpu.controller.property_store import (PropertyStore, WAL_FILE)
+from pinot_tpu.controller.state_machine import ClusterCoordinator, StateModel
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+TABLE = "baseballStats_OFFLINE"
+
+
+def wait_until(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — condition not ready yet
+            pass
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clean_crash_points():
+    crash_points.clear()
+    yield
+    crash_points.clear()
+
+
+@pytest.fixture
+def work_dir():
+    return tempfile.mkdtemp()
+
+
+# ---------------------------------------------------------------------------
+# tier 1: property-store WAL + snapshots
+# ---------------------------------------------------------------------------
+
+def test_wal_replay_restores_durable_state(work_dir):
+    s = PropertyStore(data_dir=work_dir)
+    s.set("/CONFIGS/TABLE/t1", {"name": "t1"})
+    s.set("/SEGMENTS/t1/s0", {"crc": "123"})
+    s.update("/SEGMENTS/t1/s0",
+             lambda old: {**(old or {}), "status": "DONE"})
+    assert s.cas("/IDEALSTATES/t1", None, {"segments": {"s0": {}}})
+    s.set("/CONFIGS/TABLE/gone", {"x": 1})
+    s.remove("/CONFIGS/TABLE/gone")
+    # session state: never replayed
+    s.set("/LIVEINSTANCES/Server_0", {"tags": ["T"]})       # by prefix
+    s.set("/CURRENTSTATES/Server_0/t1", {"segments": {}})   # by prefix
+    s.set("/EXTERNALVIEW/t1", {"segments": {}})             # derived
+    s.set("/EPHEMERAL/x", {"v": 1}, ephemeral=True)         # by flag
+    s.close()
+
+    r = PropertyStore(data_dir=work_dir)
+    assert r.get("/CONFIGS/TABLE/t1") == {"name": "t1"}
+    assert r.get("/SEGMENTS/t1/s0") == {"crc": "123", "status": "DONE"}
+    assert r.get("/IDEALSTATES/t1") == {"segments": {"s0": {}}}
+    assert r.get("/CONFIGS/TABLE/gone") is None
+    assert r.get("/LIVEINSTANCES/Server_0") is None
+    assert r.get("/CURRENTSTATES/Server_0/t1") is None
+    assert r.get("/EXTERNALVIEW/t1") is None
+    assert r.get("/EPHEMERAL/x") is None
+    r.close()
+
+
+def test_snapshot_compaction_then_replay(work_dir):
+    s = PropertyStore(data_dir=work_dir, snapshot_every=5)
+    for i in range(17):
+        s.set(f"/SEGMENTS/t/s{i}", {"i": i})
+    snaps = [f for f in os.listdir(work_dir) if f.startswith("snapshot-")]
+    assert len(snaps) == 1, "old snapshots compacted away"
+    # WAL truncated at the last snapshot: only the post-snapshot tail
+    wal_lines = open(os.path.join(work_dir, WAL_FILE)).readlines()
+    assert len(wal_lines) == 17 % 5
+    # a leftover staging snapshot from a crash mid-snapshot is ignored
+    with open(os.path.join(work_dir, "snapshot-99999.json.tmp"), "w") as f:
+        f.write("{ torn")
+    s.close()
+    r = PropertyStore(data_dir=work_dir)
+    for i in range(17):
+        assert r.get(f"/SEGMENTS/t/s{i}") == {"i": i}
+    r.close()
+
+
+def test_torn_wal_tail_dropped_and_truncated(work_dir):
+    s = PropertyStore(data_dir=work_dir)
+    for i in range(3):
+        s.set(f"/SEGMENTS/t/s{i}", {"i": i})
+    s.close()
+    wal = os.path.join(work_dir, WAL_FILE)
+    with open(wal, "a") as f:
+        f.write('{"seq": 4, "op": "set", "path": "/SEGMENTS/t/s3", "rec')
+    r = PropertyStore(data_dir=work_dir)
+    assert r.get("/SEGMENTS/t/s2") == {"i": 2}
+    assert r.get("/SEGMENTS/t/s3") is None
+    # the torn bytes were truncated away: new appends form valid records
+    r.set("/SEGMENTS/t/s4", {"i": 4})
+    r.close()
+    r2 = PropertyStore(data_dir=work_dir)
+    assert r2.get("/SEGMENTS/t/s4") == {"i": 4}
+    assert r2.get("/SEGMENTS/t/s3") is None
+    r2.close()
+
+
+def test_crash_before_wal_append_loses_only_that_write(work_dir):
+    s = PropertyStore(data_dir=work_dir)
+    s.set("/SEGMENTS/t/s0", {"i": 0})
+    crash_points.arm("store.wal_append")
+    with pytest.raises(InjectedCrash):
+        s.set("/SEGMENTS/t/s1", {"i": 1})
+    # process "died": abandon s without close
+    r = PropertyStore(data_dir=work_dir)
+    assert r.get("/SEGMENTS/t/s0") == {"i": 0}
+    assert r.get("/SEGMENTS/t/s1") is None
+    r.close()
+
+
+def test_crash_mid_wal_append_writes_torn_record(work_dir):
+    s = PropertyStore(data_dir=work_dir)
+    s.set("/SEGMENTS/t/s0", {"i": 0})
+    crash_points.arm("store.wal_torn")
+    with pytest.raises(InjectedCrash):
+        s.set("/SEGMENTS/t/s1", {"i": 1})
+    # half a record really reached the disk
+    raw = open(os.path.join(work_dir, WAL_FILE), "rb").read()
+    assert not raw.endswith(b"\n")
+    r = PropertyStore(data_dir=work_dir)
+    assert r.get("/SEGMENTS/t/s0") == {"i": 0}
+    assert r.get("/SEGMENTS/t/s1") is None
+    r.set("/SEGMENTS/t/s2", {"i": 2})
+    r.close()
+    r2 = PropertyStore(data_dir=work_dir)
+    assert r2.get("/SEGMENTS/t/s2") == {"i": 2}
+    r2.close()
+
+
+def test_store_server_restart_excludes_ephemerals(work_dir):
+    """Networked shape: ephemerals written over the wire are absent
+    after the server process restarts over the same data dir."""
+    from pinot_tpu.controller.store_client import RemotePropertyStore
+    from pinot_tpu.controller.store_server import PropertyStoreServer
+    srv = PropertyStoreServer(data_dir=work_dir)
+    srv.start()
+    c = RemotePropertyStore("127.0.0.1", srv.port)
+    c.set("/LIVEINSTANCES/Server_9", {"tags": ["T"]}, ephemeral=True)
+    c.set("/SESSION/thing", {"v": 1}, ephemeral=True)
+    c.set("/CONFIGS/TABLE/t", {"name": "t"})
+    c.close()
+    srv.stop()
+    srv.store.close()
+
+    srv2 = PropertyStoreServer(data_dir=work_dir)
+    srv2.start()
+    c2 = RemotePropertyStore("127.0.0.1", srv2.port)
+    try:
+        assert c2.get("/CONFIGS/TABLE/t") == {"name": "t"}
+        assert c2.get("/LIVEINSTANCES/Server_9") is None
+        assert c2.get("/SESSION/thing") is None
+    finally:
+        c2.close()
+        srv2.stop()
+        srv2.store.close()
+
+
+# ---------------------------------------------------------------------------
+# tier 2: whole-cluster restart
+# ---------------------------------------------------------------------------
+
+def _count(cluster):
+    resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+    if resp.exceptions:
+        return -1
+    return int(resp.aggregation_results[0].value)
+
+
+def test_controller_restart_recovers_offline_cluster(work_dir):
+    store_dir = os.path.join(work_dir, "store")
+    n = 2_000
+    cluster = EmbeddedCluster(work_dir, num_servers=2, store_dir=store_dir)
+    cluster.add_schema(make_schema())
+    cluster.add_table(make_table_config())
+    for i in range(2):
+        d = os.path.join(work_dir, f"seg{i}")
+        os.makedirs(d, exist_ok=True)
+        build_segment(d, n=n, seed=40 + i, name=f"crseg_{i}")
+        cluster.upload_segment(TABLE, d)
+    assert wait_until(lambda: _count(cluster) == 2 * n)
+    before = {s: cluster.controller.manager.segment_metadata(TABLE, s)
+              for s in cluster.controller.manager.segment_names(TABLE)}
+    ideal_before = cluster.controller.coordinator.ideal_state(TABLE)
+    cluster.stop()
+
+    # a crashed controller left a torn WAL tail behind
+    with open(os.path.join(store_dir, WAL_FILE), "a") as f:
+        f.write('{"seq": 999999, "op": "set", "path": "/SEGM')
+
+    c2 = EmbeddedCluster(work_dir, num_servers=2, store_dir=store_dir)
+    try:
+        mgr = c2.controller.manager
+        assert mgr.get_table_config(TABLE) is not None
+        assert sorted(mgr.segment_names(TABLE)) == sorted(before)
+        for seg, meta in before.items():
+            got = mgr.segment_metadata(TABLE, seg)
+            assert got == meta
+            assert got.get("crc"), "segment records carry a crc"
+        assert c2.controller.coordinator.ideal_state(TABLE) == ideal_before
+        # servers re-enter their assignments and serving resumes
+        assert wait_until(lambda: _count(c2) == 2 * n)
+    finally:
+        c2.stop()
+
+
+def _rt_cluster(work_dir, factory, topic, flush_rows=200):
+    from test_realtime import rt_config
+    store_dir = os.path.join(work_dir, "store")
+    cluster = EmbeddedCluster(work_dir, num_servers=1, store_dir=store_dir)
+    cluster.add_schema(make_schema())
+    cluster.add_table(rt_config(factory, topic, flush_rows=flush_rows))
+    return cluster
+
+
+@pytest.mark.parametrize("crash_point", ["controller.commit_pre_done",
+                                         "controller.commit_pre_successor"])
+def test_controller_crash_mid_commit_recovers(work_dir, crash_point):
+    """Controller dies mid-commit; after restart the cluster converges
+    with no lost committed segment and no double-consumed offsets."""
+    from test_realtime import make_rows
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.realtime import registry
+    topic = f"topic_{crash_point.split('.')[-1]}"
+    factory = f"mem_{topic}"
+    stream = MemoryStream(topic, num_partitions=1)
+    registry.register_stream_factory(
+        factory, MemoryStreamConsumerFactory(stream, batch_size=50))
+    rows = make_rows(300, seed=11)
+    cluster = _rt_cluster(work_dir, factory, topic, flush_rows=200)
+    rt_table = "baseballStats_REALTIME"
+    try:
+        crash_points.arm(crash_point)
+        for r in rows:
+            stream.publish(r, partition=0)
+        # the commit attempt hits the crash point ("controller died")
+        assert wait_until(lambda: crash_points.fired.get(crash_point)), \
+            "commit never reached the armed crash point"
+    finally:
+        cluster.stop()
+
+    # restart over the same durable store + deep store
+    c2 = EmbeddedCluster(work_dir, num_servers=1,
+                         store_dir=os.path.join(work_dir, "store"))
+    try:
+        mgr = c2.controller.manager
+        assert mgr.get_table_config(rt_table) is not None
+        # repair from durable state (the periodic validation task's job)
+        c2.controller.realtime.ensure_all_partitions_consuming()
+        exp_sum = sum(r["runs"] for r in rows)
+
+        def converged():
+            if _count(c2) != len(rows):
+                # consumption still resuming; re-run repair like the
+                # periodic task would
+                c2.controller.realtime.ensure_all_partitions_consuming()
+                return False
+            resp = c2.query("SELECT SUM(runs) FROM baseballStats")
+            return not resp.exceptions and \
+                float(resp.aggregation_results[0].value) == exp_sum
+
+        assert wait_until(converged, timeout=40), \
+            (f"count={_count(c2)} expected={len(rows)} "
+             f"(lost or double-consumed rows after {crash_point})")
+        # at least one segment committed durably with an artifact
+        assert wait_until(lambda: len([
+            s for s in mgr.segment_names(rt_table)
+            if (mgr.segment_metadata(rt_table, s) or {}).get(
+                "status") == "DONE"]) >= 1)
+        done = [s for s in mgr.segment_names(rt_table)
+                if (mgr.segment_metadata(rt_table, s) or {}).get(
+                    "status") == "DONE"]
+        for s in done:
+            meta = mgr.segment_metadata(rt_table, s)
+            path = meta["downloadPath"]
+            assert os.path.isdir(path)
+            from pinot_tpu.segment.integrity import verify_segment
+            verify_segment(path, meta.get("crc"))
+    finally:
+        c2.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier 3: server cold start + segment integrity
+# ---------------------------------------------------------------------------
+
+def _tamper(seg_dir):
+    """Flip bytes in a non-metadata artifact file."""
+    for name in sorted(os.listdir(seg_dir)):
+        if name == "metadata.json":
+            continue
+        path = os.path.join(seg_dir, name)
+        if not os.path.isfile(path):
+            continue
+        with open(path, "r+b") as f:
+            head = f.read(16)
+            f.seek(0)
+            f.write(bytes(b ^ 0xFF for b in head))
+        return name
+    raise AssertionError(f"no artifact file to tamper in {seg_dir}")
+
+
+@pytest.fixture
+def http_cluster(work_dir):
+    """Distributed deployment with HTTP deep store: servers download
+    and cache artifacts locally (no shared filesystem assumption)."""
+    from pinot_tpu.tools.distributed import (DistributedController,
+                                             DistributedServer)
+    ctrl = DistributedController(work_dir, http=True, download_base="http")
+    ctx = {"ctrl": ctrl, "servers": [], "brokers": []}
+
+    def add_server(instance_id="Server_0"):
+        srv = DistributedServer(
+            instance_id, "127.0.0.1", ctrl.store_port, ctrl.deep_store_dir,
+            work_dir=os.path.join(work_dir, f"{instance_id}_work"))
+        ctx["servers"].append(srv)
+        return srv
+
+    def add_broker():
+        from pinot_tpu.tools.distributed import DistributedBroker
+        b = DistributedBroker("127.0.0.1", ctrl.store_port,
+                              ctrl.deep_store_dir)
+        ctx["brokers"].append(b)
+        return b
+
+    ctx["add_server"] = add_server
+    ctx["add_broker"] = add_broker
+    yield ctx
+    for b in ctx["brokers"]:
+        try:
+            b.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    for s in ctx["servers"]:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    ctrl.stop()
+
+
+def test_server_cold_start_serves_from_local_cache(http_cluster, work_dir):
+    from pinot_tpu.common.metrics import ServerMeter
+    ctrl = http_cluster["ctrl"]
+    srv = http_cluster["add_server"]()
+    broker = http_cluster["add_broker"]()
+    mgr = ctrl.controller.manager
+    mgr.add_schema(make_schema())
+    mgr.add_table(make_table_config())
+    n = 2_000
+    for i in range(2):
+        d = os.path.join(work_dir, f"useg{i}")
+        os.makedirs(d, exist_ok=True)
+        build_segment(d, n=n, seed=70 + i, name=f"cold_{i}")
+        mgr.add_segment(TABLE, d)
+    # downloadPath is advertised over HTTP, so the server fetched + cached
+    meta = mgr.segment_metadata(TABLE, "cold_0")
+    assert meta["downloadPath"].startswith("http://")
+
+    def served(b):
+        resp = b.query("SELECT COUNT(*) FROM baseballStats")
+        return not resp.exceptions and \
+            int(resp.aggregation_results[0].value) == 2 * n
+
+    assert wait_until(lambda: served(broker))
+    assert srv.server.metrics.meter(ServerMeter.SEGMENT_DOWNLOADS).count \
+        == 2
+
+    # crash + cold restart: same instance id and work dir
+    srv.kill()
+    http_cluster["servers"].remove(srv)
+    srv2 = http_cluster["add_server"]()
+    assert wait_until(lambda: len(
+        srv2.server.data_manager.table(TABLE, create=True)
+        .segment_names()) == 2)
+    # both segments reloaded from verified local artifacts, zero downloads
+    assert srv2.server.metrics.meter(ServerMeter.SEGMENT_DOWNLOADS).count \
+        == 0
+    assert srv2.server.metrics.meter(
+        ServerMeter.SEGMENT_LOCAL_RELOADS).count == 2
+    assert srv2.recovery_report["valid"] == [(TABLE, "cold_0"),
+                                             (TABLE, "cold_1")]
+    assert wait_until(lambda: served(broker))
+
+    # corrupt one cached artifact mid-crash: the restart scan quarantines
+    # it and the transition re-downloads a verified copy
+    srv2.kill()
+    http_cluster["servers"].remove(srv2)
+    cache = os.path.join(work_dir, "Server_0_work", "fetched", TABLE,
+                         "cold_0")
+    _tamper(cache)
+    srv3 = http_cluster["add_server"]()
+    assert (TABLE, "cold_0") in srv3.recovery_report["quarantined"]
+    assert wait_until(lambda: len(
+        srv3.server.data_manager.table(TABLE, create=True)
+        .segment_names()) == 2)
+    assert srv3.server.metrics.meter(ServerMeter.SEGMENT_DOWNLOADS).count \
+        == 1
+    assert srv3.server.metrics.meter(
+        ServerMeter.SEGMENT_LOCAL_RELOADS).count == 1
+    q_root = os.path.join(work_dir, "Server_0_work", "quarantine")
+    assert os.path.isdir(q_root) and len(os.listdir(q_root)) == 1
+    assert wait_until(lambda: served(broker))
+
+
+def test_download_path_rebased_to_current_controller(work_dir):
+    """Durable segment records may carry an HTTP downloadPath stamped
+    by a previous controller incarnation (dead port after a restart);
+    consumers re-base it onto the endpoint the CURRENT controller
+    publishes at /CONTROLLER/DEEPSTORE_BASE."""
+    from pinot_tpu.controller.manager import ResourceManager
+
+    mgr = ResourceManager(ClusterCoordinator(),
+                          os.path.join(work_dir, "ds"),
+                          maintain_broker_resource=False)
+    stale = "http://127.0.0.1:1111/deepstore/t/s0"
+    assert mgr.resolve_download_path(stale) == stale     # no base yet
+    mgr.store.set("/CONTROLLER/DEEPSTORE_BASE",
+                  {"base": "http://127.0.0.1:2222"})
+    assert mgr.resolve_download_path(stale) == \
+        "http://127.0.0.1:2222/deepstore/t/s0"
+    assert mgr.resolve_download_path("/shared/fs/t/s0") == \
+        "/shared/fs/t/s0"
+
+
+def test_corrupt_download_is_never_served(http_cluster, work_dir):
+    """Deep-store corruption: the download fails verification, the
+    replica goes ERROR (not serving), and the response flags the gap —
+    corrupt rows never reach a query result."""
+    import shutil
+
+    ctrl = http_cluster["ctrl"]
+    srv = http_cluster["add_server"]()
+    mgr = ctrl.controller.manager
+    mgr.add_schema(make_schema())
+    mgr.add_table(make_table_config())
+    d = os.path.join(work_dir, "cseg")
+    os.makedirs(d, exist_ok=True)
+    build_segment(d, n=1_000, seed=90, name="corrupt_0")
+    mgr.add_segment(TABLE, d)
+    assert wait_until(lambda: len(
+        srv.server.data_manager.table(TABLE, create=True)
+        .segment_names()) == 1)
+    # crash the server, lose its local cache, and corrupt the deep-store
+    # artifact — the restarted server must re-download and refuse it
+    srv.kill()
+    http_cluster["servers"].remove(srv)
+    shutil.rmtree(os.path.join(work_dir, "Server_0_work", "fetched"))
+    _tamper(mgr.canonical_artifact_path(TABLE, "corrupt_0"))
+    srv2 = http_cluster["add_server"]()
+
+    def replica_errored():
+        view = ctrl.controller.coordinator.external_view(TABLE)
+        return view.segment_states.get("corrupt_0", {}).get(
+            "Server_0") == ERROR
+
+    assert wait_until(replica_errored, timeout=30)
+    # not serving: the segment has no live replica
+    view = ctrl.controller.coordinator.external_view(TABLE)
+    assert view.servers_for("corrupt_0") == []
+    # the corrupt download was quarantined instead of loaded
+    tdm = srv2.server.data_manager.table(TABLE)
+    assert tdm is None or "corrupt_0" not in tdm.segment_names()
+    q_root = os.path.join(work_dir, "Server_0_work", "quarantine")
+    assert os.path.isdir(q_root) and len(os.listdir(q_root)) >= 1
+
+
+def test_scrubber_quarantines_corrupt_artifact_and_sweeps_orphans(
+        work_dir):
+    from pinot_tpu.common.metrics import ControllerMeter, MetricsRegistry
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        for i in range(2):
+            d = os.path.join(work_dir, f"sseg{i}")
+            os.makedirs(d, exist_ok=True)
+            build_segment(d, n=1_000, seed=30 + i, name=f"scrub_{i}")
+            cluster.upload_segment(TABLE, d)
+        assert wait_until(lambda: _count(cluster) == 2_000)
+        mgr = cluster.controller.manager
+        _tamper(mgr.canonical_artifact_path(TABLE, "scrub_0"))
+        orphan = os.path.join(mgr.deep_store_dir, TABLE, "orphan_seg")
+        os.makedirs(orphan)
+        metrics = MetricsRegistry("controller")
+        # age everything past the orphan grace window
+        checker = SegmentIntegrityChecker(
+            metrics=metrics, now_fn=lambda: time.time() + 3600)
+        checker.run(mgr)
+        report = checker.last_report[TABLE]
+        assert report["corrupt"] == ["scrub_0"]
+        assert report["orphansDeleted"] == ["orphan_seg"]
+        assert not os.path.exists(orphan)
+        q = os.path.join(mgr.deep_store_dir, "quarantine")
+        assert os.path.isdir(q) and "scrub_0" in os.listdir(q)
+        assert not os.path.isdir(
+            mgr.canonical_artifact_path(TABLE, "scrub_0"))
+        assert metrics.meter(ControllerMeter.CORRUPT_SEGMENTS).count == 1
+        assert metrics.meter(
+            ControllerMeter.ORPHAN_ARTIFACTS_DELETED).count == 1
+        # the already-loaded (verified) replica keeps serving
+        assert _count(cluster) == 2_000
+    finally:
+        cluster.stop()
+
+
+class _FlakyLoadModel(StateModel):
+    """Participant whose segment load keeps failing (corrupt replica)."""
+
+    def __init__(self, fail=True):
+        self.fail = fail
+        self.loads = 0
+
+    def on_become_online(self, table, segment):
+        self.loads += 1
+        if self.fail:
+            raise RuntimeError("simulated corrupt local artifact")
+
+
+def test_scrubber_repairs_error_replica_bounce_then_reassign(work_dir):
+    from pinot_tpu.controller.manager import ResourceManager, SEGMENTS
+    coord = ClusterCoordinator()
+    mgr = ResourceManager(coord, os.path.join(work_dir, "ds"),
+                          maintain_broker_resource=False)
+    flaky, healthy = _FlakyLoadModel(), _FlakyLoadModel(fail=False)
+    coord.register_participant("flaky", flaky)
+    coord.register_participant("healthy", healthy)
+    mgr.add_schema(make_schema())
+    mgr.add_table(make_table_config())
+    mgr.store.set(f"{SEGMENTS}/{TABLE}/s0", {"segmentName": "s0"})
+    coord.set_ideal_state(TABLE, {"s0": {"flaky": ONLINE}})
+    assert coord.external_view(TABLE).segment_states["s0"]["flaky"] == ERROR
+
+    checker = SegmentIntegrityChecker()
+    # bounce 1 and 2: re-download attempts on the same replica
+    for attempt in range(checker.MAX_BOUNCES):
+        checker.run(mgr)
+        assert checker.last_report[TABLE]["repaired"] == ["s0:flaky"]
+        assert coord.external_view(TABLE).segment_states["s0"]["flaky"] \
+            == ERROR
+    # third run: gives up on the replica, moves it to the healthy server
+    checker.run(mgr)
+    assert checker.last_report[TABLE]["reassigned"] == \
+        ["s0:flaky->healthy"]
+    view = coord.external_view(TABLE).segment_states["s0"]
+    assert view.get("healthy") == ONLINE
+    assert "flaky" not in coord.ideal_state(TABLE)["s0"]
+    assert healthy.loads == 1
+
+
+def test_upload_rejects_artifact_that_does_not_match_its_crc(work_dir):
+    from pinot_tpu.controller.manager import ResourceManager
+    from pinot_tpu.segment.integrity import SegmentIntegrityError
+    coord = ClusterCoordinator()
+    mgr = ResourceManager(coord, os.path.join(work_dir, "ds"),
+                          maintain_broker_resource=False)
+    coord.register_participant("i0", StateModel())
+    mgr.add_schema(make_schema())
+    mgr.add_table(make_table_config())
+    d = os.path.join(work_dir, "seg")
+    os.makedirs(d)
+    build_segment(d, n=500, seed=5, name="bad_0")
+    _tamper(d)          # bytes no longer match the stamped crc
+    with pytest.raises(SegmentIntegrityError):
+        mgr.add_segment(TABLE, d)
+    assert mgr.segment_names(TABLE) == []
+
+
+def test_crash_after_download_revalidates_on_restart(work_dir,
+                                                     http_cluster):
+    """Seeded mid-download crash: the process dies right after the
+    artifact lands; the restarted server re-validates the cached bytes
+    before serving them."""
+    from pinot_tpu.common.metrics import ServerMeter
+    ctrl = http_cluster["ctrl"]
+    srv = http_cluster["add_server"]()
+    mgr = ctrl.controller.manager
+    mgr.add_schema(make_schema())
+    mgr.add_table(make_table_config())
+    d = os.path.join(work_dir, "dseg")
+    os.makedirs(d, exist_ok=True)
+    build_segment(d, n=1_000, seed=21, name="dl_0")
+    crash_points.arm("server.post_download")
+    mgr.add_segment(TABLE, d)
+    assert wait_until(lambda: crash_points.fired.get("server.post_download"))
+    # transition died with the "process"; replica is ERROR, nothing served
+    srv.kill()
+    http_cluster["servers"].remove(srv)
+    srv2 = http_cluster["add_server"]()
+    # the interrupted download was complete: verified + reused
+    assert srv2.recovery_report["valid"] == [(TABLE, "dl_0")]
+    assert wait_until(lambda: len(
+        srv2.server.data_manager.table(TABLE, create=True)
+        .segment_names()) == 1)
+    assert srv2.server.metrics.meter(
+        ServerMeter.SEGMENT_LOCAL_RELOADS).count == 1

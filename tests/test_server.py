@@ -441,6 +441,12 @@ def test_deep_store_fetch_retries_transient_failures(tmp_path):
         class _Srv:
             instance_id = "s0"
         part.server = _Srv()
+
+        class _Mgr:          # no controller in this unit: identity resolve
+            @staticmethod
+            def resolve_download_path(p):
+                return p
+        part.manager = _Mgr()
         local = part._fetch_segment_dir(
             "t_OFFLINE", "seg0", "flaky://deep/t/seg0")
         assert os.path.isfile(os.path.join(local, "ok"))

@@ -162,6 +162,110 @@ def test_bind_conflict_raises_instead_of_hanging(server):
         s2.start()
 
 
+def test_watches_survive_peer_session_death_and_reconnect(server):
+    """One client's session death must not tear down other clients'
+    watches, and a reconnecting client re-registers its watches and
+    receives subsequent events."""
+    observer, writer = _client(server), _client(server)
+    try:
+        events = []
+        got = threading.Event()
+
+        def cb(path, rec):
+            events.append((path, rec))
+            got.set()
+
+        observer.watch("/SEGMENTS/", cb)
+        writer.set("/SEGMENTS/t/s0", {"i": 0})
+        assert got.wait(5)
+        # the writer's session dies; the observer's watch must survive
+        writer.close()
+        got.clear()
+        writer2 = _client(server)
+        writer2.set("/SEGMENTS/t/s1", {"i": 1})
+        assert got.wait(5), "watch died with an unrelated session"
+        assert ("/SEGMENTS/t/s1", {"i": 1}) in events
+        # the observer reconnects: a fresh session re-registers the
+        # watch and receives events again
+        observer.close()
+        observer2 = _client(server)
+        events2 = []
+        got2 = threading.Event()
+        observer2.watch("/SEGMENTS/", lambda p, r: (events2.append((p, r)),
+                                                    got2.set()))
+        writer2.set("/SEGMENTS/t/s2", {"i": 2})
+        assert got2.wait(5)
+        assert events2[-1] == ("/SEGMENTS/t/s2", {"i": 2})
+        observer2.close()
+        writer2.close()
+    finally:
+        pass
+
+
+def test_session_death_mid_update_applies_at_most_once(server):
+    """The mutation lands but the confirmation is lost (connection dies
+    between the server applying a CAS and the response frame): the
+    client's update() must RAISE — never silently retry into a double
+    apply — and a reconnected session sees exactly one application."""
+    c = _client(server)
+    c.set("/counter", {"n": 0})
+    orig_cas = server.store.cas
+
+    def killing_cas(path, expected, record, ephemeral=False):
+        applied = orig_cas(path, expected, record, ephemeral=ephemeral)
+        # runs on the server's event-loop thread: abort the transport
+        # before the response can be written
+        for conn in list(server.connections):
+            conn.writer.transport.abort()
+        return applied
+
+    server.store.cas = killing_cas
+    try:
+        with pytest.raises((StoreClosedError, RuntimeError, OSError)):
+            c.update("/counter",
+                     lambda rec: {"n": (rec or {"n": 0})["n"] + 1})
+    finally:
+        server.store.cas = orig_cas
+    c.close()
+    # a fresh session observes the mutation applied exactly once, and an
+    # explicit caller-level retry applies exactly once more
+    c2 = _client(server)
+    try:
+        assert c2.get("/counter") == {"n": 1}
+        c2.update("/counter", lambda rec: {"n": rec["n"] + 1})
+        assert c2.get("/counter") == {"n": 2}
+    finally:
+        c2.close()
+
+
+def test_ephemeral_set_then_durable_set_keeps_durability(tmp_path):
+    """A durable set over a path previously written ephemeral makes the
+    record durable again (and vice versa the ephemeral shadow is not
+    replayed) — the journaling follows the LATEST write's class."""
+    d = str(tmp_path / "store")
+    s = PropertyStore(data_dir=d)
+    s.set("/FLAGS/x", {"v": 1}, ephemeral=True)
+    s.set("/FLAGS/x", {"v": 2})              # now durable
+    s.set("/FLAGS/y", {"v": 3})
+    s.set("/FLAGS/y", {"v": 4}, ephemeral=True)   # durable shadowed
+    # update() and cas() follow the same latest-write-wins class rules
+    s.set("/FLAGS/u", {"v": 5}, ephemeral=True)
+    s.update("/FLAGS/u", lambda old: {"v": 6})    # durable again
+    s.set("/FLAGS/c", {"v": 7}, ephemeral=True)
+    assert s.cas("/FLAGS/c", {"v": 7}, {"v": 8})  # durable again
+    s.set("/FLAGS/cz", {"v": 9})
+    assert s.cas("/FLAGS/cz", {"v": 9}, {"v": 10},
+                 ephemeral=True)                  # durable shadowed
+    s.close()
+    r = PropertyStore(data_dir=d)
+    assert r.get("/FLAGS/x") == {"v": 2}
+    assert r.get("/FLAGS/y") is None
+    assert r.get("/FLAGS/u") == {"v": 6}
+    assert r.get("/FLAGS/c") == {"v": 8}
+    assert r.get("/FLAGS/cz") is None
+    r.close()
+
+
 def test_malformed_frame_keeps_connection_alive(server):
     import json
     import socket
