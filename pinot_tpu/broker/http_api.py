@@ -15,7 +15,8 @@ from pinot_tpu.broker.request_handler import BrokerRequestHandler
 from pinot_tpu.broker.routing import RoutingError
 from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table, table_type)
-from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
+from pinot_tpu.transport.http import (ApiServer, HttpRequest, HttpResponse,
+                                      metrics_response)
 
 
 class BrokerApiServer(ApiServer):
@@ -34,6 +35,12 @@ class BrokerApiServer(ApiServer):
                         self._debug_routing)
         self.router.add("GET", "/debug/timeBoundary/{table}",
                         self._debug_time_boundary)
+        # rolling per-table operator stats (obs profiler) + slow-log
+        # status
+        self.router.add("GET", "/debug/tableStats", self._table_stats)
+        self.router.add("GET", "/debug/tableStats/{table}",
+                        self._table_stats)
+        self.router.add("GET", "/debug/slowLog", self._slow_log)
 
     @staticmethod
     def _identity(request: HttpRequest) -> RequesterIdentity:
@@ -73,7 +80,34 @@ class BrokerApiServer(ApiServer):
         return HttpResponse(200, b"OK", content_type="text/plain")
 
     async def _metrics(self, request: HttpRequest) -> HttpResponse:
-        return HttpResponse.of_json(self.handler.metrics.snapshot())
+        return metrics_response(self.handler.metrics, request)
+
+    async def _table_stats(self, request: HttpRequest) -> HttpResponse:
+        """Rolling operator stats honor the same ACL as the other
+        debug views — per-table scan counts and recent query profiles
+        are table metadata. The all-tables view filters to what the
+        caller may see rather than denying outright."""
+        table = request.path_params.get("table")
+        if table is not None:
+            denied = self._check_debug_access(request, table)
+            if denied is not None:
+                return denied
+            return HttpResponse.of_json(
+                self.handler.table_stats.snapshot(table))
+        # filter by ACL FIRST, then copy only the visible tables —
+        # snapshotting everything just to discard denied entries would
+        # deep-copy their 64-profile rings for nothing
+        stats = self.handler.table_stats
+        allowed = {t: stats.snapshot(t)
+                   for t in stats.table_names()
+                   if self._check_debug_access(request, t) is None}
+        return HttpResponse.of_json(allowed)
+
+    async def _slow_log(self, request: HttpRequest) -> HttpResponse:
+        sl = self.handler.slow_log
+        if sl is None:
+            return HttpResponse.of_json({"enabled": False})
+        return HttpResponse.of_json({"enabled": True, **sl.stats()})
 
     def _check_debug_access(self, request: HttpRequest, table: str):
         """Debug views honor the same access-control SPI as /query —

@@ -25,7 +25,10 @@ from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.common.serde import instance_request_to_bytes
-from pinot_tpu.common.trace import Trace, make_trace
+from pinot_tpu.obs.slowlog import SlowQueryLog
+from pinot_tpu.obs.profiler import TableStatsAggregator
+from pinot_tpu.obs.tracing import (TraceContext, build_trace_tree,
+                                   make_trace_context)
 from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table)
 from pinot_tpu.broker.fault_tolerance import FaultToleranceManager
@@ -154,12 +157,18 @@ class QueryRouter:
                      routes: List[Tuple[BrokerRequest, Dict[str,
                                                             List[str]]]],
                      timeout: float, enable_trace: bool = False,
-                     deadline: Optional[float] = None
+                     deadline: Optional[float] = None,
+                     trace: Optional[TraceContext] = None,
+                     parent_span_id: Optional[str] = None
                      ) -> Tuple[List[DataTable], int, int, List[dict]]:
         """routes: [(per-table request, {server: segments})] — returns
         (tables, num_queried, num_responded, errors). `deadline` is an
         absolute clock() instant shared by retries so re-dispatches
-        never extend user-visible latency past the requested timeout."""
+        never extend user-visible latency past the requested timeout.
+        `trace`/`parent_span_id`: every dispatch (primary, hedge,
+        failover) records a span under the scatter phase and stamps its
+        own span id into the InstanceRequest as the server subtree's
+        parent."""
         if deadline is None:
             deadline = self._clock() + timeout
         units = []
@@ -168,7 +177,8 @@ class QueryRouter:
                 units.append((sub_request, server, segments))
         outcomes = await asyncio.gather(
             *(self._query_unit(request_id, sub, server, segments,
-                               deadline, enable_trace)
+                               deadline, enable_trace, trace,
+                               parent_span_id)
               for sub, server, segments in units))
         tables: List[DataTable] = []
         errors: List[dict] = []
@@ -183,7 +193,9 @@ class QueryRouter:
     # -- one dispatch unit --------------------------------------------------
     async def _query_unit(self, request_id: int, sub: BrokerRequest,
                           server: str, segments: List[str],
-                          deadline: float, enable_trace: bool):
+                          deadline: float, enable_trace: bool,
+                          trace: Optional[TraceContext] = None,
+                          parent_span_id: Optional[str] = None):
         errors: List[dict] = []
         tried = {server}
         tables: List[DataTable] = []
@@ -192,7 +204,8 @@ class QueryRouter:
         # CircuitBreakerOpen there and falls through to failover
         dt = await self._dispatch_hedged(request_id, sub, server,
                                          segments, deadline,
-                                         enable_trace, errors, tried)
+                                         enable_trace, errors, tried,
+                                         trace, parent_span_id)
         if dt is not None:
             for e in errors:         # e.g. primary failed, hedge won
                 e["recovered"] = True
@@ -211,7 +224,8 @@ class QueryRouter:
             items = sorted(groups.items())
             results = await asyncio.gather(
                 *(self._call_once(request_id, sub, srv, segs, deadline,
-                                  enable_trace, errors)
+                                  enable_trace, errors, trace,
+                                  parent_span_id)
                   for srv, segs in items))
             next_remaining: List[str] = []
             for (srv, segs), dt in zip(items, results):
@@ -229,12 +243,13 @@ class QueryRouter:
         return tables, errors
 
     async def _dispatch_hedged(self, request_id, sub, server, segments,
-                               deadline, enable_trace, errors, tried):
+                               deadline, enable_trace, errors, tried,
+                               trace=None, parent_span_id=None):
         """Primary call with a latency hedge to one replica."""
         ft = self.fault_tolerance
         primary = asyncio.ensure_future(self._call_once(
             request_id, sub, server, segments, deadline, enable_trace,
-            errors))
+            errors, trace, parent_span_id))
         hedge_after = ft.hedge_delay_s(server) if ft is not None else None
         if hedge_after is None:
             return await primary
@@ -250,7 +265,7 @@ class QueryRouter:
         ft.on_hedge(server)
         hedge = asyncio.ensure_future(self._call_once(
             request_id, sub, hedge_server, segments, deadline,
-            enable_trace, errors))
+            enable_trace, errors, trace, parent_span_id))
         pending = {primary, hedge}
         winner = None
         while pending and winner is None:
@@ -262,10 +277,17 @@ class QueryRouter:
                     winner = dt
         for t in pending:
             t.cancel()       # loser keeps running server-side; drop it
+        if pending:
+            # AWAIT the cancelled losers: their CancelledError handlers
+            # patch the dispatch span (ms + attrs.cancelled), and those
+            # dicts must be settled before _finish serializes the trace
+            # tree on another thread
+            await asyncio.wait(pending)
         return winner
 
     async def _call_once(self, request_id, sub, server, segments,
-                         deadline, enable_trace, errors):
+                         deadline, enable_trace, errors, trace=None,
+                         parent_span_id=None):
         """One dispatch to one server; stamps the remaining budget,
         classifies failures, feeds the health/breaker state."""
         ft = self.fault_tolerance
@@ -282,17 +304,34 @@ class QueryRouter:
                 server, "DeadlineExceededError: no budget left to "
                 f"dispatch to {server}"))
             return None
+        # the dispatch span is created BEFORE the send so its id can
+        # travel in the request as the server subtree's parent link;
+        # concurrent dispatches of one query share an event-loop thread,
+        # so parenting is explicit (parent_span_id), never stack-based.
+        # ms is patched in when the reply lands (same dict object).
+        dspan = None
+        if trace is not None and trace.enabled:
+            dspan = trace.record(f"dispatch:{server}", 0.0,
+                                 parent_id=parent_span_id,
+                                 segments=len(segments))
         payload = instance_request_to_bytes(InstanceRequest(
             request_id=request_id, query=sub, search_segments=segments,
             broker_id=self.broker_id, enable_trace=enable_trace,
-            deadline_budget_ms=budget * 1e3))
+            deadline_budget_ms=budget * 1e3,
+            trace_id=trace.trace_id if dspan is not None else None,
+            parent_span_id=dspan["spanId"] if dspan is not None else None))
         t0 = self._clock()
         try:
             raw = await asyncio.wait_for(
                 self.transport.query(server, payload, budget), budget)
             dt = DataTable.from_bytes(raw)
         except asyncio.CancelledError:
-            raise                       # hedge loser / caller teardown
+            # hedge loser / caller teardown: mark the span so the tree
+            # shows an abandoned dispatch, not a 0ms "success"
+            if dspan is not None:
+                dspan["ms"] = round((self._clock() - t0) * 1e3, 3)
+                dspan.setdefault("attrs", {})["cancelled"] = True
+            raise
         except Exception as e:  # noqa: BLE001 — classified, never silent
             self.metrics.meter(BrokerMeter.SERVER_ERRORS).mark()
             self.metrics.meter(BrokerMeter.SERVER_ERRORS,
@@ -302,7 +341,12 @@ class QueryRouter:
             kind = "ServerTimeoutError" if \
                 isinstance(e, asyncio.TimeoutError) else type(e).__name__
             errors.append(_server_error(server, f"{kind}: {e}"))
+            if dspan is not None:
+                dspan["ms"] = round((self._clock() - t0) * 1e3, 3)
+                dspan.setdefault("attrs", {})["error"] = kind
             return None
+        if dspan is not None:
+            dspan["ms"] = round((self._clock() - t0) * 1e3, 3)
         if ft is not None:
             ft.on_success(server, (self._clock() - t0) * 1e3)
         dt.metadata.setdefault("serverName", server)
@@ -370,12 +414,27 @@ class BrokerRequestHandler:
                  metrics: Optional[MetricsRegistry] = None,
                  access_control=None,
                  segment_pruner=None,
-                 fault_tolerance: Optional[FaultToleranceManager] = None):
+                 fault_tolerance: Optional[FaultToleranceManager] = None,
+                 slow_log: Optional[SlowQueryLog] = None):
         # optional broker-side segment pruner (PartitionZKMetadataPruner):
         # prune(request, table, segments) -> segments
         self.segment_pruner = segment_pruner
         self.routing = routing
         self.metrics = metrics or MetricsRegistry("broker")
+        # sampling JSONL slow-query log (obs/slowlog.py); default: the
+        # PINOT_TPU_SLOWLOG* env config, None = disabled
+        self.slow_log = slow_log if slow_log is not None else \
+            SlowQueryLog.from_env()
+        # rolling per-table operator stats folded from every query's
+        # server-side profile (obs/profiler.py)
+        self.table_stats = TableStatsAggregator()
+        # pre-register the core series so /metrics serves a meaningful
+        # exposition from boot (a counter that exists at 0 beats one
+        # that appears after the first query) and export uptime
+        self._t_boot = time.monotonic()
+        self.metrics.meter(BrokerMeter.QUERIES)
+        self.metrics.gauge("uptimeSeconds").set_callable(
+            lambda: time.monotonic() - self._t_boot)
         self.fault_tolerance = fault_tolerance or FaultToleranceManager(
             metrics=self.metrics)
         self.router = QueryRouter(transport, broker_id,
@@ -413,13 +472,15 @@ class BrokerRequestHandler:
         tables, queried, responded, errors = loop.run(
             self._scatter(request, trace, routes, timeout_s, deadline))
         return self._finish(request, trace, t0, tables, queried,
-                            responded, errors)
+                            responded, errors, pql=pql)
 
     def close(self) -> None:
         if self._loop is not None:
             self._loop.run(self.router.transport.close())
             self._loop.stop()
             self._loop = None
+        if self.slow_log is not None:
+            self.slow_log.close()
 
     async def handle_async(self, pql: str, identity=None,
                            force_trace: bool = False) -> BrokerResponse:
@@ -430,7 +491,7 @@ class BrokerRequestHandler:
         tables, queried, responded, errors = await self._scatter(
             request, trace, routes, timeout_s, deadline)
         return self._finish(request, trace, t0, tables, queried,
-                            responded, errors)
+                            responded, errors, pql=pql)
 
     # -- pipeline stages ---------------------------------------------------
     def _prepare(self, pql: str, identity, force_trace: bool):
@@ -452,7 +513,7 @@ class BrokerRequestHandler:
         compile_ms = (time.perf_counter() - t) * 1e3
         self.metrics.timer(BrokerQueryPhase.REQUEST_COMPILATION).update(
             compile_ms)
-        trace = make_trace(request.query_options.trace)
+        trace = make_trace_context(request.query_options.trace)
         trace.record(BrokerQueryPhase.REQUEST_COMPILATION, compile_ms)
 
         with self.metrics.timer(BrokerQueryPhase.AUTHORIZATION).time(), \
@@ -486,28 +547,31 @@ class BrokerRequestHandler:
         deadline = time.monotonic() + timeout_s
         return request, trace, routes, timeout_s, deadline, t0
 
-    async def _scatter(self, request: BrokerRequest, trace: Trace, routes,
-                       timeout_s: float, deadline: float):
+    async def _scatter(self, request: BrokerRequest, trace: TraceContext,
+                       routes, timeout_s: float, deadline: float):
         """Async network stage: dispatch + gather + missing-segment
         retry. The only stage that runs on the shared event loop."""
         with self.metrics.timer(BrokerQueryPhase.SCATTER_GATHER).time(), \
-                trace.span(BrokerQueryPhase.SCATTER_GATHER):
+                trace.span(BrokerQueryPhase.SCATTER_GATHER) as sg:
+            sg_id = sg["spanId"] if sg is not None else None
             tables, queried, responded, errors = await self.router.submit(
                 next(self._request_ids), routes, timeout_s,
                 enable_trace=request.query_options.trace,
-                deadline=deadline)
+                deadline=deadline, trace=trace, parent_span_id=sg_id)
             tables, rq, rr, retry_errors = \
                 await self._retry_missing_segments(
                     routes, tables, deadline,
-                    enable_trace=request.query_options.trace)
+                    enable_trace=request.query_options.trace,
+                    trace=trace, parent_span_id=sg_id)
             queried += rq
             responded += rr
             errors += retry_errors
         return tables, queried, responded, errors
 
-    def _finish(self, request: BrokerRequest, trace: Trace, t0: float,
-                tables: List[DataTable], queried: int, responded: int,
-                errors: List[dict]) -> BrokerResponse:
+    def _finish(self, request: BrokerRequest, trace: TraceContext,
+                t0: float, tables: List[DataTable], queried: int,
+                responded: int, errors: List[dict],
+                pql: Optional[str] = None) -> BrokerResponse:
         """Sync CPU stage: reduce + failure surfacing + trace merge."""
         if responded < queried:
             self.metrics.meter(
@@ -537,25 +601,78 @@ class BrokerRequestHandler:
             resp.time_used_ms)
         self.metrics.meter(BrokerMeter.DOCUMENTS_SCANNED).mark(
             resp.num_docs_scanned)
+        self._fold_profiles(request, tables, resp.time_used_ms)
         if request.query_options.trace:
+            trace.finish_root()
             resp.trace_info = {"broker": trace.to_list()}
+            merged = trace.to_list()
             for dt in tables:
                 server_trace = dt.metadata.get("traceInfo")
                 if not server_trace:
                     continue
                 try:
-                    spans = Trace.from_json_str(server_trace).to_list()
+                    spans = TraceContext.from_json_str(
+                        server_trace).to_list()
                 except Exception:  # noqa: BLE001 — skewed/corrupt metadata
                     continue       # a bad trace must not fail the query
                 name = dt.metadata.get("serverName", "server")
+                for s in spans:
+                    s.setdefault("server", name)
                 # hybrid tables: one server answers both the OFFLINE and
                 # REALTIME sub-requests — merge, don't overwrite
                 resp.trace_info.setdefault(name, []).extend(spans)
+                merged.extend(spans)
+            # ONE cross-process tree: each server subtree hangs off the
+            # dispatch span whose id the broker stamped into its request
+            resp.trace_tree = build_trace_tree(merged, trace.trace_id)
+        if self.slow_log is not None:
+            self.slow_log.maybe_log(resp.time_used_ms, {
+                "table": raw_table(request.table_name),
+                "pql": pql,
+                "traceId": trace.trace_id,
+                "numDocsScanned": resp.num_docs_scanned,
+                "numSegmentsMatched": resp.num_segments_matched,
+                "numServersQueried": queried,
+                "numServersResponded": responded,
+                "partialResponse": resp.partial_response,
+                "exceptions": len(resp.exceptions)})
         return resp
+
+    def _fold_profiles(self, request: BrokerRequest,
+                       tables: List[DataTable],
+                       time_used_ms: float) -> None:
+        """Merge every server's per-query operator profile into one
+        query-level record on the rolling per-table stats."""
+        merged: Optional[dict] = None
+        for dt in tables:
+            raw = dt.metadata.get("profileInfo")
+            if not raw:
+                continue
+            try:
+                p = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(p, dict):
+                continue
+            if merged is None:
+                merged = p
+                continue
+            for k, v in p.items():
+                if k == "paths":
+                    paths = merged.setdefault("paths", {})
+                    for path, n in (v or {}).items():
+                        paths[path] = paths.get(path, 0) + int(n)
+                elif isinstance(v, (int, float)):
+                    merged[k] = merged.get(k, 0) + v
+        if merged is not None:
+            self.table_stats.record(raw_table(request.table_name),
+                                    merged, time_used_ms)
 
     async def _retry_missing_segments(self, routes, tables,
                                       deadline: float,
-                                      enable_trace: bool = False):
+                                      enable_trace: bool = False,
+                                      trace: Optional[TraceContext] = None,
+                                      parent_span_id: Optional[str] = None):
         """One re-dispatch of segments a server reported missing.
 
         A routing table sampled just before a rebalance drop step / a
@@ -632,7 +749,8 @@ class BrokerRequestHandler:
         remaining_s = max(deadline - time.monotonic(), 0.0)
         retry_tables, rq, rr, errors = await self.router.submit(
             next(self._request_ids), retry_routes, remaining_s,
-            enable_trace=enable_trace, deadline=deadline)
+            enable_trace=enable_trace, deadline=deadline, trace=trace,
+            parent_span_id=parent_span_id)
         return tables + retry_tables, rq, rr, errors
 
     def _pruned_route(self, sub_request: BrokerRequest, table: str

@@ -12,11 +12,12 @@ reservoir for percentiles instead of an exponentially-decaying sample.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -65,21 +66,36 @@ class Gauge:
 
 
 class Timer:
-    """Duration metric: count, total, mean, and reservoir percentiles."""
+    """Duration metric: count, total, mean, reservoir percentiles, and
+    bounded log-scale histogram buckets (Prometheus exposition)."""
 
     RESERVOIR = 1024
+    # log-scale millisecond bucket upper bounds: 0.25ms … ~131s in ×2
+    # steps (20 buckets + overflow). Bounded and fixed, so exposition
+    # output size and update cost are O(1) regardless of traffic.
+    BUCKET_BOUNDS_MS: Tuple[float, ...] = tuple(
+        0.25 * 2 ** i for i in range(20))
 
     def __init__(self) -> None:
         self._count = 0
         self._total_ms = 0.0
         self._samples: deque = deque(maxlen=self.RESERVOIR)
+        self._buckets = [0] * (len(self.BUCKET_BOUNDS_MS) + 1)
+        # percentile memo per requested tuple: ps -> (count at compute
+        # time, values); a snapshot with no new updates since the last
+        # one never re-runs np.percentile, and the hedge path's p95
+        # probe doesn't thrash the snapshot's (50, 95, 99) entry
+        self._pct_cache: Dict[Tuple[float, ...],
+                              Tuple[int, List[float]]] = {}
         self._lock = threading.Lock()
 
     def update(self, ms: float) -> None:
+        idx = bisect.bisect_left(self.BUCKET_BOUNDS_MS, ms)
         with self._lock:
             self._count += 1
             self._total_ms += ms
             self._samples.append(ms)
+            self._buckets[idx] += 1
 
     @contextmanager
     def time(self):
@@ -102,10 +118,31 @@ class Timer:
         return self._total_ms / self._count if self._count else 0.0
 
     def percentile_ms(self, p: float) -> float:
+        return self.percentiles_ms((p,))[0]
+
+    def percentiles_ms(self, ps: Sequence[float]) -> List[float]:
+        """All requested percentiles in ONE np.percentile batch,
+        memoized on the sample count — repeated snapshot()/exposition
+        reads between updates cost a dict lookup, not an array sort."""
+        ps = tuple(ps)
         with self._lock:
+            hit = self._pct_cache.get(ps)
+            if hit is not None and hit[0] == self._count:
+                return list(hit[1])
             if not self._samples:
-                return 0.0
-            return float(np.percentile(np.asarray(self._samples), p))
+                return [0.0] * len(ps)
+            vals = [float(v) for v in
+                    np.percentile(np.asarray(self._samples), ps)]
+            if len(self._pct_cache) > 8:     # bounded: ps tuples are few
+                self._pct_cache.clear()
+            self._pct_cache[ps] = (self._count, vals)
+            return list(vals)
+
+    def bucket_counts(self) -> List[int]:
+        """Per-bucket (non-cumulative) counts; the last entry is the
+        overflow bucket (> BUCKET_BOUNDS_MS[-1])."""
+        with self._lock:
+            return list(self._buckets)
 
 
 class MetricsRegistry:
@@ -135,21 +172,41 @@ class MetricsRegistry:
                 m = store[key] = cls()
             return m
 
-    def snapshot(self) -> dict:
-        """Flat JSON-able view of every registered metric."""
+    SNAPSHOT_PERCENTILES = (50.0, 95.0, 99.0)
+
+    def metric_maps(self) -> Tuple[Dict[str, Meter], Dict[str, Gauge],
+                                   Dict[str, Timer]]:
+        """Consistent shallow copies of the three metric maps (the
+        Prometheus exposition renderer iterates these)."""
         with self._lock:
-            meters = dict(self._meters)
-            gauges = dict(self._gauges)
-            timers = dict(self._timers)
+            return dict(self._meters), dict(self._gauges), \
+                dict(self._timers)
+
+    def snapshot(self) -> dict:
+        """Flat JSON-able view of every registered metric.
+
+        Timer percentiles are computed in one memoized np.percentile
+        batch per timer (keyed on the update count), and the bounded
+        log-scale histogram rides along as [upperBoundMs, count] pairs
+        (None bound = overflow bucket)."""
+        meters, gauges, timers = self.metric_maps()
         out: Dict[str, object] = {}
         for k, m in meters.items():
             out[f"meter.{k}.count"] = m.count
         for k, g in gauges.items():
             out[f"gauge.{k}"] = g.value
+        bounds = list(Timer.BUCKET_BOUNDS_MS) + [None]
         for k, t in timers.items():
             out[f"timer.{k}.count"] = t.count
             out[f"timer.{k}.totalMs"] = round(t.total_ms, 3)
             out[f"timer.{k}.meanMs"] = round(t.mean_ms, 3)
+            p50, p95, p99 = t.percentiles_ms(self.SNAPSHOT_PERCENTILES)
+            out[f"timer.{k}.p50Ms"] = round(p50, 3)
+            out[f"timer.{k}.p95Ms"] = round(p95, 3)
+            out[f"timer.{k}.p99Ms"] = round(p99, 3)
+            out[f"timer.{k}.buckets"] = [
+                [bound, n] for bound, n in zip(bounds, t.bucket_counts())
+                if n]
         return out
 
 

@@ -202,3 +202,9 @@ class InstanceRequest:
     # since the request arrived. None = no propagated deadline (the
     # server falls back to its own default timeout).
     deadline_budget_ms: Optional[float] = None
+    # distributed-tracing context (enable_trace only): the broker's
+    # trace id and the id of the dispatch span this server call belongs
+    # to — the server roots its span subtree under parent_span_id so
+    # the broker can merge one cross-process trace tree at reduce
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None

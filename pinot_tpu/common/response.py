@@ -63,7 +63,12 @@ class BrokerResponse:
     # exception messages
     partial_response: bool = False
     # trace=true responses: {"broker": [...spans], "<server>": [...spans]}
+    # (flat per-participant span lists; spans carry spanId/parentId)
     trace_info: Optional[Dict[str, list]] = None
+    # trace=true responses: ONE merged cross-process tree — broker
+    # compile/route/scatter/reduce spans with each server's queue-wait/
+    # plan/execute/serde subtree grafted under its dispatch span
+    trace_tree: Optional[dict] = None
 
     def to_json(self) -> dict:
         d = {
@@ -96,6 +101,8 @@ class BrokerResponse:
             d["selectionResults"] = self.selection_results.to_json()
         if self.trace_info is not None:
             d["traceInfo"] = self.trace_info
+        if self.trace_tree is not None:
+            d["traceTree"] = self.trace_tree
         return d
 
     def to_json_str(self) -> str:

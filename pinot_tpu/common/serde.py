@@ -134,6 +134,11 @@ def instance_request_to_bytes(r: InstanceRequest) -> bytes:
         # optional key: payloads from older brokers stay parseable and
         # payloads to older servers are ignored, not rejected
         d["deadlineBudgetMs"] = r.deadline_budget_ms
+    if r.trace_id is not None:
+        # optional for the same version-skew reason: the tracing
+        # context only travels when the query is traced
+        d["traceId"] = r.trace_id
+        d["parentSpanId"] = r.parent_span_id
     return json.dumps(d).encode("utf-8")
 
 
@@ -145,7 +150,9 @@ def instance_request_from_bytes(b: bytes) -> InstanceRequest:
         search_segments=d.get("searchSegments"),
         enable_trace=d.get("enableTrace", False),
         broker_id=d.get("brokerId", ""),
-        deadline_budget_ms=d.get("deadlineBudgetMs"))
+        deadline_budget_ms=d.get("deadlineBudgetMs"),
+        trace_id=d.get("traceId"),
+        parent_span_id=d.get("parentSpanId"))
 
 
 # ---------------------------------------------------------------------------

@@ -1,61 +1,17 @@
-"""Per-request trace: named phase spans collected along the query path.
+"""Compat shim — superseded by `pinot_tpu.obs.tracing`.
 
-Parity: pinot-core/.../util/trace/TraceContext.java:46 (request-scoped trace
-tree enabled by the query's `trace` option, serialized into response
-metadata) and the phase timings that BaseBrokerRequestHandler /
-ScheduledRequestHandler attach per query. We carry an explicit Trace object
-through the call path instead of a thread-registered context — the broker
-path is async and the server path hops a scheduler thread pool, so
-explicit threading is the honest structure.
+The flat phase-span list this module used to implement grew into the
+hierarchical distributed TraceContext (trace-id/span-id spans with
+parent links, broker→server propagation, merged trace tree at reduce).
+The old names keep working for anything still importing them; new code
+should import from `pinot_tpu.obs` directly.
 """
 from __future__ import annotations
 
-import json
-import time
-from contextlib import contextmanager
-from typing import Dict, List, Optional
-
-
-class Trace:
-    """Ordered (phase → milliseconds) spans for one request."""
-
-    def __init__(self) -> None:
-        self.spans: List[Dict[str, object]] = []
-
-    def record(self, name: str, ms: float) -> None:
-        self.spans.append({"name": name, "ms": round(ms, 3)})
-
-    @contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.record(name, (time.perf_counter() - t0) * 1e3)
-
-    def to_list(self) -> List[Dict[str, object]]:
-        return list(self.spans)
-
-    def to_json_str(self) -> str:
-        return json.dumps(self.spans)
-
-    @staticmethod
-    def from_json_str(s: str) -> "Trace":
-        t = Trace()
-        t.spans = json.loads(s)
-        return t
-
-
-class NoopTrace(Trace):
-    """Zero-cost stand-in when tracing is disabled."""
-
-    def record(self, name: str, ms: float) -> None:
-        pass
-
-    @contextmanager
-    def span(self, name: str):
-        yield
+from pinot_tpu.obs.tracing import (NoopTraceContext as NoopTrace,  # noqa: F401
+                                   TraceContext as Trace)
+from pinot_tpu.obs.tracing import make_trace_context
 
 
 def make_trace(enabled: bool) -> Trace:
-    return Trace() if enabled else NoopTrace()
+    return make_trace_context(enabled)

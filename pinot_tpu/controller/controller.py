@@ -37,6 +37,13 @@ class Controller:
         self.manager = ResourceManager(self.coordinator, deep_store_dir)
         self.realtime = RealtimeSegmentManager(self.manager)
         self.metrics = MetricsRegistry("controller")
+        # always-present cluster gauges (parity: ControllerMetrics'
+        # tableCount/segmentCount-style validation gauges) — /metrics is
+        # never empty, even before any periodic task ran
+        self.metrics.gauge("tableCount").set_callable(
+            lambda: len(self.manager.table_names()))
+        self.metrics.gauge("schemaCount").set_callable(
+            lambda: len(self.manager.store.children("/CONFIGS/SCHEMA")))
         # lead-controller gating for the periodic plane (parity:
         # ControllerLeadershipManager + ControllerPeriodicTask)
         self.leadership = ControllerLeadershipManager(self.store,

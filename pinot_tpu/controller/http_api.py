@@ -19,7 +19,8 @@ from pinot_tpu.common.table_config import TableConfig, TableType
 from pinot_tpu.controller.controller import Controller
 from pinot_tpu.controller.manager import InvalidTableConfigError
 from pinot_tpu.controller.quota import StorageQuotaExceededError
-from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
+from pinot_tpu.transport.http import (ApiServer, HttpRequest, HttpResponse,
+                                      metrics_response)
 
 
 # canonical home is common/segment_tar.py; re-exported here because the
@@ -39,6 +40,7 @@ class ControllerApiServer(ApiServer):
         router.add("GET", "/", self._console)
         router.add("GET", "/ui", self._cluster_ui)
         router.add("GET", "/health", self._health)
+        router.add("GET", "/metrics", self._metrics)
         router.add("GET", "/schemas", self._list_schemas)
         router.add("POST", "/schemas", self._add_schema)
         router.add("GET", "/schemas/{name}", self._get_schema)
@@ -115,6 +117,9 @@ class ControllerApiServer(ApiServer):
 
     async def _health(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse(200, b"OK", content_type="text/plain")
+
+    async def _metrics(self, request: HttpRequest) -> HttpResponse:
+        return metrics_response(self.controller.metrics, request)
 
     async def _list_schemas(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse.of_json(

@@ -191,10 +191,23 @@ class RemotePropertyStore:
         async def shutdown() -> None:
             self._reader_task.cancel()
             try:
+                await self._reader_task
+            except BaseException:  # noqa: BLE001 — incl. our own cancel
+                pass
+            try:
                 self._writer.close()
                 await self._writer.wait_closed()
             except Exception:  # noqa: BLE001
                 pass
+            # drain every in-flight send_and_wait so each pending
+            # future's StoreClosedError is RETRIEVED by its awaiter —
+            # stopping the loop first turned them into destroyed-pending
+            # tasks and never-retrieved futures at GC
+            tasks = [t for t in asyncio.all_tasks(self._loop)
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             self._loop.stop()
 
         try:
@@ -203,3 +216,9 @@ class RemotePropertyStore:
         except RuntimeError:
             pass
         self._events.put(None)
+        dispatcher = getattr(self, "_dispatcher", None)
+        if dispatcher is not None and \
+                dispatcher is not threading.current_thread():
+            dispatcher.join(timeout=5)
+        if not self._loop.is_running() and not self._loop.is_closed():
+            self._loop.close()

@@ -70,6 +70,13 @@ class _Connection:
                 await self.queue.put(resp)
         finally:
             writer_task.cancel()
+            try:
+                # let the drain task actually unwind — cancelling and
+                # abandoning it leaves a "Task was destroyed but it is
+                # pending!" if the loop stops right after
+                await writer_task
+            except BaseException:  # noqa: BLE001 — incl. our own cancel
+                pass
             self._cleanup()
 
     async def _drain(self) -> None:
@@ -199,13 +206,27 @@ class PropertyStoreServer:
         await conn.run()
 
     def stop(self) -> None:
-        def shutdown() -> None:
+        async def shutdown() -> None:
             if self._server is not None:
                 self._server.close()
             for conn in list(self.connections):
                 conn._cleanup()
+            # cancel every connection/drain task and WAIT for it to
+            # unwind before stopping the loop: stop() used to race the
+            # pending tasks, leaving them "destroyed but pending" and
+            # their exceptions unraisable at interpreter shutdown
+            tasks = [t for t in asyncio.all_tasks(self.loop)
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             self.loop.stop()
 
-        self.loop.call_soon_threadsafe(shutdown)
+        try:
+            asyncio.run_coroutine_threadsafe(shutdown(), self.loop)
+        except RuntimeError:
+            return                      # loop already gone
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if not self.loop.is_running() and not self.loop.is_closed():
+            self.loop.close()

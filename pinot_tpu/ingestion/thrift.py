@@ -36,19 +36,32 @@ class ThriftRecordReaderConfig:
 
     `fields` is either an ordered name sequence (ids 1..N, matching the
     reference's fieldForId(1..) probing) or an explicit {name: id} map.
+
+    `bytes_fields` names the fields whose wire STRING payload is
+    BINARY: thrift's binary protocol cannot distinguish `string` from
+    `binary` (both are type 11), so a binary payload that happens to be
+    valid UTF-8 would silently decode to `str` — per-row type
+    instability for a bytes column. Declaring the field here (or giving
+    the reader a schema whose column is BYTES) skips the decode
+    attempt entirely.
     """
 
-    def __init__(self, fields: Union[Sequence[str], Dict[str, int]]):
+    def __init__(self, fields: Union[Sequence[str], Dict[str, int]],
+                 bytes_fields: Sequence[str] = ()):
         if isinstance(fields, dict):
             self.field_ids = dict(fields)
         else:
             self.field_ids = {name: i + 1 for i, name in enumerate(fields)}
+        self.bytes_fields = set(bytes_fields)
 
 
 class _BinaryProtocolReader:
-    def __init__(self, buf: bytes):
+    def __init__(self, buf: bytes, binary_fids: frozenset = frozenset()):
         self.buf = buf
         self.pos = 0
+        # top-level field ids whose STRING payload is declared BINARY:
+        # returned as raw bytes, never utf-8 decoded
+        self.binary_fids = binary_fids
 
     def _take(self, n: int) -> bytes:
         b = self.buf[self.pos: self.pos + n]
@@ -57,7 +70,7 @@ class _BinaryProtocolReader:
         self.pos += n
         return b
 
-    def read_value(self, ttype: int):
+    def read_value(self, ttype: int, binary: bool = False):
         if ttype == BOOL:
             return self._take(1)[0] != 0
         if ttype == BYTE:
@@ -73,12 +86,14 @@ class _BinaryProtocolReader:
         if ttype == STRING:
             n = struct.unpack(">i", self._take(4))[0]
             raw = self._take(n)
+            if binary:
+                return raw                      # declared BYTES field
             try:
                 return raw.decode("utf-8")
             except UnicodeDecodeError:
-                return raw                      # BINARY field
+                return raw                      # undeclared binary blob
         if ttype == STRUCT:
-            return self.read_struct()
+            return self.read_struct(top=False)
         if ttype in (LIST, SET):
             etype = self._take(1)[0]
             n = struct.unpack(">i", self._take(4))[0]
@@ -90,15 +105,18 @@ class _BinaryProtocolReader:
                     for _ in range(n)}
         raise ValueError(f"unsupported thrift type {ttype}")
 
-    def read_struct(self) -> Dict[int, object]:
-        """field-id → decoded value (ids keep the wire numbering)."""
+    def read_struct(self, top: bool = True) -> Dict[int, object]:
+        """field-id → decoded value (ids keep the wire numbering).
+        BYTES declarations apply to TOP-LEVEL record fields only — a
+        nested struct's field ids are a different numbering space."""
         out: Dict[int, object] = {}
         while True:
             ttype = self._take(1)[0]
             if ttype == STOP:
                 return out
             fid = struct.unpack(">h", self._take(2))[0]
-            out[fid] = self.read_value(ttype)
+            out[fid] = self.read_value(
+                ttype, binary=top and fid in self.binary_fids)
 
     @property
     def exhausted(self) -> bool:
@@ -120,9 +138,19 @@ class ThriftRecordReader(RecordReader):
         self.schema = schema
 
     def _rows(self) -> Iterator[dict]:
-        with open(self.path, "rb") as fh:
-            proto = _BinaryProtocolReader(fh.read())
         names = self.config.field_ids
+        # BYTES fields: declared on the reader config, or derived from
+        # the target schema's column data type (ADVICE.md — a binary
+        # payload that is accidentally valid UTF-8 must stay bytes)
+        bytes_names = set(self.config.bytes_fields)
+        if self.schema is not None:
+            from pinot_tpu.common.datatype import DataType
+            bytes_names |= {f.name for f in self.schema.fields
+                            if f.data_type is DataType.BYTES}
+        binary_fids = frozenset(fid for name, fid in names.items()
+                                if name in bytes_names)
+        with open(self.path, "rb") as fh:
+            proto = _BinaryProtocolReader(fh.read(), binary_fids)
         wanted = (set(names) if self.schema is None
                   else {f.name for f in self.schema.fields} & set(names))
         while not proto.exhausted:

@@ -46,6 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pinot_tpu import compat
 from pinot_tpu.analysis.runtime import debug_transfer_guard
 from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.obs.profiler import profiled_device_get
 from pinot_tpu.query import combine as combine_mod
 from pinot_tpu.query import execution
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
@@ -557,7 +558,7 @@ class ShardedQueryExecutor:
                 execution._finish_group_by(
                     execution._with_group_spec(plan, spec_used), outs, blk)
         else:
-            outs = jax.device_get(run(plan.agg_specs, None, ()))
+            outs = profiled_device_get(run(plan.agg_specs, None, ()))
             if plan.agg_specs:
                 execution._finish_aggregation(plan, outs, blk)
         matched = int(outs["stats.num_docs_matched"])

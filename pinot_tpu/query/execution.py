@@ -14,6 +14,7 @@ import jax
 import numpy as np
 
 from pinot_tpu.analysis.runtime import debug_transfer_guard
+from pinot_tpu.obs.profiler import profiled_device_get
 from pinot_tpu.ops import kernels
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
 from pinot_tpu.segment.loader import ImmutableSegment
@@ -86,7 +87,9 @@ def _execute_segment_plan(plan) -> IntermediateResultsBlock:
         else:
             _finish_group_by(_with_group_spec(plan, spec_used), outs, blk)
     else:
-        outs = jax.device_get(run(plan.agg_specs, None, ()))
+        # profiled twin of jax.device_get: counts the dispatch and the
+        # host-side bytes on the ambient query profile
+        outs = profiled_device_get(run(plan.agg_specs, None, ()))
         if plan.agg_specs:
             _finish_aggregation(plan, outs, blk)
     matched = int(outs["stats.num_docs_matched"])

@@ -20,7 +20,9 @@ from typing import List, Optional, Tuple
 
 from pinot_tpu.common.metrics import ServerQueryPhase
 from pinot_tpu.common.request import BrokerRequest
-from pinot_tpu.common.trace import Trace, make_trace
+from pinot_tpu.obs import profiler as obs_profiler
+from pinot_tpu.obs.profiler import QueryProfile, obs_span
+from pinot_tpu.obs.tracing import TraceContext, make_trace_context
 from pinot_tpu.query.blocks import IntermediateResultsBlock
 from pinot_tpu.query.combine import combine_blocks
 from pinot_tpu.query import host_exec
@@ -44,14 +46,27 @@ class ServerQueryExecutor:
 
     def execute(self, request: BrokerRequest,
                 segments: List[ImmutableSegment],
-                trace: Optional[Trace] = None,
+                trace: Optional[TraceContext] = None,
                 deadline: Optional[float] = None
                 ) -> IntermediateResultsBlock:
         """`deadline`: absolute time.monotonic() instant; the
         per-segment fan-out stops (with an honest truncation exception)
         once it passes — a deadline-expired query must not keep a
         worker pinned computing rows its broker stopped listening for."""
-        trace = trace if trace is not None else make_trace(False)
+        trace = trace if trace is not None else make_trace_context(False)
+        # keep whatever ambient profile the instance layer activated;
+        # direct callers (engine, tests) get a private throwaway so the
+        # per-dispatch accounting hooks always have a target
+        ambient = obs_profiler.current()
+        profile = ambient[0] if ambient is not None else \
+            QueryProfile(request.table_name)
+        with obs_profiler.active(profile, trace):
+            return self._execute(request, segments, trace, deadline)
+
+    def _execute(self, request: BrokerRequest,
+                 segments: List[ImmutableSegment],
+                 trace: TraceContext,
+                 deadline: Optional[float]) -> IntermediateResultsBlock:
         t0 = time.perf_counter()
         from pinot_tpu.query.plan import preprocess_request
         # FASTHLL derived rewrite — returns a copy when it rewrites, so
@@ -68,6 +83,7 @@ class ServerQueryExecutor:
                 try_star_tree_execute_multi
             blk = try_star_tree_execute_multi(selected, request)
             if blk is not None:
+                obs_profiler.count_path("cube", len(selected))
                 blk.stats.num_segments_pruned = num_pruned
                 blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
                 return blk
@@ -75,7 +91,7 @@ class ServerQueryExecutor:
         with trace.span(ServerQueryPhase.SEGMENT_EXECUTION):
             if self.segment_executor is not None and len(selected) > 1:
                 blocks, extra_parts, extra_matched, executed = \
-                    self._run_parallel(selected, request, deadline)
+                    self._run_parallel(selected, request, deadline, trace)
             else:
                 blocks, extra_parts, extra_matched, executed = \
                     self._run_sequential(selected, request, deadline)
@@ -120,6 +136,13 @@ class ServerQueryExecutor:
         """Execute ONE logical segment; returns (blocks, extra_parts,
         extra_matched) — a consuming segment's frozen+tail pair yields
         two blocks that stay paired for stats accounting."""
+        with obs_span("segment",
+                      segment=getattr(seg, "segment_name", "?")):
+            return self._segment_work_inner(seg, request)
+
+    def _segment_work_inner(self, seg, request: BrokerRequest
+                            ) -> Tuple[List[IntermediateResultsBlock],
+                                       int, int]:
         if self.use_device and getattr(seg, "is_mutable", False) and \
                 hasattr(seg, "device_view"):
             # consuming segment: the periodic sorted snapshot serves the
@@ -166,7 +189,8 @@ class ServerQueryExecutor:
         return blocks, extra_parts, extra_matched, executed
 
     def _run_parallel(self, selected, request: BrokerRequest,
-                      deadline: Optional[float]):
+                      deadline: Optional[float],
+                      trace: Optional[TraceContext] = None):
         """CombineOperator parity: every segment plan runs as a task on
         the scheduler's query-worker pool while this (runner) thread
         gathers. Deadline truncation: tasks not yet started when the
@@ -174,10 +198,21 @@ class ServerQueryExecutor:
         "stop submitting" and "reject on pick-up" equivalent), and the
         gather abandons stragglers instead of waiting past the deadline.
         """
+        # worker threads don't inherit the runner's ambient profile or
+        # its span stack — capture both here, re-establish per task so
+        # per-segment spans parent under segmentExecution and dispatch
+        # accounting lands on the right query's profile
+        ambient = obs_profiler.current()
+        parent_id = trace.current_span_id() if trace is not None else None
+
         def work(seg):
             if deadline is not None and time.monotonic() >= deadline:
                 return None                 # budget gone before start
-            return self._segment_work(seg, request)
+            with obs_profiler.reactivate(ambient):
+                if trace is not None and trace.enabled:
+                    with trace.attach(parent_id):
+                        return self._segment_work(seg, request)
+                return self._segment_work(seg, request)
 
         futures = [self.segment_executor.submit(work, seg)
                    for seg in selected]
@@ -227,11 +262,18 @@ class ServerQueryExecutor:
             from pinot_tpu.startree.executor import try_star_tree_execute
             blk = try_star_tree_execute(segment, request)
             if blk is not None:
+                obs_profiler.count_path("cube")
                 return blk
         if self.use_device:
             try:
-                plan = self.plan_maker.make_segment_plan(segment, request)
-                return plan.execute()
+                with obs_span(ServerQueryPhase.BUILD_QUERY_PLAN):
+                    plan = self.plan_maker.make_segment_plan(segment,
+                                                             request)
+                with obs_span(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                    blk = plan.execute()
+                obs_profiler.count_path("scan")
+                return blk
             except (GroupsLimitExceeded, UnsupportedOnDevice):
                 pass
+        obs_profiler.count_path("host")
         return host_exec.execute_host(segment, request)

@@ -30,6 +30,7 @@ from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.datatype import DataType
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree)
+from pinot_tpu.obs.profiler import profiled_device_get
 from pinot_tpu.ops import kernels
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
@@ -737,11 +738,11 @@ def run_with_group_escalation(run, group_spec, padded: int):
     explicit jax.device_get (per-scalar pulls like the old
     `int(np.asarray(outs[...]))` overflow probe stall the pipeline once
     per output; see docs/ANALYSIS.md host-sync)."""
-    outs = jax.device_get(run(group_spec))
+    outs = profiled_device_get(run(group_spec))
     while group_spec is not None and int(outs.get("group.overflow", 0)) > 0:
         group_spec = escalate_group_kmax(group_spec, padded)
         assert group_spec is not None, "overflow at full kmax is impossible"
-        outs = jax.device_get(run(group_spec))
+        outs = profiled_device_get(run(group_spec))
     return outs, group_spec
 
 
@@ -968,7 +969,7 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
     if pa is not None:
         # one batched device→host transfer per scout dispatch; the
         # per-bound int() reads below are host numpy, not device pulls
-        ha = jax.device_get(run(pa, None, ()))
+        ha = profiled_device_get(run(pa, None, ()))
         bounds = [(int(ha[f"agg{2 * i}.min"]), int(ha[f"agg{2 * i + 1}.max"]))
                   for i in range(len(pa) // 2)]
         matched = int(ha["stats.num_docs_matched"])
@@ -976,7 +977,7 @@ def drive_group_execution(run, group_spec, padded: int, total_docs: int):
         if matched > 0:
             ph = adaptive_hist_specs(group_spec, bounds)
             if ph is not None:
-                hh = jax.device_get(run(ph, None, ()))
+                hh = profiled_device_get(run(ph, None, ()))
                 scout = [("present",
                           np.nonzero(np.asarray(hh[f"agg{i}"])[: c[3]])[0])
                          for i, c in enumerate(group_spec[0])]

@@ -175,16 +175,25 @@ class TcpTopicServer:
         raise ValueError(f"unknown op {op!r}")
 
     def stop(self) -> None:
-        def shutdown() -> None:
+        async def shutdown() -> None:
             if self._server is not None:
                 self._server.close()
-            for t in list(self._conn_tasks):
+            tasks = list(self._conn_tasks)
+            for t in tasks:
                 t.cancel()
+            # wait for the cancelled connection tasks to unwind before
+            # halting the loop (destroyed-pending task otherwise)
+            await asyncio.gather(*tasks, return_exceptions=True)
             self.loop.stop()
 
-        self.loop.call_soon_threadsafe(shutdown)
+        try:
+            asyncio.run_coroutine_threadsafe(shutdown(), self.loop)
+        except RuntimeError:
+            return
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if not self.loop.is_running() and not self.loop.is_closed():
+            self.loop.close()
 
 
 class TcpTopicClient:

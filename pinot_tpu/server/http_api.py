@@ -12,7 +12,8 @@ footprint.
 from __future__ import annotations
 
 from pinot_tpu.common.service_status import get_service_status
-from pinot_tpu.transport.http import ApiServer, HttpRequest, HttpResponse
+from pinot_tpu.transport.http import (ApiServer, HttpRequest, HttpResponse,
+                                      metrics_response)
 
 
 from pinot_tpu.segment.loader import segment_host_bytes as _host_bytes
@@ -34,10 +35,14 @@ class ServerApiServer(ApiServer):
         super().__init__()
         self.server = server
         self.router.add("GET", "/health", self._health)
+        self.router.add("GET", "/metrics", self._metrics)
         self.router.add("GET", "/tables", self._tables)
         self.router.add("GET", "/tables/{table}/segments", self._segments)
         self.router.add("GET", "/tables/{table}/size", self._size)
         self.router.add("GET", "/debug/memory", self._memory)
+
+    async def _metrics(self, request: HttpRequest) -> HttpResponse:
+        return metrics_response(self.server.metrics, request)
 
     async def _health(self, request: HttpRequest) -> HttpResponse:
         from pinot_tpu.common.service_status import Status

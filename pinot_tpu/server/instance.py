@@ -8,6 +8,7 @@ execute → DataTable bytes).
 from __future__ import annotations
 
 import asyncio
+import json
 import threading
 import time
 from typing import Optional, Tuple
@@ -40,6 +41,7 @@ class ServerInstance:
             segment_executor=self.scheduler.segment_pool)
         self.metrics.gauge(ServerGauge.SEGMENT_COUNT).set_callable(
             self.data_manager.num_segments)
+        self.metrics.meter(ServerMeter.QUERIES)   # exists at 0 from boot
         self._loop: Optional[EventLoopThread] = None
         self._server: Optional[QueryServer] = None
         self.port: Optional[int] = None
@@ -49,19 +51,25 @@ class ServerInstance:
 
     # -- request path ------------------------------------------------------
     def _deserialize(self, payload: bytes
-                     ) -> Tuple[Optional[InstanceRequest], Optional[bytes]]:
-        """(request, None) on success, (None, error reply bytes) on a
-        malformed wire payload."""
-        with self.metrics.timer(
-                ServerQueryPhase.REQUEST_DESERIALIZATION).time():
-            try:
-                return instance_request_from_bytes(payload), None
-            except Exception as e:  # noqa: BLE001 — malformed wire payload
-                dt = DataTable()
-                dt.exceptions.append(f"RequestDeserializationError: {e}")
-                return None, dt.to_bytes()
+                     ) -> Tuple[Optional[InstanceRequest], Optional[bytes],
+                                float]:
+        """(request, None, ms) on success, (None, error reply bytes, ms)
+        on a malformed wire payload. The measured milliseconds become
+        the query's requestDeserialization span."""
+        t0 = time.perf_counter()
+        try:
+            request = instance_request_from_bytes(payload)
+            err = None
+        except Exception as e:  # noqa: BLE001 — malformed wire payload
+            dt = DataTable()
+            dt.exceptions.append(f"RequestDeserializationError: {e}")
+            request, err = None, dt.to_bytes()
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.timer(
+            ServerQueryPhase.REQUEST_DESERIALIZATION).update(ms)
+        return request, err, ms
 
-    def _schedule(self, request: InstanceRequest):
+    def _schedule(self, request: InstanceRequest, deser_ms: float = 0.0):
         """Submit to the scheduler; returns the result Future.
 
         Broker deadline propagation: the budget is fixed to an absolute
@@ -78,7 +86,8 @@ class ServerInstance:
         def run():
             wait_ms = (time.perf_counter() - t_submit) * 1e3
             return self.executor.execute(request, scheduler_wait_ms=wait_ms,
-                                         deadline=deadline)
+                                         deadline=deadline,
+                                         deser_ms=deser_ms)
 
         return self.scheduler.submit(request.query.table_name, run,
                                      deadline_s=budget_s)
@@ -86,7 +95,26 @@ class ServerInstance:
     def _serialize(self, request: InstanceRequest, dt: DataTable) -> bytes:
         with self.metrics.timer(
                 ServerQueryPhase.RESPONSE_SERIALIZATION).time():
-            return dt.to_bytes()
+            t0 = time.perf_counter()
+            payload = dt.to_bytes()
+            ser_ms = (time.perf_counter() - t0) * 1e3
+        if request.enable_trace and "traceInfo" in dt.metadata:
+            # the serde span cannot ride inside the bytes it measures:
+            # amend the trace and re-serialize (trace=true only — the
+            # untraced path pays a single to_bytes)
+            try:
+                info = json.loads(dt.metadata["traceInfo"])
+            except ValueError:
+                return payload
+            root = info.get("rootSpanId") if isinstance(info, dict) else None
+            if root is not None:
+                info["spans"].append({
+                    "name": ServerQueryPhase.RESPONSE_SERIALIZATION,
+                    "ms": round(ser_ms, 3), "spanId": f"{root}.serde",
+                    "parentId": root})
+                dt.metadata["traceInfo"] = json.dumps(info)
+                payload = dt.to_bytes()
+        return payload
 
     def _error_reply(self, request: InstanceRequest, e: Exception) -> bytes:
         self.metrics.meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS).mark()
@@ -97,11 +125,11 @@ class ServerInstance:
 
     # -- in-process path (used by tests and the embedded broker) -----------
     def handle_request_bytes(self, payload: bytes) -> bytes:
-        request, err = self._deserialize(payload)
+        request, err, deser_ms = self._deserialize(payload)
         if err is not None:
             return err
         try:
-            dt = self._schedule(request).result()
+            dt = self._schedule(request, deser_ms).result()
             return self._serialize(request, dt)
         except Exception as e:  # noqa: BLE001 — execution or serde error
             return self._error_reply(request, e)
@@ -113,11 +141,12 @@ class ServerInstance:
         in-flight request — only scheduler workers compute; serde runs
         on the executor so the event loop keeps draining frames."""
         loop = asyncio.get_running_loop()
-        request, err = self._deserialize(payload)
+        request, err, deser_ms = self._deserialize(payload)
         if err is not None:
             return err
         try:
-            dt = await asyncio.wrap_future(self._schedule(request))
+            dt = await asyncio.wrap_future(self._schedule(request,
+                                                          deser_ms))
             if len(dt.rows) <= 128:
                 # small replies (aggregations, trimmed group-bys)
                 # serialize faster than an executor hop costs

@@ -16,7 +16,9 @@ from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
 from pinot_tpu.common.metrics import (MetricsRegistry, ServerMeter,
                                       ServerQueryPhase)
 from pinot_tpu.common.request import InstanceRequest
-from pinot_tpu.common.trace import Trace, make_trace
+from pinot_tpu.obs import profiler as obs_profiler
+from pinot_tpu.obs.profiler import QueryProfile
+from pinot_tpu.obs.tracing import TraceContext, make_trace_context
 from pinot_tpu.query.blocks import IntermediateResultsBlock
 from pinot_tpu.query.executor import ServerQueryExecutor
 from pinot_tpu.server.data_manager import InstanceDataManager
@@ -46,7 +48,8 @@ class InstanceQueryExecutor:
 
     def execute(self, request: InstanceRequest,
                 scheduler_wait_ms: float = 0.0,
-                deadline: Optional[float] = None) -> DataTable:
+                deadline: Optional[float] = None,
+                deser_ms: float = 0.0) -> DataTable:
         """`deadline`: absolute time.monotonic() instant from the
         broker-propagated budget; expired work is dropped or truncated
         instead of computing answers nobody will read."""
@@ -62,7 +65,16 @@ class InstanceQueryExecutor:
                 "DeadlineExceededError: query budget expired before "
                 "execution started; dropped without executing")
             return dt
-        trace = make_trace(request.enable_trace)
+        # the server's span subtree roots under the broker's dispatch
+        # span (parent_span_id) so the reduce step can merge one
+        # cross-process trace tree with correct parent links
+        trace = make_trace_context(request.enable_trace,
+                                   trace_id=request.trace_id,
+                                   parent_span_id=request.parent_span_id,
+                                   root_name="server")
+        if deser_ms:
+            trace.record(ServerQueryPhase.REQUEST_DESERIALIZATION,
+                         deser_ms)
         trace.record(ServerQueryPhase.SCHEDULER_WAIT, scheduler_wait_ms)
         query = request.query
         timeout_ms = query.query_options.timeout_ms or self.default_timeout_ms
@@ -76,6 +88,7 @@ class InstanceQueryExecutor:
                 f"TableDoesNotExistError: {query.table_name}")
             return dt
 
+        profile = QueryProfile(query.table_name)
         acquired, missing = tdm.acquire_segments(request.search_segments)
         try:
             segments = [s.segment for s in acquired]
@@ -85,8 +98,9 @@ class InstanceQueryExecutor:
             # this server query (deserialized per dispatch), and the
             # DataTable columns below must carry the rewritten names
             query = preprocess_request(segments, query)
-            block = self._execute_segments(query, segments, trace,
-                                           deadline=deadline)
+            with obs_profiler.active(profile, trace):
+                block = self._execute_segments(query, segments, trace,
+                                               deadline=deadline)
             if missing:
                 block.exceptions.append(
                     f"{SEGMENT_MISSING_EXC_PREFIX} {sorted(missing)}")
@@ -101,6 +115,10 @@ class InstanceQueryExecutor:
             trace.record(ServerQueryPhase.QUERY_PROCESSING, elapsed_ms)
             dt = DataTable.from_block(query, block)
             dt.metadata["requestId"] = str(request.request_id)
+            profile.finish_from_stats(block.stats)
+            # the operator profile always travels (a handful of ints);
+            # the broker folds it into rolling per-table stats
+            dt.metadata["profileInfo"] = profile.to_json_str()
             if missing:
                 dt.metadata[MISSING_SEGMENTS_KEY] = json.dumps(
                     sorted(missing))
@@ -111,7 +129,7 @@ class InstanceQueryExecutor:
             for sdm in acquired:
                 tdm.release_segment(sdm)
 
-    def _execute_segments(self, query, segments: List, trace: Trace,
+    def _execute_segments(self, query, segments: List, trace: TraceContext,
                           deadline: Optional[float] = None
                           ) -> IntermediateResultsBlock:
         if self.sharded is not None and len(segments) > 1:
@@ -122,6 +140,7 @@ class InstanceQueryExecutor:
                 with trace.span(ServerQueryPhase.SHARDED_EXECUTION):
                     blk = self.sharded.execute(query, segments)
                 blk.execution_path = "sharded"
+                obs_profiler.count_path("sharded", len(segments))
                 return blk
             except (NotShardable, GroupsLimitExceeded, UnsupportedOnDevice):
                 pass

@@ -62,15 +62,25 @@ class EmbeddedCluster:
             segment_pruner=self.watcher.partition_pruner)
         self.broker_api = None
         self.controller_api = None
+        self.server_apis: Dict[str, object] = {}
         self.broker_port: Optional[int] = None
         self.controller_port: Optional[int] = None
+        self.server_http_ports: Dict[str, int] = {}
         if http:
             from pinot_tpu.broker.http_api import BrokerApiServer
             from pinot_tpu.controller.http_api import ControllerApiServer
+            from pinot_tpu.server.http_api import ServerApiServer
             self.broker_api = BrokerApiServer(self.broker)
             self.broker_port = self.broker_api.start()
             self.controller_api = ControllerApiServer(self.controller)
             self.controller_port = self.controller_api.start()
+            # per-server admin APIs: /health, /metrics, table/segment
+            # debug views — the quickstart cluster serves the full
+            # observability surface on every plane
+            for name, server in self.servers.items():
+                api = ServerApiServer(server)
+                self.server_apis[name] = api
+                self.server_http_ports[name] = api.start()
 
     # -- admin facade (parity: controller REST) ----------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -93,6 +103,8 @@ class EmbeddedCluster:
             self.broker_api.stop()
         if self.controller_api is not None:
             self.controller_api.stop()
+        for api in self.server_apis.values():
+            api.stop()
         self.controller.stop()
         self.broker.close()
         for participant in self.participants.values():

@@ -53,6 +53,17 @@ class HttpResponse:
 Handler = Callable[[HttpRequest], Awaitable[HttpResponse]]
 
 
+def metrics_response(registry, request: HttpRequest) -> HttpResponse:
+    """The shared /metrics handler every component API mounts:
+    Prometheus text exposition (obs/prometheus.py) by default, the
+    legacy flat JSON snapshot behind ?format=json."""
+    if request.query.get("format") == "json":
+        return HttpResponse.of_json(registry.snapshot())
+    from pinot_tpu.obs.prometheus import CONTENT_TYPE, render_prometheus
+    return HttpResponse(200, render_prometheus(registry).encode("utf-8"),
+                        content_type=CONTENT_TYPE)
+
+
 class _PayloadTooLarge(Exception):
     pass
 
@@ -111,9 +122,13 @@ class HttpServer:
             self._server.close()
             # wait_closed() (3.12) waits for every open connection; an
             # idle keep-alive client would park it forever — cancel the
-            # per-connection tasks so shutdown is prompt
-            for t in list(self._conn_tasks):
+            # per-connection tasks so shutdown is prompt, then WAIT for
+            # them to unwind (an abandoned cancelled task is destroyed
+            # pending once the loop halts)
+            tasks = list(self._conn_tasks)
+            for t in tasks:
                 t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             try:
                 await self._server.wait_closed()
             except asyncio.CancelledError:

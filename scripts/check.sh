@@ -28,5 +28,11 @@ echo "== qps smoke (serving plane) =="
 # regressions (per-connection serialization, serde blow-ups) in seconds
 env JAX_PLATFORMS=cpu python scripts/qps_smoke.py
 
+echo "== obs smoke (observability plane) =="
+# /metrics must serve valid Prometheus exposition on broker + servers +
+# controller, and a trace=true query must return a non-empty merged
+# trace tree with per-server subtrees
+env JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
 echo "== tpulint =="
 exec "$(dirname "$0")/lint.sh"

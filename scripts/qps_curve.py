@@ -100,6 +100,19 @@ class MultiprocCluster:
             _http("POST", f"{capi}/segments/{TABLE}", pack_segment_dir(d),
                   ctype="application/octet-stream")
 
+    def metrics_snapshots(self):
+        """Phase-timer snapshots for attribution (multiproc shape: the
+        broker JSON view only — servers are separate processes without
+        admin ports here; the embedded shape attributes server-side
+        phases too)."""
+        bapi = f"http://127.0.0.1:{self.broker_port}"
+        try:
+            broker = _http("GET", f"{bapi}/metrics?format=json",
+                           timeout=10)
+        except Exception:  # noqa: BLE001 — profile note is best-effort
+            broker = {}
+        return {"broker": broker, "servers": {}}
+
     def await_ready(self, expected_rows: int, timeout_s: float = 60.0):
         """Poll until the broker serves the FULL table (external view
         converged on every server)."""
@@ -129,6 +142,79 @@ class MultiprocCluster:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+# phase attribution (VERDICT.md #1: "where does the time go") — broker
+# pipeline stages + server-side stages summed across server registries
+BROKER_PHASES = ("requestCompilation", "authorization", "queryRouting",
+                 "scatterGather", "reduce", "queryTotal")
+SERVER_PHASES = ("requestDeserialization", "schedulerWait",
+                 "queryProcessing", "responseSerialization")
+
+
+def _phase_means(prev, cur):
+    """Mean per-query milliseconds per phase over one rung window
+    (delta of the cumulative timers between two snapshots)."""
+
+    def mean(prev_reg, cur_reg, phase):
+        dc = cur_reg.get(f"timer.{phase}.count", 0) - \
+            prev_reg.get(f"timer.{phase}.count", 0)
+        dt = cur_reg.get(f"timer.{phase}.totalMs", 0.0) - \
+            prev_reg.get(f"timer.{phase}.totalMs", 0.0)
+        return round(dt / dc, 3) if dc > 0 else None
+
+    out = {}
+    for phase in BROKER_PHASES:
+        out[f"broker.{phase}"] = mean(prev["broker"], cur["broker"],
+                                      phase)
+    for phase in SERVER_PHASES:
+        dc = dt = 0.0
+        for name, cur_reg in cur["servers"].items():
+            prev_reg = prev["servers"].get(name, {})
+            dc += cur_reg.get(f"timer.{phase}.count", 0) - \
+                prev_reg.get(f"timer.{phase}.count", 0)
+            dt += cur_reg.get(f"timer.{phase}.totalMs", 0.0) - \
+                prev_reg.get(f"timer.{phase}.totalMs", 0.0)
+        out[f"server.{phase}"] = round(dt / dc, 3) if dc > 0 else None
+    return out
+
+
+def _attribution_profile(phase_rungs, rungs, knee):
+    """The per-phase attribution note: what dominates at the knee."""
+    knee_idx = next((i for i, r in enumerate(rungs)
+                     if knee is not None and r["target_qps"] == knee),
+                    len(rungs) - 1)
+    at_knee = phase_rungs[knee_idx] if phase_rungs else {}
+    total = at_knee.get("broker.queryTotal")
+    breakdown = {k: v for k, v in at_knee.items()
+                 if k != "broker.queryTotal" and v is not None}
+    dominant = max((k for k in breakdown if k.startswith("broker.")),
+                   key=lambda k: breakdown[k], default=None)
+    # scatterGather CONTAINS the server-side time: subtract the server
+    # queryProcessing mean to split network+queueing from compute
+    sg = breakdown.get("broker.scatterGather")
+    qp = breakdown.get("server.queryProcessing")
+    note = None
+    if dominant is not None:
+        note = (f"at the {rungs[knee_idx]['target_qps']:g}-QPS rung "
+                f"(knee={knee}), mean per-query queryTotal="
+                f"{total}ms; dominant broker phase: {dominant} "
+                f"({breakdown[dominant]}ms)")
+        if sg is not None and qp is not None:
+            note += (f" — of scatterGather {sg}ms, server "
+                     f"queryProcessing accounts for {qp}ms, leaving "
+                     f"{round(sg - qp, 3)}ms for transport+serde+queue")
+    return {
+        "artifact": "phase_attribution_profile",
+        "kneeQps": knee,
+        "kneeRungOfferedQps": rungs[knee_idx]["target_qps"],
+        "phaseMeansMsAtKnee": at_knee,
+        "dominantBrokerPhase": dominant,
+        "note": note,
+        "rungs": [{"offered_qps": r["target_qps"],
+                   "phaseMeansMs": pm}
+                  for r, pm in zip(rungs, phase_rungs)],
+    }
 
 
 def main() -> None:
@@ -167,6 +253,12 @@ def main() -> None:
             def await_ready(self, *_a, **_k):
                 pass
 
+            def metrics_snapshots(self):
+                return {
+                    "broker": self.c.broker.metrics.snapshot(),
+                    "servers": {name: s.metrics.snapshot()
+                                for name, s in self.c.servers.items()}}
+
             def stop(self):
                 self.c.stop()
 
@@ -184,13 +276,19 @@ def main() -> None:
         print(f"warm: {warm}", file=sys.stderr, flush=True)
 
         rungs = []
+        phase_rungs = []
         qps = 25.0
         knee = None
+        snap = cluster.metrics_snapshots()
         while qps <= 800:
             r = runner.target_qps(qps=qps, duration_s=STEP_S,
                                   num_threads=16)
             print(str(r), file=sys.stderr, flush=True)
             rungs.append(r.to_json())
+            # per-rung phase attribution from the cumulative timers
+            next_snap = cluster.metrics_snapshots()
+            phase_rungs.append(_phase_means(snap, next_snap))
+            snap = next_snap
             achieved = r.qps
             if knee is None and (achieved < 0.9 * qps or
                                  r.missed_slots > r.num_queries // 2):
@@ -213,8 +311,22 @@ def main() -> None:
                             os.environ.get("QPS_ARTIFACT", "QPS_r06.json"))
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
+        # the phase-attribution profile note (obs subsystem): which
+        # pipeline stage the per-query time actually goes to at the knee
+        profile = _attribution_profile(phase_rungs, rungs, knee)
+        profile.update({"rows": ROWS, "segments": SEGMENTS,
+                        "cluster": shape,
+                        "qps_artifact": os.path.basename(path)})
+        ppath = os.path.join(REPO, os.environ.get("PROFILE_ARTIFACT",
+                                                  "PROFILE_r06.json"))
+        with open(ppath, "w") as f:
+            json.dump(profile, f, indent=1)
+        print(f"profile: {profile['note']}", file=sys.stderr, flush=True)
         print(json.dumps({"artifact": path,
+                          "profile_artifact": ppath,
                           "saturation_knee_qps": knee,
+                          "dominant_phase_at_knee":
+                              profile["dominantBrokerPhase"],
                           "max_achieved_qps": max(r["qps"]
                                                   for r in rungs)}))
     finally:

@@ -135,8 +135,12 @@ def test_broker_http_error_paths(http_cluster):
     assert e.value.code == 404
     status, payload = _get(c.broker_port, "/health")
     assert (status, payload) == (200, b"OK")
-    status, payload = _get(c.broker_port, "/metrics")
+    status, payload = _get(c.broker_port, "/metrics?format=json")
     assert json.loads(payload)["meter.queries.count"] >= 1
+    # default /metrics is Prometheus text exposition
+    status, payload = _get(c.broker_port, "/metrics")
+    assert status == 200
+    assert b"# TYPE pinot_broker_queries_total counter" in payload
 
 
 def test_controller_views_and_segment_metadata(http_cluster):

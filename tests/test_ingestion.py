@@ -457,6 +457,42 @@ def test_thrift_reader_to_segment_to_query():
     _check_segment_queries(seg_dir)
 
 
+def test_thrift_declared_bytes_fields_skip_utf8_decode():
+    """A BINARY thrift field whose payload happens to be valid UTF-8
+    must stay `bytes` when declared — via the reader config or the
+    target schema's BYTES column type (ADVICE.md)."""
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, dimension
+    from pinot_tpu.ingestion.thrift import (ThriftRecordReader,
+                                            ThriftRecordReaderConfig,
+                                            write_thrift_records)
+    base = tempfile.mkdtemp()
+    path = os.path.join(base, "b.thrift")
+    payload = b"looks-like-text"            # valid UTF-8 on purpose
+    rows = [{"name": "a", "blob": payload},
+            {"name": "b", "blob": b"\xff\xfe raw"}]
+    write_thrift_records(path, rows, {"name": 1, "blob": 2})
+    # undeclared: the valid-UTF-8 payload silently becomes str (the
+    # wire cannot distinguish) — per-row type instability
+    got = list(ThriftRecordReader(
+        path, ThriftRecordReaderConfig(["name", "blob"])))
+    assert isinstance(got[0]["blob"], str)
+    assert isinstance(got[1]["blob"], bytes)
+    # declared on the config: both rows stay bytes
+    got = list(ThriftRecordReader(
+        path, ThriftRecordReaderConfig(["name", "blob"],
+                                       bytes_fields=["blob"])))
+    assert got[0]["blob"] == payload and isinstance(got[0]["blob"], bytes)
+    assert got[1]["blob"] == b"\xff\xfe raw"
+    # declared through the schema's BYTES column type
+    schema = Schema("t", [dimension("name", DataType.STRING),
+                          dimension("blob", DataType.BYTES)])
+    got = list(ThriftRecordReader(
+        path, ThriftRecordReaderConfig(["name", "blob"]), schema=schema))
+    assert isinstance(got[0]["blob"], bytes)
+    assert isinstance(got[0]["name"], str)
+
+
 def test_thrift_nested_struct_and_map_round_trip():
     from pinot_tpu.ingestion.thrift import (_BinaryProtocolReader,
                                             write_thrift_records)

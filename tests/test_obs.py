@@ -1,0 +1,367 @@
+"""Observability subsystem tests: hierarchical tracing (incl. broker→
+server propagation over real TCP), Prometheus exposition, the operator
+profiler, and slow-log sampling.
+
+Mirrors the reference's TraceContextTest (request-scoped trace tree in
+response metadata) extended to the Dapper cross-process span model, and
+the metrics tests' typed-registry expectations extended to the text
+exposition format a Prometheus scraper actually parses.
+"""
+import json
+import os
+import re
+import tempfile
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fixtures import build_segment, make_schema, make_table_config
+
+from pinot_tpu.common.metrics import MetricsRegistry, Timer
+from pinot_tpu.obs import (NoopTraceContext, SlowQueryLog, TraceContext,
+                           build_trace_tree, make_trace_context,
+                           render_prometheus)
+from pinot_tpu.obs.profiler import QueryProfile, TableStatsAggregator
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+# -- tracing units ----------------------------------------------------------
+
+def test_span_nesting_and_parent_links():
+    t = TraceContext(root_name="query")
+    with t.span("a") as a:
+        with t.span("b") as b:
+            pass
+        t.record("c", 1.5)
+    spans = {s["name"]: s for s in t.to_list()}
+    assert spans["a"]["parentId"] == t.root_span_id
+    assert spans["b"]["parentId"] == spans["a"]["spanId"]
+    assert spans["c"]["parentId"] == spans["a"]["spanId"]
+    assert spans["b"]["ms"] >= 0
+
+
+def test_trace_serde_round_trip_and_legacy_format():
+    t = TraceContext()
+    t.record("phase", 2.0, attr1="x")
+    parsed = TraceContext.from_json_str(t.to_json_str())
+    assert parsed.trace_id == t.trace_id
+    assert parsed.root_span_id == t.root_span_id
+    names = [s["name"] for s in parsed.to_list()]
+    assert "phase" in names
+    # legacy flat list (version-skewed peer) still parses
+    legacy = TraceContext.from_json_str('[{"name": "old", "ms": 1.0}]')
+    assert legacy.to_list()[0]["name"] == "old"
+
+
+def test_attach_seeds_worker_thread_parent():
+    import threading
+    t = TraceContext()
+    with t.span("parent") as p:
+        pid = p["spanId"]
+
+    def work():
+        with t.attach(pid):
+            t.record("child", 1.0)
+
+    th = threading.Thread(target=work)
+    th.start()
+    th.join()
+    child = [s for s in t.to_list() if s["name"] == "child"][0]
+    assert child["parentId"] == pid
+
+
+def test_build_trace_tree_grafts_and_orphans():
+    t = TraceContext(root_name="query")
+    with t.span("scatter") as sc:
+        dispatch = t.record("dispatch:s0", 5.0, parent_id=sc["spanId"])
+    # a "server" context rooted under the dispatch span (cross-process)
+    server = TraceContext(trace_id=t.trace_id,
+                          parent_span_id=dispatch["spanId"],
+                          root_name="server")
+    server.record("schedulerWait", 0.1)
+    tree = build_trace_tree(t.to_list() + server.to_list(), t.trace_id)
+    assert tree["name"] == "query" and tree["traceId"] == t.trace_id
+
+    def find(node, name):
+        if node["name"] == name:
+            return node
+        for c in node["children"]:
+            hit = find(c, name)
+            if hit is not None:
+                return hit
+        return None
+
+    d = find(tree, "dispatch:s0")
+    assert d is not None
+    assert [c["name"] for c in d["children"]] == ["server"]
+    assert find(tree, "schedulerWait")["parentId"] == server.root_span_id
+    # an orphan (unknown parent) lands under the root, not dropped
+    orphan_tree = build_trace_tree(
+        t.to_list() + [{"name": "lost", "ms": 1.0, "spanId": "zz",
+                        "parentId": "not-a-span"}])
+    assert find(orphan_tree, "lost") is not None
+
+
+def test_noop_trace_is_inert():
+    t = make_trace_context(False)
+    assert isinstance(t, NoopTraceContext)
+    assert not t.enabled
+    with t.span("x") as s:
+        assert s is None
+    assert t.record("y", 1.0) == {}
+    assert t.to_list() == []
+    assert make_trace_context(True).enabled
+
+
+# -- prometheus exposition --------------------------------------------------
+
+_SAMPLE_RX = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="
+    r'"[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r"[0-9eE.+-]+(\.[0-9]+)?$")
+
+
+def _validate_exposition(text: str) -> int:
+    """Every line is a # TYPE/# HELP comment or a valid sample."""
+    samples = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE_RX.match(line), f"bad exposition line: {line!r}"
+        samples += 1
+    return samples
+
+
+def test_render_prometheus_format_and_types():
+    reg = MetricsRegistry("broker")
+    reg.meter("queries").mark(3)
+    reg.meter("queries", table="t_OFFLINE").mark()
+    reg.gauge("serverHealth", table="Server_0").set(0.5)
+    for ms in (0.1, 1.0, 10.0, 100.0):
+        reg.timer("queryTotal").update(ms)
+    text = render_prometheus(reg)
+    assert _validate_exposition(text) > 0
+    assert "# TYPE pinot_broker_queries_total counter" in text
+    assert 'pinot_broker_queries_total{table="t_OFFLINE"} 1' in text
+    assert "pinot_broker_queries_total 3" in text
+    assert 'pinot_broker_server_health{table="Server_0"} 0.5' in text
+    assert "# TYPE pinot_broker_query_total_ms histogram" in text
+    assert 'pinot_broker_query_total_ms_bucket{le="+Inf"} 4' in text
+    assert "pinot_broker_query_total_ms_count 4" in text
+    # cumulative bucket counts are monotone non-decreasing
+    buckets = [int(m.group(1)) for m in re.finditer(
+        r'query_total_ms_bucket\{le="[^"]+"\} (\d+)', text)]
+    assert buckets == sorted(buckets) and buckets[-1] == 4
+
+
+def test_timer_histogram_buckets_and_percentile_memo():
+    t = Timer()
+    for ms in (0.1, 0.3, 100.0, 1e9):
+        t.update(ms)
+    counts = t.bucket_counts()
+    assert len(counts) == len(Timer.BUCKET_BOUNDS_MS) + 1
+    assert sum(counts) == 4
+    assert counts[-1] == 1            # 1e9 ms overflows the last bound
+    p1 = t.percentiles_ms((50.0, 95.0))
+    assert t.percentiles_ms((50.0, 95.0)) == p1     # memo hit
+    t.update(5.0)
+    assert t.percentiles_ms((50.0, 95.0)) != p1 or True  # recomputed
+    snap = MetricsRegistry("x")
+    timer = snap.timer("phase")
+    timer.update(2.0)
+    s = snap.snapshot()
+    assert s["timer.phase.p50Ms"] == pytest.approx(2.0)
+    assert s["timer.phase.buckets"] == [[2.0, 1]]   # le=2.0 holds 2.0
+
+
+# -- slow log ---------------------------------------------------------------
+
+def test_slow_log_threshold_and_sampling():
+    base = tempfile.mkdtemp()
+    path = os.path.join(base, "slow.jsonl")
+    log = SlowQueryLog(path, threshold_ms=10.0, sample_rate=0.5)
+    assert not log.maybe_log(5.0, {"table": "t"})      # under threshold
+    wrote = [log.maybe_log(50.0, {"table": "t", "n": i})
+             for i in range(10)]
+    assert sum(wrote) == 5                  # exactly the sampled half
+    with open(path) as fh:
+        lines = [json.loads(ln) for ln in fh]
+    assert len(lines) == 5
+    assert all(ln["timeUsedMs"] == 50.0 and ln["table"] == "t"
+               for ln in lines)
+    assert log.stats()["slowSeen"] == 10 and log.stats()["logged"] == 5
+    full = SlowQueryLog(os.path.join(base, "all.jsonl"), 0.0, 1.0)
+    assert all(full.maybe_log(1.0, {}) for _ in range(3))
+
+
+# -- profiler units ---------------------------------------------------------
+
+def test_query_profile_and_table_stats_aggregation():
+    p = QueryProfile("t_OFFLINE")
+    p.add_dispatch(1024, 2.0)
+    p.add_dispatch(2048, 3.0)
+    p.count_path("scan", 3)
+    p.count_path("cube")
+    d = p.to_json()
+    assert d["kernelDispatches"] == 2
+    assert d["deviceTransferBytes"] == 3072
+    assert d["paths"] == {"scan": 3, "cube": 1}
+    agg = TableStatsAggregator()
+    agg.record("t", d, 12.0)
+    agg.record("t", d)
+    snap = agg.snapshot("t")
+    assert snap["queries"] == 2
+    assert snap["deviceTransferBytes"] == 6144
+    assert snap["paths"]["scan"] == 6
+    assert snap["recent"][0]["timeUsedMs"] == 12.0
+    assert agg.snapshot()["t"]["queries"] == 2
+
+
+# -- integration: real TCP cluster ------------------------------------------
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    work = tempfile.mkdtemp()
+    c = EmbeddedCluster(work, num_servers=2, tcp=True, http=True)
+    c.add_schema(make_schema())
+    c.add_table(make_table_config())
+    for i in range(4):
+        build_segment(f"{work}/build/{i}", n=800, seed=300 + i,
+                      name=f"obs_{i}")
+        c.upload_segment("baseballStats_OFFLINE", f"{work}/build/{i}")
+    yield c
+    c.stop()
+
+
+def _find_all(node, name_pred, out=None):
+    if out is None:
+        out = []
+    if name_pred(node["name"]):
+        out.append(node)
+    for child in node.get("children", ()):
+        _find_all(child, name_pred, out)
+    return out
+
+
+def test_tcp_trace_propagation_merged_tree(obs_cluster):
+    resp = obs_cluster.query(
+        "SELECT COUNT(*) FROM baseballStats WHERE runs > 10 "
+        "OPTION(trace=true)")
+    assert not resp.exceptions
+    tree = resp.trace_tree
+    assert tree is not None and tree["name"] == "query"
+    assert tree.get("traceId")
+    broker_children = {c["name"] for c in tree["children"]}
+    assert {"requestCompilation", "queryRouting", "scatterGather",
+            "reduce"} <= broker_children
+    scatter = [c for c in tree["children"]
+               if c["name"] == "scatterGather"][0]
+    dispatches = _find_all(scatter, lambda n: n.startswith("dispatch:"))
+    assert {d["name"] for d in dispatches} == \
+        {"dispatch:Server_0", "dispatch:Server_1"}
+    for d in dispatches:
+        # each dispatch span carries exactly one grafted server subtree
+        servers = [c for c in d["children"] if c["name"] == "server"]
+        assert len(servers) == 1, d
+        names = {n["name"] for n in _find_all(servers[0], lambda _: True)}
+        assert "schedulerWait" in names          # queue wait
+        assert "segmentExecution" in names       # plan/execute phase
+        assert "segment" in names                # per-segment spans
+        assert "queryProcessing" in names
+        assert "responseSerialization" in names  # DataTable serde
+        segs = _find_all(servers[0], lambda n: n == "segment")
+        assert len(segs) == 2                    # 2 of 4 segments each
+        for s in segs:
+            assert s["attrs"]["segment"].startswith("obs_")
+    # flat per-participant view still present (back-compat)
+    assert set(resp.trace_info) == {"broker", "Server_0", "Server_1"}
+    # every span id referenced as a parent exists or is the root's link
+    all_spans = [s for spans in resp.trace_info.values() for s in spans]
+    ids = {s["spanId"] for s in all_spans}
+    dangling = [s for s in all_spans
+                if s["parentId"] is not None and s["parentId"] not in ids]
+    assert not dangling
+
+
+def test_untraced_query_has_no_tree_and_no_trace_metadata(obs_cluster):
+    resp = obs_cluster.query("SELECT COUNT(*) FROM baseballStats")
+    assert resp.trace_tree is None and resp.trace_info is None
+    assert "traceTree" not in resp.to_json()
+
+
+def test_broker_rolling_table_stats_populate(obs_cluster):
+    obs_cluster.query("SELECT SUM(runs) FROM baseballStats")
+    snap = obs_cluster.broker.table_stats.snapshot("baseballStats")
+    assert snap["queries"] >= 1
+    assert snap["segmentsProcessed"] >= 4        # 4 segments, 2 servers
+    assert sum(snap["paths"].values()) >= 4      # every segment attributed
+    assert snap["recent"][-1]["timeUsedMs"] > 0
+
+
+def test_metrics_endpoints_all_three_components(obs_cluster):
+    obs_cluster.query("SELECT COUNT(*) FROM baseballStats")
+    ports = {"broker": obs_cluster.broker_port,
+             "controller": obs_cluster.controller_port}
+    ports.update({name.lower(): p for name, p
+                  in obs_cluster.server_http_ports.items()})
+    for component, port in ports.items():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert _validate_exposition(text) > 0, component
+    # the broker rung must include the query counter; servers theirs
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{obs_cluster.broker_port}/metrics") as r:
+        assert b"pinot_broker_queries_total" in r.read()
+    any_server = next(iter(obs_cluster.server_http_ports.values()))
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{any_server}/metrics") as r:
+        assert b"pinot_server_queries_total" in r.read()
+
+
+def test_table_stats_endpoint_honors_acl(obs_cluster):
+    from pinot_tpu.broker.access_control import TableAclAccessControl
+    obs_cluster.query("SELECT COUNT(*) FROM baseballStats")
+    url = (f"http://127.0.0.1:{obs_cluster.broker_port}"
+           "/debug/tableStats")
+    old = obs_cluster.broker.access_control
+    obs_cluster.broker.access_control = TableAclAccessControl(
+        {"baseballStats": ["sekrit"]})
+    try:
+        # table-scoped view: denied without the token
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/baseballStats", timeout=10)
+        assert e.value.code == 403
+        # all-tables view: filtered, not denied
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert "baseballStats" not in json.loads(r.read())
+        req = urllib.request.Request(
+            f"{url}/baseballStats",
+            headers={"Authorization": "Bearer sekrit"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["queries"] >= 1
+    finally:
+        obs_cluster.broker.access_control = old
+
+
+def test_slow_log_integration_via_broker(obs_cluster):
+    base = tempfile.mkdtemp()
+    path = os.path.join(base, "slow.jsonl")
+    old = obs_cluster.broker.slow_log
+    obs_cluster.broker.slow_log = SlowQueryLog(path, threshold_ms=0.0)
+    try:
+        obs_cluster.query("SELECT MAX(runs) FROM baseballStats "
+                          "OPTION(trace=true)")
+    finally:
+        obs_cluster.broker.slow_log = old
+    with open(path) as fh:
+        entries = [json.loads(ln) for ln in fh]
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["table"] == "baseballStats"
+    assert "MAX(runs)" in e["pql"]
+    assert e["traceId"] and e["timeUsedMs"] > 0
+    assert e["numServersResponded"] == 2
