@@ -260,6 +260,10 @@ class ServerMeter:
     SEGMENT_DOWNLOADS = "segmentDownloads"
     SEGMENT_LOCAL_RELOADS = "segmentLocalReloads"
     SEGMENT_CRC_MISMATCHES = "segmentCrcMismatches"
+    # primary-key upsert: rows that superseded an existing key / docs
+    # invalidated in validDocIds bitmaps
+    UPSERTED_ROWS = "upsertedRows"
+    MASKED_DOCS = "maskedDocs"
 
 
 class ControllerMeter:
@@ -285,3 +289,4 @@ class ServerGauge:
     DOCUMENT_COUNT = "documentCount"
     SEGMENT_COUNT = "segmentCount"
     LLC_PARTITION_CONSUMING = "llcPartitionConsuming"
+    UPSERT_KEY_MAP_SIZE = "upsertKeyMapSize"

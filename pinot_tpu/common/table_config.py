@@ -123,6 +123,39 @@ class SegmentsConfig:
 
 
 @dataclasses.dataclass
+class UpsertConfig:
+    """Primary-key upsert configuration.
+
+    Parity: the reference's later-version UpsertConfig (mode FULL: the
+    latest row per primary key wins; superseded rows are masked at query
+    time via per-segment validDocIds). The primary key is one or more
+    schema columns; the stream must partition rows by key so one
+    partition owns each key's history (the standard Pinot deployment
+    assumption — the key map is per-partition).
+    """
+    mode: str = "NONE"                   # NONE | FULL
+    primary_key_columns: List[str] = dataclasses.field(default_factory=list)
+    # snapshot the key map + validDocIds at every segment seal, so a
+    # restarted server converges without replaying the topic from zero
+    enable_snapshot: bool = True
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode.upper() == "FULL"
+
+    def to_json(self) -> dict:
+        return {"mode": self.mode.upper(),
+                "primaryKeyColumns": list(self.primary_key_columns),
+                "enableSnapshot": self.enable_snapshot}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "UpsertConfig":
+        return cls(mode=str(d.get("mode", "NONE")).upper(),
+                   primary_key_columns=list(d.get("primaryKeyColumns") or []),
+                   enable_snapshot=bool(d.get("enableSnapshot", True)))
+
+
+@dataclasses.dataclass
 class TenantConfig:
     broker: str = "DefaultTenant"
     server: str = "DefaultTenant"
@@ -180,6 +213,7 @@ class TableConfig:
     indexing_config: IndexingConfig = dataclasses.field(default_factory=IndexingConfig)
     tenant_config: TenantConfig = dataclasses.field(default_factory=TenantConfig)
     quota_config: Optional[QuotaConfig] = None
+    upsert_config: Optional[UpsertConfig] = None
     routing_config: RoutingConfig = dataclasses.field(
         default_factory=RoutingConfig)
     custom_config: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -205,6 +239,8 @@ class TableConfig:
             d["task"] = {"taskTypeConfigsMap": self.task_configs}
         if self.quota_config:
             d["quota"] = self.quota_config.to_json()
+        if self.upsert_config:
+            d["upsertConfig"] = self.upsert_config.to_json()
         routing = self.routing_config.to_json()
         if routing:
             d["routing"] = routing
@@ -228,6 +264,8 @@ class TableConfig:
             tenant_config=TenantConfig.from_json(d.get("tenants", {})),
             quota_config=(QuotaConfig.from_json(d["quota"]) if d.get("quota")
                           else None),
+            upsert_config=(UpsertConfig.from_json(d["upsertConfig"])
+                           if d.get("upsertConfig") else None),
             custom_config=(d.get("metadata", {}) or {}).get("customConfigs", {}),
             routing_config=RoutingConfig.from_json(d.get("routing", {})
                                                    or {}),

@@ -105,6 +105,7 @@ class ResourceManager:
                   assignment: str = "balanced") -> str:
         table = config.table_name_with_type
         _validate_table_config(config)
+        self._validate_upsert_config(config)
         tenant = config.tenant_config.server or DEFAULT_TENANT
         if tenant != DEFAULT_TENANT and not self.server_instances_for(
                 config):
@@ -124,6 +125,44 @@ class ResourceManager:
                                          self.coordinator.ideal_state(table))
         self.refresh_broker_resource(table, config)
         return table
+
+    def _validate_upsert_config(self, config: TableConfig) -> None:
+        """Upsert tables must be REALTIME with single-value primary-key
+        columns the schema defines (parity: TableConfigUtils
+        validateUpsertConfig — reject at create time, not first use)."""
+        uc = config.upsert_config
+        if uc is None:
+            return
+        if uc.mode.upper() not in ("NONE", "FULL"):
+            # an unrecognized mode must fail loudly, not silently
+            # disable dedup (only FULL is implemented; PARTIAL is not)
+            raise InvalidTableConfigError(
+                f"unsupported upsert mode {uc.mode!r}; supported: "
+                "NONE, FULL")
+        if not uc.enabled:
+            return
+        from pinot_tpu.common.table_config import TableType
+        if config.table_type != TableType.REALTIME:
+            raise InvalidTableConfigError(
+                "upsert mode FULL requires a REALTIME table")
+        if not uc.primary_key_columns:
+            raise InvalidTableConfigError(
+                "upsert mode FULL requires primaryKeyColumns")
+        schema = self.get_schema(config.table_name)
+        if schema is None:
+            raise InvalidTableConfigError(
+                f"upsert table '{config.table_name}' needs its schema "
+                "registered first")
+        fields = {f.name: f for f in schema.fields}
+        for col in uc.primary_key_columns:
+            field = fields.get(col)
+            if field is None:
+                raise InvalidTableConfigError(
+                    f"upsert primary key column '{col}' not in schema")
+            if not field.single_value:
+                raise InvalidTableConfigError(
+                    f"upsert primary key column '{col}' must be "
+                    "single-value")
 
     # -- tenants -----------------------------------------------------------
     def server_instances_for(self, config: TableConfig) -> List[str]:

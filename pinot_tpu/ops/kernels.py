@@ -98,6 +98,12 @@ def _eval_pred(kind: str, source: str, extra, lane, params: List):
     elif kind == "member":
         member = params.pop(0)  # bool [card_pad]
         m = member[jnp.clip(lane, 0, member.shape[0] - 1)]
+    elif kind == "vdoc":
+        # upsert validDocIds mask: the lane IS the per-doc liveness bool
+        # (runtime operand — one compiled executable serves any bitmap);
+        # fused into the filter mask so aggregation/group/selection all
+        # see only live rows
+        m = lane
     else:
         raise ValueError(f"unknown predicate kind {kind}")
     if source == "mv":
@@ -122,7 +128,8 @@ def _eval_filter(spec, cols: Dict[str, jnp.ndarray], params: List, valid):
         return out
     if op == "pred":
         _, kind, col, source, extra = spec
-        key = {"sv": f"{col}.ids", "mv": f"{col}.mv", "raw": f"{col}.raw"}[source]
+        key = {"sv": f"{col}.ids", "mv": f"{col}.mv", "raw": f"{col}.raw",
+               "vdoc": f"{col}.vdoc"}[source]
         return _eval_pred(kind, source, extra, cols[key], params)
     raise ValueError(f"unknown filter node {op}")
 

@@ -301,45 +301,68 @@ class StackedSegments:
         self.num_docs[: self.n_real] = [s.num_docs for s in self.segments]
         self._dev_num_docs = None
         self._lanes: Dict[Tuple[str, str], object] = {}
+        # upsert validDocIds lane: keyed by every segment's bitmap
+        # version so invalidations landing after the stack was cached
+        # re-upload a fresh [S, P] mask (other lanes are immutable);
+        # the host array persists so only CHANGED segments' rows are
+        # recomputed, and the lock keeps concurrent queries from
+        # mutating it mid-upload
+        self._vdoc_cache: Optional[Tuple[tuple, object]] = None
+        self._vdoc_host: Optional[np.ndarray] = None
+        # guards every cache publish on this stack (lanes, union
+        # columns, plan segment, vdoc): queries build lanes from
+        # concurrent scheduler workers; heavy builds happen OUTSIDE the
+        # lock (first-writer-wins publish), only the vdoc rebuild holds
+        # it (in-place host-array mutation)
+        self._cache_lock = threading.Lock()
         # col -> None (dictionaries shared) | _UnionColumn (remap needed)
         self._union: Dict[str, Optional["_UnionColumn"]] = {}
         self._plan_segment = None
 
     def union_column(self, col: str) -> Optional["_UnionColumn"]:
         """None when every segment shares the column's dictionary; else
-        the union-dictionary remap artifacts (built once per column)."""
-        if col not in self._union:
-            srcs = [s.data_source(col) for s in self.segments]
-            d0 = srcs[0].dictionary
-            if d0 is None:
-                self._union[col] = None       # raw column: no id domain
-            elif all(np.array_equal(s.dictionary.values, d0.values)
-                     for s in srcs[1:]):
-                self._union[col] = None
-            else:
-                self._union[col] = _UnionColumn(col, srcs)
-        return self._union[col]
+        the union-dictionary remap artifacts (built once per column).
+        Racing builders duplicate work; the first published wins."""
+        with self._cache_lock:
+            if col in self._union:
+                return self._union[col]
+        srcs = [s.data_source(col) for s in self.segments]
+        d0 = srcs[0].dictionary
+        if d0 is None:
+            union = None                  # raw column: no id domain
+        elif all(np.array_equal(s.dictionary.values, d0.values)
+                 for s in srcs[1:]):
+            union = None
+        else:
+            union = _UnionColumn(col, srcs)
+        with self._cache_lock:
+            return self._union.setdefault(col, union)
 
     def plan_segment(self) -> ImmutableSegment:
         """Segment view queries plan against: segment 0 with every
         differing-dictionary column replaced by its union view, so
         literal→id binding, part encodings and group decode tables all
         live in the union id domain the stacked lanes use."""
-        if self._plan_segment is None:
-            self._plan_segment = _UnionViewSegment(self)
-        return self._plan_segment
+        with self._cache_lock:
+            if self._plan_segment is None:
+                self._plan_segment = _UnionViewSegment(self)
+            return self._plan_segment
 
     def device_num_docs(self):
-        if self._dev_num_docs is None:
-            self._dev_num_docs = jax.device_put(
-                self.num_docs, NamedSharding(self.mesh, P(SEG_AXIS)))
-        return self._dev_num_docs
+        with self._cache_lock:
+            if self._dev_num_docs is None:
+                self._dev_num_docs = jax.device_put(
+                    self.num_docs, NamedSharding(self.mesh, P(SEG_AXIS)))
+            return self._dev_num_docs
 
     def lane(self, col: str, kind: str):
-        """Sharded [n_total, ...] device array for one column lane."""
+        """Sharded [n_total, ...] device array for one column lane.
+        Heavy stack/upload work runs outside the cache lock; racing
+        builders duplicate the upload and the first published wins."""
         key = (col, kind)
-        if key in self._lanes:
-            return self._lanes[key]
+        with self._cache_lock:
+            if key in self._lanes:
+                return self._lanes[key]
         union = self.union_column(col) \
             if kind in ("ids", "mv", "vals", "parts", "vlane") else None
         if union is not None:
@@ -354,8 +377,8 @@ class StackedSegments:
             # dictionary values are identical (or the union table);
             # replicate instead of sharding
             out = jax.device_put(arrs[0], NamedSharding(self.mesh, P()))
-            self._lanes[key] = out
-            return out
+            with self._cache_lock:
+                return self._lanes.setdefault(key, out)
         if kind == "mv":
             w = max(a.shape[1] for a in arrs)
             arrs = [np.pad(a, ((0, 0), (0, w - a.shape[1])),
@@ -372,8 +395,8 @@ class StackedSegments:
                              pad_val, stacked.dtype)
             stacked = np.concatenate([stacked, filler])
         out = jax.device_put(stacked, NamedSharding(self.mesh, P(SEG_AXIS)))
-        self._lanes[key] = out
-        return out
+        with self._cache_lock:
+            return self._lanes.setdefault(key, out)
 
     def _union_operand(self, union: _UnionColumn, i: int,
                        kind: str) -> np.ndarray:
@@ -402,10 +425,58 @@ class StackedSegments:
                 remap[ds.host_operand("ids").astype(np.int64)]]
         raise ValueError(kind)
 
+    def vdoc_lane(self):
+        """Sharded [n_total, padded] bool upsert liveness lane; segments
+        without a bitmap (or with none invalid) contribute all-True.
+        Incremental: only segments whose bitmap version moved since the
+        last build have their row recomputed (steady upserts bump ONE
+        segment per batch; an O(S*P) rebuild per query would dwarf the
+        mask's benefit)."""
+        versions = tuple(
+            vd.version if (vd := getattr(s, "valid_doc_ids", None))
+            is not None else -1
+            for s in self.segments)
+        cached = self._vdoc_cache
+        if cached is not None and cached[0] == versions:
+            return cached[1]
+        with self._cache_lock:
+            cached = self._vdoc_cache
+            if cached is not None and cached[0] == versions:
+                return cached[1]
+            old = cached[0] if cached is not None else None
+            host = self._vdoc_host
+            if host is None:
+                host = np.zeros((self.n_total, self.padded_docs),
+                                dtype=bool)
+                old = None
+            for i, s in enumerate(self.segments):
+                if old is not None and old[i] == versions[i]:
+                    continue
+                vd = getattr(s, "valid_doc_ids", None)
+                row = host[i]
+                row[:] = False
+                if vd is None:
+                    row[: s.num_docs] = True
+                else:
+                    row[: s.num_docs] = vd.valid_mask(0, s.num_docs)
+            # upload a COPY: newer jax CPU backends may zero-copy numpy
+            # input, and the next incremental rebuild mutates `host` in
+            # place — aliasing would corrupt the cached device lane
+            out = jax.device_put(host.copy(),
+                                 NamedSharding(self.mesh, P(SEG_AXIS)))
+            self._vdoc_host = host
+            self._vdoc_cache = (versions, out)
+            return out
+
     def gather(self, needed_cols) -> Dict[str, object]:
         # lane keys are "<col>.<kind>" — the same names the kernels read
-        return {f"{col}.{kind}": self.lane(col, kind)
-                for col, kind in needed_cols}
+        cols: Dict[str, object] = {}
+        for col, kind in needed_cols:
+            if kind == "vdoc":
+                cols[f"{col}.vdoc"] = self.vdoc_lane()
+            else:
+                cols[f"{col}.{kind}"] = self.lane(col, kind)
+        return cols
 
 
 # ---------------------------------------------------------------------------
@@ -531,6 +602,23 @@ class ShardedQueryExecutor:
                             "across segments")
         plan = plan0 if not needs_union else \
             self.plan_maker.make_segment_plan(seg0, request)
+
+        # upsert validDocIds: if ANY stacked segment has superseded rows
+        # the mask predicate must cover the WHOLE stack (planning against
+        # segment 0 alone would miss other segments' masks). The wrap is
+        # param-free, so plan params/strides are untouched; plans that
+        # already carry the pred (segment 0 itself masked) pass through.
+        from pinot_tpu.query.plan import (upsert_mask_active,
+                                          with_valid_doc_mask,
+                                          VALID_DOC_COLUMN)
+        if any(upsert_mask_active(s) for s in stack.segments) and \
+                plan.filter_spec is not None:
+            import copy as _copy
+            plan = _copy.copy(plan)
+            plan.filter_spec = with_valid_doc_mask(plan.filter_spec)
+            if (VALID_DOC_COLUMN, "vdoc") not in plan.needed_cols:
+                plan.needed_cols = plan.needed_cols + (
+                    (VALID_DOC_COLUMN, "vdoc"),)
 
         cols = stack.gather(plan.needed_cols)
         lane_keys = tuple(sorted(cols.keys()))

@@ -25,12 +25,19 @@ def _count_filter_leaves(spec) -> int:
         return 0
     if spec[0] in ("and", "or"):
         return sum(_count_filter_leaves(c) for c in spec[1])
+    if spec[0] == "pred" and spec[1] == "vdoc":
+        return 0      # upsert mask: engine-injected, not a query leaf
     return 1
 
 
 def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
     cols: Dict[str, object] = {}
     for col, kind in needed_cols:
+        if kind == "vdoc":
+            # upsert validDocIds: a pseudo-column liveness lane served
+            # by the segment itself (version-cached device upload)
+            cols[f"{col}.vdoc"] = segment.device_valid_lane()
+            continue
         ds = segment.data_source(col)
         if kind == "ids":
             cols[f"{col}.ids"] = ds.device_dict_ids()

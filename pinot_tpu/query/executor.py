@@ -76,8 +76,10 @@ class ServerQueryExecutor:
             selected = self.pruner.prune(segments, request)
         num_pruned = len(segments) - len(selected)
 
+        from pinot_tpu.query.plan import upsert_mask_active
         if request.is_aggregation and not request.is_selection and \
                 len(selected) > 1 and \
+                not any(upsert_mask_active(s) for s in selected) and \
                 all(getattr(s, "star_trees", None) for s in selected):
             from pinot_tpu.startree.executor import \
                 try_star_tree_execute_multi
@@ -257,7 +259,9 @@ class ServerQueryExecutor:
 
     def _execute_segment(self, segment: ImmutableSegment,
                          request: BrokerRequest) -> IntermediateResultsBlock:
+        from pinot_tpu.query.plan import upsert_mask_active
         if request.is_aggregation and not request.is_selection and \
+                not upsert_mask_active(segment) and \
                 getattr(segment, "star_trees", None):
             from pinot_tpu.startree.executor import try_star_tree_execute
             blk = try_star_tree_execute(segment, request)

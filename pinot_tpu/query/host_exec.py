@@ -41,9 +41,27 @@ from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
 from pinot_tpu.segment.loader import DataSource, ImmutableSegment
 
 
+def _upsert_valid_mask(segment) -> Optional[np.ndarray]:
+    """Per-doc liveness mask for upsert tables, or None. Mutable
+    snapshot views carry a PINNED `valid_doc_mask`; immutable segments
+    snapshot their live ValidDocIds bitmap here (realtime/upsert.py)."""
+    vm = getattr(segment, "valid_doc_mask", None)
+    if vm is not None:
+        return vm
+    vd = getattr(segment, "valid_doc_ids", None)
+    if vd is not None and vd.num_invalid:
+        return vd.valid_mask(0, segment.num_docs)
+    return None
+
+
 def execute_host(segment: ImmutableSegment, request: BrokerRequest
                  ) -> IntermediateResultsBlock:
     mask = _eval_filter(request.filter, segment)
+    vm = _upsert_valid_mask(segment)
+    if vm is not None:
+        # superseded rows are masked BEFORE any aggregation/selection —
+        # the host half of the host-vs-device upsert parity contract
+        mask = mask & vm
     blk = IntermediateResultsBlock()
     matched = int(mask.sum())
 

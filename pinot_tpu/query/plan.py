@@ -57,6 +57,44 @@ class UnsupportedOnDevice(Exception):
 MATCH_ALL = ("match_all",)
 EMPTY = ("empty",)
 
+# -- upsert validDocIds masking ---------------------------------------------
+# A segment whose table runs primary-key upserts carries a ValidDocIds
+# bitmap (realtime/upsert.py); superseded rows must be masked on EVERY
+# result path. On device the mask rides as one more fused filter
+# predicate over a pseudo-column lane ("$validDocIds.vdoc", a bool [P]
+# runtime operand) so COUNT/SUM/GROUP BY/selection agree bit-for-bit
+# with the host oracle without new kernel machinery.
+
+VALID_DOC_COLUMN = "$validDocIds"
+VALID_DOC_PRED = ("pred", "vdoc", VALID_DOC_COLUMN, "vdoc", None)
+
+
+def upsert_mask_active(segment) -> bool:
+    """True when the segment has superseded rows to mask. An upsert
+    segment with zero invalidations plans mask-free (no lane upload);
+    the first invalidation changes the static spec, which just compiles
+    one more cached kernel variant."""
+    vd = getattr(segment, "valid_doc_ids", None)
+    return vd is not None and vd.num_invalid > 0
+
+
+def has_valid_doc_mask(spec) -> bool:
+    if spec == VALID_DOC_PRED:
+        return True
+    return spec is not None and spec[0] == "and" and \
+        VALID_DOC_PRED in spec[1]
+
+
+def with_valid_doc_mask(spec):
+    """AND the validDocIds predicate into a resolved filter spec. The
+    predicate consumes no params, so prepending it never perturbs the
+    depth-first param order of the original tree."""
+    if spec == EMPTY or has_valid_doc_mask(spec):
+        return spec
+    if spec is None or spec == MATCH_ALL:
+        return VALID_DOC_PRED
+    return ("and", (VALID_DOC_PRED, spec))
+
 
 def resolve_filter(tree: Optional[FilterQueryTree], segment: ImmutableSegment
                    ) -> Tuple[tuple, List]:
@@ -398,9 +436,14 @@ class InstancePlanMaker:
         if request.is_aggregation:
             plan.functions = make_functions(request.aggregations)
 
+        # upsert masking disables every whole-segment shortcut below:
+        # metadata counts, star-tree cubes and inverted-index counts all
+        # include superseded rows
+        masked = upsert_mask_active(segment)
+
         # fast path: no filter, metadata/dictionary-answerable aggregations
         if request.is_aggregation and not request.is_group_by and \
-                request.filter is None and \
+                request.filter is None and not masked and \
                 self._try_metadata_fast_path(plan, segment, request):
             return plan
 
@@ -409,6 +452,7 @@ class InstancePlanMaker:
         # This hook serves the sharded path (which plans directly); the
         # sequential path already checked in ServerQueryExecutor.
         if request.is_aggregation and not request.is_selection and \
+                not masked and \
                 getattr(segment, "star_trees", None):
             from pinot_tpu.startree.executor import try_star_tree_execute
             blk = try_star_tree_execute(segment, request)
@@ -424,7 +468,7 @@ class InstancePlanMaker:
 
         # fast path: COUNT(*) on a pure match-all filter
         if filter_spec == MATCH_ALL and request.is_aggregation and \
-                not request.is_group_by and \
+                not masked and not request.is_group_by and \
                 all(f.info.base == "COUNT" and not f.info.is_mv
                     for f in plan.functions):
             blk = IntermediateResultsBlock(
@@ -434,7 +478,8 @@ class InstancePlanMaker:
             return plan
 
         # fast path: COUNT(*) + single EQ/IN leaf answered by inverted index
-        if request.is_aggregation and not request.is_group_by and \
+        if request.is_aggregation and not masked and \
+                not request.is_group_by and \
                 all(f.info.base == "COUNT" and not f.info.is_mv
                     for f in plan.functions):
             cnt = self._try_inverted_count(segment, filter_spec, params)
@@ -445,6 +490,8 @@ class InstancePlanMaker:
                 plan.fast_path_result = blk
                 return plan
 
+        if masked:
+            filter_spec = with_valid_doc_mask(filter_spec)
         plan.filter_spec = filter_spec
         plan.params = params
 
@@ -1107,7 +1154,8 @@ def _collect_filter_cols(spec: tuple, needed: Dict) -> None:
             _collect_filter_cols(c, needed)
     elif spec[0] == "pred":
         _, kind, col, source, _ = spec
-        needed[(col, {"sv": "ids", "mv": "mv", "raw": "raw"}[source])] = None
+        needed[(col, {"sv": "ids", "mv": "mv", "raw": "raw",
+                      "vdoc": "vdoc"}[source])] = None
 
 
 def selection_columns(segment: ImmutableSegment, request: BrokerRequest

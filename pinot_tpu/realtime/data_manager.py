@@ -54,7 +54,11 @@ class RealtimeSegmentDataManager:
     def __init__(self, llc: LLCSegmentName, table: str, schema,
                  table_config, stream_config: StreamConfig,
                  start_offset: int, completion, instance_id: str,
-                 table_data_manager, work_dir: str, stats_history=None):
+                 table_data_manager, work_dir: str, stats_history=None,
+                 upsert=None, upsert_key_fn=None, metrics=None):
+        """`upsert`: the table's PartitionUpsertMetadata for this stream
+        partition (realtime/upsert.py) — None for non-upsert tables;
+        `upsert_key_fn`: row dict → normalized primary-key tuple."""
         self.llc = llc
         self.table = table
         self.stream_config = stream_config
@@ -65,6 +69,9 @@ class RealtimeSegmentDataManager:
         self.offset = int(start_offset)
         self.state = CONSUMING_STATE
         self.stats_history = stats_history
+        self.upsert = upsert
+        self.upsert_key_fn = upsert_key_fn
+        self.metrics = metrics
         # how often the build-time lease extender pings the controller
         self.lease_extend_interval_s = 10.0
         # allocation sizing from the table's completed-segment history
@@ -72,6 +79,11 @@ class RealtimeSegmentDataManager:
         hint = stats_history.estimate(table) if stats_history else None
         self.mutable = MutableSegmentImpl(schema, table_config, llc.name,
                                           stats_hint=hint)
+        if self.upsert is not None:
+            # reuse the restored bitmap: a restarted consumer re-applies
+            # the same (key, doc) assignments onto the same bits
+            self.mutable.valid_doc_ids = \
+                self.upsert.register_consuming(llc.sequence)
         self.consumer = stream_config.consumer_factory \
             .create_partition_consumer(stream_config, llc.partition)
         self.decoder = stream_config.decoder
@@ -150,8 +162,43 @@ class RealtimeSegmentDataManager:
                           "at offset %d", msg.offset)
                 continue
             rows.append(row)
+        keys = None
+        if self.upsert is not None:
+            # extract keys BEFORE indexing; rows whose primary key is
+            # missing/unconvertible are dropped like any other poison
+            # record (never kill the partition consumer, and an
+            # unindexed row needs no map entry)
+            keys, keyed_rows = [], []
+            for row in rows:
+                k = self.upsert_key_fn(row)
+                if k is None:
+                    log.debug("dropping row with missing/invalid "
+                              "primary key in %s", self.llc.name)
+                    continue
+                keys.append(k)
+                keyed_rows.append(row)
+            rows = keyed_rows
         # batch indexing: one column-at-a-time pass over the fetch batch
         self.mutable.index_rows(rows)
+        if self.upsert is not None and rows:
+            # fold the batch into the partition key map AFTER indexing
+            # (docs default-valid, so queries never under-count in the
+            # index→apply window) and journal the deltas for recovery
+            base = self.mutable.num_docs - len(rows)
+            before_masked = self.upsert.masked_docs
+            upserts = self.upsert.apply_batch(
+                self.llc.sequence,
+                [(k, base + i) for i, k in enumerate(keys)],
+                int(batch.next_offset))
+            if self.metrics is not None:
+                from pinot_tpu.common.metrics import ServerMeter
+                if upserts:
+                    self.metrics.meter(ServerMeter.UPSERTED_ROWS,
+                                       self.table).mark(upserts)
+                masked = self.upsert.masked_docs - before_masked
+                if masked:
+                    self.metrics.meter(ServerMeter.MASKED_DOCS,
+                                       self.table).mark(masked)
         self.offset = max(self.offset, batch.next_offset)  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
 
     # -- completion protocol (server side) ---------------------------------
@@ -247,6 +294,10 @@ class RealtimeSegmentDataManager:
         if self.stats_history is not None:
             self.stats_history.add_segment_stats(
                 self.table, self.mutable.collect_stats())
+        # capture BEFORE commit_end: the CONSUMING→ONLINE swap destroys
+        # the mutable before commit_end returns (num_docs survives as an
+        # int, but take no chances on ordering)
+        sealed_docs = int(self.mutable.num_docs)
         resp = self.completion.commit_end(self.table, self.llc.name,
                                           self.instance_id, self.offset,
                                           out_dir)
@@ -255,6 +306,17 @@ class RealtimeSegmentDataManager:
                         resp.status)
             self._enter_error(f"commit_end failed: {resp.status}")
             return
+        if self.upsert is not None:
+            # SEAL: durably snapshot the key map + validDocIds and
+            # truncate the journal. Crash-safe at any instruction — a
+            # loss here just replays the (longer) journal on restart;
+            # IO failures are advisory (the fold path re-derives masks)
+            try:
+                self.upsert.seal(self.llc.sequence, self.offset,
+                                 sealed_docs)
+            except OSError:
+                log.warning("upsert seal failed for %s", self.llc.name,
+                            exc_info=True)
         self.state = COMMITTED  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
 
 
@@ -283,8 +345,42 @@ class RealtimeTableDataManager:
         self.stats_history = RealtimeSegmentStatsHistory(
             os.path.join(work_dir, "stats_history.json"))
         self._consuming: Dict[str, RealtimeSegmentDataManager] = {}
+        # table → TableUpsertMetadataManager (realtime/upsert.py); built
+        # lazily from the table config's upsertConfig
+        self._upsert: Dict[str, Optional[object]] = {}
         self._closed = False
         self._lock = threading.Lock()
+
+    def upsert_manager(self, table: str):
+        """The table's upsert metadata manager, or None when the table
+        config carries no (enabled) upsertConfig. Only REAL managers are
+        cached — a transiently missing config (transition racing config
+        availability, or a table re-created with upsert enabled) must
+        not silently disable dedup for the table's lifetime."""
+        with self._lock:
+            mgr = self._upsert.get(table)
+        if mgr is not None:
+            return mgr
+        config = self.manager.get_table_config(table)
+        uc = getattr(config, "upsert_config", None) if config else None
+        if uc is None or not uc.enabled:
+            return None
+        from pinot_tpu.realtime.upsert import TableUpsertMetadataManager
+        schema = self.manager.get_schema(raw_table(table))
+        if schema is None:
+            raise ValueError(f"missing schema for upsert table {table}")
+        mgr = TableUpsertMetadataManager(
+            table, uc, schema,
+            os.path.join(self.work_dir, "upsert", table))
+        with self._lock:
+            winner = self._upsert.setdefault(table, mgr)
+        if winner is mgr:
+            # gauge binds only to the instance that WON the setdefault —
+            # a racing loser's callable would pin the metric at 0
+            metrics = getattr(self.server, "metrics", None)
+            if metrics is not None:
+                mgr.register_metrics(metrics)
+        return winner
 
     def consuming_state(self, segment: str) -> Optional[str]:
         with self._lock:
@@ -316,6 +412,9 @@ class RealtimeTableDataManager:
         stream_config = resolve_stream_config(config)
         llc = LLCSegmentName.parse(segment)
         tdm = self.server.data_manager.table(table, create=True)
+        um = self.upsert_manager(table)
+        upsert_part = um.partition(llc.partition) if um is not None \
+            else None
         # construct (which starts the consumer thread) under the lock so a
         # concurrent shutdown() can never miss a just-started consumer
         with self._lock:
@@ -326,7 +425,10 @@ class RealtimeTableDataManager:
                 int(meta["startOffset"]), self.completion,
                 self.server.instance_id, tdm,
                 os.path.join(self.work_dir, table),
-                stats_history=self.stats_history)
+                stats_history=self.stats_history,
+                upsert=upsert_part,
+                upsert_key_fn=um.key_of if um is not None else None,
+                metrics=getattr(self.server, "metrics", None))
 
     def on_segment_online(self, table: str, segment: str) -> None:
         """CONSUMING→ONLINE (or OFFLINE→ONLINE for a committed LLC
@@ -349,6 +451,13 @@ class RealtimeTableDataManager:
             from pinot_tpu.segment.integrity import verify_segment
             verify_segment(path, meta.get("crc"))
         seg = ImmutableSegmentLoader.load(path)
+        um = self.upsert_manager(table)
+        if um is not None:
+            # attach the partition's validDocIds (or FOLD the segment's
+            # primary keys when no durable coverage exists — the loser-
+            # download and lost-snapshot convergence path) BEFORE the
+            # segment becomes queryable
+            um.on_committed_segment(segment, seg)
         self.server.data_manager.table(table, create=True).add_segment(seg)
 
     def on_segment_offline(self, table: str, segment: str) -> None:
@@ -365,5 +474,9 @@ class RealtimeTableDataManager:
             self._closed = True
             rdms = list(self._consuming.values())
             self._consuming.clear()
+            upserts = [m for m in self._upsert.values() if m is not None]
+            self._upsert.clear()
         for rdm in rdms:
             rdm.stop()
+        for um in upserts:
+            um.close()

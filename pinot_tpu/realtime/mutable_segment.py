@@ -389,6 +389,12 @@ class MutableSegmentView:
         self.start = start
         self.num_docs = impl._num_docs - start
         self._sources: Dict[str, _SnapshotSource] = {}
+        # upsert validDocIds: PIN the liveness mask for this view's rows
+        # at snapshot time, so the filter mask and every column lane
+        # agree even while the upsert fold keeps invalidating docs
+        vd = impl.valid_doc_ids
+        self.valid_doc_mask = None if vd is None or not vd.num_invalid \
+            else vd.valid_mask(start, start + self.num_docs)
 
     @property
     def padded_docs(self) -> int:
@@ -459,6 +465,11 @@ class MutableSegmentImpl:
         self._end_time: Optional[int] = None
         self._frozen = None                  # sorted device snapshot
         self._freeze_lock = threading.Lock()
+        # primary-key upsert liveness bitmap (realtime/upsert.py):
+        # attached by the realtime data manager when the table runs
+        # upserts; shared with the frozen device snapshot and inherited
+        # by the committed immutable segment (docIds survive conversion)
+        self.valid_doc_ids = None
         self.creation_time_ms = int(time.time() * 1e3)
         # freshness: when the most recent row was indexed (parity: the
         # lastIndexedTimestamp feeding minConsumingFreshnessTimeMs)
@@ -646,6 +657,10 @@ class MutableSegmentImpl:
         seg = ImmutableSegment(meta, sources)
         for ds in sources.values():
             ds._segment = seg
+        # the frozen prefix shares the LIVE bitmap: rows [0, n) stay
+        # maskable when a later (tail/committed) row supersedes them;
+        # device lanes refresh via the bitmap version
+        seg.valid_doc_ids = self.valid_doc_ids
         return seg
 
     @property

@@ -239,6 +239,11 @@ class ImmutableSegment:
         self.metadata = metadata
         self._data_sources = data_sources
         self.star_trees = []     # pre-aggregated cubes (startree/cube.py)
+        # primary-key upsert liveness bitmap (realtime/upsert.py); None
+        # for non-upsert tables. Attached by the realtime data manager
+        # when the committed segment swaps in / cold-start loads.
+        self.valid_doc_ids = None
+        self._valid_dev = None   # (bitmap version, padded device lane)
 
     @property
     def segment_name(self) -> str:
@@ -302,6 +307,22 @@ class ImmutableSegment:
         ds.dict_ids = np.zeros(n, dtype=np.int32)
         return ds
 
+    def device_valid_lane(self):
+        """Padded bool liveness lane (upsert validDocIds) on device,
+        re-uploaded only when the bitmap version changes. Rows past
+        num_docs pad False; the kernel ANDs with its row-validity iota
+        anyway."""
+        import jax.numpy as jnp
+        vd = self.valid_doc_ids
+        ver = vd.version
+        cached = self._valid_dev
+        if cached is None or cached[0] != ver:
+            host = np.zeros(self.padded_docs, dtype=bool)
+            host[: self.num_docs] = vd.valid_mask(0, self.num_docs)
+            cached = (ver, jnp.asarray(host))
+            self._valid_dev = cached  # tpulint: disable=concurrency -- benign racy single-slot cache: concurrent queries at worst duplicate one upload; tuple publish is atomic
+        return cached[1]
+
     def warm_device(self, columns=None) -> None:
         """Eagerly push forward indexes + dictionaries to HBM."""
         for name in (columns or self.column_names):
@@ -318,6 +339,7 @@ class ImmutableSegment:
                 ds.device_mv_dict_ids()
 
     def destroy(self) -> None:
+        self._valid_dev = None  # tpulint: disable=concurrency -- destroy runs after the refcounted release of the last query; worst case a racing reader re-uploads one lane
         for ds in self._data_sources.values():
             ds._dev.clear()
 

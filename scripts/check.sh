@@ -23,6 +23,16 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_crash_recovery.py \
 # must converge (zero re-downloads) within a bounded window
 env JAX_PLATFORMS=cpu python scripts/crash_restart_smoke.py
 
+echo "== upsert (mutable-scenario durability) =="
+# primary-key dedup crash gates: kill -9 mid upsert stream at each
+# seeded crash point, restart, exact-count + latest-value convergence
+# with host-vs-device masked-result parity ...
+env JAX_PLATFORMS=cpu python -m pytest tests/test_upsert.py \
+    -q -p no:cacheprovider
+# ... plus a scripted kill-restart that must converge with ZERO topic
+# re-reads before the key-map snapshot offset
+env JAX_PLATFORMS=cpu python scripts/upsert_smoke.py
+
 echo "== qps smoke (serving plane) =="
 # one short target-QPS rung over the real TCP mux: catches serving-plane
 # regressions (per-connection serialization, serde blow-ups) in seconds

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Upsert kill-restart convergence gate.
+
+Boots an embedded cluster with a primary-key upsert table, streams rows
+with heavily duplicated keys until at least one segment commits, then
+KILLS the cluster (no graceful flush of in-memory upsert state) and
+restarts over the same durable directories. The restarted cluster must,
+within a bounded window:
+
+- converge to the EXACT distinct-key row count and latest value per key
+  (COUNT(*) / SUM over the latest rows), and
+- perform ZERO topic re-reads before the key-map snapshot offset — the
+  consumer resumes at the committed boundary, proving recovery came
+  from the key-map snapshot + validDocIds sidecars + journal, not from
+  replaying the topic from zero.
+
+Exit code 0 on convergence, 1 otherwise. Env knobs:
+  UPSERT_SMOKE_ROWS      rows published (default 800)
+  UPSERT_SMOKE_WINDOW_S  convergence window after restart (default 60)
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROWS = int(os.environ.get("UPSERT_SMOKE_ROWS", "800"))
+WINDOW_S = float(os.environ.get("UPSERT_SMOKE_WINDOW_S", "60"))
+RT_TABLE = "baseballStats_REALTIME"
+TOPIC = "upsert_smoke_topic"
+FACTORY = "mem_upsert_smoke"
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — still converging
+            pass
+        time.sleep(0.1)
+    print(f"FAIL: timed out waiting for {what}", file=sys.stderr)
+    return False
+
+
+class RecordingConsumerFactory:
+    """Wraps a consumer factory, recording the smallest offset any
+    partition consumer fetched from — the re-read detector."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.min_fetch = None
+
+    def create_metadata_provider(self, cfg):
+        return self.inner.create_metadata_provider(cfg)
+
+    def create_stream_consumer(self, cfg, checkpoint=None):
+        return self.inner.create_stream_consumer(cfg, checkpoint=checkpoint)
+
+    def create_partition_consumer(self, cfg, partition):
+        consumer = self.inner.create_partition_consumer(cfg, partition)
+        outer = self
+
+        class _Wrapped:
+            def fetch_messages(self, start, end, timeout_ms):
+                outer.min_fetch = start if outer.min_fetch is None \
+                    else min(outer.min_fetch, start)
+                return consumer.fetch_messages(start, end, timeout_ms)
+
+            def close(self):
+                consumer.close()
+
+        return _Wrapped()
+
+
+def main() -> int:
+    import shutil
+
+    from pinot_tpu.common.table_config import UpsertConfig
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tests"))
+    from fixtures import make_schema
+    from test_realtime import make_rows, rt_config
+
+    base = tempfile.mkdtemp(prefix="pinot_tpu_upsert_smoke_")
+    t0 = time.monotonic()
+    stream = MemoryStream(TOPIC, num_partitions=1)
+    registry.register_stream_factory(
+        FACTORY, MemoryStreamConsumerFactory(stream, batch_size=50))
+    cfg = rt_config(FACTORY, TOPIC, flush_rows=250)
+    cfg.upsert_config = UpsertConfig(mode="FULL",
+                                     primary_key_columns=["playerName"])
+
+    cluster = EmbeddedCluster(base, num_servers=1,
+                              store_dir=os.path.join(base, "store"))
+    rows = make_rows(ROWS, seed=17)
+    latest = {}
+    for r in rows:
+        latest[r["playerName"]] = r
+    exp_cnt = len(latest)
+    exp_sum = float(sum(r["runs"] for r in latest.values()))
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(cfg)
+        for r in rows:
+            stream.publish(r, partition=0)
+        mgr = cluster.controller.manager
+
+        def committed():
+            return any((mgr.segment_metadata(RT_TABLE, s) or {}).get(
+                "status") == "DONE"
+                for s in mgr.segment_names(RT_TABLE))
+
+        if not wait_for(committed, 40, "a committed upsert segment"):
+            return 1
+        if not wait_for(
+                lambda: _count(cluster) == exp_cnt, 40,
+                "pre-kill convergence"):
+            return 1
+    finally:
+        cluster.stop()          # "kill": in-memory upsert state is gone
+    print(f"[{time.monotonic()-t0:6.1f}s] killed cluster "
+          f"(expect {exp_cnt} keys, sum {exp_sum})")
+
+    # restart with a RECORDING consumer factory: any fetch below the
+    # durable snapshot offset is a topic re-read the recovery should
+    # have avoided
+    recorder = RecordingConsumerFactory(
+        MemoryStreamConsumerFactory(stream, batch_size=50))
+    registry.register_stream_factory(FACTORY, recorder)
+    part_dir = os.path.join(base, "server_work", "Server_0", "upsert",
+                            RT_TABLE, "partition_0")
+    snaps = [f for f in os.listdir(part_dir)
+             if f.startswith("keymap-") and f.endswith(".json")]
+    if not snaps:
+        print("FAIL: no key-map snapshot on disk", file=sys.stderr)
+        return 1
+    snap_offset = json.load(open(os.path.join(
+        part_dir, max(snaps, key=lambda n: int(n[7:-5])))))["offset"]
+
+    c2 = EmbeddedCluster(base, num_servers=1,
+                         store_dir=os.path.join(base, "store"))
+    try:
+        def converged():
+            c2.controller.realtime.ensure_all_partitions_consuming()
+            resp = c2.query(
+                "SELECT COUNT(*), SUM(runs) FROM baseballStats")
+            if resp.exceptions or not resp.aggregation_results:
+                return False
+            return int(resp.aggregation_results[0].value) == exp_cnt \
+                and float(resp.aggregation_results[1].value) == exp_sum
+
+        if not wait_for(converged, WINDOW_S, "post-restart convergence"):
+            return 1
+        print(f"[{time.monotonic()-t0:6.1f}s] restarted cluster "
+              f"converged to {exp_cnt} keys")
+        if recorder.min_fetch is None or recorder.min_fetch < snap_offset:
+            print(f"FAIL: topic re-read below the snapshot offset "
+                  f"(min fetch {recorder.min_fetch} < {snap_offset})",
+                  file=sys.stderr)
+            return 1
+        print(f"[{time.monotonic()-t0:6.1f}s] zero topic re-reads before "
+              f"snapshot offset {snap_offset} "
+              f"(first fetch at {recorder.min_fetch})")
+    finally:
+        c2.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("PASS: upsert kill-restart converged with zero pre-snapshot "
+          "topic re-reads")
+    return 0
+
+
+def _count(cluster):
+    resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+    if resp.exceptions or not resp.aggregation_results:
+        return -1
+    return int(resp.aggregation_results[0].value)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,730 @@
+"""Realtime primary-key upsert: dedup semantics + crash-consistent
+recovery (ISSUE 6).
+
+Four tiers:
+
+1. **Config + bitmap semantics** — UpsertConfig JSON round-trip,
+   controller-side validation, ValidDocIds default-valid snapshots.
+2. **Query masking parity** — device scan path, sharded kernel path and
+   the host oracle return identical masked COUNT/SUM/GROUP BY/selection
+   results; whole-segment fast paths (metadata counts, inverted-index
+   counts) are disabled once a mask is active.
+3. **Durability units** — snapshot + journal restore, torn journal
+   tail, sidecar loss → key-column fold fallback.
+4. **Kill-and-restart convergence** — the cluster dies mid upsert
+   stream at each seeded crash point (segment seal, key-map snapshot
+   write, post-restart replay) and a restart over the same durable
+   state converges to exact row count and latest value per key.
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fixtures import make_columns, make_schema, make_table_config
+
+from pinot_tpu.common.faults import crash_points
+from pinot_tpu.common.table_config import TableConfig, UpsertConfig
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.query.reduce import BrokerReduceService
+from pinot_tpu.realtime import registry
+from pinot_tpu.realtime.stream import (MemoryStream,
+                                       MemoryStreamConsumerFactory)
+from pinot_tpu.realtime.upsert import (PartitionUpsertMetadata,
+                                       ValidDocIds)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+from test_realtime import make_rows, rt_config
+
+RT_TABLE = "baseballStats_REALTIME"
+
+
+def wait_until(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — condition not ready yet
+            pass
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clean_crash_points():
+    crash_points.clear()
+    yield
+    crash_points.clear()
+
+
+@pytest.fixture
+def work_dir():
+    return tempfile.mkdtemp()
+
+
+def upsert_rt_config(factory, topic, flush_rows=300,
+                     pk=("playerName",)):
+    cfg = rt_config(factory, topic, flush_rows=flush_rows)
+    cfg.upsert_config = UpsertConfig(mode="FULL",
+                                     primary_key_columns=list(pk))
+    return cfg
+
+
+def latest_by_key(rows):
+    latest = {}
+    for r in rows:
+        latest[r["playerName"]] = r
+    return latest
+
+
+def _register(topic, batch_size=50, num_partitions=1):
+    stream = MemoryStream(topic, num_partitions=num_partitions)
+    registry.register_stream_factory(
+        f"mem_{topic}", MemoryStreamConsumerFactory(stream,
+                                                    batch_size=batch_size))
+    return stream
+
+
+def count_and_sum(cluster):
+    resp = cluster.query("SELECT COUNT(*), SUM(runs) FROM baseballStats")
+    if resp.exceptions or not resp.aggregation_results:
+        return (-1, -1.0)
+    return (int(resp.aggregation_results[0].value),
+            float(resp.aggregation_results[1].value))
+
+
+# ---------------------------------------------------------------------------
+# tier 1: config + bitmap semantics
+# ---------------------------------------------------------------------------
+
+
+def test_upsert_config_json_roundtrip():
+    cfg = upsert_rt_config("f", "t")
+    again = TableConfig.from_json_str(cfg.to_json_str())
+    assert again.upsert_config is not None
+    assert again.upsert_config.enabled
+    assert again.upsert_config.primary_key_columns == ["playerName"]
+    # absent upsertConfig stays None
+    plain = TableConfig.from_json_str(make_table_config().to_json_str())
+    assert plain.upsert_config is None
+
+
+def test_controller_rejects_bad_upsert_configs(work_dir):
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.controller.manager import InvalidTableConfigError
+    ctrl = Controller(os.path.join(work_dir, "ds"))
+    mgr = ctrl.manager
+    # schema must exist first
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(upsert_rt_config("f", "t"))
+    mgr.add_schema(make_schema())
+    # OFFLINE table cannot upsert
+    bad = make_table_config()
+    bad.upsert_config = UpsertConfig(mode="FULL",
+                                     primary_key_columns=["teamID"])
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(bad)
+    # missing / multi-value primary key columns
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(upsert_rt_config("f", "t", pk=("nosuch",)))
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(upsert_rt_config("f", "t", pk=("position",)))
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(upsert_rt_config("f", "t", pk=()))
+    # an unrecognized mode must fail loudly, never silently disable dedup
+    partial = rt_config("f", "t")
+    partial.upsert_config = UpsertConfig(mode="PARTIAL",
+                                         primary_key_columns=["teamID"])
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(partial)
+
+
+def test_valid_doc_ids_default_valid_and_versioned():
+    vd = ValidDocIds()
+    assert vd.num_invalid == 0
+    # docs are valid by default, even past any recorded bit
+    assert vd.valid_mask(0, 10).all()
+    assert vd.invalidate(3)
+    assert not vd.invalidate(3)          # idempotent
+    v1 = vd.version
+    assert vd.invalidate(40_000)         # growth
+    assert vd.version > v1
+    m = vd.valid_mask(0, 40_001)
+    assert not m[3] and not m[40_000] and m.sum() == 40_001 - 2
+    # windowed (tail view) slice
+    t = vd.valid_mask(2, 6)
+    assert list(t) == [True, False, True, True]
+    assert list(vd.invalid_ids(50_000)) == [3, 40_000]
+
+
+# ---------------------------------------------------------------------------
+# tier 2: query masking parity (device scan / sharded / host oracle)
+# ---------------------------------------------------------------------------
+
+
+def _masked_segment(tmp, n, seed, name, kill):
+    cols = make_columns(n, seed)
+    d = os.path.join(tmp, name)
+    os.makedirs(d, exist_ok=True)
+    SegmentCreator(make_schema(), make_table_config(),
+                   segment_name=name).build(cols, d)
+    seg = ImmutableSegmentLoader.load(d)
+    vd = ValidDocIds()
+    rng = np.random.default_rng(seed)
+    dead = rng.choice(n, kill, replace=False)
+    vd.invalidate_many(dead)
+    seg.valid_doc_ids = vd
+    alive = np.ones(n, bool)
+    alive[dead] = False
+    return seg, cols, alive
+
+
+def test_masked_results_host_vs_device_vs_sharded(work_dir):
+    from pinot_tpu.parallel.sharded import ShardedQueryExecutor, make_mesh
+    from pinot_tpu.query import host_exec
+    from pinot_tpu.query.combine import combine_blocks
+
+    segs, colsets, alives = [], [], []
+    for i in range(2):
+        seg, cols, alive = _masked_segment(work_dir, 3000, 11 + i,
+                                           f"mseg{i}", 300 + 57 * i)
+        segs.append(seg)
+        colsets.append(cols)
+        alives.append(alive)
+
+    dev = ServerQueryExecutor(use_device=True)
+    host = ServerQueryExecutor(use_device=False)
+    shard = ShardedQueryExecutor(mesh=make_mesh())
+    red = BrokerReduceService()
+
+    pqls = [
+        "SELECT COUNT(*) FROM baseballStats",
+        "SELECT SUM(runs), AVG(hits) FROM baseballStats",
+        "SELECT COUNT(*) FROM baseballStats WHERE league = 'AL'",
+        "SELECT MIN(runs), MAX(hits) FROM baseballStats "
+        "WHERE yearID >= 2000",
+        "SELECT SUM(hits) FROM baseballStats WHERE yearID >= 1995 "
+        "GROUP BY league, teamID TOP 200",
+        "SELECT playerName, runs FROM baseballStats "
+        "ORDER BY runs DESC LIMIT 7",
+    ]
+    for pql in pqls:
+        req = compile_pql(pql)
+        oracle = combine_blocks(
+            req, [host_exec.execute_host(s, req) for s in segs])
+        r_dev = red.reduce(req, [dev.execute(req, segs)]).to_json()
+        r_host = red.reduce(req, [host.execute(req, segs)]).to_json()
+        blk_sh = shard.execute(req, segs)
+        r_sh = red.reduce(req, [blk_sh]).to_json()
+        r_or = red.reduce(req, [oracle]).to_json()
+        for r in (r_dev, r_host, r_sh):
+            assert r.get("aggregationResults") == \
+                r_or.get("aggregationResults"), (pql, r, r_or)
+            assert r.get("selectionResults") == \
+                r_or.get("selectionResults"), (pql, r, r_or)
+
+    # COUNT agrees with the python ground truth too
+    req = compile_pql("SELECT COUNT(*) FROM baseballStats")
+    total = sum(int(a.sum()) for a in alives)
+    got = red.reduce(req, [dev.execute(req, segs)])
+    assert int(got.aggregation_results[0].value) == total
+
+
+def test_mask_disables_whole_segment_fast_paths(work_dir):
+    from pinot_tpu.query.plan import InstancePlanMaker
+    seg, cols, alive = _masked_segment(work_dir, 2000, 3, "fseg", 200)
+    maker = InstancePlanMaker()
+    # metadata COUNT fast path must NOT fire (it would count dead rows)
+    plan = maker.make_segment_plan(
+        seg, compile_pql("SELECT COUNT(*) FROM baseballStats"))
+    assert plan.fast_path_result is None
+    blk = plan.execute()
+    assert blk.agg_intermediates[0] == int(alive.sum())
+    # inverted-index count fast path must NOT fire either
+    plan = maker.make_segment_plan(
+        seg, compile_pql(
+            "SELECT COUNT(*) FROM baseballStats WHERE teamID = 'BOS'"))
+    assert plan.fast_path_result is None
+    blk = plan.execute()
+    exp = int((alive & (cols["teamID"] == "BOS")).sum())
+    assert blk.agg_intermediates[0] == exp
+    # a bitmap with ZERO invalidations keeps the fast paths
+    seg.valid_doc_ids = ValidDocIds()
+    plan = maker.make_segment_plan(
+        seg, compile_pql("SELECT COUNT(*) FROM baseballStats"))
+    assert plan.fast_path_result is not None
+
+
+def test_mutable_frozen_tail_boundary_with_straddling_mask():
+    """Satellite regression: a tail view taken while the writer appends
+    never double-counts or drops rows at the `start` boundary — and a
+    validDocIds mask STRADDLING the boundary masks exactly once."""
+    seg_impl = __import__("pinot_tpu.realtime.mutable_segment",
+                          fromlist=["MutableSegmentImpl"])
+    seg = seg_impl.MutableSegmentImpl(make_schema(), make_table_config(),
+                                      "cons_upsert")
+    seg.valid_doc_ids = ValidDocIds()
+    rows = [{"teamID": "BOS", "league": "AL", "playerName": f"p{i}",
+             "position": ["P"], "runs": 1, "hits": 1, "average": 0.5,
+             "salary": 1.0, "yearID": 2000} for i in range(12_000)]
+    for r in rows[:9_000]:
+        seg.index_row(r)
+    frozen, tail = seg.device_view()
+    assert frozen is not None and frozen.num_docs == 9_000
+    boundary = frozen.num_docs
+
+    for r in rows[9_000:11_000]:
+        seg.index_row(r)
+    # mask straddles the frozen/tail boundary
+    dead = [boundary - 3, boundary - 1, boundary, boundary + 2]
+    for d in dead:
+        seg.valid_doc_ids.invalidate(d)
+
+    ex = ServerQueryExecutor()
+    red = BrokerReduceService()
+
+    def ask():
+        req = compile_pql(
+            "SELECT COUNT(*), SUM(runs) FROM baseballStats")
+        resp = red.reduce(req, [ex.execute(req, [seg])])
+        assert resp.num_segments_processed == 1     # one LOGICAL segment
+        return (int(resp.aggregation_results[0].value),
+                float(resp.aggregation_results[1].value))
+
+    cnt, s = ask()
+    assert cnt == 11_000 - len(dead)
+    assert s == cnt                                  # runs == 1 per row
+
+    # now RACE the writer: every snapshot must stay self-consistent
+    # (COUNT == SUM) and monotonically include the masked boundary
+    stop = threading.Event()
+
+    def writer():
+        for r in rows[11_000:]:
+            seg.index_row(r)
+            if stop.is_set():
+                return
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(20):
+            cnt, s = ask()
+            assert s == cnt, (s, cnt)
+            assert 11_000 - len(dead) <= cnt <= 12_000 - len(dead)
+    finally:
+        stop.set()
+        t.join()
+    cnt, s = ask()
+    assert cnt == 12_000 - len(dead) and s == cnt
+
+
+# ---------------------------------------------------------------------------
+# tier 3: durability units (snapshot + journal + sidecars + fold)
+# ---------------------------------------------------------------------------
+
+
+def _kd(keys_docs):
+    return [((k,), d) for k, d in keys_docs]
+
+
+def test_partition_metadata_snapshot_journal_restore(work_dir):
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
+    assert p.key_map_size() == 2
+    assert p.upserted_rows == 1
+    p.seal(0, 3, 3)                       # segment 0 commits
+    p.apply_batch(1, _kd([("b", 0), ("c", 1)]), 5)    # consuming seq 1
+    p.close()
+
+    # "kill -9": a fresh instance over the same durable directory
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r.key_map_size() == 3
+    assert r._map[("a",)] == (0, 2)
+    assert r._map[("b",)] == (1, 0)       # journal replay superseded seq 0
+    assert r._map[("c",)] == (1, 1)
+    # bitmap of the committed segment carries both invalidations:
+    # a@0 (in-segment, from the sidecar) and b@1 (cross-segment, from
+    # the journal replay)
+    vd0 = r.register_consuming(0)
+    assert list(vd0.invalid_ids(3)) == [0, 1]
+    assert r.snapshot_offset == 3
+    assert r.replayed_offset == 5
+    r.close()
+
+
+def test_partition_metadata_torn_journal_tail(work_dir):
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1)]), 2)
+    p.close()
+    path = os.path.join(work_dir, "journal.jsonl")
+    with open(path, "a") as fh:
+        fh.write('{"seq": 0, "off": 9, "d": [[["c"')     # torn record
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r.key_map_size() == 2                          # tail dropped
+    # the torn bytes were truncated: new appends form valid records
+    r.apply_batch(0, _kd([("c", 2)]), 3)
+    r.close()
+    r2 = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r2.key_map_size() == 3
+    r2.close()
+
+
+def test_key_of_missing_or_unconvertible_values_returns_none(work_dir):
+    from pinot_tpu.realtime.upsert import TableUpsertMetadataManager
+    mgr = TableUpsertMetadataManager(
+        RT_TABLE, UpsertConfig(mode="FULL",
+                               primary_key_columns=["runs"]),
+        make_schema(), os.path.join(work_dir, "u"))
+    assert mgr.key_of({"runs": 5}) == (5,)
+    assert mgr.key_of({"runs": "7"}) == (7,)
+    assert mgr.key_of({}) is None                    # missing
+    assert mgr.key_of({"runs": None}) is None        # explicit null
+    assert mgr.key_of({"runs": "xyz"}) is None       # unconvertible
+    mgr.close()
+
+
+def test_poison_primary_key_rows_are_dropped_not_fatal(work_dir):
+    """A row whose primary key is missing/unconvertible is dropped like
+    any poison record — it must never kill the partition consumer."""
+    topic = "topic_poison_pk"
+    stream = _register(topic)
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        # NUMERIC pk: unconvertible values exercise the normalizer path
+        cluster.add_table(upsert_rt_config(f"mem_{topic}", topic,
+                                           flush_rows=100_000,
+                                           pk=("yearID",)))
+        good = make_rows(60, seed=2)
+        for r in good[:30]:
+            stream.publish(r, partition=0)
+        # poison: unconvertible pk value (the transformer passes it
+        # through; int("not-a-year") raises inside key extraction)
+        bad = dict(good[0])
+        bad["yearID"] = "not-a-year"
+        stream.publish(bad, partition=0)
+        for r in good[30:]:
+            stream.publish(r, partition=0)
+        exp = len({r["yearID"] for r in good})
+        assert wait_until(
+            lambda: count_and_sum(cluster)[0] == exp, timeout=30), \
+            count_and_sum(cluster)
+        # the consumer survived the poison row and kept consuming
+        rdm = cluster.participants["Server_0"].realtime._consuming[
+            "baseballStats__0__0"]
+        assert rdm.state == "CONSUMING"
+    finally:
+        cluster.stop()
+
+
+def test_unterminated_final_journal_line_is_repaired(work_dir):
+    """A crash that cuts the write between the record and its newline:
+    the record is kept, the terminator repaired — a later append can't
+    merge two records into one torn line (which a second recovery would
+    drop together with everything after it)."""
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1)]), 2)
+    p.close()
+    path = os.path.join(work_dir, "journal.jsonl")
+    with open(path, "rb+") as fh:
+        fh.seek(0, 2)
+        fh.truncate(fh.tell() - 1)           # chop the trailing \n
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r.key_map_size() == 2             # the record survived
+    r.apply_batch(0, _kd([("c", 2)]), 3)     # next append after repair
+    r.close()
+    r2 = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r2.key_map_size() == 3            # nothing merged or dropped
+    r2.close()
+
+
+def test_lost_snapshot_forces_fold_despite_sidecars(work_dir):
+    """When the key-map snapshot is unreadable, sidecar coverage must
+    NOT suppress the fold — otherwise committed segments' keys would
+    never re-enter the (empty) map and dedup would silently stop."""
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
+    p.seal(0, 3, 3)                           # snapshot + sidecar land
+    p.close()
+    snap = [f for f in os.listdir(work_dir) if f.startswith("keymap-")][0]
+    with open(os.path.join(work_dir, snap), "w") as fh:
+        fh.write("{ corrupt")
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    folds = []
+
+    class _Seg:
+        num_docs = 3
+
+    vd = r.attach_or_fold(0, _Seg(),
+                          lambda: folds.append(1) or
+                          [("a",), ("b",), ("a",)])
+    assert folds, "fold must run when the snapshot is lost"
+    assert r.key_map_size() == 2
+    assert r._map[("a",)] == (0, 2)
+    # sidecar bits are retained (masks never resurrect) and the fold
+    # re-derives the same mask
+    assert list(vd.invalid_ids(3)) == [0]
+    r.close()
+
+
+def test_committed_segment_fold_when_durable_state_lost(work_dir):
+    """The loser-download path: a replica that never consumed the rows
+    (no journal, no snapshot) folds the committed segment's primary-key
+    column and converges to the exact same mask."""
+    from pinot_tpu.realtime.upsert import TableUpsertMetadataManager
+    cols = make_columns(1000, seed=5)
+    d = os.path.join(work_dir, "seg")
+    os.makedirs(d)
+    SegmentCreator(make_schema(), make_table_config(),
+                   segment_name="baseballStats__0__0").build(cols, d)
+    seg = ImmutableSegmentLoader.load(d)
+    mgr = TableUpsertMetadataManager(
+        RT_TABLE, UpsertConfig(mode="FULL",
+                               primary_key_columns=["playerName"]),
+        make_schema(), os.path.join(work_dir, "upsert"))
+    mgr.on_committed_segment("baseballStats__0__0", seg)
+    # ground truth: last doc per playerName wins
+    last = {}
+    for i, name in enumerate(cols["playerName"]):
+        last[str(name)] = i
+    alive = np.zeros(1000, bool)
+    alive[list(last.values())] = True
+    got = seg.valid_doc_ids.valid_mask(0, 1000)
+    assert (got == alive).all()
+    assert mgr.key_map_size() == len(last)
+    # a LATER consuming row supersedes a committed doc
+    part = mgr.partition(0)
+    key = (str(cols["playerName"][0]),)
+    part.apply_batch(1, [(key, 0)], 1)
+    assert not seg.valid_doc_ids.valid_mask(0, 1000)[last[key[0]]]
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# tier 4: kill -9 mid upsert stream → restart → exact convergence
+# ---------------------------------------------------------------------------
+
+
+def _converged(cluster, exp_cnt, exp_sum):
+    cluster.controller.realtime.ensure_all_partitions_consuming()
+    cnt, s = count_and_sum(cluster)
+    return cnt == exp_cnt and s == exp_sum
+
+
+def _assert_latest_values(cluster, latest, probe=3):
+    """Spot-check latest-value convergence per key over a few keys."""
+    for name, row in list(latest.items())[:probe]:
+        resp = cluster.query(
+            "SELECT COUNT(*), SUM(runs) FROM baseballStats "
+            f"WHERE playerName = '{name}'")
+        assert not resp.exceptions, resp.exceptions
+        assert int(resp.aggregation_results[0].value) == 1, name
+        assert float(resp.aggregation_results[1].value) == \
+            float(row["runs"]), name
+
+
+def test_upsert_end_to_end_latest_row_wins(work_dir):
+    stream = _register("topic_ups_e2e")
+    cluster = EmbeddedCluster(work_dir, num_servers=1,
+                              store_dir=os.path.join(work_dir, "store"))
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(upsert_rt_config("mem_topic_ups_e2e",
+                                           "topic_ups_e2e",
+                                           flush_rows=300))
+        rows = make_rows(900, seed=3)
+        for r in rows:
+            stream.publish(r, partition=0)
+        latest = latest_by_key(rows)
+        exp_cnt = len(latest)
+        exp_sum = float(sum(r["runs"] for r in latest.values()))
+        # duplicates span committed AND consuming segments
+        assert wait_until(lambda: _converged(cluster, exp_cnt, exp_sum),
+                          timeout=40), count_and_sum(cluster)
+        mgr = cluster.controller.manager
+        done = [s for s in mgr.segment_names(RT_TABLE)
+                if (mgr.segment_metadata(RT_TABLE, s) or {}).get(
+                    "status") == "DONE"]
+        assert len(done) >= 2, "updates must straddle committed segments"
+        _assert_latest_values(cluster, latest)
+        # obs: the key-map gauge and upsert meters are live
+        from pinot_tpu.common.metrics import ServerGauge, ServerMeter
+        metrics = cluster.servers["Server_0"].metrics
+        assert metrics.gauge(ServerGauge.UPSERT_KEY_MAP_SIZE,
+                             RT_TABLE).value == exp_cnt
+        assert metrics.meter(ServerMeter.UPSERTED_ROWS,
+                             RT_TABLE).count == 900 - exp_cnt
+        assert metrics.meter(ServerMeter.MASKED_DOCS,
+                             RT_TABLE).count >= 900 - exp_cnt
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.parametrize("crash_point", ["upsert.seal",
+                                         "upsert.keymap_snapshot"])
+def test_kill_during_seal_restart_converges(work_dir, crash_point):
+    """kill -9 at the seal / mid-snapshot-write instant: the restarted
+    server rebuilds the key map from snapshots + journal + stream tail
+    and converges to exact counts and latest values."""
+    topic = f"topic_{crash_point.split('.')[-1]}"
+    stream = _register(topic)
+    cluster = EmbeddedCluster(work_dir, num_servers=1,
+                              store_dir=os.path.join(work_dir, "store"))
+    rows = make_rows(700, seed=7)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(upsert_rt_config(f"mem_{topic}", topic,
+                                           flush_rows=250))
+        crash_points.arm(crash_point)
+        for r in rows:
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: crash_points.fired.get(crash_point),
+                          timeout=30), "seal never reached the crash point"
+    finally:
+        cluster.stop()
+
+    latest = latest_by_key(rows)
+    exp_cnt = len(latest)
+    exp_sum = float(sum(r["runs"] for r in latest.values()))
+    c2 = EmbeddedCluster(work_dir, num_servers=1,
+                         store_dir=os.path.join(work_dir, "store"))
+    try:
+        assert wait_until(lambda: _converged(c2, exp_cnt, exp_sum),
+                          timeout=60), \
+            (count_and_sum(c2), exp_cnt, exp_sum)
+        _assert_latest_values(c2, latest)
+    finally:
+        c2.stop()
+
+
+def test_kill_during_post_restart_replay_converges(work_dir):
+    """Crash DURING recovery (journal replay) on the restarted server:
+    a second restart still converges — replay is idempotent."""
+    topic = "topic_replaycrash"
+    stream = _register(topic)
+    cluster = EmbeddedCluster(work_dir, num_servers=1,
+                              store_dir=os.path.join(work_dir, "store"))
+    rows = make_rows(500, seed=9)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(upsert_rt_config(f"mem_{topic}", topic,
+                                           flush_rows=200))
+        for r in rows:
+            stream.publish(r, partition=0)
+        # at least one seal + some journaled consuming rows
+        mgr = cluster.controller.manager
+        assert wait_until(lambda: any(
+            (mgr.segment_metadata(RT_TABLE, s) or {}).get("status")
+            == "DONE" for s in mgr.segment_names(RT_TABLE)), timeout=30)
+        assert wait_until(
+            lambda: count_and_sum(cluster)[0] == len(latest_by_key(rows)),
+            timeout=30)
+    finally:
+        cluster.stop()
+
+    # restart #1 dies mid-replay
+    crash_points.arm("upsert.replay")
+    c2 = EmbeddedCluster(work_dir, num_servers=1,
+                         store_dir=os.path.join(work_dir, "store"))
+    try:
+        assert wait_until(
+            lambda: crash_points.fired.get("upsert.replay"), timeout=30)
+    finally:
+        c2.stop()
+
+    latest = latest_by_key(rows)
+    exp_cnt = len(latest)
+    exp_sum = float(sum(r["runs"] for r in latest.values()))
+    # restart #2 over the same durable state converges
+    c3 = EmbeddedCluster(work_dir, num_servers=1,
+                         store_dir=os.path.join(work_dir, "store"))
+    try:
+        assert wait_until(lambda: _converged(c3, exp_cnt, exp_sum),
+                          timeout=60), \
+            (count_and_sum(c3), exp_cnt, exp_sum)
+        _assert_latest_values(c3, latest)
+    finally:
+        c3.stop()
+
+
+def test_restart_does_not_rewind_before_snapshot_offset(work_dir):
+    """The checkpoint contract: after a restart, consumption resumes at
+    the last committed boundary (== the key-map snapshot offset) — the
+    topic is never re-read before it."""
+    topic = "topic_noreread"
+    stream = _register(topic)
+    cluster = EmbeddedCluster(work_dir, num_servers=1,
+                              store_dir=os.path.join(work_dir, "store"))
+    rows = make_rows(600, seed=13)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(upsert_rt_config(f"mem_{topic}", topic,
+                                           flush_rows=250))
+        for r in rows:
+            stream.publish(r, partition=0)
+        mgr = cluster.controller.manager
+        assert wait_until(lambda: any(
+            (mgr.segment_metadata(RT_TABLE, s) or {}).get("status")
+            == "DONE" for s in mgr.segment_names(RT_TABLE)), timeout=30)
+        assert wait_until(
+            lambda: count_and_sum(cluster)[0] == len(latest_by_key(rows)),
+            timeout=30)
+    finally:
+        cluster.stop()
+
+    # durable snapshot offset == the committed boundary
+    part_dir = os.path.join(work_dir, "server_work", "Server_0",
+                            "upsert", RT_TABLE, "partition_0")
+    snaps = [f for f in os.listdir(part_dir) if f.startswith("keymap-")]
+    assert snaps, "seal must have written a key-map snapshot"
+    snap = json.load(open(os.path.join(
+        part_dir, max(snaps, key=lambda n: int(n[7:-5])))))
+    mgr_offsets = []
+
+    c2 = EmbeddedCluster(work_dir, num_servers=1,
+                         store_dir=os.path.join(work_dir, "store"))
+    try:
+        latest = latest_by_key(rows)
+        assert wait_until(lambda: _converged(
+            c2, len(latest),
+            float(sum(r["runs"] for r in latest.values()))), timeout=60)
+        rtdm = c2.participants["Server_0"].realtime
+        for seg, rdm in rtdm._consuming.items():
+            mgr_offsets.append((seg, rdm))
+        # every restarted consumer started AT or AFTER the snapshot
+        # offset — zero topic re-reads before it
+        mgr = c2.controller.manager
+        for seg, _rdm in mgr_offsets:
+            meta = mgr.segment_metadata(RT_TABLE, seg)
+            assert int(meta["startOffset"]) >= int(snap["offset"]), \
+                (seg, meta, snap["offset"])
+    finally:
+        c2.stop()
+
+
+def test_stats_history_tolerates_torn_file(work_dir):
+    """Satellite: RealtimeSegmentStatsHistory persistence is torn-write
+    safe — a corrupt file (or leftover .tmp) loads empty and the next
+    save atomically repairs it."""
+    from pinot_tpu.realtime.stats_history import RealtimeSegmentStatsHistory
+    path = os.path.join(work_dir, "stats_history.json")
+    with open(path, "w") as fh:
+        fh.write('{"baseballStats_REALTIME": [{"numRo')      # torn
+    with open(path + ".tmp", "w") as fh:
+        fh.write("{ half a snapshot")
+    h = RealtimeSegmentStatsHistory(path)
+    assert h.entries(RT_TABLE) == []
+    h.add_segment_stats(RT_TABLE, {"numRowsIndexed": 5000, "columns": {}})
+    # the save repaired the file: a reload sees the entry
+    r = RealtimeSegmentStatsHistory(path)
+    assert r.entries(RT_TABLE)[0]["numRowsIndexed"] == 5000
+    assert r.estimate(RT_TABLE) == {"rows": 5000}
