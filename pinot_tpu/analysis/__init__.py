@@ -10,16 +10,26 @@ hazards the reference Java codebase never had:
   inputs (``retrace``)
 - 64-bit literals silently downcast when x64 is disabled, and int32
   doc-id arithmetic that can overflow (``dtype-drift``)
-- server/realtime class state mutated across threads without a held
-  lock (``concurrency``)
+- class state written from >=2 thread paths without a common lock,
+  judged against a thread-entry-point map (``concurrency``)
 - JAX symbols absent from the installed version or on a deprecation
   denylist — the exact class of break that took out the seed's 33
   shard_map tests (``api-compat``)
+- lock acquisition cycles (lockdep-style, one level interprocedural)
+  and threading locks held across blocking calls (``lock-order``,
+  ``lock-blocking``)
+- blocking calls on the event loop and wrong-context asyncio APIs
+  (``async-blocking``, ``cross-loop``)
+- deep tier (``--deep``): jaxpr-level kernel contracts over the
+  registered kernel surface (``kernel-contract``) and the committed
+  wire-format snapshot (``wire-schema``)
 
 Usage::
 
-    python -m pinot_tpu.analysis pinot_tpu/            # lint the tree
+    python -m pinot_tpu.analysis pinot_tpu/            # fast tier
+    python -m pinot_tpu.analysis --deep pinot_tpu/     # + contracts
     python -m pinot_tpu.analysis --write-baseline ...  # grandfather
+    python -m pinot_tpu.analysis --write-wire-schema   # wire snapshot
     # per-line:  <code>  # tpulint: disable=host-sync -- reason
     # per-file:  # tpulint: disable-file=concurrency -- reason
 

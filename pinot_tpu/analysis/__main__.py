@@ -4,6 +4,11 @@ Exits nonzero on findings NOT covered by the committed baseline (or on
 stale baseline entries with --strict-baseline, which CI uses so the
 grandfather list only ever shrinks). Run from the repo root so finding
 keys match the baseline.
+
+`--deep` additionally runs the global deep tier: jaxpr-level kernel
+contracts over the registered kernel surface and the wire-schema gate
+against the committed `wire-schema.json` (regenerate the latter
+INTENTIONALLY with `--write-wire-schema`).
 """
 from __future__ import annotations
 
@@ -14,6 +19,55 @@ import sys
 from pinot_tpu.analysis import core, runner
 
 DEFAULT_BASELINE = "tpulint.baseline.json"
+
+#: per-rule remediation guidance for the failure summary — the diff a
+#: CI user sees should say what to DO, not just what fired
+FIX_HINTS = {
+    "host-sync": "batch into one jax.device_get per dispatch",
+    "retrace": "hoist jit out of loops; pass hashable statics",
+    "dtype-drift": "keep 64-bit math host-side (compat.wide_i64 for "
+                   "genuine 64-bit lanes)",
+    "concurrency": "guard both write paths with one lock, or make one "
+                   "path the sole writer",
+    "api-compat": "route version-sensitive symbols through "
+                  "pinot_tpu.compat",
+    "lock-order": "impose one global acquisition order or collapse "
+                  "the locks",
+    "lock-blocking": "move the blocking call outside the lock "
+                     "(snapshot under the lock, work outside)",
+    "async-blocking": "await the async form, or offload with "
+                      "loop.run_in_executor",
+    "cross-loop": "create_task from coroutines; "
+                  "run_coroutine_threadsafe from other threads",
+    "kernel-contract": "fix the kernel (or its contract_cases entry) "
+                       "until the jaxpr is callback-free, 32-bit clean "
+                       "and retrace-stable",
+    "wire-schema": "restore the field, or regenerate wire-schema.json "
+                   "with --write-wire-schema and flag the PR as a "
+                   "wire-compatibility change",
+}
+
+
+def _print_failure_summary(new, errors) -> None:
+    """Grouped rule-id → count/guidance block printed on a failed gate."""
+    by_rule = {}
+    for f in new:
+        by_rule.setdefault(f.rule, []).append(f)
+    print("tpulint: FAILING — new findings by rule:", file=sys.stderr)
+    for rule_id in sorted(by_rule):
+        fs = by_rule[rule_id]
+        print(f"  {rule_id} ({len(fs)}): fix → "
+              f"{FIX_HINTS.get(rule_id, 'see docs/ANALYSIS.md')}",
+              file=sys.stderr)
+        for f in fs[:5]:
+            print(f"    {f.path}:{f.line}", file=sys.stderr)
+        if len(fs) > 5:
+            print(f"    ... and {len(fs) - 5} more", file=sys.stderr)
+    if errors:
+        print(f"  plus {len(errors)} analysis error(s)", file=sys.stderr)
+    print("  suppress only with a verified invariant: "
+          "`# tpulint: disable=<rule> -- <why it is safe>`",
+          file=sys.stderr)
 
 
 def main(argv=None) -> int:
@@ -30,6 +84,12 @@ def main(argv=None) -> int:
                     help="regenerate the baseline from this run and exit 0")
     ap.add_argument("--strict-baseline", action="store_true",
                     help="also fail on stale baseline entries (CI mode)")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the deep tier: jaxpr kernel contracts "
+                         "+ wire-schema gate")
+    ap.add_argument("--write-wire-schema", action="store_true",
+                    help="regenerate wire-schema.json from the live "
+                         "serde surface and exit")
     ap.add_argument("--rule", action="append", dest="rules", default=None,
                     help="run only this rule id (repeatable)")
     ap.add_argument("--list-rules", action="store_true")
@@ -38,18 +98,32 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         for rid, rule in sorted(core.all_rules().items()):
-            print(f"{rid:12s} {rule.description}")
+            tier = " [deep]" if rule.tier == "deep" else ""
+            print(f"{rid:16s}{tier} {rule.description}")
         return 0
 
-    known = set(core.all_rules())
-    if args.rules and not set(args.rules) <= known:
-        bad = sorted(set(args.rules) - known)
+    if args.write_wire_schema:
+        from pinot_tpu.analysis import contracts
+        contracts.write_wire_schema()
+        print(f"tpulint: wrote {contracts.WIRE_SCHEMA_FILE} — commit it "
+              "and call out the wire-compatibility change in review")
+        return 0
+
+    known = core.all_rules()
+    if args.rules and not set(args.rules) <= set(known):
+        bad = sorted(set(args.rules) - set(known))
         print(f"tpulint: unknown rule id(s) {bad}; known: "
               f"{sorted(known)}", file=sys.stderr)
         return 2
+    if args.rules and not args.deep and \
+            any(known[r].tier == "deep" for r in args.rules):
+        # asking for a deep rule IS asking for the deep tier — without
+        # this the run would silently skip the rule and report green
+        args.deep = True
 
     result = runner.analyze_paths(
-        args.paths, rule_ids=set(args.rules) if args.rules else None)
+        args.paths, rule_ids=set(args.rules) if args.rules else None,
+        deep=args.deep)
     for err in result.errors:
         print(f"tpulint: error: {err}", file=sys.stderr)
 
@@ -58,9 +132,24 @@ def main(argv=None) -> int:
             print("tpulint: refusing to write a baseline from a run "
                   "with analysis errors", file=sys.stderr)
             return 1
+        pruned, reduced = [], []
+        if os.path.exists(args.baseline):
+            old = core.load_baseline(args.baseline)
+            fresh = core.count_keys(result.findings)
+            # "pruned" = the key left the baseline entirely; a count
+            # that merely shrank is still grandfathered — reporting it
+            # as pruned would tell the operator a live finding is gone
+            pruned = [k for k in sorted(old) if fresh.get(k, 0) == 0]
+            reduced = [(k, old[k], fresh[k]) for k in sorted(old)
+                       if 0 < fresh.get(k, 0) < old[k]]
         core.write_baseline(args.baseline, result.findings)
         print(f"tpulint: wrote {len(result.findings)} finding(s) to "
               f"{args.baseline}")
+        for key in pruned:
+            print(f"tpulint: pruned stale baseline entry: {key}")
+        for key, was, now in reduced:
+            print(f"tpulint: reduced baseline entry {was} → {now}: "
+                  f"{key}")
         return 0
 
     baseline = {}
@@ -80,11 +169,14 @@ def main(argv=None) -> int:
     n_grandfathered = len(result.findings) - len(new)
     by_rule = ", ".join(f"{r}={n}" for r, n in
                         sorted(result.by_rule().items())) or "none"
-    print(f"tpulint: {len(result.findings)} finding(s) [{by_rule}], "
-          f"{len(new)} new, {n_grandfathered} grandfathered, "
-          f"{len(result.suppressed)} suppressed, {len(stale)} stale "
-          "baseline entr(ies)")
+    tier = "deep" if args.deep else "fast"
+    print(f"tpulint[{tier}]: {len(result.findings)} finding(s) "
+          f"[{by_rule}], {len(new)} new, {n_grandfathered} "
+          f"grandfathered, {len(result.suppressed)} suppressed, "
+          f"{len(stale)} stale baseline entr(ies)")
     if new or result.errors or (stale and args.strict_baseline):
+        if new:
+            _print_failure_summary(new, result.errors)
         return 1
     return 0
 

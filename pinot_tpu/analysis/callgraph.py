@@ -1,0 +1,387 @@
+"""Interprocedural scaffolding for the deep analysis tier.
+
+Everything here is ONE-LEVEL interprocedural and file-local by design:
+rules follow `self.method()` calls (and bare module-function calls) one
+hop from the body being analyzed, which is the deepest reasoning an AST
+linter can do without whole-program import resolution — and, measured
+against this codebase, exactly the depth at which the real hazards live
+(a consume loop calling its own `_flush`, a scatter path calling its own
+`_call_once`).
+
+Three capabilities, shared by the lock-order, async-safety and upgraded
+concurrency rules:
+
+- **Method/function index** per class and per module, with a shallow
+  call-edge map (`self.x()` → method, `f()` → module function).
+- **Thread-entry-point map**: which methods run on which kind of thread.
+  Detected syntactically: `threading.Thread(target=self.m)` and
+  `threading.Timer`, `<pool>.submit(self.m)`, `loop.run_in_executor(_,
+  self.m)`, `loop.call_soon*(self.m)`, `fut.add_done_callback(self.m)`
+  mark `m` as a SPAWNED root; `async def` methods are LOOP roots; every
+  other non-underscore method is an EXTERNAL root (callable from any
+  caller thread — scheduler pools, HTTP handler threads). Private
+  methods inherit the roots of their callers (fixpoint).
+- **Lock tracking**: which `self.<attr>` / module-global names hold
+  `threading.Lock/RLock/Condition` objects, and which lock set is held
+  at any statement (with-statements plus explicit `.acquire()` /
+  `.release()`, scanned in statement order).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis import astutil
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+#: attribute names whose callable argument runs on another THREAD:
+#: (attr name → index of the callable argument)
+_THREAD_SPAWN_ATTRS = {
+    "submit": 0,              # Executor.submit(fn, ...)
+    "run_in_executor": 1,     # loop.run_in_executor(executor, fn, ...)
+}
+
+#: attribute names whose callable argument runs as an EVENT-LOOP
+#: callback (on the loop thread — create_task is legal inside these)
+_LOOP_CALLBACK_ATTRS = {
+    "call_soon": 0,
+    "call_soon_threadsafe": 0,
+    "call_later": 1,          # loop.call_later(delay, fn)
+    "add_done_callback": 0,
+}
+
+_SPAWN_ATTRS = {**_THREAD_SPAWN_ATTRS, **_LOOP_CALLBACK_ATTRS}
+
+#: resolved dotted ctors whose keyword/positional arg is a thread target
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+
+
+def _callable_ref(node: ast.AST) -> Optional[str]:
+    """`self.m` → 'm'; bare `f` → 'f'; anything else → None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _spawned_via(tree: ast.AST, aliases: Dict[str, str],
+                 attrs: Dict[str, int], thread_ctors: bool) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = astutil.resolve(node.func, aliases)
+        if thread_ctors and callee in _THREAD_CTORS:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    ref = _callable_ref(kw.value)
+                    if ref:
+                        out.add(ref)
+            # Timer(interval, fn) positional
+            if callee == "threading.Timer" and len(node.args) >= 2:
+                ref = _callable_ref(node.args[1])
+                if ref:
+                    out.add(ref)
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in attrs:
+            idx = attrs[node.func.attr]
+            if len(node.args) > idx:
+                ref = _callable_ref(node.args[idx])
+                if ref:
+                    out.add(ref)
+    return out
+
+
+def spawned_callables(tree: ast.AST, aliases: Dict[str, str]) -> Set[str]:
+    """Names of methods/functions handed to a thread/loop-callback API
+    anywhere under `tree`."""
+    return _spawned_via(tree, aliases, _SPAWN_ATTRS, thread_ctors=True)
+
+
+def thread_spawned_callables(tree: ast.AST,
+                             aliases: Dict[str, str]) -> Set[str]:
+    """Names handed to a genuinely-other-THREAD API (Thread/Timer
+    targets, Executor.submit, run_in_executor) — excludes loop-callback
+    registration, which runs on the event-loop thread."""
+    return _spawned_via(tree, aliases, _THREAD_SPAWN_ATTRS,
+                        thread_ctors=True)
+
+
+def loop_callback_callables(tree: ast.AST,
+                            aliases: Dict[str, str]) -> Set[str]:
+    """Names handed to a LOOP-scheduling API (call_soon*, call_later,
+    add_done_callback) — these run on the event-loop thread, so
+    create_task/ensure_future are legal inside them."""
+    return _spawned_via(tree, aliases, _LOOP_CALLBACK_ATTRS,
+                        thread_ctors=False)
+
+
+def lock_attrs_of(cls: ast.ClassDef, aliases: Dict[str, str]) -> Set[str]:
+    """self.X assigned a Lock/RLock/Condition anywhere in the class.
+    `threading.Condition(self._lock)` aliases the SAME underlying lock;
+    both names count as declared locks (holding either is holding)."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                astutil.resolve(node.value.func, aliases) in LOCK_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    locks.add(tgt.attr)
+    return locks
+
+
+def module_locks(tree: ast.Module, aliases: Dict[str, str]) -> Set[str]:
+    """Module-global names bound to a Lock/RLock/Condition at top level."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, ast.Call) and \
+                astutil.resolve(stmt.value.func, aliases) in LOCK_CTORS:
+            out.update(t.id for t in stmt.targets
+                       if isinstance(t, ast.Name))
+    return out
+
+
+def lock_of_expr(node: ast.AST, self_locks: Set[str],
+                 global_locks: Set[str]) -> Optional[str]:
+    """Lock identifier for an expression, or None.
+
+    `self.X` (declared) → 'self.X'; bare global lock name → 'G'.
+    """
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self" \
+            and node.attr in self_locks:
+        return f"self.{node.attr}"
+    if isinstance(node, ast.Name) and node.id in global_locks:
+        return node.id
+    return None
+
+
+@dataclasses.dataclass
+class ClassModel:
+    """Per-class view: methods, locks, thread roots, call edges."""
+
+    node: ast.ClassDef
+    methods: Dict[str, ast.AST]
+    lock_attrs: Set[str]
+    #: method → roots it can run under. Root spellings:
+    #:   "spawn:<m>"  — m is a detected thread/callback target
+    #:   "loop"       — async method (event-loop context)
+    #:   "ext:<m>"    — public method m, callable from any thread
+    roots: Dict[str, Set[str]]
+    #: method → self-methods it calls (shallow, own body only)
+    calls: Dict[str, Set[str]]
+
+    def resolve_call(self, call: ast.Call) -> Optional[ast.AST]:
+        """The method body a `self.m(...)` call lands in, if local."""
+        ref = None
+        if isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name) and \
+                call.func.value.id == "self":
+            ref = call.func.attr
+        return self.methods.get(ref) if ref else None
+
+
+#: construction-time methods (happens-before publish) — the single
+#: source of truth; rules import this instead of re-declaring it
+INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__",
+                          "__init_subclass__", "__set_name__"})
+_INIT_METHODS = INIT_METHODS
+
+
+def build_class_model(cls: ast.ClassDef, aliases: Dict[str, str]
+                      ) -> ClassModel:
+    methods: Dict[str, ast.AST] = {
+        m.name: m for m in cls.body
+        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    spawned = thread_spawned_callables(cls, aliases) & set(methods)
+    loop_cbs = loop_callback_callables(cls, aliases) & set(methods)
+    calls: Dict[str, Set[str]] = {}
+    for name, m in methods.items():
+        edges: Set[str] = set()
+        for node in astutil.walk_shallow(m):
+            if isinstance(node, ast.Call):
+                ref = _callable_ref(node.func)
+                if ref in methods:
+                    edges.add(ref)
+        calls[name] = edges
+
+    roots: Dict[str, Set[str]] = {name: set() for name in methods}
+    for name, m in methods.items():
+        if name in _INIT_METHODS:
+            # construction happens-before publish: the "init" root
+            # propagates to helpers called only from __init__ so they
+            # are recognizable as construction-time (never invented as
+            # external thread paths), then discounted by the rules
+            roots[name].add("init")
+            continue
+        # the categories are NOT exclusive: a public method that is
+        # also a Thread target runs on both the spawned thread and any
+        # caller thread — it carries both roots, which is exactly what
+        # makes a single-method two-thread race detectable
+        if name in spawned:
+            roots[name].add(f"spawn:{name}")
+        if isinstance(m, ast.AsyncFunctionDef) or name in loop_cbs:
+            # loop-callback targets (call_soon*, add_done_callback) run
+            # ON the event-loop thread — same context as async methods,
+            # never a separate thread root
+            roots[name].add("loop")
+        if not name.startswith("_") and not \
+                isinstance(m, ast.AsyncFunctionDef):
+            roots[name].add(f"ext:{name}")
+        # properties named like attributes are public too (no underscore
+        # check already covers them); underscore methods start rootless
+        # and inherit below.
+    # propagate roots caller → callee to fixpoint (graphs are tiny)
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in calls.items():
+            for callee in callees:
+                if callee in _INIT_METHODS:
+                    continue
+                add = roots[caller] - roots[callee]
+                if add:
+                    roots[callee] |= add
+                    changed = True
+    return ClassModel(node=cls, methods=methods, lock_attrs=lock_attrs_of(
+        cls, aliases), roots=roots, calls=calls)
+
+
+def iter_class_models(tree: ast.Module, aliases: Dict[str, str]
+                      ) -> Iterator[ClassModel]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield build_class_model(node, aliases)
+
+
+# ---------------------------------------------------------------------------
+# Lock-held statement walk
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """One interesting statement with the lock set held when it runs."""
+
+    node: ast.AST
+    held: Tuple[str, ...]          # sorted lock ids held at this point
+    acquires: Optional[str] = None  # lock id this site acquires, if any
+
+
+def walk_with_locks(fn: ast.AST, self_locks: Set[str],
+                    global_locks: Set[str]) -> List[Site]:
+    """Every shallow node of `fn` paired with the locks held at it.
+
+    Handles nested `with` (incl. multi-item) and explicit
+    `.acquire()`/`.release()` in statement order. Not a CFG — a release
+    inside one branch is treated as releasing for the rest of the
+    body, which under-reports at worst (a linter must not over-hold).
+    """
+    out: List[Site] = []
+    held: List[str] = []
+
+    def lock_of(expr: ast.AST) -> Optional[str]:
+        return lock_of_expr(expr, self_locks, global_locks)
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return                  # nested defs judged in their own scope
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in node.items:
+                lk = lock_of(item.context_expr)
+                if lk is not None:
+                    out.append(Site(item.context_expr,
+                                    tuple(sorted(held)), acquires=lk))
+                    held.append(lk)
+                    entered.append(lk)
+                else:
+                    visit(item.context_expr)
+            for stmt in node.body:
+                visit(stmt)
+            for lk in reversed(entered):
+                # the body may have explicitly release()d the with'd
+                # lock (temporary-release pattern) — already gone then
+                if lk in held:
+                    held.remove(lk)
+            return
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            lk = lock_of(node.func.value)
+            if lk is not None and node.func.attr == "acquire":
+                out.append(Site(node, tuple(sorted(held)), acquires=lk))
+                held.append(lk)
+                return
+            if lk is not None and node.func.attr == "release":
+                if lk in held:
+                    held.remove(lk)
+                return
+        out.append(Site(node, tuple(sorted(held))))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in ast.iter_child_nodes(fn):
+        visit(stmt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Blocking-call classification (shared by lock-blocking / async-blocking)
+# ---------------------------------------------------------------------------
+
+#: resolved dotted calls that block the calling thread
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "subprocess.Popen": "subprocess.Popen",
+    "socket.create_connection": "socket.create_connection",
+    "socket.getaddrinfo": "socket.getaddrinfo",
+    "urllib.request.urlopen": "urllib.request.urlopen",
+    "requests.get": "requests.get",
+    "requests.post": "requests.post",
+    "requests.request": "requests.request",
+    "os.system": "os.system",
+    "os.fsync": "os.fsync",
+    "jax.device_get": "jax.device_get",
+}
+
+
+def blocking_kind(node: ast.AST, aliases: Dict[str, str]
+                  ) -> Optional[str]:
+    """A short description when `node` is a blocking call, else None.
+
+    Awaitables are the caller's business (`ast.Await` is matched by the
+    rules directly — an await under a threading lock parks the lock for
+    a whole scheduling round-trip; an await NOT under a lock is normal
+    asyncio).
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    callee = astutil.resolve(node.func, aliases)
+    if callee in BLOCKING_CALLS:
+        return BLOCKING_CALLS[callee]
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        return "open() file IO"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr == "result":
+            return "Future.result()"
+        if attr in ("recv", "sendall", "makefile") and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in ("sock", "s", "conn"):
+            # conventional socket variable names; receiver types are
+            # invisible to an AST linter, so this is deliberately narrow
+            return f"socket.{attr}()"
+    return None

@@ -60,13 +60,22 @@ class AnalysisConfig:
 
 
 class Rule:
-    """One rule family. Subclasses set `id`/`description`, yield Findings."""
+    """One rule family. Subclasses set `id`/`description`, yield Findings.
+
+    `tier` is "ast" (per-file, runs always) or "deep" (global, runs only
+    under `--deep`; the subclass implements `check_global()` instead —
+    kernel tracing and wire-schema serialization live there).
+    """
 
     id: str = ""
     description: str = ""
+    tier: str = "ast"
 
     def check(self, ctx) -> Iterator[Finding]:  # ctx: runner.FileContext
         raise NotImplementedError
+
+    def check_global(self) -> List[Finding]:    # deep tier only
+        return []
 
 
 _REGISTRY: Dict[str, Rule] = {}

@@ -1,6 +1,7 @@
 """Rule modules — importing this package registers every rule."""
-from pinot_tpu.analysis.rules import (api_compat, concurrency, dtype_drift,
-                                      host_sync, retrace)
+from pinot_tpu.analysis.rules import (api_compat, async_safety,
+                                      concurrency, deep, dtype_drift,
+                                      host_sync, lock_order, retrace)
 
-__all__ = ["api_compat", "concurrency", "dtype_drift", "host_sync",
-           "retrace"]
+__all__ = ["api_compat", "async_safety", "concurrency", "deep",
+           "dtype_drift", "host_sync", "lock_order", "retrace"]

@@ -1,0 +1,245 @@
+"""async-blocking / cross-loop: event-loop discipline.
+
+One asyncio loop thread carries every concurrent query's network waits
+(broker scatter, server mux, property-store watches). A single blocking
+call on that thread — `time.sleep`, `Future.result()`, a sync socket
+op, a spawned subprocess, an unbatched `jax.device_get` — stalls EVERY
+in-flight request, which surfaces as a latency cliff under load and is
+invisible to tests that run one query at a time.
+
+- **async-blocking** flags blocking calls inside `async def` bodies and
+  inside sync functions reachable ONLY from async code in the same file
+  (one-level: every local call site sits inside an `async def`, and the
+  function is never handed to `run_in_executor`/a thread — those run
+  off-loop by construction).
+
+  `Future.result()` has a sanctioned non-blocking form the analyzer
+  verifies instead of flagging: iterating the *done* set of an awaited
+  `asyncio.wait(...)` and calling `.result()` on the loop variable —
+  the future is proven complete, so `.result()` is a value read, not a
+  wait. That is the broker `_finish` invariant (ISSUE 7 satellite)
+  encoded as something the rule checks rather than trusts.
+
+- **cross-loop** flags asyncio APIs used from the wrong side of the
+  thread/loop boundary: `asyncio.run_coroutine_threadsafe` from inside
+  a coroutine (same-loop scheduling deadlocks the await; use
+  `create_task`), and module-level `asyncio.create_task`/
+  `ensure_future` from a plain sync function (requires a running loop
+  in THIS thread; cross-thread call sites must use
+  `run_coroutine_threadsafe`).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from pinot_tpu.analysis import astutil, callgraph
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+_WAIT_CALLS = {"asyncio.wait"}
+_THREADSAFE = "asyncio.run_coroutine_threadsafe"
+_TASK_CTORS = {"asyncio.create_task", "asyncio.ensure_future"}
+
+
+def _name_bindings(fn: ast.AST, aliases) -> Dict[str, list]:
+    """Every assignment binding each name in `fn` →
+    [(line, is_done_set)]: is_done_set is True only when the binding is
+    the done-set position of an awaited `asyncio.wait(...)` (sole
+    target, or FIRST element of a tuple target). Any other assignment
+    to the name is a rebinding that invalidates the proof."""
+    out: Dict[str, list] = {}
+    for node in astutil.walk_shallow(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        is_wait = (isinstance(node.value, ast.Await) and
+                   isinstance(node.value.value, ast.Call) and
+                   astutil.resolve(node.value.value.func, aliases)
+                   in _WAIT_CALLS)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.setdefault(tgt.id, []).append(
+                    (node.lineno, is_wait))
+            elif isinstance(tgt, ast.Tuple):
+                for i, e in enumerate(tgt.elts):
+                    if isinstance(e, ast.Name):
+                        out.setdefault(e.id, []).append(
+                            (node.lineno, is_wait and i == 0))
+    return out
+
+
+def _verified_result_calls(fn: ast.AST, aliases) -> Set[int]:
+    """id() of every `t.result()` call PROVEN non-blocking: `t` is the
+    loop variable of a `for t in done:` whose iterable's CLOSEST
+    preceding binding is the done-set of an awaited `asyncio.wait(...)`
+    (an intervening rebinding to anything else voids the proof), and
+    the call sits inside that loop's body. Flow-scoped on purpose — the
+    same name used for an unproven future elsewhere stays flagged."""
+    bindings = _name_bindings(fn, aliases)
+    out: Set[int] = set()
+    for loop in astutil.walk_shallow(fn):
+        if not (isinstance(loop, (ast.For, ast.AsyncFor)) and
+                isinstance(loop.iter, ast.Name) and
+                isinstance(loop.target, ast.Name)):
+            continue
+        before = [(ln, flag) for ln, flag in
+                  bindings.get(loop.iter.id, ())
+                  if ln <= loop.lineno]
+        if not before:
+            continue
+        last_line = max(ln for ln, _flag in before)
+        if not all(flag for ln, flag in before if ln == last_line):
+            continue        # closest binding is not a wait done-set
+        tname = loop.target.id
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "result" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == tname:
+                out.add(id(node))
+    return out
+
+
+def _scopes(tree: ast.Module):
+    """(scope node, member functions, is_class) triples: the module
+    with its top-level functions, and each class with its methods.
+    Resolution is scope-local so same-named methods on different
+    classes never alias (`self.m()` only reaches methods of the SAME
+    class; a bare `f()` only reaches module-level functions)."""
+    mod_fns = [n for n in tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    yield tree, mod_fns, False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node, [n for n in node.body if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef))], True
+
+
+def _loop_only_functions(ctx) -> Set[int]:
+    """id() set of sync functions/methods that run on the event-loop
+    thread: PRIVATE helpers (underscore-prefixed — a public method is
+    an external root per the callgraph model, callable from any worker
+    thread, so async call sites prove nothing about it) whose every
+    same-SCOPE call site is inside an `async def` and which are never
+    offloaded to a THREAD (run_in_executor/submit/Thread run off-loop
+    by construction), plus functions registered as loop callbacks
+    (call_soon*, call_later, add_done_callback), which run on the loop
+    regardless of caller or visibility. Memoized on the FileContext —
+    both async rules read one consistent result."""
+    cached = getattr(ctx, "_loop_only", None)
+    if cached is not None:
+        return cached
+    out: Set[int] = set()
+    ctx._loop_only = out
+    for scope, fns, is_class in _scopes(ctx.tree):
+        offloaded = callgraph.thread_spawned_callables(scope,
+                                                       ctx.aliases)
+        loop_cbs = callgraph.loop_callback_callables(scope, ctx.aliases)
+        sync_fns = {fn.name: fn for fn in fns
+                    if isinstance(fn, ast.FunctionDef) and
+                    fn.name not in offloaded and
+                    (fn.name.startswith("_") or fn.name in loop_cbs)}
+        called_from: Dict[str, List[bool]] = {}
+        for fn in fns:
+            is_async = isinstance(fn, ast.AsyncFunctionDef)
+            for node in astutil.walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ref = None
+                if isinstance(node.func, ast.Name) and not is_class:
+                    ref = node.func.id
+                elif is_class and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    ref = node.func.attr
+                if ref in sync_fns:
+                    called_from.setdefault(ref, []).append(is_async)
+        for name, sites in called_from.items():
+            if sites and all(sites):
+                out.add(id(sync_fns[name]))
+        for name in loop_cbs:
+            if name in sync_fns:
+                out.add(id(sync_fns[name]))
+    return out
+
+
+@register
+class AsyncBlockingRule(Rule):
+    id = "async-blocking"
+    description = ("blocking calls (sleep, Future.result, sync "
+                   "socket/file IO, subprocess, device_get) on the "
+                   "event loop: async def bodies and loop-only helpers")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        loop_only = _loop_only_functions(ctx)
+        for fn in astutil.iter_functions(ctx.tree):
+            if isinstance(fn, ast.AsyncFunctionDef):
+                yield from self._check_fn(ctx, fn, "async")
+            elif id(fn) in loop_only:
+                yield from self._check_fn(ctx, fn, "loop-only")
+
+    def _check_fn(self, ctx, fn, how: str) -> Iterator[Finding]:
+        verified = _verified_result_calls(fn, ctx.aliases) \
+            if how == "async" else set()
+        where = f"`{fn.name}`" + (
+            " (reachable only from the event loop)"
+            if how == "loop-only" else "")
+        for node in astutil.walk_shallow(fn):
+            kind = callgraph.blocking_kind(node, ctx.aliases)
+            if kind is None:
+                continue
+            if kind == "Future.result()":
+                if id(node) in verified:
+                    continue     # proven complete via asyncio.wait done
+                yield ctx.finding(
+                    self.id, node,
+                    f"{where} calls .result() on the event loop — this "
+                    "blocks the whole loop unless the future is proven "
+                    "done; await it, or iterate the done set of an "
+                    "awaited asyncio.wait(...) so the analyzer can "
+                    "verify completion")
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"{where} calls {kind} on the event loop thread — every "
+                "in-flight request stalls behind it; await the async "
+                "form or offload with run_in_executor")
+
+
+@register
+class CrossLoopRule(Rule):
+    id = "cross-loop"
+    description = ("asyncio APIs used from the wrong context: "
+                   "run_coroutine_threadsafe inside a coroutine, "
+                   "create_task from a sync function")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        # sync functions that run on the loop thread anyway — loop
+        # callbacks (call_soon*, add_done_callback) and helpers called
+        # only from async code — may create tasks legally
+        loop_cbs = callgraph.loop_callback_callables(ctx.tree,
+                                                     ctx.aliases)
+        loop_only = _loop_only_functions(ctx)
+        for fn in astutil.iter_functions(ctx.tree):
+            is_async = isinstance(fn, ast.AsyncFunctionDef)
+            on_loop = is_async or fn.name in loop_cbs or \
+                id(fn) in loop_only
+            for node in astutil.walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = astutil.resolve(node.func, ctx.aliases)
+                if callee == _THREADSAFE and is_async:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"`{fn.name}` calls run_coroutine_threadsafe "
+                        "from coroutine context — scheduling onto this "
+                        "same loop deadlocks the await; use "
+                        "asyncio.create_task / ensure_future")
+                elif callee in _TASK_CTORS and not on_loop:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"`{fn.name}` calls {callee.split('.')[-1]} from "
+                        "a sync function — it requires a loop running "
+                        "in THIS thread; from other threads use "
+                        "asyncio.run_coroutine_threadsafe")

@@ -1,40 +1,45 @@
-"""concurrency: unguarded attribute mutation in threaded classes.
+"""concurrency: cross-thread attribute races, thread-entry-point aware.
 
-Server/realtime classes are touched by scheduler worker threads,
-partition-consumer threads and state-transition threads at once. The
-rule: inside modules on the concurrency watchlist, any ``self.X = ...``
-(or ``self.X[k] = ...`` / ``self.X += ...``) OUTSIDE ``__init__`` must
-happen under a ``with self.<lock>:`` where ``<lock>`` is a
-``threading.Lock``/``RLock``/``Condition`` declared on the class.
-Classes that declare no lock at all get every non-init mutation
-flagged — either the class needs a lock or the single-writer argument
-belongs in a suppression reason next to the mutation.
+v1 of this rule flagged EVERY unguarded mutation in a lock-free class —
+which buried the real races under single-writer noise (26 of the 33
+grandfathered findings were exactly that). v2 reasons about who can
+actually run each method:
+
+- A class's **thread roots** come from the callgraph thread-entry map:
+  methods handed to `threading.Thread(target=...)` / `Timer`,
+  `Executor.submit`, `run_in_executor` are SPAWNED (other-thread)
+  roots; `async def` methods and loop-callback targets (`call_soon*`,
+  `add_done_callback`) share the LOOP root; public methods carry an
+  EXTERNAL root (scheduler pools, HTTP handler threads, watcher
+  callbacks can all call them) — in addition to a spawn root when they
+  are also a thread target. `__init__`-only helpers carry the `init`
+  root (construction happens-before publish). Private methods inherit
+  the roots of their in-class callers (fixpoint), so a `_flush`
+  reachable only from the consume-loop thread carries exactly that one
+  root.
+
+- In a class that declares NO lock, a write to `self.X` is flagged when
+  X is written from **two or more distinct writing methods spanning two
+  or more roots** — or from ONE method that provably runs on two
+  threads (spawn root plus another) — with no common lock. The
+  single-writer invariant (one consumer thread mutating, all readers on
+  snapshots; all writes funneling through one sole method) is VERIFIED
+  by the analyzer instead of demanded as a suppression comment.
+
+- In a class that DOES declare a lock, the lock is the author's own
+  statement that the class is shared: every non-init mutation outside
+  the lock is still flagged (v1 semantics), because a half-guarded
+  class is worse than an unguarded one.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Set
+from typing import Dict, Iterator, List, Set, Tuple
 
-from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis import astutil, callgraph
 from pinot_tpu.analysis.core import Finding, Rule, register
 
-_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
-_INIT_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
-
-
-def _lock_attrs(cls: ast.ClassDef, aliases) -> Set[str]:
-    """self.X assigned anywhere in the class from a Lock/RLock/Condition."""
-    locks: Set[str] = set()
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Assign) and \
-                isinstance(node.value, ast.Call) and \
-                astutil.resolve(node.value.func, aliases) in _LOCK_CTORS:
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Attribute) and \
-                        isinstance(tgt.value, ast.Name) and \
-                        tgt.value.id == "self":
-                    locks.add(tgt.attr)
-    return locks
+_INIT_METHODS = callgraph.INIT_METHODS
 
 
 def _self_attr_of_target(tgt: ast.AST) -> str:
@@ -47,77 +52,178 @@ def _self_attr_of_target(tgt: ast.AST) -> str:
     return ""
 
 
-class _MethodScan(ast.NodeVisitor):
-    """Collect unguarded self-mutations, tracking the with-lock stack."""
-
-    def __init__(self, lock_attrs: Set[str]):
-        self.lock_attrs = lock_attrs
-        self.depth = 0           # nested `with self.<lock>:` depth
-        self.hits: List[ast.AST] = []   # (node, attr) pairs
-
-    def visit_With(self, node: ast.With) -> None:
-        held = any(
-            _self_attr_of_target(item.context_expr) in self.lock_attrs
-            for item in node.items)
-        if held:
-            self.depth += 1
-        self.generic_visit(node)
-        if held:
-            self.depth -= 1
-
-    def _record(self, node: ast.AST, targets) -> None:
-        if self.depth:
-            return
-        for tgt in targets:
-            attr = _self_attr_of_target(tgt)
-            if attr and attr not in self.lock_attrs:
-                self.hits.append((node, attr))
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        self._record(node, node.targets)
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node: ast.AugAssign) -> None:
-        self._record(node, [node.target])
-        self.generic_visit(node)
-
-    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
-        if node.value is not None:
-            self._record(node, [node.target])
-        self.generic_visit(node)
+def _write_targets(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return node.targets
+    if isinstance(node, ast.AugAssign):
+        return [node.target]
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target]
+    return []
 
 
 @register
 class ConcurrencyRule(Rule):
     id = "concurrency"
-    description = ("attributes of server/realtime classes mutated "
-                   "outside __init__ without holding a lock declared "
-                   "on the class")
+    description = ("attributes of server/realtime classes written on "
+                   ">=2 thread paths (or outside a declared lock) "
+                   "without a common lock")
 
     def check(self, ctx) -> Iterator[Finding]:
         if not ctx.in_prefixes(ctx.config.concurrency_prefixes):
             return
-        for cls in ast.walk(ctx.tree):
-            if not isinstance(cls, ast.ClassDef):
+        global_locks = callgraph.module_locks(ctx.tree, ctx.aliases)
+        for model in callgraph.iter_class_models(ctx.tree, ctx.aliases):
+            yield from self._check_class(ctx, model, global_locks)
+
+    def _check_class(self, ctx, model: callgraph.ClassModel,
+                     global_locks: Set[str]) -> Iterator[Finding]:
+        cls = model.node.name
+        locks = model.lock_attrs
+        # attr → [(node, method, roots, held-locks)]
+        writes: Dict[str, List[Tuple[ast.AST, str, frozenset,
+                                     frozenset]]] = {}
+
+        def method_roots(mname: str) -> frozenset:
+            raw = set(model.roots.get(mname, ()))
+            effective = raw - {"init"}
+            if not effective and not raw:
+                # uncalled private method: some other module calls it —
+                # conservatively its own external root
+                return frozenset({f"ext:{mname}"})
+            return frozenset(effective)
+
+        for mname, m in model.methods.items():
+            if mname in _INIT_METHODS:
+                # direct writes are construction-time, but a closure
+                # DEFINED here and handed to a thread/loop API runs
+                # post-publish — scan exactly those below
+                self._scan_spawned_closures(ctx, model, mname, m,
+                                            global_locks, writes)
                 continue
-            locks = _lock_attrs(cls, ctx.aliases)
-            for method in cls.body:
-                if not isinstance(method, (ast.FunctionDef,
-                                           ast.AsyncFunctionDef)):
-                    continue
-                if method.name in _INIT_METHODS:
-                    continue
-                scan = _MethodScan(locks)
-                scan.visit(method)
-                for node, attr in scan.hits:
-                    if locks:
-                        msg = (f"`{cls.name}.{method.name}` mutates "
-                               f"self.{attr} without holding "
-                               f"{'/'.join(sorted(locks))}")
-                    else:
-                        msg = (f"`{cls.name}.{method.name}` mutates "
-                               f"self.{attr} but the class declares no "
-                               "lock — add one or justify the "
-                               "single-writer invariant in a "
-                               "suppression reason")
-                    yield ctx.finding(self.id, node, msg)
+            roots = method_roots(mname)
+            if not roots:
+                # reachable from __init__ only: direct writes are
+                # construction-time, but thread/loop-handed closures
+                # still escape construction — scan those
+                self._scan_spawned_closures(ctx, model, mname, m,
+                                            global_locks, writes)
+                continue
+            for site in callgraph.walk_with_locks(m, locks, global_locks):
+                for tgt in _write_targets(site.node):
+                    attr = _self_attr_of_target(tgt)
+                    if attr and attr not in locks:
+                        writes.setdefault(attr, []).append(
+                            (site.node, mname, roots,
+                             frozenset(site.held)))
+            self._scan_spawned_closures(ctx, model, mname, m,
+                                        global_locks, writes,
+                                        roots=roots)
+        yield from self._judge(ctx, cls, locks, writes)
+
+    def _scan_spawned_closures(self, ctx, model: callgraph.ClassModel,
+                               mname: str, m: ast.AST,
+                               global_locks: Set[str], writes,
+                               roots=None) -> None:
+        """Record self-writes inside closures nested in `m`.
+
+        Closures run LATER, on whatever thread they were handed to — a
+        lock held at DEF time is not held at call time, so each closure
+        body starts with an empty held set; locks the closure ITSELF
+        takes do count (walk_with_locks starts fresh per function).
+        `roots=None` means `m` is a construction method: only closures
+        handed to a thread/loop API matter (anything else runs during
+        construction, happens-before publish).
+        """
+        locks = model.lock_attrs
+        spawned_here = callgraph.thread_spawned_callables(m, ctx.aliases)
+        loop_here = callgraph.loop_callback_callables(m, ctx.aliases)
+        # a closure whose name is only ever used as a direct `name()`
+        # call never escapes the method: it runs inline, under whatever
+        # locks its call sites hold — not a deferred callback, so the
+        # empty-held-set policy is wrong for it and it is skipped like
+        # any other inline code. For closures that DO escape through a
+        # non-spawn call (sort key=, a retry wrapper), record the lock
+        # set held at every escape site: if every hand-off happens
+        # under a lock, the closure's writes inherit that guard (the
+        # sort runs inline inside the with-block); one unlocked escape
+        # drops the inheritance (conservative).
+        direct_call_funcs = {id(c.func) for c in ast.walk(m)
+                             if isinstance(c, ast.Call)}
+        escape_held: Dict[str, List[frozenset]] = {}
+        for site in callgraph.walk_with_locks(m, locks, global_locks):
+            n = site.node
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load) and \
+                    id(n) not in direct_call_funcs:
+                escape_held.setdefault(n.id, []).append(
+                    frozenset(site.held))
+        for nd in ast.walk(m):
+            if nd is m or not isinstance(
+                    nd, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            where = f"{mname}.<{nd.name}>"
+            inherited: frozenset = frozenset()
+            if nd.name in spawned_here:
+                nroots = frozenset({f"spawn:{where}"})
+            elif nd.name in loop_here:
+                nroots = frozenset({"loop"})
+            elif roots is not None and nd.name in escape_held:
+                nroots = roots
+                inherited = frozenset.intersection(
+                    *escape_held[nd.name])
+            else:
+                continue          # init-local or inline-only closure
+            for site in callgraph.walk_with_locks(nd, locks,
+                                                  global_locks):
+                for tgt in _write_targets(site.node):
+                    attr = _self_attr_of_target(tgt)
+                    if attr and attr not in locks:
+                        writes.setdefault(attr, []).append(
+                            (site.node, where, nroots,
+                             frozenset(site.held) | inherited))
+
+    def _judge(self, ctx, cls: str, locks: Set[str],
+               writes) -> Iterator[Finding]:
+        if locks:
+            # lock-declaring class: v1 semantics — every unguarded
+            # non-init write is a finding
+            for attr, sites in sorted(writes.items()):
+                for node, mname, _roots, held in sites:
+                    if not held:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"`{cls}.{mname}` mutates self.{attr} "
+                            f"without holding {'/'.join(sorted(locks))}")
+            return
+        # lock-free class: flag an attribute when EITHER (a) it is
+        # written from >=2 distinct WRITE paths (methods) reachable
+        # from >=2 distinct thread roots, or (b) its sole writing
+        # method is itself reachable from a spawned thread AND another
+        # context (a public Thread-target: the same code provably runs
+        # on two threads) — in both cases with no common held lock.
+        # Pure ext-to-ext fan-in through one method (append→extend, a
+        # lazy cache with one filler) stays the verified single-writer
+        # pattern: the sole writer carries serialization structurally.
+        for attr, sites in sorted(writes.items()):
+            methods = {m for _n, m, _r, _h in sites}
+            all_roots = sorted(set().union(*(r for _n, _m, r, _h
+                                             in sites)))
+            if len(all_roots) < 2:
+                continue          # verified single-writer: one root
+            if len(methods) < 2 and not any(
+                    r.startswith("spawn:") for r in all_roots):
+                continue          # sole writing method, no proven
+                #                   second thread: structural fan-in
+            common = frozenset.intersection(*(h for _n, _m, _r, h
+                                              in sites))
+            if common:
+                continue          # a shared (module-level) lock guards
+            paths = ", ".join(all_roots)
+            for node, mname, _roots, _held in sites:
+                yield ctx.finding(
+                    self.id, node,
+                    f"`{cls}.{mname}` writes self.{attr}, also written "
+                    f"on other thread paths ({paths}) with no common "
+                    "lock — add a lock or make one path the sole "
+                    "writer")

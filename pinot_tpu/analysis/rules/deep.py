@@ -1,0 +1,50 @@
+"""Deep-tier global rules: kernel jaxpr contracts + wire-schema gate.
+
+Unlike the AST families these don't read source — they import the live
+modules, trace kernels, and serialize exemplar wire objects (see
+analysis/contracts.py). They register here so the CLI's rule registry,
+`--rule` filtering and the baseline machinery treat their findings
+uniformly; the runner invokes `check_global()` once per run (only in
+`--deep` mode — tracing every kernel is deliberately not part of the
+default fast lint).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+
+@register
+class KernelContractRule(Rule):
+    id = "kernel-contract"
+    description = ("jaxpr-level kernel contracts: no host callbacks, "
+                   "no 64-bit avals in 32-bit mode, stable retrace "
+                   "(deep tier)")
+    tier = "deep"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        return iter(())
+
+    def check_global(self) -> List[Finding]:
+        from pinot_tpu.analysis import contracts
+        return [Finding(path="pinot_tpu/ops/kernels.py", line=1,
+                        rule=self.id, message=v)
+                for v in contracts.check_kernel_contracts()]
+
+
+@register
+class WireSchemaRule(Rule):
+    id = "wire-schema"
+    description = ("serde wire surface must match the committed "
+                   "wire-schema.json (version-skew gate, deep tier)")
+    tier = "deep"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        return iter(())
+
+    def check_global(self) -> List[Finding]:
+        from pinot_tpu.analysis import contracts
+        return [Finding(path="pinot_tpu/common/serde.py", line=1,
+                        rule=self.id, message=v)
+                for v in contracts.check_wire_schema()]

@@ -1,0 +1,232 @@
+"""lock-order / lock-blocking: the interprocedural lock analyzer.
+
+The serving plane holds locks on scheduler worker threads, consumer
+threads, watcher threads and the asyncio loop thread at once, so the two
+hazards that matter are the two that lexical rules can't see:
+
+- **lock-order** — an acquisition CYCLE in the per-module lock graph
+  (lock B taken while A is held in one code path, A while B is held in
+  another). Two threads entering the two paths concurrently deadlock.
+  This is the kernel lockdep model: record the acquisition ORDER the
+  code exhibits, fail on a cycle, never wait for the deadlock to happen
+  in production. Edges follow calls one level interprocedurally
+  (`with self._lock: self._flush()` charges _flush's acquisitions to
+  the held set).
+
+- **lock-blocking** — a threading lock held across a blocking call
+  (`await`, `Future.result()`, `time.sleep`, socket/file IO, spawned
+  subprocesses, `jax.device_get`). The holder parks on IO while every
+  other thread convoys at the lock; under asyncio an `await` with a
+  threading lock held parks it for a whole scheduling round-trip.
+
+Both rules see `with`-statements (incl. multi-item) and explicit
+`acquire()`/`release()`; lock identity is `Class.attr` for instance
+locks and the bare global name for module-level locks.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from pinot_tpu.analysis import astutil, callgraph
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+
+def _acquisitions(fn: ast.AST, self_locks: Set[str],
+                  global_locks: Set[str]) -> List[callgraph.Site]:
+    return [s for s in callgraph.walk_with_locks(fn, self_locks,
+                                                 global_locks)
+            if s.acquires is not None]
+
+
+def _blocking_sites(fn: ast.AST, aliases) -> List[Tuple[ast.AST, str]]:
+    """(node, kind) for every blocking call/await shallow in `fn`."""
+    out: List[Tuple[ast.AST, str]] = []
+    for node in astutil.walk_shallow(fn):
+        if isinstance(node, ast.Await):
+            out.append((node, "await"))
+            continue
+        kind = callgraph.blocking_kind(node, aliases)
+        if kind is not None:
+            out.append((node, kind))
+    return out
+
+
+class _ModuleLockAnalysis:
+    """One file's lock graph + held-across-blocking sites."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.global_locks = callgraph.module_locks(ctx.tree, ctx.aliases)
+        # edge (held_lock -> acquired_lock) → example (line, where)
+        self.edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        # (node, held_lock, kind, where) blocking-under-lock hazards
+        self.blocked: List[Tuple[ast.AST, str, str, str]] = []
+        for model in callgraph.iter_class_models(ctx.tree, ctx.aliases):
+            self._scan_class(model)
+        self._scan_module_functions()
+
+    # -- scanning -----------------------------------------------------------
+    def _qualify(self, cls_name: str, lock: str) -> str:
+        return f"{cls_name}.{lock[5:]}" if lock.startswith("self.") \
+            else lock
+
+    def _scan_class(self, model: callgraph.ClassModel) -> None:
+        cls = model.node.name
+        for mname, m in model.methods.items():
+            where = f"{cls}.{mname}"
+            sites = callgraph.walk_with_locks(m, model.lock_attrs,
+                                              self.global_locks)
+            for site in sites:
+                held = [self._qualify(cls, h) for h in site.held]
+                if site.acquires is not None:
+                    acq = self._qualify(cls, site.acquires)
+                    for h in held:
+                        if h != acq:
+                            self.edges.setdefault(
+                                (h, acq), (site.node.lineno, where))
+                    continue
+                if not held:
+                    # one-level interprocedural: a self-call made while
+                    # NO lock is held contributes nothing
+                    continue
+                kind = None
+                if isinstance(site.node, ast.Await):
+                    kind = "await"
+                else:
+                    kind = callgraph.blocking_kind(site.node,
+                                                   self.ctx.aliases)
+                if kind is not None:
+                    for h in held:
+                        self.blocked.append((site.node, h, kind, where))
+                    continue
+                # follow a held self-call one level down
+                if isinstance(site.node, ast.Call):
+                    callee = model.resolve_call(site.node)
+                    if callee is None:
+                        continue
+                    cname = f"{cls}.{callee.name}"
+                    for acq_site in _acquisitions(callee,
+                                                  model.lock_attrs,
+                                                  self.global_locks):
+                        acq = self._qualify(cls, acq_site.acquires)
+                        for h in held:
+                            if h != acq:
+                                self.edges.setdefault(
+                                    (h, acq),
+                                    (site.node.lineno,
+                                     f"{where} → {cname}"))
+                    # anchor at the CALLEE's blocking line so one
+                    # suppression there covers every held call site
+                    for node, kind in _blocking_sites(callee,
+                                                      self.ctx.aliases):
+                        for h in held:
+                            self.blocked.append(
+                                (node, h, kind,
+                                 f"{where} → {cname}"))
+
+    def _scan_module_functions(self) -> None:
+        if not self.global_locks:
+            return
+        for fn in self.ctx.tree.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            sites = callgraph.walk_with_locks(fn, set(), self.global_locks)
+            for site in sites:
+                if site.acquires is not None:
+                    for h in site.held:
+                        if h != site.acquires:
+                            self.edges.setdefault(
+                                (h, site.acquires),
+                                (site.node.lineno, fn.name))
+                    continue
+                if not site.held:
+                    continue
+                kind = "await" if isinstance(site.node, ast.Await) else \
+                    callgraph.blocking_kind(site.node, self.ctx.aliases)
+                if kind is not None:
+                    for h in site.held:
+                        self.blocked.append((site.node, h, kind, fn.name))
+
+    # -- cycle detection ----------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Distinct simple cycles in the acquisition graph, each
+        reported once in canonical rotation (start at min lock)."""
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+        seen: Set[Tuple[str, ...]] = set()
+        out: List[List[str]] = []
+
+        def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):]
+                    i = cyc.index(min(cyc))
+                    canon = tuple(cyc[i:] + cyc[:i])
+                    if canon not in seen:
+                        seen.add(canon)
+                        out.append(list(canon))
+                    continue
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+        for start in sorted(graph):
+            dfs(start, [start], {start})
+        return out
+
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    description = ("cycles in the per-module lock acquisition graph "
+                   "(potential deadlocks), lockdep-style")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        analysis = getattr(ctx, "_lock_analysis", None)
+        if analysis is None:
+            analysis = _ModuleLockAnalysis(ctx)
+            ctx._lock_analysis = analysis
+        for cyc in analysis.cycles():
+            ring = cyc + [cyc[0]]
+            hops = []
+            line = 1
+            for a, b in zip(ring, ring[1:]):
+                ln, where = analysis.edges[(a, b)]
+                hops.append(f"{a} → {b} (`{where}`)")
+                line = ln
+            node = ast.Module(body=[], type_ignores=[])
+            node.lineno = line
+            yield ctx.finding(
+                self.id, node,
+                "potential deadlock: lock acquisition cycle "
+                + "; ".join(hops)
+                + " — impose one global order or collapse the locks")
+
+
+@register
+class LockBlockingRule(Rule):
+    id = "lock-blocking"
+    description = ("threading lock held across a blocking call (await, "
+                   "Future.result, sleep, socket/file IO, device_get)")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        analysis = getattr(ctx, "_lock_analysis", None)
+        if analysis is None:
+            analysis = _ModuleLockAnalysis(ctx)
+            ctx._lock_analysis = analysis
+        emitted = set()
+        for node, lock, kind, where in analysis.blocked:
+            key = (getattr(node, "lineno", 1), lock, kind)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield ctx.finding(
+                self.id, node,
+                f"`{where}` holds {lock} across {kind} — the blocked "
+                "holder convoys every other thread at this lock; move "
+                "the blocking work outside, or state why the hold is "
+                "required in a suppression reason")

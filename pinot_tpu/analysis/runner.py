@@ -59,6 +59,8 @@ def analyze_source(source: str, path: str,
     for rule_id, rule in sorted(all_rules().items()):
         if rule_ids is not None and rule_id not in rule_ids:
             continue
+        if rule.tier != "ast":
+            continue
         for f in rule.check(ctx):
             (suppressed if is_suppressed(f, per_line, per_file)
              else kept).append(f)
@@ -82,11 +84,15 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def analyze_paths(paths: Sequence[str],
                   config: Optional[AnalysisConfig] = None,
-                  rule_ids: Optional[Set[str]] = None) -> AnalysisResult:
+                  rule_ids: Optional[Set[str]] = None,
+                  deep: bool = False) -> AnalysisResult:
     """Analyze every .py file under `paths` (files or directories).
 
     Paths should be given relative to the repo root so finding keys
-    match the committed baseline.
+    match the committed baseline. `deep=True` additionally runs the
+    global deep-tier rules (kernel jaxpr contracts, wire schema) once
+    for the whole run — they are path-independent, so run them from the
+    repo root only.
     """
     total = AnalysisResult([], [], [])
     for path in iter_py_files(paths):
@@ -101,6 +107,18 @@ def analyze_paths(paths: Sequence[str],
         total.findings.extend(res.findings)
         total.suppressed.extend(res.suppressed)
         total.errors.extend(res.errors)
+    if deep:
+        for rule_id, rule in sorted(all_rules().items()):
+            if rule.tier != "deep":
+                continue
+            if rule_ids is not None and rule_id not in rule_ids:
+                continue
+            try:
+                total.findings.extend(rule.check_global())
+            except Exception as e:  # noqa: BLE001 — a crashed checker
+                total.errors.append(    # must fail the gate loudly
+                    f"deep rule {rule_id} crashed: {type(e).__name__}: "
+                    f"{e}")
     total.findings.sort()
     total.suppressed.sort()
     return total

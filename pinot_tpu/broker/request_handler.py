@@ -256,8 +256,13 @@ class QueryRouter:
         budget = deadline - self._clock()
         done, _pending = await asyncio.wait(
             {primary}, timeout=max(0.0, min(hedge_after, budget)))
-        if done:
-            return primary.result()
+        for t in done:
+            # t came out of asyncio.wait's done set, so .result() is a
+            # completed-future value read, not a loop-blocking wait —
+            # the async-blocking rule VERIFIES this iteration pattern
+            # (the audited `primary.result()` form was equivalent but
+            # unverifiable statically)
+            return t.result()
         hedge_server = self._hedge_candidate(sub, segments, tried)
         if hedge_server is None:
             return await primary

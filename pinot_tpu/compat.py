@@ -14,6 +14,7 @@ from __future__ import annotations
 import inspect
 
 import jax
+import jax.numpy as jnp
 
 _shard_map_impl = getattr(jax, "shard_map", None)
 if _shard_map_impl is None:
@@ -36,3 +37,21 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     else:
         kwargs["check_rep"] = check_vma
     return _shard_map_impl(f, **kwargs)
+
+
+def wide_i64(value):
+    """A genuinely-64-bit int constant for math on int64/float64 lanes.
+
+    A bare ``jnp.int64(x)`` is a lie when x64 is disabled: it silently
+    builds an int32, and any mask/shift arithmetic written for 64-bit
+    lanes truncates without a whisper (tpulint's dtype-drift rule exists
+    for exactly this). This helper asserts the intent instead: the
+    caller is operating on a lane whose dtype IS 64-bit, which can only
+    happen with x64 enabled — calling it in 32-bit mode is a programmer
+    error surfaced at trace time, not a silent truncation at query time.
+    """
+    if not jax.config.jax_enable_x64:
+        raise AssertionError(
+            "wide_i64 used while x64 is disabled — a 64-bit lane cannot "
+            "exist here; the surrounding dtype dispatch is wrong")
+    return jnp.int64(value)  # tpulint: disable=dtype-drift -- the one sanctioned 64-bit constructor: guarded by the x64 assertion above

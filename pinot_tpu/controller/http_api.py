@@ -11,6 +11,7 @@ reference's SegmentCompletionUtils tar.gz push.
 """
 from __future__ import annotations
 
+import asyncio
 import os
 import tempfile
 
@@ -27,6 +28,11 @@ from pinot_tpu.transport.http import (ApiServer, HttpRequest, HttpResponse,
 # upload/download endpoints are where most callers first meet the format
 from pinot_tpu.common.segment_tar import (pack_segment_dir,   # noqa: F401
                                           unpack_segment_tar)
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
 
 
 class ControllerApiServer(ApiServer):
@@ -482,13 +488,19 @@ class ControllerApiServer(ApiServer):
         full = self._deepstore_path(request)
         if full is None:
             return HttpResponse.error(403, "path outside deep store")
+        # segment artifacts run to hundreds of MB: reading (or packing)
+        # them on the event loop would stall every other controller API
+        # call for the duration — do the IO on the default executor
+        loop = asyncio.get_running_loop()
         if os.path.isdir(full):
-            return HttpResponse(200, pack_segment_dir(full),
+            data = await loop.run_in_executor(None, pack_segment_dir,
+                                              full)
+            return HttpResponse(200, data,
                                 content_type="application/octet-stream")
         if os.path.isfile(full):
-            with open(full, "rb") as f:
-                return HttpResponse(200, f.read(),
-                                    content_type="application/octet-stream")
+            data = await loop.run_in_executor(None, _read_file, full)
+            return HttpResponse(200, data,
+                                content_type="application/octet-stream")
         return HttpResponse.error(404, "not found")
 
     async def _deepstore_stat(self, request: HttpRequest) -> HttpResponse:

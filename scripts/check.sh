@@ -44,5 +44,11 @@ echo "== obs smoke (observability plane) =="
 # trace tree with per-server subtrees
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== tpulint =="
-exec "$(dirname "$0")/lint.sh"
+echo "== tpulint (deep tier) =="
+# --deep adds the below-the-AST gates on top of the AST families:
+# every registered kernel is traced with jax.make_jaxpr across the
+# shape-bucket grid (no host callbacks, no 64-bit avals in 32-bit
+# mode, stable retrace) and the serde wire surface must round-trip
+# against the committed wire-schema.json. On failure the CLI prints a
+# findings-diff summary (rule id, file:line, fix-or-suppress guidance).
+exec "$(dirname "$0")/lint.sh" --deep

@@ -2,6 +2,9 @@
 # tpulint over the tree (or explicit paths), gated on the committed
 # baseline. Run from anywhere; executes at the repo root so finding
 # keys match tpulint.baseline.json.
+#
+#   scripts/lint.sh              fast tier (AST rule families)
+#   scripts/lint.sh --deep       + jaxpr kernel contracts + wire-schema
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -36,9 +36,23 @@ def findings_of(source: str, path: str = KERNEL_PATH):
 # ---------------------------------------------------------------------------
 
 
-def test_all_five_rule_families_registered():
-    assert set(all_rules()) == {"host-sync", "retrace", "dtype-drift",
-                                "concurrency", "api-compat"}
+def test_rule_families_registered():
+    assert set(all_rules()) == {
+        # PR 1 AST families
+        "host-sync", "retrace", "dtype-drift", "concurrency",
+        "api-compat",
+        # deep-analysis AST families (lock graph + event-loop safety)
+        "lock-order", "lock-blocking", "async-blocking", "cross-loop",
+        # global deep tier (jaxpr contracts, wire surface)
+        "kernel-contract", "wire-schema"}
+
+
+def test_deep_rules_are_deep_tier_only():
+    rules = all_rules()
+    assert rules["kernel-contract"].tier == "deep"
+    assert rules["wire-schema"].tier == "deep"
+    # fast analyze_source must not invoke them (they are global)
+    assert analyze_source("x = 1\n", PLAIN_PATH).findings == []
 
 
 def test_fixture_corpus_fires_at_least_three_families():
@@ -223,14 +237,19 @@ class Scheduler:
         self.pending = 0
 
     def submit(self):
-        self.pending += 1          # unguarded
+        self.pending += 1          # unguarded in a lock-declaring class
 
 class NoLock:
     def __init__(self):
         self.state = "INIT"
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        self.state = "RUNNING"     # consumer-thread writer ...
 
     def advance(self):
-        self.state = "RUNNING"     # class declares no lock at all
+        self.state = "DONE"        # ... races the external writer
 """
 
 CONCURRENCY_NEG = """
@@ -248,16 +267,60 @@ class Scheduler:
             self._groups[name] = 1
 """
 
+# the v2 upgrade: a spawned thread being the SOLE writer is a VERIFIED
+# single-writer invariant, not a finding (v1 flagged every lock-free
+# mutation — 26 of the 33 grandfathered findings were this shape)
+CONCURRENCY_SINGLE_WRITER = """
+import threading
+
+class Consumer:
+    def __init__(self):
+        self.offset = 0
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        while True:
+            self.offset += 1       # only the spawned thread writes
+
+    def position(self):
+        return self.offset         # readers don't mutate
+"""
+
+# fan-in through one sole writing method is the structural
+# single-writer pattern (append delegating to extend)
+CONCURRENCY_FANIN = """
+class Growable:
+    def __init__(self):
+        self.n = 0
+
+    def append(self, v):
+        self.extend([v])
+
+    def extend(self, arr):
+        self.n += len(arr)         # the one writer path
+"""
+
 
 def test_concurrency_positive():
     found = findings_of(CONCURRENCY_POS, SERVER_PATH)
     assert {f.rule for f in found} == {"concurrency"}
     msgs = " ".join(f.message for f in found)
-    assert "Scheduler.submit" in msgs and "NoLock.advance" in msgs
+    assert "Scheduler.submit" in msgs
+    assert "NoLock._run" in msgs and "NoLock.advance" in msgs
+    assert "spawn:_run" in msgs     # the thread-entry map is cited
 
 
 def test_concurrency_negative():
     assert rules_of(CONCURRENCY_NEG, SERVER_PATH) == []
+
+
+def test_concurrency_verified_single_writer_is_quiet():
+    assert rules_of(CONCURRENCY_SINGLE_WRITER, SERVER_PATH) == []
+
+
+def test_concurrency_sole_writer_fanin_is_quiet():
+    assert rules_of(CONCURRENCY_FANIN, SERVER_PATH) == []
 
 
 def test_concurrency_out_of_scope_module_is_quiet():

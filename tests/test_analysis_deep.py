@@ -1,0 +1,960 @@
+"""Deep analysis tier tests: fixture corpus for the lock-order /
+async-safety rule families (known-bad snippets each rule must catch,
+known-good snippets that must pass WITHOUT suppressions), jaxpr kernel
+contracts over the registered kernel surface, the wire-schema gate, and
+the suppression-parsing / baseline-determinism edge cases (ISSUE 7
+satellites)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pinot_tpu.analysis import analyze_source
+from pinot_tpu.analysis.core import parse_suppressions
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVER_PATH = "pinot_tpu/server/_fixture.py"      # concurrency scope
+PLAIN_PATH = "pinot_tpu/common/_fixture.py"
+
+
+def rules_of(source: str, path: str = PLAIN_PATH):
+    return sorted({f.rule for f in analyze_source(source, path).findings})
+
+
+def findings_of(source: str, path: str = PLAIN_PATH):
+    return analyze_source(source, path).findings
+
+
+# ---------------------------------------------------------------------------
+# known-bad corpus — each snippet must fire its rule
+# ---------------------------------------------------------------------------
+
+BAD_DEADLOCK_CYCLE = """
+import threading
+
+class Ledger:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def debit(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def credit(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+BAD_CYCLE_INTERPROCEDURAL = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._queue_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+
+    def _promote(self):
+        with self._queue_lock:
+            pass
+
+    def rebalance(self):
+        with self._state_lock:
+            self._promote()          # state → queue ...
+
+    def drain(self):
+        with self._queue_lock:
+            with self._state_lock:   # ... queue → state: cycle
+                pass
+"""
+
+BAD_LOCK_ACROSS_AWAIT = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def refresh(self, fetch):
+        with self._lock:
+            data = await fetch()     # threading lock parked over await
+        return data
+"""
+
+BAD_LOCK_ACROSS_BLOCKING = """
+import threading
+import time
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def publish(self):
+        with self._lock:
+            time.sleep(1.0)          # every thread convoys behind this
+"""
+
+BAD_LOOP_BLOCKING_SLEEP = """
+import time
+
+async def handle(request):
+    time.sleep(0.5)                  # stalls the whole event loop
+    return request
+"""
+
+BAD_LOOP_BLOCKING_RESULT = """
+async def gather(fut):
+    return fut.result()              # unproven future: blocks the loop
+"""
+
+BAD_LOOP_ONLY_HELPER = """
+import subprocess
+
+def _compress(payload):
+    return subprocess.run(["gzip"], input=payload)   # loop-reachable
+
+async def respond(payload):
+    return _compress(payload)
+"""
+
+BAD_CROSS_LOOP_THREADSAFE = """
+import asyncio
+
+async def dispatch(coro, loop):
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+    return fut
+"""
+
+BAD_CROSS_LOOP_CREATE_TASK = """
+import asyncio
+
+def fire_and_forget(coro):
+    asyncio.create_task(coro)        # no running loop in a sync caller
+"""
+
+BAD_NARROWING_DTYPE = """
+import numpy as np
+
+def doc_offsets(doc_ids, widths):
+    return (doc_ids * widths).astype(np.int32)
+"""
+
+
+def test_bad_deadlock_cycle_fires():
+    found = findings_of(BAD_DEADLOCK_CYCLE)
+    assert [f.rule for f in found] == ["lock-order"]
+    assert "Ledger._a" in found[0].message
+    assert "Ledger._b" in found[0].message
+
+
+def test_bad_interprocedural_cycle_fires():
+    found = findings_of(BAD_CYCLE_INTERPROCEDURAL)
+    assert "lock-order" in {f.rule for f in found}
+    msg = " ".join(f.message for f in found)
+    assert "Pool.rebalance → Pool._promote" in msg
+
+
+def test_bad_lock_across_await_fires():
+    found = findings_of(BAD_LOCK_ACROSS_AWAIT)
+    assert "lock-blocking" in {f.rule for f in found}
+    assert any("await" in f.message for f in found)
+
+
+def test_bad_lock_across_blocking_call_fires():
+    found = findings_of(BAD_LOCK_ACROSS_BLOCKING)
+    assert "lock-blocking" in {f.rule for f in found}
+    assert any("time.sleep" in f.message for f in found)
+
+
+def test_bad_loop_blocking_sleep_fires():
+    assert rules_of(BAD_LOOP_BLOCKING_SLEEP) == ["async-blocking"]
+
+
+def test_bad_loop_blocking_result_fires():
+    found = findings_of(BAD_LOOP_BLOCKING_RESULT)
+    assert [f.rule for f in found] == ["async-blocking"]
+    assert "asyncio.wait" in found[0].message   # tells you the fix
+
+
+def test_bad_loop_only_helper_fires():
+    found = findings_of(BAD_LOOP_ONLY_HELPER)
+    assert [f.rule for f in found] == ["async-blocking"]
+    assert "reachable only from the event loop" in found[0].message
+
+
+def test_bad_cross_loop_threadsafe_fires():
+    assert rules_of(BAD_CROSS_LOOP_THREADSAFE) == ["cross-loop"]
+
+
+def test_bad_cross_loop_create_task_fires():
+    assert rules_of(BAD_CROSS_LOOP_CREATE_TASK) == ["cross-loop"]
+
+
+def test_bad_narrowing_dtype_fires():
+    assert rules_of(BAD_NARROWING_DTYPE) == ["dtype-drift"]
+
+
+# ---------------------------------------------------------------------------
+# known-good corpus — must pass WITHOUT suppressions
+# ---------------------------------------------------------------------------
+
+GOOD_CONSISTENT_LOCK_ORDER = """
+import threading
+
+class Ledger:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def debit(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def credit(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+GOOD_SNAPSHOT_THEN_WORK = """
+import threading
+import time
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def publish(self):
+        with self._lock:
+            snapshot = dict(self._entries)
+        time.sleep(1.0)              # blocking AFTER the lock released
+        return snapshot
+"""
+
+GOOD_ASYNC_AWAITS = """
+import asyncio
+
+async def handle(request, fetch):
+    await asyncio.sleep(0.5)
+    return await fetch(request)
+"""
+
+GOOD_DONE_SET_RESULT = """
+import asyncio
+
+async def first_winner(tasks):
+    done, pending = await asyncio.wait(
+        tasks, return_when=asyncio.FIRST_COMPLETED)
+    for t in done:
+        return t.result()            # proven complete: a value read
+"""
+
+GOOD_OFFLOADED_HELPER = """
+import asyncio
+import subprocess
+
+def _compress(payload):
+    return subprocess.run(["gzip"], input=payload)
+
+async def respond(payload):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, _compress, payload)
+"""
+
+GOOD_CROSS_LOOP_FROM_THREAD = """
+import asyncio
+
+def submit_from_watcher(coro, loop):
+    return asyncio.run_coroutine_threadsafe(coro, loop)
+
+async def schedule(coro):
+    return asyncio.ensure_future(coro)
+"""
+
+
+def test_good_corpus_passes_without_suppressions():
+    goods = [GOOD_CONSISTENT_LOCK_ORDER, GOOD_SNAPSHOT_THEN_WORK,
+             GOOD_ASYNC_AWAITS, GOOD_DONE_SET_RESULT,
+             GOOD_OFFLOADED_HELPER, GOOD_CROSS_LOOP_FROM_THREAD]
+    assert len(goods) >= 5
+    for src in goods:
+        res = analyze_source(src, PLAIN_PATH)
+        assert res.findings == [], [f.render() for f in res.findings]
+        assert res.suppressed == []      # good BY CONSTRUCTION, not
+        #                                  by suppression
+
+
+def test_bad_corpus_counts():
+    bads = [BAD_DEADLOCK_CYCLE, BAD_CYCLE_INTERPROCEDURAL,
+            BAD_LOCK_ACROSS_AWAIT, BAD_LOCK_ACROSS_BLOCKING,
+            BAD_LOOP_BLOCKING_SLEEP, BAD_LOOP_BLOCKING_RESULT,
+            BAD_LOOP_ONLY_HELPER, BAD_CROSS_LOOP_THREADSAFE,
+            BAD_CROSS_LOOP_CREATE_TASK, BAD_NARROWING_DTYPE]
+    assert len(bads) >= 5
+    for src in bads:
+        assert findings_of(src), "known-bad snippet produced no finding"
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions (findings from the ISSUE 7 review pass)
+# ---------------------------------------------------------------------------
+
+BAD_CLOSURE_WRITE = """
+import threading
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = None
+        self.done = False
+
+    def kick(self):
+        def cb():
+            self.done = True       # runs later, on a pool thread,
+        self._pool.submit(cb)      # with NO lock held
+"""
+
+BAD_RESULT_NAME_REUSE = """
+import asyncio
+
+async def race(fut, tasks):
+    t = fut
+    x = t.result()                  # NOT proven done: blocks the loop
+    done, _ = await asyncio.wait(tasks)
+    for t in done:
+        x = t.result()              # proven done: fine
+    return x
+"""
+
+GOOD_INIT_HELPER = """
+import threading
+
+class Boot:
+    def __init__(self):
+        self.state = "INIT"
+        self._setup()               # construction happens-before
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _setup(self):
+        self.state = "READY"        # init-only: not a thread path
+
+    def _run(self):
+        while True:
+            self.state = "RUNNING"  # sole post-publish writer
+"""
+
+GOOD_LOOP_CALLBACK_CREATE_TASK = """
+import asyncio
+
+class Poller:
+    def arm(self, loop):
+        loop.call_soon(self._poke)
+
+    def _poke(self):
+        asyncio.ensure_future(self._work())   # runs ON the loop thread
+
+    async def _work(self):
+        await asyncio.sleep(0)
+"""
+
+
+BAD_PUBLIC_THREAD_TARGET = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self.n = 0
+        threading.Thread(target=self.run).start()
+
+    def run(self):
+        self.n += 1        # runs on the spawned thread AND any caller
+"""
+
+GOOD_CLOSURE_TAKES_OWN_LOCK = """
+import threading
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = None
+        self.done = False
+
+    def kick(self):
+        def cb():
+            with self._lock:
+                self.done = True   # guarded at CALL time by its own
+        self._pool.submit(cb)      # acquisition — not a finding
+"""
+
+GOOD_DONE_CALLBACK_SAME_LOOP = """
+import asyncio
+
+class Stepper:
+    def __init__(self):
+        self.state = 0
+
+    async def step(self, fut):
+        self.state = 1             # event-loop thread ...
+        fut.add_done_callback(self._on_done)
+
+    def _on_done(self, fut):
+        self.state = 2             # ... same event-loop thread
+"""
+
+BAD_CALL_SOON_BLOCKING = """
+import time
+
+class Poller:
+    def arm(self, loop):
+        loop.call_soon(self._tick)
+
+    def _tick(self):
+        time.sleep(0.1)            # runs ON the loop: blocks it
+"""
+
+
+def test_public_thread_target_single_method_race_fires():
+    # the method carries BOTH a spawn root and its external root: one
+    # writing method, two provable threads → a finding, no second
+    # method required
+    found = findings_of(BAD_PUBLIC_THREAD_TARGET, SERVER_PATH)
+    assert [f.rule for f in found] == ["concurrency"]
+    assert "spawn:run" in found[0].message
+    assert "ext:run" in found[0].message
+
+
+def test_closure_acquiring_its_own_lock_is_clean():
+    assert rules_of(GOOD_CLOSURE_TAKES_OWN_LOCK, SERVER_PATH) == []
+
+
+def test_done_callback_shares_the_loop_thread():
+    # add_done_callback targets run ON the loop — same context as the
+    # async writer, not a second thread root
+    assert rules_of(GOOD_DONE_CALLBACK_SAME_LOOP, SERVER_PATH) == []
+
+
+def test_call_soon_target_is_loop_context_for_blocking():
+    found = findings_of(BAD_CALL_SOON_BLOCKING)
+    assert [f.rule for f in found] == ["async-blocking"]
+    assert "time.sleep" in found[0].message
+
+
+def test_write_baseline_reports_reduced_vs_pruned(tmp_path):
+    # two identical findings → baseline count 2; fixing ONE must report
+    # a REDUCED entry (still grandfathered), never a pruned one
+    bad = tmp_path / "mod.py"
+    two = ("import numpy as np\n\n"
+           "def f(a, b):\n"
+           "    return (a * b).astype(np.int32)\n\n"
+           "def g(a, b):\n"
+           "    return (a * b).astype(np.int32)\n")
+    bad.write_text(two)
+    baseline = tmp_path / "baseline.json"
+    proc = _run_cli([str(bad), "--write-baseline",
+                     "--baseline", str(baseline)], str(tmp_path))
+    assert proc.returncode == 0
+    bad.write_text(two.replace(
+        "def g(a, b):\n    return (a * b).astype(np.int32)\n",
+        "def g(a, b):\n    return a\n"))
+    proc = _run_cli([str(bad), "--write-baseline",
+                     "--baseline", str(baseline)], str(tmp_path))
+    assert proc.returncode == 0
+    assert "reduced baseline entry 2 → 1" in proc.stdout
+    assert "pruned" not in proc.stdout
+    assert sum(json.loads(
+        baseline.read_text())["findings"].values()) == 1
+
+
+BAD_INIT_CLOSURE_THREAD = """
+import threading
+
+class C:
+    def __init__(self):
+        self.state = 0
+        def run():
+            while True:
+                self.state += 1    # spawned from __init__: runs later
+        threading.Thread(target=run).start()
+
+    def advance(self):
+        self.state = 2             # races the closure thread
+"""
+
+GOOD_SAME_NAME_DIFFERENT_CLASSES = """
+import time
+
+class A:
+    def _send(self):
+        time.sleep(1)              # thread-only helper of class A
+
+    def pump(self):
+        self._send()               # sync caller: NOT loop-only
+
+class B:
+    async def go(self):
+        return self._send()
+
+    def _send(self):
+        return 1                   # B's loop-only _send doesn't block
+"""
+
+GOOD_SET_NAME_IS_CONSTRUCTION = """
+import threading
+
+class Descriptor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.name = None
+
+    def __set_name__(self, owner, name):
+        self.name = name           # class-definition time, pre-sharing
+"""
+
+
+BAD_INIT_HELPER_CLOSURE = """
+import threading
+
+class C:
+    def __init__(self):
+        self.state = 0
+        self._start()
+
+    def _start(self):                  # reachable from __init__ only
+        def run():
+            while True:
+                self.state += 1        # ... but the closure escapes it
+        threading.Thread(target=run).start()
+
+    def advance(self):
+        self.state = 2
+"""
+
+GOOD_LOOP_ONLY_CREATE_TASK = """
+import asyncio
+
+def _kick(coro):
+    return asyncio.ensure_future(coro)   # called only from async code
+
+async def main(coro):
+    return _kick(coro)
+"""
+
+GOOD_INLINE_CLOSURE_UNDER_LOCK = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def update(self):
+        with self._lock:
+            def bump():
+                self.n += 1        # defined AND invoked under the lock
+            bump()
+"""
+
+
+GOOD_PUBLIC_SYNC_FROM_ASYNC = """
+import time
+
+class Flusher:
+    async def tick(self):
+        self.flush()
+
+    def flush(self):
+        time.sleep(1)       # public: callable from worker threads too
+"""
+
+GOOD_SORT_KEY_UNDER_LOCK = """
+import threading
+
+class Ranker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def rank(self, xs):
+        with self._lock:
+            def key(x):
+                self.hits += 1     # runs inline inside the with-block
+                return x
+            xs.sort(key=key)
+"""
+
+BAD_SORT_KEY_ESCAPES_LOCK = GOOD_SORT_KEY_UNDER_LOCK.replace(
+    "            xs.sort(key=key)", "        xs.sort(key=key)")
+
+
+GOOD_TEMP_RELEASE_NO_CRASH = """
+import threading
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            self._lock.release()   # temporary-release pattern
+            self._lock.acquire()
+"""
+
+BAD_DONE_SET_REBOUND = """
+import asyncio
+
+async def f(tasks, futs):
+    done, _ = await asyncio.wait(tasks)
+    done = futs                    # rebinding voids the proof
+    for t in done:
+        return t.result()
+"""
+
+
+def test_temporary_release_does_not_crash_the_analyzer():
+    res = analyze_source(GOOD_TEMP_RELEASE_NO_CRASH, SERVER_PATH)
+    assert res.errors == []        # must return a result, not raise
+
+
+def test_done_set_rebinding_voids_the_result_proof():
+    found = findings_of(BAD_DONE_SET_REBOUND)
+    assert [f.rule for f in found] == ["async-blocking"]
+
+
+def test_public_sync_method_is_not_loop_only():
+    # async call sites prove nothing about a PUBLIC method — it is an
+    # external root, callable from worker threads where blocking is fine
+    assert rules_of(GOOD_PUBLIC_SYNC_FROM_ASYNC) == []
+
+
+def test_sort_key_closure_inherits_escape_site_lock():
+    assert rules_of(GOOD_SORT_KEY_UNDER_LOCK, SERVER_PATH) == []
+
+
+def test_sort_key_closure_escaping_without_lock_fires():
+    found = findings_of(BAD_SORT_KEY_ESCAPES_LOCK, SERVER_PATH)
+    assert any("Ranker.rank.<key>" in f.message for f in found), \
+        [f.render() for f in found]
+
+
+def test_init_helper_spawned_closure_race_fires():
+    found = findings_of(BAD_INIT_HELPER_CLOSURE, SERVER_PATH)
+    assert {f.rule for f in found} == {"concurrency"}
+    msgs = " ".join(f.message for f in found)
+    assert "_start.<run>" in msgs and "C.advance" in msgs
+
+
+def test_loop_only_helper_may_create_tasks():
+    assert rules_of(GOOD_LOOP_ONLY_CREATE_TASK) == []
+
+
+def test_inline_closure_under_lock_is_clean():
+    assert rules_of(GOOD_INLINE_CLOSURE_UNDER_LOCK, SERVER_PATH) == []
+
+
+def test_init_spawned_closure_race_fires():
+    found = findings_of(BAD_INIT_CLOSURE_THREAD, SERVER_PATH)
+    assert {f.rule for f in found} == {"concurrency"}
+    msgs = " ".join(f.message for f in found)
+    assert "__init__.<run>" in msgs and "C.advance" in msgs
+
+
+def test_same_named_methods_do_not_alias_across_classes():
+    assert rules_of(GOOD_SAME_NAME_DIFFERENT_CLASSES) == []
+
+
+def test_set_name_counts_as_construction():
+    assert rules_of(GOOD_SET_NAME_IS_CONSTRUCTION, SERVER_PATH) == []
+
+
+def test_closure_write_in_lock_class_fires():
+    # v1 parity: a self-write inside a closure handed to a pool is
+    # unguarded at CALL time regardless of locks held at def time
+    found = findings_of(BAD_CLOSURE_WRITE, SERVER_PATH)
+    assert "concurrency" in {f.rule for f in found}
+    assert any("self.done" in f.message for f in found)
+
+
+def test_result_exemption_is_flow_scoped():
+    found = findings_of(BAD_RESULT_NAME_REUSE)
+    assert [f.rule for f in found] == ["async-blocking"]
+    assert found[0].line == 6       # the pre-wait call, not the loop's
+
+
+def test_init_only_helper_is_not_a_thread_path():
+    assert rules_of(GOOD_INIT_HELPER, SERVER_PATH) == []
+
+
+def test_loop_callback_may_create_tasks():
+    assert rules_of(GOOD_LOOP_CALLBACK_CREATE_TASK) == []
+
+
+def test_rule_filter_on_deep_rule_implies_deep_tier(tmp_path):
+    # without the implication this reported a false green: the deep
+    # rule was accepted by validation but never executed
+    proc = _run_cli(["--rule", "wire-schema"], REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tpulint[deep]" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# audited broker pattern: the exact `_dispatch_hedged` shapes
+# ---------------------------------------------------------------------------
+
+
+def test_broker_hedge_result_pattern_is_verified_clean():
+    """The audited `primary.result()` sites (broker/request_handler
+    _dispatch_hedged) were rewritten into the done-set iteration form —
+    the committed file must analyze clean under async-blocking."""
+    path = os.path.join(REPO_ROOT, "pinot_tpu/broker/request_handler.py")
+    with open(path) as fh:
+        src = fh.read()
+    res = analyze_source(src, "pinot_tpu/broker/request_handler.py")
+    assert [f for f in res.findings if f.rule == "async-blocking"] == []
+    # and not via suppression: the invariant is analyzer-verified
+    assert [f for f in res.suppressed
+            if f.rule == "async-blocking"] == []
+
+
+# ---------------------------------------------------------------------------
+# kernel contracts (jaxpr tier)
+# ---------------------------------------------------------------------------
+
+
+def test_registered_kernel_surface_passes_contracts():
+    from pinot_tpu.analysis import contracts
+    violations = contracts.check_kernel_contracts()
+    assert violations == [], violations
+
+
+def test_contract_grid_covers_every_kernel_family():
+    from pinot_tpu.ops import kernels
+    names = {c[0] for c in kernels.contract_cases()}
+    for family in ("filter_pred_mix", "agg_part_sums", "group_dense",
+                   "group_compacted", "group_ranked", "select_limit",
+                   "select_order", "select_ordertk", "select_ordermk"):
+        assert family in names, f"{family} missing from contract grid"
+    assert len(kernels.CONTRACT_SHAPE_BUCKETS) >= 2
+
+
+def test_callback_detector_catches_pure_callback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from pinot_tpu.analysis import contracts
+
+    def bad(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    closed = jax.make_jaxpr(bad)(jnp.zeros(4))
+    assert "pure_callback" in contracts.find_callbacks(closed)
+
+
+def test_retrace_identity_of_cached_builder():
+    from pinot_tpu.ops import kernels
+    spec = (("match_all",), (("count", "*", "sv", None),), None, None)
+    k1 = kernels.build_segment_kernel(8192, *spec)
+    k2 = kernels.build_segment_kernel(8192, *spec)
+    assert k1 is k2
+
+
+def test_wide_i64_asserts_without_x64():
+    import jax
+    from pinot_tpu import compat
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled: the assertion path is unreachable")
+    with pytest.raises(AssertionError, match="x64"):
+        compat.wide_i64(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# wire schema
+# ---------------------------------------------------------------------------
+
+
+def test_committed_wire_schema_round_trips():
+    from pinot_tpu.analysis import contracts
+    path = os.path.join(REPO_ROOT, contracts.WIRE_SCHEMA_FILE)
+    assert os.path.exists(path), "wire-schema.json not committed"
+    diffs = contracts.check_wire_schema(path)
+    assert diffs == [], diffs
+
+
+def test_wire_schema_detects_removed_optional_key(tmp_path):
+    """Removing an optional serde key (the version-skew break class)
+    must fail the gate with a field-level diff naming the key."""
+    from pinot_tpu.analysis import contracts
+    schema = contracts.wire_schema()
+    schema["instanceRequest"]["optional"] = [
+        k for k in schema["instanceRequest"]["optional"]
+        if k != "deadlineBudgetMs"]
+    del schema["instanceRequest"]["shape"]["deadlineBudgetMs"]
+    stale = tmp_path / "wire-schema.json"
+    stale.write_text(json.dumps(schema))
+    diffs = contracts.check_wire_schema(str(stale))
+    assert any("deadlineBudgetMs" in d for d in diffs), diffs
+
+
+def test_wire_schema_detects_retyped_tag(tmp_path):
+    from pinot_tpu.analysis import contracts
+    schema = contracts.wire_schema()
+    schema["objectSerde"]["int64"] = "J"        # retyped tag byte
+    stale = tmp_path / "wire-schema.json"
+    stale.write_text(json.dumps(schema))
+    diffs = contracts.check_wire_schema(str(stale))
+    assert any("objectSerde.int64" in d for d in diffs), diffs
+
+
+def test_wire_schema_missing_snapshot_is_a_finding(tmp_path):
+    from pinot_tpu.analysis import contracts
+    diffs = contracts.check_wire_schema(str(tmp_path / "nope.json"))
+    assert diffs and "missing" in diffs[0]
+
+
+# ---------------------------------------------------------------------------
+# suppression parsing edge cases (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_multiple_rules_one_comment():
+    per_line, per_file = parse_suppressions(
+        "x = 1  # tpulint: disable=host-sync, retrace -- reason\n")
+    assert per_line == {1: {"host-sync", "retrace"}}
+    assert per_file == set()
+
+
+def test_suppression_disable_all():
+    per_line, _ = parse_suppressions(
+        "x = 1  # tpulint: disable=all -- fixture\n")
+    assert per_line == {1: {"all"}}
+
+
+def test_suppression_file_level_anywhere():
+    src = "x = 1\n# tpulint: disable-file=lock-blocking -- module docs\n"
+    _, per_file = parse_suppressions(src)
+    assert per_file == {"lock-blocking"}
+
+
+def test_suppression_whitespace_variants():
+    for form in ("#tpulint: disable=host-sync",
+                 "#  tpulint:  disable=host-sync",
+                 "# tpulint: disable=host-sync,dtype-drift"):
+        per_line, _ = parse_suppressions(f"x = 1  {form}\n")
+        assert "host-sync" in per_line[1], form
+
+
+def test_suppression_malformed_is_ignored():
+    for form in ("# tpulint: disable",          # no rules
+                 "# tpulint disable=host-sync",  # missing colon
+                 "# lint: disable=host-sync"):
+        per_line, per_file = parse_suppressions(f"x = 1  {form}\n")
+        assert per_line == {} and per_file == set(), form
+
+
+def test_suppression_wrong_line_does_not_apply():
+    src = ("# tpulint: disable=dtype-drift -- wrong line\n"
+           "import numpy as np\n"
+           "def f(a, b):\n"
+           "    return (a * b).astype(np.int32)\n")
+    res = analyze_source(src, PLAIN_PATH)
+    assert [f.rule for f in res.findings] == ["dtype-drift"]
+    assert res.suppressed == []
+
+
+def test_suppression_counts_as_suppressed_not_dropped():
+    src = ("import numpy as np\n"
+           "def f(a, b):\n"
+           "    return (a * b).astype(np.int32)"
+           "  # tpulint: disable=dtype-drift -- bounded upstream\n")
+    res = analyze_source(src, PLAIN_PATH)
+    assert res.findings == []
+    assert [f.rule for f in res.suppressed] == ["dtype-drift"]
+
+
+# ---------------------------------------------------------------------------
+# baseline determinism + stale pruning (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "pinot_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO_ROOT})
+
+
+def test_write_baseline_twice_is_byte_identical(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text("import numpy as np\n\n"
+                   "def f(a, b):\n"
+                   "    return (a * b).astype(np.int32)\n")
+    b1 = tmp_path / "b1.json"
+    b2 = tmp_path / "b2.json"
+    for out in (b1, b2):
+        proc = _run_cli([str(bad), "--write-baseline",
+                         "--baseline", str(out)], str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert b1.read_bytes() == b2.read_bytes()
+    # and rewriting over an existing baseline is also byte-stable
+    proc = _run_cli([str(bad), "--write-baseline",
+                     "--baseline", str(b1)], str(tmp_path))
+    assert proc.returncode == 0
+    assert b1.read_bytes() == b2.read_bytes()
+
+
+def test_stale_baseline_entries_reported_and_pruned(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text("import numpy as np\n\n"
+                   "def f(a, b):\n"
+                   "    return (a * b).astype(np.int32)\n")
+    baseline = tmp_path / "baseline.json"
+    proc = _run_cli([str(bad), "--write-baseline",
+                     "--baseline", str(baseline)], str(tmp_path))
+    assert proc.returncode == 0
+    assert json.loads(baseline.read_text())["findings"]
+
+    # fix the code: the grandfathered entry is now STALE
+    bad.write_text("import numpy as np\n\n"
+                   "def f(a, b):\n"
+                   "    wide = (a.astype(np.int64) * b)\n"
+                   "    return wide\n")
+    # CI mode reports it and fails (grandfather list must shrink)
+    proc = _run_cli([str(bad), "--strict-baseline",
+                     "--baseline", str(baseline)], str(tmp_path))
+    assert proc.returncode == 1
+    assert "stale baseline entry" in proc.stdout
+    # regenerating prunes it, says so, and leaves an empty baseline
+    proc = _run_cli([str(bad), "--write-baseline",
+                     "--baseline", str(baseline)], str(tmp_path))
+    assert proc.returncode == 0
+    assert "pruned stale baseline entry" in proc.stdout
+    assert json.loads(baseline.read_text())["findings"] == {}
+
+
+def test_failure_summary_groups_by_rule(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(BAD_LOOP_BLOCKING_SLEEP + BAD_NARROWING_DTYPE)
+    proc = _run_cli([str(bad), "--no-baseline"], str(tmp_path))
+    assert proc.returncode == 1
+    assert "new findings by rule" in proc.stderr
+    assert "async-blocking" in proc.stderr
+    assert "dtype-drift" in proc.stderr
+    assert "fix →" in proc.stderr
+
+
+@pytest.mark.slow
+def test_deep_cli_green_on_repo():
+    proc = _run_cli(["pinot_tpu/", "--deep", "--strict-baseline"],
+                    REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tpulint[deep]" in proc.stdout

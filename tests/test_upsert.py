@@ -455,7 +455,8 @@ def test_lost_snapshot_forces_fold_despite_sidecars(work_dir):
     p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
     p.seal(0, 3, 3)                           # snapshot + sidecar land
     p.close()
-    snap = [f for f in os.listdir(work_dir) if f.startswith("keymap-")][0]
+    snap = [f for f in os.listdir(work_dir)
+            if f.startswith("keymap-") and f.endswith(".json")][0]
     with open(os.path.join(work_dir, snap), "w") as fh:
         fh.write("{ corrupt")
     r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
@@ -665,6 +666,14 @@ def test_restart_does_not_rewind_before_snapshot_offset(work_dir):
     cluster = EmbeddedCluster(work_dir, num_servers=1,
                               store_dir=os.path.join(work_dir, "store"))
     rows = make_rows(600, seed=13)
+    part_dir = os.path.join(work_dir, "server_work", "Server_0",
+                            "upsert", RT_TABLE, "partition_0")
+
+    def _snaps():
+        # staged .tmp files are a seal caught mid-rename — not durable
+        return [f for f in os.listdir(part_dir)
+                if f.startswith("keymap-") and f.endswith(".json")]
+
     try:
         cluster.add_schema(make_schema())
         cluster.add_table(upsert_rt_config(f"mem_{topic}", topic,
@@ -678,14 +687,17 @@ def test_restart_does_not_rewind_before_snapshot_offset(work_dir):
         assert wait_until(
             lambda: count_and_sum(cluster)[0] == len(latest_by_key(rows)),
             timeout=30)
+        # the seal finishes its key-map snapshot asynchronously after
+        # the segment commits — wait for it to land before stopping,
+        # or the shutdown races the staged-rename (a crash-equivalent
+        # state the RECOVERY tests cover; this test needs the snapshot)
+        assert wait_until(lambda: bool(_snaps()), timeout=30), \
+            "seal must have written a key-map snapshot"
     finally:
         cluster.stop()
 
     # durable snapshot offset == the committed boundary
-    part_dir = os.path.join(work_dir, "server_work", "Server_0",
-                            "upsert", RT_TABLE, "partition_0")
-    snaps = [f for f in os.listdir(part_dir) if f.startswith("keymap-")]
-    assert snaps, "seal must have written a key-map snapshot"
+    snaps = _snaps()
     snap = json.load(open(os.path.join(
         part_dir, max(snaps, key=lambda n: int(n[7:-5])))))
     mgr_offsets = []
