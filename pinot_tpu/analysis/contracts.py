@@ -235,7 +235,7 @@ def wire_schema() -> dict:
         InstanceRequest(request_id=1, query=req, search_segments=["s"],
                         enable_trace=True, broker_id="b",
                         deadline_budget_ms=10.0, trace_id="t",
-                        parent_span_id="p")))
+                        parent_span_id="p", workload="w", hedge=True)))
     resp = BrokerResponse(
         aggregation_results=[
             AggregationResult(function="sum(m)", value=1.0),
@@ -276,7 +276,8 @@ def wire_schema() -> dict:
                 dtmod._COL_I64, dtmod._COL_F64, dtmod._COL_STR,
                 dtmod._COL_OBJ)),
             "structuredMetadataKeys": sorted([
-                dtmod.MISSING_SEGMENTS_KEY]),
+                dtmod.MISSING_SEGMENTS_KEY, dtmod.SERVER_BUSY_KEY,
+                dtmod.RETRY_AFTER_MS_KEY, dtmod.RESULT_CACHE_HIT_KEY]),
         },
         "objectSerde": object_tags,
     }

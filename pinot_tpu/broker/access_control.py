@@ -29,6 +29,19 @@ class AccessControl:
                    request: BrokerRequest) -> bool:
         raise NotImplementedError
 
+    def allow_workload(self, identity: Optional[RequesterIdentity],
+                       workload: str) -> bool:
+        """Whether `identity` may tag its queries OPTION(workload=...).
+
+        The tag drives per-tenant quota debit, scheduler grouping and
+        admission fair-share, so an unchecked tag lets one principal
+        spend another tenant's quota (or inflate its fair-share count).
+        Default: allowed — tags are cooperative scheduling hints, as in
+        the reference's workloadName option. Deployments that hand
+        per-tenant quotas to mutually-untrusting clients should
+        override this to bind tags to authenticated principals."""
+        return True
+
 
 class AllowAllAccessControl(AccessControl):
     """The reference's default: everything is allowed."""

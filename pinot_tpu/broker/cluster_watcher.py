@@ -9,10 +9,12 @@ from __future__ import annotations
 
 from typing import Optional
 
+from pinot_tpu.broker.quota import QueryQuotaManager
 from pinot_tpu.broker.routing import RoutingManager
 from pinot_tpu.broker.time_boundary import TimeBoundaryService
 from pinot_tpu.common.cluster_state import ONLINE, TableView
-from pinot_tpu.common.table_name import raw_table, table_type
+from pinot_tpu.common.table_name import (offline_table, raw_table,
+                                         realtime_table, table_type)
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.state_machine import ClusterCoordinator
 
@@ -21,25 +23,142 @@ class BrokerClusterWatcher:
     def __init__(self, coordinator: ClusterCoordinator,
                  manager: ResourceManager,
                  routing: Optional[RoutingManager] = None,
-                 time_boundary: Optional[TimeBoundaryService] = None):
+                 time_boundary: Optional[TimeBoundaryService] = None,
+                 quota: Optional[QueryQuotaManager] = None,
+                 num_brokers_fn=None):
         self.coordinator = coordinator
         self.manager = manager
         self.routing = routing or RoutingManager()
         self.time_boundary = time_boundary or TimeBoundaryService()
+        # per-table/per-tenant QPS quotas converge here: every external-
+        # view change re-reads the table config and re-divides the
+        # cluster-wide rate by the live broker count (parity:
+        # HelixExternalViewBasedQueryQuotaManager's processQueryQuota-
+        # ChangeInternal on EV / instance-config change)
+        self.quota = quota
+        self._num_brokers_fn = num_brokers_fn or (lambda: 1)
+        # broker result caches registered for segment-lifecycle
+        # invalidation (register_result_cache): the freshness bound
+        # covers consuming-ingestion staleness only — an OFFLINE
+        # backfill/replacement rewrites rows that were wrong at every
+        # point in time, and a drop-and-recreate changes the table's
+        # identity, so any external-view change flushes the cache.
+        # View changes are segment-lifecycle-rate (commits, uploads,
+        # rebalances), so a full clear costs hit rate, never much CPU.
+        self._result_caches: list = []
         self.partition_pruner = PartitionZKMetadataPruner(manager)
         coordinator.watch_external_views(self._on_view)
         for table in coordinator.tables():
             self._on_view(coordinator.external_view(table))
 
+    def register_result_cache(self, cache) -> None:
+        """Clear `cache` on every external-view change (any object
+        with a ``clear()``)."""
+        self._result_caches.append(cache)
+
     def _on_view(self, view: TableView) -> None:
         self.partition_pruner.invalidate(view.table_name)
         if not view.segment_states:
             self.routing.remove_table(view.table_name)
+            # caches flush AFTER the routing change lands (see below)
+            for cache in self._result_caches:
+                cache.clear()
+            # re-converge quotas too: if the OTHER type still exists
+            # its config wins; if the table is fully gone its buckets
+            # (and offered-load counter) are cleared
+            self._apply_quota_config(view.table_name)
             return
         self._apply_routing_config(view.table_name)
+        # routing FIRST: every store read below (table configs, broker
+        # count) delays this thread, and until update_view lands the
+        # broker routes on the PREVIOUS view — under reload/rebalance
+        # bounces a widened window turns into real misroutes on
+        # just-unloaded replicas. Quota convergence tolerates the lag.
         self.routing.update_view(view)
         if table_type(view.table_name) == "OFFLINE":
             self._update_time_boundary(view)
+        # cache flush strictly AFTER the view change has fully landed
+        # (update_view AND the time boundary — both steer what a hybrid
+        # query executes against): the clear bumps the put-guard
+        # generation, and a query racing this handler must not capture
+        # the FRESH generation while still routing on the PRE-change
+        # view or boundary — its pre-backfill result would be accepted
+        # by put() and served for the whole freshness bound. Cleared
+        # after, any in-window query holds the stale generation and
+        # its put is dropped.
+        for cache in self._result_caches:
+            cache.clear()
+        self._apply_quota_config(view.table_name)
+
+    def reapply_quotas(self) -> None:
+        """Re-divide every table's cluster-wide quota by the CURRENT
+        live broker count. Broker membership changes (join/leave/death)
+        change each broker's share but fire no external-view event —
+        without this hook a joining broker would enforce its smaller
+        share while incumbents keep the old one until unrelated segment
+        churn, over-admitting cluster-wide (and survivors of a broker
+        death would under-admit symmetrically)."""
+        if self.quota is None:
+            return
+        # dedupe to RAW names: _apply_quota_config reads BOTH typed
+        # configs per call, so iterating t_OFFLINE and t_REALTIME of a
+        # hybrid table would double the store reads on the watch-
+        # dispatch thread (which must stay fast — routing rides on it)
+        for raw in {raw_table(t) for t in self.coordinator.tables()}:
+            self._apply_quota_config(raw)
+
+    def _apply_quota_config(self, table: str) -> None:
+        """quotaConfig.maxQueriesPerSecond → this broker's token-bucket
+        share; per-tenant rates ride in customConfigs["tenantQuotas"]
+        as a JSON object {tenant: qps}.
+
+        The broker enforces at the RAW table name (one admission per
+        logical query), so a hybrid table's effective quota is merged
+        across BOTH typed configs — each type's allowance sums, and a
+        view change on the type WITHOUT a quotaConfig must not clobber
+        the other type's limits."""
+        if self.quota is None:
+            return
+        raw = raw_table(table)
+        quotas = []
+        tenant_qps: dict = {}
+        found = False
+        for typed in (offline_table(raw), realtime_table(raw)):
+            config = self.manager.get_table_config(typed)
+            if config is None:
+                continue
+            found = True
+            if config.quota_config is not None and \
+                    config.quota_config.max_queries_per_second is not None:
+                quotas.append(config.quota_config.max_queries_per_second)
+            for tenant, qps in self._tenant_quotas(config).items():
+                tenant_qps[tenant] = tenant_qps.get(tenant, 0.0) + qps
+        if not found:
+            # no typed config survives: the table is gone — clear any
+            # buckets so a re-created table doesn't inherit old limits
+            self.quota.configure_table(raw, None, {})
+            return
+        max_qps = sum(quotas) if quotas else None
+        try:
+            num_brokers = max(1, int(self._num_brokers_fn()))
+        except Exception:  # noqa: BLE001 — a broken counter never
+            num_brokers = 1   # disables quota convergence entirely
+        self.quota.configure_table(raw, max_qps, tenant_qps,
+                                   num_brokers=num_brokers)
+
+    @staticmethod
+    def _tenant_quotas(config) -> dict:
+        raw_tenants = (config.custom_config or {}).get("tenantQuotas")
+        if not raw_tenants:
+            return {}
+        import json
+        try:
+            parsed = json.loads(raw_tenants)
+            if isinstance(parsed, dict):
+                return {str(k): float(v) for k, v in parsed.items()}
+        except (ValueError, TypeError):
+            pass          # malformed tenant quotas: fail open (no limit)
+        return {}
 
     def _apply_routing_config(self, table: str) -> None:
         """Honor the table's routingTableBuilderName (parity:

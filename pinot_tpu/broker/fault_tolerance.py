@@ -224,6 +224,16 @@ class FaultToleranceManager:
         with self._lock:
             e.health = (1 - self.HEALTH_ALPHA) * e.health
 
+    def on_busy(self, server: str) -> None:
+        """The server shed the request (admission control). A health
+        ding steers replica ranking away from it while it drains, but
+        NEVER a breaker transition — a busy server is alive and honest,
+        and opening the breaker would amplify the overload's blast
+        radius to queries that would have been admitted."""
+        e = self._entry(server)
+        with self._lock:
+            e.health = (1 - self.HEALTH_ALPHA / 2) * e.health
+
     def on_hedge(self, server: str) -> None:
         """The server was slow enough to trigger a hedge: a soft health
         penalty (half a failure), never a breaker transition."""

@@ -9,6 +9,7 @@ access-control SPI sees.
 from __future__ import annotations
 
 import asyncio
+import math
 
 from pinot_tpu.broker.access_control import RequesterIdentity
 from pinot_tpu.broker.request_handler import BrokerRequestHandler
@@ -17,6 +18,13 @@ from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table, table_type)
 from pinot_tpu.transport.http import (ApiServer, HttpRequest, HttpResponse,
                                       metrics_response)
+
+
+def _retrying_response(resp, status: int, retry_s: float) -> HttpResponse:
+    """429/503 share one Retry-After surface: whole seconds, floor 1."""
+    return HttpResponse.of_json(
+        resp.to_json(), status=status,
+        headers={"Retry-After": str(max(1, math.ceil(retry_s)))})
 
 
 class BrokerApiServer(ApiServer):
@@ -41,6 +49,10 @@ class BrokerApiServer(ApiServer):
         self.router.add("GET", "/debug/tableStats/{table}",
                         self._table_stats)
         self.router.add("GET", "/debug/slowLog", self._slow_log)
+        # ingress-control operator views: per-table/tenant token-bucket
+        # state and the broker result cache
+        self.router.add("GET", "/debug/quotas", self._quotas)
+        self.router.add("GET", "/debug/resultCache", self._result_cache)
 
     @staticmethod
     def _identity(request: HttpRequest) -> RequesterIdentity:
@@ -57,6 +69,22 @@ class BrokerApiServer(ApiServer):
         loop = asyncio.get_running_loop()
         resp = await loop.run_in_executor(
             None, lambda: self.handler.handle(pql, identity, force_trace))
+        # quota rejections surface as real 429s with Retry-After derived
+        # from the token bucket's refill time, so well-behaved clients
+        # back off instead of hammering the retry loop
+        if resp.exceptions and \
+                resp.exceptions[0].get("errorCode") == 429:
+            retry_s = getattr(resp, "retry_after_s", None) or \
+                resp.exceptions[0].get("retryAfterSeconds") or 1.0
+            return _retrying_response(resp, 429, retry_s)
+        # a query FULLY lost to server-busy shedding (retry_after_s is
+        # only set on that path in _finish) mirrors the 429 story as a
+        # real HTTP 503 + Retry-After — clients keying backoff on the
+        # status code must see overload, not a 200 that invites an
+        # instant retry. Partial responses that recovered data stay 200.
+        if getattr(resp, "retry_after_s", None) and \
+                any(e.get("errorCode") == 503 for e in resp.exceptions):
+            return _retrying_response(resp, 503, resp.retry_after_s)
         return HttpResponse.of_json(resp.to_json())
 
     async def _get_query(self, request: HttpRequest) -> HttpResponse:
@@ -102,6 +130,19 @@ class BrokerApiServer(ApiServer):
                    for t in stats.table_names()
                    if self._check_debug_access(request, t) is None}
         return HttpResponse.of_json(allowed)
+
+    async def _quotas(self, request: HttpRequest) -> HttpResponse:
+        # per-table debug view: honor the same ACL as /debug/tableStats
+        # (quota rates, token counts and tenant keys are table metadata)
+        stats = self.handler.quota.stats()
+        allowed = {t: s for t, s in stats.items()
+                   if self._check_debug_access(request, t) is None}
+        return HttpResponse.of_json(allowed)
+
+    async def _result_cache(self, request: HttpRequest) -> HttpResponse:
+        # aggregate counters only (entries/bytes/hits/misses) — no
+        # table names or tenant keys, so no per-table ACL dimension
+        return HttpResponse.of_json(self.handler.result_cache.stats())
 
     async def _slow_log(self, request: HttpRequest) -> HttpResponse:
         sl = self.handler.slow_log

@@ -1,27 +1,49 @@
-"""Per-table QPS quota with a sliding hit counter.
+"""Per-tenant/per-table QPS quota: token buckets with burst allowance.
 
 Parity: pinot-broker/.../queryquota/HelixExternalViewBasedQueryQuotaManager
-+ HitCounter — per-table max QPS enforced over a rolling window, hits
-bucketed per 100ms.
+— per-table max QPS sourced from the table config
+(``quotaConfig.maxQueriesPerSecond``) and divided by the number of live
+brokers so the cluster-wide quota converges as brokers join and leave.
+
+The old sliding HitCounter window had two ingress-control bugs the
+token bucket removes structurally:
+
+- **check-after-hit**: every request (including a rejected one) counted
+  against the window, so a throttled tenant kept re-filling its own
+  window and never recovered; a bucket only debits ADMITTED requests.
+- **exact-at-limit flap**: traffic at precisely the quota alternated
+  allow/deny on bucket-boundary rounding; a bucket at rate r admits a
+  sustained r QPS exactly, with `burst` extra requests of headroom for
+  dashboard-style synchronized refresh bursts.
+
+Rejections carry the bucket's refill time so the broker can answer
+429 with an honest ``Retry-After``.
+
+The ``HitCounter`` survives as the *observed offered load* meter (it
+counts attempts, not admissions — exactly what an operator sizing a
+quota wants to see) and now takes the injectable ``now_ms`` everywhere
+so quota tests never sleep on the wall clock.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 BUCKETS = 10
 BUCKET_MS = 100
 
 
 class HitCounter:
+    """Sliding-window attempt counter (100ms buckets over 1s)."""
+
     def __init__(self):
         self._times = [0] * BUCKETS
         self._counts = [0] * BUCKETS
         self._lock = threading.Lock()
 
     def hit(self, now_ms: Optional[int] = None) -> None:
-        now_ms = int(time.time() * 1e3) if now_ms is None else now_ms
+        now_ms = int(time.time() * 1e3) if now_ms is None else int(now_ms)
         idx = (now_ms // BUCKET_MS) % BUCKETS
         with self._lock:
             stamp = now_ms // BUCKET_MS
@@ -31,35 +53,244 @@ class HitCounter:
             self._counts[idx] += 1
 
     def hits_in_window(self, now_ms: Optional[int] = None) -> int:
-        now_ms = int(time.time() * 1e3) if now_ms is None else now_ms
+        now_ms = int(time.time() * 1e3) if now_ms is None else int(now_ms)
         lo = now_ms // BUCKET_MS - BUCKETS + 1
         with self._lock:
             return sum(c for t, c in zip(self._times, self._counts)
                        if t >= lo)
 
 
+class TokenBucket:
+    """rate tokens/s, capacity `burst`; only admitted requests debit.
+
+    NOT internally locked — the owning QueryQuotaManager serializes all
+    bucket access under one lock so tenant+table admission is atomic
+    (a request rejected by the table bucket must not have debited the
+    tenant bucket first).
+    """
+
+    __slots__ = ("rate", "burst", "tokens", "last_s")
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 now_s: float = 0.0):
+        self.rate = float(rate)
+        # default burst: one second of traffic, never less than one
+        # request (a 0.5-qps quota must still admit a single query)
+        self.burst = float(burst) if burst is not None \
+            else max(1.0, self.rate)
+        self.tokens = self.burst          # start full: burst allowance
+        self.last_s = now_s
+
+    def _refill(self, now_s: float) -> None:
+        dt = now_s - self.last_s
+        if dt > 0:
+            self.tokens = min(self.burst, self.tokens + dt * self.rate)
+        self.last_s = now_s
+
+    def peek(self, now_s: float, n: float = 1.0) -> bool:
+        self._refill(now_s)
+        return self.tokens >= n
+
+    def commit(self, n: float = 1.0) -> None:
+        self.tokens -= n
+
+    def retry_after_s(self, now_s: float, n: float = 1.0) -> float:
+        """Seconds until `n` tokens will have refilled."""
+        self._refill(now_s)
+        missing = n - self.tokens
+        if missing <= 0:
+            return 0.0
+        return missing / self.rate if self.rate > 0 else float("inf")
+
+    def reconfigure(self, rate: float, burst: Optional[float],
+                    now_s: Optional[float] = None) -> None:
+        """Adjust rate/burst, preserving accumulated tokens (a view
+        change must not hand every table a fresh burst allowance)."""
+        if now_s is not None:
+            # settle the elapsed interval at the OLD rate first —
+            # otherwise the next acquire's refill retroactively credits
+            # the whole idle gap at the new rate, which on a quota
+            # raise IS the fresh-burst grant this method must not give
+            self._refill(now_s)
+        new_burst = float(burst) if burst is not None \
+            else max(1.0, float(rate))
+        self.rate = float(rate)
+        self.tokens = min(self.tokens, new_burst)
+        self.burst = new_burst
+
+
+class QuotaDecision:
+    """acquire() result: truthy on admit; carries the rejection cause
+    ("tableQuota" | "tenantQuota") and the bucket refill time that
+    becomes the 429 Retry-After."""
+
+    __slots__ = ("allowed", "retry_after_s", "cause")
+
+    def __init__(self, allowed: bool, retry_after_s: float = 0.0,
+                 cause: Optional[str] = None):
+        self.allowed = allowed
+        self.retry_after_s = retry_after_s
+        self.cause = cause
+
+    def __bool__(self) -> bool:
+        return self.allowed
+
+    def __repr__(self) -> str:
+        return (f"QuotaDecision(allowed={self.allowed}, "
+                f"retry_after_s={self.retry_after_s:.3f}, "
+                f"cause={self.cause})")
+
+
+_ALLOW = QuotaDecision(True)
+
+# Retry-After ceiling: a zero-rate bucket (operator blocked the table)
+# refills never — retry_after_s would be inf, which breaks both the
+# JSON body (bare Infinity) and the HTTP header's math.ceil. One hour
+# says "much later" without lying about a refill instant.
+MAX_RETRY_AFTER_S = 3600.0
+
+
 class QueryQuotaManager:
-    def __init__(self):
-        self._quotas: Dict[str, float] = {}
-        self._counters: Dict[str, HitCounter] = {}
+    """Per-table + per-(table, tenant) token buckets, one broker's share.
+
+    `acquire(table, tenant)` checks the tenant bucket (when one is
+    configured) and the table bucket atomically: tokens are debited
+    from BOTH only when BOTH admit, so a rejection never consumes
+    headroom anywhere — a throttled tenant recovers the moment its
+    bucket refills.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._tables: Dict[str, TokenBucket] = {}
+        self._tenants: Dict[str, Dict[str, TokenBucket]] = {}
+        self._offered: Dict[str, HitCounter] = {}
         self._lock = threading.Lock()
 
-    def set_qps_quota(self, table: str, max_qps: Optional[float]) -> None:
+    # -- configuration ------------------------------------------------------
+    def set_qps_quota(self, table: str, max_qps: Optional[float],
+                      burst: Optional[float] = None) -> None:
         with self._lock:
             if max_qps is None:
-                self._quotas.pop(table, None)
-                self._counters.pop(table, None)
-            else:
-                self._quotas[table] = max_qps
-                self._counters.setdefault(table, HitCounter())
+                self._tables.pop(table, None)
+                return
+            existing = self._tables.get(table)
+            if existing is None:
+                self._tables[table] = TokenBucket(max_qps, burst,
+                                                  self._clock())
+            elif existing.rate != float(max_qps) or burst is not None:
+                existing.reconfigure(max_qps, burst, self._clock())
 
-    def acquire(self, table: str) -> bool:
-        """Record a hit; False when the table is over quota."""
+    def set_tenant_qps_quota(self, table: str, tenant: str,
+                             max_qps: Optional[float],
+                             burst: Optional[float] = None) -> None:
         with self._lock:
-            quota = self._quotas.get(table)
-            counter = self._counters.get(table)
-        if quota is None or counter is None:
-            return True
-        counter.hit()
-        window_s = BUCKETS * BUCKET_MS / 1e3
-        return counter.hits_in_window() <= quota * window_s
+            per_table = self._tenants.setdefault(table, {})
+            if max_qps is None:
+                per_table.pop(tenant, None)
+                if not per_table:
+                    self._tenants.pop(table, None)
+                return
+            existing = per_table.get(tenant)
+            if existing is None:
+                per_table[tenant] = TokenBucket(max_qps, burst,
+                                                self._clock())
+            elif existing.rate != float(max_qps) or burst is not None:
+                existing.reconfigure(max_qps, burst, self._clock())
+
+    def configure_table(self, table: str, max_qps: Optional[float],
+                        tenant_qps: Optional[Dict[str, float]] = None,
+                        num_brokers: int = 1) -> None:
+        """Converge this broker's share of the table's quota from the
+        table config: the cluster-wide rate is split evenly across live
+        brokers (parity: HelixExternalViewBasedQueryQuotaManager
+        dividing by the online broker count)."""
+        share = max(1, int(num_brokers))
+        self.set_qps_quota(
+            table, None if max_qps is None else max_qps / share)
+        wanted = dict(tenant_qps or {})
+        with self._lock:
+            stale = [t for t in self._tenants.get(table, {})
+                     if t not in wanted]
+        for tenant in stale:
+            self.set_tenant_qps_quota(table, tenant, None)
+        for tenant, qps in wanted.items():
+            self.set_tenant_qps_quota(table, tenant, float(qps) / share)
+        with self._lock:
+            if table not in self._tables and table not in self._tenants:
+                # fully unmanaged now (quota removed / table dropped):
+                # the offered-load counter goes too
+                self._offered.pop(table, None)
+
+    # -- admission ----------------------------------------------------------
+    def acquire(self, table: str, tenant: Optional[str] = None,
+                now_ms: Optional[float] = None) -> QuotaDecision:
+        """Admit-or-reject; truthy result = admitted. `now_ms` is the
+        injectable clock instant (tests drive time explicitly)."""
+        now_s = (now_ms / 1e3) if now_ms is not None else self._clock()
+        with self._lock:
+            tb = self._tables.get(table)
+            nb = self._tenants.get(table, {}).get(tenant) \
+                if tenant is not None else None
+            if tb is None and nb is None and \
+                    not self._tenants.get(table):
+                # unmanaged table: no offered-load counter either —
+                # acquire() runs before routing validates the name, so
+                # tracking every string offered would grow without
+                # bound under a random-table flood
+                return _ALLOW
+            self._offered.setdefault(table, HitCounter()).hit(
+                int(now_s * 1e3))
+            if tb is None and nb is None:
+                return _ALLOW
+            if nb is not None and not nb.peek(now_s):
+                return QuotaDecision(
+                    False, min(nb.retry_after_s(now_s),
+                               MAX_RETRY_AFTER_S), "tenantQuota")
+            if tb is not None and not tb.peek(now_s):
+                return QuotaDecision(
+                    False, min(tb.retry_after_s(now_s),
+                               MAX_RETRY_AFTER_S), "tableQuota")
+            # both admit: debit both (atomic under the manager lock)
+            if nb is not None:
+                nb.commit()
+            if tb is not None:
+                tb.commit()
+            return _ALLOW
+
+    # -- observability ------------------------------------------------------
+    def observed_qps(self, table: str,
+                     now_ms: Optional[float] = None) -> float:
+        """Offered load (attempts, admitted or not) over the last 1s.
+
+        The window is read on the SAME clock acquire() stamps hits
+        with (the manager's injectable clock, monotonic by default) —
+        never HitCounter's wall-clock fallback, whose epoch-scale
+        stamps would make every recorded hit look ancient."""
+        counter = self._offered.get(table)
+        if counter is None:
+            return 0.0
+        return counter.hits_in_window(
+            int(self._clock() * 1e3) if now_ms is None else int(now_ms))
+
+    def stats(self) -> Dict[str, dict]:
+        now_s = self._clock()
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for table, tb in self._tables.items():
+                tb._refill(now_s)
+                out[table] = {"maxQps": tb.rate, "burst": tb.burst,
+                              "availableTokens": round(tb.tokens, 3),
+                              "tenants": {}}
+            for table, per_table in self._tenants.items():
+                entry = out.setdefault(
+                    table, {"maxQps": None, "burst": None,
+                            "availableTokens": None, "tenants": {}})
+                for tenant, nb in per_table.items():
+                    nb._refill(now_s)
+                    entry["tenants"][tenant] = {
+                        "maxQps": nb.rate, "burst": nb.burst,
+                        "availableTokens": round(nb.tokens, 3)}
+        for table, entry in out.items():
+            entry["observedQps"] = self.observed_qps(table)
+        return out

@@ -19,7 +19,11 @@ from typing import Dict, List, Optional, Tuple
 
 from pinot_tpu.common.cluster_state import CONSUMING, ONLINE
 from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
-                                        SEGMENT_MISSING_EXC_PREFIX)
+                                        RESULT_CACHE_HIT_KEY,
+                                        RETRY_AFTER_MS_KEY,
+                                        SEGMENT_MISSING_EXC_PREFIX,
+                                        SERVER_BUSY_EXC_PREFIX,
+                                        SERVER_BUSY_KEY)
 from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
                                       MetricsRegistry)
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
@@ -33,6 +37,7 @@ from pinot_tpu.common.table_name import (offline_table, raw_table,
                                          realtime_table)
 from pinot_tpu.broker.fault_tolerance import FaultToleranceManager
 from pinot_tpu.broker.quota import QueryQuotaManager
+from pinot_tpu.broker.result_cache import BrokerResultCache
 from pinot_tpu.broker.routing import RoutingError, RoutingManager
 from pinot_tpu.broker.time_boundary import (TimeBoundaryService,
                                             attach_time_boundary)
@@ -159,7 +164,8 @@ class QueryRouter:
                      timeout: float, enable_trace: bool = False,
                      deadline: Optional[float] = None,
                      trace: Optional[TraceContext] = None,
-                     parent_span_id: Optional[str] = None
+                     parent_span_id: Optional[str] = None,
+                     workload: Optional[str] = None
                      ) -> Tuple[List[DataTable], int, int, List[dict]]:
         """routes: [(per-table request, {server: segments})] — returns
         (tables, num_queried, num_responded, errors). `deadline` is an
@@ -178,7 +184,7 @@ class QueryRouter:
         outcomes = await asyncio.gather(
             *(self._query_unit(request_id, sub, server, segments,
                                deadline, enable_trace, trace,
-                               parent_span_id)
+                               parent_span_id, workload)
               for sub, server, segments in units))
         tables: List[DataTable] = []
         errors: List[dict] = []
@@ -195,7 +201,8 @@ class QueryRouter:
                           server: str, segments: List[str],
                           deadline: float, enable_trace: bool,
                           trace: Optional[TraceContext] = None,
-                          parent_span_id: Optional[str] = None):
+                          parent_span_id: Optional[str] = None,
+                          workload: Optional[str] = None):
         errors: List[dict] = []
         tried = {server}
         tables: List[DataTable] = []
@@ -205,16 +212,27 @@ class QueryRouter:
         dt = await self._dispatch_hedged(request_id, sub, server,
                                          segments, deadline,
                                          enable_trace, errors, tried,
-                                         trace, parent_span_id)
+                                         trace, parent_span_id, workload)
         if dt is not None:
             for e in errors:         # e.g. primary failed, hedge won
                 e["recovered"] = True
             return [dt], errors
         # failover: re-route this unit's segments to other live replicas
-        # (waves, because the replacement can fail too) within budget
+        # (waves, because the replacement can fail too) within budget.
+        # EXCEPT a deadline-cause shed: the server judged the remaining
+        # budget below the table's service-time estimate. The estimate
+        # is the SHEDDING server's own rolling p75 — a transiently
+        # degraded replica can shed what a healthy one would answer —
+        # but under deadline pressure per-shed failover fan-out is the
+        # worse failure mode (every doomed query multiplies RPCs right
+        # at the overload knee), and each busy reply soft-dings the
+        # shedder's health (on_busy), so routing steers subsequent
+        # queries to healthier replicas within a few requests
         remaining_segs = list(segments)
         for _ in range(1, self.MAX_ATTEMPTS):
             if not remaining_segs or self._clock() >= deadline:
+                break
+            if any(e.get("busyCause") == "deadline" for e in errors):
                 break
             groups = self._replica_groups(sub, remaining_segs, tried)
             if not groups:
@@ -225,7 +243,7 @@ class QueryRouter:
             results = await asyncio.gather(
                 *(self._call_once(request_id, sub, srv, segs, deadline,
                                   enable_trace, errors, trace,
-                                  parent_span_id)
+                                  parent_span_id, workload)
                   for srv, segs in items))
             next_remaining: List[str] = []
             for (srv, segs), dt in zip(items, results):
@@ -244,12 +262,13 @@ class QueryRouter:
 
     async def _dispatch_hedged(self, request_id, sub, server, segments,
                                deadline, enable_trace, errors, tried,
-                               trace=None, parent_span_id=None):
+                               trace=None, parent_span_id=None,
+                               workload=None):
         """Primary call with a latency hedge to one replica."""
         ft = self.fault_tolerance
         primary = asyncio.ensure_future(self._call_once(
             request_id, sub, server, segments, deadline, enable_trace,
-            errors, trace, parent_span_id))
+            errors, trace, parent_span_id, workload))
         hedge_after = ft.hedge_delay_s(server) if ft is not None else None
         if hedge_after is None:
             return await primary
@@ -268,9 +287,12 @@ class QueryRouter:
             return await primary
         tried.add(hedge_server)
         ft.on_hedge(server)
+        # hedge=True travels in the request: under queue pressure the
+        # server sheds hedged duplicates FIRST (deterministic order)
         hedge = asyncio.ensure_future(self._call_once(
             request_id, sub, hedge_server, segments, deadline,
-            enable_trace, errors, trace, parent_span_id))
+            enable_trace, errors, trace, parent_span_id, workload,
+            hedge=True))
         pending = {primary, hedge}
         winner = None
         while pending and winner is None:
@@ -292,7 +314,8 @@ class QueryRouter:
 
     async def _call_once(self, request_id, sub, server, segments,
                          deadline, enable_trace, errors, trace=None,
-                         parent_span_id=None):
+                         parent_span_id=None, workload=None,
+                         hedge=False):
         """One dispatch to one server; stamps the remaining budget,
         classifies failures, feeds the health/breaker state."""
         ft = self.fault_tolerance
@@ -324,7 +347,8 @@ class QueryRouter:
             broker_id=self.broker_id, enable_trace=enable_trace,
             deadline_budget_ms=budget * 1e3,
             trace_id=trace.trace_id if dspan is not None else None,
-            parent_span_id=dspan["spanId"] if dspan is not None else None))
+            parent_span_id=dspan["spanId"] if dspan is not None else None,
+            workload=workload, hedge=hedge))
         t0 = self._clock()
         try:
             raw = await asyncio.wait_for(
@@ -352,6 +376,38 @@ class QueryRouter:
             return None
         if dspan is not None:
             dspan["ms"] = round((self._clock() - t0) * 1e3, 3)
+        busy_cause = dt.metadata.get(SERVER_BUSY_KEY)
+        if busy_cause is not None:
+            # typed server-busy: the server's admission control shed
+            # this request. NON-RETRIABLE on the same server (it just
+            # told us it is drowning) — record the failure so the unit
+            # fails over to a replica; `tried` already excludes this
+            # server from hedges and failover waves. Health takes a
+            # soft ding, the breaker NEVER trips on honest shedding.
+            self.metrics.meter(BrokerMeter.SERVER_BUSY_RESPONSES).mark()
+            self.metrics.meter(BrokerMeter.SERVER_BUSY_RESPONSES,
+                               table=busy_cause).mark()
+            if ft is not None:
+                ft.on_busy(server)
+            retry_ms = dt.metadata.get(RETRY_AFTER_MS_KEY, "0")
+            err = _server_error(
+                server, f"{SERVER_BUSY_EXC_PREFIX} shed ({busy_cause}), "
+                f"retryAfterMs={retry_ms}")
+            # internal routing markers only — _finish surfaces just
+            # server/message, so these never reach the client.
+            # busyCause is ALSO the structured busy classifier _finish
+            # keys 503-vs-425 on (never the message text, whose wording
+            # is free to change); retryAfterMs feeds the whole-query-
+            # shed Retry-After the HTTP layer returns with its 503
+            err["busyCause"] = busy_cause
+            try:
+                err["retryAfterMs"] = float(retry_ms)
+            except (TypeError, ValueError):
+                err["retryAfterMs"] = 0.0
+            errors.append(err)
+            if dspan is not None:
+                dspan.setdefault("attrs", {})["busy"] = busy_cause
+            return None
         if ft is not None:
             ft.on_success(server, (self._clock() - t0) * 1e3)
         dt.metadata.setdefault("serverName", server)
@@ -420,7 +476,9 @@ class BrokerRequestHandler:
                  access_control=None,
                  segment_pruner=None,
                  fault_tolerance: Optional[FaultToleranceManager] = None,
-                 slow_log: Optional[SlowQueryLog] = None):
+                 slow_log: Optional[SlowQueryLog] = None,
+                 result_cache: Optional[BrokerResultCache] = None,
+                 cache_freshness_ms: Optional[float] = None):
         # optional broker-side segment pruner (PartitionZKMetadataPruner):
         # prune(request, table, segments) -> segments
         self.segment_pruner = segment_pruner
@@ -447,6 +505,12 @@ class BrokerRequestHandler:
                                   routing=routing, metrics=self.metrics)
         self.time_boundary = time_boundary or TimeBoundaryService()
         self.quota = quota or QueryQuotaManager()
+        # broker-level result cache for tables with a realtime part,
+        # bounded by minConsumingFreshnessTimeMs: the query option opts
+        # in per query; `cache_freshness_ms` sets a broker-wide default
+        # bound (None = only explicitly-bounded queries are cached)
+        self.result_cache = result_cache or BrokerResultCache()
+        self.default_cache_freshness_ms = cache_freshness_ms
         self.optimizer = BrokerRequestOptimizer()
         self.reducer = BrokerReduceService()
         if access_control is None:
@@ -473,11 +537,14 @@ class BrokerRequestHandler:
         prepared = self._prepare(pql, identity, force_trace)
         if isinstance(prepared, BrokerResponse):
             return prepared
-        request, trace, routes, timeout_s, deadline, t0 = prepared
+        request, trace, routes, timeout_s, deadline, t0, workload, \
+            fingerprint = prepared
         tables, queried, responded, errors = loop.run(
-            self._scatter(request, trace, routes, timeout_s, deadline))
+            self._scatter(request, trace, routes, timeout_s, deadline,
+                          workload))
         return self._finish(request, trace, t0, tables, queried,
-                            responded, errors, pql=pql)
+                            responded, errors, pql=pql,
+                            fingerprint=fingerprint)
 
     def close(self) -> None:
         if self._loop is not None:
@@ -492,11 +559,13 @@ class BrokerRequestHandler:
         prepared = self._prepare(pql, identity, force_trace)
         if isinstance(prepared, BrokerResponse):
             return prepared
-        request, trace, routes, timeout_s, deadline, t0 = prepared
+        request, trace, routes, timeout_s, deadline, t0, workload, \
+            fingerprint = prepared
         tables, queried, responded, errors = await self._scatter(
-            request, trace, routes, timeout_s, deadline)
+            request, trace, routes, timeout_s, deadline, workload)
         return self._finish(request, trace, t0, tables, queried,
-                            responded, errors, pql=pql)
+                            responded, errors, pql=pql,
+                            fingerprint=fingerprint)
 
     # -- pipeline stages ---------------------------------------------------
     def _prepare(self, pql: str, identity, force_trace: bool):
@@ -531,10 +600,82 @@ class BrokerRequestHandler:
                                    f"denied for table {request.table_name}")
 
         raw = raw_table(request.table_name)
-        if not self.quota.acquire(raw):
+        # tenant/workload tag: OPTION(workload=...) in the query, else
+        # a DIGEST of the authenticated identity's token — the key the
+        # per-tenant quota buckets and the server's scheduler groups
+        # isolate on. Never the raw token: the tag travels in plaintext
+        # in every InstanceRequest and surfaces in scheduler-group
+        # names and debug views, so a bearer credential must not be it.
+        workload = request.query_options.options.get("workload")
+        if workload:
+            # an explicit tag spends THAT tenant's quota and joins its
+            # scheduler group — give the ACL a chance to bind tags to
+            # authenticated principals (default SPI: allow, tags are
+            # cooperative; getattr tolerates duck-typed implementations)
+            gate = getattr(self.access_control, "allow_workload", None)
+            if gate is not None and not gate(identity, workload):
+                self.metrics.meter(
+                    BrokerMeter.REQUEST_DROPPED_DUE_TO_ACCESS_ERROR).mark()
+                return _error_response(
+                    180, "AccessDeniedError: identity may not use "
+                    f"workload {workload}")
+        else:
+            token = getattr(identity, "token", None)
+            if token:
+                import hashlib
+                workload = "id-" + hashlib.sha256(
+                    token.encode("utf-8")).hexdigest()[:12]
+        decision = self.quota.acquire(raw, workload)
+        if not decision:
             self.metrics.meter(BrokerMeter.QUERY_QUOTA_EXCEEDED).mark()
-            return _error_response(429, f"QuotaExceededError: table {raw} "
-                                   "exceeded its QPS quota")
+            cause = decision.cause or "tableQuota"
+            self.metrics.meter(BrokerMeter.QUERIES_DROPPED).mark()
+            self.metrics.meter(BrokerMeter.QUERIES_DROPPED,
+                               table=cause).mark()
+            scope = f"tenant {workload} of table {raw}" \
+                if cause == "tenantQuota" else f"table {raw}"
+            resp = _error_response(
+                429, f"QuotaExceededError: {scope} exceeded its QPS "
+                f"quota; retry after {decision.retry_after_s:.2f}s")
+            resp.exceptions[0]["retryAfterSeconds"] = round(
+                decision.retry_after_s, 3)
+            # the HTTP layer turns this into a 429 + Retry-After header
+            resp.retry_after_s = decision.retry_after_s
+            return resp
+
+        # broker-level result cache: only tables with a realtime part
+        # (the server-side CRC cache already covers pure-offline), only
+        # under an explicit freshness bound. Probed BEFORE routing —
+        # the hit path is the graceful-degradation valve under
+        # overload, so it must not pay route computation + segment
+        # pruning just to discard them (has_table on the realtime
+        # variant also guarantees the table still exists)
+        fingerprint = None
+        opt_bound = request.query_options.options.get(
+            "minConsumingFreshnessTimeMs")
+        try:
+            bound_ms = float(opt_bound) if opt_bound is not None \
+                else self.default_cache_freshness_ms
+        except (TypeError, ValueError):
+            bound_ms = self.default_cache_freshness_ms
+        # traced queries bypass the cache both ways: the client asked
+        # to watch THIS execution, and a cached reply has no spans
+        # (the put at _finish has the matching guard)
+        if bound_ms is not None and not request.query_options.trace and \
+                self.routing.has_table(realtime_table(raw)):
+            from pinot_tpu.query.fingerprint import query_fingerprint
+            fp = query_fingerprint(request)
+            # generation captured BEFORE execution: a view change that
+            # clear()s the cache while this query is in flight (an
+            # OFFLINE backfill) must not be undone by _finish's put
+            # re-inserting the pre-backfill result
+            fingerprint = (fp, self.result_cache.generation)
+            cached = self.result_cache.get(fp, bound_ms)
+            if cached is not None:
+                self.metrics.meter(BrokerMeter.RESULT_CACHE_HITS).mark()
+                cached.time_used_ms = (time.perf_counter() - t0) * 1e3
+                return cached
+            self.metrics.meter(BrokerMeter.RESULT_CACHE_MISSES).mark()
 
         with self.metrics.timer(BrokerQueryPhase.QUERY_ROUTING).time(), \
                 trace.span(BrokerQueryPhase.QUERY_ROUTING):
@@ -550,10 +691,12 @@ class BrokerRequestHandler:
         # every retry: re-dispatches spend the remaining budget, they
         # never extend user-visible latency past the requested timeout
         deadline = time.monotonic() + timeout_s
-        return request, trace, routes, timeout_s, deadline, t0
+        return request, trace, routes, timeout_s, deadline, t0, \
+            workload, fingerprint
 
     async def _scatter(self, request: BrokerRequest, trace: TraceContext,
-                       routes, timeout_s: float, deadline: float):
+                       routes, timeout_s: float, deadline: float,
+                       workload: Optional[str] = None):
         """Async network stage: dispatch + gather + missing-segment
         retry. The only stage that runs on the shared event loop."""
         with self.metrics.timer(BrokerQueryPhase.SCATTER_GATHER).time(), \
@@ -562,12 +705,14 @@ class BrokerRequestHandler:
             tables, queried, responded, errors = await self.router.submit(
                 next(self._request_ids), routes, timeout_s,
                 enable_trace=request.query_options.trace,
-                deadline=deadline, trace=trace, parent_span_id=sg_id)
+                deadline=deadline, trace=trace, parent_span_id=sg_id,
+                workload=workload)
             tables, rq, rr, retry_errors = \
                 await self._retry_missing_segments(
                     routes, tables, deadline,
                     enable_trace=request.query_options.trace,
-                    trace=trace, parent_span_id=sg_id)
+                    trace=trace, parent_span_id=sg_id,
+                    workload=workload)
             queried += rq
             responded += rr
             errors += retry_errors
@@ -576,7 +721,8 @@ class BrokerRequestHandler:
     def _finish(self, request: BrokerRequest, trace: TraceContext,
                 t0: float, tables: List[DataTable], queried: int,
                 responded: int, errors: List[dict],
-                pql: Optional[str] = None) -> BrokerResponse:
+                pql: Optional[str] = None,
+                fingerprint: Optional[str] = None) -> BrokerResponse:
         """Sync CPU stage: reduce + failure surfacing + trace merge."""
         if responded < queried:
             self.metrics.meter(
@@ -592,16 +738,41 @@ class BrokerRequestHandler:
         # telemetry-only (meters/health), not client-facing noise
         unrecovered = [e for e in errors if not e.get("recovered")]
         for e in unrecovered:
+            # the structured busyCause marker from _call_once is the
+            # classifier — never the message text, whose wording is
+            # free to change without turning sheds into 425 faults
+            busy = e.get("busyCause") is not None
             resp.exceptions.append({
-                "errorCode": 425,
+                # 503: typed server-busy (admission shed) — distinct
+                # from 425 server errors so clients can back off
+                # instead of treating overload as a fault
+                "errorCode": 503 if busy else 425,
                 "message": f"ServerQueryError: server={e['server']}: "
                            f"{e['message']}"})
+        if not tables and unrecovered and \
+                all(e.get("busyCause") is not None for e in unrecovered):
+            # the whole query was lost to shedding: a per-cause drop
+            # meter mirrors the broker-side quota drops, and the reply
+            # carries a real Retry-After (worst drain estimate across
+            # the shedding servers) so the HTTP layer can answer 503 +
+            # Retry-After instead of a 200 that invites instant retry
+            self.metrics.meter(BrokerMeter.QUERIES_DROPPED).mark()
+            self.metrics.meter(BrokerMeter.QUERIES_DROPPED,
+                               table="serverBusy").mark()
+            retry_s = max((e.get("retryAfterMs") or 0.0)
+                          for e in unrecovered) / 1e3
+            resp.retry_after_s = max(retry_s, 1.0)
         resp.partial_response = bool(
             responded < queried or unrecovered or
             any(dt.exceptions for dt in tables))
         resp.num_servers_queried = queried
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.perf_counter() - t0) * 1e3
+        if fingerprint is not None and not request.query_options.trace:
+            # put() itself refuses partial/excepted/oversized responses
+            # and drops inserts that lost a race with a clear()
+            fp, gen = fingerprint
+            self.result_cache.put(fp, resp, gen=gen)
         self.metrics.timer(BrokerQueryPhase.QUERY_TOTAL).update(
             resp.time_used_ms)
         self.metrics.meter(BrokerMeter.DOCUMENTS_SCANNED).mark(
@@ -650,6 +821,12 @@ class BrokerRequestHandler:
         query-level record on the rolling per-table stats."""
         merged: Optional[dict] = None
         for dt in tables:
+            if dt.metadata.get(RESULT_CACHE_HIT_KEY):
+                # a cache hit replays the ORIGINAL execution's profile
+                # bytes; folding it again would add a phantom copy of
+                # those operator timings per hit to the rolling stats
+                # an operator sizes quotas from, for ~0 actual work
+                continue
             raw = dt.metadata.get("profileInfo")
             if not raw:
                 continue
@@ -677,7 +854,8 @@ class BrokerRequestHandler:
                                       deadline: float,
                                       enable_trace: bool = False,
                                       trace: Optional[TraceContext] = None,
-                                      parent_span_id: Optional[str] = None):
+                                      parent_span_id: Optional[str] = None,
+                                      workload: Optional[str] = None):
         """One re-dispatch of segments a server reported missing.
 
         A routing table sampled just before a rebalance drop step / a
@@ -755,7 +933,7 @@ class BrokerRequestHandler:
         retry_tables, rq, rr, errors = await self.router.submit(
             next(self._request_ids), retry_routes, remaining_s,
             enable_trace=enable_trace, deadline=deadline, trace=trace,
-            parent_span_id=parent_span_id)
+            parent_span_id=parent_span_id, workload=workload)
         return tables + retry_tables, rq, rr, errors
 
     def _pruned_route(self, sub_request: BrokerRequest, table: str

@@ -1,0 +1,124 @@
+"""Broker-level result cache for hybrid/realtime tables.
+
+The server-side cache (server/result_cache.py) is CRC-exact but cannot
+cover consuming segments — they have no CRC and mutate continuously.
+For hybrid tables the honest bound is FRESHNESS, and the plumbing
+already exists: ``minConsumingFreshnessTimeMs`` is the response field
+that tells a client how stale its realtime rows may be. A cached
+response younger than the query's freshness bound (the
+``minConsumingFreshnessTimeMs`` query option, or the broker default)
+is indistinguishable from a live answer UNDER THE CLIENT'S OWN
+STALENESS CONTRACT — that is what makes serving it correct.
+
+Only COMPLETE responses cache (no exceptions, not partial), and only
+SMALL ones (``max_cells``): MB-scale selection payloads are poor cache
+citizens (memory) and their deep copies taxed the reduce path of every
+complete query. Bounded-size entries store a deep copy and hits hand
+out another deep copy, so no query — and no embedding caller mutating
+the response ``handle()`` returned — ever touches shared cache state.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from pinot_tpu.common.response import BrokerResponse
+
+
+class BrokerResultCache:
+    """Bounded LRU of (fingerprint → BrokerResponse, stored-at)."""
+
+    def __init__(self, max_entries: int = 512,
+                 max_cells: int = 50_000,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_entries = int(max_entries)
+        self.max_cells = int(max_cells)
+        self._clock = clock
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        # bumped by clear(): a put whose captured generation is stale
+        # (a view change invalidated the cache mid-query) is dropped —
+        # same guard the server cache uses against the swap/put race
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def get(self, fingerprint: str,
+            max_age_ms: float) -> Optional[BrokerResponse]:
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(fingerprint)
+            if entry is None:
+                self.misses += 1
+                return None
+            resp, stored_at = entry
+            if (now - stored_at) * 1e3 > max_age_ms:
+                # too stale for THIS query's bound; keep the entry —
+                # a later query with a looser bound may still hit it
+                self.misses += 1
+                return None
+            self._entries.move_to_end(fingerprint)
+            self.hits += 1
+        # deep copy OUTSIDE the lock: stored responses are only ever
+        # replaced, never mutated in place, and copying a large
+        # selection result under the lock would serialize the very
+        # hit path that is the degradation valve under overload.
+        # The stored minConsumingFreshnessTimeMs is an absolute
+        # last-indexed timestamp: it already states the cached
+        # data's true freshness, so it travels unchanged
+        return copy.deepcopy(resp)
+
+    def put(self, fingerprint: str, resp: BrokerResponse,
+            gen: Optional[int] = None) -> None:
+        """`gen`: the generation captured BEFORE the query executed
+        (at probe time). A clear() that raced the in-flight query —
+        an OFFLINE backfill's view change — bumps the generation, so
+        the pre-backfill result is dropped instead of re-populating
+        the cache with rows the backfill rewrote."""
+        if resp.exceptions or resp.partial_response:
+            return                     # only complete answers cache
+        if _approx_cells(resp) > self.max_cells:
+            return                     # large payloads never cache
+        # deep copy outside the lock: the same object handle() hands
+        # the embedding caller must never alias a cache entry (user
+        # code mutating ITS response would poison every later hit).
+        # The size cap above is what keeps this copy cheap — the
+        # O(result size) tax on huge selections is gone because huge
+        # selections no longer cache at all.
+        stored = copy.deepcopy(resp)
+        with self._lock:
+            if gen is not None and gen != self._generation:
+                return                 # lost the race with a clear()
+            self._entries[fingerprint] = (stored, self._clock())
+            self._entries.move_to_end(fingerprint)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._generation += 1
+            self._entries.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses}
+
+
+def _approx_cells(resp: BrokerResponse) -> int:
+    """Result size in cells — the copy/memory cost driver."""
+    n = 0
+    if resp.selection_results is not None:
+        n += len(resp.selection_results.results) * \
+            max(1, len(resp.selection_results.columns))
+    for agg in resp.aggregation_results or ():
+        n += len(agg.group_by_result) \
+            if agg.group_by_result is not None else 1
+    return n

@@ -57,6 +57,16 @@ MISSING_SEGMENTS_KEY = "missingSegments"
 # Human-facing exception prefix for the same condition — shared so the
 # server format and the broker's partial-response surface stay in sync.
 SEGMENT_MISSING_EXC_PREFIX = "SegmentMissingError:"
+# Structured metadata keys for server admission control: a shed request
+# answers with SERVER_BUSY_KEY = the shed cause ("overload" | "hedge" |
+# "tenantOverQuota" | "deadline" | "capacity") and RETRY_AFTER_MS_KEY =
+# an estimate of when the queue will have drained. The router treats a
+# busy reply as non-retriable on the SAME server (failover only).
+SERVER_BUSY_KEY = "serverBusy"
+RETRY_AFTER_MS_KEY = "retryAfterMs"
+SERVER_BUSY_EXC_PREFIX = "ServerBusyError:"
+# Metadata marker on replies served from the server result cache.
+RESULT_CACHE_HIT_KEY = "resultCacheHit"
 
 
 @dataclasses.dataclass
@@ -279,6 +289,29 @@ def _read_column(b: bytes, off: int, n: int):
         col, off = _r_obj(b, off)
         return col, off
     raise ValueError(f"bad DataTable column tag {tag!r} at {off - 1}")
+
+
+def amend_metadata_bytes(b: bytes, updates: Dict[str, str]) -> bytes:
+    """Rewrite ONLY the metadata map of a serialized DataTable.
+
+    The server result-cache hit path stamps per-request keys
+    (requestId, resultCacheHit) onto cached payloads; a full
+    from_bytes/to_bytes round-trip there decodes and re-encodes every
+    row — burning, on multi-MB selection results, exactly the CPU the
+    cache exists to save under overload. The metadata map sits at a
+    fixed offset right after the 9-byte header, so it can be spliced
+    at memcpy cost without touching exceptions/schema/rows."""
+    version = _U32.unpack_from(b, 0)[0]
+    if version not in (_LEGACY_VERSION, VERSION):
+        raise ValueError(f"unsupported DataTable version {version}")
+    off = 9                   # version(4) + kind(1) + numGroupCols(4)
+    metadata, end = _r_obj(b, off)
+    md = dict(metadata)
+    md.update(updates)
+    out = bytearray(b[:off])
+    _w_obj(out, md)
+    out += b[end:]
+    return bytes(out)
 
 
 def _w_obj(out: bytearray, v) -> None:

@@ -164,6 +164,15 @@ class MetricsRegistry:
     def timer(self, name: str, table: Optional[str] = None) -> Timer:
         return self._get(self._timers, Timer, name, table)
 
+    def peek_timer(self, name: str,
+                   table: Optional[str] = None) -> Optional[Timer]:
+        """Read-only lookup that never registers a series — for probes
+        keyed on unvalidated strings (e.g. request table names), where
+        get-or-create would grow the registry without bound."""
+        key = f"{table}.{name}" if table else name
+        with self._lock:
+            return self._timers.get(key)
+
     def _get(self, store, cls, name: str, table: Optional[str]):
         key = f"{table}.{name}" if table else name
         with self._lock:
@@ -225,6 +234,17 @@ class BrokerMeter:
     SERVER_ERRORS = "serverErrors"
     HEDGED_REQUESTS = "hedgedRequests"
     SEGMENT_RETRIES = "segmentRetries"
+    # ingress control: queries rejected at the broker, per cause via the
+    # table suffix ("tableQuota" | "tenantQuota" | "serverBusy")
+    QUERIES_DROPPED = "queriesDropped"
+    # per-dispatch server-busy replies observed (per shed cause via the
+    # table suffix) — distinct from QUERIES_DROPPED, which counts whole
+    # queries the client lost; a busy reply recovered by failover is
+    # telemetry only
+    SERVER_BUSY_RESPONSES = "serverBusyResponses"
+    # broker-level result cache (hybrid tables, freshness-bounded)
+    RESULT_CACHE_HITS = "resultCacheHits"
+    RESULT_CACHE_MISSES = "resultCacheMisses"
 
 
 class BrokerGauge:
@@ -264,6 +284,15 @@ class ServerMeter:
     # invalidated in validDocIds bitmaps
     UPSERTED_ROWS = "upsertedRows"
     MASKED_DOCS = "maskedDocs"
+    # admission control: requests shed before execution (per cause via
+    # the table suffix: "overload" | "hedge" | "tenantOverQuota" |
+    # "deadline" | "capacity") and requests admitted in brownout mode
+    # (degraded deadline → flagged-partial results)
+    REQUESTS_SHED = "requestsShed"
+    BROWNOUT_QUERIES = "brownoutQueries"
+    # server-side CRC-exact result cache
+    RESULT_CACHE_HITS = "resultCacheHits"
+    RESULT_CACHE_MISSES = "resultCacheMisses"
 
 
 class ControllerMeter:
@@ -290,3 +319,5 @@ class ServerGauge:
     SEGMENT_COUNT = "segmentCount"
     LLC_PARTITION_CONSUMING = "llcPartitionConsuming"
     UPSERT_KEY_MAP_SIZE = "upsertKeyMapSize"
+    # admission control queue depth (submitted minus completed)
+    ADMISSION_QUEUE_DEPTH = "admissionQueueDepth"

@@ -208,3 +208,12 @@ class InstanceRequest:
     # the broker can merge one cross-process trace tree at reduce
     trace_id: Optional[str] = None
     parent_span_id: Optional[str] = None
+    # tenant/workload tag (optional serde key, version-skew safe): the
+    # server maps it to a per-tenant TokenSchedulerGroup so one
+    # tenant's flood burns its own tokens, and admission control
+    # applies per-tenant fair-share shedding under overload
+    workload: Optional[str] = None
+    # True on hedged duplicate dispatches: under queue pressure the
+    # server sheds hedges FIRST (the primary is still in flight
+    # somewhere — dropping the duplicate loses nothing)
+    hedge: bool = False

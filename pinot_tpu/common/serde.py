@@ -139,6 +139,12 @@ def instance_request_to_bytes(r: InstanceRequest) -> bytes:
         # context only travels when the query is traced
         d["traceId"] = r.trace_id
         d["parentSpanId"] = r.parent_span_id
+    if r.workload is not None:
+        # optional: a tenant tag from a newer broker is scheduling
+        # advice an older server simply ignores
+        d["workload"] = r.workload
+    if r.hedge:
+        d["hedge"] = True
     return json.dumps(d).encode("utf-8")
 
 
@@ -152,7 +158,9 @@ def instance_request_from_bytes(b: bytes) -> InstanceRequest:
         broker_id=d.get("brokerId", ""),
         deadline_budget_ms=d.get("deadlineBudgetMs"),
         trace_id=d.get("traceId"),
-        parent_span_id=d.get("parentSpanId"))
+        parent_span_id=d.get("parentSpanId"),
+        workload=d.get("workload"),
+        hedge=d.get("hedge", False))
 
 
 # ---------------------------------------------------------------------------

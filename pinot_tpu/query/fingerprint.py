@@ -1,0 +1,68 @@
+"""Canonical query fingerprint: the result-cache key.
+
+Two requests share a fingerprint iff they MUST produce identical
+results over identical data. The fingerprint therefore hashes a
+canonicalized form of the compiled request:
+
+- execution-irrelevant options are dropped (trace, timeoutMs — they
+  shape metadata and deadlines, never result values;
+  minConsumingFreshnessTimeMs is enforced per-query at cache-GET time
+  as a max-age bound, so queries that differ only in their freshness
+  bound share one entry);
+- IN/NOT_IN value lists are sorted (set semantics);
+- AND/OR children are sorted by their canonical encoding (conjunction
+  and disjunction are commutative over result values).
+
+Canonicalization only ever MERGES equivalent queries — a query pair
+with different results always hashes differently, so a cache keyed on
+the fingerprint (plus segment CRCs) is exact by construction; an
+imperfect canonicalization costs hit rate, never correctness.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
+                                      FilterQueryTree)
+from pinot_tpu.common.serde import filter_to_json, request_to_json
+
+_COMMUTATIVE = (FilterOperator.AND, FilterOperator.OR)
+_SET_VALUED = (FilterOperator.IN, FilterOperator.NOT_IN)
+
+
+def _canonical_filter(node: Optional[FilterQueryTree]):
+    if node is None:
+        return None
+    d = filter_to_json(node)
+    if node.operator in _COMMUTATIVE:
+        children = [_canonical_filter(c) for c in node.children]
+        children.sort(key=lambda c: json.dumps(c, sort_keys=True))
+        d["children"] = children
+    elif node.operator in _SET_VALUED:
+        d["vals"] = sorted(node.values)
+    return d
+
+
+def canonical_request_dict(request: BrokerRequest) -> dict:
+    d = request_to_json(request)
+    d["filter"] = _canonical_filter(request.filter)
+    opts = d.get("options") or {}
+    # execution-shaping keys never change result values: "workload" is
+    # a scheduling/quota tag (two tenants issuing the same query must
+    # share one cache entry), trace/timeoutMs shape metadata and
+    # deadlines (the parser mirrors them into options.options too)
+    drop = {"workload", "trace", "timeoutMs",
+            "minConsumingFreshnessTimeMs"}
+    d["options"] = {"options": dict(sorted(
+        (k, v) for k, v in (opts.get("options") or {}).items()
+        if k not in drop))}
+    return d
+
+
+def query_fingerprint(request: BrokerRequest) -> str:
+    """Stable hex digest of the canonicalized request (table included)."""
+    payload = json.dumps(canonical_request_dict(request), sort_keys=True,
+                         separators=(",", ":"))
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:32]

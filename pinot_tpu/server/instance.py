@@ -13,14 +13,22 @@ import threading
 import time
 from typing import Optional, Tuple
 
-from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.datatable import (DataTable, RESULT_CACHE_HIT_KEY,
+                                        amend_metadata_bytes)
 from pinot_tpu.common.metrics import (MetricsRegistry, ServerGauge,
                                       ServerMeter, ServerQueryPhase)
 from pinot_tpu.common.request import InstanceRequest
 from pinot_tpu.common.serde import instance_request_from_bytes
+from pinot_tpu.server.admission import (AdmissionController,
+                                        ServiceTimeEstimator,
+                                        busy_datatable)
 from pinot_tpu.server.data_manager import InstanceDataManager
 from pinot_tpu.server.query_executor import InstanceQueryExecutor
-from pinot_tpu.server.scheduler import QueryScheduler, make_scheduler
+from pinot_tpu.server.result_cache import (ServerResultCache,
+                                           segment_cache_states)
+from pinot_tpu.server.scheduler import (QueryScheduler,
+                                        SchedulerOutOfCapacityError,
+                                        make_scheduler)
 from pinot_tpu.transport.tcp import EventLoopThread, QueryServer
 
 
@@ -29,7 +37,9 @@ class ServerInstance:
 
     def __init__(self, instance_id: str = "server_0",
                  scheduler: str = "fcfs", num_workers: int = 4,
-                 mesh=None, use_device: bool = True):
+                 mesh=None, use_device: bool = True,
+                 max_pending: Optional[int] = None,
+                 result_cache_entries: int = 256):
         self.instance_id = instance_id
         self.metrics = MetricsRegistry("server")
         self.data_manager = InstanceDataManager()
@@ -39,6 +49,25 @@ class ServerInstance:
             self.data_manager, mesh=mesh, use_device=use_device,
             metrics=self.metrics,
             segment_executor=self.scheduler.segment_pool)
+        # admission control + CRC-exact result cache (hits bypass the
+        # admission queue — the degradation valve under overload)
+        self.estimator = ServiceTimeEstimator(self.metrics)
+        self.admission = AdmissionController(
+            metrics=self.metrics, estimator=self.estimator,
+            max_pending=max_pending if max_pending is not None
+            else max(16, 16 * num_workers),
+            num_workers=num_workers)
+        self.result_cache = ServerResultCache(
+            max_entries=result_cache_entries)
+        # accepted workload tags (scheduler groups + fair-share keys
+        # derive from them) — bounded, because the tag is CLIENT-chosen
+        self._tenant_tags: set = set()
+        # a replaced/removed segment can change results WITHOUT a CRC
+        # change (segment reload re-processes the same artifact against
+        # an evolved schema) — any swap clears the cache; swaps are
+        # rare (reload, rebalance) so the coarse clear is cheap
+        self.data_manager.add_removal_listener(
+            lambda _name: self.result_cache.clear())
         self.metrics.gauge(ServerGauge.SEGMENT_COUNT).set_callable(
             self.data_manager.num_segments)
         self.metrics.meter(ServerMeter.QUERIES)   # exists at 0 from boot
@@ -69,18 +98,145 @@ class ServerInstance:
             ServerQueryPhase.REQUEST_DESERIALIZATION).update(ms)
         return request, err, ms
 
-    def _schedule(self, request: InstanceRequest, deser_ms: float = 0.0):
+    # scheduler groups and admission fair-share counters are permanent
+    # once created, and the workload tag that keys them is CLIENT-chosen
+    # — past this many distinct tags, new ones fall back to the
+    # (config-bounded) per-table group instead of growing the maps and
+    # the scheduler's per-pick scan without bound
+    MAX_TENANT_TAGS = 256
+
+    def _tenant(self, request: InstanceRequest) -> str:
+        """Scheduler group / fair-share key: the broker-stamped tenant
+        tag, or the table for untagged traffic (per-table isolation is
+        the old behavior and the sensible default). Tags are namespaced
+        (``w:``) so OPTION(workload=<table name>) can never join the
+        untagged traffic's per-table group.
+
+        Lookup only: a fresh tag's permanent slot is committed by
+        ``_register_tenant`` once the request is actually ADMITTED —
+        a flood of unique tags that all get shed must not burn the
+        tag budget and lock later tenants out of isolation."""
+        tag = request.workload
+        if not tag:
+            return request.query.table_name
+        if tag not in self._tenant_tags and \
+                len(self._tenant_tags) >= self.MAX_TENANT_TAGS:
+            return request.query.table_name
+        return f"w:{tag}"
+
+    def _register_tenant(self, tenant: str) -> None:
+        """Commit an admitted request's tag slot (no-op for the
+        per-table fallback). set.add is atomic under the GIL; a racing
+        duplicate add is idempotent and a transient cap overshoot in
+        the admit window is harmless."""
+        if tenant.startswith("w:"):
+            self._tenant_tags.add(tenant[2:])
+
+    # -- result cache -------------------------------------------------------
+    def _cache_lookup(self, request: InstanceRequest):
+        """→ (fingerprint, cached reply bytes or None, generation).
+        A hit is served WITHOUT touching the admission queue or the
+        scheduler. The generation is captured BEFORE execution so a
+        segment swap's clear() while the query runs invalidates its
+        eventual store instead of racing it."""
+        gen = self.result_cache.generation
+        if request.enable_trace:
+            return None, None, gen     # traced queries want real spans
+        if len(self.result_cache) == 0:
+            # empty-cache fast path: skip the probe's per-segment
+            # acquire/release and the fingerprint hash entirely —
+            # _maybe_cache_store computes the key itself at store time
+            self.metrics.meter(ServerMeter.RESULT_CACHE_MISSES).mark()
+            return None, None, gen
+        tdm = self.data_manager.table(request.query.table_name)
+        if tdm is None:
+            return None, None, gen
+        acquired, missing = tdm.acquire_segments(request.search_segments)
+        try:
+            if missing:
+                return None, None, gen
+            states = segment_cache_states([s.segment for s in acquired])
+        finally:
+            for sdm in acquired:
+                tdm.release_segment(sdm)
+        if states is None:
+            # mutable / CRC-less segment in the set
+            return None, None, gen
+        from pinot_tpu.query.fingerprint import query_fingerprint
+        fp = query_fingerprint(request.query)
+        payload = self.result_cache.get(
+            ServerResultCache.key(request.query.table_name, fp, states))
+        if payload is None:
+            self.metrics.meter(ServerMeter.RESULT_CACHE_MISSES).mark()
+            return fp, None, gen
+        self.metrics.meter(ServerMeter.RESULT_CACHE_HITS).mark()
+        # splice ONLY the metadata map (fresh bytes per hit, rows
+        # byte-identical to the original run): a full serde round-trip
+        # just to stamp two keys would burn the CPU the cache exists
+        # to save under overload
+        reply = amend_metadata_bytes(payload, {
+            "requestId": str(request.request_id),
+            RESULT_CACHE_HIT_KEY: "1"})
+        return fp, reply, gen
+
+    def _maybe_cache_store(self, request: InstanceRequest,
+                           dt: DataTable, payload: bytes,
+                           fingerprint: Optional[str],
+                           gen: Optional[int] = None) -> None:
+        """Store a fully-successful answer keyed on the EXECUTION-time
+        segment states (probe-time states could race a segment swap)."""
+        if request.enable_trace or dt.exceptions:
+            return
+        states = getattr(dt, "cache_states", None)
+        if not states:
+            return
+        if fingerprint is None:
+            # the probe was skipped (empty-cache fast path); the
+            # execution-time states above already proved cacheability
+            from pinot_tpu.query.fingerprint import query_fingerprint
+            fingerprint = query_fingerprint(request.query)
+        self.result_cache.put(
+            ServerResultCache.key(request.query.table_name, fingerprint,
+                                  states), payload, gen=gen)
+
+    # -- admission ----------------------------------------------------------
+    def _admit(self, request: InstanceRequest):
+        """→ (decision, busy reply bytes or None, tenant key). The key
+        is computed ONCE here and threaded through scheduling and
+        release so the depth accounting debits and credits the same
+        counter by construction."""
+        tenant = self._tenant(request)
+        decision = self.admission.admit(
+            request.query.table_name, tenant,
+            budget_ms=request.deadline_budget_ms, hedge=request.hedge)
+        if not decision:
+            return decision, busy_datatable(
+                request.request_id, decision.cause,
+                decision.retry_after_ms).to_bytes(), tenant
+        self._register_tenant(tenant)
+        return decision, None, tenant
+
+    def _schedule(self, request: InstanceRequest, deser_ms: float = 0.0,
+                  admission_deadline_s: Optional[float] = None,
+                  release_admission: bool = False,
+                  tenant: Optional[str] = None):
         """Submit to the scheduler; returns the result Future.
 
         Broker deadline propagation: the budget is fixed to an absolute
         instant NOW (deserialization time), so queue wait counts against
-        it and expired work is dropped, not computed.
+        it and expired work is dropped, not computed. Under brownout the
+        admission controller hands down a TIGHTER absolute deadline so
+        execution truncates to a flagged-partial result.
         """
         deadline = None
         budget_s = None
         if request.deadline_budget_ms is not None:
             budget_s = request.deadline_budget_ms / 1e3
             deadline = time.monotonic() + budget_s
+        if admission_deadline_s is not None:
+            deadline = admission_deadline_s if deadline is None \
+                else min(deadline, admission_deadline_s)
+            budget_s = max(0.0, deadline - time.monotonic())
         t_submit = time.perf_counter()
 
         def run():
@@ -89,8 +245,18 @@ class ServerInstance:
                                          deadline=deadline,
                                          deser_ms=deser_ms)
 
-        return self.scheduler.submit(request.query.table_name, run,
-                                     deadline_s=budget_s)
+        # per-TENANT scheduler group: the token hierarchy isolates CPU
+        # between tenants instead of pooling everything per table
+        if tenant is None:
+            tenant = self._tenant(request)
+        fut = self.scheduler.submit(tenant, run, deadline_s=budget_s)
+        if release_admission:
+            # pairs with the admit() in the request path; a failed
+            # future (e.g. OutOfCapacity) completes immediately, so the
+            # depth can never leak
+            fut.add_done_callback(
+                lambda _f, t=tenant: self.admission.release(t))
+        return fut
 
     def _serialize(self, request: InstanceRequest, dt: DataTable) -> bytes:
         with self.metrics.timer(
@@ -116,6 +282,15 @@ class ServerInstance:
                 payload = dt.to_bytes()
         return payload
 
+    def _capacity_reply(self, request: InstanceRequest) -> bytes:
+        """The scheduler's bounded queue rejected the query: same typed
+        server-busy surface as an admission shed."""
+        self.metrics.meter(ServerMeter.REQUESTS_SHED).mark()
+        self.metrics.meter(ServerMeter.REQUESTS_SHED,
+                           table="capacity").mark()
+        return busy_datatable(request.request_id, "capacity",
+                              0.0).to_bytes()
+
     def _error_reply(self, request: InstanceRequest, e: Exception) -> bytes:
         self.metrics.meter(ServerMeter.QUERY_EXECUTION_EXCEPTIONS).mark()
         dt = DataTable()
@@ -128,9 +303,22 @@ class ServerInstance:
         request, err, deser_ms = self._deserialize(payload)
         if err is not None:
             return err
+        fingerprint, cached, gen = self._cache_lookup(request)
+        if cached is not None:
+            return cached          # bypasses admission AND scheduling
+        decision, busy, tenant = self._admit(request)
+        if busy is not None:
+            return busy
         try:
-            dt = self._schedule(request, deser_ms).result()
-            return self._serialize(request, dt)
+            dt = self._schedule(request, deser_ms,
+                                admission_deadline_s=decision.deadline_s,
+                                release_admission=True,
+                                tenant=tenant).result()
+            reply = self._serialize(request, dt)
+            self._maybe_cache_store(request, dt, reply, fingerprint, gen)
+            return reply
+        except SchedulerOutOfCapacityError:
+            return self._capacity_reply(request)
         except Exception as e:  # noqa: BLE001 — execution or serde error
             return self._error_reply(request, e)
 
@@ -144,17 +332,40 @@ class ServerInstance:
         request, err, deser_ms = self._deserialize(payload)
         if err is not None:
             return err
+        # the cache probe touches segment refcounts and hashes the
+        # request — off-loop, like the serde it replaces on a hit. But
+        # when the probe is a guaranteed no-op (traced query, or the
+        # cache is empty — e.g. all-consuming realtime tables never
+        # store) the cheap guards run inline: no per-query threadpool
+        # hop just to bounce off _cache_lookup's early returns
+        if request.enable_trace or len(self.result_cache) == 0:
+            fingerprint, cached, gen = self._cache_lookup(request)
+        else:
+            fingerprint, cached, gen = await loop.run_in_executor(
+                None, self._cache_lookup, request)
+        if cached is not None:
+            return cached          # bypasses admission AND scheduling
+        decision, busy, tenant = self._admit(request)
+        if busy is not None:
+            return busy
         try:
-            dt = await asyncio.wrap_future(self._schedule(request,
-                                                          deser_ms))
+            dt = await asyncio.wrap_future(self._schedule(
+                request, deser_ms,
+                admission_deadline_s=decision.deadline_s,
+                release_admission=True, tenant=tenant))
             if len(dt.rows) <= 128:
                 # small replies (aggregations, trimmed group-bys)
                 # serialize faster than an executor hop costs
-                return self._serialize(request, dt)
-            return await loop.run_in_executor(
-                None, self._serialize, request, dt)
+                reply = self._serialize(request, dt)
+            else:
+                reply = await loop.run_in_executor(
+                    None, self._serialize, request, dt)
+            self._maybe_cache_store(request, dt, reply, fingerprint, gen)
+            return reply
         except asyncio.CancelledError:
             raise
+        except SchedulerOutOfCapacityError:
+            return self._capacity_reply(request)
         except Exception as e:  # noqa: BLE001 — execution or serde error
             return self._error_reply(request, e)
 

@@ -92,6 +92,15 @@ class InstanceQueryExecutor:
         acquired, missing = tdm.acquire_segments(request.search_segments)
         try:
             segments = [s.segment for s in acquired]
+            # capture result-cache key states BEFORE execution: an
+            # upsert validDocIds bump mid-query would otherwise key
+            # pre-invalidation rows under the POST-bump version — a
+            # persistent lie every later identical query would hit.
+            # Keying under the pre-bump version is safe: versions only
+            # grow, so a probe can never construct the raced key again
+            # (the entry is at worst dead weight until evicted).
+            from pinot_tpu.server.result_cache import segment_cache_states
+            pre_states = None if missing else segment_cache_states(segments)
             from pinot_tpu.query.plan import preprocess_request
             # FASTHLL derived rewrite happens HERE, once, before the
             # per-segment fan-out: this request instance is private to
@@ -112,9 +121,19 @@ class InstanceQueryExecutor:
             block.stats.time_used_ms = elapsed_ms
             self.metrics.timer(ServerQueryPhase.QUERY_PROCESSING).update(
                 elapsed_ms)
+            # per-table twin: the admission controller's rolling
+            # service-time estimate (deadline-aware shedding) reads it
+            self.metrics.timer(ServerQueryPhase.QUERY_PROCESSING,
+                               table=query.table_name).update(elapsed_ms)
             trace.record(ServerQueryPhase.QUERY_PROCESSING, elapsed_ms)
             dt = DataTable.from_block(query, block)
             dt.metadata["requestId"] = str(request.request_id)
+            # frozen (name, crc, validDocIds-version) states of the
+            # segments this answer was computed over, captured at
+            # acquisition time above — the instance layer keys the
+            # result cache on them; None = uncacheable (mutable
+            # segment, missing CRC, or missing segments)
+            dt.cache_states = pre_states
             profile.finish_from_stats(block.stats)
             # the operator profile always travels (a handful of ints);
             # the broker folds it into rolling per-table stats

@@ -28,10 +28,13 @@ class EmbeddedCluster:
 
     def __init__(self, work_dir: str, num_servers: int = 2,
                  tcp: bool = False, mesh=None, scheduler: str = "fcfs",
-                 http: bool = False, store_dir: str = None):
+                 http: bool = False, store_dir: str = None,
+                 server_max_pending: int = None,
+                 cache_freshness_ms: float = None):
         """`store_dir`: persist cluster state (property-store WAL +
         snapshots) under this directory — a cluster rebuilt over the
         same work_dir/store_dir recovers its tables and segments."""
+        from pinot_tpu.broker.quota import QueryQuotaManager
         self.work_dir = work_dir
         self.controller = Controller(os.path.join(work_dir, "deepstore"),
                                      store_dir=store_dir)
@@ -39,7 +42,8 @@ class EmbeddedCluster:
         self.participants: Dict[str, ServerParticipant] = {}
         for i in range(num_servers):
             name = f"Server_{i}"
-            server = ServerInstance(name, scheduler=scheduler, mesh=mesh)
+            server = ServerInstance(name, scheduler=scheduler, mesh=mesh,
+                                    max_pending=server_max_pending)
             self.servers[name] = server
             participant = ServerParticipant(
                 server, self.controller.manager,
@@ -48,8 +52,12 @@ class EmbeddedCluster:
             self.participants[name] = participant
             self.controller.coordinator.register_participant(name,
                                                              participant)
+        # ONE quota manager shared by the watcher (which converges
+        # table-config quotas into it) and the broker (which enforces)
+        self.quota = QueryQuotaManager()
         self.watcher = BrokerClusterWatcher(self.controller.coordinator,
-                                            self.controller.manager)
+                                            self.controller.manager,
+                                            quota=self.quota)
         if tcp:
             endpoints = {name: ("127.0.0.1", server.start(port=0))
                          for name, server in self.servers.items()}
@@ -59,7 +67,13 @@ class EmbeddedCluster:
         self.broker = BrokerRequestHandler(
             self.watcher.routing, transport,
             time_boundary=self.watcher.time_boundary,
-            segment_pruner=self.watcher.partition_pruner)
+            quota=self.quota,
+            segment_pruner=self.watcher.partition_pruner,
+            cache_freshness_ms=cache_freshness_ms)
+        # segment lifecycle (upload/replace/drop) flushes the broker
+        # result cache — the freshness bound only covers consuming-
+        # ingestion staleness, not an offline backfill
+        self.watcher.register_result_cache(self.broker.result_cache)
         self.broker_api = None
         self.controller_api = None
         self.server_apis: Dict[str, object] = {}

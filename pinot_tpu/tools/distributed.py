@@ -15,6 +15,7 @@ distributed integration tests drive them in-process over real TCP.
 from __future__ import annotations
 
 import os
+import uuid
 from typing import Optional
 
 from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
@@ -26,7 +27,8 @@ from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.property_store import PropertyStore
 from pinot_tpu.controller.state_machine import (LIVE, ClusterCoordinator,
                                                 ViewComposer)
-from pinot_tpu.controller.store_client import RemotePropertyStore
+from pinot_tpu.controller.store_client import (RemotePropertyStore,
+                                               StoreClosedError)
 from pinot_tpu.controller.store_server import PropertyStoreServer
 from pinot_tpu.server.agent import ParticipantAgent
 from pinot_tpu.server.instance import ServerInstance
@@ -169,15 +171,37 @@ class DistributedBroker:
         manager = ResourceManager(coordinator, deep_store_dir,
                                   maintain_broker_resource=False)
         self.transport = TcpTransport({})
+        # live *_BROKER ids maintained from the watch stream so
+        # _num_live_brokers is O(1): it runs inside _apply_quota_config
+        # on EVERY external-view event, and a children+get-per-instance
+        # store scan there delayed routing updates long enough to turn
+        # reload-bounce windows into real misroutes
+        self._live_broker_ids: set = set()
         self._live_watcher = self._on_live
         self.store.watch(LIVE + "/", self._live_watcher)
         for inst in self.store.children(LIVE):
             self._on_live(f"{LIVE}/{inst}", self.store.get(f"{LIVE}/{inst}"))
-        self.watcher = BrokerClusterWatcher(coordinator, manager)
+        # quota convergence across brokers: the watcher re-reads table
+        # quotaConfig on every external-view change AND on every live-
+        # instance change (_on_live → reapply_quotas) and divides the
+        # cluster-wide rate by the number of live brokers (counted from
+        # the same ephemeral live-instance records that advertise HTTP
+        # endpoints), so a broker joining or dying rebalances every
+        # broker's share immediately, not on the next segment churn
+        from pinot_tpu.broker.quota import QueryQuotaManager
+        self.quota = QueryQuotaManager()
+        self.watcher = BrokerClusterWatcher(
+            coordinator, manager, quota=self.quota,
+            num_brokers_fn=self._num_live_brokers)
         self.handler = BrokerRequestHandler(
             self.watcher.routing, self.transport,
             time_boundary=self.watcher.time_boundary,
+            quota=self.quota,
             segment_pruner=self.watcher.partition_pruner)
+        # segment lifecycle (upload/replace/drop) flushes the broker
+        # result cache — the freshness bound only covers consuming-
+        # ingestion staleness, not an offline backfill
+        self.watcher.register_result_cache(self.handler.result_cache)
         self.http_api = None
         self.http_port: Optional[int] = None
         self.instance_id = instance_id
@@ -186,23 +210,73 @@ class DistributedBroker:
             from pinot_tpu.broker.http_api import BrokerApiServer
             self.http_api = BrokerApiServer(self.handler)
             self.http_port = self.http_api.start()
-            from pinot_tpu.controller.tenants import broker_tenant_tag
-            if self.instance_id is None:
-                self.instance_id = f"Broker_{host}_{self.http_port}"
-            # ephemeral: dies with this process's store session, so a
-            # killed broker drops out of every selector automatically
-            self.store.set(
-                f"{LIVE}/{self.instance_id}",
-                {"tags": [broker_tenant_tag(broker_tenant)],
-                 "host": host, "port": self.http_port},
-                ephemeral=True)
-            self._registered = True
+        # EVERY broker registers a live record, http or not: the
+        # per-broker quota share is cluster rate / live *_BROKER
+        # records, so an unregistered broker would be invisible to the
+        # division and the cluster would admit above the configured
+        # quota. Only HTTP brokers advertise an endpoint — selectors
+        # and the controller proxy filter on "host" in record.
+        from pinot_tpu.controller.tenants import broker_tenant_tag
+        if self.instance_id is None:
+            suffix = self.http_port if self.http_port is not None \
+                else uuid.uuid4().hex[:8]
+            self.instance_id = f"Broker_{host}_{suffix}"
+        record = {"tags": [broker_tenant_tag(broker_tenant)]}
+        if self.http_port is not None:
+            record["host"] = host
+            record["port"] = self.http_port
+        # ephemeral: dies with this process's store session, so a
+        # killed broker drops out of every selector automatically
+        self.store.set(f"{LIVE}/{self.instance_id}", record,
+                       ephemeral=True)
+        self._registered = True
+        # own-record watch delivery is async: count ourselves NOW and
+        # reconverge synchronously, or the queries admitted before the
+        # echo arrives would be admitted at rate/(N-1) — a 2-broker
+        # cluster would briefly admit 1.5x the configured quota
+        self._live_broker_ids.add(self.instance_id)
+        self.watcher.reapply_quotas()
 
     def _on_live(self, path: str, record: Optional[dict]) -> None:
         inst = path[len(LIVE) + 1:]
         if record is not None and "host" in record:
             self.transport.set_endpoint(inst, record["host"],
                                         record["port"])
+        # a removal record is tag-less, so discard unconditionally —
+        # only ids that once carried a _BROKER tag are ever present
+        changed = False
+        if record is None:
+            if inst in self._live_broker_ids:
+                self._live_broker_ids.discard(inst)
+                changed = True
+        elif any(str(t).endswith("_BROKER")
+                 for t in record.get("tags", ())):
+            if inst not in self._live_broker_ids:
+                self._live_broker_ids.add(inst)
+                changed = True
+        # BROKER membership changed: every broker's share of each table
+        # quota changes with the live broker count, and no external-
+        # view event fires for it. Server joins/deaths can't change the
+        # share — skipping them keeps a rolling server restart from
+        # hammering the watch-dispatch thread with per-table config
+        # re-reads (the thread routing updates ride on). getattr: the
+        # watch fires during __init__ before the watcher exists.
+        watcher = getattr(self, "watcher", None)
+        if watcher is not None and changed:
+            try:
+                watcher.reapply_quotas()
+            except StoreClosedError:
+                # session teardown: our own ephemeral removal (and any
+                # trailing events) dispatch after close — nothing to
+                # reconfigure on a dead session
+                pass
+
+    def _num_live_brokers(self) -> int:
+        """Live brokers = live-instance records carrying a *_BROKER
+        tenant tag (this broker's own record included). Served from the
+        watch-maintained set — NO store round-trips; this runs on the
+        hot view-event path."""
+        return max(1, len(self._live_broker_ids))
 
     def query(self, pql: str) -> BrokerResponse:
         return self.handler.handle(pql)

@@ -36,14 +36,20 @@ class HttpRequest:
 
 class HttpResponse:
     def __init__(self, status: int = 200, body: bytes = b"",
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json",
+                 headers: Optional[Dict[str, str]] = None):
         self.status = status
         self.body = body
         self.content_type = content_type
+        # extra response headers (e.g. Retry-After on 429)
+        self.headers = headers or {}
 
     @staticmethod
-    def of_json(obj, status: int = 200) -> "HttpResponse":
-        return HttpResponse(status, json.dumps(obj).encode("utf-8"))
+    def of_json(obj, status: int = 200,
+                headers: Optional[Dict[str, str]] = None
+                ) -> "HttpResponse":
+        return HttpResponse(status, json.dumps(obj).encode("utf-8"),
+                            headers=headers)
 
     @staticmethod
     def error(status: int, message: str) -> "HttpResponse":
@@ -69,7 +75,8 @@ class _PayloadTooLarge(Exception):
 
 _REASONS = {200: "OK", 204: "No Content", 400: "Bad Request",
             403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
-            413: "Payload Too Large", 500: "Internal Server Error"}
+            413: "Payload Too Large", 429: "Too Many Requests",
+            500: "Internal Server Error", 503: "Service Unavailable"}
 
 
 class HttpRouter:
@@ -223,6 +230,8 @@ class HttpServer:
     async def _write_response(self, writer: asyncio.StreamWriter,
                               response: HttpResponse, keep: bool) -> None:
         reason = _REASONS.get(response.status, "Unknown")
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in response.headers.items())
         head = (f"HTTP/1.1 {response.status} {reason}\r\n"
                 f"Content-Type: {response.content_type}\r\n"
                 f"Content-Length: {len(response.body)}\r\n"
@@ -230,6 +239,7 @@ class HttpServer:
                 "Access-Control-Allow-Methods: "
                 "GET, POST, DELETE, OPTIONS\r\n"
                 "Access-Control-Allow-Headers: Content-Type\r\n"
+                f"{extra}"
                 f"Connection: {'keep-alive' if keep else 'close'}\r\n"
                 "\r\n")
         writer.write(head.encode("latin-1") + response.body)

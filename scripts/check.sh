@@ -33,6 +33,14 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_upsert.py \
 # re-reads before the key-map snapshot offset
 env JAX_PLATFORMS=cpu python scripts/upsert_smoke.py
 
+echo "== tenant isolation (ingress control) =="
+# two-tenant overload gate: an aggressor flooding at 10x its per-tenant
+# token-bucket quota must be throttled with typed 429s while the victim
+# tenant sharing the table keeps its unloaded steady-state p99 (within
+# 1.5x + a CI-noise floor); quota/admission/result-cache unit suites
+# run in tier-1 above — this drives the stack end to end
+env JAX_PLATFORMS=cpu python scripts/tenant_isolation_smoke.py
+
 echo "== qps smoke (serving plane) =="
 # one short target-QPS rung over the real TCP mux: catches serving-plane
 # regressions (per-connection serialization, serde blow-ups) in seconds

@@ -1,0 +1,343 @@
+"""Tenant-isolation smoke gate: an aggressor flooding at 10x its QPS
+quota must be throttled/shed while a victim tenant sharing the SAME
+table keeps its unloaded latency profile.
+
+The run has three phases over a real 2-server cluster (TCP data plane):
+
+1. warm      — untagged queries populate plan/kernel caches;
+2. baseline  — the victim drives alone at its steady rate → p50/p99;
+3. overload  — the aggressor floods at 10x its per-tenant token-bucket
+   quota WHILE the victim keeps the same steady rate.
+
+Gates (the end-to-end isolation story of docs/ROBUSTNESS.md):
+
+- the aggressor sees a majority of its attempts rejected with typed
+  429s carrying Retry-After (broker ingress throttling works);
+- the victim is NEVER throttled and NEVER errors (isolation is
+  asymmetric: only the flooding tenant pays);
+- the victim's STEADY-STATE loaded p99 stays within 1.5x of its
+  unloaded baseline (small absolute grace floor on top — CI boxes are
+  noisy and a 2ms baseline would otherwise gate on sub-ms scheduler
+  jitter). Steady state excludes the first second of overload: the
+  aggressor's token bucket starts full by design (burst allowance), so
+  the flood's opening transient admits burst+refill; after that the
+  bucket holds it to the refill rate and the victim must not feel it.
+  The full-window p99 and the transient's size are reported in the
+  artifact, un-gated.
+
+A regression canary, not a benchmark: it catches a quota bypass, a
+check-after-hit relapse (throttled tenant never recovers), or a lost
+per-tenant scheduler-group mapping in seconds. The latency gate runs
+best-of-3 rounds (the CI box shares CPU with noisy neighbors and a
+single ~50-sample p99 can eat a stall that is nobody's tenant
+interference); the deterministic gates — throttle fraction, victim
+never throttled, no hard errors — must hold on EVERY round. Set
+ISOLATION_ARTIFACT to also write the QPS-style JSON artifact (the
+committed ISOLATION_r07.json at the repo root came from this script).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# rates are sized for a small CI box (the committed artifact ran on 2
+# cores): the box must stay under ITS saturation knee at the admitted
+# load, or GIL/scheduler contention — not tenant interference — owns
+# the tail and the gate measures the harness instead of the datastore
+ROWS = int(os.environ.get("ISOLATION_ROWS", 4000))
+SEGMENTS = int(os.environ.get("ISOLATION_SEGMENTS", 2))
+VICTIM_QPS = float(os.environ.get("ISOLATION_VICTIM_QPS", 10.0))
+VICTIM_QUOTA = float(os.environ.get("ISOLATION_VICTIM_QUOTA", 25.0))
+AGGRESSOR_QUOTA = float(os.environ.get("ISOLATION_AGGRESSOR_QUOTA", 5.0))
+OVERLOAD_FACTOR = 10.0            # the aggressor's offered/quota ratio
+BASE_S = float(os.environ.get("ISOLATION_BASE_S", 4.0))
+LOAD_S = float(os.environ.get("ISOLATION_LOAD_S", 5.0))
+P99_RATIO = 1.5                   # victim loaded p99 vs unloaded bound
+# absolute grace on top of the ratio, sized to shared-CI-box jitter:
+# with every steady-state query a ~5ms server cache hit, tens-of-ms
+# tail noise is harness scheduling, not tenant interference — while a
+# real isolation regression (e.g. losing the per-tenant scheduler
+# groups) measured 100ms+ victim tails, far past ratio+floor
+P99_FLOOR_MS = 30.0
+STEADY_AFTER_S = 1.0              # burst-transient exclusion window
+MIN_THROTTLE_FRACTION = 0.5       # expect ~0.9 at 10x overload
+# best-of-N rounds for the latency gate only (shared-CPU CI noise);
+# the deterministic gates must hold on every round
+MAX_ATTEMPTS = int(os.environ.get("ISOLATION_ATTEMPTS", 3))
+
+
+class TenantDriver:
+    """Open-loop fixed-schedule driver for ONE tenant tag; classifies
+    every reply as ok / throttled(429) / busy(503) / error."""
+
+    def __init__(self, query_fn, pql: str):
+        self.query_fn = query_fn
+        self.pql = pql
+        self.lat_ok_ms = []       # (seconds-into-run, latency-ms) pairs
+        self.ok = 0
+        self.throttled = 0
+        self.busy = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._t_start = 0.0
+
+    def _run_one(self) -> None:
+        t0 = time.perf_counter()
+        code = None
+        try:
+            resp = self.query_fn(self.pql)
+            exc = getattr(resp, "exceptions", None) or []
+            code = exc[0].get("errorCode") if exc else None
+        except Exception:  # noqa: BLE001 — an error IS the measurement
+            code = -1
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            if code is None:
+                self.ok += 1
+                self.lat_ok_ms.append((t0 - self._t_start, dt_ms))
+            elif code == 429:
+                self.throttled += 1
+            elif code == 503:
+                self.busy += 1
+            else:
+                self.errors += 1
+
+    def run(self, qps: float, duration_s: float,
+            num_threads: int = 8) -> None:
+        period = 1.0 / qps
+        slot = [0]
+        t_start = time.perf_counter()
+        self._t_start = t_start
+        stop = t_start + duration_s
+
+        def worker() -> None:
+            while True:
+                with self._lock:
+                    i = slot[0]
+                    slot[0] += 1
+                due = t_start + i * period
+                now = time.perf_counter()
+                if now >= stop or due >= stop:
+                    return
+                if due > now:
+                    time.sleep(due - now)
+                self._run_one()
+
+        ts = [threading.Thread(target=worker) for _ in range(num_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    def report(self, steady_after_s: float = 0.0) -> dict:
+        """Latency summary; with `steady_after_s`, also a steady-state
+        cut that excludes the flood's initial burst transient — the
+        aggressor's token bucket starts FULL (burst allowance is by
+        design), so the first second of overload admits burst+refill
+        and only after that is the flood held to its refill rate."""
+        lat = [l for _, l in self.lat_ok_ms]
+        a = np.asarray(lat) if lat else np.zeros(1)
+        attempts = self.ok + self.throttled + self.busy + self.errors
+        out = {
+            "attempts": attempts, "ok": self.ok,
+            "throttled429": self.throttled, "serverBusy503": self.busy,
+            "errors": self.errors,
+            "latencyP50Ms": round(float(np.percentile(a, 50)), 3),
+            "latencyP99Ms": round(float(np.percentile(a, 99)), 3),
+            "latencyMaxMs": round(float(a.max()), 3),
+        }
+        if steady_after_s > 0.0:
+            steady = [l for t, l in self.lat_ok_ms if t >= steady_after_s]
+            s = np.asarray(steady) if steady else np.zeros(1)
+            out["steady"] = {
+                "afterS": steady_after_s, "ok": len(steady),
+                "latencyP50Ms": round(float(np.percentile(s, 50)), 3),
+                "latencyP99Ms": round(float(np.percentile(s, 99)), 3),
+                "latencyMaxMs": round(float(s.max()), 3),
+            }
+        return out
+
+
+def main() -> int:
+    from pinot_tpu.common.table_config import (IndexingConfig, QuotaConfig,
+                                               TableConfig)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.tools.datagen import (SSB_RAW_COLS,
+                                         build_ssb_segment_dirs,
+                                         ssb_schema)
+
+    base = tempfile.mkdtemp()
+    dirs, _ids, _sc = build_ssb_segment_dirs(
+        os.path.join(base, "segs"), ROWS, SEGMENTS, seed=7)
+    # tokenbucket scheduler: the per-tenant TokenSchedulerGroup mapping
+    # is the CPU-isolation half of this gate — under FCFS the victim
+    # queues behind the aggressor's admitted burst and the p99 bound
+    # fails, which is exactly the regression this smoke exists to catch
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"),
+                              num_servers=2, tcp=True,
+                              scheduler="tokenbucket")
+    try:
+        cluster.add_schema(ssb_schema())
+        # per-tenant quotas ride the table config exactly as an operator
+        # would set them; the cluster watcher converges them into the
+        # broker's token buckets on the external-view change
+        config = TableConfig(
+            "lineorder",
+            indexing_config=IndexingConfig(
+                no_dictionary_columns=sorted(SSB_RAW_COLS)),
+            quota_config=QuotaConfig(
+                max_queries_per_second=VICTIM_QUOTA + AGGRESSOR_QUOTA),
+            custom_config={"tenantQuotas": json.dumps(
+                {"victim": VICTIM_QUOTA, "aggressor": AGGRESSOR_QUOTA})})
+        cluster.add_table(config)
+        for d in dirs:
+            cluster.upload_segment("lineorder_OFFLINE", d)
+
+        victim_pql = ("SELECT SUM(lo_revenue) FROM lineorder "
+                      "WHERE lo_quantity < 25 OPTION(workload=victim)")
+        aggressor_pql = ("SELECT COUNT(*) FROM lineorder "
+                         "OPTION(workload=aggressor)")
+
+        # phase 1: warm plan/kernel caches (untagged → table bucket
+        # only, which this run never saturates)
+        for pql in (victim_pql.replace(" OPTION(workload=victim)", ""),
+                    aggressor_pql.replace(" OPTION(workload=aggressor)",
+                                          "")):
+            for _ in range(3):
+                cluster.query(pql)
+
+        def measure():
+            # phase 2: victim alone → unloaded baseline
+            baseline = TenantDriver(cluster.query, victim_pql)
+            baseline.run(VICTIM_QPS, BASE_S, num_threads=2)
+            # phase 3: aggressor floods at 10x quota; victim keeps its
+            # rate (the idle baseline phase also let the aggressor's
+            # bucket refill to full burst, so every round replays the
+            # same burst-then-throttled flood shape)
+            victim = TenantDriver(cluster.query, victim_pql)
+            aggressor = TenantDriver(cluster.query, aggressor_pql)
+            vt = threading.Thread(target=victim.run,
+                                  args=(VICTIM_QPS, LOAD_S, 2))
+            at = threading.Thread(
+                target=aggressor.run,
+                args=(AGGRESSOR_QUOTA * OVERLOAD_FACTOR, LOAD_S, 4))
+            vt.start()
+            at.start()
+            vt.join()
+            at.join()
+            return (baseline.report(),
+                    victim.report(steady_after_s=STEADY_AFTER_S),
+                    aggressor.report())
+
+        # the latency gate runs under best-of-N (the box shares CPU
+        # with noisy neighbors and a single ~50-sample p99 can eat a
+        # 50ms stall that is nobody's tenant interference); the
+        # DETERMINISTIC gates — throttle fraction, victim never
+        # throttled, no hard errors — must hold on EVERY round
+        hard_fail = None
+        latency_fail = None
+        for attempt in range(MAX_ATTEMPTS):
+            base_rep, victim_rep, aggr_rep = measure()
+            frac = aggr_rep["throttled429"] / max(1, aggr_rep["attempts"])
+            if frac < MIN_THROTTLE_FRACTION:
+                hard_fail = (f"aggressor throttle fraction {frac:.2f} < "
+                             f"{MIN_THROTTLE_FRACTION}")
+                break
+            if victim_rep["throttled429"] or victim_rep["errors"]:
+                hard_fail = ("victim saw throttles/errors "
+                             f"({victim_rep['throttled429']}/"
+                             f"{victim_rep['errors']})")
+                break
+            if aggr_rep["errors"]:
+                hard_fail = (f"aggressor saw {aggr_rep['errors']} hard "
+                             "errors (throttling must be typed 429/503, "
+                             "not failures)")
+                break
+            # the gated latency metric is STEADY-STATE p99: once the
+            # aggressor's burst allowance is spent it is held to its
+            # refill rate, and from then on the victim must not feel
+            # the flood
+            steady_p99 = victim_rep["steady"]["latencyP99Ms"]
+            bound = max(P99_RATIO * base_rep["latencyP99Ms"],
+                        base_rep["latencyP99Ms"] + P99_FLOOR_MS)
+            if steady_p99 <= bound:
+                latency_fail = None
+                break
+            latency_fail = (
+                f"victim steady-state p99 {steady_p99:.1f}ms exceeds "
+                f"{bound:.1f}ms (baseline {base_rep['latencyP99Ms']:.1f}"
+                f"ms x {P99_RATIO} with {P99_FLOOR_MS}ms floor)")
+            print(f"round {attempt + 1}/{MAX_ATTEMPTS} missed the "
+                  f"latency bound ({latency_fail}); retrying",
+                  file=sys.stderr)
+
+        bm = cluster.broker.metrics
+        shed_by_server = {
+            name: srv.metrics.meter("requestsShed").count
+            for name, srv in cluster.servers.items()}
+        # repeats of an identical query over immutable segments land in
+        # the server CRC-exact result cache and bypass admission — the
+        # degradation valve absorbing most of the admitted flood
+        cache_by_server = {
+            name: srv.metrics.meter("resultCacheHits").count
+            for name, srv in cluster.servers.items()}
+        report = {
+            "rows": ROWS, "segments": SEGMENTS, "numServers": 2,
+            "quotas": {"victim": VICTIM_QUOTA,
+                       "aggressor": AGGRESSOR_QUOTA,
+                       "table": VICTIM_QUOTA + AGGRESSOR_QUOTA},
+            "victimQps": VICTIM_QPS,
+            "aggressorOfferedQps": AGGRESSOR_QUOTA * OVERLOAD_FACTOR,
+            "baselineS": BASE_S, "overloadS": LOAD_S,
+            "victimBaseline": base_rep,
+            "victimUnderOverload": victim_rep,
+            "aggressorUnderOverload": aggr_rep,
+            "victimP99Ratio": round(
+                victim_rep["latencyP99Ms"] /
+                max(base_rep["latencyP99Ms"], 1e-9), 3),
+            "victimSteadyP99Ratio": round(
+                victim_rep["steady"]["latencyP99Ms"] /
+                max(base_rep["latencyP99Ms"], 1e-9), 3),
+            "broker": {
+                "queriesDropped": bm.meter("queriesDropped").count,
+                "tenantQuotaDrops":
+                    bm.meter("queriesDropped", table="tenantQuota").count,
+                "serverBusyResponses":
+                    bm.meter("serverBusyResponses").count,
+            },
+            "serverRequestsShed": shed_by_server,
+            "serverResultCacheHits": cache_by_server,
+            "quotaState": cluster.quota.stats(),
+        }
+        print(json.dumps(report, indent=1))
+        artifact = os.environ.get("ISOLATION_ARTIFACT")
+        if artifact:
+            with open(artifact, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+
+        ok = True
+        if hard_fail is not None:
+            print(f"FAIL: {hard_fail}", file=sys.stderr)
+            ok = False
+        if latency_fail is not None:
+            print(f"FAIL (all {MAX_ATTEMPTS} rounds): {latency_fail}",
+                  file=sys.stderr)
+            ok = False
+        print("tenant isolation smoke: " + ("OK" if ok else "FAILED"))
+        return 0 if ok else 1
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,422 @@
+"""Server admission control + typed server-busy routing.
+
+Unit level: deterministic shed order (deadline [load-gated at the low
+watermark] → capacity → hedge → tenant fair-share → brownout) on an
+injectable clock, no wall-clock sleeps. Integration level: a shed request answers with the typed
+server-busy DataTable, the router fails over to a replica WITHOUT
+retrying the same server, and a cache hit bypasses admission entirely
+even when the server is saturated.
+"""
+import tempfile
+
+import pytest
+
+from fixtures import build_segment
+
+from pinot_tpu.broker import (BrokerRequestHandler, InProcessTransport,
+                              RoutingManager)
+from pinot_tpu.common.cluster_state import ONLINE, TableView
+from pinot_tpu.common.datatable import (DataTable, RESULT_CACHE_HIT_KEY,
+                                        RETRY_AFTER_MS_KEY,
+                                        SERVER_BUSY_EXC_PREFIX,
+                                        SERVER_BUSY_KEY)
+from pinot_tpu.common.metrics import MetricsRegistry, ServerMeter
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.serde import instance_request_to_bytes
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.server import ServerInstance
+from pinot_tpu.server.admission import (AdmissionController,
+                                        ServiceTimeEstimator,
+                                        busy_datatable)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _controller(max_pending=10, est_table=None, est_ms=None, **kw):
+    metrics = MetricsRegistry("server")
+    estimator = ServiceTimeEstimator(metrics)
+    if est_table is not None:
+        # seed the SAME per-table timer query_executor.py feeds after
+        # every execution — the estimator only reads it
+        from pinot_tpu.common.metrics import ServerQueryPhase
+        for _ in range(ServiceTimeEstimator.MIN_SAMPLES):
+            metrics.timer(ServerQueryPhase.QUERY_PROCESSING,
+                          table=est_table).update(est_ms)
+    return AdmissionController(metrics=metrics, estimator=estimator,
+                               max_pending=max_pending,
+                               clock=FakeClock(), **kw), metrics
+
+
+def _fill(ctrl, n, tenant="filler"):
+    for _ in range(n):
+        assert ctrl.admit("T", tenant)
+
+
+# ---------------------------------------------------------------------------
+# Shed order (deterministic, fake clock)
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_aware_shed_uses_service_estimate():
+    ctrl, _ = _controller(est_table="T", est_ms=100.0)   # low = 4
+    # IDLE server: below the low watermark nothing deadline-sheds —
+    # the p75 estimate is table-wide, so a cheap query class with a
+    # tight timeout would otherwise hard-fail (terminally, since the
+    # router never fails over a deadline shed) on an idle cluster;
+    # the executor's deadline truncation handles truly doomed work
+    assert ctrl.admit("T", "idle", budget_ms=50.0)
+    _fill(ctrl, 3)                                       # depth 4 = low
+    d = ctrl.admit("T", "a", budget_ms=50.0)
+    assert not d and d.cause == "deadline"
+    assert ctrl.admit("T", "a", budget_ms=200.0)
+    # a table with no estimate yet never deadline-sheds
+    assert ctrl.admit("U", "a", budget_ms=0.5)
+
+
+def test_hedges_shed_first_at_low_watermark():
+    ctrl, _ = _controller(max_pending=10)          # low = 4
+    _fill(ctrl, 3)
+    assert ctrl.admit("T", "a", hedge=True)        # below low: fine
+    d = ctrl.admit("T", "a", hedge=True)           # depth 4 >= low
+    assert not d and d.cause == "hedge"
+    assert ctrl.admit("T", "a", hedge=False)       # primaries still admit
+
+
+def test_over_quota_tenant_shed_at_mid_watermark():
+    ctrl, _ = _controller(max_pending=10)          # mid = 7
+    _fill(ctrl, 6, tenant="aggressor")
+    _fill(ctrl, 1, tenant="victim")                # depth 7, 2 active
+    d = ctrl.admit("T", "aggressor")               # 6 >= fair (7//2=3)
+    assert not d and d.cause == "tenantOverQuota"
+    assert d.retry_after_ms > 0
+    # the victim is under its fair share: admitted
+    assert ctrl.admit("T", "victim")
+
+
+def test_sole_tenant_never_fair_share_shed():
+    # fair-share protects OTHER tenants: with a single active tenant
+    # fair == depth == its own count, so the gate would shed EVERYTHING
+    # at mid and brownout/capacity could never engage — it must not fire
+    ctrl, _ = _controller(max_pending=10)          # mid = 7, high = 9
+    _fill(ctrl, 7, tenant="only")
+    d = ctrl.admit("T", "only")                    # depth 7 >= mid
+    assert d and not d.brownout
+
+
+def test_brownout_at_high_watermark_tightens_deadline():
+    ctrl, _ = _controller(max_pending=10, est_table="T",
+                          est_ms=40.0)             # high = 9
+    _fill(ctrl, 5, tenant="a")
+    _fill(ctrl, 4, tenant="b")                     # depth 9, fair split
+    d = ctrl.admit("T", "c", budget_ms=10_000.0)
+    assert d and d.brownout
+    # deadline ≈ now + est × factor, far tighter than the 10s budget
+    assert d.deadline_s == pytest.approx(
+        100.0 + 40.0 * AdmissionController.BROWNOUT_FACTOR / 1e3)
+
+
+def test_capacity_shed_at_max_pending():
+    ctrl, metrics = _controller(max_pending=4)
+    _fill(ctrl, 2, tenant="a")
+    _fill(ctrl, 2, tenant="b")
+    d = ctrl.admit("T", "c")
+    assert not d and d.cause == "capacity"
+    assert metrics.meter(ServerMeter.REQUESTS_SHED).count == 1
+    assert metrics.meter(ServerMeter.REQUESTS_SHED,
+                         table="capacity").count == 1
+
+
+def test_release_restores_depth_and_tenant_share():
+    ctrl, _ = _controller(max_pending=4)
+    _fill(ctrl, 2, tenant="a")
+    _fill(ctrl, 2, tenant="b")
+    assert not ctrl.admit("T", "c")
+    for _ in range(2):
+        ctrl.release("a")
+    assert ctrl.depth() == 2
+    assert ctrl.admit("T", "c")
+
+
+def test_estimator_never_registers_unknown_tables():
+    # admission runs before any table-existence check — probing the
+    # estimate must not create a per-table timer series, or a flood of
+    # random table names grows the registry without bound
+    ctrl, metrics = _controller(max_pending=100)
+    for i in range(50):
+        assert ctrl.admit(f"no-such-table-{i}", "a", budget_ms=1.0)
+    _, _, timers = metrics.metric_maps()
+    assert not any("no-such-table" in k for k in timers)
+
+
+def test_busy_datatable_is_typed():
+    dt = busy_datatable(7, "tenantOverQuota", 120.0)
+    assert dt.metadata[SERVER_BUSY_KEY] == "tenantOverQuota"
+    assert dt.metadata[RETRY_AFTER_MS_KEY] == "120"
+    assert dt.metadata["requestId"] == "7"
+    assert dt.exceptions[0].startswith(SERVER_BUSY_EXC_PREFIX)
+    # survives the wire round-trip the router reads it from
+    rt = DataTable.from_bytes(dt.to_bytes())
+    assert rt.metadata[SERVER_BUSY_KEY] == "tenantOverQuota"
+
+
+# ---------------------------------------------------------------------------
+# Instance integration: typed busy replies + cache bypass
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    s = ServerInstance("s0", max_pending=8)
+    seg, cols = build_segment(tempfile.mkdtemp(), n=800, seed=3,
+                              name="adm_0")
+    s.data_manager.table("baseballStats_OFFLINE",
+                         create=True).add_segment(seg)
+    yield s, cols
+    s.stop()
+
+
+def _request(pql, request_id=1, **kw):
+    return instance_request_to_bytes(InstanceRequest(
+        request_id=request_id, query=compile_pql(pql), **kw))
+
+
+def test_saturated_server_sheds_with_typed_reply(server):
+    s, _ = server
+    # saturate admission without real threads (distinct tenants so
+    # the fair-share gate doesn't fire before the capacity gate)
+    for i in range(s.admission.max_pending):
+        assert s.admission.admit("baseballStats_OFFLINE", f"x{i}")
+    reply = DataTable.from_bytes(s.handle_request_bytes(
+        _request("SELECT COUNT(*) FROM baseballStats_OFFLINE")))
+    assert reply.metadata.get(SERVER_BUSY_KEY) == "capacity"
+    assert reply.exceptions and \
+        reply.exceptions[0].startswith(SERVER_BUSY_EXC_PREFIX)
+
+
+def test_cache_hit_bypasses_saturated_admission(server):
+    s, cols = server
+    pql = "SELECT COUNT(*) FROM baseballStats_OFFLINE"
+    warm = DataTable.from_bytes(s.handle_request_bytes(_request(pql)))
+    assert not warm.exceptions
+    for i in range(s.admission.max_pending):
+        assert s.admission.admit("baseballStats_OFFLINE", f"x{i}")
+    hit = DataTable.from_bytes(s.handle_request_bytes(_request(pql, 2)))
+    assert hit.metadata.get(RESULT_CACHE_HIT_KEY) == "1"
+    assert hit.rows == warm.rows           # bit-identical result
+    # ...while an uncached query is still shed
+    other = DataTable.from_bytes(s.handle_request_bytes(
+        _request("SELECT SUM(runs) FROM baseballStats_OFFLINE", 3)))
+    assert other.metadata.get(SERVER_BUSY_KEY) == "capacity"
+
+
+def test_workload_tags_namespaced_and_bounded(server):
+    s, _ = server
+    q = compile_pql("SELECT COUNT(*) FROM baseballStats_OFFLINE")
+    untagged = InstanceRequest(request_id=1, query=q)
+    tagged = InstanceRequest(request_id=2, query=q, workload="alice")
+    spoof = InstanceRequest(request_id=3, query=q,
+                            workload="baseballStats_OFFLINE")
+    assert s._tenant(untagged) == "baseballStats_OFFLINE"
+    assert s._tenant(tagged) == "w:alice"
+    # OPTION(workload=<table name>) must NOT join untagged traffic's
+    # per-table scheduler group / fair-share bucket
+    assert s._tenant(spoof) != s._tenant(untagged)
+    # past the cap, unseen client-chosen tags fall back to the
+    # (config-bounded) table group instead of growing scheduler state
+    s._tenant_tags = {f"t{i}" for i in range(s.MAX_TENANT_TAGS - 1)} \
+        | {"alice"}
+    flood = InstanceRequest(request_id=4, query=q, workload="fresh-tag")
+    assert s._tenant(flood) == "baseballStats_OFFLINE"
+    assert s._tenant(tagged) == "w:alice"      # seen tags keep working
+
+
+def test_shed_requests_do_not_burn_tag_budget(server):
+    """A flood of unique workload tags that are ALL shed must not
+    consume permanent tag slots — otherwise 256 rejected requests
+    would lock every later tenant out of per-tenant isolation until
+    server restart. Slots commit only on admission."""
+    s, _ = server
+    for i in range(s.admission.max_pending):
+        assert s.admission.admit("baseballStats_OFFLINE", f"x{i}")
+    for i in range(20):
+        reply = DataTable.from_bytes(s.handle_request_bytes(_request(
+            "SELECT COUNT(*) FROM baseballStats_OFFLINE", 10 + i,
+            workload=f"flood-{i}")))
+        assert reply.metadata.get(SERVER_BUSY_KEY) == "capacity"
+    assert s._tenant_tags == set()          # nothing committed
+    for i in range(s.admission.max_pending):
+        s.admission.release(f"x{i}")
+    ok = DataTable.from_bytes(s.handle_request_bytes(_request(
+        "SELECT COUNT(*) FROM baseballStats_OFFLINE", 99,
+        workload="alice")))
+    assert not ok.exceptions
+    assert s._tenant_tags == {"alice"}      # admitted → slot committed
+
+
+def test_hedge_flag_travels_and_sheds_under_pressure(server):
+    s, _ = server
+    low = s.admission.low
+    for _ in range(low):
+        assert s.admission.admit("baseballStats_OFFLINE", "x")
+    reply = DataTable.from_bytes(s.handle_request_bytes(
+        _request("SELECT MAX(hits) FROM baseballStats_OFFLINE",
+                 hedge=True)))
+    assert reply.metadata.get(SERVER_BUSY_KEY) == "hedge"
+
+
+# ---------------------------------------------------------------------------
+# Router integration: busy is non-retriable-on-same-server
+# ---------------------------------------------------------------------------
+
+
+def _two_server_handler(tmpdir, busy_server=True):
+    servers = {}
+    view = TableView("baseballStats_OFFLINE", {})
+    seg_a, cols = build_segment(f"{tmpdir}/sa", n=900, seed=11,
+                                name="rb_0")
+    seg_b, _ = build_segment(f"{tmpdir}/sb", n=900, seed=11, name="rb_0")
+    # A sheds everything at the door (max_pending=0); B is healthy
+    servers["A"] = ServerInstance("A", max_pending=0 if busy_server
+                                  else 64)
+    servers["B"] = ServerInstance("B")
+    servers["A"].data_manager.table("baseballStats_OFFLINE",
+                                    create=True).add_segment(seg_a)
+    servers["B"].data_manager.table("baseballStats_OFFLINE",
+                                    create=True).add_segment(seg_b)
+    view.segment_states["rb_0"] = {"A": ONLINE, "B": ONLINE}
+    routing = RoutingManager()
+    routing.update_view(view)
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers))
+    return handler, servers, cols
+
+
+def test_busy_server_fails_over_to_replica_not_retried():
+    base = tempfile.mkdtemp()
+    handler, servers, cols = _two_server_handler(base)
+    try:
+        for _ in range(4):
+            resp = handler.handle(
+                "SELECT COUNT(*) FROM baseballStats_OFFLINE")
+            # wherever the primary landed, the answer is complete:
+            # either B answered directly, or A's shed failed over to B
+            assert not resp.exceptions, resp.exceptions
+            assert not resp.partial_response
+            assert int(resp.aggregation_results[0].value) == 900
+        # A executed NOTHING (every reaching request was shed pre-
+        # scheduler) and its breaker never opened — busy is not a fault
+        assert servers["A"].metrics.meter(ServerMeter.QUERIES).count == 0
+        assert handler.fault_tolerance.breaker_state("A") == 0
+    finally:
+        for s in servers.values():
+            s.stop()
+        handler.close()
+
+
+def test_deadline_shed_is_terminal_no_failover():
+    # a deadline-cause shed means the remaining budget is below the
+    # shedding server's service-time estimate for the table. The router
+    # surfaces it instead of dispatching failover waves (per-shed
+    # fan-out multiplies RPCs at the overload knee; a degraded-replica
+    # false shed is self-correcting via the on_busy health ding).
+    from pinot_tpu.common.metrics import BrokerMeter, ServerQueryPhase
+    base = tempfile.mkdtemp()
+    handler, servers, _ = _two_server_handler(base, busy_server=False)
+    try:
+        for s in servers.values():
+            timer = s.metrics.timer(ServerQueryPhase.QUERY_PROCESSING,
+                                    table="baseballStats_OFFLINE")
+            for _ in range(8):
+                timer.update(200.0)        # p75 est far above the budget
+            # deadline shedding only engages under load (>= low
+            # watermark): park admitted-never-released filler queries
+            # so the gate is active on BOTH replicas
+            for _ in range(s.admission.low):
+                assert s.admission.admit("baseballStats_OFFLINE", "bg")
+        resp = handler.handle("SELECT COUNT(*) FROM baseballStats_OFFLINE"
+                              " OPTION(timeoutMs=40)")
+        assert resp.partial_response
+        assert any(e.get("errorCode") == 503 for e in resp.exceptions)
+        assert "deadline" in str(resp.exceptions)
+        # no failover wave was dispatched for the doomed query
+        assert handler.metrics.meter(
+            BrokerMeter.SEGMENT_RETRIES).count == 0
+        # ...and the internal routing marker never leaks to the client
+        assert "busyCause" not in str(resp.exceptions)
+    finally:
+        for s in servers.values():
+            s.stop()
+        handler.close()
+
+
+def test_all_replicas_busy_surfaces_typed_503():
+    base = tempfile.mkdtemp()
+    servers = {}
+    seg, _ = build_segment(f"{base}/s", n=500, seed=5, name="lone_0")
+    servers["A"] = ServerInstance("A", max_pending=0)
+    servers["A"].data_manager.table("baseballStats_OFFLINE",
+                                    create=True).add_segment(seg)
+    routing = RoutingManager()
+    routing.update_view(TableView("baseballStats_OFFLINE",
+                                  {"lone_0": {"A": ONLINE}}))
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers))
+    try:
+        resp = handler.handle("SELECT COUNT(*) FROM baseballStats_OFFLINE")
+        assert resp.partial_response
+        codes = {e.get("errorCode") for e in resp.exceptions}
+        assert 503 in codes                 # typed server-busy, not 425
+        assert 425 not in codes
+        from pinot_tpu.common.metrics import BrokerMeter
+        assert handler.metrics.meter(
+            BrokerMeter.QUERIES_DROPPED, table="serverBusy").count == 1
+        # the whole query was lost to shedding: the reply carries a
+        # Retry-After so the HTTP layer can answer a real 503
+        assert resp.retry_after_s >= 1.0
+    finally:
+        servers["A"].stop()
+        handler.close()
+
+
+def test_http_maps_whole_query_shed_to_503_with_retry_after():
+    """A query FULLY lost to server-busy shedding must be a real HTTP
+    503 + Retry-After — clients keying backoff on status codes must
+    see overload, not a 200 that invites an instant retry."""
+    import asyncio
+
+    from pinot_tpu.broker.http_api import BrokerApiServer
+    from pinot_tpu.common.response import BrokerResponse
+
+    class _ShedHandler:
+        metrics = MetricsRegistry("broker")
+
+        def handle(self, pql, identity=None, force_trace=False):
+            resp = BrokerResponse()
+            resp.partial_response = True
+            resp.exceptions.append(
+                {"errorCode": 427, "message": "ServerNotRespondedError"})
+            resp.exceptions.append(
+                {"errorCode": 503,
+                 "message": "ServerQueryError: ServerBusyError: shed"})
+            resp.retry_after_s = 2.4     # what _finish sets on all-busy
+            return resp
+
+    api = BrokerApiServer(_ShedHandler())
+    out = asyncio.run(api._run_query("SELECT 1", None))
+    assert out.status == 503
+    assert out.headers["Retry-After"] == "3"   # ceil(2.4)
+    # a partial response that recovered data (no retry_after_s) stays 200
+    class _PartialHandler(_ShedHandler):
+        def handle(self, pql, identity=None, force_trace=False):
+            resp = BrokerResponse()
+            resp.partial_response = True
+            resp.exceptions.append(
+                {"errorCode": 503, "message": "one replica shed"})
+            return resp
+    out = asyncio.run(BrokerApiServer(_PartialHandler())
+                      ._run_query("SELECT 1", None))
+    assert out.status == 200

@@ -148,6 +148,31 @@ def test_server_death_drops_ephemerals_and_queries_survive(cluster):
     assert int(resp.aggregation_results[0].value) == oracle.count(m)
 
 
+def test_nonhttp_broker_registers_for_quota_division(cluster):
+    """Per-broker quota shares divide the cluster rate by the live
+    *_BROKER records — a broker without an HTTP API must still
+    register (tag-only, no endpoint) or the division under-counts and
+    the cluster admits above the configured quota."""
+    ctrl, servers, broker, oracle = cluster
+    rec = broker.store.get(f"/LIVEINSTANCES/{broker.instance_id}")
+    assert rec is not None and any(
+        str(t).endswith("_BROKER") for t in rec["tags"])
+    assert "host" not in rec        # no endpoint advertised to clients
+    assert broker._num_live_brokers() == 1
+    b2 = DistributedBroker("127.0.0.1", ctrl.store_port,
+                           ctrl.deep_store_dir)
+    try:
+        # the count is maintained from the live watch stream (O(1) on
+        # the hot view path), so join visibility is async
+        _await(lambda: broker._num_live_brokers() == 2,
+               msg="incumbent sees the joining broker")
+        assert b2._num_live_brokers() == 2   # self + watched incumbent
+    finally:
+        b2.stop()
+    _await(lambda: broker._num_live_brokers() == 1,
+           msg="graceful stop deregisters")
+
+
 def test_graceful_server_stop_deregisters(cluster):
     ctrl, servers, broker, oracle = cluster
     # runs last (module order): stop the remaining server gracefully

@@ -1,0 +1,471 @@
+"""Broker ingress quota: token-bucket semantics, injectable clock
+(no wall-clock sleeps anywhere here), per-tenant buckets, broker-count
+convergence, and the Retry-After surface.
+
+Parity targets: HelixExternalViewBasedQueryQuotaManager (per-table QPS
+from quotaConfig.maxQueriesPerSecond, divided across online brokers)
+with the token-bucket upgrade the overload PR introduces.
+"""
+import pytest
+
+from pinot_tpu.broker.quota import (HitCounter, QueryQuotaManager,
+                                    TokenBucket)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_burst_then_refill():
+    b = TokenBucket(rate=2.0, now_s=0.0)   # burst defaults to max(1, 2)
+    assert b.peek(0.0)
+    b.commit()
+    assert b.peek(0.0)
+    b.commit()
+    assert not b.peek(0.0)                 # burst spent
+    assert b.retry_after_s(0.0) == pytest.approx(0.5)
+    assert b.peek(0.6)                     # 0.6s x 2/s = 1.2 tokens
+    b.commit()
+    assert not b.peek(0.6)
+
+
+def test_bucket_fractional_rate_admits_one():
+    b = TokenBucket(rate=0.5, now_s=0.0)
+    assert b.burst == 1.0                  # never below one request
+    assert b.peek(0.0)
+    b.commit()
+    assert not b.peek(1.0)
+    assert b.peek(2.0)                     # one token back after 2s
+
+
+def test_bucket_reconfigure_preserves_tokens():
+    b = TokenBucket(rate=10.0, now_s=0.0)
+    for _ in range(8):
+        b.commit()
+    b.reconfigure(5.0, None)
+    assert b.rate == 5.0
+    assert b.tokens == pytest.approx(2.0)  # NOT a fresh burst
+    b.reconfigure(1.0, None)               # burst shrinks below tokens
+    assert b.tokens <= b.burst == 1.0
+
+
+def test_reconfigure_settles_idle_gap_at_old_rate():
+    # a quota raise after an idle stretch must not retroactively credit
+    # the whole gap at the NEW rate — that would hand the table the
+    # full fresh burst the instant the config lands
+    b = TokenBucket(rate=2.0, now_s=0.0)
+    for _ in range(2):
+        b.commit()                         # empty at t=0
+    b.reconfigure(100.0, None, now_s=100.0)
+    # the 100s gap was settled at the OLD rate (capped at old burst 2)
+    assert b.tokens == pytest.approx(2.0)
+    assert b.burst == 100.0 and b.peek(100.0)
+
+
+# ---------------------------------------------------------------------------
+# QueryQuotaManager — the satellite fix: exact-at-limit traffic is
+# stable and REJECTED requests consume nothing, so a throttled tenant
+# recovers as soon as its bucket refills.
+# ---------------------------------------------------------------------------
+
+
+def test_exact_at_limit_traffic_never_flaps():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 10.0)
+    # 10 QPS offered at exactly 10 QPS quota, for 5 seconds
+    rejected = 0
+    for _ in range(50):
+        clk.advance(0.1)
+        if not q.acquire("t"):
+            rejected += 1
+    assert rejected == 0
+
+
+def test_rejected_requests_consume_nothing():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 2.0)
+    assert q.acquire("t")
+    assert q.acquire("t")
+    # a flood of rejected attempts while throttled...
+    for _ in range(100):
+        assert not q.acquire("t")
+    # ...must not delay recovery: 1s at 2/s refills 2 full tokens
+    clk.advance(1.0)
+    assert q.acquire("t")
+    assert q.acquire("t")
+    assert not q.acquire("t")
+
+
+def test_acquire_injectable_now_ms_needs_no_sleeps():
+    q = QueryQuotaManager(clock=lambda: 0.0)
+    q.set_qps_quota("t", 1.0)
+    assert q.acquire("t", now_ms=0.0)
+    assert not q.acquire("t", now_ms=100.0)
+    assert q.acquire("t", now_ms=1100.0)   # 1.1s later: one token back
+
+
+def test_retry_after_from_refill_time():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 4.0)
+    for _ in range(4):
+        assert q.acquire("t")
+    d = q.acquire("t")
+    assert not d
+    assert d.cause == "tableQuota"
+    assert d.retry_after_s == pytest.approx(0.25)
+
+
+def test_unconfigured_table_always_admits():
+    q = QueryQuotaManager(clock=lambda: 0.0)
+    for _ in range(1000):
+        assert q.acquire("anything")
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant buckets
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_bucket_isolates_within_table():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 100.0)
+    q.set_tenant_qps_quota("t", "aggressor", 2.0)
+    for _ in range(2):
+        assert q.acquire("t", "aggressor")
+    d = q.acquire("t", "aggressor")
+    assert not d and d.cause == "tenantQuota"
+    # other tenants and untagged traffic ride the table bucket only
+    assert q.acquire("t", "victim")
+    assert q.acquire("t", None)
+
+
+def test_tenant_rejection_does_not_debit_table_bucket():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 3.0)
+    q.set_tenant_qps_quota("t", "a", 1.0)
+    assert q.acquire("t", "a")
+    for _ in range(10):
+        assert not q.acquire("t", "a")     # tenant-throttled
+    # the table bucket still has its remaining 2 tokens for others
+    assert q.acquire("t", "b")
+    assert q.acquire("t", "b")
+    assert not q.acquire("t", "b")
+
+
+def test_table_rejection_does_not_debit_tenant_bucket():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 1.0)
+    q.set_tenant_qps_quota("t", "a", 5.0)
+    assert q.acquire("t", "a")             # spends table's only token
+    d = q.acquire("t", "a")
+    assert not d and d.cause == "tableQuota"
+    # tenant bucket untouched by the table-level rejection: after the
+    # table refills, all remaining tenant tokens are still there
+    clk.advance(4.0)
+    assert q.acquire("t", "a")             # tenant 4 spent of 5... no:
+    clk.advance(60.0)                      # refill both fully
+    spent = 0
+    while q.acquire("t", "a") and spent < 20:
+        spent += 1
+        clk.advance(1.0)                   # table refills 1/s; tenant 5/s
+    assert spent >= 5
+
+
+# ---------------------------------------------------------------------------
+# Convergence across brokers (cluster-watcher path)
+# ---------------------------------------------------------------------------
+
+
+def test_configure_table_divides_by_broker_count():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.configure_table("t", 30.0, {"a": 9.0}, num_brokers=3)
+    stats = q.stats()["t"]
+    assert stats["maxQps"] == pytest.approx(10.0)
+    assert stats["tenants"]["a"]["maxQps"] == pytest.approx(3.0)
+
+
+def test_configure_table_removes_stale_tenants_and_quota():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.configure_table("t", 10.0, {"a": 5.0, "b": 5.0})
+    q.configure_table("t", None, {"a": 5.0})
+    stats = q.stats()["t"]
+    assert stats["maxQps"] is None         # table quota dropped
+    assert set(stats["tenants"]) == {"a"}
+    # and with the quota gone, traffic flows freely again
+    for _ in range(100):
+        assert q.acquire("t", "c")
+
+
+def test_reconfigure_same_rate_preserves_bucket_state():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.configure_table("t", 2.0, {})
+    assert q.acquire("t")
+    assert q.acquire("t")
+    assert not q.acquire("t")
+    # a view-change re-apply of the SAME config must not re-arm burst
+    q.configure_table("t", 2.0, {})
+    assert not q.acquire("t")
+
+
+# ---------------------------------------------------------------------------
+# HitCounter (observed offered load; injectable now_ms end to end)
+# ---------------------------------------------------------------------------
+
+
+def test_hit_counter_injectable_clock_window():
+    h = HitCounter()
+    for i in range(5):
+        h.hit(now_ms=10_000 + i * 100)
+    assert h.hits_in_window(now_ms=10_400) == 5
+    # a full window later they have all aged out
+    assert h.hits_in_window(now_ms=11_500) == 0
+
+
+def test_observed_qps_counts_rejected_attempts():
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 1.0)
+    for _ in range(7):
+        q.acquire("t")                     # 1 admitted + 6 rejected
+    assert q.observed_qps("t", now_ms=clk.t * 1e3) == 7
+
+
+# ---------------------------------------------------------------------------
+# Cluster-watcher convergence (table config → this broker's buckets)
+# ---------------------------------------------------------------------------
+
+
+class _StubCoordinator:
+    def watch_external_views(self, fn):
+        self.on_view = fn
+
+    def tables(self):
+        return []
+
+
+class _StubManager:
+    """One typed config (t_OFFLINE); the realtime side has none."""
+
+    def __init__(self, config):
+        self.config = config
+
+    def get_table_config(self, table):
+        return self.config if table == "t_OFFLINE" else None
+
+
+def _watcher_for(config, quota, num_brokers=1):
+    from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+    return BrokerClusterWatcher(
+        _StubCoordinator(), _StubManager(config), quota=quota,
+        num_brokers_fn=lambda: num_brokers)
+
+
+def test_watcher_converges_quota_and_tenants_from_table_config():
+    import json as _json
+
+    from pinot_tpu.common.table_config import QuotaConfig, TableConfig
+
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    config = TableConfig(
+        "t", quota_config=QuotaConfig(max_queries_per_second=30.0),
+        custom_config={"tenantQuotas": _json.dumps({"a": 9.0})})
+    w = _watcher_for(config, q, num_brokers=3)
+    w._apply_quota_config("t_OFFLINE")
+    stats = q.stats()["t"]
+    # cluster-wide 30 qps over 3 live brokers → 10 here; tenant 9 → 3
+    assert stats["maxQps"] == pytest.approx(10.0)
+    assert stats["tenants"]["a"]["maxQps"] == pytest.approx(3.0)
+
+
+def test_watcher_malformed_tenant_quotas_fail_open():
+    from pinot_tpu.common.table_config import QuotaConfig, TableConfig
+
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    config = TableConfig(
+        "t", quota_config=QuotaConfig(max_queries_per_second=10.0),
+        custom_config={"tenantQuotas": "{not json"})
+    w = _watcher_for(config, q)
+    w._apply_quota_config("t_OFFLINE")
+    stats = q.stats()["t"]
+    assert stats["maxQps"] == pytest.approx(10.0)
+    assert stats["tenants"] == {}          # malformed → no tenant limit
+    assert q.acquire("t", "anyone")
+
+
+def test_watcher_no_quota_config_leaves_table_unlimited():
+    from pinot_tpu.common.table_config import TableConfig
+
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    w = _watcher_for(TableConfig("t"), q)
+    w._apply_quota_config("t_OFFLINE")
+    assert "t" not in q.stats()
+    for _ in range(100):
+        assert q.acquire("t", "anyone")
+
+
+def test_zero_rate_quota_rejects_with_finite_retry_after():
+    """maxQueriesPerSecond=0 blocks a table: after the single burst
+    token, every acquire rejects with a FINITE Retry-After (inf would
+    break the JSON body and the HTTP header's ceil)."""
+    import math
+
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    q.set_qps_quota("t", 0.0)
+    assert q.acquire("t")              # burst floor admits one
+    d = q.acquire("t")
+    assert not d
+    assert math.isfinite(d.retry_after_s) and d.retry_after_s > 0
+
+
+def test_observed_qps_uses_manager_clock_not_wall_clock():
+    """acquire() stamps offered-load hits on the manager's clock;
+    observed_qps must read the window on the SAME clock — with the
+    default monotonic clock a wall-clock read would see every hit as
+    ancient and always report 0."""
+    q = QueryQuotaManager()            # default clock: time.monotonic
+    q.set_qps_quota("t", 100.0)
+    for _ in range(5):
+        q.acquire("t")
+    assert q.observed_qps("t") == 5
+    assert q.stats()["t"]["observedQps"] == 5
+
+
+def test_broker_membership_change_redivides_quota_shares():
+    """A broker joining or dying changes every broker's share of each
+    table quota but fires NO external-view event — reapply_quotas (the
+    live-instance hook) must re-divide by the current count."""
+    from pinot_tpu.common.table_config import QuotaConfig, TableConfig
+
+    count = [1]
+    config = TableConfig(
+        "t", quota_config=QuotaConfig(max_queries_per_second=100.0))
+    from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+
+    class _Coord(_StubCoordinator):
+        def tables(self):
+            return ["t_OFFLINE"]
+
+        def external_view(self, table):
+            from pinot_tpu.common.cluster_state import TableView
+            return TableView(table, {})
+
+    q = QueryQuotaManager(clock=FakeClock())
+    w = BrokerClusterWatcher(_Coord(), _StubManager(config), quota=q,
+                             num_brokers_fn=lambda: count[0])
+    w._apply_quota_config("t_OFFLINE")
+    assert q.stats()["t"]["maxQps"] == pytest.approx(100.0)
+    count[0] = 2                           # a second broker joined
+    w.reapply_quotas()
+    assert q.stats()["t"]["maxQps"] == pytest.approx(50.0)
+    count[0] = 1                           # ...and died again
+    w.reapply_quotas()
+    assert q.stats()["t"]["maxQps"] == pytest.approx(100.0)
+
+
+def test_workload_tag_gated_by_access_control():
+    """An explicit OPTION(workload=...) spends THAT tenant's quota and
+    joins its scheduler group — the ACL's allow_workload hook can bind
+    tags to authenticated principals (default: allow, cooperative)."""
+    import tempfile as _tempfile
+
+    from fixtures import build_segment
+    from pinot_tpu.broker import (BrokerRequestHandler,
+                                  InProcessTransport, RoutingManager)
+    from pinot_tpu.broker.access_control import AllowAllAccessControl
+    from pinot_tpu.common.cluster_state import ONLINE, TableView
+    from pinot_tpu.server import ServerInstance
+
+    class OwnTagOnly(AllowAllAccessControl):
+        def allow_workload(self, identity, workload):
+            return workload == "alice"
+
+    servers = {"S": ServerInstance("S")}
+    seg, _ = build_segment(_tempfile.mkdtemp(), n=300, seed=31,
+                           name="acl_0")
+    servers["S"].data_manager.table("baseballStats_OFFLINE",
+                                    create=True).add_segment(seg)
+    routing = RoutingManager()
+    routing.update_view(TableView("baseballStats_OFFLINE",
+                                  {"acl_0": {"S": ONLINE}}))
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers),
+                                   access_control=OwnTagOnly())
+    try:
+        ok = handler.handle("SELECT COUNT(*) FROM baseballStats "
+                            "OPTION(workload=alice)")
+        assert not ok.exceptions
+        denied = handler.handle("SELECT COUNT(*) FROM baseballStats "
+                                "OPTION(workload=victim)")
+        assert denied.exceptions[0]["errorCode"] == 180
+        assert "workload" in denied.exceptions[0]["message"]
+    finally:
+        servers["S"].stop()
+        handler.close()
+
+
+def test_watcher_hybrid_types_merge_not_clobber():
+    """A hybrid table's quota lives on whichever typed config defines
+    it; a view change on the OTHER type must not clobber it (and when
+    both types define quotas, the raw-table bucket gets the sum)."""
+    import json as _json
+
+    from pinot_tpu.common.table_config import (QuotaConfig, TableConfig,
+                                               TableType)
+
+    class _Mgr:
+        def __init__(self, configs):
+            self.configs = configs
+
+        def get_table_config(self, table):
+            return self.configs.get(table)
+
+    from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+    clk = FakeClock()
+    q = QueryQuotaManager(clock=clk)
+    configs = {
+        "t_OFFLINE": TableConfig(
+            "t", quota_config=QuotaConfig(max_queries_per_second=30.0),
+            custom_config={"tenantQuotas": _json.dumps({"a": 9.0})}),
+        "t_REALTIME": TableConfig("t", table_type=TableType.REALTIME),
+    }
+    w = BrokerClusterWatcher(_StubCoordinator(), _Mgr(configs), quota=q,
+                             num_brokers_fn=lambda: 1)
+    # the REALTIME view change (no quotaConfig on that side) converges
+    # the MERGED config — the offline quota survives
+    w._apply_quota_config("t_REALTIME")
+    stats = q.stats()["t"]
+    assert stats["maxQps"] == pytest.approx(30.0)
+    assert stats["tenants"]["a"]["maxQps"] == pytest.approx(9.0)
+    # both sides defining quotas: allowances sum at the raw bucket
+    configs["t_REALTIME"] = TableConfig(
+        "t", table_type=TableType.REALTIME,
+        quota_config=QuotaConfig(max_queries_per_second=10.0),
+        custom_config={"tenantQuotas": _json.dumps({"a": 1.0})})
+    w._apply_quota_config("t_OFFLINE")
+    stats = q.stats()["t"]
+    assert stats["maxQps"] == pytest.approx(40.0)
+    assert stats["tenants"]["a"]["maxQps"] == pytest.approx(10.0)

@@ -1,0 +1,486 @@
+"""CRC-exact result caching: server per-(segment CRC, fingerprint)
+cache + broker freshness-bounded cache.
+
+The exactness contract under test: a cached answer is BIT-IDENTICAL to
+the uncached answer on every execution path (host numpy, device scan
+kernels, mesh-sharded), and every way the underlying data can change
+— new segment CRC, upsert validDocIds version bump, segment
+replacement — makes the stale entry unreachable.
+"""
+import tempfile
+
+import pytest
+
+from fixtures import build_segment
+
+from pinot_tpu.broker.result_cache import BrokerResultCache
+from pinot_tpu.common.datatable import DataTable, RESULT_CACHE_HIT_KEY
+from pinot_tpu.common.metrics import ServerMeter
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.response import BrokerResponse
+from pinot_tpu.common.serde import instance_request_to_bytes
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.fingerprint import query_fingerprint
+from pinot_tpu.server import ServerInstance
+from pinot_tpu.server.result_cache import segment_cache_states
+
+QUERIES = [
+    "SELECT COUNT(*) FROM baseballStats_OFFLINE",
+    "SELECT SUM(hits), AVG(average) FROM baseballStats_OFFLINE "
+    "WHERE league = 'NL'",
+    "SELECT SUM(salary) FROM baseballStats_OFFLINE GROUP BY teamID TOP 50",
+    "SELECT runs, hits FROM baseballStats_OFFLINE "
+    "ORDER BY hits DESC LIMIT 7",
+]
+
+
+def _request(pql, request_id=1, **kw):
+    return instance_request_to_bytes(InstanceRequest(
+        request_id=request_id, query=compile_pql(pql), **kw))
+
+
+def _payload_of(dt: DataTable):
+    """The result payload, metadata that may legitimately differ on a
+    cache hit (requestId, cache marker, timings) excluded."""
+    meta = {k: v for k, v in dt.metadata.items()
+            if k not in ("requestId", RESULT_CACHE_HIT_KEY, "timeUsedMs",
+                         "profileInfo")}
+    return dt.kind, dt.columns, dt.rows, meta, dt.exceptions
+
+
+def _server(mesh=None, use_device=True, num_segments=2):
+    s = ServerInstance("cache0", mesh=mesh, use_device=use_device)
+    for i in range(num_segments):
+        seg, _ = build_segment(tempfile.mkdtemp(), n=700, seed=40 + i,
+                               name=f"rc_{i}")
+        s.data_manager.table("baseballStats_OFFLINE",
+                             create=True).add_segment(seg)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint canonicalization
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_merges_only_equivalent_queries():
+    a = compile_pql("SELECT COUNT(*) FROM t WHERE x IN ('b', 'a') "
+                    "AND y = '1'")
+    b = compile_pql("SELECT COUNT(*) FROM t WHERE y = '1' "
+                    "AND x IN ('a', 'b')")
+    assert query_fingerprint(a) == query_fingerprint(b)
+    c = compile_pql("SELECT COUNT(*) FROM t WHERE x IN ('a', 'c') "
+                    "AND y = '1'")
+    assert query_fingerprint(a) != query_fingerprint(c)
+    # trace/timeout shape metadata, not results: same fingerprint
+    d = compile_pql("SELECT COUNT(*) FROM t WHERE x IN ('a', 'b') "
+                    "AND y = '1' OPTION(trace=true, timeoutMs=50)")
+    assert query_fingerprint(a) == query_fingerprint(d)
+    # a different table is a different result space
+    e = compile_pql("SELECT COUNT(*) FROM u WHERE x IN ('a', 'b') "
+                    "AND y = '1'")
+    assert query_fingerprint(a) != query_fingerprint(e)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identical cached results on every execution path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("path", ["host", "device", "sharded"])
+def test_cached_equals_uncached_bitwise(path):
+    if path == "sharded":
+        from pinot_tpu.parallel.sharded import make_mesh
+        s = _server(mesh=make_mesh())
+    else:
+        s = _server(use_device=(path == "device"))
+    try:
+        for i, pql in enumerate(QUERIES):
+            cold = DataTable.from_bytes(
+                s.handle_request_bytes(_request(pql, 10 + i)))
+            assert not cold.exceptions, (pql, cold.exceptions)
+            warm = DataTable.from_bytes(
+                s.handle_request_bytes(_request(pql, 100 + i)))
+            assert warm.metadata.get(RESULT_CACHE_HIT_KEY) == "1", pql
+            assert _payload_of(warm) == _payload_of(cold), pql
+        assert s.metrics.meter(ServerMeter.RESULT_CACHE_HITS).count == \
+            len(QUERIES)
+    finally:
+        s.stop()
+
+
+def test_trace_and_errors_never_cached():
+    s = _server()
+    try:
+        pql = QUERIES[0]
+        traced = DataTable.from_bytes(s.handle_request_bytes(
+            _request(pql, 1, enable_trace=True)))
+        assert "traceInfo" in traced.metadata
+        # the traced run neither stored nor read the cache
+        assert s.result_cache.stats()["entries"] == 0
+        again = DataTable.from_bytes(s.handle_request_bytes(
+            _request(pql, 2, enable_trace=True)))
+        assert RESULT_CACHE_HIT_KEY not in again.metadata
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Invalidation: new CRC, vdoc version bump, segment replacement
+# ---------------------------------------------------------------------------
+
+
+def test_cache_states_key_on_crc_and_vdoc_version():
+    d1, d2 = tempfile.mkdtemp(), tempfile.mkdtemp()
+    seg1, _ = build_segment(d1, n=300, seed=1, name="k_0")
+    seg2, _ = build_segment(d2, n=300, seed=2, name="k_0")  # same name!
+    s1 = segment_cache_states([seg1])
+    s2 = segment_cache_states([seg2])
+    assert s1 is not None and s2 is not None
+    assert s1 != s2                         # different content → new CRC
+    # a validDocIds version bump changes the key too
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    seg1.valid_doc_ids = ValidDocIds()
+    before = segment_cache_states([seg1])
+    assert seg1.valid_doc_ids.invalidate(5)
+    after = segment_cache_states([seg1])
+    assert before != after
+    # mutable / CRC-less segments are uncacheable
+    class FakeMutable:
+        is_mutable = True
+        segment_name = "m"
+    assert segment_cache_states([seg1, FakeMutable()]) is None
+
+
+def test_upsert_vdoc_bump_invalidates_end_to_end():
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    s = ServerInstance("vd0")
+    d = tempfile.mkdtemp()
+    seg, _ = build_segment(d, n=400, seed=9, name="vd_0")
+    seg.valid_doc_ids = ValidDocIds()
+    s.data_manager.table("baseballStats_OFFLINE",
+                         create=True).add_segment(seg)
+    try:
+        pql = "SELECT COUNT(*) FROM baseballStats_OFFLINE"
+        full = DataTable.from_bytes(s.handle_request_bytes(_request(pql)))
+        assert full.rows[0][0] == 400
+        hit = DataTable.from_bytes(s.handle_request_bytes(_request(pql, 2)))
+        assert hit.metadata.get(RESULT_CACHE_HIT_KEY) == "1"
+        # two rows get superseded → version bump → the stale 400 must
+        # be unreachable
+        seg.valid_doc_ids.invalidate(0)
+        seg.valid_doc_ids.invalidate(1)
+        masked = DataTable.from_bytes(
+            s.handle_request_bytes(_request(pql, 3)))
+        assert RESULT_CACHE_HIT_KEY not in masked.metadata
+        assert masked.rows[0][0] == 398
+        # and the masked result caches under ITS OWN key
+        again = DataTable.from_bytes(s.handle_request_bytes(
+            _request(pql, 4)))
+        assert again.metadata.get(RESULT_CACHE_HIT_KEY) == "1"
+        assert again.rows[0][0] == 398
+    finally:
+        s.stop()
+
+
+def test_segment_replacement_invalidates_end_to_end():
+    s = ServerInstance("cr0")
+    d1, d2 = tempfile.mkdtemp(), tempfile.mkdtemp()
+    seg1, _ = build_segment(d1, n=250, seed=1, name="swap_0")
+    seg2, _ = build_segment(d2, n=350, seed=2, name="swap_0")
+    tdm = s.data_manager.table("baseballStats_OFFLINE", create=True)
+    tdm.add_segment(seg1)
+    try:
+        pql = "SELECT COUNT(*) FROM baseballStats_OFFLINE"
+        first = DataTable.from_bytes(s.handle_request_bytes(_request(pql)))
+        assert first.rows[0][0] == 250
+        assert DataTable.from_bytes(
+            s.handle_request_bytes(_request(pql, 2))).metadata.get(
+                RESULT_CACHE_HIT_KEY) == "1"
+        tdm.add_segment(seg2)            # same name, new CRC
+        fresh = DataTable.from_bytes(s.handle_request_bytes(
+            _request(pql, 3)))
+        assert RESULT_CACHE_HIT_KEY not in fresh.metadata
+        assert fresh.rows[0][0] == 350
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Broker-level freshness-bounded cache (hybrid tables)
+# ---------------------------------------------------------------------------
+
+
+class FakeBrokerClock:
+    def __init__(self):
+        self.t = 50.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_broker_cache_freshness_bound_hit_and_miss():
+    clk = FakeBrokerClock()
+    cache = BrokerResultCache(clock=clk)
+    resp = BrokerResponse(total_docs=10)
+    resp.min_consuming_freshness_time_ms = 123456
+    cache.put("fp", resp)
+    clk.t += 0.2                            # 200ms later
+    hit = cache.get("fp", max_age_ms=1000)
+    assert hit is not None and hit.total_docs == 10
+    # the absolute freshness timestamp travels unchanged
+    assert hit.min_consuming_freshness_time_ms == 123456
+    # a tighter bound on the SAME entry: miss, entry retained
+    assert cache.get("fp", max_age_ms=100) is None
+    assert cache.get("fp", max_age_ms=1000) is not None
+    # hits are isolated copies: mutating one never corrupts the entry
+    hit.exceptions.append({"boom": 1})
+    assert not cache.get("fp", max_age_ms=1000).exceptions
+
+
+def test_broker_cache_refuses_partial_and_excepted():
+    cache = BrokerResultCache(clock=lambda: 0.0)
+    partial = BrokerResponse(partial_response=True)
+    cache.put("a", partial)
+    excepted = BrokerResponse(exceptions=[{"errorCode": 425}])
+    cache.put("b", excepted)
+    assert cache.stats()["entries"] == 0
+
+
+def test_broker_cache_end_to_end_hybrid_gate():
+    """Handler-level: only tables with a realtime part are broker-
+    cached, under the minConsumingFreshnessTimeMs bound."""
+    from pinot_tpu.broker import (BrokerRequestHandler,
+                                  InProcessTransport, RoutingManager)
+    from pinot_tpu.common.cluster_state import ONLINE, TableView
+    from pinot_tpu.common.metrics import BrokerMeter
+
+    servers = {"S": ServerInstance("S")}
+    seg, _ = build_segment(tempfile.mkdtemp(), n=600, seed=21,
+                           name="rt_0")
+    servers["S"].data_manager.table("baseballStats_REALTIME",
+                                    create=True).add_segment(seg)
+    routing = RoutingManager()
+    routing.update_view(TableView("baseballStats_REALTIME",
+                                  {"rt_0": {"S": ONLINE}}))
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers),
+                                   cache_freshness_ms=60_000.0)
+    try:
+        pql = "SELECT SUM(runs) FROM baseballStats"
+        cold = handler.handle(pql)
+        assert not cold.exceptions
+        warm = handler.handle(pql)
+        assert handler.metrics.meter(
+            BrokerMeter.RESULT_CACHE_HITS).count == 1
+        assert warm.aggregation_results[0].value == \
+            cold.aggregation_results[0].value
+        # an explicit zero freshness bound refuses any cached entry
+        strict = handler.handle(
+            "SELECT SUM(runs) FROM baseballStats "
+            "OPTION(minConsumingFreshnessTimeMs=0)")
+        assert strict.aggregation_results[0].value == \
+            cold.aggregation_results[0].value
+        assert handler.metrics.meter(
+            BrokerMeter.RESULT_CACHE_HITS).count == 1   # still one hit
+    finally:
+        servers["S"].stop()
+        handler.close()
+
+
+def test_segment_swap_clear_wins_over_inflight_store():
+    """A segment swap clears the cache; an execution that was already
+    in flight over the PRE-swap segment must not re-insert its stale
+    bytes afterwards — a same-CRC reload (evolved schema) constructs
+    the identical key forever, so the raced entry would never age out."""
+    from pinot_tpu.server.result_cache import ServerResultCache
+    c = ServerResultCache()
+    key = ("t", "fp", (("s", "crc", -1),))
+    gen = c.generation             # captured before "execution"
+    c.clear()                      # the swap races the running query
+    c.put(key, b"stale", gen=gen)
+    assert c.get(key) is None      # stale insert dropped
+    c.put(key, b"fresh", gen=c.generation)
+    assert c.get(key) == b"fresh"
+    c.clear()
+    c.put(key, b"ungenned")        # gen-less puts still work
+    assert c.get(key) == b"ungenned"
+
+
+def test_server_cache_hits_do_not_refold_profiles():
+    """A server cache hit replays the ORIGINAL execution's profileInfo
+    bytes; the broker must not fold that phantom copy into the rolling
+    per-table operator stats on every hit."""
+    from pinot_tpu.broker import (BrokerRequestHandler,
+                                  InProcessTransport, RoutingManager)
+    from pinot_tpu.common.cluster_state import ONLINE, TableView
+
+    servers = {"S": ServerInstance("S")}
+    seg, _ = build_segment(tempfile.mkdtemp(), n=600, seed=29,
+                           name="off_0")
+    servers["S"].data_manager.table("baseballStats_OFFLINE",
+                                    create=True).add_segment(seg)
+    routing = RoutingManager()
+    routing.update_view(TableView("baseballStats_OFFLINE",
+                                  {"off_0": {"S": ONLINE}}))
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers))
+    try:
+        pql = "SELECT SUM(runs) FROM baseballStats"
+        cold = handler.handle(pql)
+        assert not cold.exceptions
+        for _ in range(3):                  # server-side cache hits
+            assert not handler.handle(pql).exceptions
+        assert servers["S"].metrics.meter(
+            ServerMeter.RESULT_CACHE_HITS).count == 3
+        stats = handler.table_stats.snapshot("baseballStats")
+        # only the real execution was folded; 3 hits of ~0 server work
+        # added no phantom operator timings
+        assert stats["queries"] == 1
+    finally:
+        servers["S"].stop()
+        handler.close()
+
+
+def test_broker_cache_bypassed_for_traced_queries():
+    """A trace=true query must not be served a cached reply (it would
+    carry no spans) and must not overwrite the cache either."""
+    from pinot_tpu.broker import (BrokerRequestHandler,
+                                  InProcessTransport, RoutingManager)
+    from pinot_tpu.common.cluster_state import ONLINE, TableView
+    from pinot_tpu.common.metrics import BrokerMeter
+
+    servers = {"S": ServerInstance("S")}
+    seg, _ = build_segment(tempfile.mkdtemp(), n=600, seed=23,
+                           name="rt_0")
+    servers["S"].data_manager.table("baseballStats_REALTIME",
+                                    create=True).add_segment(seg)
+    routing = RoutingManager()
+    routing.update_view(TableView("baseballStats_REALTIME",
+                                  {"rt_0": {"S": ONLINE}}))
+    handler = BrokerRequestHandler(routing, InProcessTransport(servers),
+                                   cache_freshness_ms=60_000.0)
+    try:
+        pql = "SELECT SUM(runs) FROM baseballStats"
+        cold = handler.handle(pql)          # populates the cache
+        assert not cold.exceptions
+        traced = handler.handle(pql + " OPTION(trace=true)")
+        # not a cache hit: the traced execution really ran and returned
+        # its trace tree
+        assert handler.metrics.meter(
+            BrokerMeter.RESULT_CACHE_HITS).count == 0
+        assert traced.trace_tree
+        assert traced.aggregation_results[0].value == \
+            cold.aggregation_results[0].value
+        # the untraced entry is still served afterwards
+        warm = handler.handle(pql)
+        assert handler.metrics.meter(
+            BrokerMeter.RESULT_CACHE_HITS).count == 1
+        assert not warm.trace_tree
+    finally:
+        servers["S"].stop()
+        handler.close()
+
+
+def test_broker_cache_size_cap_refuses_large_payloads():
+    """MB-scale selections never cache: they are poor cache citizens
+    (memory) and their defensive put-copies taxed every complete
+    query on the reduce path."""
+    from pinot_tpu.common.response import SelectionResults
+
+    cache = BrokerResultCache(max_cells=100)
+    big = BrokerResponse(selection_results=SelectionResults(
+        columns=["a", "b"], results=[[1, 2]] * 51))       # 102 cells
+    cache.put("big", big)
+    assert cache.get("big", max_age_ms=1e9) is None
+    small = BrokerResponse(selection_results=SelectionResults(
+        columns=["a", "b"], results=[[1, 2]] * 50))       # 100 cells
+    cache.put("small", small)
+    assert cache.get("small", max_age_ms=1e9) is not None
+    # group-by results count per group; plain aggregations are 1 cell
+    from pinot_tpu.common.response import AggregationResult
+    grouped = BrokerResponse(aggregation_results=[AggregationResult(
+        "sum(x)", group_by_columns=["g"],
+        group_by_result=[{"group": [i], "value": i} for i in range(101)])])
+    cache.put("grouped", grouped)
+    assert cache.get("grouped", max_age_ms=1e9) is None
+
+
+def test_broker_cache_put_does_not_alias_callers_response():
+    """put() stores a private copy: an embedding caller mutating the
+    response handle() returned must not poison later hits."""
+    resp = BrokerResponse(total_docs=7)
+    cache = BrokerResultCache()
+    cache.put("fp", resp)
+    resp.exceptions.append({"boom": 1})     # caller mutates ITS object
+    hit = cache.get("fp", max_age_ms=1e9)
+    assert hit is not None and not hit.exceptions
+
+
+def test_broker_cache_cleared_on_external_view_change():
+    """The freshness bound covers consuming-ingestion staleness only —
+    an OFFLINE backfill/replacement must flush the broker cache, so
+    the cluster watcher clears registered caches on EVERY view
+    change (segment lifecycle rate, so the clear is cheap)."""
+    from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
+    from pinot_tpu.common.cluster_state import ONLINE, TableView
+
+    class _Coord:
+        def watch_external_views(self, fn):
+            self.on_view = fn
+
+        def tables(self):
+            return []
+
+    class _Mgr:
+        def get_table_config(self, table):
+            return None
+
+        def get_schema(self, table):
+            return None
+
+    coord = _Coord()
+    w = BrokerClusterWatcher(coord, _Mgr())
+    cache = BrokerResultCache()
+    w.register_result_cache(cache)
+    # ordering matters: the clear (generation bump) must land AFTER
+    # routing.update_view — a query racing the handler must not
+    # capture the fresh generation while routing on the stale view,
+    # or its pre-backfill put would be accepted (round-9 regression)
+    events = []
+    real_update, real_clear = w.routing.update_view, cache.clear
+    real_tb = w._update_time_boundary
+    w.routing.update_view = \
+        lambda v: (events.append("route"), real_update(v))[1]
+    w._update_time_boundary = \
+        lambda v: (events.append("boundary"), real_tb(v))[1]
+    cache.clear = lambda: (events.append("clear"), real_clear())[1]
+    cache.put("fp", BrokerResponse(total_docs=3))
+    assert cache.get("fp", max_age_ms=1e9) is not None
+    # a segment upload/replacement fires an external-view change
+    coord.on_view(TableView("t_OFFLINE", {"seg_0": {"S": ONLINE}}))
+    assert cache.get("fp", max_age_ms=1e9) is None
+    # the clear lands only after the view change has FULLY landed
+    # (routing AND time boundary) — clearing earlier lets a racing
+    # query capture the fresh put-guard generation while executing
+    # against the pre-change view/boundary
+    assert events == ["route", "boundary", "clear"]
+    # ...and so does a table drop (empty view)
+    cache.put("fp2", BrokerResponse(total_docs=4))
+    coord.on_view(TableView("t_OFFLINE", {}))
+    assert cache.get("fp2", max_age_ms=1e9) is None
+
+
+def test_broker_cache_put_after_clear_is_dropped():
+    """An OFFLINE backfill's view change clear()s the cache while a
+    query is in flight; the query's _finish-time put (generation
+    captured at probe time, pre-execution) must not re-populate the
+    cache with the pre-backfill result."""
+    cache = BrokerResultCache()
+    gen = cache.generation            # captured at probe time
+    cache.clear()                     # the backfill races the query
+    cache.put("fp", BrokerResponse(total_docs=1), gen=gen)
+    assert cache.get("fp", max_age_ms=1e9) is None   # stale insert dropped
+    cache.put("fp", BrokerResponse(total_docs=2), gen=cache.generation)
+    assert cache.get("fp", max_age_ms=1e9).total_docs == 2
+    cache.clear()
+    cache.put("fp", BrokerResponse(total_docs=3))    # gen-less puts work
+    assert cache.get("fp", max_age_ms=1e9).total_docs == 3

@@ -706,9 +706,14 @@ def test_restart_does_not_rewind_before_snapshot_offset(work_dir):
                          store_dir=os.path.join(work_dir, "store"))
     try:
         latest = latest_by_key(rows)
+        # 120s: restart + journal replay + re-consumption from the
+        # snapshot boundary is load-sensitive — on a shared CI box a
+        # 60s window flaked while the same run converges in seconds
+        # when the box is quiet (the offset assertions below, not this
+        # wait, carry the no-rewind contract)
         assert wait_until(lambda: _converged(
             c2, len(latest),
-            float(sum(r["runs"] for r in latest.values()))), timeout=60)
+            float(sum(r["runs"] for r in latest.values()))), timeout=120)
         rtdm = c2.participants["Server_0"].realtime
         for seg, rdm in rtdm._consuming.items():
             mgr_offsets.append((seg, rdm))
