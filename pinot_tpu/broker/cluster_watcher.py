@@ -46,6 +46,8 @@ class BrokerClusterWatcher:
         # View changes are segment-lifecycle-rate (commits, uploads,
         # rebalances), so a full clear costs hit rate, never much CPU.
         self._result_caches: list = []
+        self._fault_tolerance = None
+        self._live_ft_watcher = None
         self.partition_pruner = PartitionZKMetadataPruner(manager)
         coordinator.watch_external_views(self._on_view)
         for table in coordinator.tables():
@@ -55,6 +57,38 @@ class BrokerClusterWatcher:
         """Clear `cache` on every external-view change (any object
         with a ``clear()``)."""
         self._result_caches.append(cache)
+
+    def attach_fault_tolerance(self, fault_tolerance) -> None:
+        """Forget a deregistered server's health/breaker accounting in
+        the SAME watch event that removes its live-instance record
+        (`FaultToleranceManager.forget`), so it leaves the candidate
+        ranking at once and a later reincarnation on the same host:port
+        starts with a clean breaker. Deliberately does NOT touch the
+        data-plane channel: a DRAINING server deregisters while still
+        serving its in-flight window, and severing its connection here
+        would turn a planned, errorless departure into dispatch
+        failures — a genuinely dead server's channel fails fast on its
+        own, and a reincarnation's fresh endpoint record overwrites the
+        stale one (`set_endpoint` closes the old channel)."""
+        from pinot_tpu.controller.state_machine import LIVE
+        self._fault_tolerance = fault_tolerance
+
+        def on_live(path: str, record, _prefix_len=len(LIVE) + 1) -> None:
+            if record is not None:
+                return
+            if self._fault_tolerance is not None:
+                self._fault_tolerance.forget(path[_prefix_len:])
+
+        self._live_ft_watcher = on_live
+        self.coordinator.store.watch(LIVE + "/", on_live)
+
+    def close(self) -> None:
+        if self._live_ft_watcher is not None:
+            try:
+                self.coordinator.store.unwatch(self._live_ft_watcher)
+            except Exception:  # noqa: BLE001 — store may be closed
+                pass
+            self._live_ft_watcher = None
 
     def _on_view(self, view: TableView) -> None:
         self.partition_pruner.invalidate(view.table_name)

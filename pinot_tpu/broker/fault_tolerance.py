@@ -196,6 +196,29 @@ class FaultToleranceManager:
                         lambda e=e: e.breaker.state)
             return e
 
+    def forget(self, server: str) -> None:
+        """Drop a DEREGISTERED server's health/breaker state entirely.
+
+        Failure-driven state must decay (a flapping server earns its
+        penalty back gradually), but a server that LEFT the cluster —
+        its live-instance record removed — is a different event: its
+        entry would otherwise linger forever, and a later reincarnation
+        under the same id / host:port would inherit an OPEN breaker and
+        a cratered health score it never earned, shedding load from a
+        brand-new process. Routing already excludes it in the same
+        watch event (the external view drops with the live record);
+        this clears the accounting side. The table-suffixed gauges are
+        reset to the healthy defaults so the exposition doesn't freeze
+        at the corpse's last values; a reincarnation's first _entry()
+        rebinds them to its fresh state."""
+        with self._lock:
+            e = self._servers.pop(server, None)
+        if e is not None:
+            self.metrics.gauge(BrokerGauge.SERVER_HEALTH,
+                               table=server).set(1.0)
+            self.metrics.gauge(BrokerGauge.BREAKER_STATE,
+                               table=server).set(BREAKER_CLOSED)
+
     # -- dispatch gating ---------------------------------------------------
     def allow_request(self, server: str) -> bool:
         """Gate an actual dispatch (consumes half-open probe slots)."""

@@ -300,6 +300,20 @@ class ControllerMeter:
     CORRUPT_SEGMENTS = "corruptSegmentArtifacts"
     ORPHAN_ARTIFACTS_DELETED = "orphanArtifactsDeleted"
     ERROR_REPLICAS_REPAIRED = "errorReplicasRepaired"
+    # self-healing plane (ClusterHealthMonitor / SegmentRebalancer /
+    # standby failover): replica moves applied by the rebalancer,
+    # consuming partitions reassigned off dead servers, and leader-lease
+    # takeovers from a different (dead or deposed) controller
+    REBALANCE_MOVES = "rebalanceMoves"
+    PARTITION_TAKEOVERS = "partitionTakeovers"
+    LEADER_FAILOVERS = "leaderFailovers"
+
+
+class ControllerGauge:
+    # Σ over segments of (replicas the config wants, capped at live
+    # capacity) minus (ideal-state holders that are live) — 0 when the
+    # cluster is fully repaired, >0 while self-healing is in progress
+    CLUSTER_REPLICATION_DEFICIT = "clusterReplicationDeficit"
 
 
 class ServerQueryPhase:

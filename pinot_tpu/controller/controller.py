@@ -5,19 +5,31 @@ cluster coordinator, resource manager and periodic tasks. (The reference
 additionally hosts the Helix controller and a Jersey REST API; the REST
 admin surface here lives in pinot_tpu/tools and the coordinator is
 in-process.)
+
+HA shape (``ha=True``): the controller runs against a SHARED store (its
+own or a remote one also serving a peer controller), holds a renewable
+leader lease with a fencing token, and routes every cluster mutation
+through a FencedStore so a deposed leader's in-flight writes are
+rejected. Periodic tasks stay lead-gated as before; the leadership
+heartbeat renews the lease at lease/3.
 """
 from __future__ import annotations
 
 from typing import List, Optional
 
-from pinot_tpu.common.metrics import MetricsRegistry
+from pinot_tpu.common.metrics import (ControllerGauge, ControllerMeter,
+                                      MetricsRegistry)
 from pinot_tpu.controller.manager import ResourceManager
 from pinot_tpu.controller.periodic import (PeriodicTask,
                                            PeriodicTaskScheduler,
                                            RealtimeSegmentValidationManager)
-from pinot_tpu.controller.leadership import ControllerLeadershipManager
+from pinot_tpu.controller.leadership import (ControllerLeadershipManager,
+                                             FencedStore)
 from pinot_tpu.controller.property_store import PropertyStore
 from pinot_tpu.controller.realtime_manager import RealtimeSegmentManager
+from pinot_tpu.controller.rebalance import (ClusterHealthMonitor,
+                                            SegmentRebalancer,
+                                            replication_deficit)
 from pinot_tpu.controller.state_machine import ClusterCoordinator
 
 
@@ -26,17 +38,35 @@ class Controller:
                  store: Optional[PropertyStore] = None,
                  periodic_tasks: Optional[List[PeriodicTask]] = None,
                  instance_id: str = "Controller_0",
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None,
+                 ha: bool = False,
+                 lease_s: Optional[float] = None):
         """`store_dir`: when the controller constructs its own store,
         persist cluster state (WAL + snapshots) under this directory so
         a restarted controller recovers tables, ideal states, segment
-        records and the realtime FSM's durable inputs."""
+        records and the realtime FSM's durable inputs.
+        `ha`: multi-controller deployment — mutations go through a
+        FencedStore bound to this instance's leader lease (fencing
+        token), and start()/stop() run the lease heartbeat. `lease_s`
+        overrides the leader-lease TTL (HA failover happens within one
+        lease period)."""
         self._owns_store = store is None
         self.store = store or PropertyStore(data_dir=store_dir)
-        self.coordinator = ClusterCoordinator(self.store)
-        self.manager = ResourceManager(self.coordinator, deep_store_dir)
-        self.realtime = RealtimeSegmentManager(self.manager)
         self.metrics = MetricsRegistry("controller")
+        # leadership elects on the RAW store (the election CAS is the
+        # fence's ground truth and must never be fenced itself)
+        self.leadership = ControllerLeadershipManager(
+            self.store, instance_id, metrics=self.metrics,
+            **({"lease_s": lease_s} if lease_s is not None else {}))
+        self.ha = ha
+        mutation_store = FencedStore(self.store, self.leadership) \
+            if ha else self.store
+        self.coordinator = ClusterCoordinator(mutation_store)
+        self.manager = ResourceManager(self.coordinator, deep_store_dir)
+        self.realtime = RealtimeSegmentManager(self.manager,
+                                               metrics=self.metrics)
+        self.rebalancer = SegmentRebalancer(self.manager,
+                                            metrics=self.metrics)
         # always-present cluster gauges (parity: ControllerMetrics'
         # tableCount/segmentCount-style validation gauges) — /metrics is
         # never empty, even before any periodic task ran
@@ -44,24 +74,48 @@ class Controller:
             lambda: len(self.manager.table_names()))
         self.metrics.gauge("schemaCount").set_callable(
             lambda: len(self.manager.store.children("/CONFIGS/SCHEMA")))
-        # lead-controller gating for the periodic plane (parity:
-        # ControllerLeadershipManager + ControllerPeriodicTask)
-        self.leadership = ControllerLeadershipManager(self.store,
-                                                      instance_id)
+        self.metrics.gauge(
+            ControllerGauge.CLUSTER_REPLICATION_DEFICIT).set_callable(
+                lambda: replication_deficit(self.manager))
+        # self-healing meters exist at 0 from boot so /metrics exposition
+        # always carries them
+        for name in (ControllerMeter.REBALANCE_MOVES,
+                     ControllerMeter.PARTITION_TAKEOVERS,
+                     ControllerMeter.LEADER_FAILOVERS):
+            self.metrics.meter(name)
         self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks,
                                               leadership=self.leadership,
                                               metrics=self.metrics)
         if periodic_tasks is None:
-            # scheduler owns the defaults; the controller only appends the
-            # realtime validation task (it needs the realtime manager)
+            # scheduler owns the defaults; the controller appends the
+            # tasks that need its realtime manager / rebalancer
+            self.health_monitor = ClusterHealthMonitor(
+                rebalancer=self.rebalancer,
+                realtime_manager=self.realtime,
+                metrics=self.metrics)
+            self.periodic.tasks.append(self.health_monitor)
             self.periodic.tasks.append(
                 RealtimeSegmentValidationManager(self.realtime))
+            for task in self.periodic.tasks:
+                if getattr(task, "rebalancer", "missing") is None:
+                    task.rebalancer = self.rebalancer
+        else:
+            self.health_monitor = None
 
     def start(self) -> None:
+        if self.ha:
+            # claim (or queue behind) the lease NOW so a lead
+            # controller's admin writes pass the fence immediately,
+            # then renew at lease/3 — a dead leader is succeeded within
+            # one lease period
+            self.leadership.try_acquire()
+            self.leadership.start()
         self.periodic.start()
 
     def stop(self) -> None:
         self.periodic.stop()
+        if self.ha:
+            self.leadership.stop()      # graceful: resign the lease
         self.manager.close()
         if self._owns_store:
             self.store.close()

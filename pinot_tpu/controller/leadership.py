@@ -6,6 +6,15 @@ validation, task generation) run exactly once across controllers. Here
 the election is a lease record at /CONTROLLER/LEADER claimed with the
 property store's atomic read-modify-write; the holder refreshes the
 lease, others take over when it expires.
+
+Standby failover adds **fencing**: every successful takeover bumps a
+monotonic ``epoch`` in the lease record, and the holder remembers the
+epoch it acquired. A ``FencedStore`` wraps the cluster store for a HA
+controller's mutation paths and verifies holder+epoch+TTL before every
+write, so a deposed leader's in-flight mutations (a periodic task or a
+segment commit that was mid-flight when the lease expired) are rejected
+instead of clobbering the new leader's state — the ZK-style fencing
+token, enforced at the store client.
 """
 from __future__ import annotations
 
@@ -20,13 +29,22 @@ DEFAULT_LEASE_S = 10.0
 class ControllerLeadershipManager:
     def __init__(self, store, instance_id: str,
                  lease_s: float = DEFAULT_LEASE_S,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 metrics=None):
+        """`metrics`: optional controller MetricsRegistry — takeovers
+        from a different previous holder mark `leaderFailovers`."""
         self.store = store
         self.instance_id = instance_id
         self.lease_s = lease_s
         self._clock = clock
+        self.metrics = metrics
         self._listeners: List[Callable[[bool], None]] = []
         self._was_leader = False
+        #: fencing token: the lease epoch THIS instance acquired (None
+        #: until first acquisition). Compared against the live record by
+        #: FencedStore so a deposed-then-reacquired leader's writes from
+        #: its OLD incarnation still fence out.
+        self._epoch: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -52,9 +70,20 @@ class ControllerLeadershipManager:
                 self._notify(False)
                 return False
             rec = dict(cur or {})
+            takeover = holder != self.instance_id
+            if takeover:
+                # fencing token: every change of holder bumps the epoch,
+                # invalidating the previous holder's FencedStore writes
+                rec["epoch"] = int(rec.get("epoch", 0)) + 1
             rec["instance"] = self.instance_id
             rec["leaseUntil"] = now + self.lease_s
             if self.store.cas(LEADER_PATH, cur, rec):
+                self._epoch = int(rec.get("epoch", 0))
+                if takeover and holder is not None and \
+                        self.metrics is not None:
+                    from pinot_tpu.common.metrics import ControllerMeter
+                    self.metrics.meter(
+                        ControllerMeter.LEADER_FAILOVERS).mark()
                 self._notify(True)
                 return True
             # CAS lost: someone moved the record under us — one re-read
@@ -68,6 +97,23 @@ class ControllerLeadershipManager:
         rec = self.store.get(LEADER_PATH) or {}
         return rec.get("instance") == self.instance_id and \
             rec.get("leaseUntil", 0) >= self._clock()
+
+    def fencing_token(self) -> Optional[int]:
+        """The lease epoch this instance acquired (None = never led)."""
+        return self._epoch
+
+    def holds_fenced_lease(self) -> bool:
+        """True only while the live lease record names THIS instance,
+        is unexpired, AND still carries the epoch this incarnation
+        acquired — the write-side fencing check. A deposed leader fails
+        the instance/TTL check; a deposed-then-reacquired one fails the
+        epoch check for writes issued under its old token."""
+        if self._epoch is None:
+            return False
+        rec = self.store.get(LEADER_PATH) or {}
+        return rec.get("instance") == self.instance_id and \
+            rec.get("leaseUntil", 0) >= self._clock() and \
+            int(rec.get("epoch", 0)) == self._epoch
 
     def resign(self) -> None:
         def drop(rec):
@@ -111,3 +157,90 @@ class ControllerLeadershipManager:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.resign()
+
+    def abort(self) -> None:
+        """Crash simulation: stop the heartbeat WITHOUT resigning — the
+        lease record stays and must expire on its own TTL before a
+        standby can take over (exactly what a kill -9 leaves behind)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class FencedWriteError(RuntimeError):
+    """A store mutation was attempted without a valid fenced lease — the
+    writer was deposed (or never led). The mutation was NOT applied."""
+
+
+class FencedStore:
+    """PropertyStore proxy that fences every mutation on the owner's
+    leader lease (instance + TTL + epoch).
+
+    Reads, watches and children pass through untouched — a standby
+    controller must see cluster state to stay hot. Mutations verify
+    ``leadership.holds_fenced_lease()`` immediately before delegating,
+    so a deposed leader's delayed write (periodic task mid-run, segment
+    commit mid-flight when the lease expired) raises FencedWriteError
+    instead of overwriting the new leader's state. The check-then-write
+    is not atomic against a concurrent deposition — the residual window
+    is one store round-trip, the same guarantee ZK fencing tokens give
+    when the resource itself doesn't validate them transactionally; the
+    crash-pointed rebalance/takeover steps are idempotent under exactly
+    that window.
+    """
+
+    def __init__(self, inner, leadership: ControllerLeadershipManager):
+        self.inner = inner
+        self.leadership = leadership
+
+    @property
+    def compose_lock(self):
+        # compose_view serializes on the UNDERLYING store's lock so a
+        # fenced and an unfenced composer over the same store still
+        # exclude each other
+        return self.inner.compose_lock
+
+    def _fence(self, op: str, path: str) -> None:
+        if not self.leadership.holds_fenced_lease():
+            raise FencedWriteError(
+                f"{op} {path}: {self.leadership.instance_id} does not "
+                f"hold the leader lease (fencing token "
+                f"{self.leadership.fencing_token()})")
+
+    # -- mutations (fenced) -------------------------------------------------
+    def set(self, path: str, record: dict, **kw) -> None:
+        self._fence("set", path)
+        return self.inner.set(path, record, **kw)
+
+    def update(self, path: str, fn):
+        self._fence("update", path)
+        return self.inner.update(path, fn)
+
+    def cas(self, path: str, expected, record, **kw) -> bool:
+        self._fence("cas", path)
+        return self.inner.cas(path, expected, record, **kw)
+
+    def remove(self, path: str) -> bool:
+        self._fence("remove", path)
+        return self.inner.remove(path)
+
+    # -- reads / watches (pass-through) -------------------------------------
+    def get(self, path: str):
+        return self.inner.get(path)
+
+    def children(self, prefix: str):
+        return self.inner.children(prefix)
+
+    def list_paths(self, prefix: str):
+        return self.inner.list_paths(prefix)
+
+    def watch(self, prefix: str, callback) -> None:
+        self.inner.watch(prefix, callback)
+
+    def unwatch(self, callback) -> None:
+        self.inner.unwatch(callback)
+
+    def close(self) -> None:
+        # lifecycle belongs to the inner store's owner; fenced views
+        # never close the shared session
+        pass

@@ -91,9 +91,14 @@ class SegmentIntegrityChecker(PeriodicTask):
     #: upload (copy lands before the record is written), not an orphan
     ORPHAN_GRACE_S = 300.0
 
-    def __init__(self, metrics=None, now_fn=None):
+    def __init__(self, metrics=None, now_fn=None, rebalancer=None):
+        """`rebalancer`: the controller's SegmentRebalancer — replicas
+        whose host is no longer live are handed to it instead of being
+        bounced (a bounce against a dead host heals nothing); built
+        lazily from the manager when not wired."""
         self.metrics = metrics
         self._now = now_fn or time.time
+        self.rebalancer = rebalancer
         self.last_report: Dict[str, Dict] = {}
         self._bounce_counts: Dict[tuple, int] = {}
 
@@ -160,12 +165,22 @@ class SegmentIntegrityChecker(PeriodicTask):
         ideal = manager.coordinator.ideal_state(table)
         view = manager.coordinator.external_view(table).segment_states
         live = set(manager.coordinator.live_instances())
+        dead_holders = False
         for seg, wanted in ideal.items():
             if seg in skip:
                 continue
             for inst, target in sorted(wanted.items()):
-                if target != ONLINE or \
-                        view.get(seg, {}).get(inst) != ERROR:
+                if target != ONLINE:
+                    continue
+                if inst not in live:
+                    # the replica's HOST is gone: bouncing a corpse
+                    # through OFFLINE can never heal it — defer to the
+                    # rebalancer's replica-count repair (one pass below,
+                    # no bounce budget burned against a dead instance)
+                    self._bounce_counts.pop((table, seg, inst), None)
+                    dead_holders = True
+                    continue
+                if view.get(seg, {}).get(inst) != ERROR:
                     continue
                 key = (table, seg, inst)
                 bounces = self._bounce_counts.get(key, 0)
@@ -212,6 +227,19 @@ class SegmentIntegrityChecker(PeriodicTask):
                 self._bounce_counts[key] = bounces + 1
                 entry["repaired"].append(f"{seg}:{inst}")
                 self._mark(ControllerMeter.ERROR_REPLICAS_REPAIRED)
+        if dead_holders:
+            from pinot_tpu.controller.rebalance import SegmentRebalancer
+            if self.rebalancer is None:
+                self.rebalancer = SegmentRebalancer(manager,
+                                                    metrics=self.metrics)
+            report = self.rebalancer.repair_table(table)
+            for seg, insts in report["pruned"].items():
+                adds = report["added"].get(seg, [])
+                entry["reassigned"].extend(
+                    f"{seg}:{inst}->{','.join(adds) or '(pruned)'}"
+                    for inst in insts)
+                self._mark(ControllerMeter.ERROR_REPLICAS_REPAIRED,
+                           len(insts))
 
     # -- orphan sweep -------------------------------------------------------
     def _sweep_orphans(self, manager: ResourceManager, table: str,

@@ -57,12 +57,16 @@ class _CompletionFSM:
 class RealtimeSegmentManager:
     def __init__(self, manager: ResourceManager,
                  election_wait_ms: float = 2_000.0,
-                 commit_lease_ms: float = 60_000.0):
+                 commit_lease_ms: float = 60_000.0,
+                 metrics=None):
+        """`metrics`: optional controller registry — consuming-partition
+        reassignments off dead/stopped owners mark `partitionTakeovers`."""
         self.manager = manager
         self.coordinator = manager.coordinator
         self.store = manager.store
         self.election_wait_ms = election_wait_ms
         self.commit_lease_ms = commit_lease_ms
+        self.metrics = metrics
         self._fsm: Dict[str, _CompletionFSM] = {}
         self._lock = threading.Lock()
 
@@ -148,12 +152,18 @@ class RealtimeSegmentManager:
             self._create_consuming_segment(table, config, latest.next(),
                                            int(meta["endOffset"]))
             return
-        # IN_PROGRESS: make sure a live, non-errored replica is consuming
+        # IN_PROGRESS: make sure a live, non-errored replica is consuming.
+        # The guard is STATE-aware, not just membership-aware: a crash at
+        # takeover.pre_resume leaves the partition's owners parked
+        # OFFLINE (bounced but never reassigned) — live OFFLINE owners
+        # must re-enter the repair, or the partition stalls forever.
         ideal = self.coordinator.ideal_state(table)
         live = set(self.coordinator.live_instances())
-        assigned = set(ideal.get(latest.name, {}))
+        states = ideal.get(latest.name, {})
+        assigned = set(states)
         stopped = set(meta.get("stoppedInstances", []))
-        if (assigned & live) - stopped:
+        if any(st == CONSUMING and inst in live and inst not in stopped
+               for inst, st in states.items()):
             return
         servers = self.manager.server_instances_for(config)
         if not servers:
@@ -183,11 +193,22 @@ class RealtimeSegmentManager:
                 lambda old: {k: v for k, v in (old or {}).items()
                              if k != "stoppedInstances"})
 
+        # seeded crash point: the dead owners were bounced OFFLINE but
+        # the new CONSUMING assignment is not yet written — the
+        # partition has no consumer. Recovery: the next monitor /
+        # validation run re-enters this path (assigned ∩ live empty or
+        # all-OFFLINE) and finishes the takeover; the new owner resumes
+        # from the durable startOffset, so nothing is lost or doubled.
+        crash_points.hit("takeover.pre_resume")
+
         def reassign(segments):
             segments[latest.name] = {inst: CONSUMING for inst in chosen}
             return segments
 
         self.coordinator.update_ideal_state(table, reassign)
+        if self.metrics is not None:
+            from pinot_tpu.common.metrics import ControllerMeter
+            self.metrics.meter(ControllerMeter.PARTITION_TAKEOVERS).mark()
 
     def _create_consuming_segment(self, table: str, config: TableConfig,
                                   llc: LLCSegmentName,

@@ -1,0 +1,398 @@
+"""Cluster self-healing: liveness-driven replica repair and rebalance.
+
+Parity: the slice of Helix the reference leans on for node death —
+a dead participant's ephemeral session drops it from LIVEINSTANCES, the
+controller recomputes assignments, replicas are re-created on healthy
+servers and consuming partitions re-consumed elsewhere (SURVEY §:
+cluster management via Helix + ZooKeeper; PinotHelixResourceManager's
+rebalance + ensureAllPartitionsConsuming). Two cooperating pieces:
+
+- ``SegmentRebalancer`` — computes and applies **minimal** replica
+  moves against the ideal state: replica-count repair for committed
+  segments whose holders died (new replicas assigned through the
+  table's existing assignment strategy onto healthy tenant servers,
+  capped at live capacity), pruning of dead holders, and a throttled
+  make-before-break spread onto newly joined servers. Every write goes
+  through the property store, so brokers' routing views converge via
+  the existing external-view watch chain — the rebalancer never talks
+  to a broker.
+- ``ClusterHealthMonitor`` — a lead-gated periodic task that watches
+  live-instance membership, declares a server dead only after a
+  configurable grace window (a restart must not trigger a rebalance
+  storm), then drives the rebalancer for committed replicas and the
+  realtime manager's partition-takeover path for CONSUMING ones.
+
+Crash points (tests kill the controller at each and restart over the
+same durable store; every step is idempotent so recovery is re-running
+the monitor):
+
+- ``rebalance.move_staged``  — after a repair plan is computed, before
+  any ideal-state write for the batch.
+- ``rebalance.pre_commit``   — after new replicas were added to the
+  ideal state, before dead holders are pruned.
+- ``takeover.pre_resume``    — in realtime_manager, after a consuming
+  partition's dead owners were bounced OFFLINE, before the new owners'
+  CONSUMING assignment is written.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Set
+
+from pinot_tpu.common.cluster_state import CONSUMING, ONLINE
+from pinot_tpu.common.faults import crash_points
+from pinot_tpu.common.metrics import ControllerMeter
+from pinot_tpu.controller.assignment import make_assignment
+from pinot_tpu.controller.periodic import PeriodicTask
+
+log = logging.getLogger(__name__)
+
+
+def replication_deficit(manager) -> int:
+    """Σ over committed segments of (configured replicas, capped at the
+    table's live tenant capacity) − (live ideal-state holders). 0 when
+    fully repaired; the `clusterReplicationDeficit` gauge."""
+    deficit = 0
+    for table in manager.table_names():
+        config = manager.get_table_config(table)
+        if config is None:
+            continue
+        live = set(manager.server_instances_for(config))
+        wanted = config.segments_config.replication
+        capacity = min(wanted, len(live))
+        for seg, states in manager.coordinator.ideal_state(table).items():
+            if CONSUMING in states.values():
+                continue        # the realtime repair path owns these
+            alive = sum(1 for inst in states if inst in live)
+            deficit += max(0, capacity - alive)
+    return deficit
+
+
+class SegmentRebalancer:
+    """Minimal-move replica repair + bounded rebalance-on-join."""
+
+    def __init__(self, manager, metrics=None,
+                 max_moves_per_cycle: int = 16,
+                 join_converge_timeout_s: float = 20.0):
+        self.manager = manager
+        self.metrics = metrics
+        self.max_moves_per_cycle = max_moves_per_cycle
+        self.join_converge_timeout_s = join_converge_timeout_s
+
+    def _mark_moves(self, n: int) -> None:
+        if n and self.metrics is not None:
+            self.metrics.meter(ControllerMeter.REBALANCE_MOVES).mark(n)
+
+    def _strategy(self, table: str):
+        return self.manager._assignments.setdefault(
+            table, make_assignment("balanced"))
+
+    # -- replica-count repair ----------------------------------------------
+    def compute_repair(self, table: str) -> Dict[str, Dict[str, List[str]]]:
+        """The repair plan for one table: per segment, replicas to add
+        (on healthy live servers, via the table's assignment strategy)
+        and dead holders to prune. Empty when converged — the no-op
+        cycle costs only store reads."""
+        config = self.manager.get_table_config(table)
+        if config is None:
+            return {}
+        live = set(self.manager.server_instances_for(config))
+        replicas = config.segments_config.replication
+        strategy = self._strategy(table)
+        ideal = self.manager.coordinator.ideal_state(table)
+        plan: Dict[str, Dict[str, List[str]]] = {}
+        for seg in sorted(ideal):
+            states = ideal[seg]
+            if CONSUMING in states.values():
+                continue        # realtime takeover path, not ours
+            survivors = sorted(i for i in states if i in live)
+            dead = sorted(i for i in states if i not in live)
+            need = min(replicas, len(live)) - len(survivors)
+            adds: List[str] = []
+            if need > 0:
+                candidates = sorted(live - set(survivors))
+                if candidates:
+                    # honor the table's strategy for the NEW replicas:
+                    # ask it for a full assignment over the candidates
+                    # and take the first `need` it ranks
+                    pm = (self.manager.segment_metadata(table, seg) or {}
+                          ).get("partitionMetadata") or {}
+                    pids = {p for info in pm.values()
+                            for p in info.get("partitions") or ()}
+                    ranked = strategy.assign(seg, candidates,
+                                             min(need, len(candidates)),
+                                             ideal,
+                                             partition_ids=pids or None)
+                    adds = [i for i in ranked if i not in survivors][:need]
+            if adds or dead:
+                plan[seg] = {"add": adds, "dead": dead}
+        return plan
+
+    def repair_table(self, table: str,
+                     budget: Optional[int] = None) -> Dict:
+        """Apply up to `budget` (default max_moves_per_cycle) repair
+        moves: add replacement replicas first (make), then prune dead
+        holders (break). Both writes are idempotent fold functions over
+        the CURRENT ideal state, so a crash between them — or a re-run
+        after one — converges without double-owned or orphaned
+        replicas."""
+        plan = self.compute_repair(table)
+        if not plan:
+            return {"added": {}, "pruned": {}, "remaining": 0}
+        budget = self.max_moves_per_cycle if budget is None else budget
+        batch: Dict[str, Dict[str, List[str]]] = {}
+        moves = 0
+        for seg in sorted(plan):
+            cost = len(plan[seg]["add"]) or 1
+            if moves + cost > budget and batch:
+                break
+            batch[seg] = plan[seg]
+            moves += cost
+        # seeded crash point: plan computed, nothing written — restart
+        # must recompute the identical plan from the durable state
+        crash_points.hit("rebalance.move_staged")
+
+        added = {s: m["add"] for s, m in batch.items() if m["add"]}
+        if added:
+            def add_new(segments, added=added):
+                for seg, insts in added.items():
+                    entry = dict(segments.get(seg, {}))
+                    for inst in insts:
+                        entry.setdefault(inst, ONLINE)
+                    segments[seg] = entry
+                return segments
+
+            self.manager.coordinator.update_ideal_state(table, add_new)
+        # seeded crash point: replacements staged in the ideal state but
+        # dead holders not yet pruned — harmless duplicates (a dead
+        # holder serves nothing); the next cycle prunes them
+        crash_points.hit("rebalance.pre_commit")
+
+        pruned = {s: m["dead"] for s, m in batch.items() if m["dead"]}
+        if pruned:
+            config = self.manager.get_table_config(table)
+            live = set(self.manager.server_instances_for(config)) \
+                if config else set()
+
+            def drop_dead(segments, pruned=pruned, live=live):
+                for seg, insts in pruned.items():
+                    entry = dict(segments.get(seg, {}))
+                    for inst in insts:
+                        # re-check against the CURRENT ideal: the holder
+                        # may have reincarnated since the plan was built
+                        if inst not in live:
+                            entry.pop(inst, None)
+                    segments[seg] = entry
+                return segments
+
+            self.manager.coordinator.update_ideal_state(table, drop_dead)
+        self._mark_moves(sum(len(v) for v in added.values()))
+        remaining = len(plan) - len(batch)
+        if added or pruned:
+            log.warning("rebalance: %s repaired %d segment(s) "
+                        "(+%d replicas, -%d dead holders), %d deferred",
+                        table, len(batch),
+                        sum(len(v) for v in added.values()),
+                        sum(len(v) for v in pruned.values()), remaining)
+        return {"added": added, "pruned": pruned, "remaining": remaining}
+
+    def repair_all(self) -> Dict[str, Dict]:
+        out = {}
+        for table in self.manager.table_names():
+            report = self.repair_table(table)
+            if report["added"] or report["pruned"] or report["remaining"]:
+                out[table] = report
+        return out
+
+    # -- rebalance-on-join --------------------------------------------------
+    def rebalance_onto(self, joined: str,
+                       budget: Optional[int] = None) -> Dict[str, List[str]]:
+        """Spread load onto a newly joined server, make-before-break:
+        for up to `budget` segments whose strategy target includes the
+        joiner, add a replica there, await it serving in the external
+        view, then drop the most-loaded old holder. A convergence
+        timeout leaves the extra replica in place (over-replication is
+        safe; the next cycle retries the drop via compute_repair's
+        no-op). Throttled by design — one bounded pass per join event."""
+        budget = self.max_moves_per_cycle if budget is None else budget
+        moved: Dict[str, List[str]] = {}
+        for table in self.manager.table_names():
+            config = self.manager.get_table_config(table)
+            if config is None:
+                continue
+            servers = self.manager.server_instances_for(config)
+            if joined not in servers or len(servers) < 2:
+                continue
+            replicas = config.segments_config.replication
+            strategy = self._strategy(table)
+            ideal = self.manager.coordinator.ideal_state(table)
+            load = {inst: 0 for inst in servers}
+            for states in ideal.values():
+                for inst in states:
+                    if inst in load:
+                        load[inst] += 1
+            for seg in sorted(ideal):
+                if len(moved.get(table, ())) >= budget:
+                    break
+                states = ideal[seg]
+                if CONSUMING in states.values() or joined in states:
+                    continue
+                if len(states) < replicas:
+                    continue    # deficit: repair path owns it
+                pm = (self.manager.segment_metadata(table, seg) or {}
+                      ).get("partitionMetadata") or {}
+                pids = {p for info in pm.values()
+                        for p in info.get("partitions") or ()}
+                target = strategy.assign(seg, servers, replicas, ideal,
+                                         partition_ids=pids or None)
+                if joined not in target:
+                    continue
+                victim = max(states, key=lambda i: (load.get(i, 0), i))
+                if load.get(victim, 0) <= load.get(joined, 0) + 1:
+                    continue    # already balanced enough: don't churn
+
+                def add(segments, seg=seg):
+                    entry = dict(segments.get(seg, {}))
+                    entry.setdefault(joined, ONLINE)
+                    segments[seg] = entry
+                    return segments
+
+                self.manager.coordinator.update_ideal_state(table, add)
+                try:
+                    self.manager._await_converged(
+                        table, {seg: {joined: ONLINE}}, 1,
+                        self.join_converge_timeout_s, require_all=True)
+                except TimeoutError:
+                    log.warning("rebalance-on-join: %s/%s never served "
+                                "on %s; leaving the extra replica",
+                                table, seg, joined)
+                    continue
+
+                def drop(segments, seg=seg, victim=victim):
+                    entry = dict(segments.get(seg, {}))
+                    if joined in entry and len(entry) > 1:
+                        entry.pop(victim, None)
+                    segments[seg] = entry
+                    return segments
+
+                self.manager.coordinator.update_ideal_state(table, drop)
+                load[victim] = load.get(victim, 1) - 1
+                load[joined] = load.get(joined, 0) + 1
+                moved.setdefault(table, []).append(f"{seg}:{victim}->"
+                                                   f"{joined}")
+                self._mark_moves(1)
+        if moved:
+            log.info("rebalance-on-join: moved %s onto %s",
+                     {t: len(m) for t, m in moved.items()}, joined)
+        return moved
+
+
+class ClusterHealthMonitor(PeriodicTask):
+    """Lead-gated liveness watcher: declares servers dead after a grace
+    window, then drives replica repair + consuming-partition takeover;
+    newly joined servers trigger a throttled rebalance-on-join.
+
+    Parity: the Helix controller reacting to LIVEINSTANCES session
+    expiry — here liveness is polled from the same ephemeral records
+    (PR 4 excludes them from the WAL, so a restarted controller starts
+    from an empty membership view and re-learns it, never resurrecting
+    dead peers). The clock is injectable so the grace window is testable
+    without wall-clock sleeps.
+    """
+
+    name = "ClusterHealthMonitor"
+    interval_s = 1.0
+
+    def __init__(self, rebalancer: Optional[SegmentRebalancer] = None,
+                 realtime_manager=None, grace_s: float = 5.0,
+                 clock=time.monotonic, metrics=None):
+        self.rebalancer = rebalancer
+        self.realtime_manager = realtime_manager
+        self.grace_s = grace_s
+        self._clock = clock
+        self.metrics = metrics
+        #: instances ever observed live (baseline seeded on first run so
+        #: booting against an established cluster fires no join events)
+        self._ever_seen: Optional[Set[str]] = None
+        self._missing_since: Dict[str, float] = {}
+        self.last_report: Dict = {}
+
+    def _rebalancer(self, manager) -> SegmentRebalancer:
+        if self.rebalancer is None:
+            self.rebalancer = SegmentRebalancer(manager,
+                                                metrics=self.metrics)
+        return self.rebalancer
+
+    def run(self, manager) -> None:
+        now = self._clock()
+        live = set(manager.coordinator.live_instances())
+        report: Dict = {"dead": [], "joined": [], "repaired": {},
+                        "joinMoves": {}}
+        if self._ever_seen is None:
+            self._ever_seen = set(live)
+        # a join is a NEW instance — or a known one RETURNING from a
+        # missing spell (same-id restart): if its replicas were already
+        # pruned by a repair, only the join path re-adds them
+        joined = sorted((live - self._ever_seen) |
+                        (live & set(self._missing_since)))
+        self._ever_seen |= live
+        for inst in live:
+            # back (or never left): reset the death clock — a server
+            # that returned within grace was a restart, not a death
+            self._missing_since.pop(inst, None)
+        for inst in self._ever_seen - live:
+            self._missing_since.setdefault(inst, now)
+        # holders recorded in the DURABLE ideal state but not live and
+        # never observed by this controller incarnation: a restarted
+        # controller has no memory of the instance ever being alive
+        # (live records are session state the WAL excludes), yet its
+        # replicas persist — start their death clock now, grace intact
+        for table in manager.coordinator.tables():
+            for states in manager.coordinator.ideal_state(table).values():
+                for inst in states:
+                    if inst not in live and inst not in self._ever_seen:
+                        self._ever_seen.add(inst)
+                        self._missing_since.setdefault(inst, now)
+        dead = sorted(i for i, t in self._missing_since.items()
+                      if now - t >= self.grace_s)
+
+        if dead:
+            report["dead"] = dead
+            rb = self._rebalancer(manager)
+            report["repaired"] = rb.repair_all()
+            if self.realtime_manager is not None:
+                # consuming partitions whose owners died: reassign and
+                # resume from the last committed offset (the takeover
+                # path is ensure_all_partitions_consuming's repair arm,
+                # crash-pointed at takeover.pre_resume)
+                self.realtime_manager.ensure_all_partitions_consuming()
+            # forget instances that no longer appear anywhere in any
+            # ideal state: fully healed — a later reincarnation under
+            # the same id is a fresh join, not a resurrection
+            for inst in dead:
+                if not self._holds_anything(manager, inst):
+                    self._missing_since.pop(inst, None)
+                    self._ever_seen.discard(inst)
+
+        for inst in joined:
+            report["joined"].append(inst)
+            moves = self._rebalancer(manager).rebalance_onto(inst)
+            if moves:
+                report["joinMoves"][inst] = moves
+        if joined and not dead:
+            # a join raises live CAPACITY: segments the last repair
+            # could only restore to fewer replicas than configured
+            # (capped at the then-live capacity) top back up now
+            repaired = self._rebalancer(manager).repair_all()
+            if repaired:
+                report["repaired"] = repaired
+        self.last_report = report
+
+    @staticmethod
+    def _holds_anything(manager, inst: str) -> bool:
+        for table in manager.coordinator.tables():
+            for states in manager.coordinator.ideal_state(table).values():
+                if inst in states:
+                    return True
+        return False

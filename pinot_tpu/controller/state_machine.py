@@ -141,19 +141,32 @@ class ViewComposer:
     current-state/live-instance removal when a server dies.
     """
 
-    def __init__(self, store: PropertyStore):
+    def __init__(self, store: PropertyStore, gate=None):
+        """`gate`: optional () -> bool — with multiple controllers over
+        one store, only the LEAD controller's composer runs (parity:
+        one Helix controller computing external views); a standby's
+        composer stays quiet until its gate opens, then catches up via
+        recompose_all (wired to the leadership listener)."""
         self.store = store
+        self.gate = gate
         self._watcher = self._on_change
         store.watch(CURRENT + "/", self._watcher)
         store.watch(LIVE + "/", self._watcher)
 
     def _on_change(self, path: str, record: Optional[dict]) -> None:
+        if self.gate is not None and not self.gate():
+            return
         if path.startswith(CURRENT + "/"):
             parts = path[len(CURRENT) + 1:].split("/", 1)
             if len(parts) == 2:
                 compose_view(self.store, parts[1])
             return
         # live-instance change: membership affects every table's view
+        self.recompose_all()
+
+    def recompose_all(self) -> None:
+        """Recompute every table's view — the catch-up a just-promoted
+        standby runs for the events its gate suppressed."""
         for table in self.store.children(IDEAL):
             compose_view(self.store, table)
 

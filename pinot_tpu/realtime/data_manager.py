@@ -89,6 +89,7 @@ class RealtimeSegmentDataManager:
         self.decoder = stream_config.decoder
         self.transformer = CompoundTransformer(schema)
         self._catchup_target: Optional[int] = None
+        self._seal_requested = False
         self._deadline = time.monotonic() + \
             stream_config.flush_threshold_time_ms / 1e3
         self._stop = threading.Event()
@@ -115,10 +116,19 @@ class RealtimeSegmentDataManager:
 
     # -- consume loop ------------------------------------------------------
 
+    def request_seal(self) -> None:
+        """Graceful-drain hook: force the end criteria so the consumer
+        reports segmentConsumed on its next loop — with a live
+        controller this seals the segment (commit election → build →
+        split commit) before the server departs, so a planned restart
+        leaves no unsealed rows behind to re-consume."""
+        self._seal_requested = True  # tpulint: disable=concurrency -- latched one-way flag; the consumer thread reads one GIL-atomic snapshot per loop
+
     def _end_criteria_reached(self) -> bool:
         if self._catchup_target is not None:
             return self.offset >= self._catchup_target
-        return (self.mutable.num_docs >=
+        return (self._seal_requested or
+                self.mutable.num_docs >=
                 self.stream_config.flush_threshold_rows or
                 time.monotonic() >= self._deadline)
 
@@ -203,12 +213,39 @@ class RealtimeSegmentDataManager:
 
     # -- completion protocol (server side) ---------------------------------
 
+    #: backoff between completion-protocol retries while the controller
+    #: is unreachable (failover window: the lease must expire and the
+    #: standby publish its endpoint before calls can succeed again)
+    COMPLETION_RETRY_S = 0.5
+
+    def _completion_call(self, fn, *args):
+        """Run a completion-protocol op, riding out controller failover:
+        connection-level failures (dead lead controller, standby not yet
+        serving) back off and retry — the HTTP client re-resolves the
+        ACTIVE controller endpoint from the store between attempts —
+        while protocol-level outcomes (HOLD/COMMIT/FAILED...) pass
+        through untouched. Returns None when the consumer was stopped
+        mid-retry; killing the consumer over a transient controller
+        outage would strand the partition until an external repair."""
+        while not self._stop.is_set():
+            try:
+                return fn(*args)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                log.warning("completion call failed for %s (%s); "
+                            "retrying — controller may be failing over",
+                            self.llc.name, e)
+                self._stop.wait(self.COMPLETION_RETRY_S)
+        return None
+
     def _report_consumed(self) -> bool:
         """segmentConsumed → steer by response. Returns False to exit."""
         self._catchup_target = None  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
         self.state = HOLDING  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
-        resp = self.completion.segment_consumed(
+        resp = self._completion_call(
+            self.completion.segment_consumed,
             self.table, self.llc.name, self.instance_id, self.offset)
+        if resp is None:
+            return False            # stopped while the controller was away
         if resp.status == proto.HOLD:
             self._stop.wait(_POLL_S)
             return True
@@ -270,8 +307,11 @@ class RealtimeSegmentDataManager:
             lease_thread.join(timeout=5)
 
     def _commit_inner(self) -> None:
-        resp = self.completion.commit_start(self.table, self.llc.name,
-                                            self.instance_id, self.offset)
+        resp = self._completion_call(
+            self.completion.commit_start,
+            self.table, self.llc.name, self.instance_id, self.offset)
+        if resp is None:
+            return                  # stopped mid-retry: nothing committed
         if resp.status != proto.COMMIT_CONTINUE:
             log.warning("commit_start rejected for %s: %s", self.llc.name,
                         resp.status)
@@ -298,9 +338,12 @@ class RealtimeSegmentDataManager:
         # the mutable before commit_end returns (num_docs survives as an
         # int, but take no chances on ordering)
         sealed_docs = int(self.mutable.num_docs)
-        resp = self.completion.commit_end(self.table, self.llc.name,
-                                          self.instance_id, self.offset,
-                                          out_dir)
+        resp = self._completion_call(
+            self.completion.commit_end,
+            self.table, self.llc.name, self.instance_id, self.offset,
+            out_dir)
+        if resp is None:
+            return                  # stopped mid-retry
         if resp.status != proto.COMMIT_SUCCESS:
             log.warning("commit_end failed for %s: %s", self.llc.name,
                         resp.status)
@@ -468,6 +511,37 @@ class RealtimeTableDataManager:
         tdm = self.server.data_manager.table(table)
         if tdm is not None:
             tdm.remove_segment(segment)
+
+    def seal_all(self, timeout_s: float = 20.0) -> bool:
+        """Graceful drain: ask every consuming segment with indexed rows
+        to seal (commit through the completion protocol) and wait —
+        bounded — until each reaches a terminal consumer state. Empty
+        consumers are skipped (nothing to lose; the successor record
+        already points at their start offset). Returns True when every
+        sealable consumer reached COMMITTED/DISCARDED in time."""
+        with self._lock:
+            rdms = list(self._consuming.values())
+        sealing = []
+        for rdm in rdms:
+            if rdm.mutable.num_docs > 0:
+                rdm.request_seal()
+                sealing.append(rdm)
+        deadline = time.monotonic() + timeout_s
+        ok = True
+        for rdm in sealing:
+            while rdm.state not in (COMMITTED, DISCARDED, ERROR_STATE):
+                if time.monotonic() >= deadline:
+                    log.warning("drain: %s did not seal within %.1fs "
+                                "(state %s); departing unsealed — the "
+                                "takeover path re-consumes from the "
+                                "last committed offset", rdm.llc.name,
+                                timeout_s, rdm.state)
+                    ok = False
+                    break
+                time.sleep(0.02)
+            else:
+                ok = ok and rdm.state != ERROR_STATE
+        return ok
 
     def shutdown(self) -> None:
         with self._lock:

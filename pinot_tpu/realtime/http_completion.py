@@ -11,19 +11,81 @@ RealtimeTableDataManager works unchanged in a multi-process deployment
 from __future__ import annotations
 
 import json
+import logging
+import urllib.error
 import urllib.parse
 import urllib.request
 
 from pinot_tpu.common.completion import CompletionResponse
 
+log = logging.getLogger(__name__)
+
+#: the ACTIVE controller's HTTP base, published by the lead controller
+#: at boot/takeover (the /CONTROLLER/DEEPSTORE_BASE pattern) — servers
+#: re-resolve the completion endpoint from it after a failover
+CONTROLLER_ENDPOINT_PATH = "/CONTROLLER/ENDPOINT"
+
 
 class HttpSegmentCompletionClient:
-    def __init__(self, controller: str, timeout: float = 60.0):
-        """`controller`: host:port of the controller's HTTP API."""
-        self.base = f"http://{controller}"
+    def __init__(self, controller: str = None, timeout: float = 60.0,
+                 store=None):
+        """`controller`: host:port of the controller's HTTP API.
+        `store`: optional property store — when given, the ACTIVE
+        controller endpoint published at /CONTROLLER/ENDPOINT overrides
+        `controller`, and a connection failure re-resolves it and
+        retries once, so a standby-controller takeover doesn't strand
+        this server's completion protocol on the dead leader."""
+        if controller is None and store is None:
+            raise ValueError("no controller endpoint: pass `controller` "
+                             "or a store publishing "
+                             f"{CONTROLLER_ENDPOINT_PATH}")
+        self.base = f"http://{controller}" if controller else None
         self.timeout = timeout
+        self.store = store
+        if self.store is not None:
+            # best-effort eager resolve; a missing record is NOT a boot
+            # failure (servers may start before any controller has led)
+            # — the first _post resolves lazily, and _completion_call
+            # retries the ConnectionError until a leader publishes
+            self._resolve()
+
+    def _resolve(self) -> bool:
+        """Refresh self.base from the published record; True on change."""
+        try:
+            rec = self.store.get(CONTROLLER_ENDPOINT_PATH) or {}
+        except Exception:  # noqa: BLE001 — store hiccup: keep old base
+            return False
+        base = rec.get("base")
+        if base and base.rstrip("/") != self.base:
+            log.info("completion endpoint re-resolved: %s -> %s",
+                     self.base, base)
+            self.base = base.rstrip("/")
+            return True
+        return False
 
     def _post(self, path: str, params: dict, body: bytes = None) -> dict:
+        try:
+            return self._post_once(path, params, body)
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError):
+            # the controller may have failed over: re-resolve the
+            # active endpoint from the store and retry once. Completion
+            # ops are idempotent at the controller (reports re-enter
+            # the FSM; a duplicate commit_end fails the election check).
+            if self.store is None or not self._resolve():
+                raise
+            return self._post_once(path, params, body)
+
+    def _post_once(self, path: str, params: dict,
+                   body: bytes = None) -> dict:
+        if self.base is None:
+            # boot-order independence: no endpoint known yet (store-only
+            # construction before any leader published) — resolve now or
+            # surface a retriable connection error
+            if not self._resolve() and self.base is None:
+                raise ConnectionError(
+                    f"no controller endpoint published at "
+                    f"{CONTROLLER_ENDPOINT_PATH} yet")
         url = f"{self.base}{path}?" + urllib.parse.urlencode(params)
         req = urllib.request.Request(
             url, data=body, method="POST",

@@ -210,6 +210,14 @@ class ServerParticipant(StateModel):
         shutil.rmtree(self.local_segment_dir(table, segment),
                       ignore_errors=True)
 
+    def seal_consuming(self, timeout_s: float = 20.0) -> bool:
+        """Graceful drain: seal (commit) the consuming segments this
+        server owns, where possible, before it departs. No-op (True)
+        when the server never consumed."""
+        if self._realtime is None:
+            return True
+        return self._realtime.seal_all(timeout_s)
+
     def shutdown(self) -> None:
         if self._realtime is not None:
             self._realtime.shutdown()

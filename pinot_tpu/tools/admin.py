@@ -369,20 +369,49 @@ def _run_until_interrupt(stop) -> int:
 def cmd_start_controller(args) -> int:
     """Controller process: resource manager + store server (+ admin HTTP).
 
-    Parity: StartControllerCommand (the store server plays ZooKeeper)."""
+    Parity: StartControllerCommand (the store server plays ZooKeeper).
+    With --store-addr the controller joins an EXTERNAL store instead —
+    the HA shape where a lead and --standby peers share one durable
+    store and the leader lease (TTL + fencing token) decides who runs
+    the periodic tasks and the segment commit protocol."""
     from pinot_tpu.tools.distributed import DistributedController
+    store_addr = None
+    if args.store_addr:
+        host, port = args.store_addr.rsplit(":", 1)
+        store_addr = (host, int(port))
     ctrl = DistributedController(args.dir, store_port=args.store_port,
-                                 http=True, periodic=True)
+                                 http=True, periodic=True,
+                                 store_addr=store_addr,
+                                 standby=args.standby,
+                                 instance_id=args.instance_id,
+                                 lease_s=args.lease_s)
     print(json.dumps({"storePort": ctrl.store_port,
                       "httpPort": ctrl.http_port,
-                      "deepStore": ctrl.deep_store_dir}), flush=True)
+                      "deepStore": ctrl.deep_store_dir,
+                      "instanceId": ctrl.instance_id,
+                      "standby": ctrl.standby}), flush=True)
     return _run_until_interrupt(ctrl.stop)
+
+
+def cmd_start_store(args) -> int:
+    """Standalone durable store server — the ZooKeeper role for HA
+    controller deployments (the store must outlive any one controller)."""
+    from pinot_tpu.tools.distributed import StandaloneStore
+    store = StandaloneStore(args.dir, port=args.store_port)
+    print(json.dumps({"storePort": store.port}), flush=True)
+    return _run_until_interrupt(store.stop)
 
 
 def cmd_start_server(args) -> int:
     """Server process joined to the cluster through the remote store.
 
-    Parity: StartServerCommand."""
+    Parity: StartServerCommand. SIGTERM triggers the graceful DRAIN
+    path (seal consuming segments, deregister, finish in-flight work,
+    then exit) — a planned restart costs zero query errors; only
+    kill -9 exercises the self-healing chaos path."""
+    import signal
+    import threading
+
     from pinot_tpu.tools.distributed import DistributedServer
     host, port = args.store.rsplit(":", 1)
     srv = DistributedServer(args.instance_id, host, int(port),
@@ -397,10 +426,34 @@ def cmd_start_server(args) -> int:
         boot["adminPort"] = api.start(port=args.admin_port)
     print(json.dumps(boot), flush=True)
 
-    def shutdown():
+    done = {"drained": False}
+    drain_lock = threading.Lock()
+
+    def shutdown(drain: bool = False) -> bool:
+        """Returns whether THIS call performed the shutdown (the flag
+        is claimed before the long drain, outside the lock, so a
+        repeated signal returns immediately instead of re-entering)."""
+        with drain_lock:
+            if done["drained"]:
+                return False
+            done["drained"] = True
         if api is not None:
             api.stop()
-        srv.stop()
+        if drain:
+            srv.drain()
+        else:
+            srv.stop()
+        return True
+
+    def on_sigterm(_sig, _frame):
+        if not shutdown(drain=True):
+            # repeated SIGTERM while the drain runs in the interrupted
+            # frame below: ignore — raising here would abort the seal
+            # mid-commit (supervisors escalate to SIGKILL on their own)
+            return
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, on_sigterm)
     return _run_until_interrupt(shutdown)
 
 
@@ -786,7 +839,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dir", required=True,
                     help="work dir (deep store lives under it)")
     sp.add_argument("--store-port", type=int, default=2181)
+    sp.add_argument("--store-addr",
+                    help="host:port of an EXTERNAL store server (HA "
+                         "shape: lease-elected lead + standbys; this "
+                         "controller hosts no store of its own)")
+    sp.add_argument("--standby", action="store_true",
+                    help="hot standby: takes over the lead role (and "
+                         "its periodic tasks + commit protocol) when "
+                         "the current lease expires")
+    sp.add_argument("--instance-id")
+    sp.add_argument("--lease-s", type=float,
+                    help="leader-lease TTL override")
     sp.set_defaults(fn=cmd_start_controller)
+
+    sp = sub.add_parser("StartStore",
+                        help="run a standalone durable store server "
+                             "(the ZooKeeper role for HA controllers)")
+    sp.add_argument("--dir", required=True)
+    sp.add_argument("--store-port", type=int, default=2181)
+    sp.set_defaults(fn=cmd_start_store)
 
     sp = sub.add_parser("StartServer",
                         help="run a query server joined via the store")

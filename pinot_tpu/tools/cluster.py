@@ -5,11 +5,16 @@ ClusterTest.java:85 — real Controller/Broker/Server instances in one JVM)
 and the Quickstart wiring (tools/Quickstart.java:125-144). The full
 production plumbing runs: property store, state transitions, deep store,
 scatter-gather (in-process or TCP), broker reduce.
+
+Membership churn is programmable — ``add_server()`` / ``remove_server()``
+/ ``drain_server()`` — so chaos suites and scale-out benchmarks can grow,
+kill and drain servers mid-workload (the ClusterTest analogue of the
+reference's ChaosMonkey-style integration tests).
 """
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from pinot_tpu.broker.cluster_watcher import BrokerClusterWatcher
 from pinot_tpu.broker.request_handler import (BrokerRequestHandler,
@@ -36,36 +41,29 @@ class EmbeddedCluster:
         same work_dir/store_dir recovers its tables and segments."""
         from pinot_tpu.broker.quota import QueryQuotaManager
         self.work_dir = work_dir
+        self._tcp = tcp
+        self._mesh = mesh
+        self._scheduler = scheduler
+        self._http = http
+        self._server_max_pending = server_max_pending
         self.controller = Controller(os.path.join(work_dir, "deepstore"),
                                      store_dir=store_dir)
         self.servers: Dict[str, ServerInstance] = {}
         self.participants: Dict[str, ServerParticipant] = {}
-        for i in range(num_servers):
-            name = f"Server_{i}"
-            server = ServerInstance(name, scheduler=scheduler, mesh=mesh,
-                                    max_pending=server_max_pending)
-            self.servers[name] = server
-            participant = ServerParticipant(
-                server, self.controller.manager,
-                completion=self.controller.realtime,
-                work_dir=os.path.join(work_dir, "server_work", name))
-            self.participants[name] = participant
-            self.controller.coordinator.register_participant(name,
-                                                             participant)
+        if tcp:
+            self.transport = TcpTransport({})
+        else:
+            # InProcessTransport shares the live server dict, so
+            # add_server/remove_server mutate its view too
+            self.transport = InProcessTransport(self.servers)
         # ONE quota manager shared by the watcher (which converges
         # table-config quotas into it) and the broker (which enforces)
         self.quota = QueryQuotaManager()
         self.watcher = BrokerClusterWatcher(self.controller.coordinator,
                                             self.controller.manager,
                                             quota=self.quota)
-        if tcp:
-            endpoints = {name: ("127.0.0.1", server.start(port=0))
-                         for name, server in self.servers.items()}
-            transport = TcpTransport(endpoints)
-        else:
-            transport = InProcessTransport(self.servers)
         self.broker = BrokerRequestHandler(
-            self.watcher.routing, transport,
+            self.watcher.routing, self.transport,
             time_boundary=self.watcher.time_boundary,
             quota=self.quota,
             segment_pruner=self.watcher.partition_pruner,
@@ -74,27 +72,105 @@ class EmbeddedCluster:
         # result cache — the freshness bound only covers consuming-
         # ingestion staleness, not an offline backfill
         self.watcher.register_result_cache(self.broker.result_cache)
+        # a deregistered server's breaker/health state drops in the
+        # same watch event as its live record
+        self.watcher.attach_fault_tolerance(self.broker.fault_tolerance)
         self.broker_api = None
         self.controller_api = None
         self.server_apis: Dict[str, object] = {}
         self.broker_port: Optional[int] = None
         self.controller_port: Optional[int] = None
         self.server_http_ports: Dict[str, int] = {}
+        for i in range(num_servers):
+            self.add_server(f"Server_{i}")
         if http:
             from pinot_tpu.broker.http_api import BrokerApiServer
             from pinot_tpu.controller.http_api import ControllerApiServer
-            from pinot_tpu.server.http_api import ServerApiServer
             self.broker_api = BrokerApiServer(self.broker)
             self.broker_port = self.broker_api.start()
             self.controller_api = ControllerApiServer(self.controller)
             self.controller_port = self.controller_api.start()
-            # per-server admin APIs: /health, /metrics, table/segment
-            # debug views — the quickstart cluster serves the full
-            # observability surface on every plane
-            for name, server in self.servers.items():
-                api = ServerApiServer(server)
-                self.server_apis[name] = api
-                self.server_http_ports[name] = api.start()
+
+    # -- membership churn ---------------------------------------------------
+    def add_server(self, name: Optional[str] = None) -> str:
+        """Start a new query server, join it to the cluster (live
+        record + state transitions), and wire it into the broker's
+        data plane. Returns its instance id."""
+        if name is None:
+            i = len(self.servers)
+            while f"Server_{i}" in self.servers:
+                i += 1
+            name = f"Server_{i}"
+        if name in self.servers:
+            raise ValueError(f"server {name} already exists")
+        server = ServerInstance(name, scheduler=self._scheduler,
+                                mesh=self._mesh,
+                                max_pending=self._server_max_pending)
+        participant = ServerParticipant(
+            server, self.controller.manager,
+            completion=self.controller.realtime,
+            work_dir=os.path.join(self.work_dir, "server_work", name))
+        self.servers[name] = server
+        self.participants[name] = participant
+        if self._tcp:
+            port = server.start(port=0)
+            self.transport.set_endpoint(name, "127.0.0.1", port)
+        # registration LAST: the reconcile it triggers may immediately
+        # assign segments / consuming partitions to the new server
+        self.controller.coordinator.register_participant(name, participant)
+        if self._http:
+            from pinot_tpu.server.http_api import ServerApiServer
+            api = ServerApiServer(server)
+            self.server_apis[name] = api
+            self.server_http_ports[name] = api.start()
+        return name
+
+    def remove_server(self, name: str) -> None:
+        """Abrupt death (the embedded analogue of kill -9 / session
+        expiry): the live record and current states vanish with no
+        drain and no seal — the self-healing plane must repair."""
+        server = self.servers.pop(name)
+        participant = self.participants.pop(name)
+        # ephemeral-loss first: views, routing, broker ft state all
+        # react to the membership event while the "process" disappears
+        self.controller.coordinator.deregister_participant(name)
+        participant.shutdown()
+        server.stop()
+        api = self.server_apis.pop(name, None)
+        if api is not None:
+            api.stop()
+        self.server_http_ports.pop(name, None)
+
+    def drain_server(self, name: str, seal_timeout_s: float = 20.0,
+                     settle_s: float = 0.3) -> bool:
+        """Planned departure: seal consuming segments where possible,
+        deregister (brokers reroute on the watch event), let in-flight
+        work finish, then stop — zero query errors by construction.
+        Returns whether every sealable consumer actually sealed."""
+        import time
+        server = self.servers[name]
+        participant = self.participants[name]
+        sealed = participant.seal_consuming(seal_timeout_s)
+        self.controller.coordinator.deregister_participant(name)
+        # the embedded watch chain is synchronous, but the broker's
+        # in-flight scatters are not: give them a beat to finish
+        deadline = time.monotonic() + max(settle_s, 0.05)
+        while time.monotonic() < deadline and \
+                server.admission.depth() > 0:
+            time.sleep(0.02)
+        # only NOW leave the transport's server map: the seal and the
+        # settle window above still serve queries, and the in-process
+        # transport shares self.servers — popping first turned routed
+        # dispatches into KeyErrors during the seal
+        self.servers.pop(name, None)
+        self.participants.pop(name, None)
+        participant.shutdown()
+        server.stop()
+        api = self.server_apis.pop(name, None)
+        if api is not None:
+            api.stop()
+        self.server_http_ports.pop(name, None)
+        return sealed
 
     # -- admin facade (parity: controller REST) ----------------------------
     def add_schema(self, schema: Schema) -> None:
@@ -120,6 +196,7 @@ class EmbeddedCluster:
         for api in self.server_apis.values():
             api.stop()
         self.controller.stop()
+        self.watcher.close()
         self.broker.close()
         for participant in self.participants.values():
             participant.shutdown()

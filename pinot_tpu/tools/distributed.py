@@ -35,46 +35,142 @@ from pinot_tpu.server.instance import ServerInstance
 from pinot_tpu.server.participant import ServerParticipant
 
 
+class StandaloneStore:
+    """A property-store server in its own right — the ZooKeeper role.
+
+    HA controller deployments need the cluster store to OUTLIVE any one
+    controller (a lead controller hosting the store would take the whole
+    cluster down with it); this wrapper hosts a durable PropertyStore
+    behind the TCP store server with nothing else attached. Controllers,
+    servers and brokers all connect as clients."""
+
+    def __init__(self, work_dir: str, port: int = 0, durable: bool = True):
+        self.store = PropertyStore(
+            data_dir=os.path.join(work_dir, "store") if durable else None)
+        self.server = PropertyStoreServer(self.store, port=port)
+        self.port = self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.store.close()
+
+
 class DistributedController:
-    """Controller process: resource manager + store server + view composer
-    (+ optional admin HTTP)."""
+    """Controller process: resource manager + view composer (+ optional
+    admin HTTP). Hosts the store server itself by default; with
+    `store_addr` it joins an EXTERNAL store (StandaloneStore) instead —
+    the HA shape where a lead and one or more `standby=True` controllers
+    run hot against the same durable store, the lease (TTL + fencing
+    token) decides who leads, and a dead leader is succeeded within one
+    lease period."""
 
     def __init__(self, work_dir: str, store_port: int = 0,
                  http: bool = False, periodic: bool = False,
-                 durable: bool = True, download_base: Optional[str] = None):
+                 durable: bool = True, download_base: Optional[str] = None,
+                 store_addr: Optional[tuple] = None,
+                 standby: bool = False,
+                 instance_id: Optional[str] = None,
+                 lease_s: Optional[float] = None):
         """`durable`: journal cluster state under <work_dir>/store (WAL
         + snapshots) so a controller restarted over the same work_dir
         recovers every table, ideal state and segment record.
         `download_base="http"` (requires http=True): advertise segment
         downloadPaths through the controller's /deepstore endpoints —
         the no-shared-filesystem deployment where servers download and
-        cache artifacts locally."""
+        cache artifacts locally.
+        `store_addr`: (host, port) of an external store — enables the
+        HA shape (fenced mutations, lease heartbeat, endpoint
+        publication on takeover). `standby=True` marks a hot standby:
+        identical wiring, it simply won't win the lease until the
+        current one expires."""
+        if standby and store_addr is None:
+            raise ValueError("standby=True needs store_addr: a standby "
+                             "must share the lead controller's store")
         self.work_dir = work_dir
-        self.store = PropertyStore(
-            data_dir=os.path.join(work_dir, "store") if durable else None)
+        self.standby = standby
+        self._download_base = download_base
+        ha = store_addr is not None
+        if ha:
+            self.store = RemotePropertyStore(store_addr[0],
+                                             int(store_addr[1]))
+            self.store_server = None
+            self.store_port = int(store_addr[1])
+        else:
+            self.store = PropertyStore(
+                data_dir=os.path.join(work_dir, "store")
+                if durable else None)
+        if instance_id is None:
+            instance_id = f"Controller_{uuid.uuid4().hex[:8]}" if ha \
+                else "Controller_0"
+        self.instance_id = instance_id
         self.controller = Controller(os.path.join(work_dir, "deepstore"),
-                                     store=self.store)
-        self.composer = ViewComposer(self.store)
-        self.store_server = PropertyStoreServer(self.store, port=store_port)
-        self.store_port = self.store_server.start()
+                                     store=self.store,
+                                     instance_id=instance_id,
+                                     ha=ha, lease_s=lease_s)
+        # with peers over one store, only the LEADER composes views;
+        # a promoted standby catches up on the events its gate dropped
+        self.composer = ViewComposer(
+            self.store,
+            gate=self.controller.leadership.is_leader if ha else None)
+        if not ha:
+            self.store_server = PropertyStoreServer(self.store,
+                                                    port=store_port)
+            self.store_port = self.store_server.start()
         self.http_api = None
         self.http_port: Optional[int] = None
         if http:
             from pinot_tpu.controller.http_api import ControllerApiServer
             self.http_api = ControllerApiServer(self.controller)
             self.http_port = self.http_api.start()
-            if download_base == "http":
+            if download_base == "http" and not ha:
                 # advertise downloadPath through /deepstore so servers
                 # without a shared filesystem fetch over HTTP; the
                 # CURRENT endpoint is also published so servers re-base
                 # durable records stamped by a previous controller
                 # incarnation (a restart may land on a new port)
-                base = f"http://127.0.0.1:{self.http_port}"
-                self.controller.manager.download_base = base
-                self.store.set("/CONTROLLER/DEEPSTORE_BASE",
-                               {"base": base})
-        if periodic:
+                self._publish_endpoints()
+        if ha:
+            # publish this controller's endpoints the moment it becomes
+            # leader (boot for the lead, takeover for a standby): the
+            # active completion/deepstore endpoint always names the
+            # living leader. Registered BEFORE the lease is first
+            # claimed in controller.start().
+            def on_leader(leader: bool) -> None:
+                if leader:
+                    self.composer.recompose_all()
+                    # broker membership may have changed while this
+                    # controller's live watcher was fenced out (lead
+                    # dead, standby not yet promoted): replay the
+                    # /BROKERRESOURCE refresh the fence dropped, or
+                    # dynamic selectors keep routing at dead brokers
+                    # until an unrelated live event
+                    try:
+                        self.controller.manager \
+                            .refresh_all_broker_resources()
+                    except Exception:  # noqa: BLE001 — store racing
+                        pass           # shutdown; next event retries
+                    self._publish_endpoints()
+            self.controller.leadership.add_listener(on_leader)
+        if periodic or ha:
             self.controller.start()
+
+    def _publish_endpoints(self) -> None:
+        """Publish the ACTIVE controller's HTTP base for servers to
+        (re-)resolve: the completion protocol endpoint and — when this
+        deployment serves artifacts over HTTP — the deep-store base."""
+        if self.http_port is None:
+            return
+        base = f"http://127.0.0.1:{self.http_port}"
+        # raw store on purpose: the listener fires exactly on the
+        # leadership transition, and publishing must not race the
+        # fence's own bookkeeping
+        self.store.set("/CONTROLLER/ENDPOINT", {"base": base})
+        if self._download_base == "http":
+            self.controller.manager.download_base = base
+            self.store.set("/CONTROLLER/DEEPSTORE_BASE", {"base": base})
+
+    def is_leader(self) -> bool:
+        return self.controller.leadership.is_leader()
 
     @property
     def deep_store_dir(self) -> str:
@@ -85,16 +181,24 @@ class DistributedController:
             self.http_api.stop()
         self.controller.stop()
         self.composer.close()
-        self.store_server.stop()
+        if self.store_server is not None:
+            self.store_server.stop()
         self.store.close()
 
     def kill(self) -> None:
         """Crash simulation: sockets die, nothing is drained or
-        resigned — recovery must come from the store's WAL/snapshots
-        and the deep store alone."""
+        resigned — the leader lease is left to EXPIRE on its TTL, and
+        recovery must come from the store's WAL/snapshots and the deep
+        store alone."""
+        # silence this incarnation's background threads without any
+        # store writes (a real kill stops them too; in-process they'd
+        # otherwise spam the shared store with post-mortem activity)
+        self.controller.periodic.stop()
+        self.controller.leadership.abort()
         if self.http_api is not None:
             self.http_api.stop()
-        self.store_server.stop()
+        if self.store_server is not None:
+            self.store_server.stop()
         # the WAL handle is NOT fsync'd/closed gracefully on a real
         # crash either; close() only releases the fd so a successor
         # process (same test) can reopen the files
@@ -125,7 +229,12 @@ class DistributedServer:
         if controller_http is not None:
             from pinot_tpu.realtime.http_completion import \
                 HttpSegmentCompletionClient
-            completion = HttpSegmentCompletionClient(controller_http)
+            # "auto": resolve the ACTIVE controller purely from the
+            # published /CONTROLLER/ENDPOINT record (HA deployments —
+            # the store also lets the client re-resolve after failover)
+            completion = HttpSegmentCompletionClient(
+                None if controller_http == "auto" else controller_http,
+                store=self.store)
         self.participant = ServerParticipant(self.server, self.manager,
                                              completion=completion,
                                              work_dir=work_dir)
@@ -144,6 +253,63 @@ class DistributedServer:
         self.participant.shutdown()
         self.server.stop()
         self.store.close()
+
+    def drain(self, seal_timeout_s: float = 20.0,
+              settle_s: float = 10.0) -> bool:
+        """SIGTERM path — planned, errorless departure:
+
+        1. seal consuming segments where possible (commit through the
+           completion protocol — a planned restart leaves no unsealed
+           rows to re-consume),
+        2. deregister (live record + current states drop in one watch
+           chain; brokers stop routing NEW queries here),
+        3. keep serving until the external view no longer names this
+           instance and in-flight queries drained (bounded), then stop.
+
+        Returns whether every sealable consumer sealed. Distinguishes a
+        planned restart (zero client-visible errors) from kill -9 chaos
+        (masked by broker failover, healed by the controller).
+
+        Sealing runs BEFORE deregistration on purpose: the committed
+        rows stay queryable on this server through the whole window
+        (deregister-first would drop them from results until repair).
+        The cost is that commit_end assigns the successor consuming
+        segment back to this still-registered server; it departs with 0
+        rows and the takeover path re-places it within one grace window
+        — a bounded ingestion pause, never data loss or wrong answers."""
+        import time as _time
+        try:
+            sealed = self.participant.seal_consuming(seal_timeout_s)
+        except Exception:  # noqa: BLE001 — seal is best-effort
+            sealed = False
+        inst = self.agent.instance_id
+        self.agent.stop()
+        deadline = _time.monotonic() + settle_s
+
+        def view_clear() -> bool:
+            try:
+                for table in self.manager.coordinator.tables():
+                    states = self.manager.coordinator.external_view(
+                        table).segment_states
+                    if any(inst in s for s in states.values()):
+                        return False
+                return True
+            except Exception:  # noqa: BLE001 — store racing shutdown
+                return True
+        while _time.monotonic() < deadline and not view_clear():
+            _time.sleep(0.02)
+        # the brokers' own watch dispatch lags the controller's view
+        # write by a network hop: one fixed beat before draining
+        _time.sleep(min(0.25, settle_s))
+        # brokers' watch dispatch + already-scattered queries: serve
+        # until the admission queue drains (bounded by the same budget)
+        while _time.monotonic() < deadline and \
+                self.server.admission.depth() > 0:
+            _time.sleep(0.02)
+        self.participant.shutdown()
+        self.server.stop()
+        self.store.close()
+        return sealed
 
     def kill(self) -> None:
         """Crash simulation: the store session dies with the process —
@@ -202,6 +368,10 @@ class DistributedBroker:
         # result cache — the freshness bound only covers consuming-
         # ingestion staleness, not an offline backfill
         self.watcher.register_result_cache(self.handler.result_cache)
+        # a deregistered server leaves the candidate ranking in ONE
+        # watch event: breaker/health state forgotten, so a
+        # reincarnation on the same host:port starts clean
+        self.watcher.attach_fault_tolerance(self.handler.fault_tolerance)
         self.http_api = None
         self.http_port: Optional[int] = None
         self.instance_id = instance_id

@@ -33,6 +33,16 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_upsert.py \
 # re-reads before the key-map snapshot offset
 env JAX_PLATFORMS=cpu python scripts/upsert_smoke.py
 
+echo "== self-healing (membership churn + controller failover) =="
+# continuous two-table load (OFFLINE + REALTIME upserts) while the
+# harness kill -9s the consuming server, then the lead controller, then
+# SIGTERM-drains a server: replication must repair, consumption resume
+# with exact-count/latest-value convergence, the standby serve commits
+# within ~one lease period, and the drain cost zero query errors
+env JAX_PLATFORMS=cpu python -m pytest tests/test_selfheal.py \
+    -q -p no:cacheprovider
+env JAX_PLATFORMS=cpu python scripts/selfheal_smoke.py
+
 echo "== tenant isolation (ingress control) =="
 # two-tenant overload gate: an aggressor flooding at 10x its per-tenant
 # token-bucket quota must be throttled with typed 429s while the victim

@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Self-healing chaos gate: continuous two-table load under kill -9 +
+standby failover + graceful drain.
+
+Boots the HA distributed shape — a standalone durable store (the ZK
+role), a LEAD and a STANDBY controller sharing it (1s leader lease,
+fenced mutations), three servers, one broker — with TWO tables under a
+continuous query workload: an OFFLINE table at replication 2 and a
+REALTIME primary-key-upsert table. Then, in order:
+
+  1. kill -9 the server owning the consuming partition → the health
+     monitor declares it dead after grace, the rebalancer restores full
+     replication, and the consuming partition is taken over by a
+     survivor that resumes from the last committed offset —
+     exact-count + latest-value convergence.
+  2. kill -9 the LEAD controller → the standby's lease takeover happens
+     within ~one lease period; segment commits keep flowing through it
+     (servers re-resolve the active controller endpoint from the store).
+  3. SIGTERM-drain a server (seal consuming segments, deregister,
+     finish in-flight work) → ZERO query errors in the drain window.
+
+Gate: both tables converge exactly after every phase, zero NON-FLAGGED
+query errors across the whole run (kill -9 windows may surface
+partial-flagged responses — that's the broker being honest), zero
+errors of any kind during the drain, and the cluster ends at
+replication deficit 0. Result committed as SELFHEAL_r08.json.
+
+Env knobs:
+  SELFHEAL_ROWS       realtime rows (default 600)
+  SELFHEAL_WINDOW_S   per-phase convergence window (default 60)
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROWS = int(os.environ.get("SELFHEAL_ROWS", "600"))
+WINDOW_S = float(os.environ.get("SELFHEAL_WINDOW_S", "60"))
+OFF_TABLE = "baseballStats_OFFLINE"
+RT_TABLE = "upsertStats_REALTIME"
+TOPIC = "selfheal_topic"
+FACTORY = "mem_selfheal"
+LEASE_S = 1.0
+GRACE_S = 1.5
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — still converging
+            pass
+        time.sleep(0.1)
+    print(f"FAIL: timed out waiting for {what}", file=sys.stderr)
+    return False
+
+
+def upsert_schema():
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (Schema, TimeUnit, dimension,
+                                         metric, time_field)
+    return Schema("upsertStats", [
+        dimension("playerName", DataType.STRING),
+        dimension("teamID", DataType.STRING),
+        metric("runs", DataType.INT),
+        time_field("yearID", DataType.INT, TimeUnit.DAYS),
+    ])
+
+
+def upsert_config():
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig, TableConfig,
+                                               TableType, UpsertConfig)
+    return TableConfig(
+        "upsertStats", table_type=TableType.REALTIME,
+        indexing_config=IndexingConfig(stream_configs={
+            "stream.factory.name": FACTORY,
+            "stream.topic.name": TOPIC,
+            "realtime.segment.flush.threshold.size": "80",
+            "realtime.segment.flush.threshold.time.ms": "600000000",
+        }),
+        segments_config=SegmentsConfig(replication=1,
+                                       time_column_name="yearID"),
+        upsert_config=UpsertConfig(mode="FULL",
+                                   primary_key_columns=["playerName"]))
+
+
+def make_rows(n, seed):
+    import random
+    rng = random.Random(seed)
+    return [{
+        "playerName": f"player_{rng.randrange(max(40, n // 4)):04d}",
+        "teamID": rng.choice(["BOS", "NYA", "SEA", "HOU"]),
+        "runs": rng.randrange(0, 150),
+        "yearID": rng.randrange(1990, 2020),
+    } for _ in range(n)]
+
+
+def key_partition(row) -> int:
+    """Primary-key-hash partitioning (upsert requires a key to stay in
+    ONE stream partition — the per-partition key maps are independent)."""
+    import zlib
+    return zlib.crc32(row["playerName"].encode()) % 2
+
+
+class Workload(threading.Thread):
+    """Continuous two-table query loop; tallies error classes."""
+
+    def __init__(self, broker):
+        super().__init__(daemon=True)
+        self.broker = broker
+        self.stop_evt = threading.Event()
+        self.total = 0
+        self.flagged = 0            # partial-flagged responses (chaos-ok)
+        self.unflagged = []         # NEVER acceptable
+        self.window_errors = []     # any error inside a marked window
+        self._in_window = False
+        self._lock = threading.Lock()
+
+    def mark_window(self, active: bool) -> None:
+        with self._lock:
+            self._in_window = active
+
+    def run(self):
+        queries = ("SELECT COUNT(*) FROM baseballStats",
+                   "SELECT COUNT(*), SUM(runs) FROM upsertStats")
+        i = 0
+        while not self.stop_evt.is_set():
+            q = queries[i % 2]
+            i += 1
+            try:
+                resp = self.broker.query(q)
+                exceptions = list(resp.exceptions or ())
+                flagged = bool(resp.partial_response)
+            except Exception as e:  # noqa: BLE001 — an unhandled raise
+                exceptions, flagged = [f"raised: {e}"], False
+            self.total += 1
+            if exceptions:
+                if flagged:
+                    self.flagged += 1
+                else:
+                    self.unflagged.append((q, exceptions[:1]))
+                with self._lock:
+                    if self._in_window:
+                        self.window_errors.append((q, exceptions[:1]))
+            time.sleep(0.02)
+
+
+def main() -> int:
+    from pinot_tpu.common.metrics import ControllerMeter
+    from pinot_tpu.controller.rebalance import replication_deficit
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.distributed import (DistributedBroker,
+                                             DistributedController,
+                                             DistributedServer,
+                                             StandaloneStore)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tests"))
+    from fixtures import build_segment, make_schema, make_table_config
+    from pinot_tpu.common.table_config import SegmentsConfig
+
+    base = tempfile.mkdtemp(prefix="pinot_tpu_selfheal_")
+    t0 = time.monotonic()
+    result = {"phases": {}}
+
+    def log(msg):
+        print(f"[{time.monotonic() - t0:6.1f}s] {msg}", flush=True)
+
+    stream = MemoryStream(TOPIC, num_partitions=2)
+    registry.register_stream_factory(
+        FACTORY, MemoryStreamConsumerFactory(stream, batch_size=40))
+
+    zk = StandaloneStore(os.path.join(base, "zk"))
+    lead = DistributedController(base, store_addr=("127.0.0.1", zk.port),
+                                 instance_id="ctrl_lead", http=True,
+                                 lease_s=LEASE_S)
+    standby = DistributedController(base,
+                                    store_addr=("127.0.0.1", zk.port),
+                                    standby=True, http=True,
+                                    instance_id="ctrl_standby",
+                                    lease_s=LEASE_S)
+    for ctrl in (lead, standby):
+        ctrl.controller.health_monitor.grace_s = GRACE_S
+    if not wait_for(lead.is_leader, 10, "lead controller lease"):
+        return 1
+    servers = {}
+    for i in range(3):
+        name = f"Server_{i}"
+        servers[name] = DistributedServer(
+            name, "127.0.0.1", zk.port, lead.deep_store_dir,
+            work_dir=os.path.join(base, f"s{i}_work"),
+            controller_http="auto")
+    broker = DistributedBroker("127.0.0.1", zk.port, lead.deep_store_dir)
+
+    # -- tables + data ------------------------------------------------------
+    mgr = lead.controller.manager
+    mgr.add_schema(make_schema())
+    mgr.add_schema(upsert_schema())
+    mgr.add_table(make_table_config(
+        segments_config=SegmentsConfig(replication=2)))
+    off_total = 0
+    for i in range(3):
+        d = os.path.join(base, f"offseg{i}")
+        os.makedirs(d)
+        build_segment(d, n=700, seed=40 + i, name=f"offseg_{i}")
+        mgr.add_segment(OFF_TABLE, d)
+        off_total += 700
+    lead.controller.realtime.setup_table(upsert_config())
+
+    rows = make_rows(ROWS, seed=23)
+    latest = {}
+    for r in rows:
+        latest[r["playerName"]] = r
+    third = ROWS // 3
+    for r in rows[:third]:
+        stream.publish(r, partition=key_partition(r))
+    exp1 = {r["playerName"]: r for r in rows[:third]}
+
+    def off_count():
+        r = broker.query("SELECT COUNT(*) FROM baseballStats")
+        return -1 if r.exceptions else \
+            int(r.aggregation_results[0].value)
+
+    def rt_state():
+        r = broker.query("SELECT COUNT(*), SUM(runs) FROM upsertStats")
+        if r.exceptions or not r.aggregation_results:
+            return (-1, -1.0)
+        return (int(r.aggregation_results[0].value),
+                float(r.aggregation_results[1].value))
+
+    def rt_converged(expect):
+        cnt = len(expect)
+        total = float(sum(r["runs"] for r in expect.values()))
+        return rt_state() == (cnt, total)
+
+    def consuming_owners():
+        from pinot_tpu.realtime.segment_name import LLCSegmentName
+        ideal = standby.controller.coordinator.ideal_state(RT_TABLE)
+        owners = {}
+        for seg, states in ideal.items():
+            for inst, st in states.items():
+                if st == "CONSUMING" and LLCSegmentName.is_llc(seg):
+                    owners[LLCSegmentName.parse(seg).partition] = inst
+        return owners
+
+    def committed_count():
+        m = standby.controller.manager
+        return sum(1 for s in m.segment_names(RT_TABLE)
+                   if (m.segment_metadata(RT_TABLE, s) or {}).get(
+                       "status") == "DONE")
+
+    if not wait_for(lambda: off_count() == off_total, WINDOW_S,
+                    "offline bootstrap"):
+        return 1
+    if not wait_for(lambda: rt_converged(exp1), WINDOW_S,
+                    "realtime bootstrap (needs a committed segment for "
+                    "the workload to survive the kill)"):
+        return 1
+    if not wait_for(lambda: committed_count() >= 1, WINDOW_S,
+                    "first committed upsert segment"):
+        return 1
+    log(f"bootstrap: offline={off_total} rows, realtime "
+        f"{len(exp1)} keys, {committed_count()} committed segment(s)")
+
+    workload = Workload(broker)
+    workload.start()
+    ok = True
+    try:
+        # ---- phase 1: kill -9 the consuming server ------------------------
+        owners = consuming_owners()
+        assert owners, "no consuming partitions"
+        part, victim = sorted(owners.items())[0]
+        p0 = time.monotonic()
+        workload.mark_window(True)      # chaos window: flagged-only
+        servers.pop(victim).kill()
+        log(f"phase 1: kill -9 {victim} (owned the consuming partition)")
+        for r in rows[third:2 * third]:
+            stream.publish(r, partition=key_partition(r))
+        exp2 = {r["playerName"]: r for r in rows[:2 * third]}
+        ok &= wait_for(
+            lambda: replication_deficit(standby.controller.manager) == 0,
+            WINDOW_S, "replication repaired after server kill")
+        ok &= wait_for(
+            lambda: consuming_owners().get(part) not in (None, victim),
+            WINDOW_S, f"takeover of consuming partition {part}")
+        ok &= wait_for(lambda: off_count() == off_total, WINDOW_S,
+                       "offline count after repair")
+        ok &= wait_for(lambda: rt_converged(exp2), WINDOW_S,
+                       "realtime exact-count/latest-value after takeover")
+        workload.mark_window(False)
+        result["phases"]["killServer"] = {
+            "victim": victim, "seconds": round(time.monotonic() - p0, 2),
+            "converged": bool(ok)}
+        log(f"phase 1 done in {time.monotonic() - p0:.1f}s (ok={ok})")
+
+        # ---- phase 2: kill -9 the lead controller -------------------------
+        commits_before = committed_count()
+        p0 = time.monotonic()
+        lead.kill()
+        log("phase 2: kill -9 lead controller (lease must expire)")
+        ok &= wait_for(standby.is_leader, 10, "standby lease takeover")
+        takeover_s = time.monotonic() - p0
+        if takeover_s > 3 * LEASE_S + 1.0:
+            print(f"FAIL: takeover took {takeover_s:.1f}s "
+                  f"(> ~one lease period)", file=sys.stderr)
+            ok = False
+        # commits must flow THROUGH THE STANDBY: publish enough to seal
+        for r in rows[2 * third:]:
+            stream.publish(r, partition=key_partition(r))
+        exp3 = {r["playerName"]: r for r in rows}
+        ok &= wait_for(lambda: committed_count() > commits_before,
+                       WINDOW_S, "a segment committed via the standby")
+        ok &= wait_for(lambda: rt_converged(exp3), WINDOW_S,
+                       "realtime convergence under the standby")
+        ok &= wait_for(lambda: off_count() == off_total, WINDOW_S,
+                       "offline count under the standby")
+        result["phases"]["killController"] = {
+            "takeoverSeconds": round(takeover_s, 2),
+            "leasePeriodSeconds": LEASE_S,
+            "commitsViaStandby": committed_count() - commits_before,
+            "leaderFailovers": standby.controller.metrics.meter(
+                ControllerMeter.LEADER_FAILOVERS).count,
+            "converged": bool(ok)}
+        log(f"phase 2 done: takeover {takeover_s:.2f}s, "
+            f"{committed_count() - commits_before} commit(s) via standby")
+
+        # ---- phase 3: SIGTERM drain ---------------------------------------
+        victim2 = next((inst for inst in consuming_owners().values()
+                        if inst in servers), None) or sorted(servers)[0]
+        p0 = time.monotonic()
+        err_before = len(workload.window_errors)
+        workload.mark_window(True)      # drain window: NO errors at all
+        sealed = servers.pop(victim2).drain()
+        drain_errors = list(workload.window_errors[err_before:])
+        workload.mark_window(False)
+        ok &= wait_for(
+            lambda: replication_deficit(standby.controller.manager) == 0,
+            WINDOW_S, "replication repaired after drain")
+        ok &= wait_for(lambda: rt_converged(exp3), WINDOW_S,
+                       "realtime convergence after drain")
+        ok &= wait_for(lambda: off_count() == off_total, WINDOW_S,
+                       "offline count after drain")
+        if drain_errors:
+            print(f"FAIL: {len(drain_errors)} query error(s) during the "
+                  f"drain window: {drain_errors[:3]}", file=sys.stderr)
+            ok = False
+        result["phases"]["drainServer"] = {
+            "victim": victim2, "sealed": bool(sealed),
+            "seconds": round(time.monotonic() - p0, 2),
+            "drainWindowErrors": len(drain_errors),
+            "converged": bool(ok)}
+        log(f"phase 3 done: drained {victim2} (sealed={sealed}, "
+            f"{len(drain_errors)} window errors)")
+    finally:
+        workload.stop_evt.set()
+        workload.join(timeout=10)
+
+    if workload.unflagged:
+        print(f"FAIL: {len(workload.unflagged)} NON-FLAGGED query "
+              f"error(s): {workload.unflagged[:3]}", file=sys.stderr)
+        ok = False
+    metrics = standby.controller.metrics
+    result.update({
+        "ok": bool(ok),
+        "queries": workload.total,
+        "flaggedPartialResponses": workload.flagged,
+        "unflaggedErrors": len(workload.unflagged),
+        "rebalanceMoves": metrics.meter(
+            ControllerMeter.REBALANCE_MOVES).count,
+        "partitionTakeovers": metrics.meter(
+            ControllerMeter.PARTITION_TAKEOVERS).count,
+        "finalReplicationDeficit": replication_deficit(
+            standby.controller.manager),
+        "offlineRows": off_total,
+        "realtimeKeys": len(latest),
+    })
+    print(json.dumps(result, indent=2))
+    if ok:
+        art = os.path.join(os.path.dirname(__file__), "..",
+                           "SELFHEAL_r08.json")
+        with open(art, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"PASS: self-healing gate green; artifact {art}")
+
+    broker.stop()
+    for srv in servers.values():
+        try:
+            srv.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    standby.stop()
+    zk.stop()
+    registry.unregister_stream_factory(FACTORY)
+    shutil.rmtree(base, ignore_errors=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

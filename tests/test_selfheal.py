@@ -1,0 +1,653 @@
+"""Self-healing chaos suite: liveness-driven rebalance, realtime
+partition takeover, standby controller failover, graceful drain.
+
+Invariants under every scenario (docs/ROBUSTNESS.md "Self-healing &
+membership churn"):
+  1. no double-owned consuming partition,
+  2. no replica-count regression below live capacity once converged,
+  3. a deposed leader's store writes are fenced,
+  4. a drained (SIGTERM) server costs zero query errors.
+
+Clock-sensitive pieces (death grace window, leader lease) run on
+injectable clocks — no wall-clock sleeps in the unit tier; only the
+distributed end-to-end tests wait on real convergence like
+test_distributed.py does.
+"""
+import os
+import time
+
+import pytest
+
+from fixtures import build_segment, make_schema, make_table_config
+from test_realtime import make_rows, rt_config, wait_until
+
+from pinot_tpu.common.cluster_state import CONSUMING, ONLINE
+from pinot_tpu.common.faults import InjectedCrash, crash_points
+from pinot_tpu.common.table_config import SegmentsConfig
+from pinot_tpu.controller.rebalance import (ClusterHealthMonitor,
+                                            SegmentRebalancer,
+                                            replication_deficit)
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+TABLE = "baseballStats_OFFLINE"
+
+
+@pytest.fixture(autouse=True)
+def _clear_crash_points():
+    crash_points.clear()
+    yield
+    crash_points.clear()
+
+
+def _offline_cluster(tmp_path, num_servers=3, replication=2, segments=4):
+    cluster = EmbeddedCluster(str(tmp_path), num_servers=num_servers)
+    cluster.add_schema(make_schema())
+    cfg = make_table_config(
+        segments_config=SegmentsConfig(replication=replication))
+    cluster.add_table(cfg)
+    total = 0
+    for i in range(segments):
+        d = os.path.join(str(tmp_path), f"seg{i}")
+        os.makedirs(d)
+        build_segment(d, n=500, seed=30 + i, name=f"healseg_{i}")
+        cluster.upload_segment(TABLE, d)
+        total += 500
+    return cluster, total
+
+
+def _monitor(cluster, clock, grace_s=5.0):
+    return ClusterHealthMonitor(
+        rebalancer=SegmentRebalancer(cluster.controller.manager,
+                                     metrics=cluster.controller.metrics),
+        realtime_manager=cluster.controller.realtime,
+        grace_s=grace_s, clock=lambda: clock["t"],
+        metrics=cluster.controller.metrics)
+
+
+def _ideal(cluster, table=TABLE):
+    return cluster.controller.coordinator.ideal_state(table)
+
+
+def _count(cluster):
+    resp = cluster.query("SELECT COUNT(*) FROM baseballStats")
+    return -1 if resp.exceptions else \
+        int(resp.aggregation_results[0].value)
+
+
+# ---------------------------------------------------------------------------
+# Liveness monitor + rebalancer
+# ---------------------------------------------------------------------------
+
+def test_death_repair_waits_for_grace_then_heals(tmp_path):
+    cluster, total = _offline_cluster(tmp_path)
+    mgr = cluster.controller.manager
+    clock = {"t": 100.0}
+    mon = _monitor(cluster, clock, grace_s=5.0)
+    mon.run(mgr)                       # baseline: learn the membership
+    assert replication_deficit(mgr) == 0
+
+    cluster.remove_server("Server_1")  # kill -9 analogue
+    assert replication_deficit(mgr) > 0
+    mon.run(mgr)                       # observed missing, inside grace
+    assert any("Server_1" in states for states in _ideal(cluster).values())
+
+    clock["t"] += 4.0                  # still inside the grace window
+    mon.run(mgr)
+    assert mon.last_report["dead"] == []
+
+    clock["t"] += 2.0                  # grace passed: declared dead
+    mon.run(mgr)
+    assert mon.last_report["dead"] == ["Server_1"]
+    ideal = _ideal(cluster)
+    live = {"Server_0", "Server_2"}
+    for seg, states in ideal.items():
+        assert set(states) <= live, f"{seg} still names the corpse"
+        assert len(states) == 2      # back at full replication
+    assert replication_deficit(mgr) == 0
+    assert cluster.controller.metrics.meter("rebalanceMoves").count > 0
+    assert _count(cluster) == total
+    # converged: the next cycle is a no-op (no ideal-state churn)
+    before = _ideal(cluster)
+    mon.run(mgr)
+    assert _ideal(cluster) == before
+    cluster.stop()
+
+
+def test_restart_within_grace_is_not_a_death(tmp_path):
+    cluster, _ = _offline_cluster(tmp_path, num_servers=2)
+    mgr = cluster.controller.manager
+    clock = {"t": 0.0}
+    mon = _monitor(cluster, clock, grace_s=10.0)
+    mon.run(mgr)
+    before = _ideal(cluster)
+    cluster.remove_server("Server_1")
+    clock["t"] += 5.0
+    mon.run(mgr)                       # missing but inside grace
+    cluster.add_server("Server_1")     # restarted under the same id
+    clock["t"] += 20.0
+    mon.run(mgr)
+    assert mon.last_report["dead"] == []
+    # the restart reloaded its replicas: assignment unchanged
+    assert _ideal(cluster) == before
+    cluster.stop()
+
+
+def test_same_id_rejoin_after_prune_heals(tmp_path):
+    """A server declared dead (replicas pruned) that REJOINS under the
+    same id is a comeback, not a resurrection: the join path must
+    re-add replicas — nothing else would, since the id is already in
+    the monitor's seen-set and no further death event fires."""
+    cluster, total = _offline_cluster(tmp_path, num_servers=2,
+                                      replication=2)
+    mgr = cluster.controller.manager
+    clock = {"t": 0.0}
+    mon = _monitor(cluster, clock, grace_s=0.0)
+    mon.run(mgr)
+    cluster.remove_server("Server_1")
+    mon.run(mgr)                       # dead + pruned to capacity 1
+    for states in _ideal(cluster).values():
+        assert set(states) == {"Server_0"}
+    cluster.add_server("Server_1")     # same id returns
+    mon.run(mgr)
+    assert "Server_1" in mon.last_report["joined"]
+    for states in _ideal(cluster).values():
+        assert len(states) == 2        # topped back up
+    assert replication_deficit(mgr) == 0
+    assert _count(cluster) == total
+    cluster.stop()
+
+
+def test_selfheal_metrics_exposed_from_boot(tmp_path):
+    """The self-healing meters/gauge ride the controller's Prometheus
+    exposition from boot — operators see zeros, not absence."""
+    import re
+    from pinot_tpu.obs.prometheus import render_prometheus
+    # 3 servers: live CAPACITY stays >= replication after one death, so
+    # the lost replicas register as deficit (with 2 servers the cap
+    # itself would drop and the gauge honestly read 0)
+    cluster, _ = _offline_cluster(tmp_path, num_servers=3)
+    text = render_prometheus(cluster.controller.metrics)
+    for name in ("pinot_controller_rebalance_moves_total",
+                 "pinot_controller_partition_takeovers_total",
+                 "pinot_controller_leader_failovers_total",
+                 "pinot_controller_cluster_replication_deficit"):
+        assert name in text, f"{name} missing from /metrics"
+    # the gauge is live: a death raises it until repair lands
+    cluster.remove_server("Server_1")
+    deficit = replication_deficit(cluster.controller.manager)
+    assert deficit > 0
+    assert re.search(r"pinot_controller_cluster_replication_deficit "
+                     rf"{deficit}\b",
+                     render_prometheus(cluster.controller.metrics))
+    cluster.stop()
+
+
+def test_repair_caps_at_live_capacity(tmp_path):
+    """Replication 2, both remaining servers die except one: the
+    rebalancer repairs to ONE live replica (capacity), never below,
+    and tops back up when capacity returns."""
+    cluster, total = _offline_cluster(tmp_path, num_servers=2,
+                                      replication=2)
+    mgr = cluster.controller.manager
+    clock = {"t": 0.0}
+    mon = _monitor(cluster, clock, grace_s=0.0)
+    mon.run(mgr)
+    cluster.remove_server("Server_1")
+    mon.run(mgr)
+    for seg, states in _ideal(cluster).items():
+        assert set(states) == {"Server_0"}, seg
+    assert replication_deficit(mgr) == 0      # capped at live capacity
+    assert _count(cluster) == total
+    # capacity returns: join triggers repair back to full replication
+    cluster.add_server("Server_9")
+    mon.run(mgr)
+    # the join event rebalances; the deficit (repl 2 > 1 holder) is the
+    # repair path's job on the same cycle
+    for states in _ideal(cluster).values():
+        assert len(states) == 2
+    assert _count(cluster) == total
+    cluster.stop()
+
+
+def test_rebalance_on_join_is_throttled_and_makes_before_breaking(tmp_path):
+    cluster, total = _offline_cluster(tmp_path, num_servers=2,
+                                      replication=1, segments=6)
+    mgr = cluster.controller.manager
+    clock = {"t": 0.0}
+    mon = _monitor(cluster, clock)
+    mon.rebalancer.max_moves_per_cycle = 2      # tight throttle
+    mon.run(mgr)
+    cluster.add_server("Server_new")
+    mon.run(mgr)
+    assert mon.last_report["joined"] == ["Server_new"]
+    moved = mon.last_report["joinMoves"].get("Server_new", {})
+    n_moved = sum(len(m) for m in moved.values())
+    assert 1 <= n_moved <= 2                    # bounded per cycle
+    # every segment still has exactly its replica count — the move was
+    # make-before-break, never a drop-first
+    for seg, states in _ideal(cluster).items():
+        assert len(states) == 1, (seg, states)
+    assert _count(cluster) == total
+    cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Crash points: a controller dying mid-rebalance/mid-takeover leaves no
+# double-owned or orphaned replica; a fresh monitor (restart) converges.
+# ---------------------------------------------------------------------------
+
+def _assert_healthy(cluster, live, replication):
+    for seg, states in _ideal(cluster).items():
+        holders = [i for i in states]
+        assert len(set(holders)) == len(holders)          # no double-own
+        assert set(holders) <= set(live)
+        assert len(holders) == min(replication, len(live))  # no orphan
+
+
+@pytest.mark.parametrize("point", ["rebalance.move_staged",
+                                   "rebalance.pre_commit"])
+def test_controller_crash_mid_rebalance_converges(tmp_path, point):
+    cluster, total = _offline_cluster(tmp_path, num_servers=3,
+                                      replication=2)
+    mgr = cluster.controller.manager
+    clock = {"t": 0.0}
+    mon = _monitor(cluster, clock, grace_s=0.0)
+    mon.run(mgr)
+    cluster.remove_server("Server_1")
+    crash_points.arm(point)
+    with pytest.raises(InjectedCrash):
+        mon.run(mgr)
+    # "restart": all in-memory monitor/rebalancer state is lost; the
+    # durable ideal state is whatever the crash left behind
+    mon2 = _monitor(cluster, clock, grace_s=0.0)
+    mon2.run(mgr)       # learns membership fresh (baseline has no corpse)
+    mon2.run(mgr)
+    _assert_healthy(cluster, ["Server_0", "Server_2"], 2)
+    assert _count(cluster) == total
+    cluster.stop()
+
+
+def test_controller_crash_mid_takeover_resumes_consumption(tmp_path):
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    stream = MemoryStream("topic_heal", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_heal", MemoryStreamConsumerFactory(stream, batch_size=32))
+    cluster = EmbeddedCluster(str(tmp_path), num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(rt_config("mem_heal", "topic_heal",
+                                    flush_rows=100))
+        rows = make_rows(250, seed=5)
+        for r in rows[:150]:
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: _count(cluster) == 150, timeout=30)
+        rt = "baseballStats_REALTIME"
+        ideal = cluster.controller.coordinator.ideal_state(rt)
+        owner = next(i for states in ideal.values()
+                     for i, st in states.items() if st == CONSUMING)
+
+        clock = {"t": 0.0}
+        mon = _monitor(cluster, clock, grace_s=0.0)
+        mon.run(cluster.controller.manager)
+        cluster.remove_server(owner)
+        crash_points.arm("takeover.pre_resume")
+        with pytest.raises(InjectedCrash):
+            mon.run(cluster.controller.manager)
+        # crash window: partition bounced OFFLINE, no new owner yet —
+        # exactly one of OFFLINE-parked or unassigned, never two owners
+        ideal = cluster.controller.coordinator.ideal_state(rt)
+        assert not any(st == CONSUMING and i != owner
+                       for states in ideal.values()
+                       for i, st in states.items())
+        # restarted controller's monitor finishes the takeover
+        mon2 = _monitor(cluster, clock, grace_s=0.0)
+        mon2.run(cluster.controller.manager)
+        ideal = cluster.controller.coordinator.ideal_state(rt)
+        consuming = [(s, i) for s, states in ideal.items()
+                     for i, st in states.items() if st == CONSUMING]
+        assert len(consuming) == 1          # no double-owned partition
+        assert consuming[0][1] != owner
+        for r in rows[150:]:
+            stream.publish(r, partition=0)
+        # the new owner resumed from the last committed offset: exact
+        # count, nothing lost, nothing doubled
+        assert wait_until(lambda: _count(cluster) == 250, timeout=30)
+        assert cluster.controller.metrics.meter(
+            "partitionTakeovers").count >= 1
+    finally:
+        registry.unregister_stream_factory("mem_heal")
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Scrubber: dead-host replicas defer to the rebalancer (no bounce burn)
+# ---------------------------------------------------------------------------
+
+def test_scrubber_defers_dead_host_to_rebalancer(tmp_path):
+    from pinot_tpu.controller.periodic import SegmentIntegrityChecker
+    cluster, total = _offline_cluster(tmp_path, num_servers=3,
+                                      replication=2)
+    mgr = cluster.controller.manager
+    cluster.remove_server("Server_1")   # permanently dead instance
+    checker = SegmentIntegrityChecker()
+    checker.run(mgr)                    # ONE run, no grace, no bounces
+    # the corpse was reassigned immediately — zero bounce budget burned
+    assert not any(key[2] == "Server_1"
+                   for key in checker._bounce_counts)
+    for seg, states in _ideal(cluster).items():
+        assert "Server_1" not in states, seg
+        assert len(states) == 2
+    report = checker.last_report.get(TABLE, {})
+    assert report.get("reassigned"), report
+    assert _count(cluster) == total
+    # converged: a second run reports nothing
+    checker.run(mgr)
+    assert not checker.last_report.get(TABLE, {}).get("reassigned")
+    cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Broker fault-tolerance state for deregistered servers
+# ---------------------------------------------------------------------------
+
+def test_forget_clears_breaker_for_reincarnation():
+    from pinot_tpu.broker.fault_tolerance import (BREAKER_CLOSED,
+                                                  BREAKER_OPEN,
+                                                  FaultToleranceManager)
+    now = {"t": 0.0}
+    ft = FaultToleranceManager(clock=lambda: now["t"],
+                               breaker_failure_threshold=2)
+    for _ in range(3):
+        ft.on_failure("Server_X")
+    assert ft.breaker_state("Server_X") == BREAKER_OPEN
+    assert ft.health("Server_X") < 0.5
+    ft.forget("Server_X")
+    # a reincarnation under the same id starts CLEAN — no inherited
+    # breaker, full health, and the exported gauges reset with it
+    assert ft.breaker_state("Server_X") == BREAKER_CLOSED
+    assert ft.health("Server_X") == 1.0
+    assert ft.allow_request("Server_X")
+    snap = ft.metrics.snapshot()
+    assert snap["gauge.Server_X.breakerState"] == BREAKER_CLOSED
+    assert snap["gauge.Server_X.serverHealth"] == 1.0
+
+
+def test_live_instance_removal_forgets_ft_state(tmp_path):
+    from pinot_tpu.broker.fault_tolerance import BREAKER_OPEN
+    cluster, total = _offline_cluster(tmp_path, num_servers=2,
+                                      replication=2)
+    ft = cluster.broker.fault_tolerance
+    for _ in range(10):
+        ft.on_failure("Server_1")
+    assert ft.breaker_state("Server_1") == BREAKER_OPEN
+    # the SAME watch event that drops the live record clears the state
+    cluster.remove_server("Server_1")
+    with ft._lock:
+        assert "Server_1" not in ft._servers
+    assert _count(cluster) == total     # survivor serves everything
+    cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain: planned departure costs zero query errors
+# ---------------------------------------------------------------------------
+
+def test_drain_is_errorless_under_load(tmp_path):
+    import threading
+    cluster, total = _offline_cluster(tmp_path, num_servers=2,
+                                      replication=2)
+    failures, stop = [], threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            r = cluster.query("SELECT COUNT(*) FROM baseballStats")
+            if r.exceptions or \
+                    int(r.aggregation_results[0].value) != total:
+                failures.append(r.to_json())
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        time.sleep(0.2)
+        cluster.drain_server("Server_1")
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        t.join()
+    assert not failures, failures[:2]
+    assert _count(cluster) == total
+    cluster.stop()
+
+
+def test_drain_seals_consuming_segment(tmp_path):
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    stream = MemoryStream("topic_drain", num_partitions=1)
+    registry.register_stream_factory(
+        "mem_drain", MemoryStreamConsumerFactory(stream, batch_size=32))
+    cluster = EmbeddedCluster(str(tmp_path), num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(rt_config("mem_drain", "topic_drain",
+                                    flush_rows=100_000))
+        rows = make_rows(120, seed=8)
+        for r in rows:
+            stream.publish(r, partition=0)
+        assert wait_until(lambda: _count(cluster) == 120, timeout=30)
+        sealed = cluster.drain_server("Server_0")
+        assert sealed
+        rt = "baseballStats_REALTIME"
+        mgr = cluster.controller.manager
+        done = [s for s in mgr.segment_names(rt)
+                if (mgr.segment_metadata(rt, s) or {}).get("status") ==
+                "DONE"]
+        # the in-flight rows were committed durably BEFORE departure —
+        # a replacement server serves them from the deep store without
+        # re-consuming the stream
+        assert done, "drain did not seal the consuming segment"
+        name = cluster.add_server("Server_1")
+        # the departed server's committed replica + consuming successor
+        # move to the replacement via the self-healing plane (the
+        # drained holder is a stale ideal-state entry, repaired like a
+        # death once its grace elapses — zero here)
+        clock = {"t": 0.0}
+        mon = _monitor(cluster, clock, grace_s=0.0)
+        mon.run(cluster.controller.manager)
+        assert wait_until(lambda: _count(cluster) == 120, timeout=30)
+        assert name in cluster.servers
+    finally:
+        registry.unregister_stream_factory("mem_drain")
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Leadership: lease expiry, fencing, split-brain impossibility — all on
+# the injectable clock (test_crash_recovery.py style, no wall sleeps)
+# ---------------------------------------------------------------------------
+
+def _two_controllers(lease_s=10.0):
+    from pinot_tpu.controller.leadership import ControllerLeadershipManager
+    from pinot_tpu.controller.property_store import PropertyStore
+    store = PropertyStore()
+    now = {"t": 1000.0}
+    a = ControllerLeadershipManager(store, "ctrl_a", lease_s=lease_s,
+                                    clock=lambda: now["t"])
+    b = ControllerLeadershipManager(store, "ctrl_b", lease_s=lease_s,
+                                    clock=lambda: now["t"])
+    return store, now, a, b
+
+
+def test_lease_expiry_promotes_standby_and_bumps_epoch():
+    store, now, a, b = _two_controllers(lease_s=10.0)
+    assert a.try_acquire() is True
+    epoch_a = a.fencing_token()
+    assert epoch_a == 1
+    assert b.try_acquire() is False          # unexpired lease holds
+    now["t"] += 5.0
+    assert a.try_acquire() is True           # refresh keeps the epoch
+    assert a.fencing_token() == epoch_a
+    now["t"] += 10.1                         # a went silent: lease dies
+    assert b.try_acquire() is True           # standby takes over
+    assert b.fencing_token() == epoch_a + 1  # fencing token advanced
+    assert not a.is_leader()
+    assert not a.holds_fenced_lease()
+    assert b.holds_fenced_lease()
+
+
+def test_fencing_rejects_deposed_leaders_delayed_write():
+    from pinot_tpu.controller.leadership import (FencedStore,
+                                                 FencedWriteError)
+    store, now, a, b = _two_controllers(lease_s=10.0)
+    fenced_a = FencedStore(store, a)
+    fenced_b = FencedStore(store, b)
+    assert a.try_acquire()
+    fenced_a.set("/IDEALSTATES/t1", {"segments": {"s": {"a": "ONLINE"}}})
+    now["t"] += 11.0
+    assert b.try_acquire()                   # a is deposed
+    # the delayed write a had in flight when its lease expired: REJECTED
+    with pytest.raises(FencedWriteError):
+        fenced_a.set("/IDEALSTATES/t1",
+                     {"segments": {"s": {"a": "STALE"}}})
+    with pytest.raises(FencedWriteError):
+        fenced_a.update("/IDEALSTATES/t1", lambda old: {"segments": {}})
+    with pytest.raises(FencedWriteError):
+        fenced_a.remove("/IDEALSTATES/t1")
+    # the store still holds what the NEW leader sees; b's writes pass
+    assert store.get("/IDEALSTATES/t1")["segments"]["s"]["a"] == "ONLINE"
+    fenced_b.set("/IDEALSTATES/t1", {"segments": {"s": {"b": "ONLINE"}}})
+    assert store.get("/IDEALSTATES/t1")["segments"]["s"] == {
+        "b": "ONLINE"}
+    # reads on a deposed controller's fenced view keep working (a
+    # standby must stay hot)
+    assert fenced_a.get("/IDEALSTATES/t1") is not None
+
+
+def test_fencing_rejects_old_incarnation_after_reacquire():
+    """a loses the lease, b leads and dies, a re-acquires: a's NEW
+    incarnation writes fine, but a FencedStore still holding the OLD
+    epoch (a delayed write queued before deposition) stays fenced."""
+    from pinot_tpu.controller.leadership import (FencedStore,
+                                                 FencedWriteError)
+
+    class _FrozenToken:
+        """The in-flight write's view of leadership: the epoch captured
+        when the write was issued."""
+
+        def __init__(self, inner, epoch):
+            self._inner = inner
+            self._epoch = epoch
+            self.instance_id = inner.instance_id
+
+        def fencing_token(self):
+            return self._epoch
+
+        def holds_fenced_lease(self):
+            rec = self._inner.store.get("/CONTROLLER/LEADER") or {}
+            return rec.get("instance") == self.instance_id and \
+                rec.get("leaseUntil", 0) >= self._inner._clock() and \
+                int(rec.get("epoch", 0)) == self._epoch
+
+    store, now, a, b = _two_controllers(lease_s=10.0)
+    assert a.try_acquire()
+    old = _FrozenToken(a, a.fencing_token())
+    now["t"] += 11.0
+    assert b.try_acquire()
+    now["t"] += 11.0
+    assert a.try_acquire()                   # legitimate re-election
+    assert a.holds_fenced_lease()
+    FencedStore(store, a).set("/x", {"v": 1})        # new incarnation: ok
+    with pytest.raises(FencedWriteError):
+        FencedStore(store, old).set("/x", {"v": 0})  # old epoch: fenced
+    assert store.get("/x") == {"v": 1}
+
+
+def test_split_brain_impossible_under_clock_walk():
+    """At NO instant do two controllers both hold a valid lease — walk
+    the clock through acquisition, refresh, expiry, takeover, failback
+    and assert mutual exclusion at every step."""
+    store, now, a, b = _two_controllers(lease_s=10.0)
+
+    def exclusive():
+        assert not (a.is_leader() and b.is_leader())
+        assert not (a.holds_fenced_lease() and b.holds_fenced_lease())
+
+    rng_steps = [0.0, 3.0, 3.0, 3.0, 2.0, 10.1, 0.0, 3.0, 9.0, 2.0,
+                 10.1, 0.0, 1.0]
+    actors = [a, b]
+    for i, step in enumerate(rng_steps):
+        now["t"] += step
+        # both race the lease every step; CAS admits at most one
+        actors[i % 2].try_acquire()
+        actors[(i + 1) % 2].try_acquire()
+        exclusive()
+    # and the lease is live at the end with exactly one holder
+    assert a.is_leader() != b.is_leader()
+
+
+# ---------------------------------------------------------------------------
+# Standby controller failover, end to end over real TCP
+# ---------------------------------------------------------------------------
+
+def test_standby_controller_takes_over_within_lease(tmp_path):
+    from pinot_tpu.tools.distributed import (DistributedBroker,
+                                             DistributedController,
+                                             DistributedServer,
+                                             StandaloneStore)
+    base = str(tmp_path)
+    zk = StandaloneStore(os.path.join(base, "zk"))
+    lead = DistributedController(
+        base, store_addr=("127.0.0.1", zk.port), instance_id="ctrl_lead",
+        lease_s=1.0)
+    standby = DistributedController(
+        base, store_addr=("127.0.0.1", zk.port), standby=True,
+        instance_id="ctrl_standby", lease_s=1.0)
+    server = DistributedServer("Server_0", "127.0.0.1", zk.port,
+                               lead.deep_store_dir,
+                               work_dir=os.path.join(base, "s0"))
+    broker = DistributedBroker("127.0.0.1", zk.port, lead.deep_store_dir)
+    try:
+        assert wait_until(lead.is_leader, timeout=10)
+        assert not standby.is_leader()
+        mgr = lead.controller.manager
+        mgr.add_schema(make_schema())
+        mgr.add_table(make_table_config())
+        d = os.path.join(base, "seg0")
+        os.makedirs(d)
+        build_segment(d, n=800, seed=3, name="ha_seg0")
+        mgr.add_segment(TABLE, d)
+
+        def served(n):
+            r = broker.query("SELECT COUNT(*) FROM baseballStats")
+            return not r.exceptions and \
+                int(r.aggregation_results[0].value) == n
+        assert wait_until(lambda: served(800), timeout=30)
+
+        # kill -9 the lead: no resignation, the lease must EXPIRE
+        lead.kill()
+        t0 = time.monotonic()
+        assert wait_until(standby.is_leader, timeout=10), \
+            "standby never took over"
+        takeover_s = time.monotonic() - t0
+        # within ~one lease period (+ heartbeat granularity)
+        assert takeover_s < 3.0, takeover_s
+        assert standby.controller.metrics.meter(
+            "leaderFailovers").count >= 1
+
+        # the promoted standby now RUNS the controller plane: admin
+        # mutations pass its fence and reach the servers
+        d2 = os.path.join(base, "seg1")
+        os.makedirs(d2)
+        build_segment(d2, n=700, seed=4, name="ha_seg1")
+        standby.controller.manager.add_segment(TABLE, d2)
+        assert wait_until(lambda: served(1500), timeout=30)
+    finally:
+        broker.stop()
+        try:
+            server.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        standby.stop()
+        zk.stop()
