@@ -9,6 +9,15 @@ keys match the baseline.
 contracts over the registered kernel surface and the wire-schema gate
 against the committed `wire-schema.json` (regenerate the latter
 INTENTIONALLY with `--write-wire-schema`).
+
+`--protocol` runs the protocol tier: durability-ordering and
+crash-coverage over the durable writers, the metrics exposition
+contract, and the exhaustive crash-interleaving model checker over the
+extracted lease/rebalance/takeover/upsert-seal/drain transition systems
+(state budget via `--max-states`; the extracted systems are committed
+as `protocol-model.json`, regenerated INTENTIONALLY with
+`--write-protocol-model`). `--sarif out.sarif` exports every finding —
+new, grandfathered, and suppressed — as SARIF 2.1.0 for CI annotation.
 """
 from __future__ import annotations
 
@@ -45,6 +54,17 @@ FIX_HINTS = {
     "wire-schema": "restore the field, or regenerate wire-schema.json "
                    "with --write-wire-schema and flag the PR as a "
                    "wire-compatibility change",
+    "durability-order": "stage to .tmp, fsync per policy, os.replace, "
+                        "and only then truncate/publish",
+    "crash-coverage": "add a crash_points.hit at the mutation and arm "
+                      "it in a kill-restart test",
+    "metrics-contract": "declare the name in common/metrics.py; put "
+                        "balancing gauge writes in a finally block",
+    "protocol-invariants": "follow the counterexample trace; restore "
+                           "the step order/guard the model extracted",
+    "protocol-model": "restore the protocol shape, or regenerate "
+                      "protocol-model.json with --write-protocol-model "
+                      "and flag the PR as a crash-protocol change",
 }
 
 
@@ -87,9 +107,23 @@ def main(argv=None) -> int:
     ap.add_argument("--deep", action="store_true",
                     help="also run the deep tier: jaxpr kernel contracts "
                          "+ wire-schema gate")
+    ap.add_argument("--protocol", action="store_true",
+                    help="also run the protocol tier: durability order, "
+                         "crash coverage, metrics contract, and the "
+                         "crash-interleaving model checker")
+    ap.add_argument("--max-states", type=int, default=200_000,
+                    help="model-checker state budget per system "
+                         "(hitting it is a FINDING, never a silent "
+                         "truncation; default 200000)")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="also write every finding (new, grandfathered, "
+                         "suppressed) as SARIF 2.1.0 to PATH")
     ap.add_argument("--write-wire-schema", action="store_true",
                     help="regenerate wire-schema.json from the live "
                          "serde surface and exit")
+    ap.add_argument("--write-protocol-model", action="store_true",
+                    help="regenerate protocol-model.json from the live "
+                         "protocol sources and exit")
     ap.add_argument("--rule", action="append", dest="rules", default=None,
                     help="run only this rule id (repeatable)")
     ap.add_argument("--list-rules", action="store_true")
@@ -98,8 +132,8 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         for rid, rule in sorted(core.all_rules().items()):
-            tier = " [deep]" if rule.tier == "deep" else ""
-            print(f"{rid:16s}{tier} {rule.description}")
+            tier = f" [{rule.tier}]" if rule.tier != "ast" else ""
+            print(f"{rid:20s}{tier} {rule.description}")
         return 0
 
     if args.write_wire_schema:
@@ -108,6 +142,15 @@ def main(argv=None) -> int:
         print(f"tpulint: wrote {contracts.WIRE_SCHEMA_FILE} — commit it "
               "and call out the wire-compatibility change in review")
         return 0
+
+    if args.write_protocol_model:
+        from pinot_tpu.analysis import protocol
+        protocol.write_protocol_model()
+        print(f"tpulint: wrote {protocol.PROTOCOL_MODEL_FILE} — commit "
+              "it and call out the crash-protocol change in review")
+        return 0
+
+    core.OPTIONS["max_states"] = args.max_states
 
     known = core.all_rules()
     if args.rules and not set(args.rules) <= set(known):
@@ -120,10 +163,13 @@ def main(argv=None) -> int:
         # asking for a deep rule IS asking for the deep tier — without
         # this the run would silently skip the rule and report green
         args.deep = True
+    if args.rules and not args.protocol and \
+            any(known[r].tier == "protocol" for r in args.rules):
+        args.protocol = True        # same contract for the third tier
 
     result = runner.analyze_paths(
         args.paths, rule_ids=set(args.rules) if args.rules else None,
-        deep=args.deep)
+        deep=args.deep, protocol=args.protocol)
     for err in result.errors:
         print(f"tpulint: error: {err}", file=sys.stderr)
 
@@ -145,6 +191,16 @@ def main(argv=None) -> int:
         core.write_baseline(args.baseline, result.findings)
         print(f"tpulint: wrote {len(result.findings)} finding(s) to "
               f"{args.baseline}")
+        if args.sarif:
+            # a baseline write grandfathers everything it records, so
+            # the paired SARIF reflects that: all "unchanged" (silently
+            # skipping --sarif here left CI annotation steps reading a
+            # missing or stale file)
+            from pinot_tpu.analysis import sarif
+            sarif.write_sarif(args.sarif, result.findings,
+                              result.suppressed,
+                              core.count_keys(result.findings))
+            print(f"tpulint: wrote SARIF to {args.sarif}")
         for key in pruned:
             print(f"tpulint: pruned stale baseline entry: {key}")
         for key, was, now in reduced:
@@ -156,6 +212,12 @@ def main(argv=None) -> int:
     if not args.no_baseline and os.path.exists(args.baseline):
         baseline = core.load_baseline(args.baseline)
     new, stale = runner.diff_baseline(result, baseline)
+
+    if args.sarif:
+        from pinot_tpu.analysis import sarif
+        sarif.write_sarif(args.sarif, result.findings,
+                          result.suppressed, baseline)
+        print(f"tpulint: wrote SARIF to {args.sarif}")
 
     if args.show_suppressed:
         for f in result.suppressed:
@@ -169,7 +231,8 @@ def main(argv=None) -> int:
     n_grandfathered = len(result.findings) - len(new)
     by_rule = ", ".join(f"{r}={n}" for r, n in
                         sorted(result.by_rule().items())) or "none"
-    tier = "deep" if args.deep else "fast"
+    tier = "+".join(["fast"] + (["deep"] if args.deep else []) +
+                    (["protocol"] if args.protocol else []))
     print(f"tpulint[{tier}]: {len(result.findings)} finding(s) "
           f"[{by_rule}], {len(new)} new, {n_grandfathered} "
           f"grandfathered, {len(result.suppressed)} suppressed, "

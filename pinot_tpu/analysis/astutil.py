@@ -34,6 +34,15 @@ def collect_aliases(tree: ast.AST) -> Dict[str, str]:
     return aliases
 
 
+def safe_unparse(node: ast.AST) -> str:
+    """ast.unparse that degrades to "" instead of raising — shape
+    matchers treat an unparsable node as a non-match."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001
+        return ""
+
+
 def dotted(node: ast.AST) -> Optional[str]:
     """``a.b.c`` for a pure Name/Attribute chain, else None."""
     parts: List[str] = []

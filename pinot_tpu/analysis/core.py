@@ -59,12 +59,19 @@ class AnalysisConfig:
         "pinot_tpu/parallel/")
 
 
+#: run-scoped knobs the CLI sets and global-tier rules read (the rule
+#: registry holds singletons, so per-run configuration travels here)
+OPTIONS: Dict[str, object] = {"max_states": 200_000}
+
+
 class Rule:
     """One rule family. Subclasses set `id`/`description`, yield Findings.
 
-    `tier` is "ast" (per-file, runs always) or "deep" (global, runs only
-    under `--deep`; the subclass implements `check_global()` instead —
-    kernel tracing and wire-schema serialization live there).
+    `tier` is "ast" (per-file, runs always), "deep" (global, runs only
+    under `--deep`: kernel tracing, wire schema), or "protocol" (global,
+    runs only under `--protocol`: durability ordering, crash coverage,
+    metrics contract, the crash-interleaving model checker). Global
+    tiers implement `check_global()` instead of `check()`.
     """
 
     id: str = ""

@@ -1,0 +1,1149 @@
+"""Protocol tier: transition-system extraction + explicit-state model
+checking of the crash-pointed distributed protocols.
+
+The repo's safety invariants (docs/ROBUSTNESS.md, "Self-healing &
+membership churn" and "Upserts & convergence") are enforced at runtime
+by ~50 kill -9 tests, each exploring ONE crash interleaving. This
+module lifts the protocols out of the source and explores EVERY
+interleaving of 2 actors x crash-at-every-step, in the explicit-state
+model-checking tradition (stateright / TLA+ TLC): states are small
+tuples of durable + in-memory facts, transitions are the statically
+extracted mutation steps, BFS with state dedup enumerates the space
+(10^2-10^4 states per system), and a violated invariant yields an
+ordered counterexample trace.
+
+Extraction contract (documented in docs/ANALYSIS.md)
+----------------------------------------------------
+The extractor does NOT interpret arbitrary Python. For each protocol it
+locates one anchor function and matches a fixed set of step shapes by
+walking the statements in source order:
+
+- ``lease``     — `ControllerLeadershipManager.try_acquire`: the
+  `store.get` read, the `leaseUntil` expiry compare, the
+  `rec["epoch"] = ... + 1` fencing bump, and the `store.cas` write
+  (a `store.set` in its place is extracted as a BLIND write); plus
+  `holds_fenced_lease`'s holder/TTL/epoch compares.
+- ``rebalance`` — `SegmentRebalancer.repair_table`: `compute_repair`,
+  the add fold (inner def using `setdefault`), the prune fold (inner
+  def using `.pop`), the two `rebalance.*` crash points, and whether
+  the prune re-checks liveness (`not in live`).
+- ``takeover``  — `_ensure_partition_consuming`'s repair arm: the
+  state-aware re-entry guard (`== CONSUMING` AND `in live`), the
+  OFFLINE bounce fold, the `takeover.pre_resume` crash point, and the
+  replace-vs-merge shape of the CONSUMING reassignment fold.
+- ``upsert-seal`` — `PartitionUpsertMetadata.seal`: sidecar writes,
+  the staged snapshot write, the atomic rename, the in-memory offset
+  publish, and the journal truncate — in whatever order the SOURCE
+  has them: the model executes the extracted order, so reordering
+  rename/truncate in code produces a counterexample, not a parse error.
+- ``drain``     — `DistributedServer.drain`: seal -> deregister ->
+  await-external-view-clear -> await-admission-drain -> stop.
+
+Step SEMANTICS are bound here by step name; step ORDER and the
+discipline flags come from the source. A protocol edit that preserves
+the discipline re-extracts cleanly; one that breaks it either fails the
+shape contract (missing step) or, better, produces a concrete
+counterexample trace from the checker.
+
+The extracted systems are also dumped to ``protocol-model.json``
+(``--write-protocol-model``) and diffed against the committed copy by
+the ``protocol-model`` rule, so protocol changes are review-visible the
+same way wire-schema changes are.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from collections import deque
+from typing import (Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
+
+PROTOCOL_MODEL_FILE = "protocol-model.json"
+DEFAULT_MAX_STATES = 200_000
+
+# ---------------------------------------------------------------------------
+# Extraction machinery
+# ---------------------------------------------------------------------------
+
+
+class ExtractionError(ValueError):
+    """The source no longer matches the protocol shape contract."""
+
+
+def _ordered_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Pre-order, source-ordered walk (ast.walk is breadth-first and
+    loses statement order, which IS the thing we extract)."""
+    for child in ast.iter_child_nodes(fn):
+        yield child
+        yield from _ordered_nodes(child)
+
+
+def _find_def(tree: ast.Module, qualname: str) -> ast.AST:
+    """'Class.method' or bare 'function' → the def node."""
+    parts = qualname.split(".")
+    scope: ast.AST = tree
+    for part in parts:
+        found = None
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node.name == part:
+                found = node
+                break
+        if found is None:
+            raise ExtractionError(f"definition {qualname!r} not found "
+                                  "(protocol anchor moved or renamed)")
+        scope = found
+    return scope
+
+
+from pinot_tpu.analysis.astutil import safe_unparse as _u
+
+
+@dataclasses.dataclass
+class Extraction:
+    """One protocol's statically extracted shape."""
+
+    name: str
+    path: str
+    function: str
+    steps: List[Tuple[str, int]]          # (step name, line) source order
+    flags: Dict[str, bool]
+    problems: List[str]                   # shape-contract violations
+
+    def step_order(self) -> List[str]:
+        return [s for s, _ in self.steps]
+
+    def line_of(self, step: str, default: int = 1) -> int:
+        for s, ln in self.steps:
+            if s == step:
+                return ln
+        return default
+
+
+def _extract_steps(fn: ast.AST,
+                   specs: Sequence[Tuple[str, Callable[[ast.AST], bool]]]
+                   ) -> List[Tuple[str, int]]:
+    """Match each spec's FIRST occurrence in source order; the result
+    keeps source order (which IS the extracted protocol)."""
+    found: List[Tuple[str, int]] = []
+    have = set()
+    for node in _ordered_nodes(fn):
+        for name, pred in specs:
+            if name in have:
+                continue
+            try:
+                hit = pred(node)
+            except Exception:  # noqa: BLE001 — a predicate that chokes
+                hit = False    # on an odd node simply doesn't match it
+            if hit:
+                found.append((name, getattr(node, "lineno", 1)))
+                have.add(name)
+                break
+    return found
+
+
+def _is_call_containing(node: ast.AST, *needles: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    text = _u(node)
+    return all(n in text for n in needles)
+
+
+def _is_crash_hit(node: ast.AST, point: str) -> bool:
+    return (isinstance(node, ast.Call) and
+            _u(node.func).endswith("crash_points.hit") and
+            node.args and isinstance(node.args[0], ast.Constant) and
+            node.args[0].value == point)
+
+
+def _load(path: str, sources: Optional[Dict[str, str]]) -> str:
+    if sources is not None and path in sources:
+        return sources[path]
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _require_order(ex: Extraction, *names: str) -> None:
+    """Record a problem unless the named steps exist in this order."""
+    lines = []
+    for n in names:
+        ln = ex.line_of(n, default=-1)
+        if ln < 0:
+            ex.problems.append(
+                f"{ex.path}::{ex.function}: required step `{n}` not "
+                "found — the protocol shape contract no longer matches "
+                "(see docs/ANALYSIS.md, extraction contract)")
+            return
+        lines.append(ln)
+    if lines != sorted(lines):
+        ex.problems.append(
+            f"{ex.path}::{ex.function}: steps {list(names)} out of "
+            f"order (lines {lines}) — the extracted discipline is "
+            "broken")
+
+
+# -- per-protocol extractors -------------------------------------------------
+
+LEASE_PATH = "pinot_tpu/controller/leadership.py"
+REBALANCE_PATH = "pinot_tpu/controller/rebalance.py"
+TAKEOVER_PATH = "pinot_tpu/controller/realtime_manager.py"
+SEAL_PATH = "pinot_tpu/realtime/upsert.py"
+DRAIN_PATH = "pinot_tpu/tools/distributed.py"
+
+
+def extract_lease(sources: Optional[Dict[str, str]] = None) -> Extraction:
+    src = _load(LEASE_PATH, sources)
+    tree = ast.parse(src)
+    fn = _find_def(tree, "ControllerLeadershipManager.try_acquire")
+    steps = _extract_steps(fn, [
+        ("read_lease", lambda n: _is_call_containing(n, ".get(")
+         and "store" in _u(n)),
+        ("expiry_check", lambda n: isinstance(n, ast.Compare)
+         and "leaseUntil" in _u(n)),
+        ("bump_epoch", lambda n: isinstance(n, ast.Assign)
+         and "['epoch']" in _u(n.targets[0]) and "+ 1" in _u(n.value)),
+        ("cas_write", lambda n: _is_call_containing(n, ".cas(")
+         and "store" in _u(n)),
+        ("blind_write", lambda n: _is_call_containing(n, "store.set(")),
+    ])
+    ex = Extraction("lease", LEASE_PATH,
+                    "ControllerLeadershipManager.try_acquire", steps,
+                    flags={}, problems=[])
+    order = ex.step_order()
+    ex.flags["cas"] = "cas_write" in order
+    ex.flags["epoch_bump"] = "bump_epoch" in order
+    # the fence predicate: holder + TTL + epoch COMPARES. Matched on
+    # actual Compare nodes, never raw function text — a docstring that
+    # mentions "epoch" must not vouch for a deleted comparison (the
+    # exact regression class this tier exists to catch)
+    fence_epoch = fence_holder = fence_ttl = False
+    try:
+        fence = _find_def(tree,
+                          "ControllerLeadershipManager.holds_fenced_lease")
+        compares = [_u(c) for c in ast.walk(fence)
+                    if isinstance(c, ast.Compare)]
+        fence_holder = any("instance" in c for c in compares)
+        fence_ttl = any("leaseUntil" in c for c in compares)
+        fence_epoch = any("epoch" in c for c in compares)
+    except ExtractionError:
+        ex.problems.append(
+            f"{LEASE_PATH}: holds_fenced_lease missing — FencedStore "
+            "has no fence predicate to verify")
+    ex.flags["fence_holder"] = fence_holder
+    ex.flags["fence_ttl"] = fence_ttl
+    ex.flags["fence_epoch"] = fence_epoch
+    if not (ex.flags["cas"] or "blind_write" in order):
+        ex.problems.append(
+            f"{LEASE_PATH}::try_acquire: no lease write (cas or set) "
+            "found — shape contract broken")
+    _require_order(ex, "read_lease", "expiry_check")
+    return ex
+
+
+def _inner_defs(fn: ast.AST) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in ast.walk(fn)
+            if isinstance(n, ast.FunctionDef) and n is not fn}
+
+
+def extract_rebalance(sources: Optional[Dict[str, str]] = None
+                      ) -> Extraction:
+    src = _load(REBALANCE_PATH, sources)
+    tree = ast.parse(src)
+    fn = _find_def(tree, "SegmentRebalancer.repair_table")
+    inner = _inner_defs(fn)
+    add_fns = sorted(n for n, d in inner.items() if "setdefault" in _u(d))
+    prune_fns = sorted(n for n, d in inner.items() if ".pop(" in _u(d))
+    steps = _extract_steps(fn, [
+        ("compute_plan", lambda n: _is_call_containing(
+            n, "self.compute_repair(")),
+        ("crash:rebalance.move_staged",
+         lambda n: _is_crash_hit(n, "rebalance.move_staged")),
+        ("add_fold", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(a in _u(n) for a in add_fns)),
+        ("crash:rebalance.pre_commit",
+         lambda n: _is_crash_hit(n, "rebalance.pre_commit")),
+        ("prune_fold", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(p in _u(n) for p in prune_fns)),
+    ])
+    ex = Extraction("rebalance", REBALANCE_PATH,
+                    "SegmentRebalancer.repair_table", steps,
+                    flags={}, problems=[])
+    ex.flags["prune_rechecks_live"] = any(
+        "not in live" in _u(inner[p]) for p in prune_fns)
+    _require_order(ex, "compute_plan", "add_fold", "prune_fold")
+    for cp in ("crash:rebalance.move_staged", "crash:rebalance.pre_commit"):
+        if cp not in ex.step_order():
+            ex.problems.append(
+                f"{REBALANCE_PATH}::repair_table: crash point "
+                f"`{cp.split(':', 1)[1]}` removed — the kill-restart "
+                "tests can no longer split the fold")
+    return ex
+
+
+def extract_takeover(sources: Optional[Dict[str, str]] = None
+                     ) -> Extraction:
+    src = _load(TAKEOVER_PATH, sources)
+    tree = ast.parse(src)
+    fn = _find_def(tree, "_ensure_partition_consuming")
+    inner = _inner_defs(fn)
+    bounce_fns = sorted(n for n, d in inner.items() if "OFFLINE" in _u(d))
+    assign_fns = sorted(n for n, d in inner.items()
+                        if "CONSUMING" in _u(d) and n not in bounce_fns)
+    guard_pred = None
+    for node in _ordered_nodes(fn):
+        if isinstance(node, ast.Call) and _u(node.func) == "any" and \
+                "live" in _u(node):
+            guard_pred = node
+            break
+    steps = _extract_steps(fn, [
+        ("reentry_guard", lambda n: n is guard_pred),
+        ("bounce_offline", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(b in _u(n) for b in bounce_fns)),
+        ("crash:takeover.pre_resume",
+         lambda n: _is_crash_hit(n, "takeover.pre_resume")),
+        ("reassign_consuming", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(a in _u(n) for a in assign_fns)),
+    ])
+    ex = Extraction("takeover", TAKEOVER_PATH,
+                    "_ensure_partition_consuming", steps,
+                    flags={}, problems=[])
+    guard_text = _u(guard_pred) if guard_pred is not None else ""
+    ex.flags["guard_state_aware"] = ("CONSUMING" in guard_text and
+                                     "live" in guard_text)
+    ex.flags["has_bounce"] = "bounce_offline" in ex.step_order()
+    # replace-shape: the reassign fold ASSIGNS the whole entry dict
+    # (one fold writes the full replica set); setdefault/.update merge
+    # shapes leave previous-generation owners alive
+    replaces = False
+    for a in assign_fns:
+        d = inner[a]
+        if any(isinstance(n, ast.Assign) and
+               isinstance(n.targets[0], ast.Subscript)
+               for n in ast.walk(d)) and "setdefault" not in _u(d) \
+                and ".update(" not in _u(d):
+            replaces = True
+    ex.flags["reassign_replaces"] = replaces
+    if "reassign_consuming" not in ex.step_order():
+        ex.problems.append(
+            f"{TAKEOVER_PATH}::_ensure_partition_consuming: CONSUMING "
+            "reassignment fold not found — shape contract broken")
+    if ex.flags["has_bounce"]:
+        _require_order(ex, "bounce_offline", "reassign_consuming")
+    return ex
+
+
+def extract_seal(sources: Optional[Dict[str, str]] = None) -> Extraction:
+    src = _load(SEAL_PATH, sources)
+    tree = ast.parse(src)
+    fn = _find_def(tree, "PartitionUpsertMetadata.seal")
+    steps = _extract_steps(fn, [
+        ("crash:upsert.seal", lambda n: _is_crash_hit(n, "upsert.seal")),
+        ("write_sidecars", lambda n: _is_call_containing(
+            n, "self._write_sidecar(")),
+        ("stage_snapshot", lambda n: _is_call_containing(n, "open(tmp")),
+        ("crash:upsert.keymap_snapshot",
+         lambda n: _is_crash_hit(n, "upsert.keymap_snapshot")),
+        ("rename_snapshot", lambda n: _is_call_containing(
+            n, "os.replace(tmp")),
+        ("publish_offset", lambda n: isinstance(n, ast.Assign) and
+         _u(n.targets[0]) == "self.snapshot_offset"),
+        ("truncate_journal", lambda n: _is_call_containing(
+            n, "open(self._journal_path()", "'w'")),
+    ])
+    ex = Extraction("upsert-seal", SEAL_PATH,
+                    "PartitionUpsertMetadata.seal", steps,
+                    flags={}, problems=[])
+    for required in ("stage_snapshot", "rename_snapshot",
+                     "truncate_journal"):
+        if required not in ex.step_order():
+            ex.problems.append(
+                f"{SEAL_PATH}::seal: step `{required}` not found — "
+                "shape contract broken")
+    # journal-append coverage (consumer side of the same system)
+    try:
+        ja = _find_def(tree, "PartitionUpsertMetadata._journal_append")
+        ex.flags["journal_append_crash_point"] = any(
+            _is_crash_hit(n, "upsert.journal_append")
+            for n in ast.walk(ja))
+    except ExtractionError:
+        ex.flags["journal_append_crash_point"] = False
+    return ex
+
+
+def extract_drain(sources: Optional[Dict[str, str]] = None) -> Extraction:
+    src = _load(DRAIN_PATH, sources)
+    tree = ast.parse(src)
+    fn = _find_def(tree, "DistributedServer.drain")
+    steps = _extract_steps(fn, [
+        ("seal_consuming", lambda n: _is_call_containing(
+            n, "seal_consuming(")),
+        ("deregister", lambda n: isinstance(n, ast.Call) and
+         _u(n) == "self.agent.stop()"),
+        ("await_view_clear", lambda n: _is_call_containing(
+            n, "view_clear()")),
+        ("await_admission_drain", lambda n: _is_call_containing(
+            n, "admission.depth()")),
+        ("stop_serving", lambda n: isinstance(n, ast.Call) and
+         _u(n) == "self.server.stop()"),
+    ])
+    ex = Extraction("drain", DRAIN_PATH, "DistributedServer.drain",
+                    steps, flags={}, problems=[])
+    _require_order(ex, "seal_consuming", "deregister",
+                   "await_view_clear", "await_admission_drain",
+                   "stop_serving")
+    return ex
+
+
+def extract_all(sources: Optional[Dict[str, str]] = None
+                ) -> List[Extraction]:
+    return [extract_lease(sources), extract_rebalance(sources),
+            extract_takeover(sources), extract_seal(sources),
+            extract_drain(sources)]
+
+
+# ---------------------------------------------------------------------------
+# Explicit-state model checker
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    label: str
+    enabled: Callable[[tuple], bool]
+    apply: Callable[[tuple], tuple]
+
+
+@dataclasses.dataclass
+class System:
+    name: str
+    path: str
+    anchor_line: int
+    init: tuple
+    actions: List[Action]
+    #: invariant name -> predicate(state) returning a violation message
+    #: (None = holds). Checked on EVERY reached state.
+    invariants: List[Tuple[str, Callable[[tuple], Optional[str]]]]
+
+
+@dataclasses.dataclass
+class Violation:
+    system: str
+    invariant: str
+    message: str
+    trace: List[str]                      # ordered action labels
+
+    def render_trace(self) -> str:
+        steps = " -> ".join(self.trace) if self.trace else "<initial state>"
+        return (f"counterexample ({len(self.trace)} step(s)): {steps}")
+
+
+@dataclasses.dataclass
+class Report:
+    system: str
+    path: str
+    anchor_line: int
+    states: int
+    truncated: bool
+    violations: List[Violation]
+
+
+def explore(system: System, max_states: int = DEFAULT_MAX_STATES
+            ) -> Report:
+    """BFS over all interleavings with state dedup. Deterministic:
+    actions fire in list order, states are plain tuples, the frontier
+    is FIFO — two runs over the same system byte-agree."""
+    parent: Dict[tuple, Optional[Tuple[tuple, str]]] = {system.init: None}
+    queue: deque = deque([system.init])
+    violations: List[Violation] = []
+    seen_inv = set()
+
+    def trace_of(state: tuple) -> List[str]:
+        out: List[str] = []
+        cur = state
+        while parent[cur] is not None:
+            prev, label = parent[cur]
+            out.append(label)
+            cur = prev
+        out.reverse()
+        return out
+
+    def check(state: tuple) -> None:
+        for inv_name, pred in system.invariants:
+            if inv_name in seen_inv:
+                continue
+            msg = pred(state)
+            if msg is not None:
+                seen_inv.add(inv_name)
+                violations.append(Violation(
+                    system.name, inv_name, msg, trace_of(state)))
+
+    check(system.init)
+    truncated = False
+    while queue and not truncated:
+        state = queue.popleft()
+        for action in system.actions:
+            if not action.enabled(state):
+                continue
+            nxt = action.apply(state)
+            if nxt == state or nxt in parent:
+                continue
+            if len(parent) >= max_states:
+                truncated = True
+                break
+            parent[nxt] = (state, action.label)
+            check(nxt)
+            queue.append(nxt)
+    return Report(system.name, system.path, system.anchor_line,
+                  len(parent), truncated, violations)
+
+
+# ---------------------------------------------------------------------------
+# Model builders — semantics keyed by extracted step names/flags
+# ---------------------------------------------------------------------------
+
+# -- lease / epoch fencing ---------------------------------------------------
+#
+# State: (holder, epoch, valid, serial, gen, bad, A0, A1) with actor
+# Ai = (pc, snap, dec, tko, sep, myepoch, mygen, task, acq)
+#   pc 0 = before read, 1 = read done, 9 = round over
+#   snap = store serial captured at read (CAS witness)
+#   dec/tko = decision/takeover captured at read; sep = epoch at read
+#   task = pending fenced mutation (epoch, gen) from an EARLIER
+#          incarnation (a periodic task's delayed write), -1 = none
+#   acq = acquisitions used (bounds the space)
+# `gen` is the GROUND-TRUTH leadership generation (bumped on every
+# holder change, independent of the extracted epoch discipline); `bad`
+# latches when a write is ADMITTED by the extracted fence while its
+# issue-time generation differs from the live one — exactly invariant 3
+# of ROBUSTNESS.md ("fenced writes").
+
+_L_MAX_ACQ = 2
+
+
+def _lease_actor(state, i):
+    return state[6 + i]
+
+
+def _lease_with(state, i, actor, **top):
+    base = {"holder": state[0], "epoch": state[1], "valid": state[2],
+            "serial": state[3], "gen": state[4], "bad": state[5]}
+    base.update(top)
+    actors = [state[6], state[7]]
+    actors[i] = actor
+    return (base["holder"], base["epoch"], base["valid"], base["serial"],
+            base["gen"], base["bad"], actors[0], actors[1])
+
+
+def build_lease_system(ex: Extraction) -> System:
+    cas = ex.flags.get("cas", True)
+    bump = ex.flags.get("epoch_bump", True)
+    f_holder = ex.flags.get("fence_holder", True)
+    f_ttl = ex.flags.get("fence_ttl", True)
+    f_epoch = ex.flags.get("fence_epoch", True)
+
+    init_actor = (0, -1, 0, 0, 0, -1, -1, -1, 0)
+    init = (-1, 0, 1, 0, 0, 0, init_actor, init_actor)
+
+    def read(i):
+        def enabled(s):
+            a = _lease_actor(s, i)
+            return a[0] == 0 and a[8] < _L_MAX_ACQ
+
+        def apply(s):
+            holder, epoch, valid = s[0], s[1], s[2]
+            a = _lease_actor(s, i)
+            proceed = 1 if (holder in (-1, i) or not valid) else 0
+            takeover = 1 if holder != i else 0
+            na = (1, s[3], proceed, takeover, epoch, a[5], a[6], a[7],
+                  a[8])
+            return _lease_with(s, i, na)
+        return Action(f"a{i}.read_lease", enabled, apply)
+
+    def write(i):
+        def enabled(s):
+            return _lease_actor(s, i)[0] == 1
+
+        def apply(s):
+            a = _lease_actor(s, i)
+            pc, snap, proceed, takeover, sep = a[0], a[1], a[2], a[3], a[4]
+            if not proceed or (cas and s[3] != snap):
+                # lost the race (or lease held): round over, no write
+                na = (9, -1, 0, 0, 0, a[5], a[6], a[7], a[8] + 1)
+                return _lease_with(s, i, na)
+            epoch = sep + 1 if (takeover and bump) else sep
+            gen = s[4] + 1 if takeover else s[4]
+            # a pending task from an earlier incarnation SURVIVES the
+            # re-acquire (the delayed periodic-task write); only an
+            # empty slot takes the fresh credentials
+            task = a[7] if a[7] != -1 else (epoch, gen)
+            na = (9, -1, 0, 0, 0, epoch, gen, task, a[8] + 1)
+            return _lease_with(s, i, na, holder=i, epoch=epoch, valid=1,
+                               serial=s[3] + 1, gen=gen)
+        label = "cas_write" if cas else "blind_write"
+        return Action(f"a{i}.{label}", enabled, apply)
+
+    def apply_task(i):
+        def enabled(s):
+            return _lease_actor(s, i)[7] != -1
+
+        def apply(s):
+            a = _lease_actor(s, i)
+            tepoch, tgen = a[7]
+            admitted = ((s[0] == i or not f_holder) and
+                        (s[2] == 1 or not f_ttl) and
+                        (tepoch == s[1] or not f_epoch))
+            bad = s[5]
+            if admitted and tgen != s[4]:
+                bad = 1
+            na = a[:7] + (-1,) + a[8:]
+            return _lease_with(s, i, na, bad=bad)
+        return Action(f"a{i}.fenced_store_write", enabled, apply)
+
+    def retry(i):
+        def enabled(s):
+            a = _lease_actor(s, i)
+            return a[0] == 9 and a[8] < _L_MAX_ACQ
+
+        def apply(s):
+            a = _lease_actor(s, i)
+            return _lease_with(s, i, (0,) + a[1:])
+        return Action(f"a{i}.retry", enabled, apply)
+
+    def crash(i):
+        def enabled(s):
+            a = _lease_actor(s, i)
+            return a[0] == 1 and a[8] < _L_MAX_ACQ
+
+        def apply(s):
+            a = _lease_actor(s, i)
+            # restart: in-memory credentials gone, pending task DIES
+            # with the process (an in-flight RPC does not survive
+            # kill -9); the lease record itself persists until TTL
+            na = (0, -1, 0, 0, 0, -1, -1, -1, a[8])
+            return _lease_with(s, i, na)
+        return Action(f"a{i}.crash_restart", enabled, apply)
+
+    def expire(s):
+        return _lease_with(s, 0, _lease_actor(s, 0), valid=0)
+
+    actions = []
+    for i in (0, 1):
+        actions += [read(i), write(i), apply_task(i), retry(i), crash(i)]
+    actions.append(Action("env.lease_expires", lambda s: s[2] == 1,
+                          expire))
+
+    def inv_fenced(s):
+        if s[5]:
+            return ("a store mutation issued under a superseded "
+                    "leadership generation was ADMITTED by the fence "
+                    "(ROBUSTNESS invariant 3, fenced writes)")
+        return None
+
+    return System("lease", ex.path, ex.line_of("read_lease"), init,
+                  actions, [("fenced-writes", inv_fenced)])
+
+
+# -- rebalance add-then-prune fold -------------------------------------------
+#
+# World: segments s0 {X,Y}, s1 {X,Z}; replication 2; X dead at t0, may
+# reincarnate. Actors: two controller incarnations running the repair
+# loop concurrently (the fence normally serializes them, but the folds
+# must be idempotent even without it — and a crashed actor's successor
+# IS the second actor). State:
+# (h0, h1, live, regressed, A0, A1); holders/live are sorted tuples of
+# server ids 0=X 1=Y 2=Z; actor = (pc, plan, passes); plan = per
+# segment (adds, dead).
+
+_R_REPL = 2
+_R_SEGS = 2
+_R_MAX_PASSES = 2
+
+
+def _reb_plan(h, live):
+    plan = []
+    for seg in range(_R_SEGS):
+        holders = set(h[seg])
+        lset = set(live)
+        survivors = holders & lset
+        dead = tuple(sorted(holders - lset))
+        need = min(_R_REPL, len(lset)) - len(survivors)
+        adds = tuple(sorted(lset - holders)[:max(0, need)])
+        plan.append((adds, dead))
+    return tuple(plan)
+
+
+def build_rebalance_system(ex: Extraction) -> System:
+    rechecks = ex.flags.get("prune_rechecks_live", True)
+    order = [s for s in ex.step_order()
+             if s in ("compute_plan", "add_fold", "prune_fold")]
+    if not order:
+        order = ["compute_plan", "add_fold", "prune_fold"]
+
+    init_actor = (0, None, 0)
+    init = (((0, 1), (0, 2)), (1, 2), 0, init_actor, init_actor)
+    # state: (holders pair, live, regressed, A0, A1)
+
+    def actor_of(s, i):
+        return s[3 + i]
+
+    def with_actor(s, i, a, holders=None, live=None, regressed=None):
+        actors = [s[3], s[4]]
+        actors[i] = a
+        return (holders if holders is not None else s[0],
+                live if live is not None else s[1],
+                regressed if regressed is not None else s[2],
+                actors[0], actors[1])
+
+    def live_counts(holders, live):
+        lset = set(live)
+        return tuple(len(set(h) & lset) for h in holders)
+
+    def step(i, idx, name):
+        def enabled(s):
+            a = actor_of(s, i)
+            return a[0] == idx and a[2] < _R_MAX_PASSES
+
+        def apply(s):
+            holders, live = s[0], s[1]
+            a = actor_of(s, i)
+            regressed = s[2]
+            if name == "compute_plan":
+                plan = _reb_plan(holders, live)
+                if all(not adds and not dead for adds, dead in plan):
+                    # converged pass: nothing to do this round
+                    return with_actor(s, i, (len(order), None, a[2] + 1))
+                return with_actor(s, i, (idx + 1, plan, a[2]))
+            if a[1] is None:
+                return s
+            before = live_counts(holders, live)
+            new_h = [set(h) for h in holders]
+            if name == "add_fold":
+                for seg in range(_R_SEGS):
+                    new_h[seg] |= set(a[1][seg][0])
+            elif name == "prune_fold":
+                lset = set(live)
+                for seg in range(_R_SEGS):
+                    for d in a[1][seg][1]:
+                        if rechecks and d in lset:
+                            continue     # reincarnated: keep it
+                        new_h[seg].discard(d)
+            nh = tuple(tuple(sorted(h)) for h in new_h)
+            after = live_counts(nh, live)
+            if any(b > x for b, x in zip(before, after)):
+                regressed = 1
+            done = idx + 1 >= len(order)
+            na = (0 if done else idx + 1, None if done else a[1],
+                  a[2] + (1 if done else 0))
+            return with_actor(s, i, na, holders=nh, regressed=regressed)
+        return Action(f"a{i}.{name}", enabled, apply)
+
+    def crash(i):
+        def enabled(s):
+            a = actor_of(s, i)
+            return 0 < a[0] < len(order)
+
+        def apply(s):
+            # controller died: in-memory plan lost, durable state stays
+            return with_actor(s, i, (len(order), None, _R_MAX_PASSES))
+        return Action(f"a{i}.crash", enabled, apply)
+
+    def reincarnate(s):
+        return (s[0], tuple(sorted(set(s[1]) | {0})), s[2], s[3], s[4])
+
+    actions = []
+    for i in (0, 1):
+        for idx, name in enumerate(order):
+            actions.append(step(i, idx, name))
+        actions.append(crash(i))
+    actions.append(Action("env.server_reincarnates",
+                          lambda s: 0 not in s[1], reincarnate))
+
+    def inv_regress(s):
+        if s[2]:
+            return ("a repair fold REDUCED a segment's live replica "
+                    "count (pruned a live holder) — ROBUSTNESS "
+                    "invariant 2, no replica-count regression")
+        return None
+
+    return System("rebalance", ex.path, ex.line_of("compute_plan"),
+                  init, actions, [("no-replica-regression", inv_regress)])
+
+
+# -- realtime partition takeover ---------------------------------------------
+#
+# World: one partition; owner A=0 CONSUMING (generation 0) and dead;
+# healthy server C=1 always live; A may come back (zombie / restart).
+# owners: sorted tuple of (inst, consuming?, gen). Actors: controller +
+# its restarted incarnation. stalled latches when the re-entry guard
+# SKIPS repair while the partition has no live consumer (the PR 9
+# membership-only-guard bug).
+
+
+def build_takeover_system(ex: Extraction) -> System:
+    state_aware = ex.flags.get("guard_state_aware", True)
+    has_bounce = ex.flags.get("has_bounce", True)
+    replaces = ex.flags.get("reassign_replaces", True)
+    order = ["reentry_guard"] + (["bounce_offline"] if has_bounce else []) \
+        + ["reassign_consuming"]
+
+    init_actor = 0
+    init = (((0, 1, 0),), (1,), 0, 0, init_actor, init_actor)
+    # (owners, live, stalled, doubled, pc0, pc1)
+
+    def with_state(s, i, pc, owners=None, stalled=None, doubled=None):
+        pcs = [s[4], s[5]]
+        pcs[i] = pc
+        return (owners if owners is not None else s[0],
+                s[1],
+                stalled if stalled is not None else s[2],
+                doubled if doubled is not None else s[3],
+                pcs[0], pcs[1])
+
+    def live_consuming(owners, live):
+        return [o for o in owners if o[1] == 1 and o[0] in set(live)]
+
+    def check_double(owners):
+        gens = {o[2] for o in owners if o[1] == 1}
+        return 1 if len(gens) > 1 else 0
+
+    def step(i, idx, name):
+        def enabled(s):
+            return [s[4], s[5]][i] == idx
+
+        def apply(s):
+            owners, live = s[0], s[1]
+            if name == "reentry_guard":
+                if state_aware:
+                    skip = bool(live_consuming(owners, live))
+                else:
+                    skip = bool({o[0] for o in owners} & set(live))
+                if skip:
+                    stalled = s[2]
+                    if not live_consuming(owners, live):
+                        stalled = 1   # declined repair, nobody consumes
+                    return with_state(s, i, len(order), stalled=stalled)
+                return with_state(s, i, idx + 1)
+            if name == "bounce_offline":
+                no = tuple(sorted((inst, 0, gen)
+                                  for inst, _c, gen in owners))
+                return with_state(s, i, idx + 1, owners=no)
+            # reassign_consuming: one fold writes the new replica set
+            new_gen = max([g for _i, _c, g in owners] or [0]) + 1
+            chosen = (1,)                 # healthiest live server
+            if replaces:
+                no = tuple(sorted((c, 1, new_gen) for c in chosen))
+            else:
+                kept = tuple(o for o in owners if o[0] not in chosen)
+                no = tuple(sorted(kept + tuple(
+                    (c, 1, new_gen) for c in chosen)))
+            doubled = max(s[3], check_double(no))
+            return with_state(s, i, len(order), owners=no,
+                              doubled=doubled)
+        return Action(f"a{i}.{name}", enabled, apply)
+
+    def crash(i):
+        def enabled(s):
+            return 0 < [s[4], s[5]][i] < len(order)
+
+        def apply(s):
+            return with_state(s, i, len(order))
+        return Action(f"a{i}.crash", enabled, apply)
+
+    def revive(s):
+        return (s[0], tuple(sorted(set(s[1]) | {0})), s[2], s[3],
+                s[4], s[5])
+
+    actions = []
+    for i in (0, 1):
+        for idx, name in enumerate(order):
+            actions.append(step(i, idx, name))
+        actions.append(crash(i))
+    actions.append(Action("env.old_owner_returns",
+                          lambda s: 0 not in s[1], revive))
+
+    def inv_double(s):
+        if s[3]:
+            return ("two leadership generations hold CONSUMING replicas "
+                    "of the same partition — ROBUSTNESS invariant 1, "
+                    "no double-owned partition")
+        return None
+
+    def inv_stall(s):
+        if s[2]:
+            return ("the re-entry guard declined repair while the "
+                    "partition had NO live consumer (membership-only "
+                    "guard: OFFLINE-parked owners stall the partition "
+                    "forever)")
+        return None
+
+    return System("takeover", ex.path, ex.line_of("reentry_guard"),
+                  init, actions,
+                  [("no-double-owned", inv_double),
+                   ("no-takeover-stall", inv_stall)])
+
+
+# -- upsert seal / snapshot / truncate ---------------------------------------
+#
+# Offsets 1..3; seal runs after batch 2 commits (commit boundary 2).
+# Durable facts: journal, snapshot(+offset), staged copy. Crash is a
+# terminal action that IMMEDIATELY evaluates recovery: what the
+# restarted partition can rebuild = snapshot ∪ journal ∪ batches above
+# the commit boundary (re-consumed from the topic; batches at or below
+# it live in the committed segment and are never re-read). Any ACKED
+# batch outside that set is lost — the machine check of "the journal is
+# truncated only after the snapshot rename" (ROBUSTNESS, upsert
+# invariant 3: durable state is a prefix of applied state).
+
+_S_BATCHES = (1, 2, 3)
+_S_SEAL_AFTER = 2
+
+
+def build_seal_system(ex: Extraction) -> System:
+    seal_order = [s for s in ex.step_order()
+                  if s in ("write_sidecars", "stage_snapshot",
+                           "rename_snapshot", "publish_offset",
+                           "truncate_journal")]
+    program: List[str] = []
+    for b in _S_BATCHES:
+        program += [f"apply_mem(b{b})", f"journal_append(b{b})",
+                    f"ack(b{b})"]
+        if b == _S_SEAL_AFTER:
+            program += [f"seal.{s}" for s in seal_order]
+
+    # state: (pc, mem, journal, snap, snap_off, staged, commit_off,
+    #         acked, lost)
+    init = (0, (), (), (), 0, None, 0, (), 0)
+
+    def step(idx, name):
+        def enabled(s):
+            return s[0] == idx
+
+        def apply(s):
+            (pc, mem, journal, snap, snap_off, staged, commit_off,
+             acked, lost) = s
+            if name.startswith("apply_mem"):
+                b = int(name[-2])
+                mem = tuple(sorted(set(mem) | {b}))
+            elif name.startswith("journal_append"):
+                b = int(name[-2])
+                journal = journal + (b,)
+            elif name.startswith("ack"):
+                b = int(name[-2])
+                acked = tuple(sorted(set(acked) | {b}))
+                if b == _S_SEAL_AFTER:
+                    commit_off = b   # the segment commit precedes seal
+            elif name == "seal.stage_snapshot":
+                staged = (mem, max(acked or (0,)))
+            elif name == "seal.rename_snapshot":
+                if staged is not None:
+                    snap, snap_off = staged
+                    staged = None
+            elif name == "seal.truncate_journal":
+                journal = ()
+            # write_sidecars / publish_offset: no durable-map effect
+            return (pc + 1, mem, journal, snap, snap_off, staged,
+                    commit_off, acked, lost)
+        return Action(name, enabled, apply)
+
+    def crash_apply(s):
+        (pc, mem, journal, snap, snap_off, staged, commit_off,
+         acked, lost) = s
+        recovered = set(snap) | set(journal) | {
+            b for b in _S_BATCHES if b > commit_off}
+        if not set(acked) <= recovered:
+            lost = 1
+        # terminal: pc jumps past the program
+        return (len(program), mem, journal, snap, snap_off, staged,
+                commit_off, acked, lost)
+
+    actions = [step(i, n) for i, n in enumerate(program)]
+    actions.append(Action("crash_and_recover",
+                          lambda s: s[0] < len(program), crash_apply))
+
+    def inv_no_loss(s):
+        if s[8]:
+            return ("an ACKED batch is in neither the key-map snapshot, "
+                    "the journal, nor the re-consumable topic suffix — "
+                    "the journal was truncated before its covering "
+                    "snapshot was durable (upsert invariant 3, durable "
+                    "state is a prefix of applied state)")
+        return None
+
+    return System("upsert-seal", ex.path,
+                  ex.line_of("stage_snapshot"), init, actions,
+                  [("no-acked-delta-loss", inv_no_loss)])
+
+
+# -- graceful drain ----------------------------------------------------------
+#
+# State: (pc, live, ev, stopped, errors, queries_left). The broker
+# routes by external view (env.ev_sync lags env-async behind liveness);
+# a query dispatched to a stopped server is a drain error — ROBUSTNESS
+# invariant 4, drain is errorless. No crash transitions: a crash during
+# drain IS a kill -9, which the masking/healing plane owns.
+
+
+def build_drain_system(ex: Extraction) -> System:
+    order = [s for s in ex.step_order()]
+    if not order:
+        order = ["seal_consuming", "deregister", "await_view_clear",
+                 "await_admission_drain", "stop_serving"]
+    init = (0, 1, 1, 0, 0, 2)
+
+    def step(idx, name):
+        def enabled(s):
+            if s[0] != idx:
+                return False
+            if name == "await_view_clear":
+                return s[2] == 0          # blocks until EV drops us
+            return True
+
+        def apply(s):
+            pc, live, ev, stopped, errors, q = s
+            if name == "deregister":
+                live = 0
+            elif name == "stop_serving":
+                stopped = 1
+            return (pc + 1, live, ev, stopped, errors, q)
+        return Action(f"drain.{name}", enabled, apply)
+
+    def ev_sync(s):
+        return (s[0], s[1], s[1], s[3], s[4], s[5])
+
+    def query(s):
+        pc, live, ev, stopped, errors, q = s
+        if stopped:
+            errors = 1
+        return (pc, live, ev, stopped, errors, q - 1)
+
+    actions = [step(i, n) for i, n in enumerate(order)]
+    actions.append(Action("env.ev_sync", lambda s: s[2] != s[1], ev_sync))
+    actions.append(Action("env.query_routed_by_ev",
+                          lambda s: s[5] > 0 and s[2] == 1, query))
+
+    def inv_errorless(s):
+        if s[4]:
+            return ("a query was routed (per the external view) to a "
+                    "server that had already stopped — ROBUSTNESS "
+                    "invariant 4, drain is errorless")
+        return None
+
+    return System("drain", ex.path, ex.line_of("seal_consuming"),
+                  init, actions, [("drain-errorless", inv_errorless)])
+
+
+_BUILDERS = {
+    "lease": build_lease_system,
+    "rebalance": build_rebalance_system,
+    "takeover": build_takeover_system,
+    "upsert-seal": build_seal_system,
+    "drain": build_drain_system,
+}
+
+
+# ---------------------------------------------------------------------------
+# Entry points (used by rules/protocol_check.py, the CLI, and tests)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ProtocolCheckResult:
+    reports: List[Report]
+    problems: List[Tuple[str, str, int, str]]   # (system, path, line, msg)
+
+    def summary_lines(self) -> List[str]:
+        out = []
+        for r in self.reports:
+            status = "TRUNCATED" if r.truncated else "exhaustive"
+            out.append(f"{r.system}: {r.states} state(s) explored "
+                       f"({status}), {len(r.violations)} violation(s)")
+        return out
+
+
+def check_protocols(max_states: int = DEFAULT_MAX_STATES,
+                    sources: Optional[Dict[str, str]] = None,
+                    only: Optional[Sequence[str]] = None
+                    ) -> ProtocolCheckResult:
+    reports: List[Report] = []
+    problems: List[Tuple[str, str, int, str]] = []
+    for ex in extract_all(sources):
+        if only is not None and ex.name not in only:
+            continue
+        for p in ex.problems:
+            problems.append((ex.name, ex.path, ex.steps[0][1]
+                             if ex.steps else 1, p))
+        try:
+            system = _BUILDERS[ex.name](ex)
+        except Exception as e:  # noqa: BLE001 — a builder crash must
+            problems.append((ex.name, ex.path, 1,    # fail the gate
+                             f"model build failed: {type(e).__name__}: "
+                             f"{e}"))
+            continue
+        reports.append(explore(system, max_states))
+    return ProtocolCheckResult(reports, problems)
+
+
+def protocol_model(sources: Optional[Dict[str, str]] = None) -> dict:
+    """The reviewable JSON dump of every extracted transition system
+    (step ORDER and discipline flags — line numbers excluded so
+    unrelated edits don't churn the committed file)."""
+    systems = {}
+    for ex in extract_all(sources):
+        systems[ex.name] = {
+            "file": ex.path,
+            "function": ex.function,
+            "steps": ex.step_order(),
+            "flags": {k: ex.flags[k] for k in sorted(ex.flags)},
+            "problems": sorted(ex.problems),
+        }
+    return {
+        "version": 1,
+        "comment": ("extracted protocol transition systems; regenerate "
+                    "INTENTIONALLY with `python -m pinot_tpu.analysis "
+                    "--write-protocol-model` and review the diff as a "
+                    "crash-protocol change"),
+        "systems": systems,
+    }
+
+
+def write_protocol_model(path: str = PROTOCOL_MODEL_FILE) -> dict:
+    model = protocol_model()
+    with open(path, "w") as fh:
+        json.dump(model, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return model
+
+
+def check_protocol_model(path: str = PROTOCOL_MODEL_FILE) -> List[str]:
+    """Field-level diffs between the committed model and the live
+    extraction ([] = protocols unchanged)."""
+    if not os.path.exists(path):
+        return [f"missing committed snapshot {path} — generate it with "
+                "--write-protocol-model and commit it"]
+    with open(path) as fh:
+        committed = json.load(fh)
+    fresh = protocol_model()
+    out: List[str] = []
+
+    def diff(a, b, at):
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k in sorted(set(a) | set(b)):
+                loc = f"{at}.{k}" if at else k
+                if k not in b:
+                    out.append(f"removed: {loc} (was {a[k]!r})")
+                elif k not in a:
+                    out.append(f"added: {loc} = {b[k]!r}")
+                else:
+                    diff(a[k], b[k], loc)
+            return
+        if a != b:
+            out.append(f"changed: {at}: {a!r} -> {b!r}")
+
+    diff(committed, fresh, "")
+    return out

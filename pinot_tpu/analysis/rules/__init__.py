@@ -1,7 +1,10 @@
 """Rule modules — importing this package registers every rule."""
 from pinot_tpu.analysis.rules import (api_compat, async_safety,
                                       concurrency, deep, dtype_drift,
-                                      host_sync, lock_order, retrace)
+                                      durability, host_sync, lock_order,
+                                      metrics_contract, protocol_check,
+                                      retrace)
 
 __all__ = ["api_compat", "async_safety", "concurrency", "deep",
-           "dtype_drift", "host_sync", "lock_order", "retrace"]
+           "dtype_drift", "durability", "host_sync", "lock_order",
+           "metrics_contract", "protocol_check", "retrace"]

@@ -1,0 +1,467 @@
+"""durability-order / crash-coverage: the staged-write discipline gate.
+
+Every crash-recovery guarantee in this repo rests on ONE write
+discipline (docs/ROBUSTNESS.md): durable state is mutated as
+
+    temp-file write -> flush/fsync (per policy) -> atomic os.replace
+    -> only then journal truncate / in-memory publish
+
+and every such mutation carries a seeded crash point so the kill -9
+suites can split it. Both halves rot silently — a refactor that moves
+the journal truncate above the snapshot rename still passes every
+existing test (each test explores one interleaving), and a new durable
+mutation without a crash point is simply never killed mid-flight. These
+two rules make the discipline mechanical:
+
+- **durability-order** walks each function of the protocol-bearing
+  writers (`DURABILITY_FILES`) in statement order and flags: an
+  `os.replace` whose staged source was never written; an in-memory
+  `self.*` publish between the staged write and its rename; a journal
+  truncate (mode-"w" reopen or `.truncate()` of a WAL/journal path)
+  that precedes the covering snapshot's rename; a staged file that is
+  never renamed; and an in-place rewrite of a durable file that was
+  read earlier in the same function (read-modify-write without staging
+  — a crash mid-write destroys the only copy).
+- **crash-coverage** cross-references three registries: durable-mutation
+  functions in `DURABILITY_FILES` must reach a `crash_points.hit`
+  (directly, via a one-level self-call, or via every in-file caller);
+  every crash point hit anywhere in the tree must be armed by at least
+  one test/script; and every name a test arms must exist in the code —
+  a renamed point must fail loudly, not silently test nothing.
+
+Both run in the `--protocol` tier; suppressions work exactly like the
+AST tier (`# tpulint: disable=durability-order -- <invariant>`).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis.core import (Finding, Rule, is_suppressed,
+                                     parse_suppressions, register)
+
+#: the protocol-bearing durable writers the ordering rule audits
+DURABILITY_FILES = (
+    "pinot_tpu/controller/property_store.py",
+    "pinot_tpu/realtime/data_manager.py",
+    "pinot_tpu/realtime/upsert.py",
+    "pinot_tpu/segment/integrity.py",
+)
+
+#: substrings identifying append-only journal/WAL paths
+JOURNAL_MARKERS = ("wal", "journal")
+
+_DOTTED_NAME = re.compile(r"^[a-z_][a-z0-9_]*(\.[a-z_][a-z0-9_]*)+$")
+
+
+# ---------------------------------------------------------------------------
+# Shared repo scanning (used by metrics_contract / protocol_check too)
+# ---------------------------------------------------------------------------
+
+
+#: one read+decode of each tree per process — the three protocol-tier
+#: rules (and the live-tree tests) share it instead of re-walking the
+#: repo per rule. Safe: the CLI is one-shot, and nothing mutates
+#: sources on disk mid-run.
+_SOURCE_CACHE: Dict[tuple, Dict[str, str]] = {}
+
+
+def repo_sources(paths, sources: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, str]:
+    """path -> source for every requested file/tree. `sources` overrides
+    the filesystem entirely when given (test fixtures)."""
+    if sources is not None:
+        return dict(sources)
+    key = tuple(paths)
+    cached = _SOURCE_CACHE.get(key)
+    if cached is not None:
+        return dict(cached)
+    out: Dict[str, str] = {}
+    for p in paths:
+        if os.path.isfile(p):
+            files = [p]
+        elif os.path.isdir(p):
+            files = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        else:
+            continue
+        for f in sorted(files):
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    out[f.replace(os.sep, "/")] = fh.read()
+            except OSError:
+                continue
+    _SOURCE_CACHE[key] = out
+    return dict(out)
+
+
+def missing_audited_files(sources: Dict[str, str], rule_id: str
+                          ) -> List[Finding]:
+    """A configured durable writer that no longer resolves is itself a
+    finding — the anti-rot rule must not rot silently when a refactor
+    moves/renames one of the files it audits."""
+    return [Finding(path, 1, rule_id,
+                    "configured durable writer is missing — a rename/"
+                    "move must update DURABILITY_FILES in "
+                    "analysis/rules/durability.py or this audit "
+                    "silently shrinks")
+            for path in DURABILITY_FILES if path not in sources]
+
+
+def unsuppressed(findings: List[Finding],
+                 sources: Dict[str, str]) -> List[Finding]:
+    """Apply the standard in-source suppression machinery to global-tier
+    findings (the per-file runner only does this for the AST tier)."""
+    parsed: Dict[str, Tuple[dict, set]] = {}
+    kept = []
+    for f in findings:
+        src = sources.get(f.path)
+        if src is None:
+            kept.append(f)
+            continue
+        if f.path not in parsed:
+            parsed[f.path] = parse_suppressions(src)
+        per_line, per_file = parsed[f.path]
+        if not is_suppressed(f, per_line, per_file):
+            kept.append(f)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# Statement-ordered durable-write event extraction
+# ---------------------------------------------------------------------------
+
+
+def _ordered(fn: ast.AST) -> Iterator[ast.AST]:
+    for child in ast.iter_child_nodes(fn):
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue            # nested defs are their own functions
+        yield from _ordered(child)
+
+
+from pinot_tpu.analysis.astutil import safe_unparse as _u  # noqa: E402
+
+
+def _iter_functions(tree: ast.Module) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _open_mode(call: ast.Call) -> str:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            return str(kw.value.value)
+    return "r"
+
+
+def _is_journalish(text: str) -> bool:
+    low = text.lower()
+    return any(m in low for m in JOURNAL_MARKERS)
+
+
+def function_events(fn: ast.AST) -> List[Tuple[str, str, int]]:
+    """(kind, detail, line) in statement order. Kinds: stage, rename,
+    truncate_journal, journal_append, write_open, read_open, publish."""
+    tmp_vars: Set[str] = set()
+    events: List[Tuple[str, str, int]] = []
+    fn_text = _u(fn)
+    for node in _ordered(fn):
+        line = getattr(node, "lineno", 1)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                ".tmp" in _u(node.value):
+            tmp_vars.add(node.targets[0].id)
+            continue
+        if isinstance(node, ast.Assign) and \
+                _u(node.targets[0]).startswith("self.") and \
+                "open(" not in _u(node.value):
+            events.append(("publish", _u(node.targets[0]), line))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        text = _u(node)
+        func_text = _u(node.func)
+        if func_text == "os.replace" and node.args:
+            src = _u(node.args[0])
+            if src in tmp_vars or ".tmp" in src:
+                events.append(("rename", src, line))
+            continue
+        if func_text.endswith(".truncate") and _is_journalish(fn_text):
+            events.append(("truncate_journal", func_text, line))
+            continue
+        if func_text.endswith(".write") and \
+                _is_journalish(func_text):
+            events.append(("journal_append", func_text, line))
+            continue
+        if func_text == "open" and node.args:
+            target = _u(node.args[0])
+            mode = _open_mode(node)
+            if target in tmp_vars or ".tmp" in target:
+                if "w" in mode:
+                    events.append(("stage", target, line))
+            elif "w" in mode and _is_journalish(target):
+                events.append(("truncate_journal", target, line))
+            elif "a" in mode and _is_journalish(target):
+                events.append(("journal_append", target, line))
+            elif "w" in mode:
+                events.append(("write_open", target, line))
+            elif "r" in mode or mode == "r":
+                events.append(("read_open", target, line))
+            continue
+        if "crash_points.hit" in text or "crash_points.consume" in text:
+            events.append(("crash_hit", text, line))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# durability-order
+# ---------------------------------------------------------------------------
+
+
+def check_durability_order(sources: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in sorted(sources):
+        try:
+            tree = ast.parse(sources[path], filename=path)
+        except SyntaxError:
+            continue
+        for fn in _iter_functions(tree):
+            events = function_events(fn)
+            stages = {d: ln for k, d, ln in events if k == "stage"}
+            renames = {d: ln for k, d, ln in events if k == "rename"}
+            for var, ln in sorted(renames.items()):
+                if var not in stages:
+                    findings.append(Finding(
+                        path, ln, "durability-order",
+                        f"`{fn.name}` renames staged file `{var}` that "
+                        "was never written in this function — the "
+                        "rename publishes bytes whose completeness "
+                        "nothing here guarantees"))
+                elif stages[var] > ln:
+                    findings.append(Finding(
+                        path, ln, "durability-order",
+                        f"`{fn.name}` renames `{var}` BEFORE the staged "
+                        "write — a crash publishes a torn file under "
+                        "the durable name"))
+            for var, ln in sorted(stages.items()):
+                if var not in renames:
+                    findings.append(Finding(
+                        path, ln, "durability-order",
+                        f"`{fn.name}` stages `{var}` but never "
+                        "atomically renames it — the durable copy is "
+                        "never updated (or is updated non-atomically "
+                        "elsewhere)"))
+            if stages and renames:
+                first_stage = min(stages.values())
+                last_rename = max(renames.values())
+                for kind, detail, ln in events:
+                    if kind == "publish" and first_stage < ln < last_rename:
+                        findings.append(Finding(
+                            path, ln, "durability-order",
+                            f"`{fn.name}` publishes in-memory state "
+                            f"`{detail}` before the staged file is "
+                            "renamed — a crash leaves memory ahead of "
+                            "the durable copy"))
+                    if kind == "truncate_journal" and ln < last_rename:
+                        findings.append(Finding(
+                            path, ln, "durability-order",
+                            f"`{fn.name}` truncates a journal before "
+                            "the covering snapshot rename is durable — "
+                            "a crash in between loses every journaled "
+                            "delta (the PR-4/PR-6 write discipline)"))
+            reads: Dict[str, int] = {}
+            for kind, detail, ln in events:
+                if kind == "read_open":
+                    reads.setdefault(detail, ln)
+                elif kind == "write_open" and detail in reads:
+                    findings.append(Finding(
+                        path, ln, "durability-order",
+                        f"`{fn.name}` rewrites `{detail}` in place "
+                        "after reading it (read-modify-write without a "
+                        "staged rename) — a crash mid-write destroys "
+                        "the only durable copy"))
+    return findings
+
+
+@register
+class DurabilityOrderRule(Rule):
+    id = "durability-order"
+    description = ("staged-write discipline at every durable-mutation "
+                   "site: write -> fsync -> atomic rename -> only then "
+                   "truncate/publish (protocol tier)")
+    tier = "protocol"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        return iter(())
+
+    def check_global(self) -> List[Finding]:
+        sources = repo_sources(DURABILITY_FILES)
+        return (missing_audited_files(sources, self.id) +
+                unsuppressed(check_durability_order(sources), sources))
+
+
+# ---------------------------------------------------------------------------
+# crash-coverage
+# ---------------------------------------------------------------------------
+
+
+def collect_crash_points(sources: Dict[str, str]
+                         ) -> Dict[str, Tuple[str, int]]:
+    """name -> (path, line) for every `crash_points.hit/consume` with a
+    literal name anywhere in the given sources."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for path in sorted(sources):
+        try:
+            tree = ast.parse(sources[path], filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    _u(node.func).endswith(("crash_points.hit",
+                                            "crash_points.consume")) and \
+                    node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out.setdefault(node.args[0].value,
+                               (path, node.lineno))
+    return out
+
+
+def _armed_strings(sources: Dict[str, str],
+                   registry: Dict[str, Tuple[str, int]]
+                   ) -> Tuple[Set[str], List[Tuple[str, str, int]]]:
+    """(strings that appear in tests, suspicious armed-but-unknown
+    names). A name counts as armed when it appears as ANY string
+    literal in a test/script (parametrize lists feed `arm(point)`
+    through a variable, so call-literal matching alone is blind)."""
+    seen: Set[str] = set()
+    unknown: List[Tuple[str, str, int]] = []
+    for path in sorted(sources):
+        try:
+            tree = ast.parse(sources[path], filename=path)
+        except SyntaxError:
+            continue
+        consts: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                consts.add(node.value)
+            # a literal armed directly, or a literal list/tuple that
+            # mixes known and unknown dotted names (a parametrize list
+            # with one renamed entry) — the unknowns are findings
+            if isinstance(node, ast.Call) and \
+                    _u(node.func).endswith(".arm") and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    node.args[0].value not in registry:
+                unknown.append((node.args[0].value, path, node.lineno))
+            if isinstance(node, (ast.List, ast.Tuple)):
+                vals = [e.value for e in node.elts
+                        if isinstance(e, ast.Constant) and
+                        isinstance(e.value, str)]
+                if any(v in registry for v in vals):
+                    for v in vals:
+                        if v not in registry and _DOTTED_NAME.match(v):
+                            unknown.append((v, path, node.lineno))
+        seen |= consts
+    return seen, unknown
+
+
+def check_crash_coverage(prod_sources: Dict[str, str],
+                         test_sources: Dict[str, str],
+                         durability_sources: Dict[str, str]
+                         ) -> List[Finding]:
+    findings: List[Finding] = []
+    registry = collect_crash_points(prod_sources)
+    armed, unknown = _armed_strings(test_sources, registry)
+
+    for name in sorted(registry):
+        path, line = registry[name]
+        if name not in armed:
+            findings.append(Finding(
+                path, line, "crash-coverage",
+                f"crash point `{name}` is armed by no test or smoke "
+                "script — the interleaving it splits is never "
+                "exercised"))
+    for name, path, line in sorted(set(unknown)):
+        findings.append(Finding(
+            path, line, "crash-coverage",
+            f"tests arm unknown crash point `{name}` — the production "
+            "hit was renamed or removed, so the test now exercises "
+            "nothing"))
+
+    # durable-mutation sites must be crash-splittable
+    for path in sorted(durability_sources):
+        try:
+            tree = ast.parse(durability_sources[path], filename=path)
+        except SyntaxError:
+            continue
+        fns = {fn.name: fn for fn in _iter_functions(tree)}
+        events = {name: function_events(fn) for name, fn in fns.items()}
+        hits = {name for name, evs in events.items()
+                if any(k == "crash_hit" for k, _d, _l in evs)}
+        calls: Dict[str, Set[str]] = {}
+        for name, fn in fns.items():
+            edges = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    t = _u(node.func)
+                    ref = t[5:] if t.startswith("self.") else t
+                    if ref in fns and ref != name:
+                        edges.add(ref)
+            calls[name] = edges
+        callers: Dict[str, Set[str]] = {}
+        for caller, callees in calls.items():
+            for c in callees:
+                callers.setdefault(c, set()).add(caller)
+        durable_kinds = {"stage", "rename", "truncate_journal",
+                         "journal_append"}
+        for name in sorted(fns):
+            evs = events[name]
+            durable = [(k, d, ln) for k, d, ln in evs
+                       if k in durable_kinds]
+            if not durable:
+                continue
+            covered = (name in hits or
+                       any(c in hits for c in calls[name]) or
+                       (callers.get(name) and
+                        all(c in hits for c in callers[name])))
+            if not covered:
+                findings.append(Finding(
+                    path, durable[0][2], "crash-coverage",
+                    f"durable mutation in `{name}` has no reachable "
+                    "crash point — kill-restart tests cannot split "
+                    "this write sequence (add a crash_points.hit and "
+                    "arm it)"))
+    return findings
+
+
+@register
+class CrashCoverageRule(Rule):
+    id = "crash-coverage"
+    description = ("every durable mutation reaches an armed crash "
+                   "point; every crash point is armed by a test; no "
+                   "test arms a phantom point (protocol tier)")
+    tier = "protocol"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        return iter(())
+
+    def check_global(self) -> List[Finding]:
+        prod = repo_sources(["pinot_tpu"])
+        tests = repo_sources(["tests", "scripts"])
+        dur = {p: s for p, s in prod.items() if p in DURABILITY_FILES}
+        return (missing_audited_files(dur, self.id) +
+                unsuppressed(check_crash_coverage(prod, tests, dur),
+                             prod))

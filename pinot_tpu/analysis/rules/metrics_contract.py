@@ -1,0 +1,244 @@
+"""metrics-contract: the exposition surface is a declared contract.
+
+Two checks, both protocol-tier (they need whole-tree knowledge):
+
+- **Registration**: every metric NAME passed as a string literal to
+  `metrics.meter/gauge/timer/peek_timer` must be a value declared in
+  one of the metric enum classes in `common/metrics.py`. Those classes
+  ARE the exposition contract — dashboards, alerts and the obs smoke
+  test key on them; an ad-hoc literal name is a series that exists only
+  where one call site happens to run, is invisible to review, and
+  silently vanishes when that call site moves. (Table/cause SUFFIXES —
+  the second argument — are intentionally free-form, mirroring the
+  reference's table-level metrics.)
+
+- **Gauge balance** (the `admissionQueueDepth` shape): a gauge exported
+  via `set_callable(lambda: self.<attr>)` over a counter attribute that
+  some method increments must have a balancing decrement somewhere in
+  the class — and when the increment and decrement live in the SAME
+  method with raising-capable calls between them, the decrement must
+  sit in a `finally`/`except` block, or the first exception leaks the
+  depth forever (the gauge drifts up until the capacity watermark sheds
+  everything). Cross-method pairings (inc in `admit`, dec in `release`
+  wired through a future callback) are the caller's contract and are
+  left to review — this rule pins down the two shapes it can prove.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis.core import Finding, Rule, register
+from pinot_tpu.analysis.rules.durability import repo_sources, unsuppressed
+
+METRICS_DECL_FILE = "pinot_tpu/common/metrics.py"
+
+_METRIC_FACTORIES = ("meter", "gauge", "timer", "peek_timer")
+
+#: trees whose metric call sites the registration check audits
+SCAN_PATHS = ("pinot_tpu",)
+_EXCLUDED_PREFIXES = ("pinot_tpu/analysis/",)
+
+
+from pinot_tpu.analysis.astutil import safe_unparse as _u  # noqa: E402
+
+
+def declared_metric_names(source: str) -> Set[str]:
+    """Every string constant assigned at class level in the metric enum
+    classes of common/metrics.py (Meter/Gauge/Timer/QueryPhase)."""
+    names: Set[str] = set()
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not node.name.endswith(("Meter", "Gauge", "Timer",
+                                   "QueryPhase", "Phase")):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    isinstance(stmt.value.value, str):
+                names.add(stmt.value.value)
+    return names
+
+
+def check_registration(sources: Dict[str, str],
+                       declared: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in sorted(sources):
+        if path == METRICS_DECL_FILE or \
+                any(path.startswith(p) for p in _EXCLUDED_PREFIXES):
+            continue
+        try:
+            tree = ast.parse(sources[path], filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in _METRIC_FACTORIES and node.args):
+                continue
+            receiver = _u(node.func.value).lower()
+            if "metric" not in receiver and "registry" not in receiver:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    arg.value not in declared:
+                findings.append(Finding(
+                    path, node.lineno, "metrics-contract",
+                    f"metric name {arg.value!r} is not declared in "
+                    "common/metrics.py — the exposition contract "
+                    "(dashboards, obs smoke) cannot see it; declare a "
+                    "constant in the component's enum class"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Gauge balance
+# ---------------------------------------------------------------------------
+
+
+def _gauge_backed_attrs(cls: ast.ClassDef) -> Dict[str, int]:
+    """attr -> line for gauges exported as `set_callable(lambda:
+    self.<attr>)` (the live counter shape; method refs are snapshots,
+    not counters, and are skipped)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "set_callable" and node.args):
+            continue
+        if not (isinstance(node.func.value, ast.Call) and
+                isinstance(node.func.value.func, ast.Attribute) and
+                node.func.value.func.attr == "gauge"):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Lambda) and \
+                isinstance(arg.body, ast.Attribute) and \
+                isinstance(arg.body.value, ast.Name) and \
+                arg.body.value.id == "self":
+            out[arg.body.attr] = node.lineno
+    return out
+
+
+def _writes_of(method: ast.AST, attr: str
+               ) -> List[Tuple[str, int, ast.AST]]:
+    """('inc'|'dec', line, node) for every +/- write of self.<attr>."""
+    out = []
+    target = f"self.{attr}"
+    for node in ast.walk(method):
+        if isinstance(node, ast.AugAssign) and _u(node.target) == target:
+            op = "inc" if isinstance(node.op, ast.Add) else "dec"
+            out.append((op, node.lineno, node))
+        elif isinstance(node, ast.Assign) and \
+                _u(node.targets[0]) == target:
+            text = _u(node.value)
+            if "+ 1" in text or "+1" in text:
+                out.append(("inc", node.lineno, node))
+            elif "- 1" in text or "-1" in text:
+                out.append(("dec", node.lineno, node))
+    return out
+
+
+def _in_handler_or_finally(method: ast.AST, node: ast.AST) -> bool:
+    for t in ast.walk(method):
+        if isinstance(t, ast.Try):
+            for blk in list(t.finalbody) + \
+                    [s for h in t.handlers for s in h.body]:
+                if node is blk or any(node is d for d in ast.walk(blk)):
+                    return True
+    return False
+
+
+def check_gauge_balance(sources: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in sorted(sources):
+        if any(path.startswith(p) for p in _EXCLUDED_PREFIXES):
+            continue
+        try:
+            tree = ast.parse(sources[path], filename=path)
+        except SyntaxError:
+            continue
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for attr, decl_line in sorted(
+                    _gauge_backed_attrs(cls).items()):
+                methods = [m for m in cls.body if isinstance(
+                    m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+                incs, decs = [], []
+                for m in methods:
+                    for op, line, node in _writes_of(m, attr):
+                        (incs if op == "inc" else decs).append(
+                            (m, line, node))
+                if incs and not decs:
+                    m, line, _n = incs[0]
+                    findings.append(Finding(
+                        path, line, "metrics-contract",
+                        f"gauge-backed counter `self.{attr}` is "
+                        f"incremented in `{m.name}` but never "
+                        "decremented anywhere in "
+                        f"`{cls.name}` — the exported depth can only "
+                        "drift up"))
+                    continue
+                # same-method pairs: the dec must survive exceptions.
+                # Risky = a call strictly BETWEEN the increment and the
+                # first following decrement — calls after the pair has
+                # already balanced (trailing logging etc.) cannot leak
+                for m in methods:
+                    writes = _writes_of(m, attr)
+                    m_incs = [w for w in writes if w[0] == "inc"]
+                    m_decs = [w for w in writes if w[0] == "dec"]
+                    if not (m_incs and m_decs):
+                        continue
+                    inc_line = min(w[1] for w in m_incs)
+                    dec_after = [w[1] for w in m_decs if w[1] > inc_line]
+                    dec_line = min(dec_after) if dec_after else \
+                        max(getattr(n, "lineno", 0) for n in ast.walk(m))
+                    risky = any(isinstance(n, ast.Call) and
+                                inc_line < getattr(n, "lineno", 0)
+                                < dec_line
+                                for n in ast.walk(m))
+                    if risky and not any(
+                            _in_handler_or_finally(m, w[2])
+                            for w in m_decs):
+                        findings.append(Finding(
+                            path, m_decs[0][1], "metrics-contract",
+                            f"`{cls.name}.{m.name}` increments "
+                            f"gauge-backed `self.{attr}` and "
+                            "decrements it on the success path only — "
+                            "an exception between the two leaks the "
+                            "depth forever; put the balancing write in "
+                            "a finally block"))
+    return findings
+
+
+@register
+class MetricsContractRule(Rule):
+    id = "metrics-contract"
+    description = ("metric names must be declared in common/metrics.py; "
+                   "gauge-backed counters must balance on exception "
+                   "paths (protocol tier)")
+    tier = "protocol"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        return iter(())
+
+    def check_global(self,
+                     sources: Optional[Dict[str, str]] = None
+                     ) -> List[Finding]:
+        srcs = repo_sources(SCAN_PATHS, sources)
+        decl_src = srcs.get(METRICS_DECL_FILE)
+        findings: List[Finding] = []
+        if decl_src is None:
+            findings.append(Finding(
+                METRICS_DECL_FILE, 1, self.id,
+                "metric declaration module not found — the "
+                "registration check has no contract to verify"))
+            declared: Set[str] = set()
+        else:
+            declared = declared_metric_names(decl_src)
+        findings += check_registration(srcs, declared)
+        findings += check_gauge_balance(srcs)
+        return unsuppressed(findings, srcs)

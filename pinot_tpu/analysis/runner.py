@@ -85,14 +85,16 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 def analyze_paths(paths: Sequence[str],
                   config: Optional[AnalysisConfig] = None,
                   rule_ids: Optional[Set[str]] = None,
-                  deep: bool = False) -> AnalysisResult:
+                  deep: bool = False,
+                  protocol: bool = False) -> AnalysisResult:
     """Analyze every .py file under `paths` (files or directories).
 
     Paths should be given relative to the repo root so finding keys
     match the committed baseline. `deep=True` additionally runs the
-    global deep-tier rules (kernel jaxpr contracts, wire schema) once
-    for the whole run — they are path-independent, so run them from the
-    repo root only.
+    global deep-tier rules (kernel jaxpr contracts, wire schema);
+    `protocol=True` the protocol tier (durability ordering, crash
+    coverage, metrics contract, crash-interleaving model checker).
+    Both tiers are path-independent — run them from the repo root only.
     """
     total = AnalysisResult([], [], [])
     for path in iter_py_files(paths):
@@ -107,9 +109,11 @@ def analyze_paths(paths: Sequence[str],
         total.findings.extend(res.findings)
         total.suppressed.extend(res.suppressed)
         total.errors.extend(res.errors)
-    if deep:
+    tiers = (["deep"] if deep else []) + (["protocol"] if protocol
+                                          else [])
+    for tier in tiers:
         for rule_id, rule in sorted(all_rules().items()):
-            if rule.tier != "deep":
+            if rule.tier != tier:
                 continue
             if rule_ids is not None and rule_id not in rule_ids:
                 continue
@@ -117,8 +121,8 @@ def analyze_paths(paths: Sequence[str],
                 total.findings.extend(rule.check_global())
             except Exception as e:  # noqa: BLE001 — a crashed checker
                 total.errors.append(    # must fail the gate loudly
-                    f"deep rule {rule_id} crashed: {type(e).__name__}: "
-                    f"{e}")
+                    f"{tier} rule {rule_id} crashed: "
+                    f"{type(e).__name__}: {e}")
     total.findings.sort()
     total.suppressed.sort()
     return total

@@ -1,0 +1,100 @@
+"""SARIF 2.1.0 export: tpulint findings as CI annotations.
+
+One run, one tool (`tpulint`), every rule that is registered, every
+finding that the analysis produced — including suppressed ones (SARIF
+`suppressions`, kind `inSource`) and the grandfathered/new split
+(SARIF `baselineState`: `unchanged` vs `new`), so a CI viewer renders
+exactly the gate's verdict and nothing is lost in translation. The
+round-trip contract (tested): rule id, file, line, message, suppression
+state, and baseline state all survive `to_sarif` -> JSON -> parse.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from pinot_tpu.analysis.core import Finding, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _result(f: Finding, baseline_state: str, suppressed: bool) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "level": "error" if baseline_state == "new" and not suppressed
+                 else "note",
+        "baselineState": baseline_state,
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line},
+            },
+        }],
+    }
+    if suppressed:
+        out["suppressions"] = [{"kind": "inSource"}]
+    return out
+
+
+def to_sarif(findings: List[Finding], suppressed: List[Finding],
+             baseline: Dict[str, int]) -> dict:
+    """`baseline` is the committed grandfather map (key -> count): per
+    key the first N occurrences are `unchanged`, the rest `new` — the
+    exact split the gate enforces."""
+    rules = [{"id": rid,
+              "shortDescription": {"text": rule.description},
+              "properties": {"tier": rule.tier}}
+             for rid, rule in sorted(all_rules().items())]
+    seen: Dict[str, int] = {}
+    results = []
+    for f in sorted(findings):
+        n = seen.get(f.key(), 0)
+        seen[f.key()] = n + 1
+        state = "unchanged" if n < baseline.get(f.key(), 0) else "new"
+        results.append(_result(f, state, suppressed=False))
+    for f in sorted(suppressed):
+        results.append(_result(f, "unchanged", suppressed=True))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri":
+                    "docs/ANALYSIS.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: List[Finding],
+                suppressed: List[Finding],
+                baseline: Dict[str, int]) -> dict:
+    doc = to_sarif(findings, suppressed, baseline)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return doc
+
+
+def parse_sarif(doc: dict) -> List[dict]:
+    """Flatten a SARIF doc back to comparable finding dicts (the
+    round-trip test's other half)."""
+    out = []
+    for run in doc.get("runs", []):
+        for res in run.get("results", []):
+            loc = res["locations"][0]["physicalLocation"]
+            out.append({
+                "rule": res["ruleId"],
+                "path": loc["artifactLocation"]["uri"],
+                "line": loc["region"]["startLine"],
+                "message": res["message"]["text"],
+                "baselineState": res.get("baselineState"),
+                "suppressed": bool(res.get("suppressions")),
+            })
+    return out

@@ -24,8 +24,8 @@ from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
                                         SEGMENT_MISSING_EXC_PREFIX,
                                         SERVER_BUSY_EXC_PREFIX,
                                         SERVER_BUSY_KEY)
-from pinot_tpu.common.metrics import (BrokerMeter, BrokerQueryPhase,
-                                      MetricsRegistry)
+from pinot_tpu.common.metrics import (BrokerGauge, BrokerMeter,
+                                      BrokerQueryPhase, MetricsRegistry)
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.common.serde import instance_request_to_bytes
@@ -496,7 +496,7 @@ class BrokerRequestHandler:
         # that appears after the first query) and export uptime
         self._t_boot = time.monotonic()
         self.metrics.meter(BrokerMeter.QUERIES)
-        self.metrics.gauge("uptimeSeconds").set_callable(
+        self.metrics.gauge(BrokerGauge.UPTIME_SECONDS).set_callable(
             lambda: time.monotonic() - self._t_boot)
         self.fault_tolerance = fault_tolerance or FaultToleranceManager(
             metrics=self.metrics)

@@ -251,6 +251,8 @@ class BrokerGauge:
     # per-server (table-suffixed) fault-tolerance observability
     SERVER_HEALTH = "serverHealth"          # EWMA success score in [0, 1]
     BREAKER_STATE = "breakerState"          # 0 closed / 1 half-open / 2 open
+    # seconds since the handler booted (exposition liveness probe)
+    UPTIME_SECONDS = "uptimeSeconds"
 
 
 class BrokerTimer:
@@ -314,6 +316,9 @@ class ControllerGauge:
     # capacity) minus (ideal-state holders that are live) — 0 when the
     # cluster is fully repaired, >0 while self-healing is in progress
     CLUSTER_REPLICATION_DEFICIT = "clusterReplicationDeficit"
+    # registered tables / schemas (cheap sanity series for dashboards)
+    TABLE_COUNT = "tableCount"
+    SCHEMA_COUNT = "schemaCount"
 
 
 class ServerQueryPhase:

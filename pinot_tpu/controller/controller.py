@@ -70,9 +70,9 @@ class Controller:
         # always-present cluster gauges (parity: ControllerMetrics'
         # tableCount/segmentCount-style validation gauges) — /metrics is
         # never empty, even before any periodic task ran
-        self.metrics.gauge("tableCount").set_callable(
+        self.metrics.gauge(ControllerGauge.TABLE_COUNT).set_callable(
             lambda: len(self.manager.table_names()))
-        self.metrics.gauge("schemaCount").set_callable(
+        self.metrics.gauge(ControllerGauge.SCHEMA_COUNT).set_callable(
             lambda: len(self.manager.store.children("/CONFIGS/SCHEMA")))
         self.metrics.gauge(
             ControllerGauge.CLUSTER_REPLICATION_DEFICIT).set_callable(

@@ -146,7 +146,14 @@ class PropertyStore:
             size = os.path.getsize(wal_path)
             if valid_bytes < size:
                 # truncate back to the last complete record so new
-                # appends don't concatenate onto torn bytes
+                # appends don't concatenate onto torn bytes. Seeded
+                # crash point: dying DURING recovery's repair truncate
+                # (the double-crash window) must leave the WAL
+                # recoverable again — truncation only ever removes
+                # already-rejected torn bytes, so re-running recovery
+                # converges to the same state
+                from pinot_tpu.common.faults import crash_points
+                crash_points.hit("store.recover_truncate")
                 with open(wal_path, "r+b") as f:
                     f.truncate(valid_bytes)
         self._wal = open(wal_path, "a", encoding="utf-8")
@@ -204,6 +211,11 @@ class PropertyStore:
             json.dump({"seq": self._seq, "data": durable}, f)
             f.flush()
             os.fsync(f.fileno())  # tpulint: disable=lock-blocking -- same snapshot-swap atomicity invariant as the open() above
+        # seeded crash point: snapshot staged but not renamed — the WAL
+        # is untruncated, so recovery ignores the .tmp and replays the
+        # (longer) journal over the previous snapshot
+        from pinot_tpu.common.faults import crash_points
+        crash_points.hit("store.snapshot_rename")
         os.replace(tmp, os.path.join(self.data_dir, name))
         self._wal.close()
         self._wal = open(os.path.join(self.data_dir, WAL_FILE), "w",  # tpulint: disable=lock-blocking -- the WAL swap is part of the atomic snapshot step; a mutation slipping between truncate and reopen would be lost

@@ -239,6 +239,11 @@ class PartitionUpsertMetadata:
         if not self.enable_snapshot:
             return
         with self._lock:                  # RLock: reentrant from callers
+            # seeded crash point: die before the append — the batch is
+            # in memory but neither journaled nor offset-acked, so the
+            # restarted consumer re-fetches and re-applies it (the
+            # order-independent fold makes the replay idempotent)
+            crash_points.hit("upsert.journal_append")
             try:
                 if self._journal_f is None:
                     self._journal_f = open(self._journal_path(), "a")  # tpulint: disable=lock-blocking -- crash-consistency: the key-map mutation and its journal record must be atomic; append cadence is per consume batch, not per query

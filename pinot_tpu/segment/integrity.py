@@ -40,7 +40,11 @@ def compute_crc(seg_dir: str) -> str:
     are detected. Returned as a decimal string (SegmentMetadata.crc)."""
     crc = 0
     for name in sorted(os.listdir(seg_dir)):
-        if name == fmt.METADATA_FILE:
+        if name == fmt.METADATA_FILE or name.endswith(".tmp"):
+            # .tmp files are staging leftovers (a crash between stage
+            # and rename, e.g. at integrity.stamp_rename) — never part
+            # of the durable payload, so they must not poison the crc
+            # of an otherwise-intact artifact on cold-start rescan
             continue
         path = os.path.join(seg_dir, name)
         if os.path.isdir(path):
@@ -56,16 +60,29 @@ def compute_crc(seg_dir: str) -> str:
 
 
 def stamp_crc(seg_dir: str) -> str:
-    """Compute the artifact crc and stamp it into metadata.json in
-    place; returns the crc. Run at seal time (SegmentCreator.build) and
-    lazily for pre-integrity artifacts entering the deep store."""
+    """Compute the artifact crc and stamp it into metadata.json via a
+    staged write + atomic rename; returns the crc. Run at seal time
+    (SegmentCreator.build) and lazily for pre-integrity artifacts
+    entering the deep store. The rewrite used to be in place — a crash
+    mid-write left a torn metadata.json, destroying the only copy of
+    the segment's schema/index layout (surfaced by the tpulint
+    `durability-order` rule; staged-rename is the repo-wide discipline,
+    docs/ROBUSTNESS.md)."""
+    from pinot_tpu.common.faults import crash_points
     crc = compute_crc(seg_dir)
     meta_path = os.path.join(seg_dir, fmt.METADATA_FILE)
     with open(meta_path) as f:
         meta = json.load(f)
     meta["crc"] = crc
-    with open(meta_path, "w") as f:
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(meta, f, indent=1, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    # seeded crash point: metadata staged but not yet published — the
+    # old metadata.json is still intact and a re-run re-stamps cleanly
+    crash_points.hit("integrity.stamp_rename")
+    os.replace(tmp, meta_path)
     return crc
 
 

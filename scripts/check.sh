@@ -62,11 +62,21 @@ echo "== obs smoke (observability plane) =="
 # trace tree with per-server subtrees
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== tpulint (deep tier) =="
+echo "== tpulint (deep + protocol tiers) =="
 # --deep adds the below-the-AST gates on top of the AST families:
 # every registered kernel is traced with jax.make_jaxpr across the
 # shape-bucket grid (no host callbacks, no 64-bit avals in 32-bit
 # mode, stable retrace) and the serde wire surface must round-trip
-# against the committed wire-schema.json. On failure the CLI prints a
-# findings-diff summary (rule id, file:line, fix-or-suppress guidance).
-exec "$(dirname "$0")/lint.sh" --deep
+# against the committed wire-schema.json. --protocol adds the
+# crash-protocol gates: staged-write durability ordering over the
+# durable writers, crash-point coverage (every durable mutation
+# splittable, every point armed by a test), the metrics exposition
+# contract, an exhaustive crash-interleaving model check of the
+# extracted lease/rebalance/takeover/upsert-seal/drain transition
+# systems against the written ROBUSTNESS.md invariants (state counts
+# logged; hitting --max-states is a finding, never silent), and a
+# drift gate against the committed protocol-model.json. On failure the
+# CLI prints a findings-diff summary (rule id, file:line,
+# fix-or-suppress guidance) — and for invariant violations, the
+# counterexample trace.
+exec "$(dirname "$0")/lint.sh" --deep --protocol

@@ -5,6 +5,10 @@
 #
 #   scripts/lint.sh              fast tier (AST rule families)
 #   scripts/lint.sh --deep       + jaxpr kernel contracts + wire-schema
+#   scripts/lint.sh --deep --protocol
+#                                + durability order, crash coverage,
+#                                  metrics contract, and the exhaustive
+#                                  crash-interleaving model checker
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

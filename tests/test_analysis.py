@@ -44,7 +44,11 @@ def test_rule_families_registered():
         # deep-analysis AST families (lock graph + event-loop safety)
         "lock-order", "lock-blocking", "async-blocking", "cross-loop",
         # global deep tier (jaxpr contracts, wire surface)
-        "kernel-contract", "wire-schema"}
+        "kernel-contract", "wire-schema",
+        # global protocol tier (durability discipline, crash coverage,
+        # metrics exposition contract, crash-interleaving model check)
+        "durability-order", "crash-coverage", "metrics-contract",
+        "protocol-invariants", "protocol-model"}
 
 
 def test_deep_rules_are_deep_tier_only():

@@ -701,7 +701,7 @@ def test_rule_filter_on_deep_rule_implies_deep_tier(tmp_path):
     # rule was accepted by validation but never executed
     proc = _run_cli(["--rule", "wire-schema"], REPO_ROOT)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "tpulint[deep]" in proc.stdout
+    assert "tpulint[fast+deep]" in proc.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -957,4 +957,4 @@ def test_deep_cli_green_on_repo():
     proc = _run_cli(["pinot_tpu/", "--deep", "--strict-baseline"],
                     REPO_ROOT)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "tpulint[deep]" in proc.stdout
+    assert "tpulint[fast+deep]" in proc.stdout

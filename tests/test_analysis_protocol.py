@@ -1,0 +1,706 @@
+"""tpulint protocol tier: durability-order, crash-coverage,
+metrics-contract, the crash-interleaving model checker, the committed
+protocol model, and SARIF export.
+
+Every rule is exercised both ways: known-bad fixtures (each one a shape
+that really bit, or would have — publish-before-rename, truncate-
+before-snapshot, the PR-6-era in-place metadata rewrite, uncovered
+durable mutations, phantom crash points, unbalanced gauges, a 3-step
+lease protocol with a seeded double-leader bug) must be CAUGHT, and the
+live tree must pass with ZERO suppressions. The model checker is
+additionally pinned for determinism (state counts + trace bytes agree
+across runs) and loud truncation.
+"""
+import json
+import os
+
+import pytest
+
+from pinot_tpu.analysis import protocol, sarif
+from pinot_tpu.analysis.core import Finding
+from pinot_tpu.analysis.rules import durability, metrics_contract
+from pinot_tpu.analysis.rules.durability import (
+    check_crash_coverage, check_durability_order, collect_crash_points,
+    repo_sources)
+from pinot_tpu.analysis.rules.metrics_contract import (
+    check_gauge_balance, check_registration, declared_metric_names)
+
+
+# ---------------------------------------------------------------------------
+# durability-order
+# ---------------------------------------------------------------------------
+
+
+def test_publish_before_rename_flagged():
+    src = '''
+import json, os
+
+class Store:
+    def seal(self, path, snap):
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        self.snapshot_offset = snap["offset"]
+        os.replace(tmp, path)
+'''
+    fs = check_durability_order({"fix/store.py": src})
+    assert any("publishes in-memory state" in f.message for f in fs), fs
+
+
+def test_truncate_before_snapshot_rename_flagged():
+    src = '''
+import json, os
+
+class Store:
+    def seal(self, path, snap):
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        self._journal_f = open(self._journal_path(), "w")
+        os.replace(tmp, path)
+'''
+    fs = check_durability_order({"fix/store.py": src})
+    assert any("truncates a journal before" in f.message for f in fs), fs
+
+
+def test_inplace_rewrite_flagged():
+    # the exact pre-fix stamp_crc shape: read metadata.json, rewrite it
+    # in place — a crash mid-write destroys the only copy
+    src = '''
+import json, os
+
+def stamp_crc(seg_dir):
+    meta_path = os.path.join(seg_dir, "metadata.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["crc"] = "1"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+'''
+    fs = check_durability_order({"fix/integrity.py": src})
+    assert any("rewrites" in f.message and "in place" in f.message
+               for f in fs), fs
+
+
+def test_rename_without_staged_write_flagged():
+    src = '''
+import os
+
+class Store:
+    def seal(self, path):
+        tmp = f"{path}.tmp"
+        os.replace(tmp, path)
+'''
+    fs = check_durability_order({"fix/store.py": src})
+    assert any("never written" in f.message for f in fs), fs
+
+
+def test_stage_without_rename_flagged():
+    src = '''
+import json
+
+class Store:
+    def seal(self, path, snap):
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+'''
+    fs = check_durability_order({"fix/store.py": src})
+    assert any("never" in f.message and "renames" in f.message
+               for f in fs), fs
+
+
+def test_missing_audited_writer_is_a_finding():
+    """A refactor that moves/renames one of the four durable writers
+    must fail the gate, not silently shrink the audit."""
+    from pinot_tpu.analysis.rules.durability import missing_audited_files
+    sources = repo_sources(durability.DURABILITY_FILES)
+    sources.pop("pinot_tpu/realtime/data_manager.py")
+    fs = missing_audited_files(sources, "durability-order")
+    assert len(fs) == 1
+    assert fs[0].path == "pinot_tpu/realtime/data_manager.py"
+    assert "missing" in fs[0].message
+    # and the intact tree yields none
+    assert missing_audited_files(
+        repo_sources(durability.DURABILITY_FILES),
+        "durability-order") == []
+
+
+def test_live_tree_durability_order_clean():
+    """The four protocol writers pass with ZERO suppressions — the
+    discipline holds by code, not by disable comments."""
+    sources = repo_sources(durability.DURABILITY_FILES)
+    assert len(sources) == len(durability.DURABILITY_FILES)
+    fs = check_durability_order(sources)   # raw, pre-suppression
+    assert fs == [], [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# crash-coverage
+# ---------------------------------------------------------------------------
+
+
+def test_uncovered_durable_mutation_flagged():
+    prod = {"p/writer.py": '''
+import json, os
+
+class Writer:
+    def seal(self, path, snap):
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        os.replace(tmp, path)
+'''}
+    fs = check_crash_coverage(prod, {}, prod)
+    assert any("no reachable crash point" in f.message for f in fs), fs
+
+
+def test_covered_via_caller_passes():
+    prod = {"p/writer.py": '''
+import json, os
+from pinot_tpu.common.faults import crash_points
+
+class Writer:
+    def seal(self, path, snap):
+        crash_points.hit("writer.seal")
+        self._write_one(path, snap)
+
+    def _write_one(self, path, snap):
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        os.replace(tmp, path)
+'''}
+    tests = {"t/test_w.py": 'def test():\n    arm("writer.seal")\n'}
+    fs = check_crash_coverage(prod, tests, prod)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_unarmed_crash_point_flagged():
+    prod = {"p/writer.py": '''
+from pinot_tpu.common.faults import crash_points
+
+def mutate():
+    crash_points.hit("writer.lonely_point")
+'''}
+    fs = check_crash_coverage(prod, {"t/test_w.py": "x = 1\n"}, {})
+    assert any("armed by no test" in f.message and
+               "writer.lonely_point" in f.message for f in fs), fs
+
+
+def test_phantom_armed_point_flagged():
+    prod = {"p/writer.py": '''
+from pinot_tpu.common.faults import crash_points
+
+def mutate():
+    crash_points.hit("writer.real_point")
+'''}
+    tests = {"t/test_w.py": '''
+def test():
+    crash_points.arm("writer.renamed_away")
+'''}
+    fs = check_crash_coverage(prod, tests, {})
+    assert any("unknown crash point" in f.message and
+               "writer.renamed_away" in f.message for f in fs), fs
+
+
+def test_parametrize_list_member_resolution():
+    """A parametrize list mixing known and renamed points flags exactly
+    the renamed member."""
+    prod = {"p/writer.py": '''
+from pinot_tpu.common.faults import crash_points
+
+def mutate():
+    crash_points.hit("writer.a")
+'''}
+    tests = {"t/test_w.py": '''
+import pytest
+
+@pytest.mark.parametrize("point", ["writer.a", "writer.gone"])
+def test(point):
+    crash_points.arm(point)
+'''}
+    fs = check_crash_coverage(prod, tests, {})
+    unknown = [f for f in fs if "unknown crash point" in f.message]
+    assert len(unknown) == 1 and "writer.gone" in unknown[0].message, fs
+
+
+def test_live_tree_crash_coverage_clean():
+    prod = repo_sources(["pinot_tpu"])
+    tests = repo_sources(["tests", "scripts"])
+    dur = {p: s for p, s in prod.items()
+           if p in durability.DURABILITY_FILES}
+    fs = check_crash_coverage(prod, tests, dur)
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_live_registry_covers_all_documented_points():
+    """Every crash point the docs/tests rely on exists in code."""
+    registry = collect_crash_points(repo_sources(["pinot_tpu"]))
+    for name in ("store.wal_append", "store.wal_torn",
+                 "store.snapshot_rename", "store.recover_truncate",
+                 "upsert.seal", "upsert.keymap_snapshot",
+                 "upsert.replay", "upsert.journal_append",
+                 "rebalance.move_staged", "rebalance.pre_commit",
+                 "takeover.pre_resume", "integrity.stamp_rename",
+                 "controller.commit_pre_done",
+                 "controller.commit_pre_successor",
+                 "server.post_download"):
+        assert name in registry, name
+
+
+# ---------------------------------------------------------------------------
+# metrics-contract
+# ---------------------------------------------------------------------------
+
+_DECL = '''
+class ServerMeter:
+    QUERIES = "queries"
+
+class ServerGauge:
+    DEPTH = "queueDepth"
+'''
+
+
+def test_unregistered_literal_name_flagged():
+    src = '''
+class C:
+    def f(self):
+        self.metrics.meter("adHocSeries").mark()
+        self.metrics.meter("queries").mark()
+'''
+    declared = declared_metric_names(_DECL)
+    fs = check_registration({"p/c.py": src}, declared)
+    assert len(fs) == 1 and "adHocSeries" in fs[0].message, fs
+
+
+def test_unbalanced_gauge_flagged():
+    src = '''
+class Gate:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self._depth = 0
+        self.metrics.gauge("queueDepth").set_callable(
+            lambda: self._depth)
+
+    def admit(self):
+        self._depth += 1
+'''
+    fs = check_gauge_balance({"p/gate.py": src})
+    assert any("never" in f.message and "decremented" in f.message
+               for f in fs), fs
+
+
+def test_success_path_only_decrement_flagged():
+    src = '''
+class Gate:
+    def __init__(self, metrics):
+        self._depth = 0
+        metrics.gauge("queueDepth").set_callable(lambda: self._depth)
+
+    def run(self, work):
+        self._depth += 1
+        work()
+        self._depth -= 1
+'''
+    fs = check_gauge_balance({"p/gate.py": src})
+    assert any("finally" in f.message for f in fs), fs
+
+
+def test_balanced_in_finally_passes():
+    src = '''
+class Gate:
+    def __init__(self, metrics):
+        self._depth = 0
+        metrics.gauge("queueDepth").set_callable(lambda: self._depth)
+
+    def run(self, work):
+        self._depth += 1
+        try:
+            work()
+        finally:
+            self._depth -= 1
+'''
+    assert check_gauge_balance({"p/gate.py": src}) == []
+
+
+def test_trailing_call_after_balanced_pair_passes():
+    """Calls AFTER the pair has balanced (trailing logging) cannot leak
+    the depth — only calls strictly between inc and dec are risky."""
+    src = '''
+class Gate:
+    def __init__(self, metrics):
+        self._depth = 0
+        metrics.gauge("queueDepth").set_callable(lambda: self._depth)
+
+    def tick(self):
+        self._depth += 1
+        self._depth -= 1
+        log.debug("ticked")
+'''
+    assert check_gauge_balance({"p/gate.py": src}) == []
+
+
+def test_cross_method_pairing_passes():
+    """The admissionQueueDepth shape itself: inc in admit, dec in
+    release — balanced across methods, caller-wired."""
+    src = '''
+class Gate:
+    def __init__(self, metrics):
+        self._depth = 0
+        metrics.gauge("queueDepth").set_callable(lambda: self._depth)
+
+    def admit(self):
+        self._depth += 1
+
+    def release(self):
+        self._depth -= 1
+'''
+    assert check_gauge_balance({"p/gate.py": src}) == []
+
+
+def test_live_tree_metrics_contract_clean():
+    rule = metrics_contract.MetricsContractRule()
+    fs = rule.check_global()
+    assert fs == [], [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# protocol model checker
+# ---------------------------------------------------------------------------
+
+
+def test_live_protocols_hold_exhaustively():
+    result = protocol.check_protocols()
+    assert result.problems == []
+    assert len(result.reports) == 5
+    for report in result.reports:
+        assert not report.truncated, report.system
+        assert report.states > 0
+        assert report.violations == [], (
+            report.system,
+            [(v.invariant, v.render_trace()) for v in report.violations])
+    # the lease interleaving space is the big one; the whole exploration
+    # is genuinely multi-thousand-state, not a degenerate walk
+    assert sum(r.states for r in result.reports) > 1_000
+
+
+_BAD_LEASE = '''
+class ControllerLeadershipManager:
+    def try_acquire(self):
+        cur = self.store.get(LEADER_PATH)
+        expired = (cur or {}).get("leaseUntil", 0) < now
+        rec = dict(cur or {})
+        rec["instance"] = self.instance_id
+        rec["leaseUntil"] = now + self.lease_s
+        return self.store.cas(LEADER_PATH, cur, rec)
+
+    def holds_fenced_lease(self):
+        rec = self.store.get(LEADER_PATH) or {}
+        return rec.get("instance") == self.instance_id and \\
+            rec.get("leaseUntil", 0) >= self._clock() and \\
+            int(rec.get("epoch", 0)) == self._epoch
+'''
+
+
+def test_seeded_double_leader_bug_yields_counterexample():
+    """The 3-step lease protocol WITHOUT the epoch bump: a deposed-
+    then-reelected controller's old-incarnation write is admitted. The
+    checker must produce the readable ordered trace."""
+    result = protocol.check_protocols(
+        sources={protocol.LEASE_PATH: _BAD_LEASE}, only=["lease"])
+    assert result.problems == []
+    (report,) = result.reports
+    assert len(report.violations) == 1
+    v = report.violations[0]
+    assert v.invariant == "fenced-writes"
+    trace = v.render_trace()
+    assert "counterexample" in trace and "->" in trace
+    # the trace is the reelection scenario: two expiries, a competing
+    # acquire, then the stale incarnation's store write
+    assert "env.lease_expires" in trace
+    assert "fenced_store_write" in trace
+
+
+def test_fence_flag_ignores_docstring_mentions():
+    """A docstring that says "epoch" must not vouch for a DELETED epoch
+    comparison — the flag is derived from Compare nodes only, so the
+    weakened fence produces the fenced-writes counterexample."""
+    weakened = '''
+class ControllerLeadershipManager:
+    def try_acquire(self):
+        cur = self.store.get(LEADER_PATH)
+        expired = (cur or {}).get("leaseUntil", 0) < now
+        rec = dict(cur or {})
+        rec["epoch"] = int(rec.get("epoch", 0)) + 1
+        rec["instance"] = self.instance_id
+        return self.store.cas(LEADER_PATH, cur, rec)
+
+    def holds_fenced_lease(self):
+        """Verifies holder + TTL + epoch before every write."""
+        rec = self.store.get(LEADER_PATH) or {}
+        return rec.get("instance") == self.instance_id and \\
+            rec.get("leaseUntil", 0) >= self._clock()
+'''
+    ex = protocol.extract_lease({protocol.LEASE_PATH: weakened})
+    assert ex.flags["fence_epoch"] is False
+    result = protocol.check_protocols(
+        sources={protocol.LEASE_PATH: weakened}, only=["lease"])
+    (report,) = result.reports
+    assert "fenced-writes" in [v.invariant for v in report.violations]
+
+
+def test_seal_truncate_before_rename_yields_counterexample():
+    bad = '''
+class PartitionUpsertMetadata:
+    def seal(self, seq, end_offset, num_docs):
+        crash_points.hit("upsert.seal")
+        self._write_sidecar(seq, 0, [], 0)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        self._journal_f = open(self._journal_path(), "w")
+        crash_points.hit("upsert.keymap_snapshot")
+        os.replace(tmp, path)
+        self.snapshot_offset = int(end_offset)
+'''
+    result = protocol.check_protocols(
+        sources={protocol.SEAL_PATH: bad}, only=["upsert-seal"])
+    (report,) = result.reports
+    assert [v.invariant for v in report.violations] == \
+        ["no-acked-delta-loss"]
+    assert "truncate_journal" in report.violations[0].render_trace()
+
+
+def test_prune_without_liveness_recheck_yields_counterexample():
+    bad = '''
+class SegmentRebalancer:
+    def repair_table(self, table, budget=None):
+        plan = self.compute_repair(table)
+        crash_points.hit("rebalance.move_staged")
+
+        def add_new(segments):
+            segments.setdefault("s", {})
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, add_new)
+        crash_points.hit("rebalance.pre_commit")
+
+        def drop_dead(segments):
+            segments.pop("x", None)
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, drop_dead)
+'''
+    result = protocol.check_protocols(
+        sources={protocol.REBALANCE_PATH: bad}, only=["rebalance"])
+    (report,) = result.reports
+    assert [v.invariant for v in report.violations] == \
+        ["no-replica-regression"]
+    assert "server_reincarnates" in report.violations[0].render_trace()
+
+
+def test_membership_only_guard_yields_stall_counterexample():
+    """The PR 9 bug class: owners parked OFFLINE by a crash at
+    takeover.pre_resume stall forever behind a membership-only guard."""
+    bad = '''
+def _ensure_partition_consuming(self, table, config, stream, mp, p):
+    ideal = self.coordinator.ideal_state(table)
+    live = set(self.coordinator.live_instances())
+    states = ideal.get(latest.name, {})
+    assigned = set(states)
+    if any(inst in live for inst in assigned):
+        return
+
+    def offline(segments):
+        segments[latest.name] = {i: OFFLINE for i in sorted(assigned)}
+        return segments
+
+    self.coordinator.update_ideal_state(table, offline)
+    crash_points.hit("takeover.pre_resume")
+
+    def reassign(segments):
+        segments[latest.name] = {inst: CONSUMING for inst in chosen}
+        return segments
+
+    self.coordinator.update_ideal_state(table, reassign)
+'''
+    result = protocol.check_protocols(
+        sources={protocol.TAKEOVER_PATH: bad}, only=["takeover"])
+    (report,) = result.reports
+    assert "no-takeover-stall" in [v.invariant
+                                   for v in report.violations]
+
+
+def test_merge_reassign_yields_double_owned_counterexample():
+    bad = '''
+def _ensure_partition_consuming(self, table, config, stream, mp, p):
+    ideal = self.coordinator.ideal_state(table)
+    live = set(self.coordinator.live_instances())
+    states = ideal.get(latest.name, {})
+    if any(st == CONSUMING and inst in live
+           for inst, st in states.items()):
+        return
+    crash_points.hit("takeover.pre_resume")
+
+    def reassign(segments):
+        entry = dict(segments.get(latest.name, {}))
+        for inst in chosen:
+            entry.setdefault(inst, CONSUMING)
+        segments.update({latest.name: entry})
+        return segments
+
+    self.coordinator.update_ideal_state(table, reassign)
+'''
+    result = protocol.check_protocols(
+        sources={protocol.TAKEOVER_PATH: bad}, only=["takeover"])
+    (report,) = result.reports
+    assert "no-double-owned" in [v.invariant for v in report.violations]
+
+
+def test_drain_stop_before_view_clear_yields_counterexample():
+    bad = '''
+class DistributedServer:
+    def drain(self, seal_timeout_s=20.0, settle_s=10.0):
+        sealed = self.participant.seal_consuming(seal_timeout_s)
+        self.agent.stop()
+        self.server.stop()
+        while not view_clear():
+            pass
+        while self.server.admission.depth() > 0:
+            pass
+        return sealed
+'''
+    result = protocol.check_protocols(
+        sources={protocol.DRAIN_PATH: bad}, only=["drain"])
+    (report,) = result.reports
+    assert [v.invariant for v in report.violations] == \
+        ["drain-errorless"]
+    assert "query_routed_by_ev" in report.violations[0].render_trace()
+
+
+def test_model_checker_determinism():
+    """Same state counts AND byte-identical counterexample traces
+    across two runs — required for a CI gate."""
+    def run():
+        res = protocol.check_protocols(
+            sources={protocol.LEASE_PATH: _BAD_LEASE})
+        return ([(r.system, r.states) for r in res.reports],
+                json.dumps([[v.system, v.invariant, v.message, v.trace]
+                            for r in res.reports
+                            for v in r.violations]))
+    a, b = run(), run()
+    assert a == b
+
+
+def test_truncation_is_loud_never_silent():
+    ex = protocol.extract_lease()
+    report = protocol.explore(protocol.build_lease_system(ex),
+                              max_states=10)
+    assert report.truncated
+    assert report.states <= 10
+
+
+def test_extraction_contract_violation_is_a_problem():
+    """An anchor rename must fail the gate loudly, not extract garbage."""
+    with pytest.raises(protocol.ExtractionError):
+        protocol.extract_lease({protocol.LEASE_PATH: "x = 1\n"})
+
+
+# ---------------------------------------------------------------------------
+# protocol-model.json
+# ---------------------------------------------------------------------------
+
+
+def test_committed_protocol_model_matches_live_tree():
+    assert protocol.check_protocol_model() == []
+
+
+def test_protocol_model_drift_is_field_level(tmp_path):
+    model = protocol.protocol_model()
+    model["systems"]["upsert-seal"]["steps"].remove("truncate_journal")
+    path = os.path.join(str(tmp_path), "protocol-model.json")
+    with open(path, "w") as fh:
+        json.dump(model, fh)
+    diffs = protocol.check_protocol_model(path)
+    assert any("truncate_journal" in d for d in diffs), diffs
+
+
+def test_protocol_model_write_is_deterministic(tmp_path):
+    p1 = os.path.join(str(tmp_path), "a.json")
+    p2 = os.path.join(str(tmp_path), "b.json")
+    protocol.write_protocol_model(p1)
+    protocol.write_protocol_model(p2)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_roundtrip_preserves_every_field(tmp_path):
+    findings = [
+        Finding("pinot_tpu/a.py", 10, "durability-order", "msg one"),
+        Finding("pinot_tpu/a.py", 11, "durability-order", "msg one"),
+        Finding("pinot_tpu/b.py", 5, "metrics-contract", "msg two"),
+    ]
+    suppressed = [
+        Finding("pinot_tpu/c.py", 7, "lock-blocking", "msg three"),
+    ]
+    # one occurrence of "msg one" is grandfathered, the second is new
+    baseline = {findings[0].key(): 1}
+    path = os.path.join(str(tmp_path), "out.sarif")
+    sarif.write_sarif(path, findings, suppressed, baseline)
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["version"] == "2.1.0"
+    flat = sarif.parse_sarif(doc)
+    assert len(flat) == 4
+    by_key = {(r["path"], r["line"]): r for r in flat}
+    a10 = by_key[("pinot_tpu/a.py", 10)]
+    a11 = by_key[("pinot_tpu/a.py", 11)]
+    b5 = by_key[("pinot_tpu/b.py", 5)]
+    c7 = by_key[("pinot_tpu/c.py", 7)]
+    assert a10["baselineState"] == "unchanged"
+    assert a11["baselineState"] == "new"
+    assert b5["baselineState"] == "new"
+    assert (a10["rule"], a10["message"]) == ("durability-order",
+                                             "msg one")
+    assert c7["suppressed"] and c7["rule"] == "lock-blocking"
+    assert not a10["suppressed"] and not a11["suppressed"]
+    # rule metadata travels for CI annotation rendering
+    rules = {r["id"] for r in
+             doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"durability-order", "metrics-contract",
+            "protocol-invariants", "crash-coverage"} <= rules
+
+
+def test_sarif_cli_flag(tmp_path):
+    from pinot_tpu.analysis.__main__ import main
+    out = os.path.join(str(tmp_path), "cli.sarif")
+    rc = main(["pinot_tpu/analysis/sarif.py", "--sarif", out])
+    assert rc == 0
+    with open(out) as fh:
+        doc = json.load(fh)
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "tpulint"
+
+
+def test_sarif_written_alongside_write_baseline(tmp_path):
+    """--write-baseline must not silently swallow --sarif (the CI
+    annotation step reads the file either way)."""
+    from pinot_tpu.analysis.__main__ import main
+    out = os.path.join(str(tmp_path), "wb.sarif")
+    bl = os.path.join(str(tmp_path), "baseline.json")
+    rc = main(["pinot_tpu/analysis/sarif.py", "--write-baseline",
+               "--baseline", bl, "--sarif", out])
+    assert rc == 0
+    assert os.path.exists(out) and os.path.exists(bl)
+
+
+def test_rule_filter_implies_protocol_tier():
+    """`--rule durability-order` without --protocol must still run the
+    rule (same contract as the deep tier)."""
+    from pinot_tpu.analysis.__main__ import main
+    assert main(["pinot_tpu/analysis/sarif.py", "--rule",
+                 "durability-order"]) == 0

@@ -161,6 +161,81 @@ def test_crash_mid_wal_append_writes_torn_record(work_dir):
     r2.close()
 
 
+def test_crash_before_snapshot_rename_recovers_from_wal(work_dir):
+    """Die with the compacted snapshot staged but not renamed: the WAL
+    is untruncated, so recovery ignores the .tmp and replays the full
+    journal over the previous snapshot — nothing is lost."""
+    s = PropertyStore(data_dir=work_dir)
+    for i in range(4):
+        s.set(f"/SEGMENTS/t/s{i}", {"i": i})
+    crash_points.arm("store.snapshot_rename")
+    with pytest.raises(InjectedCrash):
+        s.snapshot()
+    # process "died": the staged .tmp exists, no snapshot landed
+    assert any(n.endswith(".tmp") for n in os.listdir(work_dir))
+    r = PropertyStore(data_dir=work_dir)
+    for i in range(4):
+        assert r.get(f"/SEGMENTS/t/s{i}") == {"i": i}
+    # and a clean snapshot afterwards still works end to end
+    r.snapshot()
+    r.set("/SEGMENTS/t/s9", {"i": 9})
+    r.close()
+    r2 = PropertyStore(data_dir=work_dir)
+    assert r2.get("/SEGMENTS/t/s3") == {"i": 3}
+    assert r2.get("/SEGMENTS/t/s9") == {"i": 9}
+    r2.close()
+
+
+def test_crash_during_recovery_truncate_converges_on_second_restart(
+        work_dir):
+    """The double-crash window: die DURING recovery's torn-tail repair
+    truncate — a second recovery over the same files still converges
+    (truncation only ever drops already-rejected torn bytes)."""
+    s = PropertyStore(data_dir=work_dir)
+    for i in range(3):
+        s.set(f"/SEGMENTS/t/s{i}", {"i": i})
+    s.close()
+    with open(os.path.join(work_dir, WAL_FILE), "a") as f:
+        f.write('{"seq": 4, "op": "set", "path": "/SEGMENTS/t/s3", "re')
+    crash_points.arm("store.recover_truncate")
+    with pytest.raises(InjectedCrash):
+        PropertyStore(data_dir=work_dir)
+    r = PropertyStore(data_dir=work_dir)
+    assert r.get("/SEGMENTS/t/s2") == {"i": 2}
+    assert r.get("/SEGMENTS/t/s3") is None
+    r.set("/SEGMENTS/t/s4", {"i": 4})
+    r.close()
+    r2 = PropertyStore(data_dir=work_dir)
+    assert r2.get("/SEGMENTS/t/s4") == {"i": 4}
+    r2.close()
+
+
+def test_crash_mid_crc_stamp_preserves_metadata(work_dir):
+    """stamp_crc stages + renames: dying between the two leaves the old
+    metadata.json intact (the in-place rewrite it replaced destroyed
+    it), and a re-run stamps cleanly."""
+    from pinot_tpu.segment.integrity import (compute_crc, stamp_crc,
+                                             verify_segment)
+    seg_dir = os.path.join(work_dir, "seg")
+    os.makedirs(seg_dir)
+    build_segment(seg_dir, n=500)
+    meta_path = os.path.join(seg_dir, "metadata.json")
+    with open(meta_path) as f:
+        before = f.read()
+    crash_points.arm("integrity.stamp_rename")
+    with pytest.raises(InjectedCrash):
+        stamp_crc(seg_dir)
+    # old metadata survived the crash, byte for byte
+    with open(meta_path) as f:
+        assert f.read() == before
+    # "restart": the leftover .tmp does NOT poison the checksum (it is
+    # a staging artifact, excluded like metadata.json itself), so the
+    # re-stamp succeeds and the artifact verifies as-is
+    assert os.path.exists(meta_path + ".tmp")
+    crc = stamp_crc(seg_dir)
+    assert verify_segment(seg_dir) == crc == compute_crc(seg_dir)
+
+
 def test_store_server_restart_excludes_ephemerals(work_dir):
     """Networked shape: ephemerals written over the wire are absent
     after the server process restarts over the same data dir."""

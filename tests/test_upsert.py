@@ -571,11 +571,14 @@ def test_upsert_end_to_end_latest_row_wins(work_dir):
 
 
 @pytest.mark.parametrize("crash_point", ["upsert.seal",
-                                         "upsert.keymap_snapshot"])
+                                         "upsert.keymap_snapshot",
+                                         "upsert.journal_append"])
 def test_kill_during_seal_restart_converges(work_dir, crash_point):
-    """kill -9 at the seal / mid-snapshot-write instant: the restarted
-    server rebuilds the key map from snapshots + journal + stream tail
-    and converges to exact counts and latest values."""
+    """kill -9 at the seal / mid-snapshot-write / pre-journal-append
+    instant: the restarted server rebuilds the key map from snapshots +
+    journal + stream tail and converges to exact counts and latest
+    values (a batch that died before its journal append was never
+    offset-acked, so it is simply re-consumed)."""
     topic = f"topic_{crash_point.split('.')[-1]}"
     stream = _register(topic)
     cluster = EmbeddedCluster(work_dir, num_servers=1,
