@@ -38,6 +38,15 @@ walking the statements in source order:
   rename/truncate in code produces a counterexample, not a parse error.
 - ``drain``     — `DistributedServer.drain`: seal -> deregister ->
   await-external-view-clear -> await-admission-drain -> stop.
+- ``compact-swap`` — `SegmentSwapManager.swap_segments` (+ the fold
+  order inside `_swap_ideal_state`, spliced in place of the
+  swap-serving call): stage copy, staged verify, the
+  `compact.staged`/`compact.pre_swap`/`compact.pre_delete` crash
+  points, intent write, same-name trash slide, atomic publish, record
+  write, the drop-olds / add-new ideal-state folds, delayed-delete
+  tombstoning, and the intent clear — in source order, so reordering
+  the folds (serve-both window) or the tombstone (delete-before-swap)
+  produces a counterexample, not a parse error.
 
 Step SEMANTICS are bound here by step name; step ORDER and the
 discipline flags come from the source. A protocol edit that preserves
@@ -191,6 +200,7 @@ REBALANCE_PATH = "pinot_tpu/controller/rebalance.py"
 TAKEOVER_PATH = "pinot_tpu/controller/realtime_manager.py"
 SEAL_PATH = "pinot_tpu/realtime/upsert.py"
 DRAIN_PATH = "pinot_tpu/tools/distributed.py"
+COMPACT_PATH = "pinot_tpu/controller/compaction.py"
 
 
 def extract_lease(sources: Optional[Dict[str, str]] = None) -> Extraction:
@@ -400,11 +410,97 @@ def extract_drain(sources: Optional[Dict[str, str]] = None) -> Extraction:
     return ex
 
 
+def extract_compact(sources: Optional[Dict[str, str]] = None
+                    ) -> Extraction:
+    src = _load(COMPACT_PATH, sources)
+    tree = ast.parse(src)
+    fn = _find_def(tree, "SegmentSwapManager.swap_segments")
+    outer = _extract_steps(fn, [
+        ("stage_copy", lambda n: _is_call_containing(
+            n, ".copy(", "stage")),
+        ("verify_staged", lambda n: _is_call_containing(
+            n, "verify_segment(stage")),
+        ("crash:compact.staged",
+         lambda n: _is_crash_hit(n, "compact.staged")),
+        ("intent_write", lambda n: _is_call_containing(
+            n, ".set(", "intent_path")),
+        ("trash_old", lambda n: _is_call_containing(
+            n, ".move(", "trash_path(canonical")),
+        ("publish_new", lambda n: _is_call_containing(
+            n, ".move(stage")),
+        ("record_write", lambda n: _is_call_containing(
+            n, "._write_record(")),
+        ("crash:compact.pre_swap",
+         lambda n: _is_crash_hit(n, "compact.pre_swap")),
+        ("swap_serving", lambda n: _is_call_containing(
+            n, "._swap_ideal_state(")),
+        ("crash:compact.pre_delete",
+         lambda n: _is_crash_hit(n, "compact.pre_delete")),
+        ("tombstone_olds", lambda n: _is_call_containing(
+            n, "._tombstone_olds(")),
+        ("clear_intent", lambda n: _is_call_containing(
+            n, ".remove(", "intent_path")),
+    ])
+    swapfn = _find_def(tree, "SegmentSwapManager._swap_ideal_state")
+    inner = _inner_defs(swapfn)
+    drop_fns = sorted(n for n, d in inner.items() if "DROPPED" in _u(d))
+    prune_fns = sorted(n for n, d in inner.items() if ".pop(" in _u(d))
+    add_fns = sorted(n for n, d in inner.items()
+                     if "ONLINE" in _u(d) and n not in drop_fns)
+    sub = _extract_steps(swapfn, [
+        ("reload_inplace", lambda n: _is_call_containing(
+            n, ".reload_segment(")),
+        ("drop_olds_fold", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(f in _u(n) for f in drop_fns)),
+        ("prune_olds_fold", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(f in _u(n) for f in prune_fns)),
+        ("add_new_fold", lambda n: _is_call_containing(
+            n, "update_ideal_state") and
+            any(f in _u(n) for f in add_fns)),
+    ])
+    # the serving swap expands into its fold order IN PLACE — the model
+    # executes the spliced program, so a fold reorder in the source
+    # (serve-both window) shows up as a counterexample trace
+    steps: List[Tuple[str, int]] = []
+    for name, ln in outer:
+        if name == "swap_serving":
+            steps.extend(sub)
+        else:
+            steps.append((name, ln))
+    ex = Extraction("compact-swap", COMPACT_PATH,
+                    "SegmentSwapManager.swap_segments", steps,
+                    flags={}, problems=[])
+    order = ex.step_order()
+    ex.flags["intent_logged"] = ("intent_write" in order and
+                                 "clear_intent" in order)
+    ex.flags["staged_verify"] = "verify_staged" in order
+    ex.flags["inplace_reloads"] = "reload_inplace" in order
+    ex.flags["delayed_delete"] = "tombstone_olds" in order
+    for required in ("stage_copy", "intent_write", "publish_new",
+                     "record_write", "drop_olds_fold", "add_new_fold",
+                     "clear_intent"):
+        if required not in order:
+            ex.problems.append(
+                f"{COMPACT_PATH}::swap_segments: step `{required}` not "
+                "found — shape contract broken (see docs/ANALYSIS.md)")
+    for cp in ("crash:compact.staged", "crash:compact.pre_swap",
+               "crash:compact.pre_delete"):
+        if cp not in order:
+            ex.problems.append(
+                f"{COMPACT_PATH}::swap_segments: crash point "
+                f"`{cp.split(':', 1)[1]}` removed — the kill-restart "
+                "tests can no longer split the swap")
+    _require_order(ex, "stage_copy", "publish_new")
+    return ex
+
+
 def extract_all(sources: Optional[Dict[str, str]] = None
                 ) -> List[Extraction]:
     return [extract_lease(sources), extract_rebalance(sources),
             extract_takeover(sources), extract_seal(sources),
-            extract_drain(sources)]
+            extract_drain(sources), extract_compact(sources)]
 
 
 # ---------------------------------------------------------------------------
@@ -1038,12 +1134,189 @@ def build_drain_system(ex: Extraction) -> System:
                   init, actions, [("drain-errorless", inv_errorless)])
 
 
+# -- compaction / merge swap --------------------------------------------------
+#
+# The merge shape (distinct old/new names) is modeled — it is the
+# general case where serve-both (doubled rows) and routed-without-
+# artifact are reachable; the same-name in-place shape is structurally
+# immune to doubles (one name routes once). Durable facts:
+#   staged      the verified rewrite sits in .staging.swap
+#   olds_art    old artifacts exist in the deep store (0 = tombstoned)
+#   olds_routed olds in the ideal state / routing view
+#   new_art     rewrite published under its canonical name
+#   new_record  new segment record written
+#   new_routed  new segment in the ideal state / routing view
+#   intent      durable /SWAPS intent record open
+# Actors: the swap DRIVER (runs the extracted program; may crash at
+# every step) and the JANITOR (SwapJanitor/requeued task, running the
+# resume discipline concurrently — its step semantics are bound here,
+# its opportunity set is every interleaving). Environment: a query
+# routed by the view (latches `dbl` when both generations are routed),
+# and the scrubber sweeping ORPHANED staging (only when no intent
+# covers it — the coordination the scrubber satellite implements).
+# Invariants: no-double-serve (a query must never count a row from an
+# old AND the merged copy), routed-implies-artifact (a routed segment
+# must be loadable — a replica restart mid-swap must be able to
+# reload it; the delete-before-swap seeded bug), and no-swap-loss
+# (once quiescent — driver dead/done, intent cleared — exactly one of
+# old/new is fully servable: never neither).
+
+
+def build_compact_system(ex: Extraction) -> System:
+    program = [s for s in ex.step_order()
+               if not s.startswith("crash:") and s not in
+               ("verify_staged", "reload_inplace", "prune_olds_fold")]
+
+    # state: (pc, staged, olds_art, olds_routed, new_art, new_record,
+    #         new_routed, intent, dbl)
+    init = (0, 0, 1, 1, 0, 0, 0, 0, 0)
+    END = len(program)
+
+    def step(idx, name):
+        def enabled(s):
+            return s[0] == idx
+
+        def apply(s):
+            (pc, staged, olds_art, olds_routed, new_art, new_record,
+             new_routed, intent, dbl) = s
+            if name == "stage_copy":
+                staged = 1
+            elif name == "intent_write":
+                intent = 1
+            elif name == "trash_old":
+                pass                  # merge shape: fresh canonical name
+            elif name == "publish_new":
+                if not staged:
+                    # the staged copy vanished (scrubber raced an
+                    # intent-less window): fs.move raises, the driver
+                    # ABORTS with the intent open — recovery rolls back
+                    return (END,) + s[1:]
+                new_art, staged = 1, 0
+            elif name == "record_write":
+                new_record = 1
+            elif name == "drop_olds_fold":
+                olds_routed = 0
+            elif name == "add_new_fold":
+                new_routed = 1
+            elif name == "tombstone_olds":
+                olds_art = 0
+            elif name == "clear_intent":
+                intent = 0
+            return (pc + 1, staged, olds_art, olds_routed, new_art,
+                    new_record, new_routed, intent, dbl)
+        return Action(f"drv.{name}", enabled, apply)
+
+    def crash(s):
+        # kill -9 of the swap driver: in-memory state dies, durable
+        # facts persist; the janitor (or a re-queued task) owns
+        # recovery from here
+        return (END,) + s[1:]
+
+    def jan(name, enabled_fn, apply_fn):
+        def apply(s):
+            out = apply_fn(dict(pc=s[0], staged=s[1], olds_art=s[2],
+                                olds_routed=s[3], new_art=s[4],
+                                new_record=s[5], new_routed=s[6],
+                                intent=s[7], dbl=s[8]))
+            return (s[0], out["staged"], out["olds_art"],
+                    out["olds_routed"], out["new_art"],
+                    out["new_record"], out["new_routed"], out["intent"],
+                    out["dbl"])
+
+        def enabled(s):
+            return bool(s[7]) and enabled_fn(dict(
+                staged=s[1], olds_art=s[2], olds_routed=s[3],
+                new_art=s[4], new_record=s[5], new_routed=s[6]))
+        return Action(f"jan.{name}", enabled, apply)
+
+    def upd(d, **kw):
+        d = dict(d)
+        d.update(kw)
+        return d
+
+    actions = [step(i, n) for i, n in enumerate(program)]
+    actions.append(Action("drv.crash", lambda s: s[0] < END, crash))
+    actions += [
+        jan("publish", lambda f: f["staged"] and not f["new_art"],
+            lambda f: upd(f, new_art=1, staged=0)),
+        jan("record", lambda f: f["new_art"] and not f["new_record"],
+            lambda f: upd(f, new_record=1)),
+        jan("drop_olds", lambda f: f["new_art"] and f["new_record"]
+            and f["olds_routed"],
+            lambda f: upd(f, olds_routed=0)),
+        jan("add_new", lambda f: f["new_art"] and f["new_record"]
+            and not f["olds_routed"] and not f["new_routed"],
+            lambda f: upd(f, new_routed=1)),
+        jan("tombstone", lambda f: f["new_routed"] and f["olds_art"],
+            lambda f: upd(f, olds_art=0)),
+        jan("clear", lambda f: f["new_routed"] and f["new_art"]
+            and f["new_record"],
+            lambda f: upd(f, intent=0)),
+        # rollback: nothing durable to roll forward — the old world is
+        # intact, the intent clears, the requeued task rebuilds
+        jan("rollback", lambda f: not f["staged"] and not f["new_art"],
+            lambda f: upd(f, intent=0)),
+    ]
+
+    def query(s):
+        return s[:8] + (1,)
+
+    actions.append(Action(
+        "env.query_routed_by_view",
+        lambda s: bool(s[3]) and bool(s[6]) and not s[8], query))
+
+    def sweep(s):
+        return (s[0], 0) + s[2:]
+
+    # the scrubber reclaims ORPHANED staging only — an open intent
+    # protects its staging (the recovery publishes from it)
+    actions.append(Action("env.scrubber_sweeps_staging",
+                          lambda s: bool(s[1]) and not s[7], sweep))
+
+    def inv_double(s):
+        if s[8]:
+            return ("a query counted rows from an old segment AND its "
+                    "merged/compacted replacement (both routed "
+                    "simultaneously) — the swap must break olds before "
+                    "making the new segment visible")
+        return None
+
+    def inv_loadable(s):
+        if s[3] and not s[2]:
+            return ("old segments are still routed but their artifacts "
+                    "were already tombstoned (delete-before-swap) — a "
+                    "replica restart mid-swap cannot reload what it "
+                    "serves")
+        if s[6] and not (s[4] and s[5]):
+            return ("the new segment is routed but its artifact/record "
+                    "is not durably published — replicas cannot load "
+                    "it")
+        return None
+
+    def inv_loss(s):
+        quiescent = s[0] >= END and not s[7]
+        servable_old = s[2] and s[3]
+        servable_new = s[4] and s[5] and s[6]
+        if quiescent and not (servable_old or servable_new):
+            return ("swap finished (or died) with the intent cleared "
+                    "and NEITHER the old nor the new segment fully "
+                    "servable — rows are lost")
+        return None
+
+    return System("compact-swap", ex.path, ex.line_of("stage_copy"),
+                  init, actions,
+                  [("no-double-serve", inv_double),
+                   ("routed-implies-artifact", inv_loadable),
+                   ("no-swap-loss", inv_loss)])
+
+
 _BUILDERS = {
     "lease": build_lease_system,
     "rebalance": build_rebalance_system,
     "takeover": build_takeover_system,
     "upsert-seal": build_seal_system,
     "drain": build_drain_system,
+    "compact-swap": build_compact_system,
 }
 
 

@@ -1,12 +1,12 @@
 """protocol-invariants / protocol-model: the crash-interleaving gates.
 
-`protocol-invariants` extracts the five protocol transition systems
+`protocol-invariants` extracts the six protocol transition systems
 (lease/epoch fencing, rebalance add-then-prune, realtime takeover,
-upsert seal/snapshot/truncate, graceful drain — see
-analysis/protocol.py) from the LIVE source and exhaustively explores
-every interleaving of their steps, environment events, and
-crash-at-every-step placements, machine-checking the written
-ROBUSTNESS.md invariants:
+upsert seal/snapshot/truncate, graceful drain, compaction/merge
+segment swap — see analysis/protocol.py) from the LIVE source and
+exhaustively explores every interleaving of their steps, environment
+events, and crash-at-every-step placements, machine-checking the
+written ROBUSTNESS.md invariants:
 
 1. no double-owned partition      (takeover: `no-double-owned`,
                                    plus `no-takeover-stall`)
@@ -14,6 +14,9 @@ ROBUSTNESS.md invariants:
 3. fenced writes                  (lease: `fenced-writes`)
 4. drain is errorless             (drain: `drain-errorless`)
    + upsert durability prefix     (upsert-seal: `no-acked-delta-loss`)
+5. swap serves exactly-one        (compact-swap: `no-double-serve`,
+                                   `routed-implies-artifact`,
+                                   `no-swap-loss`)
 
 A violated invariant is reported WITH its counterexample trace (the
 ordered step list that reaches the bad state). Per the no-silent-caps

@@ -295,6 +295,11 @@ class ServerMeter:
     # server-side CRC-exact result cache
     RESULT_CACHE_HITS = "resultCacheHits"
     RESULT_CACHE_MISSES = "resultCacheMisses"
+    # upsert maintenance: committed segments whose compacted rewrite was
+    # remapped into the key map at swap, and key-map entries dropped
+    # when a retention-deleted segment's keys were garbage-collected
+    UPSERT_SEGMENTS_REMAPPED = "upsertSegmentsRemapped"
+    UPSERT_KEYS_GCED = "upsertKeysGced"
 
 
 class ControllerMeter:
@@ -309,6 +314,24 @@ class ControllerMeter:
     REBALANCE_MOVES = "rebalanceMoves"
     PARTITION_TAKEOVERS = "partitionTakeovers"
     LEADER_FAILOVERS = "leaderFailovers"
+    # maintenance plane (SegmentSwapManager / RetentionManager /
+    # SwapJanitor): crash-safe segment rewrites swapped in, expired
+    # segments tombstoned by retention, interrupted swaps the janitor
+    # resumed from their durable intent records, and delayed-delete
+    # tombstones finally reclaimed after the grace window
+    SEGMENTS_COMPACTED = "segmentsCompacted"
+    SEGMENTS_MERGED = "segmentsMerged"
+    RETENTION_SEGMENTS_DELETED = "retentionSegmentsDeleted"
+    SWAPS_RESUMED = "swapsResumed"
+    TOMBSTONES_DELETED = "tombstonesDeleted"
+
+
+class MinionMeter:
+    # task-queue hygiene: IN_PROGRESS claims whose lease expired (the
+    # claiming minion died mid-task) requeued to GENERATED, and claims
+    # that exhausted their attempt budget and went ERROR
+    TASK_REQUEUES = "taskRequeues"
+    TASK_ATTEMPTS_EXHAUSTED = "taskAttemptsExhausted"
 
 
 class ControllerGauge:

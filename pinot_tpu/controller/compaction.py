@@ -1,0 +1,441 @@
+"""Crash-safe segment swap protocol: compaction, merge, delayed delete.
+
+The minion plane rewrites sealed segments in the background (upsert
+compaction drops validDocIds-dead rows; merge/rollup folds many small
+segments into one packed artifact). The REWRITE is cheap to redo; the
+SWAP — replacing served state with the rewrite — is the part that must
+survive kill -9 at any instruction. This module is that swap, one
+staged-commit discipline shared by both task shapes (parity: the
+reference's segment replacement protocol around
+SegmentReplacementUtils / the upsert-compaction refresh push):
+
+    stage copy -> CRC verify -> durable INTENT record -> atomic
+    artifact rename (same-name old slides to a .trash tombstone
+    first) -> segment record update -> ideal-state swap (break olds
+    before make new, so no interleaving ever serves a row twice) ->
+    delayed delete of old artifacts (.trash tombstones reclaimed by
+    the scrubber after a grace window) -> intent cleared
+
+Crash points split every phase boundary: ``compact.staged`` (artifact
+staged, nothing published), ``compact.pre_swap`` (artifact + record
+published, serving state untouched), ``compact.pre_delete`` (swap
+complete, old artifacts not yet tombstoned). The durable intent record
+makes recovery a roll-forward: ``resume_swaps`` (run by the lead-gated
+``SwapJanitor`` and by re-queued minion tasks) completes any
+interrupted swap idempotently — or, when nothing was published, rolls
+back to the intact old world. A kill -9 at ANY step therefore leaves
+either the old or the new segment fully servable after recovery, never
+both and never neither; the transition system is extracted and
+exhaustively model-checked by the tpulint protocol tier
+(analysis/protocol.py, system ``compact-swap``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+from pinot_tpu.common.cluster_state import ONLINE
+from pinot_tpu.common.faults import crash_points
+from pinot_tpu.common.metrics import ControllerMeter
+from pinot_tpu.controller.manager import SEGMENTS, ResourceManager
+from pinot_tpu.controller.periodic import PeriodicTask
+from pinot_tpu.controller.state_machine import DROPPED
+from pinot_tpu.realtime.upsert import deadness_path
+from pinot_tpu.segment.integrity import (SegmentIntegrityError,
+                                         recorded_crc, verify_segment)
+from pinot_tpu.segment.metadata import SegmentMetadata
+
+log = logging.getLogger(__name__)
+
+#: durable swap-intent records: /SWAPS/<table>/<newSegment>
+SWAPS_ROOT = "/SWAPS"
+#: suffix of the staged rewrite inside the deep store
+STAGING_SUFFIX = ".staging.swap"
+#: marker inside delayed-delete tombstone names
+TRASH_MARKER = ".trash."
+
+
+def trash_path(canonical: str, now_ms: int) -> str:
+    return f"{canonical}{TRASH_MARKER}{int(now_ms)}"
+
+
+def is_trash(name: str) -> bool:
+    return TRASH_MARKER in name
+
+
+class SegmentSwapManager:
+    """Drives the staged-commit swap of rewritten segments."""
+
+    def __init__(self, manager: ResourceManager, metrics=None,
+                 now_fn=time.time):
+        self.manager = manager
+        self.store = manager.store
+        self.metrics = metrics
+        self._now = now_fn
+
+    def _mark(self, name: str, n: int = 1) -> None:
+        if self.metrics is not None and n:
+            self.metrics.meter(name).mark(n)
+
+    def _intent_path(self, table: str, new_name: str) -> str:
+        return f"{SWAPS_ROOT}/{table}/{new_name}"
+
+    # ------------------------------------------------------------------
+    # The swap protocol (the extracted transition system — step order
+    # here IS the protocol; see docs/ANALYSIS.md extraction contract)
+    # ------------------------------------------------------------------
+
+    def swap_segments(self, table: str, olds: List[str],
+                      new_dir: str) -> str:
+        """Swap `olds` (served, recorded) for the rewritten artifact in
+        `new_dir`. Same-name (olds == [new]) is the in-place compaction
+        shape — the old artifact slides to a trash tombstone and the
+        replicas bounce through a staggered reload; distinct names are
+        the merge shape — olds leave the ideal state BEFORE the new
+        segment enters it (break-before-make: the gap is a flagged
+        partial, never a silently doubled row). Returns the new
+        segment's name."""
+        meta = SegmentMetadata.load(new_dir)
+        new_name = meta.segment_name
+        inplace = list(olds) == [new_name]
+        for old in olds:
+            if self.manager.segment_metadata(table, old) is None:
+                raise ValueError(f"swap input {table}/{old} has no "
+                                 "segment record")
+        if not inplace and self.manager.segment_metadata(
+                table, new_name) is not None and \
+                self.store.get(self._intent_path(table, new_name)) is None:
+            raise ValueError(f"swap output {table}/{new_name} already "
+                             "exists")
+        verify_segment(new_dir, meta.crc)
+        canonical = self.manager.canonical_artifact_path(table, new_name)
+        stage = canonical + STAGING_SUFFIX
+        os.makedirs(os.path.dirname(canonical), exist_ok=True)
+        self.manager.fs.delete(stage)
+        self.manager.fs.copy(new_dir, stage)
+        # verify the STAGED bytes: a torn copy must never roll forward
+        verify_segment(stage, meta.crc)
+        # seeded crash point: rewrite staged and verified, nothing
+        # published — recovery abandons the intent-less staging (swept
+        # by the scrubber after grace) and the requeued task re-runs
+        crash_points.hit("compact.staged")
+        intent_path = self._intent_path(table, new_name)
+        self.store.set(intent_path, {
+            "table": table, "new": new_name, "olds": list(olds),
+            "newCrc": meta.crc,
+            "oldCrc": (self.manager.segment_metadata(table, new_name)
+                       or {}).get("crc") if inplace else None,
+            "inplace": inplace,
+            "startedMs": int(self._now() * 1e3)})
+        # publish the artifact: the same-name old copy slides to a
+        # delayed-delete tombstone FIRST (it must stay recoverable
+        # until the swap is durable), then the staged rewrite lands
+        # under the canonical name atomically. Both moves are guarded
+        # by the canonical artifact's recorded crc so a concurrent
+        # resumer that already published (a janitor racing a stalled
+        # driver) is detected instead of having its work trashed; the
+        # janitor additionally ignores young intents (min_intent_age),
+        # so a LIVE driver is never raced in practice
+        if os.path.isdir(canonical) and \
+                recorded_crc(canonical) != meta.crc:
+            self.manager.fs.move(canonical,
+                                 trash_path(canonical,
+                                            self._now() * 1e3))
+        if not (os.path.isdir(canonical) and
+                recorded_crc(canonical) == meta.crc):
+            self.manager.fs.move(stage, canonical)
+        self._write_record(table, meta, olds, inplace)
+        # seeded crash point: artifact + record published, serving
+        # state untouched — queries still see exactly the old world;
+        # recovery rolls the swap forward from the intent record
+        crash_points.hit("compact.pre_swap")
+        self._swap_ideal_state(table, olds, new_name, inplace)
+        # seeded crash point: the swap is serving the new artifact but
+        # the old ones are not yet tombstoned — recovery only has
+        # cleanup left; nothing user-visible changes
+        crash_points.hit("compact.pre_delete")
+        self._tombstone_olds(table, olds, new_name)
+        self._clear_deadness(table, olds)
+        self.store.remove(intent_path)
+        self._mark(ControllerMeter.SEGMENTS_COMPACTED if inplace
+                   else ControllerMeter.SEGMENTS_MERGED)
+        log.info("swap: %s/%s now serves the rewritten artifact "
+                 "(replaced %s)", table, new_name, olds)
+        return new_name
+
+    def _write_record(self, table: str, meta: SegmentMetadata,
+                      olds: List[str], inplace: bool) -> None:
+        """Publish the new segment's durable record. In-place keeps the
+        LLC fields (status/offsets) and folds in the rewrite's crc and
+        sizes; merge writes a fresh record."""
+        name = meta.segment_name
+        canonical = self.manager.canonical_artifact_path(table, name)
+        size = _dir_size(canonical)
+        partition_meta = {
+            cname: {"functionName": cm.partition_function,
+                    "numPartitions": cm.num_partitions,
+                    "partitions": list(cm.partitions)}
+            for cname, cm in meta.columns.items()
+            if cm.partition_function and cm.partitions}
+
+        def fold(old: Optional[dict]) -> dict:
+            rec = dict(old or {})
+            rec.update({
+                "segmentName": name,
+                "downloadPath": self.manager.advertised_download_path(
+                    table, name),
+                "startTime": meta.start_time,
+                "endTime": meta.end_time,
+                "timeUnit": meta.time_unit,
+                "totalDocs": meta.total_docs,
+                "pushTimeMs": int(self._now() * 1e3),
+                "crc": meta.crc,
+                "sizeBytes": size,
+                "partitionMetadata": partition_meta,
+                "swappedFrom": list(olds),
+            })
+            return rec
+
+        self.store.update(f"{SEGMENTS}/{table}/{name}", fold)
+
+    def _swap_ideal_state(self, table: str, olds: List[str],
+                          new_name: str, inplace: bool) -> None:
+        """Serving swap. In-place: staggered replica reload (the record
+        already names the new crc, so each bounce loads the rewrite).
+        Merge: break-before-make — olds DROPPED and pruned (their
+        records removed) BEFORE the new segment is assigned, so no
+        interleaving of per-server transitions can ever serve an old
+        and the new copy of the same row simultaneously."""
+        if inplace:
+            self.manager.reload_segment(table, new_name)
+            return
+
+        def drop_olds(segments):
+            for old in olds:
+                if old in segments:
+                    segments[old] = {inst: DROPPED
+                                     for inst in segments[old]}
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, drop_olds)
+
+        def prune_olds(segments):
+            for old in olds:
+                segments.pop(old, None)
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, prune_olds)
+        for old in olds:
+            self.store.remove(f"{SEGMENTS}/{table}/{old}")
+        config = self.manager.get_table_config(table)
+        if config is None:
+            raise ValueError(f"table {table} vanished mid-swap")
+        servers = self.manager.server_instances_for(config)
+        if not servers:
+            raise ValueError(f"no live servers for {table} mid-swap")
+        meta = self.manager.segment_metadata(table, new_name) or {}
+        pids = {p for info in (meta.get("partitionMetadata") or {}
+                               ).values()
+                for p in info.get("partitions") or ()}
+        from pinot_tpu.controller.assignment import make_assignment
+        strategy = self.manager._assignments.setdefault(
+            table, make_assignment("balanced"))
+        current = self.manager.coordinator.ideal_state(table)
+        assigned = current.get(new_name) or None
+        if not assigned:
+            assigned = strategy.assign(
+                new_name, servers,
+                config.segments_config.replication, current,
+                partition_ids=pids or None)
+
+        def add_new(segments):
+            entry = dict(segments.get(new_name, {}))
+            for inst in assigned:
+                entry.setdefault(inst, ONLINE)
+            segments[new_name] = entry
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, add_new)
+
+    def _tombstone_olds(self, table: str, olds: List[str],
+                        new_name: str) -> None:
+        """Delayed delete: old artifacts become .trash tombstones the
+        scrubber reclaims after its grace window — an operator (or a
+        mid-swap recovery) can still roll back until then."""
+        for old in olds:
+            if old == new_name:
+                continue                  # in-place: tombstoned at publish
+            path = self.manager.canonical_artifact_path(table, old)
+            if os.path.isdir(path):
+                self.manager.fs.move(path,
+                                     trash_path(path, self._now() * 1e3))
+
+    def _clear_deadness(self, table: str, olds: List[str]) -> None:
+        """The swapped-out artifacts' published deadness is stale (doc
+        ids shifted / rows gone) — drop it; servers republish the fresh
+        bitmap at their next seal."""
+        for old in olds:
+            self.store.remove(deadness_path(table, old))
+
+    # ------------------------------------------------------------------
+    # Recovery: roll interrupted swaps forward (or back) from intents
+    # ------------------------------------------------------------------
+
+    #: resume ignores intents younger than this by default: a LIVE
+    #: driver's swap completes in seconds, so the janitor never races
+    #: one mid-protocol (the publish-step crc guards make even that
+    #: race non-destructive; this gate makes it not happen). Recovery
+    #: paths that KNOW the driver is dead (a requeued task whose old
+    #: claim lease expired, tests) pass min_age_s=0.
+    DEFAULT_MIN_INTENT_AGE_S = 30.0
+
+    def resume_swaps(self, table: Optional[str] = None,
+                     min_age_s: Optional[float] = None,
+                     only: Optional[str] = None) -> List[str]:
+        """Complete every interrupted swap recorded under /SWAPS —
+        idempotent; every step re-checks durable state. Returns the
+        table/segment pairs that were touched. `only` restricts to one
+        new-segment name (a requeued task resumes ITS swap, never a
+        concurrent task's live one)."""
+        if min_age_s is None:
+            min_age_s = self.DEFAULT_MIN_INTENT_AGE_S
+        tables = [table] if table is not None else \
+            self.store.children(SWAPS_ROOT)
+        resumed = []
+        now_ms = self._now() * 1e3
+        for t in tables:
+            for name in self.store.children(f"{SWAPS_ROOT}/{t}"):
+                if only is not None and name != only:
+                    continue
+                intent = self.store.get(self._intent_path(t, name))
+                if not intent:
+                    continue
+                age_s = (now_ms - int(intent.get("startedMs", 0))) / 1e3
+                if age_s < min_age_s:
+                    continue        # plausibly a LIVE driver's swap
+                try:
+                    if self._resume_one(t, name, intent):
+                        resumed.append(f"{t}/{name}")
+                        self._mark(ControllerMeter.SWAPS_RESUMED)
+                except Exception:  # noqa: BLE001 — one stuck swap must
+                    log.exception("swap resume failed for %s/%s", t,
+                                  name)  # not block the others
+        return resumed
+
+    def _resume_one(self, table: str, new_name: str,
+                    intent: dict) -> bool:
+        olds = list(intent.get("olds") or [])
+        new_crc = intent.get("newCrc")
+        inplace = bool(intent.get("inplace"))
+        canonical = self.manager.canonical_artifact_path(table, new_name)
+        stage = canonical + STAGING_SUFFIX
+        intent_path = self._intent_path(table, new_name)
+
+        published = os.path.isdir(canonical) and \
+            recorded_crc(canonical) == new_crc
+        if not published and os.path.isdir(stage):
+            try:
+                verify_segment(stage, new_crc)
+            except SegmentIntegrityError:
+                self.manager.fs.delete(stage)   # torn staging: discard
+            else:
+                if os.path.isdir(canonical):
+                    self.manager.fs.move(
+                        canonical, trash_path(canonical,
+                                              self._now() * 1e3))
+                self.manager.fs.move(stage, canonical)
+                published = True
+        if not published:
+            # nothing durable to roll forward. In-place with the
+            # canonical artifact missing (killed between the two
+            # renames): restore the freshest tombstone matching the
+            # old crc so the old world is fully servable again.
+            if inplace and not os.path.isdir(canonical):
+                restored = self._restore_from_trash(
+                    canonical, intent.get("oldCrc"))
+                if not restored:
+                    log.error("swap resume: %s/%s has neither artifact "
+                              "nor staging nor tombstone — leaving the "
+                              "intent for the operator", table, new_name)
+                    return False
+            self.store.remove(intent_path)
+            log.warning("swap resume: rolled back un-published swap of "
+                        "%s/%s (requeued task will retry)", table,
+                        new_name)
+            return True
+
+        # roll forward: record, serving swap, delayed delete, cleanup
+        meta = SegmentMetadata.load(canonical)
+        self._write_record(table, meta, olds, inplace)
+        self._swap_ideal_state(table, olds, new_name, inplace)
+        self._tombstone_olds(table, olds, new_name)
+        self._clear_deadness(table, olds)
+        self.store.remove(intent_path)
+        # a resumed roll-forward IS a completed swap — count it like one
+        self._mark(ControllerMeter.SEGMENTS_COMPACTED if inplace
+                   else ControllerMeter.SEGMENTS_MERGED)
+        log.warning("swap resume: completed interrupted swap of %s/%s "
+                    "(replaced %s)", table, new_name, olds)
+        return True
+
+    def _restore_from_trash(self, canonical: str,
+                            old_crc: Optional[str]) -> bool:
+        parent = os.path.dirname(canonical)
+        base = os.path.basename(canonical) + TRASH_MARKER
+        if not os.path.isdir(parent):
+            return False
+        candidates = sorted((n for n in os.listdir(parent)
+                             if n.startswith(base)), reverse=True)
+        for name in candidates:
+            path = os.path.join(parent, name)
+            if old_crc is not None and recorded_crc(path) != old_crc:
+                continue
+            self.manager.fs.move(path, canonical)
+            log.warning("swap resume: restored %s from tombstone %s",
+                        canonical, name)
+            return True
+        return False
+
+    def open_intents(self, table: str) -> List[str]:
+        """Segments with an in-flight swap — the scrubber must neither
+        CRC-sweep nor orphan/tombstone-sweep them mid-protocol."""
+        return self.store.children(f"{SWAPS_ROOT}/{table}")
+
+
+def _dir_size(path: str) -> int:
+    total = 0
+    for dirpath, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+            except OSError:
+                pass
+    return total
+
+
+class SwapJanitor(PeriodicTask):
+    """Lead-gated periodic recovery driver: completes interrupted swaps
+    from their durable intent records (a controller kill -9 mid-swap
+    heals within one janitor interval, independent of minion task
+    requeue)."""
+
+    name = "SwapJanitor"
+    interval_s = 60.0
+
+    def __init__(self, swaps: Optional[SegmentSwapManager] = None,
+                 metrics=None, min_intent_age_s: Optional[float] = None):
+        """`min_intent_age_s`: override the resume age gate (tests and
+        known-dead-driver recovery pass 0)."""
+        self.swaps = swaps
+        self.metrics = metrics
+        self.min_intent_age_s = min_intent_age_s
+        self.last_resumed: List[str] = []
+
+    def run(self, manager) -> None:
+        if self.swaps is None:
+            self.swaps = SegmentSwapManager(manager,
+                                            metrics=self.metrics)
+        self.last_resumed = self.swaps.resume_swaps(
+            min_age_s=self.min_intent_age_s)

@@ -67,6 +67,13 @@ class Controller:
                                                metrics=self.metrics)
         self.rebalancer = SegmentRebalancer(self.manager,
                                             metrics=self.metrics)
+        # minion maintenance plane: swap protocol driver + task queue
+        from pinot_tpu.controller.compaction import SegmentSwapManager
+        from pinot_tpu.minion.task_manager import PinotTaskManager
+        self.swaps = SegmentSwapManager(self.manager,
+                                        metrics=self.metrics)
+        self.task_manager = PinotTaskManager(self.manager,
+                                             metrics=self.metrics)
         # always-present cluster gauges (parity: ControllerMetrics'
         # tableCount/segmentCount-style validation gauges) — /metrics is
         # never empty, even before any periodic task ran
@@ -77,18 +84,26 @@ class Controller:
         self.metrics.gauge(
             ControllerGauge.CLUSTER_REPLICATION_DEFICIT).set_callable(
                 lambda: replication_deficit(self.manager))
-        # self-healing meters exist at 0 from boot so /metrics exposition
-        # always carries them
+        # self-healing + maintenance meters exist at 0 from boot so
+        # /metrics exposition always carries them
         for name in (ControllerMeter.REBALANCE_MOVES,
                      ControllerMeter.PARTITION_TAKEOVERS,
-                     ControllerMeter.LEADER_FAILOVERS):
+                     ControllerMeter.LEADER_FAILOVERS,
+                     ControllerMeter.SEGMENTS_COMPACTED,
+                     ControllerMeter.SEGMENTS_MERGED,
+                     ControllerMeter.RETENTION_SEGMENTS_DELETED,
+                     ControllerMeter.SWAPS_RESUMED,
+                     ControllerMeter.TOMBSTONES_DELETED):
             self.metrics.meter(name)
         self.periodic = PeriodicTaskScheduler(self.manager, periodic_tasks,
                                               leadership=self.leadership,
                                               metrics=self.metrics)
         if periodic_tasks is None:
             # scheduler owns the defaults; the controller appends the
-            # tasks that need its realtime manager / rebalancer
+            # tasks that need its realtime manager / rebalancer /
+            # minion task manager / swap driver
+            from pinot_tpu.controller.compaction import SwapJanitor
+            from pinot_tpu.controller.periodic import MinionTaskScheduler
             self.health_monitor = ClusterHealthMonitor(
                 rebalancer=self.rebalancer,
                 realtime_manager=self.realtime,
@@ -96,6 +111,10 @@ class Controller:
             self.periodic.tasks.append(self.health_monitor)
             self.periodic.tasks.append(
                 RealtimeSegmentValidationManager(self.realtime))
+            self.periodic.tasks.append(
+                MinionTaskScheduler(self.task_manager))
+            self.periodic.tasks.append(
+                SwapJanitor(self.swaps, metrics=self.metrics))
             for task in self.periodic.tasks:
                 if getattr(task, "rebalancer", "missing") is None:
                     task.rebalancer = self.rebalancer

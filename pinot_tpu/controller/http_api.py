@@ -225,17 +225,20 @@ class ControllerApiServer(ApiServer):
         concurrent schedules would double-submit per segment."""
         import asyncio as _asyncio
         if not hasattr(self, "_task_manager"):
-            import threading as _threading
-            from pinot_tpu.minion.task_manager import PinotTaskManager
-            self._task_manager = PinotTaskManager(self.manager)
-            self._task_schedule_lock = _threading.Lock()
-
-        def run():
-            with self._task_schedule_lock:
-                return self._task_manager.schedule_tasks()
+            # share the controller's task manager (its queue carries
+            # the requeue meters and the per-sweep throttle; its
+            # schedule_tasks serializes internally, covering the
+            # periodic sweep AND this endpoint) — build a private one
+            # only for bare managers in tests
+            tm = getattr(self.controller, "task_manager", None)
+            if tm is None:
+                from pinot_tpu.minion.task_manager import \
+                    PinotTaskManager
+                tm = PinotTaskManager(self.manager)
+            self._task_manager = tm
 
         submitted = await _asyncio.get_running_loop().run_in_executor(
-            None, run)
+            None, self._task_manager.schedule_tasks)
         return HttpResponse.of_json({"submitted": submitted})
 
     async def _table_size(self, request: HttpRequest) -> HttpResponse:

@@ -106,6 +106,8 @@ class ResourceManager:
         table = config.table_name_with_type
         _validate_table_config(config)
         self._validate_upsert_config(config)
+        self._validate_retention_config(config)
+        self._validate_task_configs(config)
         tenant = config.tenant_config.server or DEFAULT_TENANT
         if tenant != DEFAULT_TENANT and not self.server_instances_for(
                 config):
@@ -164,6 +166,79 @@ class ResourceManager:
                     f"upsert primary key column '{col}' must be "
                     "single-value")
 
+    def _validate_retention_config(self, config: TableConfig) -> None:
+        """Reject malformed retention at create/update time instead of
+        silently never scheduling a deletion (parity: TableConfigUtils
+        retention validation; the upsert-config precedent)."""
+        from pinot_tpu.common.timeutils import UNIT_MS
+        sc = config.segments_config
+        unit, value = sc.retention_time_unit, sc.retention_time_value
+        if unit is None and value is None:
+            return
+        if unit is None or value is None:
+            raise InvalidTableConfigError(
+                "retentionTimeUnit and retentionTimeValue must be set "
+                "together (one without the other never schedules a "
+                "deletion)")
+        if str(unit).upper() not in UNIT_MS:
+            raise InvalidTableConfigError(
+                f"unrecognized retentionTimeUnit {unit!r}; supported: "
+                f"{sorted(UNIT_MS)}")
+        try:
+            ok = int(value) > 0
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise InvalidTableConfigError(
+                f"retentionTimeValue must be a positive integer, got "
+                f"{value!r}")
+
+    def _validate_task_configs(self, config: TableConfig) -> None:
+        """Reject malformed minion task configs at the API instead of
+        silently never scheduling (generators would skip or crash a
+        periodic run otherwise)."""
+
+        def _num(cfg, key, default, lo, hi, task):
+            raw = cfg.get(key, default)
+            try:
+                v = float(raw)
+            except (TypeError, ValueError):
+                raise InvalidTableConfigError(
+                    f"{task}.{key} must be a number, got {raw!r}"
+                    ) from None
+            if not lo <= v <= hi:
+                raise InvalidTableConfigError(
+                    f"{task}.{key} must be in [{lo}, {hi}], got {raw!r}")
+            return v
+
+        upsert_on = config.upsert_config is not None and \
+            config.upsert_config.enabled
+        for ttype, cfg in (config.task_configs or {}).items():
+            if ttype == "UpsertCompactionTask":
+                if not upsert_on:
+                    raise InvalidTableConfigError(
+                        "UpsertCompactionTask requires an enabled "
+                        "upsertConfig (there are no validDocIds-dead "
+                        "rows to drop otherwise)")
+                _num(cfg, "invalidDocsThresholdPercent", "20", 0.0,
+                     100.0, ttype)
+                _num(cfg, "minInvalidDocs", "1", 0, 1e12, ttype)
+            elif ttype == "MergeRollupTask":
+                if upsert_on:
+                    raise InvalidTableConfigError(
+                        "MergeRollupTask is not supported on upsert "
+                        "tables (merging reshuffles doc ids under the "
+                        "key map; use UpsertCompactionTask)")
+                _num(cfg, "smallSegmentDocsThreshold", "1", 1, 1e12,
+                     ttype)
+                _num(cfg, "maxNumSegmentsPerTask", "8", 2, 1e6, ttype)
+                merge_type = cfg.get("mergeType", "CONCATENATE")
+                if str(merge_type).upper() not in ("CONCATENATE",
+                                                   "ROLLUP"):
+                    raise InvalidTableConfigError(
+                        f"MergeRollupTask.mergeType must be CONCATENATE "
+                        f"or ROLLUP, got {merge_type!r}")
+
     # -- tenants -----------------------------------------------------------
     def server_instances_for(self, config: TableConfig) -> List[str]:
         """Live server instances the table's segments may be assigned to
@@ -207,6 +282,8 @@ class ResourceManager:
         if self.store.get(f"{TABLE_CONFIGS}/{table}") is None:
             raise ValueError(f"table {table} not found")
         _validate_table_config(config)
+        self._validate_retention_config(config)
+        self._validate_task_configs(config)
         tenant = config.tenant_config.server or DEFAULT_TENANT
         if tenant != DEFAULT_TENANT and not self.server_instances_for(
                 config):
@@ -364,11 +441,18 @@ class ResourceManager:
     def segment_metadata(self, table: str, segment: str) -> Optional[dict]:
         return self.store.get(f"{SEGMENTS}/{table}/{segment}")
 
-    def delete_segment(self, table: str, segment: str) -> None:
+    def delete_segment(self, table: str, segment: str,
+                       tombstone_artifact: bool = False) -> None:
         """Parity: SegmentDeletionManager — drop from ideal state, remove
         metadata, delete the deep-store artifact (the recorded
         downloadPath AND the canonical location, plus any stale
-        split-commit staging copies — retention must not leak bytes)."""
+        split-commit staging copies — retention must not leak bytes).
+
+        `tombstone_artifact`: delayed delete — the canonical artifact
+        slides to a ``.trash.<ms>`` tombstone the integrity scrubber
+        reclaims after its grace window (the retention path: a
+        fat-fingered retention config stays recoverable for the grace
+        period)."""
         meta = self.segment_metadata(table, segment) or {}
 
         def drop(segments):
@@ -385,8 +469,16 @@ class ResourceManager:
 
         self.coordinator.update_ideal_state(table, purge)
         self.store.remove(f"{SEGMENTS}/{table}/{segment}")
+        # published per-segment deadness dies with the segment
+        from pinot_tpu.realtime.upsert import deadness_path
+        self.store.remove(deadness_path(table, segment))
         canonical = os.path.join(self.deep_store_dir, table, segment)
-        self.fs.delete(canonical)
+        if tombstone_artifact and os.path.isdir(canonical):
+            from pinot_tpu.controller.compaction import trash_path
+            self.fs.move(canonical,
+                         trash_path(canonical, time.time() * 1e3))
+        else:
+            self.fs.delete(canonical)
         download = meta.get("downloadPath")
         if download and "://" not in download and \
                 os.path.abspath(download) != os.path.abspath(canonical):

@@ -29,15 +29,26 @@ class PeriodicTask:
 
 
 class RetentionManager(PeriodicTask):
-    """Deletes segments whose time range is past the table's retention."""
+    """Deletes segments whose time range is past the table's retention.
+
+    Deletions are DELAYED: the artifact becomes a ``.trash`` tombstone
+    the integrity scrubber reclaims after its grace window, so a
+    fat-fingered retention config stays recoverable for the grace
+    period. Consuming (not-yet-committed) segments are never touched —
+    the realtime successor chain owns them. On upsert tables the
+    record removal also triggers server-side key-map GC (the
+    `upsertKeyMapSize` flatness story: an expired segment's keys leave
+    the map with it)."""
 
     name = "RetentionManager"
     interval_s = 6 * 3600.0
 
-    def __init__(self, now_ms_fn=None):
+    def __init__(self, now_ms_fn=None, metrics=None):
         self._now_ms = now_ms_fn or (lambda: int(time.time() * 1e3))
+        self.metrics = metrics
 
     def run(self, manager: ResourceManager) -> None:
+        from pinot_tpu.common.metrics import ControllerMeter
         for table in manager.table_names():
             config = manager.get_table_config(table)
             sc = config.segments_config if config else None
@@ -47,8 +58,16 @@ class RetentionManager(PeriodicTask):
             retention_ms = sc.retention_time_value * unit_ms(
                 sc.retention_time_unit)
             cutoff_ms = self._now_ms() - retention_ms
+            latest = self._latest_llc_sequences(manager, table)
             for seg in manager.segment_names(table):
                 meta = manager.segment_metadata(table, seg) or {}
+                if meta.get("status") == "IN_PROGRESS":
+                    continue        # consuming: no artifact to expire
+                if self._is_latest_llc(seg, latest):
+                    # the newest committed sequence anchors the
+                    # partition's restart offset (successor repair
+                    # reads its endOffset) — never expire it
+                    continue
                 end, unit = meta.get("endTime"), meta.get("timeUnit")
                 if end is None:
                     continue
@@ -56,7 +75,26 @@ class RetentionManager(PeriodicTask):
                 if end_ms < cutoff_ms:
                     log.info("retention: deleting %s/%s (end %s < cutoff)",
                              table, seg, end_ms)
-                    manager.delete_segment(table, seg)
+                    manager.delete_segment(table, seg,
+                                           tombstone_artifact=True)
+                    if self.metrics is not None:
+                        self.metrics.meter(
+                            ControllerMeter.RETENTION_SEGMENTS_DELETED
+                        ).mark()
+
+    @staticmethod
+    def _latest_llc_sequences(manager: ResourceManager,
+                              table: str) -> Dict[int, int]:
+        from pinot_tpu.realtime.segment_name import latest_llc_sequences
+        return latest_llc_sequences(manager.segment_names(table))
+
+    @staticmethod
+    def _is_latest_llc(seg: str, latest: Dict[int, int]) -> bool:
+        from pinot_tpu.realtime.segment_name import LLCSegmentName
+        if not LLCSegmentName.is_llc(seg):
+            return False
+        llc = LLCSegmentName.parse(seg)
+        return latest.get(llc.partition) == llc.sequence
 
 
 class SegmentIntegrityChecker(PeriodicTask):
@@ -90,6 +128,10 @@ class SegmentIntegrityChecker(PeriodicTask):
     #: an unrecorded deep-store entry younger than this is an in-flight
     #: upload (copy lands before the record is written), not an orphan
     ORPHAN_GRACE_S = 300.0
+    #: ``.trash.<ms>`` delayed-delete tombstones (compaction swaps,
+    #: retention) are reclaimed only after this grace — until then an
+    #: interrupted swap's recovery (or an operator) can roll back
+    DELAYED_DELETE_GRACE_S = 300.0
 
     def __init__(self, metrics=None, now_fn=None, rebalancer=None):
         """`rebalancer`: the controller's SegmentRebalancer — replicas
@@ -118,7 +160,24 @@ class SegmentIntegrityChecker(PeriodicTask):
                                        self.QUARANTINE_DIR)
         for table in manager.table_names():
             entry = {"corrupt": [], "missingArtifact": [], "repaired": [],
-                     "reassigned": [], "orphansDeleted": []}
+                     "reassigned": [], "orphansDeleted": [],
+                     "tombstonesDeleted": []}
+            # segments mid compaction/merge swap (open /SWAPS intent):
+            # artifact and record are updated in separate durable steps,
+            # so a CRC sweep racing the protocol would quarantine a
+            # HEALTHY artifact against the not-yet-updated record — the
+            # swap's own recovery (SwapJanitor) owns these until the
+            # intent clears. The protection covers the intent's OLD
+            # segments too: a merge swap prunes the olds' records
+            # mid-protocol, and their artifacts/tombstones must stay
+            # recoverable until the intent resolves
+            from pinot_tpu.controller.compaction import SWAPS_ROOT
+            in_swap = set()
+            for name in manager.store.children(f"{SWAPS_ROOT}/{table}"):
+                in_swap.add(name)
+                rec = manager.store.get(
+                    f"{SWAPS_ROOT}/{table}/{name}") or {}
+                in_swap.update(rec.get("olds") or ())
             # segments no replica bounce can heal (artifact quarantined
             # this run, or already gone from an earlier one): repair
             # would churn the ideal state forever against nothing
@@ -126,6 +185,8 @@ class SegmentIntegrityChecker(PeriodicTask):
             known = set()
             for seg in manager.segment_names(table):
                 known.add(seg)
+                if seg in in_swap:
+                    continue
                 meta = manager.segment_metadata(table, seg) or {}
                 path, crc = meta.get("downloadPath"), meta.get("crc")
                 if path and "://" in path:
@@ -147,8 +208,8 @@ class SegmentIntegrityChecker(PeriodicTask):
                     log.error("integrity: quarantined corrupt deep-store "
                               "artifact %s/%s", table, seg)
             self._repair_error_replicas(manager, table, entry,
-                                        skip=unrepairable)
-            self._sweep_orphans(manager, table, known, entry)
+                                        skip=unrepairable | in_swap)
+            self._sweep_orphans(manager, table, known, entry, in_swap)
             if any(entry.values()):
                 report[table] = entry
         self.last_report = report
@@ -243,23 +304,55 @@ class SegmentIntegrityChecker(PeriodicTask):
 
     # -- orphan sweep -------------------------------------------------------
     def _sweep_orphans(self, manager: ResourceManager, table: str,
-                       known: set, entry: Dict) -> None:
+                       known: set, entry: Dict,
+                       in_swap: Optional[set] = None) -> None:
         import os
 
         from pinot_tpu.common.metrics import ControllerMeter
+        from pinot_tpu.controller.compaction import (STAGING_SUFFIX,
+                                                     TRASH_MARKER)
+        in_swap = in_swap or set()
         tdir = os.path.join(manager.deep_store_dir, table)
         if not os.path.isdir(tdir):
             return
         for name in sorted(os.listdir(tdir)):
-            if name in known:
+            if name in known or name in in_swap:
                 continue
-            if ".staging." in name:
-                continue        # in-flight split-commit staging copy
             path = os.path.join(tdir, name)
             try:
                 age = self._now() - os.path.getmtime(path)
             except OSError:
                 continue        # vanished under us
+            if TRASH_MARKER in name:
+                # delayed-delete tombstone (compaction swap, retention):
+                # reclaim only past the grace window, and never while
+                # the swap that wrote it is still in flight (its
+                # recovery may roll back to this copy)
+                base = name.split(TRASH_MARKER, 1)[0]
+                if base in in_swap or age < self.DELAYED_DELETE_GRACE_S:
+                    continue
+                manager.fs.delete(path)
+                entry["tombstonesDeleted"].append(name)
+                self._mark(ControllerMeter.TOMBSTONES_DELETED)
+                log.info("integrity: reclaimed delayed-delete tombstone "
+                         "%s/%s", table, name)
+                continue
+            if ".staging." in name:
+                # split-commit / swap staging copy: an OPEN swap intent
+                # still needs its staging (recovery publishes from it);
+                # a young one may be an in-flight commit; anything else
+                # is a crash leftover whose intent was resolved — sweep
+                base = name.split(".staging.", 1)[0]
+                if name.endswith(STAGING_SUFFIX) and base in in_swap:
+                    continue
+                if age < self.ORPHAN_GRACE_S:
+                    continue
+                manager.fs.delete(path)
+                entry["orphansDeleted"].append(name)
+                self._mark(ControllerMeter.ORPHAN_ARTIFACTS_DELETED)
+                log.info("integrity: deleted stale staging leftover "
+                         "%s/%s", table, name)
+                continue
             if age < self.ORPHAN_GRACE_S:
                 continue        # in-flight upload: copy precedes record
             manager.fs.delete(path)
@@ -313,13 +406,34 @@ class RealtimeSegmentValidationManager(PeriodicTask):
         self.realtime_manager.ensure_all_partitions_consuming()
 
 
+class MinionTaskScheduler(PeriodicTask):
+    """Lead-gated minion-plane heartbeat: requeue expired task claims
+    (a kill -9'd minion's lease running out) and run the registered
+    task generators over every table's taskConfig (parity:
+    PinotTaskManager riding the ControllerPeriodicTask cadence)."""
+
+    name = "MinionTaskScheduler"
+    interval_s = 30.0
+
+    def __init__(self, task_manager):
+        self.task_manager = task_manager
+        self.last_requeued: List[str] = []
+        self.last_scheduled: List[str] = []
+
+    def run(self, manager: ResourceManager) -> None:
+        queue = self.task_manager.queue
+        queue.prune_terminal()
+        self.last_requeued = queue.requeue_expired()
+        self.last_scheduled = self.task_manager.schedule_tasks()
+
+
 class PeriodicTaskScheduler:
     def __init__(self, manager: ResourceManager,
                  tasks: Optional[List[PeriodicTask]] = None,
                  leadership=None, metrics=None):
         self.manager = manager
         self.tasks = tasks if tasks is not None else [
-            RetentionManager(), SegmentStatusChecker(),
+            RetentionManager(metrics=metrics), SegmentStatusChecker(),
             SegmentIntegrityChecker(metrics=metrics)]
         # parity: ControllerPeriodicTask lead-controller gating — with
         # multiple controllers, only the lease holder runs the tasks

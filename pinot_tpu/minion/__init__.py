@@ -7,12 +7,16 @@ Framework.
 """
 from pinot_tpu.minion.executors import (CONVERT_TO_RAW_TASK,
                                         MERGE_ROLLUP_TASK, PURGE_TASK,
+                                        UPSERT_COMPACTION_TASK,
                                         MinionContext, PinotTaskExecutor,
-                                        TaskExecutorRegistry)
+                                        TaskExecutorRegistry,
+                                        UpsertCompactionTaskExecutor)
 from pinot_tpu.minion.task_manager import (ConvertToRawIndexTaskGenerator,
+                                           MergeRollupTaskGenerator,
                                            PinotTaskGenerator,
                                            PinotTaskManager,
-                                           PurgeTaskGenerator)
+                                           PurgeTaskGenerator,
+                                           UpsertCompactionTaskGenerator)
 from pinot_tpu.minion.tasks import (COMPLETED, ERROR, GENERATED,
                                     IN_PROGRESS, PinotTaskConfig, TaskQueue)
 from pinot_tpu.minion.worker import (MinionEventObserver,
@@ -20,9 +24,13 @@ from pinot_tpu.minion.worker import (MinionEventObserver,
 
 __all__ = [
     "CONVERT_TO_RAW_TASK", "MERGE_ROLLUP_TASK", "PURGE_TASK",
+    "UPSERT_COMPACTION_TASK",
     "MinionContext", "PinotTaskExecutor", "TaskExecutorRegistry",
-    "ConvertToRawIndexTaskGenerator", "PinotTaskGenerator",
-    "PinotTaskManager", "PurgeTaskGenerator", "COMPLETED", "ERROR",
+    "UpsertCompactionTaskExecutor",
+    "ConvertToRawIndexTaskGenerator", "MergeRollupTaskGenerator",
+    "PinotTaskGenerator",
+    "PinotTaskManager", "PurgeTaskGenerator",
+    "UpsertCompactionTaskGenerator", "COMPLETED", "ERROR",
     "GENERATED", "IN_PROGRESS", "PinotTaskConfig", "TaskQueue",
     "MinionEventObserver",
     "MinionWorker",

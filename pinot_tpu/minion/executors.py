@@ -24,14 +24,22 @@ from pinot_tpu.segment.loader import ImmutableSegmentLoader
 PURGE_TASK = "PurgeTask"
 CONVERT_TO_RAW_TASK = "ConvertToRawIndexTask"
 MERGE_ROLLUP_TASK = "MergeRollupTask"
+UPSERT_COMPACTION_TASK = "UpsertCompactionTask"
 
 
 class SegmentConversionResult:
     def __init__(self, out_dir: str, segment_name: str,
-                 custom: Optional[Dict] = None):
+                 custom: Optional[Dict] = None,
+                 replaces: Optional[List[str]] = None):
+        """`replaces`: input segment names this rewrite supersedes —
+        when set, the worker routes the upload through the crash-safe
+        swap protocol (controller/compaction.py) instead of the plain
+        refresh push, so the inputs leave serving atomically with the
+        rewrite entering it."""
         self.out_dir = out_dir
         self.segment_name = segment_name
         self.custom = custom or {}
+        self.replaces = list(replaces or [])
 
 
 class MinionContext:
@@ -43,6 +51,12 @@ class MinionContext:
         self.record_purger_factory: Dict[str, Callable[[dict], bool]] = {}
         # table → row-transform (mutates/returns the row)
         self.record_modifier_factory: Dict[str, Callable[[dict], dict]] = {}
+        # (table, segment) → published deadness record (invalid doc ids
+        # + doc count) — wired by the worker from the cluster store;
+        # the compaction executor reads its drop list through this so
+        # executors stay store-agnostic
+        self.deadness_lookup: Optional[
+            Callable[[str, str], Optional[dict]]] = None
 
 
 class PinotTaskExecutor:
@@ -138,21 +152,82 @@ class MergeRollupTaskExecutor(PinotTaskExecutor):
                     for m in metric_names:   # SUM rollup (default agg)
                         cur[m] = cur[m] + row[m]
             rows = list(merged.values())
-        name = task.configs.get(
-            SEGMENT_NAME_KEY,
-            "merged_" + "_".join(os.path.basename(d) for d in input_dirs))
-        name = f"{name}_merged" if name in {
-            os.path.basename(d) for d in input_dirs} else name
+        inputs = {os.path.basename(d) for d in input_dirs}
+        out_name = task.configs.get("outputSegmentName")
+        replaces: List[str] = []
+        if out_name:
+            # generator-driven swap mode: SEGMENT_NAME_KEY carries the
+            # INPUT names (the worker's download list) and the merged
+            # output replaces them through the crash-safe swap protocol
+            replaces = [s for s in
+                        task.configs.get(SEGMENT_NAME_KEY, "").split(",")
+                        if s]
+            name = out_name
+        else:
+            name = task.configs.get(
+                SEGMENT_NAME_KEY,
+                "merged_" + "_".join(os.path.basename(d)
+                                     for d in input_dirs))
+            name = f"{name}_merged" if name in inputs else name
         out = os.path.join(work_dir, name)
         SegmentCreator(schema, table_config, segment_name=name).build(
             rows, out)
         return SegmentConversionResult(out, name,
                                        {"numSegmentsMerged": len(input_dirs),
-                                        "rollup": rollup})
+                                        "rollup": rollup},
+                                       replaces=replaces)
 
 
 def _freeze(v):
     return tuple(v) if isinstance(v, list) else v
+
+
+class UpsertCompactionTaskExecutor(PinotTaskExecutor):
+    """Rewrite a sealed upsert segment dropping its validDocIds-dead
+    rows (parity: the reference's UpsertCompactionTaskExecutor, which
+    fetches validDocIds from the servers; here the drop list is the
+    deadness record servers publish to the cluster store at seal).
+
+    Doc order is preserved, so surviving rows keep their relative
+    order and the server-side swap remap (PartitionUpsertMetadata
+    remap) re-points each key-map entry at the row's new id. Deadness
+    only ever GROWS, so a drop list captured at any instant is safe:
+    dropped rows are provably superseded; rows that died since stay
+    masked after the swap because the remap re-derives their bits from
+    the authoritative key map."""
+
+    task_type = UPSERT_COMPACTION_TASK
+
+    def execute(self, task, schema, table_config, input_dirs, work_dir,
+                context) -> SegmentConversionResult:
+        table = task.configs[TABLE_NAME_KEY]
+        name = task.configs[SEGMENT_NAME_KEY]
+        segment = ImmutableSegmentLoader.load(input_dirs[0])
+        rec = None
+        if context.deadness_lookup is not None:
+            rec = context.deadness_lookup(table, name)
+        if rec is None:
+            raise ValueError(
+                f"no published deadness for {table}/{name} — cannot "
+                "prove any row dead (the server republishes at its "
+                "next seal)")
+        if int(rec.get("numDocs", -1)) > segment.num_docs:
+            raise ValueError(
+                f"stale deadness for {table}/{name}: record covers "
+                f"{rec.get('numDocs')} docs, artifact holds "
+                f"{segment.num_docs} — already compacted?")
+        invalid = {int(i) for i in rec.get("invalid", ())
+                   if 0 <= int(i) < segment.num_docs}
+        rows = [row for doc, row in enumerate(SegmentRecordReader(segment))
+                if doc not in invalid]
+        out = os.path.join(work_dir, name)
+        SegmentCreator(schema, table_config,
+                       segment_name=name).build(rows, out)
+        return SegmentConversionResult(
+            out, name,
+            {"numDocsDropped": len(invalid),
+             "numDocsKept": len(rows)},
+            replaces=[name])
 
 
 class TaskExecutorRegistry:
@@ -161,7 +236,8 @@ class TaskExecutorRegistry:
     def __init__(self):
         self._executors: Dict[str, PinotTaskExecutor] = {}
         for ex in (PurgeTaskExecutor(), ConvertToRawIndexTaskExecutor(),
-                   MergeRollupTaskExecutor()):
+                   MergeRollupTaskExecutor(),
+                   UpsertCompactionTaskExecutor()):
             self.register(ex)
 
     def register(self, executor: PinotTaskExecutor) -> None:

@@ -1,16 +1,22 @@
 """Controller-side task generation (parity: PinotTaskManager +
-TaskGeneratorRegistry + ConvertToRawIndexTaskGenerator).
+TaskGeneratorRegistry + the per-type generators).
 
-A periodic task walks every table's `task_configs`; each registered
-generator emits PinotTaskConfigs for work not yet queued (dedup against
-open tasks per segment).
+A periodic task (controller/periodic.py `MinionTaskScheduler`) walks
+every table's `task_configs`; each registered generator emits
+PinotTaskConfigs for work not yet queued (dedup against open tasks per
+segment). Generation is THROTTLED like the PR 9 rebalancer: at most
+`max_tasks_per_run` submissions per sweep, so a deadness avalanche (or
+a fat backlog of small segments) drains over several cycles instead of
+swamping the minions and the serving plane with concurrent rewrites.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List
 
-from pinot_tpu.minion.executors import (CONVERT_TO_RAW_TASK, MERGE_ROLLUP_TASK,
-                                        PURGE_TASK)
+from pinot_tpu.minion.executors import (CONVERT_TO_RAW_TASK,
+                                        MERGE_ROLLUP_TASK, PURGE_TASK,
+                                        UPSERT_COMPACTION_TASK)
 from pinot_tpu.minion.tasks import (COLUMNS_TO_CONVERT_KEY, SEGMENT_NAME_KEY,
                                     TABLE_NAME_KEY, PinotTaskConfig,
                                     TaskQueue)
@@ -59,20 +65,142 @@ class PurgeTaskGenerator(PinotTaskGenerator):
         return out
 
 
-class PinotTaskManager:
-    """Walks tables and schedules generator output onto the queue."""
+class UpsertCompactionTaskGenerator(PinotTaskGenerator):
+    """Schedule a compaction rewrite for every sealed (DONE) upsert
+    segment whose published deadness crosses the configured threshold
+    (parity: the reference's UpsertCompactionTaskGenerator over
+    server-reported validDocIds counts; here deadness rides the
+    cluster store, published by servers at seal).
 
-    def __init__(self, manager):
+    taskConfig knobs: ``invalidDocsThresholdPercent`` (default 20) —
+    deadness ratio = invalid docs / total docs; ``minInvalidDocs``
+    (default 1) — absolute floor so tiny segments don't churn."""
+
+    task_type = UPSERT_COMPACTION_TASK
+
+    def generate(self, table, table_config, manager, queue):
+        from pinot_tpu.realtime.upsert import deadness_path
+        uc = table_config.upsert_config
+        if uc is None or not uc.enabled:
+            return []
+        cfg = table_config.task_configs.get(self.task_type, {})
+        threshold_pct = float(cfg.get("invalidDocsThresholdPercent", 20))
+        min_invalid = int(float(cfg.get("minInvalidDocs", 1)))
+        out = []
+        for seg in manager.segment_names(table):
+            meta = manager.segment_metadata(table, seg) or {}
+            if meta.get("status") != "DONE":
+                continue                      # consuming / offline-less
+            total = int(meta.get("totalDocs") or 0)
+            if total <= 0:
+                continue
+            if queue.tasks_for_segment(self.task_type, table, seg):
+                continue
+            rec = manager.store.get(deadness_path(table, seg))
+            if not rec:
+                continue                      # nothing published yet
+            invalid = len(rec.get("invalid", ()))
+            if invalid < max(min_invalid, 1):
+                continue
+            if invalid >= total:
+                continue      # fully dead: retention's job, and an
+            #                   empty rewrite has nothing to serve
+            if 100.0 * invalid / total < threshold_pct:
+                continue
+            out.append(PinotTaskConfig(self.task_type, {
+                TABLE_NAME_KEY: table, SEGMENT_NAME_KEY: seg,
+                "deadnessVersion": str(rec.get("version", 0))}))
+        return out
+
+
+class MergeRollupTaskGenerator(PinotTaskGenerator):
+    """Fold runs of small committed segments into one packed segment
+    (parity: MergeRollupTaskGenerator's small-segment buckets). Upsert
+    tables are excluded — merging reshuffles doc ids under the key map
+    (rejected at table create too); each realtime partition's LATEST
+    committed sequence is excluded because it anchors the successor /
+    restart-offset chain.
+
+    taskConfig knobs: ``smallSegmentDocsThreshold`` (merge candidates
+    hold fewer docs than this; default 10000),
+    ``maxNumSegmentsPerTask`` (default 8), ``mergeType``
+    (CONCATENATE | ROLLUP)."""
+
+    task_type = MERGE_ROLLUP_TASK
+
+    def generate(self, table, table_config, manager, queue):
+        from pinot_tpu.realtime.segment_name import (LLCSegmentName,
+                                                     latest_llc_sequences)
+        uc = table_config.upsert_config
+        if uc is not None and uc.enabled:
+            return []
+        cfg = table_config.task_configs.get(self.task_type, {})
+        threshold = int(float(cfg.get("smallSegmentDocsThreshold", 10_000)))
+        per_task = max(2, int(float(cfg.get("maxNumSegmentsPerTask", 8))))
+        merge_type = str(cfg.get("mergeType", "CONCATENATE")).upper()
+        latest = latest_llc_sequences(manager.segment_names(table))
+        candidates = []
+        for seg in sorted(manager.segment_names(table)):
+            meta = manager.segment_metadata(table, seg) or {}
+            if meta.get("status") == "IN_PROGRESS":
+                continue                      # consuming
+            if LLCSegmentName.is_llc(seg):
+                llc = LLCSegmentName.parse(seg)
+                if latest.get(llc.partition) == llc.sequence:
+                    continue  # anchors the partition's restart offset
+            total = int(meta.get("totalDocs") or 0)
+            if not meta.get("downloadPath") or total <= 0 or \
+                    total >= threshold:
+                continue
+            if queue.tasks_for_segment(self.task_type, table, seg):
+                continue
+            candidates.append((meta.get("startTime") or 0, seg))
+        candidates.sort()
+        out = []
+        group = [seg for _t, seg in candidates]
+        for i in range(0, len(group) - 1, per_task):
+            batch = group[i:i + per_task]
+            if len(batch) < 2:
+                continue                      # nothing to fold
+            out_name = f"merged_{batch[0]}_{batch[-1]}"
+            out.append(PinotTaskConfig(self.task_type, {
+                TABLE_NAME_KEY: table,
+                SEGMENT_NAME_KEY: ",".join(batch),
+                "outputSegmentName": out_name,
+                "mergeType": merge_type}))
+        return out
+
+
+class PinotTaskManager:
+    """Walks tables and schedules generator output onto the queue,
+    bounded per sweep (`max_tasks_per_run`) so background rewrites
+    never swamp the minions or the serving plane."""
+
+    def __init__(self, manager, metrics=None,
+                 max_tasks_per_run: int = 16):
         self.manager = manager
-        self.queue = TaskQueue(manager.store)
+        self.queue = TaskQueue(manager.store, metrics=metrics)
+        self.max_tasks_per_run = max_tasks_per_run
+        # the generators' dedup check (tasks_for_segment) and submit
+        # are not atomic — concurrent schedules (the periodic sweep
+        # racing a REST /tasks/schedule) would double-submit per
+        # segment, so the whole sweep is serialized HERE, where every
+        # caller shares it
+        self._schedule_lock = threading.Lock()
         self._generators: Dict[str, PinotTaskGenerator] = {}
-        for g in (ConvertToRawIndexTaskGenerator(), PurgeTaskGenerator()):
+        for g in (ConvertToRawIndexTaskGenerator(), PurgeTaskGenerator(),
+                  UpsertCompactionTaskGenerator(),
+                  MergeRollupTaskGenerator()):
             self.register(g)
 
     def register(self, gen: PinotTaskGenerator) -> None:
         self._generators[gen.task_type] = gen
 
     def schedule_tasks(self) -> List[str]:
+        with self._schedule_lock:
+            return self._schedule_locked()
+
+    def _schedule_locked(self) -> List[str]:
         scheduled = []
         for table in self.manager.table_names():
             config = self.manager.get_table_config(table)
@@ -84,5 +212,7 @@ class PinotTaskManager:
                     continue
                 for task in gen.generate(table, config, self.manager,
                                          self.queue):
+                    if len(scheduled) >= self.max_tasks_per_run:
+                        return scheduled      # throttle: next sweep
                     scheduled.append(self.queue.submit(task))
         return scheduled

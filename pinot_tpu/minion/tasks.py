@@ -7,6 +7,14 @@ pinot-common PinotTaskConfig. The TPU build replaces the Helix task
 state machine with atomic claim/complete updates on the cluster
 property store — the same single-writer CAS discipline the ideal-state
 updates use.
+
+Claim leases: an ``IN_PROGRESS`` task whose minion was kill -9'd must
+not stay stranded forever. Every claim stamps ``claimTimeMs`` and bumps
+``attempts``; ``requeue_expired`` (driven by the controller's periodic
+minion scheduler) moves expired claims back to ``GENERATED`` — or to
+``ERROR`` once the attempt budget is exhausted — and ``finish`` rejects
+a completion from a worker whose claim was requeued from under it (the
+zombie-minion fencing analogue of the leadership epoch check).
 """
 from __future__ import annotations
 
@@ -60,22 +68,52 @@ class TaskQueue:
     """Task lifecycle on the property store.
 
     /TASKS/<taskType>/<taskId> → {"config": ..., "state": ...,
-    "worker": ..., "info": ...}. Claiming is an atomic read-modify-write
-    so concurrent minions never double-run a task.
+    "worker": ..., "info": ..., "claimTimeMs": ..., "attempts": ...}.
+    Claiming is an atomic read-modify-write so concurrent minions never
+    double-run a task; the claim carries a lease (`lease_s`, injectable
+    `clock`) so a claimer's death requeues the task instead of
+    stranding it.
     """
 
-    def __init__(self, store: PropertyStore):
+    #: how long a claim stays valid before requeue (a task exceeding
+    #: this should extend via re-claim semantics — not supported; size
+    #: the lease for the slowest expected segment rewrite)
+    DEFAULT_LEASE_S = 300.0
+    #: claims per task before the queue gives up and marks ERROR
+    DEFAULT_MAX_ATTEMPTS = 3
+    #: how long COMPLETED/ERROR records stay queryable before the
+    #: periodic sweep prunes them — without pruning, /TASKS grows
+    #: without bound and every requeue/dedup scan pays for the whole
+    #: task HISTORY of the cluster
+    DEFAULT_TERMINAL_RETENTION_S = 6 * 3600.0
+
+    def __init__(self, store: PropertyStore, clock=time.time,
+                 lease_s: float = DEFAULT_LEASE_S,
+                 max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 metrics=None):
         self.store = store
+        self._clock = clock
+        self.lease_s = lease_s
+        self.max_attempts = max_attempts
+        self.metrics = metrics
+
+    def _now_ms(self) -> int:
+        return int(self._clock() * 1e3)
 
     def submit(self, task: PinotTaskConfig) -> str:
         self.store.set(f"{TASKS_ROOT}/{task.task_type}/{task.task_id}", {
             "config": task.to_json(), "state": GENERATED,
-            "submitTimeMs": int(time.time() * 1e3)})
+            "attempts": 0,
+            "submitTimeMs": self._now_ms()})
         return task.task_id
+
+    def task_types(self) -> List[str]:
+        return self.store.children(TASKS_ROOT)
 
     def claim(self, worker_id: str, task_types: List[str]
               ) -> Optional[PinotTaskConfig]:
-        """Atomically move one GENERATED task to IN_PROGRESS."""
+        """Atomically move one GENERATED task to IN_PROGRESS, stamping
+        the claim lease and attempt count."""
         for ttype in task_types:
             for task_id in self.store.children(f"{TASKS_ROOT}/{ttype}"):
                 path = f"{TASKS_ROOT}/{ttype}/{task_id}"
@@ -86,6 +124,8 @@ class TaskQueue:
                         rec = dict(rec)
                         rec["state"] = IN_PROGRESS
                         rec["worker"] = worker_id
+                        rec["claimTimeMs"] = self._now_ms()
+                        rec["attempts"] = int(rec.get("attempts", 0)) + 1
                         claimed["config"] = rec["config"]
                     return rec or {}
 
@@ -95,17 +135,98 @@ class TaskQueue:
         return None
 
     def finish(self, task: PinotTaskConfig, state: str,
-               info: str = "") -> None:
+               info: str = "", worker_id: Optional[str] = None) -> bool:
+        """Record a terminal state. When `worker_id` is given, the
+        completion is FENCED: it lands only if the task is still
+        IN_PROGRESS under that worker's claim — a worker whose lease
+        expired and whose task was requeued (possibly already re-run by
+        another minion) must not clobber the newer outcome. Returns
+        whether the write landed."""
         path = f"{TASKS_ROOT}/{task.task_type}/{task.task_id}"
+        accepted = {}
 
         def done(rec):
             rec = dict(rec or {})
+            if worker_id is not None and (
+                    rec.get("state") != IN_PROGRESS or
+                    rec.get("worker") != worker_id):
+                return rec                  # stale claim: reject
+            accepted["ok"] = True
             rec["state"] = state
             rec["info"] = info
-            rec["endTimeMs"] = int(time.time() * 1e3)
+            rec["endTimeMs"] = self._now_ms()
             return rec
 
         self.store.update(path, done)
+        return bool(accepted)
+
+    def requeue_expired(self, task_types: Optional[List[str]] = None
+                        ) -> List[str]:
+        """Requeue IN_PROGRESS tasks whose claim lease expired (the
+        claiming minion is presumed dead). A task that exhausted its
+        attempt budget goes ERROR instead. Atomic per task via the
+        store's read-modify-write. Returns the affected task ids."""
+        from pinot_tpu.common.metrics import MinionMeter
+        now = self._now_ms()
+        cutoff = now - int(self.lease_s * 1e3)
+        touched: List[str] = []
+        for ttype in (task_types if task_types is not None
+                      else self.task_types()):
+            for task_id in self.store.children(f"{TASKS_ROOT}/{ttype}"):
+                path = f"{TASKS_ROOT}/{ttype}/{task_id}"
+                outcome = {}
+
+                def sweep(rec):
+                    if not rec or rec.get("state") != IN_PROGRESS:
+                        return rec or {}
+                    if int(rec.get("claimTimeMs", now)) > cutoff:
+                        return rec          # lease still live
+                    rec = dict(rec)
+                    if int(rec.get("attempts", 1)) >= self.max_attempts:
+                        rec["state"] = ERROR
+                        rec["info"] = (
+                            f"claim lease expired after "
+                            f"{rec.get('attempts')} attempt(s); worker "
+                            f"{rec.get('worker')!r} presumed dead")
+                        outcome["state"] = ERROR
+                    else:
+                        rec["state"] = GENERATED
+                        outcome["state"] = GENERATED
+                    rec.pop("worker", None)
+                    rec.pop("claimTimeMs", None)
+                    return rec
+
+                self.store.update(path, sweep)
+                if outcome:
+                    touched.append(task_id)
+                    if self.metrics is not None:
+                        name = MinionMeter.TASK_REQUEUES \
+                            if outcome["state"] == GENERATED \
+                            else MinionMeter.TASK_ATTEMPTS_EXHAUSTED
+                        self.metrics.meter(name).mark()
+        return touched
+
+    def prune_terminal(self, retention_s: Optional[float] = None
+                       ) -> List[str]:
+        """Remove COMPLETED/ERROR records older than `retention_s`
+        (default DEFAULT_TERMINAL_RETENTION_S) so the queue's scans
+        stay O(open tasks), not O(cluster lifetime). Returns pruned
+        ids."""
+        if retention_s is None:
+            retention_s = self.DEFAULT_TERMINAL_RETENTION_S
+        cutoff = self._now_ms() - int(retention_s * 1e3)
+        pruned: List[str] = []
+        for ttype in self.task_types():
+            for task_id in self.store.children(f"{TASKS_ROOT}/{ttype}"):
+                path = f"{TASKS_ROOT}/{ttype}/{task_id}"
+                rec = self.store.get(path)
+                if not rec or rec.get("state") not in (COMPLETED, ERROR):
+                    continue
+                if int(rec.get("endTimeMs", cutoff + 1)) > cutoff:
+                    continue
+                self.store.remove(path)
+                pruned.append(task_id)
+        return pruned
 
     def task_states(self, task_type: str) -> Dict[str, str]:
         out = {}

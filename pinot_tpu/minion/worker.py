@@ -9,6 +9,7 @@ servers), and marks the task COMPLETED/ERROR.
 """
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import tempfile
@@ -16,10 +17,13 @@ import threading
 import traceback
 from typing import List, Optional
 
+from pinot_tpu.common.faults import InjectedCrash
 from pinot_tpu.minion.executors import (MinionContext, TaskExecutorRegistry)
 from pinot_tpu.minion.tasks import (COMPLETED, ERROR, SEGMENT_NAME_KEY,
                                     TABLE_NAME_KEY, PinotTaskConfig,
                                     TaskQueue)
+
+log = logging.getLogger(__name__)
 
 
 class MinionEventObserver:
@@ -45,13 +49,25 @@ class MinionWorker:
                  work_dir: Optional[str] = None,
                  registry: Optional[TaskExecutorRegistry] = None,
                  context: Optional[MinionContext] = None,
-                 observers: Optional[List[MinionEventObserver]] = None):
+                 observers: Optional[List[MinionEventObserver]] = None,
+                 metrics=None):
         self.manager = manager                      # ControllerManager
         self.instance_id = instance_id
         self.queue = TaskQueue(manager.store)
         self.registry = registry or TaskExecutorRegistry()
         self.observers: List[MinionEventObserver] = list(observers or ())
         self.context = context or MinionContext()
+        if self.context.deadness_lookup is None:
+            # compaction drop lists ride the cluster store (published
+            # by servers at seal) — executors stay store-agnostic
+            from pinot_tpu.realtime.upsert import deadness_path
+            self.context.deadness_lookup = \
+                lambda t, s: manager.store.get(deadness_path(t, s))
+        # the crash-safe swap driver for rewrites that REPLACE their
+        # inputs (upsert compaction, merge) — shares the controller
+        # manager's store/deep-store handles
+        from pinot_tpu.controller.compaction import SegmentSwapManager
+        self.swaps = SegmentSwapManager(manager, metrics=metrics)
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="minion_")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -67,12 +83,26 @@ class MinionWorker:
         self._notify(lambda o: o.notify_task_start(task))
         try:
             self._execute(task)
-            self.queue.finish(task, COMPLETED)
-            self._notify(lambda o: o.notify_task_success(task))
+            if not self.queue.finish(task, COMPLETED,
+                                     worker_id=self.instance_id):
+                # the claim lease expired and the task was requeued
+                # from under us (possibly already re-run): our outcome
+                # must not clobber the newer claim's
+                log.warning("minion %s lost the claim on %s before "
+                            "completion landed", self.instance_id,
+                            task.task_id)
+            else:
+                self._notify(lambda o: o.notify_task_success(task))
+        except InjectedCrash:
+            # simulated kill -9: the process is gone mid-task — the
+            # claim stays IN_PROGRESS until its lease expires and the
+            # queue requeues it (never mark ERROR for a death)
+            raise
         except Exception as e:  # noqa: BLE001 — task isolation boundary
             self.queue.finish(task, ERROR,
                               f"{type(e).__name__}: {e}\n"
-                              f"{traceback.format_exc(limit=5)}")
+                              f"{traceback.format_exc(limit=5)}",
+                              worker_id=self.instance_id)
             self._notify(lambda o: o.notify_task_error(task, e))
         return task.task_id
 
@@ -90,6 +120,8 @@ class MinionWorker:
         executor = self.registry.get(task.task_type)
         if executor is None:
             raise ValueError(f"no executor for task type {task.task_type}")
+        if self._finish_interrupted_swap(task, table, segments):
+            return
         from pinot_tpu.common.table_name import raw_table
         schema = self.manager.get_schema(raw_table(table)) or \
             self.manager.get_schema(table)
@@ -123,8 +155,62 @@ class MinionWorker:
         os.makedirs(out_dir, exist_ok=True)
         result = executor.execute(task, schema, config, inputs, out_dir,
                                   self.context)
-        self.manager.add_segment(table, result.out_dir)
+        if result.replaces:
+            # the rewrite supersedes its inputs: swap them atomically
+            # through the crash-safe staged-commit protocol
+            self.swaps.swap_segments(table, result.replaces,
+                                     result.out_dir)
+        else:
+            self.manager.add_segment(table, result.out_dir)
         shutil.rmtree(task_dir, ignore_errors=True)
+
+    def _finish_interrupted_swap(self, task: PinotTaskConfig, table: str,
+                                 segments: List[str]) -> bool:
+        """A re-queued swap task whose previous attempt crashed after
+        the durable intent landed: resume the swap instead of
+        rebuilding (the staged/published rewrite rolls forward). Also
+        short-circuits a task whose previous attempt fully swapped but
+        died before its COMPLETED write. Returns True when the task
+        needs no rebuild."""
+        from pinot_tpu.controller.compaction import SWAPS_ROOT
+        from pinot_tpu.minion.executors import UPSERT_COMPACTION_TASK
+        out_name = task.configs.get("outputSegmentName", "")
+        if not out_name and task.task_type == UPSERT_COMPACTION_TASK:
+            out_name = segments[0] if segments else ""
+        if not out_name:
+            return False
+        intent = self.manager.store.get(
+            f"{SWAPS_ROOT}/{table}/{out_name}")
+        if intent:
+            # THIS task's previous claim died mid-swap (the lease
+            # expired, or we'd never have claimed it) — resume exactly
+            # its swap, immediately; other tasks' live swaps are their
+            # claimants' (or the janitor's) to finish
+            log.warning("minion %s: resuming interrupted swap of %s/%s "
+                        "from its intent record", self.instance_id,
+                        table, out_name)
+            self.swaps.resume_swaps(table, min_age_s=0.0, only=out_name)
+            # rolled FORWARD (record now carries the rewrite's crc) →
+            # done; rolled BACK (nothing was published, old world
+            # intact) → fall through and rebuild
+            rec = self.manager.segment_metadata(table, out_name) or {}
+            return rec.get("crc") == intent.get("newCrc")
+        from pinot_tpu.realtime.upsert import deadness_path
+        if task.task_type == UPSERT_COMPACTION_TASK and \
+                self.manager.store.get(
+                    deadness_path(table, out_name)) is None:
+            # the deadness record died with a completed swap (or the
+            # segment was deleted): nothing provably dead to drop
+            log.info("minion %s: no published deadness for %s/%s — "
+                     "nothing to compact", self.instance_id, table,
+                     out_name)
+            return True
+        if out_name and task.task_type != UPSERT_COMPACTION_TASK and \
+                self.manager.segment_metadata(table, out_name) and \
+                all(self.manager.segment_metadata(table, s) is None
+                    for s in segments):
+            return True          # merge already swapped in fully
+        return False
 
     # -- background loop --------------------------------------------------
 

@@ -55,10 +55,13 @@ class RealtimeSegmentDataManager:
                  table_config, stream_config: StreamConfig,
                  start_offset: int, completion, instance_id: str,
                  table_data_manager, work_dir: str, stats_history=None,
-                 upsert=None, upsert_key_fn=None, metrics=None):
+                 upsert=None, upsert_key_fn=None, metrics=None,
+                 post_seal=None):
         """`upsert`: the table's PartitionUpsertMetadata for this stream
         partition (realtime/upsert.py) — None for non-upsert tables;
-        `upsert_key_fn`: row dict → normalized primary-key tuple."""
+        `upsert_key_fn`: row dict → normalized primary-key tuple;
+        `post_seal`: advisory hook run after a successful upsert seal
+        (deadness publication for the minion compaction plane)."""
         self.llc = llc
         self.table = table
         self.stream_config = stream_config
@@ -72,6 +75,7 @@ class RealtimeSegmentDataManager:
         self.upsert = upsert
         self.upsert_key_fn = upsert_key_fn
         self.metrics = metrics
+        self.post_seal = post_seal
         # how often the build-time lease extender pings the controller
         self.lease_extend_interval_s = 10.0
         # allocation sizing from the table's completed-segment history
@@ -360,6 +364,14 @@ class RealtimeSegmentDataManager:
             except OSError:
                 log.warning("upsert seal failed for %s", self.llc.name,
                             exc_info=True)
+            if self.post_seal is not None:
+                try:
+                    # advisory: deadness publication for the compaction
+                    # plane — a failure must never fail the commit
+                    self.post_seal()
+                except Exception:  # noqa: BLE001
+                    log.warning("post-seal hook failed for %s",
+                                self.llc.name, exc_info=True)
         self.state = COMMITTED  # tpulint: disable=concurrency -- consumer-thread single-writer; cross-thread readers (consuming_state) take one GIL-atomic snapshot
 
 
@@ -391,8 +403,73 @@ class RealtimeTableDataManager:
         # table → TableUpsertMetadataManager (realtime/upsert.py); built
         # lazily from the table config's upsertConfig
         self._upsert: Dict[str, Optional[object]] = {}
+        # (table, segment) → last published deadness bitmap version
+        self._published_deadness: Dict[tuple, int] = {}
         self._closed = False
         self._lock = threading.Lock()
+        # table-wide segment deletion (TTL retention, table drop) must
+        # garbage-collect the key-map entries whose winners lived in the
+        # deleted segment — watch the durable record removals; replica-
+        # local drops (rebalance moves) fire no record removal and keep
+        # their entries (the winners still exist in the table)
+        self._record_watcher = self._on_segment_record_change
+        self.manager.store.watch("/SEGMENTS/", self._record_watcher)
+
+    def _on_segment_record_change(self, path: str, record) -> None:
+        if record is not None:
+            return                          # only removals drive GC
+        parts = path.split("/")
+        if len(parts) != 4:                 # /SEGMENTS/<table>/<segment>
+            return
+        table, segment = parts[2], parts[3]
+        with self._lock:
+            um = self._upsert.get(table)
+            self._published_deadness.pop((table, segment), None)
+        if um is not None:
+            um.gc_segment_record(segment)
+
+    def _live_llc_seqs(self, table: str, partition: int):
+        """Sequences with a live segment record for one stream
+        partition — the boot-time upsert GC reconcile's ground truth."""
+        out = set()
+        for seg in self.manager.segment_names(table):
+            try:
+                llc = LLCSegmentName.parse(seg)
+            except ValueError:
+                continue
+            if llc.partition == partition:
+                out.add(llc.sequence)
+        return out
+
+    def publish_deadness(self, table: str) -> int:
+        """Publish per-committed-segment deadness (invalid doc ids) to
+        the property store for the minion compaction plane. Version-
+        skipped: only bitmaps that changed since the last publication
+        are rewritten. Advisory — IO failures are logged, never
+        propagated."""
+        from pinot_tpu.realtime.upsert import deadness_path
+        um = self.upsert_manager(table)
+        if um is None:
+            return 0
+        with self._lock:
+            already = {name: ver for (t, name), ver in
+                       self._published_deadness.items() if t == table}
+        published = 0
+        for name, info in sorted(um.deadness_reports(already).items()):
+            key = (table, name)
+            with self._lock:
+                if self._published_deadness.get(key) == info["version"]:
+                    continue
+            try:
+                self.manager.store.set(deadness_path(table, name), info)
+            except Exception:  # noqa: BLE001 — advisory publication
+                log.warning("deadness publish failed for %s/%s", table,
+                            name, exc_info=True)
+                continue
+            with self._lock:
+                self._published_deadness[key] = info["version"]
+            published += 1
+        return published
 
     def upsert_manager(self, table: str):
         """The table's upsert metadata manager, or None when the table
@@ -414,7 +491,8 @@ class RealtimeTableDataManager:
             raise ValueError(f"missing schema for upsert table {table}")
         mgr = TableUpsertMetadataManager(
             table, uc, schema,
-            os.path.join(self.work_dir, "upsert", table))
+            os.path.join(self.work_dir, "upsert", table),
+            live_seqs_fn=lambda p, t=table: self._live_llc_seqs(t, p))
         with self._lock:
             winner = self._upsert.setdefault(table, mgr)
         if winner is mgr:
@@ -471,7 +549,9 @@ class RealtimeTableDataManager:
                 stats_history=self.stats_history,
                 upsert=upsert_part,
                 upsert_key_fn=um.key_of if um is not None else None,
-                metrics=getattr(self.server, "metrics", None))
+                metrics=getattr(self.server, "metrics", None),
+                post_seal=((lambda t=table: self.publish_deadness(t))
+                           if um is not None else None))
 
     def on_segment_online(self, table: str, segment: str) -> None:
         """CONSUMING→ONLINE (or OFFLINE→ONLINE for a committed LLC
@@ -498,9 +578,14 @@ class RealtimeTableDataManager:
         if um is not None:
             # attach the partition's validDocIds (or FOLD the segment's
             # primary keys when no durable coverage exists — the loser-
-            # download and lost-snapshot convergence path) BEFORE the
-            # segment becomes queryable
+            # download and lost-snapshot convergence path; or REMAP a
+            # compacted rewrite) BEFORE the segment becomes queryable
             um.on_committed_segment(segment, seg)
+            with self._lock:
+                # whatever deadness we last published described the
+                # pre-swap artifact — force a fresh publication at the
+                # next seal regardless of version collisions
+                self._published_deadness.pop((table, segment), None)
         self.server.data_manager.table(table, create=True).add_segment(seg)
 
     def on_segment_offline(self, table: str, segment: str) -> None:
@@ -544,6 +629,10 @@ class RealtimeTableDataManager:
         return ok
 
     def shutdown(self) -> None:
+        try:
+            self.manager.store.unwatch(self._record_watcher)
+        except Exception:  # noqa: BLE001 — store may already be closed
+            pass
         with self._lock:
             self._closed = True
             rdms = list(self._consuming.values())

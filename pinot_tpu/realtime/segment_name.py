@@ -37,3 +37,18 @@ class LLCSegmentName:
             return True
         except ValueError:
             return False
+
+
+def latest_llc_sequences(names) -> dict:
+    """partition -> max sequence over the LLC names in `names`. The
+    newest sequence per partition anchors the successor / restart-
+    offset chain, so retention and merge generation must never touch
+    it — shared here so both exemptions stay in sync."""
+    latest: dict = {}
+    for name in names:
+        if not LLCSegmentName.is_llc(name):
+            continue
+        llc = LLCSegmentName.parse(name)
+        latest[llc.partition] = max(latest.get(llc.partition, -1),
+                                    llc.sequence)
+    return latest

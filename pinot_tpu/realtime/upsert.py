@@ -64,6 +64,18 @@ JOURNAL_FILE = "journal.jsonl"
 SNAPSHOT_PREFIX = "keymap-"
 SIDECAR_PREFIX = "validdocids-"
 
+#: store prefix where servers publish per-committed-segment deadness
+#: (invalid doc ids + doc count + bitmap version) for the minion
+#: plane's compaction generator/executor — any replica's snapshot is a
+#: safe UNDER-approximation (bits only ever set when a newer row won,
+#: a global fact), so compaction may drop exactly those docs and the
+#: swap-time remap re-derives whatever died since
+DEADNESS_ROOT = "/DEADNESS"
+
+
+def deadness_path(table: str, segment: str) -> str:
+    return f"{DEADNESS_ROOT}/{table}/{segment}"
+
 
 class ValidDocIds:
     """Per-segment liveness bitmap: a doc is valid unless invalidated.
@@ -168,6 +180,9 @@ class PartitionUpsertMetadata:
         self.replayed_offset = -1       # ... advanced by journal replay
         self.upserted_rows = 0          # rows that superseded an older doc
         self.masked_docs = 0            # docs invalidated
+        self.remapped_segments = 0      # compacted artifacts remapped in
+        self.gced_keys = 0              # map entries dropped by segment GC
+        self._snapshot_seq = -1         # filename seq of the last snapshot
         os.makedirs(data_dir, exist_ok=True)
         self._restore()
 
@@ -295,6 +310,7 @@ class PartitionUpsertMetadata:
         os.replace(tmp, path)
         with self._lock:
             self.snapshot_offset = int(end_offset)
+            self._snapshot_seq = int(seq)
         for name in os.listdir(self.data_dir):
             if name.startswith(SNAPSHOT_PREFIX) and \
                     name.endswith(".json") and \
@@ -352,6 +368,7 @@ class PartitionUpsertMetadata:
             snapshot_lost = False
             if snaps:
                 _seq, name = max(snaps)
+                self._snapshot_seq = int(_seq)
                 try:
                     with open(os.path.join(self.data_dir, name)) as fh:  # tpulint: disable=lock-blocking -- _restore runs once at boot before the consumer starts; nothing else can hold or want this lock yet
                         snap = json.load(fh)
@@ -443,21 +460,30 @@ class PartitionUpsertMetadata:
             except OSError:
                 pass
 
-    # -- committed-segment attach / fold -----------------------------------
+    # -- committed-segment attach / fold / remap ---------------------------
 
     def attach_or_fold(self, seq: int, segment,
                        keys_fn: Callable[[], List[tuple]]) -> ValidDocIds:
-        """Give `segment` its ValidDocIds. When durable state already
+        """Give `segment` its ValidDocIds. When durable state exactly
         covers the segment's docs (local consume, or snapshot+journal
-        restore), the registered bitmap attaches as-is; otherwise the
-        segment's primary keys (``keys_fn``) are folded into the map —
-        the loser-download / lost-durable-state convergence path."""
+        restore), the registered bitmap attaches as-is; when it covers
+        FEWER docs, the segment's primary keys (``keys_fn``) are folded
+        into the map — the loser-download / lost-durable-state
+        convergence path. When it covers MORE docs than the artifact
+        holds, the artifact is a compacted (or discard-truncated)
+        rewrite: its doc ids shifted, so the stale bitmap is discarded
+        and every row is REMAPPED against the key map (same-key map
+        entries move to the new doc id; rows whose key a newer segment
+        owns are invalidated fresh)."""
         with self._lock:
             vd = self._valid.get(seq)
-            if vd is not None and \
-                    self._covered.get(seq, 0) >= segment.num_docs:
+            covered = self._covered.get(seq, 0)
+            if vd is not None and covered == segment.num_docs:
                 return vd
+            needs_remap = covered > segment.num_docs
         keys = keys_fn()                  # heavy decode outside the lock
+        if needs_remap:
+            return self._remap_segment(seq, keys)
         with self._lock:
             vd = self._bitmap(seq)
             upserts = 0
@@ -467,6 +493,164 @@ class PartitionUpsertMetadata:
             self.upserted_rows += upserts
             self._covered[seq] = max(self._covered.get(seq, 0), len(keys))
             return vd
+
+    def _remap_segment(self, seq: int, keys: List[tuple]) -> ValidDocIds:
+        """Compaction swap: rebuild seq's bitmap and re-point its map
+        entries at the rewritten artifact's doc ids. The fold stays
+        order-independent: a key some NEWER segment owns masks the
+        compacted row; a key an OLDER segment owns is superseded by it
+        (the compacted row is the same logical row that already won).
+        Idempotent — re-running over an already-remapped map is a
+        no-op — and persisted (snapshot + sidecar) so a crash after the
+        swap does not resurrect stale doc ids on restart."""
+        with self._lock:
+            vd = ValidDocIds()
+            self._valid[seq] = vd
+            for doc, key in enumerate(keys):
+                loc = (seq, doc)
+                e = self._map.get(key)
+                if e is None or e[0] == seq:
+                    # this key's winner lives (or lived) in this segment:
+                    # the compacted row IS that winner, at its new id
+                    self._map[key] = loc
+                elif e > loc:
+                    # a newer segment superseded the key since compaction
+                    if vd.invalidate(doc):
+                        self.masked_docs += 1
+                else:
+                    # an older segment held the key: compacted row wins
+                    if self._bitmap(e[0]).invalidate(e[1]):
+                        self.masked_docs += 1
+                    self._map[key] = loc
+            self._covered[seq] = len(keys)
+            self._sidecar_versions.pop(seq, None)
+            self.remapped_segments += 1
+            invalid = vd.invalid_ids(len(keys))
+            version = vd.version
+            num_docs = len(keys)
+        # persist OUTSIDE the lock: snapshot first (remapped entries),
+        # then the sidecar — a crash anywhere here re-runs the remap on
+        # restart from whatever durable state survived; every path is
+        # idempotent by the fold above. Seeded crash point: die with the
+        # remap applied in memory but nothing persisted.
+        crash_points.hit("upsert.compact_snapshot")
+        self.snapshot_now(seq)
+        self._write_sidecar(seq, num_docs, invalid, version)
+        return vd
+
+    def snapshot_now(self, seq_hint: int = 0) -> None:
+        """Write a key-map snapshot outside the seal path (compaction
+        remap / GC persistence). Same staged + fsync + atomic-rename
+        discipline as seal; the journal is NOT truncated — its replay
+        is idempotent over the newer snapshot, and offset bookkeeping
+        belongs to seal alone. Deliberate twin of seal()'s snapshot
+        block, NOT a shared helper: seal's own `open(tmp…)` stage and
+        `os.replace(tmp…)` rename statements are the protocol tier's
+        extraction anchors (analysis/protocol.py extract_seal) — moving
+        them into a callee would break the shape contract the
+        upsert-seal model is built from."""
+        if not self.enable_snapshot:
+            return
+        with self._lock:
+            seq = max(self._snapshot_seq, int(seq_hint))
+            entries = [[list(k), int(s), int(d)]
+                       for k, (s, d) in self._map.items()]
+            offset = int(self.snapshot_offset)
+        snap = {"seq": int(seq), "offset": offset, "entries": entries}
+        path = os.path.join(self.data_dir, f"{SNAPSHOT_PREFIX}{seq}.json")
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(snap, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            with self._lock:
+                self._snapshot_seq = seq
+        except OSError:
+            # advisory (module contract): the remap re-derives on boot
+            log.warning("compact snapshot write failed for %s/p%d",
+                        self.table, self.partition, exc_info=True)
+
+    def gc_segment(self, seq: int) -> int:
+        """Table-wide segment deletion (TTL retention / merge-away):
+        drop every key-map entry whose winner lived in `seq`, its
+        bitmap, coverage and sidecar — the key no longer exists in the
+        table, so the map must stop carrying it (the `upsertKeyMapSize`
+        growth story). Masks never resurrect: other segments' bits for
+        keys this segment once superseded stay set. Returns the number
+        of entries dropped."""
+        dropped = self._gc_segment_inner(seq)
+        if dropped:
+            self._persist_gc()
+        return dropped
+
+    def _gc_segment_inner(self, seq: int) -> int:
+        with self._lock:
+            doomed = [k for k, loc in self._map.items() if loc[0] == seq]
+            for k in doomed:
+                del self._map[k]
+            self._valid.pop(seq, None)
+            self._covered.pop(seq, None)
+            self._sidecar_versions.pop(seq, None)
+            self.gced_keys += len(doomed)
+        try:
+            os.remove(self._sidecar_path(seq))
+        except OSError:
+            pass                          # never written / already gone
+        return len(doomed)
+
+    def _persist_gc(self) -> None:
+        """Persist the shrunken map NOW: the record-removal event fires
+        exactly once, so waiting for the next seal would let a crash
+        resurrect the dropped entries from the old snapshot forever on
+        a low-traffic partition. Seeded crash point: dying HERE leaves
+        zombie entries in the old snapshot — a bounded metric skew
+        (key_map_size overcounts), never a correctness loss (the
+        deleted segment is unrouted and masks never resurrect); the
+        boot-time `gc_missing` reconcile re-converges them."""
+        crash_points.hit("upsert.gc_snapshot")
+        self.snapshot_now()
+
+    def gc_missing(self, live_seqs) -> int:
+        """Boot/build-time reconcile: garbage-collect every seq this
+        partition's durable state still tracks whose segment RECORD no
+        longer exists in the cluster state. The record-removal watch
+        (the online GC path) is in-memory and one-shot — a server that
+        was down, restarting, or had not yet built the table's upsert
+        manager when retention deleted a segment would otherwise carry
+        its zombie keys forever. Returns entries dropped."""
+        live = set(live_seqs)
+        with self._lock:
+            known = set(self._covered) | set(self._valid) | \
+                {loc[0] for loc in self._map.values()}
+        dropped = 0
+        for seq in sorted(known - live):
+            dropped += self._gc_segment_inner(seq)
+        if dropped:
+            self._persist_gc()
+        return dropped
+
+    def deadness_report(self, skip_versions: Optional[Dict[int, int]]
+                        = None) -> Dict[int, dict]:
+        """Per-seq deadness snapshot (invalid doc ids + covered docs +
+        bitmap version) for obs-plane publication — the compaction
+        generator's scheduling signal and the executor's drop list.
+        `skip_versions` (seq → already-published version) suppresses
+        unchanged bitmaps BEFORE their invalid-id lists are
+        materialized, so a per-seal publication sweep is O(changed),
+        not O(all segments × invalid docs)."""
+        with self._lock:
+            out = {}
+            for seq, vd in self._valid.items():
+                if skip_versions is not None and \
+                        skip_versions.get(seq) == vd.version:
+                    continue
+                n = int(self._covered.get(seq, 0))
+                out[seq] = {"version": int(vd.version), "numDocs": n,
+                            "invalid": [int(i) for i in
+                                        vd.invalid_ids(n)]}
+            return out
 
     def close(self) -> None:
         with self._lock:
@@ -484,11 +668,17 @@ class TableUpsertMetadataManager:
     decoded segment columns produce identical key tuples)."""
 
     def __init__(self, table: str, config: UpsertConfig, schema,
-                 data_dir: str, metrics=None):
+                 data_dir: str, metrics=None, live_seqs_fn=None):
+        """`live_seqs_fn`: partition -> set of sequences with a LIVE
+        segment record — when wired, a freshly built/restored
+        partition reconciles its durable key-map state against the
+        cluster state (gc_missing), catching table-wide deletions this
+        server's one-shot record watch missed while down."""
         self.table = table
         self.config = config
         self.data_dir = data_dir
         self.metrics = metrics
+        self._live_seqs_fn = live_seqs_fn
         self._parts: Dict[int, PartitionUpsertMetadata] = {}
         self._lock = threading.Lock()
         self._normalizers: List[Tuple[str, Callable]] = []
@@ -519,13 +709,28 @@ class TableUpsertMetadataManager:
     def partition(self, partition: int) -> PartitionUpsertMetadata:
         with self._lock:
             part = self._parts.get(partition)
-            if part is None:
+            created = part is None
+            if created:
                 part = PartitionUpsertMetadata(
                     os.path.join(self.data_dir, f"partition_{partition}"),
                     self.table, partition,
                     enable_snapshot=self.config.enable_snapshot)
                 self._parts[partition] = part
-            return part
+        if created and self._live_seqs_fn is not None:
+            # reconcile restored state against the cluster records:
+            # segments deleted while this server was away leave no
+            # watch event — their keys must not resurrect
+            try:
+                dropped = part.gc_missing(self._live_seqs_fn(partition))
+            except Exception:  # noqa: BLE001 — advisory reconcile:
+                dropped = 0    # a flaky store read must not block boot
+                log.warning("upsert GC reconcile failed for %s/p%d",
+                            self.table, partition, exc_info=True)
+            if dropped and self.metrics is not None:
+                from pinot_tpu.common.metrics import ServerMeter
+                self.metrics.meter(ServerMeter.UPSERT_KEYS_GCED,
+                                   self.table).mark(dropped)
+        return part
 
     def key_of(self, row: dict) -> Optional[tuple]:
         """Normalized primary-key tuple, or None when any key value is
@@ -560,15 +765,67 @@ class TableUpsertMetadataManager:
         return list(zip(*cols))
 
     def on_committed_segment(self, segment_name: str, segment) -> None:
-        """CONSUMING→ONLINE swap / cold-start load: attach (or fold) the
+        """CONSUMING→ONLINE swap / cold-start load: attach (or fold, or
+        — for a compacted rewrite whose doc ids shifted — remap) the
         committed segment's validDocIds and mark superseded rows."""
         try:
             llc = LLCSegmentName.parse(segment_name)
         except ValueError:
             return                         # non-LLC segment: not upserted
         part = self.partition(llc.partition)
+        before = part.remapped_segments
         segment.valid_doc_ids = part.attach_or_fold(
             llc.sequence, segment, lambda: self.segment_keys(segment))
+        if part.remapped_segments > before and self.metrics is not None:
+            from pinot_tpu.common.metrics import ServerMeter
+            self.metrics.meter(ServerMeter.UPSERT_SEGMENTS_REMAPPED,
+                               self.table).mark()
+
+    def gc_segment_record(self, segment_name: str) -> int:
+        """A segment's durable record left the cluster state (TTL
+        retention / table-wide delete): garbage-collect its key-map
+        entries so the map stops growing. No-op for partitions this
+        server never built metadata for."""
+        try:
+            llc = LLCSegmentName.parse(segment_name)
+        except ValueError:
+            return 0
+        with self._lock:
+            part = self._parts.get(llc.partition)
+        if part is None:
+            return 0
+        dropped = part.gc_segment(llc.sequence)
+        if dropped and self.metrics is not None:
+            from pinot_tpu.common.metrics import ServerMeter
+            self.metrics.meter(ServerMeter.UPSERT_KEYS_GCED,
+                               self.table).mark(dropped)
+        return dropped
+
+    def deadness_reports(self, skip_versions: Optional[Dict[str, int]]
+                         = None) -> Dict[str, dict]:
+        """segment name → deadness record for every partition/seq this
+        manager tracks (the obs-plane publication payload).
+        `skip_versions` (segment name → already-published version)
+        suppresses unchanged bitmaps before their lists are built."""
+        with self._lock:
+            parts = dict(self._parts)
+        out: Dict[str, dict] = {}
+        raw = raw_table(self.table)
+        for partition, part in parts.items():
+            per_seq = None
+            if skip_versions is not None:
+                per_seq = {}
+                for name, ver in skip_versions.items():
+                    try:
+                        llc = LLCSegmentName.parse(name)
+                    except ValueError:
+                        continue
+                    if llc.partition == partition:
+                        per_seq[llc.sequence] = ver
+            for seq, info in part.deadness_report(per_seq).items():
+                name = LLCSegmentName(raw, partition, seq).name
+                out[name] = dict(info, segment=name)
+        return out
 
     def key_map_size(self) -> int:
         with self._lock:

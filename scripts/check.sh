@@ -43,6 +43,17 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_selfheal.py \
     -q -p no:cacheprovider
 env JAX_PLATFORMS=cpu python scripts/selfheal_smoke.py
 
+echo "== compaction soak (background maintenance plane) =="
+# two-phase soak at 2x upsert churn: WITHOUT maintenance the key map
+# and masked-dead rows grow monotonically; WITH the minion plane
+# (deadness-driven compaction swaps + TTL retention with delayed
+# delete + upsert key GC) scan p99, committed docs and
+# upsertKeyMapSize stay flat — while a kill -9 of the minion
+# (compact.staged) and of the swap driver (compact.pre_swap) both
+# recover exactly from the durable intent records, with COUNT(*) ==
+# key-map size at every checkpoint; artifact: COMPACT_r09.json
+env JAX_PLATFORMS=cpu python scripts/compaction_smoke.py
+
 echo "== tenant isolation (ingress control) =="
 # two-tenant overload gate: an aggressor flooding at 10x its per-tenant
 # token-bucket quota must be throttled with typed 429s while the victim
@@ -72,11 +83,11 @@ echo "== tpulint (deep + protocol tiers) =="
 # durable writers, crash-point coverage (every durable mutation
 # splittable, every point armed by a test), the metrics exposition
 # contract, an exhaustive crash-interleaving model check of the
-# extracted lease/rebalance/takeover/upsert-seal/drain transition
-# systems against the written ROBUSTNESS.md invariants (state counts
-# logged; hitting --max-states is a finding, never silent), and a
-# drift gate against the committed protocol-model.json. On failure the
-# CLI prints a findings-diff summary (rule id, file:line,
-# fix-or-suppress guidance) — and for invariant violations, the
-# counterexample trace.
+# extracted lease/rebalance/takeover/upsert-seal/drain/compact-swap
+# transition systems against the written ROBUSTNESS.md invariants
+# (state counts logged; hitting --max-states is a finding, never
+# silent), and a drift gate against the committed protocol-model.json.
+# On failure the CLI prints a findings-diff summary (rule id,
+# file:line, fix-or-suppress guidance) — and for invariant violations,
+# the counterexample trace.
 exec "$(dirname "$0")/lint.sh" --deep --protocol

@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Compaction / retention / upsert-GC soak gate (ISSUE 11).
+
+Two phases over an embedded primary-key upsert cluster, identical
+workload: a rotating-key stream at 2x steady churn (every window
+publishes a fresh key cohort AND republishes the previous cohort with
+new values, so every row is overwritten once in its lifetime).
+
+- **Phase OFF** (no maintenance): masked-dead rows and the key map
+  grow monotonically — the degradation ISSUE 11 exists to stop.
+- **Phase ON** (maintenance each window: minion scheduler -> worker
+  compaction swaps -> TTL retention with delayed delete -> swap
+  janitor): scan p99, total committed docs and `upsertKeyMapSize` must
+  stay FLAT, while every checkpoint keeps the exact-dedup invariant
+  COUNT(*) == key-map size and zero query exceptions.
+
+Mid-run, phase ON additionally kill -9s the maintenance plane at the
+swap protocol's seeded crash points:
+
+- `compact.staged`   — the MINION dies mid rewrite+swap; the claim
+  lease expires, the queue requeues, a second worker converges.
+- `compact.pre_swap` — the SWAP DRIVER dies with the durable intent
+  record open (the controller-restart shape: in-memory state gone,
+  store survives); a FRESH SwapJanitor over the same durable store
+  resumes the swap. (True controller process kill -9 / restart is
+  crash_restart_smoke.py's gate; the recovery surface — resume from
+  the durable intent — is identical.)
+
+Writes COMPACT_ARTIFACT (default COMPACT_r09.json). Exit 0 when every
+gate holds. Env knobs:
+  COMPACT_SMOKE_WINDOWS   churn windows per phase   (default 8)
+  COMPACT_SMOKE_KEYS      fresh keys per window     (default 150)
+  COMPACT_ARTIFACT        artifact path             (default COMPACT_r09.json)
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+WINDOWS = int(os.environ.get("COMPACT_SMOKE_WINDOWS", "8"))
+KEYS = int(os.environ.get("COMPACT_SMOKE_KEYS", "150"))
+ARTIFACT = os.environ.get("COMPACT_ARTIFACT", "COMPACT_r09.json")
+RT_TABLE = "baseballStats_REALTIME"
+DAY_MS = 86_400_000
+RETENTION_DAYS = 3
+QUERIES_PER_CHECKPOINT = 30
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — still converging
+            pass
+        time.sleep(0.05)
+    print(f"FAIL: timed out waiting for {what}", file=sys.stderr)
+    return False
+
+
+def window_rows(w, keys=KEYS):
+    """Window w: fresh cohort K_w interleaved with a republish of
+    K_{w-1} under new values — 2x churn. The interleave matters: each
+    sealed segment ends up PARTIALLY dead (a compaction target), never
+    cleanly 100% dead (which would be retention's job alone). yearID
+    encodes the window so TTL retention expires whole cohorts."""
+    def row(k, gen):
+        return {"teamID": f"T{k % 7}", "league": "AL" if k % 2 else "NL",
+                "playerName": f"key_{k}", "position": ["P"],
+                "runs": 10 * gen + (k % 10), "hits": k % 5,
+                "average": 0.25, "salary": 100.0, "yearID": w + 1}
+    fresh = [row(k, 1) for k in range(w * keys, (w + 1) * keys)]
+    if w == 0:
+        return fresh
+    again = [row(k, 2) for k in range((w - 1) * keys, w * keys)]
+    return [r for pair in zip(fresh, again) for r in pair]
+
+
+def run_phase(maintain, crash_plan, log):
+    """One soak phase; returns its checkpoint series dict."""
+    from fixtures import make_schema
+    from test_upsert import upsert_rt_config
+
+    from pinot_tpu.common.faults import InjectedCrash, crash_points
+    from pinot_tpu.controller.compaction import SegmentSwapManager, \
+        SwapJanitor
+    from pinot_tpu.controller.periodic import RetentionManager
+    from pinot_tpu.minion import MinionWorker, TaskQueue
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    tag = "on" if maintain else "off"
+    topic = f"compact_smoke_{tag}"
+    stream = MemoryStream(topic, num_partitions=1)
+    registry.register_stream_factory(
+        f"mem_{topic}", MemoryStreamConsumerFactory(stream,
+                                                    batch_size=50))
+    work = tempfile.mkdtemp(prefix=f"compact_smoke_{tag}_")
+    cluster = EmbeddedCluster(work, num_servers=1,
+                              store_dir=os.path.join(work, "store"))
+    series = {"keyMap": [], "scanP99Ms": [], "committedDocs": [],
+              "countEqualsKeyMap": [], "queryErrors": 0,
+              "crashGates": []}
+    try:
+        cluster.add_schema(make_schema())
+        cfg = upsert_rt_config(f"mem_{topic}", topic, flush_rows=KEYS)
+        if maintain:
+            cfg.task_configs = {"UpsertCompactionTask": {
+                "invalidDocsThresholdPercent": "10",
+                "minInvalidDocs": "5"}}
+            cfg.segments_config.retention_time_unit = "DAYS"
+            cfg.segments_config.retention_time_value = RETENTION_DAYS
+        cluster.add_table(cfg)
+        mgr = cluster.controller.manager
+        rtdm = cluster.participants["Server_0"].realtime
+
+        class Clock:
+            t = 1000.0
+        queue = TaskQueue(mgr.store, clock=lambda: Clock.t,
+                          lease_s=60.0)
+        tm = cluster.controller.task_manager
+        tm.queue = queue
+        published = 0
+        for w in range(WINDOWS):
+            rows = window_rows(w)
+            for r in rows:
+                stream.publish(r, partition=0)
+            published += len(rows)
+
+            def consumed():
+                rdms = list(rtdm._consuming.values())
+                return rdms and max(r.offset for r in rdms) >= published
+            if not wait_for(consumed, 60, f"window {w} consumption"):
+                raise RuntimeError("consumption stalled")
+            if maintain:
+                crash_at = crash_plan.get(w)
+                if crash_at:
+                    # the crash gates need a swap to crash: wait for
+                    # the seal-time deadness publication to land for
+                    # at least one partially dead DONE segment
+                    from pinot_tpu.realtime.upsert import deadness_path
+
+                    def compactable():
+                        for s in mgr.segment_names(RT_TABLE):
+                            meta = mgr.segment_metadata(RT_TABLE, s) \
+                                or {}
+                            if meta.get("status") != "DONE":
+                                continue
+                            rec = mgr.store.get(
+                                deadness_path(RT_TABLE, s))
+                            if rec and 5 <= len(rec["invalid"]) < \
+                                    int(rec["numDocs"] or 0):
+                                return True
+                        return False
+                    if not wait_for(compactable, 30,
+                                    "a compactable segment"):
+                        raise RuntimeError(
+                            f"window {w}: no compactable segment for "
+                            f"the {crash_at} gate")
+                tm.schedule_tasks()
+                worker = MinionWorker(
+                    mgr, instance_id=f"Minion_{tag}_{w}",
+                    work_dir=os.path.join(work, f"minion_{w}"))
+                worker.queue = queue
+                if crash_at:
+                    crash_points.arm(crash_at)
+                    try:
+                        worker.drain()
+                        gate = f"{crash_at}: NEVER FIRED"
+                    except InjectedCrash:
+                        # kill -9 mid-swap: recover with a FRESH
+                        # janitor over the durable store (restarted
+                        # controller shape; the driver is provably
+                        # dead so the live-driver age gate is waived),
+                        # then lease-requeue the died-with-the-minion
+                        # claim for worker #2
+                        janitor = SwapJanitor(
+                            SegmentSwapManager(mgr),
+                            min_intent_age_s=0)
+                        janitor.run(mgr)
+                        Clock.t += 61
+                        queue.requeue_expired()
+                        worker2 = MinionWorker(
+                            mgr, instance_id=f"Minion_{tag}_{w}b",
+                            work_dir=os.path.join(work,
+                                                  f"minion_{w}b"))
+                        worker2.queue = queue
+                        worker2.drain()
+                        open_intents = cluster.controller.swaps \
+                            .open_intents(RT_TABLE)
+                        gate = (f"{crash_at}: recovered, "
+                                f"{len(open_intents)} open intent(s)")
+                        if open_intents:
+                            raise RuntimeError(
+                                f"unresolved intents {open_intents}")
+                    finally:
+                        crash_points.clear()
+                    series["crashGates"].append(gate)
+                    log(f"  window {w}: {gate}")
+                else:
+                    worker.drain()
+                RetentionManager(
+                    now_ms_fn=lambda: (w + 1) * DAY_MS + 1).run(mgr)
+                SwapJanitor(cluster.controller.swaps).run(mgr)
+
+            # checkpoint: scan latency, key-map size, committed docs,
+            # and the exact-dedup invariant COUNT(*) == key map
+            lat = []
+            for i in range(QUERIES_PER_CHECKPOINT):
+                q = ("SELECT COUNT(*), SUM(runs) FROM baseballStats"
+                     if i % 2 else
+                     "SELECT COUNT(*) FROM baseballStats "
+                     "WHERE league = 'AL'")
+                t0 = time.perf_counter()
+                resp = cluster.query(q)
+                lat.append((time.perf_counter() - t0) * 1e3)
+                if resp.exceptions:
+                    series["queryErrors"] += 1
+            lat.sort()
+            p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+            um = rtdm.upsert_manager(RT_TABLE)
+            keymap = um.key_map_size()
+            count = int(cluster.query(
+                "SELECT COUNT(*) FROM baseballStats")
+                .aggregation_results[0].value)
+            docs = sum(int((mgr.segment_metadata(RT_TABLE, s) or {}
+                            ).get("totalDocs") or 0)
+                       for s in mgr.segment_names(RT_TABLE))
+            series["keyMap"].append(keymap)
+            series["scanP99Ms"].append(round(p99, 2))
+            series["committedDocs"].append(docs)
+            series["countEqualsKeyMap"].append(count == keymap)
+            log(f"  [{tag}] window {w}: keyMap={keymap} count={count} "
+                f"docs={docs} scanP99={p99:.1f}ms")
+        return series
+    finally:
+        cluster.stop()
+
+
+def main() -> int:
+    def log(msg):
+        print(msg, flush=True)
+
+    log(f"== compaction soak: {WINDOWS} windows x {KEYS} keys, "
+        "2x churn ==")
+    log("phase OFF (no maintenance — the degradation baseline)")
+    off = run_phase(False, {}, log)
+    log("phase ON (compaction + retention + GC each window, "
+        "kill -9 mid-swap twice)")
+    on = run_phase(True, {WINDOWS // 2: "compact.staged",
+                          WINDOWS // 2 + 1: "compact.pre_swap"}, log)
+
+    # post-warmup reference: the live set reaches steady state once
+    # retention holds (retention window + 1) cohorts, at window 3
+    mid = min(3, WINDOWS - 2)
+    gates = {
+        # the problem exists: without maintenance the key map and the
+        # committed-doc count grow monotonically with churn
+        "offKeyMapGrows": off["keyMap"][-1] >= off["keyMap"][mid] +
+        (WINDOWS - 1 - mid) * KEYS,
+        "offDocsGrow": off["committedDocs"][-1] >
+        1.5 * max(off["committedDocs"][mid], 1),
+        # the fix holds: maintenance keeps both flat
+        "onKeyMapFlat": on["keyMap"][-1] <=
+        1.25 * max(on["keyMap"][mid], 1),
+        "onDocsFlat": on["committedDocs"][-1] <=
+        1.35 * max(on["committedDocs"][mid], 1),
+        "onKeyMapBelowOff": on["keyMap"][-1] < 0.7 * off["keyMap"][-1],
+        # scan latency stays flat (generous CI-noise bound: the OFF
+        # phase's tail keeps growing with dead rows, ON must not)
+        "onScanP99Flat": on["scanP99Ms"][-1] <=
+        max(2.0 * on["scanP99Ms"][mid], on["scanP99Ms"][mid] + 25.0),
+        # exactness all the way through, including across the kill -9s
+        "onExactDedupEveryCheckpoint": all(on["countEqualsKeyMap"]),
+        "onZeroQueryErrors": on["queryErrors"] == 0,
+        "bothCrashGatesRecovered":
+            len(on["crashGates"]) == 2 and
+            all("recovered" in g for g in on["crashGates"]),
+    }
+    artifact = {
+        "suite": "compaction_soak",
+        "windows": WINDOWS, "keysPerWindow": KEYS,
+        "churn": "2x (every row overwritten once)",
+        "retentionDays": RETENTION_DAYS,
+        "phaseOff": off, "phaseOn": on,
+        "gates": gates,
+        "pass": all(gates.values()),
+    }
+    with open(ARTIFACT, "w") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    log(f"gates: {json.dumps(gates, indent=1)}")
+    log(f"artifact: {ARTIFACT}")
+    if not artifact["pass"]:
+        log("FAIL: compaction soak gates not met")
+        return 1
+    log("PASS: flat scan p99 + flat key map under 2x churn with "
+        "maintenance on; monotonic growth with it off; kill -9 "
+        "mid-swap recovered exactly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -373,7 +373,7 @@ def test_live_tree_metrics_contract_clean():
 def test_live_protocols_hold_exhaustively():
     result = protocol.check_protocols()
     assert result.problems == []
-    assert len(result.reports) == 5
+    assert len(result.reports) == 6
     for report in result.reports:
         assert not report.truncated, report.system
         assert report.states > 0
@@ -577,6 +577,132 @@ class DistributedServer:
     assert [v.invariant for v in report.violations] == \
         ["drain-errorless"]
     assert "query_routed_by_ev" in report.violations[0].render_trace()
+
+
+_COMPACT_FIXTURE = '''
+class SegmentSwapManager:
+    def swap_segments(self, table, olds, new_dir):
+        self.manager.fs.copy(new_dir, stage)
+        verify_segment(stage, meta.crc)
+        crash_points.hit("compact.staged")
+        self.store.set(intent_path, {})
+        self.manager.fs.move(canonical, trash_path(canonical, now))
+        self.manager.fs.move(stage, canonical)
+        self._write_record(table, meta, olds, inplace)
+        crash_points.hit("compact.pre_swap")
+        self._swap_ideal_state(table, olds, new_name, inplace)
+        crash_points.hit("compact.pre_delete")
+        self._tombstone_olds(table, olds, new_name)
+        self.store.remove(intent_path)
+
+    def _swap_ideal_state(self, table, olds, new_name, inplace):
+        if inplace:
+            self.manager.reload_segment(table, new_name)
+            return
+
+        def drop_olds(segments):
+            for old in olds:
+                segments[old] = {i: DROPPED for i in segments[old]}
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, drop_olds)
+
+        def prune_olds(segments):
+            for old in olds:
+                segments.pop(old, None)
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, prune_olds)
+
+        def add_new(segments):
+            segments[new_name] = {i: ONLINE for i in assigned}
+            return segments
+
+        self.manager.coordinator.update_ideal_state(table, add_new)
+'''
+
+
+def test_compact_swap_extraction_shape():
+    ex = protocol.extract_compact(
+        {protocol.COMPACT_PATH: _COMPACT_FIXTURE})
+    assert ex.problems == []
+    order = ex.step_order()
+    # the serving swap is spliced into its fold order in place
+    assert order.index("drop_olds_fold") < order.index("add_new_fold")
+    assert order.index("intent_write") < order.index("publish_new")
+    assert order.index("publish_new") < order.index("record_write")
+    assert "crash:compact.staged" in order
+    assert "crash:compact.pre_swap" in order
+    assert "crash:compact.pre_delete" in order
+    assert ex.flags == {"intent_logged": True, "staged_verify": True,
+                        "inplace_reloads": True, "delayed_delete": True}
+    # and the well-formed protocol explores clean
+    result = protocol.check_protocols(
+        sources={protocol.COMPACT_PATH: _COMPACT_FIXTURE},
+        only=["compact-swap"])
+    (report,) = result.reports
+    assert not report.truncated and report.violations == []
+
+
+def test_compact_fold_reorder_yields_double_serve_counterexample():
+    """The seeded swap-reorder bug: the new segment enters the ideal
+    state BEFORE the olds leave it — a query routed in the window
+    counts every merged row twice. The checker must produce the
+    ordered trace."""
+    reordered = _COMPACT_FIXTURE.replace(
+        "self.manager.coordinator.update_ideal_state(table, drop_olds)",
+        "self.manager.coordinator.update_ideal_state(table, add_new)",
+        1)
+    tail = reordered.rfind(
+        "self.manager.coordinator.update_ideal_state(table, add_new)")
+    reordered = (reordered[:tail] +
+                 "self.manager.coordinator.update_ideal_state(table, "
+                 "drop_olds)" + reordered[tail + len(
+                     "self.manager.coordinator.update_ideal_state("
+                     "table, add_new)"):])
+    result = protocol.check_protocols(
+        sources={protocol.COMPACT_PATH: reordered},
+        only=["compact-swap"])
+    assert result.problems == []
+    (report,) = result.reports
+    invariants = {v.invariant for v in report.violations}
+    assert "no-double-serve" in invariants, invariants
+    (double,) = [v for v in report.violations
+                 if v.invariant == "no-double-serve"]
+    trace = double.render_trace()
+    assert "add_new_fold" in trace
+    assert "env.query_routed_by_view" in trace
+
+
+def test_compact_delete_before_swap_yields_counterexample():
+    """The seeded delete-before-swap bug: old artifacts are tombstoned
+    while still routed — a replica restart mid-swap cannot reload what
+    it serves."""
+    bad = _COMPACT_FIXTURE.replace(
+        '''        crash_points.hit("compact.pre_swap")
+        self._swap_ideal_state(table, olds, new_name, inplace)''',
+        '''        crash_points.hit("compact.pre_swap")
+        self._tombstone_olds(table, olds, new_name)
+        self._swap_ideal_state(table, olds, new_name, inplace)''', 1)
+    result = protocol.check_protocols(
+        sources={protocol.COMPACT_PATH: bad}, only=["compact-swap"])
+    assert result.problems == []
+    (report,) = result.reports
+    invariants = [v.invariant for v in report.violations]
+    assert "routed-implies-artifact" in invariants, invariants
+    (v,) = [x for x in report.violations
+            if x.invariant == "routed-implies-artifact"]
+    assert "tombstone_olds" in v.render_trace()
+
+
+def test_compact_missing_intent_is_a_shape_problem():
+    """Removing the durable intent write breaks the recovery story —
+    the extractor must fail the shape contract loudly."""
+    no_intent = _COMPACT_FIXTURE.replace(
+        "        self.store.set(intent_path, {})\n", "")
+    ex = protocol.extract_compact(
+        {protocol.COMPACT_PATH: no_intent})
+    assert any("intent_write" in p for p in ex.problems), ex.problems
 
 
 def test_model_checker_determinism():

@@ -1,0 +1,808 @@
+"""Minion-plane background maintenance: compaction, merge, retention,
+upsert GC, and the crash-safe swap protocol (ISSUE 11).
+
+Five tiers:
+
+1. **Task-queue leases** — a kill -9'd minion's IN_PROGRESS claim
+   requeues on lease expiry (injectable clock), bounded attempts go
+   ERROR, completion is fenced against requeued claims, concurrent
+   claims have a single winner.
+2. **Upsert remap/GC units** — a compacted artifact's shifted doc ids
+   re-point the key map (attach_or_fold remap path), persistence makes
+   the remap crash-safe, retention-deleted segments' keys leave the
+   map.
+3. **End-to-end compaction** — deadness published at seal drives the
+   generator; the worker rewrites and swaps; COUNT/SUM stay exactly
+   equal to the host oracle across the swap and dedup keeps working.
+4. **Kill -9 at every swap crash point** — compact.staged /
+   compact.pre_swap / compact.pre_delete: after recovery (janitor
+   resume + task requeue) results match the oracle exactly, and no
+   healthy artifact is CRC-quarantined.
+5. **Merge + retention + scrubber coordination** — small segments fold
+   into one through the same swap protocol; retention tombstones with
+   grace; the scrubber respects open swap intents and reclaims
+   tombstones only past grace.
+"""
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from fixtures import make_columns, make_schema, make_table_config
+
+from pinot_tpu.common.faults import InjectedCrash, crash_points
+from pinot_tpu.common.metrics import MetricsRegistry, MinionMeter
+from pinot_tpu.common.table_config import UpsertConfig
+from pinot_tpu.controller.compaction import (SegmentSwapManager,
+                                             SwapJanitor, TRASH_MARKER)
+from pinot_tpu.controller.manager import InvalidTableConfigError
+from pinot_tpu.controller.periodic import (RetentionManager,
+                                           SegmentIntegrityChecker)
+from pinot_tpu.controller.property_store import PropertyStore
+from pinot_tpu.minion import (COMPLETED, ERROR, GENERATED, IN_PROGRESS,
+                              UPSERT_COMPACTION_TASK, MinionWorker,
+                              PinotTaskConfig, PinotTaskManager,
+                              TaskQueue)
+from pinot_tpu.minion.tasks import SEGMENT_NAME_KEY, TABLE_NAME_KEY
+from pinot_tpu.realtime.upsert import (PartitionUpsertMetadata,
+                                       deadness_path)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+from test_realtime import make_rows, rt_config
+from test_upsert import (RT_TABLE, _register, count_and_sum,
+                         latest_by_key, upsert_rt_config, wait_until)
+
+
+@pytest.fixture(autouse=True)
+def _clean_crash_points():
+    crash_points.clear()
+    yield
+    crash_points.clear()
+
+
+@pytest.fixture
+def work_dir():
+    return tempfile.mkdtemp()
+
+
+# ---------------------------------------------------------------------------
+# tier 1: task-queue claim leases
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _task(seg="s0"):
+    return PinotTaskConfig("PurgeTask", {TABLE_NAME_KEY: "t_OFFLINE",
+                                         SEGMENT_NAME_KEY: seg})
+
+
+def test_lease_expiry_requeues_then_error_after_attempts():
+    clock = FakeClock()
+    metrics = MetricsRegistry("minion")
+    q = TaskQueue(PropertyStore(), clock=clock, lease_s=60.0,
+                  max_attempts=2, metrics=metrics)
+    t = _task()
+    q.submit(t)
+    assert q.claim("w1", ["PurgeTask"]) is not None
+    # lease still live: nothing to requeue
+    assert q.requeue_expired() == []
+    clock.t += 61
+    assert q.requeue_expired() == [t.task_id]
+    assert q.task_states("PurgeTask")[t.task_id] == GENERATED
+    assert metrics.meter(MinionMeter.TASK_REQUEUES).count == 1
+    # second claim, second expiry: attempts exhausted -> ERROR
+    assert q.claim("w2", ["PurgeTask"]) is not None
+    clock.t += 61
+    assert q.requeue_expired() == [t.task_id]
+    rec = q.store.get(f"/TASKS/PurgeTask/{t.task_id}")
+    assert rec["state"] == ERROR and "lease expired" in rec["info"]
+    assert metrics.meter(
+        MinionMeter.TASK_ATTEMPTS_EXHAUSTED).count == 1
+
+
+def test_complete_after_requeue_is_rejected():
+    clock = FakeClock()
+    q = TaskQueue(PropertyStore(), clock=clock, lease_s=60.0)
+    t = _task()
+    q.submit(t)
+    assert q.claim("w1", ["PurgeTask"]) is not None
+    clock.t += 61
+    q.requeue_expired()
+    assert q.claim("w2", ["PurgeTask"]) is not None
+    # the zombie's completion must not clobber w2's claim
+    assert q.finish(t, COMPLETED, worker_id="w1") is False
+    assert q.task_states("PurgeTask")[t.task_id] == IN_PROGRESS
+    # the live claimant's completion lands
+    assert q.finish(t, COMPLETED, worker_id="w2") is True
+    assert q.task_states("PurgeTask")[t.task_id] == COMPLETED
+
+
+def test_concurrent_claims_have_single_winner():
+    q = TaskQueue(PropertyStore())
+    t = _task()
+    q.submit(t)
+    winners = []
+    barrier = threading.Barrier(8)
+
+    def contend(i):
+        barrier.wait()
+        got = q.claim(f"w{i}", ["PurgeTask"])
+        if got is not None:
+            winners.append(i)
+
+    threads = [threading.Thread(target=contend, args=(i,))
+               for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(winners) == 1
+    rec = q.store.get(f"/TASKS/PurgeTask/{t.task_id}")
+    assert rec["worker"] == f"w{winners[0]}"
+    assert rec["attempts"] == 1
+
+
+def test_worker_crash_mid_execute_requeues_then_second_converges(
+        work_dir):
+    """kill -9 mid-task: the worker dies (InjectedCrash propagates, no
+    ERROR write), the claim lease expires, the queue requeues, and a
+    second worker converges the task."""
+    from pinot_tpu.minion.executors import PinotTaskExecutor, \
+        TaskExecutorRegistry
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        d = os.path.join(work_dir, "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "crash_seg").build(make_columns(500, seed=1), d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+
+        ran = {"n": 0}
+
+        class DieOnce(PinotTaskExecutor):
+            task_type = "PurgeTask"
+
+            def execute(self, task, schema, table_config, input_dirs,
+                        work_dir, context):
+                ran["n"] += 1
+                if ran["n"] == 1:
+                    raise InjectedCrash("minion kill -9")
+                from pinot_tpu.minion.executors import \
+                    SegmentConversionResult
+                return SegmentConversionResult(input_dirs[0],
+                                               "crash_seg")
+
+        registry = TaskExecutorRegistry()
+        registry.register(DieOnce())
+        clock = FakeClock()
+        mgr = cluster.controller.manager
+        q = TaskQueue(mgr.store, clock=clock, lease_s=60.0)
+        t = PinotTaskConfig("PurgeTask", {
+            TABLE_NAME_KEY: "baseballStats_OFFLINE",
+            SEGMENT_NAME_KEY: "crash_seg"})
+        q.submit(t)
+        w1 = MinionWorker(mgr, instance_id="Minion_1",
+                          registry=registry,
+                          work_dir=os.path.join(work_dir, "m1"))
+        w1.queue = q
+        with pytest.raises(InjectedCrash):
+            w1.run_one()
+        # the death wrote NO terminal state
+        assert q.task_states("PurgeTask")[t.task_id] == IN_PROGRESS
+        clock.t += 61
+        assert q.requeue_expired() == [t.task_id]
+        w2 = MinionWorker(mgr, instance_id="Minion_2",
+                          registry=registry,
+                          work_dir=os.path.join(work_dir, "m2"))
+        w2.queue = q
+        assert w2.run_one() == t.task_id
+        assert q.task_states("PurgeTask")[t.task_id] == COMPLETED
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier 2: upsert remap / GC units
+# ---------------------------------------------------------------------------
+
+
+class _Seg:
+    def __init__(self, n):
+        self.num_docs = n
+
+
+def _kd(keys_docs):
+    return [((k,), d) for k, d in keys_docs]
+
+
+def test_attach_or_fold_remaps_compacted_artifact(work_dir):
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    # seg 0: a@0 b@1 c@2 a@3  -> a@0 dead; seal
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("c", 2), ("a", 3)]), 4)
+    p.seal(0, 4, 4)
+    # seg 1 supersedes b -> b@1 (seg 0) dead too
+    p.apply_batch(1, _kd([("b", 0)]), 5)
+    assert list(p.register_consuming(0).invalid_ids(4)) == [0, 1]
+    # the compacted artifact dropped docs {0, 1}: surviving order c, a
+    vd = p.attach_or_fold(0, _Seg(2), lambda: [("c",), ("a",)])
+    assert p._map[("c",)] == (0, 0)
+    assert p._map[("a",)] == (0, 1)
+    assert p._map[("b",)] == (1, 0)          # newer seg keeps b
+    assert list(vd.invalid_ids(2)) == []     # both survivors live
+    assert p.remapped_segments == 1
+    # a key superseded AFTER compaction masks the compacted row
+    p.apply_batch(1, _kd([("c", 1)]), 6)
+    assert list(vd.invalid_ids(2)) == [0]
+    # idempotent: re-running the remap converges to the same state
+    vd2 = p.attach_or_fold(0, _Seg(2), lambda: [("c",), ("a",)])
+    assert vd2 is not vd or True
+    assert p._map[("c",)] == (1, 1)
+    assert p._map[("a",)] == (0, 1)
+    p.close()
+    # persistence: a fresh instance attaches (no re-remap needed)
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r._covered[0] == 2
+    folds = []
+    r.attach_or_fold(0, _Seg(2), lambda: folds.append(1) or
+                     [("c",), ("a",)])
+    assert folds == []                        # attached, not re-derived
+    assert r._map[("a",)] == (0, 1)
+    r.close()
+
+
+def test_remap_crash_point_then_restart_converges(work_dir):
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
+    p.seal(0, 3, 3)
+    crash_points.arm("upsert.compact_snapshot")
+    with pytest.raises(InjectedCrash):
+        p.attach_or_fold(0, _Seg(2), lambda: [("b",), ("a",)])
+    p.close()
+    # restart over the same durable state: the old snapshot still says
+    # 3 covered docs, so the remap re-derives and persists this time
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    vd = r.attach_or_fold(0, _Seg(2), lambda: [("b",), ("a",)])
+    assert r._map[("a",)] == (0, 1)
+    assert r._map[("b",)] == (0, 0)
+    assert list(vd.invalid_ids(2)) == []
+    r.close()
+    # and the persisted remap attaches cleanly on the NEXT restart
+    r2 = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r2._covered[0] == 2
+    r2.close()
+
+
+def test_gc_segment_drops_keys_bitmap_and_sidecar(work_dir):
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
+    p.seal(0, 3, 3)
+    p.apply_batch(1, _kd([("c", 0)]), 4)
+    sidecar = p._sidecar_path(0)
+    assert os.path.exists(sidecar)
+    assert p.key_map_size() == 3
+    dropped = p.gc_segment(0)
+    assert dropped == 2
+    assert p.key_map_size() == 1              # only c remains
+    assert 0 not in p._valid and 0 not in p._covered
+    assert not os.path.exists(sidecar)
+    assert p.gced_keys == 2
+    # the shrunken map is durable: a restart does NOT resurrect the
+    # dropped entries from the pre-GC snapshot
+    p.close()
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r.key_map_size() == 1
+    r.close()
+
+
+def test_gc_crash_point_leaves_idempotent_rerun(work_dir):
+    """Dying between the in-memory drop and the snapshot persist
+    (upsert.gc_snapshot) resurrects the entries on restart — a bounded
+    metric skew, never a correctness loss — and a re-run of the GC
+    converges."""
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
+    p.seal(0, 3, 3)
+    crash_points.arm("upsert.gc_snapshot")
+    with pytest.raises(InjectedCrash):
+        p.gc_segment(0)
+    p.close()
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r.key_map_size() == 2          # zombies: snapshot predates gc
+    assert r.gc_segment(0) == 2           # idempotent re-run converges
+    r.close()
+    r2 = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r2.key_map_size() == 0
+    r2.close()
+
+
+# ---------------------------------------------------------------------------
+# tier 3 + 4: end-to-end compaction, kill -9 at every swap crash point
+# ---------------------------------------------------------------------------
+
+
+COMPACT_CFG = {"invalidDocsThresholdPercent": "10", "minInvalidDocs": "5"}
+
+
+def _compaction_cluster(work_dir, topic, rows_a=400, flush_rows=300):
+    """Upsert cluster where the sealed segments carry dead rows:
+    publish `rows_a` rows, then republish EVERY OTHER one (new values)
+    so the sealed segments end up partially — never fully — superseded
+    (a fully dead segment is retention's job, not compaction's).
+    Returns (cluster, stream, all_rows)."""
+    stream = _register(topic)
+    cluster = EmbeddedCluster(
+        work_dir, num_servers=1,
+        store_dir=os.path.join(work_dir, "store"))
+    cluster.add_schema(make_schema())
+    cfg = upsert_rt_config(f"mem_{topic}", topic, flush_rows=flush_rows)
+    cfg.task_configs = {UPSERT_COMPACTION_TASK: dict(COMPACT_CFG)}
+    cluster.add_table(cfg)
+    rows = make_rows(rows_a, seed=7)
+    for r in rows:
+        stream.publish(r, partition=0)
+    again = [dict(r, runs=r["runs"] + 1000) for r in rows[::2]]
+    for r in again:
+        stream.publish(r, partition=0)
+    return cluster, stream, rows + again
+
+
+def _oracle(rows):
+    latest = latest_by_key(rows)
+    return len(latest), float(sum(r["runs"] for r in latest.values()))
+
+
+def _wait_deadness(cluster, segment, min_invalid=5, timeout=40):
+    store = cluster.controller.manager.store
+
+    def ready():
+        meta = cluster.controller.manager.segment_metadata(RT_TABLE,
+                                                           segment)
+        if not meta or meta.get("status") != "DONE":
+            return False
+        rec = store.get(deadness_path(RT_TABLE, segment))
+        return rec is not None and len(rec["invalid"]) >= min_invalid
+    return wait_until(ready, timeout=timeout)
+
+
+def test_compaction_end_to_end_holds_exact_parity(work_dir):
+    cluster, stream, rows = _compaction_cluster(work_dir, "topic_cmp_e2e")
+    try:
+        exp = _oracle(rows)
+        assert wait_until(lambda: count_and_sum(cluster) == exp,
+                          timeout=60), (count_and_sum(cluster), exp)
+        seg0 = "baseballStats__0__0"
+        assert _wait_deadness(cluster, seg0), "deadness never published"
+        mgr = cluster.controller.manager
+        before_docs = int(mgr.segment_metadata(RT_TABLE,
+                                               seg0)["totalDocs"])
+        tm = cluster.controller.task_manager
+        ids = tm.schedule_tasks()
+        assert any(i.startswith(f"Task_{UPSERT_COMPACTION_TASK}")
+                   for i in ids), ids
+        # scheduling again must not duplicate the open task
+        assert not any(
+            i.startswith(f"Task_{UPSERT_COMPACTION_TASK}")
+            for i in tm.schedule_tasks())
+        worker = MinionWorker(mgr, work_dir=os.path.join(work_dir, "mw"))
+        done = worker.drain()
+        assert done, "worker ran no tasks"
+        states = worker.queue.task_states(UPSERT_COMPACTION_TASK)
+        assert all(s == COMPLETED for s in states.values()), states
+
+        # the swap shrank the artifact without changing ANY result
+        after = int(mgr.segment_metadata(RT_TABLE, seg0)["totalDocs"])
+        assert after < before_docs
+        assert count_and_sum(cluster) == exp
+        # the old artifact is a delayed-delete tombstone, not gone
+        canonical = mgr.canonical_artifact_path(RT_TABLE, seg0)
+        parent = os.path.dirname(canonical)
+        assert any(TRASH_MARKER in n for n in os.listdir(parent))
+        # stale deadness was cleared at swap
+        assert mgr.store.get(deadness_path(RT_TABLE, seg0)) is None
+        # dedup still works across the compacted segment: supersede a
+        # key whose winner now lives in the compacted artifact
+        more = [dict(rows[0], runs=5)]
+        for r in more:
+            stream.publish(r, partition=0)
+        exp2 = _oracle(rows + more)
+        assert wait_until(lambda: count_and_sum(cluster) == exp2,
+                          timeout=30), (count_and_sum(cluster), exp2)
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.parametrize("point", ["compact.staged", "compact.pre_swap",
+                                   "compact.pre_delete"])
+def test_swap_crash_point_recovery_exact_parity(work_dir, point):
+    """kill -9 the swap at each seeded crash point: queries keep exact
+    COUNT/SUM parity with the host oracle through the crash, recovery
+    (janitor resume + task requeue) converges to the compacted state,
+    and the scrubber never quarantines a healthy artifact."""
+    cluster, stream, rows = _compaction_cluster(
+        work_dir, f"topic_cmp_{point.replace('.', '_')}")
+    try:
+        exp = _oracle(rows)
+        assert wait_until(lambda: count_and_sum(cluster) == exp,
+                          timeout=60), (count_and_sum(cluster), exp)
+        seg0 = "baseballStats__0__0"
+        assert _wait_deadness(cluster, seg0), "deadness never published"
+        mgr = cluster.controller.manager
+        tm = cluster.controller.task_manager
+        clock = FakeClock()
+        queue = TaskQueue(mgr.store, clock=clock, lease_s=60.0)
+        tm.queue = queue
+        assert tm.schedule_tasks()
+        worker = MinionWorker(mgr, instance_id="Minion_A",
+                              work_dir=os.path.join(work_dir, "mA"))
+        worker.queue = queue
+        crash_points.arm(point)
+        with pytest.raises(InjectedCrash):
+            worker.drain()
+        # mid-crash: every query still exact (old or new world, never
+        # a torn mix)
+        assert count_and_sum(cluster) == exp
+        # the scrubber must not quarantine anything mid-swap (intent
+        # open or staging young)
+        checker = SegmentIntegrityChecker()
+        checker.run(mgr)
+        assert not any(e["corrupt"] or e["missingArtifact"]
+                       for e in checker.last_report.values()), \
+            checker.last_report
+        # recovery: janitor resumes from the durable intent (the
+        # driver is provably dead here, so the live-driver age gate is
+        # waived), the task queue requeues the died-with-the-minion
+        # claim, a second worker converges whatever remains
+        janitor = SwapJanitor(cluster.controller.swaps,
+                              min_intent_age_s=0)
+        janitor.run(mgr)
+        clock.t += 61
+        queue.requeue_expired()
+        worker2 = MinionWorker(mgr, instance_id="Minion_B",
+                               work_dir=os.path.join(work_dir, "mB"))
+        worker2.queue = queue
+        worker2.drain()
+        assert count_and_sum(cluster) == exp
+        # converged: compacted artifact served, no open intents
+        assert cluster.controller.swaps.open_intents(RT_TABLE) == []
+        states = queue.task_states(UPSERT_COMPACTION_TASK)
+        assert all(s in (COMPLETED, GENERATED) for s in
+                   states.values()), states
+        meta = mgr.segment_metadata(RT_TABLE, seg0)
+        if point != "compact.staged":
+            # past `staged` the rewrite is durable: recovery rolls
+            # FORWARD, so the record carries the compacted artifact
+            assert meta.get("swappedFrom") == [seg0], meta
+        checker.run(mgr)
+        assert not any(e["corrupt"] for e in
+                       checker.last_report.values()), checker.last_report
+        # dedup still exact after recovery
+        more = [dict(rows[0], runs=5)]
+        for r in more:
+            stream.publish(r, partition=0)
+        exp2 = _oracle(rows + more)
+        assert wait_until(lambda: count_and_sum(cluster) == exp2,
+                          timeout=30), (count_and_sum(cluster), exp2)
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier 5: merge, retention, scrubber coordination, validation
+# ---------------------------------------------------------------------------
+
+
+def test_merge_end_to_end_replaces_inputs_exactly(work_dir):
+    cluster = EmbeddedCluster(work_dir, num_servers=2)
+    try:
+        cluster.add_schema(make_schema())
+        cfg = make_table_config()
+        cfg.task_configs = {"MergeRollupTask": {
+            "smallSegmentDocsThreshold": "100000",
+            "maxNumSegmentsPerTask": "4"}}
+        cluster.add_table(cfg)
+        for i in range(3):
+            d = os.path.join(work_dir, f"small_{i}")
+            SegmentCreator(make_schema(), make_table_config(),
+                           segment_name=f"small_{i}").build(
+                make_columns(400, seed=10 + i), d)
+            cluster.upload_segment("baseballStats_OFFLINE", d)
+        resp = cluster.query(
+            "SELECT COUNT(*), SUM(runs) FROM baseballStats")
+        exp = (int(resp.aggregation_results[0].value),
+               float(resp.aggregation_results[1].value))
+        assert exp[0] == 1200
+        mgr = cluster.controller.manager
+        tm = cluster.controller.task_manager
+        ids = tm.schedule_tasks()
+        assert len(ids) == 1, ids
+        worker = MinionWorker(mgr, work_dir=os.path.join(work_dir, "mw"))
+        worker.drain()
+        states = worker.queue.task_states("MergeRollupTask")
+        assert all(s == COMPLETED for s in states.values()), states
+        names = mgr.segment_names("baseballStats_OFFLINE")
+        assert len(names) == 1 and names[0].startswith("merged_"), names
+        resp = cluster.query(
+            "SELECT COUNT(*), SUM(runs) FROM baseballStats")
+        got = (int(resp.aggregation_results[0].value),
+               float(resp.aggregation_results[1].value))
+        assert got == exp
+        # inputs were tombstoned (delayed delete), not destroyed
+        tdir = os.path.join(mgr.deep_store_dir, "baseballStats_OFFLINE")
+        trash = [n for n in os.listdir(tdir) if TRASH_MARKER in n]
+        assert len(trash) == 3, sorted(os.listdir(tdir))
+        # scheduling again: the merged segment is not re-merged (one
+        # segment is never a merge group)
+        assert tm.schedule_tasks() == []
+    finally:
+        cluster.stop()
+
+
+def test_retention_tombstones_expired_and_gcs_upsert_keys(work_dir):
+    topic = "topic_retention_gc"
+    stream = _register(topic)
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cfg = upsert_rt_config(f"mem_{topic}", topic, flush_rows=300)
+        cfg.segments_config.retention_time_unit = "DAYS"
+        cfg.segments_config.retention_time_value = 5
+        cluster.add_table(cfg)
+        rows = make_rows(400, seed=3)
+        for r in rows:
+            stream.publish(r, partition=0)
+        exp = _oracle(rows)
+        assert wait_until(lambda: count_and_sum(cluster) == exp,
+                          timeout=60), (count_and_sum(cluster), exp)
+        mgr = cluster.controller.manager
+        seg0 = "baseballStats__0__0"
+        assert wait_until(lambda: (mgr.segment_metadata(RT_TABLE, seg0)
+                                   or {}).get("status") == "DONE",
+                          timeout=30)
+        part = cluster.participants["Server_0"].realtime \
+            .upsert_manager(RT_TABLE).partition(0)
+        keys_before = part.key_map_size()
+        seg0_keys = sum(1 for loc in part._map.values() if loc[0] == 0)
+        assert seg0_keys > 0
+        # far-future clock: everything committed is past retention,
+        # but the latest sequence is protected (restart-offset anchor)
+        far = int((time.time() + 10 * 86_400) * 1e3)
+        RetentionManager(now_ms_fn=lambda: far).run(mgr)
+        assert mgr.segment_metadata(RT_TABLE, seg0) is None
+        # the artifact became a tombstone, not an immediate delete
+        tdir = os.path.join(mgr.deep_store_dir, RT_TABLE)
+        assert any(n.startswith(seg0 + TRASH_MARKER)
+                   for n in os.listdir(tdir))
+        # server-side upsert GC dropped the expired segment's keys
+        assert wait_until(
+            lambda: part.key_map_size() == keys_before - seg0_keys,
+            timeout=10), (part.key_map_size(), keys_before, seg0_keys)
+        # the consuming partition survived: new rows still ingest
+        more = make_rows(50, seed=99)
+        for r in more:
+            stream.publish(r, partition=0)
+        assert wait_until(
+            lambda: count_and_sum(cluster)[0] > 0, timeout=30)
+    finally:
+        cluster.stop()
+
+
+def test_scrubber_respects_staging_tombstones_and_intents(work_dir):
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        d = os.path.join(work_dir, "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "sc_seg").build(make_columns(300, seed=5), d)
+        cluster.upload_segment("baseballStats_OFFLINE", d)
+        mgr = cluster.controller.manager
+        tdir = os.path.join(mgr.deep_store_dir, "baseballStats_OFFLINE")
+        canonical = os.path.join(tdir, "sc_seg")
+        # a staging dir covered by an OPEN intent + a trash tombstone
+        staging = canonical + ".staging.swap"
+        mgr.fs.copy(canonical, staging)
+        trash = canonical + f"{TRASH_MARKER}123"
+        mgr.fs.copy(canonical, trash)
+        orphan = os.path.join(tdir, "random_leftover")
+        mgr.fs.copy(canonical, orphan)
+        mgr.store.set("/SWAPS/baseballStats_OFFLINE/sc_seg",
+                      {"olds": ["sc_seg"], "newCrc": "x",
+                       "inplace": True})
+        far = time.time() + 3600
+        checker = SegmentIntegrityChecker(now_fn=lambda: far)
+        checker.run(mgr)
+        rep = checker.last_report.get("baseballStats_OFFLINE", {})
+        # the intent protects its staging AND its tombstone AND the
+        # canonical artifact from the CRC sweep, at ANY age; the
+        # unrelated orphan is swept
+        assert os.path.isdir(staging)
+        assert os.path.isdir(trash)
+        assert not os.path.isdir(orphan)
+        assert "sc_seg" not in rep.get("corrupt", [])
+        assert rep.get("orphansDeleted") == ["random_leftover"], rep
+        # intent cleared: old staging is swept, old tombstone reclaimed
+        mgr.store.remove("/SWAPS/baseballStats_OFFLINE/sc_seg")
+        checker2 = SegmentIntegrityChecker(now_fn=lambda: far)
+        checker2.run(mgr)
+        assert not os.path.isdir(staging)
+        assert not os.path.isdir(trash)
+        rep2 = checker2.last_report.get("baseballStats_OFFLINE", {})
+        assert rep2.get("tombstonesDeleted") == [
+            f"sc_seg{TRASH_MARKER}123"], rep2
+        # YOUNG staging/tombstones survive even with no intent
+        mgr.fs.copy(canonical, staging)
+        mgr.fs.copy(canonical, trash)
+        checker3 = SegmentIntegrityChecker(now_fn=time.time)
+        checker3.run(mgr)
+        assert os.path.isdir(staging) and os.path.isdir(trash)
+    finally:
+        cluster.stop()
+
+
+def test_terminal_tasks_are_pruned_after_retention():
+    clock = FakeClock()
+    q = TaskQueue(PropertyStore(), clock=clock)
+    t1, t2 = _task("s0"), _task("s1")
+    q.submit(t1)
+    q.submit(t2)
+    q.claim("w", ["PurgeTask"])
+    q.claim("w", ["PurgeTask"])
+    q.finish(t1, COMPLETED, worker_id="w")
+    q.finish(t2, ERROR, worker_id="w")
+    assert q.prune_terminal() == []          # younger than retention
+    clock.t += TaskQueue.DEFAULT_TERMINAL_RETENTION_S + 1
+    assert sorted(q.prune_terminal()) == sorted([t1.task_id,
+                                                 t2.task_id])
+    assert q.task_states("PurgeTask") == {}
+    # open tasks are never pruned
+    t3 = _task("s2")
+    q.submit(t3)
+    clock.t += TaskQueue.DEFAULT_TERMINAL_RETENTION_S + 1
+    assert q.prune_terminal() == []
+    assert q.task_states("PurgeTask")[t3.task_id] == GENERATED
+
+
+def test_gc_missing_reconciles_watchless_deletions(work_dir):
+    """A server that was DOWN when retention deleted a segment missed
+    the record-removal watch event: the boot-time reconcile
+    (gc_missing against live segment records) must drop the zombie
+    keys anyway."""
+    p = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    p.apply_batch(0, _kd([("a", 0), ("b", 1), ("a", 2)]), 3)
+    p.seal(0, 3, 3)
+    p.apply_batch(1, _kd([("c", 0)]), 4)
+    p.seal(1, 4, 1)
+    p.close()
+    # "restart": seq 0's record is gone cluster-wide; only seq 1 (and
+    # the consuming seq 2) remain
+    r = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r.key_map_size() == 3             # zombies restored
+    assert r.gc_missing({1, 2}) == 2         # a + b lived in seq 0
+    assert r.key_map_size() == 1
+    r.close()
+    # and the reconcile is durable
+    r2 = PartitionUpsertMetadata(work_dir, RT_TABLE, 0)
+    assert r2.key_map_size() == 1
+    r2.close()
+
+
+def test_scrubber_protects_merge_olds_via_intent(work_dir):
+    """A merge swap's OPEN intent must shield its OLD segments'
+    artifacts and tombstones too — mid-protocol their records are
+    already pruned, so without the intent they look like ancient
+    orphans and would be hard-deleted inside the rollback window."""
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        mgr = cluster.controller.manager
+        tdir = os.path.join(mgr.deep_store_dir, "baseballStats_OFFLINE")
+        os.makedirs(tdir, exist_ok=True)
+        d = os.path.join(work_dir, "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "old_a").build(make_columns(100, seed=1), d)
+        old_art = os.path.join(tdir, "old_a")
+        mgr.fs.copy(d, old_art)
+        old_trash = os.path.join(tdir, f"old_a{TRASH_MARKER}1")
+        mgr.fs.copy(d, old_trash)
+        # open merge intent referencing old_a; its record is gone
+        mgr.store.set("/SWAPS/baseballStats_OFFLINE/merged_x",
+                      {"olds": ["old_a"], "newCrc": "x",
+                       "inplace": False})
+        far = time.time() + 3600
+        checker = SegmentIntegrityChecker(now_fn=lambda: far)
+        checker.run(mgr)
+        assert os.path.isdir(old_art), "intent must protect the old"
+        assert os.path.isdir(old_trash)
+        # intent resolved: both are reclaimable past grace
+        mgr.store.remove("/SWAPS/baseballStats_OFFLINE/merged_x")
+        checker.run(mgr)
+        assert not os.path.isdir(old_art)
+        assert not os.path.isdir(old_trash)
+    finally:
+        cluster.stop()
+
+
+def test_retention_and_task_config_validation(work_dir):
+    from pinot_tpu.controller.controller import Controller
+    ctrl = Controller(os.path.join(work_dir, "ds"))
+    mgr = ctrl.manager
+    mgr.add_schema(make_schema())
+
+    def offline(**kw):
+        cfg = make_table_config()
+        for k, v in kw.items():
+            setattr(cfg, k, v)
+        return cfg
+
+    # retention: unit without value / bad unit / bad value
+    cfg = offline()
+    cfg.segments_config.retention_time_unit = "DAYS"
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(cfg)
+    cfg = offline()
+    cfg.segments_config.retention_time_unit = "FORTNIGHTS"
+    cfg.segments_config.retention_time_value = 2
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(cfg)
+    cfg = offline()
+    cfg.segments_config.retention_time_unit = "DAYS"
+    cfg.segments_config.retention_time_value = 0
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(cfg)
+    # compaction task on a non-upsert table
+    cfg = offline(task_configs={UPSERT_COMPACTION_TASK: {}})
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(cfg)
+    # malformed thresholds
+    cfg = offline(task_configs={"MergeRollupTask": {
+        "smallSegmentDocsThreshold": "lots"}})
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(cfg)
+    cfg = offline(task_configs={"MergeRollupTask": {
+        "mergeType": "AVERAGE"}})
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(cfg)
+    # merge on an upsert table is rejected (doc ids under the key map)
+    rt = upsert_rt_config("f", "t")
+    rt.task_configs = {"MergeRollupTask": {}}
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(rt)
+    # upsert compaction thresholds validated
+    rt = upsert_rt_config("f", "t")
+    rt.task_configs = {UPSERT_COMPACTION_TASK: {
+        "invalidDocsThresholdPercent": "150"}}
+    with pytest.raises(InvalidTableConfigError):
+        mgr.add_table(rt)
+    # and the valid shapes pass
+    ok = offline(task_configs={"MergeRollupTask": {
+        "smallSegmentDocsThreshold": "1000", "mergeType": "ROLLUP"}})
+    mgr.add_table(ok)
+    ctrl.stop()
+
+
+def test_swap_rejects_unknown_inputs(work_dir):
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    try:
+        cluster.add_schema(make_schema())
+        cluster.add_table(make_table_config())
+        d = os.path.join(work_dir, "seg0")
+        SegmentCreator(make_schema(), make_table_config(),
+                       "solo").build(make_columns(100, seed=2), d)
+        swaps = SegmentSwapManager(cluster.controller.manager)
+        with pytest.raises(ValueError):
+            swaps.swap_segments("baseballStats_OFFLINE",
+                                ["never_existed"], d)
+    finally:
+        cluster.stop()
