@@ -111,6 +111,13 @@ def _compact(result: dict) -> dict:
     if "per_query" in result:
         out["pq_cols"] = ["device_p50_ms", "cpu_p50_ms", "speedup"]
         out["per_query"] = shrink(result["per_query"])
+    vec = result.get("vector")
+    if isinstance(vec, dict):
+        out["vector"] = {
+            "value": vec.get("value"), "pass": vec.get("pass"),
+            "rungs": {name: (r.get("speedup") if "speedup" in r
+                             else "skip" if "skipped" in r else "err")
+                      for name, r in (vec.get("rungs") or {}).items()}}
     big = result.get("big_synth")
     if isinstance(big, dict) and big.get("per_query"):
         out["big_synth"] = {"rows": big.get("rows"),
@@ -632,6 +639,206 @@ def bench_queries(mesh, stack, cpu, reps, rows, stage: str,
     return per_query, speedups
 
 
+# ---------------------------------------------------------------------------
+# Vector rung: filtered exact top-k over embeddings vs the numpy host
+# baseline (ISSUE 13 — same ≥150x discipline as q1.x). Artifact:
+# VEC_r10.json next to this file.
+# ---------------------------------------------------------------------------
+
+VEC_DIM = 128
+VEC_K = 10
+VEC_ARTIFACT = os.environ.get("PINOT_TPU_VEC_ARTIFACT", "VEC_r10.json")
+
+
+def _np_tree(x):
+    x = np.asarray(x, np.float32)
+    while x.shape[-1] > 1:
+        x = x[..., 0::2] + x[..., 1::2]
+    return x[..., 0]
+
+
+def _np_vec_baseline(mat, shard, q):
+    """The numpy host baseline AND oracle: filtered cosine top-k with
+    the engine's f32 balanced-tree score contract."""
+    def run():
+        scores = _np_tree(mat * q[None, :])
+        denom = np.sqrt(_np_tree(mat * mat)).astype(np.float32) * \
+            np.float32(np.sqrt(_np_tree(q * q)))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            s = (scores / denom).astype(np.float32)
+        s[~(denom > 0)] = -np.inf
+        docs = np.nonzero(shard < 2)[0]
+        sv = s[docs]
+        order = np.lexsort((docs, -sv))[:VEC_K]
+        return [(int(docs[i]), float(sv[i])) for i in order]
+    return run
+
+
+def vector_rung(mesh, budget_s: float = 900.0) -> dict:
+    """Build → load → stack → time the filtered vector top-k at the
+    100k and 1M rungs; returns the artifact dict (also written to
+    VEC_ARTIFACT)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, dimension, metric, vector
+    from pinot_tpu.parallel.sharded import (ShardedQueryExecutor,
+                                            get_sharded_kernel)
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.query.plan import InstancePlanMaker
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    t_stage = time.monotonic()
+    reps = int(os.environ.get("PINOT_TPU_VEC_REPS", "5"))
+    n_exec = int(os.environ.get("PINOT_TPU_VEC_EXECS", "32"))
+    schema = Schema("vectab", [dimension("shard", DataType.INT),
+                               metric("rid", DataType.INT),
+                               vector("emb", VEC_DIM)])
+    out = {"metric": "vector_topk_speedup_vs_numpy_host",
+           "unit": "x", "target": 150.0, "dim": VEC_DIM, "k": VEC_K,
+           "metric_fn": "COSINE", "filter": "shard < 2 (50%)",
+           "backend": jax.devices()[0].platform,
+           "n_devices": len(jax.devices()),
+           "rungs": {}}
+    plan_maker = InstancePlanMaker()
+    for label, rows, n_segs in (("100k_128d", 100_000, 2),
+                                ("1m_128d", 1_000_000, 4)):
+        if time.monotonic() - t_stage > budget_s or remaining_s() < 120:
+            out["rungs"][label] = {"skipped": "time budget"}
+            continue
+        rng = np.random.default_rng(10)
+        per = rows // n_segs
+        segs = []
+        try:
+            _vector_rung_one(out, label, rows, n_segs, per, rng, schema,
+                             plan_maker, mesh, segs, reps, n_exec)
+        finally:
+            for s in segs:
+                s.destroy()
+    big = out["rungs"].get("1m_128d", {})
+    out["value"] = big.get("speedup", 0.0)
+    out["vs_target"] = round(out["value"] / 150.0, 4)
+    out["pass"] = bool(big.get("parity")) and (
+        out["value"] >= 150.0 or out["backend"] != "tpu")
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            VEC_ARTIFACT)
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        log(f"bench[vec]: artifact written to {path}")
+    except OSError as e:
+        log(f"bench[vec]: could not write artifact ({e})")
+    return out
+
+
+def _vector_rung_one(out, label, rows, n_segs, per, rng, schema,
+                     plan_maker, mesh, segs, reps, n_exec) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pinot_tpu.parallel.sharded import (ShardedQueryExecutor,
+                                            get_sharded_kernel)
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    if True:
+        with tempfile.TemporaryDirectory() as base:
+            t0 = time.perf_counter()
+            mats, shards = [], []
+            for s in range(n_segs):
+                mat = rng.standard_normal((per, VEC_DIM)).astype(np.float32)
+                shard = rng.integers(0, 4, per).astype(np.int32)
+                d = os.path.join(base, f"v{s}")
+                SegmentCreator(schema, segment_name=f"v{s}").build(
+                    {"shard": shard,
+                     "rid": np.arange(per, dtype=np.int32) + s * per,
+                     "emb": mat}, d)
+                segs.append(ImmutableSegmentLoader.load(d))
+                mats.append(mat)
+                shards.append(shard)
+            build_s = time.perf_counter() - t0
+            q = rng.standard_normal(VEC_DIM).astype(np.float32)
+            qs = ", ".join(repr(float(x)) for x in q)
+            pql = (f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], {VEC_K}, "
+                   "'COSINE') FROM vectab WHERE shard < 2")
+            request = compile_pql(pql)
+            sharded = ShardedQueryExecutor(mesh=mesh)
+            stack = sharded.stack_for(segs)
+            # parity gate BEFORE timing: engine result == numpy oracle
+            blk = sharded.execute(request, segs)
+            got = [(row[1], row[2], row[3]) for row in blk.selection_rows]
+            cand = []
+            for s in range(n_segs):
+                for doc, score in _np_vec_baseline(mats[s], shards[s], q)():
+                    cand.append((-score, f"v{s}", doc, score))
+            cand.sort()
+            exp = [(doc, name, score) for _ns, name, doc, score
+                   in cand[:VEC_K]]
+            parity = got == exp
+            if not parity:
+                out["rungs"][label] = {"parity": False, "got": got[:3],
+                                       "exp": exp[:3]}
+                return
+
+            # device timing: scan of n_exec dispatches, minus relay RTT
+            plan = plan_maker.make_segment_plan(stack.plan_segment(),
+                                                request)
+            cols = stack.gather(plan.needed_cols)
+            nd = stack.device_num_docs()
+            lane_keys = tuple(sorted(cols.keys()))
+            fn = get_sharded_kernel(mesh, stack.padded_docs,
+                                    plan.filter_spec, (), None,
+                                    plan.select_spec, lane_keys)
+            fparams = tuple(plan.params)
+            rtt = measure_rtt(nd)
+            zs = jnp.zeros(n_exec, jnp.int32)
+
+            @jax.jit
+            def timed(cols, nd, zs, fparams):
+                def body(c, z):
+                    o = fn(cols, fparams, nd + z)
+                    s = jnp.float32(0)
+                    for v in o.values():
+                        s = s + v.astype(jnp.float32).sum()
+                    return c + s, None
+                acc, _ = jax.lax.scan(body, jnp.float32(0), zs)
+                return acc
+
+            jax.device_get(timed(cols, nd, zs, fparams))     # compile
+            samples = []
+            for _ in range(max(3, reps)):
+                t0 = time.perf_counter()
+                jax.device_get(timed(cols, nd, zs, fparams))
+                total = time.perf_counter() - t0
+                samples.append(max(total - rtt, 1e-5) / n_exec)
+            d50 = median(samples)
+
+            # numpy host baseline over ONE contiguous table (the shape a
+            # host serving stack would scan), same score contract
+            mat_all = np.concatenate(mats)
+            shard_all = np.concatenate(shards)
+            cpu_fn = _np_vec_baseline(mat_all, shard_all, q)
+            c50, cpu_ts = time_cpu(cpu_fn, reps)
+            out["rungs"][label] = {
+                "rows": rows, "segments": n_segs,
+                "build_s": round(build_s, 1),
+                "parity": True,
+                "device_p50_ms": round(d50 * 1e3, 3),
+                "device_min_ms": round(min(samples) * 1e3, 3),
+                "n_device": len(samples), "execs_per_sample": n_exec,
+                "cpu_p50_ms": round(c50 * 1e3, 3),
+                "n_cpu": len(cpu_ts),
+                "speedup": round(c50 / d50, 2),
+                "rows_per_s_per_chip": round(rows / d50),
+            }
+            log(f"bench[vec] {label}: device p50 {d50 * 1e3:.3f}ms, "
+                f"numpy {c50 * 1e3:.2f}ms, speedup {c50 / d50:.1f}x")
+
+
 def probe_creator_rate() -> float:
     """rows/s through build_ssb_segment_dirs on THIS box (1M-row probe) —
     drives the row-count auto-scale so build+measure provably fits the
@@ -671,6 +878,18 @@ def main() -> None:
 
     log(f"bench: global wall budget {TOTAL_BUDGET_S:.0f}s "
         "(PINOT_TPU_BENCH_TOTAL_BUDGET_S)")
+
+    if os.environ.get("PINOT_TPU_BENCH_VECTOR_ONLY") == "1":
+        # standalone vector rung (artifact refresh / device evidence)
+        from pinot_tpu.parallel import make_mesh
+        vec = vector_rung(make_mesh(), budget_s=TOTAL_BUDGET_S)
+        _RESULT.clear()
+        _RESULT.update({"metric": vec["metric"], "value": vec["value"],
+                        "unit": "x", "vs_baseline": vec["vs_target"],
+                        "vector": vec})
+        emit_final(_RESULT)
+        return
+
     rate = probe_creator_rate()
     scaled = autoscale_rows(store_rows, rate)
     if scaled != store_rows:
@@ -796,6 +1015,20 @@ def main() -> None:
         "hbm_upload_mbps": round(up_bytes / 1e6 / up_s, 1),
         "per_query": store_pq,
     }
+    # ---- vector rung (ISSUE 13): filtered exact top-k vs numpy host ------
+    if os.environ.get("PINOT_TPU_BENCH_VECTOR", "1") == "1" and \
+            remaining_s() > 180:
+        try:
+            result["vector"] = vector_rung(mesh)
+        except Exception as e:  # noqa: BLE001 — the SSB headline above
+            # is the bench result and must always be emitted
+            log(f"bench[vec]: STAGE ERROR {type(e).__name__}: "
+                f"{str(e)[:200]}")
+            result["vector"] = {"error": f"{type(e).__name__}: "
+                                f"{str(e)[:300]}"}
+    elif os.environ.get("PINOT_TPU_BENCH_VECTOR", "1") == "1":
+        result["vector"] = {"skipped": "global time budget"}
+
     _RESULT.clear()
     _RESULT.update(result)      # SIGTERM from here on emits the headline
     # print the storage headline NOW: a hard kill (SIGKILL after the

@@ -190,7 +190,7 @@ def _exemplar_request():
                                           FilterOperator, FilterQueryTree,
                                           GroupBy, HavingNode,
                                           QueryOptions, Selection,
-                                          SelectionSort)
+                                          SelectionSort, VectorSimilarity)
     filt = FilterQueryTree(
         operator=FilterOperator.AND,
         children=[
@@ -209,6 +209,8 @@ def _exemplar_request():
         selection=Selection(columns=["a"],
                             order_by=[SelectionSort("a", False)],
                             offset=1, size=7),
+        vector=VectorSimilarity(column="e", query=[1.0, 0.0], k=3,
+                                metric="COSINE"),
         having=having,
         query_options=QueryOptions(trace=True, timeout_ms=1000,
                                    debug_options={"k": "v"},

@@ -23,6 +23,12 @@ class DataType(enum.Enum):
     BOOLEAN = "BOOLEAN"
     STRING = "STRING"
     BYTES = "BYTES"
+    # dense embedding column: each row is a fixed-dimension float32
+    # vector (FieldSpec.vector_dimension). Stored as a packed [n, dim]
+    # forward block; served by the batched top-k similarity kernels.
+    # The index SPI's TPU-native family (SURVEY §2.5) — no 2019-era
+    # Pinot analogue.
+    VECTOR = "VECTOR"
 
     @property
     def is_numeric(self) -> bool:
@@ -70,6 +76,10 @@ class DataType(enum.Enum):
             if isinstance(value, (bytes, bytearray)):
                 return bytes(value)
             return bytes.fromhex(str(value))
+        if self is DataType.VECTOR:
+            # dimension validation lives in FieldSpec.convert (the field
+            # knows its dimension); this is the dimension-less coercion
+            return np.asarray(value, dtype=np.float32)
         raise ValueError(f"unsupported type {self}")
 
 
@@ -83,6 +93,7 @@ _NP_DTYPES = {
     DataType.BOOLEAN: np.dtype(object),
     DataType.STRING: np.dtype(object),
     DataType.BYTES: np.dtype(object),
+    DataType.VECTOR: np.dtype(np.float32),
 }
 
 _DEVICE_DTYPES = {
@@ -94,6 +105,7 @@ _DEVICE_DTYPES = {
     DataType.BOOLEAN: np.dtype(np.int32),
     DataType.STRING: np.dtype(np.int32),
     DataType.BYTES: np.dtype(np.int32),
+    DataType.VECTOR: np.dtype(np.float32),
 }
 
 _NULL_DIM = {
@@ -104,4 +116,5 @@ _NULL_DIM = {
     DataType.BOOLEAN: "null",
     DataType.STRING: "null",
     DataType.BYTES: b"",
+    DataType.VECTOR: None,   # FieldSpec.convert substitutes a zero vector
 }

@@ -96,6 +96,28 @@ class Selection:
     size: int = 10
 
 
+#: result columns every vector-similarity row ends with, in order: the
+#: global doc id within its segment, the (logical) segment name, and the
+#: float32 similarity score. Cross-segment/server merges order by
+#: (score desc, segment, docId) — deterministic on every path.
+VECTOR_RESULT_COLUMNS = ("$docId", "$segmentName", "$score")
+
+
+@dataclasses.dataclass
+class VectorSimilarity:
+    """A ranked top-k similarity clause: VECTOR_SIMILARITY(col, [..], k).
+
+    `metric` ∈ {COSINE, DOT, MIPS} (MIPS is an alias of DOT — maximum
+    inner product). Exact filtered top-k, not ANN: the candidate set is
+    the WHERE filter's (and the upsert validDocIds mask's) surviving
+    rows, scored exhaustively.
+    """
+    column: str
+    query: List[float]
+    k: int = 10
+    metric: str = "COSINE"
+
+
 @dataclasses.dataclass
 class HavingNode:
     """HAVING clause tree: comparison over aggregation results, or AND/OR."""
@@ -129,6 +151,9 @@ class BrokerRequest:
     aggregations: List[AggregationInfo] = dataclasses.field(default_factory=list)
     group_by: Optional[GroupBy] = None
     selection: Optional[Selection] = None
+    # ranked vector top-k (set together with `selection`, whose columns
+    # are the ride-along display columns and whose size bounds the merge)
+    vector: Optional[VectorSimilarity] = None
     having: Optional[HavingNode] = None
     query_options: QueryOptions = dataclasses.field(default_factory=QueryOptions)
     limit: int = 10
@@ -180,6 +205,8 @@ class BrokerRequest:
                 if c != "*":
                     cols.update(expand(c))
             cols.update(s.column for s in self.selection.order_by)
+        if self.vector:
+            cols.add(self.vector.column)
         return sorted(cols)
 
 

@@ -33,6 +33,12 @@ class TimeUnit(enum.Enum):
         return int(value) * self.value
 
 
+#: hard cap on VECTOR dimensions (1024 f32 lanes x 4 bytes = 4KB/row is
+#: already generous; anything wider should be a modeling question, not a
+#: silent multi-GB segment)
+MAX_VECTOR_DIMENSION = 4096
+
+
 @dataclasses.dataclass
 class FieldSpec:
     name: str
@@ -43,6 +49,10 @@ class FieldSpec:
     # TIME fields only:
     time_unit: Optional[TimeUnit] = None
     time_unit_size: int = 1
+    # VECTOR fields only: fixed embedding dimension (every row carries
+    # exactly this many float32 lanes; validated at controller
+    # schema-create and again at segment build/ingest)
+    vector_dimension: int = 0
 
     def __post_init__(self):
         if self.default_null_value is None:
@@ -57,9 +67,41 @@ class FieldSpec:
         return self.data_type.is_numeric
 
     def convert(self, value):
+        if self.data_type == DataType.VECTOR:
+            import numpy as np
+            if value is None:
+                return np.zeros(self.vector_dimension, np.float32)
+            arr = np.asarray(value, dtype=np.float32)
+            if arr.shape != (self.vector_dimension,):
+                raise ValueError(
+                    f"column '{self.name}' expects a {self.vector_dimension}"
+                    f"-dimension vector, got shape {arr.shape}")
+            return arr
         if value is None:
             return self.default_null_value
         return self.data_type.convert(value)
+
+    def validate(self) -> None:
+        """Structural validation (parity: Schema.validate — reject at
+        controller schema-create, not at first segment build)."""
+        if self.data_type == DataType.VECTOR:
+            if self.field_type != FieldType.DIMENSION:
+                raise ValueError(
+                    f"VECTOR column '{self.name}' must be a DIMENSION "
+                    f"field, not {self.field_type.value}")
+            if not self.single_value:
+                raise ValueError(
+                    f"VECTOR column '{self.name}' must be single-value "
+                    "(each row is ONE fixed-width embedding)")
+            if not (0 < self.vector_dimension <= MAX_VECTOR_DIMENSION):
+                raise ValueError(
+                    f"VECTOR column '{self.name}' needs a dimension in "
+                    f"[1, {MAX_VECTOR_DIMENSION}], got "
+                    f"{self.vector_dimension}")
+        elif self.vector_dimension:
+            raise ValueError(
+                f"column '{self.name}' carries vectorDimension but is "
+                f"{self.data_type.value}, not VECTOR")
 
     def to_json(self) -> dict:
         default = self.default_null_value
@@ -76,6 +118,8 @@ class FieldSpec:
         if self.time_unit is not None:
             d["timeUnit"] = self.time_unit.name
             d["timeUnitSize"] = self.time_unit_size
+        if self.data_type == DataType.VECTOR:
+            d["vectorDimension"] = self.vector_dimension
         return d
 
 
@@ -85,6 +129,12 @@ def dimension(name: str, data_type: DataType, single_value: bool = True) -> Fiel
 
 def metric(name: str, data_type: DataType) -> FieldSpec:
     return FieldSpec(name, data_type, FieldType.METRIC)
+
+
+def vector(name: str, dimension: int) -> FieldSpec:
+    """Fixed-dimension float32 embedding column."""
+    return FieldSpec(name, DataType.VECTOR, FieldType.DIMENSION,
+                     vector_dimension=dimension)
 
 
 def time_field(name: str, data_type: DataType, unit: TimeUnit = TimeUnit.DAYS,
@@ -130,6 +180,16 @@ class Schema:
                 return f
         return None
 
+    @property
+    def vector_columns(self) -> List[str]:
+        return [f.name for f in self.fields
+                if f.data_type == DataType.VECTOR]
+
+    def validate(self) -> None:
+        """Per-field structural validation (VECTOR dimension bounds)."""
+        for f in self.fields:
+            f.validate()
+
     # -- serde -------------------------------------------------------------
     def to_json(self) -> dict:
         out = {"schemaName": self.schema_name, "dimensionFieldSpecs": [],
@@ -160,7 +220,9 @@ class Schema:
             fields.append(FieldSpec(fs["name"], DataType(fs["dataType"]),
                                     FieldType.DIMENSION,
                                     fs.get("singleValueField", True),
-                                    _default(fs)))
+                                    _default(fs),
+                                    vector_dimension=fs.get(
+                                        "vectorDimension", 0)))
         for fs in d.get("metricFieldSpecs", []) or []:
             fields.append(FieldSpec(fs["name"], DataType(fs["dataType"]),
                                     FieldType.METRIC,

@@ -17,7 +17,8 @@ from typing import Any, List, Optional
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree,
                                       GroupBy, HavingNode, InstanceRequest,
-                                      QueryOptions, Selection, SelectionSort)
+                                      QueryOptions, Selection, SelectionSort,
+                                      VectorSimilarity)
 from pinot_tpu.common.sketches import HyperLogLog, TDigest
 
 # ---------------------------------------------------------------------------
@@ -89,6 +90,12 @@ def request_to_json(r: BrokerRequest) -> dict:
             "orderBy": [{"col": s.column, "asc": s.ascending}
                         for s in r.selection.order_by],
             "offset": r.selection.offset, "size": r.selection.size},
+        # optional vector-similarity clause (absent pre-vector payloads
+        # parse unchanged; older peers ignore the extra key)
+        "vector": None if r.vector is None else {
+            "col": r.vector.column,
+            "q": [float(x) for x in r.vector.query],
+            "k": r.vector.k, "metric": r.vector.metric},
         "having": _having_to_json(r.having),
         "options": {"trace": r.query_options.trace,
                     "timeoutMs": r.query_options.timeout_ms,
@@ -101,6 +108,7 @@ def request_to_json(r: BrokerRequest) -> dict:
 def request_from_json(d: dict) -> BrokerRequest:
     sel = d.get("selection")
     gb = d.get("groupBy")
+    vec = d.get("vector")
     opts = d.get("options") or {}
     return BrokerRequest(
         table_name=d["table"],
@@ -113,6 +121,9 @@ def request_from_json(d: dict) -> BrokerRequest:
             order_by=[SelectionSort(s["col"], s["asc"])
                       for s in sel.get("orderBy") or []],
             offset=sel.get("offset", 0), size=sel.get("size", 10)),
+        vector=None if vec is None else VectorSimilarity(
+            column=vec["col"], query=list(vec["q"]),
+            k=vec.get("k", 10), metric=vec.get("metric", "COSINE")),
         having=_having_from_json(d.get("having")),
         query_options=QueryOptions(
             trace=opts.get("trace", False),

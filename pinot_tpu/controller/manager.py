@@ -95,6 +95,13 @@ class ResourceManager:
 
     # -- schemas & tables --------------------------------------------------
     def add_schema(self, schema: Schema) -> None:
+        # structural validation at create time (parity: Schema.validate):
+        # today the only per-field invariant is the VECTOR family's —
+        # DIMENSION, single-value, 1 <= dimension <= MAX_VECTOR_DIMENSION
+        try:
+            schema.validate()
+        except ValueError as e:
+            raise InvalidTableConfigError(str(e)) from None
         self.store.set(f"{SCHEMAS}/{schema.schema_name}", schema.to_json())
 
     def get_schema(self, name: str) -> Optional[Schema]:
@@ -106,6 +113,7 @@ class ResourceManager:
         table = config.table_name_with_type
         _validate_table_config(config)
         self._validate_upsert_config(config)
+        self._validate_vector_columns(config)
         self._validate_retention_config(config)
         self._validate_task_configs(config)
         tenant = config.tenant_config.server or DEFAULT_TENANT
@@ -165,6 +173,36 @@ class ResourceManager:
                 raise InvalidTableConfigError(
                     f"upsert primary key column '{col}' must be "
                     "single-value")
+            from pinot_tpu.common.datatype import DataType
+            if field.data_type == DataType.VECTOR:
+                raise InvalidTableConfigError(
+                    f"upsert primary key column '{col}' cannot be a "
+                    "VECTOR column")
+
+    def _validate_vector_columns(self, config: TableConfig) -> None:
+        """VECTOR columns carry no dictionary, so every dictionary- or
+        value-hash-backed index config is a misconfiguration — reject at
+        create time (the schema may legitimately not be registered yet
+        for OFFLINE bootstrap flows; then there is nothing to check)."""
+        schema = self.get_schema(config.table_name)
+        if schema is None:
+            return
+        from pinot_tpu.common.datatype import DataType
+        vec_cols = {f.name for f in schema.fields
+                    if f.data_type == DataType.VECTOR}
+        if not vec_cols:
+            return
+        idx = config.indexing_config
+        for label, cols in (
+                ("invertedIndexColumns", idx.inverted_index_columns),
+                ("bloomFilterColumns", idx.bloom_filter_columns),
+                ("noDictionaryColumns", idx.no_dictionary_columns)):
+            bad = vec_cols & set(cols or ())
+            if bad:
+                raise InvalidTableConfigError(
+                    f"VECTOR column(s) {sorted(bad)} cannot appear in "
+                    f"{label} (vector forward blocks have no dictionary "
+                    "or hashable values)")
 
     def _validate_retention_config(self, config: TableConfig) -> None:
         """Reject malformed retention at create/update time instead of

@@ -83,11 +83,16 @@ class DataTypeTransformer(RecordTransformer):
         self.schema = schema
 
     def transform(self, row: dict) -> Optional[dict]:
+        from pinot_tpu.common.datatype import DataType
         for f in self.schema.fields:
             v = row.get(f.name)
             if v is None:
                 continue
-            if f.single_value:
+            if f.data_type == DataType.VECTOR:
+                # the list payload IS the embedding — never unwrap it
+                # like an accidentally-listed scalar
+                row[f.name] = f.convert(v)
+            elif f.single_value:
                 if isinstance(v, (list, tuple)):
                     v = v[0] if v else None
                 row[f.name] = None if v is None else f.convert(v)

@@ -124,7 +124,10 @@ class MergeRollupTaskGenerator(PinotTaskGenerator):
     taskConfig knobs: ``smallSegmentDocsThreshold`` (merge candidates
     hold fewer docs than this; default 10000),
     ``maxNumSegmentsPerTask`` (default 8), ``mergeType``
-    (CONCATENATE | ROLLUP)."""
+    (CONCATENATE | ROLLUP), ``bucketTimePeriodMs`` (group candidates by
+    ``startTime // bucket`` so no merged output spans a bucket boundary
+    — parity: MergeRollupTaskGenerator's bucketTimePeriod; unset = one
+    global bundle, the pre-bucketing behavior)."""
 
     task_type = MERGE_ROLLUP_TASK
 
@@ -138,6 +141,7 @@ class MergeRollupTaskGenerator(PinotTaskGenerator):
         threshold = int(float(cfg.get("smallSegmentDocsThreshold", 10_000)))
         per_task = max(2, int(float(cfg.get("maxNumSegmentsPerTask", 8))))
         merge_type = str(cfg.get("mergeType", "CONCATENATE")).upper()
+        bucket_ms = int(float(cfg.get("bucketTimePeriodMs", 0)))
         latest = latest_llc_sequences(manager.segment_names(table))
         candidates = []
         for seg in sorted(manager.segment_names(table)):
@@ -156,18 +160,28 @@ class MergeRollupTaskGenerator(PinotTaskGenerator):
                 continue
             candidates.append((meta.get("startTime") or 0, seg))
         candidates.sort()
+        # time-bucketed grouping: a rollup output whose rows straddle a
+        # bucket (= retention window) boundary would pin young rows to
+        # the oldest input's retention clock — bucketing keeps retention
+        # deletes aligned with merged artifacts. Segments without a
+        # start time all land in bucket 0 (the unbucketed behavior).
+        groups: Dict[int, List[str]] = {}
+        for t, seg in candidates:
+            bucket = (int(t) // bucket_ms) if bucket_ms > 0 else 0
+            groups.setdefault(bucket, []).append(seg)
         out = []
-        group = [seg for _t, seg in candidates]
-        for i in range(0, len(group) - 1, per_task):
-            batch = group[i:i + per_task]
-            if len(batch) < 2:
-                continue                      # nothing to fold
-            out_name = f"merged_{batch[0]}_{batch[-1]}"
-            out.append(PinotTaskConfig(self.task_type, {
-                TABLE_NAME_KEY: table,
-                SEGMENT_NAME_KEY: ",".join(batch),
-                "outputSegmentName": out_name,
-                "mergeType": merge_type}))
+        for bucket in sorted(groups):
+            group = groups[bucket]
+            for i in range(0, len(group) - 1, per_task):
+                batch = group[i:i + per_task]
+                if len(batch) < 2:
+                    continue                  # nothing to fold
+                out_name = f"merged_{batch[0]}_{batch[-1]}"
+                out.append(PinotTaskConfig(self.task_type, {
+                    TABLE_NAME_KEY: table,
+                    SEGMENT_NAME_KEY: ",".join(batch),
+                    "outputSegmentName": out_name,
+                    "mergeType": merge_type}))
         return out
 
 

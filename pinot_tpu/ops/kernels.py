@@ -1298,8 +1298,48 @@ def _group_outputs(group_spec, cols, mask, num_docs, params=None):
 #   kind ∈ {"limit",     # no order: first-k matched docids
 #           "order",     # all-dict keys packed into one int32 → top_k
 #           "ordertk",   # single raw int32/f32 key → monotone-map + top_k
-#           "ordermk"}   # general multi-key → lax.sort (no packing limit)
+#           "ordermk",   # general multi-key → lax.sort (no packing limit)
+#           "vector"}    # batched similarity scores → top_k (order slot
+#                        #   carries ((col, metric, dim_pad),); runtime
+#                        #   params: query vector f32 [dim_pad] + its
+#                        #   f32 norm)
 # ---------------------------------------------------------------------------
+
+
+def vec_tree_sum(x):
+    """Balanced pairwise sum over the LAST axis (pow2 width).
+
+    This is the vector subsystem's exactness contract: every backend —
+    numpy host oracle, XLA CPU, XLA TPU, per-shard sharded lanes — runs
+    the SAME log2(D) sequence of elementwise IEEE f32 adds, so scores
+    are bit-identical across all of them by construction. A matmul
+    would hit the MXU but leaves the accumulation order (and therefore
+    the low bits) implementation-defined; for a [P, 128] @ [128]
+    matvec the MXU is row-starved anyway, while this form fuses into
+    one VPU row stream at HBM bandwidth. Zero padding lanes are exact
+    no-ops (x + 0.0 == x for every x the guards let through).
+    """
+    while x.shape[-1] > 1:
+        x = x[..., 0::2] + x[..., 1::2]
+    return x[..., 0]
+
+
+def _vector_scores(mat, q, q_norm, metric: str):
+    """Per-row similarity scores, float32 [P].
+
+    mat: f32 [P, dim_pad] embedding block; q: f32 [dim_pad] query
+    (zero-padded); q_norm: f32 scalar — the query's tree-norm, computed
+    host-side by the planner with the same balanced tree (only read by
+    the cosine metric; the planner rejects zero query vectors there).
+    Rows with zero norm score -inf under cosine (they can never rank
+    above any real match, exactly like the host twin).
+    """
+    dot = vec_tree_sum(mat * q[None, :])
+    if metric == "cosine":
+        denom = jnp.sqrt(vec_tree_sum(mat * mat)) * q_norm
+        return jnp.where(denom > 0, dot / denom,
+                         jnp.float32(-jnp.inf)).astype(jnp.float32)
+    return dot.astype(jnp.float32)
 
 
 def _monotone_int32_keys(lane, asc: bool) -> list:
@@ -1333,9 +1373,30 @@ def _monotone_int32_keys(lane, asc: bool) -> list:
     return keys if asc else [~k for k in keys]
 
 
-def _selection_outputs(select_spec, cols, mask):
+def _selection_outputs(select_spec, cols, mask, params=None):
     kind, k, order, gather_cols = select_spec
-    if kind == "limit":
+    extra_outs = {}
+    if kind == "vector":
+        # batched top-k similarity: scores → monotone int32 keys →
+        # lax.top_k (XLA top_k breaks ties toward the LOWER index, so
+        # equal scores rank docid-ascending — the host twin's contract)
+        (col, metric, _dim_pad), = order
+        q = params.pop(0)                   # f32 [dim_pad] query vector
+        q_norm = params.pop(0)              # f32 scalar (tree-norm of q)
+        score = _vector_scores(cols[f"{col}.vec"], q, q_norm, metric)
+        key = _monotone_int32_keys(score, True)[0]
+        # reserve INT32_MIN for the masked-row sentinel (cost: the two
+        # lowest real keys — NaN-pattern scores our guards never emit —
+        # collapse into one rank)
+        key = jnp.maximum(key, -INT32_MAX)
+        scored = jnp.where(mask, key, -INT32_MAX - 1)
+        _, docids = jax.lax.top_k(scored, k)
+        n_valid = mask.sum(dtype=jnp.int32)
+        valid_k = jnp.arange(k, dtype=jnp.int32) < n_valid
+        docids = jnp.where(valid_k, docids, -1)
+        extra_outs["sel.scores"] = jnp.where(
+            valid_k, score[jnp.maximum(docids, 0)], jnp.float32(0))
+    elif kind == "limit":
         docids = jnp.nonzero(mask, size=k, fill_value=-1)[0]
     elif kind == "order":
         # pack dict order columns into one int32 key (planner guarantees
@@ -1377,6 +1438,7 @@ def _selection_outputs(select_spec, cols, mask):
         docids = jnp.where(res[0][:k] == 0, res[-1][:k], -1)
     out = {"sel.docids": docids.astype(jnp.int32),
            "sel.count": mask.sum(dtype=jnp.int32)}
+    out.update(extra_outs)
     safe = jnp.maximum(docids, 0)
     for col, source in gather_cols:
         lane = {"sv": f"{col}.ids", "raw": f"{col}.raw",
@@ -1406,7 +1468,10 @@ def build_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
         elif agg_specs:
             outs.update(_agg_outputs(agg_specs, cols, mask, num_docs))
         if select_spec is not None:
-            outs.update(_selection_outputs(select_spec, cols, mask))
+            # runtime selection operands (the vector query + its norm)
+            # follow the filter/group params in depth-first plan order
+            outs.update(_selection_outputs(select_spec, cols, mask,
+                                           plist))
         return outs
 
     return kernel
@@ -1561,4 +1626,20 @@ def contract_cases():
          ("ordermk", 16, (("d0", True, 8, "sv"), ("r0", False, 0, "raw")),
           (("r0", "raw"),)),
          {"d0.ids": (i32, (P,)), "r0.raw": (f32, (P,))})
+    # batched vector similarity top-k: MIPS/dot over the packed [P, dim]
+    # embedding block, with a gather column riding along
+    case("select_vector_dot", ("match_all",), [], None,
+         ("vector", 16, (("e0", "dot", 128),), (("d0", "sv"),)),
+         {"e0.vec": (f32, (P, 128)), "d0.ids": (i32, (P,))},
+         [(f32, (128,)), (f32, ())])
+    # cosine, fused with a filter predicate AND the upsert vdoc lane —
+    # the "dead upserted rows can never rank" path
+    case("select_vector_cosine_filtered",
+         ("and", (("pred", "eq_id", "d0", "sv", None),
+                  ("pred", "vdoc", "$validDocIds", "vdoc", None))),
+         [], None,
+         ("vector", 16, (("e0", "cosine", 128),), ()),
+         {"e0.vec": (f32, (P, 128)), "d0.ids": (i32, (P,)),
+          "$validDocIds.vdoc": (bl, (P,))},
+         [(i32, ()), (f32, (128,)), (f32, ())])
     return cases

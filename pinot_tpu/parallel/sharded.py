@@ -674,6 +674,9 @@ class ShardedQueryExecutor:
         Parity: CombineService selection merge — each segment returns its
         own (already ordered/limited) rows; the combiner re-sorts and trims.
         """
+        if plan.select_spec[0] == "vector":
+            self._finish_vector(request, plan, stack, outs, blk)
+            return
         rows_all: List[tuple] = []
         columns = None
         seg_matched = np.asarray(outs["stats.seg_matched"])
@@ -699,3 +702,32 @@ class ShardedQueryExecutor:
         blk.selection_rows = rows_all[: sel.offset + sel.size]
         blk.selection_columns = columns
         blk.selection_display_cols = plan.select_display
+
+    def _finish_vector(self, request, plan, stack, outs, blk) -> None:
+        """Per-shard local top-k → exact global merge by score.
+
+        Each stacked segment's kernel lane already holds its own exact
+        top-k (the per-shard local top-k); the global k is the score-
+        ordered merge — identity (segment name, docid) comes from the
+        REAL segment, while dictionary decode of ride-along columns goes
+        through the union view (the stacked lanes' id domain)."""
+        from pinot_tpu.common.request import VECTOR_RESULT_COLUMNS
+        decode_seg = stack.plan_segment()
+        columns = [c for c, _ in plan.select_spec[3]] + \
+            list(VECTOR_RESULT_COLUMNS)
+        rows_all: List[tuple] = []
+        for i, seg in enumerate(stack.segments):
+            sub = {k: v[i] for k, v in outs.items()
+                   if k.startswith("sel.")}
+            name, base = execution.vector_segment_identity(seg)
+            rows = execution.vector_result_rows(
+                decode_seg, plan.select_spec, sub, name, base)
+            if rows_all and rows:
+                rows_all = combine_mod.merge_selection_rows(
+                    request, columns, rows_all, rows)
+            elif rows:
+                rows_all = rows
+        sel = request.selection
+        blk.selection_rows = rows_all[: sel.offset + sel.size]
+        blk.selection_columns = columns
+        blk.selection_display_cols = None

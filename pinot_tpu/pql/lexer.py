@@ -20,6 +20,8 @@ class TokType(enum.Enum):
     OP = "OP"          # = <> != < <= > >=
     LPAREN = "LPAREN"
     RPAREN = "RPAREN"
+    LBRACKET = "LBRACKET"
+    RBRACKET = "RBRACKET"
     COMMA = "COMMA"
     STAR = "STAR"
     KEYWORD = "KEYWORD"
@@ -118,6 +120,10 @@ def tokenize(text: str) -> List[Token]:
             toks.append(Token(TokType.LPAREN, c, i)); i += 1; continue
         if c == ")":
             toks.append(Token(TokType.RPAREN, c, i)); i += 1; continue
+        if c == "[":
+            toks.append(Token(TokType.LBRACKET, c, i)); i += 1; continue
+        if c == "]":
+            toks.append(Token(TokType.RBRACKET, c, i)); i += 1; continue
         if c == ",":
             toks.append(Token(TokType.COMMA, c, i)); i += 1; continue
         if c == "*":
@@ -141,4 +147,4 @@ def _numeric_context(toks: List[Token]) -> bool:
     if not toks:
         return True
     return toks[-1].type in (TokType.OP, TokType.LPAREN, TokType.COMMA,
-                             TokType.KEYWORD)
+                             TokType.KEYWORD, TokType.LBRACKET)

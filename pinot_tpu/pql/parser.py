@@ -18,7 +18,7 @@ from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree, GroupBy,
                                       HavingNode, QueryOptions, Selection,
-                                      SelectionSort)
+                                      SelectionSort, VectorSimilarity)
 from pinot_tpu.pql.lexer import PqlSyntaxError, TokType, Token, tokenize
 
 # Aggregation function names the engine recognizes (PERCENTILE variants are
@@ -156,6 +156,7 @@ class _Parser:
         # -- assemble ------------------------------------------------------
         aggs = [it for it in select_items if isinstance(it, AggregationInfo)]
         cols = [it for it in select_items if isinstance(it, str)]
+        vecs = [it for it in select_items if isinstance(it, VectorSimilarity)]
         if aggs and cols:
             raise PqlSyntaxError(
                 "cannot mix aggregations and plain columns in SELECT "
@@ -163,6 +164,29 @@ class _Parser:
 
         req = BrokerRequest(table_name=table, filter=filt,
                             query_options=options)
+        if vecs:
+            if len(vecs) > 1:
+                raise PqlSyntaxError(
+                    "only one VECTOR_SIMILARITY clause per query")
+            if aggs or group_by_cols or having is not None or order_by:
+                raise PqlSyntaxError(
+                    "VECTOR_SIMILARITY cannot mix with aggregations, "
+                    "GROUP BY, HAVING or ORDER BY (results are ranked "
+                    "by similarity score)")
+            if "*" in cols:
+                raise PqlSyntaxError(
+                    "VECTOR_SIMILARITY with SELECT * is not supported — "
+                    "name the ride-along columns explicitly")
+            if top_n is not None or size is not None:
+                raise PqlSyntaxError(
+                    "VECTOR_SIMILARITY takes k as its third argument; "
+                    "TOP/LIMIT do not apply")
+            v = vecs[0]
+            req.vector = v
+            req.selection = Selection(columns=cols, order_by=[],
+                                      offset=0, size=v.k)
+            req.limit = v.k
+            return req
         if aggs:
             req.aggregations = aggs
             if group_by_cols:
@@ -194,12 +218,52 @@ class _Parser:
     def parse_select_item(self):
         t = self.peek()
         if t.type == TokType.IDENT and \
-                self.toks[self.i + 1].type == TokType.LPAREN and \
-                is_aggregation_function(t.value):
-            return self.parse_agg_call()
+                self.toks[self.i + 1].type == TokType.LPAREN:
+            if t.upper == "VECTOR_SIMILARITY":
+                return self.parse_vector_call()
+            if is_aggregation_function(t.value):
+                return self.parse_agg_call()
         if t.type == TokType.IDENT:
             return self.next().value
         raise PqlSyntaxError(f"bad select item at {t.pos}: {t.value!r}")
+
+    def parse_vector_call(self) -> VectorSimilarity:
+        """VECTOR_SIMILARITY(col, [f, f, ...], k[, 'COSINE'|'DOT'|'MIPS'])."""
+        self.next()                              # VECTOR_SIMILARITY
+        self.expect(TokType.LPAREN)
+        col = self.expect(TokType.IDENT).value
+        self.expect(TokType.COMMA)
+        self.expect(TokType.LBRACKET)
+        q: List[float] = []
+        while self.peek().type != TokType.RBRACKET:
+            t = self.next()
+            if t.type not in (TokType.INT, TokType.FLOAT):
+                raise PqlSyntaxError(
+                    f"expected a number in the query vector at {t.pos}, "
+                    f"got {t.value!r}")
+            q.append(float(t.value))
+            if self.peek().type == TokType.COMMA:
+                self.next()
+        self.expect(TokType.RBRACKET)
+        if not q:
+            raise PqlSyntaxError("empty query vector")
+        self.expect(TokType.COMMA)
+        t = self.peek()
+        k = int(self.expect(TokType.INT).value)
+        if k <= 0:
+            raise PqlSyntaxError(f"VECTOR_SIMILARITY k must be positive "
+                                 f"at {t.pos}, got {k}")
+        metric = "COSINE"
+        if self.peek().type == TokType.COMMA:
+            self.next()
+            m = self.expect(TokType.STRING).value.upper()
+            if m not in ("COSINE", "DOT", "MIPS"):
+                raise PqlSyntaxError(
+                    f"unknown similarity metric {m!r} "
+                    "(COSINE | DOT | MIPS)")
+            metric = m
+        self.expect(TokType.RPAREN)
+        return VectorSimilarity(column=col, query=q, k=k, metric=metric)
 
     def parse_agg_call(self) -> AggregationInfo:
         name = self.next().upper

@@ -72,13 +72,30 @@ def _merge_into(request: BrokerRequest,
                 b.selection_rows)
 
 
+def vector_order_key(columns: List[str]):
+    """Merge order for vector-similarity rows: score desc, then
+    (segment, docId) asc — total and deterministic, so every merge
+    topology (frozen+tail pair, per-server combine, broker reduce)
+    produces the same top-k as one global pass."""
+    si = columns.index("$score")
+    ni = columns.index("$segmentName")
+    di = columns.index("$docId")
+
+    def key(row: tuple):
+        return (-row[si], row[ni], row[di])
+
+    return key
+
+
 def merge_selection_rows(request: BrokerRequest, columns: List[str],
                          rows_a: List[tuple], rows_b: List[tuple]
                          ) -> List[tuple]:
     sel = request.selection
     limit = sel.offset + sel.size
     merged = list(rows_a) + list(rows_b)
-    if sel.order_by:
+    if request.vector is not None:
+        merged.sort(key=vector_order_key(columns))
+    elif sel.order_by:
         merged.sort(key=_order_key(sel.order_by, columns))
     return merged[:limit]
 
@@ -133,7 +150,12 @@ def _trim_selection(request: BrokerRequest,
     sel = request.selection
     limit = sel.offset + sel.size
     rows = out.selection_rows
-    if sel.order_by:
+    if not rows:
+        out.selection_rows = []
+        return
+    if request.vector is not None:
+        rows = sorted(rows, key=vector_order_key(out.selection_columns))
+    elif sel.order_by:
         rows = sorted(rows, key=_order_key(sel.order_by,
                                            out.selection_columns))
     out.selection_rows = rows[:limit]

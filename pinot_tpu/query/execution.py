@@ -51,6 +51,8 @@ def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
             cols[f"{col}.parts"] = ds.device_part_lanes()
         elif kind == "vlane":
             cols[f"{col}.vlane"] = ds.device_value_lane()
+        elif kind == "vec":
+            cols[f"{col}.vec"] = ds.device_vec_values()
     return cols
 
 
@@ -101,7 +103,10 @@ def _execute_segment_plan(plan) -> IntermediateResultsBlock:
             _finish_aggregation(plan, outs, blk)
     matched = int(outs["stats.num_docs_matched"])
     if plan.select_spec is not None:
-        _finish_selection(plan, outs, blk, matched)
+        if plan.select_spec[0] == "vector":
+            _finish_vector(plan, outs, blk, matched)
+        else:
+            _finish_selection(plan, outs, blk, matched)
 
     n_leaves = _count_filter_leaves(plan.filter_spec)
     n_project = len({c for c, _ in plan.needed_cols})
@@ -465,15 +470,28 @@ def _finish_group_by_ranked(plan, outs, blk) -> None:
     _assemble_group_map(plan, blk, value_cols, per_agg_arrays, len(nz))
 
 
-def _finish_selection(plan, outs, blk, matched: int) -> None:
-    kind, k, order, gather_cols = plan.select_spec
-    docids = np.asarray(outs["sel.docids"])
-    valid = docids >= 0
-    n = int(valid.sum())
-    columns = [c for c, _ in gather_cols]
+def vector_segment_identity(segment) -> Tuple[str, int]:
+    """(logical segment name, doc-id base) for vector result rows.
+
+    A consuming segment's device snapshot (`__frozen`, rows [0, start))
+    and host tail (`__tail`, rows [start, n)) are ONE logical segment:
+    stripping the suffix and offsetting tail docids by `start` makes
+    (name, $docId) identical to what a whole-segment host pass reports —
+    the bit-identical-ids contract across host/device/sharded paths.
+    """
+    name = getattr(segment, "segment_name", "?")
+    for suffix in ("__frozen", "__tail"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    return name, int(getattr(segment, "start", 0) or 0)
+
+
+def _decode_gather_columns(segment, gather_cols, outs, plain=None):
+    """Per-column decoded value arrays for selection/vector gather lanes."""
+    plain = plain or _plain
     col_values = []
     for col, source in gather_cols:
-        ds = plan.segment.data_source(col)
+        ds = segment.data_source(col)
         lane = np.asarray(outs[f"sel.{col}"])
         if source == "sv":
             vals = ds.dictionary.decode(np.clip(lane, 0,
@@ -482,9 +500,50 @@ def _finish_selection(plan, outs, blk, matched: int) -> None:
             vals = lane
         else:  # mv: [k, W] padded ids
             card = ds.metadata.cardinality
-            vals = [[_plain(ds.dictionary.get(i)) for i in row if i < card]
+            vals = [[plain(ds.dictionary.get(i)) for i in row if i < card]
                     for row in lane]
         col_values.append(vals)
+    return col_values
+
+
+def vector_result_rows(decode_segment, select_spec, outs,
+                       seg_name: str, doc_base: int) -> List[tuple]:
+    """Rows (user cols..., $docId, $segmentName, $score) from one
+    segment's kernel outputs. `decode_segment` supplies the dictionary
+    decode tables (the union view on the sharded path); name/base name
+    the rows' identity."""
+    _kind, _k, _order, gather_cols = select_spec
+    docids = np.asarray(outs["sel.docids"])
+    scores = np.asarray(outs["sel.scores"])
+    col_values = _decode_gather_columns(decode_segment, gather_cols, outs)
+    rows = []
+    for r in range(len(docids)):
+        if docids[r] < 0:
+            continue
+        rows.append(tuple(_plain(cv[r]) for cv in col_values) +
+                    (int(docids[r]) + doc_base, seg_name,
+                     float(scores[r])))
+    return rows
+
+
+def _finish_vector(plan, outs, blk, matched: int) -> None:
+    from pinot_tpu.common.request import VECTOR_RESULT_COLUMNS
+    name, base = vector_segment_identity(plan.segment)
+    blk.selection_rows = vector_result_rows(plan.segment, plan.select_spec,
+                                            outs, name, base)
+    blk.selection_columns = [c for c, _ in plan.select_spec[3]] + \
+        list(VECTOR_RESULT_COLUMNS)
+    blk.selection_display_cols = None
+    blk.stats.num_docs_scanned = matched
+
+
+def _finish_selection(plan, outs, blk, matched: int) -> None:
+    kind, k, order, gather_cols = plan.select_spec
+    docids = np.asarray(outs["sel.docids"])
+    valid = docids >= 0
+    n = int(valid.sum())
+    columns = [c for c, _ in gather_cols]
+    col_values = _decode_gather_columns(plan.segment, gather_cols, outs)
     rows = []
     for r in range(len(docids)):
         if not valid[r]:

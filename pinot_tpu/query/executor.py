@@ -108,6 +108,10 @@ class ServerQueryExecutor:
             if request.is_selection:
                 blk.selection_rows = []
                 blk.selection_columns = list(request.selection.columns)
+                if request.vector is not None:
+                    from pinot_tpu.common.request import \
+                        VECTOR_RESULT_COLUMNS
+                    blk.selection_columns += list(VECTOR_RESULT_COLUMNS)
         else:
             blk = combine_blocks(request, blocks)
         if truncated:

@@ -71,7 +71,9 @@ def execute_host(segment: ImmutableSegment, request: BrokerRequest
         blk.agg_intermediates = [
             _aggregate(segment, f, mask) for f in make_functions(
                 request.aggregations)]
-    if request.is_selection:
+    if request.vector is not None:
+        _vector_topk(segment, request, mask, blk)
+    elif request.is_selection:
         _selection(segment, request, mask, blk)
 
     blk.stats = ExecutionStats(
@@ -533,6 +535,117 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
 
 
 # ---------------------------------------------------------------------------
+# Vector similarity (exact filtered top-k — the oracle twin of the
+# device kernel's "vector" selection kind)
+# ---------------------------------------------------------------------------
+
+
+def _np_tree_sum(x: np.ndarray) -> np.ndarray:
+    """Balanced pairwise f32 sum over the last (pow2) axis — the host
+    half of the score exactness contract (kernels.vec_tree_sum): both
+    sides run the SAME sequence of IEEE f32 adds, so scores agree
+    bit-for-bit with the device kernel."""
+    x = np.asarray(x, np.float32)
+    while x.shape[-1] > 1:
+        x = x[..., 0::2] + x[..., 1::2]
+    return x[..., 0]
+
+
+def _np_vector_scores(mat: np.ndarray, query, metric: str) -> np.ndarray:
+    """float32 [n] similarity scores over pow2-dim-padded operands."""
+    dim = mat.shape[1]
+    dim_pad = 1
+    while dim_pad < max(dim, 1):
+        dim_pad *= 2
+    m = np.zeros((len(mat), dim_pad), np.float32)
+    m[:, :dim] = mat
+    q = np.zeros(dim_pad, np.float32)
+    q[:dim] = np.asarray(query, np.float32)
+    dot = _np_tree_sum(m * q[None, :])
+    if metric == "cosine":
+        q_norm = np.float32(np.sqrt(_np_tree_sum(q * q)))
+        if not q_norm > 0:
+            raise ValueError("COSINE similarity needs a non-zero, finite "
+                             "query vector")
+        denom = np.sqrt(_np_tree_sum(m * m)).astype(np.float32) * q_norm
+        with np.errstate(divide="ignore", invalid="ignore"):
+            scores = (dot / denom).astype(np.float32)
+        scores[~(denom > 0)] = -np.inf
+        return scores
+    return dot.astype(np.float32)
+
+
+def _vector_topk(segment: ImmutableSegment, request: BrokerRequest,
+                 mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.request import VECTOR_RESULT_COLUMNS
+    v = request.vector
+    ds = segment.data_source(v.column)
+    cm = ds.metadata
+    if cm.data_type != DataType.VECTOR:
+        raise ValueError(
+            f"VECTOR_SIMILARITY over non-VECTOR column '{v.column}'")
+    if len(v.query) != cm.vector_dimension:
+        raise ValueError(
+            f"query vector has {len(v.query)} dimensions; column "
+            f"'{v.column}' stores {cm.vector_dimension}")
+    # wire-arrived requests bypass the parser/planner guards, so the
+    # host twin re-validates k and metric itself
+    if v.k <= 0:
+        raise ValueError(f"VECTOR_SIMILARITY k must be positive, "
+                         f"got {v.k}")
+    metric = v.metric.lower()
+    if metric == "mips":
+        metric = "dot"
+    if metric not in ("cosine", "dot"):
+        raise ValueError(f"unknown similarity metric '{v.metric}' "
+                         "(COSINE | DOT | MIPS)")
+    # score ONLY the filter's candidates: per-row scores are independent
+    # of which other rows are scored (the tree contract is per-row), so
+    # this is bit-identical to scoring everything at a fraction of the
+    # work on selective queries
+    docids = np.nonzero(mask)[0]
+    s = _np_vector_scores(ds.vec_values[docids], v.query, metric)
+    # rank: score desc, docid asc — lexsort's LAST key is primary, and
+    # stability gives equal scores ascending docids (the device kernel's
+    # top_k tie-break)
+    order = np.lexsort((docids, -s))[: v.k]
+    docids = docids[order]
+    s = s[order]
+
+    # consuming tail views report GLOBAL docids under the base segment
+    # name, so frozen+tail merges are indistinguishable from a
+    # whole-segment pass (same contract as the device finish)
+    from pinot_tpu.query.execution import vector_segment_identity
+    name, base = vector_segment_identity(segment)
+
+    user_cols = list(request.selection.columns) if request.selection else []
+    decoded = {}
+    for c in user_cols:
+        cds = segment.data_source(c)
+        ccm = cds.metadata
+        if ccm.data_type == DataType.VECTOR:
+            decoded[c] = [[float(x) for x in row]
+                          for row in cds.vec_values[docids]]
+        elif not ccm.has_dictionary:
+            decoded[c] = cds.raw_values[docids]
+        elif ccm.single_value:
+            decoded[c] = cds.dictionary.values[cds.dict_ids[docids]]
+        else:
+            card = ccm.cardinality
+            decoded[c] = [
+                [_plain(cds.dictionary.get(i)) for i in row if i < card]
+                for row in cds.mv_dict_ids[docids]]
+    rows = []
+    for r in range(len(docids)):
+        rows.append(tuple(_plain(decoded[c][r]) for c in user_cols) +
+                    (int(docids[r]) + base, name, float(s[r])))
+    blk.selection_rows = rows
+    blk.selection_columns = user_cols + list(VECTOR_RESULT_COLUMNS)
+    blk.selection_display_cols = None
+
+
+# ---------------------------------------------------------------------------
 # Selection
 # ---------------------------------------------------------------------------
 
@@ -550,6 +663,9 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
         for ob in reversed(sel.order_by):  # lexsort: last key is primary
             ds = segment.data_source(ob.column)
             cm = ds.metadata
+            if getattr(ds, "vec_values", None) is not None:
+                raise ValueError("order-by on VECTOR column (use "
+                                 "VECTOR_SIMILARITY for ranked results)")
             if cm.has_dictionary and cm.single_value:
                 k = ds.dict_ids[docids].astype(np.int64)
             elif not cm.has_dictionary:
@@ -571,7 +687,10 @@ def _selection(segment: ImmutableSegment, request: BrokerRequest,
     for c in cols:
         ds = segment.data_source(c)
         cm = ds.metadata
-        if not cm.has_dictionary:
+        if getattr(ds, "vec_values", None) is not None:
+            decoded[c] = [[float(x) for x in row]
+                          for row in ds.vec_values[docids]]
+        elif not cm.has_dictionary:
             decoded[c] = ds.raw_values[docids]
         elif cm.single_value:
             decoded[c] = ds.dictionary.values[ds.dict_ids[docids]]

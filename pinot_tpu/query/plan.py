@@ -205,6 +205,12 @@ def _resolve_leaf(node: FilterQueryTree, segment: ImmutableSegment,
         return _resolve_expr_leaf(node, segment, params)
     ds = segment.data_source(node.column)
     cm = ds.metadata
+    if cm.data_type == DataType.VECTOR:
+        # embeddings have no value order or equality semantics a WHERE
+        # predicate could use; similarity is the VECTOR_SIMILARITY clause
+        raise ValueError(
+            f"column '{node.column}' is a VECTOR column — WHERE "
+            "predicates over embeddings are not supported")
     op = node.operator
 
     if not cm.has_dictionary:
@@ -503,7 +509,9 @@ class InstancePlanMaker:
         elif request.is_aggregation:
             plan.agg_specs = tuple(
                 _agg_device_spec(f, segment, needed) for f in plan.functions)
-        if request.is_selection:
+        if request.vector is not None:
+            self._plan_vector(plan, segment, request, needed)
+        elif request.is_selection:
             self._plan_selection(plan, segment, request, needed)
 
         plan.needed_cols = tuple(needed.keys())
@@ -672,6 +680,69 @@ class InstancePlanMaker:
         plan.group_spec = (tuple(gcols), strides, g_pad, agg_specs, kmax)
         plan.group_strides = strides
 
+    def _plan_vector(self, plan: SegmentPlan, segment: ImmutableSegment,
+                     request: BrokerRequest, needed: Dict) -> None:
+        """Ranked vector selection: filtered batched top-k over the
+        packed embedding block. The WHERE filter (and the upsert vdoc
+        lane) is already fused into plan.filter_spec, so predicate
+        pruning narrows the candidate mask BEFORE scores rank — a dead
+        upserted row can never reach the top-k."""
+        v = request.vector
+        ds = segment.data_source(v.column)
+        cm = ds.metadata
+        if cm.data_type != DataType.VECTOR:
+            raise ValueError(
+                f"VECTOR_SIMILARITY over non-VECTOR column '{v.column}'")
+        dim = cm.vector_dimension
+        q_raw = np.asarray(v.query, dtype=np.float32)
+        if q_raw.shape != (dim,):
+            raise ValueError(
+                f"query vector has {q_raw.shape[0] if q_raw.ndim == 1 else '?'}"
+                f" dimensions; column '{v.column}' stores {dim}")
+        if v.k <= 0:
+            raise ValueError(f"VECTOR_SIMILARITY k must be positive, "
+                             f"got {v.k}")
+        metric = v.metric.lower()
+        if metric == "mips":
+            metric = "dot"
+        if metric not in ("cosine", "dot"):
+            raise ValueError(f"unknown similarity metric '{v.metric}' "
+                             "(COSINE | DOT | MIPS)")
+        gather = []
+        for c in request.selection.columns if request.selection else []:
+            cds = segment.data_source(c)
+            ccm = cds.metadata
+            if ccm.data_type == DataType.VECTOR:
+                raise UnsupportedOnDevice(
+                    f"selection of VECTOR column {c} (host path)")
+            if not ccm.has_dictionary:
+                if ccm.data_type.np_dtype.kind not in "iuf":
+                    raise UnsupportedOnDevice(
+                        f"selection over non-numeric raw column {c}")
+                gather.append((c, "raw"))
+                needed[(c, "raw")] = None
+            elif ccm.single_value:
+                gather.append((c, "sv"))
+                needed[(c, "ids")] = None
+            else:
+                gather.append((c, "mv"))
+                needed[(c, "mv")] = None
+        dim_pad = kernels.pow2_bucket(max(dim, 1), floor=1)
+        q = np.zeros(dim_pad, np.float32)
+        q[:dim] = q_raw
+        q_norm = np_vec_tree_norm(q)
+        if metric == "cosine" and not q_norm > 0:
+            raise ValueError("COSINE similarity needs a non-zero, finite "
+                             "query vector")
+        k = min(kernels.pow2_bucket(v.k, floor=1), segment.padded_docs)
+        plan.select_spec = ("vector", k, ((v.column, metric, dim_pad),),
+                            tuple(gather))
+        plan.select_display = None
+        needed[(v.column, "vec")] = None
+        # runtime operands AFTER the filter params (depth-first order)
+        plan.params.append(q)
+        plan.params.append(np.float32(q_norm))
+
     def _plan_selection(self, plan: SegmentPlan, segment: ImmutableSegment,
                         request: BrokerRequest, needed: Dict) -> None:
         sel = request.selection
@@ -685,6 +756,11 @@ class InstancePlanMaker:
         gather = []
         for c in cols + extras:
             ds = segment.data_source(c)
+            if ds.metadata.data_type == DataType.VECTOR:
+                # embedding rows have no device gather lane; the host
+                # executor decodes them as per-row float lists
+                raise UnsupportedOnDevice(
+                    f"selection over VECTOR column {c}")
             if not ds.metadata.has_dictionary:
                 if ds.metadata.data_type.np_dtype.kind not in "iuf":
                     # chunked raw string/bytes: object arrays have no
@@ -742,6 +818,18 @@ class InstancePlanMaker:
             # general path: per-column int32 key lanes, full device sort —
             # covers >31-bit dict packings, raw columns, and mixes
             plan.select_spec = ("ordermk", k, tuple(order), tuple(gather))
+
+
+def np_vec_tree_norm(q: np.ndarray) -> np.float32:
+    """f32 balanced-tree norm of a (pow2-padded) query vector.
+
+    Delegates to kernels.vec_tree_sum on a NUMPY operand (the helper is
+    pure slicing + adds, backend-agnostic), so the engine has exactly
+    ONE tree implementation: the q_norm operand the device divides by
+    is the same contract the kernel applies to row norms. The host
+    oracle (host_exec) keeps its independent twin by policy."""
+    qf = np.asarray(q, np.float32)
+    return np.float32(np.sqrt(kernels.vec_tree_sum(qf * qf)))
 
 
 def mixed_radix_strides(cards) -> tuple:
@@ -1074,6 +1162,10 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
         return ("hist", src, "sv", ("hist", card_pad))
     ds = segment.data_source(col)
     cm = ds.metadata
+    if cm.data_type == DataType.VECTOR:
+        raise ValueError(
+            f"aggregation {base} over VECTOR column '{col}' is not "
+            "supported (use VECTOR_SIMILARITY for ranking)")
     fname = {
         "COUNT": "countmv" if f.info.is_mv else "count",
         "SUM": "sum", "MIN": "min", "MAX": "max", "AVG": "avg",
@@ -1174,7 +1266,12 @@ def _empty_block(plan: SegmentPlan, segment: ImmutableSegment
         blk.group_map = {}
     elif plan.request.is_aggregation:
         blk.agg_intermediates = [None for _ in plan.functions]
-    if plan.request.is_selection:
+    if plan.request.vector is not None:
+        from pinot_tpu.common.request import VECTOR_RESULT_COLUMNS
+        blk.selection_rows = []
+        blk.selection_columns = list(plan.request.selection.columns) + \
+            list(VECTOR_RESULT_COLUMNS)
+    elif plan.request.is_selection:
         blk.selection_rows = []
         blk.selection_columns = selection_columns(segment, plan.request)
     _fill_stats(blk, segment, 0, 0, 0)

@@ -153,22 +153,56 @@ class _GrowableArray:
         return self._arr[:n]
 
 
+class _GrowableMatrix:
+    """Append-only [n, dim] float32 matrix with capacity doubling — the
+    consuming-side vector forward block. Same reader contract as
+    _GrowableArray: growth copies into a NEW buffer, rows land beyond
+    every published n, so [:n] snapshots stay stable."""
+
+    def __init__(self, dim: int, capacity: int = 4096):
+        self._arr = np.zeros((capacity, dim), np.float32)
+        self.n = 0
+
+    def extend(self, rows: np.ndarray) -> None:
+        need = self.n + len(rows)
+        if need > len(self._arr):
+            cap = len(self._arr)
+            while cap < need:
+                cap *= 2
+            bigger = np.zeros((cap, self._arr.shape[1]), np.float32)
+            bigger[: self.n] = self._arr[: self.n]
+            self._arr = bigger  # tpulint: disable=concurrency -- single consumer-thread writer (same invariant as _GrowableArray): growth publishes a fully-copied buffer
+        self._arr[self.n: need] = rows  # tpulint: disable=concurrency -- same single-writer invariant; rows land beyond every published n
+        self.n = need  # tpulint: disable=concurrency -- same single-writer invariant: n publishes after the row writes
+
+    def snapshot(self, n: int) -> np.ndarray:
+        return self._arr[:n]
+
+
 class _MutableDataSource:
     """DataSource-compatible column view over mutable storage."""
 
     def __init__(self, field: FieldSpec, has_dictionary: bool,
                  initial_capacity: int = 4096):
         self.field = field
-        self.has_dictionary = has_dictionary
+        self.is_vector = field.data_type == DataType.VECTOR
+        self.has_dictionary = has_dictionary and not self.is_vector
         self.dictionary = MutableDictionary(field.data_type) \
-            if has_dictionary else None
+            if self.has_dictionary else None
         self.inverted_index = None
         self.bloom_filter = None
         self.sorted_ranges = None
-        if field.single_value:
-            dtype = np.int32 if has_dictionary else field.data_type.np_dtype
-            self._sv = _GrowableArray(dtype, capacity=initial_capacity)
+        self._vec: Optional[_GrowableMatrix] = None
+        if self.is_vector:
+            self._vec = _GrowableMatrix(field.vector_dimension,
+                                        capacity=initial_capacity)
+            self._sv = None
             self._mv: Optional[List[List[int]]] = None
+        elif field.single_value:
+            dtype = np.int32 if self.has_dictionary \
+                else field.data_type.np_dtype
+            self._sv = _GrowableArray(dtype, capacity=initial_capacity)
+            self._mv = None
         else:
             self._sv = None
             self._mv = []
@@ -178,7 +212,9 @@ class _MutableDataSource:
     # -- write path --------------------------------------------------------
     def add(self, value) -> None:
         f = self.field
-        if f.single_value:
+        if self.is_vector:
+            self._vec.extend(f.convert(value)[None])
+        elif f.single_value:
             v = f.convert(value)
             if self.has_dictionary:
                 self._sv.append(self.dictionary.index_of_or_add(v))
@@ -195,6 +231,11 @@ class _MutableDataSource:
         """Batch write path (one listcomp/array op per column instead of
         per-row python dispatch — the consume loop's 2x)."""
         f = self.field
+        if self.is_vector:
+            self._vec.extend(np.stack([f.convert(v) for v in values])
+                             if values else
+                             np.zeros((0, f.vector_dimension), np.float32))
+            return
         if not f.single_value:
             for v in values:
                 self.add(v)
@@ -227,7 +268,8 @@ class _MutableDataSource:
             else None,
             max_value=self.dictionary.max_value if self.has_dictionary
             else None,
-            total_number_of_entries=self._snapshot_n)
+            total_number_of_entries=self._snapshot_n,
+            vector_dimension=self.field.vector_dimension)
 
     @property
     def dict_ids(self) -> Optional[np.ndarray]:
@@ -240,6 +282,12 @@ class _MutableDataSource:
         if self._sv is None or self.has_dictionary:
             return None
         return self._sv.snapshot(self._snapshot_n)
+
+    @property
+    def vec_values(self) -> Optional[np.ndarray]:
+        if self._vec is None:
+            return None
+        return self._vec.snapshot(self._snapshot_n)
 
     @property
     def mv_dict_ids(self) -> Optional[np.ndarray]:
@@ -257,8 +305,11 @@ class _MutableDataSource:
         self._mv_cache = out
         return out
 
-    def raw_column(self, n: int) -> List:
+    def raw_column(self, n: int):
         """Decoded values for the segment converter."""
+        if self._vec is not None:
+            # 2-D float32 block: the creator's VECTOR branch takes it
+            return np.array(self._vec.snapshot(n), copy=True)
         if self._mv is not None:
             return [[self.dictionary.get(i) for i in r]
                     for r in self._mv[:n]]
@@ -344,7 +395,8 @@ class _SnapshotSource:
             else None,
             max_value=self.dictionary.max_value if self.has_dictionary
             else None,
-            total_number_of_entries=self._n - self._start)
+            total_number_of_entries=self._n - self._start,
+            vector_dimension=self.field.vector_dimension)
 
     @property
     def dict_ids(self) -> Optional[np.ndarray]:
@@ -357,6 +409,12 @@ class _SnapshotSource:
         if self._ds._sv is None or self.has_dictionary:
             return None
         return self._ds._sv.snapshot(self._n)[self._start:]
+
+    @property
+    def vec_values(self) -> Optional[np.ndarray]:
+        if self._ds._vec is None:
+            return None
+        return self._ds._vec.snapshot(self._n)[self._start:]
 
     @property
     def mv_dict_ids(self) -> Optional[np.ndarray]:
@@ -595,6 +653,18 @@ class MutableSegmentImpl:
         col_meta: Dict[str, ColumnMetadata] = {}
         for name, ms in self._sources.items():
             f = ms.field
+            if ms.is_vector:
+                mat = np.array(ms._vec.snapshot(n), copy=True)
+                cm = ColumnMetadata(
+                    name=name, data_type=f.data_type, cardinality=n,
+                    bits_per_element=32, single_value=True,
+                    has_dictionary=False, total_number_of_entries=n,
+                    vector_dimension=f.vector_dimension)
+                ds = DataSource(cm, None)
+                ds.vec_values = mat
+                sources[name] = ds
+                col_meta[name] = cm
+                continue
             if not ms.has_dictionary:
                 raw = np.array(ms._sv.snapshot(n), copy=True)
                 cm = ColumnMetadata(

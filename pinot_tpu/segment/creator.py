@@ -24,7 +24,7 @@ from pinot_tpu.segment.bloom import BloomFilter
 from pinot_tpu.segment.dictionary import Dictionary
 from pinot_tpu.segment.fwd import (SVForwardIndexWriter, bits_required,
                                    write_mv_fwd, write_raw_fwd,
-                                   write_sorted_fwd)
+                                   write_sorted_fwd, write_vec_fwd)
 from pinot_tpu.segment.inverted import InvertedIndexWriter
 from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
 
@@ -141,6 +141,34 @@ class SegmentCreator:
             if name not in columns:
                 raise ValueError(f"missing column {name}")
             raw = columns[name]
+            if field.data_type == DataType.VECTOR:
+                # packed fixed-width float32 forward block (no
+                # dictionary/inverted/bloom — embeddings are dense,
+                # effectively all-distinct payloads served row-parallel
+                # by the batched similarity kernels)
+                if isinstance(raw, np.ndarray) and raw.ndim == 2:
+                    mat = np.asarray(raw, dtype=np.float32)
+                    if mat.shape[1] != field.vector_dimension:
+                        raise ValueError(
+                            f"column {name}: vector width {mat.shape[1]} "
+                            f"!= schema dimension {field.vector_dimension}")
+                else:
+                    mat = np.stack([field.convert(v) for v in raw]) \
+                        if len(raw) else \
+                        np.zeros((0, field.vector_dimension), np.float32)
+                n = len(mat)
+                if num_docs is None:
+                    num_docs = n
+                elif num_docs != n:
+                    raise ValueError(
+                        f"column {name} length {n} != {num_docs}")
+                write_vec_fwd(out_dir, name, mat)
+                col_meta[name] = ColumnMetadata(
+                    name=name, data_type=field.data_type, cardinality=n,
+                    bits_per_element=32, has_dictionary=False,
+                    total_number_of_entries=n,
+                    vector_dimension=field.vector_dimension)
+                continue
             encoded = isinstance(raw, DictionaryEncodedColumn) and \
                 field.single_value
             if encoded:

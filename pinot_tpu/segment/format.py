@@ -29,6 +29,8 @@ SV_SORTED_FWD = "{col}.sv.sorted.fwd.npy"
 SV_RAW_FWD = "{col}.sv.raw.fwd.npy"
 MV_FWD = "{col}.mv.fwd.npy"
 MV_OFFSETS = "{col}.mv.offsets.npy"
+# VECTOR column: packed fixed-width [num_docs, dimension] float32 block
+VEC_FWD = "{col}.vec.fwd.npy"
 
 INV_DOCIDS = "{col}.inv.docids.npy"
 INV_OFFSETS = "{col}.inv.offsets.npy"

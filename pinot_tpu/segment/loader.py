@@ -20,7 +20,8 @@ from pinot_tpu.segment import format as fmt
 from pinot_tpu.segment.bloom import BloomFilter
 from pinot_tpu.segment.dictionary import Dictionary
 from pinot_tpu.segment.fwd import (mv_to_padded, read_mv_fwd, read_raw_fwd,
-                                   read_sorted_fwd, read_sv_fwd)
+                                   read_sorted_fwd, read_sv_fwd,
+                                   read_vec_fwd)
 from pinot_tpu.segment.inverted import InvertedIndexReader
 from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
 
@@ -53,6 +54,14 @@ def pad_dict_values(values: np.ndarray, np_dtype) -> np.ndarray:
     card_pad = pow2_bucket(len(values) + 1)
     return np.concatenate(
         [values, np.full(card_pad - len(values), values[-1], values.dtype)])
+
+
+def vec_dim_pad(dim: int) -> int:
+    """Pow2-bucketed vector width: the tree-dot kernels halve the dim
+    axis pairwise, and one bucket per pow2 keeps the jit cache small.
+    Padding lanes are zero — an exact no-op in every dot/norm sum."""
+    from pinot_tpu.ops.kernels import pow2_bucket
+    return pow2_bucket(max(dim, 1), floor=1)
 
 
 def int_part_info_for(values: np.ndarray) -> tuple:
@@ -91,7 +100,8 @@ def segment_host_bytes(seg) -> int:
         if chunks is not None and raw is None:
             total += len(chunks._data)
         for arr in (getattr(ds, "dict_ids", None), raw,
-                    getattr(ds, "mv_dict_ids", None)):
+                    getattr(ds, "mv_dict_ids", None),
+                    getattr(ds, "vec_values", None)):
             total += _arr_bytes(arr)
         vals = getattr(getattr(ds, "dictionary", None), "values", None)
         total += _arr_bytes(vals)
@@ -137,6 +147,7 @@ class DataSource:
         # raw_values materializes lazily for scan paths
         self.raw_chunks = None
         self.mv_dict_ids: Optional[np.ndarray] = None     # int32 [docs, width]
+        self.vec_values: Optional[np.ndarray] = None      # f32 [docs, dim]
         self.sorted_ranges: Optional[np.ndarray] = None   # [card, 2]
         self.inverted_index: Optional[InvertedIndexReader] = None
         self.bloom_filter: Optional[BloomFilter] = None
@@ -171,6 +182,12 @@ class DataSource:
     def device_value_lane(self):
         """Decoded dictionary-value lane [P] for float sums."""
         return self._device("value_lane", self.host_operand("vlane"))
+
+    def device_vec_values(self):
+        """Padded [P, dim_pad] float32 embedding block on device; row
+        padding is zeros (masked by the kernel's validity iota), dim
+        padding is zeros (an exact no-op in the tree-dot sums)."""
+        return self._device("vec_values", self.host_operand("vec"))
 
     def int_part_info(self) -> tuple:
         """(n_parts, min_value) for the bit-sliced integer sum encoding.
@@ -212,6 +229,13 @@ class DataSource:
             vals = np.asarray(self.dictionary.values, dtype=np.float64)
             vals = np.concatenate([vals, [0.0]])
             return vals[self.host_operand("ids")]
+        if kind == "vec":
+            mat = self.vec_values
+            p = padded_size(len(mat))
+            dp = vec_dim_pad(self.metadata.vector_dimension)
+            out = np.zeros((p, dp), dtype=np.float32)
+            out[: len(mat), : mat.shape[1]] = mat
+            return out
         raise ValueError(kind)
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
@@ -331,6 +355,8 @@ class ImmutableSegment:
                 ds.device_dict_ids()
                 if ds.metadata.data_type.is_numeric:
                     ds.device_dict_values()
+            elif getattr(ds, "vec_values", None) is not None:
+                ds.device_vec_values()
             elif ds.raw_chunks is not None:
                 pass      # no device lane for string/bytes raw columns
             elif ds.raw_values is not None:
@@ -367,6 +393,10 @@ class ImmutableSegmentLoader:
         sources: Dict[str, DataSource] = {}
         for name, cm in meta.columns.items():
             ds = DataSource(cm, None)
+            if cm.data_type == DataType.VECTOR:
+                ds.vec_values = read_vec_fwd(seg_dir, name)
+                sources[name] = ds
+                continue
             if not cm.has_dictionary:
                 from pinot_tpu.segment.rawchunks import (ChunkedRawReader,
                                                          has_raw_chunks)
@@ -434,6 +464,17 @@ class ImmutableSegmentLoader:
 def _default_column(field, num_docs: int) -> DataSource:
     """Constant default-value column (parity: DefaultColumnHandler +
     virtual default column providers)."""
+    if field.data_type == DataType.VECTOR:
+        # segments predating the vector field serve zero embeddings
+        cm = ColumnMetadata(
+            name=field.name, data_type=field.data_type,
+            cardinality=num_docs, bits_per_element=32,
+            has_dictionary=False, total_number_of_entries=num_docs,
+            vector_dimension=field.vector_dimension)
+        ds = DataSource(cm, None)
+        ds.vec_values = np.zeros((num_docs, field.vector_dimension),
+                                 np.float32)
+        return ds
     default = field.default_null_value
     cm = ColumnMetadata(
         name=field.name, data_type=field.data_type, cardinality=1,

@@ -41,6 +41,9 @@ class ColumnMetadata:
     # `derived_from`, targeted by the FASTHLL broker-request rewrite)
     derived_metric_type: Optional[str] = None
     derived_from: Optional[str] = None
+    # VECTOR columns: fixed embedding dimension of the packed [n, dim]
+    # float32 forward block
+    vector_dimension: int = 0
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)

@@ -62,6 +62,13 @@ echo "== tenant isolation (ingress control) =="
 # run in tier-1 above — this drives the stack end to end
 env JAX_PLATFORMS=cpu python scripts/tenant_isolation_smoke.py
 
+echo "== vector search (similarity over mutable embeddings) =="
+# embedded cluster with a primary-key upsert table carrying a VECTOR
+# column: filtered VECTOR_SIMILARITY top-k must match the independent
+# numpy oracle bit-exactly, an upsert published mid-run must rank FIRST
+# on the next converged query, and the superseded row must never rank
+env JAX_PLATFORMS=cpu python scripts/vector_smoke.py
+
 echo "== qps smoke (serving plane) =="
 # one short target-QPS rung over the real TCP mux: catches serving-plane
 # regressions (per-connection serialization, serde blow-ups) in seconds

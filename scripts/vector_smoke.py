@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Vector-search smoke gate: filtered top-k over MUTABLE embeddings.
+
+Boots an embedded cluster with a primary-key upsert REALTIME table
+carrying a VECTOR(16) embedding column, streams rows with duplicated
+keys (so superseded embeddings accumulate behind the validDocIds mask),
+then asserts end to end through the broker:
+
+- PARITY: the filtered VECTOR_SIMILARITY top-k returned by the cluster
+  equals an independent numpy oracle computed over the LATEST row per
+  key (balanced-tree f32 scores — the engine's exactness contract),
+  scores bit-identical;
+- FRESHNESS: an upsert published MID-RUN (a known key gets a crafted
+  perfect-match embedding) is ranked FIRST by the next converged query,
+  and the superseded row never ranks again;
+- MASKING: no dead (superseded) rid ever appears in any top-k.
+
+Exit code 0 on success, 1 otherwise. Env knobs:
+  VECTOR_SMOKE_ROWS      rows published initially (default 400)
+  VECTOR_SMOKE_KEYS      distinct primary keys     (default 100)
+  VECTOR_SMOKE_WINDOW_S  convergence window        (default 60)
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+ROWS = int(os.environ.get("VECTOR_SMOKE_ROWS", "400"))
+KEYS = int(os.environ.get("VECTOR_SMOKE_KEYS", "100"))
+WINDOW_S = float(os.environ.get("VECTOR_SMOKE_WINDOW_S", "60"))
+DIM = 16
+K = 5
+TOPIC = "vector_smoke_topic"
+RT_TABLE = "vecfeed_REALTIME"
+
+
+def wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = cond()
+            if last:
+                return last
+        except Exception:  # noqa: BLE001 — still converging
+            pass
+        time.sleep(0.1)
+    print(f"FAIL: timed out waiting for {what} (last={last!r})",
+          file=sys.stderr)
+    return None
+
+
+def tree_scores(mat, q):
+    """The engine's f32 balanced-tree cosine scores, independently."""
+    dim_pad = 1
+    while dim_pad < mat.shape[1]:
+        dim_pad *= 2
+    m = np.zeros((len(mat), dim_pad), np.float32)
+    m[:, : mat.shape[1]] = mat
+    qp = np.zeros(dim_pad, np.float32)
+    qp[: len(q)] = q
+
+    def tree(x):
+        x = np.asarray(x, np.float32)
+        while x.shape[-1] > 1:
+            x = x[..., 0::2] + x[..., 1::2]
+        return x[..., 0]
+
+    dot = tree(m * qp[None, :])
+    denom = np.sqrt(tree(m * m)).astype(np.float32) * \
+        np.float32(np.sqrt(tree(qp * qp)))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s = (dot / denom).astype(np.float32)
+    s[~(denom > 0)] = -np.inf
+    return s
+
+
+def main() -> int:
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (Schema, TimeUnit, dimension,
+                                         metric, time_field, vector)
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig, TableConfig,
+                                               TableType, UpsertConfig)
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    rng = np.random.default_rng(1234)
+    schema = Schema("vecfeed", [
+        dimension("key", DataType.STRING),
+        dimension("shard", DataType.INT),
+        metric("rid", DataType.INT),
+        vector("emb", DIM),
+        time_field("ts", DataType.INT, TimeUnit.DAYS),
+    ])
+    stream = MemoryStream(TOPIC, num_partitions=1)
+    registry.register_stream_factory(
+        f"mem_{TOPIC}", MemoryStreamConsumerFactory(stream, batch_size=50))
+    cfg = TableConfig(
+        "vecfeed", table_type=TableType.REALTIME,
+        indexing_config=IndexingConfig(stream_configs={
+            "stream.factory.name": f"mem_{TOPIC}",
+            "stream.topic.name": TOPIC,
+            "realtime.segment.flush.threshold.size": "1000000",
+            "realtime.segment.flush.threshold.time.ms": "600000000",
+        }),
+        segments_config=SegmentsConfig(replication=1,
+                                       time_column_name="ts"))
+    cfg.upsert_config = UpsertConfig(mode="FULL",
+                                     primary_key_columns=["key"])
+
+    rows = []
+    for i in range(ROWS):
+        rows.append({
+            "key": f"k{i % KEYS}",
+            "shard": int(i % 4),
+            "rid": i,
+            "emb": [float(x) for x in
+                    rng.standard_normal(DIM).astype(np.float32)],
+            "ts": 1 + (i % 30),
+        })
+
+    q = rng.standard_normal(DIM).astype(np.float32)
+    qs = ", ".join(repr(float(x)) for x in q)
+    pql = (f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], {K}, 'COSINE') "
+           "FROM vecfeed WHERE shard < 2")
+
+    def latest(rows_):
+        by_key = {}
+        for r in rows_:
+            by_key[r["key"]] = r
+        return list(by_key.values())
+
+    def oracle_topk(rows_):
+        live = latest(rows_)
+        cand = [r for r in live if r["shard"] < 2]
+        mat = np.asarray([r["emb"] for r in cand], np.float32)
+        s = tree_scores(mat, q)
+        order = np.lexsort((np.asarray([r["rid"] for r in cand]), -s))[:K]
+        return [(cand[i]["rid"], float(s[i])) for i in order]
+
+    work_dir = tempfile.mkdtemp(prefix="vector_smoke_")
+    cluster = EmbeddedCluster(work_dir, num_servers=1)
+    ok = False
+    try:
+        cluster.add_schema(schema)
+        cluster.add_table(cfg)
+        for r in rows:
+            stream.publish(r, partition=0)
+
+        def topk():
+            resp = cluster.query(pql)
+            if resp.exceptions or resp.selection_results is None:
+                return None
+            return [(int(row[0]), float(row[-1]))
+                    for row in resp.selection_results.results]
+
+        exp = oracle_topk(rows)
+        got = wait_for(lambda: topk() == exp and topk(), WINDOW_S,
+                       "initial top-k parity")
+        if got is None:
+            print(f"FAIL: parity — expected {exp}, last {topk()}",
+                  file=sys.stderr)
+            return 1
+        print(f"vector_smoke: initial filtered top-{K} matches the "
+              f"numpy oracle bit-exactly: {exp}")
+
+        # mid-run upsert: the CURRENT winner's key gets a perfect-match
+        # embedding; the superseded row must never rank again
+        old_rid = exp[0][0]
+        old_key = rows[old_rid]["key"]
+        unit = (q / np.linalg.norm(q)).astype(np.float32)
+        new_row = {"key": old_key, "shard": 0, "rid": ROWS + 1,
+                   "emb": [float(x) for x in unit], "ts": 31}
+        rows.append(new_row)
+        stream.publish(new_row, partition=0)
+        exp2 = oracle_topk(rows)
+        assert exp2[0][0] == ROWS + 1, exp2
+        got2 = wait_for(lambda: topk() == exp2 and topk(), WINDOW_S,
+                        "post-upsert freshness")
+        if got2 is None:
+            print(f"FAIL: freshness — expected {exp2}, last {topk()}",
+                  file=sys.stderr)
+            return 1
+        if any(rid == old_rid for rid, _ in got2):
+            print(f"FAIL: superseded rid {old_rid} still ranks: {got2}",
+                  file=sys.stderr)
+            return 1
+        print(f"vector_smoke: upserted embedding ranked FIRST on the "
+              f"next converged query (rid {ROWS + 1}); superseded rid "
+              f"{old_rid} never ranked again")
+        ok = True
+    finally:
+        cluster.stop()
+    print("vector_smoke: PASS" if ok else "vector_smoke: FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,44 @@ class Oracle:
             return float("-inf")
         return float(v[min((len(v) * q) // 100, len(v) - 1)])
 
+    # -- vector similarity -------------------------------------------------
+    def vector_topk(self, col: str, query, k: int, m,
+                    metric: str = "cosine"):
+        """Exact filtered top-k over an embedding column: list of
+        (docid, score) ranked score-desc with docid-asc tie-break.
+
+        The score is the engine's contract — a balanced pairwise f32
+        tree over the pow2-padded dim axis (cosine divides by the f32
+        tree norms) — written here independently of the engine code.
+        """
+        mat = np.asarray(self.cols[col], dtype=np.float32)
+        q = np.asarray(query, dtype=np.float32)
+        dim_pad = 1
+        while dim_pad < max(mat.shape[1], 1):
+            dim_pad *= 2
+        mp = np.zeros((len(mat), dim_pad), np.float32)
+        mp[:, : mat.shape[1]] = mat
+        qp = np.zeros(dim_pad, np.float32)
+        qp[: len(q)] = q
+
+        def tree(x):
+            x = np.asarray(x, np.float32)
+            while x.shape[-1] > 1:
+                x = x[..., 0::2] + x[..., 1::2]
+            return x[..., 0]
+
+        scores = tree(mp * qp[None, :])
+        if metric.lower() in ("cosine",):
+            denom = np.sqrt(tree(mp * mp)).astype(np.float32) * \
+                np.float32(np.sqrt(tree(qp * qp)))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                scores = (scores / denom).astype(np.float32)
+            scores[~(denom > 0)] = -np.inf
+        docs = np.nonzero(m)[0]
+        s = scores[docs]
+        order = np.lexsort((docs, -s))[:k]
+        return [(int(docs[i]), float(s[i])) for i in order]
+
     # -- group by ----------------------------------------------------------
     def group_by(self, gcols: List[str], m, agg):
         """agg: (name, col) → dict[group_tuple → final value]."""

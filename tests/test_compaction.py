@@ -548,6 +548,59 @@ def test_merge_end_to_end_replaces_inputs_exactly(work_dir):
         cluster.stop()
 
 
+def test_merge_rollup_time_bucketing_respects_boundaries():
+    """`bucketTimePeriodMs` groups merge inputs by startTime bucket so
+    no merged output spans a bucket (= retention window) boundary; unset
+    keeps the one-global-bundle behavior."""
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.minion.task_manager import MergeRollupTaskGenerator
+
+    day = 86_400_000
+    metas = {f"s{i}": {"status": "DONE", "totalDocs": 100,
+                       "downloadPath": f"/x/s{i}",
+                       "startTime": (i // 2) * day + i}
+             for i in range(6)}           # buckets: day0 x2, day1 x2, day2 x2
+
+    class StubManager:
+        def segment_names(self, table):
+            return sorted(metas)
+
+        def segment_metadata(self, table, seg):
+            return metas[seg]
+
+    class StubQueue:
+        def tasks_for_segment(self, ttype, table, seg):
+            return []
+
+    def gen(cfg_extra):
+        cfg = TableConfig("t")
+        cfg.task_configs = {"MergeRollupTask": dict(
+            {"smallSegmentDocsThreshold": "1000",
+             "maxNumSegmentsPerTask": "8"}, **cfg_extra)}
+        return MergeRollupTaskGenerator().generate(
+            "t_OFFLINE", cfg, StubManager(), StubQueue())
+
+    # unbucketed: one global bundle of all 6
+    tasks = gen({})
+    assert len(tasks) == 1
+    assert tasks[0].configs["segmentName"].count(",") == 5
+
+    # bucketed by day: three 2-segment tasks, none crossing a boundary
+    tasks = gen({"bucketTimePeriodMs": str(day)})
+    assert len(tasks) == 3
+    for t in tasks:
+        batch = t.configs["segmentName"].split(",")
+        buckets = {metas[s]["startTime"] // day for s in batch}
+        assert len(buckets) == 1, (batch, buckets)
+
+    # a bucket with a single small segment schedules nothing for it
+    metas["s6"] = {"status": "DONE", "totalDocs": 100,
+                   "downloadPath": "/x/s6", "startTime": 3 * day}
+    tasks = gen({"bucketTimePeriodMs": str(day)})
+    assert len(tasks) == 3
+    assert all("s6" not in t.configs["segmentName"] for t in tasks)
+
+
 def test_retention_tombstones_expired_and_gcs_upsert_keys(work_dir):
     topic = "topic_retention_gc"
     stream = _register(topic)

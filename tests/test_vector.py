@@ -1,0 +1,517 @@
+"""TPU-native vector similarity search over mutable embeddings (ISSUE 13).
+
+Five tiers:
+
+1. **Type + storage** — VECTOR schema validation (dimension bounds,
+   controller rejection of vector columns in index configs / primary
+   keys), packed float32 forward-block build/load round-trip (v1 and v3
+   container), CRC stamping, schema-evolution default columns.
+2. **PQL surface** — VECTOR_SIMILARITY parse (query vector literal, k,
+   metric), rejection of malformed mixes (SELECT *, LIMIT, GROUP BY),
+   request serde round-trip, canonical fingerprint keying.
+3. **Exactness** — host oracle, device kernel and sharded paths agree
+   BIT-IDENTICALLY on (ids, scores) with WHERE filters applied, checked
+   against the independent tests/oracle.py numpy top-k.
+4. **Mutable path** — upserting a key's embedding makes the very next
+   query rank the NEW vector and never the superseded one (the vdoc
+   lane), bit-identical host vs device vs sharded, including results
+   straddling the frozen/tail boundary of a consuming segment.
+5. **Caching** — the CRC+vdoc-version result-cache key changes on every
+   upsert invalidation, so cached top-k can never serve a dead row.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from oracle import Oracle
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.request import VECTOR_RESULT_COLUMNS
+from pinot_tpu.common.schema import (MAX_VECTOR_DIMENSION, Schema, dimension,
+                                     metric, vector)
+from pinot_tpu.common.serde import (instance_request_from_bytes,
+                                    instance_request_to_bytes,
+                                    request_from_json, request_to_json)
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.pql.lexer import PqlSyntaxError
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.fingerprint import query_fingerprint
+from pinot_tpu.query.reduce import BrokerReduceService
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.server.result_cache import segment_cache_states
+
+DIM = 16
+
+
+def vec_schema(dim=DIM, name="vectab"):
+    return Schema(name, [
+        dimension("shard", DataType.INT),
+        metric("rid", DataType.INT),
+        vector("emb", dim),
+    ])
+
+
+def vec_columns(n, seed=0, dim=DIM, rid_base=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "shard": rng.integers(0, 4, n).astype(np.int32),
+        "rid": (np.arange(n, dtype=np.int32) + rid_base),
+        "emb": rng.standard_normal((n, dim)).astype(np.float32),
+    }
+
+
+def build_vec_segments(base, n_segs=2, n=2048, dim=DIM, seed=3,
+                       version="v1"):
+    segs, cols_list = [], []
+    idx = IndexingConfig()
+    idx.segment_version = version
+    cfg = TableConfig("vectab", indexing_config=idx)
+    for s in range(n_segs):
+        cols = vec_columns(n, seed=seed + s, dim=dim, rid_base=s * n)
+        d = os.path.join(base, f"v{s}")
+        SegmentCreator(vec_schema(dim), cfg,
+                       segment_name=f"v{s}").build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        cols_list.append(cols)
+    return segs, cols_list
+
+
+def pql_for(q, k=7, metric="COSINE", where="WHERE shard < 2",
+            select="rid, "):
+    qs = ", ".join(repr(float(x)) for x in q)
+    return (f"SELECT {select}VECTOR_SIMILARITY(emb, [{qs}], {k}, "
+            f"'{metric}') FROM vectab {where}").strip()
+
+
+def result_rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return [tuple(r) for r in resp.selection_results.results]
+
+
+# ---------------------------------------------------------------------------
+# tier 1: type + storage
+# ---------------------------------------------------------------------------
+
+
+def test_schema_validation_bounds():
+    vec_schema().validate()                      # fine
+    with pytest.raises(ValueError, match="dimension"):
+        Schema("s", [vector("e", 0)]).validate()
+    with pytest.raises(ValueError, match="dimension"):
+        Schema("s", [vector("e", MAX_VECTOR_DIMENSION + 1)]).validate()
+    from pinot_tpu.common.schema import FieldSpec, FieldType
+    with pytest.raises(ValueError, match="single-value"):
+        Schema("s", [FieldSpec("e", DataType.VECTOR, FieldType.DIMENSION,
+                               single_value=False,
+                               vector_dimension=4)]).validate()
+    with pytest.raises(ValueError, match="vectorDimension"):
+        Schema("s", [FieldSpec("x", DataType.INT,
+                               vector_dimension=4)]).validate()
+
+
+def test_schema_json_roundtrip_keeps_dimension():
+    sch = vec_schema(dim=12)
+    again = Schema.from_json_str(sch.to_json_str())
+    f = again.field("emb")
+    assert f.data_type == DataType.VECTOR
+    assert f.vector_dimension == 12
+
+
+def test_fieldspec_convert_validates_dimension():
+    sch = vec_schema(dim=4)
+    f = sch.field("emb")
+    assert np.array_equal(f.convert(None), np.zeros(4, np.float32))
+    assert f.convert([1, 2, 3, 4]).dtype == np.float32
+    with pytest.raises(ValueError, match="4-dimension"):
+        f.convert([1.0, 2.0])
+
+
+def test_controller_rejects_bad_vector_configs(tmp_path):
+    from pinot_tpu.controller.manager import InvalidTableConfigError
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    cluster = EmbeddedCluster(str(tmp_path), num_servers=1)
+    try:
+        with pytest.raises(InvalidTableConfigError, match="dimension"):
+            cluster.add_schema(Schema("bad", [vector("e", 0)]))
+        cluster.add_schema(vec_schema())
+        bad = TableConfig("vectab", indexing_config=IndexingConfig(
+            inverted_index_columns=["emb"]))
+        with pytest.raises(InvalidTableConfigError, match="VECTOR"):
+            cluster.add_table(bad)
+        bad2 = TableConfig("vectab", indexing_config=IndexingConfig(
+            no_dictionary_columns=["emb"]))
+        with pytest.raises(InvalidTableConfigError, match="VECTOR"):
+            cluster.add_table(bad2)
+        ok = TableConfig("vectab")
+        cluster.add_table(ok)
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.parametrize("version", ["v1", "v3"])
+def test_build_load_roundtrip(tmp_path, version):
+    segs, cols_list = build_vec_segments(str(tmp_path), n_segs=1, n=512,
+                                         version=version)
+    seg = segs[0]
+    assert seg.metadata.crc
+    cm = seg.data_source("emb").metadata
+    assert cm.vector_dimension == DIM and not cm.has_dictionary
+    assert np.array_equal(seg.data_source("emb").vec_values,
+                          cols_list[0]["emb"])
+    op = seg.data_source("emb").host_operand("vec")
+    assert op.shape[0] % 8192 == 0 and op.dtype == np.float32
+    assert np.array_equal(op[:512, :DIM], cols_list[0]["emb"])
+    assert op[512:].sum() == 0
+
+
+def test_dimension_mismatch_rejected_at_build(tmp_path):
+    cols = vec_columns(64)
+    cols["emb"] = cols["emb"][:, :8]             # wrong width
+    with pytest.raises(ValueError, match="dimension"):
+        SegmentCreator(vec_schema(), segment_name="bad").build(
+            cols, str(tmp_path / "bad"))
+
+
+def test_schema_evolution_default_vector_column(tmp_path):
+    # segment built WITHOUT emb; loading with the evolved schema
+    # synthesizes zero embeddings
+    old = Schema("vectab", [dimension("shard", DataType.INT),
+                            metric("rid", DataType.INT)])
+    cols = vec_columns(128)
+    SegmentCreator(old, segment_name="old").build(
+        {"shard": cols["shard"], "rid": cols["rid"]}, str(tmp_path / "old"))
+    seg = ImmutableSegmentLoader.load(str(tmp_path / "old"),
+                                      schema=vec_schema())
+    vv = seg.data_source("emb").vec_values
+    assert vv.shape == (128, DIM) and vv.sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# tier 2: PQL surface + serde + fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_pql_parse_vector_similarity():
+    req = compile_pql("SELECT rid, VECTOR_SIMILARITY(emb, "
+                      "[1.0, -2, 3e-1], 5, 'MIPS') FROM vectab "
+                      "WHERE shard = 1")
+    assert req.vector is not None
+    assert req.vector.column == "emb"
+    assert req.vector.query == [1.0, -2.0, 0.3]
+    assert req.vector.k == 5 and req.vector.metric == "MIPS"
+    assert req.selection.columns == ["rid"]
+    assert req.selection.size == 5
+    assert req.filter is not None
+    # default metric
+    req2 = compile_pql("SELECT VECTOR_SIMILARITY(emb, [1], 3) FROM t")
+    assert req2.vector.metric == "COSINE" and req2.selection.columns == []
+
+
+@pytest.mark.parametrize("bad", [
+    "SELECT VECTOR_SIMILARITY(emb, [], 3) FROM t",
+    "SELECT VECTOR_SIMILARITY(emb, [1.0], 3, 'L2') FROM t",
+    "SELECT *, VECTOR_SIMILARITY(emb, [1.0], 3) FROM t",
+    "SELECT VECTOR_SIMILARITY(emb, [1.0], 3) FROM t LIMIT 5",
+    "SELECT VECTOR_SIMILARITY(emb, [1.0], 3) FROM t GROUP BY shard",
+    "SELECT VECTOR_SIMILARITY(emb, [1.0], 3) FROM t ORDER BY rid",
+    "SELECT COUNT(*), VECTOR_SIMILARITY(emb, [1.0], 3) FROM t",
+    "SELECT VECTOR_SIMILARITY(emb, [1.0], 3), "
+    "VECTOR_SIMILARITY(emb, [2.0], 3) FROM t",
+])
+def test_pql_rejects_malformed_vector_queries(bad):
+    with pytest.raises(PqlSyntaxError):
+        compile_pql(bad)
+
+
+def test_request_serde_roundtrip_vector():
+    req = compile_pql("SELECT rid, VECTOR_SIMILARITY(emb, [0.5, 1.5], 9, "
+                      "'DOT') FROM vectab WHERE shard = 2")
+    again = request_from_json(request_to_json(req))
+    assert again.vector == req.vector
+    assert again.selection == req.selection
+    wire = instance_request_from_bytes(instance_request_to_bytes(
+        InstanceRequest(request_id=7, query=req)))
+    assert wire.query.vector == req.vector
+
+
+def test_fingerprint_keys_vector_clause():
+    base = "SELECT VECTOR_SIMILARITY(emb, [1.0, 2.0], 5) FROM t"
+    fp = query_fingerprint(compile_pql(base))
+    # same query → same fingerprint
+    assert fp == query_fingerprint(compile_pql(base))
+    # different query vector / k / metric → different fingerprints
+    assert fp != query_fingerprint(compile_pql(
+        "SELECT VECTOR_SIMILARITY(emb, [1.0, 2.5], 5) FROM t"))
+    assert fp != query_fingerprint(compile_pql(
+        "SELECT VECTOR_SIMILARITY(emb, [1.0, 2.0], 6) FROM t"))
+    assert fp != query_fingerprint(compile_pql(
+        "SELECT VECTOR_SIMILARITY(emb, [1.0, 2.0], 5, 'DOT') FROM t"))
+
+
+# ---------------------------------------------------------------------------
+# tier 3: exactness — host vs device vs sharded vs independent oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def vec_setup():
+    base = tempfile.mkdtemp()
+    segs, cols_list = build_vec_segments(base, n_segs=2, n=2048)
+    rng = np.random.default_rng(99)
+    q = rng.standard_normal(DIM).astype(np.float32)
+    return segs, cols_list, q
+
+
+@pytest.mark.parametrize("metric", ["COSINE", "DOT"])
+def test_filtered_topk_bit_identical_and_oracle(vec_setup, metric):
+    from pinot_tpu.parallel import make_mesh
+    segs, cols_list, q = vec_setup
+    pql = pql_for(q, k=9, metric=metric)
+    host = QueryEngine(segs, use_device=False)
+    dev = QueryEngine(segs)
+    sh = QueryEngine(segs, mesh=make_mesh())
+    rh = result_rows(host.query(pql))
+    rd = result_rows(dev.query(pql))
+    rs = result_rows(sh.query(pql))
+    assert rh == rd == rs
+    assert len(rh) == 9
+    # independent oracle: per-segment top-k merged by (score, seg, doc)
+    cand = []
+    for s, cols in enumerate(cols_list):
+        o = Oracle(cols)
+        m = o.mask(lambda r: r["shard"] < 2)
+        for doc, score in o.vector_topk("emb", q, 9, m,
+                                        metric=metric.lower()):
+            cand.append((-score, f"v{s}", doc,
+                         int(cols["rid"][doc]), score))
+    cand.sort()
+    exp = [(rid, doc, name, score)
+           for _ns, name, doc, rid, score in cand[:9]]
+    assert rh == exp
+    cols = host.query(pql).selection_results.columns
+    assert cols == ["rid"] + list(VECTOR_RESULT_COLUMNS)
+
+
+def test_empty_filter_returns_no_rows(vec_setup):
+    segs, _cols, q = vec_setup
+    pql = pql_for(q, where="WHERE shard = 999")
+    for engine in (QueryEngine(segs, use_device=False), QueryEngine(segs)):
+        assert result_rows(engine.query(pql)) == []
+
+
+def test_predicate_over_vector_column_rejected(vec_setup):
+    segs, _cols, q = vec_setup
+    pql = pql_for(q, where="WHERE emb = 1")
+    with pytest.raises(ValueError, match="VECTOR"):
+        QueryEngine(segs).query(pql)
+
+
+def test_dimension_mismatch_query_errors(vec_setup):
+    segs, _cols, _q = vec_setup
+    with pytest.raises(ValueError, match="dimension"):
+        QueryEngine(segs).query(
+            "SELECT VECTOR_SIMILARITY(emb, [1.0, 2.0], 3) FROM vectab")
+
+
+def test_zero_query_vector_cosine_rejected(vec_setup):
+    segs, _cols, _q = vec_setup
+    zeros = ", ".join(["0.0"] * DIM)
+    with pytest.raises(ValueError, match="non-zero"):
+        QueryEngine(segs).query(
+            f"SELECT VECTOR_SIMILARITY(emb, [{zeros}], 3) FROM vectab")
+    # DOT accepts a zero query (all scores 0.0, docid order)
+    resp2 = QueryEngine(segs).query(
+        f"SELECT VECTOR_SIMILARITY(emb, [{zeros}], 3, 'DOT') FROM vectab")
+    rows = result_rows(resp2)
+    assert [r[-1] for r in rows] == [0.0, 0.0, 0.0]
+    assert [r[0] for r in rows] == [0, 1, 2]
+
+
+def test_k_larger_than_matches_returns_all(vec_setup):
+    segs, cols_list, q = vec_setup
+    pql = pql_for(q, k=5000, where="WHERE shard = 3")
+    n_exp = sum(int((c["shard"] == 3).sum()) for c in cols_list)
+    # k caps at the match count (and at the merge trim)
+    rh = result_rows(QueryEngine(segs, use_device=False).query(pql))
+    rd = result_rows(QueryEngine(segs).query(pql))
+    assert rh == rd
+    assert len(rh) == min(n_exp, 5000)
+
+
+def test_vector_column_selectable_on_host_path(vec_setup):
+    segs, cols_list, _q = vec_setup
+    resp = QueryEngine(segs, use_device=False).query(
+        "SELECT emb FROM vectab LIMIT 2")
+    rows = result_rows(resp)
+    assert len(rows) == 2 and len(rows[0][0]) == DIM
+
+
+# ---------------------------------------------------------------------------
+# tier 4: the mutable-path invariant (upserted embeddings + freshness)
+# ---------------------------------------------------------------------------
+
+
+def _mutable_upsert_segment(n_rows=9000, dim=DIM):
+    """Consuming segment with an upsert bitmap, big enough that the
+    device path serves a frozen snapshot with a live host tail."""
+    from pinot_tpu.realtime.mutable_segment import MutableSegmentImpl
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    impl = MutableSegmentImpl(vec_schema(dim), TableConfig("vectab"),
+                              "vectab__0__0")
+    impl.valid_doc_ids = ValidDocIds()
+    rng = np.random.default_rng(17)
+    rows = [{"shard": int(i % 4), "rid": i,
+             "emb": [float(x) for x in
+                     rng.standard_normal(dim).astype(np.float32)]}
+            for i in range(n_rows)]
+    impl.index_rows(rows)
+    return impl, rng
+
+
+def _run(executor, req, segs):
+    blk = executor.execute(req, segs)
+    resp = BrokerReduceService().reduce(req, [blk])
+    return result_rows(resp)
+
+
+def test_upsert_makes_next_query_rank_new_vector():
+    impl, rng = _mutable_upsert_segment()
+    q = rng.standard_normal(DIM).astype(np.float32)
+    unit = (q / np.linalg.norm(q)).astype(np.float32)
+    req = compile_pql(pql_for(q, k=5, where=""))
+    dev = ServerQueryExecutor()
+    host = ServerQueryExecutor(use_device=False)
+    r0_dev, r0_host = _run(dev, req, [impl]), _run(host, req, [impl])
+    assert r0_dev == r0_host and len(r0_dev) == 5
+    assert impl._frozen is not None      # device path took a snapshot
+
+    # upsert doc 10's key with a perfect-match embedding; the OLD row
+    # (a frozen-prefix row) must never rank again, the NEW row (a tail
+    # row) must rank first on the IMMEDIATELY following query
+    new_doc = impl.num_docs
+    impl.index_rows([{"shard": 0, "rid": 555_000,
+                      "emb": [float(x) for x in unit]}])
+    impl.valid_doc_ids.invalidate(10)
+    r1_dev, r1_host = _run(dev, req, [impl]), _run(host, req, [impl])
+    assert r1_dev == r1_host
+    assert r1_dev[0][:2] == (555_000, new_doc)
+    assert all(row[1] != 10 for row in r1_dev)
+
+    # supersede the new row too — the immediately following query must
+    # drop it (never ranks a dead row, even the previous winner)
+    impl.index_rows([{"shard": 0, "rid": 555_001,
+                      "emb": [float(x) for x in unit]}])
+    impl.valid_doc_ids.invalidate(new_doc)
+    r2_dev, r2_host = _run(dev, req, [impl]), _run(host, req, [impl])
+    assert r2_dev == r2_host
+    assert r2_dev[0][0] == 555_001
+    assert all(row[1] != new_doc for row in r2_dev)
+
+
+def test_straddling_frozen_tail_boundary_bit_identical():
+    impl, rng = _mutable_upsert_segment(n_rows=8300)
+    # frozen covers [0, 8192); tail [8192, 8300) — craft a query whose
+    # top-k straddles: plant strong matches on both sides
+    q = rng.standard_normal(DIM).astype(np.float32)
+    unit = (q / np.linalg.norm(q)).astype(np.float32)
+    for doc, scale in ((100, 0.99), (8200, 0.98), (50, 0.97)):
+        impl._sources["emb"]._vec._arr[doc] = unit * scale + \
+            rng.standard_normal(DIM).astype(np.float32) * 1e-3
+    req = compile_pql(pql_for(q, k=4, where=""))
+    dev = ServerQueryExecutor()
+    host = ServerQueryExecutor(use_device=False)
+    rd, rh = _run(dev, req, [impl]), _run(host, req, [impl])
+    assert rd == rh
+    docs = [row[1] for row in rd]
+    assert 100 in docs and 8200 in docs     # both sides of the boundary
+    # ids are GLOBAL docids under the base segment name on both paths
+    assert all(row[2] == "vectab__0__0" for row in rd)
+
+
+def test_committed_upsert_masking_sharded(tmp_path):
+    """Sealed segments with validDocIds invalidations: dead rows never
+    rank on any path, and all three paths stay bit-identical."""
+    from pinot_tpu.parallel import make_mesh
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    segs, cols_list = build_vec_segments(str(tmp_path), n_segs=2, n=2048)
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal(DIM).astype(np.float32)
+    pql = pql_for(q, k=6, where="")
+    base = result_rows(QueryEngine(segs, use_device=False).query(pql))
+    # kill the current top hit on its segment
+    top_rid, top_doc, top_seg, _s = base[0]
+    seg_idx = int(top_seg[1:])
+    vd = ValidDocIds()
+    vd.invalidate(top_doc)
+    segs[seg_idx].valid_doc_ids = vd
+    rh = result_rows(QueryEngine(segs, use_device=False).query(pql))
+    rd = result_rows(QueryEngine(segs).query(pql))
+    rs = result_rows(QueryEngine(segs, mesh=make_mesh()).query(pql))
+    assert rh == rd == rs
+    assert all(not (row[1] == top_doc and row[2] == top_seg)
+               for row in rh)
+    assert rh[0] == base[1]      # ranking shifts up by exactly one
+
+
+# ---------------------------------------------------------------------------
+# tier 5: result-cache exactness (CRC + vdoc version keying)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_key_changes_on_vdoc_bump(tmp_path):
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    segs, _cols = build_vec_segments(str(tmp_path), n_segs=1, n=256)
+    seg = segs[0]
+    s0 = segment_cache_states(segs)
+    assert s0 is not None
+    vd = ValidDocIds()
+    seg.valid_doc_ids = vd
+    s1 = segment_cache_states(segs)
+    vd.invalidate(3)
+    s2 = segment_cache_states(segs)
+    assert s0 != s1 != s2 and s0 != s2
+
+
+def test_cached_topk_invalidates_on_upsert(tmp_path):
+    """End-to-end through the server result cache: identical queries
+    hit; an upsert invalidation changes the key so the stale top-k is
+    never served."""
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    from pinot_tpu.server.result_cache import ServerResultCache
+    segs, _cols = build_vec_segments(str(tmp_path), n_segs=1, n=256)
+    seg = segs[0]
+    rng = np.random.default_rng(11)
+    q = rng.standard_normal(DIM).astype(np.float32)
+    req = compile_pql(pql_for(q, k=3, where=""))
+    fp = query_fingerprint(req)
+    cache = ServerResultCache()
+    key0 = ServerResultCache.key("vectab", fp, segment_cache_states(segs))
+    cache.put(key0, b"payload-0")
+    assert cache.get(key0) == b"payload-0"
+    vd = ValidDocIds()
+    seg.valid_doc_ids = vd
+    vd.invalidate(0)
+    key1 = ServerResultCache.key("vectab", fp, segment_cache_states(segs))
+    assert key1 != key0
+    assert cache.get(key1) is None       # post-upsert key misses
+
+
+# ---------------------------------------------------------------------------
+# converter: consuming vector columns survive the commit build
+# ---------------------------------------------------------------------------
+
+
+def test_realtime_converter_preserves_vectors(tmp_path):
+    from pinot_tpu.realtime.converter import convert
+    impl, _rng = _mutable_upsert_segment(n_rows=200)
+    before = np.array(impl._sources["emb"]._vec.snapshot(200), copy=True)
+    meta = convert(impl, str(tmp_path / "committed"), "vectab_c0")
+    seg = ImmutableSegmentLoader.load(str(tmp_path / "committed"))
+    assert meta.crc and seg.num_docs == 200
+    assert np.array_equal(seg.data_source("emb").vec_values, before)
