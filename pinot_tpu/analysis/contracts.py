@@ -272,7 +272,8 @@ def wire_schema() -> dict:
         },
         "brokerResponse": _shape_of(resp.to_json()),
         "dataTable": {
-            "versions": sorted([dtmod._LEGACY_VERSION, dtmod.VERSION]),
+            "versions": sorted([dtmod._LEGACY_VERSION,
+                                dtmod._V2_VERSION, dtmod.VERSION]),
             "defaultVersion": dtmod.VERSION,
             "columnTags": sorted(t.decode("latin1") for t in (
                 dtmod._COL_I64, dtmod._COL_F64, dtmod._COL_STR,

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import asyncio
 import math
+import os
+from typing import Optional
 
 from pinot_tpu.broker.access_control import RequesterIdentity
 from pinot_tpu.broker.request_handler import BrokerRequestHandler
@@ -28,11 +30,26 @@ def _retrying_response(resp, status: int, retry_s: float) -> HttpResponse:
 
 
 class BrokerApiServer(ApiServer):
-    """HTTP front door for one BrokerRequestHandler."""
+    """HTTP front door for one BrokerRequestHandler.
 
-    def __init__(self, handler: BrokerRequestHandler):
+    `inline` (or PINOT_TPU_BROKER_INLINE=1): run the whole query
+    pipeline — compile, route, scatter await, reduce — on the API's own
+    event loop via `handle_async`, with NO executor hop and no second
+    loop thread. On a single-core host every cross-thread wakeup is a
+    self-pipe syscall plus GIL churn (~1ms measured under load), so the
+    inline shape is what the serving-plane benchmarks run. Exclusive
+    with the sync `handle()` facade: once inline, the TCP data-plane
+    connections live on THIS loop, so queries must all enter through
+    HTTP (the multi-process broker's only entry point anyway).
+    """
+
+    def __init__(self, handler: BrokerRequestHandler,
+                 inline: Optional[bool] = None):
         super().__init__()
         self.handler = handler
+        if inline is None:
+            inline = os.environ.get("PINOT_TPU_BROKER_INLINE", "0") != "0"
+        self.inline = bool(inline)
         self.router.add("GET", "/query", self._get_query)
         self.router.add("POST", "/query", self._post_query)
         self.router.add("GET", "/health", self._health)
@@ -54,6 +71,17 @@ class BrokerApiServer(ApiServer):
         self.router.add("GET", "/debug/quotas", self._quotas)
         self.router.add("GET", "/debug/resultCache", self._result_cache)
 
+    def stop(self) -> None:
+        if self.inline and self._loop is not None:
+            # the data-plane connections live on THIS loop — close them
+            # here (awaited, so reader tasks unwind) before the loop
+            # dies, or their read loops are GC'd mid-coroutine
+            try:
+                self._loop.run(self.handler.router.transport.close())
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        super().stop()
+
     @staticmethod
     def _identity(request: HttpRequest) -> RequesterIdentity:
         parts = request.headers.get("authorization", "").split(None, 1)
@@ -64,11 +92,19 @@ class BrokerApiServer(ApiServer):
 
     async def _run_query(self, pql: str, identity: RequesterIdentity,
                          force_trace: bool = False) -> HttpResponse:
-        # the broker handler owns its own event loop (per-server TCP
-        # connections live there); hop through its sync facade off-thread
-        loop = asyncio.get_running_loop()
-        resp = await loop.run_in_executor(
-            None, lambda: self.handler.handle(pql, identity, force_trace))
+        if self.inline:
+            # single-loop serving: pipeline runs right here; the only
+            # await inside is the scatter-gather network wait
+            resp = await self.handler.handle_async(pql, identity,
+                                                   force_trace)
+        else:
+            # the broker handler owns its own event loop (per-server
+            # TCP connections live there); hop through its sync facade
+            # off-thread
+            loop = asyncio.get_running_loop()
+            resp = await loop.run_in_executor(
+                None, lambda: self.handler.handle(pql, identity,
+                                                  force_trace))
         # quota rejections surface as real 429s with Retry-After derived
         # from the token bucket's refill time, so well-behaved clients
         # back off instead of hammering the retry loop

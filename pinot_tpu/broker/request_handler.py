@@ -26,6 +26,7 @@ from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
                                         SERVER_BUSY_KEY)
 from pinot_tpu.common.metrics import (BrokerGauge, BrokerMeter,
                                       BrokerQueryPhase, MetricsRegistry)
+from pinot_tpu.transport.shm import ShmReply
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.common.serde import instance_request_to_bytes
@@ -114,7 +115,14 @@ class TcpTransport(ServerTransport):
 
     async def close(self) -> None:
         for conn in self._conns.values():
-            await conn.close()
+            # inline-HTTP brokers create connections on the API loop;
+            # a close arriving from the handler's own loop must hop to
+            # the connection's loop instead of awaiting cross-loop
+            if conn._loop is None or \
+                    conn._loop is asyncio.get_running_loop():
+                await conn.close()
+            else:
+                conn.close_threadsafe()
         self._conns.clear()
 
 
@@ -349,11 +357,30 @@ class QueryRouter:
             trace_id=trace.trace_id if dspan is not None else None,
             parent_span_id=dspan["spanId"] if dspan is not None else None,
             workload=workload, hedge=hedge))
+        self.metrics.meter(BrokerMeter.INSTANCE_REQUEST_BYTES).mark(
+            len(payload))
         t0 = self._clock()
         try:
             raw = await asyncio.wait_for(
                 self.transport.query(server, payload, budget), budget)
-            dt = DataTable.from_bytes(raw)
+            # per-hop serde attribution: the decode share of the gather
+            # is timed and its byte volume metered, so PROFILE
+            # artifacts can split serde from transport+queueing
+            self.metrics.meter(BrokerMeter.SERVER_RESPONSE_BYTES).mark(
+                len(raw))
+            with self.metrics.timer(
+                    BrokerQueryPhase
+                    .SERVER_RESPONSE_DESERIALIZATION).time():
+                if isinstance(raw, ShmReply):
+                    # colocated shared-memory reply: decode straight
+                    # from the segment, then unlink (the decoder copies
+                    # blocks out of writable buffers by contract)
+                    try:
+                        dt = DataTable.from_bytes(raw.view)
+                    finally:
+                        raw.close()
+                else:
+                    dt = DataTable.from_bytes(raw)
         except asyncio.CancelledError:
             # hedge loser / caller teardown: mark the span so the tree
             # shows an abandoned dispatch, not a 0ms "success"
@@ -478,7 +505,8 @@ class BrokerRequestHandler:
                  fault_tolerance: Optional[FaultToleranceManager] = None,
                  slow_log: Optional[SlowQueryLog] = None,
                  result_cache: Optional[BrokerResultCache] = None,
-                 cache_freshness_ms: Optional[float] = None):
+                 cache_freshness_ms: Optional[float] = None,
+                 cache_offline: Optional[bool] = None):
         # optional broker-side segment pruner (PartitionZKMetadataPruner):
         # prune(request, table, segments) -> segments
         self.segment_pruner = segment_pruner
@@ -511,6 +539,24 @@ class BrokerRequestHandler:
         # bound (None = only explicitly-bounded queries are cached)
         self.result_cache = result_cache or BrokerResultCache()
         self.default_cache_freshness_ms = cache_freshness_ms
+        # pure-OFFLINE tables: results change only on segment lifecycle
+        # events, and the cluster watcher flushes this cache on exactly
+        # those (register_result_cache) — so caching them is EXACT, not
+        # freshness-bounded, keyed on the same canonical fingerprint.
+        # Default off (opt in per deployment / via env for bench rigs).
+        if cache_offline is None:
+            import os
+            cache_offline = os.environ.get(
+                "PINOT_TPU_BROKER_CACHE_OFFLINE", "0") != "0"
+        self.cache_offline = bool(cache_offline)
+        # compiled-request cache: the serving plane replays a small set
+        # of query STRINGS at high rate; re-lexing the same PQL per
+        # request was ~0.4ms of the per-query CPU budget. Entries are
+        # treated as immutable downstream (_retable/attach_time_boundary
+        # copy; force_trace copies below). Fingerprints memoize beside
+        # the compiled form since they hash the same canonical tree.
+        self._compile_cache: Dict[str, list] = {}
+        self._compile_cache_max = 512
         self.optimizer = BrokerRequestOptimizer()
         self.reducer = BrokerReduceService()
         if access_control is None:
@@ -574,15 +620,26 @@ class BrokerRequestHandler:
         t0 = time.perf_counter()
         self.metrics.meter(BrokerMeter.QUERIES).mark()
         t = time.perf_counter()
-        try:
-            request = compile_pql(pql)
-        except Exception as e:  # noqa: BLE001 — compile errors → response
-            self.metrics.meter(
-                BrokerMeter.REQUEST_COMPILATION_EXCEPTIONS).mark()
-            return _error_response(150, f"PQLParsingError: {e}")
+        entry = self._compile_cache.get(pql)
+        if entry is None:
+            try:
+                request = compile_pql(pql)
+            except Exception as e:  # noqa: BLE001 — compile errors → resp
+                self.metrics.meter(
+                    BrokerMeter.REQUEST_COMPILATION_EXCEPTIONS).mark()
+                return _error_response(150, f"PQLParsingError: {e}")
+            if len(self._compile_cache) >= self._compile_cache_max:
+                self._compile_cache.clear()    # rare: bounded, not LRU
+            # [request, memoized fingerprint] — fp filled lazily below
+            entry = self._compile_cache[pql] = [request, None]
+        request = entry[0]
         if force_trace and "trace" not in request.query_options.options:
             # the HTTP client's JSON trace flag; an explicit OPTION(trace=…)
-            # in the query wins
+            # in the query wins. COPY before flipping: the cached
+            # compiled request is shared across concurrent queries.
+            import copy
+            request = copy.copy(request)
+            request.query_options = copy.copy(request.query_options)
             request.query_options.trace = True
         compile_ms = (time.perf_counter() - t) * 1e3
         self.metrics.timer(BrokerQueryPhase.REQUEST_COMPILATION).update(
@@ -661,16 +718,29 @@ class BrokerRequestHandler:
         # traced queries bypass the cache both ways: the client asked
         # to watch THIS execution, and a cached reply has no spans
         # (the put at _finish has the matching guard)
-        if bound_ms is not None and not request.query_options.trace and \
-                self.routing.has_table(realtime_table(raw)):
-            from pinot_tpu.query.fingerprint import query_fingerprint
-            fp = query_fingerprint(request)
+        cache_bound = None
+        if not request.query_options.trace:
+            if bound_ms is not None and \
+                    self.routing.has_table(realtime_table(raw)):
+                cache_bound = bound_ms
+            elif self.cache_offline and \
+                    not self.routing.has_table(realtime_table(raw)) and \
+                    self.routing.has_table(offline_table(raw)):
+                # pure-offline: exact (not freshness-bounded) — every
+                # segment lifecycle event flushes this cache, so age
+                # never bounds validity
+                cache_bound = float("inf")
+        if cache_bound is not None:
+            fp = entry[1]
+            if fp is None:
+                from pinot_tpu.query.fingerprint import query_fingerprint
+                fp = entry[1] = query_fingerprint(request)
             # generation captured BEFORE execution: a view change that
             # clear()s the cache while this query is in flight (an
             # OFFLINE backfill) must not be undone by _finish's put
             # re-inserting the pre-backfill result
             fingerprint = (fp, self.result_cache.generation)
-            cached = self.result_cache.get(fp, bound_ms)
+            cached = self.result_cache.get(fp, cache_bound)
             if cached is not None:
                 self.metrics.meter(BrokerMeter.RESULT_CACHE_HITS).mark()
                 cached.time_used_ms = (time.perf_counter() - t0) * 1e3

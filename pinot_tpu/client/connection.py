@@ -101,16 +101,59 @@ class ResultSetGroup:
 
 
 class _HttpEndpoint:
-    """One host:port with persistent keep-alive connections."""
+    """One host:port with persistent keep-alive connections.
+
+    Connections are PER-THREAD (thread-local): `http.client` connections
+    are not thread-safe, and a single shared socket would serialize
+    every concurrent caller — at high offered rates the client itself
+    became the bottleneck, so a perf driver's `missed_slots` measured
+    client serialization, not server saturation. Each worker thread now
+    keeps its own keep-alive socket (TCP_NODELAY set, so the two-write
+    request never hits Nagle + delayed-ACK stalls)."""
 
     def __init__(self, host: str, port: int, timeout: float = 60.0,
                  tls_config=None):
+        import threading
+        import weakref
         self.host, self.port, self.timeout = host, port, timeout
         # TlsConfig → https with the configured CA/verification
         # (parity: the reference client's ClientSSLContextGenerator)
         self._ssl_ctx = tls_config.client_context() \
             if tls_config is not None else None
-        self._conn: Optional[http.client.HTTPConnection] = None
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        # WEAK set: each live connection is strongly held only by its
+        # owning thread's local slot, so a dying worker thread releases
+        # its socket to GC instead of pinning it here forever (close()
+        # still reaches every connection that is actually alive)
+        self._all_conns = weakref.WeakSet()
+
+    def _connect(self) -> http.client.HTTPConnection:
+        import socket
+        if self._ssl_ctx is not None:
+            conn = http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout,
+                context=self._ssl_ctx)
+        else:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        conn.connect()
+        try:
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        with self._lock:
+            self._all_conns.add(conn)
+        return conn
+
+    def _drop(self, conn) -> None:
+        try:
+            conn.close()
+        finally:
+            with self._lock:
+                self._all_conns.discard(conn)
+            self._local.conn = None
 
     def request(self, method: str, path: str, body: Optional[bytes] = None,
                 headers: Optional[Dict[str, str]] = None,
@@ -122,30 +165,29 @@ class _HttpEndpoint:
         if idempotent is None:
             idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
         for attempt in (0, 1):
-            if self._conn is None:
-                if self._ssl_ctx is not None:
-                    self._conn = http.client.HTTPSConnection(
-                        self.host, self.port, timeout=self.timeout,
-                        context=self._ssl_ctx)
-                else:
-                    self._conn = http.client.HTTPConnection(
-                        self.host, self.port, timeout=self.timeout)
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = self._local.conn = self._connect()
             try:
-                self._conn.request(method, path, body=body, headers=headers)
-                resp = self._conn.getresponse()
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
                 return resp.status, resp.read()
             except (http.client.HTTPException, ConnectionError, OSError):
-                self.close()
+                self._drop(conn)
                 if attempt or not idempotent:
                     raise
         raise PinotClientError("unreachable")  # pragma: no cover
 
     def close(self) -> None:
-        if self._conn is not None:
+        with self._lock:
+            conns = list(self._all_conns)
+            self._all_conns.clear()
+        for conn in conns:
             try:
-                self._conn.close()
-            finally:
-                self._conn = None
+                conn.close()
+            except OSError:
+                pass
+        self._local.conn = None
 
 
 class SimpleBrokerSelector:

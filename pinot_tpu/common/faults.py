@@ -134,9 +134,10 @@ class _Armed:
         self.remaining = spec.times
 
 
-def corrupt_bytes(raw: bytes) -> bytes:
+def corrupt_bytes(raw) -> bytes:
     """Deterministically mangle a response frame so DataTable.from_bytes
     must fail (the version header is inverted, never silently valid)."""
+    raw = bytes(raw)       # the mux hands replies as frame memoryviews
     head = bytes(b ^ 0xFF for b in raw[:8])
     return head + raw[8:]
 

@@ -245,6 +245,12 @@ class BrokerMeter:
     # broker-level result cache (hybrid tables, freshness-bounded)
     RESULT_CACHE_HITS = "resultCacheHits"
     RESULT_CACHE_MISSES = "resultCacheMisses"
+    # per-hop serde accounting: bytes of server reply payloads decoded
+    # at the broker (pairs with the serverResponseDeserialization timer
+    # so PROFILE artifacts can attribute serde separately from
+    # transport) and bytes of InstanceRequest payloads sent
+    SERVER_RESPONSE_BYTES = "serverResponseBytes"
+    INSTANCE_REQUEST_BYTES = "instanceRequestBytes"
 
 
 class BrokerGauge:
@@ -266,6 +272,9 @@ class BrokerQueryPhase:
     AUTHORIZATION = "authorization"
     QUERY_ROUTING = "queryRouting"
     SCATTER_GATHER = "scatterGather"
+    # DataTable decode of one server reply (a slice of scatterGather:
+    # the serde share of the gather, metered per dispatch)
+    SERVER_RESPONSE_DESERIALIZATION = "serverResponseDeserialization"
     REDUCE = "reduce"
     QUERY_TOTAL = "queryTotal"
 
@@ -295,6 +304,11 @@ class ServerMeter:
     # server-side CRC-exact result cache
     RESULT_CACHE_HITS = "resultCacheHits"
     RESULT_CACHE_MISSES = "resultCacheMisses"
+    # per-hop serde accounting: request payload bytes deserialized and
+    # reply payload bytes serialized (the responseSerialization /
+    # requestDeserialization timers' byte-volume counterparts)
+    REQUEST_BYTES = "requestBytes"
+    RESPONSE_BYTES = "responseBytes"
     # upsert maintenance: committed segments whose compacted rewrite was
     # remapped into the key map at swap, and key-map entries dropped
     # when a retention-deleted segment's keys were garbage-collected

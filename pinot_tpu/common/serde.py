@@ -194,7 +194,9 @@ def obj_to_bytes(v: Any) -> bytes:
     return bytes(out)
 
 
-def obj_from_bytes(b: bytes) -> Any:
+def obj_from_bytes(b) -> Any:
+    """`b`: any buffer (bytes / memoryview) — the zero-copy DataTable
+    decode path hands frame memoryviews straight in."""
     v, off = _read_obj(b, 0)
     return v
 
@@ -268,7 +270,9 @@ def _write_obj(out: bytearray, v: Any) -> None:
         raise TypeError(f"unserializable object type {type(v)}")
 
 
-def _read_obj(b: bytes, off: int):
+def _read_obj(b, off: int):
+    # str(buf, "utf-8") decodes bytes AND memoryview slices — .decode()
+    # exists only on bytes, and the zero-copy frame path passes views
     tag = b[off:off + 1]
     off += 1
     if tag == b"N":
@@ -280,13 +284,13 @@ def _read_obj(b: bytes, off: int):
     if tag == b"I":
         n = _U32.unpack_from(b, off)[0]
         off += 4
-        return int(b[off:off + n].decode()), off + n
+        return int(str(b[off:off + n], "ascii")), off + n
     if tag == b"d":
         return _F64.unpack_from(b, off)[0], off + 8
     if tag == b"s":
         n = _U32.unpack_from(b, off)[0]
         off += 4
-        return b[off:off + n].decode("utf-8"), off + n
+        return str(b[off:off + n], "utf-8"), off + n
     if tag == b"b":
         n = _U32.unpack_from(b, off)[0]
         off += 4
@@ -320,5 +324,5 @@ def _read_obj(b: bytes, off: int):
         n = _U32.unpack_from(b, off)[0]
         off += 4
         cls = HyperLogLog if tag == b"H" else TDigest
-        return cls.from_bytes(b[off:off + n]), off + n
+        return cls.from_bytes(bytes(b[off:off + n])), off + n
     raise ValueError(f"bad object tag {tag!r} at {off - 1}")

@@ -75,8 +75,17 @@ class IntermediateResultsBlock:
     agg_intermediates: Optional[List[object]] = None
     # group-by: group key values tuple → list of intermediates
     group_map: Optional[Dict[Tuple, List[object]]] = None
+    # group-by, COLUMNAR form (zero-copy DataTable v3 decode): a
+    # (key_cols, inter_cols) pair of per-column blocks — each a numpy
+    # array (i64/f64) or list (str/object). Exactly one of group_map /
+    # group_cols is set; combine materializes group_map lazily only
+    # when a merge cannot run as a vectorized fold.
+    group_cols: Optional[Tuple[List[object], List[object]]] = None
     # selection: row tuples (decoded values) + total matched count
     selection_rows: Optional[List[tuple]] = None
+    # selection, COLUMNAR form: one block per column (numpy array or
+    # list), same exactly-one-of contract vs selection_rows
+    selection_cols: Optional[List[object]] = None
     selection_columns: Optional[List[str]] = None
     # rows may carry trailing ORDER-BY-only columns (needed to re-sort in
     # cross-segment merges); the reducer trims to the first N display cols

@@ -9,13 +9,16 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
                                       HavingNode)
 from pinot_tpu.common.response import (AggregationResult, BrokerResponse,
                                        SelectionResults)
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import IntermediateResultsBlock
-from pinot_tpu.query.combine import combine_blocks
+from pinot_tpu.query.combine import (combine_blocks, group_map_of,
+                                     np_foldable, sortable_desc_key)
 
 
 class BrokerReduceService:
@@ -54,9 +57,18 @@ class BrokerReduceService:
                 for f, x in zip(functions, inters)]
         if request.is_selection:
             sel = request.selection
-            rows = merged.selection_rows or []
-            rows = rows[sel.offset: sel.offset + sel.size]
             columns = merged.selection_columns or sel.columns
+            if merged.selection_cols is not None:
+                # columnar payload: slice the window first, materialize
+                # row lists only for the ≤ size emitted rows
+                cols = [c[sel.offset: sel.offset + sel.size]
+                        for c in merged.selection_cols]
+                rows = list(zip(*[c.tolist()  # tpulint: disable=host-sync -- numpy host array, not a device value
+                                  if isinstance(c, np.ndarray) else c
+                                  for c in cols])) if cols else []
+            else:
+                rows = merged.selection_rows or []
+                rows = rows[sel.offset: sel.offset + sel.size]
             n = merged.selection_display_cols
             if n is not None and n < len(columns):
                 columns = columns[:n]
@@ -70,7 +82,13 @@ class BrokerReduceService:
                          merged: IntermediateResultsBlock,
                          resp: BrokerResponse) -> None:
         functions = make_functions(request.aggregations)
-        group_map = merged.group_map or {}
+        if merged.group_cols is not None and request.having is None and \
+                np_foldable(functions) and \
+                all(isinstance(c, np.ndarray) and c.dtype.kind in "if"
+                    for c in merged.group_cols[1]):
+            self._reduce_group_cols(request, merged, resp, functions)
+            return
+        group_map = group_map_of(merged) or {}
         # final values per group per function
         finals: Dict[Tuple, List] = {
             key: [f.extract_final(x) for f, x in zip(functions, inters)]
@@ -93,6 +111,40 @@ class BrokerReduceService:
                     {"group": [_json_val(g) for g in key], "value":
                      _final_str(vals[fi])}
                     for key, vals in ordered]))
+        resp.aggregation_results = results
+
+    def _reduce_group_cols(self, request: BrokerRequest,
+                           merged: IntermediateResultsBlock,
+                           resp: BrokerResponse,
+                           functions: List[AggregationFunction]) -> None:
+        """Vectorized finals for columnar group payloads: top-N per
+        function via ONE stable argsort over the intermediate column —
+        no per-group tuple keys, no python sort lambda per row. Bit
+        parity with the row path: stable argsort of the negated values
+        IS sorted(reverse=True) over first-occurrence group order, and
+        per-cell finals go through the same extract_final/_fmt."""
+        key_cols, inter_cols = merged.group_cols
+        top_n = request.group_by.top_n
+        results = []
+        for fi, f in enumerate(functions):
+            vals = inter_cols[fi]
+            # sortable_desc_key reproduces sortable_final's comparison
+            # semantics (exact int for COUNT, float for the rest), so
+            # top-N ties land exactly where the row oracle's do
+            order = np.argsort(sortable_desc_key(f, vals),
+                               kind="stable")[:top_n]
+            group_by_result = []
+            for i in order:
+                key = [_json_val(c[i]) if isinstance(c, np.ndarray)
+                       else c[i] for c in key_cols]
+                group_by_result.append(
+                    {"group": key,
+                     "value": _final_str(f.extract_final(
+                         _json_val(vals[i])))})
+            results.append(AggregationResult(
+                function=f.result_name,
+                group_by_columns=list(request.group_by.columns),
+                group_by_result=group_by_result))
         resp.aggregation_results = results
 
 

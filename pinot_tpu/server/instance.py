@@ -96,6 +96,7 @@ class ServerInstance:
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.timer(
             ServerQueryPhase.REQUEST_DESERIALIZATION).update(ms)
+        self.metrics.meter(ServerMeter.REQUEST_BYTES).mark(len(payload))
         return request, err, ms
 
     # scheduler groups and admission fair-share counters are permanent
@@ -264,6 +265,7 @@ class ServerInstance:
             t0 = time.perf_counter()
             payload = dt.to_bytes()
             ser_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.meter(ServerMeter.RESPONSE_BYTES).mark(len(payload))
         if request.enable_trace and "traceInfo" in dt.metadata:
             # the serde span cannot ride inside the bytes it measures:
             # amend the trace and re-serialize (trace=true only — the
@@ -353,7 +355,7 @@ class ServerInstance:
                 request, deser_ms,
                 admission_deadline_s=decision.deadline_s,
                 release_admission=True, tenant=tenant))
-            if len(dt.rows) <= 128:
+            if dt.num_rows() <= 128:
                 # small replies (aggregations, trimmed group-bys)
                 # serialize faster than an executor hop costs
                 reply = self._serialize(request, dt)

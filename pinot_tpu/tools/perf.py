@@ -62,55 +62,83 @@ def load_query_file(path: str) -> List[str]:
     return out
 
 
-def http_query_fn(broker: str, timeout: float = 30.0
+def http_query_fn(brokers, timeout: float = 30.0
                   ) -> Callable[[str], dict]:
     """POST {"pql": ...} to http://<broker>/query (pinot-api transport).
 
-    Keep-alive: each calling thread holds ONE persistent connection
-    (http.client, thread-local), the way real serving clients talk to a
-    broker — a fresh TCP handshake per query measures the OS, not the
-    serving plane. TCP_NODELAY is set, or Nagle + delayed-ACK turns the
-    two-write request (headers, then body) into 40ms stalls on a
-    persistent socket. Broken connections reconnect transparently."""
-    import http.client
-    import socket
+    `brokers`: one "host:port" or a list of them — each worker THREAD
+    is pinned round-robin to one broker and (via the client library's
+    `_HttpEndpoint`, which keeps per-thread keep-alive sockets with
+    TCP_NODELAY and one transparent retry on a stale connection) holds
+    ONE persistent connection to it, the way real serving clients talk
+    to a broker fleet — a fresh TCP handshake per query measures the
+    OS, not the serving plane, and a single shared socket serializes
+    the offered load."""
+    import itertools
 
-    host, _, port = broker.partition(":")
+    from pinot_tpu.client.connection import _HttpEndpoint
+
+    if isinstance(brokers, str):
+        brokers = [brokers]
+    endpoints = []
+    for b in brokers:
+        host, _, port = b.partition(":")
+        endpoints.append(_HttpEndpoint(host, int(port or 80),
+                                       timeout=timeout))
+    assign = itertools.count()
     local = threading.local()
+    headers = {"Content-Type": "application/json"}
 
     def fn(pql: str) -> dict:
-        body = json.dumps({"pql": pql})
-        conn = getattr(local, "conn", None)
-        for attempt in (0, 1):
-            if conn is None:
-                conn = http.client.HTTPConnection(
-                    host, int(port or 80), timeout=timeout)
-                conn.connect()
-                conn.sock.setsockopt(socket.IPPROTO_TCP,
-                                     socket.TCP_NODELAY, 1)
-                local.conn = conn
-            try:
-                conn.request("POST", "/query", body=body,
-                             headers={"Content-Type": "application/json"})
-                resp = conn.getresponse()
-                return json.loads(resp.read())
-            except (http.client.HTTPException, ConnectionError, OSError):
-                # stale keep-alive (broker restarted / idle-closed):
-                # retry ONCE on a fresh connection, then surface
-                conn.close()
-                local.conn = conn = None
-                if attempt:
-                    raise
+        ep = getattr(local, "endpoint", None)
+        if ep is None:
+            ep = local.endpoint = endpoints[next(assign) % len(endpoints)]
+        # read-only query: idempotent → the endpoint may retry once on
+        # a stale keep-alive before surfacing
+        _status, payload = ep.request(
+            "POST", "/query", body=json.dumps({"pql": pql}).encode(),
+            headers=headers, idempotent=True)
+        return json.loads(payload)
     return fn
 
 
 class QueryRunner:
     def __init__(self, query_fn: Callable[[str], object],
-                 queries: Sequence[str]):
-        if not queries:
+                 queries: Sequence[str],
+                 query_provider: Optional[Callable[[int], str]] = None):
+        """`query_provider(slot_index) -> pql` overrides the default
+        round-robin replay — benchmark drivers use it to mix replayed
+        queries with cache-busting variants at a controlled fraction."""
+        if not queries and query_provider is None:
             raise ValueError("empty query list")
         self.query_fn = query_fn
         self.queries = list(queries)
+        self.query_provider = query_provider
+        # persistent worker pool: threads (and their thread-local
+        # keep-alive client connections) survive ACROSS rungs, so a
+        # high rung starts with warm sockets instead of a reconnect
+        # storm that measures the client, not the serving plane
+        self._pool = None
+
+    def _query_for(self, i: int) -> str:
+        if self.query_provider is not None:
+            return self.query_provider(i)
+        return self.queries[i % len(self.queries)]
+
+    def _pool_for(self, num_threads: int):
+        import concurrent.futures
+        if self._pool is None or \
+                self._pool._max_workers < num_threads:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=num_threads)
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     # -- internals ---------------------------------------------------------
     def _run_one(self, pql: str, lat_ms: List[float],
@@ -217,14 +245,12 @@ class QueryRunner:
                 elif now - due > period:
                     with lock:
                         missed[0] += 1
-                self._run_one(self.queries[i % len(self.queries)],
-                              lat, errors, lock)
+                self._run_one(self._query_for(i), lat, errors, lock)
 
-        ts = [threading.Thread(target=worker) for _ in range(num_threads)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
+        pool = self._pool_for(num_threads)
+        futures = [pool.submit(worker) for _ in range(num_threads)]
+        for f in futures:
+            f.result()
         return self._report("targetQPS", lat, errors[0],
                             time.perf_counter() - t_start,
                             missed=missed[0], target_qps=qps)

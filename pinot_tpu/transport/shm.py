@@ -1,0 +1,218 @@
+"""Shared-memory reply transport for colocated broker↔server processes.
+
+The multiplexed TCP data plane (transport/tcp.py) copies every reply
+payload twice through kernel socket buffers. For processes on the SAME
+host — the multi-process serving shapes `scripts/qps_curve.py` drives —
+a large DataTable can instead travel as a tiny reference to a
+shared-memory segment: the server memcpy's the payload into a fresh
+`multiprocessing.shared_memory` block and sends a control frame naming
+it; the broker attaches, hands the segment's memoryview STRAIGHT to the
+zero-copy DataTable decoder, then closes and unlinks.
+
+Correctness notes:
+
+- **Negotiation**: the broker announces shm support with a hello frame
+  (correlation id 0) on each connection it opens to a loopback
+  address. A server never sends shm references to a peer that did not
+  announce — remote brokers keep getting inline payloads.
+- **Threshold**: only replies of at least `min_bytes()` ride shm
+  (segment create/attach costs two syscalls — a losing trade for the
+  small aggregation replies that dominate steady traffic). The env
+  knob PINOT_TPU_SHM_MIN_BYTES enables the path (0 = disabled).
+- **Aliasing**: a shm buffer is writable and unlinked right after
+  decode, so the DataTable decoder's aliasing rule (datatable.py:
+  writable sources are copied block-wise) is what makes the immediate
+  unlink safe — decoded tables never reference the segment.
+- **Ownership**: the broker (consumer) unlinks after reading. If a
+  reply is abandoned (per-request timeout) the connection's read loop
+  still attaches and unlinks it when the late control frame lands. The
+  server keeps the names it created per connection and sweeps them on
+  connection close, tolerating already-unlinked names — so a broker
+  that dies mid-flight leaks nothing past the connection teardown.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+#: control-frame magic. A real DataTable payload starts with its u32
+#: version tag (0x00 0x00 0x00 vv), so a 0xFF first byte can never be
+#: confused with an inline payload.
+SHM_MAGIC = b"\xffSHM1"
+#: broker→server hello payload announcing shm support (corr id 0)
+SHM_HELLO = b"\xffSHMHELLO"
+#: the reserved correlation id hello frames travel under
+HELLO_CORR = b"\x00" * 8
+
+_U32_LEN = 4
+
+#: names THIS process currently holds registered with the multiprocessing
+#: resource tracker — create and attach both register, unlink
+#: unregisters, and the tracker's books must balance or it prints
+#: KeyError noise / spurious leak warnings at interpreter exit. The
+#: creator and consumer may be the SAME process (embedded clusters,
+#: tests), so the set is shared module state, not per-role.
+_registered: set = set()
+
+
+def min_bytes() -> int:
+    """Reply-size floor for the shm path; 0 disables it entirely."""
+    try:
+        return int(os.environ.get("PINOT_TPU_SHM_MIN_BYTES", "0"))
+    except ValueError:
+        return 0
+
+
+def is_loopback(host: str) -> bool:
+    return host in ("127.0.0.1", "::1", "localhost")
+
+
+def encode_reply(payload: bytes, created: List[str]) -> bytes:
+    """Server side: move `payload` into a fresh shm segment and return
+    the control frame referencing it; appends the segment name to
+    `created` (the connection's sweep list)."""
+    from multiprocessing import shared_memory
+
+    seg = shared_memory.SharedMemory(create=True, size=len(payload))
+    try:
+        seg.buf[:len(payload)] = payload
+        name = seg.name
+        created.append(name)
+        _registered.add(name)
+        nb = name.encode("utf-8")
+        return SHM_MAGIC + len(payload).to_bytes(_U32_LEN, "big") + nb
+    finally:
+        seg.close()    # the mapping; the named segment itself persists
+
+
+def is_shm_frame(payload) -> bool:
+    return bytes(payload[:len(SHM_MAGIC)]) == SHM_MAGIC
+
+
+class ShmReply:
+    """An attached shm reply: expose the payload view, then `close()`
+    unlinks (consumer-side ownership transfer)."""
+
+    __slots__ = ("_seg", "size")
+
+    def __init__(self, name: str, size: int):
+        from multiprocessing import shared_memory
+        self._seg = shared_memory.SharedMemory(name=name)
+        self.size = size
+        # attach does not register with the resource tracker, but the
+        # unlink in close() UNregisters — pre-register so the tracker's
+        # books balance (and so a consumer that dies before close()
+        # still gets the segment reclaimed at interpreter exit). The
+        # tracker's cache is a set, so a same-process creator having
+        # registered already is harmless.
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.register(self._seg._name, "shared_memory")
+            _registered.add(name)
+        except Exception:  # noqa: BLE001 — tracker bookkeeping is best-effort
+            pass
+
+    @property
+    def view(self) -> memoryview:
+        return self._seg.buf[:self.size]
+
+    def close(self) -> None:
+        seg, self._seg = self._seg, None
+        if seg is None:
+            return
+        name = seg.name
+        try:
+            try:
+                seg.close()
+            except BufferError:
+                # a decode error's traceback can pin numpy views over
+                # the buffer; the mapping then closes at GC — unlink
+                # the NAME regardless so the segment cannot leak, and
+                # never let this mask the original decode exception
+                pass
+            seg.unlink()           # unregisters on success
+            _registered.discard(name)
+        except FileNotFoundError:
+            _untrack(name)         # raced: unlink skipped unregister
+
+    def __len__(self) -> int:
+        return self.size
+
+
+def decode_reply(payload) -> Optional[ShmReply]:
+    """Broker side: resolve a control frame into an attached ShmReply
+    (None if the segment vanished — surfaces as a decode error)."""
+    size = int.from_bytes(bytes(
+        payload[len(SHM_MAGIC):len(SHM_MAGIC) + _U32_LEN]), "big")
+    name = str(payload[len(SHM_MAGIC) + _U32_LEN:], "utf-8")
+    try:
+        return ShmReply(name, size)
+    except FileNotFoundError:
+        return None
+
+
+def discard_reply(payload) -> None:
+    """Attach-and-unlink a control frame nobody will consume (late
+    reply to a timed-out request)."""
+    reply = decode_reply(payload)
+    if reply is not None:
+        reply.close()
+
+
+#: created-list length at which the serving path opportunistically
+#: prunes names the broker already consumed (one shm-open syscall per
+#: historical name, so it must run rarely, not per reply)
+PRUNE_AT = 128
+
+
+def prune_consumed(created: List[str]) -> None:
+    """Drop names the consumer has already unlinked from the sweep
+    list (and this process's tracker books) — without this, a
+    long-lived connection's created-list grows by one name per
+    over-threshold reply forever."""
+    from multiprocessing import shared_memory
+    still: List[str] = []
+    for name in created:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            _untrack(name)          # consumed: forget it
+            continue
+        seg.close()                 # probe only; still unconsumed
+        still.append(name)
+    created[:] = still
+
+
+def sweep(created: List[str]) -> None:
+    """Server side, at connection close: unlink any segment the broker
+    never consumed. Already-unlinked names are the normal case."""
+    from multiprocessing import shared_memory
+    for name in created:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            _untrack(name)
+            continue
+        try:
+            seg.close()
+            seg.unlink()
+            _registered.discard(name)
+        except FileNotFoundError:
+            _untrack(name)
+    created.clear()
+
+
+def _untrack(name: str) -> None:
+    """Drop an already-unlinked segment from this process's resource
+    tracker — but ONLY if this process still has it registered
+    (unregistering a name the tracker never saw, or saw unregistered by
+    the consumer in the same process, prints KeyError noise from the
+    tracker at exit)."""
+    if name not in _registered:
+        return
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(f"/{name}", "shared_memory")
+        _registered.discard(name)
+    except Exception:  # noqa: BLE001 — tracker bookkeeping is best-effort
+        pass

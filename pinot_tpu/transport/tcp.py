@@ -24,7 +24,9 @@ import asyncio
 import itertools
 import struct
 import threading
-from typing import Awaitable, Callable, Dict, Optional
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from pinot_tpu.transport import shm as _shm
 
 _LEN = struct.Struct(">I")
 _CORR = struct.Struct(">Q")
@@ -41,6 +43,16 @@ async def read_frame(reader: asyncio.StreamReader) -> bytes:
 
 def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     writer.write(_LEN.pack(len(payload)) + payload)
+
+
+def write_frame2(writer: asyncio.StreamWriter, head: bytes,
+                 payload) -> None:
+    """Two-part frame write: the 8-byte correlation header and the
+    payload go to the transport buffer as-is — no `head + payload`
+    concatenation copying a multi-MB reply just to prepend 8 bytes."""
+    writer.write(_LEN.pack(len(head) + len(payload)))
+    writer.write(head)
+    writer.write(payload)
 
 
 class QueryServer:
@@ -91,14 +103,25 @@ class QueryServer:
         self._connections.add(writer)
         write_lock = asyncio.Lock()
         tasks: set = set()
+        # per-connection shm state: hello-negotiated capability + the
+        # created-segment sweep list (transport/shm.py ownership story)
+        shm_state = {"ok": False}
+        shm_created: List[str] = []
         try:
             while True:
                 frame = await read_frame(reader)
                 corr, payload = frame[:8], frame[8:]
+                if corr == _shm.HELLO_CORR:
+                    # control plane: a loopback broker announcing it
+                    # accepts shared-memory reply references
+                    if payload == _shm.SHM_HELLO:
+                        shm_state["ok"] = True
+                    continue
                 # dispatch without blocking the read loop: the next
                 # frame is picked up while this one executes
                 t = asyncio.ensure_future(
-                    self._handle_one(corr, payload, writer, write_lock))
+                    self._handle_one(corr, payload, writer, write_lock,
+                                     shm_state, shm_created))
                 tasks.add(t)
                 t.add_done_callback(tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -109,10 +132,13 @@ class QueryServer:
                 t.cancel()
             self._connections.discard(writer)
             writer.close()
+            _shm.sweep(shm_created)
 
     async def _handle_one(self, corr: bytes, payload: bytes,
                           writer: asyncio.StreamWriter,
-                          write_lock: asyncio.Lock) -> None:
+                          write_lock: asyncio.Lock,
+                          shm_state: Optional[dict] = None,
+                          shm_created: Optional[List[str]] = None) -> None:
         try:
             if self.async_handler is not None:
                 reply = await self.async_handler(payload)
@@ -127,11 +153,28 @@ class QueryServer:
             # fails over, instead of letting one request hang forever
             writer.close()
             return
+        threshold = _shm.min_bytes()
+        if shm_state is not None and shm_state["ok"] and threshold and \
+                len(reply) >= threshold:
+            # colocated big reply: ship a shared-memory reference, not
+            # the payload (the broker unlinks after its zero-copy read)
+            if len(shm_created) >= _shm.PRUNE_AT:
+                # long-lived connection hygiene: forget names the
+                # broker already consumed, or the sweep list grows by
+                # one entry per big reply for the connection's lifetime
+                _shm.prune_consumed(shm_created)
+            try:
+                reply = _shm.encode_reply(reply, shm_created)
+            except OSError:
+                # /dev/shm full (container default is tiny): degrade
+                # to the inline payload instead of dropping the frame
+                # and letting the broker wait out its whole timeout
+                pass
         try:
             # the write lock keeps frames atomic when replies from many
             # tasks interleave on one connection
             async with write_lock:
-                write_frame(writer, corr + reply)
+                write_frame2(writer, corr, reply)
                 await writer.drain()
         except (ConnectionError, OSError):
             pass        # client went away; its broker timed out already
@@ -170,6 +213,11 @@ class ServerConnection:
             if self._writer is None or self._writer.is_closing():
                 self._reader, self._writer = await asyncio.open_connection(
                     self.host, self.port)
+                if _shm.min_bytes() and _shm.is_loopback(self.host):
+                    # announce shared-memory reply support (corr id 0
+                    # is reserved for this control frame)
+                    write_frame(self._writer,
+                                _shm.HELLO_CORR + _shm.SHM_HELLO)
                 self._reader_task = asyncio.ensure_future(
                     self._read_loop(self._reader, self._writer))
 
@@ -178,10 +226,31 @@ class ServerConnection:
         try:
             while True:
                 frame = await read_frame(reader)
-                corr = _CORR.unpack(frame[:8])[0]
+                corr = _CORR.unpack_from(frame, 0)[0]
                 fut = self._pending.pop(corr, None)
+                # the payload rides as a memoryview over the (immutable
+                # bytes) frame — handed straight to the zero-copy
+                # DataTable decoder, which aliases it safely
+                payload = memoryview(frame)[8:]
+                if _shm.is_shm_frame(payload):
+                    if fut is None or fut.done():
+                        _shm.discard_reply(payload)   # late: unlink
+                        continue
+                    reply = _shm.decode_reply(payload)
+                    if reply is None:
+                        fut.set_exception(ConnectionError(
+                            "shm reply segment vanished before attach"))
+                    else:
+                        # noted on the future too: if the caller
+                        # abandons it in the cancellation race window,
+                        # request() closes the reply via this attribute
+                        # (close() is idempotent, so the normal
+                        # consumer path double-closing is harmless)
+                        fut.shm_reply = reply
+                        fut.set_result(reply)
+                    continue
                 if fut is not None and not fut.done():
-                    fut.set_result(frame[8:])
+                    fut.set_result(payload)
                 # unknown/done id: a reply that outlived its timeout —
                 # dropped here, which is what keeps the stream in sync
         except asyncio.CancelledError:
@@ -238,6 +307,16 @@ class ServerConnection:
             raise
         try:
             return await asyncio.wait_for(fut, timeout)
+        except (asyncio.CancelledError, asyncio.TimeoutError):
+            # an shm reply that landed in the cancellation race window
+            # (future resolved, caller never consumed) must still be
+            # unlinked — nobody else holds the reference. The caller
+            # that DID consume closes through _call_once instead; a
+            # raced double close is a no-op (ShmReply.close guards).
+            reply = getattr(fut, "shm_reply", None)
+            if reply is not None:
+                reply.close()
+            raise
         finally:
             # timeout/cancel abandons only THIS request; the connection
             # and every other in-flight request stay live

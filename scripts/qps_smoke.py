@@ -1,11 +1,19 @@
 """QPS smoke rung for CI: the serving plane must sustain a modest
-target-QPS step over the real TCP data plane with zero errors.
+target-QPS step over the real TCP data plane with zero errors — and
+must never regress below the throughput the committed r06 artifact
+recorded for the PRE-zero-copy serving plane.
 
 A regression canary, not a benchmark: it catches a reintroduced
 one-in-flight-per-connection bottleneck, a serde blow-up, or a
 scheduler deadlock in seconds. The honest throughput numbers come from
 scripts/qps_curve.py (QPS_r*.json artifacts); docs/PERFORMANCE.md
 explains how to read both.
+
+Knee-regression gate: the committed QPS_r06.json (pre-overhaul plane,
+knee 100 QPS / ~78 sustained) is the floor. A rung offered at 2× the
+r06 sustained rate must achieve at least the r06 sustained rate with
+zero errors — if the zero-copy serving plane ever loses what the r06
+plane could do, CI fails.
 """
 import json
 import os
@@ -17,13 +25,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROWS = int(os.environ.get("QPS_SMOKE_ROWS", 4000))
 SEGMENTS = int(os.environ.get("QPS_SMOKE_SEGMENTS", 2))
-TARGET_QPS = float(os.environ.get("QPS_SMOKE_TARGET", 20.0))
 STEP_S = float(os.environ.get("QPS_SMOKE_STEP_S", 2.0))
 # generous floor: CI boxes are noisy; the pre-mux serving plane failed
 # this by an order of magnitude at equal per-query cost
 MIN_ACHIEVED_FRACTION = 0.5
+
+
+def _r06_sustained_qps() -> float:
+    """Max achieved QPS in the committed pre-overhaul artifact — the
+    throughput floor this plane must never regress below."""
+    try:
+        with open(os.path.join(REPO, "QPS_r06.json")) as f:
+            r06 = json.load(f)
+        return max(r["qps"] for r in r06["rungs"])
+    except (OSError, ValueError, KeyError):
+        return 78.0               # the committed r06 value, pinned
 
 
 def main() -> int:
@@ -31,6 +50,9 @@ def main() -> int:
     from pinot_tpu.tools.datagen import (build_ssb_segment_dirs,
                                          ssb_schema, ssb_table_config)
     from pinot_tpu.tools.perf import QueryRunner
+
+    floor = _r06_sustained_qps()
+    target = float(os.environ.get("QPS_SMOKE_TARGET", 2.0 * floor))
 
     base = tempfile.mkdtemp()
     dirs, _ids, _sc = build_ssb_segment_dirs(
@@ -47,16 +69,26 @@ def main() -> int:
                    "WHERE lo_quantity < 25"]
         runner = QueryRunner(cluster.query, queries)
         runner.single_thread(num_times=2)      # warm plan/kernel caches
-        report = runner.target_qps(qps=TARGET_QPS, duration_s=STEP_S,
+        report = runner.target_qps(qps=target, duration_s=STEP_S,
                                    num_threads=8)
-        print(json.dumps(report.to_json(), indent=1))
+        runner.close()
+        out = report.to_json()
+        out["r06_sustained_floor_qps"] = floor
+        print(json.dumps(out, indent=1))
         ok = True
         if report.num_errors:
-            print(f"FAIL: {report.num_errors} query errors", file=sys.stderr)
+            print(f"FAIL: {report.num_errors} query errors",
+                  file=sys.stderr)
             ok = False
-        if report.qps < MIN_ACHIEVED_FRACTION * TARGET_QPS:
+        if report.qps < MIN_ACHIEVED_FRACTION * target:
             print(f"FAIL: achieved {report.qps:.1f} QPS < "
-                  f"{MIN_ACHIEVED_FRACTION:.0%} of target {TARGET_QPS:g}",
+                  f"{MIN_ACHIEVED_FRACTION:.0%} of target {target:g}",
+                  file=sys.stderr)
+            ok = False
+        if report.qps < floor:
+            print(f"FAIL: achieved {report.qps:.1f} QPS < r06 sustained "
+                  f"floor {floor:.1f} — the serving plane regressed "
+                  "below the committed pre-zero-copy artifact",
                   file=sys.stderr)
             ok = False
         print("qps smoke: " + ("OK" if ok else "FAILED"))

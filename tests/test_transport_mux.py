@@ -383,6 +383,9 @@ def test_parallel_segment_execution_deadline_truncates():
 # DataTable wire-format compatibility
 # ---------------------------------------------------------------------------
 
+ALL_VERSIONS = (1, 2, 3)
+
+
 def _sample_tables():
     group_by = DataTable(
         kind=2, columns=["d1", "d2", "sum(m)", "avg(m)", "fasthll(x)"],
@@ -403,51 +406,370 @@ def _sample_tables():
     return [group_by, selection, aggregation, empty]
 
 
-def test_datatable_v1_payloads_still_decode():
-    """Old-version payloads (a version-skewed server mid-rollout) decode
-    bit-for-bit equal to what the v1 reader produced."""
+def test_datatable_cross_version_matrix():
+    """Every (encode version → decoder) pair in the rollout matrix —
+    old server → new broker AND new server → old-style payloads —
+    decodes value-equal: same rows, same schema, same metadata."""
     for dt in _sample_tables():
-        legacy = dt.to_bytes(version=1)
-        rt = DataTable.from_bytes(legacy)
-        assert rt.rows == dt.rows
-        assert rt.columns == dt.columns
-        assert rt.metadata == dt.metadata
-        assert rt.exceptions == dt.exceptions
-        assert rt.num_group_cols == dt.num_group_cols
+        decoded = {v: DataTable.from_bytes(dt.to_bytes(version=v))
+                   for v in ALL_VERSIONS}
+        for v, rt in decoded.items():
+            assert list(rt.rows) == list(dt.rows), f"v{v}"
+            assert rt.columns == dt.columns
+            assert rt.metadata == dt.metadata
+            assert rt.exceptions == dt.exceptions
+            assert rt.num_group_cols == dt.num_group_cols
+        # blocks rebuilt from every version agree with each other
+        from pinot_tpu.query.combine import (group_map_of,
+                                             selection_rows_of)
+        blocks = {v: rt.to_block() for v, rt in decoded.items()}
+        for v, b in blocks.items():
+            ref = blocks[1]
+            assert group_map_of(b) == group_map_of(ref), f"v{v}"
+            assert b.agg_intermediates == ref.agg_intermediates
+            assert selection_rows_of(b) == selection_rows_of(ref)
 
 
-def test_datatable_columnar_roundtrip_value_equal_to_row_path():
-    """The v2 columnar encoding decodes value-equal to the v1 row path
-    for every payload kind, including blocks rebuilt via to_block."""
+def test_datatable_v3_reencode_roundtrips_all_versions():
+    """A decoded v3 table re-encodes (from its column blocks, rows
+    never materialized) to every version bit-compatibly."""
     for dt in _sample_tables():
-        via_v1 = DataTable.from_bytes(dt.to_bytes(version=1))
-        via_v2 = DataTable.from_bytes(dt.to_bytes())
-        assert via_v2.rows == via_v1.rows
-        assert via_v2.columns == via_v1.columns
-        assert via_v2.metadata == via_v1.metadata
-        assert via_v2.exceptions == via_v1.exceptions
-        b1, b2 = via_v1.to_block(), via_v2.to_block()
-        assert b1.group_map == b2.group_map
-        assert b1.agg_intermediates == b2.agg_intermediates
-        assert b1.selection_rows == b2.selection_rows
+        v3 = DataTable.from_bytes(dt.to_bytes(version=3))
+        for v in ALL_VERSIONS:
+            rt = DataTable.from_bytes(v3.to_bytes(version=v))
+            assert list(rt.rows) == list(dt.rows)
+            assert rt.columns == dt.columns
 
 
 def test_datatable_columnar_preserves_python_types():
-    dt = DataTable(kind=3, columns=["i", "f", "s", "o"],
-                   rows=[(np.int64(7), np.float64(2.5), "a", True),
-                         (8, 3.5, "b", False)])
-    rt = DataTable.from_bytes(dt.to_bytes())
-    assert rt.rows == [(7, 2.5, "a", True), (8, 3.5, "b", False)]
-    assert type(rt.rows[0][0]) is int
-    assert type(rt.rows[0][1]) is float
-    assert type(rt.rows[0][3]) is bool
+    for version in (2, 3):
+        dt = DataTable(kind=3, columns=["i", "f", "s", "o"],
+                       rows=[(np.int64(7), np.float64(2.5), "a", True),
+                             (8, 3.5, "b", False)])
+        rt = DataTable.from_bytes(dt.to_bytes(version=version))
+        assert list(rt.rows) == [(7, 2.5, "a", True), (8, 3.5, "b", False)]
+        assert type(rt.rows[0][0]) is int
+        assert type(rt.rows[0][1]) is float
+        assert type(rt.rows[0][3]) is bool
 
 
 def test_datatable_from_block_to_block_roundtrip():
+    from pinot_tpu.query.combine import group_map_of
+
     request = compile_pql(
         "SELECT SUM(m) FROM t GROUP BY d1, d2 TOP 10")
     blk = IntermediateResultsBlock()
     blk.group_map = {("a", 1): [2.0], ("b", 2): [3.0]}
     dt = DataTable.from_block(request, blk)
     rt = DataTable.from_bytes(dt.to_bytes())
-    assert rt.to_block().group_map == blk.group_map
+    assert group_map_of(rt.to_block()) == blk.group_map
+
+
+def test_datatable_v3_zero_copy_aliasing_safety():
+    """The aliasing contract: decoding from an immutable bytes frame
+    may alias (and must keep the frame alive); decoding from a REUSED
+    writable buffer must copy — clobbering the buffer afterwards cannot
+    change the decoded values."""
+    dt = DataTable(kind=3, columns=["a", "b"],
+                   rows=[(i, float(i) * 0.5) for i in range(256)])
+    payload = dt.to_bytes(version=3)
+
+    # immutable bytes: views may alias; frame stays alive via the array
+    rt = DataTable.from_bytes(payload)
+    assert rt.col_data is not None
+    del payload                       # only the decoded table holds it
+    assert list(rt.rows)[:3] == [(0, 0.0), (1, 0.5), (2, 1.0)]
+
+    # writable frame arena (the reuse case): decode, clobber, re-check
+    arena = bytearray(dt.to_bytes(version=3))
+    rt2 = DataTable.from_bytes(memoryview(arena))
+    before = [tuple(r) for r in rt2.rows]
+    arena[:] = b"\xee" * len(arena)   # simulate frame-buffer reuse
+    rt2._rows = None                  # re-materialize from col_data
+    assert [tuple(r) for r in rt2.rows] == before
+    for col in rt2.col_data:
+        if isinstance(col, np.ndarray):
+            assert col.base is None or col.base.obj is not arena
+
+
+# ---------------------------------------------------------------------------
+# columnar-vs-row reduce bit-parity
+# ---------------------------------------------------------------------------
+
+def _reduce_both_ways(pql, blocks_rows):
+    """Reduce the same per-server payloads decoded via the row path
+    (v2) and the columnar path (v3); returns both response JSONs."""
+    from pinot_tpu.query.reduce import BrokerReduceService
+
+    request = compile_pql(pql)
+    out = []
+    for version in (2, 3):
+        tables = []
+        for blk in blocks_rows:
+            dt = DataTable.from_block(request, blk)
+            tables.append(DataTable.from_bytes(dt.to_bytes(version)))
+        resp = BrokerReduceService().reduce(
+            request, [t.to_block() for t in tables],
+            num_servers_queried=len(tables),
+            num_servers_responded=len(tables))
+        out.append(resp.to_json())
+    return out
+
+
+def _stats_block(**kw):
+    blk = IntermediateResultsBlock(**kw)
+    blk.stats.num_docs_scanned = 10
+    blk.stats.total_docs = 100
+    return blk
+
+
+def test_reduce_parity_aggregation_count_sum():
+    b1 = _stats_block(agg_intermediates=[7, 12.5])
+    b2 = _stats_block(agg_intermediates=[3, 2.25])
+    row, col = _reduce_both_ways(
+        "SELECT COUNT(*), SUM(m) FROM t", [b1, b2])
+    assert row == col
+
+
+def test_reduce_parity_group_by_all_folds():
+    """COUNT/SUM/MIN/MAX group-by over 3 servers with overlapping and
+    disjoint keys: the vectorized fold must be bit-identical to the
+    dict merge, including top-N order and formatted values."""
+    import random
+    rng = random.Random(5)
+    blocks = []
+    for _ in range(3):
+        gm = {}
+        for k in rng.sample(range(40), 25):
+            gm[(f"g{k}", k)] = [rng.randint(1, 9),
+                                round(rng.uniform(-50, 50), 3),
+                                float(rng.randint(-20, 20)),
+                                float(rng.randint(-20, 20))]
+        blocks.append(_stats_block(group_map=gm))
+    row, col = _reduce_both_ways(
+        "SELECT COUNT(*), SUM(m), MIN(m), MAX(m) FROM t "
+        "GROUP BY d1, d2 TOP 12", blocks)
+    assert row == col
+
+
+def test_reduce_parity_group_by_obj_intermediates_fall_back():
+    """AVG pairs cannot fold vectorized — the columnar payload must
+    fall back to the row engine and still match exactly."""
+    b1 = _stats_block(group_map={("a",): [(10.0, 2)],
+                                 ("b",): [(3.0, 1)]})
+    b2 = _stats_block(group_map={("a",): [(2.0, 2)],
+                                 ("c",): [(9.0, 3)]})
+    row, col = _reduce_both_ways(
+        "SELECT AVG(m) FROM t GROUP BY d TOP 5", [b1, b2])
+    assert row == col
+
+
+def test_reduce_parity_group_by_obj_trim_does_not_crash():
+    """A single columnar AVG payload exceeding 4×trim must trim through
+    the row engine (object intermediates cannot fold vectorized)."""
+    gm = {(f"g{i}",): [(float(i), 2)] for i in range(20_050)}
+    row, col = _reduce_both_ways(
+        "SELECT AVG(m) FROM t GROUP BY d TOP 3", [_stats_block(group_map=gm)])
+    assert row == col
+    assert len(row["aggregationResults"][0]["groupByResult"]) == 3
+
+
+def test_reduce_parity_group_by_int64_exact_past_2_53():
+    """int64 COUNT folds stay EXACT past 2^53 (no float64 accumulation
+    in the columnar engine — COUNT finals format as exact ints), and
+    ordering ties exactly where the row oracle's float sort key ties."""
+    big = (1 << 60)
+    b1 = _stats_block(group_map={("a",): [big + 3], ("b",): [big + 1]})
+    b2 = _stats_block(group_map={("a",): [1], ("c",): [big + 2]})
+    row, col = _reduce_both_ways(
+        "SELECT COUNT(*) FROM t GROUP BY d TOP 3", [b1, b2])
+    assert row == col
+    vals = [g["value"]
+            for g in col["aggregationResults"][0]["groupByResult"]]
+    # exact values AND exact (int-semantics) descending order
+    assert vals == [str(big + 4), str(big + 2), str(big + 1)]
+
+
+def test_reduce_parity_zero_row_block_keeps_columnar_engine():
+    """A server that matched nothing must not demote the merge: the
+    result equals the row engine AND the merged block stays columnar."""
+    from pinot_tpu.query.combine import combine_blocks
+
+    empty = _stats_block(group_map={})
+    full = _stats_block(group_map={("a",): [5], ("b",): [7]})
+    request = compile_pql("SELECT COUNT(*) FROM t GROUP BY d TOP 5")
+    tables = []
+    for blk in (empty, full, empty):
+        dt = DataTable.from_block(request, blk)
+        tables.append(DataTable.from_bytes(dt.to_bytes(3)))
+    merged = combine_blocks(request, [t.to_block() for t in tables])
+    assert merged.group_cols is not None     # columnar path survived
+    row, col = _reduce_both_ways(
+        "SELECT COUNT(*) FROM t GROUP BY d TOP 5",
+        [_stats_block(group_map={}),
+         _stats_block(group_map={("a",): [5], ("b",): [7]}),
+         _stats_block(group_map={})])
+    assert row == col
+
+
+def test_reduce_parity_group_by_mixed_type_keys_fall_back():
+    """A key column mixing str and int (or None) serializes as an
+    object-tagged block; the columnar gate must reject it so '5' and 5
+    stay DISTINCT groups (np.unique would stringify-collapse them)."""
+    b1 = _stats_block(group_map={("5",): [4], (5,): [2]})
+    b2 = _stats_block(group_map={(5,): [1], (None,): [3]})
+    row, col = _reduce_both_ways(
+        "SELECT COUNT(*) FROM t GROUP BY d TOP 5", [b1, b2])
+    assert row == col
+    groups = {tuple(g["group"]): g["value"] for g in
+              col["aggregationResults"][0]["groupByResult"]}
+    assert groups[("5",)] == "4" and groups[(5,)] == "3"
+
+
+def test_reduce_parity_group_by_nan_keys_fall_back():
+    """np.unique treats every NaN as equal; the dict oracle keeps NaN
+    keys distinct — NaN-keyed payloads must use the row engine."""
+    import json as _json
+    nan = float("nan")
+    b1 = _stats_block(group_map={(nan,): [10], (1.0,): [20]})
+    b2 = _stats_block(group_map={(nan,): [5], (2.0,): [7]})
+    row, col = _reduce_both_ways(
+        "SELECT COUNT(*) FROM t GROUP BY d TOP 5", [b1, b2])
+    # dict equality is poisoned by nan != nan — compare the serialized
+    # responses instead
+    assert _json.dumps(row) == _json.dumps(col)
+    vals = sorted(g["value"] for g in
+                  col["aggregationResults"][0]["groupByResult"])
+    # two DISTINCT NaN groups (10 and 5), never one merged 15
+    assert vals == ["10", "20", "5", "7"]
+
+
+def test_reduce_parity_group_by_int64_sum_overflow_falls_back():
+    """Per-server int sums that would wrap an int64 fold across the
+    merge must take the row engine's unbounded python-int path."""
+    big = 1 << 62
+    blocks = [_stats_block(group_map={("a",): [big]}) for _ in range(2)]
+    row, col = _reduce_both_ways(
+        "SELECT SUM(m) FROM t GROUP BY d TOP 2",
+        [_stats_block(group_map={("a",): [big]}) for _ in range(2)])
+    del blocks
+    assert row == col
+    v = col["aggregationResults"][0]["groupByResult"][0]["value"]
+    assert float(v) > 0          # never the wrapped negative int64
+
+
+def test_reduce_parity_selection_order_by():
+    import random
+    rng = random.Random(11)
+    blocks = []
+    for _ in range(3):
+        rows = [(rng.randint(0, 50), f"n{rng.randint(0, 99)}",
+                 round(rng.uniform(0, 1), 6)) for _ in range(40)]
+        blocks.append(_stats_block(
+            selection_rows=rows, selection_columns=["x", "name", "s"]))
+    for pql in (
+            "SELECT x, name, s FROM t ORDER BY x DESC LIMIT 17",
+            "SELECT x, name, s FROM t ORDER BY name, s DESC LIMIT 9",
+            "SELECT x, name, s FROM t LIMIT 30"):
+        row, col = _reduce_both_ways(pql, [
+            _stats_block(selection_rows=list(b.selection_rows),
+                         selection_columns=list(b.selection_columns))
+            for b in blocks])
+        assert row == col, pql
+
+
+def test_reduce_parity_vector_similarity_merge():
+    """Vector top-k merge order (score desc, segment/docId asc) through
+    the lexsort engine matches the row-tuple oracle."""
+    import random
+    rng = random.Random(3)
+    cols = ["id", "$score", "$segmentName", "$docId"]
+    blocks = []
+    for s in range(3):
+        rows = [(rng.randint(0, 1000), round(rng.uniform(0, 1), 6),
+                 f"seg_{s}", d) for d in range(20)]
+        # duplicate scores across segments exercise the tiebreaker
+        rows[0] = (1, 0.5, f"seg_{s}", 0)
+        blocks.append(_stats_block(
+            selection_rows=rows, selection_columns=list(cols)))
+    row, col = _reduce_both_ways(
+        "SELECT id, VECTOR_SIMILARITY(emb, [1.0, 0.0], 15) FROM t",
+        blocks)
+    assert row == col
+
+
+# ---------------------------------------------------------------------------
+# shared-memory reply transport (colocated broker↔server)
+# ---------------------------------------------------------------------------
+
+def test_shm_reply_round_trip_and_unlink(monkeypatch):
+    """A reply over the threshold rides shared memory: the broker-side
+    connection resolves the reference, the decoder copies out of the
+    writable segment, and the segment is unlinked after consumption."""
+    from multiprocessing import shared_memory
+
+    from pinot_tpu.broker.request_handler import TcpTransport
+    from pinot_tpu.common.serde import instance_request_to_bytes
+    from pinot_tpu.common.request import InstanceRequest
+
+    monkeypatch.setenv("PINOT_TPU_SHM_MIN_BYTES", "1024")
+
+    big = DataTable(kind=3, columns=["a", "b"],
+                    rows=[(i, float(i)) for i in range(4096)])
+    payload_len = len(big.to_bytes())
+    assert payload_len > 1024
+    names = []
+
+    async def handler(payload: bytes) -> bytes:
+        return big.to_bytes()
+
+    async def main():
+        server = QueryServer("127.0.0.1", 0, handler=None,
+                             async_handler=handler)
+        await server.start()
+        transport = TcpTransport(
+            {"s0": ("127.0.0.1", server.port)})
+        try:
+            req = instance_request_to_bytes(InstanceRequest(
+                request_id=1, query=compile_pql(
+                    "SELECT a, b FROM t LIMIT 10")))
+            from pinot_tpu.transport.shm import ShmReply
+            raw = await transport.query("s0", req, timeout=30)
+            assert isinstance(raw, ShmReply)
+            names.append(raw._seg.name)
+            dt = DataTable.from_bytes(raw.view)
+            raw.close()
+            assert list(dt.rows) == list(big.rows)
+        finally:
+            await transport.close()
+            await server.stop()
+
+    _run(main())
+    # consumed segment must be gone from the system
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=names[0])
+
+
+def test_shm_small_replies_stay_inline(monkeypatch):
+    monkeypatch.setenv("PINOT_TPU_SHM_MIN_BYTES", "1048576")
+
+    from pinot_tpu.broker.request_handler import TcpTransport
+    from pinot_tpu.transport.shm import ShmReply
+
+    async def handler(payload: bytes) -> bytes:
+        return b"tiny-reply"
+
+    async def main():
+        server = QueryServer("127.0.0.1", 0, handler=None,
+                             async_handler=handler)
+        await server.start()
+        transport = TcpTransport({"s0": ("127.0.0.1", server.port)})
+        try:
+            raw = await transport.query("s0", b"x", timeout=30)
+            assert not isinstance(raw, ShmReply)
+            assert bytes(raw) == b"tiny-reply"
+        finally:
+            await transport.close()
+            await server.stop()
+
+    _run(main())
