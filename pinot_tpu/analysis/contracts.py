@@ -158,6 +158,76 @@ def check_kernel_contracts(buckets=None) -> List[str]:
                             f"{name}@P={padded}: output `{key}` must "
                             f"be integral (docid/count contract), "
                             f"got {dt}")
+    violations.extend(_check_extra_kernels(buckets, x64))
+    return violations
+
+
+def _materialize_tree(spec, padded: int):
+    """Materialize a pytree of (dtype, shape) leaves (extra kernel
+    cases): tuples whose first element is a string are leaves."""
+    import numpy as np
+    if isinstance(spec, tuple) and len(spec) == 2 and \
+            isinstance(spec[0], str):
+        dtype, shape = spec
+        shape = tuple(padded if s == "P" else s for s in shape)
+        return np.zeros(shape, dtype=np.dtype(dtype))
+    return tuple(_materialize_tree(s, padded) for s in spec)
+
+
+def _check_extra_kernels(buckets, x64: bool) -> List[str]:
+    """Trace the non-segment-plan kernel families (window stage-2 —
+    kernels.extra_contract_cases) through the same jaxpr gates."""
+    import jax
+    import numpy as np
+
+    from pinot_tpu.ops import kernels
+
+    violations: List[str] = []
+    for name, builder, static_args, arg_specs in \
+            kernels.extra_contract_cases():
+        for padded in buckets:
+            args = tuple(padded if a == "P" else a for a in static_args)
+            try:
+                k1 = builder(*args)
+                k2 = builder(*args)
+            except TypeError as e:
+                violations.append(f"{name}: builder args not hashable — "
+                                  f"jit cache can never hit: {e}")
+                break
+            if k1 is not k2:
+                violations.append(
+                    f"{name}@P={padded}: builder missed its cache on "
+                    "equal args — every dispatch would recompile")
+            operands = _materialize_tree(arg_specs, padded)
+            try:
+                closed = jax.make_jaxpr(k1)(*operands)
+                closed2 = jax.make_jaxpr(k1)(*operands)
+            except Exception as e:  # noqa: BLE001 — the finding itself
+                violations.append(
+                    f"{name}@P={padded}: kernel does not trace "
+                    f"abstractly: {type(e).__name__}: {e}")
+                continue
+            cbs = find_callbacks(closed)
+            if cbs:
+                violations.append(
+                    f"{name}@P={padded}: host callback primitive(s) "
+                    f"{sorted(set(cbs))} inside the kernel jaxpr")
+            if str(closed) != str(closed2):
+                violations.append(
+                    f"{name}@P={padded}: re-trace produced a different "
+                    "jaxpr — trace-time nondeterminism")
+            shapes = jax.eval_shape(k1, *operands)
+            for key, sds in sorted(shapes.items()):
+                dt = np.dtype(sds.dtype)
+                if not x64 and dt.itemsize == 8 and dt.kind in "iuf":
+                    violations.append(
+                        f"{name}@P={padded}: output `{key}` is {dt} "
+                        "under 32-bit mode")
+                if key.startswith("win.") and not x64 and \
+                        dt != np.dtype("int32"):
+                    violations.append(
+                        f"{name}@P={padded}: output `{key}` must be "
+                        f"int32 (window contract), got {dt}")
     return violations
 
 
@@ -188,9 +258,10 @@ def _shape_of(v, depth: int = 0):
 def _exemplar_request():
     from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                           FilterOperator, FilterQueryTree,
-                                          GroupBy, HavingNode,
+                                          GroupBy, HavingNode, JoinSpec,
                                           QueryOptions, Selection,
-                                          SelectionSort, VectorSimilarity)
+                                          SelectionSort, VectorSimilarity,
+                                          WindowSpec)
     filt = FilterQueryTree(
         operator=FilterOperator.AND,
         children=[
@@ -211,6 +282,14 @@ def _exemplar_request():
                             offset=1, size=7),
         vector=VectorSimilarity(column="e", query=[1.0, 0.0], k=3,
                                 metric="COSINE"),
+        join=JoinSpec(dim_table="d", fact_key="k", dim_key="pk",
+                      dim_filter=FilterQueryTree(
+                          operator=FilterOperator.EQUALITY, column="a",
+                          values=["v"]),
+                      dim_columns=["b"]),
+        windows=[WindowSpec(function="SUM", column="m",
+                            partition_by=["g"],
+                            order_by=[SelectionSort("t", True)])],
         having=having,
         query_options=QueryOptions(trace=True, timeout_ms=1000,
                                    debug_options={"k": "v"},
@@ -237,7 +316,15 @@ def wire_schema() -> dict:
         InstanceRequest(request_id=1, query=req, search_segments=["s"],
                         enable_trace=True, broker_id="b",
                         deadline_budget_ms=10.0, trace_id="t",
-                        parent_span_id="p", workload="w", hedge=True)))
+                        parent_span_id="p", workload="w", hedge=True,
+                        publish_exchange={"id": "x1.0",
+                                          "keyColumn": "pk"},
+                        exchange_sources=[{
+                            "server": "s", "xkey": "k", "host": "h",
+                            "port": 1, "id": "x1.0", "rows": 1,
+                            "partitions": [0],
+                            "partitionFunction": "Modulo",
+                            "numPartitions": 2}])))
     resp = BrokerResponse(
         aggregation_results=[
             AggregationResult(function="sum(m)", value=1.0),
@@ -280,9 +367,30 @@ def wire_schema() -> dict:
                 dtmod._COL_OBJ)),
             "structuredMetadataKeys": sorted([
                 dtmod.MISSING_SEGMENTS_KEY, dtmod.SERVER_BUSY_KEY,
-                dtmod.RETRY_AFTER_MS_KEY, dtmod.RESULT_CACHE_HIT_KEY]),
+                dtmod.RETRY_AFTER_MS_KEY, dtmod.RESULT_CACHE_HIT_KEY,
+                dtmod.STAGE_ERROR_KEY]),
         },
         "objectSerde": object_tags,
+        # exchange plane (multi-stage stage-1 blocks, server↔server):
+        # the frame magic + fetch-op JSON keys, and the ack/source
+        # metadata keys the broker round-trips into stage-2 requests
+        "exchangeFrame": _exchange_frame_schema(),
+    }
+
+
+def _exchange_frame_schema() -> dict:
+    from pinot_tpu.query.stages import exchange
+    frame = exchange.fetch_frame("x1.0")
+    msg = json.loads(frame[4:].decode("utf-8"))
+    return {
+        "magic": exchange.XCHG_MAGIC.decode("latin1"),
+        "fetchKeys": sorted(msg),
+        "ackMetadataKeys": sorted([
+            "exchangeId", "exchangeKey", "exchangeRows",
+            "exchangePartitions", "partitionFunction", "numPartitions"]),
+        "sourceKeys": sorted([
+            "server", "xkey", "host", "port", "id", "rows",
+            "partitions", "partitionFunction", "numPartitions"]),
     }
 
 

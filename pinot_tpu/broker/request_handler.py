@@ -23,10 +23,10 @@ from pinot_tpu.common.datatable import (DataTable, MISSING_SEGMENTS_KEY,
                                         RETRY_AFTER_MS_KEY,
                                         SEGMENT_MISSING_EXC_PREFIX,
                                         SERVER_BUSY_EXC_PREFIX,
-                                        SERVER_BUSY_KEY)
+                                        SERVER_BUSY_KEY, STAGE_ERROR_KEY)
 from pinot_tpu.common.metrics import (BrokerGauge, BrokerMeter,
                                       BrokerQueryPhase, MetricsRegistry)
-from pinot_tpu.transport.shm import ShmReply
+from pinot_tpu.transport import shm as _shm_mod
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.common.serde import instance_request_to_bytes
@@ -173,7 +173,8 @@ class QueryRouter:
                      deadline: Optional[float] = None,
                      trace: Optional[TraceContext] = None,
                      parent_span_id: Optional[str] = None,
-                     workload: Optional[str] = None
+                     workload: Optional[str] = None,
+                     exchange_sources: Optional[List[dict]] = None
                      ) -> Tuple[List[DataTable], int, int, List[dict]]:
         """routes: [(per-table request, {server: segments})] — returns
         (tables, num_queried, num_responded, errors). `deadline` is an
@@ -192,7 +193,8 @@ class QueryRouter:
         outcomes = await asyncio.gather(
             *(self._query_unit(request_id, sub, server, segments,
                                deadline, enable_trace, trace,
-                               parent_span_id, workload)
+                               parent_span_id, workload,
+                               exchange_sources)
               for sub, server, segments in units))
         tables: List[DataTable] = []
         errors: List[dict] = []
@@ -210,7 +212,8 @@ class QueryRouter:
                           deadline: float, enable_trace: bool,
                           trace: Optional[TraceContext] = None,
                           parent_span_id: Optional[str] = None,
-                          workload: Optional[str] = None):
+                          workload: Optional[str] = None,
+                          exchange_sources: Optional[List[dict]] = None):
         errors: List[dict] = []
         tried = {server}
         tables: List[DataTable] = []
@@ -220,7 +223,8 @@ class QueryRouter:
         dt = await self._dispatch_hedged(request_id, sub, server,
                                          segments, deadline,
                                          enable_trace, errors, tried,
-                                         trace, parent_span_id, workload)
+                                         trace, parent_span_id, workload,
+                                         exchange_sources)
         if dt is not None:
             for e in errors:         # e.g. primary failed, hedge won
                 e["recovered"] = True
@@ -251,7 +255,8 @@ class QueryRouter:
             results = await asyncio.gather(
                 *(self._call_once(request_id, sub, srv, segs, deadline,
                                   enable_trace, errors, trace,
-                                  parent_span_id, workload)
+                                  parent_span_id, workload,
+                                  exchange_sources=exchange_sources)
                   for srv, segs in items))
             next_remaining: List[str] = []
             for (srv, segs), dt in zip(items, results):
@@ -271,12 +276,13 @@ class QueryRouter:
     async def _dispatch_hedged(self, request_id, sub, server, segments,
                                deadline, enable_trace, errors, tried,
                                trace=None, parent_span_id=None,
-                               workload=None):
+                               workload=None, exchange_sources=None):
         """Primary call with a latency hedge to one replica."""
         ft = self.fault_tolerance
         primary = asyncio.ensure_future(self._call_once(
             request_id, sub, server, segments, deadline, enable_trace,
-            errors, trace, parent_span_id, workload))
+            errors, trace, parent_span_id, workload,
+            exchange_sources=exchange_sources))
         hedge_after = ft.hedge_delay_s(server) if ft is not None else None
         if hedge_after is None:
             return await primary
@@ -300,7 +306,7 @@ class QueryRouter:
         hedge = asyncio.ensure_future(self._call_once(
             request_id, sub, hedge_server, segments, deadline,
             enable_trace, errors, trace, parent_span_id, workload,
-            hedge=True))
+            hedge=True, exchange_sources=exchange_sources))
         pending = {primary, hedge}
         winner = None
         while pending and winner is None:
@@ -323,7 +329,7 @@ class QueryRouter:
     async def _call_once(self, request_id, sub, server, segments,
                          deadline, enable_trace, errors, trace=None,
                          parent_span_id=None, workload=None,
-                         hedge=False):
+                         hedge=False, exchange_sources=None):
         """One dispatch to one server; stamps the remaining budget,
         classifies failures, feeds the health/breaker state."""
         ft = self.fault_tolerance
@@ -356,7 +362,8 @@ class QueryRouter:
             deadline_budget_ms=budget * 1e3,
             trace_id=trace.trace_id if dspan is not None else None,
             parent_span_id=dspan["spanId"] if dspan is not None else None,
-            workload=workload, hedge=hedge))
+            workload=workload, hedge=hedge,
+            exchange_sources=exchange_sources))
         self.metrics.meter(BrokerMeter.INSTANCE_REQUEST_BYTES).mark(
             len(payload))
         t0 = self._clock()
@@ -371,16 +378,10 @@ class QueryRouter:
             with self.metrics.timer(
                     BrokerQueryPhase
                     .SERVER_RESPONSE_DESERIALIZATION).time():
-                if isinstance(raw, ShmReply):
-                    # colocated shared-memory reply: decode straight
-                    # from the segment, then unlink (the decoder copies
-                    # blocks out of writable buffers by contract)
-                    try:
-                        dt = DataTable.from_bytes(raw.view)
-                    finally:
-                        raw.close()
-                else:
-                    dt = DataTable.from_bytes(raw)
+                # colocated shared-memory replies decode straight from
+                # the segment, then unlink (the decoder copies blocks
+                # out of writable buffers by contract)
+                dt = _shm_mod.datatable_from_reply(raw)
         except asyncio.CancelledError:
             # hedge loser / caller teardown: mark the span so the tree
             # shows an abandoned dispatch, not a 0ms "success"
@@ -717,9 +718,14 @@ class BrokerRequestHandler:
             bound_ms = self.default_cache_freshness_ms
         # traced queries bypass the cache both ways: the client asked
         # to watch THIS execution, and a cached reply has no spans
-        # (the put at _finish has the matching guard)
+        # (the put at _finish has the matching guard). Multi-stage
+        # queries bypass too: the fingerprint keys on ONE table, but a
+        # join answer also depends on the DIM table's segment state — a
+        # cached join result would survive dim-table changes (the server
+        # cache has the matching guard in ServerInstance._stage_request)
         cache_bound = None
-        if not request.query_options.trace:
+        if not request.query_options.trace and request.join is None and \
+                not request.windows:
             if bound_ms is not None and \
                     self.routing.has_table(realtime_table(raw)):
                 cache_bound = bound_ms
@@ -772,6 +778,13 @@ class BrokerRequestHandler:
         with self.metrics.timer(BrokerQueryPhase.SCATTER_GATHER).time(), \
                 trace.span(BrokerQueryPhase.SCATTER_GATHER) as sg:
             sg_id = sg["spanId"] if sg is not None else None
+            if request.join is not None or request.windows:
+                # multi-stage plan: stage-1 exchange publish, then the
+                # stage-2 scatter (query/stages/broker.py)
+                from pinot_tpu.query.stages import broker as stages_broker
+                return await stages_broker.scatter_stages(
+                    self, request, routes, timeout_s, deadline, trace,
+                    workload, next(self._request_ids))
             tables, queried, responded, errors = await self.router.submit(
                 next(self._request_ids), routes, timeout_s,
                 enable_trace=request.query_options.trace,
@@ -797,16 +810,46 @@ class BrokerRequestHandler:
         if responded < queried:
             self.metrics.meter(
                 BrokerMeter.BROKER_RESPONSES_WITH_PARTIAL_SERVERS).mark()
+        # multi-stage compile errors come back as STAGE_ERROR_KEY-tagged
+        # tables (deterministic query properties → 4xx, never reduced)
+        stage_errs = [dt for dt in tables if STAGE_ERROR_KEY in dt.metadata]
+        tables = [dt for dt in tables
+                  if STAGE_ERROR_KEY not in dt.metadata]
+        unrecovered = [e for e in errors if not e.get("recovered")]
         with self.metrics.timer(BrokerQueryPhase.REDUCE).time(), \
                 trace.span(BrokerQueryPhase.REDUCE):
             blocks = [dt.to_block() for dt in tables]
-            resp = self.reducer.reduce(request, blocks) if blocks else \
-                _error_response(427, "ServerNotRespondedError: no server "
-                                "responded in time")
+            if blocks:
+                resp = self.reducer.reduce(request, blocks)
+            elif stage_errs:
+                from pinot_tpu.query.stages.errors import \
+                    STAGE_COMPILE_ERROR_CODE
+                msg = stage_errs[0].exceptions[0] if \
+                    stage_errs[0].exceptions else \
+                    stage_errs[0].metadata[STAGE_ERROR_KEY]
+                resp = _error_response(STAGE_COMPILE_ERROR_CODE, str(msg))
+                stage_errs = stage_errs[1:]
+            else:
+                typed = next((e for e in unrecovered
+                              if e.get("errorCode")), None)
+                resp = _error_response(typed["errorCode"],
+                                       typed["message"]) \
+                    if typed is not None else \
+                    _error_response(427, "ServerNotRespondedError: no "
+                                    "server responded in time")
+                if typed is not None:
+                    unrecovered = [e for e in unrecovered
+                                   if e is not typed]
+        for dt in stage_errs:
+            from pinot_tpu.query.stages.errors import \
+                STAGE_COMPILE_ERROR_CODE
+            resp.exceptions.append({
+                "errorCode": STAGE_COMPILE_ERROR_CODE,
+                "message": str(dt.exceptions[0] if dt.exceptions
+                               else dt.metadata[STAGE_ERROR_KEY])})
         # surface per-server failures a replica did NOT recover (the
         # old code silently `continue`d over them); recovered ones are
         # telemetry-only (meters/health), not client-facing noise
-        unrecovered = [e for e in errors if not e.get("recovered")]
         for e in unrecovered:
             # the structured busyCause marker from _call_once is the
             # classifier — never the message text, whose wording is
@@ -815,8 +858,9 @@ class BrokerRequestHandler:
             resp.exceptions.append({
                 # 503: typed server-busy (admission shed) — distinct
                 # from 425 server errors so clients can back off
-                # instead of treating overload as a fault
-                "errorCode": 503 if busy else 425,
+                # instead of treating overload as a fault; stage
+                # orchestration errors carry their own code
+                "errorCode": e.get("errorCode") or (503 if busy else 425),
                 "message": f"ServerQueryError: server={e['server']}: "
                            f"{e['message']}"})
         if not tables and unrecovered and \
@@ -925,7 +969,9 @@ class BrokerRequestHandler:
                                       enable_trace: bool = False,
                                       trace: Optional[TraceContext] = None,
                                       parent_span_id: Optional[str] = None,
-                                      workload: Optional[str] = None):
+                                      workload: Optional[str] = None,
+                                      exchange_sources: Optional[
+                                          List[dict]] = None):
         """One re-dispatch of segments a server reported missing.
 
         A routing table sampled just before a rebalance drop step / a
@@ -1003,7 +1049,8 @@ class BrokerRequestHandler:
         retry_tables, rq, rr, errors = await self.router.submit(
             next(self._request_ids), retry_routes, remaining_s,
             enable_trace=enable_trace, deadline=deadline, trace=trace,
-            parent_span_id=parent_span_id, workload=workload)
+            parent_span_id=parent_span_id, workload=workload,
+            exchange_sources=exchange_sources)
         return tables + retry_tables, rq, rr, errors
 
     def _pruned_route(self, sub_request: BrokerRequest, table: str

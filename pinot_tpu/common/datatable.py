@@ -82,6 +82,12 @@ RETRY_AFTER_MS_KEY = "retryAfterMs"
 SERVER_BUSY_EXC_PREFIX = "ServerBusyError:"
 # Metadata marker on replies served from the server result cache.
 RESULT_CACHE_HIT_KEY = "resultCacheHit"
+# Structured marker for multi-stage compile errors (join key type
+# mismatch, non-unique dim keys, window overflow, exchange capacity):
+# the value is a short machine kind, the human message rides in
+# exceptions. The broker maps these to 4xx errorCodes — deterministic
+# query properties, never retried as server faults.
+STAGE_ERROR_KEY = "stageError"
 
 
 def _col_to_list(col) -> list:

@@ -119,6 +119,60 @@ class VectorSimilarity:
 
 
 @dataclasses.dataclass
+class JoinSpec:
+    """One INNER equi-join against a small dimension table.
+
+    Compiled from ``FROM fact JOIN dim ON fact.k = dim.k``. The fact side
+    is the request's own table; the dim side is scanned in stage 1 of the
+    multi-stage plan (filtered by `dim_filter`, projecting `dim_key` +
+    `dim_columns`), shipped through the exchange plane, and probed by the
+    stage-2 fact kernels. Dim join keys must be unique (star-schema PK
+    semantics: each fact row matches at most one dim row).
+
+    Column name conventions in a compiled join request: fact columns are
+    stored UNQUALIFIED (the engine resolves them against fact segments);
+    dim columns appear qualified as ``<dim_table>.<col>`` wherever they
+    ride in the shared request shape (group_by.columns), and unqualified
+    inside this spec's dim-side fields.
+    """
+    dim_table: str
+    fact_key: str                         # fact column (unqualified)
+    dim_key: str                          # dim column (unqualified)
+    dim_filter: Optional[FilterQueryTree] = None   # dim-side WHERE conjuncts
+    dim_columns: List[str] = dataclasses.field(default_factory=list)
+
+    def qualifies(self, col: str) -> bool:
+        """True when `col` is a dim-qualified reference of this join."""
+        return col.startswith(self.dim_table + ".")
+
+    def unqualify(self, col: str) -> str:
+        return col[len(self.dim_table) + 1:]
+
+
+@dataclasses.dataclass
+class WindowSpec:
+    """One window function: ``ROW_NUMBER() OVER (...)`` or
+    ``SUM(col) OVER (PARTITION BY ... ORDER BY ...)``.
+
+    Frame semantics: rows between unbounded preceding and CURRENT ROW in
+    the window order (running aggregates), with ties broken by input
+    order — the one deterministic frame the device cumsum kernel and the
+    host oracle reproduce bit-identically. SUM windows are integer-only
+    (int32 running sums are the cross-backend exactness contract; the
+    executor rejects inputs whose running sums could wrap).
+    """
+    function: str                          # "ROW_NUMBER" | "SUM"
+    column: Optional[str] = None           # SUM argument (None: ROW_NUMBER)
+    partition_by: List[str] = dataclasses.field(default_factory=list)
+    order_by: List[SelectionSort] = dataclasses.field(default_factory=list)
+
+    @property
+    def result_name(self) -> str:
+        arg = self.column or ""
+        return f"{self.function.lower()}({arg})_over"
+
+
+@dataclasses.dataclass
 class HavingNode:
     """HAVING clause tree: comparison over aggregation results, or AND/OR."""
     operator: FilterOperator              # EQUALITY/NOT/RANGE/IN/... or AND/OR
@@ -154,6 +208,11 @@ class BrokerRequest:
     # ranked vector top-k (set together with `selection`, whose columns
     # are the ride-along display columns and whose size bounds the merge)
     vector: Optional[VectorSimilarity] = None
+    # multi-stage surfaces (query/stages/): an INNER equi-join against a
+    # dim table, or window functions over the scan result. Mutually
+    # exclusive with each other and with `vector`.
+    join: Optional[JoinSpec] = None
+    windows: List[WindowSpec] = dataclasses.field(default_factory=list)
     having: Optional[HavingNode] = None
     query_options: QueryOptions = dataclasses.field(default_factory=QueryOptions)
     limit: int = 10
@@ -199,6 +258,8 @@ class BrokerRequest:
                 cols.update(expand(a.column))
         if self.group_by:
             for c in self.group_by.columns:
+                if self.join is not None and self.join.qualifies(c):
+                    continue      # dim-side key: lives on the dim table
                 cols.update(expand(c))
         if self.selection:
             for c in self.selection.columns:
@@ -207,6 +268,13 @@ class BrokerRequest:
             cols.update(s.column for s in self.selection.order_by)
         if self.vector:
             cols.add(self.vector.column)
+        if self.join is not None:
+            cols.add(self.join.fact_key)
+        for w in self.windows:
+            if w.column is not None:
+                cols.add(w.column)
+            cols.update(w.partition_by)
+            cols.update(s.column for s in w.order_by)
         return sorted(cols)
 
 
@@ -244,3 +312,15 @@ class InstanceRequest:
     # server sheds hedges FIRST (the primary is still in flight
     # somewhere — dropping the duplicate loses nothing)
     hedge: bool = False
+    # -- multi-stage exchange plane (query/stages/) -------------------------
+    # stage-1 producer: {"id": exchange id, "keyColumn": join/partition
+    # key} — the server executes the query normally, PUBLISHES the
+    # serialized result into its ExchangeManager under the id, and
+    # replies with a small ack (rows, partition tags) instead of the
+    # payload. Optional serde key: older peers ignore it.
+    publish_exchange: Optional[dict] = None
+    # stage-2 consumer: descriptors of stage-1 blocks to fetch over the
+    # data plane before executing — [{"server", "xkey", "host", "port",
+    # "id", "rows", "partitions"?, "partitionFunction"?,
+    # "numPartitions"?}]. Optional serde key.
+    exchange_sources: Optional[List[dict]] = None

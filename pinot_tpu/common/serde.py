@@ -17,8 +17,9 @@ from typing import Any, List, Optional
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree,
                                       GroupBy, HavingNode, InstanceRequest,
-                                      QueryOptions, Selection, SelectionSort,
-                                      VectorSimilarity)
+                                      JoinSpec, QueryOptions, Selection,
+                                      SelectionSort, VectorSimilarity,
+                                      WindowSpec)
 from pinot_tpu.common.sketches import HyperLogLog, TDigest
 
 # ---------------------------------------------------------------------------
@@ -96,6 +97,17 @@ def request_to_json(r: BrokerRequest) -> dict:
             "col": r.vector.column,
             "q": [float(x) for x in r.vector.query],
             "k": r.vector.k, "metric": r.vector.metric},
+        # optional multi-stage clauses (same version-skew contract)
+        "join": None if r.join is None else {
+            "dimTable": r.join.dim_table,
+            "factKey": r.join.fact_key, "dimKey": r.join.dim_key,
+            "dimFilter": filter_to_json(r.join.dim_filter),
+            "dimColumns": list(r.join.dim_columns)},
+        "windows": [{
+            "fn": w.function, "col": w.column,
+            "partitionBy": list(w.partition_by),
+            "orderBy": [{"col": s.column, "asc": s.ascending}
+                        for s in w.order_by]} for w in r.windows],
         "having": _having_to_json(r.having),
         "options": {"trace": r.query_options.trace,
                     "timeoutMs": r.query_options.timeout_ms,
@@ -109,6 +121,7 @@ def request_from_json(d: dict) -> BrokerRequest:
     sel = d.get("selection")
     gb = d.get("groupBy")
     vec = d.get("vector")
+    jn = d.get("join")
     opts = d.get("options") or {}
     return BrokerRequest(
         table_name=d["table"],
@@ -124,6 +137,17 @@ def request_from_json(d: dict) -> BrokerRequest:
         vector=None if vec is None else VectorSimilarity(
             column=vec["col"], query=list(vec["q"]),
             k=vec.get("k", 10), metric=vec.get("metric", "COSINE")),
+        join=None if jn is None else JoinSpec(
+            dim_table=jn["dimTable"], fact_key=jn["factKey"],
+            dim_key=jn["dimKey"],
+            dim_filter=filter_from_json(jn.get("dimFilter")),
+            dim_columns=list(jn.get("dimColumns") or [])),
+        windows=[WindowSpec(
+            function=w["fn"], column=w.get("col"),
+            partition_by=list(w.get("partitionBy") or []),
+            order_by=[SelectionSort(s["col"], s["asc"])
+                      for s in w.get("orderBy") or []])
+            for w in d.get("windows") or []],
         having=_having_from_json(d.get("having")),
         query_options=QueryOptions(
             trace=opts.get("trace", False),
@@ -156,6 +180,13 @@ def instance_request_to_bytes(r: InstanceRequest) -> bytes:
         d["workload"] = r.workload
     if r.hedge:
         d["hedge"] = True
+    if r.publish_exchange is not None:
+        # multi-stage exchange plane (optional keys, version-skew safe):
+        # a stage-1 producer publishes its result under the exchange id;
+        # a stage-2 consumer fetches the listed peer blocks first
+        d["publishExchange"] = r.publish_exchange
+    if r.exchange_sources is not None:
+        d["exchangeSources"] = r.exchange_sources
     return json.dumps(d).encode("utf-8")
 
 
@@ -171,7 +202,9 @@ def instance_request_from_bytes(b: bytes) -> InstanceRequest:
         trace_id=d.get("traceId"),
         parent_span_id=d.get("parentSpanId"),
         workload=d.get("workload"),
-        hedge=d.get("hedge", False))
+        hedge=d.get("hedge", False),
+        publish_exchange=d.get("publishExchange"),
+        exchange_sources=d.get("exchangeSources"))
 
 
 # ---------------------------------------------------------------------------

@@ -63,6 +63,29 @@ def _hash_values(values) -> np.ndarray:
     return out
 
 
+def hll_tables(values, log2m: int = DEFAULT_LOG2M
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-value (register index, rank) int32 tables for `values`.
+
+    The ONE hashing implementation shared by HyperLogLog.add_values and
+    the device HLL kernel's per-dictId precompute (ops/kernels.py agg
+    "hll"): a register array built by scatter-maxing rank over idx for
+    any subset of `values` is bit-identical to
+    HyperLogLog.from_values(that subset) by construction — the
+    host/device/sharded register-identity contract.
+    """
+    if len(values) == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+    h = _hash_values(values)
+    idx = (h >> _U64(64 - log2m)).astype(np.int32)
+    low = h & ((_U64(1) << _U64(64 - log2m)) - _U64(1))
+    # rank = (64 - log2m + 1) - bitlength, all values <= 64: int32-exact
+    max_rank = 65 - log2m
+    bl = _bit_length_u64(low).astype(np.int32)
+    rank = np.int32(max_rank) - bl
+    return idx, rank
+
+
 class HyperLogLog:
     """Dense HLL with the standard bias-corrected estimator."""
 
@@ -83,11 +106,10 @@ class HyperLogLog:
     def add_values(self, values) -> None:
         if len(values) == 0:
             return
-        h = _hash_values(values)
-        idx = (h >> _U64(64 - self.log2m)).astype(np.int64)
-        low = h & ((_U64(1) << _U64(64 - self.log2m)) - _U64(1))
-        rank = (64 - self.log2m - _bit_length_u64(low) + 1).astype(np.uint8)
-        np.maximum.at(self.registers, idx, rank)
+        # delegates to the shared (host+device) hash/rank tables so the
+        # device register kernel stays bit-identical by construction
+        idx, rank = hll_tables(values, self.log2m)
+        np.maximum.at(self.registers, idx, rank.astype(np.uint8))
 
     def merge(self, other: "HyperLogLog") -> "HyperLogLog":
         assert self.log2m == other.log2m, "HLL log2m mismatch"

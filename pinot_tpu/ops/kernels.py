@@ -99,13 +99,29 @@ def _eval_pred(kind: str, source: str, extra, lane, params: List):
         m = ml & mh
     elif kind == "member":
         member = params.pop(0)  # bool [card_pad]
-        m = member[jnp.clip(lane, 0, member.shape[0] - 1)]
+        # int32 index: a narrow (int8) id lane cannot address a member
+        # table whose size exceeds its own dtype range (jax normalizes
+        # the axis size into the INDEX dtype)
+        m = member[jnp.clip(lane.astype(jnp.int32), 0,
+                            member.shape[0] - 1)]
     elif kind == "vdoc":
         # upsert validDocIds mask: the lane IS the per-doc liveness bool
         # (runtime operand — one compiled executable serves any bitmap);
         # fused into the filter mask so aggregation/group/selection all
         # see only live rows
         m = lane
+    elif kind == "join_raw":
+        # raw-key inner-join probe: the dim side's key array arrives as
+        # a RUNTIME operand (padded by repeating its max key, so padding
+        # slots are duplicates of a real key and can never create or
+        # destroy a match); the probe structure is BUILT ON DEVICE —
+        # lax.sort is the hash-build, searchsorted the probe — so one
+        # compiled executable serves every dim table of the same
+        # pow2-bucketed size
+        keys = params.pop(0)                       # [Dp] fact-key dtype
+        sk = jax.lax.sort(keys)
+        pos = jnp.clip(jnp.searchsorted(sk, lane), 0, sk.shape[0] - 1)
+        m = sk[pos] == lane
     else:
         raise ValueError(f"unknown predicate kind {kind}")
     if source == "mv":
@@ -556,6 +572,24 @@ def _agg_outputs(agg_specs: Tuple, cols, mask, num_docs):
             # percentile: host walks the value-count CDF; distinctcount:
             # host needs the value set anyway for cross-segment merge
             outs[f"agg{i}"] = hists[hk]
+        elif fname == "hll" and source == "sv":
+            # HLL sketch registers ON DEVICE: the dictId histogram's
+            # present set drives an O(cardinality) scatter-max of the
+            # precomputed per-dictId (register index, rank) tables
+            # (hashes shared with the host HyperLogLog twin through
+            # sketches.hll_tables) into the [m] register array.
+            # Registers merge ASSOCIATIVELY (elementwise max) across
+            # segments, shards and servers — rank 0 is the merge
+            # identity, so masked/padding ids contribute nothing.
+            card_pad, m = extra[1], extra[2]
+            hk = (col, card_pad)
+            if hk not in hists:
+                hists[hk] = _histogram(cols, col, card_pad, mask)
+            idx = cols[f"{col}.hllidx"]
+            rank = cols[f"{col}.hllrank"]
+            present = hists[hk] > 0
+            outs[f"agg{i}.hll"] = jnp.zeros(m, jnp.int32).at[idx].max(
+                jnp.where(present, rank, 0))
         elif source == "mv":
             card_pad, card = extra
             ids = cols[f"{col}.mv"]
@@ -658,6 +692,30 @@ def _group_key(gcols, strides, g_pad, cols, params=None):
             ids = jnp.matmul(oh, rank.astype(jnp.float32)[:, None],
                              preferred_element_type=jnp.float32
                              )[:, 0].astype(jnp.int32)
+        elif gkind == "jcode":
+            # dict-keyed join group code: the per-dictId fact-key →
+            # dim-group-code translation table (runtime operand,
+            # [card_pad] int32, built host-side in O(cardinality) by the
+            # join planner). A GATHER, not the idrank one-hot matmul:
+            # join translate tables span the FACT key's cardinality
+            # (thousands to millions), where an O(rows·card) contraction
+            # loses to the O(rows) gather. Unmatched dictIds carry code
+            # 0 — masked by the fused join-match predicate everywhere.
+            code = params.pop(0)
+            lane = cols[f"{c}.ids"].astype(jnp.int32)
+            ids = code[jnp.clip(lane, 0, code.shape[0] - 1)]
+        elif gkind == "jraw":
+            # raw-keyed join group code: device-built sorted probe over
+            # the dim (key, code) pair — the group-side twin of the
+            # join_raw predicate (XLA CSE shares the sort/searchsorted
+            # between them). Padding repeats (max key, its code), so
+            # probe hits in the padding run resolve to the right code.
+            keys = params.pop(0)                   # [Dp] fact-key dtype
+            codes = params.pop(0)                  # [Dp] int32
+            sk, sc = jax.lax.sort((keys, codes), num_keys=1)
+            lane = cols[f"{c}.raw"]
+            pos = jnp.clip(jnp.searchsorted(sk, lane), 0, sk.shape[0] - 1)
+            ids = sc[pos]
         else:
             ids = cols[f"{c}.ids"].astype(jnp.int32)
         term = ids * np.int32(s)
@@ -1448,6 +1506,67 @@ def _selection_outputs(select_spec, cols, mask, params=None):
 
 
 # ---------------------------------------------------------------------------
+# Window kernel (stage 2 of the multi-stage engine, query/stages/window.py)
+#
+# Operates on ONE exchanged row block (every server's stage-1 scan,
+# concatenated in deterministic source order): lax.sort by (validity,
+# partition code, window-order keys, input index) puts each window
+# partition contiguous with a deterministic total order — the input
+# index tie-break makes the sort equal to the host oracle's stable
+# np.lexsort — then ROW_NUMBER is an iota rebased at partition starts
+# and SUM(...) OVER is jnp.cumsum rebased the same way. All int32: the
+# one accumulation every backend (numpy, XLA CPU, XLA TPU) reproduces
+# bit-identically, with the executor rejecting inputs whose running
+# sums could wrap (the window exactness contract, docs/QUERYENGINE.md).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=128)
+def build_window_kernel(n_pad: int, n_order: int, n_sums: int):
+    """Unjitted window kernel: fn(part, orders, sums, num_rows) → outs.
+
+    part: int32 [n_pad] partition codes; orders: tuple of n_order int32
+    monotone order-key lanes; sums: tuple of n_sums int32 value lanes;
+    num_rows: int32 valid prefix. Outputs (all [n_pad], valid prefix
+    num_rows): "win.perm" input row index in window order, "win.rn"
+    1-based row number within its partition, "win.sum<j>" running sums.
+    """
+
+    def kernel(part, orders, sums, num_rows):
+        iota = jnp.arange(n_pad, dtype=jnp.int32)
+        invalid = (iota >= num_rows).astype(jnp.int32)
+        ops = (invalid, part) + tuple(orders) + (iota,) + tuple(sums)
+        res = jax.lax.sort(ops, num_keys=3 + n_order)
+        sp = res[1]
+        perm = res[2 + n_order]
+        svals = res[3 + n_order:]
+        new = jnp.concatenate([jnp.ones(1, bool), sp[1:] != sp[:-1]])
+        starts = jax.lax.cummax(jnp.where(new, iota, 0), axis=0)
+        # all lanes arrive int32 by the window contract, so differences
+        # and cumsum stay int32 with no narrowing casts (the executor's
+        # host-side bound check guarantees no wrap)
+        outs = {"win.perm": perm,
+                "win.rn": iota - starts + jnp.int32(1)}
+        for j, v in enumerate(svals):
+            cs = jnp.cumsum(v, dtype=jnp.int32)
+            base = cs[starts] - v[starts]
+            outs[f"win.sum{j}"] = cs - base
+        return outs
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=128)
+def get_window_kernel(n_pad: int, n_order: int, n_sums: int):
+    return jax.jit(build_window_kernel(n_pad, n_order, n_sums))
+
+
+def run_window_kernel(part, orders, sums, num_rows):
+    fn = get_window_kernel(int(part.shape[0]), len(orders), len(sums))
+    return fn(part, tuple(orders), tuple(sums), jnp.int32(num_rows))
+
+
+# ---------------------------------------------------------------------------
 # Kernel assembly + jit cache
 # ---------------------------------------------------------------------------
 
@@ -1642,4 +1761,60 @@ def contract_cases():
          {"e0.vec": (f32, (P, 128)), "d0.ids": (i32, (P,)),
           "$validDocIds.vdoc": (bl, (P,))},
          [(i32, ()), (f32, (128,)), (f32, ())])
+    # inner-join probe fused into the filter, dict-keyed fact side: the
+    # host-translated member vector is the join-match predicate, the
+    # jcode gather the dim group code — composed with the upsert vdoc
+    # lane so dead upserted rows never reach a join side
+    case("join_dict_group",
+         ("and", (("pred", "member", "k0", "sv", 64),
+                  ("pred", "vdoc", "$validDocIds", "vdoc", None))),
+         [],
+         ((("k0", "jcode", 0, 8), ("d0", "ids", 0, 8)), (8, 1), 64,
+          (("sum", "m0", "sv", ("psums", 2)),
+           ("count", "*", "sv", None)), 0),
+         None,
+         {"k0.ids": (i32, (P,)), "d0.ids": (i32, (P,)),
+          "m0.parts": (i8, (2, P)), "$validDocIds.vdoc": (bl, (P,))},
+         [(bl, (64,)), (i32, (64,))])
+    # raw-keyed fact side: the dim key/code tables ride as runtime
+    # operands and the probe structure is BUILT ON DEVICE (lax.sort +
+    # searchsorted) — join_raw pred + jraw group code share the build
+    case("join_raw_probe",
+         ("pred", "join_raw", "k0", "raw", 128),
+         [],
+         ((("k0", "jraw", 0, 8),), (1,), 8,
+          (("count", "*", "sv", None),), 0),
+         None,
+         {"k0.raw": (i32, (P,))},
+         [(i32, (128,)), (i32, (128,)), (i32, (128,))])
+    # DISTINCTCOUNTHLL device registers: histogram-present scatter-max
+    # of the per-dictId (register index, rank) tables → [m] int32
+    # registers that merge associatively (max) on every combine path
+    case("agg_hll",
+         ("pred", "eq_id", "d0", "sv", None),
+         [("hll", "v0", "sv", ("hll", 64, 4096)),
+          ("count", "*", "sv", None)],
+         None, None,
+         {"d0.ids": (i32, (P,)), "v0.ids": (i32, (P,)),
+          "v0.hllidx": (i32, (64,)), "v0.hllrank": (i32, (64,))},
+         [(i32, ())])
     return cases
+
+
+def extra_contract_cases():
+    """Non-segment-plan kernel families, traced by the same deep-tier
+    gate (analysis/contracts.py): [(name, builder, static_args,
+    arg_specs)]. builder(*static_args) must return the unjitted kernel
+    (lru-cached — the gate asserts cache identity like
+    build_segment_kernel's); arg_specs is a pytree of (dtype, shape)
+    leaves mirroring the kernel's positional args, with "P" filled per
+    shape bucket in both static_args and shapes."""
+    P = "P"
+    i32 = "int32"
+    return [
+        ("window_rank", build_window_kernel, (P, 2, 0),
+         ((i32, (P,)), ((i32, (P,)), (i32, (P,))), (), (i32, ()))),
+        ("window_rank_sum", build_window_kernel, (P, 1, 2),
+         ((i32, (P,)), ((i32, (P,)),),
+          ((i32, (P,)), (i32, (P,))), (i32, ()))),
+    ]

@@ -82,8 +82,8 @@ def _combine_kind(key: str) -> str:
         #                         spaces; host merges by group key
     if key.endswith(".min"):
         return "min"
-    if key.endswith(".max"):
-        return "max"
+    if key.endswith((".max", ".hll")):
+        return "max"            # HLL registers merge by elementwise max
     return "sum"                # counts, histograms, group tables
 
 
@@ -99,9 +99,12 @@ def get_sharded_kernel(mesh: Mesh, padded: int, filter_spec, agg_specs,
     from pinot_tpu.ops.kernels import build_segment_kernel
     kern = build_segment_kernel(padded, filter_spec, agg_specs, group_spec,
                                 select_spec)
-    col_specs = {k: P() if k.endswith(".vals") else P(SEG_AXIS)
+    # dictionary-scale tables (values, HLL idx/rank) are replicated;
+    # row-scale lanes shard over the seg axis
+    REPL = (".vals", ".hllidx", ".hllrank")
+    col_specs = {k: P() if k.endswith(REPL) else P(SEG_AXIS)
                  for k in lane_keys}
-    col_axes = {k: None if k.endswith(".vals") else 0 for k in lane_keys}
+    col_axes = {k: None if k.endswith(REPL) else 0 for k in lane_keys}
 
     def local(cols, params, num_docs):
         # cols leaves: [S_local, ...] (vals replicated); num_docs [S_local]
@@ -196,6 +199,9 @@ class _UnionColumn:
         self.f64_vals = np.concatenate(
             [np.asarray(union, dtype=np.float64), [0.0]]) \
             if cm0.data_type.is_numeric else None
+        # HLL (idx, rank) tables in the union value domain, built lazily
+        # (only DISTINCTCOUNTHLL queries pay)
+        self.hll_tables = None
 
 
 class _UnionDataSource:
@@ -364,7 +370,8 @@ class StackedSegments:
             if key in self._lanes:
                 return self._lanes[key]
         union = self.union_column(col) \
-            if kind in ("ids", "mv", "vals", "parts", "vlane") else None
+            if kind in ("ids", "mv", "vals", "parts", "vlane",
+                        "hllidx", "hllrank") else None
         if union is not None:
             arrs = [self._union_operand(union, i, kind)
                     for i in range(self.n_real)]
@@ -373,9 +380,9 @@ class StackedSegments:
             arrs = [s.data_source(col).host_operand(kind)
                     for s in self.segments]
             card = self.segments[0].data_source(col).metadata.cardinality
-        if kind == "vals":
-            # dictionary values are identical (or the union table);
-            # replicate instead of sharding
+        if kind in ("vals", "hllidx", "hllrank"):
+            # dictionary-scale tables are identical (or the union
+            # table); replicate instead of sharding
             out = jax.device_put(arrs[0], NamedSharding(self.mesh, P()))
             with self._cache_lock:
                 return self._lanes.setdefault(key, out)
@@ -408,6 +415,11 @@ class StackedSegments:
         remap = union.remaps[i]
         if kind == "vals":
             return union.padded_vals
+        if kind in ("hllidx", "hllrank"):
+            from pinot_tpu.segment.loader import hll_tables_padded
+            if union.hll_tables is None:
+                union.hll_tables = hll_tables_padded(union.values)
+            return union.hll_tables[0 if kind == "hllidx" else 1]
         if kind == "ids":
             local = ds.host_operand("ids")
             return remap[local.astype(np.int64)].astype(

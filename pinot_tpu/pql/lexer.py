@@ -31,7 +31,7 @@ class TokType(enum.Enum):
 KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "TOP",
     "LIMIT", "OFFSET", "AND", "OR", "NOT", "IN", "BETWEEN", "IS", "NULL",
-    "ASC", "DESC", "OPTION",
+    "ASC", "DESC", "OPTION", "JOIN", "ON", "OVER", "PARTITION",
 }
 
 

@@ -17,8 +17,9 @@ from typing import List, Optional, Tuple
 from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree, GroupBy,
-                                      HavingNode, QueryOptions, Selection,
-                                      SelectionSort, VectorSimilarity)
+                                      HavingNode, JoinSpec, QueryOptions,
+                                      Selection, SelectionSort,
+                                      VectorSimilarity, WindowSpec)
 from pinot_tpu.pql.lexer import PqlSyntaxError, TokType, Token, tokenize
 
 # Aggregation function names the engine recognizes (PERCENTILE variants are
@@ -100,6 +101,10 @@ class _Parser:
         self.expect_kw("FROM")
         table = self.expect(TokType.IDENT).value
 
+        join = None
+        if self.accept_kw("JOIN"):
+            join = self.parse_join_clause(table)
+
         filt = None
         if self.accept_kw("WHERE"):
             filt = self.parse_predicate()
@@ -157,6 +162,7 @@ class _Parser:
         aggs = [it for it in select_items if isinstance(it, AggregationInfo)]
         cols = [it for it in select_items if isinstance(it, str)]
         vecs = [it for it in select_items if isinstance(it, VectorSimilarity)]
+        wins = [it for it in select_items if isinstance(it, WindowSpec)]
         if aggs and cols:
             raise PqlSyntaxError(
                 "cannot mix aggregations and plain columns in SELECT "
@@ -164,7 +170,33 @@ class _Parser:
 
         req = BrokerRequest(table_name=table, filter=filt,
                             query_options=options)
+        if wins:
+            if join is not None:
+                raise PqlSyntaxError(
+                    "window functions cannot mix with JOIN")
+            if aggs or vecs or group_by_cols or having is not None:
+                raise PqlSyntaxError(
+                    "window functions cannot mix with aggregations, "
+                    "GROUP BY, HAVING or VECTOR_SIMILARITY")
+            if order_by or top_n is not None:
+                raise PqlSyntaxError(
+                    "outer ORDER BY/TOP do not apply to window queries — "
+                    "rows come back in (PARTITION BY, ORDER BY) window "
+                    "order")
+            if "*" in cols:
+                raise PqlSyntaxError(
+                    "window queries must name their display columns "
+                    "explicitly (SELECT * is not supported)")
+            req.windows = wins
+            req.selection = Selection(columns=cols, order_by=[],
+                                      offset=offset,
+                                      size=size if size is not None else 10)
+            req.limit = size if size is not None else 10
+            return req
         if vecs:
+            if join is not None:
+                raise PqlSyntaxError(
+                    "VECTOR_SIMILARITY cannot mix with JOIN")
             if len(vecs) > 1:
                 raise PqlSyntaxError(
                     "only one VECTOR_SIMILARITY clause per query")
@@ -201,7 +233,26 @@ class _Parser:
                                       order_by=order_by, offset=offset,
                                       size=size if size is not None else 10)
             req.limit = size if size is not None else 10
+        if join is not None:
+            _finalize_join(req, table, *join)
         return req
+
+    def parse_join_clause(self, fact_table: str):
+        """``JOIN dim ON a.x = b.y`` — returns (dim_table, left, right)
+        raw qualified names; resolution against the two table names
+        happens in _finalize_join once the whole query is parsed."""
+        dim = self.expect(TokType.IDENT).value
+        if dim == fact_table:
+            raise PqlSyntaxError("self-joins are not supported")
+        self.expect_kw("ON")
+        left = self.expect(TokType.IDENT).value
+        t = self.next()
+        if t.type != TokType.OP or t.value != "=":
+            raise PqlSyntaxError(
+                f"JOIN ... ON supports only equality conditions, got "
+                f"{t.value!r} at {t.pos}")
+        right = self.expect(TokType.IDENT).value
+        return dim, left, right
 
     def parse_select_list(self):
         items = []
@@ -221,11 +272,51 @@ class _Parser:
                 self.toks[self.i + 1].type == TokType.LPAREN:
             if t.upper == "VECTOR_SIMILARITY":
                 return self.parse_vector_call()
+            if t.upper == "ROW_NUMBER":
+                self.next()
+                self.expect(TokType.LPAREN)
+                self.expect(TokType.RPAREN)
+                return self.parse_over_clause("ROW_NUMBER", None)
             if is_aggregation_function(t.value):
-                return self.parse_agg_call()
+                agg = self.parse_agg_call()
+                if self.peek().type == TokType.KEYWORD and \
+                        self.peek().upper == "OVER":
+                    if agg.function_name != "SUM":
+                        raise PqlSyntaxError(
+                            f"window function {agg.function_name} is not "
+                            "supported (ROW_NUMBER | SUM)")
+                    if agg.column == "*" or \
+                            expr_mod.is_expression(agg.column):
+                        raise PqlSyntaxError(
+                            "SUM(...) OVER takes a plain column argument")
+                    return self.parse_over_clause("SUM", agg.column)
+                return agg
         if t.type == TokType.IDENT:
             return self.next().value
         raise PqlSyntaxError(f"bad select item at {t.pos}: {t.value!r}")
+
+    def parse_over_clause(self, function: str,
+                          column: Optional[str]) -> WindowSpec:
+        """``OVER ( [PARTITION BY cols] ORDER BY cols )`` — ORDER BY is
+        mandatory: the running-aggregate frame is defined by the window
+        order, so an orderless window has no deterministic meaning."""
+        self.expect_kw("OVER")
+        self.expect(TokType.LPAREN)
+        partition_by: List[str] = []
+        if self.accept_kw("PARTITION", "BY"):
+            partition_by = [self.expect(TokType.IDENT).value]
+            while self.peek().type == TokType.COMMA:
+                self.next()
+                partition_by.append(self.expect(TokType.IDENT).value)
+        if not self.accept_kw("ORDER", "BY"):
+            raise PqlSyntaxError(
+                f"window specification at {self.peek().pos} needs ORDER "
+                "BY (running-aggregate frames are defined by the window "
+                "order)")
+        order_by = self.parse_order_list()
+        self.expect(TokType.RPAREN)
+        return WindowSpec(function=function, column=column,
+                          partition_by=partition_by, order_by=order_by)
 
     def parse_vector_call(self) -> VectorSimilarity:
         """VECTOR_SIMILARITY(col, [f, f, ...], k[, 'COSINE'|'DOT'|'MIPS'])."""
@@ -468,6 +559,128 @@ class _Parser:
             self.expect(TokType.RPAREN)
             return HavingNode(FilterOperator.IN, agg=agg, values=vals)
         raise PqlSyntaxError(f"bad HAVING predicate at {t.pos}")
+
+
+def _qual_split(name: str, fact: str, dim: str, what: str):
+    """``table.column`` → (side, column) against the two joined tables."""
+    if expr_mod.is_expression(name):
+        raise PqlSyntaxError(
+            f"transform expressions are not supported in JOIN queries "
+            f"({what} {name!r})")
+    if "." not in name:
+        raise PqlSyntaxError(
+            f"{what} {name!r} must be qualified as <table>.<column> in a "
+            f"JOIN query (FROM {fact} JOIN {dim})")
+    t, c = name.split(".", 1)
+    if t == fact:
+        return "fact", c
+    if t == dim:
+        return "dim", c
+    raise PqlSyntaxError(
+        f"{what} {name!r} references unknown table {t!r} "
+        f"(FROM {fact} JOIN {dim})")
+
+
+def _filter_side(node: FilterQueryTree, fact: str, dim: str) -> str:
+    if node.is_leaf():
+        return _qual_split(node.column, fact, dim, "WHERE column")[0]
+    sides = {_filter_side(c, fact, dim) for c in node.children}
+    if len(sides) != 1:
+        raise PqlSyntaxError(
+            "a nested OR predicate cannot span both join sides — only "
+            "top-level AND may mix fact-side and dim-side conditions")
+    return sides.pop()
+
+
+def _strip_qualifiers(node: FilterQueryTree, fact: str, dim: str) -> None:
+    if node.is_leaf():
+        node.column = _qual_split(node.column, fact, dim,
+                                  "WHERE column")[1]
+        return
+    for c in node.children:
+        _strip_qualifiers(c, fact, dim)
+
+
+def _finalize_join(req: BrokerRequest, fact: str, dim: str,
+                   left: str, right: str) -> None:
+    """Resolve qualified names of a JOIN query into the compiled form:
+    fact columns unqualified, dim columns kept ``<dim>.<col>``-qualified
+    (group keys) or collected into the JoinSpec; the WHERE tree splits
+    into fact-side conjuncts (stay on the request) and dim-side
+    conjuncts (pushed down into the stage-1 dim scan)."""
+    if req.is_selection and not req.is_aggregation:
+        raise PqlSyntaxError(
+            "JOIN queries must aggregate (SELECT agg(...) "
+            "[GROUP BY ...]) — row selection over joins is not supported")
+    l_side, l_col = _qual_split(left, fact, dim, "join key")
+    r_side, r_col = _qual_split(right, fact, dim, "join key")
+    if {l_side, r_side} != {"fact", "dim"}:
+        raise PqlSyntaxError(
+            "JOIN ... ON must relate one fact-side and one dim-side "
+            f"column (got {left} = {right})")
+    fact_key = l_col if l_side == "fact" else r_col
+    dim_key = r_col if l_side == "fact" else l_col
+
+    join = JoinSpec(dim_table=dim, fact_key=fact_key, dim_key=dim_key)
+
+    # WHERE: split top-level AND conjuncts by side
+    if req.filter is not None:
+        conjuncts = req.filter.children \
+            if req.filter.operator == FilterOperator.AND \
+            else [req.filter]
+        fact_nodes, dim_nodes = [], []
+        for c in conjuncts:
+            (fact_nodes if _filter_side(c, fact, dim) == "fact"
+             else dim_nodes).append(c)
+        for c in fact_nodes + dim_nodes:
+            _strip_qualifiers(c, fact, dim)
+        req.filter = None if not fact_nodes else (
+            fact_nodes[0] if len(fact_nodes) == 1 else
+            FilterQueryTree(FilterOperator.AND, children=fact_nodes))
+        join.dim_filter = None if not dim_nodes else (
+            dim_nodes[0] if len(dim_nodes) == 1 else
+            FilterQueryTree(FilterOperator.AND, children=dim_nodes))
+
+    # aggregations: fact metrics only (COUNT(*) excepted)
+    for a in req.aggregations:
+        if a.column == "*":
+            continue
+        side, c = _qual_split(a.column, fact, dim, "aggregation argument")
+        if side != "fact":
+            raise PqlSyntaxError(
+                f"aggregation over dim-table column {a.column!r} is not "
+                "supported — aggregate fact metrics; dim columns may "
+                "filter (WHERE) and group (GROUP BY)")
+        a.column = c
+    if req.having is not None:
+        _rewrite_having_join(req.having, fact, dim)
+
+    # GROUP BY: fact keys unqualified, dim keys stay qualified
+    if req.group_by is not None:
+        out = []
+        for g in req.group_by.columns:
+            side, c = _qual_split(g, fact, dim, "group-by column")
+            if side == "fact":
+                out.append(c)
+            else:
+                out.append(f"{dim}.{c}")
+                if c not in join.dim_columns:
+                    join.dim_columns.append(c)
+        req.group_by.columns = out
+    req.join = join
+
+
+def _rewrite_having_join(node: HavingNode, fact: str, dim: str) -> None:
+    for c in node.children:
+        _rewrite_having_join(c, fact, dim)
+    if node.agg is not None and node.agg.column != "*":
+        side, c = _qual_split(node.agg.column, fact, dim,
+                              "HAVING aggregation argument")
+        if side != "fact":
+            raise PqlSyntaxError(
+                f"HAVING over dim-table column {node.agg.column!r} is "
+                "not supported")
+        node.agg.column = c
 
 
 def _comparison_to_tree(col: str, op: str, val: str) -> FilterQueryTree:

@@ -53,6 +53,10 @@ def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
             cols[f"{col}.vlane"] = ds.device_value_lane()
         elif kind == "vec":
             cols[f"{col}.vec"] = ds.device_vec_values()
+        elif kind == "hllidx":
+            cols[f"{col}.hllidx"] = ds.device_hll_idx()
+        elif kind == "hllrank":
+            cols[f"{col}.hllrank"] = ds.device_hll_rank()
     return cols
 
 
@@ -132,6 +136,15 @@ def _finish_aggregation(plan, outs, blk) -> None:
         strategy = extra[0] if isinstance(extra, tuple) else None
         if fname in ("count", "countmv"):
             inters.append(int(outs[f"agg{i}"]))
+        elif fname == "hll":
+            # device-built sketch registers ([m] int32, already maxed
+            # across shards on the sharded path) → the HyperLogLog
+            # intermediate every combine/reduce layer merges by
+            # register max
+            from pinot_tpu.common.sketches import (DEFAULT_LOG2M,
+                                                   HyperLogLog)
+            regs = np.asarray(outs[f"agg{i}.hll"]).astype(np.uint8)
+            inters.append(HyperLogLog(DEFAULT_LOG2M, regs))
         elif source == "sv" and fname in ("sum", "avg") and \
                 strategy in ("parts", "vlane"):
             cnt = int(outs[f"agg{i}.count"])
@@ -245,6 +258,10 @@ def _decode_group_values(plan, nz: np.ndarray) -> List[np.ndarray]:
             # densifying remap: `off` carries the present-id array; only
             # nonzero-count groups reach here, so every rank is in range
             ids = np.asarray(off)[ids]
+        elif gkind in ("jcode", "jraw"):
+            # join group codes ARE the dim value-table indices already;
+            # the value table (dim uniques) decodes them below
+            pass
         if tv is not None:
             value_cols.append(tv[ids])
         elif gkind == "rawoff":

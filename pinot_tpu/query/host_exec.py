@@ -62,11 +62,20 @@ def execute_host(segment: ImmutableSegment, request: BrokerRequest
         # superseded rows are masked BEFORE any aggregation/selection —
         # the host half of the host-vs-device upsert parity contract
         mask = mask & vm
+    dimrow = None
+    jctx = getattr(request, "_join_ctx", None)
+    if jctx is not None:
+        # inner-join probe (the oracle twin of the fused device probe):
+        # rows without a dim match mask out BEFORE aggregation, exactly
+        # like the kernel's join predicate — and after the vdoc mask,
+        # so dead upserted rows never join here either
+        hit, dimrow = _join_probe(segment, jctx)
+        mask = mask & hit
     blk = IntermediateResultsBlock()
     matched = int(mask.sum())
 
     if request.is_group_by:
-        _group_by(segment, request, mask, blk)
+        _group_by(segment, request, mask, blk, jctx=jctx, dimrow=dimrow)
     elif request.is_aggregation:
         blk.agg_intermediates = [
             _aggregate(segment, f, mask) for f in make_functions(
@@ -339,6 +348,28 @@ def _aggregate(segment: ImmutableSegment, f: AggregationFunction,
 
 
 # ---------------------------------------------------------------------------
+# Join probe (host twin of the fused device join predicate)
+# ---------------------------------------------------------------------------
+
+
+def _join_probe(segment: ImmutableSegment, jctx):
+    """(hit mask [n], dim row index [n]) for the fact key column —
+    value-domain searchsorted against the JoinContext's dim keys, so
+    mutable (arrival-order-dictionary) segments probe exactly like
+    committed ones."""
+    from pinot_tpu.query.plan import _join_key_source
+    n = segment.num_docs
+    if jctx.empty:
+        return np.zeros(n, dtype=bool), np.zeros(n, dtype=np.int64)
+    source, ds = _join_key_source(jctx, segment)
+    if source == "sv":
+        vals = np.asarray(ds.dictionary.values)[ds.dict_ids]
+    else:
+        vals = ds.raw_values
+    return jctx.probe_values(vals[:n])
+
+
+# ---------------------------------------------------------------------------
 # Group-by
 # ---------------------------------------------------------------------------
 
@@ -408,8 +439,10 @@ def _group_value_rows(segment: ImmutableSegment, c: str,
 
 
 def _group_by(segment: ImmutableSegment, request: BrokerRequest,
-              mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
+              mask: np.ndarray, blk: IntermediateResultsBlock,
+              jctx=None, dimrow=None) -> None:
     gcols = request.group_by.columns
+    join = request.join if jctx is not None else None
     # MV keys expand the row space: one row per (doc, value) — and per
     # value combination when several keys are MV (reference cross-product
     # semantics, DefaultGroupByExecutor.aggregateGroupByMV). Scalar keys
@@ -417,6 +450,8 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
     row2doc = np.nonzero(mask)[0]
     mv_lanes: Dict[int, np.ndarray] = {}
     for idx, c in enumerate(gcols):
+        if join is not None and join.qualifies(c):
+            continue            # dim-side keys are scalar by contract
         src = _mv_group_source(segment, c)
         if src is None:
             continue
@@ -432,6 +467,10 @@ def _group_by(segment: ImmutableSegment, request: BrokerRequest,
     uniq_vals: List[np.ndarray] = []
     for idx, c in enumerate(gcols):
         lane = mv_lanes.get(idx)
+        if lane is None and join is not None and join.qualifies(c):
+            # dim-side group key: decode through the matched dim row
+            # (mask already guarantees every surviving row has one)
+            lane = jctx.dim_values(join.unqualify(c))[dimrow[row2doc]]
         if lane is None:
             lane = _group_value_rows(segment, c, row2doc)
         u, inv = np.unique(lane, return_inverse=True)

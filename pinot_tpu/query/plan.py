@@ -347,6 +347,65 @@ def _resolve_raw_leaf(node: FilterQueryTree, ds: DataSource, params: List
 
 
 # ---------------------------------------------------------------------------
+# Join resolution (stage 2 of the multi-stage engine)
+#
+# The dim side arrives as a JoinContext (query/stages/join.py) — the
+# exchanged, already-dim-filtered key/column arrays. The fact-side probe
+# compiles to existing kernel primitives wherever possible:
+# - dict-encoded fact key: the per-dictId translation (searchsorted of
+#   the dictionary's values against the dim keys, O(cardinality) on
+#   host) turns the join MATCH into a plain member-vector predicate and
+#   each dim group key into a "jcode" gather table;
+# - raw fact key: the dim (key, code) arrays ride as runtime operands
+#   and the device builds the sorted probe itself ("join_raw"/"jraw" —
+#   lax.sort is the build, searchsorted the probe).
+# Either way the match predicate ANDs into the fused filter ahead of
+# the upsert vdoc lane, so a dead upserted row can never join.
+# ---------------------------------------------------------------------------
+
+
+def _join_key_source(jctx, segment: ImmutableSegment):
+    """→ ("sv"|"raw", DataSource) for the fact key column, with the
+    integer-key contract enforced (typed StageCompileError)."""
+    from pinot_tpu.query.stages.errors import StageCompileError
+    if not segment.has_column(jctx.fact_key):
+        raise StageCompileError(
+            f"join key column '{jctx.fact_key}' does not exist on the "
+            "fact table")
+    ds = segment.data_source(jctx.fact_key)
+    cm = ds.metadata
+    if not cm.single_value or cm.data_type.np_dtype.kind not in "iu":
+        raise StageCompileError(
+            f"join keys must be single-value INTEGER columns; fact key "
+            f"'{jctx.fact_key}' is {cm.data_type.name}"
+            f"{'' if cm.single_value else ' (multi-value)'}")
+    return ("sv" if cm.has_dictionary else "raw"), ds
+
+
+def _resolve_join_pred(jctx, segment: ImmutableSegment):
+    """(filter spec, params) for the join-match predicate."""
+    if jctx.empty:
+        return EMPTY, []
+    source, ds = _join_key_source(jctx, segment)
+    cm = ds.metadata
+    if source == "sv":
+        member = jctx.member_for(np.asarray(ds.dictionary.values))
+        if not member.any():
+            return EMPTY, []
+        card_pad = kernels.pow2_bucket(cm.cardinality + 1)
+        memb = np.zeros(card_pad, dtype=bool)
+        memb[: cm.cardinality] = member
+        return ("pred", "member", jctx.fact_key, "sv", card_pad), [memb]
+    keys = jctx.padded_keys(cm.data_type.np_dtype)
+    if keys is None:
+        # no dim key is representable in the fact dtype — nothing can
+        # match (the raw twin of the all-False member vector above)
+        return EMPTY, []
+    return ("pred", "join_raw", jctx.fact_key, "raw",
+            len(keys)), [keys]
+
+
+# ---------------------------------------------------------------------------
 # Plan construction
 # ---------------------------------------------------------------------------
 
@@ -442,14 +501,21 @@ class InstancePlanMaker:
         if request.is_aggregation:
             plan.functions = make_functions(request.aggregations)
 
+        # stage-2 join context (query/stages/join.py attaches it to the
+        # server-local request copy): the probe fuses into the filter,
+        # so every whole-segment shortcut below is off — they would
+        # count unjoined rows
+        jctx = getattr(request, "_join_ctx", None)
+
         # upsert masking disables every whole-segment shortcut below:
         # metadata counts, star-tree cubes and inverted-index counts all
         # include superseded rows
         masked = upsert_mask_active(segment)
+        no_fast = masked or jctx is not None
 
         # fast path: no filter, metadata/dictionary-answerable aggregations
         if request.is_aggregation and not request.is_group_by and \
-                request.filter is None and not masked and \
+                request.filter is None and not no_fast and \
                 self._try_metadata_fast_path(plan, segment, request):
             return plan
 
@@ -458,7 +524,7 @@ class InstancePlanMaker:
         # This hook serves the sharded path (which plans directly); the
         # sequential path already checked in ServerQueryExecutor.
         if request.is_aggregation and not request.is_selection and \
-                not masked and \
+                not no_fast and \
                 getattr(segment, "star_trees", None):
             from pinot_tpu.startree.executor import try_star_tree_execute
             blk = try_star_tree_execute(segment, request)
@@ -468,13 +534,24 @@ class InstancePlanMaker:
 
         filter_spec, params = resolve_filter(request.filter, segment)
 
+        if jctx is not None and filter_spec != EMPTY:
+            # the join-match predicate ANDs in FIRST (its params precede
+            # the original tree's in depth-first order)
+            jspec, jparams = _resolve_join_pred(jctx, segment)
+            if jspec == EMPTY:
+                filter_spec = EMPTY
+            elif jspec != MATCH_ALL:
+                params = jparams + params
+                filter_spec = jspec if filter_spec == MATCH_ALL else \
+                    ("and", (jspec, filter_spec))
+
         if filter_spec == EMPTY:
             plan.fast_path_result = _empty_block(plan, segment)
             return plan
 
         # fast path: COUNT(*) on a pure match-all filter
         if filter_spec == MATCH_ALL and request.is_aggregation and \
-                not masked and not request.is_group_by and \
+                not no_fast and not request.is_group_by and \
                 all(f.info.base == "COUNT" and not f.info.is_mv
                     for f in plan.functions):
             blk = IntermediateResultsBlock(
@@ -484,7 +561,7 @@ class InstancePlanMaker:
             return plan
 
         # fast path: COUNT(*) + single EQ/IN leaf answered by inverted index
-        if request.is_aggregation and not masked and \
+        if request.is_aggregation and not no_fast and \
                 not request.is_group_by and \
                 all(f.info.base == "COUNT" and not f.info.is_mv
                     for f in plan.functions):
@@ -574,7 +651,35 @@ class InstancePlanMaker:
         gcols = []
         value_tables = []
         cards = []
+        jctx = getattr(request, "_join_ctx", None)
         for c in request.group_by.columns:
+            if jctx is not None and request.join is not None and \
+                    request.join.qualifies(c):
+                # dim-side group key: the fact key lane group-codes
+                # through the join translation (jcode gather table for
+                # dict keys; device-probed jraw for raw keys); decode
+                # goes through the dim value table like an expression key
+                dcol = request.join.unqualify(c)
+                codes, uniq = jctx.group_coding(dcol)
+                source, ds = _join_key_source(jctx, segment)
+                n = len(uniq)
+                if source == "sv":
+                    cm = ds.metadata
+                    card_pad = kernels.pow2_bucket(cm.cardinality + 1)
+                    plan.params.append(jctx.code_table_for(
+                        np.asarray(ds.dictionary.values), dcol, card_pad))
+                    gcols.append((jctx.fact_key, "jcode", 0, n))
+                    needed[(jctx.fact_key, "ids")] = None
+                else:
+                    keys_p, codes_p = jctx.padded_key_codes(
+                        dcol, ds.metadata.data_type.np_dtype)
+                    plan.params.append(keys_p)
+                    plan.params.append(codes_p)
+                    gcols.append((jctx.fact_key, "jraw", 0, n))
+                    needed[(jctx.fact_key, "raw")] = None
+                value_tables.append(uniq)
+                cards.append(n)
+                continue
             if expr_mod.is_expression(c):
                 # expression group key: group in the SOURCE column's id
                 # domain on device; decode through the transformed value
@@ -1217,6 +1322,21 @@ def _agg_device_spec(f: AggregationFunction, segment: ImmutableSegment,
                 return (fname, col, "sv", ("vals", card_pad))
             needed[(col, "ids")] = None
             return (fname, col, "sv", ("ids", card_pad))
+        if base in ("DISTINCTCOUNTHLL", "DISTINCTCOUNTRAWHLL") and \
+                not f.info.is_mv:
+            # device HLL sketch registers: the dictId histogram's
+            # present set scatter-maxes the per-dictId (register index,
+            # rank) tables — register-identical to the host
+            # HyperLogLog.from_values by construction (shared hashing,
+            # sketches.hll_tables), merged by elementwise max across
+            # segments/shards/servers. FASTHLL keeps the histogram path
+            # (its derived-column rewrite unions serialized sketches).
+            from pinot_tpu.common.sketches import DEFAULT_LOG2M
+            needed[(col, "ids")] = None
+            needed[(col, "hllidx")] = None
+            needed[(col, "hllrank")] = None
+            return ("hll", col, "sv", ("hll", card_pad,
+                                       1 << DEFAULT_LOG2M))
         if fname in ("sum", "avg"):
             if is_int_dict:
                 needed[(col, "parts")] = None

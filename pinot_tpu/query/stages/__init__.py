@@ -1,0 +1,14 @@
+"""Multi-stage query engine: distributed joins, window functions, and
+the exchange plane that ships columnar blocks server↔server.
+
+Layout (submodules import explicitly — this package init stays empty so
+`query/plan.py` can import `stages.errors` without cycles):
+
+- errors.py    typed stage compile/execution errors (→ 4xx at the broker)
+- exchange.py  ExchangeManager + fetch client over the TCP data plane
+- join.py      JoinContext: dim-side blocks → probe/gather tables
+- window.py    stage-2 window executor (device kernel + host oracle)
+- broker.py    broker-side two-stage orchestration
+
+See docs/QUERYENGINE.md for the stage model and exactness contracts.
+"""

@@ -1,0 +1,261 @@
+"""Broker-side two-stage orchestration.
+
+A join query runs: stage 1 — the dim-side scan (dim WHERE conjuncts,
+join key + referenced dim columns) dispatched to the dim table's
+servers with a ``publish_exchange`` tag, each returning a small ack
+(exchange id/key, row count, partition tags); stage 2 — the normal fact
+scatter (hedges/failover intact, via QueryRouter) with the ack-derived
+source descriptors stamped into every InstanceRequest so fact servers
+fetch the dim blocks server↔server over the data plane.
+
+A window query runs: stage 1 — the scan (display + window input
+columns) published by every routed fact server; stage 2 — ONE
+coordinator server (deterministically the first of the routed set)
+fetches all blocks and runs the window kernel.
+
+Stage-compile failures (unknown dim table, dim side over the broadcast
+cap, typed server-side stage errors) surface as errorCode-tagged
+entries the request handler maps to 4xx responses — never crashes, and
+never the generic 425 fault class clients would retry.
+"""
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import time
+from typing import List, Optional
+
+from pinot_tpu.common.datatable import DataTable, STAGE_ERROR_KEY
+from pinot_tpu.common.request import (BrokerRequest, InstanceRequest,
+                                      Selection)
+from pinot_tpu.common.serde import instance_request_to_bytes
+from pinot_tpu.common.table_name import raw_table
+from pinot_tpu.query.stages.errors import STAGE_COMPILE_ERROR_CODE
+from pinot_tpu.query.stages.join import DIM_CAP
+from pinot_tpu.query.stages.window import WINDOW_CAP, scan_columns
+
+
+def _stage_error(server: str, message: str, code: int) -> dict:
+    return {"server": server, "message": message, "recovered": False,
+            "errorCode": code}
+
+
+def _busy_error(server: str, dt: DataTable, what: str):
+    """Typed server-busy classification for stage dispatches: an
+    admission shed must keep its 503/Retry-After surface (the
+    busyCause/retryAfterMs markers _finish keys on), never degrade to
+    a retriable 425 fault or reduce as an empty success."""
+    from pinot_tpu.common.datatable import (RETRY_AFTER_MS_KEY,
+                                            SERVER_BUSY_KEY)
+    cause = dt.metadata.get(SERVER_BUSY_KEY)
+    if cause is None:
+        return None
+    err = _stage_error(
+        server, f"ServerBusyError: {what} shed ({cause})", 0)
+    err.pop("errorCode")        # _finish derives 503 from busyCause
+    err["busyCause"] = cause
+    try:
+        err["retryAfterMs"] = float(
+            dt.metadata.get(RETRY_AFTER_MS_KEY, "0"))
+    except (TypeError, ValueError):
+        err["retryAfterMs"] = 0.0
+    return err
+
+
+def dim_scan_request(request: BrokerRequest) -> BrokerRequest:
+    """The stage-1 dim scan: dim-side WHERE + (key, referenced columns)
+    selection, capped at the broadcast window (the publish ack fails
+    loudly when the filtered dim side exceeds it)."""
+    join = request.join
+    cols = [join.dim_key] + [c for c in join.dim_columns
+                             if c != join.dim_key]
+    return BrokerRequest(
+        table_name=join.dim_table, filter=join.dim_filter,
+        selection=Selection(columns=cols, order_by=[], offset=0,
+                            size=DIM_CAP),
+        limit=DIM_CAP)
+
+
+def window_scan_request(sub: BrokerRequest,
+                        request: BrokerRequest) -> BrokerRequest:
+    """The stage-1 window scan for one physical sub-request: same table
+    and (time-boundary-attached) filter, selecting display + window
+    input columns, no windows."""
+    scan = copy.copy(sub)
+    scan.windows = []
+    scan.selection = Selection(columns=scan_columns(request), order_by=[],
+                               offset=0, size=WINDOW_CAP)
+    scan.limit = WINDOW_CAP
+    return scan
+
+
+async def _publish_unit(handler, sub: BrokerRequest, server: str,
+                        segments, xid: str, key_column: str,
+                        request_id: int, deadline: float,
+                        workload: Optional[str]):
+    """One stage-1 publish dispatch → (source descriptor | None, error
+    dict | None)."""
+    transport = handler.router.transport
+    budget = deadline - time.monotonic()
+    if budget <= 0:
+        return None, _stage_error(
+            server, "DeadlineExceededError: no budget left for the "
+            "stage-1 scan", 408)
+    payload = instance_request_to_bytes(InstanceRequest(
+        request_id=request_id, query=sub, search_segments=segments,
+        broker_id=handler.router.broker_id,
+        deadline_budget_ms=budget * 1e3, workload=workload,
+        publish_exchange={"id": xid, "keyColumn": key_column}))
+    try:
+        raw = await asyncio.wait_for(
+            transport.query(server, payload, budget), budget)
+        from pinot_tpu.transport.shm import datatable_from_reply
+        dt = datatable_from_reply(raw)
+    except Exception as e:  # noqa: BLE001 — transport-class failure
+        return None, _stage_error(
+            server, f"ExchangeStageError: stage-1 publish to {server} "
+            f"failed: {type(e).__name__}: {e}", 0)
+    busy = _busy_error(server, dt, "stage-1 scan")
+    if busy is not None:
+        return None, busy
+    kind = dt.metadata.get(STAGE_ERROR_KEY)
+    if kind is not None:
+        msg = dt.exceptions[0] if dt.exceptions else kind
+        return None, _stage_error(server, str(msg),
+                                  STAGE_COMPILE_ERROR_CODE)
+    if dt.exceptions:
+        return None, _stage_error(
+            server, f"ExchangeStageError: stage-1 scan on {server} "
+            f"failed: {dt.exceptions[0]}", 0)
+    endpoints = getattr(transport, "endpoints", None) or {}
+    host, port = endpoints.get(server, (None, None))
+    source = {"server": server, "id": xid,
+              "xkey": dt.metadata.get("exchangeKey"),
+              "host": host, "port": port,
+              "rows": int(dt.metadata.get("exchangeRows", "0"))}
+    parts = dt.metadata.get("exchangePartitions")
+    if parts is not None:
+        try:
+            source["partitions"] = json.loads(parts)
+            source["partitionFunction"] = dt.metadata.get(
+                "partitionFunction")
+            source["numPartitions"] = int(dt.metadata.get(
+                "numPartitions", "0"))
+        except (ValueError, TypeError):
+            pass
+    return source, None
+
+
+async def _publish_stage(handler, scan_routes, key_column: str,
+                         request_id: int, deadline: float,
+                         workload: Optional[str]):
+    """Dispatch every (sub, server, segments) stage-1 unit → (sources,
+    errors, queried). Sources is None when any unit failed (a join/
+    window over a PARTIAL dim/scan side would be silently wrong)."""
+    units = []
+    for sub, routing in scan_routes:
+        for server, segments in sorted(routing.items()):
+            xid = f"x{request_id}.{len(units)}"
+            units.append((sub, server, segments, xid))
+    results = await asyncio.gather(
+        *(_publish_unit(handler, sub, server, segments, xid, key_column,
+                        request_id, deadline, workload)
+          for sub, server, segments, xid in units))
+    sources, errors = [], []
+    for src, err in results:
+        if err is not None:
+            errors.append(err)
+        elif src is not None:
+            sources.append(src)
+    if errors:
+        return None, errors, len(units)
+    return sources, [], len(units)
+
+
+async def scatter_stages(handler, request: BrokerRequest, routes,
+                         timeout_s: float, deadline: float, trace,
+                         workload: Optional[str], request_id: int):
+    """Multi-stage scatter → the same (tables, queried, responded,
+    errors) contract as QueryRouter.submit."""
+    if request.join is not None:
+        return await _scatter_join(handler, request, routes, deadline,
+                                   trace, workload, request_id)
+    return await _scatter_window(handler, request, routes, deadline,
+                                 trace, workload, request_id)
+
+
+async def _scatter_join(handler, request, routes, deadline, trace,
+                        workload, request_id: int):
+    join = request.join
+    dim_proto = dim_scan_request(request)
+    dim_routes, err = handler._resolve_routes(dim_proto,
+                                              raw_table(join.dim_table))
+    if err is not None:
+        # unknown dim table and friends — reuse the resolver's typed
+        # response (190 TableDoesNotExist / RoutingError)
+        exc = err.exceptions[0]
+        return [], 0, 0, [_stage_error("broker", exc["message"],
+                                       exc["errorCode"])]
+    sources, errors, queried1 = await _publish_stage(
+        handler, dim_routes, join.dim_key, request_id, deadline,
+        workload)
+    if sources is None:
+        return [], queried1, 0, errors
+    total_rows = sum(s["rows"] for s in sources)
+    if total_rows > DIM_CAP:
+        return [], queried1, queried1, [_stage_error(
+            "broker", f"JoinCapacityError: dim side has {total_rows} "
+            f"rows after filtering > broadcast cap {DIM_CAP} — narrow "
+            "the dim-side WHERE", STAGE_COMPILE_ERROR_CODE)]
+    budget = max(deadline - time.monotonic(), 0.0)
+    tables, queried2, responded, errors2 = await handler.router.submit(
+        request_id, routes, budget,
+        enable_trace=request.query_options.trace, deadline=deadline,
+        trace=trace, workload=workload, exchange_sources=sources)
+    # same moved-segment tolerance as the single-stage scatter: one
+    # re-dispatch against the current view (retried InstanceRequests
+    # carry the SAME exchange sources — the dim side is already
+    # published and any replica can fetch it)
+    tables, rq, rr, retry_errors = await handler._retry_missing_segments(
+        routes, tables, deadline,
+        enable_trace=request.query_options.trace, trace=trace,
+        workload=workload, exchange_sources=sources)
+    return (tables, queried1 + queried2 + rq,
+            queried1 + responded + rr, errors2 + retry_errors)
+
+
+async def _scatter_window(handler, request, routes, deadline, trace,
+                          workload, request_id: int):
+    scan_routes = [(window_scan_request(sub, request), routing)
+                   for sub, routing in routes]
+    sources, errors, queried1 = await _publish_stage(
+        handler, scan_routes, "", request_id, deadline, workload)
+    if sources is None:
+        return [], queried1, 0, errors
+    servers = sorted({server for _sub, routing in routes
+                      for server in routing})
+    if not servers:
+        return [], queried1, queried1, []
+    coordinator = servers[0]
+    budget = max(deadline - time.monotonic(), 0.01)
+    payload = instance_request_to_bytes(InstanceRequest(
+        request_id=request_id, query=request, search_segments=[],
+        broker_id=handler.router.broker_id,
+        deadline_budget_ms=budget * 1e3, workload=workload,
+        exchange_sources=sources))
+    try:
+        raw = await asyncio.wait_for(
+            handler.router.transport.query(coordinator, payload, budget),
+            budget)
+        from pinot_tpu.transport.shm import datatable_from_reply
+        dt = datatable_from_reply(raw)
+    except Exception as e:  # noqa: BLE001 — transport-class failure
+        return [], queried1 + 1, queried1, [_stage_error(
+            coordinator, f"ExchangeStageError: window stage 2 on "
+            f"{coordinator} failed: {type(e).__name__}: {e}", 0)]
+    busy = _busy_error(coordinator, dt, "window stage 2")
+    if busy is not None:
+        return [], queried1 + 1, queried1, [busy]
+    dt.metadata.setdefault("serverName", coordinator)
+    return [dt], queried1 + 1, queried1 + 1, []

@@ -108,6 +108,21 @@ def segment_host_bytes(seg) -> int:
     return total
 
 
+def hll_tables_padded(values: np.ndarray) -> tuple:
+    """(idx, rank) int32 [card_pad] HLL tables for a dictionary, padded
+    to the kernels' pow2 cardinality bucket with (0, 0) — rank 0 is the
+    register-max identity, so padding ids can never perturb a sketch."""
+    from pinot_tpu.common.sketches import hll_tables
+    from pinot_tpu.ops.kernels import pow2_bucket
+    idx, rank = hll_tables(np.asarray(values))
+    card_pad = pow2_bucket(len(idx) + 1)
+    out_i = np.zeros(card_pad, np.int32)
+    out_r = np.zeros(card_pad, np.int32)
+    out_i[: len(idx)] = idx
+    out_r[: len(rank)] = rank
+    return out_i, out_r
+
+
 def int_part_table(values: np.ndarray, n_parts: int,
                    min_v: int) -> np.ndarray:
     """[n_parts, card + 1] int8 plane table (last column = all-zero pad
@@ -154,6 +169,7 @@ class DataSource:
         # device arrays (lazy)
         self._dev: Dict[str, object] = {}
         self._part_info: Optional[tuple] = None
+        self._hll_tables: Optional[tuple] = None
 
     # -- device access -----------------------------------------------------
     def device_dict_ids(self):
@@ -188,6 +204,18 @@ class DataSource:
         padding is zeros (masked by the kernel's validity iota), dim
         padding is zeros (an exact no-op in the tree-dot sums)."""
         return self._device("vec_values", self.host_operand("vec"))
+
+    def device_hll_idx(self):
+        """Per-dictId HLL register-index table [card_pad] int32 — built
+        once from the dictionary values with the SAME hashing the host
+        HyperLogLog uses (sketches.hll_tables), so the device register
+        kernel is bit-identical to the host sketch by construction."""
+        return self._device("hll_idx", self.host_operand("hllidx"))
+
+    def device_hll_rank(self):
+        """Per-dictId HLL rank table [card_pad] int32 (padding rank 0 =
+        the register-max merge identity)."""
+        return self._device("hll_rank", self.host_operand("hllrank"))
 
     def int_part_info(self) -> tuple:
         """(n_parts, min_value) for the bit-sliced integer sum encoding.
@@ -236,6 +264,10 @@ class DataSource:
             out = np.zeros((p, dp), dtype=np.float32)
             out[: len(mat), : mat.shape[1]] = mat
             return out
+        if kind in ("hllidx", "hllrank"):
+            if self._hll_tables is None:
+                self._hll_tables = hll_tables_padded(self.dictionary.values)
+            return self._hll_tables[0 if kind == "hllidx" else 1]
         raise ValueError(kind)
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:

@@ -59,6 +59,10 @@ class ServerInstance:
             num_workers=num_workers)
         self.result_cache = ServerResultCache(
             max_entries=result_cache_entries)
+        # exchange plane (multi-stage queries): published stage-1 blocks
+        # served to peer servers over XCHG data-plane frames
+        from pinot_tpu.query.stages.exchange import ExchangeManager
+        self.exchange = ExchangeManager()
         # accepted workload tags (scheduler groups + fair-share keys
         # derive from them) — bounded, because the tag is CLIENT-chosen
         self._tenant_tags: set = set()
@@ -300,12 +304,102 @@ class ServerInstance:
         dt.exceptions.append(f"QueryExecutionError: {e}")
         return dt.to_bytes()
 
+    # -- multi-stage plumbing ----------------------------------------------
+    @staticmethod
+    def _stage_request(request: InstanceRequest) -> bool:
+        """Multi-stage requests bypass the result cache both ways: the
+        fingerprint keys on ONE table's segment states, but a join/
+        window answer also depends on the dim/exchanged side (satellite:
+        a join result cached under the fact table would survive
+        dim-table changes)."""
+        return (request.publish_exchange is not None or
+                request.exchange_sources is not None or
+                request.query.join is not None or
+                bool(request.query.windows))
+
+    def _maybe_publish(self, request: InstanceRequest, dt: DataTable,
+                       payload: bytes) -> bytes:
+        """Stage-1 producer epilogue: store the full serialized result
+        in the exchange, answer with a small ack (or a typed stage
+        error when the scan was truncated by the selection cap)."""
+        from pinot_tpu.query.stages.errors import (ExchangeError,
+                                                   stage_error_datatable)
+        info = request.publish_exchange
+        xid = str(info.get("id", ""))
+        if dt.exceptions:
+            return payload          # surface the scan failure verbatim
+        rows = dt.num_rows()
+        matched = int(dt.metadata.get("numDocsScanned", "0"))
+        if matched > rows:
+            return stage_error_datatable(
+                request.request_id, "exchangeCapacity",
+                f"stage-1 scan matched {matched} rows but the exchange "
+                f"window holds {rows} — narrow the stage's filter"
+            ).to_bytes()
+        try:
+            # lifetime tracks the query: the block only matters until
+            # stage 2's deadline passes (+slack for clock skew/retries)
+            ttl = None
+            if request.deadline_budget_ms is not None:
+                ttl = request.deadline_budget_ms / 1e3 + 15.0
+            self.exchange.put(xid, payload, ttl_s=ttl)
+        except ExchangeError as e:
+            return stage_error_datatable(
+                request.request_id, "exchangeCapacity",
+                str(e)).to_bytes()
+        ack = DataTable()
+        ack.metadata["requestId"] = str(request.request_id)
+        ack.metadata["exchangeId"] = xid
+        ack.metadata["exchangeKey"] = self.exchange.xkey
+        ack.metadata["exchangeRows"] = str(rows)
+        ack.metadata["numDocsScanned"] = dt.metadata.get(
+            "numDocsScanned", "0")
+        key_col = info.get("keyColumn")
+        if key_col:
+            tags = self._partition_tags(request, str(key_col))
+            if tags is not None:
+                fn, n, pids = tags
+                import json as _json
+                ack.metadata["partitionFunction"] = fn
+                ack.metadata["numPartitions"] = str(n)
+                ack.metadata["exchangePartitions"] = _json.dumps(
+                    sorted(pids))
+        return ack.to_bytes()
+
+    def _partition_tags(self, request: InstanceRequest, key_col: str):
+        """Partition metadata of the published block's key column across
+        the scanned segments (None unless consistently tagged) — the
+        co-partitioned dispatch contract (stages/join.py)."""
+        from pinot_tpu.query.stages.join import fact_partition_info
+        tdm = self.data_manager.table(request.query.table_name)
+        if tdm is None:
+            return None
+        acquired, missing = tdm.acquire_segments(request.search_segments)
+        try:
+            if missing:
+                return None
+            return fact_partition_info(
+                [s.segment for s in acquired], key_col)
+        finally:
+            for sdm in acquired:
+                tdm.release_segment(sdm)
+
     # -- in-process path (used by tests and the embedded broker) -----------
     def handle_request_bytes(self, payload: bytes) -> bytes:
+        from pinot_tpu.query.stages import exchange as _exchange
+        if _exchange.is_exchange_frame(payload):
+            # peer-server exchange fetch: a memory lookup, answered
+            # inline (never scheduled — stage-2 executors are blocked
+            # on it, and admission would deadlock colocated stages)
+            return self.exchange.handle_frame(payload)
         request, err, deser_ms = self._deserialize(payload)
         if err is not None:
             return err
-        fingerprint, cached, gen = self._cache_lookup(request)
+        staged = self._stage_request(request)
+        if staged:
+            fingerprint, cached, gen = None, None, None
+        else:
+            fingerprint, cached, gen = self._cache_lookup(request)
         if cached is not None:
             return cached          # bypasses admission AND scheduling
         decision, busy, tenant = self._admit(request)
@@ -317,7 +411,11 @@ class ServerInstance:
                                 release_admission=True,
                                 tenant=tenant).result()
             reply = self._serialize(request, dt)
-            self._maybe_cache_store(request, dt, reply, fingerprint, gen)
+            if request.publish_exchange is not None:
+                return self._maybe_publish(request, dt, reply)
+            if not staged:
+                self._maybe_cache_store(request, dt, reply, fingerprint,
+                                        gen)
             return reply
         except SchedulerOutOfCapacityError:
             return self._capacity_reply(request)
@@ -331,16 +429,24 @@ class ServerInstance:
         in-flight request — only scheduler workers compute; serde runs
         on the executor so the event loop keeps draining frames."""
         loop = asyncio.get_running_loop()
+        from pinot_tpu.query.stages import exchange as _exchange
+        if _exchange.is_exchange_frame(payload):
+            # peer-server exchange fetch: a memory lookup, answered
+            # inline off the read loop's dispatch task
+            return self.exchange.handle_frame(payload)
         request, err, deser_ms = self._deserialize(payload)
         if err is not None:
             return err
+        staged = self._stage_request(request)
         # the cache probe touches segment refcounts and hashes the
         # request — off-loop, like the serde it replaces on a hit. But
-        # when the probe is a guaranteed no-op (traced query, or the
-        # cache is empty — e.g. all-consuming realtime tables never
-        # store) the cheap guards run inline: no per-query threadpool
-        # hop just to bounce off _cache_lookup's early returns
-        if request.enable_trace or len(self.result_cache) == 0:
+        # when the probe is a guaranteed no-op (traced query, stage
+        # request, or the cache is empty — e.g. all-consuming realtime
+        # tables never store) the cheap guards run inline: no per-query
+        # threadpool hop just to bounce off _cache_lookup's early returns
+        if staged:
+            fingerprint, cached, gen = None, None, None
+        elif request.enable_trace or len(self.result_cache) == 0:
             fingerprint, cached, gen = self._cache_lookup(request)
         else:
             fingerprint, cached, gen = await loop.run_in_executor(
@@ -362,7 +468,11 @@ class ServerInstance:
             else:
                 reply = await loop.run_in_executor(
                     None, self._serialize, request, dt)
-            self._maybe_cache_store(request, dt, reply, fingerprint, gen)
+            if request.publish_exchange is not None:
+                return self._maybe_publish(request, dt, reply)
+            if not staged:
+                self._maybe_cache_store(request, dt, reply, fingerprint,
+                                        gen)
             return reply
         except asyncio.CancelledError:
             raise
@@ -392,3 +502,4 @@ class ServerInstance:
                 self._loop = None
         self.scheduler.shutdown()
         self.data_manager.shutdown()
+        self.exchange.close()

@@ -77,6 +77,10 @@ class InstanceQueryExecutor:
                          deser_ms)
         trace.record(ServerQueryPhase.SCHEDULER_WAIT, scheduler_wait_ms)
         query = request.query
+        if query.windows and request.exchange_sources is not None:
+            # window stage 2 (coordinator): all data arrives through the
+            # exchange — no local segment acquisition at all
+            return self._execute_window_stage(request, deadline)
         timeout_ms = query.query_options.timeout_ms or self.default_timeout_ms
         if request.deadline_budget_ms is not None:
             # the broker's remaining budget caps the server-side timeout
@@ -107,9 +111,31 @@ class InstanceQueryExecutor:
             # this server query (deserialized per dispatch), and the
             # DataTable columns below must carry the rewritten names
             query = preprocess_request(segments, query)
-            with obs_profiler.active(profile, trace):
-                block = self._execute_segments(query, segments, trace,
-                                               deadline=deadline)
+            if query.join is not None:
+                # join stage 2: fetch the (partition-filtered) dim
+                # blocks and attach the probe context; StageCompileError
+                # → typed reply, never a generic execution fault
+                from pinot_tpu.query.stages.errors import (
+                    StageCompileError, stage_error_datatable)
+                try:
+                    query = self._attach_join_context(request, query,
+                                                      segments, deadline)
+                except StageCompileError as e:
+                    return stage_error_datatable(
+                        request.request_id, "joinCompile", str(e))
+                try:
+                    with obs_profiler.active(profile, trace):
+                        block = self._execute_segments(
+                            query, segments, trace, deadline=deadline)
+                except StageCompileError as e:
+                    # raised from per-segment planning (e.g. the fact
+                    # key column's type fails the integer contract)
+                    return stage_error_datatable(
+                        request.request_id, "joinCompile", str(e))
+            else:
+                with obs_profiler.active(profile, trace):
+                    block = self._execute_segments(query, segments, trace,
+                                                   deadline=deadline)
             if missing:
                 block.exceptions.append(
                     f"{SEGMENT_MISSING_EXC_PREFIX} {sorted(missing)}")
@@ -147,6 +173,48 @@ class InstanceQueryExecutor:
         finally:
             for sdm in acquired:
                 tdm.release_segment(sdm)
+
+    def _attach_join_context(self, request: InstanceRequest, query,
+                             segments: List, deadline: Optional[float]):
+        """Build the JoinContext from the exchanged dim blocks and
+        attach it to a server-local request copy."""
+        import copy
+        from pinot_tpu.query.stages import join as stages_join
+        from pinot_tpu.query.stages.errors import StageCompileError
+        if request.exchange_sources is None:
+            raise StageCompileError(
+                "join query dispatched without exchange sources (stage-1 "
+                "dim scan missing)")
+        fact_parts = stages_join.fact_partition_info(
+            segments, query.join.fact_key)
+        ctx = stages_join.build_context(query.join,
+                                        request.exchange_sources,
+                                        fact_parts, deadline_s=deadline)
+        if segments:
+            # fact-key contract check up front (exists, SV integer) —
+            # an empty dim side must not mask a misspelled/mistyped key
+            from pinot_tpu.query.plan import _join_key_source
+            _join_key_source(ctx, segments[0])
+        query = copy.copy(query)
+        query._join_ctx = ctx
+        return query
+
+    def _execute_window_stage(self, request: InstanceRequest,
+                              deadline: Optional[float]) -> DataTable:
+        from pinot_tpu.query.stages.errors import (StageCompileError,
+                                                   stage_error_datatable)
+        from pinot_tpu.query.stages.window import execute_window_stage
+        try:
+            blk = execute_window_stage(
+                request.query, request.exchange_sources,
+                deadline_s=deadline,
+                use_device=self.executor.use_device)
+        except StageCompileError as e:
+            return stage_error_datatable(request.request_id,
+                                         "windowCompile", str(e))
+        dt = DataTable.from_block(request.query, blk)
+        dt.metadata["requestId"] = str(request.request_id)
+        return dt
 
     def _execute_segments(self, query, segments: List, trace: TraceContext,
                           deadline: Optional[float] = None

@@ -343,6 +343,174 @@ def build_ssb_segment_dirs(base_dir: str, total_rows: int,
     return dirs, ids, supplycost
 
 
+# ---------------------------------------------------------------------------
+# Star-schema JOIN tables: a `part` dim table × a `lineorderj` fact table
+# (the normalized shape the multi-stage join engine serves — the flat SSB
+# table above is the 2019-era denormalized workaround).
+# ---------------------------------------------------------------------------
+
+
+def part_dim_schema():
+    from pinot_tpu.common.schema import Schema, dimension
+    return Schema("part", [
+        dimension("p_partkey", DataType.INT),
+        dimension("p_mfgr", DataType.STRING),
+        dimension("p_category", DataType.STRING),
+        dimension("p_brand1", DataType.STRING),
+    ])
+
+
+def fact_join_schema():
+    from pinot_tpu.common.schema import Schema, dimension, metric
+    return Schema("lineorderj", [
+        dimension("lo_partkey", DataType.INT),
+        dimension("d_year", DataType.INT),
+        metric("lo_quantity", DataType.INT),
+        metric("lo_revenue", DataType.LONG),
+    ])
+
+
+def join_table_configs(num_partitions: int = 0):
+    """(fact config, dim config); `num_partitions` > 0 partitions BOTH
+    tables on their join keys (Modulo) — the co-partitioned dispatch
+    shape."""
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    part_cfg = {"functionName": "Modulo",
+                "numPartitions": num_partitions}
+    fact_idx = IndexingConfig(
+        segment_partition_config={"lo_partkey": dict(part_cfg)}
+        if num_partitions else {})
+    dim_idx = IndexingConfig(
+        segment_partition_config={"p_partkey": dict(part_cfg)}
+        if num_partitions else {})
+    return (TableConfig("lineorderj", indexing_config=fact_idx),
+            TableConfig("part", indexing_config=dim_idx))
+
+
+def make_join_rows(fact_rows: int, dim_rows: int = 800, seed: int = 0,
+                   miss_rate: float = 0.1) -> Tuple[Dict, Dict]:
+    """(dim columns, fact columns) as plain arrays (oracle-friendly).
+
+    Dim keys are a NON-CONTIGUOUS sorted sample (probes must not
+    degenerate to offsets) with SSB-style brand→category→mfgr
+    functional dependencies; `miss_rate` of fact keys reference no dim
+    row (inner-join drops them).
+    """
+    rng = np.random.default_rng(seed + 40_009)
+    keys = np.sort(rng.choice(np.arange(1, dim_rows * 7, dtype=np.int64),
+                              size=dim_rows, replace=False))
+    brand_id = rng.integers(0, 1000, dim_rows)
+    dim = {
+        "p_partkey": keys.astype(np.int32),
+        "p_brand1": np.array(
+            [f"MFGR#{b // 200 + 1}{(b // 40) % 5 + 1}{b % 40 + 1:02d}"
+             for b in brand_id], dtype=object),
+        "p_category": np.array(
+            [f"MFGR#{b // 200 + 1}{(b // 40) % 5 + 1}" for b in brand_id],
+            dtype=object),
+        "p_mfgr": np.array([f"MFGR#{b // 200 + 1}" for b in brand_id],
+                           dtype=object),
+    }
+    n = fact_rows
+    fact_key = keys[rng.integers(0, dim_rows, n)].astype(np.int64)
+    miss = rng.random(n) < miss_rate
+    # miss keys: values guaranteed absent from the dim key set
+    fact_key[miss] = -fact_key[miss] - 1
+    fact = {
+        "lo_partkey": fact_key.astype(np.int32),
+        "d_year": rng.integers(1992, 1999, n).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+        "lo_revenue": (rng.integers(100, 10_000, n) * 100).astype(
+            np.int64),
+    }
+    return dim, fact
+
+
+def build_join_table_dirs(base_dir: str, fact_rows: int,
+                          num_fact_segments: int, dim_rows: int = 800,
+                          num_dim_segments: int = 1, seed: int = 0,
+                          num_partitions: int = 0
+                          ) -> Tuple[List[str], List[str], Dict, Dict]:
+    """Segment dirs for the join tables via the real storage path.
+
+    With `num_partitions` > 0, rows are partition-aligned: each segment
+    holds exactly one Modulo partition's rows (per-segment partition
+    metadata becomes discriminating, the co-partitioned exchange shape).
+    Returns (fact_dirs, dim_dirs, dim columns, fact columns).
+    """
+    import os
+
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    dim, fact = make_join_rows(fact_rows, dim_rows, seed)
+    fact_cfg, dim_cfg = join_table_configs(num_partitions)
+
+    def build(schema, cfg, cols, key_col, n_segs, prefix):
+        n = len(cols[key_col])
+        if num_partitions:
+            pids = np.abs(cols[key_col].astype(np.int64)) % num_partitions
+            slices = [np.nonzero(pids == p)[0]
+                      for p in range(num_partitions)]
+        else:
+            per = -(-n // n_segs)
+            slices = [np.arange(i * per, min((i + 1) * per, n))
+                      for i in range(n_segs)]
+        dirs = []
+        for i, rows in enumerate(slices):
+            if not len(rows):
+                continue
+            d = os.path.join(base_dir, f"{prefix}_{i}")
+            sub = {c: (v[rows] if isinstance(v, np.ndarray)
+                       else [v[j] for j in rows])
+                   for c, v in cols.items()}
+            SegmentCreator(schema, cfg,
+                           segment_name=f"{prefix}_{i}").build(sub, d)
+            dirs.append(d)
+        return dirs
+
+    fact_dirs = build(fact_join_schema(), fact_cfg, fact, "lo_partkey",
+                      num_fact_segments, "factj")
+    dim_dirs = build(part_dim_schema(), dim_cfg, dim, "p_partkey",
+                     num_dim_segments, "partd")
+    return fact_dirs, dim_dirs, dim, fact
+
+
+def join_oracle(dim: Dict, fact: Dict, dim_filter=None,
+                group_cols: Sequence[str] = (),
+                agg: str = "sum_revenue") -> Dict:
+    """Independent numpy oracle for the join smoke/bench parity gates:
+    inner-join fact×dim on the part key, optional dim-side row mask
+    (callable dim→bool [D]), group by (qualified) columns, aggregate
+    SUM(lo_revenue)+COUNT."""
+    keys = dim["p_partkey"].astype(np.int64)
+    order = np.argsort(keys, kind="stable")
+    skeys = keys[order]
+    fk = fact["lo_partkey"].astype(np.int64)
+    pos = np.clip(np.searchsorted(skeys, fk), 0, max(len(skeys) - 1, 0))
+    hit = skeys[pos] == fk if len(skeys) else np.zeros(len(fk), bool)
+    dimrow = order[pos]
+    if dim_filter is not None:
+        hit = hit & dim_filter(dim)[dimrow]
+    rows = np.nonzero(hit)[0]
+    out: Dict = {"count": int(len(rows)),
+                 "sum_revenue": int(fact["lo_revenue"][rows].sum())}
+    if group_cols:
+        lanes = []
+        for c in group_cols:
+            if c.startswith("part."):
+                lanes.append(dim[c[5:]][dimrow[rows]])
+            else:
+                lanes.append(fact[c.split(".", 1)[-1]][rows])
+        keyed: Dict[tuple, list] = {}
+        for i in range(len(rows)):
+            k = tuple(lane[i] for lane in lanes)
+            e = keyed.setdefault(k, [0, 0])
+            e[0] += int(fact["lo_revenue"][rows[i]])
+            e[1] += 1
+        out["groups"] = {k: tuple(v) for k, v in keyed.items()}
+    return out
+
+
 class SsbTable:
     """Generated table: segments + id-level host arrays for oracle math.
 

@@ -139,6 +139,23 @@ class ShmReply:
         return self.size
 
 
+def datatable_from_reply(raw):
+    """Decode a data-plane reply — inline bytes/memoryview OR an
+    ShmReply — into a DataTable, closing the shm segment either way.
+
+    The ONE place the reply-wrapper contract lives: the broker's
+    _call_once, the stage orchestration dispatches and the exchange
+    fetch client all consume replies through here, so a new reply
+    wrapper type changes exactly one decode site."""
+    from pinot_tpu.common.datatable import DataTable
+    if isinstance(raw, ShmReply):
+        try:
+            return DataTable.from_bytes(raw.view)
+        finally:
+            raw.close()
+    return DataTable.from_bytes(raw)
+
+
 def decode_reply(payload) -> Optional[ShmReply]:
     """Broker side: resolve a control frame into an attached ShmReply
     (None if the segment vanished — surfaces as a decode error)."""

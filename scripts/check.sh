@@ -69,6 +69,15 @@ echo "== vector search (similarity over mutable embeddings) =="
 # on the next converged query, and the superseded row must never rank
 env JAX_PLATFORMS=cpu python scripts/vector_smoke.py
 
+echo "== join smoke (multi-stage query engine) =="
+# SSB-style dim × fact through the full stage plane: broadcast +
+# co-partitioned joins exact vs the numpy oracle, stage-1 blocks
+# fetched over the TCP exchange byte-identically, window invariants +
+# determinism, DISTINCTCOUNTHLL register-identical to the host sketch,
+# host/device/sharded join parity, and a REALTIME upsert fact table
+# whose join tracks mid-run upserts (superseded rows never join)
+env JAX_PLATFORMS=cpu python scripts/join_smoke.py
+
 echo "== qps smoke (serving plane) =="
 # one short target-QPS rung over the real TCP mux: catches serving-plane
 # regressions (per-connection serialization, serde blow-ups) in seconds

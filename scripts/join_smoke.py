@@ -1,0 +1,538 @@
+#!/usr/bin/env python
+"""Multi-stage join smoke gate (SSB-style dim × fact, embedded cluster).
+
+Drives the whole stage plane end to end over the real TCP data plane:
+
+- BROADCAST join: ``SELECT SUM(...) FROM fact JOIN part ON ...`` with a
+  dim-side WHERE and dim+fact GROUP BY must match an independent numpy
+  oracle EXACTLY (values per group, not approximately);
+- CO-PARTITIONED join: the same query over partition-aligned tables
+  stays exact, and the per-segment partition metadata provably lets a
+  single-partition server skip disjoint dim sources;
+- EXCHANGE over TCP: a stage-1 block published on one server is fetched
+  over the XCHG data-plane frame (forced remote path) byte-identically;
+- WINDOW functions: ROW_NUMBER + SUM OVER rows satisfy the per-partition
+  rank/telescoping invariants and are run-to-run deterministic;
+- HLL: DISTINCTCOUNTHLL equals the host HyperLogLog oracle's estimate
+  exactly (register-identical sketches ⇒ identical estimates);
+- UPSERT freshness: a REALTIME upsert fact table joins against the dim
+  table; re-publishing a key with a NEW join key converges the join
+  result to the latest-rows oracle — the superseded row never joins.
+
+Artifact mode (the committed JOIN_r12.json): JOIN_SMOKE_ROWS=1000000
+JOIN_SMOKE_ARTIFACT=JOIN_r12.json adds a host/device/sharded parity
+sweep over the 1M-row fact and records wall times per query class.
+
+Exit code 0 on success, 1 otherwise. Env knobs:
+  JOIN_SMOKE_ROWS      fact rows             (default 30000)
+  JOIN_SMOKE_DIM_ROWS  dim rows              (default 600)
+  JOIN_SMOKE_ARTIFACT  write a JSON artifact (default off)
+  JOIN_SMOKE_WINDOW_S  upsert convergence    (default 60)
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+ROWS = int(os.environ.get("JOIN_SMOKE_ROWS", "30000"))
+DIM_ROWS = int(os.environ.get("JOIN_SMOKE_DIM_ROWS", "600"))
+ARTIFACT = os.environ.get("JOIN_SMOKE_ARTIFACT", "")
+WINDOW_S = float(os.environ.get("JOIN_SMOKE_WINDOW_S", "60"))
+
+FACT = "lineorderj"
+DIM = "part"
+
+
+def log(msg):
+    print(f"join_smoke: {msg}")
+
+
+def group_dict(resp, fi=0):
+    return {tuple(g["group"]): float(g["value"])
+            for g in resp.aggregation_results[fi].group_by_result}
+
+
+def expect_exact(name, resp, oracle_groups):
+    if resp.exceptions:
+        print(f"FAIL: {name}: {resp.exceptions}", file=sys.stderr)
+        return False
+    got = group_dict(resp)
+    exp = {k: float(v[0]) for k, v in oracle_groups.items()}
+    if got != exp:
+        diff = {k: (got.get(k), exp.get(k))
+                for k in set(got) | set(exp) if got.get(k) != exp.get(k)}
+        print(f"FAIL: {name}: {len(diff)} group(s) differ, e.g. "
+              f"{list(diff.items())[:3]}", file=sys.stderr)
+        return False
+    log(f"{name}: exact over {len(exp)} groups")
+    return True
+
+
+def run_cluster_suite(report):
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.tools.datagen import (build_join_table_dirs,
+                                         fact_join_schema, join_oracle,
+                                         join_table_configs,
+                                         part_dim_schema)
+
+    base = tempfile.mkdtemp(prefix="join_smoke_")
+    t0 = time.perf_counter()
+    fact_dirs, dim_dirs, dim, fact = build_join_table_dirs(
+        os.path.join(base, "b"), fact_rows=ROWS, num_fact_segments=4,
+        dim_rows=DIM_ROWS, seed=12)
+    cp_fact_dirs, cp_dim_dirs, cp_dim, cp_fact = build_join_table_dirs(
+        os.path.join(base, "cp"), fact_rows=min(ROWS, 60000),
+        num_fact_segments=4, dim_rows=DIM_ROWS, seed=13,
+        num_partitions=4)
+    report["datagenS"] = round(time.perf_counter() - t0, 2)
+
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=2,
+                              tcp=True)
+    ok = True
+    try:
+        cluster.add_schema(fact_join_schema())
+        cluster.add_schema(part_dim_schema())
+        fc, dc = join_table_configs()
+        cluster.add_table(fc)
+        cluster.add_table(dc)
+        for d in fact_dirs:
+            cluster.upload_segment(f"{FACT}_OFFLINE", d)
+        for d in dim_dirs:
+            cluster.upload_segment(f"{DIM}_OFFLINE", d)
+
+        # -- broadcast join, dim WHERE + dim/fact GROUP BY ----------------
+        q = (f"SELECT SUM({FACT}.lo_revenue) FROM {FACT} JOIN {DIM} "
+             f"ON {FACT}.lo_partkey = {DIM}.p_partkey "
+             f"WHERE {DIM}.p_mfgr = 'MFGR#2' AND {FACT}.lo_quantity < 30 "
+             f"GROUP BY {DIM}.p_brand1, {FACT}.d_year TOP 100000")
+        t = time.perf_counter()
+        resp = cluster.query(q)
+        report["broadcastJoinMs"] = round(
+            (time.perf_counter() - t) * 1e3, 1)
+        fq = fact["lo_quantity"] < 30
+        o = join_oracle(dim, {k: (v[fq] if isinstance(v, np.ndarray)
+                                  else v) for k, v in fact.items()},
+                        dim_filter=lambda d: d["p_mfgr"] == "MFGR#2",
+                        group_cols=["part.p_brand1", "f.d_year"])
+        exp = {(k[0], int(k[1])): v for k, v in o["groups"].items()}
+        ok &= expect_exact("broadcast join", resp,
+                           {k: v for k, v in exp.items()})
+        report["broadcastJoinGroups"] = len(exp)
+
+        # -- forced-TCP exchange fetch ------------------------------------
+        servers = sorted(cluster.servers)
+        s0 = cluster.servers[servers[0]]
+        s0.exchange.put("smoke.x", b"\x00\x01payload\x7f" * 100)
+        from pinot_tpu.query.stages import exchange as xmod
+        host, port = cluster.transport.endpoints[servers[0]]
+        import asyncio
+        from pinot_tpu.transport.tcp import ServerConnection
+        loop = asyncio.new_event_loop()
+        try:
+            conn = ServerConnection(host, port)
+            raw = loop.run_until_complete(
+                conn.request(xmod.fetch_frame("smoke.x"), 5.0))
+            loop.run_until_complete(conn.close())
+        finally:
+            loop.close()
+        if bytes(raw) != b"\x00\x01payload\x7f" * 100:
+            print("FAIL: TCP exchange fetch not byte-identical",
+                  file=sys.stderr)
+            ok = False
+        else:
+            log("exchange: stage-1 block fetched over the TCP data "
+                "plane byte-identically")
+
+        # -- window functions (SUM OVER the bounded metric: the int32
+        # running-sum contract — lo_revenue at 1M-row scale would
+        # rightly be rejected by the overflow guard) -----------------------
+        qw = (f"SELECT d_year, lo_quantity, ROW_NUMBER() OVER "
+              f"(PARTITION BY d_year ORDER BY lo_revenue DESC), "
+              f"SUM(lo_quantity) OVER (PARTITION BY d_year ORDER BY "
+              f"lo_revenue DESC) FROM {FACT} WHERE lo_quantity = 1 "
+              f"LIMIT 65536")
+        t = time.perf_counter()
+        r1 = cluster.query(qw)
+        report["windowMs"] = round((time.perf_counter() - t) * 1e3, 1)
+        r2 = cluster.query(qw)
+        if r1.exceptions or r1.selection_results is None or \
+                not r1.selection_results.results:
+            print(f"FAIL: window query: {r1.exceptions}", file=sys.stderr)
+            ok = False
+        elif r1.selection_results.results != r2.selection_results.results:
+            print("FAIL: window query not deterministic", file=sys.stderr)
+            ok = False
+        else:
+            rows = r1.selection_results.results
+            seen = {}
+            w_ok = True
+            for year, qty, rn, run in rows:
+                prev = seen.get(year)
+                if prev is None:
+                    w_ok &= rn == 1 and run == qty
+                else:
+                    w_ok &= (rn == prev[0] + 1 and run == prev[1] + qty)
+                seen[year] = (rn, run)
+            n_scan = int((fact["lo_quantity"] == 1).sum())
+            w_ok &= sum(s[0] for s in seen.values()) == n_scan
+            if not w_ok:
+                print("FAIL: window invariants violated", file=sys.stderr)
+                ok = False
+            else:
+                log(f"window: {len(rows)} rows (of {n_scan} scanned), "
+                    "rank/telescoping invariants hold, deterministic")
+            report["windowRows"] = n_scan
+
+        # -- HLL ----------------------------------------------------------
+        from pinot_tpu.common.sketches import HyperLogLog
+        t = time.perf_counter()
+        rh = cluster.query(
+            f"SELECT DISTINCTCOUNTHLL(lo_partkey) FROM {FACT}")
+        report["hllMs"] = round((time.perf_counter() - t) * 1e3, 1)
+        oracle_est = int(round(HyperLogLog.from_values(
+            np.unique(fact["lo_partkey"])).cardinality()))
+        got_est = int(float(rh.aggregation_results[0].value))
+        if rh.exceptions or got_est != oracle_est:
+            print(f"FAIL: HLL estimate {got_est} != oracle {oracle_est} "
+                  f"(register-identity broken) {rh.exceptions}",
+                  file=sys.stderr)
+            ok = False
+        else:
+            log(f"HLL: estimate {got_est} == host-sketch oracle "
+                f"(true distinct {len(np.unique(fact['lo_partkey']))})")
+        report["hllEstimate"] = got_est
+
+        # -- co-partitioned join ------------------------------------------
+        cluster2 = EmbeddedCluster(os.path.join(base, "c2"),
+                                   num_servers=2, tcp=True)
+        try:
+            cluster2.add_schema(fact_join_schema())
+            cluster2.add_schema(part_dim_schema())
+            fc2, dc2 = join_table_configs(num_partitions=4)
+            cluster2.add_table(fc2)
+            cluster2.add_table(dc2)
+            for d in cp_fact_dirs:
+                cluster2.upload_segment(f"{FACT}_OFFLINE", d)
+            for d in cp_dim_dirs:
+                cluster2.upload_segment(f"{DIM}_OFFLINE", d)
+            t = time.perf_counter()
+            rc = cluster2.query(
+                f"SELECT SUM({FACT}.lo_revenue) FROM {FACT} JOIN {DIM} "
+                f"ON {FACT}.lo_partkey = {DIM}.p_partkey "
+                f"GROUP BY {DIM}.p_mfgr TOP 100")
+            report["copartJoinMs"] = round(
+                (time.perf_counter() - t) * 1e3, 1)
+            oc = join_oracle(cp_dim, cp_fact,
+                             group_cols=["part.p_mfgr"])
+            ok &= expect_exact(
+                "co-partitioned join", rc,
+                {(k[0],): v for k, v in oc["groups"].items()})
+            # partition metadata is discriminating per segment
+            from pinot_tpu.query.stages.join import (fact_partition_info,
+                                                     filter_sources)
+            from pinot_tpu.segment.loader import ImmutableSegmentLoader
+            seg0 = ImmutableSegmentLoader.load(cp_fact_dirs[0])
+            fp = fact_partition_info([seg0], "lo_partkey")
+            sources = [{"server": "s", "id": f"x{p}", "partitions": [p],
+                        "partitionFunction": "Modulo",
+                        "numPartitions": 4} for p in range(4)]
+            _kept, skipped = filter_sources(sources, fp)
+            if fp is None or skipped != 4 - len(fp[2]):
+                print("FAIL: co-partitioned source filtering inert",
+                      file=sys.stderr)
+                ok = False
+            else:
+                log(f"co-partitioned dispatch: single-partition server "
+                    f"skips {skipped}/4 dim sources")
+            report["copartSkippedSources"] = skipped
+        finally:
+            cluster2.stop()
+    finally:
+        cluster.stop()
+    return ok
+
+
+def run_upsert_suite(report):
+    """REALTIME upsert fact table joining an OFFLINE dim table: the
+    join must track the LATEST row per key — a mid-run upsert moving a
+    key to a different dim category converges, the superseded row never
+    joins again."""
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (Schema, TimeUnit, dimension,
+                                         metric, time_field)
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig, TableConfig,
+                                               TableType, UpsertConfig)
+    from pinot_tpu.realtime import registry
+    from pinot_tpu.realtime.stream import (MemoryStream,
+                                           MemoryStreamConsumerFactory)
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.tools.datagen import (join_table_configs, make_join_rows,
+                                         part_dim_schema)
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    topic = "join_smoke_topic"
+    rt = "ordersrt"
+    keys = 120
+    rows_n = 400
+    dim, _fact = make_join_rows(10, dim_rows=200, seed=21)
+    schema = Schema(rt, [
+        dimension("okey", DataType.STRING),
+        dimension("lo_partkey", DataType.INT),
+        metric("lo_revenue", DataType.LONG),
+        time_field("ts", DataType.INT, TimeUnit.DAYS),
+    ])
+    stream = MemoryStream(topic, num_partitions=1)
+    registry.register_stream_factory(
+        f"mem_{topic}", MemoryStreamConsumerFactory(stream, batch_size=50))
+    cfg = TableConfig(
+        rt, table_type=TableType.REALTIME,
+        indexing_config=IndexingConfig(stream_configs={
+            "stream.factory.name": f"mem_{topic}",
+            "stream.topic.name": topic,
+            "realtime.segment.flush.threshold.size": "1000000",
+            "realtime.segment.flush.threshold.time.ms": "600000000",
+        }),
+        segments_config=SegmentsConfig(replication=1,
+                                       time_column_name="ts"))
+    cfg.upsert_config = UpsertConfig(mode="FULL",
+                                     primary_key_columns=["okey"])
+
+    rng = np.random.default_rng(31)
+    dim_keys = dim["p_partkey"].astype(np.int64)
+    rows = []
+    for i in range(rows_n):
+        rows.append({"okey": f"o{i % keys}",
+                     "lo_partkey": int(dim_keys[rng.integers(
+                         0, len(dim_keys))]),
+                     "lo_revenue": int(rng.integers(100, 10_000) * 100),
+                     "ts": 1 + (i % 30)})
+
+    def latest(rs):
+        by = {}
+        for r in rs:
+            by[r["okey"]] = r
+        return list(by.values())
+
+    def oracle(rs, mfgr):
+        order = np.argsort(dim_keys, kind="stable")
+        skeys = dim_keys[order]
+        total = cnt = 0
+        for r in latest(rs):
+            p = int(np.searchsorted(skeys, r["lo_partkey"]))
+            if p < len(skeys) and skeys[p] == r["lo_partkey"]:
+                if dim["p_mfgr"][order[p]] == mfgr:
+                    total += r["lo_revenue"]
+                    cnt += 1
+        return total, cnt
+
+    base = tempfile.mkdtemp(prefix="join_smoke_rt_")
+    ddir = os.path.join(base, "d0")
+    _fc, dc = join_table_configs()
+    SegmentCreator(part_dim_schema(), dc,
+                   segment_name="partd_0").build(dim, ddir)
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=1)
+    ok = False
+    try:
+        cluster.add_schema(schema)
+        cluster.add_schema(part_dim_schema())
+        cluster.add_table(dc)
+        cluster.upload_segment(f"{DIM}_OFFLINE", ddir)
+        cluster.add_table(cfg)
+        for r in rows:
+            stream.publish(r, partition=0)
+
+        q = (f"SELECT SUM({rt}.lo_revenue), COUNT(*) FROM {rt} "
+             f"JOIN {DIM} ON {rt}.lo_partkey = {DIM}.p_partkey "
+             f"WHERE {DIM}.p_mfgr = 'MFGR#1'")
+
+        def result():
+            resp = cluster.query(q)
+            if resp.exceptions:
+                return None
+            return (int(float(resp.aggregation_results[0].value or 0)),
+                    int(float(resp.aggregation_results[1].value)))
+
+        deadline = time.monotonic() + WINDOW_S
+        exp = oracle(rows, "MFGR#1")
+        while time.monotonic() < deadline and result() != exp:
+            time.sleep(0.1)
+        if result() != exp:
+            print(f"FAIL: upsert join initial parity: {result()} != "
+                  f"{exp}", file=sys.stderr)
+            return False
+        log(f"upsert join: initial SUM/COUNT match latest-rows oracle "
+            f"{exp}")
+
+        # move one joined key to a DIFFERENT manufacturer's part: the
+        # old row's contribution must vanish, the new one appear
+        m1 = dim["p_mfgr"] == "MFGR#1"
+        m3 = dim["p_mfgr"] == "MFGR#3"
+        new_row = {"okey": "o7",
+                   "lo_partkey": int(dim_keys[np.nonzero(m3)[0][0]]),
+                   "lo_revenue": 123_400, "ts": 31}
+        rows.append(new_row)
+        stream.publish(new_row, partition=0)
+        exp2 = oracle(rows, "MFGR#1")
+        deadline = time.monotonic() + WINDOW_S
+        while time.monotonic() < deadline and result() != exp2:
+            time.sleep(0.1)
+        if result() != exp2:
+            print(f"FAIL: upsert join freshness: {result()} != {exp2}",
+                  file=sys.stderr)
+            return False
+        exp3 = oracle(rows, "MFGR#3")
+        r3 = cluster.query(
+            f"SELECT SUM({rt}.lo_revenue), COUNT(*) FROM {rt} "
+            f"JOIN {DIM} ON {rt}.lo_partkey = {DIM}.p_partkey "
+            f"WHERE {DIM}.p_mfgr = 'MFGR#3'")
+        got3 = (int(float(r3.aggregation_results[0].value or 0)),
+                int(float(r3.aggregation_results[1].value)))
+        if got3 != exp3:
+            print(f"FAIL: upserted row not joined on new side: {got3} "
+                  f"!= {exp3}", file=sys.stderr)
+            return False
+        log("upsert join: mid-run upsert moved key o7 between dim "
+            "categories — superseded row never joins, new row joins on "
+            "the next converged query")
+        report["upsertJoin"] = {"initial": list(exp), "after": list(exp2),
+                                "movedTo": list(exp3)}
+        ok = True
+    finally:
+        cluster.stop()
+    return ok
+
+
+def run_parity_sweep(report):
+    """Host/device/sharded bit-parity over the generated fact (the
+    artifact's oracle-parity suite; also run at smoke scale)."""
+    import copy
+    from pinot_tpu.parallel.sharded import ShardedQueryExecutor, make_mesh
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.reduce import BrokerReduceService
+    from pinot_tpu.query.stages import join as jmod
+    from pinot_tpu.query.stages import window as wmod
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.tools.datagen import build_join_table_dirs
+
+    base = tempfile.mkdtemp(prefix="join_parity_")
+    t0 = time.perf_counter()
+    fact_dirs, _dim_dirs, dim, fact = build_join_table_dirs(
+        os.path.join(base, "b"), fact_rows=ROWS, num_fact_segments=4,
+        dim_rows=DIM_ROWS, seed=12)
+    segs = [ImmutableSegmentLoader.load(d) for d in fact_dirs]
+    report["paritySetupS"] = round(time.perf_counter() - t0, 2)
+    red = BrokerReduceService()
+
+    request = compile_pql(
+        f"SELECT SUM({FACT}.lo_revenue), COUNT(*) FROM {FACT} JOIN "
+        f"{DIM} ON {FACT}.lo_partkey = {DIM}.p_partkey "
+        f"WHERE {DIM}.p_category = 'MFGR#23' "
+        f"GROUP BY {DIM}.p_brand1 TOP 100000")
+    dmask = dim["p_category"] == "MFGR#23"
+    ctx = jmod.JoinContext(
+        request.join, dim["p_partkey"][dmask].astype(np.int64),
+        {c: dim[c][dmask] for c in request.join.dim_columns})
+    req = copy.copy(request)
+    req._join_ctx = ctx
+
+    def gd(resp, fi):
+        return {tuple(g["group"]): g["value"] for g in
+                resp.to_json()["aggregationResults"][fi]["groupByResult"]}
+
+    times = {}
+    outs = {}
+    for name, ex in [("host", ServerQueryExecutor(use_device=False)),
+                     ("device", ServerQueryExecutor(use_device=True)),
+                     ("sharded", ShardedQueryExecutor(mesh=make_mesh()))]:
+        t = time.perf_counter()
+        outs[name] = red.reduce(request, [ex.execute(req, segs)])
+        times[name] = round((time.perf_counter() - t) * 1e3, 1)
+    join_parity = all(
+        gd(outs["host"], fi) == gd(outs["device"], fi) ==
+        gd(outs["sharded"], fi) for fi in range(2))
+    report["joinParity"] = {"bitIdentical": join_parity, "ms": times}
+    if not join_parity:
+        print("FAIL: join host/device/sharded parity", file=sys.stderr)
+        return False
+    log(f"parity: join host/device/sharded bit-identical over "
+        f"{len(gd(outs['host'], 0))} groups "
+        f"(host {times['host']}ms, device {times['device']}ms, "
+        f"sharded {times['sharded']}ms)")
+
+    # window host-vs-device bit parity on the scan input
+    wreq = compile_pql(
+        "SELECT d_year, lo_revenue, ROW_NUMBER() OVER (PARTITION BY "
+        "d_year ORDER BY lo_revenue), SUM(lo_quantity) OVER "
+        "(PARTITION BY d_year ORDER BY lo_revenue) FROM t LIMIT 100000")
+    sel = fact["lo_quantity"] <= 2
+    cols = {c: fact[c][sel] for c in
+            ("d_year", "lo_revenue", "lo_quantity")}
+    n = int(sel.sum())
+    t = time.perf_counter()
+    dev = wmod.execute_window(wreq, dict(cols), n, use_device=True)
+    t_dev = round((time.perf_counter() - t) * 1e3, 1)
+    host = wmod.execute_window(wreq, dict(cols), n, use_device=False)
+    win_parity = all(np.array_equal(np.asarray(a), np.asarray(b))
+                     for a, b in zip(dev.selection_cols,
+                                     host.selection_cols))
+    report["windowParity"] = {"bitIdentical": win_parity, "rows": n,
+                              "deviceMs": t_dev}
+    if not win_parity:
+        print("FAIL: window host/device parity", file=sys.stderr)
+        return False
+    log(f"parity: window host/device bit-identical over {n} rows "
+        f"({t_dev}ms device)")
+
+    # HLL registers host/device/sharded identical
+    from pinot_tpu.engine import QueryEngine
+    hq = f"SELECT DISTINCTCOUNTHLL(lo_partkey) FROM {FACT}"
+    t = time.perf_counter()
+    vals = [QueryEngine(segs, use_device=True).query(hq),
+            QueryEngine(segs, use_device=False).query(hq),
+            QueryEngine(segs, use_device=True,
+                        mesh=make_mesh()).query(hq)]
+    t_hll = round((time.perf_counter() - t) * 1e3, 1)
+    ests = [v.aggregation_results[0].value for v in vals]
+    hll_parity = len(set(ests)) == 1
+    report["hllParity"] = {"registerIdentical": hll_parity,
+                           "estimate": ests[0], "sweepMs": t_hll}
+    if not hll_parity:
+        print(f"FAIL: HLL parity {ests}", file=sys.stderr)
+        return False
+    log(f"parity: HLL device/host/sharded estimates identical "
+        f"({ests[0]})")
+    return True
+
+
+def main() -> int:
+    report = {"artifact": "JOIN_r12", "rows": ROWS, "dimRows": DIM_ROWS,
+              "backend": os.environ.get("JAX_PLATFORMS", "cpu")}
+    ok = run_parity_sweep(report)
+    ok = run_cluster_suite(report) and ok
+    ok = run_upsert_suite(report) and ok
+    report["pass"] = bool(ok)
+    if ARTIFACT:
+        with open(ARTIFACT, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        log(f"wrote {ARTIFACT}")
+    print("join_smoke: " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

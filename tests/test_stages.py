@@ -1,0 +1,772 @@
+"""Multi-stage query engine: joins, windows, HLL kernels, exchange plane.
+
+Parity philosophy matches the rest of the suite: every new kernel has a
+host-oracle twin and the tests pin BIT-identical results across host,
+device and sharded paths — including under upsert masking — plus typed
+4xx negative paths and the exchange plane's unit semantics.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.request import BrokerRequest, JoinSpec
+from pinot_tpu.common.serde import (instance_request_from_bytes,
+                                    instance_request_to_bytes,
+                                    request_from_json, request_to_json)
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.pql.parser import PqlSyntaxError, compile_pql
+from pinot_tpu.query.stages import exchange as xmod
+from pinot_tpu.query.stages import join as jmod
+from pinot_tpu.query.stages import window as wmod
+from pinot_tpu.query.stages.errors import StageCompileError
+from pinot_tpu.tools.datagen import (build_join_table_dirs,
+                                     fact_join_schema, join_oracle,
+                                     join_table_configs, part_dim_schema)
+
+
+# ---------------------------------------------------------------------------
+# PQL + serde
+# ---------------------------------------------------------------------------
+
+
+def test_join_parse_and_serde_roundtrip():
+    q = ("SELECT SUM(f.lo_revenue), COUNT(*) FROM f JOIN part "
+         "ON f.lo_partkey = part.p_partkey "
+         "WHERE part.p_category = 'MFGR#12' AND f.lo_quantity < 25 "
+         "GROUP BY part.p_brand1, f.d_year TOP 7")
+    r = compile_pql(q)
+    j = r.join
+    assert (j.dim_table, j.fact_key, j.dim_key) == \
+        ("part", "lo_partkey", "p_partkey")
+    assert j.dim_columns == ["p_brand1"]
+    assert j.dim_filter.column == "p_category"      # dim conjunct split
+    assert r.filter.column == "lo_quantity"         # fact conjunct stays
+    assert r.group_by.columns == ["part.p_brand1", "d_year"]
+    assert [a.column for a in r.aggregations] == ["lo_revenue", "*"]
+    r2 = request_from_json(request_to_json(r))
+    assert r2.join == j
+    assert r2.group_by.columns == r.group_by.columns
+    # dim-qualified names never leak into fact-side column resolution
+    assert "part.p_brand1" not in r.referenced_columns()
+    assert "lo_partkey" in r.referenced_columns()
+
+
+def test_window_parse_and_serde_roundtrip():
+    q = ("SELECT d_year, lo_revenue, "
+         "ROW_NUMBER() OVER (PARTITION BY d_year ORDER BY lo_revenue "
+         "DESC), SUM(lo_quantity) OVER (PARTITION BY d_year ORDER BY "
+         "lo_revenue DESC) FROM t WHERE lo_quantity < 9 LIMIT 20")
+    r = compile_pql(q)
+    assert [w.function for w in r.windows] == ["ROW_NUMBER", "SUM"]
+    assert r.windows[1].column == "lo_quantity"
+    assert r.windows[0].partition_by == ["d_year"]
+    assert not r.windows[0].order_by[0].ascending
+    assert r.selection.columns == ["d_year", "lo_revenue"]
+    r2 = request_from_json(request_to_json(r))
+    assert r2.windows == r.windows
+    assert sorted(r.referenced_columns()) == \
+        ["d_year", "lo_quantity", "lo_revenue"]
+
+
+def test_instance_request_stage_keys_roundtrip():
+    req = InstanceRequest(
+        request_id=7, query=compile_pql("SELECT COUNT(*) FROM t"),
+        publish_exchange={"id": "x7.0", "keyColumn": "k"},
+        exchange_sources=[{"server": "s", "xkey": "u", "id": "x7.0",
+                           "host": None, "port": None, "rows": 3}])
+    r2 = instance_request_from_bytes(instance_request_to_bytes(req))
+    assert r2.publish_exchange == req.publish_exchange
+    assert r2.exchange_sources == req.exchange_sources
+
+
+@pytest.mark.parametrize("bad", [
+    # malformed JOIN
+    "SELECT COUNT(*) FROM f JOIN d",
+    "SELECT COUNT(*) FROM f JOIN d ON f.k < d.j",
+    "SELECT COUNT(*) FROM f JOIN d ON f.k = f.j",
+    "SELECT COUNT(*) FROM f JOIN f ON f.k = f.k",
+    "SELECT COUNT(*) FROM f JOIN d ON k = d.j",
+    # unsupported join shapes (typed, never a crash)
+    "SELECT f.a FROM f JOIN d ON f.k = d.j",
+    "SELECT SUM(d.m) FROM f JOIN d ON f.k = d.j",
+    "SELECT COUNT(*) FROM f JOIN d ON f.k = d.j WHERE f.a = 1 OR d.b = 2",
+    "SELECT COUNT(*) FROM f JOIN d ON f.k = d.j GROUP BY x",
+    # malformed OVER
+    "SELECT ROW_NUMBER() OVER (PARTITION BY a) FROM t",
+    "SELECT ROW_NUMBER() FROM t",
+    "SELECT AVG(x) OVER (ORDER BY y) FROM t",
+    "SELECT SUM(x) OVER (ORDER BY y), COUNT(*) FROM t",
+    "SELECT ROW_NUMBER() OVER (ORDER BY y) FROM t ORDER BY y",
+    "SELECT * , ROW_NUMBER() OVER (ORDER BY y) FROM t",
+    # malformed HLL
+    "SELECT DISTINCTCOUNTHLL() FROM t",
+    "SELECT DISTINCTCOUNTHLL(a, b) FROM t",
+])
+def test_pql_negative_paths_are_typed(bad):
+    with pytest.raises(PqlSyntaxError):
+        compile_pql(bad)
+
+
+# ---------------------------------------------------------------------------
+# Exchange plane
+# ---------------------------------------------------------------------------
+
+
+def test_exchange_manager_put_get_ttl_and_capacity():
+    clock = [0.0]
+    m = xmod.ExchangeManager(ttl_s=10.0, max_bytes=100,
+                             clock=lambda: clock[0])
+    try:
+        m.put("a", b"x" * 60)
+        assert m.get("a") == b"x" * 60
+        with pytest.raises(Exception):          # over the byte budget
+            m.put("b", b"y" * 60)
+        clock[0] = 11.0                          # TTL expiry frees space
+        assert m.get("a") is None
+        m.put("b", b"y" * 60)
+        assert m.get("b") == b"y" * 60
+    finally:
+        m.close()
+
+
+def test_exchange_frame_fetch_and_miss():
+    m = xmod.ExchangeManager()
+    try:
+        from pinot_tpu.common.datatable import DataTable
+        dt = DataTable()
+        dt.metadata["k"] = "v"
+        m.put("x1.0", dt.to_bytes())
+        reply = m.handle_frame(xmod.fetch_frame("x1.0"))
+        assert DataTable.from_bytes(reply).metadata["k"] == "v"
+        miss = DataTable.from_bytes(m.handle_frame(xmod.fetch_frame("no")))
+        assert any("ExchangeMissError" in e for e in miss.exceptions)
+        # local-registry fetch path
+        got = xmod.fetch_block({"server": "s", "xkey": m.xkey,
+                                "id": "x1.0"}, 1.0)
+        assert got.metadata["k"] == "v"
+        with pytest.raises(xmod.ExchangeError):
+            xmod.fetch_block({"server": "s", "xkey": m.xkey,
+                              "id": "gone"}, 1.0)
+    finally:
+        m.close()
+
+
+def test_filter_sources_copartitioned():
+    sources = [
+        {"server": "a", "id": "x1", "partitions": [0],
+         "partitionFunction": "Modulo", "numPartitions": 2},
+        {"server": "b", "id": "x2", "partitions": [1],
+         "partitionFunction": "Modulo", "numPartitions": 2},
+        {"server": "c", "id": "x3"},                     # untagged
+        {"server": "d", "id": "x4", "partitions": [1],
+         "partitionFunction": "Murmur", "numPartitions": 2},  # fn differs
+    ]
+    kept, skipped = jmod.filter_sources(sources, ("Modulo", 2, {0}))
+    assert [s["server"] for s in kept] == ["a", "c", "d"]
+    assert skipped == 1
+    # unknown fact partitions → fetch everything (superset is correct)
+    kept, skipped = jmod.filter_sources(sources, None)
+    assert len(kept) == 4 and skipped == 0
+
+
+# ---------------------------------------------------------------------------
+# Join parity: host vs device vs sharded, dict and raw keys
+# ---------------------------------------------------------------------------
+
+
+def _load_segments(dirs):
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    return [ImmutableSegmentLoader.load(d) for d in dirs]
+
+
+def _join_ctx(spec, dim):
+    cols = {c: dim[c] for c in spec.dim_columns}
+    return jmod.JoinContext(spec, dim[spec.dim_key].astype(np.int64),
+                            cols)
+
+
+def _attach(request, ctx):
+    import copy
+    out = copy.copy(request)
+    out._join_ctx = ctx
+    return out
+
+
+def _reduce(request, block):
+    from pinot_tpu.query.reduce import BrokerReduceService
+    return BrokerReduceService().reduce(request, [block]).to_json()
+
+
+@pytest.fixture(scope="module")
+def join_fixture(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("join"))
+    fact_dirs, dim_dirs, dim, fact = build_join_table_dirs(
+        base, fact_rows=12000, num_fact_segments=3, dim_rows=400, seed=5)
+    return _load_segments(fact_dirs), dim, fact
+
+
+def test_join_parity_host_device_sharded(join_fixture):
+    segments, dim, fact = join_fixture
+    q = ("SELECT SUM(lineorderj.lo_revenue), COUNT(*) FROM lineorderj "
+         "JOIN part ON lineorderj.lo_partkey = part.p_partkey "
+         "WHERE part.p_mfgr = 'MFGR#2' AND lineorderj.lo_quantity < 30 "
+         "GROUP BY part.p_brand1, lineorderj.d_year TOP 5000")
+    request = compile_pql(q)
+    mask = lambda d: d["p_mfgr"] == "MFGR#2"  # noqa: E731
+    dmask = np.asarray(mask(dim))
+    spec = request.join
+    ctx = _join_ctx(spec, {k: (v[dmask] if isinstance(v, np.ndarray)
+                               else v) for k, v in dim.items()})
+    req = _attach(request, ctx)
+
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    host = _reduce(request, ServerQueryExecutor(use_device=False)
+                   .execute(req, segments))
+    dev = _reduce(request, ServerQueryExecutor(use_device=True)
+                  .execute(req, segments))
+    from pinot_tpu.parallel.sharded import ShardedQueryExecutor, make_mesh
+    sh = _reduce(request, ShardedQueryExecutor(mesh=make_mesh())
+                 .execute(req, segments))
+
+    def as_dict(r, fi):
+        # (group → value) map: top-N TIE order legitimately differs by
+        # path (insertion order breaks ties); the VALUES must be exact
+        return {tuple(g["group"]): g["value"]
+                for g in r["aggregationResults"][fi]["groupByResult"]}
+
+    for fi in range(2):
+        assert as_dict(host, fi) == as_dict(dev, fi)
+        assert as_dict(host, fi) == as_dict(sh, fi)
+
+    # and all three equal the independent numpy oracle
+    fq = fact["lo_quantity"] < 30
+    o = join_oracle(dim, {k: (v[fq] if isinstance(v, np.ndarray) else v)
+                          for k, v in fact.items()},
+                    dim_filter=mask,
+                    group_cols=["part.p_brand1", "lineorderj.d_year"])
+    got = {k: float(v) for k, v in as_dict(host, 0).items()}
+    exp = {(k[0], int(k[1])): float(v[0]) for k, v in o["groups"].items()}
+    assert got == exp
+
+
+def test_join_raw_key_parity(tmp_path):
+    """Raw (no-dictionary) fact key: the device-built sorted probe
+    (join_raw/jraw) agrees bit-for-bit with the host twin."""
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.datagen import make_join_rows
+    dim, fact = make_join_rows(6000, dim_rows=250, seed=9)
+    cfg = TableConfig("lineorderj", indexing_config=IndexingConfig(
+        no_dictionary_columns=["lo_partkey"]))
+    d = str(tmp_path / "seg0")
+    SegmentCreator(fact_join_schema(), cfg,
+                   segment_name="rawk_0").build(fact, d)
+    segments = _load_segments([d])
+    q = ("SELECT SUM(lineorderj.lo_revenue), COUNT(*) FROM lineorderj "
+         "JOIN part ON lineorderj.lo_partkey = part.p_partkey "
+         "GROUP BY part.p_category TOP 100")
+    request = compile_pql(q)
+    ctx = _join_ctx(request.join, dim)
+    req = _attach(request, ctx)
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    host = _reduce(request, ServerQueryExecutor(use_device=False)
+                   .execute(req, segments))
+    dev = _reduce(request, ServerQueryExecutor(use_device=True)
+                  .execute(req, segments))
+    assert host["aggregationResults"] == dev["aggregationResults"]
+    o = join_oracle(dim, fact, group_cols=["part.p_category"])
+    got = {g["group"][0]: float(g["value"])
+           for g in dev["aggregationResults"][0]["groupByResult"]}
+    assert got == {k[0]: float(v[0]) for k, v in o["groups"].items()}
+
+
+def test_join_upsert_mask_never_leaks(join_fixture):
+    """Invalidated (upsert-superseded) fact rows never reach a join
+    side — host and device agree after the mask flips mid-sequence."""
+    segments, dim, fact = join_fixture
+    seg = segments[0]
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    q = ("SELECT SUM(lineorderj.lo_revenue), COUNT(*) FROM lineorderj "
+         "JOIN part ON lineorderj.lo_partkey = part.p_partkey")
+    request = compile_pql(q)
+    ctx = _join_ctx(request.join, dim)
+    req = _attach(request, ctx)
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    base_dev = _reduce(request, ServerQueryExecutor(use_device=True)
+                       .execute(req, [seg]))
+    vd = ValidDocIds()
+    killed = [0, 5, 17, 100]
+    for doc in killed:
+        vd.invalidate(doc)
+    seg.valid_doc_ids = vd
+    try:
+        host = _reduce(request, ServerQueryExecutor(use_device=False)
+                       .execute(req, [seg]))
+        dev = _reduce(request, ServerQueryExecutor(use_device=True)
+                      .execute(req, [seg]))
+        assert host["aggregationResults"] == dev["aggregationResults"]
+        assert dev["aggregationResults"] != base_dev["aggregationResults"]
+        # the masked rows' contribution is exactly absent
+        n = seg.num_docs
+        keys = np.sort(np.unique(dim["p_partkey"].astype(np.int64)))
+        fk = fact["lo_partkey"][:n].astype(np.int64)
+        pos = np.clip(np.searchsorted(keys, fk), 0, len(keys) - 1)
+        hit = keys[pos] == fk
+        alive = hit.copy()
+        alive[killed] = False
+        exp_count = int(alive.sum())
+        got_count = int(float(
+            dev["aggregationResults"][1]["value"]))
+        assert got_count == exp_count
+    finally:
+        seg.valid_doc_ids = None
+
+
+def test_join_empty_dim_side(join_fixture):
+    segments, dim, _fact = join_fixture
+    q = ("SELECT COUNT(*) FROM lineorderj JOIN part "
+         "ON lineorderj.lo_partkey = part.p_partkey")
+    request = compile_pql(q)
+    ctx = jmod.JoinContext(request.join, np.zeros(0, np.int64), {})
+    req = _attach(request, ctx)
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    for dev in (False, True):
+        out = _reduce(request, ServerQueryExecutor(use_device=dev)
+                      .execute(req, segments))
+        assert float(out["aggregationResults"][0]["value"]) == 0
+
+
+def test_join_context_typed_errors():
+    spec = JoinSpec(dim_table="part", fact_key="k", dim_key="pk")
+    with pytest.raises(StageCompileError):      # duplicate dim keys
+        jmod.JoinContext(spec, np.array([1, 2, 2], np.int64), {})
+    with pytest.raises(StageCompileError):      # non-integer keys
+        jmod.JoinContext(spec, np.array(["a", "b"], dtype=object), {})
+    ctx = jmod.JoinContext(spec, np.array([3, 1, 7], np.int64), {})
+    with pytest.raises(StageCompileError):      # unshipped dim column
+        ctx.dim_values("missing")
+    hit, dimrow = ctx.probe_values(np.array([1, 2, 7]))
+    assert hit.tolist() == [True, False, True]
+    assert dimrow[hit].tolist() == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Window functions
+# ---------------------------------------------------------------------------
+
+
+def _window_request(sum_col="v"):
+    return compile_pql(
+        f"SELECT g, o, ROW_NUMBER() OVER (PARTITION BY g ORDER BY o), "
+        f"SUM({sum_col}) OVER (PARTITION BY g ORDER BY o) FROM t "
+        f"LIMIT 100000")
+
+
+def test_window_parity_device_vs_host():
+    rng = np.random.default_rng(11)
+    n = 3000
+    cols = {"g": rng.integers(0, 13, n).astype(np.int64),
+            "o": rng.integers(0, 500, n).astype(np.int64),
+            "v": rng.integers(-50, 50, n).astype(np.int64)}
+    req = _window_request()
+    dev = wmod.execute_window(req, dict(cols), n, use_device=True)
+    host = wmod.execute_window(req, dict(cols), n, use_device=False)
+    for a, b in zip(dev.selection_cols, host.selection_cols):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # semantic invariants vs a straightforward pandas-style oracle
+    dcols = {c: np.asarray(v) for c, v in
+             zip(dev.selection_columns, dev.selection_cols)}
+    rn = dcols["row_number()_over"]
+    run = dcols["sum(v)_over"]
+    g, o, v = dcols["g"], dcols["o"], dcols["v"] if "v" in dcols else None
+    # per-partition: rn is 1..count in order, running sum telescopes
+    for gv in np.unique(g):
+        rows = np.nonzero(g == gv)[0]
+        assert rn[rows].tolist() == list(range(1, len(rows) + 1))
+        assert (np.diff(o[rows]) >= 0).all()
+    total = {gv: cols["v"][cols["g"] == gv].sum()
+             for gv in np.unique(cols["g"])}
+    for gv in np.unique(g):
+        rows = np.nonzero(g == gv)[0]
+        assert run[rows][-1] == total[gv]
+
+
+def test_window_string_partition_and_desc_order():
+    n = 500
+    rng = np.random.default_rng(3)
+    cols = {"g": np.array([f"t{int(i)}" for i in rng.integers(0, 4, n)],
+                          dtype=object),
+            "o": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.integers(0, 9, n).astype(np.int64)}
+    req = compile_pql(
+        "SELECT g, o, ROW_NUMBER() OVER (PARTITION BY g ORDER BY o "
+        "DESC), SUM(v) OVER (PARTITION BY g ORDER BY o DESC) FROM t "
+        "LIMIT 100000")
+    dev = wmod.execute_window(req, dict(cols), n, use_device=True)
+    host = wmod.execute_window(req, dict(cols), n, use_device=False)
+    for a, b in zip(dev.selection_cols, host.selection_cols):
+        assert np.array_equal(np.asarray(a, dtype=object),
+                              np.asarray(b, dtype=object))
+    o = np.asarray(dev.selection_cols[1])
+    g = np.asarray(dev.selection_cols[0], dtype=object)
+    for gv in np.unique(g):
+        assert (np.diff(o[g == gv]) <= 0).all()    # DESC within partition
+
+
+def test_window_typed_errors():
+    req = _window_request()
+    # float sum argument
+    cols = {"g": np.zeros(4, np.int64), "o": np.arange(4),
+            "v": np.ones(4, np.float64)}
+    with pytest.raises(StageCompileError):
+        wmod.execute_window(req, cols, 4, use_device=False)
+    # int32 overflow guard
+    cols["v"] = np.full(4, 2 ** 40, dtype=np.int64)
+    with pytest.raises(StageCompileError):
+        wmod.execute_window(req, cols, 4, use_device=False)
+    # mixed frames
+    mixed = compile_pql(
+        "SELECT g, ROW_NUMBER() OVER (PARTITION BY g ORDER BY o), "
+        "SUM(v) OVER (ORDER BY o) FROM t LIMIT 10")
+    with pytest.raises(StageCompileError):
+        wmod.execute_window(mixed, {"g": np.zeros(1, np.int64),
+                                    "o": np.zeros(1, np.int64),
+                                    "v": np.zeros(1, np.int64)}, 1,
+                            use_device=False)
+    # row cap
+    with pytest.raises(StageCompileError):
+        wmod.execute_window(req, {}, wmod.WINDOW_CAP + 1,
+                            use_device=False)
+
+
+# ---------------------------------------------------------------------------
+# HLL registers: host/device/sharded identity (the sketch contract)
+# ---------------------------------------------------------------------------
+
+
+def test_hll_registers_identical_and_associative():
+    from pinot_tpu.common.sketches import HyperLogLog, hll_tables
+    rng = np.random.default_rng(8)
+    values = np.unique(rng.integers(0, 10_000, 2000))
+    # device-kernel emulation: scatter-max of the shared tables over an
+    # arbitrary subset == from_values of that subset, registers equal
+    idx, rank = hll_tables(values)
+    subset = np.zeros(len(values), dtype=bool)
+    subset[rng.integers(0, len(values), 700)] = True
+    regs = np.zeros(1 << 12, np.int32)
+    np.maximum.at(regs, idx[subset], rank[subset])
+    direct = HyperLogLog.from_values(values[subset])
+    assert np.array_equal(regs.astype(np.uint8), direct.registers)
+    # associativity: split-merge == whole
+    half = len(values) // 2
+    merged = HyperLogLog.from_values(values[:half]).merge(
+        HyperLogLog.from_values(values[half:]))
+    assert merged == HyperLogLog.from_values(values)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end (embedded cluster): broadcast + co-partitioned joins,
+# windows, cache bypass/invalidation, typed errors over the wire
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def join_cluster(tmp_path_factory):
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    base = str(tmp_path_factory.mktemp("jcluster"))
+    fact_dirs, dim_dirs, dim, fact = build_join_table_dirs(
+        os.path.join(base, "segs"), fact_rows=8000, num_fact_segments=2,
+        dim_rows=300, seed=2)
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=2)
+    cluster.add_schema(fact_join_schema())
+    cluster.add_schema(part_dim_schema())
+    fc, dc = join_table_configs()
+    cluster.add_table(fc)
+    cluster.add_table(dc)
+    for d in fact_dirs:
+        cluster.upload_segment("lineorderj_OFFLINE", d)
+    for d in dim_dirs:
+        cluster.upload_segment("part_OFFLINE", d)
+    yield cluster, dim, fact
+    cluster.stop()
+
+
+def test_e2e_broadcast_join_exact(join_cluster):
+    cluster, dim, fact = join_cluster
+    r = cluster.query(
+        "SELECT SUM(lineorderj.lo_revenue), COUNT(*) FROM lineorderj "
+        "JOIN part ON lineorderj.lo_partkey = part.p_partkey "
+        "WHERE part.p_category = 'MFGR#11'")
+    assert not r.exceptions
+    o = join_oracle(dim, fact,
+                    dim_filter=lambda d: d["p_category"] == "MFGR#11")
+    assert float(r.aggregation_results[0].value) == float(o["sum_revenue"])
+    assert int(float(r.aggregation_results[1].value)) == o["count"]
+
+
+def test_e2e_window_deterministic(join_cluster):
+    cluster, _dim, _fact = join_cluster
+    q = ("SELECT d_year, lo_revenue, ROW_NUMBER() OVER (PARTITION BY "
+         "d_year ORDER BY lo_revenue DESC), SUM(lo_revenue) OVER "
+         "(PARTITION BY d_year ORDER BY lo_revenue DESC) "
+         "FROM lineorderj WHERE lo_quantity = 2 LIMIT 50")
+    r1 = cluster.query(q)
+    r2 = cluster.query(q)
+    assert not r1.exceptions
+    assert r1.selection_results.results == r2.selection_results.results
+    rows = r1.selection_results.results
+    assert rows, "window query returned no rows"
+    # rank restarts at 1 per partition, revenue descends within it
+    seen = {}
+    for year, rev, rn, run in rows:
+        prev = seen.get(year)
+        if prev is None:
+            assert rn == 1 and run == rev
+        else:
+            assert rn == prev[0] + 1 and run == prev[1] + rev
+            assert rev <= prev[2]
+        seen[year] = (rn, run, rev)
+
+
+def test_e2e_join_bypasses_result_caches(join_cluster, monkeypatch):
+    """Multi-stage queries must never populate broker/server result
+    caches (their fingerprints don't cover the dim side)."""
+    cluster, _dim, _fact = join_cluster
+    broker_cache = cluster.broker.result_cache
+    q = ("SELECT COUNT(*) FROM lineorderj JOIN part "
+         "ON lineorderj.lo_partkey = part.p_partkey")
+    before = len(getattr(broker_cache, "_store", {}))
+    r1 = cluster.query(q)
+    r2 = cluster.query(q)
+    assert r1.aggregation_results[0].value == \
+        r2.aggregation_results[0].value
+    assert len(getattr(broker_cache, "_store", {})) == before
+    for server in cluster.servers.values():
+        assert len(server.result_cache) == 0
+
+
+def test_e2e_join_result_tracks_dim_changes(tmp_path):
+    """The invalidation regression: a join answer must change when the
+    DIM table changes, even with both result caches enabled."""
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.tools.datagen import make_join_rows
+    from pinot_tpu.segment.creator import SegmentCreator
+    base = str(tmp_path)
+    dim, fact = make_join_rows(3000, dim_rows=100, seed=4)
+    fc, dc = join_table_configs()
+    fdir = os.path.join(base, "f0")
+    SegmentCreator(fact_join_schema(), fc,
+                   segment_name="factj_0").build(fact, fdir)
+    half = {c: v[:50] for c, v in dim.items()}
+    ddir = os.path.join(base, "d0")
+    SegmentCreator(part_dim_schema(), dc,
+                   segment_name="partd_0").build(half, ddir)
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=1,
+                              cache_freshness_ms=3600_000.0)
+    try:
+        cluster.broker.cache_offline = True     # broker cache armed
+        cluster.add_schema(fact_join_schema())
+        cluster.add_schema(part_dim_schema())
+        cluster.add_table(fc)
+        cluster.add_table(dc)
+        cluster.upload_segment("lineorderj_OFFLINE", fdir)
+        cluster.upload_segment("part_OFFLINE", ddir)
+        q = ("SELECT COUNT(*) FROM lineorderj JOIN part "
+             "ON lineorderj.lo_partkey = part.p_partkey")
+        c1 = int(float(cluster.query(q).aggregation_results[0].value))
+        o1 = join_oracle(half, fact)["count"]
+        assert c1 == o1
+        # grow the dim table: the join must see it on the NEXT query
+        rest = {c: v[50:] for c, v in dim.items()}
+        ddir2 = os.path.join(base, "d1")
+        SegmentCreator(part_dim_schema(), dc,
+                       segment_name="partd_1").build(rest, ddir2)
+        cluster.upload_segment("part_OFFLINE", ddir2)
+        c2 = int(float(cluster.query(q).aggregation_results[0].value))
+        assert c2 == join_oracle(dim, fact)["count"]
+        assert c2 > c1
+    finally:
+        cluster.stop()
+
+
+def test_e2e_copartitioned_join_exact_and_filtered(tmp_path):
+    """Partition-aligned tables: results stay exact AND the stage-2
+    fetch provably skips disjoint-partition sources."""
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    base = str(tmp_path)
+    fact_dirs, dim_dirs, dim, fact = build_join_table_dirs(
+        os.path.join(base, "segs"), fact_rows=6000, num_fact_segments=4,
+        dim_rows=200, seed=6, num_partitions=4)
+    cluster = EmbeddedCluster(os.path.join(base, "c"), num_servers=2)
+    try:
+        cluster.add_schema(fact_join_schema())
+        cluster.add_schema(part_dim_schema())
+        fc, dc = join_table_configs(num_partitions=4)
+        cluster.add_table(fc)
+        cluster.add_table(dc)
+        for d in fact_dirs:
+            cluster.upload_segment("lineorderj_OFFLINE", d)
+        for d in dim_dirs:
+            cluster.upload_segment("part_OFFLINE", d)
+        r = cluster.query(
+            "SELECT SUM(lineorderj.lo_revenue), COUNT(*) FROM "
+            "lineorderj JOIN part ON lineorderj.lo_partkey = "
+            "part.p_partkey GROUP BY part.p_mfgr TOP 100")
+        assert not r.exceptions
+        o = join_oracle(dim, fact, group_cols=["part.p_mfgr"])
+        got = {g["group"][0]: float(g["value"])
+               for g in r.aggregation_results[0].group_by_result}
+        assert got == {k[0]: float(v[0]) for k, v in o["groups"].items()}
+        # the per-segment partition metadata is discriminating: a
+        # single-partition fact server must skip disjoint dim sources
+        segs = _load_segments([fact_dirs[0]])
+        fp = jmod.fact_partition_info(segs, "lo_partkey")
+        assert fp is not None and fp[0] == "Modulo" and fp[1] == 4
+        sources = [{"server": "s", "id": f"x{p}", "partitions": [p],
+                    "partitionFunction": "Modulo", "numPartitions": 4}
+                   for p in range(4)]
+        kept, skipped = jmod.filter_sources(sources, fp)
+        assert skipped == 4 - len(fp[2])
+        assert {s["partitions"][0] for s in kept} == fp[2]
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.parametrize("bad,code", [
+    ("SELECT COUNT(*) FROM lineorderj JOIN ghost "
+     "ON lineorderj.lo_partkey = ghost.k", 190),
+    ("SELECT COUNT(*) FROM lineorderj JOIN part "
+     "ON lineorderj.lo_partkey = part.p_brand1", 422),     # type mismatch
+    ("SELECT COUNT(*) FROM lineorderj JOIN part "
+     "ON lineorderj.p_partkey = part.lo_partkey", 422),    # swapped cols
+])
+def test_e2e_typed_stage_errors(join_cluster, bad, code):
+    cluster, _dim, _fact = join_cluster
+    r = cluster.query(bad)
+    assert r.exceptions, "expected a typed error"
+    assert r.exceptions[0]["errorCode"] == code
+    assert r.aggregation_results in (None, [])
+
+
+def test_e2e_unknown_dim_column_is_empty_not_crash(join_cluster):
+    """An unknown dim column follows the engine's unknown-column
+    semantics (schema pruner → empty scan → empty join) — never a
+    broker crash."""
+    cluster, _dim, _fact = join_cluster
+    r = cluster.query(
+        "SELECT COUNT(*) FROM lineorderj JOIN part "
+        "ON lineorderj.lo_partkey = part.p_partkey "
+        "GROUP BY part.nosuch TOP 10")
+    assert r.aggregation_results[0].group_by_result in (None, [])
+
+
+def test_e2e_dim_capacity_typed_error(join_cluster, monkeypatch):
+    cluster, _dim, _fact = join_cluster
+    from pinot_tpu.query.stages import broker as stages_broker
+    monkeypatch.setattr(stages_broker, "DIM_CAP", 10)
+    r = cluster.query(
+        "SELECT COUNT(*) FROM lineorderj JOIN part "
+        "ON lineorderj.lo_partkey = part.p_partkey")
+    assert r.exceptions
+    assert r.exceptions[0]["errorCode"] == 422
+
+
+def test_raw_key_join_with_unrepresentable_dim_keys_is_empty(tmp_path):
+    """Review regression: dim keys outside the raw fact dtype's range
+    drop to an EMPTY join (the raw twin of the all-False member
+    vector), never a TypeError on padded_keys() returning None."""
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.tools.datagen import make_join_rows
+    _dim, fact = make_join_rows(500, dim_rows=50, seed=14)
+    cfg = TableConfig("lineorderj", indexing_config=IndexingConfig(
+        no_dictionary_columns=["lo_partkey"]))
+    d = str(tmp_path / "seg0")
+    SegmentCreator(fact_join_schema(), cfg,
+                   segment_name="rawk2_0").build(fact, d)
+    segments = _load_segments([d])
+    request = compile_pql(
+        "SELECT COUNT(*) FROM lineorderj JOIN part "
+        "ON lineorderj.lo_partkey = part.p_partkey")
+    huge = np.array([2 ** 40, 2 ** 41], dtype=np.int64)  # > int32 range
+    ctx = jmod.JoinContext(request.join, huge, {})
+    req = _attach(request, ctx)
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    for dev in (False, True):
+        out = _reduce(request, ServerQueryExecutor(use_device=dev)
+                      .execute(req, segments))
+        assert float(out["aggregationResults"][0]["value"]) == 0
+
+
+def test_window_per_partition_overflow_bound():
+    """Review regression: the int32 guard is PER PARTITION — a query
+    whose global abs-sum exceeds 2^31 but whose partitions each fit
+    must run (and stay host/device bit-identical)."""
+    n = 2000
+    rng = np.random.default_rng(5)
+    cols = {"g": np.arange(n) % 100,          # 100 partitions
+            "o": rng.integers(0, 9, n).astype(np.int64),
+            "v": np.full(n, 2_000_000, dtype=np.int64)}
+    assert int(np.abs(cols["v"]).sum()) >= 2 ** 31        # global over
+    req = _window_request()
+    dev = wmod.execute_window(req, dict(cols), n, use_device=True)
+    host = wmod.execute_window(req, dict(cols), n, use_device=False)
+    for a, b in zip(dev.selection_cols, host.selection_cols):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # but one partition over the bound still rejects
+    cols["g"] = np.zeros(n, dtype=np.int64)
+    with pytest.raises(StageCompileError):
+        wmod.execute_window(req, dict(cols), n, use_device=False)
+
+
+def test_exchange_put_ttl_tracks_query_deadline():
+    clock = [0.0]
+    m = xmod.ExchangeManager(ttl_s=120.0, clock=lambda: clock[0])
+    try:
+        m.put("short", b"x", ttl_s=5.0)
+        m.put("default", b"y")
+        clock[0] = 6.0
+        assert m.get("short") is None          # expired with its query
+        assert m.get("default") == b"y"        # manager default TTL
+    finally:
+        m.close()
+
+
+def test_stage_busy_reply_keeps_503_classification():
+    from pinot_tpu.query.stages.broker import _busy_error
+    from pinot_tpu.server.admission import busy_datatable
+    dt = busy_datatable(1, "overload", 250.0)
+    err = _busy_error("srv", dt, "stage-1 scan")
+    assert err is not None
+    assert err["busyCause"] == "overload"
+    assert err["retryAfterMs"] == 250.0
+    assert "errorCode" not in err       # _finish derives 503 from cause
+    from pinot_tpu.common.datatable import DataTable
+    assert _busy_error("srv", DataTable(), "x") is None
+
+
+def test_wire_schema_pins_exchange_frame():
+    from pinot_tpu.analysis.contracts import wire_schema
+    schema = wire_schema()
+    assert schema["exchangeFrame"]["magic"] == "XCHG"
+    assert schema["exchangeFrame"]["fetchKeys"] == ["id", "op"]
+    assert "exchangePartitions" in \
+        schema["exchangeFrame"]["ackMetadataKeys"]
+    opt = schema["instanceRequest"]["optional"]
+    assert "publishExchange" in opt and "exchangeSources" in opt
+
+
+def test_fingerprint_covers_join_and_windows():
+    from pinot_tpu.query.fingerprint import query_fingerprint
+    a = compile_pql("SELECT COUNT(*) FROM f JOIN d ON f.k = d.j")
+    b = compile_pql("SELECT COUNT(*) FROM f JOIN d ON f.k = d.j2")
+    c = compile_pql("SELECT COUNT(*) FROM f")
+    assert len({query_fingerprint(x) for x in (a, b, c)}) == 3
+    w1 = compile_pql("SELECT a, ROW_NUMBER() OVER (ORDER BY b) FROM f "
+                     "LIMIT 5")
+    w2 = compile_pql("SELECT a, ROW_NUMBER() OVER (ORDER BY c) FROM f "
+                     "LIMIT 5")
+    assert query_fingerprint(w1) != query_fingerprint(w2)

@@ -602,8 +602,12 @@ def test_kill_during_seal_restart_converges(work_dir, crash_point):
     c2 = EmbeddedCluster(work_dir, num_servers=1,
                          store_dir=os.path.join(work_dir, "store"))
     try:
+        # 120s like test_restart_does_not_rewind_before_snapshot_offset:
+        # kill-restart re-consumption is load-sensitive on a shared CI
+        # box; the convergence CONTRACT lives in the exact-count/value
+        # assertions, not the wait
         assert wait_until(lambda: _converged(c2, exp_cnt, exp_sum),
-                          timeout=60), \
+                          timeout=120), \
             (count_and_sum(c2), exp_cnt, exp_sum)
         _assert_latest_values(c2, latest)
     finally:
@@ -653,7 +657,7 @@ def test_kill_during_post_restart_replay_converges(work_dir):
                          store_dir=os.path.join(work_dir, "store"))
     try:
         assert wait_until(lambda: _converged(c3, exp_cnt, exp_sum),
-                          timeout=60), \
+                          timeout=120), \
             (count_and_sum(c3), exp_cnt, exp_sum)
         _assert_latest_values(c3, latest)
     finally:
