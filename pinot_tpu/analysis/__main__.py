@@ -10,6 +10,11 @@ contracts over the registered kernel surface and the wire-schema gate
 against the committed `wire-schema.json` (regenerate the latter
 INTENTIONALLY with `--write-wire-schema`).
 
+`--lifecycle` runs the resource-lifecycle tier per file: `device-ledger`
+(every device upload on the serving path must route through
+obs/residency.py) and `cache-bound` (every query-path cache must carry a
+structural bound).
+
 `--protocol` runs the protocol tier: durability-ordering and
 crash-coverage over the durable writers, the metrics exposition
 contract, and the exhaustive crash-interleaving model checker over the
@@ -65,6 +70,12 @@ FIX_HINTS = {
     "protocol-model": "restore the protocol shape, or regenerate "
                       "protocol-model.json with --write-protocol-model "
                       "and flag the PR as a crash-protocol change",
+    "device-ledger": "route the upload through obs/residency.py "
+                     "(ledgered_put / ledgered_asarray) so the bytes "
+                     "are accounted",
+    "cache-bound": "cap the cache (LRU/size check), key it by a "
+                   "version that invalidates, or make it single-slot; "
+                   "state a genuinely extrinsic bound in a suppression",
 }
 
 
@@ -104,6 +115,11 @@ def main(argv=None) -> int:
                     help="regenerate the baseline from this run and exit 0")
     ap.add_argument("--strict-baseline", action="store_true",
                     help="also fail on stale baseline entries (CI mode)")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="also run the resource-lifecycle tier: every "
+                         "device upload routed through the residency "
+                         "ledger, every query-path cache structurally "
+                         "bounded")
     ap.add_argument("--deep", action="store_true",
                     help="also run the deep tier: jaxpr kernel contracts "
                          "+ wire-schema gate")
@@ -166,10 +182,14 @@ def main(argv=None) -> int:
     if args.rules and not args.protocol and \
             any(known[r].tier == "protocol" for r in args.rules):
         args.protocol = True        # same contract for the third tier
+    if args.rules and not args.lifecycle and \
+            any(known[r].tier == "lifecycle" for r in args.rules):
+        args.lifecycle = True       # and the fourth
 
     result = runner.analyze_paths(
         args.paths, rule_ids=set(args.rules) if args.rules else None,
-        deep=args.deep, protocol=args.protocol)
+        deep=args.deep, protocol=args.protocol,
+        lifecycle=args.lifecycle)
     for err in result.errors:
         print(f"tpulint: error: {err}", file=sys.stderr)
 
@@ -231,12 +251,19 @@ def main(argv=None) -> int:
     n_grandfathered = len(result.findings) - len(new)
     by_rule = ", ".join(f"{r}={n}" for r, n in
                         sorted(result.by_rule().items())) or "none"
-    tier = "+".join(["fast"] + (["deep"] if args.deep else []) +
+    tier = "+".join(["fast"] +
+                    (["lifecycle"] if args.lifecycle else []) +
+                    (["deep"] if args.deep else []) +
                     (["protocol"] if args.protocol else []))
     print(f"tpulint[{tier}]: {len(result.findings)} finding(s) "
           f"[{by_rule}], {len(new)} new, {n_grandfathered} "
           f"grandfathered, {len(result.suppressed)} suppressed, "
           f"{len(stale)} stale baseline entr(ies)")
+    if result.timings:
+        shown = {"ast": "fast"}
+        print("tpulint: tier wall time: " +
+              " ".join(f"{shown.get(t, t)}={s:.2f}s"
+                       for t, s in sorted(result.timings.items())))
     if new or result.errors or (stale and args.strict_baseline):
         if new:
             _print_failure_summary(new, result.errors)

@@ -67,11 +67,14 @@ OPTIONS: Dict[str, object] = {"max_states": 200_000}
 class Rule:
     """One rule family. Subclasses set `id`/`description`, yield Findings.
 
-    `tier` is "ast" (per-file, runs always), "deep" (global, runs only
-    under `--deep`: kernel tracing, wire schema), or "protocol" (global,
-    runs only under `--protocol`: durability ordering, crash coverage,
-    metrics contract, the crash-interleaving model checker). Global
-    tiers implement `check_global()` instead of `check()`.
+    `tier` is "ast" (per-file, runs always), "lifecycle" (per-file,
+    runs only under `--lifecycle`: device-upload ledger routing,
+    query-path cache bounds — still `check(ctx)` rules, so suppressions
+    and fixtures work exactly like the fast tier), "deep" (global, runs
+    only under `--deep`: kernel tracing, wire schema), or "protocol"
+    (global, runs only under `--protocol`: durability ordering, crash
+    coverage, metrics contract, the crash-interleaving model checker).
+    Global tiers implement `check_global()` instead of `check()`.
     """
 
     id: str = ""

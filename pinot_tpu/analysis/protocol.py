@@ -47,6 +47,18 @@ walking the statements in source order:
   tombstoning, and the intent clear — in source order, so reordering
   the folds (serve-both window) or the tombstone (delete-before-swap)
   produces a counterexample, not a parse error.
+- ``exchange`` — `ExchangeManager.put`/`get`/`_sweep` (stages/
+  exchange.py) plus the stage-1 publish epilogue
+  `ServerInstance._maybe_publish` (server/instance.py): the put-scope
+  sweep, the replaced-entry credit, the budget overflow compare, the
+  store/debit/ledger-register writes, the get-scope sweep + read, the
+  sweep's evict + ledger release, and the publish→ack site order — in
+  whatever order the SOURCE has them. The model runs publisher x
+  fetcher x TTL sweeper x crash-at-every-step; lock flags
+  (`locked_put`/`locked_get`) decide whether put/get execute
+  atomically or micro-step-interleaved, so deleting the lock or
+  reordering credit/compare produces a counterexample, not a parse
+  error.
 
 Step SEMANTICS are bound here by step name; step ORDER and the
 discipline flags come from the source. A protocol edit that preserves
@@ -201,6 +213,8 @@ TAKEOVER_PATH = "pinot_tpu/controller/realtime_manager.py"
 SEAL_PATH = "pinot_tpu/realtime/upsert.py"
 DRAIN_PATH = "pinot_tpu/tools/distributed.py"
 COMPACT_PATH = "pinot_tpu/controller/compaction.py"
+XCHG_PATH = "pinot_tpu/query/stages/exchange.py"
+XCHG_SITE_PATH = "pinot_tpu/server/instance.py"
 
 
 def extract_lease(sources: Optional[Dict[str, str]] = None) -> Extraction:
@@ -496,11 +510,149 @@ def extract_compact(sources: Optional[Dict[str, str]] = None
     return ex
 
 
+def _uses_lock(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if "_lock" in _u(item.context_expr):
+                    return True
+    return False
+
+
+def extract_exchange(sources: Optional[Dict[str, str]] = None
+                     ) -> Extraction:
+    src = _load(XCHG_PATH, sources)
+    tree = ast.parse(src)
+    put_fn = _find_def(tree, "ExchangeManager.put")
+    get_fn = _find_def(tree, "ExchangeManager.get")
+    sweep_fn = _find_def(tree, "ExchangeManager._sweep")
+    steps = _extract_steps(put_fn, [
+        ("put.sweep", lambda n: _is_call_containing(n, "._sweep(")),
+        # the replaced-entry credit: held = self._bytes - len(old...)
+        ("put.credit_replaced", lambda n: isinstance(n, ast.Assign)
+         and "self._bytes" in _u(n.value) and "old" in _u(n.value)),
+        ("put.overflow_check", lambda n: isinstance(n, ast.Compare)
+         and "max_bytes" in _u(n)),
+        ("put.store", lambda n: isinstance(n, ast.Assign)
+         and "._store[" in _u(n.targets[0])),
+        ("put.debit", lambda n: isinstance(n, ast.Assign)
+         and _u(n.targets[0]) == "self._bytes"),
+        ("put.ledger_register",
+         lambda n: _is_call_containing(n, "LEDGER.register(")),
+    ])
+    steps += _extract_steps(get_fn, [
+        ("get.sweep", lambda n: _is_call_containing(n, "._sweep(")),
+        ("get.read", lambda n: _is_call_containing(n, "._store.get(")),
+    ])
+    steps += _extract_steps(sweep_fn, [
+        ("sweep.evict", lambda n: _is_call_containing(
+            n, "._store.pop(")),
+        ("sweep.ledger_release",
+         lambda n: _is_call_containing(n, "LEDGER.release(")),
+    ])
+    ex = Extraction("exchange", XCHG_PATH, "ExchangeManager.put", steps,
+                    flags={}, problems=[])
+    ex.flags["locked_put"] = _uses_lock(put_fn)
+    ex.flags["locked_get"] = _uses_lock(get_fn)
+    standalone = False
+    try:
+        se = _find_def(tree, "ExchangeManager.sweep_expired")
+        standalone = any(_is_call_containing(n, "._sweep(")
+                         for n in ast.walk(se))
+    except ExtractionError:
+        pass
+    ex.flags["standalone_sweep"] = standalone
+    try:
+        init = _find_def(tree, "ExchangeManager.__init__")
+        ex.flags["ledger_sweep_hook"] = any(
+            _is_call_containing(n, "add_sweeper")
+            for n in ast.walk(init))
+    except ExtractionError:
+        ex.flags["ledger_sweep_hook"] = False
+    try:
+        close = _find_def(tree, "ExchangeManager.close")
+        ex.flags["close_releases_ledger"] = any(
+            _is_call_containing(n, "release_prefix(")
+            for n in ast.walk(close))
+    except ExtractionError:
+        ex.flags["close_releases_ledger"] = False
+    # the typed-miss surface: handle_frame answers an unknown/expired id
+    # with an ExchangeMissError DataTable, and the fetch client converts
+    # it into a raised ExchangeError (the 422/stageError path)
+    miss_typed = False
+    try:
+        hf = _find_def(tree, "ExchangeManager.handle_frame")
+        replies = any(_is_call_containing(n, "_miss_reply(")
+                      for n in ast.walk(hf))
+        cb = _find_def(tree, "_check_block")
+        raises = any(isinstance(n, ast.Raise) and "ExchangeError" in _u(n)
+                     for n in ast.walk(cb))
+        miss_typed = replies and raises
+    except ExtractionError:
+        pass
+    ex.flags["miss_typed"] = miss_typed
+    # the publish/ack site: put must precede the ack the broker
+    # schedules stage 2 from, and an overflow must surface as the typed
+    # exchangeCapacity stageError
+    raises_typed = any(isinstance(n, ast.Raise) and
+                       "ExchangeError" in _u(n)
+                       for n in ast.walk(put_fn))
+    ack_after_put = False
+    site_catches = False
+    try:
+        psrc = _load(XCHG_SITE_PATH, sources)
+        site = _find_def(ast.parse(psrc),
+                         "ServerInstance._maybe_publish")
+        site_steps = _extract_steps(site, [
+            ("ack.publish_block",
+             lambda n: _is_call_containing(n, ".exchange.put(")),
+            ("ack.send_ack", lambda n: isinstance(n, ast.Assign)
+             and "exchangeId" in _u(n.targets[0])),
+        ])
+        ex.steps += site_steps
+        lines = dict(site_steps)
+        if "ack.publish_block" in lines and "ack.send_ack" in lines:
+            ack_after_put = (lines["ack.publish_block"] <
+                             lines["ack.send_ack"])
+        else:
+            ex.problems.append(
+                f"{XCHG_SITE_PATH}::_maybe_publish: publish/ack steps "
+                "not found — the stage-1 producer epilogue no longer "
+                "matches the shape contract")
+        site_catches = any(
+            isinstance(h, ast.ExceptHandler) and h.type is not None and
+            "ExchangeError" in _u(h.type) and
+            "stage_error_datatable" in _u(h)
+            for h in ast.walk(site))
+    except (ExtractionError, SyntaxError, OSError):
+        ex.problems.append(
+            f"{XCHG_SITE_PATH}: ServerInstance._maybe_publish missing — "
+            "the exchange publish/ack site cannot be extracted")
+    ex.flags["ack_after_put"] = ack_after_put
+    ex.flags["overflow_typed"] = raises_typed and site_catches
+    if not ex.flags["overflow_typed"]:
+        ex.problems.append(
+            f"{XCHG_PATH}::put: budget overflow is not surfaced as a "
+            "typed ExchangeError -> exchangeCapacity stageError — the "
+            "broker would see a transport-class failure instead of the "
+            "422 surface")
+    order = ex.step_order()
+    for required in ("put.overflow_check", "put.store", "put.debit",
+                     "get.read", "sweep.evict"):
+        if required not in order:
+            ex.problems.append(
+                f"{XCHG_PATH}: required step `{required}` not found — "
+                "the exchange shape contract no longer matches "
+                "(see docs/ANALYSIS.md, extraction contract)")
+    return ex
+
+
 def extract_all(sources: Optional[Dict[str, str]] = None
                 ) -> List[Extraction]:
     return [extract_lease(sources), extract_rebalance(sources),
             extract_takeover(sources), extract_seal(sources),
-            extract_drain(sources), extract_compact(sources)]
+            extract_drain(sources), extract_compact(sources),
+            extract_exchange(sources)]
 
 
 # ---------------------------------------------------------------------------
@@ -1310,6 +1462,279 @@ def build_compact_system(ex: Extraction) -> System:
                    ("no-swap-loss", inv_loss)])
 
 
+# -- exchange publish / ack / fetch / TTL-sweep -------------------------------
+#
+# World: ONE exchange id, byte budget 1, payloads of size 1. The
+# publisher publishes TWICE — the second put is the replace-publish
+# that exercises the credit-before-compare budget discipline (a replace
+# within the REAL occupancy must never be rejected as overflow) — and
+# acks the broker in the extracted site order. The fetcher (stage 2)
+# fetches once after the ack; the TTL sweeper is the residency-ledger
+# scrape hook (`sweep_expired`); the environment expires the entry.
+# Books tracked: the manager's held bytes AND the residency ledger's
+# exchange bytes (lreg = id currently registered). Atomicity follows
+# the extracted locks: with `locked_put`/`locked_get` the put/get
+# programs run as single actions; without, every micro-step interleaves
+# and crash lands between micro-steps — deleting the lock turns into a
+# torn-books or half-published-read counterexample, not silence.
+#
+# State: (entry, bytes, ledger, lreg, cred, acked, expired_ever,
+#         pub, fet, half, ras, silent, spur)
+#   entry  0 absent / 1 live / 2 expired (TTL passed, not yet swept)
+#   cred   the publisher's in-flight `held` credit local (dies with
+#          the put call frame)
+#   half   latched: fetch observed a half-published entry / acked-but-
+#          unpublished id
+#   ras    latched: fetch returned payload for an EXPIRED entry
+#   silent latched: miss produced a silent empty result, not the typed
+#          ExchangeMissError surface
+#   spur   latched: within-budget replace-publish rejected as overflow
+
+_X_KEYS = ("entry", "bytes", "ledger", "lreg", "cred", "acked",
+           "expired_ever", "pub", "fet", "half", "ras", "silent",
+           "spur")
+_X_MAX_BYTES = 1
+
+
+def _x_dict(s: tuple) -> dict:
+    return dict(zip(_X_KEYS, s))
+
+
+def _x_tuple(d: dict) -> tuple:
+    return tuple(d[k] for k in _X_KEYS)
+
+
+def build_exchange_system(ex: Extraction) -> System:
+    order = ex.step_order()
+    put_order = [s for s in order if s.startswith("put.")]
+    get_order = [s for s in order if s.startswith("get.")]
+    locked_put = ex.flags.get("locked_put", True)
+    locked_get = ex.flags.get("locked_get", True)
+    standalone = ex.flags.get("standalone_sweep", True)
+    miss_typed = ex.flags.get("miss_typed", True)
+    ack_after_put = ex.flags.get("ack_after_put", True)
+    sweep_evicts = "sweep.evict" in order
+    sweep_releases = "sweep.ledger_release" in order
+
+    def do_sweep(d: dict) -> None:
+        if sweep_evicts and d["entry"] == 2:
+            d["entry"] = 0
+            d["bytes"] -= 1
+            if sweep_releases and d["lreg"]:
+                d["ledger"] -= 1
+                d["lreg"] = 0
+
+    def op_put(name):
+        def fn(d):
+            if name == "put.sweep":
+                do_sweep(d)
+            elif name == "put.credit_replaced":
+                d["cred"] = 1 if d["entry"] else 0
+            elif name == "put.overflow_check":
+                if d["bytes"] - d["cred"] + 1 > _X_MAX_BYTES:
+                    real = d["bytes"] - (1 if d["entry"] else 0)
+                    if real + 1 <= _X_MAX_BYTES:
+                        d["spur"] = 1   # real occupancy admitted it
+                    d["abort"] = 1      # typed raise: books untouched
+            elif name == "put.store":
+                d["entry"] = 1
+            elif name == "put.debit":
+                d["bytes"] = d["bytes"] - d["cred"] + 1
+            elif name == "put.ledger_register":
+                if not d["lreg"]:       # owner-replace: no double count
+                    d["ledger"] += 1
+                    d["lreg"] = 1
+        return fn
+
+    # the publisher program: macros of (label, ops, abort_to) — with
+    # the lock an attempt is ONE atomic macro; without, each extracted
+    # micro-step is its own macro and `abort_to` jumps past the attempt
+    pub_macros: List[tuple] = []
+    mid_after_store: set = set()
+    boundary_pcs: set = set()
+
+    def add_attempt(tag: str) -> None:
+        start = len(pub_macros)
+        if locked_put:
+            pub_macros.append(
+                (f"{tag}.put", [op_put(n) for n in put_order],
+                 start + 1))
+            return
+        end = start + len(put_order)
+        for n in put_order:
+            pub_macros.append((f"{tag}.{n}", [op_put(n)], end))
+        if "put.store" in put_order:
+            si = put_order.index("put.store")
+            mid_after_store.update(range(start + si + 1, end))
+
+    def op_ack(d):
+        d["acked"] = 1
+
+    if ack_after_put:
+        add_attempt("pub1")
+        pub_macros.append(("pub.send_ack", [op_ack], None))
+        add_attempt("pub2")
+    else:
+        pub_macros.append(("pub.send_ack", [op_ack], None))
+        add_attempt("pub1")
+        add_attempt("pub2")
+    p_end = len(pub_macros)
+    boundary_pcs.update(i for i in range(p_end + 1)
+                        if i not in mid_after_store)
+
+    def op_get(name):
+        def fn(d):
+            if name == "get.sweep":
+                do_sweep(d)
+            elif name == "get.read":
+                if d["entry"] == 1:
+                    if d["pub"] in mid_after_store:
+                        d["half"] = 1
+                elif d["entry"] == 2:
+                    d["ras"] = 1        # returned an expired payload
+                elif d["acked"] and not d["expired_ever"]:
+                    d["half"] = 1       # acked id not yet published
+                elif not miss_typed:
+                    d["silent"] = 1
+        return fn
+
+    if locked_get:
+        fet_macros = [("fet.get", [op_get(n) for n in get_order])]
+    else:
+        fet_macros = [(f"fet.{n}", [op_get(n)]) for n in get_order]
+    f_end = len(fet_macros)
+
+    init = _x_tuple(dict.fromkeys(_X_KEYS, 0))
+
+    def pub_step(idx, label, ops, abort_to):
+        def enabled(s):
+            return s[7] == idx
+
+        def apply(s):
+            d = _x_dict(s)
+            aborted = False
+            for fn in ops:
+                fn(d)
+                if d.pop("abort", 0):
+                    aborted = True
+                    break
+            if aborted:
+                d["cred"] = 0
+                d["pub"] = abort_to if abort_to is not None else idx + 1
+            else:
+                d["pub"] = idx + 1
+                if abort_to is not None and d["pub"] >= abort_to:
+                    d["cred"] = 0       # put frame returned
+            return _x_tuple(d)
+        return Action(label, enabled, apply)
+
+    def fet_step(idx, label, ops):
+        def enabled(s):
+            return s[5] == 1 and s[8] == idx
+
+        def apply(s):
+            d = _x_dict(s)
+            for fn in ops:
+                fn(d)
+            d["fet"] = idx + 1
+            return _x_tuple(d)
+        return Action(label, enabled, apply)
+
+    actions = [pub_step(i, label, ops, abort_to)
+               for i, (label, ops, abort_to) in enumerate(pub_macros)]
+    actions += [fet_step(i, label, ops)
+                for i, (label, ops) in enumerate(fet_macros)]
+
+    def pub_crash(s):
+        d = _x_dict(s)
+        d["pub"], d["cred"] = p_end, 0
+        return _x_tuple(d)
+
+    def fet_crash(s):
+        d = _x_dict(s)
+        d["fet"] = f_end
+        return _x_tuple(d)
+
+    actions.append(Action("pub.crash", lambda s: s[7] < p_end,
+                          pub_crash))
+    actions.append(Action("fet.crash", lambda s: s[8] < f_end,
+                          fet_crash))
+
+    if standalone:
+        def sweep_apply(s):
+            d = _x_dict(s)
+            do_sweep(d)
+            return _x_tuple(d)
+        actions.append(Action("swp.sweep_expired",
+                              lambda s: s[0] == 2, sweep_apply))
+
+    def expire(s):
+        d = _x_dict(s)
+        d["entry"], d["expired_ever"] = 2, 1
+        return _x_tuple(d)
+
+    actions.append(Action("env.ttl_expires", lambda s: s[0] == 1,
+                          expire))
+
+    def inv_half(s):
+        if s[9]:
+            return ("a fetch observed a half-published exchange entry "
+                    "(stored but not yet byte-debited/ledger-"
+                    "registered, or the id was ACKED to the broker "
+                    "before the block was published) — stage 2 must "
+                    "never see a partial put")
+        return None
+
+    def inv_ras(s):
+        if s[10]:
+            return ("a fetch returned payload bytes for an entry whose "
+                    "TTL had already expired — get must sweep before "
+                    "reading (no-read-after-sweep)")
+        return None
+
+    def inv_silent(s):
+        if s[11]:
+            return ("an expired/unknown exchange fetch produced a "
+                    "SILENT empty result instead of the typed "
+                    "ExchangeMissError/stageError surface — a join "
+                    "side would silently vanish")
+        return None
+
+    def inv_spur(s):
+        if s[12]:
+            return ("a replace-publish within the real byte budget was "
+                    "rejected as overflow — the to-be-replaced entry "
+                    "must be credited BEFORE the budget compare "
+                    "(debit/credit imbalance)")
+        return None
+
+    def inv_books(s):
+        pub_done = s[7] >= p_end
+        fet_quiet = s[8] >= f_end or (pub_done and not s[5])
+        if s[7] in boundary_pcs and s[2] != s[1]:
+            return ("the manager's held bytes and the residency "
+                    "ledger's exchange bytes diverge outside a put "
+                    "critical section — register/release no longer "
+                    "pairs with debit/credit")
+        if pub_done and fet_quiet and s[0] == 0 and (s[1] or s[2]):
+            return ("all actors quiescent and the store empty, but "
+                    "held/ledger bytes are nonzero — the exchange "
+                    "leaks budget (bytes-conservation)")
+        if pub_done and fet_quiet and s[0] == 2 and not standalone:
+            return ("an expired entry survives quiescence with no "
+                    "standalone sweep path (sweep only runs inside "
+                    "put/get) — held bytes leak until process death")
+        return None
+
+    return System("exchange", ex.path, ex.line_of("put.store"), init,
+                  actions,
+                  [("no-half-published-read", inv_half),
+                   ("no-read-after-sweep", inv_ras),
+                   ("expired-fetch-is-typed", inv_silent),
+                   ("no-spurious-overflow", inv_spur),
+                   ("bytes-conservation", inv_books)])
+
+
 _BUILDERS = {
     "lease": build_lease_system,
     "rebalance": build_rebalance_system,
@@ -1317,6 +1742,7 @@ _BUILDERS = {
     "upsert-seal": build_seal_system,
     "drain": build_drain_system,
     "compact-swap": build_compact_system,
+    "exchange": build_exchange_system,
 }
 
 

@@ -3,8 +3,8 @@ from pinot_tpu.analysis.rules import (api_compat, async_safety,
                                       concurrency, deep, dtype_drift,
                                       durability, host_sync, lock_order,
                                       metrics_contract, protocol_check,
-                                      retrace)
+                                      residency, retrace)
 
 __all__ = ["api_compat", "async_safety", "concurrency", "deep",
            "dtype_drift", "durability", "host_sync", "lock_order",
-           "metrics_contract", "protocol_check", "retrace"]
+           "metrics_contract", "protocol_check", "residency", "retrace"]

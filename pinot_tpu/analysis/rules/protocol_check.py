@@ -1,12 +1,13 @@
 """protocol-invariants / protocol-model: the crash-interleaving gates.
 
-`protocol-invariants` extracts the six protocol transition systems
+`protocol-invariants` extracts the seven protocol transition systems
 (lease/epoch fencing, rebalance add-then-prune, realtime takeover,
 upsert seal/snapshot/truncate, graceful drain, compaction/merge
-segment swap — see analysis/protocol.py) from the LIVE source and
-exhaustively explores every interleaving of their steps, environment
-events, and crash-at-every-step placements, machine-checking the
-written ROBUSTNESS.md invariants:
+segment swap, exchange publish/ack/fetch/TTL-sweep — see
+analysis/protocol.py) from the LIVE source and exhaustively explores
+every interleaving of their steps, environment events, and
+crash-at-every-step placements, machine-checking the written
+ROBUSTNESS.md invariants:
 
 1. no double-owned partition      (takeover: `no-double-owned`,
                                    plus `no-takeover-stall`)
@@ -17,6 +18,11 @@ written ROBUSTNESS.md invariants:
 5. swap serves exactly-one        (compact-swap: `no-double-serve`,
                                    `routed-implies-artifact`,
                                    `no-swap-loss`)
+6. exchange lifecycle             (exchange: `no-half-published-read`,
+                                   `no-read-after-sweep`,
+                                   `expired-fetch-is-typed`,
+                                   `no-spurious-overflow`,
+                                   `bytes-conservation`)
 
 A violated invariant is reported WITH its counterexample trace (the
 ordered step list that reaches the bad state). Per the no-silent-caps
@@ -43,7 +49,8 @@ class ProtocolInvariantsRule(Rule):
     id = "protocol-invariants"
     description = ("exhaustive crash-interleaving model check of the "
                    "extracted lease/rebalance/takeover/upsert-seal/"
-                   "drain protocols (protocol tier)")
+                   "drain/compact-swap/exchange protocols (protocol "
+                   "tier)")
     tier = "protocol"
 
     def check(self, ctx) -> Iterator[Finding]:

@@ -1,0 +1,310 @@
+"""Resource-lifecycle tier (``--lifecycle``): HBM residency rules.
+
+Two per-file rule families guard the invariants ROADMAP item 1's
+residency manager will budget against:
+
+- ``device-ledger``: every host→device materialization on the serving
+  path must route through ``obs/residency.py`` (``ledgered_put`` /
+  ``ledgered_asarray``) so the bytes land in the process ledger. A raw
+  ``jax.device_put`` / ``jnp.asarray`` at dispatch scope is an upload
+  the ledger cannot see — exactly how "what is holding HBM" questions
+  become unanswerable. Calls INSIDE jitted functions are trace-time ops
+  (no host→device transfer of their own) and are exempt, mirroring the
+  host-sync rule's jit-scope reasoning.
+
+- ``cache-bound``: every memoization-shaped container on the query path
+  (a dict/list/set attr or module global that is both membership-read
+  and inserted into) must carry a STRUCTURAL bound the AST can see —
+  eviction (``pop``/``popitem``/``del``/``clear``), whole-container
+  reassignment outside ``__init__`` (generation swap), a ``len()``
+  guard (size cap), or ``deque(maxlen=...)``. Growth with none of these
+  is how the soak's flat-RSS gate regresses one innocent-looking cache
+  at a time. Genuinely extrinsic bounds (a cache keyed by cluster
+  membership, a per-query context) state their invariant in a
+  ``# tpulint: disable=cache-bound -- <why bounded>`` suppression, per
+  the PR 7 "by analysis, not suppression" bar for everything else.
+
+Both rules are per-file ``check(ctx)`` rules (tier "lifecycle"), so
+line suppressions, fixtures and the baseline behave exactly like the
+fast tier.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from pinot_tpu.analysis import astutil
+from pinot_tpu.analysis.core import Finding, Rule, register
+
+#: the serving path: modules whose device uploads serve queries (and
+#: must therefore be accounted). tools/, tests/, benchmarks stay out —
+#: a datagen upload is not resident serving state.
+SERVING_PREFIXES = (
+    "pinot_tpu/segment/", "pinot_tpu/parallel/", "pinot_tpu/query/",
+    "pinot_tpu/realtime/", "pinot_tpu/server/", "pinot_tpu/broker/",
+    "pinot_tpu/startree/",
+)
+
+#: resolved call targets that materialize a device array from host data
+UPLOAD_CALLS = {"jax.device_put", "jax.numpy.asarray", "jax.numpy.array"}
+
+#: the accountable choke points (and the module that owns the ledger)
+LEDGER_CALLS = {"pinot_tpu.obs.residency.ledgered_put",
+                "pinot_tpu.obs.residency.ledgered_asarray",
+                "residency.ledgered_put", "residency.ledgered_asarray"}
+
+
+def _jit_scope_nodes(tree: ast.AST, aliases: Dict[str, str]) -> Set[int]:
+    """ids of every node inside a jit boundary: decorated-jitted
+    functions, plus functions wrapped by name in a `jax.jit(...)` /
+    `shard_map(...)` call anywhere in the file (the sharded executor's
+    `jax.jit(shard_map(fn, ...))` idiom)."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = astutil.resolve(node.func, aliases) or ""
+        if astutil.is_jit_expr(node.func, aliases) or \
+                f.endswith("shard_map") or f.endswith("pmap") or \
+                f.endswith("vmap"):
+            for arg in node.args[:1]:
+                inner = arg
+                # unwrap nested wrappers: jit(shard_map(fn, mesh...))
+                while isinstance(inner, ast.Call) and inner.args:
+                    inner = inner.args[0]
+                if isinstance(inner, ast.Name):
+                    wrapped.add(inner.id)
+    out: Set[int] = set()
+    for fn in astutil.iter_functions(tree):
+        if astutil.is_jitted(fn, aliases) or fn.name in wrapped:
+            out.update(id(n) for n in ast.walk(fn))
+    return out
+
+
+@register
+class DeviceLedgerRule(Rule):
+    id = "device-ledger"
+    description = ("serving-path device uploads must route through the "
+                   "residency ledger (obs/residency.py)")
+    tier = "lifecycle"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if not ctx.in_prefixes(SERVING_PREFIXES):
+            return
+        jit_nodes = _jit_scope_nodes(ctx.tree, ctx.aliases)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in jit_nodes:
+                continue
+            f = astutil.resolve(node.func, ctx.aliases)
+            if f not in UPLOAD_CALLS:
+                continue
+            short = f.rsplit(".", 1)[-1]
+            yield ctx.finding(
+                self.id, node,
+                f"unledgered device upload: {short}() materializes a "
+                f"device array outside obs/residency.py — use "
+                f"residency.ledgered_"
+                f"{'put' if short == 'device_put' else 'asarray'}() so "
+                f"the bytes are accounted")
+
+
+# ---------------------------------------------------------------------------
+# cache-bound
+# ---------------------------------------------------------------------------
+
+#: constructors that build a growable container
+_CONTAINER_CTORS = {"dict", "list", "set", "collections.OrderedDict",
+                    "collections.defaultdict", "collections.Counter",
+                    "OrderedDict", "defaultdict", "Counter"}
+
+_GROW_METHODS = {"setdefault", "append", "add", "appendleft"}
+_EVICT_METHODS = {"pop", "popitem", "clear", "remove", "discard",
+                  "popleft"}
+_READ_METHODS = {"get"}
+
+
+def _container_init(value: ast.AST, aliases: Dict[str, str]
+                    ) -> Optional[str]:
+    """"unbounded" / "bounded" when `value` constructs a container,
+    None otherwise (deque(maxlen=...) is born bounded)."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return "unbounded"
+    if isinstance(value, ast.Call):
+        f = astutil.resolve(value.func, aliases) or ""
+        if f in _CONTAINER_CTORS:
+            return "unbounded"
+        if f in ("collections.deque", "deque"):
+            for kw in value.keywords:
+                if kw.arg == "maxlen" and not (
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is None):
+                    return "bounded"
+            return "unbounded"
+    return None
+
+
+class _Usage:
+    __slots__ = ("grown", "read", "bounded", "node")
+
+    def __init__(self, node: ast.AST):
+        self.grown = False
+        self.read = False
+        self.bounded = False
+        self.node = node
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _scan_usage(body_nodes, usages: Dict[str, _Usage], key,
+                init_scope: bool) -> None:
+    """Fold growth/read/bound evidence for the tracked containers into
+    `usages`. `key(node)` maps an expression to a tracked container
+    name (attr name or global name) or None."""
+    for node in body_nodes:
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            name = key(node.target)
+            if name in usages and not init_scope:
+                usages[name].bounded = True
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                # whole-container reassignment outside init: a
+                # generation swap bounds the old contents
+                name = key(tgt)
+                if name in usages and not init_scope:
+                    usages[name].bounded = True
+                if isinstance(tgt, ast.Subscript):
+                    name = key(tgt.value)
+                    if name in usages:
+                        usages[name].grown = True
+        elif isinstance(node, ast.AugAssign):
+            name = key(node.target)
+            if name in usages:
+                usages[name].grown = True
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                t = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                name = key(t)
+                if name in usages:
+                    usages[name].bounded = True
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                name = key(node.func.value)
+                if name in usages:
+                    m = node.func.attr
+                    if m in _GROW_METHODS:
+                        usages[name].grown = True
+                        if m == "setdefault":
+                            usages[name].read = True
+                    elif m in _EVICT_METHODS:
+                        usages[name].bounded = True
+                    elif m in _READ_METHODS:
+                        usages[name].read = True
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id == "len" and node.args:
+                name = key(node.args[0])
+                if name in usages:
+                    usages[name].bounded = True    # a size guard/cap
+        elif isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn))
+                   for op in node.ops):
+                for cand in node.comparators:
+                    name = key(cand)
+                    if name in usages:
+                        usages[name].read = True
+
+
+@register
+class CacheBoundRule(Rule):
+    id = "cache-bound"
+    description = ("memoization-shaped containers on the query path "
+                   "must carry a structural bound (eviction, swap, "
+                   "size cap, or maxlen)")
+    tier = "lifecycle"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if not ctx.in_prefixes(SERVING_PREFIXES):
+            return
+        yield from self._check_classes(ctx)
+        yield from self._check_globals(ctx)
+
+    def _check_classes(self, ctx) -> Iterator[Finding]:
+        from pinot_tpu.analysis.callgraph import INIT_METHODS
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            usages: Dict[str, _Usage] = {}
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) or \
+                        fn.name not in INIT_METHODS:
+                    continue
+                for node in astutil.walk_shallow(fn):
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                        value = node.value
+                    elif isinstance(node, ast.AnnAssign) and \
+                            node.value is not None:
+                        targets = [node.target]
+                        value = node.value
+                    else:
+                        continue
+                    if _container_init(value,
+                                       ctx.aliases) != "unbounded":
+                        continue
+                    for tgt in targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None and attr not in usages:
+                            usages[attr] = _Usage(node)
+            if not usages:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                _scan_usage(astutil.walk_shallow(fn), usages,
+                            _self_attr,
+                            init_scope=fn.name in INIT_METHODS)
+            for attr, u in sorted(usages.items()):
+                if u.grown and u.read and not u.bounded:
+                    yield ctx.finding(
+                        self.id, u.node,
+                        f"cache '{cls.name}.{attr}' is read-guarded and "
+                        f"inserted into but never evicted, swapped, or "
+                        f"size-capped — an unbounded query-path cache")
+
+    def _check_globals(self, ctx) -> Iterator[Finding]:
+        usages: Dict[str, _Usage] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if _container_init(value, ctx.aliases) != "unbounded":
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id not in usages:
+                    usages[tgt.id] = _Usage(node)
+        if not usages:
+            return
+
+        def gkey(node: ast.AST) -> Optional[str]:
+            return node.id if isinstance(node, ast.Name) else None
+
+        for fn in astutil.iter_functions(ctx.tree):
+            _scan_usage(astutil.walk_shallow(fn), usages, gkey,
+                        init_scope=False)
+        for name, u in sorted(usages.items()):
+            if u.grown and u.read and not u.bounded:
+                yield ctx.finding(
+                    self.id, u.node,
+                    f"module-global cache '{name}' is read-guarded and "
+                    f"inserted into but never evicted, swapped, or "
+                    f"size-capped — an unbounded query-path cache")

@@ -4,6 +4,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pinot_tpu.analysis import astutil
@@ -37,6 +38,8 @@ class AnalysisResult:
     findings: List[Finding]                 # kept (not suppressed)
     suppressed: List[Finding]
     errors: List[str]                       # unparseable files etc.
+    # tier → wall seconds (per-file tiers accumulate across files)
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def by_rule(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -47,8 +50,13 @@ class AnalysisResult:
 
 def analyze_source(source: str, path: str,
                    config: Optional[AnalysisConfig] = None,
-                   rule_ids: Optional[Set[str]] = None) -> AnalysisResult:
-    """Analyze one file's source under a (possibly virtual) repo path."""
+                   rule_ids: Optional[Set[str]] = None,
+                   tiers: Sequence[str] = ("ast",)) -> AnalysisResult:
+    """Analyze one file's source under a (possibly virtual) repo path.
+
+    `tiers`: which PER-FILE tiers run ("ast" always in practice;
+    "lifecycle" under --lifecycle). Global tiers (deep/protocol) never
+    run here — they have no per-file check()."""
     try:
         ctx = FileContext(path, source, config)
     except SyntaxError as e:
@@ -56,17 +64,21 @@ def analyze_source(source: str, path: str,
     per_line, per_file = parse_suppressions(source)
     kept: List[Finding] = []
     suppressed: List[Finding] = []
+    timings: Dict[str, float] = {}
     for rule_id, rule in sorted(all_rules().items()):
         if rule_ids is not None and rule_id not in rule_ids:
             continue
-        if rule.tier != "ast":
+        if rule.tier not in tiers:
             continue
+        t0 = time.perf_counter()
         for f in rule.check(ctx):
             (suppressed if is_suppressed(f, per_line, per_file)
              else kept).append(f)
+        timings[rule.tier] = timings.get(rule.tier, 0.0) + \
+            (time.perf_counter() - t0)
     kept.sort()
     suppressed.sort()
-    return AnalysisResult(kept, suppressed, [])
+    return AnalysisResult(kept, suppressed, [], timings)
 
 
 def iter_py_files(paths: Sequence[str]) -> List[str]:
@@ -86,17 +98,21 @@ def analyze_paths(paths: Sequence[str],
                   config: Optional[AnalysisConfig] = None,
                   rule_ids: Optional[Set[str]] = None,
                   deep: bool = False,
-                  protocol: bool = False) -> AnalysisResult:
+                  protocol: bool = False,
+                  lifecycle: bool = False) -> AnalysisResult:
     """Analyze every .py file under `paths` (files or directories).
 
     Paths should be given relative to the repo root so finding keys
-    match the committed baseline. `deep=True` additionally runs the
-    global deep-tier rules (kernel jaxpr contracts, wire schema);
-    `protocol=True` the protocol tier (durability ordering, crash
-    coverage, metrics contract, crash-interleaving model checker).
-    Both tiers are path-independent — run them from the repo root only.
+    match the committed baseline. `lifecycle=True` additionally runs
+    the per-file lifecycle tier (device-upload ledger routing, cache
+    bounds); `deep=True` the global deep-tier rules (kernel jaxpr
+    contracts, wire schema); `protocol=True` the protocol tier
+    (durability ordering, crash coverage, metrics contract,
+    crash-interleaving model checker). The global tiers are
+    path-independent — run them from the repo root only.
     """
     total = AnalysisResult([], [], [])
+    file_tiers = ("ast",) + (("lifecycle",) if lifecycle else ())
     for path in iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as fh:
@@ -105,13 +121,16 @@ def analyze_paths(paths: Sequence[str],
             total.errors.append(f"{path}: {e}")
             continue
         res = analyze_source(source, os.path.relpath(path), config,
-                             rule_ids)
+                             rule_ids, tiers=file_tiers)
         total.findings.extend(res.findings)
         total.suppressed.extend(res.suppressed)
         total.errors.extend(res.errors)
+        for tier, secs in res.timings.items():
+            total.timings[tier] = total.timings.get(tier, 0.0) + secs
     tiers = (["deep"] if deep else []) + (["protocol"] if protocol
                                           else [])
     for tier in tiers:
+        t0 = time.perf_counter()
         for rule_id, rule in sorted(all_rules().items()):
             if rule.tier != tier:
                 continue
@@ -123,6 +142,8 @@ def analyze_paths(paths: Sequence[str],
                 total.errors.append(    # must fail the gate loudly
                     f"{tier} rule {rule_id} crashed: "
                     f"{type(e).__name__}: {e}")
+        total.timings[tier] = total.timings.get(tier, 0.0) + \
+            (time.perf_counter() - t0)
     total.findings.sort()
     total.suppressed.sort()
     return total

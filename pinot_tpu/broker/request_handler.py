@@ -513,6 +513,8 @@ class BrokerRequestHandler:
         self.segment_pruner = segment_pruner
         self.routing = routing
         self.metrics = metrics or MetricsRegistry("broker")
+        from pinot_tpu.obs import residency
+        residency.bind_registry(self.metrics)
         # sampling JSONL slow-query log (obs/slowlog.py); default: the
         # PINOT_TPU_SLOWLOG* env config, None = disabled
         self.slow_log = slow_log if slow_log is not None else \

@@ -221,6 +221,13 @@ class MetricsRegistry:
 
 # -- metric name constants (parity: the reference's metric enums) ------------
 
+class CommonGauge:
+    # process-wide HBM residency metering (obs/residency.py ledger);
+    # exposed by EVERY component with the kind (and per-table) label
+    # riding the table-suffix convention as "<table>|<kind>"
+    DEVICE_BYTES_RESIDENT = "deviceBytesResident"
+
+
 class BrokerMeter:
     QUERIES = "queries"
     REQUEST_COMPILATION_EXCEPTIONS = "requestCompilationExceptions"

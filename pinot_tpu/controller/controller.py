@@ -53,6 +53,8 @@ class Controller:
         self._owns_store = store is None
         self.store = store or PropertyStore(data_dir=store_dir)
         self.metrics = MetricsRegistry("controller")
+        from pinot_tpu.obs import residency
+        residency.bind_registry(self.metrics)
         # leadership elects on the RAW store (the election CAS is the
         # fence's ground truth and must never be fenced itself)
         self.leadership = ControllerLeadershipManager(

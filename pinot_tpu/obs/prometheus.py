@@ -42,10 +42,27 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def _labels(table: Optional[str]) -> str:
+def _label_pairs(table: Optional[str]) -> List[str]:
+    """Label assignments for a registry table suffix. A plain suffix is
+    the reference's table-level convention (→ ``table`` label); a
+    ``<table>|<kind>`` suffix (the residency gauges) splits into
+    ``table`` + ``kind`` labels, empty parts omitted."""
     if table is None:
-        return ""
-    return '{table="%s"}' % _escape_label(table)
+        return []
+    if "|" in table:
+        tbl, kind = table.split("|", 1)
+        pairs = []
+        if tbl:
+            pairs.append(f'table="{_escape_label(tbl)}"')
+        if kind:
+            pairs.append(f'kind="{_escape_label(kind)}"')
+        return pairs
+    return [f'table="{_escape_label(table)}"']
+
+
+def _labels(table: Optional[str]) -> str:
+    pairs = _label_pairs(table)
+    return "{%s}" % ",".join(pairs) if pairs else ""
 
 
 def _fmt(v: float) -> str:
@@ -84,7 +101,8 @@ def render_prometheus(registry: MetricsRegistry,
         table, name = _split_key(key)
         full = f"{prefix}_{_snake(name)}_ms"
         lines = series(full, "histogram")
-        tl = "" if table is None else f'table="{_escape_label(table)}",'
+        pairs = _label_pairs(table)
+        tl = "".join(p + "," for p in pairs)
         cumulative = 0
         counts = t.bucket_counts()          # len(BOUNDS) + 1 (overflow)
         bounds = [_fmt(b) for b in Timer.BUCKET_BOUNDS_MS] + ["+Inf"]

@@ -36,6 +36,7 @@ import collections
 import functools
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -46,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pinot_tpu import compat
 from pinot_tpu.analysis.runtime import debug_transfer_guard
 from pinot_tpu.common.request import BrokerRequest
+from pinot_tpu.obs import residency
 from pinot_tpu.obs.profiler import profiled_device_get
 from pinot_tpu.query import combine as combine_mod
 from pinot_tpu.query import execution
@@ -238,7 +240,7 @@ class _UnionViewSegment:
     def __init__(self, stack: "StackedSegments"):
         self._stack = stack
         self._base = stack.segments[0]
-        self._sources: Dict[str, object] = {}
+        self._sources: Dict[str, object] = {}  # tpulint: disable=cache-bound -- bounded by the table's column count; dies with the stack (executor LRU)
 
     @property
     def metadata(self):
@@ -306,7 +308,7 @@ class StackedSegments:
         self.num_docs = np.zeros(self.n_total, np.int32)
         self.num_docs[: self.n_real] = [s.num_docs for s in self.segments]
         self._dev_num_docs = None
-        self._lanes: Dict[Tuple[str, str], object] = {}
+        self._lanes: Dict[Tuple[str, str], object] = {}  # tpulint: disable=cache-bound -- bounded by columns x lane kinds; the whole stack is LRU-evicted by ShardedQueryExecutor (max_stacks)
         # upsert validDocIds lane: keyed by every segment's bitmap
         # version so invalidations landing after the stack was cached
         # re-upload a fresh [S, P] mask (other lanes are immutable);
@@ -322,8 +324,30 @@ class StackedSegments:
         # it (in-place host-array mutation)
         self._cache_lock = threading.Lock()
         # col -> None (dictionaries shared) | _UnionColumn (remap needed)
-        self._union: Dict[str, Optional["_UnionColumn"]] = {}
+        self._union: Dict[str, Optional["_UnionColumn"]] = {}  # tpulint: disable=cache-bound -- bounded by the table's column count; dies with the stack (executor LRU)
         self._plan_segment = None
+        # residency: one ledger prefix per stack. Eviction only drops
+        # the executor's dict ref — in-flight queries keep the device
+        # lanes alive — so release rides GC via the finalizer, which
+        # tracks the actual HBM lifetime.
+        self._ledger_prefix = f"stack:{id(self)}:"
+        self._ledger_table = self.segments[0].metadata.table_name or ""
+        self._ledger_seg = f"stack[{self.n_real}]"
+        weakref.finalize(self, residency.LEDGER.release_prefix,
+                         self._ledger_prefix)
+
+    #: lane kind → residency ledger kind (everything else is a stacked
+    #: scan lane)
+    _LEDGER_KINDS = {"vec": "vector", "hllidx": "hll", "hllrank": "hll",
+                     "vdoc": "vdoc"}
+
+    def _ledgered_put(self, host, owner_suffix: str, lane_kind: str,
+                      sharding):
+        return residency.ledgered_put(
+            host, owner=self._ledger_prefix + owner_suffix,
+            table=self._ledger_table, segment=self._ledger_seg,
+            kind=self._LEDGER_KINDS.get(lane_kind, "stack"),
+            sharding=sharding)
 
     def union_column(self, col: str) -> Optional["_UnionColumn"]:
         """None when every segment shares the column's dictionary; else
@@ -357,8 +381,9 @@ class StackedSegments:
     def device_num_docs(self):
         with self._cache_lock:
             if self._dev_num_docs is None:
-                self._dev_num_docs = jax.device_put(
-                    self.num_docs, NamedSharding(self.mesh, P(SEG_AXIS)))
+                self._dev_num_docs = self._ledgered_put(
+                    self.num_docs, "num_docs", "stack",
+                    NamedSharding(self.mesh, P(SEG_AXIS)))
             return self._dev_num_docs
 
     def lane(self, col: str, kind: str):
@@ -383,7 +408,8 @@ class StackedSegments:
         if kind in ("vals", "hllidx", "hllrank"):
             # dictionary-scale tables are identical (or the union
             # table); replicate instead of sharding
-            out = jax.device_put(arrs[0], NamedSharding(self.mesh, P()))
+            out = self._ledgered_put(arrs[0], f"{col}.{kind}", kind,
+                                     NamedSharding(self.mesh, P()))
             with self._cache_lock:
                 return self._lanes.setdefault(key, out)
         if kind == "mv":
@@ -401,7 +427,8 @@ class StackedSegments:
             filler = np.full((self.n_total - self.n_real,) + stacked.shape[1:],
                              pad_val, stacked.dtype)
             stacked = np.concatenate([stacked, filler])
-        out = jax.device_put(stacked, NamedSharding(self.mesh, P(SEG_AXIS)))
+        out = self._ledgered_put(stacked, f"{col}.{kind}", kind,
+                                 NamedSharding(self.mesh, P(SEG_AXIS)))
         with self._cache_lock:
             return self._lanes.setdefault(key, out)
 
@@ -474,8 +501,8 @@ class StackedSegments:
             # upload a COPY: newer jax CPU backends may zero-copy numpy
             # input, and the next incremental rebuild mutates `host` in
             # place — aliasing would corrupt the cached device lane
-            out = jax.device_put(host.copy(),
-                                 NamedSharding(self.mesh, P(SEG_AXIS)))
+            out = self._ledgered_put(host.copy(), "vdoc", "vdoc",
+                                     NamedSharding(self.mesh, P(SEG_AXIS)))
             self._vdoc_host = host
             self._vdoc_cache = (versions, out)
             return out

@@ -30,6 +30,7 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.obs import residency
 from pinot_tpu.query.stages.errors import ExchangeError
 
 XCHG_MAGIC = b"XCHG"
@@ -64,13 +65,19 @@ class ExchangeManager:
         self._bytes = 0
         with _REGISTRY_LOCK:
             _REGISTRY[self.xkey] = self
+        # residency: held blocks are device-adjacent memory a stage-2
+        # join will upload; the ledger sweeps us on scrape so expired
+        # entries leave the books at quiescence, not on the next put/get
+        residency.LEDGER.add_sweeper(self.sweep_expired)
 
     def close(self) -> None:
         with _REGISTRY_LOCK:
             _REGISTRY.pop(self.xkey, None)
+        residency.LEDGER.remove_sweeper(self.sweep_expired)
         with self._lock:
             self._store.clear()
             self._bytes = 0
+        residency.LEDGER.release_prefix(f"xchg:{self.xkey}:")
 
     # -- store -------------------------------------------------------------
     def put(self, xid: str, payload: bytes,
@@ -84,15 +91,22 @@ class ExchangeManager:
         ttl = self.ttl_s if ttl_s is None else min(self.ttl_s, ttl_s)
         with self._lock:
             self._sweep(now)
-            if self._bytes + len(payload) > self.max_bytes:
+            # credit a to-be-replaced entry BEFORE the overflow compare:
+            # a republish of xid must be judged against the budget it
+            # will actually occupy, and the typed-422 reject path must
+            # leave the books exactly as they were (debit/credit pairs
+            # balance — the model checker's bytes-conservation invariant)
+            old = self._store.get(xid)
+            held = self._bytes - (len(old[0]) if old is not None else 0)
+            if held + len(payload) > self.max_bytes:
                 raise ExchangeError(
-                    f"exchange buffer full ({self._bytes} bytes held, "
+                    f"exchange buffer full ({held} bytes held, "
                     f"{len(payload)} offered, cap {self.max_bytes})")
-            old = self._store.pop(xid, None)
-            if old is not None:
-                self._bytes -= len(old[0])
             self._store[xid] = (payload, now + max(ttl, 1.0))
-            self._bytes += len(payload)
+            self._bytes = held + len(payload)
+            residency.LEDGER.register(
+                f"xchg:{self.xkey}:{xid}", table="", segment="",
+                kind="exchange", nbytes=len(payload))
 
     def get(self, xid: str) -> Optional[bytes]:
         now = self._clock()
@@ -101,12 +115,28 @@ class ExchangeManager:
             entry = self._store.get(xid)
             return entry[0] if entry is not None else None
 
+    def sweep_expired(self) -> int:
+        """Drop every expired entry NOW; returns the bytes released.
+        Without this the sweep only ran inside put/get, so a quiescent
+        manager held expired blocks (and their budget) indefinitely —
+        exactly the leak the exchange protocol model flags when the
+        `standalone_sweep` shape is missing."""
+        with self._lock:
+            before = self._bytes
+            self._sweep(self._clock())
+            return before - self._bytes
+
+    def held_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
     def _sweep(self, now: float) -> None:
         # caller holds the lock
         dead = [k for k, (_p, exp) in self._store.items() if exp <= now]
         for k in dead:
             payload, _exp = self._store.pop(k)
             self._bytes -= len(payload)
+            residency.LEDGER.release(f"xchg:{self.xkey}:{k}")
 
     def __len__(self) -> int:
         with self._lock:
@@ -147,7 +177,7 @@ def _miss_reply(message: str) -> bytes:
 
 _CLIENT_LOCK = threading.Lock()
 _CLIENT_LOOP = None
-_CLIENT_CONNS: Dict[Tuple[str, int], object] = {}
+_CLIENT_CONNS: Dict[Tuple[str, int], object] = {}  # tpulint: disable=cache-bound -- one connection per (host, port) peer: bounded by cluster membership
 
 
 def _client_loop():

@@ -72,8 +72,22 @@ class JoinContext:
         self.order = np.argsort(self.keys, kind="stable").astype(np.int64)
         self.skeys = self.keys[self.order]
         self._lock = threading.Lock()
-        self._member_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
-        self._codings: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        self._member_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}  # tpulint: disable=cache-bound -- keyed by id(dictionary): bounded by the query's segment count; the context dies with the query
+        self._codings: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}  # tpulint: disable=cache-bound -- one coding per projected dim column: bounded by the join's column list
+        # residency: the probe tables become jitted-kernel operands (one
+        # implicit upload per dispatch); account them for the context's
+        # lifetime — a query holds at most its own dim side, and the
+        # finalizer releases when the stage's plan drops the context
+        import weakref
+        from pinot_tpu.obs import residency
+        nbytes = (self.keys.nbytes + self.order.nbytes +
+                  self.skeys.nbytes +
+                  sum(c.nbytes for c in columns.values()
+                      if isinstance(c, np.ndarray)))
+        owner = f"join:{id(self)}"
+        residency.LEDGER.register(owner, table=spec.dim_table or "",
+                                  segment="", kind="join", nbytes=nbytes)
+        weakref.finalize(self, residency.LEDGER.release, owner)
 
     @property
     def empty(self) -> bool:

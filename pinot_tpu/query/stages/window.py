@@ -126,9 +126,19 @@ def _device_window(part: np.ndarray, orders: List[np.ndarray],
         out[:n] = a
         return out
 
-    outs = profiled_device_get(kernels.run_window_kernel(
-        pad(part), tuple(pad(o) for o in orders),
-        tuple(pad(v) for v in sums), n))
+    # residency: the padded operands become jitted-kernel params (one
+    # implicit upload each); account them for the dispatch's duration
+    from pinot_tpu.obs import residency
+    owner = f"win:{id(part)}"
+    residency.LEDGER.register(
+        owner, table="", segment="", kind="window",
+        nbytes=4 * n_pad * (1 + len(orders) + len(sums)))
+    try:
+        outs = profiled_device_get(kernels.run_window_kernel(
+            pad(part), tuple(pad(o) for o in orders),
+            tuple(pad(v) for v in sums), n))
+    finally:
+        residency.LEDGER.release(owner)
     perm = np.asarray(outs["win.perm"])[:n].astype(np.int64)
     rn = np.asarray(outs["win.rn"])[:n].astype(np.int32)
     run_sums = [np.asarray(outs[f"win.sum{j}"])[:n].astype(np.int32)

@@ -33,7 +33,7 @@ class MutableDictionary:
     def __init__(self, data_type: DataType):
         self.data_type = data_type
         self._values: List = []
-        self._index: Dict = {}
+        self._index: Dict = {}  # tpulint: disable=cache-bound -- the dictionary IS the data: bounded by the segment-size seal threshold, frozen at commit
         self._np_cache: Optional[np.ndarray] = None
 
     @property
@@ -450,7 +450,7 @@ class MutableSegmentView:
         self.schema = impl.schema
         self.start = start
         self.num_docs = impl._num_docs - start
-        self._sources: Dict[str, _SnapshotSource] = {}
+        self._sources: Dict[str, _SnapshotSource] = {}  # tpulint: disable=cache-bound -- bounded by the schema's column count; dies with the snapshot view
         # upsert validDocIds: PIN the liveness mask for this view's rows
         # at snapshot time, so the filter mask and every column lane
         # agree even while the upsert fold keeps invalidating docs

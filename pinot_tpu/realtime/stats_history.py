@@ -23,7 +23,7 @@ class RealtimeSegmentStatsHistory:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
-        self._tables: Dict[str, List[dict]] = {}
+        self._tables: Dict[str, List[dict]] = {}  # tpulint: disable=cache-bound -- keyed by table name (bounded by cluster tables); inner lists trimmed to max_rows
         try:
             with open(path) as fh:
                 data = json.load(fh)

@@ -53,7 +53,7 @@ class TcpTopicServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
-        self._topics: Dict[str, List[List[bytes]]] = {}
+        self._topics: Dict[str, List[List[bytes]]] = {}  # tpulint: disable=cache-bound -- keyed by topic name: bounded by configured topics (test harness scale)
         self._lock = threading.Lock()
         self.loop = asyncio.new_event_loop()
         self._thread: Optional[threading.Thread] = None

@@ -168,6 +168,7 @@ class DataSource:
         self.bloom_filter: Optional[BloomFilter] = None
         # device arrays (lazy)
         self._dev: Dict[str, object] = {}
+        self._dev_finalizer = None           # set on first device upload
         self._part_info: Optional[tuple] = None
         self._hll_tables: Optional[tuple] = None
 
@@ -277,11 +278,36 @@ class DataSource:
         out[: len(ids)] = ids
         return out
 
+    #: _device key → residency ledger kind
+    _LEDGER_KINDS = {"vec_values": "vector", "hll_idx": "hll",
+                     "hll_rank": "hll"}
+
     def _device(self, key: str, host_array: np.ndarray):
         if key not in self._dev:
-            import jax.numpy as jnp
-            self._dev[key] = jnp.asarray(host_array)
+            import weakref
+            from pinot_tpu.obs import residency
+            seg = self._segment
+            if self._dev_finalizer is None:
+                # superseded frozen snapshots are freed by GC, not
+                # destroy() — the finalizer keeps the ledger truthful
+                # on that path too (release_prefix is idempotent)
+                self._dev_finalizer = weakref.finalize(
+                    self, residency.LEDGER.release_prefix,
+                    f"ds:{id(self)}:")
+            self._dev[key] = residency.ledgered_asarray(
+                host_array,
+                owner=f"ds:{id(self)}:{key}",
+                table=seg.metadata.table_name if seg is not None else "",
+                segment=seg.segment_name if seg is not None else "",
+                kind=self._LEDGER_KINDS.get(key, "scan"))
         return self._dev[key]
+
+    def release_device(self) -> None:
+        """Drop every device lane and its ledger entries (segment drop/
+        eviction path; re-upload after this re-registers)."""
+        from pinot_tpu.obs import residency
+        self._dev.clear()
+        residency.LEDGER.release_prefix(f"ds:{id(self)}:")
 
 
 class ImmutableSegment:
@@ -294,12 +320,16 @@ class ImmutableSegment:
                  data_sources: Dict[str, DataSource]):
         self.metadata = metadata
         self._data_sources = data_sources
+        for ds in data_sources.values():
+            if ds._segment is None:   # loader builds DataSource(cm, None)
+                ds._segment = self    # backref names ledger entries
         self.star_trees = []     # pre-aggregated cubes (startree/cube.py)
         # primary-key upsert liveness bitmap (realtime/upsert.py); None
         # for non-upsert tables. Attached by the realtime data manager
         # when the committed segment swaps in / cold-start loads.
         self.valid_doc_ids = None
         self._valid_dev = None   # (bitmap version, padded device lane)
+        self._valid_finalizer = None         # set on first vdoc upload
 
     @property
     def segment_name(self) -> str:
@@ -368,14 +398,23 @@ class ImmutableSegment:
         re-uploaded only when the bitmap version changes. Rows past
         num_docs pad False; the kernel ANDs with its row-validity iota
         anyway."""
-        import jax.numpy as jnp
+        from pinot_tpu.obs import residency
         vd = self.valid_doc_ids
         ver = vd.version
         cached = self._valid_dev
         if cached is None or cached[0] != ver:
+            import weakref
             host = np.zeros(self.padded_docs, dtype=bool)
             host[: self.num_docs] = vd.valid_mask(0, self.num_docs)
-            cached = (ver, jnp.asarray(host))
+            if self._valid_finalizer is None:
+                self._valid_finalizer = weakref.finalize(
+                    self, residency.LEDGER.release,
+                    f"seg:{id(self)}:vdoc")
+            lane = residency.ledgered_asarray(
+                host, owner=f"seg:{id(self)}:vdoc",
+                table=self.metadata.table_name or "",
+                segment=self.segment_name, kind="vdoc")
+            cached = (ver, lane)
             self._valid_dev = cached  # tpulint: disable=concurrency -- benign racy single-slot cache: concurrent queries at worst duplicate one upload; tuple publish is atomic
         return cached[1]
 
@@ -397,9 +436,11 @@ class ImmutableSegment:
                 ds.device_mv_dict_ids()
 
     def destroy(self) -> None:
+        from pinot_tpu.obs import residency
         self._valid_dev = None  # tpulint: disable=concurrency -- destroy runs after the refcounted release of the last query; worst case a racing reader re-uploads one lane
+        residency.LEDGER.release(f"seg:{id(self)}:vdoc")
         for ds in self._data_sources.values():
-            ds._dev.clear()
+            ds.release_device()
 
 
 class ImmutableSegmentLoader:

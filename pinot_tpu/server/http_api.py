@@ -40,6 +40,7 @@ class ServerApiServer(ApiServer):
         self.router.add("GET", "/tables/{table}/segments", self._segments)
         self.router.add("GET", "/tables/{table}/size", self._size)
         self.router.add("GET", "/debug/memory", self._memory)
+        self.router.add("GET", "/debug/residency", self._residency)
 
     async def _metrics(self, request: HttpRequest) -> HttpResponse:
         return metrics_response(self.server.metrics, request)
@@ -123,3 +124,12 @@ class ServerApiServer(ApiServer):
                         for t in out.values() for s in t.values())
         return HttpResponse.of_json({"totalHbmResidentBytes": total_hbm,
                                      "tables": out})
+
+    async def _residency(self, request: HttpRequest) -> HttpResponse:
+        """The process-global residency ledger: every accounted device
+        upload (scan/vdoc/vector/hll/stack/join/window lanes + exchange
+        held bytes) by table and kind, with the largest owners. This is
+        the ledger view the `deviceBytesResident{table,kind}` gauges
+        export — /debug/memory remains the per-segment lane walk."""
+        from pinot_tpu.obs.residency import LEDGER
+        return HttpResponse.of_json(LEDGER.snapshot())

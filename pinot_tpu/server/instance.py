@@ -42,6 +42,8 @@ class ServerInstance:
                  result_cache_entries: int = 256):
         self.instance_id = instance_id
         self.metrics = MetricsRegistry("server")
+        from pinot_tpu.obs import residency
+        residency.bind_registry(self.metrics)
         self.data_manager = InstanceDataManager()
         self.scheduler: QueryScheduler = make_scheduler(scheduler,
                                                         num_workers)

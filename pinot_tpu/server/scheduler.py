@@ -259,7 +259,7 @@ class MultiLevelPriorityQueue:
         self.token_lifetime_ms = token_lifetime_ms
         self.query_deadline_s = query_deadline_s
         self._clock = clock
-        self._groups: Dict[str, TokenSchedulerGroup] = {}
+        self._groups: Dict[str, TokenSchedulerGroup] = {}  # tpulint: disable=cache-bound -- one group per table: bounded by tables hosted on this server
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._seq = 0
@@ -525,8 +525,8 @@ class BoundedFCFSScheduler(QueryScheduler):
                  policy: Optional[ResourceLimitPolicy] = None):
         super().__init__(num_workers)
         self.policy = policy or ResourceLimitPolicy(num_workers)
-        self._pending: Dict[str, list] = {}
-        self._running: Dict[str, int] = {}
+        self._pending: Dict[str, list] = {}  # tpulint: disable=cache-bound -- one queue per table (bounded by hosted tables); each queue is capped at max_pending_per_group with a typed reject
+        self._running: Dict[str, int] = {}  # tpulint: disable=cache-bound -- per-table running counters: bounded by hosted tables
         self._order: list = []            # (seq, group) FCFS across groups
         self._seq = 0
         self._lock = threading.Lock()

@@ -413,7 +413,7 @@ class StarTreeCubeLike:
 
     def __init__(self, counts: np.ndarray, stats_cat: Dict[str, np.ndarray]):
         self.counts = counts
-        self.metric_stats: Dict[str, Dict[str, np.ndarray]] = {}
+        self.metric_stats: Dict[str, Dict[str, np.ndarray]] = {}  # tpulint: disable=cache-bound -- keyed by metric column: bounded by the star-tree's metric set
         for k, arr in stats_cat.items():
             col, stat = k.rsplit(".", 1)
             self.metric_stats.setdefault(col, {})[stat] = arr

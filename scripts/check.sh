@@ -105,5 +105,10 @@ echo "== tpulint (deep + protocol tiers) =="
 # silent), and a drift gate against the committed protocol-model.json.
 # On failure the CLI prints a findings-diff summary (rule id,
 # file:line, fix-or-suppress guidance) — and for invariant violations,
-# the counterexample trace.
-exec "$(dirname "$0")/lint.sh" --deep --protocol
+# the counterexample trace. --lifecycle adds the resource-lifecycle
+# tier (device uploads routed through the residency ledger, query-path
+# caches structurally bounded), --sarif exports every finding for CI
+# annotation, and lint.sh fails the gate if the whole four-tier run
+# exceeds its wall-time budget (default 30s).
+exec "$(dirname "$0")/lint.sh" --lifecycle --deep --protocol \
+    --sarif lint.sarif

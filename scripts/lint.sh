@@ -4,12 +4,31 @@
 # keys match tpulint.baseline.json.
 #
 #   scripts/lint.sh              fast tier (AST rule families)
+#   scripts/lint.sh --lifecycle  + residency-ledger routing + cache
+#                                  bounds (resource-lifecycle tier)
 #   scripts/lint.sh --deep       + jaxpr kernel contracts + wire-schema
 #   scripts/lint.sh --deep --protocol
 #                                + durability order, crash coverage,
 #                                  metrics contract, and the exhaustive
 #                                  crash-interleaving model checker
+#
+# The CLI prints per-tier wall time on every run; TPULINT_BUDGET_S
+# (default 30, 0 disables) fails the run when the whole multi-tier
+# pass exceeds the budget — the gate must stay cheap enough for the
+# pre-commit path, so a rule that turns quadratic is itself a failure.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m pinot_tpu.analysis --strict-baseline "${@:-pinot_tpu/}"
+
+budget="${TPULINT_BUDGET_S:-30}"
+start=$(date +%s)
+status=0
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m pinot_tpu.analysis --strict-baseline "${@:-pinot_tpu/}" \
+    || status=$?
+elapsed=$(( $(date +%s) - start ))
+if [ "$budget" -gt 0 ] && [ "$elapsed" -gt "$budget" ]; then
+    echo "tpulint: FAILING — run took ${elapsed}s > ${budget}s budget" \
+         "(set TPULINT_BUDGET_S to adjust)" >&2
+    exit 1
+fi
+exit "$status"

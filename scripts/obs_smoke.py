@@ -45,7 +45,30 @@ def check_exposition(name: str, port: int) -> int:
             f"{name}: invalid exposition line {line!r}"
         samples += 1
     assert samples > 0, f"{name}: /metrics served an empty exposition"
+    # the residency ledger's gauges are pre-registered at boot on every
+    # component, so "is HBM accounted" is scrapeable before (and after)
+    # any upload happens — a dashboard never sees a missing series
+    assert "device_bytes_resident" in text, \
+        f"{name}: /metrics has no deviceBytesResident series"
     return samples
+
+
+def check_residency(name: str, port: int) -> dict:
+    """The /debug/residency view must agree with a live ledger: after
+    segments are uploaded and a query has warmed the scan lanes, the
+    serving process holds accounted device bytes."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/residency", timeout=10) as r:
+        assert r.status == 200, f"{name}: /debug/residency -> {r.status}"
+        view = json.loads(r.read())
+    for key in ("totalDeviceBytesResident", "byKind", "tables",
+                "entryCount"):
+        assert key in view, f"{name}: /debug/residency missing {key!r}"
+    assert view["totalDeviceBytesResident"] > 0, \
+        f"{name}: no resident bytes after a warmed query: {view}"
+    assert view["byKind"].get("scan", 0) > 0, \
+        f"{name}: scan lanes not accounted: {view['byKind']}"
+    return view
 
 
 def tree_names(node, out):
@@ -99,9 +122,19 @@ def main() -> int:
         dispatches = {n for n in names if n.startswith("dispatch:")}
         assert len(dispatches) == 2, \
             f"expected per-server dispatch spans, got {dispatches}"
+
+        # the query warmed the scan lanes: at least one server must now
+        # report ledgered resident bytes, and its re-scraped exposition
+        # must carry a nonzero per-table deviceBytesResident sample
+        resident = 0
+        for name, port in cluster.server_http_ports.items():
+            view = check_residency(name, port)
+            resident += view["totalDeviceBytesResident"]
+        assert resident > 0, "no server holds ledgered device bytes"
         print(json.dumps({"metricsSamples": counts,
                           "traceSpans": len(names),
-                          "dispatchSpans": sorted(dispatches)}, indent=1))
+                          "dispatchSpans": sorted(dispatches),
+                          "residentBytes": resident}, indent=1))
         print("obs smoke: OK")
         return 0
     finally:

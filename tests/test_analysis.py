@@ -48,7 +48,9 @@ def test_rule_families_registered():
         # global protocol tier (durability discipline, crash coverage,
         # metrics exposition contract, crash-interleaving model check)
         "durability-order", "crash-coverage", "metrics-contract",
-        "protocol-invariants", "protocol-model"}
+        "protocol-invariants", "protocol-model",
+        # per-file lifecycle tier (HBM residency accounting)
+        "device-ledger", "cache-bound"}
 
 
 def test_deep_rules_are_deep_tier_only():

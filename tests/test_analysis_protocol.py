@@ -373,7 +373,7 @@ def test_live_tree_metrics_contract_clean():
 def test_live_protocols_hold_exhaustively():
     result = protocol.check_protocols()
     assert result.problems == []
-    assert len(result.reports) == 6
+    assert len(result.reports) == 7
     for report in result.reports:
         assert not report.truncated, report.system
         assert report.states > 0
@@ -731,6 +731,180 @@ def test_extraction_contract_violation_is_a_problem():
     """An anchor rename must fail the gate loudly, not extract garbage."""
     with pytest.raises(protocol.ExtractionError):
         protocol.extract_lease({protocol.LEASE_PATH: "x = 1\n"})
+
+
+# ---------------------------------------------------------------------------
+# exchange publish/ack/fetch/TTL-sweep
+# ---------------------------------------------------------------------------
+
+# the exchange seeds mutate the LIVE source (string-surgery, each
+# anchor asserted) instead of a frozen fixture: the tests then also
+# pin that the extraction anchors still match the tree
+
+
+def _exchange_seed(*replacements, site=None):
+    src = protocol._load(protocol.XCHG_PATH, None)
+    for old, new in replacements:
+        assert old in src, f"exchange seed anchor drifted: {old!r}"
+        src = src.replace(old, new, 1)
+    sources = {protocol.XCHG_PATH: src}
+    if site is not None:
+        sources[protocol.XCHG_SITE_PATH] = site
+    return sources
+
+
+def _exchange_report(sources):
+    result = protocol.check_protocols(sources=sources,
+                                      only=["exchange"])
+    assert result.problems == [], result.problems
+    (report,) = result.reports
+    assert not report.truncated
+    return report
+
+
+def test_live_exchange_extraction_shape():
+    """The live tree carries every exchange discipline: locked put/get,
+    the standalone TTL sweep wired as a ledger scrape hook, typed miss
+    and overflow surfaces, and ack strictly after publish."""
+    ex = protocol.extract_exchange()
+    assert ex.problems == []
+    put_steps = [s for s in ex.step_order() if s.startswith("put.")]
+    assert put_steps == ["put.sweep", "put.credit_replaced",
+                         "put.overflow_check", "put.store", "put.debit",
+                         "put.ledger_register"]
+    assert ex.flags == {"locked_put": True, "locked_get": True,
+                        "standalone_sweep": True,
+                        "ledger_sweep_hook": True,
+                        "close_releases_ledger": True,
+                        "miss_typed": True, "ack_after_put": True,
+                        "overflow_typed": True}
+    report = _exchange_report(None)
+    assert report.violations == [], [
+        (v.invariant, v.render_trace()) for v in report.violations]
+    assert report.states > 0
+
+
+_ACK_FIRST_SITE = '''
+class ServerInstance:
+    def _maybe_publish(self, request, dt, info):
+        ack = DataTable()
+        ack.metadata["exchangeId"] = xid
+        try:
+            self.exchange.put(xid, payload, ttl_s=ttl)
+        except ExchangeError as e:
+            return stage_error_datatable(
+                request.request_id, "exchangeCapacity",
+                str(e)).to_bytes()
+        return ack.to_bytes()
+'''
+
+
+def test_seeded_ack_before_publish_yields_half_read_counterexample():
+    """The reorder bug: the server acks the exchange id to the broker
+    BEFORE putting the block — stage 2 can fetch an id that was
+    promised but never published. The checker must produce the ordered
+    ack-then-fetch trace."""
+    report = _exchange_report(_exchange_seed(site=_ACK_FIRST_SITE))
+    invariants = {v.invariant for v in report.violations}
+    assert "no-half-published-read" in invariants, invariants
+    (v,) = [x for x in report.violations
+            if x.invariant == "no-half-published-read"]
+    trace = v.trace
+    assert "pub.send_ack" in trace and "fet.get" in trace
+    assert trace.index("pub.send_ack") < trace.index("fet.get"), trace
+
+
+def test_seeded_compare_before_credit_yields_spurious_overflow():
+    """The budget bug the runtime fix closed: judging a replace-publish
+    against gross held bytes (no credit for the entry it replaces)
+    rejects a put that fits the REAL budget."""
+    sources = _exchange_seed((
+        """            old = self._store.get(xid)
+            held = self._bytes - (len(old[0]) if old is not None else 0)
+            if held + len(payload) > self.max_bytes:""",
+        """            held = self._bytes
+            if held + len(payload) > self.max_bytes:"""))
+    report = _exchange_report(sources)
+    invariants = {v.invariant for v in report.violations}
+    assert "no-spurious-overflow" in invariants, invariants
+
+
+def test_seeded_missing_standalone_sweep_leaks_bytes():
+    """Without the public sweep (the pre-fix shape: expiry only ran
+    inside put/get), a quiescent manager holds expired blocks and
+    their budget forever — the bytes-conservation invariant trips."""
+    sources = _exchange_seed(
+        ("self._sweep(self._clock())", "pass"))
+    report = _exchange_report(sources)
+    violations = [v for v in report.violations
+                  if v.invariant == "bytes-conservation"]
+    assert violations, {v.invariant for v in report.violations}
+    assert any("env.ttl_expires" in v.trace for v in violations)
+
+
+def test_seeded_get_without_sweep_reads_expired_payload():
+    sources = _exchange_seed((
+        """        with self._lock:
+            self._sweep(now)
+            entry = self._store.get(xid)""",
+        """        with self._lock:
+            entry = self._store.get(xid)"""))
+    report = _exchange_report(sources)
+    invariants = {v.invariant for v in report.violations}
+    assert "no-read-after-sweep" in invariants, invariants
+    (v,) = [x for x in report.violations
+            if x.invariant == "no-read-after-sweep"]
+    assert trace_order(v.trace, "env.ttl_expires", "fet.get")
+
+
+def trace_order(trace, first, second):
+    return (first in trace and second in trace and
+            trace.index(first) < trace.index(second))
+
+
+def test_seeded_untyped_miss_yields_silent_vanish_counterexample():
+    """If the fetch client stops converting ExchangeMissError into a
+    raised ExchangeError, an expired fetch silently vanishes a join
+    side instead of failing typed."""
+    sources = _exchange_seed(
+        ("raise ExchangeError(str(exc))", "continue"))
+    report = _exchange_report(sources)
+    invariants = {v.invariant for v in report.violations}
+    assert "expired-fetch-is-typed" in invariants, invariants
+
+
+_UNLOCKED_PUT = ("""        with self._lock:
+            self._sweep(now)
+            # credit a to-be-replaced entry BEFORE the overflow""",
+                 """        if True:
+            self._sweep(now)
+            # credit a to-be-replaced entry BEFORE the overflow""")
+
+
+def test_seeded_unlocked_put_interleaves_to_torn_books():
+    """Dropping put's lock turns the attempt into interleavable
+    micro-steps: a crash between debit and ledger-register leaves the
+    books torn, and a fetch can observe the half-published entry."""
+    report = _exchange_report(_exchange_seed(_UNLOCKED_PUT))
+    invariants = {v.invariant for v in report.violations}
+    assert "bytes-conservation" in invariants, invariants
+    assert "no-half-published-read" in invariants, invariants
+    # the traces name the extracted micro-steps, not invented labels
+    all_steps = {s for v in report.violations for s in v.trace}
+    assert any(s.startswith(("pub1.put.", "pub2.put."))
+               for s in all_steps), all_steps
+
+
+def test_exchange_model_checker_is_deterministic():
+    """Same state count AND byte-identical counterexample traces across
+    two runs of the richest seeded model (unlocked put)."""
+    def run():
+        report = _exchange_report(_exchange_seed(_UNLOCKED_PUT))
+        return (report.states,
+                json.dumps([[v.invariant, v.message, v.trace]
+                            for v in report.violations]))
+    a, b = run(), run()
+    assert a[0] == b[0] and a[1] == b[1]
 
 
 # ---------------------------------------------------------------------------
