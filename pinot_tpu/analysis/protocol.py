@@ -60,6 +60,19 @@ walking the statements in source order:
   reordering credit/compare produces a counterexample, not a parse
   error.
 
+- ``residency`` — `ResidencyManager.demote_segment` /
+  `promote_segment` (server/residency_manager.py): the staged tier
+  swap — stage/verify host copy, the `residency.demote_staged` /
+  `residency.pre_publish` / `residency.pre_release` crash points,
+  artifact verification (disk), the tier publish, the query-pin drain,
+  the lane release, and promotion's reload→upload→publish — in
+  whatever order the SOURCE has them. The model runs demoter (→host,
+  →disk) x promoter x a pin/read/unpin query x artifact loss x
+  crash-at-every-step against `no-read-of-released-lane`,
+  `promoted-implies-artifact` and `budget-conservation`, so releasing
+  before the publish+drain or publishing disk tier without a verified
+  artifact produces a counterexample, not a parse error.
+
 Step SEMANTICS are bound here by step name; step ORDER and the
 discipline flags come from the source. A protocol edit that preserves
 the discipline re-extracts cleanly; one that breaks it either fails the
@@ -215,6 +228,7 @@ DRAIN_PATH = "pinot_tpu/tools/distributed.py"
 COMPACT_PATH = "pinot_tpu/controller/compaction.py"
 XCHG_PATH = "pinot_tpu/query/stages/exchange.py"
 XCHG_SITE_PATH = "pinot_tpu/server/instance.py"
+RESIDENCY_PATH = "pinot_tpu/server/residency_manager.py"
 
 
 def extract_lease(sources: Optional[Dict[str, str]] = None) -> Extraction:
@@ -647,12 +661,128 @@ def extract_exchange(sources: Optional[Dict[str, str]] = None
     return ex
 
 
+def _with_lock_named(fn: ast.AST, needle: str) -> bool:
+    return any(isinstance(n, ast.With) and
+               any(needle in _u(item.context_expr) for item in n.items)
+               for n in ast.walk(fn))
+
+
+def extract_residency(sources: Optional[Dict[str, str]] = None
+                      ) -> Extraction:
+    """Tiered segment residency (server/residency_manager.py): the
+    staged demote swap (stage/verify → publish tier → drain query pins
+    → release lanes, with the three `residency.*` crash points), the
+    promote swap (reload → upload → publish), and the discipline flags
+    (swap_lock serialization, budget admitted against the LEDGER total,
+    disk→host reload published only after the rebind)."""
+    src = _load(RESIDENCY_PATH, sources)
+    tree = ast.parse(src)
+    dem_fn = _find_def(tree, "ResidencyManager.demote_segment")
+    pro_fn = _find_def(tree, "ResidencyManager.promote_segment")
+    steps = _extract_steps(dem_fn, [
+        ("demote.stage_host",
+         lambda n: _is_call_containing(n, "._stage_host(")),
+        ("demote.crash_staged",
+         lambda n: _is_crash_hit(n, "residency.demote_staged")),
+        ("demote.require_artifact",
+         lambda n: _is_call_containing(n, "._require_artifact(")),
+        ("demote.crash_pre_publish",
+         lambda n: _is_crash_hit(n, "residency.pre_publish")),
+        ("demote.publish_tier", lambda n: isinstance(n, ast.Assign)
+         and _u(n.targets[0]) == "entry.tier"
+         and _u(n.value) == "tier"),
+        ("demote.await_unpinned",
+         lambda n: _is_call_containing(n, "._await_unpinned(")),
+        ("demote.crash_pre_release",
+         lambda n: _is_crash_hit(n, "residency.pre_release")),
+        ("demote.release_lanes",
+         lambda n: _is_call_containing(n, "._release_lanes(")),
+    ])
+    steps += _extract_steps(pro_fn, [
+        ("promote.admit_check",
+         lambda n: _is_call_containing(n, "._admit_device(")),
+        ("promote.reload_artifact",
+         lambda n: _is_call_containing(n, "._reload_from_artifact(")),
+        ("promote.upload",
+         lambda n: _is_call_containing(n, ".warm_device(")),
+        ("promote.publish_tier", lambda n: isinstance(n, ast.Assign)
+         and _u(n.targets[0]) == "entry.tier"
+         and "TIER_DEVICE" in _u(n.value)),
+    ])
+    ex = Extraction("residency", RESIDENCY_PATH,
+                    "ResidencyManager.demote_segment", steps,
+                    flags={}, problems=[])
+    ex.flags["locked_swap"] = (_with_lock_named(dem_fn, "swap_lock") and
+                               _with_lock_named(pro_fn, "swap_lock"))
+    if not ex.flags["locked_swap"]:
+        ex.problems.append(
+            f"{RESIDENCY_PATH}: demote_segment/promote_segment do not "
+            "serialize on entry.swap_lock — concurrent tier transitions "
+            "on one segment can tear the staged swap")
+    # budget admission must read the process-global ledger total (the
+    # ground truth that includes stacks/join/window/exchange bytes),
+    # not a private per-manager estimate
+    admits_by_ledger = False
+    try:
+        adm = _find_def(tree, "ResidencyManager._admit_device")
+        admits_by_ledger = any(
+            _is_call_containing(n, "total_bytes(")
+            for n in ast.walk(adm))
+    except ExtractionError:
+        pass
+    ex.flags["admits_by_ledger"] = admits_by_ledger
+    if not admits_by_ledger:
+        ex.problems.append(
+            f"{RESIDENCY_PATH}::_admit_device: device admission does "
+            "not read LEDGER.total_bytes() — the budget would diverge "
+            "from the ledger ground truth (budget-conservation)")
+    # the disk→host cold path must reload+rebind BEFORE publishing
+    # host tier, or a racing query reads a half-rebound segment
+    reload_before_publish = False
+    try:
+        eh = _find_def(tree, "ResidencyManager.ensure_host")
+        eh_steps = _extract_steps(eh, [
+            ("reload", lambda n: _is_call_containing(
+                n, "._reload_from_artifact(")),
+            ("publish", lambda n: isinstance(n, ast.Assign)
+             and _u(n.targets[0]) == "entry.tier"),
+        ])
+        lines = dict(eh_steps)
+        reload_before_publish = ("reload" in lines and
+                                 "publish" in lines and
+                                 lines["reload"] < lines["publish"])
+    except ExtractionError:
+        pass
+    ex.flags["reload_before_publish"] = reload_before_publish
+    if not reload_before_publish:
+        ex.problems.append(
+            f"{RESIDENCY_PATH}::ensure_host: the disk-tier cold reload "
+            "does not rebind host lanes BEFORE publishing host tier — "
+            "a racing query would read a half-rebound segment")
+    # the one hard shape requirement: the host copy is staged/verified
+    # before the tier flips (everything else — drain order, release
+    # order, artifact verification — surfaces as a model-checker
+    # counterexample rather than a parse error)
+    _require_order(ex, "demote.stage_host", "demote.publish_tier")
+    order = ex.step_order()
+    for required in ("demote.crash_staged", "demote.crash_pre_publish",
+                     "demote.crash_pre_release", "demote.publish_tier",
+                     "demote.await_unpinned", "demote.release_lanes",
+                     "promote.upload", "promote.publish_tier"):
+        if required not in order:
+            ex.problems.append(
+                f"{RESIDENCY_PATH}: required step `{required}` not "
+                "found — the residency shape contract no longer "
+                "matches (see docs/ANALYSIS.md, extraction contract)")
+    return ex
+
+
 def extract_all(sources: Optional[Dict[str, str]] = None
                 ) -> List[Extraction]:
     return [extract_lease(sources), extract_rebalance(sources),
             extract_takeover(sources), extract_seal(sources),
             extract_drain(sources), extract_compact(sources),
-            extract_exchange(sources)]
+            extract_exchange(sources), extract_residency(sources)]
 
 
 # ---------------------------------------------------------------------------
@@ -1735,6 +1865,254 @@ def build_exchange_system(ex: Extraction) -> System:
                    ("bytes-conservation", inv_books)])
 
 
+# -- tiered segment residency ------------------------------------------------
+#
+# World: ONE managed segment and the model's byte unit is its device
+# lane-set. State (tier, dev, host, art, pins, qpc, qroute, dpc, ppc,
+# bad, lost, crashed): published tier (0=device/1=host/2=disk), lane
+# presence bits, the on-disk artifact bit, the query pin, the query's
+# pc + routed tier, the demoter/promoter pcs, and the violation
+# latches. Actors: a demoter that runs the extracted demote program
+# twice (→host, then →disk), a promoter that runs the extracted promote
+# program after it, a query that loops begin(pin)/read/end(unpin), an
+# environment action that deletes the artifact (only before the
+# demoter's verify step has run — verification freezes it), and
+# crash-at-every-step for demoter and promoter (the query's unpin is a
+# `finally`; a process crash kills every actor, which the kill-restart
+# suite covers). The swap_lock is modeled exactly where the code takes
+# it: demote/promote/ensure_host serialize; pin/unpin do not.
+
+_R_KEYS = ("tier", "dev", "host", "art", "pins", "qpc", "qroute",
+           "dpc", "ppc", "bad", "lost", "crashed")
+
+
+def _r_dict(s: tuple) -> dict:
+    return dict(zip(_R_KEYS, s))
+
+
+def _r_tuple(d: dict) -> tuple:
+    return tuple(d[k] for k in _R_KEYS)
+
+
+def build_residency_system(ex: Extraction) -> System:
+    order = ex.step_order()
+    demote_order = [s for s in order if s.startswith("demote.")]
+    promote_order = [s for s in order if s.startswith("promote.")]
+
+    def op_demote(name: str, target: int):
+        def fn(d: dict) -> None:
+            if name == "demote.stage_host":
+                if d["host"] == 0 or d["tier"] == 2:
+                    d["abort"] = 1      # ResidencyError: books untouched
+            elif name == "demote.require_artifact":
+                if d["art"] == 0:
+                    d["abort"] = 1      # unreloadable: refuse the demote
+            elif name == "demote.publish_tier":
+                d["tier"] = target
+            elif name == "demote.release_lanes":
+                d["dev"] = 0
+                if target == 2:
+                    d["host"] = 0
+            # crash_* markers are no-ops: the dem.crash ACTION models
+            # the InjectedCrash at every pc boundary
+        return fn
+
+    # program: (label, op, step name, abort_to) per extracted micro-step
+    # — swap transitions interleave with query pin/read/unpin by design
+    # (the swap_lock does NOT cover the query path)
+    prog: List[tuple] = []
+    attempt_bounds: List[Tuple[int, int]] = []
+
+    def add_attempt(tag: str, target: int) -> None:
+        start = len(prog)
+        names = [n for n in demote_order
+                 if target == 2 or n != "demote.require_artifact"]
+        end = start + len(names)
+        for n in names:
+            prog.append((f"{tag}.{n[7:]}", op_demote(n, target), n, end))
+        attempt_bounds.append((start, end))
+
+    add_attempt("dem1", 1)              # device → host
+    add_attempt("dem2", 2)              # host → disk
+    dem_end = len(prog)
+
+    # the artifact-verification freeze: once the disk attempt has
+    # executed require_artifact, the environment can no longer lose the
+    # artifact out from under the publish (the real code verifies under
+    # the swap_lock it publishes under). A mutated source that skips
+    # verification leaves the environment enabled right up to the disk
+    # publish — the counterexample for publish-without-artifact.
+    disk_start, disk_end_pc = attempt_bounds[1]
+    disk_names = [prog[i][2] for i in range(disk_start, disk_end_pc)]
+    if "demote.require_artifact" in disk_names:
+        env_cutoff = disk_start + disk_names.index(
+            "demote.require_artifact")
+    elif "demote.publish_tier" in disk_names:
+        env_cutoff = disk_start + disk_names.index("demote.publish_tier")
+    else:
+        env_cutoff = disk_end_pc
+
+    def dem_step(idx: int, label: str, op, step: str, abort_to: int
+                 ) -> Action:
+        def enabled(s: tuple) -> bool:
+            if s[7] != idx:
+                return False
+            if step == "demote.await_unpinned":
+                return s[4] == 0        # drains: blocks while pinned
+            return True
+
+        def apply(s: tuple) -> tuple:
+            d = _r_dict(s)
+            op(d)
+            d["dpc"] = abort_to if d.pop("abort", 0) else idx + 1
+            return _r_tuple(d)
+        return Action(label, enabled, apply)
+
+    actions = [dem_step(i, label, op, step, abort_to)
+               for i, (label, op, step, abort_to) in enumerate(prog)]
+
+    def op_promote(name: str):
+        def fn(d: dict) -> None:
+            if name == "promote.reload_artifact":
+                if d["tier"] == 2:
+                    if d["art"]:
+                        d["host"] = 1
+                    else:
+                        d["lost"] = 1   # unrecoverable: data gone
+                        d["abort"] = 1
+            elif name == "promote.upload":
+                if d["host"]:
+                    d["dev"] = 1
+                else:
+                    d["abort"] = 1      # nothing to upload from
+            elif name == "promote.publish_tier":
+                d["tier"] = 0
+        return fn
+
+    pro_prog = [(f"pro.{n[8:]}", op_promote(n)) for n in promote_order]
+    pro_end = len(pro_prog)
+
+    def pro_step(idx: int, label: str, op) -> Action:
+        def enabled(s: tuple) -> bool:
+            return s[7] >= dem_end and s[8] == idx
+
+        def apply(s: tuple) -> tuple:
+            d = _r_dict(s)
+            op(d)
+            d["ppc"] = pro_end if d.pop("abort", 0) else idx + 1
+            return _r_tuple(d)
+        return Action(label, enabled, apply)
+
+    actions += [pro_step(i, label, op)
+                for i, (label, op) in enumerate(pro_prog)]
+
+    # swap_lock: ensure_host (the query's disk-tier cold reload) cannot
+    # run while a demote/promote attempt holds the lock mid-swap
+    swap_boundaries = {0, dem_end} | {b for _a, b in attempt_bounds}
+
+    def swap_idle(s: tuple) -> bool:
+        return (s[7] in swap_boundaries and
+                s[8] in (0, pro_end))
+
+    def qry_begin(s: tuple) -> tuple:
+        d = _r_dict(s)
+        if d["tier"] == 2:
+            # ensure_host: reload from the artifact, publish host tier
+            if d["art"]:
+                d["host"] = 1
+                d["tier"] = 1
+            else:
+                d["lost"] = 1
+        d["qroute"] = 0 if d["tier"] == 0 else 1
+        d["pins"] = 1
+        d["qpc"] = 1
+        return _r_tuple(d)
+
+    def qry_read(s: tuple) -> tuple:
+        d = _r_dict(s)
+        if d["qroute"] == 0 and d["dev"] == 0:
+            d["bad"] = 1
+        if d["qroute"] == 1 and d["host"] == 0:
+            d["bad"] = 1
+        d["qpc"] = 2
+        return _r_tuple(d)
+
+    def qry_end(s: tuple) -> tuple:
+        d = _r_dict(s)
+        d["pins"] = 0
+        d["qpc"] = 0
+        return _r_tuple(d)
+
+    actions.append(Action(
+        "qry.begin",
+        lambda s: s[5] == 0 and (s[0] != 2 or swap_idle(s)), qry_begin))
+    actions.append(Action("qry.read", lambda s: s[5] == 1, qry_read))
+    actions.append(Action("qry.end", lambda s: s[5] == 2, qry_end))
+
+    def dem_crash(s: tuple) -> tuple:
+        d = _r_dict(s)
+        d["dpc"], d["crashed"] = dem_end, 1
+        return _r_tuple(d)
+
+    def pro_crash(s: tuple) -> tuple:
+        d = _r_dict(s)
+        d["ppc"], d["crashed"] = pro_end, 1
+        return _r_tuple(d)
+
+    actions.append(Action("dem.crash", lambda s: s[7] < dem_end,
+                          dem_crash))
+    actions.append(Action("pro.crash",
+                          lambda s: s[7] >= dem_end and s[8] < pro_end,
+                          pro_crash))
+
+    def env_lost(s: tuple) -> tuple:
+        d = _r_dict(s)
+        d["art"] = 0
+        return _r_tuple(d)
+
+    actions.append(Action(
+        "env.artifact_lost",
+        lambda s: s[3] == 1 and s[0] != 2 and s[7] <= env_cutoff,
+        env_lost))
+
+    init = _r_tuple({"tier": 0, "dev": 1, "host": 1, "art": 1,
+                     "pins": 0, "qpc": 0, "qroute": 0, "dpc": 0,
+                     "ppc": 0, "bad": 0, "lost": 0, "crashed": 0})
+
+    def inv_read(s: tuple) -> Optional[str]:
+        if s[9]:
+            return ("a query read a lane its routed tier had already "
+                    "released — demotion must publish the fallback "
+                    "tier, drain in-flight pins, and only then release "
+                    "(no-read-of-released-lane)")
+        return None
+
+    def inv_artifact(s: tuple) -> Optional[str]:
+        if s[0] == 2 and s[3] == 0:
+            return ("disk tier published with no reloadable artifact — "
+                    "the artifact must be verified before the tier "
+                    "flips (promoted-implies-artifact)")
+        if s[10]:
+            return ("a disk-tier reload found no artifact: the segment "
+                    "is unrecoverable (promoted-implies-artifact)")
+        return None
+
+    def inv_budget(s: tuple) -> Optional[str]:
+        quiescent = (s[7] >= dem_end and s[8] in (0, pro_end) and
+                     s[4] == 0 and s[5] == 0 and not s[11])
+        if quiescent and s[0] != 0 and s[1] == 1:
+            return ("an off-device segment's device lanes are still "
+                    "ledger-resident at quiescence — the demote path "
+                    "leaks HBM past the budget (budget-conservation)")
+        return None
+
+    return System("residency", ex.path, ex.line_of("demote.publish_tier"),
+                  init, actions,
+                  [("no-read-of-released-lane", inv_read),
+                   ("promoted-implies-artifact", inv_artifact),
+                   ("budget-conservation", inv_budget)])
+
+
 _BUILDERS = {
     "lease": build_lease_system,
     "rebalance": build_rebalance_system,
@@ -1743,6 +2121,7 @@ _BUILDERS = {
     "drain": build_drain_system,
     "compact-swap": build_compact_system,
     "exchange": build_exchange_system,
+    "residency": build_residency_system,
 }
 
 

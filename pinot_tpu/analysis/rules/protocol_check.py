@@ -1,10 +1,11 @@
 """protocol-invariants / protocol-model: the crash-interleaving gates.
 
-`protocol-invariants` extracts the seven protocol transition systems
+`protocol-invariants` extracts the eight protocol transition systems
 (lease/epoch fencing, rebalance add-then-prune, realtime takeover,
 upsert seal/snapshot/truncate, graceful drain, compaction/merge
-segment swap, exchange publish/ack/fetch/TTL-sweep — see
-analysis/protocol.py) from the LIVE source and exhaustively explores
+segment swap, exchange publish/ack/fetch/TTL-sweep, tiered-residency
+demote/promote swaps — see analysis/protocol.py) from the LIVE source
+and exhaustively explores
 every interleaving of their steps, environment events, and
 crash-at-every-step placements, machine-checking the written
 ROBUSTNESS.md invariants:
@@ -23,6 +24,9 @@ ROBUSTNESS.md invariants:
                                    `expired-fetch-is-typed`,
                                    `no-spurious-overflow`,
                                    `bytes-conservation`)
+7. tiered residency swaps         (residency: `no-read-of-released-lane`,
+                                   `promoted-implies-artifact`,
+                                   `budget-conservation`)
 
 A violated invariant is reported WITH its counterexample trace (the
 ordered step list that reaches the bad state). Per the no-silent-caps
@@ -49,8 +53,8 @@ class ProtocolInvariantsRule(Rule):
     id = "protocol-invariants"
     description = ("exhaustive crash-interleaving model check of the "
                    "extracted lease/rebalance/takeover/upsert-seal/"
-                   "drain/compact-swap/exchange protocols (protocol "
-                   "tier)")
+                   "drain/compact-swap/exchange/residency protocols "
+                   "(protocol tier)")
     tier = "protocol"
 
     def check(self, ctx) -> Iterator[Finding]:

@@ -321,6 +321,13 @@ class ServerMeter:
     # when a retention-deleted segment's keys were garbage-collected
     UPSERT_SEGMENTS_REMAPPED = "upsertSegmentsRemapped"
     UPSERT_KEYS_GCED = "upsertKeysGced"
+    # tiered residency (server/residency_manager.py): segments promoted
+    # back to HBM, segments demoted under budget pressure (per target
+    # tier via the table suffix: "host" | "disk"), and queries that hit
+    # a disk-tier segment and paid the artifact reload
+    RESIDENCY_PROMOTIONS = "residencyPromotions"
+    RESIDENCY_DEMOTIONS = "residencyDemotions"
+    RESIDENCY_COLD_HITS = "residencyColdHits"
 
 
 class ControllerMeter:
@@ -384,3 +391,9 @@ class ServerGauge:
     UPSERT_KEY_MAP_SIZE = "upsertKeyMapSize"
     # admission control queue depth (submitted minus completed)
     ADMISSION_QUEUE_DEPTH = "admissionQueueDepth"
+    # tiered residency: per-tier twins of deviceBytesResident (the
+    # `|tier:<tier>` registry suffix renders as a `tier` label) plus
+    # the count of segments hot enough for HBM but still waiting on a
+    # promotion slot — the admission brownout watermark input
+    RESIDENCY_TIER_BYTES = "residencyTierBytes"
+    RESIDENCY_PROMOTION_BACKLOG = "residencyPromotionBacklog"

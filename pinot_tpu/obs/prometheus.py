@@ -46,7 +46,9 @@ def _label_pairs(table: Optional[str]) -> List[str]:
     """Label assignments for a registry table suffix. A plain suffix is
     the reference's table-level convention (→ ``table`` label); a
     ``<table>|<kind>`` suffix (the residency gauges) splits into
-    ``table`` + ``kind`` labels, empty parts omitted."""
+    ``table`` + ``kind`` labels, empty parts omitted. A ``tier:<tier>``
+    kind part (the residency manager's per-tier twins) renders as a
+    ``tier`` label instead of a kind."""
     if table is None:
         return []
     if "|" in table:
@@ -54,7 +56,9 @@ def _label_pairs(table: Optional[str]) -> List[str]:
         pairs = []
         if tbl:
             pairs.append(f'table="{_escape_label(tbl)}"')
-        if kind:
+        if kind.startswith("tier:"):
+            pairs.append(f'tier="{_escape_label(kind[5:])}"')
+        elif kind:
             pairs.append(f'kind="{_escape_label(kind)}"')
         return pairs
     return [f'table="{_escape_label(table)}"']

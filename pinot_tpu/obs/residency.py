@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import threading
 import weakref
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from pinot_tpu.common.metrics import CommonGauge
 
@@ -69,6 +69,10 @@ class ResidencyLedger:
         # leave the books on scrape, not on the next put/get (the
         # bytes-conservation invariant the protocol model checks)
         self._sweepers: List[Callable[[], int]] = []
+        # optional snapshot-entry annotator (the residency manager adds
+        # tier + last-access heat so /debug/residency says WHY a byte
+        # is resident, not just that it is)
+        self._entry_annotator: Optional[Callable[[dict], None]] = None
 
     # -- accounting --------------------------------------------------------
     def register(self, owner: str, *, table: str, segment: str,
@@ -137,7 +141,7 @@ class ResidencyLedger:
                 tables.setdefault(table or "", {})[kind] = n
             largest = sorted(self._entries.items(),
                              key=lambda kv: -kv[1][3])[:max_entries]
-            return {
+            snap = {
                 "totalDeviceBytesResident": self._total,
                 "byKind": {k: v for k, v in sorted(self._by_kind.items())
                            if v},
@@ -149,6 +153,19 @@ class ResidencyLedger:
                     for o, (t, s, k, n) in largest],
                 "entryCount": len(self._entries),
             }
+            annot = self._entry_annotator
+        if annot is not None:
+            for entry in snap["entries"]:
+                annot(entry)
+        return snap
+
+    def set_entry_annotator(self, fn: Callable[[dict], None]) -> None:
+        """Install (or clear, with None) a per-entry snapshot annotator.
+        The residency manager uses this to stamp `tier` and `heat`
+        columns onto entries it tracks — annotation runs OUTSIDE the
+        ledger lock, on the already-built entry dicts."""
+        with self._lock:
+            self._entry_annotator = fn
 
     # -- sweep hooks (exchange TTL) ----------------------------------------
     def add_sweeper(self, fn: Callable[[], int]) -> None:

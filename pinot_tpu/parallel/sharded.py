@@ -585,6 +585,15 @@ class ShardedQueryExecutor:
             for key in [k for k in self._stacks if segment_name in k]:
                 del self._stacks[key]
 
+    def evict_all(self) -> None:
+        """Drop every cached stack. Wired as a residency-manager
+        pressure hook: under device-budget pressure the duplicated
+        stack lanes are the cheapest HBM to reclaim (stacks rebuild
+        from retained host arrays on the next homogeneous query)."""
+        with self._lock:
+            self._evict_gen += 1
+            self._stacks.clear()
+
     def execute(self, request: BrokerRequest,
                 segments: Sequence[ImmutableSegment]
                 ) -> IntermediateResultsBlock:

@@ -43,6 +43,13 @@ class ServerQueryExecutor:
         self.use_device = use_device
         # the scheduler's query-worker pool; None → sequential loop
         self.segment_executor = segment_executor
+        # residency gates (server/residency_manager.py): device_gate
+        # routes host/disk-tier segments through host_exec instead of
+        # the device kernels; mutable_gate blocks frozen-snapshot
+        # uploads under HBM pressure. None (the default) keeps the
+        # ungated device-first behavior.
+        self.device_gate = None
+        self.mutable_gate = None
 
     def execute(self, request: BrokerRequest,
                 segments: List[ImmutableSegment],
@@ -150,7 +157,8 @@ class ServerQueryExecutor:
                             ) -> Tuple[List[IntermediateResultsBlock],
                                        int, int]:
         if self.use_device and getattr(seg, "is_mutable", False) and \
-                hasattr(seg, "device_view"):
+                hasattr(seg, "device_view") and \
+                (self.mutable_gate is None or self.mutable_gate(seg)):
             # consuming segment: the periodic sorted snapshot serves the
             # frozen prefix on the DEVICE kernels and the post-freeze
             # tail host-side; the two parts combine like any other pair
@@ -272,7 +280,8 @@ class ServerQueryExecutor:
             if blk is not None:
                 obs_profiler.count_path("cube")
                 return blk
-        if self.use_device:
+        if self.use_device and \
+                (self.device_gate is None or self.device_gate(segment)):
             try:
                 with obs_span(ServerQueryPhase.BUILD_QUERY_PLAN):
                     plan = self.plan_maker.make_segment_plan(segment,

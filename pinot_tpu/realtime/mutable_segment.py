@@ -643,6 +643,15 @@ class MutableSegmentImpl:
             return None, self.snapshot_view()
         return snap, self.snapshot_view(start=snap.num_docs)
 
+    def release_device_snapshot(self) -> None:
+        """Graceful degradation under HBM pressure (the residency
+        manager's pressure hook): drop the frozen device snapshot.
+        In-flight queries keep their reference (GC releases the lanes
+        when the last drops); new queries serve the full row range
+        host-side until the executor's mutable gate re-admits a freeze."""
+        with self._freeze_lock:
+            self._frozen = None
+
     def _build_frozen(self, n: int):
         """Rows [0, n) as a sorted-dictionary in-memory ImmutableSegment."""
         from pinot_tpu.segment.dictionary import Dictionary

@@ -11,6 +11,7 @@ sees static shapes (SURVEY.md §7 — padded power-of-two blocks instead of mmap
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, Optional
 
 import numpy as np
@@ -143,16 +144,22 @@ class DataSource:
     @property
     def raw_values(self) -> Optional[np.ndarray]:
         if self._raw_values is None and self.raw_chunks is not None:
-            self._raw_values = self.raw_chunks.decode_all()
+            with self._lane_lock:
+                if self._raw_values is None:
+                    self._raw_values = self.raw_chunks.decode_all()
         return self._raw_values
 
     @raw_values.setter
     def raw_values(self, arr) -> None:
-        self._raw_values = arr
+        with self._lane_lock:
+            self._raw_values = arr
 
     def __init__(self, metadata: ColumnMetadata, segment: "ImmutableSegment"):
         self.metadata = metadata
         self._segment = segment
+        # one lock for every host-lane writer: lazy raw decode, lazy
+        # HLL tables, and the residency tier's release/adopt swaps
+        self._lane_lock = threading.Lock()
         self.dictionary: Optional[Dictionary] = None
         # host arrays
         self.dict_ids: Optional[np.ndarray] = None        # int32 [num_docs]
@@ -225,7 +232,10 @@ class DataSource:
         into 7-bit slices: value = min_value + sum_k part_k << (7k).
         """
         if self._part_info is None:
-            self._part_info = int_part_info_for(self.dictionary.values)
+            with self._lane_lock:
+                if self._part_info is None:
+                    self._part_info = int_part_info_for(
+                        self.dictionary.values)
         return self._part_info
 
     def host_operand(self, kind: str) -> np.ndarray:
@@ -267,7 +277,10 @@ class DataSource:
             return out
         if kind in ("hllidx", "hllrank"):
             if self._hll_tables is None:
-                self._hll_tables = hll_tables_padded(self.dictionary.values)
+                with self._lane_lock:
+                    if self._hll_tables is None:
+                        self._hll_tables = hll_tables_padded(
+                            self.dictionary.values)
             return self._hll_tables[0 if kind == "hllidx" else 1]
         raise ValueError(kind)
 
@@ -287,19 +300,23 @@ class DataSource:
             import weakref
             from pinot_tpu.obs import residency
             seg = self._segment
-            if self._dev_finalizer is None:
-                # superseded frozen snapshots are freed by GC, not
-                # destroy() — the finalizer keeps the ledger truthful
-                # on that path too (release_prefix is idempotent)
-                self._dev_finalizer = weakref.finalize(
-                    self, residency.LEDGER.release_prefix,
-                    f"ds:{id(self)}:")
-            self._dev[key] = residency.ledgered_asarray(
-                host_array,
-                owner=f"ds:{id(self)}:{key}",
-                table=seg.metadata.table_name if seg is not None else "",
-                segment=seg.segment_name if seg is not None else "",
-                kind=self._LEDGER_KINDS.get(key, "scan"))
+            with self._lane_lock:
+                if self._dev_finalizer is None:
+                    # superseded frozen snapshots are freed by GC, not
+                    # destroy() — the finalizer keeps the ledger truthful
+                    # on that path too (release_prefix is idempotent)
+                    self._dev_finalizer = weakref.finalize(
+                        self, residency.LEDGER.release_prefix,
+                        f"ds:{id(self)}:")
+                if key not in self._dev:
+                    self._dev[key] = residency.ledgered_asarray(
+                        host_array,
+                        owner=f"ds:{id(self)}:{key}",
+                        table=seg.metadata.table_name
+                        if seg is not None else "",
+                        segment=seg.segment_name
+                        if seg is not None else "",
+                        kind=self._LEDGER_KINDS.get(key, "scan"))
         return self._dev[key]
 
     def release_device(self) -> None:
@@ -308,6 +325,65 @@ class DataSource:
         from pinot_tpu.obs import residency
         self._dev.clear()
         residency.LEDGER.release_prefix(f"ds:{id(self)}:")
+
+    def device_bytes_estimate(self) -> int:
+        """Bytes `warm_device` would pin in HBM for this column, from
+        metadata alone (no array is materialized or uploaded) — the
+        residency manager's admission charge for a not-yet-resident
+        segment."""
+        from pinot_tpu.ops.kernels import pow2_bucket
+        cm = self.metadata
+        n = cm.total_number_of_entries
+        if self.dict_ids is not None or \
+                (cm.has_dictionary and cm.single_value):
+            total = padded_size(n) * min_id_dtype(cm.cardinality).itemsize
+            if cm.data_type.is_numeric:
+                total += pow2_bucket(cm.cardinality + 1) * \
+                    cm.data_type.np_dtype.itemsize
+            return total
+        if self.vec_values is not None:
+            rows = len(self.vec_values)
+            return padded_size(rows) * vec_dim_pad(
+                cm.vector_dimension) * 4
+        if self.raw_chunks is not None:
+            return 0              # no device lane for chunked raw
+        if self.raw_values is not None:
+            return padded_size(len(self.raw_values)) * \
+                self.raw_values.dtype.itemsize \
+                if self.raw_values.dtype.kind != "O" else 0
+        if self.mv_dict_ids is not None:
+            return padded_size(self.mv_dict_ids.shape[0]) * \
+                self.mv_dict_ids.shape[1] * 4
+        if cm.has_dictionary and not cm.single_value:
+            return padded_size(n) * 4
+        return 0
+
+    def release_host(self) -> None:
+        """Drop the fat host-side row payloads (forward indexes, raw
+        values, embeddings) for the disk residency tier. Dictionaries,
+        inverted/bloom indexes and chunked-raw readers stay — they are
+        dictionary-scale (or already disk-backed) and the pruner still
+        needs them. `adopt_host` restores the dropped arrays from a
+        freshly loaded copy of the same artifact."""
+        with self._lane_lock:
+            self.dict_ids = None
+            self._raw_values = None
+            self.mv_dict_ids = None
+            self.vec_values = None
+            self._hll_tables = None
+
+    def adopt_host(self, fresh: "DataSource") -> None:
+        """Rebind host row payloads from a freshly loaded DataSource of
+        the same column (disk-tier reload). Object identity of `self`
+        is preserved so data-manager refs, sharded caches and in-flight
+        plans keyed on the live object stay valid."""
+        with self._lane_lock:
+            self.dict_ids = fresh.dict_ids
+            self._raw_values = fresh._raw_values
+            self.mv_dict_ids = fresh.mv_dict_ids
+            self.vec_values = fresh.vec_values
+            if fresh.raw_chunks is not None:
+                self.raw_chunks = fresh.raw_chunks
 
 
 class ImmutableSegment:
@@ -435,12 +511,47 @@ class ImmutableSegment:
             elif ds.mv_dict_ids is not None:
                 ds.device_mv_dict_ids()
 
-    def destroy(self) -> None:
+    def device_bytes_estimate(self) -> int:
+        """Bytes a full `warm_device` (plus the upsert vdoc lane, when
+        one exists) would pin in HBM — the residency manager's
+        admission charge, computed without touching the device."""
+        total = sum(ds.device_bytes_estimate()
+                    for ds in self._data_sources.values())
+        if self.valid_doc_ids is not None:
+            total += self.padded_docs        # bool lane, 1 byte/row
+        return total
+
+    def release_device_lanes(self) -> None:
+        """Drop every device lane (vdoc included) and the ledger
+        entries backing them, keeping host arrays intact — the
+        device→host demotion step. Re-access re-uploads lazily."""
         from pinot_tpu.obs import residency
-        self._valid_dev = None  # tpulint: disable=concurrency -- destroy runs after the refcounted release of the last query; worst case a racing reader re-uploads one lane
+        self._valid_dev = None  # tpulint: disable=concurrency -- the residency manager drains query pins before releasing; worst case a racing reader re-uploads one lane
         residency.LEDGER.release(f"seg:{id(self)}:vdoc")
         for ds in self._data_sources.values():
             ds.release_device()
+
+    def release_host_lanes(self, columns) -> None:
+        """Drop the named columns' fat host payloads (host→disk
+        demotion). Only columns the on-disk artifact can restore may be
+        named — the residency manager verifies the artifact first."""
+        for name in columns:
+            ds = self._data_sources.get(name)
+            if ds is not None:
+                ds.release_host()
+
+    def rebind_host_lanes(self, fresh: "ImmutableSegment") -> None:
+        """Re-populate host payloads from a freshly loaded copy of the
+        same artifact (disk-tier reload), preserving this object's
+        identity so refcounted managers and caches stay valid."""
+        for name, ds in self._data_sources.items():
+            src = fresh._data_sources.get(name)
+            if src is not None:
+                ds.adopt_host(src)
+
+    def destroy(self) -> None:
+        self._valid_dev = None  # tpulint: disable=concurrency -- destroy runs after the refcounted release of the last query; worst case a racing reader re-uploads one lane
+        self.release_device_lanes()
 
 
 class ImmutableSegmentLoader:

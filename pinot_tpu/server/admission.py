@@ -94,6 +94,11 @@ class AdmissionController:
     BROWNOUT_FACTOR = 2.0     # brownout deadline = estimate × factor
     BROWNOUT_FLOOR_MS = 25.0  # ...never tighter than this floor
     MIN_TENANT_SHARE = 2      # fair-share floor per tenant (queries)
+    # residency promotion backlog (hot segments stuck off-device) at or
+    # above this → brownout regardless of queue depth: a reload storm
+    # means queries are already paying cold/host penalties, so tighten
+    # deadlines early instead of timing out late
+    PROMOTION_BACKLOG_WATERMARK = 4
 
     def __init__(self, metrics: Optional[MetricsRegistry] = None,
                  estimator: Optional[ServiceTimeEstimator] = None,
@@ -101,7 +106,8 @@ class AdmissionController:
                  low_pct: float = 0.4, mid_pct: float = 0.7,
                  high_pct: float = 0.9,
                  num_workers: int = 4,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 backlog_fn: Optional[Callable[[], int]] = None):
         self.metrics = metrics or MetricsRegistry("server")
         self.estimator = estimator or ServiceTimeEstimator(self.metrics)
         self.max_pending = int(max_pending)
@@ -110,6 +116,8 @@ class AdmissionController:
         self.high = max(3, int(max_pending * high_pct))
         self.num_workers = max(1, num_workers)
         self._clock = clock
+        # reads the residency manager's promotionBacklog gauge value
+        self._backlog_fn = backlog_fn
         self._depth = 0
         self._by_tenant: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -147,8 +155,11 @@ class AdmissionController:
               budget_ms: Optional[float] = None,
               hedge: bool = False) -> AdmissionDecision:
         # the estimator read happens OUTSIDE self._lock (it takes the
-        # timer's own lock; no nesting)
+        # timer's own lock; no nesting); same for the residency
+        # promotion backlog (it takes the manager's lock)
         est = self.estimator.estimate_ms(table)
+        backlogged = self._backlog_fn is not None and \
+            self._backlog_fn() >= self.PROMOTION_BACKLOG_WATERMARK
         now = self._clock()
         with self._lock:
             depth = self._depth
@@ -183,7 +194,7 @@ class AdmissionController:
                         "tenantOverQuota",
                         self._drain_estimate_ms(
                             self._by_tenant.get(tenant, 0), est))
-            brownout = depth >= self.high
+            brownout = depth >= self.high or backlogged
             self._depth = depth + 1
             self._by_tenant[tenant] = self._by_tenant.get(tenant, 0) + 1
         deadline_s = None

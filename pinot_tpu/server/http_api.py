@@ -128,8 +128,16 @@ class ServerApiServer(ApiServer):
     async def _residency(self, request: HttpRequest) -> HttpResponse:
         """The process-global residency ledger: every accounted device
         upload (scan/vdoc/vector/hll/stack/join/window lanes + exchange
-        held bytes) by table and kind, with the largest owners. This is
-        the ledger view the `deviceBytesResident{table,kind}` gauges
+        held bytes) by table and kind, with the largest owners — each
+        entry annotated with the residency manager's `tier` and
+        last-access `heat` when the segment is under management. The
+        `manager` block adds the tier map (budget, per-tier totals,
+        per-segment tier/heat/pins/coldHits, promotion backlog). This
+        is the ledger view the `deviceBytesResident{table,kind}` gauges
         export — /debug/memory remains the per-segment lane walk."""
         from pinot_tpu.obs.residency import LEDGER
-        return HttpResponse.of_json(LEDGER.snapshot())
+        snap = LEDGER.snapshot()
+        residency = getattr(self.server, "residency", None)
+        if residency is not None:
+            snap["manager"] = residency.snapshot()
+        return HttpResponse.of_json(snap)

@@ -39,18 +39,42 @@ class ServerInstance:
                  scheduler: str = "fcfs", num_workers: int = 4,
                  mesh=None, use_device: bool = True,
                  max_pending: Optional[int] = None,
-                 result_cache_entries: int = 256):
+                 result_cache_entries: int = 256,
+                 device_bytes_budget: Optional[int] = None):
         self.instance_id = instance_id
         self.metrics = MetricsRegistry("server")
         from pinot_tpu.obs import residency
         residency.bind_registry(self.metrics)
         self.data_manager = InstanceDataManager()
+        # tiered residency: this instance's segments demote HBM → host
+        # → disk under the device byte budget (config `deviceBytesBudget`
+        # or env PINOT_TPU_DEVICE_BYTES_BUDGET; unset = unbounded, the
+        # pre-manager behavior). Per-instance manager: its entries and
+        # hooks die with the instance, while admission reads the
+        # PROCESS-global ledger so colocated instances see real pressure.
+        from pinot_tpu.server.residency_manager import (
+            ResidencyManager, budget_from_env, host_budget_from_env)
+        self.residency = ResidencyManager(
+            device_bytes_budget if device_bytes_budget is not None
+            else budget_from_env(), host_budget_from_env())
+        self.residency.bind_metrics(self.metrics)
+        self.data_manager.add_removal_listener(self.residency.untrack)
         self.scheduler: QueryScheduler = make_scheduler(scheduler,
                                                         num_workers)
         self.executor = InstanceQueryExecutor(
             self.data_manager, mesh=mesh, use_device=use_device,
             metrics=self.metrics,
-            segment_executor=self.scheduler.segment_pool)
+            segment_executor=self.scheduler.segment_pool,
+            residency=self.residency)
+        if self.executor.sharded is not None:
+            # a demoted segment's stacked twin must drop with it, and
+            # the (rebuildable) stack caches are the cheapest HBM to
+            # reclaim under pressure
+            self.residency.add_release_hook(
+                self.executor.sharded.evict_segment)
+            self.residency.add_pressure_hook(
+                self.executor.sharded.evict_all)
+        self.residency.add_pressure_hook(self._release_mutable_snapshots)
         # admission control + CRC-exact result cache (hits bypass the
         # admission queue — the degradation valve under overload)
         self.estimator = ServiceTimeEstimator(self.metrics)
@@ -58,7 +82,8 @@ class ServerInstance:
             metrics=self.metrics, estimator=self.estimator,
             max_pending=max_pending if max_pending is not None
             else max(16, 16 * num_workers),
-            num_workers=num_workers)
+            num_workers=num_workers,
+            backlog_fn=self.residency.promotion_backlog)
         self.result_cache = ServerResultCache(
             max_entries=result_cache_entries)
         # exchange plane (multi-stage queries): published stage-1 blocks
@@ -83,6 +108,25 @@ class ServerInstance:
         # guards the start/stop lifecycle fields (_loop/_server/port):
         # an admin-triggered stop can race a late start on another thread
         self._lifecycle_lock = threading.Lock()
+
+    def _release_mutable_snapshots(self) -> None:
+        """Residency pressure hook: drop consuming segments' frozen
+        device snapshots (rebuildable caches — in-flight queries keep
+        their references; GC releases the lanes)."""
+        for table in self.data_manager.table_names():
+            tdm = self.data_manager.table(table)
+            if tdm is None:
+                continue
+            sdms, _ = tdm.acquire_segments()
+            try:
+                for sdm in sdms:
+                    release = getattr(sdm.segment,
+                                      "release_device_snapshot", None)
+                    if release is not None:
+                        release()
+            finally:
+                for sdm in sdms:
+                    tdm.release_segment(sdm)
 
     # -- request path ------------------------------------------------------
     def _deserialize(self, payload: bytes
@@ -505,3 +549,4 @@ class ServerInstance:
         self.scheduler.shutdown()
         self.data_manager.shutdown()
         self.exchange.close()
+        self.residency.shutdown()

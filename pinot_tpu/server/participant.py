@@ -186,13 +186,21 @@ class ServerParticipant(StateModel):
         from pinot_tpu.common.table_name import raw_table
         schema = self.manager.get_schema(raw_table(table))
         config = self.manager.get_table_config(table)
+        seg_dir = self._fetch_segment_dir(table, segment,
+                                          meta["downloadPath"],
+                                          expected_crc=meta.get("crc"))
         seg = ImmutableSegmentLoader.load(
-            self._fetch_segment_dir(table, segment, meta["downloadPath"],
-                                    expected_crc=meta.get("crc")),
-            schema=schema,
+            seg_dir, schema=schema,
             index_loading_config=(config.indexing_config
                                   if config else None))
         self.server.data_manager.table(table, create=True).add_segment(seg)
+        # residency admission: the manager decides the attach tier
+        # (device within budget, host over it) and keeps the verified
+        # local artifact dir as the disk-tier reload source; device
+        # warm-up stays routed through it (lazy by default)
+        residency = getattr(self.server, "residency", None)
+        if residency is not None:
+            residency.track(table, seg, seg_dir=seg_dir)
 
     def on_become_offline(self, table: str, segment: str) -> None:
         if self._realtime is not None and table.endswith("_REALTIME"):

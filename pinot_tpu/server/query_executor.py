@@ -31,13 +31,23 @@ class InstanceQueryExecutor:
                  mesh=None, use_device: bool = True,
                  default_timeout_ms: float = 15_000.0,
                  metrics: Optional[MetricsRegistry] = None,
-                 segment_executor=None):
+                 segment_executor=None, residency=None):
         self.data_manager = data_manager
         # segment_executor: the scheduler's query-worker pool — per-
         # segment plans fan out on it (CombineOperator parity); None
         # keeps the sequential per-segment loop
         self.executor = ServerQueryExecutor(
             use_device=use_device, segment_executor=segment_executor)
+        # residency manager: heat accounting, tier routing (host/disk-
+        # tier segments execute through host_exec), query pins so a
+        # concurrent demotion never releases a lane mid-read. Defaults
+        # to the process-global manager, which is unbudgeted (= the
+        # pre-manager behavior) until someone configures a budget.
+        from pinot_tpu.server import residency_manager
+        self.residency = residency if residency is not None \
+            else residency_manager.MANAGER
+        self.executor.device_gate = self.residency.device_allowed
+        self.executor.mutable_gate = self.residency.mutable_device_allowed
         self.sharded = None
         if mesh is not None:
             from pinot_tpu.parallel.sharded import ShardedQueryExecutor
@@ -94,6 +104,11 @@ class InstanceQueryExecutor:
 
         profile = QueryProfile(query.table_name)
         acquired, missing = tdm.acquire_segments(request.search_segments)
+        # residency entry: bump heat, reload disk-tier segments, pin
+        # lane epochs so demotion drains us before releasing (paired
+        # end_query in the finally below)
+        residency_token = self.residency.begin_query(
+            [s.segment for s in acquired])
         try:
             segments = [s.segment for s in acquired]
             # capture result-cache key states BEFORE execution: an
@@ -171,6 +186,7 @@ class InstanceQueryExecutor:
                 dt.metadata["traceInfo"] = trace.to_json_str()
             return dt
         finally:
+            self.residency.end_query(residency_token)
             for sdm in acquired:
                 tdm.release_segment(sdm)
 
@@ -219,7 +235,11 @@ class InstanceQueryExecutor:
     def _execute_segments(self, query, segments: List, trace: TraceContext,
                           deadline: Optional[float] = None
                           ) -> IntermediateResultsBlock:
-        if self.sharded is not None and len(segments) > 1:
+        # the sharded combine stacks ALL segments' lanes in HBM — it
+        # only applies when every segment is device-tier (a demoted
+        # segment must not be re-uploaded through the stack path)
+        if self.sharded is not None and len(segments) > 1 and \
+                all(self.residency.device_allowed(s) for s in segments):
             from pinot_tpu.parallel.sharded import NotShardable
             from pinot_tpu.query.plan import (GroupsLimitExceeded,
                                               UnsupportedOnDevice)

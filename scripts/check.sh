@@ -89,6 +89,15 @@ echo "== obs smoke (observability plane) =="
 # trace tree with per-server subtrees
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
+echo "== residency smoke (tiered memory pressure) =="
+# a working set ~3x the device budget must serve with graceful
+# degradation: every answer bit-equal to the unbounded twin run, the
+# HBM ledger never above budget at checkpoints, the full
+# device->host->disk ladder exercised (promotions/demotions/cold hits
+# all nonzero), and a bounded p99 penalty — never a cliff or a wrong
+# answer
+env JAX_PLATFORMS=cpu python scripts/residency_smoke.py
+
 echo "== tpulint (deep + protocol tiers) =="
 # --deep adds the below-the-AST gates on top of the AST families:
 # every registered kernel is traced with jax.make_jaxpr across the

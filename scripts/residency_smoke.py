@@ -1,0 +1,316 @@
+"""Residency smoke gate: a working set 2-4x the HBM budget must serve
+with graceful degradation — zero unflagged errors, exact results, the
+device ledger never above budget, and a bounded p99 penalty versus the
+unbounded twin run.
+
+Two sequential phases over the SAME on-disk segments (a skewed SSB
+aggregation mix plus a vector-similarity table):
+
+1. unbounded — no device budget (the pre-manager behavior): every
+   query runs device-resident; records the answer key and baseline
+   p50/p99.
+2. budgeted  — deviceBytesBudget ~ 1/3 of the working set (plus a host
+   budget so the coldest host-tier segments continue to disk, driving
+   the full device→host→disk→host ladder). The access skew flips
+   mid-run, so yesterday's hot segments must demote to admit today's,
+   and disk-tier stragglers pay metered cold-hit reloads on access.
+
+Gates:
+
+- every response in BOTH phases is exception-free and bit-equal to the
+  unbounded phase's answer for the same (query, segment-subset) — a
+  demoted segment must degrade to the host/disk path, never to a wrong
+  or failed answer;
+- ``LEDGER.total_bytes() <= budget`` at EVERY checkpoint — eviction is
+  budget-conserving, the machine-checked ledger ground truth;
+- the tiering engaged: promotions > 0, demotions > 0, cold hits > 0
+  (a smoke that never leaves device tier proves nothing);
+- budgeted p99 <= GRACE_FACTOR x unbounded p99 + floor — degradation
+  is a latency story, not a cliff.
+
+Set RESIDENCY_ARTIFACT to write the JSON artifact (the committed
+RESIDENCY_r13.json at the repo root came from this script).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the exactness gate compares device-path and host-path answers
+# bit-for-bit, which requires the same accumulator widths on both —
+# x64 on, exactly like tests/conftest.py and the oracle suite
+import jax  # noqa: E402
+jax.config.update("jax_enable_x64", True)
+
+ROWS = int(os.environ.get("RESIDENCY_ROWS", 4000))
+SEGMENTS = int(os.environ.get("RESIDENCY_SEGMENTS", 8))
+VEC_SEGMENTS = 2
+VEC_N = 512
+VEC_DIM = 16
+QUERIES = int(os.environ.get("RESIDENCY_QUERIES", 160))
+CHECK_EVERY = 20                 # ledger checkpoint cadence (queries)
+BUDGET_DIVISOR = 3.0             # working set ~3x the device budget
+HOST_SEGS_BUDGET = 2.5           # host tier holds ~this many segments
+GRACE_FACTOR = 10.0              # budgeted p99 vs unbounded p99 bound
+GRACE_FLOOR_MS = 150.0           # CI-noise floor on top of the ratio
+# heat half-life is 30s of MANAGER-clock time; the driver feeds the
+# manager a virtual clock advancing this much per query, so the
+# hot-set flip plays out the same decay curve deterministically on any
+# CI box instead of needing minutes of wall time
+VIRTUAL_S_PER_QUERY = 1.5
+
+
+def build_vec_dirs(base):
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import (Schema, dimension, metric,
+                                         vector)
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    schema = Schema("vectab", [
+        dimension("shard", DataType.INT),
+        metric("rid", DataType.INT),
+        vector("emb", VEC_DIM),
+    ])
+    rng = np.random.default_rng(23)
+    dirs = []
+    for s in range(VEC_SEGMENTS):
+        cols = {
+            "shard": rng.integers(0, 4, VEC_N).astype(np.int32),
+            "rid": (np.arange(VEC_N, dtype=np.int32) + s * VEC_N),
+            "emb": rng.standard_normal(
+                (VEC_N, VEC_DIM)).astype(np.float32),
+        }
+        d = os.path.join(base, f"vec_{s}")
+        SegmentCreator(schema, TableConfig("vectab"),
+                       segment_name=f"vec_{s}").build(cols, d)
+        dirs.append(d)
+    return dirs, rng.standard_normal(VEC_DIM).astype(np.float32)
+
+
+def _canon(v):
+    """numpy/jax scalars → python scalars: the host path hands back
+    np.float32 where the device path hands a python float of the SAME
+    value; the gate compares values, not container reprs."""
+    return repr(v.item() if hasattr(v, "item") else v)
+
+
+def result_key(dt):
+    """Canonical, metadata-free view of a DataTable result for the
+    exactness gate (timings and execution-path tags excluded — the
+    PATH is allowed to change under pressure, the answer is not)."""
+    blk = dt.to_block()
+    if blk.agg_intermediates is not None:
+        return tuple(_canon(v) for v in blk.agg_intermediates)
+    if blk.selection_rows is not None:
+        return tuple(tuple(map(_canon, r)) for r in blk.selection_rows)
+    if blk.selection_cols is not None:
+        rows = zip(*[list(c) for c in blk.selection_cols])
+        return tuple(tuple(map(_canon, r)) for r in rows)
+    if blk.group_map is not None:
+        return tuple(sorted((_canon(k), _canon(v))
+                            for k, v in blk.group_map.items()))
+    return ("empty",)
+
+
+def run_phase(ssb_dirs, vec_dirs, vec_q, budget, host_budget,
+              answers=None):
+    """One full serve cycle; returns (report, answers, failures)."""
+    from pinot_tpu.common.metrics import MetricsRegistry, ServerMeter
+    from pinot_tpu.common.request import InstanceRequest
+    from pinot_tpu.obs.residency import LEDGER
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.server.data_manager import InstanceDataManager
+    from pinot_tpu.server.query_executor import InstanceQueryExecutor
+    from pinot_tpu.server.residency_manager import ResidencyManager
+
+    metrics = MetricsRegistry("server")
+    clk = [0.0]
+    mgr = ResidencyManager(budget, host_budget, clock=lambda: clk[0])
+    mgr.bind_metrics(metrics)
+    dm = InstanceDataManager()
+    dm.add_removal_listener(mgr.untrack)
+    executor = InstanceQueryExecutor(dm, metrics=metrics, residency=mgr)
+
+    segs, names = [], []
+    for table, dirs in (("lineorder", ssb_dirs), ("vectab", vec_dirs)):
+        tdm = dm.table(table, create=True)
+        for d in dirs:
+            seg = ImmutableSegmentLoader.load(d)
+            tdm.add_segment(seg)
+            # attach admission + eager warm-up ROUTED through the
+            # manager: over-budget attaches land host-tier and are
+            # simply not warmed (the raw seg.warm_device() bypass is
+            # what serving paths must never call)
+            mgr.track(table, seg, seg_dir=d)
+            mgr.warm_device(seg.segment_name)
+            segs.append(seg)
+            if table == "lineorder":
+                names.append(seg.segment_name)
+
+    qs = ", ".join(repr(float(x)) for x in vec_q)
+    ssb_pql = compile_pql(
+        "SELECT COUNT(*), SUM(lo_revenue), MAX(lo_supplycost) "
+        "FROM lineorder WHERE lo_quantity < 30")
+    vec_pql = compile_pql(
+        f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], 10, 'COSINE') "
+        "FROM vectab")
+
+    rng = np.random.default_rng(7)
+    answers = {} if answers is None else answers
+    failures = []
+    lat_ms = []
+    checkpoints = []
+    phase_answers = {}
+
+    for i in range(QUERIES):
+        clk[0] += VIRTUAL_S_PER_QUERY
+        # the skew flips mid-run to segments that attach left OFF the
+        # device tier: today's hot set must be cold-hit reloaded and
+        # then promoted by demoting yesterday's
+        hot = names[:2] if i < QUERIES // 2 else names[4:6]
+        r = rng.random()
+        if r < 0.6:
+            req = InstanceRequest(request_id=i, query=ssb_pql)
+            req.search_segments = list(hot)
+            key = ("ssb", tuple(hot))
+        elif r < 0.9:
+            req = InstanceRequest(request_id=i, query=ssb_pql)
+            key = ("ssb", ("*",))
+        else:
+            req = InstanceRequest(request_id=i, query=vec_pql)
+            key = ("vec", ("*",))
+        t0 = time.perf_counter()
+        dt = executor.execute(req)
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+        if dt.exceptions:
+            failures.append(f"query {i} {key}: {dt.exceptions}")
+            continue
+        got = result_key(dt)
+        phase_answers.setdefault(key, got)
+        if key in answers and answers[key] != got:
+            failures.append(f"query {i} {key}: answer drifted under "
+                            "memory pressure")
+        if (i + 1) % CHECK_EVERY == 0:
+            total = LEDGER.total_bytes()
+            checkpoints.append(total)
+            if budget is not None and total > budget:
+                failures.append(
+                    f"checkpoint after query {i + 1}: ledger {total} "
+                    f"bytes exceeds budget {budget}")
+
+    def meter_total(name):
+        # residency meters are tagged per table/tier; the gate cares
+        # about the fleet-wide total, so sum every series of the name
+        meters = metrics.metric_maps()[0]
+        return sum(m.count for k, m in meters.items()
+                   if k == name or k.endswith("." + name))
+
+    lat = np.asarray(lat_ms)
+    report = {
+        "queries": QUERIES,
+        "deviceBytesBudget": budget,
+        "hostBytesBudget": host_budget,
+        "latencyP50Ms": round(float(np.percentile(lat, 50)), 3),
+        "latencyP99Ms": round(float(np.percentile(lat, 99)), 3),
+        "latencyMaxMs": round(float(lat.max()), 3),
+        "ledgerCheckpoints": checkpoints,
+        "promotions": meter_total(ServerMeter.RESIDENCY_PROMOTIONS),
+        "demotions": meter_total(ServerMeter.RESIDENCY_DEMOTIONS),
+        "coldHits": meter_total(ServerMeter.RESIDENCY_COLD_HITS),
+        "tiersAtEnd": mgr.snapshot()["tiers"],
+    }
+    for seg in segs:
+        seg.destroy()
+    mgr.shutdown()
+    return report, phase_answers, failures
+
+
+def main() -> int:
+    from pinot_tpu.tools.datagen import build_ssb_segment_dirs
+
+    base = tempfile.mkdtemp()
+    ssb_dirs, _ids, _sc = build_ssb_segment_dirs(
+        os.path.join(base, "ssb"), ROWS, SEGMENTS, seed=9)
+    vec_dirs, vec_q = build_vec_dirs(os.path.join(base, "vec"))
+
+    # size the budgets off the real working set: load one of each shape
+    from pinot_tpu.segment.loader import (ImmutableSegmentLoader,
+                                          segment_host_bytes)
+    probes = [ImmutableSegmentLoader.load(ssb_dirs[0]),
+              ImmutableSegmentLoader.load(vec_dirs[0])]
+    working_set = (probes[0].device_bytes_estimate() * SEGMENTS +
+                   probes[1].device_bytes_estimate() * VEC_SEGMENTS)
+    # host tier holds only a few evicted segments before the coldest
+    # continue to disk — the second rung of the degradation ladder
+    host_budget = int(segment_host_bytes(probes[0]) * HOST_SEGS_BUDGET)
+    for p in probes:
+        p.destroy()
+    budget = int(working_set / BUDGET_DIVISOR)
+
+    print(f"working set ~{working_set} device bytes over "
+          f"{SEGMENTS}+{VEC_SEGMENTS} segments; budget {budget} "
+          f"({working_set / budget:.1f}x oversubscribed), host budget "
+          f"{host_budget}", file=sys.stderr)
+
+    unbounded, answers, fail_a = run_phase(
+        ssb_dirs, vec_dirs, vec_q, None, None)
+    budgeted, _, fail_b = run_phase(
+        ssb_dirs, vec_dirs, vec_q, budget, host_budget,
+        answers=answers)
+
+    failures = [f"[unbounded] {f}" for f in fail_a] + \
+               [f"[budgeted] {f}" for f in fail_b]
+    if budgeted["demotions"] == 0:
+        failures.append("budgeted run performed no demotions — the "
+                        "working set never pressured the budget")
+    if budgeted["promotions"] == 0:
+        failures.append("budgeted run performed no promotions — the "
+                        "skew flip never re-admitted a hot segment")
+    if budgeted["coldHits"] == 0:
+        failures.append("budgeted run took no cold hits — the disk "
+                        "tier was never exercised")
+    p99_bound = (GRACE_FACTOR * unbounded["latencyP99Ms"] +
+                 GRACE_FLOOR_MS)
+    if budgeted["latencyP99Ms"] > p99_bound:
+        failures.append(
+            f"budgeted p99 {budgeted['latencyP99Ms']:.1f}ms exceeds "
+            f"{p99_bound:.1f}ms (unbounded "
+            f"{unbounded['latencyP99Ms']:.1f}ms x {GRACE_FACTOR} + "
+            f"{GRACE_FLOOR_MS}ms) — degradation is a cliff, not a "
+            "slope")
+
+    report = {
+        "rows": ROWS, "segments": SEGMENTS,
+        "vectorSegments": VEC_SEGMENTS,
+        "workingSetDeviceBytes": working_set,
+        "oversubscription": round(working_set / budget, 2),
+        "unbounded": unbounded,
+        "budgeted": budgeted,
+        "p99Ratio": round(budgeted["latencyP99Ms"] /
+                          max(unbounded["latencyP99Ms"], 1e-9), 3),
+        "distinctAnswerKeys": len(answers),
+    }
+    print(json.dumps(report, indent=1))
+    artifact = os.environ.get("RESIDENCY_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print("residency smoke: " + ("OK" if not failures else "FAILED"))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

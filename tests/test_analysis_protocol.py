@@ -373,7 +373,7 @@ def test_live_tree_metrics_contract_clean():
 def test_live_protocols_hold_exhaustively():
     result = protocol.check_protocols()
     assert result.problems == []
-    assert len(result.reports) == 7
+    assert len(result.reports) == 8
     for report in result.reports:
         assert not report.truncated, report.system
         assert report.states > 0
@@ -900,6 +900,110 @@ def test_exchange_model_checker_is_deterministic():
     two runs of the richest seeded model (unlocked put)."""
     def run():
         report = _exchange_report(_exchange_seed(_UNLOCKED_PUT))
+        return (report.states,
+                json.dumps([[v.invariant, v.message, v.trace]
+                            for v in report.violations]))
+    a, b = run(), run()
+    assert a[0] == b[0] and a[1] == b[1]
+
+
+# ---------------------------------------------------------------------------
+# residency: extraction + seeded swap-order bugs
+# ---------------------------------------------------------------------------
+
+
+def _residency_seed(*replacements):
+    src = protocol._load(protocol.RESIDENCY_PATH, None)
+    for old, new in replacements:
+        assert old in src, f"residency seed anchor drifted: {old!r}"
+        src = src.replace(old, new, 1)
+    return {protocol.RESIDENCY_PATH: src}
+
+
+def _residency_report(sources):
+    result = protocol.check_protocols(sources=sources,
+                                      only=["residency"])
+    assert result.problems == [], result.problems
+    (report,) = result.reports
+    assert not report.truncated
+    return report
+
+
+def test_live_residency_extraction_shape():
+    """The live tree carries the full staged-swap discipline: host copy
+    staged (and disk artifact verified) before the tier flips, query
+    pins drained before lanes release, both transition directions
+    serialized on the per-entry swap lock, admission read off the
+    process-global ledger, and the disk cold reload rebinding host
+    lanes before the host tier is published."""
+    ex = protocol.extract_residency()
+    assert ex.problems == []
+    assert ex.step_order() == [
+        "demote.stage_host", "demote.crash_staged",
+        "demote.require_artifact", "demote.crash_pre_publish",
+        "demote.publish_tier", "demote.await_unpinned",
+        "demote.crash_pre_release", "demote.release_lanes",
+        "promote.admit_check", "promote.reload_artifact",
+        "promote.upload", "promote.publish_tier"]
+    assert ex.flags == {"locked_swap": True, "admits_by_ledger": True,
+                        "reload_before_publish": True}
+    report = _residency_report(None)
+    assert report.violations == [], [
+        (v.invariant, v.render_trace()) for v in report.violations]
+    assert report.states > 0
+
+
+def test_seeded_release_before_publish_reads_released_lane():
+    """The reorder bug the staged swap exists to prevent: releasing the
+    device lanes right after staging the host copy, BEFORE the tier
+    flip — an in-flight query that routed to the device tier then reads
+    a released lane. The checker must produce the ordered trace."""
+    sources = _residency_seed((
+        'crash_points.hit("residency.demote_staged")',
+        'self._release_lanes(entry, tier)\n'
+        '            crash_points.hit("residency.demote_staged")'))
+    report = _residency_report(sources)
+    invariants = {v.invariant for v in report.violations}
+    assert "no-read-of-released-lane" in invariants, invariants
+    (v,) = [x for x in report.violations
+            if x.invariant == "no-read-of-released-lane"]
+    trace = v.trace
+    assert any(s.endswith(".release_lanes") for s in trace), trace
+    assert trace[-1] == "qry.read", trace
+    release = next(i for i, s in enumerate(trace)
+                   if s.endswith(".release_lanes"))
+    assert release < trace.index("qry.read"), trace
+
+
+def test_seeded_skipped_artifact_check_yields_counterexample():
+    """Dropping the pre-publish artifact verification from the disk
+    demotion: a segment whose on-disk artifact is gone (quarantined,
+    dropped, truncated) is still demoted to the disk tier, leaving it
+    unreloadable — and its later cold read is a read of nothing. Both
+    invariants must fire with ordered traces."""
+    sources = _residency_seed((
+        "            if tier == TIER_DISK:\n"
+        "                self._require_artifact(entry)\n",
+        ""))
+    report = _residency_report(sources)
+    invariants = {v.invariant for v in report.violations}
+    assert "promoted-implies-artifact" in invariants, invariants
+    (v,) = [x for x in report.violations
+            if x.invariant == "promoted-implies-artifact"]
+    assert "env.artifact_lost" in v.trace, v.trace
+    assert any(s.endswith(".publish_tier") for s in v.trace), v.trace
+
+
+def test_residency_model_checker_is_deterministic():
+    """Same state count AND byte-identical counterexample traces across
+    two runs of the seeded missing-artifact model."""
+    sources = _residency_seed((
+        "            if tier == TIER_DISK:\n"
+        "                self._require_artifact(entry)\n",
+        ""))
+
+    def run():
+        report = _residency_report(sources)
         return (report.states,
                 json.dumps([[v.invariant, v.message, v.trace]
                             for v in report.violations]))

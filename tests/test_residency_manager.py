@@ -1,0 +1,408 @@
+"""Tiered segment residency (server/residency_manager.py): the staged
+HBM ↔ host ↔ disk swaps under a device budget.
+
+Three acceptance-critical families:
+
+1. **kill -9 at every `residency.*` crash point** — the swap dies at
+   each armed stage; a "restarted" server (fresh load from the local
+   artifact dir, exactly what cold-start recovery serves) answers
+   COUNT/SUM and vector-top-k with bit-identical results, and the LIVE
+   process that caught the crash keeps serving correct answers too
+   (the staged order means every interrupted state is still readable).
+2. **query-vs-demotion pin race** — an in-flight query's pin must hold
+   the lane release until end_query; the tier publishes immediately
+   (fresh queries route off-device) but no lane disappears under a
+   reader.
+3. **demote → promote round-trip bit-parity** — host, device and
+   sharded execution paths return byte-identical results after a full
+   device→host→disk→host→device cycle versus a never-evicted twin.
+
+Plus the admission/eviction policy: over-budget attaches land
+host-tier, hotter segments evict strictly-colder victims only, and the
+promotion backlog drives the admission brownout.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from fixtures import build_segment
+
+from pinot_tpu.common.faults import InjectedCrash, crash_points
+from pinot_tpu.common.metrics import MetricsRegistry, ServerGauge, ServerMeter
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.obs.residency import LEDGER
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+from pinot_tpu.server.residency_manager import (ResidencyError,
+                                                ResidencyManager, TIER_DEVICE,
+                                                TIER_DISK, TIER_HOST)
+
+COUNT_SUM = ("SELECT COUNT(*), SUM(runs) FROM baseballStats "
+             "WHERE yearID >= 2000")
+
+
+@pytest.fixture(autouse=True)
+def _clean_crash_points():
+    crash_points.clear()
+    yield
+    crash_points.clear()
+
+
+def expected_count_sum(cols):
+    m = cols["yearID"] >= 2000
+    return int(m.sum()), float(cols["runs"][m].sum())
+
+
+def count_sum(engine):
+    resp = engine.query(COUNT_SUM)
+    assert not resp.exceptions, resp.exceptions
+    return (int(resp.aggregation_results[0].value),
+            float(resp.aggregation_results[1].value))
+
+
+def make_manager(budget=None, host_budget=None):
+    """A standalone manager with a controllable clock; budgets are
+    relative to the CURRENT process-global ledger occupancy so the test
+    is insensitive to lanes other tests left resident."""
+    clk = [0.0]
+    base = LEDGER.total_bytes()
+    mgr = ResidencyManager(
+        None if budget is None else base + budget,
+        host_budget, clock=lambda: clk[0])
+    return mgr, clk
+
+
+def tracked_segment(tmp_path, mgr, name="res_seg", n=2048, seed=11):
+    d = str(tmp_path / name)
+    seg, cols = build_segment(d, n=n, seed=seed, name=name)
+    mgr.track("baseballStats", seg, seg_dir=d)
+    seg.warm_device()
+    return seg, cols, d
+
+
+# ---------------------------------------------------------------------------
+# 1. kill -9 at every staged-swap crash point
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ["residency.demote_staged",
+                                   "residency.pre_publish",
+                                   "residency.pre_release"])
+def test_crash_mid_demotion_recovers_with_exact_results(tmp_path, point):
+    """The demotion dies at each stage; recovery = reload the verified
+    local artifact (what a restarted server's cold-start scan serves)
+    → COUNT/SUM parity. The live survivor keeps answering correctly
+    too: every interrupted swap state is readable because the fallback
+    publishes before anything releases."""
+    mgr, _clk = make_manager()
+    seg, cols, d = tracked_segment(tmp_path, mgr, name=f"c_{point[10:]}")
+    exp = expected_count_sum(cols)
+    try:
+        crash_points.arm(point)
+        with pytest.raises(InjectedCrash):
+            mgr.demote_segment(seg.segment_name, TIER_DISK)
+
+        # the surviving process: no torn lanes, both paths still exact
+        live = QueryEngine([seg])
+        live.executor.device_gate = mgr.device_allowed
+        assert count_sum(live) == exp
+        assert count_sum(QueryEngine([seg], use_device=False)) == exp
+
+        # the restarted process: fresh load from the artifact dir
+        fresh = ImmutableSegmentLoader.load(d)
+        try:
+            assert count_sum(QueryEngine([fresh])) == exp
+        finally:
+            fresh.destroy()
+
+        # the interrupted swap retries cleanly (crash-once semantics)
+        assert mgr.demote_segment(seg.segment_name, TIER_DISK) or \
+            mgr.tracked(seg.segment_name) == TIER_DISK
+        mgr.ensure_host(seg.segment_name)
+        assert count_sum(QueryEngine([seg], use_device=False)) == exp
+    finally:
+        seg.destroy()
+
+
+@pytest.mark.parametrize("point", ["residency.demote_staged",
+                                   "residency.pre_release"])
+def test_crash_mid_demotion_vector_topk_parity(tmp_path, point):
+    """Same kill -9 drill on a vector segment: top-k neighbours after
+    recovery are bit-identical to the never-crashed oracle."""
+    from test_vector import build_vec_segments, pql_for, result_rows
+    segs, cols_list = build_vec_segments(str(tmp_path), n_segs=1, n=512)
+    seg = segs[0]
+    d = os.path.join(str(tmp_path), "v0")
+    q = cols_list[0]["emb"][17]
+    pql = pql_for(q, k=9)
+    baseline = result_rows(QueryEngine([seg]).query(pql))
+    assert len(baseline) == 9
+
+    mgr, _clk = make_manager()
+    mgr.track("vectab", seg, seg_dir=d)
+    seg.warm_device()
+    try:
+        crash_points.arm(point)
+        with pytest.raises(InjectedCrash):
+            mgr.demote_segment(seg.segment_name, TIER_DISK)
+        fresh = ImmutableSegmentLoader.load(d)
+        try:
+            assert result_rows(QueryEngine([fresh]).query(pql)) == baseline
+        finally:
+            fresh.destroy()
+        assert result_rows(QueryEngine([seg], use_device=False)
+                           .query(pql)) == baseline
+    finally:
+        seg.destroy()
+
+
+# ---------------------------------------------------------------------------
+# 2. query-vs-demotion pin race
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_pin_blocks_lane_release_until_end_query(tmp_path):
+    mgr, _clk = make_manager()
+    released = []
+    mgr.add_release_hook(released.append)
+    seg, cols, _d = tracked_segment(tmp_path, mgr, name="pin_race")
+    exp = expected_count_sum(cols)
+    try:
+        token = mgr.begin_query([seg])
+        assert len(token) == 1
+
+        done = threading.Event()
+        result = {}
+
+        def demoter():
+            result["ok"] = mgr.demote_segment(seg.segment_name,
+                                              TIER_HOST)
+            done.set()
+
+        t = threading.Thread(target=demoter, daemon=True)
+        t.start()
+        # the tier publishes promptly (fresh queries route host-side)
+        # but the release MUST wait on the pin
+        deadline = time.monotonic() + 5.0
+        while mgr.tracked(seg.segment_name) != TIER_HOST:
+            assert time.monotonic() < deadline, "publish never happened"
+            time.sleep(0.01)
+        assert not done.wait(0.15), "release did not wait for the pin"
+        assert released == []
+        # the pinned reader still sees intact lanes mid-swap
+        assert count_sum(QueryEngine([seg], use_device=False)) == exp
+
+        mgr.end_query(token)
+        assert done.wait(5.0), "demotion wedged after pins drained"
+        t.join(5.0)
+        assert result["ok"] is True
+        assert released == [seg.segment_name]
+        assert count_sum(QueryEngine([seg], use_device=False)) == exp
+    finally:
+        seg.destroy()
+
+
+# ---------------------------------------------------------------------------
+# 3. demote → promote round-trip bit-parity
+# ---------------------------------------------------------------------------
+
+
+def test_full_tier_cycle_bit_parity_on_all_execution_paths(tmp_path):
+    """device→host→disk→host→device round trip, then the same query on
+    the host, device and sharded paths versus a never-evicted twin
+    built from identical inputs — results must be bit-identical."""
+    from pinot_tpu.parallel import make_mesh
+    mgr, _clk = make_manager()
+    segs, twins, cols_all = [], [], []
+    for i in range(2):
+        d = str(tmp_path / f"cyc{i}")
+        seg, cols = build_segment(d, n=2048, seed=40 + i,
+                                  name=f"cyc_{i}")
+        mgr.track("baseballStats", seg, seg_dir=d)
+        seg.warm_device()
+        segs.append(seg)
+        cols_all.append(cols)
+        td = str(tmp_path / f"twin{i}")
+        twin, _ = build_segment(td, n=2048, seed=40 + i,
+                                name=f"cyc_{i}")
+        twins.append(twin)
+    try:
+        for seg in segs:
+            assert mgr.demote_segment(seg.segment_name, TIER_DISK)
+            assert mgr.tracked(seg.segment_name) == TIER_DISK
+            assert mgr.promote_segment(seg.segment_name)
+            assert mgr.tracked(seg.segment_name) == TIER_DEVICE
+
+        pql = ("SELECT COUNT(*), SUM(hits) FROM baseballStats "
+               "WHERE league = 'AL' GROUP BY teamID TOP 1000")
+
+        def groups(resp, i):
+            return {tuple(g["group"]): g["value"]
+                    for g in resp.aggregation_results[i].group_by_result}
+
+        for engines in [(QueryEngine(segs, use_device=False),
+                         QueryEngine(twins, use_device=False)),
+                        (QueryEngine(segs), QueryEngine(twins)),
+                        (QueryEngine(segs, mesh=make_mesh()),
+                         QueryEngine(twins, mesh=make_mesh()))]:
+            got = engines[0].query(pql)
+            want = engines[1].query(pql)
+            assert not got.exceptions and not want.exceptions
+            assert groups(got, 0) == groups(want, 0)
+            assert groups(got, 1) == groups(want, 1)
+            assert count_sum(engines[0]) == count_sum(engines[1])
+    finally:
+        for s in segs + twins:
+            s.destroy()
+
+
+def test_cold_hit_reload_is_metered_and_exact(tmp_path):
+    """Disk-tier first read: begin_query reloads through ensure_host
+    (a metered cold hit), the segment lands host-tier, and the answer
+    is exact."""
+    metrics = MetricsRegistry("server")
+    mgr, _clk = make_manager()
+    mgr.bind_metrics(metrics)
+    seg, cols, _d = tracked_segment(tmp_path, mgr, name="cold_hit")
+    try:
+        assert mgr.demote_segment(seg.segment_name, TIER_DISK)
+        token = mgr.begin_query([seg])
+        try:
+            assert mgr.tracked(seg.segment_name) in (TIER_HOST,
+                                                     TIER_DEVICE)
+            assert count_sum(QueryEngine([seg], use_device=False)) == \
+                expected_count_sum(cols)
+        finally:
+            mgr.end_query(token)
+        assert metrics.meter(ServerMeter.RESIDENCY_COLD_HITS,
+                             table="baseballStats").count == 1
+        snap = mgr.snapshot()
+        (entry,) = [s for s in snap["segments"]
+                    if s["segment"] == seg.segment_name]
+        assert entry["coldHits"] == 1
+    finally:
+        seg.destroy()
+
+
+# ---------------------------------------------------------------------------
+# admission, eviction policy, degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_over_budget_attach_lands_host_tier_not_a_crash(tmp_path):
+    mgr, _clk = make_manager(budget=0)
+    d = str(tmp_path / "over_budget")
+    seg, cols = build_segment(d, n=2048, seed=11, name="over_budget")
+    mgr.track("baseballStats", seg, seg_dir=d)
+    try:
+        assert mgr.tracked(seg.segment_name) == TIER_HOST
+        # the routed warm-up refuses (the raw seg.warm_device() bypass
+        # is exactly what serving paths must not call)
+        assert mgr.warm_device(seg.segment_name) is False
+        # the execution gate routes it off-device; results stay exact
+        assert not mgr.device_allowed(seg)
+        eng = QueryEngine([seg])
+        eng.executor.device_gate = mgr.device_allowed
+        assert count_sum(eng) == expected_count_sum(cols)
+    finally:
+        seg.destroy()
+
+
+def test_hotter_segment_evicts_strictly_colder_victim(tmp_path):
+    mgr, clk = make_manager()               # attach both unbudgeted
+    cold, _cc, _d0 = tracked_segment(tmp_path, mgr, name="victim_cold",
+                                     seed=1)
+    hot, _hc, _d1 = tracked_segment(tmp_path, mgr, name="asker_hot",
+                                    seed=2)
+    try:
+        # make `hot` much hotter than `cold`, then let cold decay
+        for _ in range(6):
+            mgr.end_query(mgr.begin_query([hot]))
+        clk[0] += 120.0                     # cold loses 4 half-lives
+        mgr.end_query(mgr.begin_query([hot]))
+        # budget: one byte less than full residency — re-promoting hot
+        # cannot fit without claiming a victim
+        full = LEDGER.total_bytes()
+        assert mgr.demote_segment(hot.segment_name, TIER_HOST)
+        mgr.configure(full - 1)
+
+        # promotion of the hot segment claims the cold victim's lanes
+        assert mgr.promote_segment(hot.segment_name)
+        assert mgr.tracked(hot.segment_name) == TIER_DEVICE
+        assert mgr.tracked(cold.segment_name) == TIER_HOST
+        # the converse never happens: a colder asker cannot evict a
+        # hotter resident
+        assert not mgr.promote_segment(cold.segment_name)
+        assert mgr.tracked(hot.segment_name) == TIER_DEVICE
+    finally:
+        cold.destroy()
+        hot.destroy()
+
+
+def test_disk_demotion_without_artifact_is_refused(tmp_path):
+    mgr, _clk = make_manager()
+    d = str(tmp_path / "no_art")
+    seg, _cols = build_segment(d, n=512, seed=5, name="no_art")
+    mgr.track("baseballStats", seg)          # no seg_dir recorded
+    seg.warm_device()
+    try:
+        with pytest.raises(ResidencyError, match="artifact"):
+            mgr.demote_segment(seg.segment_name, TIER_DISK)
+        # host demotion (no artifact needed) still works
+        assert mgr.demote_segment(seg.segment_name, TIER_HOST)
+    finally:
+        seg.destroy()
+
+
+def test_promotion_backlog_drives_admission_brownout(tmp_path):
+    from pinot_tpu.server.admission import AdmissionController
+    mgr, _clk = make_manager(budget=0)
+    segs = []
+    try:
+        for i in range(AdmissionController.PROMOTION_BACKLOG_WATERMARK):
+            d = str(tmp_path / f"bk{i}")
+            seg, _ = build_segment(d, n=512, seed=60 + i,
+                                   name=f"bk_{i}")
+            mgr.track("baseballStats", seg, seg_dir=d)
+            segs.append(seg)
+        # every attach landed off-device with seed heat ≥ the
+        # promotion threshold → all of them back up behind the budget
+        backlog = mgr.promotion_backlog()
+        assert backlog >= AdmissionController.PROMOTION_BACKLOG_WATERMARK
+        ac = AdmissionController(backlog_fn=mgr.promotion_backlog)
+        d = ac.admit("baseballStats", "tenantA")
+        assert d.admitted and d.brownout    # brownout on an IDLE queue
+        ac.release("tenantA")
+        idle = AdmissionController(backlog_fn=lambda: 0)
+        d2 = idle.admit("baseballStats", "tenantA")
+        assert d2.admitted and not d2.brownout
+    finally:
+        for s in segs:
+            s.destroy()
+
+
+def test_gauges_and_debug_snapshot_expose_tiers(tmp_path):
+    metrics = MetricsRegistry("server")
+    mgr, _clk = make_manager()
+    mgr.bind_metrics(metrics)
+    seg, _cols, _d = tracked_segment(tmp_path, mgr, name="gauged")
+    try:
+        dev_gauge = metrics.gauge(ServerGauge.RESIDENCY_TIER_BYTES,
+                                  table="|tier:device")
+        host_gauge = metrics.gauge(ServerGauge.RESIDENCY_TIER_BYTES,
+                                   table="|tier:host")
+        assert dev_gauge.value > 0 and host_gauge.value == 0
+        assert mgr.demote_segment(seg.segment_name, TIER_HOST)
+        assert dev_gauge.value == 0 and host_gauge.value > 0
+        # ledger snapshot rows carry the residency annotations; note
+        # the demotion released the device lanes, so the manager's own
+        # snapshot is the authoritative tier view
+        snap = mgr.snapshot()
+        assert snap["tiers"]["host"]["segments"] == 1
+        (entry,) = [s for s in snap["segments"]
+                    if s["segment"] == seg.segment_name]
+        assert entry["tier"] == TIER_HOST and entry["heat"] > 0
+    finally:
+        seg.destroy()
+        mgr.shutdown()
