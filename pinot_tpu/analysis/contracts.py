@@ -159,6 +159,88 @@ def check_kernel_contracts(buckets=None) -> List[str]:
                             f"be integral (docid/count contract), "
                             f"got {dt}")
     violations.extend(_check_extra_kernels(buckets, x64))
+    violations.extend(_check_batched_kernels(buckets, x64))
+    return violations
+
+
+def _check_batched_kernels(buckets, x64: bool) -> List[str]:
+    """Trace the cross-query batched dispatch (`get_batched_segment_
+    kernel`: vmap over the params axis, cols and num_docs shared)
+    through the same jaxpr gates at each batch occupancy. The batched
+    kernel must inherit every per-member invariant — no callbacks, no
+    64-bit leaks, int32 docids/counts — with a leading batch axis on
+    every output, or batching would change results member-by-member."""
+    import jax
+    import numpy as np
+
+    from pinot_tpu.ops import kernels
+
+    violations: List[str] = []
+    for (name, filt, aggs, group, select, cols_spec,
+         params_spec) in kernels.batched_contract_cases():
+        name = f"batched:{name}"
+        try:
+            k1 = kernels.get_batched_segment_kernel(buckets[0], filt,
+                                                    aggs, select)
+            k2 = kernels.get_batched_segment_kernel(buckets[0], filt,
+                                                    aggs, select)
+        except TypeError as e:
+            violations.append(f"{name}: spec not hashable — jit cache "
+                              f"can never hit: {e}")
+            continue
+        if k1 is not k2:
+            violations.append(f"{name}: get_batched_segment_kernel "
+                              "missed its cache on an equal spec — "
+                              "every batch would recompile")
+        for padded in buckets:
+            kernel = kernels.get_batched_segment_kernel(padded, filt,
+                                                        aggs, select)
+            cols, params = _materialize(cols_spec, params_spec, padded)
+            num_docs = np.int32(padded - 3)
+            for bsz in kernels.BATCH_CONTRACT_SIZES:
+                stacked = tuple(np.stack([p] * bsz) for p in params)
+                tag = f"{name}@P={padded},B={bsz}"
+                try:
+                    closed = jax.make_jaxpr(kernel)(cols, stacked,
+                                                    num_docs)
+                    closed2 = jax.make_jaxpr(kernel)(cols, stacked,
+                                                     num_docs)
+                except Exception as e:  # noqa: BLE001 — the finding
+                    violations.append(
+                        f"{tag}: batched kernel does not trace "
+                        f"abstractly: {type(e).__name__}: {e}")
+                    continue
+                cbs = find_callbacks(closed)
+                if cbs:
+                    violations.append(
+                        f"{tag}: host callback primitive(s) "
+                        f"{sorted(set(cbs))} inside the batched jaxpr")
+                if str(closed) != str(closed2):
+                    violations.append(
+                        f"{tag}: re-trace produced a different jaxpr — "
+                        "trace-time nondeterminism")
+                shapes = jax.eval_shape(kernel, cols, stacked, num_docs)
+                for key, sds in sorted(shapes.items()):
+                    dt = np.dtype(sds.dtype)
+                    if not sds.shape or sds.shape[0] != bsz:
+                        violations.append(
+                            f"{tag}: output `{key}` shape {sds.shape} "
+                            f"lacks the leading batch axis of {bsz} — "
+                            "fan-back would mix members")
+                    if not x64 and dt.itemsize == 8 and dt.kind in "iuf":
+                        violations.append(
+                            f"{tag}: output `{key}` is {dt} under "
+                            "32-bit mode")
+                    if key.startswith(_I32_OUTPUT_PREFIXES):
+                        if not x64 and dt != np.dtype("int32"):
+                            violations.append(
+                                f"{tag}: output `{key}` must be int32 "
+                                f"(docid/count contract), got {dt}")
+                        elif x64 and dt.kind not in "iu":
+                            violations.append(
+                                f"{tag}: output `{key}` must be "
+                                f"integral (docid/count contract), "
+                                f"got {dt}")
     return violations
 
 

@@ -328,6 +328,21 @@ class ServerMeter:
     RESIDENCY_PROMOTIONS = "residencyPromotions"
     RESIDENCY_DEMOTIONS = "residencyDemotions"
     RESIDENCY_COLD_HITS = "residencyColdHits"
+    # cross-query dispatch coalescing: kernel executions that served
+    # more than one query, and queries that skipped the batching window
+    # (budget too tight to survive it)
+    BATCHED_DISPATCHES = "batchedDispatches"
+    BATCH_BYPASS = "batchBypass"
+    # single-flight result-cache dedup: identical concurrent queries
+    # that waited on the leader's execution instead of their own
+    SINGLE_FLIGHT_WAITS = "singleFlightWaits"
+
+
+class ServerTimer:
+    # queries served per sealed batch window (a Timer so the occupancy
+    # DISTRIBUTION rides the existing histogram/percentile machinery;
+    # the "ms" unit suffix in the exposition reads as "queries")
+    BATCH_OCCUPANCY = "batchOccupancy"
 
 
 class ControllerMeter:

@@ -109,7 +109,8 @@ class QueryProfile:
 
     __slots__ = ("table", "docs_scanned", "segments_processed",
                  "segments_matched", "segments_pruned", "paths",
-                 "dispatches", "transfer_bytes", "kernel_ms", "_lock")
+                 "dispatches", "transfer_bytes", "kernel_ms",
+                 "batch_size", "_lock")
 
     def __init__(self, table: str = ""):
         self.table = table
@@ -121,6 +122,9 @@ class QueryProfile:
         self.dispatches = 0
         self.transfer_bytes = 0
         self.kernel_ms = 0.0
+        # queries served by this query's batch window (1 == unbatched;
+        # set by the coalescer runner when the query rode a batch)
+        self.batch_size = 1
         self._lock = threading.Lock()
 
     def add_dispatch(self, nbytes: int, ms: float) -> None:
@@ -151,6 +155,7 @@ class QueryProfile:
                 "kernelDispatches": self.dispatches,
                 "deviceTransferBytes": self.transfer_bytes,
                 "kernelMs": round(self.kernel_ms, 3),
+                "batchSize": self.batch_size,
             }
 
     def to_json_str(self) -> str:

@@ -1612,6 +1612,78 @@ def run_segment_kernel(padded: int, filter_spec, agg_specs, group_spec,
 
 
 # ---------------------------------------------------------------------------
+# Cross-query batched dispatch: one kernel execution serves N queries
+# that share a compiled spec and differ only in runtime literal
+# operands. The column lanes are per-segment data shared across the
+# batch (in_axes=None — uploaded once, read by every lane of the vmap);
+# each param leaf gains a leading query axis. Group specs are excluded:
+# adaptive group execution (query/groupby.py) drives value-dependent
+# scout phases per query, so stacking its operands would fuse control
+# flow that must stay per-member.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def get_batched_segment_kernel(padded: int, filter_spec, agg_specs,
+                               select_spec):
+    """jit(vmap) of the SAME unjitted closure the sequential path
+    compiles — batched and per-query dispatch trace one program, which
+    is what makes batched-vs-sequential bit-parity a structural
+    property rather than a numerical accident for the integer paths."""
+    base = build_segment_kernel(padded, filter_spec, agg_specs, None,
+                                select_spec)
+    return jax.jit(jax.vmap(base, in_axes=(None, 0, None)))
+
+
+def stack_param_leaves(params_list):
+    """[(p0, p1, ...)] per member → one tuple of [B, ...] leaves.
+
+    Spec equality implies leaf-shape equality (widths are padded from
+    the spec); a mismatch here means the caller grouped plans whose
+    specs diverged and is a bug, surfaced as ValueError before any
+    device work."""
+    n = len(params_list[0])
+    for ps in params_list:
+        if len(ps) != n:
+            raise ValueError("batched plans disagree on param arity")
+    return tuple(
+        jnp.stack([jnp.asarray(ps[i]) for ps in params_list])
+        for i in range(n))
+
+
+def batch_bucket(n: int) -> int:
+    """Next power of two ≥ n (min 2): the batch axis is padded to a
+    bucket before jit sees it, exactly like the doc-count padding —
+    jit specializes on the leading dim, so raw occupancies would
+    compile one XLA program PER DISTINCT BATCH SIZE under load (a
+    compile storm that inverts the whole point of coalescing).
+    Bucketing bounds the compile surface at log2(max occupancy)
+    programs per spec."""
+    b = 2
+    while b < n:
+        b <<= 1
+    return b
+
+
+def run_segment_kernel_batched(padded: int, filter_spec, agg_specs,
+                               select_spec, cols, params_list, num_docs):
+    """One dispatch for N same-spec queries; every output gains a
+    leading query axis the caller slices per member (padded bucket
+    lanes beyond N are never read). Callers handle the param-free case
+    themselves (one unbatched dispatch shared by all members — vmap
+    cannot infer a batch size from an empty pytree)."""
+    fn = get_batched_segment_kernel(padded, filter_spec,
+                                    tuple(agg_specs or ()), select_spec)
+    members = [tuple(ps) for ps in params_list]
+    # pad to the bucket by repeating the last member: dead lanes cost
+    # only vmapped compute, never a fresh compile
+    members.extend([members[-1]] * (batch_bucket(len(members))
+                                    - len(members)))
+    stacked = stack_param_leaves(members)
+    return fn(cols, stacked, jnp.int32(num_docs))
+
+
+# ---------------------------------------------------------------------------
 # Kernel contract registry (consumed by analysis/contracts.py --deep)
 #
 # Every kernel family the planner can emit is registered here as a
@@ -1799,6 +1871,25 @@ def contract_cases():
           "v0.hllidx": (i32, (64,)), "v0.hllrank": (i32, (64,))},
          [(i32, ())])
     return cases
+
+
+#: leading-query-axis sizes the deep tier traces batched cases at —
+#: pow2 only, because batch_bucket pads every occupancy to a pow2
+#: before jit ever sees the leading dim
+BATCH_CONTRACT_SIZES = (2, 4)
+
+
+def batched_contract_cases():
+    """The registered cases the dispatch coalescer can stack, traced by
+    the deep tier through get_batched_segment_kernel at each
+    BATCH_CONTRACT_SIZES occupancy: group-by cases are excluded (the
+    coalescer never batches them — adaptive group execution is
+    value-dependent per query) and so are param-free cases (they share
+    one unbatched dispatch instead of a vmap)."""
+    return [(name, filt, aggs, group, select, cols, params)
+            for (name, filt, aggs, group, select, cols, params)
+            in contract_cases()
+            if group is None and params]
 
 
 def extra_contract_cases():

@@ -125,6 +125,68 @@ def _execute_segment_plan(plan) -> IntermediateResultsBlock:
     return blk
 
 
+def execute_segment_plans_batched(plans) -> List[IntermediateResultsBlock]:
+    """One device dispatch serves N plans over ONE segment.
+
+    Callers guarantee every plan shares a batch_signature (equal
+    compiled specs, same segment — query/plan.py:batch_signature): the
+    column lanes are gathered once and shared across the vmap lanes,
+    each member contributes its params to the stacked leading axis, and
+    the outputs are sliced back per member and fed through the same
+    host finishers the sequential path uses — which is why batched and
+    sequential results agree bit-for-bit on every path the coalescer
+    admits (pinned by the contract tier).
+    """
+    if len(plans) == 1:
+        return [execute_segment_plan(plans[0])]
+    lead = plans[0]
+    segment = lead.segment
+    t0 = time.perf_counter()
+    with debug_transfer_guard():
+        cols = gather_operands(lead)
+        if lead.params:
+            outs_b = profiled_device_get(kernels.run_segment_kernel_batched(
+                segment.padded_docs, lead.filter_spec, lead.agg_specs,
+                lead.select_spec, cols,
+                [tuple(p.params) for p in plans], segment.num_docs))
+            per_member = [{k: v[b] for k, v in outs_b.items()}
+                          for b in range(len(plans))]
+        else:
+            # param-free same-signature plans are identical programs:
+            # one unbatched dispatch, every member reads the same outs
+            outs1 = profiled_device_get(kernels.run_segment_kernel(
+                segment.padded_docs, lead.filter_spec, lead.agg_specs,
+                None, lead.select_spec, cols, (), segment.num_docs))
+            per_member = [outs1] * len(plans)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    n_leaves = _count_filter_leaves(lead.filter_spec)
+    n_project = len({c for c, _ in lead.needed_cols})
+    blocks = []
+    for plan, outs in zip(plans, per_member):
+        blk = IntermediateResultsBlock()
+        if plan.agg_specs:
+            _finish_aggregation(plan, outs, blk)
+        matched = int(outs["stats.num_docs_matched"])
+        if plan.select_spec is not None:
+            if plan.select_spec[0] == "vector":
+                _finish_vector(plan, outs, blk, matched)
+            else:
+                _finish_selection(plan, outs, blk, matched)
+        # the dispatch was shared; each member reports the batch wall
+        # time (it really waited that long) and its own scan stats
+        blk.stats = ExecutionStats(
+            num_docs_scanned=matched,
+            num_entries_scanned_in_filter=n_leaves * segment.num_docs,
+            num_entries_scanned_post_filter=matched * max(
+                n_project - n_leaves, 0),
+            num_segments_processed=1,
+            num_segments_matched=1 if matched else 0,
+            total_docs=segment.num_docs,
+            time_used_ms=elapsed_ms)
+        blocks.append(blk)
+    return blocks
+
+
 # ---------------------------------------------------------------------------
 
 

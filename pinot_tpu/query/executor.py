@@ -294,3 +294,193 @@ class ServerQueryExecutor:
                 pass
         obs_profiler.count_path("host")
         return host_exec.execute_host(segment, request)
+
+    # -- cross-query batched execution --------------------------------------
+    def execute_batch(self, requests: List[BrokerRequest],
+                      segments: List[ImmutableSegment],
+                      trace: Optional[TraceContext] = None,
+                      deadline: Optional[float] = None
+                      ) -> List[IntermediateResultsBlock]:
+        """Execute N same-shape requests over one segment set, sharing
+        device dispatches wherever their per-segment plans compile to
+        equal specs (query/plan.py:batch_signature).
+
+        The coalescer (server/scheduler.py) guarantees the members
+        share a table, segment list, and plan-shape key; this layer
+        still prunes/plans per member (literals steer pruning and can
+        constant-fold a plan) and re-groups by COMPILED signature, so a
+        key collision degrades to sequential execution, never to a
+        wrong answer. Members that fall off the batchable path (star
+        trees, mutable segments, host fallback, group-by) run exactly
+        the sequential ladder. Returns blocks aligned with `requests`.
+        """
+        trace = trace if trace is not None else make_trace_context(False)
+        ambient = obs_profiler.current()
+        profile = ambient[0] if ambient is not None else \
+            QueryProfile(requests[0].table_name if requests else "?")
+        with obs_profiler.active(profile, trace):
+            return self._execute_batch(requests, segments, deadline)
+
+    def _execute_batch(self, requests, segments, deadline):
+        t0 = time.perf_counter()
+        from pinot_tpu.query.plan import (preprocess_request,
+                                          upsert_mask_active)
+        members = []
+        for req in requests:
+            req = preprocess_request(segments, req)
+            selected = self.pruner.prune(segments, req)
+            members.append(_BatchMember(req, selected, len(segments)))
+
+        # per-member multi-segment star-tree fast path (mirrors
+        # _execute; a member it answers never reaches the batch loop)
+        for m in members:
+            req, selected = m.request, m.selected
+            if req.is_aggregation and not req.is_selection and \
+                    len(selected) > 1 and \
+                    not any(upsert_mask_active(s) for s in selected) and \
+                    all(getattr(s, "star_trees", None) for s in selected):
+                from pinot_tpu.startree.executor import \
+                    try_star_tree_execute_multi
+                blk = try_star_tree_execute_multi(selected, req)
+                if blk is not None:
+                    obs_profiler.count_path("cube", len(selected))
+                    m.final = blk
+        pending = [m for m in members if m.final is None]
+
+        for seg in segments:
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            takers = [m for m in pending if id(seg) in m.selected_ids]
+            if not takers:
+                continue
+            self._batch_segment(seg, takers)
+            for m in takers:
+                m.executed += 1
+
+        return [m.final if m.final is not None
+                else m.finish(t0) for m in members]
+
+    def _batch_segment(self, seg, takers) -> None:
+        """One segment, many members: batch the plans whose compiled
+        signatures agree, run everything else down the sequential
+        ladder unchanged."""
+        from pinot_tpu.query import execution
+        from pinot_tpu.query.plan import batch_signature
+
+        if getattr(seg, "is_mutable", False) or not self.use_device or \
+                (self.device_gate is not None and
+                 not self.device_gate(seg)):
+            # consuming segments (frozen/tail or snapshot views) and
+            # gated-off-device segments keep their per-member path
+            for m in takers:
+                m.add(*self._segment_work(seg, m.request))
+            return
+
+        groups: dict = {}
+        for m in takers:
+            blk = self._try_star_tree(seg, m.request)
+            if blk is not None:
+                m.add([blk], 0, 0)
+                continue
+            try:
+                with obs_span(ServerQueryPhase.BUILD_QUERY_PLAN):
+                    plan = self.plan_maker.make_segment_plan(seg,
+                                                             m.request)
+            except (GroupsLimitExceeded, UnsupportedOnDevice):
+                obs_profiler.count_path("host")
+                m.add([host_exec.execute_host(seg, m.request)], 0, 0)
+                continue
+            sig = batch_signature(plan)
+            if sig is None:
+                # fast-path / group-by plans execute per member
+                try:
+                    with obs_span(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                        blk = plan.execute()
+                    obs_profiler.count_path("scan")
+                except (GroupsLimitExceeded, UnsupportedOnDevice):
+                    obs_profiler.count_path("host")
+                    blk = host_exec.execute_host(seg, m.request)
+                m.add([blk], 0, 0)
+                continue
+            groups.setdefault(sig, []).append((m, plan))
+
+        for group in groups.values():
+            plans = [plan for _, plan in group]
+            with obs_span(ServerQueryPhase.QUERY_PLAN_EXECUTION):
+                blocks = execution.execute_segment_plans_batched(plans)
+            obs_profiler.count_path("scan", len(group))
+            for (m, _), blk in zip(group, blocks):
+                m.add([blk], 0, 0)
+
+    def _try_star_tree(self, segment, request):
+        from pinot_tpu.query.plan import upsert_mask_active
+        if request.is_aggregation and not request.is_selection and \
+                not upsert_mask_active(segment) and \
+                getattr(segment, "star_trees", None):
+            from pinot_tpu.startree.executor import try_star_tree_execute
+            blk = try_star_tree_execute(segment, request)
+            if blk is not None:
+                obs_profiler.count_path("cube")
+                return blk
+        return None
+
+
+class _BatchMember:
+    """Per-request accumulator for the batched execution loop."""
+    __slots__ = ("request", "selected", "selected_ids", "num_pruned",
+                 "blocks", "extra_parts", "extra_matched", "executed",
+                 "final")
+
+    def __init__(self, request, selected, num_total: int):
+        self.request = request
+        self.selected = selected
+        self.selected_ids = {id(s) for s in selected}
+        self.num_pruned = num_total - len(selected)
+        self.blocks: List[IntermediateResultsBlock] = []
+        self.extra_parts = 0
+        self.extra_matched = 0
+        self.executed = 0
+        self.final: Optional[IntermediateResultsBlock] = None
+
+    def add(self, blocks, parts: int, matched: int) -> None:
+        self.blocks.extend(blocks)
+        self.extra_parts += parts
+        self.extra_matched += matched
+
+    def finish(self, t0: float) -> IntermediateResultsBlock:
+        """Combine + stats, mirroring ServerQueryExecutor._execute's
+        tail for one member."""
+        request = self.request
+        if not self.blocks:
+            blk = IntermediateResultsBlock()
+            if request.is_group_by:
+                blk.group_map = {}
+            elif request.is_aggregation:
+                blk.agg_intermediates = None
+            if request.is_selection:
+                blk.selection_rows = []
+                blk.selection_columns = list(request.selection.columns)
+                if request.vector is not None:
+                    from pinot_tpu.common.request import \
+                        VECTOR_RESULT_COLUMNS
+                    blk.selection_columns += list(VECTOR_RESULT_COLUMNS)
+        else:
+            blk = combine_blocks(request, self.blocks)
+        if self.executed < len(self.selected):
+            blk.exceptions.append(
+                "DeadlineExceededError: segment execution truncated at "
+                f"{self.executed}/{len(self.selected)} segments (budget "
+                "expired mid-query)")
+        if self.extra_parts:
+            blk.stats.num_segments_processed -= self.extra_parts
+            blk.stats.num_segments_matched -= self.extra_matched
+        consuming_ts = [int(s_.last_indexed_time_ms)
+                        for s_ in self.selected
+                        if getattr(s_, "is_mutable", False) and
+                        hasattr(s_, "last_indexed_time_ms")]
+        blk.stats.num_consuming_segments_processed = len(consuming_ts)
+        if consuming_ts:
+            blk.stats.min_consuming_freshness_ms = min(consuming_ts)
+        blk.stats.num_segments_pruned = self.num_pruned
+        blk.stats.time_used_ms = (time.perf_counter() - t0) * 1e3
+        return blk

@@ -66,3 +66,105 @@ def query_fingerprint(request: BrokerRequest) -> str:
     payload = json.dumps(canonical_request_dict(request), sort_keys=True,
                          separators=(",", ":"))
     return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# Plan-shape key: the canonical fingerprint with literals hoisted out.
+#
+# Two requests share a plan-shape key iff they compile to the same
+# kernel SHAPE and differ only in runtime literal operands — the
+# condition under which the dispatch coalescer may stack them along a
+# leading batch axis and serve both from one kernel execution. The
+# compiled filter spec carries structure (operator tree, columns,
+# lane sources, padded widths); literal values ride as runtime params
+# (dictionary ids, member vectors, range bounds), so hoisting them
+# here mirrors the spec/params split in query/plan.py exactly.
+#
+# The key is ADVISORY: the executor re-verifies compiled-spec equality
+# before stacking (plan-time constant folds — an EQUALITY literal
+# missing from a segment dictionary folds to EMPTY, an IN list whose
+# resolved-id count crosses a pow2 bucket widens its lane — can make
+# same-key plans diverge). A collision therefore costs batch
+# occupancy, never correctness.
+
+_VALUE_LEAVES = (FilterOperator.EQUALITY, FilterOperator.NOT,
+                 FilterOperator.IN, FilterOperator.NOT_IN,
+                 FilterOperator.REGEXP_LIKE)
+
+
+def _shape_filter(node: Optional[FilterQueryTree]):
+    """Canonical shape dict + hoisted literal list for a filter tree."""
+    if node is None:
+        return None, []
+    d = filter_to_json(node)
+    lits: list = []
+    if node.operator in _COMMUTATIVE:
+        pairs = [_shape_filter(c) for c in node.children]
+        # sort by shape first so literal-only rewrites keep the child
+        # order (and thus the key) stable; tiebreak identical-shape
+        # siblings by their literal sub-vectors for determinism — a
+        # swap of such siblings permutes the literal vector but the
+        # shape encoding, and the key, are unchanged
+        pairs.sort(key=lambda p: (json.dumps(p[0], sort_keys=True),
+                                  json.dumps(p[1], default=str)))
+        d["children"] = [shape for shape, _ in pairs]
+        for _, sub in pairs:
+            lits.extend(sub)
+    elif node.operator in _SET_VALUED:
+        vals = sorted(node.values)
+        lits.extend(vals)
+        # arity stays structural: the compiled lane width is padded
+        # from the list length, so a different-arity IN is (usually) a
+        # different kernel shape
+        d["vals"] = ["?"] * len(vals)
+    elif node.operator in _VALUE_LEAVES:
+        lits.extend(node.values)
+        d["vals"] = ["?"] * len(node.values)
+    elif node.operator is FilterOperator.RANGE:
+        lits.append(node.lower)
+        lits.append(node.upper)
+        d["lo"] = "?" if node.lower is not None else None
+        d["hi"] = "?" if node.upper is not None else None
+        # bound PRESENCE and inclusivity flags stay structural
+    return d, lits
+
+
+def plan_shape_key(request: BrokerRequest):
+    """``(key, literal_vector)`` — the canonical fingerprint with
+    literals hoisted out. Same key == batchable modulo the compiled
+    spec check; the literal vector is the hoisted operands in canonical
+    order (diagnostics and property tests, not an execution input —
+    the stacked params come from each member's compiled plan)."""
+    d = request_to_json(request)
+    shape, lits = _shape_filter(request.filter)
+    d["filter"] = shape
+    # LIMIT and the selection window are literal knobs too: they shape
+    # the host-side finish (and at most a pow2 topk bucket the spec
+    # check re-verifies), not the operator tree
+    lits.append(d.get("limit"))
+    d["limit"] = "?"
+    sel = d.get("selection")
+    if sel:
+        lits.append(sel.get("offset"))
+        lits.append(sel.get("size"))
+        sel["offset"] = "?"
+        sel["size"] = "?"
+    gb = d.get("groupBy")
+    if gb:
+        lits.append(gb.get("topN"))
+        gb["topN"] = "?"
+    vec = d.get("vector")
+    if vec:
+        # the query embedding is a runtime operand; k shapes the topk
+        # lane and stays structural
+        lits.extend(vec.get("q") or ())
+        vec["q"] = "?"
+    opts = d.get("options") or {}
+    drop = {"workload", "trace", "timeoutMs",
+            "minConsumingFreshnessTimeMs"}
+    d["options"] = {"options": dict(sorted(
+        (k, v) for k, v in (opts.get("options") or {}).items()
+        if k not in drop))}
+    payload = json.dumps(d, sort_keys=True, separators=(",", ":"))
+    key = hashlib.sha256(payload.encode("utf-8")).hexdigest()[:32]
+    return key, tuple(lits)

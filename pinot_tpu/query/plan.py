@@ -435,6 +435,23 @@ class SegmentPlan:
         return execution.execute_segment_plan(self)
 
 
+def batch_signature(plan: SegmentPlan) -> Optional[tuple]:
+    """The compiled-spec identity under which plans for ONE segment may
+    share a batched dispatch, or None when this plan cannot batch.
+
+    This is the ground truth behind the advisory plan_shape_key: two
+    plans with equal signatures compile (get_batched_segment_kernel)
+    to one executable and differ only in runtime param values. Fast
+    paths never reach the device; group specs are excluded because
+    drive_group_execution's scout phases are value-dependent per query.
+    """
+    if plan.fast_path_result is not None or plan.group_spec is not None:
+        return None
+    return (plan.segment.padded_docs, plan.filter_spec,
+            tuple(plan.agg_specs or ()), plan.select_spec,
+            tuple(plan.needed_cols))
+
+
 def preprocess_request(segments, request):
     """Parity: core/plan/maker/BrokerRequestPreProcessor.preProcess —
     rewrite FASTHLL(col) to the derived serialized-HLL column recorded in

@@ -153,7 +153,13 @@ class AdmissionController:
 
     def admit(self, table: str, tenant: str,
               budget_ms: Optional[float] = None,
-              hedge: bool = False) -> AdmissionDecision:
+              hedge: bool = False,
+              batch_join: bool = False) -> AdmissionDecision:
+        """``batch_join``: this server already holds an open batch
+        window for the request's plan shape — a hedged duplicate that
+        would normally be shed at the low watermark instead rides the
+        primary's dispatch for (almost) free, so shedding it wastes a
+        slot for zero information."""
         # the estimator read happens OUTSIDE self._lock (it takes the
         # timer's own lock; no nesting); same for the residency
         # promotion backlog (it takes the manager's lock)
@@ -180,7 +186,7 @@ class AdmissionController:
             if depth >= self.max_pending:
                 return self._shed(
                     "capacity", self._drain_estimate_ms(depth, est))
-            if hedge and depth >= self.low:
+            if hedge and depth >= self.low and not batch_join:
                 return self._shed("hedge", 0.0)
             if depth >= self.mid and len(self._by_tenant) >= 2:
                 # the fair-share gate protects OTHER tenants: with one

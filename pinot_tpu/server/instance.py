@@ -9,14 +9,17 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
-from typing import Optional, Tuple
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
 
 from pinot_tpu.common.datatable import (DataTable, RESULT_CACHE_HIT_KEY,
                                         amend_metadata_bytes)
 from pinot_tpu.common.metrics import (MetricsRegistry, ServerGauge,
-                                      ServerMeter, ServerQueryPhase)
+                                      ServerMeter, ServerQueryPhase,
+                                      ServerTimer)
 from pinot_tpu.common.request import InstanceRequest
 from pinot_tpu.common.serde import instance_request_from_bytes
 from pinot_tpu.server.admission import (AdmissionController,
@@ -24,12 +27,32 @@ from pinot_tpu.server.admission import (AdmissionController,
                                         busy_datatable)
 from pinot_tpu.server.data_manager import InstanceDataManager
 from pinot_tpu.server.query_executor import InstanceQueryExecutor
-from pinot_tpu.server.result_cache import (ServerResultCache,
+from pinot_tpu.server.result_cache import (ServerResultCache, SingleFlight,
                                            segment_cache_states)
-from pinot_tpu.server.scheduler import (QueryScheduler,
+from pinot_tpu.server.scheduler import (BatchGroup, DispatchCoalescer,
+                                        QueryScheduler,
                                         SchedulerOutOfCapacityError,
                                         make_scheduler)
 from pinot_tpu.transport.tcp import EventLoopThread, QueryServer
+
+#: batching admission window (ms) when neither the constructor nor
+#: PINOT_TPU_BATCH_WINDOW_MS says otherwise; 0 disables coalescing
+#: entirely (bit-exact pre-coalescer behavior)
+DEFAULT_BATCH_WINDOW_MS = 2.0
+
+
+class _BatchTicket:
+    """One coalescer member: the request plus the future its caller is
+    already awaiting; resolved by the group runner (or the abandon
+    callback) exactly once."""
+
+    __slots__ = ("request", "deser_ms", "future", "t_arrive")
+
+    def __init__(self, request: InstanceRequest, deser_ms: float):
+        self.request = request
+        self.deser_ms = deser_ms
+        self.future: Future = Future()
+        self.t_arrive = time.perf_counter()
 
 
 class ServerInstance:
@@ -40,7 +63,8 @@ class ServerInstance:
                  mesh=None, use_device: bool = True,
                  max_pending: Optional[int] = None,
                  result_cache_entries: int = 256,
-                 device_bytes_budget: Optional[int] = None):
+                 device_bytes_budget: Optional[int] = None,
+                 batch_window_ms: Optional[float] = None):
         self.instance_id = instance_id
         self.metrics = MetricsRegistry("server")
         from pinot_tpu.obs import residency
@@ -86,6 +110,30 @@ class ServerInstance:
             backlog_fn=self.residency.promotion_backlog)
         self.result_cache = ServerResultCache(
             max_entries=result_cache_entries)
+        # cold-cache dedup for IDENTICAL concurrent queries: the first
+        # executes, the rest await its cache entry (bounded) — the
+        # degenerate batch the coalescer never needs to see
+        self.single_flight = SingleFlight()
+        # cross-query dispatch coalescing: same-plan-shape queries that
+        # overlap in flight share one (vmapped) kernel execution after
+        # a short admission window (config `batchWindowMs` /
+        # PINOT_TPU_BATCH_WINDOW_MS; <= 0 disables, restoring the
+        # strictly per-query dispatch path)
+        if batch_window_ms is None:
+            batch_window_ms = float(os.environ.get(
+                "PINOT_TPU_BATCH_WINDOW_MS", DEFAULT_BATCH_WINDOW_MS))
+        self.batch_window_ms = float(batch_window_ms)
+        self.coalescer: Optional[DispatchCoalescer] = None
+        if self.batch_window_ms > 0:
+            self.coalescer = DispatchCoalescer(
+                self.batch_window_ms / 1e3,
+                on_dispatch=self._on_batch_dispatch,
+                on_bypass=self._on_batch_bypass)
+        # exist at 0 from boot so dashboards see the series immediately
+        self.metrics.meter(ServerMeter.BATCHED_DISPATCHES)
+        self.metrics.meter(ServerMeter.BATCH_BYPASS)
+        self.metrics.meter(ServerMeter.SINGLE_FLIGHT_WAITS)
+        self.metrics.timer(ServerTimer.BATCH_OCCUPANCY)
         # exchange plane (multi-stage queries): published stage-1 blocks
         # served to peer servers over XCHG data-plane frames
         from pinot_tpu.query.stages.exchange import ExchangeManager
@@ -185,41 +233,44 @@ class ServerInstance:
 
     # -- result cache -------------------------------------------------------
     def _cache_lookup(self, request: InstanceRequest):
-        """→ (fingerprint, cached reply bytes or None, generation).
-        A hit is served WITHOUT touching the admission queue or the
-        scheduler. The generation is captured BEFORE execution so a
-        segment swap's clear() while the query runs invalidates its
-        eventual store instead of racing it."""
+        """→ (fingerprint, cached reply bytes or None, generation,
+        full cache key or None). A hit is served WITHOUT touching the
+        admission queue or the scheduler. The generation is captured
+        BEFORE execution so a segment swap's clear() while the query
+        runs invalidates its eventual store instead of racing it. The
+        key comes back even on a miss — including the cold (empty)
+        cache — because it doubles as the single-flight dedup key; a
+        None key means the request is uncacheable (traced, mutable /
+        CRC-less segments, missing segments)."""
         gen = self.result_cache.generation
         if request.enable_trace:
-            return None, None, gen     # traced queries want real spans
-        if len(self.result_cache) == 0:
-            # empty-cache fast path: skip the probe's per-segment
-            # acquire/release and the fingerprint hash entirely —
-            # _maybe_cache_store computes the key itself at store time
-            self.metrics.meter(ServerMeter.RESULT_CACHE_MISSES).mark()
-            return None, None, gen
+            return None, None, gen, None  # traced queries want real spans
         tdm = self.data_manager.table(request.query.table_name)
         if tdm is None:
-            return None, None, gen
+            return None, None, gen, None
         acquired, missing = tdm.acquire_segments(request.search_segments)
         try:
             if missing:
-                return None, None, gen
+                return None, None, gen, None
             states = segment_cache_states([s.segment for s in acquired])
         finally:
             for sdm in acquired:
                 tdm.release_segment(sdm)
         if states is None:
             # mutable / CRC-less segment in the set
-            return None, None, gen
+            return None, None, gen, None
         from pinot_tpu.query.fingerprint import query_fingerprint
         fp = query_fingerprint(request.query)
-        payload = self.result_cache.get(
-            ServerResultCache.key(request.query.table_name, fp, states))
+        key = ServerResultCache.key(request.query.table_name, fp, states)
+        if len(self.result_cache) == 0:
+            # empty-cache fast path: skip the entry probe (the states /
+            # fingerprint above still feed the single-flight key)
+            self.metrics.meter(ServerMeter.RESULT_CACHE_MISSES).mark()
+            return fp, None, gen, key
+        payload = self.result_cache.get(key)
         if payload is None:
             self.metrics.meter(ServerMeter.RESULT_CACHE_MISSES).mark()
-            return fp, None, gen
+            return fp, None, gen, key
         self.metrics.meter(ServerMeter.RESULT_CACHE_HITS).mark()
         # splice ONLY the metadata map (fresh bytes per hit, rows
         # byte-identical to the original run): a full serde round-trip
@@ -228,7 +279,28 @@ class ServerInstance:
         reply = amend_metadata_bytes(payload, {
             "requestId": str(request.request_id),
             RESULT_CACHE_HIT_KEY: "1"})
-        return fp, reply, gen
+        return fp, reply, gen, key
+
+    def _single_flight_follow(self, request: InstanceRequest,
+                              ckey: tuple, ev) -> Optional[bytes]:
+        """A leader is executing this exact query: wait (bounded) on
+        its event, then re-probe the cache. None → fall through to own
+        execution (leader failed / skipped the store / wait expired) —
+        correctness never depends on the leader."""
+        self.metrics.meter(ServerMeter.SINGLE_FLIGHT_WAITS).mark()
+        timeout_s = 1.0
+        if request.deadline_budget_ms is not None:
+            # never burn more than half the remaining budget waiting
+            timeout_s = min(timeout_s,
+                            max(0.0, request.deadline_budget_ms / 2e3))
+        ev.wait(timeout_s)
+        payload = self.result_cache.get(ckey)
+        if payload is None:
+            return None
+        self.metrics.meter(ServerMeter.RESULT_CACHE_HITS).mark()
+        return amend_metadata_bytes(payload, {
+            "requestId": str(request.request_id),
+            RESULT_CACHE_HIT_KEY: "1"})
 
     def _maybe_cache_store(self, request: InstanceRequest,
                            dt: DataTable, payload: bytes,
@@ -257,15 +329,170 @@ class ServerInstance:
         release so the depth accounting debits and credits the same
         counter by construction."""
         tenant = self._tenant(request)
+        # a hedged duplicate whose plan shape already has an OPEN batch
+        # window here rides the primary's dispatch for (almost) free —
+        # shedding it at the low watermark would waste a slot for zero
+        # information (hedges are rare, so the extra key hash is cheap)
+        batch_join = False
+        if request.hedge and self.coalescer is not None and \
+                self._batchable(request):
+            batch_join = self.coalescer.joinable(self._batch_key(request))
         decision = self.admission.admit(
             request.query.table_name, tenant,
-            budget_ms=request.deadline_budget_ms, hedge=request.hedge)
+            budget_ms=request.deadline_budget_ms, hedge=request.hedge,
+            batch_join=batch_join)
         if not decision:
             return decision, busy_datatable(
                 request.request_id, decision.cause,
                 decision.retry_after_ms).to_bytes(), tenant
         self._register_tenant(tenant)
         return decision, None, tenant
+
+    # -- dispatch coalescing ------------------------------------------------
+    def _batchable(self, request: InstanceRequest) -> bool:
+        """Coalescer eligibility: plain single-stage queries only —
+        staged requests (join/window/exchange) have per-request side
+        channels, and traced queries want their own real spans."""
+        return self.coalescer is not None and \
+            not request.enable_trace and not self._stage_request(request)
+
+    def _batch_key(self, request: InstanceRequest) -> tuple:
+        """Queries coalesce iff they agree on table, plan shape, and
+        the segment set the broker routed here."""
+        from pinot_tpu.query.fingerprint import plan_shape_key
+        shape, _lits = plan_shape_key(request.query)
+        return (request.query.table_name, shape,
+                tuple(sorted(request.search_segments or ())))
+
+    def _on_batch_dispatch(self, occupancy: int) -> None:
+        # every sealed window lands in the occupancy distribution;
+        # batchedDispatches counts only executions that served >1 query
+        self.metrics.timer(ServerTimer.BATCH_OCCUPANCY).update(
+            float(occupancy))
+        if occupancy > 1:
+            self.metrics.meter(ServerMeter.BATCHED_DISPATCHES).mark()
+
+    def _on_batch_bypass(self) -> None:
+        self.metrics.meter(ServerMeter.BATCH_BYPASS).mark()
+
+    @staticmethod
+    def _resolve_ticket(ticket: _BatchTicket, dt: Optional[DataTable],
+                        exc: Optional[BaseException]) -> None:
+        try:
+            if exc is not None:
+                ticket.future.set_exception(exc)
+            else:
+                ticket.future.set_result(dt)
+        except Exception:  # noqa: BLE001 — already cancelled/resolved
+            pass
+
+    #: per-dispatch member cap: groups past this run as consecutive
+    #: chunks, which pins the pow2 batch buckets the vmapped kernels
+    #: ever compile at to {2, 4, 8} — an unbounded occupancy would keep
+    #: minting new bucket sizes (= fresh XLA compiles) exactly when the
+    #: server is busiest
+    MAX_BATCH_CHUNK = 8
+
+    def _run_batch(self, members: List[_BatchTicket],
+                   deadline_s: Optional[float]) -> None:
+        """Execute a sealed group and fan results back to every
+        member's future (one-member groups take the ordinary execute
+        path — same code the solo/bypass states run)."""
+        for i in range(0, len(members), self.MAX_BATCH_CHUNK):
+            self._run_batch_chunk(members[i:i + self.MAX_BATCH_CHUNK],
+                                  deadline_s)
+
+    def _run_batch_chunk(self, members: List[_BatchTicket],
+                         deadline_s: Optional[float]) -> None:
+        waits = [(time.perf_counter() - m.t_arrive) * 1e3
+                 for m in members]
+        try:
+            if len(members) == 1:
+                m = members[0]
+                dt = self.executor.execute(
+                    m.request, scheduler_wait_ms=waits[0],
+                    deadline=deadline_s, deser_ms=m.deser_ms)
+                dts = [dt]
+            else:
+                dts = self.executor.execute_batch(
+                    [m.request for m in members], waits, deadline_s)
+            for m, dt in zip(members, dts):
+                self._resolve_ticket(m, dt, None)
+        except BaseException as e:  # noqa: BLE001 — fan the failure out
+            for m in members:
+                self._resolve_ticket(m, None, e)
+
+    def _abandon_group(self, gfut: Future, group: BatchGroup) -> None:
+        """Done-callback on the group runner's scheduler future: if the
+        runner never got to seal (queue rejection, deadline trim,
+        shutdown), fail every member future so no caller hangs. After a
+        NORMAL run the group is already sealed and this is a no-op."""
+        if self.coalescer is None:
+            return
+        members = self.coalescer.seal(group)
+        if not members:
+            return
+        try:
+            exc: Optional[BaseException] = None
+            try:
+                exc = gfut.exception()
+            except BaseException as e:  # noqa: BLE001 — cancelled
+                exc = e
+            if exc is None:
+                exc = RuntimeError(
+                    "batch group abandoned without executing")
+            for m in members:
+                self._resolve_ticket(m, None, exc)
+        finally:
+            self.coalescer.leave(group.key)
+
+    def _coalesced_submit(self, request: InstanceRequest, deser_ms: float,
+                          deadline: Optional[float],
+                          budget_s: Optional[float],
+                          tenant: str) -> Future:
+        """Route an eligible query through the dispatch coalescer;
+        returns the future its caller awaits (a scheduler future for
+        solo/bypass, the member ticket's future for joined/lead)."""
+        key = self._batch_key(request)
+        ticket = _BatchTicket(request, deser_ms)
+        state, group = self.coalescer.arrive(key, ticket, deadline)
+        if state in ("solo", "bypass"):
+            t_submit = time.perf_counter()
+
+            def run():
+                wait_ms = (time.perf_counter() - t_submit) * 1e3
+                return self.executor.execute(
+                    request, scheduler_wait_ms=wait_ms,
+                    deadline=deadline, deser_ms=deser_ms)
+
+            fut = self.scheduler.submit(tenant, run, deadline_s=budget_s)
+            fut.add_done_callback(
+                lambda _f, k=key: self.coalescer.leave(k))
+            return fut
+        if state == "joined":
+            return ticket.future
+
+        # lead: schedule the window runner under the leader's tenant.
+        # It sleeps out the window, seals, and executes the batch under
+        # the group deadline (the TIGHTEST member deadline at seal).
+        def run_group():
+            delay = self.coalescer.remaining_window_s(group)
+            if delay > 0:
+                time.sleep(delay)
+            members = self.coalescer.seal(group)
+            if not members:      # abandon callback won the seal race
+                return None
+            try:
+                self._run_batch(members, group.deadline_s)
+            finally:
+                self.coalescer.leave(key)
+            return None
+
+        gfut = self.scheduler.submit(tenant, run_group,
+                                     deadline_s=budget_s)
+        gfut.add_done_callback(
+            lambda f, g=group: self._abandon_group(f, g))
+        return ticket.future
 
     def _schedule(self, request: InstanceRequest, deser_ms: float = 0.0,
                   admission_deadline_s: Optional[float] = None,
@@ -288,23 +515,29 @@ class ServerInstance:
             deadline = admission_deadline_s if deadline is None \
                 else min(deadline, admission_deadline_s)
             budget_s = max(0.0, deadline - time.monotonic())
-        t_submit = time.perf_counter()
-
-        def run():
-            wait_ms = (time.perf_counter() - t_submit) * 1e3
-            return self.executor.execute(request, scheduler_wait_ms=wait_ms,
-                                         deadline=deadline,
-                                         deser_ms=deser_ms)
-
         # per-TENANT scheduler group: the token hierarchy isolates CPU
         # between tenants instead of pooling everything per table
         if tenant is None:
             tenant = self._tenant(request)
-        fut = self.scheduler.submit(tenant, run, deadline_s=budget_s)
+        if self._batchable(request):
+            fut = self._coalesced_submit(request, deser_ms, deadline,
+                                         budget_s, tenant)
+        else:
+            t_submit = time.perf_counter()
+
+            def run():
+                wait_ms = (time.perf_counter() - t_submit) * 1e3
+                return self.executor.execute(request,
+                                             scheduler_wait_ms=wait_ms,
+                                             deadline=deadline,
+                                             deser_ms=deser_ms)
+
+            fut = self.scheduler.submit(tenant, run, deadline_s=budget_s)
         if release_admission:
             # pairs with the admit() in the request path; a failed
             # future (e.g. OutOfCapacity) completes immediately, so the
-            # depth can never leak
+            # depth can never leak. Each batch member carries its OWN
+            # future, so every member credits its own tenant here.
             fut.add_done_callback(
                 lambda _f, t=tenant: self.admission.release(t))
         return fut
@@ -443,30 +676,47 @@ class ServerInstance:
             return err
         staged = self._stage_request(request)
         if staged:
-            fingerprint, cached, gen = None, None, None
+            fingerprint, cached, gen, ckey = None, None, None, None
         else:
-            fingerprint, cached, gen = self._cache_lookup(request)
+            fingerprint, cached, gen, ckey = self._cache_lookup(request)
         if cached is not None:
             return cached          # bypasses admission AND scheduling
-        decision, busy, tenant = self._admit(request)
-        if busy is not None:
-            return busy
+        leader_key = None
+        if ckey is not None:
+            # single-flight: identical concurrent queries on a cold
+            # entry — the first becomes leader, the rest await its
+            # store (bounded) and re-probe, falling through on failure
+            is_leader, ev = self.single_flight.begin(ckey)
+            if is_leader:
+                leader_key = ckey
+            else:
+                reply = self._single_flight_follow(request, ckey, ev)
+                if reply is not None:
+                    return reply
         try:
-            dt = self._schedule(request, deser_ms,
-                                admission_deadline_s=decision.deadline_s,
-                                release_admission=True,
-                                tenant=tenant).result()
-            reply = self._serialize(request, dt)
-            if request.publish_exchange is not None:
-                return self._maybe_publish(request, dt, reply)
-            if not staged:
-                self._maybe_cache_store(request, dt, reply, fingerprint,
-                                        gen)
-            return reply
-        except SchedulerOutOfCapacityError:
-            return self._capacity_reply(request)
-        except Exception as e:  # noqa: BLE001 — execution or serde error
-            return self._error_reply(request, e)
+            decision, busy, tenant = self._admit(request)
+            if busy is not None:
+                return busy
+            try:
+                dt = self._schedule(
+                    request, deser_ms,
+                    admission_deadline_s=decision.deadline_s,
+                    release_admission=True,
+                    tenant=tenant).result()
+                reply = self._serialize(request, dt)
+                if request.publish_exchange is not None:
+                    return self._maybe_publish(request, dt, reply)
+                if not staged:
+                    self._maybe_cache_store(request, dt, reply,
+                                            fingerprint, gen)
+                return reply
+            except SchedulerOutOfCapacityError:
+                return self._capacity_reply(request)
+            except Exception as e:  # noqa: BLE001 — execution/serde error
+                return self._error_reply(request, e)
+        finally:
+            if leader_key is not None:
+                self.single_flight.done(leader_key)
 
     # -- network path (one coroutine per in-flight frame) ------------------
     async def handle_request_async(self, payload: bytes) -> bytes:
@@ -486,46 +736,60 @@ class ServerInstance:
         staged = self._stage_request(request)
         # the cache probe touches segment refcounts and hashes the
         # request — off-loop, like the serde it replaces on a hit. But
-        # when the probe is a guaranteed no-op (traced query, stage
-        # request, or the cache is empty — e.g. all-consuming realtime
-        # tables never store) the cheap guards run inline: no per-query
-        # threadpool hop just to bounce off _cache_lookup's early returns
+        # when the probe is a guaranteed no-op (traced query or stage
+        # request) the cheap guards run inline: no per-query threadpool
+        # hop just to bounce off _cache_lookup's early returns
         if staged:
-            fingerprint, cached, gen = None, None, None
-        elif request.enable_trace or len(self.result_cache) == 0:
-            fingerprint, cached, gen = self._cache_lookup(request)
+            fingerprint, cached, gen, ckey = None, None, None, None
+        elif request.enable_trace:
+            fingerprint, cached, gen, ckey = self._cache_lookup(request)
         else:
-            fingerprint, cached, gen = await loop.run_in_executor(
+            fingerprint, cached, gen, ckey = await loop.run_in_executor(
                 None, self._cache_lookup, request)
         if cached is not None:
             return cached          # bypasses admission AND scheduling
-        decision, busy, tenant = self._admit(request)
-        if busy is not None:
-            return busy
-        try:
-            dt = await asyncio.wrap_future(self._schedule(
-                request, deser_ms,
-                admission_deadline_s=decision.deadline_s,
-                release_admission=True, tenant=tenant))
-            if dt.num_rows() <= 128:
-                # small replies (aggregations, trimmed group-bys)
-                # serialize faster than an executor hop costs
-                reply = self._serialize(request, dt)
+        leader_key = None
+        if ckey is not None:
+            is_leader, ev = self.single_flight.begin(ckey)
+            if is_leader:
+                leader_key = ckey
             else:
+                # the bounded wait blocks — off-loop like the probe
                 reply = await loop.run_in_executor(
-                    None, self._serialize, request, dt)
-            if request.publish_exchange is not None:
-                return self._maybe_publish(request, dt, reply)
-            if not staged:
-                self._maybe_cache_store(request, dt, reply, fingerprint,
-                                        gen)
-            return reply
-        except asyncio.CancelledError:
-            raise
-        except SchedulerOutOfCapacityError:
-            return self._capacity_reply(request)
-        except Exception as e:  # noqa: BLE001 — execution or serde error
-            return self._error_reply(request, e)
+                    None, self._single_flight_follow, request, ckey, ev)
+                if reply is not None:
+                    return reply
+        try:
+            decision, busy, tenant = self._admit(request)
+            if busy is not None:
+                return busy
+            try:
+                dt = await asyncio.wrap_future(self._schedule(
+                    request, deser_ms,
+                    admission_deadline_s=decision.deadline_s,
+                    release_admission=True, tenant=tenant))
+                if dt.num_rows() <= 128:
+                    # small replies (aggregations, trimmed group-bys)
+                    # serialize faster than an executor hop costs
+                    reply = self._serialize(request, dt)
+                else:
+                    reply = await loop.run_in_executor(
+                        None, self._serialize, request, dt)
+                if request.publish_exchange is not None:
+                    return self._maybe_publish(request, dt, reply)
+                if not staged:
+                    self._maybe_cache_store(request, dt, reply,
+                                            fingerprint, gen)
+                return reply
+            except asyncio.CancelledError:
+                raise
+            except SchedulerOutOfCapacityError:
+                return self._capacity_reply(request)
+            except Exception as e:  # noqa: BLE001 — execution/serde error
+                return self._error_reply(request, e)
+        finally:
+            if leader_key is not None:
+                self.single_flight.done(leader_key)
 
     # -- network service ---------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:

@@ -190,6 +190,112 @@ class InstanceQueryExecutor:
             for sdm in acquired:
                 tdm.release_segment(sdm)
 
+    def execute_batch(self, requests: List[InstanceRequest],
+                      scheduler_wait_ms: List[float],
+                      deadline: Optional[float]) -> List[DataTable]:
+        """One sealed coalescer batch: N same-shape requests over one
+        table + segment list, sharing device dispatches.
+
+        The coalescer only seals groups whose members share a table,
+        search-segment list, and plan-shape key, carry no trace, and
+        are not staged (join/window/exchange) — the invariants this
+        path leans on. Returns DataTables aligned with `requests`.
+        """
+        t_start = time.perf_counter()
+        n = len(requests)
+        for wait_ms in scheduler_wait_ms:
+            self.metrics.meter(ServerMeter.QUERIES).mark()
+            self.metrics.timer(ServerQueryPhase.SCHEDULER_WAIT).update(
+                wait_ms)
+        if deadline is not None and time.monotonic() >= deadline:
+            out = []
+            for request in requests:
+                self.metrics.meter(
+                    ServerMeter.DEADLINE_EXPIRED_QUERIES).mark()
+                dt = DataTable()
+                dt.metadata["requestId"] = str(request.request_id)
+                dt.exceptions.append(
+                    "DeadlineExceededError: query budget expired before "
+                    "execution started; dropped without executing")
+                out.append(dt)
+            return out
+        table = requests[0].query.table_name
+        tdm = self.data_manager.table(table)
+        if tdm is None:
+            out = []
+            for request in requests:
+                dt = DataTable()
+                dt.metadata["requestId"] = str(request.request_id)
+                dt.exceptions.append(
+                    f"TableDoesNotExistError: {table}")
+                out.append(dt)
+            return out
+
+        trace = make_trace_context(False)
+        profile = QueryProfile(table)
+        acquired, missing = tdm.acquire_segments(
+            requests[0].search_segments)
+        residency_token = self.residency.begin_query(
+            [s.segment for s in acquired])
+        try:
+            segments = [s.segment for s in acquired]
+            from pinot_tpu.server.result_cache import segment_cache_states
+            pre_states = None if missing else \
+                segment_cache_states(segments)
+            from pinot_tpu.query.plan import preprocess_request
+            # preprocess HERE (not just inside the executor): the
+            # DataTable columns must carry any FASTHLL-rewritten names
+            queries = [preprocess_request(segments, r.query)
+                       for r in requests]
+            with obs_profiler.active(profile, trace):
+                blocks = self.executor.execute_batch(
+                    queries, segments, trace=trace, deadline=deadline)
+            elapsed_ms = (time.perf_counter() - t_start) * 1e3
+            out = []
+            for request, query, block in zip(requests, queries, blocks):
+                if missing:
+                    block.exceptions.append(
+                        f"{SEGMENT_MISSING_EXC_PREFIX} {sorted(missing)}")
+                timeout_ms = query.query_options.timeout_ms or \
+                    self.default_timeout_ms
+                if request.deadline_budget_ms is not None:
+                    timeout_ms = min(timeout_ms,
+                                     request.deadline_budget_ms)
+                if elapsed_ms > timeout_ms:
+                    block.exceptions.append(
+                        f"QueryTimeoutError: {elapsed_ms:.0f}ms > "
+                        f"{timeout_ms:.0f}ms")
+                block.stats.time_used_ms = elapsed_ms
+                # every member pays (and reports) the batch wall time —
+                # it really did wait for the shared dispatch
+                self.metrics.timer(
+                    ServerQueryPhase.QUERY_PROCESSING).update(elapsed_ms)
+                self.metrics.timer(ServerQueryPhase.QUERY_PROCESSING,
+                                   table=table).update(elapsed_ms)
+                dt = DataTable.from_block(query, block)
+                dt.metadata["requestId"] = str(request.request_id)
+                dt.cache_states = pre_states
+                # per-member profile: own result stats; the dispatch /
+                # transfer / path numbers are the BATCH's (each member
+                # honestly rode every shared dispatch), batchSize says so
+                mp = QueryProfile(table)
+                mp.dispatches = profile.dispatches
+                mp.transfer_bytes = profile.transfer_bytes
+                mp.kernel_ms = profile.kernel_ms
+                mp.paths = dict(profile.paths)
+                mp.batch_size = n
+                mp.finish_from_stats(block.stats)
+                dt.metadata["profileInfo"] = mp.to_json_str()
+                if missing:
+                    dt.metadata[MISSING_SEGMENTS_KEY] = json.dumps(
+                        sorted(missing))
+                out.append(dt)
+            return out
+        finally:
+            self.residency.end_query(residency_token)
+            for sdm in acquired:
+                tdm.release_segment(sdm)
+
     def _attach_join_context(self, request: InstanceRequest, query,
                              segments: List, deadline: Optional[float]):
         """Build the JoinContext from the exchanged dim blocks and

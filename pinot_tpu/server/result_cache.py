@@ -121,3 +121,41 @@ class ServerResultCache:
         with self._lock:
             return {"entries": len(self._entries), "bytes": self._bytes,
                     "hits": self.hits, "misses": self.misses}
+
+
+class SingleFlight:
+    """Cold-cache dedup for IDENTICAL concurrent queries.
+
+    N requests sharing a full result-cache key (table + canonical
+    fingerprint + frozen segment states) on a cold cache are the
+    degenerate batch — same literals, same everything. The first probe
+    becomes the LEADER and executes; followers block (bounded) on the
+    leader's completion and then RE-PROBE the cache. Correctness never
+    depends on the leader: a follower whose wait times out, or whose
+    re-probe still misses (leader failed, cache cleared by a segment
+    swap, entry evicted), simply falls through to its own execution —
+    the pre-existing behavior.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waiters: "dict[tuple, threading.Event]" = {}
+
+    def begin(self, key: tuple):
+        """(is_leader, event). Leaders MUST call done(key) afterwards
+        (any outcome); followers wait on the event then re-probe."""
+        with self._lock:
+            ev = self._waiters.get(key)
+            if ev is not None:
+                return False, ev
+            ev = threading.Event()
+            self._waiters[key] = ev
+            return True, ev
+
+    def done(self, key: tuple) -> None:
+        """The leader finished (stored, failed, or skipped the store):
+        release every follower and retire the key."""
+        with self._lock:
+            ev = self._waiters.pop(key, None)
+        if ev is not None:
+            ev.set()

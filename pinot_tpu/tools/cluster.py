@@ -153,10 +153,15 @@ class EmbeddedCluster:
         sealed = participant.seal_consuming(seal_timeout_s)
         self.controller.coordinator.deregister_participant(name)
         # the embedded watch chain is synchronous, but the broker's
-        # in-flight scatters are not: give them a beat to finish
+        # in-flight scatters are not: hold the FULL settle window. A
+        # depth()==0 early exit raced queries already scattered but not
+        # yet admitted (in transit they hold no admission slot), so the
+        # stop below turned them into execution errors on a loaded box.
         deadline = time.monotonic() + max(settle_s, 0.05)
-        while time.monotonic() < deadline and \
-                server.admission.depth() > 0:
+        while time.monotonic() < deadline:
+            time.sleep(0.02)
+        while server.admission.depth() > 0 and \
+                time.monotonic() < deadline + seal_timeout_s:
             time.sleep(0.02)
         # only NOW leave the transport's server map: the seal and the
         # settle window above still serve queries, and the in-process

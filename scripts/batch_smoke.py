@@ -1,0 +1,140 @@
+"""Cross-query batching smoke for CI: a concurrent same-plan-shape
+query mix must actually coalesce (batchOccupancy > 1) AND answer
+bit-identically to a sequential twin server with coalescing disabled
+(batchWindowMs=0 — the strictly per-query dispatch path).
+
+A correctness-under-concurrency canary, not a benchmark: it catches a
+fan-back that mixes members, a literal that leaked into the shared
+spec, or a window that stopped sealing — in seconds, on the embedded
+in-process plane. Honest throughput numbers come from
+scripts/qps_curve.py (QPS_r*.json artifacts).
+"""
+import os
+import sys
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROWS = int(os.environ.get("BATCH_SMOKE_ROWS", 4000))
+SEGMENTS = int(os.environ.get("BATCH_SMOKE_SEGMENTS", 2))
+WAVES = int(os.environ.get("BATCH_SMOKE_WAVES", 6))
+WAVE_WIDTH = int(os.environ.get("BATCH_SMOKE_WIDTH", 6))
+WINDOW_MS = float(os.environ.get("BATCH_SMOKE_WINDOW_MS", 50.0))
+
+TABLE = "lineorder_OFFLINE"
+# same plan shape, literal-only jitter — the coalescer's target
+# workload; integer-exact aggregations so bit-equality is meaningful
+PQL = ("SELECT COUNT(*), SUM(lo_revenue) FROM lineorder_OFFLINE "
+       "WHERE lo_revenue > '{lit}'")
+
+
+def _build_server(window_ms: float, seg_dirs):
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+    from pinot_tpu.server import ServerInstance
+
+    s = ServerInstance(f"smoke_w{window_ms:g}",
+                       batch_window_ms=window_ms)
+    tdm = s.data_manager.table(TABLE, create=True)
+    for d in seg_dirs:
+        tdm.add_segment(ImmutableSegmentLoader.load(d))
+    return s
+
+
+def _payload_of(dt):
+    meta = {k: v for k, v in dt.metadata.items()
+            if k not in ("requestId", "resultCacheHit", "timeUsedMs",
+                         "profileInfo", "executionPath")}
+    return dt.kind, dt.columns, dt.rows, meta, dt.exceptions
+
+
+def main() -> int:
+    from pinot_tpu.common.datatable import DataTable
+    from pinot_tpu.common.metrics import ServerMeter, ServerTimer
+    from pinot_tpu.common.request import InstanceRequest
+    from pinot_tpu.common.serde import instance_request_to_bytes
+    from pinot_tpu.pql.parser import compile_pql
+    from pinot_tpu.tools.datagen import build_ssb_segment_dirs
+
+    base = tempfile.mkdtemp()
+    seg_dirs, _ids, _sc = build_ssb_segment_dirs(
+        os.path.join(base, "segs"), ROWS, SEGMENTS, seed=11)
+    batched = _build_server(WINDOW_MS, seg_dirs)
+    twin = _build_server(0.0, seg_dirs)
+    assert twin.coalescer is None, "window 0 must disable the coalescer"
+
+    def ask(server, pql, request_id):
+        payload = instance_request_to_bytes(InstanceRequest(
+            request_id=request_id, query=compile_pql(pql)))
+        return DataTable.from_bytes(server.handle_request_bytes(payload))
+
+    ok = True
+    try:
+        rid = 0
+        for wave in range(WAVES):
+            # fresh literals every wave: no result-cache interference,
+            # every member really executes (or rides a batch)
+            pqls = [PQL.format(lit=1000 * wave + 77 * i)
+                    for i in range(WAVE_WIDTH)]
+            expected = []
+            for pql in pqls:
+                rid += 1
+                dt = ask(twin, pql, rid)
+                if dt.exceptions:
+                    print(f"FAIL: twin errored on {pql}: "
+                          f"{dt.exceptions}", file=sys.stderr)
+                    return 1
+                expected.append(_payload_of(dt))
+            barrier = threading.Barrier(WAVE_WIDTH)
+            base_rid = rid
+
+            def fire(i, _pqls=pqls, _base=base_rid):
+                barrier.wait()
+                return ask(batched, _pqls[i], _base + 1 + i)
+
+            with ThreadPoolExecutor(max_workers=WAVE_WIDTH) as pool:
+                got = list(pool.map(fire, range(WAVE_WIDTH)))
+            rid += WAVE_WIDTH
+            for pql, dt, want in zip(pqls, got, expected):
+                if dt.exceptions:
+                    print(f"FAIL: batched errored on {pql}: "
+                          f"{dt.exceptions}", file=sys.stderr)
+                    ok = False
+                elif _payload_of(dt) != want:
+                    print(f"FAIL: batched result differs from the "
+                          f"sequential twin on {pql}:\n  batched: "
+                          f"{_payload_of(dt)}\n  sequential: {want}",
+                          file=sys.stderr)
+                    ok = False
+
+        dispatches = batched.metrics.meter(
+            ServerMeter.BATCHED_DISPATCHES).count
+        occ = batched.metrics.timer(ServerTimer.BATCH_OCCUPANCY)
+        max_occ = occ.percentile_ms(100.0) if occ.count else 0.0
+        mean_occ = occ.mean_ms if occ.count else 0.0
+        print(f"batch smoke: {WAVES}x{WAVE_WIDTH} same-shape queries, "
+              f"{dispatches} batched dispatches, occupancy "
+              f"mean={mean_occ:.2f} max={max_occ:.0f}")
+        if dispatches < 1 or max_occ < 2:
+            print("FAIL: the concurrent mix never coalesced "
+                  f"(batchedDispatches={dispatches}, "
+                  f"max occupancy={max_occ:.0f}) — the window is not "
+                  "admitting joiners", file=sys.stderr)
+            ok = False
+        if twin.metrics.meter(ServerMeter.BATCHED_DISPATCHES).count:
+            print("FAIL: the batchWindowMs=0 twin batched something",
+                  file=sys.stderr)
+            ok = False
+        print("batch smoke: " + ("OK" if ok else "FAILED"))
+        return 0 if ok else 1
+    finally:
+        batched.stop()
+        twin.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,13 @@ echo "== residency smoke (tiered memory pressure) =="
 # answer
 env JAX_PLATFORMS=cpu python scripts/residency_smoke.py
 
+echo "== batch smoke (cross-query dispatch coalescing) =="
+# a concurrent same-plan-shape mix must coalesce (batchOccupancy > 1)
+# and answer bit-identically to a batchWindowMs=0 sequential twin —
+# catches member-mixing fan-backs and literals leaking into the
+# shared kernel spec in seconds
+env JAX_PLATFORMS=cpu python scripts/batch_smoke.py
+
 echo "== tpulint (deep + protocol tiers) =="
 # --deep adds the below-the-AST gates on top of the AST families:
 # every registered kernel is traced with jax.make_jaxpr across the

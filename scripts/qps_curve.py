@@ -355,6 +355,15 @@ def _run_shape(dirs, schema, table_config, base, num_brokers,
         if JITTER > 0:
             for _ in range(2):
                 fn(provider(0))
+            # warm the BATCHED buckets too: concurrent same-shape
+            # bursts form real coalescer groups at the servers, so the
+            # pow2 batch-axis buckets (2/4/8) compile here instead of
+            # inside a measured rung (the single-thread warm above can
+            # never overlap, so it only ever compiles batch=1 kernels)
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=48) as pool:
+                for _ in range(4):
+                    list(pool.map(fn, [provider(0) for _ in range(48)]))
         print(f"warm[{num_brokers}x{num_servers}]: {warm}",
               file=sys.stderr, flush=True)
 
@@ -436,6 +445,7 @@ def main() -> None:
     knee = max((s["saturation_knee_qps"] for s in shapes_out
                 if s["saturation_knee_qps"] is not None),
                default=None)
+    from pinot_tpu.server.instance import DEFAULT_BATCH_WINDOW_MS
     out = {
         "artifact": "ssb13_throughput_scaling_curve",
         "rows": ROWS, "segments": SEGMENTS,
@@ -454,6 +464,8 @@ def main() -> None:
             "brokerOfflineResultCache":
                 os.environ["PINOT_TPU_BROKER_CACHE_OFFLINE"] != "0",
             "shmMinBytes": int(os.environ["PINOT_TPU_SHM_MIN_BYTES"]),
+            "batchWindowMs": float(os.environ.get(
+                "PINOT_TPU_BATCH_WINDOW_MS", DEFAULT_BATCH_WINDOW_MS)),
         },
         "saturation_knee_qps": knee,
         "max_sustained_qps": max(s["max_sustained_qps"]

@@ -88,6 +88,24 @@ def test_hedges_shed_first_at_low_watermark():
     assert ctrl.admit("T", "a", hedge=False)       # primaries still admit
 
 
+def test_hedge_joining_open_batch_window_is_admitted():
+    """A hedged duplicate whose plan shape has an OPEN batch window on
+    this server rides the primary's dispatch for (almost) free — the
+    low-watermark hedge shed must not apply to it."""
+    ctrl, _ = _controller(max_pending=10)          # low = 4
+    _fill(ctrl, 4)
+    d = ctrl.admit("T", "a", hedge=True)
+    assert not d and d.cause == "hedge"            # no window: shed
+    assert ctrl.admit("T", "a", hedge=True, batch_join=True)
+    # the carve-out is hedge-specific sugar, not an admission bypass:
+    # capacity still wins at max_pending (distinct tenants keep each
+    # below its fair-share floor so only the capacity tier engages)
+    for i in range(5):                             # depth 10 = max
+        assert ctrl.admit("T", f"x{i}")
+    d = ctrl.admit("T", "a", hedge=True, batch_join=True)
+    assert not d and d.cause == "capacity"
+
+
 def test_over_quota_tenant_shed_at_mid_watermark():
     ctrl, _ = _controller(max_pending=10)          # mid = 7
     _fill(ctrl, 6, tenant="aggressor")

@@ -1,0 +1,388 @@
+"""Cross-query device batching: the dispatch coalescer.
+
+Same-plan-shape queries that overlap in flight share ONE (vmapped)
+kernel execution per segment. The contracts under test:
+
+- coalescer state machine: solo queries pay nothing, overlapping
+  same-shape queries lead/join a bounded window, members whose budget
+  cannot survive the window bypass, seal() is idempotent;
+- batched results are BIT-IDENTICAL to the sequential twin's — on the
+  host, device, and mesh-sharded paths, and with an upsert validDocIds
+  mask active (the mask rides the cols side, shared across members);
+- `batchWindowMs=0` disables coalescing entirely (today's behavior);
+- single-flight dedup: N identical concurrent queries on a cold cache
+  execute once, the rest are served the leader's cache entry;
+- a hedged duplicate that can join an open batch window is admitted
+  past the low-watermark hedge shed (it rides the primary's dispatch).
+"""
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from fixtures import build_segment
+
+from pinot_tpu.common.datatable import DataTable, RESULT_CACHE_HIT_KEY
+from pinot_tpu.common.metrics import ServerMeter, ServerTimer
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.serde import instance_request_to_bytes
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.server import ServerInstance
+from pinot_tpu.server.scheduler import DispatchCoalescer
+
+
+# ---------------------------------------------------------------------------
+# Coalescer state machine (fake clock, no server)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_coalescer_solo_costs_nothing():
+    clk = FakeClock()
+    c = DispatchCoalescer(0.002, clock=clk)
+    state, group = c.arrive("k", "m1", None)
+    assert state == "solo" and group is None
+    c.leave("k")
+    # after leave the key is idle again: next arrival is solo too
+    assert c.arrive("k", "m2", None)[0] == "solo"
+
+
+def test_coalescer_lead_join_seal():
+    clk = FakeClock()
+    occupancies = []
+    c = DispatchCoalescer(0.002, clock=clk,
+                          on_dispatch=occupancies.append)
+    assert c.arrive("k", "solo", None)[0] == "solo"   # in flight now
+    state, g = c.arrive("k", "m1", None)
+    assert state == "lead" and g is not None
+    assert c.joinable("k")
+    assert c.arrive("k", "m2", None) == ("joined", g)
+    assert c.arrive("k", "m3", None) == ("joined", g)
+    # a different key is unaffected
+    assert c.arrive("other", "x", None)[0] == "solo"
+    clk.t += 0.001
+    assert c.remaining_window_s(g) == pytest.approx(0.001)
+    members = c.seal(g)
+    assert members == ["m1", "m2", "m3"]
+    assert occupancies == [3]
+    assert not c.joinable("k")
+    # idempotent: the abandon callback racing the runner gets []
+    assert c.seal(g) == []
+    assert occupancies == [3]
+    # the sealed group counts as in flight until leave(): a new arrival
+    # while the batch (and the original solo) run becomes a fresh lead
+    assert c.arrive("k", "m4", None)[0] == "lead"
+
+
+def test_coalescer_deadline_bypass():
+    clk = FakeClock()
+    bypasses = []
+    c = DispatchCoalescer(0.010, clock=clk,
+                          on_bypass=lambda: bypasses.append(1))
+    assert c.arrive("k", "solo", None)[0] == "solo"
+    # min_slack_windows=2: under 20ms of budget cannot ride a 10ms
+    # window and still execute — bypass, executing immediately
+    state, _ = c.arrive("k", "tight", clk.t + 0.015)
+    assert state == "bypass" and len(bypasses) == 1
+    # a comfortable budget leads a window instead
+    state, g = c.arrive("k", "roomy", clk.t + 10.0)
+    assert state == "lead"
+    # the group deadline is the TIGHTEST member's
+    c.arrive("k", "tighter", clk.t + 5.0)
+    assert g.deadline_s == pytest.approx(clk.t + 5.0)
+    c.arrive("k", "looser", clk.t + 8.0)
+    assert g.deadline_s == pytest.approx(clk.t + 5.0)
+
+
+def test_coalescer_leave_accounting_survives_interleaving():
+    c = DispatchCoalescer(0.002, clock=FakeClock())
+    assert c.arrive("k", "a", None)[0] == "solo"
+    _, g = c.arrive("k", "b", None)
+    c.seal(g)              # two in flight now: solo + sealed batch
+    c.leave("k")           # solo done
+    assert c.arrive("k", "c", None)[0] == "lead"   # batch still runs
+    c.leave("k")           # batch done
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: batched results are bit-identical to sequential ones
+# ---------------------------------------------------------------------------
+
+# same plan shape (COUNT + SUM + filter literal), different literals —
+# the coalescer's target workload; integer-exact so "bit-identical"
+# is meaningful even across summation orders
+BATCH_PQLS = [
+    "SELECT COUNT(*), SUM(hits) FROM baseballStats_OFFLINE "
+    "WHERE runs > '%d'" % lit for lit in (10, 40, 75, 110, 130)
+]
+
+
+def _request_bytes(pql, request_id=1, **kw):
+    return instance_request_to_bytes(InstanceRequest(
+        request_id=request_id, query=compile_pql(pql), **kw))
+
+
+def _payload_of(dt: DataTable):
+    # executionPath is provenance, not result content: a mesh twin
+    # reports "sharded" while batch members ran the per-segment
+    # kernels — the ROWS must still agree bitwise
+    meta = {k: v for k, v in dt.metadata.items()
+            if k not in ("requestId", RESULT_CACHE_HIT_KEY, "timeUsedMs",
+                         "profileInfo", "executionPath")}
+    return dt.kind, dt.columns, dt.rows, meta, dt.exceptions
+
+
+def _server(batch_window_ms, mesh=None, use_device=True,
+            num_segments=2, vdoc=False):
+    s = ServerInstance("batch0", mesh=mesh, use_device=use_device,
+                       batch_window_ms=batch_window_ms)
+    for i in range(num_segments):
+        seg, _ = build_segment(tempfile.mkdtemp(), n=700, seed=70 + i,
+                               name=f"bt_{i}")
+        if vdoc:
+            from pinot_tpu.realtime.upsert import ValidDocIds
+            seg.valid_doc_ids = ValidDocIds()
+            for doc in range(0, 700, 7):       # mask 100 rows
+                seg.valid_doc_ids.invalidate(doc)
+        s.data_manager.table("baseballStats_OFFLINE",
+                             create=True).add_segment(seg)
+    return s
+
+
+def _concurrent_replies(server, pqls, window_warm_s=0.0):
+    """Fire one request per pql from its own thread, roughly at once."""
+    barrier = threading.Barrier(len(pqls))
+
+    def fire(i_pql):
+        i, pql = i_pql
+        barrier.wait()
+        return DataTable.from_bytes(server.handle_request_bytes(
+            _request_bytes(pql, 100 + i)))
+
+    with ThreadPoolExecutor(max_workers=len(pqls)) as pool:
+        return list(pool.map(fire, enumerate(pqls)))
+
+
+@pytest.mark.parametrize("path", ["host", "device", "sharded"])
+def test_batched_equals_sequential_bitwise(path):
+    if path == "sharded":
+        from pinot_tpu.parallel.sharded import make_mesh
+        batched = _server(250.0, mesh=make_mesh())
+        twin = _server(0.0, mesh=make_mesh())
+    else:
+        batched = _server(250.0, use_device=(path == "device"))
+        twin = _server(0.0, use_device=(path == "device"))
+    try:
+        # sequential twin first: same segments (same seeds → same CRC),
+        # strictly per-query dispatch (window 0 → no coalescer at all)
+        assert twin.coalescer is None
+        expected = [_payload_of(DataTable.from_bytes(
+            twin.handle_request_bytes(_request_bytes(p, 10 + i))))
+            for i, p in enumerate(BATCH_PQLS)]
+        got = _concurrent_replies(batched, BATCH_PQLS)
+        for pql, dt, want in zip(BATCH_PQLS, got, expected):
+            assert not dt.exceptions, (pql, dt.exceptions)
+            assert _payload_of(dt) == want, pql
+        # the concurrent run really coalesced: at least one dispatch
+        # served >1 query (the first arrival may have gone solo)
+        assert batched.metrics.meter(
+            ServerMeter.BATCHED_DISPATCHES).count >= 1
+        occ = batched.metrics.timer(ServerTimer.BATCH_OCCUPANCY)
+        assert occ.count >= 1 and occ.percentile_ms(100) >= 2
+    finally:
+        batched.stop()
+        twin.stop()
+
+
+def test_batched_equals_sequential_with_vdoc_mask():
+    """The upsert validDocIds mask rides the shared cols side of the
+    batched dispatch — every member must see the same masked view."""
+    batched = _server(250.0, vdoc=True)
+    twin = _server(0.0, vdoc=True)
+    try:
+        expected = [_payload_of(DataTable.from_bytes(
+            twin.handle_request_bytes(_request_bytes(p, 10 + i))))
+            for i, p in enumerate(BATCH_PQLS)]
+        got = _concurrent_replies(batched, BATCH_PQLS)
+        for pql, dt, want in zip(BATCH_PQLS, got, expected):
+            assert not dt.exceptions, (pql, dt.exceptions)
+            assert _payload_of(dt) == want, pql
+        assert batched.metrics.meter(
+            ServerMeter.BATCHED_DISPATCHES).count >= 1
+    finally:
+        batched.stop()
+        twin.stop()
+
+
+def test_batch_members_report_batch_size_in_profile():
+    import json
+    s = _server(250.0)
+    try:
+        got = _concurrent_replies(s, BATCH_PQLS)
+        sizes = [json.loads(dt.metadata["profileInfo"])["batchSize"]
+                 for dt in got]
+        # at least one member rode a >1 batch; every member reports a
+        # positive size, and solo members report exactly 1
+        assert max(sizes) >= 2
+        assert all(b >= 1 for b in sizes)
+    finally:
+        s.stop()
+
+
+def test_window_zero_disables_coalescing():
+    s = _server(0.0)
+    try:
+        assert s.coalescer is None
+        got = _concurrent_replies(s, BATCH_PQLS)
+        for dt in got:
+            assert not dt.exceptions
+        assert s.metrics.meter(ServerMeter.BATCHED_DISPATCHES).count == 0
+        assert s.metrics.timer(ServerTimer.BATCH_OCCUPANCY).count == 0
+    finally:
+        s.stop()
+
+
+def test_sequential_queries_never_wait_for_a_window():
+    """An idle server (nothing same-shape in flight) executes every
+    query immediately — the window costs an unbatched workload
+    nothing, even with a deliberately huge window configured."""
+    s = _server(batch_window_ms=10_000.0)
+    try:
+        t0 = time.perf_counter()
+        for i, pql in enumerate(BATCH_PQLS):
+            dt = DataTable.from_bytes(s.handle_request_bytes(
+                _request_bytes(pql, 10 + i)))
+            assert not dt.exceptions
+            time.sleep(0.01)    # let the leave() done-callback land
+        assert time.perf_counter() - t0 < 5.0   # no 10s sleeps anywhere
+        # nothing overlapped → nothing batched
+        assert s.metrics.meter(ServerMeter.BATCHED_DISPATCHES).count == 0
+    finally:
+        s.stop()
+
+
+def test_group_by_queries_stay_unbatched_but_correct():
+    """GROUP BY plans are excluded from the batched dispatch (their
+    scout phases are value-dependent) — concurrent same-shape group-bys
+    must still answer correctly through the coalescer plumbing."""
+    pqls = ["SELECT SUM(hits) FROM baseballStats_OFFLINE "
+            "WHERE runs > '%d' GROUP BY teamID TOP 30" % lit
+            for lit in (10, 40, 75, 110)]
+    batched = _server(250.0)
+    twin = _server(0.0)
+    try:
+        expected = [_payload_of(DataTable.from_bytes(
+            twin.handle_request_bytes(_request_bytes(p, 10 + i))))
+            for i, p in enumerate(pqls)]
+        got = _concurrent_replies(batched, pqls)
+        for pql, dt, want in zip(pqls, got, expected):
+            assert not dt.exceptions, (pql, dt.exceptions)
+            assert _payload_of(dt) == want, pql
+    finally:
+        batched.stop()
+        twin.stop()
+
+
+# ---------------------------------------------------------------------------
+# Single-flight dedup (satellite): identical concurrent queries
+# ---------------------------------------------------------------------------
+
+
+def test_single_flight_dedups_identical_cold_queries():
+    s = _server(0.0)    # no coalescer: isolates the single-flight path
+    try:
+        pql = BATCH_PQLS[0]
+        n = 6
+        barrier = threading.Barrier(n)
+
+        def fire(i):
+            barrier.wait()
+            return DataTable.from_bytes(s.handle_request_bytes(
+                _request_bytes(pql, 200 + i)))
+
+        with ThreadPoolExecutor(max_workers=n) as pool:
+            got = list(pool.map(fire, range(n)))
+        rows = {tuple(map(tuple, dt.rows)) for dt in got}
+        assert len(rows) == 1       # every reply has the same result rows
+        # followers waited on the leader and were served its entry
+        waits = s.metrics.meter(ServerMeter.SINGLE_FLIGHT_WAITS).count
+        hits = s.metrics.meter(ServerMeter.RESULT_CACHE_HITS).count
+        assert waits >= 1 and hits >= 1
+        # every reply carries its OWN requestId (fresh DataTable per
+        # follower, no shared mutable reply)
+        assert {dt.metadata["requestId"] for dt in got} == \
+            {str(200 + i) for i in range(n)}
+    finally:
+        s.stop()
+
+
+def test_single_flight_follower_falls_through_on_leader_failure():
+    from pinot_tpu.server.result_cache import SingleFlight
+    sf = SingleFlight()
+    is_leader, ev = sf.begin(("k",))
+    assert is_leader
+    is_leader2, ev2 = sf.begin(("k",))
+    assert not is_leader2 and ev2 is ev
+    # leader "fails" (stores nothing) — done() still releases waiters
+    sf.done(("k",))
+    assert ev.wait(0.1)
+    # the key is retired: a new arrival leads again
+    assert sf.begin(("k",))[0]
+    sf.done(("k",))
+    # done() on an unknown key is harmless
+    sf.done(("nope",))
+
+
+# ---------------------------------------------------------------------------
+# Hedge-join admission carve-out (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_hedged_duplicate_joins_open_batch_instead_of_shedding():
+    """At the low watermark hedges are shed — UNLESS this server holds
+    an open batch window for the hedge's plan shape, in which case it
+    rides the primary's dispatch. Exercises the real `_admit` gate
+    with a hand-opened window (the scheduler never runs here)."""
+    s = _server(batch_window_ms=30_000.0, num_segments=1)
+    depth = s.admission.low           # sit exactly at the low watermark
+    try:
+        for i in range(depth):
+            assert s.admission.admit("baseballStats_OFFLINE", f"t{i}")
+        pql = BATCH_PQLS[0]
+        hedge = InstanceRequest(request_id=1, query=compile_pql(pql),
+                                hedge=True)
+        # no open window: the hedge is shed at the low watermark
+        decision, busy, _ = s._admit(hedge)
+        assert not decision and decision.cause == "hedge"
+        assert busy is not None
+        # open a window for that plan shape (a primary is in flight and
+        # a same-shape query led a window)
+        key = s._batch_key(hedge)
+        assert s.coalescer.arrive(key, "primary", None)[0] == "solo"
+        _, group = s.coalescer.arrive(key, "leader", None)
+        assert s.coalescer.joinable(key)
+        # the same hedge is now admitted: it will ride the open batch
+        decision2, busy2, tenant2 = s._admit(hedge)
+        assert decision2 and busy2 is None
+        s.admission.release(tenant2)
+        # ...while a hedge with a DIFFERENT plan shape is still shed
+        other = InstanceRequest(
+            request_id=2, hedge=True,
+            query=compile_pql(
+                "SELECT MAX(runs) FROM baseballStats_OFFLINE"))
+        decision3, busy3, _ = s._admit(other)
+        assert not decision3 and decision3.cause == "hedge"
+        s.coalescer.seal(group)
+    finally:
+        for i in range(depth):
+            s.admission.release(f"t{i}")
+        s.stop()

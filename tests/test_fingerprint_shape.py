@@ -1,0 +1,155 @@
+"""Plan-shape fingerprinting: the coalescer's batching key.
+
+The contract under test: `plan_shape_key` hoists every filter /
+aggregation / paging LITERAL out of the canonical fingerprint, so two
+queries share a key iff one is a literal-only rewrite of the other —
+the exact condition under which their compiled kernels can share a
+vmapped dispatch. Structural edits (column set, aggregation function,
+GROUP BY arity, filter tree shape) must change the key; literal edits
+(IN-list values, range bounds, LIMIT) must not.
+"""
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.fingerprint import plan_shape_key, query_fingerprint
+
+
+def key(pql: str) -> str:
+    return plan_shape_key(compile_pql(pql))[0]
+
+
+def lits(pql: str) -> tuple:
+    return plan_shape_key(compile_pql(pql))[1]
+
+
+# ---------------------------------------------------------------------------
+# Literal-only rewrites preserve the key
+# ---------------------------------------------------------------------------
+
+
+def test_equality_literal_is_hoisted():
+    a = "SELECT COUNT(*) FROM t WHERE x = 'a'"
+    b = "SELECT COUNT(*) FROM t WHERE x = 'b'"
+    assert key(a) == key(b)
+    assert query_fingerprint(compile_pql(a)) != \
+        query_fingerprint(compile_pql(b))   # ...but full fp still differs
+    assert lits(a) != lits(b)               # the values live in the vector
+
+
+def test_in_list_values_are_hoisted_arity_is_structural():
+    a = "SELECT COUNT(*) FROM t WHERE x IN ('a', 'b', 'c')"
+    b = "SELECT COUNT(*) FROM t WHERE x IN ('p', 'q', 'r')"
+    assert key(a) == key(b)
+    # ...and value ORDER is canonicalized away like the full fingerprint
+    c = "SELECT COUNT(*) FROM t WHERE x IN ('c', 'a', 'b')"
+    assert key(a) == key(c)
+    assert lits(a) == lits(c)
+    # arity shapes the compiled membership test: structural
+    d = "SELECT COUNT(*) FROM t WHERE x IN ('a', 'b')"
+    assert key(a) != key(d)
+
+
+def test_range_bounds_are_hoisted_inclusivity_is_structural():
+    a = "SELECT SUM(m) FROM t WHERE v > '10'"
+    b = "SELECT SUM(m) FROM t WHERE v > '9000'"
+    assert key(a) == key(b)
+    assert lits(a) != lits(b)
+    # >= vs > compiles a different comparison: structural
+    c = "SELECT SUM(m) FROM t WHERE v >= '10'"
+    assert key(a) != key(c)
+    # one-sided vs two-sided range: structural
+    d = "SELECT SUM(m) FROM t WHERE v BETWEEN '10' AND '20'"
+    assert key(a) != key(d)
+
+
+def test_limit_and_paging_are_hoisted():
+    assert key("SELECT a, b FROM t LIMIT 5") == \
+        key("SELECT a, b FROM t LIMIT 500")
+    assert key("SELECT a FROM t ORDER BY a LIMIT 10, 5") == \
+        key("SELECT a FROM t ORDER BY a LIMIT 90, 7")
+
+
+def test_group_by_topn_is_hoisted():
+    assert key("SELECT SUM(m) FROM t GROUP BY g TOP 5") == \
+        key("SELECT SUM(m) FROM t GROUP BY g TOP 50")
+
+
+def test_shape_metadata_options_are_dropped():
+    a = "SELECT COUNT(*) FROM t WHERE x = 'a'"
+    b = a + " OPTION(trace=true, timeoutMs=50)"
+    assert key(a) == key(b)
+
+
+def test_commutative_children_reorder_preserves_key():
+    a = "SELECT COUNT(*) FROM t WHERE x = '1' AND y = '2'"
+    b = "SELECT COUNT(*) FROM t WHERE y = '2' AND x = '1'"
+    assert key(a) == key(b)
+    # same-shape siblings with swapped literals: key stable, and the
+    # literal vector is deterministic for each spelling
+    c = "SELECT COUNT(*) FROM t WHERE x = '9' AND y = '2'"
+    assert key(a) == key(c)
+    assert lits(a) != lits(c)
+
+
+# ---------------------------------------------------------------------------
+# Structural edits change the key
+# ---------------------------------------------------------------------------
+
+
+def test_column_set_is_structural():
+    assert key("SELECT COUNT(*) FROM t WHERE x = 'a'") != \
+        key("SELECT COUNT(*) FROM t WHERE y = 'a'")
+    assert key("SELECT a, b FROM t LIMIT 5") != \
+        key("SELECT a, c FROM t LIMIT 5")
+
+
+def test_aggregation_function_is_structural():
+    assert key("SELECT SUM(m) FROM t") != key("SELECT MAX(m) FROM t")
+    assert key("SELECT SUM(m) FROM t") != key("SELECT SUM(n) FROM t")
+    assert key("SELECT SUM(m) FROM t") != \
+        key("SELECT SUM(m), COUNT(*) FROM t")
+
+
+def test_group_by_arity_is_structural():
+    assert key("SELECT SUM(m) FROM t GROUP BY g") != \
+        key("SELECT SUM(m) FROM t GROUP BY g, h")
+    assert key("SELECT SUM(m) FROM t GROUP BY g") != \
+        key("SELECT SUM(m) FROM t")
+
+
+def test_filter_tree_shape_is_structural():
+    assert key("SELECT COUNT(*) FROM t WHERE x = '1' AND y = '2'") != \
+        key("SELECT COUNT(*) FROM t WHERE x = '1' OR y = '2'")
+    assert key("SELECT COUNT(*) FROM t WHERE x = '1'") != \
+        key("SELECT COUNT(*) FROM t WHERE x = '1' AND y = '2'")
+    assert key("SELECT COUNT(*) FROM t WHERE x = '1'") != \
+        key("SELECT COUNT(*) FROM t WHERE x <> '1'")
+    assert key("SELECT COUNT(*) FROM t WHERE x IN ('a','b')") != \
+        key("SELECT COUNT(*) FROM t WHERE x NOT IN ('a','b')")
+
+
+def test_table_is_structural():
+    assert key("SELECT COUNT(*) FROM t") != key("SELECT COUNT(*) FROM u")
+
+
+def test_order_by_is_structural():
+    assert key("SELECT a FROM t ORDER BY a LIMIT 5") != \
+        key("SELECT a FROM t ORDER BY a DESC LIMIT 5")
+
+
+# ---------------------------------------------------------------------------
+# Literal vector sanity
+# ---------------------------------------------------------------------------
+
+
+def test_literal_vector_distinguishes_same_key_queries():
+    """key + literal vector together must still pin the query down:
+    two same-shape queries differ iff their vectors differ."""
+    a = "SELECT SUM(m) FROM t WHERE v > '10' AND x IN ('a','b') LIMIT 5"
+    b = "SELECT SUM(m) FROM t WHERE v > '77' AND x IN ('c','d') LIMIT 9"
+    assert key(a) == key(b)
+    assert lits(a) != lits(b)
+    # identical queries: identical vectors (determinism)
+    assert lits(a) == lits(a)
+    # the full fingerprint still separates them (cache correctness
+    # never rides on the shape key)
+    assert query_fingerprint(compile_pql(a)) != \
+        query_fingerprint(compile_pql(b))
