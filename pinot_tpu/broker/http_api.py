@@ -70,6 +70,14 @@ class BrokerApiServer(ApiServer):
         # state and the broker result cache
         self.router.add("GET", "/debug/quotas", self._quotas)
         self.router.add("GET", "/debug/resultCache", self._result_cache)
+        # one-scrape leak-gate rollup for the soak harness / operators
+        self.router.add("GET", "/debug/health", self._debug_health)
+        # chaos plane: arm/clear/inspect transport fault windows when
+        # the broker was started with a FaultInjectingTransport
+        # (PINOT_TPU_BROKER_FAULTS=1)
+        self.router.add("POST", "/debug/faults", self._inject_fault)
+        self.router.add("DELETE", "/debug/faults", self._clear_faults)
+        self.router.add("GET", "/debug/faults", self._fault_counts)
 
     def stop(self) -> None:
         if self.inline and self._loop is not None:
@@ -179,6 +187,77 @@ class BrokerApiServer(ApiServer):
         # aggregate counters only (entries/bytes/hits/misses) — no
         # table names or tenant keys, so no per-table ACL dimension
         return HttpResponse.of_json(self.handler.result_cache.stats())
+
+    async def _debug_health(self, request: HttpRequest) -> HttpResponse:
+        """One-scrape leak-gate rollup (obs/health.py): RSS, residency
+        ledger, exchange held-bytes, plus the broker's result-cache
+        counters — what the soak's flatness detectors poll."""
+        from pinot_tpu.obs.health import health_rollup
+        extra = {}
+        try:
+            extra = {f"resultCache.{k}": v
+                     for k, v in self.handler.result_cache.stats().items()
+                     if isinstance(v, (int, float))}
+        except Exception:  # noqa: BLE001 — cache stats are best-effort
+            pass
+        return HttpResponse.of_json(
+            health_rollup("broker", self.handler.metrics, extra=extra))
+
+    # -- chaos plane: transport fault windows ------------------------------
+    def _fault_transport(self):
+        t = getattr(self.handler.router, "transport", None)
+        return t if hasattr(t, "inject") and hasattr(t, "clear") else None
+
+    async def _inject_fault(self, request: HttpRequest) -> HttpResponse:
+        """Arm a transport fault window against one server — the HTTP
+        face of FaultInjectingTransport.inject, so the chaos
+        coordinator can open latency/drop windows inside a real broker
+        process. 409 unless the broker runs the fault-wrapped transport
+        (PINOT_TPU_BROKER_FAULTS=1)."""
+        t = self._fault_transport()
+        if t is None:
+            return HttpResponse.error(
+                409, "broker transport has no fault arm (start with "
+                "PINOT_TPU_BROKER_FAULTS=1)")
+        try:
+            body = request.json() or {}
+        except ValueError:
+            return HttpResponse.error(400, "invalid JSON body")
+        server, kind = body.get("server"), body.get("kind")
+        if not server or not kind:
+            return HttpResponse.error(400, '"server" and "kind" required')
+        from pinot_tpu.common.faults import FaultSpec
+        try:
+            spec = FaultSpec(
+                kind=kind,
+                latency_s=float(body.get("latencyS", 0.0)),
+                segments=tuple(body.get("segments", [])),
+                probability=float(body.get("probability", 1.0)),
+                times=body.get("times"))
+        except (ValueError, TypeError) as e:
+            return HttpResponse.error(400, str(e))
+        t.inject(server, spec)
+        return HttpResponse.of_json(
+            {"status": "armed", "server": server, "kind": kind})
+
+    async def _clear_faults(self, request: HttpRequest) -> HttpResponse:
+        t = self._fault_transport()
+        if t is None:
+            return HttpResponse.error(
+                409, "broker transport has no fault arm")
+        server = request.query.get("server")
+        t.clear(server or None)
+        return HttpResponse.of_json(
+            {"status": "cleared", "server": server or "*"})
+
+    async def _fault_counts(self, request: HttpRequest) -> HttpResponse:
+        t = self._fault_transport()
+        if t is None:
+            return HttpResponse.of_json({"enabled": False})
+        return HttpResponse.of_json(
+            {"enabled": True,
+             "injected": {f"{s}:{k}": n
+                          for (s, k), n in sorted(t.injected.items())}})
 
     async def _slow_log(self, request: HttpRequest) -> HttpResponse:
         sl = self.handler.slow_log

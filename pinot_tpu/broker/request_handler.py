@@ -28,7 +28,8 @@ from pinot_tpu.common.metrics import (BrokerGauge, BrokerMeter,
                                       BrokerQueryPhase, MetricsRegistry)
 from pinot_tpu.transport import shm as _shm_mod
 from pinot_tpu.common.request import BrokerRequest, InstanceRequest
-from pinot_tpu.common.response import BrokerResponse
+from pinot_tpu.common.response import (BrokerResponse, classify_exception,
+                                       exception_entry)
 from pinot_tpu.common.serde import instance_request_to_bytes
 from pinot_tpu.obs.slowlog import SlowQueryLog
 from pinot_tpu.obs.profiler import TableStatsAggregator
@@ -847,6 +848,7 @@ class BrokerRequestHandler:
                 STAGE_COMPILE_ERROR_CODE
             resp.exceptions.append({
                 "errorCode": STAGE_COMPILE_ERROR_CODE,
+                "cause": "stageCompile",
                 "message": str(dt.exceptions[0] if dt.exceptions
                                else dt.metadata[STAGE_ERROR_KEY])})
         # surface per-server failures a replica did NOT recover (the
@@ -857,12 +859,19 @@ class BrokerRequestHandler:
             # classifier — never the message text, whose wording is
             # free to change without turning sheds into 425 faults
             busy = e.get("busyCause") is not None
+            # the machine cause ladder: a shed carries its admission
+            # busyCause; otherwise classify the underlying message
+            # prefix; otherwise it is a generic server fault
+            inner = classify_exception(e.get("message") or "")
             resp.exceptions.append({
                 # 503: typed server-busy (admission shed) — distinct
                 # from 425 server errors so clients can back off
                 # instead of treating overload as a fault; stage
                 # orchestration errors carry their own code
                 "errorCode": e.get("errorCode") or (503 if busy else 425),
+                "cause": (e["busyCause"] if busy else
+                          inner[1] if inner is not None else
+                          "serverFault"),
                 "message": f"ServerQueryError: server={e['server']}: "
                            f"{e['message']}"})
         if not tables and unrecovered and \
@@ -1109,5 +1118,7 @@ def _retable(request: BrokerRequest, table: str) -> BrokerRequest:
 
 def _error_response(code: int, message: str) -> BrokerResponse:
     resp = BrokerResponse()
-    resp.exceptions.append({"errorCode": code, "message": message})
+    # exception_entry stamps the machine `cause` from the message
+    # prefix; the explicit code always wins (e.g. stage compile 422)
+    resp.exceptions.append(exception_entry(message, error_code=code))
     return resp

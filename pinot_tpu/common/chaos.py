@@ -1,0 +1,302 @@
+"""Deterministic chaos plane: a seeded coordinator that executes a
+declarative fault schedule against a live cluster.
+
+Chaos-engineering support for the production soak (ROADMAP item 5):
+`common/faults.py` injects faults into ONE transport deterministically;
+this module sequences WHOLE-CLUSTER faults — kill -9 a serving server,
+SIGTERM-drain another, kill the lead controller and verify standby
+takeover, kill the minion mid-swap, arm/disarm transport latency and
+drop windows — from a declarative schedule on an injectable clock.
+
+Design rules (the same ones the rest of the repo's fault machinery
+follows):
+
+- **Deterministic**: one seeded RNG picks targets for events that do
+  not name one; the clock and the sleep are injectable; the recorded
+  timeline of two runs with the same seed, schedule, fake clock and
+  adapter is byte-identical (``timeline_json``).
+- **Declarative**: a schedule is a list of :class:`ChaosEvent` (or
+  plain dicts) — *what* fires *when*, with an optional fault window
+  duration and a per-fault recovery deadline. No imperative glue.
+- **Cluster-agnostic**: the coordinator drives a duck-typed *adapter*.
+  Every event ``kind`` is an adapter method ``kind(target, **params)``;
+  windowed events additionally need ``clear_fault(target)``; seeded
+  target selection needs ``targets(kind) -> iterable`` and recovery
+  tracking needs ``recovery_probe(event, target) -> callable | None``.
+  `tools/cluster.py`'s multi-process driver implements the verbs
+  against real processes; tests use fakes.
+- **Accountable**: every action (fired / disarmed / recovered /
+  recovery_deadline_violated / error) lands on an event timeline with
+  offsets from schedule start; ``report()`` is the JSON block the SOAK
+  artifact commits.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Union
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault.
+
+    ``at_s`` is the offset from schedule start. ``kind`` names the
+    adapter verb (``kill_server``, ``drain_server``, ``fail_controller``,
+    ``kill_minion``, ``net_latency``, ``net_drop``, ``start_server``...).
+    ``target=None`` means the coordinator picks one (seeded) from
+    ``adapter.targets(kind)`` at fire time. ``duration_s > 0`` makes
+    the event a *window*: ``adapter.clear_fault(target)`` runs at
+    ``at_s + duration_s``. ``recovery_deadline_s`` arms recovery
+    tracking: the adapter's probe must go true within the deadline or
+    the timeline records a violation."""
+    at_s: float
+    kind: str
+    target: Optional[str] = None
+    duration_s: float = 0.0
+    recovery_deadline_s: Optional[float] = None
+    params: dict = dataclasses.field(default_factory=dict)
+    note: str = ""
+
+    def to_json(self) -> dict:
+        d = {"atS": self.at_s, "kind": self.kind}
+        if self.target is not None:
+            d["target"] = self.target
+        if self.duration_s:
+            d["durationS"] = self.duration_s
+        if self.recovery_deadline_s is not None:
+            d["recoveryDeadlineS"] = self.recovery_deadline_s
+        if self.params:
+            d["params"] = dict(sorted(self.params.items()))
+        if self.note:
+            d["note"] = self.note
+        return d
+
+
+def coerce_schedule(schedule: Iterable[Union[ChaosEvent, dict]]
+                    ) -> List[ChaosEvent]:
+    """Accept plain dicts (the declarative JSON form) next to
+    ChaosEvent instances."""
+    out: List[ChaosEvent] = []
+    for ev in schedule:
+        if isinstance(ev, ChaosEvent):
+            out.append(ev)
+            continue
+        out.append(ChaosEvent(
+            at_s=float(ev.get("atS", ev.get("at_s", 0.0))),
+            kind=ev["kind"],
+            target=ev.get("target"),
+            duration_s=float(ev.get("durationS",
+                                    ev.get("duration_s", 0.0))),
+            recovery_deadline_s=ev.get("recoveryDeadlineS",
+                                       ev.get("recovery_deadline_s")),
+            params=dict(ev.get("params", {})),
+            note=ev.get("note", "")))
+    return out
+
+
+class ChaosCoordinator:
+    """Executes a :class:`ChaosEvent` schedule against an adapter.
+
+    ``run()`` blocks until every event fired, every window disarmed and
+    every recovery resolved (or violated); the soak harness runs it on
+    its own thread against the real clock, the unit tests drive
+    ``step()`` directly on a fake clock. The coordinator never raises
+    out of an adapter verb — a failed verb is itself a timeline entry
+    (chaos tooling dying mid-soak would mask the very bugs it exists
+    to surface)."""
+
+    def __init__(self, adapter, schedule, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll_interval_s: float = 0.5):
+        self.adapter = adapter
+        self.schedule = coerce_schedule(schedule)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._sleep = sleep
+        self.poll_interval_s = poll_interval_s
+        self.timeline: List[dict] = []
+        self._seq = 0
+        self._t0: Optional[float] = None
+        # pending actions, ordered by (time, arrival): fire events plus
+        # the disarms their windows schedule
+        self._actions: List[dict] = []
+        for i, ev in enumerate(sorted(self.schedule,
+                                      key=lambda e: e.at_s)):
+            self._actions.append({"at": ev.at_s, "order": i,
+                                  "type": "fire", "event": ev})
+        # recoveries being tracked: {event, target, probe, firedAt,
+        # deadline}
+        self._pending: List[dict] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def begin(self) -> None:
+        if self._t0 is None:
+            self._t0 = self._clock()
+
+    def done(self) -> bool:
+        return self._t0 is not None and not self._actions \
+            and not self._pending
+
+    def elapsed_s(self) -> float:
+        return 0.0 if self._t0 is None else self._clock() - self._t0
+
+    def stop(self) -> None:
+        """Abort: drop not-yet-fired actions and unresolved recovery
+        tracking so ``run()`` returns at its next wakeup. The timeline
+        keeps everything that already happened."""
+        self.begin()
+        self._actions = []
+        self._pending = []
+
+    def run(self) -> dict:
+        """Blocking: execute the whole schedule, then return
+        ``report()``."""
+        self.begin()
+        while not self.done():
+            self.step()
+            if self.done():
+                break
+            delay = self.poll_interval_s
+            if self._actions and not self._pending:
+                delay = max(0.0, min(
+                    self._actions[0]["at"] - self.elapsed_s(),
+                    self.poll_interval_s))
+            self._sleep(max(delay, 1e-3))
+        return self.report()
+
+    def step(self) -> None:
+        """Fire every due action at the current clock, then poll
+        pending recoveries. Idempotent between clock advances."""
+        self.begin()
+        now = self.elapsed_s()
+        due = [a for a in self._actions if a["at"] <= now]
+        self._actions = [a for a in self._actions if a["at"] > now]
+        for action in sorted(due, key=lambda a: (a["at"], a["order"])):
+            if action["type"] == "fire":
+                self._fire(action["event"], now)
+            else:
+                self._disarm(action["event"], action["target"], now)
+        self._poll_recoveries(self.elapsed_s() if due else now)
+
+    # -- internals ---------------------------------------------------------
+    def _record(self, **entry) -> dict:
+        entry["seq"] = self._seq
+        self._seq += 1
+        self.timeline.append(entry)
+        return entry
+
+    def _fire(self, ev: ChaosEvent, now: float) -> None:
+        target = ev.target
+        if target is None:
+            pool = sorted(self.adapter.targets(ev.kind) or []) \
+                if hasattr(self.adapter, "targets") else []
+            if not pool:
+                self._record(tOffsetS=round(now, 3), action="skipped",
+                             kind=ev.kind, reason="no targets")
+                return
+            target = self._rng.choice(pool)
+        verb = getattr(self.adapter, ev.kind, None)
+        if verb is None:
+            self._record(tOffsetS=round(now, 3), action="error",
+                         kind=ev.kind, target=target,
+                         error=f"adapter has no verb {ev.kind!r}")
+            return
+        try:
+            result = verb(target, **ev.params)
+        except Exception as e:  # noqa: BLE001 — chaos must not die mid-soak
+            self._record(tOffsetS=round(now, 3), action="error",
+                         kind=ev.kind, target=target,
+                         error=f"{type(e).__name__}: {e}")
+            return
+        entry = {"tOffsetS": round(now, 3), "action": "fired",
+                 "kind": ev.kind, "target": target}
+        if ev.note:
+            entry["note"] = ev.note
+        if isinstance(result, (str, int, float, bool)):
+            entry["result"] = result
+        self._record(**entry)
+        if ev.duration_s > 0:
+            self._actions.append({"at": ev.at_s + ev.duration_s,
+                                  "order": self._seq, "type": "disarm",
+                                  "event": ev, "target": target})
+            self._actions.sort(key=lambda a: (a["at"], a["order"]))
+        if ev.recovery_deadline_s is not None:
+            probe = None
+            if hasattr(self.adapter, "recovery_probe"):
+                try:
+                    probe = self.adapter.recovery_probe(ev, target)
+                except Exception:  # noqa: BLE001 — probe setup optional
+                    probe = None
+            if probe is not None:
+                self._pending.append({
+                    "event": ev, "target": target, "probe": probe,
+                    "firedAt": now,
+                    "deadline": now + ev.recovery_deadline_s})
+
+    def _disarm(self, ev: ChaosEvent, target: str, now: float) -> None:
+        try:
+            self.adapter.clear_fault(target)
+            self._record(tOffsetS=round(now, 3), action="disarmed",
+                         kind=ev.kind, target=target)
+        except Exception as e:  # noqa: BLE001
+            self._record(tOffsetS=round(now, 3), action="error",
+                         kind=ev.kind, target=target,
+                         error=f"{type(e).__name__}: {e}")
+
+    def _poll_recoveries(self, now: float) -> None:
+        still: List[dict] = []
+        for p in self._pending:
+            ok = False
+            try:
+                ok = bool(p["probe"]())
+            except Exception:  # noqa: BLE001 — probe racing the fault
+                ok = False
+            if ok:
+                self._record(
+                    tOffsetS=round(now, 3), action="recovered",
+                    kind=p["event"].kind, target=p["target"],
+                    recoveryS=round(now - p["firedAt"], 3),
+                    deadlineS=p["event"].recovery_deadline_s)
+            elif now >= p["deadline"]:
+                self._record(
+                    tOffsetS=round(now, 3),
+                    action="recovery_deadline_violated",
+                    kind=p["event"].kind, target=p["target"],
+                    deadlineS=p["event"].recovery_deadline_s)
+            else:
+                still.append(p)
+        self._pending = still
+
+    # -- reporting ---------------------------------------------------------
+    def violations(self) -> List[dict]:
+        return [e for e in self.timeline
+                if e["action"] == "recovery_deadline_violated"]
+
+    def recoveries(self) -> Dict[str, float]:
+        """kind → recovery seconds (last recovery per kind)."""
+        out: Dict[str, float] = {}
+        for e in self.timeline:
+            if e["action"] == "recovered":
+                out[e["kind"]] = e["recoveryS"]
+        return out
+
+    def report(self) -> dict:
+        return {
+            "seed": self.seed,
+            "schedule": [ev.to_json() for ev in self.schedule],
+            "timeline": list(self.timeline),
+            "recoveries": self.recoveries(),
+            "violations": self.violations(),
+            "completed": self.done(),
+        }
+
+    def timeline_json(self) -> str:
+        """Canonical serialization — the determinism contract: same
+        seed + schedule + adapter + clock ⇒ byte-identical output."""
+        return json.dumps(self.report(), sort_keys=True,
+                          separators=(",", ":"))

@@ -160,6 +160,17 @@ class FaultInjectingTransport:
         self.injected: Dict[tuple, int] = {}
         self._lock = threading.Lock()
 
+    @property
+    def endpoints(self):
+        """Endpoint transparency: the multi-stage planner addresses
+        exchange peers via ``transport.endpoints`` — a fault wrapper
+        must not hide the inner TCP transport's map (faults perturb
+        dispatch, never addressing)."""
+        return getattr(self.inner, "endpoints", {})
+
+    def set_endpoint(self, server: str, host: str, port: int) -> None:
+        self.inner.set_endpoint(server, host, port)
+
     # -- arming ------------------------------------------------------------
     def inject(self, server: str, spec: FaultSpec) -> FaultSpec:
         with self._lock:

@@ -11,7 +11,56 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+#: Exception-message prefix → (errorCode, machine cause). Every degraded
+#: path in the system raises/appends strings with one of these prefixes;
+#: `classify_exception` turns them into structured entries so that
+#: "flagged vs unflagged" is a field check, never a message grep. An
+#: exception whose prefix is NOT here gets no errorCode — the SLO
+#: classifier (obs/slo.py) counts it as UNFLAGGED, which is exactly the
+#: signal that a new degraded path forgot to register itself.
+EXCEPTION_CLASSES: Dict[str, Tuple[int, str]] = {
+    "PQLParsingError:": (150, "parse"),
+    "AccessDeniedError:": (180, "accessDenied"),
+    "TableDoesNotExistError:": (190, "unknownTable"),
+    "RoutingError:": (190, "routing"),
+    "QueryExecutionError:": (200, "execution"),
+    "RequestDeserializationError:": (200, "deserialization"),
+    "DeadlineExceededError:": (250, "deadline"),
+    "QueryTimeoutError:": (250, "timeout"),
+    "StageCompileError:": (422, "stageCompile"),
+    "JoinCapacityError:": (422, "joinCapacity"),
+    "SegmentMissingError:": (425, "segmentMissing"),
+    "ServerQueryError:": (425, "serverFault"),
+    "ExchangeStageError:": (425, "exchange"),
+    "ExchangeMissError:": (425, "exchangeMiss"),
+    "ServerNotRespondedError:": (427, "noServerResponded"),
+    "QuotaExceededError:": (429, "quotaExceeded"),
+    "ServerBusyError:": (503, "serverBusy"),
+}
+
+
+def classify_exception(message: str) -> Optional[Tuple[int, str]]:
+    """(errorCode, cause) for a known exception-message prefix, else
+    None (→ the entry stays unflagged and the SLO gate trips)."""
+    prefix = message.split(" ", 1)[0] if message else ""
+    return EXCEPTION_CLASSES.get(prefix)
+
+
+def exception_entry(message: str, error_code: Optional[int] = None,
+                    cause: Optional[str] = None) -> dict:
+    """Build a structured exceptions[] entry: message plus errorCode +
+    cause, classified from the message prefix unless given explicitly."""
+    entry: dict = {"message": message}
+    cls = classify_exception(message)
+    if cls is not None:
+        entry["errorCode"], entry["cause"] = cls
+    if error_code is not None:
+        entry["errorCode"] = error_code
+    if cause is not None:
+        entry["cause"] = cause
+    return entry
 
 
 @dataclasses.dataclass

@@ -46,6 +46,7 @@ class ControllerApiServer(ApiServer):
         router.add("GET", "/", self._console)
         router.add("GET", "/ui", self._cluster_ui)
         router.add("GET", "/health", self._health)
+        router.add("GET", "/debug/health", self._debug_health)
         router.add("GET", "/metrics", self._metrics)
         router.add("GET", "/schemas", self._list_schemas)
         router.add("POST", "/schemas", self._add_schema)
@@ -123,6 +124,13 @@ class ControllerApiServer(ApiServer):
 
     async def _health(self, request: HttpRequest) -> HttpResponse:
         return HttpResponse(200, b"OK", content_type="text/plain")
+
+    async def _debug_health(self, request: HttpRequest) -> HttpResponse:
+        """Leak-gate rollup (obs/health.py) — RSS + residency + the
+        controller's replication-deficit gauge in one scrape."""
+        from pinot_tpu.obs.health import health_rollup
+        return HttpResponse.of_json(
+            health_rollup("controller", self.controller.metrics))
 
     async def _metrics(self, request: HttpRequest) -> HttpResponse:
         return metrics_response(self.controller.metrics, request)

@@ -1,0 +1,83 @@
+"""`/debug/health`: one-scrape leak-gate rollup per process.
+
+Every component (broker / server / controller / minion) exposes the
+same small JSON via `GET /debug/health` so the soak harness — and an
+operator — polls ONE endpoint per process for everything the leak
+gates watch: RSS, the residency ledger (total + per-kind, which is
+where exchange held-bytes live), and the summed leak-sensitive gauges
+(`upsertKeyMapSize`, `admissionQueueDepth`,
+`clusterReplicationDeficit`). `/metrics` stays the full-fidelity
+surface; this is the curated subset whose FLATNESS over a 30-minute
+run is the pass/fail signal (obs/slo.GaugeSeries).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+#: gauge base-names summed across their table-suffixed series into the
+#: rollup (a gauge registered as "tbl.upsertKeyMapSize" counts toward
+#: "upsertKeyMapSize")
+LEAK_GAUGES = (
+    "upsertKeyMapSize",
+    "admissionQueueDepth",
+    "clusterReplicationDeficit",
+    "deviceBytesResident",
+)
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") \
+    else 4096
+
+
+def rss_bytes() -> int:
+    """Resident set size of THIS process, from /proc (zero if the
+    platform has no procfs — the soak gates run on Linux)."""
+    try:
+        with open("/proc/self/statm", "r", encoding="ascii") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _sum_gauges(metrics, base: str) -> float:
+    """Sum a gauge across its global and table-suffixed series."""
+    _, gauges, _ = metrics.metric_maps()
+    total = 0.0
+    for key, g in gauges.items():
+        if key == base or key.endswith(f".{base}"):
+            try:
+                total += float(g.value)
+            except Exception:  # noqa: BLE001 — callable gauge racing shutdown
+                pass
+    return total
+
+
+def health_rollup(component: str, metrics=None,
+                  extra: Optional[Dict[str, object]] = None) -> dict:
+    """The /debug/health body. ``extra`` lets a component graft
+    process-specific gauges (e.g. the broker's result-cache size)."""
+    from pinot_tpu.obs.residency import LEDGER
+    snap = LEDGER.snapshot()
+    out: dict = {
+        "component": component,
+        "pid": os.getpid(),
+        "rssBytes": rss_bytes(),
+        "residency": {
+            "totalDeviceBytesResident":
+                snap.get("totalDeviceBytesResident", 0),
+            "byKind": snap.get("byKind", {}),
+            "entryCount": snap.get("entryCount", 0),
+        },
+        # exchange held-bytes ride the residency ledger under the
+        # "exchange" kind; surfacing them top-level keeps the soak's
+        # gauge-series wiring one key deep
+        "exchangeHeldBytes":
+            (snap.get("byKind") or {}).get("exchange", 0),
+        "gauges": {},
+    }
+    if metrics is not None:
+        for base in LEAK_GAUGES:
+            out["gauges"][base] = _sum_gauges(metrics, base)
+    if extra:
+        out["gauges"].update(extra)
+    return out

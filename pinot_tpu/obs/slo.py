@@ -1,0 +1,243 @@
+"""SLO gate layer for the production soak (ROADMAP item 5).
+
+Three machine-checkable pieces:
+
+- :func:`classify_response` — flagged-vs-unflagged over a
+  ``BrokerResponse`` (or its JSON): a degraded response is FLAGGED when
+  every exception entry carries a structured ``errorCode`` (and the
+  ``partialResponse`` bit covers exception-free truncation); it is
+  UNFLAGGED the moment any entry signals degradation only via message
+  text. "Zero unflagged errors" is then an assertion over counters, not
+  a grep — and an unflagged error is itself the bug report: some path
+  forgot `common/response.py`'s ``EXCEPTION_CLASSES``.
+- :class:`SLOTracker` — per-query-class latency ladders (p50/p95/p99
+  from full sample lists) plus ok/flagged/unflagged counts and a cause
+  histogram, with declared p99 bounds checked by :meth:`violations`.
+- :class:`GaugeSeries` — leak-flatness detector over a sampled gauge
+  (RSS, ``upsertKeyMapSize``, exchange held-bytes, residency ledger):
+  drops a settle window (caches fill, pools warm, churn reaches steady
+  state — a step there is startup, not a leak), then requires the
+  least-squares trend over the remainder to project ~zero growth across
+  the observed window. Linear growth fails; step-after-churn-settles
+  passes; a 30-minute window is long enough that a real leak cannot
+  hide inside the tolerance.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+def _resp_json(resp) -> dict:
+    if isinstance(resp, dict):
+        return resp
+    return resp.to_json()
+
+
+def classify_response(resp) -> Tuple[str, List[str]]:
+    """→ (cls, causes): cls in {"ok", "flagged", "unflagged"}.
+
+    ok: no exceptions, not partial. flagged: every exceptions[] entry
+    carries an integer errorCode (cause slugs collected; a partial
+    response with no exceptions is flagged as "partial" — the
+    partialResponse bit IS its structured marker). unflagged: any entry
+    without an errorCode — degradation only a human reading message
+    text could detect."""
+    d = _resp_json(resp)
+    exceptions = d.get("exceptions") or []
+    partial = bool(d.get("partialResponse"))
+    if not exceptions and not partial:
+        return "ok", []
+    causes: List[str] = []
+    unflagged = False
+    for e in exceptions:
+        if not isinstance(e.get("errorCode"), int):
+            unflagged = True
+            causes.append("unclassified")
+        else:
+            causes.append(e.get("cause") or f"code{e['errorCode']}")
+    if partial and not exceptions:
+        causes.append("partial")
+    return ("unflagged" if unflagged else "flagged"), causes
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[i]
+
+
+class SLOTracker:
+    """Per-query-class latency ladder + structured error tally.
+
+    ``p99_bounds_ms`` declares the gate: class → p99 upper bound.
+    Classes not in the bounds map are tracked but ungated."""
+
+    def __init__(self, p99_bounds_ms: Optional[Dict[str, float]] = None):
+        self.p99_bounds_ms = dict(p99_bounds_ms or {})
+        self._samples: Dict[str, List[float]] = {}
+        self._counts: Dict[str, Dict[str, int]] = {}
+        self._causes: Dict[str, Dict[str, int]] = {}
+        self.unflagged_examples: List[dict] = []
+
+    def record(self, qclass: str, latency_ms: float, resp=None) -> str:
+        """Record one query; returns its classification."""
+        self._samples.setdefault(qclass, []).append(float(latency_ms))
+        cls, causes = ("ok", []) if resp is None \
+            else classify_response(resp)
+        counts = self._counts.setdefault(
+            qclass, {"ok": 0, "flagged": 0, "unflagged": 0})
+        counts[cls] += 1
+        ch = self._causes.setdefault(qclass, {})
+        for c in causes:
+            ch[c] = ch.get(c, 0) + 1
+        if cls == "unflagged" and len(self.unflagged_examples) < 20:
+            d = _resp_json(resp)
+            self.unflagged_examples.append(
+                {"class": qclass,
+                 "exceptions": d.get("exceptions") or []})
+        return cls
+
+    def unflagged_total(self) -> int:
+        return sum(c["unflagged"] for c in self._counts.values())
+
+    def snapshot(self) -> dict:
+        out: Dict[str, dict] = {}
+        for qclass, samples in sorted(self._samples.items()):
+            s = sorted(samples)
+            counts = self._counts.get(
+                qclass, {"ok": 0, "flagged": 0, "unflagged": 0})
+            entry = {
+                "count": len(s),
+                "p50Ms": round(_percentile(s, 50), 3),
+                "p95Ms": round(_percentile(s, 95), 3),
+                "p99Ms": round(_percentile(s, 99), 3),
+                "maxMs": round(s[-1], 3) if s else 0.0,
+                **counts,
+            }
+            if self._causes.get(qclass):
+                entry["causes"] = dict(sorted(
+                    self._causes[qclass].items()))
+            bound = self.p99_bounds_ms.get(qclass)
+            if bound is not None:
+                entry["p99BoundMs"] = bound
+            out[qclass] = entry
+        return out
+
+    def violations(self) -> List[str]:
+        """Human-readable SLO violations: p99 over bound, or any
+        unflagged error anywhere."""
+        out: List[str] = []
+        snap = self.snapshot()
+        for qclass, entry in snap.items():
+            bound = entry.get("p99BoundMs")
+            if bound is not None and entry["p99Ms"] > bound:
+                out.append(f"{qclass}: p99 {entry['p99Ms']}ms > "
+                           f"bound {bound}ms")
+            if entry["unflagged"]:
+                out.append(f"{qclass}: {entry['unflagged']} UNFLAGGED "
+                           f"errors (degradation without structured "
+                           f"errorCode)")
+        return out
+
+
+@dataclasses.dataclass
+class GaugeVerdict:
+    name: str
+    flat: bool
+    reason: str
+    samples: int
+    window_s: float
+    mean: float
+    projected_growth: float     # fitted slope × analysed window
+    rel_growth: float           # projected growth / max(|mean|, 1)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "flat": self.flat,
+                "reason": self.reason, "samples": self.samples,
+                "windowS": round(self.window_s, 1),
+                "mean": round(self.mean, 2),
+                "projectedGrowth": round(self.projected_growth, 2),
+                "relGrowth": round(self.rel_growth, 4)}
+
+
+class GaugeSeries:
+    """Leak-flatness detector over one sampled gauge.
+
+    ``settle_frac`` of the time window is discarded before fitting (a
+    step while churn settles is startup, not a leak). Over the rest, a
+    least-squares line is fit; the series is FLAT when the projected
+    growth across the analysed window is within ``abs_tol`` or within
+    ``rel_tol`` of the series mean. Linear growth projects its full
+    rise and fails; a settled step projects ~zero and passes.
+
+    ``bound`` switches the detector to bounded mode for gauges that are
+    structurally capped but wobble under chaos (a replica kill wipes a
+    server's upsert key map; the healed replacement rebuilds it, which
+    reads as a positive slope without being a leak). In bounded mode
+    the series is FLAT iff every post-settle sample stays at or under
+    ``bound`` — a real leak grows with churn and crosses any sane cap,
+    while legitimate rebuild wobble cannot."""
+
+    def __init__(self, name: str, settle_frac: float = 0.25,
+                 rel_tol: float = 0.10, abs_tol: float = 0.0,
+                 bound: Optional[float] = None):
+        self.name = name
+        self.settle_frac = settle_frac
+        self.rel_tol = rel_tol
+        self.abs_tol = abs_tol
+        self.bound = bound
+        self._ts: List[float] = []
+        self._vs: List[float] = []
+
+    def add(self, t_s: float, value: float) -> None:
+        self._ts.append(float(t_s))
+        self._vs.append(float(value))
+
+    def series(self) -> List[Tuple[float, float]]:
+        return list(zip(self._ts, self._vs))
+
+    def verdict(self) -> GaugeVerdict:
+        n = len(self._ts)
+        if n < 4:
+            return GaugeVerdict(self.name, True, "insufficient samples",
+                                n, 0.0, 0.0, 0.0, 0.0)
+        t0, t1 = self._ts[0], self._ts[-1]
+        window = t1 - t0
+        cut = t0 + window * self.settle_frac
+        ts = [t for t in self._ts if t >= cut]
+        vs = [v for t, v in zip(self._ts, self._vs) if t >= cut]
+        if len(ts) < 3 or ts[-1] <= ts[0]:
+            return GaugeVerdict(self.name, True, "insufficient samples "
+                                "after settle window", n, window,
+                                0.0, 0.0, 0.0)
+        if self.bound is not None:
+            mean_v = sum(vs) / len(vs)
+            mx = max(vs)
+            flat = mx <= self.bound
+            reason = (f"bounded: max {mx:.1f} <= cap {self.bound:.1f}"
+                      if flat else
+                      f"max {mx:.1f} exceeds cap {self.bound:.1f}")
+            return GaugeVerdict(self.name, flat, reason, n, window,
+                                mean_v, mx - self.bound, 0.0)
+        # least-squares slope, no numpy needed (soak imports stay light)
+        m = len(ts)
+        mean_t = sum(ts) / m
+        mean_v = sum(vs) / m
+        den = sum((t - mean_t) ** 2 for t in ts)
+        slope = 0.0 if den == 0 else \
+            sum((t - mean_t) * (v - mean_v)
+                for t, v in zip(ts, vs)) / den
+        analysed = ts[-1] - ts[0]
+        projected = slope * analysed
+        scale = max(abs(mean_v), 1.0)
+        rel = abs(projected) / scale
+        flat = abs(projected) <= self.abs_tol or rel <= self.rel_tol
+        reason = "flat" if flat else (
+            f"projects {projected:+.1f} over {analysed:.0f}s "
+            f"({rel:.1%} of mean {mean_v:.1f})")
+        return GaugeVerdict(self.name, flat, reason, n, window,
+                            mean_v, projected, rel)

@@ -14,7 +14,7 @@ import numpy as np
 from pinot_tpu.common.request import (BrokerRequest, FilterOperator,
                                       HavingNode)
 from pinot_tpu.common.response import (AggregationResult, BrokerResponse,
-                                       SelectionResults)
+                                       SelectionResults, exception_entry)
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import IntermediateResultsBlock
 from pinot_tpu.query.combine import (combine_blocks, group_map_of,
@@ -44,7 +44,10 @@ class BrokerReduceService:
             stats.min_consuming_freshness_ms
         resp.num_servers_queried = num_servers_queried
         resp.num_servers_responded = num_servers_responded
-        resp.exceptions = [{"message": e} for e in merged.exceptions]
+        # structured degradation: every per-segment/server exception
+        # string carries errorCode + machine cause so clients and the
+        # soak's SLO gate never have to string-match message text
+        resp.exceptions = [exception_entry(e) for e in merged.exceptions]
 
         if request.is_group_by:
             self._reduce_group_by(request, merged, resp)

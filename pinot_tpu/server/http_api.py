@@ -41,6 +41,7 @@ class ServerApiServer(ApiServer):
         self.router.add("GET", "/tables/{table}/size", self._size)
         self.router.add("GET", "/debug/memory", self._memory)
         self.router.add("GET", "/debug/residency", self._residency)
+        self.router.add("GET", "/debug/health", self._debug_health)
 
     async def _metrics(self, request: HttpRequest) -> HttpResponse:
         return metrics_response(self.server.metrics, request)
@@ -124,6 +125,15 @@ class ServerApiServer(ApiServer):
                         for t in out.values() for s in t.values())
         return HttpResponse.of_json({"totalHbmResidentBytes": total_hbm,
                                      "tables": out})
+
+    async def _debug_health(self, request: HttpRequest) -> HttpResponse:
+        """One-scrape leak-gate rollup (obs/health.py): RSS, residency
+        ledger, exchange held-bytes, and the leak-sensitive gauges —
+        the curated subset the soak's flatness detectors poll."""
+        from pinot_tpu.obs.health import health_rollup
+        return HttpResponse.of_json(health_rollup(
+            "server", self.server.metrics,
+            extra={"instanceId": self.server.instance_id}))
 
     async def _residency(self, request: HttpRequest) -> HttpResponse:
         """The process-global residency ledger: every accounted device

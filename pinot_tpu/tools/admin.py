@@ -468,6 +468,29 @@ def cmd_start_broker(args) -> int:
     return _run_until_interrupt(broker.stop)
 
 
+def cmd_start_minion(args) -> int:
+    """Minion process: task executor polling the cluster task queue.
+
+    Parity: StartMinionCommand. SIGTERM finishes the in-flight task
+    then exits; kill -9 mid-swap exercises the intent-log recovery
+    path (the task queue requeues the lease, the swap protocol resumes
+    or rolls back from the logged intent)."""
+    import signal
+
+    from pinot_tpu.tools.distributed import DistributedMinion
+    host, port = args.store.rsplit(":", 1)
+    minion = DistributedMinion(args.instance_id, host, int(port),
+                               args.deep_store, work_dir=args.dir)
+    print(json.dumps({"instanceId": args.instance_id}), flush=True)
+
+    def on_sigterm(_sig, _frame):
+        minion.stop()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    return _run_until_interrupt(minion.stop)
+
+
 def cmd_quickstart(args) -> int:
     """Boot an embedded cluster with demo data and run sample queries.
 
@@ -884,6 +907,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--store", default="127.0.0.1:2181")
     sp.add_argument("--deep-store", required=True)
     sp.set_defaults(fn=cmd_start_broker)
+
+    sp = sub.add_parser("StartMinion",
+                        help="run a minion task executor joined via "
+                             "the store")
+    sp.add_argument("--store", default="127.0.0.1:2181")
+    sp.add_argument("--deep-store", required=True)
+    sp.add_argument("--instance-id", default="Minion_0")
+    sp.add_argument("--dir", help="task work dir")
+    sp.set_defaults(fn=cmd_start_minion)
 
     sp = sub.add_parser("Quickstart",
                         help="embedded demo cluster with sample data")

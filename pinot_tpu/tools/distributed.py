@@ -331,12 +331,28 @@ class DistributedBroker:
                  deep_store_dir: str, http: bool = False,
                  instance_id: Optional[str] = None,
                  broker_tenant: str = "DefaultTenant",
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 faults: Optional[bool] = None):
         self.store = RemotePropertyStore(store_host, store_port)
         coordinator = ClusterCoordinator(self.store)
         manager = ResourceManager(coordinator, deep_store_dir,
                                   maintain_broker_resource=False)
         self.transport = TcpTransport({})
+        # chaos plane (PINOT_TPU_BROKER_FAULTS=1, or faults=True): the
+        # data plane runs through a FaultInjectingTransport so the soak
+        # coordinator can arm latency/drop windows over the broker's
+        # /debug/faults endpoints. Endpoint updates still target the
+        # inner TcpTransport (self.transport); only dispatch is wrapped.
+        data_transport = self.transport
+        if faults is None:
+            faults = os.environ.get("PINOT_TPU_BROKER_FAULTS",
+                                    "0") != "0"
+        if faults:
+            from pinot_tpu.common.faults import FaultInjectingTransport
+            data_transport = FaultInjectingTransport(
+                self.transport,
+                seed=int(os.environ.get(
+                    "PINOT_TPU_BROKER_FAULTS_SEED", "0")))
         # live *_BROKER ids maintained from the watch stream so
         # _num_live_brokers is O(1): it runs inside _apply_quota_config
         # on EVERY external-view event, and a children+get-per-instance
@@ -360,7 +376,7 @@ class DistributedBroker:
             coordinator, manager, quota=self.quota,
             num_brokers_fn=self._num_live_brokers)
         self.handler = BrokerRequestHandler(
-            self.watcher.routing, self.transport,
+            self.watcher.routing, data_transport,
             time_boundary=self.watcher.time_boundary,
             quota=self.quota,
             segment_pruner=self.watcher.partition_pruner)
@@ -469,3 +485,36 @@ class DistributedBroker:
         if self.http_api is not None:
             self.http_api.stop()
         self.handler.close()
+
+
+class DistributedMinion:
+    """Minion process: a MinionWorker polling the cluster task queue
+    over a remote store (parity: the reference's MinionStarter — a
+    task-executor instance joining the cluster, pulling from the Helix
+    task framework). Compaction/merge/retention tasks download
+    artifacts through the shared deep store (or the controller's HTTP
+    deepstore endpoints) and push swaps through the same intent-logged
+    protocol the in-process minion tests model-check."""
+
+    def __init__(self, instance_id: str, store_host: str, store_port: int,
+                 deep_store_dir: str, work_dir: Optional[str] = None):
+        self.store = RemotePropertyStore(store_host, store_port)
+        coordinator = ClusterCoordinator(self.store)
+        self.manager = ResourceManager(coordinator, deep_store_dir,
+                                       maintain_broker_resource=False)
+        self.instance_id = instance_id
+        from pinot_tpu.minion.worker import MinionWorker
+        self.worker = MinionWorker(self.manager, instance_id,
+                                   work_dir=work_dir)
+        self.worker.start()
+
+    def stop(self) -> None:
+        """Graceful: finish the in-flight task, then leave."""
+        self.worker.stop()
+        self.store.close()
+
+    def kill(self) -> None:
+        """Crash simulation: the store session dies mid-task; the task
+        queue's lease/requeue machinery (and the swap protocol's intent
+        log) must recover the work."""
+        self.store.close()

@@ -105,6 +105,21 @@ echo "== batch smoke (cross-query dispatch coalescing) =="
 # shared kernel spec in seconds
 env JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
+echo "== production soak (short mode: one cluster, every subsystem) =="
+# 120s scaled-down soak of the FULL production shape: multi-process HA
+# cluster (standalone store + lead/standby controller + servers +
+# broker + minion) serving the weighted mix (SSB + joins + windows +
+# VECTOR_SIMILARITY + 2-tenant quotas) while realtime upserts churn,
+# with a deterministic chaos schedule firing one kill -9 of a serving
+# server and one lead-controller failover mid-run. Gates: ZERO
+# unflagged errors (every BrokerResponse exception carries a
+# machine-readable errorCode), per-class p99 in bounds, recoveries
+# inside deadlines, leak gauges flat. Full 30+ min run commits
+# SOAK_r15.json; this short gate reuses the identical harness.
+env PINOT_TPU_SOAK_SECONDS="${PINOT_TPU_SOAK_SECONDS:-120}" \
+    SOAK_ARTIFACT="${SOAK_ARTIFACT:-/tmp/soak_ci.json}" \
+    python scripts/prod_soak.py
+
 echo "== tpulint (deep + protocol tiers) =="
 # --deep adds the below-the-AST gates on top of the AST families:
 # every registered kernel is traced with jax.make_jaxpr across the

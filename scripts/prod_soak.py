@@ -1,0 +1,683 @@
+"""Production soak: one multi-process cluster, every subsystem, a
+deterministic chaos schedule, SLO-gated end to end.
+
+One 4 broker x 8 server HA cluster (standalone store, lead + standby
+controller, minion) serves a weighted production mix — SSB aggregations,
+broadcast + co-partitioned joins, window functions, VECTOR_SIMILARITY,
+and a 2-tenant quota split — at ~80% of the measured saturation knee
+(QPS_r14.json), while realtime upsert ingestion churns a bounded
+keyspace over the TCP stream and the minion runs UpsertCompaction on
+schedule. A seeded ChaosCoordinator (common/chaos.py) fires mid-run:
+transport latency/drop windows armed inside the broker processes,
+kill -9 of a serving server, a SIGTERM drain, lead-controller failover
+onto the standby lease, and a minion kill — each with a recovery
+deadline.
+
+Gates (all must hold, or exit 1):
+- ZERO unflagged errors: every exception on every BrokerResponse must
+  carry a machine-readable errorCode (obs/slo.py classify_response) —
+  "the error rate was zero OR every error was a flagged, classified
+  degradation" as an assertion, not a grep.
+- Per-class p99 within bounds (SLOTracker).
+- Every chaos recovery inside its deadline (replication healed +
+  clean query after kill -9; endpoint re-published + /health after
+  controller failover).
+- Leak gauges FLAT (obs/slo.py GaugeSeries): per-process RSS,
+  exchange held-bytes, residency ledger bytes, summed
+  upsertKeyMapSize — sampled from every /debug/health rollup.
+
+Writes SOAK_r15.json (timeline + per-class latency ladder + leak-gauge
+series + recovery times) at the repo root (override SOAK_ARTIFACT).
+
+Modes: PINOT_TPU_SOAK_SECONDS sets the duration (default 1800). Under
+600s the harness runs the scaled-down CI shape — 1 broker x 4 servers,
+low rates, one server-kill + one controller-failover — wired into
+scripts/check.sh as the short soak gate (120s).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# serving-plane configuration (inherited by every spawned process) —
+# the same rig QPS_r14.json measured, so the knee transfers
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ.setdefault("PINOT_TPU_BROKER_INLINE", "1")
+os.environ.setdefault("PINOT_TPU_BROKER_CACHE_OFFLINE", "1")
+os.environ.setdefault("PINOT_TPU_SHM_MIN_BYTES", str(256 * 1024))
+
+import numpy as np  # noqa: E402
+
+from pinot_tpu.common.chaos import ChaosCoordinator  # noqa: E402
+from pinot_tpu.obs.slo import (GaugeSeries, SLOTracker,  # noqa: E402
+                               classify_response)
+from pinot_tpu.tools.cluster import MultiprocCluster  # noqa: E402
+
+DURATION_S = float(os.environ.get("PINOT_TPU_SOAK_SECONDS", "1800"))
+SHORT = DURATION_S < 600
+SEED = int(os.environ.get("SOAK_SEED", "15"))
+ARTIFACT = os.environ.get(
+    "SOAK_ARTIFACT", os.path.join(REPO, "SOAK_r15.json"))
+
+NUM_BROKERS = 1 if SHORT else 4
+NUM_SERVERS = 4 if SHORT else 8
+ROWS = int(os.environ.get("SOAK_ROWS", "20000" if SHORT else "500000"))
+SEGMENTS = 4
+THREADS = int(os.environ.get("SOAK_THREADS", "4" if SHORT else "7"))
+INGEST_ROWS_PER_S = float(os.environ.get(
+    "SOAK_INGEST_RPS", "40" if SHORT else "150"))
+# bounded so the key map SETTLES inside the run (coupon-collector:
+# full coverage needs ~K·lnK rows; the short gate publishes ~4.8k)
+UPSERT_KEYSPACE = 300 if SHORT else 2000
+VEC_DIM = 16
+
+# p99 bounds per query class (ms): generous — the run includes fault
+# windows and kill -9 recovery; the load-bearing gates are zero
+# unflagged errors, recovery deadlines, and leak flatness
+P99_BOUNDS_MS = json.loads(os.environ.get("SOAK_P99_BOUNDS", json.dumps({
+    "ssb": 4000.0, "join": 8000.0, "window": 8000.0,
+    "vector": 8000.0, "upsert": 4000.0, "tenant": 4000.0,
+})))
+
+# the production mix: weight per query class
+MIX = [("ssb", 40), ("join", 15), ("window", 10), ("vector", 10),
+       ("upsert", 15), ("tenant", 10)]
+
+
+def _target_qps() -> float:
+    if "SOAK_QPS" in os.environ:
+        return float(os.environ["SOAK_QPS"])
+    if SHORT:
+        return 25.0
+    try:
+        d = json.load(open(os.path.join(REPO, "QPS_r14.json")))
+        knee = next(s["saturation_knee_qps"] for s in d["shapes"]
+                    if s["brokers"] == 4 and s["servers"] == 8)
+        return 0.8 * float(knee)
+    except Exception:  # noqa: BLE001 — artifact missing on a fresh rig
+        return 320.0
+
+
+def _http(method, url, body=None, ctype="application/json", timeout=30):
+    req = urllib.request.Request(
+        url, data=body, method=method,
+        headers={"Content-Type": ctype} if body else {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# -- workload ---------------------------------------------------------------
+
+SSB_TEMPLATES = [
+    "SELECT SUM(lo_revenue) FROM lineorder WHERE d_year = 1993 AND "
+    "lo_discount BETWEEN 1 AND 3 AND lo_quantity < {q}",
+    "SELECT SUM(lo_revenue) FROM lineorder WHERE p_category = 'MFGR#12' "
+    "AND s_region = 'AMERICA' GROUP BY d_year, p_brand1 TOP 100",
+    "SELECT COUNT(*), SUM(lo_revenue) FROM lineorder WHERE "
+    "c_region = 'ASIA' AND s_region = 'ASIA' GROUP BY d_year TOP 100",
+    "SELECT MAX(lo_revenue), MIN(lo_supplycost) FROM lineorder "
+    "WHERE lo_quantity BETWEEN {q} AND 40",
+]
+
+JOIN_TEMPLATES = [
+    # broadcast probe (dim filtered server-side, fact co-partitioned)
+    "SELECT SUM(lineorderj.lo_revenue), COUNT(*) FROM lineorderj "
+    "JOIN part ON lineorderj.lo_partkey = part.p_partkey "
+    "WHERE part.p_mfgr = 'MFGR#{m}'",
+    "SELECT SUM(lineorderj.lo_quantity) FROM lineorderj "
+    "JOIN part ON lineorderj.lo_partkey = part.p_partkey "
+    "WHERE lineorderj.d_year = {y}",
+]
+
+WINDOW_TEMPLATES = [
+    "SELECT d_year, lo_revenue, ROW_NUMBER() OVER (PARTITION BY d_year "
+    "ORDER BY lo_revenue DESC) FROM lineorderj WHERE d_year = {y} "
+    "LIMIT 20",
+    "SELECT d_year, SUM(lo_quantity) OVER (PARTITION BY d_year "
+    "ORDER BY lo_revenue) FROM lineorderj WHERE d_year = {y} LIMIT 20",
+]
+
+
+def build_query(qclass: str, rng: np.random.Generator) -> str:
+    if qclass == "ssb":
+        t = SSB_TEMPLATES[int(rng.integers(len(SSB_TEMPLATES)))]
+        return t.format(q=int(rng.integers(20, 30)))
+    if qclass == "join":
+        t = JOIN_TEMPLATES[int(rng.integers(len(JOIN_TEMPLATES)))]
+        return t.format(m=int(rng.integers(1, 6)),
+                        y=int(rng.integers(1992, 1999)))
+    if qclass == "window":
+        t = WINDOW_TEMPLATES[int(rng.integers(len(WINDOW_TEMPLATES)))]
+        return t.format(y=int(rng.integers(1992, 1999)))
+    if qclass == "vector":
+        qs = ", ".join(f"{x:.4f}" for x in rng.standard_normal(VEC_DIM))
+        return (f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], 7, "
+                f"'COSINE') FROM vectab WHERE shard < 2")
+    if qclass == "upsert":
+        return "SELECT COUNT(*), SUM(value) FROM events"
+    if qclass == "tenant":
+        tenant = "gold" if rng.random() < 0.7 else "bronze"
+        q = int(rng.integers(20, 30))
+        return (f"SELECT SUM(lo_revenue) FROM lineorder WHERE "
+                f"lo_quantity < {q} OPTION(workload={tenant})")
+    raise ValueError(qclass)
+
+
+class LoadDriver:
+    """Open-loop paced query mix against the broker fleet. Each worker
+    owns a slot cadence; a query still in flight when its next slot
+    arrives counts a missed slot instead of piling up (client-side
+    shedding — offered load stays bounded under fault windows)."""
+
+    def __init__(self, cluster, tracker: SLOTracker, qps: float,
+                 threads: int, seed: int):
+        self.cluster = cluster
+        self.tracker = tracker
+        self.qps = qps
+        self.threads = threads
+        self.seed = seed
+        self.stop_flag = threading.Event()
+        self.missed_slots = 0
+        self.transport_errors = 0
+        self.issued = 0
+        self._lock = threading.Lock()
+        self._workers = []
+
+    def _post(self, port: int, pql: str):
+        body = json.dumps({"pql": pql}).encode()
+        try:
+            return _http("POST", f"http://127.0.0.1:{port}/query", body,
+                         timeout=30)
+        except urllib.error.HTTPError as e:
+            # 429/503 carry the BrokerResponse JSON in the error body
+            try:
+                return json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return None
+        except Exception:  # noqa: BLE001 — connection-level failure
+            return None
+
+    def _worker(self, wid: int):
+        rng = np.random.default_rng(self.seed * 1000 + wid)
+        ports = self.cluster.broker_ports
+        interval = self.threads / self.qps
+        nxt = time.monotonic() + rng.random() * interval
+        weights = np.array([w for _, w in MIX], dtype=float)
+        weights /= weights.sum()
+        classes = [c for c, _ in MIX]
+        while not self.stop_flag.is_set():
+            now = time.monotonic()
+            if now < nxt:
+                time.sleep(min(nxt - now, 0.2))
+                continue
+            behind = int((now - nxt) / interval)
+            if behind > 0:           # shed the slots we already missed
+                with self._lock:
+                    self.missed_slots += behind
+                nxt += behind * interval
+            nxt += interval
+            qclass = classes[int(rng.choice(len(classes), p=weights))]
+            pql = build_query(qclass, rng)
+            port = ports[int(rng.integers(len(ports)))]
+            t0 = time.monotonic()
+            resp = self._post(port, pql)
+            dt_ms = (time.monotonic() - t0) * 1000.0
+            with self._lock:
+                self.issued += 1
+                if resp is None:
+                    self.transport_errors += 1
+                else:
+                    self.tracker.record(qclass, dt_ms, resp)
+
+    def start(self):
+        for i in range(self.threads):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True, name=f"load-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def stop(self):
+        self.stop_flag.set()
+        for t in self._workers:
+            t.join(timeout=35)
+
+
+class UpsertIngest:
+    """Realtime churn: rows over the TCP stream topic, keys cycling a
+    BOUNDED keyspace so upserts dominate — upsertKeyMapSize must go
+    FLAT once every key has been seen (the leak-gate signal), while
+    superseded rows accumulate deadness for the minion's
+    UpsertCompactionTask."""
+
+    def __init__(self, publisher, topic: str, rows_per_s: float,
+                 seed: int, partitions: int = 2):
+        self.pub = publisher
+        self.topic = topic
+        self.rows_per_s = rows_per_s
+        self.partitions = partitions
+        self.rng = np.random.default_rng(seed + 77)
+        self.stop_flag = threading.Event()
+        self.published = 0
+        self._thread = None
+
+    def _run(self):
+        interval = 1.0 / self.rows_per_s
+        nxt = time.monotonic()
+        while not self.stop_flag.is_set():
+            now = time.monotonic()
+            if now < nxt:
+                time.sleep(min(nxt - now, 0.2))
+                continue
+            nxt = max(nxt + interval, now - 1.0)
+            k = int(self.rng.integers(UPSERT_KEYSPACE))
+            row = {"key": f"k{k}", "value": int(self.rng.integers(1000)),
+                   "ts": 1_700_000_000_000 + self.published}
+            try:
+                self.pub.publish_row(self.topic, row,
+                                     partition=k % self.partitions)
+                self.published += 1
+            except Exception:  # noqa: BLE001 — topic server restart gap
+                time.sleep(0.5)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ingest")
+        self._thread.start()
+
+    def stop(self):
+        self.stop_flag.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+class LeakSampler:
+    """Polls every /debug/health rollup on a cadence into GaugeSeries.
+    Per-process RSS / exchange held-bytes / residency bytes, plus the
+    cluster-summed upsertKeyMapSize (per-server series would step to
+    zero on kill -9; the sum recovers as the replacement rebuilds its
+    key map from committed segments)."""
+
+    def __init__(self, cluster, period_s: float = 5.0):
+        self.cluster = cluster
+        self.period_s = period_s
+        self.series = {}
+        self.stop_flag = threading.Event()
+        self._thread = None
+        self._t0 = time.monotonic()
+
+    def _get(self, name: str, **kw) -> GaugeSeries:
+        if name not in self.series:
+            self.series[name] = GaugeSeries(name, **kw)
+        return self.series[name]
+
+    def sample(self):
+        t = time.monotonic() - self._t0
+        rollups = self.cluster.health_rollups()
+        key_map_total = 0.0
+        for proc, h in rollups.items():
+            self._get(f"{proc}.rssBytes", rel_tol=0.15,
+                      abs_tol=96e6).add(t, float(h.get("rssBytes", 0)))
+            self._get(f"{proc}.exchangeHeldBytes", abs_tol=4e6).add(
+                t, float(h.get("exchangeHeldBytes", 0)))
+            res = h.get("residency") or {}
+            self._get(f"{proc}.residencyBytes", rel_tol=0.15,
+                      abs_tol=64e6).add(
+                t, float(res.get("totalDeviceBytesResident", 0)))
+            key_map_total += float(
+                (h.get("gauges") or {}).get("upsertKeyMapSize") or 0)
+        # Bounded mode, not slope: a kill -9 wipes one server's key map
+        # and the healed replica rebuilds it, which reads as a positive
+        # slope without being a leak. The structural cap is keyspace x
+        # replicas-hosting (every server may hold committed copies); a
+        # real leak grows with publish churn and crosses it.
+        self._get("cluster.upsertKeyMapSize",
+                  bound=UPSERT_KEYSPACE * NUM_SERVERS).add(t, key_map_total)
+
+    def _run(self):
+        while not self.stop_flag.is_set():
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — mid-failover scrape
+                pass
+            self.stop_flag.wait(self.period_s)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="leak-sampler")
+        self._thread.start()
+
+    def stop(self):
+        self.stop_flag.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+# -- chaos schedule ---------------------------------------------------------
+
+def chaos_schedule(duration_s: float):
+    """Deterministic fault plan scaled to the run length. Explicit
+    targets for kill/drain/restart pairs (the restart must name the
+    process the kill took down); net_* targets are seeded-chosen by the
+    coordinator from the live pool."""
+    if SHORT:
+        return [
+            {"at_s": 0.25 * duration_s, "kind": "kill_server",
+             "target": "Server_2", "recovery_deadline_s": 60.0,
+             "note": "kill -9 a serving replica"},
+            {"at_s": 0.45 * duration_s, "kind": "start_server",
+             "target": "Server_2"},
+            {"at_s": 0.60 * duration_s, "kind": "fail_controller",
+             "recovery_deadline_s": 30.0,
+             "note": "lead lease takeover"},
+        ]
+    return [
+        {"at_s": 300.0, "kind": "net_latency", "duration_s": 60.0,
+         "params": {"latency_s": 0.1, "probability": 0.5},
+         "note": "100ms on half the dispatches to one server"},
+        {"at_s": 480.0, "kind": "kill_server", "target": "Server_3",
+         "recovery_deadline_s": 120.0,
+         "note": "kill -9 a serving replica mid-load"},
+        {"at_s": 780.0, "kind": "start_server", "target": "Server_3"},
+        {"at_s": 960.0, "kind": "drain_server", "target": "Server_5",
+         "recovery_deadline_s": 90.0,
+         "note": "SIGTERM graceful drain (zero-error restart path)"},
+        {"at_s": 1080.0, "kind": "start_server", "target": "Server_5"},
+        {"at_s": 1140.0, "kind": "fail_controller",
+         "recovery_deadline_s": 60.0,
+         "note": "kill -9 the ACTIVE lead; standby lease takeover"},
+        {"at_s": 1260.0, "kind": "start_controller",
+         "target": "Controller_lead",
+         "note": "failed lead rejoins as the new standby"},
+        {"at_s": 1320.0, "kind": "kill_minion", "target": "Minion_0",
+         "note": "kill -9 possibly mid-swap (intent-log recovery)"},
+        {"at_s": 1380.0, "kind": "start_minion", "target": "Minion_0"},
+        {"at_s": 1500.0, "kind": "net_drop", "duration_s": 30.0,
+         "params": {"probability": 0.3},
+         "note": "drop 30% of dispatches to one server"},
+    ]
+
+
+# -- data build + table registration ----------------------------------------
+
+def make_vec_segments(base):
+    from pinot_tpu.common.schema import (DataType, Schema, dimension,
+                                         metric, vector)
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    schema = Schema("vectab", [
+        dimension("shard", DataType.INT),
+        metric("rid", DataType.INT),
+        vector("emb", VEC_DIM),
+    ])
+    cfg = TableConfig("vectab")
+    rng = np.random.default_rng(SEED + 5)
+    dirs = []
+    n = 1024 if SHORT else 4096
+    for i in range(2):
+        cols = {
+            "shard": rng.integers(0, 4, n).astype(np.int32),
+            "rid": (np.arange(n, dtype=np.int32) + i * n),
+            "emb": rng.standard_normal((n, VEC_DIM)).astype(np.float32),
+        }
+        d = os.path.join(base, f"vec_{i}")
+        SegmentCreator(schema, cfg, segment_name=f"vec_{i}").build(
+            cols, d)
+        dirs.append(d)
+    return schema, cfg, dirs
+
+
+def events_schema_config(topic_host, topic_port):
+    from pinot_tpu.common.schema import (DataType, Schema, dimension,
+                                         metric)
+    from pinot_tpu.common.table_config import (IndexingConfig,
+                                               SegmentsConfig,
+                                               TableConfig, TableType,
+                                               UpsertConfig)
+    schema = Schema("events", [
+        dimension("key", DataType.STRING),
+        metric("value", DataType.LONG),
+        dimension("ts", DataType.LONG),
+    ])
+    cfg = TableConfig(
+        "events", table_type=TableType.REALTIME,
+        indexing_config=IndexingConfig(stream_configs={
+            "stream.factory.name": "tcp",
+            "stream.topic.name": "events",
+            "stream.tcp.host": topic_host,
+            "stream.tcp.port": str(topic_port),
+            "realtime.segment.flush.threshold.size":
+                "500" if SHORT else "2000",
+            "realtime.segment.flush.threshold.time.ms": "600000000",
+        }),
+        segments_config=SegmentsConfig(replication=1,
+                                       time_column_name="ts"))
+    cfg.upsert_config = UpsertConfig(mode="FULL",
+                                     primary_key_columns=["key"])
+    cfg.task_configs = {"UpsertCompactionTask":
+                        {"invalidDocsThresholdPercent": 30,
+                         "minInvalidDocs": 50}}
+    return schema, cfg
+
+
+def load_tables(cluster, base):
+    import json as _json
+
+    from pinot_tpu.common.table_config import QuotaConfig
+    from pinot_tpu.tools.datagen import (build_join_table_dirs,
+                                         build_ssb_segment_dirs,
+                                         fact_join_schema,
+                                         join_table_configs,
+                                         part_dim_schema, ssb_schema,
+                                         ssb_table_config)
+
+    # 1. lineorder OFFLINE: SSB + window base + 2-tenant quota split
+    ssb_dirs, _ids, _sc = build_ssb_segment_dirs(
+        os.path.join(base, "ssb"), ROWS, SEGMENTS, seed=SEED,
+        star_tree=True)
+    cfg = ssb_table_config(star_tree=True)
+    cfg.segments_config.replication = 2
+    cfg.quota_config = QuotaConfig(max_queries_per_second=10_000.0)
+    bronze_qps = 2.0 if SHORT else 8.0
+    cfg.custom_config = {"tenantQuotas": _json.dumps(
+        {"gold": 5_000.0, "bronze": bronze_qps})}
+    cluster.add_schema(ssb_schema())
+    cluster.add_table(cfg)
+    for d in ssb_dirs:
+        cluster.upload_segment("lineorder_OFFLINE", d)
+
+    # 2. join pair, co-partitioned (Modulo) on the join keys
+    fact_rows = 5000 if SHORT else 50_000
+    fact_dirs, dim_dirs, _dim, _fact = build_join_table_dirs(
+        os.path.join(base, "join"), fact_rows, 4, dim_rows=800,
+        seed=SEED, num_partitions=4)
+    fact_cfg, dim_cfg = join_table_configs(num_partitions=4)
+    fact_cfg.segments_config.replication = 2
+    cluster.add_schema(fact_join_schema())
+    cluster.add_schema(part_dim_schema())
+    cluster.add_table(fact_cfg)
+    cluster.add_table(dim_cfg)
+    for d in fact_dirs:
+        cluster.upload_segment("lineorderj_OFFLINE", d)
+    for d in dim_dirs:
+        cluster.upload_segment("part_OFFLINE", d)
+
+    # 3. vector table
+    vschema, vcfg, vdirs = make_vec_segments(os.path.join(base, "vec"))
+    cluster.add_schema(vschema)
+    cluster.add_table(vcfg)
+    for d in vdirs:
+        cluster.upload_segment("vectab_OFFLINE", d)
+    return ROWS, fact_rows
+
+
+# -- gating + artifact -------------------------------------------------------
+
+def evaluate_gates(tracker, coordinator, sampler, driver,
+                   chaos_excluded):
+    failures = []
+    unflagged = tracker.unflagged_total()
+    if unflagged:
+        failures.append(
+            f"{unflagged} UNFLAGGED errors (responses whose exceptions "
+            f"lack a machine-readable errorCode)")
+    failures.extend(tracker.violations())
+    for v in coordinator.violations():
+        failures.append(f"chaos recovery deadline violated: {v}")
+    verdicts = {}
+    for name, series in sorted(sampler.series.items()):
+        verdict = series.verdict()
+        verdicts[name] = verdict
+        proc = name.split(".", 1)[0]
+        if proc in chaos_excluded and name.endswith("rssBytes"):
+            continue    # killed/drained + restarted: RSS series steps
+        if not verdict.flat:
+            failures.append(f"leak gauge not flat: {name} "
+                            f"({verdict.reason})")
+    if driver.issued == 0:
+        failures.append("load driver issued zero queries")
+    return failures, verdicts
+
+
+def main() -> int:
+    t_start = time.time()
+    qps = _target_qps()
+    base = tempfile.mkdtemp(prefix="pinot_tpu_soak_")
+    print(f"soak: {'SHORT' if SHORT else 'FULL'} {DURATION_S:.0f}s, "
+          f"{NUM_BROKERS}x{NUM_SERVERS}, target {qps:.0f} QPS, "
+          f"base {base}", file=sys.stderr, flush=True)
+
+    from pinot_tpu.realtime.tcp_stream import (TcpTopicClient,
+                                               TcpTopicServer)
+    topic_srv = TcpTopicServer()
+    tport = topic_srv.start()
+    topic_srv.create_topic("events", 2)
+    publisher = TcpTopicClient("127.0.0.1", tport)
+
+    cluster = MultiprocCluster(
+        base, num_brokers=NUM_BROKERS, num_servers=NUM_SERVERS,
+        ha=True, minion=True, lease_s=2.0, broker_faults=True)
+    tracker = SLOTracker(p99_bounds_ms=P99_BOUNDS_MS)
+    sampler = LeakSampler(cluster, period_s=5.0)
+    driver = None
+    ingest = None
+    rc = 1
+    try:
+        load_tables(cluster, base)
+        eschema, ecfg = events_schema_config("127.0.0.1", tport)
+        cluster.add_schema(eschema)
+        cluster.add_table(ecfg)
+        cluster.await_ready("lineorder", ROWS, timeout_s=600)
+        print(f"tables ready at t={time.time() - t_start:.0f}s",
+              file=sys.stderr, flush=True)
+
+        ingest = UpsertIngest(publisher, "events", INGEST_ROWS_PER_S,
+                              SEED)
+        ingest.start()
+        driver = LoadDriver(cluster, tracker, qps, THREADS, SEED)
+        driver.start()
+        sampler.start()
+
+        coordinator = ChaosCoordinator(cluster,
+                                       chaos_schedule(DURATION_S),
+                                       seed=SEED)
+        chaos_thread = threading.Thread(target=coordinator.run,
+                                        daemon=True, name="chaos")
+        t0 = time.monotonic()
+        chaos_thread.start()
+        while time.monotonic() - t0 < DURATION_S:
+            time.sleep(5.0)
+            el = time.monotonic() - t0
+            snap = tracker.snapshot()
+            total = sum(c["count"] for c in snap.values())
+            print(f"t={el:.0f}s issued={driver.issued} tracked={total} "
+                  f"unflagged={tracker.unflagged_total()} "
+                  f"transportErr={driver.transport_errors} "
+                  f"missed={driver.missed_slots}",
+                  file=sys.stderr, flush=True)
+        coordinator.stop()
+        chaos_thread.join(timeout=30)
+
+        driver.stop()
+        ingest.stop()
+        sampler.sample()        # one final point for the verdicts
+        sampler.stop()
+
+        chaos_excluded = {f"{ev['target']}"
+                          for ev in chaos_schedule(DURATION_S)
+                          if ev.get("target") and
+                          ev["kind"] in ("kill_server", "drain_server")}
+        chaos_excluded.add("controller")
+        failures, verdicts = evaluate_gates(
+            tracker, coordinator, sampler, driver, chaos_excluded)
+
+        artifact = {
+            "artifact": "production_soak",
+            "mode": "short" if SHORT else "full",
+            "config": {
+                "durationS": DURATION_S, "seed": SEED,
+                "brokers": NUM_BROKERS, "servers": NUM_SERVERS,
+                "ha": True, "minion": True,
+                "targetQps": qps, "threads": THREADS,
+                "offlineRows": ROWS,
+                "ingestRowsPerS": INGEST_ROWS_PER_S,
+                "upsertKeyspace": UPSERT_KEYSPACE,
+                "mix": dict(MIX),
+            },
+            "chaos": coordinator.report(),
+            "slo": {
+                "perClass": tracker.snapshot(),
+                "p99BoundsMs": P99_BOUNDS_MS,
+                "unflaggedErrors": tracker.unflagged_total(),
+                "unflaggedExamples": tracker.unflagged_examples,
+                "violations": tracker.violations(),
+            },
+            "load": {
+                "issued": driver.issued,
+                "missedSlots": driver.missed_slots,
+                "transportErrors": driver.transport_errors,
+                "ingestPublished": ingest.published,
+            },
+            "leakGauges": {
+                name: {"verdict": v.to_json(),
+                       "series": sampler.series[name].series()}
+                for name, v in verdicts.items()
+            },
+            "gates": {"passed": not failures, "failures": failures},
+            "wallClockS": round(time.time() - t_start, 1),
+        }
+        with open(ARTIFACT, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+        print(f"artifact -> {ARTIFACT}", file=sys.stderr, flush=True)
+        if failures:
+            print("SOAK GATE FAILURES:", file=sys.stderr)
+            for fmsg in failures:
+                print(f"  - {fmsg}", file=sys.stderr)
+            rc = 1
+        else:
+            print("SOAK GATES GREEN", file=sys.stderr)
+            rc = 0
+    finally:
+        if driver is not None:
+            driver.stop_flag.set()
+        if ingest is not None:
+            ingest.stop_flag.set()
+        sampler.stop_flag.set()
+        cluster.stop()
+        try:
+            publisher.close()
+        except Exception:  # noqa: BLE001
+            pass
+        topic_srv.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

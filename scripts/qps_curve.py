@@ -50,6 +50,7 @@ import urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+
 # HARD override: the serving-plane benchmark must not pay the test
 # harness's TPU relay RTT (~90ms/dispatch) per query — that measures the
 # relay, not the broker path. bench.py owns the chip-plane numbers.
@@ -60,6 +61,8 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 os.environ.setdefault("PINOT_TPU_BROKER_INLINE", "1")
 os.environ.setdefault("PINOT_TPU_BROKER_CACHE_OFFLINE", "1")
 os.environ.setdefault("PINOT_TPU_SHM_MIN_BYTES", str(256 * 1024))
+
+from pinot_tpu.tools.cluster import MultiprocCluster as _ProcCluster  # noqa: E402
 
 ROWS = int(os.environ.get("QPS_ROWS", 2_000_000))
 SEGMENTS = int(os.environ.get("QPS_SEGMENTS", 4))
@@ -82,109 +85,26 @@ def _http(method, url, body=None, ctype="application/json", timeout=60):
         return json.loads(resp.read())
 
 
-class MultiprocCluster:
+class MultiprocCluster(_ProcCluster):
     """controller + num_servers servers + num_brokers brokers, one
-    process each; server admin APIs started so per-rung PROFILE
-    attribution covers the server-side phases too."""
+    process each (shared harness: pinot_tpu.tools.cluster); server
+    admin APIs started so per-rung PROFILE attribution covers the
+    server-side phases too. This wrapper only loads the SSB data."""
 
     def __init__(self, base: str, dirs, schema, table_config,
                  num_brokers: int = 1, num_servers: int = 2):
-        self._procs = []
+        super().__init__(base, num_brokers=num_brokers,
+                         num_servers=num_servers)
         self.num_brokers = num_brokers
         self.num_servers = num_servers
-        env = dict(os.environ, PYTHONPATH=REPO)
-
-        def spawn(*cmd):
-            p = subprocess.Popen(
-                [sys.executable, "-m", "pinot_tpu.tools.admin", *cmd],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                env=env, cwd=REPO, text=True)
-            self._procs.append(p)
-            line = p.stdout.readline().strip()
-            if not line:
-                raise RuntimeError(f"process {cmd[0]} died on boot")
-            return json.loads(line)
-
-        ctrl = spawn("StartController", "--dir", base, "--store-port", "0")
-        self.store_port = ctrl["storePort"]
-        store = f"127.0.0.1:{self.store_port}"
-        deep = ctrl["deepStore"]
-        self.server_admin_ports = {}
-        for i in range(num_servers):
-            boot = spawn("StartServer", "--store", store,
-                         "--deep-store", deep,
-                         "--instance-id", f"Server_{i}",
-                         "--admin-port", "0")
-            self.server_admin_ports[f"Server_{i}"] = boot["adminPort"]
-        self.broker_ports = []
-        for _ in range(num_brokers):
-            broker = spawn("StartBroker", "--store", store,
-                           "--deep-store", deep)
-            self.broker_ports.append(broker["httpPort"])
-
-        capi = f"http://127.0.0.1:{ctrl['httpPort']}"
-        _http("POST", f"{capi}/schemas",
-              json.dumps(schema.to_json()).encode())
-        _http("POST", f"{capi}/tables",
-              json.dumps(table_config.to_json()).encode())
-        from pinot_tpu.controller.http_api import pack_segment_dir
+        self.add_schema(schema)
+        self.add_table(table_config)
         for d in dirs:
-            _http("POST", f"{capi}/segments/{TABLE}", pack_segment_dir(d),
-                  ctype="application/octet-stream")
-
-    def metrics_snapshots(self):
-        """Cumulative phase timers from EVERY broker and server process
-        (summed per phase by _phase_means for attribution)."""
-        out = {"brokers": {}, "servers": {}}
-        for i, port in enumerate(self.broker_ports):
-            try:
-                out["brokers"][f"Broker_{i}"] = _http(
-                    "GET", f"http://127.0.0.1:{port}/metrics?format=json",
-                    timeout=10)
-            except Exception:  # noqa: BLE001 — profile note is best-effort
-                pass
-        for name, port in self.server_admin_ports.items():
-            try:
-                out["servers"][name] = _http(
-                    "GET", f"http://127.0.0.1:{port}/metrics?format=json",
-                    timeout=10)
-            except Exception:  # noqa: BLE001
-                pass
-        return out
+            self.upload_segment(TABLE, d)
 
     def await_ready(self, expected_rows: int, timeout_s: float = 300.0):
-        """Poll until EVERY broker serves the FULL table (external view
-        converged on every server, all broker watchers caught up)."""
-        deadline = time.monotonic() + timeout_s
-        last = None
-        pending = list(self.broker_ports)
-        while time.monotonic() < deadline and pending:
-            port = pending[0]
-            try:
-                out = _http("POST", f"http://127.0.0.1:{port}/query",
-                            json.dumps({"pql": "SELECT COUNT(*) FROM "
-                                        "lineorder"}).encode(),
-                            timeout=10)
-                last = out
-                if not out.get("exceptions") and \
-                        out["aggregationResults"][0]["value"] == \
-                        str(expected_rows):
-                    pending.pop(0)
-                    continue
-            except Exception:  # noqa: BLE001 — still booting
-                pass
-            time.sleep(0.3)
-        if pending:
-            raise RuntimeError(f"cluster not ready in {timeout_s}s: {last}")
-
-    def stop(self):
-        for p in self._procs:
-            p.terminate()
-        for p in self._procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        super().await_ready("lineorder", expected_rows,
+                            timeout_s=timeout_s)
 
 
 class EmbeddedShape:

@@ -1,0 +1,432 @@
+"""Deterministic chaos plane + SLO/leak gate units (ISSUE 19).
+
+Tiers:
+1. **Determinism** — same seed + schedule + fake clock + adapter ⇒
+   byte-identical ``timeline_json``; a different seed changes the
+   seeded target choice.
+2. **Windows on a fake clock** — a ``duration_s`` event arms at
+   ``at_s`` and disarms via ``clear_fault`` at ``at_s + duration_s``;
+   never before.
+3. **Recovery tracking** — the adapter's probe resolving inside the
+   deadline records ``recovered`` with the measured recovery time;
+   a probe that never resolves records ``recovery_deadline_violated``
+   (and ``violations()`` reports it exactly once).
+4. **Coordinator robustness** — adapter verbs that raise become
+   timeline ``error`` entries, empty target pools become ``skipped``
+   entries, and the run completes either way.
+5. **Leak-flatness detector** (obs/slo.py GaugeSeries) — flat stays
+   flat, linear growth trips, a step inside the settle window (churn
+   settling) passes, insufficient samples defaults to flat.
+6. **Response classifier** (obs/slo.py + common/response.py) — the
+   flagged-vs-unflagged split over BrokerResponse exception entries,
+   and the prefix → errorCode/cause table the broker's degraded paths
+   rely on.
+"""
+import json
+
+import pytest
+
+from pinot_tpu.common.chaos import (ChaosCoordinator, ChaosEvent,
+                                    coerce_schedule)
+from pinot_tpu.common.response import (classify_exception,
+                                       exception_entry)
+from pinot_tpu.obs.slo import GaugeSeries, SLOTracker, classify_response
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeCluster:
+    """Adapter double: records verb calls, serves configurable target
+    pools and probes."""
+
+    def __init__(self, servers=("Server_0", "Server_1", "Server_2"),
+                 probe_results=None, raise_on=()):
+        self.servers = list(servers)
+        self.calls = []
+        self.cleared = []
+        self.probe_results = dict(probe_results or {})
+        self.raise_on = set(raise_on)
+
+    def targets(self, kind):
+        if kind in ("kill_server", "drain_server", "net_latency",
+                    "net_drop"):
+            return list(self.servers)
+        return []
+
+    def _verb(self, kind, target, **params):
+        if kind in self.raise_on:
+            raise RuntimeError(f"boom in {kind}")
+        self.calls.append((kind, target, params))
+        return target
+
+    def __getattr__(self, name):
+        if name.startswith(("kill_", "drain_", "fail_", "start_",
+                            "net_")):
+            return lambda target=None, **p: self._verb(name, target, **p)
+        raise AttributeError(name)
+
+    def clear_fault(self, target):
+        self.cleared.append(target)
+
+    def recovery_probe(self, event, target):
+        result = self.probe_results.get(event.kind)
+        if result is None:
+            return None
+        return result
+
+
+def drive(coordinator, clock, until_s, dt=0.25):
+    while clock.t < until_s:
+        clock.advance(dt)
+        coordinator.step()
+
+
+# -- tier 1: determinism ------------------------------------------------------
+
+SCHEDULE = [
+    {"at_s": 1.0, "kind": "net_latency", "duration_s": 2.0,
+     "params": {"latency_s": 0.1}},
+    {"at_s": 3.0, "kind": "kill_server", "recovery_deadline_s": 5.0},
+    {"at_s": 6.0, "kind": "drain_server", "target": "Server_1"},
+]
+
+
+def run_once(seed):
+    clock = FakeClock()
+    recovered = {"n": 0}
+
+    def probe():
+        recovered["n"] += 1
+        return recovered["n"] >= 3      # recovers on the third poll
+
+    cluster = FakeCluster(probe_results={"kill_server": probe})
+    coord = ChaosCoordinator(cluster, SCHEDULE, seed=seed, clock=clock,
+                             sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 12.0)
+    assert coord.done()
+    return coord.timeline_json(), cluster
+
+
+def test_same_seed_byte_identical_timeline():
+    a, _ = run_once(seed=7)
+    b, _ = run_once(seed=7)
+    assert a == b
+
+
+def test_different_seed_changes_seeded_target():
+    targets = set()
+    for seed in range(12):
+        _, cluster = run_once(seed=seed)
+        kills = [t for k, t, _ in cluster.calls if k == "kill_server"]
+        targets.update(kills)
+    assert len(targets) > 1, "seed never changed the chosen target"
+
+
+def test_explicit_target_wins_over_rng():
+    _, cluster = run_once(seed=3)
+    drains = [t for k, t, _ in cluster.calls if k == "drain_server"]
+    assert drains == ["Server_1"]
+
+
+# -- tier 2: fault windows on the fake clock ---------------------------------
+
+def test_window_arms_then_disarms_at_duration():
+    clock = FakeClock()
+    cluster = FakeCluster()
+    coord = ChaosCoordinator(
+        cluster,
+        [{"at_s": 2.0, "kind": "net_latency", "duration_s": 3.0}],
+        seed=0, clock=clock, sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 1.5)
+    assert not cluster.calls, "fired before at_s"
+    drive(coord, clock, 4.5)
+    assert [k for k, _, _ in cluster.calls] == ["net_latency"]
+    assert not cluster.cleared, "disarmed before at_s + duration_s"
+    drive(coord, clock, 5.5)
+    target = cluster.calls[0][1]
+    assert cluster.cleared == [target]
+    assert coord.done()
+    actions = [e["action"] for e in coord.timeline]
+    assert actions == ["fired", "disarmed"]
+
+
+# -- tier 3: recovery deadlines ----------------------------------------------
+
+def test_recovery_inside_deadline_records_recovery_time():
+    clock = FakeClock()
+    state = {"ok": False}
+    cluster = FakeCluster(
+        probe_results={"kill_server": lambda: state["ok"]})
+    coord = ChaosCoordinator(
+        cluster,
+        [{"at_s": 1.0, "kind": "kill_server", "target": "Server_0",
+          "recovery_deadline_s": 10.0}],
+        seed=0, clock=clock, sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 3.0)
+    assert not coord.done(), "recovery pending must keep the run open"
+    state["ok"] = True
+    drive(coord, clock, 3.5)
+    assert coord.done()
+    rec = [e for e in coord.timeline if e["action"] == "recovered"]
+    assert len(rec) == 1
+    assert rec[0]["recoveryS"] == pytest.approx(2.5, abs=0.3)
+    assert coord.recoveries() == {"kill_server": rec[0]["recoveryS"]}
+    assert coord.violations() == []
+
+
+def test_recovery_deadline_violation_reported_once():
+    clock = FakeClock()
+    cluster = FakeCluster(
+        probe_results={"kill_server": lambda: False})
+    coord = ChaosCoordinator(
+        cluster,
+        [{"at_s": 1.0, "kind": "kill_server", "target": "Server_0",
+          "recovery_deadline_s": 4.0}],
+        seed=0, clock=clock, sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 20.0)
+    assert coord.done()
+    viols = coord.violations()
+    assert len(viols) == 1
+    assert viols[0]["kind"] == "kill_server"
+    assert viols[0]["deadlineS"] == 4.0
+    assert not coord.report()["recoveries"]
+
+
+# -- tier 4: robustness -------------------------------------------------------
+
+def test_raising_verb_becomes_timeline_error():
+    clock = FakeClock()
+    cluster = FakeCluster(raise_on={"kill_server"})
+    coord = ChaosCoordinator(
+        cluster,
+        [{"at_s": 1.0, "kind": "kill_server", "target": "Server_0"},
+         {"at_s": 2.0, "kind": "drain_server", "target": "Server_1"}],
+        seed=0, clock=clock, sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 5.0)
+    assert coord.done()
+    errors = [e for e in coord.timeline if e["action"] == "error"]
+    assert len(errors) == 1 and "boom" in errors[0]["error"]
+    # the later event still fired: chaos tooling never dies mid-soak
+    assert ("drain_server", "Server_1", {}) in cluster.calls
+
+
+def test_empty_target_pool_skips():
+    clock = FakeClock()
+    cluster = FakeCluster(servers=())
+    coord = ChaosCoordinator(
+        cluster, [{"at_s": 1.0, "kind": "kill_server"}],
+        seed=0, clock=clock, sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 3.0)
+    assert coord.done()
+    assert [e["action"] for e in coord.timeline] == ["skipped"]
+
+
+def test_stop_aborts_pending_work():
+    clock = FakeClock()
+    cluster = FakeCluster(
+        probe_results={"kill_server": lambda: False})
+    coord = ChaosCoordinator(
+        cluster,
+        [{"at_s": 1.0, "kind": "kill_server", "target": "Server_0",
+          "recovery_deadline_s": 100.0},
+         {"at_s": 50.0, "kind": "drain_server", "target": "Server_1"}],
+        seed=0, clock=clock, sleep=lambda s: clock.advance(s))
+    coord.begin()
+    drive(coord, clock, 2.0)
+    assert not coord.done()
+    coord.stop()
+    assert coord.done()
+    # the not-yet-fired drain never ran
+    assert all(k != "drain_server" for k, _, _ in cluster.calls)
+
+
+def test_coerce_schedule_accepts_both_forms():
+    evs = coerce_schedule([
+        ChaosEvent(at_s=1.0, kind="kill_server"),
+        {"atS": 2.0, "kind": "net_drop", "durationS": 3.0,
+         "recoveryDeadlineS": 4.0, "params": {"probability": 0.5}},
+    ])
+    assert evs[1].at_s == 2.0 and evs[1].duration_s == 3.0
+    assert evs[1].recovery_deadline_s == 4.0
+    assert evs[1].params == {"probability": 0.5}
+
+
+# -- tier 5: leak-flatness detector ------------------------------------------
+
+def test_flat_series_is_flat():
+    s = GaugeSeries("rss")
+    for i in range(40):
+        s.add(float(i), 1e9 + (1e6 if i % 2 else -1e6))   # jitter only
+    v = s.verdict()
+    assert v.flat, v.reason
+
+
+def test_linear_growth_trips():
+    s = GaugeSeries("rss", rel_tol=0.10)
+    for i in range(40):
+        s.add(float(i), 1e9 + i * 2e7)       # +2e7/sample ⇒ ~78% growth
+    v = s.verdict()
+    assert not v.flat
+    assert v.projected_growth > 0
+
+
+def test_step_inside_settle_window_passes():
+    """Churn settling (cache fill, key-map build) lives in the first
+    quarter of the window — the detector must not flag it."""
+    s = GaugeSeries("keyMap", settle_frac=0.25, rel_tol=0.10)
+    for i in range(40):
+        s.add(float(i), 0.0 if i < 8 else 2000.0)   # step at 20%
+    v = s.verdict()
+    assert v.flat, v.reason
+
+
+def test_step_after_settle_trips():
+    s = GaugeSeries("held", settle_frac=0.25, rel_tol=0.05,
+                    abs_tol=0.0)
+    for i in range(40):
+        s.add(float(i), 1000.0 if i < 30 else 4000.0)  # step at 75%
+    v = s.verdict()
+    assert not v.flat
+
+
+def test_insufficient_samples_defaults_flat():
+    s = GaugeSeries("x")
+    s.add(0.0, 5.0)
+    s.add(1.0, 500.0)
+    v = s.verdict()
+    assert v.flat and "insufficient" in v.reason
+
+
+def test_bounded_mode_tolerates_chaos_wobble():
+    """A kill -9 wipes one server's key map and the healed replica
+    rebuilds it — a positive slope that is NOT a leak. Bounded mode
+    passes any wobble that stays under the structural cap."""
+    s = GaugeSeries("keyMap", bound=1200.0)
+    for i in range(40):
+        # dip to 200 mid-window (kill), rebuild toward 400 (heal)
+        v = 400.0 if i < 15 else (200.0 + (i - 15) * 10.0)
+        s.add(float(i), min(v, 450.0))
+    v = s.verdict()
+    assert v.flat, v.reason
+    assert "bounded" in v.reason
+
+
+def test_bounded_mode_trips_past_cap():
+    """A real key-map leak grows with publish churn and crosses the
+    keyspace x replicas cap; bounded mode must trip on it."""
+    s = GaugeSeries("keyMap", bound=1200.0)
+    for i in range(40):
+        s.add(float(i), 300.0 + i * 40.0)     # churn-proportional growth
+    v = s.verdict()
+    assert not v.flat
+    assert "cap" in v.reason
+
+
+def test_bounded_mode_ignores_settle_spike():
+    """A pre-settle excursion above the cap (startup backfill racing
+    compaction GC) is startup, not a leak — only post-settle samples
+    are judged against the bound."""
+    s = GaugeSeries("keyMap", settle_frac=0.25, bound=1000.0)
+    for i in range(40):
+        s.add(float(i), 5000.0 if i < 8 else 800.0)   # spike at <20%
+    v = s.verdict()
+    assert v.flat, v.reason
+
+
+# -- tier 6: flagged-vs-unflagged classifier ---------------------------------
+
+def test_classify_exception_prefix_table():
+    assert classify_exception(
+        "QuotaExceededError: tenant over limit") == (429,
+                                                     "quotaExceeded")
+    assert classify_exception("PQLParsingError: bad token") == \
+        (150, "parse")
+    assert classify_exception("SomeNovelError: what") is None
+
+
+def test_exception_entry_explicit_args_win():
+    e = exception_entry("QueryTimeoutError: 10s", error_code=123,
+                        cause="custom")
+    assert e == {"message": "QueryTimeoutError: 10s", "errorCode": 123,
+                 "cause": "custom"}
+    e2 = exception_entry("QueryTimeoutError: 10s")
+    assert e2["errorCode"] == 250 and e2["cause"] == "timeout"
+
+
+def test_classify_response_ok_flagged_unflagged():
+    ok, _ = classify_response({"exceptions": [],
+                               "partialResponse": False})
+    assert ok == "ok"
+    flagged, causes = classify_response(
+        {"exceptions": [{"message": "x", "errorCode": 425,
+                         "cause": "exchange"}],
+         "partialResponse": True})
+    assert flagged == "flagged" and "exchange" in causes
+    un, causes = classify_response(
+        {"exceptions": [{"message": "mystery failure"}],
+         "partialResponse": True})
+    assert un == "unflagged" and "unclassified" in causes
+
+
+def test_slo_tracker_gates():
+    t = SLOTracker(p99_bounds_ms={"ssb": 100.0})
+    for _ in range(50):
+        t.record("ssb", 10.0, {"exceptions": [],
+                               "partialResponse": False})
+    assert t.violations() == []
+    t.record("ssb", 10.0, {"exceptions": [{"message": "mystery"}],
+                           "partialResponse": True})
+    assert t.unflagged_total() == 1
+    assert any("unflagged" in v.lower() for v in t.violations())
+    t2 = SLOTracker(p99_bounds_ms={"ssb": 100.0})
+    for _ in range(100):
+        t2.record("ssb", 500.0, {"exceptions": [],
+                                 "partialResponse": False})
+    assert any("p99" in v for v in t2.violations())
+
+
+def test_fault_wrapper_exposes_inner_endpoints():
+    """Soak-surfaced regression: with the broker's data plane wrapped
+    in FaultInjectingTransport, the multi-stage planner reads
+    ``transport.endpoints`` to address exchange peers — the wrapper
+    hiding the inner TCP map made EVERY cross-server join/window query
+    fail with 'exchange source neither local nor TCP-addressable'."""
+    from pinot_tpu.common.faults import FaultInjectingTransport
+
+    class InnerTcp:
+        def __init__(self):
+            self.endpoints = {}
+
+        def set_endpoint(self, server, host, port):
+            self.endpoints[server] = (host, port)
+
+    inner = InnerTcp()
+    wrapped = FaultInjectingTransport(inner, seed=0)
+    wrapped.set_endpoint("Server_0", "127.0.0.1", 4242)
+    assert wrapped.endpoints == {"Server_0": ("127.0.0.1", 4242)}
+    inner.set_endpoint("Server_1", "127.0.0.1", 4243)
+    assert "Server_1" in wrapped.endpoints
+
+
+def test_tracker_snapshot_shape():
+    t = SLOTracker()
+    t.record("join", 5.0, {"exceptions": [], "partialResponse": False})
+    snap = t.snapshot()
+    assert snap["join"]["count"] == 1
+    assert snap["join"]["ok"] == 1
+    assert json.dumps(snap)        # artifact-serializable
