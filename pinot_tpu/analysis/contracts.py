@@ -363,7 +363,7 @@ def _exemplar_request():
                             order_by=[SelectionSort("a", False)],
                             offset=1, size=7),
         vector=VectorSimilarity(column="e", query=[1.0, 0.0], k=3,
-                                metric="COSINE"),
+                                metric="COSINE", nprobe=4),
         join=JoinSpec(dim_table="d", fact_key="k", dim_key="pk",
                       dim_filter=FilterQueryTree(
                           operator=FilterOperator.EQUALITY, column="a",

@@ -336,6 +336,10 @@ class ServerMeter:
     # single-flight result-cache dedup: identical concurrent queries
     # that waited on the leader's execution instead of their own
     SINGLE_FLIGHT_WAITS = "singleFlightWaits"
+    # IVF ANN vector search: queries that requested probing (nprobe>0).
+    # The probe-vs-exact-fallback split per segment rides the obs
+    # profiler's path counters ("ivfProbe" / "ivfExactFallback")
+    IVF_NPROBE_QUERIES = "ivfNprobeQueries"
 
 
 class ServerTimer:

@@ -108,14 +108,19 @@ class VectorSimilarity:
     """A ranked top-k similarity clause: VECTOR_SIMILARITY(col, [..], k).
 
     `metric` ∈ {COSINE, DOT, MIPS} (MIPS is an alias of DOT — maximum
-    inner product). Exact filtered top-k, not ANN: the candidate set is
-    the WHERE filter's (and the upsert validDocIds mask's) surviving
-    rows, scored exhaustively.
+    inner product). With `nprobe` == 0 (the default) the candidate set
+    is the WHERE filter's (and the upsert validDocIds mask's) surviving
+    rows, scored exhaustively. `nprobe` > 0 requests IVF ANN: segments
+    carrying a built index score only rows assigned to the query's
+    top-nprobe coarse cells; segments without one (and consuming/
+    unsealed rows) transparently fall back to the exact scan, so upsert
+    freshness semantics are unchanged.
     """
     column: str
     query: List[float]
     k: int = 10
     metric: str = "COSINE"
+    nprobe: int = 0
 
 
 @dataclasses.dataclass

@@ -76,6 +76,11 @@ class FieldSpec:
                 raise ValueError(
                     f"column '{self.name}' expects a {self.vector_dimension}"
                     f"-dimension vector, got shape {arr.shape}")
+            # NaN/Inf rejected at ingest: they would contaminate every
+            # score tree they touch and poison trained IVF centroids
+            if not np.isfinite(arr).all():
+                raise ValueError(
+                    f"column '{self.name}': NaN/Inf embedding values")
             return arr
         if value is None:
             return self.default_null_value

@@ -96,7 +96,8 @@ def request_to_json(r: BrokerRequest) -> dict:
         "vector": None if r.vector is None else {
             "col": r.vector.column,
             "q": [float(x) for x in r.vector.query],
-            "k": r.vector.k, "metric": r.vector.metric},
+            "k": r.vector.k, "metric": r.vector.metric,
+            "nprobe": r.vector.nprobe},
         # optional multi-stage clauses (same version-skew contract)
         "join": None if r.join is None else {
             "dimTable": r.join.dim_table,
@@ -136,7 +137,8 @@ def request_from_json(d: dict) -> BrokerRequest:
             offset=sel.get("offset", 0), size=sel.get("size", 10)),
         vector=None if vec is None else VectorSimilarity(
             column=vec["col"], query=list(vec["q"]),
-            k=vec.get("k", 10), metric=vec.get("metric", "COSINE")),
+            k=vec.get("k", 10), metric=vec.get("metric", "COSINE"),
+            nprobe=int(vec.get("nprobe", 0))),
         join=None if jn is None else JoinSpec(
             dim_table=jn["dimTable"], fact_key=jn["factKey"],
             dim_key=jn["dimKey"],

@@ -41,6 +41,12 @@ class IndexingConfig:
     # "log2m": N, "suffix": "_hll"}: the creator adds a derived column of
     # per-row serialized HLLs per origin, targeted by the FASTHLL rewrite
     hll_config: Optional[dict] = None
+    # VECTOR column → IVF index config: {"type": "IVF", "numCentroids",
+    # "trainIterations", "seed", "trainSampleSize"} (index/ivf.py
+    # defaults apply). The creator trains a per-segment codebook at
+    # seal; absent columns stay exact-scan.
+    vector_index_configs: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -56,6 +62,7 @@ class IndexingConfig:
                 "columnPartitionMap": self.segment_partition_config},
             "segmentFormatVersion": self.segment_version,
             "hllConfig": self.hll_config,
+            "vectorIndexConfigs": self.vector_index_configs,
         }
 
     @classmethod
@@ -74,6 +81,7 @@ class IndexingConfig:
                                       ).get("columnPartitionMap", {}),
             segment_version=d.get("segmentFormatVersion", "v1"),
             hll_config=d.get("hllConfig"),
+            vector_index_configs=d.get("vectorIndexConfigs") or {},
         )
 
 

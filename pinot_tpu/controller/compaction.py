@@ -195,6 +195,9 @@ class SegmentSwapManager:
                 "sizeBytes": size,
                 "partitionMetadata": partition_meta,
                 "swappedFrom": list(olds),
+                # rewrite result's custom stats (IVF drift after a
+                # compaction reassigns rows against the carried codebook)
+                "customMap": dict(meta.custom or {}),
             })
             return rec
 

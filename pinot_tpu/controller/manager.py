@@ -184,12 +184,27 @@ class ResourceManager:
         value-hash-backed index config is a misconfiguration — reject at
         create time (the schema may legitimately not be registered yet
         for OFFLINE bootstrap flows; then there is nothing to check)."""
+        from pinot_tpu.index import ivf
+        for col, raw in (config.indexing_config.vector_index_configs
+                         or {}).items():
+            cfg = dict(ivf.DEFAULT_CONFIG)
+            cfg.update(raw or {})
+            try:
+                ivf.validate_config(cfg, col)
+            except ValueError as e:
+                raise InvalidTableConfigError(str(e)) from None
         schema = self.get_schema(config.table_name)
         if schema is None:
             return
         from pinot_tpu.common.datatype import DataType
         vec_cols = {f.name for f in schema.fields
                     if f.data_type == DataType.VECTOR}
+        bad_idx = set(config.indexing_config.vector_index_configs
+                      or {}) - vec_cols
+        if bad_idx:
+            raise InvalidTableConfigError(
+                f"vectorIndexConfigs name non-VECTOR column(s) "
+                f"{sorted(bad_idx)}")
         if not vec_cols:
             return
         idx = config.indexing_config
@@ -276,6 +291,14 @@ class ResourceManager:
                     raise InvalidTableConfigError(
                         f"MergeRollupTask.mergeType must be CONCATENATE "
                         f"or ROLLUP, got {merge_type!r}")
+            elif ttype == "IvfRetrainTask":
+                if not (config.indexing_config.vector_index_configs
+                        or {}):
+                    raise InvalidTableConfigError(
+                        "IvfRetrainTask requires vectorIndexConfigs "
+                        "(there is no codebook to retrain otherwise)")
+                _num(cfg, "retrainDriftThreshold", "0.2", 0.0, 1e6,
+                     ttype)
 
     # -- tenants -----------------------------------------------------------
     def server_instances_for(self, config: TableConfig) -> List[str]:
@@ -406,6 +429,8 @@ class ResourceManager:
             "crc": meta.crc,
             "sizeBytes": size_bytes,
             "partitionMetadata": partition_meta,
+            # segment-custom stats (e.g. IVF drift) for task generators
+            "customMap": dict(meta.custom or {}),
         })
         replicas = config.segments_config.replication
         strategy = self._assignments.setdefault(

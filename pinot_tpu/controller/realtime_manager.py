@@ -432,6 +432,9 @@ class RealtimeSegmentManager:
                 "totalDocs": built.total_docs,
                 "pushTimeMs": int(time.time() * 1e3),
                 "crc": built.crc,
+                # seal-time custom stats (IVF drift baseline) for the
+                # minion task generators
+                "customMap": dict(built.custom or {}),
             })
             return rec
 

@@ -1,0 +1,7 @@
+"""Auxiliary per-segment index structures (beyond the columnar core).
+
+`ivf`: the IVF ANN coarse quantizer for VECTOR columns — k-means
+centroids trained as a batched device kernel, per-row centroid
+assignments persisted next to the `.vec.fwd.npy` block, and probe-list
+selection fused into the filter plane as its own lane kind.
+"""

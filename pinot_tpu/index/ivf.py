@@ -1,0 +1,343 @@
+"""IVF coarse quantizer for VECTOR columns (ANN pre-filtering).
+
+Parity: the IVF family of Johnson et al. (billion-scale similarity
+search) adapted to the segment model — each sealed segment carries its
+own k-means codebook:
+
+  {col}.ivf.centroids.npy   f32 [numCentroids, dim]   trained codebook
+  {col}.ivf.assign.npy      i32 [num_docs]            per-row coarse cell
+  {col}.ivf.meta.json       seed/iterations/meanDist baseline (drift)
+
+Training is a fixed-iteration Lloyd's loop with deterministic seeded
+init (numpy Generator) driving a jitted device step — the distance
+matrix + argmin + one-hot recentering are batched matmuls (MXU work).
+Big segments train on a seeded sample and then assign all rows through
+a fixed-shape assign-only kernel so the compile surface stays bounded.
+
+At query time `VECTOR_SIMILARITY(..., nprobe=N)` turns into an
+"ivf_probe" filter predicate over three lanes (assignments, padded
+centroids, centroid validity); probe-list selection runs on-device so
+sharded execution can share one plan across segments with different
+live centroid counts. The numpy twins here mirror the device math
+op-for-op (same balanced-tree sums, same monotone-int32 keys, same
+tie-breaking) so host/device/sharded agree on the probed candidate set
+bit-exactly.
+
+Why a validity lane instead of a runtime count: zero-padded centroid
+rows score 0.0 under dot-product (beating real negative scores), and a
+count scalar would ride in plan params — which sharded execution shares
+across segments. A precomputed bool lane (centroid has >= 1 assigned
+row) solves padding, per-segment counts, and dead-cell probing at once.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.ops import kernels
+from pinot_tpu.segment import format as fmt
+
+INT32_MAX = np.int32(2 ** 31 - 1)
+
+# index-config knobs (tableIndexConfig.vectorIndexConfigs.<col>)
+DEFAULT_CONFIG = {
+    "type": "IVF",
+    "numCentroids": 256,
+    "trainIterations": 10,
+    "seed": 0,
+    "trainSampleSize": 65536,
+}
+# segment-custom keys stamped by the creator and read by the minion
+# drift generator (controller record "customMap" mirrors them)
+CUSTOM_MEAN = "ivf.{col}.meanDist"
+CUSTOM_BASELINE = "ivf.{col}.baselineMeanDist"
+CUSTOM_CENTROIDS = "ivf.{col}.numCentroids"
+
+ASSIGN_BLOCK = 65536       # fixed assign-kernel row block (one compile)
+
+
+def pad_dim(dim: int) -> int:
+    """Embedding dim padding — MUST match the planner's query padding."""
+    return kernels.pow2_bucket(max(dim, 1), floor=1)
+
+
+def pad_centroids(c: int) -> int:
+    return kernels.pow2_bucket(max(c, 1), floor=8)
+
+
+# ---------------------------------------------------------------------------
+# config / custom-map helpers
+# ---------------------------------------------------------------------------
+
+
+def column_config(table_config, col: str) -> Optional[dict]:
+    """Effective IVF config for a column, or None when not indexed."""
+    idx = getattr(table_config, "indexing_config", None)
+    cfgs = getattr(idx, "vector_index_configs", None) or {}
+    raw = cfgs.get(col)
+    if raw is None:
+        return None
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(raw)
+    return cfg
+
+
+def validate_config(cfg: dict, col: str) -> None:
+    if str(cfg.get("type", "IVF")).upper() != "IVF":
+        raise ValueError(
+            f"vector index for '{col}': unknown type {cfg.get('type')!r}")
+    for key in ("numCentroids", "trainIterations", "trainSampleSize"):
+        if int(cfg.get(key, DEFAULT_CONFIG[key])) < 1:
+            raise ValueError(f"vector index for '{col}': {key} must be >= 1")
+
+
+def stamp_custom(custom: Dict[str, str], col: str, meta: dict) -> None:
+    custom[CUSTOM_MEAN.format(col=col)] = repr(float(meta["meanDist"]))
+    custom[CUSTOM_BASELINE.format(col=col)] = \
+        repr(float(meta["baselineMeanDist"]))
+    custom[CUSTOM_CENTROIDS.format(col=col)] = str(int(meta["numCentroids"]))
+
+
+def drift_from_custom(custom: Dict[str, str], col: str) -> Optional[float]:
+    """Relative drift = meanDist / trained baseline - 1 (None if absent
+    or the baseline is ~0, e.g. all-identical embeddings)."""
+    try:
+        mean = float(custom[CUSTOM_MEAN.format(col=col)])
+        base = float(custom[CUSTOM_BASELINE.format(col=col)])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if base <= 1e-12:
+        return None
+    return mean / base - 1.0
+
+
+# ---------------------------------------------------------------------------
+# index files
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class IvfIndex:
+    centroids: np.ndarray     # f32 [numCentroids, dim]
+    assignments: np.ndarray   # i32 [num_docs]
+    meta: dict
+
+    @property
+    def num_centroids(self) -> int:
+        return int(self.centroids.shape[0])
+
+
+def write_index(out_dir: str, col: str, index: IvfIndex) -> None:
+    import os
+    np.save(os.path.join(out_dir, fmt.IVF_CENTROIDS.format(col=col)),
+            np.ascontiguousarray(index.centroids, dtype=np.float32))
+    np.save(os.path.join(out_dir, fmt.IVF_ASSIGN.format(col=col)),
+            np.ascontiguousarray(index.assignments, dtype=np.int32))
+    with open(os.path.join(out_dir, fmt.IVF_META.format(col=col)), "w") as f:
+        json.dump(index.meta, f, indent=1, sort_keys=True)
+
+
+def load_index(seg_dir, col: str) -> Optional[IvfIndex]:
+    d = fmt.open_dir(seg_dir)
+    name = fmt.IVF_META.format(col=col)
+    if not d.exists(name):
+        return None
+    meta = json.loads(d.read_text(name))
+    return IvfIndex(
+        centroids=d.load_array(fmt.IVF_CENTROIDS.format(col=col)),
+        assignments=d.load_array(fmt.IVF_ASSIGN.format(col=col)),
+        meta=meta)
+
+
+# ---------------------------------------------------------------------------
+# query-time lanes (padded operands served by the loader)
+# ---------------------------------------------------------------------------
+
+
+def centroid_lane(centroids: np.ndarray) -> np.ndarray:
+    """f32 [C_pad, dim_pad] zero-padded codebook lane."""
+    c, dim = centroids.shape
+    out = np.zeros((pad_centroids(c), pad_dim(dim)), np.float32)
+    out[:c, :dim] = centroids
+    return out
+
+
+def validity_lane(assignments: np.ndarray, num_centroids: int) -> np.ndarray:
+    """bool [C_pad]: centroid has >= 1 assigned row (padding rows and
+    dead cells both drop out of probe selection)."""
+    counts = np.bincount(np.asarray(assignments, np.int64),
+                         minlength=pad_centroids(num_centroids))
+    return counts[:pad_centroids(num_centroids)] > 0
+
+
+def assignment_lane(assignments: np.ndarray, num_centroids: int,
+                    padded_rows: int) -> np.ndarray:
+    """Narrowed [padded_rows] assignment lane; padding rows carry the
+    (never-probed) sentinel id `num_centroids`."""
+    dt = np.dtype(np.int8 if num_centroids <= 127 else
+                  np.int16 if num_centroids <= 32767 else np.int32)
+    out = np.full(padded_rows, num_centroids, dt)
+    out[:assignments.shape[0]] = assignments.astype(dt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# numpy probe-select twin (host oracle; bit-parity with the device path)
+# ---------------------------------------------------------------------------
+
+
+def np_monotone_i32(scores: np.ndarray) -> np.ndarray:
+    """f32 → order-preserving int32 keys (same IEEE bit trick as
+    kernels._monotone_int32_keys)."""
+    b = np.ascontiguousarray(np.asarray(scores, np.float32)).view(np.int32)
+    return b ^ ((b >> 31) & np.int32(0x7FFFFFFF))
+
+
+def np_centroid_scores(centroids_pad: np.ndarray, q_pad: np.ndarray,
+                       q_norm, metric: str) -> np.ndarray:
+    """Twin of kernels._vector_scores over the padded codebook."""
+    mat = np.asarray(centroids_pad, np.float32)
+    q = np.asarray(q_pad, np.float32)
+    dot = np.asarray(kernels.vec_tree_sum(mat * q[None, :]), np.float32)
+    if metric == "cosine":
+        denom = np.sqrt(
+            np.asarray(kernels.vec_tree_sum(mat * mat), np.float32)
+        ).astype(np.float32) * np.float32(q_norm)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.where(denom > 0, dot / denom,
+                            np.float32(-np.inf)).astype(np.float32)
+    return dot
+
+
+def select_probes_np(centroids_pad: np.ndarray, cvalid: np.ndarray,
+                     q_pad: np.ndarray, q_norm, metric: str,
+                     nprobe: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(probe_ids i32 [nprobe], probe_ok bool [nprobe]) — same ranking
+    and tie-breaking (equal key → lower centroid id) as lax.top_k."""
+    score = np_centroid_scores(centroids_pad, q_pad, q_norm, metric)
+    key = np.maximum(np_monotone_i32(score), np.int32(-INT32_MAX))
+    key = np.where(np.asarray(cvalid, bool), key,
+                   np.int32(-INT32_MAX - 1)).astype(np.int64)
+    order = np.lexsort((np.arange(key.shape[0]), -key))[:nprobe]
+    ok = np.arange(nprobe) < int(np.asarray(cvalid, bool).sum())
+    return order.astype(np.int32), ok
+
+
+def probe_mask_np(assignments: np.ndarray, centroids_pad: np.ndarray,
+                  cvalid: np.ndarray, q_pad: np.ndarray, q_norm,
+                  metric: str, nprobe: int) -> np.ndarray:
+    """bool [P] row mask: row's coarse cell is in the top-nprobe list."""
+    probe, ok = select_probes_np(centroids_pad, cvalid, q_pad, q_norm,
+                                 metric, nprobe)
+    a = np.asarray(assignments, np.int32)
+    return ((a[:, None] == probe[None, :]) & ok[None, :]).any(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# training (seeded Lloyd's; device step kernels in ops/ivf_kernels.py)
+# ---------------------------------------------------------------------------
+
+
+def _assign_all(mat: np.ndarray, centroids: np.ndarray):
+    """Assign every row through the fixed-block device kernel.
+
+    Returns (assignments i32 [n], mean_dist float) where mean_dist is
+    the mean L2 distance to the assigned centroid (the drift metric)."""
+    from pinot_tpu.ops import ivf_kernels
+    n, dim = mat.shape
+    c = centroids.shape[0]
+    c_pad, d_pad = pad_centroids(c), pad_dim(dim)
+    cen = np.zeros((c_pad, d_pad), np.float32)
+    cen[:c, :dim] = centroids
+    out = np.empty(n, np.int32)
+    total = 0.0
+    kern = ivf_kernels.get_ivf_assign_kernel(ASSIGN_BLOCK, c_pad, d_pad)
+    for start in range(0, n, ASSIGN_BLOCK):
+        stop = min(start + ASSIGN_BLOCK, n)
+        block = np.zeros((ASSIGN_BLOCK, d_pad), np.float32)
+        block[:stop - start, :dim] = mat[start:stop]
+        res = kern(block, cen, np.int32(stop - start), np.int32(c))
+        out[start:stop] = np.asarray(res["ivf.assign"])[:stop - start]
+        d2 = np.asarray(res["ivf.dist"], np.float64)[:stop - start]
+        total += float(np.sqrt(np.maximum(d2, 0.0)).sum())
+    return out, (total / n if n else 0.0)
+
+
+def train(mat: np.ndarray, *, num_centroids: int, iterations: int,
+          seed: int, sample_size: int) -> IvfIndex:
+    """Fixed-iteration Lloyd's with seeded init; deterministic artifacts.
+
+    L2 k-means regardless of query metric (standard IVF practice — the
+    coarse partition only has to be consistent between build and probe).
+    NaN/Inf embeddings are rejected (ingest already filters them; this
+    guards the minion path against poisoning a whole codebook)."""
+    from pinot_tpu.ops import ivf_kernels
+    mat = np.ascontiguousarray(mat, dtype=np.float32)
+    if mat.ndim != 2:
+        raise ValueError(f"IVF training needs [n, dim] input, got "
+                         f"shape {mat.shape}")
+    if mat.size and not np.isfinite(mat).all():
+        raise ValueError("IVF training input contains NaN/Inf embeddings")
+    n, dim = mat.shape
+    k = max(1, min(int(num_centroids), n))
+    rng = np.random.default_rng(int(seed))
+    if n > sample_size:
+        sample = mat[np.sort(rng.choice(n, int(sample_size), replace=False))]
+    else:
+        sample = mat
+    m = sample.shape[0]
+    centroids = sample[np.sort(rng.choice(m, k, replace=False))].copy() \
+        if m else np.zeros((k, dim), np.float32)
+
+    m_pad, c_pad, d_pad = pad_centroids(m), pad_centroids(k), pad_dim(dim)
+    data = np.zeros((m_pad, d_pad), np.float32)
+    data[:m, :dim] = sample
+    cen = np.zeros((c_pad, d_pad), np.float32)
+    cen[:k, :dim] = centroids
+    step = ivf_kernels.get_ivf_train_kernel(m_pad, c_pad, d_pad)
+    for _ in range(max(0, int(iterations))):
+        res = step(data, cen, np.int32(m), np.int32(k))
+        cen = np.asarray(res["ivf.centroids"], np.float32)
+    centroids = np.ascontiguousarray(cen[:k, :dim])
+
+    assignments, mean_dist = _assign_all(mat, centroids) if n else \
+        (np.zeros(0, np.int32), 0.0)
+    meta = {
+        "version": 1,
+        "numCentroids": k,
+        "dim": dim,
+        "seed": int(seed),
+        "iterations": int(iterations),
+        "trainRows": m,
+        "meanDist": mean_dist,
+        "baselineMeanDist": mean_dist,
+    }
+    return IvfIndex(centroids=centroids, assignments=assignments, meta=meta)
+
+
+def build_for_column(mat: np.ndarray, cfg: dict,
+                     priors: Optional[IvfIndex] = None) -> IvfIndex:
+    """Build a column's index: fresh train, or — given priors (the
+    compaction path) — reuse the existing codebook, reassign the
+    surviving rows, and CARRY the trained baseline forward so the drift
+    metric measures real movement since training."""
+    validate_config(cfg, cfg.get("column", "?"))
+    if priors is not None and priors.num_centroids:
+        mat = np.ascontiguousarray(mat, dtype=np.float32)
+        if mat.size and not np.isfinite(mat).all():
+            raise ValueError("IVF input contains NaN/Inf embeddings")
+        assignments, mean_dist = _assign_all(mat, priors.centroids) \
+            if mat.shape[0] else (np.zeros(0, np.int32), 0.0)
+        meta = dict(priors.meta)
+        meta["meanDist"] = mean_dist
+        meta.setdefault("baselineMeanDist", mean_dist)
+        return IvfIndex(centroids=priors.centroids.copy(),
+                        assignments=assignments, meta=meta)
+    return train(mat,
+                 num_centroids=int(cfg["numCentroids"]),
+                 iterations=int(cfg["trainIterations"]),
+                 seed=int(cfg["seed"]),
+                 sample_size=int(cfg["trainSampleSize"]))

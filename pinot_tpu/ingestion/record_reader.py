@@ -117,7 +117,9 @@ class SegmentRecordReader(RecordReader):
         for name in seg.column_names:
             ds = seg.data_source(name)
             cm = ds.metadata
-            if not cm.has_dictionary:
+            if cm.data_type.name == "VECTOR":
+                cols[name] = ds.vec_values       # [n, dim] f32 rows
+            elif not cm.has_dictionary:
                 cols[name] = ds.raw_values
             elif cm.single_value:
                 cols[name] = ds.dictionary.values[ds.dict_ids]
@@ -135,6 +137,8 @@ def _plain(v):
     import numpy as np
     if isinstance(v, np.generic):
         return v.item()
+    if isinstance(v, np.ndarray):        # embedding row → float list
+        return v.tolist()
     return v
 
 

@@ -25,6 +25,7 @@ PURGE_TASK = "PurgeTask"
 CONVERT_TO_RAW_TASK = "ConvertToRawIndexTask"
 MERGE_ROLLUP_TASK = "MergeRollupTask"
 UPSERT_COMPACTION_TASK = "UpsertCompactionTask"
+IVF_RETRAIN_TASK = "IvfRetrainTask"
 
 
 class SegmentConversionResult:
@@ -182,6 +183,25 @@ def _freeze(v):
     return tuple(v) if isinstance(v, list) else v
 
 
+def _ivf_priors(schema: Schema, table_config: TableConfig,
+                seg_dir: str) -> Dict[str, object]:
+    """Existing IVF codebooks of an input segment, for rebuilds that
+    should REUSE them (compaction): reassignment under the old codebook
+    carries the trained baseline forward, so the drift metric keeps
+    measuring embedding movement since the original training instead of
+    resetting on every rewrite."""
+    from pinot_tpu.index import ivf
+    priors: Dict[str, object] = {}
+    for f in schema.fields:
+        if f.data_type.name != "VECTOR" or \
+                ivf.column_config(table_config, f.name) is None:
+            continue
+        idx = ivf.load_index(seg_dir, f.name)
+        if idx is not None:
+            priors[f.name] = idx
+    return priors
+
+
 class UpsertCompactionTaskExecutor(PinotTaskExecutor):
     """Rewrite a sealed upsert segment dropping its validDocIds-dead
     rows (parity: the reference's UpsertCompactionTaskExecutor, which
@@ -221,12 +241,50 @@ class UpsertCompactionTaskExecutor(PinotTaskExecutor):
         rows = [row for doc, row in enumerate(SegmentRecordReader(segment))
                 if doc not in invalid]
         out = os.path.join(work_dir, name)
-        SegmentCreator(schema, table_config,
-                       segment_name=name).build(rows, out)
+        SegmentCreator(schema, table_config, segment_name=name,
+                       ivf_priors=_ivf_priors(schema, table_config,
+                                              input_dirs[0])).build(rows, out)
         return SegmentConversionResult(
             out, name,
             {"numDocsDropped": len(invalid),
              "numDocsKept": len(rows)},
+            replaces=[name])
+
+
+class IvfRetrainTaskExecutor(PinotTaskExecutor):
+    """Rebuild a sealed segment with FRESH IVF codebooks (no priors).
+
+    Scheduled by IvfRetrainTaskGenerator when a segment's assignment
+    drift (meanDist vs the trained baseline, carried forward through
+    compaction rewrites) crosses the threshold — or as a backfill for
+    segments sealed before the table enabled its vector index. The
+    fresh train resets baselineMeanDist == meanDist, so the drift
+    metric starts over from the new codebook. Same-name replace rides
+    the crash-safe swap protocol (queries fall back to the exact scan
+    only for the instant the segment bounces)."""
+
+    task_type = IVF_RETRAIN_TASK
+
+    def execute(self, task, schema, table_config, input_dirs, work_dir,
+                context) -> SegmentConversionResult:
+        from pinot_tpu.index import ivf
+        name = task.configs[SEGMENT_NAME_KEY]
+        cols = [f.name for f in schema.fields
+                if f.data_type.name == "VECTOR" and
+                ivf.column_config(table_config, f.name) is not None]
+        if not cols:
+            raise ValueError(
+                f"IvfRetrainTask for {name}: table has no IVF-indexed "
+                "vector columns")
+        segment = ImmutableSegmentLoader.load(input_dirs[0])
+        rows = list(SegmentRecordReader(segment))
+        out = os.path.join(work_dir, name)
+        # no ivf_priors: the creator trains fresh codebooks
+        SegmentCreator(schema, table_config, segment_name=name).build(
+            rows, out)
+        return SegmentConversionResult(
+            out, name, {"retrainedColumns": ",".join(cols),
+                        "numDocs": len(rows)},
             replaces=[name])
 
 
@@ -237,7 +295,8 @@ class TaskExecutorRegistry:
         self._executors: Dict[str, PinotTaskExecutor] = {}
         for ex in (PurgeTaskExecutor(), ConvertToRawIndexTaskExecutor(),
                    MergeRollupTaskExecutor(),
-                   UpsertCompactionTaskExecutor()):
+                   UpsertCompactionTaskExecutor(),
+                   IvfRetrainTaskExecutor()):
             self.register(ex)
 
     def register(self, executor: PinotTaskExecutor) -> None:

@@ -15,6 +15,7 @@ import threading
 from typing import Dict, List
 
 from pinot_tpu.minion.executors import (CONVERT_TO_RAW_TASK,
+                                        IVF_RETRAIN_TASK,
                                         MERGE_ROLLUP_TASK, PURGE_TASK,
                                         UPSERT_COMPACTION_TASK)
 from pinot_tpu.minion.tasks import (COLUMNS_TO_CONVERT_KEY, SEGMENT_NAME_KEY,
@@ -113,6 +114,53 @@ class UpsertCompactionTaskGenerator(PinotTaskGenerator):
         return out
 
 
+class IvfRetrainTaskGenerator(PinotTaskGenerator):
+    """Schedule an IVF codebook retrain for every sealed segment whose
+    assignment drift crossed the threshold, plus index backfills for
+    segments sealed before the table enabled its vector index.
+
+    Drift rides the segment record's customMap (the creator stamps
+    ``ivf.<col>.meanDist`` / ``.baselineMeanDist``; compaction rewrites
+    reassign under the old codebook and CARRY the baseline, so the
+    ratio measures real embedding movement since training). taskConfig
+    knob: ``retrainDriftThreshold`` (default 0.2) — relative drift =
+    meanDist / baseline - 1."""
+
+    task_type = IVF_RETRAIN_TASK
+
+    def generate(self, table, table_config, manager, queue):
+        from pinot_tpu.index import ivf
+        vic = getattr(table_config.indexing_config,
+                      "vector_index_configs", None) or {}
+        if not vic:
+            return []
+        cfg = table_config.task_configs.get(self.task_type, {})
+        threshold = float(cfg.get("retrainDriftThreshold", 0.2))
+        out = []
+        for seg in manager.segment_names(table):
+            meta = manager.segment_metadata(table, seg) or {}
+            if meta.get("status") == "IN_PROGRESS":
+                continue                      # consuming: seals soon
+            if not meta.get("downloadPath"):
+                continue                      # no artifact to rebuild
+            if queue.tasks_for_segment(self.task_type, table, seg):
+                continue
+            custom = meta.get("customMap") or {}
+            due = False
+            for col in vic:
+                if ivf.CUSTOM_CENTROIDS.format(col=col) not in custom:
+                    due = True                # sealed pre-index: backfill
+                    break
+                drift = ivf.drift_from_custom(custom, col)
+                if drift is not None and drift >= threshold:
+                    due = True
+                    break
+            if due:
+                out.append(PinotTaskConfig(self.task_type, {
+                    TABLE_NAME_KEY: table, SEGMENT_NAME_KEY: seg}))
+        return out
+
+
 class MergeRollupTaskGenerator(PinotTaskGenerator):
     """Fold runs of small committed segments into one packed segment
     (parity: MergeRollupTaskGenerator's small-segment buckets). Upsert
@@ -204,6 +252,7 @@ class PinotTaskManager:
         self._generators: Dict[str, PinotTaskGenerator] = {}
         for g in (ConvertToRawIndexTaskGenerator(), PurgeTaskGenerator(),
                   UpsertCompactionTaskGenerator(),
+                  IvfRetrainTaskGenerator(),
                   MergeRollupTaskGenerator()):
             self.register(g)
 

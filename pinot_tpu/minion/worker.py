@@ -173,9 +173,12 @@ class MinionWorker:
         died before its COMPLETED write. Returns True when the task
         needs no rebuild."""
         from pinot_tpu.controller.compaction import SWAPS_ROOT
-        from pinot_tpu.minion.executors import UPSERT_COMPACTION_TASK
+        from pinot_tpu.minion.executors import (IVF_RETRAIN_TASK,
+                                                UPSERT_COMPACTION_TASK)
         out_name = task.configs.get("outputSegmentName", "")
-        if not out_name and task.task_type == UPSERT_COMPACTION_TASK:
+        if not out_name and task.task_type in (UPSERT_COMPACTION_TASK,
+                                               IVF_RETRAIN_TASK):
+            # same-name rewrites: the swap intent is keyed by the input
             out_name = segments[0] if segments else ""
         if not out_name:
             return False

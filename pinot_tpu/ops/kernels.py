@@ -70,6 +70,11 @@ def sum_dtype():
 #             eq_raw, neq_raw, in_raw, notin_raw, range_raw}
 #     source ∈ {sv, mv, raw}
 #     extra: kind-specific static data (bucketed value count, inclusivity)
+#   ("pred", "ivf_probe", col, "ivf", (nprobe, metric)) — ANN coarse
+#     filter over THREE lanes ({col}.ivfa assignments, {col}.ivfc padded
+#     centroids, {col}.ivfv centroid validity); consumes the query
+#     vector + norm as params and keeps only rows whose coarse cell is
+#     in the on-device top-nprobe probe list
 # params: flat tuple of jnp arrays consumed in depth-first pred order.
 # ---------------------------------------------------------------------------
 
@@ -132,6 +137,41 @@ def _eval_pred(kind: str, source: str, extra, lane, params: List):
     return m
 
 
+def ivf_select_probes(centroids, cvalid, q, q_norm, metric: str,
+                      nprobe: int):
+    """Top-nprobe coarse-cell selection for the IVF filter lane.
+
+    centroids: f32 [C_pad, dim_pad] zero-padded codebook; cvalid: bool
+    [C_pad] liveness (padding rows and dead cells False — a runtime
+    lane, NOT a count param, so sharded execution can share one plan
+    across segments with different live counts). Scoring reuses the
+    query-metric machinery (same balanced tree, same monotone keys) so
+    the numpy twin in index/ivf.py is bit-identical; lax.top_k breaks
+    score ties toward the LOWER centroid id, like everywhere else.
+    Returns (probe_ids i32 [nprobe], probe_ok bool [nprobe])."""
+    cscore = _vector_scores(centroids, q, q_norm, metric)
+    ckey = jnp.maximum(_monotone_int32_keys(cscore, True)[0], -INT32_MAX)
+    scored = jnp.where(cvalid, ckey, -INT32_MAX - 1)
+    _, probe = jax.lax.top_k(scored, nprobe)
+    probe_ok = jnp.arange(nprobe, dtype=jnp.int32) < \
+        cvalid.sum(dtype=jnp.int32)
+    return probe.astype(jnp.int32), probe_ok
+
+
+def _eval_ivf_probe(extra, assign, centroids, cvalid, params: List):
+    """rows whose assigned coarse cell is probed. assign: narrow int [P]
+    (padding rows carry the never-live sentinel num_centroids). The
+    membership test is the in_ids compare form — [P, nprobe] broadcast
+    compare + any — which fuses instead of gathering at row scale."""
+    nprobe, metric = extra
+    q = params.pop(0)               # f32 [dim_pad] query vector
+    q_norm = params.pop(0)          # f32 scalar (tree-norm of q)
+    probe, probe_ok = ivf_select_probes(centroids, cvalid, q, q_norm,
+                                        metric, nprobe)
+    m = (assign.astype(jnp.int32)[..., None] == probe) & probe_ok
+    return m.any(-1)
+
+
 def _eval_filter(spec, cols: Dict[str, jnp.ndarray], params: List, valid):
     op = spec[0]
     if op == "match_all":
@@ -146,6 +186,11 @@ def _eval_filter(spec, cols: Dict[str, jnp.ndarray], params: List, valid):
         return out
     if op == "pred":
         _, kind, col, source, extra = spec
+        if source == "ivf":
+            # three-lane predicate (assignments + codebook + validity)
+            return _eval_ivf_probe(extra, cols[f"{col}.ivfa"],
+                                   cols[f"{col}.ivfc"],
+                                   cols[f"{col}.ivfv"], params)
         key = {"sv": f"{col}.ids", "mv": f"{col}.mv", "raw": f"{col}.raw",
                "vdoc": f"{col}.vdoc"}[source]
         return _eval_pred(kind, source, extra, cols[key], params)
@@ -1833,6 +1878,20 @@ def contract_cases():
          {"e0.vec": (f32, (P, 128)), "d0.ids": (i32, (P,)),
           "$validDocIds.vdoc": (bl, (P,))},
          [(i32, ()), (f32, (128,)), (f32, ())])
+    # IVF-indexed vector top-k: the ANN coarse-probe pred (assignment +
+    # codebook + validity lanes, probe list selected ON DEVICE) fused
+    # with the upsert vdoc lane ahead of the exact scoring tree — the
+    # "score only probed, live rows" path. Params: probe q + norm
+    # (filter, depth-first first), then the selection's q + norm.
+    case("select_vector_ivf_probed",
+         ("and", (("pred", "ivf_probe", "e0", "ivf", (8, "cosine")),
+                  ("pred", "vdoc", "$validDocIds", "vdoc", None))),
+         [], None,
+         ("vector", 16, (("e0", "cosine", 128),), ()),
+         {"e0.vec": (f32, (P, 128)), "e0.ivfa": (i16, (P,)),
+          "e0.ivfc": (f32, (64, 128)), "e0.ivfv": (bl, (64,)),
+          "$validDocIds.vdoc": (bl, (P,))},
+         [(f32, (128,)), (f32, ()), (f32, (128,)), (f32, ())])
     # inner-join probe fused into the filter, dict-keyed fact side: the
     # host-translated member vector is the join-match predicate, the
     # jcode gather the dim group code — composed with the upsert vdoc
@@ -1900,12 +1959,24 @@ def extra_contract_cases():
     build_segment_kernel's); arg_specs is a pytree of (dtype, shape)
     leaves mirroring the kernel's positional args, with "P" filled per
     shape bucket in both static_args and shapes."""
+    from pinot_tpu.ops import ivf_kernels  # lazy: avoids import cycle
     P = "P"
-    i32 = "int32"
+    i32, f32, bl = "int32", "float32", "bool"
     return [
         ("window_rank", build_window_kernel, (P, 2, 0),
          ((i32, (P,)), ((i32, (P,)), (i32, (P,))), (), (i32, ()))),
         ("window_rank_sum", build_window_kernel, (P, 1, 2),
          ((i32, (P,)), ((i32, (P,)),),
           ((i32, (P,)), (i32, (P,))), (i32, ()))),
+        # IVF codebook lifecycle: Lloyd's train step, assign-only (the
+        # sample-then-assign sweep), and standalone probe-select
+        ("ivf_train_step", ivf_kernels.build_ivf_train_kernel,
+         (P, 64, 128),
+         ((f32, (P, 128)), (f32, (64, 128)), (i32, ()), (i32, ()))),
+        ("ivf_assign", ivf_kernels.build_ivf_assign_kernel,
+         (P, 64, 128),
+         ((f32, (P, 128)), (f32, (64, 128)), (i32, ()), (i32, ()))),
+        ("ivf_probe_select", ivf_kernels.build_ivf_probe_kernel,
+         (64, 128, 8, "cosine"),
+         ((f32, (64, 128)), (bl, (64,)), (f32, (128,)), (f32, ()))),
     ]

@@ -339,6 +339,7 @@ class StackedSegments:
     #: lane kind → residency ledger kind (everything else is a stacked
     #: scan lane)
     _LEDGER_KINDS = {"vec": "vector", "hllidx": "hll", "hllrank": "hll",
+                     "ivfa": "vector", "ivfc": "vector", "ivfv": "vector",
                      "vdoc": "vdoc"}
 
     def _ledgered_put(self, host, owner_suffix: str, lane_kind: str,
@@ -650,6 +651,22 @@ class ShardedQueryExecutor:
                             "across segments")
         plan = plan0 if not needs_union else \
             self.plan_maker.make_segment_plan(seg0, request)
+
+        # ANN probe homogeneity: the shared plan (built against segment
+        # 0) either carries the ivf_probe pred for EVERY stacked segment
+        # or for none. A mixed stack would diverge from the sequential
+        # path's per-segment index-vs-exact decision, so fall back; lane
+        # shape disagreements (different padded codebooks) are caught by
+        # the stacker's shape check during gather.
+        vec = request.vector
+        if vec is not None and int(getattr(vec, "nprobe", 0) or 0) > 0:
+            presence = {
+                getattr(s.data_source(vec.column), "ivf_centroids", None)
+                is not None
+                for s in stack.segments}
+            if len(presence) > 1:
+                raise NotShardable(
+                    "stacked segments disagree on IVF index presence")
 
         # upsert validDocIds: if ANY stacked segment has superseded rows
         # the mask predicate must cover the WHOLE stack (planning against

@@ -319,7 +319,9 @@ class _Parser:
                           partition_by=partition_by, order_by=order_by)
 
     def parse_vector_call(self) -> VectorSimilarity:
-        """VECTOR_SIMILARITY(col, [f, f, ...], k[, 'COSINE'|'DOT'|'MIPS'])."""
+        """VECTOR_SIMILARITY(col, [f, f, ...], k[, 'COSINE'|'DOT'|'MIPS']
+        [, nprobe=N]) — nprobe > 0 requests IVF ANN probing (segments
+        without a built index fall back to the exact scan)."""
         self.next()                              # VECTOR_SIMILARITY
         self.expect(TokType.LPAREN)
         col = self.expect(TokType.IDENT).value
@@ -345,16 +347,36 @@ class _Parser:
             raise PqlSyntaxError(f"VECTOR_SIMILARITY k must be positive "
                                  f"at {t.pos}, got {k}")
         metric = "COSINE"
-        if self.peek().type == TokType.COMMA:
+        nprobe = 0
+        while self.peek().type == TokType.COMMA:
             self.next()
-            m = self.expect(TokType.STRING).value.upper()
-            if m not in ("COSINE", "DOT", "MIPS"):
+            t = self.peek()
+            if t.type == TokType.STRING:
+                m = self.next().value.upper()
+                if m not in ("COSINE", "DOT", "MIPS"):
+                    raise PqlSyntaxError(
+                        f"unknown similarity metric {m!r} "
+                        "(COSINE | DOT | MIPS)")
+                metric = m
+            elif t.type == TokType.IDENT and t.value.lower() == "nprobe":
+                self.next()
+                op = self.expect(TokType.OP)
+                if op.value != "=":
+                    raise PqlSyntaxError(
+                        f"expected nprobe=N at {op.pos}, got {op.value!r}")
+                nt = self.peek()
+                nprobe = int(self.expect(TokType.INT).value)
+                if nprobe <= 0:
+                    raise PqlSyntaxError(
+                        f"nprobe must be positive at {nt.pos}, got "
+                        f"{nprobe}")
+            else:
                 raise PqlSyntaxError(
-                    f"unknown similarity metric {m!r} "
-                    "(COSINE | DOT | MIPS)")
-            metric = m
+                    f"expected 'METRIC' or nprobe=N at {t.pos}, got "
+                    f"{t.value!r}")
         self.expect(TokType.RPAREN)
-        return VectorSimilarity(column=col, query=q, k=k, metric=metric)
+        return VectorSimilarity(column=col, query=q, k=k, metric=metric,
+                                nprobe=nprobe)
 
     def parse_agg_call(self) -> AggregationInfo:
         name = self.next().upper

@@ -25,9 +25,9 @@ def _count_filter_leaves(spec) -> int:
         return 0
     if spec[0] in ("and", "or"):
         return sum(_count_filter_leaves(c) for c in spec[1])
-    if spec[0] == "pred" and spec[1] == "vdoc":
-        return 0      # upsert mask: engine-injected, not a query leaf
-    return 1
+    if spec[0] == "pred" and spec[1] in ("vdoc", "ivf_probe"):
+        return 0      # engine-injected (upsert mask / ANN probe), not a
+    return 1          # query leaf
 
 
 def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
@@ -53,6 +53,12 @@ def gather_operands_for(segment, needed_cols) -> Dict[str, object]:
             cols[f"{col}.vlane"] = ds.device_value_lane()
         elif kind == "vec":
             cols[f"{col}.vec"] = ds.device_vec_values()
+        elif kind == "ivfa":
+            cols[f"{col}.ivfa"] = ds.device_ivf_assign()
+        elif kind == "ivfc":
+            cols[f"{col}.ivfc"] = ds.device_ivf_centroids()
+        elif kind == "ivfv":
+            cols[f"{col}.ivfv"] = ds.device_ivf_valid()
         elif kind == "hllidx":
             cols[f"{col}.hllidx"] = ds.device_hll_idx()
         elif kind == "hllrank":

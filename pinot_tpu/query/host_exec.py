@@ -81,7 +81,10 @@ def execute_host(segment: ImmutableSegment, request: BrokerRequest
             _aggregate(segment, f, mask) for f in make_functions(
                 request.aggregations)]
     if request.vector is not None:
-        _vector_topk(segment, request, mask, blk)
+        # ANN probing narrows the candidate set inside _vector_topk;
+        # the returned count keeps scanned-docs stats identical to the
+        # device path's fused-filter accounting
+        matched = _vector_topk(segment, request, mask, blk)
     elif request.is_selection:
         _selection(segment, request, mask, blk)
 
@@ -615,7 +618,7 @@ def _np_vector_scores(mat: np.ndarray, query, metric: str) -> np.ndarray:
 
 
 def _vector_topk(segment: ImmutableSegment, request: BrokerRequest,
-                 mask: np.ndarray, blk: IntermediateResultsBlock) -> None:
+                 mask: np.ndarray, blk: IntermediateResultsBlock) -> int:
     from pinot_tpu.common.datatype import DataType
     from pinot_tpu.common.request import VECTOR_RESULT_COLUMNS
     v = request.vector
@@ -639,11 +642,35 @@ def _vector_topk(segment: ImmutableSegment, request: BrokerRequest,
     if metric not in ("cosine", "dot"):
         raise ValueError(f"unknown similarity metric '{v.metric}' "
                          "(COSINE | DOT | MIPS)")
+    # ANN probe: nprobe>0 with a built IVF index narrows the candidate
+    # mask to rows whose coarse cell is in the query's top-nprobe list.
+    # The numpy twins in index/ivf.py select the SAME probe ids (same
+    # tree sums, monotone-int32 keys, tie-breaking) as the device pred,
+    # so host and device agree on the probed candidate set bit-exactly.
+    # Segments without an index (and consuming tails) stay exact.
+    nprobe = int(getattr(v, "nprobe", 0) or 0)
+    if nprobe > 0 and getattr(ds, "ivf_centroids", None) is not None \
+            and getattr(ds, "ivf_assignments", None) is not None:
+        from pinot_tpu.index import ivf as ivf_mod
+        dim = cm.vector_dimension
+        q = np.zeros(ivf_mod.pad_dim(dim), np.float32)
+        q[:dim] = np.asarray(v.query, np.float32)
+        q_norm = np.float32(np.sqrt(_np_tree_sum(q * q)))
+        nprobe_eff = min(nprobe, ivf_mod.pad_centroids(
+            int(ds.ivf_centroids.shape[0])))
+        probed = ivf_mod.probe_mask_np(
+            np.asarray(ds.ivf_assignments, np.int32),
+            ds.host_operand("ivfc"), ds.host_operand("ivfv"),
+            q, q_norm, metric, nprobe_eff)
+        aligned = np.zeros(len(mask), bool)
+        aligned[: len(probed)] = probed[: len(mask)]
+        mask = mask & aligned
     # score ONLY the filter's candidates: per-row scores are independent
     # of which other rows are scored (the tree contract is per-row), so
     # this is bit-identical to scoring everything at a fraction of the
     # work on selective queries
     docids = np.nonzero(mask)[0]
+    num_candidates = len(docids)
     s = _np_vector_scores(ds.vec_values[docids], v.query, metric)
     # rank: score desc, docid asc — lexsort's LAST key is primary, and
     # stability gives equal scores ascending docids (the device kernel's
@@ -682,6 +709,7 @@ def _vector_topk(segment: ImmutableSegment, request: BrokerRequest,
     blk.selection_rows = rows
     blk.selection_columns = user_cols + list(VECTOR_RESULT_COLUMNS)
     blk.selection_display_cols = None
+    return num_candidates
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,7 @@ from pinot_tpu.common import expression as expr_mod
 from pinot_tpu.common.datatype import DataType
 from pinot_tpu.common.request import (AggregationInfo, BrokerRequest,
                                       FilterOperator, FilterQueryTree)
-from pinot_tpu.obs.profiler import profiled_device_get
+from pinot_tpu.obs.profiler import count_path, profiled_device_get
 from pinot_tpu.ops import kernels
 from pinot_tpu.query.aggregation import AggregationFunction, make_functions
 from pinot_tpu.query.blocks import ExecutionStats, IntermediateResultsBlock
@@ -856,6 +856,29 @@ class InstancePlanMaker:
         if metric == "cosine" and not q_norm > 0:
             raise ValueError("COSINE similarity needs a non-zero, finite "
                              "query vector")
+        nprobe = int(getattr(v, "nprobe", 0) or 0)
+        if nprobe > 0:
+            cents = getattr(ds, "ivf_centroids", None)
+            if cents is not None and \
+                    getattr(ds, "ivf_assignments", None) is not None:
+                from pinot_tpu.index import ivf as ivf_mod
+                # clamp so lax.top_k never exceeds the padded codebook lane
+                nprobe_eff = min(nprobe,
+                                 ivf_mod.pad_centroids(cents.shape[0]))
+                pred = ("pred", "ivf_probe", v.column, "ivf",
+                        (nprobe_eff, metric))
+                plan.filter_spec = pred if plan.filter_spec == MATCH_ALL \
+                    else ("and", (pred, plan.filter_spec))
+                # probe operands precede all other filter params: the pred
+                # is the first AND child in depth-first evaluation order
+                plan.params = [q, np.float32(q_norm)] + plan.params
+                for lane in ("ivfa", "ivfc", "ivfv"):
+                    needed[(v.column, lane)] = None
+                count_path("ivfProbe")
+            else:
+                # nprobe requested but this segment has no built index:
+                # exact scan keeps results correct (ANN is best-effort)
+                count_path("ivfExactFallback")
         k = min(kernels.pow2_bucket(v.k, floor=1), segment.padded_docs)
         plan.select_spec = ("vector", k, ((v.column, metric, dim_pad),),
                             tuple(gather))
@@ -1383,6 +1406,11 @@ def _collect_filter_cols(spec: tuple, needed: Dict) -> None:
             _collect_filter_cols(c, needed)
     elif spec[0] == "pred":
         _, kind, col, source, _ = spec
+        if source == "ivf":
+            # three lanes: assignments + padded codebook + validity
+            for lane in ("ivfa", "ivfc", "ivfv"):
+                needed[(col, lane)] = None
+            return
         needed[(col, {"sv": "ids", "mv": "mv", "raw": "raw",
                       "vdoc": "vdoc"}[source])] = None
 

@@ -71,7 +71,8 @@ class SegmentCreator:
 
     def __init__(self, schema: Schema, table_config: Optional[TableConfig] = None,
                  segment_name: Optional[str] = None,
-                 fixed_dictionaries: Optional[Dict[str, np.ndarray]] = None):
+                 fixed_dictionaries: Optional[Dict[str, np.ndarray]] = None,
+                 ivf_priors: Optional[Dict[str, object]] = None):
         self.schema = schema
         self.table_config = table_config or TableConfig(schema.schema_name)
         self.segment_name = segment_name
@@ -80,6 +81,12 @@ class SegmentCreator:
         # share dictionaries (enables the stacked/sharded device path even
         # when a small slice misses rare values)
         self.fixed_dictionaries = fixed_dictionaries or {}
+        # column → IvfIndex from a rewrite's INPUT segment (the upsert-
+        # compaction path): the codebook is reused and its trained
+        # baseline carried forward, so the drift metric keeps measuring
+        # movement since TRAINING across rewrites. Fresh builds (and the
+        # minion IvfRetrainTask) train from scratch instead.
+        self.ivf_priors = ivf_priors or {}
 
     # -- input normalization ----------------------------------------------
     def _columnarize(self, rows: Iterable[dict]) -> Dict[str, list]:
@@ -135,6 +142,9 @@ class SegmentCreator:
         hll_cfg = getattr(idx_cfg, "hll_config", None) or {}
         hll_derive = set(hll_cfg.get("columnsToDerive", []))
         hll_sources: Dict[str, tuple] = {}
+        # IVF drift stats stamped into metadata custom (and mirrored to
+        # the controller record's customMap) for the retrain generator
+        ivf_custom: Dict[str, str] = {}
 
         for field in self.schema.fields:
             name = field.name
@@ -152,6 +162,12 @@ class SegmentCreator:
                         raise ValueError(
                             f"column {name}: vector width {mat.shape[1]} "
                             f"!= schema dimension {field.vector_dimension}")
+                    # the columnar fast path bypasses field.convert —
+                    # repeat its finite guard so NaN/Inf can't reach the
+                    # scoring tree or poison a trained codebook
+                    if mat.size and not np.isfinite(mat).all():
+                        raise ValueError(
+                            f"column {name}: NaN/Inf embedding values")
                 else:
                     mat = np.stack([field.convert(v) for v in raw]) \
                         if len(raw) else \
@@ -163,6 +179,14 @@ class SegmentCreator:
                     raise ValueError(
                         f"column {name} length {n} != {num_docs}")
                 write_vec_fwd(out_dir, name, mat)
+                # IVF index at seal (tableIndexConfig.vectorIndexConfigs)
+                from pinot_tpu.index import ivf as ivf_mod
+                ivf_cfg = ivf_mod.column_config(self.table_config, name)
+                if ivf_cfg is not None and n:
+                    index = ivf_mod.build_for_column(
+                        mat, ivf_cfg, priors=self.ivf_priors.get(name))
+                    ivf_mod.write_index(out_dir, name, index)
+                    ivf_mod.stamp_custom(ivf_custom, name, index.meta)
                 col_meta[name] = ColumnMetadata(
                     name=name, data_type=field.data_type, cardinality=n,
                     bits_per_element=32, has_dictionary=False,
@@ -387,7 +411,8 @@ class SegmentCreator:
             total_docs=num_docs, columns=col_meta,
             time_column=time_col_name, time_unit=time_unit,
             start_time=start_t, end_time=end_t,
-            creation_time_ms=int(time.time() * 1000))
+            creation_time_ms=int(time.time() * 1000),
+            custom=ivf_custom)
         meta.save(out_dir)
         with open(os.path.join(out_dir, fmt.CREATION_META_FILE), "w") as f:
             json.dump({"creator": "pinot_tpu", "version": fmt.SEGMENT_VERSION},

@@ -31,6 +31,13 @@ MV_FWD = "{col}.mv.fwd.npy"
 MV_OFFSETS = "{col}.mv.offsets.npy"
 # VECTOR column: packed fixed-width [num_docs, dimension] float32 block
 VEC_FWD = "{col}.vec.fwd.npy"
+# IVF ANN index members (built at seal when the table's vector index
+# config enables it): trained k-means centroids [numCentroids, dim] f32,
+# per-row coarse assignments [num_docs] int32, and training metadata
+# (seed / iterations / mean assignment distance baseline for drift).
+IVF_CENTROIDS = "{col}.ivf.centroids.npy"
+IVF_ASSIGN = "{col}.ivf.assign.npy"
+IVF_META = "{col}.ivf.meta.json"
 
 INV_DOCIDS = "{col}.inv.docids.npy"
 INV_OFFSETS = "{col}.inv.offsets.npy"

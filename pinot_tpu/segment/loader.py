@@ -170,6 +170,10 @@ class DataSource:
         self.raw_chunks = None
         self.mv_dict_ids: Optional[np.ndarray] = None     # int32 [docs, width]
         self.vec_values: Optional[np.ndarray] = None      # f32 [docs, dim]
+        # IVF ANN index (VECTOR columns with a built index only)
+        self.ivf_centroids: Optional[np.ndarray] = None   # f32 [C, dim]
+        self.ivf_assignments: Optional[np.ndarray] = None  # i32 [docs]
+        self.ivf_meta: Optional[dict] = None
         self.sorted_ranges: Optional[np.ndarray] = None   # [card, 2]
         self.inverted_index: Optional[InvertedIndexReader] = None
         self.bloom_filter: Optional[BloomFilter] = None
@@ -212,6 +216,20 @@ class DataSource:
         padding is zeros (masked by the kernel's validity iota), dim
         padding is zeros (an exact no-op in the tree-dot sums)."""
         return self._device("vec_values", self.host_operand("vec"))
+
+    def device_ivf_assign(self):
+        """Narrow per-row coarse-cell lane [P] (padding rows carry the
+        never-probed sentinel id numCentroids)."""
+        return self._device("ivf_assign", self.host_operand("ivfa"))
+
+    def device_ivf_centroids(self):
+        """Zero-padded codebook [C_pad, dim_pad] f32."""
+        return self._device("ivf_centroids", self.host_operand("ivfc"))
+
+    def device_ivf_valid(self):
+        """Centroid liveness [C_pad] bool (live count rides as a lane,
+        not a param, so sharded plans stay shareable)."""
+        return self._device("ivf_valid", self.host_operand("ivfv"))
 
     def device_hll_idx(self):
         """Per-dictId HLL register-index table [card_pad] int32 — built
@@ -275,6 +293,16 @@ class DataSource:
             out = np.zeros((p, dp), dtype=np.float32)
             out[: len(mat), : mat.shape[1]] = mat
             return out
+        if kind in ("ivfa", "ivfc", "ivfv"):
+            from pinot_tpu.index import ivf
+            c = int(self.ivf_centroids.shape[0])
+            if kind == "ivfa":
+                return ivf.assignment_lane(
+                    self.ivf_assignments, c,
+                    padded_size(len(self.ivf_assignments)))
+            if kind == "ivfc":
+                return ivf.centroid_lane(self.ivf_centroids)
+            return ivf.validity_lane(self.ivf_assignments, c)
         if kind in ("hllidx", "hllrank"):
             if self._hll_tables is None:
                 with self._lane_lock:
@@ -293,7 +321,8 @@ class DataSource:
 
     #: _device key → residency ledger kind
     _LEDGER_KINDS = {"vec_values": "vector", "hll_idx": "hll",
-                     "hll_rank": "hll"}
+                     "hll_rank": "hll", "ivf_assign": "vector",
+                     "ivf_centroids": "vector", "ivf_valid": "vector"}
 
     def _device(self, key: str, host_array: np.ndarray):
         if key not in self._dev:
@@ -343,8 +372,16 @@ class DataSource:
             return total
         if self.vec_values is not None:
             rows = len(self.vec_values)
-            return padded_size(rows) * vec_dim_pad(
+            total = padded_size(rows) * vec_dim_pad(
                 cm.vector_dimension) * 4
+            if self.ivf_centroids is not None:
+                from pinot_tpu.index import ivf
+                c = int(self.ivf_centroids.shape[0])
+                total += padded_size(rows) * \
+                    min_id_dtype(c).itemsize             # assignment lane
+                total += ivf.pad_centroids(c) * \
+                    (vec_dim_pad(cm.vector_dimension) * 4 + 1)  # cb + valid
+            return total
         if self.raw_chunks is not None:
             return 0              # no device lane for chunked raw
         if self.raw_values is not None:
@@ -370,6 +407,7 @@ class DataSource:
             self._raw_values = None
             self.mv_dict_ids = None
             self.vec_values = None
+            self.ivf_assignments = None    # row-scale; codebook stays
             self._hll_tables = None
 
     def adopt_host(self, fresh: "DataSource") -> None:
@@ -382,6 +420,7 @@ class DataSource:
             self._raw_values = fresh._raw_values
             self.mv_dict_ids = fresh.mv_dict_ids
             self.vec_values = fresh.vec_values
+            self.ivf_assignments = fresh.ivf_assignments
             if fresh.raw_chunks is not None:
                 self.raw_chunks = fresh.raw_chunks
 
@@ -579,6 +618,12 @@ class ImmutableSegmentLoader:
             ds = DataSource(cm, None)
             if cm.data_type == DataType.VECTOR:
                 ds.vec_values = read_vec_fwd(seg_dir, name)
+                from pinot_tpu.index import ivf
+                index = ivf.load_index(seg_dir, name)
+                if index is not None:
+                    ds.ivf_centroids = index.centroids
+                    ds.ivf_assignments = index.assignments
+                    ds.ivf_meta = index.meta
                 sources[name] = ds
                 continue
             if not cm.has_dictionary:

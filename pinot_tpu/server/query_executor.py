@@ -65,6 +65,9 @@ class InstanceQueryExecutor:
         instead of computing answers nobody will read."""
         t_start = time.perf_counter()
         self.metrics.meter(ServerMeter.QUERIES).mark()
+        vec = request.query.vector
+        if vec is not None and int(getattr(vec, "nprobe", 0) or 0) > 0:
+            self.metrics.meter(ServerMeter.IVF_NPROBE_QUERIES).mark()
         self.metrics.timer(ServerQueryPhase.SCHEDULER_WAIT).update(
             scheduler_wait_ms)
         if deadline is not None and time.monotonic() >= deadline:

@@ -159,8 +159,12 @@ def build_query(qclass: str, rng: np.random.Generator) -> str:
         return t.format(y=int(rng.integers(1992, 1999)))
     if qclass == "vector":
         qs = ", ".join(f"{x:.4f}" for x in rng.standard_normal(VEC_DIM))
+        # half the class probes the IVF index (mixed stack: one segment
+        # indexed, one not — the exact-fallback and NotShardable paths
+        # serve continuously, including through minion kill windows)
+        ann = ", nprobe=4" if rng.random() < 0.5 else ""
         return (f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], 7, "
-                f"'COSINE') FROM vectab WHERE shard < 2")
+                f"'COSINE'{ann}) FROM vectab WHERE shard < 2")
     if qclass == "upsert":
         return "SELECT COUNT(*), SUM(value) FROM events"
     if qclass == "tenant":
@@ -417,7 +421,20 @@ def make_vec_segments(base):
         metric("rid", DataType.INT),
         vector("emb", VEC_DIM),
     ])
-    cfg = TableConfig("vectab")
+    from pinot_tpu.common.table_config import IndexingConfig
+    idx = IndexingConfig()
+    idx.vector_index_configs = {"emb": {"numCentroids": 32}}
+    cfg = TableConfig("vectab", indexing_config=idx)
+    # the minion backfills vec_1's missing codebook mid-soak (and the
+    # chaos plane may kill it mid-swap — the durable-intent resume path)
+    cfg.task_configs = {"IvfRetrainTask": {}}
+    # segment 0 seals WITH the IVF codebook; segment 1 is built
+    # index-less on purpose, so every probed query in the mix exercises
+    # the index-miss exact fallback AND the sharded mixed-stack
+    # sequential fallback for the whole run — including minion kill
+    # windows, where the IvfRetrainTask backfill for vec_1 may be
+    # mid-flight
+    plain = TableConfig("vectab")
     rng = np.random.default_rng(SEED + 5)
     dirs = []
     n = 1024 if SHORT else 4096
@@ -428,8 +445,8 @@ def make_vec_segments(base):
             "emb": rng.standard_normal((n, VEC_DIM)).astype(np.float32),
         }
         d = os.path.join(base, f"vec_{i}")
-        SegmentCreator(schema, cfg, segment_name=f"vec_{i}").build(
-            cols, d)
+        SegmentCreator(schema, cfg if i == 0 else plain,
+                       segment_name=f"vec_{i}").build(cols, d)
         dirs.append(d)
     return schema, cfg, dirs
 

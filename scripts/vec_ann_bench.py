@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""VEC_r16: exact scan vs IVF ANN probing at the 10M x 128d rung.
+
+Builds a 10M-row (4 x 2.5M segments) VECTOR table with the IVF codebook
+trained at seal by the real SegmentCreator (256 centroids/segment),
+then drives filtered-less VECTOR_SIMILARITY top-10 queries through the
+host engine path: one exact pass per query, then an nprobe sweep. Per
+nprobe rung the artifact records recall@10 against the exact answer,
+the scanned-row fraction (numDocsScanned — the probed candidate set)
+and the wall-clock speedup over the exact scan.
+
+Pass gate (the ISSUE 20 acceptance bar): some rung reaches
+recall@10 >= 0.95 while scanning < 15% of the rows.
+
+Embeddings are cluster-structured (draws around 256 shared centers) —
+the regime IVF exists for; i.i.d. gaussian data has no coarse structure
+for ANY coarse quantizer to exploit.
+
+Env knobs:
+  VEC_ANN_ROWS     total rows    (default 10_000_000)
+  VEC_ANN_DIM      dimension     (default 128)
+  VEC_ANN_QUERIES  query count   (default 5)
+  VEC_ANN_ARTIFACT output path   (default VEC_r16.json next to repo root)
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+ROWS = int(os.environ.get("VEC_ANN_ROWS", str(10_000_000)))
+DIM = int(os.environ.get("VEC_ANN_DIM", "128"))
+N_QUERIES = int(os.environ.get("VEC_ANN_QUERIES", "5"))
+N_SEGS = 4
+N_CENTROIDS = 256
+NPROBES = (1, 2, 4, 8, 16)
+K = 10
+ARTIFACT = os.environ.get(
+    "VEC_ANN_ARTIFACT",
+    os.path.join(os.path.dirname(__file__), "..", "VEC_r16.json"))
+
+
+def main() -> int:
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, metric, vector
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    from pinot_tpu.engine import QueryEngine
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+    rng = np.random.default_rng(2016)
+    per = ROWS // N_SEGS
+    schema = Schema("vecbench", [
+        metric("rid", DataType.INT),
+        vector("emb", DIM),
+    ])
+    idx = IndexingConfig()
+    idx.vector_index_configs = {"emb": {"numCentroids": N_CENTROIDS}}
+    cfg = TableConfig("vecbench", indexing_config=idx)
+    centers = rng.standard_normal((N_CENTROIDS, DIM)).astype(np.float32) * 4
+
+    base = tempfile.mkdtemp(prefix="vec_ann_bench_")
+    segs = []
+    t_build0 = time.monotonic()
+    try:
+        for s in range(N_SEGS):
+            which = rng.integers(0, N_CENTROIDS, per)
+            emb = (centers[which] +
+                   rng.standard_normal((per, DIM)).astype(np.float32) * 0.3)
+            cols = {"rid": np.arange(per, dtype=np.int32) + s * per,
+                    "emb": emb}
+            d = os.path.join(base, f"b{s}")
+            SegmentCreator(schema, cfg,
+                           segment_name=f"b{s}").build(cols, d)
+            del emb, cols
+            segs.append(ImmutableSegmentLoader.load(d))
+            print(f"vec_ann_bench: segment {s + 1}/{N_SEGS} sealed "
+                  f"({per} rows, codebook trained) "
+                  f"t={time.monotonic() - t_build0:.0f}s", flush=True)
+        build_s = time.monotonic() - t_build0
+
+        engine = QueryEngine(segs, use_device=False)
+        queries = [(centers[int(rng.integers(N_CENTROIDS))] +
+                    rng.standard_normal(DIM).astype(np.float32) * 0.3)
+                   for _ in range(N_QUERIES)]
+
+        def run(q, nprobe):
+            qs = ", ".join(repr(float(x)) for x in q)
+            clause = f", nprobe={nprobe}" if nprobe else ""
+            t0 = time.monotonic()
+            resp = engine.query(
+                f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], {K}, "
+                f"'COSINE'{clause}) FROM vecbench")
+            ms = (time.monotonic() - t0) * 1000.0
+            assert not resp.exceptions, resp.exceptions
+            rids = [int(r[0]) for r in resp.selection_results.results]
+            return rids, resp.num_docs_scanned, ms
+
+        exact = []
+        for qi, q in enumerate(queries):
+            rids, scanned, ms = run(q, 0)
+            exact.append((rids, ms))
+            print(f"vec_ann_bench: exact q{qi} {ms:.0f}ms "
+                  f"scanned={scanned}", flush=True)
+        exact_p50 = float(np.median([ms for _, ms in exact]))
+
+        rungs = {}
+        for nprobe in NPROBES:
+            recalls, fracs, times = [], [], []
+            for qi, q in enumerate(queries):
+                rids, scanned, ms = run(q, nprobe)
+                want = set(exact[qi][0])
+                recalls.append(len(set(rids) & want) / len(want))
+                fracs.append(scanned / ROWS)
+                times.append(ms)
+            p50 = float(np.median(times))
+            rungs[f"nprobe={nprobe}"] = {
+                "recall_at_10": round(float(np.mean(recalls)), 4),
+                "recall_min": round(float(min(recalls)), 4),
+                "scanned_fraction": round(float(np.mean(fracs)), 4),
+                "p50_ms": round(p50, 2),
+                "speedup_vs_exact": round(exact_p50 / p50, 2),
+            }
+            print(f"vec_ann_bench: nprobe={nprobe} "
+                  f"recall@10={np.mean(recalls):.3f} "
+                  f"scan={np.mean(fracs):.1%} p50={p50:.0f}ms "
+                  f"({exact_p50 / p50:.1f}x)", flush=True)
+
+        ok = any(r["recall_at_10"] >= 0.95 and
+                 r["scanned_fraction"] < 0.15 for r in rungs.values())
+        out = {
+            "metric": "ivf_recall_and_speedup_vs_exact_scan",
+            "backend": "cpu",
+            "rows": ROWS, "dim": DIM, "segments": N_SEGS,
+            "num_centroids_per_segment": N_CENTROIDS,
+            "k": K, "queries": N_QUERIES,
+            "data": "clustered (256 shared centers, sigma 0.3)",
+            "build_s": round(build_s, 1),
+            "exact_p50_ms": round(exact_p50, 2),
+            "rungs": rungs,
+            "gate": "recall@10 >= 0.95 at < 15% rows scanned",
+            "pass": bool(ok),
+        }
+        with open(ARTIFACT, "w") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"vec_ann_bench: artifact -> {ARTIFACT}  "
+              f"{'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    finally:
+        for seg in segs:
+            getattr(seg, "close", lambda: None)()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

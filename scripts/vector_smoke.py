@@ -13,12 +13,21 @@ then asserts end to end through the broker:
 - FRESHNESS: an upsert published MID-RUN (a known key gets a crafted
   perfect-match embedding) is ranked FIRST by the next converged query,
   and the superseded row never ranks again;
-- MASKING: no dead (superseded) rid ever appears in any top-k.
+- MASKING: no dead (superseded) rid ever appears in any top-k;
+- ANN FRESHNESS: the same converged top-k with ``nprobe=4`` — the
+  consuming segment has no IVF index, so probing falls back to the
+  exact scan and the freshly upserted row STILL ranks first;
+- IVF RECALL: a second, OFFLINE table with vectorIndexConfigs enabled
+  gets clustered embeddings sealed through the real creator (codebook
+  trained at seal); probed top-10 through the broker must hit
+  recall@10 >= 0.95 against the exact-scan answer while scanning
+  under 25% of the rows.
 
 Exit code 0 on success, 1 otherwise. Env knobs:
   VECTOR_SMOKE_ROWS      rows published initially (default 400)
   VECTOR_SMOKE_KEYS      distinct primary keys     (default 100)
   VECTOR_SMOKE_WINDOW_S  convergence window        (default 60)
+  VECTOR_SMOKE_ANN_ROWS  rows per sealed ANN segment (default 4096)
 """
 import os
 import sys
@@ -32,6 +41,7 @@ import numpy as np
 ROWS = int(os.environ.get("VECTOR_SMOKE_ROWS", "400"))
 KEYS = int(os.environ.get("VECTOR_SMOKE_KEYS", "100"))
 WINDOW_S = float(os.environ.get("VECTOR_SMOKE_WINDOW_S", "60"))
+ANN_ROWS = int(os.environ.get("VECTOR_SMOKE_ANN_ROWS", "4096"))
 DIM = 16
 K = 5
 TOPIC = "vector_smoke_topic"
@@ -77,6 +87,83 @@ def tree_scores(mat, q):
         s = (dot / denom).astype(np.float32)
     s[~(denom > 0)] = -np.inf
     return s
+
+
+def ivf_phase(cluster, work_dir) -> bool:
+    """Sealed-segment ANN gate: recall@10 + scanned-fraction through
+    the broker, over a codebook trained by the real SegmentCreator."""
+    from pinot_tpu.common.datatype import DataType
+    from pinot_tpu.common.schema import Schema, dimension, metric, vector
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    rng = np.random.default_rng(77)
+    schema = Schema("vecann", [
+        dimension("shard", DataType.INT),
+        metric("rid", DataType.INT),
+        vector("emb", DIM),
+    ])
+    idx = IndexingConfig()
+    idx.vector_index_configs = {"emb": {"numCentroids": 32}}
+    cfg = TableConfig("vecann", indexing_config=idx)
+    cluster.add_schema(schema)
+    cluster.add_table(cfg)
+
+    # clustered embeddings — the regime IVF exists for: most of a
+    # query's neighbors live in a handful of coarse cells
+    centers = rng.standard_normal((32, DIM)).astype(np.float32) * 4
+    mats = []
+    for s in range(2):
+        which = rng.integers(0, 32, ANN_ROWS)
+        emb = (centers[which] +
+               rng.standard_normal((ANN_ROWS, DIM)) * 0.3
+               ).astype(np.float32)
+        cols = {"shard": rng.integers(0, 4, ANN_ROWS).astype(np.int32),
+                "rid": np.arange(ANN_ROWS, dtype=np.int32) + s * ANN_ROWS,
+                "emb": emb}
+        d = os.path.join(work_dir, f"ann_{s}")
+        SegmentCreator(schema, cfg, segment_name=f"ann_{s}").build(cols, d)
+        cluster.upload_segment("vecann_OFFLINE", d)
+        mats.append(emb)
+
+    aq = (centers[3] + rng.standard_normal(DIM) * 0.3).astype(np.float32)
+    aqs = ", ".join(repr(float(x)) for x in aq)
+
+    def ann_pql(nprobe):
+        clause = f", nprobe={nprobe}" if nprobe else ""
+        return (f"SELECT rid, VECTOR_SIMILARITY(emb, [{aqs}], 10, "
+                f"'COSINE'{clause}) FROM vecann")
+
+    exact = wait_for(lambda: cluster.query(ann_pql(0)), WINDOW_S,
+                     "ANN table exact top-k")
+    if exact is None or exact.exceptions:
+        print(f"FAIL: exact scan over vecann: "
+              f"{exact and exact.exceptions}", file=sys.stderr)
+        return False
+    probed = cluster.query(ann_pql(4))
+    if probed.exceptions:
+        print(f"FAIL: probed scan over vecann: {probed.exceptions}",
+              file=sys.stderr)
+        return False
+    want = {int(r[0]) for r in exact.selection_results.results}
+    got = {int(r[0]) for r in probed.selection_results.results}
+    recall = len(got & want) / len(want)
+    total = 2 * ANN_ROWS
+    frac = probed.num_docs_scanned / total
+    if recall < 0.95:
+        print(f"FAIL: IVF recall@10 {recall:.2f} < 0.95 "
+              f"(want {sorted(want)}, got {sorted(got)})",
+              file=sys.stderr)
+        return False
+    if frac >= 0.25:
+        print(f"FAIL: IVF probe scanned {probed.num_docs_scanned}/"
+              f"{total} rows ({frac:.1%}) — index not narrowing",
+              file=sys.stderr)
+        return False
+    print(f"vector_smoke: IVF probe recall@10={recall:.2f} scanning "
+          f"{probed.num_docs_scanned}/{total} rows ({frac:.1%}) "
+          f"vs the exact broker scan")
+    return True
 
 
 def main() -> int:
@@ -195,6 +282,31 @@ def main() -> int:
         print(f"vector_smoke: upserted embedding ranked FIRST on the "
               f"next converged query (rid {ROWS + 1}); superseded rid "
               f"{old_rid} never ranked again")
+
+        # ANN freshness: the consuming segment carries no IVF index, so
+        # nprobe must fall back to the exact scan — same converged
+        # top-k, fresh row still first, never an error
+        pql_ann = pql.replace("'COSINE'", "'COSINE', nprobe=4")
+
+        def topk_ann():
+            resp = cluster.query(pql_ann)
+            if resp.exceptions or resp.selection_results is None:
+                return None
+            return [(int(row[0]), float(row[-1]))
+                    for row in resp.selection_results.results]
+
+        got_ann = topk_ann()
+        if got_ann != exp2:
+            print(f"FAIL: nprobe fallback diverged from the exact "
+                  f"answer — expected {exp2}, got {got_ann}",
+                  file=sys.stderr)
+            return 1
+        print("vector_smoke: nprobe=4 over the consuming segment fell "
+              "back to the exact scan (identical top-k, fresh row "
+              "first)")
+
+        if not ivf_phase(cluster, work_dir):
+            return 1
         ok = True
     finally:
         cluster.stop()

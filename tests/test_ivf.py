@@ -1,0 +1,480 @@
+"""IVF ANN vector index (ISSUE 20).
+
+Covers the coarse-quantizer lifecycle end to end:
+
+1. **Config surface** — vectorIndexConfigs validation (table create
+   rejects bad type / counts / non-VECTOR columns; IvfRetrainTask
+   config requires an index to retrain), PQL ``nprobe=N`` parse +
+   serde round-trip + fingerprint keying.
+2. **Training degeneracy** — fewer rows than centroids (k clamps),
+   all-identical embeddings (one live centroid, ~0 baseline → drift
+   undefined, probe still serves), NaN/Inf rejected at ingest AND at
+   train (a poisoned minion input must never mint a codebook).
+3. **Probe exactness** — host oracle, device kernel and sharded paths
+   agree BIT-IDENTICALLY on the probed candidate set; recall@10 vs the
+   exact scan on clustered data while scanning a small fraction;
+   exact-scan fallback for index-less segments and mixed stacks.
+4. **Lifecycle** — index artifacts + drift stats stamped at seal,
+   compaction priors carry the trained baseline, minion backfill +
+   drift-triggered retrain through the real queue/worker, upsert
+   freshness unchanged under nprobe.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatype import DataType
+from pinot_tpu.common.request import InstanceRequest
+from pinot_tpu.common.schema import Schema, dimension, metric, vector
+from pinot_tpu.common.serde import (instance_request_from_bytes,
+                                    instance_request_to_bytes,
+                                    request_from_json, request_to_json)
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine import QueryEngine
+from pinot_tpu.index import ivf
+from pinot_tpu.pql.lexer import PqlSyntaxError
+from pinot_tpu.pql.parser import compile_pql
+from pinot_tpu.query.fingerprint import query_fingerprint
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegmentLoader
+
+DIM = 16
+N_CENTROIDS = 16
+
+
+def ivf_schema(dim=DIM, name="vectab"):
+    return Schema(name, [
+        dimension("shard", DataType.INT),
+        metric("rid", DataType.INT),
+        vector("emb", dim),
+    ])
+
+
+def ivf_table_config(num_centroids=N_CENTROIDS, indexed=True, **extra):
+    idx = IndexingConfig()
+    if indexed:
+        idx.vector_index_configs = {
+            "emb": {"numCentroids": num_centroids, **extra}}
+    return TableConfig("vectab", indexing_config=idx)
+
+
+def clustered_columns(n, seed=0, dim=DIM, rid_base=0, n_clusters=8):
+    """Embeddings drawn tightly around a few cluster centers, so the
+    coarse quantizer's partition is meaningful and recall measurable."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32) * 4
+    which = rng.integers(0, n_clusters, n)
+    emb = centers[which] + \
+        rng.standard_normal((n, dim)).astype(np.float32) * 0.3
+    return {
+        "shard": rng.integers(0, 4, n).astype(np.int32),
+        "rid": (np.arange(n, dtype=np.int32) + rid_base),
+        "emb": emb.astype(np.float32),
+    }
+
+
+def build_ivf_segments(base, n_segs=2, n=2048, dim=DIM, seed=3,
+                       indexed=True):
+    segs, cols_list = [], []
+    cfg = ivf_table_config(indexed=indexed)
+    for s in range(n_segs):
+        cols = clustered_columns(n, seed=seed + s, dim=dim, rid_base=s * n)
+        d = os.path.join(base, f"v{s}")
+        SegmentCreator(ivf_schema(dim), cfg,
+                       segment_name=f"v{s}").build(cols, d)
+        segs.append(ImmutableSegmentLoader.load(d))
+        cols_list.append(cols)
+    return segs, cols_list
+
+
+def pql_for(q, k=7, metric="COSINE", where="WHERE shard < 2",
+            nprobe=0):
+    qs = ", ".join(repr(float(x)) for x in q)
+    np_clause = f", nprobe={nprobe}" if nprobe else ""
+    return (f"SELECT rid, VECTOR_SIMILARITY(emb, [{qs}], {k}, "
+            f"'{metric}'{np_clause}) FROM vectab {where}").strip()
+
+
+def result_rows(resp):
+    assert not resp.exceptions, resp.exceptions
+    return [tuple(r) for r in resp.selection_results.results]
+
+
+# ---------------------------------------------------------------------------
+# tier 1: config surface
+# ---------------------------------------------------------------------------
+
+
+def test_validate_config_rejects_bad_knobs():
+    ivf.validate_config(dict(ivf.DEFAULT_CONFIG), "emb")     # fine
+    with pytest.raises(ValueError, match="unknown type"):
+        ivf.validate_config({"type": "HNSW"}, "emb")
+    for key in ("numCentroids", "trainIterations", "trainSampleSize"):
+        with pytest.raises(ValueError, match=key):
+            ivf.validate_config({**ivf.DEFAULT_CONFIG, key: 0}, "emb")
+
+
+def test_column_config_merges_defaults():
+    cfg = ivf.column_config(ivf_table_config(num_centroids=9), "emb")
+    assert cfg["numCentroids"] == 9
+    assert cfg["trainIterations"] == ivf.DEFAULT_CONFIG["trainIterations"]
+    assert ivf.column_config(ivf_table_config(), "other") is None
+    assert ivf.column_config(ivf_table_config(indexed=False), "emb") is None
+
+
+def test_controller_rejects_bad_ivf_configs(tmp_path):
+    from pinot_tpu.controller.manager import InvalidTableConfigError
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    cluster = EmbeddedCluster(str(tmp_path), num_servers=1)
+    try:
+        cluster.add_schema(ivf_schema())
+        with pytest.raises(InvalidTableConfigError, match="unknown type"):
+            cluster.add_table(ivf_table_config(type="HNSW"))
+        with pytest.raises(InvalidTableConfigError, match="numCentroids"):
+            cluster.add_table(ivf_table_config(num_centroids=0))
+        bad = ivf_table_config()
+        bad.indexing_config.vector_index_configs = {"rid": {}}
+        with pytest.raises(InvalidTableConfigError, match="non-VECTOR"):
+            cluster.add_table(bad)
+        # retrain task without any index configured: nothing to retrain
+        no_idx = ivf_table_config(indexed=False)
+        no_idx.task_configs = {"IvfRetrainTask": {}}
+        with pytest.raises(InvalidTableConfigError,
+                           match="vectorIndexConfigs"):
+            cluster.add_table(no_idx)
+    finally:
+        cluster.stop()
+
+
+def test_pql_nprobe_parse_serde_fingerprint():
+    q = [0.5] * DIM
+    req = compile_pql(pql_for(q, nprobe=8))
+    assert req.vector.nprobe == 8
+    exact = compile_pql(pql_for(q))
+    assert exact.vector.nprobe == 0
+    with pytest.raises(PqlSyntaxError, match="nprobe"):
+        compile_pql(pql_for(q).replace("'COSINE'", "'COSINE', nprobe=0"))
+    # serde round-trips (broker JSON and server wire)
+    again = request_from_json(request_to_json(req))
+    assert again.vector.nprobe == 8
+    ir = InstanceRequest(request_id=1, broker_id="b", query=req,
+                         search_segments=["v0"])
+    wire = instance_request_from_bytes(instance_request_to_bytes(ir))
+    assert wire.query.vector.nprobe == 8
+    # ANN and exact plans must never share a fingerprint (the result
+    # cache and batch coalescer key on it)
+    assert query_fingerprint(req) != query_fingerprint(exact)
+    assert query_fingerprint(req) != \
+        query_fingerprint(compile_pql(pql_for(q, nprobe=4)))
+
+
+# ---------------------------------------------------------------------------
+# tier 2: training degeneracy
+# ---------------------------------------------------------------------------
+
+
+def test_train_clamps_k_to_rows():
+    rng = np.random.default_rng(0)
+    mat = rng.standard_normal((5, DIM)).astype(np.float32)
+    index = ivf.train(mat, num_centroids=64, iterations=4, seed=0,
+                      sample_size=65536)
+    assert index.num_centroids == 5
+    assert index.assignments.shape == (5,)
+    assert (index.assignments >= 0).all()
+    assert (index.assignments < 5).all()
+
+
+def test_train_identical_embeddings():
+    mat = np.ones((128, DIM), np.float32)
+    index = ivf.train(mat, num_centroids=8, iterations=4, seed=0,
+                      sample_size=65536)
+    # every row lands on one centroid at distance ~0 → the drift ratio
+    # is undefined (division by ~0) and must read as None, not inf
+    assert index.meta["baselineMeanDist"] < 1e-6
+    custom = {}
+    ivf.stamp_custom(custom, "emb", index.meta)
+    assert ivf.drift_from_custom(custom, "emb") is None
+    live = np.unique(index.assignments)
+    assert len(live) == 1
+
+
+def test_identical_embeddings_probe_still_serves(tmp_path):
+    """Degenerate codebook (one live centroid) must still answer."""
+    n = 64
+    cols = {"shard": np.zeros(n, np.int32),
+            "rid": np.arange(n, dtype=np.int32),
+            "emb": np.ones((n, DIM), np.float32)}
+    d = os.path.join(str(tmp_path), "ident")
+    SegmentCreator(ivf_schema(), ivf_table_config(num_centroids=8),
+                   segment_name="ident").build(cols, d)
+    seg = ImmutableSegmentLoader.load(d)
+    pql = pql_for(np.ones(DIM), k=5, metric="DOT", where="", nprobe=2)
+    rh = result_rows(QueryEngine([seg], use_device=False).query(pql))
+    rd = result_rows(QueryEngine([seg]).query(pql))
+    assert rh == rd and len(rh) == 5
+
+
+def test_nan_inf_rejected_everywhere():
+    mat = np.ones((16, DIM), np.float32)
+    mat[3, 2] = np.nan
+    with pytest.raises(ValueError, match="NaN/Inf"):
+        ivf.train(mat, num_centroids=4, iterations=2, seed=0,
+                  sample_size=100)
+    mat[3, 2] = np.inf
+    with pytest.raises(ValueError, match="NaN/Inf"):
+        ivf.train(mat, num_centroids=4, iterations=2, seed=0,
+                  sample_size=100)
+    # ingest: FieldSpec.convert already refuses non-finite rows
+    f = ivf_schema().field("emb")
+    with pytest.raises(ValueError, match="NaN/Inf"):
+        f.convert([float("nan")] + [0.0] * (DIM - 1))
+    # seal: the creator refuses to mint an index (or a forward block)
+    # from a poisoned matrix that bypassed ingest
+    cols = {"shard": np.zeros(16, np.int32),
+            "rid": np.arange(16, dtype=np.int32), "emb": mat}
+    with pytest.raises(ValueError, match="finite|NaN/Inf"):
+        SegmentCreator(ivf_schema(), ivf_table_config(num_centroids=4),
+                       segment_name="bad").build(
+            cols, tempfile.mkdtemp())
+
+
+# ---------------------------------------------------------------------------
+# tier 3: probe exactness + fallbacks
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ivf_setup():
+    base = tempfile.mkdtemp()
+    segs, cols_list = build_ivf_segments(base, n_segs=2, n=2048)
+    rng = np.random.default_rng(99)
+    q = rng.standard_normal(DIM).astype(np.float32)
+    return segs, cols_list, q
+
+
+@pytest.mark.parametrize("metric", ["COSINE", "DOT"])
+def test_probed_topk_bit_identical(ivf_setup, metric):
+    from pinot_tpu.parallel import make_mesh
+    segs, _cols, q = ivf_setup
+    pql = pql_for(q, k=9, metric=metric, nprobe=4)
+    rh = result_rows(QueryEngine(segs, use_device=False).query(pql))
+    rd = result_rows(QueryEngine(segs).query(pql))
+    rs = result_rows(QueryEngine(segs, mesh=make_mesh()).query(pql))
+    assert rh == rd == rs
+    assert len(rh) == 9
+
+
+def test_probe_scans_fraction_and_recall(ivf_setup):
+    segs, _cols, q = ivf_setup
+    exact = QueryEngine(segs, use_device=False).query(
+        pql_for(q, k=10, where=""))
+    probed = QueryEngine(segs, use_device=False).query(
+        pql_for(q, k=10, where="", nprobe=3))
+    total = sum(s.num_docs for s in segs)
+    assert probed.num_docs_scanned < 0.5 * total
+    assert probed.num_docs_scanned < exact.num_docs_scanned
+    got = {r[:3] for r in result_rows(probed)}
+    want = {r[:3] for r in result_rows(exact)}
+    recall = len(got & want) / len(want)
+    assert recall >= 0.9, (recall, got, want)
+
+
+def test_nprobe_on_indexless_segments_is_exact(tmp_path):
+    """ANN is best-effort: no index → silently exact, never an error."""
+    segs, _cols = build_ivf_segments(str(tmp_path), n_segs=2, n=512,
+                                     indexed=False)
+    q = np.random.default_rng(7).standard_normal(DIM).astype(np.float32)
+    exact = result_rows(QueryEngine(segs, use_device=False).query(
+        pql_for(q, k=6)))
+    for engine in (QueryEngine(segs, use_device=False),
+                   QueryEngine(segs)):
+        assert result_rows(engine.query(pql_for(q, k=6, nprobe=4))) == exact
+
+
+def test_mixed_stack_falls_back_to_sequential(tmp_path):
+    """One indexed + one index-less segment: the sharded path must not
+    stack them (probe/exact divergence) — it falls back and stays
+    bit-identical to the sequential paths."""
+    from pinot_tpu.parallel import make_mesh
+    from pinot_tpu.parallel.sharded import NotShardable, StackedSegments
+    seg_i, _ = build_ivf_segments(os.path.join(str(tmp_path), "i"),
+                                  n_segs=1, n=512, indexed=True)
+    seg_x, _ = build_ivf_segments(os.path.join(str(tmp_path), "x"),
+                                  n_segs=1, n=512, seed=11, indexed=False)
+    segs = [seg_i[0], seg_x[0]]
+    q = np.random.default_rng(13).standard_normal(DIM).astype(np.float32)
+    pql = pql_for(q, k=6, where="", nprobe=4)
+    rh = result_rows(QueryEngine(segs, use_device=False).query(pql))
+    rd = result_rows(QueryEngine(segs).query(pql))
+    rs = result_rows(QueryEngine(segs, mesh=make_mesh()).query(pql))
+    assert rh == rd == rs and len(rh) == 6
+
+
+def test_probe_mask_np_matches_device_selection(ivf_setup):
+    """The host oracle's probe mask and the device probe-select kernel
+    pick the SAME centroid lists (identical tie-breaks)."""
+    segs, _cols, q = ivf_setup
+    ds = segs[0].data_source("emb")
+    cents = ds.ivf_centroids
+    nprobe = 3
+    q_pad = np.zeros(ivf.pad_dim(DIM), np.float32)
+    q_pad[:DIM] = q
+    q_norm = np.float32(np.sqrt((q_pad * q_pad).sum()))
+    cpad = ds.host_operand("ivfc")
+    cvalid = ds.host_operand("ivfv")
+    probes, ok = ivf.select_probes_np(cpad, cvalid, q_pad, q_norm,
+                                      "COSINE", nprobe)
+    from pinot_tpu.ops import kernels
+    dev_probes, dev_ok = kernels.ivf_select_probes(
+        cpad, cvalid.astype(bool), q_pad, q_norm, "COSINE", nprobe)
+    assert np.array_equal(probes, np.asarray(dev_probes))
+    assert np.array_equal(ok, np.asarray(dev_ok))
+    assert (np.asarray(probes)[np.asarray(ok)] <
+            ivf.pad_centroids(cents.shape[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# tier 4: lifecycle — seal stamps, priors, minion retrain, upsert
+# ---------------------------------------------------------------------------
+
+
+def test_seal_writes_index_and_stamps_custom(tmp_path):
+    cols = clustered_columns(512, seed=1)
+    d = os.path.join(str(tmp_path), "s0")
+    SegmentCreator(ivf_schema(), ivf_table_config(),
+                   segment_name="s0").build(cols, d)
+    index = ivf.load_index(d, "emb")
+    assert index is not None
+    assert index.num_centroids == N_CENTROIDS
+    assert index.assignments.shape == (512,)
+    seg = ImmutableSegmentLoader.load(d)
+    custom = seg.metadata.custom
+    assert ivf.CUSTOM_CENTROIDS.format(col="emb") in custom
+    drift = ivf.drift_from_custom(custom, "emb")
+    assert drift is not None and abs(drift) < 1e-9     # fresh train
+    # deterministic: same rows + seed → identical artifacts
+    d2 = os.path.join(str(tmp_path), "s1")
+    SegmentCreator(ivf_schema(), ivf_table_config(),
+                   segment_name="s1").build(cols, d2)
+    again = ivf.load_index(d2, "emb")
+    assert np.array_equal(index.centroids, again.centroids)
+    assert np.array_equal(index.assignments, again.assignments)
+
+
+def test_priors_carry_baseline_fresh_train_resets():
+    rng = np.random.default_rng(3)
+    mat = rng.standard_normal((600, DIM)).astype(np.float32)
+    cfg = dict(ivf.DEFAULT_CONFIG, numCentroids=8)
+    trained = ivf.build_for_column(mat, cfg)
+    base = trained.meta["baselineMeanDist"]
+    # drifted survivors reassigned under the OLD codebook (compaction):
+    # meanDist grows, the baseline is CARRIED → positive drift
+    drifted = mat * 1.8
+    rebuilt = ivf.build_for_column(drifted, cfg, priors=trained)
+    assert rebuilt.meta["baselineMeanDist"] == base
+    assert rebuilt.meta["meanDist"] > base
+    assert np.array_equal(rebuilt.centroids, trained.centroids)
+    custom = {}
+    ivf.stamp_custom(custom, "emb", rebuilt.meta)
+    assert ivf.drift_from_custom(custom, "emb") > 0.3
+    # a fresh train over the drifted rows RESETS the baseline
+    fresh = ivf.build_for_column(drifted, cfg)
+    assert fresh.meta["baselineMeanDist"] == fresh.meta["meanDist"]
+
+
+def test_minion_backfill_and_drift_retrain(tmp_path):
+    """End to end through the real queue: a segment sealed BEFORE the
+    index existed gets a backfill task; a drifted segment gets exactly
+    one retrain that resets its drift to ~0; idle afterwards."""
+    from pinot_tpu.controller.manager import SEGMENTS
+    from pinot_tpu.minion import MinionWorker, PinotTaskManager
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    base = str(tmp_path)
+    cluster = EmbeddedCluster(os.path.join(base, "cluster"),
+                              num_servers=1)
+    try:
+        cluster.add_schema(ivf_schema())
+        cfg = ivf_table_config()
+        cfg.task_configs = {"IvfRetrainTask": {
+            "retrainDriftThreshold": "0.2"}}
+        cluster.add_table(cfg)
+        # built WITHOUT the index config → sealed pre-index
+        cols = clustered_columns(600, seed=5)
+        d = os.path.join(base, "old")
+        SegmentCreator(ivf_schema(), ivf_table_config(indexed=False),
+                       segment_name="old").build(cols, d)
+        cluster.upload_segment("vectab_OFFLINE", d)
+
+        manager = cluster.controller.manager
+        tm = PinotTaskManager(manager)
+        ids = tm.schedule_tasks()
+        assert len(ids) == 1                  # backfill scheduled once
+        assert tm.schedule_tasks() == []      # deduped while open
+        worker = MinionWorker(manager,
+                              work_dir=os.path.join(base, "minion"))
+        assert sorted(worker.drain()) == sorted(ids)
+        meta = manager.segment_metadata("vectab_OFFLINE", "old")
+        custom = meta.get("customMap") or {}
+        assert ivf.CUSTOM_CENTROIDS.format(col="emb") in custom
+        assert abs(ivf.drift_from_custom(custom, "emb")) < 1e-9
+        assert tm.schedule_tasks() == []      # fresh index: idle
+
+        # simulate embedding churn: bump the published meanDist 1.5x
+        path = f"{SEGMENTS}/vectab_OFFLINE/old"
+        rec = manager.store.get(path)
+        cm = dict(rec["customMap"])
+        key = ivf.CUSTOM_MEAN.format(col="emb")
+        cm[key] = repr(float(cm[key]) * 1.5)
+        manager.store.set(path, {**rec, "customMap": cm})
+        ids2 = tm.schedule_tasks()
+        assert len(ids2) == 1                 # drift over threshold
+        assert sorted(worker.drain()) == sorted(ids2)
+        meta2 = manager.segment_metadata("vectab_OFFLINE", "old")
+        drift = ivf.drift_from_custom(meta2["customMap"], "emb")
+        assert abs(drift) < 1e-9              # retrain reset baseline
+        assert tm.schedule_tasks() == []
+        # the retrained segment still serves ANN queries
+        q = np.random.default_rng(9).standard_normal(DIM)
+        resp = cluster.query(pql_for(q, k=5, where="", nprobe=4))
+        assert len(result_rows(resp)) == 5
+    finally:
+        cluster.stop()
+
+
+def test_upsert_freshness_unchanged_under_nprobe():
+    """Consuming segments carry no IVF index: nprobe falls back to the
+    exact scan, so an upsert published mid-run still ranks FIRST on the
+    immediately following query — freshness is never traded away."""
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.reduce import BrokerReduceService
+    from pinot_tpu.realtime.mutable_segment import MutableSegmentImpl
+    from pinot_tpu.realtime.upsert import ValidDocIds
+    impl = MutableSegmentImpl(ivf_schema(), ivf_table_config(),
+                              "vectab__0__0")
+    impl.valid_doc_ids = ValidDocIds()
+    rng = np.random.default_rng(17)
+    impl.index_rows([
+        {"shard": int(i % 4), "rid": i,
+         "emb": [float(x) for x in
+                 rng.standard_normal(DIM).astype(np.float32)]}
+        for i in range(400)])
+    q = rng.standard_normal(DIM).astype(np.float32)
+    unit = (q / np.linalg.norm(q)).astype(np.float32)
+    req = compile_pql(pql_for(q, k=5, where="", nprobe=4))
+
+    def run(executor):
+        blk = executor.execute(req, [impl])
+        return result_rows(BrokerReduceService().reduce(req, [blk]))
+
+    dev, host = ServerQueryExecutor(), ServerQueryExecutor(use_device=False)
+    assert run(dev) == run(host)
+    new_doc = impl.num_docs
+    impl.index_rows([{"shard": 0, "rid": 777_000,
+                      "emb": [float(x) for x in unit]}])
+    impl.valid_doc_ids.invalidate(10)
+    r_dev, r_host = run(dev), run(host)
+    assert r_dev == r_host
+    assert r_dev[0][:2] == (777_000, new_doc)
+    assert all(row[1] != 10 for row in r_dev)
